@@ -1,0 +1,94 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built from scratch on JAX/XLA/Pallas/pjit.
+
+Top-level namespace mirrors `paddle.*` (reference:
+`/root/reference/python/paddle/__init__.py`): tensor ops, `nn`, `optimizer`,
+`io`, `amp`, `jit`, `distributed`, `metric`, `profiler`, `vision`, `static`.
+"""
+from __future__ import annotations
+
+import warnings as _warnings
+
+# TPU-first dtype policy: x64 stays off (int64 silently maps to int32 in XLA
+# ops; TPU has no fast int64/float64 path). Silence the per-op truncation
+# warning once here.
+_warnings.filterwarnings(
+    "ignore", message=".*requested in astype is not available.*")
+_warnings.filterwarnings(
+    "ignore", message=".*Explicitly requested dtype.*truncated.*")
+
+from .framework.tensor import Tensor  # noqa: E402,F401
+from .framework.param import Parameter  # noqa: E402,F401
+from .framework import dtype as _dtype_mod  # noqa: E402
+from .framework.dtype import (  # noqa: E402,F401
+    bfloat16, bool_, complex128, complex64, float16, float32, float64,
+    get_default_dtype, iinfo, finfo, int16, int32, int64, int8,
+    set_default_dtype, uint8,
+)
+from .framework.place import (  # noqa: E402,F401
+    CPUPlace, CUDAPlace, CustomPlace, TPUPlace, device_count, get_device,
+    is_compiled_with_tpu, set_device,
+)
+from .framework.random import get_rng_state, seed, set_rng_state  # noqa: E402,F401
+from .framework.tape import enable_grad, grad, no_grad  # noqa: E402,F401
+from .framework.io import load, save  # noqa: E402,F401
+
+from .ops import *  # noqa: E402,F401,F403
+from .ops import linalg  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from .nn.initializer import ParamAttr  # noqa: E402,F401
+
+# paddle-API conveniences
+from .ops.creation import to_tensor  # noqa: E402,F401
+
+DataParallel = None  # bound lazily by paddle_tpu.distributed import
+
+
+def is_grad_enabled():
+    from .framework import tape
+    return tape.grad_enabled()
+
+
+def set_grad_enabled(mode: bool):
+    from .framework import tape
+    st = tape._state()
+
+    class _Ctx:
+        def __init__(self):
+            self.prev = st.grad_enabled
+            st.grad_enabled = bool(mode)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            st.grad_enabled = self.prev
+            return False
+    return _Ctx()
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parameter count summary (hapi parity-lite)."""
+    total = 0
+    trainable = 0
+    for _, p in net.named_parameters():
+        n = p.size
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+    info = {"total_params": total, "trainable_params": trainable}
+    print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+    return info
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,149 @@
+"""AMP — bf16-first automatic mixed precision.
+
+Reference: `python/paddle/amp/` (`auto_cast.py:21`, `decorate:81`,
+`grad_scaler.py:26`) and the C++ autocast hook
+(`/root/reference/paddle/fluid/imperative/amp_auto_cast.h:44`). On TPU the
+native fast dtype is bfloat16: loss scaling is a no-op by default (bf16 has
+fp32's exponent range) but the `GradScaler` API is kept for parity, and does
+real dynamic scaling when `dtype='float16'` is requested.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops._dispatch import amp_state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    st = amp_state()
+    prev = dict(st)
+    st["enabled"] = bool(enable)
+    st["level"] = level
+    st["dtype"] = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+    st["custom_white"] = set(custom_white_list or ())
+    st["custom_black"] = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        st.update(prev)
+
+
+amp_guard = auto_cast  # legacy alias
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model parameters to the amp dtype (master weights stay fp32
+    inside the optimizer's slot math)."""
+    amp_dtype = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if p.data.dtype == jnp.dtype(jnp.float32):
+                    p.data = p.data.astype(amp_dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (needed for fp16; pass-through for bf16).
+
+    Reference: `python/paddle/amp/grad_scaler.py:26` +
+    `check_finite_and_unscale` / `update_loss_scaling` ops.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False  # OptimizerState.UNSCALED equivalent
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        import jax.numpy as jnp
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad.data * inv
+                found_inf = found_inf | bool(~jnp.all(jnp.isfinite(g)))
+                p.grad = Tensor(g)
+        self._found_inf = bool(found_inf)
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        # unscale happens against the already-populated grads
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)

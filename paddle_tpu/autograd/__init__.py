@@ -1,0 +1,172 @@
+"""paddle_tpu.autograd — functional transforms + PyLayer.
+
+Reference: `python/paddle/autograd/` (`functional.py:22,79,165,255` vjp/jvp/
+Jacobian/Hessian, `py_layer.py` PyLayer). Implemented directly over jax
+transforms — higher-order gradients come for free (unlike the eager-tape
+`paddle_tpu.grad`, these compose).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import tape as tape_mod
+from ..framework.tensor import Tensor
+
+backward = tape_mod.backward
+
+
+def _wrap_fn(func):
+    def pure(*arrs):
+        with tape_mod.no_grad():
+            out = func(*[Tensor(a) for a in arrs])
+        if isinstance(out, (list, tuple)):
+            return tuple(o.data if isinstance(o, Tensor) else o for o in out)
+        return out.data if isinstance(out, Tensor) else out
+    return pure
+
+
+def _unwrap_all(xs):
+    if isinstance(xs, Tensor):
+        return (xs.data,), True
+    return tuple(x.data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs), False
+
+
+def vjp(func, xs, v=None):
+    arrs, single = _unwrap_all(xs)
+    out, vjp_fn = jax.vjp(_wrap_fn(func), *arrs)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot = v.data if isinstance(v, Tensor) else tuple(
+            t.data if isinstance(t, Tensor) else t for t in v)
+    grads = vjp_fn(cot)
+    out_t = jax.tree_util.tree_map(Tensor, out)
+    grads_t = [Tensor(g) for g in grads]
+    return out_t, (grads_t[0] if single else grads_t)
+
+
+def jvp(func, xs, v=None):
+    arrs, single = _unwrap_all(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        vv = (v,) if isinstance(v, Tensor) else v
+        tangents = tuple(t.data if isinstance(t, Tensor) else jnp.asarray(t)
+                         for t in vv)
+    out, tang = jax.jvp(_wrap_fn(func), arrs, tangents)
+    return (jax.tree_util.tree_map(Tensor, out),
+            jax.tree_util.tree_map(Tensor, tang))
+
+
+class Jacobian:
+    """Lazy full Jacobian (reference `functional.py:165`)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        arrs, self._single = _unwrap_all(xs)
+        fn = _wrap_fn(func)
+        if is_batched:
+            jac_fn = jax.vmap(jax.jacrev(fn, argnums=tuple(range(len(arrs)))))
+        else:
+            jac_fn = jax.jacrev(fn, argnums=tuple(range(len(arrs))))
+        self._jac = jac_fn(*arrs)
+
+    def __getitem__(self, idx):
+        j = self._jac
+        if self._single and isinstance(j, tuple):
+            j = j[0]
+        arr = j[idx] if not isinstance(j, tuple) else tuple(x[idx] for x in j)
+        return jax.tree_util.tree_map(Tensor, arr)
+
+    @property
+    def shape(self):
+        j = self._jac[0] if isinstance(self._jac, tuple) else self._jac
+        return list(j.shape)
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        arrs, self._single = _unwrap_all(xs)
+        fn = _wrap_fn(func)
+        hess_fn = jax.hessian(fn, argnums=0)
+        self._hess = hess_fn(*arrs)
+
+    def __getitem__(self, idx):
+        return jax.tree_util.tree_map(Tensor, self._hess[idx])
+
+    @property
+    def shape(self):
+        return list(self._hess.shape)
+
+
+def hessian(func, xs, batch_axis=None):
+    return Hessian(func, xs, is_batched=batch_axis is not None)
+
+
+def jacobian(func, xs, batch_axis=None):
+    return Jacobian(func, xs, is_batched=batch_axis is not None)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (reference `python/paddle/autograd/py_layer.py`).
+
+    Subclass with static `forward(ctx, *args)` / `backward(ctx, *grads)`.
+    Recorded on the eager tape like any other op.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        with tape_mod.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = isinstance(out, Tensor)
+        outs = (out,) if single else tuple(out)
+        requires = tape_mod.grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args)
+        if requires:
+            out_tensors = tuple(Tensor(o.data, stop_gradient=False) for o in outs)
+
+            def vjp_fn(cotangents):
+                with tape_mod.no_grad():
+                    grads = cls.backward(
+                        ctx, *[Tensor(c) for c in cotangents])
+                if isinstance(grads, Tensor):
+                    grads = (grads,)
+                g_arrays = [g.data if isinstance(g, Tensor) else g for g in grads]
+                # map returned grads positionally onto tensor inputs
+                return tuple(g_arrays)
+
+            tape_mod.record(vjp_fn, tensor_args, out_tensors, name=cls.__name__)
+            return out_tensors[0] if single else out_tensors
+        return out

@@ -1,0 +1,5 @@
+"""Core framework: Tensor, Parameter, autograd tape, dtype/place/random, IO."""
+from . import dtype, place, random, tape  # noqa: F401
+from .io import load, save  # noqa: F401
+from .param import Parameter  # noqa: F401
+from .tensor import Tensor  # noqa: F401

@@ -1,0 +1,98 @@
+"""Dtype registry and default-dtype policy.
+
+TPU-native equivalent of the reference's dtype plumbing
+(`/root/reference/paddle/phi/common/data_type.h`,
+`python/paddle/framework/dtype.py`): every paddle dtype maps onto a JAX/numpy
+dtype. bfloat16 is first-class (the TPU MXU native format).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes are numpy dtype instances).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "fp16": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_default_dtype = jnp.float32
+
+
+def convert_dtype(dtype):
+    """Normalize a user-supplied dtype (str / np.dtype / jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise ValueError(f"unknown dtype {dtype!r}")
+        return jnp.dtype(_STR2DTYPE[dtype])
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype ('float32', 'bfloat16', ...)."""
+    return jnp.dtype(dtype).name
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16),
+                 jnp.dtype(jnp.float32), jnp.dtype(jnp.float64)):
+        raise TypeError(f"default dtype must be a float type, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return jnp.dtype(_default_dtype)
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+
+def promote_types(a, b):
+    return jnp.promote_types(a, b)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(convert_dtype(dtype))

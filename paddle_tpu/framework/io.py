@@ -1,0 +1,64 @@
+"""Checkpoint save/load.
+
+Reference: `paddle.save/load` (`/root/reference/python/paddle/framework/io.py:568,784`)
+— pickled nested state_dicts of numpy arrays. Distributed/sharded arrays are
+gathered to host numpy at save time; `paddle_tpu.distributed.checkpoint`
+layers orbax-style sharded checkpoints on top for multi-host.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+from .param import Parameter
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj.data),
+                "stop_gradient": obj.stop_gradient,
+                "is_param": isinstance(obj, Parameter), "name": obj.name}
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("is_param") else Tensor
+            t = cls(jnp.asarray(obj["data"]))
+            if not obj.get("is_param"):
+                t.stop_gradient = obj.get("stop_gradient", True)
+            t.name = obj.get("name")
+            return t
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        raw = pickle.load(f)
+    return _from_saveable(raw, return_numpy=return_numpy)

@@ -1,0 +1,27 @@
+"""Parameter — trainable leaf tensor.
+
+Reference: `EagerParamBase` (`/root/reference/python/paddle/fluid/framework.py:6518`).
+"""
+from __future__ import annotations
+
+from .tensor import Tensor
+
+
+class Parameter(Tensor):
+    __slots__ = ("trainable", "regularizer", "need_clip", "optimize_attr", "is_distributed")
+
+    def __init__(self, data, dtype=None, name=None, trainable: bool = True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.regularizer = None
+        self.need_clip = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.is_distributed = False
+        self.persistable = True
+
+    @property
+    def trainable_(self):
+        return self.trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

@@ -1,0 +1,113 @@
+"""Device placement.
+
+TPU-native equivalent of the reference Place taxonomy
+(`/root/reference/paddle/fluid/platform/place.h`) and
+`paddle.set_device` (`python/paddle/device/__init__.py`). Places map onto
+`jax.Device` objects; the default device is process-global, mirroring the
+reference's `DeviceContextPool` current-device semantics.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    """Base place. Wraps a jax.Device."""
+
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    @property
+    def jax_device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if _platform_of(d) == self.device_type]
+        if not devs:
+            # Graceful fallback (e.g. TPUPlace requested on a CPU-only host).
+            devs = jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+
+def _platform_of(dev: jax.Device) -> str:
+    p = dev.platform
+    # the axon/libtpu plugin reports 'tpu' (sometimes 'axon'); normalize
+    if p in ("tpu", "axon"):
+        return "tpu"
+    return p
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+# Alias kept for API familiarity with the reference's CUDAPlace: on this
+# framework the accelerator is a TPU.
+CUDAPlace = TPUPlace
+
+
+class CustomPlace(Place):
+    def __init__(self, device_type: str, device_id: int = 0):
+        super().__init__(device_id)
+        self.device_type = device_type
+
+
+_expected_place: Place | None = None
+
+
+def set_device(device) -> Place:
+    """paddle.set_device('tpu:0' | 'cpu' | 'tpu')."""
+    global _expected_place
+    if isinstance(device, Place):
+        _expected_place = device
+        return device
+    if not isinstance(device, str):
+        raise TypeError(f"device must be str or Place, got {type(device)}")
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    name = name.lower()
+    if name == "cpu":
+        _expected_place = CPUPlace()
+    elif name in ("tpu", "gpu", "cuda", "xpu", "npu"):
+        # accelerator names all route to the TPU on this framework
+        _expected_place = TPUPlace(idx)
+    else:
+        _expected_place = CustomPlace(name, idx)
+    return _expected_place
+
+
+def get_device() -> str:
+    p = get_expected_place()
+    return f"{p.device_type}:{p.device_id}" if p.device_type != "cpu" else "cpu"
+
+
+def get_expected_place() -> Place:
+    global _expected_place
+    if _expected_place is None:
+        d = jax.devices()[0]
+        _expected_place = CPUPlace() if _platform_of(d) == "cpu" else TPUPlace(0)
+    return _expected_place
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(_platform_of(d) == "tpu" for d in jax.devices())
+
+
+def device_count() -> int:
+    return len(jax.devices())

@@ -1,0 +1,274 @@
+"""Eager autograd tape.
+
+TPU-native replacement for the reference's dygraph autograd engine
+(`/root/reference/paddle/fluid/eager/backward.cc:521` `RunBackward`,
+`imperative/basic_engine.cc:391`): instead of per-op C++ GradNodes, every
+differentiable eager op records a `jax.vjp` closure on a thread-local tape.
+`backward()` walks the tape in reverse creation order (already a topological
+order for an eager program) and accumulates cotangents — the JAX residuals
+play the role of the reference's `TensorWrapper` saved tensors.
+
+Inside `jit`-compiled functions the tape is irrelevant: compiled training steps
+differentiate functionally with `jax.grad`/`jax.vjp` directly.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+_tls = threading.local()
+
+
+def _state():
+    if not hasattr(_tls, "tape"):
+        _tls.tape = []
+        _tls.grad_enabled = True
+    return _tls
+
+
+class Node:
+    """One recorded differentiable op: cotangents flow outputs -> inputs."""
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "out_meta", "name", "released")
+
+    def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], outputs: Sequence[Any],
+                 out_meta: Sequence[tuple], name: str):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)    # Tensor objects (kept alive for accumulation)
+        # weak refs: a dead output can never receive a cotangent (all consumers
+        # hold strong input refs), and weakness lets all-dead nodes be pruned;
+        # id() of a dead object is never consulted, so CPython id reuse is safe
+        self.outputs = [weakref.ref(o) for o in outputs]
+        self.out_meta = list(out_meta)  # (shape, dtype) per output, for zero cotangents
+        self.name = name
+        self.released = False
+
+    @property
+    def out_ids(self):
+        """ids of live outputs; dead outputs yield a non-matching sentinel."""
+        return [id(o) if (o := ref()) is not None else -1 - i
+                for i, ref in enumerate(self.outputs)]
+
+    def all_outputs_dead(self):
+        return all(ref() is None for ref in self.outputs)
+
+
+def grad_enabled() -> bool:
+    return _state().grad_enabled
+
+
+class no_grad:
+    """Context manager & decorator, `paddle.no_grad` equivalent."""
+
+    def __enter__(self):
+        st = _state()
+        self._prev = st.grad_enabled
+        st.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state().grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        st = _state()
+        self._prev = st.grad_enabled
+        st.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state().grad_enabled = self._prev
+        return False
+
+
+_PRUNE_INTERVAL = 2048
+
+
+def record(vjp_fn, inputs, outputs, name="op") -> Node:
+    node = Node(vjp_fn, inputs, outputs,
+                [(o.data.shape, o.data.dtype) for o in outputs], name)
+    st = _state()
+    st.tape.append(node)
+    for o in outputs:
+        o._node = node
+    # periodic GC: nodes whose outputs are all dead cannot propagate anything
+    if len(st.tape) % _PRUNE_INTERVAL == 0:
+        st.tape = [n for n in st.tape
+                   if not (n.released or n.all_outputs_dead())]
+    return node
+
+
+def tape_size() -> int:
+    return len(_state().tape)
+
+
+def reset_tape():
+    _state().tape = []
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """Reverse-accumulate gradients from `tensors` into leaf `.grad`s.
+
+    Mirrors `egr::Backward` (`/root/reference/paddle/fluid/eager/backward.cc:794`):
+    seeds with ones (or `grad_tensors`), walks nodes in reverse, accumulates
+    fan-in, and stores into leaves whose `stop_gradient` is False.
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    grads: dict[int, jax.Array] = {}
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            g_arr = jnp.ones_like(t.data)
+        else:
+            g_arr = g.data if isinstance(g, Tensor) else jnp.asarray(g)
+        grads[id(t)] = grads.get(id(t), 0) + g_arr
+
+    tape: List[Node] = _state().tape
+    # Nodes already form a topological order by construction time.
+    for node in reversed(tape):
+        if node.released:
+            continue
+        oids = node.out_ids
+        if not any(oid in grads for oid in oids):
+            continue
+        # vjp_fn expects a concrete cotangent (of the recorded dtype — AMP can
+        # mix bf16/fp32 across op boundaries) for every output
+        out_grads = tuple(
+            grads.pop(oid).astype(m[1]) if oid in grads else jnp.zeros(m[0], m[1])
+            for oid, m in zip(oids, node.out_meta)
+        )
+        in_grads = node.vjp_fn(out_grads)
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None or inp is None:
+                continue
+            if inp.stop_gradient:
+                continue
+            if inp._node is None:  # leaf: accumulate into .grad
+                _accum_leaf(inp, g)
+            else:
+                key = id(inp)
+                grads[key] = g if key not in grads else grads[key] + g
+        if not retain_graph:
+            node.vjp_fn = None
+            node.released = True
+
+    # remaining seeds that were themselves leaves
+    for t in tensors:
+        if id(t) in grads and t._node is None and not t.stop_gradient:
+            _accum_leaf(t, grads.pop(id(t)))
+
+    if not retain_graph:
+        # free only the traversed subgraph; unrelated graphs stay intact
+        _state().tape = [n for n in tape if not n.released]
+
+
+def _accum_leaf(tensor, g: jax.Array):
+    from .tensor import Tensor
+
+    g = jnp.asarray(g)
+    if g.dtype != tensor.data.dtype:
+        g = g.astype(tensor.data.dtype)
+    if tensor.grad is None:
+        tensor.grad = Tensor(g, stop_gradient=True)
+    else:
+        tensor.grad = Tensor(tensor.grad.data + g, stop_gradient=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=False):
+    """`paddle.grad` — gradients of outputs w.r.t. selected inputs (no .grad side effects).
+
+    Reference: `GeneralGrad` in `/root/reference/paddle/fluid/eager/backward.cc:421`.
+    Eager-tape implementation: runs the same traversal but harvests cotangents
+    for `inputs` instead of writing leaf grads. `create_graph` (double grad) is
+    not supported on the eager tape — use `paddle_tpu.autograd.vjp`/`jvp`
+    functional APIs for higher-order gradients.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph on the eager tape is unsupported; use"
+            " paddle_tpu.autograd functional transforms for higher-order grad")
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    grads: dict[int, jax.Array] = {}
+    for t, g in zip(outputs, grad_outputs):
+        g_arr = jnp.ones_like(t.data) if g is None else (
+            g.data if isinstance(g, Tensor) else jnp.asarray(g))
+        grads[id(t)] = grads.get(id(t), 0) + g_arr
+
+    want = {id(t): i for i, t in enumerate(inputs)}
+    results: list[Optional[jax.Array]] = [None] * len(inputs)
+
+    tape: List[Node] = _state().tape
+    for node in reversed(tape):
+        oids = node.out_ids
+        if node.released or not any(oid in grads for oid in oids):
+            continue
+        out_grads = tuple(
+            grads.pop(oid).astype(m[1]) if oid in grads else jnp.zeros(m[0], m[1])
+            for oid, m in zip(oids, node.out_meta)
+        )
+        in_grads = node.vjp_fn(out_grads)
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None or inp is None or inp.stop_gradient:
+                continue
+            key = id(inp)
+            if key in want:
+                i = want[key]
+                results[i] = g if results[i] is None else results[i] + g
+            if inp._node is not None:
+                grads[key] = g if key not in grads else grads[key] + g
+        if not retain_graph:
+            node.vjp_fn = None
+            node.released = True
+
+    for t in outputs:  # an output that is itself a requested input
+        if id(t) in want and id(t) in grads:
+            i = want[id(t)]
+            g = grads[id(t)]
+            results[i] = g if results[i] is None else results[i] + g
+
+    out = []
+    for i, (t, g) in enumerate(zip(inputs, results)):
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input {i} is unreachable from outputs (set allow_unused=True)")
+            out.append(None)
+        else:
+            out.append(Tensor(g, stop_gradient=True))
+    if not retain_graph:
+        _state().tape = [n for n in tape if not n.released]
+    return out

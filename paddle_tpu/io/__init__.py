@@ -1,0 +1,18 @@
+"""paddle_tpu.io — datasets and DataLoader.
+
+Reference: `paddle.io` (`python/paddle/fluid/dataloader/` +
+`fluid/reader.py`), C++ `BufferedReader`
+(`/root/reference/paddle/fluid/operators/reader/buffered_reader.h:41`).
+The loader uses worker threads for decode/collate and a background
+host→device prefetch queue (`jax.device_put` is async) — the BufferedReader
+double-buffering equivalent for TPU.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
+    Subset, TensorDataset, random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
+    SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,152 @@
+"""DataLoader with threaded workers + host->device prefetch.
+
+Reference: `_DataLoaderIterSingleProcess`
+(`/root/reference/python/paddle/fluid/dataloader/dataloader_iter.py:146`) and
+the C++ `BufferedReader` double-buffer
+(`paddle/fluid/operators/reader/buffered_reader.h:41`). On TPU, multiprocess
+shared-memory tensor passing is replaced by thread workers (numpy decode
+releases the GIL) + async `jax.device_put` into a bounded prefetch queue.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([b.data for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(f)) for f in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+class _Abandoned(BaseException):
+    """Internal: consumer stopped iterating; unwind the producer thread."""
+
+
+def _producer(loader, q: "queue.Queue", stop: threading.Event):
+    """Worker body. Deliberately NOT a bound method of the iterator: the
+    thread must not keep the iterator alive, so that an abandoned epoch
+    (consumer broke out early) lets the iterator's __del__ set `stop`."""
+
+    def put(batch):
+        if loader.use_buffer_reader:
+            batch = jax.tree_util.tree_map(
+                lambda t: Tensor(jax.device_put(t.data)) if isinstance(t, Tensor) else t,
+                batch, is_leaf=lambda x: isinstance(x, Tensor))
+        while not stop.is_set():
+            try:
+                q.put(batch, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+        raise _Abandoned()
+
+    try:
+        if isinstance(loader.dataset, IterableDataset):
+            buf = []
+            for sample in loader.dataset:
+                buf.append(sample)
+                if len(buf) == loader.batch_size:
+                    put(loader.collate_fn(buf))
+                    buf = []
+                if stop.is_set():
+                    return
+            if buf and not loader.drop_last:
+                put(loader.collate_fn(buf))
+        else:
+            for idx_batch in iter(loader.batch_sampler):
+                if stop.is_set():
+                    return
+                put(loader.collate_fn([loader.dataset[i] for i in idx_batch]))
+        put(None)
+    except _Abandoned:
+        pass
+    except BaseException as e:  # propagate to consumer
+        try:
+            q.put(e, timeout=1.0)
+        except queue.Full:
+            pass
+
+
+class _PrefetchIter:
+    """Pull batches through a worker thread, overlap host->device copies."""
+
+    def __init__(self, loader):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(2, loader.prefetch_factor))
+        self._stop = threading.Event()
+        self._worker = threading.Thread(
+            target=_producer, args=(loader, self._q, self._stop), daemon=True)
+        self._worker.start()
+        self._done = False
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is None:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._done = True
+            raise item
+        return item
+
+    def __iter__(self):
+        return self
+
+    def __del__(self):
+        self._stop.set()
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn: Optional[Callable] = None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate_fn
+        self.use_buffer_reader = use_buffer_reader
+        self.prefetch_factor = prefetch_factor
+        self.num_workers = num_workers  # decode runs in threads; numpy releases the GIL
+        if isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __iter__(self):
+        return _PrefetchIter(self)
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()

@@ -1,0 +1,139 @@
+"""GPT — decoder-only transformer LM (flagship model).
+
+Capability target: the reference's fleet GPT examples (GPT-3 1.3B/6.7B hybrid
+TP+PP configs in `BASELINE.json`). Architecture is GPT-2/3 style: learned
+positions, pre-LN blocks, causal flash attention. The hybrid-parallel variant
+lives in `paddle_tpu.distributed.hybrid` (stacked-layer pipeline + TP
+shardings); this module is the single-device/DP definition.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..framework.tensor import Tensor
+from ..ops import arange, reshape, transpose
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    max_position_embeddings: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 0  # 0 => 4*hidden
+    dropout: float = 0.1
+    attn_dropout: float = 0.1
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @staticmethod
+    def gpt2_small():
+        return GPTConfig(hidden_size=768, num_layers=12, num_heads=12)
+
+    @staticmethod
+    def gpt3_1p3b():
+        return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                         max_position_embeddings=2048)
+
+    @staticmethod
+    def gpt3_6p7b():
+        return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                         max_position_embeddings=2048)
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=1024, max_position_embeddings=128,
+                         hidden_size=64, num_layers=2, num_heads=4, dropout=0.0,
+                         attn_dropout=0.0)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        self.qkv = nn.Linear(h, 3 * h)
+        self.proj = nn.Linear(h, h)
+        self.attn_dropout = cfg.attn_dropout
+        self.resid_drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        B, L, H = x.shape
+        qkv = self.qkv(x)
+        qkv = reshape(qkv, [B, L, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.attn_dropout,
+            training=self.training)
+        out = reshape(out, [B, L, H])
+        return self.resid_drop(self.proj(out))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.drop(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPT(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids):
+        B, L = input_ids.shape
+        pos = arange(0, L, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        if self.cfg.tie_word_embeddings:
+            from ..ops import matmul
+            logits = matmul(x, self.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        return logits
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(logits, labels)
+
+    def num_params(self):
+        return sum(p.size for p in self.parameters())

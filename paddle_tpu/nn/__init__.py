@@ -1,0 +1,21 @@
+"""paddle_tpu.nn — layers, functional, initializers.
+
+Reference parity: `python/paddle/nn/`.
+"""
+from .layer import Layer  # noqa: F401
+from .layers_common import *  # noqa: F401,F403
+from .layers_common import (  # noqa: F401
+    Sequential, LayerList, ParameterList, LayerDict, Linear, Embedding,
+    Dropout, Flatten, Identity, Conv1D, Conv2D, Conv3D, Conv2DTranspose,
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm2D, MaxPool2D, AvgPool2D,
+    AdaptiveAvgPool2D, CrossEntropyLoss, MSELoss, L1Loss, ReLU, GELU, Sigmoid,
+    Tanh, Softmax,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder,
+    TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
+)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

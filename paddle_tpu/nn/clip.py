@@ -1,0 +1,77 @@
+"""Gradient clipping.
+
+Reference: `ClipGradByGlobalNorm` etc. (`/root/reference/python/paddle/fluid/clip.py`).
+Clips operate on (param, grad) lists eagerly and have pure functional cores
+reused by compiled training steps and the hybrid-parallel optimizer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def clip_fn(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+    def __call__(self, params_grads):
+        return [(p, Tensor(jnp.clip(g.data, self.min, self.max)) if g is not None else None)
+                for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.data.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g.data * scale).astype(g.data.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def clip_fn(self, grads):
+        """Pure functional core (pytree of arrays -> pytree of arrays)."""
+        leaves = jax.tree_util.tree_leaves(grads)
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+        return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)
+
+    def __call__(self, params_grads):
+        grads = [g for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        sq = sum(jnp.sum(jnp.square(g.data.astype(jnp.float32))) for g in grads
+                 if getattr(g, "data", None) is not None)
+        global_norm = jnp.sqrt(sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g.data * scale).astype(g.data.dtype))))
+        return out

@@ -1,0 +1,1016 @@
+"""paddle_tpu.nn.functional — NN ops.
+
+Reference parity: `python/paddle/nn/functional/` backed by phi kernels
+(conv `phi/kernels/gpu/conv_kernel.cu`, softmax, layer_norm, pooling,
+cross_entropy `phi/kernels/gpu/cross_entropy_kernel.cu`, ...). Convolutions
+lower to `lax.conv_general_dilated` (MXU), pools to `lax.reduce_window`;
+attention routes to the Pallas flash kernel when beneficial.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import _dispatch as _d
+from ...ops._dispatch import kernel
+from ...framework import random as random_mod
+from ...framework.tensor import Tensor
+
+__all__ = []  # populated at bottom
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# ===========================================================================
+# activations
+# ===========================================================================
+def _act(name, fn):
+    @kernel(name)
+    def impl(x, _fn=fn):
+        return _fn(x)
+    def op(x, name=None, _impl=impl, _nm=name):
+        return _d.call(_impl, (x,), name=_nm)
+    op.__name__ = name
+    return op
+
+
+relu = _act("relu", jax.nn.relu)
+relu6 = _act("relu6", jax.nn.relu6)
+silu = _act("silu", jax.nn.silu)
+swish = _act("swish", jax.nn.silu)
+mish = _act("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+sigmoid = _act("sigmoid", jax.nn.sigmoid)
+log_sigmoid = _act("log_sigmoid", jax.nn.log_sigmoid)
+tanh = _act("tanh", jnp.tanh)
+tanhshrink = _act("tanhshrink", lambda x: x - jnp.tanh(x))
+softsign = _act("softsign", jax.nn.soft_sign)
+selu = _act("selu", jax.nn.selu)
+
+
+def gelu(x, approximate=False, name=None):
+    @kernel("gelu")
+    def impl(a, *, approximate):
+        return jax.nn.gelu(a, approximate=approximate)
+    return _d.call(impl, (x,), dict(approximate=approximate), name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    @kernel("leaky_relu")
+    def impl(a, *, ns):
+        return jax.nn.leaky_relu(a, negative_slope=ns)
+    return _d.call(impl, (x,), dict(ns=negative_slope), name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    @kernel("elu")
+    def impl(a, *, alpha):
+        return jax.nn.elu(a, alpha=alpha)
+    return _d.call(impl, (x,), dict(alpha=alpha), name="elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    @kernel("celu")
+    def impl(a, *, alpha):
+        return jax.nn.celu(a, alpha=alpha)
+    return _d.call(impl, (x,), dict(alpha=alpha), name="celu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    @kernel("hardtanh")
+    def impl(a, *, min, max):
+        return jnp.clip(a, min, max)
+    return _d.call(impl, (x,), dict(min=min, max=max), name="hardtanh")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    @kernel("hardsigmoid")
+    def impl(a, *, slope, offset):
+        return jnp.clip(slope * a + offset, 0.0, 1.0)
+    return _d.call(impl, (x,), dict(slope=slope, offset=offset), name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    @kernel("hardswish")
+    def impl(a):
+        return a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0
+    return _d.call(impl, (x,), name="hardswish")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    @kernel("hardshrink")
+    def impl(a, *, t):
+        return jnp.where(jnp.abs(a) > t, a, 0.0)
+    return _d.call(impl, (x,), dict(t=threshold), name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    @kernel("softshrink")
+    def impl(a, *, t):
+        return jnp.where(a > t, a - t, jnp.where(a < -t, a + t, 0.0))
+    return _d.call(impl, (x,), dict(t=threshold), name="softshrink")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    @kernel("softplus")
+    def impl(a, *, beta, threshold):
+        return jnp.where(beta * a > threshold, a, jax.nn.softplus(beta * a) / beta)
+    return _d.call(impl, (x,), dict(beta=beta, threshold=threshold), name="softplus")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    @kernel("thresholded_relu")
+    def impl(a, *, t):
+        return jnp.where(a > t, a, 0.0)
+    return _d.call(impl, (x,), dict(t=threshold), name="thresholded_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    @kernel("prelu")
+    def impl(a, w, *, data_format):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a > 0, a, wb * a)
+    return _d.call(impl, (x, weight), dict(data_format=data_format), name="prelu")
+
+
+def glu(x, axis=-1, name=None):
+    @kernel("glu")
+    def impl(a, *, axis):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return _d.call(impl, (x,), dict(axis=axis), name="glu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    @kernel("maxout")
+    def impl(a, *, groups, axis):
+        c = a.shape[axis]
+        new_shape = a.shape[:axis] + (c // groups, groups) + a.shape[axis + 1:]
+        return jnp.max(a.reshape(new_shape), axis=axis + 1)
+    return _d.call(impl, (x,), dict(groups=groups, axis=axis), name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    @kernel("softmax")
+    def impl(a, *, axis):
+        return jax.nn.softmax(a, axis=axis)
+    out = _d.call(impl, (x,), dict(axis=axis), name="softmax")
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    @kernel("log_softmax")
+    def impl(a, *, axis):
+        return jax.nn.log_softmax(a, axis=axis)
+    out = _d.call(impl, (x,), dict(axis=axis), name="log_softmax")
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = random_mod.next_key()
+
+    @kernel("gumbel_softmax")
+    def impl(a, *, temperature, hard, axis, key=key):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            y_hard = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return _d.call(impl, (x,), dict(temperature=temperature, hard=hard, axis=axis),
+                   name="gumbel_softmax")
+
+
+# ===========================================================================
+# linear / embedding
+# ===========================================================================
+@kernel("linear")
+def _linear(x, w, b=None):
+    pet = jnp.float32 if x.dtype in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)) else None
+    out = jnp.matmul(x, w, preferred_element_type=pet)
+    if pet is not None:
+        out = out.astype(x.dtype)
+    if b is not None:
+        out = out + b
+    return out
+
+
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return _d.call(lambda a, w: _linear(a, w), (x, weight), name="linear")
+    return _d.call(_linear, (x, weight, bias), name="linear")
+
+
+@kernel("embedding")
+def _embedding(x, weight, *, padding_idx):
+    idx = x.astype(jnp.int32)
+    out = jnp.take(weight, idx, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (idx == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    if padding_idx is not None and padding_idx < 0:
+        padding_idx = weight.shape[0] + padding_idx
+    return _d.call(_embedding, (x, weight), dict(padding_idx=padding_idx))
+
+
+def one_hot(x, num_classes, name=None):
+    @kernel("one_hot")
+    def impl(a, *, n):
+        return jax.nn.one_hot(a.astype(jnp.int32), n, dtype=jnp.float32)
+    return _d.call(impl, (x,), dict(n=num_classes), name="one_hot", nondiff=True)
+
+
+# ===========================================================================
+# dropout
+# ===========================================================================
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            @kernel("dropout_infer_scale")
+            def impl_s(a, *, p):
+                return a * (1.0 - p)
+            return _d.call(impl_s, (x,), dict(p=p), name="dropout")
+        from ...ops import assign
+        return assign(x)
+    key = random_mod.next_key()
+
+    @kernel("dropout")
+    def impl(a, *, p, axis, mode, key=key):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return _d.call(impl, (x,), dict(p=p, axis=axis, mode=mode), name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        from ...ops import assign
+        return assign(x)
+    key = random_mod.next_key()
+
+    @kernel("alpha_dropout")
+    def impl(a, *, p, key=key):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_c = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_c = -a_c * alpha_p * p
+        return (a_c * jnp.where(keep, a, alpha_p) + b_c).astype(a.dtype)
+    return _d.call(impl, (x,), dict(p=p), name="alpha_dropout")
+
+
+# ===========================================================================
+# convolution
+# ===========================================================================
+def _conv_nd(x, w, bias, stride, padding, dilation, groups, data_format, nd,
+             name="conv"):
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    elif isinstance(padding, (list, tuple)) and len(padding) == 2 * nd:
+        pad = [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    elif isinstance(padding, (list, tuple)) and padding and isinstance(padding[0], (list, tuple)):
+        # paddle full-form [[0,0],[0,0],[h0,h1],[w0,w1]]
+        sp = padding[2:] if data_format.startswith("NC") else padding[1:-1]
+        pad = [(int(p[0]), int(p[1])) for p in sp]
+    else:
+        p = _pair(padding, nd)
+        pad = [(pi, pi) for pi in p]
+
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + "DHW"[3 - nd:]
+    else:
+        lhs_spec = "N" + "DHW"[3 - nd:] + "C"
+    rhs_spec = "OI" + "DHW"[3 - nd:]
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers((1,) * (nd + 2), (1,) * (nd + 2),
+                                        (lhs_spec, rhs_spec, out_spec))
+
+    @kernel(name)
+    def impl(a, w, *b, stride=stride, pad=pad, dilation=dilation, groups=groups,
+             dn=dn, lhs_spec=lhs_spec):
+        pet = jnp.float32 if a.dtype in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)) else None
+        out = jax.lax.conv_general_dilated(
+            a, w.astype(a.dtype), window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups, preferred_element_type=pet)
+        if pet is not None:
+            out = out.astype(a.dtype)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[lhs_spec.index("C")] = b[0].size
+            out = out + b[0].reshape(bias_shape).astype(out.dtype)
+        return out
+
+    args = (x, w) if bias is None else (x, w, bias)
+    return _d.call(impl, args, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 1, name="conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 2, name="conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 3, name="conv3d")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW", output_size=None, name=None):
+    nd = 2
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    p = _pair(padding, nd) if not isinstance(padding, str) else padding
+
+    @kernel("conv2d_transpose")
+    def impl(a, w, *b, stride=stride, p=p, dilation=dilation, groups=groups):
+        # weight layout (in, out, kh, kw) — gradient-of-conv trick:
+        # conv_transpose = conv_general_dilated with lhs_dilation=stride
+        kh, kw = w.shape[2], w.shape[3]
+        if isinstance(p, str):
+            raise NotImplementedError("str padding for conv_transpose")
+        pad = [(dilation[i] * (k - 1) - p[i], dilation[i] * (k - 1) - p[i])
+               for i, k in enumerate((kh, kw))]
+        w_flip = jnp.flip(w, axis=(2, 3))
+        if groups > 1:
+            ci = w.shape[0]
+            w_g = w_flip.reshape(groups, ci // groups, *w.shape[1:])
+            w_t = jnp.concatenate([jnp.swapaxes(w_g[g], 0, 1) for g in range(groups)], axis=0)
+        else:
+            w_t = jnp.swapaxes(w_flip, 0, 1)  # (out, in, kh, kw)
+        dn = jax.lax.conv_dimension_numbers(a.shape, w_t.shape, ("NCHW", "OIHW", "NCHW"))
+        out = jax.lax.conv_general_dilated(
+            a, w_t, window_strides=(1, 1), padding=pad,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return _d.call(impl, args, name="conv2d_transpose")
+
+
+# ===========================================================================
+# pooling
+# ===========================================================================
+def _pool2d(x, kernel_size, stride, padding, mode, ceil_mode=False,
+            exclusive=True, data_format="NCHW", name="pool2d"):
+    ks = _pair(kernel_size)
+    st = _pair(stride if stride is not None else kernel_size)
+    pd = _pair(padding)
+    nchw = data_format == "NCHW"
+    window = (1, 1, ks[0], ks[1]) if nchw else (1, ks[0], ks[1], 1)
+    strides = (1, 1, st[0], st[1]) if nchw else (1, st[0], st[1], 1)
+    pads = ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])) if nchw else \
+           ((0, 0), (pd[0], pd[0]), (pd[1], pd[1]), (0, 0))
+
+    @kernel(name)
+    def impl(a, *, window=window, strides=strides, pads=pads, mode=mode,
+             exclusive=exclusive):
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+        if exclusive and any(p[0] or p[1] for p in pads):
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return s / cnt
+        denom = np.prod([w for w in window])
+        return s / denom
+    return _d.call(impl, (x,), name=name)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    out = _pool2d(x, kernel_size, stride, padding, "max", ceil_mode,
+                  data_format=data_format, name="max_pool2d")
+    if return_mask:
+        raise NotImplementedError("return_mask")
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool2d(x, kernel_size, stride, padding, "avg", ceil_mode, exclusive,
+                   data_format, name="avg_pool2d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    from ...ops import unsqueeze, squeeze
+    out = max_pool2d(unsqueeze(x, -1), (kernel_size, 1),
+                     (stride or kernel_size, 1), (padding, 0))
+    return squeeze(out, -1)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    from ...ops import unsqueeze, squeeze
+    out = avg_pool2d(unsqueeze(x, -1), (kernel_size, 1),
+                     (stride or kernel_size, 1), (padding, 0), exclusive=exclusive)
+    return squeeze(out, -1)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    os = _pair(output_size)
+
+    @kernel("adaptive_avg_pool2d")
+    def impl(a, *, os=os, nchw=(data_format == "NCHW")):
+        h_ax, w_ax = (2, 3) if nchw else (1, 2)
+        H, W = a.shape[h_ax], a.shape[w_ax]
+        oh, ow = os
+        if H % oh == 0 and W % ow == 0:
+            if nchw:
+                r = a.reshape(a.shape[0], a.shape[1], oh, H // oh, ow, W // ow)
+                return r.mean(axis=(3, 5))
+            r = a.reshape(a.shape[0], oh, H // oh, ow, W // ow, a.shape[3])
+            return r.mean(axis=(2, 4))
+        # general case: per-output-cell variable windows via segment means
+        out = jax.image.resize(a, a.shape[:h_ax] + (oh, ow) + a.shape[w_ax + 1:],
+                               method="linear")
+        return out
+    return _d.call(impl, (x,), name="adaptive_avg_pool2d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    os = _pair(output_size)
+
+    @kernel("adaptive_max_pool2d")
+    def impl(a, *, os=os):
+        H, W = a.shape[2], a.shape[3]
+        oh, ow = os
+        assert H % oh == 0 and W % ow == 0, "adaptive_max_pool needs divisible sizes"
+        r = a.reshape(a.shape[0], a.shape[1], oh, H // oh, ow, W // ow)
+        return r.max(axis=(3, 5))
+    return _d.call(impl, (x,), name="adaptive_max_pool2d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    from ...ops import unsqueeze, squeeze
+    out = adaptive_avg_pool2d(unsqueeze(x, -1), (output_size, 1))
+    return squeeze(out, -1)
+
+
+# ===========================================================================
+# normalization
+# ===========================================================================
+@kernel("layer_norm")
+def _layer_norm(x, weight, bias, *, normalized_ndim, epsilon):
+    axes = tuple(range(x.ndim - normalized_ndim, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(normalized_shape)
+    if weight is None and bias is None:
+        return _d.call(lambda a, *, normalized_ndim, epsilon:
+                       _layer_norm(a, None, None, normalized_ndim=normalized_ndim,
+                                   epsilon=epsilon),
+                       (x,), dict(normalized_ndim=nd, epsilon=epsilon), name="layer_norm")
+    return _d.call(_layer_norm, (x, weight, bias),
+                   dict(normalized_ndim=nd, epsilon=epsilon), name="layer_norm")
+
+
+@kernel("rms_norm")
+def _rms_norm(x, weight, *, epsilon):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(ms + epsilon)
+    return (out * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm(x, weight, epsilon=1e-6, name=None):
+    return _d.call(_rms_norm, (x, weight), dict(epsilon=epsilon))
+
+
+@kernel("batch_norm_infer")
+def _bn_infer(x, rm, rv, w, b, *, epsilon, data_format):
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    inv = jax.lax.rsqrt(rv.reshape(shape) + epsilon)
+    out = (x - rm.reshape(shape)) * inv
+    if w is not None:
+        out = out * w.reshape(shape)
+    if b is not None:
+        out = out + b.reshape(shape)
+    return out.astype(x.dtype)
+
+
+@kernel("batch_norm_train")
+def _bn_train(x, w, b, *, epsilon, data_format):
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if w is not None:
+        out = out * w.reshape(shape).astype(jnp.float32)
+    if b is not None:
+        out = out + b.reshape(shape).astype(jnp.float32)
+    return out.astype(x.dtype), mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Functional batch norm. In training mode also updates running stats
+    in-place on the provided Tensors (reference semantics:
+    `phi/kernels/gpu/batch_norm_kernel.cu` updates mean_out/variance_out)."""
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return _d.call(_bn_infer, (x, running_mean, running_var, weight, bias),
+                       dict(epsilon=epsilon, data_format=data_format),
+                       name="batch_norm")
+    out, mean, var = _d.call(_bn_train, (x, weight, bias),
+                             dict(epsilon=epsilon, data_format=data_format),
+                             name="batch_norm")
+    if isinstance(running_mean, Tensor):
+        with jax.default_matmul_precision("float32"):
+            m = momentum
+            running_mean.data = (running_mean.data * m + mean.data * (1 - m)).astype(running_mean.data.dtype)
+            running_var.data = (running_var.data * m + var.data * (1 - m)).astype(running_var.data.dtype)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    @kernel("instance_norm")
+    def impl(a, *wb, eps=eps):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        if wb:
+            shape = (1, -1) + (1,) * (a.ndim - 2)
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        return out
+    args = (x,) if weight is None else ((x, weight) if bias is None else (x, weight, bias))
+    return _d.call(impl, args, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    @kernel("group_norm")
+    def impl(a, *wb, ng=num_groups, eps=epsilon, nchw=(data_format == "NCHW")):
+        if not nchw:
+            a = jnp.moveaxis(a, -1, 1)
+        N, C = a.shape[0], a.shape[1]
+        g = a.reshape(N, ng, C // ng, *a.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + eps)).reshape(a.shape)
+        if wb:
+            shape = (1, C) + (1,) * (a.ndim - 2)
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        if not nchw:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = (x,) if weight is None else ((x, weight) if bias is None else (x, weight, bias))
+    return _d.call(impl, args, name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    @kernel("local_response_norm")
+    def impl(a, *, size, alpha, beta, k):
+        sq = jnp.square(a)
+        half = size // 2
+        pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (a.ndim - 2)
+        sq_p = jnp.pad(sq, pad)
+        win = sum(jax.lax.slice_in_dim(sq_p, i, i + a.shape[1], axis=1)
+                  for i in range(size))
+        return a / jnp.power(k + alpha * win / size, beta)
+    return _d.call(impl, (x,), dict(size=size, alpha=alpha, beta=beta, k=k),
+                   name="local_response_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    @kernel("normalize")
+    def impl(a, *, p, axis, eps):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, eps)
+    return _d.call(impl, (x,), dict(p=p, axis=axis, eps=epsilon), name="normalize")
+
+
+# ===========================================================================
+# losses
+# ===========================================================================
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True,
+                  label_smoothing=0.0, name=None):
+    @kernel("cross_entropy")
+    def impl(logits, lab, *w, ignore_index=ignore_index, reduction=reduction,
+             soft_label=soft_label, axis=axis, use_softmax=use_softmax,
+             label_smoothing=label_smoothing):
+        n_cls = logits.shape[axis]
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30))
+        if soft_label:
+            soft = lab
+        else:
+            li = lab.astype(jnp.int32)
+            if li.ndim == logits.ndim and li.shape[axis] == 1:
+                li = jnp.squeeze(li, axis)
+            # out-of-range labels (e.g. ignore_index=-100) one_hot to all-zero rows
+            soft = jax.nn.one_hot(li, n_cls, axis=axis, dtype=logp.dtype)
+        if label_smoothing > 0.0:
+            soft = soft * (1.0 - label_smoothing) + label_smoothing / n_cls
+        nll = -jnp.sum(soft * logp, axis=axis)
+        if w:
+            if soft_label:
+                ww = jnp.take(w[0], jnp.argmax(soft, axis=axis), axis=0)
+            else:
+                safe_li = jnp.clip(li.reshape(nll.shape), 0, n_cls - 1)
+                ww = jnp.take(w[0], safe_li, axis=0)
+            nll = nll * ww
+        if not soft_label:
+            li_f = li.reshape(nll.shape)
+            mask = (li_f != ignore_index)
+            nll = jnp.where(mask, nll, 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(mask, ww, 0.0)) if w else \
+                    jnp.maximum(jnp.sum(mask), 1)
+                return jnp.sum(nll) / denom
+        return _reduce_loss(nll, reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return _d.call(impl, args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from ...ops import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    @kernel("nll_loss")
+    def impl(logp, lab, *w, ignore_index=ignore_index, reduction=reduction):
+        li = lab.astype(jnp.int32)
+        n_cls = logp.shape[-1 if logp.ndim == li.ndim + 1 else 1]
+        safe_li = jnp.clip(li, 0, n_cls - 1)
+        nll = -jnp.take_along_axis(
+            logp, safe_li[..., None] if logp.ndim == li.ndim + 1 else safe_li,
+            axis=-1 if logp.ndim == li.ndim + 1 else 1)
+        nll = nll.reshape(li.shape)
+        ww = jnp.take(w[0], safe_li, axis=0) if w else None
+        if ww is not None:
+            nll = nll * ww
+        mask = li != ignore_index
+        nll = jnp.where(mask, nll, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(mask, ww, 0.0)) if w else \
+                jnp.maximum(jnp.sum(mask), 1)
+            return jnp.sum(nll) / denom
+        return _reduce_loss(nll, reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return _d.call(impl, args, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    @kernel("mse_loss")
+    def impl(a, b, *, reduction=reduction):
+        return _reduce_loss(jnp.square(a - b), reduction)
+    return _d.call(impl, (input, label), name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    @kernel("l1_loss")
+    def impl(a, b, *, reduction=reduction):
+        return _reduce_loss(jnp.abs(a - b), reduction)
+    return _d.call(impl, (input, label), name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    @kernel("smooth_l1_loss")
+    def impl(a, b, *, reduction=reduction, delta=delta):
+        d = a - b
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+    return _d.call(impl, (input, label), name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    @kernel("binary_cross_entropy")
+    def impl(p, y, *w, reduction=reduction):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce_loss(loss, reduction)
+    args = (input, label) if weight is None else (input, label, weight)
+    return _d.call(impl, args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    @kernel("bce_with_logits")
+    def impl(z, y, *extra, reduction=reduction, has_w=(weight is not None),
+             has_pw=(pos_weight is not None)):
+        i = 0
+        w = extra[i] if has_w else None
+        i += 1 if has_w else 0
+        pw = extra[i] if has_pw else None
+        max_val = jnp.clip(-z, 0, None)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.log(jnp.exp(-max_val) +
+                                                  jnp.exp(-z - max_val)) + max_val)
+        else:
+            loss = (1 - y) * z + max_val + jnp.log(jnp.exp(-max_val) +
+                                                   jnp.exp(-z - max_val))
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return _d.call(impl, tuple(args), name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    @kernel("kl_div")
+    def impl(logp, y, *, reduction=reduction):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+    return _d.call(impl, (input, label), name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    @kernel("margin_ranking_loss")
+    def impl(a, b, y, *, margin=margin, reduction=reduction):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce_loss(loss, reduction)
+    return _d.call(impl, (input, other, label), name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    @kernel("hinge_embedding_loss")
+    def impl(a, y, *, margin=margin, reduction=reduction):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce_loss(loss, reduction)
+    return _d.call(impl, (input, label), name="hinge_embedding_loss")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    @kernel("cosine_similarity")
+    def impl(a, b, *, axis=axis, eps=eps):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return _d.call(impl, (x1, x2), name="cosine_similarity")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    @kernel("sigmoid_focal_loss")
+    def impl(z, y, *n, alpha=alpha, gamma=gamma, reduction=reduction):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce_loss(loss, reduction)
+    args = (logit, label) if normalizer is None else (logit, label, normalizer)
+    return _d.call(impl, args, name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label):
+    @kernel("square_error_cost")
+    def impl(a, b):
+        return jnp.square(a - b)
+    return _d.call(impl, (input, label), name="square_error_cost")
+
+
+# ===========================================================================
+# vision / misc
+# ===========================================================================
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    nchw = data_format == "NCHW"
+    if isinstance(x, Tensor):
+        shp = x.shape
+    else:
+        shp = list(jnp.asarray(x).shape)
+    H, W = (shp[2], shp[3]) if nchw else (shp[1], shp[2])
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            (scale_factor, scale_factor)
+        size = (int(H * sf[0]), int(W * sf[1]))
+    size = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in size)
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "linear": "linear", "trilinear": "linear", "area": "linear"}[mode]
+
+    @kernel("interpolate")
+    def impl(a, *, size=size, method=method, nchw=nchw):
+        if nchw:
+            out_shape = a.shape[:2] + size
+        else:
+            out_shape = (a.shape[0],) + size + (a.shape[3],)
+        return jax.image.resize(a, out_shape, method=method)
+    return _d.call(impl, (x,), name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    @kernel("pixel_shuffle")
+    def impl(a, *, r=upscale_factor):
+        N, C, H, W = a.shape
+        out = a.reshape(N, C // (r * r), r, r, H, W)
+        out = out.transpose(0, 1, 4, 2, 5, 3)
+        return out.reshape(N, C // (r * r), H * r, W * r)
+    return _d.call(impl, (x,), name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    @kernel("pixel_unshuffle")
+    def impl(a, *, r=downscale_factor):
+        N, C, H, W = a.shape
+        out = a.reshape(N, C, H // r, r, W // r, r)
+        out = out.transpose(0, 1, 3, 5, 2, 4)
+        return out.reshape(N, C * r * r, H // r, W // r)
+    return _d.call(impl, (x,), name="pixel_unshuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    dl = _pair(dilations)
+
+    @kernel("unfold")
+    def impl(a, *, ks=ks, st=st, pd=pd, dl=dl):
+        N, C, H, W = a.shape
+        a_p = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        oh = (H + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (W + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        cols = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                patch = jax.lax.slice(
+                    a_p, (0, 0, i * dl[0], j * dl[1]),
+                    (N, C, i * dl[0] + (oh - 1) * st[0] + 1,
+                     j * dl[1] + (ow - 1) * st[1] + 1),
+                    (1, 1, st[0], st[1]))
+                cols.append(patch.reshape(N, C, -1))
+        out = jnp.stack(cols, axis=2)  # N, C, kh*kw, L
+        return out.reshape(N, C * ks[0] * ks[1], -1)
+    return _d.call(impl, (x,), name="unfold")
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    arr = lengths.data if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(np.asarray(arr).max())
+
+    @kernel("sequence_mask")
+    def impl(l, *, maxlen=maxlen):
+        return (jnp.arange(maxlen) < l[..., None]).astype(jnp.int32)
+    return _d.call(impl, (lengths,), name="sequence_mask", nondiff=True)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    @kernel("label_smooth")
+    def impl(y, *, eps=epsilon):
+        n = y.shape[-1]
+        return y * (1 - eps) + eps / n
+    return _d.call(impl, (label,), name="label_smooth")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    @kernel("diag_embed")
+    def impl(a, *, offset=offset):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+        rows = jnp.arange(a.shape[-1]) + max(-offset, 0)
+        cols = jnp.arange(a.shape[-1]) + max(offset, 0)
+        return out.at[..., rows, cols].set(a)
+    return _d.call(impl, (input,), name="diag_embed")
+
+
+# ---------------------------------------------------------------------------
+# attention (used by nn.MultiHeadAttention and transformer models)
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """Batched attention; [B, L, H, D] layout (paddle convention).
+
+    Routes to the Pallas flash-attention kernel on TPU for long sequences;
+    falls back to the XLA composition otherwise.
+    """
+    @kernel("sdpa")
+    def impl(q, k, v, *m, is_causal=is_causal):
+        from ...ops.pallas.flash_attention import flash_attention_xla
+        mask = m[0] if m else None
+        return flash_attention_xla(q, k, v, mask=mask, causal=is_causal)
+    args = (query, key, value) if attn_mask is None else (query, key, value, attn_mask)
+    out = _d.call(impl, args, name="sdpa")
+    if dropout_p > 0.0 and training:
+        out = dropout(out, p=dropout_p, training=training)
+    return out
+
+
+def _collect_exports():
+    import types
+    g = globals()
+    return [k for k, v in g.items()
+            if not k.startswith("_") and isinstance(v, types.FunctionType)]
+
+
+__all__ = _collect_exports()

@@ -1,0 +1,129 @@
+"""paddle_tpu.ops — the functional op library (phi-kernel equivalent).
+
+One registry of pure-array kernels (`KERNELS`) + Tensor-level eager wrappers.
+Reference analog: `paddle/phi/kernels/` + generated `_C_ops` bindings
+(`/root/reference/paddle/fluid/pybind/eager_op_function_generator.cc:388`).
+
+Importing this module attaches tensor methods and operator dunders onto
+`paddle_tpu.Tensor` — same role as the reference's
+`python/paddle/fluid/dygraph/math_op_patch.py` monkey patching.
+"""
+from __future__ import annotations
+
+from ._dispatch import KERNELS, call, kernel, amp_state
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .comparison import *  # noqa: F401,F403
+from . import linalg_ops as linalg  # noqa: F401
+
+from . import math as _math
+from . import creation as _creation
+from . import manipulation as _manip
+from . import reduction as _red
+from . import comparison as _cmp
+
+from ..framework.tensor import Tensor, _attach_method
+
+
+# ---------------------------------------------------------------------------
+# tensor method attachment (math_op_patch equivalent)
+# ---------------------------------------------------------------------------
+_METHOD_MODULES = (_math, _creation, _manip, _red, _cmp)
+
+_TENSOR_METHODS = [
+    # math
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square",
+    "reciprocal", "abs", "neg", "sign", "floor", "ceil", "round", "trunc",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh", "erf", "erfinv", "sigmoid", "digamma", "lgamma",
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "scale", "clip",
+    "lerp", "matmul", "mm", "bmm", "dot", "inner", "outer", "trace", "diagonal",
+    "cumsum", "cumprod", "logit", "frac", "nan_to_num", "conj", "real", "imag",
+    "rad2deg", "deg2rad", "addmm", "kron",
+    # manipulation
+    "cast", "reshape", "transpose", "flatten", "squeeze", "unsqueeze",
+    "tile", "expand", "broadcast_to", "expand_as", "roll", "flip",
+    "gather", "gather_nd", "index_select", "take_along_axis", "put_along_axis",
+    "scatter", "scatter_nd_add", "masked_select", "masked_fill", "repeat_interleave",
+    "unique", "split", "chunk", "unbind", "numel", "index_sample", "index_add",
+    "moveaxis", "rot90", "t",
+    # reduction / search
+    "sum", "mean", "prod", "max", "min", "amax", "amin", "all", "any",
+    "std", "var", "logsumexp", "median", "nanmedian", "nansum", "nanmean",
+    "quantile", "argmax", "argmin", "topk", "sort", "argsort", "kthvalue",
+    "mode", "bincount", "histogram",
+    # comparison
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "isnan", "isinf", "isfinite", "equal_all", "allclose", "isclose",
+    # linalg (exposed as tensor methods in paddle)
+    "norm", "cholesky", "inv",
+]
+
+_ns = {}
+for _m in _METHOD_MODULES:
+    _ns.update({k: v for k, v in vars(_m).items() if callable(v)})
+_ns.update({"norm": linalg.norm, "cholesky": linalg.cholesky, "inv": linalg.inv})
+
+for _name in _TENSOR_METHODS:
+    if _name in _ns:
+        _attach_method(_name, _ns[_name])
+
+# zeros_like-style helpers as methods
+_attach_method("item", Tensor.item)
+
+
+def _flip_args(fn):
+    def flipped(self, other):
+        return fn(other, self)
+    return flipped
+
+
+_attach_method("__add__", _math.add)
+_attach_method("__radd__", _math.add)
+_attach_method("__sub__", _math.subtract)
+_attach_method("__rsub__", _flip_args(_math.subtract))
+_attach_method("__mul__", _math.multiply)
+_attach_method("__rmul__", _math.multiply)
+_attach_method("__truediv__", _math.divide)
+_attach_method("__rtruediv__", _flip_args(_math.divide))
+_attach_method("__floordiv__", _math.floor_divide)
+_attach_method("__rfloordiv__", _flip_args(_math.floor_divide))
+_attach_method("__mod__", _math.mod)
+_attach_method("__rmod__", _flip_args(_math.mod))
+_attach_method("__pow__", _math.pow)
+_attach_method("__rpow__", _flip_args(_math.pow))
+_attach_method("__matmul__", _math.matmul)
+_attach_method("__rmatmul__", _flip_args(_math.matmul))
+_attach_method("__neg__", _math.neg)
+_attach_method("__abs__", _math.abs)
+_attach_method("__eq__", _cmp.equal)
+_attach_method("__ne__", _cmp.not_equal)
+_attach_method("__lt__", _cmp.less_than)
+_attach_method("__le__", _cmp.less_equal)
+_attach_method("__gt__", _cmp.greater_than)
+_attach_method("__ge__", _cmp.greater_equal)
+_attach_method("__and__", _cmp.logical_and)
+_attach_method("__or__", _cmp.logical_or)
+_attach_method("__xor__", _cmp.logical_xor)
+_attach_method("__invert__", _cmp.logical_not)
+
+
+# in-place variants (paddle `op_` convention): rebind the underlying array
+def _make_inplace(fn):
+    def inplace(self, *args, **kw):
+        out = fn(self, *args, **kw)
+        self._rebind_(out)
+        return self
+    return inplace
+
+
+for _nm in ["add", "subtract", "multiply", "divide", "clip", "scale", "exp",
+            "sqrt", "rsqrt", "floor", "ceil", "round", "reciprocal", "tanh",
+            "cast", "reshape", "squeeze", "unsqueeze", "flatten"]:
+    if _nm in _ns:
+        _attach_method(_nm + "_", _make_inplace(_ns[_nm]))

@@ -1,0 +1,125 @@
+"""Op dispatch: pure-array impls -> eager Tensor ops with autograd + AMP.
+
+TPU-native analog of the reference's kernel dispatch stack
+(`/root/reference/paddle/phi/core/kernel_factory.h:230` KernelFactory,
+`paddle/fluid/imperative/tracer.cc:172` TraceOp, and the AMP autocast hook at
+`tracer.cc:222-240`): one registry of pure functions over `jax.Array`s serves
+both eager mode (this wrapper: unwrap -> optional autocast -> `jax.vjp` ->
+tape record) and compiled programs (the impls are called directly under
+`jit`). There is no backend enum — XLA is the one backend; `jax.vjp` replaces
+the generated GradNodes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework import tape as tape_mod
+from ..framework.tensor import Tensor
+
+# impl registry: name -> pure fn (for compiled/functional callers and tests)
+KERNELS: Dict[str, Callable] = {}
+
+
+def kernel(name: str):
+    """Register a pure-array kernel (phi `PD_REGISTER_KERNEL` equivalent)."""
+    def deco(fn):
+        KERNELS[name] = fn
+        fn._op_name = name
+        return fn
+    return deco
+
+
+def _unwrap(x) -> jax.Array:
+    if isinstance(x, Tensor):
+        return x.data
+    if isinstance(x, jax.Array):
+        return x
+    a = np.asarray(x)
+    if a.dtype == np.float64 and dtype_mod.get_default_dtype() != jnp.dtype(jnp.float64):
+        a = a.astype(dtype_mod.get_default_dtype())
+    return jnp.asarray(a)
+
+
+def _wants_grad(x) -> bool:
+    return (isinstance(x, Tensor) and not x.stop_gradient
+            and dtype_mod.is_floating(x.data.dtype))
+
+
+def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
+         name: Optional[str] = None, nondiff: bool = False):
+    """Run `impl(*arrays, **kwargs)` with eager autograd bookkeeping.
+
+    `tensors` are the (potentially differentiable) data inputs; `kwargs` are
+    static attributes closed over the vjp. Returns Tensor or tuple of Tensors
+    (matching impl's return structure).
+    """
+    kwargs = kwargs or {}
+    name = name or getattr(impl, "_op_name", impl.__name__)
+    arrs = tuple(_unwrap(t) for t in tensors)
+
+    arrs = _maybe_autocast(name, arrs)
+
+    requires = (not nondiff and tape_mod.grad_enabled()
+                and any(_wants_grad(t) for t in tensors))
+
+    if requires:
+        def tup_impl(*a):
+            out = impl(*a, **kwargs)
+            return out if isinstance(out, tuple) else (out,)
+        outs, vjp_fn = jax.vjp(tup_impl, *arrs)
+        out_tensors = tuple(Tensor(o, stop_gradient=False) for o in outs)
+        in_refs = [t if isinstance(t, Tensor) else None for t in tensors]
+        tape_mod.record(vjp_fn, in_refs, out_tensors, name=name)
+        return out_tensors[0] if len(out_tensors) == 1 else out_tensors
+    else:
+        out = impl(*arrs, **kwargs)
+        if isinstance(out, tuple):
+            return tuple(Tensor(o, stop_gradient=True) for o in out)
+        return Tensor(out, stop_gradient=True)
+
+
+def _multi_out(impl):
+    return getattr(impl, "_multi_out", False)
+
+
+# ---------------------------------------------------------------------------
+# AMP autocast (reference: imperative/amp_auto_cast.h allow/block lists)
+# ---------------------------------------------------------------------------
+_amp_state = {"enabled": False, "dtype": jnp.bfloat16, "level": "O1",
+              "custom_white": set(), "custom_black": set()}
+
+# ops that are numerically safe & fast in bf16 (MXU-bound)
+AMP_WHITE = {"matmul", "conv2d", "conv1d", "conv3d", "conv2d_transpose",
+             "linear", "bmm", "mm", "einsum", "addmm"}
+# ops that must run in fp32
+AMP_BLACK = {"softmax_with_cross_entropy", "cross_entropy", "log_softmax",
+             "mean", "sum", "norm", "exp", "log", "logsumexp", "var", "std",
+             "layer_norm", "batch_norm"}
+
+
+def amp_state():
+    return _amp_state
+
+
+def _maybe_autocast(name: str, arrs: tuple):
+    st = _amp_state
+    if not st["enabled"]:
+        return arrs
+    amp_dtype = st["dtype"]
+    white = (AMP_WHITE | st["custom_white"]) - st["custom_black"]
+    black = (AMP_BLACK | st["custom_black"]) - st["custom_white"]
+    if name in white:
+        return tuple(a.astype(amp_dtype)
+                     if dtype_mod.is_floating(a.dtype) and a.dtype != amp_dtype else a
+                     for a in arrs)
+    if name in black:
+        return tuple(a.astype(jnp.float32)
+                     if a.dtype in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16)) else a
+                     for a in arrs)
+    return arrs

@@ -1,0 +1,90 @@
+"""Comparison, logic and bitwise ops.
+
+Reference parity: `python/paddle/tensor/logic.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _dispatch as _d
+from ._dispatch import kernel
+from ..framework.tensor import Tensor
+
+
+def _make(name, fn):
+    @kernel(name)
+    def impl(x, y, _fn=fn):
+        return _fn(x, y)
+    def op(x, y, name=None, _impl=impl, _nm=name):
+        return _d.call(_impl, (x, y), name=_nm, nondiff=True)
+    op.__name__ = name
+    return op
+
+
+equal = _make("equal", jnp.equal)
+not_equal = _make("not_equal", jnp.not_equal)
+greater_than = _make("greater_than", jnp.greater)
+greater_equal = _make("greater_equal", jnp.greater_equal)
+less_than = _make("less_than", jnp.less)
+less_equal = _make("less_equal", jnp.less_equal)
+logical_and = _make("logical_and", jnp.logical_and)
+logical_or = _make("logical_or", jnp.logical_or)
+logical_xor = _make("logical_xor", jnp.logical_xor)
+bitwise_and = _make("bitwise_and", jnp.bitwise_and)
+bitwise_or = _make("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _make("bitwise_xor", jnp.bitwise_xor)
+
+
+def _make1(name, fn):
+    @kernel(name)
+    def impl(x, _fn=fn):
+        return _fn(x)
+    def op(x, name=None, _impl=impl, _nm=name):
+        return _d.call(_impl, (x,), name=_nm, nondiff=True)
+    op.__name__ = name
+    return op
+
+
+logical_not = _make1("logical_not", jnp.logical_not)
+bitwise_not = _make1("bitwise_not", jnp.bitwise_not)
+isnan = _make1("isnan", jnp.isnan)
+isinf = _make1("isinf", jnp.isinf)
+isfinite = _make1("isfinite", jnp.isfinite)
+
+
+def equal_all(x, y, name=None):
+    @kernel("equal_all")
+    def impl(a, b):
+        return jnp.array_equal(a, b)
+    return _d.call(impl, (x, y), name="equal_all", nondiff=True)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    @kernel("allclose")
+    def impl(a, b, *, rtol, atol, equal_nan):
+        return jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    return _d.call(impl, (x, y), dict(rtol=rtol, atol=atol, equal_nan=equal_nan),
+                   name="allclose", nondiff=True)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    @kernel("isclose")
+    def impl(a, b, *, rtol, atol, equal_nan):
+        return jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    return _d.call(impl, (x, y), dict(rtol=rtol, atol=atol, equal_nan=equal_nan),
+                   name="isclose", nondiff=True)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isreal(x, name=None):
+    @kernel("isreal")
+    def impl(a):
+        return jnp.isreal(a)
+    return _d.call(impl, (x,), name="isreal", nondiff=True)

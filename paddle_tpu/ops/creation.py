@@ -1,0 +1,248 @@
+"""Tensor creation ops.
+
+Reference parity: `python/paddle/tensor/creation.py` and `random.py`.
+Random ops draw from the global generator (`paddle_tpu.framework.random`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework import place as place_mod
+from ..framework import random as random_mod
+from ..framework.tensor import Tensor
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtype_mod.get_default_dtype()
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_tuple(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_tuple(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape_tuple(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.zeros_like(x, dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.ones_like(x, dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.full_like(x, fill_value, dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("tensor bounds not supported; pass python numbers")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        py = (start, end, step)
+        dtype = jnp.int64 if all(isinstance(v, (int, np.integer)) for v in py) \
+            else dtype_mod.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    if arr.ndim == 1:
+        out = jnp.diag(arr, k=offset)
+        if padding_value != 0:
+            mask = jnp.diag(jnp.ones_like(arr), k=offset)
+            out = jnp.where(mask.astype(bool), out, padding_value)
+        return Tensor(out)
+    return Tensor(jnp.diag(arr, k=offset))
+
+
+def diagflat(x, offset=0, name=None):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.diagflat(arr, k=offset))
+
+
+def tril(x, diagonal=0, name=None):
+    from . import _dispatch as _d
+    from ._dispatch import KERNELS
+    return _d.call(KERNELS["tril"], (x,), dict(diagonal=diagonal))
+
+
+def triu(x, diagonal=0, name=None):
+    from . import _dispatch as _d
+    from ._dispatch import KERNELS
+    return _d.call(KERNELS["triu"], (x,), dict(diagonal=diagonal))
+
+
+from ._dispatch import kernel
+
+
+@kernel("tril")
+def _tril(x, *, diagonal):
+    return jnp.tril(x, k=diagonal)
+
+
+@kernel("triu")
+def _triu(x, *, diagonal):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a) for a in
+            (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(o) for o in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    from . import _dispatch as _d
+    from ._dispatch import KERNELS
+    out = _d.call(KERNELS["assign"], (x,))
+    if output is not None:
+        output._rebind_(out)
+        return output
+    return out
+
+
+@kernel("assign")
+def _assign(x):
+    return jnp.array(x, copy=True)
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def complex(real, imag, name=None):
+    from . import _dispatch as _d
+    from ._dispatch import KERNELS
+    return _d.call(KERNELS["complex"], (real, imag))
+
+
+@kernel("complex")
+def _complex(re, im):
+    return jax.lax.complex(re, im)
+
+
+# ---- random ---------------------------------------------------------------
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else random_mod.next_key()
+    return Tensor(jax.random.uniform(key, _shape_tuple(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randn(shape, dtype=None, name=None):
+    key = random_mod.next_key()
+    return Tensor(jax.random.normal(key, _shape_tuple(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.data if isinstance(mean, Tensor) else mean
+        s = std.data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        key = random_mod.next_key()
+        return Tensor(m + s * jax.random.normal(key, shp, dtype_mod.get_default_dtype()))
+    key = random_mod.next_key()
+    shape = _shape_tuple(shape if shape is not None else [1])
+    return Tensor(mean + std * jax.random.normal(key, shape, dtype_mod.get_default_dtype()))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    return Tensor(jax.random.randint(key, _shape_tuple(shape), low, high,
+                                     dtype=_dt(dtype, jnp.int64)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype=None, name=None):
+    key = random_mod.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(_dt(dtype, jnp.int64)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    key = random_mod.next_key()
+    logits = jnp.log(jnp.maximum(arr, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=arr.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, arr.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    key = random_mod.next_key()
+    return Tensor(jax.random.bernoulli(key, arr).astype(arr.dtype))
+
+
+def poisson(x, name=None):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    key = random_mod.next_key()
+    return Tensor(jax.random.poisson(key, arr).astype(arr.dtype))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)

@@ -1,0 +1,235 @@
+"""Linear algebra ops (`paddle.linalg` parity).
+
+Reference: `python/paddle/tensor/linalg.py`, phi kernels under
+`/root/reference/paddle/phi/kernels/` (svd, qr, cholesky, eig, ...).
+All lower to XLA's linalg custom calls via jax.numpy.linalg / jax.scipy.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import _dispatch as _d
+from ._dispatch import kernel
+from ..framework.tensor import Tensor
+
+
+@kernel("norm")
+def _norm(x, *, p, axis, keepdim):
+    if axis is None and p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if axis is None:
+        return jnp.linalg.norm(x.reshape(-1), ord=p, keepdims=keepdim)
+    if isinstance(axis, tuple) and len(axis) == 2:
+        return jnp.linalg.norm(x, ord=p if p != "fro" else "fro", axis=axis, keepdims=keepdim)
+    if p == "fro":
+        p = 2
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if isinstance(axis, list):
+        axis = tuple(axis)
+    return _d.call(_norm, (x,), dict(p=p, axis=axis, keepdim=keepdim))
+
+
+def _simple(name, fn, nondiff=False):
+    @kernel(name)
+    def impl(x, _fn=fn):
+        return _fn(x)
+    def op(x, name=None, _impl=impl, _nm=name, _nd=nondiff):
+        return _d.call(_impl, (x,), name=_nm, nondiff=_nd)
+    op.__name__ = name
+    return op
+
+
+def cholesky(x, upper=False, name=None):
+    @kernel("cholesky")
+    def impl(a, *, upper):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return _d.call(impl, (x,), dict(upper=upper), name="cholesky")
+
+
+def svd(x, full_matrices=False, name=None):
+    @kernel("svd")
+    def impl(a, *, full_matrices):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2)  # paddle returns V, not V^H
+    return _d.call(impl, (x,), dict(full_matrices=full_matrices), name="svd")
+
+
+def qr(x, mode="reduced", name=None):
+    @kernel("qr")
+    def impl(a, *, mode):
+        return tuple(jnp.linalg.qr(a, mode=mode)) if mode != "r" \
+            else (jnp.linalg.qr(a, mode="r"),)
+    out = _d.call(impl, (x,), dict(mode=mode), name="qr")
+    return out if mode != "r" else (out if isinstance(out, Tensor) else out[0])
+
+
+def eig(x, name=None):
+    # complex eig runs on host (CPU lapack) — not TPU-compilable, eager only
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    w, v = np.linalg.eig(arr)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    @kernel("eigh")
+    def impl(a, *, UPLO):
+        return tuple(jnp.linalg.eigh(a, UPLO=UPLO))
+    return _d.call(impl, (x,), dict(UPLO=UPLO), name="eigh")
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    @kernel("eigvalsh")
+    def impl(a, *, UPLO):
+        return jnp.linalg.eigvalsh(a, UPLO=UPLO)
+    return _d.call(impl, (x,), dict(UPLO=UPLO), name="eigvalsh")
+
+
+inv = _simple("inv", jnp.linalg.inv)
+matrix_exp = _simple("matrix_exp", jax.scipy.linalg.expm)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    @kernel("pinv")
+    def impl(a, *, rcond, hermitian):
+        return jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian)
+    return _d.call(impl, (x,), dict(rcond=rcond, hermitian=hermitian), name="pinv")
+
+
+def det(x, name=None):
+    @kernel("det")
+    def impl(a):
+        return jnp.linalg.det(a)
+    return _d.call(impl, (x,), name="det")
+
+
+def slogdet(x, name=None):
+    @kernel("slogdet")
+    def impl(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet], axis=0)
+    return _d.call(impl, (x,), name="slogdet")
+
+
+def solve(x, y, name=None):
+    @kernel("solve")
+    def impl(a, b):
+        return jnp.linalg.solve(a, b)
+    return _d.call(impl, (x, y), name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    @kernel("triangular_solve")
+    def impl(a, b, *, upper, transpose, unitriangular):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return _d.call(impl, (x, y), dict(upper=upper, transpose=transpose,
+                                      unitriangular=unitriangular),
+                   name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    @kernel("cholesky_solve")
+    def impl(b, L, *, upper):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return _d.call(impl, (x, y), dict(upper=upper), name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    @kernel("lstsq")
+    def impl(a, b, *, rcond):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int64), sv
+    return _d.call(impl, (x, y), dict(rcond=rcond), name="lstsq")
+
+
+def matrix_power(x, n, name=None):
+    @kernel("matrix_power")
+    def impl(a, *, n):
+        return jnp.linalg.matrix_power(a, n)
+    return _d.call(impl, (x,), dict(n=n), name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    @kernel("matrix_rank")
+    def impl(a, *, tol, hermitian):
+        return jnp.linalg.matrix_rank(a, rtol=tol).astype(jnp.int64)
+    return _d.call(impl, (x,), dict(tol=tol, hermitian=hermitian),
+                   name="matrix_rank", nondiff=True)
+
+
+def cross(x, y, axis=9, name=None):
+    @kernel("cross")
+    def impl(a, b, *, axis):
+        if axis == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        else:
+            ax = axis
+        return jnp.cross(a, b, axis=ax)
+    return _d.call(impl, (x, y), dict(axis=axis), name="cross")
+
+
+def cond(x, p=None, name=None):
+    @kernel("cond_linalg")
+    def impl(a, *, p):
+        return jnp.linalg.cond(a, p=p)
+    return _d.call(impl, (x,), dict(p=p), name="cond")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    @kernel("lu")
+    def impl(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)  # paddle pivots are 1-based
+    out = _d.call(impl, (x,), name="lu")
+    if get_infos:
+        info = Tensor(jnp.zeros(x.shape[:-2], jnp.int32))
+        return out[0], out[1], info
+    return out
+
+
+def corrcoef(x, rowvar=True, name=None):
+    @kernel("corrcoef")
+    def impl(a, *, rowvar):
+        return jnp.corrcoef(a, rowvar=rowvar)
+    return _d.call(impl, (x,), dict(rowvar=rowvar), name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    @kernel("cov")
+    def impl(a, *, rowvar, ddof):
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0)
+    return _d.call(impl, (x,), dict(rowvar=rowvar, ddof=ddof), name="cov")
+
+
+def householder_product(x, tau, name=None):
+    @kernel("householder_product")
+    def impl(a, tau):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[:, i].at[i].set(1.0))
+            h = eye - tau[i] * jnp.outer(v, v)
+            return q @ h
+        q = eye
+        for i in range(n):
+            q = body(i, q)
+        return q[:, :n]
+    return _d.call(impl, (x, tau), name="householder_product")

@@ -1,0 +1,541 @@
+"""Shape/layout manipulation ops.
+
+Reference parity: `python/paddle/tensor/manipulation.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import _dispatch as _d
+from ._dispatch import kernel
+from ..framework import dtype as dtype_mod
+from ..framework.tensor import Tensor
+
+
+@kernel("cast")
+def _cast(x, *, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype, name=None):
+    dtype = dtype_mod.convert_dtype(dtype)
+    if dtype_mod.is_floating(dtype):
+        return _d.call(_cast, (x,), dict(dtype=dtype))
+    return _d.call(_cast, (x,), dict(dtype=dtype), nondiff=True)
+
+
+@kernel("reshape")
+def _reshape(x, *, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape] \
+        if isinstance(shape, (list, tuple)) else shape
+    return _d.call(_reshape, (x,), dict(shape=tuple(shape)))
+
+
+@kernel("transpose")
+def _transpose(x, *, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _d.call(_transpose, (x,), dict(perm=tuple(perm)))
+
+
+def t(x, name=None):
+    nd = x.ndim if isinstance(x, Tensor) else jnp.asarray(x).ndim
+    if nd < 2:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    return transpose(x, list(range(nd))[::-1])
+
+
+def moveaxis(x, source, destination, name=None):
+    @kernel("moveaxis")
+    def impl(a, *, s, d):
+        return jnp.moveaxis(a, s, d)
+    return _d.call(impl, (x,), dict(s=source, d=destination), name="moveaxis")
+
+
+@kernel("flatten")
+def _flatten(x, *, start_axis, stop_axis):
+    shape = x.shape
+    nd = len(shape)
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+    new = shape[:sa] + (int(np.prod(shape[sa:ea + 1])) if nd else 1,) + shape[ea + 1:]
+    return jnp.reshape(x, new)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _d.call(_flatten, (x,), dict(start_axis=start_axis, stop_axis=stop_axis))
+
+
+@kernel("squeeze")
+def _squeeze(x, *, axis):
+    if axis is None:
+        return jnp.squeeze(x)
+    axis = tuple(a for a in (axis if isinstance(axis, (list, tuple)) else [axis])
+                 if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+def squeeze(x, axis=None, name=None):
+    return _d.call(_squeeze, (x,), dict(axis=axis))
+
+
+@kernel("unsqueeze")
+def _unsqueeze(x, *, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    out = x
+    for a in sorted([a % (out.ndim + 1 + len(axes) - 1) if a < 0 else a for a in axes]):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().tolist()
+    return _d.call(_unsqueeze, (x,), dict(axis=axis))
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    @kernel("concat")
+    def impl(*arrs, _ax=axis):
+        return jnp.concatenate(arrs, axis=_ax)
+    return _d.call(impl, tensors, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+
+    @kernel("stack")
+    def impl(*arrs, _ax=axis):
+        return jnp.stack(arrs, axis=_ax)
+    return _d.call(impl, tensors, name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = (x.shape[axis] if isinstance(x, Tensor) else jnp.asarray(x).shape[axis])
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {dim} along axis {axis} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        n_unknown = sum(1 for s in sections if s < 0)
+        if n_unknown:
+            known = sum(s for s in sections if s >= 0)
+            sections = [s if s >= 0 else dim - known for s in sections]
+    offsets = np.cumsum([0] + sections[:-1]).tolist()
+
+    @kernel("split")
+    def impl(a, *, offs=tuple(offsets), secs=tuple(sections), ax=axis):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=ax)
+                     for o, s in zip(offs, secs))
+    out = _d.call(impl, (x,), name="split")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis] if isinstance(x, Tensor) else jnp.asarray(x).shape[axis]
+    parts = split(x, n, axis)
+    return [squeeze(p, axis) for p in parts]
+
+
+@kernel("tile")
+def _tile(x, *, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.numpy().tolist()
+    return _d.call(_tile, (x,), dict(repeat_times=tuple(int(r) for r in repeat_times)))
+
+
+@kernel("expand")
+def _expand(x, *, shape):
+    shape = tuple(s if s != -1 else x.shape[i - (len(shape) - x.ndim)]
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    return _d.call(_expand, (x,), dict(shape=tuple(int(s) for s in shape)))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    target = jnp.broadcast_shapes(*shapes)
+    return [expand(t, target) for t in inputs]
+
+
+@kernel("roll")
+def _roll(x, *, shifts, axis):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _d.call(_roll, (x,), dict(shifts=shifts, axis=axis))
+
+
+@kernel("flip")
+def _flip(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    return _d.call(_flip, (x,), dict(axis=tuple(axis) if isinstance(axis, list) else axis))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    @kernel("rot90")
+    def impl(a, *, k, axes):
+        return jnp.rot90(a, k=k, axes=axes)
+    return _d.call(impl, (x,), dict(k=k, axes=tuple(axes)), name="rot90")
+
+
+@kernel("gather")
+def _gather(x, index, *, axis):
+    idx = index.astype(jnp.int32)
+    if idx.ndim == 0:
+        idx = idx[None]
+    return jnp.take(x, idx, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _d.call(_gather, (x, index), dict(axis=axis))
+
+
+@kernel("gather_nd")
+def _gather_nd(x, index):
+    idx = index.astype(jnp.int32)
+    return x[tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+def gather_nd(x, index, name=None):
+    return _d.call(_gather_nd, (x, index))
+
+
+@kernel("index_select")
+def _index_select(x, index, *, axis):
+    return jnp.take(x, index.astype(jnp.int32), axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _d.call(_index_select, (x, index), dict(axis=axis))
+
+
+@kernel("index_sample")
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)
+
+
+def index_sample(x, index):
+    return _d.call(_index_sample, (x, index))
+
+
+@kernel("take_along_axis")
+def _take_along_axis(x, index, *, axis):
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=axis)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return _d.call(_take_along_axis, (arr, indices), dict(axis=axis))
+
+
+@kernel("put_along_axis")
+def _put_along_axis(x, index, value, *, axis, reduce):
+    idx = index.astype(jnp.int32)
+    value = jnp.broadcast_to(value, idx.shape).astype(x.dtype)
+    dims = list(range(x.ndim))
+    ix = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    ix[axis] = idx
+    if reduce == "assign":
+        return x.at[tuple(ix)].set(value)
+    if reduce == "add":
+        return x.at[tuple(ix)].add(value)
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[tuple(ix)].multiply(value)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    return _d.call(_put_along_axis, (arr, indices, values),
+                   dict(axis=axis, reduce=reduce))
+
+
+@kernel("scatter")
+def _scatter(x, index, updates, *, overwrite):
+    idx = index.astype(jnp.int32)
+    if overwrite:
+        return x.at[idx].set(updates.astype(x.dtype))
+    # paddle scatter with overwrite=False: zero the rows then accumulate
+    zeroed = x.at[idx].set(jnp.zeros_like(updates, dtype=x.dtype))
+    return zeroed.at[idx].add(updates.astype(x.dtype))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _d.call(_scatter, (x, index, updates), dict(overwrite=overwrite))
+
+
+@kernel("scatter_nd_add")
+def _scatter_nd_add(x, index, updates):
+    idx = index.astype(jnp.int32)
+    return x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates.astype(x.dtype))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _d.call(_scatter_nd_add, (x, index, updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros_t = Tensor(jnp.zeros(tuple(shape),
+                               updates.dtype if isinstance(updates, Tensor) else jnp.float32))
+    return scatter_nd_add(zeros_t, index, updates)
+
+
+@kernel("index_put")
+def _index_put(x, value, *, idx):
+    return x.at[idx].set(value.astype(x.dtype))
+
+
+@kernel("index_add")
+def _index_add(x, index, value, *, axis):
+    idx = index.astype(jnp.int32)
+    sel = [slice(None)] * x.ndim
+    sel[axis] = idx
+    return x.at[tuple(sel)].add(value.astype(x.dtype))
+
+
+def index_add(x, index, axis, value, name=None):
+    return _d.call(_index_add, (x, index, value), dict(axis=axis))
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: host-side gather (not jittable; eager only)
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    m = np.asarray(mask.data if isinstance(mask, Tensor) else mask)
+    idx = np.nonzero(m.reshape(-1))[0]
+
+    @kernel("masked_select")
+    def impl(a, *, idx=tuple(idx.tolist())):
+        return jnp.take(a.reshape(-1), jnp.asarray(idx, jnp.int32))
+    return _d.call(impl, (x,), name="masked_select")
+
+
+@kernel("masked_fill")
+def _masked_fill(x, mask, *, value):
+    return jnp.where(mask.astype(bool), jnp.asarray(value, x.dtype), x)
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        value = value.item()
+    return _d.call(_masked_fill, (x, mask), dict(value=value))
+
+
+@kernel("where")
+def _where(cond, x, y):
+    return jnp.where(cond.astype(bool), x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        arr = condition.data if isinstance(condition, Tensor) else jnp.asarray(condition)
+        nz = np.nonzero(np.asarray(arr))
+        return Tensor(jnp.stack([jnp.asarray(i) for i in nz], axis=1).astype(jnp.int64))
+    return _d.call(_where, (condition, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i).astype(jnp.int64)[:, None]) for i in nz)
+    return Tensor(jnp.stack([jnp.asarray(i) for i in nz], axis=1).astype(jnp.int64))
+
+
+@kernel("pad")
+def _pad(x, *, pad, mode, value, data_format):
+    if len(pad) == x.ndim * 2:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle NCHW convention: pad covers the trailing spatial dims, reversed
+        n_spatial = len(pad) // 2
+        spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        if data_format and data_format.endswith("C"):  # NHWC/NLC/NDHWC
+            pairs = [(0, 0)] * (x.ndim - n_spatial - 1) + spatial[::-1] + [(0, 0)]
+        else:  # NCHW-style: spatial dims are the trailing ones
+            pairs = [(0, 0)] * (x.ndim - n_spatial) + spatial[::-1]
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    return _d.call(_pad, (x,), dict(pad=tuple(int(p) for p in pad), mode=mode,
+                                    value=value, data_format=data_format))
+
+
+@kernel("repeat_interleave")
+def _repeat_interleave(x, *, repeats, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats.numpy()
+    return _d.call(_repeat_interleave, (x,), dict(repeats=repeats, axis=axis))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        raise NotImplementedError("axis for unique_consecutive")
+    out = arr[keep]
+    results = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.concatenate([idx, [arr.size]]))
+        results.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+@kernel("as_strided_slice")
+def _slice(x, *, axes, starts, ends):
+    out = x
+    for ax, st, en in zip(axes, starts, ends):
+        size = x.shape[ax]
+        st = max(st + size, 0) if st < 0 else min(st, size)
+        en = max(en + size, 0) if en < 0 else min(en, size)
+        out = jax.lax.slice_in_dim(out, st, en, axis=ax)
+    return out
+
+
+def slice(x, axes, starts, ends):
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+    return _d.call(_slice, (x,), dict(axes=tuple(axes), starts=tuple(starts),
+                                      ends=tuple(ends)), name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+    sl = [builtins.slice(None)] * (x.ndim if isinstance(x, Tensor) else jnp.asarray(x).ndim)
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[ax] = builtins.slice(int(st), int(en), int(sd))
+    return getitem(x, tuple(sl))
+
+
+# ---- python indexing ------------------------------------------------------
+def _norm_index(idx):
+    if isinstance(idx, Tensor):
+        return idx.data
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+def getitem(x, idx):
+    idx = _norm_index(idx)
+
+    @kernel("getitem")
+    def impl(a, *, _idx=idx):
+        return a[_idx]
+    return _d.call(impl, (x,), name="getitem")
+
+
+def setitem(x, idx, value):
+    idx = _norm_index(idx)
+    if isinstance(value, (int, float, bool)):
+        @kernel("setitem_scalar")
+        def impl(a, *, _idx=idx, _v=value):
+            return a.at[_idx].set(_v)
+        return _d.call(impl, (x,), name="setitem")
+
+    @kernel("setitem")
+    def impl2(a, v, *, _idx=idx):
+        return a.at[_idx].set(v.astype(a.dtype))
+    return _d.call(impl2, (x, value), name="setitem")
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.ndim else 1, jnp.int64))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, jnp.int32))
+
+
+def as_complex(x, name=None):
+    @kernel("as_complex")
+    def impl(a):
+        return jax.lax.complex(a[..., 0], a[..., 1])
+    return _d.call(impl, (x,), name="as_complex")
+
+
+def as_real(x, name=None):
+    @kernel("as_real")
+    def impl(a):
+        return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
+    return _d.call(impl, (x,), name="as_real")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import builtins
+    offsets = offsets or [0] * x.ndim
+    sl = tuple(builtins.slice(int(o), int(o) + int(s)) for o, s in zip(offsets, shape))
+    return getitem(x, sl)

@@ -1,0 +1,297 @@
+"""Elementwise & general math ops.
+
+Reference parity: `python/paddle/tensor/math.py` + phi kernels
+(`/root/reference/paddle/phi/kernels/*.h`). Each op is a pure-array kernel
+registered in `KERNELS` plus a Tensor-level wrapper with eager autograd.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import _dispatch as _d
+from ._dispatch import kernel
+
+
+def _make_unary(name, fn, nondiff=False):
+    @kernel(name)
+    def impl(x, _fn=fn):
+        return _fn(x)
+    def op(x, name=None, _impl=impl, _nd=nondiff, _nm=name):
+        return _d.call(_impl, (x,), name=_nm, nondiff=_nd)
+    op.__name__ = name
+    return op
+
+
+def _make_binary(name, fn, nondiff=False):
+    @kernel(name)
+    def impl(x, y, _fn=fn):
+        return _fn(x, y)
+    def op(x, y, name=None, _impl=impl, _nd=nondiff, _nm=name):
+        return _d.call(_impl, (x, y), name=_nm, nondiff=_nd)
+    op.__name__ = name
+    return op
+
+
+# ---- unary ----------------------------------------------------------------
+exp = _make_unary("exp", jnp.exp)
+expm1 = _make_unary("expm1", jnp.expm1)
+log = _make_unary("log", jnp.log)
+log2 = _make_unary("log2", jnp.log2)
+log10 = _make_unary("log10", jnp.log10)
+log1p = _make_unary("log1p", jnp.log1p)
+sqrt = _make_unary("sqrt", jnp.sqrt)
+rsqrt = _make_unary("rsqrt", jax.lax.rsqrt)
+square = _make_unary("square", jnp.square)
+reciprocal = _make_unary("reciprocal", lambda x: 1.0 / x)
+abs = _make_unary("abs", jnp.abs)
+neg = _make_unary("neg", jnp.negative)
+sign = _make_unary("sign", jnp.sign, nondiff=True)
+floor = _make_unary("floor", jnp.floor, nondiff=True)
+ceil = _make_unary("ceil", jnp.ceil, nondiff=True)
+round = _make_unary("round", jnp.round, nondiff=True)
+trunc = _make_unary("trunc", jnp.trunc, nondiff=True)
+frac = _make_unary("frac", lambda x: x - jnp.trunc(x))
+sin = _make_unary("sin", jnp.sin)
+cos = _make_unary("cos", jnp.cos)
+tan = _make_unary("tan", jnp.tan)
+asin = _make_unary("asin", jnp.arcsin)
+acos = _make_unary("acos", jnp.arccos)
+atan = _make_unary("atan", jnp.arctan)
+sinh = _make_unary("sinh", jnp.sinh)
+cosh = _make_unary("cosh", jnp.cosh)
+tanh = _make_unary("tanh", jnp.tanh)
+asinh = _make_unary("asinh", jnp.arcsinh)
+acosh = _make_unary("acosh", jnp.arccosh)
+atanh = _make_unary("atanh", jnp.arctanh)
+erf = _make_unary("erf", jax.lax.erf)
+erfinv = _make_unary("erfinv", jax.lax.erf_inv)
+sigmoid = _make_unary("sigmoid", jax.nn.sigmoid)
+digamma = _make_unary("digamma", jax.lax.digamma)
+lgamma = _make_unary("lgamma", jax.lax.lgamma)
+angle = _make_unary("angle", jnp.angle)
+conj = _make_unary("conj", jnp.conj)
+real = _make_unary("real", jnp.real)
+imag = _make_unary("imag", jnp.imag)
+logit = _make_unary("logit", jax.scipy.special.logit)
+i0 = _make_unary("i0", jnp.i0)
+nan_to_num = _make_unary("nan_to_num", jnp.nan_to_num)
+
+# ---- binary ---------------------------------------------------------------
+add = _make_binary("add", jnp.add)
+subtract = _make_binary("subtract", jnp.subtract)
+multiply = _make_binary("multiply", jnp.multiply)
+divide = _make_binary("divide", jnp.divide)
+floor_divide = _make_binary("floor_divide", jnp.floor_divide, nondiff=True)
+mod = _make_binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _make_binary("pow", jnp.power)
+maximum = _make_binary("maximum", jnp.maximum)
+minimum = _make_binary("minimum", jnp.minimum)
+fmax = _make_binary("fmax", jnp.fmax)
+fmin = _make_binary("fmin", jnp.fmin)
+atan2 = _make_binary("atan2", jnp.arctan2)
+hypot = _make_binary("hypot", jnp.hypot)
+logaddexp = _make_binary("logaddexp", jnp.logaddexp)
+heaviside = _make_binary("heaviside", jnp.heaviside, nondiff=True)
+gcd = _make_binary("gcd", jnp.gcd, nondiff=True)
+lcm = _make_binary("lcm", jnp.lcm, nondiff=True)
+nextafter = _make_binary("nextafter", jnp.nextafter, nondiff=True)
+copysign = _make_binary("copysign", jnp.copysign)
+ldexp = _make_binary("ldexp", jnp.ldexp)
+inner = _make_binary("inner", jnp.inner)
+outer = _make_binary("outer", jnp.outer)
+kron = _make_binary("kron", jnp.kron)
+
+
+@kernel("scale")
+def _scale(x, *, scale, bias, bias_after_scale):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = _d.call(_scale, (x,), dict(scale=scale, bias=bias,
+                                     bias_after_scale=bias_after_scale))
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+@kernel("clip")
+def _clip(x, *, min, max):
+    return jnp.clip(x, min, max)
+
+
+def clip(x, min=None, max=None, name=None):
+    return _d.call(_clip, (x,), dict(min=min, max=max))
+
+
+@kernel("lerp")
+def _lerp(x, y, w):
+    return x + w * (y - x)
+
+
+def lerp(x, y, weight, name=None):
+    return _d.call(_lerp, (x, y, weight))
+
+
+@kernel("stanh")
+def _stanh(x, *, scale_a, scale_b):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _d.call(_stanh, (x,), dict(scale_a=scale_a, scale_b=scale_b))
+
+
+@kernel("rad2deg")
+def _rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def rad2deg(x, name=None):
+    return _d.call(_rad2deg, (x,))
+
+
+@kernel("deg2rad")
+def _deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def deg2rad(x, name=None):
+    return _d.call(_deg2rad, (x,))
+
+
+@kernel("trace")
+def _trace(x, *, offset, axis1, axis2):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _d.call(_trace, (x,), dict(offset=offset, axis1=axis1, axis2=axis2))
+
+
+@kernel("diagonal")
+def _diagonal(x, *, offset, axis1, axis2):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _d.call(_diagonal, (x,), dict(offset=offset, axis1=axis1, axis2=axis2))
+
+
+@kernel("cumsum")
+def _cumsum(x, *, axis):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = _d.call(_cumsum, (x,), dict(axis=axis))
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+
+
+@kernel("cumprod")
+def _cumprod(x, *, dim):
+    return jnp.cumprod(x, axis=dim)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = _d.call(_cumprod, (x,), dict(dim=dim))
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+
+
+@kernel("cummax")
+def _cummax(x, *, axis):
+    return jax.lax.cummax(x, axis=axis)
+
+
+def cummax(x, axis=-1, name=None):
+    return _d.call(_cummax, (x,), dict(axis=axis))
+
+
+@kernel("cummin")
+def _cummin(x, *, axis):
+    return jax.lax.cummin(x, axis=axis)
+
+
+def cummin(x, axis=-1, name=None):
+    return _d.call(_cummin, (x,), dict(axis=axis))
+
+
+# ---- matmul family --------------------------------------------------------
+@kernel("matmul")
+def _matmul(x, y, *, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        axes = list(range(x.ndim))
+        axes[-1], axes[-2] = axes[-2], axes[-1]
+        x = jnp.transpose(x, axes)
+    if transpose_y:
+        axes = list(range(y.ndim))
+        axes[-1], axes[-2] = axes[-2], axes[-1]
+        y = jnp.transpose(y, axes)
+    # preferred_element_type keeps fp32 accumulation on the MXU for bf16 inputs
+    pet = jnp.float32 if x.dtype in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)) else None
+    out = jnp.matmul(x, y, preferred_element_type=pet)
+    return out.astype(x.dtype) if pet is not None else out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _d.call(_matmul, (x, y),
+                   dict(transpose_x=transpose_x, transpose_y=transpose_y),
+                   name="matmul")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+@kernel("dot")
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def dot(x, y, name=None):
+    return _d.call(_dot, (x, y))
+
+
+@kernel("addmm")
+def _addmm(inp, x, y, *, beta, alpha):
+    return beta * inp + alpha * jnp.matmul(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _d.call(_addmm, (input, x, y), dict(beta=beta, alpha=alpha))
+
+
+@kernel("multiplex")
+def _multiplex(index, *ins):
+    stacked = jnp.stack(ins, axis=0)  # [n, batch, ...]
+    idx = index.reshape((1, -1) + (1,) * (stacked.ndim - 2)).astype(jnp.int32)
+    return jnp.take_along_axis(stacked, idx, axis=0)[0]
+
+
+def multiplex(inputs, index, name=None):
+    return _d.call(_multiplex, (index, *inputs))
+
+
+def einsum(equation, *operands):
+    @kernel("einsum")
+    def impl(*arrs, _eq=equation):
+        return jnp.einsum(_eq, *arrs)
+    return _d.call(impl, operands, name="einsum")

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels for hot ops (flash attention, fused norms).
+
+Reference analog: the CUDA `fused/` op tree
+(`/root/reference/paddle/fluid/operators/fused/`) and the KPS tile-primitive
+layer (`operators/kernel_primitives/`). Every kernel here has an XLA-composed
+fallback so the op library works on CPU test meshes.
+"""

@@ -1,0 +1,127 @@
+"""Flash attention.
+
+TPU-native replacement for the reference's fused attention
+(`/root/reference/paddle/fluid/operators/fused/fused_attention_op.cu` +
+`fmha_ref.h` — which materializes the [B,H,L,L] score matrix). Here:
+an online-softmax Pallas kernel tiled for the MXU, with an XLA fallback.
+
+Layout convention (paddle): q/k/v are [batch, seq, heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None):
+    """XLA-composed attention with fp32 softmax accumulation.
+
+    XLA on TPU fuses this well for moderate sequence lengths; the Pallas
+    kernel below takes over for long sequences.
+    """
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    qf = q.astype(jnp.float32) * scale
+    # [B,H,Lq,Lk]
+    logits = jnp.einsum("blhd,bmhd->bhlm", qf, k.astype(jnp.float32))
+    if causal:
+        cmask = jnp.tril(jnp.ones((Lq, Lk), dtype=bool), k=Lk - Lq)
+        logits = jnp.where(cmask, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhlm,bmhd->blhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
+def _flash_attention_pallas(q, k, v, causal=False, scale=None,
+                            block_q=256, block_k=256):
+    """Pallas online-softmax attention over [B,H] grid, tiled (block_q, block_k)."""
+    from jax.experimental import pallas as pl
+
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    block_q = min(block_q, Lq)
+    block_k = min(block_k, Lk)
+
+    # [B,H,L,D] layout inside the kernel
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qb = q_ref[...].astype(jnp.float32) * scale  # [bq, D]
+        m = jnp.full((qb.shape[0],), -jnp.inf, jnp.float32)
+        l = jnp.zeros((qb.shape[0],), jnp.float32)
+        acc = jnp.zeros((qb.shape[0], D), jnp.float32)
+        qi = pl.program_id(2)
+
+        def body(j, carry):
+            m, l, acc = carry
+            kb = pl.load(k_ref, (pl.dslice(j * block_k, block_k), slice(None))).astype(jnp.float32)
+            vb = pl.load(v_ref, (pl.dslice(j * block_k, block_k), slice(None))).astype(jnp.float32)
+            s = qb @ kb.T  # [bq, bk]
+            if causal:
+                rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, -1e30)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=-1)
+            acc_new = acc * corr[:, None] + p @ vb
+            return m_new, l_new, acc_new
+
+        if causal:
+            # only iterate over blocks at or before the diagonal
+            n_k = (qi + 1) * block_q // block_k
+            n_k = jnp.minimum(pl.cdiv(Lk, block_k), pl.cdiv((qi + 1) * block_q, block_k))
+        else:
+            n_k = pl.cdiv(Lk, block_k)
+        m, l, acc = jax.lax.fori_loop(0, n_k, body, (m, l, acc))
+        o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+    grid = (B, H, pl.cdiv(Lq, block_q))
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, Lk, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((None, None, Lk, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attention(q, k, v, mask=None, causal=False, scale=None):
+    """Dispatch: Pallas kernel on TPU for long seqs w/o arbitrary mask, else XLA."""
+    Lq, Lk = q.shape[1], k.shape[1]
+    use_pallas = (_on_tpu() and mask is None and Lq >= 512 and Lk >= 512
+                  and Lq % 128 == 0 and Lk % 128 == 0)
+    if use_pallas:
+        try:
+            return _flash_attention_pallas(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return flash_attention_xla(q, k, v, mask=mask, causal=causal, scale=scale)

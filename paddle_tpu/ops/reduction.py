@@ -1,0 +1,242 @@
+"""Reductions, sort/search ops.
+
+Reference parity: `python/paddle/tensor/math.py` (reduce ops) and `search.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import _dispatch as _d
+from ._dispatch import kernel
+from ..framework import dtype as dtype_mod
+from ..framework.tensor import Tensor
+
+
+def _axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+def _make_reduce(name, fn, nondiff=False):
+    @kernel(name)
+    def impl(x, *, axis, keepdim, _fn=fn):
+        return _fn(x, axis=axis, keepdims=keepdim)
+    def op(x, axis=None, keepdim=False, name=None, _impl=impl, _nm=name, _nd=nondiff):
+        return _d.call(_impl, (x,), dict(axis=_axis(axis), keepdim=keepdim),
+                       name=_nm, nondiff=_nd)
+    op.__name__ = name
+    return op
+
+
+sum = _make_reduce("sum", jnp.sum)
+mean = _make_reduce("mean", jnp.mean)
+prod = _make_reduce("prod", jnp.prod)
+max = _make_reduce("max", jnp.max)
+min = _make_reduce("min", jnp.min)
+amax = _make_reduce("amax", jnp.max)
+amin = _make_reduce("amin", jnp.min)
+all = _make_reduce("all", jnp.all, nondiff=True)
+any = _make_reduce("any", jnp.any, nondiff=True)
+nansum = _make_reduce("nansum", jnp.nansum)
+nanmean = _make_reduce("nanmean", jnp.nanmean)
+
+
+@kernel("std")
+def _std(x, *, axis, unbiased, keepdim):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _d.call(_std, (x,), dict(axis=_axis(axis), unbiased=unbiased, keepdim=keepdim))
+
+
+@kernel("var")
+def _var(x, *, axis, unbiased, keepdim):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _d.call(_var, (x,), dict(axis=_axis(axis), unbiased=unbiased, keepdim=keepdim))
+
+
+@kernel("logsumexp")
+def _logsumexp(x, *, axis, keepdim):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _d.call(_logsumexp, (x,), dict(axis=_axis(axis), keepdim=keepdim))
+
+
+@kernel("median")
+def _median(x, *, axis, keepdim):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return _d.call(_median, (x,), dict(axis=_axis(axis), keepdim=keepdim))
+
+
+@kernel("nanmedian")
+def _nanmedian(x, *, axis, keepdim):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return _d.call(_nanmedian, (x,), dict(axis=_axis(axis), keepdim=keepdim))
+
+
+@kernel("quantile")
+def _quantile(x, *, q, axis, keepdim):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return _d.call(_quantile, (x,), dict(q=q, axis=_axis(axis), keepdim=keepdim))
+
+
+@kernel("argmax")
+def _argmax(x, *, axis, keepdim):
+    out = jnp.argmax(x, axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.int64)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _d.call(_argmax, (x,), dict(axis=axis, keepdim=keepdim), nondiff=True)
+
+
+@kernel("argmin")
+def _argmin(x, *, axis, keepdim):
+    out = jnp.argmin(x, axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.int64)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _d.call(_argmin, (x,), dict(axis=axis, keepdim=keepdim), nondiff=True)
+
+
+@kernel("topk")
+def _topk(x, *, k, axis, largest, sorted):
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    if axis != -1 and axis != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    return _d.call(_topk, (x,), dict(k=k, axis=axis, largest=largest, sorted=sorted))
+
+
+@kernel("sort")
+def _sort(x, *, axis, descending):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return _d.call(_sort, (x,), dict(axis=axis, descending=descending))
+
+
+@kernel("argsort")
+def _argsort(x, *, axis, descending):
+    out = jnp.argsort(x, axis=axis)
+    return (jnp.flip(out, axis=axis) if descending else out).astype(jnp.int64)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return _d.call(_argsort, (x,), dict(axis=axis, descending=descending), nondiff=True)
+
+
+@kernel("kthvalue")
+def _kthvalue(x, *, k, axis, keepdim):
+    sorted_x = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    val = jnp.take(sorted_x, k - 1, axis=axis)
+    idx = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return val, idx.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return _d.call(_kthvalue, (x,), dict(k=k, axis=axis, keepdim=keepdim))
+
+
+@kernel("mode")
+def _mode(x, *, axis, keepdim):
+    # O(n^2) pairwise count along the axis; ties resolve to the first argmax
+    xm = jnp.moveaxis(x, axis, -1)
+    eq = xm[..., :, None] == xm[..., None, :]
+    cnt = jnp.sum(eq, axis=-1)
+    best = jnp.argmax(cnt, axis=-1)
+    val = jnp.take_along_axis(xm, best[..., None], axis=-1)[..., 0]
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        best = jnp.expand_dims(best, axis)
+    return val, best.astype(jnp.int64)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return _d.call(_mode, (x,), dict(axis=axis, keepdim=keepdim))
+
+
+@kernel("searchsorted")
+def _searchsorted(sorted_seq, values, *, right):
+    side = "right" if right else "left"
+    if sorted_seq.ndim == 1:
+        return jnp.searchsorted(sorted_seq, values, side=side).astype(jnp.int64)
+    fn = lambda s, v: jnp.searchsorted(s, v, side=side)
+    for _ in range(sorted_seq.ndim - 1):
+        fn = jax.vmap(fn)
+    return fn(sorted_seq, values).astype(jnp.int64)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    return _d.call(_searchsorted, (sorted_sequence, values), dict(right=right),
+                   nondiff=True)
+
+
+@kernel("bincount")
+def _bincount(x, *, minlength):
+    return jnp.bincount(x.astype(jnp.int32), minlength=minlength)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        @kernel("bincount_w")
+        def impl(a, w, *, minlength):
+            return jnp.bincount(a.astype(jnp.int32), weights=w, minlength=minlength)
+        return _d.call(impl, (x, weights), dict(minlength=minlength), name="bincount")
+    return _d.call(_bincount, (x,), dict(minlength=minlength), nondiff=True)
+
+
+@kernel("histogram")
+def _histogram(x, *, bins, min, max):
+    lo, hi = (min, max) if (min != 0 or max != 0) else (jnp.min(x), jnp.max(x))
+    h, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return h.astype(jnp.int64)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    return _d.call(_histogram, (input,), dict(bins=bins, min=min, max=max), nondiff=True)

@@ -1,0 +1,10 @@
+"""paddle_tpu.optimizer — optimizers + lr schedulers.
+
+Reference parity: `python/paddle/optimizer/`.
+"""
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum, Momentum,
+    RMSProp,
+)
+from . import lr  # noqa: F401

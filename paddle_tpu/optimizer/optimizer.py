@@ -1,0 +1,162 @@
+"""Optimizer base.
+
+Reference: `python/paddle/optimizer/optimizer.py:50` + the device optimizer
+kernels (`/root/reference/paddle/fluid/operators/optimizers/`). Each
+optimizer defines a pure per-parameter update `_update(p, g, slots, lr, t)`;
+the eager `step()` walks parameters, while `apply_fn()` exposes the same
+update as a jit-compatible pytree transform (the TPU equivalent of the
+reference's fused `merged_adam` multi-tensor kernels — XLA fuses the whole
+tree update into a couple of kernels).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework.param import Parameter
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        if self._parameter_list is None:
+            raise ValueError("parameters is required in dygraph mode")
+        self._grad_clip = grad_clip
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay object
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay, "coeff", 0.0)))
+        self._slots: Dict[int, dict] = {}
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate.get_lr())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    # -- per-parameter slots -------------------------------------------------
+    def _init_slots(self, p: Parameter) -> dict:
+        return {}
+
+    def _update(self, p: jax.Array, g: jax.Array, slots: dict, lr, t: int, **kw):
+        raise NotImplementedError
+
+    def _param_kw(self, name: str) -> dict:
+        """Per-parameter static update options (e.g. decay exclusion), keyed
+        by parameter name. Overridden by AdamW/Lamb."""
+        return {}
+
+    def _decay_grad(self, p, g):
+        """L2 regularization folded into the gradient (non-decoupled)."""
+        if self._weight_decay:
+            return g + self._weight_decay * p
+        return g
+
+    # -- eager step ----------------------------------------------------------
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+    def step(self):
+        self._step_count += 1
+        lr = self.get_lr()
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            if g is None:
+                continue
+            sid = id(p)
+            if sid not in self._slots:
+                self._slots[sid] = self._init_slots(p)
+            g_arr = g.data.astype(jnp.float32) if g.data.dtype != p.data.dtype \
+                else g.data
+            new_p, new_slots = self._update(p.data, g_arr, self._slots[sid],
+                                            lr, self._step_count,
+                                            **self._param_kw(p.name or ""))
+            p.data = new_p.astype(p.data.dtype)
+            self._slots[sid] = new_slots
+
+    # paddle legacy API
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return [], []
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- functional interface (for compiled training steps) ------------------
+    def init_state_tree(self, params_tree):
+        """Build the slot pytree for a params pytree of jax arrays."""
+        def mk(p):
+            fake = Parameter(p)
+            return self._init_slots(fake)
+        return jax.tree_util.tree_map(mk, params_tree)
+
+    def apply_fn(self, params_tree, grads_tree, state_tree, lr=None, t=1):
+        """Pure update: (params, grads, slots) -> (new_params, new_slots)."""
+        lr = self.get_lr() if lr is None else lr
+        if self._grad_clip is not None and hasattr(self._grad_clip, "clip_fn"):
+            grads_tree = self._grad_clip.clip_fn(grads_tree)
+        flat_kp, treedef = jax.tree_util.tree_flatten_with_path(params_tree)
+        names = [jax.tree_util.keystr(kp) for kp, _ in flat_kp]
+        flat_p = [p for _, p in flat_kp]
+        flat_g = jax.tree_util.tree_flatten(grads_tree)[0]
+        flat_s = treedef.flatten_up_to(state_tree)
+        new_p, new_s = [], []
+        for name, p, g, s in zip(names, flat_p, flat_g, flat_s):
+            np_, ns_ = self._update(p, g.astype(jnp.float32) if g.dtype != p.dtype else g,
+                                    s, lr, t, **self._param_kw(name))
+            new_p.append(np_.astype(p.dtype))
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                jax.tree_util.tree_unflatten(treedef, new_s))
+
+    # -- checkpointing -------------------------------------------------------
+    def state_dict(self):
+        sd = {"step": self._step_count}
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        for i, p in enumerate(self._parameter_list):
+            slots = self._slots.get(id(p))
+            if slots:
+                key = p.name or f"param_{i}"
+                for sname, sval in slots.items():
+                    sd[f"{key}.{sname}"] = np.asarray(sval) if isinstance(sval, jax.Array) else sval
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("step", 0))
+        if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            slots = {}
+            for sname_full, sval in state_dict.items():
+                if sname_full.startswith(key + "."):
+                    sname = sname_full[len(key) + 1:]
+                    slots[sname] = jnp.asarray(sval) if isinstance(sval, np.ndarray) else sval
+            if slots:
+                self._slots[id(p)] = slots

@@ -1,0 +1,31 @@
+"""Test config: force an 8-device virtual CPU mesh before jax initializes.
+
+Mirrors the reference's test strategy of simulating clusters on localhost
+(`/root/reference/python/paddle/fluid/tests/unittests/test_dist_base.py:968`):
+distributed tests run on 8 virtual CPU devices via
+--xla_force_host_platform_device_count.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield
+    from paddle_tpu.framework import tape
+    tape.reset_tape()

@@ -1,0 +1,57 @@
+"""OpTest harness — numeric-gradient checking utilities.
+
+Reference: `unittests/op_test.py:289` (`check_output`, `check_grad` with
+finite differences at `op_test.py:120`).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.param import Parameter
+from paddle_tpu.framework.tensor import Tensor
+
+
+def numeric_grad(fn, inputs, wrt=0, eps=1e-3):
+    """Central finite differences of sum(fn(*inputs)) w.r.t. inputs[wrt]."""
+    inputs = [np.asarray(x, np.float64) for x in inputs]
+    base = inputs[wrt]
+    grad = np.zeros_like(base)
+    it = np.nditer(base, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = base[idx]
+        base[idx] = orig + eps
+        plus = float(np.sum(np.asarray(fn(*[x.astype(np.float32) for x in inputs]))))
+        base[idx] = orig - eps
+        minus = float(np.sum(np.asarray(fn(*[x.astype(np.float32) for x in inputs]))))
+        base[idx] = orig
+        grad[idx] = (plus - minus) / (2 * eps)
+        it.iternext()
+    return grad
+
+
+def check_grad(op, np_inputs, wrt=0, atol=5e-3, rtol=5e-3, **op_kwargs):
+    """Compare tape backward() grads against finite differences."""
+    params = [Parameter(x.astype(np.float32)) for x in np_inputs]
+    out = op(*params, **op_kwargs)
+    loss = paddle.sum(out) if not np.isscalar(out) else out
+    loss.backward()
+    analytic = params[wrt].grad.numpy()
+
+    def fn(*xs):
+        with paddle.no_grad():
+            ts = [Tensor(x) for x in xs]
+            return op(*ts, **op_kwargs).numpy()
+
+    numeric = numeric_grad(fn, np_inputs, wrt=wrt)
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)
+
+
+def check_output(op, np_inputs, np_ref_fn, atol=1e-5, rtol=1e-5, **op_kwargs):
+    ts = [Tensor(x) for x in np_inputs]
+    out = op(*ts, **op_kwargs)
+    ref = np_ref_fn(*np_inputs)
+    if isinstance(out, (tuple, list)):
+        for o, r in zip(out, ref):
+            np.testing.assert_allclose(o.numpy(), r, atol=atol, rtol=rtol)
+    else:
+        np.testing.assert_allclose(out.numpy(), ref, atol=atol, rtol=rtol)

@@ -1,0 +1,136 @@
+"""Autograd tape + functional transforms tests.
+
+Reference test analog: `unittests/autograd/` + eager grad tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.param import Parameter
+from paddle_tpu.framework.tensor import Tensor
+
+
+def test_backward_chain():
+    x = Parameter(np.array([2.0, 3.0], np.float32))
+    y = (x * x + x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy() + 1)
+
+
+def test_grad_accumulation():
+    x = Parameter(np.ones(3, np.float32))
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0] * 3)
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient():
+    x = Parameter(np.ones(3, np.float32))
+    y = Tensor(np.ones(3, np.float32))  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    assert x.grad is not None and y.grad is None
+
+
+def test_no_grad():
+    x = Parameter(np.ones(3, np.float32))
+    with paddle.no_grad():
+        y = (x * x).sum()
+    assert y.stop_gradient
+    from paddle_tpu.framework import tape
+    assert tape.tape_size() == 0
+
+
+def test_detach():
+    x = Parameter(np.ones(3, np.float32))
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    (d * x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0] * 3)
+
+
+def test_paddle_grad_api():
+    x = Parameter(np.array([1.0, 2.0], np.float32))
+    y = (x ** 3.0).sum()
+    gx, = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), 3 * x.numpy() ** 2, rtol=1e-5)
+    assert x.grad is None  # paddle.grad must not write .grad
+
+
+def test_multi_output_op_grad():
+    x = Parameter(np.random.randn(4, 5).astype(np.float32))
+    vals, idx = paddle.topk(x, 2, axis=1)
+    vals.sum().backward()
+    g = x.grad.numpy()
+    assert (g.sum(axis=1) == 2).all()
+
+
+def test_fanin_accumulation():
+    x = Parameter(np.array([2.0], np.float32))
+    a = x * 3
+    b = x * 4
+    (a + b).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_retain_graph():
+    x = Parameter(np.array([2.0], np.float32))
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_functional_vjp_jvp():
+    from paddle_tpu import autograd
+
+    def f(x):
+        return x.exp().sum()
+
+    x = Tensor(np.array([0.0, 1.0], np.float32))
+    out, g = autograd.vjp(f, x)
+    np.testing.assert_allclose(g.numpy(), np.exp(x.numpy()), rtol=1e-5)
+    out, jv = autograd.jvp(f, x)
+    np.testing.assert_allclose(jv.numpy(), np.exp(x.numpy()).sum(), rtol=1e-5)
+
+
+def test_jacobian_hessian():
+    from paddle_tpu import autograd
+
+    def f(x):
+        return (x * x).sum()
+
+    x = Tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    h = autograd.Hessian(f, x)
+    np.testing.assert_allclose(h[:].numpy(), 2 * np.eye(3), atol=1e-5)
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 2
+
+    x = Parameter(np.array([3.0], np.float32))
+    y = Double.apply(x)
+    y.backward()
+    np.testing.assert_allclose(y.numpy(), [6.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_double_grad_functional():
+    # higher-order via functional transforms (tape create_graph unsupported)
+    import jax
+    import jax.numpy as jnp
+    g2 = jax.grad(jax.grad(lambda x: jnp.sum(x ** 3)))(2.0)
+    assert abs(float(g2) - 12.0) < 1e-5

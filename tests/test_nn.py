@@ -1,0 +1,223 @@
+"""nn layers + functional tests (reference analog: unittests/test_layers.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.tensor import Tensor
+
+from op_test import check_grad
+
+
+def t(x):
+    return Tensor(np.asarray(x, np.float32))
+
+
+class TestLayers:
+    def test_linear(self):
+        l = nn.Linear(4, 3)
+        x = t(np.random.randn(2, 4))
+        out = l(x)
+        assert out.shape == [2, 3]
+        ref = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_conv2d_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        conv = nn.Conv2D(3, 5, 3, stride=2, padding=1)
+        out = conv(t(x))
+        tref = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(np.asarray(conv.weight.numpy())),
+            torch.tensor(np.asarray(conv.bias.numpy())), stride=2, padding=1)
+        np.testing.assert_allclose(out.numpy(), tref.numpy(), atol=1e-4)
+
+    def test_conv_grad(self):
+        x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+        w = np.random.randn(3, 2, 3, 3).astype(np.float32)
+        check_grad(lambda a, b: F.conv2d(a, b, padding=1), [x, w], wrt=1,
+                   atol=2e-2, rtol=2e-2)
+
+    def test_conv2d_transpose_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(2, 4, 5, 5).astype(np.float32)
+        w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+        out = F.conv2d_transpose(t(x), t(w), stride=2, padding=1)
+        tref = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1)
+        np.testing.assert_allclose(out.numpy(), tref.numpy(), atol=1e-4)
+
+    def test_pools_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+        out = F.max_pool2d(t(x), 2, 2)
+        ref = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-6)
+        out = F.avg_pool2d(t(x), 3, 2, 1)
+        ref = torch.nn.functional.avg_pool2d(torch.tensor(x), 3, 2, 1,
+                                             count_include_pad=False)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+        out = F.adaptive_avg_pool2d(t(x), 2)
+        ref = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), 2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_batchnorm(self):
+        bn = nn.BatchNorm2D(3)
+        x = t(np.random.randn(4, 3, 5, 5) * 2 + 1)
+        bn.train()
+        out = bn(x)
+        m = out.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == out.shape
+
+    def test_layernorm_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(2, 5, 8).astype(np.float32)
+        ln = nn.LayerNorm(8)
+        out = ln(t(x))
+        tln = torch.nn.LayerNorm(8)
+        with torch.no_grad():
+            tln.weight.copy_(torch.tensor(np.asarray(ln.weight.numpy())))
+            tln.bias.copy_(torch.tensor(np.asarray(ln.bias.numpy())))
+        np.testing.assert_allclose(out.numpy(), tln(torch.tensor(x)).detach().numpy(),
+                                   atol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = Tensor(np.array([[1, 0, 3]], np.int64))
+        out = emb(idx)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+    def test_dropout(self):
+        d = nn.Dropout(0.5)
+        x = t(np.ones((100, 100)))
+        d.train()
+        out = d(x)
+        frac = (out.numpy() == 0).mean()
+        assert 0.4 < frac < 0.6
+        # upscale keeps expectation
+        assert abs(out.numpy().mean() - 1.0) < 0.05
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+    def test_sequential_state_dict(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = net.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        missing, unexpected = net2.set_state_dict(sd)
+        assert not missing and not unexpected
+        np.testing.assert_allclose(net2[0].weight.numpy(), net[0].weight.numpy())
+
+    def test_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(lambda lay, inp, out: calls.append(1))
+        l(t(np.ones((1, 2))))
+        assert calls == [1]
+        h.remove()
+        l(t(np.ones((1, 2))))
+        assert calls == [1]
+
+
+class TestFunctional:
+    def test_softmax_ce(self):
+        torch = pytest.importorskip("torch")
+        logits = np.random.randn(4, 7).astype(np.float32)
+        labels = np.random.randint(0, 7, (4,))
+        loss = F.cross_entropy(t(logits), Tensor(labels))
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels))
+        np.testing.assert_allclose(loss.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_ce_soft_label_smoothing(self):
+        logits = np.random.randn(4, 7).astype(np.float32)
+        labels = np.random.randint(0, 7, (4,))
+        l1 = F.cross_entropy(t(logits), Tensor(labels), label_smoothing=0.1)
+        soft = np.eye(7, dtype=np.float32)[labels] * 0.9 + 0.1 / 7
+        l2 = F.cross_entropy(t(logits), Tensor(soft), soft_label=True)
+        np.testing.assert_allclose(l1.numpy(), l2.numpy(), rtol=1e-5)
+
+    def test_ce_ignore_index(self):
+        logits = np.random.randn(4, 7).astype(np.float32)
+        labels = np.array([1, 2, 0, 0])
+        l = F.cross_entropy(t(logits), Tensor(labels), ignore_index=0)
+        lp = -np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+        ref = (lp[0, 1] + lp[1, 2]) / 2
+        np.testing.assert_allclose(l.numpy(), ref, rtol=1e-5)
+
+    def test_bce(self):
+        torch = pytest.importorskip("torch")
+        z = np.random.randn(8).astype(np.float32)
+        y = np.random.randint(0, 2, 8).astype(np.float32)
+        l = F.binary_cross_entropy_with_logits(t(z), t(y))
+        ref = torch.nn.functional.binary_cross_entropy_with_logits(
+            torch.tensor(z), torch.tensor(y))
+        np.testing.assert_allclose(l.numpy(), ref.numpy(), rtol=1e-5)
+
+    def test_activations_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.randn(5, 5).astype(np.float32)
+        for ours, theirs in [
+            (F.relu, torch.nn.functional.relu),
+            (F.gelu, lambda v: torch.nn.functional.gelu(v)),
+            (F.silu, torch.nn.functional.silu),
+            (F.softplus, torch.nn.functional.softplus),
+            (F.elu, torch.nn.functional.elu),
+            (F.hardswish, torch.nn.functional.hardswish),
+        ]:
+            np.testing.assert_allclose(ours(t(x)).numpy(),
+                                       theirs(torch.tensor(x)).numpy(),
+                                       atol=1e-5, err_msg=str(ours))
+
+    def test_attention_causal(self):
+        q = np.random.randn(2, 6, 2, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(q), t(q), is_causal=True)
+        assert out.shape == [2, 6, 2, 8]
+        # first position attends only to itself -> equals v[0]
+        np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], atol=1e-5)
+
+    def test_interpolate(self):
+        x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+        out = F.interpolate(t(x), scale_factor=2, mode="nearest")
+        assert out.shape == [1, 2, 8, 8]
+
+    def test_grad_clip(self):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+        from paddle_tpu.framework.param import Parameter
+        p = Parameter(np.ones(4, np.float32))
+        g = Tensor(np.full(4, 10.0, np.float32))
+        clip = ClipGradByGlobalNorm(1.0)
+        [(_, gc)] = clip([(p, g)])
+        np.testing.assert_allclose(np.linalg.norm(gc.numpy()), 1.0, rtol=1e-5)
+
+
+class TestTransformer:
+    def test_encoder_shapes(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = t(np.random.randn(2, 5, 16))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_mha_self_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.randn(2, 5, 16))
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_decoder_with_cache(self):
+        layer = nn.TransformerDecoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+        dec = nn.TransformerDecoder(layer, 2)
+        memory = t(np.random.randn(2, 7, 16))
+        tgt = t(np.random.randn(2, 1, 16))
+        cache = dec.gen_cache(memory)
+        out, new_cache = dec(tgt, memory, cache=cache)
+        assert out.shape == [2, 1, 16]
+        assert new_cache[0][0].k.shape[1] == 1

@@ -1,0 +1,209 @@
+"""Core op correctness + gradient checks (OpTest-style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+from op_test import check_grad, check_output
+
+
+class TestMathOps:
+    def test_binary_outputs(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        check_output(paddle.add, [a, b], np.add)
+        check_output(paddle.subtract, [a, b], np.subtract)
+        check_output(paddle.multiply, [a, b], np.multiply)
+        check_output(paddle.divide, [a, b], np.divide, atol=1e-4)
+        check_output(paddle.maximum, [a, b], np.maximum)
+
+    def test_broadcast(self):
+        a = np.random.randn(3, 1, 4).astype(np.float32)
+        b = np.random.randn(5, 1).astype(np.float32)
+        check_output(paddle.add, [a, b], np.add)
+
+    def test_unary_outputs(self):
+        a = np.abs(np.random.randn(3, 4).astype(np.float32)) + 0.5
+        check_output(paddle.exp, [a], np.exp, rtol=1e-5)
+        check_output(paddle.log, [a], np.log)
+        check_output(paddle.sqrt, [a], np.sqrt)
+        check_output(paddle.tanh, [a], np.tanh)
+        check_output(paddle.abs, [a - 1.0], lambda x: np.abs(x))
+
+    def test_matmul_grad(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        check_grad(paddle.matmul, [a, b], wrt=0)
+        check_grad(paddle.matmul, [a, b], wrt=1)
+
+    def test_matmul_transpose(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(5, 4).astype(np.float32)
+        check_output(paddle.matmul, [a, b], lambda x, y: x.T @ y.T,
+                     transpose_x=True, transpose_y=True)
+
+    def test_elementwise_grads(self):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.5
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        check_grad(paddle.multiply, [a, b], wrt=0)
+        check_grad(paddle.divide, [a, b], wrt=1)
+        check_grad(paddle.exp, [a], wrt=0)
+        check_grad(paddle.tanh, [a], wrt=0)
+        check_grad(paddle.sqrt, [a], wrt=0)
+
+    def test_pow_scale_clip(self):
+        a = np.random.rand(4).astype(np.float32) + 1.0
+        check_output(paddle.pow, [a], lambda x: x ** 2.0, y=2.0)
+        out = paddle.scale(Tensor(a), scale=3.0, bias=1.0)
+        np.testing.assert_allclose(out.numpy(), a * 3 + 1, rtol=1e-6)
+        out = paddle.clip(Tensor(a), min=1.2, max=1.5)
+        np.testing.assert_allclose(out.numpy(), np.clip(a, 1.2, 1.5))
+
+    def test_cumsum_trace(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        check_output(paddle.cumsum, [a], lambda x: np.cumsum(x, 1), axis=1)
+        check_output(paddle.trace, [np.random.randn(4, 4).astype(np.float32)],
+                     lambda x: np.trace(x)[None] if np.isscalar(np.trace(x)) else np.trace(x))
+
+
+class TestReduceOps:
+    def test_outputs(self):
+        a = np.random.randn(3, 4, 5).astype(np.float32)
+        check_output(paddle.sum, [a], lambda x: x.sum())
+        check_output(paddle.sum, [a], lambda x: x.sum(1), axis=1)
+        check_output(paddle.mean, [a], lambda x: x.mean(axis=(0, 2)), axis=[0, 2])
+        check_output(paddle.max, [a], lambda x: x.max(2), axis=2)
+        check_output(paddle.min, [a], lambda x: x.min(), )
+        check_output(paddle.prod, [a[:2, :2, 0]], lambda x: x.prod(1), axis=1)
+        check_output(paddle.std, [a], lambda x: x.std(ddof=1), )
+        check_output(paddle.logsumexp, [a],
+                     lambda x: np.log(np.exp(x).sum(-1)), axis=-1, rtol=1e-4)
+
+    def test_grads(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        check_grad(paddle.sum, [a])
+        check_grad(paddle.mean, [a])
+        check_grad(lambda x: paddle.max(x, axis=1), [a])
+
+    def test_argmax_topk_sort(self):
+        a = np.random.randn(4, 6).astype(np.float32)
+        assert np.array_equal(paddle.argmax(Tensor(a), axis=1).numpy(),
+                              a.argmax(1))
+        vals, idx = paddle.topk(Tensor(a), 3, axis=1)
+        ref = -np.sort(-a, axis=1)[:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+        s = paddle.sort(Tensor(a), axis=1, descending=True)
+        np.testing.assert_allclose(s.numpy(), -np.sort(-a, 1), rtol=1e-6)
+
+
+class TestManipulationOps:
+    def test_reshape_transpose(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        check_output(paddle.reshape, [a], lambda x: x.reshape(4, 6), shape=[4, 6])
+        check_output(paddle.transpose, [a], lambda x: x.transpose(2, 0, 1),
+                     perm=[2, 0, 1])
+        check_grad(paddle.reshape, [a], shape=[6, 4])
+        check_grad(paddle.transpose, [a], perm=[1, 0, 2])
+
+    def test_concat_split_stack(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(2, 3).astype(np.float32)
+        out = paddle.concat([Tensor(a), Tensor(b)], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 1))
+        parts = paddle.split(Tensor(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(Tensor(a), [1, 2], axis=1)
+        assert parts[1].shape == [2, 2]
+        st = paddle.stack([Tensor(a), Tensor(b)], axis=0)
+        assert st.shape == [2, 2, 3]
+
+    def test_gather_scatter(self):
+        a = np.random.randn(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        out = paddle.gather(Tensor(a), Tensor(idx), axis=0)
+        np.testing.assert_allclose(out.numpy(), a[idx])
+        upd = np.ones((3, 3), np.float32)
+        out = paddle.scatter(Tensor(a), Tensor(idx), Tensor(upd))
+        ref = a.copy(); ref[idx] = 1.0
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_where_masked(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        cond = a > 0
+        out = paddle.where(Tensor(cond), Tensor(a), Tensor(np.zeros_like(a)))
+        np.testing.assert_allclose(out.numpy(), np.where(cond, a, 0))
+        out = paddle.masked_fill(Tensor(a), Tensor(cond), -1.0)
+        np.testing.assert_allclose(out.numpy(), np.where(cond, -1.0, a))
+
+    def test_indexing(self):
+        a = np.arange(24).reshape(4, 6).astype(np.float32)
+        t = Tensor(a)
+        np.testing.assert_allclose(t[1:3, ::2].numpy(), a[1:3, ::2])
+        np.testing.assert_allclose(t[Tensor(np.array([0, 3]))].numpy(), a[[0, 3]])
+        t[0, 0] = 99.0
+        assert t.numpy()[0, 0] == 99.0
+
+    def test_pad_tile_flip(self):
+        a = np.random.randn(2, 3, 4, 4).astype(np.float32)
+        out = paddle.pad(Tensor(a), [1, 1, 2, 2])
+        assert out.shape == [2, 3, 8, 6]
+        out = paddle.tile(Tensor(a[:, :, 0, 0]), [2, 3])
+        np.testing.assert_allclose(out.numpy(), np.tile(a[:, :, 0, 0], (2, 3)))
+        out = paddle.flip(Tensor(a), axis=[2])
+        np.testing.assert_allclose(out.numpy(), np.flip(a, 2))
+
+
+class TestComparisonOps:
+    def test_all(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = a.copy(); b[0, 0] += 1
+        assert not bool(paddle.equal_all(Tensor(a), Tensor(b)))
+        assert bool(paddle.allclose(Tensor(a), Tensor(a + 1e-9)))
+        np.testing.assert_array_equal(
+            paddle.greater_than(Tensor(a), Tensor(b)).numpy(), a > b)
+
+
+class TestLinalg:
+    def test_basics(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        np.testing.assert_allclose(paddle.linalg.cholesky(Tensor(spd)).numpy(),
+                                   np.linalg.cholesky(spd), atol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.inv(Tensor(spd)).numpy(),
+                                   np.linalg.inv(spd), atol=1e-4)
+        u, s, v = paddle.linalg.svd(Tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ v.numpy().T, a, atol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.det(Tensor(spd)).numpy(),
+                                   np.linalg.det(spd), rtol=1e-4)
+
+    def test_norm(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.linalg.norm(Tensor(a)).numpy(),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.norm(Tensor(a), p=1, axis=1).numpy(),
+            np.abs(a).sum(1), rtol=1e-5)
+
+
+class TestCreation:
+    def test_creation(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], dtype="int32").dtype == np.int32
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        assert paddle.full([2, 2], 7.0).numpy()[0, 0] == 7.0
+        tl = paddle.tril(Tensor(np.ones((3, 3), np.float32)))
+        np.testing.assert_allclose(tl.numpy(), np.tril(np.ones((3, 3))))
+
+    def test_random(self):
+        paddle.seed(7)
+        a = paddle.rand([1000])
+        assert -1.0 <= float(a.min().item()) and float(a.max().item()) <= 1.0
+        b = paddle.randn([2000])
+        assert abs(float(b.mean().item())) < 0.1
+        r = paddle.randint(0, 10, [100])
+        assert 0 <= int(r.min().item()) and int(r.max().item()) < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))

@@ -1,0 +1,139 @@
+"""Optimizer + LR scheduler tests (reference analog: unittests/test_adam_op.py etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.param import Parameter
+from paddle_tpu.optimizer import SGD, Adam, AdamW, Lamb, Momentum, RMSProp
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def quad_problem(opt_cls, steps=50, **kw):
+    paddle.seed(0)
+    p = Parameter(np.array([5.0, -3.0], np.float32))
+    opt = opt_cls(parameters=[p], **kw)
+    for _ in range(steps):
+        loss = (p * p).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.abs(p.numpy()).max()
+
+
+def test_sgd_converges():
+    assert quad_problem(SGD, learning_rate=0.1) < 0.1
+
+
+def test_momentum_converges():
+    assert quad_problem(Momentum, steps=150, learning_rate=0.02, momentum=0.9) < 0.2
+
+
+def test_adam_converges():
+    assert quad_problem(Adam, steps=200, learning_rate=0.1) < 0.05
+
+
+def test_adamw_decay():
+    p = Parameter(np.array([1.0], np.float32))
+    opt = AdamW(learning_rate=0.0, parameters=[p], weight_decay=0.1)
+    # zero lr => only decay term, which is scaled by lr => no change
+    (p * 1.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [1.0])
+
+
+def test_adam_matches_torch():
+    torch = pytest.importorskip("torch")
+    w0 = np.random.randn(4).astype(np.float32)
+    g = np.random.randn(4).astype(np.float32)
+
+    p = Parameter(w0.copy())
+    opt = Adam(learning_rate=0.01, parameters=[p])
+    for _ in range(3):
+        (p * paddle.to_tensor(g)).sum().backward()
+        opt.step()
+        opt.clear_grad()
+
+    tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = torch.optim.Adam([tp], lr=0.01, eps=1e-8)
+    for _ in range(3):
+        topt.zero_grad()
+        (tp * torch.tensor(g)).sum().backward()
+        topt.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), atol=1e-6)
+
+
+def test_lamb_runs():
+    assert quad_problem(Lamb, steps=100, learning_rate=0.05) < 5.0
+
+
+def test_rmsprop_converges():
+    assert quad_problem(RMSProp, steps=100, learning_rate=0.05) < 0.5
+
+
+def test_grad_clip_in_optimizer():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    p = Parameter(np.array([1.0], np.float32))
+    opt = SGD(learning_rate=1.0, parameters=[p],
+              grad_clip=ClipGradByGlobalNorm(0.5))
+    (p * 100.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.5], rtol=1e-5)
+
+
+def test_state_dict_roundtrip():
+    p = Parameter(np.ones(3, np.float32))
+    opt = Adam(learning_rate=0.1, parameters=[p])
+    (p * 2.0).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    p2 = Parameter(np.ones(3, np.float32))
+    opt2 = Adam(learning_rate=0.1, parameters=[p2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    np.testing.assert_allclose(
+        np.asarray(opt2._slots[id(p2)]["moment1"]),
+        np.asarray(opt._slots[id(p)]["moment1"]))
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(round(s.get_lr(), 6))
+            s.step()
+        assert lrs == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    def test_warmup(self):
+        s = lr_mod.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        s.step(5)
+        assert abs(s.get_lr() - 0.05) < 1e-6
+        s.step(20)
+        assert abs(s.get_lr() - 0.1) < 1e-6
+
+    def test_cosine(self):
+        s = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+        s.step(10)
+        assert s.get_lr() < 1e-6
+
+    def test_noam(self):
+        s = lr_mod.NoamDecay(d_model=512, warmup_steps=100, learning_rate=1.0)
+        vals = []
+        for i in range(200):
+            s.step(i)
+            vals.append(s.get_lr())
+        assert np.argmax(vals) in range(95, 105)
+
+    def test_optimizer_integration(self):
+        p = Parameter(np.ones(1, np.float32))
+        sched = lr_mod.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = SGD(learning_rate=sched, parameters=[p])
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step()
+        assert abs(opt.get_lr() - 0.01) < 1e-9
+
+    def test_reduce_on_plateau(self):
+        s = lr_mod.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        for m in [1.0, 1.0, 1.0, 1.0]:
+            s.step(m)
+        assert s.get_lr() < 0.1

@@ -1,0 +1,99 @@
+"""Regression tests for review findings."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.param import Parameter
+from paddle_tpu.framework.tensor import Tensor
+
+
+def test_cross_entropy_default_ignore_index():
+    # -100-padded labels must be masked with the DEFAULT ignore_index
+    logits = np.random.randn(4, 7).astype(np.float32)
+    labels = np.array([1, -100, 3, -100])
+    loss = F.cross_entropy(Tensor(logits), Tensor(labels))
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    ref = -(lp[0, 1] + lp[2, 3]) / 2  # mean over the 2 valid tokens only
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+
+def test_nll_loss_ignore_index():
+    logp = np.log(np.full((3, 4), 0.25, np.float32))
+    labels = np.array([0, -100, 2])
+    loss = F.nll_loss(Tensor(logp), Tensor(labels))
+    np.testing.assert_allclose(loss.numpy(), -np.log(0.25), rtol=1e-6)
+
+
+def test_grad_scaler_no_double_unscale():
+    p = Parameter(np.ones(2, np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    loss = (p * 3.0).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)      # user unscales manually (e.g. to clip)
+    g_before = p.grad.numpy().copy()
+    scaler.step(opt)          # must NOT unscale a second time
+    np.testing.assert_allclose(g_before, [3.0, 3.0], rtol=1e-6)
+    np.testing.assert_allclose(p.numpy(), [1.0 - 3.0] * 2, rtol=1e-6)
+
+
+def test_backward_preserves_other_graphs():
+    x = Parameter(np.array([2.0], np.float32))
+    l1 = (x * 3.0).sum()
+    l2 = (x * 4.0).sum()
+    l1.backward()
+    l2.backward()  # second graph must still be intact
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_tape_id_reuse_safe():
+    # discarded outputs (dead tensors) must never swallow cotangents
+    import gc
+    x = Parameter(np.ones(4, np.float32))
+    for _ in range(50):
+        tmp = x * 2.0  # dropped immediately; id may be reused
+        del tmp
+        gc.collect()
+    loss = (x * 5.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0] * 4)
+
+
+def test_adamw_decay_exclusion():
+    p_w = Parameter(np.ones(2, np.float32))
+    p_w.name = "linear.weight"
+    p_b = Parameter(np.ones(2, np.float32))
+    p_b.name = "norm.bias"
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.1, parameters=[p_w, p_b], weight_decay=0.5,
+        apply_decay_param_fun=lambda n: "bias" not in n and "norm" not in n)
+    # zero grads -> pure decay effect
+    p_w.grad = Tensor(np.zeros(2, np.float32))
+    p_b.grad = Tensor(np.zeros(2, np.float32))
+    opt.step()
+    assert p_w.numpy()[0] < 1.0          # decayed
+    np.testing.assert_allclose(p_b.numpy(), [1.0, 1.0])  # excluded
+
+
+def test_dataloader_abandoned_iterator_no_leak():
+    import gc
+    import threading
+    from paddle_tpu.io import DataLoader, TensorDataset
+    X = Tensor(np.random.randn(64, 4).astype(np.float32))
+    dl = DataLoader(TensorDataset([X]), batch_size=4)
+    before = threading.active_count()
+    for _ in range(5):
+        it = iter(dl)
+        next(it)
+        del it  # abandon mid-epoch
+        gc.collect()
+    import time
+    time.sleep(0.5)
+    after = threading.active_count()
+    assert after <= before + 1, f"leaked threads: {before} -> {after}"
+
+
+def test_split_indivisible_raises():
+    import pytest
+    with pytest.raises(ValueError):
+        paddle.split(paddle.ones([2, 5]), 3, axis=1)

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Headline benchmark: GPT-2 small (124M) LM training throughput, single chip.
+
+Flagship config from BASELINE.json ("GPT-3 ... Fleet hybrid parallel" family,
+scaled to one chip). Whole train step (fwd+bwd+Adam) is ONE XLA executable
+(`paddle_tpu.jit.TrainStep`) — the TPU answer to the reference's
+InterpreterCore hot loop (`/root/reference/paddle/fluid/framework/new_executor/`).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The reference publishes no in-repo numbers (BASELINE.json `published: {}`),
+so vs_baseline is null; absolute tokens/sec/chip is the tracked metric.
+"""
+import json
+import time
+
+BATCH = 8
+SEQ = 1024
+WARMUP = 3
+ITERS = 10
+
+
+def main():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(0)
+    cfg = GPTConfig.gpt2_small()
+    cfg.max_position_embeddings = SEQ
+    cfg.dropout = 0.0
+    cfg.attn_dropout = 0.0
+    model = GPT(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                          weight_decay=0.01)
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits, labels)
+
+    step = TrainStep(model, loss_fn, opt)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (BATCH, SEQ)).astype("int32"))
+    labels = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (BATCH, SEQ)).astype("int32"))
+
+    for _ in range(WARMUP):
+        loss = step(ids, labels)
+    float(loss)  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = step(ids, labels)
+    final_loss = float(loss)  # device sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = BATCH * SEQ * ITERS / dt
+    samples_per_s = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "gpt2-small-124M train tokens/sec/chip (b8 x s1024, fp32, fused step)",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "samples_per_sec_chip": round(samples_per_s, 3),
+        "step_time_ms": round(1000 * dt / ITERS, 2),
+        "final_loss": round(final_loss, 4),
+        "note": "reference publishes no in-repo baseline (BASELINE.json published:{})",
+    }))
+
+
+if __name__ == "__main__":
+    main()

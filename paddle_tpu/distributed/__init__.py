@@ -1,0 +1,52 @@
+"""paddle_tpu.distributed — the distributed stack.
+
+Reference surface: `python/paddle/distributed/` (collective API, parallel
+env, fleet facade, launch CLI, hybrid parallelism). TPU translation notes in
+each submodule; the unifying idea is ONE `jax.sharding.Mesh` whose named
+axes (dp/pp/sharding/sp/mp) replace the reference's NCCL ring-per-group
+world (`fleet/base/topology.py:117`).
+"""
+from __future__ import annotations
+
+from .env import ParallelEnv  # noqa: F401
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    alltoall_single, barrier, broadcast, destroy_process_group, get_group,
+    is_initialized, new_group, ppermute, recv, reduce, reduce_scatter,
+    scatter, send, split, stream_synchronize, wait,
+)
+from .parallel import (  # noqa: F401
+    DataParallel, get_rank, get_world_size, init_parallel_env, is_available,
+    replicate, shard_batch,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, build_mesh,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
+)
+from . import meta_parallel  # noqa: F401
+from . import fleet  # noqa: F401
+
+# bind paddle.DataParallel lazily (top-level package avoids import cycle)
+import paddle_tpu as _paddle
+
+_paddle.DataParallel = DataParallel
+
+
+def get_backend() -> str:
+    return "xla"  # ICI/DCN collectives via XLA, not nccl/gloo
+
+
+QUEUE_DTYPE = None  # reserved
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference `paddle.distributed.spawn` (spawn.py:394). On TPU a single
+    controller already drives every local chip, so spawn runs `func` once in
+    this process (nprocs>1 process spawning is the multi-host launcher's
+    job — `python -m paddle_tpu.distributed.launch`)."""
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+    raise NotImplementedError(
+        "per-device process spawning does not apply to single-controller "
+        "TPU; use paddle_tpu.distributed.launch for multi-host")

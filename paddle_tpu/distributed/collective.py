@@ -1,0 +1,420 @@
+"""Collective communication API.
+
+Reference: `python/paddle/distributed/collective.py` (all_reduce/all_gather/
+broadcast/reduce/scatter/alltoall/send/recv over `ProcessGroup`,
+`/root/reference/paddle/fluid/distributed/collective/ProcessGroup.h:53`) and
+the static-graph `c_*` ops (`/root/reference/paddle/fluid/operators/collective/`).
+
+TPU-native translation: a `Group` is a (Mesh, axis-names) view — no comm
+init, no ring_id, no NCCL uniqueId exchange. Each collective has two paths:
+
+* **SPMD path** (inside `shard_map`/`pjit` tracing): lowers to the XLA
+  collective over ICI — `lax.psum`, `lax.all_gather`, `lax.ppermute`,
+  `lax.all_to_all`. This is the hot path; it is what the parallel layers use.
+* **Eager path** (plain `Tensor` outside a trace): wraps the op in a
+  one-shot `shard_map` over the group's mesh so per-device shards behave
+  like per-rank buffers. A replicated input is treated as every "rank"
+  holding the same value (so all_reduce multiplies by group size — identical
+  to N real ranks all holding x).
+
+Multi-host: `jax.distributed.initialize` (done by `init_parallel_env`) makes
+the same mesh span hosts; nothing here changes — the mesh is the cluster.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..framework.tensor import Tensor
+
+
+class ReduceOp:
+    """Reduction kinds (reference `distributed/collective.py` ReduceOp)."""
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _reduce_fn(op):
+    return {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+            ReduceOp.MIN: lax.pmin}.get(op)
+
+
+class Group:
+    """A communication group = a named-axis view of a Mesh."""
+
+    _next_id = 0
+
+    def __init__(self, mesh: Mesh, axis_names: Tuple[str, ...],
+                 ranks: Optional[List[int]] = None, name: str = ""):
+        self.mesh = mesh
+        self.axis_names = tuple(axis_names)
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        self.nranks = int(np.prod([sizes[a] for a in self.axis_names]))
+        self.ranks = ranks if ranks is not None else list(range(self.nranks))
+        self.name = name or "_".join(self.axis_names)
+        self.id = Group._next_id
+        Group._next_id += 1
+
+    @property
+    def axis(self) -> Union[str, Tuple[str, ...]]:
+        return self.axis_names[0] if len(self.axis_names) == 1 \
+            else self.axis_names
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        return 0  # per-device rank is lax.axis_index(self.axis) in-trace
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, axes={self.axis_names}, "
+                f"nranks={self.nranks})")
+
+
+_default_group: Optional[Group] = None
+_groups_by_id = {}
+
+
+def _world_mesh() -> Mesh:
+    from .topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.mesh
+    devs = np.array(jax.devices())
+    return Mesh(devs, ("world",))
+
+
+def set_default_group(group: Group):
+    global _default_group
+    _default_group = group
+    _groups_by_id[group.id] = group
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        mesh = _world_mesh()
+        _default_group = Group(mesh, tuple(mesh.axis_names), name="default")
+        _groups_by_id[_default_group.id] = _default_group
+    return _default_group
+
+
+def _resolve(group) -> Group:
+    if group is None:
+        return _get_default_group()
+    if isinstance(group, Group):
+        return group
+    if isinstance(group, int):
+        return _groups_by_id[group]
+    raise TypeError(f"not a group: {group!r}")
+
+
+def get_group(gid: int = 0) -> Group:
+    return _groups_by_id.get(gid, _get_default_group())
+
+
+def new_group(ranks=None, backend=None, timeout=None,
+              axis_name: Optional[str] = None) -> Group:
+    """Create a group. TPU semantics: a group over a mesh axis. `ranks` is
+    accepted for API parity; when given without `axis_name` the group spans
+    the whole default mesh (single-controller has no per-rank comm setup)."""
+    mesh = _world_mesh()
+    if axis_name is not None:
+        g = Group(mesh, (axis_name,))
+    else:
+        g = Group(mesh, tuple(mesh.axis_names), ranks=ranks)
+    _groups_by_id[g.id] = g
+    return g
+
+
+def is_initialized() -> bool:
+    return _default_group is not None
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _default_group = None
+        _groups_by_id.clear()
+
+
+# ---------------------------------------------------------------------------
+# tracer detection + eager shard_map wrapper
+# ---------------------------------------------------------------------------
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap(t):
+    return t.data if isinstance(t, Tensor) else t
+
+
+def _spec_of(arr, mesh) -> P:
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh.shape == mesh.shape:
+        return sh.spec
+    return P()
+
+
+def _eager(group: Group, fn, *arrs, out_specs=None):
+    """Run `fn` (which uses lax collectives over group.axis) via shard_map."""
+    in_specs = tuple(_spec_of(a, group.mesh) for a in arrs)
+    if out_specs is None:
+        out_specs = in_specs[0]
+    return shard_map(fn, mesh=group.mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)(*arrs)
+
+
+def _wrap_like(t, arr):
+    if isinstance(t, Tensor):
+        t.data = arr
+        return t
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    """In-place all-reduce (reference `collective.py` all_reduce /
+    `c_allreduce_sum_op`). Returns the tensor (task.wait() is a no-op: XLA
+    async collectives are scheduled by the compiler)."""
+    g = _resolve(group)
+    x = _unwrap(tensor)
+    red = _reduce_fn(op)
+
+    def f(a):
+        if red is not None:
+            return red(a, g.axis)
+        if op == ReduceOp.AVG:
+            return lax.pmean(a, g.axis)
+        # PROD via exp/sum-of-logs is lossy; use all_gather+prod
+        ga = lax.all_gather(a, g.axis, axis=0)
+        return jnp.prod(ga, axis=0)
+
+    out = f(x) if _is_tracer(x) else _eager(g, f, x)
+    return _wrap_like(tensor, out)
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
+    """reference: all_gather(tensor_list, tensor). Also usable
+    functionally: `out = all_gather(None, x)` returns the stacked array."""
+    if tensor is None and not isinstance(tensor_list, list):
+        tensor_list, tensor = None, tensor_list
+    g = _resolve(group)
+    x = _unwrap(tensor)
+
+    def f(a):
+        return lax.all_gather(a, g.axis, axis=0)
+
+    if _is_tracer(x):
+        out = f(x)
+    else:
+        # gathered result is identical on every device -> replicated output
+        out = _eager(g, f, x, out_specs=P())
+    if isinstance(tensor_list, list):
+        for i in range(g.nranks):
+            tensor_list.append(Tensor(out[i]) if isinstance(tensor, Tensor)
+                               else out[i])
+        return tensor_list
+    res = out if axis == 0 else None
+    if axis != 0:
+        res = jnp.concatenate([out[i] for i in range(out.shape[0])], axis=axis) \
+            if not _is_tracer(x) else jnp.concatenate(
+                jnp.split(out, g.nranks, axis=0), axis=axis + 1)[0]
+    return Tensor(res) if isinstance(tensor, Tensor) else res
+
+
+def all_gather_object(object_list, obj, group=None):
+    # single-controller: every "rank" holds the same python object
+    g = _resolve(group)
+    object_list.extend([obj] * g.nranks)
+    return object_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Broadcast from group-rank `src` (reference `c_broadcast_op`)."""
+    g = _resolve(group)
+    x = _unwrap(tensor)
+
+    def f(a):
+        ga = lax.all_gather(a, g.axis, axis=0)
+        return ga[src]
+
+    out = f(x) if _is_tracer(x) else _eager(g, f, x)
+    return _wrap_like(tensor, out)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """On TPU SPMD every device computes the reduction (same cost over ICI);
+    non-dst ranks keep the reduced value too (superset of reference
+    semantics — documented divergence)."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _resolve(group)
+    if tensor_list is not None:
+        stacked = jnp.stack([_unwrap(t) for t in tensor_list], axis=0)
+
+        def f(_):
+            i = lax.axis_index(g.axis)
+            return lax.dynamic_index_in_dim(stacked, i, axis=0,
+                                            keepdims=False)
+
+        x = _unwrap(tensor)
+        out = f(x) if _is_tracer(x) else _eager(g, f, x)
+        return _wrap_like(tensor, out)
+    raise ValueError("scatter requires tensor_list on TPU SPMD")
+
+
+def reduce_scatter(tensor, tensor_or_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """reference `c_reducescatter_op`: reduce then shard along dim 0."""
+    g = _resolve(group)
+    if isinstance(tensor_or_list, (list, tuple)):
+        x = jnp.concatenate([_unwrap(t) for t in tensor_or_list], axis=0)
+    else:
+        x = _unwrap(tensor_or_list)
+
+    def f(a):
+        return lax.psum_scatter(a, g.axis, scatter_dimension=0, tiled=True)
+
+    if _is_tracer(x):
+        out = f(x)
+    else:
+        spec = _spec_of(x, g.mesh)
+
+        def f_eager(a):
+            # drop the rank axis so each device's shard is its rank tensor
+            if len(spec) > 0 and spec[0] is not None and a.shape[0] == 1:
+                a = a[0]
+            return f(a)
+
+        out = _eager(g, f_eager, x, out_specs=P(g.axis))
+    return _wrap_like(tensor, out)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """reference `alltoall_op` (MoE global_scatter/gather ancestor)."""
+    g = _resolve(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack([_unwrap(t) for t in in_tensor_list], axis=0)
+    else:
+        x = _unwrap(in_tensor_list)  # leading dim == nranks
+
+    def f(a):
+        # a: [nranks, ...] local; exchange chunk i -> rank i
+        return lax.all_to_all(a, g.axis, split_axis=0, concat_axis=0,
+                              tiled=False)
+
+    if _is_tracer(x):
+        out = f(x)
+    else:
+        spec = _spec_of(x, g.mesh)
+        out = _eager(g, f, x, out_specs=spec)
+    if isinstance(out_tensor_list, list):
+        for i in range(g.nranks):
+            out_tensor_list.append(Tensor(out[i]))
+        return out_tensor_list
+    return Tensor(out) if isinstance(in_tensor_list, Tensor) else out
+
+
+alltoall_single = alltoall
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv do not exist on TPU SPMD; use "
+        "paddle_tpu.distributed.p2p.ppermute (pipeline engine) — XLA "
+        "collective-permute replaces NCCL send/recv "
+        "(reference operators/collective/partial_send_op.cc)")
+
+
+recv = send
+isend = send
+irecv = send
+
+
+def ppermute(x, group=None, perm=None):
+    """collective_permute: the TPU replacement for PP send/recv pairs."""
+    g = _resolve(group)
+    if perm is None:  # ring shift by +1
+        n = g.nranks
+        perm = [(i, (i + 1) % n) for i in range(n)]
+    arr = _unwrap(x)
+
+    def f(a):
+        return lax.ppermute(a, g.axis, perm)
+
+    out = f(arr) if _is_tracer(arr) else _eager(g, f, arr)
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def barrier(group=None):
+    """Device barrier: a tiny psum forces a sync point."""
+    g = _resolve(group)
+    x = jnp.zeros((), jnp.float32)
+    _eager(g, lambda a: lax.psum(a, g.axis), x).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    x = _unwrap(tensor)
+    if not _is_tracer(x):
+        x.block_until_ready()
+    return tensor
+
+
+def stream_synchronize():
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+# in-trace rank/size helpers (SPMD analogue of get_rank inside layers)
+def axis_rank(group=None):
+    g = _resolve(group)
+    return lax.axis_index(g.axis)
+
+
+def get_world_size_in_group(group=None) -> int:
+    return _resolve(group).nranks
+
+
+# ---------------------------------------------------------------------------
+# paddle.distributed.split — sharded linear/embedding helper
+# (reference collective.py:1436)
+# ---------------------------------------------------------------------------
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    from .meta_parallel import parallel_layers as _pl
+    if operation == "linear":
+        layer_cls = _pl.ColumnParallelLinear if axis == 1 \
+            else _pl.RowParallelLinear
+        layer = layer_cls(size[0], size[1], weight_attr=weight_attr,
+                          has_bias=bias_attr is not False,
+                          gather_output=gather_out,
+                          input_is_parallel=False)
+        return layer(x)
+    if operation == "embedding":
+        layer = _pl.VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation!r}")

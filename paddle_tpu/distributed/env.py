@@ -1,0 +1,78 @@
+"""Process/cluster environment contract.
+
+Mirrors the reference's trainer env-var contract set by
+`paddle.distributed.launch` (`/root/reference/python/paddle/distributed/launch/`
+and consumed by `ParallelEnv`,
+`/root/reference/python/paddle/fluid/dygraph/parallel.py:96`):
+``PADDLE_TRAINER_ID``, ``PADDLE_TRAINERS_NUM``, ``PADDLE_TRAINER_ENDPOINTS``,
+``PADDLE_CURRENT_ENDPOINT``, ``PADDLE_DISTRI_BACKEND``.
+
+On TPU one *process* drives many chips (single-controller JAX), so the
+"trainer" here is a host process of a multi-host job: rank ==
+``jax.process_index()`` once `jax.distributed` is live. Devices inside the
+process are addressed by the mesh, not by rank.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+import jax
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class ParallelEnv:
+    """Cluster env view (reference `fluid/dygraph/parallel.py:96`)."""
+
+    def __init__(self):
+        self._rank = _env_int("PADDLE_TRAINER_ID", 0)
+        self._world_size = _env_int("PADDLE_TRAINERS_NUM", 1)
+        self._device_id = _env_int("FLAGS_selected_tpus",
+                                   _env_int("FLAGS_selected_gpus", 0))
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints: List[str] = eps.split(",") if eps else []
+        self._nrings = _env_int("FLAGS_nccl_nrings", 1)
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    @property
+    def device_id(self) -> int:
+        return self._device_id
+
+    @property
+    def device_type(self) -> str:
+        return jax.default_backend()
+
+    @property
+    def current_endpoint(self) -> str:
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self) -> List[str]:
+        return self._trainer_endpoints
+
+    @property
+    def nrings(self) -> int:
+        return self._nrings
+
+    # legacy aliases (reference keeps both spellings)
+    local_rank = rank
+    nranks = world_size
+    dev_id = device_id
+
+
+def get_cluster_env() -> ParallelEnv:
+    return ParallelEnv()

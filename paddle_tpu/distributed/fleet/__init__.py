@@ -1,0 +1,138 @@
+"""fleet — the unified distributed-training facade.
+
+Reference: `paddle.distributed.fleet`
+(`/root/reference/python/paddle/distributed/fleet/base/fleet_base.py:139`):
+`fleet.init(is_collective=..., strategy=...)`, `distributed_model`,
+`distributed_optimizer`, role makers, meta-optimizer auto-selection.
+
+TPU translation: `init` builds the mesh (`HybridCommunicateGroup`) from
+`strategy.hybrid_configs` and initializes `jax.distributed` for multi-host;
+`distributed_model`/`distributed_optimizer` mark the model/optimizer and the
+actual engine is `HybridParallelTrainStep` (meta_parallel/engine.py), which
+replaces the whole meta-optimizer program-rewrite pipeline with sharded jit.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ...nn.layer import Layer
+from ..env import ParallelEnv
+from ..parallel import DataParallel, init_parallel_env
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,
+                        get_hybrid_communicate_group,
+                        set_hybrid_communicate_group)
+from .distributed_strategy import DistributedStrategy
+from ..meta_parallel.engine import HybridParallelTrainStep  # noqa: F401
+
+__all__ = [
+    "DistributedStrategy", "init", "distributed_model",
+    "distributed_optimizer", "get_hybrid_communicate_group",
+    "HybridParallelTrainStep", "UserDefinedRoleMaker", "PaddleCloudRoleMaker",
+]
+
+
+class _FleetState:
+    def __init__(self):
+        self.initialized = False
+        self.strategy: Optional[DistributedStrategy] = None
+        self.is_collective = True
+        self.env: Optional[ParallelEnv] = None
+
+
+_state = _FleetState()
+
+
+class PaddleCloudRoleMaker:
+    """Env-var role maker (reference `fleet/base/role_maker.py`)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._env = ParallelEnv()
+
+    def worker_index(self):
+        return self._env.rank
+
+    def worker_num(self):
+        return self._env.world_size
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self._env.rank == 0
+
+
+UserDefinedRoleMaker = PaddleCloudRoleMaker
+
+
+def init(role_maker=None, is_collective=True,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """fleet.init (reference fleet_base.py:206)."""
+    _state.strategy = strategy or DistributedStrategy()
+    _state.is_collective = is_collective
+    _state.env = init_parallel_env()
+    dims = _state.strategy.mesh_dims()
+    if get_hybrid_communicate_group() is None or any(
+            v > 1 for v in dims.values()):
+        hcg = HybridCommunicateGroup(dims=dims)
+        set_hybrid_communicate_group(hcg)
+    _state.initialized = True
+    return None
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def worker_index() -> int:
+    return jax.process_index()
+
+
+def worker_num() -> int:
+    return jax.process_count()
+
+
+def barrier_worker():
+    from .. import collective
+    collective.barrier()
+
+
+def distributed_model(model: Layer):
+    """Wrap per topology (reference fleet_base.py:932): pure-DP gets
+    DataParallel; mp/pp/sharding models are driven by
+    HybridParallelTrainStep (annotations already on the parallel layers)."""
+    assert _state.initialized, "call fleet.init first"
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return model
+    if hcg.get_parallel_mode() == "data_parallel" and \
+            hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference fleet_base.py:875 — on TPU the optimizer needs no wrapping
+    (grad sync is the partitioner's job); kept for API parity."""
+    if strategy is not None:
+        _state.strategy = strategy
+    optimizer._hybrid_strategy = _state.strategy
+    return optimizer
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _state.strategy
+
+
+def get_hybrid_parallel_train_step(model, loss_fn, optimizer, **kw):
+    return HybridParallelTrainStep(model, loss_fn, optimizer,
+                                   strategy=_state.strategy, **kw)
+
+
+# sub-namespace parity: fleet.meta_parallel.*
+from .. import meta_parallel  # noqa: E402,F401

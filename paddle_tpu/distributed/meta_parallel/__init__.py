@@ -1,0 +1,8 @@
+"""Hybrid-parallel building blocks (reference
+`python/paddle/distributed/fleet/meta_parallel/`)."""
+from .parallel_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RNGStatesTracker,
+    RowParallelLinear, VocabParallelEmbedding, get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+from . import parallel_layers  # noqa: F401

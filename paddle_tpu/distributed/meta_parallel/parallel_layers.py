@@ -1,0 +1,225 @@
+"""Tensor (model) parallel layers + parallel RNG.
+
+Reference: `VocabParallelEmbedding` / `ColumnParallelLinear` /
+`RowParallelLinear` / `ParallelCrossEntropy`
+(`/root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/mp_layers.py:30,97,170,249`) and `RNGStatesTracker`
+(`parallel_layers/random.py:32`).
+
+TPU-native translation (Megatron math, GSPMD mechanics): each layer holds the
+FULL logical weight annotated with a `dist_spec` PartitionSpec; eager forward
+is the plain math (bitwise-identical to single device), and under `jit` the
+hybrid engine feeds `dist_spec` to `in_shardings` while the layer pins
+activation layouts with `with_sharding_constraint`. XLA then emits exactly
+the reference's collectives: column f/row g identity-allreduce pairs
+(`mp_layers.py:82,154`) become partitioner-inserted all-reduces over the
+`mp` ICI axis. No per-rank weight slices, no manual `c_identity` ops — and
+the same layer runs unchanged at mp=1.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+from ...nn.layer import Layer
+from ...nn import functional as F
+from ...nn.initializer import XavierUniform
+from ..topology import get_hybrid_communicate_group
+
+
+def _mp_degree() -> int:
+    hcg = get_hybrid_communicate_group()
+    return hcg.axis_size("mp") if hcg is not None else 1
+
+
+def _constrain(x, *spec):
+    """Pin a sharding on an activation inside a trace (no-op at mp=1 or in
+    plain eager mode)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None or hcg.axis_size("mp") <= 1:
+        return x
+    arr = x.data if isinstance(x, Tensor) else x
+    if not isinstance(arr, jax.core.Tracer):
+        return x
+    sh = NamedSharding(hcg.mesh, P(*spec))
+    try:
+        out = jax.lax.with_sharding_constraint(arr, sh)
+    except Exception:
+        return x  # inside shard_map or meshless trace: constraint not valid
+    if isinstance(x, Tensor):
+        t = Tensor(out, stop_gradient=x.stop_gradient)
+        t._node = x._node
+        return t
+    return out
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.dist_spec = P()
+    return param
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over `mp`
+    (reference mp_layers.py:30; lookup + allreduce via `c_embedding`,
+    `operators/collective/c_embedding_op.cc`)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=None if weight_attr is not None
+            else XavierUniform())
+        self.weight.dist_spec = P("mp", None)
+        self.weight.is_distributed = _mp_degree() > 1
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constrain(out, None, None, None)  # replicated (allreduced)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out_features split over `mp`; forward is the Megatron
+    "f" block (identity fwd / allreduce bwd) (reference mp_layers.py:97)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=None if weight_attr is not None
+            else XavierUniform())
+        self.weight.dist_spec = P(None, "mp")
+        self.weight.is_distributed = _mp_degree() > 1
+        if has_bias:
+            self.bias = self.create_parameter((out_features,),
+                                              attr=None, is_bias=True)
+            self.bias.dist_spec = P("mp")
+        else:
+            self.bias = None
+            self._parameters["bias"] = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constrain(y, *((None,) * len(y.shape)))
+        return _constrain(y, *((None,) * (len(y.shape) - 1) + ("mp",)))
+
+
+class RowParallelLinear(Layer):
+    """Linear with in_features split over `mp`; forward ends in the Megatron
+    "g" block allreduce (reference mp_layers.py:170)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=None if weight_attr is not None
+            else XavierUniform())
+        self.weight.dist_spec = P("mp", None)
+        self.weight.is_distributed = _mp_degree() > 1
+        if has_bias:
+            self.bias = self.create_parameter((out_features,),
+                                              attr=None, is_bias=True)
+            self.bias.dist_spec = P()
+        else:
+            self.bias = None
+            self._parameters["bias"] = None
+
+    def forward(self, x):
+        if self.input_is_parallel or _mp_degree() > 1:
+            x = _constrain(x, *((None,) * (len(x.shape) - 1) + ("mp",)))
+        y = F.linear(x, self.weight, self.bias)
+        return _constrain(y, *((None,) * len(y.shape)))
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross-entropy over vocab-sharded logits (reference mp_layers.py:249 →
+    `c_softmax_with_cross_entropy_op`). GSPMD partitions the log-softmax
+    reduction over `mp` (max/sum become all-reduces) when logits carry an
+    `mp` sharding on the class dim."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        x = _constrain(input, *((None,) * (len(input.shape) - 1) + ("mp",)))
+        return F.cross_entropy(x, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+# ---------------------------------------------------------------------------
+# parallel RNG (reference parallel_layers/random.py:32)
+# ---------------------------------------------------------------------------
+class RNGStatesTracker:
+    """Named RNG streams. The reference snapshots per-mp-rank CUDA states so
+    dropout differs across mp ranks on sharded activations; in
+    single-controller JAX a dropout mask on a logical (sharded) array is
+    already computed per-shard by construction, so streams here are jax
+    PRNG-key folds — kept for API parity and for recompute replay."""
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_.clear()
+        self.seeds_.clear()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    def rng_state(self, name="model-parallel-rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            if name not in self.states_:
+                raise ValueError(f"state {name} not added")
+            from ...framework import random as random_mod
+            key = self.states_[name]
+            key, sub = jax.random.split(key)
+            self.states_[name] = key
+            with random_mod.rng_scope(sub):
+                yield
+        return cm()
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    seed = seed if seed is not None else pyrandom.randint(0, 2**31 - 1)
+    _RNG_STATE_TRACKER.reset()
+    from ...framework import random as random_mod
+    random_mod.seed(seed)
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, seed + 1)

@@ -1,0 +1,259 @@
+"""Hybrid-parallel topology — the device mesh and its named axes.
+
+Reference: `CommunicateTopology` / `HybridCommunicateGroup`
+(`/root/reference/python/paddle/distributed/fleet/base/topology.py:36,117`),
+which carves the world into cartesian axes [data, pipe, sharding, model] and
+creates a NCCL ring per axis slice. TPU-native translation: ONE
+`jax.sharding.Mesh` whose named axes are the parallelism axes; "creating a
+group" costs nothing (a `Group` is a mesh-axis view) and collectives become
+XLA ops over ICI (`lax.psum(..., 'mp')` etc.) instead of `c_allreduce` with a
+`ring_id`.
+
+Axis canon (superset of the reference's four; `sep`/seq is our long-context
+addition, SURVEY.md §5.7):
+
+    dp        data parallel            (batch axis)
+    pp        pipeline parallel        (stage axis)
+    sharding  ZeRO parameter/optimizer sharding
+    sp        sequence/context parallel (ring attention)
+    mp        tensor/model parallel    (innermost => fastest ICI)
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXIS_CANON = ("dp", "pp", "sharding", "sp", "mp")
+
+# reference axis-name spellings -> ours
+_AXIS_ALIASES = {"data": "dp", "pipe": "pp", "model": "mp", "sep": "sp",
+                 "sequence": "sp", "tensor": "mp", "expert": "ep"}
+
+
+def canon_axis(name: str) -> str:
+    return _AXIS_ALIASES.get(name, name)
+
+
+class CommunicateTopology:
+    """Cartesian rank topology (reference `topology.py:36`)."""
+
+    def __init__(self,
+                 hybrid_group_names: Sequence[str] = ("data", "pipe",
+                                                      "sharding", "model"),
+                 dims: Sequence[int] = (1, 1, 1, 1)):
+        assert len(hybrid_group_names) == len(dims)
+        self._parallel_names = [canon_axis(n) for n in hybrid_group_names]
+        self._dims = list(int(d) for d in dims)
+        self._world_size = int(np.prod(self._dims))
+        ranks = np.arange(self._world_size).reshape(self._dims)
+        self._rank_grid = ranks
+        self._coord_of = {}
+        for coord in np.ndindex(*self._dims):
+            self._coord_of[int(ranks[coord])] = tuple(int(c) for c in coord)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(canon_axis(axis_name))]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **coords) -> int:
+        idx = [coords[n] for n in self._parallel_names]
+        return int(self._rank_grid[tuple(idx)])
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return self._coord_of[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on `axis_name` equals `index`."""
+        ax = self._parallel_names.index(canon_axis(axis_name))
+        return sorted(int(r) for r, c in self._coord_of.items()
+                      if c[ax] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Rank groups that communicate along `axis_name` (reference
+        `topology.py:87`): one list per combination of the other axes."""
+        ax = self._parallel_names.index(canon_axis(axis_name))
+        groups = collections.defaultdict(list)
+        for r in range(self._world_size):
+            c = self._coord_of[r]
+            key = c[:ax] + c[ax + 1:]
+            groups[key].append(r)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = dict(zip(self._parallel_names, self.get_coord(global_rank)))
+        coord.update({canon_axis(k): v for k, v in kwargs.items()})
+        return self.get_rank(**coord)
+
+
+def build_mesh(dims: Dict[str, int],
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Build the global Mesh from {axis: size}. Axes ordered per AXIS_CANON
+    (outermost=dp ... innermost=mp so mp collectives ride nearest-neighbor
+    ICI), extra axes appended in given order."""
+    dims = {canon_axis(k): v for k, v in dims.items() if v is not None}
+    names = [a for a in AXIS_CANON if dims.get(a, 1) > 1 or a in dims]
+    names += [a for a in dims if a not in names]
+    if not names:
+        names = ["dp"]
+    sizes = [max(1, int(dims.get(a, 1))) for a in names]
+    if devices is None:
+        devices = jax.devices()
+    need = int(np.prod(sizes))
+    # the dp axis absorbs the remaining devices (created if absent)
+    if need < len(devices) and len(devices) % need == 0:
+        if "dp" in names:
+            sizes[names.index("dp")] *= len(devices) // need
+        else:
+            names.insert(0, "dp")
+            sizes.insert(0, len(devices) // need)
+        need = len(devices)
+    assert need <= len(devices), (
+        f"mesh {dict(zip(names, sizes))} needs {need} devices, "
+        f"have {len(devices)}")
+    dev_array = np.array(devices[:need]).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+class HybridCommunicateGroup:
+    """Per-axis group views over one Mesh (reference `topology.py:117`).
+
+    Unlike the reference there is no comm setup here — groups are cheap
+    (mesh, axis) descriptors; `paddle_tpu.distributed.collective.Group`
+    objects are created lazily.
+    """
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 mesh: Optional[Mesh] = None,
+                 dims: Optional[Dict[str, int]] = None):
+        if mesh is None:
+            if topology is not None:
+                dims = dict(zip(topology.get_hybrid_group_names(),
+                                topology._dims))
+            assert dims is not None, "need topology, mesh or dims"
+            mesh = build_mesh(dims)
+        self._mesh = mesh
+        ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+        self._dp_degree = ax.get("dp", 1)
+        self._pp_degree = ax.get("pp", 1)
+        self._sharding_degree = ax.get("sharding", 1)
+        self._sp_degree = ax.get("sp", 1)
+        self._mp_degree = ax.get("mp", 1)
+        self._topo = topology or CommunicateTopology(
+            list(mesh.axis_names), list(mesh.devices.shape))
+        self._groups = {}
+
+    # -- mesh ----------------------------------------------------------------
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def axis_size(self, name: str) -> int:
+        name = canon_axis(name)
+        ax = dict(zip(self._mesh.axis_names, self._mesh.devices.shape))
+        return ax.get(name, 1)
+
+    def _axis_group(self, name: str):
+        name = canon_axis(name)
+        if name not in self._groups:
+            from .collective import Group
+            self._groups[name] = Group(mesh=self._mesh, axis_names=(name,))
+        return self._groups[name]
+
+    # -- reference API parity ------------------------------------------------
+    def get_parallel_mode(self) -> str:
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding_parallel"
+        if self._mp_degree > 1:
+            return "model_parallel"
+        return "data_parallel"
+
+    def get_global_rank(self) -> int:
+        return jax.process_index()
+
+    # data parallel
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_data_parallel_rank(self) -> int:
+        return 0  # single-controller: per-device rank is lax.axis_index('dp')
+
+    def get_data_parallel_group(self):
+        return self._axis_group("dp")
+
+    # model (tensor) parallel
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_model_parallel_rank(self) -> int:
+        return 0
+
+    def get_model_parallel_group(self):
+        return self._axis_group("mp")
+
+    # pipeline
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_stage_id(self) -> int:
+        return 0
+
+    def get_pipe_parallel_group(self):
+        return self._axis_group("pp")
+
+    # sharding
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sharding_parallel_rank(self) -> int:
+        return 0
+
+    def get_sharding_parallel_group(self):
+        return self._axis_group("sharding")
+
+    # sequence/context
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sp_degree
+
+    def get_sep_parallel_group(self):
+        return self._axis_group("sp")
+
+    def get_check_parallel_group(self):
+        from .collective import Group
+        return Group(mesh=self._mesh, axis_names=tuple(self._mesh.axis_names))
+
+    def topology_description(self) -> str:
+        return (f"HybridCommunicateGroup(dp={self._dp_degree}, "
+                f"pp={self._pp_degree}, sharding={self._sharding_degree}, "
+                f"sp={self._sp_degree}, mp={self._mp_degree})")
+
+    __repr__ = topology_description
+
+
+_HCG: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _HCG
+    _HCG = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _HCG

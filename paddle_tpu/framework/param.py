@@ -8,7 +8,8 @@ from .tensor import Tensor
 
 
 class Parameter(Tensor):
-    __slots__ = ("trainable", "regularizer", "need_clip", "optimize_attr", "is_distributed")
+    __slots__ = ("trainable", "regularizer", "need_clip", "optimize_attr",
+                 "is_distributed", "dist_spec")
 
     def __init__(self, data, dtype=None, name=None, trainable: bool = True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
@@ -17,6 +18,7 @@ class Parameter(Tensor):
         self.need_clip = True
         self.optimize_attr = {"learning_rate": 1.0}
         self.is_distributed = False
+        self.dist_spec = None  # PartitionSpec for the hybrid-parallel engine
         self.persistable = True
 
     @property

@@ -1,0 +1,210 @@
+"""Hybrid-parallel engine: TP/ZeRO/AMP/grad-merge on the 8-device CPU mesh.
+
+Reference test style: hybrid dygraph suites
+(`/root/reference/python/paddle/fluid/tests/unittests/
+test_parallel_dygraph_tensor_parallel.py`) assert parallel losses equal
+single-device losses — same here, with the mesh standing in for ranks.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.meta_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy)
+from paddle_tpu.distributed.meta_parallel.engine import HybridParallelTrainStep
+from paddle_tpu.distributed.topology import (HybridCommunicateGroup,
+                                             build_mesh)
+
+
+@pytest.fixture(autouse=True)
+def _clean_topology():
+    yield
+    dist.set_hybrid_communicate_group(None)
+    dist.destroy_process_group()
+
+
+class MLP(nn.Layer):
+    """Megatron block: column-parallel then row-parallel."""
+
+    def __init__(self, d=16, hidden=32, nclass=8):
+        super().__init__()
+        self.fc1 = ColumnParallelLinear(d, hidden, gather_output=False)
+        self.fc2 = RowParallelLinear(hidden, nclass, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _make_data(n=16, d=16, nclass=8):
+    rs = np.random.RandomState(0)
+    X = rs.randn(n, d).astype(np.float32)
+    Y = rs.randint(0, nclass, (n,)).astype(np.int32)
+    return X, Y
+
+
+def _run_steps(step, X, Y, n=4):
+    losses = []
+    for _ in range(n):
+        losses.append(float(step(paddle.to_tensor(X), paddle.to_tensor(Y))))
+    return losses
+
+
+def _reference_losses(seed, X, Y, n=4, lr=0.1):
+    paddle.seed(seed)
+    net = MLP()
+    opt = optimizer.SGD(learning_rate=lr, parameters=net.parameters())
+    losses = []
+    for _ in range(n):
+        loss = F.cross_entropy(net(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def _engine_losses(seed, X, Y, dims, strategy=None, n=4, lr=0.1):
+    fleet.init(is_collective=True, strategy=strategy or DistributedStrategy())
+    dist.set_hybrid_communicate_group(HybridCommunicateGroup(dims=dims))
+    paddle.seed(seed)
+    net = MLP()
+    opt = optimizer.SGD(learning_rate=lr, parameters=net.parameters())
+    step = HybridParallelTrainStep(
+        net, lambda lg, lb: F.cross_entropy(lg, lb), opt,
+        strategy=strategy)
+    return _run_steps(step, X, Y, n), step
+
+
+class TestTensorParallel:
+    def test_tp_matches_single_device(self):
+        X, Y = _make_data()
+        ref = _reference_losses(3, X, Y)
+        got, step = _engine_losses(3, X, Y, {"dp": 2, "mp": 4})
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+        # weights really are sharded over mp
+        w1 = step.params["fc1.weight"]
+        assert "mp" in str(w1.sharding.spec)
+
+    def test_tp_param_sync_back(self):
+        X, Y = _make_data()
+        _, step = _engine_losses(5, X, Y, {"mp": 8})
+        step.sync_to_layer()
+        w = dict(step.layer.named_parameters())["fc1.weight"]
+        np.testing.assert_allclose(np.asarray(step.params["fc1.weight"]),
+                                   w.numpy())
+
+    def test_vocab_parallel_embedding_and_ce(self):
+        mesh = build_mesh({"mp": 8})
+        dist.set_hybrid_communicate_group(
+            HybridCommunicateGroup(mesh=mesh))
+        paddle.seed(11)
+        emb = VocabParallelEmbedding(64, 16)
+        pce = ParallelCrossEntropy()
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 64, (4, 8)).astype("int32"))
+        out = emb(ids)
+        assert out.shape == [4, 8, 16]
+        # parity with plain embedding math
+        ref = emb.weight.numpy()[ids.numpy()]
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+        logits = paddle.to_tensor(
+            np.random.RandomState(3).randn(4, 64).astype("float32"))
+        labels = paddle.to_tensor(
+            np.random.RandomState(4).randint(0, 64, (4,)).astype("int32"))
+        got = pce(logits, labels)
+        ref_loss = F.cross_entropy(logits, labels, reduction="none")
+        np.testing.assert_allclose(got.numpy(), ref_loss.numpy(), rtol=1e-6)
+
+
+class TestZeRO:
+    @pytest.mark.parametrize("stage", [1, 2, 3])
+    def test_sharding_stage_matches_single_device(self, stage):
+        X, Y = _make_data()
+        ref = _reference_losses(7, X, Y)
+        strategy = DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": stage, "degree": 4}
+        got, step = _engine_losses(7, X, Y, {"dp": 2, "sharding": 4},
+                                   strategy=strategy)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+        if stage >= 3:
+            w = step.params["fc1.weight"]
+            assert "sharding" in str(w.sharding.spec)
+
+    def test_zero1_slots_sharded(self):
+        X, Y = _make_data()
+        fleet.init()
+        dist.set_hybrid_communicate_group(
+            HybridCommunicateGroup(dims={"sharding": 8}))
+        paddle.seed(1)
+        net = MLP()
+        opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=net.parameters())
+        step = HybridParallelTrainStep(
+            net, lambda lg, lb: F.cross_entropy(lg, lb), opt)
+        step(paddle.to_tensor(X), paddle.to_tensor(Y))
+        m = step.opt_state["fc1.weight"]["moment1"] \
+            if "moment1" in step.opt_state["fc1.weight"] \
+            else list(step.opt_state["fc1.weight"].values())[0]
+        assert "sharding" in str(m.sharding.spec)
+
+
+class TestAMPAndGradMerge:
+    def test_amp_bf16_compute(self):
+        X, Y = _make_data()
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        got, step = _engine_losses(9, X, Y, {"dp": 8}, strategy=strategy)
+        # master params stay fp32
+        assert step.params["fc1.weight"].dtype == jnp.float32
+        # bf16 training converges same direction
+        assert got[-1] < got[0]
+
+    def test_gradient_merge_matches_full_batch_sgd(self):
+        X, Y = _make_data(n=16)
+        ref = _reference_losses(13, X, Y, n=3)
+        strategy = DistributedStrategy()
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 4}
+        got, _ = _engine_losses(13, X, Y, {"dp": 2}, strategy=strategy, n=3)
+        # mean-of-micro-losses == full-batch loss; SGD update identical
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+
+class TestFleetFacade:
+    def test_fleet_init_and_wrappers(self):
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                   "pp_degree": 1, "sharding_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_model_parallel_world_size() == 2
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_sharding_parallel_world_size() == 2
+        net = MLP()
+        model = fleet.distributed_model(net)
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(learning_rate=0.1, parameters=net.parameters()))
+        assert opt is not None and model is not None
+        assert fleet.worker_index() == 0 and fleet.worker_num() == 1
+
+    def test_strategy_roundtrip(self):
+        s = DistributedStrategy()
+        s.amp = True
+        s.sharding = True
+        s.sharding_configs = {"stage": 2, "degree": 4}
+        s.hybrid_configs = {"mp_degree": 4}
+        s2 = DistributedStrategy.from_json(s.to_json())
+        assert s2.amp and s2.sharding
+        assert s2.sharding_configs["stage"] == 2
+        assert s2.hybrid_configs["mp_degree"] == 4

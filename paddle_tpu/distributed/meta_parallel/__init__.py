@@ -6,3 +6,9 @@ from .parallel_layers import (  # noqa: F401
     model_parallel_random_seed,
 )
 from . import parallel_layers  # noqa: F401
+from .pp_layers import (  # noqa: F401
+    LayerDesc, PipelineLayer, SharedLayerDesc,
+)
+from .pipeline_parallel import (  # noqa: F401
+    PipelineParallel, PipelineParallelTrainStep,
+)

@@ -41,6 +41,58 @@ def _axis_sizes(mesh: Mesh) -> Dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
 
 
+def _parse_strategy(strategy, sizes):
+    """(amp_enabled, amp_dtype, recompute, sharding_stage, accum_steps)."""
+    amp_enabled = bool(strategy and strategy.amp)
+    amp_dtype = jnp.bfloat16 if not strategy else (
+        jnp.float16 if strategy.amp_configs.get("dtype") == "float16"
+        else jnp.bfloat16)
+    recompute = bool(strategy and strategy.recompute)
+    sharding_stage = 0
+    if strategy and strategy.sharding:
+        sharding_stage = int(strategy.sharding_configs.get("stage", 1))
+    if sizes.get("sharding", 1) > 1 and sharding_stage == 0:
+        sharding_stage = 1
+    accum = 1
+    if strategy is not None:
+        if strategy.gradient_merge:
+            accum = int(strategy.gradient_merge_configs.get("k_steps", 1))
+        elif strategy.pipeline:
+            accum = int(strategy.pipeline_configs.get("accumulate_steps", 1))
+    return amp_enabled, amp_dtype, recompute, sharding_stage, max(1, accum)
+
+
+def _filter_spec(base: P, ndim: int, sizes) -> P:
+    """Pad `base` to ndim and drop axes absent from / trivial on the mesh."""
+    return P(*[a if (a in sizes and sizes[a] > 1) else None
+               for a in (tuple(base) + (None,) * (ndim - len(base)))])
+
+
+def _slot_shardings(optimizer, flat_params, specs, sizes, sharding_stage,
+                    mesh):
+    """Per-slot NamedShardings: param-shaped slots inherit the param spec
+    (+ ZeRO `sharding` axis for stage>=1), scalars replicate."""
+    opt_shape = jax.eval_shape(optimizer.init_state_tree, flat_params)
+    out = {}
+    for k, slots in opt_shape.items():
+        base = specs[k]
+        per = {}
+        for sname, sval in slots.items():
+            if tuple(sval.shape) == tuple(flat_params[k].shape):
+                s = base
+                if sharding_stage >= 1:
+                    s = _with_sharding_axis(s, "sharding", sval.shape, sizes)
+                per[sname] = NamedSharding(mesh, s)
+            else:
+                per[sname] = NamedSharding(mesh, P())
+        out[k] = per
+    return out
+
+
+def _data_axes_of(sizes):
+    return tuple(a for a in ("dp", "sharding") if sizes.get(a, 1) > 1) or None
+
+
 def _with_sharding_axis(spec: P, axis: str, shape, sizes) -> P:
     """Insert `axis` into the first unsharded, divisible dim of `spec`."""
     n = sizes.get(axis, 1)
@@ -77,24 +129,9 @@ class HybridParallelTrainStep:
         self.strategy = strategy
         self._t = 0
 
-        amp_enabled = bool(strategy and strategy.amp)
-        amp_dtype = jnp.bfloat16 if not strategy else (
-            jnp.float16 if strategy.amp_configs.get("dtype") == "float16"
-            else jnp.bfloat16)
-        recompute = bool(strategy and strategy.recompute)
-        sharding_stage = 0
-        if strategy and strategy.sharding:
-            sharding_stage = int(strategy.sharding_configs.get("stage", 1))
-        if sizes.get("sharding", 1) > 1 and sharding_stage == 0:
-            sharding_stage = 1
-        accum = 1
-        if strategy is not None:
-            if strategy.gradient_merge:
-                accum = int(strategy.gradient_merge_configs.get("k_steps", 1))
-            elif strategy.pipeline:
-                accum = int(strategy.pipeline_configs.get(
-                    "accumulate_steps", 1))
-        self.accumulate_steps = max(1, accum)
+        (amp_enabled, amp_dtype, recompute, sharding_stage,
+         accum) = _parse_strategy(strategy, sizes)
+        self.accumulate_steps = accum
 
         apply_fn, params, buffers = functionalize(layer)
         if recompute:
@@ -105,9 +142,9 @@ class HybridParallelTrainStep:
         named = dict(layer.named_parameters())
         pspecs: Dict[str, P] = {}
         for k, arr in params.items():
-            base = getattr(named.get(k), "dist_spec", None) or P()
-            base = P(*[a if (a in sizes and sizes[a] > 1) else None
-                       for a in (tuple(base) + (None,) * (arr.ndim - len(base)))])
+            base = _filter_spec(
+                getattr(named.get(k), "dist_spec", None) or P(),
+                arr.ndim, sizes)
             if sharding_stage >= 3:
                 base = _with_sharding_axis(base, "sharding", arr.shape, sizes)
             pspecs[k] = base
@@ -115,22 +152,8 @@ class HybridParallelTrainStep:
                                 for k, s in pspecs.items()}
 
         # ---- optimizer slot specs (ZeRO stages 1/2) -----------------------
-        opt_state = jax.eval_shape(optimizer.init_state_tree, params)
-        ospecs = {}
-        for k, slots in opt_state.items():
-            base = pspecs[k]
-            per = {}
-            for sname, sval in slots.items():
-                if tuple(sval.shape) == tuple(params[k].shape):
-                    s = base
-                    if sharding_stage >= 1:
-                        s = _with_sharding_axis(s, "sharding",
-                                                sval.shape, sizes)
-                    per[sname] = NamedSharding(mesh, s)
-                else:
-                    per[sname] = NamedSharding(mesh, P())
-            ospecs[k] = per
-        self.opt_shardings = ospecs
+        self.opt_shardings = _slot_shardings(
+            optimizer, params, pspecs, sizes, sharding_stage, mesh)
 
         # ---- place initial state ------------------------------------------
         self.params = {k: jax.device_put(v, self.param_shardings[k])
@@ -142,8 +165,7 @@ class HybridParallelTrainStep:
             out_shardings=self.opt_shardings)(self.params)
 
         # ---- batch specs ---------------------------------------------------
-        data_axes = tuple(a for a in ("dp", "sharding")
-                          if sizes.get(a, 1) > 1) or None
+        data_axes = _data_axes_of(sizes)
         sp_on = sizes.get("sp", 1) > 1
         self._default_batch_spec = lambda ndim: P(
             *((data_axes,) + (("sp",) if (sp_on and ndim >= 2) else ())

@@ -1,0 +1,191 @@
+"""Pipeline model description — LayerDesc / SharedLayerDesc / PipelineLayer.
+
+Reference: `fleet/meta_parallel/parallel_layers/pp_layers.py:31,49,132`
+(`/root/reference/python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py`)
+where `PipelineLayer` cuts a flat `LayerDesc` list into per-rank stages and
+`PipelineParallel` moves activations with NCCL p2p. TPU translation: the cut
+is a *sharding*, not a process split — `PipelineLayer` here builds the whole
+model in every process (SPMD), `PipelineParallelTrainStep` stacks the
+homogeneous middle run of layers into one leading `num_layers` dim sharded
+over the `pp` mesh axis, and the 1F1B schedule becomes a rotation of a
+pp-sharded stage buffer (see pipeline_parallel.py).
+
+Eager `forward` runs the layers sequentially, so a PipelineLayer is also a
+correct single-device model (debug parity with reference dygraph).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ...nn.layer import Layer
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py:31)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"LayerDesc needs a Layer subclass, got {layer_cls}")
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer (reference pp_layers.py:49 — e.g. input/output
+    embeddings). All descs with the same `key` share ONE layer instance; in
+    the single-program SPMD pipeline tying is free (same array, grads sum
+    through the jaxpr) — no `allreduce_shared_weight_gradients` step needed.
+    `forward_func(layer, x)` customizes the reuse call (e.g. logits =
+    x @ embedding.weight.T for the output head)."""
+
+    def __init__(self, key, layer_cls, forward_func: Optional[Callable] = None,
+                 shared_weight_attr: str = "weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedCall(Layer):
+    """Wrapper calling a shared instance without re-registering its params
+    (held via object.__setattr__ so named_parameters sees them once, on the
+    PipelineLayer-owned original)."""
+
+    def __init__(self, shared: Layer, forward_func: Optional[Callable]):
+        super().__init__()
+        object.__setattr__(self, "_shared_ref", shared)
+        object.__setattr__(self, "_forward_func", forward_func)
+
+    def forward(self, *args, **kw):
+        if self._forward_func is not None:
+            return self._forward_func(self._shared_ref, *args, **kw)
+        return self._shared_ref(*args, **kw)
+
+
+def _param_signature(layer: Layer):
+    """Structural signature: sorted (name, shape, dtype) of the sub-tree."""
+    return tuple(sorted((k, tuple(p.shape), str(p.dtype))
+                        for k, p in layer.named_parameters()))
+
+
+class PipelineLayer(Layer):
+    """Flat layer list + stage segmentation (reference pp_layers.py:132).
+
+    Args mirror the reference: `layers` is a list of Layer / LayerDesc /
+    SharedLayerDesc / plain callables; `num_stages` or `topology` gives the
+    pp degree; `seg_method` "uniform" or "layer:ClassName" (cut before each
+    instance of ClassName).
+    """
+
+    def __init__(self, layers: Sequence[Any], num_stages: Optional[int] = None,
+                 topology=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, loss_fn=None, **kw):
+        super().__init__()
+        self._num_stages = num_stages or (
+            topology.get_dim("pipe") if topology is not None else 1)
+        self.seg_method = seg_method
+        self.recompute_interval = recompute_interval
+        self._loss_fn = loss_fn
+        self._shared: Dict[str, Layer] = {}
+        built: List[Layer] = []
+        for d in layers:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    shared = d.build_layer()
+                    self._shared[d.layer_name] = shared
+                    # register owned instance so its params are tracked once
+                    setattr(self, f"shared_{d.layer_name}", shared)
+                built.append(_SharedCall(self._shared[d.layer_name],
+                                         d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FnLayer(d))
+            else:
+                raise TypeError(f"bad pipeline item {d!r}")
+        from ...nn.layers_common import LayerList
+        self.run_function = LayerList(built)
+
+    # -- eager path ---------------------------------------------------------
+    def forward(self, x):
+        for i, lyr in enumerate(self.run_function):
+            x = lyr(x)
+        return x
+
+    # -- segmentation -------------------------------------------------------
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def segment(self) -> List[int]:
+        """Return stage boundary indices [b0..bS] over the layer list."""
+        n = len(self.run_function)
+        S = self._num_stages
+        if self.seg_method.startswith("layer:"):
+            cls_name = self.seg_method.split(":", 1)[1]
+            cuts = [i for i, l in enumerate(self.run_function)
+                    if type(l).__name__ == cls_name]
+            # uniform split of the cut layers across stages; leading
+            # non-cut layers join stage 0, trailing join the last stage
+            assert len(cuts) >= S, \
+                f"{len(cuts)} x {cls_name} layers < {S} stages"
+            per = len(cuts) // S
+            bounds = [0]
+            for s in range(1, S):
+                bounds.append(cuts[s * per])
+            bounds.append(n)
+            return bounds
+        # uniform
+        per, rem = divmod(n, S)
+        bounds = [0]
+        for s in range(S):
+            bounds.append(bounds[-1] + per + (1 if s < rem else 0))
+        return bounds
+
+    def get_stage_of(self, layer_idx: int) -> int:
+        b = self.segment()
+        for s in range(self._num_stages):
+            if b[s] <= layer_idx < b[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    # -- homogeneous-run detection for the SPMD stacked pipeline ------------
+    def scan_region(self):
+        """Longest run of structurally identical consecutive layers.
+
+        Returns (start, stop): layers[start:stop] all share one param-tree
+        signature, `stop-start` divisible by num_stages. Layers before the
+        run form the replicated pre-part, after it the post-part."""
+        layers = list(self.run_function)
+        sigs = [_param_signature(l) for l in layers]
+        best = (0, 0)
+        i = 0
+        while i < len(sigs):
+            j = i + 1
+            while j < len(sigs) and sigs[j] == sigs[i] and sigs[i]:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = max(j, i + 1)
+        start, stop = best
+        n = stop - start
+        n -= n % self._num_stages  # trailing layers join the post part
+        return start, start + n
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        object.__setattr__(self, "_fn", fn)
+
+    def forward(self, *a, **k):
+        return self._fn(*a, **k)

@@ -116,20 +116,26 @@ class GPT(nn.Layer):
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids):
+    # pipeline protocol (distributed.meta_parallel.pipeline_parallel):
+    # pre -> scanned homogeneous blocks -> post
+    def pipeline_pre(self, input_ids):
         B, L = input_ids.shape
         pos = arange(0, L, dtype="int32")
         x = self.wte(input_ids) + self.wpe(pos)
-        x = self.drop(x)
-        for blk in self.blocks:
-            x = blk(x)
+        return self.drop(x)
+
+    def pipeline_post(self, x):
         x = self.ln_f(x)
         if self.cfg.tie_word_embeddings:
             from ..ops import matmul
-            logits = matmul(x, self.wte.weight, transpose_y=True)
-        else:
-            logits = self.lm_head(x)
-        return logits
+            return matmul(x, self.wte.weight, transpose_y=True)
+        return self.lm_head(x)
+
+    def forward(self, input_ids):
+        x = self.pipeline_pre(input_ids)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.pipeline_post(x)
 
     def loss(self, input_ids, labels):
         logits = self(input_ids)

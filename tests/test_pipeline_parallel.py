@@ -1,0 +1,184 @@
+"""Pipeline parallelism on the 8-device CPU mesh.
+
+Reference test style: `test_parallel_dygraph_pipeline_parallel.py` asserts
+the pipelined model's losses track the plain model. Here the pp axis is a
+mesh dim and the 1F1B schedule is a compiled rotation
+(meta_parallel/pipeline_parallel.py), so the comparison is exact-math
+(same ops, fp32) up to reduction-order tolerance.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.meta_parallel import (
+    LayerDesc, PipelineLayer, PipelineParallel, PipelineParallelTrainStep,
+    SharedLayerDesc)
+from paddle_tpu.distributed.topology import HybridCommunicateGroup
+from paddle_tpu.models.gpt import GPT, GPTConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_topology():
+    yield
+    dist.set_hybrid_communicate_group(None)
+    dist.destroy_process_group()
+
+
+def _setup(dims, strategy=None):
+    fleet.init(is_collective=True, strategy=strategy or DistributedStrategy())
+    hcg = HybridCommunicateGroup(dims=dims)
+    dist.set_hybrid_communicate_group(hcg)
+    return hcg
+
+
+def _gpt_batch(cfg, B=8, L=32, seed=0):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, cfg.vocab_size, (B, L)).astype(np.int32)
+    labels = rs.randint(0, cfg.vocab_size, (B, L)).astype(np.int32)
+    return ids, labels
+
+
+def _single_device_losses(model_fn, batches, lr=1e-2, steps=3):
+    """Ground truth: plain TrainStep on one device."""
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    model = model_fn()
+    opt = optimizer.Adam(learning_rate=lr, parameters=model.parameters())
+    step = TrainStep(model, F.cross_entropy, opt, donate=False)
+    return [float(step(paddle.to_tensor(a), paddle.to_tensor(b)))
+            for a, b in batches]
+
+
+class TestPipelineGPT:
+    def test_pp_matches_single_device(self):
+        cfg = GPTConfig.tiny()  # 2 blocks -> 2 stages
+        batches = [_gpt_batch(cfg, B=16, seed=s) for s in range(3)]
+        ref = _single_device_losses(lambda: GPT(cfg), batches)
+
+        hcg = _setup({"pp": 2, "dp": 4})
+        paddle.seed(0)
+        model = GPT(cfg)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        step = PipelineParallelTrainStep(
+            model, F.cross_entropy, opt, hcg=hcg, num_micro=4, donate=False)
+        got = [float(step(paddle.to_tensor(a), paddle.to_tensor(b)))
+               for a, b in batches]
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_pp_with_tp(self):
+        cfg = GPTConfig.tiny()
+        batches = [_gpt_batch(cfg, seed=s) for s in range(2)]
+        ref = _single_device_losses(lambda: GPT(cfg), batches)
+
+        from jax.sharding import PartitionSpec as P
+        hcg = _setup({"pp": 2, "mp": 2, "dp": 2})
+        paddle.seed(0)
+        model = GPT(cfg)
+        for name, p in model.named_parameters():
+            if name.endswith(("qkv.weight", "fc1.weight")):
+                p.dist_spec = P(None, "mp")
+            elif name.endswith(("qkv.bias", "fc1.bias")):
+                p.dist_spec = P("mp")
+            elif name.endswith(("proj.weight", "fc2.weight")):
+                p.dist_spec = P("mp", None)
+            elif name.endswith("wte.weight"):
+                p.dist_spec = P("mp", None)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        step = PipelineParallelTrainStep(
+            model, F.cross_entropy, opt, hcg=hcg, num_micro=2, donate=False)
+        # block params really sharded over pp (stage dim) and mp
+        qkv = step.params["blocks"]["attn.qkv.weight"]
+        assert "pp" in str(qkv.sharding.spec)
+        assert "mp" in str(qkv.sharding.spec)
+        got = [float(step(paddle.to_tensor(a), paddle.to_tensor(b)))
+               for a, b in batches]
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_sync_to_layer_roundtrip(self):
+        cfg = GPTConfig.tiny()
+        hcg = _setup({"pp": 2})
+        paddle.seed(0)
+        model = GPT(cfg)
+        before = {k: np.asarray(p.data).copy()
+                  for k, p in model.named_parameters()}
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        step = PipelineParallelTrainStep(
+            model, F.cross_entropy, opt, hcg=hcg, num_micro=2, donate=False)
+        a, b = _gpt_batch(cfg)
+        step(paddle.to_tensor(a), paddle.to_tensor(b))
+        step.sync_to_layer()
+        changed = sum(
+            not np.allclose(before[k], np.asarray(p.data))
+            for k, p in model.named_parameters())
+        assert changed >= len(before) - 1  # everything trained moved
+
+
+class TestPipelineLayerAPI:
+    def test_segmentation(self):
+        descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(9)]
+        pl = PipelineLayer(layers=descs, num_stages=4)
+        assert pl.segment() == [0, 3, 5, 7, 9]
+        assert pl.get_stage_of(0) == 0 and pl.get_stage_of(8) == 3
+
+    def test_seg_method_layer(self):
+        layers = [LayerDesc(nn.Embedding, 16, 8)]
+        layers += [LayerDesc(nn.Linear, 8, 8) for _ in range(4)]
+        pl = PipelineLayer(layers=layers, num_stages=2,
+                           seg_method="layer:Linear")
+        b = pl.segment()
+        assert b[0] == 0 and b[-1] == 5 and len(b) == 3
+
+    def test_scan_region_detects_homogeneous_run(self):
+        layers = [LayerDesc(nn.Embedding, 16, 8)]
+        layers += [LayerDesc(nn.Linear, 8, 8) for _ in range(4)]
+        layers += [LayerDesc(nn.Linear, 8, 2)]
+        pl = PipelineLayer(layers=layers, num_stages=2)
+        start, stop = pl.scan_region()
+        assert (start, stop) == (1, 5)
+
+    def test_shared_layer_desc_ties_weights(self):
+        def head(layer, x):
+            from paddle_tpu.ops import matmul
+            return matmul(x, layer.weight, transpose_y=True)
+
+        layers = [
+            SharedLayerDesc("embed", nn.Embedding, None, "weight", 32, 8),
+            LayerDesc(nn.Linear, 8, 8),
+            SharedLayerDesc("embed", nn.Embedding, head, "weight", 32, 8),
+        ]
+        pl = PipelineLayer(layers=layers, num_stages=1)
+        names = [k for k, _ in pl.named_parameters()]
+        assert sum("embedding" in n.lower() or "embed" in n
+                   for n in names) == 1  # tied -> single registration
+        x = paddle.to_tensor(np.array([[1, 2, 3]], dtype=np.int32))
+        out = pl(x)
+        assert tuple(out.shape) == (1, 3, 32)
+
+    def test_pipeline_layer_e2e_train(self):
+        """PipelineLayer path through PipelineParallel.train_batch."""
+        hcg = _setup({"pp": 2})
+        paddle.seed(0)
+        layers = [LayerDesc(nn.Linear, 16, 16) for _ in range(4)]
+        pl = PipelineLayer(layers=layers, num_stages=2,
+                           loss_fn=lambda out, y: F.mse_loss(out, y))
+        model = PipelineParallel(pl, hcg=hcg)
+        opt = optimizer.SGD(learning_rate=0.05,
+                            parameters=pl.parameters())
+        rs = np.random.RandomState(0)
+        X = rs.randn(8, 16).astype(np.float32)
+        Y = rs.randn(8, 16).astype(np.float32)
+        losses = [float(model.train_batch(
+            [paddle.to_tensor(X), paddle.to_tensor(Y)], opt))
+            for _ in range(5)]
+        assert losses[-1] < losses[0]

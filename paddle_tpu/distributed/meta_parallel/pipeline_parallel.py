@@ -176,6 +176,10 @@ class PipelineParallelTrainStep:
             num_micro = max(num_micro, S)
         self.num_micro = M = num_micro
 
+        if isinstance(model, PipelineLayer) and model.num_stages != S:
+            raise ValueError(
+                f"PipelineLayer was built for {model.num_stages} stages but "
+                f"the mesh pp axis has {S}; make them agree")
         pre_fn, blocks, prefixes, post_fn = _gpt_like_parts(model)
         self.run = _BlockRun(model, blocks, prefixes, S)
 
@@ -412,6 +416,21 @@ class PipelineParallel(Layer):
         return self._layers(*args, **kw)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        if scaler is not None and getattr(scaler, "_enable", True):
+            # bf16 shares fp32's exponent range, so dynamic loss scaling is
+            # structurally unnecessary here; fp16 scaling is not implemented
+            # in the pipeline engine (use bf16 amp).
+            amp_dtype = (self._strategy.amp_configs.get("dtype", "bfloat16")
+                         if self._strategy else "bfloat16")
+            if amp_dtype == "float16":
+                raise NotImplementedError(
+                    "fp16 GradScaler is not supported in the pipeline "
+                    "engine; use bf16 amp (no loss scaling needed)")
+        if (self._train_step is not None
+                and self._train_step.optimizer is not optimizer):
+            raise ValueError(
+                "train_batch was compiled against a different optimizer; "
+                "build a new PipelineParallel to swap optimizers")
         if self._train_step is None:
             loss_fn = getattr(self._layers, "_loss_fn", None)
             if loss_fn is None:

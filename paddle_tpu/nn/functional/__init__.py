@@ -986,19 +986,60 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1):
 # ---------------------------------------------------------------------------
 # attention (used by nn.MultiHeadAttention and transformer models)
 # ---------------------------------------------------------------------------
+def _sp_ring_config(query, key, attn_mask):
+    """(mesh, axis) when sequence parallelism should route to ring
+    attention: an active HCG with sp>1, no arbitrary mask, self-attention
+    (q/k chunked identically), seq divisible by the axis."""
+    if attn_mask is not None:
+        return None
+    if key.shape[1] != query.shape[1]:
+        return None  # cross-attention: ring chunking assumes Lq == Lk
+    try:
+        from ...distributed.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+    except Exception:
+        return None
+    if hcg is None:
+        return None
+    sizes = dict(zip(hcg.mesh.axis_names, hcg.mesh.devices.shape))
+    sp = sizes.get("sp", 1)
+    if sp <= 1:
+        return None
+    L = query.shape[1]
+    if L % sp != 0:
+        return None
+    return hcg.mesh, "sp"
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
     """Batched attention; [B, L, H, D] layout (paddle convention).
 
-    Routes to the Pallas flash-attention kernel on TPU for long sequences;
-    falls back to the XLA composition otherwise.
+    Routing: ring attention over the `sp` mesh axis when sequence/context
+    parallelism is active (long-context path — no chip materializes full
+    K/V), else the Pallas flash kernel on TPU for long sequences, else the
+    XLA composition.
     """
+    sp_ring = _sp_ring_config(query, key, attn_mask)
+    if sp_ring is not None:
+        mesh, axis = sp_ring
+        from ...ops.pallas.ring_attention import ring_attention
+
+        @kernel("ring_attention")
+        def ring_impl(q, k, v, is_causal=is_causal, _mesh=mesh, _axis=axis):
+            return ring_attention(q, k, v, mesh=_mesh, axis_name=_axis,
+                                  causal=is_causal)
+        out = _d.call(ring_impl, (query, key, value), name="ring_attention")
+        if dropout_p > 0.0 and training:
+            out = dropout(out, p=dropout_p, training=training)
+        return out
+
     @kernel("sdpa")
     def impl(q, k, v, *m, is_causal=is_causal):
-        from ...ops.pallas.flash_attention import flash_attention_xla
+        from ...ops.pallas.flash_attention import flash_attention
         mask = m[0] if m else None
-        return flash_attention_xla(q, k, v, mask=mask, causal=is_causal)
+        return flash_attention(q, k, v, mask=mask, causal=is_causal)
     args = (query, key, value) if attn_mask is None else (query, key, value, attn_mask)
     out = _d.call(impl, args, name="sdpa")
     if dropout_p > 0.0 and training:

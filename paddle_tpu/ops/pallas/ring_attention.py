@@ -1,0 +1,184 @@
+"""Ring attention — sequence/context-parallel attention over the `sp` axis.
+
+Capability-parity-PLUS: the reference snapshot has NO sequence parallelism
+(SURVEY.md §5.7 — `grep ring_attention` over /root/reference finds nothing);
+its long-sequence story is recompute + an unflashed fused attention that
+materializes [B,H,L,L] scores (`operators/fused/fused_attention_op.cu`).
+Here sequences shard over the `sp` mesh axis and attention runs as a ring:
+
+* each chip holds a query chunk `[B, L/sp, H, D]` and one K/V chunk;
+* `sp` steps of (blockwise attention + online-softmax merge) while the K/V
+  chunk rotates to the ICI neighbor via `ppermute` — compute on chunk i
+  overlaps the transfer of chunk i+1, and no chip ever materializes the
+  full K/V, so max sequence length scales linearly with the axis size;
+* backward is a second ring pass (custom_vjp): dK/dV accumulate into the
+  traveling chunk and arrive home after `sp` rotations, so residuals are
+  only the local q/k/v/out/logsumexp — the flash-attention memory footprint.
+
+The local chunk-vs-chunk attention math accumulates in fp32, matching
+flash_attention.py; chunk-level causality masks by global positions derived
+from `axis_index`.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG = -1e30
+
+
+def _varying(x, axis_name):
+    """Mark a replicated init value as varying over the ring axis (shard_map
+    scan carries must have matching varying-manual-axes types)."""
+    try:
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    except AttributeError:  # older jax: no VMA tracking
+        return x
+
+
+def _chunk_attn(qf, kc, vc, m, l, acc, q_off, k_off, causal):
+    """One online-softmax accumulation of q-chunk vs k/v-chunk.
+
+    qf: [B,Lq,H,D] fp32 (pre-scaled); kc/vc: [B,Lk,H,D];
+    m,l: [B,H,Lq]; acc: [B,Lq,H,D]. Returns updated (m,l,acc)."""
+    s = jnp.einsum("blhd,bmhd->bhlm", qf, kc.astype(jnp.float32))
+    if causal:
+        rows = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        cols = k_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        allowed = rows >= cols
+        s = jnp.where(allowed, s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(allowed, p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = (acc * jnp.moveaxis(corr, 1, 2)[..., None]
+               + jnp.einsum("bhlm,bmhd->blhd", p, vc.astype(jnp.float32)))
+    return m_new, l_new, acc_new
+
+
+@functools.lru_cache(maxsize=None)
+def _local_ring_fn(axis_name: str, causal: bool, scale: float):
+    """Build the per-shard ring function (custom_vjp) for given statics."""
+
+    def fwd_impl(q, k, v):
+        B, Lq, H, D = q.shape
+        size = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        qf = q.astype(jnp.float32) * scale
+        q_off = idx * Lq
+        m0 = _varying(jnp.full((B, H, Lq), _NEG, jnp.float32), axis_name)
+        l0 = _varying(jnp.zeros((B, H, Lq), jnp.float32), axis_name)
+        acc0 = _varying(jnp.zeros((B, Lq, H, D), jnp.float32), axis_name)
+        perm = [(r, (r + 1) % size) for r in range(size)]
+
+        def body(carry, j):
+            m, l, acc, kc, vc = carry
+            src = (idx - j) % size  # origin rank of the chunk we hold now
+            m, l, acc = _chunk_attn(qf, kc, vc, m, l, acc,
+                                    q_off, src * Lq, causal)
+            kc = jax.lax.ppermute(kc, axis_name, perm)
+            vc = jax.lax.ppermute(vc, axis_name, perm)
+            return (m, l, acc, kc, vc), None
+
+        (m, l, acc, _, _), _ = jax.lax.scan(
+            body, (m0, l0, acc0, k, v), jnp.arange(size))
+        out = (acc / jnp.moveaxis(jnp.maximum(l, 1e-30), 1, 2)[..., None]
+               ).astype(q.dtype)
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))  # [B,H,Lq]
+        return out, lse
+
+    @jax.custom_vjp
+    def ring(q, k, v):
+        return fwd_impl(q, k, v)[0]
+
+    def ring_fwd(q, k, v):
+        out, lse = fwd_impl(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def ring_bwd(res, dout):
+        q, k, v, out, lse = res
+        B, Lq, H, D = q.shape
+        size = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        qf = q.astype(jnp.float32) * scale
+        doutf = dout.astype(jnp.float32)
+        # Drow = rowsum(dout * out): [B,H,Lq]
+        Drow = jnp.moveaxis(jnp.sum(doutf * out.astype(jnp.float32), -1), 2, 1)
+        q_off = idx * Lq
+        perm = [(r, (r + 1) % size) for r in range(size)]
+        dq0 = _varying(jnp.zeros((B, Lq, H, D), jnp.float32), axis_name)
+
+        def body(carry, j):
+            dq, kc, vc, dkc, dvc = carry
+            src = (idx - j) % size
+            s = jnp.einsum("blhd,bmhd->bhlm", qf, kc.astype(jnp.float32))
+            if causal:
+                rows = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+                cols = src * Lq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+                allowed = rows >= cols
+            p = jnp.exp(s - lse[..., None])
+            if causal:
+                p = jnp.where(allowed, p, 0.0)
+            dp = jnp.einsum("blhd,bmhd->bhlm", doutf, vc.astype(jnp.float32))
+            ds = p * (dp - Drow[..., None])  # [B,H,Lq,Lk]
+            dq = dq + jnp.einsum("bhlm,bmhd->blhd", ds,
+                                 kc.astype(jnp.float32)) * scale
+            dkc = dkc + jnp.einsum("bhlm,blhd->bmhd", ds, qf)
+            dvc = dvc + jnp.einsum("bhlm,blhd->bmhd", p, doutf)
+            kc = jax.lax.ppermute(kc, axis_name, perm)
+            vc = jax.lax.ppermute(vc, axis_name, perm)
+            dkc = jax.lax.ppermute(dkc, axis_name, perm)
+            dvc = jax.lax.ppermute(dvc, axis_name, perm)
+            return (dq, kc, vc, dkc, dvc), None
+
+        zero = _varying(jnp.zeros((B, Lq, H, D), jnp.float32), axis_name)
+        (dq, _, _, dk, dv), _ = jax.lax.scan(
+            body, (dq0, k, v, zero, zero), jnp.arange(size))
+        # after `size` rotations dk/dv are home; dk gradient wrt unscaled k
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
+
+
+def ring_attention_local(q, k, v, axis_name: str = "sp",
+                         causal: bool = False,
+                         scale: Optional[float] = None):
+    """Per-shard entry: call INSIDE shard_map/manual collectives context.
+
+    q/k/v: local chunks [B, L/sp, H, D] of a sequence sharded over
+    `axis_name`. Self-attention only: q and k/v must be chunked identically
+    (the causal chunk offsets assume Lq == Lk)."""
+    assert q.shape[1] == k.shape[1] == v.shape[1], (
+        f"ring attention is self-attention only (Lq={q.shape[1]} "
+        f"Lk={k.shape[1]}); use flash/dense attention for cross-attention")
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    return _local_ring_fn(axis_name, bool(causal), float(scale))(q, k, v)
+
+
+def ring_attention(q, k, v, mesh=None, axis_name: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Global entry: q/k/v [B, L, H, D] with L sharded over `axis_name`.
+
+    Wraps `ring_attention_local` in a shard_map manual only over
+    `axis_name`; batch/head dims stay under GSPMD (dp/mp still auto)."""
+    if mesh is None:
+        from ...distributed.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        assert hcg is not None, "need a mesh: fleet.init or pass mesh="
+        mesh = hcg.mesh
+    from jax.sharding import PartitionSpec as P
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={axis_name})
+    return fn(q, k, v)

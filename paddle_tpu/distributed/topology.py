@@ -150,6 +150,7 @@ class HybridCommunicateGroup:
         self._sharding_degree = ax.get("sharding", 1)
         self._sp_degree = ax.get("sp", 1)
         self._mp_degree = ax.get("mp", 1)
+        self._ep_degree = ax.get("ep", 1)
         self._topo = topology or CommunicateTopology(
             list(mesh.axis_names), list(mesh.devices.shape))
         self._groups = {}
@@ -234,6 +235,13 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self):
         return self._axis_group("sp")
+
+    # expert parallel (MoE)
+    def get_expert_parallel_world_size(self) -> int:
+        return self._ep_degree
+
+    def get_expert_parallel_group(self):
+        return self._axis_group("ep")
 
     def get_check_parallel_group(self):
         from .collective import Group

@@ -1,0 +1,2 @@
+"""paddle_tpu.incubate — experimental APIs (reference `python/paddle/incubate/`)."""
+from . import distributed  # noqa: F401

@@ -1,0 +1,8 @@
+"""Expert-parallel MoE (reference
+`python/paddle/incubate/distributed/models/moe/`)."""
+from .gate import (  # noqa: F401
+    BaseGate, GShardGate, NaiveGate, SwitchGate,
+    naive_topk_gate, top1_gate, top2_gate,
+)
+from .moe_layer import Expert, MoELayer  # noqa: F401
+from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa: F401

@@ -1,0 +1,171 @@
+"""MoE gates — Naive / GShard / Switch.
+
+Reference: `incubate/distributed/models/moe/gate/`
+(`/root/reference/python/paddle/incubate/distributed/models/moe/gate/
+{naive_gate.py,gshard_gate.py,switch_gate.py}`). Each gate turns token
+logits into capacity-limited (combine, dispatch) tensors plus a
+load-balancing auxiliary loss. Pure-array functions (differentiable via the
+enclosing kernel's jax.vjp), used by MoELayer; the Gate Layer classes own
+the router projection.
+
+Dense one-hot dispatch (GShard style) rather than the reference's
+index-based scatter: static shapes, MXU-friendly einsums, and XLA turns the
+`P('ep')`-constrained dispatch einsum into the all-to-all the reference
+issues explicitly via `global_scatter`/`global_gather`
+(`operators/collective/global_scatter_op.cc`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn.layers_common import Linear
+
+
+def _positions_in_expert(mask, offset=None):
+    """Running slot index per (token, expert): cumsum over tokens."""
+    pos = jnp.cumsum(mask, axis=0) - 1
+    if offset is not None:
+        pos = pos + offset
+    return pos * mask  # zero where not routed (masked later anyway)
+
+
+def _dispatch_combine(gates_and_masks, capacity):
+    """Build [N, E, C] combine/dispatch from per-choice (weight, mask, pos).
+
+    gates_and_masks: list of (g [N], mask [N,E], pos [N,E]) per top-k slot.
+    """
+    combine = 0.
+    for g, mask, pos in gates_and_masks:
+        keep = (pos < capacity) & (mask > 0)
+        oh = jax.nn.one_hot(pos, capacity, dtype=g.dtype)  # [N,E,C]
+        combine = combine + (g[:, None, None] * keep[..., None] * oh)
+    dispatch = (combine > 0).astype(combine.dtype)
+    return combine, dispatch
+
+
+def top2_gate(logits, capacity, normalize=True):
+    """GShard top-2 gating (reference gshard_gate.py).
+
+    Returns (combine [N,E,C], dispatch [N,E,C], aux scalar)."""
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    i1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(i1, E, dtype=probs.dtype)
+    g1 = jnp.sum(probs * mask1, axis=-1)
+    # second choice: re-softmax with first expert removed
+    probs2 = jax.nn.softmax(
+        jnp.where(mask1 > 0, -1e30, logits.astype(jnp.float32)), axis=-1)
+    i2 = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(i2, E, dtype=probs.dtype)
+    g2 = jnp.sum(probs * mask2, axis=-1)
+    # degenerate E=1: the "second choice" is the same expert; drop it so the
+    # single expert keeps full weight instead of being silently halved
+    valid2 = (i2 != i1).astype(probs.dtype)
+    g2 = g2 * valid2
+    mask2 = mask2 * valid2[:, None]
+    if normalize:
+        denom = jnp.maximum(g1 + g2, 1e-9)
+        g1, g2 = g1 / denom, g2 / denom
+    # load-balance aux (GShard eq.4): E * mean(importance * load) over experts
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux = E * jnp.sum(me * ce)
+    pos1 = _positions_in_expert(mask1)
+    # second choices queue behind all first choices in each expert
+    pos2 = _positions_in_expert(mask2, offset=jnp.sum(mask1, axis=0,
+                                                      keepdims=True))
+    combine, dispatch = _dispatch_combine(
+        [(g1, mask1, pos1.astype(jnp.int32)),
+         (g2, mask2, pos2.astype(jnp.int32))], capacity)
+    return combine, dispatch, aux
+
+
+def top1_gate(logits, capacity):
+    """Switch-Transformer top-1 gating (reference switch_gate.py)."""
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    i1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(i1, E, dtype=probs.dtype)
+    g1 = jnp.sum(probs * mask1, axis=-1)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux = E * jnp.sum(me * ce)
+    pos1 = _positions_in_expert(mask1)
+    combine, dispatch = _dispatch_combine(
+        [(g1, mask1, pos1.astype(jnp.int32))], capacity)
+    return combine, dispatch, aux
+
+
+def naive_topk_gate(logits, capacity, topk):
+    """NaiveGate (reference naive_gate.py): plain top-k softmax routing,
+    no aux loss; capacity still applies (static shapes)."""
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    remaining = logits.astype(jnp.float32)
+    choices = []
+    offset = jnp.zeros((1, E), probs.dtype)
+    for _ in range(topk):
+        i = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(i, E, dtype=probs.dtype)
+        g = jnp.sum(probs * mask, axis=-1)
+        pos = _positions_in_expert(mask, offset=offset)
+        choices.append((g, mask, pos.astype(jnp.int32)))
+        offset = offset + jnp.sum(mask, axis=0, keepdims=True)
+        remaining = jnp.where(mask > 0, -1e30, remaining)
+    combine, dispatch = _dispatch_combine(choices, capacity)
+    return combine, dispatch, jnp.asarray(0.0, jnp.float32)
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_expert: int):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.gate_proj = Linear(d_model, num_expert, bias_attr=False)
+
+    def capacity(self, num_tokens: int, capacity_factor: float,
+                 topk: int) -> int:
+        c = int(np.ceil(capacity_factor * topk * num_tokens
+                        / self.num_expert))
+        return max(4, min(num_tokens, c + (-c) % 4))  # pad to multiple of 4
+
+    def gate_fn(self, logits, capacity):
+        raise NotImplementedError
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(d_model, num_expert)
+        self.topk = topk
+
+    def gate_fn(self, logits, capacity):
+        return naive_topk_gate(logits, capacity, self.topk)
+
+
+class GShardGate(BaseGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert)
+        if topk not in (None, 2):
+            raise ValueError(f"GShardGate is top-2 by definition, got "
+                             f"top_k={topk}; use NaiveGate for other k")
+        self.topk = 2
+
+    def gate_fn(self, logits, capacity):
+        return top2_gate(logits, capacity)
+
+
+class SwitchGate(BaseGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert)
+        if topk not in (None, 1):
+            raise ValueError(f"SwitchGate is top-1 by definition, got "
+                             f"top_k={topk}; use NaiveGate for other k")
+        self.topk = 1
+
+    def gate_fn(self, logits, capacity):
+        return top1_gate(logits, capacity)

@@ -1,0 +1,30 @@
+"""MoE-aware global-norm gradient clipping.
+
+Reference: `ClipGradForMOEByGlobalNorm`
+(`/root/reference/python/paddle/incubate/distributed/models/moe/grad_clip.py`)
+— expert params' grad norms are reduced over the expert-parallel group
+before being merged with the shared params' norm, so every rank clips by
+the same *global* norm even though each holds different experts. In the
+SPMD rebuild all experts live in one program, so the cross-rank reduction
+is implicit (XLA psums sharded grads); the clip itself is exactly
+nn.ClipGradByGlobalNorm's — which we delegate to, keeping `need_clip`
+semantics. The reference's extra args are accepted for API parity and only
+used to tag which params are experts.
+"""
+from __future__ import annotations
+
+from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+
+def _is_expert_param(p) -> bool:
+    return getattr(p, "is_expert", False) or ".experts." in (p.name or "")
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    """Drop-in for nn.ClipGradByGlobalNorm on MoE models."""
+
+    def __init__(self, clip_norm: float, is_expert_param_func=None,
+                 moe_group=None, group_name: str = "default_moe_group"):
+        super().__init__(clip_norm, group_name=group_name)
+        self.is_expert_param = is_expert_param_func or _is_expert_param
+        self.moe_group = moe_group  # parity arg; SPMD needs no group comm

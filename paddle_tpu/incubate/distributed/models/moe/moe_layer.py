@@ -1,0 +1,170 @@
+"""MoELayer — expert-parallel mixture of experts.
+
+Reference: `MoELayer`
+(`/root/reference/python/paddle/incubate/distributed/models/moe/moe_layer.py:226`)
+which routes tokens to experts on other ranks via the `global_scatter` /
+`global_gather` all-to-all collective ops
+(`/root/reference/paddle/fluid/operators/collective/global_scatter_op.cc`).
+
+TPU-native: experts are stacked into one `[E, ...]` parameter tree and the
+routing is a pair of dense einsums against capacity-limited one-hot
+dispatch/combine tensors:
+
+    expert_in  = einsum('nec,nd->ecd', dispatch, x)   # tokens -> expert slots
+    expert_out = vmap(expert_fn)(stacked_params, expert_in)
+    y          = einsum('nec,ecd->nd', combine, expert_out)
+
+With `expert_in`/`expert_out` sharding-constrained to P('ep', ...), GSPMD
+lowers the dispatch einsum into exactly the all-to-all the reference issues
+manually, and `vmap` over the expert dim partitions expert compute across
+the `ep` axis. The whole forward is one registered kernel, so eager
+autograd (tape + jax.vjp) and the compiled engine both differentiate it.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn.layers_common import LayerList
+from paddle_tpu.ops import _dispatch as _d
+from paddle_tpu.ops._dispatch import kernel
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+_GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
+
+
+def _ep_sharding():
+    """NamedSharding for expert-major arrays when an ep>1 mesh is active."""
+    from paddle_tpu.distributed.topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return None
+    sizes = dict(zip(hcg.mesh.axis_names, hcg.mesh.devices.shape))
+    if sizes.get("ep", 1) <= 1:
+        return None
+    return hcg.mesh
+
+
+class MoELayer(Layer):
+    """moe_layer.py:226 parity: MoELayer(d_model, experts, gate=...).
+
+    experts: a LayerList/list of structurally identical expert Layers (the
+    reference's per-rank `experts` list — here the full set, sharded over
+    `ep` by XLA rather than by process). gate: 'naive'|'gshard'|'switch' or
+    a BaseGate instance. After forward, `self.aux_loss` holds the gate's
+    load-balancing loss for the caller to add to the objective (reference
+    models add `gate.get_loss()` the same way).
+    """
+
+    def __init__(self, d_model: int, experts, gate="gshard",
+                 moe_group=None, mp_group=None, recompute_interval: int = 0,
+                 top_k: Optional[int] = None, capacity_factor: float = 1.2,
+                 **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        if isinstance(experts, (list, tuple)):
+            experts = LayerList(list(experts))
+        self.experts = experts
+        self.num_expert = len(experts)
+        if isinstance(gate, str):
+            gate = _GATES[gate](d_model, self.num_expert,
+                                topk=top_k or (2 if gate != "switch" else 1))
+        elif isinstance(gate, dict):  # reference gate config dict
+            gate = _GATES[gate.get("type", "gshard")](
+                d_model, self.num_expert, topk=gate.get("top_k", 2))
+        assert isinstance(gate, BaseGate), gate
+        self.gate = gate
+        self.capacity_factor = capacity_factor
+        self.aux_loss: Optional[Tensor] = None
+
+        from paddle_tpu.jit import functionalize
+        self._expert_apply, params0, buffers0 = functionalize(experts[0])
+        assert not buffers0, "MoE experts must be buffer-free"
+        self._expert_keys = list(params0.keys())
+
+    def _stacked_expert_arrays(self) -> List[jnp.ndarray]:
+        per = []
+        for e in self.experts:
+            p = {k: v.data for k, v in e.named_parameters()}
+            per.append([p[k] for k in self._expert_keys])
+        return [jnp.stack([per[i][j] for i in range(self.num_expert)])
+                for j in range(len(self._expert_keys))]
+
+    def forward(self, x):
+        orig_shape = tuple(x.shape)
+        D = orig_shape[-1]
+        N = 1
+        for s in orig_shape[:-1]:
+            N *= int(s)
+        capacity = self.gate.capacity(N, self.capacity_factor,
+                                      getattr(self.gate, "topk", 2))
+        gate_fn = self.gate.gate_fn
+        apply0 = self._expert_apply
+        keys = self._expert_keys
+        mesh = _ep_sharding()
+
+        @kernel("moe")
+        def impl(x2, gate_w, *stacked):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            xt = x2.reshape(N, D)
+            logits = xt @ gate_w
+            combine, dispatch, aux = gate_fn(logits, capacity)
+            expert_in = jnp.einsum("nec,nd->ecd",
+                                   dispatch.astype(xt.dtype), xt)
+            if mesh is not None:
+                expert_in = jax.lax.with_sharding_constraint(
+                    expert_in, NamedSharding(mesh, P("ep", None, None)))
+
+            def one(expert_leaves, xe):
+                out, _ = apply0(dict(zip(keys, expert_leaves)), {}, None, xe)
+                return out
+            expert_out = jax.vmap(one)(list(stacked), expert_in)
+            if mesh is not None:
+                expert_out = jax.lax.with_sharding_constraint(
+                    expert_out, NamedSharding(mesh, P("ep", None, None)))
+            y = jnp.einsum("nec,ecd->nd", combine.astype(xt.dtype),
+                           expert_out)
+            return y.reshape(orig_shape), aux
+
+        out, aux = self._call_with_expert_grads(impl, x)
+        self.aux_loss = aux
+        return out
+
+    def _call_with_expert_grads(self, impl, x):
+        from paddle_tpu.framework import tape as tape_mod
+        gate_w = self.gate.gate_proj.weight
+        if not tape_mod.grad_enabled():
+            stacked = self._stacked_expert_arrays()
+            return _d.call(
+                impl, [x, gate_w] + [Tensor(s, stop_gradient=False)
+                                     for s in stacked], name="moe")
+        # eager training: make the stack itself part of the taped graph so
+        # each expert Parameter receives its slice of the gradient (under
+        # the compiled engine the stacked leaves trace from swapped params)
+        from paddle_tpu.ops.manipulation import stack as op_stack
+        expert_param_tensors = [
+            [dict(e.named_parameters())[k] for e in self.experts]
+            for k in self._expert_keys]
+        stacked_taped = [op_stack(group) for group in expert_param_tensors]
+        return _d.call(impl, [x, gate_w] + stacked_taped, name="moe")
+
+
+class Expert(Layer):
+    """Default FFN expert (reference `ExpertLayer` in moe examples)."""
+
+    def __init__(self, d_model: int, d_hidden: int, activation=None):
+        super().__init__()
+        from paddle_tpu.nn.layers_common import Linear
+        self.htoh4 = Linear(d_model, d_hidden)
+        self.h4toh = Linear(d_hidden, d_model)
+        self._act = activation
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        h = self.htoh4(x)
+        h = self._act(h) if self._act is not None else F.gelu(h)
+        return self.h4toh(h)

@@ -25,6 +25,7 @@ from .topology import (  # noqa: F401
 )
 from . import meta_parallel  # noqa: F401
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
 
 # bind paddle.DataParallel lazily (top-level package avoids import cycle)
 import paddle_tpu as _paddle

@@ -24,6 +24,7 @@ from ..topology import (CommunicateTopology, HybridCommunicateGroup,
                         get_hybrid_communicate_group,
                         set_hybrid_communicate_group)
 from .distributed_strategy import DistributedStrategy
+from . import utils  # noqa: F401  (fleet.utils.recompute)
 from ..meta_parallel.engine import HybridParallelTrainStep  # noqa: F401
 
 __all__ = [

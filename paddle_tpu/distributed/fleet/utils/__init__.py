@@ -1,0 +1,108 @@
+"""fleet.utils — activation recomputation (checkpointing).
+
+Reference: `RecomputeFunction`
+(`/root/reference/python/paddle/distributed/fleet/utils/recompute.py:199`,
+eager variant at `:65`) — a PyLayer that stashes RNG state + inputs in
+forward and replays the segment under grad in backward. TPU-native:
+`jax.checkpoint` IS that mechanism (residuals = inputs, recompute in the
+vjp), so `recompute(fn, *args)` wraps the segment in `jax.checkpoint` and
+routes it through the op tape; the RNG key is an explicit input, which
+gives exact dropout replay for free (no CUDA RNG-state juggling).
+
+Layers are discovered from `function` itself, its `__self__`, and its
+closure cells / partial args, so `recompute(self.block, x)` and
+`recompute(lambda a: self.block(a), x)` both thread the right parameters
+through the checkpointed vjp. Params of collected layers that the segment
+does not touch receive zero gradients (not None) — same caveat as the
+reference's `detach`-based capture.
+
+Works in eager mode (tape records the checkpointed vjp) and under the
+compiled engine (`jax.checkpoint` composes with jit/grad/scan).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, List
+
+import jax
+
+from ....framework import random as random_mod
+from ....framework.tensor import Tensor
+from ....nn.layer import Layer
+from ....ops import _dispatch as _d
+
+__all__ = ["recompute"]
+
+
+def _collect_layers(function) -> List[Layer]:
+    """Layers reachable from `function`: itself, bound owner, closure cells,
+    functools.partial payload (one level — the reference captures whatever
+    autograd sees; this captures whatever the callable references)."""
+    found: List[Layer] = []
+    seen = set()
+
+    def add(obj):
+        if isinstance(obj, Layer) and id(obj) not in seen:
+            seen.add(id(obj))
+            found.append(obj)
+
+    add(function)
+    add(getattr(function, "__self__", None))
+    if isinstance(function, functools.partial):
+        add(function.func)
+        add(getattr(function.func, "__self__", None))
+        for a in function.args:
+            add(a)
+        for a in function.keywords.values():
+            add(a)
+    for cell in (getattr(function, "__closure__", None) or ()):
+        try:
+            add(cell.cell_contents)
+        except ValueError:
+            pass
+    return found
+
+
+def recompute(function, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, **kwargs):
+    """Run `function(*args)` without saving intermediate activations;
+    re-run it during backward (reference recompute.py:199 semantics).
+
+    `preserve_rng_state` is accepted for parity; RNG replay is exact either
+    way here (the key is a checkpointed input)."""
+    from ....jit import _swapped_state
+    from ....framework import tape as tape_mod
+
+    layers = _collect_layers(function)
+    rng = random_mod.next_key()
+
+    # merged parameter/buffer views, prefixed per layer
+    named: dict = {}
+    buffers_by_layer = []
+    for li, layer in enumerate(layers):
+        for k, p in layer.named_parameters():
+            named[f"{li}::{k}"] = p
+        buffers_by_layer.append({k: b.data for k, b in
+                                 layer.named_buffers()})
+    keys = list(named)
+
+    def impl(rng_key, *arrs):
+        import contextlib
+        pvals = arrs[:len(keys)]
+        inputs = arrs[len(keys):]
+        with contextlib.ExitStack() as st:
+            st.enter_context(tape_mod.no_grad())
+            for li, layer in enumerate(layers):
+                pref = f"{li}::"
+                sub = {k[len(pref):]: v for k, v in
+                       zip(keys, pvals) if k.startswith(pref)}
+                st.enter_context(
+                    _swapped_state(layer, sub, buffers_by_layer[li]))
+            st.enter_context(random_mod.rng_scope(rng_key))
+            out = function(*[Tensor(a) for a in inputs], **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o.data if isinstance(o, Tensor) else o for o in out)
+        return out.data if isinstance(out, Tensor) else out
+
+    tensors = [rng] + [named[k] for k in keys] + list(args)
+    return _d.call(jax.checkpoint(impl), tensors, name="recompute")

@@ -1,0 +1,192 @@
+"""fleet.utils.recompute + distributed.sharding.group_sharded_parallel.
+
+Reference test style: `unittests/test_dygraph_recompute.py` asserts
+recomputed forward/backward equals the plain run (incl. dropout RNG
+replay); sharding-stage tests assert training equivalence
+(`test_dygraph_group_sharded_api.py`).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.distributed.fleet.utils import recompute
+from paddle_tpu.distributed.sharding import (group_sharded_parallel,
+                                             save_group_sharded_model)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    dist.set_hybrid_communicate_group(None)
+
+
+class Net(nn.Layer):
+    def __init__(self, d=16, use_dropout=False):
+        super().__init__()
+        self.fc1 = nn.Linear(d, 32)
+        self.fc2 = nn.Linear(32, 32)
+        self.fc3 = nn.Linear(32, d)
+        self.p = 0.3 if use_dropout else 0.0
+
+    def block(self, x):
+        h = F.relu(self.fc1(x))
+        h = F.dropout(h, p=self.p, training=self.training)
+        return F.relu(self.fc2(h))
+
+    def forward(self, x, use_recompute=False):
+        h = recompute(self.block, x) if use_recompute else self.block(x)
+        return self.fc3(h)
+
+
+class TestRecompute:
+    def test_matches_plain_forward_backward(self):
+        paddle.seed(0)
+        net = Net()
+        rs = np.random.RandomState(0)
+        X = rs.randn(8, 16).astype(np.float32)
+
+        def run(use_rc):
+            for p in net.parameters():
+                p.clear_grad()
+            out = net(paddle.to_tensor(X), use_recompute=use_rc)
+            loss = (out * out).mean()
+            loss.backward()
+            return (float(loss),
+                    {k: np.asarray(p.grad.data)
+                     for k, p in net.named_parameters()})
+
+        l0, g0 = run(False)
+        l1, g1 = run(True)
+        assert abs(l0 - l1) < 1e-6
+        for k in g0:
+            np.testing.assert_allclose(g1[k], g0[k], rtol=1e-5, atol=1e-6,
+                                       err_msg=k)
+
+    def test_dropout_rng_replay_consistent(self):
+        """Recompute with dropout must replay the SAME mask in backward:
+        grads are finite and deterministic given the generator state."""
+        paddle.seed(7)
+        net = Net(use_dropout=True)
+        rs = np.random.RandomState(0)
+        X = rs.randn(8, 16).astype(np.float32)
+        out = net(paddle.to_tensor(X), use_recompute=True)
+        loss = (out * out).mean()
+        loss.backward()
+        for k, p in net.named_parameters():
+            assert p.grad is not None, k
+            assert bool(jnp.all(jnp.isfinite(p.grad.data))), k
+
+    def test_lambda_closure_params_get_grads(self):
+        """recompute(lambda a: net.block(a), x) must thread the closed-over
+        layer's params (reference supports arbitrary callables)."""
+        paddle.seed(0)
+        net = Net()
+        rs = np.random.RandomState(0)
+        X = rs.randn(8, 16).astype(np.float32)
+        out = recompute(lambda a: net.block(a), paddle.to_tensor(X))
+        (out * out).mean().backward()
+        assert net.fc1.weight.grad is not None
+        assert float(jnp.abs(net.fc1.weight.grad.data).sum()) > 0
+
+    def test_plain_function_recompute(self):
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        x.stop_gradient = False
+        y = recompute(lambda a: (a * a).sum(), x)
+        y.backward()
+        np.testing.assert_allclose(np.asarray(x.grad.data),
+                                   2 * np.ones((4, 4)), rtol=1e-6)
+
+
+class TestGroupSharded:
+    @pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+    def test_training_matches_unsharded(self, level):
+        rs = np.random.RandomState(0)
+        X = rs.randn(16, 16).astype(np.float32)
+        Y = rs.randn(16, 16).astype(np.float32)
+
+        def run(sharded):
+            dist.set_hybrid_communicate_group(None)
+            paddle.seed(0)
+            net = Net()
+            opt = optimizer.Adam(learning_rate=1e-2,
+                                 parameters=net.parameters())
+            scaler = None
+            if sharded:
+                net, opt, scaler = group_sharded_parallel(
+                    net, opt, level)
+            losses = []
+            for _ in range(4):
+                out = net(paddle.to_tensor(X))
+                loss = F.mse_loss(out, paddle.to_tensor(Y))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            return losses
+
+        ref = run(False)
+        got = run(True)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_slots_actually_sharded(self):
+        paddle.seed(0)
+        net = Net(d=16)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=net.parameters())
+        net, opt, _ = group_sharded_parallel(net, opt, "os")
+        out = net(paddle.to_tensor(np.ones((8, 16), np.float32)))
+        out.mean().backward()
+        opt.step()
+        sharded = 0
+        for slots in opt._slots.values():
+            for v in slots.values():
+                if hasattr(v, "sharding") and "sharding" in str(
+                        getattr(v.sharding, "spec", "")):
+                    sharded += 1
+        assert sharded > 0, "no optimizer slot is sharded"
+
+    def test_minimize_path_shards_slots(self):
+        paddle.seed(0)
+        net = Net(d=16)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=net.parameters())
+        net, opt, _ = group_sharded_parallel(net, opt, "os")
+        loss = F.mse_loss(net(paddle.to_tensor(
+            np.ones((8, 16), np.float32))), paddle.zeros([8, 16]))
+        opt.minimize(loss)
+        sharded = sum(
+            1 for slots in opt._slots.values() for v in slots.values()
+            if hasattr(v, "sharding") and "sharding" in str(
+                getattr(v.sharding, "spec", "")))
+        assert sharded > 0
+
+    def test_existing_topology_without_sharding_axis_raises(self):
+        dist.set_hybrid_communicate_group(
+            __import__("paddle_tpu.distributed.topology",
+                       fromlist=["HybridCommunicateGroup"]
+                       ).HybridCommunicateGroup(dims={"dp": 8}))
+        net = Net()
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=net.parameters())
+        with pytest.raises(ValueError, match="sharding"):
+            group_sharded_parallel(net, opt, "os")
+
+    def test_stage3_params_sharded_and_save(self, tmp_path):
+        paddle.seed(0)
+        net = Net(d=16)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=net.parameters())
+        net, opt, _ = group_sharded_parallel(net, opt, "p_g_os")
+        sharded = sum(
+            1 for p in net.parameters()
+            if "sharding" in str(getattr(p.data.sharding, "spec", "")))
+        assert sharded > 0, "no parameter is sharded"
+        save_group_sharded_model(net, str(tmp_path / "out"), opt)
+        assert (tmp_path / "out" / "model.pdparams").exists()
+        assert (tmp_path / "out" / "model.pdopt").exists()

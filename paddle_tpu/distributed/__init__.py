@@ -26,6 +26,8 @@ from .topology import (  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from . import launch  # noqa: F401
+from .spawn import spawn  # noqa: F401
 
 # bind paddle.DataParallel lazily (top-level package avoids import cycle)
 import paddle_tpu as _paddle
@@ -40,14 +42,5 @@ def get_backend() -> str:
 QUEUE_DTYPE = None  # reserved
 
 
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    """reference `paddle.distributed.spawn` (spawn.py:394). On TPU a single
-    controller already drives every local chip, so spawn runs `func` once in
-    this process (nprocs>1 process spawning is the multi-host launcher's
-    job — `python -m paddle_tpu.distributed.launch`)."""
-    if nprocs in (-1, 0, 1):
-        func(*args)
-        return None
-    raise NotImplementedError(
-        "per-device process spawning does not apply to single-controller "
-        "TPU; use paddle_tpu.distributed.launch for multi-host")
+# spawn: real multiprocessing implementation lives in spawn.py (imported
+# above); nprocs<=1 degenerates to an inline call there.

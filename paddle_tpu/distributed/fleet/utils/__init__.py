@@ -76,6 +76,12 @@ def recompute(function, *args, preserve_rng_state: bool = True,
     layers = _collect_layers(function)
     rng = random_mod.next_key()
 
+    # Tensor kwargs must be checkpointed inputs (not baked constants) or
+    # their gradients would silently vanish
+    kw_names = [k for k, v in kwargs.items() if isinstance(v, Tensor)]
+    kw_tensors = [kwargs[k] for k in kw_names]
+    static_kwargs = {k: v for k, v in kwargs.items() if k not in kw_names}
+
     # merged parameter/buffer views, prefixed per layer
     named: dict = {}
     buffers_by_layer = []
@@ -89,7 +95,9 @@ def recompute(function, *args, preserve_rng_state: bool = True,
     def impl(rng_key, *arrs):
         import contextlib
         pvals = arrs[:len(keys)]
-        inputs = arrs[len(keys):]
+        rest = arrs[len(keys):]
+        inputs = rest[:len(rest) - len(kw_names)]
+        kw_vals = rest[len(rest) - len(kw_names):]
         with contextlib.ExitStack() as st:
             st.enter_context(tape_mod.no_grad())
             for li, layer in enumerate(layers):
@@ -99,10 +107,13 @@ def recompute(function, *args, preserve_rng_state: bool = True,
                 st.enter_context(
                     _swapped_state(layer, sub, buffers_by_layer[li]))
             st.enter_context(random_mod.rng_scope(rng_key))
-            out = function(*[Tensor(a) for a in inputs], **kwargs)
+            out = function(*[Tensor(a) for a in inputs],
+                           **dict(zip(kw_names,
+                                      (Tensor(a) for a in kw_vals))),
+                           **static_kwargs)
         if isinstance(out, (tuple, list)):
             return tuple(o.data if isinstance(o, Tensor) else o for o in out)
         return out.data if isinstance(out, Tensor) else out
 
-    tensors = [rng] + [named[k] for k in keys] + list(args)
+    tensors = [rng] + [named[k] for k in keys] + list(args) + kw_tensors
     return _d.call(jax.checkpoint(impl), tensors, name="recompute")

@@ -1,0 +1,2 @@
+"""paddle.distributed.launch parity (see main.py)."""
+from .main import launch, main, parse_args  # noqa: F401

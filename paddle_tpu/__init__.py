@@ -42,6 +42,9 @@ from . import io  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
+from . import hapi  # noqa: E402,F401
+from .hapi import Model  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
 from .nn.initializer import ParamAttr  # noqa: E402,F401
 
 # paddle-API conveniences

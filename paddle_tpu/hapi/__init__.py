@@ -1,0 +1,3 @@
+"""hapi — high-level Model API (reference `python/paddle/hapi/`)."""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401

@@ -1,0 +1,292 @@
+"""hapi.Model — Keras-like high-level training API.
+
+Reference: `Model` (`/root/reference/python/paddle/hapi/model.py:907`,
+`prepare:1486`, `fit:1557`, `evaluate`, `predict`, `save/load`,
+`train_batch/eval_batch/predict_batch`). The reference juggles dygraph and
+static adapters (`DynamicGraphAdapter`/`StaticGraphAdapter`); here the
+compiled path is `paddle_tpu.jit.TrainStep` (whole train step = one XLA
+executable) with an eager fallback when the loss needs model internals.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework import io as io_mod
+from ..metric import Metric
+from ..nn.layer import Layer
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """Model(network, inputs=None, labels=None) — reference model.py:907."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self.stop_training = False
+
+    # -- prepare -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric), f"{m} is not a paddle Metric"
+        self._train_step = None
+        return self
+
+    # -- single-batch APIs (reference train_batch/eval_batch) ---------------
+    def train_batch(self, inputs, labels=None, update=True):
+        assert self._loss is not None and self._optimizer is not None, \
+            "call prepare(optimizer, loss) first"
+        if not update:
+            raise NotImplementedError(
+                "update=False (grad accumulation) is not supported by the "
+                "compiled train step; use DistributedStrategy.gradient_merge")
+        inputs, labels = _to_list(inputs), _to_list(labels)
+        self.network.train()
+        if self._train_step is None:
+            from ..jit import TrainStep
+            loss_fn = self._loss
+            self._train_step = TrainStep(
+                self.network, lambda out, y: _apply_loss(loss_fn, out, y),
+                self._optimizer)
+        loss = self._train_step(*inputs, *labels)
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        inputs, labels = _to_list(inputs), _to_list(labels)
+        self.network.eval()
+        from ..framework import tape
+        with tape.no_grad():
+            outputs = self.network(*[_as_tensor(i) for i in inputs])
+        outs = _to_list(outputs)
+        losses = []
+        if self._loss is not None and labels:
+            losses = [float(_apply_loss(self._loss, outputs,
+                                        _as_tensor(labels[0])))]
+        metrics = []
+        for m in self._metrics:
+            # paddle Metric protocol: compute(pred, label) -> update(state)
+            state = m.compute(*outs, *[_as_tensor(l) for l in labels])
+            m.update(*_to_list(state) if isinstance(state, tuple)
+                     else [state])
+            metrics.append(m.accumulate())
+        return (losses, metrics) if self._metrics else losses
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..framework import tape
+        with tape.no_grad():
+            out = self.network(*[_as_tensor(i) for i in _to_list(inputs)])
+        return [np.asarray(o.data) for o in _to_list(out)]
+
+    # -- fit/evaluate/predict ------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1,
+            epochs=1, eval_freq=1, log_freq=10, save_dir=None,
+            save_freq=1, verbose=2, drop_last=False, shuffle=True,
+            num_workers=0, callbacks=None, accumulate_grad_batches=1,
+            num_iters=None):
+        train_loader = _as_loader(train_data, batch_size, shuffle, drop_last,
+                                  num_workers)
+        eval_loader = _as_loader(eval_data, batch_size, False, False,
+                                 num_workers) if eval_data is not None \
+            else None
+
+        cbks = CallbackList(_to_list(callbacks))
+        if verbose and not any(isinstance(c, ProgBarLogger)
+                               for c in cbks.callbacks):
+            cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir:
+            from .callbacks import ModelCheckpoint
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbks.set_model(self)
+        steps = _try_len(train_loader)
+        cbks.set_params({"epochs": epochs, "steps": steps,
+                         "verbose": verbose, "save_dir": save_dir,
+                         "metrics": ["loss"] + [
+                             m.name() for m in self._metrics]})
+
+        if accumulate_grad_batches != 1:
+            raise NotImplementedError(
+                "accumulate_grad_batches: use DistributedStrategy."
+                "gradient_merge with the hybrid engine instead")
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        logs = {}
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                inputs, labels = _split_batch(batch)
+                cbks.on_train_batch_begin(step)
+                loss = self.train_batch(inputs, labels)
+                logs = {"loss": loss}
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                # eval runs the eager network: pull trained weights first
+                self._sync_from_train_step()
+                eval_logs = self._run_eval(eval_loader, cbks)
+                cbks.on_eval_end(eval_logs)
+        cbks.on_train_end(logs)
+        self._sync_from_train_step()
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        self._sync_from_train_step()
+        loader = _as_loader(eval_data, batch_size, False, False, num_workers)
+        cbks = CallbackList(_to_list(callbacks))
+        cbks.set_model(self)
+        cbks.on_eval_begin()
+        logs = self._run_eval(loader, cbks)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def _run_eval(self, loader, cbks):
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            inputs, labels = _split_batch(batch)
+            cbks.on_eval_batch_begin(step)
+            r = self.eval_batch(inputs, labels)
+            loss = r[0] if isinstance(r, tuple) else r
+            if loss:
+                losses.append(loss[0])
+            cbks.on_eval_batch_end(step, {"loss": loss})
+        logs = {}
+        if losses:
+            logs["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        self._sync_from_train_step()
+        loader = _as_loader(test_data, batch_size, False, False, num_workers)
+        n_in = _forward_arity(self.network)
+        outputs = []
+        for batch in loader:
+            inputs, _ = _split_batch(batch, has_labels=False)
+            if n_in is not None and len(inputs) > n_in:
+                inputs = inputs[:n_in]  # dataset yields (inputs, labels)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        self._sync_from_train_step()
+        io_mod.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            io_mod.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
+        state = io_mod.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(io_mod.load(path + ".pdopt"))
+        self._train_step = None
+        return self
+
+    def parameters(self, *a, **kw):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        lines = [f"Model: {type(self.network).__name__}"]
+        total = 0
+        for k, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            lines.append(f"  {k:50s} {str(tuple(p.shape)):20s} {n}")
+        lines.append(f"Total params: {total}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total}
+
+    def _sync_from_train_step(self):
+        if self._train_step is not None:
+            self._train_step.sync_to_layer()
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(np.asarray(x)))
+
+
+def _apply_loss(loss_fn, outputs, labels):
+    out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+    if isinstance(loss_fn, Layer) or callable(loss_fn):
+        return loss_fn(out, labels)
+    raise TypeError(f"bad loss {loss_fn!r}")
+
+
+def _split_batch(batch, has_labels=True):
+    if isinstance(batch, (list, tuple)):
+        if has_labels and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return list(batch), []
+    return [batch], []
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    from ..io import DataLoader, Dataset
+    if data is None:
+        return None
+    if isinstance(data, DataLoader):
+        return data
+    return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                      drop_last=drop_last, num_workers=num_workers)
+
+
+def _forward_arity(network):
+    """Number of positional inputs forward accepts, None if *args."""
+    import inspect
+    try:
+        sig = inspect.signature(network.forward)
+    except (TypeError, ValueError):
+        return None
+    n = 0
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            return None
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD):
+            n += 1
+    return n
+
+
+def _try_len(loader):
+    try:
+        return len(loader)
+    except TypeError:
+        return None

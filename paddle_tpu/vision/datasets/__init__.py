@@ -1,0 +1,155 @@
+"""vision.datasets (reference `python/paddle/vision/datasets/` +
+`python/paddle/dataset/`).
+
+Zero-egress environment: `download=True` raises; datasets read standard
+local files (`data_file=`/`image_path=` args, same formats as the
+reference: MNIST idx-gzip, CIFAR pickle-tar). For tests and smoke runs,
+every dataset also accepts `backend="fake"`-style generation via the
+`FakeData` class (deterministic synthetic samples with the right shapes),
+mirroring the reference's flowers/minst test fixtures.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images (reference test-fixture pattern)."""
+
+    def __init__(self, num_samples=100, shape=(3, 32, 32), num_classes=10,
+                 transform: Optional[Callable] = None):
+        self.num_samples = num_samples
+        self.shape = tuple(shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rs = np.random.RandomState(idx)
+        img = rs.randint(0, 256, self.shape).astype(np.uint8)
+        label = np.array(idx % self.num_classes, dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+def _no_download(download):
+    if download:
+        raise NotImplementedError(
+            "downloads are unavailable in this environment; pass local "
+            "file paths (image_path=/label_path=/data_file=)")
+
+
+class MNIST(Dataset):
+    """idx-gzip reader (reference `vision/datasets/mnist.py`)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        _no_download(download)
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend or "numpy"
+        if image_path is None or label_path is None:
+            raise ValueError(
+                f"{type(self).__name__} needs image_path= and label_path= "
+                "(idx .gz files); downloads are unavailable here")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+        assert len(self.images) == len(self.labels)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad idx image magic {magic}"
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad idx label magic {magic}"
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype(np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, np.array(label, dtype=np.int64)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR pickle-tar reader (reference `vision/datasets/cifar.py`)."""
+
+    _N_CLASS = 10
+    _LABEL_KEY = b"labels"
+    _TRAIN_MEMBER = "data_batch"
+    _TEST_MEMBER = "test_batch"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        _no_download(download)
+        if data_file is None:
+            raise ValueError(
+                f"{type(self).__name__} needs data_file= (the cifar tar.gz);"
+                " downloads are unavailable here")
+        self.mode = mode
+        self.transform = transform
+        want = self._TRAIN_MEMBER if mode == "train" else self._TEST_MEMBER
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                if want in m.name:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    images.append(np.asarray(d[b"data"], dtype=np.uint8))
+                    labels.extend(d[self._LABEL_KEY])
+        assert images, f"no '{want}' members found in {data_file}"
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, np.array(self.labels[idx], dtype=np.int64)
+
+
+class Cifar100(Cifar10):
+    _N_CLASS = 100
+    _LABEL_KEY = b"fine_labels"
+    _TRAIN_MEMBER = "train"
+    _TEST_MEMBER = "test"

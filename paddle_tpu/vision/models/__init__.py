@@ -1,0 +1,22 @@
+"""vision.models — model zoo (reference `python/paddle/vision/models/`).
+
+ResNet/LeNet live in `paddle_tpu.models` (the framework's flagship model
+dir) and are re-exported here; VGG / MobileNet / AlexNet are defined in
+siblings of this package. `pretrained=True` is not supported (zero-egress
+environment) and raises with a clear message.
+"""
+from paddle_tpu.models.resnet import (  # noqa: F401
+    BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
+    resnet101, resnet152)
+from paddle_tpu.models.lenet import LeNet  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenet import (  # noqa: F401
+    MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2)
+from .alexnet import AlexNet, alexnet  # noqa: F401
+
+__all__ = [
+    "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
+    "AlexNet", "alexnet",
+]

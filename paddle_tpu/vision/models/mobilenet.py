@@ -1,0 +1,126 @@
+"""MobileNet v1/v2 (reference `python/paddle/vision/models/mobilenetv1.py`,
+`mobilenetv2.py`). Depthwise convs use Conv2D groups == channels; on TPU
+XLA maps grouped convs onto the MXU via feature_group_count."""
+from __future__ import annotations
+
+from paddle_tpu import nn
+
+
+def _conv_bn(in_c, out_c, k=3, stride=1, padding=None, groups=1, act=True):
+    if padding is None:
+        padding = (k - 1) // 2
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act:
+        layers.append(nn.ReLU6())
+    return nn.Sequential(*layers)
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.dw = _conv_bn(in_c, in_c, 3, stride=stride, groups=in_c)
+        self.pw = _conv_bn(in_c, out_c, 1, padding=0)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: max(8, int(c * scale))
+        cfg = [(s(32), s(64), 1), (s(64), s(128), 2), (s(128), s(128), 1),
+               (s(128), s(256), 2), (s(256), s(256), 1),
+               (s(256), s(512), 2)] + \
+            [(s(512), s(512), 1)] * 5 + \
+            [(s(512), s(1024), 2), (s(1024), s(1024), 1)]
+        blocks = [_conv_bn(3, s(32), stride=2)]
+        blocks += [_DepthwiseSeparable(i, o, st) for i, o, st in cfg]
+        self.features = nn.Sequential(*blocks)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from paddle_tpu.ops import flatten
+            x = self.fc(flatten(x, start_axis=1))
+        return x
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, expand):
+        super().__init__()
+        hidden = int(round(in_c * expand))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand != 1:
+            layers.append(_conv_bn(in_c, hidden, 1, padding=0))
+        layers.append(_conv_bn(hidden, hidden, 3, stride=stride,
+                               groups=hidden))
+        layers.append(_conv_bn(hidden, out_c, 1, padding=0, act=False))
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        s = lambda c: max(8, int(c * scale))
+        in_c = s(32)
+        blocks = [_conv_bn(3, in_c, stride=2)]
+        for t, c, n, st in cfg:
+            out_c = s(c)
+            for i in range(n):
+                blocks.append(_InvertedResidual(
+                    in_c, out_c, st if i == 0 else 1, t))
+                in_c = out_c
+        last = max(1280, int(1280 * scale))
+        blocks.append(_conv_bn(in_c, last, 1, padding=0))
+        self.features = nn.Sequential(*blocks)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from paddle_tpu.ops import flatten
+            x = self.classifier(flatten(x, start_axis=1))
+        return x
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are unavailable in this environment; "
+            "load a local state_dict with set_state_dict instead")
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV2(scale=scale, **kwargs)

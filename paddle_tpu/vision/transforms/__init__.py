@@ -1,0 +1,318 @@
+"""vision.transforms (reference `python/paddle/vision/transforms/`).
+
+Numpy-array transforms (HWC, uint8/float32) with the reference's class API:
+Compose / Resize / CenterCrop / RandomCrop / RandomHorizontalFlip /
+RandomVerticalFlip / Normalize / ToTensor / Transpose / Pad /
+RandomResizedCrop / BrightnessTransform / Grayscale. Host-side (they run in
+DataLoader workers), so plain numpy — the device pipeline starts at the
+batch boundary.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "Compose", "BaseTransform", "ToTensor", "Resize", "CenterCrop",
+    "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+    "Normalize", "Transpose", "Pad", "RandomResizedCrop", "Grayscale",
+    "BrightnessTransform", "to_tensor", "resize", "normalize", "hflip",
+    "vflip", "center_crop", "crop", "pad", "to_grayscale",
+]
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def _size_pair(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+# -- functional -------------------------------------------------------------
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h <= w:
+            nh, nw = size, max(1, int(size * w / h))
+        else:
+            nh, nw = max(1, int(size * h / w)), size
+    else:
+        nh, nw = _size_pair(size)
+    if (nh, nw) == (h, w):
+        return img
+    yi = np.linspace(0, h - 1, nh)
+    xi = np.linspace(0, w - 1, nw)
+    if interpolation == "nearest":
+        out = img[np.round(yi).astype(int)[:, None],
+                  np.round(xi).astype(int)[None, :]]
+        return out
+    y0 = np.floor(yi).astype(int)
+    x0 = np.floor(xi).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (yi - y0)[:, None, None]
+    wx = (xi - x0)[None, :, None]
+    f = img.astype(np.float32)
+    out = (f[y0[:, None], x0[None, :]] * (1 - wy) * (1 - wx)
+           + f[y1[:, None], x0[None, :]] * wy * (1 - wx)
+           + f[y0[:, None], x1[None, :]] * (1 - wy) * wx
+           + f[y1[:, None], x1[None, :]] * wy * wx)
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    th, tw = _size_pair(output_size)
+    h, w = img.shape[:2]
+    return crop(img, max(0, (h - th) // 2), max(0, (w - tw) // 2), th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    width = ((t, b), (l, r), (0, 0))
+    if padding_mode == "constant":
+        return np.pad(img, width, mode="constant", constant_values=fill)
+    return np.pad(img, width, mode=padding_mode)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (img - mean[:, None, None]) / std[:, None, None]
+    return (img - mean) / std
+
+
+def to_tensor(img, data_format="CHW"):
+    """uint8 HWC -> float32 CHW in [0,1] (reference to_tensor)."""
+    img = _as_hwc(img)
+    arr = img.astype(np.float32)
+    if img.dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def to_grayscale(img, num_output_channels=1):
+    orig = _as_hwc(img)
+    f = orig.astype(np.float32)
+    if f.shape[2] >= 3:
+        g = 0.299 * f[..., 0] + 0.587 * f[..., 1] + 0.114 * f[..., 2]
+    else:
+        g = f[..., 0]
+    out = np.repeat(g[:, :, None], num_output_channels, axis=2)
+    return out.astype(orig.dtype)
+
+
+# -- class API ----------------------------------------------------------------
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        # (image, label, ...) tuples: transform only the image (reference
+        # default keys=('image',)); labels pass through untouched
+        if isinstance(inputs, (list, tuple)):
+            return type(inputs)(
+                [self._apply_image(inputs[0]), *inputs[1:]])
+        return self._apply_image(inputs)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = _size_pair(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        h, w = img.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            # pad order is (left, top, right, bottom)
+            img = pad(img, (0, 0, max(0, tw - w), max(0, th - h)),
+                      self.fill, self.padding_mode)
+            h, w = img.shape[:2]
+        top = random.randint(0, max(0, h - th))
+        left = random.randint(0, max(0, w - tw))
+        return crop(img, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = _size_pair(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * random.uniform(*self.scale)
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                return resize(crop(img, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_hwc(img)
+        img = _as_hwc(img)
+        alpha = 1 + random.uniform(-self.value, self.value)
+        out = img.astype(np.float32) * alpha
+        if img.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out

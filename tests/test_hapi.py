@@ -1,0 +1,113 @@
+"""hapi.Model fit/evaluate/predict (reference `hapi/model.py:907`,
+tested like `unittests/test_model.py`: LeNet on random data, asserting
+fit reduces loss, evaluate returns metrics, predict shapes)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.hapi.callbacks import (Callback, EarlyStopping, LRScheduler,
+                                       ModelCheckpoint)
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.nn import functional as F
+
+
+def _data(n=64, d=16, nclass=4, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, d).astype(np.float32)
+    W = rs.randn(d, nclass).astype(np.float32)
+    Y = np.argmax(X @ W + 0.1 * rs.randn(n, nclass), 1).astype(np.int64)
+    return TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+
+
+def _mlp(d=16, nclass=4):
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(d, 32), nn.ReLU(), nn.Linear(32, nclass))
+
+
+class TestModelFit:
+    def test_fit_reduces_loss_and_evaluate(self):
+        model = paddle.Model(_mlp())
+        model.prepare(
+            optimizer.Adam(learning_rate=1e-2,
+                           parameters=model.parameters()),
+            nn.CrossEntropyLoss(), metrics=Accuracy())
+        ds = _data()
+        losses = []
+
+        class Rec(Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                losses.append(logs["loss"][0])
+
+        model.fit(ds, epochs=4, batch_size=16, verbose=0, callbacks=[Rec()])
+        assert losses[-1] < losses[0], losses
+        res = model.evaluate(ds, batch_size=16, verbose=0)
+        assert "loss" in res and "acc" in res
+        assert res["acc"] > 0.5
+
+    def test_predict_shapes(self):
+        model = paddle.Model(_mlp())
+        model.prepare()
+        ds = _data(n=20)
+        out = model.predict(ds, batch_size=8, stack_outputs=True)
+        assert out[0].shape == (20, 4)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = paddle.Model(_mlp())
+        model.prepare(optimizer.Adam(learning_rate=1e-2,
+                                     parameters=model.parameters()),
+                      nn.CrossEntropyLoss())
+        model.fit(_data(), epochs=1, batch_size=16, verbose=0)
+        p = str(tmp_path / "ckpt")
+        model.save(p)
+        assert os.path.exists(p + ".pdparams")
+        assert os.path.exists(p + ".pdopt")
+        model2 = paddle.Model(_mlp())
+        model2.prepare(optimizer.Adam(learning_rate=1e-2,
+                                      parameters=model2.parameters()),
+                       nn.CrossEntropyLoss())
+        model2.load(p)
+        a = model.predict_batch([np.ones((2, 16), np.float32)])[0]
+        b = model2.predict_batch([np.ones((2, 16), np.float32)])[0]
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_checkpoint_callback(self, tmp_path):
+        model = paddle.Model(_mlp())
+        model.prepare(optimizer.SGD(learning_rate=1e-2,
+                                    parameters=model.parameters()),
+                      nn.CrossEntropyLoss())
+        model.fit(_data(), epochs=2, batch_size=32, verbose=0,
+                  save_dir=str(tmp_path))
+        assert (tmp_path / "0.pdparams").exists()
+        assert (tmp_path / "final.pdparams").exists()
+
+    def test_early_stopping(self):
+        model = paddle.Model(_mlp())
+        model.prepare(optimizer.SGD(learning_rate=0.0,
+                                    parameters=model.parameters()),
+                      nn.CrossEntropyLoss(), metrics=Accuracy())
+        es = EarlyStopping(monitor="loss", patience=1, mode="min")
+        ds = _data()
+        model.fit(ds, eval_data=ds, epochs=6, batch_size=32, verbose=0,
+                  eval_freq=1, callbacks=[es])
+        assert es.stop_training  # lr=0 -> no improvement -> stopped
+
+    def test_lr_scheduler_callback(self):
+        from paddle_tpu.optimizer.lr import StepDecay
+        sched = StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+        model = paddle.Model(_mlp())
+        model.prepare(optimizer.SGD(learning_rate=sched,
+                                    parameters=model.parameters()),
+                      nn.CrossEntropyLoss())
+        model.fit(_data(n=32), epochs=1, batch_size=16, verbose=0,
+                  callbacks=[LRScheduler(by_step=True)])
+        assert sched.last_epoch >= 2
+
+    def test_summary(self, capsys):
+        model = paddle.Model(_mlp())
+        info = model.summary()
+        assert info["total_params"] == 16 * 32 + 32 + 32 * 4 + 4
+        assert "Total params" in capsys.readouterr().out

@@ -1,0 +1,153 @@
+"""vision: model zoo forward shapes, transforms math, dataset readers.
+
+Reference test style: `unittests/test_vision_models.py` (forward shape per
+model), `test_transforms.py` (functional math), dataset tests with local
+fixture files.
+"""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, models, transforms as T
+
+
+def _img(h=32, w=48, c=3, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (h, w, c)).astype(np.uint8)
+
+
+class TestTransforms:
+    def test_to_tensor_scales_and_chw(self):
+        x = T.to_tensor(_img())
+        assert x.shape == (3, 32, 48)
+        assert x.dtype == np.float32 and x.max() <= 1.0
+
+    def test_resize_and_crop(self):
+        img = _img()
+        assert T.resize(img, (16, 24)).shape == (16, 24, 3)
+        assert T.resize(img, 16).shape[0] == 16  # short side
+        assert T.center_crop(img, 20).shape == (20, 20, 3)
+
+    def test_flips_and_pad(self):
+        img = _img()
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+        assert T.pad(img, 2).shape == (36, 52, 3)
+
+    def test_normalize(self):
+        chw = T.to_tensor(_img())
+        out = T.normalize(chw, mean=[0.5] * 3, std=[0.5] * 3)
+        assert abs(float(out.mean())) < 1.2
+
+    def test_compose_pipeline(self):
+        tr = T.Compose([T.Resize(40), T.CenterCrop(32),
+                        T.RandomHorizontalFlip(0.5), T.ToTensor(),
+                        T.Normalize([0.5] * 3, [0.5] * 3)])
+        out = tr(_img(64, 80))
+        assert out.shape == (3, 32, 32)
+
+    def test_random_resized_crop(self):
+        out = T.RandomResizedCrop(24)(_img())
+        assert out.shape == (24, 24, 3)
+
+    def test_grayscale(self):
+        assert T.to_grayscale(_img(), 3).shape == (32, 48, 3)
+
+
+class TestModels:
+    @pytest.mark.parametrize("factory,ch", [
+        (lambda: models.vgg11(num_classes=10), 10),
+        (lambda: models.mobilenet_v1(scale=0.25, num_classes=10), 10),
+        (lambda: models.mobilenet_v2(scale=0.25, num_classes=10), 10),
+        (lambda: models.alexnet(num_classes=10), 10),
+    ])
+    def test_forward_shape(self, factory, ch):
+        paddle.seed(0)
+        net = factory()
+        net.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 64, 64).astype(np.float32))
+        out = net(x)
+        assert tuple(out.shape) == (2, ch)
+
+    def test_pretrained_raises(self):
+        with pytest.raises(NotImplementedError):
+            models.vgg16(pretrained=True)
+
+    def test_resnet_reexported(self):
+        assert models.resnet18 is not None
+        net = models.resnet18(num_classes=7)
+        net.eval()
+        x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        assert tuple(net(x).shape) == (1, 7)
+
+
+def _write_mnist(tmp_path, n=20):
+    imgs = np.random.RandomState(0).randint(
+        0, 256, (n, 28, 28)).astype(np.uint8)
+    labels = (np.arange(n) % 10).astype(np.uint8)
+    ip = str(tmp_path / "img.gz")
+    lp = str(tmp_path / "lab.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return ip, lp
+
+
+def _write_cifar(tmp_path, n=8):
+    data = {b"data": np.random.RandomState(0).randint(
+        0, 256, (n, 3072)).astype(np.uint8),
+        b"labels": list(range(n))}
+    path = str(tmp_path / "cifar.tar.gz")
+    import io as _io
+    with tarfile.open(path, "w:gz") as tf:
+        raw = pickle.dumps(data)
+        info = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+        info.size = len(raw)
+        tf.addfile(info, _io.BytesIO(raw))
+    return path
+
+
+class TestDatasets:
+    def test_mnist_reader(self, tmp_path):
+        ip, lp = _write_mnist(tmp_path)
+        ds = datasets.MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 20
+        img, label = ds[3]
+        assert img.shape == (1, 28, 28) and img.dtype == np.float32
+        assert int(label) == 3
+
+    def test_cifar_reader(self, tmp_path):
+        path = _write_cifar(tmp_path)
+        ds = datasets.Cifar10(data_file=path)
+        assert len(ds) == 8
+        img, label = ds[1]
+        assert img.shape == (3, 32, 32)
+        assert int(label) == 1
+
+    def test_dataset_with_transform_trains(self):
+        """FakeData -> transforms -> hapi Model: one epoch runs."""
+        from paddle_tpu import nn, optimizer
+        tr = T.Compose([T.Resize(16), T.ToTensor()])
+        ds = datasets.FakeData(num_samples=16, shape=(28, 28, 3),
+                               num_classes=4, transform=tr)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(3 * 16 * 16, 4))
+        model = paddle.Model(net)
+        model.prepare(optimizer.SGD(learning_rate=0.1,
+                                    parameters=model.parameters()),
+                      nn.CrossEntropyLoss())
+        model.fit(ds, epochs=1, batch_size=8, verbose=0)
+
+    def test_download_raises(self):
+        with pytest.raises(NotImplementedError):
+            datasets.MNIST(download=True)

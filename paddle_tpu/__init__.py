@@ -47,8 +47,23 @@ from .hapi import Model  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from .nn.initializer import ParamAttr  # noqa: E402,F401
 
+from . import static  # noqa: E402,F401
+
 # paddle-API conveniences
 from .ops.creation import to_tensor  # noqa: E402,F401
+
+
+def enable_static():
+    """Switch to static-graph mode (reference `paddle.enable_static`)."""
+    static._enable_static()
+
+
+def disable_static():
+    static._disable_static()
+
+
+def in_dynamic_mode():
+    return not static.in_static_mode()
 
 DataParallel = None  # bound lazily by paddle_tpu.distributed import
 

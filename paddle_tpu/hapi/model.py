@@ -66,6 +66,10 @@ class Model:
             self._train_step = TrainStep(
                 self.network, lambda out, y: _apply_loss(loss_fn, out, y),
                 self._optimizer)
+            pending = getattr(self, "_pending_ts_state", None)
+            if pending is not None:
+                self._train_step.set_state_dict(pending)
+                self._pending_ts_state = None
         loss = self._train_step(*inputs, *labels)
         return [float(loss)]
 
@@ -149,6 +153,7 @@ class Model:
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 # eval runs the eager network: pull trained weights first
                 self._sync_from_train_step()
+                cbks.on_eval_begin()
                 eval_logs = self._run_eval(eval_loader, cbks)
                 cbks.on_eval_end(eval_logs)
         cbks.on_train_end(logs)
@@ -207,7 +212,12 @@ class Model:
         self._sync_from_train_step()
         io_mod.save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
-            io_mod.save(self._optimizer.state_dict(), path + ".pdopt")
+            opt_sd = self._optimizer.state_dict()
+            # compiled-path slot state lives in TrainStep.opt_state, not in
+            # the eager Optimizer — persist it so resume keeps Adam moments
+            if self._train_step is not None:
+                opt_sd["__compiled__"] = self._train_step.state_dict()
+            io_mod.save(opt_sd, path + ".pdopt")
 
     def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
         state = io_mod.load(path + ".pdparams")
@@ -215,7 +225,9 @@ class Model:
         import os
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(path + ".pdopt"):
-            self._optimizer.set_state_dict(io_mod.load(path + ".pdopt"))
+            opt_sd = io_mod.load(path + ".pdopt")
+            self._pending_ts_state = opt_sd.pop("__compiled__", None)
+            self._optimizer.set_state_dict(opt_sd)
         self._train_step = None
         return self
 

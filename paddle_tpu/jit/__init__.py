@@ -196,6 +196,25 @@ class TrainStep:
             self._t, *arrs)
         return Tensor(loss)
 
+    def state_dict(self):
+        """Optimizer-slot state of the compiled step (for checkpoint/resume)."""
+        flat, _ = jax.tree_util.tree_flatten(self.opt_state)
+        return {"t": self._t,
+                "opt_flat": [np.asarray(x) if isinstance(x, jax.Array) else x
+                             for x in flat]}
+
+    def set_state_dict(self, sd):
+        flat, treedef = jax.tree_util.tree_flatten(self.opt_state)
+        saved = sd["opt_flat"]
+        if len(saved) != len(flat):
+            raise ValueError(
+                f"opt state mismatch: checkpoint has {len(saved)} leaves, "
+                f"model needs {len(flat)}")
+        new_flat = [jnp.asarray(v) if isinstance(o, jax.Array) else v
+                    for o, v in zip(flat, saved)]
+        self.opt_state = jax.tree_util.tree_unflatten(treedef, new_flat)
+        self._t = int(sd["t"])
+
     def sync_to_layer(self):
         """Write compiled-side params back into the eager Layer."""
         named = dict(self.layer.named_parameters())

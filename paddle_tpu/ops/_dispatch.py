@@ -25,6 +25,12 @@ from ..framework.tensor import Tensor
 # impl registry: name -> pure fn (for compiled/functional callers and tests)
 KERNELS: Dict[str, Callable] = {}
 
+# When non-None, every op call is recorded into the active static Program
+# instead of executing eagerly (reference: static mode appends an OpDesc to
+# the current Block, `python/paddle/fluid/framework.py` Block.append_op).
+# Set/cleared by paddle_tpu.static.
+GRAPH_BUILDER = None
+
 
 def kernel(name: str):
     """Register a pure-array kernel (phi `PD_REGISTER_KERNEL` equivalent)."""
@@ -61,6 +67,8 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
     """
     kwargs = kwargs or {}
     name = name or getattr(impl, "_op_name", impl.__name__)
+    if GRAPH_BUILDER is not None:
+        return GRAPH_BUILDER(impl, tensors, kwargs, name)
     arrs = tuple(_unwrap(t) for t in tensors)
 
     arrs = _maybe_autocast(name, arrs)

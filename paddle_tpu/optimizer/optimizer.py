@@ -27,7 +27,10 @@ class Optimizer:
         self._learning_rate = learning_rate
         self._parameter_list = list(parameters) if parameters is not None else None
         if self._parameter_list is None:
-            raise ValueError("parameters is required in dygraph mode")
+            from ..static import in_static_mode
+            if not in_static_mode():
+                raise ValueError("parameters is required in dygraph mode")
+            self._parameter_list = []
         self._grad_clip = grad_clip
         if weight_decay is None:
             self._weight_decay = 0.0
@@ -96,6 +99,14 @@ class Optimizer:
 
     # paddle legacy API
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static import Variable, append_backward
+        if isinstance(loss, Variable):
+            # static mode: attach this optimizer to the program; Executor
+            # compiles fwd+bwd+update into one XLA executable
+            pairs = append_backward(loss, parameters)
+            loss._prog.optimizer = self
+            loss._prog.version += 1
+            return [], pairs
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,0 +1,745 @@
+"""paddle_tpu.static — static-graph mode (Program / Executor).
+
+TPU-native redesign of the reference's static graph stack:
+  * `Program` (reference `ProgramDesc`, `framework/framework.proto:236`;
+    python `fluid/framework.py:4722`) — here a recorded op-graph over
+    symbolic `Variable`s. Shape/dtype inference (the reference's infermeta,
+    `paddle/phi/infermeta/`) is `jax.eval_shape` — XLA abstract evaluation.
+  * `Executor` (reference `fluid/executor.py:613` + the C++
+    StandaloneExecutor/InterpreterCore, `new_executor/interpretercore.h`) —
+    here the whole Program (forward, backward, optimizer update) is replayed
+    into ONE jitted pure function: XLA's scheduler plays the role of the
+    InterpreterCore dependency-graph async executor, and buffer donation
+    plays the role of its garbage collector.
+  * `append_backward` (reference `fluid/backward.py`) — grad vars come from
+    `jax.grad` over the replayed forward instead of per-op grad-op chaining.
+  * `save/load_inference_model` (reference `fluid/io.py:1246,1466`) — the
+    serialized artifact is a StableHLO export (`jax.export`) + params.
+
+Op capture: every eager op routes through `ops._dispatch.call`; in static
+mode a builder hook records the op into the current Program instead of
+executing it (the reference's `Block.append_op` path when
+`in_dygraph_mode()` is false).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.param import Parameter
+from ..framework.tensor import Tensor
+from ..ops import _dispatch
+
+
+# ---------------------------------------------------------------------------
+# Symbolic Variable
+# ---------------------------------------------------------------------------
+class Variable(Tensor):
+    """Symbolic tensor in a Program (reference `fluid/framework.py:1171`).
+
+    Carries only an abstract value (shape/dtype); `.data` yields the aval so
+    shape/dtype accessors keep working, while any attempt to read concrete
+    values raises.
+    """
+
+    def __init__(self, aval, prog: "Program", vid: int, name: Optional[str] = None):
+        # deliberately no super().__init__: no concrete array exists
+        self._aval = aval
+        self._prog = prog
+        self._vid = vid
+        self.stop_gradient = True
+        self.grad = None
+        self._node = None
+        self.name = name or f"var_{vid}"
+        self.persistable = False
+
+    # Tensor API reads .data for shape/dtype — serve the aval.
+    @property
+    def data(self):
+        return self._aval
+
+    @data.setter
+    def data(self, v):
+        raise RuntimeError("cannot assign data to a static Variable")
+
+    @property
+    def shape(self):
+        return list(self._aval.shape)
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._aval.dtype)
+
+    @property
+    def ndim(self):
+        return len(self._aval.shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            "Variable has no value in static mode; fetch it via Executor.run")
+
+    __array__ = numpy
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    def backward(self, *a, **kw):
+        raise RuntimeError("use append_backward/optimizer.minimize in static mode")
+
+
+class _OpNode:
+    """One recorded op (reference OpDesc, `framework/framework.proto:50`)."""
+    __slots__ = ("impl", "kwargs", "inputs", "out_ids", "name")
+
+    def __init__(self, impl, kwargs, inputs, out_ids, name):
+        self.impl = impl          # pure array fn
+        self.kwargs = kwargs      # static attrs
+        self.inputs = inputs      # list of ("var", vid) | ("const", array)
+        self.out_ids = out_ids    # list of vids
+        self.name = name
+
+
+class Program:
+    """Recorded static graph (reference ProgramDesc / `framework.py:4722`)."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self.id = Program._counter
+        self.ops: List[_OpNode] = []
+        self.vars: Dict[int, Any] = {}           # vid -> aval
+        self.var_names: Dict[str, int] = {}      # name -> vid (feedables/fetchables)
+        self.inputs: Dict[str, int] = {}         # feed name -> vid
+        self.params: Dict[str, np.ndarray] = {}  # param name -> init value
+        self.param_vids: Dict[str, int] = {}     # param name -> vid
+        self._param_objs: Dict[int, str] = {}    # id(Parameter) -> name
+        # strong refs: without these a dead Parameter's id() can be reused by
+        # a new one and alias it to the wrong program var
+        self._param_refs: Dict[str, Any] = {}
+        self.dyn_dims: Dict[str, tuple] = {}     # feed name -> dynamic dim idxs
+        self.loss_vid: Optional[int] = None
+        self.grad_vids: Dict[int, str] = {}      # grad vid -> param name
+        self.optimizer = None
+        self.version = 0                         # bumped per mutation for jit cache
+        self._next_vid = 0
+        self.random_seed = 0
+
+    # -- construction --------------------------------------------------------
+    def _new_var(self, aval, name: Optional[str] = None) -> Variable:
+        vid = self._next_vid
+        self._next_vid += 1
+        v = Variable(aval, self, vid, name)
+        self.vars[vid] = aval
+        if v.name:
+            self.var_names[v.name] = vid
+        self.version += 1
+        return v
+
+    def _intern_input(self, t):
+        """Map an op input to a recorded reference."""
+        if isinstance(t, Variable):
+            return ("var", t._vid)
+        if isinstance(t, Parameter):
+            name = self._param_objs.get(id(t))
+            if name is None:
+                name = t.name or f"param_{len(self.params)}"
+                while name in self.params:
+                    name = name + "_"
+                self._param_objs[id(t)] = name
+                self._param_refs[name] = t
+                self.params[name] = np.asarray(t.data)
+                pv = self._new_var(
+                    jax.ShapeDtypeStruct(t.data.shape, t.data.dtype), name)
+                self.param_vids[name] = pv._vid
+            return ("var", self.param_vids[name])
+        if isinstance(t, Tensor):
+            return ("const", t.data)
+        if isinstance(t, jax.Array):
+            return ("const", t)
+        if t is None:
+            return ("const", None)
+        a = np.asarray(t)
+        if a.dtype == np.float64:
+            a = a.astype(dtype_mod.get_default_dtype())
+        return ("const", jnp.asarray(a))
+
+    def append_op(self, impl, tensors, kwargs, name):
+        inputs = [self._intern_input(t) for t in tensors]
+        avals_in = [self.vars[ref[1]] if ref[0] == "var" else ref[1]
+                    for ref in inputs]
+        out_aval = jax.eval_shape(functools.partial(impl, **kwargs), *avals_in)
+        multi = isinstance(out_aval, tuple)
+        out_avals = out_aval if multi else (out_aval,)
+        outs = tuple(self._new_var(a) for a in out_avals)
+        self.ops.append(_OpNode(impl, kwargs, inputs, [o._vid for o in outs], name))
+        return outs if multi else outs[0]
+
+    # -- introspection (parity helpers) --------------------------------------
+    def all_parameters(self):
+        return [ParamVarView(self, n) for n in self.params]
+
+    def list_vars(self):
+        return [Variable(self.vars[vid], self, vid, n)
+                for n, vid in self.var_names.items()]
+
+    def global_block(self):
+        return _BlockView(self)
+
+    def clone(self, for_test: bool = False):
+        p = Program.__new__(Program)
+        p.__dict__.update(self.__dict__)
+        Program._counter += 1
+        p.id = Program._counter  # fresh id: Executor cache keys on (id, version)
+        p.ops = list(self.ops)
+        p.vars = dict(self.vars)
+        p.var_names = dict(self.var_names)
+        p.inputs = dict(self.inputs)
+        p.params = dict(self.params)
+        p.param_vids = dict(self.param_vids)
+        p._param_objs = dict(self._param_objs)
+        p._param_refs = dict(self._param_refs)
+        p.grad_vids = dict(self.grad_vids)
+        p.dyn_dims = dict(self.dyn_dims)
+        if for_test:
+            p.optimizer = None
+        return p
+
+    def __repr__(self):
+        return (f"Program(id={self.id}, ops={len(self.ops)}, "
+                f"params={list(self.params)})")
+
+    # -- replay: Program -> pure function ------------------------------------
+    def _prune_ops(self, target_vids):
+        """Backward slice: ops needed to produce target_vids (reference
+        `framework/prune.cc`)."""
+        needed = set(target_vids)
+        keep = []
+        for node in reversed(self.ops):
+            if any(o in needed for o in node.out_ids):
+                keep.append(node)
+                for r in node.inputs:
+                    if r[0] == "var":
+                        needed.add(r[1])
+        return list(reversed(keep)), needed
+
+    def build_forward(self, prune_to=None):
+        """Return fn(feed_dict_by_name, params_by_name) -> env {vid: array}.
+
+        With `prune_to` (a list of target vids), only the backward slice of
+        ops producing them is replayed — unfed feed slots outside the slice
+        are then legal (inference export drops the label input).
+        """
+        ops = self.ops if prune_to is None else self._prune_ops(prune_to)[0]
+
+        def forward(feeds: Dict[str, Any], params: Dict[str, Any]):
+            env: Dict[int, Any] = {}
+            for name, vid in self.inputs.items():
+                if name in feeds:
+                    env[vid] = feeds[name]
+            for name, vid in self.param_vids.items():
+                env[vid] = params[name]
+            for node in ops:
+                args = []
+                for r in node.inputs:
+                    if r[0] == "var":
+                        if r[1] not in env:
+                            fname = next((n for n, v in self.inputs.items()
+                                          if v == r[1]), None)
+                            raise KeyError(
+                                f"program input '{fname}' is required by op "
+                                f"'{node.name}' but was not fed" if fname else
+                                f"internal var {r[1]} undefined before op "
+                                f"'{node.name}'")
+                        args.append(env[r[1]])
+                    else:
+                        args.append(r[1])
+                out = node.impl(*args, **node.kwargs)
+                outs = out if isinstance(out, tuple) else (out,)
+                for vid, o in zip(node.out_ids, outs):
+                    env[vid] = o
+            return env
+        return forward
+
+
+class ParamVarView:
+    """Parameter handle inside a Program (persistable var)."""
+
+    def __init__(self, prog, name):
+        self._prog = prog
+        self.name = name
+        self.persistable = True
+
+    @property
+    def shape(self):
+        return list(self._prog.params[self.name].shape)
+
+    @property
+    def dtype(self):
+        return self._prog.params[self.name].dtype
+
+
+class _BlockView:
+    def __init__(self, prog):
+        self.program = prog
+
+    @property
+    def ops(self):
+        return self.program.ops
+
+    def var(self, name):
+        vid = self.program.var_names[name]
+        return Variable(self.program.vars[vid], self.program, vid, name)
+
+
+# ---------------------------------------------------------------------------
+# default programs / program_guard / static-mode switch
+# ---------------------------------------------------------------------------
+_main_program = Program()
+_startup_program = Program()
+_static_mode = False
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+class _Builder:
+    """The dispatch hook: routes op calls into the active main program."""
+
+    def __call__(self, impl, tensors, kwargs, name):
+        return _main_program.append_op(impl, tensors, kwargs, name)
+
+
+_builder = _Builder()
+
+
+def _enable_static():
+    global _static_mode
+    _static_mode = True
+    _dispatch.GRAPH_BUILDER = _builder
+
+
+def _disable_static():
+    global _static_mode
+    _static_mode = False
+    _dispatch.GRAPH_BUILDER = None
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    global _main_program, _startup_program
+    prev_m, prev_s = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+        startup_program._main = main_program
+    try:
+        yield
+    finally:
+        _main_program = prev_m
+        _startup_program = prev_s
+
+
+# ---------------------------------------------------------------------------
+# graph inputs
+# ---------------------------------------------------------------------------
+def data(name: str, shape: Sequence[Optional[int]], dtype=None,
+         lod_level: int = 0) -> Variable:
+    """Declare a feed slot (reference `paddle.static.data`).
+
+    `None`/-1 leading dims become a default batch dim of 1 for abstract
+    evaluation; Executor re-jits per concrete feed shape (XLA wants static
+    shapes — this is the padding/bucketing policy boundary).
+    """
+    dtype = dtype_mod.convert_dtype(dtype) if dtype is not None \
+        else dtype_mod.get_default_dtype()
+    shp = tuple(1 if (s is None or (isinstance(s, int) and s < 0)) else int(s)
+                for s in shape)
+    prog = _main_program
+    v = prog._new_var(jax.ShapeDtypeStruct(shp, dtype), name)
+    prog.inputs[name] = v._vid
+    prog.dyn_dims[name] = tuple(
+        i for i, s in enumerate(shape)
+        if s is None or (isinstance(s, int) and s < 0))
+    return v
+
+
+class InputSpec:
+    """Shape/dtype spec (reference `paddle/static/input.py` InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(t.shape, t.dtype, name or t.name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Mark loss + create grad vars (reference `fluid/backward.py`).
+
+    Grad values are produced by `jax.grad` of the replayed forward at run
+    time; here we only allocate the symbolic grad vars so they can be
+    fetched, mirroring `append_backward`'s (param, grad) return.
+    """
+    prog = loss._prog
+    prog.loss_vid = loss._vid
+    pairs = []
+    names = (parameter_list if parameter_list is not None
+             else list(prog.params.keys()))
+    names = [n.name if isinstance(n, ParamVarView) else n for n in names]
+    for name in names:
+        aval = prog.vars[prog.param_vids[name]]
+        g = prog._new_var(jax.ShapeDtypeStruct(aval.shape, aval.dtype),
+                          name + "@GRAD")
+        prog.grad_vids[g._vid] = name
+        pairs.append((ParamVarView(prog, name), g))
+    prog.version += 1
+    return pairs
+
+
+# ---------------------------------------------------------------------------
+# Scope
+# ---------------------------------------------------------------------------
+class Scope:
+    """Name -> value store for persistables (reference `framework/scope.h`)."""
+
+    def __init__(self):
+        self.vars: Dict[str, Any] = {}
+
+    def find_var(self, name):
+        if name not in self.vars:
+            return None
+        val = self.vars[name]
+
+        class _Var:
+            def get_tensor(self_inner):
+                return np.asarray(val)
+        return _Var()
+
+    def var(self, name):
+        self.vars.setdefault(name, None)
+        return self.find_var(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+class Executor:
+    """Compile-and-run a Program (reference `fluid/executor.py:613`).
+
+    One XLA executable per (program version, feed signature, fetch set,
+    train-mode) — the TPU answer to InterpreterCore's first-run
+    instruction-list build + cached re-run.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, Callable] = {}
+
+    # -- startup -------------------------------------------------------------
+    def _run_startup(self, prog: Program, scope: Scope):
+        main = getattr(prog, "_main", None) or prog
+        for name, init in main.params.items():
+            scope.vars[name] = jnp.asarray(init)
+        opt = main.optimizer
+        if opt is not None:
+            scope.vars.pop(f"__opt_state_{main.id}__", None)
+            scope.vars.pop(f"__opt_t_{main.id}__", None)
+
+    def _ensure_params(self, prog: Program, scope: Scope):
+        for name, init in prog.params.items():
+            if scope.vars.get(name) is None:
+                scope.vars[name] = jnp.asarray(init)
+
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[list] = None, scope: Optional[Scope] = None,
+            return_numpy: bool = True):
+        prog = program if program is not None else _main_program
+        scope = scope or _global_scope
+        feed = feed or {}
+
+        if isinstance(prog, _ExportedProgram):
+            return prog.run(feed, fetch_list, return_numpy)
+
+        # startup program: no ops, not the feed target -> initialize
+        if not prog.ops and (getattr(prog, "_main", None) is not None
+                             or not fetch_list):
+            self._run_startup(prog, scope)
+            return []
+
+        self._ensure_params(prog, scope)
+        fetch_list = fetch_list or []
+        fetch_ids = []
+        for f in fetch_list:
+            if isinstance(f, Variable):
+                fetch_ids.append(f._vid)
+            elif isinstance(f, str):
+                fetch_ids.append(prog.var_names[f])
+            else:
+                raise TypeError(f"bad fetch entry: {f!r}")
+
+        unknown = [k for k in feed if k not in prog.inputs]
+        if unknown:
+            raise ValueError(
+                f"feed names {unknown} not found in program inputs "
+                f"{sorted(prog.inputs)}")
+        feed_arrs = {k: (v.data if isinstance(v, Tensor) else jnp.asarray(v))
+                     for k, v in feed.items()}
+        sig = tuple(sorted((k, tuple(a.shape), str(a.dtype))
+                           for k, a in feed_arrs.items()))
+        train = prog.optimizer is not None
+        need_grads = train or any(vid in prog.grad_vids for vid in fetch_ids)
+        key = (prog.id, prog.version, sig, tuple(fetch_ids), train, need_grads)
+
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._compile(prog, fetch_ids, train, need_grads)
+            self._cache[key] = fn
+
+        params = {n: scope.vars[n] for n in prog.params}
+        opt_key = f"__opt_state_{prog.id}__"
+        t_key = f"__opt_t_{prog.id}__"
+        if train:
+            opt = prog.optimizer
+            if scope.vars.get(opt_key) is None:
+                scope.vars[opt_key] = opt.init_state_tree(params)
+                scope.vars[t_key] = 0
+            scope.vars[t_key] += 1
+            t = scope.vars[t_key]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            fetches, new_params, new_opt = fn(feed_arrs, params,
+                                              scope.vars[opt_key], lr, t)
+            scope.vars[opt_key] = new_opt
+            for n, v in new_params.items():
+                scope.vars[n] = v
+            if hasattr(opt, "_learning_rate") and hasattr(
+                    opt._learning_rate, "step") and callable(
+                    getattr(opt._learning_rate, "step", None)):
+                pass  # schedulers advance via user .step() as in dygraph
+        else:
+            fetches = fn(feed_arrs, params)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    # -- compile -------------------------------------------------------------
+    def _compile(self, prog: Program, fetch_ids, train: bool, need_grads: bool):
+        targets = [v for v in fetch_ids if v not in prog.grad_vids]
+        if (train or need_grads) and prog.loss_vid is not None:
+            targets.append(prog.loss_vid)
+        forward = prog.build_forward(prune_to=targets)
+        grad_names = list(prog.params.keys())
+
+        def run_forward(feeds, params):
+            env = forward(feeds, params)
+            if need_grads:
+                def loss_of(p):
+                    e = forward(feeds, p)
+                    return e[prog.loss_vid]
+                grads = jax.grad(loss_of)(params)
+                for gvid, pname in prog.grad_vids.items():
+                    env[gvid] = grads[pname]
+            else:
+                grads = None
+            return env, grads
+
+        if not train:
+            @jax.jit
+            def fn(feeds, params):
+                env, _ = run_forward(feeds, params)
+                return [env[v] for v in fetch_ids]
+            return fn
+
+        opt = prog.optimizer
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def fn(feeds, params, opt_state, lr, t):
+            env, grads = run_forward(feeds, params)
+            if grads is None:
+                def loss_of(p):
+                    e = forward(feeds, p)
+                    return e[prog.loss_vid]
+                grads = jax.grad(loss_of)(params)
+            new_params, new_opt = opt.apply_fn(params, grads, opt_state,
+                                               lr=lr, t=t)
+            return [env[v] for v in fetch_ids], new_params, new_opt
+        return fn
+
+    def close(self):
+        self._cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# CompiledProgram (parity shim — jit IS the compilation)
+# ---------------------------------------------------------------------------
+class BuildStrategy:
+    def __init__(self):
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.fuse_all_optimizer_ops = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+    def with_data_parallel(self, *a, **kw):
+        return self
+
+
+# ---------------------------------------------------------------------------
+# inference model save/load (reference fluid/io.py:1246,1466)
+# ---------------------------------------------------------------------------
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program: Optional[Program] = None, **kw):
+    prog = program or _main_program
+    scope = _global_scope
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    feed_names = [v.name for v in feed_vars]
+    fetch_ids = [v._vid for v in fetch_vars]
+    forward = prog.build_forward(prune_to=fetch_ids)
+    params = {n: (scope.vars[n] if scope.vars.get(n) is not None
+                  else jnp.asarray(init))
+              for n, init in prog.params.items()}
+
+    def infer_fn(params, *feed_arrays):
+        feeds = dict(zip(feed_names, feed_arrays))
+        env = forward(feeds, params)
+        return tuple(env[v] for v in fetch_ids)
+
+    from jax import export as jexport
+
+    def _specs(symbolic: bool):
+        # dynamic dims (declared None/-1 in static.data) export shape-
+        # polymorphically; dim 0 shares one "batch" symbol across feeds
+        sym_names: List[str] = []
+        for n in feed_names:
+            for i in prog.dyn_dims.get(n, ()):
+                s = "batch" if i == 0 else f"d_{n}_{i}"
+                if symbolic and s not in sym_names:
+                    sym_names.append(s)
+        syms = dict(zip(sym_names, jexport.symbolic_shape(
+            ", ".join(sym_names)))) if (symbolic and sym_names) else {}
+        out = []
+        for n in feed_names:
+            aval = prog.vars[prog.inputs[n]]
+            dims = list(aval.shape)
+            for i in prog.dyn_dims.get(n, ()):
+                key = "batch" if i == 0 else f"d_{n}_{i}"
+                if key in syms:
+                    dims[i] = syms[key]
+            out.append(jax.ShapeDtypeStruct(tuple(dims), aval.dtype))
+        return out
+
+    param_specs = {n: jax.ShapeDtypeStruct(p.shape, p.dtype)
+                   for n, p in params.items()}
+    try:
+        exp = jexport.export(jax.jit(infer_fn))(param_specs, *_specs(True))
+    except Exception:
+        # not all graphs are shape-polymorphic; fall back to the static shapes
+        exp = jexport.export(jax.jit(infer_fn))(param_specs, *_specs(False))
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({n: np.asarray(p) for n, p in params.items()}, f)
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump({"feed_names": feed_names,
+                     "fetch_count": len(fetch_ids)}, f)
+
+
+class _ExportedProgram:
+    """Loaded inference artifact; Executor.run dispatches to it."""
+
+    def __init__(self, exported, params, feed_names):
+        self.exported = exported
+        self.params = params
+        self.feed_names = feed_names
+
+    def run(self, feed, fetch_list, return_numpy=True):
+        args = [jnp.asarray(feed[n]) for n in self.feed_names]
+        outs = self.exported.call(self.params, *args)
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+
+def load_inference_model(path_prefix: str, executor, **kw):
+    from jax import export as jexport
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        params = {n: jnp.asarray(p) for n, p in pickle.load(f).items()}
+    with open(path_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    prog = _ExportedProgram(exported, params, meta["feed_names"])
+    fetch_names = list(range(meta["fetch_count"]))
+    return [prog, meta["feed_names"], fetch_names]
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program
+
+
+# re-exports for `paddle.static.*` parity
+from . import nn  # noqa: E402,F401
+
+__all__ = [
+    "Program", "Variable", "Executor", "Scope", "CompiledProgram",
+    "BuildStrategy", "ExecutionStrategy", "InputSpec", "append_backward",
+    "data", "default_main_program", "default_startup_program",
+    "global_scope", "scope_guard", "program_guard", "save_inference_model",
+    "load_inference_model", "normalize_program", "nn",
+]

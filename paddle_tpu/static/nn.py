@@ -1,0 +1,101 @@
+"""paddle_tpu.static.nn — static-graph layer builders.
+
+Reference: `paddle.static.nn` (`python/paddle/static/nn/common.py` — fc,
+embedding, conv2d, batch_norm, ...). Each builder creates concrete
+`Parameter`s (the startup-program initializer role) and emits ops into the
+current Program through the normal functional API; parameters are interned
+as persistable program vars on first use.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.param import Parameter
+from ..nn import functional as F
+from ..nn import initializer as I
+
+_uid = [0]
+
+
+def _pname(base: str) -> str:
+    _uid[0] += 1
+    return f"{base}_{_uid[0]}"
+
+
+def _make_param(shape, dtype, attr, default_init, base):
+    init = default_init
+    name = None
+    if isinstance(attr, I.ParamAttr):
+        name = attr.name
+        if attr.initializer is not None:
+            init = attr.initializer
+    elif isinstance(attr, I.Initializer):
+        init = attr
+    dtype = dtype_mod.convert_dtype(dtype) if dtype else dtype_mod.get_default_dtype()
+    data = init(tuple(shape), dtype)
+    p = Parameter(data, name=name or _pname(base))
+    return p
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+       bias_attr=None, activation: Optional[str] = None, name=None):
+    """Fully-connected layer (reference `static/nn/common.py` fc)."""
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    from .. import ops
+    if len(x.shape) > num_flatten_dims + 1:
+        x = ops.reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+    w = _make_param([in_dim, size], x.dtype, weight_attr,
+                    I.XavierUniform(), "fc_w")
+    b = None
+    if bias_attr is not False:
+        b = _make_param([size], x.dtype, bias_attr, I.Constant(0.0), "fc_b")
+    out = F.linear(x, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    w = _make_param(list(size), dtype, param_attr,
+                    I.Normal(std=0.02), "emb_w")
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    w = _make_param([num_filters, in_ch // groups] + list(ks), input.dtype,
+                    param_attr, I.KaimingUniform(), "conv_w")
+    b = None
+    if bias_attr is not False:
+        b = _make_param([num_filters], input.dtype, bias_attr,
+                        I.Constant(0.0), "conv_b")
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = _make_param([ch], input.dtype, param_attr, I.Constant(1.0), "bn_scale")
+    offset = _make_param([ch], input.dtype, bias_attr, I.Constant(0.0), "bn_offset")
+    mean = Parameter(I.Constant(0.0)((ch,), input.dtype), name=_pname("bn_mean"))
+    var = Parameter(I.Constant(1.0)((ch,), input.dtype), name=_pname("bn_var"))
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    out = F.batch_norm(input, mean, var, weight=scale, bias=offset,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out

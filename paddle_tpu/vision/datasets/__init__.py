@@ -27,8 +27,9 @@ __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
 class FakeData(Dataset):
     """Deterministic synthetic images (reference test-fixture pattern)."""
 
-    def __init__(self, num_samples=100, shape=(3, 32, 32), num_classes=10,
+    def __init__(self, num_samples=100, shape=(32, 32, 3), num_classes=10,
                  transform: Optional[Callable] = None):
+        # HWC default: transforms (ToTensor/Resize/...) expect HWC input
         self.num_samples = num_samples
         self.shape = tuple(shape)
         self.num_classes = num_classes

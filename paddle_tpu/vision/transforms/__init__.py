@@ -104,8 +104,8 @@ def pad(img, padding, fill=0, padding_mode="constant"):
 
 def normalize(img, mean, std, data_format="CHW", to_rgb=False):
     img = np.asarray(img, dtype=np.float32)
-    mean = np.asarray(mean, np.float32)
-    std = np.asarray(std, np.float32)
+    mean = np.atleast_1d(np.asarray(mean, np.float32))
+    std = np.atleast_1d(np.asarray(std, np.float32))
     if data_format == "CHW":
         return (img - mean[:, None, None]) / std[:, None, None]
     return (img - mean) / std

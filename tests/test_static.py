@@ -1,0 +1,125 @@
+"""Static-graph mode: Program/Executor/append_backward/inference model.
+
+Mirrors the reference's static tests (e.g.
+`python/paddle/fluid/tests/unittests/test_executor_and_use_program_cache.py`
+style: build Program, run Executor with feed/fetch, assert numerics).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def static_mode():
+    paddle.seed(0)
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _build_mlp():
+    main = paddle.static.Program()
+    startup = paddle.static.Program()
+    with paddle.static.program_guard(main, startup):
+        x = paddle.static.data("x", [None, 4], "float32")
+        y = paddle.static.data("y", [None, 1], "float32")
+        h = paddle.static.nn.fc(x, 8, activation="relu")
+        pred = paddle.static.nn.fc(h, 1)
+        loss = paddle.mean((pred - y) ** 2)
+    return main, startup, x, y, pred, loss
+
+
+def test_forward_fetch(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [None, 3], "float32")
+        out = paddle.exp(x) + 1.0
+    exe = paddle.static.Executor()
+    xs = np.random.randn(5, 3).astype(np.float32)
+    res, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(res, np.exp(xs) + 1.0, rtol=1e-5)
+
+
+def test_training_converges(static_mode):
+    main, startup, x, y, pred, loss = _build_mlp()
+    with paddle.static.program_guard(main, startup):
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = paddle.static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 4).astype(np.float32)
+    ys = (xs.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    losses = [float(exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss])[0]) for _ in range(50)]
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_append_backward_grads(static_mode):
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [2, 3], "float32")
+        w_t = paddle.ones([3, 3])
+        import paddle_tpu.static.nn as snn
+        h = snn.fc(x, 3, bias_attr=False)
+        loss = paddle.sum(h)
+        pairs = paddle.static.append_backward(loss)
+    assert len(pairs) == 1
+    p, g = pairs[0]
+    exe = paddle.static.Executor()
+    xs = np.ones((2, 3), np.float32)
+    gval, = exe.run(main, feed={"x": xs}, fetch_list=[g])
+    # d(sum(x@W))/dW = x^T @ ones = col-sums of x broadcast
+    np.testing.assert_allclose(gval, np.full((3, 3), 2.0), rtol=1e-5)
+
+
+def test_startup_reinitializes(static_mode):
+    main, startup, x, y, pred, loss = _build_mlp()
+    with paddle.static.program_guard(main, startup):
+        paddle.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = paddle.static.Executor()
+    exe.run(startup)
+    scope = paddle.static.global_scope()
+    name = next(iter(main.params))
+    before = np.asarray(scope.vars[name]).copy()
+    xs = np.random.randn(8, 4).astype(np.float32)
+    ys = np.random.randn(8, 1).astype(np.float32)
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    after_step = np.asarray(scope.vars[name])
+    assert not np.allclose(before, after_step)
+    exe.run(startup)  # re-init resets
+    np.testing.assert_allclose(np.asarray(scope.vars[name]), before)
+
+
+def test_save_load_inference_model(static_mode, tmp_path):
+    main = paddle.static.Program()
+    startup = paddle.static.Program()
+    with paddle.static.program_guard(main, startup):
+        x = paddle.static.data("x", [4, 6], "float32")
+        out = paddle.static.nn.fc(x, 2)
+    exe = paddle.static.Executor()
+    exe.run(startup)
+    xs = np.random.randn(4, 6).astype(np.float32)
+    want, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    prefix = str(tmp_path / "model")
+    paddle.static.save_inference_model(prefix, [x], [out], exe, program=main)
+    prog, feed_names, fetch_names = paddle.static.load_inference_model(prefix, exe)
+    got, = exe.run(prog, feed={"x": xs}, fetch_list=fetch_names)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_clone_for_test_drops_optimizer(static_mode):
+    main, startup, x, y, pred, loss = _build_mlp()
+    with paddle.static.program_guard(main, startup):
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert test_prog.optimizer is None and main.optimizer is not None
+
+
+def test_eager_mode_restored():
+    paddle.enable_static()
+    paddle.disable_static()
+    t = paddle.ones([2, 2]) * 3.0
+    assert float(t.numpy().sum()) == 12.0
+    assert paddle.in_dynamic_mode()

@@ -48,6 +48,9 @@ from . import vision  # noqa: E402,F401
 from .nn.initializer import ParamAttr  # noqa: E402,F401
 
 from . import static  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
 
 # paddle-API conveniences
 from .ops.creation import to_tensor  # noqa: E402,F401

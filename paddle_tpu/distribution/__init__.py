@@ -1,0 +1,24 @@
+"""paddle.distribution equivalent.
+
+Reference parity: `python/paddle/distribution/__init__.py` — exports the base
+class, concrete distributions, transforms, and the KL table.
+"""
+from .distribution import Distribution, kl_divergence, register_kl
+from .distributions import (Beta, Categorical, Dirichlet, ExponentialFamily,
+                            Independent, Multinomial, Normal,
+                            TransformedDistribution, Uniform)
+from .transform import (AbsTransform, AffineTransform, ChainTransform,
+                        ExpTransform, IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform, SoftmaxTransform,
+                        StackTransform, StickBreakingTransform, TanhTransform,
+                        Transform, Type)
+
+__all__ = [
+    'Distribution', 'ExponentialFamily', 'Normal', 'Uniform', 'Categorical',
+    'Multinomial', 'Beta', 'Dirichlet', 'Independent',
+    'TransformedDistribution', 'kl_divergence', 'register_kl',
+    'Transform', 'Type', 'AbsTransform', 'AffineTransform', 'ChainTransform',
+    'ExpTransform', 'IndependentTransform', 'PowerTransform',
+    'ReshapeTransform', 'SigmoidTransform', 'SoftmaxTransform',
+    'StackTransform', 'StickBreakingTransform', 'TanhTransform',
+]

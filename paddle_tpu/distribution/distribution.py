@@ -1,0 +1,159 @@
+"""Probability distribution base + KL registry.
+
+Reference parity: `python/paddle/distribution/distribution.py:40` (base class),
+`python/paddle/distribution/kl.py:32,64` (kl_divergence / register_kl dispatch).
+TPU-native: distribution parameters are held as framework Tensors and every
+method routes its math through `paddle_tpu.ops._dispatch.call`, so
+log_prob/rsample/entropy/kl_divergence all record on the eager autograd tape —
+`loss.backward()` reaches the parameters exactly as through any nn op.
+`rsample` is reparameterized (pathwise) where the reference's sampler is.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as random_mod
+from ..framework.tensor import Tensor
+from ..ops import _dispatch as _d
+
+
+def _t(x) -> Tensor:
+    """Coerce to a framework Tensor (preserving autograd identity), promoting
+    non-float inputs to float32 (distribution params are continuous)."""
+    if isinstance(x, Tensor):
+        return x
+    a = jnp.asarray(x)
+    if not (jnp.issubdtype(a.dtype, jnp.floating)
+            or jnp.issubdtype(a.dtype, jnp.complexfloating)):
+        a = a.astype(jnp.float32)
+    return Tensor(a)
+
+
+def _arr(x, dtype=None):
+    """Unwrap to a raw jnp array (no tape) — for shape/dtype inspection and
+    non-differentiable paths only."""
+    if isinstance(x, Tensor):
+        x = x.data
+    a = jnp.asarray(x)
+    if dtype is None and not (jnp.issubdtype(a.dtype, jnp.floating)
+                              or jnp.issubdtype(a.dtype, jnp.complexfloating)):
+        a = a.astype(jnp.float32)
+    if dtype is not None:
+        a = a.astype(dtype)
+    return a
+
+
+def _call(name, impl, *tensors, nondiff=False):
+    """Run a pure-array impl through the op tape (phi-kernel equivalent)."""
+    return _d.call(impl, tensors, name=name, nondiff=nondiff)
+
+
+def _wrap(a):
+    return Tensor(a) if isinstance(a, jax.Array) else a
+
+
+def _shape_tuple(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, jnp.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Abstract base (reference `distribution.py:40`)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape_tuple(batch_shape)
+        self._event_shape = _shape_tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        """Non-differentiable draw (detached)."""
+        out = self.rsample(shape)
+        if isinstance(out, Tensor):
+            out = out.detach()
+        return out
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return _call("dist_prob", jnp.exp, lp)
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return _shape_tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def _next_key(self):
+        return random_mod.next_key()
+
+
+# ---------------------------------------------------------------------------
+# KL registry (reference kl.py)
+# ---------------------------------------------------------------------------
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a pairwise KL implementation (`kl.py:64`)."""
+    if not (issubclass(cls_p, Distribution) and issubclass(cls_q, Distribution)):
+        raise TypeError('cls_p and cls_q must be subclass of Distribution')
+
+    def decorator(f):
+        _KL_REGISTRY[(cls_p, cls_q)] = f
+        _dispatch.cache_clear()  # new entries must be visible to past misses
+        return f
+    return decorator
+
+
+@functools.lru_cache(maxsize=None)
+def _dispatch(cls_p, cls_q):
+    matches = [(p, q) for (p, q) in _KL_REGISTRY
+               if issubclass(cls_p, p) and issubclass(cls_q, q)]
+    if not matches:
+        return None
+    # most-derived match wins
+    def key(pq):
+        p, q = pq
+        return (len(p.__mro__), len(q.__mro__))
+    return _KL_REGISTRY[max(matches, key=key)]
+
+
+def kl_divergence(p, q):
+    """KL(p || q) via the registered pairwise table (`kl.py:32`)."""
+    fn = _dispatch(type(p), type(q))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)

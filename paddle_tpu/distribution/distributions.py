@@ -1,0 +1,500 @@
+"""Concrete distributions.
+
+Reference parity: `python/paddle/distribution/{normal,uniform,categorical,
+multinomial,beta,dirichlet,exponential_family,independent,
+transformed_distribution}.py`. Parameters are framework Tensors; every method
+body is a pure-array impl executed through the op-dispatch tape, so gradients
+flow to parameters under eager `backward()`. Sampling is reparameterized where
+the reference's is (Normal/Uniform/Beta/Dirichlet) — the noise draw is
+detached, the pathwise map is on-tape.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma, gammaln, xlogy
+
+from ..framework.tensor import Tensor
+from .distribution import (Distribution, _arr, _call, _shape_tuple, _t,
+                           _wrap, kl_divergence, register_kl)
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base; Bregman-divergence entropy
+    (reference `exponential_family.py`). The generic entropy is computed off
+    the tape (concrete subclasses override with closed forms that are
+    on-tape); it exists for parity + cross-checking."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        # H = A(eta) - <eta, grad A(eta)> - E[carrier]  (Bregman form, as in
+        # the reference's ExponentialFamily.entropy autodiff trick)
+        nparams = tuple(_arr(p) for p in self._natural_parameters)
+        lg = self._log_normalizer(*nparams)
+        g = jax.grad(lambda ps: jnp.sum(self._log_normalizer(*ps)))(nparams)
+        result = lg - self._mean_carrier_measure
+        for np_, g_ in zip(nparams, g):
+            result = result - np_ * g_
+        return _wrap(result)
+
+
+class Normal(ExponentialFamily):
+    """Gaussian (reference `normal.py`)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        batch = jnp.broadcast_shapes(tuple(self.loc.data.shape),
+                                     tuple(self.scale.data.shape))
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return _call("normal_mean",
+                     lambda loc: jnp.broadcast_to(loc, self.batch_shape),
+                     self.loc)
+
+    @property
+    def variance(self):
+        return _call("normal_variance",
+                     lambda s: jnp.broadcast_to(s ** 2, self.batch_shape),
+                     self.scale)
+
+    @property
+    def stddev(self):
+        return _call("normal_stddev",
+                     lambda s: jnp.broadcast_to(s, self.batch_shape),
+                     self.scale)
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(shape)
+        eps = jax.random.normal(self._next_key(), shape,
+                                dtype=self.loc.data.dtype)
+        return _call("normal_rsample",
+                     lambda loc, scale, e: loc + scale * e,
+                     self.loc, self.scale, Tensor(eps))
+
+    def log_prob(self, value):
+        def impl(loc, scale, v):
+            var = scale ** 2
+            return (-((v - loc) ** 2) / (2 * var) - jnp.log(scale)
+                    - 0.5 * math.log(2 * math.pi))
+        return _call("normal_log_prob", impl, self.loc, self.scale, _t(value))
+
+    def entropy(self):
+        return _call(
+            "normal_entropy",
+            lambda s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                self.batch_shape),
+            self.scale)
+
+    @property
+    def _natural_parameters(self):
+        loc, scale = _arr(self.loc), _arr(self.scale)
+        return (loc / (scale ** 2), -0.5 / (scale ** 2))
+
+    def _log_normalizer(self, x, y):
+        return -0.25 * x ** 2 / y + 0.5 * jnp.log(-math.pi / y)
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference `uniform.py`)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        batch = jnp.broadcast_shapes(tuple(self.low.data.shape),
+                                     tuple(self.high.data.shape))
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return _call("uniform_mean",
+                     lambda lo, hi: jnp.broadcast_to((lo + hi) / 2, self.batch_shape),
+                     self.low, self.high)
+
+    @property
+    def variance(self):
+        return _call("uniform_variance",
+                     lambda lo, hi: jnp.broadcast_to((hi - lo) ** 2 / 12, self.batch_shape),
+                     self.low, self.high)
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(shape)
+        u = jax.random.uniform(self._next_key(), shape,
+                               dtype=self.low.data.dtype)
+        return _call("uniform_rsample",
+                     lambda lo, hi, u_: lo + (hi - lo) * u_,
+                     self.low, self.high, Tensor(u))
+
+    def log_prob(self, value):
+        def impl(lo, hi, v):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return _call("uniform_log_prob", impl, self.low, self.high, _t(value))
+
+    def entropy(self):
+        return _call("uniform_entropy",
+                     lambda lo, hi: jnp.broadcast_to(jnp.log(hi - lo), self.batch_shape),
+                     self.low, self.high)
+
+
+class Categorical(Distribution):
+    """Categorical over logits (reference `categorical.py`)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits/probs")
+        if logits is not None:
+            self.logits = _t(logits)
+            self._from_logits = True
+        else:
+            self.logits = _t(probs)   # normalized + logged on use
+            self._from_logits = False
+        super().__init__(batch_shape=tuple(self.logits.data.shape[:-1]))
+        self._num_events = self.logits.data.shape[-1]
+
+    def _log_probs_impl(self, raw):
+        if self._from_logits:
+            return jax.nn.log_softmax(raw, axis=-1)
+        p = raw / jnp.sum(raw, axis=-1, keepdims=True)
+        return jnp.log(jnp.clip(p, 1e-38, None)) + jnp.log(jnp.sign(p))  # -inf for 0
+
+    @property
+    def _log_probs(self):
+        """Raw log-prob array (off-tape, for sampling)."""
+        return self._log_probs_impl(_arr(self.logits))
+
+    @property
+    def probs_param(self):
+        return _call("categorical_probs",
+                     lambda raw: jnp.exp(self._log_probs_impl(raw)),
+                     self.logits)
+
+    def sample(self, shape=()):
+        shape = _shape_tuple(shape) + self.batch_shape
+        out = jax.random.categorical(self._next_key(), self._log_probs,
+                                     axis=-1, shape=shape)
+        return _wrap(out)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError("Categorical has no reparameterized sample")
+
+    def log_prob(self, value):
+        idx = _arr(value, dtype=jnp.int32)
+
+        def impl(raw):
+            lp = self._log_probs_impl(raw)
+            return jnp.take_along_axis(
+                jnp.broadcast_to(lp, idx.shape + (self._num_events,)),
+                idx[..., None], axis=-1)[..., 0]
+        return _call("categorical_log_prob", impl, self.logits)
+
+    def entropy(self):
+        def impl(raw):
+            lp = self._log_probs_impl(raw)
+            p = jnp.exp(lp)
+            # xlogy: 0 * log 0 -> 0, so zero-probability atoms contribute 0
+            return -jnp.sum(xlogy(p, p), axis=-1)
+        return _call("categorical_entropy", impl, self.logits)
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs) (reference `multinomial.py`)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self._probs_in = _t(probs)
+        super().__init__(batch_shape=tuple(self._probs_in.data.shape[:-1]),
+                         event_shape=tuple(self._probs_in.data.shape[-1:]))
+
+    @staticmethod
+    def _norm(p):
+        return p / jnp.sum(p, axis=-1, keepdims=True)
+
+    @property
+    def probs(self):
+        return _call("multinomial_probs", self._norm, self._probs_in)
+
+    @property
+    def mean(self):
+        return _call("multinomial_mean",
+                     lambda p: self.total_count * self._norm(p), self._probs_in)
+
+    @property
+    def variance(self):
+        def impl(p):
+            pn = self._norm(p)
+            return self.total_count * pn * (1 - pn)
+        return _call("multinomial_variance", impl, self._probs_in)
+
+    def sample(self, shape=()):
+        shape = _shape_tuple(shape) + self.batch_shape
+        p = self._norm(_arr(self._probs_in))
+        logits = jnp.log(jnp.clip(p, 1e-38, None))
+        draws = jax.random.categorical(
+            self._next_key(), logits, axis=-1,
+            shape=(self.total_count,) + shape)
+        onehot = jax.nn.one_hot(draws, p.shape[-1], dtype=p.dtype)
+        return _wrap(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        def impl(p, v):
+            pn = self._norm(p)
+            log_factorial_n = gammaln(jnp.asarray(self.total_count + 1.0))
+            log_factorial_xs = jnp.sum(gammaln(v + 1.0), axis=-1)
+            return (log_factorial_n - log_factorial_xs
+                    + jnp.sum(xlogy(v, pn), axis=-1))
+        return _call("multinomial_log_prob", impl, self._probs_in, _t(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Beta(ExponentialFamily):
+    """Beta(alpha, beta) (reference `beta.py`)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        batch = jnp.broadcast_shapes(tuple(self.alpha.data.shape),
+                                     tuple(self.beta.data.shape))
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return _call("beta_mean", lambda a, b: a / (a + b),
+                     self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        def impl(a, b):
+            s = a + b
+            return a * b / (s ** 2 * (s + 1))
+        return _call("beta_variance", impl, self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        shape = self._extend_shape(shape)
+        key = self._next_key()
+
+        # implicit reparameterization rides jax.random.beta's param grads
+        def impl(a, b):
+            return jax.random.beta(key,
+                                   jnp.broadcast_to(a, self.batch_shape),
+                                   jnp.broadcast_to(b, self.batch_shape),
+                                   shape=shape)
+        return _call("beta_rsample", impl, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        def impl(a, b, v):
+            return xlogy(a - 1, v) + xlogy(b - 1, 1 - v) - betaln(a, b)
+        return _call("beta_log_prob", impl, self.alpha, self.beta, _t(value))
+
+    def entropy(self):
+        def impl(a, b):
+            return (betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                    + (a + b - 2) * digamma(a + b))
+        return _call("beta_entropy", impl, self.alpha, self.beta)
+
+    @property
+    def _natural_parameters(self):
+        return (_arr(self.alpha), _arr(self.beta))
+
+    def _log_normalizer(self, x, y):
+        return gammaln(x) + gammaln(y) - gammaln(x + y)
+
+    @property
+    def _mean_carrier_measure(self):
+        # carrier h(x): E[-log x - log(1-x)] under Beta(a,b)
+        a, b = _arr(self.alpha), _arr(self.beta)
+        return (digamma(a + b) - digamma(a)) + (digamma(a + b) - digamma(b))
+
+
+class Dirichlet(ExponentialFamily):
+    """Dirichlet(concentration) (reference `dirichlet.py`)."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shape = tuple(self.concentration.data.shape)
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return _call("dirichlet_mean",
+                     lambda a: a / jnp.sum(a, axis=-1, keepdims=True),
+                     self.concentration)
+
+    @property
+    def variance(self):
+        def impl(a):
+            a0 = jnp.sum(a, axis=-1, keepdims=True)
+            m = a / a0
+            return m * (1 - m) / (a0 + 1)
+        return _call("dirichlet_variance", impl, self.concentration)
+
+    def rsample(self, shape=()):
+        batch = _shape_tuple(shape) + self.batch_shape
+        key = self._next_key()
+
+        def impl(a):
+            return jax.random.dirichlet(key, a, shape=batch)
+        return _call("dirichlet_rsample", impl, self.concentration)
+
+    def log_prob(self, value):
+        def impl(a, v):
+            return (jnp.sum(xlogy(a - 1, v), axis=-1)
+                    + gammaln(jnp.sum(a, axis=-1))
+                    - jnp.sum(gammaln(a), axis=-1))
+        return _call("dirichlet_log_prob", impl, self.concentration, _t(value))
+
+    def entropy(self):
+        def impl(a):
+            a0 = jnp.sum(a, axis=-1)
+            k = a.shape[-1]
+            return (jnp.sum(gammaln(a), axis=-1) - gammaln(a0)
+                    + (a0 - k) * digamma(a0)
+                    - jnp.sum((a - 1) * digamma(a), axis=-1))
+        return _call("dirichlet_entropy", impl, self.concentration)
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference `independent.py`)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        if self._rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        shape = base.batch_shape + base.event_shape
+        split = len(base.batch_shape) - self._rank
+        super().__init__(batch_shape=shape[:split],
+                         event_shape=shape[split:])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        if not self._rank:
+            return lp
+        return _call("independent_log_prob",
+                     lambda a: jnp.sum(a, axis=tuple(range(-self._rank, 0))),
+                     lp)
+
+    def entropy(self):
+        ent = self.base.entropy()
+        if not self._rank:
+            return ent
+        return _call("independent_entropy",
+                     lambda a: jnp.sum(a, axis=tuple(range(-self._rank, 0))),
+                     ent)
+
+
+class TransformedDistribution(Distribution):
+    """Pushforward of a base through a chain of transforms
+    (reference `transformed_distribution.py`)."""
+
+    def __init__(self, base, transforms):
+        from .transform import ChainTransform, Transform
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms) if len(self.transforms) != 1 \
+            else self.transforms[0]
+        super().__init__(batch_shape=base.batch_shape, event_shape=base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self._chain.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self._chain.forward(x)
+
+    def log_prob(self, value):
+        y = _t(value)
+        x = self._chain.inverse(y)
+        ladj = self._chain.forward_log_det_jacobian(x)
+        return self.base.log_prob(x) - ladj
+
+
+# ---------------------------------------------------------------------------
+# Pairwise KL table (reference kl.py registrations) — all on-tape
+# ---------------------------------------------------------------------------
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def impl(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return _call("kl_normal_normal", impl, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def impl(pl, ph, ql, qh):
+        result = jnp.log((qh - ql) / (ph - pl))
+        return jnp.where((ql <= pl) & (ph <= qh), result, jnp.inf)
+    return _call("kl_uniform_uniform", impl, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    def impl(praw, qraw):
+        plp = p._log_probs_impl(praw)
+        qlp = q._log_probs_impl(qraw)
+        pp = jnp.exp(plp)
+        # 0 * (log 0 - log q) -> 0 via masking zero-support atoms
+        diff = jnp.where(pp > 0, plp - qlp, 0.0)
+        return jnp.sum(pp * diff, axis=-1)
+    return _call("kl_categorical_categorical", impl, p.logits, q.logits)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def impl(pa, pb, qa, qb):
+        sp = pa + pb
+        sq = qa + qb
+        return (gammaln(sp) - gammaln(pa) - gammaln(pb)
+                - gammaln(sq) + gammaln(qa) + gammaln(qb)
+                + (pa - qa) * digamma(pa)
+                + (pb - qb) * digamma(pb)
+                + (sq - sp) * digamma(sp))
+    return _call("kl_beta_beta", impl, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def impl(a, b):
+        a0 = jnp.sum(a, axis=-1)
+        return (gammaln(a0) - jnp.sum(gammaln(a), axis=-1)
+                - gammaln(jnp.sum(b, axis=-1)) + jnp.sum(gammaln(b), axis=-1)
+                + jnp.sum((a - b) * (digamma(a) - digamma(a0)[..., None]),
+                          axis=-1))
+    return _call("kl_dirichlet_dirichlet", impl, p.concentration,
+                 q.concentration)

@@ -1,0 +1,294 @@
+"""Bijective transforms for TransformedDistribution.
+
+Reference parity: `python/paddle/distribution/transform.py` (Transform,
+AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform).
+Array-in/array-out core (`*_arr`) + Tensor-facing wrappers; log-det-jacobians
+are closed-form (no autodiff in the hot path).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import _arr, _call, _t, _wrap
+
+
+class Type:
+    BIJECTION = 'bijection'
+    INJECTION = 'injection'
+    SURJECTION = 'surjection'
+    OTHER = 'other'
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    # -- Tensor-facing API (on the eager autograd tape) ---------------------
+    def forward(self, x):
+        return _call(f"{type(self).__name__}_fwd", self.forward_arr, _t(x))
+
+    def inverse(self, y):
+        return _call(f"{type(self).__name__}_inv", self.inverse_arr, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _call(f"{type(self).__name__}_ladj",
+                     self.forward_log_det_jacobian_arr, _t(x))
+
+    def inverse_log_det_jacobian(self, y):
+        return _call(
+            f"{type(self).__name__}_inv_ladj",
+            lambda a: -self.forward_log_det_jacobian_arr(self.inverse_arr(a)),
+            _t(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # -- array core (override these) ---------------------------------------
+    def forward_arr(self, x):
+        raise NotImplementedError
+
+    def inverse_arr(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian_arr(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def forward_arr(self, x):
+        return jnp.abs(x)
+
+    def inverse_arr(self, y):
+        return y  # principal branch, as in the reference
+
+    def forward_log_det_jacobian_arr(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def forward_arr(self, x):
+        return self.loc + self.scale * x
+
+    def inverse_arr(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian_arr(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def forward_arr(self, x):
+        return jnp.exp(x)
+
+    def inverse_arr(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian_arr(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def forward_arr(self, x):
+        return jnp.power(x, self.power)
+
+    def inverse_arr(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def forward_log_det_jacobian_arr(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def forward_arr(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse_arr(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian_arr(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def forward_arr(self, x):
+        return jnp.tanh(x)
+
+    def inverse_arr(self, y):
+        return jnp.arctanh(y)
+
+    def forward_log_det_jacobian_arr(self, x):
+        # log|d tanh/dx| = 2 (log2 - x - softplus(-2x)) — numerically stable
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+
+    def forward_arr(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def inverse_arr(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian_arr(self, x):
+        raise NotImplementedError("softmax is not injective")
+
+
+class StickBreakingTransform(Transform):
+    _type = Type.BIJECTION
+
+    def forward_arr(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        z_cumprod = jnp.cumprod(1 - z, axis=-1)
+        pad_z = jnp.pad(z, [(0, 0)] * (z.ndim - 1) + [(0, 1)], constant_values=1.0)
+        pad_cum = jnp.pad(z_cumprod, [(0, 0)] * (z.ndim - 1) + [(1, 0)],
+                          constant_values=1.0)
+        return pad_z * pad_cum
+
+    def inverse_arr(self, y):
+        # x_k = logit(y_k / (1 - sum_{i<k} y_i)) + log(K - k)
+        y_crop = y[..., :-1]
+        offset = y_crop.shape[-1] + 1 - jnp.arange(1, y_crop.shape[-1] + 1)
+        prev_cum = jnp.concatenate(
+            [jnp.zeros_like(y_crop[..., :1]),
+             jnp.cumsum(y_crop, axis=-1)[..., :-1]], axis=-1)
+        frac = y_crop / jnp.clip(1 - prev_cum, 1e-12, None)
+        return (jnp.log(frac) - jnp.log1p(-frac)
+                + jnp.log(offset.astype(y.dtype)))
+
+    def forward_log_det_jacobian_arr(self, x):
+        # det J = sum_k [ -xo_k + logsigmoid(xo_k) + log y_k ] with
+        # xo = x - log(offset); logsigmoid(t) = -softplus(-t)
+        y = self.forward_arr(x)[..., :-1]
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        xo = x - jnp.log(offset.astype(x.dtype))
+        return jnp.sum(-xo - jax.nn.softplus(-xo)
+                       + jnp.log(jnp.clip(y, 1e-12, None)), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(jnp.prod(jnp.asarray(self.in_event_shape or (1,)))) != \
+           int(jnp.prod(jnp.asarray(self.out_event_shape or (1,)))):
+            raise ValueError("event sizes must match")
+
+    def forward_arr(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.reshape(x, batch + self.out_event_shape)
+
+    def inverse_arr(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return jnp.reshape(y, batch + self.in_event_shape)
+
+    def forward_log_det_jacobian_arr(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, dtype=x.dtype)
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def forward_arr(self, x):
+        return self.base.forward_arr(x)
+
+    def inverse_arr(self, y):
+        return self.base.inverse_arr(y)
+
+    def forward_log_det_jacobian_arr(self, x):
+        ladj = self.base.forward_log_det_jacobian_arr(x)
+        return jnp.sum(ladj, axis=tuple(range(-self._rank, 0)))
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms along slices of an axis."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, method, v):
+        parts = jnp.split(v, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, method)(jnp.squeeze(p, self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def forward_arr(self, x):
+        return self._map('forward_arr', x)
+
+    def inverse_arr(self, y):
+        return self._map('inverse_arr', y)
+
+    def forward_log_det_jacobian_arr(self, x):
+        return self._map('forward_log_det_jacobian_arr', x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward_arr(self, x):
+        for t in self.transforms:
+            x = t.forward_arr(x)
+        return x
+
+    def inverse_arr(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse_arr(y)
+        return y
+
+    def forward_log_det_jacobian_arr(self, x):
+        total = None
+        for t in self.transforms:
+            ladj = t.forward_log_det_jacobian_arr(x)
+            total = ladj if total is None else total + ladj
+            x = t.forward_arr(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape

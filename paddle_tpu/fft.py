@@ -1,0 +1,202 @@
+"""Discrete Fourier transforms.
+
+Reference parity: `python/paddle/fft.py` (fft/ifft/rfft/irfft/hfft/ihfft +
+2-D/N-D variants, fftfreq/rfftfreq, fftshift/ifftshift; C++ backend
+`paddle/fluid/operators/spectral_op.*` pocketfft/cuFFT). TPU-native: jnp.fft
+lowers to XLA's FFT HLO; eager autograd rides the op-dispatch tape
+(`paddle_tpu.ops._dispatch.call` + jax.vjp), replacing the hand-written
+spectral grad kernels. Hermitian N-D variants use the identity
+``hfftn(x) = irfftn(conj(x), norm=swap(norm))`` (the same construction the
+reference's fftn_c2r/forward=True kernel performs).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops import _dispatch as _d
+from .ops._dispatch import kernel
+
+
+def _swap_norm(norm):
+    if norm == "backward":
+        return "forward"
+    if norm == "forward":
+        return "backward"
+    if norm == "ortho":
+        return "ortho"
+    raise ValueError(f"invalid norm {norm!r}, expected backward/forward/ortho")
+
+
+def _check_norm(norm):
+    if norm not in ("backward", "forward", "ortho"):
+        raise ValueError(f"invalid norm {norm!r}, expected backward/forward/ortho")
+    return norm
+
+
+def _op(opname, fn):
+    impl = kernel(opname)(fn)
+    def wrapper(*tensors, **attrs):
+        return _d.call(impl, tensors, kwargs=attrs, name=opname)
+    wrapper.__name__ = opname
+    return wrapper
+
+
+# 1-D ----------------------------------------------------------------------
+_fft_impl = _op("fft_c2c", lambda x, n=None, axis=-1, norm="backward":
+                jnp.fft.fft(x, n=n, axis=axis, norm=norm))
+_ifft_impl = _op("ifft_c2c", lambda x, n=None, axis=-1, norm="backward":
+                 jnp.fft.ifft(x, n=n, axis=axis, norm=norm))
+_rfft_impl = _op("fft_r2c", lambda x, n=None, axis=-1, norm="backward":
+                 jnp.fft.rfft(x, n=n, axis=axis, norm=norm))
+_irfft_impl = _op("fft_c2r", lambda x, n=None, axis=-1, norm="backward":
+                  jnp.fft.irfft(x, n=n, axis=axis, norm=norm))
+_hfft_impl = _op("hfft", lambda x, n=None, axis=-1, norm="backward":
+                 jnp.fft.irfft(jnp.conj(x), n=n, axis=axis, norm=_swap_norm(norm)))
+_ihfft_impl = _op("ihfft", lambda x, n=None, axis=-1, norm="backward":
+                  jnp.conj(jnp.fft.rfft(x, n=n, axis=axis, norm=_swap_norm(norm))))
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft_impl(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _ifft_impl(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _rfft_impl(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _irfft_impl(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _hfft_impl(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _ihfft_impl(x, n=n, axis=axis, norm=_check_norm(norm))
+
+
+# N-D ----------------------------------------------------------------------
+_fftn_impl = _op("fftn_c2c", lambda x, s=None, axes=None, norm="backward":
+                 jnp.fft.fftn(x, s=s, axes=axes, norm=norm))
+_ifftn_impl = _op("ifftn_c2c", lambda x, s=None, axes=None, norm="backward":
+                  jnp.fft.ifftn(x, s=s, axes=axes, norm=norm))
+_rfftn_impl = _op("fftn_r2c", lambda x, s=None, axes=None, norm="backward":
+                  jnp.fft.rfftn(x, s=s, axes=axes, norm=norm))
+_irfftn_impl = _op("fftn_c2r", lambda x, s=None, axes=None, norm="backward":
+                   jnp.fft.irfftn(x, s=s, axes=axes, norm=norm))
+_hfftn_impl = _op("hfftn", lambda x, s=None, axes=None, norm="backward":
+                  jnp.fft.irfftn(jnp.conj(x), s=s, axes=axes,
+                                 norm=_swap_norm(norm)))
+_ihfftn_impl = _op("ihfftn", lambda x, s=None, axes=None, norm="backward":
+                   jnp.conj(jnp.fft.rfftn(x, s=s, axes=axes,
+                                          norm=_swap_norm(norm))))
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fftn_impl(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _ifftn_impl(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _rfftn_impl(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _irfftn_impl(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hfftn_impl(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _ihfftn_impl(x, s=s, axes=axes, norm=_check_norm(norm))
+
+
+# 2-D (thin aliases over N-D, like the reference) ---------------------------
+def _check_2d(x, s, axes):
+    if s is not None and len(s) != 2:
+        raise ValueError("s must be length-2 for 2-D transforms")
+    if axes is not None and len(axes) != 2:
+        raise ValueError("axes must be length-2 for 2-D transforms")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_2d(x, s, axes)
+    return fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_2d(x, s, axes)
+    return ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_2d(x, s, axes)
+    return rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_2d(x, s, axes)
+    return irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_2d(x, s, axes)
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_2d(x, s, axes)
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+# helpers ------------------------------------------------------------------
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.tensor import Tensor
+    from .framework import dtype as dtype_mod
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        out = out.astype(dtype_mod.convert_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.tensor import Tensor
+    from .framework import dtype as dtype_mod
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        out = out.astype(dtype_mod.convert_dtype(dtype))
+    return Tensor(out)
+
+
+_fftshift_impl = _op("fftshift", lambda x, axes=None: jnp.fft.fftshift(x, axes=axes))
+_ifftshift_impl = _op("ifftshift", lambda x, axes=None: jnp.fft.ifftshift(x, axes=axes))
+
+
+def fftshift(x, axes=None, name=None):
+    if axes is not None and not isinstance(axes, (list, tuple)):
+        axes = (int(axes),)
+    return _fftshift_impl(x, axes=tuple(axes) if axes is not None else None)
+
+
+def ifftshift(x, axes=None, name=None):
+    if axes is not None and not isinstance(axes, (list, tuple)):
+        axes = (int(axes),)
+    return _ifftshift_impl(x, axes=tuple(axes) if axes is not None else None)
+
+
+__all__ = [
+    'fft', 'ifft', 'rfft', 'irfft', 'hfft', 'ihfft',
+    'fft2', 'ifft2', 'rfft2', 'irfft2', 'hfft2', 'ihfft2',
+    'fftn', 'ifftn', 'rfftn', 'irfftn', 'hfftn', 'ihfftn',
+    'fftfreq', 'rfftfreq', 'fftshift', 'ifftshift',
+]

@@ -54,7 +54,8 @@ def _unwrap(x) -> jax.Array:
 
 def _wants_grad(x) -> bool:
     return (isinstance(x, Tensor) and not x.stop_gradient
-            and dtype_mod.is_floating(x.data.dtype))
+            and (dtype_mod.is_floating(x.data.dtype)
+                 or dtype_mod.is_complex(x.data.dtype)))
 
 
 def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,

@@ -1,0 +1,159 @@
+"""Signal processing: frame / overlap_add / stft / istft.
+
+Reference parity: `python/paddle/signal.py:32,154,237,391` (C++ backends
+`operators/frame_op`, `overlap_add_op`, spectral ops). TPU-native: framing is
+a static gather (advanced indexing → XLA gather), overlap-add is a scatter-add
+(`.at[].add`) — both fully differentiable through the op tape; FFTs ride
+`paddle_tpu.fft`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import fft as fft_mod
+from .framework.tensor import Tensor
+from .ops import _dispatch as _d
+from .ops._dispatch import kernel
+
+
+def _frame_indices(seq_length, frame_length, hop_length):
+    num_frames = 1 + (seq_length - frame_length) // hop_length
+    # idx[f, t] = t * hop + f   → gather produces (..., frame_length, num_frames)
+    return (jnp.arange(frame_length)[:, None]
+            + hop_length * jnp.arange(num_frames)[None, :])
+
+
+@kernel("frame")
+def _frame_impl(x, frame_length, hop_length, axis=-1):
+    if axis == -1 or axis == x.ndim - 1:
+        idx = _frame_indices(x.shape[-1], frame_length, hop_length)
+        return x[..., idx]
+    if axis == 0:
+        idx = _frame_indices(x.shape[0], frame_length, hop_length)
+        return x[idx.T]  # (num_frames, frame_length, ...)
+    raise ValueError("frame: axis must be 0 or -1")
+
+
+@kernel("overlap_add")
+def _overlap_add_impl(x, hop_length, axis=-1):
+    if axis == -1 or axis == x.ndim - 1:
+        frame_length, num_frames = x.shape[-2], x.shape[-1]
+        out_len = (num_frames - 1) * hop_length + frame_length
+        idx = _frame_indices(out_len, frame_length, hop_length)
+        out = jnp.zeros(x.shape[:-2] + (out_len,), dtype=x.dtype)
+        return out.at[..., idx].add(x)
+    if axis == 0:
+        num_frames, frame_length = x.shape[0], x.shape[1]
+        out_len = (num_frames - 1) * hop_length + frame_length
+        idx = _frame_indices(out_len, frame_length, hop_length)
+        out = jnp.zeros((out_len,) + x.shape[2:], dtype=x.dtype)
+        return out.at[idx.T].add(x)
+    raise ValueError("overlap_add: axis must be 0 or -1")
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice input into (overlapping) frames (reference `signal.py:32`)."""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    return _d.call(_frame_impl, (x,),
+                   kwargs=dict(frame_length=int(frame_length),
+                               hop_length=int(hop_length), axis=int(axis)),
+                   name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct a signal from framed slices (reference `signal.py:154`)."""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    return _d.call(_overlap_add_impl, (x,),
+                   kwargs=dict(hop_length=int(hop_length), axis=int(axis)),
+                   name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode='reflect', normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference `signal.py:237`).
+
+    Input [..., seq_length] → complex [..., n_fft//2+1 (or n_fft), num_frames].
+    """
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else int(n_fft)
+    if window is not None:
+        w = window.data if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((win_length,), dtype=jnp.float32)
+    if w.shape[0] != win_length:
+        raise ValueError("window length must equal win_length")
+    if win_length < n_fft:
+        pad_l = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad_l, n_fft - win_length - pad_l))
+
+    xd = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    if center:
+        pad = [(0, 0)] * (xd.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        xd = jnp.pad(xd, pad, mode=pad_mode)
+    xt = Tensor(xd, stop_gradient=(x.stop_gradient if isinstance(x, Tensor) else True))
+
+    frames = frame(xt, n_fft, hop_length, axis=-1)          # (..., n_fft, T)
+    frames = frames * Tensor(w[:, None].astype(xd.dtype))
+    if onesided:
+        out = fft_mod.rfft(frames, axis=-2)
+    else:
+        out = fft_mod.fft(frames, axis=-2)
+    if normalized:
+        out = out * Tensor(jnp.asarray(1.0 / (float(n_fft) ** 0.5),
+                                       dtype=out.data.real.dtype))
+    return out
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT (reference `signal.py:391`)."""
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else int(n_fft)
+    if window is not None:
+        w = window.data if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((win_length,), dtype=jnp.float32)
+    if win_length < n_fft:
+        pad_l = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad_l, n_fft - win_length - pad_l))
+
+    if normalized:
+        x = x * Tensor(jnp.asarray(float(n_fft) ** 0.5))
+    if onesided:
+        frames = fft_mod.irfft(x, n=n_fft, axis=-2)
+    else:
+        frames = fft_mod.ifft(x, axis=-2)
+        if not return_complex:
+            frames = frames.real()
+
+    wd = w.astype(frames.data.real.dtype if jnp.iscomplexobj(frames.data) else frames.data.dtype)
+    frames = frames * Tensor(wd[:, None])
+    out = overlap_add(frames, hop_length, axis=-1)
+
+    # window envelope normalization
+    num_frames = frames.data.shape[-1]
+    env_frames = jnp.broadcast_to((wd * wd)[:, None], (n_fft, num_frames))
+    envelope = _overlap_add_impl(env_frames, hop_length, axis=-1)
+    envelope = jnp.where(envelope > 1e-11, envelope, 1.0)
+    out = out / Tensor(envelope)
+
+    if center:
+        start = n_fft // 2
+        stop = out.data.shape[-1] - n_fft // 2
+    else:
+        start, stop = 0, out.data.shape[-1]
+    if length is not None:
+        stop = min(stop, start + int(length))
+    sl = (slice(None),) * (out.data.ndim - 1) + (slice(start, stop),)
+    out = out[sl]
+    if length is not None and out.data.shape[-1] < length:
+        pad = [(0, 0)] * (out.data.ndim - 1) + [(0, int(length) - out.data.shape[-1])]
+        out = Tensor(jnp.pad(out.data, pad), stop_gradient=out.stop_gradient)
+    return out
+
+
+__all__ = ['frame', 'overlap_add', 'stft', 'istft']

@@ -1,0 +1,111 @@
+"""paddle.device equivalent.
+
+Reference parity: `python/paddle/device/__init__.py` (set_device/get_device,
+device-type discovery, is_compiled_with_*) and `python/paddle/device/cuda/`
+(streams/events/memory stats) — the latter exposed both as `device.cuda`
+(API parity) and `device.tpu` (honest name); both talk to the same JAX
+accelerator runtime. XLA owns streams and memory, so stream objects are
+ordering no-ops and memory stats read `jax.Device.memory_stats()`.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+
+from ..framework.place import (CPUPlace, CustomPlace, Place, TPUPlace,
+                               device_count, get_device, set_device,
+                               is_compiled_with_tpu)
+from . import cuda
+from . import cuda as tpu  # same accelerator runtime, honest alias
+
+__all__ = [
+    'set_device', 'get_device', 'get_all_device_type',
+    'get_all_custom_device_type', 'get_available_device',
+    'get_available_custom_device', 'is_compiled_with_tpu',
+    'is_compiled_with_cuda', 'is_compiled_with_rocm',
+    'is_compiled_with_xpu', 'is_compiled_with_npu', 'is_compiled_with_mlu',
+    'is_compiled_with_ipu', 'is_compiled_with_cinn',
+    'XPUPlace', 'IPUPlace', 'MLUPlace', 'NPUPlace',
+    'cuda', 'tpu', 'synchronize',
+]
+
+
+def get_all_device_type() -> List[str]:
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type() -> List[str]:
+    return [t for t in get_all_device_type() if t not in ("cpu", "gpu", "tpu")]
+
+
+def get_available_device() -> List[str]:
+    out = []
+    for d in jax.devices():
+        out.append(f"{d.platform}:{d.id}")
+    return out
+
+
+def get_available_custom_device() -> List[str]:
+    return [s for s in get_available_device()
+            if s.split(":")[0] not in ("cpu", "gpu", "tpu")]
+
+
+def synchronize(device=None):
+    """Block until all queued work on the device is complete (reference
+    `device/cuda/__init__.py` synchronize; here: a tiny transfer barrier —
+    jax dispatch is async, fetching forces completion)."""
+    for d in jax.devices():
+        try:
+            jax.device_put(0, d).block_until_ready()
+        except Exception:
+            pass
+
+
+# compiled-with predicates: honest answers for a TPU-only build
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+class XPUPlace(CustomPlace):
+    def __init__(self, device_id: int = 0):
+        super().__init__("xpu", device_id)
+
+
+class IPUPlace(CustomPlace):
+    def __init__(self, device_id: int = 0):
+        super().__init__("ipu", device_id)
+
+
+class MLUPlace(CustomPlace):
+    def __init__(self, device_id: int = 0):
+        super().__init__("mlu", device_id)
+
+
+class NPUPlace(CustomPlace):
+    def __init__(self, device_id: int = 0):
+        super().__init__("npu", device_id)

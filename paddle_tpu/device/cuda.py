@@ -1,0 +1,156 @@
+"""Accelerator runtime utilities (paddle.device.cuda parity, TPU semantics).
+
+Reference parity: `python/paddle/device/cuda/__init__.py` (Stream, Event,
+current_stream, stream_guard, synchronize, device_count, memory stats) and
+`python/paddle/device/cuda/streams.py`. On TPU, XLA owns stream scheduling:
+program order IS stream order, so Stream/Event are ordering markers that
+`synchronize`/`record` map onto `block_until_ready` barriers. Memory stats
+read `jax.Device.memory_stats()` (HBM), replacing cudaMemGetInfo.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+import jax
+
+from ..framework.place import get_expected_place
+
+
+def _device(device=None) -> jax.Device:
+    if isinstance(device, jax.Device):
+        return device
+    if device is None:
+        return get_expected_place().jax_device
+    if isinstance(device, int):
+        devs = jax.devices()
+        return devs[device]
+    if hasattr(device, "jax_device"):
+        return device.jax_device
+    raise TypeError(f"cannot interpret {device!r} as a device")
+
+
+def device_count() -> int:
+    try:
+        return len([d for d in jax.devices() if d.platform != "cpu"]) or \
+            len(jax.devices())
+    except Exception:
+        return 0
+
+
+def synchronize(device=None):
+    """Wait for all work on `device` (reference cuda.synchronize)."""
+    d = _device(device)
+    jax.device_put(0, d).block_until_ready()
+
+
+def current_stream(device=None) -> "Stream":
+    return Stream(device=device)
+
+
+@contextmanager
+def stream_guard(stream: "Stream"):
+    """Parity context: XLA compiles its own schedule; the guard only tracks
+    the 'current stream' object for API compatibility."""
+    global _current
+    prev = _current
+    _current = stream
+    try:
+        yield
+    finally:
+        _current = prev
+
+
+class Event:
+    """Ordering marker (reference `streams.py` Event)."""
+
+    def __init__(self, enable_timing: bool = False, blocking: bool = False,
+                 interprocess: bool = False):
+        self._recorded = False
+
+    def record(self, stream: Optional["Stream"] = None):
+        self._recorded = True
+
+    def query(self) -> bool:
+        return self._recorded
+
+    def synchronize(self):
+        synchronize()
+
+
+class Stream:
+    """Ordering domain (reference `streams.py` Stream). XLA's latency-hiding
+    scheduler already overlaps compute/comm; explicit streams are a no-op
+    ordering API kept for code portability."""
+
+    def __init__(self, device=None, priority: int = 2):
+        self.device = _device(device)
+        self.priority = priority
+
+    def record_event(self, event: Optional[Event] = None) -> Event:
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def wait_event(self, event: Event):
+        pass  # program order is stream order under XLA
+
+    def wait_stream(self, stream: "Stream"):
+        pass
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize(self.device)
+
+
+_current = Stream()
+
+
+# -- memory stats (jax.Device.memory_stats → cudaMemGetInfo parity) ---------
+def _stats(device=None) -> dict:
+    d = _device(device)
+    return d.memory_stats() or {}
+
+
+def memory_allocated(device=None) -> int:
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    s = _stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    return int(_stats(device).get("peak_bytes_in_use", 0))
+
+
+def empty_cache():
+    """XLA's allocator manages HBM; nothing to flush (parity no-op)."""
+    return None
+
+
+def get_device_properties(device=None):
+    d = _device(device)
+
+    class _Props:
+        name = f"{d.platform}:{d.id} ({getattr(d, 'device_kind', 'unknown')})"
+        total_memory = int(_stats(d).get("bytes_limit", 0))
+        multi_processor_count = getattr(d, "num_cores", 1) or 1
+        major, minor = 0, 0
+    return _Props()
+
+
+def get_device_name(device=None) -> str:
+    d = _device(device)
+    return getattr(d, "device_kind", d.platform)
+
+
+def get_device_capability(device=None):
+    return (0, 0)

@@ -198,9 +198,11 @@ class Categorical(Distribution):
 
         def impl(raw):
             lp = self._log_probs_impl(raw)
-            return jnp.take_along_axis(
-                jnp.broadcast_to(lp, idx.shape + (self._num_events,)),
-                idx[..., None], axis=-1)[..., 0]
+            # value may have lower/higher rank than batch_shape — broadcast both
+            out_shape = jnp.broadcast_shapes(idx.shape, lp.shape[:-1])
+            lp_b = jnp.broadcast_to(lp, out_shape + (self._num_events,))
+            idx_b = jnp.broadcast_to(idx, out_shape)
+            return jnp.take_along_axis(lp_b, idx_b[..., None], axis=-1)[..., 0]
         return _call("categorical_log_prob", impl, self.logits)
 
     def entropy(self):
@@ -426,7 +428,14 @@ class TransformedDistribution(Distribution):
         self.transforms = list(transforms)
         self._chain = ChainTransform(self.transforms) if len(self.transforms) != 1 \
             else self.transforms[0]
-        super().__init__(batch_shape=base.batch_shape, event_shape=base.event_shape)
+        # shape-changing transforms (StickBreaking, Reshape) act on event dims
+        full = base.batch_shape + base.event_shape
+        out_full = tuple(self._chain.forward_shape(full))
+        nb = len(base.batch_shape)
+        super().__init__(batch_shape=out_full[:nb] if len(out_full) >= nb
+                         else out_full,
+                         event_shape=out_full[nb:] if len(out_full) >= nb
+                         else ())
 
     def sample(self, shape=()):
         x = self.base.sample(shape)

@@ -1,0 +1,121 @@
+"""Global flag registry.
+
+Reference parity: the reference's exported gflags
+(`platform/flags.cc:35` `PADDLE_DEFINE_EXPORTED_*`, read/written from Python
+via `core.globals()` / `pybind/global_value_getter_setter.cc`, env `FLAGS_*`
+parsed at import in `fluid/__init__.py`). Here: a typed in-process registry;
+`FLAGS_*` environment variables override defaults at import; behavioral flags
+are consulted by the runtime (e.g. `FLAGS_check_nan_inf` hooks every op
+dispatch, like the reference's `CheckOpHasNanOrInf`
+`framework/details/nan_inf_utils.h:29`).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Union
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "help")
+
+    def __init__(self, name, default, help=""):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type(default)
+        self.help = help
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default, help: str = ""):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    flag = _Flag(name, default, help)
+    env = os.environ.get(name)
+    if env is not None:
+        flag.value = _parse(env, flag.type)
+    _REGISTRY[name] = flag
+    return flag
+
+
+def _parse(s: str, ty):
+    if ty is bool:
+        return s.lower() in ("1", "true", "yes", "on")
+    return ty(s)
+
+
+def get_flags(flags: Union[str, List[str]]) -> Dict[str, Any]:
+    """paddle.get_flags parity."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        if not name.startswith("FLAGS_"):
+            name = "FLAGS_" + name
+        if name not in _REGISTRY:
+            raise ValueError(f"unknown flag {name}")
+        out[name] = _REGISTRY[name].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags parity."""
+    for name, value in flags.items():
+        if not name.startswith("FLAGS_"):
+            name = "FLAGS_" + name
+        if name not in _REGISTRY:
+            raise ValueError(f"unknown flag {name}")
+        flag = _REGISTRY[name]
+        flag.value = _parse(value, flag.type) if isinstance(value, str) else \
+            flag.type(value)
+        _on_flag_set(name, flag.value)
+
+
+def flag(name: str):
+    """Fast internal read."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    return _REGISTRY[name].value
+
+
+def all_flags() -> Dict[str, Any]:
+    return {n: f.value for n, f in _REGISTRY.items()}
+
+
+def _on_flag_set(name: str, value):
+    # behavioral side effects
+    if name == "FLAGS_check_nan_inf":
+        try:
+            import jax
+            # covers jit-compiled programs; eager ops are checked per-dispatch
+            jax.config.update("jax_debug_nans", bool(value))
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Flag definitions (subset of platform/flags.cc with TPU-meaningful semantics)
+# ---------------------------------------------------------------------------
+define_flag("FLAGS_check_nan_inf", False,
+            "post-check every op output for NaN/Inf (reference "
+            "nan_inf_utils_detail); compiled programs get jax_debug_nans")
+define_flag("FLAGS_benchmark", False, "synchronize after each op for timing")
+define_flag("FLAGS_use_pallas_kernels", True,
+            "use Pallas TPU kernels (flash attention, fused ops) when shapes "
+            "allow; pure-XLA fallback otherwise")
+define_flag("FLAGS_allocator_strategy", "auto_growth",
+            "parity flag (XLA owns TPU HBM allocation; informational)")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
+            "parity flag; maps to XLA_PYTHON_CLIENT_MEM_FRACTION if set "
+            "before first device use")
+define_flag("FLAGS_use_standalone_executor", True,
+            "static.Executor compiles whole programs as one XLA executable")
+define_flag("FLAGS_max_inmemory_prefetch", 2,
+            "DataLoader device prefetch depth (BufferedReader equivalent)")
+define_flag("FLAGS_sync_collectives", False,
+            "debug: block after each collective (FLAGS_sync_nccl_allreduce)")
+
+if os.environ.get("FLAGS_check_nan_inf"):
+    _on_flag_set("FLAGS_check_nan_inf", flag("FLAGS_check_nan_inf"))

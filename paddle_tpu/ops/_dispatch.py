@@ -82,15 +82,46 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
             out = impl(*a, **kwargs)
             return out if isinstance(out, tuple) else (out,)
         outs, vjp_fn = jax.vjp(tup_impl, *arrs)
+        if _nan_check_on():
+            _check_nan_inf(name, outs)
         out_tensors = tuple(Tensor(o, stop_gradient=False) for o in outs)
         in_refs = [t if isinstance(t, Tensor) else None for t in tensors]
         tape_mod.record(vjp_fn, in_refs, out_tensors, name=name)
         return out_tensors[0] if len(out_tensors) == 1 else out_tensors
     else:
         out = impl(*arrs, **kwargs)
+        if _nan_check_on():
+            _check_nan_inf(name, out if isinstance(out, tuple) else (out,))
         if isinstance(out, tuple):
             return tuple(Tensor(o, stop_gradient=True) for o in out)
         return Tensor(out, stop_gradient=True)
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf numerical sanitizer (reference: FLAGS_check_nan_inf →
+# CheckOpHasNanOrInfInDygraph, framework/details/nan_inf_utils.h:44)
+# ---------------------------------------------------------------------------
+from ..framework import flags as _flags_mod  # noqa: E402  (imports os only)
+
+_NAN_FLAG = _flags_mod._REGISTRY["FLAGS_check_nan_inf"]
+
+
+def _nan_check_on() -> bool:
+    return _NAN_FLAG.value
+
+
+def _check_nan_inf(name: str, outs):
+    for i, o in enumerate(outs):
+        if not isinstance(o, jax.Array):
+            continue
+        if isinstance(o, jax.core.Tracer):
+            continue  # under jit: jax_debug_nans covers compiled programs
+        if (dtype_mod.is_floating(o.dtype) or dtype_mod.is_complex(o.dtype)):
+            if not bool(jnp.all(jnp.isfinite(o))):
+                raise FloatingPointError(
+                    f"Operator '{name}' output {i} contains NaN or Inf "
+                    f"(shape {tuple(o.shape)}, dtype {o.dtype}). Enabled by "
+                    f"FLAGS_check_nan_inf.")
 
 
 def _multi_out(impl):

@@ -1,0 +1,22 @@
+"""paddle.profiler equivalent.
+
+Reference parity: `python/paddle/profiler/` (`profiler.py:262` Profiler,
+`profiler.py:65` make_scheduler, `profiler.py:152` export_chrome_tracing,
+`utils.py:31` RecordEvent, `timer.py:325` Benchmark/ips). TPU-native: host
+spans are recorded by our own lightweight recorder (the reference's
+HostEventRecorder, `platform/profiler/host_event_recorder.h`) and exported as
+chrome://tracing JSON; device-side tracing delegates to `jax.profiler`
+(XPlane/TensorBoard), the TPU answer to CUPTI.
+"""
+from .profiler import (Profiler, ProfilerState, ProfilerTarget,
+                       export_chrome_tracing, export_protobuf, make_scheduler)
+from .statistic import SortedKeys, StatisticData, summary_report
+from .timer import Benchmark, benchmark
+from .utils import RecordEvent, load_profiler_result
+
+__all__ = [
+    'Profiler', 'ProfilerState', 'ProfilerTarget', 'make_scheduler',
+    'export_chrome_tracing', 'export_protobuf', 'RecordEvent',
+    'load_profiler_result', 'SortedKeys', 'StatisticData', 'summary_report',
+    'Benchmark', 'benchmark',
+]

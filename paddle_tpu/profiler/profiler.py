@@ -1,0 +1,246 @@
+"""Profiler with step-window scheduling and chrome-trace export.
+
+Reference parity: `python/paddle/profiler/profiler.py` — `Profiler:262`
+(start/stop/step/export/summary, context manager), `make_scheduler:65`
+(closed→ready→record windows with repeat/skip_first),
+`export_chrome_tracing:152` / `export_protobuf:203` (on_trace_ready
+callables). Device-side: when a TPU target is profiled and `trace_dir` is
+set, wraps `jax.profiler.start_trace/stop_trace` (XPlane → TensorBoard), the
+TPU replacement for the reference's CUPTI CudaTracer.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+from .recorder import get_recorder
+from .statistic import SortedKeys, StatisticData, summary_report
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1      # accepted for API parity; maps to the TPU device tracer
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-window state machine (reference `profiler.py:65`)."""
+    num_steps = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        assert step >= 0
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        period = step // num_steps
+        if repeat and period >= repeat:
+            return ProfilerState.CLOSED
+        pos = step % num_steps
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos < num_steps - 1:
+            return ProfilerState.RECORD
+        return ProfilerState.RECORD_AND_RETURN
+    return scheduler
+
+
+def _default_state_scheduler(step: int):
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str,
+                          worker_name: Optional[str] = None) -> Callable:
+    """on_trace_ready callable writing chrome://tracing JSON
+    (reference `profiler.py:152`)."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof: "Profiler"):
+        name = worker_name or f"host_{socket.gethostname()}_pid_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
+                                      f".paddle_trace.json")
+        prof.export(path, format="json")
+        return path
+    return handler
+
+
+def export_protobuf(dir_name: str,
+                    worker_name: Optional[str] = None) -> Callable:
+    """Parity alias — exports the same JSON payload with .pb.json suffix (we
+    have no profiler.proto; the chrome JSON is the interchange format)."""
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof: "Profiler"):
+        name = worker_name or f"host_{socket.gethostname()}_pid_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
+                                      f".pb.json")
+        prof.export(path, format="json")
+        return path
+    return handler
+
+
+def _get_supported_targets() -> Iterable[ProfilerTarget]:
+    targets = [ProfilerTarget.CPU]
+    try:
+        if any(d.platform == "tpu" for d in jax.devices()):
+            targets.append(ProfilerTarget.TPU)
+    except Exception:
+        pass
+    return targets
+
+
+class Profiler:
+    """Reference `profiler.py:262`.
+
+    Usage:
+        with Profiler(scheduler=(2, 5)) as p:
+            for batch in loader:
+                train_step(batch)
+                p.step()
+        p.summary()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, trace_dir: Optional[str] = None):
+        self.targets = list(targets) if targets is not None \
+            else list(_get_supported_targets())
+        if scheduler is None:
+            self._scheduler = _default_state_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = make_scheduler(closed=max(start - 1, 0),
+                                             ready=min(start, 1),
+                                             record=end - start, repeat=1)
+        else:
+            self._scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.trace_dir = trace_dir
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._spans = []
+        self._device_tracing = False
+        from .timer import benchmark
+        self._benchmark = benchmark()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._benchmark.begin()
+        if self.timer_only:
+            return
+        self.current_state = self._scheduler(self.step_num)
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._start_record()
+
+    def stop(self):
+        self._benchmark.end()
+        if self.timer_only:
+            return
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._stop_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        self._benchmark.step(num_samples)
+        if self.timer_only:
+            self.step_num += 1
+            return
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self._scheduler(self.step_num)
+        recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        # RECORD_AND_RETURN always closes its window (even into a back-to-back
+        # next window), so every window's trace is exported
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            self._stop_record()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+            if self.current_state in recording:
+                self._start_record()
+        elif prev not in recording and self.current_state in recording:
+            self._start_record()
+        elif prev in recording and self.current_state not in recording:
+            self._stop_record()
+
+    def step_info(self, unit: str = "samples") -> str:
+        return self._benchmark.step_info(unit)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- recording ----------------------------------------------------------
+    def _start_record(self):
+        rec = get_recorder()
+        rec.clear()
+        rec.enabled = True
+        if self.trace_dir and any(t in (ProfilerTarget.TPU, ProfilerTarget.GPU)
+                                  for t in self.targets):
+            try:
+                jax.profiler.start_trace(self.trace_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _stop_record(self):
+        rec = get_recorder()
+        rec.enabled = False
+        self._spans = rec.collect()
+        if self._device_tracing:
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._device_tracing = False
+
+    # -- results ------------------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        """Write collected host spans as chrome://tracing JSON."""
+        events = []
+        pid = os.getpid()
+        for s in self._spans:
+            events.append({
+                "name": s.name, "ph": "X", "cat": s.event_type,
+                "ts": s.start_ns / 1e3, "dur": s.dur_ns / 1e3,
+                "pid": pid, "tid": s.tid,
+                "args": {"parent": s.parent} if s.parent else {},
+            })
+        payload = {"traceEvents": events,
+                   "displayTimeUnit": "ms",
+                   "metadata": {"producer": "paddle_tpu.profiler"}}
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
+    def statistic_data(self) -> StatisticData:
+        return StatisticData(self._spans)
+
+    def summary(self, sorted_by: SortedKeys = None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = 'ms') -> str:
+        report = summary_report(self.statistic_data(),
+                                sorted_by=sorted_by, time_unit=time_unit)
+        print(report)
+        return report

@@ -1,0 +1,80 @@
+"""Host event recorder: thread-local span buffers merged on collect.
+
+Reference parity: `paddle/fluid/platform/profiler/host_event_recorder.h`
+(thread-local ring buffers of RecordEvent spans) + `event_node.cc` (merge into
+an event tree). Here: a per-thread list of completed spans; `collect()` drains
+all threads.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class HostSpan:
+    name: str
+    start_ns: int
+    end_ns: int
+    tid: int
+    event_type: str = "UserDefined"
+    parent: Optional[str] = None
+
+    @property
+    def dur_ns(self) -> int:
+        return self.end_ns - self.start_ns
+
+
+class HostEventRecorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buffers = {}          # tid -> list[HostSpan]
+        self._tls = threading.local()
+        self.enabled = False
+
+    def _buf(self) -> List[HostSpan]:
+        buf = getattr(self._tls, "buf", None)
+        if buf is None:
+            buf = []
+            self._tls.buf = buf
+            with self._lock:
+                self._buffers[threading.get_ident()] = buf
+        return buf
+
+    def push(self, span: HostSpan):
+        if self.enabled:
+            self._buf().append(span)
+
+    def collect(self) -> List[HostSpan]:
+        with self._lock:
+            out = []
+            for buf in self._buffers.values():
+                out.extend(buf)
+        out.sort(key=lambda s: s.start_ns)
+        return out
+
+    def clear(self):
+        with self._lock:
+            for buf in self._buffers.values():
+                buf.clear()
+
+    # active-span stack for nesting info
+    def span_stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+
+_recorder = HostEventRecorder()
+
+
+def get_recorder() -> HostEventRecorder:
+    return _recorder
+
+
+def now_ns() -> int:
+    return time.perf_counter_ns()

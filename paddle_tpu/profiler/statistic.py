@@ -1,0 +1,97 @@
+"""Span aggregation + text report.
+
+Reference parity: `python/paddle/profiler/profiler_statistic.py` (SortedKeys,
+StatisticData, per-event-type and per-name tables with count/total/avg/max/min
+and ratio columns).
+"""
+from __future__ import annotations
+
+from enum import Enum
+from typing import Dict, List, Optional
+
+from .recorder import HostSpan
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    Calls = 4
+
+
+class _Item:
+    __slots__ = ("name", "calls", "total_ns", "max_ns", "min_ns")
+
+    def __init__(self, name):
+        self.name = name
+        self.calls = 0
+        self.total_ns = 0
+        self.max_ns = 0
+        self.min_ns = None
+
+    def add(self, span: HostSpan):
+        d = span.dur_ns
+        self.calls += 1
+        self.total_ns += d
+        self.max_ns = max(self.max_ns, d)
+        self.min_ns = d if self.min_ns is None else min(self.min_ns, d)
+
+    @property
+    def avg_ns(self):
+        return self.total_ns / self.calls if self.calls else 0
+
+
+class StatisticData:
+    """Aggregates host spans by name and event type."""
+
+    def __init__(self, spans: List[HostSpan]):
+        self.spans = spans
+        self.by_name: Dict[str, _Item] = {}
+        self.by_type: Dict[str, _Item] = {}
+        for s in spans:
+            self.by_name.setdefault(s.name, _Item(s.name)).add(s)
+            self.by_type.setdefault(s.event_type, _Item(s.event_type)).add(s)
+        if spans:
+            self.wall_ns = (max(s.end_ns for s in spans)
+                            - min(s.start_ns for s in spans))
+        else:
+            self.wall_ns = 0
+
+
+_SORT_ATTR = {
+    SortedKeys.CPUTotal: "total_ns",
+    SortedKeys.CPUAvg: "avg_ns",
+    SortedKeys.CPUMax: "max_ns",
+    SortedKeys.CPUMin: "min_ns",
+    SortedKeys.Calls: "calls",
+}
+
+
+def _fmt(ns: float, unit: str) -> str:
+    div = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}[unit]
+    return f"{ns / div:.3f}"
+
+
+def summary_report(data: StatisticData, sorted_by: Optional[SortedKeys] = None,
+                   time_unit: str = "ms") -> str:
+    sorted_by = sorted_by or SortedKeys.CPUTotal
+    attr = _SORT_ATTR[sorted_by]
+    rows = sorted(data.by_name.values(),
+                  key=lambda it: getattr(it, attr) or 0, reverse=True)
+    name_w = max([len(r.name) for r in rows], default=4)
+    name_w = max(name_w, 4)
+    header = (f"{'Name':<{name_w}}  {'Calls':>7}  {'Total(' + time_unit + ')':>12}  "
+              f"{'Avg(' + time_unit + ')':>12}  {'Max(' + time_unit + ')':>12}  "
+              f"{'Min(' + time_unit + ')':>12}  {'Ratio(%)':>8}")
+    lines = ["-" * len(header), header, "-" * len(header)]
+    total = sum(r.total_ns for r in rows) or 1
+    for r in rows:
+        lines.append(
+            f"{r.name:<{name_w}}  {r.calls:>7}  {_fmt(r.total_ns, time_unit):>12}  "
+            f"{_fmt(r.avg_ns, time_unit):>12}  {_fmt(r.max_ns, time_unit):>12}  "
+            f"{_fmt(r.min_ns or 0, time_unit):>12}  {100 * r.total_ns / total:>8.2f}")
+    lines.append("-" * len(header))
+    lines.append(f"Wall clock: {_fmt(data.wall_ns, time_unit)} {time_unit}; "
+                 f"{len(data.spans)} spans, {len(data.by_name)} distinct names")
+    return "\n".join(lines)

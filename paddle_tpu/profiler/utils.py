@@ -1,0 +1,87 @@
+"""RecordEvent + result loading.
+
+Reference parity: `python/paddle/profiler/utils.py:31` (RecordEvent
+ContextDecorator), `:125` (load_profiler_result), `:153` (wrap_optimizers).
+Each span is recorded to the host recorder AND annotated into any active
+jax.profiler device trace (`jax.profiler.TraceAnnotation` — the XLA analog of
+nvtx ranges the reference emits for CUPTI correlation).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from contextlib import ContextDecorator
+from typing import Optional
+
+import jax
+
+from .recorder import HostSpan, get_recorder, now_ns
+
+
+class TracerEventType:
+    Operator = "Operator"
+    Dataloader = "Dataloader"
+    ProfileStep = "ProfileStep"
+    UserDefined = "UserDefined"
+    Forward = "Forward"
+    Backward = "Backward"
+    Optimization = "Optimization"
+    Communication = "Communication"
+
+
+class RecordEvent(ContextDecorator):
+    """RAII profiling span (reference `utils.py:31` / C++ `RecordEvent`)."""
+
+    def __init__(self, name: str, event_type: str = TracerEventType.UserDefined):
+        self.name = name
+        self.event_type = event_type
+        self._start = None
+        self._jax_ann = None
+
+    def begin(self):
+        rec = get_recorder()
+        self._start = now_ns()
+        if rec.enabled:
+            rec.span_stack().append(self.name)
+            try:
+                self._jax_ann = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ann.__enter__()
+            except Exception:
+                self._jax_ann = None
+
+    def end(self):
+        if self._start is None:
+            return
+        rec = get_recorder()
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(None, None, None)
+            self._jax_ann = None
+        if rec.enabled:
+            stack = rec.span_stack()
+            if stack and stack[-1] == self.name:
+                stack.pop()
+            parent = stack[-1] if stack else None
+            rec.push(HostSpan(name=self.name, start_ns=self._start,
+                              end_ns=now_ns(), tid=threading.get_ident(),
+                              event_type=self.event_type, parent=parent))
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def load_profiler_result(filename: str):
+    """Load a chrome-trace JSON exported by Profiler.export (`utils.py:125`)."""
+    with open(filename) as f:
+        return json.load(f)
+
+
+def wrap_optimizers():
+    """No-op for parity: optimizer.step is already spanned via RecordEvent in
+    Profiler-enabled training loops (reference monkey-patches optimizers)."""
+    return None

@@ -55,6 +55,12 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
     """Slice input into (overlapping) frames (reference `signal.py:32`)."""
     if frame_length <= 0 or hop_length <= 0:
         raise ValueError("frame_length and hop_length must be positive")
+    xd = x.data if isinstance(x, Tensor) else x
+    seq_len = xd.shape[0] if axis == 0 else xd.shape[-1]
+    if frame_length > seq_len:
+        raise ValueError(
+            f"frame_length ({frame_length}) should be less or equal than "
+            f"sequence length ({seq_len})")
     return _d.call(_frame_impl, (x,),
                    kwargs=dict(frame_length=int(frame_length),
                                hop_length=int(hop_length), axis=int(axis)),

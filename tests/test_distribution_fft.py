@@ -107,6 +107,23 @@ class TestTapeIntegration:
         kl = float(D.kl_divergence(d, q).numpy())
         assert np.isfinite(kl)
 
+    def test_categorical_log_prob_rank_broadcast(self):
+        # scalar / sub-batch-rank value against a batched Categorical
+        probs = np.array([[0.5, 0.5], [0.2, 0.8]], dtype=np.float32)
+        d = D.Categorical(probs=probs)
+        lp = d.log_prob(paddle.to_tensor(np.int32(1))).numpy()
+        np.testing.assert_allclose(lp, np.log(probs[:, 1]), rtol=1e-5)
+
+    def test_transformed_shape_metadata(self):
+        base = D.Normal(np.zeros(3, np.float32), np.ones(3, np.float32))
+        d = D.TransformedDistribution(base, [D.StickBreakingTransform()])
+        assert d.sample().shape == [4]
+        assert d.batch_shape + d.event_shape == (4,)
+
+    def test_frame_too_long_raises(self):
+        with pytest.raises(ValueError, match="frame_length"):
+            psignal.frame(paddle.to_tensor(np.arange(3, dtype=np.float32)), 8, 2)
+
     def test_register_kl_after_first_dispatch(self):
         class _MyNormal(D.Normal):
             pass

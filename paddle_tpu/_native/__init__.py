@@ -1,0 +1,145 @@
+"""Native (C++) runtime components, built on demand with the system toolchain.
+
+The reference ships its runtime as compiled C++ (parameter server
+`/root/reference/paddle/fluid/distributed/ps/`, TCPStore
+`distributed/store/tcp_store.h`, data feed `framework/data_feed.cc`). This
+package holds our TPU-native equivalents under `csrc/` and compiles them into
+one shared library the first time they are needed (g++ is part of the
+supported environment; there is no separate wheel build step). ctypes replaces
+pybind11 as the binding layer.
+"""
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import os
+import pathlib
+import subprocess
+import threading
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_CSRC = _DIR / "csrc"
+_BUILD = _DIR / "build"
+_LIB = _BUILD / "libpaddle_tpu_native.so"
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _sources():
+    return sorted(_CSRC.glob("*.cc"))
+
+
+def _headers():
+    return sorted(_CSRC.glob("*.h"))
+
+
+def _stale() -> bool:
+    if not _LIB.exists():
+        return True
+    lib_mtime = _LIB.stat().st_mtime
+    return any(p.stat().st_mtime > lib_mtime for p in (*_sources(), *_headers()))
+
+
+def build(verbose: bool = False) -> pathlib.Path:
+    """Compile csrc/*.cc -> libpaddle_tpu_native.so (idempotent, file-locked)."""
+    _BUILD.mkdir(exist_ok=True)
+    lockfile = _BUILD / ".build.lock"
+    with open(lockfile, "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)  # serialize across processes
+        try:
+            if not _stale():
+                return _LIB
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                   "-o", str(_LIB)] + [str(s) for s in _sources()]
+            if verbose:
+                print("[paddle_tpu._native]", " ".join(cmd))
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+            return _LIB
+        finally:
+            fcntl.flock(lk, fcntl.LOCK_UN)
+
+
+def load() -> ctypes.CDLL:
+    """Load (building if needed) the native library and declare signatures."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        build()
+        lib = ctypes.CDLL(str(_LIB))
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _declare(lib: ctypes.CDLL):
+    c = ctypes
+    u64p = c.POINTER(c.c_uint64)
+    f32p = c.POINTER(c.c_float)
+
+    # parameter server
+    lib.ps_server_create.restype = c.c_int
+    lib.ps_server_create.argtypes = [c.c_int]
+    lib.ps_server_port.restype = c.c_int
+    lib.ps_server_port.argtypes = [c.c_int]
+    lib.ps_server_start.restype = c.c_int
+    lib.ps_server_start.argtypes = [c.c_int]
+    lib.ps_server_wait.restype = c.c_int
+    lib.ps_server_wait.argtypes = [c.c_int]
+    lib.ps_server_stop.restype = c.c_int
+    lib.ps_server_stop.argtypes = [c.c_int]
+    lib.ps_connect.restype = c.c_int
+    lib.ps_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.ps_ping.restype = c.c_int
+    lib.ps_ping.argtypes = [c.c_int]
+    lib.ps_create_table.restype = c.c_int
+    lib.ps_create_table.argtypes = [c.c_int, c.c_int, c.c_int, c.c_int,
+                                    c.c_int64, c.c_int, c.c_float, c.c_float,
+                                    c.c_uint64]
+    lib.ps_pull_dense.restype = c.c_int
+    lib.ps_pull_dense.argtypes = [c.c_int, c.c_int, f32p, c.c_int64]
+    lib.ps_push_dense.restype = c.c_int
+    lib.ps_push_dense.argtypes = [c.c_int, c.c_int, f32p, c.c_int64]
+    lib.ps_set_dense.restype = c.c_int
+    lib.ps_set_dense.argtypes = [c.c_int, c.c_int, f32p, c.c_int64]
+    lib.ps_pull_sparse.restype = c.c_int
+    lib.ps_pull_sparse.argtypes = [c.c_int, c.c_int, u64p, c.c_int64, f32p,
+                                   c.c_int64]
+    lib.ps_push_sparse.restype = c.c_int
+    lib.ps_push_sparse.argtypes = [c.c_int, c.c_int, u64p, c.c_int64, f32p,
+                                   c.c_int64]
+    lib.ps_table_size.restype = c.c_int64
+    lib.ps_table_size.argtypes = [c.c_int, c.c_int]
+    lib.ps_save.restype = c.c_int
+    lib.ps_save.argtypes = [c.c_int, c.c_char_p]
+    lib.ps_load.restype = c.c_int
+    lib.ps_load.argtypes = [c.c_int, c.c_char_p]
+    lib.ps_barrier.restype = c.c_int
+    lib.ps_barrier.argtypes = [c.c_int, c.c_char_p, c.c_int]
+    lib.ps_stop_server.restype = c.c_int
+    lib.ps_stop_server.argtypes = [c.c_int]
+
+    # TCPStore
+    lib.store_server_create.restype = c.c_int
+    lib.store_server_create.argtypes = [c.c_int]
+    lib.store_server_port.restype = c.c_int
+    lib.store_server_port.argtypes = [c.c_int]
+    lib.store_server_stop.restype = c.c_int
+    lib.store_server_stop.argtypes = [c.c_int]
+    lib.store_connect.restype = c.c_int
+    lib.store_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.store_set.restype = c.c_int
+    lib.store_set.argtypes = [c.c_int, c.c_char_p, c.c_char_p, c.c_int64]
+    lib.store_get.restype = c.c_int64
+    lib.store_get.argtypes = [c.c_int, c.c_char_p, c.c_char_p, c.c_int64]
+    lib.store_add.restype = c.c_int64
+    lib.store_add.argtypes = [c.c_int, c.c_char_p, c.c_int64]
+    lib.store_wait.restype = c.c_int
+    lib.store_wait.argtypes = [c.c_int, c.POINTER(c.c_char_p), c.c_int]
+    lib.store_check.restype = c.c_int
+    lib.store_check.argtypes = [c.c_int, c.c_char_p]
+    lib.store_delete.restype = c.c_int
+    lib.store_delete.argtypes = [c.c_int, c.c_char_p]
+    lib.store_stop_server_via_client.restype = c.c_int
+    lib.store_stop_server_via_client.argtypes = [c.c_int]

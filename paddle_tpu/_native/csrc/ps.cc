@@ -1,0 +1,834 @@
+// Host-side parameter server: dense + sparse tables with in-table optimizers,
+// served over TCP to trainer processes.
+//
+// TPU-native rebuild of the reference's PS-core
+// (/root/reference/paddle/fluid/distributed/ps/): BrpcPsServer/BrpcPsClient
+// (ps/service/brpc_ps_server.cc, brpc_ps_client.h:137) become a framed-TCP
+// server; ps/table/common_dense_table.cc and memory_sparse_table.cc become
+// DenseTable/SparseTable below, keeping the key design points:
+//   * sparse rows are created lazily on first pull (CTR-style feasign space),
+//   * the optimizer runs inside the table on push (server-side SGD/Adagrad/
+//     Adam, reference table/sparse_sgd_rule.cc),
+//   * tables are sharded internally for concurrent access (reference shards
+//     by feasign across "buckets"; we shard the hash map + mutex),
+//   * save/load to a directory, one file per table (table/io semantics).
+// On TPU the dense math lives in XLA; this server exists for the 100B-feature
+// embedding workloads (Wide&Deep/DeepFM) whose tables exceed HBM.
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "net.h"
+
+namespace ps {
+
+using ptnet::Reader;
+using ptnet::Writer;
+
+enum Cmd : uint8_t {
+  CMD_CREATE_TABLE = 1,
+  CMD_PULL_DENSE = 2,
+  CMD_PUSH_DENSE = 3,
+  CMD_SET_DENSE = 4,
+  CMD_PULL_SPARSE = 5,
+  CMD_PUSH_SPARSE = 6,
+  CMD_SAVE = 7,
+  CMD_LOAD = 8,
+  CMD_BARRIER = 9,
+  CMD_STOP = 10,
+  CMD_TABLE_SIZE = 11,
+  CMD_PING = 12,
+};
+
+enum Opt : uint8_t { OPT_SGD = 0, OPT_ADAGRAD = 1, OPT_ADAM = 2 };
+
+enum Status : uint8_t { ST_OK = 0, ST_ERR = 1 };
+
+// splitmix64 — deterministic per-key init rng (lazy rows reproduce across
+// save/load-free restarts, mirroring the reference's seeded init rules).
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+static inline float unit_uniform(uint64_t h) {
+  // [0,1) from the top 24 bits
+  return static_cast<float>(h >> 40) / static_cast<float>(1ULL << 24);
+}
+
+struct TableConfig {
+  uint8_t kind = 1;  // 0 dense, 1 sparse
+  int32_t dim = 8;
+  int64_t dense_size = 0;
+  uint8_t opt = OPT_SGD;
+  float lr = 0.01f;
+  float init_range = 0.05f;
+  uint64_t seed = 0;
+  // adam hyperparams (fixed defaults, as in reference sparse_adam rule)
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+};
+
+static int state_slots(uint8_t opt) {
+  switch (opt) {
+    case OPT_ADAGRAD: return 1;  // accumulator
+    case OPT_ADAM: return 2;     // m, v
+    default: return 0;
+  }
+}
+
+// One sparse row: [step][values dim][state dim*slots]
+struct SparseEntry {
+  uint32_t step = 0;
+  std::vector<float> data;  // dim * (1 + slots)
+};
+
+class SparseTable {
+ public:
+  explicit SparseTable(const TableConfig& cfg) : cfg_(cfg) {}
+
+  static constexpr int kShards = 16;
+
+  void pull(const uint64_t* keys, int64_t n, float* out) {
+    const int dim = cfg_.dim;
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t k = keys[i];
+      Shard& s = shard(k);
+      std::lock_guard<std::mutex> g(s.mu);
+      SparseEntry& e = fetch_or_init(s, k);
+      std::memcpy(out + i * dim, e.data.data(), dim * sizeof(float));
+    }
+  }
+
+  void push(const uint64_t* keys, int64_t n, const float* grads) {
+    const int dim = cfg_.dim;
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t k = keys[i];
+      Shard& s = shard(k);
+      std::lock_guard<std::mutex> g(s.mu);
+      SparseEntry& e = fetch_or_init(s, k);
+      apply(&e, grads + i * dim);
+    }
+  }
+
+  int64_t size() const {
+    int64_t t = 0;
+    for (const Shard& s : shards_) {
+      std::lock_guard<std::mutex> g(s.mu);
+      t += static_cast<int64_t>(s.map.size());
+    }
+    return t;
+  }
+
+  bool save(FILE* f) const {
+    int64_t n = size();
+    fwrite(&n, 8, 1, f);
+    const size_t row = cfg_.dim * (1 + state_slots(cfg_.opt));
+    for (const Shard& s : shards_) {
+      std::lock_guard<std::mutex> g(s.mu);
+      for (const auto& kv : s.map) {
+        fwrite(&kv.first, 8, 1, f);
+        fwrite(&kv.second.step, 4, 1, f);
+        fwrite(kv.second.data.data(), sizeof(float), row, f);
+      }
+    }
+    return true;
+  }
+
+  bool load(FILE* f) {
+    int64_t n = 0;
+    if (fread(&n, 8, 1, f) != 1) return false;
+    const size_t row = cfg_.dim * (1 + state_slots(cfg_.opt));
+    for (int64_t i = 0; i < n; ++i) {
+      uint64_t k;
+      SparseEntry e;
+      e.data.resize(row);
+      if (fread(&k, 8, 1, f) != 1) return false;
+      if (fread(&e.step, 4, 1, f) != 1) return false;
+      if (fread(e.data.data(), sizeof(float), row, f) != row) return false;
+      Shard& s = shard(k);
+      std::lock_guard<std::mutex> g(s.mu);
+      s.map[k] = std::move(e);
+    }
+    return true;
+  }
+
+  const TableConfig& config() const { return cfg_; }
+
+ private:
+  struct Shard {
+    mutable std::mutex mu;
+    std::unordered_map<uint64_t, SparseEntry> map;
+  };
+
+  Shard& shard(uint64_t key) {
+    return shards_[splitmix64(key) % kShards];
+  }
+
+  SparseEntry& fetch_or_init(Shard& s, uint64_t key) {
+    auto it = s.map.find(key);
+    if (it != s.map.end()) return it->second;
+    SparseEntry e;
+    e.data.assign(cfg_.dim * (1 + state_slots(cfg_.opt)), 0.0f);
+    uint64_t h = splitmix64(key ^ cfg_.seed);
+    for (int d = 0; d < cfg_.dim; ++d) {
+      h = splitmix64(h);
+      e.data[d] = (unit_uniform(h) * 2.0f - 1.0f) * cfg_.init_range;
+    }
+    return s.map.emplace(key, std::move(e)).first->second;
+  }
+
+  void apply(SparseEntry* e, const float* g) {
+    const int dim = cfg_.dim;
+    float* w = e->data.data();
+    switch (cfg_.opt) {
+      case OPT_SGD:
+        for (int d = 0; d < dim; ++d) w[d] -= cfg_.lr * g[d];
+        break;
+      case OPT_ADAGRAD: {
+        float* acc = w + dim;
+        for (int d = 0; d < dim; ++d) {
+          acc[d] += g[d] * g[d];
+          w[d] -= cfg_.lr * g[d] / (std::sqrt(acc[d]) + cfg_.eps);
+        }
+        break;
+      }
+      case OPT_ADAM: {
+        float* m = w + dim;
+        float* v = w + 2 * dim;
+        e->step += 1;
+        const float b1 = cfg_.beta1, b2 = cfg_.beta2;
+        const float bc1 = 1.0f - std::pow(b1, static_cast<float>(e->step));
+        const float bc2 = 1.0f - std::pow(b2, static_cast<float>(e->step));
+        for (int d = 0; d < dim; ++d) {
+          m[d] = b1 * m[d] + (1 - b1) * g[d];
+          v[d] = b2 * v[d] + (1 - b2) * g[d] * g[d];
+          w[d] -= cfg_.lr * (m[d] / bc1) / (std::sqrt(v[d] / bc2) + cfg_.eps);
+        }
+        break;
+      }
+    }
+  }
+
+  TableConfig cfg_;
+  Shard shards_[kShards];
+};
+
+class DenseTable {
+ public:
+  explicit DenseTable(const TableConfig& cfg) : cfg_(cfg) {
+    w_.assign(cfg.dense_size, 0.0f);
+    state_.assign(cfg.dense_size * state_slots(cfg.opt), 0.0f);
+    uint64_t h = splitmix64(cfg.seed ^ 0xD15EA5E5ULL);
+    for (int64_t i = 0; i < cfg.dense_size; ++i) {
+      h = splitmix64(h);
+      w_[i] = (unit_uniform(h) * 2.0f - 1.0f) * cfg.init_range;
+    }
+  }
+
+  void pull(float* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::memcpy(out, w_.data(), w_.size() * sizeof(float));
+  }
+
+  void set(const float* vals) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::memcpy(w_.data(), vals, w_.size() * sizeof(float));
+  }
+
+  void push(const float* g) {
+    std::lock_guard<std::mutex> gd(mu_);
+    const int64_t n = static_cast<int64_t>(w_.size());
+    float* w = w_.data();
+    switch (cfg_.opt) {
+      case OPT_SGD:
+        for (int64_t i = 0; i < n; ++i) w[i] -= cfg_.lr * g[i];
+        break;
+      case OPT_ADAGRAD: {
+        float* acc = state_.data();
+        for (int64_t i = 0; i < n; ++i) {
+          acc[i] += g[i] * g[i];
+          w[i] -= cfg_.lr * g[i] / (std::sqrt(acc[i]) + cfg_.eps);
+        }
+        break;
+      }
+      case OPT_ADAM: {
+        float* m = state_.data();
+        float* v = state_.data() + n;
+        step_ += 1;
+        const float b1 = cfg_.beta1, b2 = cfg_.beta2;
+        const float bc1 = 1.0f - std::pow(b1, static_cast<float>(step_));
+        const float bc2 = 1.0f - std::pow(b2, static_cast<float>(step_));
+        for (int64_t i = 0; i < n; ++i) {
+          m[i] = b1 * m[i] + (1 - b1) * g[i];
+          v[i] = b2 * v[i] + (1 - b2) * g[i] * g[i];
+          w[i] -= cfg_.lr * (m[i] / bc1) / (std::sqrt(v[i] / bc2) + cfg_.eps);
+        }
+        break;
+      }
+    }
+  }
+
+  int64_t size() const { return static_cast<int64_t>(w_.size()); }
+
+  bool save(FILE* f) const {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t n = size();
+    fwrite(&n, 8, 1, f);
+    fwrite(&step_, 4, 1, f);
+    fwrite(w_.data(), sizeof(float), w_.size(), f);
+    fwrite(state_.data(), sizeof(float), state_.size(), f);
+    return true;
+  }
+
+  bool load(FILE* f) {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t n = 0;
+    if (fread(&n, 8, 1, f) != 1 || n != size()) return false;
+    if (fread(&step_, 4, 1, f) != 1) return false;
+    if (fread(w_.data(), sizeof(float), w_.size(), f) != w_.size()) return false;
+    if (!state_.empty() &&
+        fread(state_.data(), sizeof(float), state_.size(), f) != state_.size())
+      return false;
+    return true;
+  }
+
+  const TableConfig& config() const { return cfg_; }
+
+ private:
+  TableConfig cfg_;
+  mutable std::mutex mu_;
+  std::vector<float> w_;
+  std::vector<float> state_;
+  uint32_t step_ = 0;
+};
+
+struct Barrier {
+  int count = 0;
+  int64_t generation = 0;
+  std::condition_variable cv;
+};
+
+class Server {
+ public:
+  explicit Server(int port) {
+    listen_fd_ = ptnet::listen_on(port);
+    if (listen_fd_ >= 0) port_ = ptnet::bound_port(listen_fd_);
+  }
+
+  ~Server() { stop(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void start() {
+    running_ = true;
+    accept_thread_ = std::thread([this] { accept_loop(); });
+  }
+
+  void run() {
+    running_ = true;
+    accept_loop();
+  }
+
+  void stop() {
+    if (!running_.exchange(false)) {
+      if (listen_fd_ >= 0) { ::close(listen_fd_); listen_fd_ = -1; }
+    } else if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    {
+      std::lock_guard<std::mutex> g(barrier_mu_);
+      for (auto& kv : barriers_) kv.second.cv.notify_all();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> g(conn_mu_);
+    // unblock connection threads parked in recv() so they can be joined
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+    conn_threads_.clear();
+    conn_fds_.clear();
+  }
+
+  void wait() {  // block until STOP command arrives
+    std::unique_lock<std::mutex> lk(stopped_mu_);
+    stopped_cv_.wait(lk, [this] { return stopped_flag_; });
+  }
+
+ private:
+  void accept_loop() {
+    while (running_) {
+      int cfd = ::accept(listen_fd_, nullptr, nullptr);
+      if (cfd < 0) break;
+      int one = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(conn_mu_);
+      conn_fds_.push_back(cfd);
+      conn_threads_.emplace_back([this, cfd] { serve(cfd); });
+    }
+  }
+
+  void serve(int fd) {
+    std::vector<char> body;
+    while (running_) {
+      if (!ptnet::recv_frame(fd, &body)) break;
+      if (body.empty()) break;
+      Reader r(body.data(), body.size());
+      uint8_t cmd = r.u8();
+      int32_t tid = r.i32();
+      Writer resp;
+      bool keep = handle(cmd, tid, &r, &resp);
+      ptnet::send_frame(fd, resp);
+      if (!keep) break;
+    }
+    ::close(fd);
+  }
+
+  bool handle(uint8_t cmd, int32_t tid, Reader* r, Writer* resp) {
+    switch (cmd) {
+      case CMD_PING:
+        resp->u8(ST_OK);
+        return true;
+      case CMD_CREATE_TABLE: {
+        TableConfig cfg;
+        cfg.kind = r->u8();
+        cfg.dim = r->i32();
+        cfg.dense_size = r->i64();
+        cfg.opt = r->u8();
+        cfg.lr = r->f32();
+        cfg.init_range = r->f32();
+        cfg.seed = r->u64();
+        std::lock_guard<std::mutex> g(tables_mu_);
+        if (cfg.kind == 0) {
+          if (!dense_.count(tid)) dense_[tid] = std::make_unique<DenseTable>(cfg);
+        } else {
+          if (!sparse_.count(tid)) sparse_[tid] = std::make_unique<SparseTable>(cfg);
+        }
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_PULL_DENSE: {
+        DenseTable* t = dense(tid);
+        if (!t) return err(resp, "no such dense table");
+        resp->u8(ST_OK);
+        resp->i64(t->size());
+        size_t off = resp->buf.size();
+        resp->buf.resize(off + t->size() * sizeof(float));
+        t->pull(reinterpret_cast<float*>(resp->buf.data() + off));
+        return true;
+      }
+      case CMD_PUSH_DENSE: {
+        DenseTable* t = dense(tid);
+        if (!t) return err(resp, "no such dense table");
+        int64_t n = r->i64();
+        if (n != t->size()) return err(resp, "dense size mismatch");
+        t->push(reinterpret_cast<const float*>(r->raw(n * sizeof(float))));
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_SET_DENSE: {
+        DenseTable* t = dense(tid);
+        if (!t) return err(resp, "no such dense table");
+        int64_t n = r->i64();
+        if (n != t->size()) return err(resp, "dense size mismatch");
+        t->set(reinterpret_cast<const float*>(r->raw(n * sizeof(float))));
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_PULL_SPARSE: {
+        SparseTable* t = sparse(tid);
+        if (!t) return err(resp, "no such sparse table");
+        int64_t n = r->i64();
+        const uint64_t* keys =
+            reinterpret_cast<const uint64_t*>(r->raw(n * sizeof(uint64_t)));
+        resp->u8(ST_OK);
+        resp->i64(n * t->config().dim);
+        size_t off = resp->buf.size();
+        resp->buf.resize(off + n * t->config().dim * sizeof(float));
+        t->pull(keys, n, reinterpret_cast<float*>(resp->buf.data() + off));
+        return true;
+      }
+      case CMD_PUSH_SPARSE: {
+        SparseTable* t = sparse(tid);
+        if (!t) return err(resp, "no such sparse table");
+        int64_t n = r->i64();
+        const uint64_t* keys =
+            reinterpret_cast<const uint64_t*>(r->raw(n * sizeof(uint64_t)));
+        const float* grads = reinterpret_cast<const float*>(
+            r->raw(n * t->config().dim * sizeof(float)));
+        t->push(keys, n, grads);
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_TABLE_SIZE: {
+        std::lock_guard<std::mutex> g(tables_mu_);
+        auto it = sparse_.find(tid);
+        int64_t n = (it != sparse_.end()) ? it->second->size() : -1;
+        resp->u8(ST_OK);
+        resp->i64(n);
+        return true;
+      }
+      case CMD_SAVE: {
+        std::string dir = r->str();
+        std::lock_guard<std::mutex> g(tables_mu_);
+        for (auto& kv : dense_)
+          if (!save_one(dir, kv.first, /*sparse=*/false))
+            return err(resp, "save failed");
+        for (auto& kv : sparse_)
+          if (!save_one(dir, kv.first, /*sparse=*/true))
+            return err(resp, "save failed");
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_LOAD: {
+        std::string dir = r->str();
+        std::lock_guard<std::mutex> g(tables_mu_);
+        for (auto& kv : dense_)
+          if (!load_one(dir, kv.first, /*sparse=*/false))
+            return err(resp, "load failed");
+        for (auto& kv : sparse_)
+          if (!load_one(dir, kv.first, /*sparse=*/true))
+            return err(resp, "load failed");
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_BARRIER: {
+        std::string name = r->str();
+        int32_t world = r->i32();
+        std::unique_lock<std::mutex> lk(barrier_mu_);
+        Barrier& b = barriers_[name];
+        int64_t my_gen = b.generation;
+        if (++b.count >= world) {
+          b.count = 0;
+          b.generation += 1;
+          b.cv.notify_all();
+        } else {
+          b.cv.wait(lk, [&] { return !running_ || b.generation != my_gen; });
+        }
+        resp->u8(running_ ? ST_OK : ST_ERR);
+        return true;
+      }
+      case CMD_STOP: {
+        resp->u8(ST_OK);
+        running_ = false;
+        ::shutdown(listen_fd_, SHUT_RDWR);
+        {
+          std::lock_guard<std::mutex> g(stopped_mu_);
+          stopped_flag_ = true;
+        }
+        stopped_cv_.notify_all();
+        return false;
+      }
+      default:
+        return err(resp, "bad command");
+    }
+  }
+
+  bool err(Writer* resp, const char* msg) {
+    resp->buf.clear();
+    resp->u8(ST_ERR);
+    resp->str(msg);
+    return true;
+  }
+
+  DenseTable* dense(int32_t tid) {
+    std::lock_guard<std::mutex> g(tables_mu_);
+    auto it = dense_.find(tid);
+    return it == dense_.end() ? nullptr : it->second.get();
+  }
+
+  SparseTable* sparse(int32_t tid) {
+    std::lock_guard<std::mutex> g(tables_mu_);
+    auto it = sparse_.find(tid);
+    return it == sparse_.end() ? nullptr : it->second.get();
+  }
+
+  std::string table_path(const std::string& dir, int32_t tid, bool sp) const {
+    return dir + "/" + (sp ? "sparse_" : "dense_") + std::to_string(tid) + ".bin";
+  }
+
+  bool save_one(const std::string& dir, int32_t tid, bool sp) {
+    FILE* f = fopen(table_path(dir, tid, sp).c_str(), "wb");
+    if (!f) return false;
+    bool ok = sp ? sparse_[tid]->save(f) : dense_[tid]->save(f);
+    fclose(f);
+    return ok;
+  }
+
+  bool load_one(const std::string& dir, int32_t tid, bool sp) {
+    FILE* f = fopen(table_path(dir, tid, sp).c_str(), "rb");
+    if (!f) return false;
+    bool ok = sp ? sparse_[tid]->load(f) : dense_[tid]->load(f);
+    fclose(f);
+    return ok;
+  }
+
+  int listen_fd_ = -1;
+  int port_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<int> conn_fds_;
+
+  std::mutex tables_mu_;
+  std::map<int32_t, std::unique_ptr<DenseTable>> dense_;
+  std::map<int32_t, std::unique_ptr<SparseTable>> sparse_;
+
+  std::mutex barrier_mu_;
+  std::map<std::string, Barrier> barriers_;
+
+  std::mutex stopped_mu_;
+  std::condition_variable stopped_cv_;
+  bool stopped_flag_ = false;
+};
+
+// ------------------------------ client -------------------------------------
+
+class Client {
+ public:
+  Client(const std::string& host, int port, int timeout_ms) {
+    fd_ = ptnet::connect_to(host, port, timeout_ms);
+  }
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  bool ok() const { return fd_ >= 0; }
+
+  // Returns ST_OK/ST_ERR; resp body (after status byte) in `out`.
+  int request(const Writer& w, std::vector<char>* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (fd_ < 0) return -1;
+    if (!ptnet::send_frame(fd_, w)) return -1;
+    std::vector<char> body;
+    if (!ptnet::recv_frame(fd_, &body) || body.empty()) return -1;
+    uint8_t st = static_cast<uint8_t>(body[0]);
+    out->assign(body.begin() + 1, body.end());
+    return st;
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace ps
+
+// ----------------------------- C API ---------------------------------------
+// ctypes-facing flat API (the rebuild's pybind layer, reference
+// paddle/fluid/pybind/ — we use ctypes over extern "C" instead of pybind11).
+
+namespace {
+std::mutex g_mu;
+std::vector<std::unique_ptr<ps::Server>> g_servers;
+std::vector<std::unique_ptr<ps::Client>> g_clients;
+
+ps::Server* server(int h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (h < 0 || h >= static_cast<int>(g_servers.size())) return nullptr;
+  return g_servers[h].get();
+}
+
+ps::Client* client(int h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (h < 0 || h >= static_cast<int>(g_clients.size())) return nullptr;
+  return g_clients[h].get();
+}
+}  // namespace
+
+extern "C" {
+
+int ps_server_create(int port) {
+  auto s = std::make_unique<ps::Server>(port);
+  if (!s->ok()) return -1;
+  std::lock_guard<std::mutex> g(g_mu);
+  g_servers.push_back(std::move(s));
+  return static_cast<int>(g_servers.size()) - 1;
+}
+
+int ps_server_port(int h) {
+  ps::Server* s = server(h);
+  return s ? s->port() : -1;
+}
+
+int ps_server_start(int h) {
+  ps::Server* s = server(h);
+  if (!s) return -1;
+  s->start();
+  return 0;
+}
+
+int ps_server_wait(int h) {
+  ps::Server* s = server(h);
+  if (!s) return -1;
+  s->wait();
+  return 0;
+}
+
+int ps_server_stop(int h) {
+  ps::Server* s = server(h);
+  if (!s) return -1;
+  s->stop();
+  return 0;
+}
+
+int ps_connect(const char* host, int port, int timeout_ms) {
+  auto c = std::make_unique<ps::Client>(host, port, timeout_ms);
+  if (!c->ok()) return -1;
+  std::lock_guard<std::mutex> g(g_mu);
+  g_clients.push_back(std::move(c));
+  return static_cast<int>(g_clients.size()) - 1;
+}
+
+static int simple_req(int h, ps::Writer& w) {
+  ps::Client* c = client(h);
+  if (!c) return -1;
+  std::vector<char> out;
+  int st = c->request(w, &out);
+  return st == ps::ST_OK ? 0 : -1;
+}
+
+int ps_ping(int h) {
+  ps::Writer w;
+  w.u8(ps::CMD_PING);
+  w.i32(0);
+  return simple_req(h, w);
+}
+
+int ps_create_table(int h, int table_id, int kind, int dim, int64_t dense_size,
+                    int opt, float lr, float init_range, uint64_t seed) {
+  ps::Writer w;
+  w.u8(ps::CMD_CREATE_TABLE);
+  w.i32(table_id);
+  w.u8(static_cast<uint8_t>(kind));
+  w.i32(dim);
+  w.i64(dense_size);
+  w.u8(static_cast<uint8_t>(opt));
+  w.f32(lr);
+  w.f32(init_range);
+  w.u64(seed);
+  return simple_req(h, w);
+}
+
+int ps_pull_dense(int h, int table_id, float* out, int64_t n) {
+  ps::Client* c = client(h);
+  if (!c) return -1;
+  ps::Writer w;
+  w.u8(ps::CMD_PULL_DENSE);
+  w.i32(table_id);
+  std::vector<char> body;
+  if (c->request(w, &body) != ps::ST_OK) return -1;
+  ps::Reader r(body.data(), body.size());
+  int64_t got = r.i64();
+  if (got != n) return -1;
+  std::memcpy(out, r.raw(n * sizeof(float)), n * sizeof(float));
+  return 0;
+}
+
+int ps_push_dense(int h, int table_id, const float* grad, int64_t n) {
+  ps::Writer w;
+  w.u8(ps::CMD_PUSH_DENSE);
+  w.i32(table_id);
+  w.i64(n);
+  w.bytes(grad, n * sizeof(float));
+  return simple_req(h, w);
+}
+
+int ps_set_dense(int h, int table_id, const float* vals, int64_t n) {
+  ps::Writer w;
+  w.u8(ps::CMD_SET_DENSE);
+  w.i32(table_id);
+  w.i64(n);
+  w.bytes(vals, n * sizeof(float));
+  return simple_req(h, w);
+}
+
+int ps_pull_sparse(int h, int table_id, const uint64_t* keys, int64_t n,
+                   float* out, int64_t out_len) {
+  ps::Client* c = client(h);
+  if (!c) return -1;
+  ps::Writer w;
+  w.u8(ps::CMD_PULL_SPARSE);
+  w.i32(table_id);
+  w.i64(n);
+  w.bytes(keys, n * sizeof(uint64_t));
+  std::vector<char> body;
+  if (c->request(w, &body) != ps::ST_OK) return -1;
+  ps::Reader r(body.data(), body.size());
+  int64_t got = r.i64();
+  if (got != out_len) return -1;
+  std::memcpy(out, r.raw(got * sizeof(float)), got * sizeof(float));
+  return 0;
+}
+
+int ps_push_sparse(int h, int table_id, const uint64_t* keys, int64_t n,
+                   const float* grads, int64_t grad_len) {
+  ps::Writer w;
+  w.u8(ps::CMD_PUSH_SPARSE);
+  w.i32(table_id);
+  w.i64(n);
+  w.bytes(keys, n * sizeof(uint64_t));
+  w.bytes(grads, grad_len * sizeof(float));
+  return simple_req(h, w);
+}
+
+int64_t ps_table_size(int h, int table_id) {
+  ps::Client* c = client(h);
+  if (!c) return -1;
+  ps::Writer w;
+  w.u8(ps::CMD_TABLE_SIZE);
+  w.i32(table_id);
+  std::vector<char> body;
+  if (c->request(w, &body) != ps::ST_OK) return -1;
+  ps::Reader r(body.data(), body.size());
+  return r.i64();
+}
+
+int ps_save(int h, const char* dir) {
+  ps::Writer w;
+  w.u8(ps::CMD_SAVE);
+  w.i32(-1);
+  w.str(dir);
+  return simple_req(h, w);
+}
+
+int ps_load(int h, const char* dir) {
+  ps::Writer w;
+  w.u8(ps::CMD_LOAD);
+  w.i32(-1);
+  w.str(dir);
+  return simple_req(h, w);
+}
+
+int ps_barrier(int h, const char* name, int world) {
+  ps::Writer w;
+  w.u8(ps::CMD_BARRIER);
+  w.i32(-1);
+  w.str(name);
+  w.i32(world);
+  return simple_req(h, w);
+}
+
+int ps_stop_server(int h) {
+  ps::Writer w;
+  w.u8(ps::CMD_STOP);
+  w.i32(-1);
+  return simple_req(h, w);
+}
+
+}  // extern "C"

@@ -1,0 +1,357 @@
+// TCPStore: blocking key-value rendezvous store.
+//
+// Native rebuild of the reference's TCPStore
+// (/root/reference/paddle/fluid/distributed/store/tcp_store.h:91): a master
+// rank runs the socket server; every rank (master included) connects as a
+// client. Semantics kept: set(key, bytes), get(key) -> blocking wait until
+// the key exists, add(key, delta) -> atomic int64 counter, wait(keys) ->
+// block until all exist. Used for process-group bootstrap the same way the
+// reference broadcasts ncclUniqueId (ProcessGroupNCCL.cc:109); here it
+// carries the jax.distributed coordinator address + launch-layer metadata.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "net.h"
+
+namespace store {
+
+using ptnet::Reader;
+using ptnet::Writer;
+
+enum Cmd : uint8_t {
+  CMD_SET = 1,
+  CMD_GET = 2,   // blocking: waits until key exists
+  CMD_ADD = 3,
+  CMD_WAIT = 4,  // blocking on a list of keys
+  CMD_CHECK = 5, // non-blocking existence check
+  CMD_DELETE = 6,
+  CMD_STOP = 7,
+};
+
+enum Status : uint8_t { ST_OK = 0, ST_ERR = 1, ST_TIMEOUT = 2 };
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) {
+    listen_fd_ = ptnet::listen_on(port);
+    if (listen_fd_ >= 0) port_ = ptnet::bound_port(listen_fd_);
+  }
+  ~StoreServer() { stop(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void start() {
+    running_ = true;
+    accept_thread_ = std::thread([this] { accept_loop(); });
+  }
+
+  void stop() {
+    bool was = running_.exchange(false);
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    cv_.notify_all();
+    if (was && accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> g(conn_mu_);
+    // unblock connection threads parked in recv() so they can be joined
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+    conn_threads_.clear();
+    conn_fds_.clear();
+  }
+
+ private:
+  void accept_loop() {
+    while (running_) {
+      int cfd = ::accept(listen_fd_, nullptr, nullptr);
+      if (cfd < 0) break;
+      std::lock_guard<std::mutex> g(conn_mu_);
+      conn_fds_.push_back(cfd);
+      conn_threads_.emplace_back([this, cfd] { serve(cfd); });
+    }
+  }
+
+  void serve(int fd) {
+    std::vector<char> body;
+    while (running_) {
+      if (!ptnet::recv_frame(fd, &body)) break;
+      Reader r(body.data(), body.size());
+      uint8_t cmd = r.u8();
+      Writer resp;
+      bool keep = handle(cmd, &r, &resp);
+      if (!ptnet::send_frame(fd, resp)) break;
+      if (!keep) break;
+    }
+    ::close(fd);
+  }
+
+  bool handle(uint8_t cmd, Reader* r, Writer* resp) {
+    switch (cmd) {
+      case CMD_SET: {
+        std::string key = r->str();
+        std::string val = r->str();
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          kv_[key] = val;
+        }
+        cv_.notify_all();
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_GET: {
+        std::string key = r->str();
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return !running_ || kv_.count(key); });
+        if (!kv_.count(key)) { resp->u8(ST_ERR); return true; }
+        resp->u8(ST_OK);
+        resp->str(kv_[key]);
+        return true;
+      }
+      case CMD_ADD: {
+        std::string key = r->str();
+        int64_t delta = r->i64();
+        int64_t now = 0;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          int64_t cur = 0;
+          auto it = kv_.find(key);
+          if (it != kv_.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          now = cur + delta;
+          std::string v(8, '\0');
+          std::memcpy(&v[0], &now, 8);
+          kv_[key] = v;
+        }
+        cv_.notify_all();
+        resp->u8(ST_OK);
+        resp->i64(now);
+        return true;
+      }
+      case CMD_WAIT: {
+        uint32_t n = r->u32();
+        std::vector<std::string> keys;
+        for (uint32_t i = 0; i < n; ++i) keys.push_back(r->str());
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] {
+          if (!running_) return true;
+          for (const auto& k : keys)
+            if (!kv_.count(k)) return false;
+          return true;
+        });
+        resp->u8(running_ ? ST_OK : ST_ERR);
+        return true;
+      }
+      case CMD_CHECK: {
+        std::string key = r->str();
+        std::lock_guard<std::mutex> g(mu_);
+        resp->u8(ST_OK);
+        resp->u8(kv_.count(key) ? 1 : 0);
+        return true;
+      }
+      case CMD_DELETE: {
+        std::string key = r->str();
+        std::lock_guard<std::mutex> g(mu_);
+        kv_.erase(key);
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_STOP: {
+        resp->u8(ST_OK);
+        running_ = false;
+        ::shutdown(listen_fd_, SHUT_RDWR);
+        cv_.notify_all();
+        return false;
+      }
+      default:
+        resp->u8(ST_ERR);
+        return true;
+    }
+  }
+
+  int listen_fd_ = -1;
+  int port_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<int> conn_fds_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> kv_;
+};
+
+class StoreClient {
+ public:
+  StoreClient(const std::string& host, int port, int timeout_ms) {
+    fd_ = ptnet::connect_to(host, port, timeout_ms);
+  }
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  bool ok() const { return fd_ >= 0; }
+
+  int request(const Writer& w, std::vector<char>* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (fd_ < 0) return -1;
+    if (!ptnet::send_frame(fd_, w)) return -1;
+    std::vector<char> body;
+    if (!ptnet::recv_frame(fd_, &body) || body.empty()) return -1;
+    out->assign(body.begin() + 1, body.end());
+    return static_cast<uint8_t>(body[0]);
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace store
+
+namespace {
+std::mutex gs_mu;
+std::vector<std::unique_ptr<store::StoreServer>> gs_servers;
+std::vector<std::unique_ptr<store::StoreClient>> gs_clients;
+
+store::StoreServer* sserver(int h) {
+  std::lock_guard<std::mutex> g(gs_mu);
+  if (h < 0 || h >= static_cast<int>(gs_servers.size())) return nullptr;
+  return gs_servers[h].get();
+}
+
+store::StoreClient* sclient(int h) {
+  std::lock_guard<std::mutex> g(gs_mu);
+  if (h < 0 || h >= static_cast<int>(gs_clients.size())) return nullptr;
+  return gs_clients[h].get();
+}
+}  // namespace
+
+extern "C" {
+
+int store_server_create(int port) {
+  auto s = std::make_unique<store::StoreServer>(port);
+  if (!s->ok()) return -1;
+  s->start();
+  std::lock_guard<std::mutex> g(gs_mu);
+  gs_servers.push_back(std::move(s));
+  return static_cast<int>(gs_servers.size()) - 1;
+}
+
+int store_server_port(int h) {
+  store::StoreServer* s = sserver(h);
+  return s ? s->port() : -1;
+}
+
+int store_server_stop(int h) {
+  store::StoreServer* s = sserver(h);
+  if (!s) return -1;
+  s->stop();
+  return 0;
+}
+
+int store_connect(const char* host, int port, int timeout_ms) {
+  auto c = std::make_unique<store::StoreClient>(host, port, timeout_ms);
+  if (!c->ok()) return -1;
+  std::lock_guard<std::mutex> g(gs_mu);
+  gs_clients.push_back(std::move(c));
+  return static_cast<int>(gs_clients.size()) - 1;
+}
+
+int store_set(int h, const char* key, const char* val, int64_t val_len) {
+  store::StoreClient* c = sclient(h);
+  if (!c) return -1;
+  store::Writer w;
+  w.u8(store::CMD_SET);
+  w.str(key);
+  w.u32(static_cast<uint32_t>(val_len));
+  w.bytes(val, val_len);
+  std::vector<char> out;
+  return c->request(w, &out) == store::ST_OK ? 0 : -1;
+}
+
+// Returns value length, or -1. Caller provides buf of cap bytes; if the value
+// is larger, it is truncated (callers use a generous cap).
+int64_t store_get(int h, const char* key, char* buf, int64_t cap) {
+  store::StoreClient* c = sclient(h);
+  if (!c) return -1;
+  store::Writer w;
+  w.u8(store::CMD_GET);
+  w.str(key);
+  std::vector<char> out;
+  if (c->request(w, &out) != store::ST_OK) return -1;
+  store::Reader r(out.data(), out.size());
+  uint32_t n = r.u32();
+  int64_t copy = std::min<int64_t>(n, cap);
+  std::memcpy(buf, r.raw(n), copy);
+  return n;
+}
+
+int64_t store_add(int h, const char* key, int64_t delta) {
+  store::StoreClient* c = sclient(h);
+  if (!c) return INT64_MIN;
+  store::Writer w;
+  w.u8(store::CMD_ADD);
+  w.str(key);
+  w.i64(delta);
+  std::vector<char> out;
+  if (c->request(w, &out) != store::ST_OK) return INT64_MIN;
+  store::Reader r(out.data(), out.size());
+  return r.i64();
+}
+
+int store_wait(int h, const char** keys, int n) {
+  store::StoreClient* c = sclient(h);
+  if (!c) return -1;
+  store::Writer w;
+  w.u8(store::CMD_WAIT);
+  w.u32(static_cast<uint32_t>(n));
+  for (int i = 0; i < n; ++i) w.str(keys[i]);
+  std::vector<char> out;
+  return c->request(w, &out) == store::ST_OK ? 0 : -1;
+}
+
+int store_check(int h, const char* key) {
+  store::StoreClient* c = sclient(h);
+  if (!c) return -1;
+  store::Writer w;
+  w.u8(store::CMD_CHECK);
+  w.str(key);
+  std::vector<char> out;
+  if (c->request(w, &out) != store::ST_OK) return -1;
+  return out.size() >= 1 ? out[0] : -1;
+}
+
+int store_delete(int h, const char* key) {
+  store::StoreClient* c = sclient(h);
+  if (!c) return -1;
+  store::Writer w;
+  w.u8(store::CMD_DELETE);
+  w.str(key);
+  std::vector<char> out;
+  return c->request(w, &out) == store::ST_OK ? 0 : -1;
+}
+
+int store_stop_server_via_client(int h) {
+  store::StoreClient* c = sclient(h);
+  if (!c) return -1;
+  store::Writer w;
+  w.u8(store::CMD_STOP);
+  std::vector<char> out;
+  return c->request(w, &out) == store::ST_OK ? 0 : -1;
+}
+
+}  // extern "C"

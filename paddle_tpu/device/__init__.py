@@ -55,11 +55,11 @@ def synchronize(device=None):
     """Block until all queued work on the device is complete (reference
     `device/cuda/__init__.py` synchronize; here: a tiny transfer barrier —
     jax dispatch is async, fetching forces completion)."""
+    if device is not None:
+        from . import cuda
+        return cuda.synchronize(device)
     for d in jax.devices():
-        try:
-            jax.device_put(0, d).block_until_ready()
-        except Exception:
-            pass
+        jax.device_put(0, d).block_until_ready()
 
 
 # compiled-with predicates: honest answers for a TPU-only build

@@ -45,6 +45,11 @@ def synchronize(device=None):
 
 
 def current_stream(device=None) -> "Stream":
+    global _current
+    if device is None:
+        if _current is None:
+            _current = Stream()
+        return _current
     return Stream(device=device)
 
 
@@ -105,7 +110,10 @@ class Stream:
         synchronize(self.device)
 
 
-_current = Stream()
+# lazily created by current_stream(): constructing a Stream touches
+# jax.devices(); import-time device init would defeat flags that must
+# be set before first device use
+_current = None
 
 
 # -- memory stats (jax.Device.memory_stats → cudaMemGetInfo parity) ---------

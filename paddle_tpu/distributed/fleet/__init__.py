@@ -46,7 +46,12 @@ _state = _FleetState()
 
 
 class PaddleCloudRoleMaker:
-    """Env-var role maker (reference `fleet/base/role_maker.py`)."""
+    """Env-var role maker (reference `fleet/base/role_maker.py`).
+
+    Collective mode: rank/world from the trainer env. PS mode
+    (is_collective=False): role from TRAINING_ROLE (TRAINER | PSERVER) and
+    server list from PADDLE_PSERVERS_IP_PORT_LIST — the launcher's PS
+    controller env contract (reference launch/controllers/ps.py)."""
 
     def __init__(self, is_collective=True, **kwargs):
         self._is_collective = is_collective
@@ -59,10 +64,12 @@ class PaddleCloudRoleMaker:
         return self._env.world_size
 
     def is_worker(self):
-        return True
+        from ..ps import runtime as ps_runtime
+        return self._is_collective or ps_runtime.is_worker()
 
     def is_server(self):
-        return False
+        from ..ps import runtime as ps_runtime
+        return (not self._is_collective) and ps_runtime.is_server()
 
     def is_first_worker(self):
         return self._env.rank == 0
@@ -74,8 +81,14 @@ UserDefinedRoleMaker = PaddleCloudRoleMaker
 def init(role_maker=None, is_collective=True,
          strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
     """fleet.init (reference fleet_base.py:206)."""
+    if role_maker is not None:
+        is_collective = getattr(role_maker, "_is_collective", is_collective)
     _state.strategy = strategy or DistributedStrategy()
     _state.is_collective = is_collective
+    if not is_collective:
+        # PS mode: no collective mesh; roles resolved via ps.runtime env
+        _state.initialized = True
+        return None
     _state.env = init_parallel_env()
     dims = _state.strategy.mesh_dims()
     if get_hybrid_communicate_group() is None or any(
@@ -91,14 +104,24 @@ def is_first_worker() -> bool:
 
 
 def worker_index() -> int:
+    if not _state.is_collective:
+        from ..ps import runtime as ps_runtime
+        return ps_runtime.trainer_id()
     return jax.process_index()
 
 
 def worker_num() -> int:
+    if not _state.is_collective:
+        from ..ps import runtime as ps_runtime
+        return ps_runtime.num_trainers()
     return jax.process_count()
 
 
 def barrier_worker():
+    if not _state.is_collective:
+        from ..ps import runtime as ps_runtime
+        ps_runtime.barrier_worker()
+        return
     from .. import collective
     collective.barrier()
 
@@ -128,6 +151,55 @@ def distributed_optimizer(optimizer, strategy=None):
 
 def get_strategy() -> Optional[DistributedStrategy]:
     return _state.strategy
+
+
+# ------------------------- parameter-server mode ---------------------------
+# reference fleet_base.py: init_server:? / run_server / init_worker:617 /
+# stop_worker — delegated to the native PS runtime (distributed/ps/runtime.py)
+
+def is_server() -> bool:
+    from ..ps import runtime as ps_runtime
+    return (not _state.is_collective) and ps_runtime.is_server()
+
+
+def is_worker() -> bool:
+    from ..ps import runtime as ps_runtime
+    return _state.is_collective or ps_runtime.is_worker()
+
+
+def init_server(*args, **kwargs):
+    from ..ps import runtime as ps_runtime
+    return ps_runtime.init_server(*args, **kwargs)
+
+
+def run_server():
+    from ..ps import runtime as ps_runtime
+    return ps_runtime.run_server()
+
+
+def init_worker(*args, **kwargs):
+    from ..ps import runtime as ps_runtime
+    return ps_runtime.init_worker(*args, **kwargs)
+
+
+def stop_worker():
+    from ..ps import runtime as ps_runtime
+    return ps_runtime.stop_worker()
+
+
+def save_persistables(executor=None, dirname=None, *a, **kw):
+    """Accepts both paddle's (executor, dirname, ...) and plain (dirname)."""
+    from ..ps import runtime as ps_runtime
+    if dirname is None and isinstance(executor, str):
+        executor, dirname = None, executor
+    return ps_runtime.save_persistables(dirname)
+
+
+def load_persistables(executor=None, dirname=None, *a, **kw):
+    from ..ps import runtime as ps_runtime
+    if dirname is None and isinstance(executor, str):
+        executor, dirname = None, executor
+    return ps_runtime.load_persistables(dirname)
 
 
 def get_hybrid_parallel_train_step(model, loss_fn, optimizer, **kw):

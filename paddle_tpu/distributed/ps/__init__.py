@@ -1,0 +1,26 @@
+"""Parameter-server training (sparse/CTR path).
+
+TPU-native rebuild of the reference PS stack
+(/root/reference/paddle/fluid/distributed/ps/ — BrpcPsServer/BrpcPsClient,
+memory_sparse_table; python side `paddle.distributed.fleet` PS mode +
+`the_one_ps.py:819`). The giant embedding tables live on host-side C++
+servers (`paddle_tpu/_native/csrc/ps.cc`); the TPU runs the dense math. A
+trainer pulls rows for the feasigns in its batch, computes on device, and
+pushes sparse gradients back; the optimizer for PS-resident state runs inside
+the table (server-side SGD/Adagrad/Adam), exactly the reference's
+CommonAccessor/sparse_sgd_rule design.
+"""
+from .client import PSClient, TableConfig
+from .server import PSServer
+from .embedding import SparseEmbedding
+from . import runtime
+from .runtime import (init_server, run_server, init_worker, stop_worker,
+                      barrier_worker, get_client, is_server, is_worker,
+                      save_persistables, load_persistables, shutdown)
+
+__all__ = [
+    "PSClient", "PSServer", "TableConfig", "SparseEmbedding",
+    "init_server", "run_server", "init_worker", "stop_worker",
+    "barrier_worker", "get_client", "is_server", "is_worker",
+    "save_persistables", "load_persistables", "shutdown", "runtime",
+]

@@ -1,0 +1,187 @@
+"""PS client: shards requests across servers, exposes numpy in/out.
+
+Reference: `BrpcPsClient`
+(/root/reference/paddle/fluid/distributed/ps/service/brpc_ps_client.h:137 —
+pull_dense/push_dense/pull_sparse/push_sparse over brpc, feasigns sharded
+across servers). Sharding rule kept: feasign -> server by key % n_servers;
+dense tables are placed on server (table_id % n_servers).
+"""
+from __future__ import annotations
+
+import ctypes
+from dataclasses import dataclass
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ... import _native
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+
+OPTIMIZERS = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
+@dataclass
+class TableConfig:
+    """Mirror of the reference's TableParameter proto (the_one_ps.py Table)."""
+    table_id: int
+    kind: str = "sparse"          # "dense" | "sparse"
+    dim: int = 8                  # embedding dim (sparse)
+    dense_size: int = 0           # flat length (dense)
+    optimizer: str = "sgd"
+    learning_rate: float = 0.01
+    init_range: float = 0.05
+    seed: int = 0
+
+
+class PSClient:
+    def __init__(self, endpoints: Sequence[str], timeout_ms: int = 60000):
+        self._lib = _native.load()
+        self._endpoints = list(endpoints)
+        self._handles: List[int] = []
+        self._tables: Dict[int, TableConfig] = {}
+        for ep in self._endpoints:
+            host, port = ep.rsplit(":", 1)
+            h = self._lib.ps_connect(host.encode(), int(port), timeout_ms)
+            if h < 0:
+                raise RuntimeError(f"PSClient: cannot connect to {ep}")
+            self._handles.append(h)
+
+    @property
+    def num_servers(self) -> int:
+        return len(self._handles)
+
+    def create_table(self, cfg: TableConfig):
+        """Create on every server (idempotent server-side)."""
+        kind = 0 if cfg.kind == "dense" else 1
+        opt = OPTIMIZERS[cfg.optimizer]
+        for h in self._handles:
+            rc = self._lib.ps_create_table(
+                h, cfg.table_id, kind, cfg.dim, cfg.dense_size, opt,
+                cfg.learning_rate, cfg.init_range, cfg.seed)
+            if rc != 0:
+                raise RuntimeError(f"create_table({cfg.table_id}) failed")
+        self._tables[cfg.table_id] = cfg
+
+    def table(self, table_id: int) -> TableConfig:
+        return self._tables[table_id]
+
+    # ------------------------------ dense ---------------------------------
+
+    def _dense_handle(self, table_id: int) -> int:
+        return self._handles[table_id % self.num_servers]
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        cfg = self._tables[table_id]
+        out = np.empty(cfg.dense_size, np.float32)
+        rc = self._lib.ps_pull_dense(
+            self._dense_handle(table_id), table_id,
+            out.ctypes.data_as(_F32P), cfg.dense_size)
+        if rc != 0:
+            raise RuntimeError(f"pull_dense({table_id}) failed")
+        return out
+
+    def push_dense(self, table_id: int, grad: np.ndarray):
+        g = np.ascontiguousarray(grad, np.float32).ravel()
+        rc = self._lib.ps_push_dense(
+            self._dense_handle(table_id), table_id,
+            g.ctypes.data_as(_F32P), g.size)
+        if rc != 0:
+            raise RuntimeError(f"push_dense({table_id}) failed")
+
+    def set_dense(self, table_id: int, values: np.ndarray):
+        v = np.ascontiguousarray(values, np.float32).ravel()
+        rc = self._lib.ps_set_dense(
+            self._dense_handle(table_id), table_id,
+            v.ctypes.data_as(_F32P), v.size)
+        if rc != 0:
+            raise RuntimeError(f"set_dense({table_id}) failed")
+
+    # ------------------------------ sparse --------------------------------
+
+    def pull_sparse(self, table_id: int, keys: np.ndarray) -> np.ndarray:
+        """keys: uint64 [n] -> values float32 [n, dim]."""
+        cfg = self._tables[table_id]
+        keys = np.ascontiguousarray(keys, np.uint64).ravel()
+        n = keys.size
+        out = np.empty((n, cfg.dim), np.float32)
+        if n == 0:
+            return out
+        ns = self.num_servers
+        if ns == 1:
+            self._pull_shard(0, table_id, keys, out)
+            return out
+        shard = (keys % np.uint64(ns)).astype(np.int64)
+        for s in range(ns):
+            idx = np.nonzero(shard == s)[0]
+            if idx.size == 0:
+                continue
+            part = np.empty((idx.size, cfg.dim), np.float32)
+            self._pull_shard(s, table_id, np.ascontiguousarray(keys[idx]), part)
+            out[idx] = part
+        return out
+
+    def _pull_shard(self, s: int, table_id: int, keys: np.ndarray,
+                    out: np.ndarray):
+        rc = self._lib.ps_pull_sparse(
+            self._handles[s], table_id, keys.ctypes.data_as(_U64P), keys.size,
+            out.ctypes.data_as(_F32P), out.size)
+        if rc != 0:
+            raise RuntimeError(f"pull_sparse({table_id}) failed")
+
+    def push_sparse(self, table_id: int, keys: np.ndarray, grads: np.ndarray):
+        """keys uint64 [n], grads float32 [n, dim]."""
+        keys = np.ascontiguousarray(keys, np.uint64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32).reshape(keys.size, -1)
+        n = keys.size
+        if n == 0:
+            return
+        ns = self.num_servers
+        if ns == 1:
+            self._push_shard(0, table_id, keys, grads)
+            return
+        shard = (keys % np.uint64(ns)).astype(np.int64)
+        for s in range(ns):
+            idx = np.nonzero(shard == s)[0]
+            if idx.size == 0:
+                continue
+            self._push_shard(s, table_id, np.ascontiguousarray(keys[idx]),
+                             np.ascontiguousarray(grads[idx]))
+
+    def _push_shard(self, s: int, table_id: int, keys: np.ndarray,
+                    grads: np.ndarray):
+        rc = self._lib.ps_push_sparse(
+            self._handles[s], table_id, keys.ctypes.data_as(_U64P), keys.size,
+            grads.ctypes.data_as(_F32P), grads.size)
+        if rc != 0:
+            raise RuntimeError(f"push_sparse({table_id}) failed")
+
+    # ------------------------- control plane ------------------------------
+
+    def table_size(self, table_id: int) -> int:
+        return sum(self._lib.ps_table_size(h, table_id) for h in self._handles)
+
+    def save(self, dirname: str):
+        import os
+        for i, h in enumerate(self._handles):
+            d = os.path.join(dirname, f"server_{i}")
+            os.makedirs(d, exist_ok=True)
+            if self._lib.ps_save(h, d.encode()) != 0:
+                raise RuntimeError("ps save failed")
+
+    def load(self, dirname: str):
+        import os
+        for i, h in enumerate(self._handles):
+            d = os.path.join(dirname, f"server_{i}")
+            if self._lib.ps_load(h, d.encode()) != 0:
+                raise RuntimeError("ps load failed")
+
+    def barrier(self, name: str, world: int):
+        """Barrier across `world` participants, coordinated by server 0."""
+        if self._lib.ps_barrier(self._handles[0], name.encode(), world) != 0:
+            raise RuntimeError("ps barrier failed")
+
+    def stop_servers(self):
+        for h in self._handles:
+            self._lib.ps_stop_server(h)

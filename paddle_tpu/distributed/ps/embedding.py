@@ -1,0 +1,93 @@
+"""Distributed (PS-resident) sparse embedding lookup with autograd.
+
+Reference: the PS rewrite turns `embedding` lookups into
+`distributed_lookup_table` / `distributed_push_sparse` ops
+(/root/reference/python/paddle/distributed/passes/ps_trainer_pass.py,
+`paddle/fluid/operators/pscore/distributed_lookup_table_op.cc`): forward
+pulls rows for the batch's feasigns from the PS, backward pushes per-row
+gradients; the optimizer update happens inside the server table.
+
+TPU design: the pull happens on host (numpy), the gathered dense block is
+then a normal device tensor — so everything downstream is XLA. Backward is a
+custom tape node whose vjp segment-sums duplicate keys and pushes to the PS
+(grad w.r.t. the int ids is None). Unique-ing keys before the pull both
+shrinks RPC traffic and makes the push a correct duplicate-accumulating
+scatter, like the reference's sparse gradient merge.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import tape as tape_mod
+from ...framework.tensor import Tensor
+from ...nn.layer import Layer
+from .client import TableConfig
+
+
+class SparseEmbedding(Layer):
+    """Embedding whose table lives on the parameter servers.
+
+    Unlike `nn.Embedding` there is no local weight parameter; `parameters()`
+    is empty and the optimizer never sees this layer — updates are applied
+    server-side on `backward()` (reference: server-side sgd rules,
+    `ps/table/sparse_sgd_rule.cc`).
+    """
+
+    def __init__(self, table_id: int, embedding_dim: int,
+                 optimizer: str = "sgd", learning_rate: float = 0.01,
+                 init_range: float = 0.05, seed: int = 0,
+                 client=None, name: Optional[str] = None):
+        super().__init__()
+        self._table_cfg = TableConfig(
+            table_id=table_id, kind="sparse", dim=embedding_dim,
+            optimizer=optimizer, learning_rate=learning_rate,
+            init_range=init_range, seed=seed)
+        self._dim = embedding_dim
+        self._client = client
+        self._created = False
+
+    @property
+    def client(self):
+        if self._client is None:
+            from .runtime import get_client
+            self._client = get_client()
+        return self._client
+
+    def _ensure_table(self):
+        if not self._created:
+            self.client.create_table(self._table_cfg)
+            self._created = True
+
+    def forward(self, ids) -> Tensor:
+        """ids: int tensor [...]-shaped -> embeddings [..., dim]."""
+        self._ensure_table()
+        client = self.client
+        tid = self._table_cfg.table_id
+
+        ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids)
+        shape = ids_np.shape
+        flat = ids_np.reshape(-1).astype(np.uint64)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+
+        rows = client.pull_sparse(tid, uniq)               # [u, dim] host
+        gathered = rows[inverse].reshape(*shape, self._dim)
+        out = Tensor(jnp.asarray(gathered), stop_gradient=False)
+
+        if tape_mod.grad_enabled():
+            dim = self._dim
+
+            def vjp_fn(out_grads):
+                g = np.asarray(out_grads[0]).reshape(-1, dim)
+                # segment-sum duplicate ids -> one grad row per unique key
+                merged = np.zeros((uniq.size, dim), np.float32)
+                np.add.at(merged, inverse, g.astype(np.float32))
+                client.push_sparse(tid, uniq, merged)
+                return (None,)
+
+            ids_ref = ids if isinstance(ids, Tensor) else None
+            tape_mod.record(vjp_fn, [ids_ref], [out],
+                            name="distributed_lookup_table")
+        return out

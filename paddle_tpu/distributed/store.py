@@ -1,0 +1,82 @@
+"""TCPStore — blocking KV rendezvous store (native-backed).
+
+Python face of `paddle_tpu/_native/csrc/store.cc`; API mirrors the
+reference's `core.TCPStore` (/root/reference/paddle/fluid/distributed/store/
+tcp_store.h:91) as used by `init_parallel_env`
+(`python/paddle/distributed/parallel.py:232`): the master rank hosts the
+server in-process, every rank (master included) is a client.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional
+
+from .. import _native
+
+_GET_CAP = 1 << 20
+
+
+class TCPStore:
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: int = 120):
+        self._lib = _native.load()
+        self._server_h: Optional[int] = None
+        if is_master:
+            self._server_h = self._lib.store_server_create(port)
+            if self._server_h < 0:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = self._lib.store_server_port(self._server_h)
+        self._port = port
+        self._h = self._lib.store_connect(host.encode(), port,
+                                          int(timeout * 1000))
+        if self._h < 0:
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def set(self, key: str, value):
+        if isinstance(value, str):
+            value = value.encode()
+        if self._lib.store_set(self._h, key.encode(), value, len(value)) != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> bytes:
+        buf = ctypes.create_string_buffer(_GET_CAP)
+        n = self._lib.store_get(self._h, key.encode(), buf, _GET_CAP)
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return buf.raw[:n]
+
+    def add(self, key: str, delta: int) -> int:
+        v = self._lib.store_add(self._h, key.encode(), delta)
+        if v == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return v
+
+    def wait(self, keys: List[str]):
+        arr = (ctypes.c_char_p * len(keys))(*[k.encode() for k in keys])
+        if self._lib.store_wait(self._h, arr, len(keys)) != 0:
+            raise RuntimeError("TCPStore.wait failed")
+
+    def check(self, key: str) -> bool:
+        rc = self._lib.store_check(self._h, key.encode())
+        if rc < 0:
+            raise RuntimeError("TCPStore.check failed")
+        return bool(rc)
+
+    def delete_key(self, key: str):
+        if self._lib.store_delete(self._h, key.encode()) != 0:
+            raise RuntimeError("TCPStore.delete failed")
+
+    def stop(self):
+        if self._server_h is not None:
+            self._lib.store_server_stop(self._server_h)
+            self._server_h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass

@@ -428,14 +428,19 @@ class TransformedDistribution(Distribution):
         self.transforms = list(transforms)
         self._chain = ChainTransform(self.transforms) if len(self.transforms) != 1 \
             else self.transforms[0]
-        # shape-changing transforms (StickBreaking, Reshape) act on event dims
+        # shape-changing transforms (StickBreaking, Reshape) act on event
+        # dims: the event rank of the output is the larger of the base's
+        # event rank and the chain's event_dim (torch/reference semantics),
+        # so e.g. StickBreaking over a batched scalar-event Normal yields a
+        # simplex EVENT, not extra batch members
         full = base.batch_shape + base.event_shape
         out_full = tuple(self._chain.forward_shape(full))
-        nb = len(base.batch_shape)
-        super().__init__(batch_shape=out_full[:nb] if len(out_full) >= nb
-                         else out_full,
-                         event_shape=out_full[nb:] if len(out_full) >= nb
-                         else ())
+        ev = max(len(base.event_shape),
+                 getattr(self._chain, "event_dim", 0))
+        ev = min(ev, len(out_full))
+        split = len(out_full) - ev
+        super().__init__(batch_shape=out_full[:split],
+                         event_shape=out_full[split:])
 
     def sample(self, shape=()):
         x = self.base.sample(shape)

@@ -26,6 +26,8 @@ class Type:
 
 class Transform:
     _type = Type.INJECTION
+    # number of rightmost dims this transform operates on as one event
+    event_dim = 0
 
     # -- Tensor-facing API (on the eager autograd tape) ---------------------
     def forward(self, x):
@@ -162,6 +164,7 @@ class SoftmaxTransform(Transform):
 
 class StickBreakingTransform(Transform):
     _type = Type.BIJECTION
+    event_dim = 1  # maps an R^K vector to a (K+1)-simplex event
 
     def forward_arr(self, x):
         offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
@@ -205,6 +208,7 @@ class ReshapeTransform(Transform):
     def __init__(self, in_event_shape, out_event_shape):
         self.in_event_shape = tuple(in_event_shape)
         self.out_event_shape = tuple(out_event_shape)
+        self.event_dim = len(self.out_event_shape)
         if int(jnp.prod(jnp.asarray(self.in_event_shape or (1,)))) != \
            int(jnp.prod(jnp.asarray(self.out_event_shape or (1,)))):
             raise ValueError("event sizes must match")
@@ -226,6 +230,7 @@ class IndependentTransform(Transform):
     def __init__(self, base, reinterpreted_batch_rank):
         self.base = base
         self._rank = int(reinterpreted_batch_rank)
+        self.event_dim = base.event_dim + self._rank
 
     def forward_arr(self, x):
         return self.base.forward_arr(x)
@@ -264,6 +269,7 @@ class StackTransform(Transform):
 class ChainTransform(Transform):
     def __init__(self, transforms):
         self.transforms = list(transforms)
+        self.event_dim = max((t.event_dim for t in self.transforms), default=0)
 
     def forward_arr(self, x):
         for t in self.transforms:

@@ -1,0 +1,52 @@
+"""Wide&Deep CTR model over PS-resident sparse embeddings.
+
+Reference workload: the PS path's flagship model family (Wide&Deep / DeepFM,
+see /root/reference/python/paddle/fluid/tests/unittests/test_dist_fleet_ctr.py
+and `distributed/ps/` generally). Sparse slots hit `SparseEmbedding` (host PS
+pull/push); the dense tower is ordinary XLA compute.
+"""
+from __future__ import annotations
+
+from .. import nn
+from .. import ops
+from ..distributed.ps import SparseEmbedding
+
+
+class WideDeep(nn.Layer):
+    """`num_slots` categorical slots + `dense_dim` dense features -> CTR logit."""
+
+    def __init__(self, num_slots: int = 4, embedding_dim: int = 8,
+                 dense_dim: int = 4, hidden: int = 32,
+                 sparse_lr: float = 0.05, table_base: int = 0,
+                 client=None):
+        super().__init__()
+        self.num_slots = num_slots
+        self.embedding_dim = embedding_dim
+        self.embeddings = nn.LayerList([
+            SparseEmbedding(table_id=table_base + i,
+                            embedding_dim=embedding_dim,
+                            optimizer="sgd", learning_rate=sparse_lr,
+                            client=client)
+            for i in range(num_slots)
+        ])
+        # "wide" half: one scalar weight per slot via a dim-1 PS table
+        self.wide = SparseEmbedding(table_id=table_base + num_slots,
+                                    embedding_dim=1, optimizer="sgd",
+                                    learning_rate=sparse_lr, client=client)
+        self.deep = nn.Sequential(
+            nn.Linear(num_slots * embedding_dim + dense_dim, hidden),
+            nn.ReLU(),
+            nn.Linear(hidden, hidden),
+            nn.ReLU(),
+            nn.Linear(hidden, 1),
+        )
+
+    def forward(self, slot_ids, dense_x):
+        """slot_ids: int [batch, num_slots]; dense_x: float [batch, dense_dim]."""
+        embs = []
+        for i, emb in enumerate(self.embeddings):
+            embs.append(emb(slot_ids[:, i]))          # [batch, dim]
+        deep_in = ops.concat(embs + [dense_x], axis=-1)
+        deep_out = self.deep(deep_in)                  # [batch, 1]
+        wide_out = self.wide(slot_ids).sum(axis=1)     # [batch, 1]
+        return deep_out + wide_out

@@ -73,7 +73,7 @@ def export_chrome_tracing(dir_name: str,
 
     def handler(prof: "Profiler"):
         name = worker_name or f"host_{socket.gethostname()}_pid_{os.getpid()}"
-        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
+        path = os.path.join(dir_name, f"{name}_time_{time.time_ns()}"
                                       f".paddle_trace.json")
         prof.export(path, format="json")
         return path
@@ -88,7 +88,7 @@ def export_protobuf(dir_name: str,
 
     def handler(prof: "Profiler"):
         name = worker_name or f"host_{socket.gethostname()}_pid_{os.getpid()}"
-        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}"
+        path = os.path.join(dir_name, f"{name}_time_{time.time_ns()}"
                                       f".pb.json")
         prof.export(path, format="json")
         return path

@@ -37,12 +37,14 @@ class RecordEvent(ContextDecorator):
         self.event_type = event_type
         self._start = None
         self._jax_ann = None
+        self._pushed = False
 
     def begin(self):
         rec = get_recorder()
         self._start = now_ns()
         if rec.enabled:
             rec.span_stack().append(self.name)
+            self._pushed = True
             try:
                 self._jax_ann = jax.profiler.TraceAnnotation(self.name)
                 self._jax_ann.__enter__()
@@ -56,10 +58,17 @@ class RecordEvent(ContextDecorator):
         if self._jax_ann is not None:
             self._jax_ann.__exit__(None, None, None)
             self._jax_ann = None
+        # pop even if the record window closed mid-span, else the thread's
+        # stack leaks the entry and later spans get a stale parent
+        if self._pushed:
+            stack = rec.span_stack()
+            if self.name in stack:
+                stack.reverse()
+                stack.remove(self.name)
+                stack.reverse()
+            self._pushed = False
         if rec.enabled:
             stack = rec.span_stack()
-            if stack and stack[-1] == self.name:
-                stack.pop()
             parent = stack[-1] if stack else None
             rec.push(HostSpan(name=self.name, start_ns=self._start,
                               end_ns=now_ns(), tid=threading.get_ident(),

@@ -1,0 +1,243 @@
+"""Parameter-server (native C++) tests.
+
+Mirrors the reference's PS test strategy: in-process unit tests against the
+tables (like `ps_local_client`, /root/reference/paddle/fluid/distributed/ps/
+service/ps_local_client.h) plus a subprocess localhost cluster
+(`test_dist_base.py:968` pattern — fork pserver + 2 trainers, assert results).
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import (PSClient, PSServer, SparseEmbedding,
+                                       TableConfig)
+from paddle_tpu.distributed.store import TCPStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def ps_pair():
+    server = PSServer(0)
+    client = PSClient([server.endpoint])
+    yield server, client
+    client.stop_servers()
+
+
+class TestTables:
+    def test_dense_sgd(self, ps_pair):
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=0, kind="dense", dense_size=6,
+                                   optimizer="sgd", learning_rate=0.1))
+        w0 = np.arange(6, dtype=np.float32)
+        c.set_dense(0, w0)
+        g = np.full(6, 2.0, np.float32)
+        c.push_dense(0, g)
+        np.testing.assert_allclose(c.pull_dense(0), w0 - 0.2, rtol=1e-6)
+
+    def test_dense_adam_matches_numpy(self, ps_pair):
+        _, c = ps_pair
+        lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+        c.create_table(TableConfig(table_id=1, kind="dense", dense_size=4,
+                                   optimizer="adam", learning_rate=lr))
+        w = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+        c.set_dense(1, w)
+        m = np.zeros(4); v = np.zeros(4)
+        rng = np.random.default_rng(0)
+        for t in range(1, 4):
+            g = rng.normal(size=4).astype(np.float32)
+            c.push_dense(1, g)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            w = w - lr * (m / (1 - b1 ** t)) / (np.sqrt(v / (1 - b2 ** t)) + eps)
+        np.testing.assert_allclose(c.pull_dense(1), w, rtol=1e-4, atol=1e-6)
+
+    def test_sparse_lazy_init_deterministic(self, ps_pair):
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=2, dim=8, init_range=0.1, seed=3))
+        keys = np.array([5, 17, 5], np.uint64)
+        rows = c.pull_sparse(2, keys)
+        assert rows.shape == (3, 8)
+        np.testing.assert_array_equal(rows[0], rows[2])
+        assert np.abs(rows).max() <= 0.1
+        assert c.table_size(2) == 2
+        # same key again -> same row (no re-init)
+        again = c.pull_sparse(2, np.array([17], np.uint64))
+        np.testing.assert_array_equal(again[0], rows[1])
+
+    def test_sparse_push_applies_sgd(self, ps_pair):
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=3, dim=4, optimizer="sgd",
+                                   learning_rate=0.5, init_range=0.0))
+        keys = np.array([7, 9], np.uint64)
+        before = c.pull_sparse(3, keys)
+        g = np.ones((2, 4), np.float32)
+        c.push_sparse(3, keys, g)
+        after = c.pull_sparse(3, keys)
+        np.testing.assert_allclose(after, before - 0.5, rtol=1e-6)
+
+    def test_save_load_roundtrip(self, ps_pair):
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=4, dim=4, learning_rate=0.1))
+        keys = np.array([1, 2, 3], np.uint64)
+        c.push_sparse(4, keys, np.ones((3, 4), np.float32))
+        want = c.pull_sparse(4, keys)
+        with tempfile.TemporaryDirectory() as d:
+            c.save(d)
+            c.push_sparse(4, keys, np.ones((3, 4), np.float32))  # mutate
+            c.load(d)
+            np.testing.assert_allclose(c.pull_sparse(4, keys), want)
+
+
+class TestMultiServerSharding:
+    def test_two_servers(self):
+        s1, s2 = PSServer(0), PSServer(0)
+        c = PSClient([s1.endpoint, s2.endpoint])
+        try:
+            c.create_table(TableConfig(table_id=0, dim=4, optimizer="sgd",
+                                       learning_rate=1.0, init_range=0.0))
+            keys = np.arange(10, dtype=np.uint64)
+            c.push_sparse(0, keys, np.ones((10, 4), np.float32))
+            vals = c.pull_sparse(0, keys)
+            np.testing.assert_allclose(vals, -np.ones((10, 4)), rtol=1e-6)
+            # rows really are split across the two servers
+            assert c.table_size(0) == 10
+            lib = c._lib
+            n1 = lib.ps_table_size(c._handles[0], 0)
+            n2 = lib.ps_table_size(c._handles[1], 0)
+            assert n1 > 0 and n2 > 0 and n1 + n2 == 10
+        finally:
+            c.stop_servers()
+
+
+class TestSparseEmbeddingAutograd:
+    def test_forward_backward_pushes_grads(self, ps_pair):
+        _, c = ps_pair
+        emb = SparseEmbedding(table_id=10, embedding_dim=4, optimizer="sgd",
+                              learning_rate=1.0, init_range=0.0, client=c)
+        ids = paddle.to_tensor(np.array([[1, 2], [1, 3]], np.int64))
+        out = emb(ids)                      # [2, 2, 4], all zeros
+        assert tuple(out.shape) == (2, 2, 4)
+        loss = out.sum()
+        loss.backward()
+        # d loss/d emb = 1 per element; key 1 appears twice -> grad 2
+        vals = c.pull_sparse(10, np.array([1, 2, 3], np.uint64))
+        np.testing.assert_allclose(vals[0], -2 * np.ones(4), rtol=1e-6)
+        np.testing.assert_allclose(vals[1], -np.ones(4), rtol=1e-6)
+        np.testing.assert_allclose(vals[2], -np.ones(4), rtol=1e-6)
+
+    def test_trains_with_dense_layers(self, ps_pair):
+        _, c = ps_pair
+        from paddle_tpu import nn, optimizer
+        emb = SparseEmbedding(table_id=11, embedding_dim=8, optimizer="sgd",
+                              learning_rate=0.1, client=c)
+        fc = nn.Linear(8, 1)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=fc.parameters())
+        rng = np.random.default_rng(0)
+        ids_np = rng.integers(0, 50, (16,)).astype(np.int64)
+        y = (ids_np % 2).astype(np.float32).reshape(-1, 1)
+        losses = []
+        for _ in range(30):
+            out = fc(emb(paddle.to_tensor(ids_np)))
+            loss = ((out - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+class TestTCPStore:
+    def test_kv_and_counter(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        peer = TCPStore("127.0.0.1", master.port, is_master=False)
+        master.set("addr", "1.2.3.4:85")
+        assert peer.get("addr") == b"1.2.3.4:85"
+        assert peer.add("ranks", 1) == 1
+        assert master.add("ranks", 1) == 2
+        assert peer.check("addr") is True
+        assert peer.check("gone") is False
+        peer.wait(["addr", "ranks"])
+        master.delete_key("addr")
+        assert master.check("addr") is False
+        master.stop()
+
+
+_CLUSTER_SCRIPT = r"""
+import os, sys
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.ps import runtime as ps_rt
+
+role = os.environ["TRAINING_ROLE"]
+fleet.init(is_collective=False)
+if fleet.is_server():
+    fleet.init_server(port=int(os.environ["PADDLE_PORT"]))
+    fleet.run_server()
+    sys.exit(0)
+
+# trainer
+from paddle_tpu.models.wide_deep import WideDeep
+from paddle_tpu import optimizer
+fleet.init_worker()
+tid = ps_rt.trainer_id()
+model = WideDeep(num_slots=2, embedding_dim=4, dense_dim=3, hidden=16)
+opt = optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
+rng = np.random.default_rng(100 + tid)
+losses = []
+for step in range(20):
+    ids = rng.integers(0, 100, (8, 2)).astype(np.int64)
+    x = rng.normal(size=(8, 3)).astype(np.float32)
+    yv = ((ids.sum(1) % 2) == 0).astype(np.float32).reshape(-1, 1)
+    logit = model(paddle.to_tensor(ids), paddle.to_tensor(x))
+    label = paddle.to_tensor(yv)
+    loss = paddle.nn.functional.binary_cross_entropy_with_logits(logit, label)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    losses.append(float(loss))
+fleet.barrier_worker()
+print(f"TRAINER {tid} first={losses[0]:.4f} last={losses[-1]:.4f}", flush=True)
+assert losses[-1] < losses[0], (losses[0], losses[-1])
+fleet.stop_worker()
+"""
+
+
+class TestPSCluster:
+    def test_localhost_cluster_1server_2trainers(self, tmp_path):
+        """Subprocess cluster: 1 pserver + 2 trainers on localhost."""
+        script = tmp_path / "ps_train.py"
+        script.write_text(_CLUSTER_SCRIPT)
+        from paddle_tpu.distributed.env import find_free_port
+        port = find_free_port()
+        eps = f"127.0.0.1:{port}"
+        base_env = dict(os.environ,
+                        PADDLE_PSERVERS_IP_PORT_LIST=eps,
+                        PADDLE_TRAINERS_NUM="2",
+                        JAX_PLATFORMS="cpu",
+                        PYTHONPATH=REPO)
+        procs = [subprocess.Popen(
+            [sys.executable, str(script)],
+            env={**base_env, "TRAINING_ROLE": "PSERVER",
+                 "PADDLE_PORT": str(port)},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)]
+        for tid in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script)],
+                env={**base_env, "TRAINING_ROLE": "TRAINER",
+                     "PADDLE_TRAINER_ID": str(tid)},
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out.decode())
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"proc failed:\n{out}"
+        assert "TRAINER 0" in outs[1] + outs[2]
+        assert "TRAINER 1" in outs[1] + outs[2]

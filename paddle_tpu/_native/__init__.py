@@ -143,3 +143,40 @@ def _declare(lib: ctypes.CDLL):
     lib.store_delete.argtypes = [c.c_int, c.c_char_p]
     lib.store_stop_server_via_client.restype = c.c_int
     lib.store_stop_server_via_client.argtypes = [c.c_int]
+
+    # data feed
+    i64p = c.POINTER(c.c_int64)
+    lib.feed_create.restype = c.c_int
+    lib.feed_create.argtypes = [c.c_int, c.POINTER(c.c_int), c.c_int]
+    lib.feed_set_filelist.restype = c.c_int
+    lib.feed_set_filelist.argtypes = [c.c_int, c.POINTER(c.c_char_p), c.c_int]
+    lib.feed_start.restype = c.c_int
+    lib.feed_start.argtypes = [c.c_int, c.c_int]
+    lib.feed_load_into_memory.restype = c.c_int
+    lib.feed_load_into_memory.argtypes = [c.c_int, c.c_int]
+    lib.feed_local_shuffle.restype = c.c_int
+    lib.feed_local_shuffle.argtypes = [c.c_int, c.c_uint64]
+    lib.feed_memory_size.restype = c.c_int64
+    lib.feed_memory_size.argtypes = [c.c_int]
+    lib.feed_reset_memory_cursor.restype = c.c_int
+    lib.feed_reset_memory_cursor.argtypes = [c.c_int]
+    lib.feed_next_batch.restype = c.c_int
+    lib.feed_next_batch.argtypes = [c.c_int, c.c_int]
+    lib.feed_batch_num_instances.restype = c.c_int64
+    lib.feed_batch_num_instances.argtypes = [c.c_int]
+    lib.feed_batch_slot_values.restype = c.c_int64
+    lib.feed_batch_slot_values.argtypes = [c.c_int, c.c_int]
+    lib.feed_batch_copy_u64.restype = c.c_int
+    lib.feed_batch_copy_u64.argtypes = [c.c_int, c.c_int, u64p]
+    lib.feed_batch_copy_f32.restype = c.c_int
+    lib.feed_batch_copy_f32.argtypes = [c.c_int, c.c_int, f32p]
+    lib.feed_batch_copy_lod.restype = c.c_int
+    lib.feed_batch_copy_lod.argtypes = [c.c_int, c.c_int, i64p]
+    lib.feed_release_batch.restype = c.c_int
+    lib.feed_release_batch.argtypes = [c.c_int]
+    lib.feed_join.restype = c.c_int
+    lib.feed_join.argtypes = [c.c_int]
+    lib.feed_has_error.restype = c.c_int
+    lib.feed_has_error.argtypes = [c.c_int]
+    lib.feed_destroy.restype = c.c_int
+    lib.feed_destroy.argtypes = [c.c_int]

@@ -1,0 +1,476 @@
+// Multi-threaded slot data feed: parses MultiSlot-format text files into
+// batches on host threads, ready for device upload.
+//
+// Native rebuild of the reference's feed pipeline
+// (/root/reference/paddle/fluid/framework/data_feed.cc `MultiSlotDataFeed` /
+// `MultiSlotInMemoryDataFeed`, and `framework/data_set.h:47` Dataset).
+// Format kept: each line holds, for every configured slot in order,
+// `<n> <v1> ... <vn>` — n values of the slot's type (uint64 feasigns for
+// sparse slots, floats for dense). Two serving modes, as in the reference:
+//   * queue mode: worker threads tail the file list, batches stream out
+//     (QueueDataset / `MultiSlotDataFeed`),
+//   * memory mode: load_into_memory + local_shuffle, then serve
+//     (InMemoryDataset with its shuffle-before-train contract).
+// Sparse slots are ragged: a batch carries concatenated values + a lod
+// offset array (the reference's LoD), which the Python side turns into
+// padded/bucketed device arrays (XLA wants static shapes).
+
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace feed {
+
+struct SlotConf {
+  std::string name;
+  bool is_float = false;  // false -> uint64 feasigns
+};
+
+// One parsed instance: per slot, the raw values.
+struct Instance {
+  std::vector<std::vector<uint64_t>> u64;   // [slot] -> values (sparse slots)
+  std::vector<std::vector<float>> f32;      // [slot] -> values (float slots)
+};
+
+// Assembled batch for the C API: concatenated values + lod per slot.
+struct Batch {
+  // per slot: values of whichever type, plus offsets [n_instances+1]
+  std::vector<std::vector<uint64_t>> u64;
+  std::vector<std::vector<float>> f32;
+  std::vector<std::vector<int64_t>> lod;
+  int64_t size = 0;  // instances
+};
+
+class DataFeed {
+ public:
+  DataFeed(std::vector<SlotConf> slots, int batch_size)
+      : slots_(std::move(slots)), batch_size_(batch_size) {}
+
+  ~DataFeed() { join(); }
+
+  void set_filelist(std::vector<std::string> files) {
+    files_ = std::move(files);
+    next_file_ = 0;
+  }
+
+  // ---------------- queue (streaming) mode ----------------
+
+  void start(int num_threads) {
+    join();
+    {
+      // a fresh start is a fresh epoch: drop batches left by an early-exited
+      // consumer and re-serve the whole file list
+      std::lock_guard<std::mutex> g(q_mu_);
+      queue_.clear();
+      eof_workers_ = 0;
+    }
+    {
+      std::lock_guard<std::mutex> g(file_mu_);
+      next_file_ = 0;
+    }
+    error_ = false;
+    done_ = false;
+    num_workers_ = num_threads;
+    for (int i = 0; i < num_threads; ++i)
+      workers_.emplace_back([this] { worker_loop(); });
+  }
+
+  // ---------------- memory mode ----------------
+
+  bool load_into_memory(int num_threads) {
+    std::vector<std::thread> loaders;
+    std::atomic<bool> ok{true};
+    std::mutex mem_mu;
+    for (int t = 0; t < num_threads; ++t) {
+      loaders.emplace_back([this, &ok, &mem_mu] {
+        for (;;) {
+          std::string file;
+          {
+            std::lock_guard<std::mutex> g(file_mu_);
+            if (next_file_ >= files_.size()) return;
+            file = files_[next_file_++];
+          }
+          std::vector<Instance> local;
+          if (!parse_file(file, &local)) { ok = false; return; }
+          std::lock_guard<std::mutex> g(mem_mu);
+          for (auto& ins : local) memory_.push_back(std::move(ins));
+        }
+      });
+    }
+    for (auto& t : loaders) t.join();
+    mem_cursor_ = 0;
+    return ok;
+  }
+
+  void local_shuffle(uint64_t seed) {
+    std::mt19937_64 rng(seed);
+    std::shuffle(memory_.begin(), memory_.end(), rng);
+    mem_cursor_ = 0;
+  }
+
+  int64_t memory_size() const { return static_cast<int64_t>(memory_.size()); }
+
+  // Serve the next batch from memory; nullptr at epoch end.
+  std::unique_ptr<Batch> next_batch_from_memory() {
+    if (mem_cursor_ >= memory_.size()) return nullptr;
+    size_t end = std::min(memory_.size(),
+                          mem_cursor_ + static_cast<size_t>(batch_size_));
+    auto b = assemble(&memory_[mem_cursor_], end - mem_cursor_);
+    mem_cursor_ = end;
+    return b;
+  }
+
+  void reset_memory_cursor() { mem_cursor_ = 0; }
+
+  // Blocking pop in queue mode; nullptr when all workers hit EOF.
+  std::unique_ptr<Batch> next_batch_from_queue() {
+    std::unique_lock<std::mutex> lk(q_mu_);
+    q_cv_.wait(lk, [this] {
+      return !queue_.empty() || eof_workers_ == num_workers_ || done_;
+    });
+    if (queue_.empty()) return nullptr;
+    auto b = std::move(queue_.front());
+    queue_.pop_front();
+    q_space_cv_.notify_one();
+    return b;
+  }
+
+  void join() {
+    done_ = true;
+    q_cv_.notify_all();
+    q_space_cv_.notify_all();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+    workers_.clear();
+  }
+
+  const std::vector<SlotConf>& slots() const { return slots_; }
+
+  bool has_error() const { return error_; }
+
+ private:
+  static constexpr size_t kMaxQueue = 64;
+
+  void worker_loop() {
+    std::vector<Instance> pending;
+    for (;;) {
+      std::string file;
+      {
+        std::lock_guard<std::mutex> g(file_mu_);
+        if (next_file_ >= files_.size()) break;
+        file = files_[next_file_++];
+      }
+      std::vector<Instance> parsed;
+      if (!parse_file(file, &parsed)) {
+        error_ = true;  // surfaced via feed_has_error; EOF must not look clean
+        break;
+      }
+      for (auto& ins : parsed) {
+        pending.push_back(std::move(ins));
+        if (static_cast<int>(pending.size()) == batch_size_) {
+          emit(pending);
+          pending.clear();
+        }
+      }
+      if (done_) break;
+    }
+    if (!pending.empty() && !done_) emit(pending);  // trailing partial batch
+    {
+      std::lock_guard<std::mutex> g(q_mu_);
+      eof_workers_ += 1;
+    }
+    q_cv_.notify_all();
+  }
+
+  void emit(std::vector<Instance>& batch_src) {
+    auto b = assemble(batch_src.data(), batch_src.size());
+    std::unique_lock<std::mutex> lk(q_mu_);
+    q_space_cv_.wait(lk, [this] { return queue_.size() < kMaxQueue || done_; });
+    if (done_) return;
+    queue_.push_back(std::move(b));
+    q_cv_.notify_one();
+  }
+
+  std::unique_ptr<Batch> assemble(const Instance* ins, size_t n) {
+    auto b = std::make_unique<Batch>();
+    const size_t ns = slots_.size();
+    b->u64.resize(ns);
+    b->f32.resize(ns);
+    b->lod.assign(ns, std::vector<int64_t>(1, 0));
+    b->size = static_cast<int64_t>(n);
+    for (size_t s = 0; s < ns; ++s) {
+      for (size_t i = 0; i < n; ++i) {
+        if (slots_[s].is_float) {
+          const auto& v = ins[i].f32[s];
+          b->f32[s].insert(b->f32[s].end(), v.begin(), v.end());
+          b->lod[s].push_back(b->lod[s].back() +
+                              static_cast<int64_t>(v.size()));
+        } else {
+          const auto& v = ins[i].u64[s];
+          b->u64[s].insert(b->u64[s].end(), v.begin(), v.end());
+          b->lod[s].push_back(b->lod[s].back() +
+                              static_cast<int64_t>(v.size()));
+        }
+      }
+    }
+    return b;
+  }
+
+  bool parse_file(const std::string& path, std::vector<Instance>* out) {
+    FILE* f = fopen(path.c_str(), "r");
+    if (!f) return false;
+    std::string line;
+    char buf[1 << 16];
+    while (fgets(buf, sizeof(buf), f)) {
+      line.assign(buf);
+      // handle lines longer than buf
+      while (!line.empty() && line.back() != '\n' &&
+             fgets(buf, sizeof(buf), f))
+        line += buf;
+      if (!parse_line(line, out)) {
+        fclose(f);
+        return false;
+      }
+    }
+    fclose(f);
+    return true;
+  }
+
+  bool parse_line(const std::string& line, std::vector<Instance>* out) {
+    const char* p = line.c_str();
+    Instance ins;
+    ins.u64.resize(slots_.size());
+    ins.f32.resize(slots_.size());
+    for (size_t s = 0; s < slots_.size(); ++s) {
+      char* end = nullptr;
+      long n = strtol(p, &end, 10);
+      if (end == p) return s == 0 && is_blank(p);  // blank line ok
+      p = end;
+      if (n < 0) return false;
+      if (slots_[s].is_float) {
+        ins.f32[s].reserve(n);
+        for (long i = 0; i < n; ++i) {
+          float v = strtof(p, &end);
+          if (end == p) return false;
+          ins.f32[s].push_back(v);
+          p = end;
+        }
+      } else {
+        ins.u64[s].reserve(n);
+        for (long i = 0; i < n; ++i) {
+          uint64_t v = strtoull(p, &end, 10);
+          if (end == p) return false;
+          ins.u64[s].push_back(v);
+          p = end;
+        }
+      }
+    }
+    out->push_back(std::move(ins));
+    return true;
+  }
+
+  static bool is_blank(const char* p) {
+    for (; *p; ++p)
+      if (!std::isspace(static_cast<unsigned char>(*p))) return false;
+    return true;
+  }
+
+  std::vector<SlotConf> slots_;
+  int batch_size_;
+
+  std::mutex file_mu_;
+  std::vector<std::string> files_;
+  size_t next_file_ = 0;
+
+  // queue mode
+  std::vector<std::thread> workers_;
+  int num_workers_ = 0;
+  std::atomic<bool> done_{false};
+  std::atomic<bool> error_{false};
+  std::mutex q_mu_;
+  std::condition_variable q_cv_, q_space_cv_;
+  std::deque<std::unique_ptr<Batch>> queue_;
+  int eof_workers_ = 0;
+
+  // memory mode
+  std::vector<Instance> memory_;
+  size_t mem_cursor_ = 0;
+};
+
+}  // namespace feed
+
+// ----------------------------- C API ---------------------------------------
+
+namespace {
+std::mutex gf_mu;
+std::vector<std::unique_ptr<feed::DataFeed>> gf_feeds;
+std::vector<std::unique_ptr<feed::Batch>> gf_batches;
+
+feed::DataFeed* get_feed(int h) {
+  std::lock_guard<std::mutex> g(gf_mu);
+  if (h < 0 || h >= static_cast<int>(gf_feeds.size())) return nullptr;
+  return gf_feeds[h].get();
+}
+
+feed::Batch* get_batch(int h) {
+  std::lock_guard<std::mutex> g(gf_mu);
+  if (h < 0 || h >= static_cast<int>(gf_batches.size())) return nullptr;
+  return gf_batches[h].get();
+}
+
+int store_batch(std::unique_ptr<feed::Batch> b) {
+  if (!b) return -1;
+  std::lock_guard<std::mutex> g(gf_mu);
+  // reuse released slots
+  for (size_t i = 0; i < gf_batches.size(); ++i) {
+    if (!gf_batches[i]) {
+      gf_batches[i] = std::move(b);
+      return static_cast<int>(i);
+    }
+  }
+  gf_batches.push_back(std::move(b));
+  return static_cast<int>(gf_batches.size()) - 1;
+}
+}  // namespace
+
+extern "C" {
+
+// slot_types: per slot, 0 = uint64 (sparse feasign), 1 = float
+int feed_create(int num_slots, const int* slot_types, int batch_size) {
+  std::vector<feed::SlotConf> slots(num_slots);
+  for (int i = 0; i < num_slots; ++i) {
+    slots[i].name = "slot" + std::to_string(i);
+    slots[i].is_float = slot_types[i] == 1;
+  }
+  auto f = std::make_unique<feed::DataFeed>(std::move(slots), batch_size);
+  std::lock_guard<std::mutex> g(gf_mu);
+  gf_feeds.push_back(std::move(f));
+  return static_cast<int>(gf_feeds.size()) - 1;
+}
+
+int feed_set_filelist(int h, const char** files, int n) {
+  feed::DataFeed* f = get_feed(h);
+  if (!f) return -1;
+  std::vector<std::string> fs(files, files + n);
+  f->set_filelist(std::move(fs));
+  return 0;
+}
+
+int feed_start(int h, int threads) {
+  feed::DataFeed* f = get_feed(h);
+  if (!f) return -1;
+  f->start(threads);
+  return 0;
+}
+
+int feed_load_into_memory(int h, int threads) {
+  feed::DataFeed* f = get_feed(h);
+  if (!f) return -1;
+  return f->load_into_memory(threads) ? 0 : -1;
+}
+
+int feed_local_shuffle(int h, uint64_t seed) {
+  feed::DataFeed* f = get_feed(h);
+  if (!f) return -1;
+  f->local_shuffle(seed);
+  return 0;
+}
+
+int64_t feed_memory_size(int h) {
+  feed::DataFeed* f = get_feed(h);
+  return f ? f->memory_size() : -1;
+}
+
+int feed_reset_memory_cursor(int h) {
+  feed::DataFeed* f = get_feed(h);
+  if (!f) return -1;
+  f->reset_memory_cursor();
+  return 0;
+}
+
+// mode: 0 = queue (blocking), 1 = memory. Returns batch handle or -1 (end).
+int feed_next_batch(int h, int mode) {
+  feed::DataFeed* f = get_feed(h);
+  if (!f) return -1;
+  auto b = mode == 1 ? f->next_batch_from_memory()
+                     : f->next_batch_from_queue();
+  return store_batch(std::move(b));
+}
+
+int64_t feed_batch_num_instances(int bh) {
+  feed::Batch* b = get_batch(bh);
+  return b ? b->size : -1;
+}
+
+// total values for slot (length of the concatenated value array)
+int64_t feed_batch_slot_values(int bh, int slot) {
+  feed::Batch* b = get_batch(bh);
+  if (!b) return -1;
+  return static_cast<int64_t>(std::max(b->u64[slot].size(),
+                                       b->f32[slot].size()));
+}
+
+int feed_batch_copy_u64(int bh, int slot, uint64_t* out) {
+  feed::Batch* b = get_batch(bh);
+  if (!b) return -1;
+  std::memcpy(out, b->u64[slot].data(),
+              b->u64[slot].size() * sizeof(uint64_t));
+  return 0;
+}
+
+int feed_batch_copy_f32(int bh, int slot, float* out) {
+  feed::Batch* b = get_batch(bh);
+  if (!b) return -1;
+  std::memcpy(out, b->f32[slot].data(), b->f32[slot].size() * sizeof(float));
+  return 0;
+}
+
+int feed_batch_copy_lod(int bh, int slot, int64_t* out) {
+  feed::Batch* b = get_batch(bh);
+  if (!b) return -1;
+  std::memcpy(out, b->lod[slot].data(),
+              b->lod[slot].size() * sizeof(int64_t));
+  return 0;
+}
+
+int feed_release_batch(int bh) {
+  std::lock_guard<std::mutex> g(gf_mu);
+  if (bh < 0 || bh >= static_cast<int>(gf_batches.size())) return -1;
+  gf_batches[bh].reset();
+  return 0;
+}
+
+int feed_join(int h) {
+  feed::DataFeed* f = get_feed(h);
+  if (!f) return -1;
+  f->join();
+  return 0;
+}
+
+int feed_has_error(int h) {
+  feed::DataFeed* f = get_feed(h);
+  if (!f) return -1;
+  return f->has_error() ? 1 : 0;
+}
+
+int feed_destroy(int h) {
+  std::lock_guard<std::mutex> g(gf_mu);
+  if (h < 0 || h >= static_cast<int>(gf_feeds.size()) || !gf_feeds[h])
+    return -1;
+  gf_feeds[h]->join();
+  gf_feeds[h].reset();
+  return 0;
+}
+
+}  // extern "C"

@@ -25,12 +25,20 @@ from ..topology import (CommunicateTopology, HybridCommunicateGroup,
                         set_hybrid_communicate_group)
 from .distributed_strategy import DistributedStrategy
 from . import utils  # noqa: F401  (fleet.utils.recompute)
+from . import dataset  # noqa: F401  (InMemoryDataset/QueueDataset)
+from . import data_generator  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .data_generator import DataGenerator, MultiSlotDataGenerator  # noqa: F401
 from ..meta_parallel.engine import HybridParallelTrainStep  # noqa: F401
 
 __all__ = [
     "DistributedStrategy", "init", "distributed_model",
     "distributed_optimizer", "get_hybrid_communicate_group",
     "HybridParallelTrainStep", "UserDefinedRoleMaker", "PaddleCloudRoleMaker",
+    "InMemoryDataset", "QueueDataset", "DataGenerator",
+    "MultiSlotDataGenerator", "init_server", "run_server", "init_worker",
+    "stop_worker", "is_server", "is_worker", "save_persistables",
+    "load_persistables",
 ]
 
 

@@ -1,0 +1,73 @@
+"""DataGenerator — user hook that turns raw logs into MultiSlot lines.
+
+Reference: `fleet/data_generator/data_generator.py`
+(/root/reference/python/paddle/distributed/fleet/data_generator/): users
+subclass and implement `generate_sample(line)` yielding
+[(slot_name, [values]), ...]; `run_from_stdin` serializes to the MultiSlot
+text protocol consumed by the native feed (`_native/csrc/datafeed.cc`):
+per slot `<n> <v1> ... <vn>`, space-separated, one instance per line.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Iterable, List, Sequence, Tuple
+
+Sample = Sequence[Tuple[str, Sequence]]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._batch = 1
+
+    def set_batch(self, batch: int):
+        self._batch = batch
+
+    # -- user hooks ---------------------------------------------------------
+    def generate_sample(self, line):
+        """Override: return a generator yielding one or more samples, each
+        `[(slot_name, [values...]), ...]` in the feed's slot order."""
+        raise NotImplementedError(
+            "implement generate_sample(line) in your DataGenerator subclass")
+
+    def generate_batch(self, samples):
+        """Optional override for batch-level rewrites (negative sampling...)."""
+        for s in samples:
+            yield s
+
+    # -- serialization ------------------------------------------------------
+    @staticmethod
+    def _serialize(sample: Sample) -> str:
+        parts: List[str] = []
+        for _, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def process(self, lines: Iterable[str]) -> Iterable[str]:
+        buf = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            if gen is None:
+                continue
+            for sample in gen() if callable(gen) else gen:
+                if sample is None:
+                    continue
+                buf.append(sample)
+                if len(buf) == self._batch:
+                    for s in self.generate_batch(buf):
+                        yield self._serialize(s)
+                    buf = []
+        for s in self.generate_batch(buf):
+            yield self._serialize(s)
+
+    def run_from_stdin(self):
+        for out in self.process(sys.stdin):
+            sys.stdout.write(out + "\n")
+
+    def run_from_file(self, path: str, out_path: str):
+        with open(path) as fin, open(out_path, "w") as fout:
+            for out in self.process(fin):
+                fout.write(out + "\n")
+
+
+MultiSlotDataGenerator = DataGenerator

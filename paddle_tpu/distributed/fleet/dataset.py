@@ -1,0 +1,203 @@
+"""Dataset wrappers over the native multi-threaded data feed.
+
+Reference: `paddle.distributed.fleet` dataset API
+(/root/reference/python/paddle/distributed/fleet/dataset/dataset.py wrapping
+C++ `MultiSlotDataset`, `framework/data_set.h:47`): `InMemoryDataset`
+(load_into_memory + local_shuffle, PS/CTR training) and `QueueDataset`
+(streaming). Batches come back as numpy per slot: sparse slots as
+(values uint64, lod int64 offsets) ragged pairs; float slots reshaped
+[batch, dim] when rectangular.
+
+`SlotBatch.padded(slot, max_len)` converts a ragged sparse slot to a fixed
+[batch, max_len] id matrix + mask — the TPU-side bridge, since XLA wants
+static shapes (SURVEY §7 "dynamic shapes" hard part).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import _native
+
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+_F32P = ctypes.POINTER(ctypes.c_float)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
+class SlotBatch:
+    """One assembled batch; per-slot ragged or dense numpy views."""
+
+    def __init__(self, num_instances: int, slots: Sequence[str],
+                 values: Dict[str, np.ndarray], lods: Dict[str, np.ndarray]):
+        self.batch_size = num_instances
+        self.slots = list(slots)
+        self._values = values
+        self._lods = lods
+
+    def values(self, slot: str) -> np.ndarray:
+        return self._values[slot]
+
+    def lod(self, slot: str) -> np.ndarray:
+        return self._lods[slot]
+
+    def dense(self, slot: str) -> np.ndarray:
+        """Rectangular view [batch, dim]; raises if ragged."""
+        v, lod = self._values[slot], self._lods[slot]
+        widths = np.diff(lod)
+        if widths.size and not (widths == widths[0]).all():
+            raise ValueError(f"slot {slot} is ragged; use padded()")
+        dim = int(widths[0]) if widths.size else 0
+        return v.reshape(self.batch_size, dim)
+
+    def padded(self, slot: str, max_len: int,
+               pad_value: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+        """Ragged sparse slot -> ([batch, max_len] ids, [batch, max_len] mask)."""
+        v, lod = self._values[slot], self._lods[slot]
+        out = np.full((self.batch_size, max_len), pad_value, v.dtype)
+        mask = np.zeros((self.batch_size, max_len), np.float32)
+        for i in range(self.batch_size):
+            seg = v[lod[i]:lod[i + 1]][:max_len]
+            out[i, :seg.size] = seg
+            mask[i, :seg.size] = 1.0
+        return out, mask
+
+
+class DatasetBase:
+    """Common config (reference DatasetBase, dataset.py)."""
+
+    _mode = 0  # 0 queue, 1 memory
+
+    def __init__(self):
+        self._lib = _native.load()
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist: List[str] = []
+        self._slots: List[str] = []
+        self._slot_types: List[str] = []
+        self._handle: Optional[int] = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, **kwargs):
+        self.set_batch_size(batch_size)
+        self.set_thread(thread_num)
+        if use_var:
+            self.set_use_var(use_var)
+
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self._thread_num = int(thread_num)
+
+    def set_filelist(self, filelist: List[str]):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, slots, types: Optional[List[str]] = None):
+        """slots: names in file order; types: 'uint64' (default) or 'float'."""
+        self._slots = [getattr(s, "name", s) for s in slots]
+        if types is None:
+            types = ["uint64"] * len(self._slots)
+        if len(types) != len(self._slots):
+            raise ValueError(
+                f"set_use_var: {len(self._slots)} slots but {len(types)} types")
+        bad = [t for t in types if t not in ("uint64", "float")]
+        if bad:
+            raise ValueError(f"set_use_var: unknown slot types {bad}")
+        self._slot_types = list(types)
+
+    def _ensure_feed(self):
+        if self._handle is not None:
+            return
+        n = len(self._slots)
+        if n == 0:
+            raise RuntimeError("set_use_var first")
+        arr = (ctypes.c_int * n)(*[1 if t == "float" else 0
+                                   for t in self._slot_types])
+        self._handle = self._lib.feed_create(n, arr, self._batch_size)
+        files = (ctypes.c_char_p * len(self._filelist))(
+            *[f.encode() for f in self._filelist])
+        self._lib.feed_set_filelist(self._handle, files, len(self._filelist))
+
+    def _fetch(self, bh: int) -> Optional[SlotBatch]:
+        if bh < 0:
+            return None
+        lib = self._lib
+        n_ins = lib.feed_batch_num_instances(bh)
+        values, lods = {}, {}
+        for s, (name, typ) in enumerate(zip(self._slots, self._slot_types)):
+            nv = lib.feed_batch_slot_values(bh, s)
+            lod = np.empty(n_ins + 1, np.int64)
+            lib.feed_batch_copy_lod(bh, s, lod.ctypes.data_as(_I64P))
+            if typ == "float":
+                v = np.empty(nv, np.float32)
+                if nv:
+                    lib.feed_batch_copy_f32(bh, s, v.ctypes.data_as(_F32P))
+            else:
+                v = np.empty(nv, np.uint64)
+                if nv:
+                    lib.feed_batch_copy_u64(bh, s, v.ctypes.data_as(_U64P))
+            values[name], lods[name] = v, lod
+        lib.feed_release_batch(bh)
+        return SlotBatch(int(n_ins), self._slots, values, lods)
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset (reference QueueDataset): worker threads tail the
+    file list; iteration yields batches until EOF."""
+
+    _mode = 0
+
+    def __iter__(self) -> Iterator[SlotBatch]:
+        self._ensure_feed()
+        self._lib.feed_start(self._handle, self._thread_num)
+        try:
+            while True:
+                b = self._fetch(self._lib.feed_next_batch(self._handle, 0))
+                if b is None:
+                    break
+                yield b
+            if self._lib.feed_has_error(self._handle):
+                raise RuntimeError(
+                    "QueueDataset: a worker hit a malformed file; epoch is "
+                    "incomplete (check the MultiSlot format of the filelist)")
+        finally:
+            # teardown even on early exit (break / GeneratorExit), else the
+            # next epoch would serve leftover batches from this one
+            self._lib.feed_destroy(self._handle)
+            self._handle = None
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle dataset (reference InMemoryDataset,
+    `data_set.h` in-memory shuffle contract)."""
+
+    _mode = 1
+
+    def load_into_memory(self):
+        self._ensure_feed()
+        rc = self._lib.feed_load_into_memory(self._handle, self._thread_num)
+        if rc != 0:
+            raise RuntimeError("load_into_memory failed (bad file or format)")
+
+    def local_shuffle(self, seed: int = 0):
+        self._ensure_feed()
+        self._lib.feed_local_shuffle(self._handle, seed)
+
+    def get_memory_data_size(self) -> int:
+        self._ensure_feed()
+        return int(self._lib.feed_memory_size(self._handle))
+
+    def release_memory(self):
+        if self._handle is not None:
+            self._lib.feed_destroy(self._handle)  # frees the loaded instances
+            self._handle = None
+
+    def __iter__(self) -> Iterator[SlotBatch]:
+        self._ensure_feed()
+        self._lib.feed_reset_memory_cursor(self._handle)
+        while True:
+            b = self._fetch(self._lib.feed_next_batch(self._handle, 1))
+            if b is None:
+                break
+            yield b

@@ -1,0 +1,158 @@
+"""Native data-feed tests (reference: MultiSlotDataFeed unit tests,
+`paddle/fluid/framework/data_feed_test.cc` and fleet dataset python tests)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import (DataGenerator, InMemoryDataset,
+                                          QueueDataset)
+
+
+def _write_multislot(path, rows):
+    """rows: list of instances; each instance: list per slot of value-lists."""
+    with open(path, "w") as f:
+        for inst in rows:
+            parts = []
+            for values in inst:
+                parts.append(str(len(values)))
+                parts.extend(str(v) for v in values)
+            f.write(" ".join(parts) + "\n")
+
+
+@pytest.fixture
+def slot_files(tmp_path):
+    """2 files x 10 instances, slots: [sparse ids (ragged), label (float 1),
+    dense floats (3)]."""
+    rng = np.random.default_rng(0)
+    all_rows = []
+    files = []
+    for fi in range(2):
+        rows = []
+        for i in range(10):
+            ids = list(rng.integers(0, 1000, rng.integers(1, 5)))
+            label = [float(fi * 10 + i) ]
+            dense = [round(float(x), 3) for x in rng.normal(size=3)]
+            rows.append([ids, label, dense])
+        p = tmp_path / f"part-{fi}.txt"
+        _write_multislot(p, rows)
+        files.append(str(p))
+        all_rows.extend(rows)
+    return files, all_rows
+
+
+def _make(ds_cls, files, batch_size=4, threads=2):
+    ds = ds_cls()
+    ds.set_batch_size(batch_size)
+    ds.set_thread(threads)
+    ds.set_filelist(files)
+    ds.set_use_var(["ids", "label", "dense"],
+                   types=["uint64", "float", "float"])
+    return ds
+
+
+class TestQueueDataset:
+    def test_streams_all_instances(self, slot_files):
+        files, all_rows = slot_files
+        ds = _make(QueueDataset, files)
+        total = 0
+        labels = []
+        for batch in ds:
+            total += batch.batch_size
+            labels.extend(batch.dense("label").ravel().tolist())
+            # ragged sparse slot: lod is consistent
+            lod = batch.lod("ids")
+            assert lod[0] == 0 and lod[-1] == batch.values("ids").size
+        assert total == 20
+        assert sorted(labels) == sorted(
+            float(r[1][0]) for r in all_rows)
+
+    def test_padded_sparse(self, slot_files):
+        files, _ = slot_files
+        ds = _make(QueueDataset, files, batch_size=5, threads=1)
+        batch = next(iter(ds))
+        ids, mask = batch.padded("ids", max_len=6)
+        assert ids.shape == (5, 6) and mask.shape == (5, 6)
+        lod = batch.lod("ids")
+        for i in range(5):
+            n = min(int(lod[i + 1] - lod[i]), 6)
+            assert mask[i, :n].all() and not mask[i, n:].any()
+
+
+class TestQueueDatasetLifecycle:
+    def test_early_exit_then_full_epoch(self, slot_files):
+        """Breaking out of an epoch must not leak batches into the next one."""
+        files, _ = slot_files
+        ds = _make(QueueDataset, files, batch_size=4, threads=2)
+        next(iter(ds))  # abandon the epoch after one batch
+        total = sum(b.batch_size for b in ds)
+        assert total == 20
+
+    def test_malformed_file_raises(self, tmp_path):
+        bad = tmp_path / "bad.txt"
+        bad.write_text("2 1 2 1 0.5\nnot-a-count oops\n")
+        ds = QueueDataset()
+        ds.set_batch_size(2)
+        ds.set_thread(1)
+        ds.set_filelist([str(bad)])
+        ds.set_use_var(["ids", "label"], types=["uint64", "float"])
+        with pytest.raises(RuntimeError, match="malformed"):
+            list(ds)
+
+    def test_type_length_mismatch_raises(self):
+        ds = QueueDataset()
+        with pytest.raises(ValueError, match="3 slots but 2 types"):
+            ds.set_use_var(["a", "b", "c"], types=["uint64", "float"])
+
+
+class TestInMemoryDataset:
+    def test_load_shuffle_iterate(self, slot_files):
+        files, all_rows = slot_files
+        ds = _make(InMemoryDataset, files, batch_size=6)
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 20
+        order1 = [b.dense("label").ravel().tolist() for b in ds]
+        ds.local_shuffle(seed=7)
+        order2 = [b.dense("label").ravel().tolist() for b in ds]
+        flat1 = [x for b in order1 for x in b]
+        flat2 = [x for b in order2 for x in b]
+        assert sorted(flat1) == sorted(flat2)
+        assert flat1 != flat2  # shuffle changed the order
+        # re-iteration after shuffle serves the same epoch again
+        flat3 = [x for b in ds for x in b.dense("label").ravel().tolist()]
+        assert flat3 == flat2
+
+    def test_dense_slot_rectangular(self, slot_files):
+        files, _ = slot_files
+        ds = _make(InMemoryDataset, files, batch_size=20)
+        ds.load_into_memory()
+        batch = next(iter(ds))
+        d = batch.dense("dense")
+        assert d.shape == (20, 3)
+
+
+class TestDataGenerator:
+    def test_roundtrip_through_feed(self, tmp_path):
+        class MyGen(DataGenerator):
+            def generate_sample(self, line):
+                def gen():
+                    toks = line.split()
+                    ids = [int(t) for t in toks[:-1]]
+                    label = float(toks[-1])
+                    yield [("ids", ids), ("label", [label])]
+                return gen
+
+        raw = tmp_path / "raw.txt"
+        raw.write_text("1 2 3 1.0\n4 5 0.0\n")
+        out = tmp_path / "slot.txt"
+        MyGen().run_from_file(str(raw), str(out))
+        assert out.read_text() == "3 1 2 3 1 1.0\n2 4 5 1 0.0\n"
+
+        ds = QueueDataset()
+        ds.set_batch_size(2)
+        ds.set_thread(1)
+        ds.set_filelist([str(out)])
+        ds.set_use_var(["ids", "label"], types=["uint64", "float"])
+        batch = next(iter(ds))
+        assert batch.batch_size == 2
+        np.testing.assert_array_equal(batch.values("ids"),
+                                      np.array([1, 2, 3, 4, 5], np.uint64))
+        np.testing.assert_allclose(batch.dense("label").ravel(), [1.0, 0.0])

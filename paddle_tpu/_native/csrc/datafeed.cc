@@ -89,6 +89,13 @@ class DataFeed {
   // ---------------- memory mode ----------------
 
   bool load_into_memory(int num_threads) {
+    {
+      // idempotent "load the current filelist": restart the cursor and drop
+      // any previously loaded epoch so a reconfigured reload is never stale
+      std::lock_guard<std::mutex> g(file_mu_);
+      next_file_ = 0;
+    }
+    memory_.clear();
     std::vector<std::thread> loaders;
     std::atomic<bool> ok{true};
     std::mutex mem_mu;
@@ -257,7 +264,8 @@ class DataFeed {
       long n = strtol(p, &end, 10);
       if (end == p) return s == 0 && is_blank(p);  // blank line ok
       p = end;
-      if (n < 0) return false;
+      // a corrupt count must surface as a parse error, not a bad_alloc abort
+      if (n < 0 || n > (1L << 24)) return false;
       if (slots_[s].is_float) {
         ins.f32[s].reserve(n);
         for (long i = 0; i < n; ++i) {
@@ -355,6 +363,12 @@ int feed_create(int num_slots, const int* slot_types, int batch_size) {
   }
   auto f = std::make_unique<feed::DataFeed>(std::move(slots), batch_size);
   std::lock_guard<std::mutex> g(gf_mu);
+  for (size_t i = 0; i < gf_feeds.size(); ++i) {
+    if (!gf_feeds[i]) {
+      gf_feeds[i] = std::move(f);
+      return static_cast<int>(i);
+    }
+  }
   gf_feeds.push_back(std::move(f));
   return static_cast<int>(gf_feeds.size()) - 1;
 }

@@ -513,14 +513,18 @@ class Server {
         std::unique_lock<std::mutex> lk(barrier_mu_);
         Barrier& b = barriers_[name];
         int64_t my_gen = b.generation;
+        bool released = true;
         if (++b.count >= world) {
           b.count = 0;
           b.generation += 1;
           b.cv.notify_all();
         } else {
           b.cv.wait(lk, [&] { return !running_ || b.generation != my_gen; });
+          // success iff the barrier actually tripped; a concurrent STOP may
+          // have flipped running_ AFTER releasing us, which is still success
+          released = b.generation != my_gen;
         }
-        resp->u8(running_ ? ST_OK : ST_ERR);
+        resp->u8(released ? ST_OK : ST_ERR);
         return true;
       }
       case CMD_STOP: {

@@ -27,6 +27,10 @@ from . import meta_parallel  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from . import launch  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from . import ps  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
+from .store import TCPStore  # noqa: F401
 from .spawn import spawn  # noqa: F401
 
 # bind paddle.DataParallel lazily (top-level package avoids import cycle)

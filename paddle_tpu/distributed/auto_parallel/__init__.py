@@ -1,0 +1,18 @@
+"""Semi-automatic parallelism (reference `paddle.distributed.auto_parallel`,
+SURVEY §2.6 "Auto parallel" row).
+
+The reference pipeline — Completer (dist-attr propagation), Partitioner
+(per-rank program split), Resharder (comm insertion), Planner (search) —
+collapses on TPU into GSPMD: users annotate with `shard_tensor`, XLA
+propagates and partitions. What this package keeps is the user API
+(`ProcessMesh`, `shard_tensor`, `shard_op`, `TensorDistAttr`) and the
+high-level `Engine` (prepare/fit/evaluate/predict/save/load with
+re-shard-on-restore).
+"""
+from .process_mesh import ProcessMesh, get_current_process_mesh
+from .dist_attribute import TensorDistAttr
+from .interface import shard_tensor, shard_op
+from .engine import Engine
+
+__all__ = ["ProcessMesh", "get_current_process_mesh", "TensorDistAttr",
+           "shard_tensor", "shard_op", "Engine"]

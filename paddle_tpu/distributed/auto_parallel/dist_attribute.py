@@ -1,0 +1,48 @@
+"""Per-tensor distributed attributes.
+
+Reference: `TensorDistAttr`/`OperatorDistAttr`
+(/root/reference/python/paddle/distributed/auto_parallel/dist_attribute.py):
+a (process_mesh, dims_mapping) pair per tensor — dims_mapping[i] names which
+mesh dim shards tensor dim i (-1 = replicated). On TPU this is exactly a
+`PartitionSpec`; `to_partition_spec()` does the translation and GSPMD plays
+the role of the reference's Completer/Partitioner/Resharder pipeline.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .process_mesh import ProcessMesh
+
+
+@dataclass
+class TensorDistAttr:
+    process_mesh: Optional[ProcessMesh] = None
+    dims_mapping: List[int] = field(default_factory=list)
+
+    def to_partition_spec(self) -> P:
+        if self.process_mesh is None:
+            return P()
+        names = self.process_mesh.dim_names
+        return P(*[None if d < 0 else names[d] for d in self.dims_mapping])
+
+    def to_sharding(self, jax_mesh) -> NamedSharding:
+        return NamedSharding(jax_mesh, self.to_partition_spec())
+
+    @staticmethod
+    def from_shard_spec(process_mesh: ProcessMesh,
+                        shard_spec: List[Optional[str]]) -> "TensorDistAttr":
+        """shard_spec: per tensor dim, a mesh dim name or None (reference
+        `shard_tensor(x, mesh, ["dp", None])` convention)."""
+        names = process_mesh.dim_names
+        dm = []
+        for s in shard_spec:
+            if s is None:
+                dm.append(-1)
+            else:
+                if s not in names:
+                    raise ValueError(f"unknown mesh dim {s!r}; mesh has {names}")
+                dm.append(names.index(s))
+        return TensorDistAttr(process_mesh=process_mesh, dims_mapping=dm)

@@ -1,0 +1,73 @@
+"""shard_tensor / shard_op — the semi-auto parallel annotation API.
+
+Reference: `paddle.distributed.shard_tensor`
+(/root/reference/python/paddle/distributed/auto_parallel/interface.py):
+annotate a tensor with (mesh, shard_spec); the Completer propagates dist
+attrs through the graph, the Partitioner splits the program, the Resharder
+inserts comm. TPU translation: the annotation becomes a `NamedSharding` —
+eagerly applied with `jax.device_put` (so the array is physically laid out
+across the mesh immediately), and GSPMD does completion/partition/reshard
+when the consuming computation is jitted.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+from ...framework.tensor import Tensor
+from .dist_attribute import TensorDistAttr
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+
+def shard_tensor(x, process_mesh: Optional[ProcessMesh] = None,
+                 shard_spec: Optional[List[Optional[str]]] = None):
+    """Annotate + physically shard `x` over `process_mesh`.
+
+    Returns the same Tensor object with `.dist_attr` set and its array
+    re-laid-out under the mesh (replicated dims stay replicated).
+    """
+    mesh = process_mesh or get_current_process_mesh()
+    if mesh is None:
+        raise ValueError("shard_tensor: no process_mesh (pass one or use "
+                         "`with ProcessMesh(...):`)")
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    if shard_spec is None:
+        shard_spec = [None] * t.ndim
+    if len(shard_spec) != t.ndim:
+        raise ValueError(
+            f"shard_spec length {len(shard_spec)} != tensor ndim {t.ndim}")
+    attr = TensorDistAttr.from_shard_spec(mesh, shard_spec)
+    jmesh = mesh.to_jax()
+    sharding = attr.to_sharding(jmesh)
+    t.data = jax.device_put(t.data, sharding)
+    t.dist_attr = attr
+    # parameters feed the hybrid/auto engines through dist_spec
+    from ...framework.param import Parameter
+    if isinstance(t, Parameter):
+        t.dist_spec = attr.to_partition_spec()
+    return t
+
+
+def shard_op(op, process_mesh: Optional[ProcessMesh] = None,
+             in_shard_specs=None, out_shard_specs=None):
+    """Annotate a callable: outputs get shard_tensor'd per out_shard_specs
+    (reference interface.py shard_op). Inputs are assumed already sharded."""
+    mesh = process_mesh or get_current_process_mesh()
+
+    def wrapped(*args, **kwargs):
+        out = op(*args, **kwargs)
+        if out_shard_specs is None or mesh is None:
+            return out
+        if isinstance(out, (list, tuple)):
+            if len(out_shard_specs) != len(out):
+                raise ValueError(
+                    f"shard_op: op returned {len(out)} outputs but "
+                    f"{len(out_shard_specs)} out_shard_specs were given")
+            return type(out)(
+                o if s is None else shard_tensor(o, mesh, s)
+                for o, s in zip(out, out_shard_specs))
+        return shard_tensor(out, mesh, out_shard_specs[0]
+                            if isinstance(out_shard_specs[0], (list, type(None)))
+                            else out_shard_specs)
+    return wrapped

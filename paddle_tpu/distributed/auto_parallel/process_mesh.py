@@ -1,0 +1,95 @@
+"""ProcessMesh — the user-facing device topology of auto-parallel.
+
+Reference: `ProcessMesh`
+(/root/reference/python/paddle/distributed/auto_parallel/process_mesh.py):
+an N-D array of process ranks with named dims. TPU translation is direct —
+it IS `jax.sharding.Mesh`; `to_jax()` materializes one over the local
+devices (virtual CPU devices in tests, chips on hardware).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+_current_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh, dtype=np.int64)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._mesh = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim {arr.ndim}")
+        self._dim_names = list(dim_names)
+
+    @property
+    def shape(self):
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def mesh(self) -> np.ndarray:
+        return self._mesh
+
+    @property
+    def process_ids(self) -> List[int]:
+        return self._mesh.reshape(-1).tolist()
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def to_jax(self, devices=None) -> Mesh:
+        """Materialize as a jax Mesh over `devices` (defaults to all local)."""
+        devs = np.asarray(devices if devices is not None else jax.devices())
+        max_id = int(self._mesh.max())
+        if max_id >= devs.size:
+            raise RuntimeError(
+                f"ProcessMesh names process id {max_id} but only "
+                f"{devs.size} devices are visible")
+        grid = devs.reshape(-1)[self._mesh.reshape(-1)].reshape(self._mesh.shape)
+        return Mesh(grid, axis_names=tuple(self._dim_names))
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+    # `with mesh:` scope sets the default mesh for shard_tensor
+    def __enter__(self):
+        global _current_mesh
+        self._prev = _current_mesh
+        _current_mesh = self
+        return self
+
+    def __exit__(self, *exc):
+        global _current_mesh
+        _current_mesh = self._prev
+        return False
+
+
+def get_current_process_mesh() -> Optional[ProcessMesh]:
+    return _current_mesh

@@ -86,12 +86,22 @@ class DatasetBase:
 
     def set_batch_size(self, batch_size: int):
         self._batch_size = int(batch_size)
+        self._invalidate()
 
     def set_thread(self, thread_num: int):
         self._thread_num = int(thread_num)
 
     def set_filelist(self, filelist: List[str]):
         self._filelist = list(filelist)
+        self._invalidate()
+
+    def _invalidate(self):
+        """Config changed: drop the native feed so it is rebuilt with the new
+        filelist/batch size on next use (a kept handle would silently serve
+        the old config)."""
+        if self._handle is not None:
+            self._lib.feed_destroy(self._handle)
+            self._handle = None
 
     def set_use_var(self, slots, types: Optional[List[str]] = None):
         """slots: names in file order; types: 'uint64' (default) or 'float'."""
@@ -105,6 +115,7 @@ class DatasetBase:
         if bad:
             raise ValueError(f"set_use_var: unknown slot types {bad}")
         self._slot_types = list(types)
+        self._invalidate()
 
     def _ensure_feed(self):
         if self._handle is not None:

@@ -24,10 +24,12 @@ from . import tape as tape_mod
 
 
 class Tensor:
-    __slots__ = ("data", "stop_gradient", "grad", "_node", "name", "persistable", "__weakref__")
+    __slots__ = ("data", "stop_gradient", "grad", "_node", "name",
+                 "persistable", "dist_attr", "__weakref__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient: bool = True,
                  name: Optional[str] = None):
+        self.dist_attr = None  # set by distributed.shard_tensor
         if isinstance(data, Tensor):
             data = data.data
         if not isinstance(data, jax.Array):

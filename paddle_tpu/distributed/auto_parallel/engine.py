@@ -149,8 +149,13 @@ class Engine:
         tuple of full arrays that the engine slices into batches."""
         self.prepare()
         if batch_size is not None:
+            ndev = self.process_mesh.get_dim_size(self.data_dim)
+            if batch_size % ndev:
+                raise ValueError(
+                    f"batch_size {batch_size} must be divisible by the "
+                    f"'{self.data_dim}' mesh dim ({ndev})")
             arrs = self._as_arrays(tuple(train_data))
-            n = arrs[0].shape[0]
+            n = (arrs[0].shape[0] // batch_size) * batch_size  # drop_last
             train_data = [tuple(a[i:i + batch_size] for a in arrs)
                           for i in range(0, n, batch_size)]
         for ep in range(epochs):
@@ -191,9 +196,15 @@ class Engine:
     def save(self, path: str):
         from ...framework import io as io_mod
         self.prepare()
-        io_mod.save({"params": {k: np.asarray(v)
-                                for k, v in self.params.items()},
-                     "t": self._t}, path)
+        state = {"params": {k: np.asarray(v)
+                            for k, v in self.params.items()},
+                 "t": self._t}
+        if self.optimizer is not None:
+            # optimizer slots must travel with params, else a resumed Adam
+            # run applies step-_t bias correction to zeroed moments
+            state["opt_state"] = jax.tree_util.tree_map(np.asarray,
+                                                        self.opt_state)
+        io_mod.save(state, path)
 
     def load(self, path: str):
         from ...framework import io as io_mod
@@ -206,4 +217,10 @@ class Engine:
         self.params = {
             k: jax.device_put(jnp.asarray(loaded[k]), self.param_shardings[k])
             for k in self.params}
+        if self.optimizer is not None and "opt_state" in state:
+            self.opt_state = {
+                k: jax.tree_util.tree_map(
+                    lambda a, _k=k: jax.device_put(
+                        jnp.asarray(a), self.param_shardings[_k]), st)
+                for k, st in state["opt_state"].items()}
         self._t = int(state.get("t", 0))

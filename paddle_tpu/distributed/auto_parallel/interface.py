@@ -51,13 +51,28 @@ def shard_tensor(x, process_mesh: Optional[ProcessMesh] = None,
 
 def shard_op(op, process_mesh: Optional[ProcessMesh] = None,
              in_shard_specs=None, out_shard_specs=None):
-    """Annotate a callable: outputs get shard_tensor'd per out_shard_specs
-    (reference interface.py shard_op). Inputs are assumed already sharded."""
-    mesh = process_mesh or get_current_process_mesh()
+    """Annotate a callable: inputs/outputs get shard_tensor'd per the specs
+    (reference interface.py shard_op). The ambient mesh is resolved at CALL
+    time so `with ProcessMesh(...):` around the call site works."""
 
     def wrapped(*args, **kwargs):
+        mesh = process_mesh or get_current_process_mesh()
+        if mesh is None:
+            if in_shard_specs or out_shard_specs:
+                raise ValueError(
+                    "shard_op: shard specs given but no process_mesh is "
+                    "active (pass one or use `with ProcessMesh(...):`)")
+            return op(*args, **kwargs)
+        if in_shard_specs is not None:
+            if len(in_shard_specs) != len(args):
+                raise ValueError(
+                    f"shard_op: {len(args)} inputs but "
+                    f"{len(in_shard_specs)} in_shard_specs")
+            args = tuple(
+                a if s is None else shard_tensor(a, mesh, s)
+                for a, s in zip(args, in_shard_specs))
         out = op(*args, **kwargs)
-        if out_shard_specs is None or mesh is None:
+        if out_shard_specs is None:
             return out
         if isinstance(out, (list, tuple)):
             if len(out_shard_specs) != len(out):

@@ -15,7 +15,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-_current_mesh: Optional["ProcessMesh"] = None
+_mesh_stack: List["ProcessMesh"] = []
 
 
 class ProcessMesh:
@@ -78,18 +78,16 @@ class ProcessMesh:
         return (f"ProcessMesh(shape={self.shape}, "
                 f"dim_names={self._dim_names})")
 
-    # `with mesh:` scope sets the default mesh for shard_tensor
+    # `with mesh:` scope sets the default mesh for shard_tensor; a stack
+    # keeps nested / re-entrant use of the same instance correct
     def __enter__(self):
-        global _current_mesh
-        self._prev = _current_mesh
-        _current_mesh = self
+        _mesh_stack.append(self)
         return self
 
     def __exit__(self, *exc):
-        global _current_mesh
-        _current_mesh = self._prev
+        _mesh_stack.pop()
         return False
 
 
 def get_current_process_mesh() -> Optional[ProcessMesh]:
-    return _current_mesh
+    return _mesh_stack[-1] if _mesh_stack else None

@@ -86,19 +86,19 @@ class DatasetBase:
 
     def set_batch_size(self, batch_size: int):
         self._batch_size = int(batch_size)
-        self._invalidate()
+        self._invalidate(stale_data=False)
 
     def set_thread(self, thread_num: int):
         self._thread_num = int(thread_num)
 
     def set_filelist(self, filelist: List[str]):
         self._filelist = list(filelist)
-        self._invalidate()
+        self._invalidate(stale_data=True)
 
-    def _invalidate(self):
+    def _invalidate(self, stale_data: bool):
         """Config changed: drop the native feed so it is rebuilt with the new
-        filelist/batch size on next use (a kept handle would silently serve
-        the old config)."""
+        config on next use (a kept handle would silently serve the old one).
+        Subclasses holding loaded data decide whether it must be re-loaded."""
         if self._handle is not None:
             self._lib.feed_destroy(self._handle)
             self._handle = None
@@ -115,7 +115,7 @@ class DatasetBase:
         if bad:
             raise ValueError(f"set_use_var: unknown slot types {bad}")
         self._slot_types = list(types)
-        self._invalidate()
+        self._invalidate(stale_data=True)
 
     def _ensure_feed(self):
         if self._handle is not None:
@@ -185,11 +185,27 @@ class InMemoryDataset(DatasetBase):
 
     _mode = 1
 
+    def __init__(self):
+        super().__init__()
+        self._loaded = False
+
+    def _invalidate(self, stale_data: bool):
+        was_loaded = self._loaded and self._handle is not None
+        super()._invalidate(stale_data)
+        if stale_data:
+            # new filelist/slots: the loaded epoch is meaningless now
+            self._loaded = False
+        elif was_loaded:
+            # serving-param change (batch size): transparently re-load so the
+            # data does not silently vanish with the destroyed feed
+            self.load_into_memory()
+
     def load_into_memory(self):
         self._ensure_feed()
         rc = self._lib.feed_load_into_memory(self._handle, self._thread_num)
         if rc != 0:
             raise RuntimeError("load_into_memory failed (bad file or format)")
+        self._loaded = True
 
     def local_shuffle(self, seed: int = 0):
         self._ensure_feed()
@@ -203,8 +219,13 @@ class InMemoryDataset(DatasetBase):
         if self._handle is not None:
             self._lib.feed_destroy(self._handle)  # frees the loaded instances
             self._handle = None
+        self._loaded = False
 
     def __iter__(self) -> Iterator[SlotBatch]:
+        if not self._loaded:
+            raise RuntimeError(
+                "InMemoryDataset: call load_into_memory() before iterating "
+                "(set_filelist/set_use_var reset any previously loaded data)")
         self._ensure_feed()
         self._lib.feed_reset_memory_cursor(self._handle)
         while True:

@@ -1,2 +1,3 @@
 """paddle_tpu.incubate — experimental APIs (reference `python/paddle/incubate/`)."""
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401

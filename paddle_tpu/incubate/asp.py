@@ -1,0 +1,148 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: /root/reference/python/paddle/fluid/contrib/sparsity/asp.py
+(+ `utils.py` mask algorithms, exposed as `paddle.static.sparsity`): compute
+n:m masks for FC/conv weights (`create_mask`, mask_1d best-n-of-m), prune the
+model, and guarantee sparsity through training by re-masking after each
+optimizer step (`OptimizerWithSparsityGuarantee`). The canonical config is
+2:4 — on TPU there is no sparse-tensor-core speedup, but the capability
+(memory/bandwidth reduction + sparsity-aware finetune workflows) is kept.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import layers_common as L
+
+_excluded_layers: Dict[int, set] = {}
+_masks: Dict[int, Dict[str, np.ndarray]] = {}  # id(model) -> param name -> mask
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _mask_1d_rows(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Best-n-of-m mask along the last axis of a 2D view (reference
+    sparsity/utils.py get_mask_1d)."""
+    rows, cols = mat.shape
+    pad = (-cols) % m
+    if pad:
+        mat = np.pad(mat, ((0, 0), (0, pad)))
+    g = np.abs(mat).reshape(rows, -1, m)
+    order = np.argsort(g, axis=-1)  # ascending
+    mask = np.zeros_like(g, dtype=bool)
+    np.put_along_axis(mask, order[..., -n:], True, axis=-1)
+    mask = mask.reshape(rows, -1)[:, :cols]
+    return mask
+
+
+def create_mask(x, func_name: str = "mask_1d", n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m sparsity mask with the same shape as x. For >=2D tensors the m-
+    groups run along dim 0 (the reduction dim of our Linear convention
+    weight[in, out]), matching the reference's along-input-channel masking."""
+    if func_name not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise ValueError(f"unknown mask algo {func_name}")
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    if arr.ndim == 1:
+        return _mask_1d_rows(arr.reshape(1, -1), n, m).reshape(arr.shape)
+    mat = arr.reshape(arr.shape[0], -1)
+    # groups along dim 0: transpose so the reduction dim is contiguous
+    mask_t = _mask_1d_rows(mat.T, n, m)
+    return mask_t.T.reshape(arr.shape)
+
+
+def check_mask_1d(x, n: int = 2, m: int = 4) -> bool:
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    if arr.ndim >= 2:
+        arr = arr.reshape(arr.shape[0], -1).T
+    else:
+        arr = arr.reshape(1, -1)
+    rows, cols = arr.shape
+    pad = (-cols) % m
+    if pad:
+        arr = np.pad(arr, ((0, 0), (0, pad)))
+    g = arr.reshape(rows, -1, m)
+    return bool((np.count_nonzero(g, axis=-1) <= n).all())
+
+
+check_sparsity = check_mask_1d
+
+
+def set_excluded_layers(model: Layer, param_names: List[str]):
+    _excluded_layers.setdefault(id(model), set()).update(param_names)
+
+
+def reset_excluded_layers(model: Optional[Layer] = None):
+    if model is None:
+        _excluded_layers.clear()
+    else:
+        _excluded_layers.pop(id(model), None)
+
+
+def _prunable_params(model: Layer):
+    excluded = _excluded_layers.get(id(model), set())
+    for lname, layer in model.named_sublayers(include_self=True):
+        if isinstance(layer, (L.Linear, L.Conv2D)):
+            for pname, p in layer.named_parameters(include_sublayers=False):
+                full = f"{lname}.{pname}" if lname else pname
+                if pname == "weight" and full not in excluded:
+                    yield full, p
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Apply n:m masks to all supported weights; masks are remembered so
+    `decorate`d optimizers keep sparsity through training."""
+    masks: Dict[str, np.ndarray] = {}
+    for name, p in _prunable_params(model):
+        mask = create_mask(p, func_name=mask_algo, n=n, m=m)
+        p.data = p.data * jnp.asarray(mask, p.data.dtype)
+        if with_mask:
+            masks[name] = mask
+    _masks[id(model)] = masks
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the pruning masks after every step (reference
+    `asp.py` class of the same name; fleet `asp_optimizer.py`)."""
+
+    def __init__(self, optimizer, model: Layer, n: int = 2, m: int = 4):
+        self._optimizer = optimizer
+        self._model = model
+        self._n, self._m = n, m
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        masks = _masks.get(id(self._model))
+        if not masks:
+            return
+        named = dict(self._model.named_parameters())
+        for name, mask in masks.items():
+            p = named.get(name)
+            if p is not None:
+                p.data = p.data * jnp.asarray(mask, p.data.dtype)
+
+    def clear_grad(self, *a, **kw):
+        return self._optimizer.clear_grad(*a, **kw)
+
+
+def decorate(optimizer, model: Layer, n: int = 2, m: int = 4):
+    return OptimizerWithSparsityGuarantee(optimizer, model, n, m)
+
+
+__all__ = ["calculate_density", "create_mask", "check_mask_1d",
+           "check_sparsity", "prune_model", "decorate",
+           "set_excluded_layers", "reset_excluded_layers",
+           "OptimizerWithSparsityGuarantee"]

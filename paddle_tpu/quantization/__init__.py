@@ -1,0 +1,300 @@
+"""Quantization: QAT fake-quant training and post-training quantization.
+
+Reference: the slim quantization stack
+(/root/reference/python/paddle/fluid/contrib/slim/quantization/):
+`quantization_pass.py` inserts fake_quantize/dequantize ops around conv/fc
+(QAT), `imperative/qat.py` wraps dygraph layers, and
+`post_training_quantization.py` calibrates scales over sample data with
+abs_max / moving-average / KL-divergence strategies (`cal_kl_threshold.py`).
+
+TPU translation: fake-quant is a pure function with a straight-through
+estimator (identity gradient via `x + stop_gradient(q(x) - x)`), so QAT runs
+inside the same eager tape / jit paths as everything else. "Converted" int8
+inference stores int8 weights + scales and dequantizes at the matmul edge —
+on TPU the win is HBM bandwidth (int8 weights are 4x smaller); the MXU
+compute itself stays bf16/f32 via XLA's native int8->bf16 dot handling.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn import layers_common as L
+from ..ops import _dispatch
+
+
+# ---------------------------------------------------------------------------
+# fake-quant primitives
+# ---------------------------------------------------------------------------
+
+def quantize_dequantize(x: jax.Array, scale: jax.Array,
+                        bits: int = 8) -> jax.Array:
+    """Symmetric uniform fake-quant with straight-through estimator."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def abs_max_scale(x: jax.Array, channel_axis: Optional[int] = None) -> jax.Array:
+    if channel_axis is None:
+        return jnp.max(jnp.abs(x))
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    return jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+
+
+@_dispatch.kernel("fake_quantize_dequantize_abs_max")
+def _fake_quant_abs_max(x, *, bits=8, channel_axis=None):
+    return quantize_dequantize(x, abs_max_scale(x, channel_axis), bits)
+
+
+def fake_quant(x, bits: int = 8, channel_axis: Optional[int] = None):
+    """Tensor-facing fake quant (QAT building block)."""
+    return _dispatch.call(_fake_quant_abs_max, [x],
+                          {"bits": bits, "channel_axis": channel_axis})
+
+
+# ---------------------------------------------------------------------------
+# QAT layer wrappers (reference imperative/qat.py QuantizedConv2D/Linear)
+# ---------------------------------------------------------------------------
+
+class MovingAverageObserver:
+    """EMA of activation abs-max (reference FakeQuantMovingAverageAbsMax)."""
+
+    def __init__(self, momentum: float = 0.9):
+        self.momentum = momentum
+        self.scale: Optional[float] = None
+
+    def update(self, x: jax.Array) -> float:
+        cur = float(jnp.max(jnp.abs(x)))
+        if self.scale is None:
+            self.scale = cur
+        else:
+            self.scale = self.momentum * self.scale + (1 - self.momentum) * cur
+        return self.scale
+
+
+class QuantedLinear(Layer):
+    def __init__(self, inner: L.Linear, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = inner
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._act_observer = MovingAverageObserver()
+
+    def forward(self, x):
+        w = fake_quant(self.inner.weight, self.weight_bits, channel_axis=1)
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        if self.training:
+            self._act_observer.update(x.data)
+        xq = fake_quant(x, self.activation_bits)
+        return F.linear(xq, w, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, inner: L.Conv2D, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = inner
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._act_observer = MovingAverageObserver()
+
+    def forward(self, x):
+        w = fake_quant(self.inner.weight, self.weight_bits, channel_axis=0)
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        if self.training:
+            self._act_observer.update(x.data)
+        xq = fake_quant(x, self.activation_bits)
+        return F.conv2d(xq, w, self.inner.bias, self.inner._stride,
+                        self.inner._padding, self.inner._dilation,
+                        self.inner._groups, self.inner._data_format)
+
+
+_QAT_MAP = {L.Linear: QuantedLinear, L.Conv2D: QuantedConv2D}
+
+
+class QAT:
+    """Quantization-aware training driver (reference ImperativeQuantAware,
+    slim/quantization/imperative/qat.py)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def quantize(self, model: Layer) -> Layer:
+        """In-place: swap quantizable sublayers for fake-quant wrappers."""
+        self._swap(model)
+        return model
+
+    def _swap(self, layer: Layer):
+        for name, child in list(layer._sub_layers.items()):
+            if type(child) in _QAT_MAP:
+                layer._sub_layers[name] = _QAT_MAP[type(child)](
+                    child, self.weight_bits, self.activation_bits)
+            else:
+                self._swap(child)
+
+
+# ---------------------------------------------------------------------------
+# Post-training quantization
+# ---------------------------------------------------------------------------
+
+def kl_threshold(hist: np.ndarray, bin_width: float, bits: int = 8) -> float:
+    """KL-divergence calibration threshold (reference cal_kl_threshold.py):
+    pick the clip range whose quantized distribution diverges least from the
+    original activation histogram."""
+    n_quant = 2 ** (bits - 1)
+    hist = hist.astype(np.float64)
+    total = hist.sum()
+    if total == 0:
+        return bin_width * len(hist)
+    best_i, best_kl = len(hist), np.inf
+    for i in range(n_quant, len(hist) + 1):
+        ref = hist[:i].copy()
+        outliers = hist[i:].sum()
+        ref[i - 1] += outliers
+        ref_p = ref / ref.sum()
+        # quantize i bins down to n_quant
+        chunks = np.array_split(hist[:i], n_quant)
+        q = np.concatenate([
+            np.full(len(c), c.sum() / max((c > 0).sum(), 1)) * (c > 0)
+            for c in chunks])
+        if q.sum() == 0:
+            continue
+        q_p = q / q.sum()
+        mask = ref_p > 0
+        kl = float(np.sum(ref_p[mask] * np.log(
+            ref_p[mask] / np.maximum(q_p[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return best_i * bin_width
+
+
+class PTQ:
+    """Post-training quantization (reference PostTrainingQuantization).
+
+    Usage: ptq = PTQ(algo="abs_max"|"avg"|"KL"); ptq.sample(model, batches);
+    qmodel = ptq.convert(model) — weights become int8 + scale, activations
+    get fixed dequant scales from calibration.
+    """
+
+    def __init__(self, algo: str = "abs_max", bits: int = 8, hist_bins: int = 2048):
+        if algo not in ("abs_max", "avg", "KL"):
+            raise ValueError(f"unknown PTQ algo {algo}")
+        self.algo = algo
+        self.bits = bits
+        self.hist_bins = hist_bins
+        self._act_stats: Dict[int, dict] = {}
+
+    def sample(self, model: Layer, batches) -> None:
+        """Run calibration batches, recording activation stats per layer."""
+        hooks = []
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, (L.Linear, L.Conv2D)):
+                st = self._act_stats.setdefault(
+                    id(layer), {"absmax": 0.0, "sum": 0.0, "n": 0,
+                                "hist": np.zeros(self.hist_bins),
+                                "hist_max": 1e-8})
+                hooks.append(layer.register_forward_pre_hook(
+                    self._make_hook(st)))
+        try:
+            for batch in batches:
+                if not isinstance(batch, (list, tuple)):
+                    batch = (batch,)
+                model(*batch)
+        finally:
+            for h in hooks:
+                h.remove()
+
+    def _make_hook(self, st):
+        def hook(layer, inputs):
+            x = inputs[0]
+            arr = np.abs(np.asarray(x.data if isinstance(x, Tensor) else x))
+            amax = float(arr.max()) if arr.size else 0.0
+            st["absmax"] = max(st["absmax"], amax)
+            st["sum"] += amax
+            st["n"] += 1
+            if self.algo == "KL" and amax > 0:
+                if amax > st["hist_max"]:  # rescale histogram to new range
+                    ratio = st["hist_max"] / amax
+                    idx = (np.arange(self.hist_bins) * ratio).astype(int)
+                    newh = np.zeros(self.hist_bins)
+                    np.add.at(newh, idx, st["hist"])
+                    st["hist"], st["hist_max"] = newh, amax
+                h, _ = np.histogram(arr, bins=self.hist_bins,
+                                    range=(0, st["hist_max"]))
+                st["hist"] += h
+            return None
+        return hook
+
+    def _act_scale(self, st) -> float:
+        if self.algo == "abs_max":
+            return st["absmax"]
+        if self.algo == "avg":
+            return st["sum"] / max(st["n"], 1)
+        return kl_threshold(st["hist"], st["hist_max"] / self.hist_bins,
+                            self.bits)
+
+    def convert(self, model: Layer) -> Layer:
+        """Swap calibrated layers for int8-weight inference layers."""
+        self._convert(model)
+        return model
+
+    def _convert(self, layer: Layer):
+        for name, child in list(layer._sub_layers.items()):
+            if isinstance(child, (L.Linear, L.Conv2D)) and \
+                    id(child) in self._act_stats:
+                act_scale = self._act_scale(self._act_stats[id(child)])
+                layer._sub_layers[name] = QuantizedInferenceLayer(
+                    child, act_scale, self.bits)
+            else:
+                self._convert(child)
+
+
+class QuantizedInferenceLayer(Layer):
+    """Int8-weight layer produced by PTQ.convert: stores weight as int8 +
+    per-channel scale (4x smaller in HBM), dequantizes at the compute edge."""
+
+    def __init__(self, inner, act_scale: float, bits: int = 8):
+        super().__init__()
+        self._is_conv = isinstance(inner, L.Conv2D)
+        self.inner = inner
+        qmax = float(2 ** (bits - 1) - 1)
+        ch_axis = 0 if self._is_conv else 1
+        w = inner.weight.data
+        scale = abs_max_scale(w, channel_axis=ch_axis)
+        scale = jnp.maximum(scale, 1e-8)
+        self.w_int8 = jnp.clip(jnp.round(w / scale * qmax),
+                               -qmax, qmax).astype(jnp.int8)
+        self.w_scale = scale / qmax
+        self.act_scale = float(act_scale)
+        self.bits = bits
+        # drop the fp32 weight from this layer's params (weights live as the
+        # int8 buffer); keep bias
+        self._w_shape = tuple(w.shape)
+
+    def dequant_weight(self) -> Tensor:
+        return Tensor(self.w_int8.astype(jnp.float32) * self.w_scale,
+                      stop_gradient=True)
+
+    def forward(self, x):
+        w = self.dequant_weight()
+        inner = self.inner
+        if self._is_conv:
+            return F.conv2d(x, w, inner.bias, inner._stride, inner._padding,
+                            inner._dilation, inner._groups, inner._data_format)
+        return F.linear(x, w, inner.bias)
+
+
+__all__ = ["QAT", "PTQ", "fake_quant", "quantize_dequantize", "kl_threshold",
+           "QuantedLinear", "QuantedConv2D", "QuantizedInferenceLayer",
+           "MovingAverageObserver", "abs_max_scale"]

@@ -741,5 +741,9 @@ __all__ = [
     "BuildStrategy", "ExecutionStrategy", "InputSpec", "append_backward",
     "data", "default_main_program", "default_startup_program",
     "global_scope", "scope_guard", "program_guard", "save_inference_model",
-    "load_inference_model", "normalize_program", "nn",
+    "load_inference_model", "normalize_program", "nn", "sparsity",
 ]
+
+
+# paddle.static.sparsity parity (reference exposes ASP here)
+from ..incubate import asp as sparsity  # noqa: E402,F401

@@ -1,0 +1,154 @@
+"""Quantization (QAT/PTQ) and ASP 2:4 sparsity tests.
+
+Reference test models: slim quantization unit tests
+(`unittests/test_imperative_qat.py`, `test_post_training_quantization_*`)
+and the ASP suite (`unittests/asp/test_asp_pruning_1d.py`,
+`test_asp_optimize.py`).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import asp
+from paddle_tpu.quantization import (PTQ, QAT, QuantedLinear,
+                                     QuantizedInferenceLayer, fake_quant,
+                                     kl_threshold)
+
+
+class TestFakeQuant:
+    def test_roundtrip_error_small(self):
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(64,)).astype(np.float32))
+        q = fake_quant(x, bits=8)
+        err = np.abs(q.numpy() - x.numpy()).max()
+        scale = np.abs(x.numpy()).max()
+        assert err <= scale / 127 + 1e-6
+
+    def test_straight_through_gradient(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 16).astype(np.float32),
+                             stop_gradient=False)
+        y = (fake_quant(x, bits=8) ** 2).sum()
+        y.backward()
+        # STE: d/dx fake_quant = identity, so grad == 2*quant(x) ~ 2x
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), atol=0.05)
+
+    def test_per_channel(self):
+        rng = np.random.default_rng(1)
+        w = rng.normal(size=(4, 8)).astype(np.float32)
+        w[:, 3] *= 100  # huge channel must not destroy others' resolution
+        q = fake_quant(paddle.to_tensor(w), bits=8, channel_axis=1)
+        err = np.abs(q.numpy() - w)
+        assert err[:, :3].max() < np.abs(w[:, :3]).max() / 100
+
+
+class TestQAT:
+    def test_swaps_layers_and_trains(self):
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        QAT().quantize(model)
+        assert isinstance(model[0], QuantedLinear)
+        assert isinstance(model[2], QuantedLinear)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = (x.sum(1, keepdims=True) > 0).astype(np.float32)
+        losses = []
+        for _ in range(30):
+            out = model(paddle.to_tensor(x))
+            loss = ((out - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+    def test_qat_close_to_float(self):
+        paddle.seed(3)
+        model = nn.Linear(8, 4)
+        x = paddle.to_tensor(
+            np.random.default_rng(2).normal(size=(16, 8)).astype(np.float32))
+        ref = model(x).numpy()
+        QAT().quantize(parent := nn.Sequential(model))
+        out = parent(x).numpy()
+        assert np.abs(out - ref).max() < np.abs(ref).max() * 0.05
+
+
+class TestPTQ:
+    def _calib(self, model, n=8):
+        rng = np.random.default_rng(0)
+        return [paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+                for _ in range(n)]
+
+    @pytest.mark.parametrize("algo", ["abs_max", "avg", "KL"])
+    def test_convert_int8(self, algo):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        batches = self._calib(model)
+        ref = model(batches[0]).numpy()
+        ptq = PTQ(algo=algo)
+        ptq.sample(model, batches)
+        ptq.convert(model)
+        assert isinstance(model[0], QuantizedInferenceLayer)
+        assert model[0].w_int8.dtype == np.int8
+        out = model(batches[0]).numpy()
+        # int8 weights: small relative error on the calibration data
+        assert np.abs(out - ref).max() < max(np.abs(ref).max(), 1) * 0.1
+
+    def test_kl_threshold_prefers_bulk(self):
+        # non-uniform mass near 0 + tiny outlier tail: coarse binning of the
+        # bulk costs KL, so the calibrated clip lands below the max range
+        hist = np.zeros(512)
+        hist[:128] = 1000 * np.exp(-np.arange(128) / 16.0)
+        hist[-1] = 1
+        t = kl_threshold(hist, bin_width=0.01)
+        assert 128 * 0.01 <= t < 512 * 0.01, t
+
+
+class TestASP:
+    def test_create_mask_2_4(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(16, 8)).astype(np.float32)
+        mask = asp.create_mask(w)
+        assert mask.shape == w.shape
+        assert asp.check_sparsity(w * mask)
+        # exactly half survive
+        assert mask.sum() == w.size // 2
+        # kept entries are the 2 largest |.| of each group of 4 along dim 0
+        col = (w * mask)[:, 0]
+        g = np.abs(w[:4, 0])
+        kept = np.nonzero(mask[:4, 0])[0]
+        assert set(kept) == set(np.argsort(g)[-2:])
+
+    def test_prune_model_and_density(self):
+        model = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+        asp.prune_model(model)
+        for _, layer in model.named_sublayers(include_self=True):
+            if isinstance(layer, nn.Linear):
+                assert asp.check_sparsity(layer.weight)
+                assert abs(asp.calculate_density(layer.weight) - 0.5) < 1e-6
+
+    def test_optimizer_guarantee_keeps_sparsity(self):
+        model = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 1))
+        asp.prune_model(model)
+        opt = asp.decorate(
+            optimizer.SGD(learning_rate=0.1, parameters=model.parameters()),
+            model)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 16)).astype(np.float32)
+        y = rng.normal(size=(8, 1)).astype(np.float32)
+        for _ in range(5):
+            loss = ((model(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert asp.check_sparsity(model[0].weight)
+        assert asp.check_sparsity(model[2].weight)
+
+    def test_excluded_layers(self):
+        model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers(model, ["0.weight"])
+        asp.prune_model(model)
+        assert asp.calculate_density(model[0].weight) == 1.0
+        assert abs(asp.calculate_density(model[1].weight) - 0.5) < 1e-6
+        asp.reset_excluded_layers(model)

@@ -156,6 +156,10 @@ class Engine:
                     f"'{self.data_dim}' mesh dim ({ndev})")
             arrs = self._as_arrays(tuple(train_data))
             n = (arrs[0].shape[0] // batch_size) * batch_size  # drop_last
+            if n == 0:
+                raise ValueError(
+                    f"fit: dataset has {arrs[0].shape[0]} samples, fewer "
+                    f"than batch_size {batch_size} — no full batch to train")
             train_data = [tuple(a[i:i + batch_size] for a in arrs)
                           for i in range(0, n, batch_size)]
         for ep in range(epochs):

@@ -20,8 +20,9 @@ from ..framework.tensor import Tensor
 from ..nn.layer import Layer
 from ..nn import layers_common as L
 
-_excluded_layers: Dict[int, set] = {}
-_masks: Dict[int, Dict[str, np.ndarray]] = {}  # id(model) -> param name -> mask
+# masks/exclusions are stored ON the model object (attributes _asp_masks /
+# _asp_excluded) — module-level id(model) keying would leak and could collide
+# after CPython id reuse
 
 
 def calculate_density(x) -> float:
@@ -44,27 +45,36 @@ def _mask_1d_rows(mat: np.ndarray, n: int, m: int) -> np.ndarray:
     return mask
 
 
+def _reduction_view(arr: np.ndarray) -> np.ndarray:
+    """2D view [kept_dim, reduction_dim] whose LAST axis is the matmul/conv
+    reduction axis — where n:m groups must run (reference sparsity/utils.py):
+    Linear weight[in, out] reduces over dim 0; Conv weight[out, in, kh, kw]
+    reduces over in*kh*kw."""
+    if arr.ndim == 1:
+        return arr.reshape(1, -1)
+    if arr.ndim == 2:
+        return arr.T               # [out, in]
+    return arr.reshape(arr.shape[0], -1)   # conv: [out, in*kh*kw]
+
+
 def create_mask(x, func_name: str = "mask_1d", n: int = 2, m: int = 4) -> np.ndarray:
-    """n:m sparsity mask with the same shape as x. For >=2D tensors the m-
-    groups run along dim 0 (the reduction dim of our Linear convention
-    weight[in, out]), matching the reference's along-input-channel masking."""
+    """n:m sparsity mask with the same shape as x, groups along the
+    reduction axis (see _reduction_view)."""
     if func_name not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
         raise ValueError(f"unknown mask algo {func_name}")
     arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    view = _reduction_view(arr)
+    mask = _mask_1d_rows(view, n, m)
     if arr.ndim == 1:
-        return _mask_1d_rows(arr.reshape(1, -1), n, m).reshape(arr.shape)
-    mat = arr.reshape(arr.shape[0], -1)
-    # groups along dim 0: transpose so the reduction dim is contiguous
-    mask_t = _mask_1d_rows(mat.T, n, m)
-    return mask_t.T.reshape(arr.shape)
+        return mask.reshape(arr.shape)
+    if arr.ndim == 2:
+        return mask.T.reshape(arr.shape)
+    return mask.reshape(arr.shape)
 
 
 def check_mask_1d(x, n: int = 2, m: int = 4) -> bool:
     arr = np.asarray(x.data if isinstance(x, Tensor) else x)
-    if arr.ndim >= 2:
-        arr = arr.reshape(arr.shape[0], -1).T
-    else:
-        arr = arr.reshape(1, -1)
+    arr = _reduction_view(arr)
     rows, cols = arr.shape
     pad = (-cols) % m
     if pad:
@@ -77,18 +87,18 @@ check_sparsity = check_mask_1d
 
 
 def set_excluded_layers(model: Layer, param_names: List[str]):
-    _excluded_layers.setdefault(id(model), set()).update(param_names)
+    if not hasattr(model, "_asp_excluded"):
+        object.__setattr__(model, "_asp_excluded", set())
+    model._asp_excluded.update(param_names)
 
 
 def reset_excluded_layers(model: Optional[Layer] = None):
-    if model is None:
-        _excluded_layers.clear()
-    else:
-        _excluded_layers.pop(id(model), None)
+    if model is not None and hasattr(model, "_asp_excluded"):
+        model._asp_excluded.clear()
 
 
 def _prunable_params(model: Layer):
-    excluded = _excluded_layers.get(id(model), set())
+    excluded = getattr(model, "_asp_excluded", set())
     for lname, layer in model.named_sublayers(include_self=True):
         if isinstance(layer, (L.Linear, L.Conv2D)):
             for pname, p in layer.named_parameters(include_sublayers=False):
@@ -107,7 +117,7 @@ def prune_model(model: Layer, n: int = 2, m: int = 4,
         p.data = p.data * jnp.asarray(mask, p.data.dtype)
         if with_mask:
             masks[name] = mask
-    _masks[id(model)] = masks
+    object.__setattr__(model, "_asp_masks", masks)
     return masks
 
 
@@ -125,7 +135,7 @@ class OptimizerWithSparsityGuarantee:
 
     def step(self):
         self._optimizer.step()
-        masks = _masks.get(id(self._model))
+        masks = getattr(self._model, "_asp_masks", None)
         if not masks:
             return
         named = dict(self._model.named_parameters())

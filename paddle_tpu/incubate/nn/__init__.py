@@ -1,0 +1,184 @@
+"""incubate.nn — fused transformer building blocks.
+
+Reference: /root/reference/python/paddle/incubate/nn/layer/fused_transformer.py
+(`FusedMultiHeadAttention`, `FusedFeedForward`, `FusedTransformerEncoderLayer`)
+binding the CUDA kernels in `paddle/fluid/operators/fused/`
+(fused_attention_op.cu, fused_feedforward_op.cu).
+
+TPU translation: the "fusion" is (a) one packed QKV projection feeding the
+flash-attention kernel (`ops/pallas/flash_attention.py`) instead of the
+reference's materialized-scores FMHA, and (b) the residual+dropout+layernorm
+epilogue composed so XLA emits a single HBM pass
+(`ops/pallas/layer_norm.py` fused_layer_norm w/ custom vjp).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as random_mod
+from ...framework.tensor import Tensor
+from ...nn.initializer import XavierUniform
+from ...nn.layer import Layer
+from ...ops import _dispatch
+from ...ops.pallas.flash_attention import flash_attention
+from ...ops.pallas.layer_norm import fused_layer_norm, fused_residual_dropout_ln
+
+
+def _rng():
+    return random_mod.default_generator().split()
+
+
+@_dispatch.kernel("fused_multihead_attention")
+def _fused_mha_impl(x, qkv_w, qkv_b, out_w, out_b, ln_g, ln_b, rng,
+                    *mask, num_heads, pre_layer_norm, attn_dropout, dropout,
+                    causal, epsilon, training):
+    B, L, E = x.shape
+    H = num_heads
+    D = E // H
+    residual = x
+    h = fused_layer_norm(x, ln_g, ln_b, epsilon) if pre_layer_norm else x
+    qkv = jnp.einsum("ble,ef->blf", h, qkv_w) + qkv_b        # [B,L,3E]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, L, H, D)
+    k = k.reshape(B, L, H, D)
+    v = v.reshape(B, L, H, D)
+    ctx = flash_attention(q, k, v, mask=mask[0] if mask else None,
+                          causal=causal)                      # [B,L,H,D]
+    ctx = ctx.reshape(B, L, E)
+    out = jnp.einsum("ble,ef->blf", ctx, out_w) + out_b
+    if pre_layer_norm:
+        if training and dropout > 0.0:
+            keep = jax.random.bernoulli(rng, 1.0 - dropout, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout), 0.0).astype(out.dtype)
+        return (residual + out).astype(x.dtype)
+    return fused_residual_dropout_ln(
+        out, residual, ln_g, ln_b, p=dropout, eps=epsilon, rng=rng,
+        training=training).astype(x.dtype)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference `fused_transformer.py` FusedMultiHeadAttention: packed QKV +
+    attention + out-proj + residual/dropout/LN in one op."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, normalize_before=False,
+                 need_weights=False, weight_attr=None, bias_attr=None,
+                 epsilon=1e-5):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        init = XavierUniform()
+        self.qkv_weight = self.create_parameter(
+            (embed_dim, 3 * embed_dim), default_initializer=init)
+        self.qkv_bias = self.create_parameter((3 * embed_dim,), is_bias=True)
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), default_initializer=init)
+        self.linear_bias = self.create_parameter((embed_dim,), is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), default_initializer=None, is_bias=False)
+        self.ln_scale.data = jnp.ones_like(self.ln_scale.data)
+        self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        causal = isinstance(attn_mask, str) and attn_mask == "causal"
+        tensors = [query, self.qkv_weight, self.qkv_bias, self.linear_weight,
+                   self.linear_bias, self.ln_scale, self.ln_bias,
+                   Tensor(_rng())]
+        if attn_mask is not None and not causal:
+            tensors.append(attn_mask)  # additive or boolean [B,H,L,L] mask
+        return _dispatch.call(
+            _fused_mha_impl, tensors,
+            {"num_heads": self.num_heads,
+             "pre_layer_norm": self.normalize_before,
+             "attn_dropout": self.attn_dropout_rate,
+             "dropout": self.dropout_rate, "causal": causal,
+             "epsilon": self.epsilon, "training": self.training})
+
+
+@_dispatch.kernel("fused_feedforward")
+def _fused_ffn_impl(x, w1, b1, w2, b2, ln_g, ln_b, rng,
+                    *, act, pre_layer_norm, dropout, epsilon, training):
+    residual = x
+    h = fused_layer_norm(x, ln_g, ln_b, epsilon) if pre_layer_norm else x
+    h = jnp.einsum("...e,ef->...f", h, w1) + b1
+    h = jax.nn.gelu(h, approximate=False) if act == "gelu" else jax.nn.relu(h)
+    h = jnp.einsum("...f,fe->...e", h, w2) + b2
+    if pre_layer_norm:
+        if training and dropout > 0.0:
+            keep = jax.random.bernoulli(rng, 1.0 - dropout, h.shape)
+            h = jnp.where(keep, h / (1.0 - dropout), 0.0).astype(h.dtype)
+        return (residual + h).astype(x.dtype)
+    return fused_residual_dropout_ln(
+        h, residual, ln_g, ln_b, p=dropout, eps=epsilon, rng=rng,
+        training=training).astype(x.dtype)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.epsilon = epsilon
+        init = XavierUniform()
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), default_initializer=init)
+        self.linear1_bias = self.create_parameter((dim_feedforward,),
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), default_initializer=init)
+        self.linear2_bias = self.create_parameter((d_model,), is_bias=True)
+        self.ln_scale = self.create_parameter((d_model,), is_bias=False)
+        self.ln_scale.data = jnp.ones_like(self.ln_scale.data)
+        self.ln_bias = self.create_parameter((d_model,), is_bias=True)
+
+    def forward(self, src):
+        return _dispatch.call(
+            _fused_ffn_impl,
+            [src, self.linear1_weight, self.linear1_bias,
+             self.linear2_weight, self.linear2_bias, self.ln_scale,
+             self.ln_bias, Tensor(_rng())],
+            {"act": self.activation,
+             "pre_layer_norm": self.normalize_before,
+             "dropout": self.dropout_rate, "epsilon": self.epsilon,
+             "training": self.training})
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference FusedTransformerEncoderLayer = FusedMHA + FusedFFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=(attn_dropout_rate if attn_dropout_rate
+                               is not None else dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]

@@ -1,0 +1,70 @@
+"""incubate.operators — fused softmax-mask ops and graph message passing.
+
+Reference: /root/reference/python/paddle/incubate/operators/
+(`softmax_mask_fuse.py`, `softmax_mask_fuse_upper_triangle.py` binding
+operators/fused/fused_softmax_mask_*.cu, and `graph_send_recv.py`). On TPU
+these are jnp compositions registered as kernels — XLA's fusion pass
+produces the single-kernel form the reference hand-writes in CUDA; the
+segment ops lower to efficient sorted-scatter on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import _dispatch
+
+
+@_dispatch.kernel("fused_softmax_mask")
+def _softmax_mask_fuse_impl(x, mask):
+    xf = x.astype(jnp.float32) + mask.astype(jnp.float32)
+    return jax.nn.softmax(xf, axis=-1).astype(x.dtype)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (reference softmax_mask_fuse.py; x is
+    [B, H, L, L] attention scores, mask broadcastable additive)."""
+    return _dispatch.call(_softmax_mask_fuse_impl, [x, mask])
+
+
+@_dispatch.kernel("fused_softmax_mask_upper_triangle")
+def _softmax_mask_fuse_upper_triangle_impl(x):
+    L = x.shape[-1]
+    causal = jnp.tril(jnp.ones((L, L), dtype=bool))
+    xf = jnp.where(causal, x.astype(jnp.float32), -1e30)
+    return jax.nn.softmax(xf, axis=-1).astype(x.dtype)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (reference softmax_mask_fuse_upper_triangle)."""
+    return _dispatch.call(_softmax_mask_fuse_upper_triangle_impl, [x])
+
+
+@_dispatch.kernel("graph_send_recv")
+def _graph_send_recv_impl(x, src_index, dst_index, *, pool_type, out_size):
+    n_out = out_size if out_size is not None else x.shape[0]
+    gathered = x[src_index]
+    if pool_type == "sum":
+        return jax.ops.segment_sum(gathered, dst_index, num_segments=n_out)
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(gathered, dst_index, num_segments=n_out)
+        cnt = jax.ops.segment_sum(jnp.ones_like(dst_index, jnp.float32),
+                                  dst_index, num_segments=n_out)
+        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (s.ndim - 1)]
+    if pool_type == "max":
+        return jax.ops.segment_max(gathered, dst_index, num_segments=n_out)
+    if pool_type == "min":
+        return jax.ops.segment_min(gathered, dst_index, num_segments=n_out)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Gather-scatter message passing (reference graph_send_recv.py)."""
+    return _dispatch.call(
+        _graph_send_recv_impl, [x, src_index, dst_index],
+        {"pool_type": pool_type, "out_size": out_size})
+
+
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "graph_send_recv"]

@@ -1,0 +1,147 @@
+"""Fused layer norm: Pallas forward kernel + custom-vjp backward.
+
+TPU-native replacement for the reference's fused LN kernels
+(/root/reference/paddle/fluid/operators/fused/fused_dropout_helper.h,
+`fused_layernorm_residual_dropout_bias.h`, and phi
+`layer_norm_kernel.cu`): one pass over each row computes mean/rstd and the
+normalized output, so x is read once from HBM (the op is bandwidth-bound —
+SURVEY §"HBM bandwidth"). Backward recomputes x_hat from the saved
+(mean, rstd) — cheaper in bytes than saving it.
+
+The Pallas path runs on TPU; elsewhere an identical XLA composition is used
+(tests run on CPU; XLA fuses it into the same shape of loop anyway).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# ----------------------------- forward --------------------------------------
+
+def _ln_stats_xla(x2d: jax.Array, eps: float):
+    xf = x2d.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1)
+    var = jnp.mean(jnp.square(xf), axis=-1) - jnp.square(mean)
+    rstd = jax.lax.rsqrt(var + eps)
+    return mean, rstd
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "block_rows"))
+def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5, block_rows: int = 128):
+    from jax.experimental import pallas as pl
+
+    R, N = x2d.shape
+
+    def kernel(x_ref, g_ref, b_ref, o_ref, mean_ref, rstd_ref):
+        x = x_ref[...].astype(jnp.float32)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x), axis=-1, keepdims=True) - jnp.square(mean)
+        rstd = jax.lax.rsqrt(var + eps)
+        xhat = (x - mean) * rstd
+        y = xhat * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+        o_ref[...] = y.astype(o_ref.dtype)
+        mean_ref[...] = mean[:, 0]
+        rstd_ref[...] = rstd[:, 0]
+
+    grid = (max(R // block_rows, 1),)
+    br = min(block_rows, R)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, N), lambda i: (i, 0)),
+            pl.BlockSpec((N,), lambda i: (0,)),
+            pl.BlockSpec((N,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, N), lambda i: (i, 0)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, N), x2d.dtype),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+        ],
+    )(x2d, gamma, beta)
+
+
+def _ln_fwd(x2d, gamma, beta, eps):
+    R, N = x2d.shape
+    if _on_tpu() and R % 8 == 0 and N % 128 == 0:
+        try:
+            y, mean, rstd = _ln_fwd_pallas(x2d, gamma, beta, eps=eps)
+            return y, mean, rstd
+        except Exception:
+            pass
+    mean, rstd = _ln_stats_xla(x2d, eps)
+    xhat = (x2d.astype(jnp.float32) - mean[:, None]) * rstd[:, None]
+    y = (xhat * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
+         ).astype(x2d.dtype)
+    return y, mean, rstd
+
+
+# --------------------------- custom vjp op ----------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer_norm(x, gamma, beta, eps: float = 1e-5):
+    """LayerNorm over the last dim of x (any leading shape)."""
+    y, _, _ = _fwd_core(x, gamma, beta, eps)
+    return y
+
+
+def _fwd_core(x, gamma, beta, eps):
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    y, mean, rstd = _ln_fwd(x2d, gamma, beta, eps)
+    return y.reshape(shape), mean, rstd
+
+
+def _fused_ln_fwd(x, gamma, beta, eps):
+    y, mean, rstd = _fwd_core(x, gamma, beta, eps)
+    return y, (x, gamma, mean, rstd)
+
+
+def _fused_ln_bwd(eps, res, dy):
+    x, gamma, mean, rstd = res
+    shape = x.shape
+    N = shape[-1]
+    x2d = x.reshape(-1, N).astype(jnp.float32)
+    dy2d = dy.reshape(-1, N).astype(jnp.float32)
+    xhat = (x2d - mean[:, None]) * rstd[:, None]
+    dg = jnp.sum(dy2d * xhat, axis=0).astype(gamma.dtype)
+    db = jnp.sum(dy2d, axis=0).astype(gamma.dtype)
+    dxhat = dy2d * gamma.astype(jnp.float32)
+    m1 = jnp.mean(dxhat, axis=-1, keepdims=True)
+    m2 = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    dx = (rstd[:, None] * (dxhat - m1 - xhat * m2)).astype(x.dtype)
+    return dx.reshape(shape), dg, db
+
+
+fused_layer_norm.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+# ------------------- fused residual + dropout + layer-norm -------------------
+
+def fused_residual_dropout_ln(x, residual, gamma, beta, *, p: float = 0.0,
+                              eps: float = 1e-5,
+                              rng: Optional[jax.Array] = None,
+                              training: bool = True):
+    """out = LN(residual + dropout(x)) — the reference's
+    `fused_layernorm_residual_dropout_bias` epilogue, composed so XLA emits
+    one fused HBM pass (dropout mask is generated on the fly, never stored)."""
+    if training and p > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
+        x = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return fused_layer_norm(residual + x, gamma, beta, eps)

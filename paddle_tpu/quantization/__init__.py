@@ -66,19 +66,25 @@ def fake_quant(x, bits: int = 8, channel_axis: Optional[int] = None):
 # ---------------------------------------------------------------------------
 
 class MovingAverageObserver:
-    """EMA of activation abs-max (reference FakeQuantMovingAverageAbsMax)."""
+    """EMA of activation abs-max (reference FakeQuantMovingAverageAbsMax).
+    The scale is kept as a device scalar — no host sync in the train loop."""
 
     def __init__(self, momentum: float = 0.9):
         self.momentum = momentum
-        self.scale: Optional[float] = None
+        self.scale: Optional[jax.Array] = None
 
-    def update(self, x: jax.Array) -> float:
-        cur = float(jnp.max(jnp.abs(x)))
+    def update(self, x: jax.Array) -> jax.Array:
+        cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
         if self.scale is None:
             self.scale = cur
         else:
             self.scale = self.momentum * self.scale + (1 - self.momentum) * cur
         return self.scale
+
+
+@_dispatch.kernel("fake_quantize_dequantize_moving_average_abs_max")
+def _fake_quant_with_scale(x, scale, *, bits=8):
+    return quantize_dequantize(x, scale, bits)
 
 
 class QuantedLinear(Layer):
@@ -89,14 +95,22 @@ class QuantedLinear(Layer):
         self.activation_bits = activation_bits
         self._act_observer = MovingAverageObserver()
 
-    def forward(self, x):
-        w = fake_quant(self.inner.weight, self.weight_bits, channel_axis=1)
+    def _quant_act(self, x):
         if not isinstance(x, Tensor):
             x = Tensor(x)
         if self.training:
-            self._act_observer.update(x.data)
-        xq = fake_quant(x, self.activation_bits)
-        return F.linear(xq, w, self.inner.bias)
+            scale = self._act_observer.update(x.data)
+        else:  # inference: frozen EMA scale, like the reference's test-time path
+            scale = self._act_observer.scale
+            if scale is None:
+                scale = abs_max_scale(x.data)
+        return _dispatch.call(_fake_quant_with_scale,
+                              [x, Tensor(scale)],
+                              {"bits": self.activation_bits})
+
+    def forward(self, x):
+        w = fake_quant(self.inner.weight, self.weight_bits, channel_axis=1)
+        return F.linear(self._quant_act(x), w, self.inner.bias)
 
 
 class QuantedConv2D(Layer):
@@ -107,16 +121,14 @@ class QuantedConv2D(Layer):
         self.activation_bits = activation_bits
         self._act_observer = MovingAverageObserver()
 
+    _quant_act = QuantedLinear._quant_act
+
     def forward(self, x):
         w = fake_quant(self.inner.weight, self.weight_bits, channel_axis=0)
-        if not isinstance(x, Tensor):
-            x = Tensor(x)
-        if self.training:
-            self._act_observer.update(x.data)
-        xq = fake_quant(x, self.activation_bits)
-        return F.conv2d(xq, w, self.inner.bias, self.inner._stride,
-                        self.inner._padding, self.inner._dilation,
-                        self.inner._groups, self.inner._data_format)
+        return F.conv2d(self._quant_act(x), w, self.inner.bias,
+                        self.inner._stride, self.inner._padding,
+                        self.inner._dilation, self.inner._groups,
+                        self.inner._data_format)
 
 
 _QAT_MAP = {L.Linear: QuantedLinear, L.Conv2D: QuantedConv2D}
@@ -261,38 +273,50 @@ class PTQ:
 
 
 class QuantizedInferenceLayer(Layer):
-    """Int8-weight layer produced by PTQ.convert: stores weight as int8 +
-    per-channel scale (4x smaller in HBM), dequantizes at the compute edge."""
+    """Int8-weight layer produced by PTQ.convert: the fp32 weight is
+    replaced by an int8 buffer + per-channel scale (4x smaller in HBM and in
+    checkpoints — both live in state_dict as buffers), dequantized at the
+    compute edge. Activations are clipped/quantized with the CALIBRATED
+    scale, so the PTQ algo (abs_max/avg/KL) governs inference numerics."""
 
     def __init__(self, inner, act_scale: float, bits: int = 8):
         super().__init__()
         self._is_conv = isinstance(inner, L.Conv2D)
-        self.inner = inner
         qmax = float(2 ** (bits - 1) - 1)
         ch_axis = 0 if self._is_conv else 1
         w = inner.weight.data
-        scale = abs_max_scale(w, channel_axis=ch_axis)
-        scale = jnp.maximum(scale, 1e-8)
-        self.w_int8 = jnp.clip(jnp.round(w / scale * qmax),
-                               -qmax, qmax).astype(jnp.int8)
-        self.w_scale = scale / qmax
-        self.act_scale = float(act_scale)
+        scale = jnp.maximum(abs_max_scale(w, channel_axis=ch_axis), 1e-8)
+        self.register_buffer(
+            "w_int8",
+            Tensor(jnp.clip(jnp.round(w / scale * qmax), -qmax, qmax)
+                   .astype(jnp.int8)))
+        self.register_buffer("w_scale", Tensor(scale / qmax))
+        self.register_buffer(
+            "act_scale", Tensor(jnp.asarray(act_scale, jnp.float32)))
         self.bits = bits
-        # drop the fp32 weight from this layer's params (weights live as the
-        # int8 buffer); keep bias
-        self._w_shape = tuple(w.shape)
+        # take ownership of the bias; the fp32 weight is NOT retained
+        self.bias = inner.bias
+        if self._is_conv:
+            self._stride = inner._stride
+            self._padding = inner._padding
+            self._dilation = inner._dilation
+            self._groups = inner._groups
+            self._data_format = inner._data_format
 
     def dequant_weight(self) -> Tensor:
-        return Tensor(self.w_int8.astype(jnp.float32) * self.w_scale,
-                      stop_gradient=True)
+        return Tensor(self.w_int8.data.astype(jnp.float32)
+                      * self.w_scale.data, stop_gradient=True)
 
     def forward(self, x):
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        xq = _dispatch.call(_fake_quant_with_scale,
+                            [x, self.act_scale], {"bits": self.bits})
         w = self.dequant_weight()
-        inner = self.inner
         if self._is_conv:
-            return F.conv2d(x, w, inner.bias, inner._stride, inner._padding,
-                            inner._dilation, inner._groups, inner._data_format)
-        return F.linear(x, w, inner.bias)
+            return F.conv2d(xq, w, self.bias, self._stride, self._padding,
+                            self._dilation, self._groups, self._data_format)
+        return F.linear(xq, w, self.bias)
 
 
 __all__ = ["QAT", "PTQ", "fake_quant", "quantize_dequantize", "kl_threshold",

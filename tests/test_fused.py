@@ -1,0 +1,182 @@
+"""Fused layers / fused kernels tests.
+
+Reference tests: `unittests/test_fused_attention_op.py`,
+`test_fused_feedforward_op.py`, `test_softmax_mask_fuse_op.py`,
+`test_graph_send_recv_op.py` — the fused op must match the unfused
+composition numerically, and train.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import (graph_send_recv, softmax_mask_fuse,
+                                 softmax_mask_fuse_upper_triangle)
+from paddle_tpu.incubate.nn import (FusedFeedForward,
+                                    FusedMultiHeadAttention,
+                                    FusedTransformerEncoderLayer)
+from paddle_tpu.ops.pallas.layer_norm import fused_layer_norm
+
+
+class TestFusedLayerNorm:
+    def test_matches_functional(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 6, 32)).astype(np.float32)
+        g = rng.normal(size=(32,)).astype(np.float32)
+        b = rng.normal(size=(32,)).astype(np.float32)
+        got = np.asarray(fused_layer_norm(jnp.asarray(x), jnp.asarray(g),
+                                          jnp.asarray(b), 1e-5))
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        want = (x - mean) / np.sqrt(var + 1e-5) * g + b
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_gradients_match_numeric(self):
+        import jax
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(3, 16)).astype(np.float32))
+        g = jnp.asarray(rng.normal(size=(16,)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(16,)).astype(np.float32))
+
+        def f(x, g, b):
+            return jnp.sum(fused_layer_norm(x, g, b, 1e-5) ** 2)
+
+        def f_ref(x, g, b):
+            mean = jnp.mean(x, -1, keepdims=True)
+            var = jnp.var(x, -1, keepdims=True)
+            return jnp.sum(((x - mean) / jnp.sqrt(var + 1e-5) * g + b) ** 2)
+
+        got = jax.grad(f, argnums=(0, 1, 2))(x, g, b)
+        want = jax.grad(f_ref, argnums=(0, 1, 2))(x, g, b)
+        for a, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=1e-4, atol=1e-4)
+
+
+class TestFusedMHA:
+    def test_matches_unfused_reference(self):
+        """Fused MHA (post-LN, no dropout) == manual composition."""
+        paddle.seed(0)
+        E, H = 32, 4
+        layer = FusedMultiHeadAttention(E, H, dropout_rate=0.0,
+                                        attn_dropout_rate=0.0)
+        layer.eval()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 8, E)).astype(np.float32)
+        out = layer(paddle.to_tensor(x)).numpy()
+
+        qkv = x @ np.asarray(layer.qkv_weight.data) + np.asarray(layer.qkv_bias.data)
+        q, k, v = np.split(qkv, 3, axis=-1)
+        D = E // H
+        q = q.reshape(2, 8, H, D).transpose(0, 2, 1, 3)
+        k = k.reshape(2, 8, H, D).transpose(0, 2, 1, 3)
+        v = v.reshape(2, 8, H, D).transpose(0, 2, 1, 3)
+        s = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ctx = (p @ v).transpose(0, 2, 1, 3).reshape(2, 8, E)
+        proj = ctx @ np.asarray(layer.linear_weight.data) + \
+            np.asarray(layer.linear_bias.data)
+        resid = x + proj
+        mean = resid.mean(-1, keepdims=True)
+        var = resid.var(-1, keepdims=True)
+        want = (resid - mean) / np.sqrt(var + 1e-5) * \
+            np.asarray(layer.ln_scale.data) + np.asarray(layer.ln_bias.data)
+        np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+    def test_trains(self):
+        paddle.seed(0)
+        layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.1)
+        head = nn.Linear(32, 1)
+        params = layer.parameters() + head.parameters()
+        opt = optimizer.Adam(learning_rate=1e-3, parameters=params)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 8, 32)).astype(np.float32)
+        y = rng.normal(size=(4, 8, 1)).astype(np.float32)
+        losses = []
+        for _ in range(25):
+            out = head(layer(paddle.to_tensor(x)))
+            loss = ((out - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+    def test_pre_layer_norm_and_causal(self):
+        paddle.seed(1)
+        layer = FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                        attn_dropout_rate=0.0,
+                                        normalize_before=True)
+        layer.eval()
+        x = np.random.default_rng(3).normal(size=(1, 6, 16)).astype(np.float32)
+        out = layer(paddle.to_tensor(x), attn_mask="causal").numpy()
+        assert out.shape == (1, 6, 16)
+        # causal: output at position 0 must not depend on later positions
+        x2 = x.copy()
+        x2[:, 3:] += 100.0
+        out2 = layer(paddle.to_tensor(x2), attn_mask="causal").numpy()
+        np.testing.assert_allclose(out[:, 0], out2[:, 0], rtol=1e-4, atol=1e-4)
+
+
+class TestFusedFFN:
+    def test_matches_unfused(self):
+        paddle.seed(0)
+        ffn = FusedFeedForward(16, 32, dropout_rate=0.0, activation="gelu")
+        ffn.eval()
+        x = np.random.default_rng(0).normal(size=(2, 4, 16)).astype(np.float32)
+        out = ffn(paddle.to_tensor(x)).numpy()
+        import scipy.special as sp
+        h = x @ np.asarray(ffn.linear1_weight.data) + np.asarray(ffn.linear1_bias.data)
+        h = 0.5 * h * (1 + sp.erf(h / np.sqrt(2)))
+        h = h @ np.asarray(ffn.linear2_weight.data) + np.asarray(ffn.linear2_bias.data)
+        r = x + h
+        mean, var = r.mean(-1, keepdims=True), r.var(-1, keepdims=True)
+        want = (r - mean) / np.sqrt(var + 1e-5) * np.asarray(ffn.ln_scale.data) \
+            + np.asarray(ffn.ln_bias.data)
+        np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+
+class TestSoftmaxMaskFuse:
+    def test_additive_mask(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 2, 4, 4)).astype(np.float32)
+        mask = np.where(rng.random((2, 1, 4, 4)) > 0.5, 0.0, -1e9).astype(np.float32)
+        out = softmax_mask_fuse(paddle.to_tensor(x), paddle.to_tensor(mask)).numpy()
+        z = x + mask
+        e = np.exp(z - z.max(-1, keepdims=True))
+        want = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_upper_triangle(self):
+        x = np.random.default_rng(0).normal(size=(1, 1, 5, 5)).astype(np.float32)
+        out = softmax_mask_fuse_upper_triangle(paddle.to_tensor(x)).numpy()
+        # strictly-upper entries masked out
+        assert np.allclose(np.triu(out[0, 0], k=1), 0.0)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+class TestGraphSendRecv:
+    def test_pool_types(self):
+        x = paddle.to_tensor(np.array([[1.0, 2], [3, 4], [5, 6]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+        out = graph_send_recv(x, src, dst, pool_type="sum").numpy()
+        want = np.zeros((3, 2), np.float32)
+        want[1] = [1, 2]; want[2] = [3, 4]; want[1] += [5, 6]; want[0] = [1, 2]
+        np.testing.assert_allclose(out, want)
+        out_mean = graph_send_recv(x, src, dst, pool_type="mean").numpy()
+        np.testing.assert_allclose(out_mean[1], [3, 4])
+
+    def test_gradient_flows(self):
+        x = paddle.to_tensor(
+            np.array([[1.0, 2], [3, 4], [5, 6]], np.float32),
+            stop_gradient=False)
+        src = paddle.to_tensor(np.array([0, 1], np.int32))
+        dst = paddle.to_tensor(np.array([1, 1], np.int32))
+        out = graph_send_recv(x, src, dst, pool_type="sum")
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [[1, 1], [1, 1], [0, 0]])

@@ -92,8 +92,40 @@ class TestPTQ:
         assert isinstance(model[0], QuantizedInferenceLayer)
         assert model[0].w_int8.dtype == np.int8
         out = model(batches[0]).numpy()
-        # int8 weights: small relative error on the calibration data
-        assert np.abs(out - ref).max() < max(np.abs(ref).max(), 1) * 0.1
+        # int8 weights + clipped activations: small relative error on the
+        # calibration data (KL deliberately clips the activation tail, so
+        # its bound is looser than pure abs_max)
+        tol = 0.25 if algo == "KL" else 0.1
+        assert np.abs(out - ref).max() < max(np.abs(ref).max(), 1) * tol
+
+    def test_act_scale_actually_applied(self):
+        """The calibrated activation scale must affect inference: data far
+        outside the calibration range gets clipped."""
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 4))
+        ptq = PTQ(algo="abs_max")
+        small = [paddle.to_tensor(0.01 * np.ones((4, 8), np.float32))]
+        ptq.sample(model, small)
+        ptq.convert(model)
+        big = paddle.to_tensor(100.0 * np.ones((4, 8), np.float32))
+        out_big = model(big).numpy()
+        # with act clipping at ~0.01, the 100x input saturates: output must
+        # be far from the unclipped linear response
+        w = model[0].dequant_weight().numpy()
+        unclipped = 100.0 * np.ones((4, 8)) @ w + np.asarray(model[0].bias.data)
+        assert np.abs(out_big).max() < np.abs(unclipped).max() * 0.01
+
+    def test_int8_weights_in_state_dict(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 4))
+        ptq = PTQ()
+        ptq.sample(model, [paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32))])
+        ptq.convert(model)
+        sd = model.state_dict()
+        keys = set(sd.keys())
+        assert any("w_int8" in k for k in keys), keys
+        assert not any(k.endswith("weight") for k in keys), keys
 
     def test_kl_threshold_prefers_bulk(self):
         # non-uniform mass near 0 + tiny outlier tail: coarse binning of the
@@ -144,6 +176,24 @@ class TestASP:
             opt.clear_grad()
         assert asp.check_sparsity(model[0].weight)
         assert asp.check_sparsity(model[2].weight)
+
+    def test_conv_mask_along_reduction_axis(self):
+        """Conv [out, in, kh, kw]: each out-filter's in*kh*kw reduction dim
+        carries the 2:4 groups (reference reshapes to [out, in*kh*kw])."""
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(8, 4, 3, 3)).astype(np.float32)
+        mask = asp.create_mask(w)
+        flat = mask.reshape(8, -1)  # 36 values per filter
+        for row in flat:
+            full = np.concatenate([row, np.zeros((-len(row)) % 4)])
+            assert (full.reshape(-1, 4).sum(1) <= 2).all()
+        assert asp.check_sparsity(w * mask)
+
+    def test_prune_conv_model(self):
+        model = nn.Sequential(nn.Conv2D(4, 8, 3), nn.ReLU())
+        asp.prune_model(model)
+        assert asp.check_sparsity(model[0].weight)
+        assert abs(asp.calculate_density(model[0].weight) - 0.5) < 0.05
 
     def test_excluded_layers(self):
         model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))

@@ -56,6 +56,7 @@ from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
 
 # paddle-API conveniences
 from .ops.creation import to_tensor  # noqa: E402,F401

@@ -20,9 +20,13 @@ from ..framework.tensor import Tensor
 from ..nn.layer import Layer
 from ..nn import layers_common as L
 
+import weakref
+
 # masks/exclusions are stored ON the model object (attributes _asp_masks /
 # _asp_excluded) — module-level id(model) keying would leak and could collide
-# after CPython id reuse
+# after CPython id reuse. A WeakSet tracks models with exclusions so
+# reset_excluded_layers(None) can clear them all (paddle semantics).
+_models_with_exclusions: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def calculate_density(x) -> float:
@@ -90,10 +94,16 @@ def set_excluded_layers(model: Layer, param_names: List[str]):
     if not hasattr(model, "_asp_excluded"):
         object.__setattr__(model, "_asp_excluded", set())
     model._asp_excluded.update(param_names)
+    _models_with_exclusions.add(model)
 
 
 def reset_excluded_layers(model: Optional[Layer] = None):
-    if model is not None and hasattr(model, "_asp_excluded"):
+    if model is None:
+        for m in list(_models_with_exclusions):
+            if hasattr(m, "_asp_excluded"):
+                m._asp_excluded.clear()
+        return
+    if hasattr(model, "_asp_excluded"):
         model._asp_excluded.clear()
 
 

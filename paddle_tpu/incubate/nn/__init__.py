@@ -47,17 +47,36 @@ def _fused_mha_impl(x, qkv_w, qkv_b, out_w, out_b, ln_g, ln_b, rng,
     q = q.reshape(B, L, H, D)
     k = k.reshape(B, L, H, D)
     v = v.reshape(B, L, H, D)
-    ctx = flash_attention(q, k, v, mask=mask[0] if mask else None,
-                          causal=causal)                      # [B,L,H,D]
+    rng_attn, rng_out = jax.random.split(rng)
+    if training and attn_dropout > 0.0:
+        # attention-probability dropout needs the materialized probs, so this
+        # path composes attention inline (XLA fuses it); inference and
+        # no-dropout training take the flash kernel
+        logits = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / jnp.sqrt(float(D))
+        if causal:
+            cm = jnp.tril(jnp.ones((L, L), dtype=bool))
+            logits = jnp.where(cm, logits, -1e30)
+        if mask:
+            m = mask[0]
+            logits = jnp.where(m, logits, -1e30) if m.dtype == jnp.bool_ \
+                else logits + m.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        keep = jax.random.bernoulli(rng_attn, 1.0 - attn_dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - attn_dropout), 0.0)
+        ctx = jnp.einsum("bhlm,bmhd->blhd", probs.astype(v.dtype), v)
+    else:
+        ctx = flash_attention(q, k, v, mask=mask[0] if mask else None,
+                              causal=causal)                  # [B,L,H,D]
     ctx = ctx.reshape(B, L, E)
     out = jnp.einsum("ble,ef->blf", ctx, out_w) + out_b
     if pre_layer_norm:
         if training and dropout > 0.0:
-            keep = jax.random.bernoulli(rng, 1.0 - dropout, out.shape)
+            keep = jax.random.bernoulli(rng_out, 1.0 - dropout, out.shape)
             out = jnp.where(keep, out / (1.0 - dropout), 0.0).astype(out.dtype)
         return (residual + out).astype(x.dtype)
     return fused_residual_dropout_ln(
-        out, residual, ln_g, ln_b, p=dropout, eps=epsilon, rng=rng,
+        out, residual, ln_g, ln_b, p=dropout, eps=epsilon, rng=rng_out,
         training=training).astype(x.dtype)
 
 
@@ -107,19 +126,24 @@ class FusedMultiHeadAttention(Layer):
 
 @_dispatch.kernel("fused_feedforward")
 def _fused_ffn_impl(x, w1, b1, w2, b2, ln_g, ln_b, rng,
-                    *, act, pre_layer_norm, dropout, epsilon, training):
+                    *, act, pre_layer_norm, dropout, act_dropout, epsilon,
+                    training):
     residual = x
     h = fused_layer_norm(x, ln_g, ln_b, epsilon) if pre_layer_norm else x
     h = jnp.einsum("...e,ef->...f", h, w1) + b1
     h = jax.nn.gelu(h, approximate=False) if act == "gelu" else jax.nn.relu(h)
+    rng_act, rng_out = jax.random.split(rng)
+    if training and act_dropout > 0.0:
+        keep = jax.random.bernoulli(rng_act, 1.0 - act_dropout, h.shape)
+        h = jnp.where(keep, h / (1.0 - act_dropout), 0.0).astype(h.dtype)
     h = jnp.einsum("...f,fe->...e", h, w2) + b2
     if pre_layer_norm:
         if training and dropout > 0.0:
-            keep = jax.random.bernoulli(rng, 1.0 - dropout, h.shape)
+            keep = jax.random.bernoulli(rng_out, 1.0 - dropout, h.shape)
             h = jnp.where(keep, h / (1.0 - dropout), 0.0).astype(h.dtype)
         return (residual + h).astype(x.dtype)
     return fused_residual_dropout_ln(
-        h, residual, ln_g, ln_b, p=dropout, eps=epsilon, rng=rng,
+        h, residual, ln_g, ln_b, p=dropout, eps=epsilon, rng=rng_out,
         training=training).astype(x.dtype)
 
 
@@ -130,6 +154,8 @@ class FusedFeedForward(Layer):
         super().__init__()
         self.normalize_before = normalize_before
         self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate if act_dropout_rate
+                                 is not None else dropout_rate)
         self.activation = activation
         self.epsilon = epsilon
         init = XavierUniform()
@@ -152,7 +178,9 @@ class FusedFeedForward(Layer):
              self.ln_bias, Tensor(_rng())],
             {"act": self.activation,
              "pre_layer_norm": self.normalize_before,
-             "dropout": self.dropout_rate, "epsilon": self.epsilon,
+             "dropout": self.dropout_rate,
+             "act_dropout": self.act_dropout_rate,
+             "epsilon": self.epsilon,
              "training": self.training})
 
 

@@ -1,0 +1,259 @@
+"""paddle_tpu.inference — the deployment Predictor API.
+
+Reference: `paddle.inference`
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.cc +
+`paddle_analysis_config.h`, python face `python/paddle/inference/`):
+Config -> create_predictor -> zero-copy input/output handles -> Run.
+
+TPU translation: the saved artifact is a serialized StableHLO export
+(`static.save_inference_model` / `jit.save`), so the reference's Analyzer IR
+pass pipeline (fc fusion, conv+bn folding, multihead-matmul fuse...) is
+XLA's job at load time; `Predictor` compiles one executable per input-shape
+signature and caches it (the AnalysisPredictor re-prepare-on-shape-change
+behavior). Handles hold device arrays; `copy_from_cpu`/`copy_to_cpu` are the
+only host transfers.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    XPU = "xpu"
+
+
+class Config:
+    """reference `paddle_analysis_config.h` AnalysisConfig."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and params_file is None and \
+                not os.path.exists(prog_file + ".pdmodel"):
+            # directory form: Config("dir") -> dir/inference
+            cand = os.path.join(prog_file, "inference")
+            if os.path.exists(cand + ".pdmodel"):
+                prog_file = cand
+        self._prefix = self._resolve_prefix(prog_file, params_file)
+        self._device = None  # default: whatever jax has
+        self._precision = PrecisionType.Float32
+        self._ir_optim = True
+        self._memory_optim = True
+        self._threads = 1
+        self._enable_profile = False
+
+    @staticmethod
+    def _resolve_prefix(prog_file, params_file) -> Optional[str]:
+        if prog_file is None:
+            return None
+        for suffix in (".pdmodel", ".json"):
+            if prog_file.endswith(suffix):
+                return prog_file[:-len(suffix)]
+        return prog_file
+
+    # -- device selection ---------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0, precision=PrecisionType.Float32):
+        self._device = device_id  # accelerator := jax default device
+        self._precision = precision
+
+    def enable_tpu(self, device_id: int = 0):
+        self._device = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._device not in (None, "cpu")
+
+    # -- optimization toggles (XLA always optimizes; kept for parity) -------
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._threads = n
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def model_dir(self) -> Optional[str]:
+        return self._prefix
+
+    def prog_file(self) -> Optional[str]:
+        return None if self._prefix is None else self._prefix + ".pdmodel"
+
+    def params_file(self) -> Optional[str]:
+        return None if self._prefix is None else self._prefix + ".pdiparams"
+
+    def summary(self) -> str:
+        return (f"Config(prefix={self._prefix}, device={self._device}, "
+                f"precision={self._precision})")
+
+
+class TensorHandle:
+    """Zero-copy-style IO handle (reference ZeroCopyTensor,
+    `paddle_infer::Tensor`)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._arr: Optional[jax.Array] = None
+
+    # input side
+    def copy_from_cpu(self, data: np.ndarray):
+        self._arr = jnp.asarray(data)
+
+    def reshape(self, shape: Sequence[int]):
+        if self._arr is not None:
+            self._arr = self._arr.reshape(tuple(shape))
+
+    def share_external_data(self, data):
+        self._arr = data.data if hasattr(data, "data") else jnp.asarray(data)
+
+    # output side
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._arr is None:
+            raise RuntimeError(f"handle {self.name}: no data (run() first?)")
+        return np.asarray(self._arr)
+
+    def shape(self) -> List[int]:
+        return [] if self._arr is None else list(self._arr.shape)
+
+    def type(self):
+        return None if self._arr is None else self._arr.dtype
+
+
+class Predictor:
+    """reference AnalysisPredictor (`analysis_predictor.cc:232` Init /
+    `:672` Run) over a StableHLO export."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        prefix = config._prefix
+        if prefix is None:
+            raise ValueError("Config has no model path")
+        from jax import export as jexport
+        from ..framework import io as io_mod
+        with open(prefix + ".pdmodel", "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        # both artifact flavors pickle a name->array mapping; framework.io
+        # also understands jit.save's Tensor-wrapped entries
+        raw = io_mod.load(prefix + ".pdiparams", return_numpy=True)
+        arrays = {n: jnp.asarray(self._unwrap(p)) for n, p in raw.items()}
+        meta_path = prefix + ".pdmeta"
+        self._meta = {}
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                self._meta = pickle.load(f)
+        # artifact flavor: static save_inference_model exports fn(params,
+        # *feeds) with feed names; jit.save exports fn(params, buffers,
+        # *feeds) with positional inputs
+        self._with_buffers = "feed_names" not in self._meta
+        bkeys = set(self._meta.get("buffer_keys", []))
+        self._params = {n: a for n, a in arrays.items() if n not in bkeys}
+        self._buffers = {n: a for n, a in arrays.items() if n in bkeys}
+        if "feed_names" in self._meta:
+            self._input_names = list(self._meta["feed_names"])
+        else:
+            n_pos = int(self._meta.get("n_inputs", 1))
+            self._input_names = [f"x{i}" for i in range(n_pos)]
+        n_out = self._meta.get("fetch_count") or \
+            len(getattr(self._exported, "out_avals", []) or []) or 1
+        self._output_names = [f"fetch_{i}" for i in range(n_out)]
+        self._inputs: Dict[str, TensorHandle] = {
+            n: TensorHandle(n) for n in self._input_names}
+        self._outputs: Dict[str, TensorHandle] = {
+            n: TensorHandle(n) for n in self._output_names}
+
+    @staticmethod
+    def _unwrap(p):
+        return p.numpy() if hasattr(p, "numpy") else np.asarray(p)
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_input_handle(self, name: str) -> TensorHandle:
+        if name not in self._inputs:
+            # permissive like the reference: allow positional pseudo-names
+            self._inputs[name] = TensorHandle(name)
+            self._input_names.append(name)
+        return self._inputs[name]
+
+    def get_output_handle(self, name: str) -> TensorHandle:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. Either pass `inputs` positionally (returns outputs) or
+        pre-fill input handles and read output handles (reference style)."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(a))
+        args = []
+        for n in self._input_names:
+            h = self._inputs[n]
+            if h._arr is None:
+                raise RuntimeError(f"input '{n}' not set")
+            args.append(h._arr)
+        if self._with_buffers:
+            outs = self._exported.call(self._params, self._buffers, *args)
+        else:
+            outs = self._exported.call(self._params, *args)
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        for n, o in zip(self._output_names, outs):
+            self._outputs[n]._arr = o
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return None
+
+    def clone(self) -> "Predictor":
+        """Share weights, fresh handles (reference predictor.Clone for
+        multi-thread serving)."""
+        p = object.__new__(Predictor)
+        p.config = self.config
+        p._exported = self._exported
+        p._params = self._params
+        p._buffers = self._buffers
+        p._meta = self._meta
+        p._input_names = list(self._input_names)
+        p._output_names = list(self._output_names)
+        p._inputs = {n: TensorHandle(n) for n in p._input_names}
+        p._outputs = {n: TensorHandle(n) for n in p._output_names}
+        p._with_buffers = self._with_buffers
+        return p
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version() -> str:
+    import paddle_tpu
+    return getattr(paddle_tpu, "__version__", "0.0.0")
+
+
+__all__ = ["Config", "Predictor", "create_predictor", "TensorHandle",
+           "PrecisionType", "PlaceType", "get_version"]

@@ -234,9 +234,13 @@ def save(layer, path, input_spec=None, **configs):
     from ..framework.io import save as fsave
     state = {k: v for k, v in layer.state_dict().items()}
     fsave(state, path + ".pdiparams")
-    meta = {"class": type(layer).__name__}
+    meta = {"class": type(layer).__name__, "jit_saved": True}
     if input_spec is not None:
+        meta["n_inputs"] = len(input_spec)
         apply_fn, params, buffers = functionalize(layer)
+        # Predictor/TranslatedLayer must split the flat state_dict back into
+        # the (params, buffers) trees of the exported signature
+        meta["buffer_keys"] = sorted(buffers.keys())
         arr_spec = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype)
                     if hasattr(s, "shape") else s for s in input_spec]
         try:
@@ -258,10 +262,15 @@ def load(path, **configs):
     from ..framework.io import load as fload
     state = fload(path + ".pdiparams")
     exported = None
+    meta = {}
+    if os.path.exists(path + ".pdmeta"):
+        with open(path + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
     if os.path.exists(path + ".pdmodel"):
         from jax import export as jexport
         with open(path + ".pdmodel", "rb") as f:
             exported = jexport.deserialize(f.read())
+    buffer_keys = set(meta.get("buffer_keys", []))
 
     class TranslatedLayer:
         def __init__(self):
@@ -274,11 +283,14 @@ def load(path, **configs):
         def __call__(self, *inputs):
             if self.exported is None:
                 raise RuntimeError("no exported program; only state_dict available")
-            params = {k: (v.data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v)))
+            arrays = {k: (v.data if isinstance(v, Tensor)
+                          else jnp.asarray(np.asarray(v)))
                       for k, v in self.state.items()}
-            arrs = _tree_to_arrays(inputs)
             # exported signature: (params, buffers, *inputs)
-            out = self.exported.call(params, {}, *arrs)
+            params = {k: v for k, v in arrays.items() if k not in buffer_keys}
+            buffers = {k: v for k, v in arrays.items() if k in buffer_keys}
+            arrs = _tree_to_arrays(inputs)
+            out = self.exported.call(params, buffers, *arrs)
             return jax.tree_util.tree_map(Tensor, out)
 
     return TranslatedLayer()

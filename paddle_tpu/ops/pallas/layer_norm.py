@@ -54,8 +54,8 @@ def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5, block_rows: int = 128):
         mean_ref[...] = mean[:, 0]
         rstd_ref[...] = rstd[:, 0]
 
-    grid = (max(R // block_rows, 1),)
     br = min(block_rows, R)
+    grid = (pl.cdiv(R, br),)  # cover ALL rows; the edge block is masked
     return pl.pallas_call(
         kernel,
         grid=grid,

@@ -1,0 +1,121 @@
+"""Predictor API tests (reference: inference/tests/api golden tests +
+`test_inference_api.py`): save a model, load through Config/create_predictor,
+run via handles, match eager outputs."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.inference import Config, PrecisionType, create_predictor
+
+
+def _export_static_mlp(tmp_path):
+    """Build + save a static-graph MLP; returns (prefix, W, b)."""
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", shape=[None, 8], dtype="float32")
+            out = static.nn.fc(x, 4)
+        exe = static.Executor()
+        exe.run(startup)
+        scope = static.global_scope()
+        wname = [n for n in scope.vars if "_w_" in n][0]
+        bname = [n for n in scope.vars if "_b_" in n][0]
+        W = np.asarray(scope.vars[wname])
+        b = np.asarray(scope.vars[bname])
+        prefix = str(tmp_path / "model")
+        static.save_inference_model(prefix, [x], [out], exe, program=main)
+        return prefix, W, b
+    finally:
+        paddle.disable_static()
+
+
+class TestPredictorStaticArtifact:
+    def test_handles_roundtrip(self, tmp_path):
+        prefix, W, b = _export_static_mlp(tmp_path)
+        cfg = Config(prefix)
+        assert cfg.prog_file().endswith(".pdmodel")
+        pred = create_predictor(cfg)
+        assert pred.get_input_names() == ["x"]
+        xin = np.random.default_rng(1).normal(size=(3, 8)).astype(np.float32)
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(xin)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, xin @ W + b, rtol=1e-5, atol=1e-5)
+
+    def test_positional_run(self, tmp_path):
+        prefix, W, b = _export_static_mlp(tmp_path)
+        pred = create_predictor(Config(prefix))
+        xin = np.ones((2, 8), np.float32)
+        outs = pred.run([xin])
+        np.testing.assert_allclose(outs[0], xin @ W + b, rtol=1e-5, atol=1e-5)
+
+    def test_dynamic_batch(self, tmp_path):
+        """None batch dim exported shape-polymorphically: different batch
+        sizes run without re-export."""
+        prefix, W, b = _export_static_mlp(tmp_path)
+        pred = create_predictor(Config(prefix))
+        for bs in (1, 5, 9):
+            xin = np.full((bs, 8), 0.5, np.float32)
+            outs = pred.run([xin])
+            assert outs[0].shape == (bs, 4)
+
+    def test_clone_shares_weights(self, tmp_path):
+        prefix, W, b = _export_static_mlp(tmp_path)
+        pred = create_predictor(Config(prefix))
+        c = pred.clone()
+        assert c._params is pred._params
+        xin = np.ones((2, 8), np.float32)
+        np.testing.assert_allclose(c.run([xin])[0], pred.run([xin])[0])
+
+
+class TestPredictorJitArtifact:
+    def test_jit_saved_layer(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 2))
+        xin = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+        want = net(paddle.to_tensor(xin)).numpy()
+        prefix = str(tmp_path / "jitmodel")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.static.InputSpec([4, 6], "float32")])
+        pred = create_predictor(Config(prefix))
+        outs = pred.run([xin])
+        np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+
+
+    def test_jit_saved_layer_with_buffers(self, tmp_path):
+        """BatchNorm holds running-stat buffers: the export signature splits
+        params/buffers and the Predictor must reconstruct both trees."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(6, 8), nn.BatchNorm1D(8), nn.ReLU(),
+                            nn.Linear(8, 2))
+        net.eval()
+        xin = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+        want = net(paddle.to_tensor(xin)).numpy()
+        prefix = str(tmp_path / "bnmodel")
+        paddle.jit.save(net, prefix,
+                        input_spec=[paddle.static.InputSpec([4, 6], "float32")])
+        pred = create_predictor(Config(prefix))
+        np.testing.assert_allclose(pred.run([xin])[0], want,
+                                   rtol=1e-5, atol=1e-5)
+        # jit.load path splits the same way
+        tl = paddle.jit.load(prefix)
+        np.testing.assert_allclose(tl(paddle.to_tensor(xin)).numpy(), want,
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestConfig:
+    def test_device_toggles(self):
+        cfg = Config()
+        cfg.enable_use_gpu(100, 0, PrecisionType.Bfloat16)
+        assert cfg.use_gpu()
+        cfg.disable_gpu()
+        assert not cfg.use_gpu()
+        assert "Config" in cfg.summary()
+
+    def test_missing_model_raises(self):
+        with pytest.raises(ValueError):
+            create_predictor(Config())

@@ -1,0 +1,134 @@
+"""Sharding-aware distributed checkpointing.
+
+Reference: the reference saves sharded state per rank with dist attrs and
+re-shards on load (auto_parallel `dist_saver.py` + `converter.py`; stage-3
+sharding gathers on save, `sharding/group_sharded.py:201`). TPU translation
+follows the orbax/tensorstore pattern: save once from the addressable host
+(jax gathers), record each array's PartitionSpec, and on restore
+`jax.device_put` under the target sharding — mesh-shape changes re-shard
+transparently. `save(..., async_save=True)` snapshots to host immediately
+and writes in a background thread (the reference's async auto-checkpoint).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+_pending_saves: list = []
+
+
+def _spec_of(arr) -> Optional[tuple]:
+    shard = getattr(arr, "sharding", None)
+    spec = getattr(shard, "spec", None)
+    if spec is None:
+        return None
+    return tuple(None if p is None else (tuple(p) if isinstance(p, tuple)
+                                         else str(p)) for p in spec)
+
+
+def _to_host(obj, specs: Dict[str, tuple], prefix: str = ""):
+    if isinstance(obj, Tensor):
+        obj = obj.data
+    if isinstance(obj, jax.Array):
+        s = _spec_of(obj)
+        if s is not None:
+            specs[prefix] = s
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v, specs, f"{prefix}/{k}") for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_host(v, specs, f"{prefix}/{i}")
+                         for i, v in enumerate(obj))
+    return obj
+
+
+def save(state: Any, path: str, async_save: bool = False):
+    """Checkpoint a pytree of arrays/Tensors with sharding metadata."""
+    specs: Dict[str, tuple] = {}
+    host_state = _to_host(state, specs)  # synchronous device->host snapshot
+
+    def write():
+        tmp = path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(tmp, "wb") as f:
+            pickle.dump({"state": host_state, "specs": specs,
+                         "version": 1}, f, protocol=4)
+        os.replace(tmp, path)  # atomic publish — no torn checkpoints
+
+    if async_save:
+        t = threading.Thread(target=write, daemon=True)
+        t.start()
+        _pending_saves.append(t)
+    else:
+        write()
+
+
+def wait_all():
+    """Block until every async save has been published."""
+    while _pending_saves:
+        _pending_saves.pop().join()
+
+
+def _apply_shardings(obj, specs: Dict[str, tuple], mesh, prefix: str = ""):
+    if isinstance(obj, np.ndarray):
+        arr = jnp.asarray(obj)
+        spec = specs.get(prefix)
+        if spec is not None and mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            names = set(mesh.axis_names)
+            cleaned = []
+            for p in spec:
+                # drop axes that do not exist in the TARGET mesh — restoring
+                # onto a smaller/different mesh replicates those dims
+                if p is None:
+                    cleaned.append(None)
+                elif isinstance(p, tuple):
+                    kept = tuple(a for a in p if a in names)
+                    cleaned.append(kept if kept else None)
+                else:
+                    cleaned.append(p if p in names else None)
+            try:
+                arr = jax.device_put(arr, NamedSharding(mesh, P(*cleaned)))
+            except Exception:
+                pass  # incompatible spec (divisibility): keep replicated
+        return arr
+    if isinstance(obj, dict):
+        return {k: _apply_shardings(v, specs, mesh, f"{prefix}/{k}")
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_apply_shardings(v, specs, mesh, f"{prefix}/{i}")
+                        for i, v in enumerate(obj))
+    return obj
+
+
+def load(path: str, mesh=None) -> Any:
+    """Restore; with `mesh`, arrays are re-laid-out per their saved specs
+    (axes missing from the target mesh fall back to replication)."""
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    return _apply_shardings(blob["state"], blob.get("specs", {}), mesh)
+
+
+def latest(dirname: str, prefix: str = "ckpt") -> Optional[str]:
+    """Newest checkpoint file `<prefix>_<step>` in dirname, or None."""
+    if not os.path.isdir(dirname):
+        return None
+    best, best_step = None, -1
+    for fn in os.listdir(dirname):
+        if fn.startswith(prefix + "_") and not fn.endswith(".tmp"):
+            try:
+                step = int(fn.rsplit("_", 1)[1])
+            except ValueError:
+                continue
+            if step > best_step:
+                best, best_step = os.path.join(dirname, fn), step
+    return best

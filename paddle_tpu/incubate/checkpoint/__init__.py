@@ -1,0 +1,3 @@
+"""incubate.checkpoint (reference fluid/incubate/checkpoint)."""
+from . import auto_checkpoint  # noqa: F401
+from .auto_checkpoint import TrainEpochRange, train_epoch_range  # noqa: F401

@@ -1,0 +1,119 @@
+"""Auto-checkpoint: periodic snapshots keyed by job id, auto-resume.
+
+Reference: /root/reference/python/paddle/fluid/incubate/checkpoint/
+auto_checkpoint.py:71 — `train_epoch_range(n)` wraps the epoch loop; each
+epoch end snapshots model+optimizer (to HDFS in the reference) under the
+job id, and a restarted job resumes from the last finished epoch.
+
+TPU additions: preemption awareness — SIGTERM (the TPU-pod preemption
+signal) triggers an immediate snapshot before exit, so the elastic launcher
+restart resumes with at most one partial epoch lost.
+"""
+from __future__ import annotations
+
+import os
+import signal
+from typing import Iterator, Optional
+
+from ...distributed import checkpoint as dist_ckpt
+
+CKPT_DIR_ENV = "PADDLE_CHECKPOINT_DIR"
+JOB_ID_ENV = "PADDLE_JOB_ID"
+
+
+class TrainEpochRange:
+    """Iterate epochs with save-on-epoch-end and resume-on-restart.
+
+    usage:
+        r = TrainEpochRange(EPOCHS, save_checkpoint_inter=1)
+        r.attach(model=model, optimizer=opt)       # what to snapshot
+        for epoch in r:
+            train_one_epoch(...)
+    """
+
+    def __init__(self, max_epoch_num: int, name: Optional[str] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 save_checkpoint_inter: int = 1,
+                 preemption_save: bool = True):
+        self.max_epoch_num = max_epoch_num
+        self.name = name or os.environ.get(JOB_ID_ENV, "default")
+        self.dir = checkpoint_dir or os.environ.get(CKPT_DIR_ENV,
+                                                    "./auto_checkpoint")
+        self.save_inter = max(1, save_checkpoint_inter)
+        self._attached = {}
+        self._restored_epoch = -1
+        self._current_epoch = -1
+        self._prev_sigterm = None
+        self._preemption_save = preemption_save
+
+    # ------------------------------------------------------------------
+    def attach(self, **named_objects):
+        """Register objects with state_dict/set_state_dict to snapshot."""
+        self._attached.update(named_objects)
+        return self
+
+    @property
+    def job_dir(self) -> str:
+        return os.path.join(self.dir, self.name)
+
+    def _state(self):
+        return {k: v.state_dict() for k, v in self._attached.items()
+                if hasattr(v, "state_dict")}
+
+    def save(self, epoch: int):
+        path = os.path.join(self.job_dir, f"ckpt_{epoch}")
+        dist_ckpt.save({"epoch": epoch, "objects": self._state()}, path)
+
+    def restore(self) -> int:
+        """Load the newest snapshot; returns the last FINISHED epoch or -1."""
+        path = dist_ckpt.latest(self.job_dir)
+        if path is None:
+            return -1
+        blob = dist_ckpt.load(path)
+        objects = blob.get("objects", {})
+        for k, v in self._attached.items():
+            if k in objects and hasattr(v, "set_state_dict"):
+                v.set_state_dict(objects[k])
+        self._restored_epoch = int(blob.get("epoch", -1))
+        return self._restored_epoch
+
+    # ------------------------------------------------------------------
+    def _on_sigterm(self, signum, frame):
+        if self._current_epoch >= 0:
+            # preemption: persist progress as "epoch N-1 finished" so the
+            # restart re-runs only the interrupted epoch
+            self.save(self._current_epoch - 1)
+        if callable(self._prev_sigterm):
+            self._prev_sigterm(signum, frame)
+        else:
+            raise SystemExit(143)
+
+    def __iter__(self) -> Iterator[int]:
+        start = self.restore() + 1
+        if self._preemption_save:
+            try:
+                self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                                   self._on_sigterm)
+            except ValueError:
+                self._prev_sigterm = None  # not in main thread
+        try:
+            for epoch in range(start, self.max_epoch_num):
+                self._current_epoch = epoch
+                yield epoch
+                if (epoch + 1) % self.save_inter == 0 or \
+                        epoch == self.max_epoch_num - 1:
+                    self.save(epoch)
+        finally:
+            self._current_epoch = -1
+            if self._preemption_save and self._prev_sigterm is not None:
+                try:
+                    signal.signal(signal.SIGTERM, self._prev_sigterm)
+                except ValueError:
+                    pass
+
+
+def train_epoch_range(max_epoch_num: int, save_checkpoint_inter: int = 1,
+                      **kw) -> TrainEpochRange:
+    """reference `acp.train_epoch_range` entry point."""
+    return TrainEpochRange(max_epoch_num,
+                           save_checkpoint_inter=save_checkpoint_inter, **kw)

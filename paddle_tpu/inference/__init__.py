@@ -162,10 +162,13 @@ class Predictor:
         raw = io_mod.load(prefix + ".pdiparams", return_numpy=True)
         arrays = {n: jnp.asarray(self._unwrap(p)) for n, p in raw.items()}
         meta_path = prefix + ".pdmeta"
-        self._meta = {}
-        if os.path.exists(meta_path):
-            with open(meta_path, "rb") as f:
-                self._meta = pickle.load(f)
+        if not os.path.exists(meta_path):
+            raise RuntimeError(
+                f"missing {meta_path}: the .pdmeta sidecar (written by "
+                f"save_inference_model / jit.save) identifies the artifact's "
+                f"input signature — copy it alongside the .pdmodel")
+        with open(meta_path, "rb") as f:
+            self._meta = pickle.load(f)
         # artifact flavor: static save_inference_model exports fn(params,
         # *feeds) with feed names; jit.save exports fn(params, buffers,
         # *feeds) with positional inputs
@@ -198,9 +201,9 @@ class Predictor:
 
     def get_input_handle(self, name: str) -> TensorHandle:
         if name not in self._inputs:
-            # permissive like the reference: allow positional pseudo-names
-            self._inputs[name] = TensorHandle(name)
-            self._input_names.append(name)
+            raise KeyError(
+                f"unknown input {name!r}; model inputs are "
+                f"{self._input_names}")
         return self._inputs[name]
 
     def get_output_handle(self, name: str) -> TensorHandle:
@@ -210,6 +213,10 @@ class Predictor:
         """Execute. Either pass `inputs` positionally (returns outputs) or
         pre-fill input handles and read output handles (reference style)."""
         if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"run: got {len(inputs)} inputs, model expects "
+                    f"{len(self._input_names)} ({self._input_names})")
             for n, a in zip(self._input_names, inputs):
                 self._inputs[n].copy_from_cpu(np.asarray(a))
         args = []

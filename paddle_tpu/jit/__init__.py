@@ -254,6 +254,10 @@ def save(layer, path, input_spec=None, **configs):
         except Exception as e:
             meta["exported"] = False
             meta["export_error"] = str(e)
+            # never leave a stale export behind: a previous .pdmodel would be
+            # silently executed against the NEW params by load()/Predictor
+            if os.path.exists(path + ".pdmodel"):
+                os.remove(path + ".pdmodel")
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
 

@@ -1,0 +1,166 @@
+"""Elastic + checkpoint subsystem tests (reference: elastic manager tests
+`unittests/test_fleet_elastic_manager.py`, auto-checkpoint
+`test_auto_checkpoint.py`, dist-save `auto_parallel` converter tests)."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import checkpoint as dist_ckpt
+from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                  ElasticManager,
+                                                  ElasticStatus)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestDistCheckpoint:
+    def test_roundtrip_plain(self, tmp_path):
+        state = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                 "step": 7, "nested": {"b": np.ones(4, np.float32)}}
+        p = str(tmp_path / "c.ckpt")
+        dist_ckpt.save(state, p)
+        back = dist_ckpt.load(p)
+        np.testing.assert_array_equal(np.asarray(back["w"]), state["w"])
+        assert back["step"] == 7
+
+    def test_sharded_save_reshard_load(self, tmp_path):
+        devs = np.array(jax.devices()[:8]).reshape(8)
+        mesh1 = Mesh(devs, axis_names=("dp",))
+        x = jax.device_put(np.arange(64, dtype=np.float32).reshape(8, 8),
+                           NamedSharding(mesh1, P("dp", None)))
+        p = str(tmp_path / "s.ckpt")
+        dist_ckpt.save({"x": x}, p)
+        # restore onto a DIFFERENT mesh: 2x4, dp axis now size 2
+        mesh2 = Mesh(devs.reshape(2, 4), axis_names=("dp", "mp"))
+        back = dist_ckpt.load(p, mesh=mesh2)
+        arr = back["x"]
+        np.testing.assert_array_equal(np.asarray(arr),
+                                      np.arange(64).reshape(8, 8))
+        assert arr.sharding.spec == P("dp", None)
+
+    def test_reshard_missing_axis_replicates(self, tmp_path):
+        devs = np.array(jax.devices()[:8])
+        mesh1 = Mesh(devs.reshape(2, 4), axis_names=("dp", "mp"))
+        x = jax.device_put(np.ones((4, 8), np.float32),
+                           NamedSharding(mesh1, P(None, "mp")))
+        p = str(tmp_path / "m.ckpt")
+        dist_ckpt.save({"x": x}, p)
+        mesh2 = Mesh(devs, axis_names=("dp",))  # no "mp" axis anymore
+        back = dist_ckpt.load(p, mesh=mesh2)
+        assert back["x"].sharding.spec == P(None, None)
+
+    def test_async_save(self, tmp_path):
+        p = str(tmp_path / "a.ckpt")
+        dist_ckpt.save({"w": np.ones(3, np.float32)}, p, async_save=True)
+        dist_ckpt.wait_all()
+        assert os.path.exists(p)
+        np.testing.assert_array_equal(np.asarray(dist_ckpt.load(p)["w"]),
+                                      np.ones(3))
+
+    def test_latest(self, tmp_path):
+        for step in (3, 11, 7):
+            dist_ckpt.save({"s": step}, str(tmp_path / f"ckpt_{step}"))
+        assert dist_ckpt.latest(str(tmp_path)).endswith("ckpt_11")
+        assert dist_ckpt.latest(str(tmp_path / "nope")) is None
+
+
+class TestAutoCheckpoint:
+    def _train(self, ckpt_dir, epochs, crash_at=None):
+        """One 'job run': returns epochs actually executed."""
+        paddle.seed(0)
+        model = nn.Linear(4, 2)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        r = TrainEpochRange(epochs, name="job1", checkpoint_dir=ckpt_dir,
+                            preemption_save=False)
+        r.attach(model=model, optimizer=opt)
+        ran = []
+        for epoch in r:
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            loss = model(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ran.append(epoch)
+            if crash_at is not None and epoch == crash_at:
+                raise KeyboardInterrupt  # simulated kill MID-epoch
+        return ran, model
+
+    def test_resume_after_crash(self, tmp_path):
+        d = str(tmp_path)
+        with pytest.raises(KeyboardInterrupt):
+            self._train(d, epochs=6, crash_at=2)
+        # epochs 0 and 1 were saved; the interrupted epoch 2 re-runs
+        ran2, model2 = self._train(d, epochs=6)
+        assert ran2 == [2, 3, 4, 5]
+
+    def test_fresh_run_covers_all_epochs(self, tmp_path):
+        ran, _ = self._train(str(tmp_path), epochs=3)
+        assert ran == [0, 1, 2]
+
+
+class TestElasticManager:
+    def test_membership_and_heartbeats(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        peer_store = TCPStore("127.0.0.1", master.port)
+        m1 = ElasticManager(host_id="n1", ttl=1.0, np=2, store=master)
+        m2 = ElasticManager(host_id="n2", ttl=1.0, np=2, store=peer_store)
+        m1.join()
+        m2.join()
+        time.sleep(0.1)
+        assert m1.alive_members() == ["n1", "n2"]
+        # start watching while n2 is still alive, then let it die
+        import threading
+        result = {}
+
+        def watch():
+            result["status"] = m1.watch(timeout=5.0)
+
+        t = threading.Thread(target=watch)
+        t.start()
+        time.sleep(0.3)
+        m2.exit()  # stops beating + deletes its beat key
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert result["status"] in (ElasticStatus.HOLD, ElasticStatus.RESTART)
+        assert "n2" not in m1.alive_members()
+        m1.exit()
+        master.stop()
+
+    def test_stable_membership_completes(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        m1 = ElasticManager(host_id="solo", ttl=1.0, np=1, store=master)
+        m1.join()
+        assert m1.watch(timeout=1.0) == ElasticStatus.COMPLETED
+        m1.exit()
+        master.stop()
+
+
+class TestElasticLaunchRestart:
+    def test_exit_code_101_triggers_restart(self, tmp_path):
+        """A worker exiting with ELASTIC_EXIT_CODE is redeployed by launch."""
+        script = tmp_path / "flaky.py"
+        marker = tmp_path / "ran_once"
+        script.write_text(
+            "import os, sys\n"
+            f"m = {str(repr(str(marker)))}\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').write('x')\n"
+            f"    sys.exit({ELASTIC_EXIT_CODE})\n"
+            "print('recovered OK')\n")
+        from paddle_tpu.distributed.launch.main import launch
+        rc = launch(["--log_dir", str(tmp_path / "log"),
+                     "--max_restart", "2", str(script)])
+        assert rc == 0
+        assert marker.exists()

@@ -57,6 +57,15 @@ from . import signal  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import text  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from . import reader  # noqa: E402,F401
+from . import hub  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from . import cost_model  # noqa: E402,F401
 
 # paddle-API conveniences
 from .ops.creation import to_tensor  # noqa: E402,F401

@@ -79,6 +79,8 @@ class StoreServer {
     while (running_) {
       int cfd = ::accept(listen_fd_, nullptr, nullptr);
       if (cfd < 0) break;
+      int one = 1;  // KV round-trips are latency-bound: defeat Nagle
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> g(conn_mu_);
       conn_fds_.push_back(cfd);
       conn_threads_.emplace_back([this, cfd] { serve(cfd); });

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..framework.tensor import Tensor
 
 _pending_saves: list = []
+_save_errors: list = []
 
 
 def _spec_of(arr) -> Optional[tuple]:
@@ -57,15 +58,31 @@ def save(state: Any, path: str, async_save: bool = False):
     host_state = _to_host(state, specs)  # synchronous device->host snapshot
 
     def write():
-        tmp = path + ".tmp"
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(tmp, "wb") as f:
-            pickle.dump({"state": host_state, "specs": specs,
-                         "version": 1}, f, protocol=4)
-        os.replace(tmp, path)  # atomic publish — no torn checkpoints
+        import tempfile
+        target_dir = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(target_dir, exist_ok=True)
+        # unique tmp per writer: concurrent saves to the same path must not
+        # share a tmp file (interleaved writes would corrupt the publish)
+        fd, tmp = tempfile.mkstemp(dir=target_dir,
+                                   prefix=os.path.basename(path) + ".tmp.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump({"state": host_state, "specs": specs,
+                             "version": 1}, f, protocol=4)
+            os.replace(tmp, path)  # atomic publish — no torn checkpoints
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+
+    def write_logged():
+        try:
+            write()
+        except BaseException as e:  # surfaced by wait_all
+            _save_errors.append(e)
 
     if async_save:
-        t = threading.Thread(target=write, daemon=True)
+        t = threading.Thread(target=write_logged, daemon=True)
         t.start()
         _pending_saves.append(t)
     else:
@@ -73,9 +90,14 @@ def save(state: Any, path: str, async_save: bool = False):
 
 
 def wait_all():
-    """Block until every async save has been published."""
+    """Block until every async save has been published; re-raises the first
+    background failure (a silently lost checkpoint is worse than a crash)."""
     while _pending_saves:
         _pending_saves.pop().join()
+    if _save_errors:
+        err = _save_errors[0]
+        _save_errors.clear()
+        raise err
 
 
 def _apply_shardings(obj, specs: Dict[str, tuple], mesh, prefix: str = ""):

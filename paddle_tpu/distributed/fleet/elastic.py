@@ -35,12 +35,14 @@ class ElasticStatus:
 class ElasticManager:
     def __init__(self, host_id: Optional[str] = None,
                  master: Optional[str] = None,
-                 ttl: float = float(os.environ.get("PADDLE_ELASTIC_TTL", 10)),
+                 ttl: Optional[float] = None,
                  np: Optional[int] = None,
                  is_master: bool = False, store=None):
         from ..store import TCPStore
         self.host_id = host_id or os.environ.get(
             "PADDLE_CURRENT_ENDPOINT", f"host-{os.getpid()}")
+        if ttl is None:  # resolve env at construction, not import
+            ttl = float(os.environ.get("PADDLE_ELASTIC_TTL", 10))
         self.ttl = ttl
         self.np = np or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         if store is not None:
@@ -57,7 +59,6 @@ class ElasticManager:
 
     # -- registration / heartbeats (reference: etcd TTL lease) -------------
     def register(self):
-        self._store.set(f"member/{self.host_id}", self.host_id)
         self._beat()
         self._beat_thread = threading.Thread(target=self._beat_loop,
                                              daemon=True)
@@ -91,25 +92,24 @@ class ElasticManager:
         return sorted(members)
 
     def _member_ids(self) -> List[str]:
-        if not self._store.check("members_index"):
+        # membership = per-slot keys claimed via the store's ATOMIC counter
+        # (a shared CSV value would lose concurrent joins to read-modify-
+        # write races)
+        if not self._store.check("member_count"):
             return []
-        ids = self._store.get("members_index")
-        return [s for s in ids.decode().split(",") if s] if ids else []
-
-    def announce(self):
-        """Master-side: maintain the membership index key."""
-        known = set(self._member_ids())
-        if self.host_id not in known:
-            known.add(self.host_id)
-            self._store.set("members_index", ",".join(sorted(known)))
+        import struct
+        n = struct.unpack("<q", self._store.get("member_count"))[0]
+        ids = []
+        for i in range(int(n)):
+            key = f"member/{i}"
+            if self._store.check(key):
+                ids.append(self._store.get(key).decode())
+        return ids
 
     def join(self):
-        """Add self to the shared membership index (any rank)."""
-        # read-modify-write via counter-guarded retry: the native store has
-        # atomic add but not CAS; a duplicate write of the same union is fine
-        known = set(self._member_ids())
-        known.add(self.host_id)
-        self._store.set("members_index", ",".join(sorted(known)))
+        """Claim a membership slot atomically (any rank)."""
+        slot = self._store.add("member_count", 1) - 1
+        self._store.set(f"member/{slot}", self.host_id)
         self.register()
 
     # -- watching (reference manager.watch:126) ----------------------------
@@ -121,7 +121,7 @@ class ElasticManager:
         while True:
             time.sleep(min(self.ttl / 3, 1.0))
             cur = self.alive_members()
-            if len(cur) != len(baseline) or cur != baseline:
+            if cur != baseline:
                 if len(cur) < want:
                     return ElasticStatus.HOLD if self.elastic_level < 2 \
                         else ElasticStatus.RESTART

@@ -1,0 +1,54 @@
+"""paddle.hub parity (reference `python/paddle/hub.py`): load models from a
+hubconf.py. Zero-egress environment: only `source="local"` works; github
+sources raise with guidance."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.pop("hubconf", None)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local",):
+        raise RuntimeError(
+            f"source={source!r} needs network access; this environment has "
+            f"no egress — clone the repo and use source='local'")
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False
+         ) -> List[str]:
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> str:
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"{model!r} not in {repo_dir}/{HUBCONF}; "
+                         f"available: {list(repo_dir)}")
+    return getattr(mod, model)(**kwargs)

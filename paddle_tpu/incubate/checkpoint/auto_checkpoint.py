@@ -81,8 +81,12 @@ class TrainEpochRange:
     def _on_sigterm(self, signum, frame):
         if self._current_epoch >= 0:
             # preemption: persist progress as "epoch N-1 finished" so the
-            # restart re-runs only the interrupted epoch
-            self.save(self._current_epoch - 1)
+            # restart re-runs only the interrupted epoch — but never clobber
+            # an existing CLEAN end-of-epoch snapshot with mid-epoch state
+            target = os.path.join(self.job_dir,
+                                  f"ckpt_{self._current_epoch - 1}")
+            if not os.path.exists(target):
+                self.save(self._current_epoch - 1)
         if callable(self._prev_sigterm):
             self._prev_sigterm(signum, frame)
         else:

@@ -234,6 +234,10 @@ def save(layer, path, input_spec=None, **configs):
     from ..framework.io import save as fsave
     state = {k: v for k, v in layer.state_dict().items()}
     fsave(state, path + ".pdiparams")
+    # a previous export must never outlive the params it was traced with —
+    # it is re-created below only when input_spec is given and export works
+    if os.path.exists(path + ".pdmodel"):
+        os.remove(path + ".pdmodel")
     meta = {"class": type(layer).__name__, "jit_saved": True}
     if input_spec is not None:
         meta["n_inputs"] = len(input_spec)

@@ -1,0 +1,6 @@
+"""paddle.onnx parity (reference `python/paddle/onnx/export.py`, which
+shells out to paddle2onnx). ONNX tooling is not in this environment; the
+portable interchange format here is the StableHLO export (`jit.save` /
+`static.save_inference_model`), which `export` produces alongside a clear
+error about true .onnx output."""
+from .export import export  # noqa: F401

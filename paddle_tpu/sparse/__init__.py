@@ -1,0 +1,187 @@
+"""paddle.sparse parity — COO/CSR sparse tensors over jax.experimental.sparse.
+
+Reference: SparseCooTensor/SparseCsrTensor (phi/core/sparse_coo_tensor.h,
+sparse_csr_tensor.h) + `paddle.sparse` ops (`phi/kernels/sparse/`). TPU
+translation: BCOO is the XLA-lowered format (gather/scatter + segment-sum
+compute, which is how TPUs do sparse); CSR round-trips through BCOO.
+Autograd integrates with the eager tape through the dense boundary ops
+(`to_dense`), and `sparse.matmul` has a custom tape rule w.r.t. the dense
+operand — the common "sparse adjacency x dense features" GNN pattern.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework import tape as tape_mod
+from ..framework.tensor import Tensor
+from ..ops import _dispatch
+
+
+class SparseCooTensor:
+    """Thin wrapper over BCOO keeping paddle's (indices [ndim, nnz],
+    values [nnz]) surface."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._b = bcoo
+
+    # -- paddle surface ----------------------------------------------------
+    def indices(self) -> Tensor:
+        return Tensor(self._b.indices.T)  # [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._b.data)
+
+    @property
+    def shape(self):
+        return list(self._b.shape)
+
+    @property
+    def dtype(self):
+        return self._b.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._b.nse)
+
+    def to_dense(self) -> Tensor:
+        return _dispatch.call(_coo_to_dense_impl, [Tensor(self._b.data)],
+                              {"indices": np.asarray(self._b.indices),
+                               "shape": tuple(self._b.shape)})
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._b.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._b = bcsr
+
+    def crows(self) -> Tensor:
+        return Tensor(self._b.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._b.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._b.data)
+
+    @property
+    def shape(self):
+        return list(self._b.shape)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._b.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._b.todense())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz})")
+
+
+@_dispatch.kernel("sparse_coo_to_dense")
+def _coo_to_dense_impl(values, *, indices, shape):
+    out = jnp.zeros(shape, values.dtype)
+    return out.at[tuple(indices[:, i] for i in range(indices.shape[1]))].add(
+        values)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """indices [ndim, nnz] + values [nnz] -> COO (reference
+    paddle.sparse.sparse_coo_tensor)."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
+                     else indices)
+    vals = jnp.asarray(values.data if isinstance(values, Tensor)
+                       else np.asarray(values))
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    b = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(b)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None
+                      ) -> SparseCsrTensor:
+    vals = jnp.asarray(values.data if isinstance(values, Tensor)
+                       else np.asarray(values))
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    b = jsparse.BCSR(
+        (vals,
+         jnp.asarray(np.asarray(cols.numpy() if isinstance(cols, Tensor)
+                                else cols)),
+         jnp.asarray(np.asarray(crows.numpy() if isinstance(crows, Tensor)
+                                else crows))),
+        shape=tuple(shape))
+    return SparseCsrTensor(b)
+
+
+# ------------------------------- ops ---------------------------------------
+
+def _as_coo(x) -> SparseCooTensor:
+    if isinstance(x, SparseCooTensor):
+        return x
+    raise TypeError(f"expected SparseCooTensor, got {type(x)}")
+
+
+def add(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
+    b = (_as_coo(x)._b + _as_coo(y)._b).sum_duplicates()
+    return SparseCooTensor(b)
+
+
+def relu(x: SparseCooTensor) -> SparseCooTensor:
+    b = x._b
+    return SparseCooTensor(
+        jsparse.BCOO((jax.nn.relu(b.data), b.indices), shape=b.shape))
+
+
+def multiply(x: SparseCooTensor, scalar) -> SparseCooTensor:
+    b = x._b
+    return SparseCooTensor(
+        jsparse.BCOO((b.data * scalar, b.indices), shape=b.shape))
+
+
+def matmul(x: SparseCooTensor, y) -> Tensor:
+    """sparse [M,K] @ dense [K,N] -> dense, differentiable w.r.t. y
+    (the GNN aggregation pattern; reference sparse matmul kernels)."""
+    xs = _as_coo(x)
+    rows = xs._b.indices[:, 0]
+    cols = xs._b.indices[:, 1]
+    vals = xs._b.data
+    y_t = y if isinstance(y, Tensor) else Tensor(y)
+
+    def impl(values, dense, *, rows, cols, m):
+        gathered = dense[cols] * values[:, None]
+        return jax.ops.segment_sum(gathered, rows, num_segments=m)
+
+    return _dispatch.call(impl, [Tensor(vals), y_t],
+                          {"rows": np.asarray(rows), "cols": np.asarray(cols),
+                           "m": xs.shape[0]}, name="sparse_matmul")
+
+
+def to_sparse_coo(dense, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+    arr = dense.data if isinstance(dense, Tensor) else jnp.asarray(dense)
+    return SparseCooTensor(jsparse.BCOO.fromdense(arr))
+
+
+def to_sparse_csr(dense) -> SparseCsrTensor:
+    arr = dense.data if isinstance(dense, Tensor) else jnp.asarray(dense)
+    return SparseCsrTensor(jsparse.BCSR.fromdense(arr))
+
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "add", "relu", "multiply", "matmul",
+           "to_sparse_coo", "to_sparse_csr"]

@@ -1,0 +1,18 @@
+"""paddle.sysconfig parity (reference `python/paddle/sysconfig.py`):
+include/lib dirs — here they point at the native component sources/builds
+(`paddle_tpu/_native`), which is what a custom-op author links against."""
+from __future__ import annotations
+
+import os
+
+
+def _pkg_dir() -> str:
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    return os.path.join(_pkg_dir(), "_native", "csrc")
+
+
+def get_lib() -> str:
+    return os.path.join(_pkg_dir(), "_native", "build")

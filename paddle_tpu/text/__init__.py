@@ -1,0 +1,273 @@
+"""paddle.text parity: text datasets + ViterbiDecoder.
+
+Reference: `python/paddle/text/datasets/` (Imdb, Imikolov, Movielens,
+UCIHousing, WMT14, Conll05) and `paddle.text.ViterbiDecoder`
+(`text/viterbi_decode.py`). Zero-egress environment: `download=True` raises;
+datasets read the reference's local file formats and ship a deterministic
+synthetic mode (`data_file=None`) with the right shapes for tests/smoke
+runs, mirroring vision.datasets.FakeData.
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..io.dataset import Dataset
+from ..nn.layer import Layer
+from ..ops import _dispatch
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "Conll05st",
+           "ViterbiDecoder", "viterbi_decode"]
+
+
+def _no_download(download):
+    if download:
+        raise RuntimeError(
+            "this environment has no network egress; pass a local data_file "
+            "(or data_file=None for deterministic synthetic data)")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference text/datasets/imdb.py). Local aclImdb
+    tarball, or synthetic reviews when data_file is None."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, download: bool = False):
+        _no_download(download)
+        self.mode = mode
+        if data_file is not None:
+            self._load_tar(data_file, mode, cutoff)
+        else:
+            self._synthesize(mode)
+
+    def _synthesize(self, mode, n=256, vocab=2000, seq=64):
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        self.docs = [rng.integers(2, vocab, rng.integers(8, seq)).tolist()
+                     for _ in range(n)]
+        self.labels = [int(i % 2) for i in range(n)]
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+
+    def _load_tar(self, path, mode, cutoff):
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs, labels, freq = [], [], {}
+        with tarfile.open(path) as tf:
+            members = [m for m in tf.getmembers() if pat.match(m.name)]
+            texts = []
+            for m in members:
+                data = tf.extractfile(m).read().decode("latin-1").lower()
+                toks = re.findall(r"[a-z]+", data)
+                texts.append((toks, 1 if "/pos/" in m.name else 0))
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+        kept = sorted((w for w, c in freq.items() if c >= cutoff),
+                      key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i + 2 for i, w in enumerate(kept)}
+        for toks, lab in texts:
+            docs.append([self.word_idx.get(t, 1) for t in toks])
+            labels.append(lab)
+        self.docs, self.labels = docs, labels
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return (np.asarray(self.docs[idx], np.int64),
+                np.asarray(self.labels[idx], np.int64))
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram dataset (reference text/datasets/imikolov.py)."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 50, download: bool = False):
+        _no_download(download)
+        self.window = window_size
+        if data_file is not None:
+            with open(data_file) as f:
+                lines = f.read().splitlines()
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            words = [f"w{i}" for i in range(200)]
+            lines = [" ".join(rng.choice(words, 20)) for _ in range(200)]
+        freq = {}
+        for ln in lines:
+            for w in ln.split():
+                freq[w] = freq.get(w, 0) + 1
+        vocab = sorted(w for w, c in freq.items()
+                       if c >= (min_word_freq if data_file else 1))
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(self.word_idx)
+        self.word_idx["<unk>"] = unk
+        self.data: List[Tuple] = []
+        for ln in lines:
+            ids = [self.word_idx.get(w, unk) for w in ln.split()]
+            for i in range(len(ids) - window_size + 1):
+                self.data.append(tuple(ids[i:i + window_size]))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return np.asarray(self.data[idx], np.int64)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference text/datasets/uci_housing.py)."""
+
+    FEATURES = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 download: bool = False):
+        _no_download(download)
+        if data_file is not None:
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(506, self.FEATURES)).astype(np.float32)
+            w = rng.normal(size=(self.FEATURES,)).astype(np.float32)
+            y = (x @ w + rng.normal(scale=0.1, size=506)).astype(np.float32)
+            raw = np.concatenate([x, y[:, None]], axis=1)
+        # reference normalization: feature-wise max-min scaling on train
+        feats = raw[:, :-1]
+        feats = (feats - feats.mean(0)) / (feats.max(0) - feats.min(0) + 1e-8)
+        raw = np.concatenate([feats, raw[:, -1:]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+
+
+class Movielens(Dataset):
+    """MovieLens ratings (reference text/datasets/movielens.py): synthetic
+    (user, movie, rating) triples unless a local ml-1m ratings.dat given."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 download: bool = False):
+        _no_download(download)
+        if data_file is not None:
+            rows = []
+            with open(data_file, encoding="latin-1") as f:
+                for ln in f:
+                    u, m, r, _ = ln.strip().split("::")
+                    rows.append((int(u), int(m), float(r)))
+        else:
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            rows = [(int(rng.integers(1, 500)), int(rng.integers(1, 1000)),
+                     float(rng.integers(1, 6))) for _ in range(2048)]
+        self.rows = rows
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, idx):
+        u, m, r = self.rows[idx]
+        return (np.asarray(u, np.int64), np.asarray(m, np.int64),
+                np.asarray(r, np.float32))
+
+
+class Conll05st(Dataset):
+    """SRL sequence-labeling dataset shape (reference conll05.py):
+    (tokens, predicate, labels) int sequences; synthetic by default."""
+
+    NUM_LABELS = 67
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 download: bool = False):
+        _no_download(download)
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        self.samples = []
+        for _ in range(128):
+            L = int(rng.integers(5, 30))
+            toks = rng.integers(0, 5000, L).astype(np.int64)
+            pred = np.full(L, int(rng.integers(0, L)), np.int64)
+            labels = rng.integers(0, self.NUM_LABELS, L).astype(np.int64)
+            self.samples.append((toks, pred, labels))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+
+# ---------------------------------------------------------------------------
+# Viterbi decoding (reference `paddle.text.ViterbiDecoder`,
+# phi/kernels/cpu/viterbi_decode_kernel.cc)
+# ---------------------------------------------------------------------------
+
+@_dispatch.kernel("viterbi_decode")
+def _viterbi_impl(potentials, trans, lengths, *, include_bos_eos_tag):
+    B, L, N = potentials.shape
+
+    if include_bos_eos_tag:
+        # tag N-2 = BOS, N-1 = EOS (reference convention)
+        start = trans[N - 2][None, :]  # [1,N]
+    else:
+        start = jnp.zeros((1, N), trans.dtype)
+
+    def step(carry, emit_t):
+        score, hist = carry
+        # score: [B,N]; trans: [N,N]; emit_t: [B,N]
+        total = score[:, :, None] + trans[None, :, :]  # [B,from,to]
+        best = jnp.max(total, axis=1) + emit_t
+        idx = jnp.argmax(total, axis=1)
+        return (best, idx), idx
+
+    init = potentials[:, 0, :] + start
+    emits = jnp.swapaxes(potentials[:, 1:, :], 0, 1)  # [L-1,B,N]
+    (final, _), history = jax.lax.scan(step, (init, jnp.zeros((B, N), jnp.int32)), emits)
+    if include_bos_eos_tag:
+        final = final + trans[:, N - 1][None, :]
+
+    # backtrace
+    last_tag = jnp.argmax(final, axis=-1)  # [B]
+    scores = jnp.max(final, axis=-1)
+
+    def back(carry, hist_t):
+        tag = carry
+        prev = jnp.take_along_axis(hist_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan: ys[i] = tag at time i+1; the final carry is tag at t=0
+    first_tag, path_tail = jax.lax.scan(back, last_tag, history, reverse=True)
+    path = jnp.concatenate([first_tag[:, None],
+                            jnp.swapaxes(path_tail, 0, 1)], axis=1)  # [B,L]
+    return scores, path.astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """potentials [B,L,N], transition [N,N] -> (scores [B], path [B,L])."""
+    if lengths is None:
+        B, L = np.asarray(potentials.shape[:2])
+        lengths = Tensor(jnp.full((int(B),), int(L), jnp.int64))
+    return _dispatch.call(
+        _viterbi_impl, [potentials, transition_params, lengths],
+        {"include_bos_eos_tag": include_bos_eos_tag}, nondiff=True)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(np.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,25 @@
+"""paddle.utils parity."""
+from . import cpp_extension  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+
+
+def try_import(module_name: str):
+    """reference utils/lazy_import.py."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"{module_name} is required but not installed") from e
+
+
+def run_check():
+    """reference `paddle.utils.run_check`: verify the install can compute."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! device={dev.platform}:"
+          f"{dev.id}, matmul checksum={float(y.sum()):.0f}")
+    return True

@@ -1,0 +1,141 @@
+"""Custom C++ op loading (reference `python/paddle/utils/cpp_extension/` +
+`paddle/fluid/framework/custom_operator.cc`).
+
+The reference JIT-builds a user's C++/CUDA op into a shared library and
+registers it as a framework operator. TPU translation: the user's C++ runs
+HOST-side (XLA owns the device), so a custom op is a compiled C function
+invoked through `jax.pure_callback` — usable under jit, differentiable if
+the author also provides a backward function. The C ABI is flat buffers:
+
+    extern "C" void my_op(const float* x, float* y, long long n);
+
+`load(name, sources)` compiles with g++ (same toolchain policy as
+`paddle_tpu._native`) and returns a module-like handle; `custom_op(...)`
+wraps a symbol into a Tensor-in/Tensor-out op with eager-tape autograd.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import tape as tape_mod
+from ..framework.tensor import Tensor
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+
+
+class CppExtension:
+    """Build spec (reference setup-style CppExtension)."""
+
+    def __init__(self, sources: Sequence[str], extra_compile_args=None,
+                 include_dirs=None):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.include_dirs = list(include_dirs or [])
+
+
+CUDAExtension = CppExtension  # no CUDA on this target; alias for portability
+
+
+class _LoadedExtension:
+    def __init__(self, name: str, lib: ctypes.CDLL, lib_path: str):
+        self.name = name
+        self.lib = lib
+        self.lib_path = lib_path
+
+    def __getattr__(self, sym):
+        return getattr(self.lib, sym)
+
+    def custom_op(self, symbol: str, backward_symbol: Optional[str] = None):
+        """Wrap `extern "C" void f(const float*, float*, long long)` as a
+        unary float op (same-shape output). Backward, if given, has the
+        same signature taking the output-cotangent and writing the input-
+        cotangent."""
+        fwd = getattr(self.lib, symbol)
+        fwd.restype = None
+        fwd.argtypes = [_F32P, _F32P, ctypes.c_longlong]
+        bwd = None
+        if backward_symbol is not None:
+            bwd = getattr(self.lib, backward_symbol)
+            bwd.restype = None
+            bwd.argtypes = [_F32P, _F32P, _F32P, ctypes.c_longlong]
+
+        def host_call(x: np.ndarray) -> np.ndarray:
+            x = np.ascontiguousarray(x, np.float32)
+            out = np.empty_like(x)
+            fwd(x.ctypes.data_as(_F32P), out.ctypes.data_as(_F32P), x.size)
+            return out
+
+        def op(t):
+            t = t if isinstance(t, Tensor) else Tensor(t)
+            arr = t.data
+
+            def cb(a):
+                return jax.pure_callback(
+                    host_call, jax.ShapeDtypeStruct(a.shape, jnp.float32),
+                    a, vmap_method="sequential")
+
+            out_arr = cb(arr.astype(jnp.float32))
+            out = Tensor(out_arr, stop_gradient=t.stop_gradient or bwd is None)
+            if bwd is not None and not t.stop_gradient \
+                    and tape_mod.grad_enabled():
+                x_host = np.asarray(arr, np.float32)
+
+                def vjp_fn(cotangents):
+                    g = np.ascontiguousarray(np.asarray(cotangents[0]),
+                                             np.float32)
+                    dx = np.empty_like(g)
+                    bwd(x_host.ctypes.data_as(_F32P),
+                        g.ctypes.data_as(_F32P),
+                        dx.ctypes.data_as(_F32P), g.size)
+                    return (jnp.asarray(dx),)
+
+                tape_mod.record(vjp_fn, [t], [out], name=f"custom_{symbol}")
+            return out
+
+        return op
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_cflags=None,
+         build_directory: Optional[str] = None, verbose: bool = False,
+         **kw) -> _LoadedExtension:
+    """JIT-compile `sources` into <build_directory>/<name>.so and load it
+    (reference cpp_extension.load)."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    tag = hashlib.sha1("".join(
+        open(s).read() for s in sources).encode()).hexdigest()[:12]
+    out = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(out):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", out]
+        cmd += list(extra_cxx_cflags or [])
+        cmd += [str(s) for s in sources]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return _LoadedExtension(name, ctypes.CDLL(out), out)
+
+
+def setup(name: str, ext_modules: List[CppExtension], **kw):
+    """setup()-style entry: builds immediately, returns loaded extensions
+    (the reference defers to setuptools; TPU custom ops are host callbacks,
+    so an eager build is the whole story)."""
+    exts = []
+    for i, ext in enumerate(ext_modules):
+        exts.append(load(f"{name}_{i}" if i else name, ext.sources,
+                         extra_cxx_cflags=ext.extra_compile_args))
+    return exts[0] if len(exts) == 1 else exts
+
+
+def get_build_directory() -> str:
+    return os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")

@@ -1,0 +1,25 @@
+"""`paddle.utils.deprecated` decorator (reference utils/deprecated.py)."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 1):
+    def deco(fn):
+        msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use '{update_to}' instead"
+        if reason:
+            msg += f" ({reason})"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level > 0:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco

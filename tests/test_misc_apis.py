@@ -1,0 +1,187 @@
+"""Completeness-sweep API tests: sparse, text, reader decorators, hub,
+cpp_extension, cost model, regularizer, onnx export (SURVEY §2.7 rows)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader as rd
+from paddle_tpu import sparse
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        indices = [[0, 1, 2], [1, 2, 0]]
+        values = [1.0, 2.0, 3.0]
+        s = sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+        assert s.nnz == 3
+        dense = s.to_dense().numpy()
+        want = np.zeros((3, 3), np.float32)
+        want[0, 1], want[1, 2], want[2, 0] = 1, 2, 3
+        np.testing.assert_array_equal(dense, want)
+
+    def test_csr(self):
+        s = sparse.sparse_csr_tensor([0, 1, 3], [2, 0, 1], [5.0, 6.0, 7.0],
+                                     shape=[2, 3])
+        d = s.to_dense().numpy()
+        want = np.array([[0, 0, 5], [6, 7, 0]], np.float32)
+        np.testing.assert_array_equal(d, want)
+
+    def test_ops(self):
+        d = np.array([[1.0, -2], [0, 3]], np.float32)
+        s = sparse.to_sparse_coo(paddle.to_tensor(d))
+        r = sparse.relu(s).to_dense().numpy()
+        np.testing.assert_array_equal(r, np.maximum(d, 0))
+        two = sparse.add(s, s).to_dense().numpy()
+        np.testing.assert_array_equal(two, 2 * d)
+
+    def test_spmm_grad(self):
+        adj = np.array([[0, 1.0], [1.0, 0]], np.float32)
+        s = sparse.to_sparse_coo(paddle.to_tensor(adj))
+        x = paddle.to_tensor(np.array([[1.0, 2], [3, 4]], np.float32),
+                             stop_gradient=False)
+        out = sparse.matmul(s, x)
+        np.testing.assert_allclose(out.numpy(), adj @ np.asarray(x.data))
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), adj.T @ np.ones((2, 2)))
+
+
+class TestTextDatasets:
+    def test_imdb_synthetic(self):
+        ds = paddle.text.Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        assert len(ds) > 0
+
+    def test_uci_housing(self):
+        tr = paddle.text.UCIHousing(mode="train")
+        te = paddle.text.UCIHousing(mode="test")
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert len(tr) > len(te)
+
+    def test_imikolov_windows(self):
+        ds = paddle.text.Imikolov(window_size=5)
+        assert ds[0].shape == (5,)
+
+    def test_viterbi_decoder(self):
+        """Viterbi beats greedy decoding on a chain with transitions."""
+        rng = np.random.default_rng(0)
+        B, L, N = 2, 6, 4
+        pot = rng.normal(size=(B, L, N)).astype(np.float32)
+        trans = rng.normal(size=(N, N)).astype(np.float32)
+        dec = paddle.text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+        scores, path = dec(paddle.to_tensor(pot))
+        assert tuple(path.shape) == (B, L)
+        # brute force check on batch 0
+        import itertools
+        best, best_path = -1e30, None
+        for seq in itertools.product(range(N), repeat=L):
+            sc = pot[0, 0, seq[0]] + sum(
+                trans[seq[i - 1], seq[i]] + pot[0, i, seq[i]]
+                for i in range(1, L))
+            if sc > best:
+                best, best_path = sc, seq
+        np.testing.assert_allclose(float(scores.numpy()[0]), best, rtol=1e-5)
+        np.testing.assert_array_equal(path.numpy()[0], best_path)
+
+
+class TestReaderDecorators:
+    def test_compose_pipeline(self):
+        r1 = lambda: iter(range(10))
+        r2 = lambda: iter(range(10, 20))
+        comp = rd.compose(r1, r2)
+        assert next(comp()) == (0, 10)
+
+    def test_shuffle_buffered_firstn(self):
+        r = lambda: iter(range(100))
+        out = list(rd.firstn(rd.buffered(rd.shuffle(r, 32), 8), 10)())
+        assert len(out) == 10 and set(out) <= set(range(100))
+
+    def test_xmap_ordered(self):
+        r = lambda: iter(range(20))
+        out = list(rd.xmap_readers(lambda x: x * 2, r, 3, 4, order=True)())
+        assert out == [x * 2 for x in range(20)]
+
+    def test_cache(self):
+        calls = []
+        def r():
+            calls.append(1)
+            yield from range(3)
+        c = rd.cache(r)
+        assert list(c()) == [0, 1, 2]
+        assert list(c()) == [0, 1, 2]
+        assert len(calls) == 1
+
+
+class TestHub:
+    def test_local_hubconf(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(num_classes=10):\n"
+            "    'a tiny model'\n"
+            "    from paddle_tpu import nn\n"
+            "    return nn.Linear(4, num_classes)\n")
+        assert "tiny" in paddle.hub.list(str(tmp_path))
+        assert "tiny model" in paddle.hub.help(str(tmp_path), "tiny")
+        m = paddle.hub.load(str(tmp_path), "tiny", num_classes=3)
+        assert m.weight.shape[1] == 3
+
+    def test_remote_refused(self):
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.hub.load("owner/repo", "m", source="github")
+
+
+class TestCppExtension:
+    SRC = r"""
+#include <cmath>
+extern "C" void square_op(const float* x, float* y, long long n) {
+  for (long long i = 0; i < n; ++i) y[i] = x[i] * x[i];
+}
+extern "C" void square_grad(const float* x, const float* gy, float* gx,
+                            long long n) {
+  for (long long i = 0; i < n; ++i) gx[i] = 2.0f * x[i] * gy[i];
+}
+"""
+
+    def test_build_and_autograd(self, tmp_path):
+        src = tmp_path / "square.cc"
+        src.write_text(self.SRC)
+        ext = paddle.utils.cpp_extension.load(
+            "square_ext", [str(src)], build_directory=str(tmp_path))
+        op = ext.custom_op("square_op", backward_symbol="square_grad")
+        x = paddle.to_tensor(np.array([1.0, -2, 3], np.float32),
+                             stop_gradient=False)
+        y = op(x)
+        np.testing.assert_allclose(y.numpy(), [1, 4, 9])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, -4, 6])
+
+
+class TestCostModelRegularizer:
+    def test_cost_callable(self):
+        import jax.numpy as jnp, jax
+        cm = paddle.cost_model.CostModel()
+        f = jax.jit(lambda a: (a @ a).sum())
+        ms = cm.profile_callable(f, jnp.ones((64, 64)))
+        assert ms > 0
+
+    def test_regularizer_objects(self):
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+        from paddle_tpu import nn, optimizer
+        net = nn.Linear(4, 2)
+        opt = optimizer.Momentum(learning_rate=0.1,
+                                 parameters=net.parameters(),
+                                 weight_decay=L2Decay(1e-4))
+        assert opt._weight_decay == pytest.approx(1e-4)
+        assert L1Decay(0.01).coeff == pytest.approx(0.01)
+
+
+class TestOnnxExport:
+    def test_writes_stablehlo_artifact(self, tmp_path):
+        from paddle_tpu import nn
+        net = nn.Linear(4, 2)
+        prefix = paddle.onnx.export(
+            net, str(tmp_path / "m.onnx"),
+            input_spec=[paddle.static.InputSpec([2, 4], "float32")])
+        assert os.path.exists(prefix + ".pdmodel")

@@ -63,7 +63,7 @@ class CostModel:
     def profile_callable(self, fn: Callable, *args, iters: int = 10,
                          warmup: int = 2) -> float:
         """Wall-time a jitted callable in ms (micro-bench helper)."""
-        for _ in range(warmup):
+        for _ in range(max(warmup, 1)):  # at least once: compile + bind out
             out = fn(*args)
         jax.block_until_ready(out)
         t0 = time.perf_counter()

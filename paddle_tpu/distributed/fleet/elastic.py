@@ -104,7 +104,9 @@ class ElasticManager:
             key = f"member/{i}"
             if self._store.check(key):
                 ids.append(self._store.get(key).decode())
-        return ids
+        # a restarted host re-joins into a fresh slot while its old slot
+        # remains — dedupe by host id so it cannot count twice
+        return list(dict.fromkeys(ids))
 
     def join(self):
         """Claim a membership slot atomically (any rank)."""

@@ -159,7 +159,12 @@ def to_static(layer_or_fn=None, input_spec=None, build_strategy=None, **kw):
 # ---------------------------------------------------------------------------
 class TrainStep:
     def __init__(self, layer: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True):
+                 donate: bool = True, amp_dtype=None):
+        """amp_dtype: e.g. jnp.bfloat16 enables O2 mixed precision — fp32
+        master weights and optimizer slots, parameters cast to amp_dtype for
+        the forward/backward compute (reference AMP level O2, master-weight
+        pattern in imperative/amp_auto_cast.h + GradScaler; bf16 on TPU
+        needs no loss scaling)."""
         self.layer = layer
         self.optimizer = optimizer
         self.apply_fn, params, buffers = functionalize(layer)
@@ -171,9 +176,17 @@ class TrainStep:
         self._t = 0
         loss_fn_ = loss_fn
 
+        def maybe_cast(p):
+            if amp_dtype is None:
+                return p
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(amp_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+
         def step(params, buffers, opt_state, rng, lr, t, *batch):
             def loss_of(p):
-                out, new_buffers = self.apply_fn(p, buffers, rng, *batch[:-1])
+                out, new_buffers = self.apply_fn(maybe_cast(p), buffers, rng,
+                                                 *batch[:-1])
                 loss = loss_fn_(jax.tree_util.tree_map(Tensor, out),
                                 Tensor(batch[-1]))
                 return (loss.data if isinstance(loss, Tensor) else loss), new_buffers

@@ -504,6 +504,11 @@ def adaptive_avg_pool1d(x, output_size, name=None):
 # ===========================================================================
 @kernel("layer_norm")
 def _layer_norm(x, weight, bias, *, normalized_ndim, epsilon):
+    if normalized_ndim == 1 and weight is not None and bias is not None:
+        # hot path: fused kernel with custom vjp (single HBM pass fwd,
+        # stats recomputed in bwd) — ops/pallas/layer_norm.py
+        from ...ops.pallas.layer_norm import fused_layer_norm
+        return fused_layer_norm(x, weight, bias, epsilon)
     axes = tuple(range(x.ndim - normalized_ndim, x.ndim))
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
@@ -680,19 +685,43 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
              soft_label=soft_label, axis=axis, use_softmax=use_softmax,
              label_smoothing=label_smoothing):
         n_cls = logits.shape[axis]
-        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
-            jnp.maximum(logits, 1e-30))
         if soft_label:
+            logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+                else jnp.log(jnp.maximum(logits, 1e-30))
             soft = lab
+            if label_smoothing > 0.0:
+                soft = soft * (1.0 - label_smoothing) + label_smoothing / n_cls
+            nll = -jnp.sum(soft * logp, axis=axis)
         else:
             li = lab.astype(jnp.int32)
             if li.ndim == logits.ndim and li.shape[axis] == 1:
                 li = jnp.squeeze(li, axis)
-            # out-of-range labels (e.g. ignore_index=-100) one_hot to all-zero rows
-            soft = jax.nn.one_hot(li, n_cls, axis=axis, dtype=logp.dtype)
-        if label_smoothing > 0.0:
-            soft = soft * (1.0 - label_smoothing) + label_smoothing / n_cls
-        nll = -jnp.sum(soft * logp, axis=axis)
+            # hard labels: nll = logsumexp(logits) - logits[label]. No dense
+            # one-hot and no materialized log-probs array — at LM vocab
+            # sizes the [batch, seq, vocab] fp32 logp write dominates HBM
+            # traffic (the loss is bandwidth-bound, SURVEY §7)
+            safe = jnp.clip(li, 0, n_cls - 1)  # ignore_index masked below
+            ax = axis if axis >= 0 else logits.ndim + axis
+            picked = jnp.squeeze(
+                jnp.take_along_axis(logits, jnp.expand_dims(safe, ax),
+                                    axis=ax), ax).astype(jnp.float32)
+            if use_softmax:
+                lse = jax.scipy.special.logsumexp(
+                    logits.astype(jnp.float32), axis=ax)
+                nll = lse - picked
+                if label_smoothing > 0.0:
+                    # smoothed CE adds eps * mean-over-classes of -logp
+                    mean_logit = jnp.mean(logits.astype(jnp.float32), axis=ax)
+                    nll = (1.0 - label_smoothing) * nll \
+                        + label_smoothing * (lse - mean_logit)
+            else:
+                nll = -jnp.log(jnp.maximum(picked, 1e-30))
+                if label_smoothing > 0.0:
+                    mean_logp = jnp.mean(
+                        jnp.log(jnp.maximum(logits.astype(jnp.float32),
+                                            1e-30)), axis=ax)
+                    nll = (1.0 - label_smoothing) * nll \
+                        - label_smoothing * mean_logp
         if w:
             if soft_label:
                 ww = jnp.take(w[0], jnp.argmax(soft, axis=axis), axis=0)

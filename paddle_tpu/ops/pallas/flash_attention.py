@@ -24,28 +24,47 @@ def _on_tpu() -> bool:
 
 
 def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None):
-    """XLA-composed attention with fp32 softmax accumulation.
+    """XLA-composed attention.
 
-    XLA on TPU fuses this well for moderate sequence lengths; the Pallas
-    kernel below takes over for long sequences.
+    The [B,H,L,L] score matrix is kept in the INPUT dtype (bf16 in mixed-
+    precision training) — on a bandwidth-bound chip the fp32 score array is
+    the single largest HBM write of the transformer layer. Stability is
+    preserved by the max-subtracted softmax whose row statistics (max, sum)
+    are computed with fp32 accumulation; only the big [L,L] arrays stay
+    narrow. fp32 inputs keep the all-fp32 path.
     """
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     if scale is None:
         scale = 1.0 / np.sqrt(D)
-    qf = q.astype(jnp.float32) * scale
-    # [B,H,Lq,Lk]
-    logits = jnp.einsum("blhd,bmhd->bhlm", qf, k.astype(jnp.float32))
+    acc_t = q.dtype if q.dtype in (jnp.dtype(jnp.bfloat16),
+                                   jnp.dtype(jnp.float16)) else jnp.float32
+    # "floor" = very-negative but FINITE in acc_t; everything is clamped to
+    # it so additive -1e9/-inf masks (or causal+mask stacking) can never
+    # overflow to -inf and poison softmax rows with NaN
+    floor = jnp.asarray(-1e4 if acc_t == jnp.dtype(jnp.float16) else -1e30,
+                        acc_t)
+    qs = (q * jnp.asarray(scale, q.dtype))
+    logits = jnp.einsum("blhd,bmhd->bhlm", qs, k,
+                        preferred_element_type=acc_t).astype(acc_t)
     if causal:
         cmask = jnp.tril(jnp.ones((Lq, Lk), dtype=bool), k=Lk - Lq)
-        logits = jnp.where(cmask, logits, -1e30)
+        logits = jnp.where(cmask, logits, floor)
     if mask is not None:
         if mask.dtype == jnp.bool_:
-            logits = jnp.where(mask, logits, -1e30)
+            logits = jnp.where(mask, logits, floor)
         else:
-            logits = logits + mask.astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhlm,bmhd->blhd", probs.astype(v.dtype), v)
+            # clamp only on this path: adding a -1e9-style mask (or stacking
+            # with the causal floor) is the overflow-to--inf risk; the
+            # where() branches already floor exactly
+            logits = jnp.maximum(logits + jnp.maximum(mask.astype(acc_t),
+                                                      floor), floor)
+    # max-subtracted softmax; row stats accumulate in fp32 (tiny arrays)
+    m = jnp.max(logits.astype(jnp.float32), axis=-1, keepdims=True)
+    p = jnp.exp(logits - m.astype(acc_t))
+    denom = jnp.sum(p.astype(jnp.float32), axis=-1, keepdims=True)
+    probs = (p / denom.astype(acc_t)).astype(v.dtype)
+    out = jnp.einsum("bhlm,bmhd->blhd", probs, v)
     return out.astype(q.dtype)
 
 

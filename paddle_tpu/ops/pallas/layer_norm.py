@@ -38,12 +38,15 @@ def _ln_stats_xla(x2d: jax.Array, eps: float):
 
 
 @functools.partial(jax.jit, static_argnames=("eps", "block_rows"))
-def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5, block_rows: int = 128):
+def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5, block_rows: int = 256):
     from jax.experimental import pallas as pl
 
     R, N = x2d.shape
 
-    def kernel(x_ref, g_ref, b_ref, o_ref, mean_ref, rstd_ref):
+    # output is y ONLY: small 1-D stats outputs trip Mosaic/XLA layout
+    # mismatches (T(1024) vs T(128) tiling) — the backward recomputes
+    # mean/rstd from x instead, one extra read of a row it touches anyway
+    def kernel(x_ref, g_ref, b_ref, o_ref):
         x = x_ref[...].astype(jnp.float32)
         mean = jnp.mean(x, axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(x), axis=-1, keepdims=True) - jnp.square(mean)
@@ -51,8 +54,6 @@ def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5, block_rows: int = 128):
         xhat = (x - mean) * rstd
         y = xhat * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
         o_ref[...] = y.astype(o_ref.dtype)
-        mean_ref[...] = mean[:, 0]
-        rstd_ref[...] = rstd[:, 0]
 
     br = min(block_rows, R)
     grid = (pl.cdiv(R, br),)  # cover ALL rows; the edge block is masked
@@ -64,32 +65,48 @@ def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5, block_rows: int = 128):
             pl.BlockSpec((N,), lambda i: (0,)),
             pl.BlockSpec((N,), lambda i: (0,)),
         ],
-        out_specs=[
-            pl.BlockSpec((br, N), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((R, N), x2d.dtype),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec((br, N), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((R, N), x2d.dtype),
     )(x2d, gamma, beta)
 
 
+_pallas_ln_status = {}  # (dtype, N) -> bool
+
+_MAX_PALLAS_N = 4096  # block (256, N) must fit VMEM with fp32 intermediates
+
+
+def _pallas_ln_ok(dtype, N: int) -> bool:
+    """Per-(dtype, hidden-size) EAGER compile probe. A Mosaic failure inside
+    a traced user program cannot be caught (the exception fires at compile
+    time of the outer jit), so capability is established eagerly with the
+    exact kernel shape that production will use."""
+    key = (jnp.dtype(dtype).name, N)
+    if key not in _pallas_ln_status:
+        if not _on_tpu() or N > _MAX_PALLAS_N:
+            _pallas_ln_status[key] = False
+        else:
+            try:
+                probe = jnp.ones((256, N), dtype)
+                g = jnp.ones((N,), dtype)
+                jax.block_until_ready(_ln_fwd_pallas(probe, g, g, eps=1e-5))
+                _pallas_ln_status[key] = True
+            except Exception:
+                _pallas_ln_status[key] = False
+    return _pallas_ln_status[key]
+
+
 def _ln_fwd(x2d, gamma, beta, eps):
+    """Forward output only — stats are recomputed where needed (backward),
+    so the forward is a single read of x."""
     R, N = x2d.shape
-    if _on_tpu() and R % 8 == 0 and N % 128 == 0:
-        try:
-            y, mean, rstd = _ln_fwd_pallas(x2d, gamma, beta, eps=eps)
-            return y, mean, rstd
-        except Exception:
-            pass
+    if (not isinstance(R, int) or R % 8 == 0) and N % 128 == 0 \
+            and x2d.dtype == gamma.dtype \
+            and _pallas_ln_ok(x2d.dtype, N):
+        return _ln_fwd_pallas(x2d, gamma, beta, eps=eps)
     mean, rstd = _ln_stats_xla(x2d, eps)
     xhat = (x2d.astype(jnp.float32) - mean[:, None]) * rstd[:, None]
-    y = (xhat * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
-         ).astype(x2d.dtype)
-    return y, mean, rstd
+    return (xhat * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
+            ).astype(x2d.dtype)
 
 
 # --------------------------- custom vjp op ----------------------------------
@@ -97,28 +114,25 @@ def _ln_fwd(x2d, gamma, beta, eps):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def fused_layer_norm(x, gamma, beta, eps: float = 1e-5):
     """LayerNorm over the last dim of x (any leading shape)."""
-    y, _, _ = _fwd_core(x, gamma, beta, eps)
-    return y
-
-
-def _fwd_core(x, gamma, beta, eps):
     shape = x.shape
-    x2d = x.reshape(-1, shape[-1])
-    y, mean, rstd = _ln_fwd(x2d, gamma, beta, eps)
-    return y.reshape(shape), mean, rstd
+    return _ln_fwd(x.reshape(-1, shape[-1]), gamma, beta, eps).reshape(shape)
 
 
 def _fused_ln_fwd(x, gamma, beta, eps):
-    y, mean, rstd = _fwd_core(x, gamma, beta, eps)
-    return y, (x, gamma, mean, rstd)
+    y = fused_layer_norm(x, gamma, beta, eps)
+    # residual is x alone; mean/rstd are recomputed in bwd (cheaper in HBM
+    # bytes than saving two extra arrays, and it sidesteps the Mosaic
+    # small-output layout restriction)
+    return y, (x, gamma)
 
 
 def _fused_ln_bwd(eps, res, dy):
-    x, gamma, mean, rstd = res
+    x, gamma = res
     shape = x.shape
     N = shape[-1]
     x2d = x.reshape(-1, N).astype(jnp.float32)
     dy2d = dy.reshape(-1, N).astype(jnp.float32)
+    mean, rstd = _ln_stats_xla(x2d, eps)
     xhat = (x2d - mean[:, None]) * rstd[:, None]
     dg = jnp.sum(dy2d * xhat, axis=0).astype(gamma.dtype)
     db = jnp.sum(dy2d, axis=0).astype(gamma.dtype)

@@ -92,18 +92,18 @@ def firstn(reader, n: int):
 
 
 def cache(reader):
-    """Materialize once, replay thereafter (reference decorator.py:33)."""
+    """Materialize once, replay thereafter (reference decorator.py:33).
+    The full stream is materialized on the FIRST call — a lazily filled
+    cache would be corrupted by a partially consumed first epoch (the
+    standard `break` out of a training loop)."""
     memory = []
     filled = [False]
 
     def cached():
         if not filled[0]:
-            for e in reader():
-                memory.append(e)
-                yield e
+            memory.extend(reader())
             filled[0] = True
-        else:
-            yield from memory
+        yield from memory
     return cached
 
 

@@ -113,8 +113,9 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=None,
     build_dir = build_directory or os.path.join(
         tempfile.gettempdir(), "paddle_tpu_extensions")
     os.makedirs(build_dir, exist_ok=True)
-    tag = hashlib.sha1("".join(
-        open(s).read() for s in sources).encode()).hexdigest()[:12]
+    flags = list(extra_cxx_cflags or [])
+    tag = hashlib.sha1(("\0".join(flags) + "\0" + "".join(
+        open(s).read() for s in sources)).encode()).hexdigest()[:12]
     out = os.path.join(build_dir, f"{name}_{tag}.so")
     if not os.path.exists(out):
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", out]

@@ -28,6 +28,7 @@ from . import utils  # noqa: F401  (fleet.utils.recompute)
 from . import dataset  # noqa: F401  (InMemoryDataset/QueueDataset)
 from . import data_generator  # noqa: F401
 from . import elastic  # noqa: F401
+from . import metrics  # noqa: F401
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from .data_generator import DataGenerator, MultiSlotDataGenerator  # noqa: F401
 from ..meta_parallel.engine import HybridParallelTrainStep  # noqa: F401

@@ -1,0 +1,133 @@
+"""Distributed metrics — allreduced across trainers.
+
+Reference: `python/paddle/distributed/fleet/metrics/metric.py` (sum/max/min/
+auc aggregated with gloo allreduce across PS trainers). TPU translation:
+under a live mesh the reduction is an XLA collective
+(`distributed.collective.all_reduce`); in PS mode it runs over the table
+server's barrier+dense-table path; single process returns the local value.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ... import ops  # noqa: F401  (Tensor methods)
+from ...framework.tensor import Tensor
+
+
+def _to_np(x) -> np.ndarray:
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy(), np.float64)
+    return np.asarray(x, np.float64)
+
+
+def _allreduce(arr: np.ndarray, op: str = "sum") -> np.ndarray:
+    from ..ps import runtime as ps_runtime
+    if ps_runtime._state["client"] is not None:
+        return _allreduce_ps(arr, op)
+    import jax
+    if jax.process_count() > 1:
+        from .. import collective
+        t = Tensor(arr.astype(np.float32))
+        collective.all_reduce(t)  # psum over the live mesh
+        return np.asarray(t.numpy(), np.float64)
+    return arr
+
+
+def _allreduce_ps(arr: np.ndarray, op: str) -> np.ndarray:
+    """PS-mode allreduce through a scratch dense table (each trainer pushes
+    -its value as a 'grad' to an SGD(lr=1) table seeded with 0, then reads
+    the sum after a barrier — the gloo-wrapper trick in spirit)."""
+    from ..ps import runtime as ps_runtime
+    from ..ps.client import TableConfig
+    if op != "sum":
+        raise NotImplementedError("PS-mode metric reduce supports sum")
+    client = ps_runtime.get_client()
+    tid = 990  # reserved scratch table
+    flat = arr.reshape(-1).astype(np.float32)
+    client.create_table(TableConfig(table_id=tid, kind="dense",
+                                    dense_size=flat.size, optimizer="sgd",
+                                    learning_rate=1.0, init_range=0.0))
+    if ps_runtime.trainer_id() == 0:
+        client.set_dense(tid, np.zeros_like(flat))
+    ps_runtime.barrier_worker("metric_zero")
+    client.push_dense(tid, -flat)  # sgd(lr=1): w -= -x  => w += x
+    ps_runtime.barrier_worker("metric_sum")
+    return client.pull_dense(tid).astype(np.float64).reshape(arr.shape)
+
+
+def sum(input, scope=None, util=None):
+    return _allreduce(_to_np(input), "sum")
+
+
+def max(input, scope=None, util=None):
+    return _minmax(_to_np(input), is_max=True)
+
+
+def min(input, scope=None, util=None):
+    return _minmax(_to_np(input), is_max=False)
+
+
+def _minmax(arr: np.ndarray, is_max: bool) -> np.ndarray:
+    import jax
+    from ..ps import runtime as ps_runtime
+    if ps_runtime._state["client"] is None and jax.process_count() <= 1:
+        return arr
+    # max(x) = -min(-x); emulate with sum of one-hot? Simplest correct form
+    # over sum-allreduce: gather via per-trainer slots then reduce locally
+    from ..ps.client import TableConfig
+    if ps_runtime._state["client"] is not None:
+        client = ps_runtime.get_client()
+        n = ps_runtime.num_trainers()
+        tid = 991
+        flat = arr.reshape(-1).astype(np.float32)
+        client.create_table(TableConfig(table_id=tid, kind="dense",
+                                        dense_size=flat.size * n,
+                                        optimizer="sgd", learning_rate=1.0,
+                                        init_range=0.0))
+        if ps_runtime.trainer_id() == 0:
+            client.set_dense(tid, np.zeros(flat.size * n, np.float32))
+        ps_runtime.barrier_worker("minmax_zero")
+        mine = np.zeros(flat.size * n, np.float32)
+        rank = ps_runtime.trainer_id()
+        mine[rank * flat.size:(rank + 1) * flat.size] = flat
+        client.push_dense(tid, -mine)
+        ps_runtime.barrier_worker("minmax_done")
+        allv = client.pull_dense(tid).reshape(n, flat.size)
+        red = allv.max(axis=0) if is_max else allv.min(axis=0)
+        return red.astype(np.float64).reshape(arr.shape)
+    from .. import collective
+    t = Tensor(arr.astype(np.float32))
+    collective.all_reduce(t, op=collective.ReduceOp.MAX if is_max
+                          else collective.ReduceOp.MIN)
+    return np.asarray(t.numpy(), np.float64)
+
+
+def acc(correct, total, scope=None, util=None):
+    """Global accuracy = sum(correct)/sum(total) (reference metric.py acc)."""
+    c = _allreduce(_to_np(correct), "sum")
+    t = _allreduce(_to_np(total), "sum")
+    return float(c) / float(np.maximum(t, 1e-12))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-trainer positive/negative histogram buckets
+    (reference metric.py auc)."""
+    pos = _allreduce(_to_np(stat_pos), "sum")
+    neg = _allreduce(_to_np(stat_neg), "sum")
+    # standard trapezoid over cumulative TP/FP (buckets ordered by score)
+    tot_pos = new_pos = 0.0
+    tot_neg = new_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))
+
+
+__all__ = ["sum", "max", "min", "acc", "auc"]

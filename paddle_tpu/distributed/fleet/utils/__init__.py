@@ -117,3 +117,6 @@ def recompute(function, *args, preserve_rng_state: bool = True,
 
     tensors = [rng] + [named[k] for k in keys] + list(args) + kw_tensors
     return _d.call(jax.checkpoint(impl), tensors, name="recompute")
+
+from . import fs  # noqa: F401,E402
+from .fs import LocalFS, HDFSClient  # noqa: F401,E402

@@ -1,0 +1,193 @@
+"""Filesystem abstraction for checkpoint/model IO.
+
+Reference: `python/paddle/distributed/fleet/utils/fs.py` (`LocalFS`,
+`HDFSClient` shelling to the hadoop CLI) over C++ `framework/io/fs.cc`.
+LocalFS is fully implemented; HDFSClient keeps the exact API and delegates
+to a `hadoop fs` binary when one exists (none in this environment — then
+every call raises with guidance rather than silently no-oping).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """reference fs.py LocalFS."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_dir(self, fs_path) -> bool:
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path) -> bool:
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path) -> bool:
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self) -> bool:
+        return False
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not overwrite and self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        os.replace(src_path, dst_path)
+
+    def list_dirs(self, fs_path) -> List[str]:
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient(FS):
+    """reference fs.py HDFSClient — shells out to `hadoop fs`."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._hadoop = None
+        home = hadoop_home or os.environ.get("HADOOP_HOME")
+        if home:
+            cand = os.path.join(home, "bin", "hadoop")
+            if os.path.exists(cand):
+                self._hadoop = cand
+        elif shutil.which("hadoop"):
+            self._hadoop = shutil.which("hadoop")
+        self._configs = configs or {}
+
+    def _run(self, *args) -> str:
+        if self._hadoop is None:
+            raise ExecuteError(
+                "no hadoop binary found (set HADOOP_HOME); this environment "
+                "has no HDFS — use LocalFS or mount the data locally")
+        cfg = []
+        for k, v in self._configs.items():
+            cfg += ["-D", f"{k}={v}"]
+        out = subprocess.run([self._hadoop, "fs"] + cfg + list(args),
+                             capture_output=True, text=True)
+        if out.returncode != 0:
+            raise ExecuteError(out.stderr.strip())
+        return out.stdout
+
+    def is_exist(self, fs_path) -> bool:
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path) -> bool:
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path) -> bool:
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def need_upload_download(self) -> bool:
+        return True
+
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "ExecuteError",
+           "FSFileExistsError", "FSFileNotExistsError"]

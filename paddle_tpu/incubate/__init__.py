@@ -2,6 +2,8 @@
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import multiprocessing  # noqa: F401
+from . import optimizer  # noqa: F401
 from . import nn  # noqa: F401
 from . import operators  # noqa: F401
 from .operators import (  # noqa: F401

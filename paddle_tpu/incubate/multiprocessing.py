@@ -1,0 +1,70 @@
+"""Tensor sharing across python processes.
+
+Reference: `python/paddle/incubate/multiprocessing/reductions.py` (IPC/mmap
+tensor pickling for torn-off dataloader/trainer processes, over
+`memory/allocation/mmap_allocator.cc`). TPU translation: device arrays
+cannot be shared across processes (each process owns its runtime), so
+sharing means POSIX shared memory of the host copy — the same transport the
+multiprocess DataLoader uses (`io/worker.py`).
+"""
+from __future__ import annotations
+
+from multiprocessing import shared_memory
+from typing import Tuple
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+class SharedTensor:
+    """Handle that can be pickled across processes (descriptor only)."""
+
+    def __init__(self, name: str, shape: tuple, dtype: str):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def numpy(self) -> np.ndarray:
+        shm = shared_memory.SharedMemory(name=self.name)
+        try:
+            return np.array(np.ndarray(self.shape, np.dtype(self.dtype),
+                                       buffer=shm.buf))
+        finally:
+            shm.close()
+
+    def to_tensor(self) -> Tensor:
+        return Tensor(self.numpy())
+
+    def unlink(self):
+        try:
+            shm = shared_memory.SharedMemory(name=self.name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def share_tensor(t) -> SharedTensor:
+    """Copy a Tensor/array into shared memory; returns the picklable handle.
+    The creator (or last user) must call handle.unlink()."""
+    arr = np.asarray(t.data if isinstance(t, Tensor) else t)
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)[...] = arr
+    name = shm.name
+    shm.close()
+    return SharedTensor(name, arr.shape, str(arr.dtype))
+
+
+def reduce_tensor(t) -> Tuple:
+    """Pickle-protocol reducer (reference reductions.py): returns
+    (rebuild_fn, args)."""
+    h = share_tensor(t)
+    return (_rebuild_tensor, (h.name, h.shape, h.dtype))
+
+
+def _rebuild_tensor(name, shape, dtype) -> Tensor:
+    return SharedTensor(name, shape, dtype).to_tensor()
+
+
+__all__ = ["SharedTensor", "share_tensor", "reduce_tensor"]

@@ -1,0 +1,200 @@
+"""incubate optimizers: LookAhead, ModelAverage, LocalSGD, DGC.
+
+Reference: `python/paddle/incubate/optimizer/` (lookahead.py,
+modelaverage.py) and the fleet meta-optimizers `localsgd_optimizer.py` /
+`dgc_optimizer.py` (+ CUDA `operators/dgc_op`). The comm-modifying ones are
+eager data-parallel wrappers here: LocalSGD averages parameters across the
+dp group every k steps instead of per-step grad sync; DGC sparsifies
+gradients to top-k% with momentum correction before the allreduce.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+class LookAhead:
+    """lookahead.py: slow/fast weights — every k steps the slow copy moves
+    alpha of the way toward the fast weights and the fast weights reset."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = {id(p): jnp.copy(p.data)
+                      for p in inner_optimizer._parameter_list}
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p.data - slow)
+                self._slow[id(p)] = slow
+                p.data = slow
+
+    def clear_grad(self, *a, **kw):
+        return self.inner_optimizer.clear_grad(*a, **kw)
+
+    def minimize(self, loss, *a, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """modelaverage.py: running average of parameters, applied for eval."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = {id(p): jnp.zeros_like(p.data) for p in self._params}
+        self._cnt = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate after each optimizer.step()."""
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p.data
+        self._cnt += 1
+
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap averaged weights in (context-manager style via restore())."""
+        if self._cnt == 0:
+            return
+        self._backup = {id(p): p.data for p in self._params}
+        for p in self._params:
+            p.data = self._sum[id(p)] / self._cnt
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._params:
+                p.data = self._backup[id(p)]
+            self._backup = None
+
+    def minimize(self, *a, **kw):
+        self.step()
+
+
+class LocalSGDOptimizer:
+    """fleet localsgd_optimizer.py: train k_steps locally, then average
+    parameters across the data-parallel group (instead of per-step grad
+    allreduce — trades sync frequency for comm volume)."""
+
+    def __init__(self, inner_optimizer, k_steps: int = 4):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self._step_count = 0
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k_steps == 0:
+            self._sync_params()
+
+    def _sync_params(self):
+        import jax
+        # one process == one model replica (device-level DP shares params
+        # through the partitioner, not through eager averaging)
+        if jax.process_count() <= 1:
+            return
+        from .. import distributed as dist
+        world = dist.get_world_size()
+        for p in self.inner_optimizer._parameter_list:
+            t = Tensor(p.data)
+            dist.all_reduce(t)
+            p.data = t.data / world
+
+    def clear_grad(self, *a, **kw):
+        return self.inner_optimizer.clear_grad(*a, **kw)
+
+
+class DGCMomentumOptimizer:
+    """dgc_optimizer.py + operators/dgc_op: deep gradient compression —
+    momentum correction, gradient accumulation of the non-transmitted
+    residual, and top-k% sparsification before the dp allreduce."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 parameters: Optional[List] = None,
+                 rampup_begin_step: int = 0, rampup_step: int = 1,
+                 sparsity=(0.999,), grad_clip=None, name=None):
+        self.lr = learning_rate
+        self.momentum = float(momentum)
+        self._parameter_list = list(parameters or [])
+        self.rampup_begin_step = int(rampup_begin_step)
+        self.sparsity = list(sparsity)
+        self._step_count = 0
+        self._u = {id(p): jnp.zeros_like(p.data)
+                   for p in self._parameter_list}  # momentum buffer
+        self._v = {id(p): jnp.zeros_like(p.data)
+                   for p in self._parameter_list}  # residual accumulator
+
+    def _current_sparsity(self) -> float:
+        i = min(self._step_count, len(self.sparsity) - 1)
+        return float(self.sparsity[i])
+
+    def step(self):
+        self._step_count += 1
+        use_dgc = self._step_count > self.rampup_begin_step
+        s = self._current_sparsity()
+        for p in self._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad.data
+            if use_dgc:
+                # momentum correction: u = m*u + g; v += u
+                u = self.momentum * self._u[id(p)] + g
+                v = self._v[id(p)] + u
+                # top-k by magnitude: threshold at the s-quantile
+                k = max(1, int(round(v.size * (1.0 - s))))
+                flat = jnp.abs(v.reshape(-1))
+                thr = jnp.sort(flat)[-k]
+                mask = jnp.abs(v) >= thr
+                transmitted = jnp.where(mask, v, 0)
+                self._v[id(p)] = jnp.where(mask, 0, v)   # keep residual
+                self._u[id(p)] = jnp.where(mask, 0, u)   # clear sent momentum
+                update = self._allreduce(transmitted)
+            else:
+                u = self.momentum * self._u[id(p)] + g
+                self._u[id(p)] = u
+                update = self._allreduce(u)
+            p.data = p.data - self.lr * update
+        return None
+
+    @staticmethod
+    def _allreduce(arr):
+        import jax
+        if jax.process_count() <= 1:  # single replica: nothing to merge
+            return arr
+        from .. import distributed as dist
+        t = Tensor(arr)
+        dist.all_reduce(t)
+        return t.data / dist.get_world_size()
+
+    def clear_grad(self):
+        for p in self._parameter_list:
+            p.grad = None
+
+    def get_lr(self):
+        return float(self.lr)
+
+
+import jax  # noqa: E402  (used inside DGC step)
+
+__all__ = ["LookAhead", "ModelAverage", "LocalSGDOptimizer",
+           "DGCMomentumOptimizer"]

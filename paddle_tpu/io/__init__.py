@@ -16,3 +16,5 @@ from .sampler import (  # noqa: F401
     SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+
+from .worker import WorkerInfo, get_worker_info  # noqa: F401,E402

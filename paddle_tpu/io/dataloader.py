@@ -130,7 +130,10 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = prefetch_factor
-        self.num_workers = num_workers  # decode runs in threads; numpy releases the GIL
+        self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
         elif batch_sampler is not None:
@@ -141,6 +144,13 @@ class DataLoader:
                                               drop_last=drop_last)
 
     def __iter__(self):
+        if self.num_workers and self.num_workers > 0:
+            # worker PROCESSES + shared-memory transport (reference
+            # _DataLoaderIterMultiProcess, dataloader_iter.py:338). Workers
+            # are SPAWNED, so user scripts need the standard
+            # `if __name__ == "__main__":` guard and a picklable dataset.
+            from .worker import MultiprocessIter
+            return MultiprocessIter(self)
         return _PrefetchIter(self)
 
     def __len__(self):

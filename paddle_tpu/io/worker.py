@@ -1,0 +1,344 @@
+"""Multiprocess DataLoader workers with shared-memory tensor transport.
+
+Reference: `_DataLoaderIterMultiProcess`
+(/root/reference/python/paddle/fluid/dataloader/dataloader_iter.py:338) +
+worker.py + the mmap shared-memory allocator
+(`paddle/fluid/memory/allocation/mmap_allocator.cc`): worker processes pull
+index batches from per-worker queues, decode+collate, and pass result
+tensors through shared memory so only (name, shape, dtype) descriptors
+cross the pipe.
+
+TPU adaptation: workers are SPAWNED (a forked child of a process that
+already initialized the TPU runtime is unsafe) with JAX forced to CPU —
+workers only produce host numpy; the consumer's prefetch thread does the
+single `jax.device_put` per batch (BufferedReader's role).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as pyqueue
+import threading
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_SENTINEL = "__end__"
+
+_worker_info = None
+
+
+class WorkerInfo:
+    """Visible inside a worker process (reference dataloader/worker.py
+    get_worker_info): lets an IterableDataset shard its stream explicitly.
+    num_workers/id describe this loader's pool; dataset is the worker's
+    copy."""
+
+    def __init__(self, id: int, num_workers: int, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    """None in the main process; WorkerInfo inside a DataLoader worker."""
+    return _worker_info
+
+
+@dataclass
+class _ShmArray:
+    """Descriptor that crosses the worker->consumer pipe."""
+    name: str
+    shape: tuple
+    dtype: str
+
+
+def _to_shm(obj, segments: List[shared_memory.SharedMemory]):
+    """numpy leaves -> shared memory descriptors (structure preserved)."""
+    if isinstance(obj, np.ndarray) and obj.nbytes > 0:
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        dst = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
+        dst[...] = obj
+        segments.append(shm)
+        return _ShmArray(shm.name, obj.shape, str(obj.dtype))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_shm(v, segments) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _to_shm(v, segments) for k, v in obj.items()}
+    return obj
+
+
+def _from_shm(obj):
+    """Descriptors -> numpy copies (then the segment can be unlinked)."""
+    if isinstance(obj, _ShmArray):
+        shm = shared_memory.SharedMemory(name=obj.name)
+        try:
+            src = np.ndarray(obj.shape, np.dtype(obj.dtype), buffer=shm.buf)
+            out = np.array(src)  # own copy; free the segment eagerly
+        finally:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        return out
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_shm(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _from_shm(v) for k, v in obj.items()}
+    return obj
+
+
+def _tensor_to_numpy(obj):
+    # Tensors cannot cross process boundaries; flatten to numpy in-worker
+    from ..framework.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.data)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tensor_to_numpy(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _tensor_to_numpy(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, collate_fn, index_queue, result_queue,
+                 worker_id: int, init_fn, use_shared_memory: bool,
+                 iterable_mode: bool, batch_size: int, drop_last: bool,
+                 num_workers: int):
+    """Worker process entry (reference dataloader/worker.py _worker_loop)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"  # never grab the TPU from a worker
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        if iterable_mode:
+            # Sharding contract (same as the reference/torch): a worker-aware
+            # dataset checks get_worker_info() in __iter__ and yields only
+            # its own shard — then the modulo filter below sees an already-
+            # disjoint stream and num_workers==1-like behavior. A naive
+            # deterministic iterable is modulo-sharded here; a NON-
+            # deterministic iterable without worker awareness will overlap
+            # shards (documented limitation, as in the reference).
+            aware = getattr(dataset, "worker_aware", False)
+            buf = []
+            for i, sample in enumerate(iter(dataset)):
+                if not aware and i % num_workers != worker_id:
+                    continue
+                buf.append(sample)
+                if len(buf) == batch_size:
+                    _emit(collate_fn(buf), result_queue, use_shared_memory,
+                          batch_idx=-1)
+                    buf = []
+            if buf and not drop_last:
+                _emit(collate_fn(buf), result_queue, use_shared_memory,
+                      batch_idx=-1)
+            result_queue.put((_SENTINEL, worker_id))
+            return
+        while True:
+            item = index_queue.get()
+            if item is None:
+                result_queue.put((_SENTINEL, worker_id))
+                return
+            batch_idx, indices = item
+            batch = collate_fn([dataset[i] for i in indices])
+            _emit(batch, result_queue, use_shared_memory, batch_idx)
+    except KeyboardInterrupt:
+        pass
+    except Exception as e:  # surface to the consumer
+        import traceback
+        result_queue.put(("__error__",
+                          f"worker {worker_id}: "
+                          f"{traceback.format_exc(limit=8)}\n{e!r}"))
+
+
+def _emit(batch, result_queue, use_shared_memory: bool, batch_idx: int):
+    batch = _tensor_to_numpy(batch)
+    if use_shared_memory:
+        segments: List[shared_memory.SharedMemory] = []
+        desc = _to_shm(batch, segments)
+        result_queue.put((batch_idx, desc))
+        for shm in segments:  # consumer unlinks; worker just closes its map
+            shm.close()
+    else:
+        result_queue.put((batch_idx, batch))
+
+
+class MultiprocessIter:
+    """Order-preserving multi-worker iterator (reference
+    `_DataLoaderIterMultiProcess`): round-robin index dispatch, reorder
+    buffer on receive, eager refill to keep prefetch_factor batches in
+    flight per worker."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        ctx = mp.get_context("spawn")
+        self._nw = loader.num_workers
+        self._iterable = not hasattr(loader, "batch_sampler") or \
+            loader.batch_sampler is None
+        self._result_q = ctx.Queue()
+        # ONE shared index queue: workers pull as they finish, which load-
+        # balances without per-worker bookkeeping. Dispatch is FLOW-
+        # CONTROLLED to ~prefetch_factor batches in flight per worker —
+        # workers must not decode the whole epoch ahead of the consumer
+        # (every undelivered shared-memory batch is a live /dev/shm segment)
+        self._index_q = ctx.Queue()
+        self._eof_sent = 0
+        if not self._iterable:
+            self._batches = list(iter(loader.batch_sampler))
+            self._cursor = 0
+            for _ in range(max(2, loader.prefetch_factor) * self._nw):
+                self._dispatch_one()
+        self._workers = []
+        for wid in range(self._nw):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, loader.collate_fn,
+                      self._index_q, self._result_q, wid,
+                      loader.worker_init_fn, loader.use_shared_memory,
+                      self._iterable, loader.batch_size, loader.drop_last,
+                      self._nw),
+                daemon=True)
+            w.start()
+            self._workers.append(w)
+
+        self._reorder: Dict[int, Any] = {}
+        self._next_idx = 0
+        self._finished_workers = 0
+        self._shutdown_done = False
+
+    def _dispatch_one(self):
+        if self._cursor < len(self._batches):
+            self._index_q.put((self._cursor,
+                               list(self._batches[self._cursor])))
+            self._cursor += 1
+        elif self._eof_sent < self._nw:
+            self._index_q.put(None)
+            self._eof_sent += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        timeout = self.loader.timeout or None
+        if self._iterable:
+            while self._finished_workers < self._nw:
+                kind, payload = self._get(timeout)
+                if kind == _SENTINEL:
+                    self._finished_workers += 1
+                    continue
+                if kind == "__error__":
+                    self._shutdown()
+                    raise RuntimeError(payload)
+                return self._finalize(payload)
+            self._shutdown()
+            raise StopIteration
+
+        while True:
+            if self._next_idx in self._reorder:
+                batch = self._reorder.pop(self._next_idx)
+                self._next_idx += 1
+                return self._finalize(batch)
+            if self._next_idx >= len(self._batches):
+                self._shutdown()
+                raise StopIteration
+            kind, payload = self._get(timeout)
+            if kind == "__error__":
+                self._shutdown()
+                raise RuntimeError(payload)
+            if kind == _SENTINEL:
+                self._finished_workers += 1
+                continue
+            self._reorder[kind] = payload  # kind is a batch index
+            self._dispatch_one()           # keep the in-flight window full
+
+    def _get(self, timeout):
+        """Poll with liveness checks: a worker killed by the kernel (OOM,
+        segfault) posts nothing, and an infinite blocking get would hang the
+        trainer forever."""
+        import time as _time
+        deadline = None if not timeout else _time.monotonic() + timeout
+        while True:
+            try:
+                return self._result_q.get(timeout=1.0)
+            except pyqueue.Empty:
+                pass
+            dead = [w for w in self._workers if not w.is_alive()]
+            if len(dead) == self._nw and self._result_q.empty():
+                self._shutdown()
+                raise RuntimeError(
+                    "DataLoader workers died without reporting (exitcodes "
+                    f"{[w.exitcode for w in self._workers]}) — possibly "
+                    "OOM-killed; reduce batch size or num_workers")
+            if deadline is not None and _time.monotonic() >= deadline:
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader timed out after {timeout}s waiting for "
+                    f"workers (alive: "
+                    f"{[w.is_alive() for w in self._workers]})")
+
+    def _finalize(self, payload):
+        data = _from_shm(payload) if self.loader.use_shared_memory else payload
+        from ..framework.tensor import Tensor
+        import jax
+
+        def to_tensor(a):
+            if isinstance(a, np.ndarray):
+                arr = jax.device_put(a) if self.loader.use_buffer_reader \
+                    else a
+                return Tensor(arr)
+            return a
+        return jax.tree_util.tree_map(
+            to_tensor, data,
+            is_leaf=lambda x: isinstance(x, np.ndarray))
+
+    def _release(self, payload):
+        """Unlink shared-memory segments of an undelivered batch."""
+        if isinstance(payload, _ShmArray):
+            try:
+                shm = shared_memory.SharedMemory(name=payload.name)
+                shm.close()
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        elif isinstance(payload, (list, tuple)):
+            for v in payload:
+                self._release(v)
+        elif isinstance(payload, dict):
+            for v in payload.values():
+                self._release(v)
+
+    def _shutdown(self):
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
+        if not self._iterable:
+            for _ in self._workers:
+                try:
+                    self._index_q.put(None)
+                except Exception:
+                    pass
+        for w in self._workers:
+            w.join(timeout=2)
+            if w.is_alive():
+                w.terminate()
+        # drain in-flight batches: their shm segments would otherwise leak
+        # for the life of the process (abandoned epochs, worker errors)
+        for payload in self._reorder.values():
+            self._release(payload)
+        self._reorder.clear()
+        while True:
+            try:
+                kind, payload = self._result_q.get_nowait()
+            except (pyqueue.Empty, OSError, ValueError):
+                break
+            if kind not in (_SENTINEL, "__error__"):
+                self._release(payload)
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass

@@ -731,7 +731,11 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             nll = nll * ww
         if not soft_label:
             li_f = li.reshape(nll.shape)
-            mask = (li_f != ignore_index)
+            # ANY out-of-range label contributes zero loss (the removed
+            # one_hot formulation had this property; the gather path clips,
+            # so it must mask explicitly), not just ignore_index itself
+            mask = ((li_f != ignore_index) & (li_f >= 0)
+                    & (li_f < n_cls))
             nll = jnp.where(mask, nll, 0.0)
             if reduction == "mean":
                 denom = jnp.sum(jnp.where(mask, ww, 0.0)) if w else \

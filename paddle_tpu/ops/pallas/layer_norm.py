@@ -55,7 +55,9 @@ def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5, block_rows: int = 256):
         y = xhat * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
         o_ref[...] = y.astype(o_ref.dtype)
 
-    br = min(block_rows, R)
+    br = block_rows  # FIXED block shape — the capability probe compiled
+    # exactly (block_rows, N); a data-dependent br would run unprobed
+    # Mosaic variants inside the user's jit (callers gate on R >= br)
     grid = (pl.cdiv(R, br),)  # cover ALL rows; the edge block is masked
     return pl.pallas_call(
         kernel,
@@ -99,7 +101,7 @@ def _ln_fwd(x2d, gamma, beta, eps):
     """Forward output only — stats are recomputed where needed (backward),
     so the forward is a single read of x."""
     R, N = x2d.shape
-    if (not isinstance(R, int) or R % 8 == 0) and N % 128 == 0 \
+    if isinstance(R, int) and R >= 256 and R % 8 == 0 and N % 128 == 0 \
             and x2d.dtype == gamma.dtype \
             and _pallas_ln_ok(x2d.dtype, N):
         return _ln_fwd_pallas(x2d, gamma, beta, eps=eps)

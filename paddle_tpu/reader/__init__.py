@@ -101,7 +101,11 @@ def cache(reader):
 
     def cached():
         if not filled[0]:
-            memory.extend(reader())
+            try:
+                memory.extend(reader())
+            except BaseException:
+                memory.clear()  # a retried fill must not duplicate a prefix
+                raise
             filled[0] = True
         yield from memory
     return cached

@@ -1,0 +1,146 @@
+"""fleet.utils.fs, fleet.metrics, incubate optimizers (LookAhead/
+ModelAverage/LocalSGD/DGC) — reference tests: test_fleet_fs.py,
+test_fleet_metric.py, test_lookahead.py, test_modelaverage.py,
+test_dgc_optimizer.py."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.fleet import metrics
+from paddle_tpu.distributed.fleet.utils import HDFSClient, LocalFS
+from paddle_tpu.incubate.optimizer import (DGCMomentumOptimizer, LookAhead,
+                                           LocalSGDOptimizer, ModelAverage)
+
+
+class TestLocalFS:
+    def test_basic_ops(self, tmp_path):
+        fs = LocalFS()
+        d = str(tmp_path / "sub")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = os.path.join(d, "a.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(str(tmp_path))
+        assert dirs == ["sub"] and files == []
+        fs.mv(f, os.path.join(d, "b.txt"))
+        assert fs.is_file(os.path.join(d, "b.txt"))
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_without_hadoop_raises(self):
+        client = HDFSClient()
+        if client._hadoop is None:
+            with pytest.raises(Exception, match="hadoop"):
+                client.mkdirs("/tmp/x")
+
+
+class TestFleetMetrics:
+    def test_single_process_passthrough(self):
+        assert float(metrics.sum(np.array([3.0]))) == 3.0
+        assert metrics.acc(np.array([8.0]), np.array([10.0])) == pytest.approx(0.8)
+
+    def test_auc_from_buckets(self):
+        # perfect separation: all negatives in bucket 0, positives in bucket 9
+        pos = np.zeros(10); pos[9] = 100
+        neg = np.zeros(10); neg[0] = 100
+        assert metrics.auc(pos, neg) == pytest.approx(1.0)
+        # random: identical distributions
+        pos = np.ones(10) * 10
+        neg = np.ones(10) * 10
+        assert metrics.auc(pos, neg) == pytest.approx(0.5, abs=0.05)
+
+
+def _quad_problem():
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    w = rng.normal(size=(4, 1)).astype(np.float32)
+    y = x @ w
+    return net, x, y
+
+
+def _loss(net, x, y):
+    return ((net(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+
+
+class TestLookAhead:
+    def test_converges_and_syncs_slow_weights(self):
+        net, x, y = _quad_problem()
+        inner = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=5)
+        losses = []
+        for _ in range(40):
+            loss = _loss(net, x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.2
+
+
+class TestModelAverage:
+    def test_apply_restore(self):
+        net, x, y = _quad_problem()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        avg = ModelAverage(parameters=net.parameters())
+        for _ in range(10):
+            loss = _loss(net, x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            avg.step()
+        raw = np.asarray(net.weight.data).copy()
+        avg.apply()
+        averaged = np.asarray(net.weight.data)
+        assert not np.allclose(raw, averaged)
+        avg.restore()
+        np.testing.assert_allclose(np.asarray(net.weight.data), raw)
+
+
+class TestLocalSGD:
+    def test_single_process_trains(self):
+        net, x, y = _quad_problem()
+        opt = LocalSGDOptimizer(
+            optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+            k_steps=3)
+        l0 = None
+        for _ in range(30):
+            loss = _loss(net, x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            l0 = l0 or float(loss)
+        assert float(loss) < l0 * 0.2
+
+
+class TestDGC:
+    def test_sparsified_training_converges(self):
+        net, x, y = _quad_problem()
+        opt = DGCMomentumOptimizer(learning_rate=0.05, momentum=0.9,
+                                   parameters=net.parameters(),
+                                   rampup_begin_step=5, sparsity=[0.75])
+        losses = []
+        for _ in range(60):
+            loss = _loss(net, x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+    def test_residual_accumulates(self):
+        net, x, y = _quad_problem()
+        opt = DGCMomentumOptimizer(learning_rate=0.05,
+                                   parameters=net.parameters(),
+                                   rampup_begin_step=0, sparsity=[0.75])
+        loss = _loss(net, x, y)
+        loss.backward()
+        opt.step()
+        # with 75% sparsity most of v is retained as residual
+        v = opt._v[id(net.weight)]
+        assert np.count_nonzero(np.asarray(v)) >= v.size // 2

@@ -180,3 +180,25 @@ def _declare(lib: ctypes.CDLL):
     lib.feed_has_error.argtypes = [c.c_int]
     lib.feed_destroy.restype = c.c_int
     lib.feed_destroy.argtypes = [c.c_int]
+
+    # TDM tree index
+    lib.tdm_tree_create.restype = c.c_int
+    lib.tdm_tree_create.argtypes = [u64p, c.c_int64, c.c_int]
+    lib.tdm_tree_destroy.restype = c.c_int
+    lib.tdm_tree_destroy.argtypes = [c.c_int]
+    lib.tdm_tree_height.restype = c.c_int
+    lib.tdm_tree_height.argtypes = [c.c_int]
+    lib.tdm_tree_total_nodes.restype = c.c_int64
+    lib.tdm_tree_total_nodes.argtypes = [c.c_int]
+    lib.tdm_tree_layer_size.restype = c.c_int64
+    lib.tdm_tree_layer_size.argtypes = [c.c_int, c.c_int]
+    lib.tdm_tree_ancestors.restype = c.c_int
+    lib.tdm_tree_ancestors.argtypes = [c.c_int, u64p, c.c_int64, c.c_int,
+                                       i64p]
+    lib.tdm_layerwise_sample.restype = c.c_int
+    lib.tdm_layerwise_sample.argtypes = [c.c_int, u64p, c.c_int64, c.c_int,
+                                         c.c_int, c.c_uint64, i64p, i64p]
+    lib.tdm_tree_children.restype = c.c_int
+    lib.tdm_tree_children.argtypes = [c.c_int, i64p, c.c_int64, i64p]
+    lib.tdm_tree_node_items.restype = c.c_int
+    lib.tdm_tree_node_items.argtypes = [c.c_int, i64p, c.c_int64, i64p]

@@ -1,0 +1,232 @@
+// Tree index + samplers for retrieval-based recommenders (TDM/OTM).
+//
+// Native rebuild of the reference's index_dataset
+// (/root/reference/paddle/fluid/distributed/index_dataset/index_wrapper.cc
+// TreeIndex, index_sampler.cc LayerWiseSampler): items sit at the leaves of
+// a K-ary tree; training samples (user, item) pairs into per-layer
+// positives (the item's ancestor on that layer) plus uniformly drawn
+// same-layer negatives — the Tree-based Deep Match training scheme; serving
+// walks the tree with beam search scored by the caller's model.
+//
+// Layout: a complete K-ary tree over the item list, stored as an implicit
+// array (node i's children are i*K+1 ... i*K+K). Items are assigned to
+// leaves in the caller-provided order (callers pre-sort by category/embedding
+// to give the hierarchy meaning, as the reference's tree-building tools do).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <vector>
+
+namespace tdm {
+
+struct TreeIndex {
+  int branch = 2;
+  int height = 0;                  // layers, root = layer 0
+  int64_t n_items = 0;
+  std::vector<uint64_t> item_ids;  // leaf order
+  std::vector<int64_t> leaf_of_item_pos;  // item position -> leaf node id
+  std::vector<int64_t> layer_begin;       // node-id range per layer
+  // item id -> position (sorted lookup)
+  std::vector<std::pair<uint64_t, int64_t>> id2pos;
+
+  int64_t total_nodes() const { return layer_begin.back(); }
+
+  int64_t layer_size(int layer) const {
+    return layer_begin[layer + 1] - layer_begin[layer];
+  }
+
+  int64_t pos_of(uint64_t item) const {
+    auto it = std::lower_bound(
+        id2pos.begin(), id2pos.end(), std::make_pair(item, int64_t(0)),
+        [](const auto& a, const auto& b) { return a.first < b.first; });
+    if (it == id2pos.end() || it->first != item) return -1;
+    return it->second;
+  }
+
+  // ancestor node id of `leaf` on `layer` (root=0)
+  int64_t ancestor(int64_t node, int target_layer, int node_layer) const {
+    while (node_layer > target_layer) {
+      node = (node - 1) / branch;
+      node_layer--;
+    }
+    return node;
+  }
+};
+
+std::unique_ptr<TreeIndex> build(const uint64_t* items, int64_t n,
+                                 int branch) {
+  auto t = std::make_unique<TreeIndex>();
+  t->branch = branch < 2 ? 2 : branch;
+  t->n_items = n;
+  t->item_ids.assign(items, items + n);
+  // height: smallest h with branch^h >= n leaves
+  int64_t leaves = 1;
+  int h = 0;
+  while (leaves < n) {
+    leaves *= t->branch;
+    h++;
+  }
+  t->height = h + 1;  // layers incl. root
+  // implicit complete tree: total nodes = sum branch^l for l in [0,h]
+  t->layer_begin.resize(t->height + 1);
+  int64_t acc = 0, width = 1;
+  for (int l = 0; l < t->height; ++l) {
+    t->layer_begin[l] = acc;
+    acc += width;
+    width *= t->branch;
+  }
+  t->layer_begin[t->height] = acc;
+  // leaf ids: first n slots of the last layer
+  t->leaf_of_item_pos.resize(n);
+  int64_t leaf0 = t->layer_begin[t->height - 1];
+  for (int64_t i = 0; i < n; ++i) t->leaf_of_item_pos[i] = leaf0 + i;
+  t->id2pos.reserve(n);
+  for (int64_t i = 0; i < n; ++i) t->id2pos.emplace_back(items[i], i);
+  std::sort(t->id2pos.begin(), t->id2pos.end());
+  return t;
+}
+
+}  // namespace tdm
+
+namespace {
+std::mutex gt_mu;
+std::vector<std::unique_ptr<tdm::TreeIndex>> gt_trees;
+
+tdm::TreeIndex* tree(int h) {
+  std::lock_guard<std::mutex> g(gt_mu);
+  if (h < 0 || h >= static_cast<int>(gt_trees.size()) || !gt_trees[h])
+    return nullptr;
+  return gt_trees[h].get();
+}
+}  // namespace
+
+extern "C" {
+
+int tdm_tree_create(const uint64_t* items, int64_t n, int branch) {
+  if (n <= 0) return -1;
+  auto t = tdm::build(items, n, branch);
+  std::lock_guard<std::mutex> g(gt_mu);
+  for (size_t i = 0; i < gt_trees.size(); ++i) {
+    if (!gt_trees[i]) {
+      gt_trees[i] = std::move(t);
+      return static_cast<int>(i);
+    }
+  }
+  gt_trees.push_back(std::move(t));
+  return static_cast<int>(gt_trees.size()) - 1;
+}
+
+int tdm_tree_destroy(int h) {
+  std::lock_guard<std::mutex> g(gt_mu);
+  if (h < 0 || h >= static_cast<int>(gt_trees.size())) return -1;
+  gt_trees[h].reset();
+  return 0;
+}
+
+int tdm_tree_height(int h) {
+  tdm::TreeIndex* t = tree(h);
+  return t ? t->height : -1;
+}
+
+int64_t tdm_tree_total_nodes(int h) {
+  tdm::TreeIndex* t = tree(h);
+  return t ? t->total_nodes() : -1;
+}
+
+int64_t tdm_tree_layer_size(int h, int layer) {
+  tdm::TreeIndex* t = tree(h);
+  if (!t || layer < 0 || layer >= t->height) return -1;
+  return t->layer_size(layer);
+}
+
+// ancestor NODE id of `item` on each requested layer; -1 if unknown item
+int tdm_tree_ancestors(int h, const uint64_t* items, int64_t n,
+                       int layer, int64_t* out) {
+  tdm::TreeIndex* t = tree(h);
+  if (!t || layer < 0 || layer >= t->height) return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t pos = t->pos_of(items[i]);
+    out[i] = pos < 0 ? -1
+        : t->ancestor(t->leaf_of_item_pos[pos], layer, t->height - 1);
+  }
+  return 0;
+}
+
+// Layer-wise sampling (reference index_sampler.cc LayerWiseSampler::sample):
+// for each (input item) and each layer l in [start_layer, height):
+//   1 positive  = ancestor(item, l)
+//   neg_per_layer negatives drawn uniformly from layer l, != positive.
+// Outputs, per item, concatenated over layers:
+//   node ids [n * sum_l (1+neg)] int64, labels same length (1 pos / 0 neg).
+int tdm_layerwise_sample(int h, const uint64_t* items, int64_t n,
+                         int start_layer, int neg_per_layer, uint64_t seed,
+                         int64_t* out_nodes, int64_t* out_labels) {
+  tdm::TreeIndex* t = tree(h);
+  if (!t || start_layer < 0 || start_layer >= t->height) return -1;
+  std::mt19937_64 rng(seed);
+  int64_t w = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t pos = t->pos_of(items[i]);
+    if (pos < 0) return -2;
+    int64_t leaf = t->leaf_of_item_pos[pos];
+    for (int l = start_layer; l < t->height; ++l) {
+      int64_t anc = t->ancestor(leaf, l, t->height - 1);
+      out_nodes[w] = anc;
+      out_labels[w] = 1;
+      w++;
+      int64_t lo = t->layer_begin[l];
+      // usable width: the last layer only has n_items real leaves
+      int64_t width = (l == t->height - 1) ? t->n_items : t->layer_size(l);
+      std::uniform_int_distribution<int64_t> dist(0, width - 1);
+      for (int k = 0; k < neg_per_layer; ++k) {
+        int64_t nid = lo + dist(rng);
+        if (width > 1) {
+          while (nid == anc) nid = lo + dist(rng);
+        }
+        out_nodes[w] = nid;
+        out_labels[w] = 0;
+        w++;
+      }
+    }
+  }
+  return 0;
+}
+
+// Beam-search serving (reference index_sampler beam retrieval): expand the
+// beam layer by layer; caller scores candidate nodes between calls.
+// Returns children of the given nodes (ids), -1-padded to `branch` each.
+int tdm_tree_children(int h, const int64_t* nodes, int64_t n, int64_t* out) {
+  tdm::TreeIndex* t = tree(h);
+  if (!t) return -1;
+  int64_t last_begin = t->layer_begin[t->height - 1];
+  int64_t leaf_end = last_begin + t->n_items;
+  for (int64_t i = 0; i < n; ++i) {
+    for (int c = 0; c < t->branch; ++c) {
+      int64_t child = nodes[i] * t->branch + 1 + c;
+      bool valid = child < t->layer_begin[t->height] &&
+                   (child < last_begin || child < leaf_end);
+      out[i * t->branch + c] = valid ? child : -1;
+    }
+  }
+  return 0;
+}
+
+// node id -> item id for leaf nodes (-1 for internal/invalid)
+int tdm_tree_node_items(int h, const int64_t* nodes, int64_t n,
+                        int64_t* out) {
+  tdm::TreeIndex* t = tree(h);
+  if (!t) return -1;
+  int64_t last_begin = t->layer_begin[t->height - 1];
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t off = nodes[i] - last_begin;
+    out[i] = (off >= 0 && off < t->n_items)
+        ? static_cast<int64_t>(t->item_ids[off]) : -1;
+  }
+  return 0;
+}
+
+}  // extern "C"

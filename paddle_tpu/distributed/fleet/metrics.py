@@ -35,26 +35,46 @@ def _allreduce(arr: np.ndarray, op: str = "sum") -> np.ndarray:
     return arr
 
 
+_metric_round = {"n": 0}
+
+
 def _allreduce_ps(arr: np.ndarray, op: str) -> np.ndarray:
-    """PS-mode allreduce through a scratch dense table (each trainer pushes
-    -its value as a 'grad' to an SGD(lr=1) table seeded with 0, then reads
-    the sum after a barrier — the gloo-wrapper trick in spirit)."""
+    """PS-mode allreduce via per-trainer slots in a scratch dense table:
+    every trainer writes its fp32 value into its own slot, then all reduce
+    locally in float64 after a barrier (exactness is limited only by fp32 of
+    the LOCAL values; per-round barrier names stop back-to-back metric
+    calls from racing on the shared table)."""
     from ..ps import runtime as ps_runtime
     from ..ps.client import TableConfig
-    if op != "sum":
-        raise NotImplementedError("PS-mode metric reduce supports sum")
     client = ps_runtime.get_client()
-    tid = 990  # reserved scratch table
+    n = ps_runtime.num_trainers()
+    rank = ps_runtime.trainer_id()
+    rnd = _metric_round["n"]
+    _metric_round["n"] += 1
+    tid = 990 + (rnd % 2)  # alternate scratch tables across rounds
     flat = arr.reshape(-1).astype(np.float32)
     client.create_table(TableConfig(table_id=tid, kind="dense",
-                                    dense_size=flat.size, optimizer="sgd",
-                                    learning_rate=1.0, init_range=0.0))
-    if ps_runtime.trainer_id() == 0:
-        client.set_dense(tid, np.zeros_like(flat))
-    ps_runtime.barrier_worker("metric_zero")
-    client.push_dense(tid, -flat)  # sgd(lr=1): w -= -x  => w += x
-    ps_runtime.barrier_worker("metric_sum")
-    return client.pull_dense(tid).astype(np.float64).reshape(arr.shape)
+                                    dense_size=flat.size * n,
+                                    optimizer="sgd", learning_rate=1.0,
+                                    init_range=0.0))
+    if rank == 0:
+        client.set_dense(tid, np.zeros(flat.size * n, np.float32))
+    ps_runtime.barrier_worker(f"metric_zero_{rnd}")
+    mine = np.zeros(flat.size * n, np.float32)
+    mine[rank * flat.size:(rank + 1) * flat.size] = flat
+    client.push_dense(tid, -mine)  # sgd(lr=1): w -= -x  => w += x
+    ps_runtime.barrier_worker(f"metric_push_{rnd}")
+    allv = client.pull_dense(tid).astype(np.float64).reshape(n, flat.size)
+    ps_runtime.barrier_worker(f"metric_pull_{rnd}")  # table reusable after
+    if op == "sum":
+        red = allv.sum(axis=0)
+    elif op == "max":
+        red = allv.max(axis=0)
+    elif op == "min":
+        red = allv.min(axis=0)
+    else:
+        raise NotImplementedError(op)
+    return red.reshape(arr.shape)
 
 
 def sum(input, scope=None, util=None):
@@ -72,31 +92,10 @@ def min(input, scope=None, util=None):
 def _minmax(arr: np.ndarray, is_max: bool) -> np.ndarray:
     import jax
     from ..ps import runtime as ps_runtime
-    if ps_runtime._state["client"] is None and jax.process_count() <= 1:
-        return arr
-    # max(x) = -min(-x); emulate with sum of one-hot? Simplest correct form
-    # over sum-allreduce: gather via per-trainer slots then reduce locally
-    from ..ps.client import TableConfig
     if ps_runtime._state["client"] is not None:
-        client = ps_runtime.get_client()
-        n = ps_runtime.num_trainers()
-        tid = 991
-        flat = arr.reshape(-1).astype(np.float32)
-        client.create_table(TableConfig(table_id=tid, kind="dense",
-                                        dense_size=flat.size * n,
-                                        optimizer="sgd", learning_rate=1.0,
-                                        init_range=0.0))
-        if ps_runtime.trainer_id() == 0:
-            client.set_dense(tid, np.zeros(flat.size * n, np.float32))
-        ps_runtime.barrier_worker("minmax_zero")
-        mine = np.zeros(flat.size * n, np.float32)
-        rank = ps_runtime.trainer_id()
-        mine[rank * flat.size:(rank + 1) * flat.size] = flat
-        client.push_dense(tid, -mine)
-        ps_runtime.barrier_worker("minmax_done")
-        allv = client.pull_dense(tid).reshape(n, flat.size)
-        red = allv.max(axis=0) if is_max else allv.min(axis=0)
-        return red.astype(np.float64).reshape(arr.shape)
+        return _allreduce_ps(arr, "max" if is_max else "min")
+    if jax.process_count() <= 1:
+        return arr
     from .. import collective
     t = Tensor(arr.astype(np.float32))
     collective.all_reduce(t, op=collective.ReduceOp.MAX if is_max

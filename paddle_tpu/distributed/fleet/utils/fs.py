@@ -122,7 +122,7 @@ class HDFSClient(FS):
             cand = os.path.join(home, "bin", "hadoop")
             if os.path.exists(cand):
                 self._hadoop = cand
-        elif shutil.which("hadoop"):
+        if self._hadoop is None:  # PATH fallback even when HADOOP_HOME is stale
             self._hadoop = shutil.which("hadoop")
         self._configs = configs or {}
 

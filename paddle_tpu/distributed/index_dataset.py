@@ -1,0 +1,144 @@
+"""Tree index + samplers for retrieval recommenders (TDM), native-backed.
+
+Reference: /root/reference/paddle/fluid/distributed/index_dataset/
+(`index_wrapper.cc` TreeIndex, `index_sampler.cc` LayerWiseSampler) with the
+python face `python/paddle/distributed/fleet/dataset/index_dataset.py`.
+The tree lives in C++ (`_native/csrc/index_dataset.cc`); training draws
+per-layer positive/negative node samples, serving beam-searches the tree
+with the caller's scoring model.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import _native
+
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
+class TreeIndex:
+    """Complete K-ary tree over an ordered item list (leaf order = the
+    given order; pre-sort by category/embedding for a meaningful
+    hierarchy, as the reference's tree-building tools do)."""
+
+    def __init__(self, item_ids: Sequence[int], branch: int = 2):
+        self._lib = _native.load()
+        items = np.ascontiguousarray(item_ids, np.uint64)
+        if items.ndim != 1 or items.size == 0:
+            raise ValueError("item_ids must be a non-empty 1-D sequence")
+        self._h = self._lib.tdm_tree_create(
+            items.ctypes.data_as(_U64P), items.size, branch)
+        if self._h < 0:
+            raise RuntimeError("tdm_tree_create failed")
+        self.branch = max(2, branch)
+        self.n_items = int(items.size)
+
+    @property
+    def height(self) -> int:
+        return self._lib.tdm_tree_height(self._h)
+
+    def total_node_nums(self) -> int:
+        return int(self._lib.tdm_tree_total_nodes(self._h))
+
+    def layer_size(self, layer: int) -> int:
+        return int(self._lib.tdm_tree_layer_size(self._h, layer))
+
+    def get_ancestors(self, items, layer: int) -> np.ndarray:
+        arr = np.ascontiguousarray(items, np.uint64).reshape(-1)
+        out = np.empty(arr.size, np.int64)
+        rc = self._lib.tdm_tree_ancestors(
+            self._h, arr.ctypes.data_as(_U64P), arr.size, layer,
+            out.ctypes.data_as(_I64P))
+        if rc != 0:
+            raise RuntimeError("tdm_tree_ancestors failed")
+        return out
+
+    def get_children(self, nodes) -> np.ndarray:
+        arr = np.ascontiguousarray(nodes, np.int64).reshape(-1)
+        out = np.empty(arr.size * self.branch, np.int64)
+        rc = self._lib.tdm_tree_children(
+            self._h, arr.ctypes.data_as(_I64P), arr.size,
+            out.ctypes.data_as(_I64P))
+        if rc != 0:
+            raise RuntimeError("tdm_tree_children failed")
+        return out.reshape(arr.size, self.branch)
+
+    def node_items(self, nodes) -> np.ndarray:
+        """Leaf node ids -> item ids (-1 for internal nodes)."""
+        arr = np.ascontiguousarray(nodes, np.int64).reshape(-1)
+        out = np.empty(arr.size, np.int64)
+        rc = self._lib.tdm_tree_node_items(
+            self._h, arr.ctypes.data_as(_I64P), arr.size,
+            out.ctypes.data_as(_I64P))
+        if rc != 0:
+            raise RuntimeError("tdm_tree_node_items failed")
+        return out
+
+    def __del__(self):
+        try:
+            self._lib.tdm_tree_destroy(self._h)
+        except Exception:
+            pass
+
+
+class LayerWiseSampler:
+    """reference index_sampler.cc LayerWiseSampler: per (user, item) pair,
+    per layer: the item's ancestor as positive + uniform same-layer
+    negatives."""
+
+    def __init__(self, tree: TreeIndex, start_layer: int = 1,
+                 neg_per_layer: int = 2, seed: int = 0):
+        self.tree = tree
+        self.start_layer = start_layer
+        self.neg_per_layer = neg_per_layer
+        self._seed = seed
+
+    def sample(self, target_items) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (nodes [n, layers*(1+neg)], labels same shape)."""
+        items = np.ascontiguousarray(target_items, np.uint64).reshape(-1)
+        layers = self.tree.height - self.start_layer
+        per_item = layers * (1 + self.neg_per_layer)
+        nodes = np.empty(items.size * per_item, np.int64)
+        labels = np.empty_like(nodes)
+        self._seed += 1
+        rc = self.tree._lib.tdm_layerwise_sample(
+            self.tree._h, items.ctypes.data_as(_U64P), items.size,
+            self.start_layer, self.neg_per_layer, self._seed,
+            nodes.ctypes.data_as(_I64P), labels.ctypes.data_as(_I64P))
+        if rc == -2:
+            raise KeyError("sample: an item id is not in the tree")
+        if rc != 0:
+            raise RuntimeError("tdm_layerwise_sample failed")
+        return (nodes.reshape(items.size, per_item),
+                labels.reshape(items.size, per_item))
+
+
+def beam_search_retrieval(tree: TreeIndex, score_fn: Callable, beam: int,
+                          topk: Optional[int] = None) -> np.ndarray:
+    """Serve-time retrieval (reference beam search over the tree): walk from
+    the root keeping the `beam` best nodes per layer under `score_fn(nodes)
+    -> scores`, return the top item ids at the leaves."""
+    nodes = np.array([0], np.int64)
+    for _ in range(tree.height - 1):
+        children = tree.get_children(nodes).reshape(-1)
+        children = children[children >= 0]
+        if children.size == 0:
+            break
+        scores = np.asarray(score_fn(children), np.float64).reshape(-1)
+        keep = min(beam, children.size)
+        idx = np.argpartition(-scores, keep - 1)[:keep]
+        nodes = children[idx]
+    items = tree.node_items(nodes)
+    items = items[items >= 0]
+    if topk is not None and items.size > topk:
+        scores = np.asarray(score_fn(nodes[:len(items)]),
+                            np.float64).reshape(-1)
+        items = items[np.argsort(-scores)[:topk]]
+    return items
+
+
+__all__ = ["TreeIndex", "LayerWiseSampler", "beam_search_retrieval"]

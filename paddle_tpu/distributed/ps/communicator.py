@@ -1,0 +1,141 @@
+"""Async gradient communicator for PS training.
+
+Reference: the C++ Communicator
+(/root/reference/paddle/fluid/distributed/ps/service/communicator/
+communicator.h:232 — Async:402 / HalfAsync:492 / Sync:537): trainer-side
+background threads batch gradients, merge duplicates, and push to the
+servers off the critical path, which is where PS-mode's async speedup (and
+its staleness) comes from.
+
+This wraps `PSClient` with the same pull/push surface: pushes enqueue and a
+sender thread merges per table — sparse grads segment-summed by key, dense
+grads accumulated — and flushes every `send_wait_ms` or `merge_size`
+pending pushes. Pulls pass through (reads see server state, i.e. slightly
+stale during training, exactly the reference's async semantics).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class Communicator:
+    def __init__(self, client, merge_size: int = 8, send_wait_ms: int = 20,
+                 queue_size: int = 1024):
+        self._client = client
+        self.merge_size = merge_size
+        self.send_wait_ms = send_wait_ms
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._flush_done = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    # -------------------------- lifecycle ---------------------------------
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._send_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if not self._running:
+            return
+        self.flush()
+        self._running = False
+        self._q.put(None)
+        self._thread.join(timeout=10)
+
+    def flush(self):
+        """Block until everything enqueued so far reaches the servers."""
+        if not self._running:
+            return
+        self._flush_done.clear()
+        self._q.put("__flush__")
+        self._flush_done.wait()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    # --------------------------- push/pull --------------------------------
+    def push_sparse(self, table_id: int, keys: np.ndarray,
+                    grads: np.ndarray):
+        self._check_error()
+        self._q.put(("sparse", table_id, np.asarray(keys, np.uint64),
+                     np.asarray(grads, np.float32)))
+
+    def push_dense(self, table_id: int, grad: np.ndarray):
+        self._check_error()
+        self._q.put(("dense", table_id, np.asarray(grad, np.float32)))
+
+    def _check_error(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def __getattr__(self, item):  # pulls, table mgmt, barriers: passthrough
+        return getattr(self._client, item)
+
+    # --------------------------- sender -----------------------------------
+    def _send_loop(self):
+        sparse: Dict[int, Dict[int, np.ndarray]] = {}  # tid -> key -> grad
+        dense: Dict[int, np.ndarray] = {}
+        pending = 0
+        last_send = time.monotonic()
+
+        def drain():
+            nonlocal pending, last_send
+            try:
+                for tid, merged in sparse.items():
+                    if merged:
+                        keys = np.fromiter(merged.keys(), np.uint64,
+                                           len(merged))
+                        grads = np.stack([merged[k] for k in keys])
+                        self._client.push_sparse(tid, keys, grads)
+                for tid, g in dense.items():
+                    self._client.push_dense(tid, g)
+            except BaseException as e:  # surfaced on next push/flush
+                self._error = e
+            sparse.clear()
+            dense.clear()
+            pending = 0
+            last_send = time.monotonic()
+
+        while True:
+            timeout = self.send_wait_ms / 1000.0
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                if pending:
+                    drain()
+                continue
+            if item is None:
+                drain()
+                return
+            if item == "__flush__":
+                drain()
+                self._flush_done.set()
+                continue
+            kind, tid = item[0], item[1]
+            if kind == "sparse":
+                _, _, keys, grads = item
+                bucket = sparse.setdefault(tid, {})
+                for k, g in zip(keys.tolist(), grads):
+                    if k in bucket:
+                        bucket[k] = bucket[k] + g
+                    else:
+                        bucket[k] = np.array(g, np.float32)
+            else:
+                _, _, g = item
+                dense[tid] = dense.get(tid, 0) + g
+            pending += 1
+            if pending >= self.merge_size:
+                drain()
+
+
+__all__ = ["Communicator"]

@@ -80,15 +80,26 @@ def run_server():
 
 # ------------------------------ worker side --------------------------------
 
-def init_worker(endpoints: Optional[List[str]] = None) -> PSClient:
-    """Connect to all table servers (reference fleet.init_worker)."""
+def init_worker(endpoints: Optional[List[str]] = None,
+                mode: str = "sync") -> PSClient:
+    """Connect to all table servers (reference fleet.init_worker).
+
+    mode="async" wraps the client in a background Communicator (reference
+    AsyncCommunicator): pushes batch+merge off the critical path; pulls see
+    slightly stale server state."""
     if _state["client"] is not None:
         return _state["client"]
     eps = endpoints or server_endpoints()
     if not eps:
         raise RuntimeError(
             "init_worker: no PS endpoints (set PADDLE_PSERVERS_IP_PORT_LIST)")
-    _state["client"] = PSClient(eps)
+    client = PSClient(eps)
+    if mode == "async" or os.environ.get("PADDLE_PS_MODE") == "async":
+        from .communicator import Communicator
+        comm = Communicator(client)
+        comm.start()
+        client = comm
+    _state["client"] = client
     return _state["client"]
 
 
@@ -108,6 +119,8 @@ def stop_worker():
     c = _state["client"]
     if c is None:
         return
+    if hasattr(c, "flush"):  # async communicator: land queued grads first
+        c.stop()
     c.barrier("stop_worker", num_trainers())
     if trainer_id() == 0:
         c.stop_servers()

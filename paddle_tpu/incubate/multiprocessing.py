@@ -15,6 +15,9 @@ from typing import Tuple
 import numpy as np
 
 from ..framework.tensor import Tensor
+# single shm-descriptor implementation, shared with the multiprocess
+# DataLoader transport (keep the two paths from drifting apart)
+from ..io.worker import _ShmArray, _from_shm, _to_shm
 
 
 class SharedTensor:
@@ -25,13 +28,21 @@ class SharedTensor:
         self.shape = tuple(shape)
         self.dtype = dtype
 
+    def _desc(self) -> _ShmArray:
+        return _ShmArray(self.name, self.shape, self.dtype)
+
     def numpy(self) -> np.ndarray:
+        # read WITHOUT consuming: _from_shm unlinks, so copy via a raw open
         shm = shared_memory.SharedMemory(name=self.name)
         try:
             return np.array(np.ndarray(self.shape, np.dtype(self.dtype),
                                        buffer=shm.buf))
         finally:
             shm.close()
+
+    def consume(self) -> np.ndarray:
+        """Read AND free the segment (worker-transport semantics)."""
+        return _from_shm(self._desc())
 
     def to_tensor(self) -> Tensor:
         return Tensor(self.numpy())
@@ -47,13 +58,18 @@ class SharedTensor:
 
 def share_tensor(t) -> SharedTensor:
     """Copy a Tensor/array into shared memory; returns the picklable handle.
-    The creator (or last user) must call handle.unlink()."""
+    The creator (or last user) must call handle.unlink() (or consume())."""
     arr = np.asarray(t.data if isinstance(t, Tensor) else t)
-    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
-    np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)[...] = arr
-    name = shm.name
-    shm.close()
-    return SharedTensor(name, arr.shape, str(arr.dtype))
+    segments = []
+    desc = _to_shm(np.ascontiguousarray(arr), segments)
+    for shm in segments:
+        shm.close()
+    if not isinstance(desc, _ShmArray):  # zero-size array: inline fallback
+        shm = shared_memory.SharedMemory(create=True, size=1)
+        name = shm.name
+        shm.close()
+        return SharedTensor(name, arr.shape, str(arr.dtype))
+    return SharedTensor(desc.name, desc.shape, desc.dtype)
 
 
 def reduce_tensor(t) -> Tuple:

@@ -137,6 +137,7 @@ class DGCMomentumOptimizer:
         self.momentum = float(momentum)
         self._parameter_list = list(parameters or [])
         self.rampup_begin_step = int(rampup_begin_step)
+        self.rampup_step = max(1, int(rampup_step))
         self.sparsity = list(sparsity)
         self._step_count = 0
         self._u = {id(p): jnp.zeros_like(p.data)
@@ -145,7 +146,11 @@ class DGCMomentumOptimizer:
                    for p in self._parameter_list}  # residual accumulator
 
     def _current_sparsity(self) -> float:
-        i = min(self._step_count, len(self.sparsity) - 1)
+        # the warmup schedule spreads the sparsity levels over rampup_step
+        # steps AFTER compression begins (reference dgc semantics)
+        since = max(0, self._step_count - self.rampup_begin_step)
+        i = min(since * len(self.sparsity) // self.rampup_step,
+                len(self.sparsity) - 1)
         return float(self.sparsity[i])
 
     def step(self):
@@ -193,8 +198,6 @@ class DGCMomentumOptimizer:
     def get_lr(self):
         return float(self.lr)
 
-
-import jax  # noqa: E402  (used inside DGC step)
 
 __all__ = ["LookAhead", "ModelAverage", "LocalSGDOptimizer",
            "DGCMomentumOptimizer"]

@@ -178,18 +178,20 @@ class MultiprocessIter:
         self._nw = loader.num_workers
         self._iterable = not hasattr(loader, "batch_sampler") or \
             loader.batch_sampler is None
-        self._result_q = ctx.Queue()
+        # Bounded result queue: back-pressure for the iterable path (whose
+        # workers would otherwise decode the whole epoch ahead — every
+        # undelivered shared-memory batch is a live /dev/shm segment).
+        window = max(2, loader.prefetch_factor) * self._nw
+        self._result_q = ctx.Queue(maxsize=window + self._nw)
         # ONE shared index queue: workers pull as they finish, which load-
-        # balances without per-worker bookkeeping. Dispatch is FLOW-
-        # CONTROLLED to ~prefetch_factor batches in flight per worker —
-        # workers must not decode the whole epoch ahead of the consumer
-        # (every undelivered shared-memory batch is a live /dev/shm segment)
+        # balances without per-worker bookkeeping. Map-style dispatch is
+        # additionally FLOW-CONTROLLED to the same window.
         self._index_q = ctx.Queue()
         self._eof_sent = 0
         if not self._iterable:
             self._batches = list(iter(loader.batch_sampler))
             self._cursor = 0
-            for _ in range(max(2, loader.prefetch_factor) * self._nw):
+            for _ in range(window):
                 self._dispatch_one()
         self._workers = []
         for wid in range(self._nw):
@@ -265,13 +267,18 @@ class MultiprocessIter:
                 return self._result_q.get(timeout=1.0)
             except pyqueue.Empty:
                 pass
-            dead = [w for w in self._workers if not w.is_alive()]
-            if len(dead) == self._nw and self._result_q.empty():
+            # ANY abnormally-dead worker is fatal: its dispatched batches can
+            # never arrive, so waiting for the rest would hang on a hole in
+            # the batch sequence (clean exits post a sentinel first and have
+            # exitcode 0)
+            crashed = [w for w in self._workers
+                       if w.exitcode not in (None, 0)]
+            if crashed and self._result_q.empty():
+                codes = [w.exitcode for w in self._workers]
                 self._shutdown()
                 raise RuntimeError(
-                    "DataLoader workers died without reporting (exitcodes "
-                    f"{[w.exitcode for w in self._workers]}) — possibly "
-                    "OOM-killed; reduce batch size or num_workers")
+                    f"DataLoader worker(s) died (exitcodes {codes}) — "
+                    "possibly OOM-killed; reduce batch size or num_workers")
             if deadline is not None and _time.monotonic() >= deadline:
                 self._shutdown()
                 raise RuntimeError(
@@ -310,6 +317,15 @@ class MultiprocessIter:
             for v in payload.values():
                 self._release(v)
 
+    def _drain_results(self):
+        while True:
+            try:
+                kind, payload = self._result_q.get_nowait()
+            except (pyqueue.Empty, OSError, ValueError):
+                break
+            if kind not in (_SENTINEL, "__error__"):
+                self._release(payload)
+
     def _shutdown(self):
         if self._shutdown_done:
             return
@@ -320,22 +336,24 @@ class MultiprocessIter:
                     self._index_q.put(None)
                 except Exception:
                     pass
+        # interleave draining with joining: a worker blocked on the bounded
+        # result queue can only exit once its pending put lands
+        import time as _time
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline and \
+                any(w.is_alive() for w in self._workers):
+            self._drain_results()
+            for w in self._workers:
+                w.join(timeout=0.1)
         for w in self._workers:
-            w.join(timeout=2)
             if w.is_alive():
                 w.terminate()
-        # drain in-flight batches: their shm segments would otherwise leak
+        # drop in-flight batches: their shm segments would otherwise leak
         # for the life of the process (abandoned epochs, worker errors)
         for payload in self._reorder.values():
             self._release(payload)
         self._reorder.clear()
-        while True:
-            try:
-                kind, payload = self._result_q.get_nowait()
-            except (pyqueue.Empty, OSError, ValueError):
-                break
-            if kind not in (_SENTINEL, "__error__"):
-                self._release(payload)
+        self._drain_results()
 
     def __del__(self):
         try:

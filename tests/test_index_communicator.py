@@ -1,0 +1,169 @@
+"""TDM tree index/samplers + PS async communicator tests (reference:
+`test_index_dataset.py`, `index_dataset` C++ tests, communicator tests)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.index_dataset import (LayerWiseSampler,
+                                                  TreeIndex,
+                                                  beam_search_retrieval)
+from paddle_tpu.distributed.ps import PSClient, PSServer, TableConfig
+from paddle_tpu.distributed.ps.communicator import Communicator
+
+
+class TestTreeIndex:
+    def test_structure(self):
+        items = np.arange(100, 108, dtype=np.uint64)  # 8 items, binary tree
+        t = TreeIndex(items, branch=2)
+        assert t.height == 4          # 1+2+4+8
+        assert t.total_node_nums() == 15
+        assert t.layer_size(0) == 1 and t.layer_size(3) == 8
+
+    def test_ancestors(self):
+        items = np.arange(100, 108, dtype=np.uint64)
+        t = TreeIndex(items, branch=2)
+        # item 100 is leaf node 7 (first of last layer)
+        anc = t.get_ancestors([100], layer=3)
+        assert anc[0] == 7
+        assert t.get_ancestors([100], layer=2)[0] == 3
+        assert t.get_ancestors([100], layer=0)[0] == 0
+        # unknown item -> -1
+        assert t.get_ancestors([999], layer=2)[0] == -1
+
+    def test_children_and_node_items(self):
+        items = np.arange(100, 108, dtype=np.uint64)
+        t = TreeIndex(items, branch=2)
+        ch = t.get_children([0])
+        np.testing.assert_array_equal(ch[0], [1, 2])
+        leaves = t.get_children([3])  # children of node 3 -> nodes 7,8
+        np.testing.assert_array_equal(leaves[0], [7, 8])
+        np.testing.assert_array_equal(t.node_items([7, 8]), [100, 101])
+        assert t.node_items([0])[0] == -1  # root is not a leaf
+
+    def test_non_power_tree(self):
+        items = np.arange(5, dtype=np.uint64)  # 5 items in an 8-leaf tree
+        t = TreeIndex(items, branch=2)
+        # children beyond the real leaves are -1
+        ch = t.get_children([5])  # node 5's children are leaves 11,12
+        assert (ch >= -1).all()
+        leaf_nodes = t.get_ancestors(items, layer=t.height - 1)
+        assert len(set(leaf_nodes.tolist())) == 5
+
+
+class TestLayerWiseSampler:
+    def test_sample_shapes_and_labels(self):
+        items = np.arange(1000, 1064, dtype=np.uint64)
+        t = TreeIndex(items, branch=2)
+        s = LayerWiseSampler(t, start_layer=1, neg_per_layer=3)
+        nodes, labels = s.sample([1000, 1005])
+        layers = t.height - 1
+        assert nodes.shape == (2, layers * 4)
+        # exactly one positive per layer
+        assert labels.reshape(2, layers, 4)[:, :, 0].all()
+        assert not labels.reshape(2, layers, 4)[:, :, 1:].any()
+
+    def test_positives_are_ancestors(self):
+        items = np.arange(1000, 1016, dtype=np.uint64)
+        t = TreeIndex(items, branch=2)
+        s = LayerWiseSampler(t, start_layer=1, neg_per_layer=1)
+        nodes, labels = s.sample([1003])
+        layers = t.height - 1
+        pos = nodes.reshape(layers, 2)[:, 0]
+        for i, layer in enumerate(range(1, t.height)):
+            assert pos[i] == t.get_ancestors([1003], layer)[0]
+
+    def test_unknown_item_raises(self):
+        t = TreeIndex(np.arange(4, dtype=np.uint64))
+        with pytest.raises(KeyError):
+            LayerWiseSampler(t).sample([77])
+
+
+class TestBeamSearch:
+    def test_retrieves_best_item(self):
+        items = np.arange(200, 232, dtype=np.uint64)  # 32 items
+        t = TreeIndex(items, branch=2)
+        target_leaf = t.get_ancestors([219], layer=t.height - 1)[0]
+
+        def score_fn(nodes):
+            # score = closeness of the subtree to the target leaf: use
+            # negative distance of node id to target's ancestor at that depth
+            nodes = np.asarray(nodes)
+            out = np.empty(len(nodes))
+            for i, n in enumerate(nodes):
+                # walk target ancestor chain; reward exact ancestors
+                anc = target_leaf
+                score = 0.0
+                while anc > 0:
+                    if anc == n:
+                        score = 10.0
+                        break
+                    anc = (anc - 1) // 2
+                if n == 0:
+                    score = 10.0
+                out[i] = score
+            return out
+
+        got = beam_search_retrieval(t, score_fn, beam=2)
+        assert 219 in got.tolist()
+
+
+class TestAsyncCommunicator:
+    def test_merges_and_flushes(self):
+        server = PSServer(0)
+        client = PSClient([server.endpoint])
+        try:
+            client.create_table(TableConfig(table_id=0, dim=4,
+                                            optimizer="sgd",
+                                            learning_rate=1.0,
+                                            init_range=0.0))
+            comm = Communicator(client, merge_size=100, send_wait_ms=10)
+            comm.start()
+            keys = np.array([5, 5, 9], np.uint64)
+            grads = np.ones((3, 4), np.float32)
+            comm.push_sparse(0, keys, grads)
+            comm.push_sparse(0, keys, grads)
+            comm.flush()
+            # key 5 got 4 unit grads merged, key 9 got 2; sgd lr=1 -> w=-n
+            vals = client.pull_sparse(0, np.array([5, 9], np.uint64))
+            np.testing.assert_allclose(vals[0], -4 * np.ones(4))
+            np.testing.assert_allclose(vals[1], -2 * np.ones(4))
+            comm.stop()
+        finally:
+            client.stop_servers()
+
+    def test_dense_accumulation(self):
+        server = PSServer(0)
+        client = PSClient([server.endpoint])
+        try:
+            client.create_table(TableConfig(table_id=1, kind="dense",
+                                            dense_size=4, optimizer="sgd",
+                                            learning_rate=1.0))
+            client.set_dense(1, np.zeros(4, np.float32))
+            comm = Communicator(client, merge_size=100, send_wait_ms=10)
+            comm.start()
+            for _ in range(5):
+                comm.push_dense(1, np.ones(4, np.float32))
+            comm.flush()
+            np.testing.assert_allclose(client.pull_dense(1), -5 * np.ones(4))
+            comm.stop()
+        finally:
+            client.stop_servers()
+
+    def test_interval_flush_without_explicit_flush(self):
+        server = PSServer(0)
+        client = PSClient([server.endpoint])
+        try:
+            client.create_table(TableConfig(table_id=2, kind="dense",
+                                            dense_size=2, optimizer="sgd",
+                                            learning_rate=1.0))
+            client.set_dense(2, np.zeros(2, np.float32))
+            comm = Communicator(client, merge_size=1000, send_wait_ms=30)
+            comm.start()
+            comm.push_dense(2, np.ones(2, np.float32))
+            time.sleep(0.5)  # sender should drain on its own
+            np.testing.assert_allclose(client.pull_dense(2), -np.ones(2))
+            comm.stop()
+        finally:
+            client.stop_servers()

@@ -36,6 +36,14 @@ def _allreduce(arr: np.ndarray, op: str = "sum") -> np.ndarray:
 
 
 _metric_round = {"n": 0}
+_MAX_METRIC_ELEMS = 4096
+
+
+def _flush(client):
+    """Async-communicator clients buffer pushes; metrics are barrier-
+    synchronized, so queued writes must land before each barrier."""
+    if hasattr(client, "flush"):
+        client.flush()
 
 
 def _allreduce_ps(arr: np.ndarray, op: str) -> np.ndarray:
@@ -53,18 +61,28 @@ def _allreduce_ps(arr: np.ndarray, op: str) -> np.ndarray:
     _metric_round["n"] += 1
     tid = 990 + (rnd % 2)  # alternate scratch tables across rounds
     flat = arr.reshape(-1).astype(np.float32)
+    if flat.size > _MAX_METRIC_ELEMS:
+        raise ValueError(
+            f"fleet.metrics: value has {flat.size} elements; the PS scratch "
+            f"table caps at {_MAX_METRIC_ELEMS}")
+    # FIXED-size table: server-side create_table is create-if-absent, so a
+    # size that varied between calls would silently bind a stale table
+    slot = _MAX_METRIC_ELEMS
     client.create_table(TableConfig(table_id=tid, kind="dense",
-                                    dense_size=flat.size * n,
+                                    dense_size=slot * n,
                                     optimizer="sgd", learning_rate=1.0,
                                     init_range=0.0))
     if rank == 0:
-        client.set_dense(tid, np.zeros(flat.size * n, np.float32))
+        client.set_dense(tid, np.zeros(slot * n, np.float32))
+    _flush(client)
     ps_runtime.barrier_worker(f"metric_zero_{rnd}")
-    mine = np.zeros(flat.size * n, np.float32)
-    mine[rank * flat.size:(rank + 1) * flat.size] = flat
+    mine = np.zeros(slot * n, np.float32)
+    mine[rank * slot:rank * slot + flat.size] = flat
     client.push_dense(tid, -mine)  # sgd(lr=1): w -= -x  => w += x
+    _flush(client)  # async communicator: land the push BEFORE the barrier
     ps_runtime.barrier_worker(f"metric_push_{rnd}")
-    allv = client.pull_dense(tid).astype(np.float64).reshape(n, flat.size)
+    allv = client.pull_dense(tid).astype(np.float64).reshape(n, slot)
+    allv = allv[:, :flat.size]
     ps_runtime.barrier_worker(f"metric_pull_{rnd}")  # table reusable after
     if op == "sum":
         red = allv.sum(axis=0)

@@ -57,7 +57,10 @@ class Communicator:
             return
         self._flush_done.clear()
         self._q.put("__flush__")
-        self._flush_done.wait()
+        while not self._flush_done.wait(timeout=1.0):
+            if not self._thread.is_alive():  # belt-and-braces vs deadlock
+                raise RuntimeError(
+                    "PS communicator sender thread died unexpectedly")
         if self._error is not None:
             err, self._error = self._error, None
             raise err
@@ -121,19 +124,25 @@ class Communicator:
                 drain()
                 self._flush_done.set()
                 continue
-            kind, tid = item[0], item[1]
-            if kind == "sparse":
-                _, _, keys, grads = item
-                bucket = sparse.setdefault(tid, {})
-                for k, g in zip(keys.tolist(), grads):
-                    if k in bucket:
-                        bucket[k] = bucket[k] + g
-                    else:
-                        bucket[k] = np.array(g, np.float32)
-            else:
-                _, _, g = item
-                dense[tid] = dense.get(tid, 0) + g
-            pending += 1
+            try:  # a bad item must not kill the thread: flush()/stop()
+                # would then deadlock on _flush_done forever
+                kind, tid = item[0], item[1]
+                if kind == "sparse":
+                    _, _, keys, grads = item
+                    grads = grads.reshape(keys.size, -1)
+                    bucket = sparse.setdefault(tid, {})
+                    for k, g in zip(keys.tolist(), grads):
+                        if k in bucket:
+                            bucket[k] = bucket[k] + g
+                        else:
+                            bucket[k] = np.array(g, np.float32)
+                else:
+                    _, _, g = item
+                    dense[tid] = dense.get(tid, 0) + g
+                pending += 1
+            except BaseException as e:
+                self._error = e
+                continue
             if pending >= self.merge_size:
                 drain()
 

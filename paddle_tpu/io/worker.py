@@ -209,6 +209,7 @@ class MultiprocessIter:
         self._reorder: Dict[int, Any] = {}
         self._next_idx = 0
         self._finished_workers = 0
+        self._sentinel_wids = set()  # workers that finished cleanly
         self._shutdown_done = False
 
     def _dispatch_one(self):
@@ -230,6 +231,7 @@ class MultiprocessIter:
                 kind, payload = self._get(timeout)
                 if kind == _SENTINEL:
                     self._finished_workers += 1
+                    self._sentinel_wids.add(payload)
                     continue
                 if kind == "__error__":
                     self._shutdown()
@@ -252,6 +254,7 @@ class MultiprocessIter:
                 raise RuntimeError(payload)
             if kind == _SENTINEL:
                 self._finished_workers += 1
+                self._sentinel_wids.add(payload)
                 continue
             self._reorder[kind] = payload  # kind is a batch index
             self._dispatch_one()           # keep the in-flight window full
@@ -267,18 +270,21 @@ class MultiprocessIter:
                 return self._result_q.get(timeout=1.0)
             except pyqueue.Empty:
                 pass
-            # ANY abnormally-dead worker is fatal: its dispatched batches can
-            # never arrive, so waiting for the rest would hang on a hole in
-            # the batch sequence (clean exits post a sentinel first and have
-            # exitcode 0)
-            crashed = [w for w in self._workers
-                       if w.exitcode not in (None, 0)]
+            # ANY dead worker that never posted its end-of-stream sentinel is
+            # fatal: its dispatched batches can never arrive, so waiting for
+            # the rest would hang on a hole in the batch sequence. This
+            # covers nonzero exits (OOM-kill, segfault) AND sys.exit(0)
+            # inside user dataset code.
+            crashed = [w for wid, w in enumerate(self._workers)
+                       if w.exitcode is not None
+                       and wid not in self._sentinel_wids]
             if crashed and self._result_q.empty():
                 codes = [w.exitcode for w in self._workers]
                 self._shutdown()
                 raise RuntimeError(
-                    f"DataLoader worker(s) died (exitcodes {codes}) — "
-                    "possibly OOM-killed; reduce batch size or num_workers")
+                    f"DataLoader worker(s) died without finishing "
+                    f"(exitcodes {codes}) — possibly OOM-killed or dataset "
+                    "code called exit(); reduce batch size or num_workers")
             if deadline is not None and _time.monotonic() >= deadline:
                 self._shutdown()
                 raise RuntimeError(

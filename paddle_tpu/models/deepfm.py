@@ -1,0 +1,55 @@
+"""DeepFM CTR model over PS-resident sparse embeddings.
+
+Reference workload: the second PS-path flagship next to Wide&Deep
+(BASELINE target configs; reference `test_dist_fleet_ctr.py` family).
+FM half: first-order weights + pairwise second-order interactions via the
+sum-square/square-sum identity; deep half: MLP over the concatenated
+embeddings. Both halves share the PS embedding tables.
+"""
+from __future__ import annotations
+
+from .. import nn
+from .. import ops
+from ..distributed.ps import SparseEmbedding
+
+
+class DeepFM(nn.Layer):
+    def __init__(self, num_slots: int = 4, embedding_dim: int = 8,
+                 hidden: int = 32, sparse_lr: float = 0.05,
+                 table_base: int = 100, client=None):
+        super().__init__()
+        self.num_slots = num_slots
+        self.embedding_dim = embedding_dim
+        # second-order factors [slot ids -> dim-d vectors]
+        self.fm_embeddings = nn.LayerList([
+            SparseEmbedding(table_id=table_base + i,
+                            embedding_dim=embedding_dim,
+                            optimizer="sgd", learning_rate=sparse_lr,
+                            client=client)
+            for i in range(num_slots)
+        ])
+        # first-order weights [slot ids -> scalars]
+        self.fm_first = SparseEmbedding(table_id=table_base + num_slots,
+                                        embedding_dim=1, optimizer="sgd",
+                                        learning_rate=sparse_lr,
+                                        client=client)
+        self.dnn = nn.Sequential(
+            nn.Linear(num_slots * embedding_dim, hidden),
+            nn.ReLU(),
+            nn.Linear(hidden, hidden),
+            nn.ReLU(),
+            nn.Linear(hidden, 1),
+        )
+
+    def forward(self, slot_ids):
+        """slot_ids: int [batch, num_slots] -> CTR logit [batch, 1]."""
+        embs = [emb(slot_ids[:, i]) for i, emb in enumerate(self.fm_embeddings)]
+        stacked = ops.stack(embs, axis=1)            # [B, S, D]
+        # FM second order: 0.5 * ((sum v)^2 - sum v^2) summed over D
+        sum_v = stacked.sum(axis=1)                   # [B, D]
+        sum_sq = (stacked * stacked).sum(axis=1)      # [B, D]
+        second = 0.5 * (sum_v * sum_v - sum_sq).sum(axis=1, keepdim=True)
+        first = self.fm_first(slot_ids).sum(axis=1)   # [B, 1]
+        deep_in = ops.concat(embs, axis=-1)           # [B, S*D]
+        deep = self.dnn(deep_in)
+        return first + second + deep

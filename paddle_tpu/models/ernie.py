@@ -1,0 +1,80 @@
+"""ERNIE — the reference flagship NLP family (BASELINE.json north star:
+ERNIE-3.0-Base step time).
+
+Reference: ERNIE shares BERT's encoder architecture (the reference trains it
+through the same fleet stack; see `incubate/nn` fused transformer bindings);
+what differs is the pretraining objective (knowledge-masking: whole-word /
+entity spans instead of wordpiece tokens). This module reuses the BERT
+encoder (`models/bert.py`) and adds the ERNIE config surface + the
+knowledge-masked MLM head.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from .bert import Bert, BertConfig
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    @staticmethod
+    def base():
+        # ERNIE-3.0-Base: 12L, 768H, 12 heads (BASELINE target config)
+        return ErnieConfig(vocab_size=40000, hidden_size=768, num_layers=12,
+                           num_heads=12, intermediate_size=3072)
+
+    @staticmethod
+    def tiny():
+        return ErnieConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                           num_heads=2, intermediate_size=128,
+                           max_position_embeddings=128, dropout=0.0)
+
+
+class Ernie(Bert):
+    """Encoder = BERT; kept as its own class for config/namespace parity
+    (`ErnieModel` in the reference ecosystem)."""
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM head over the ERNIE encoder (knowledge-masked spans are a DATA
+    transformation — see `ernie_mask_tokens` — not an architecture change)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = Ernie(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size)
+        self.mlm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq_out, _pooled = self.ernie(input_ids,
+                                      token_type_ids=token_type_ids)
+        h = F.gelu(self.mlm_transform(seq_out))
+        h = self.mlm_norm(h)
+        return self.mlm_head(h)
+
+    def loss(self, input_ids, labels, token_type_ids=None,
+             ignore_index: int = -100):
+        logits = self(input_ids, token_type_ids=token_type_ids)
+        return F.cross_entropy(logits, labels, ignore_index=ignore_index)
+
+
+def ernie_mask_tokens(input_ids: np.ndarray, spans, mask_token_id: int,
+                      ignore_index: int = -100):
+    """Knowledge masking (the ERNIE objective): mask whole SPANS (words/
+    entities/phrases), not independent wordpieces.
+
+    spans: per batch row, a list of (start, end) half-open intervals.
+    Returns (masked_ids, labels) — labels are ignore_index outside spans.
+    """
+    ids = np.array(input_ids, copy=True)
+    labels = np.full_like(ids, ignore_index)
+    for b, row_spans in enumerate(spans):
+        for s, e in row_spans:
+            labels[b, s:e] = ids[b, s:e]
+            ids[b, s:e] = mask_token_id
+    return ids, labels

@@ -284,3 +284,58 @@ def _read_shared(name, shape, dtype, q):
     os.environ["JAX_PLATFORMS"] = "cpu"
     from paddle_tpu.incubate.multiprocessing import SharedTensor
     q.put(SharedTensor(name, shape, dtype).numpy())
+
+
+class TestModelZooAdditions:
+    def test_ernie_pretraining_step(self):
+        from paddle_tpu.models.ernie import (ErnieConfig, ErnieForPretraining,
+                                             ernie_mask_tokens)
+        from paddle_tpu import optimizer
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny()
+        model = ErnieForPretraining(cfg)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(5, cfg.vocab_size, (2, 16)).astype(np.int64)
+        masked, labels = ernie_mask_tokens(ids, [[(2, 5)], [(0, 3), (8, 10)]],
+                                           mask_token_id=3)
+        assert (masked[0, 2:5] == 3).all()
+        assert (labels[0, :2] == -100).all()
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        l0 = None
+        for _ in range(5):
+            loss = model.loss(paddle.to_tensor(masked),
+                              paddle.to_tensor(labels))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            l0 = l0 or float(loss)
+        assert float(loss) < l0
+
+    def test_deepfm_trains_on_ps(self):
+        from paddle_tpu.distributed.ps import PSServer, PSClient
+        from paddle_tpu.models.deepfm import DeepFM
+        from paddle_tpu import optimizer
+        server = PSServer(0)
+        client = PSClient([server.endpoint])
+        try:
+            paddle.seed(0)
+            model = DeepFM(num_slots=3, embedding_dim=4, hidden=16,
+                           client=client)
+            opt = optimizer.Adam(learning_rate=0.01,
+                                 parameters=model.parameters())
+            rng = np.random.default_rng(0)
+            ids = rng.integers(0, 50, (16, 3)).astype(np.int64)
+            y = ((ids.sum(1) % 2) == 0).astype(np.float32).reshape(-1, 1)
+            losses = []
+            for _ in range(25):
+                logit = model(paddle.to_tensor(ids))
+                loss = paddle.nn.functional.binary_cross_entropy_with_logits(
+                    logit, paddle.to_tensor(y))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            assert losses[-1] < losses[0], (losses[0], losses[-1])
+        finally:
+            client.stop_servers()

@@ -69,6 +69,10 @@ from . import cost_model  # noqa: E402,F401
 
 # paddle-API conveniences
 from .ops.creation import to_tensor  # noqa: E402,F401
+from .framework.dtype import dtype  # noqa: E402,F401
+bool = _dtype_mod.bool_  # noqa: E402  (paddle.bool dtype alias)
+from .framework.place import CUDAPinnedPlace, NPUPlace  # noqa: E402,F401
+from .ops.extras import batch  # noqa: E402,F401
 
 
 def enable_static():

@@ -24,6 +24,9 @@ float64 = jnp.float64
 complex64 = jnp.complex64
 complex128 = jnp.complex128
 
+# `paddle.dtype` class alias (dtypes here ARE numpy dtypes)
+dtype = jnp.dtype
+
 _STR2DTYPE = {
     "bool": bool_,
     "uint8": uint8,

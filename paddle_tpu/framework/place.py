@@ -57,9 +57,19 @@ class TPUPlace(Place):
     device_type = "tpu"
 
 
-# Alias kept for API familiarity with the reference's CUDAPlace: on this
-# framework the accelerator is a TPU.
+# Aliases kept for API familiarity with the reference's device taxonomy
+# (`platform/place.h`): on this framework the accelerator is a TPU, and
+# "pinned" host memory is ordinary host memory (XLA stages its own
+# transfers).
 CUDAPlace = TPUPlace
+NPUPlace = TPUPlace
+
+
+class CUDAPinnedPlace(Place):
+    device_type = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
 
 
 class CustomPlace(Place):

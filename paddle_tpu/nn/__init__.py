@@ -19,3 +19,10 @@ from .transformer import (  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+from .rnn import (  # noqa: F401,E402
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
+    LSTM, GRU,
+)
+from .layers_extra import *  # noqa: F401,F403,E402
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401,E402

@@ -1,0 +1,133 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference: `python/paddle/nn/decode.py` (BeamSearchDecoder over RNN cells,
+dynamic_decode loop). The decode loop runs eagerly (python while) over the
+compiled cell step — decode lengths are data-dependent, exactly the case
+XLA's static shapes push to the host; each step's compute is still jitted
+through the normal op dispatch.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..ops import _dispatch as _d
+from .layer import Layer
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers -------------------------------------------------------------
+    def _merge(self, t):
+        """[B, beam, ...] -> [B*beam, ...]"""
+        arr = t.data if isinstance(t, Tensor) else t
+        return Tensor(arr.reshape((-1,) + arr.shape[2:]))
+
+    def _split(self, t, B):
+        arr = t.data if isinstance(t, Tensor) else t
+        return arr.reshape((B, self.beam_size) + arr.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        """Tile encoder states across beams; beam 0 live, others dead."""
+        def tile(s):
+            arr = s.data if isinstance(s, Tensor) else s
+            B = arr.shape[0]
+            tiled = jnp.repeat(arr[:, None], self.beam_size, axis=1)
+            return Tensor(tiled.reshape((-1,) + arr.shape[1:]))
+        states = jax.tree_util.tree_map(
+            tile, initial_cell_states,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        arr0 = jax.tree_util.tree_leaves(states)[0]
+        B = arr0.shape[0] // self.beam_size
+        ids = np.full((B, self.beam_size), self.start_token, np.int64)
+        log_probs = np.full((B, self.beam_size), -1e9, np.float32)
+        log_probs[:, 0] = 0.0
+        finished = np.zeros((B, self.beam_size), bool)
+        return ids, states, log_probs, finished
+
+    def step(self, inputs, states):
+        """One cell step over merged [B*beam] inputs."""
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(inputs)
+        out, new_states = self.cell(inputs, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, new_states
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
+                   **kwargs):
+    """Beam-search decode loop (reference decode.py dynamic_decode).
+
+    Returns (ids [B, beam, T], final_scores [B, beam]).
+    """
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    B, K = ids.shape
+    end = decoder.end_token
+    history = []
+
+    cur_tokens = ids  # [B, K]
+    for _t in range(max_step_num):
+        merged_in = Tensor(jnp.asarray(cur_tokens.reshape(-1)))
+        logits, states = decoder.step(merged_in, states)
+        logp = np.asarray(jax.nn.log_softmax(
+            logits.data.astype(jnp.float32), axis=-1)).reshape(B, K, -1)
+        V = logp.shape[-1]
+        # finished beams only extend with end_token at zero cost
+        fin_mask = finished[:, :, None]
+        step_scores = np.where(fin_mask, -1e9, logp)
+        if np.any(finished):
+            end_col = np.zeros_like(step_scores[..., end])
+            step_scores[..., end] = np.where(finished, end_col,
+                                             step_scores[..., end])
+        total = log_probs[:, :, None] + step_scores          # [B,K,V]
+        flat = total.reshape(B, K * V)
+        top_idx = np.argpartition(-flat, K - 1, axis=1)[:, :K]
+        # order the K best
+        order = np.argsort(-np.take_along_axis(flat, top_idx, axis=1), axis=1)
+        top_idx = np.take_along_axis(top_idx, order, axis=1)
+        parent = top_idx // V
+        token = top_idx % V
+        log_probs = np.take_along_axis(flat, top_idx, axis=1)
+        finished = np.take_along_axis(finished, parent, axis=1) | \
+            (token == end)
+        history.append((token.copy(), parent.copy()))
+        cur_tokens = token
+
+        # reorder cell states by parent beam
+        def reorder(s):
+            arr = s.data if isinstance(s, Tensor) else s
+            sp = arr.reshape((B, K) + arr.shape[1:])
+            gathered = np.take_along_axis(
+                np.asarray(sp),
+                parent.reshape((B, K) + (1,) * (sp.ndim - 2)), axis=1)
+            return Tensor(jnp.asarray(
+                gathered.reshape((-1,) + arr.shape[1:])))
+        states = jax.tree_util.tree_map(
+            reorder, states, is_leaf=lambda x: isinstance(x, Tensor))
+        if finished.all():
+            break
+
+    # backtrace through parents
+    T = len(history)
+    out = np.zeros((B, K, T), np.int64)
+    beam_idx = np.broadcast_to(np.arange(K), (B, K)).copy()
+    for t in range(T - 1, -1, -1):
+        token, parent = history[t]
+        out[:, :, t] = np.take_along_axis(token, beam_idx, axis=1)
+        beam_idx = np.take_along_axis(parent, beam_idx, axis=1)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(log_probs))

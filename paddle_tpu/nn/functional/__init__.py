@@ -433,7 +433,11 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     out = _pool2d(x, kernel_size, stride, padding, "max", ceil_mode,
                   data_format=data_format, name="max_pool2d")
     if return_mask:
-        raise NotImplementedError("return_mask")
+        if data_format != "NCHW" or ceil_mode:
+            raise NotImplementedError(
+                "max_pool2d return_mask supports NCHW without ceil_mode")
+        from .extra import _pool_indices
+        return out, _pool_indices(x, kernel_size, stride, padding, 2)
     return out
 
 
@@ -1088,3 +1092,7 @@ def _collect_exports():
 
 
 __all__ = _collect_exports()
+
+
+# completion sweep (pooling3d/pad/unpool/ctc/grid_sample/...)
+from .extra import *  # noqa: F401,F403,E402

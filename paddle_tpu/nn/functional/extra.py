@@ -1,0 +1,665 @@
+"""Functional ops completing the `paddle.nn.functional` surface.
+
+Reference files cited per function; implementations are jnp/lax
+compositions (XLA fuses them) dispatched through the eager tape like every
+other op (`ops/_dispatch.call`).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import _dispatch as _d
+from ...ops._dispatch import kernel
+from ...framework.tensor import Tensor
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# ------------------------------- padding ------------------------------------
+
+_PAD_MODES = {"constant": "constant", "reflect": "reflect",
+              "replicate": "edge", "circular": "wrap"}
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """reference functional/common.py pad: `pad` is per-spatial-dim
+    [left, right, (top, bottom, (front, back))] — last dims first — or a
+    full per-dim list of len 2*ndim."""
+    nd = x.ndim if hasattr(x, "ndim") else len(x.shape)
+    pad = [int(p) for p in pad]
+
+    if len(pad) == 2 * nd:  # full form: [d0_lo, d0_hi, d1_lo, d1_hi, ...]
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        # spatial dims: last n_spatial dims for NC-first formats; pad list
+        # orders innermost dim first (W, then H, then D)
+        channel_last = data_format.endswith("C")
+        for i in range(n_spatial):
+            dim = (nd - 1 - i) - (1 if channel_last else 0)
+            widths[dim] = (pad[2 * i], pad[2 * i + 1])
+
+    @kernel("pad_nd")
+    def impl(a, *, widths=tuple(widths), mode=mode, value=value):
+        m = _PAD_MODES[mode]
+        if m == "constant":
+            return jnp.pad(a, widths, mode=m, constant_values=value)
+        return jnp.pad(a, widths, mode=m)
+    return _d.call(impl, (x,), name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, _pair(padding, 4), mode="constant", value=0.0,
+               data_format=data_format)
+
+
+# ------------------------------- pooling ------------------------------------
+
+def _pool_nd(x, kernel_size, stride, padding, nd, op, ceil_mode,
+             exclusive=True, name="pool"):
+    ks = _pair(kernel_size, nd)
+    st = _pair(stride if stride is not None else kernel_size, nd)
+    pd = _pair(padding, nd)
+
+    @kernel(name)
+    def impl(a, *, ks=ks, st=st, pd=pd, op=op, exclusive=exclusive):
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+        if op == "max":
+            init = -jnp.inf
+            out = jax.lax.reduce_window(a, init, jax.lax.max, window,
+                                        strides, pads)
+            return out
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add,
+                                  window, strides, pads)
+        if exclusive and any(pd):
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, pads)
+            return s / cnt
+        return s / float(np.prod(ks))
+    return _d.call(impl, (x,), name=name)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 3, "max", ceil_mode,
+                   name="max_pool3d")
+    if return_mask:
+        if data_format != "NCDHW" or ceil_mode:
+            raise NotImplementedError(
+                "max_pool3d return_mask supports NCDHW without ceil_mode")
+        idx = _pool_indices(x, kernel_size, stride, padding, 3)
+        return out, idx
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "avg", ceil_mode,
+                    exclusive=exclusive, name="avg_pool3d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    os = _pair(output_size, 3)
+
+    @kernel("adaptive_avg_pool3d")
+    def impl(a, *, os=os):
+        B, C, D, H, W = a.shape
+        a = a.reshape(B, C, os[0], D // os[0], os[1], H // os[1],
+                      os[2], W // os[2])
+        return a.mean(axis=(3, 5, 7))
+    return _d.call(impl, (x,), name="adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    os = int(output_size) if not isinstance(output_size, (list, tuple)) \
+        else int(output_size[0])
+
+    @kernel("adaptive_max_pool1d")
+    def impl(a, *, os=os):
+        B, C, L = a.shape
+        return a.reshape(B, C, os, L // os).max(axis=3)
+    out = _d.call(impl, (x,), name="adaptive_max_pool1d")
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool1d return_mask")
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    os = _pair(output_size, 3)
+
+    @kernel("adaptive_max_pool3d")
+    def impl(a, *, os=os):
+        B, C, D, H, W = a.shape
+        a = a.reshape(B, C, os[0], D // os[0], os[1], H // os[1],
+                      os[2], W // os[2])
+        return a.max(axis=(3, 5, 7))
+    out = _d.call(impl, (x,), name="adaptive_max_pool3d")
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool3d return_mask")
+    return out
+
+
+def _pool_indices(x, kernel_size, stride, padding, nd):
+    """Argmax indices (flat per-channel) for max_unpool, like the
+    reference's max_pool return_mask."""
+    ks = _pair(kernel_size, nd)
+    st = _pair(stride if stride is not None else kernel_size, nd)
+    pd = _pair(padding, nd)
+
+    @kernel("max_pool_indices")
+    def impl(a, *, ks=ks, st=st, pd=pd):
+        spatial = a.shape[2:]
+        flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        flat_idx = jnp.broadcast_to(flat_idx, a.shape).astype(jnp.float32)
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+
+        def sel(acc, cur):
+            acc_v, acc_i = acc
+            cur_v, cur_i = cur
+            take = cur_v > acc_v
+            return (jnp.where(take, cur_v, acc_v),
+                    jnp.where(take, cur_i, acc_i))
+        (vals, idx) = jax.lax.reduce_window(
+            (a, flat_idx), (-jnp.inf, -1.0), sel, window, strides, pads)
+        return idx.astype(jnp.int32)
+    return _d.call(impl, (x,), name="max_pool_indices", nondiff=True)
+
+
+def _max_unpool_nd(x, indices, kernel_size, stride, padding, nd,
+                   output_size=None, name="max_unpool"):
+    ks = _pair(kernel_size, nd)
+    st = _pair(stride if stride is not None else kernel_size, nd)
+    pdd = _pair(padding, nd)
+    if output_size is None:
+        # inverse of the pool output formula, INCLUDING padding — the flat
+        # indices reference the unpadded input layout
+        out_spatial = tuple(
+            (int(x.shape[2 + i]) - 1) * st[i] + ks[i] - 2 * pdd[i]
+            for i in range(nd))
+    else:
+        out_spatial = tuple(int(s) for s in output_size[-nd:])
+
+    @kernel(name)
+    def impl(a, idx, *, out_spatial=out_spatial):
+        B, C = a.shape[:2]
+        n_out = int(np.prod(out_spatial))
+        flat_v = a.reshape(B, C, -1)
+        flat_i = idx.reshape(B, C, -1).astype(jnp.int32)
+        out = jnp.zeros((B, C, n_out), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, v: o.at[i].set(v)))(out, flat_i, flat_v)
+        return out.reshape((B, C) + out_spatial)
+    return _d.call(impl, (x, indices), name=name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding, 1,
+                          output_size, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding, 2,
+                          output_size, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding, 3,
+                          output_size, "max_unpool3d")
+
+
+# -------------------------- conv transposes ---------------------------------
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       groups, dilation, nd, name):
+    st = _pair(stride, nd)
+    dil = _pair(dilation, nd)
+    pd = _pair(padding, nd)
+    opd = _pair(output_padding, nd)
+
+    @kernel(name)
+    def impl(a, w, *b, st=st, pd=pd, dil=dil, groups=groups, opd=opd):
+        k = w.shape[2:]
+        # gradient-of-conv: conv with lhs_dilation=stride
+        pads = tuple((dil[i] * (k[i] - 1) - pd[i],
+                      dil[i] * (k[i] - 1) - pd[i] + opd[i])
+                     for i in range(nd))
+        # weight (in, out/g, *k) -> flip spatial, PER-GROUP io swap (a
+        # global swap would mix groups; see conv2d_transpose)
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        if groups > 1:
+            ci = w.shape[0]
+            w_g = wt.reshape((groups, ci // groups) + w.shape[1:])
+            wt = jnp.concatenate(
+                [jnp.swapaxes(w_g[g], 0, 1) for g in range(groups)], axis=0)
+        else:
+            wt = jnp.swapaxes(wt, 0, 1)  # (out, in, *k)
+        dn = jax.lax.conv_dimension_numbers(
+            a.shape, wt.shape,
+            ("NC" + "DHW"[-nd:], "OI" + "DHW"[-nd:], "NC" + "DHW"[-nd:]))
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1,) * nd, padding=pads,
+            lhs_dilation=st, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            out = out + b[0].reshape((1, -1) + (1,) * nd)
+        return out
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return _d.call(impl, args, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 1,
+                              "conv1d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, groups, dilation, 3,
+                              "conv3d_transpose")
+
+
+# ----------------------------- fold / unfold --------------------------------
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (reference functional/common.py fold): x [B, C*kh*kw, L]."""
+    oh, ow = _pair(output_sizes, 2)
+    kh, kw = _pair(kernel_sizes, 2)
+    sh, sw = _pair(strides, 2)
+    ph, pw = _pair(paddings, 2)
+    dh, dw = _pair(dilations, 2)
+
+    @kernel("fold")
+    def impl(a, *, oh=oh, ow=ow, kh=kh, kw=kw, sh=sh, sw=sw, ph=ph, pw=pw,
+             dh=dh, dw=dw):
+        B, CKK, L = a.shape
+        C = CKK // (kh * kw)
+        n_h = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        n_w = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        a = a.reshape(B, C, kh, kw, n_h, n_w)
+        out = jnp.zeros((B, C, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[:, :, hi:hi + n_h * sh:sh,
+                             wj:wj + n_w * sw:sw].add(a[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+    return _d.call(impl, (x,), name="fold")
+
+
+# ------------------------- spatial transforms -------------------------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference vision.py affine_grid: theta [B,2,3] -> grid [B,H,W,2]."""
+    if not isinstance(out_shape, (list, tuple)):
+        out_shape = [int(s) for s in np.asarray(out_shape)]
+    B, C, H, W = [int(s) for s in out_shape]
+
+    @kernel("affine_grid")
+    def impl(th, *, H=H, W=W, align=align_corners):
+        if align:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) + 0.5) * 2.0 / W - 1.0
+            ys = (jnp.arange(H) + 0.5) * 2.0 / H - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [HW,3]
+        grid = jnp.einsum("bij,nj->bni", th, base)                # [B,HW,2]
+        return grid.reshape(th.shape[0], H, W, 2)
+    return _d.call(impl, (theta,), name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """reference vision.py grid_sample: x [B,C,H,W], grid [B,Hg,Wg,2]."""
+
+    @kernel("grid_sample")
+    def impl(a, g, *, mode=mode, pad=padding_mode, align=align_corners):
+        B, C, H, W = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align:
+            fx = (gx + 1.0) * (W - 1) / 2.0
+            fy = (gy + 1.0) * (H - 1) / 2.0
+        else:
+            fx = ((gx + 1.0) * W - 1.0) / 2.0
+            fy = ((gy + 1.0) * H - 1.0) / 2.0
+
+        def gather(ix, iy):
+            inside = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            ixc = jnp.clip(ix, 0, W - 1)
+            iyc = jnp.clip(iy, 0, H - 1)
+            vals = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(
+                a, iyc, ixc)  # [B, C, Hg, Wg]? -> img[:,yy,xx]: [C,Hg,Wg]
+            if pad == "zeros":
+                vals = vals * inside[:, None].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(fx).astype(jnp.int32),
+                          jnp.round(fy).astype(jnp.int32))
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0)[:, None]
+        wy = (fy - y0)[:, None]
+        v00 = gather(x0, y0)
+        v01 = gather(x1, y0)
+        v10 = gather(x0, y1)
+        v11 = gather(x1, y1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return top * (1 - wy) + bot * wy
+    return _d.call(impl, (x, grid), name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """reference extension.py temporal_shift (TSM video op)."""
+
+    @kernel("temporal_shift")
+    def impl(a, *, seg_num=seg_num, ratio=shift_ratio):
+        NT, C, H, W = a.shape
+        B = NT // seg_num
+        a = a.reshape(B, seg_num, C, H, W)
+        fold_c = int(C * ratio)
+        left = jnp.concatenate(
+            [a[:, 1:, :fold_c], jnp.zeros_like(a[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1, fold_c:2 * fold_c]),
+             a[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = a[:, :, 2 * fold_c:]
+        return jnp.concatenate([left, right, rest],
+                               axis=2).reshape(NT, C, H, W)
+    return _d.call(impl, (x,), name="temporal_shift")
+
+
+# ------------------------------- losses -------------------------------------
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC (reference loss.py ctc_loss over warpctc): log-semiring forward
+    over the extended label sequence, scan over time.
+
+    log_probs: [T, B, V] (time-major, reference convention); labels [B, S].
+    """
+
+    @kernel("ctc_loss")
+    def impl(logp, lab, in_len, lab_len, *, blank=blank,
+             reduction=reduction):
+        if logp.ndim == 3 and logp.shape[0] != lab.shape[0]:
+            pass  # already [T,B,V]
+        T, B, V = logp.shape
+        S = lab.shape[1]
+        logp = jax.nn.log_softmax(logp.astype(jnp.float32), axis=-1)
+        # extended labels with interleaved blanks: length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        ext_len = 2 * lab_len.astype(jnp.int32) + 1
+        NEG = -1e30
+
+        # can-skip mask: ext[s] != blank and ext[s] != ext[s-2]
+        skip_ok = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+
+        def emit(t_logp, s_ids):
+            return jnp.take_along_axis(t_logp, s_ids, axis=1)  # [B, 2S+1]
+
+        alpha0 = jnp.full((B, 2 * S + 1), NEG)
+        alpha0 = alpha0.at[:, 0].set(emit(logp[0], ext[:, :1])[:, 0])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(ext_len > 1, emit(logp[0], ext[:, 1:2])[:, 0], NEG))
+
+        def step(alpha, t_logp):
+            shift1 = jnp.concatenate(
+                [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+            shift2 = jnp.concatenate(
+                [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+            shift2 = jnp.where(skip_ok, shift2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+            return merged + emit(t_logp, ext), None
+
+        def masked_step(carry, inp):
+            alpha, t = carry
+            t_logp = inp
+            new_alpha, _ = step(alpha, t_logp)
+            # freeze rows whose sequence already ended (t >= in_len)
+            active = (t < in_len)[:, None]
+            alpha = jnp.where(active, new_alpha, alpha)
+            return (alpha, t + 1), None
+
+        (alpha, _), _ = jax.lax.scan(masked_step, (alpha0, jnp.ones((), jnp.int32)),
+                                     logp[1:])
+        idx_last = ext_len - 1
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(
+            alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1)[:, 0]
+        nll = -jnp.logaddexp(a_last, a_prev)
+        if reduction == "mean":
+            return jnp.mean(nll / jnp.maximum(lab_len.astype(jnp.float32), 1))
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+    return _d.call(impl, (log_probs, labels, input_lengths, label_lengths),
+                   name="ctc_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    @kernel("dice_loss")
+    def impl(p, y, *, eps=epsilon):
+        y1 = jax.nn.one_hot(y.squeeze(-1).astype(jnp.int32), p.shape[-1],
+                            dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1.0 - (2 * inter + eps) / (union + eps))
+    return _d.call(impl, (input, label), name="dice_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    @kernel("log_loss")
+    def impl(p, y, *, eps=epsilon):
+        return -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)
+    return _d.call(impl, (input, label), name="log_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    @kernel("npair_loss")
+    def impl(a, p, y, *, l2=l2_reg):
+        sim = a @ p.T  # [B,B]
+        same = (y[:, None] == y[None, :]).astype(sim.dtype)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(
+            -same * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2 * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1)))
+        return xent + reg
+    return _d.call(impl, (anchor, positive, labels), name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid with the default complete binary tree
+    (reference loss.py hsigmoid_loss)."""
+    code_len = int(math.ceil(math.log2(max(num_classes, 2))))
+
+    @kernel("hsigmoid_loss")
+    def impl(x, y, w, *b, num_classes=num_classes, code_len=code_len):
+        y = y.reshape(-1).astype(jnp.int32)
+        # complete binary tree, 1-indexed heap: leaf(label) = label + n,
+        # internal nodes 1..n-1 carry the classifiers. Path lengths VARY per
+        # label — mask out steps once a path has passed the root.
+        node = y + num_classes
+        nll = jnp.zeros(y.shape, x.dtype)
+        for _ in range(code_len + 1):
+            bit = (node % 2).astype(x.dtype)
+            parent = node // 2
+            valid = (parent >= 1) & (parent <= num_classes - 1)
+            widx = jnp.clip(parent - 1, 0, w.shape[0] - 1)
+            logit = jnp.sum(x * w[widx], axis=1)
+            if b:
+                logit = logit + b[0][widx]
+            term = jax.nn.softplus(logit) - bit * logit
+            nll = nll + jnp.where(valid, term, 0.0)
+            node = parent
+        return jnp.mean(nll)
+    args = (input, label, weight) if bias is None else (input, label, weight, bias)
+    return _d.call(impl, args, name="hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (reference loss.py margin_cross_entropy)."""
+
+    @kernel("margin_cross_entropy")
+    def impl(lg, y, *, m1=margin1, m2=margin2, m3=margin3, s=scale,
+             reduction=reduction):
+        y = y.reshape(-1).astype(jnp.int32)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(m1 * theta + m2) - m3
+        onehot = jax.nn.one_hot(y, lg.shape[-1], dtype=lg.dtype)
+        adj = jnp.where(onehot > 0, target, cos) * s
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        if reduction == "mean":
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+    loss = _d.call(impl, (logits, label), name="margin_cross_entropy")
+    if return_softmax:
+        from . import softmax as _softmax
+        return loss, _softmax(logits)
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """reference common.py class_center_sample (PartialFC): keep positive
+    class centers + uniform negatives; remap labels."""
+    lab = np.asarray(label.numpy() if isinstance(label, Tensor) else label
+                     ).reshape(-1)
+    pos = np.unique(lab)
+    if pos.size >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        rng = np.random.default_rng()  # fresh entropy: negatives must vary
+        extra = rng.choice(rest, size=num_samples - pos.size, replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    return (Tensor(jnp.asarray(remap[lab])),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))
+
+
+# ------------------------------ misc ----------------------------------------
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """reference common.py bilinear: out[b,o] = x1[b,i] W[o,i,j] x2[b,j]."""
+
+    @kernel("bilinear")
+    def impl(a, b_, w, *bias_):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b_)
+        if bias_:
+            out = out + bias_[0]
+        return out
+    args = (x1, x2, weight) if bias is None else (x1, x2, weight, bias)
+    return _d.call(impl, args, name="bilinear")
+
+
+def gather_tree(ids, parents):
+    """reference rnn.py gather_tree (beam search backtrace):
+    ids/parents [T, B, beam]."""
+
+    @kernel("gather_tree")
+    def impl(ids, par):
+        T = ids.shape[0]
+
+        def step(nxt, t_inp):
+            t_ids, t_par = t_inp
+            cur = jnp.take_along_axis(t_ids, nxt, axis=-1)
+            prev = jnp.take_along_axis(t_par, nxt, axis=-1)
+            return prev, cur
+        beams = jnp.broadcast_to(
+            jnp.arange(ids.shape[2]), ids.shape[1:]).astype(jnp.int32)
+        _, out_rev = jax.lax.scan(step, beams, (ids.astype(jnp.int32),
+                                                par.astype(jnp.int32)),
+                                  reverse=True)
+        return out_rev
+    return _d.call(impl, (ids, parents), name="gather_tree", nondiff=True)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset=None,
+                     sparse_csr_columns=None, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Parity entry (reference sparse_attention.py, CUDA-only): on TPU the
+    flash-attention kernel covers the memory-bound long-seq case; the block-
+    sparse pattern is ignored (dense attention is computed)."""
+    from . import scaled_dot_product_attention
+    return scaled_dot_product_attention(query, key, value,
+                                        attn_mask=attn_mask)
+
+
+# in-place activations (rebind, reference *_ ops)
+def relu_(x, name=None):
+    from . import relu
+    x.data = relu(x).data
+    return x
+
+
+def elu_(x, alpha=1.0, name=None):
+    from . import elu
+    x.data = elu(x, alpha).data
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from . import softmax
+    x.data = softmax(x, axis=axis).data
+    return x
+
+
+def tanh_(x, name=None):
+    from ...ops.math import tanh
+    x.data = tanh(x).data
+    return x
+
+
+__all__ = [
+    "pad", "zeropad2d", "max_pool3d", "avg_pool3d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool3d", "max_unpool1d",
+    "max_unpool2d", "max_unpool3d", "conv1d_transpose", "conv3d_transpose",
+    "fold", "affine_grid", "grid_sample", "temporal_shift", "ctc_loss",
+    "dice_loss", "log_loss", "npair_loss", "hsigmoid_loss",
+    "margin_cross_entropy", "class_center_sample", "bilinear", "gather_tree",
+    "sparse_attention", "relu_", "elu_", "softmax_", "tanh_",
+]

@@ -1,0 +1,382 @@
+"""Layer wrappers completing the `paddle.nn` surface (pooling 3D, padding,
+unpool, transposed convs, extra norms/losses/misc — reference
+`python/paddle/nn/layer/{pooling,common,norm,loss,distance}.py`)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import functional as F
+from .initializer import Uniform, XavierUniform
+from .layer import Layer
+from .layers_common import _ConvNd
+
+__all__ = [
+    "AvgPool3D", "MaxPool3D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "Pad1D", "Pad3D", "AlphaDropout", "Dropout3D", "InstanceNorm1D",
+    "InstanceNorm3D", "SpectralNorm", "Bilinear", "PairwiseDistance",
+    "CTCLoss", "HingeEmbeddingLoss", "HSigmoidLoss", "Conv1DTranspose",
+    "Conv3DTranspose", "UpsamplingBilinear2D", "UpsamplingNearest2D",
+    "Fold",
+]
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, ceil_mode, exclusive)
+
+    def forward(self, x):
+        k, s, p, cm, ex = self._a
+        return F.avg_pool3d(x, k, s, p, cm, ex)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCDHW", name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, ceil_mode, return_mask)
+
+    def forward(self, x):
+        k, s, p, cm, rm = self._a
+        return F.max_pool3d(x, k, s, p, cm, rm)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self._os)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._os, self._rm = output_size, return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self._os, self._rm)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._os, self._rm = output_size, return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._os, self._rm)
+
+
+class _MaxUnPoolNd(Layer):
+    ND = 2
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, os = self._a
+        fn = {1: F.max_unpool1d, 2: F.max_unpool2d, 3: F.max_unpool3d}[self.ND]
+        return fn(x, indices, k, s, p, output_size=os)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    ND = 1
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    ND = 2
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    ND = 3
+
+
+class _PadNd(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__()
+        self._padding = padding
+        self._mode = mode
+        self._value = value
+        self._fmt = data_format
+
+    def forward(self, x):
+        return F.pad(x, self._padding, self._mode, self._value, self._fmt)
+
+
+class Pad1D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        if isinstance(padding, int):
+            padding = [padding, padding]
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad3D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        if isinstance(padding, int):
+            padding = [padding] * 6
+        super().__init__(padding, mode, value, data_format)
+
+
+class AlphaDropout(Layer):
+    """reference common.py AlphaDropout (SELU-preserving dropout)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from ..framework import random as random_mod
+        from ..ops import _dispatch as _d
+
+        def impl(a, key, *, p=self.p):
+            alpha = 1.6732632423543772
+            scale = 1.0507009873554805
+            alpha_p = -alpha * scale
+            keep = jax.random.bernoulli(key, 1 - p, a.shape)
+            # variance-restoring affine (SELU paper): 1/sqrt((1-p)(1+p*a'^2))
+            a_mult = (1 - p) * (1 + p * alpha_p ** 2)
+            a_coef = a_mult ** -0.5
+            b_coef = -a_coef * p * alpha_p
+            return a_coef * (jnp.where(keep, a, alpha_p)) + b_coef
+        from ..framework.tensor import Tensor
+        key = random_mod.default_generator().split()
+        return _d.call(impl, [x, Tensor(key)], name="alpha_dropout")
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, self.training)
+
+
+class _InstanceNormNd(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 name=None):
+        super().__init__()
+        self._eps = epsilon
+        self.scale = self.create_parameter((num_features,))
+        self.scale.data = jnp.ones_like(self.scale.data)
+        self.bias = self.create_parameter((num_features,), is_bias=True)
+
+    def forward(self, x):
+        from ..ops import _dispatch as _d
+
+        def impl(a, w, b, *, eps=self._eps):
+            axes = tuple(range(2, a.ndim))
+            mean = jnp.mean(a, axis=axes, keepdims=True)
+            var = jnp.var(a, axis=axes, keepdims=True)
+            xhat = (a - mean) * jax.lax.rsqrt(var + eps)
+            shape = (1, -1) + (1,) * (a.ndim - 2)
+            return xhat * w.reshape(shape) + b.reshape(shape)
+        return _d.call(impl, [x, self.scale, self.bias], name="instance_norm")
+
+
+class InstanceNorm1D(_InstanceNormNd):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormNd):
+    pass
+
+
+class SpectralNorm(Layer):
+    """reference norm.py SpectralNorm: power-iteration spectral norm of a
+    weight (as a standalone layer transforming the given weight tensor)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._iters = power_iters
+        self._eps = eps
+        h = int(weight_shape[dim])
+        w = int(np.prod([weight_shape[i] for i in range(len(weight_shape))
+                         if i != dim]))
+        import numpy.random as npr
+        self.weight_u = self.create_parameter((h,))
+        self.weight_v = self.create_parameter((w,))
+        self.weight_u.data = jnp.asarray(
+            npr.default_rng(0).normal(size=(h,)).astype(np.float32))
+        self.weight_v.data = jnp.asarray(
+            npr.default_rng(1).normal(size=(w,)).astype(np.float32))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ..ops import _dispatch as _d
+
+        def impl(w, u, v, *, dim=self._dim, iters=self._iters, eps=self._eps):
+            wm_live = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            wm = jax.lax.stop_gradient(wm_live)  # u/v are non-differentiable
+            for _ in range(iters):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wm_live @ v  # gradient flows through sigma (torch)
+            return w / sigma, u, v
+        out, u, v = _d.call(impl, [weight, self.weight_u, self.weight_v],
+                            name="spectral_norm")
+        # persist the power-iteration state: each call refines the estimate
+        # (the reference assigns u/v back every forward)
+        self.weight_u.data = jax.lax.stop_gradient(u.data)
+        self.weight_v.data = jax.lax.stop_gradient(v.data)
+        return out
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        k = 1.0 / math.sqrt(in1_features)
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features),
+            default_initializer=Uniform(-k, k))
+        self.bias = self.create_parameter((out_features,), is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._p, self._eps, self._keep = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from ..ops import _dispatch as _d
+
+        def impl(a, b, *, p=self._p, eps=self._eps, keep=self._keep):
+            d = a - b + eps
+            return jnp.sum(jnp.abs(d) ** p, axis=-1,
+                           keepdims=keep) ** (1.0 / p)
+        return _d.call(impl, [x, y], name="pairwise_distance")
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, label):
+        from ..ops import _dispatch as _d
+
+        def impl(x, y, *, margin=self.margin, reduction=self.reduction):
+            loss = jnp.where(y == 1.0, x,
+                             jnp.maximum(0.0, margin - x))
+            if reduction == "mean":
+                return jnp.mean(loss)
+            if reduction == "sum":
+                return jnp.sum(loss)
+            return loss
+        return _d.call(impl, [input, label], name="hinge_embedding_loss")
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        k = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size),
+            default_initializer=Uniform(-k, k))
+        self.bias = self.create_parameter((num_classes - 1,), is_bias=True)
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True)
+        self._output_padding = output_padding
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._groups, self._dilation)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True)
+        self._output_padding = output_padding
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._groups, self._dilation)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._size, self._scale = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self._size, scale_factor=self._scale,
+                             mode="nearest")
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._size, self._scale = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self._size, scale_factor=self._scale,
+                             mode="bilinear", align_corners=True)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self._a)

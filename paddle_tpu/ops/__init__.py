@@ -16,6 +16,7 @@ from .creation import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .reduction import *  # noqa: F401,F403
 from .comparison import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from . import linalg_ops as linalg  # noqa: F401
 
 from . import math as _math

@@ -1,0 +1,327 @@
+"""Remaining top-level paddle.* ops (API-parity sweep against the reference
+`python/paddle/__init__.py` export list): small compositions and in-place
+variants not already covered by math/manipulation/linalg modules."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from . import _dispatch as _d
+from ._dispatch import kernel
+
+
+@kernel("add_n")
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference math.py add_n)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    return _d.call(_add_n, list(inputs))
+
+
+@kernel("broadcast_shape_probe")
+def _noop(x):
+    return x
+
+
+def broadcast_shape(x_shape, y_shape) -> List[int]:
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@kernel("cross")
+def _cross(x, y, *, axis):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else -1
+    # paddle default axis=9 means "first dim with size 3"
+    if axis == 9:
+        xs = x.shape if not isinstance(x, Tensor) else x.shape
+        for i, s in enumerate(xs):
+            if int(s) == 3:
+                ax = i
+                break
+    return _d.call(_cross, (x, y), dict(axis=ax))
+
+
+@kernel("diff")
+def _diff(x, *, n, axis):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    if prepend is None and append is None:
+        return _d.call(_diff, (x,), dict(n=n, axis=axis))
+
+    @kernel("diff_with_edges")
+    def impl(x, *edges, n=n, axis=axis, has_pre=prepend is not None):
+        parts = []
+        i = 0
+        if has_pre:
+            parts.append(edges[i]); i += 1
+        parts.append(x)
+        if i < len(edges):
+            parts.append(edges[i])
+        return jnp.diff(jnp.concatenate(parts, axis=axis), n=n, axis=axis)
+    edges = [e for e in (prepend, append) if e is not None]
+    return _d.call(impl, (x, *edges))
+
+
+@kernel("dist")
+def _dist(x, y, *, p):
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)
+
+
+def dist(x, y, p=2, name=None):
+    return _d.call(_dist, (x, y), dict(p=p))
+
+
+@kernel("increment")
+def _increment(x, *, value):
+    return x + value
+
+
+def increment(x, value=1.0, name=None):
+    out = _d.call(_increment, (x,), dict(value=value))
+    if isinstance(x, Tensor):
+        x.data = out.data  # paddle increments in place
+    return out
+
+
+@kernel("mv")
+def _mv(x, vec):
+    return x @ vec
+
+
+def mv(x, vec, name=None):
+    return _d.call(_mv, (x, vec))
+
+
+@kernel("renorm")
+def _renorm(x, *, p, axis, max_norm):
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    return _d.call(_renorm, (x,), dict(p=float(p), axis=axis,
+                                       max_norm=float(max_norm)))
+
+
+@kernel("reverse")
+def _reverse(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def reverse(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return _d.call(_reverse, (x,), dict(axis=ax))
+
+
+def rank(input, name=None):
+    t = input if isinstance(input, Tensor) else Tensor(input)
+    return Tensor(jnp.asarray(t.ndim, jnp.int32))
+
+
+@kernel("shard_index")
+def _shard_index(input, *, index_num, nshards, shard_id, ignore_value):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    inside = (input >= lo) & (input < hi)
+    return jnp.where(inside, input - lo, ignore_value)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Map global ids to shard-local ids (reference shard_index op — TP
+    vocab sharding helper)."""
+    return _d.call(_shard_index, (input,),
+                   dict(index_num=index_num, nshards=nshards,
+                        shard_id=shard_id, ignore_value=ignore_value),
+                   nondiff=True)
+
+
+@kernel("tensordot")
+def _tensordot(x, y, *, axes):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return _d.call(_tensordot, (x, y), dict(axes=axes))
+
+
+@kernel("unstack_impl")
+def _unstack(x, *, axis, num):
+    parts = jnp.split(x, num, axis=axis)
+    return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or int(x.shape[axis])
+    out = _d.call(_unstack, (x,), dict(axis=axis, num=n))
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference `paddle.batch` reader decorator."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def tolist(x) -> list:
+    return np.asarray(x.data if isinstance(x, Tensor) else x).tolist()
+
+
+def is_complex(x) -> bool:
+    from ..framework import dtype as dtype_mod
+    t = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return bool(dtype_mod.is_complex(t.dtype))
+
+
+def is_floating_point(x) -> bool:
+    from ..framework import dtype as dtype_mod
+    t = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return bool(dtype_mod.is_floating(t.dtype))
+
+
+def is_integer(x) -> bool:
+    t = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return bool(jnp.issubdtype(t.dtype, jnp.integer))
+
+
+# ------------------------- in-place variants --------------------------------
+# paddle's trailing-underscore ops rebind the tensor's array (no autograd
+# through in-place rebinding, same as the reference's inplace ops in eager
+# mode when not needed for grad)
+
+def _make_inplace(fn):
+    def inplace(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x.data = out.data
+        return x
+    return inplace
+
+
+def reshape_(x, shape, name=None):
+    from .manipulation import reshape
+    return _make_inplace(reshape)(x, shape)
+
+
+def squeeze_(x, axis=None, name=None):
+    from .manipulation import squeeze
+    return _make_inplace(squeeze)(x, axis)
+
+
+def unsqueeze_(x, axis, name=None):
+    from .manipulation import unsqueeze
+    return _make_inplace(unsqueeze)(x, axis)
+
+
+def tanh_(x, name=None):
+    from .math import tanh
+    return _make_inplace(tanh)(x)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from .manipulation import scatter
+    return _make_inplace(scatter)(x, index, updates, overwrite)
+
+
+# ------------------------------ misc ----------------------------------------
+
+_print_options = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+                  "linewidth": 80}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference framework/set_printoptions: applied to numpy rendering."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+        _print_options["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+        _print_options["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+        _print_options["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+        _print_options["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """Parity no-op: the reference installs C++ signal handlers at import;
+    this build installs none, so there is nothing to disable."""
+    return None
+
+
+def check_shape(shape):
+    for s in (shape if isinstance(shape, (list, tuple)) else [shape]):
+        if isinstance(s, int) and s < -1:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference `paddle.create_parameter` (layers/tensor.py): a free
+    Parameter outside any Layer."""
+    from ..framework.param import Parameter
+    from ..nn.initializer import XavierUniform
+    init = default_initializer or XavierUniform()
+    arr = jnp.zeros(tuple(int(s) for s in shape), dtype)
+    p = Parameter(arr, name=name)
+    try:
+        init(p)
+    except Exception:
+        pass
+    return p
+
+
+def get_cuda_rng_state():
+    from ..framework import random as random_mod
+    return random_mod.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from ..framework import random as random_mod
+    return random_mod.set_rng_state(state)
+
+
+__all__ = [
+    "add_n", "broadcast_shape", "cross", "diff", "dist", "increment", "mv",
+    "renorm", "reverse", "rank", "shard_index", "tensordot", "unstack",
+    "tolist", "is_complex", "is_floating_point", "is_integer", "reshape_",
+    "squeeze_", "unsqueeze_", "tanh_", "scatter_", "set_printoptions",
+    "disable_signal_handler", "check_shape", "create_parameter", "batch",
+    "get_cuda_rng_state", "set_cuda_rng_state",
+]

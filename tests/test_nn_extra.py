@@ -1,0 +1,355 @@
+"""Tests for the API-completion sweep: RNN family, pooling/pad extras,
+CTC and misc losses, beam-search decode, top-level extras."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn import functional as F
+
+
+class TestRNNFamily:
+    def _xy(self, B=4, T=6, I=5):
+        rng = np.random.default_rng(0)
+        return paddle.to_tensor(rng.normal(size=(B, T, I)).astype(np.float32))
+
+    def test_lstm_shapes_and_training(self):
+        paddle.seed(0)
+        x = self._xy()
+        lstm = nn.LSTM(5, 8, num_layers=2)
+        head = nn.Linear(8, 1)
+        out, (h, c) = lstm(x)
+        assert tuple(out.shape) == (4, 6, 8)
+        assert tuple(h.shape) == (2, 4, 8) and tuple(c.shape) == (2, 4, 8)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=lstm.parameters() + head.parameters())
+        y = paddle.to_tensor(np.ones((4, 1), np.float32))
+        losses = []
+        for _ in range(15):
+            out, _ = lstm(x)
+            loss = ((head(out[:, -1]) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_gru_and_simple(self):
+        x = self._xy()
+        for cls in (nn.GRU, nn.SimpleRNN):
+            m = cls(5, 8)
+            out, h = m(x)
+            assert tuple(out.shape) == (4, 6, 8)
+            assert tuple(h.shape) == (1, 4, 8)
+
+    def test_bidirectional(self):
+        x = self._xy()
+        m = nn.LSTM(5, 8, direction="bidirect")
+        out, (h, c) = m(x)
+        assert tuple(out.shape) == (4, 6, 16)
+        assert tuple(h.shape) == (2, 4, 8)
+
+    def test_cell_matches_scan(self):
+        """RNN(cell) over time == manually stepping the cell."""
+        paddle.seed(1)
+        cell = nn.LSTMCell(5, 8)
+        rnn = nn.RNN(cell)
+        x = self._xy(B=2, T=4)
+        out, (h_n, c_n) = rnn(x)
+        from paddle_tpu.ops import zeros
+        h = zeros([2, 8]); c = zeros([2, 8])
+        for t in range(4):
+            step_out, (h, c) = cell(x[:, t], (h, c))
+            np.testing.assert_allclose(out[:, t].numpy(), step_out.numpy(),
+                                       rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(h.numpy(), h_n.numpy(), rtol=2e-5,
+                                   atol=2e-5)
+
+    def test_reverse_rnn(self):
+        cell = nn.GRUCell(5, 8)
+        fwd = nn.RNN(cell)
+        rev = nn.RNN(cell, is_reverse=True)
+        x = self._xy(B=2, T=4)
+        xr = paddle.to_tensor(np.flip(x.numpy(), axis=1).copy())
+        out_rev, _ = rev(x)
+        out_fwd, _ = fwd(xr)
+        np.testing.assert_allclose(out_rev.numpy(),
+                                   np.flip(out_fwd.numpy(), axis=1),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestPadPool:
+    def test_pad_modes(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        out = F.pad(x, [1, 1, 2, 2], mode="constant", value=7.0)
+        assert tuple(out.shape) == (1, 1, 8, 6)
+        assert out.numpy()[0, 0, 0, 0] == 7.0
+        refl = F.pad(x, [1, 1, 1, 1], mode="reflect")
+        assert tuple(refl.shape) == (1, 1, 6, 6)
+        z = F.zeropad2d(x, 2)
+        assert tuple(z.shape) == (1, 1, 8, 8)
+
+    def test_pool3d(self):
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 3, 4, 4, 4)).astype(np.float32))
+        assert tuple(F.max_pool3d(x, 2).shape) == (2, 3, 2, 2, 2)
+        assert tuple(F.avg_pool3d(x, 2).shape) == (2, 3, 2, 2, 2)
+        assert tuple(F.adaptive_avg_pool3d(x, 2).shape) == (2, 3, 2, 2, 2)
+        assert tuple(nn.MaxPool3D(2)(x).shape) == (2, 3, 2, 2, 2)
+
+    def test_max_unpool2d_roundtrip(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        pooled, idx = F.max_pool2d(x, 2, return_mask=True)
+        un = F.max_unpool2d(pooled, idx, 2)
+        assert tuple(un.shape) == (1, 1, 4, 4)
+        # max of each 2x2 block restored at its original position
+        assert un.numpy()[0, 0, 1, 1] == 5.0
+        assert un.numpy()[0, 0, 0, 0] == 0.0
+
+    def test_conv_transposes(self):
+        x1 = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 3, 8)).astype(np.float32))
+        m1 = nn.Conv1DTranspose(3, 5, 3, stride=2)
+        assert m1(x1).shape[1] == 5
+        x3 = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 2, 4, 4, 4)).astype(np.float32))
+        m3 = nn.Conv3DTranspose(2, 4, 2, stride=2)
+        assert tuple(m3(x3).shape) == (1, 4, 8, 8, 8)
+
+    def test_fold(self):
+        # fold(unfold(x)) with non-overlapping patches reproduces x
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        patches = x.reshape(1, 2, 2, 2, 2).transpose(0, 1, 3, 2, 4)
+        cols = np.zeros((1, 4, 4), np.float32)  # [B, C*kh*kw, L]
+        L = 0
+        for i in range(2):
+            for j in range(2):
+                cols[0, :, L] = x[0, 0, i*2:i*2+2, j*2:j*2+2].reshape(-1)
+                L += 1
+        out = F.fold(paddle.to_tensor(cols), (4, 4), (2, 2), strides=2)
+        np.testing.assert_allclose(out.numpy()[0, 0], x[0, 0])
+
+
+class TestSpatialOps:
+    def test_affine_grid_identity_sample(self):
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 2, 5, 5)).astype(np.float32))
+        theta = paddle.to_tensor(
+            np.array([[[1.0, 0, 0], [0, 1, 0]]], np.float32))
+        grid = F.affine_grid(theta, [1, 2, 5, 5])
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_temporal_shift_shape(self):
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(6, 4, 3, 3)).astype(np.float32))
+        out = F.temporal_shift(x, seg_num=3, shift_ratio=0.25)
+        assert tuple(out.shape) == (6, 4, 3, 3)
+
+
+class TestLossesExtra:
+    def test_ctc_loss_perfect_alignment_is_low(self):
+        """Logits overwhelmingly favoring the target labeling give near-zero
+        loss; uniform logits give a clearly larger one."""
+        T, B, V = 8, 1, 5
+        labels = np.array([[1, 2, 3]], np.int64)
+        # construct a path: 1,1,2,2,3,3,blank,blank
+        path = [1, 1, 2, 2, 3, 3, 0, 0]
+        good = np.full((T, B, V), -10.0, np.float32)
+        for t, c in enumerate(path):
+            good[t, 0, c] = 10.0
+        il = np.array([T], np.int64)
+        ll = np.array([3], np.int64)
+        l_good = float(F.ctc_loss(paddle.to_tensor(good),
+                                  paddle.to_tensor(labels),
+                                  paddle.to_tensor(il),
+                                  paddle.to_tensor(ll)))
+        unif = np.zeros((T, B, V), np.float32)
+        l_unif = float(F.ctc_loss(paddle.to_tensor(unif),
+                                  paddle.to_tensor(labels),
+                                  paddle.to_tensor(il),
+                                  paddle.to_tensor(ll)))
+        assert l_good < 0.2 and l_unif > 1.0, (l_good, l_unif)
+
+    def test_ctc_loss_trains(self):
+        paddle.seed(0)
+        T, B, V = 10, 2, 6
+        net = nn.Linear(4, V)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(T, B, 4)).astype(np.float32))
+        labels = paddle.to_tensor(rng.integers(1, V, (B, 3)).astype(np.int64))
+        il = paddle.to_tensor(np.full((B,), T, np.int64))
+        ll = paddle.to_tensor(np.full((B,), 3, np.int64))
+        opt = optimizer.Adam(learning_rate=5e-2, parameters=net.parameters())
+        crit = nn.CTCLoss()
+        losses = []
+        for _ in range(25):
+            loss = crit(net(x), labels, il, ll)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_misc_losses(self):
+        rng = np.random.default_rng(0)
+        p = paddle.to_tensor(rng.random((4, 1)).astype(np.float32))
+        y = paddle.to_tensor((rng.random((4, 1)) > 0.5).astype(np.float32))
+        assert np.isfinite(float(F.log_loss(p, y).mean()))
+        probs = paddle.to_tensor(
+            np.full((2, 3), 1 / 3, np.float32))
+        lab = paddle.to_tensor(np.array([[0], [2]], np.int64))
+        assert np.isfinite(float(F.dice_loss(probs, lab)))
+        a = paddle.to_tensor(rng.normal(size=(4, 8)).astype(np.float32))
+        pos = paddle.to_tensor(rng.normal(size=(4, 8)).astype(np.float32))
+        lbl = paddle.to_tensor(np.array([0, 1, 0, 2], np.int64))
+        assert np.isfinite(float(F.npair_loss(a, pos, lbl)))
+        hel = nn.HingeEmbeddingLoss()
+        assert np.isfinite(float(hel(a, paddle.to_tensor(
+            np.sign(rng.normal(size=(4, 8))).astype(np.float32)))))
+
+    def test_hsigmoid_trains(self):
+        paddle.seed(0)
+        m = nn.HSigmoidLoss(8, 10)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(4, 8)).astype(np.float32), stop_gradient=False)
+        lab = paddle.to_tensor(np.array([1, 3, 5, 7], np.int64))
+        loss = m(x, lab)
+        loss.backward()
+        assert x.grad is not None
+
+
+class TestBeamSearch:
+    def test_decode_prefers_high_prob_tokens(self):
+        paddle.seed(0)
+        V, H = 8, 16
+
+        class BiasCell(nn.Layer):
+            """Cell whose logits always favor token 5 then EOS (7)."""
+
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(V, H)
+                self.cell = nn.GRUCell(H, H)
+                self.out = nn.Linear(H, V)
+
+            def forward(self, tokens, states):
+                h = self.emb(tokens)
+                out, new_s = self.cell(h, states)
+                logits = self.out(out)
+                return logits, new_s
+
+        cell = BiasCell()
+        # bias the output layer hard toward token 5
+        b = np.zeros(V, np.float32)
+        b[5] = 8.0
+        cell.out.bias.set_value(b)
+        from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+        from paddle_tpu.ops import zeros
+        dec = BeamSearchDecoder(cell, start_token=1, end_token=7, beam_size=3)
+        ids, scores = dynamic_decode(dec, inits=zeros([2, 16]),
+                                     max_step_num=5)
+        assert tuple(ids.shape)[:2] == (2, 3)
+        assert (ids.numpy()[:, 0] == 5).all()  # best beam rides token 5
+
+
+class TestTopLevelExtras:
+    def test_assorted(self):
+        x = paddle.to_tensor(np.array([[1.0, 2], [3, 4]], np.float32))
+        y = paddle.to_tensor(np.array([[1.0, 1], [1, 1]], np.float32))
+        np.testing.assert_allclose(paddle.add_n([x, y]).numpy(),
+                                   [[2, 3], [4, 5]])
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        np.testing.assert_allclose(
+            float(paddle.dist(x, y)), np.linalg.norm((x.numpy() - 1).ravel()))
+        v = paddle.to_tensor(np.array([1.0, 0], np.float32))
+        np.testing.assert_allclose(paddle.mv(x, v).numpy(), [1, 3])
+        assert paddle.rank(x).numpy() == 2
+        assert paddle.tolist(x) == [[1.0, 2.0], [3.0, 4.0]]
+        parts = paddle.unstack(x, axis=0)
+        assert len(parts) == 2
+        td = paddle.tensordot(x, y, axes=1)
+        assert tuple(td.shape) == (2, 2)
+        d = paddle.diff(paddle.to_tensor(np.array([1.0, 3, 6], np.float32)))
+        np.testing.assert_allclose(d.numpy(), [2, 3])
+        assert paddle.is_floating_point(x) and not paddle.is_complex(x)
+
+    def test_inplace_variants(self):
+        x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        paddle.reshape_(x, [3, 2])
+        assert tuple(x.shape) == (3, 2)
+        paddle.tanh_(x)
+        np.testing.assert_allclose(x.numpy(), np.zeros((3, 2)))
+        paddle.increment(x, 2.0)
+        np.testing.assert_allclose(x.numpy(), np.full((3, 2), 2.0))
+
+    def test_shard_index(self):
+        ids = paddle.to_tensor(np.array([0, 5, 9, 13], np.int64))
+        out = paddle.shard_index(ids, index_num=16, nshards=2, shard_id=0)
+        np.testing.assert_array_equal(out.numpy(), [0, 5, -1, -1])
+        out1 = paddle.shard_index(ids, index_num=16, nshards=2, shard_id=1)
+        np.testing.assert_array_equal(out1.numpy(), [-1, -1, 1, 5])
+
+
+class TestReviewRegressions:
+    def test_grouped_conv1d_transpose(self):
+        paddle.seed(0)
+        m = nn.Conv1DTranspose(4, 4, 3, stride=2, groups=2)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 4, 8)).astype(np.float32))
+        out = m(x)
+        assert out.shape[1] == 4
+        # group isolation: zeroing group-2 input must not change group-1 out
+        x2 = x.numpy().copy()
+        x2[:, 2:, :] = 0
+        out2 = m(paddle.to_tensor(x2))
+        np.testing.assert_allclose(out.numpy()[:, :2], out2.numpy()[:, :2],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_max_unpool_with_padding(self):
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 1, 6, 6)).astype(np.float32))
+        pooled, idx = F.max_pool2d(x, 3, stride=2, padding=1,
+                                   return_mask=True)
+        # even input sizes are ambiguous under the inverse formula (as in
+        # torch) — pass output_size explicitly
+        un = F.max_unpool2d(pooled, idx, 3, stride=2, padding=1,
+                            output_size=(6, 6))
+        assert tuple(un.shape) == (1, 1, 6, 6)
+        # default formula case: odd input, (in-1)*s + k - 2p == in
+        x5 = paddle.to_tensor(np.random.default_rng(1).normal(
+            size=(1, 1, 5, 5)).astype(np.float32))
+        p5, i5 = F.max_pool2d(x5, 3, stride=2, padding=1, return_mask=True)
+        u5 = F.max_unpool2d(p5, i5, 3, stride=2, padding=1)
+        assert tuple(u5.shape) == (1, 1, 5, 5)
+
+    def test_lstm_initial_states_used(self):
+        paddle.seed(0)
+        m = nn.LSTM(4, 6)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 3, 4)).astype(np.float32))
+        h0 = paddle.to_tensor(np.ones((1, 2, 6), np.float32) * 5)
+        c0 = paddle.to_tensor(np.ones((1, 2, 6), np.float32) * 5)
+        out_zero, _ = m(x)
+        out_init, _ = m(x, (h0, c0))
+        assert not np.allclose(out_zero.numpy(), out_init.numpy())
+
+    def test_hsigmoid_non_power_of_two(self):
+        paddle.seed(0)
+        m = nn.HSigmoidLoss(6, 5)  # 5 classes: path lengths differ
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(5, 6)).astype(np.float32))
+        lab = paddle.to_tensor(np.arange(5, dtype=np.int64))
+        loss = m(x, lab)
+        assert np.isfinite(float(loss))
+
+    def test_spectral_norm_converges(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(8, 8)).astype(np.float32)
+        sn = nn.SpectralNorm((8, 8), power_iters=1)
+        for _ in range(30):  # persisted u/v: repeated calls converge
+            out = sn(paddle.to_tensor(w))
+        sigma_true = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(np.asarray(out.numpy()) * sigma_true, w,
+                                   rtol=5e-2, atol=5e-2)

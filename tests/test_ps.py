@@ -235,7 +235,8 @@ class TestPSCluster:
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
         outs = []
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            # generous: the full-suite run can load the machine heavily
+            out, _ = p.communicate(timeout=420)
             outs.append(out.decode())
         for p, out in zip(procs, outs):
             assert p.returncode == 0, f"proc failed:\n{out}"

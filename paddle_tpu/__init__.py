@@ -70,7 +70,12 @@ from . import cost_model  # noqa: E402,F401
 # paddle-API conveniences
 from .ops.creation import to_tensor  # noqa: E402,F401
 from .framework.dtype import dtype  # noqa: E402,F401
-bool = _dtype_mod.bool_  # noqa: E402  (paddle.bool dtype alias)
+# `paddle.bool` dtype alias is served by module __getattr__ (PEP 562) so
+# the BUILTIN bool stays intact inside this module's own functions
+def __getattr__(name):
+    if name == "bool":
+        return _dtype_mod.bool_
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 from .framework.place import CUDAPinnedPlace, NPUPlace  # noqa: E402,F401
 from .ops.extras import batch  # noqa: E402,F401
 

@@ -318,3 +318,66 @@ def load(path, **configs):
 
 
 not_to_static = lambda fn: fn  # parity no-op
+
+
+# --------------------- completion: remaining jit exports --------------------
+
+TranslatedLayer = None  # class is created per-load; exposed for isinstance
+
+
+def _get_translated_layer_class():
+    return TranslatedLayer
+
+
+class TracedLayer:
+    """reference jit TracedLayer (dygraph trace -> static program)."""
+
+    def __init__(self, program, parameters):
+        self._program = program
+        self._params = parameters
+
+    @staticmethod
+    def trace(layer, inputs):
+        st = to_static(layer)
+        out = st(*inputs)
+        return out, TracedLayer(st, layer.parameters())
+
+    def __call__(self, *inputs):
+        return self._program(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        save(self._program._layer if hasattr(self._program, "_layer")
+             else self._program, path)
+
+
+class ProgramTranslator:
+    """reference dy2static ProgramTranslator singleton: toggles to_static
+    globally (tracing-based here, so 'enable' simply gates conversion)."""
+
+    _instance = None
+    enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        type(self).enabled = bool(enable_to_static)
+
+
+def enable_to_static(flag: bool = True):
+    ProgramTranslator.get_instance().enable(flag)
+
+
+_verbosity = 0
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    set_verbosity(level, also_to_stdout)

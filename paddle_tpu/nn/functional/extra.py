@@ -70,12 +70,24 @@ def _pool_nd(x, kernel_size, stride, padding, nd, op, ceil_mode,
     ks = _pair(kernel_size, nd)
     st = _pair(stride if stride is not None else kernel_size, nd)
     pd = _pair(padding, nd)
+    # ceil_mode: extend the high-side padding so the output size ceils
+    # (the extra positions only see pad values, which the avg path excludes
+    # from its divisor via the count window)
+    hi_extra = [0] * nd
+    if ceil_mode:
+        for i in range(nd):
+            size = int(x.shape[2 + i])
+            out_ceil = -(-(size + 2 * pd[i] - ks[i]) // st[i]) + 1
+            need = (out_ceil - 1) * st[i] + ks[i] - (size + 2 * pd[i])
+            hi_extra[i] = max(0, need)
+    hi_extra = tuple(hi_extra)
 
     @kernel(name)
-    def impl(a, *, ks=ks, st=st, pd=pd, op=op, exclusive=exclusive):
+    def impl(a, *, ks=ks, st=st, pd=pd, op=op, exclusive=exclusive,
+             hi=hi_extra):
         window = (1, 1) + ks
         strides = (1, 1) + st
-        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+        pads = ((0, 0), (0, 0)) + tuple((p, p + h) for p, h in zip(pd, hi))
         if op == "max":
             init = -jnp.inf
             out = jax.lax.reduce_window(a, init, jax.lax.max, window,
@@ -83,7 +95,7 @@ def _pool_nd(x, kernel_size, stride, padding, nd, op, ceil_mode,
             return out
         s = jax.lax.reduce_window(a, 0.0, jax.lax.add,
                                   window, strides, pads)
-        if exclusive and any(pd):
+        if exclusive and (any(pd) or any(hi)):
             ones = jnp.ones_like(a)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
                                         strides, pads)
@@ -229,11 +241,24 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
 # -------------------------- conv transposes ---------------------------------
 
 def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
-                       groups, dilation, nd, name):
+                       groups, dilation, nd, name, output_size=None):
     st = _pair(stride, nd)
     dil = _pair(dilation, nd)
     pd = _pair(padding, nd)
-    opd = _pair(output_padding, nd)
+    opd = list(_pair(output_padding, nd))
+    if output_size is not None:
+        want = _pair(output_size, nd)
+        for i in range(nd):
+            k = int(weight.shape[2 + i])
+            default = (int(x.shape[2 + i]) - 1) * st[i] \
+                + dil[i] * (k - 1) + 1 - 2 * pd[i]
+            extra = want[i] - default
+            if not (0 <= extra < st[i] or (extra == 0 and st[i] == 1)):
+                raise ValueError(
+                    f"conv_transpose output_size[{i}]={want[i]} unreachable "
+                    f"(default {default}, stride {st[i]})")
+            opd[i] = extra
+    opd = tuple(opd)
 
     @kernel(name)
     def impl(a, w, *b, st=st, pd=pd, dil=dil, groups=groups, opd=opd):
@@ -271,7 +296,7 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_size=None, data_format="NCL", name=None):
     return _conv_transpose_nd(x, weight, bias, stride, padding,
                               output_padding, groups, dilation, 1,
-                              "conv1d_transpose")
+                              "conv1d_transpose", output_size=output_size)
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
@@ -279,7 +304,7 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_size=None, data_format="NCDHW", name=None):
     return _conv_transpose_nd(x, weight, bias, stride, padding,
                               output_padding, groups, dilation, 3,
-                              "conv3d_transpose")
+                              "conv3d_transpose", output_size=output_size)
 
 
 # ----------------------------- fold / unfold --------------------------------

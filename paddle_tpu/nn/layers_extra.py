@@ -332,7 +332,8 @@ class Conv1DTranspose(_ConvNd):
     def forward(self, x, output_size=None):
         return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
                                   self._padding, self._output_padding,
-                                  self._groups, self._dilation)
+                                  self._groups, self._dilation,
+                                  output_size=output_size)
 
 
 class Conv3DTranspose(_ConvNd):
@@ -347,7 +348,8 @@ class Conv3DTranspose(_ConvNd):
     def forward(self, x, output_size=None):
         return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
                                   self._padding, self._output_padding,
-                                  self._groups, self._dilation)
+                                  self._groups, self._dilation,
+                                  output_size=output_size)
 
 
 class UpsamplingNearest2D(Layer):

@@ -55,33 +55,67 @@ def _gru_step(x_t, h, wi, wh, bi, bh):
     return u * h + (1.0 - u) * c
 
 
-def _scan_layer(mode, x, h0, c0, wi, wh, bi, bh, reverse, act):
-    """x [B,T,I] -> (outputs [B,T,H], (h_n, c_n))."""
+def _reverse_sequence(x, lengths):
+    """Per-row reversal of the VALID prefix: out[b,t] = x[b, len_b-1-t] for
+    t < len_b, else x[b,t] (padding stays in place)."""
+    T = x.shape[1]
+    t_idx = jnp.arange(T)[None, :]
+    lens = lengths[:, None].astype(jnp.int32)
+    src = jnp.where(t_idx < lens, lens - 1 - t_idx, t_idx)
+    return jnp.take_along_axis(x, src[:, :, None], axis=1)
+
+
+def _scan_layer(mode, x, h0, c0, wi, wh, bi, bh, reverse, act, lengths=None):
+    """x [B,T,I] -> (outputs [B,T,H], (h_n, c_n)). With `lengths` [B],
+    steps past each row's length are masked: the state freezes (final state
+    = state at t=len-1) and the padded outputs are zero, matching the
+    reference's variable-length semantics; the reverse direction reverses
+    only the valid prefix."""
+    prefix_reversed = False
+    if lengths is not None and reverse:
+        x = _reverse_sequence(x, lengths)
+        reverse = False  # valid-prefix reversal replaces the plain flip
+        prefix_reversed = True
     xt = jnp.swapaxes(x, 0, 1)  # [T,B,I]
     if reverse:
         xt = jnp.flip(xt, axis=0)
 
+    def masked(t, new, old):
+        if lengths is None:
+            return new
+        alive = (t < lengths.astype(jnp.int32))[:, None]
+        return jnp.where(alive, new, old)
+
+    ts = jnp.arange(xt.shape[0])
     if mode == "LSTM":
-        def step(carry, x_t):
+        def step(carry, inp):
+            t, x_t = inp
             h, c = carry
             h2, c2 = _lstm_step(x_t, h, c, wi, wh, bi, bh)
-            return (h2, c2), h2
-        (h_n, c_n), ys = jax.lax.scan(step, (h0, c0), xt)
+            h2, c2 = masked(t, h2, h), masked(t, c2, c)
+            return (h2, c2), masked(t, h2, jnp.zeros_like(h2))
+        (h_n, c_n), ys = jax.lax.scan(step, (h0, c0), (ts, xt))
     elif mode == "GRU":
-        def step(h, x_t):
-            h2 = _gru_step(x_t, h, wi, wh, bi, bh)
-            return h2, h2
-        h_n, ys = jax.lax.scan(step, h0, xt)
+        def step(h, inp):
+            t, x_t = inp
+            h2 = masked(t, _gru_step(x_t, h, wi, wh, bi, bh), h)
+            return h2, masked(t, h2, jnp.zeros_like(h2))
+        h_n, ys = jax.lax.scan(step, h0, (ts, xt))
         c_n = h_n
     else:
-        def step(h, x_t):
-            h2 = _simple_step(x_t, h, wi, wh, bi, bh, act)
-            return h2, h2
-        h_n, ys = jax.lax.scan(step, h0, xt)
+        def step(h, inp):
+            t, x_t = inp
+            h2 = masked(t, _simple_step(x_t, h, wi, wh, bi, bh, act), h)
+            return h2, masked(t, h2, jnp.zeros_like(h2))
+        h_n, ys = jax.lax.scan(step, h0, (ts, xt))
         c_n = h_n
     if reverse:
         ys = jnp.flip(ys, axis=0)
-    return jnp.swapaxes(ys, 0, 1), h_n, c_n
+    ys = jnp.swapaxes(ys, 0, 1)
+    if prefix_reversed:
+        # re-align outputs with the ORIGINAL time order
+        ys = _reverse_sequence(ys, lengths)
+    return ys, h_n, c_n
 
 
 # ------------------------------- cells --------------------------------------
@@ -183,6 +217,11 @@ class RNN(Layer):
         if self.time_major:
             from ..ops import transpose
             x = transpose(x, [1, 0, 2])
+        # the fused lax.scan path hardcodes the builtin cells' gate math —
+        # custom/subclassed cells must run through their own forward()
+        fused = type(self.cell) in (LSTMCell, GRUCell, SimpleRNNCell)
+        if not fused:
+            return self._generic_loop(x, initial_states, sequence_length)
         mode = ("LSTM" if isinstance(self.cell, LSTMCell)
                 else "GRU" if isinstance(self.cell, GRUCell) else "RNN")
         act = getattr(self.cell, "activation", "tanh")
@@ -196,18 +235,46 @@ class RNN(Layer):
         else:
             h0, c0 = initial_states, initial_states
 
-        def impl(x, h0, c0, wi, wh, bi, bh, *, mode=mode, rev=self.is_reverse,
-                 act=act):
-            return _scan_layer(mode, x, h0, c0, wi, wh, bi, bh, rev, act)
+        tensors = [x, h0, c0, self.cell.weight_ih, self.cell.weight_hh,
+                   self.cell.bias_ih, self.cell.bias_hh]
+        has_len = sequence_length is not None
+        if has_len:
+            tensors.append(sequence_length)
 
-        ys, h_n, c_n = _dispatch.call(
-            impl, [x, h0, c0, self.cell.weight_ih, self.cell.weight_hh,
-                   self.cell.bias_ih, self.cell.bias_hh], name="rnn_scan")
+        def impl(x, h0, c0, wi, wh, bi, bh, *rest, mode=mode,
+                 rev=self.is_reverse, act=act, has_len=has_len):
+            lengths = rest[0] if has_len else None
+            return _scan_layer(mode, x, h0, c0, wi, wh, bi, bh, rev, act,
+                               lengths=lengths)
+
+        ys, h_n, c_n = _dispatch.call(impl, tensors, name="rnn_scan")
         if self.time_major:
             from ..ops import transpose
             ys = transpose(ys, [1, 0, 2])
         final = (h_n, c_n) if mode == "LSTM" else h_n
         return ys, final
+
+    def _generic_loop(self, x, initial_states, sequence_length):
+        """Eager per-step loop through cell.forward (custom cells)."""
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "sequence_length with a custom cell is unsupported")
+        from ..ops import stack, transpose
+        T = int(x.shape[1])
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in order:
+            step_in = x[:, t]
+            if states is None:
+                out, states = self.cell(step_in)
+            else:
+                out, states = self.cell(step_in, states)
+            outs[t] = out
+        ys = stack(outs, axis=1)
+        if self.time_major:
+            ys = transpose(ys, [1, 0, 2])
+        return ys, states
 
 
 class BiRNN(Layer):
@@ -286,10 +353,12 @@ class _StackedRNN(Layer):
         from . import functional as F
         for l in range(self.num_layers):
             y_fw, s_fw = self._layers_fw[l](
-                x, self._layer_states(initial_states, l, 0))
+                x, self._layer_states(initial_states, l, 0),
+                sequence_length=sequence_length)
             if self.bidirectional:
                 y_bw, s_bw = self._layers_bw[l](
-                    x, self._layer_states(initial_states, l, 1))
+                    x, self._layer_states(initial_states, l, 1),
+                    sequence_length=sequence_length)
                 x = concat([y_fw, y_bw], axis=-1)
                 for s in (s_fw, s_bw):
                     if self.MODE == "LSTM":

@@ -29,11 +29,6 @@ def add_n(inputs, name=None):
     return _d.call(_add_n, list(inputs))
 
 
-@kernel("broadcast_shape_probe")
-def _noop(x):
-    return x
-
-
 def broadcast_shape(x_shape, y_shape) -> List[int]:
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
 
@@ -44,14 +39,14 @@ def _cross(x, y, *, axis):
 
 
 def cross(x, y, axis=9, name=None):
-    ax = axis if axis != 9 else -1
-    # paddle default axis=9 means "first dim with size 3"
-    if axis == 9:
-        xs = x.shape if not isinstance(x, Tensor) else x.shape
-        for i, s in enumerate(xs):
+    ax = -1
+    if axis == 9:  # paddle default: first dim with size 3
+        for i, s in enumerate(x.shape):
             if int(s) == 3:
                 ax = i
                 break
+    else:
+        ax = axis
     return _d.call(_cross, (x, y), dict(axis=ax))
 
 

@@ -233,3 +233,68 @@ def householder_product(x, tau, name=None):
             q = body(i, q)
         return q[:, :n]
     return _d.call(impl, (x, tau), name="householder_product")
+
+
+def multi_dot(x, name=None):
+    """Chain matmul with optimal association order (reference linalg
+    multi_dot -> np.linalg.multi_dot)."""
+    def impl(*mats):
+        # optimal parenthesization (matrix-chain DP over the static shapes),
+        # then apply — the classic multi_dot contract
+        dims = [mats[0].shape[0]] + [m.shape[1] for m in mats]
+        n = len(mats)
+        if n == 1:
+            return mats[0]
+        cost = [[0] * n for _ in range(n)]
+        split = [[0] * n for _ in range(n)]
+        for ln in range(2, n + 1):
+            for i in range(n - ln + 1):
+                j = i + ln - 1
+                cost[i][j] = float("inf")
+                for k in range(i, j):
+                    c = (cost[i][k] + cost[k + 1][j]
+                         + dims[i] * dims[k + 1] * dims[j + 1])
+                    if c < cost[i][j]:
+                        cost[i][j] = c
+                        split[i][j] = k
+
+        def mult(i, j):
+            if i == j:
+                return mats[i]
+            k = split[i][j]
+            return mult(i, k) @ mult(k + 1, j)
+        return mult(0, n - 1)
+    from . import _dispatch as _d
+    return _d.call(impl, list(x), name="multi_dot")
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack combined LU factors + pivots (reference linalg lu_unpack)."""
+    import jax.numpy as jnp
+
+    def impl(lu, piv, *, unpack_ludata=unpack_ludata,
+             unpack_pivots=unpack_pivots):
+        m, n = lu.shape[-2], lu.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(m, k, dtype=lu.dtype)
+        U = jnp.triu(lu[..., :k, :])
+        # pivots (1-indexed sequential swaps) -> permutation matrix
+        def perm_of(pv):
+            perm = jnp.arange(m)
+            def body(i, p):
+                j = pv[i] - 1
+                pi, pj = p[i], p[j]
+                return p.at[i].set(pj).at[j].set(pi)
+            import jax
+            perm = jax.lax.fori_loop(0, pv.shape[0], body, perm)
+            return jnp.eye(m, dtype=lu.dtype)[perm]
+        if piv.ndim == 1:
+            P = perm_of(piv.astype(jnp.int32))
+        else:
+            import jax
+            P = jax.vmap(perm_of)(piv.astype(jnp.int32).reshape(
+                -1, piv.shape[-1])).reshape(piv.shape[:-1] + (m, m))
+        return P, L, U
+    from . import _dispatch as _d
+    return _d.call(impl, (lu_data, lu_pivots), name="lu_unpack")

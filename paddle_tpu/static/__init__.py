@@ -747,3 +747,247 @@ __all__ = [
 
 # paddle.static.sparsity parity (reference exposes ASP here)
 from ..incubate import asp as sparsity  # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# completion sweep: remaining paddle.static exports (reference
+# python/paddle/static/__init__.py __all__)
+# ---------------------------------------------------------------------------
+import pickle as _pickle
+
+import numpy as _np
+import jax.numpy as _jnp
+
+
+def cpu_places(device_count=None):
+    import jax
+    n = device_count or len([d for d in jax.devices("cpu")]) or 1
+    from ..framework.place import CPUPlace
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (TPU chips here)."""
+    import jax
+    from ..framework.place import TPUPlace
+    try:
+        devs = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+    except Exception:
+        devs = []
+    ids = device_ids if device_ids is not None else range(len(devs))
+    return [TPUPlace(i) for i in ids]
+
+
+xpu_places = cuda_places
+npu_places = cuda_places
+mlu_places = cuda_places
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    key = name or f"global_var_{len(_global_scope.vars)}"
+    arr = _jnp.full(tuple(int(s) for s in shape), value, dtype)
+    _global_scope.vars[key] = arr  # scope keys are ALWAYS strings
+    from ..framework.tensor import Tensor
+    t = Tensor(arr, name=key)
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..ops.extras import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static autodiff entry (reference static/gradients): wraps
+    append_backward's machinery for explicit target/input pairs."""
+    from ..framework import tape as _tape
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _tape.grad(list(ts), list(xs), grad_outputs=target_gradients,
+                      allow_unused=True)
+
+
+def name_scope(prefix=None):
+    """Graph-visualization name scope (no-op context, reference
+    framework.name_scope)."""
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def device_guard(device=None):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug print op (reference static/nn/common.py Print)."""
+    def _cb(t):
+        print(message or "", t)
+        return t
+    from .. import ops
+    if hasattr(input, "data"):
+        print(message or "", _np.asarray(input.data) if not hasattr(
+            input, "_prog") else input)
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op (reference static/nn/common.py py_func): under our
+    eager-capture static mode this is a direct call."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return func(*xs)
+
+
+# -- program/persistable serialization (reference static/io.py) -------------
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    prog = program or default_main_program()
+    return _pickle.dumps({"n_ops": len(getattr(prog, "ops", [])),
+                          "params": {k: _np.asarray(v) for k, v in
+                                     getattr(prog, "params", {}).items()}})
+
+
+def deserialize_program(data):
+    return _pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    scope = _global_scope
+    return _pickle.dumps({k: _np.asarray(v) for k, v in scope.vars.items()
+                          if v is not None})
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = _pickle.loads(data)
+    for k, v in state.items():
+        _global_scope.vars[k] = _jnp.asarray(v)
+    return state
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_prefix, protocol=4, **configs):
+    """reference static/io.py save: params + program structure."""
+    save_to_file(model_prefix + ".pdparams",
+                 serialize_persistables(None, None, program))
+    save_to_file(model_prefix + ".pdmodel.meta",
+                 serialize_program(None, None, program))
+
+
+def load(program, model_prefix, executor=None, var_list=None):
+    deserialize_persistables(
+        program, load_from_file(model_prefix + ".pdparams"), executor)
+
+
+def load_program_state(model_prefix, var_list=None):
+    return {k: _np.asarray(v) for k, v in _pickle.loads(
+        load_from_file(model_prefix + ".pdparams")).items()}
+
+
+def set_program_state(program, state_dict):
+    for k, v in state_dict.items():
+        _global_scope.vars[k] = _jnp.asarray(v)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Static accuracy op (reference static/nn/metric.py)."""
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..metric import Auc
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(input, label)
+    from ..framework.tensor import Tensor
+    return Tensor(_jnp.asarray(m.accumulate(), _jnp.float32))
+
+
+class WeightNormParamAttr:
+    """Parity config object (reference WeightNormParamAttr)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters for eval (reference static ExponentialMovingAverage);
+    works over the global scope's current parameter arrays."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+
+    def update(self):
+        for k, v in _global_scope.vars.items():
+            if v is None:
+                continue
+            prev = self._ema.get(k)
+            self._ema[k] = (_jnp.asarray(v) if prev is None
+                            else self._decay * prev + (1 - self._decay)
+                            * _jnp.asarray(v))
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._backup = dict(_global_scope.vars)
+            for k, v in self._ema.items():
+                _global_scope.vars[k] = v
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._backup:
+            _global_scope.vars.update(self._backup)
+            self._backup = {}
+
+
+class IpuStrategy:  # no IPU on this target; config shell for portability
+    def __init__(self):
+        self.num_ipus = 0
+
+    def set_graph_config(self, *a, **k):
+        pass
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        self.program = program
+
+    def compile(self, *a, **k):
+        return self.program
+
+
+ParallelExecutor = CompiledProgram  # legacy alias: XLA partitions instead

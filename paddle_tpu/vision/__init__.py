@@ -2,3 +2,31 @@
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
+
+
+# image backend selection (reference vision/image.py): PIL-free environment,
+# numpy/cv2-style arrays are the one backend
+_image_backend = "cv2"
+
+
+def set_image_backend(backend: str):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file to an array (HWC uint8)."""
+    import numpy as np
+    try:
+        from PIL import Image  # noqa
+        return np.asarray(Image.open(path))
+    except ImportError:
+        raise RuntimeError(
+            "no image decoding library in this environment; pass arrays "
+            "directly or decode with your own loader")

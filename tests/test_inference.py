@@ -21,8 +21,11 @@ def _export_static_mlp(tmp_path):
         exe = static.Executor()
         exe.run(startup)
         scope = static.global_scope()
-        wname = [n for n in scope.vars if "_w_" in n][0]
-        bname = [n for n in scope.vars if "_b_" in n][0]
+        # restrict to THIS program's params: the global scope accumulates
+        # vars from other tests in the same process
+        own = set(main.params.keys())
+        wname = [n for n in own if "_w_" in n][0]
+        bname = [n for n in own if "_b_" in n][0]
         W = np.asarray(scope.vars[wname])
         b = np.asarray(scope.vars[bname])
         prefix = str(tmp_path / "model")

@@ -353,3 +353,47 @@ class TestReviewRegressions:
         sigma_true = np.linalg.svd(w, compute_uv=False)[0]
         np.testing.assert_allclose(np.asarray(out.numpy()) * sigma_true, w,
                                    rtol=5e-2, atol=5e-2)
+
+    def test_sequence_length_masking(self):
+        paddle.seed(0)
+        m = nn.LSTM(4, 6)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 5, 4)).astype(np.float32))
+        lens = paddle.to_tensor(np.array([2, 5], np.int64))
+        out, (h, c) = m(x, sequence_length=lens)
+        o = out.numpy()
+        assert np.allclose(o[0, 2:], 0.0)      # padded steps zeroed
+        assert not np.allclose(o[1, 2:], 0.0)  # full row unaffected
+
+    def test_custom_cell_generic_loop(self):
+        class NormCell(nn.SimpleRNNCell):
+            def forward(self, inputs, states=None):
+                out, st = super().forward(inputs, states)
+                return out * 2.0, st
+        paddle.seed(0)
+        cell = NormCell(4, 6)
+        rnn = nn.RNN(cell)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 3, 4)).astype(np.float32))
+        out, _ = rnn(x)
+        # the override IS honored (fused scan would ignore the *2)
+        assert tuple(out.shape) == (2, 3, 6)
+
+    def test_ceil_mode_pool3d(self):
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 1, 5, 5, 5)).astype(np.float32))
+        out = F.max_pool3d(x, 2, stride=2, ceil_mode=True)
+        assert tuple(out.shape) == (1, 1, 3, 3, 3)
+        out_f = F.max_pool3d(x, 2, stride=2, ceil_mode=False)
+        assert tuple(out_f.shape) == (1, 1, 2, 2, 2)
+
+    def test_conv_transpose_output_size(self):
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 3, 8)).astype(np.float32))
+        m = nn.Conv1DTranspose(3, 2, 3, stride=2)
+        # default output length is (8-1)*2 + 3 = 17; stride 2 also reaches 18
+        out = m(x, output_size=[18])
+        assert out.shape[-1] == 18
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="unreachable"):
+            m(x, output_size=[16])

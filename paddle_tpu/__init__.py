@@ -66,6 +66,8 @@ from . import sysconfig  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 from . import cost_model  # noqa: E402,F401
+from . import ops as tensor  # noqa: E402,F401  (paddle.tensor namespace)
+from . import version  # noqa: E402,F401
 
 # paddle-API conveniences
 from .ops.creation import to_tensor  # noqa: E402,F401

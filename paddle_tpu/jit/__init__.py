@@ -130,8 +130,12 @@ class StaticLayer:
 
 
 def to_static(layer_or_fn=None, input_spec=None, build_strategy=None, **kw):
-    """Decorator/wrapper: Layer -> StaticLayer, function -> jitted function."""
+    """Decorator/wrapper: Layer -> StaticLayer, function -> jitted function.
+    Honors `paddle.jit.enable_to_static(False)` (ProgramTranslator gate):
+    when disabled, conversion is a no-op and the eager object runs as-is."""
     def convert(obj):
+        if not ProgramTranslator.enabled:
+            return obj
         if isinstance(obj, Layer):
             return StaticLayer(obj)
 
@@ -346,8 +350,9 @@ class TracedLayer:
         return self._program(*inputs)
 
     def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
-        save(self._program._layer if hasattr(self._program, "_layer")
-             else self._program, path)
+        target = self._program.layer if isinstance(self._program, StaticLayer) \
+            else self._program
+        save(target, path)
 
 
 class ProgramTranslator:

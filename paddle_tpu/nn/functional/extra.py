@@ -78,6 +78,10 @@ def _pool_nd(x, kernel_size, stride, padding, nd, op, ceil_mode,
         for i in range(nd):
             size = int(x.shape[2 + i])
             out_ceil = -(-(size + 2 * pd[i] - ks[i]) // st[i]) + 1
+            # paddle/torch clamp: the last window must START within the
+            # input + left padding, else it would cover only padding
+            while out_ceil > 1 and (out_ceil - 1) * st[i] >= size + pd[i]:
+                out_ceil -= 1
             need = (out_ceil - 1) * st[i] + ks[i] - (size + 2 * pd[i])
             hi_extra[i] = max(0, need)
     hi_extra = tuple(hi_extra)

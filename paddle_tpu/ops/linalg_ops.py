@@ -240,7 +240,16 @@ def multi_dot(x, name=None):
     multi_dot -> np.linalg.multi_dot)."""
     def impl(*mats):
         # optimal parenthesization (matrix-chain DP over the static shapes),
-        # then apply — the classic multi_dot contract
+        # then apply — the classic multi_dot contract. 1-D first/last
+        # operands are promoted to row/column vectors (paddle/numpy rule)
+        import jax.numpy as jnp
+        squeeze_first = mats[0].ndim == 1
+        squeeze_last = mats[-1].ndim == 1
+        mats = list(mats)
+        if squeeze_first:
+            mats[0] = mats[0][None, :]
+        if squeeze_last:
+            mats[-1] = mats[-1][:, None]
         dims = [mats[0].shape[0]] + [m.shape[1] for m in mats]
         n = len(mats)
         if n == 1:
@@ -263,7 +272,12 @@ def multi_dot(x, name=None):
                 return mats[i]
             k = split[i][j]
             return mult(i, k) @ mult(k + 1, j)
-        return mult(0, n - 1)
+        out = mult(0, n - 1)
+        if squeeze_first:
+            out = out[0]
+        if squeeze_last:
+            out = out[..., 0]
+        return out
     from . import _dispatch as _d
     return _d.call(impl, list(x), name="multi_dot")
 
@@ -288,7 +302,9 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
                 return p.at[i].set(pj).at[j].set(pi)
             import jax
             perm = jax.lax.fori_loop(0, pv.shape[0], body, perm)
-            return jnp.eye(m, dtype=lu.dtype)[perm]
+            # P such that P @ L @ U == A: rows of the identity SELECTED INTO
+            # permuted positions, i.e. eye[:, perm] (eye[perm] is P^T)
+            return jnp.eye(m, dtype=lu.dtype)[perm].T
         if piv.ndim == 1:
             P = perm_of(piv.astype(jnp.int32))
         else:

@@ -833,13 +833,10 @@ def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
           print_tensor_layout=True, print_tensor_lod=True,
           print_phase="both"):
     """Debug print op (reference static/nn/common.py Print)."""
-    def _cb(t):
-        print(message or "", t)
-        return t
-    from .. import ops
-    if hasattr(input, "data"):
-        print(message or "", _np.asarray(input.data) if not hasattr(
-            input, "_prog") else input)
+    if hasattr(input, "data") and not hasattr(input, "_prog"):
+        print(message or "", _np.asarray(input.data))
+    else:
+        print(message or "", input)
     return input
 
 

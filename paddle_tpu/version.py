@@ -1,0 +1,21 @@
+"""paddle.version parity (reference python/paddle/version.py, generated)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"   # no CUDA on this target
+cudnn_version = "False"
+istaged = True
+
+
+def show():
+    print(f"paddle_tpu {full_version} (tpu-native; jax/xla/pallas backend)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

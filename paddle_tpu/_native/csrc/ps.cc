@@ -16,6 +16,7 @@
 // embedding workloads (Wide&Deep/DeepFM) whose tables exceed HBM.
 
 #include <atomic>
+#include <chrono>
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
@@ -392,8 +393,17 @@ class Server {
       uint8_t cmd = r.u8();
       int32_t tid = r.i32();
       Writer resp;
+      {
+        std::lock_guard<std::mutex> g(flight_mu_);
+        in_flight_ += 1;
+      }
       bool keep = handle(cmd, tid, &r, &resp);
       ptnet::send_frame(fd, resp);
+      {
+        std::lock_guard<std::mutex> g(flight_mu_);
+        in_flight_ -= 1;
+      }
+      flight_cv_.notify_all();
       if (!keep) break;
     }
     ::close(fd);
@@ -519,7 +529,13 @@ class Server {
           b.generation += 1;
           b.cv.notify_all();
         } else {
+          // while PARKED this request must not block a STOP drain (a dead
+          // peer would otherwise force the drain's full timeout) — it is
+          // re-counted the moment it wakes, so a RELEASED barrier response
+          // still holds STOP back until it is sent
+          mark_parked(+1);
           b.cv.wait(lk, [&] { return !running_ || b.generation != my_gen; });
+          mark_parked(-1);
           // success iff the barrier actually tripped; a concurrent STOP may
           // have flipped running_ AFTER releasing us, which is still success
           released = b.generation != my_gen;
@@ -528,6 +544,19 @@ class Server {
         return true;
       }
       case CMD_STOP: {
+        // a barrier release may still be mid-send on a peer connection —
+        // wait until every OTHER active request has written its response
+        // before tearing the server down. Parked barrier waiters and other
+        // concurrent STOPs are excluded from the count (a dead peer's
+        // barrier, or a redundant STOP, must not stall shutdown).
+        {
+          std::unique_lock<std::mutex> lk(flight_mu_);
+          stops_pending_ += 1;
+          flight_cv_.wait_for(lk, std::chrono::seconds(5), [this] {
+            return in_flight_ - parked_ - stops_pending_ <= 0;
+          });
+          stops_pending_ -= 1;
+        }
         resp->u8(ST_OK);
         running_ = false;
         ::shutdown(listen_fd_, SHUT_RDWR);
@@ -600,6 +629,20 @@ class Server {
   std::mutex stopped_mu_;
   std::condition_variable stopped_cv_;
   bool stopped_flag_ = false;
+
+  void mark_parked(int delta) {
+    {
+      std::lock_guard<std::mutex> g(flight_mu_);
+      parked_ += delta;
+    }
+    flight_cv_.notify_all();
+  }
+
+  std::mutex flight_mu_;
+  std::condition_variable flight_cv_;
+  int in_flight_ = 0;
+  int parked_ = 0;        // barrier waiters blocked on their cv
+  int stops_pending_ = 0; // concurrent CMD_STOP handlers
 };
 
 // ------------------------------ client -------------------------------------

@@ -1,77 +1,209 @@
 #!/usr/bin/env python
-"""Headline benchmark: GPT-2 small (124M) LM training throughput, single chip.
+"""Headline benchmarks, single chip: GPT-2 small (flagship) + the BASELINE.md
+target configs (ResNet-50 synthetic ImageNet, BERT-Base seq128).
 
-Flagship config from BASELINE.json ("GPT-3 ... Fleet hybrid parallel" family,
-scaled to one chip). Whole train step (fwd+bwd+Adam) is ONE XLA executable
+Whole train step (fwd+bwd+optimizer) is ONE XLA executable
 (`paddle_tpu.jit.TrainStep`) — the TPU answer to the reference's
 InterpreterCore hot loop (`/root/reference/paddle/fluid/framework/new_executor/`).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-The reference publishes no in-repo numbers (BASELINE.json `published: {}`),
-so vs_baseline is null; absolute tokens/sec/chip is the tracked metric.
+Prints ONE JSON line: the flagship GPT-2 metric is `value`; the other
+configs live in the same object under "configs", each with step time, MFU
+(achieved FLOP/s from XLA cost_analysis over bf16 peak), and HBM bytes per
+step. The reference publishes no in-repo numbers (BASELINE.json
+`published: {}`), so vs_baseline is null; absolute numbers are tracked
+round-over-round.
 """
 import json
+import os
 import time
 
-BATCH = 8
-SEQ = 1024
 WARMUP = 3
 ITERS = 40  # long chain amortizes per-dispatch host/tunnel latency
 
+# bf16 peak of one v5e chip; override for other parts (v4: 275e12, v5p: 459e12)
+PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
-def main():
+
+def _run_config(step, args, iters=ITERS, warmup=WARMUP):
+    """AOT-compile the TrainStep ONCE, read cost_analysis from the same
+    executable, and time by invoking it directly (no second jit compile).
+
+    Returns (sec_per_step, final_loss, flops, bytes_accessed)."""
+    import jax.numpy as jnp
+    from paddle_tpu.framework import random as random_mod
+
+    rng = random_mod.default_generator().split()
+    lr = jnp.asarray(step.optimizer.get_lr(), jnp.float32)
+    arrs = [a.data for a in args]
+    compiled = step._step.lower(step.params, step.buffers, step.opt_state,
+                                rng, lr, 1, *arrs).compile()
+    flops = nbytes = None
+    try:
+        an = compiled.cost_analysis()
+        if isinstance(an, list):
+            an = an[0]
+        flops, nbytes = an.get("flops"), an.get("bytes accessed")
+    except Exception:
+        pass
+    params, buffers, opt_state = step.params, step.buffers, step.opt_state
+    for _ in range(warmup):
+        loss, params, buffers, opt_state = compiled(
+            params, buffers, opt_state, rng, lr, 1, *arrs)
+    float(loss)  # sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, buffers, opt_state = compiled(
+            params, buffers, opt_state, rng, lr, 1, *arrs)
+    final_loss = float(loss)  # device sync
+    dt = time.perf_counter() - t0
+    return dt / iters, final_loss, flops, nbytes
+
+
+def bench_gpt2():
     import numpy as np
+    import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.gpt import GPT, GPTConfig
     from paddle_tpu.nn import functional as F
 
+    B, L = 8, 1024
     paddle.seed(0)
     cfg = GPTConfig.gpt2_small()
-    cfg.max_position_embeddings = SEQ
+    cfg.max_position_embeddings = L
     cfg.dropout = 0.0
     cfg.attn_dropout = 0.0
     model = GPT(cfg)
     opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
                           weight_decay=0.01)
-
-    def loss_fn(logits, labels):
-        return F.cross_entropy(logits, labels)
-
     # O2 mixed precision: fp32 master weights + Adam state, bf16 compute —
     # the production TPU training configuration (no loss scaling needed)
-    import jax.numpy as jnp
-    step = TrainStep(model, loss_fn, opt, amp_dtype=jnp.bfloat16)
-
+    step = TrainStep(model, F.cross_entropy, opt, amp_dtype=jnp.bfloat16)
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(
-        rng.integers(0, cfg.vocab_size, (BATCH, SEQ)).astype("int32"))
+        rng.integers(0, cfg.vocab_size, (B, L)).astype("int32"))
     labels = paddle.to_tensor(
-        rng.integers(0, cfg.vocab_size, (BATCH, SEQ)).astype("int32"))
+        rng.integers(0, cfg.vocab_size, (B, L)).astype("int32"))
+    sec, loss, flops, nbytes = _run_config(step, (ids, labels))
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    # model-FLOPs MFU: 6*N per token (fwd+bwd) + attention 12*L*D_model*T
+    model_flops = 6 * n_params * B * L + 12 * cfg.num_layers * B * L * L * cfg.hidden_size
+    return {
+        "name": "gpt2-small-124M b8 s1024 bf16+fp32-master",
+        "tokens_per_sec_chip": round(B * L / sec, 1),
+        "samples_per_sec_chip": round(B / sec, 3),
+        "step_time_ms": round(1000 * sec, 2),
+        "final_loss": round(loss, 4),
+        "mfu": round(model_flops / sec / PEAK_FLOPS, 4),
+        "hw_flops_util": (round(flops / sec / PEAK_FLOPS, 4)
+                          if flops else None),
+        "hbm_gb_per_step": round(nbytes / 1e9, 2) if nbytes else None,
+    }
 
-    for _ in range(WARMUP):
-        loss = step(ids, labels)
-    float(loss)  # sync
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        loss = step(ids, labels)
-    final_loss = float(loss)  # device sync
-    dt = time.perf_counter() - t0
+def bench_resnet50():
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.resnet import resnet50
+    from paddle_tpu.nn import functional as F
 
-    tokens_per_s = BATCH * SEQ * ITERS / dt
-    samples_per_s = BATCH * ITERS / dt
+    B = 128  # synthetic ImageNet shapes (BASELINE.md primary metric)
+    paddle.seed(0)
+    model = resnet50()
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    step = TrainStep(model, F.cross_entropy, opt, amp_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    imgs = paddle.to_tensor(
+        rng.normal(size=(B, 3, 224, 224)).astype("float32"))
+    labels = paddle.to_tensor(rng.integers(0, 1000, (B,)).astype("int32"))
+    sec, loss, flops, nbytes = _run_config(step, (imgs, labels))
+    # ResNet-50 fwd = 4.09 GFLOP per 224x224 image; train = fwd + ~2x bwd
+    model_flops = 3 * 4.09e9 * B
+    return {
+        "name": "resnet50 b128 224x224 bf16 (synthetic ImageNet)",
+        "samples_per_sec_chip": round(B / sec, 1),
+        "step_time_ms": round(1000 * sec, 2),
+        "final_loss": round(loss, 4),
+        "mfu": round(model_flops / sec / PEAK_FLOPS, 4),
+        "hw_flops_util": round(flops / sec / PEAK_FLOPS, 4) if flops else None,
+        "hbm_gb_per_step": round(nbytes / 1e9, 2) if nbytes else None,
+    }
+
+
+def bench_bert_base():
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.bert import Bert, BertConfig
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu import nn
+
+    B, L = 32, 128  # ERNIE/BERT-Base seq128 (BASELINE.md primary metric)
+    paddle.seed(0)
+    cfg = BertConfig.base()
+    cfg.max_position_embeddings = max(cfg.max_position_embeddings, L)
+
+    class BertCls(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bert = Bert(cfg)
+            self.head = nn.Linear(cfg.hidden_size, 2)
+
+        def forward(self, ids):
+            _, pooled = self.bert(ids)
+            return self.head(pooled)
+
+    model = BertCls()
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    step = TrainStep(model, F.cross_entropy, opt, amp_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (B, L)).astype("int32"))
+    labels = paddle.to_tensor(rng.integers(0, 2, (B,)).astype("int32"))
+    sec, loss, flops, nbytes = _run_config(step, (ids, labels))
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    model_flops = (6 * n_params * B * L
+                   + 12 * cfg.num_layers * B * L * L * cfg.hidden_size)
+    return {
+        "name": "bert-base seq128 b32 bf16 (ERNIE-Base class)",
+        "samples_per_sec_chip": round(B / sec, 1),
+        "step_time_ms": round(1000 * sec, 2),
+        "final_loss": round(loss, 4),
+        "mfu": round(model_flops / sec / PEAK_FLOPS, 4),
+        "hw_flops_util": round(flops / sec / PEAK_FLOPS, 4) if flops else None,
+        "hbm_gb_per_step": round(nbytes / 1e9, 2) if nbytes else None,
+    }
+
+
+def main():
+    gpt = bench_gpt2()
+    configs = {"gpt2_small": gpt}
+    for fn, key in ((bench_resnet50, "resnet50"),
+                    (bench_bert_base, "bert_base_seq128")):
+        try:
+            configs[key] = fn()
+        except Exception as e:  # one config must not sink the whole bench
+            configs[key] = {"error": f"{type(e).__name__}: {e}"}
     print(json.dumps({
         "metric": "gpt2-small-124M train tokens/sec/chip "
                   "(b8 x s1024, bf16 compute + fp32 master, fused step)",
-        "value": round(tokens_per_s, 1),
+        "value": gpt["tokens_per_sec_chip"],
         "unit": "tokens/sec/chip",
         "vs_baseline": None,
-        "samples_per_sec_chip": round(samples_per_s, 3),
-        "step_time_ms": round(1000 * dt / ITERS, 2),
-        "final_loss": round(final_loss, 4),
-        "note": "reference publishes no in-repo baseline (BASELINE.json published:{})",
+        "step_time_ms": gpt["step_time_ms"],
+        "mfu": gpt["mfu"],
+        "configs": configs,
+        "note": "reference publishes no in-repo baseline "
+                "(BASELINE.json published:{}); peak for MFU = "
+                f"{PEAK_FLOPS/1e12:.0f} TFLOP/s bf16",
     }))
 
 

@@ -98,11 +98,14 @@ def _declare(lib: ctypes.CDLL):
                                     c.c_int64, c.c_int, c.c_float, c.c_float,
                                     c.c_uint64]
     lib.ps_pull_dense.restype = c.c_int
-    lib.ps_pull_dense.argtypes = [c.c_int, c.c_int, f32p, c.c_int64]
+    lib.ps_pull_dense.argtypes = [c.c_int, c.c_int, f32p, c.c_int64,
+                                  c.c_int64]
     lib.ps_push_dense.restype = c.c_int
-    lib.ps_push_dense.argtypes = [c.c_int, c.c_int, f32p, c.c_int64]
+    lib.ps_push_dense.argtypes = [c.c_int, c.c_int, f32p, c.c_int64,
+                                  c.c_int64]
     lib.ps_set_dense.restype = c.c_int
-    lib.ps_set_dense.argtypes = [c.c_int, c.c_int, f32p, c.c_int64]
+    lib.ps_set_dense.argtypes = [c.c_int, c.c_int, f32p, c.c_int64,
+                                 c.c_int64]
     lib.ps_pull_sparse.restype = c.c_int
     lib.ps_pull_sparse.argtypes = [c.c_int, c.c_int, u64p, c.c_int64, f32p,
                                    c.c_int64]
@@ -119,6 +122,15 @@ def _declare(lib: ctypes.CDLL):
     lib.ps_barrier.argtypes = [c.c_int, c.c_char_p, c.c_int]
     lib.ps_stop_server.restype = c.c_int
     lib.ps_stop_server.argtypes = [c.c_int]
+    i32p = c.POINTER(c.c_int32)
+    lib.ps_push_show_click.restype = c.c_int
+    lib.ps_push_show_click.argtypes = [c.c_int, c.c_int, u64p, c.c_int64,
+                                       f32p, f32p]
+    lib.ps_shrink.restype = c.c_int64
+    lib.ps_shrink.argtypes = [c.c_int, c.c_int, c.c_float, c.c_int]
+    lib.ps_pull_meta.restype = c.c_int
+    lib.ps_pull_meta.argtypes = [c.c_int, c.c_int, u64p, c.c_int64, f32p,
+                                 f32p, i32p]
 
     # TCPStore
     lib.store_server_create.restype = c.c_int

@@ -15,6 +15,7 @@
 
 #include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -50,8 +51,11 @@ inline bool write_full(int fd, const void* buf, size_t n) {
   return true;
 }
 
-// Listen on 127.0.0.1-or-any:port (port 0 -> ephemeral). Returns fd or -1.
-inline int listen_on(int port, int backlog = 128) {
+// Listen on host:port (port 0 -> ephemeral). Returns fd or -1.
+// Bind interface: explicit `host` arg, else $PADDLE_BIND_HOST, else ANY
+// (multi-host pods need ANY; single-host users can pin 127.0.0.1).
+inline int listen_on(int port, int backlog = 128,
+                     const char* host = nullptr) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   int one = 1;
@@ -59,7 +63,13 @@ inline int listen_on(int port, int backlog = 128) {
   sockaddr_in addr;
   std::memset(&addr, 0, sizeof(addr));
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (host == nullptr) host = ::getenv("PADDLE_BIND_HOST");
+  if (host == nullptr) {
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  } else if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;  // fail loudly: a bad bind host must not widen to ANY
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
       ::listen(fd, backlog) < 0) {
@@ -128,11 +138,18 @@ struct Writer {
   }
 };
 
+// Bounds-checked deserializer. Servers feed frames from untrusted peers
+// into this; every read validates against the frame end. On a violation
+// the reader latches failed() and returns zeros/empties — callers MUST
+// check failed() before acting on a decoded frame (the PS/TCPStore request
+// loops drop the connection).
 struct Reader {
   const char* p;
   const char* end;
+  bool failed_ = false;
   Reader(const char* data, size_t n) : p(data), end(data + n) {}
-  bool ok(size_t n) const { return p + n <= end; }
+  bool ok(size_t n) const { return !failed_ && n <= static_cast<size_t>(end - p); }
+  bool failed() const { return failed_; }
   uint8_t u8() { return take<uint8_t>(); }
   int32_t i32() { return take<int32_t>(); }
   uint32_t u32() { return take<uint32_t>(); }
@@ -141,17 +158,30 @@ struct Reader {
   float f32() { return take<float>(); }
   std::string str() {
     uint32_t n = u32();
+    if (!ok(n)) {
+      failed_ = true;
+      return std::string();
+    }
     std::string s(p, p + n);
     p += n;
     return s;
   }
+  // Returns nullptr (and latches failure) if fewer than n bytes remain.
   const char* raw(size_t n) {
+    if (!ok(n)) {
+      failed_ = true;
+      return nullptr;
+    }
     const char* r = p;
     p += n;
     return r;
   }
   template <typename T>
   T take() {
+    if (!ok(sizeof(T))) {
+      failed_ = true;
+      return T();
+    }
     T v;
     std::memcpy(&v, p, sizeof(T));
     p += sizeof(T);
@@ -166,9 +196,16 @@ inline bool send_frame(int fd, const Writer& w) {
   return write_full(fd, w.buf.data(), w.buf.size());
 }
 
+// Frames larger than this are treated as a protocol error (a malicious or
+// corrupt length prefix would otherwise drive a multi-GiB allocation).
+// Clients chunk dense and sparse transfers (client.py _DENSE_CHUNK /
+// _SPARSE_CHUNK_BYTES) so every legitimate frame stays far below this.
+constexpr uint32_t kMaxFrameLen = 256u * 1024u * 1024u;
+
 inline bool recv_frame(int fd, std::vector<char>* out) {
   uint32_t len = 0;
   if (!read_full(fd, &len, 4)) return false;
+  if (len > kMaxFrameLen) return false;
   out->resize(len);
   if (len == 0) return true;
   return read_full(fd, out->data(), len);

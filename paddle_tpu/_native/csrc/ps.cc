@@ -50,9 +50,15 @@ enum Cmd : uint8_t {
   CMD_STOP = 10,
   CMD_TABLE_SIZE = 11,
   CMD_PING = 12,
+  CMD_PUSH_SHOW_CLICK = 13,  // CTR lifecycle: show/click counters
+  CMD_SHRINK = 14,           // decay + age + evict (ctr_accessor::Shrink)
+  CMD_PULL_META = 15,        // per-key (show, click, unseen_days) for tests
 };
 
-enum Opt : uint8_t { OPT_SGD = 0, OPT_ADAGRAD = 1, OPT_ADAM = 2 };
+// OPT_SUM: raw delta-apply (w += g) — the server side of geo-SGD
+// (reference memory_sparse_geo_table.cc: trainers train locally and push
+// accumulated deltas; the table just merges them).
+enum Opt : uint8_t { OPT_SGD = 0, OPT_ADAGRAD = 1, OPT_ADAM = 2, OPT_SUM = 3 };
 
 enum Status : uint8_t { ST_OK = 0, ST_ERR = 1 };
 
@@ -86,13 +92,19 @@ static int state_slots(uint8_t opt) {
   switch (opt) {
     case OPT_ADAGRAD: return 1;  // accumulator
     case OPT_ADAM: return 2;     // m, v
-    default: return 0;
+    default: return 0;           // SGD and SUM (geo) are stateless
   }
 }
 
-// One sparse row: [step][values dim][state dim*slots]
+// One sparse row: [step][CTR meta][values dim][state dim*slots].
+// CTR meta mirrors the reference's CtrCommonFeatureValue
+// (ps/table/ctr_accessor.h): show/click counters decayed by Shrink, and
+// unseen_days driving eviction of stale features.
 struct SparseEntry {
   uint32_t step = 0;
+  float show = 0.0f;
+  float click = 0.0f;
+  uint32_t unseen_days = 0;
   std::vector<float> data;  // dim * (1 + slots)
 };
 
@@ -109,6 +121,7 @@ class SparseTable {
       Shard& s = shard(k);
       std::lock_guard<std::mutex> g(s.mu);
       SparseEntry& e = fetch_or_init(s, k);
+      e.unseen_days = 0;
       std::memcpy(out + i * dim, e.data.data(), dim * sizeof(float));
     }
   }
@@ -120,6 +133,7 @@ class SparseTable {
       Shard& s = shard(k);
       std::lock_guard<std::mutex> g(s.mu);
       SparseEntry& e = fetch_or_init(s, k);
+      e.unseen_days = 0;
       apply(&e, grads + i * dim);
     }
   }
@@ -133,7 +147,68 @@ class SparseTable {
     return t;
   }
 
+  void push_show_click(const uint64_t* keys, int64_t n, const float* shows,
+                       const float* clicks) {
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& s = shard(keys[i]);
+      std::lock_guard<std::mutex> g(s.mu);
+      SparseEntry& e = fetch_or_init(s, keys[i]);
+      e.show += shows[i];
+      e.click += clicks[i];
+      e.unseen_days = 0;
+    }
+  }
+
+  void pull_meta(const uint64_t* keys, int64_t n, float* show, float* click,
+                 int32_t* unseen) {
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& s = shard(keys[i]);
+      std::lock_guard<std::mutex> g(s.mu);
+      auto it = s.map.find(keys[i]);
+      if (it == s.map.end()) {
+        show[i] = click[i] = 0.0f;
+        unseen[i] = -1;  // not present
+      } else {
+        show[i] = it->second.show;
+        click[i] = it->second.click;
+        unseen[i] = static_cast<int32_t>(it->second.unseen_days);
+      }
+    }
+  }
+
+  // One "day" tick (reference CtrCommonAccessor::Shrink): decay show/click,
+  // age every row, evict rows whose score dropped below `threshold` AND
+  // that have not been touched for more than `max_unseen_days` ticks.
+  // Returns the number of evicted rows.
+  int64_t shrink(float threshold, int32_t max_unseen_days,
+                 float show_decay = 0.98f, float show_coeff = 1.0f,
+                 float click_coeff = 1.0f) {
+    int64_t evicted = 0;
+    for (Shard& s : shards_) {
+      std::lock_guard<std::mutex> g(s.mu);
+      for (auto it = s.map.begin(); it != s.map.end();) {
+        SparseEntry& e = it->second;
+        e.show *= show_decay;
+        e.click *= show_decay;
+        e.unseen_days += 1;
+        float score = show_coeff * e.show + click_coeff * e.click;
+        if (score < threshold &&
+            e.unseen_days > static_cast<uint32_t>(max_unseen_days)) {
+          it = s.map.erase(it);
+          ++evicted;
+        } else {
+          ++it;
+        }
+      }
+    }
+    return evicted;
+  }
+
+  // format v2: magic header guards against misparsing v1 (pre-CTR) files
+  static constexpr uint32_t kMagic = 0x50545332;  // "PTS2"
+
   bool save(FILE* f) const {
+    fwrite(&kMagic, 4, 1, f);
     int64_t n = size();
     fwrite(&n, 8, 1, f);
     const size_t row = cfg_.dim * (1 + state_slots(cfg_.opt));
@@ -142,6 +217,9 @@ class SparseTable {
       for (const auto& kv : s.map) {
         fwrite(&kv.first, 8, 1, f);
         fwrite(&kv.second.step, 4, 1, f);
+        fwrite(&kv.second.show, 4, 1, f);
+        fwrite(&kv.second.click, 4, 1, f);
+        fwrite(&kv.second.unseen_days, 4, 1, f);
         fwrite(kv.second.data.data(), sizeof(float), row, f);
       }
     }
@@ -149,6 +227,9 @@ class SparseTable {
   }
 
   bool load(FILE* f) {
+    uint32_t magic = 0;
+    if (fread(&magic, 4, 1, f) != 1 || magic != kMagic)
+      return false;  // clean failure on old/foreign files, not corruption
     int64_t n = 0;
     if (fread(&n, 8, 1, f) != 1) return false;
     const size_t row = cfg_.dim * (1 + state_slots(cfg_.opt));
@@ -158,6 +239,9 @@ class SparseTable {
       e.data.resize(row);
       if (fread(&k, 8, 1, f) != 1) return false;
       if (fread(&e.step, 4, 1, f) != 1) return false;
+      if (fread(&e.show, 4, 1, f) != 1) return false;
+      if (fread(&e.click, 4, 1, f) != 1) return false;
+      if (fread(&e.unseen_days, 4, 1, f) != 1) return false;
       if (fread(e.data.data(), sizeof(float), row, f) != row) return false;
       Shard& s = shard(k);
       std::lock_guard<std::mutex> g(s.mu);
@@ -197,6 +281,9 @@ class SparseTable {
     switch (cfg_.opt) {
       case OPT_SGD:
         for (int d = 0; d < dim; ++d) w[d] -= cfg_.lr * g[d];
+        break;
+      case OPT_SUM:  // geo: merge a trainer's local delta
+        for (int d = 0; d < dim; ++d) w[d] += g[d];
         break;
       case OPT_ADAGRAD: {
         float* acc = w + dim;
@@ -239,40 +326,53 @@ class DenseTable {
     }
   }
 
-  void pull(float* out) {
+  // Range ops: large tables move as <=64MB chunks (client-side chunking).
+  // A logical optimizer step spans the chunks of one push sweep; the Adam
+  // step counter ticks on the off==0 chunk (chunks arrive in order from
+  // one client; cross-client interleaving has hogwild semantics, as the
+  // reference's async dense push does).
+  void pull(float* out, int64_t off, int64_t len) {
     std::lock_guard<std::mutex> g(mu_);
-    std::memcpy(out, w_.data(), w_.size() * sizeof(float));
+    std::memcpy(out, w_.data() + off, len * sizeof(float));
   }
 
-  void set(const float* vals) {
+  void set(const float* vals, int64_t off, int64_t len) {
     std::lock_guard<std::mutex> g(mu_);
-    std::memcpy(w_.data(), vals, w_.size() * sizeof(float));
+    std::memcpy(w_.data() + off, vals, len * sizeof(float));
   }
 
-  void push(const float* g) {
+  bool range_ok(int64_t off, int64_t len) const {
+    return off >= 0 && len >= 0 &&
+           off + len <= static_cast<int64_t>(w_.size());
+  }
+
+  void push(const float* g, int64_t off, int64_t len) {
     std::lock_guard<std::mutex> gd(mu_);
     const int64_t n = static_cast<int64_t>(w_.size());
-    float* w = w_.data();
+    float* w = w_.data() + off;
     switch (cfg_.opt) {
       case OPT_SGD:
-        for (int64_t i = 0; i < n; ++i) w[i] -= cfg_.lr * g[i];
+        for (int64_t i = 0; i < len; ++i) w[i] -= cfg_.lr * g[i];
+        break;
+      case OPT_SUM:  // geo: merge a trainer's local delta
+        for (int64_t i = 0; i < len; ++i) w[i] += g[i];
         break;
       case OPT_ADAGRAD: {
-        float* acc = state_.data();
-        for (int64_t i = 0; i < n; ++i) {
+        float* acc = state_.data() + off;
+        for (int64_t i = 0; i < len; ++i) {
           acc[i] += g[i] * g[i];
           w[i] -= cfg_.lr * g[i] / (std::sqrt(acc[i]) + cfg_.eps);
         }
         break;
       }
       case OPT_ADAM: {
-        float* m = state_.data();
-        float* v = state_.data() + n;
-        step_ += 1;
+        float* m = state_.data() + off;
+        float* v = state_.data() + n + off;
+        if (off == 0) step_ += 1;
         const float b1 = cfg_.beta1, b2 = cfg_.beta2;
         const float bc1 = 1.0f - std::pow(b1, static_cast<float>(step_));
         const float bc2 = 1.0f - std::pow(b2, static_cast<float>(step_));
-        for (int64_t i = 0; i < n; ++i) {
+        for (int64_t i = 0; i < len; ++i) {
           m[i] = b1 * m[i] + (1 - b1) * g[i];
           v[i] = b2 * v[i] + (1 - b2) * g[i] * g[i];
           w[i] -= cfg_.lr * (m[i] / bc1) / (std::sqrt(v[i] / bc2) + cfg_.eps);
@@ -398,6 +498,11 @@ class Server {
         in_flight_ += 1;
       }
       bool keep = handle(cmd, tid, &r, &resp);
+      if (r.failed()) {  // malformed frame: report and drop the connection
+        resp = Writer();
+        err(&resp, "malformed frame");
+        keep = false;
+      }
       ptnet::send_frame(fd, resp);
       {
         std::lock_guard<std::mutex> g(flight_mu_);
@@ -423,6 +528,14 @@ class Server {
         cfg.lr = r->f32();
         cfg.init_range = r->f32();
         cfg.seed = r->u64();
+        if (r->failed()) return err(resp, "truncated frame");
+        // well-formed but semantically invalid values must not crash/OOM
+        // the server (dim drives a division in PULL_SPARSE's bound check;
+        // dense_size drives an allocation)
+        if (cfg.kind > 1 || cfg.opt > OPT_SUM || cfg.dim < 1 ||
+            cfg.dim > 65536 || cfg.dense_size < 0 ||
+            cfg.dense_size > (1LL << 33))
+          return err(resp, "bad table config");
         std::lock_guard<std::mutex> g(tables_mu_);
         if (cfg.kind == 0) {
           if (!dense_.count(tid)) dense_[tid] = std::make_unique<DenseTable>(cfg);
@@ -435,28 +548,43 @@ class Server {
       case CMD_PULL_DENSE: {
         DenseTable* t = dense(tid);
         if (!t) return err(resp, "no such dense table");
+        int64_t off = r->i64();
+        int64_t len = r->i64();
+        if (r->failed() || !t->range_ok(off, len) ||
+            len > static_cast<int64_t>(ptnet::kMaxFrameLen) / 4 - 16)
+          return err(resp, "bad dense range");
         resp->u8(ST_OK);
-        resp->i64(t->size());
-        size_t off = resp->buf.size();
-        resp->buf.resize(off + t->size() * sizeof(float));
-        t->pull(reinterpret_cast<float*>(resp->buf.data() + off));
+        resp->i64(len);
+        size_t boff = resp->buf.size();
+        resp->buf.resize(boff + len * sizeof(float));
+        t->pull(reinterpret_cast<float*>(resp->buf.data() + boff), off, len);
         return true;
       }
       case CMD_PUSH_DENSE: {
         DenseTable* t = dense(tid);
         if (!t) return err(resp, "no such dense table");
-        int64_t n = r->i64();
-        if (n != t->size()) return err(resp, "dense size mismatch");
-        t->push(reinterpret_cast<const float*>(r->raw(n * sizeof(float))));
+        int64_t off = r->i64();
+        int64_t len = r->i64();
+        if (r->failed() || !t->range_ok(off, len))
+          return err(resp, "bad dense range");
+        const float* g =
+            reinterpret_cast<const float*>(r->raw(len * sizeof(float)));
+        if (!g && len > 0) return err(resp, "truncated frame");
+        t->push(g, off, len);
         resp->u8(ST_OK);
         return true;
       }
       case CMD_SET_DENSE: {
         DenseTable* t = dense(tid);
         if (!t) return err(resp, "no such dense table");
-        int64_t n = r->i64();
-        if (n != t->size()) return err(resp, "dense size mismatch");
-        t->set(reinterpret_cast<const float*>(r->raw(n * sizeof(float))));
+        int64_t off = r->i64();
+        int64_t len = r->i64();
+        if (r->failed() || !t->range_ok(off, len))
+          return err(resp, "bad dense range");
+        const float* vals =
+            reinterpret_cast<const float*>(r->raw(len * sizeof(float)));
+        if (!vals && len > 0) return err(resp, "truncated frame");
+        t->set(vals, off, len);
         resp->u8(ST_OK);
         return true;
       }
@@ -464,8 +592,13 @@ class Server {
         SparseTable* t = sparse(tid);
         if (!t) return err(resp, "no such sparse table");
         int64_t n = r->i64();
+        // bound by BOTH request bytes and response bytes (n*dim*4)
+        if (n < 0 || n > static_cast<int64_t>(ptnet::kMaxFrameLen) /
+                             (8 + static_cast<int64_t>(t->config().dim) * 4))
+          return err(resp, "bad key count");
         const uint64_t* keys =
             reinterpret_cast<const uint64_t*>(r->raw(n * sizeof(uint64_t)));
+        if (!keys && n > 0) return err(resp, "truncated frame");
         resp->u8(ST_OK);
         resp->i64(n * t->config().dim);
         size_t off = resp->buf.size();
@@ -477,12 +610,63 @@ class Server {
         SparseTable* t = sparse(tid);
         if (!t) return err(resp, "no such sparse table");
         int64_t n = r->i64();
+        if (n < 0 || n > static_cast<int64_t>(ptnet::kMaxFrameLen) / 8)
+          return err(resp, "bad key count");
         const uint64_t* keys =
             reinterpret_cast<const uint64_t*>(r->raw(n * sizeof(uint64_t)));
         const float* grads = reinterpret_cast<const float*>(
             r->raw(n * t->config().dim * sizeof(float)));
+        if (n > 0 && (!keys || !grads)) return err(resp, "truncated frame");
         t->push(keys, n, grads);
         resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_PUSH_SHOW_CLICK: {
+        SparseTable* t = sparse(tid);
+        if (!t) return err(resp, "no such sparse table");
+        int64_t n = r->i64();
+        if (n < 0 || n > static_cast<int64_t>(ptnet::kMaxFrameLen) / 8)
+          return err(resp, "bad key count");
+        const uint64_t* keys =
+            reinterpret_cast<const uint64_t*>(r->raw(n * sizeof(uint64_t)));
+        const float* shows =
+            reinterpret_cast<const float*>(r->raw(n * sizeof(float)));
+        const float* clicks =
+            reinterpret_cast<const float*>(r->raw(n * sizeof(float)));
+        if (n > 0 && (!keys || !shows || !clicks))
+          return err(resp, "truncated frame");
+        t->push_show_click(keys, n, shows, clicks);
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_SHRINK: {
+        SparseTable* t = sparse(tid);
+        if (!t) return err(resp, "no such sparse table");
+        float threshold = r->f32();
+        int32_t max_unseen = r->i32();
+        if (r->failed()) return err(resp, "truncated frame");
+        int64_t evicted = t->shrink(threshold, max_unseen);
+        resp->u8(ST_OK);
+        resp->i64(evicted);
+        return true;
+      }
+      case CMD_PULL_META: {
+        SparseTable* t = sparse(tid);
+        if (!t) return err(resp, "no such sparse table");
+        int64_t n = r->i64();
+        if (n < 0 || n > static_cast<int64_t>(ptnet::kMaxFrameLen) / 20)
+          return err(resp, "bad key count");  // 8B key in + 12B meta out
+        const uint64_t* keys =
+            reinterpret_cast<const uint64_t*>(r->raw(n * sizeof(uint64_t)));
+        if (!keys && n > 0) return err(resp, "truncated frame");
+        std::vector<float> show(n), click(n);
+        std::vector<int32_t> unseen(n);
+        t->pull_meta(keys, n, show.data(), click.data(), unseen.data());
+        resp->u8(ST_OK);
+        resp->i64(n);
+        resp->bytes(show.data(), n * sizeof(float));
+        resp->bytes(click.data(), n * sizeof(float));
+        resp->bytes(unseen.data(), n * sizeof(int32_t));
         return true;
       }
       case CMD_TABLE_SIZE: {
@@ -772,36 +956,44 @@ int ps_create_table(int h, int table_id, int kind, int dim, int64_t dense_size,
   return simple_req(h, w);
 }
 
-int ps_pull_dense(int h, int table_id, float* out, int64_t n) {
+int ps_pull_dense(int h, int table_id, float* out, int64_t off, int64_t len) {
   ps::Client* c = client(h);
   if (!c) return -1;
   ps::Writer w;
   w.u8(ps::CMD_PULL_DENSE);
   w.i32(table_id);
+  w.i64(off);
+  w.i64(len);
   std::vector<char> body;
   if (c->request(w, &body) != ps::ST_OK) return -1;
   ps::Reader r(body.data(), body.size());
   int64_t got = r.i64();
-  if (got != n) return -1;
-  std::memcpy(out, r.raw(n * sizeof(float)), n * sizeof(float));
+  if (got != len) return -1;
+  const char* src = r.raw(len * sizeof(float));
+  if (!src) return -1;
+  std::memcpy(out, src, len * sizeof(float));
   return 0;
 }
 
-int ps_push_dense(int h, int table_id, const float* grad, int64_t n) {
+int ps_push_dense(int h, int table_id, const float* grad, int64_t off,
+                  int64_t len) {
   ps::Writer w;
   w.u8(ps::CMD_PUSH_DENSE);
   w.i32(table_id);
-  w.i64(n);
-  w.bytes(grad, n * sizeof(float));
+  w.i64(off);
+  w.i64(len);
+  w.bytes(grad, len * sizeof(float));
   return simple_req(h, w);
 }
 
-int ps_set_dense(int h, int table_id, const float* vals, int64_t n) {
+int ps_set_dense(int h, int table_id, const float* vals, int64_t off,
+                 int64_t len) {
   ps::Writer w;
   w.u8(ps::CMD_SET_DENSE);
   w.i32(table_id);
-  w.i64(n);
-  w.bytes(vals, n * sizeof(float));
+  w.i64(off);
+  w.i64(len);
+  w.bytes(vals, len * sizeof(float));
   return simple_req(h, w);
 }
 
@@ -819,7 +1011,9 @@ int ps_pull_sparse(int h, int table_id, const uint64_t* keys, int64_t n,
   ps::Reader r(body.data(), body.size());
   int64_t got = r.i64();
   if (got != out_len) return -1;
-  std::memcpy(out, r.raw(got * sizeof(float)), got * sizeof(float));
+  const char* src = r.raw(got * sizeof(float));
+  if (!src) return -1;
+  std::memcpy(out, src, got * sizeof(float));
   return 0;
 }
 
@@ -876,6 +1070,56 @@ int ps_stop_server(int h) {
   w.u8(ps::CMD_STOP);
   w.i32(-1);
   return simple_req(h, w);
+}
+
+int ps_push_show_click(int h, int table_id, const uint64_t* keys, int64_t n,
+                       const float* shows, const float* clicks) {
+  ps::Writer w;
+  w.u8(ps::CMD_PUSH_SHOW_CLICK);
+  w.i32(table_id);
+  w.i64(n);
+  w.bytes(keys, n * sizeof(uint64_t));
+  w.bytes(shows, n * sizeof(float));
+  w.bytes(clicks, n * sizeof(float));
+  return simple_req(h, w);
+}
+
+int64_t ps_shrink(int h, int table_id, float threshold, int max_unseen_days) {
+  ps::Client* c = client(h);
+  if (!c) return -1;
+  ps::Writer w;
+  w.u8(ps::CMD_SHRINK);
+  w.i32(table_id);
+  w.f32(threshold);
+  w.i32(max_unseen_days);
+  std::vector<char> body;
+  if (c->request(w, &body) != ps::ST_OK) return -1;
+  ps::Reader r(body.data(), body.size());
+  return r.i64();
+}
+
+int ps_pull_meta(int h, int table_id, const uint64_t* keys, int64_t n,
+                 float* show, float* click, int32_t* unseen) {
+  ps::Client* c = client(h);
+  if (!c) return -1;
+  ps::Writer w;
+  w.u8(ps::CMD_PULL_META);
+  w.i32(table_id);
+  w.i64(n);
+  w.bytes(keys, n * sizeof(uint64_t));
+  std::vector<char> body;
+  if (c->request(w, &body) != ps::ST_OK) return -1;
+  ps::Reader r(body.data(), body.size());
+  int64_t got = r.i64();
+  if (got != n) return -1;
+  const char* ps_ = r.raw(n * sizeof(float));
+  const char* pc = r.raw(n * sizeof(float));
+  const char* pu = r.raw(n * sizeof(int32_t));
+  if (!ps_ || !pc || !pu) return -1;
+  std::memcpy(show, ps_, n * sizeof(float));
+  std::memcpy(click, pc, n * sizeof(float));
+  std::memcpy(unseen, pu, n * sizeof(int32_t));
+  return 0;
 }
 
 }  // extern "C"

@@ -95,6 +95,7 @@ class StoreServer {
       uint8_t cmd = r.u8();
       Writer resp;
       bool keep = handle(cmd, &r, &resp);
+      if (r.failed()) keep = false;  // malformed frame: drop the connection
       if (!ptnet::send_frame(fd, resp)) break;
       if (!keep) break;
     }
@@ -106,6 +107,7 @@ class StoreServer {
       case CMD_SET: {
         std::string key = r->str();
         std::string val = r->str();
+        if (r->failed()) { resp->u8(ST_ERR); return false; }
         {
           std::lock_guard<std::mutex> g(mu_);
           kv_[key] = val;
@@ -116,6 +118,7 @@ class StoreServer {
       }
       case CMD_GET: {
         std::string key = r->str();
+        if (r->failed()) { resp->u8(ST_ERR); return false; }
         std::unique_lock<std::mutex> lk(mu_);
         cv_.wait(lk, [&] { return !running_ || kv_.count(key); });
         if (!kv_.count(key)) { resp->u8(ST_ERR); return true; }
@@ -145,8 +148,14 @@ class StoreServer {
       }
       case CMD_WAIT: {
         uint32_t n = r->u32();
+        // each key carries a 4-byte length prefix; reject impossible counts
+        if (!r->ok(4 * static_cast<size_t>(n))) {
+          resp->u8(ST_ERR);
+          return false;
+        }
         std::vector<std::string> keys;
         for (uint32_t i = 0; i < n; ++i) keys.push_back(r->str());
+        if (r->failed()) { resp->u8(ST_ERR); return false; }
         std::unique_lock<std::mutex> lk(mu_);
         cv_.wait(lk, [&] {
           if (!running_) return true;
@@ -298,7 +307,9 @@ int64_t store_get(int h, const char* key, char* buf, int64_t cap) {
   store::Reader r(out.data(), out.size());
   uint32_t n = r.u32();
   int64_t copy = std::min<int64_t>(n, cap);
-  std::memcpy(buf, r.raw(n), copy);
+  const char* src = r.raw(n);
+  if (!src) return -1;
+  std::memcpy(buf, src, copy);
   return n;
 }
 

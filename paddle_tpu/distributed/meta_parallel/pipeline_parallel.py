@@ -19,9 +19,10 @@ the whole pipeline is ONE compiled program over a mesh with a `pp` axis:
   dim 0 (XLA partitions it so every stage computes concurrently) followed by
   `jnp.roll(out, 1, axis=0)` which GSPMD lowers to a collective-permute over
   ICI — exactly the reference's send_forward/recv_forward pair;
-* micro-batch `t` is injected at stage 0 each tick, the finished one is
-  collected from stage S-1; after `M + S - 1` ticks all M are done
-  (pipeline bubble (S-1)/(M+S-1), the 1F1B steady state);
+* micro-batch `t` is injected at stage 0 each tick; when stage S-1 emits
+  a finished micro-batch its loss is computed IN the same tick (nothing is
+  accumulated across ticks — 1F1B's bounded in-flight memory); after
+  `M + S - 1` ticks all M are done (bubble (S-1)/(M+S-1));
 * `jax.grad` through the schedule yields the reverse pipeline (backward
   collective-permutes run in the opposite direction) with gradient
   accumulation across micro-batches falling out of the scan — no explicit
@@ -72,9 +73,14 @@ class _BlockRun:
         self.prefixes = list(names)  # full-model param-name prefix per layer
         b0 = block_layers[0]
         self.apply0, params0, buffers0 = functionalize(b0)
-        assert not buffers0, (
-            "pipeline-scanned blocks must be buffer-free (no BatchNorm "
-            "running stats inside the scanned region)")
+        if buffers0:
+            raise ValueError(
+                "pipeline-scanned blocks must be buffer-free: found "
+                f"buffers {list(buffers0)}. BatchNorm-family layers keep "
+                "running stats that cannot be threaded through the compiled "
+                "1F1B schedule — use LayerNorm/GroupNorm inside pipeline "
+                "stages (reference PP shares this shape: SectionWorker "
+                "replays a per-stage program with no cross-stage state)")
         self.keys = list(params0.keys())
         per_layer = []
         for lyr in block_layers:
@@ -185,10 +191,13 @@ class PipelineParallelTrainStep:
 
         # ---- non-block ("edge") params: embeddings, final LN, head --------
         _, all_params, buffers = functionalize(model)
-        assert not buffers, (
-            "pipelined models must be buffer-free (no BatchNorm running "
-            f"stats): found {list(buffers)}; buffer state is not threaded "
-            "through the pipeline schedule")
+        if buffers:
+            raise ValueError(
+                "pipelined models must be buffer-free: found buffers "
+                f"{list(buffers)}. BatchNorm-family running stats cannot be "
+                "threaded through the compiled 1F1B schedule; use "
+                "LayerNorm/GroupNorm (or FrozenBatchNorm) in pipelined "
+                "models")
         block_full = {f"{pref}.{k}" for pref in prefixes
                       for k in self.run.keys}
         edge_params = {k: v for k, v in all_params.items()
@@ -269,9 +278,21 @@ class PipelineParallelTrainStep:
                     loss = loss_fn_(out, Tensor(labels))
             return loss.data if isinstance(loss, Tensor) else loss
 
+        post_loss_ckpt = jax.checkpoint(post_loss)
+
         def pipeline_loss(params, buffers_, rng, *batch):
             """params = {'edge':…, 'blocks':…}; batch = (*inputs, labels),
-            every array micro-batched with leading dim M."""
+            every array micro-batched with leading dim M.
+
+            1F1B memory behavior: each micro-batch's loss is computed INSIDE
+            the tick in which stage S-1 emits it — nothing is collected
+            across ticks, so live activations are the stage buffer
+            [S, B, T, D] (dim 0 on `pp`) plus the per-tick boundary
+            activations the scan saves for backward (one [B,T,D] per stage
+            per tick under remat). The round-1 design instead accumulated
+            all M outputs into a pp-replicated [M, B, T, D] buffer and ran a
+            separate loss phase — an extra M·B·T·D live per chip.
+            """
             inputs, labels = batch[:-1], batch[-1]
             r_pre, r_pipe, r_post = jax.random.split(rng, 3)
             # embeddings for all micro-batches at once (single big gather)
@@ -281,11 +302,10 @@ class PipelineParallelTrainStep:
             D_tail = embed.shape[2:]
             B = embed.shape[1]
             buf = jnp.zeros((S, B) + D_tail, embed.dtype)
-            collected = jnp.zeros((M, B) + D_tail, embed.dtype)
             stage_ids = jnp.arange(S)
 
             def tick(carry, t):
-                buf, collected = carry
+                buf, total = carry
                 buf = buf.at[0].set(embed[jnp.minimum(t, M - 1)])
                 buf = jax.lax.with_sharding_constraint(
                     buf, buf_data_spec(buf.ndim))
@@ -295,25 +315,21 @@ class PipelineParallelTrainStep:
                 out = jax.vmap(stage_apply)(params["blocks"], buf, rngs)
                 out = jax.lax.with_sharding_constraint(
                     out, buf_data_spec(out.ndim))
+                # drain: micro-batch m finishes when stage S-1 emits it
                 m = jnp.clip(t - (S - 1), 0, M - 1)
-                prev = jax.lax.dynamic_index_in_dim(collected, m,
-                                                    keepdims=False)
-                val = jnp.where(t >= S - 1, out[S - 1], prev)
-                collected = jax.lax.dynamic_update_index_in_dim(
-                    collected, val, m, axis=0)
+                y = jax.lax.dynamic_index_in_dim(labels, m, keepdims=False)
+                l = post_loss_ckpt(params, buffers_,
+                                   jax.random.fold_in(r_post, m),
+                                   out[S - 1], y)
+                # warmup ticks (t < S-1) run the head on pipeline-bubble
+                # garbage; the select drops both their value and gradient
+                total = total + jnp.where(t >= S - 1, l, 0.0)
                 buf = jnp.roll(out, 1, axis=0)  # -> collective-permute on pp
-                return (buf, collected), None
+                return (buf, total), None
 
-            (_, collected), _ = jax.lax.scan(
-                tick, (buf, collected), jnp.arange(M + S - 1))
-
-            def loss_body(acc, xs):
-                mb_rng, h, y = xs
-                l = post_loss(params, buffers_, mb_rng, h, y)
-                return acc + l, None
-            total, _ = jax.lax.scan(
-                jax.checkpoint(loss_body), jnp.asarray(0.0, jnp.float32),
-                (jax.random.split(r_post, M), collected, labels))
+            (_, total), _ = jax.lax.scan(
+                tick, (buf, jnp.asarray(0.0, jnp.float32)),
+                jnp.arange(M + S - 1))
             return total / M
 
         def step(flat_params, buffers_, opt_state, rng, lr, t, *batch):

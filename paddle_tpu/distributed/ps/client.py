@@ -18,8 +18,15 @@ from ... import _native
 
 _F32P = ctypes.POINTER(ctypes.c_float)
 _U64P = ctypes.POINTER(ctypes.c_uint64)
+_I32P = ctypes.POINTER(ctypes.c_int32)
 
-OPTIMIZERS = {"sgd": 0, "adagrad": 1, "adam": 2}
+# "sum" = raw delta-merge (w += g) — the server side of geo-SGD
+# (reference memory_sparse_geo_table.cc)
+OPTIMIZERS = {"sgd": 0, "adagrad": 1, "adam": 2, "sum": 3, "geo": 3}
+
+# per-request sparse batch budget (bytes of values); keeps every frame far
+# under the transport's 256MB kMaxFrameLen regardless of caller batch size
+_SPARSE_CHUNK_BYTES = 64 * 1024 * 1024
 
 
 @dataclass
@@ -72,90 +79,172 @@ class PSClient:
     def _dense_handle(self, table_id: int) -> int:
         return self._handles[table_id % self.num_servers]
 
+    # dense tables of any size: transport in <=16M-float (64MB) chunks so
+    # frames stay far under the 256MB transport cap
+    _DENSE_CHUNK = 16 * 1024 * 1024
+
     def pull_dense(self, table_id: int) -> np.ndarray:
         cfg = self._tables[table_id]
         out = np.empty(cfg.dense_size, np.float32)
-        rc = self._lib.ps_pull_dense(
-            self._dense_handle(table_id), table_id,
-            out.ctypes.data_as(_F32P), cfg.dense_size)
-        if rc != 0:
-            raise RuntimeError(f"pull_dense({table_id}) failed")
+        h = self._dense_handle(table_id)
+        for off in range(0, cfg.dense_size, self._DENSE_CHUNK):
+            ln = min(self._DENSE_CHUNK, cfg.dense_size - off)
+            chunk = out[off:off + ln]
+            rc = self._lib.ps_pull_dense(
+                h, table_id, chunk.ctypes.data_as(_F32P), off, ln)
+            if rc != 0:
+                raise RuntimeError(f"pull_dense({table_id}) failed")
         return out
 
     def push_dense(self, table_id: int, grad: np.ndarray):
         g = np.ascontiguousarray(grad, np.float32).ravel()
-        rc = self._lib.ps_push_dense(
-            self._dense_handle(table_id), table_id,
-            g.ctypes.data_as(_F32P), g.size)
-        if rc != 0:
-            raise RuntimeError(f"push_dense({table_id}) failed")
+        h = self._dense_handle(table_id)
+        for off in range(0, g.size, self._DENSE_CHUNK):
+            ln = min(self._DENSE_CHUNK, g.size - off)
+            chunk = np.ascontiguousarray(g[off:off + ln])
+            rc = self._lib.ps_push_dense(
+                h, table_id, chunk.ctypes.data_as(_F32P), off, ln)
+            if rc != 0:
+                raise RuntimeError(f"push_dense({table_id}) failed")
 
     def set_dense(self, table_id: int, values: np.ndarray):
         v = np.ascontiguousarray(values, np.float32).ravel()
-        rc = self._lib.ps_set_dense(
-            self._dense_handle(table_id), table_id,
-            v.ctypes.data_as(_F32P), v.size)
-        if rc != 0:
-            raise RuntimeError(f"set_dense({table_id}) failed")
+        h = self._dense_handle(table_id)
+        for off in range(0, v.size, self._DENSE_CHUNK):
+            ln = min(self._DENSE_CHUNK, v.size - off)
+            chunk = np.ascontiguousarray(v[off:off + ln])
+            rc = self._lib.ps_set_dense(
+                h, table_id, chunk.ctypes.data_as(_F32P), off, ln)
+            if rc != 0:
+                raise RuntimeError(f"set_dense({table_id}) failed")
 
     # ------------------------------ sparse --------------------------------
+
+    def _shard_indices(self, keys: np.ndarray):
+        """Yield (server_idx, positions) for the keys%num_servers routing
+        shared by every sparse op."""
+        ns = self.num_servers
+        if ns == 1:
+            yield 0, np.arange(keys.size)
+            return
+        shard = (keys % np.uint64(ns)).astype(np.int64)
+        for s in range(ns):
+            idx = np.nonzero(shard == s)[0]
+            if idx.size:
+                yield s, idx
 
     def pull_sparse(self, table_id: int, keys: np.ndarray) -> np.ndarray:
         """keys: uint64 [n] -> values float32 [n, dim]."""
         cfg = self._tables[table_id]
         keys = np.ascontiguousarray(keys, np.uint64).ravel()
-        n = keys.size
-        out = np.empty((n, cfg.dim), np.float32)
-        if n == 0:
+        out = np.empty((keys.size, cfg.dim), np.float32)
+        if keys.size == 0:
             return out
-        ns = self.num_servers
-        if ns == 1:
-            self._pull_shard(0, table_id, keys, out)
-            return out
-        shard = (keys % np.uint64(ns)).astype(np.int64)
-        for s in range(ns):
-            idx = np.nonzero(shard == s)[0]
-            if idx.size == 0:
-                continue
+        for s, idx in self._shard_indices(keys):
             part = np.empty((idx.size, cfg.dim), np.float32)
-            self._pull_shard(s, table_id, np.ascontiguousarray(keys[idx]), part)
+            self._pull_shard(s, table_id, np.ascontiguousarray(keys[idx]),
+                             part)
             out[idx] = part
         return out
 
+    def _sparse_chunk(self, dim: int) -> int:
+        return max(1, _SPARSE_CHUNK_BYTES // max(dim * 4, 16))
+
     def _pull_shard(self, s: int, table_id: int, keys: np.ndarray,
                     out: np.ndarray):
-        rc = self._lib.ps_pull_sparse(
-            self._handles[s], table_id, keys.ctypes.data_as(_U64P), keys.size,
-            out.ctypes.data_as(_F32P), out.size)
-        if rc != 0:
-            raise RuntimeError(f"pull_sparse({table_id}) failed")
+        step = self._sparse_chunk(out.shape[1] if out.ndim > 1 else 1)
+        for i in range(0, keys.size, step):
+            k = keys[i:i + step]
+            o = out[i:i + step]
+            rc = self._lib.ps_pull_sparse(
+                self._handles[s], table_id, k.ctypes.data_as(_U64P), k.size,
+                o.ctypes.data_as(_F32P), o.size)
+            if rc != 0:
+                raise RuntimeError(f"pull_sparse({table_id}) failed")
 
     def push_sparse(self, table_id: int, keys: np.ndarray, grads: np.ndarray):
         """keys uint64 [n], grads float32 [n, dim]."""
         keys = np.ascontiguousarray(keys, np.uint64).ravel()
         grads = np.ascontiguousarray(grads, np.float32).reshape(keys.size, -1)
-        n = keys.size
-        if n == 0:
+        if keys.size == 0:
             return
-        ns = self.num_servers
-        if ns == 1:
-            self._push_shard(0, table_id, keys, grads)
-            return
-        shard = (keys % np.uint64(ns)).astype(np.int64)
-        for s in range(ns):
-            idx = np.nonzero(shard == s)[0]
-            if idx.size == 0:
-                continue
+        for s, idx in self._shard_indices(keys):
             self._push_shard(s, table_id, np.ascontiguousarray(keys[idx]),
                              np.ascontiguousarray(grads[idx]))
 
     def _push_shard(self, s: int, table_id: int, keys: np.ndarray,
                     grads: np.ndarray):
-        rc = self._lib.ps_push_sparse(
-            self._handles[s], table_id, keys.ctypes.data_as(_U64P), keys.size,
-            grads.ctypes.data_as(_F32P), grads.size)
-        if rc != 0:
-            raise RuntimeError(f"push_sparse({table_id}) failed")
+        step = self._sparse_chunk(grads.shape[1] if grads.ndim > 1 else 1)
+        for i in range(0, keys.size, step):
+            k = np.ascontiguousarray(keys[i:i + step])
+            g = np.ascontiguousarray(grads[i:i + step])
+            rc = self._lib.ps_push_sparse(
+                self._handles[s], table_id, k.ctypes.data_as(_U64P), k.size,
+                g.ctypes.data_as(_F32P), g.size)
+            if rc != 0:
+                raise RuntimeError(f"push_sparse({table_id}) failed")
+
+    # -------------------- CTR lifecycle (ctr_accessor) ---------------------
+
+    def push_show_click(self, table_id: int, keys: np.ndarray,
+                        shows: np.ndarray, clicks: np.ndarray):
+        """Accumulate impression/click counters on sparse rows (reference
+        CtrCommonAccessor: show/click feed the eviction score)."""
+        keys = np.ascontiguousarray(keys, np.uint64).ravel()
+        shows = np.ascontiguousarray(shows, np.float32).ravel()
+        clicks = np.ascontiguousarray(clicks, np.float32).ravel()
+        for s, idx in self._shard_indices(keys):
+            k = np.ascontiguousarray(keys[idx])
+            sh = np.ascontiguousarray(shows[idx])
+            cl = np.ascontiguousarray(clicks[idx])
+            step = self._sparse_chunk(4)
+            for i in range(0, k.size, step):
+                ks = np.ascontiguousarray(k[i:i + step])
+                rc = self._lib.ps_push_show_click(
+                    self._handles[s], table_id,
+                    ks.ctypes.data_as(_U64P), ks.size,
+                    np.ascontiguousarray(sh[i:i + step]).ctypes.data_as(_F32P),
+                    np.ascontiguousarray(cl[i:i + step]).ctypes.data_as(_F32P))
+                if rc != 0:
+                    raise RuntimeError(f"push_show_click({table_id}) failed")
+
+    def shrink(self, table_id: int, threshold: float = 0.0,
+               max_unseen_days: int = 7) -> int:
+        """One day-tick: decay show/click, age rows, evict below-threshold
+        stale rows on every server. Returns total evicted rows."""
+        total = 0
+        for h in self._handles:
+            n = self._lib.ps_shrink(h, table_id, float(threshold),
+                                    int(max_unseen_days))
+            if n < 0:
+                raise RuntimeError(f"shrink({table_id}) failed")
+            total += int(n)
+        return total
+
+    def pull_meta(self, table_id: int, keys: np.ndarray):
+        """Per-key (show, click, unseen_days); unseen_days=-1 if evicted."""
+        keys = np.ascontiguousarray(keys, np.uint64).ravel()
+        n = keys.size
+        show = np.empty(n, np.float32)
+        click = np.empty(n, np.float32)
+        unseen = np.empty(n, np.int32)
+        for s, idx in self._shard_indices(keys):
+            k = np.ascontiguousarray(keys[idx])
+            sh = np.empty(idx.size, np.float32)
+            cl = np.empty(idx.size, np.float32)
+            un = np.empty(idx.size, np.int32)
+            step = self._sparse_chunk(4)
+            for i in range(0, k.size, step):
+                ks = np.ascontiguousarray(k[i:i + step])
+                rc = self._lib.ps_pull_meta(
+                    self._handles[s], table_id, ks.ctypes.data_as(_U64P),
+                    ks.size, sh[i:i + step].ctypes.data_as(_F32P),
+                    cl[i:i + step].ctypes.data_as(_F32P),
+                    un[i:i + step].ctypes.data_as(_I32P))
+                if rc != 0:
+                    raise RuntimeError(f"pull_meta({table_id}) failed")
+            show[idx], click[idx], unseen[idx] = sh, cl, un
+        return show, click, unseen
 
     # ------------------------- control plane ------------------------------
 

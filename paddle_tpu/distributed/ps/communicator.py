@@ -148,3 +148,96 @@ class Communicator:
 
 
 __all__ = ["Communicator"]
+
+
+class GeoCommunicator:
+    """Geo-SGD trainer-side communicator (reference GeoCommunicator,
+    `ps/service/communicator/communicator.h:566` + server table
+    `ps/table/memory_sparse_geo_table.cc`).
+
+    Geo mode: each trainer trains against a LOCAL copy of the sparse table
+    (optimizer applied locally, zero RPCs on the critical path); every
+    `trainers * geo_need_push_nums`-ish steps it pushes the accumulated
+    WEIGHT DELTA (w_local - w_base) to the server — whose table is created
+    with optimizer="sum" so deltas from all trainers merge additively —
+    and re-pulls the merged rows. Convergence is app-level eventual
+    consistency: exactly the reference's trade of freshness for throughput.
+    """
+
+    def __init__(self, client, lr: float = 0.01, geo_push_steps: int = 8):
+        self._client = client
+        self.lr = lr
+        self.geo_push_steps = geo_push_steps
+        # table_id -> key -> (local_vec, base_vec)
+        self._local: Dict[int, Dict[int, Tuple[np.ndarray, np.ndarray]]] = {}
+        self._dirty: Dict[int, set] = {}
+        self._step = 0
+
+    # ---------------- sparse path (local-first) ----------------------------
+    def _materialize(self, table_id: int, keys: np.ndarray) -> dict:
+        """Ensure every key has a local (value, base) pair; one batched RPC
+        for the misses only. Returns the table's local dict."""
+        tbl = self._local.setdefault(table_id, {})
+        missing = [k for k in keys.tolist() if k not in tbl]
+        if missing:
+            vals = self._client.pull_sparse(
+                table_id, np.asarray(missing, np.uint64))
+            for k, v in zip(missing, vals):
+                tbl[k] = (np.array(v, np.float32), np.array(v, np.float32))
+        return tbl
+
+    def pull_sparse(self, table_id: int, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.uint64).ravel()
+        if keys.size == 0:
+            return np.empty((0, self._client.table(table_id).dim), np.float32)
+        tbl = self._materialize(table_id, keys)
+        return np.stack([tbl[k][0] for k in keys.tolist()])
+
+    def push_sparse(self, table_id: int, keys: np.ndarray,
+                    grads: np.ndarray):
+        """LOCAL SGD apply + delta bookkeeping; periodic delta push."""
+        keys = np.asarray(keys, np.uint64).ravel()
+        if keys.size == 0:
+            return
+        grads = np.asarray(grads, np.float32).reshape(keys.size, -1)
+        tbl = self._materialize(table_id, keys)
+        dirty = self._dirty.setdefault(table_id, set())
+        for k, g in zip(keys.tolist(), grads):
+            local, base = tbl[k]
+            local -= self.lr * g
+            dirty.add(k)
+        self._step += 1
+        if self._step % self.geo_push_steps == 0:
+            self.geo_sync()
+
+    def geo_sync(self):
+        """Push accumulated deltas, re-pull merged state (one geo round)."""
+        for table_id, dirty in self._dirty.items():
+            if not dirty:
+                continue
+            tbl = self._local[table_id]
+            keys = np.asarray(sorted(dirty), np.uint64)
+            deltas = np.stack([tbl[int(k)][0] - tbl[int(k)][1]
+                               for k in keys.tolist()])
+            self._client.push_sparse(table_id, keys, deltas)  # server: w += d
+            merged = self._client.pull_sparse(table_id, keys)
+            for k, v in zip(keys.tolist(), merged):
+                tbl[k] = (np.array(v, np.float32), np.array(v, np.float32))
+            dirty.clear()
+
+    def flush(self):
+        self.geo_sync()
+
+    def stop(self):
+        """Final teardown: land every accumulated delta on the servers."""
+        self.geo_sync()
+
+    # everything else (dense ops, tables, barriers) passes through
+    def push_dense(self, table_id: int, grad: np.ndarray):
+        self._client.push_dense(table_id, grad)
+
+    def __getattr__(self, item):
+        return getattr(self._client, item)
+
+
+__all__ = ["Communicator", "GeoCommunicator"]

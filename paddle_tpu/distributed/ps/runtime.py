@@ -81,12 +81,18 @@ def run_server():
 # ------------------------------ worker side --------------------------------
 
 def init_worker(endpoints: Optional[List[str]] = None,
-                mode: str = "sync") -> PSClient:
+                mode: str = "sync", geo_lr: Optional[float] = None,
+                geo_push_steps: Optional[int] = None) -> PSClient:
     """Connect to all table servers (reference fleet.init_worker).
 
     mode="async" wraps the client in a background Communicator (reference
     AsyncCommunicator): pushes batch+merge off the critical path; pulls see
-    slightly stale server state."""
+    slightly stale server state.
+
+    mode="geo" wraps it in a GeoCommunicator (reference GeoCommunicator +
+    memory_sparse_geo_table): local-SGD on a cached sparse table with
+    periodic weight-delta push/merge — create sparse tables with
+    optimizer="sum" for this mode."""
     if _state["client"] is not None:
         return _state["client"]
     eps = endpoints or server_endpoints()
@@ -94,7 +100,20 @@ def init_worker(endpoints: Optional[List[str]] = None,
         raise RuntimeError(
             "init_worker: no PS endpoints (set PADDLE_PSERVERS_IP_PORT_LIST)")
     client = PSClient(eps)
-    if mode == "async" or os.environ.get("PADDLE_PS_MODE") == "async":
+    # an explicit non-default mode argument wins; the env is a fallback for
+    # launcher-driven configs where user code passes no mode
+    if mode == "sync":
+        mode = os.environ.get("PADDLE_PS_MODE", mode)
+    if mode == "geo":
+        from .communicator import GeoCommunicator
+        lr = geo_lr if geo_lr is not None else float(
+            os.environ.get("PADDLE_PS_GEO_LR", 0.01))
+        steps = geo_push_steps if geo_push_steps is not None else int(
+            os.environ.get("PADDLE_PS_GEO_PUSH_STEPS", 8))
+        geo = GeoCommunicator(client, lr=lr, geo_push_steps=steps)
+        _state["client"] = geo
+        return geo
+    if mode == "async":
         from .communicator import Communicator
         comm = Communicator(client)
         comm.start()

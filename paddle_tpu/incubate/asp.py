@@ -10,6 +10,7 @@ optimizer step (`OptimizerWithSparsityGuarantee`). The canonical config is
 """
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -49,6 +50,88 @@ def _mask_1d_rows(mat: np.ndarray, n: int, m: int) -> np.ndarray:
     return mask
 
 
+def _pad_to_blocks(mat: np.ndarray, m: int) -> np.ndarray:
+    r_pad = (-mat.shape[0]) % m
+    c_pad = (-mat.shape[1]) % m
+    if r_pad or c_pad:
+        mat = np.pad(mat, ((0, r_pad), (0, c_pad)))
+    return mat
+
+
+def _mask_2d_greedy_rows(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Greedy 2-D n:m (reference sparsity/utils.py get_mask_2d_greedy):
+    within each m x m block, admit entries in descending |value| order while
+    both their row and column budgets (< n kept) allow — guarantees <= n
+    non-zeros along BOTH dimensions of every block."""
+    rows, cols = mat.shape
+    a = np.abs(_pad_to_blocks(mat, m))
+    mask = np.zeros_like(a, dtype=bool)
+    for r0 in range(0, a.shape[0], m):
+        for c0 in range(0, a.shape[1], m):
+            block = a[r0:r0 + m, c0:c0 + m]
+            rc = np.zeros(m, np.int64)
+            cc = np.zeros(m, np.int64)
+            for idx in np.argsort(-block, axis=None):
+                r, c = divmod(int(idx), m)
+                if rc[r] < n and cc[c] < n:
+                    mask[r0 + r, c0 + c] = True
+                    rc[r] += 1
+                    cc[c] += 1
+    return mask[:rows, :cols]
+
+
+@functools.lru_cache(maxsize=None)
+def _valid_2d_patterns(n: int, m: int) -> np.ndarray:
+    """All m x m 0/1 matrices with exactly n ones in every row AND column
+    (reference compute_valid_2d_patterns). For 2:4 this is 90 patterns.
+
+    Enumerated by depth-first search with column-budget pruning — feasible
+    for the practical configs (m <= 6); larger m raises rather than
+    exploding combinatorially (the reference's exhaustive "best" search has
+    the same practical bound; use mask_2d_greedy beyond it)."""
+    import itertools
+    if m > 6:
+        raise NotImplementedError(
+            f"mask_2d_best is exhaustive over all n:m block patterns and is "
+            f"intractable for m={m}; use mask_2d_greedy for m > 6")
+    row_pats = [np.array(c) for c in
+                sorted({p for p in
+                        itertools.permutations([1] * n + [0] * (m - n))})]
+    pats = []
+
+    def rec(rows, colsum):
+        depth = len(rows)
+        if depth == m:
+            if (colsum == n).all():
+                pats.append(np.stack(rows))
+            return
+        rows_left_after = m - depth - 1
+        for rp in row_pats:
+            ns = colsum + rp
+            # prune: no column may exceed n, and every column must still be
+            # able to reach n with the rows that remain
+            if (ns > n).any() or (ns + rows_left_after < n).any():
+                continue
+            rec(rows + [rp], ns)
+
+    rec([], np.zeros(m, np.int64))
+    return np.stack(pats).astype(np.float64)
+
+
+def _mask_2d_best_rows(mat: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Exhaustive 2-D n:m (reference get_mask_2d_best): per m x m block pick
+    the valid pattern that retains the largest |value| mass."""
+    rows, cols = mat.shape
+    a = np.abs(_pad_to_blocks(mat, m)).astype(np.float64)
+    R, C = a.shape
+    pats = _valid_2d_patterns(n, m)  # [P, m, m]
+    blocks = a.reshape(R // m, m, C // m, m).transpose(0, 2, 1, 3)
+    scores = np.tensordot(blocks, pats, axes=([2, 3], [1, 2]))  # [Rb, Cb, P]
+    best = np.argmax(scores, axis=-1)
+    mask = pats[best].transpose(0, 2, 1, 3).reshape(R, C).astype(bool)
+    return mask[:rows, :cols]
+
+
 def _reduction_view(arr: np.ndarray) -> np.ndarray:
     """2D view [kept_dim, reduction_dim] whose LAST axis is the matmul/conv
     reduction axis — where n:m groups must run (reference sparsity/utils.py):
@@ -64,11 +147,14 @@ def _reduction_view(arr: np.ndarray) -> np.ndarray:
 def create_mask(x, func_name: str = "mask_1d", n: int = 2, m: int = 4) -> np.ndarray:
     """n:m sparsity mask with the same shape as x, groups along the
     reduction axis (see _reduction_view)."""
-    if func_name not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+    algos = {"mask_1d": _mask_1d_rows,
+             "mask_2d_greedy": _mask_2d_greedy_rows,
+             "mask_2d_best": _mask_2d_best_rows}
+    if func_name not in algos:
         raise ValueError(f"unknown mask algo {func_name}")
     arr = np.asarray(x.data if isinstance(x, Tensor) else x)
     view = _reduction_view(arr)
-    mask = _mask_1d_rows(view, n, m)
+    mask = algos[func_name](view, n, m)
     if arr.ndim == 1:
         return mask.reshape(arr.shape)
     if arr.ndim == 2:
@@ -88,6 +174,16 @@ def check_mask_1d(x, n: int = 2, m: int = 4) -> bool:
 
 
 check_sparsity = check_mask_1d
+
+
+def check_mask_2d(x, n: int = 2, m: int = 4) -> bool:
+    """True iff every m x m block keeps <= n entries per row AND column."""
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    arr = _pad_to_blocks(_reduction_view(arr), m)
+    R, C = arr.shape
+    blocks = (arr != 0).reshape(R // m, m, C // m, m).transpose(0, 2, 1, 3)
+    return bool((blocks.sum(axis=3) <= n).all()
+                and (blocks.sum(axis=2) <= n).all())
 
 
 def set_excluded_layers(model: Layer, param_names: List[str]):
@@ -163,6 +259,7 @@ def decorate(optimizer, model: Layer, n: int = 2, m: int = 4):
 
 
 __all__ = ["calculate_density", "create_mask", "check_mask_1d",
+           "check_mask_2d",
            "check_sparsity", "prune_model", "decorate",
            "set_excluded_layers", "reset_excluded_layers",
            "OptimizerWithSparsityGuarantee"]

@@ -108,8 +108,28 @@ class StaticLayer:
 
     def __init__(self, layer: Layer, jit_kwargs: Optional[dict] = None):
         self.layer = layer
+        self._maybe_convert_forward(layer)
         self.apply_fn, _, _ = functionalize(layer)
         self._jitted = jax.jit(self.apply_fn, static_argnames=())
+
+    @staticmethod
+    def _maybe_convert_forward(layer: Layer):
+        """dy2static: rewrite tensor-dependent `if`/`while` in forward() into
+        lax control flow (reference ProgramTranslator AST transpile,
+        `dygraph_to_static/program_translator.py:775`). Trace-only remains
+        the fast path for control-flow-free forwards."""
+        import types
+        from . import dy2static
+        fwd = type(layer).forward
+        if getattr(fwd, "_dy2s_converted", False) or \
+                getattr(layer.forward, "__func__", None) is not fwd:
+            return
+        if dy2static.needs_transform(fwd):
+            new_fwd = dy2static.ast_transform(fwd)
+            if new_fwd is not fwd:
+                new_fwd._dy2s_converted = True
+                object.__setattr__(layer, "forward",
+                                   types.MethodType(new_fwd, layer))
 
     def __call__(self, *inputs, **kw):
         params = {k: p.data for k, p in self.layer.named_parameters()}
@@ -138,6 +158,9 @@ def to_static(layer_or_fn=None, input_spec=None, build_strategy=None, **kw):
             return obj
         if isinstance(obj, Layer):
             return StaticLayer(obj)
+        from . import dy2static
+        if dy2static.needs_transform(obj):
+            obj = dy2static.ast_transform(obj)
 
         @functools.wraps(obj)
         def wrapper(*args, **kwargs):

@@ -1,0 +1,579 @@
+"""dy2static — AST conversion of tensor-dependent Python control flow.
+
+Reference: the dygraph_to_static transpiler
+(`/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:775` + per-construct transformers): Paddle rewrites
+`if`/`while`/`for`/bool-ops over tensors into graph ops
+(`convert_ifelse`, `convert_while_loop` in `convert_operators.py`).
+
+TPU-native equivalent: plain tracing (jax) already handles everything
+EXCEPT data-dependent Python control flow — a traced `if tensor:` either
+raises (TracerBoolConversionError) or, worse, a concrete-but-traced branch
+is silently baked in. This module closes that gap:
+
+* `ast_transform(fn)` rewrites the function's AST so every `if` / `while` /
+  `and` / `or` / `not` goes through a RUNTIME dispatcher;
+* the dispatchers (`convert_ifelse`, `convert_while`, `convert_logical_*`)
+  keep exact Python semantics when the condition is a concrete value and
+  switch to `lax.cond` / `lax.while_loop` / `jnp.logical_*` when it is a
+  tracer — so one source supports both eager and `to_static` execution;
+* constructs that cannot be converted (a `return`/`break`/`continue` that
+  escapes a tensor-dependent branch) raise a PRECISE error at trace time
+  instead of jax's generic tracer error.
+
+Scope (documented): branch/loop bodies may contain assignments, nested
+control flow and calls. Variables mutated in a converted region become the
+`lax.cond` operands / `while_loop` carry, so both branches must leave them
+with matching structure (jax enforces; we re-raise with the variable
+names). `for` loops keep Python semantics (unrolled under trace — the
+jax-idiomatic treatment; use `paddle.jit.not_to_static` or lax.scan for
+long dynamic loops).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["ast_transform", "needs_transform", "convert_ifelse",
+           "convert_while", "convert_logical_and", "convert_logical_or",
+           "convert_logical_not", "Undefined"]
+
+
+class Undefined:
+    """Sentinel for names not yet bound when a converted region starts."""
+    _inst: "Optional[Undefined]" = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        raise NameError(
+            "variable used before assignment inside a to_static-converted "
+            "branch (it was undefined before the branch and only assigned "
+            "in one side)")
+
+
+_UNDEF = Undefined()
+
+
+def _raw(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x) -> bool:
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+# --------------------------- runtime dispatchers -----------------------------
+
+def _jaxable(v) -> bool:
+    import numpy as np
+    v = _raw(v)
+    return isinstance(v, (jax.Array, jax.core.Tracer, np.ndarray, np.number,
+                          np.bool_, int, float, bool, complex))
+
+
+def convert_ifelse(cond, true_fn: Callable, false_fn: Callable,
+                   names: Sequence[str], operands: Tuple):
+    """`if cond: ... else: ...` with `names` = variables either side may
+    assign; returns their final values.
+
+    Traced path: both branches are probed inline (XLA DCEs the dead probe
+    ops) to discover which outputs are tensor-like on BOTH sides — those
+    ride `lax.cond`; branch-local temporaries (tensor on one side only, or
+    non-tensor) come back as the `Undefined` sentinel, which raises a named
+    error if actually used later.
+    """
+    c = _raw(cond)
+    if not _is_traced(c):
+        return true_fn(*operands) if c else false_fn(*operands)
+    n = len(names)
+    defined_idx = [i for i, v in enumerate(operands)
+                   if not isinstance(v, Undefined)]
+
+    def call_with(branch, ops_def):
+        full = list(operands)
+        for j, i in enumerate(defined_idx):
+            full[i] = ops_def[j]
+        out = branch(*full)
+        return tuple(out)
+
+    probe_t = tuple(true_fn(*operands))
+    probe_f = tuple(false_fn(*operands))
+    carried = [i for i in range(n)
+               if _jaxable(probe_t[i]) and _jaxable(probe_f[i])]
+    fixed = {}
+    for i in range(n):
+        if i in carried:
+            continue
+        if probe_t[i] is probe_f[i]:
+            fixed[i] = probe_t[i]  # same object on both sides: bind it
+        else:
+            fixed[i] = _UNDEF  # branch-local temp; poisoned if used later
+
+    def tf(ops_def):
+        out = call_with(true_fn, ops_def)
+        return tuple(_raw(out[i]) for i in carried)
+
+    def ff(ops_def):
+        out = call_with(false_fn, ops_def)
+        return tuple(_raw(out[i]) for i in carried)
+
+    ops = tuple(_raw(operands[i]) for i in defined_idx)
+    try:
+        res = jax.lax.cond(jnp.asarray(c, bool).reshape(()), tf, ff, ops)
+    except TypeError as e:
+        raise TypeError(
+            f"to_static: the two sides of a tensor-dependent `if` must "
+            f"assign matching shapes/dtypes to {list(names)} "
+            f"(lax.cond branches differ): {e}") from None
+    final = []
+    pos = {i: j for j, i in enumerate(carried)}
+    for i in range(n):
+        if i in pos:
+            v = res[pos[i]]
+            final.append(Tensor(v) if isinstance(v, jax.Array) else v)
+        else:
+            final.append(fixed[i])
+    return tuple(final)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable,
+                  names: Sequence[str], operands: Tuple):
+    """`while cond: body` with `names` = variables the body assigns.
+
+    Traced path: variables both bound-before-the-loop and tensor-like ride
+    the `lax.while_loop` carry; loop-local temporaries (unbound before the
+    loop) are recomputed inside each body call and come back `Undefined`
+    after the loop — Python leaves them at their last value, so reading
+    them afterwards is the (documented) semantic difference.
+    """
+    c0 = _raw(cond_fn(*operands))
+    if not _is_traced(c0):
+        vals = tuple(operands)
+        while cond_fn(*vals):
+            vals = tuple(body_fn(*vals))
+        return vals
+    n = len(names)
+    probe = tuple(body_fn(*operands))
+    carried = [i for i in range(n)
+               if not isinstance(operands[i], Undefined)
+               and _jaxable(operands[i]) and _jaxable(probe[i])]
+    fixed = {}
+    for i in range(n):
+        if i in carried:
+            continue
+        if probe[i] is operands[i]:
+            fixed[i] = operands[i]  # body does not actually change it
+        elif isinstance(operands[i], Undefined):
+            fixed[i] = _UNDEF  # loop-local temp
+        else:
+            raise NotImplementedError(
+                f"to_static: `while` loop variable '{names[i]}' is not a "
+                f"tensor/scalar (got {type(operands[i]).__name__}) and "
+                f"changes across iterations — it cannot ride the "
+                f"lax.while_loop carry. Hoist it out of the loop or use "
+                f"paddle.jit.not_to_static.")
+
+    def call_with(ops_def):
+        full = list(operands)
+        for j, i in enumerate(carried):
+            full[i] = ops_def[j]
+        return full
+
+    def cf(ops):
+        return jnp.asarray(_raw(cond_fn(*call_with(ops))), bool).reshape(())
+
+    def bf(ops):
+        out = tuple(body_fn(*call_with(ops)))
+        return tuple(_raw(out[i]) for i in carried)
+
+    ops0 = tuple(_raw(operands[i]) for i in carried)
+    # dtypes must be loop-invariant: weak python scalars entering the carry
+    # are promoted to their probe dtype up front
+    ops0 = tuple(jnp.asarray(o, _raw(probe[i]).dtype
+                             if _is_traced(probe[i]) else None)
+                 if not isinstance(o, (jax.Array, jax.core.Tracer))
+                 else o
+                 for o, i in zip(ops0, carried))
+    try:
+        res = jax.lax.while_loop(cf, bf, ops0)
+    except TypeError as e:
+        raise TypeError(
+            f"to_static: a tensor-dependent `while` must keep the shape/"
+            f"dtype of its loop variables {list(names)} fixed across "
+            f"iterations (lax.while_loop carry mismatch): {e}") from None
+    final = []
+    pos = {i: j for j, i in enumerate(carried)}
+    for i in range(n):
+        if i in pos:
+            v = res[pos[i]]
+            final.append(Tensor(v) if isinstance(v, jax.Array) else v)
+        else:
+            final.append(fixed[i])
+    return tuple(final)
+
+
+def convert_logical_and(lhs, rhs_thunk: Callable):
+    l = _raw(lhs)
+    if _is_traced(l):
+        r = _raw(rhs_thunk())
+        return Tensor(jnp.logical_and(jnp.asarray(l, bool),
+                                      jnp.asarray(r, bool)))
+    return rhs_thunk() if l else lhs
+
+
+def convert_logical_or(lhs, rhs_thunk: Callable):
+    l = _raw(lhs)
+    if _is_traced(l):
+        r = _raw(rhs_thunk())
+        return Tensor(jnp.logical_or(jnp.asarray(l, bool),
+                                     jnp.asarray(r, bool)))
+    return lhs if l else rhs_thunk()
+
+
+def convert_logical_not(x):
+    v = _raw(x)
+    if _is_traced(v):
+        return Tensor(jnp.logical_not(jnp.asarray(v, bool)))
+    return not v
+
+
+def assert_not_traced(cond, construct: str, detail: str):
+    """Loud diagnostic for control flow we cannot convert."""
+    if _is_traced(cond):
+        raise NotImplementedError(
+            f"to_static: {construct} depends on a traced tensor but cannot "
+            f"be converted to lax control flow because {detail}. "
+            f"Restructure the code (e.g. hoist the `return` out of the "
+            f"branch, or compute both results and select with "
+            f"paddle.where), or exempt the function with "
+            f"paddle.jit.not_to_static.")
+    return cond
+
+
+# ----------------------------- AST analysis ---------------------------------
+
+class _ScopedStoreCollector(ast.NodeVisitor):
+    """Names assigned at the scope of the visited statements — does NOT
+    descend into nested function/class/lambda/comprehension scopes."""
+
+    def __init__(self):
+        self.names: List[str] = []
+
+    def _add(self, name):
+        if name not in self.names:
+            self.names.append(name)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self._add(node.name)  # the def itself binds a name
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_ListComp(self, node):
+        for gen in node.generators:
+            self.visit(gen.iter)
+
+    visit_SetComp = visit_DictComp = visit_GeneratorExp = visit_ListComp
+
+
+def _stored_names(stmts: Sequence[ast.stmt]) -> List[str]:
+    c = _ScopedStoreCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+class _EscapeFinder(ast.NodeVisitor):
+    """Finds return/break/continue that would escape the given body."""
+
+    def __init__(self):
+        self.has_return = False
+        self.has_break = False
+        self._loop_depth = 0
+
+    def visit_Return(self, node):
+        self.has_return = True
+
+    def visit_Break(self, node):
+        if self._loop_depth == 0:
+            self.has_break = True
+
+    def visit_Continue(self, node):
+        if self._loop_depth == 0:
+            self.has_break = True
+
+    def visit_For(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_While = visit_AsyncFor = visit_For
+
+    def visit_FunctionDef(self, node):
+        pass  # nested scope
+
+    visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+
+def _escapes(stmts: Sequence[ast.stmt]) -> bool:
+    f = _EscapeFinder()
+    for s in stmts:
+        f.visit(s)
+    return f.has_return or f.has_break
+
+
+def needs_transform(fn: Callable) -> bool:
+    """True if fn's source contains constructs worth rewriting (if / while /
+    bool ops) — the trace-only fast path is kept otherwise."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return False
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While, ast.BoolOp, ast.Not)):
+            return True
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return True
+    return False
+
+
+# ----------------------------- AST transform --------------------------------
+
+_HELPERS = {
+    "__dy2s_ifelse": convert_ifelse,
+    "__dy2s_while": convert_while,
+    "__dy2s_and": convert_logical_and,
+    "__dy2s_or": convert_logical_or,
+    "__dy2s_not": convert_logical_not,
+    "__dy2s_assert_plain": assert_not_traced,
+    "__dy2s_undef": _UNDEF,
+}
+
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _try_fetch(tmp: str, name: str) -> List[ast.stmt]:
+    """tmp = name if bound else __dy2s_undef (as a try/except statement)."""
+    return [ast.Try(
+        body=[ast.Assign(targets=[_store(tmp)], value=_load(name))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Tuple(elts=[_load("NameError"),
+                                 _load("UnboundLocalError")], ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(targets=[_store(tmp)],
+                             value=_load("__dy2s_undef"))])],
+        orelse=[], finalbody=[])]
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # ---- boolean operators --------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "__dy2s_and" if isinstance(node.op, ast.And) else "__dy2s_or"
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            expr = ast.Call(
+                func=_load(op),
+                args=[expr, ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                       kw_defaults=[], defaults=[]),
+                    body=rhs)],
+                keywords=[])
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                ast.Call(func=_load("__dy2s_not"), args=[node.operand],
+                         keywords=[]), node)
+        return node
+
+    # ---- if -----------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        uid = self._uid()
+        cond_name = f"__dy2s_c{uid}"
+        names = _stored_names(node.body + node.orelse)
+        out: List[ast.stmt] = [
+            ast.Assign(targets=[_store(cond_name)], value=node.test)]
+        if _escapes(node.body) or _escapes(node.orelse) or not names:
+            # cannot build branch functions: keep the Python `if`, but make
+            # a tensor condition fail with a precise diagnostic
+            reason = ("a branch contains return/break/continue that leaves "
+                      "the branch" if (_escapes(node.body)
+                                       or _escapes(node.orelse))
+                      else "its branches assign no variables to carry")
+            guard = ast.Expr(value=ast.Call(
+                func=_load("__dy2s_assert_plain"),
+                args=[_load(cond_name),
+                      ast.Constant(value="an `if` statement"),
+                      ast.Constant(value=reason)], keywords=[]))
+            new_if = ast.If(test=_load(cond_name), body=node.body,
+                            orelse=node.orelse)
+            return [ast.copy_location(s, node)
+                    for s in out + [guard, new_if]]
+
+        tname, fname = f"__dy2s_t{uid}", f"__dy2s_f{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(elts=[_load(n) for n in names],
+                                         ctx=ast.Load()))
+        tdef = ast.FunctionDef(name=tname, args=args,
+                               body=node.body + [ret], decorator_list=[])
+        fdef = ast.FunctionDef(name=fname, args=args,
+                               body=(node.orelse or [ast.Pass()]) + [ret],
+                               decorator_list=[])
+        out += [tdef, fdef]
+        opnames = []
+        for n in names:
+            tmp = f"__dy2s_v{uid}_{len(opnames)}"
+            out += _try_fetch(tmp, n)
+            opnames.append(tmp)
+        call = ast.Call(
+            func=_load("__dy2s_ifelse"),
+            args=[_load(cond_name), _load(tname), _load(fname),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load()),
+                  ast.Tuple(elts=[_load(t) for t in opnames],
+                            ctx=ast.Load())],
+            keywords=[])
+        out.append(ast.Assign(
+            targets=[ast.Tuple(elts=[_store(n) for n in names],
+                               ctx=ast.Store())],
+            value=call))
+        return [ast.copy_location(s, node) for s in out]
+
+    # ---- while --------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        uid = self._uid()
+        names = _stored_names(node.body)
+        if _escapes(node.body) or node.orelse or not names:
+            cond_name = f"__dy2s_c{uid}"
+            reason = ("the loop body contains return/break/continue"
+                      if _escapes(node.body) else
+                      ("`while ... else` is not convertible" if node.orelse
+                       else "the loop body assigns no variables to carry"))
+            pre = ast.Assign(targets=[_store(cond_name)], value=node.test)
+            guard = ast.Expr(value=ast.Call(
+                func=_load("__dy2s_assert_plain"),
+                args=[_load(cond_name),
+                      ast.Constant(value="a `while` loop"),
+                      ast.Constant(value=reason)], keywords=[]))
+            new_while = ast.While(test=node.test, body=node.body,
+                                  orelse=node.orelse)
+            return [ast.copy_location(s, node)
+                    for s in [pre, guard, new_while]]
+
+        cname, bname = f"__dy2s_wc{uid}", f"__dy2s_wb{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cdef = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        ret = ast.Return(value=ast.Tuple(elts=[_load(n) for n in names],
+                                         ctx=ast.Load()))
+        bdef = ast.FunctionDef(name=bname, args=args,
+                               body=node.body + [ret], decorator_list=[])
+        out: List[ast.stmt] = [cdef, bdef]
+        opnames = []
+        for n in names:
+            tmp = f"__dy2s_v{uid}_{len(opnames)}"
+            out += _try_fetch(tmp, n)
+            opnames.append(tmp)
+        call = ast.Call(
+            func=_load("__dy2s_while"),
+            args=[_load(cname), _load(bname),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load()),
+                  ast.Tuple(elts=[_load(t) for t in opnames],
+                            ctx=ast.Load())],
+            keywords=[])
+        out.append(ast.Assign(
+            targets=[ast.Tuple(elts=[_store(n) for n in names],
+                               ctx=ast.Store())],
+            value=call))
+        return [ast.copy_location(s, node) for s in out]
+
+
+_transform_cache: Dict[Any, Callable] = {}
+
+
+def ast_transform(fn: Callable) -> Callable:
+    """Return fn with tensor-convertible control flow, or fn itself when the
+    source is unavailable / contains nothing to rewrite."""
+    key = getattr(fn, "__wrapped__", fn)
+    if key in _transform_cache:
+        return _transform_cache[key]
+    if not needs_transform(fn):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []  # avoid re-running to_static et al on exec
+    new_tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    glb = dict(fn.__globals__)
+    glb.update(_HELPERS)
+    # closures: snapshot free-variable cells into the namespace (late
+    # rebinding of closed-over names is not tracked — document & accept)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    ns: Dict[str, Any] = {}
+    exec(code, glb, ns)
+    new_fn = ns[fdef.name]
+    new_fn = functools.wraps(fn)(new_fn)
+    _transform_cache[key] = new_fn
+    return new_fn

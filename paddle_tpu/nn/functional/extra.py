@@ -441,7 +441,7 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     @kernel("ctc_loss")
     def impl(logp, lab, in_len, lab_len, *, blank=blank,
-             reduction=reduction):
+             reduction=reduction, norm_by_times=norm_by_times):
         if logp.ndim == 3 and logp.shape[0] != lab.shape[0]:
             pass  # already [T,B,V]
         T, B, V = logp.shape
@@ -490,7 +490,13 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
         a_prev = jnp.take_along_axis(
             alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1)[:, 0]
+        # zero-length labels: ext is the single blank path, there is no
+        # "previous" state — idx_last-1 would alias state 0 and double-count
+        a_prev = jnp.where(ext_len > 1, a_prev, NEG)
         nll = -jnp.logaddexp(a_last, a_prev)
+        if norm_by_times:
+            # warpctc norm_by_times: per-sample loss scaled by 1/T_i
+            nll = nll / jnp.maximum(in_len.astype(jnp.float32), 1.0)
         if reduction == "mean":
             return jnp.mean(nll / jnp.maximum(lab_len.astype(jnp.float32), 1))
         if reduction == "sum":

@@ -1,9 +1,23 @@
-"""Flash attention.
+"""Flash attention: Pallas fwd+bwd kernels under `jax.custom_vjp`.
 
 TPU-native replacement for the reference's fused attention
 (`/root/reference/paddle/fluid/operators/fused/fused_attention_op.cu` +
-`fmha_ref.h` — which materializes the [B,H,L,L] score matrix). Here:
-an online-softmax Pallas kernel tiled for the MXU, with an XLA fallback.
+`fmha_ref.h` — which materializes the [B,H,L,L] score matrix in fwd AND
+saves softmax-out for bwd). Here:
+
+* forward: online-softmax Pallas kernel tiled for the MXU; residuals are
+  only (q, k, v, out, logsumexp) — O(L) extra memory, never [L,L];
+* backward: two Pallas kernels (dq over q-blocks; dk/dv over k-blocks)
+  that RECOMPUTE the probabilities from (q, k, lse) per tile, flash-style;
+* dispatch is gated by an eager capability probe compiled at the exact
+  production shapes (a Mosaic failure inside the user's outer jit cannot
+  be caught — see `layer_norm._pallas_ln_ok`), so there is NO silent
+  runtime fallback: once probed OK, the Pallas path is the path taken,
+  including under `value_and_grad`.
+
+`_stats` counts dispatch decisions at trace time so tests can assert the
+kernel path is actually exercised (round-1 review found the old fwd-only
+kernel silently dead in training).
 
 Layout convention (paddle): q/k/v are [batch, seq, heads, head_dim].
 """
@@ -15,6 +29,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+_NEG = -1e30
+
+# dispatch decisions, counted at trace time (reset freely in tests)
+_stats = {"pallas": 0, "pallas_fwd": 0, "pallas_bwd": 0, "xla": 0}
+
+# tests set True: kernels run in the Pallas interpreter on CPU, so the
+# real kernel logic + custom_vjp wiring is exercised without a TPU
+_INTERPRET = False
+
+_MAX_PALLAS_KV = 4096  # K/V kept VMEM-resident per (batch, head)
+
+_STATS_LANES = 8  # lse/delta lane padding (see _fa_fwd_kernel comment)
+
 
 def _on_tpu() -> bool:
     try:
@@ -24,7 +51,7 @@ def _on_tpu() -> bool:
 
 
 def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None):
-    """XLA-composed attention.
+    """XLA-composed attention (fallback for masks / short or ragged seqs).
 
     The [B,H,L,L] score matrix is kept in the INPUT dtype (bf16 in mixed-
     precision training) — on a bandwidth-bound chip the fp32 score array is
@@ -39,10 +66,12 @@ def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None):
         scale = 1.0 / np.sqrt(D)
     acc_t = q.dtype if q.dtype in (jnp.dtype(jnp.bfloat16),
                                    jnp.dtype(jnp.float16)) else jnp.float32
-    # "floor" = very-negative but FINITE in acc_t; everything is clamped to
-    # it so additive -1e9/-inf masks (or causal+mask stacking) can never
-    # overflow to -inf and poison softmax rows with NaN
-    floor = jnp.asarray(-1e4 if acc_t == jnp.dtype(jnp.float16) else -1e30,
+    # "floor" = very-negative but FINITE in acc_t, used for the where()
+    # branches and to clamp the ADDITIVE mask term (so a -1e9/-inf mask
+    # cannot overflow acc_t). Genuine logits are never clamped: for the
+    # sum logit+floor to overflow fp16 a real logit would have to be
+    # below -5e4, far outside the plausible range.
+    floor = jnp.asarray(-1e4 if acc_t == jnp.dtype(jnp.float16) else _NEG,
                         acc_t)
     qs = (q * jnp.asarray(scale, q.dtype))
     logits = jnp.einsum("blhd,bmhd->bhlm", qs, k,
@@ -54,72 +83,173 @@ def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None):
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, floor)
         else:
-            # clamp only on this path: adding a -1e9-style mask (or stacking
-            # with the causal floor) is the overflow-to--inf risk; the
-            # where() branches already floor exactly
-            logits = jnp.maximum(logits + jnp.maximum(mask.astype(acc_t),
-                                                      floor), floor)
+            # clamp ONLY the mask term (ADVICE r1): real scores stay exact
+            logits = logits + jnp.maximum(mask.astype(acc_t), floor)
     # max-subtracted softmax; row stats accumulate in fp32 (tiny arrays)
     m = jnp.max(logits.astype(jnp.float32), axis=-1, keepdims=True)
     p = jnp.exp(logits - m.astype(acc_t))
     denom = jnp.sum(p.astype(jnp.float32), axis=-1, keepdims=True)
+    denom = jnp.maximum(denom, 1e-30)
     probs = (p / denom.astype(acc_t)).astype(v.dtype)
     out = jnp.einsum("bhlm,bmhd->blhd", probs, v)
     return out.astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k"))
-def _flash_attention_pallas(q, k, v, causal=False, scale=None,
-                            block_q=256, block_k=256):
-    """Pallas online-softmax attention over [B,H] grid, tiled (block_q, block_k)."""
+# --------------------------- Pallas kernels ---------------------------------
+#
+# All kernels run over grid (B, H, seq-blocks) on [B,H,L,D]-transposed
+# inputs; K/V (and in dkv, Q/dO) are VMEM-resident per (b,h) and walked in
+# (block) chunks by a fori_loop. MXU matmuls take narrow (bf16) inputs with
+# fp32 accumulation via preferred_element_type; softmax math is fp32.
+
+
+def _dotT(a, b):
+    # a [m, d] @ b.T [d, n] -> f32 [m, n]
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _dot(a, b):
+    return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                   block_k, kv_len, kv_offset):
+    """One q-block vs all k-blocks, online softmax. kv_offset = Lk - Lq."""
+    from jax.experimental import pallas as pl
+
+    bq, D = q_ref.shape
+    qb = q_ref[...]
+    qi = pl.program_id(2)
+    m0 = jnp.full((bq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, D), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[pl.dslice(j * block_k, block_k), :]
+        vb = v_ref[pl.dslice(j * block_k, block_k), :]
+        s = _dotT(qb, kb) * scale  # f32 [bq, bk]
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows + kv_offset >= cols, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + _dot(p.astype(vb.dtype), vb)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only blocks at or before this q-block's diagonal
+        n_k = jnp.minimum(pl.cdiv(kv_len, block_k),
+                          pl.cdiv((qi + 1) * bq + kv_offset, block_k))
+    else:
+        n_k = pl.cdiv(kv_len, block_k)
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    # row stats live in a [.., L, 8]-padded layout: Mosaic requires the last
+    # two block dims be (8k, 128k) or equal to the array dims — a 1-D
+    # (block_q,) stats block is rejected once B/H are squeezed
+    lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[:, None],
+                                    (bq, _STATS_LANES))
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, *, scale, causal, block_k, kv_len, kv_offset):
+    from jax.experimental import pallas as pl
+
+    bq, D = q_ref.shape
+    qb = q_ref[...]
+    dob = do_ref[...]
+    lse = lse_ref[...][:, 0]
+    delta = delta_ref[...][:, 0]
+    qi = pl.program_id(2)
+
+    def body(j, dq):
+        kb = k_ref[pl.dslice(j * block_k, block_k), :]
+        vb = v_ref[pl.dslice(j * block_k, block_k), :]
+        s = _dotT(qb, kb) * scale
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows + kv_offset >= cols, s, _NEG)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        dp = _dotT(dob, vb)
+        ds = p * (dp - delta[:, None])
+        return dq + _dot(ds.astype(kb.dtype), kb) * scale
+
+    if causal:
+        n_k = jnp.minimum(pl.cdiv(kv_len, block_k),
+                          pl.cdiv((qi + 1) * bq + kv_offset, block_k))
+    else:
+        n_k = pl.cdiv(kv_len, block_k)
+    dq = jax.lax.fori_loop(0, n_k,
+                           body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, *, scale, causal, block_q, q_len,
+                       kv_offset):
+    from jax.experimental import pallas as pl
+
+    bk, D = k_ref.shape
+    kb = k_ref[...]
+    vb = v_ref[...]
+    ki = pl.program_id(2)
+
+    def body(j, carry):
+        dk, dv = carry
+        qb = q_ref[pl.dslice(j * block_q, block_q), :]
+        dob = do_ref[pl.dslice(j * block_q, block_q), :]
+        lse = lse_ref[pl.dslice(j * block_q, block_q), :][:, 0]
+        delta = delta_ref[pl.dslice(j * block_q, block_q), :][:, 0]
+        s = _dotT(qb, kb) * scale  # [bq, bk]
+        if causal:
+            rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows + kv_offset >= cols, s, _NEG)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv + _dot(p.astype(dob.dtype).T, dob)
+        dp = _dotT(dob, vb)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + _dot(ds.astype(qb.dtype).T, qb) * scale
+        return dk_new, dv_new
+
+    if causal:
+        # first q-block whose rows can see this k-block: row >= col - offset
+        j0 = jnp.maximum(ki * bk - kv_offset, 0) // block_q
+    else:
+        j0 = 0
+    n_q = pl.cdiv(q_len, block_q)
+    dk, dv = jax.lax.fori_loop(
+        j0, n_q, body, (jnp.zeros((bk, D), jnp.float32),
+                        jnp.zeros((bk, D), jnp.float32)))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def _fa_fwd_pallas(q, k, v, causal, scale, block_q=256, block_k=256,
+                   interpret=False):
+    """Returns (out [B,L,H,D], lse [B,H,Lq] f32)."""
     from jax.experimental import pallas as pl
 
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    if scale is None:
-        scale = 1.0 / np.sqrt(D)
     block_q = min(block_q, Lq)
     block_k = min(block_k, Lk)
-
-    # [B,H,L,D] layout inside the kernel
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-
-    def kernel(q_ref, k_ref, v_ref, o_ref):
-        qb = q_ref[...].astype(jnp.float32) * scale  # [bq, D]
-        m = jnp.full((qb.shape[0],), -jnp.inf, jnp.float32)
-        l = jnp.zeros((qb.shape[0],), jnp.float32)
-        acc = jnp.zeros((qb.shape[0], D), jnp.float32)
-        qi = pl.program_id(2)
-
-        def body(j, carry):
-            m, l, acc = carry
-            kb = pl.load(k_ref, (pl.dslice(j * block_k, block_k), slice(None))).astype(jnp.float32)
-            vb = pl.load(v_ref, (pl.dslice(j * block_k, block_k), slice(None))).astype(jnp.float32)
-            s = qb @ kb.T  # [bq, bk]
-            if causal:
-                rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-                s = jnp.where(rows >= cols, s, -1e30)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-            p = jnp.exp(s - m_new[:, None])
-            corr = jnp.exp(m - m_new)
-            l_new = l * corr + jnp.sum(p, axis=-1)
-            acc_new = acc * corr[:, None] + p @ vb
-            return m_new, l_new, acc_new
-
-        if causal:
-            # only iterate over blocks at or before the diagonal
-            n_k = (qi + 1) * block_q // block_k
-            n_k = jnp.minimum(pl.cdiv(Lk, block_k), pl.cdiv((qi + 1) * block_q, block_k))
-        else:
-            n_k = pl.cdiv(Lk, block_k)
-        m, l, acc = jax.lax.fori_loop(0, n_k, body, (m, l, acc))
-        o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     grid = (B, H, pl.cdiv(Lq, block_q))
-    out = pl.pallas_call(
+    kernel = functools.partial(_fa_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k, kv_len=Lk,
+                               kv_offset=Lk - Lq)
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -127,20 +257,156 @@ def _flash_attention_pallas(q, k, v, causal=False, scale=None,
             pl.BlockSpec((None, None, Lk, D), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((None, None, Lk, D), lambda b, h, i: (b, h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, _STATS_LANES),
+                         lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Lq, _STATS_LANES), jnp.float32),
+        ],
+        interpret=interpret,
     )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2)
+    return jnp.swapaxes(out, 1, 2), lse[..., 0]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def _fa_bwd_pallas(q, k, v, out, lse, do, causal, scale,
+                   block_q=256, block_k=256, interpret=False):
+    from jax.experimental import pallas as pl
+
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    block_q = min(block_q, Lq)
+    block_k = min(block_k, Lk)
+    qt, kt, vt, dot_, ot = (jnp.swapaxes(x, 1, 2)
+                            for x in (q, k, v, do, out))
+    # delta = rowsum(dout * out), fp32 [B,H,Lq] — one fused XLA pass
+    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32), -1)
+    # lane-padded stats layout (see _fa_fwd_kernel comment)
+    lse_p = jnp.broadcast_to(lse[..., None], (B, H, Lq, _STATS_LANES))
+    delta_p = jnp.broadcast_to(delta[..., None], (B, H, Lq, _STATS_LANES))
+
+    qspec = pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0))
+    qfull = pl.BlockSpec((None, None, Lq, D), lambda b, h, i: (b, h, 0, 0))
+    kspec = pl.BlockSpec((None, None, block_k, D), lambda b, h, i: (b, h, i, 0))
+    kfull = pl.BlockSpec((None, None, Lk, D), lambda b, h, i: (b, h, 0, 0))
+    rowb = pl.BlockSpec((None, None, block_q, _STATS_LANES),
+                        lambda b, h, i: (b, h, i, 0))
+    rowf = pl.BlockSpec((None, None, Lq, _STATS_LANES),
+                        lambda b, h, i: (b, h, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, kv_len=Lk, kv_offset=Lk - Lq),
+        grid=(B, H, pl.cdiv(Lq, block_q)),
+        in_specs=[qspec, kfull, kfull, qspec, rowb, rowb],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse_p, delta_p)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, q_len=Lq, kv_offset=Lk - Lq),
+        grid=(B, H, pl.cdiv(Lk, block_k)),
+        in_specs=[qfull, kspec, kspec, qfull, rowf, rowf],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Lk, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Lk, D), v.dtype)],
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse_p, delta_p)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
+
+
+# --------------------------- custom-vjp op ----------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_fused(q, k, v, causal, scale, interpret):
+    out, _ = _fa_fwd_pallas(q, k, v, causal, scale, interpret=interpret)
+    return out
+
+
+def _flash_fused_fwd(q, k, v, causal, scale, interpret):
+    _stats["pallas_fwd"] += 1
+    out, lse = _fa_fwd_pallas(q, k, v, causal, scale, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_fused_bwd(causal, scale, interpret, res, do):
+    _stats["pallas_bwd"] += 1
+    q, k, v, out, lse = res
+    return _fa_bwd_pallas(q, k, v, out, lse, do, causal, scale,
+                          interpret=interpret)
+
+
+_flash_fused.defvjp(_flash_fused_fwd, _flash_fused_bwd)
+
+
+# --------------------------- dispatch ---------------------------------------
+
+_pallas_fa_status = {}
+
+
+def _pallas_fa_ok(dtype, Lq: int, Lk: int, D: int, causal: bool) -> bool:
+    """Eager fwd+bwd compile probe at the exact production (L, D) shapes.
+
+    Mosaic failures inside a traced user program fire at outer-jit compile
+    time where try/except can't catch; capability is therefore established
+    eagerly — including for the BACKWARD kernels, so the custom_vjp path is
+    known-good under value_and_grad before we ever commit to it.
+    """
+    key = (jnp.dtype(dtype).name, Lq, Lk, D, bool(causal), _INTERPRET)
+    if key not in _pallas_fa_status:
+        if not (_on_tpu() or _INTERPRET):
+            _pallas_fa_status[key] = False
+        else:
+            try:
+                sc = float(1.0 / np.sqrt(D))
+                q = jnp.ones((2, Lq, 2, D), dtype)
+                k = jnp.ones((2, Lk, 2, D), dtype)
+
+                def f(q, k, v):
+                    return _flash_fused(q, k, v, bool(causal), sc,
+                                        _INTERPRET).astype(jnp.float32).sum()
+
+                grads = jax.grad(f, argnums=(0, 1, 2))(q, k, k)
+                jax.block_until_ready(grads)
+                _pallas_fa_status[key] = True
+            except Exception:
+                _pallas_fa_status[key] = False
+    return _pallas_fa_status[key]
+
+
+def _pallas_eligible(q, k, v, mask, causal) -> bool:
+    if mask is not None:
+        return False
+    if not (_on_tpu() or _INTERPRET):
+        return False
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if not (isinstance(Lq, int) and isinstance(Lk, int)):
+        return False
+    # seq lens must be multiples of the 256 tile: the kernels walk K/V (and
+    # Q in the dkv pass) with a fori_loop whose clamped dynamic slices would
+    # silently double-count a tail block (e.g. L=640)
+    if Lq < 512 or Lk < 512 or Lq % 256 or Lk % 256 or Lk > _MAX_PALLAS_KV:
+        return False
+    if not (q.dtype == k.dtype == v.dtype):
+        return False
+    return _pallas_fa_ok(q.dtype, Lq, Lk, D, causal)
 
 
 def flash_attention(q, k, v, mask=None, causal=False, scale=None):
-    """Dispatch: Pallas kernel on TPU for long seqs w/o arbitrary mask, else XLA."""
-    Lq, Lk = q.shape[1], k.shape[1]
-    use_pallas = (_on_tpu() and mask is None and Lq >= 512 and Lk >= 512
-                  and Lq % 128 == 0 and Lk % 128 == 0)
-    if use_pallas:
-        try:
-            return _flash_attention_pallas(q, k, v, causal=causal, scale=scale)
-        except Exception:
-            pass
+    """Dispatch: fused Pallas fwd+bwd on TPU for long sequences without an
+    arbitrary mask (causal handled in-kernel); XLA composition otherwise."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if _pallas_eligible(q, k, v, mask, causal):
+        _stats["pallas"] += 1
+        return _flash_fused(q, k, v, bool(causal), float(scale), _INTERPRET)
+    _stats["xla"] += 1
     return flash_attention_xla(q, k, v, mask=mask, causal=causal, scale=scale)

@@ -1,0 +1,114 @@
+"""dygraph <-> static parity with data-dependent control flow.
+
+Reference test style: `unittests/dygraph_to_static/` runs the same model
+eagerly and transpiled and asserts equal outputs (SURVEY §4.6). Here the
+transpile is `jit.dy2static.ast_transform` → lax.cond / lax.while_loop.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import ast_transform, needs_transform
+
+
+class BranchyNet(nn.Layer):
+    """Forward with a genuine data-dependent branch + loop."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 8)
+        self.head = nn.Linear(8, 2)
+
+    def forward(self, x):
+        h = self.fc1(x)
+        if paddle.mean(h) > 0:          # tensor-dependent if
+            h = paddle.tanh(self.fc2(h))
+        else:
+            h = paddle.nn.functional.relu(self.fc2(h)) - 1.0
+        scale = paddle.max(paddle.abs(h))
+        while scale > 1.0:              # tensor-dependent while
+            h = h / 2.0
+            scale = scale / 2.0
+        return self.head(h)
+
+
+class TestDy2StaticParity:
+    def _data(self, seed, lo=-1.0, hi=1.0):
+        rng = np.random.default_rng(seed)
+        return paddle.to_tensor(
+            rng.uniform(lo, hi, size=(4, 4)).astype(np.float32))
+
+    def test_branch_model_parity_both_branches(self):
+        paddle.seed(0)
+        model = BranchyNet()
+        static_model = to_static(model)
+        hit = set()
+        for seed in range(8):
+            x = self._data(seed, -2.0, 2.0)
+            eager = model(x).numpy()
+            static = static_model(x).numpy()
+            np.testing.assert_allclose(eager, static, rtol=2e-5, atol=2e-5)
+            hit.add(bool(np.mean(model.fc1(x).numpy()) > 0))
+        assert hit == {True, False}, (
+            f"test data exercised only one branch: {hit}")
+
+    def test_function_if_while_parity(self):
+        def fn(x):
+            if paddle.sum(x) > 0:
+                y = x * 3.0
+            else:
+                y = -x
+            n = paddle.to_tensor(np.float32(0.0))
+            while paddle.max(y) > 1.0:
+                y = y / 2.0
+                n = n + 1.0
+            return y, n
+
+        st = to_static(fn)
+        for seed in (0, 1, 2):
+            x = self._data(seed, -3.0, 3.0)
+            ey, en = fn(x)
+            sy, sn = st(x)
+            np.testing.assert_allclose(ey.numpy(), sy.numpy(), rtol=1e-6)
+            assert float(en) == float(sn)
+
+    def test_trace_only_fast_path_kept(self):
+        def plain(x):
+            return x * 2 + 1
+        assert not needs_transform(plain)
+        assert ast_transform(plain) is plain
+
+    def test_return_in_tensor_branch_raises_precisely(self):
+        def bad(x):
+            if paddle.mean(x) > 0:
+                return x * 2
+            return x
+
+        st = to_static(bad)
+        # concrete condition still fine eagerly (python fast path)…
+        x = self._data(0)
+        with pytest.raises(NotImplementedError,
+                           match="return/break/continue"):
+            st(x)
+
+    def test_bool_ops_over_tensors(self):
+        def fn(x, flag):
+            if flag and paddle.mean(x) > 0:
+                y = x + 10.0
+            else:
+                y = x
+            if not (paddle.sum(x) > 100.0):
+                y = y + 1.0
+            return y
+
+        st = to_static(fn)
+        for seed in (0, 3):
+            x = self._data(seed, -2.0, 2.0)
+            np.testing.assert_allclose(fn(x, True).numpy(),
+                                       st(x, True).numpy(), rtol=1e-6)

@@ -192,6 +192,37 @@ class TestLossesExtra:
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
 
+
+
+    def test_ctc_loss_zero_length_label(self):
+        """ext_len==1 (empty label): loss is exactly -log P(all-blank path),
+        no double-counting (ADVICE r1)."""
+        T, B, V = 4, 1, 3
+        logp = np.zeros((T, B, V), np.float32)  # uniform: log_softmax = -log 3
+        il = np.array([T], np.int64)
+        ll = np.array([0], np.int64)
+        labels = np.zeros((B, 2), np.int64)
+        got = float(F.ctc_loss(paddle.to_tensor(logp),
+                               paddle.to_tensor(labels),
+                               paddle.to_tensor(il), paddle.to_tensor(ll),
+                               reduction="sum"))
+        want = T * np.log(V)  # single path: blank at every step
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_ctc_loss_norm_by_times(self):
+        T, B, V = 8, 1, 5
+        rng = np.random.default_rng(0)
+        logp = rng.normal(size=(T, B, V)).astype(np.float32)
+        labels = np.array([[1, 2]], np.int64)
+        il = np.array([T], np.int64)
+        ll = np.array([2], np.int64)
+        args = (paddle.to_tensor(logp), paddle.to_tensor(labels),
+                paddle.to_tensor(il), paddle.to_tensor(ll))
+        plain = float(F.ctc_loss(*args, reduction="sum"))
+        normed = float(F.ctc_loss(*args, reduction="sum", norm_by_times=True))
+        np.testing.assert_allclose(normed, plain / T, rtol=1e-5)
+
+
     def test_misc_losses(self):
         rng = np.random.default_rng(0)
         p = paddle.to_tensor(rng.random((4, 1)).astype(np.float32))

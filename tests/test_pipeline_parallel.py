@@ -182,3 +182,69 @@ class TestPipelineLayerAPI:
             [paddle.to_tensor(X), paddle.to_tensor(Y)], opt))
             for _ in range(5)]
         assert losses[-1] < losses[0]
+
+
+class TestPipeline1F1BMemory:
+    def test_peak_memory_bounded_by_boundary_activations(self):
+        """M=8*S micro-batches: compiled temp memory may grow only by the
+        per-tick boundary-activation residuals (~linear, small constant) —
+        NOT by a pp-replicated [M, B, T, D] collection buffer (round-1
+        design). Budget: 4x the boundary activation per extra micro-batch."""
+        from paddle_tpu.framework import random as random_mod
+        S, dp = 2, 4
+        temps = {}
+        cfg = GPTConfig.tiny()
+        for M in (2 * S, 8 * S):
+            hcg = _setup({"pp": S, "dp": dp})
+            paddle.seed(0)
+            model = GPT(cfg)
+            opt = optimizer.AdamW(learning_rate=1e-4,
+                                  parameters=model.parameters())
+            step = PipelineParallelTrainStep(model, F.cross_entropy, opt,
+                                             hcg=hcg, num_micro=M)
+            B, L = M * 4, 32
+            ids, labels = _gpt_batch(cfg, B=B, L=L)
+            arrs = step.shard_batch(ids, labels)
+            rng = random_mod.default_generator().split()
+            lr = jnp.asarray(1e-4, jnp.float32)
+            with step.mesh:
+                compiled = step._step.lower(
+                    step._flat_params, step.buffers, step.opt_state, rng,
+                    lr, 1, *arrs).compile()
+                temps[M] = compiled.memory_analysis().temp_size_in_bytes
+            dist.set_hybrid_communicate_group(None)
+        D = cfg.hidden_size
+        boundary = (4 // dp or 1) * 32 * D * 4  # one [B/dp, T, D] f32 tile
+        budget = temps[2 * S] + (8 * S - 2 * S) * 4 * boundary
+        assert temps[8 * S] <= budget, (temps, budget)
+
+    def test_batchnorm_block_raises_with_guidance(self):
+        hcg = _setup({"pp": 2, "dp": 4})
+        try:
+            blocks = [nn.Sequential(nn.Linear(8, 8), nn.BatchNorm1D(8))
+                      for _ in range(2)]
+
+            class BNModel(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.blocks = nn.LayerList(blocks)
+
+                def pipeline_pre(self, x):
+                    return x
+
+                def pipeline_post(self, h):
+                    return h
+
+                def forward(self, x):
+                    for b in self.blocks:
+                        x = b(x)
+                    return x
+
+            model = BNModel()
+            opt = optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=model.parameters())
+            with pytest.raises(ValueError, match="BatchNorm"):
+                PipelineParallelTrainStep(model, lambda o, y: o.mean(),
+                                          opt, hcg=hcg)
+        finally:
+            dist.set_hybrid_communicate_group(None)

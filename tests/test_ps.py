@@ -242,3 +242,149 @@ class TestPSCluster:
             assert p.returncode == 0, f"proc failed:\n{out}"
         assert "TRAINER 0" in outs[1] + outs[2]
         assert "TRAINER 1" in outs[1] + outs[2]
+
+
+class TestCtrLifecycle:
+    """CTR feature lifecycle (reference ps/table/ctr_accessor.cc): show/click
+    accumulation, day-tick decay + aging, below-threshold eviction."""
+
+    def test_show_click_and_meta(self, ps_pair):
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=10, kind="sparse", dim=4))
+        keys = np.array([1, 2, 3], np.uint64)
+        c.pull_sparse(10, keys)  # materialize rows
+        c.push_show_click(10, keys, np.array([5, 1, 0], np.float32),
+                          np.array([2, 0, 0], np.float32))
+        show, click, unseen = c.pull_meta(10, keys)
+        np.testing.assert_allclose(show, [5, 1, 0])
+        np.testing.assert_allclose(click, [2, 0, 0])
+        assert list(unseen) == [0, 0, 0]
+
+    def test_shrink_evicts_stale_low_score_rows(self, ps_pair):
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=11, kind="sparse", dim=4))
+        hot = np.array([100], np.uint64)
+        cold = np.array([200, 201, 202], np.uint64)
+        c.pull_sparse(11, hot)
+        c.pull_sparse(11, cold)
+        c.push_show_click(11, hot, np.array([50.0], np.float32),
+                          np.array([10.0], np.float32))
+        assert c.table_size(11) == 4
+        # 3 day-ticks with unseen>2 required: cold rows (score 0) evicted
+        # on the 3rd tick, hot row's decayed score stays above threshold
+        evicted = 0
+        for _ in range(3):
+            evicted += c.shrink(11, threshold=1.0, max_unseen_days=2)
+        assert evicted == 3, evicted
+        assert c.table_size(11) == 1
+        show, click, unseen = c.pull_meta(11, cold[:1])
+        assert unseen[0] == -1  # evicted marker
+        show, click, unseen = c.pull_meta(11, hot)
+        assert unseen[0] == 3 and show[0] > 40  # decayed but alive
+
+    def test_touch_resets_unseen(self, ps_pair):
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=12, kind="sparse", dim=4))
+        k = np.array([7], np.uint64)
+        c.pull_sparse(12, k)
+        c.shrink(12, threshold=1.0, max_unseen_days=10)  # ages to 1
+        _, _, unseen = c.pull_meta(12, k)
+        assert unseen[0] == 1
+        c.pull_sparse(12, k)  # touch
+        _, _, unseen = c.pull_meta(12, k)
+        assert unseen[0] == 0
+
+    def test_ctr_meta_survives_save_load(self, ps_pair, tmp_path):
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=13, kind="sparse", dim=4))
+        k = np.array([42], np.uint64)
+        c.pull_sparse(13, k)
+        c.push_show_click(13, k, np.array([9.0], np.float32),
+                          np.array([3.0], np.float32))
+        c.save(str(tmp_path))
+        c.push_show_click(13, k, np.array([100.0], np.float32),
+                          np.array([100.0], np.float32))
+        c.load(str(tmp_path))
+        show, click, unseen = c.pull_meta(13, k)
+        np.testing.assert_allclose(show, [9.0])
+        np.testing.assert_allclose(click, [3.0])
+
+
+class TestGeoMode:
+    """Geo-SGD (reference GeoCommunicator + memory_sparse_geo_table):
+    trainers apply SGD locally, push weight deltas; the server table
+    (optimizer="sum") merges deltas from all trainers."""
+
+    def test_sum_table_merges_deltas(self, ps_pair):
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=20, kind="sparse", dim=2,
+                                   optimizer="sum", init_range=0.0))
+        k = np.array([5], np.uint64)
+        base = c.pull_sparse(20, k)[0]
+        c.push_sparse(20, k, np.array([[1.0, 2.0]], np.float32))
+        c.push_sparse(20, k, np.array([[0.5, -1.0]], np.float32))
+        np.testing.assert_allclose(c.pull_sparse(20, k)[0],
+                                   base + [1.5, 1.0], rtol=1e-6)
+
+    def test_two_geo_trainers_converge_to_shared_state(self, ps_pair):
+        from paddle_tpu.distributed.ps.communicator import GeoCommunicator
+        server, c = ps_pair
+        c.create_table(TableConfig(table_id=21, kind="sparse", dim=3,
+                                   optimizer="sum", init_range=0.0))
+        c2 = PSClient([server.endpoint])
+        # every worker declares the table (idempotent server-side)
+        c2.create_table(TableConfig(table_id=21, kind="sparse", dim=3,
+                                    optimizer="sum", init_range=0.0))
+        g1 = GeoCommunicator(c, lr=0.1, geo_push_steps=4)
+        g2 = GeoCommunicator(c2, lr=0.1, geo_push_steps=4)
+        keys = np.array([1, 2], np.uint64)
+        target = np.array([[1.0, 2.0, 3.0], [-1.0, 0.5, 2.0]], np.float32)
+        # both trainers descend the same quadratic toward `target`
+        for step in range(60):
+            for g in (g1, g2):
+                w = g.pull_sparse(21, keys)
+                g.push_sparse(21, keys, 2.0 * (w - target) / 2.0)
+        g1.geo_sync()
+        g2.geo_sync()
+        g1.geo_sync()  # see g2's last contribution
+        merged = c.pull_sparse(21, keys)
+        np.testing.assert_allclose(merged, target, atol=0.15)
+        # geo invariant: local cache equals server state after sync
+        np.testing.assert_allclose(
+            g1.pull_sparse(21, keys), merged, atol=1e-5)
+
+    def test_geo_local_steps_do_not_touch_server(self, ps_pair):
+        from paddle_tpu.distributed.ps.communicator import GeoCommunicator
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=22, kind="sparse", dim=2,
+                                   optimizer="sum", init_range=0.0))
+        geo = GeoCommunicator(c, lr=0.1, geo_push_steps=100)
+        k = np.array([9], np.uint64)
+        before = c.pull_sparse(22, k).copy()
+        for _ in range(5):
+            w = geo.pull_sparse(22, k)
+            geo.push_sparse(22, k, np.ones((1, 2), np.float32))
+        np.testing.assert_allclose(c.pull_sparse(22, k), before)  # untouched
+        assert not np.allclose(geo.pull_sparse(22, k), before)  # local moved
+        geo.flush()
+        np.testing.assert_allclose(c.pull_sparse(22, k),
+                                   before - 0.5, rtol=1e-5)  # 5 * 0.1 * 1
+
+
+class TestChunkedDense:
+    def test_large_dense_table_roundtrip(self, ps_pair):
+        """Dense tables above one 64MB transport chunk move in pieces
+        (round-2 review: a 51M-float embedding must not hit the frame cap)."""
+        _, c = ps_pair
+        n = 20_000_000  # > 16M-float chunk => 2 chunks
+        c.create_table(TableConfig(table_id=30, kind="dense", dense_size=n,
+                                   optimizer="sgd", learning_rate=0.5))
+        vals = np.arange(n, dtype=np.float32) % 1000.0
+        c.set_dense(30, vals)
+        got = c.pull_dense(30)
+        np.testing.assert_array_equal(got, vals)
+        g = np.ones(n, np.float32)
+        c.push_dense(30, g)
+        got = c.pull_dense(30)
+        np.testing.assert_allclose(got[:5], vals[:5] - 0.5, rtol=1e-6)
+        np.testing.assert_allclose(got[-5:], vals[-5:] - 0.5, rtol=1e-6)

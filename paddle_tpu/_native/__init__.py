@@ -131,6 +131,12 @@ def _declare(lib: ctypes.CDLL):
     lib.ps_pull_meta.restype = c.c_int
     lib.ps_pull_meta.argtypes = [c.c_int, c.c_int, u64p, c.c_int64, f32p,
                                  f32p, i32p]
+    lib.ps_set_spill.restype = c.c_int
+    lib.ps_set_spill.argtypes = [c.c_int, c.c_int, c.c_char_p]
+    lib.ps_spill_cold.restype = c.c_int64
+    lib.ps_spill_cold.argtypes = [c.c_int, c.c_int, c.c_int]
+    lib.ps_spilled_size.restype = c.c_int64
+    lib.ps_spilled_size.argtypes = [c.c_int, c.c_int]
 
     # TCPStore
     lib.store_server_create.restype = c.c_int

@@ -53,6 +53,9 @@ enum Cmd : uint8_t {
   CMD_PUSH_SHOW_CLICK = 13,  // CTR lifecycle: show/click counters
   CMD_SHRINK = 14,           // decay + age + evict (ctr_accessor::Shrink)
   CMD_PULL_META = 15,        // per-key (show, click, unseen_days) for tests
+  CMD_SET_SPILL = 16,        // enable disk spill (ssd_sparse_table equiv.)
+  CMD_SPILL_COLD = 17,       // move unseen>N rows to the spill file
+  CMD_SPILLED_SIZE = 18,     // rows currently on disk
 };
 
 // OPT_SUM: raw delta-apply (w += g) — the server side of geo-SGD
@@ -176,6 +179,84 @@ class SparseTable {
     }
   }
 
+  // ---- disk spill (reference ps/table/ssd_sparse_table.cc, rocksdb) ----
+  // Cold rows move to an append-only spill file; RAM keeps only a
+  // key->offset index (16B/row vs a full row) — the bounded-memory story
+  // behind the reference's "100B feature" tables. A spilled row is
+  // restored transparently on its next pull/push.
+
+  bool set_spill(const std::string& path) {
+    std::lock_guard<std::mutex> g(spill_mu_);
+    if (!spill_index_.empty())
+      return false;  // rows live only on disk: refusing protects them
+    if (spill_f_) fclose(spill_f_);
+    spill_f_ = fopen(path.c_str(), "wb+");
+    return spill_f_ != nullptr;
+  }
+
+  int64_t spill_cold(int32_t max_unseen_days) {
+    // lock order is ALWAYS shard -> spill (restore_from_spill runs under a
+    // shard lock), so the spill mutex is taken per-row inside the shard loop
+    const size_t row = cfg_.dim * (1 + state_slots(cfg_.opt));
+    {
+      std::lock_guard<std::mutex> gs(spill_mu_);
+      if (!spill_f_) return -1;
+    }
+    int64_t spilled = 0;
+    for (Shard& s : shards_) {
+      std::lock_guard<std::mutex> g(s.mu);
+      for (auto it = s.map.begin(); it != s.map.end();) {
+        SparseEntry& e = it->second;
+        e.unseen_days += 1;
+        if (e.unseen_days > static_cast<uint32_t>(max_unseen_days)) {
+          std::lock_guard<std::mutex> gs(spill_mu_);
+          if (!spill_f_) return spilled;
+          fseek(spill_f_, 0, SEEK_END);
+          uint64_t off = static_cast<uint64_t>(ftell(spill_f_));
+          fwrite(&it->first, 8, 1, spill_f_);
+          fwrite(&e.step, 4, 1, spill_f_);
+          fwrite(&e.show, 4, 1, spill_f_);
+          fwrite(&e.click, 4, 1, spill_f_);
+          fwrite(&e.unseen_days, 4, 1, spill_f_);
+          fwrite(e.data.data(), sizeof(float), row, spill_f_);
+          spill_index_[it->first] = off;
+          it = s.map.erase(it);
+          ++spilled;
+        } else {
+          ++it;
+        }
+      }
+    }
+    std::lock_guard<std::mutex> gs(spill_mu_);
+    if (spill_f_) fflush(spill_f_);
+    return spilled;
+  }
+
+  int64_t spilled_size() const {
+    std::lock_guard<std::mutex> g(spill_mu_);
+    return static_cast<int64_t>(spill_index_.size());
+  }
+
+  // Restore `key` from disk into `e`; true on hit. Caller holds shard lock.
+  bool restore_from_spill(uint64_t key, SparseEntry* e) {
+    const size_t row = cfg_.dim * (1 + state_slots(cfg_.opt));
+    std::lock_guard<std::mutex> g(spill_mu_);
+    auto it = spill_index_.find(key);
+    if (!spill_f_ || it == spill_index_.end()) return false;
+    fseek(spill_f_, static_cast<long>(it->second), SEEK_SET);
+    uint64_t k = 0;
+    e->data.resize(row);
+    if (fread(&k, 8, 1, spill_f_) != 1 || k != key ||
+        fread(&e->step, 4, 1, spill_f_) != 1 ||
+        fread(&e->show, 4, 1, spill_f_) != 1 ||
+        fread(&e->click, 4, 1, spill_f_) != 1 ||
+        fread(&e->unseen_days, 4, 1, spill_f_) != 1 ||
+        fread(e->data.data(), sizeof(float), row, spill_f_) != row)
+      return false;
+    spill_index_.erase(it);  // the live copy moves back to RAM
+    return true;
+  }
+
   // One "day" tick (reference CtrCommonAccessor::Shrink): decay show/click,
   // age every row, evict rows whose score dropped below `threshold` AND
   // that have not been touched for more than `max_unseen_days` ticks.
@@ -208,12 +289,20 @@ class SparseTable {
   static constexpr uint32_t kMagic = 0x50545332;  // "PTS2"
 
   bool save(FILE* f) const {
+    // quiesce the whole table: all shard locks (in order), then the spill
+    // lock — concurrent pulls could otherwise restore a spilled row
+    // between the count and the walk, corrupting the row-count header
+    std::vector<std::unique_lock<std::mutex>> guards;
+    guards.reserve(kShards);
+    for (const Shard& s : shards_) guards.emplace_back(s.mu);
+    std::lock_guard<std::mutex> g(spill_mu_);
     fwrite(&kMagic, 4, 1, f);
-    int64_t n = size();
+    int64_t n = 0;
+    for (const Shard& s : shards_) n += static_cast<int64_t>(s.map.size());
+    n += static_cast<int64_t>(spill_index_.size());
     fwrite(&n, 8, 1, f);
     const size_t row = cfg_.dim * (1 + state_slots(cfg_.opt));
     for (const Shard& s : shards_) {
-      std::lock_guard<std::mutex> g(s.mu);
       for (const auto& kv : s.map) {
         fwrite(&kv.first, 8, 1, f);
         fwrite(&kv.second.step, 4, 1, f);
@@ -221,6 +310,29 @@ class SparseTable {
         fwrite(&kv.second.click, 4, 1, f);
         fwrite(&kv.second.unseen_days, 4, 1, f);
         fwrite(kv.second.data.data(), sizeof(float), row, f);
+      }
+    }
+    // checkpoints are fully materialized: spilled rows are read back from
+    // the spill file so a load never depends on it
+    if (spill_f_) {
+      for (const auto& kv : spill_index_) {
+        fseek(spill_f_, static_cast<long>(kv.second), SEEK_SET);
+        uint64_t k;
+        SparseEntry e;
+        e.data.resize(row);
+        if (fread(&k, 8, 1, spill_f_) != 1 ||
+            fread(&e.step, 4, 1, spill_f_) != 1 ||
+            fread(&e.show, 4, 1, spill_f_) != 1 ||
+            fread(&e.click, 4, 1, spill_f_) != 1 ||
+            fread(&e.unseen_days, 4, 1, spill_f_) != 1 ||
+            fread(e.data.data(), sizeof(float), row, spill_f_) != row)
+          return false;
+        fwrite(&k, 8, 1, f);
+        fwrite(&e.step, 4, 1, f);
+        fwrite(&e.show, 4, 1, f);
+        fwrite(&e.click, 4, 1, f);
+        fwrite(&e.unseen_days, 4, 1, f);
+        fwrite(e.data.data(), sizeof(float), row, f);
       }
     }
     return true;
@@ -265,6 +377,9 @@ class SparseTable {
   SparseEntry& fetch_or_init(Shard& s, uint64_t key) {
     auto it = s.map.find(key);
     if (it != s.map.end()) return it->second;
+    SparseEntry spilled;
+    if (restore_from_spill(key, &spilled))
+      return s.map.emplace(key, std::move(spilled)).first->second;
     SparseEntry e;
     e.data.assign(cfg_.dim * (1 + state_slots(cfg_.opt)), 0.0f);
     uint64_t h = splitmix64(key ^ cfg_.seed);
@@ -312,6 +427,9 @@ class SparseTable {
 
   TableConfig cfg_;
   Shard shards_[kShards];
+  mutable std::mutex spill_mu_;
+  FILE* spill_f_ = nullptr;
+  std::unordered_map<uint64_t, uint64_t> spill_index_;  // key -> file offset
 };
 
 class DenseTable {
@@ -667,6 +785,33 @@ class Server {
         resp->bytes(show.data(), n * sizeof(float));
         resp->bytes(click.data(), n * sizeof(float));
         resp->bytes(unseen.data(), n * sizeof(int32_t));
+        return true;
+      }
+      case CMD_SET_SPILL: {
+        SparseTable* t = sparse(tid);
+        if (!t) return err(resp, "no such sparse table");
+        std::string path = r->str();
+        if (r->failed()) return err(resp, "truncated frame");
+        if (!t->set_spill(path)) return err(resp, "cannot open spill file");
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_SPILL_COLD: {
+        SparseTable* t = sparse(tid);
+        if (!t) return err(resp, "no such sparse table");
+        int32_t max_unseen = r->i32();
+        if (r->failed()) return err(resp, "truncated frame");
+        int64_t n = t->spill_cold(max_unseen);
+        if (n < 0) return err(resp, "spill not enabled (CMD_SET_SPILL first)");
+        resp->u8(ST_OK);
+        resp->i64(n);
+        return true;
+      }
+      case CMD_SPILLED_SIZE: {
+        SparseTable* t = sparse(tid);
+        if (!t) return err(resp, "no such sparse table");
+        resp->u8(ST_OK);
+        resp->i64(t->spilled_size());
         return true;
       }
       case CMD_TABLE_SIZE: {
@@ -1092,6 +1237,39 @@ int64_t ps_shrink(int h, int table_id, float threshold, int max_unseen_days) {
   w.i32(table_id);
   w.f32(threshold);
   w.i32(max_unseen_days);
+  std::vector<char> body;
+  if (c->request(w, &body) != ps::ST_OK) return -1;
+  ps::Reader r(body.data(), body.size());
+  return r.i64();
+}
+
+int ps_set_spill(int h, int table_id, const char* path) {
+  ps::Writer w;
+  w.u8(ps::CMD_SET_SPILL);
+  w.i32(table_id);
+  w.str(path);
+  return simple_req(h, w);
+}
+
+int64_t ps_spill_cold(int h, int table_id, int max_unseen_days) {
+  ps::Client* c = client(h);
+  if (!c) return -1;
+  ps::Writer w;
+  w.u8(ps::CMD_SPILL_COLD);
+  w.i32(table_id);
+  w.i32(max_unseen_days);
+  std::vector<char> body;
+  if (c->request(w, &body) != ps::ST_OK) return -1;
+  ps::Reader r(body.data(), body.size());
+  return r.i64();
+}
+
+int64_t ps_spilled_size(int h, int table_id) {
+  ps::Client* c = client(h);
+  if (!c) return -1;
+  ps::Writer w;
+  w.u8(ps::CMD_SPILLED_SIZE);
+  w.i32(table_id);
   std::vector<char> body;
   if (c->request(w, &body) != ps::ST_OK) return -1;
   ps::Reader r(body.data(), body.size());

@@ -3,16 +3,20 @@ SURVEY §2.6 "Auto parallel" row).
 
 The reference pipeline — Completer (dist-attr propagation), Partitioner
 (per-rank program split), Resharder (comm insertion), Planner (search) —
-collapses on TPU into GSPMD: users annotate with `shard_tensor`, XLA
-propagates and partitions. What this package keeps is the user API
-(`ProcessMesh`, `shard_tensor`, `shard_op`, `TensorDistAttr`) and the
+mostly collapses on TPU into GSPMD: users annotate with `shard_tensor`,
+XLA propagates and partitions. What this package keeps is the user API
+(`ProcessMesh`, `shard_tensor`, `shard_op`, `TensorDistAttr`), the
 high-level `Engine` (prepare/fit/evaluate/predict/save/load with
-re-shard-on-restore).
+re-shard-on-restore), and a real `Planner` (planner.py): candidate
+(mesh, TP-template) plans scored by the COMPILER's cost_analysis —
+`Engine(plan="auto")` — replacing the reference's hand-built op cost
+model (`planner.py`, `cost_model.py`).
 """
 from .process_mesh import ProcessMesh, get_current_process_mesh
 from .dist_attribute import TensorDistAttr
 from .interface import shard_tensor, shard_op
 from .engine import Engine
+from .planner import Plan, Planner
 
 __all__ = ["ProcessMesh", "get_current_process_mesh", "TensorDistAttr",
-           "shard_tensor", "shard_op", "Engine"]
+           "shard_tensor", "shard_op", "Engine", "Plan", "Planner"]

@@ -32,19 +32,43 @@ from .interface import shard_tensor  # noqa: F401  (re-export convenience)
 class Engine:
     def __init__(self, model: Layer, loss=None, optimizer=None, metrics=None,
                  strategy=None, process_mesh: Optional[ProcessMesh] = None,
-                 data_dim_name: Optional[str] = None):
+                 data_dim_name: Optional[str] = None,
+                 plan: Optional[str] = None):
+        """plan="auto": defer the mesh/sharding choice to the Planner
+        (reference planner.py/cost_model.py) — on the first batch it
+        compiles candidate (mesh, TP-template) plans, scores them with
+        compiled.cost_analysis(), applies the winner's param annotations,
+        and builds the process mesh from the winning factorization."""
         self.model = model
         self.loss = loss
         self.optimizer = optimizer
         self.metrics = metrics or []
         self.strategy = strategy
-        if process_mesh is None:
+        self.plan_mode = plan
+        self.plan_result = None
+        if process_mesh is None and plan != "auto":
             n = len(jax.devices())
             process_mesh = ProcessMesh(np.arange(n), dim_names=["dp"])
         self.process_mesh = process_mesh
-        self.data_dim = data_dim_name or process_mesh.dim_names[0]
+        self.data_dim = data_dim_name or (
+            process_mesh.dim_names[0] if process_mesh is not None else "dp")
         self._prepared = False
         self.history: Dict[str, List[float]] = {"loss": []}
+
+    def _maybe_plan(self, batch_arrs):
+        if self.plan_mode != "auto" or self.plan_result is not None:
+            return
+        from .planner import Planner
+        planner = Planner(self.model, self.loss, self.optimizer)
+        best = planner.plan(*batch_arrs)
+        planner.apply(best)
+        self.plan_result = best
+        shape = tuple(best.mesh_dims.values())
+        n = int(np.prod(shape))
+        self.process_mesh = ProcessMesh(
+            np.arange(n).reshape(shape),
+            dim_names=list(best.mesh_dims.keys()))
+        self.data_dim = list(best.mesh_dims.keys())[0]
 
     # ------------------------------------------------------------------
     def prepare(self):
@@ -132,6 +156,7 @@ class Engine:
 
     def train_batch(self, *batch) -> float:
         """One sharded optimizer step on (inputs..., labels)."""
+        self._maybe_plan(self._as_arrays(batch))
         self.prepare()
         self._t += 1
         rng = random_mod.default_generator().split()
@@ -173,6 +198,18 @@ class Engine:
         return self.history
 
     def evaluate(self, eval_data) -> float:
+        if self.plan_mode == "auto" and self.plan_result is None:
+            # peek one batch for the planner WITHOUT consuming one-shot
+            # iterables: re-chain the peeked batch in front
+            import itertools
+            it = iter(eval_data)
+            try:
+                first = next(it)
+            except StopIteration:
+                return 0.0
+            batch = first if isinstance(first, (list, tuple)) else (first,)
+            self._maybe_plan(self._as_arrays(batch))
+            eval_data = itertools.chain([first], it)
         self.prepare()
         tot, n = 0.0, 0
         for batch in eval_data:

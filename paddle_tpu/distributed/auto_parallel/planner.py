@@ -1,0 +1,201 @@
+"""Auto-parallel planner — searches for a sharding plan by compiled cost.
+
+Reference: `Planner`/cost-model search
+(/root/reference/python/paddle/distributed/auto_parallel/planner.py,
+`cost_model.py`): enumerate partitioning candidates for the serial program,
+estimate each with an analytic per-op + comm cost model, pick the cheapest.
+
+TPU translation: the cost model IS the compiler. Each candidate here is a
+(mesh factorization, TP-template) pair; the whole train step is lowered and
+compiled under that candidate's shardings (GSPMD partitions it) and scored
+from `compiled.cost_analysis()` with a roofline estimate
+    t = max(flops / peak_flops, bytes / hbm_bw)
+over the PER-DEVICE SPMD module — so compute/bandwidth/collective traffic
+are all priced by the same compiler that will execute the plan, replacing
+the reference's hand-maintained op cost tables at a fraction of the code.
+
+Templates (reference `mp_layers.py` Megatron layouts):
+  * "dp"             — pure data parallel, params replicated
+  * "tp_alternating" — consecutive Linear layers alternate column/row
+                       parallel over `mp` (one allreduce per pair)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+from ...nn.layer import Layer
+
+# Roofline constants (v5e). Only the RATIO matters for ranking plans; both
+# are overridable for other parts.
+PEAK_FLOPS = 197e12
+HBM_BW = 819e9
+
+
+@dataclasses.dataclass
+class Plan:
+    mesh_dims: Dict[str, int]              # e.g. {"dp": 4, "mp": 2}
+    param_specs: Dict[str, P]              # name -> PartitionSpec
+    template: str
+    score: float                           # estimated step seconds (roofline)
+    cost: Dict[str, float]                 # raw flops / bytes
+
+    def build_mesh(self, devices=None) -> Mesh:
+        devs = np.array(devices if devices is not None else jax.devices())
+        shape = tuple(self.mesh_dims.values())
+        return Mesh(devs[:int(np.prod(shape))].reshape(shape),
+                    tuple(self.mesh_dims.keys()))
+
+
+def _divisor_pairs(n: int) -> List[Tuple[int, int]]:
+    """(dp, mp) factorizations of n, mp ascending."""
+    out = []
+    mp = 1
+    while mp <= n:
+        if n % mp == 0:
+            out.append((n // mp, mp))
+        mp *= 2
+    return out
+
+
+def _ordered_linears(model: Layer):
+    from ...nn import layers_common as L
+    return [(name, lyr) for name, lyr in model.named_sublayers()
+            if isinstance(lyr, L.Linear)]
+
+
+def _template_specs(model: Layer, template: str, mp: int) -> Dict[str, P]:
+    """Param-name -> spec for a TP template (empty for pure dp)."""
+    specs: Dict[str, P] = {}
+    if template == "dp" or mp == 1:
+        return specs
+    if template == "tp_alternating":
+        # Megatron MLP layout: col-parallel then row-parallel, repeating —
+        # activations stay sharded between the pair, one psum at the row end
+        for i, (name, lyr) in enumerate(_ordered_linears(model)):
+            w = f"{name}.weight"
+            b = f"{name}.bias"
+            out_features = lyr.weight.shape[1]
+            in_features = lyr.weight.shape[0]
+            if i % 2 == 0:
+                if out_features % mp == 0:
+                    specs[w] = P(None, "mp")
+                    specs[b] = P("mp")
+            else:
+                if in_features % mp == 0:
+                    specs[w] = P("mp", None)
+        return specs
+    raise ValueError(f"unknown template {template!r}")
+
+
+class Planner:
+    """Searches (mesh, template) candidates for a model + loss (+ optimizer).
+
+    `plan(*batch)` compiles one train (or forward) step per candidate and
+    returns the argmin-score `Plan`.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer=None,
+                 n_devices: Optional[int] = None,
+                 templates: Sequence[str] = ("dp", "tp_alternating"),
+                 data_axis: str = "dp"):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.n = n_devices or len(jax.devices())
+        self.templates = list(templates)
+        self.data_axis = data_axis
+
+    # -- one candidate ------------------------------------------------------
+    def _score_candidate(self, dp: int, mp: int, template: str,
+                         batch: Tuple) -> Optional[Plan]:
+        from ...jit import functionalize
+        specs = _template_specs(self.model, template, mp)
+        if template != "dp" and mp > 1 and not specs:
+            return None  # template found nothing to shard: skip duplicate
+        if batch[0].shape[0] % dp:
+            return None  # batch not divisible over the data axis
+        mesh_dims = {"dp": dp, "mp": mp}
+        devs = np.array(jax.devices()[:self.n]).reshape(dp, mp)
+        mesh = Mesh(devs, ("dp", "mp"))
+
+        apply_fn, params, buffers = functionalize(self.model)
+        pshard = {k: NamedSharding(mesh, specs.get(k, P()))
+                  for k in params}
+        repl = NamedSharding(mesh, P())
+        bshard = NamedSharding(mesh, P("dp"))
+        loss_fn = self.loss_fn
+        optimizer = self.optimizer
+
+        def step(params, buffers, rng, *batch):
+            def loss_of(p):
+                out, _ = apply_fn(p, buffers, rng, *batch[:-1])
+                loss = loss_fn(jax.tree_util.tree_map(Tensor, out),
+                               Tensor(batch[-1]))
+                return loss.data if isinstance(loss, Tensor) else loss
+            if optimizer is None:
+                return loss_of(params)
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            new_params, _ = optimizer.apply_fn(
+                params, grads, optimizer.init_state_tree(params),
+                lr=jnp.asarray(1e-3, jnp.float32), t=1)
+            return loss, new_params
+
+        in_shardings = (pshard, {k: repl for k in buffers}, repl) + \
+            tuple(bshard for _ in batch)
+        with mesh:
+            lowered = jax.jit(step, in_shardings=in_shardings).lower(
+                params, buffers, jax.random.PRNGKey(0), *batch)
+            an = lowered.compile().cost_analysis()
+        if isinstance(an, list):
+            an = an[0]
+        flops = float(an.get("flops", 0.0))
+        nbytes = float(an.get("bytes accessed", 0.0))
+        score = max(flops / PEAK_FLOPS, nbytes / HBM_BW)
+        return Plan(mesh_dims=mesh_dims, param_specs=specs,
+                    template=template, score=score,
+                    cost={"flops": flops, "bytes": nbytes})
+
+    # -- the search ---------------------------------------------------------
+    def plan(self, *batch) -> Plan:
+        arrs = tuple(b.data if isinstance(b, Tensor) else jnp.asarray(b)
+                     for b in batch)
+        candidates: List[Plan] = []
+        errors: List[str] = []
+        for dp, mp in _divisor_pairs(self.n):
+            for template in self.templates:
+                if template == "dp" and mp > 1:
+                    continue  # replicated-over-mp duplicates pure dp
+                try:
+                    p = self._score_candidate(dp, mp, template, arrs)
+                except Exception as e:  # an uncompilable candidate is skipped
+                    errors.append(f"dp={dp},mp={mp},{template}: "
+                                  f"{type(e).__name__}: {e}")
+                    continue
+                if p is not None:
+                    candidates.append(p)
+        if not candidates:
+            raise RuntimeError(
+                "auto-parallel planner: no viable candidate. Per-candidate "
+                "failures:\n  " + "\n  ".join(errors or ["(none tried)"]))
+        best = min(candidates, key=lambda p: p.score)
+        best.cost["n_candidates"] = len(candidates)
+        return best
+
+    def apply(self, plan: Plan):
+        """Annotate the model's parameters with the winning specs."""
+        named = dict(self.model.named_parameters())
+        for k, spec in plan.param_specs.items():
+            if k in named:
+                named[k].dist_spec = spec
+        return plan
+
+
+__all__ = ["Plan", "Planner", "PEAK_FLOPS", "HBM_BW"]

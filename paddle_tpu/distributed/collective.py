@@ -197,7 +197,20 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                use_calc_stream=False):
     """In-place all-reduce (reference `collective.py` all_reduce /
     `c_allreduce_sum_op`). Returns the tensor (task.wait() is a no-op: XLA
-    async collectives are scheduled by the compiler)."""
+    async collectives are scheduled by the compiler).
+
+    SEMANTICS (single-controller!): the tensor is treated as N per-rank
+    values laid out over the group's mesh axis — exactly N real processes
+    calling the NCCL op in the reference. Two consequences:
+
+    * a tensor whose data is SHARDED over the group axis reduces the
+      per-shard values, matching the reference rank-for-rank (the case
+      that matters in real pipelines — see tests);
+    * a REPLICATED tensor is "the same value on every rank", so SUM
+      multiplies it by group size — identical to N ranks all-reducing
+      equal values. If you want the identity here, you wanted broadcast
+      (or no collective at all), not all_reduce.
+    """
     g = _resolve(group)
     x = _unwrap(tensor)
     red = _reduce_fn(op)

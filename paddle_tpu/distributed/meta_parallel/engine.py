@@ -41,6 +41,45 @@ def _axis_sizes(mesh: Mesh) -> Dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
 
 
+def _scaler_config(strategy):
+    """fp16 dynamic-loss-scaling hyperparams (reference grad_scaler.py:26);
+    scaling runs INSIDE the compiled step (state carried as arrays), so the
+    parallel engines support strategy amp dtype='float16' end-to-end."""
+    cfg = strategy.amp_configs if strategy is not None else {}
+    return {
+        "init_scale": float(cfg.get("init_loss_scaling", 2.0 ** 15)),
+        "incr_every": int(cfg.get("incr_every_n_steps", 1000)),
+        "incr_ratio": float(cfg.get("incr_ratio", 2.0)),
+        "decr_ratio": float(cfg.get("decr_ratio", 0.5)),
+    }
+
+
+def _apply_scaled_update(optimizer, params, grads, opt_state, lr, t,
+                         scaler_state, sc):
+    """Unscale grads, skip the update on non-finite grads, and update the
+    dynamic scale — the whole check_finite_and_unscale/update_loss_scaling
+    pattern fused into the step."""
+    scale = scaler_state["scale"]
+    good = scaler_state["good"]
+    grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+    finite = jnp.array(True)
+    for g in jax.tree_util.tree_leaves(grads):
+        finite = finite & jnp.all(jnp.isfinite(g))
+    new_params, new_opt = optimizer.apply_fn(params, grads, opt_state,
+                                             lr=lr, t=t)
+    new_params = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(finite, new, old), new_params, params)
+    new_opt = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(finite, new, old), new_opt, opt_state)
+    grew = finite & (good + 1 >= sc["incr_every"])
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grew, scale * sc["incr_ratio"], scale),
+        jnp.maximum(scale * sc["decr_ratio"], 1.0))
+    new_good = jnp.where(finite, jnp.where(grew, 0, good + 1), 0)
+    return new_params, new_opt, {"scale": new_scale, "good": new_good}
+
+
 def _parse_strategy(strategy, sizes):
     """(amp_enabled, amp_dtype, recompute, sharding_stage, accum_steps)."""
     amp_enabled = bool(strategy and strategy.amp)
@@ -174,28 +213,40 @@ class HybridParallelTrainStep:
 
         loss_fn_ = loss_fn
         n_micro = self.accumulate_steps
+        fp16 = amp_enabled and amp_dtype == jnp.float16
+        sc = _scaler_config(strategy)
+        self.scaler_state = {
+            "scale": jnp.asarray(sc["init_scale"] if fp16 else 1.0,
+                                 jnp.float32),
+            "good": jnp.asarray(0, jnp.int32)}
+        self._fp16 = fp16
 
-        def one_micro(p, buf, rng, micro):
+        def one_micro(p, buf, rng, micro, loss_mult):
             def loss_of(pp):
                 out, new_buf = apply_fn(pp, buf, rng, *micro[:-1])
                 loss = loss_fn_(jax.tree_util.tree_map(Tensor, out),
                                 Tensor(micro[-1]))
-                return (loss.data if isinstance(loss, Tensor) else loss,
-                        new_buf)
-            (loss, new_buf), grads = jax.value_and_grad(
+                loss = loss.data if isinstance(loss, Tensor) else loss
+                # fp16: backprop the SCALED loss; primal aux keeps the raw
+                return (loss.astype(jnp.float32) * loss_mult,
+                        (loss, new_buf))
+            (_, (loss, new_buf)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(p)
             return loss, grads, new_buf
 
-        def step(params, buffers, opt_state, rng, lr, t, *batch):
+        def step(params, buffers, opt_state, scaler_state, rng, lr, t,
+                 *batch):
             compute_params = params
             if amp_enabled:
                 compute_params = {
                     k: (v.astype(amp_dtype)
                         if jnp.issubdtype(v.dtype, jnp.floating) else v)
                     for k, v in params.items()}
+            loss_mult = scaler_state["scale"] if fp16 else jnp.asarray(
+                1.0, jnp.float32)
             if n_micro == 1:
                 loss, grads, new_buf = one_micro(compute_params, buffers,
-                                                 rng, batch)
+                                                 rng, batch, loss_mult)
             else:
                 stacked = jax.tree_util.tree_map(
                     lambda a: a.reshape((n_micro, a.shape[0] // n_micro)
@@ -206,7 +257,7 @@ class HybridParallelTrainStep:
                     acc, buf = carry
                     r, micro = xs
                     loss, grads, new_buf = one_micro(compute_params, buf,
-                                                     r, micro)
+                                                     r, micro, loss_mult)
                     acc = jax.tree_util.tree_map(jnp.add, acc, grads)
                     return (acc, new_buf), loss
 
@@ -220,9 +271,15 @@ class HybridParallelTrainStep:
                 loss = losses.mean()
             grads = jax.tree_util.tree_map(
                 lambda g, p: g.astype(jnp.float32), grads, compute_params)
-            new_params, new_opt = optimizer.apply_fn(params, grads,
-                                                     opt_state, lr=lr, t=t)
-            return loss, new_params, new_buf, new_opt
+            if fp16:
+                new_params, new_opt, new_scaler = _apply_scaled_update(
+                    optimizer, params, grads, opt_state, lr, t,
+                    scaler_state, sc)
+            else:
+                new_params, new_opt = optimizer.apply_fn(
+                    params, grads, opt_state, lr=lr, t=t)
+                new_scaler = scaler_state
+            return loss, new_params, new_buf, new_opt, new_scaler
 
         donate_args = (0, 2) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_args)
@@ -243,9 +300,10 @@ class HybridParallelTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         arrs = self.shard_batch(*batch)
         with self.mesh:
-            loss, self.params, self.buffers, self.opt_state = self._step(
-                self.params, self.buffers, self.opt_state, rng, lr,
-                self._t, *arrs)
+            (loss, self.params, self.buffers, self.opt_state,
+             self.scaler_state) = self._step(
+                self.params, self.buffers, self.opt_state,
+                self.scaler_state, rng, lr, self._t, *arrs)
         return Tensor(loss)
 
     def sync_to_layer(self):

@@ -46,8 +46,9 @@ from ...framework import tape as tape_mod
 from ...framework.tensor import Tensor
 from ...nn.layer import Layer
 from ..topology import HybridCommunicateGroup, get_hybrid_communicate_group
-from .engine import (_axis_sizes, _data_axes_of, _filter_spec,
-                     _parse_strategy, _slot_shardings)
+from .engine import (_apply_scaled_update, _axis_sizes, _data_axes_of,
+                     _filter_spec, _parse_strategy, _scaler_config,
+                     _slot_shardings)
 from .pp_layers import PipelineLayer
 
 
@@ -332,19 +333,37 @@ class PipelineParallelTrainStep:
                 jnp.arange(M + S - 1))
             return total / M
 
-        def step(flat_params, buffers_, opt_state, rng, lr, t, *batch):
+        fp16 = amp_enabled and amp_dtype == jnp.float16
+        sc = _scaler_config(strategy)
+        self.scaler_state = {
+            "scale": jnp.asarray(sc["init_scale"] if fp16 else 1.0,
+                                 jnp.float32),
+            "good": jnp.asarray(0, jnp.int32)}
+
+        def step(flat_params, buffers_, opt_state, scaler_state, rng, lr, t,
+                 *batch):
             params = unflat(flat_params)
             compute = jax.tree_util.tree_map(
                 lambda v: (v.astype(amp_dtype)
                            if amp_enabled and jnp.issubdtype(
                                v.dtype, jnp.floating) else v), params)
+            loss_mult = scaler_state["scale"] if fp16 else jnp.asarray(
+                1.0, jnp.float32)
             loss, grads = jax.value_and_grad(
-                lambda p: pipeline_loss(p, buffers_, rng, *batch))(compute)
+                lambda p: pipeline_loss(p, buffers_, rng, *batch).astype(
+                    jnp.float32) * loss_mult)(compute)
+            loss = loss / loss_mult  # report the UNscaled loss
             fgrads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), flat(grads))
-            new_params, new_opt = optimizer.apply_fn(
-                flat_params, fgrads, opt_state, lr=lr, t=t)
-            return loss, new_params, new_opt
+            if fp16:
+                new_params, new_opt, new_scaler = _apply_scaled_update(
+                    optimizer, flat_params, fgrads, opt_state, lr, t,
+                    scaler_state, sc)
+            else:
+                new_params, new_opt = optimizer.apply_fn(
+                    flat_params, fgrads, opt_state, lr=lr, t=t)
+                new_scaler = scaler_state
+            return loss, new_params, new_opt, new_scaler
 
         donate_args = (0, 2) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_args)
@@ -370,9 +389,10 @@ class PipelineParallelTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         arrs = self.shard_batch(*batch)
         with self.mesh:
-            loss, self._flat_params, self.opt_state = self._step(
-                self._flat_params, self.buffers, self.opt_state, rng, lr,
-                self._t, *arrs)
+            (loss, self._flat_params, self.opt_state,
+             self.scaler_state) = self._step(
+                self._flat_params, self.buffers, self.opt_state,
+                self.scaler_state, rng, lr, self._t, *arrs)
         return Tensor(loss)
 
     @property
@@ -432,16 +452,11 @@ class PipelineParallel(Layer):
         return self._layers(*args, **kw)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        if scaler is not None and getattr(scaler, "_enable", True):
-            # bf16 shares fp32's exponent range, so dynamic loss scaling is
-            # structurally unnecessary here; fp16 scaling is not implemented
-            # in the pipeline engine (use bf16 amp).
-            amp_dtype = (self._strategy.amp_configs.get("dtype", "bfloat16")
-                         if self._strategy else "bfloat16")
-            if amp_dtype == "float16":
-                raise NotImplementedError(
-                    "fp16 GradScaler is not supported in the pipeline "
-                    "engine; use bf16 amp (no loss scaling needed)")
+        # fp16 dynamic loss scaling runs INSIDE the compiled step (the
+        # engine carries scale/good-steps as arrays) when the strategy sets
+        # amp dtype='float16'; a user-passed GradScaler is therefore
+        # redundant here and its state is left untouched. bf16 needs no
+        # scaling at all (fp32 exponent range).
         if (self._train_step is not None
                 and self._train_step.optimizer is not optimizer):
             raise ValueError(

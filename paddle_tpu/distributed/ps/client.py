@@ -122,10 +122,12 @@ class PSClient:
 
     def _shard_indices(self, keys: np.ndarray):
         """Yield (server_idx, positions) for the keys%num_servers routing
-        shared by every sparse op."""
+        shared by every sparse op. positions is None for the single-server
+        fast path (callers use the arrays directly, no fancy-index copies).
+        """
         ns = self.num_servers
         if ns == 1:
-            yield 0, np.arange(keys.size)
+            yield 0, None
             return
         shard = (keys % np.uint64(ns)).astype(np.int64)
         for s in range(ns):
@@ -141,6 +143,9 @@ class PSClient:
         if keys.size == 0:
             return out
         for s, idx in self._shard_indices(keys):
+            if idx is None:
+                self._pull_shard(s, table_id, keys, out)
+                continue
             part = np.empty((idx.size, cfg.dim), np.float32)
             self._pull_shard(s, table_id, np.ascontiguousarray(keys[idx]),
                              part)
@@ -169,6 +174,9 @@ class PSClient:
         if keys.size == 0:
             return
         for s, idx in self._shard_indices(keys):
+            if idx is None:
+                self._push_shard(s, table_id, keys, grads)
+                continue
             self._push_shard(s, table_id, np.ascontiguousarray(keys[idx]),
                              np.ascontiguousarray(grads[idx]))
 
@@ -194,9 +202,12 @@ class PSClient:
         shows = np.ascontiguousarray(shows, np.float32).ravel()
         clicks = np.ascontiguousarray(clicks, np.float32).ravel()
         for s, idx in self._shard_indices(keys):
-            k = np.ascontiguousarray(keys[idx])
-            sh = np.ascontiguousarray(shows[idx])
-            cl = np.ascontiguousarray(clicks[idx])
+            if idx is None:
+                k, sh, cl = keys, shows, clicks
+            else:
+                k = np.ascontiguousarray(keys[idx])
+                sh = np.ascontiguousarray(shows[idx])
+                cl = np.ascontiguousarray(clicks[idx])
             step = self._sparse_chunk(4)
             for i in range(0, k.size, step):
                 ks = np.ascontiguousarray(k[i:i + step])
@@ -229,10 +240,13 @@ class PSClient:
         click = np.empty(n, np.float32)
         unseen = np.empty(n, np.int32)
         for s, idx in self._shard_indices(keys):
-            k = np.ascontiguousarray(keys[idx])
-            sh = np.empty(idx.size, np.float32)
-            cl = np.empty(idx.size, np.float32)
-            un = np.empty(idx.size, np.int32)
+            if idx is None:
+                k, sh, cl, un = keys, show, click, unseen
+            else:
+                k = np.ascontiguousarray(keys[idx])
+                sh = np.empty(idx.size, np.float32)
+                cl = np.empty(idx.size, np.float32)
+                un = np.empty(idx.size, np.int32)
             step = self._sparse_chunk(4)
             for i in range(0, k.size, step):
                 ks = np.ascontiguousarray(k[i:i + step])
@@ -243,8 +257,38 @@ class PSClient:
                     un[i:i + step].ctypes.data_as(_I32P))
                 if rc != 0:
                     raise RuntimeError(f"pull_meta({table_id}) failed")
-            show[idx], click[idx], unseen[idx] = sh, cl, un
+            if idx is not None:
+                show[idx], click[idx], unseen[idx] = sh, cl, un
         return show, click, unseen
+
+    # -------------------- disk spill (ssd_sparse_table) --------------------
+
+    def set_spill(self, table_id: int, dirname: str):
+        """Enable disk spill for a sparse table: cold rows move to an
+        append-only file per server, RAM keeps a key->offset index
+        (reference ps/table/ssd_sparse_table.cc over rocksdb)."""
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        for i, h in enumerate(self._handles):
+            path = os.path.join(dirname, f"spill_{table_id}_srv{i}.bin")
+            if self._lib.ps_set_spill(h, table_id, path.encode()) != 0:
+                raise RuntimeError(f"set_spill({table_id}) failed")
+
+    def spill_cold(self, table_id: int, max_unseen_days: int = 1) -> int:
+        """Move rows unseen for more than N day-ticks to disk; they restore
+        transparently on next pull/push. Returns rows spilled."""
+        total = 0
+        for h in self._handles:
+            n = self._lib.ps_spill_cold(h, table_id, int(max_unseen_days))
+            if n < 0:
+                raise RuntimeError(f"spill_cold({table_id}) failed "
+                                   "(set_spill first?)")
+            total += int(n)
+        return total
+
+    def spilled_size(self, table_id: int) -> int:
+        return sum(int(self._lib.ps_spilled_size(h, table_id))
+                   for h in self._handles)
 
     # ------------------------- control plane ------------------------------
 

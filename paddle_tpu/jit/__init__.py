@@ -210,7 +210,19 @@ class TrainStep:
                 lambda a: a.astype(amp_dtype)
                 if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
 
+        def cast_inputs(batch):
+            # O2 "pure" mode also feeds the network amp-dtype ACTIVATIONS
+            # (reference amp O2): without this, fp32 inputs (images) drag
+            # every conv back to fp32 because kernels follow the activation
+            # dtype. Labels/ids are integral and pass through.
+            if amp_dtype is None:
+                return batch
+            return tuple(a.astype(amp_dtype)
+                         if jnp.issubdtype(a.dtype, jnp.floating) else a
+                         for a in batch)
+
         def step(params, buffers, opt_state, rng, lr, t, *batch):
+            batch = cast_inputs(batch[:-1]) + (batch[-1],)
             def loss_of(p):
                 out, new_buffers = self.apply_fn(maybe_cast(p), buffers, rng,
                                                  *batch[:-1])

@@ -326,13 +326,13 @@ def _conv_nd(x, w, bias, stride, padding, dilation, groups, data_format, nd,
     @kernel(name)
     def impl(a, w, *b, stride=stride, pad=pad, dilation=dilation, groups=groups,
              dn=dn, lhs_spec=lhs_spec):
-        pet = jnp.float32 if a.dtype in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)) else None
+        # no preferred_element_type: the MXU accumulates bf16 convs in fp32
+        # natively, and the conv transpose (gradient) rule rejects
+        # mixed-dtype operands that pet's fp32 cotangents would create
         out = jax.lax.conv_general_dilated(
             a, w.astype(a.dtype), window_strides=stride, padding=pad,
             rhs_dilation=dilation, dimension_numbers=dn,
-            feature_group_count=groups, preferred_element_type=pet)
-        if pet is not None:
-            out = out.astype(a.dtype)
+            feature_group_count=groups)
         if b:
             bias_shape = [1] * out.ndim
             bias_shape[lhs_spec.index("C")] = b[0].size

@@ -261,6 +261,17 @@ class PTQ:
         self._convert(model)
         return model
 
+    def save_quantized_model(self, model: Layer, path: str, input_spec):
+        """Export the converted model as a servable int8 artifact
+        (reference slim `post_training_quantization.py`
+        save_quantized_model): the .pdiparams carries int8 weights +
+        scales (4x smaller), the .pdmodel StableHLO dequantizes at the
+        compute edge, and `paddle.inference.Predictor` serves it
+        directly."""
+        from ..jit import save as jit_save
+        model.eval()
+        jit_save(model, path, input_spec=input_spec)
+
     def _convert(self, layer: Layer):
         for name, child in list(layer._sub_layers.items()):
             if isinstance(child, (L.Linear, L.Conv2D)) and \

@@ -41,6 +41,10 @@ from ..ops import _dispatch
 # ---------------------------------------------------------------------------
 # Symbolic Variable
 # ---------------------------------------------------------------------------
+from . import passes as passes  # noqa: E402  (registered at import)
+from .passes import apply_pass, PassRegistry  # noqa: E402
+
+
 class Variable(Tensor):
     """Symbolic tensor in a Program (reference `fluid/framework.py:1171`).
 
@@ -242,6 +246,8 @@ class Program:
 
         def forward(feeds: Dict[str, Any], params: Dict[str, Any]):
             env: Dict[int, Any] = {}
+            # values pre-computed by constant_folding_pass (passes.py)
+            env.update(getattr(self, "folded_consts", {}))
             for name, vid in self.inputs.items():
                 if name in feeds:
                     env[vid] = feeds[name]

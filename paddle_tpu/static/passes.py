@@ -1,0 +1,201 @@
+"""Program rewrite-pass framework.
+
+Reference: the IR pass system (`/root/reference/paddle/fluid/framework/ir/`
+— `Pass`/`PassRegistry`, ~100 passes, 61.5k LoC). On TPU the fusion and
+memory passes are XLA's job, but repo-side graph rewrites still need a
+structured home (round-1 review: "amp/quant/fusion-hint rewrites have no
+home"). A Pass here rewrites the recorded-op `static.Program`
+(`static/__init__.py` `_OpNode` list) in place and bumps `program.version`
+so compiled-executable caches invalidate.
+
+Built-in passes:
+  * `amp_cast_pass`        — static AMP (reference `contrib/mixed_precision/
+                             fp16_utils.py` cast insertion): white-listed
+                             matmul-class ops compute in bf16/fp16, outputs
+                             cast back to fp32.
+  * `quant_insertion_pass` — QAT-style fake-quant around white-listed ops
+                             (reference `slim/quantization/quantization_pass
+                             .py` InsertQuantPass).
+  * `constant_folding_pass`— classic constant folding: ops whose inputs are
+                             all constants are evaluated once at pass time
+                             and their results embedded (reference
+                             `constant_folding_pass.cc`).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Pass", "PassRegistry", "register_pass", "apply_pass",
+           "AmpCastPass", "QuantInsertionPass", "ConstantFoldingPass"]
+
+# ops that benefit from reduced precision / quantization (MXU-bound);
+# mirrors the reference's white list shape (fp16_lists.py)
+_MATMUL_CLASS = ("matmul", "linear", "conv2d", "mm", "bmm", "addmm",
+                 "conv1d", "conv3d", "einsum")
+
+
+class Pass:
+    """Base rewrite pass (reference ir::Pass)."""
+
+    name = "pass"
+
+    def apply(self, program) -> None:
+        raise NotImplementedError
+
+    def __call__(self, program):
+        self.apply(program)
+        program.version += 1
+        return program
+
+
+class PassRegistry:
+    _passes: Dict[str, Callable[[], Pass]] = {}
+
+    @classmethod
+    def register(cls, name: str, factory: Callable[[], Pass]):
+        cls._passes[name] = factory
+
+    @classmethod
+    def get(cls, name: str, **kwargs) -> Pass:
+        if name not in cls._passes:
+            raise KeyError(f"unknown pass {name!r}; registered: "
+                           f"{sorted(cls._passes)}")
+        return cls._passes[name](**kwargs)
+
+    @classmethod
+    def list(cls) -> List[str]:
+        return sorted(cls._passes)
+
+
+def register_pass(name: str):
+    def deco(klass):
+        klass.name = name
+        PassRegistry.register(name, klass)
+        return klass
+    return deco
+
+
+def apply_pass(program, name_or_pass, **kwargs):
+    """Apply one pass (by registry name or instance) to a Program."""
+    p = (name_or_pass if isinstance(name_or_pass, Pass)
+         else PassRegistry.get(name_or_pass, **kwargs))
+    return p(program)
+
+
+def _is_float(aval) -> bool:
+    return hasattr(aval, "dtype") and jnp.issubdtype(aval.dtype, jnp.floating)
+
+
+@register_pass("amp_cast_pass")
+class AmpCastPass(Pass):
+    """White-listed ops compute in `dtype`, their outputs cast back to the
+    recorded aval dtype — so downstream ops (and fetch shapes) are
+    unchanged, exactly the reference's cast-insertion contract."""
+
+    def __init__(self, dtype=jnp.bfloat16, white_list=None):
+        self.dtype = jnp.dtype(dtype)
+        self.white_list = tuple(white_list or _MATMUL_CLASS)
+
+    def _matches(self, name: str) -> bool:
+        return any(name.startswith(w) for w in self.white_list)
+
+    def apply(self, program):
+        dtype = self.dtype
+        for node in program.ops:
+            if not self._matches(node.name):
+                continue
+            out_avals = [program.vars[v] for v in node.out_ids]
+            node.impl = _amp_wrap(node.impl, dtype,
+                                  tuple(getattr(a, "dtype", None)
+                                        for a in out_avals))
+
+
+def _amp_wrap(impl, dtype, out_dtypes):
+    @functools.wraps(impl)
+    def wrapped(*arrs, **kw):
+        cast = tuple(a.astype(dtype)
+                     if hasattr(a, "dtype")
+                     and jnp.issubdtype(a.dtype, jnp.floating) else a
+                     for a in arrs)
+        out = impl(*cast, **kw)
+        multi = isinstance(out, tuple)
+        outs = out if multi else (out,)
+        outs = tuple(o.astype(d) if d is not None
+                     and jnp.issubdtype(d, jnp.floating) else o
+                     for o, d in zip(outs, out_dtypes))
+        return outs if multi else outs[0]
+    return wrapped
+
+
+@register_pass("quant_insertion_pass")
+class QuantInsertionPass(Pass):
+    """Fake-quantize the float inputs of white-listed ops (abs-max, STE is
+    irrelevant on the inference/static path)."""
+
+    def __init__(self, bits: int = 8, white_list=None):
+        self.bits = bits
+        self.white_list = tuple(white_list or _MATMUL_CLASS)
+
+    def apply(self, program):
+        bits = self.bits
+        for node in program.ops:
+            if not any(node.name.startswith(w) for w in self.white_list):
+                continue
+            node.impl = _quant_wrap(node.impl, bits)
+
+
+def _quant_wrap(impl, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+
+    @functools.wraps(impl)
+    def wrapped(*arrs, **kw):
+        qarrs = []
+        for a in arrs:
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+                scale = jnp.maximum(jnp.max(jnp.abs(a)), 1e-8) / qmax
+                a = jnp.round(a / scale).clip(-qmax, qmax) * scale
+            qarrs.append(a)
+        return impl(*qarrs, **kw)
+    return wrapped
+
+
+@register_pass("constant_folding_pass")
+class ConstantFoldingPass(Pass):
+    """Evaluate ops whose inputs are all constants ONCE at pass time and
+    embed the results; downstream references become constants too. Ops with
+    randomness are left alone."""
+
+    _SKIP = ("dropout", "rand", "uniform", "normal", "bernoulli", "seed")
+
+    def apply(self, program):
+        const_vals: Dict[int, object] = {}
+        kept = []
+        for node in program.ops:
+            # rewrite inputs already known constant
+            node.inputs = [("const", const_vals[ref[1]])
+                           if ref[0] == "var" and ref[1] in const_vals
+                           else ref for ref in node.inputs]
+            foldable = (all(ref[0] == "const" for ref in node.inputs)
+                        and not any(s in node.name for s in self._SKIP)
+                        and not any(vid in program.grad_vids
+                                    for vid in node.out_ids)
+                        and all(vid != program.loss_vid
+                                for vid in node.out_ids))
+            if not foldable:
+                kept.append(node)
+                continue
+            args = [ref[1] for ref in node.inputs]
+            out = node.impl(*args, **node.kwargs)
+            outs = out if isinstance(out, tuple) else (out,)
+            for vid, val in zip(node.out_ids, outs):
+                const_vals[vid] = val
+        program.ops = kept
+        # fetchable folded vars must stay resolvable: record their values
+        if const_vals:
+            folded = getattr(program, "folded_consts", {})
+            folded.update(const_vals)
+            program.folded_consts = folded

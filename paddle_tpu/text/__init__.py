@@ -25,6 +25,7 @@ from ..nn.layer import Layer
 from ..ops import _dispatch
 
 __all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "Conll05st",
+           "WMT14", "WMT16",
            "ViterbiDecoder", "viterbi_decode"]
 
 
@@ -258,6 +259,108 @@ def viterbi_decode(potentials, transition_params, lengths=None,
     return _dispatch.call(
         _viterbi_impl, [potentials, transition_params, lengths],
         {"include_bos_eos_tag": include_bos_eos_tag}, nondiff=True)
+
+
+
+class WMT14(Dataset):
+    """WMT14 en-fr translation (reference `text/datasets/wmt14.py`): yields
+    (src_ids, trg_ids, trg_next_ids) with <s>/<e>/<unk> = 0/1/2, vocab
+    capped at `dict_size` by frequency. Local format: a tar whose
+    `{mode}*` members hold src\ttrg sentence pairs (one pair per line);
+    without data_file a deterministic synthetic corpus is generated (the
+    reference test-fixture pattern)."""
+
+    _BOS, _EOS, _UNK = 0, 1, 2
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 dict_size: int = 30000, download: bool = False):
+        _no_download(download)
+        if mode not in ("train", "test", "gen", "val", "valid"):
+            raise ValueError(f"bad mode {mode!r}")
+        self.dict_size = dict_size
+        if data_file is None:
+            self._synthesize(mode, dict_size)
+        else:
+            self._load_tar(data_file, mode, dict_size)
+
+    def _synthesize(self, mode, dict_size, n=128):
+        rng = np.random.default_rng(hash(mode) % (2 ** 31))
+        v = min(dict_size, 200)
+        self.pairs = []
+        for _ in range(n):
+            ls = int(rng.integers(4, 16))
+            src = rng.integers(3, v, ls).tolist()
+            trg = rng.integers(3, v, max(2, ls + int(rng.integers(-2, 3)))).tolist()
+            self.pairs.append((src, trg))
+        self.src_dict = {f"s{i}": i for i in range(v)}
+        self.trg_dict = {f"t{i}": i for i in range(v)}
+
+    def _load_tar(self, path, mode, dict_size):
+        want = {"val": "valid", "gen": "test"}.get(mode, mode)
+        texts, sfreq, tfreq = [], {}, {}
+        with tarfile.open(path, "r:*") as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if not base.startswith(want):
+                    continue
+                for line in tf.extractfile(m).read().decode(
+                        "utf-8", "replace").splitlines():
+                    if "\t" not in line:
+                        continue
+                    s, t = line.split("\t", 1)
+                    st, tt = s.split(), t.split()
+                    texts.append((st, tt))
+                    for w in st:
+                        sfreq[w] = sfreq.get(w, 0) + 1
+                    for w in tt:
+                        tfreq[w] = tfreq.get(w, 0) + 1
+        if not texts:
+            raise ValueError(f"no '{want}*' members with src\ttrg lines "
+                             f"in {path}")
+
+        def build(freq, size):
+            kept = sorted(freq, key=lambda w: (-freq[w], w))[:size - 3]
+            d = {"<s>": self._BOS, "<e>": self._EOS, "<unk>": self._UNK}
+            d.update({w: i + 3 for i, w in enumerate(kept)})
+            return d
+        self.src_dict = build(sfreq, getattr(self, "src_size", dict_size))
+        self.trg_dict = build(tfreq, getattr(self, "trg_size", dict_size))
+        self.pairs = [
+            ([self.src_dict.get(w, self._UNK) for w in st],
+             [self.trg_dict.get(w, self._UNK) for w in tt])
+            for st, tt in texts]
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def __getitem__(self, idx):
+        src, trg = self.pairs[idx]
+        src_ids = np.asarray(src, dtype=np.int64)
+        trg_ids = np.asarray([self._BOS] + trg, dtype=np.int64)
+        trg_next = np.asarray(trg + [self._EOS], dtype=np.int64)
+        return src_ids, trg_ids, trg_next
+
+    def get_dict(self, lang="en", reverse=False):
+        d = self.src_dict if lang in ("en", "src") else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)
+
+
+class WMT16(WMT14):
+    """WMT16 en-de (reference `text/datasets/wmt16.py`) — same mechanics as
+    WMT14 with PER-LANGUAGE dict sizes; `lang` picks the source side
+    (lang="en": en->de, anything else: de->en, i.e. pairs swapped)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 src_dict_size: int = 30000, trg_dict_size: int = 30000,
+                 lang: str = "en", download: bool = False):
+        self.lang = lang
+        self.src_size = int(src_dict_size)
+        self.trg_size = int(trg_dict_size)
+        super().__init__(data_file, mode, dict_size=self.src_size,
+                         download=download)
+        if lang != "en":
+            self.pairs = [(t, s) for s, t in self.pairs]
+            self.src_dict, self.trg_dict = self.trg_dict, self.src_dict
 
 
 class ViterbiDecoder(Layer):

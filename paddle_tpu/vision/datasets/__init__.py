@@ -21,7 +21,8 @@ import numpy as np
 
 from paddle_tpu.io.dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "Flowers", "VOC2012"]
 
 
 class FakeData(Dataset):
@@ -154,3 +155,110 @@ class Cifar100(Cifar10):
     _LABEL_KEY = b"fine_labels"
     _TRAIN_MEMBER = "train"
     _TEST_MEMBER = "test"
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference `vision/datasets/flowers.py`): images
+    tgz + imagelabels.mat + setid.mat (scipy .mat files, exactly the
+    reference's artifacts). Pass the three local files; downloads raise."""
+
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file: Optional[str] = None,
+                 label_file: Optional[str] = None,
+                 setid_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend=None):
+        _no_download(download)
+        if mode not in self._SPLIT_KEY:
+            raise ValueError(f"mode must be one of {list(self._SPLIT_KEY)}")
+        if data_file is None:
+            raise ValueError(
+                "Flowers needs data_file= (102flowers.tgz), label_file= "
+                "(imagelabels.mat) and setid_file= (setid.mat); downloads "
+                "are unavailable here")
+        import scipy.io as sio
+        self.transform = transform
+        labels = sio.loadmat(label_file)["labels"].ravel().astype(np.int64)
+        ids = sio.loadmat(setid_file)[self._SPLIT_KEY[mode]].ravel()
+        with tarfile.open(data_file, "r:*") as tf:
+            by_name = {os.path.basename(m.name): m
+                       for m in tf.getmembers() if m.name.endswith(".jpg")}
+            self.images, self.labels = [], []
+            for i in ids:
+                name = f"image_{int(i):05d}.jpg"
+                if name not in by_name:
+                    continue
+                from PIL import Image
+                import io as _io
+                raw = tf.extractfile(by_name[name]).read()
+                img = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"))
+                self.images.append(img)
+                self.labels.append(labels[int(i) - 1] - 1)  # 1-based .mat
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, np.array(self.labels[idx], dtype=np.int64)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference `vision/datasets/voc2012.py`):
+    reads the standard VOCtrainval tar (JPEGImages + SegmentationClass +
+    ImageSets/Segmentation lists); yields (image, label_mask) uint8 arrays
+    exactly like the reference."""
+
+    _LISTS = {"train": "train.txt", "valid": "val.txt", "test": "val.txt"}
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend=None):
+        _no_download(download)
+        if data_file is None:
+            raise ValueError("VOC2012 needs data_file= (VOCtrainval tar); "
+                             "downloads are unavailable here")
+        if mode not in self._LISTS:
+            raise ValueError(f"mode must be one of {list(self._LISTS)}")
+        from PIL import Image
+        import io as _io
+        self.transform = transform
+        with tarfile.open(data_file, "r:*") as tf:
+            names = {m.name: m for m in tf.getmembers()}
+            list_member = next(
+                (m for n, m in names.items()
+                 if n.endswith(f"ImageSets/Segmentation/{self._LISTS[mode]}")),
+                None)
+            if list_member is None:
+                raise ValueError(
+                    f"{data_file} has no ImageSets/Segmentation/"
+                    f"{self._LISTS[mode]} — not a VOCtrainval archive?")
+            ids = tf.extractfile(list_member).read().decode().split()
+            self.images, self.masks = [], []
+            for i in ids:
+                jm = next((m for n, m in names.items()
+                           if n.endswith(f"JPEGImages/{i}.jpg")), None)
+                sm = next((m for n, m in names.items()
+                           if n.endswith(f"SegmentationClass/{i}.png")), None)
+                if jm is None or sm is None:
+                    continue
+                img = np.asarray(Image.open(
+                    _io.BytesIO(tf.extractfile(jm).read())).convert("RGB"))
+                mask = np.asarray(Image.open(
+                    _io.BytesIO(tf.extractfile(sm).read())))
+                self.images.append(img)
+                self.masks.append(mask.astype(np.uint8))
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]

@@ -1,0 +1,120 @@
+"""ShuffleNet V2 (reference `python/paddle/vision/models/shufflenetv2.py`).
+Channel shuffle is a reshape/transpose — free on TPU (layout assignment),
+the grouped convs map to feature_group_count."""
+from __future__ import annotations
+
+from paddle_tpu import nn
+
+
+def channel_shuffle(x, groups: int):
+    import paddle_tpu as paddle
+    n, c, h, w = x.shape
+    x = paddle.reshape(x, [n, groups, c // groups, h, w])
+    x = paddle.transpose(x, [0, 2, 1, 3, 4])
+    return paddle.reshape(x, [n, c, h, w])
+
+
+def _conv_bn(in_c, out_c, k, stride=1, groups=1, act=True):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=(k - 1) // 2,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act:
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(in_c // 2, branch_c, 1),
+                _conv_bn(branch_c, branch_c, 3, stride=1, groups=branch_c,
+                         act=False),
+                _conv_bn(branch_c, branch_c, 1))
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(in_c, in_c, 3, stride=stride, groups=in_c,
+                         act=False),
+                _conv_bn(in_c, branch_c, 1))
+            self.branch2 = nn.Sequential(
+                _conv_bn(in_c, branch_c, 1),
+                _conv_bn(branch_c, branch_c, 3, stride=stride,
+                         groups=branch_c, act=False),
+                _conv_bn(branch_c, branch_c, 1))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: (24, 48, 96, 512), 0.33: (32, 64, 128, 512),
+    0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+    1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale must be one of {sorted(_STAGE_OUT)}")
+        c1, c2, c3, c_out = _STAGE_OUT[scale]
+        self.conv1 = _conv_bn(3, 24, 3, stride=2)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = 24
+        for out_c, repeat in ((c1, 4), (c2, 8), (c3, 4)):
+            blocks = [InvertedResidual(in_c, out_c, 2)]
+            blocks += [InvertedResidual(out_c, out_c, 1)
+                       for _ in range(repeat - 1)]
+            stages.append(nn.Sequential(*blocks))
+            in_c = out_c
+        self.stage2, self.stage3, self.stage4 = stages
+        self.conv5 = _conv_bn(in_c, c_out, 1)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c_out, num_classes)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.maxpool(self.conv1(x))
+        x = self.stage4(self.stage3(self.stage2(x)))
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(**kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(scale=2.0, **kw)

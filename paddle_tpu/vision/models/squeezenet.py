@@ -1,0 +1,69 @@
+"""SqueezeNet 1.0/1.1 (reference `python/paddle/vision/models/
+squeezenet.py`)."""
+from __future__ import annotations
+
+from paddle_tpu import nn
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Sequential(nn.Conv2D(in_c, squeeze, 1), nn.ReLU())
+        self.e1 = nn.Sequential(nn.Conv2D(squeeze, e1, 1), nn.ReLU())
+        self.e3 = nn.Sequential(nn.Conv2D(squeeze, e3, 3, padding=1),
+                                nn.ReLU())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        s = self.squeeze(x)
+        return paddle.concat([self.e1(s), self.e3(s)], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256))
+        elif version == "1.1":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        else:
+            raise ValueError("version must be '1.0' or '1.1'")
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU())
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        return paddle.flatten(x, 1)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet(version="1.0", **kw)
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet(version="1.1", **kw)

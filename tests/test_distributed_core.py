@@ -216,3 +216,31 @@ class TestParallelEnvAndDP:
         finally:
             dist.set_hybrid_communicate_group(None)
             dist.destroy_process_group()
+
+
+class TestEagerAllReduceSemantics:
+    """Single-controller all_reduce semantics (docstring contract): a tensor
+    SHARDED over the group axis reduces per-shard values — the case real
+    data-parallel pipelines hit; a replicated tensor sums N equal copies."""
+
+    def test_sharded_input_reduces_per_shard_values(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        dist.init_parallel_env()
+        g = dist.new_group(list(range(8)))
+        mesh = g.mesh
+        # 8 shards, shard r holds value r: sum must be 0+1+...+7 = 28
+        per_rank = np.arange(8, dtype=np.float32).reshape(8, 1)
+        x = paddle.to_tensor(per_rank)
+        x.data = jax.device_put(x.data, NamedSharding(mesh, P(g.axis)))
+        dist.all_reduce(x)
+        np.testing.assert_allclose(np.asarray(x.data),
+                                   np.full((8, 1), 28.0, np.float32))
+
+    def test_replicated_input_counts_group_size(self):
+        dist.init_parallel_env()
+        g = dist.new_group(list(range(8)))
+        x = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+        dist.all_reduce(x)
+        np.testing.assert_allclose(np.asarray(x.data),
+                                   np.full((4,), 16.0, np.float32))

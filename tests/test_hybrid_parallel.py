@@ -208,3 +208,75 @@ class TestFleetFacade:
         assert s2.amp and s2.sharding
         assert s2.sharding_configs["stage"] == 2
         assert s2.hybrid_configs["mp_degree"] == 4
+
+
+class TestFp16GradScaling:
+    """Strategy amp dtype='float16' runs dynamic loss scaling INSIDE the
+    compiled step (reference GradScaler/check_finite_and_unscale parity —
+    round-1 review flagged the engines as fp16-unsupported)."""
+
+    def _hcg(self, dims):
+        from paddle_tpu.distributed.topology import HybridCommunicateGroup
+        hcg = HybridCommunicateGroup(dims=dims)
+        dist.set_hybrid_communicate_group(hcg)
+        return hcg
+
+    def test_fp16_trains_and_keeps_scale(self):
+        hcg = self._hcg({"dp": 8})
+        try:
+            strategy = DistributedStrategy()
+            strategy.amp = True
+            strategy.amp_configs = {"dtype": "float16",
+                                    "init_loss_scaling": 256.0}
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                                  nn.Linear(32, 4))
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=model.parameters())
+            step = HybridParallelTrainStep(
+                model, lambda o, y: F.cross_entropy(o, y), opt, hcg=hcg,
+                strategy=strategy)
+            rng = np.random.default_rng(0)
+            x = paddle.to_tensor(rng.normal(size=(16, 16)).astype(np.float32))
+            y = paddle.to_tensor(rng.integers(0, 4, (16,)).astype(np.int32))
+            losses = [float(step(x, y)) for _ in range(12)]
+            assert all(np.isfinite(losses)), losses
+            assert losses[-1] < losses[0], losses
+            # healthy fp16 run: scale survives at its initial value
+            assert float(step.scaler_state["scale"]) == 256.0
+        finally:
+            dist.set_hybrid_communicate_group(None)
+
+    def test_overflow_shrinks_scale_and_skips_update(self):
+        hcg = self._hcg({"dp": 8})
+        try:
+            strategy = DistributedStrategy()
+            strategy.amp = True
+            # absurd scale: fp16 grads overflow -> update skipped, scale
+            # halves each step until training can resume
+            strategy.amp_configs = {"dtype": "float16",
+                                    "init_loss_scaling": 2.0 ** 40}
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(8, 8))
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=model.parameters())
+            step = HybridParallelTrainStep(
+                model, lambda o, y: ((o - y) ** 2).mean(), opt, hcg=hcg,
+                strategy=strategy)
+            w0 = np.asarray(step.params["0.weight"])
+            rng = np.random.default_rng(0)
+            x = paddle.to_tensor(rng.normal(size=(8, 8)).astype(np.float32))
+            y = paddle.to_tensor(rng.normal(size=(8, 8)).astype(np.float32))
+            float(step(x, y))
+            # overflowed: scale halved, parameters untouched
+            assert float(step.scaler_state["scale"]) == 2.0 ** 39
+            np.testing.assert_array_equal(
+                np.asarray(step.params["0.weight"]), w0)
+            for _ in range(40):
+                float(step(x, y))
+            # scale decayed into fp16 range and updates resumed
+            assert float(step.scaler_state["scale"]) < 2.0 ** 20
+            assert not np.array_equal(
+                np.asarray(step.params["0.weight"]), w0)
+        finally:
+            dist.set_hybrid_communicate_group(None)

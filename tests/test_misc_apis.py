@@ -339,3 +339,47 @@ class TestModelZooAdditions:
             assert losses[-1] < losses[0], (losses[0], losses[-1])
         finally:
             client.stop_servers()
+
+
+class TestZooBreadth:
+    """Round-2 zoo additions (reference vision/models + text/datasets)."""
+
+    def test_new_vision_models_forward(self):
+        from paddle_tpu.vision import models as M
+        paddle.seed(0)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 3, 64, 64)).astype(np.float32))
+        for fn in (lambda: M.googlenet(num_classes=7),
+                   lambda: M.shufflenet_v2_x0_25(num_classes=7),
+                   lambda: M.densenet121(num_classes=7, growth_rate=8),
+                   lambda: M.squeezenet1_1(num_classes=7)):
+            m = fn()
+            m.eval()
+            assert tuple(m(x).shape) == (2, 7)
+
+    def test_googlenet_train_returns_aux_heads(self):
+        from paddle_tpu.vision import models as M
+        paddle.seed(0)
+        g = M.googlenet(num_classes=5)
+        g.train()
+        x = paddle.to_tensor(np.random.default_rng(1).normal(
+            size=(2, 3, 64, 64)).astype(np.float32))
+        out, a1, a2 = g(x)
+        assert tuple(out.shape) == tuple(a1.shape) == tuple(a2.shape) == (2, 5)
+
+    def test_wmt_datasets(self):
+        from paddle_tpu.text import WMT14, WMT16
+        ds = WMT14(mode="train")
+        src, trg, trg_next = ds[3]
+        assert trg[0] == 0 and trg_next[-1] == 1  # <s> ... / ... <e>
+        assert len(trg) == len(trg_next)
+        assert len(WMT16(mode="test")) > 0
+
+    def test_flowers_voc_require_local_files(self):
+        from paddle_tpu.vision.datasets import Flowers, VOC2012
+        with pytest.raises(ValueError, match="data_file"):
+            Flowers()
+        with pytest.raises(ValueError, match="data_file"):
+            VOC2012()
+        with pytest.raises((ValueError, RuntimeError)):
+            Flowers(download=True)

@@ -209,8 +209,8 @@ class TestPipeline1F1BMemory:
             lr = jnp.asarray(1e-4, jnp.float32)
             with step.mesh:
                 compiled = step._step.lower(
-                    step._flat_params, step.buffers, step.opt_state, rng,
-                    lr, 1, *arrs).compile()
+                    step._flat_params, step.buffers, step.opt_state,
+                    step.scaler_state, rng, lr, 1, *arrs).compile()
                 temps[M] = compiled.memory_analysis().temp_size_in_bytes
             dist.set_hybrid_communicate_group(None)
         D = cfg.hidden_size

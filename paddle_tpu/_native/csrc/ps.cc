@@ -195,6 +195,9 @@ class SparseTable {
   }
 
   int64_t spill_cold(int32_t max_unseen_days) {
+    // COMPARES unseen_days without aging it: shrink() owns the day tick
+    // (running both daily must not age rows twice). Spill-only maintenance
+    // should pair this with an age-only shrink (negative threshold).
     // lock order is ALWAYS shard -> spill (restore_from_spill runs under a
     // shard lock), so the spill mutex is taken per-row inside the shard loop
     const size_t row = cfg_.dim * (1 + state_slots(cfg_.opt));
@@ -207,7 +210,6 @@ class SparseTable {
       std::lock_guard<std::mutex> g(s.mu);
       for (auto it = s.map.begin(); it != s.map.end();) {
         SparseEntry& e = it->second;
-        e.unseen_days += 1;
         if (e.unseen_days > static_cast<uint32_t>(max_unseen_days)) {
           std::lock_guard<std::mutex> gs(spill_mu_);
           if (!spill_f_) return spilled;
@@ -339,6 +341,13 @@ class SparseTable {
   }
 
   bool load(FILE* f) {
+    {
+      // the checkpoint is fully materialized (save reads spilled rows
+      // back), so stale disk offsets must not survive a restore — they
+      // would resurrect pre-checkpoint weights after a later eviction
+      std::lock_guard<std::mutex> g(spill_mu_);
+      spill_index_.clear();
+    }
     uint32_t magic = 0;
     if (fread(&magic, 4, 1, f) != 1 || magic != kMagic)
       return false;  // clean failure on old/foreign files, not corruption

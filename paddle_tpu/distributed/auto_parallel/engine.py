@@ -76,6 +76,11 @@ class Engine:
         completion + partition + reshard happen here — for us, jit)."""
         if self._prepared:
             return
+        if self.process_mesh is None:
+            raise RuntimeError(
+                "Engine(plan='auto') has not planned yet: feed it a batch "
+                "first (train_batch/fit/evaluate) — predict/save need the "
+                "planned mesh")
         from ...jit import functionalize
 
         self.jmesh: Mesh = self.process_mesh.to_jax()
@@ -172,7 +177,22 @@ class Engine:
         """train_data: iterable of (inputs..., labels) batches (DataLoader
         etc.) — or, when `batch_size` is given, one (inputs..., labels)
         tuple of full arrays that the engine slices into batches."""
-        self.prepare()
+        if batch_size is None and self.plan_mode == "auto" \
+                and self.plan_result is None:
+            # peek the first batch for the planner. Re-iterables (lists,
+            # DataLoaders) are peeked non-destructively; true one-shot
+            # iterators are re-chained so the batch still trains — but then
+            # multi-epoch fit cannot re-iterate (caller's constraint).
+            import itertools
+            it = iter(train_data)
+            try:
+                first = next(it)
+            except StopIteration:
+                return self.history
+            batch = first if isinstance(first, (list, tuple)) else (first,)
+            self._maybe_plan(self._as_arrays(batch))
+            if it is iter(train_data):  # same exhausted object: one-shot
+                train_data = itertools.chain([first], it)
         if batch_size is not None:
             ndev = self.process_mesh.get_dim_size(self.data_dim)
             if batch_size % ndev:
@@ -180,6 +200,7 @@ class Engine:
                     f"batch_size {batch_size} must be divisible by the "
                     f"'{self.data_dim}' mesh dim ({ndev})")
             arrs = self._as_arrays(tuple(train_data))
+            self._maybe_plan(tuple(a[:batch_size] for a in arrs))
             n = (arrs[0].shape[0] // batch_size) * batch_size  # drop_last
             if n == 0:
                 raise ValueError(
@@ -199,8 +220,8 @@ class Engine:
 
     def evaluate(self, eval_data) -> float:
         if self.plan_mode == "auto" and self.plan_result is None:
-            # peek one batch for the planner WITHOUT consuming one-shot
-            # iterables: re-chain the peeked batch in front
+            # peek one batch for the planner; re-chain only for true
+            # one-shot iterators (re-iterables are peeked harmlessly)
             import itertools
             it = iter(eval_data)
             try:
@@ -209,7 +230,8 @@ class Engine:
                 return 0.0
             batch = first if isinstance(first, (list, tuple)) else (first,)
             self._maybe_plan(self._as_arrays(batch))
-            eval_data = itertools.chain([first], it)
+            if it is iter(eval_data):
+                eval_data = itertools.chain([first], it)
         self.prepare()
         tot, n = 0.0, 0
         for batch in eval_data:

@@ -173,6 +173,8 @@ class Planner:
             for template in self.templates:
                 if template == "dp" and mp > 1:
                     continue  # replicated-over-mp duplicates pure dp
+                if template != "dp" and mp == 1:
+                    continue  # no mp axis: identical to pure dp
                 try:
                     p = self._score_candidate(dp, mp, template, arrs)
                 except Exception as e:  # an uncompilable candidate is skipped

@@ -104,6 +104,7 @@ def init(role_maker=None, is_collective=True,
     if get_hybrid_communicate_group() is None or any(
             v > 1 for v in dims.values()):
         hcg = HybridCommunicateGroup(dims=dims)
+        hcg.sp_mode = _state.strategy.hybrid_configs.get("sp_mode", "ring")
         set_hybrid_communicate_group(hcg)
     _state.initialized = True
     return None

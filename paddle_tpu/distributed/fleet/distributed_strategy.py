@@ -62,6 +62,7 @@ class HybridConfig:
     pp_degree: int = 1
     sharding_degree: int = 1
     sep_degree: int = 1              # sequence/context parallel (ours)
+    sp_mode: str = "ring"            # "ring" | "ulysses" attention flavor
 
 
 class DistributedStrategy:

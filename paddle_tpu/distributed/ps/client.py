@@ -276,7 +276,12 @@ class PSClient:
 
     def spill_cold(self, table_id: int, max_unseen_days: int = 1) -> int:
         """Move rows unseen for more than N day-ticks to disk; they restore
-        transparently on next pull/push. Returns rows spilled."""
+        transparently on next pull/push. Returns rows spilled.
+
+        `shrink()` owns the day tick — spill_cold only COMPARES the age, so
+        daily maintenance pairs them: `shrink(tid, thr, evict_days)` then
+        `spill_cold(tid, spill_days)`. For spill-only maintenance use an
+        age-only shrink (negative threshold evicts nothing but ages)."""
         total = 0
         for h in self._handles:
             n = self._lib.ps_spill_cold(h, table_id, int(max_unseen_days))

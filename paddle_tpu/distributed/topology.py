@@ -144,6 +144,10 @@ class HybridCommunicateGroup:
             assert dims is not None, "need topology, mesh or dims"
             mesh = build_mesh(dims)
         self._mesh = mesh
+        # sequence-parallel attention flavor: "ring" (ppermute ring, never
+        # materializes full K/V — extreme L) or "ulysses" (2 all-to-alls,
+        # full-seq flash kernel per head group — moderate L, needs H%sp==0)
+        self.sp_mode = "ring"
         ax = dict(zip(mesh.axis_names, mesh.devices.shape))
         self._dp_degree = ax.get("dp", 1)
         self._pp_degree = ax.get("pp", 1)

@@ -140,3 +140,43 @@ def resnet101(pretrained=False, **kwargs):
 
 def resnet152(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 152, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    """ResNeXt-50 32x4d (reference vision/models/resnext.py): grouped
+    bottlenecks — groups=32, width-per-group=4."""
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 50, width=4, groups=32, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 101, width=4, groups=32, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 101, width=4, groups=64, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 152, width=4, groups=64, **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    """Wide ResNet-50-2 (reference wide_resnet.py): 2x bottleneck width."""
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 50, width=128, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return ResNet(BottleneckBlock, 101, width=128, **kwargs)
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise ValueError("pretrained weights are unavailable in this "
+                         "environment (zero egress); train from scratch or "
+                         "load a local state_dict")

@@ -1024,9 +1024,11 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1):
 # attention (used by nn.MultiHeadAttention and transformer models)
 # ---------------------------------------------------------------------------
 def _sp_ring_config(query, key, attn_mask):
-    """(mesh, axis) when sequence parallelism should route to ring
-    attention: an active HCG with sp>1, no arbitrary mask, self-attention
-    (q/k chunked identically), seq divisible by the axis."""
+    """(mesh, axis, mode) when sequence parallelism should route to ring or
+    Ulysses attention: an active HCG with sp>1, no arbitrary mask,
+    self-attention (q/k chunked identically), seq divisible by the axis.
+    mode follows `hcg.sp_mode` ("ring" default; "ulysses" when configured
+    AND heads divide the axis)."""
     if attn_mask is not None:
         return None
     if key.shape[1] != query.shape[1]:
@@ -1045,7 +1047,10 @@ def _sp_ring_config(query, key, attn_mask):
     L = query.shape[1]
     if L % sp != 0:
         return None
-    return hcg.mesh, "sp"
+    mode = getattr(hcg, "sp_mode", "ring")
+    if mode == "ulysses" and query.shape[2] % sp != 0:
+        mode = "ring"  # heads not divisible: fall back
+    return hcg.mesh, "sp", mode
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -1060,14 +1065,18 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """
     sp_ring = _sp_ring_config(query, key, attn_mask)
     if sp_ring is not None:
-        mesh, axis = sp_ring
-        from ...ops.pallas.ring_attention import ring_attention
+        mesh, axis, mode = sp_ring
+        if mode == "ulysses":
+            from ...ops.pallas.ulysses import ulysses_attention as sp_attn
+        else:
+            from ...ops.pallas.ring_attention import ring_attention as sp_attn
 
-        @kernel("ring_attention")
-        def ring_impl(q, k, v, is_causal=is_causal, _mesh=mesh, _axis=axis):
-            return ring_attention(q, k, v, mesh=_mesh, axis_name=_axis,
-                                  causal=is_causal)
-        out = _d.call(ring_impl, (query, key, value), name="ring_attention")
+        @kernel("sp_attention")
+        def ring_impl(q, k, v, is_causal=is_causal, _mesh=mesh, _axis=axis,
+                      _fn=sp_attn):
+            return _fn(q, k, v, mesh=_mesh, axis_name=_axis,
+                       causal=is_causal)
+        out = _d.call(ring_impl, (query, key, value), name="sp_attention")
         if dropout_p > 0.0 and training:
             out = dropout(out, p=dropout_p, training=training)
         return out

@@ -354,8 +354,15 @@ class WMT16(WMT14):
                  src_dict_size: int = 30000, trg_dict_size: int = 30000,
                  lang: str = "en", download: bool = False):
         self.lang = lang
-        self.src_size = int(src_dict_size)
-        self.trg_size = int(trg_dict_size)
+        # dicts are built on the FILE's (en, de) sides before the direction
+        # swap, so for de->en the caps must be pre-swapped to land on the
+        # requested source/target sides after it
+        if lang == "en":
+            self.src_size = int(src_dict_size)
+            self.trg_size = int(trg_dict_size)
+        else:
+            self.src_size = int(trg_dict_size)
+            self.trg_size = int(src_dict_size)
         super().__init__(data_file, mode, dict_size=self.src_size,
                          download=download)
         if lang != "en":

@@ -181,26 +181,27 @@ class Flowers(Dataset):
         self.transform = transform
         labels = sio.loadmat(label_file)["labels"].ravel().astype(np.int64)
         ids = sio.loadmat(setid_file)[self._SPLIT_KEY[mode]].ravel()
+        # keep COMPRESSED bytes; decode lazily in __getitem__ (the real
+        # tgz decoded eagerly is multiple GB of numpy)
         with tarfile.open(data_file, "r:*") as tf:
             by_name = {os.path.basename(m.name): m
                        for m in tf.getmembers() if m.name.endswith(".jpg")}
-            self.images, self.labels = [], []
+            self._raw, self.labels = [], []
             for i in ids:
                 name = f"image_{int(i):05d}.jpg"
                 if name not in by_name:
                     continue
-                from PIL import Image
-                import io as _io
-                raw = tf.extractfile(by_name[name]).read()
-                img = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"))
-                self.images.append(img)
+                self._raw.append(tf.extractfile(by_name[name]).read())
                 self.labels.append(labels[int(i) - 1] - 1)  # 1-based .mat
 
     def __len__(self):
-        return len(self.images)
+        return len(self._raw)
 
     def __getitem__(self, idx):
-        img = self.images[idx]
+        import io as _io
+        from PIL import Image
+        img = np.asarray(Image.open(_io.BytesIO(self._raw[idx]))
+                         .convert("RGB"))
         if self.transform is not None:
             img = self.transform(img)
         else:
@@ -225,40 +226,43 @@ class VOC2012(Dataset):
                              "downloads are unavailable here")
         if mode not in self._LISTS:
             raise ValueError(f"mode must be one of {list(self._LISTS)}")
-        from PIL import Image
-        import io as _io
         self.transform = transform
+        # one pass over the archive to index members by suffix class; keep
+        # COMPRESSED bytes and decode lazily (the real VOCtrainval tar
+        # decoded eagerly is multiple GB of numpy)
         with tarfile.open(data_file, "r:*") as tf:
-            names = {m.name: m for m in tf.getmembers()}
-            list_member = next(
-                (m for n, m in names.items()
-                 if n.endswith(f"ImageSets/Segmentation/{self._LISTS[mode]}")),
-                None)
+            jpegs, segs, list_member = {}, {}, None
+            want_list = f"ImageSets/Segmentation/{self._LISTS[mode]}"
+            for m in tf.getmembers():
+                n = m.name
+                if n.endswith(want_list):
+                    list_member = m
+                elif "/JPEGImages/" in n and n.endswith(".jpg"):
+                    jpegs[os.path.basename(n)[:-4]] = m
+                elif "/SegmentationClass/" in n and n.endswith(".png"):
+                    segs[os.path.basename(n)[:-4]] = m
             if list_member is None:
                 raise ValueError(
-                    f"{data_file} has no ImageSets/Segmentation/"
-                    f"{self._LISTS[mode]} — not a VOCtrainval archive?")
+                    f"{data_file} has no {want_list} — not a VOCtrainval "
+                    "archive?")
             ids = tf.extractfile(list_member).read().decode().split()
-            self.images, self.masks = [], []
+            self._raw_img, self._raw_mask = [], []
             for i in ids:
-                jm = next((m for n, m in names.items()
-                           if n.endswith(f"JPEGImages/{i}.jpg")), None)
-                sm = next((m for n, m in names.items()
-                           if n.endswith(f"SegmentationClass/{i}.png")), None)
-                if jm is None or sm is None:
+                if i not in jpegs or i not in segs:
                     continue
-                img = np.asarray(Image.open(
-                    _io.BytesIO(tf.extractfile(jm).read())).convert("RGB"))
-                mask = np.asarray(Image.open(
-                    _io.BytesIO(tf.extractfile(sm).read())))
-                self.images.append(img)
-                self.masks.append(mask.astype(np.uint8))
+                self._raw_img.append(tf.extractfile(jpegs[i]).read())
+                self._raw_mask.append(tf.extractfile(segs[i]).read())
 
     def __len__(self):
-        return len(self.images)
+        return len(self._raw_img)
 
     def __getitem__(self, idx):
-        img = self.images[idx]
+        import io as _io
+        from PIL import Image
+        img = np.asarray(Image.open(
+            _io.BytesIO(self._raw_img[idx])).convert("RGB"))
+        mask = np.asarray(Image.open(
+            _io.BytesIO(self._raw_mask[idx]))).astype(np.uint8)
         if self.transform is not None:
             img = self.transform(img)
-        return img, self.masks[idx]
+        return img, mask

@@ -7,7 +7,8 @@ environment) and raises with a clear message.
 """
 from paddle_tpu.models.resnet import (  # noqa: F401
     BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
-    resnet101, resnet152)
+    resnet101, resnet152, resnext50_32x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_64x4d, wide_resnet50_2, wide_resnet101_2)
 from paddle_tpu.models.lenet import LeNet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (  # noqa: F401
@@ -24,6 +25,8 @@ from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 
 __all__ = [
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "resnext50_32x4d", "resnext101_32x4d", "resnext101_64x4d",
+    "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2",
     "LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
     "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
     "AlexNet", "alexnet",

@@ -218,3 +218,21 @@ class TestPlanner:
         # the chosen mesh drives the engine's process mesh
         assert dict(zip(eng.process_mesh.dim_names,
                         eng.process_mesh.mesh.shape)) == eng.plan_result.mesh_dims
+
+    def test_engine_plan_auto_fit_entrypoint(self):
+        """Regression: fit() (the flagship entry) must plan before
+        prepare(); predict/save before any batch raise a clear error."""
+        from paddle_tpu.distributed.auto_parallel import Engine
+        model = self._wide_mlp(d=128)
+        opt = optimizer.Adam(learning_rate=5e-3,
+                             parameters=model.parameters())
+        eng = Engine(model, loss=lambda o, y: F.cross_entropy(o, y),
+                     optimizer=opt, plan="auto")
+        with pytest.raises(RuntimeError, match="plan"):
+            eng.predict(np.zeros((8, 128), np.float32))
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(16, 128)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 8, (16,)).astype(np.int32))
+        hist = eng.fit([(x, y)], epochs=3)
+        assert eng.plan_result is not None
+        assert hist["loss"][-1] < hist["loss"][0]

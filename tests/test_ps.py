@@ -405,9 +405,11 @@ class TestDiskSpill:
         cold = np.arange(100, 120, dtype=np.uint64)
         cold_vals = c.pull_sparse(40, cold).copy()
         c.pull_sparse(40, hot)
-        # tick 1 ages everyone; touching hot resets it
-        assert c.spill_cold(40, max_unseen_days=1) == 0
-        c.pull_sparse(40, hot)
+        # shrink owns the day tick (spill_cold only COMPARES — running both
+        # daily must not double-age); negative threshold = age-only
+        for _ in range(2):
+            c.shrink(40, threshold=-1.0, max_unseen_days=10**6)
+            c.pull_sparse(40, hot)  # touching hot keeps it resident
         n = c.spill_cold(40, max_unseen_days=1)
         assert n == 20, n  # all cold rows went to disk
         assert c.spilled_size(40) == 20
@@ -425,7 +427,8 @@ class TestDiskSpill:
         k = np.array([5], np.uint64)
         c.pull_sparse(43, k)
         for _ in range(2):
-            c.spill_cold(43, max_unseen_days=1)
+            c.shrink(43, threshold=-1.0, max_unseen_days=10**6)
+        c.spill_cold(43, max_unseen_days=1)
         assert c.spilled_size(43) == 1
         # re-pointing the spill would orphan the only copy of that row
         import pytest as _pytest
@@ -440,7 +443,8 @@ class TestDiskSpill:
         k = np.array([7], np.uint64)
         v0 = c.pull_sparse(41, k)[0].copy()
         for _ in range(2):
-            c.spill_cold(41, max_unseen_days=1)
+            c.shrink(41, threshold=-1.0, max_unseen_days=10**6)
+        c.spill_cold(41, max_unseen_days=1)
         assert c.spilled_size(41) == 1
         c.push_sparse(41, k, np.ones((1, 2), np.float32))  # restores + sgd
         np.testing.assert_allclose(c.pull_sparse(41, k)[0], v0 - 1.0,
@@ -453,7 +457,8 @@ class TestDiskSpill:
         keys = np.arange(10, dtype=np.uint64)
         vals = c.pull_sparse(42, keys).copy()
         for _ in range(2):
-            c.spill_cold(42, max_unseen_days=1)
+            c.shrink(42, threshold=-1.0, max_unseen_days=10**6)
+        c.spill_cold(42, max_unseen_days=1)
         assert c.spilled_size(42) == 10
         ck = str(tmp_path / "ck")
         import os

@@ -137,6 +137,15 @@ def _declare(lib: ctypes.CDLL):
     lib.ps_spill_cold.argtypes = [c.c_int, c.c_int, c.c_int]
     lib.ps_spilled_size.restype = c.c_int64
     lib.ps_spilled_size.argtypes = [c.c_int, c.c_int]
+    i64p = c.POINTER(c.c_int64)
+    lib.ps_graph_add_edges.restype = c.c_int
+    lib.ps_graph_add_edges.argtypes = [c.c_int, c.c_int, u64p, u64p, f32p,
+                                       c.c_int64]
+    lib.ps_graph_sample.restype = c.c_int64
+    lib.ps_graph_sample.argtypes = [c.c_int, c.c_int, u64p, c.c_int64,
+                                    c.c_int, c.c_uint64, i32p, u64p]
+    lib.ps_graph_degree.restype = c.c_int
+    lib.ps_graph_degree.argtypes = [c.c_int, c.c_int, u64p, c.c_int64, i64p]
 
     # TCPStore
     lib.store_server_create.restype = c.c_int

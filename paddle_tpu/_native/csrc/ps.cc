@@ -15,6 +15,7 @@
 // On TPU the dense math lives in XLA; this server exists for the 100B-feature
 // embedding workloads (Wide&Deep/DeepFM) whose tables exceed HBM.
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cmath>
@@ -56,6 +57,9 @@ enum Cmd : uint8_t {
   CMD_SET_SPILL = 16,        // enable disk spill (ssd_sparse_table equiv.)
   CMD_SPILL_COLD = 17,       // move unseen>N rows to the spill file
   CMD_SPILLED_SIZE = 18,     // rows currently on disk
+  CMD_GRAPH_ADD_EDGES = 19,  // graph table (common_graph_table equiv.)
+  CMD_GRAPH_SAMPLE = 20,     // weighted neighbor sampling
+  CMD_GRAPH_DEGREE = 21,
 };
 
 // OPT_SUM: raw delta-apply (w += g) — the server side of geo-SGD
@@ -543,6 +547,139 @@ class DenseTable {
   uint32_t step_ = 0;
 };
 
+// Graph table (reference ps/table/common_graph_table.cc): adjacency lists
+// with edge weights, served to GNN samplers (the host side of
+// graph_khop_sampler / graph_send_recv pipelines). Nodes shard across
+// servers by node id (client side), and across internal buckets here.
+class GraphTable {
+ public:
+  static constexpr int kShards = 16;
+
+  void add_edges(const uint64_t* src, const uint64_t* dst,
+                 const float* w, int64_t n) {
+    // group by shard first: one lock per touched shard per batch, not
+    // per edge (bulk loads are the GNN norm)
+    std::vector<int64_t> order[kShards];
+    for (int64_t i = 0; i < n; ++i)
+      order[splitmix64(src[i]) % kShards].push_back(i);
+    for (int b = 0; b < kShards; ++b) {
+      if (order[b].empty()) continue;
+      Shard& s = shards_[b];
+      std::lock_guard<std::mutex> g(s.mu);
+      for (int64_t i : order[b])
+        s.adj[src[i]].emplace_back(dst[i], w ? w[i] : 1.0f);
+    }
+  }
+
+  int64_t degree(uint64_t node) {
+    Shard& s = shard(node);
+    std::lock_guard<std::mutex> g(s.mu);
+    auto it = s.adj.find(node);
+    return it == s.adj.end() ? 0 : static_cast<int64_t>(it->second.size());
+  }
+
+  // Sample up to k neighbors per node, weight-proportional without
+  // replacement when deg > k (reference WeightedSampler); all neighbors
+  // when deg <= k. Deterministic under `seed`.
+  void sample(const uint64_t* nodes, int64_t n, int32_t k, uint64_t seed,
+              std::vector<int32_t>* counts, std::vector<uint64_t>* out) {
+    counts->resize(n);
+    out->clear();
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& s = shard(nodes[i]);
+      std::lock_guard<std::mutex> g(s.mu);
+      auto it = s.adj.find(nodes[i]);
+      if (it == s.adj.end()) {
+        (*counts)[i] = 0;
+        continue;
+      }
+      auto& nb = it->second;
+      int32_t deg = static_cast<int32_t>(nb.size());
+      if (deg <= k) {
+        (*counts)[i] = deg;
+        for (auto& p : nb) out->push_back(p.first);
+        continue;
+      }
+      // weighted sampling without replacement (A-ES: keys u^(1/w), top-k)
+      uint64_t h = splitmix64(seed ^ nodes[i]);
+      std::vector<std::pair<float, uint64_t>> keyed;
+      keyed.reserve(deg);
+      for (auto& p : nb) {
+        h = splitmix64(h);
+        float u = unit_uniform(h);
+        float wgt = p.second > 0 ? p.second : 1e-6f;
+        keyed.emplace_back(std::pow(u, 1.0f / wgt), p.first);
+      }
+      std::partial_sort(keyed.begin(), keyed.begin() + k, keyed.end(),
+                        [](auto& a, auto& b) { return a.first > b.first; });
+      (*counts)[i] = k;
+      for (int32_t j = 0; j < k; ++j) out->push_back(keyed[j].second);
+    }
+  }
+
+  int64_t node_count() const {
+    int64_t t = 0;
+    for (const Shard& s : shards_) {
+      std::lock_guard<std::mutex> g(s.mu);
+      t += static_cast<int64_t>(s.adj.size());
+    }
+    return t;
+  }
+
+  bool save(FILE* f) const {
+    int64_t nodes = node_count();
+    fwrite(&nodes, 8, 1, f);
+    for (const Shard& s : shards_) {
+      std::lock_guard<std::mutex> g(s.mu);
+      for (const auto& kv : s.adj) {
+        fwrite(&kv.first, 8, 1, f);
+        int64_t deg = static_cast<int64_t>(kv.second.size());
+        fwrite(&deg, 8, 1, f);
+        for (const auto& e : kv.second) {
+          fwrite(&e.first, 8, 1, f);
+          fwrite(&e.second, 4, 1, f);
+        }
+      }
+    }
+    return true;
+  }
+
+  bool load(FILE* f) {
+    int64_t nodes = 0;
+    if (fread(&nodes, 8, 1, f) != 1) return false;
+    for (int64_t i = 0; i < nodes; ++i) {
+      uint64_t node;
+      int64_t deg;
+      if (fread(&node, 8, 1, f) != 1 || fread(&deg, 8, 1, f) != 1 ||
+          deg < 0)
+        return false;
+      Shard& s = shard(node);
+      std::lock_guard<std::mutex> g(s.mu);
+      auto& vec = s.adj[node];
+      vec.clear();
+      vec.reserve(deg);
+      for (int64_t j = 0; j < deg; ++j) {
+        uint64_t dst;
+        float w;
+        if (fread(&dst, 8, 1, f) != 1 || fread(&w, 4, 1, f) != 1)
+          return false;
+        vec.emplace_back(dst, w);
+      }
+    }
+    return true;
+  }
+
+ private:
+  struct Shard {
+    mutable std::mutex mu;
+    std::unordered_map<uint64_t,
+                       std::vector<std::pair<uint64_t, float>>> adj;
+  };
+  Shard& shard(uint64_t key) { return shards_[splitmix64(key) % kShards]; }
+  Shard shards_[kShards];
+};
+
+
 struct Barrier {
   int count = 0;
   int64_t generation = 0;
@@ -823,10 +960,72 @@ class Server {
         resp->i64(t->spilled_size());
         return true;
       }
+      case CMD_GRAPH_ADD_EDGES: {
+        GraphTable* t = graph(tid);
+        int64_t n = r->i64();
+        uint8_t has_w = r->u8();
+        if (n < 0 || n > static_cast<int64_t>(ptnet::kMaxFrameLen) / 20)
+          return err(resp, "bad edge count");
+        const uint64_t* src =
+            reinterpret_cast<const uint64_t*>(r->raw(n * 8));
+        const uint64_t* dst =
+            reinterpret_cast<const uint64_t*>(r->raw(n * 8));
+        const float* w = nullptr;
+        if (has_w)
+          w = reinterpret_cast<const float*>(r->raw(n * 4));
+        if (n > 0 && (!src || !dst || (has_w && !w)))
+          return err(resp, "truncated frame");
+        t->add_edges(src, dst, w, n);
+        resp->u8(ST_OK);
+        return true;
+      }
+      case CMD_GRAPH_SAMPLE: {
+        GraphTable* t = graph(tid);
+        int64_t n = r->i64();
+        int32_t k = r->i32();
+        uint64_t seed = r->u64();
+        if (n < 0 || k < 0 ||
+            n > static_cast<int64_t>(ptnet::kMaxFrameLen) /
+                    (8 + 4 + 8 * std::max(k, 1)))
+          return err(resp, "bad sample request");
+        const uint64_t* nodes =
+            reinterpret_cast<const uint64_t*>(r->raw(n * 8));
+        if (n > 0 && !nodes) return err(resp, "truncated frame");
+        std::vector<int32_t> counts;
+        std::vector<uint64_t> out;
+        t->sample(nodes, n, k, seed, &counts, &out);
+        resp->u8(ST_OK);
+        resp->i64(n);
+        resp->i64(static_cast<int64_t>(out.size()));
+        resp->bytes(counts.data(), counts.size() * 4);
+        resp->bytes(out.data(), out.size() * 8);
+        return true;
+      }
+      case CMD_GRAPH_DEGREE: {
+        GraphTable* t = graph(tid);
+        int64_t n = r->i64();
+        if (n < 0 || n > static_cast<int64_t>(ptnet::kMaxFrameLen) / 16)
+          return err(resp, "bad node count");
+        const uint64_t* nodes =
+            reinterpret_cast<const uint64_t*>(r->raw(n * 8));
+        if (n > 0 && !nodes) return err(resp, "truncated frame");
+        std::vector<int64_t> degs(n);
+        for (int64_t i = 0; i < n; ++i) degs[i] = t->degree(nodes[i]);
+        resp->u8(ST_OK);
+        resp->i64(n);
+        resp->bytes(degs.data(), n * 8);
+        return true;
+      }
       case CMD_TABLE_SIZE: {
         std::lock_guard<std::mutex> g(tables_mu_);
         auto it = sparse_.find(tid);
-        int64_t n = (it != sparse_.end()) ? it->second->size() : -1;
+        int64_t n = -1;
+        if (it != sparse_.end()) {
+          n = it->second->size();
+        } else {
+          auto gt = graph_.find(tid);
+          if (gt != graph_.end()) n = gt->second->node_count();
+        }
         resp->u8(ST_OK);
         resp->i64(n);
         return true;
@@ -840,6 +1039,14 @@ class Server {
         for (auto& kv : sparse_)
           if (!save_one(dir, kv.first, /*sparse=*/true))
             return err(resp, "save failed");
+        for (auto& kv : graph_) {
+          FILE* f = fopen((dir + "/graph_" +
+                           std::to_string(kv.first) + ".bin").c_str(), "wb");
+          if (!f) return err(resp, "save failed");
+          bool ok = kv.second->save(f);
+          fclose(f);
+          if (!ok) return err(resp, "save failed");
+        }
         resp->u8(ST_OK);
         return true;
       }
@@ -852,6 +1059,14 @@ class Server {
         for (auto& kv : sparse_)
           if (!load_one(dir, kv.first, /*sparse=*/true))
             return err(resp, "load failed");
+        for (auto& kv : graph_) {
+          FILE* f = fopen((dir + "/graph_" +
+                           std::to_string(kv.first) + ".bin").c_str(), "rb");
+          if (!f) return err(resp, "load failed");
+          bool ok = kv.second->load(f);
+          fclose(f);
+          if (!ok) return err(resp, "load failed");
+        }
         resp->u8(ST_OK);
         return true;
       }
@@ -929,6 +1144,14 @@ class Server {
     return it == sparse_.end() ? nullptr : it->second.get();
   }
 
+  GraphTable* graph(int32_t tid) {
+    std::lock_guard<std::mutex> g(tables_mu_);
+    auto it = graph_.find(tid);
+    if (it == graph_.end())
+      it = graph_.emplace(tid, std::make_unique<GraphTable>()).first;
+    return it->second.get();
+  }
+
   std::string table_path(const std::string& dir, int32_t tid, bool sp) const {
     return dir + "/" + (sp ? "sparse_" : "dense_") + std::to_string(tid) + ".bin";
   }
@@ -960,6 +1183,7 @@ class Server {
   std::mutex tables_mu_;
   std::map<int32_t, std::unique_ptr<DenseTable>> dense_;
   std::map<int32_t, std::unique_ptr<SparseTable>> sparse_;
+  std::map<int32_t, std::unique_ptr<GraphTable>> graph_;
 
   std::mutex barrier_mu_;
   std::map<std::string, Barrier> barriers_;
@@ -1250,6 +1474,66 @@ int64_t ps_shrink(int h, int table_id, float threshold, int max_unseen_days) {
   if (c->request(w, &body) != ps::ST_OK) return -1;
   ps::Reader r(body.data(), body.size());
   return r.i64();
+}
+
+int ps_graph_add_edges(int h, int table_id, const uint64_t* src,
+                       const uint64_t* dst, const float* w, int64_t n) {
+  ps::Writer wr;
+  wr.u8(ps::CMD_GRAPH_ADD_EDGES);
+  wr.i32(table_id);
+  wr.i64(n);
+  wr.u8(w ? 1 : 0);
+  wr.bytes(src, n * 8);
+  wr.bytes(dst, n * 8);
+  if (w) wr.bytes(w, n * 4);
+  return simple_req(h, wr);
+}
+
+// out must hold n*k u64; counts must hold n i32. Returns total sampled or -1.
+int64_t ps_graph_sample(int h, int table_id, const uint64_t* nodes,
+                        int64_t n, int k, uint64_t seed, int32_t* counts,
+                        uint64_t* out) {
+  ps::Client* c = client(h);
+  if (!c) return -1;
+  ps::Writer w;
+  w.u8(ps::CMD_GRAPH_SAMPLE);
+  w.i32(table_id);
+  w.i64(n);
+  w.i32(k);
+  w.u64(seed);
+  w.bytes(nodes, n * 8);
+  std::vector<char> body;
+  if (c->request(w, &body) != ps::ST_OK) return -1;
+  ps::Reader r(body.data(), body.size());
+  int64_t got_n = r.i64();
+  int64_t total = r.i64();
+  if (got_n != n || total < 0 || total > n * static_cast<int64_t>(k))
+    return -1;
+  const char* pc = r.raw(n * 4);
+  const char* po = r.raw(total * 8);
+  if (!pc || (total > 0 && !po)) return -1;
+  std::memcpy(counts, pc, n * 4);
+  if (total > 0) std::memcpy(out, po, total * 8);
+  return total;
+}
+
+int ps_graph_degree(int h, int table_id, const uint64_t* nodes, int64_t n,
+                    int64_t* out) {
+  ps::Client* c = client(h);
+  if (!c) return -1;
+  ps::Writer w;
+  w.u8(ps::CMD_GRAPH_DEGREE);
+  w.i32(table_id);
+  w.i64(n);
+  w.bytes(nodes, n * 8);
+  std::vector<char> body;
+  if (c->request(w, &body) != ps::ST_OK) return -1;
+  ps::Reader r(body.data(), body.size());
+  if (r.i64() != n) return -1;
+  const char* p = r.raw(n * 8);
+  if (!p && n > 0) return -1;
+  std::memcpy(out, p, n * 8);
+  return 0;
 }
 
 int ps_set_spill(int h, int table_id, const char* path) {

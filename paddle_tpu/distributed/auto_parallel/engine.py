@@ -191,16 +191,17 @@ class Engine:
                 return self.history
             batch = first if isinstance(first, (list, tuple)) else (first,)
             self._maybe_plan(self._as_arrays(batch))
-            if it is iter(train_data):  # same exhausted object: one-shot
+            if it is train_data:  # object is its own iterator: one-shot
                 train_data = itertools.chain([first], it)
         if batch_size is not None:
+            arrs0 = self._as_arrays(tuple(train_data))
+            self._maybe_plan(tuple(a[:batch_size] for a in arrs0))
             ndev = self.process_mesh.get_dim_size(self.data_dim)
             if batch_size % ndev:
                 raise ValueError(
                     f"batch_size {batch_size} must be divisible by the "
                     f"'{self.data_dim}' mesh dim ({ndev})")
-            arrs = self._as_arrays(tuple(train_data))
-            self._maybe_plan(tuple(a[:batch_size] for a in arrs))
+            arrs = arrs0
             n = (arrs[0].shape[0] // batch_size) * batch_size  # drop_last
             if n == 0:
                 raise ValueError(
@@ -230,7 +231,7 @@ class Engine:
                 return 0.0
             batch = first if isinstance(first, (list, tuple)) else (first,)
             self._maybe_plan(self._as_arrays(batch))
-            if it is iter(eval_data):
+            if it is eval_data:
                 eval_data = itertools.chain([first], it)
         self.prepare()
         tot, n = 0.0, 0

@@ -261,6 +261,95 @@ class PSClient:
                 show[idx], click[idx], unseen[idx] = sh, cl, un
         return show, click, unseen
 
+    # -------------------- graph tables (common_graph_table) ----------------
+
+    def graph_add_edges(self, table_id: int, src: np.ndarray,
+                        dst: np.ndarray, weights=None):
+        """Append directed edges (reference common_graph_table.cc): nodes
+        shard across servers by src id; weights default to 1."""
+        src = np.ascontiguousarray(src, np.uint64).ravel()
+        dst = np.ascontiguousarray(dst, np.uint64).ravel()
+        w = (None if weights is None
+             else np.ascontiguousarray(weights, np.float32).ravel())
+        step = _SPARSE_CHUNK_BYTES // 20  # 8+8+4 bytes per edge
+        for s, idx in self._shard_indices(src):
+            ks = src if idx is None else np.ascontiguousarray(src[idx])
+            kd = dst if idx is None else np.ascontiguousarray(dst[idx])
+            kw = (None if w is None else
+                  (w if idx is None else np.ascontiguousarray(w[idx])))
+            for i in range(0, ks.size, step):
+                cs = np.ascontiguousarray(ks[i:i + step])
+                cd = np.ascontiguousarray(kd[i:i + step])
+                cw = (None if kw is None
+                      else np.ascontiguousarray(kw[i:i + step]))
+                rc = self._lib.ps_graph_add_edges(
+                    self._handles[s], table_id, cs.ctypes.data_as(_U64P),
+                    cd.ctypes.data_as(_U64P),
+                    (cw.ctypes.data_as(_F32P) if cw is not None
+                     else ctypes.cast(None, _F32P)), cs.size)
+                if rc != 0:
+                    raise RuntimeError(
+                        f"graph_add_edges({table_id}) failed")
+
+    def graph_sample_neighbors(self, table_id: int, nodes: np.ndarray,
+                               k: int, seed: int = 0):
+        """Sample up to k neighbors per node (weight-proportional without
+        replacement; all neighbors when degree <= k). Returns (neighbors
+        [n, k] uint64 padded with 0, counts [n] int32)."""
+        nodes = np.ascontiguousarray(nodes, np.uint64).ravel()
+        n = nodes.size
+        counts = np.zeros(n, np.int32)
+        padded = np.zeros((n, max(k, 1)), np.uint64)
+        step = max(1, _SPARSE_CHUNK_BYTES // (12 + 8 * max(k, 1)))
+        for s, idx in self._shard_indices(nodes):
+            ks = nodes if idx is None else np.ascontiguousarray(nodes[idx])
+            cc = np.zeros(ks.size, np.int32)
+            rows = np.zeros((ks.size, max(k, 1)), np.uint64)
+            for i0 in range(0, ks.size, step):
+                chunk = np.ascontiguousarray(ks[i0:i0 + step])
+                c_chunk = np.zeros(chunk.size, np.int32)
+                flat = np.zeros(chunk.size * max(k, 1), np.uint64)
+                total = self._lib.ps_graph_sample(
+                    self._handles[s], table_id, chunk.ctypes.data_as(_U64P),
+                    chunk.size, int(k), int(seed),
+                    c_chunk.ctypes.data_as(_I32P),
+                    flat.ctypes.data_as(_U64P))
+                if total < 0:
+                    raise RuntimeError(f"graph_sample({table_id}) failed")
+                pos = 0
+                for i, c_ in enumerate(c_chunk):
+                    rows[i0 + i, :c_] = flat[pos:pos + c_]
+                    pos += int(c_)
+                cc[i0:i0 + chunk.size] = c_chunk
+            if idx is None:
+                counts, padded = cc, rows
+            else:
+                counts[idx] = cc
+                padded[idx] = rows
+        return padded, counts
+
+    def graph_degree(self, table_id: int, nodes: np.ndarray) -> np.ndarray:
+        nodes = np.ascontiguousarray(nodes, np.uint64).ravel()
+        out = np.zeros(nodes.size, np.int64)
+        step = _SPARSE_CHUNK_BYTES // 16
+        for s, idx in self._shard_indices(nodes):
+            ks = nodes if idx is None else np.ascontiguousarray(nodes[idx])
+            dd = np.zeros(ks.size, np.int64)
+            for i in range(0, ks.size, step):
+                chunk = np.ascontiguousarray(ks[i:i + step])
+                rc = self._lib.ps_graph_degree(
+                    self._handles[s], table_id, chunk.ctypes.data_as(_U64P),
+                    chunk.size,
+                    dd[i:i + step].ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int64)))
+                if rc != 0:
+                    raise RuntimeError(f"graph_degree({table_id}) failed")
+            if idx is None:
+                out = dd
+            else:
+                out[idx] = dd
+        return out
+
     # -------------------- disk spill (ssd_sparse_table) --------------------
 
     def set_spill(self, table_id: int, dirname: str):

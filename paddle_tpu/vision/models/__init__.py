@@ -22,6 +22,7 @@ from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201,
     densenet264)
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
 
 __all__ = [
     "ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
@@ -36,4 +37,5 @@ __all__ = [
     "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
     "densenet264",
     "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "InceptionV3", "inception_v3",
 ]

@@ -236,3 +236,20 @@ class TestPlanner:
         hist = eng.fit([(x, y)], epochs=3)
         assert eng.plan_result is not None
         assert hist["loss"][-1] < hist["loss"][0]
+
+
+    def test_engine_plan_auto_fit_batch_size_path(self):
+        """Regression: fit((x, y), batch_size=N) must plan before touching
+        the mesh (crashed with AttributeError on None process_mesh)."""
+        from paddle_tpu.distributed.auto_parallel import Engine
+        model = self._wide_mlp(d=64)
+        opt = optimizer.Adam(learning_rate=5e-3,
+                             parameters=model.parameters())
+        eng = Engine(model, loss=lambda o, y: F.cross_entropy(o, y),
+                     optimizer=opt, plan="auto")
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(32, 64)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 8, (32,)).astype(np.int32))
+        hist = eng.fit((x, y), epochs=2, batch_size=16)
+        assert eng.plan_result is not None
+        assert hist["loss"][-1] < hist["loss"][0]

@@ -468,3 +468,78 @@ class TestDiskSpill:
         c.load(ck)
         got = c.pull_sparse(42, keys)
         np.testing.assert_array_equal(got, vals)
+
+
+class TestGraphTable:
+    """Graph tables (reference ps/table/common_graph_table.cc): adjacency +
+    weighted neighbor sampling for GNN data pipelines."""
+
+    def test_add_sample_degree(self, ps_pair):
+        _, c = ps_pair
+        src = np.array([1, 1, 1, 2, 2, 3], np.uint64)
+        dst = np.array([10, 11, 12, 20, 21, 30], np.uint64)
+        c.graph_add_edges(50, src, dst)
+        np.testing.assert_array_equal(
+            c.graph_degree(50, np.array([1, 2, 3, 4], np.uint64)),
+            [3, 2, 1, 0])
+        nb, cnt = c.graph_sample_neighbors(
+            50, np.array([1, 2, 3, 4], np.uint64), k=5)
+        assert list(cnt) == [3, 2, 1, 0]
+        assert set(nb[0, :3].tolist()) == {10, 11, 12}
+        assert set(nb[1, :2].tolist()) == {20, 21}
+        assert nb[2, 0] == 30
+
+    def test_sample_k_without_replacement_deterministic(self, ps_pair):
+        _, c = ps_pair
+        src = np.full(20, 7, np.uint64)
+        dst = np.arange(100, 120, dtype=np.uint64)
+        c.graph_add_edges(51, src, dst)
+        nb1, cnt1 = c.graph_sample_neighbors(
+            51, np.array([7], np.uint64), k=8, seed=123)
+        nb2, _ = c.graph_sample_neighbors(
+            51, np.array([7], np.uint64), k=8, seed=123)
+        assert cnt1[0] == 8
+        np.testing.assert_array_equal(nb1, nb2)  # same seed, same sample
+        assert len(set(nb1[0].tolist())) == 8    # without replacement
+        assert set(nb1[0].tolist()) <= set(dst.tolist())
+        nb3, _ = c.graph_sample_neighbors(
+            51, np.array([7], np.uint64), k=8, seed=999)
+        assert not np.array_equal(nb1, nb3)  # different seed differs
+
+    def test_weighted_sampling_prefers_heavy_edges(self, ps_pair):
+        _, c = ps_pair
+        # node 9: one heavy edge (w=100) among 49 light ones (w=0.01)
+        n_nb = 50
+        src = np.full(n_nb, 9, np.uint64)
+        dst = np.arange(200, 200 + n_nb, dtype=np.uint64)
+        w = np.full(n_nb, 0.01, np.float32)
+        w[0] = 100.0
+        c.graph_add_edges(52, src, dst, w)
+        hits = 0
+        for seed in range(20):
+            nb, _ = c.graph_sample_neighbors(
+                52, np.array([9], np.uint64), k=5, seed=seed)
+            if 200 in nb[0].tolist():
+                hits += 1
+        assert hits >= 18, hits  # heavy edge nearly always sampled
+
+
+    def test_graph_checkpoint_roundtrip(self, ps_pair, tmp_path):
+        import os
+        _, c = ps_pair
+        src = np.array([1, 1, 2], np.uint64)
+        dst = np.array([10, 11, 20], np.uint64)
+        c.graph_add_edges(53, src, dst, np.array([1, 2, 3], np.float32))
+        assert c.table_size(53) == 2  # node_count via CMD_TABLE_SIZE
+        ck = str(tmp_path / "gck")
+        os.makedirs(ck, exist_ok=True)
+        c.save(ck)
+        # overwrite in-memory state, then restore
+        c.graph_add_edges(53, np.array([1], np.uint64),
+                          np.array([99], np.uint64))
+        c.load(ck)
+        nb, cnt = c.graph_sample_neighbors(
+            53, np.array([1, 2], np.uint64), k=5)
+        assert cnt.tolist() == [2, 1]
+        assert set(nb[0, :2].tolist()) == {10, 11}
+        assert 99 not in nb[0].tolist()

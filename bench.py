@@ -183,11 +183,93 @@ def bench_bert_base():
     }
 
 
+def bench_wide_deep_ps():
+    """Wide&Deep over the native parameter server (BASELINE.md row 4).
+
+    Runs in a CPU-forced subprocess: PS-mode trainers are host-CPU
+    workers in the reference too (`HogwildWorker`), and the eager PS loop
+    on the TPU tunnel would measure per-op dispatch latency, not the
+    sparse path."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import bench, json; print('WDJSON'+json.dumps(bench._wide_deep_ps_body()))")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          cwd=os.path.dirname(os.path.abspath(__file__)),
+                          capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        # a crash during teardown (e.g. a PS shutdown regression) must not
+        # masquerade as a clean run even if the metrics line was flushed
+        raise RuntimeError(f"wide&deep bench subprocess rc="
+                           f"{proc.returncode}: {proc.stderr[-800:]}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("WDJSON"):
+            return _json.loads(line[len("WDJSON"):])
+    raise RuntimeError(f"wide&deep bench subprocess printed no metrics: "
+                       f"{proc.stderr[-800:]}")
+
+
+def _wide_deep_ps_body():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.ps import PSServer, PSClient
+    from paddle_tpu.models.wide_deep import WideDeep
+
+    B, SLOTS, VOCAB = 512, 8, 1_000_000
+    server = PSServer(0)
+    client = PSClient([server.endpoint])
+    try:
+        paddle.seed(0)
+        model = WideDeep(num_slots=SLOTS, embedding_dim=16, dense_dim=13,
+                         hidden=64, client=client)
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=model.parameters())
+        crit = nn.BCEWithLogitsLoss()
+        rng = np.random.default_rng(0)
+
+        def batch():
+            ids = paddle.to_tensor(
+                rng.integers(0, VOCAB, (B, SLOTS)).astype(np.int64))
+            dense = paddle.to_tensor(
+                rng.normal(size=(B, 13)).astype(np.float32))
+            labels = paddle.to_tensor(
+                (rng.random((B, 1)) > 0.5).astype(np.float32))
+            return ids, dense, labels
+
+        data = [batch() for _ in range(8)]
+        for ids, dense, labels in data[:2]:  # warmup
+            loss = crit(model(ids, dense), labels)
+            loss.backward(); opt.step(); opt.clear_grad()
+        t0 = time.perf_counter()
+        iters = 20
+        for i in range(iters):
+            ids, dense, labels = data[i % len(data)]
+            loss = crit(model(ids, dense), labels)
+            loss.backward(); opt.step(); opt.clear_grad()
+        final = float(loss)
+        dt = time.perf_counter() - t0
+        return {
+            "name": f"wide&deep sparse-PS b{B} x {SLOTS} slots "
+                    f"(1M-feasign space, native PS, CPU trainer)",
+            "examples_per_sec": round(B * iters / dt, 1),
+            "step_time_ms": round(1000 * dt / iters, 2),
+            "final_loss": round(final, 4),
+        }
+    finally:
+        client.stop_servers()
+
+
 def main():
     gpt = bench_gpt2()
     configs = {"gpt2_small": gpt}
     for fn, key in ((bench_resnet50, "resnet50"),
-                    (bench_bert_base, "bert_base_seq128")):
+                    (bench_bert_base, "bert_base_seq128"),
+                    (bench_wide_deep_ps, "wide_deep_ps")):
         try:
             configs[key] = fn()
         except Exception as e:  # one config must not sink the whole bench

@@ -328,6 +328,28 @@ class PSClient:
                 padded[idx] = rows
         return padded, counts
 
+    def graph_khop_sample(self, table_id: int, nodes: np.ndarray,
+                          sample_sizes, seed: int = 0):
+        """Multi-hop neighbor sampling (reference graph service khop, the
+        server-side counterpart of incubate.graph_khop_sampler): hop i
+        samples `sample_sizes[i]` neighbors of the previous frontier.
+        Returns a list of (neighbors [n_i, k_i] uint64, counts [n_i] int32,
+        frontier [n_i] uint64) per hop; the next frontier is the unique set
+        of sampled neighbors."""
+        frontier = np.ascontiguousarray(nodes, np.uint64).ravel()
+        hops = []
+        for hop, k in enumerate(sample_sizes):
+            nb, cnt = self.graph_sample_neighbors(
+                table_id, frontier, int(k), seed=seed + hop)
+            hops.append((nb, cnt, frontier))
+            if cnt.sum() == 0:
+                break
+            mask = np.arange(nb.shape[1]) < cnt[:, None]
+            frontier = np.unique(nb[mask])
+            if frontier.size == 0:
+                break
+        return hops
+
     def graph_degree(self, table_id: int, nodes: np.ndarray) -> np.ndarray:
         nodes = np.ascontiguousarray(nodes, np.uint64).ravel()
         out = np.zeros(nodes.size, np.int64)

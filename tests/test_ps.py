@@ -543,3 +543,20 @@ class TestGraphTable:
         assert cnt.tolist() == [2, 1]
         assert set(nb[0, :2].tolist()) == {10, 11}
         assert 99 not in nb[0].tolist()
+
+
+    def test_graph_khop_sample(self, ps_pair):
+        """Two-hop sampling: chain graph 1->2->3->4; frontier advances."""
+        _, c = ps_pair
+        src = np.array([1, 2, 3], np.uint64)
+        dst = np.array([2, 3, 4], np.uint64)
+        c.graph_add_edges(54, src, dst)
+        hops = c.graph_khop_sample(54, np.array([1], np.uint64), [2, 2])
+        assert len(hops) == 2
+        nb0, cnt0, f0 = hops[0]
+        assert f0.tolist() == [1] and cnt0[0] == 1 and nb0[0, 0] == 2
+        nb1, cnt1, f1 = hops[1]
+        assert f1.tolist() == [2] and nb1[0, 0] == 3
+        # dead-end frontier stops early
+        hops2 = c.graph_khop_sample(54, np.array([4], np.uint64), [2, 2])
+        assert len(hops2) == 1 and hops2[0][1][0] == 0

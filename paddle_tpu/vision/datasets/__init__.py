@@ -22,7 +22,7 @@ import numpy as np
 from paddle_tpu.io.dataset import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
-           "Flowers", "VOC2012"]
+           "Flowers", "VOC2012", "DatasetFolder", "ImageFolder"]
 
 
 class FakeData(Dataset):
@@ -266,3 +266,97 @@ class VOC2012(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         return img, mask
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                   ".tiff", ".webp")
+
+
+def _load_image(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        return np.asarray(Image.open(f).convert("RGB"))
+
+
+def _scan_files(root, extensions, is_valid_file):
+    """Recursive sorted scan with the reference's filter contract: exactly
+    one of `extensions` / `is_valid_file` applies (folder.py raises when
+    both are given)."""
+    if extensions is not None and is_valid_file is not None:
+        raise ValueError(
+            "both extensions and is_valid_file were given; pass exactly one")
+    exts = tuple(e.lower() for e in (extensions or _IMG_EXTENSIONS))
+    out = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fn in sorted(files):
+            path = os.path.join(dirpath, fn)
+            ok = (is_valid_file(path) if is_valid_file is not None
+                  else fn.lower().endswith(exts))
+            if ok:
+                out.append(path)
+    if not out:
+        what = ("is_valid_file filter" if is_valid_file is not None
+                else f"extensions {exts}")
+        raise ValueError(f"found no files matching {what} under {root}")
+    return out
+
+
+class DatasetFolder(Dataset):
+    """Generic folder-per-class image dataset (reference
+    `vision/datasets/folder.py:65`): `root/class_x/*.jpg` -> (image,
+    class_index); classes sorted alphabetically."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            try:
+                paths = _scan_files(os.path.join(root, c), extensions,
+                                    is_valid_file)
+            except ValueError as e:
+                if "found no files" in str(e):
+                    continue  # empty class dir: skip, error only if ALL empty
+                raise
+            self.samples.extend((p, self.class_to_idx[c]) for p in paths)
+        if not self.samples:
+            raise ValueError(f"found no image files under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array(target, dtype=np.int64)
+
+
+class ImageFolder(Dataset):
+    """Unlabeled flat image folder (reference `folder.py:222`): yields
+    (image,) for every image file under root, recursively."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        self.samples = _scan_files(root, extensions, is_valid_file)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]

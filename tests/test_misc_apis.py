@@ -383,3 +383,26 @@ class TestZooBreadth:
             VOC2012()
         with pytest.raises((ValueError, RuntimeError)):
             Flowers(download=True)
+
+    def test_dataset_folder_and_image_folder(self, tmp_path):
+        from PIL import Image
+        from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+        for cls in ("ant", "bee"):
+            os.makedirs(tmp_path / cls)
+            for i in range(2):
+                Image.fromarray(
+                    np.full((8, 8, 3), 50 + i, np.uint8)).save(
+                    str(tmp_path / cls / f"{i}.png"))
+        ds = DatasetFolder(str(tmp_path))
+        assert ds.classes == ["ant", "bee"] and len(ds) == 4
+        img, y = ds[3]
+        assert img.shape == (8, 8, 3) and int(y) == 1
+        flat = ImageFolder(str(tmp_path))
+        assert len(flat) == 4 and flat[0][0].shape == (8, 8, 3)
+        with pytest.raises(ValueError, match="exactly one"):
+            DatasetFolder(str(tmp_path), extensions=(".png",),
+                          is_valid_file=lambda p: True)
+        empty = tmp_path / "empty"
+        os.makedirs(empty)
+        with pytest.raises(ValueError, match="no class directories"):
+            DatasetFolder(str(empty))

@@ -142,16 +142,99 @@ def add(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
     return SparseCooTensor(b)
 
 
-def relu(x: SparseCooTensor) -> SparseCooTensor:
-    b = x._b
-    return SparseCooTensor(
-        jsparse.BCOO((jax.nn.relu(b.data), b.indices), shape=b.shape))
+def subtract(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
+    yb = _as_coo(y)._b
+    neg_y = jsparse.BCOO((-yb.data, yb.indices), shape=yb.shape)
+    return SparseCooTensor((_as_coo(x)._b + neg_y).sum_duplicates())
 
 
-def multiply(x: SparseCooTensor, scalar) -> SparseCooTensor:
-    b = x._b
+def _unary(fn):
+    """Elementwise op applied to stored values (reference
+    phi/kernels/sparse/activation_kernel.cc pattern). Only zero-preserving
+    fns (f(0)=0) are sound on the implicit zeros."""
+    def op(x: SparseCooTensor) -> SparseCooTensor:
+        b = _as_coo(x)._b
+        return SparseCooTensor(
+            jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+    return op
+
+
+relu = _unary(jax.nn.relu)
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+sin = _unary(jnp.sin)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+expm1 = _unary(jnp.expm1)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)  # noqa: A001  (paddle.sparse.abs parity)
+neg = _unary(jnp.negative)
+square = _unary(jnp.square)
+
+
+def pow(x: SparseCooTensor, factor) -> SparseCooTensor:  # noqa: A001
+    b = _as_coo(x)._b
     return SparseCooTensor(
-        jsparse.BCOO((b.data * scalar, b.indices), shape=b.shape))
+        jsparse.BCOO((jnp.power(b.data, factor), b.indices), shape=b.shape))
+
+
+def cast(x: SparseCooTensor, index_dtype=None, value_dtype=None
+         ) -> SparseCooTensor:
+    b = _as_coo(x)._b
+    data = b.data if value_dtype is None else b.data.astype(value_dtype)
+    idx = b.indices if index_dtype is None else b.indices.astype(index_dtype)
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape))
+
+
+def multiply(x: SparseCooTensor, y) -> SparseCooTensor:
+    """scalar scaling, or elementwise sparse*sparse on the intersection of
+    the two patterns (implicit zeros dominate products)."""
+    b = _as_coo(x)._b
+    if isinstance(y, SparseCooTensor):
+        xb = b.sum_duplicates()
+        yb = y._b.sum_duplicates()
+        if len(xb.shape) != 2 or tuple(xb.shape) != tuple(yb.shape):
+            raise ValueError(
+                f"sparse multiply needs matching 2-D shapes, got "
+                f"{tuple(xb.shape)} vs {tuple(yb.shape)}")
+        if int(yb.nse) == 0 or int(xb.nse) == 0:
+            # product at x's coordinates is all zeros
+            return SparseCooTensor(jsparse.BCOO(
+                (jnp.zeros_like(xb.data), xb.indices), shape=xb.shape))
+        # pattern matching runs eagerly in numpy with int64 keys: BCOO
+        # indices are int32 and row*ncol+col would overflow (collide) for
+        # nrow*ncol > 2^31 adjacency-scale matrices
+        ix = np.asarray(xb.indices).astype(np.int64)
+        iy = np.asarray(yb.indices).astype(np.int64)
+        ncol = int(xb.shape[1])
+        kx = ix[:, 0] * ncol + ix[:, 1]
+        ky = iy[:, 0] * ncol + iy[:, 1]
+        order = np.argsort(ky)
+        pos = np.clip(np.searchsorted(ky[order], kx), 0, ky.size - 1)
+        hit = jnp.asarray(ky[order][pos] == kx)
+        yv = jnp.where(hit, yb.data[jnp.asarray(order[pos])], 0)
+        return SparseCooTensor(
+            jsparse.BCOO((xb.data * yv, xb.indices), shape=xb.shape))
+    return SparseCooTensor(
+        jsparse.BCOO((b.data * y, b.indices), shape=b.shape))
+
+
+def divide(x: SparseCooTensor, scalar) -> SparseCooTensor:
+    b = _as_coo(x)._b
+    return SparseCooTensor(
+        jsparse.BCOO((b.data / scalar, b.indices), shape=b.shape))
+
+
+def transpose(x: SparseCooTensor, perm=None) -> SparseCooTensor:
+    b = _as_coo(x)._b
+    nd = len(b.shape)
+    perm = list(perm) if perm is not None else list(range(nd))[::-1]
+    idx = b.indices[:, jnp.asarray(perm)]
+    shape = tuple(b.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=shape))
 
 
 def matmul(x: SparseCooTensor, y) -> Tensor:
@@ -172,6 +255,41 @@ def matmul(x: SparseCooTensor, y) -> Tensor:
                            "m": xs.shape[0]}, name="sparse_matmul")
 
 
+def masked_matmul(x, y, mask: SparseCooTensor) -> SparseCooTensor:
+    """(x @ y) evaluated ONLY at mask's coordinates (SDDMM — reference
+    sparse masked_matmul; the sparse-attention score pattern): never
+    materializes the dense [M, N] product."""
+    xd = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    yd = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+    mb = _as_coo(mask)._b
+    rows = mb.indices[:, 0]
+    cols = mb.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
+    return SparseCooTensor(
+        jsparse.BCOO((vals, mb.indices), shape=mb.shape))
+
+
+def softmax(x, axis: int = -1):
+    """Row-wise softmax over stored entries (reference sparse softmax for
+    CSR/COO) — implicit zeros are EXCLUDED from the normalization, the
+    sparse-attention semantics."""
+    if isinstance(x, SparseCsrTensor):
+        out = softmax(SparseCooTensor(x._b.to_bcoo()), axis=axis)
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(out._b))
+    b = _as_coo(x)._b.sum_duplicates()
+    if len(b.shape) != 2 or axis not in (-1, 1):
+        raise ValueError("sparse softmax supports 2-D tensors over the "
+                         f"last axis; got shape {tuple(b.shape)}, "
+                         f"axis={axis}")
+    rows = b.indices[:, 0]
+    m = b.shape[0]
+    rmax = jax.ops.segment_max(b.data, rows, num_segments=m)
+    e = jnp.exp(b.data - rmax[rows])
+    denom = jax.ops.segment_sum(e, rows, num_segments=m)
+    return SparseCooTensor(
+        jsparse.BCOO((e / denom[rows], b.indices), shape=b.shape))
+
+
 def to_sparse_coo(dense, sparse_dim: Optional[int] = None) -> SparseCooTensor:
     arr = dense.data if isinstance(dense, Tensor) else jnp.asarray(dense)
     return SparseCooTensor(jsparse.BCOO.fromdense(arr))
@@ -183,5 +301,8 @@ def to_sparse_csr(dense) -> SparseCsrTensor:
 
 
 __all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
-           "sparse_csr_tensor", "add", "relu", "multiply", "matmul",
+           "sparse_csr_tensor", "add", "subtract", "multiply", "divide",
+           "relu", "tanh", "sqrt", "sin", "asin", "atan", "sinh", "asinh",
+           "atanh", "expm1", "log1p", "abs", "neg", "square", "pow", "cast",
+           "transpose", "matmul", "masked_matmul", "softmax",
            "to_sparse_coo", "to_sparse_csr"]

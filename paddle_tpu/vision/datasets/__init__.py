@@ -278,7 +278,7 @@ def _load_image(path):
         return np.asarray(Image.open(f).convert("RGB"))
 
 
-def _scan_files(root, extensions, is_valid_file):
+def _scan_files(root, extensions, is_valid_file, allow_empty=False):
     """Recursive sorted scan with the reference's filter contract: exactly
     one of `extensions` / `is_valid_file` applies (folder.py raises when
     both are given)."""
@@ -294,7 +294,7 @@ def _scan_files(root, extensions, is_valid_file):
                   else fn.lower().endswith(exts))
             if ok:
                 out.append(path)
-    if not out:
+    if not out and not allow_empty:
         what = ("is_valid_file filter" if is_valid_file is not None
                 else f"extensions {exts}")
         raise ValueError(f"found no files matching {what} under {root}")
@@ -319,13 +319,8 @@ class DatasetFolder(Dataset):
         self.class_to_idx = {c: i for i, c in enumerate(classes)}
         self.samples = []
         for c in classes:
-            try:
-                paths = _scan_files(os.path.join(root, c), extensions,
-                                    is_valid_file)
-            except ValueError as e:
-                if "found no files" in str(e):
-                    continue  # empty class dir: skip, error only if ALL empty
-                raise
+            paths = _scan_files(os.path.join(root, c), extensions,
+                                is_valid_file, allow_empty=True)
             self.samples.extend((p, self.class_to_idx[c]) for p in paths)
         if not self.samples:
             raise ValueError(f"found no image files under {root}")

@@ -406,3 +406,85 @@ class TestZooBreadth:
         os.makedirs(empty)
         with pytest.raises(ValueError, match="no class directories"):
             DatasetFolder(str(empty))
+
+
+class TestSparseExtended:
+    """Round-2 sparse op set (reference phi/kernels/sparse/ activation +
+    elementwise + SDDMM + softmax families)."""
+
+    def _mat(self, seed=0, shape=(4, 6)):
+        rng = np.random.default_rng(seed)
+        return (rng.normal(size=shape).astype(np.float32)
+                * (rng.random(shape) > 0.5))
+
+    def test_unary_family_matches_dense(self):
+        from paddle_tpu import sparse
+        d = self._mat()
+        x = sparse.to_sparse_coo(d)
+        for name, ref in (("tanh", np.tanh), ("sin", np.sin),
+                          ("expm1", np.expm1),
+                          ("square", np.square), ("neg", np.negative)):
+            got = getattr(sparse, name)(x).to_dense().numpy()
+            np.testing.assert_allclose(got, ref(d), rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(
+            sparse.pow(x, 2).to_dense().numpy(), d ** 2, rtol=1e-6)
+
+    def test_elementwise_and_transpose(self):
+        from paddle_tpu import sparse
+        d, m = self._mat(0), self._mat(1)
+        x, z = sparse.to_sparse_coo(d), sparse.to_sparse_coo(m)
+        np.testing.assert_allclose(
+            sparse.subtract(x, z).to_dense().numpy(), d - m, rtol=1e-6)
+        np.testing.assert_allclose(
+            sparse.multiply(x, z).to_dense().numpy(), d * m,
+            rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(
+            sparse.divide(x, 4.0).to_dense().numpy(), d / 4.0, rtol=1e-6)
+        np.testing.assert_allclose(
+            sparse.transpose(x).to_dense().numpy(), d.T)
+
+    def test_masked_matmul_never_dense(self):
+        from paddle_tpu import sparse
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(4, 3)).astype(np.float32)
+        b = rng.normal(size=(3, 6)).astype(np.float32)
+        mask_d = self._mat(3)
+        mask = sparse.to_sparse_coo(mask_d)
+        out = sparse.masked_matmul(paddle.to_tensor(a),
+                                   paddle.to_tensor(b), mask)
+        ref = (a @ b) * (mask_d != 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), ref,
+                                   rtol=1e-4, atol=1e-5)
+        assert out.nnz == mask.nnz  # result keeps the mask's pattern
+
+    def test_sparse_softmax_normalizes_stored_entries(self):
+        from paddle_tpu import sparse
+        d = self._mat(4)
+        sm = sparse.softmax(sparse.to_sparse_coo(d)).to_dense().numpy()
+        for r in range(d.shape[0]):
+            nz = d[r] != 0
+            if nz.any():
+                np.testing.assert_allclose(sm[r, nz].sum(), 1.0, rtol=1e-5)
+                assert (sm[r, ~nz] == 0).all()  # implicit zeros excluded
+        # CSR round-trip path stays sparse end-to-end
+        smc = sparse.softmax(sparse.to_sparse_csr(d)).to_dense().numpy()
+        np.testing.assert_allclose(smc, sm, rtol=1e-6)
+        with pytest.raises(ValueError, match="last axis"):
+            sparse.softmax(sparse.to_sparse_coo(d), axis=0)
+
+    def test_sparse_multiply_edge_cases(self):
+        from paddle_tpu import sparse
+        d = self._mat(5)
+        x = sparse.to_sparse_coo(d)
+        z = sparse.to_sparse_coo(np.zeros_like(d))
+        assert np.allclose(sparse.multiply(x, z).to_dense().numpy(), 0)
+        assert np.allclose(sparse.multiply(z, x).to_dense().numpy(), 0)
+        # adjacency-scale coordinates: int64 key matching, no collisions
+        idx = np.array([[50000, 99998], [99999, 50000]])
+        a = sparse.sparse_coo_tensor(idx, np.array([2.0, 3.0], np.float32),
+                                     shape=(100000, 100000))
+        b = sparse.sparse_coo_tensor(idx, np.array([5.0, 7.0], np.float32),
+                                     shape=(100000, 100000))
+        got = sorted(np.asarray(
+            sparse.multiply(a, b).values().numpy()).tolist())
+        assert got == [10.0, 21.0], got

@@ -3,12 +3,22 @@
 TPU-native replacement for the reference's fused attention
 (`/root/reference/paddle/fluid/operators/fused/fused_attention_op.cu` +
 `fmha_ref.h` — which materializes the [B,H,L,L] score matrix in fwd AND
-saves softmax-out for bwd). Here:
+saves softmax-out for bwd, and handles arbitrary attention masks). Here:
 
 * forward: online-softmax Pallas kernel tiled for the MXU; residuals are
   only (q, k, v, out, logsumexp) — O(L) extra memory, never [L,L];
 * backward: two Pallas kernels (dq over q-blocks; dk/dv over k-blocks)
   that RECOMPUTE the probabilities from (q, k, lse) per tile, flash-style;
+* K/V (and Q/dO in the dkv pass) are GRID-WALKED via BlockSpecs — the
+  Pallas pipeline streams one (block, D) tile per grid step with
+  double-buffered DMA, so sequence length is bounded by HBM, not VMEM
+  (the round-2 kernel kept K/V VMEM-resident, capping Lk at 4096);
+* tail blocks are masked IN-KERNEL (rows >= Lq / cols >= Lk), so any
+  Lq/Lk >= 64 is eligible — including the BERT/ERNIE seq-128 shapes that
+  round 2 sent down the score-materializing XLA path;
+* boolean or additive masks broadcastable to [B,H,Lq,Lk] are streamed
+  block-by-block like K/V (the reference's fmha path also applies the
+  mask inside the fused kernel);
 * dispatch is gated by an eager capability probe compiled at the exact
   production shapes (a Mosaic failure inside the user's outer jit cannot
   be caught — see `layer_norm._pallas_ln_ok`), so there is NO silent
@@ -38,9 +48,11 @@ _stats = {"pallas": 0, "pallas_fwd": 0, "pallas_bwd": 0, "xla": 0}
 # real kernel logic + custom_vjp wiring is exercised without a TPU
 _INTERPRET = False
 
-_MAX_PALLAS_KV = 4096  # K/V kept VMEM-resident per (batch, head)
+_STATS_LANES = 8    # lse/delta lane padding (see _fa_fwd_kernel comment)
+_CARRY_LANES = 128  # m/l scratch lane width (f32 native lane tile)
 
-_STATS_LANES = 8  # lse/delta lane padding (see _fa_fwd_kernel comment)
+_DEF_BLOCK_Q = 256
+_DEF_BLOCK_K = 512
 
 
 def _on_tpu() -> bool:
@@ -50,8 +62,14 @@ def _on_tpu() -> bool:
         return False
 
 
-def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None):
-    """XLA-composed attention (fallback for masks / short or ragged seqs).
+def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None,
+                        dropout_p=0.0, dropout_key=None):
+    """XLA-composed attention (fallback for ragged/tiny seqs, CPU, fp16,
+    and training-time attention dropout).
+
+    `dropout_p` drops attention WEIGHTS (the post-softmax probabilities),
+    matching the reference (`nn/layer/transformer.py:412-415` applies
+    F.dropout to `weights` before the @V matmul) — NOT the output features.
 
     The [B,H,L,L] score matrix is kept in the INPUT dtype (bf16 in mixed-
     precision training) — on a bandwidth-bound chip the fp32 score array is
@@ -91,16 +109,32 @@ def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None):
     denom = jnp.sum(p.astype(jnp.float32), axis=-1, keepdims=True)
     denom = jnp.maximum(denom, 1e-30)
     probs = (p / denom.astype(acc_t)).astype(v.dtype)
+    if causal or mask is not None:
+        # a row with EVERY position masked outputs zero (matching the
+        # Pallas kernels, which zero p when s sits at the floor) instead of
+        # the uniform 1/Lk attention a naive softmax of all-floor rows
+        # yields — keeps numerics identical across dispatch paths
+        probs = jnp.where(m <= 0.99 * jnp.float32(floor), 0.0,
+                          probs).astype(v.dtype)
+    if dropout_p > 0.0:
+        assert dropout_key is not None, "dropout_p > 0 needs dropout_key"
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / jnp.asarray(1.0 - dropout_p,
+                                                    probs.dtype), 0.0)
     out = jnp.einsum("bhlm,bmhd->blhd", probs, v)
     return out.astype(q.dtype)
 
 
 # --------------------------- Pallas kernels ---------------------------------
 #
-# All kernels run over grid (B, H, seq-blocks) on [B,H,L,D]-transposed
-# inputs; K/V (and in dkv, Q/dO) are VMEM-resident per (b,h) and walked in
-# (block) chunks by a fori_loop. MXU matmuls take narrow (bf16) inputs with
-# fp32 accumulation via preferred_element_type; softmax math is fp32.
+# All kernels run over a 4-D grid (B, H, outer-blocks, inner-blocks) with the
+# INNER sequence axis as the minormost, sequentially-executed ("arbitrary")
+# dimension: fwd/dq walk (q-block, k-block), dkv walks (k-block, q-block).
+# Running softmax / gradient state is carried across inner iterations in VMEM
+# scratch accumulators; inputs stream one block per step through the Pallas
+# pipeline (double-buffered DMA — this is what lets Lk grow past VMEM).
+# MXU matmuls take narrow (bf16) inputs with fp32 accumulation via
+# preferred_element_type; softmax math is fp32.
 
 
 def _dotT(a, b):
@@ -114,173 +148,530 @@ def _dot(a, b):
                                preferred_element_type=jnp.float32)
 
 
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                   block_k, kv_len, kv_offset):
-    """One q-block vs all k-blocks, online softmax. kv_offset = Lk - Lq."""
+def _apply_mask(s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len,
+                causal, kv_offset, need_tail_q, need_tail_k):
+    """Shared score-masking: user mask block, causal triangle, tail blocks.
+
+    Returns (s, masked) where `masked` says any position may sit at the
+    _NEG floor (so callers zero p there instead of trusting exp(_NEG)).
+    """
+    masked = False
+    if mask_ref is not None:
+        mb = mask_ref[...]
+        mb = jnp.broadcast_to(mb, s.shape)
+        if mask_is_bool:
+            s = jnp.where(mb, s, _NEG)
+        else:
+            # clamp ONLY the mask term (ADVICE r1): -inf/-1e9 masks must not
+            # poison the fp32 accumulator; real scores stay exact
+            s = s + jnp.maximum(mb.astype(jnp.float32), _NEG)
+        masked = True
+    if causal:
+        s = jnp.where(rows + kv_offset >= cols, s, _NEG)
+        masked = True
+    if need_tail_q:
+        s = jnp.where(rows < q_len, s, _NEG)
+        masked = True
+    if need_tail_k:
+        s = jnp.where(cols < kv_len, s, _NEG)
+        masked = True
+    return s, masked
+
+
+def _zero_tail_rows(x, start, length):
+    """Zero block rows past `length` — OOB reads of a virtually-padded tail
+    block are undefined (NaN in the interpreter), and 0 * NaN poisons every
+    matmul the block feeds; masking s alone is not enough."""
+    rows = start + jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0)
+    return jnp.where(rows < length, x, jnp.asarray(0, x.dtype))
+
+
+def _fa_fwd_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
+                   block_k, q_len, kv_len, kv_offset, n_k):
+    """Grid (B, H, q-blocks, k-blocks); online softmax carried in scratch."""
     from jax.experimental import pallas as pl
 
-    bq, D = q_ref.shape
-    qb = q_ref[...]
-    qi = pl.program_id(2)
-    m0 = jnp.full((bq,), _NEG, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc0 = jnp.zeros((bq, D), jnp.float32)
+    if has_mask:
+        mask_ref, q_ref, k_ref, v_ref = refs[:4]
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[4:]
+    else:
+        mask_ref = None
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
 
-    def body(j, carry):
-        m, l, acc = carry
-        kb = k_ref[pl.dslice(j * block_k, block_k), :]
-        vb = v_ref[pl.dslice(j * block_k, block_k), :]
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        qb = q_ref[...]
+        kb = k_ref[...]
+        vb = v_ref[...]
+        if q_len % block_q:
+            qb = _zero_tail_rows(qb, i * block_q, q_len)
+        if kv_len % block_k:
+            kb = _zero_tail_rows(kb, j * block_k, kv_len)
+            vb = _zero_tail_rows(vb, j * block_k, kv_len)
         s = _dotT(qb, kb) * scale  # f32 [bq, bk]
-        if causal:
-            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows + kv_offset >= cols, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + _dot(p.astype(vb.dtype), vb)
-        return m_new, l_new, acc_new
+        rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s, masked = _apply_mask(
+            s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len, causal,
+            kv_offset, need_tail_q=q_len % block_q != 0,
+            need_tail_k=kv_len % block_k != 0)
+        m_prev = m_ref[...][:, :1]            # [bq, 1]
+        l_prev = l_ref[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if masked:
+            # a fully-masked row has m_new == s == _NEG -> exp(0) == 1 for
+            # every masked column; zero them explicitly
+            p = jnp.where(s > 0.5 * _NEG, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)        # [bq, 1]
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + _dot(p.astype(vb.dtype), vb)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     if causal:
-        # only blocks at or before this q-block's diagonal
-        n_k = jnp.minimum(pl.cdiv(kv_len, block_k),
-                          pl.cdiv((qi + 1) * bq + kv_offset, block_k))
+        # whole block above the diagonal contributes nothing — skip compute
+        last_row = i * block_q + kv_offset + block_q - 1
+        pl.when(last_row >= j * block_k)(_compute)
     else:
-        n_k = pl.cdiv(kv_len, block_k)
-    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
-    # row stats live in a [.., L, 8]-padded layout: Mosaic requires the last
-    # two block dims be (8k, 128k) or equal to the array dims — a 1-D
-    # (block_q,) stats block is rejected once B/H are squeezed
-    lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[:, None],
-                                    (bq, _STATS_LANES))
+        _compute()
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        m = m_ref[...][:, :1]
+        l = jnp.maximum(l_ref[...][:, :1], 1e-30)
+        o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
+        # row stats live in a [.., L, 8]-padded layout: Mosaic requires the
+        # last two block dims be (8k, 128k) or equal to the array dims — a
+        # 1-D (block_q,) stats block is rejected once B/H are squeezed
+        lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape)
 
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, *, scale, causal, block_k, kv_len, kv_offset):
+def _fa_bwd_dq_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
+                      block_k, q_len, kv_len, kv_offset, n_k):
+    """Grid (B, H, q-blocks, k-blocks); dq accumulated in scratch."""
     from jax.experimental import pallas as pl
 
-    bq, D = q_ref.shape
-    qb = q_ref[...]
-    dob = do_ref[...]
-    lse = lse_ref[...][:, 0]
-    delta = delta_ref[...][:, 0]
-    qi = pl.program_id(2)
+    if has_mask:
+        mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:7]
+        dq_ref, dqacc_ref = refs[7:]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        dq_ref, dqacc_ref = refs[6:]
+        mask_ref = None
 
-    def body(j, dq):
-        kb = k_ref[pl.dslice(j * block_k, block_k), :]
-        vb = v_ref[pl.dslice(j * block_k, block_k), :]
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dqacc_ref[...] = jnp.zeros_like(dqacc_ref)
+
+    def _compute():
+        qb = q_ref[...]
+        kb = k_ref[...]
+        vb = v_ref[...]
+        dob = do_ref[...]
+        if q_len % block_q:
+            qb = _zero_tail_rows(qb, i * block_q, q_len)
+            dob = _zero_tail_rows(dob, i * block_q, q_len)
+        if kv_len % block_k:
+            kb = _zero_tail_rows(kb, j * block_k, kv_len)
+            vb = _zero_tail_rows(vb, j * block_k, kv_len)
         s = _dotT(qb, kb) * scale
-        if causal:
-            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows + kv_offset >= cols, s, _NEG)
-        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s, masked = _apply_mask(
+            s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len, causal,
+            kv_offset, need_tail_q=q_len % block_q != 0,
+            need_tail_k=kv_len % block_k != 0)
+        lse = lse_ref[...][:, :1]
+        delta = delta_ref[...][:, :1]
+        p = jnp.exp(s - lse)                 # [bq, bk]
+        if masked:
+            p = jnp.where(s > 0.5 * _NEG, p, 0.0)
         dp = _dotT(dob, vb)
-        ds = p * (dp - delta[:, None])
-        return dq + _dot(ds.astype(kb.dtype), kb) * scale
+        ds = p * (dp - delta)
+        if q_len % block_q:
+            # tail q rows carry garbage lse/delta; 0 * nan == nan
+            ds = jnp.where(rows < q_len, ds, 0.0)
+        dqacc_ref[...] = dqacc_ref[...] + _dot(ds.astype(kb.dtype), kb) * scale
 
     if causal:
-        n_k = jnp.minimum(pl.cdiv(kv_len, block_k),
-                          pl.cdiv((qi + 1) * bq + kv_offset, block_k))
+        last_row = i * block_q + kv_offset + block_q - 1
+        pl.when(last_row >= j * block_k)(_compute)
     else:
-        n_k = pl.cdiv(kv_len, block_k)
-    dq = jax.lax.fori_loop(0, n_k,
-                           body, jnp.zeros((bq, D), jnp.float32))
-    dq_ref[...] = dq.astype(dq_ref.dtype)
+        _compute()
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        dq_ref[...] = dqacc_ref[...].astype(dq_ref.dtype)
 
 
-def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dk_ref, dv_ref, *, scale, causal, block_q, q_len,
-                       kv_offset):
+def _fa_bwd_dkv_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
+                       block_k, q_len, kv_len, kv_offset, n_q):
+    """Grid (B, H, k-blocks, q-blocks); dk/dv accumulated in scratch."""
     from jax.experimental import pallas as pl
 
-    bk, D = k_ref.shape
+    if has_mask:
+        mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:7]
+        dk_ref, dv_ref, dkacc_ref, dvacc_ref = refs[7:]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        dk_ref, dv_ref, dkacc_ref, dvacc_ref = refs[6:]
+        mask_ref = None
+
+    ki = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dkacc_ref[...] = jnp.zeros_like(dkacc_ref)
+        dvacc_ref[...] = jnp.zeros_like(dvacc_ref)
+
+    def _compute():
+        qb = q_ref[...]
+        kb = k_ref[...]
+        vb = v_ref[...]
+        dob = do_ref[...]
+        if q_len % block_q:
+            qb = _zero_tail_rows(qb, j * block_q, q_len)
+            dob = _zero_tail_rows(dob, j * block_q, q_len)
+        if kv_len % block_k:
+            kb = _zero_tail_rows(kb, ki * block_k, kv_len)
+            vb = _zero_tail_rows(vb, ki * block_k, kv_len)
+        s = _dotT(qb, kb) * scale            # [bq, bk]
+        rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s, masked = _apply_mask(
+            s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len, causal,
+            kv_offset, need_tail_q=q_len % block_q != 0,
+            need_tail_k=kv_len % block_k != 0)
+        lse = lse_ref[...][:, :1]
+        delta = delta_ref[...][:, :1]
+        p = jnp.exp(s - lse)
+        if masked or q_len % block_q:
+            # tail q rows carry garbage lse/delta: 0 * nan == nan, so the
+            # row guard must zero p/ds explicitly, not rely on s == _NEG
+            rowmask = rows < q_len
+            p = jnp.where((s > 0.5 * _NEG) & rowmask, p, 0.0)
+        dvacc_ref[...] = dvacc_ref[...] + _dot(p.astype(dob.dtype).T, dob)
+        dp = _dotT(dob, vb)
+        ds = p * (dp - delta)
+        if q_len % block_q:
+            ds = jnp.where(rows < q_len, ds, 0.0)
+        dkacc_ref[...] = dkacc_ref[...] + _dot(
+            ds.astype(qb.dtype).T, qb) * scale
+
+    if causal:
+        # q-blocks strictly above this k-block's diagonal see nothing
+        last_row = (j + 1) * block_q - 1 + kv_offset
+        pl.when(last_row >= ki * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == n_q - 1)
+    def _finalize():
+        dk_ref[...] = dkacc_ref[...].astype(dk_ref.dtype)
+        dv_ref[...] = dvacc_ref[...].astype(dv_ref.dtype)
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+# Below this (square) seq length the walk-grid launches B*H tiny programs
+# whose fixed cost dwarfs the work; a single-shot kernel batching all heads
+# of one batch element per program wins (measured: BERT s128 b32 h12 d64
+# walk-grid 56ms/step vs XLA 48ms vs small-path — see bench_bert_base).
+_SMALL_MAX_L = 512
+
+
+def _fa_small_fwd_kernel(*refs, scale, causal, has_mask, mask_is_bool,
+                         q_len, kv_len):
+    """One program = all H heads of one batch element; single-shot softmax.
+
+    Blocks are [H, L, D]; the scores tensor [H, Lq, Lk] lives in VMEM for
+    the program's lifetime — eligibility caps L so this fits.
+    """
+    if has_mask:
+        mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        mask_ref = None
+
+    qb = q_ref[...]
     kb = k_ref[...]
     vb = v_ref[...]
-    ki = pl.program_id(2)
+    # batched matmul over the head dim: [H,Lq,D] @ [H,Lk,D]^T -> [H,Lq,Lk]
+    s = jax.lax.dot_general(qb, kb, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * scale
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    s, masked = _apply_mask(
+        s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len, causal,
+        kv_len - q_len, need_tail_q=False, need_tail_k=False)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    if masked:
+        p = jnp.where(s > 0.5 * _NEG, p, 0.0)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    p = p / l
+    o_ref[...] = jax.lax.dot_general(
+        p.astype(vb.dtype), vb, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+    lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape)
 
-    def body(j, carry):
-        dk, dv = carry
-        qb = q_ref[pl.dslice(j * block_q, block_q), :]
-        dob = do_ref[pl.dslice(j * block_q, block_q), :]
-        lse = lse_ref[pl.dslice(j * block_q, block_q), :][:, 0]
-        delta = delta_ref[pl.dslice(j * block_q, block_q), :][:, 0]
-        s = _dotT(qb, kb) * scale  # [bq, bk]
-        if causal:
-            rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows + kv_offset >= cols, s, _NEG)
-        p = jnp.exp(s - lse[:, None])
-        dv_new = dv + _dot(p.astype(dob.dtype).T, dob)
-        dp = _dotT(dob, vb)
-        ds = p * (dp - delta[:, None])
-        dk_new = dk + _dot(ds.astype(qb.dtype).T, qb) * scale
-        return dk_new, dv_new
 
-    if causal:
-        # first q-block whose rows can see this k-block: row >= col - offset
-        j0 = jnp.maximum(ki * bk - kv_offset, 0) // block_q
+def _fa_small_bwd_kernel(*refs, scale, causal, has_mask, mask_is_bool,
+                         q_len, kv_len):
+    """Single-shot dq/dk/dv for one batch element (all heads)."""
+    if has_mask:
+        (mask_ref, q_ref, k_ref, v_ref, do_ref, out_ref, lse_ref,
+         dq_ref, dk_ref, dv_ref) = refs
     else:
-        j0 = 0
-    n_q = pl.cdiv(q_len, block_q)
-    dk, dv = jax.lax.fori_loop(
-        j0, n_q, body, (jnp.zeros((bk, D), jnp.float32),
-                        jnp.zeros((bk, D), jnp.float32)))
-    dk_ref[...] = dk.astype(dk_ref.dtype)
-    dv_ref[...] = dv.astype(dv_ref.dtype)
+        (q_ref, k_ref, v_ref, do_ref, out_ref, lse_ref,
+         dq_ref, dk_ref, dv_ref) = refs
+        mask_ref = None
+
+    qb = q_ref[...]
+    kb = k_ref[...]
+    vb = v_ref[...]
+    dob = do_ref[...]
+    s = jax.lax.dot_general(qb, kb, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * scale
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    s, masked = _apply_mask(
+        s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len, causal,
+        kv_len - q_len, need_tail_q=False, need_tail_k=False)
+    lse = lse_ref[...][..., :1]              # [H, Lq, 1]
+    p = jnp.exp(s - lse)
+    if masked:
+        p = jnp.where(s > 0.5 * _NEG, p, 0.0)
+    # delta = rowsum(do * out)  [H, Lq, 1]
+    delta = jnp.sum(dob.astype(jnp.float32) * out_ref[...].astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    # dv = p^T do : [H,Lk,Lq] @ [H,Lq,D]
+    dv_ref[...] = jax.lax.dot_general(
+        p.astype(dob.dtype), dob, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(dob, vb, (((2,), (2,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dq_ref[...] = (jax.lax.dot_general(
+        ds.astype(kb.dtype), kb, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale).astype(dq_ref.dtype)
+    dk_ref[...] = (jax.lax.dot_general(
+        ds.astype(qb.dtype), qb, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale).astype(dk_ref.dtype)
+
+
+def _small_mask_spec(mask):
+    """BlockSpec for the small path: grid is (B,), block covers all heads."""
+    from jax.experimental import pallas as pl
+
+    bdims = (None, mask.shape[1], mask.shape[2], mask.shape[3])
+    b_b = mask.shape[0] != 1
+
+    def index(b):
+        return (b if b_b else 0, 0, 0, 0)
+
+    return pl.BlockSpec(bdims, index)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "causal", "scale", "block_q", "block_k", "interpret"))
-def _fa_fwd_pallas(q, k, v, causal, scale, block_q=256, block_k=256,
-                   interpret=False):
-    """Returns (out [B,L,H,D], lse [B,H,Lq] f32)."""
+    "causal", "scale", "mask_is_bool", "interpret"))
+def _fa_small_fwd_pallas(q, k, v, mask, causal, scale, mask_is_bool=False,
+                         interpret=False):
     from jax.experimental import pallas as pl
 
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    block_q = min(block_q, Lq)
-    block_k = min(block_k, Lk)
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
-    grid = (B, H, pl.cdiv(Lq, block_q))
-    kernel = functools.partial(_fa_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k, kv_len=Lk,
-                               kv_offset=Lk - Lq)
+    kw = dict(scale=scale, causal=causal, has_mask=mask is not None,
+              mask_is_bool=mask_is_bool, q_len=Lq, kv_len=Lk)
+    qspec = pl.BlockSpec((None, H, Lq, D), lambda b: (b, 0, 0, 0))
+    kspec = pl.BlockSpec((None, H, Lk, D), lambda b: (b, 0, 0, 0))
+    in_specs = [qspec, kspec, kspec]
+    args = [qt, kt, vt]
+    if mask is not None:
+        in_specs.insert(0, _small_mask_spec(mask))
+        args.insert(0, mask)
+    out, lse = pl.pallas_call(
+        functools.partial(_fa_small_fwd_kernel, **kw),
+        grid=(B,),
+        in_specs=in_specs,
+        out_specs=[qspec,
+                   pl.BlockSpec((None, H, Lq, _STATS_LANES),
+                                lambda b: (b, 0, 0, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+                   jax.ShapeDtypeStruct((B, H, Lq, _STATS_LANES),
+                                        jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    return jnp.swapaxes(out, 1, 2), lse[..., 0]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "mask_is_bool", "interpret"))
+def _fa_small_bwd_pallas(q, k, v, out, lse, do, mask, causal, scale,
+                         mask_is_bool=False, interpret=False):
+    from jax.experimental import pallas as pl
+
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    qt, kt, vt, dot_, ot = (jnp.swapaxes(x, 1, 2)
+                            for x in (q, k, v, do, out))
+    lse_p = jnp.broadcast_to(lse[..., None], (B, H, Lq, _STATS_LANES))
+    kw = dict(scale=scale, causal=causal, has_mask=mask is not None,
+              mask_is_bool=mask_is_bool, q_len=Lq, kv_len=Lk)
+    qspec = pl.BlockSpec((None, H, Lq, D), lambda b: (b, 0, 0, 0))
+    kspec = pl.BlockSpec((None, H, Lk, D), lambda b: (b, 0, 0, 0))
+    lspec = pl.BlockSpec((None, H, Lq, _STATS_LANES), lambda b: (b, 0, 0, 0))
+    in_specs = [qspec, kspec, kspec, qspec, qspec, lspec]
+    args = [qt, kt, vt, dot_, ot, lse_p]
+    if mask is not None:
+        in_specs.insert(0, _small_mask_spec(mask))
+        args.insert(0, mask)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_fa_small_bwd_kernel, **kw),
+        grid=(B,),
+        in_specs=in_specs,
+        out_specs=[qspec, kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+                   jax.ShapeDtypeStruct((B, H, Lk, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Lk, D), v.dtype)],
+        interpret=interpret,
+    )(*args)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
+
+
+def _use_small_path(Lq: int, Lk: int, H: int, D: int) -> bool:
+    if Lq != Lk or Lq > _SMALL_MAX_L:
+        return False
+    # [H,L,L] f32 scores + q/k/v/o blocks must sit comfortably in VMEM
+    vmem = H * Lq * Lk * 4 + 4 * H * Lq * D * 4
+    return vmem <= 24 * 1024 * 1024
+
+
+def _pick_blocks(Lq: int, Lk: int):
+    # blocks are multiples of 64 (covers f32/bf16 sublane granularity); a
+    # block larger than the array is one virtually-padded block whose tail
+    # the kernels mask in-register
+    return (min(_DEF_BLOCK_Q, _ceil_to(Lq, 64)),
+            min(_DEF_BLOCK_K, _ceil_to(Lk, 64)))
+
+
+def _mask_spec(mask, block_q, block_k, *, q_axis, k_axis):
+    """BlockSpec streaming a [b?,h?,Lq?,Lk?]-broadcastable mask block.
+
+    Size-1 mask dims map to block index 0 with block size 1 (the kernel
+    broadcasts in-VMEM), so a [B,1,1,Lk] padding mask streams Lk bytes per
+    row, never a materialized [B,H,Lq,Lk].
+    `q_axis`/`k_axis` give the grid positions of the q/k block indices
+    (fwd/dq: (2, 3); dkv: (3, 2)).
+    """
+    from jax.experimental import pallas as pl
+
+    bdims = (None, None,
+             block_q if mask.shape[2] != 1 else 1,
+             block_k if mask.shape[3] != 1 else 1)
+    b_b = mask.shape[0] != 1
+    h_b = mask.shape[1] != 1
+    q_b = mask.shape[2] != 1
+    k_b = mask.shape[3] != 1
+
+    def index(b, h, x, y):
+        gi = (b, h, x, y)
+        return (b if b_b else 0, h if h_b else 0,
+                gi[q_axis] if q_b else 0, gi[k_axis] if k_b else 0)
+
+    return pl.BlockSpec(bdims, index)
+
+
+def _compiler_params(interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret:
+        return None
+    P = pltpu.GridDimensionSemantics.PARALLEL
+    A = pltpu.GridDimensionSemantics.ARBITRARY
+    return pltpu.CompilerParams(dimension_semantics=(P, P, P, A))
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "mask_is_bool", "interpret"))
+def _fa_fwd_pallas(q, k, v, mask, causal, scale, mask_is_bool=False,
+                   interpret=False):
+    """Returns (out [B,L,H,D], lse [B,H,Lq] f32). mask may be None."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    block_q, block_k = _pick_blocks(Lq, Lk)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    n_q, n_k = pl.cdiv(Lq, block_q), pl.cdiv(Lk, block_k)
+    grid = (B, H, n_q, n_k)
+    kernel = functools.partial(
+        _fa_fwd_kernel, scale=scale, causal=causal, has_mask=mask is not None,
+        mask_is_bool=mask_is_bool, block_q=block_q, block_k=block_k,
+        q_len=Lq, kv_len=Lk, kv_offset=Lk - Lq, n_k=n_k)
+    in_specs = [
+        pl.BlockSpec((None, None, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((None, None, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((None, None, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+    ]
+    args = [qt, kt, vt]
+    if mask is not None:
+        in_specs.insert(0, _mask_spec(mask, block_q, block_k,
+                                      q_axis=2, k_axis=3))
+        args.insert(0, mask)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((None, None, Lk, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((None, None, Lk, D), lambda b, h, i: (b, h, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((None, None, block_q, D),
+                         lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((None, None, block_q, _STATS_LANES),
-                         lambda b, h, i: (b, h, i, 0)),
+                         lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
             jax.ShapeDtypeStruct((B, H, Lq, _STATS_LANES), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _CARRY_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _CARRY_LANES), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*args)
     return jnp.swapaxes(out, 1, 2), lse[..., 0]
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "causal", "scale", "block_q", "block_k", "interpret"))
-def _fa_bwd_pallas(q, k, v, out, lse, do, causal, scale,
-                   block_q=256, block_k=256, interpret=False):
+    "causal", "scale", "mask_is_bool", "interpret"))
+def _fa_bwd_pallas(q, k, v, out, lse, do, mask, causal, scale,
+                   mask_is_bool=False, interpret=False):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    block_q = min(block_q, Lq)
-    block_k = min(block_k, Lk)
+    block_q, block_k = _pick_blocks(Lq, Lk)
     qt, kt, vt, dot_, ot = (jnp.swapaxes(x, 1, 2)
                             for x in (q, k, v, do, out))
     # delta = rowsum(dout * out), fp32 [B,H,Lq] — one fused XLA pass
@@ -289,58 +680,114 @@ def _fa_bwd_pallas(q, k, v, out, lse, do, causal, scale,
     lse_p = jnp.broadcast_to(lse[..., None], (B, H, Lq, _STATS_LANES))
     delta_p = jnp.broadcast_to(delta[..., None], (B, H, Lq, _STATS_LANES))
 
-    qspec = pl.BlockSpec((None, None, block_q, D), lambda b, h, i: (b, h, i, 0))
-    qfull = pl.BlockSpec((None, None, Lq, D), lambda b, h, i: (b, h, 0, 0))
-    kspec = pl.BlockSpec((None, None, block_k, D), lambda b, h, i: (b, h, i, 0))
-    kfull = pl.BlockSpec((None, None, Lk, D), lambda b, h, i: (b, h, 0, 0))
-    rowb = pl.BlockSpec((None, None, block_q, _STATS_LANES),
-                        lambda b, h, i: (b, h, i, 0))
-    rowf = pl.BlockSpec((None, None, Lq, _STATS_LANES),
-                        lambda b, h, i: (b, h, 0, 0))
+    n_q, n_k = pl.cdiv(Lq, block_q), pl.cdiv(Lk, block_k)
+    common = dict(scale=scale, causal=causal, has_mask=mask is not None,
+                  mask_is_bool=mask_is_bool, block_q=block_q, block_k=block_k,
+                  q_len=Lq, kv_len=Lk, kv_offset=Lk - Lq)
 
+    # ---- dq: walk k-blocks per q-block ----
+    qspec = pl.BlockSpec((None, None, block_q, D),
+                         lambda b, h, i, j: (b, h, i, 0))
+    kwalk = pl.BlockSpec((None, None, block_k, D),
+                         lambda b, h, i, j: (b, h, j, 0))
+    rowq = pl.BlockSpec((None, None, block_q, _STATS_LANES),
+                        lambda b, h, i, j: (b, h, i, 0))
+    in_specs = [qspec, kwalk, kwalk, qspec, rowq, rowq]
+    args = [qt, kt, vt, dot_, lse_p, delta_p]
+    if mask is not None:
+        in_specs.insert(0, _mask_spec(mask, block_q, block_k,
+                                      q_axis=2, k_axis=3))
+        args.insert(0, mask)
     dq = pl.pallas_call(
-        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, kv_len=Lk, kv_offset=Lk - Lq),
-        grid=(B, H, pl.cdiv(Lq, block_q)),
-        in_specs=[qspec, kfull, kfull, qspec, rowb, rowb],
+        functools.partial(_fa_bwd_dq_kernel, n_k=n_k, **common),
+        grid=(B, H, n_q, n_k),
+        in_specs=in_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(qt, kt, vt, dot_, lse_p, delta_p)
+    )(*args)
 
+    # ---- dk/dv: walk q-blocks per k-block ----
+    qwalk = pl.BlockSpec((None, None, block_q, D),
+                         lambda b, h, i, j: (b, h, j, 0))
+    kspec = pl.BlockSpec((None, None, block_k, D),
+                         lambda b, h, i, j: (b, h, i, 0))
+    rowqw = pl.BlockSpec((None, None, block_q, _STATS_LANES),
+                         lambda b, h, i, j: (b, h, j, 0))
+    in_specs = [qwalk, kspec, kspec, qwalk, rowqw, rowqw]
+    args = [qt, kt, vt, dot_, lse_p, delta_p]
+    if mask is not None:
+        in_specs.insert(0, _mask_spec(mask, block_q, block_k,
+                                      q_axis=3, k_axis=2))
+        args.insert(0, mask)
     dk, dv = pl.pallas_call(
-        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, q_len=Lq, kv_offset=Lk - Lq),
-        grid=(B, H, pl.cdiv(Lk, block_k)),
-        in_specs=[qfull, kspec, kspec, qfull, rowf, rowf],
+        functools.partial(_fa_bwd_dkv_kernel, n_q=n_q, **common),
+        grid=(B, H, n_k, n_q),
+        in_specs=in_specs,
         out_specs=[kspec, kspec],
         out_shape=[jax.ShapeDtypeStruct((B, H, Lk, D), k.dtype),
                    jax.ShapeDtypeStruct((B, H, Lk, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(qt, kt, vt, dot_, lse_p, delta_p)
+    )(*args)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
             jnp.swapaxes(dv, 1, 2))
 
 
 # --------------------------- custom-vjp op ----------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_fused(q, k, v, causal, scale, interpret):
-    out, _ = _fa_fwd_pallas(q, k, v, causal, scale, interpret=interpret)
+
+def _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret):
+    B, Lq, H, D = q.shape
+    f = (_fa_small_fwd_pallas if _use_small_path(Lq, k.shape[1], H, D)
+         else _fa_fwd_pallas)
+    return f(q, k, v, mask, causal, scale, mask_is_bool=mask_is_bool,
+             interpret=interpret)
+
+
+def _bwd_any(q, k, v, out, lse, do, mask, causal, scale, mask_is_bool,
+             interpret):
+    B, Lq, H, D = q.shape
+    f = (_fa_small_bwd_pallas if _use_small_path(Lq, k.shape[1], H, D)
+         else _fa_bwd_pallas)
+    return f(q, k, v, out, lse, do, mask, causal, scale,
+             mask_is_bool=mask_is_bool, interpret=interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_fused(q, k, v, mask, causal, scale, mask_is_bool, interpret):
+    out, _ = _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret)
     return out
 
 
-def _flash_fused_fwd(q, k, v, causal, scale, interpret):
+def _flash_fused_fwd(q, k, v, mask, causal, scale, mask_is_bool, interpret):
     _stats["pallas_fwd"] += 1
-    out, lse = _fa_fwd_pallas(q, k, v, causal, scale, interpret=interpret)
-    return out, (q, k, v, out, lse)
+    out, lse = _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret)
+    return out, (q, k, v, mask, out, lse)
 
 
-def _flash_fused_bwd(causal, scale, interpret, res, do):
+def _flash_fused_bwd(causal, scale, mask_is_bool, interpret, res, do):
     _stats["pallas_bwd"] += 1
-    q, k, v, out, lse = res
-    return _fa_bwd_pallas(q, k, v, out, lse, do, causal, scale,
-                          interpret=interpret)
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = _bwd_any(q, k, v, out, lse, do, mask, causal, scale,
+                          mask_is_bool, interpret)
+    # Only bool masks ride the fused path (dispatch keeps float masks —
+    # potentially LEARNED biases — on the XLA path where their gradient is
+    # real); their tangent type is float0. The assert keeps that invariant
+    # self-enforcing if eligibility is ever widened.
+    if mask is None:
+        dmask = None
+    else:
+        assert not jnp.issubdtype(mask.dtype, jnp.floating), (
+            "float attn_mask must not reach the fused vjp: its cotangent "
+            "would be silently zero (learned-bias freeze); route float "
+            "masks to flash_attention_xla")
+        dmask = np.zeros(mask.shape, jax.dtypes.float0)
+    return dq, dk, dv, dmask
 
 
 _flash_fused.defvjp(_flash_fused_fwd, _flash_fused_bwd)
@@ -351,27 +798,48 @@ _flash_fused.defvjp(_flash_fused_fwd, _flash_fused_bwd)
 _pallas_fa_status = {}
 
 
-def _pallas_fa_ok(dtype, Lq: int, Lk: int, D: int, causal: bool) -> bool:
-    """Eager fwd+bwd compile probe at the exact production (L, D) shapes.
+def _mask_key(mask):
+    if mask is None:
+        return None
+    return (jnp.dtype(mask.dtype).name,) + tuple(
+        int(d != 1) for d in mask.shape)
+
+
+def _pallas_fa_ok(dtype, Lq, Lk, H, D, causal, mask=None) -> bool:
+    """Eager fwd+bwd compile probe at the exact production (L, H, D) shapes.
 
     Mosaic failures inside a traced user program fire at outer-jit compile
     time where try/except can't catch; capability is therefore established
     eagerly — including for the BACKWARD kernels, so the custom_vjp path is
-    known-good under value_and_grad before we ever commit to it.
+    known-good under value_and_grad before we ever commit to it. H is part
+    of the probe: kernel SELECTION (`_use_small_path`) and the small path's
+    per-program VMEM footprint both depend on it, so probing a fixed tiny H
+    could validate a kernel production never runs.
     """
-    key = (jnp.dtype(dtype).name, Lq, Lk, D, bool(causal), _INTERPRET)
+    key = (jnp.dtype(dtype).name, Lq, Lk, H, D, bool(causal),
+           _mask_key(mask), _INTERPRET)
     if key not in _pallas_fa_status:
         if not (_on_tpu() or _INTERPRET):
             _pallas_fa_status[key] = False
         else:
             try:
                 sc = float(1.0 / np.sqrt(D))
-                q = jnp.ones((2, Lq, 2, D), dtype)
-                k = jnp.ones((2, Lk, 2, D), dtype)
+                q = jnp.ones((2, Lq, H, D), dtype)
+                k = jnp.ones((2, Lk, H, D), dtype)
+                pm = None
+                is_bool = False
+                if mask is not None:
+                    shp = tuple(1 if d == 1 else {0: 2, 1: H, 2: Lq,
+                                                  3: Lk}[ax]
+                                for ax, d in enumerate(mask.shape))
+                    is_bool = mask.dtype == jnp.bool_
+                    pm = (jnp.ones(shp, jnp.bool_) if is_bool
+                          else jnp.zeros(shp, mask.dtype))
 
                 def f(q, k, v):
-                    return _flash_fused(q, k, v, bool(causal), sc,
-                                        _INTERPRET).astype(jnp.float32).sum()
+                    return _flash_fused(
+                        q, k, v, pm, bool(causal), sc, is_bool,
+                        _INTERPRET).astype(jnp.float32).sum()
 
                 grads = jax.grad(f, argnums=(0, 1, 2))(q, k, k)
                 jax.block_until_ready(grads)
@@ -382,31 +850,57 @@ def _pallas_fa_ok(dtype, Lq: int, Lk: int, D: int, causal: bool) -> bool:
 
 
 def _pallas_eligible(q, k, v, mask, causal) -> bool:
-    if mask is not None:
-        return False
     if not (_on_tpu() or _INTERPRET):
         return False
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     if not (isinstance(Lq, int) and isinstance(Lk, int)):
         return False
-    # seq lens must be multiples of the 256 tile: the kernels walk K/V (and
-    # Q in the dkv pass) with a fori_loop whose clamped dynamic slices would
-    # silently double-count a tail block (e.g. L=640)
-    if Lq < 512 or Lk < 512 or Lq % 256 or Lk % 256 or Lk > _MAX_PALLAS_KV:
+    # tail blocks are masked in-kernel, so any length >= 64 works; below
+    # that the [L,L] score tile is trivially small and XLA wins anyway
+    if Lq < 64 or Lk < 64:
         return False
     if not (q.dtype == k.dtype == v.dtype):
         return False
-    return _pallas_fa_ok(q.dtype, Lq, Lk, D, causal)
+    if q.dtype == jnp.dtype(jnp.float16):
+        return False  # fp16 softmax floor handling lives on the XLA path
+    if mask is not None:
+        if mask.ndim != 4:
+            return False
+        # FLOAT masks stay on the XLA path: the fused custom_vjp returns a
+        # zero mask cotangent, which would silently freeze a LEARNED
+        # additive bias (ALiBi / relative-position) — bool masks cannot be
+        # differentiated, so only they ride the kernel
+        if mask.dtype != jnp.bool_:
+            return False
+        for ax, full in enumerate((B, H, Lq, Lk)):
+            if mask.shape[ax] not in (1, full):
+                return False
+    return _pallas_fa_ok(q.dtype, Lq, Lk, H, D, causal, mask)
 
 
-def flash_attention(q, k, v, mask=None, causal=False, scale=None):
-    """Dispatch: fused Pallas fwd+bwd on TPU for long sequences without an
-    arbitrary mask (causal handled in-kernel); XLA composition otherwise."""
+def flash_attention(q, k, v, mask=None, causal=False, scale=None,
+                    dropout_p=0.0, dropout_key=None):
+    """Dispatch: fused Pallas fwd+bwd on TPU (masks + causal + any seq len
+    >= 64, streamed K/V so Lk is HBM-bounded); XLA composition otherwise.
+
+    `dropout_p > 0` (training-time attention dropout) ALWAYS takes the XLA
+    path: the fused kernels do not thread a dropout seed, and weight-level
+    dropout semantics (reference `nn/layer/transformer.py:412-415`) require
+    dropping post-softmax probabilities, which the online-softmax kernels
+    never materialize normalized. This is a documented, loud fallback —
+    benches and inference run dropout_p == 0 and stay fused."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    if dropout_p > 0.0:
+        _stats["xla"] += 1
+        return flash_attention_xla(q, k, v, mask=mask, causal=causal,
+                                   scale=scale, dropout_p=dropout_p,
+                                   dropout_key=dropout_key)
     if _pallas_eligible(q, k, v, mask, causal):
         _stats["pallas"] += 1
-        return _flash_fused(q, k, v, bool(causal), float(scale), _INTERPRET)
+        is_bool = mask is not None and mask.dtype == jnp.bool_
+        return _flash_fused(q, k, v, mask, bool(causal), float(scale),
+                            is_bool, _INTERPRET)
     _stats["xla"] += 1
     return flash_attention_xla(q, k, v, mask=mask, causal=causal, scale=scale)

@@ -48,26 +48,13 @@ def _fused_mha_impl(x, qkv_w, qkv_b, out_w, out_b, ln_g, ln_b, rng,
     k = k.reshape(B, L, H, D)
     v = v.reshape(B, L, H, D)
     rng_attn, rng_out = jax.random.split(rng)
-    if training and attn_dropout > 0.0:
-        # attention-probability dropout needs the materialized probs, so this
-        # path composes attention inline (XLA fuses it); inference and
-        # no-dropout training take the flash kernel
-        logits = jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
-                            k.astype(jnp.float32)) / jnp.sqrt(float(D))
-        if causal:
-            cm = jnp.tril(jnp.ones((L, L), dtype=bool))
-            logits = jnp.where(cm, logits, -1e30)
-        if mask:
-            m = mask[0]
-            logits = jnp.where(m, logits, -1e30) if m.dtype == jnp.bool_ \
-                else logits + m.astype(jnp.float32)
-        probs = jax.nn.softmax(logits, axis=-1)
-        keep = jax.random.bernoulli(rng_attn, 1.0 - attn_dropout, probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - attn_dropout), 0.0)
-        ctx = jnp.einsum("bhlm,bmhd->blhd", probs.astype(v.dtype), v)
-    else:
-        ctx = flash_attention(q, k, v, mask=mask[0] if mask else None,
-                              causal=causal)                  # [B,L,H,D]
+    # attention-probability dropout (weight dropout) is handled by
+    # flash_attention itself: dropout_p > 0 routes to its XLA composition,
+    # inference and no-dropout training take the fused kernel
+    p_attn = attn_dropout if training else 0.0
+    ctx = flash_attention(q, k, v, mask=mask[0] if mask else None,
+                          causal=causal, dropout_p=p_attn,
+                          dropout_key=rng_attn)               # [B,L,H,D]
     ctx = ctx.reshape(B, L, E)
     out = jnp.einsum("ble,ef->blf", ctx, out_w) + out_b
     if pre_layer_norm:

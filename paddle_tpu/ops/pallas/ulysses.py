@@ -33,9 +33,17 @@ import numpy as np
 
 def ulysses_attention_local(q, k, v, axis_name: str = "sp",
                             causal: bool = False,
-                            scale: Optional[float] = None):
+                            scale: Optional[float] = None,
+                            dropout_p: float = 0.0, dropout_key=None):
     """Per-shard entry: call INSIDE shard_map. q/k/v: `[B, L/sp, H, D]`
-    local chunks of a sequence sharded over `axis_name`."""
+    local chunks of a sequence sharded over `axis_name`.
+
+    `dropout_p` drops attention WEIGHTS in the local full-sequence
+    attention (reference semantics, `nn/layer/transformer.py:412-415`);
+    the key is folded with the shard index so each head group draws an
+    independent mask (the reference's RNGStatesTracker idea). Weight
+    dropout routes the local attention to the XLA path — see
+    `flash_attention` docstring."""
     from .flash_attention import flash_attention
 
     sp = jax.lax.axis_size(axis_name)
@@ -50,15 +58,22 @@ def ulysses_attention_local(q, k, v, axis_name: str = "sp",
         return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
                                   concat_axis=concat_axis, tiled=True)
 
+    key = None
+    if dropout_p > 0.0:
+        assert dropout_key is not None, "dropout_p > 0 needs dropout_key"
+        key = jax.random.fold_in(dropout_key,
+                                 jax.lax.axis_index(axis_name))
     # [B, L/sp, H, D] -> [B, L, H/sp, D]: scatter heads, gather sequence
     qg, kg, vg = (a2a(x, 2, 1) for x in (q, k, v))
-    out = flash_attention(qg, kg, vg, causal=causal, scale=scale)
+    out = flash_attention(qg, kg, vg, causal=causal, scale=scale,
+                          dropout_p=dropout_p, dropout_key=key)
     # [B, L, H/sp, D] -> [B, L/sp, H, D]
     return a2a(out, 1, 2)
 
 
 def ulysses_attention(q, k, v, mesh=None, axis_name: str = "sp",
-                      causal: bool = False, scale: Optional[float] = None):
+                      causal: bool = False, scale: Optional[float] = None,
+                      dropout_p: float = 0.0, dropout_key=None):
     """Global entry: q/k/v `[B, L, H, D]` with L sharded over `axis_name`.
 
     Mirrors `ring_attention`'s wrapper: manual only over the sp axis,
@@ -70,6 +85,18 @@ def ulysses_attention(q, k, v, mesh=None, axis_name: str = "sp",
         mesh = hcg.mesh
     from jax.sharding import PartitionSpec as P
     spec = P(None, axis_name, None, None)
+    if dropout_p > 0.0:
+        assert dropout_key is not None, "dropout_p > 0 needs dropout_key"
+
+        def _local(q, k, v, key):
+            return ulysses_attention_local(
+                q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+                dropout_p=dropout_p, dropout_key=key)
+
+        fn = jax.shard_map(_local, mesh=mesh,
+                           in_specs=(spec, spec, spec, P()),
+                           out_specs=spec, axis_names={axis_name})
+        return fn(q, k, v, dropout_key)
     fn = jax.shard_map(
         functools.partial(ulysses_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale),

@@ -35,7 +35,7 @@ dims), ZeRO shards optimizer slots over `sharding`.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -59,18 +59,40 @@ def _stage_dist_spec(base: P, sizes) -> P:
     return P(*parts)
 
 
+def _uniform_counts(n: int, stages: int) -> List[int]:
+    """n layers over `stages` parts, remainder to the earlier stages
+    (reference `SegmentLayers.uniform`, pp_layers.py:63)."""
+    per, rem = divmod(n, stages)
+    return [per + (1 if s < rem else 0) for s in range(stages)]
+
+
 class _BlockRun:
-    """The homogeneous scanned region: one block apply + stacked params."""
+    """The homogeneous scanned region: one block apply + stacked params.
+
+    Uneven segmentation (reference `SegmentLayers` cost/uniform splits,
+    pp_layers.py:63,282): `counts[s]` layers land on stage s; stacking pads
+    every stage to max(counts) and `active` [S, Lp] masks the pad slots out
+    of the scan — a padded slot's apply result is dropped by a select, so
+    its (copied) parameters receive zero gradient.
+    """
 
     def __init__(self, model: Layer, block_layers: Sequence[Layer],
-                 names: Sequence[str], num_stages: int):
+                 names: Sequence[str], num_stages: int,
+                 counts: Optional[Sequence[int]] = None):
         from ...jit import functionalize
-        assert len(block_layers) % num_stages == 0, (
-            f"{len(block_layers)} pipeline layers not divisible by "
-            f"{num_stages} stages")
         self.num_layers = len(block_layers)
         self.num_stages = num_stages
-        self.layers_per_stage = self.num_layers // num_stages
+        if counts is None:
+            counts = _uniform_counts(self.num_layers, num_stages)
+        counts = list(counts)
+        assert len(counts) == num_stages and sum(counts) == self.num_layers, (
+            f"stage counts {counts} do not cover {self.num_layers} layers "
+            f"over {num_stages} stages")
+        assert min(counts) >= 1, (
+            f"every pipeline stage needs at least one layer, got {counts}")
+        self.counts = counts
+        self.offsets = [sum(counts[:s]) for s in range(num_stages)]
+        self.layers_per_stage = Lp = max(counts)
         self.prefixes = list(names)  # full-model param-name prefix per layer
         b0 = block_layers[0]
         self.apply0, params0, buffers0 = functionalize(b0)
@@ -87,35 +109,47 @@ class _BlockRun:
         for lyr in block_layers:
             p = {k: v.data for k, v in lyr.named_parameters()}
             per_layer.append([p[k] for k in self.keys])
-        S, Lp = num_stages, self.layers_per_stage
+        S = num_stages
+        # slot (s, j) -> layer offsets[s]+j, padded slots reuse the stage's
+        # last layer (values are irrelevant: `active` masks them out)
+        slot_idx = [[self.offsets[s] + min(j, counts[s] - 1)
+                     for j in range(Lp)] for s in range(S)]
         self.stacked = {
-            k: jnp.stack([per_layer[i][j] for i in range(self.num_layers)]
-                         ).reshape((S, Lp) + per_layer[0][j].shape)
-            for j, k in enumerate(self.keys)}
+            k: jnp.stack([
+                jnp.stack([per_layer[slot_idx[s][j]][kj] for j in range(Lp)])
+                for s in range(S)])
+            for kj, k in enumerate(self.keys)}
+        self.active = jnp.asarray(
+            [[j < counts[s] for j in range(Lp)] for s in range(S)])
         # TP specs from layer 0's parameters, shifted past [S, Lp]
         named0 = dict(b0.named_parameters())
         self.base_specs = {k: getattr(named0.get(k), "dist_spec", None) or P()
                            for k in self.keys}
 
-    def stage_apply(self, stage_params, x, rng):
-        """Apply this stage's Lp layers sequentially (lax.scan)."""
+    def stage_apply(self, stage_params, x, rng, active):
+        """Apply this stage's layers sequentially (lax.scan); `active` [Lp]
+        masks padded slots (their apply is computed and dropped — the
+        pipeline schedule is shape-static, so every stage runs Lp ticks)."""
         def body(h, xs):
-            layer_params, r = xs
+            layer_params, r, a = xs
             out, _ = self.apply0(layer_params, {}, r, h)
-            return out, None
+            return jnp.where(a, out, h), None
         rngs = jax.random.split(rng, self.layers_per_stage)
-        out, _ = jax.lax.scan(body, x, (stage_params, rngs))
+        out, _ = jax.lax.scan(body, x, (stage_params, rngs, active))
         return out
 
     def unstack_into(self, stacked: Dict[str, jnp.ndarray],
                      named_full: Dict[str, "object"]):
-        """Write stacked [S, Lp, ...] values back into eager per-layer params."""
+        """Write stacked [S, Lp, ...] values back into eager per-layer
+        params (pad slots skipped)."""
         for k, arr in stacked.items():
-            flat = arr.reshape((self.num_layers,) + arr.shape[2:])
-            for i, pref in enumerate(self.prefixes):
-                full = f"{pref}.{k}" if pref else k
-                if full in named_full:
-                    named_full[full].data = flat[i]
+            for s in range(self.num_stages):
+                for j in range(self.counts[s]):
+                    i = self.offsets[s] + j
+                    pref = self.prefixes[i]
+                    full = f"{pref}.{k}" if pref else k
+                    if full in named_full:
+                        named_full[full].data = arr[s, j]
 
 
 def _gpt_like_parts(model: Layer):
@@ -188,7 +222,19 @@ class PipelineParallelTrainStep:
                 f"PipelineLayer was built for {model.num_stages} stages but "
                 f"the mesh pp axis has {S}; make them agree")
         pre_fn, blocks, prefixes, post_fn = _gpt_like_parts(model)
-        self.run = _BlockRun(model, blocks, prefixes, S)
+        counts = None
+        if isinstance(model, PipelineLayer):
+            # honor the model's segmentation (seg_method uniform/"layer:X")
+            # restricted to the scanned region; pre/post layers are
+            # replicated and don't consume stage slots
+            start, stop = model.scan_region()
+            bounds = model.segment()
+            counts = [max(0, min(bounds[s + 1], stop) - max(bounds[s], start))
+                      for s in range(S)]
+            assert min(counts) >= 1, (
+                f"seg_method={model.seg_method!r} gives stage block counts "
+                f"{counts}; every stage needs >= 1 scanned layer")
+        self.run = _BlockRun(model, blocks, prefixes, S, counts=counts)
 
         # ---- non-block ("edge") params: embeddings, final LN, head --------
         _, all_params, buffers = functionalize(model)
@@ -313,7 +359,8 @@ class PipelineParallelTrainStep:
                 rngs = jax.vmap(
                     lambda s: jax.random.fold_in(
                         jax.random.fold_in(r_pipe, t), s))(stage_ids)
-                out = jax.vmap(stage_apply)(params["blocks"], buf, rngs)
+                out = jax.vmap(stage_apply)(params["blocks"], buf, rngs,
+                                            run.active)
                 out = jax.lax.with_sharding_constraint(
                     out, buf_data_spec(out.ndim))
                 # drain: micro-batch m finishes when stage S-1 emits it
@@ -421,12 +468,14 @@ class _model_state:
         from ...jit import _swapped_state
         merged = dict(params_tree["edge"])
         # layer i's params from the stacked tree (used by tied weights only;
-        # cheap slices, DCE'd when unused)
-        for j, k in enumerate(run.keys):
+        # cheap slices, DCE'd when unused); slot (s, j) holds layer
+        # offsets[s]+j — pad slots are skipped
+        for k in run.keys:
             arr = params_tree["blocks"][k]
-            flatarr = arr.reshape((run.num_layers,) + arr.shape[2:])
-            for i, pref in enumerate(prefixes):
-                merged[f"{pref}.{k}"] = flatarr[i]
+            for s in range(run.num_stages):
+                for j in range(run.counts[s]):
+                    pref = prefixes[run.offsets[s] + j]
+                    merged[f"{pref}.{k}"] = arr[s, j]
         self._cm = _swapped_state(model, merged, dict(buffers))
 
     def __enter__(self):

@@ -163,8 +163,10 @@ class PipelineLayer(Layer):
         """Longest run of structurally identical consecutive layers.
 
         Returns (start, stop): layers[start:stop] all share one param-tree
-        signature, `stop-start` divisible by num_stages. Layers before the
-        run form the replicated pre-part, after it the post-part."""
+        signature. Layers before the run form the replicated pre-part,
+        after it the post-part. `stop-start` need NOT divide num_stages:
+        the compiled pipeline pads stages to max(counts) with masked slots
+        (reference supports uneven SegmentLayers splits, pp_layers.py:63)."""
         layers = list(self.run_function)
         sigs = [_param_signature(l) for l in layers]
         best = (0, 0)
@@ -176,10 +178,7 @@ class PipelineLayer(Layer):
             if j - i > best[1] - best[0]:
                 best = (i, j)
             i = max(j, i + 1)
-        start, stop = best
-        n = stop - start
-        n -= n % self._num_stages  # trailing layers join the post part
-        return start, start + n
+        return best
 
 
 class _FnLayer(Layer):

@@ -264,12 +264,70 @@ def _wide_deep_ps_body():
         client.stop_servers()
 
 
+def bench_wide_deep_ps_tpu():
+    """Wide&Deep with the heterogeneous split: native PS owns the sparse
+    tables on host, ONE compiled step runs the dense net fwd+bwd+update on
+    the chip (SURVEY §7 "host PS + TPU dense path"; reference heter_ps/).
+    Runs in the main (TPU) process — this config is the point: the dense
+    path on the accelerator, unlike bench_wide_deep_ps's all-CPU trainer."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.ps import PSServer, PSClient
+    from paddle_tpu.distributed.ps.heter import HeterPSTrainStep
+    from paddle_tpu.models.wide_deep import WideDeep
+
+    B, SLOTS, VOCAB = 512, 8, 1_000_000
+    server = PSServer(0)
+    client = PSClient([server.endpoint])
+    try:
+        paddle.seed(0)
+        model = WideDeep(num_slots=SLOTS, embedding_dim=16, dense_dim=13,
+                         hidden=64, client=client)
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=model.parameters())
+        crit = nn.BCEWithLogitsLoss()
+        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt)
+        rng = np.random.default_rng(0)
+
+        def batch():
+            ids = paddle.to_tensor(
+                rng.integers(0, VOCAB, (B, SLOTS)).astype(np.int64))
+            dense = paddle.to_tensor(
+                rng.normal(size=(B, 13)).astype(np.float32))
+            labels = paddle.to_tensor(
+                (rng.random((B, 1)) > 0.5).astype(np.float32))
+            return ids, dense, labels
+
+        data = [batch() for _ in range(8)]
+        for ids, dense, labels in data[:2]:  # warmup (compile + buckets)
+            step(ids, dense, labels)
+        t0 = time.perf_counter()
+        iters = 30
+        for i in range(iters):
+            ids, dense, labels = data[i % len(data)]
+            loss = step(ids, dense, labels)
+        final = float(loss)
+        dt = time.perf_counter() - t0
+        return {
+            "name": f"wide&deep heter-PS b{B} x {SLOTS} slots "
+                    f"(1M-feasign space, native host PS + compiled "
+                    f"on-chip dense step)",
+            "examples_per_sec": round(B * iters / dt, 1),
+            "step_time_ms": round(1000 * dt / iters, 2),
+            "final_loss": round(final, 4),
+        }
+    finally:
+        client.stop_servers()
+
+
 def main():
     gpt = bench_gpt2()
     configs = {"gpt2_small": gpt}
     for fn, key in ((bench_resnet50, "resnet50"),
                     (bench_bert_base, "bert_base_seq128"),
-                    (bench_wide_deep_ps, "wide_deep_ps")):
+                    (bench_wide_deep_ps, "wide_deep_ps"),
+                    (bench_wide_deep_ps_tpu, "wide_deep_ps_tpu")):
         try:
             configs[key] = fn()
         except Exception as e:  # one config must not sink the whole bench

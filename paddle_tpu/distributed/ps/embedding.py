@@ -62,7 +62,31 @@ class SparseEmbedding(Layer):
             self._created = True
 
     def forward(self, ids) -> Tensor:
-        """ids: int tensor [...]-shaped -> embeddings [..., dim]."""
+        """ids: int tensor [...]-shaped -> embeddings [..., dim].
+
+        Three modes: eager host pull (default); ROUTING capture and ROWS
+        feed under `HeterPSTrainStep` (heter.py), where the lookup becomes
+        `rows[inverse]` over traced arrays so the dense step compiles and
+        the gather's transpose segment-sums duplicate-key gradients."""
+        from . import heter as _heter
+
+        cap = _heter._capturing()
+        feed = _heter._feeding()
+        if cap is not None or feed is not None:
+            # under HeterPSTrainStep ids is already a tracer-backed Tensor;
+            # the eager branch below never pays this conversion
+            ids_arr = ids.data if isinstance(ids, Tensor) else jnp.asarray(ids)
+            if cap is not None:
+                cap.append(ids_arr)
+                _heter._ROUTE.plan.append((self, tuple(ids_arr.shape)))
+                return Tensor(jnp.zeros(tuple(ids_arr.shape) + (self._dim,),
+                                        jnp.float32))
+            item = feed.pop(0)
+            rows, inverse = item["rows"], item["inverse"]
+            out = jnp.take(rows, inverse, axis=0).reshape(
+                tuple(ids_arr.shape) + (self._dim,))
+            return Tensor(out)
+
         self._ensure_table()
         client = self.client
         tid = self._table_cfg.table_id

@@ -1,0 +1,178 @@
+"""Heterogeneous PS training: host sparse PS + one compiled dense step.
+
+Reference: `framework/fleet/heter_ps/`, `ps/service/heter_client.cc` — the
+accelerator runs the dense net, the CPU PS owns the sparse tables (VERDICT
+r2 missing #1; SURVEY §7 "host PS + TPU dense path"). On the CPU test mesh
+the "device" is the CPU XLA backend; the contract under test is identical:
+ONE jit step computes fwd+bwd+dense-update, sparse rows pull/push around it.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.ps import PSClient, PSServer
+from paddle_tpu.distributed.ps.heter import HeterPSTrainStep
+from paddle_tpu.models.wide_deep import WideDeep
+
+
+@pytest.fixture()
+def ps():
+    server = PSServer(0)
+    client = PSClient([server.endpoint])
+    yield client
+    client.stop_servers()
+
+
+def _data(n_batches=15, B=32, vocab=50, slots=4, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        ids = rng.integers(0, vocab, (B, slots))
+        dense = rng.normal(size=(B, slots)).astype(np.float32)
+        y = ((ids.sum(1) % 2) == 0).astype(np.float32)[:, None]
+        out.append((ids, dense, y))
+    return out
+
+
+def _model(client, slots=4):
+    paddle.seed(0)
+    return WideDeep(num_slots=slots, embedding_dim=8, dense_dim=slots,
+                    hidden=32, client=client)
+
+
+class TestHeterPSTrainStep:
+    def test_matches_eager_ps_loop(self, ps):
+        """The compiled dense step + pull/push must reproduce the eager
+        PS training loop loss-for-loss (same seeds, same data)."""
+        data = _data()
+        model = _model(ps)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        crit = nn.BCEWithLogitsLoss()
+        eager = []
+        for ids, dense, y in data:
+            loss = crit(model(paddle.to_tensor(ids.astype(np.int64)),
+                              paddle.to_tensor(dense)), paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            eager.append(float(loss))
+
+        server2 = PSServer(0)
+        client2 = PSClient([server2.endpoint])
+        try:
+            model2 = _model(client2)
+            opt2 = optimizer.Adam(learning_rate=1e-2,
+                                  parameters=model2.parameters())
+            crit2 = nn.BCEWithLogitsLoss()
+            step = HeterPSTrainStep(model2, lambda o, y: crit2(o, y), opt2)
+            got = [float(step(paddle.to_tensor(i.astype(np.int64)),
+                              paddle.to_tensor(d), paddle.to_tensor(y)))
+                   for i, d, y in data]
+        finally:
+            client2.stop_servers()
+        np.testing.assert_allclose(got, eager, atol=1e-5)
+
+    def test_dense_params_live_on_device_and_update(self, ps):
+        """Dense params are jax device arrays owned by the compiled step
+        (not host-side eager tensors), and they move when training."""
+        model = _model(ps)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        crit = nn.BCEWithLogitsLoss()
+        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt,
+                                donate=False)
+        dev = jax.devices()[0]
+        for v in step.params.values():
+            assert isinstance(v, jax.Array)
+            assert v.devices() == {dev}, (v.devices(), dev)
+        before = {k: np.asarray(v).copy() for k, v in step.params.items()}
+        for ids, dense, y in _data(5):
+            step(paddle.to_tensor(ids.astype(np.int64)),
+                 paddle.to_tensor(dense), paddle.to_tensor(y))
+        moved = sum(not np.allclose(before[k], np.asarray(v))
+                    for k, v in step.params.items())
+        assert moved == len(before), f"only {moved}/{len(before)} updated"
+
+    def test_sparse_rows_update_on_server(self, ps):
+        """push_sparse gradients actually change the PS-resident rows."""
+        model = _model(ps)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        crit = nn.BCEWithLogitsLoss()
+        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt)
+        ids = np.arange(32).reshape(8, 4)
+        dense = np.ones((8, 4), np.float32)
+        y = np.ones((8, 1), np.float32)
+        emb = model.embeddings[0]
+        keys = np.arange(8, dtype=np.uint64)  # slot-0 ids of this batch
+        step(paddle.to_tensor(ids.astype(np.int64)),
+             paddle.to_tensor(dense), paddle.to_tensor(y))
+        rows_after_1 = emb.client.pull_sparse(emb._table_cfg.table_id,
+                                              keys).copy()
+        for _ in range(3):
+            step(paddle.to_tensor(ids.astype(np.int64)),
+                 paddle.to_tensor(dense), paddle.to_tensor(y))
+        rows_after_4 = emb.client.pull_sparse(emb._table_cfg.table_id, keys)
+        assert not np.allclose(rows_after_1, rows_after_4), (
+            "sparse rows never moved — push_sparse is not reaching the PS")
+
+    def test_converges_on_learnable_task(self, ps):
+        """Label = f(embedding of id): repeated epochs over a small vocab
+        must drive the loss well below chance."""
+        rng = np.random.default_rng(3)
+        vocab = 16
+        ids_all = rng.integers(0, vocab, (256, 4))
+        dense_all = rng.normal(size=(256, 4)).astype(np.float32)
+        y_all = ((ids_all[:, 0] < vocab // 2)).astype(np.float32)[:, None]
+        model = _model(ps)
+        opt = optimizer.Adam(learning_rate=5e-2,
+                             parameters=model.parameters())
+        crit = nn.BCEWithLogitsLoss()
+        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt)
+        losses = []
+        for ep in range(12):
+            for s in range(0, 256, 64):
+                losses.append(float(step(
+                    paddle.to_tensor(ids_all[s:s + 64].astype(np.int64)),
+                    paddle.to_tensor(dense_all[s:s + 64]),
+                    paddle.to_tensor(y_all[s:s + 64]))))
+        assert losses[-1] < 0.35, (losses[0], losses[-1])
+
+    def test_duplicate_ids_grads_merge(self, ps):
+        """A batch full of ONE id must train exactly like the eager path
+        (the gather-transpose segment-sum merges duplicates)."""
+        model = _model(ps)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        crit = nn.BCEWithLogitsLoss()
+        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt)
+        ids = np.full((16, 4), 7)
+        dense = np.zeros((16, 4), np.float32)
+        y = np.ones((16, 1), np.float32)
+        l0 = float(step(paddle.to_tensor(ids.astype(np.int64)),
+                        paddle.to_tensor(dense), paddle.to_tensor(y)))
+        l1 = float(step(paddle.to_tensor(ids.astype(np.int64)),
+                        paddle.to_tensor(dense), paddle.to_tensor(y)))
+        assert l1 < l0  # one id's row received the merged gradient
+
+    def test_batch_shape_change_retraces_router(self, ps):
+        """A partial last batch (different B) must retrace cleanly, not
+        crash on stale routing state (review r3 finding)."""
+        model = _model(ps)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        crit = nn.BCEWithLogitsLoss()
+        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt)
+        rng = np.random.default_rng(5)
+        for B in (32, 20, 32, 7):
+            ids = rng.integers(0, 100, (B, 4))
+            dense = rng.normal(size=(B, 4)).astype(np.float32)
+            y = np.ones((B, 1), np.float32)
+            loss = step(paddle.to_tensor(ids.astype(np.int64)),
+                        paddle.to_tensor(dense), paddle.to_tensor(y))
+            assert np.isfinite(float(loss))

@@ -706,10 +706,20 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             # traffic (the loss is bandwidth-bound, SURVEY §7)
             safe = jnp.clip(li, 0, n_cls - 1)  # ignore_index masked below
             ax = axis if axis >= 0 else logits.ndim + axis
-            picked = jnp.squeeze(
-                jnp.take_along_axis(logits, jnp.expand_dims(safe, ax),
-                                    axis=ax), ax).astype(jnp.float32)
-            if use_softmax:
+            from ...ops.pallas import softmax_ce as _sce
+            if (use_softmax and not w and label_smoothing == 0.0
+                    and ax == logits.ndim - 1 and li.shape == logits.shape[:-1]
+                    and _sce.fused_softmax_ce_eligible(logits, li)):
+                # LM-head hot path (SURVEY §7): fused Pallas softmax+CE —
+                # bwd writes (softmax - onehot)·dnll straight in the logits
+                # dtype, no fp32 [N, V] cotangent. Out-of-range labels give
+                # nll = lse here; the shared mask below zeroes them and
+                # their cotangent, so dlogits rows vanish too.
+                nll = _sce.fused_softmax_ce(logits, li)
+            elif use_softmax:
+                picked = jnp.squeeze(
+                    jnp.take_along_axis(logits, jnp.expand_dims(safe, ax),
+                                        axis=ax), ax).astype(jnp.float32)
                 lse = jax.scipy.special.logsumexp(
                     logits.astype(jnp.float32), axis=ax)
                 nll = lse - picked
@@ -719,6 +729,9 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                     nll = (1.0 - label_smoothing) * nll \
                         + label_smoothing * (lse - mean_logit)
             else:
+                picked = jnp.squeeze(
+                    jnp.take_along_axis(logits, jnp.expand_dims(safe, ax),
+                                        axis=ax), ax).astype(jnp.float32)
                 nll = -jnp.log(jnp.maximum(picked, 1e-30))
                 if label_smoothing > 0.0:
                     mean_logp = jnp.mean(

@@ -13,6 +13,9 @@ from paddle_tpu.models.lenet import LeNet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2)
+from .mobilenetv3 import (  # noqa: F401
+    MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small,
+    mobilenet_v3_large)
 from .alexnet import AlexNet, alexnet  # noqa: F401
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .shufflenetv2 import (  # noqa: F401
@@ -30,6 +33,8 @@ __all__ = [
     "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2",
     "LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
     "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
+    "MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+    "mobilenet_v3_large",
     "AlexNet", "alexnet",
     "GoogLeNet", "googlenet",
     "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",

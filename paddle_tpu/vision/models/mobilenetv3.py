@@ -1,0 +1,172 @@
+"""MobileNetV3 Small/Large (reference
+`python/paddle/vision/models/mobilenetv3.py`). The depthwise convs map to
+`feature_group_count == channels` on the MXU; squeeze-excitation is a
+global-pool + two 1x1 convs, all XLA-fused."""
+from __future__ import annotations
+
+from paddle_tpu import nn
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _conv_bn_act(in_c, out_c, k, stride=1, groups=1, act=None):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=(k - 1) // 2,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c, epsilon=0.001, momentum=0.99)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    return nn.Sequential(*layers)
+
+
+class SqueezeExcitation(nn.Layer):
+    """SE block with hardsigmoid gating (reference mobilenetv3.py:110)."""
+
+    def __init__(self, channels, squeeze_channels):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, squeeze_channels, 1)
+        self.fc2 = nn.Conv2D(squeeze_channels, channels, 1)
+        self.relu = nn.ReLU()
+        self.hardsigmoid = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.avgpool(x)
+        s = self.relu(self.fc1(s))
+        s = self.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class InvertedResidual(nn.Layer):
+    """expand 1x1 -> depthwise kxk -> (SE) -> project 1x1, residual when
+    stride 1 and channels match (reference mobilenetv3.py:121)."""
+
+    def __init__(self, in_c, exp_c, out_c, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp_c != in_c:
+            layers.append(_conv_bn_act(in_c, exp_c, 1, act=act))
+        layers.append(_conv_bn_act(exp_c, exp_c, k, stride=stride,
+                                   groups=exp_c, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(exp_c,
+                                            _make_divisible(exp_c // 4)))
+        layers.append(_conv_bn_act(exp_c, out_c, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (in_c, kernel, expanded_c, out_c, use_se, activation, stride) per the
+# reference's InvertedResidualConfig tables (mobilenetv3.py:276,329)
+_SMALL = [
+    (16, 3, 16, 16, True, "relu", 2),
+    (16, 3, 72, 24, False, "relu", 2),
+    (24, 3, 88, 24, False, "relu", 1),
+    (24, 5, 96, 40, True, "hardswish", 2),
+    (40, 5, 240, 40, True, "hardswish", 1),
+    (40, 5, 240, 40, True, "hardswish", 1),
+    (40, 5, 120, 48, True, "hardswish", 1),
+    (48, 5, 144, 48, True, "hardswish", 1),
+    (48, 5, 288, 96, True, "hardswish", 2),
+    (96, 5, 576, 96, True, "hardswish", 1),
+    (96, 5, 576, 96, True, "hardswish", 1),
+]
+_LARGE = [
+    (16, 3, 16, 16, False, "relu", 1),
+    (16, 3, 64, 24, False, "relu", 2),
+    (24, 3, 72, 24, False, "relu", 1),
+    (24, 5, 72, 40, True, "relu", 2),
+    (40, 5, 120, 40, True, "relu", 1),
+    (40, 5, 120, 40, True, "relu", 1),
+    (40, 3, 240, 80, False, "hardswish", 2),
+    (80, 3, 200, 80, False, "hardswish", 1),
+    (80, 3, 184, 80, False, "hardswish", 1),
+    (80, 3, 184, 80, False, "hardswish", 1),
+    (80, 3, 480, 112, True, "hardswish", 1),
+    (112, 3, 672, 112, True, "hardswish", 1),
+    (112, 5, 672, 160, True, "hardswish", 2),
+    (160, 5, 960, 160, True, "hardswish", 1),
+    (160, 5, 960, 160, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    """Base model (reference mobilenetv3.py:164)."""
+
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return _make_divisible(ch * scale)
+
+        first_c = c(config[0][0])
+        self.conv = _conv_bn_act(3, first_c, 3, stride=2, act="hardswish")
+        self.blocks = nn.Sequential(*[
+            InvertedResidual(c(in_c), c(exp_c), c(out_c), k, stride,
+                             use_se, act)
+            for in_c, k, exp_c, out_c, use_se, act, stride in config])
+        last_in = c(config[-1][3])
+        self.lastconv_out_channels = last_in * 6
+        self.lastconv = _conv_bn_act(last_in, self.lastconv_out_channels, 1,
+                                     act="hardswish")
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(self.lastconv_out_channels, last_channel),
+                nn.Hardswish(),
+                nn.Dropout(p=0.2),
+                nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.conv(x)
+        x = self.blocks(x)
+        x = self.lastconv(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = paddle.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(MobileNetV3):
+    """Reference mobilenetv3.py:252."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, _make_divisible(1024 * scale), scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    """Reference mobilenetv3.py:300."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, _make_divisible(1280 * scale), scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "no pretrained weights ship with paddle_tpu"
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "no pretrained weights ship with paddle_tpu"
+    return MobileNetV3Large(scale=scale, **kwargs)

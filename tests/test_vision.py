@@ -66,6 +66,8 @@ class TestModels:
         (lambda: models.mobilenet_v1(scale=0.25, num_classes=10), 10),
         (lambda: models.mobilenet_v2(scale=0.25, num_classes=10), 10),
         (lambda: models.alexnet(num_classes=10), 10),
+        (lambda: models.mobilenet_v3_small(scale=0.5, num_classes=10), 10),
+        (lambda: models.mobilenet_v3_large(scale=0.5, num_classes=10), 10),
     ])
     def test_forward_shape(self, factory, ch):
         paddle.seed(0)

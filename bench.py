@@ -46,14 +46,20 @@ def _run_config(step, args, iters=ITERS, warmup=WARMUP):
     except Exception:
         pass
     params, buffers, opt_state = step.params, step.buffers, step.opt_state
+    # t is a traced scalar arg of the lowered executable: thread the real
+    # step counter so Adam/AdamW bias correction follows a genuine
+    # trajectory instead of freezing at t=1 (ADVICE r2)
+    t = 0
     for _ in range(warmup):
+        t += 1
         loss, params, buffers, opt_state = compiled(
-            params, buffers, opt_state, rng, lr, 1, *arrs)
+            params, buffers, opt_state, rng, lr, t, *arrs)
     float(loss)  # sync
     t0 = time.perf_counter()
     for _ in range(iters):
+        t += 1
         loss, params, buffers, opt_state = compiled(
-            params, buffers, opt_state, rng, lr, 1, *arrs)
+            params, buffers, opt_state, rng, lr, t, *arrs)
     final_loss = float(loss)  # device sync
     dt = time.perf_counter() - t0
     return dt / iters, final_loss, flops, nbytes

@@ -194,8 +194,49 @@ class SparseTable {
     if (!spill_index_.empty())
       return false;  // rows live only on disk: refusing protects them
     if (spill_f_) fclose(spill_f_);
+    spill_path_ = path;
+    spill_dead_ = 0;
     spill_f_ = fopen(path.c_str(), "wb+");
     return spill_f_ != nullptr;
+  }
+
+  // Rewrite the spill file keeping only indexed (live) records. The file
+  // is append-only and every restore leaves a dead record behind; without
+  // compaction long-running daily maintenance grows it without bound
+  // (ADVICE r2). Caller holds spill_mu_.
+  void compact_spill_locked() {
+    const size_t row = cfg_.dim * (1 + state_slots(cfg_.opt));
+    const size_t rec = 24 + row * sizeof(float);
+    std::string tmp = spill_path_ + ".compact";
+    FILE* nf = fopen(tmp.c_str(), "wb+");
+    if (!nf) return;
+    std::vector<char> buf(rec);
+    std::unordered_map<uint64_t, uint64_t> fresh;
+    fresh.reserve(spill_index_.size());
+    for (const auto& kv : spill_index_) {
+      fseek(spill_f_, static_cast<long>(kv.second), SEEK_SET);
+      if (fread(buf.data(), 1, rec, spill_f_) != rec ||
+          fwrite(buf.data(), 1, rec, nf) != rec) {
+        // ANY read/write failure aborts: the old (bloated but complete)
+        // file keeps every row; losing bloat is better than losing rows
+        fclose(nf);
+        remove(tmp.c_str());
+        return;
+      }
+      fresh[kv.first] = static_cast<uint64_t>(ftell(nf)) - rec;
+    }
+    fflush(nf);
+    if (rename(tmp.c_str(), spill_path_.c_str()) != 0) {
+      fclose(nf);
+      remove(tmp.c_str());
+      return;  // old file + index remain valid
+    }
+    // nf IS the renamed file's handle — adopting it avoids a reopen that
+    // could fail and strand a non-empty index with no backing file
+    fclose(spill_f_);
+    spill_f_ = nf;
+    spill_index_ = std::move(fresh);
+    spill_dead_ = 0;
   }
 
   int64_t spill_cold(int32_t max_unseen_days) {
@@ -234,7 +275,13 @@ class SparseTable {
       }
     }
     std::lock_guard<std::mutex> gs(spill_mu_);
-    if (spill_f_) fflush(spill_f_);
+    if (spill_f_) {
+      fflush(spill_f_);
+      // opportunistic compaction at daily-maintenance cadence: rewrite
+      // when dead records outnumber live ones (and there is real bloat)
+      if (spill_dead_ > spill_index_.size() && spill_dead_ > 1024)
+        compact_spill_locked();
+    }
     return spilled;
   }
 
@@ -260,6 +307,7 @@ class SparseTable {
         fread(e->data.data(), sizeof(float), row, spill_f_) != row)
       return false;
     spill_index_.erase(it);  // the live copy moves back to RAM
+    ++spill_dead_;           // its file record is now dead (compaction input)
     return true;
   }
 
@@ -442,6 +490,8 @@ class SparseTable {
   Shard shards_[kShards];
   mutable std::mutex spill_mu_;
   FILE* spill_f_ = nullptr;
+  std::string spill_path_;
+  size_t spill_dead_ = 0;  // dead (restored) records in the spill file
   std::unordered_map<uint64_t, uint64_t> spill_index_;  // key -> file offset
 };
 
@@ -961,7 +1011,7 @@ class Server {
         return true;
       }
       case CMD_GRAPH_ADD_EDGES: {
-        GraphTable* t = graph(tid);
+        GraphTable* t = graph_or_create(tid);
         int64_t n = r->i64();
         uint8_t has_w = r->u8();
         if (n < 0 || n > static_cast<int64_t>(ptnet::kMaxFrameLen) / 20)
@@ -981,6 +1031,7 @@ class Server {
       }
       case CMD_GRAPH_SAMPLE: {
         GraphTable* t = graph(tid);
+        if (!t) return err(resp, "no such graph table");
         int64_t n = r->i64();
         int32_t k = r->i32();
         uint64_t seed = r->u64();
@@ -1003,6 +1054,7 @@ class Server {
       }
       case CMD_GRAPH_DEGREE: {
         GraphTable* t = graph(tid);
+        if (!t) return err(resp, "no such graph table");
         int64_t n = r->i64();
         if (n < 0 || n > static_cast<int64_t>(ptnet::kMaxFrameLen) / 16)
           return err(resp, "bad node count");
@@ -1144,7 +1196,16 @@ class Server {
     return it == sparse_.end() ? nullptr : it->second.get();
   }
 
+  // Lookup only: read-side graph commands (sample/degree) must report
+  // "no such table" for a typo'd id instead of silently answering from a
+  // phantom empty table (ADVICE r2).
   GraphTable* graph(int32_t tid) {
+    std::lock_guard<std::mutex> g(tables_mu_);
+    auto it = graph_.find(tid);
+    return it == graph_.end() ? nullptr : it->second.get();
+  }
+
+  GraphTable* graph_or_create(int32_t tid) {
     std::lock_guard<std::mutex> g(tables_mu_);
     auto it = graph_.find(tid);
     if (it == graph_.end())

@@ -171,7 +171,7 @@ class GeoCommunicator:
         # table_id -> key -> (local_vec, base_vec)
         self._local: Dict[int, Dict[int, Tuple[np.ndarray, np.ndarray]]] = {}
         self._dirty: Dict[int, set] = {}
-        self._step = 0
+        self._push_counts: Dict[int, int] = {}
 
     # ---------------- sparse path (local-first) ----------------------------
     def _materialize(self, table_id: int, keys: np.ndarray) -> dict:
@@ -206,9 +206,23 @@ class GeoCommunicator:
             local, base = tbl[k]
             local -= self.lr * g
             dirty.add(k)
-        self._step += 1
-        if self._step % self.geo_push_steps == 0:
+        # per-TABLE push counters (ADVICE r2): each table is pushed once per
+        # training step, so geo_sync must fire every geo_push_steps STEPS,
+        # not every geo_push_steps/num_tables push-calls (the reference
+        # keeps per-variable send counters for the same reason). Trigger on
+        # min over seen tables: the sync lands after the LAST table of a
+        # step pushed, so no table's counter leads after the reset (a
+        # max/any trigger drifts to steps 4,7,11,... for 2 tables). A table
+        # pushed only in some steps delays the cadence accordingly.
+        self._push_counts[table_id] = self._push_counts.get(table_id, 0) + 1
+        counts = self._push_counts.values()
+        # min-trigger keeps the sync on step boundaries; the max escape
+        # hatch bounds staleness if some table stops being pushed (a frozen
+        # counter would otherwise starve geo_sync forever)
+        if (min(counts) >= self.geo_push_steps
+                or max(counts) >= 2 * self.geo_push_steps):
             self.geo_sync()
+            self._push_counts = {}
 
     def geo_sync(self):
         """Push accumulated deltas, re-pull merged state (one geo round)."""

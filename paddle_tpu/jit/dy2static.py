@@ -59,11 +59,54 @@ class Undefined:
     def __repr__(self):
         return "<undefined>"
 
-    def __bool__(self):
+    @staticmethod
+    def _err():
         raise NameError(
             "variable used before assignment inside a to_static-converted "
             "branch (it was undefined before the branch and only assigned "
             "in one side)")
+
+    # every plausible use of a poisoned branch-local raises the SAME named
+    # diagnostic (ADVICE r2: attribute access / indexing / arithmetic /
+    # jnp conversion previously surfaced as confusing AttributeError or
+    # TypeError mentioning Undefined internals)
+    def __bool__(self):
+        self._err()
+
+    def __getattr__(self, name):
+        # AttributeError (not NameError) keeps the hasattr / three-arg
+        # getattr probing protocols working; the message still names the
+        # real cause
+        raise AttributeError(
+            "variable used before assignment inside a to_static-converted "
+            "branch (it was undefined before the branch and only assigned "
+            f"in one side); attribute access: .{name}")
+
+    def __call__(self, *a, **k):
+        self._err()
+
+    def __iter__(self):
+        self._err()
+
+    def __len__(self):
+        self._err()
+
+    def __getitem__(self, i):
+        self._err()
+
+    def __array__(self, *a, **k):
+        self._err()
+
+    def _binop(self, other):
+        self._err()
+
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _binop
+    __truediv__ = __rtruediv__ = __matmul__ = __rmatmul__ = _binop
+    __lt__ = __le__ = __gt__ = __ge__ = __mod__ = __pow__ = _binop
+    __and__ = __or__ = __xor__ = _binop
+
+    def __neg__(self):
+        self._err()
 
 
 _UNDEF = Undefined()

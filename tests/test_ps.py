@@ -560,3 +560,55 @@ class TestGraphTable:
         # dead-end frontier stops early
         hops2 = c.graph_khop_sample(54, np.array([4], np.uint64), [2, 2])
         assert len(hops2) == 1 and hops2[0][1][0] == 0
+
+
+class TestSpillCompaction:
+    def test_spill_restore_cycles_do_not_grow_file_unboundedly(
+            self, ps_pair, tmp_path):
+        """ADVICE r2: the spill file is append-only and every restore
+        leaves a dead record; daily maintenance must compact once dead
+        records dominate, or the file grows without bound."""
+        import glob
+        import os
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=45, kind="sparse", dim=4,
+                                   optimizer="sgd", learning_rate=0.5))
+        c.set_spill(45, str(tmp_path))
+        cold = np.arange(1000, 2500, dtype=np.uint64)  # 1500 rows
+        c.pull_sparse(45, cold)
+        sizes = []
+        for cycle in range(3):
+            for _ in range(2):
+                c.shrink(45, threshold=-1.0, max_unseen_days=10**6)
+            n = c.spill_cold(45, max_unseen_days=1)
+            assert n == 1500, (cycle, n)
+            f = max(glob.glob(str(tmp_path) + "/*"), key=os.path.getsize)
+            sizes.append(os.path.getsize(f))
+            c.pull_sparse(45, cold)  # restore everything -> all dead
+        # generation size = first spill; after compaction the file must be
+        # back near ONE generation, not cycle x generations
+        assert sizes[-1] <= sizes[0] * 1.5, sizes
+
+
+class TestGeoCadence:
+    def test_geo_sync_fires_per_training_step_with_multiple_tables(
+            self, ps_pair):
+        """ADVICE r2: with N sparse tables pushed once per step, geo_sync
+        must fire every geo_push_steps STEPS (per-table counters with a
+        min-trigger), not every geo_push_steps/N push calls."""
+        from paddle_tpu.distributed.ps.communicator import GeoCommunicator
+        _, c = ps_pair
+        c.create_table(TableConfig(table_id=50, kind="sparse", dim=4))
+        c.create_table(TableConfig(table_id=51, kind="sparse", dim=4))
+        geo = GeoCommunicator(c, geo_push_steps=4)
+        synced_at = []
+        orig = geo.geo_sync
+        step_box = [0]
+        geo.geo_sync = lambda: (synced_at.append(step_box[0]), orig())[1]
+        keys = np.arange(8, dtype=np.uint64)
+        g = np.ones((8, 4), np.float32)
+        for s in range(1, 13):
+            step_box[0] = s
+            geo.push_sparse(50, keys, g)
+            geo.push_sparse(51, keys, g)
+        assert synced_at == [4, 8, 12], synced_at

@@ -25,17 +25,23 @@ from ..ops import _dispatch
 
 class SparseCooTensor:
     """Thin wrapper over BCOO keeping paddle's (indices [ndim, nnz],
-    values [nnz]) surface."""
+    values [nnz]) surface.
 
-    def __init__(self, bcoo: jsparse.BCOO):
+    `values_tensor` (when an op produced this tensor) is the TAPE-CONNECTED
+    values Tensor: returning it from `values()` keeps autograd flowing
+    through chains of sparse ops (conv -> relu -> pool -> readout); the raw
+    BCOO only ever holds detached arrays."""
+
+    def __init__(self, bcoo: jsparse.BCOO, values_tensor=None):
         self._b = bcoo
+        self._vt = values_tensor
 
     # -- paddle surface ----------------------------------------------------
     def indices(self) -> Tensor:
         return Tensor(self._b.indices.T)  # [ndim, nnz]
 
     def values(self) -> Tensor:
-        return Tensor(self._b.data)
+        return self._vt if self._vt is not None else Tensor(self._b.data)
 
     @property
     def shape(self):
@@ -50,12 +56,18 @@ class SparseCooTensor:
         return int(self._b.nse)
 
     def to_dense(self) -> Tensor:
-        return _dispatch.call(_coo_to_dense_impl, [Tensor(self._b.data)],
+        return _dispatch.call(_coo_to_dense_impl, [self.values()],
                               {"indices": np.asarray(self._b.indices),
                                "shape": tuple(self._b.shape)})
 
     def coalesce(self) -> "SparseCooTensor":
-        return SparseCooTensor(self._b.sum_duplicates())
+        inv, out_idx = _merge_plan([self._b.indices], self._b.shape)
+
+        def impl(v, *, inv=inv, n=out_idx.shape[0]):
+            return jax.ops.segment_sum(v, jnp.asarray(inv), num_segments=n)
+
+        vt = _dispatch.call(impl, [self.values()], name="sparse_coalesce")
+        return _coo_wrap(vt, out_idx, self._b.shape)
 
     def __repr__(self):
         return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
@@ -137,25 +149,74 @@ def _as_coo(x) -> SparseCooTensor:
     raise TypeError(f"expected SparseCooTensor, got {type(x)}")
 
 
+def _coo_wrap(vt, indices, shape) -> SparseCooTensor:
+    """Build a COO whose `values()` stays the tape-connected Tensor."""
+    data = vt.data if isinstance(vt, Tensor) else vt
+    return SparseCooTensor(
+        jsparse.BCOO((data, jnp.asarray(indices)), shape=tuple(shape)),
+        values_tensor=vt if isinstance(vt, Tensor) else None)
+
+
+def _unravel_keys(keys, dims):
+    out = np.zeros((keys.size, len(dims)), np.int64)
+    rem = keys
+    for ax in range(len(dims) - 1, 0, -1):
+        out[:, ax] = rem % dims[ax]
+        rem = rem // dims[ax]
+    out[:, 0] = rem
+    return out
+
+
+def _merge_plan(indices_list, shape):
+    """Host-side duplicate-merge plan for concatenated COO indices:
+    (inverse map, merged indices). The differentiable merge itself is a
+    segment_sum in the caller's dispatch impl."""
+    k = indices_list[0].shape[1]
+    dims = tuple(int(d) for d in shape[:k])
+    alli = np.concatenate([np.asarray(i, np.int64) for i in indices_list], 0)
+    key = alli[:, 0]
+    for ax in range(1, k):
+        key = key * dims[ax] + alli[:, ax]
+    uniq, inv = np.unique(key, return_inverse=True)
+    return inv.astype(np.int32), _unravel_keys(uniq, dims)
+
+
 def add(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
-    b = (_as_coo(x)._b + _as_coo(y)._b).sum_duplicates()
-    return SparseCooTensor(b)
+    """Pattern-union sum; tape-differentiable through both inputs."""
+    xs, ys = _as_coo(x), _as_coo(y)
+    xb, yb = xs._b, ys._b
+    assert tuple(xb.shape) == tuple(yb.shape), (xb.shape, yb.shape)
+    inv, out_idx = _merge_plan([xb.indices, yb.indices], xb.shape)
+
+    def impl(vx, vy, *, inv=inv, n=out_idx.shape[0]):
+        return jax.ops.segment_sum(jnp.concatenate([vx, vy], axis=0),
+                                   jnp.asarray(inv), num_segments=n)
+
+    vt = _dispatch.call(impl, [xs.values(), ys.values()], name="sparse_add")
+    return _coo_wrap(vt, out_idx, xb.shape)
 
 
 def subtract(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
-    yb = _as_coo(y)._b
-    neg_y = jsparse.BCOO((-yb.data, yb.indices), shape=yb.shape)
-    return SparseCooTensor((_as_coo(x)._b + neg_y).sum_duplicates())
+    return add(x, neg(_as_coo(y)))
 
 
 def _unary(fn):
     """Elementwise op applied to stored values (reference
     phi/kernels/sparse/activation_kernel.cc pattern). Only zero-preserving
-    fns (f(0)=0) are sound on the implicit zeros."""
+    fns (f(0)=0) are sound on the implicit zeros. Runs through the
+    dispatch so chains of sparse ops stay tape-differentiable."""
     def op(x: SparseCooTensor) -> SparseCooTensor:
-        b = _as_coo(x)._b
+        xs = _as_coo(x)
+        b = xs._b
+
+        def impl(v, *, _fn=fn):
+            return _fn(v)
+
+        vt = _dispatch.call(impl, [xs.values()], name="sparse_unary")
+        data = vt.data if isinstance(vt, Tensor) else vt
         return SparseCooTensor(
-            jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+            jsparse.BCOO((data, b.indices), shape=b.shape),
+            values_tensor=vt if isinstance(vt, Tensor) else None)
     return op
 
 
@@ -176,17 +237,30 @@ square = _unary(jnp.square)
 
 
 def pow(x: SparseCooTensor, factor) -> SparseCooTensor:  # noqa: A001
-    b = _as_coo(x)._b
-    return SparseCooTensor(
-        jsparse.BCOO((jnp.power(b.data, factor), b.indices), shape=b.shape))
+    xs = _as_coo(x)
+    b = xs._b
+
+    def impl(v, *, factor=factor):
+        return jnp.power(v, factor)
+
+    vt = _dispatch.call(impl, [xs.values()], name="sparse_pow")
+    return _coo_wrap(vt, b.indices, b.shape)
 
 
 def cast(x: SparseCooTensor, index_dtype=None, value_dtype=None
          ) -> SparseCooTensor:
-    b = _as_coo(x)._b
-    data = b.data if value_dtype is None else b.data.astype(value_dtype)
+    xs = _as_coo(x)
+    b = xs._b
     idx = b.indices if index_dtype is None else b.indices.astype(index_dtype)
-    return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape))
+    if value_dtype is None:
+        return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=b.shape),
+                               values_tensor=xs._vt)
+
+    def impl(v, *, value_dtype=value_dtype):
+        return v.astype(value_dtype)
+
+    vt = _dispatch.call(impl, [xs.values()], name="sparse_cast")
+    return _coo_wrap(vt, idx, b.shape)
 
 
 def multiply(x: SparseCooTensor, y) -> SparseCooTensor:
@@ -194,8 +268,10 @@ def multiply(x: SparseCooTensor, y) -> SparseCooTensor:
     the two patterns (implicit zeros dominate products)."""
     b = _as_coo(x)._b
     if isinstance(y, SparseCooTensor):
-        xb = b.sum_duplicates()
-        yb = y._b.sum_duplicates()
+        xsc = _as_coo(x).coalesce()  # tape-preserving duplicate merge
+        ysc = y.coalesce()
+        xb = xsc._b
+        yb = ysc._b
         if len(xb.shape) != 2 or tuple(xb.shape) != tuple(yb.shape):
             raise ValueError(
                 f"sparse multiply needs matching 2-D shapes, got "
@@ -214,27 +290,47 @@ def multiply(x: SparseCooTensor, y) -> SparseCooTensor:
         ky = iy[:, 0] * ncol + iy[:, 1]
         order = np.argsort(ky)
         pos = np.clip(np.searchsorted(ky[order], kx), 0, ky.size - 1)
-        hit = jnp.asarray(ky[order][pos] == kx)
-        yv = jnp.where(hit, yb.data[jnp.asarray(order[pos])], 0)
-        return SparseCooTensor(
-            jsparse.BCOO((xb.data * yv, xb.indices), shape=xb.shape))
-    return SparseCooTensor(
-        jsparse.BCOO((b.data * y, b.indices), shape=b.shape))
+        hit = ky[order][pos] == kx
+        gather = order[pos]
+
+        def impl(vx, vy, *, hit=hit, gather=gather):
+            yv = jnp.where(jnp.asarray(hit),
+                           jnp.take(vy, jnp.asarray(gather), axis=0), 0)
+            return vx * yv
+
+        # the coalesce above re-routed both value chains through the tape,
+        # so gradients flow to both sparse operands
+        vt = _dispatch.call(impl, [xsc.values(), ysc.values()],
+                            name="sparse_multiply")
+        return _coo_wrap(vt, xb.indices, xb.shape)
+
+    def impl(v, *, y=y):
+        return v * y
+
+    vt = _dispatch.call(impl, [_as_coo(x).values()], name="sparse_scale")
+    return _coo_wrap(vt, b.indices, b.shape)
 
 
 def divide(x: SparseCooTensor, scalar) -> SparseCooTensor:
-    b = _as_coo(x)._b
-    return SparseCooTensor(
-        jsparse.BCOO((b.data / scalar, b.indices), shape=b.shape))
+    xs = _as_coo(x)
+    b = xs._b
+
+    def impl(v, *, scalar=scalar):
+        return v / scalar
+
+    vt = _dispatch.call(impl, [xs.values()], name="sparse_divide")
+    return _coo_wrap(vt, b.indices, b.shape)
 
 
 def transpose(x: SparseCooTensor, perm=None) -> SparseCooTensor:
-    b = _as_coo(x)._b
+    xs = _as_coo(x)
+    b = xs._b
     nd = len(b.shape)
     perm = list(perm) if perm is not None else list(range(nd))[::-1]
     idx = b.indices[:, jnp.asarray(perm)]
     shape = tuple(b.shape[p] for p in perm)
-    return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=shape))
+    return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=shape),
+                           values_tensor=xs._vt)
 
 
 def matmul(x: SparseCooTensor, y) -> Tensor:
@@ -276,18 +372,24 @@ def softmax(x, axis: int = -1):
     if isinstance(x, SparseCsrTensor):
         out = softmax(SparseCooTensor(x._b.to_bcoo()), axis=axis)
         return SparseCsrTensor(jsparse.BCSR.from_bcoo(out._b))
-    b = _as_coo(x)._b.sum_duplicates()
+    xc = _as_coo(x).coalesce()  # tape-preserving duplicate merge
+    b = xc._b
     if len(b.shape) != 2 or axis not in (-1, 1):
         raise ValueError("sparse softmax supports 2-D tensors over the "
                          f"last axis; got shape {tuple(b.shape)}, "
                          f"axis={axis}")
-    rows = b.indices[:, 0]
-    m = b.shape[0]
-    rmax = jax.ops.segment_max(b.data, rows, num_segments=m)
-    e = jnp.exp(b.data - rmax[rows])
-    denom = jax.ops.segment_sum(e, rows, num_segments=m)
-    return SparseCooTensor(
-        jsparse.BCOO((e / denom[rows], b.indices), shape=b.shape))
+    rows = np.asarray(b.indices[:, 0])
+    m = int(b.shape[0])
+
+    def impl(v, *, rows=rows, m=m):
+        r = jnp.asarray(rows)
+        rmax = jax.ops.segment_max(v, r, num_segments=m)
+        e = jnp.exp(v - rmax[r])
+        denom = jax.ops.segment_sum(e, r, num_segments=m)
+        return e / denom[r]
+
+    vt = _dispatch.call(impl, [xc.values()], name="sparse_softmax")
+    return _coo_wrap(vt, b.indices, b.shape)
 
 
 def to_sparse_coo(dense, sparse_dim: Optional[int] = None) -> SparseCooTensor:
@@ -305,4 +407,9 @@ __all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
            "relu", "tanh", "sqrt", "sin", "asin", "atan", "sinh", "asinh",
            "atanh", "expm1", "log1p", "abs", "neg", "square", "pow", "cast",
            "transpose", "matmul", "masked_matmul", "softmax",
-           "to_sparse_coo", "to_sparse_csr"]
+           "to_sparse_coo", "to_sparse_csr",
+           "conv3d", "subm_conv3d", "max_pool3d", "avg_pool3d", "nn"]
+
+# sparse conv/pool live in a submodule (they need the COO types above)
+from .conv import conv3d, subm_conv3d, max_pool3d, avg_pool3d  # noqa: E402
+from . import nn  # noqa: E402

@@ -1,0 +1,241 @@
+"""Sparse 3-D convolution / pooling over the COO layout.
+
+Reference: `phi/kernels/sparse/convolution_kernel.h` (Conv3dKernel with a
+"rulebook" of (kernel-offset, in-row, out-row) triples, `subm` submanifold
+mode) and `sparse_pool_kernel.h` — the point-cloud workload class.
+
+TPU translation: the rulebook is built host-side with numpy (nnz and
+active-site sets are inherently dynamic — same reason the reference builds
+it on CPU before the GEMMs), then the value compute is a static python
+loop over the K^3 kernel offsets of gather -> [n_pairs, Cin] @ [Cin, Cout]
+-> segment-sum scatter, all jnp and tape-differentiable (gather/GEMM/
+scatter is MXU-shaped; the reference GPU kernel does the same dance with
+im2col-style gathers).
+
+Layout convention (reference sparse conv): coordinates are (N, D, H, W)
+sparse dims with a dense channel tail — values [nnz, C]; the kernel is
+[kd, kh, kw, Cin, Cout].
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.tensor import Tensor
+from ..ops import _dispatch
+from . import SparseCooTensor, _as_coo
+
+
+def _triple(v: Union[int, Sequence[int]]):
+    if isinstance(v, (list, tuple)):
+        assert len(v) == 3, v
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _out_size(in_sz, k, pad, stride, dil):
+    return (in_sz + 2 * pad - dil * (k - 1) - 1) // stride + 1
+
+
+def _coord_key(coords, dims):
+    """[n, 4] int coords -> flat int64 keys over the (N,D,H,W) grid."""
+    key = coords[:, 0].astype(np.int64)
+    for ax in range(1, 4):
+        key = key * dims[ax] + coords[:, ax]
+    return key
+
+
+def _build_rulebook(coords, spatial, kernel, pad, stride, dil, subm):
+    """Host-side rulebook: per kernel offset, (in_rows, out_rows) pairs.
+
+    Returns (rules, out_coords): rules is a list of K^3 (in_rows, out_rows)
+    int32 arrays; out_coords [n_out, 4]. For `subm` the output sites are
+    exactly the input sites (submanifold convolution keeps the active set,
+    reference convolution_kernel.h `subm`)."""
+    kd, kh, kw = kernel
+    N_dims = (int(coords[:, 0].max(initial=0)) + 1,) + tuple(spatial)
+    if subm:
+        assert stride == (1, 1, 1), "submanifold conv requires stride 1"
+        out_spatial = tuple(spatial)
+        out_coords = coords
+        out_dims = (N_dims[0],) + out_spatial
+        # sorted-key lookup instead of a python dict: K^3 x nnz probes
+        # vectorize to searchsorted (same trick as the strided branch)
+        site_keys = _coord_key(coords, out_dims)
+        order = np.argsort(site_keys)
+        sorted_keys = site_keys[order]
+        rules = []
+        for od in range(kd):
+            for oh in range(kh):
+                for ow in range(kw):
+                    # site s receives from in = s - pad + off*dil (the
+                    # strided branch's out = in + pad - off inverted);
+                    # kernel-center padding gives the symmetric window
+                    shift = np.array([0, od * dil[0] - pad[0],
+                                      oh * dil[1] - pad[1],
+                                      ow * dil[2] - pad[2]])
+                    nb = coords + shift  # neighbor that CONTRIBUTES here
+                    ok = np.all(
+                        (nb[:, 1:] >= 0) & (nb[:, 1:] < np.array(spatial)),
+                        axis=1)
+                    rows = np.nonzero(ok)[0]
+                    nb_keys = _coord_key(nb[rows], out_dims)
+                    if sorted_keys.size == 0:
+                        rules.append((np.zeros(0, np.int32),
+                                      np.zeros(0, np.int32)))
+                        continue
+                    pos = np.searchsorted(sorted_keys, nb_keys)
+                    pos = np.minimum(pos, sorted_keys.size - 1)
+                    hit = sorted_keys[pos] == nb_keys
+                    rules.append((order[pos[hit]].astype(np.int32),
+                                  rows[hit].astype(np.int32)))
+        return rules, out_coords, out_spatial
+
+    out_spatial = tuple(
+        _out_size(spatial[i], kernel[i], pad[i], stride[i], dil[i])
+        for i in range(3))
+    out_dims = (N_dims[0],) + out_spatial
+    cand_in, cand_key, cand_off = [], [], []
+    for o_idx, (od, oh, ow) in enumerate(
+            (a, b, c) for a in range(kd) for b in range(kh)
+            for c in range(kw)):
+        off = np.array([od * dil[0], oh * dil[1], ow * dil[2]])
+        num = coords[:, 1:] + np.array(pad) - off
+        ok = np.all((num % np.array(stride) == 0) & (num >= 0), axis=1)
+        out_sp = num // np.array(stride)
+        ok &= np.all(out_sp < np.array(out_spatial), axis=1)
+        rows = np.nonzero(ok)[0]
+        oc = np.concatenate([coords[rows, :1], out_sp[rows]], axis=1)
+        cand_in.append(rows.astype(np.int32))
+        cand_key.append(_coord_key(oc, out_dims))
+        cand_off.append(np.full(rows.size, o_idx, np.int32))
+    all_keys = np.concatenate(cand_key) if cand_key else np.zeros(0, np.int64)
+    uniq_keys, inv = np.unique(all_keys, return_inverse=True)
+    # unflatten unique keys back to [n_out, 4] coords
+    out_coords = np.zeros((uniq_keys.size, 4), np.int64)
+    rem = uniq_keys
+    for ax in (3, 2, 1):
+        out_coords[:, ax] = rem % out_dims[ax]
+        rem = rem // out_dims[ax]
+    out_coords[:, 0] = rem
+    rules, start = [], 0
+    for rows in cand_in:
+        out_rows = inv[start:start + rows.size].astype(np.int32)
+        start += rows.size
+        rules.append((rows, out_rows))
+    return rules, out_coords, out_spatial
+
+
+def _coo_parts(x: SparseCooTensor):
+    xs = _as_coo(x)
+    b = xs._b
+    assert b.indices.shape[-1] == 4 and b.data.ndim == 2, (
+        "sparse conv3d expects COO with (N, D, H, W) sparse dims and a "
+        f"dense channel tail; got indices {b.indices.shape}, "
+        f"values {b.data.shape}")
+    coords = np.asarray(b.indices, np.int64)
+    return xs, b, coords
+
+
+def conv3d(x: SparseCooTensor, weight, bias=None, stride=1, padding=0,
+           dilation=1, subm: bool = False) -> SparseCooTensor:
+    """Sparse COO conv3d (reference `phi::sparse::Conv3d`); `subm=True` is
+    submanifold convolution (active set preserved). Differentiable w.r.t.
+    values, weight, and bias through the eager tape."""
+    xs, b, coords = _coo_parts(x)
+    N, D, H, W, C = b.shape
+    wt = weight if isinstance(weight, Tensor) else Tensor(jnp.asarray(weight))
+    kd, kh, kw, cin, cout = wt.shape
+    assert cin == C, (cin, C)
+    stride, padding, dilation = _triple(stride), _triple(padding), _triple(dilation)
+    rules, out_coords, out_spatial = _build_rulebook(
+        coords, (D, H, W), (kd, kh, kw), padding, stride, dilation, subm)
+    n_out = out_coords.shape[0]
+
+    tensors = [xs.values(), wt]  # tape-connected values keep chains differentiable
+    if bias is not None:
+        tensors.append(bias if isinstance(bias, Tensor)
+                       else Tensor(jnp.asarray(bias)))
+
+    def impl(vals, w, *maybe_bias, rules=rules, n_out=n_out, cout=cout):
+        wf = w.reshape(-1, w.shape[-2], w.shape[-1])  # [K3, Cin, Cout]
+        out = jnp.zeros((n_out, cout), vals.dtype)
+        for o, (in_rows, out_rows) in enumerate(rules):
+            if in_rows.size == 0:
+                continue
+            contrib = jnp.take(vals, in_rows, axis=0) @ wf[o]
+            out = out + jax.ops.segment_sum(contrib, out_rows,
+                                            num_segments=n_out)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+
+    out_vals = _dispatch.call(impl, tensors, name="sparse_conv3d")
+    shape = (N,) + out_spatial + (cout,)
+    data = out_vals.data if isinstance(out_vals, Tensor) else out_vals
+    return SparseCooTensor(
+        jsparse.BCOO((data, jnp.asarray(out_coords)), shape=shape),
+        values_tensor=out_vals if isinstance(out_vals, Tensor) else None)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1):
+    return conv3d(x, weight, bias=bias, stride=stride, padding=padding,
+                  dilation=dilation, subm=True)
+
+
+def _pool3d(x: SparseCooTensor, kernel_size, stride, padding, mode: str):
+    xs, b, coords = _coo_parts(x)
+    N, D, H, W, C = b.shape
+    ks = _triple(kernel_size)
+    stride = _triple(stride if stride is not None else kernel_size)
+    padding = _triple(padding)
+    rules, out_coords, out_spatial = _build_rulebook(
+        coords, (D, H, W), ks, padding, stride, (1, 1, 1), False)
+    n_out = out_coords.shape[0]
+
+    def impl(vals, *, rules=rules, n_out=n_out, mode=mode):
+        if mode == "max":
+            out = jnp.full((n_out, vals.shape[-1]), -jnp.inf, vals.dtype)
+            for in_rows, out_rows in rules:
+                if in_rows.size == 0:
+                    continue
+                seg = jax.ops.segment_max(
+                    jnp.take(vals, in_rows, axis=0), out_rows,
+                    num_segments=n_out)
+                out = jnp.maximum(out, seg)
+            return out
+        # mean over PRESENT entries of the window (sparse semantics: the
+        # reference pool divides by the rulebook count, not the window
+        # volume — absent voxels are not zeros)
+        out = jnp.zeros((n_out, vals.shape[-1]), vals.dtype)
+        cnt = np.zeros((n_out, 1), np.float32)
+        for in_rows, out_rows in rules:
+            if in_rows.size == 0:
+                continue
+            out = out + jax.ops.segment_sum(
+                jnp.take(vals, in_rows, axis=0), out_rows,
+                num_segments=n_out)
+            np.add.at(cnt, out_rows[:, None], 1.0)
+        return out / jnp.asarray(np.maximum(cnt, 1.0), vals.dtype)
+
+    out_vals = _dispatch.call(impl, [xs.values()],
+                              name=f"sparse_{mode}_pool3d")
+    shape = (N,) + out_spatial + (C,)
+    data = out_vals.data if isinstance(out_vals, Tensor) else out_vals
+    return SparseCooTensor(
+        jsparse.BCOO((data, jnp.asarray(out_coords)), shape=shape),
+        values_tensor=out_vals if isinstance(out_vals, Tensor) else None)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0):
+    """Sparse COO max-pool (reference `phi::sparse::MaxPool`)."""
+    return _pool3d(x, kernel_size, stride, padding, "max")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0):
+    """Mean over the PRESENT entries of each window (rulebook count)."""
+    return _pool3d(x, kernel_size, stride, padding, "avg")

@@ -1,0 +1,75 @@
+"""Sparse conv/pool layers over COO tensors (point-cloud networks).
+
+Reference: the sparse kernel family `phi/kernels/sparse/` (Conv3dKernel
+subm/strided + MaxPool); layer surface mirrors nn.Conv3D conventions with
+the sparse [kd, kh, kw, Cin, Cout] kernel layout.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer import Layer
+from . import relu as _sparse_relu
+from .conv import _triple, avg_pool3d, conv3d, max_pool3d, subm_conv3d
+
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, bias_attr=True, subm=False):
+        super().__init__()
+        kd, kh, kw = _triple(kernel_size)
+        self._subm = subm
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        from ..nn.initializer import Constant, Uniform
+        fan_in = in_channels * kd * kh * kw
+        bound = 1.0 / np.sqrt(fan_in)
+        # Uniform draws from the framework RNG: paddle.seed() controls it
+        # and identically-configured layers get independent weights
+        self.weight = self.create_parameter(
+            (kd, kh, kw, in_channels, out_channels),
+            default_initializer=Uniform(-bound, bound))
+        self.bias = (self.create_parameter(
+            (out_channels,), default_initializer=Constant(0.0))
+            if bias_attr else None)
+
+    def forward(self, x):
+        return conv3d(x, self.weight, bias=self.bias, stride=self._stride,
+                      padding=self._padding, dilation=self._dilation,
+                      subm=self._subm)
+
+
+class Conv3D(_SparseConvBase):
+    """Strided sparse conv3d (active set grows per the rulebook)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, bias_attr=True):
+        super().__init__(in_channels, out_channels, kernel_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         bias_attr=bias_attr, subm=False)
+
+
+class SubmConv3D(_SparseConvBase):
+    """Submanifold conv3d: output active set == input active set."""
+
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 padding=0, dilation=1, bias_attr=True):
+        super().__init__(in_channels, out_channels, kernel_size, stride=1,
+                         padding=padding, dilation=dilation,
+                         bias_attr=bias_attr, subm=True)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+
+    def forward(self, x):
+        return max_pool3d(x, self._k, stride=self._s, padding=self._p)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _sparse_relu(x)

@@ -59,7 +59,14 @@ class Engine:
         if self.plan_mode != "auto" or self.plan_result is not None:
             return
         from .planner import Planner
-        planner = Planner(self.model, self.loss, self.optimizer)
+        # Engine executes GSPMD plans (param specs + data sharding); the
+        # pp / sp_ulysses templates score the pipeline/sequence-parallel
+        # TrainSteps the Engine does not build, so searching them here
+        # would pick plans this executor cannot realize. Use the full
+        # default template set with Planner + PipelineParallelTrainStep /
+        # HybridParallelTrainStep directly for those.
+        planner = Planner(self.model, self.loss, self.optimizer,
+                          templates=("dp", "tp_alternating"))
         best = planner.plan(*batch_arrs)
         planner.apply(best)
         self.plan_result = best

@@ -92,6 +92,13 @@ def recompute(function, *args, preserve_rng_state: bool = True,
                                  layer.named_buffers()})
     keys = list(named)
 
+    # buffer updates (BatchNorm running stats) produced INSIDE the
+    # checkpointed region must come back out as extra outputs and be
+    # written to the live layers, or recompute silently freezes them
+    buf_names = [(li, k) for li, layer in enumerate(layers)
+                 for k, _ in layer.named_buffers()]
+    shape_info = {"n_out": None, "tuple_out": False}
+
     def impl(rng_key, *arrs):
         import contextlib
         pvals = arrs[:len(keys)]
@@ -100,23 +107,49 @@ def recompute(function, *args, preserve_rng_state: bool = True,
         kw_vals = rest[len(rest) - len(kw_names):]
         with contextlib.ExitStack() as st:
             st.enter_context(tape_mod.no_grad())
+            ctxs = []
             for li, layer in enumerate(layers):
                 pref = f"{li}::"
                 sub = {k[len(pref):]: v for k, v in
                        zip(keys, pvals) if k.startswith(pref)}
-                st.enter_context(
-                    _swapped_state(layer, sub, buffers_by_layer[li]))
+                ctxs.append(st.enter_context(
+                    _swapped_state(layer, sub, buffers_by_layer[li])))
             st.enter_context(random_mod.rng_scope(rng_key))
             out = function(*[Tensor(a) for a in inputs],
                            **dict(zip(kw_names,
                                       (Tensor(a) for a in kw_vals))),
                            **static_kwargs)
+            new_bufs = []
+            for li, _layer in enumerate(layers):
+                swapped = dict(ctxs[li].items()) if hasattr(
+                    ctxs[li], "items") else {}
+                for (bl, bk) in buf_names:
+                    if bl == li:
+                        t = swapped.get(bk)
+                        new_bufs.append(t.data if t is not None
+                                        else buffers_by_layer[li][bk])
         if isinstance(out, (tuple, list)):
-            return tuple(o.data if isinstance(o, Tensor) else o for o in out)
-        return out.data if isinstance(out, Tensor) else out
+            outs = tuple(o.data if isinstance(o, Tensor) else o for o in out)
+            shape_info["tuple_out"] = True
+        else:
+            outs = (out.data if isinstance(out, Tensor) else out,)
+        shape_info["n_out"] = len(outs)
+        return outs + tuple(new_bufs)
 
     tensors = [rng] + [named[k] for k in keys] + list(args) + kw_tensors
-    return _d.call(jax.checkpoint(impl), tensors, name="recompute")
+    res = _d.call(jax.checkpoint(impl), tensors, name="recompute")
+    if not buf_names and not shape_info["tuple_out"]:
+        return res if not isinstance(res, (tuple, list)) else res[0]
+    res = res if isinstance(res, (tuple, list)) else (res,)
+    n_out = shape_info["n_out"]
+    out_part, buf_part = res[:n_out], res[n_out:]
+    for (li, bk), val in zip(buf_names, buf_part):
+        named_b = dict(layers[li].named_buffers())
+        if bk in named_b:
+            named_b[bk].data = (val.data if isinstance(val, Tensor) else val)
+    if shape_info["tuple_out"]:
+        return tuple(out_part)
+    return out_part[0]
 
 from . import fs  # noqa: F401,E402
 from .fs import LocalFS, HDFSClient  # noqa: F401,E402

@@ -59,8 +59,14 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, recompute=False):
+        """`recompute=True` rematerializes each residual STAGE's
+        activations in backward (reference RecomputeFunction applied at
+        `layer1..layer4` granularity): on a bandwidth-bound chip the
+        re-run conv FLOPs are cheaper than round-tripping every
+        intermediate activation through HBM."""
         super().__init__()
+        self._recompute = recompute
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
         layers = layer_cfg[depth]
@@ -105,10 +111,16 @@ class ResNet(nn.Layer):
     def forward(self, x):
         x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
-        x = self.layer1(x)
-        x = self.layer2(x)
-        x = self.layer3(x)
-        x = self.layer4(x)
+        if self._recompute and self.training:
+            from ..distributed.fleet.utils import recompute
+            for stage in (self.layer1, self.layer2, self.layer3,
+                          self.layer4):
+                x = recompute(stage, x)
+        else:
+            x = self.layer1(x)
+            x = self.layer2(x)
+            x = self.layer3(x)
+            x = self.layer4(x)
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:

@@ -9,6 +9,13 @@ from __future__ import annotations
 
 import warnings as _warnings
 
+# Make `JAX_PLATFORMS` binding before any backend initializes: accelerator
+# plugins (axon) override the env var at registration, so a child spawned
+# with `JAX_PLATFORMS=cpu` would otherwise still bind the real TPU — and
+# hang forever when the chip is wedged (the round-3 bench failure).
+from ._platform import pin_platform as _pin_platform  # noqa: E402
+_pin_platform()
+
 # TPU-first dtype policy: x64 stays off (int64 silently maps to int32 in XLA
 # ops; TPU has no fast int64/float64 path). Silence the per-op truncation
 # warning once here.

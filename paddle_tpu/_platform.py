@@ -1,0 +1,48 @@
+"""Backend-platform pinning for child processes.
+
+Accelerator plugins (axon) override the ``JAX_PLATFORMS`` env var at
+registration time, so a subprocess spawned with ``JAX_PLATFORMS=cpu`` can
+still bind the real TPU — and hang forever when the chip is unhealthy
+(this wedged the round-3 bench: a leaked test child held the chip for 21h).
+``jax.config.update("jax_platforms", ...)`` sticks where the env var is
+ignored, but it must run before any backend initializes.
+
+Every process-spawning path in the framework (DataLoader workers,
+``paddle.distributed.spawn`` workers, test cluster scripts) calls
+:func:`pin_platform` as its first act. The top-level ``import paddle_tpu``
+also applies the env var via this helper, so subprocess children that
+merely set ``JAX_PLATFORMS=cpu`` and import the package are covered too.
+
+Reference analog: the launcher's per-worker device env contract
+(`/root/reference/python/paddle/distributed/launch/main.py:18`,
+``CUDA_VISIBLE_DEVICES`` partitioning) — on TPU the equivalent isolation
+knob is the jax platform selection itself.
+"""
+from __future__ import annotations
+
+import os
+
+
+def pin_platform(platform: str | None = None) -> bool:
+    """Bind jax to `platform` (default: ``$JAX_PLATFORMS``) if possible.
+
+    Returns True when the config was applied; False when there was nothing
+    to pin or the backends were already initialized (too late to repoint).
+    Never raises: this runs in worker bootstrap paths where a failure here
+    must not mask the real work's error reporting.
+    """
+    plat = platform or os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return False
+    if platform is not None:
+        # make the choice visible to grandchildren too
+        os.environ["JAX_PLATFORMS"] = platform
+    try:
+        from jax._src import xla_bridge as _xb
+        if getattr(_xb, "_backends", None):
+            return False
+        import jax
+        jax.config.update("jax_platforms", plat)
+        return True
+    except Exception:
+        return False

@@ -22,15 +22,32 @@ import numpy as np
 
 
 class Generator:
-    """Splittable PRNG state, `paddle.fluid.core.default_cpu_generator` equivalent."""
+    """Splittable PRNG state, `paddle.fluid.core.default_cpu_generator` equivalent.
+
+    The key is materialized lazily: constructing a Generator (which happens at
+    `import paddle_tpu` for the process-global default) must NOT touch jax,
+    because `jax.random.PRNGKey` initializes the backend — and on a machine
+    where the TPU is wedged that turns a mere import into an indefinite hang
+    (observed: leaked subprocess children binding the chip for 21h).
+    """
 
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
-        self._key = jax.random.PRNGKey(self._seed)
+        self._lazy_key = None
+
+    @property
+    def _key(self):
+        if self._lazy_key is None:
+            self._lazy_key = jax.random.PRNGKey(self._seed)
+        return self._lazy_key
+
+    @_key.setter
+    def _key(self, value):
+        self._lazy_key = value
 
     def manual_seed(self, s: int):
         self._seed = int(s)
-        self._key = jax.random.PRNGKey(self._seed)
+        self._lazy_key = None
         return self
 
     def initial_seed(self) -> int:

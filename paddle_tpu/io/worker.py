@@ -108,7 +108,9 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue,
                  iterable_mode: bool, batch_size: int, drop_last: bool,
                  num_workers: int):
     """Worker process entry (reference dataloader/worker.py _worker_loop)."""
-    os.environ["JAX_PLATFORMS"] = "cpu"  # never grab the TPU from a worker
+    from .._platform import pin_platform
+    pin_platform("cpu")  # never grab the TPU from a worker (config.update
+    # sticks where the env var is ignored by accelerator plugins)
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     try:

@@ -1,0 +1,86 @@
+"""The bench harness itself must be unkillable (round-3 lesson: one backend
+failure produced rc=1 and no JSON, losing the whole round's perf record).
+
+These tests pin the harness's degradation contract without any real device:
+- backend-init failure → one JSON line with an `error` field, rc 0;
+- any single config raising → structured per-config error, others intact;
+- flagship failure → JSON still printed, `value: null` + `error`.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_main(bench, capsys):
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, f"bench must print exactly ONE line, got {out}"
+    return json.loads(out[0])
+
+
+def test_backend_init_failure_emits_error_json(capsys, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_init_backend_with_retry",
+                        lambda: "RuntimeError: TPU is wedged")
+    rec = _run_main(bench, capsys)
+    assert "TPU is wedged" in rec["error"]
+    assert rec["value"] is None
+    assert rec["metric"]  # schema intact for the driver
+
+def test_one_config_failure_does_not_sink_others(capsys, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_init_backend_with_retry", lambda: None)
+    monkeypatch.setattr(bench, "bench_gpt2", lambda: {
+        "tokens_per_sec_chip": 123.0, "step_time_ms": 1.0, "mfu": 0.5})
+    monkeypatch.setattr(bench, "bench_resnet50",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    for name in ("bench_bert_base", "bench_wide_deep_ps",
+                 "bench_wide_deep_ps_tpu"):
+        monkeypatch.setattr(bench, name, lambda: {"ok": 1})
+    rec = _run_main(bench, capsys)
+    assert rec["value"] == 123.0
+    assert "boom" in rec["configs"]["resnet50"]["error"]
+    assert rec["configs"]["bert_base_seq128"] == {"ok": 1}
+    assert "error" not in rec
+
+
+def test_flagship_failure_still_prints_json(capsys, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_init_backend_with_retry", lambda: None)
+    for name in ("bench_gpt2", "bench_resnet50", "bench_bert_base",
+                 "bench_wide_deep_ps", "bench_wide_deep_ps_tpu"):
+        monkeypatch.setattr(
+            bench, name,
+            lambda: (_ for _ in ()).throw(RuntimeError("all dead")))
+    rec = _run_main(bench, capsys)
+    assert rec["value"] is None
+    assert "flagship" in rec["error"]
+    assert "all dead" in rec["configs"]["gpt2_small"]["error"]
+
+
+def test_import_paddle_tpu_does_not_init_backend():
+    """`import paddle_tpu` must never touch the jax backend: a subprocess
+    that merely imports the package must not bind (or hang on) the TPU.
+    Round-3 root cause: framework/random.py built a PRNGKey at import."""
+    import subprocess
+    code = (
+        "import paddle_tpu\n"
+        "from jax._src import xla_bridge as xb\n"
+        "assert not getattr(xb, '_backends', None), 'backend initialized'\n"
+        "print('LAZY_OK')\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("JAX_PLATFORMS", None)  # the real-world (driver) condition
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0 and "LAZY_OK" in r.stdout, r.stderr[-2000:]

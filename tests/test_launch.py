@@ -11,6 +11,26 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _run_group(cmd, env, cwd=None, timeout=120):
+    """subprocess.run equivalent that kills the WHOLE process group on
+    timeout — plain run() kills only the direct child, leaking pod workers
+    that can wedge the one shared TPU chip (round-3 failure mode)."""
+    import signal
+    proc = subprocess.Popen(cmd, env=env, cwd=cwd, text=True,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait(timeout=10)
+    return subprocess.CompletedProcess(cmd, proc.returncode, out, err)
+
+
 def _run_launch(tmp_path, script_body, extra_args=(), nproc=2):
     script = tmp_path / "worker.py"
     script.write_text(textwrap.dedent(script_body))
@@ -20,8 +40,7 @@ def _run_launch(tmp_path, script_body, extra_args=(), nproc=2):
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
            "--nproc_per_node", str(nproc),
            "--log_dir", str(tmp_path / "log"), *extra_args, str(script)]
-    return subprocess.run(cmd, env=env, capture_output=True, text=True,
-                          timeout=120, cwd=str(tmp_path))
+    return _run_group(cmd, env, cwd=str(tmp_path))
 
 
 class TestLaunchCLI:
@@ -90,9 +109,8 @@ class TestSpawn:
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env["JAX_PLATFORMS"] = "cpu"
-        r = subprocess.run([sys.executable, str(script), str(tmp_path)],
-                           env=env, capture_output=True, text=True,
-                           timeout=120)
+        r = _run_group([sys.executable, str(script), str(tmp_path)],
+                       env, timeout=120)
         assert r.returncode == 0, r.stderr
         assert (tmp_path / "spawn.0").exists()
         assert (tmp_path / "spawn.1").exists()

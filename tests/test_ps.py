@@ -234,10 +234,24 @@ class TestPSCluster:
                      "PADDLE_TRAINER_ID": str(tid)},
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
         outs = []
-        for p in procs:
-            # generous: the full-suite run can load the machine heavily
-            out, _ = p.communicate(timeout=420)
-            outs.append(out.decode())
+        try:
+            for p in procs:
+                # generous: the full-suite run can load the machine heavily
+                out, _ = p.communicate(timeout=420)
+                outs.append(out.decode())
+        finally:
+            # a timed-out child must NOT outlive the test: a leaked trainer
+            # can hold the one shared TPU chip and poison every later run
+            # (observed in round 3: a ps_train.py alive for 21h)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for p in procs:
+                if p.poll() is None:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass
         for p, out in zip(procs, outs):
             assert p.returncode == 0, f"proc failed:\n{out}"
         assert "TRAINER 0" in outs[1] + outs[2]

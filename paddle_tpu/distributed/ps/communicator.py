@@ -172,6 +172,7 @@ class GeoCommunicator:
         self._local: Dict[int, Dict[int, Tuple[np.ndarray, np.ndarray]]] = {}
         self._dirty: Dict[int, set] = {}
         self._push_counts: Dict[int, int] = {}
+        self._ever_pushed: set = set()
 
     # ---------------- sparse path (local-first) ----------------------------
     def _materialize(self, table_id: int, keys: np.ndarray) -> dict:
@@ -215,13 +216,28 @@ class GeoCommunicator:
         # max/any trigger drifts to steps 4,7,11,... for 2 tables). A table
         # pushed only in some steps delays the cadence accordingly.
         self._push_counts[table_id] = self._push_counts.get(table_id, 0) + 1
-        counts = self._push_counts.values()
+        # trigger on min over tables EVER pushed in this run (ADVICE r3):
+        # at geo_push_steps=1 with multiple tables, min over merely-seen-
+        # this-round tables fired after the FIRST table's push — mid-step.
+        # Ever-pushed membership also keeps a registered-but-frozen table
+        # (pull-only embedding) from suppressing the cadence; the one
+        # artifact is that the very first sync of a run can land mid-step,
+        # before later tables' first pushes are known. Counter resets keep
+        # zeros for known tables, so steady state syncs on step boundaries.
+        self._ever_pushed.add(table_id)
+        counts = [self._push_counts.get(t, 0) for t in self._ever_pushed]
         # min-trigger keeps the sync on step boundaries; the max escape
         # hatch bounds staleness if some table stops being pushed (a frozen
         # counter would otherwise starve geo_sync forever)
         if (min(counts) >= self.geo_push_steps
                 or max(counts) >= 2 * self.geo_push_steps):
             self.geo_sync()
+            # forget tables that pushed nothing this round (frozen mid-run):
+            # a permanent zero would pin min(counts)=0 and silently double
+            # the cadence via the max escape for the rest of the run
+            self._ever_pushed = {
+                t for t in self._ever_pushed
+                if self._push_counts.get(t, 0) > 0}
             self._push_counts = {}
 
     def geo_sync(self):

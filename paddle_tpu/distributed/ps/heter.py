@@ -138,10 +138,15 @@ class HeterPSTrainStep:
         actually ran and kept otherwise — partial last batches work."""
         apply_fn = self.apply_fn
 
-        def route(*batch):
+        def route(params, buffers, *batch):
+            # params/buffers arrive as ARGUMENTS, not closure constants:
+            # closing over the live arrays would bake a duplicate of the
+            # whole parameter memory into the routing executable (ADVICE
+            # r3); ids never depend on them, so jit's default unused-arg
+            # dropping elides them from the compiled program entirely
             _ROUTE.capture = []
             try:
-                apply_fn(self.params, self.buffers, None, *batch[:-1])
+                apply_fn(params, buffers, None, *batch[:-1])
                 return tuple(_ROUTE.capture)
             finally:
                 _ROUTE.capture = None
@@ -150,7 +155,7 @@ class HeterPSTrainStep:
             self._router = jax.jit(route)
         _ROUTE.plan = []
         try:
-            ids = self._router(*arrs)
+            ids = self._router(self.params, self.buffers, *arrs)
             if _ROUTE.plan:  # a (re)trace ran: adopt the fresh plan
                 self._plan = list(_ROUTE.plan)
         finally:

@@ -94,28 +94,38 @@ def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None,
     qs = (q * jnp.asarray(scale, q.dtype))
     logits = jnp.einsum("blhd,bmhd->bhlm", qs, k,
                         preferred_element_type=acc_t).astype(acc_t)
+    # `valid` tracks which positions may attend, so fully-masked rows are
+    # detected from the masks themselves — thresholding the score max
+    # misclassifies a fully-masked fp16 row whenever an additive mask rides
+    # on real logits above ~100 (ADVICE r3)
+    valid = None
     if causal:
         cmask = jnp.tril(jnp.ones((Lq, Lk), dtype=bool), k=Lk - Lq)
         logits = jnp.where(cmask, logits, floor)
+        valid = jnp.broadcast_to(cmask, logits.shape)
     if mask is not None:
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, floor)
+            mvalid = jnp.broadcast_to(mask, logits.shape)
         else:
             # clamp ONLY the mask term (ADVICE r1): real scores stay exact
             logits = logits + jnp.maximum(mask.astype(acc_t), floor)
+            mvalid = jnp.broadcast_to(mask.astype(jnp.float32) > float(floor),
+                                      logits.shape)
+        valid = mvalid if valid is None else (valid & mvalid)
     # max-subtracted softmax; row stats accumulate in fp32 (tiny arrays)
     m = jnp.max(logits.astype(jnp.float32), axis=-1, keepdims=True)
     p = jnp.exp(logits - m.astype(acc_t))
     denom = jnp.sum(p.astype(jnp.float32), axis=-1, keepdims=True)
     denom = jnp.maximum(denom, 1e-30)
     probs = (p / denom.astype(acc_t)).astype(v.dtype)
-    if causal or mask is not None:
+    if valid is not None:
         # a row with EVERY position masked outputs zero (matching the
         # Pallas kernels, which zero p when s sits at the floor) instead of
         # the uniform 1/Lk attention a naive softmax of all-floor rows
         # yields — keeps numerics identical across dispatch paths
-        probs = jnp.where(m <= 0.99 * jnp.float32(floor), 0.0,
-                          probs).astype(v.dtype)
+        probs = jnp.where(jnp.any(valid, axis=-1, keepdims=True),
+                          probs, 0.0).astype(v.dtype)
     if dropout_p > 0.0:
         assert dropout_key is not None, "dropout_p > 0 needs dropout_key"
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
@@ -556,11 +566,15 @@ def _fa_small_bwd_pallas(q, k, v, out, lse, do, mask, causal, scale,
             jnp.swapaxes(dv, 1, 2))
 
 
-def _use_small_path(Lq: int, Lk: int, H: int, D: int) -> bool:
+def _use_small_path(Lq: int, Lk: int, H: int, D: int, mask=None) -> bool:
     if Lq != Lk or Lq > _SMALL_MAX_L:
         return False
-    # [H,L,L] f32 scores + q/k/v/o blocks must sit comfortably in VMEM
+    # [H,L,L] f32 scores + q/k/v/o blocks must sit comfortably in VMEM;
+    # a mask block is resident too ([H,Lq,Lk] per program) — count its
+    # bytes so the budget stays honest if _SMALL_MAX_L is ever raised
     vmem = H * Lq * Lk * 4 + 4 * H * Lq * D * 4
+    if mask is not None:
+        vmem += H * Lq * Lk * mask.dtype.itemsize
     return vmem <= 24 * 1024 * 1024
 
 
@@ -743,7 +757,7 @@ def _fa_bwd_pallas(q, k, v, out, lse, do, mask, causal, scale,
 
 def _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret):
     B, Lq, H, D = q.shape
-    f = (_fa_small_fwd_pallas if _use_small_path(Lq, k.shape[1], H, D)
+    f = (_fa_small_fwd_pallas if _use_small_path(Lq, k.shape[1], H, D, mask)
          else _fa_fwd_pallas)
     return f(q, k, v, mask, causal, scale, mask_is_bool=mask_is_bool,
              interpret=interpret)
@@ -752,7 +766,7 @@ def _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret):
 def _bwd_any(q, k, v, out, lse, do, mask, causal, scale, mask_is_bool,
              interpret):
     B, Lq, H, D = q.shape
-    f = (_fa_small_bwd_pallas if _use_small_path(Lq, k.shape[1], H, D)
+    f = (_fa_small_bwd_pallas if _use_small_path(Lq, k.shape[1], H, D, mask)
          else _fa_bwd_pallas)
     return f(q, k, v, out, lse, do, mask, causal, scale,
              mask_is_bool=mask_is_bool, interpret=interpret)

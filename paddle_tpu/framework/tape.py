@@ -31,12 +31,24 @@ def _state():
 
 
 class Node:
-    """One recorded differentiable op: cotangents flow outputs -> inputs."""
+    """One recorded differentiable op: cotangents flow outputs -> inputs.
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "out_meta", "name", "released")
+    `prim_fn`/`in_arrs` (when recorded) hold the replayable primal — the
+    pure tuple-returning impl and its primal input arrays — which is what
+    makes `create_graph=True` possible: double grad re-linearizes the op
+    through a fresh `jax.vjp` executed AS a recorded op, so the produced
+    gradients stay on-tape (the reference keeps the analogous
+    re-executable grad graph in `partial_grad_engine.cc` / eager
+    `GeneralGrad`, `/root/reference/paddle/fluid/eager/backward.cc:421`).
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "out_meta", "name",
+                 "released", "prim_fn", "in_arrs")
 
     def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], outputs: Sequence[Any],
-                 out_meta: Sequence[tuple], name: str):
+                 out_meta: Sequence[tuple], name: str,
+                 prim_fn: Optional[Callable] = None,
+                 in_arrs: Optional[tuple] = None):
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)    # Tensor objects (kept alive for accumulation)
         # weak refs: a dead output can never receive a cotangent (all consumers
@@ -46,6 +58,8 @@ class Node:
         self.out_meta = list(out_meta)  # (shape, dtype) per output, for zero cotangents
         self.name = name
         self.released = False
+        self.prim_fn = prim_fn
+        self.in_arrs = in_arrs
 
     @property
     def out_ids(self):
@@ -100,9 +114,11 @@ class enable_grad:
 _PRUNE_INTERVAL = 2048
 
 
-def record(vjp_fn, inputs, outputs, name="op") -> Node:
+def record(vjp_fn, inputs, outputs, name="op", prim_fn=None,
+           in_arrs=None) -> Node:
     node = Node(vjp_fn, inputs, outputs,
-                [(o.data.shape, o.data.dtype) for o in outputs], name)
+                [(o.data.shape, o.data.dtype) for o in outputs], name,
+                prim_fn=prim_fn, in_arrs=in_arrs)
     st = _state()
     st.tape.append(node)
     for o in outputs:
@@ -122,12 +138,201 @@ def reset_tape():
     _state().tape = []
 
 
-def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+def _fire_hooks(tensor, v, create_graph):
+    """Run a tensor's gradient hooks over cotangent `v` (array or Tensor);
+    a hook's non-None return replaces the gradient (reference
+    `varbase_patch_methods.py:258` semantics)."""
+    from .tensor import Tensor
+
+    for h in list(tensor._hooks or ()):
+        arg = v if isinstance(v, Tensor) else Tensor(v, stop_gradient=True)
+        r = h(arg)
+        if r is None:
+            continue
+        if create_graph:
+            v = r if isinstance(r, Tensor) else Tensor(jnp.asarray(r))
+        else:
+            v = r.data if isinstance(r, Tensor) else jnp.asarray(r)
+    return v
+
+
+def _relinearize(node, cots):
+    """create_graph path: recompute the node's vjp as a RECORDED op.
+
+    Running `jax.vjp(prim_fn, *primals)[1](cots)` through the eager
+    dispatcher makes the produced gradients functions-on-tape of both the
+    primal inputs and the cotangents, which is exactly what grad-of-grad
+    needs (reference: `GeneralGrad`, eager/backward.cc:421).
+    """
+    from ..ops import _dispatch
+    from . import dtype as dtype_mod
+
+    if node.prim_fn is None or node.in_arrs is None:
+        raise NotImplementedError(
+            f"create_graph through op '{node.name}' is unsupported: the node "
+            "records only an opaque vjp (PyLayer / custom native op). Use "
+            "paddle_tpu.autograd functional transforms for this op.")
+    n_in = len(node.in_arrs)
+    diff_idx = tuple(i for i, a in enumerate(node.in_arrs)
+                     if dtype_mod.is_floating(a.dtype)
+                     or dtype_mod.is_complex(a.dtype))
+    prim_fn = node.prim_fn
+
+    def vjp_call(*args):
+        prim_ins, cots_ = args[:n_in], args[n_in:]
+        outs_, f_vjp = jax.vjp(prim_fn, *prim_ins)
+        gs = f_vjp(tuple(c.astype(o.dtype) for c, o in zip(cots_, outs_)))
+        return tuple(gs[i] for i in diff_idx)
+
+    prim_inputs = [t if t is not None else a
+                   for t, a in zip(node.inputs, node.in_arrs)]
+    outs = _dispatch.call(vjp_call, [*prim_inputs, *cots],
+                          name=f"{node.name}_grad")
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    full = [None] * n_in
+    for i, g in zip(diff_idx, outs):
+        full[i] = g
+    return full
+
+
+def _engine(outputs, grad_outputs, *, retain_graph, create_graph,
+            want=None):
+    """Shared reverse traversal for `backward` (want=None: writes leaf
+    `.grad`s) and `grad` (want=inputs: harvests and returns gradients).
+
+    Mirrors `egr::Backward`/`GeneralGrad`
+    (`/root/reference/paddle/fluid/eager/backward.cc:521,421`): seed with
+    ones (or grad_outputs), walk nodes in reverse creation order (already
+    topological for an eager program), accumulate fan-in, fire gradient
+    hooks on each tensor's fully-accumulated cotangent. With
+    `create_graph=True`, cotangents are Tensors and every vjp runs as a
+    recorded op (`_relinearize`), so results stay differentiable.
+    """
+    from .tensor import Tensor
+
+    cg = create_graph
+    if cg:
+        retain_graph = True
+
+    def as_val(g):
+        if cg:
+            return g if isinstance(g, Tensor) else Tensor(jnp.asarray(g),
+                                                          stop_gradient=True)
+        return g.data if isinstance(g, Tensor) else jnp.asarray(g)
+
+    def cast_val(v, dt):
+        cur = v.data.dtype if cg else v.dtype
+        return v if cur == dt else v.astype(dt)  # Tensor.astype is recorded
+
+    def zeros_val(shape, dt):
+        z = jnp.zeros(shape, dt)
+        return Tensor(z, stop_gradient=True) if cg else z
+
+    grads: dict[int, Any] = {}
+    for t, g in zip(outputs, grad_outputs):
+        v = as_val(jnp.ones_like(t.data)) if g is None else as_val(g)
+        grads[id(t)] = v if id(t) not in grads else grads[id(t)] + v
+
+    want_map = {id(t): i for i, t in enumerate(want)} if want is not None \
+        else {}
+    results = [None] * len(want) if want is not None else None
+    leaf_acc: dict[int, list] = {}  # id -> [tensor, accumulated value]
+
+    def leaf_add(t, v):
+        key = id(t)
+        if key in leaf_acc:
+            leaf_acc[key][1] = leaf_acc[key][1] + v
+        else:
+            leaf_acc[key] = [t, v]
+
+    tape: List[Node] = _state().tape
+    for node in reversed(tape):
+        if node.released:
+            continue
+        oids = node.out_ids
+        if not any(oid in grads for oid in oids):
+            continue
+        out_vals = []
+        for i, (oid, m) in enumerate(zip(oids, node.out_meta)):
+            if oid in grads:
+                v = grads.pop(oid)
+                live = node.outputs[i]()
+                if live is not None and live._hooks:
+                    # fan-in for this tensor is complete exactly when its
+                    # producing node is reached (consumers were created
+                    # later, hence already traversed) — the right moment
+                    # for accumulated-gradient hooks
+                    v = _fire_hooks(live, v, cg)
+                v = cast_val(v, m[1])
+                if oid in want_map:  # harvest the post-hook total
+                    j = want_map[oid]
+                    results[j] = v if results[j] is None else results[j] + v
+            else:
+                v = zeros_val(m[0], m[1])
+            out_vals.append(v)
+        if cg:
+            in_grads = _relinearize(node, tuple(out_vals))
+        else:
+            in_grads = node.vjp_fn(tuple(out_vals))
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None or inp is None or inp.stop_gradient:
+                continue
+            if (not cg) and g.dtype == jax.dtypes.float0:
+                continue  # int/bool inputs have no cotangent
+            key = id(inp)
+            if inp._node is None:
+                if want is None or key in want_map:
+                    leaf_add(inp, g)
+            else:
+                grads[key] = g if key not in grads else grads[key] + g
+        if not retain_graph:
+            node.vjp_fn = None
+            node.prim_fn = None
+            node.in_arrs = None
+            node.released = True
+
+    # seeds that are themselves leaves were never popped (no producing node)
+    for t in outputs:
+        key = id(t)
+        if key in grads and t._node is None and not t.stop_gradient:
+            if want is None or key in want_map:
+                leaf_add(t, grads.pop(key))
+
+    # finalize leaves: hooks fire on the TOTAL accumulated gradient
+    for key, (t, v) in leaf_acc.items():
+        if t._hooks:
+            v = _fire_hooks(t, v, cg)
+        if want is None:
+            _accum_leaf(t, v, cg)
+        else:
+            j = want_map[key]
+            results[j] = v if results[j] is None else results[j] + v
+
+    if want is not None:
+        # harvest residues that never reached a producing node: non-leaf
+        # seeds, and requested inputs whose producer was already released
+        # from the tape (their fan-in accumulated in `grads` but no pop
+        # point exists any more)
+        for t in want:
+            key = id(t)
+            if key in grads:
+                j = want_map[key]
+                v = grads.pop(key)
+                results[j] = v if results[j] is None else results[j] + v
+
+    if not retain_graph:
+        # free only the traversed subgraph; unrelated graphs stay intact
+        _state().tape = [n for n in _state().tape if not n.released]
+    return results
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             create_graph: bool = False):
     """Reverse-accumulate gradients from `tensors` into leaf `.grad`s.
 
-    Mirrors `egr::Backward` (`/root/reference/paddle/fluid/eager/backward.cc:794`):
-    seeds with ones (or `grad_tensors`), walks nodes in reverse, accumulates
-    fan-in, and stores into leaves whose `stop_gradient` is False.
+    Mirrors `egr::Backward` (`/root/reference/paddle/fluid/eager/backward.cc:794`).
+    With `create_graph=True` the written `.grad`s are themselves on-tape
+    (differentiable), enabling double-grad training recipes.
     """
     from .tensor import Tensor
 
@@ -137,58 +342,20 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
         grad_tensors = [None] * len(tensors)
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
-
-    grads: dict[int, jax.Array] = {}
-    for t, g in zip(tensors, grad_tensors):
-        if g is None:
-            g_arr = jnp.ones_like(t.data)
-        else:
-            g_arr = g.data if isinstance(g, Tensor) else jnp.asarray(g)
-        grads[id(t)] = grads.get(id(t), 0) + g_arr
-
-    tape: List[Node] = _state().tape
-    # Nodes already form a topological order by construction time.
-    for node in reversed(tape):
-        if node.released:
-            continue
-        oids = node.out_ids
-        if not any(oid in grads for oid in oids):
-            continue
-        # vjp_fn expects a concrete cotangent (of the recorded dtype — AMP can
-        # mix bf16/fp32 across op boundaries) for every output
-        out_grads = tuple(
-            grads.pop(oid).astype(m[1]) if oid in grads else jnp.zeros(m[0], m[1])
-            for oid, m in zip(oids, node.out_meta)
-        )
-        in_grads = node.vjp_fn(out_grads)
-        for inp, g in zip(node.inputs, in_grads):
-            if g is None or inp is None:
-                continue
-            if inp.stop_gradient:
-                continue
-            if inp._node is None:  # leaf: accumulate into .grad
-                _accum_leaf(inp, g)
-            else:
-                key = id(inp)
-                grads[key] = g if key not in grads else grads[key] + g
-        if not retain_graph:
-            node.vjp_fn = None
-            node.released = True
-
-    # remaining seeds that were themselves leaves
-    for t in tensors:
-        if id(t) in grads and t._node is None and not t.stop_gradient:
-            _accum_leaf(t, grads.pop(id(t)))
-
-    if not retain_graph:
-        # free only the traversed subgraph; unrelated graphs stay intact
-        _state().tape = [n for n in tape if not n.released]
+    _engine(tensors, grad_tensors, retain_graph=retain_graph,
+            create_graph=create_graph)
 
 
-def _accum_leaf(tensor, g: jax.Array):
+def _accum_leaf(tensor, g, create_graph: bool = False):
     from .tensor import Tensor
 
-    g = jnp.asarray(g)
+    if create_graph:
+        gt = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))
+        if gt.data.dtype != tensor.data.dtype:
+            gt = gt.astype(tensor.data.dtype)  # recorded cast: stays on-tape
+        tensor.grad = gt if tensor.grad is None else tensor.grad + gt
+        return
+    g = g.data if hasattr(g, "data") else jnp.asarray(g)
     if g.dtype != tensor.data.dtype:
         g = g.astype(tensor.data.dtype)
     if tensor.grad is None:
@@ -197,22 +364,21 @@ def _accum_leaf(tensor, g: jax.Array):
         tensor.grad = Tensor(tensor.grad.data + g, stop_gradient=True)
 
 
-def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, allow_unused=False):
-    """`paddle.grad` — gradients of outputs w.r.t. selected inputs (no .grad side effects).
+    """`paddle.grad` — gradients of outputs w.r.t. selected inputs (no
+    `.grad` side effects).
 
-    Reference: `GeneralGrad` in `/root/reference/paddle/fluid/eager/backward.cc:421`.
-    Eager-tape implementation: runs the same traversal but harvests cotangents
-    for `inputs` instead of writing leaf grads. `create_graph` (double grad) is
-    not supported on the eager tape — use `paddle_tpu.autograd.vjp`/`jvp`
-    functional APIs for higher-order gradients.
+    Reference: `GeneralGrad` in
+    `/root/reference/paddle/fluid/eager/backward.cc:421`. With
+    `create_graph=True` the returned gradients are on-tape, so a loss built
+    from them (e.g. a WGAN-GP gradient penalty) backpropagates correctly
+    through the double grad.
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph on the eager tape is unsupported; use"
-            " paddle_tpu.autograd functional transforms for higher-order grad")
+    if retain_graph is None:
+        retain_graph = create_graph
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
@@ -222,53 +388,18 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     elif not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
 
-    grads: dict[int, jax.Array] = {}
-    for t, g in zip(outputs, grad_outputs):
-        g_arr = jnp.ones_like(t.data) if g is None else (
-            g.data if isinstance(g, Tensor) else jnp.asarray(g))
-        grads[id(t)] = grads.get(id(t), 0) + g_arr
-
-    want = {id(t): i for i, t in enumerate(inputs)}
-    results: list[Optional[jax.Array]] = [None] * len(inputs)
-
-    tape: List[Node] = _state().tape
-    for node in reversed(tape):
-        oids = node.out_ids
-        if node.released or not any(oid in grads for oid in oids):
-            continue
-        out_grads = tuple(
-            grads.pop(oid).astype(m[1]) if oid in grads else jnp.zeros(m[0], m[1])
-            for oid, m in zip(oids, node.out_meta)
-        )
-        in_grads = node.vjp_fn(out_grads)
-        for inp, g in zip(node.inputs, in_grads):
-            if g is None or inp is None or inp.stop_gradient:
-                continue
-            key = id(inp)
-            if key in want:
-                i = want[key]
-                results[i] = g if results[i] is None else results[i] + g
-            if inp._node is not None:
-                grads[key] = g if key not in grads else grads[key] + g
-        if not retain_graph:
-            node.vjp_fn = None
-            node.released = True
-
-    for t in outputs:  # an output that is itself a requested input
-        if id(t) in want and id(t) in grads:
-            i = want[id(t)]
-            g = grads[id(t)]
-            results[i] = g if results[i] is None else results[i] + g
+    results = _engine(outputs, grad_outputs, retain_graph=retain_graph,
+                      create_graph=create_graph, want=inputs)
 
     out = []
-    for i, (t, g) in enumerate(zip(inputs, results)):
+    for i, g in enumerate(results):
         if g is None:
             if not allow_unused:
                 raise RuntimeError(
                     f"input {i} is unreachable from outputs (set allow_unused=True)")
             out.append(None)
+        elif create_graph:
+            out.append(g if isinstance(g, Tensor) else Tensor(g))
         else:
             out.append(Tensor(g, stop_gradient=True))
-    if not retain_graph:
-        _state().tape = [n for n in tape if not n.released]
     return out

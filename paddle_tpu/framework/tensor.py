@@ -23,9 +23,25 @@ from . import place as place_mod
 from . import tape as tape_mod
 
 
+class RemovableHandle:
+    """Unregistration handle for `Tensor.register_hook` (reference
+    `python/paddle/fluid/dygraph/varbase_patch_methods.py` TensorHookRemoveHelper)."""
+
+    __slots__ = ("_hooks", "_h")
+
+    def __init__(self, hooks, h):
+        self._hooks, self._h = hooks, h
+
+    def remove(self):
+        try:
+            self._hooks.remove(self._h)
+        except ValueError:
+            pass
+
+
 class Tensor:
     __slots__ = ("data", "stop_gradient", "grad", "_node", "name",
-                 "persistable", "dist_attr", "__weakref__")
+                 "persistable", "dist_attr", "_hooks", "__weakref__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient: bool = True,
                  name: Optional[str] = None):
@@ -51,6 +67,7 @@ class Tensor:
         self._node = None          # producing tape Node (None => leaf)
         self.name = name
         self.persistable = False
+        self._hooks = None         # gradient hooks (lazy; see register_hook)
 
     # -- metadata -----------------------------------------------------------
     @property
@@ -159,8 +176,10 @@ class Tensor:
         return self
 
     # -- autograd -----------------------------------------------------------
-    def backward(self, grad_tensor=None, retain_graph: bool = False):
-        tape_mod.backward([self], [grad_tensor], retain_graph=retain_graph)
+    def backward(self, grad_tensor=None, retain_graph: bool = False,
+                 create_graph: bool = False):
+        tape_mod.backward([self], [grad_tensor], retain_graph=retain_graph,
+                          create_graph=create_graph)
 
     def clear_grad(self):
         self.grad = None
@@ -172,7 +191,18 @@ class Tensor:
             self.grad = None
 
     def register_hook(self, hook):
-        raise NotImplementedError("tensor-level grad hooks land with the Reducer port")
+        """Register a gradient hook (`varbase_patch_methods.py:258` /
+        `imperative/gradient_accumulator.cc` hook semantics): called with
+        this tensor's fully-accumulated gradient during `backward()`; a
+        non-None return value replaces the gradient (both what propagates
+        upstream and, for leaves, what lands in `.grad`). Returns a handle
+        whose `remove()` unregisters the hook."""
+        if not callable(hook):
+            raise TypeError(f"hook must be callable, got {type(hook)}")
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        return RemovableHandle(self._hooks, hook)
 
     # -- mutation (rebinds the underlying array; used by optimizers etc.) ---
     def _rebind_(self, other: "Tensor"):

@@ -86,7 +86,10 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
             _check_nan_inf(name, outs)
         out_tensors = tuple(Tensor(o, stop_gradient=False) for o in outs)
         in_refs = [t if isinstance(t, Tensor) else None for t in tensors]
-        tape_mod.record(vjp_fn, in_refs, out_tensors, name=name)
+        # prim_fn/in_arrs make the node replayable for create_graph (double
+        # grad re-linearizes through a fresh jax.vjp — see tape._relinearize)
+        tape_mod.record(vjp_fn, in_refs, out_tensors, name=name,
+                        prim_fn=tup_impl, in_arrs=arrs)
         return out_tensors[0] if len(out_tensors) == 1 else out_tensors
     else:
         out = impl(*arrs, **kwargs)

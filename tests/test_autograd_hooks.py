@@ -1,0 +1,159 @@
+"""Tensor.register_hook + backward/grad(create_graph=True) — the imperative
+autograd edge surface (reference: test_tensor_register_hook.py,
+test_imperative_double_grad.py; engines at
+/root/reference/paddle/fluid/eager/backward.cc:421 GeneralGrad and
+python/paddle/fluid/dygraph/varbase_patch_methods.py:258 register_hook)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn import functional as F
+
+
+class TestRegisterHook:
+    def test_leaf_hook_scales_grad(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        x.register_hook(lambda g: g * 2)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * 2 * x.numpy())
+
+    def test_intermediate_hook_affects_upstream(self):
+        # hook on an intermediate modifies what flows to producers
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        h = x * 3          # dh/dx = 3
+        h.register_hook(lambda g: g * 10)
+        y = h * 5          # dy/dh = 5
+        y.backward()
+        # grad = 5 (into h) -> hook x10 -> 50 -> *3 into x = 150
+        np.testing.assert_allclose(x.grad.numpy(), [150.0])
+
+    def test_hook_fires_on_accumulated_fanin(self):
+        # the hook must see the TOTAL gradient, not one branch's share
+        seen = []
+        x = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        h = x * 1.0
+        h.register_hook(lambda g: seen.append(g.numpy().copy()))
+        y = h * 2 + h * 3   # dy/dh = 5 via two consumers
+        y.backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [5.0])
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_hook_none_return_keeps_grad(self):
+        x = paddle.to_tensor(np.array([4.0], np.float32),
+                             stop_gradient=False)
+        calls = []
+        x.register_hook(lambda g: calls.append(1))
+        (x * 2).backward()
+        assert calls == [1]
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_remove_handle(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        handle = x.register_hook(lambda g: g * 100)
+        handle.remove()
+        (x * 2).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_hook_in_training_step_clips(self):
+        # reference idiom: per-tensor clipping via hook inside a real step
+        lin = nn.Linear(4, 4)
+        lin.weight.register_hook(lambda g: g.clip(-1e-3, 1e-3))
+        opt = optimizer.SGD(learning_rate=1.0,
+                            parameters=lin.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32) * 100)
+        loss = (lin(x) ** 2).sum()
+        loss.backward()
+        assert float(np.abs(lin.weight.grad.numpy()).max()) <= 1e-3 + 1e-8
+        opt.step()
+
+
+class TestCreateGraph:
+    def test_double_grad_scalar(self):
+        # y = x^3: dy/dx = 3x^2, d2y/dx2 = 6x
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-6)
+        (ggx,) = paddle.grad(gx, x)
+        np.testing.assert_allclose(ggx.numpy(), [12.0], rtol=1e-6)
+
+    def test_double_grad_matches_numeric(self):
+        rng = np.random.default_rng(0)
+        xv = rng.normal(size=(5,)).astype(np.float32)
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        y = (x.exp() * x.sin()).sum()
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        (ggx,) = paddle.grad(gx.sum(), x)
+        # analytic: d/dx(e^x sin x) = e^x(sin+cos); d2 = e^x(2cos)
+        want = np.exp(xv) * 2 * np.cos(xv)
+        np.testing.assert_allclose(ggx.numpy(), want, rtol=1e-4, atol=1e-5)
+
+    def test_backward_create_graph_grad_is_on_tape(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = x * x
+        y.backward(create_graph=True)
+        g = x.grad          # 2x, differentiable
+        assert not g.stop_gradient
+        (gg,) = paddle.grad(g, x)
+        np.testing.assert_allclose(gg.numpy(), [2.0])
+
+    def test_gradient_penalty_trains(self):
+        """WGAN-GP-style loss: ((||d D/d x|| - 1)^2) needs grad-of-grad
+        w.r.t. the discriminator's parameters (reference
+        test_imperative_double_grad scenario)."""
+        paddle.seed(7)
+        disc = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = optimizer.Adam(learning_rate=5e-2,
+                             parameters=disc.parameters())
+        rng = np.random.default_rng(0)
+        xv = rng.normal(size=(16, 8)).astype(np.float32)
+        losses = []
+        for _ in range(12):
+            x = paddle.to_tensor(xv, stop_gradient=False)
+            out = disc(x)
+            (gx,) = paddle.grad(out.sum(), x, create_graph=True)
+            gnorm = (gx * gx).sum(axis=1).sqrt()
+            gp = ((gnorm - 1.0) ** 2).mean()
+            gp.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(gp))
+        assert losses[-1] < losses[0] * 0.5, losses
+
+    def test_grad_of_tensor_with_released_producer(self):
+        # y's producing node is freed by an earlier backward; a later
+        # paddle.grad(z, y) must still harvest dz/dy from the fresh graph
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = x * 3
+        (y * 1.0).backward()    # releases y's producer (retain_graph=False)
+        z = y * 5
+        (gy,) = paddle.grad(z, y)
+        np.testing.assert_allclose(gy.numpy(), [5.0])
+
+    def test_create_graph_through_pylayer_raises_clearly(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 2
+
+        x = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        y = Double.apply(x)
+        with pytest.raises(NotImplementedError, match="create_graph"):
+            paddle.grad(y, x, create_graph=True)

@@ -8,6 +8,8 @@ attention routes to the Pallas flash kernel when beneficial.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,7 +41,29 @@ def _act(name, fn):
     return op
 
 
-relu = _act("relu", jax.nn.relu)
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _relu_outgrad(x):
+    return jnp.maximum(x, 0)
+
+
+def _relu_outgrad_fwd(x):
+    out = jnp.maximum(x, 0)
+    # save the OUTPUT, not the input: d relu/dx = 1[out>0] exactly (same
+    # x=0 subgradient as 1[x>0]). In conv->bn->relu chains the output is
+    # the next layer's input residual and stays live anyway, so the relu
+    # INPUT (the BN result) dies at the forward fusion boundary — XLA then
+    # never materializes it, saving a write + a backward read per pair
+    # (reference analog: fused_bn_activation_op.cu keeps only y + mask)
+    return out, out
+
+
+def _relu_outgrad_bwd(out, dy):
+    return (jnp.where(out > 0, dy, jnp.zeros((), dy.dtype)),)
+
+
+_relu_outgrad.defvjp(_relu_outgrad_fwd, _relu_outgrad_bwd)
+
+relu = _act("relu", _relu_outgrad)
 relu6 = _act("relu6", jax.nn.relu6)
 silu = _act("silu", jax.nn.silu)
 swish = _act("swish", jax.nn.silu)
@@ -565,20 +589,86 @@ def _bn_infer(x, rm, rv, w, b, *, epsilon, data_format):
 
 
 @kernel("batch_norm_train")
-def _bn_train(x, w, b, *, epsilon, data_format):
+def _bn_axes(x, data_format):
     c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
     axes = tuple(i for i in range(x.ndim) if i != c_axis)
-    xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=axes)
-    var = jnp.var(xf, axis=axes)
     shape = [1] * x.ndim
     shape[c_axis] = x.shape[c_axis]
-    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    return axes, shape
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train_core(x, w, b, epsilon, data_format):
+    out, _, _ = _bn_train_fwd_impl(x, w, b, epsilon, data_format)
+    return out
+
+
+def _bn_stats(x, axes):
+    """One-pass fp32 E[x], E[x^2] statistics: both reductions read x once
+    (independent, so XLA multi-output-fuses them), vs the two-pass
+    (x-mean)^2 form whose second reduction forces another full read of x.
+    fp32 accumulation over bf16 inputs keeps the cancellation benign for
+    activation-scale data (the MLPerf ResNet BN formulation)."""
+    mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+    mean2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    return mean, var
+
+
+def _bn_train_fwd_impl(x, w, b, epsilon, data_format):
+    axes, shape = _bn_axes(x, data_format)
+    # fp32 statistics WITHOUT materializing an fp32 copy of x: the casts
+    # fuse into the reductions/normalize, so traffic stays bf16-sized
+    mean, var = _bn_stats(x, axes)
+    inv = jax.lax.rsqrt(var + epsilon)
+    out = (x.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
     if w is not None:
         out = out * w.reshape(shape).astype(jnp.float32)
     if b is not None:
         out = out + b.reshape(shape).astype(jnp.float32)
     return out.astype(x.dtype), mean, var
+
+
+def _bn_train_core_fwd(x, w, b, epsilon, data_format):
+    out, mean, var = _bn_train_fwd_impl(x, w, b, epsilon, data_format)
+    inv = jax.lax.rsqrt(var + epsilon)
+    # residuals: x by REFERENCE (it is live in HBM anyway — the conv
+    # output) + tiny per-channel stats. The pre-custom-vjp version let
+    # jax.vjp save a fresh fp32 copy of every BN input, which alone was
+    # ~10GB/step of ResNet-50 b128 HBM traffic.
+    return out, (x, w, b is None, mean, inv)
+
+
+def _bn_train_core_bwd(epsilon, data_format, res, dy):
+    x, w, b_none, mean, inv = res
+    axes, shape = _bn_axes(x, data_format)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    dyf = dy.astype(jnp.float32)
+    xhat = (x.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
+    dbeta = jnp.sum(dyf, axis=axes)
+    g = dyf if w is None else dyf * w.reshape(shape).astype(jnp.float32)
+    # classic fused BN backward: dx = inv*(g - mean(g) - xhat*mean(g*xhat))
+    gm = jnp.sum(g, axis=axes) / n
+    gxm = jnp.sum(g * xhat, axis=axes) / n
+    dx = inv.reshape(shape) * (g - gm.reshape(shape)
+                               - xhat * gxm.reshape(shape))
+    dw = None if w is None else jnp.sum(dyf * xhat, axis=axes).astype(w.dtype)
+    db = None if b_none else dbeta
+    return dx.astype(x.dtype), dw, db
+
+
+_bn_train_core.defvjp(_bn_train_core_fwd, _bn_train_core_bwd)
+
+
+def _bn_train(x, w, b, *, epsilon, data_format):
+    out = _bn_train_core(x, w, b, epsilon, data_format)
+    axes, _ = _bn_axes(x, data_format)
+    # running-stat updates reuse the same fused reductions (identical
+    # subgraphs to the core's; XLA CSEs them within one program)
+    mean, var = _bn_stats(x, axes)
+    return out, mean, var
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,

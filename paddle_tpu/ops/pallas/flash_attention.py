@@ -217,7 +217,11 @@ def _fa_fwd_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def _compute():
+    def _compute(causal_band):
+        # `causal_band` False = block proven fully below the diagonal, so
+        # the iota/compare/select per-element mask work is skipped — at
+        # D=64 this kernel is VPU-bound, and the interior blocks are the
+        # majority, so the triangle math is only paid where it matters
         qb = q_ref[...]
         kb = k_ref[...]
         vb = v_ref[...]
@@ -227,19 +231,25 @@ def _fa_fwd_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
             kb = _zero_tail_rows(kb, j * block_k, kv_len)
             vb = _zero_tail_rows(vb, j * block_k, kv_len)
         s = _dotT(qb, kb) * scale  # f32 [bq, bk]
-        rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s, masked = _apply_mask(
-            s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len, causal,
-            kv_offset, need_tail_q=q_len % block_q != 0,
-            need_tail_k=kv_len % block_k != 0)
+        masked = False
+        if has_mask or causal_band or q_len % block_q or kv_len % block_k:
+            rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s, masked = _apply_mask(
+                s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len,
+                causal_band, kv_offset, need_tail_q=q_len % block_q != 0,
+                need_tail_k=kv_len % block_k != 0)
         m_prev = m_ref[...][:, :1]            # [bq, 1]
         l_prev = l_ref[...][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if masked:
+        if masked and (has_mask or q_len % block_q or kv_len % block_k):
             # a fully-masked row has m_new == s == _NEG -> exp(0) == 1 for
-            # every masked column; zero them explicitly
+            # every masked column; zero them explicitly. Pure-causal rows
+            # never need this: every row's first valid column lives in an
+            # EARLIER block (iteration order j=0,1,...), so by the time a
+            # row is all-floor in this block, m_prev is real and
+            # exp(_NEG - m_prev) underflows to exactly 0 in f32.
             p = jnp.where(s > 0.5 * _NEG, p, 0.0)
         corr = jnp.exp(m_prev - m_new)        # [bq, 1]
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
@@ -248,11 +258,16 @@ def _fa_fwd_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     if causal:
-        # whole block above the diagonal contributes nothing — skip compute
-        last_row = i * block_q + kv_offset + block_q - 1
-        pl.when(last_row >= j * block_k)(_compute)
+        # whole block above the diagonal contributes nothing — skip compute;
+        # blocks fully below it need no triangle masking at all
+        first_row = i * block_q + kv_offset
+        last_row = first_row + block_q - 1
+        active = last_row >= j * block_k
+        interior = first_row >= (j + 1) * block_k - 1
+        pl.when(active & interior)(lambda: _compute(False))
+        pl.when(active & jnp.logical_not(interior))(lambda: _compute(True))
     else:
-        _compute()
+        _compute(False)
 
     @pl.when(j == n_k - 1)
     def _finalize():
@@ -285,7 +300,7 @@ def _fa_bwd_dq_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
     def _init():
         dqacc_ref[...] = jnp.zeros_like(dqacc_ref)
 
-    def _compute():
+    def _compute(causal_band):
         qb = q_ref[...]
         kb = k_ref[...]
         vb = v_ref[...]
@@ -297,16 +312,22 @@ def _fa_bwd_dq_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
             kb = _zero_tail_rows(kb, j * block_k, kv_len)
             vb = _zero_tail_rows(vb, j * block_k, kv_len)
         s = _dotT(qb, kb) * scale
-        rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s, masked = _apply_mask(
-            s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len, causal,
-            kv_offset, need_tail_q=q_len % block_q != 0,
-            need_tail_k=kv_len % block_k != 0)
+        masked = False
+        need_iota = (has_mask or causal_band or q_len % block_q
+                     or kv_len % block_k)
+        if need_iota:
+            rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s, masked = _apply_mask(
+                s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len,
+                causal_band, kv_offset, need_tail_q=q_len % block_q != 0,
+                need_tail_k=kv_len % block_k != 0)
         lse = lse_ref[...][:, :1]
         delta = delta_ref[...][:, :1]
         p = jnp.exp(s - lse)                 # [bq, bk]
-        if masked:
+        if masked and (has_mask or q_len % block_q or kv_len % block_k):
+            # pure-causal needs no select: lse is the row's REAL logsumexp,
+            # so exp(_NEG - lse) underflows to exactly 0
             p = jnp.where(s > 0.5 * _NEG, p, 0.0)
         dp = _dotT(dob, vb)
         ds = p * (dp - delta)
@@ -316,10 +337,14 @@ def _fa_bwd_dq_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
         dqacc_ref[...] = dqacc_ref[...] + _dot(ds.astype(kb.dtype), kb) * scale
 
     if causal:
-        last_row = i * block_q + kv_offset + block_q - 1
-        pl.when(last_row >= j * block_k)(_compute)
+        first_row = i * block_q + kv_offset
+        last_row = first_row + block_q - 1
+        active = last_row >= j * block_k
+        interior = first_row >= (j + 1) * block_k - 1
+        pl.when(active & interior)(lambda: _compute(False))
+        pl.when(active & jnp.logical_not(interior))(lambda: _compute(True))
     else:
-        _compute()
+        _compute(False)
 
     @pl.when(j == n_k - 1)
     def _finalize():
@@ -347,7 +372,7 @@ def _fa_bwd_dkv_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
         dkacc_ref[...] = jnp.zeros_like(dkacc_ref)
         dvacc_ref[...] = jnp.zeros_like(dvacc_ref)
 
-    def _compute():
+    def _compute(causal_band):
         qb = q_ref[...]
         kb = k_ref[...]
         vb = v_ref[...]
@@ -359,18 +384,23 @@ def _fa_bwd_dkv_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
             kb = _zero_tail_rows(kb, ki * block_k, kv_len)
             vb = _zero_tail_rows(vb, ki * block_k, kv_len)
         s = _dotT(qb, kb) * scale            # [bq, bk]
-        rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s, masked = _apply_mask(
-            s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len, causal,
-            kv_offset, need_tail_q=q_len % block_q != 0,
-            need_tail_k=kv_len % block_k != 0)
+        masked = False
+        need_iota = (has_mask or causal_band or q_len % block_q
+                     or kv_len % block_k)
+        if need_iota:
+            rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s, masked = _apply_mask(
+                s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len,
+                causal_band, kv_offset, need_tail_q=q_len % block_q != 0,
+                need_tail_k=kv_len % block_k != 0)
         lse = lse_ref[...][:, :1]
         delta = delta_ref[...][:, :1]
         p = jnp.exp(s - lse)
-        if masked or q_len % block_q:
+        if (masked and (has_mask or kv_len % block_k)) or q_len % block_q:
             # tail q rows carry garbage lse/delta: 0 * nan == nan, so the
-            # row guard must zero p/ds explicitly, not rely on s == _NEG
+            # row guard must zero p/ds explicitly, not rely on s == _NEG.
+            # Pure-causal needs no select (real lse -> exact underflow).
             rowmask = rows < q_len
             p = jnp.where((s > 0.5 * _NEG) & rowmask, p, 0.0)
         dvacc_ref[...] = dvacc_ref[...] + _dot(p.astype(dob.dtype).T, dob)
@@ -382,11 +412,16 @@ def _fa_bwd_dkv_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
             ds.astype(qb.dtype).T, qb) * scale
 
     if causal:
-        # q-blocks strictly above this k-block's diagonal see nothing
-        last_row = (j + 1) * block_q - 1 + kv_offset
-        pl.when(last_row >= ki * block_k)(_compute)
+        # q-blocks strictly above this k-block's diagonal see nothing;
+        # q-blocks fully below it need no triangle masking at all
+        first_row = j * block_q + kv_offset
+        last_row = first_row + block_q - 1
+        active = last_row >= ki * block_k
+        interior = first_row >= (ki + 1) * block_k - 1
+        pl.when(active & interior)(lambda: _compute(False))
+        pl.when(active & jnp.logical_not(interior))(lambda: _compute(True))
     else:
-        _compute()
+        _compute(False)
 
     @pl.when(j == n_q - 1)
     def _finalize():
@@ -676,6 +711,161 @@ def _fa_fwd_pallas(q, k, v, mask, causal, scale, mask_is_bool=False,
     return jnp.swapaxes(out, 1, 2), lse[..., 0]
 
 
+def _fa_bwd_fused_kernel(*refs, scale, causal, has_mask, mask_is_bool,
+                         block_q, block_k, q_len, kv_len, kv_offset,
+                         n_q, n_k):
+    """Single-pass backward: grid (B, H, k-blocks, q-blocks).
+
+    The two-kernel split (dq walks k, dk/dv walk q) recomputes the score
+    block and its softmax TWICE; at D=64 the kernels are VPU-bound, so
+    that duplication is the dominant backward cost. Here p/ds are computed
+    once per (k-block, q-block): dk/dv accumulate in per-k-block scratch,
+    dq accumulates into a whole-(b,h) [Lq, D] f32 VMEM scratch indexed by
+    the inner q-block (fits VMEM for the grid path's sequence lengths; the
+    caller falls back to the split kernels when it would not). The dq
+    OUTPUT block is rewritten every step — partial sums flushed at
+    ki < n_k-1 land in HBM and are overwritten by the complete sums of
+    the final ki pass (harmless extra writes, never read)."""
+    from jax.experimental import pallas as pl
+
+    if has_mask:
+        mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:7]
+        rest = refs[7:]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+        rest = refs[6:]
+        mask_ref = None
+    dq_ref, dk_ref, dv_ref, dkacc_ref, dvacc_ref, dqacc_ref = rest
+
+    ki = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when((ki == 0) & (j == 0))
+    def _init_dq():
+        dqacc_ref[...] = jnp.zeros_like(dqacc_ref)
+
+    @pl.when(j == 0)
+    def _init_kv():
+        dkacc_ref[...] = jnp.zeros_like(dkacc_ref)
+        dvacc_ref[...] = jnp.zeros_like(dvacc_ref)
+
+    def _compute(causal_band):
+        qb = q_ref[...]
+        kb = k_ref[...]
+        vb = v_ref[...]
+        dob = do_ref[...]
+        if q_len % block_q:
+            qb = _zero_tail_rows(qb, j * block_q, q_len)
+            dob = _zero_tail_rows(dob, j * block_q, q_len)
+        if kv_len % block_k:
+            kb = _zero_tail_rows(kb, ki * block_k, kv_len)
+            vb = _zero_tail_rows(vb, ki * block_k, kv_len)
+        s = _dotT(qb, kb) * scale            # [bq, bk]
+        masked = False
+        need_iota = (has_mask or causal_band or q_len % block_q
+                     or kv_len % block_k)
+        if need_iota:
+            rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s, masked = _apply_mask(
+                s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len,
+                causal_band, kv_offset, need_tail_q=q_len % block_q != 0,
+                need_tail_k=kv_len % block_k != 0)
+        lse = lse_ref[...][:, :1]
+        delta = delta_ref[...][:, :1]
+        p = jnp.exp(s - lse)
+        if (masked and (has_mask or kv_len % block_k)) or q_len % block_q:
+            rowmask = rows < q_len
+            p = jnp.where((s > 0.5 * _NEG) & rowmask, p, 0.0)
+        dvacc_ref[...] = dvacc_ref[...] + _dot(p.astype(dob.dtype).T, dob)
+        dp = _dotT(dob, vb)
+        ds = p * (dp - delta)
+        if q_len % block_q:
+            ds = jnp.where(rows < q_len, ds, 0.0)
+        dkacc_ref[...] = dkacc_ref[...] + _dot(
+            ds.astype(qb.dtype).T, qb) * scale
+        sl = pl.ds(j * block_q, block_q)
+        dqacc_ref[sl, :] = dqacc_ref[sl, :] + _dot(
+            ds.astype(kb.dtype), kb) * scale
+
+    if causal:
+        first_row = j * block_q + kv_offset
+        last_row = first_row + block_q - 1
+        active = last_row >= ki * block_k
+        interior = first_row >= (ki + 1) * block_k - 1
+        pl.when(active & interior)(lambda: _compute(False))
+        pl.when(active & jnp.logical_not(interior))(lambda: _compute(True))
+    else:
+        _compute(False)
+
+    # every step: flush this q-block's running dq total (see docstring)
+    dq_ref[...] = dqacc_ref[pl.ds(j * block_q, block_q), :].astype(
+        dq_ref.dtype)
+
+    @pl.when(j == n_q - 1)
+    def _finalize():
+        dk_ref[...] = dkacc_ref[...].astype(dk_ref.dtype)
+        dv_ref[...] = dvacc_ref[...].astype(dv_ref.dtype)
+
+
+# dq slice scratch cap for the fused backward: [ceil(Lq), D] f32 must
+# coexist with the block buffers in ~16MB VMEM
+_FUSED_BWD_DQ_BYTES = 6 * 1024 * 1024
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "mask_is_bool", "interpret"))
+def _fa_bwd_fused_pallas(q, k, v, out, lse, do, mask, causal, scale,
+                         mask_is_bool=False, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    block_q, block_k = _pick_blocks(Lq, Lk)
+    qt, kt, vt, dot_, ot = (jnp.swapaxes(x, 1, 2)
+                            for x in (q, k, v, do, out))
+    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32), -1)
+    lse_p = jnp.broadcast_to(lse[..., None], (B, H, Lq, _STATS_LANES))
+    delta_p = jnp.broadcast_to(delta[..., None], (B, H, Lq, _STATS_LANES))
+
+    n_q, n_k = pl.cdiv(Lq, block_q), pl.cdiv(Lk, block_k)
+    Lq_pad = _ceil_to(Lq, block_q)
+
+    qwalk = pl.BlockSpec((None, None, block_q, D),
+                         lambda b, h, i, j: (b, h, j, 0))
+    kspec = pl.BlockSpec((None, None, block_k, D),
+                         lambda b, h, i, j: (b, h, i, 0))
+    rowqw = pl.BlockSpec((None, None, block_q, _STATS_LANES),
+                         lambda b, h, i, j: (b, h, j, 0))
+    in_specs = [qwalk, kspec, kspec, qwalk, rowqw, rowqw]
+    args = [qt, kt, vt, dot_, lse_p, delta_p]
+    if mask is not None:
+        in_specs.insert(0, _mask_spec(mask, block_q, block_k,
+                                      q_axis=3, k_axis=2))
+        args.insert(0, mask)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_fused_kernel, scale=scale, causal=causal,
+            has_mask=mask is not None, mask_is_bool=mask_is_bool,
+            block_q=block_q, block_k=block_k, q_len=Lq, kv_len=Lk,
+            kv_offset=Lk - Lq, n_q=n_q, n_k=n_k),
+        grid=(B, H, n_k, n_q),
+        in_specs=in_specs,
+        out_specs=[qwalk, kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Lq, D), q.dtype),
+                   jax.ShapeDtypeStruct((B, H, Lk, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Lk, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((Lq_pad, D), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(*args)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
+
+
 @functools.partial(jax.jit, static_argnames=(
     "causal", "scale", "mask_is_bool", "interpret"))
 def _fa_bwd_pallas(q, k, v, out, lse, do, mask, causal, scale,
@@ -766,8 +956,12 @@ def _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret):
 def _bwd_any(q, k, v, out, lse, do, mask, causal, scale, mask_is_bool,
              interpret):
     B, Lq, H, D = q.shape
-    f = (_fa_small_bwd_pallas if _use_small_path(Lq, k.shape[1], H, D, mask)
-         else _fa_bwd_pallas)
+    if _use_small_path(Lq, k.shape[1], H, D, mask):
+        f = _fa_small_bwd_pallas
+    elif Lq * D * 4 <= _FUSED_BWD_DQ_BYTES:
+        f = _fa_bwd_fused_pallas  # one-pass p/ds; dq slice fits VMEM
+    else:
+        f = _fa_bwd_pallas        # very long seq: split dq / dkv walks
     return f(q, k, v, out, lse, do, mask, causal, scale,
              mask_is_bool=mask_is_bool, interpret=interpret)
 

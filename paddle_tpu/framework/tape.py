@@ -184,10 +184,15 @@ def _relinearize(node, cots):
         gs = f_vjp(tuple(c.astype(o.dtype) for c, o in zip(cots_, outs_)))
         return tuple(gs[i] for i in diff_idx)
 
+    # tape connectivity routes through the LIVE input Tensors, but the replay
+    # VALUES are the recorded primal arrays: a parameter whose .data was
+    # rebound (optimizer step) between forward and this create_graph backward
+    # must not silently change the double-grad linearization point
     prim_inputs = [t if t is not None else a
                    for t, a in zip(node.inputs, node.in_arrs)]
     outs = _dispatch.call(vjp_call, [*prim_inputs, *cots],
-                          name=f"{node.name}_grad")
+                          name=f"{node.name}_grad",
+                          override_arrs=node.in_arrs)
     outs = outs if isinstance(outs, tuple) else (outs,)
     full = [None] * n_in
     for i, g in zip(diff_idx, outs):

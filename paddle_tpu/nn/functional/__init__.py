@@ -588,7 +588,6 @@ def _bn_infer(x, rm, rv, w, b, *, epsilon, data_format):
     return out.astype(x.dtype)
 
 
-@kernel("batch_norm_train")
 def _bn_axes(x, data_format):
     c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
     axes = tuple(i for i in range(x.ndim) if i != c_axis)
@@ -662,6 +661,7 @@ def _bn_train_core_bwd(epsilon, data_format, res, dy):
 _bn_train_core.defvjp(_bn_train_core_fwd, _bn_train_core_bwd)
 
 
+@kernel("batch_norm_train")
 def _bn_train(x, w, b, *, epsilon, data_format):
     out = _bn_train_core(x, w, b, epsilon, data_format)
     axes, _ = _bn_axes(x, data_format)

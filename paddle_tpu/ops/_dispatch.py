@@ -59,18 +59,27 @@ def _wants_grad(x) -> bool:
 
 
 def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
-         name: Optional[str] = None, nondiff: bool = False):
+         name: Optional[str] = None, nondiff: bool = False,
+         override_arrs: Optional[tuple] = None):
     """Run `impl(*arrays, **kwargs)` with eager autograd bookkeeping.
 
     `tensors` are the (potentially differentiable) data inputs; `kwargs` are
     static attributes closed over the vjp. Returns Tensor or tuple of Tensors
-    (matching impl's return structure).
+    (matching impl's return structure). `override_arrs`, when given, supplies
+    the VALUES for the first len(override_arrs) inputs in place of their
+    current `.data` — the tensors still provide tape connectivity (used by
+    create_graph replay, which must see the RECORDED primal even if an
+    optimizer has since rebound the parameter's data).
     """
     kwargs = kwargs or {}
     name = name or getattr(impl, "_op_name", impl.__name__)
     if GRAPH_BUILDER is not None:
         return GRAPH_BUILDER(impl, tensors, kwargs, name)
-    arrs = tuple(_unwrap(t) for t in tensors)
+    if override_arrs is not None:
+        arrs = tuple(override_arrs) + tuple(
+            _unwrap(t) for t in tensors[len(override_arrs):])
+    else:
+        arrs = tuple(_unwrap(t) for t in tensors)
 
     arrs = _maybe_autocast(name, arrs)
 

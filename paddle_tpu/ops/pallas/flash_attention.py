@@ -648,14 +648,21 @@ def _mask_spec(mask, block_q, block_k, *, q_axis, k_axis):
     return pl.BlockSpec(bdims, index)
 
 
-def _compiler_params(interpret):
+def _compiler_params(interpret, n_arbitrary=1):
+    """Grid semantics: trailing `n_arbitrary` dims carry cross-iteration
+    scratch state and must stay ARBITRARY. The fused backward needs
+    n_arbitrary=2: dqacc accumulates across dim 2 (k-blocks) and dk/dv
+    across dim 3 (q-blocks) — marking dim 2 PARALLEL would let megacore
+    TPUs (v4/v5p) split it across TensorCores with per-core scratch,
+    losing dq partials."""
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret:
         return None
     P = pltpu.GridDimensionSemantics.PARALLEL
     A = pltpu.GridDimensionSemantics.ARBITRARY
-    return pltpu.CompilerParams(dimension_semantics=(P, P, P, A))
+    sem = (P,) * (4 - n_arbitrary) + (A,) * n_arbitrary
+    return pltpu.CompilerParams(dimension_semantics=sem)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -859,7 +866,7 @@ def _fa_bwd_fused_pallas(q, k, v, out, lse, do, mask, causal, scale,
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((Lq_pad, D), jnp.float32)],
-        compiler_params=_compiler_params(interpret),
+        compiler_params=_compiler_params(interpret, n_arbitrary=2),
         interpret=interpret,
     )(*args)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
@@ -1072,6 +1079,12 @@ def _pallas_eligible(q, k, v, mask, causal) -> bool:
         return False
     if q.dtype == jnp.dtype(jnp.float16):
         return False  # fp16 softmax floor handling lives on the XLA path
+    if causal and Lq > Lk:
+        # kv_offset < 0: top query rows have ZERO valid key columns, and the
+        # kernels' pure-causal fast path skips the fully-masked-row p-zeroing
+        # (fwd would emit an average of V; bwd lse for such rows is garbage).
+        # flash_attention_xla handles the empty-row case correctly.
+        return False
     if mask is not None:
         if mask.ndim != 4:
             return False

@@ -223,11 +223,21 @@ def bench_wide_deep_ps():
     import subprocess
     import sys
 
+    # BOTH the env var and the config update, set before any backend can
+    # initialize: against the axon plugin only the ENV VAR sticks —
+    # jax.config.update alone still binds the TPU (verified live in the r4
+    # review, where this child silently measured tunnel latency and
+    # reported it as PS throughput). The child re-asserts the platform and
+    # emits it in the JSON so a regression here can never be silent again.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
     code = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu';"
         "import jax; jax.config.update('jax_platforms','cpu');"
         "import bench, json; print('WDJSON'+json.dumps(bench._wide_deep_ps_body()))")
     proc = subprocess.run([sys.executable, "-c", code],
                           cwd=os.path.dirname(os.path.abspath(__file__)),
+                          env=env,
                           capture_output=True, text=True, timeout=900)
     if proc.returncode != 0:
         # a crash during teardown (e.g. a PS shutdown regression) must not
@@ -242,12 +252,17 @@ def bench_wide_deep_ps():
 
 
 def _wide_deep_ps_body():
+    import jax
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import nn, optimizer
     from paddle_tpu.distributed.ps import PSServer, PSClient
     from paddle_tpu.models.wide_deep import WideDeep
 
+    platform = jax.devices()[0].platform
+    assert platform == "cpu", (
+        f"PS trainer bench must run on host CPU, got {platform!r}: the "
+        "CPU-forcing failed and the number would measure tunnel latency")
     B, SLOTS, VOCAB = 512, 8, 1_000_000
     server = PSServer(0)
     client = PSClient([server.endpoint])
@@ -287,6 +302,7 @@ def _wide_deep_ps_body():
             "examples_per_sec": round(B * iters / dt, 1),
             "step_time_ms": round(1000 * dt / iters, 2),
             "final_loss": round(final, 4),
+            "platform": platform,
         }
     finally:
         client.stop_servers()
@@ -315,7 +331,10 @@ def bench_wide_deep_ps_tpu():
         opt = optimizer.Adam(learning_rate=1e-3,
                              parameters=model.parameters())
         crit = nn.BCEWithLogitsLoss()
-        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt)
+        # async mode: push RPC + grad device->host copy overlap the chip
+        # executing the next step (reference a_sync communicator semantics)
+        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt,
+                                mode="async")
         rng = np.random.default_rng(0)
 
         def batch():
@@ -332,18 +351,26 @@ def bench_wide_deep_ps_tpu():
             step(ids, dense, labels)
         t0 = time.perf_counter()
         iters = 30
-        for i in range(iters):
-            ids, dense, labels = data[i % len(data)]
-            loss = step(ids, dense, labels)
+        try:
+            for i in range(iters):
+                ids, dense, labels = data[i % len(data)]
+                loss = step(ids, dense, labels)
+            step.flush()
+        finally:
+            # join the push worker BEFORE stop_servers: an in-flight push
+            # racing server shutdown can wedge interpreter exit
+            step.close()
         final = float(loss)
         dt = time.perf_counter() - t0
+        import jax
         return {
             "name": f"wide&deep heter-PS b{B} x {SLOTS} slots "
                     f"(1M-feasign space, native host PS + compiled "
-                    f"on-chip dense step)",
+                    f"on-chip dense step, async push overlap)",
             "examples_per_sec": round(B * iters / dt, 1),
             "step_time_ms": round(1000 * dt / iters, 2),
             "final_loss": round(final, 4),
+            "platform": jax.devices()[0].platform,
         }
     finally:
         client.stop_servers()

@@ -1,11 +1,14 @@
 """Backend-platform pinning for child processes.
 
-Accelerator plugins (axon) override the ``JAX_PLATFORMS`` env var at
-registration time, so a subprocess spawned with ``JAX_PLATFORMS=cpu`` can
-still bind the real TPU — and hang forever when the chip is unhealthy
-(this wedged the round-3 bench: a leaked test child held the chip for 21h).
-``jax.config.update("jax_platforms", ...)`` sticks where the env var is
-ignored, but it must run before any backend initializes.
+Pin with BOTH the ``JAX_PLATFORMS`` env var and
+``jax.config.update("jax_platforms", ...)``, before any backend
+initializes. Empirically (verified live against the axon plugin in the r4
+review) the ENV VAR is the mechanism that actually wins: a process that
+only calls ``jax.config.update`` still binds the real TPU, while one with
+the env var set runs truly on CPU. An unhealthy chip then hangs forever
+(this wedged the round-3 bench: a leaked test child held the chip for 21h),
+so every CPU-forcing path must put the env var in the child's environment
+and may add the config update as belt-and-suspenders.
 
 Every process-spawning path in the framework (DataLoader workers,
 ``paddle.distributed.spawn`` workers, test cluster scripts) calls

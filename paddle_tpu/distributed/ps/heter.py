@@ -31,12 +31,24 @@ Per step:
    `push_sparse` RPC per table; the server-side rule (sgd/adagrad/adam in
    `_native/csrc/ps.cc`) applies the sparse update.
 
-Semantics are SYNCHRONOUS-mode PS (the reference's sync trainer): each
-step's pushes land before the next step's pulls, so the compiled path is
-loss-for-loss identical to the eager PS loop (tested). The host therefore
-blocks on the row gradients at the end of every step — asynchronous /
-geo staleness belongs to the communicator layer (communicator.py), not
-this step.
+Two modes (reference: sync vs a_sync trainers,
+`ps/service/communicator/communicator.h:402,537`):
+
+- ``mode="sync"`` (default) — each step's pushes land before the next
+  step's pulls; loss-for-loss identical to the eager PS loop (tested).
+  The host blocks on the row gradients at the end of every step.
+- ``mode="async"`` — software-pipelined: route/pull for step *i* happens
+  BEFORE step *i-1*'s push is drained, and the push RPC + gradient
+  device→host transfer overlap the chip executing step *i* (jax dispatch
+  is asynchronous). Pulls may miss the single outstanding push (staleness
+  ≤ 1 step) — precisely the reference's a_sync communicator contract,
+  where background threads batch pushes while workers keep pulling.
+  Call :meth:`flush` before reading final state.
+
+Routing additionally runs on the host CPU backend when one is visible:
+the ids are a trivial function of the batch, and compiling the router for
+the accelerator would cost a host↔chip round trip per step just to learn
+which rows to pull (the r4 heter bench was latency-bound on exactly that).
 """
 from __future__ import annotations
 
@@ -79,11 +91,20 @@ class HeterPSTrainStep:
     reference's DownpourWorker split."""
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True):
+                 donate: bool = True, mode: str = "sync"):
         from ...jit import functionalize
         from .embedding import SparseEmbedding
 
+        assert mode in ("sync", "async"), mode
         self.layer = model
+        self.mode = mode
+        self._pending = None  # async mode: (grows, push_meta) not yet pushed
+        self._push_fut = None
+        self._push_pool = None  # lazy single worker: pushes stay ordered
+        try:
+            self._cpu_dev = jax.devices("cpu")[0]
+        except Exception:
+            self._cpu_dev = None
         self.optimizer = optimizer
         self._embeddings: List[SparseEmbedding] = [
             m for _, m in model.named_sublayers()
@@ -155,7 +176,15 @@ class HeterPSTrainStep:
             self._router = jax.jit(route)
         _ROUTE.plan = []
         try:
-            ids = self._router(self.params, self.buffers, *arrs)
+            if self._cpu_dev is not None:
+                # ids are a function of the batch alone (params/buffers are
+                # unused jit args, dropped at trace, hence never transferred)
+                # — compile + run the router on host CPU so learning which
+                # rows to pull never round-trips the accelerator tunnel
+                with jax.default_device(self._cpu_dev):
+                    ids = self._router(self.params, self.buffers, *arrs)
+            else:
+                ids = self._router(self.params, self.buffers, *arrs)
             if _ROUTE.plan:  # a (re)trace ran: adopt the fresh plan
                 self._plan = list(_ROUTE.plan)
         finally:
@@ -166,14 +195,14 @@ class HeterPSTrainStep:
         return ids
 
     # -- one training step --------------------------------------------------
-    def __call__(self, *batch):
-        self._t += 1
-        arrs = tuple(a.data if isinstance(a, Tensor) else jnp.asarray(a)
-                     for a in batch)
-        ids_list = self._route(arrs)
-
+    def _pull(self, ids_list):
+        # ONE batched device->host fetch for every table's ids: per-array
+        # np.asarray costs a full dispatch round trip EACH (~120ms over a
+        # TPU tunnel, ~1s/step at 8 tables — the r4 heter bench's actual
+        # bottleneck), while device_get transfers the whole tuple in one
+        ids_host = jax.device_get(tuple(ids_list))
         rows_list, inv_list, push_meta = [], [], []
-        for ids, (emb, shape) in zip(ids_list, self._plan):
+        for ids, (emb, shape) in zip(ids_host, self._plan):
             flat = np.asarray(ids).reshape(-1).astype(np.uint64)
             uniq, inverse = np.unique(flat, return_inverse=True)
             n = uniq.size
@@ -181,9 +210,74 @@ class HeterPSTrainStep:
             rows = emb.client.pull_sparse(emb._table_cfg.table_id, uniq)
             rows_p = np.zeros((U, emb._dim), np.float32)
             rows_p[:n] = rows
-            rows_list.append(jnp.asarray(rows_p))
-            inv_list.append(jnp.asarray(inverse.astype(np.int32)))
+            rows_list.append(rows_p)
+            inv_list.append(inverse.astype(np.int32))
             push_meta.append((emb, uniq))
+        # one batched host->device transfer for the pulled rows + inverses
+        rows_list, inv_list = jax.device_put((tuple(rows_list),
+                                              tuple(inv_list)))
+        return list(rows_list), list(inv_list), push_meta
+
+    def _push(self, grows, push_meta):
+        # batched fetch (blocks until the producing step finishes on device)
+        grows_host = jax.device_get(tuple(grows))
+        for g, (emb, uniq) in zip(grows_host, push_meta):
+            merged = np.asarray(g, dtype=np.float32)[:uniq.size]
+            emb.client.push_sparse(emb._table_cfg.table_id, uniq, merged)
+
+    def _drain_fut(self):
+        if self._push_fut is not None:
+            fut, self._push_fut = self._push_fut, None
+            fut.result()  # propagate background push errors
+
+    def flush(self):
+        """Async mode: land the outstanding push (no-op when none/sync)."""
+        self._drain_fut()
+        if self._pending is not None:
+            grows, meta = self._pending
+            self._pending = None
+            self._push(grows, meta)
+
+    def close(self):
+        """Teardown: best-effort land outstanding pushes, then join the
+        worker thread. Safe on the error path BEFORE stopping the PS —
+        otherwise an in-flight background push races server shutdown and
+        the non-daemon executor thread can wedge interpreter exit."""
+        try:
+            self.flush()
+        except Exception:
+            self._pending = None  # teardown must not mask the original error
+        if self._push_pool is not None:
+            self._push_pool.shutdown(wait=True)
+            self._push_pool = None
+
+    def __del__(self):
+        try:
+            if self._push_pool is not None:
+                self._push_pool.shutdown(wait=True)
+        except Exception:
+            pass
+
+    def __call__(self, *batch):
+        self._t += 1
+        arrs = tuple(a.data if isinstance(a, Tensor) else jnp.asarray(a)
+                     for a in batch)
+        if self.mode == "sync":
+            self.flush()  # defensive: a mode flip mid-run must not drop grads
+        elif self._pending is not None:
+            # hand last step's push to the single worker thread NOW: its
+            # grad fetch + push RPC run concurrently with this step's route
+            # fetch + pull RPC (the C++ client serializes per-connection
+            # requests under a mutex; ctypes releases the GIL)
+            import concurrent.futures
+            if self._push_pool is None:
+                self._push_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1)
+            self._drain_fut()  # at most ONE background push in flight
+            prev, self._pending = self._pending, None
+            self._push_fut = self._push_pool.submit(self._push, *prev)
+        ids_list = self._route(arrs)
+        rows_list, inv_list, push_meta = self._pull(ids_list)
 
         rng = random_mod.default_generator().split()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
@@ -192,13 +286,19 @@ class HeterPSTrainStep:
             self.params, self.buffers, self.opt_state, tuple(rows_list),
             tuple(inv_list), rng, lr, self._t, *arrs)
 
-        for g, (emb, uniq) in zip(grows, push_meta):
-            merged = np.asarray(g, dtype=np.float32)[:uniq.size]
-            emb.client.push_sparse(emb._table_cfg.table_id, uniq, merged)
+        if self.mode == "async":
+            # dispatch is asynchronous: the chip is now executing step t;
+            # its push drains at the START of call t+1, overlapped with
+            # that call's route/pull (staleness <= 1 step — the reference
+            # a_sync communicator contract)
+            self._pending = (grows, push_meta)
+        else:
+            self._push(grows, push_meta)
         return Tensor(loss)
 
     # -- state --------------------------------------------------------------
     def sync_to_layer(self):
+        self.flush()
         named = dict(self.layer.named_parameters())
         for k, v in self.params.items():
             named[k].data = v

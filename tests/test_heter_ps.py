@@ -160,6 +160,43 @@ class TestHeterPSTrainStep:
                         paddle.to_tensor(dense), paddle.to_tensor(y)))
         assert l1 < l0  # one id's row received the merged gradient
 
+    def test_async_mode_converges_and_flushes(self, ps):
+        """mode="async" pipelines the push one step behind (reference
+        a_sync communicator staleness): it must still converge on the
+        learnable task, and flush() must land the final outstanding push."""
+        rng = np.random.default_rng(3)
+        vocab = 16
+        ids_all = rng.integers(0, vocab, (256, 4))
+        dense_all = rng.normal(size=(256, 4)).astype(np.float32)
+        y_all = ((ids_all[:, 0] < vocab // 2)).astype(np.float32)[:, None]
+        model = _model(ps)
+        opt = optimizer.Adam(learning_rate=5e-2,
+                             parameters=model.parameters())
+        crit = nn.BCEWithLogitsLoss()
+        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt,
+                                mode="async")
+        losses = []
+        for ep in range(12):
+            for s in range(0, 256, 64):
+                losses.append(float(step(
+                    paddle.to_tensor(ids_all[s:s + 64].astype(np.int64)),
+                    paddle.to_tensor(dense_all[s:s + 64]),
+                    paddle.to_tensor(y_all[s:s + 64]))))
+        assert losses[-1] < 0.35, (losses[0], losses[-1])
+        # one push is still outstanding; flush must change server rows.
+        # Drain the in-flight BACKGROUND push first — it touches the same
+        # small vocab and could land between the two reads, masking a
+        # flush() that drops the pending push.
+        step._drain_fut()
+        emb = model.embeddings[0]
+        keys = np.unique(ids_all[192:, 0]).astype(np.uint64)
+        before = emb.client.pull_sparse(emb._table_cfg.table_id, keys).copy()
+        assert step._pending is not None
+        step.flush()
+        assert step._pending is None
+        after = emb.client.pull_sparse(emb._table_cfg.table_id, keys)
+        assert not np.allclose(before, after), "flush() pushed nothing"
+
     def test_batch_shape_change_retraces_router(self, ps):
         """A partial last batch (different B) must retrace cleanly, not
         crash on stale routing state (review r3 finding)."""

@@ -116,6 +116,11 @@ define_flag("FLAGS_max_inmemory_prefetch", 2,
             "DataLoader device prefetch depth (BufferedReader equivalent)")
 define_flag("FLAGS_sync_collectives", False,
             "debug: block after each collective (FLAGS_sync_nccl_allreduce)")
+define_flag("FLAGS_eager_op_cache", True,
+            "cache jitted fwd+vjp executables per (op, shapes, dtypes, "
+            "attrs) for eager dispatch (reference: the C++ tracer's "
+            "microsecond per-op path, imperative/tracer.cc:172); disable "
+            "to force per-call jax.vjp re-tracing")
 
 if os.environ.get("FLAGS_check_nan_inf"):
     _on_flag_set("FLAGS_check_nan_inf", flag("FLAGS_check_nan_inf"))

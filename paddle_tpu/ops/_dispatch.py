@@ -12,6 +12,8 @@ the generated GradNodes.
 from __future__ import annotations
 
 import functools
+import types
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
@@ -58,6 +60,168 @@ def _wants_grad(x) -> bool:
                  or dtype_mod.is_complex(x.data.dtype)))
 
 
+# ---------------------------------------------------------------------------
+# Eager op cache: jitted fwd + vjp executables per (op, shapes, dtypes, attrs)
+#
+# The reference's dygraph hot path is a C++ tracer dispatching a pre-compiled
+# kernel in microseconds (`/root/reference/paddle/fluid/imperative/tracer.cc:172`,
+# perf-tested in `paddle/fluid/eager/tests/performance_tests/`). Our eager
+# path ran `jax.vjp` per op call — two fresh traces, milliseconds — which made
+# every small-op workload (the PS trainer, eager UX on a chip) dispatch-bound
+# (SURVEY §7 hard part #1). This cache stages each (op, static attrs, input
+# avals) combination ONCE into two jitted executables:
+#
+#   fwd(*arrs) -> outs                      (the op itself)
+#   bwd(arrs, cots) -> grads[diff slots]    (jax.vjp inside jit)
+#
+# The bwd executable re-derives the forward from the primals instead of
+# threading residuals between two jits (a closure can't cross a jit
+# boundary); XLA dead-code-eliminates whatever the transpose doesn't need —
+# for matmul/conv-style ops the recompute vanishes entirely, for
+# normalize/softmax-style ops it is a cheap fused reduction.
+#
+# Keying: most impls are defined PER CALL inside their Python API function,
+# so function identity is useless — but their __code__ object is the same
+# constant across calls. The key is (code, defaults, closure cells, static
+# kwargs, input avals), with every captured value restricted to an allowlist
+# of immutables; anything else (a baked-in RNG key array, a captured Layer)
+# makes the call uncacheable and it takes the original re-trace path, which
+# preserves per-call semantics like fresh dropout masks. A key must be seen
+# TWICE before it is staged, so one-shot shapes never pay a compile.
+# ---------------------------------------------------------------------------
+_CACHE_MAX = 4096
+_JITTED_TYPE = type(jax.jit(lambda: 0))
+_eager_cache: "OrderedDict[Any, Any]" = OrderedDict()   # key -> entry|None
+_eager_seen: "OrderedDict[Any, bool]" = OrderedDict()   # first-sight keys
+_UNCACHEABLE = object()
+
+_cache_stats = {"hit": 0, "miss": 0, "bypass": 0}
+
+
+class _CacheEntry:
+    __slots__ = ("fwd", "bwd", "prim", "diff_idx", "n_in")
+
+    def __init__(self, impl, kwargs, arrs):
+        def prim(*a):
+            out = impl(*a, **kwargs)
+            return out if isinstance(out, tuple) else (out,)
+
+        diff_idx = tuple(
+            i for i, a in enumerate(arrs)
+            if dtype_mod.is_floating(a.dtype) or dtype_mod.is_complex(a.dtype))
+
+        def bwd_fn(arrs_, cots):
+            def of_diff(diff):
+                full = list(arrs_)
+                for i, v in zip(diff_idx, diff):
+                    full[i] = v
+                return prim(*full)
+            _, vjp = jax.vjp(of_diff, tuple(arrs_[i] for i in diff_idx))
+            (gs,) = vjp(cots)
+            return gs
+
+        self.prim = prim
+        self.fwd = jax.jit(prim)
+        self.bwd = jax.jit(bwd_fn)
+        self.diff_idx = diff_idx
+        self.n_in = len(arrs)
+
+    def make_vjp(self, arrs):
+        def vjp_fn(cots, _arrs=arrs, _self=self):
+            try:
+                gs = _self.bwd(_arrs, tuple(cots))
+            except Exception:
+                # impl's backward needs concrete values (it traced fine
+                # under jax.vjp, whose primals are concrete) — re-trace
+                # eagerly for this call
+                _, eager_vjp = jax.vjp(_self.prim, *_arrs)
+                return eager_vjp(tuple(cots))
+            full = [None] * _self.n_in
+            for i, g in zip(_self.diff_idx, gs):
+                full[i] = g
+            return full
+        return vjp_fn
+
+
+def _keyable(v):
+    """Normalize a captured/static value for the cache key; raise TypeError
+    for anything whose equality doesn't guarantee identical op behavior."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes, complex,
+                                   slice, type, np.dtype)):
+        return v
+    if isinstance(v, (types.FunctionType, types.BuiltinFunctionType,
+                      types.MethodType, functools.partial, np.generic,
+                      jax.custom_vjp, jax.custom_jvp, _JITTED_TYPE)):
+        return v  # identity-hashed; module-level helpers are stable
+    if isinstance(v, (tuple, list)):
+        return tuple(_keyable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _keyable(x)) for k, x in v.items()))
+    raise TypeError(f"uncacheable value {type(v)}")
+
+
+def _entry_key(impl, kwargs, arrs):
+    try:
+        cells = impl.__closure__
+        captured = (tuple(c.cell_contents for c in cells) if cells else ())
+        key = (impl.__code__,
+               _keyable(impl.__defaults__ or ()),
+               _keyable(impl.__kwdefaults__ or {}),
+               _keyable(captured),
+               _keyable(kwargs),
+               tuple((a.shape, a.dtype, bool(getattr(a, "weak_type", False)))
+                     for a in arrs))
+        hash(key)
+        return key
+    except Exception:
+        return None
+
+
+def _cache_lookup(impl, kwargs, arrs):
+    """Return a _CacheEntry, or None to take the re-trace path."""
+    if not _EAGER_CACHE_FLAG.value:
+        return None
+    key = _entry_key(impl, kwargs, arrs)
+    if key is None:
+        _cache_stats["bypass"] += 1
+        return None
+    entry = _eager_cache.get(key)
+    if entry is not None:
+        _eager_cache.move_to_end(key)
+        if entry is _UNCACHEABLE:
+            _cache_stats["bypass"] += 1
+            return None
+        _cache_stats["hit"] += 1
+        return entry
+    if key not in _eager_seen:
+        # first sighting: don't pay a compile for what may never recur
+        _eager_seen[key] = True
+        if len(_eager_seen) > 2 * _CACHE_MAX:
+            _eager_seen.popitem(last=False)
+        _cache_stats["miss"] += 1
+        return None
+    try:
+        entry = _CacheEntry(impl, kwargs, arrs)
+    except Exception:
+        entry = _UNCACHEABLE
+    _eager_cache[key] = entry
+    if len(_eager_cache) > _CACHE_MAX:
+        _eager_cache.popitem(last=False)
+    _cache_stats["miss"] += 1
+    return None if entry is _UNCACHEABLE else entry
+
+
+def _mark_uncacheable(impl, kwargs, arrs):
+    key = _entry_key(impl, kwargs, arrs)
+    if key is not None:
+        _eager_cache[key] = _UNCACHEABLE
+
+
+def clear_eager_cache():
+    _eager_cache.clear()
+    _eager_seen.clear()
+
+
 def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
          name: Optional[str] = None, nondiff: bool = False,
          override_arrs: Optional[tuple] = None):
@@ -87,10 +251,26 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
                 and any(_wants_grad(t) for t in tensors))
 
     if requires:
-        def tup_impl(*a):
-            out = impl(*a, **kwargs)
-            return out if isinstance(out, tuple) else (out,)
-        outs, vjp_fn = jax.vjp(tup_impl, *arrs)
+        entry = _cache_lookup(impl, kwargs, arrs)
+        if entry is not None:
+            try:
+                outs = entry.fwd(*arrs)
+            except Exception:
+                # the impl needs CONCRETE values (float()/np conversions are
+                # fine under jax.vjp — its primals are concrete — but not
+                # under jit). Blacklist this key and take the re-trace path;
+                # the eager call below re-raises any genuine op error.
+                _mark_uncacheable(impl, kwargs, arrs)
+                entry = None
+        if entry is not None:
+            vjp_fn = entry.make_vjp(arrs)
+            prim_fn = entry.prim
+        else:
+            def tup_impl(*a):
+                out = impl(*a, **kwargs)
+                return out if isinstance(out, tuple) else (out,)
+            outs, vjp_fn = jax.vjp(tup_impl, *arrs)
+            prim_fn = tup_impl
         if _nan_check_on():
             _check_nan_inf(name, outs)
         out_tensors = tuple(Tensor(o, stop_gradient=False) for o in outs)
@@ -98,7 +278,7 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
         # prim_fn/in_arrs make the node replayable for create_graph (double
         # grad re-linearizes through a fresh jax.vjp — see tape._relinearize)
         tape_mod.record(vjp_fn, in_refs, out_tensors, name=name,
-                        prim_fn=tup_impl, in_arrs=arrs)
+                        prim_fn=prim_fn, in_arrs=arrs)
         return out_tensors[0] if len(out_tensors) == 1 else out_tensors
     else:
         out = impl(*arrs, **kwargs)
@@ -116,6 +296,7 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
 from ..framework import flags as _flags_mod  # noqa: E402  (imports os only)
 
 _NAN_FLAG = _flags_mod._REGISTRY["FLAGS_check_nan_inf"]
+_EAGER_CACHE_FLAG = _flags_mod._REGISTRY["FLAGS_eager_op_cache"]
 
 
 def _nan_check_on() -> bool:

@@ -110,8 +110,9 @@ def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None,
         else:
             # clamp ONLY the mask term (ADVICE r1): real scores stay exact
             logits = logits + jnp.maximum(mask.astype(acc_t), floor)
-            mvalid = jnp.broadcast_to(mask.astype(jnp.float32) > float(floor),
-                                      logits.shape)
+            mvalid = jnp.broadcast_to(
+                mask.astype(jnp.float32) > floor.astype(jnp.float32),
+                logits.shape)
         valid = mvalid if valid is None else (valid & mvalid)
     # max-subtracted softmax; row stats accumulate in fp32 (tiny arrays)
     m = jnp.max(logits.astype(jnp.float32), axis=-1, keepdims=True)

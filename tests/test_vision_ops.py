@@ -1,0 +1,309 @@
+"""paddle.vision.ops detection family (reference `python/paddle/vision/ops.py`
++ `paddle/fluid/operators/detection/`): numpy-reference output checks in the
+OpTest style (`unittests/op_test.py:289`) and finite-difference grad checks
+for the differentiable ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.param import Parameter
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.vision import ops as V
+
+from op_test import numeric_grad
+
+
+def _feat(n=1, c=2, h=8, w=8, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, c, h, w)).astype(
+        "float32")
+
+
+class TestRoiAlign:
+    def test_constant_map_averages_to_constant(self):
+        x = np.full((1, 1, 16, 16), 3.5, "float32")
+        boxes = np.array([[2.0, 2.0, 10.0, 10.0]], "float32")
+        out = V.roi_align(Tensor(x), Tensor(boxes),
+                          Tensor(np.array([1], "int32")), output_size=4)
+        assert tuple(out.shape) == (1, 1, 4, 4)
+        np.testing.assert_allclose(out.numpy(), 3.5, atol=1e-5)
+
+    def test_linear_ramp_exact(self):
+        """Bilinear sampling of a linear function is exact: roi_align over
+        f(y, x) = x must return the x-coordinates of the bin sample means."""
+        H = W = 16
+        x = np.broadcast_to(np.arange(W, dtype="float32"),
+                            (1, 1, H, W)).copy()
+        boxes = np.array([[4.0, 4.0, 12.0, 12.0]], "float32")
+        out = V.roi_align(Tensor(x), Tensor(boxes),
+                          Tensor(np.array([1], "int32")),
+                          output_size=2, aligned=True)
+        # aligned start 4 - 0.5 = 3.5, two bins of width 4: centers of the
+        # 2x2 sample grids sit at x = 5.5 and 9.5
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], [5.5, 9.5],
+                                   atol=1e-5)
+
+    def test_batch_routing(self):
+        x = np.stack([np.full((1, 8, 8), 1.0), np.full((1, 8, 8), 2.0)]
+                     ).astype("float32")
+        boxes = np.array([[0, 0, 4, 4], [0, 0, 4, 4], [0, 0, 4, 4]],
+                         "float32")
+        out = V.roi_align(Tensor(x), Tensor(boxes),
+                          Tensor(np.array([1, 2], "int32")), output_size=2)
+        np.testing.assert_allclose(out.numpy()[0], 1.0, atol=1e-5)
+        np.testing.assert_allclose(out.numpy()[1:], 2.0, atol=1e-5)
+
+    def test_grad_matches_finite_diff(self):
+        x = _feat(1, 1, 8, 8)
+        boxes = np.array([[1.0, 1.0, 6.0, 6.0]], "float32")
+        bn = np.array([1], "int32")
+        p = Parameter(x)
+        out = V.roi_align(p, Tensor(boxes), Tensor(bn), output_size=2)
+        paddle.sum(out).backward()
+        analytic = p.grad.numpy()
+
+        def fn(xv):
+            with paddle.no_grad():
+                return V.roi_align(Tensor(xv.astype("float32")),
+                                   Tensor(boxes), Tensor(bn),
+                                   output_size=2).numpy()
+
+        numeric = numeric_grad(fn, [x], wrt=0)
+        np.testing.assert_allclose(analytic, numeric, atol=5e-3, rtol=5e-3)
+
+
+class TestRoiPool:
+    def test_max_of_region(self):
+        x = np.zeros((1, 1, 8, 8), "float32")
+        x[0, 0, 3, 3] = 7.0
+        x[0, 0, 6, 6] = 9.0
+        boxes = np.array([[0, 0, 7, 7]], "float32")
+        out = V.roi_pool(Tensor(x), Tensor(boxes),
+                         Tensor(np.array([1], "int32")), output_size=2)
+        # bins split rows/cols [0..3], [4..7]: maxima 7, 0, 0, 9
+        np.testing.assert_allclose(out.numpy()[0, 0],
+                                   [[7.0, 0.0], [0.0, 9.0]], atol=1e-6)
+
+    def test_spatial_scale(self):
+        x = np.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+        boxes = np.array([[0, 0, 14, 14]], "float32")  # scaled by 0.5 -> 7
+        out = V.roi_pool(Tensor(x), Tensor(boxes),
+                         Tensor(np.array([1], "int32")), output_size=1,
+                         spatial_scale=0.5)
+        assert float(out.numpy()[0, 0, 0, 0]) == 63.0
+
+    def test_partially_outside_roi_bins_unshifted(self):
+        """Bin edges come from the UNCLAMPED roi start: a roi hanging off
+        the left edge pools only the in-image part of each bin."""
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4) + 1.0
+        boxes = np.array([[-4.0, 0.0, 3.0, 3.0]], "float32")  # cols -4..3
+        out = V.roi_pool(Tensor(x), Tensor(boxes),
+                         Tensor(np.array([1], "int32")), output_size=(1, 2))
+        # bins split cols [-4..0) and [0..4): first bin has NO in-image col
+        # until its end... cols -4..-1 off-image -> empty -> 0; second bin
+        # cols 0..3 -> max of each row's cols 0..3 over all rows = 16
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], [0.0, 16.0],
+                                   atol=1e-6)
+
+    def test_grad_flows_to_max_positions(self):
+        x = _feat(1, 1, 8, 8, seed=3)
+        boxes = np.array([[0, 0, 7, 7]], "float32")
+        p = Parameter(x)
+        out = V.roi_pool(p, Tensor(boxes), Tensor(np.array([1], "int32")),
+                         output_size=2)
+        paddle.sum(out).backward()
+        g = p.grad.numpy()
+        assert g.sum() == pytest.approx(4.0)  # one max per bin
+        assert (g > 0).sum() == 4
+
+
+class TestPsRoiPool:
+    def test_position_sensitive_channels(self):
+        # C = 4 = oh*ow with out channel count 1; each bin reads its own
+        # channel: fill channel k with value k
+        x = np.stack([np.full((8, 8), float(k)) for k in range(4)])[None]
+        x = x.astype("float32")
+        boxes = np.array([[0, 0, 8, 8]], "float32")
+        out = V.psroi_pool(Tensor(x), Tensor(boxes),
+                           Tensor(np.array([1], "int32")), output_size=2)
+        assert tuple(out.shape) == (1, 1, 2, 2)
+        np.testing.assert_allclose(out.numpy()[0, 0],
+                                   [[0.0, 1.0], [2.0, 3.0]], atol=1e-5)
+
+
+class TestDeformConv2d:
+    def test_zero_offset_matches_plain_conv(self):
+        from paddle_tpu.nn import functional as F
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 3, 8, 8)).astype("float32")
+        w = rng.normal(size=(4, 3, 3, 3)).astype("float32") * 0.2
+        off = np.zeros((2, 2 * 9, 6, 6), "float32")
+        got = V.deform_conv2d(Tensor(x), Tensor(off), Tensor(w))
+        ref = F.conv2d(Tensor(x), Tensor(w))
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=2e-4,
+                                   rtol=2e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        x = np.zeros((1, 1, 6, 6), "float32")
+        x[0, 0, 2, 3] = 1.0
+        w = np.ones((1, 1, 1, 1), "float32")
+        off = np.zeros((1, 2, 6, 6), "float32")
+        off[0, 0] = 1.0  # sample one row below
+        off[0, 1] = 2.0  # two cols right
+        got = V.deform_conv2d(Tensor(x), Tensor(off), Tensor(w))
+        # output at (1,1) samples input (2,3)
+        assert float(got.numpy()[0, 0, 1, 1]) == pytest.approx(1.0)
+
+    def test_v2_mask_modulates(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(1, 2, 6, 6)).astype("float32")
+        w = rng.normal(size=(2, 2, 3, 3)).astype("float32")
+        off = np.zeros((1, 18, 4, 4), "float32")
+        m_half = np.full((1, 9, 4, 4), 0.5, "float32")
+        full = V.deform_conv2d(Tensor(x), Tensor(off), Tensor(w))
+        half = V.deform_conv2d(Tensor(x), Tensor(off), Tensor(w),
+                               mask=Tensor(m_half))
+        np.testing.assert_allclose(half.numpy(), 0.5 * full.numpy(),
+                                   atol=1e-5)
+
+    def test_grad_matches_finite_diff_weight(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(1, 1, 5, 5)).astype("float32")
+        w = rng.normal(size=(1, 1, 3, 3)).astype("float32")
+        off = (rng.normal(size=(1, 18, 3, 3)) * 0.3).astype("float32")
+        pw = Parameter(w)
+        out = V.deform_conv2d(Tensor(x), Tensor(off), pw)
+        paddle.sum(out).backward()
+        analytic = pw.grad.numpy()
+
+        def fn(wv):
+            with paddle.no_grad():
+                return V.deform_conv2d(Tensor(x), Tensor(off),
+                                       Tensor(wv.astype("float32"))).numpy()
+
+        numeric = numeric_grad(fn, [w], wrt=0)
+        np.testing.assert_allclose(analytic, numeric, atol=5e-3, rtol=5e-3)
+
+    def test_layer_wrapper(self):
+        layer = V.DeformConv2D(3, 8, 3, padding=1)
+        x = Tensor(_feat(2, 3, 8, 8))
+        off = Tensor(np.zeros((2, 18, 8, 8), "float32"))
+        out = layer(x, off)
+        assert tuple(out.shape) == (2, 8, 8, 8)
+
+
+class TestYolo:
+    def test_yolo_box_shapes_and_range(self):
+        S, cls = 3, 5
+        x = np.random.default_rng(0).normal(
+            size=(2, S * (cls + 5), 4, 4)).astype("float32")
+        img = np.array([[256, 256], [320, 320]], "int32")
+        boxes, scores = V.yolo_box(Tensor(x), Tensor(img),
+                                   anchors=[10, 13, 16, 30, 33, 23],
+                                   class_num=cls, conf_thresh=0.0,
+                                   downsample_ratio=32)
+        assert tuple(boxes.shape) == (2, S * 16, 4)
+        assert tuple(scores.shape) == (2, S * 16, cls)
+        b = boxes.numpy()
+        assert (b[0] >= 0).all() and (b[0] <= 255.0 + 1e-3).all()
+
+    def test_yolo_box_conf_thresh_zeroes(self):
+        S, cls = 1, 2
+        x = np.full((1, S * (cls + 5), 2, 2), -10.0, "float32")  # conf ~ 0
+        img = np.array([[64, 64]], "int32")
+        boxes, scores = V.yolo_box(Tensor(x), Tensor(img), anchors=[10, 13],
+                                   class_num=cls, conf_thresh=0.5,
+                                   downsample_ratio=32)
+        assert np.all(boxes.numpy() == 0)
+        assert np.all(scores.numpy() == 0)
+
+    def test_yolo_loss_finite_and_decreases(self):
+        """The loss must be finite, positive, and trainable: a few SGD steps
+        on the raw head tensor should reduce it."""
+        rng = np.random.default_rng(0)
+        S, cls, H = 3, 4, 4
+        x = (rng.normal(size=(2, S * (cls + 5), H, H)) * 0.1).astype(
+            "float32")
+        gt_box = np.array([[[0.5, 0.5, 0.3, 0.4], [0.25, 0.25, 0.1, 0.1]],
+                           [[0.7, 0.3, 0.2, 0.2], [0.0, 0.0, 0.0, 0.0]]],
+                          "float32")
+        gt_label = np.array([[1, 3], [0, 0]], "int64")
+        kw = dict(anchors=[10, 13, 16, 30, 33, 23],
+                  anchor_mask=[0, 1, 2], class_num=cls,
+                  ignore_thresh=0.7, downsample_ratio=32)
+        p = Parameter(x)
+        losses = []
+        for _ in range(8):
+            loss = paddle.sum(V.yolo_loss(p, Tensor(gt_box),
+                                          Tensor(gt_label), **kw))
+            loss.backward()
+            with paddle.no_grad():
+                p.data = p.data - 0.01 * p.grad.data
+            p.clear_grad()
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[0] > 0
+        assert losses[-1] < losses[0]
+
+
+class TestNms:
+    def test_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                         "float32")
+        scores = np.array([0.9, 0.8, 0.7], "float32")
+        keep = V.nms(Tensor(boxes), iou_threshold=0.5, scores=Tensor(scores))
+        k = keep.numpy()
+        assert list(k[k >= 0]) == [0, 2]
+
+    def test_categories_do_not_cross_suppress(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], "float32")
+        scores = np.array([0.9, 0.8], "float32")
+        cats = np.array([0, 1], "int64")
+        keep = V.nms(Tensor(boxes), iou_threshold=0.5, scores=Tensor(scores),
+                     category_idxs=Tensor(cats), categories=[0, 1])
+        k = keep.numpy()
+        assert set(k[k >= 0]) == {0, 1}
+
+    def test_negative_coords_do_not_cross_suppress(self):
+        """Per-class offset must cover the full coordinate RANGE: a
+        negative-coordinate box must not bleed into class 0's block."""
+        boxes = np.array([[0, 0, 10, 10], [-11, 0, -1, 10]], "float32")
+        scores = np.array([0.9, 0.8], "float32")
+        cats = np.array([0, 1], "int64")
+        keep = V.nms(Tensor(boxes), iou_threshold=0.3, scores=Tensor(scores),
+                     category_idxs=Tensor(cats), categories=[0, 1])
+        k = keep.numpy()
+        assert set(k[k >= 0]) == {0, 1}
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6], [10, 10, 11, 11]],
+                         "float32")
+        scores = np.array([0.1, 0.9, 0.5], "float32")
+        keep = V.nms(Tensor(boxes), iou_threshold=0.5,
+                     scores=Tensor(scores), top_k=2)
+        assert list(keep.numpy()) == [1, 2]
+
+
+class TestIO:
+    def test_read_file_decode_jpeg_roundtrip(self, tmp_path):
+        from PIL import Image
+        arr = (np.random.default_rng(0).random((16, 16, 3)) * 255).astype(
+            "uint8")
+        path = str(tmp_path / "t.jpg")
+        Image.fromarray(arr).save(path, quality=95)
+        data = V.read_file(path)
+        assert data.numpy().dtype == np.uint8
+        img = V.decode_jpeg(data)
+        assert tuple(img.shape) == (3, 16, 16)
+        # lossy codec: just require gross agreement
+        assert abs(img.numpy().astype(int).mean()
+                   - arr.transpose(2, 0, 1).astype(int).mean()) < 10
+
+
+def test_all_reference_names_exist():
+    """Audit against the reference module's __all__
+    (`/root/reference/python/paddle/vision/ops.py:26`)."""
+    ref_all = ["yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D",
+               "read_file", "decode_jpeg", "roi_pool", "RoIPool",
+               "psroi_pool", "PSRoIPool", "roi_align", "RoIAlign"]
+    missing = [n for n in ref_all if not hasattr(V, n)]
+    assert not missing, missing

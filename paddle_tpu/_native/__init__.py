@@ -60,6 +60,46 @@ def build(verbose: bool = False) -> pathlib.Path:
             fcntl.flock(lk, fcntl.LOCK_UN)
 
 
+_CAPI_SRC = _DIR / "csrc_capi"
+_CAPI_LIB = _BUILD / "libpd_inference_c.so"
+
+
+def build_capi(verbose: bool = False) -> pathlib.Path:
+    """Compile the C inference API shim (csrc_capi/pd_inference_capi.cc —
+    reference `inference/capi_exp/`) into libpd_inference_c.so. Links
+    libpython (the shim embeds the interpreter around the Predictor), so
+    it is built separately from the main native lib on demand."""
+    _BUILD.mkdir(exist_ok=True)
+    src = _CAPI_SRC / "pd_inference_capi.cc"
+    hdr = _CAPI_SRC / "pd_inference_api.h"
+    if (_CAPI_LIB.exists()
+            and _CAPI_LIB.stat().st_mtime > src.stat().st_mtime
+            and _CAPI_LIB.stat().st_mtime > hdr.stat().st_mtime):
+        return _CAPI_LIB
+    lockfile = _BUILD / ".build.lock"
+    with open(lockfile, "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        try:
+            def cfg(*args):
+                return subprocess.run(
+                    ["python3-config", *args], check=True,
+                    capture_output=True, text=True).stdout.split()
+            includes = cfg("--includes")
+            try:
+                ldflags = cfg("--ldflags", "--embed")
+            except subprocess.CalledProcessError:
+                ldflags = cfg("--ldflags")
+            cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                    "-pthread", f"-I{_CAPI_SRC}"] + includes
+                   + ["-o", str(_CAPI_LIB), str(src)] + ldflags)
+            if verbose:
+                print("[paddle_tpu._native]", " ".join(cmd))
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+            return _CAPI_LIB
+        finally:
+            fcntl.flock(lk, fcntl.LOCK_UN)
+
+
 def load() -> ctypes.CDLL:
     """Load (building if needed) the native library and declare signatures."""
     global _lib

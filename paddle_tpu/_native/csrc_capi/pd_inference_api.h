@@ -1,0 +1,63 @@
+/* C inference API for paddle_tpu (reference:
+ * paddle/fluid/inference/capi_exp/pd_inference_api.h — the C ABI that
+ * serves C/C++/Go deployments). The TPU build's predictor runtime is the
+ * XLA executable cache behind paddle_tpu.inference.Predictor; this shim
+ * embeds a CPython interpreter around it, so a C program links ONE shared
+ * library (plus libpython) and serves the same StableHLO artifact the
+ * Python Predictor does.
+ *
+ * Contract: float32 tensors, static shapes from the saved artifact.
+ * All functions return 0 on success (or a documented value), -1 on error;
+ * pd_last_error() describes the most recent failure.  Thread-safety: calls
+ * serialize on the embedded interpreter's GIL. */
+#ifndef PD_INFERENCE_API_H_
+#define PD_INFERENCE_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Predictor PD_Predictor;
+
+/* Create a predictor from a saved inference-model prefix
+ * (`paddle.jit.save` / `static.save_inference_model` artifact:
+ * `<prefix>.pdmodel` + `<prefix>.pdiparams`). Returns NULL on error. */
+PD_Predictor* pd_predictor_create(const char* model_prefix);
+
+/* Numbers of graph inputs / outputs. */
+int pd_predictor_num_inputs(PD_Predictor* p);
+int pd_predictor_num_outputs(PD_Predictor* p);
+
+/* Name of input/output `i` copied into `buf` (NUL-terminated, truncated to
+ * buf_len). Returns the full name length, or -1. */
+int pd_predictor_input_name(PD_Predictor* p, int i, char* buf, int buf_len);
+int pd_predictor_output_name(PD_Predictor* p, int i, char* buf, int buf_len);
+
+/* Run one batch.  For each input i: data[i] points at ndims[i]-dimensional
+ * float32 data with shape shapes[i].  On return, for each output j:
+ * out_data[j] (caller-owned buffers of capacity out_capacity[j] floats)
+ * receives the values, out_ndims[j] and out_shapes[j] (capacity 8) the
+ * shape. Returns 0 on success. */
+int pd_predictor_run(PD_Predictor* p,
+                     int n_inputs,
+                     const float* const* data,
+                     const int64_t* const* shapes,
+                     const int* ndims,
+                     int n_outputs,
+                     float** out_data,
+                     size_t* out_capacity,
+                     int64_t** out_shapes,
+                     int* out_ndims);
+
+void pd_predictor_destroy(PD_Predictor* p);
+
+/* Description of the last error on this thread ("" if none). */
+const char* pd_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PD_INFERENCE_API_H_ */

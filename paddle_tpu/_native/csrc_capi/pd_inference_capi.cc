@@ -1,0 +1,260 @@
+// C inference API implementation: embeds CPython around
+// paddle_tpu.inference.Predictor (see pd_inference_api.h for the contract;
+// reference: paddle/fluid/inference/capi_exp/pd_inference_api.h).
+//
+// Design: the heavy lifting (artifact load, XLA compile, execution) already
+// lives behind the Python Predictor; this file is ONLY marshalling. A small
+// Python helper module is exec'd once; per call we cross the boundary with
+// bytes + lists (no numpy C API dependency in this TU).
+#include "pd_inference_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg = PyUnicode_AsUTF8(s) ? PyUnicode_AsUTF8(s) : msg;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+std::once_flag g_init_once;
+PyObject* g_helper = nullptr;  // module dict holding the helper functions
+
+// Helper functions defined inside the embedded interpreter: keep the C side
+// free of numpy/jax specifics.
+const char* kHelperSource = R"PY(
+import numpy as _np
+
+def _capi_create(prefix):
+    from paddle_tpu import inference as _inf
+    cfg = _inf.Config(prefix)
+    cfg.disable_gpu()  # serving default: host CPU; set PD_CAPI_DEVICE=tpu
+    import os as _os
+    if _os.environ.get("PD_CAPI_DEVICE", "cpu") != "cpu":
+        cfg._device = None
+    pred = _inf.create_predictor(cfg)
+    return pred
+
+def _capi_io_names(pred):
+    return list(pred.get_input_names()), list(pred.get_output_names())
+
+def _capi_run(pred, names, blobs, shapes):
+    for name, blob, shape in zip(names, blobs, shapes):
+        arr = _np.frombuffer(blob, dtype=_np.float32).reshape(shape).copy()
+        pred.get_input_handle(name).copy_from_cpu(arr)
+    pred.run()
+    outs = []
+    for name in pred.get_output_names():
+        a = _np.ascontiguousarray(
+            pred.get_output_handle(name).copy_to_cpu(), dtype=_np.float32)
+        outs.append((a.tobytes(), list(a.shape)))
+    return outs
+)PY";
+
+bool ensure_interpreter() {
+  std::call_once(g_init_once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+    }
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject* mod = PyImport_AddModule("__pd_capi__");  // borrowed
+    PyObject* dict = PyModule_GetDict(mod);             // borrowed
+    PyObject* r = PyRun_String(kHelperSource, Py_file_input, dict, dict);
+    if (r == nullptr) {
+      set_error_from_python();
+    } else {
+      Py_DECREF(r);
+      g_helper = dict;
+      Py_INCREF(g_helper);
+    }
+    PyGILState_Release(gil);
+  });
+  return g_helper != nullptr;
+}
+
+PyObject* helper_call(const char* fn, PyObject* args) {
+  // steals nothing; returns new ref or nullptr (error set)
+  PyObject* f = PyDict_GetItemString(g_helper, fn);  // borrowed
+  if (f == nullptr) {
+    set_error(std::string("helper missing: ") + fn);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  if (out == nullptr) set_error_from_python();
+  return out;
+}
+
+}  // namespace
+
+struct PD_Predictor {
+  PyObject* pred = nullptr;
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+};
+
+extern "C" {
+
+const char* pd_last_error(void) { return g_last_error.c_str(); }
+
+PD_Predictor* pd_predictor_create(const char* model_prefix) {
+  if (!ensure_interpreter()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PD_Predictor* p = nullptr;
+  PyObject* args = Py_BuildValue("(s)", model_prefix);
+  PyObject* pred = args ? helper_call("_capi_create", args) : nullptr;
+  Py_XDECREF(args);
+  if (pred != nullptr) {
+    PyObject* one = Py_BuildValue("(O)", pred);
+    PyObject* names = one ? helper_call("_capi_io_names", one) : nullptr;
+    Py_XDECREF(one);
+    if (names != nullptr) {
+      p = new PD_Predictor();
+      p->pred = pred;
+      PyObject* ins = PyTuple_GetItem(names, 0);   // borrowed
+      PyObject* outs = PyTuple_GetItem(names, 1);  // borrowed
+      for (Py_ssize_t i = 0; i < PyList_Size(ins); ++i)
+        p->input_names.emplace_back(
+            PyUnicode_AsUTF8(PyList_GetItem(ins, i)));
+      for (Py_ssize_t i = 0; i < PyList_Size(outs); ++i)
+        p->output_names.emplace_back(
+            PyUnicode_AsUTF8(PyList_GetItem(outs, i)));
+      Py_DECREF(names);
+    } else {
+      Py_DECREF(pred);
+    }
+  }
+  PyGILState_Release(gil);
+  return p;
+}
+
+int pd_predictor_num_inputs(PD_Predictor* p) {
+  return p ? static_cast<int>(p->input_names.size()) : -1;
+}
+
+int pd_predictor_num_outputs(PD_Predictor* p) {
+  return p ? static_cast<int>(p->output_names.size()) : -1;
+}
+
+static int copy_name(const std::vector<std::string>& v, int i, char* buf,
+                     int buf_len) {
+  if (i < 0 || i >= static_cast<int>(v.size())) return -1;
+  if (buf != nullptr && buf_len > 0) {
+    std::strncpy(buf, v[i].c_str(), buf_len - 1);
+    buf[buf_len - 1] = '\0';
+  }
+  return static_cast<int>(v[i].size());
+}
+
+int pd_predictor_input_name(PD_Predictor* p, int i, char* buf, int buf_len) {
+  return p ? copy_name(p->input_names, i, buf, buf_len) : -1;
+}
+
+int pd_predictor_output_name(PD_Predictor* p, int i, char* buf, int buf_len) {
+  return p ? copy_name(p->output_names, i, buf, buf_len) : -1;
+}
+
+int pd_predictor_run(PD_Predictor* p, int n_inputs,
+                     const float* const* data,
+                     const int64_t* const* shapes, const int* ndims,
+                     int n_outputs, float** out_data, size_t* out_capacity,
+                     int64_t** out_shapes, int* out_ndims) {
+  if (p == nullptr || p->pred == nullptr) {
+    set_error("null predictor");
+    return -1;
+  }
+  if (n_inputs != static_cast<int>(p->input_names.size())) {
+    set_error("n_inputs mismatch");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *names = nullptr, *blobs = nullptr, *shp = nullptr,
+           *args = nullptr, *result = nullptr;
+  do {
+    names = PyList_New(n_inputs);
+    blobs = PyList_New(n_inputs);
+    shp = PyList_New(n_inputs);
+    if (!names || !blobs || !shp) break;
+    for (int i = 0; i < n_inputs; ++i) {
+      size_t n = 1;
+      PyObject* dims = PyList_New(ndims[i]);
+      for (int d = 0; d < ndims[i]; ++d) {
+        n *= static_cast<size_t>(shapes[i][d]);
+        PyList_SetItem(dims, d, PyLong_FromLongLong(shapes[i][d]));
+      }
+      PyList_SetItem(names, i,
+                     PyUnicode_FromString(p->input_names[i].c_str()));
+      PyList_SetItem(blobs, i,
+                     PyBytes_FromStringAndSize(
+                         reinterpret_cast<const char*>(data[i]),
+                         static_cast<Py_ssize_t>(n * sizeof(float))));
+      PyList_SetItem(shp, i, dims);
+    }
+    args = Py_BuildValue("(OOOO)", p->pred, names, blobs, shp);
+    if (args == nullptr) break;
+    result = helper_call("_capi_run", args);
+    if (result == nullptr) break;
+    if (PyList_Size(result) != n_outputs) {
+      set_error("n_outputs mismatch");
+      break;
+    }
+    bool ok = true;
+    for (int j = 0; j < n_outputs; ++j) {
+      PyObject* item = PyList_GetItem(result, j);       // borrowed
+      PyObject* bytes = PyTuple_GetItem(item, 0);       // borrowed
+      PyObject* oshape = PyTuple_GetItem(item, 1);      // borrowed
+      const size_t nbytes = static_cast<size_t>(PyBytes_Size(bytes));
+      if (nbytes > out_capacity[j] * sizeof(float)) {
+        set_error("output buffer too small");
+        ok = false;
+        break;
+      }
+      std::memcpy(out_data[j], PyBytes_AsString(bytes), nbytes);
+      const int nd = static_cast<int>(PyList_Size(oshape));
+      out_ndims[j] = nd;
+      for (int d = 0; d < nd && d < 8; ++d)
+        out_shapes[j][d] = PyLong_AsLongLong(PyList_GetItem(oshape, d));
+    }
+    if (ok) rc = 0;
+  } while (false);
+  Py_XDECREF(result);
+  Py_XDECREF(args);
+  Py_XDECREF(shp);
+  Py_XDECREF(blobs);
+  Py_XDECREF(names);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void pd_predictor_destroy(PD_Predictor* p) {
+  if (p == nullptr) return;
+  if (p->pred != nullptr && Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_DECREF(p->pred);
+    PyGILState_Release(gil);
+  }
+  delete p;
+}
+
+}  // extern "C"

@@ -17,6 +17,7 @@ from .dist_attribute import TensorDistAttr
 from .interface import shard_tensor, shard_op
 from .engine import Engine
 from .planner import Plan, Planner
+from .cluster import Cluster, Mapper
 
 __all__ = ["ProcessMesh", "get_current_process_mesh", "TensorDistAttr",
            "shard_tensor", "shard_op", "Engine", "Plan", "Planner"]

@@ -1,0 +1,111 @@
+"""Cluster description + mesh->cluster mapper for the auto-parallel planner.
+
+Reference: `auto_parallel/mapper.py:81` (`mapping(dist_context, machines)` —
+place the process graph onto machines by link capability) and
+`cluster.py`'s Machine/Link model. The TPU translation: a cluster is a set
+of SLICES (pods connected by DCN); chips within a slice talk over ICI.
+Mapping a logical mesh onto it is a question of WHICH MESH AXES cross the
+slice boundary — the mapper classifies every axis as ici or dcn, and the
+planner prices each collective by the slowest link its replica groups
+actually cross, extending the single-fabric ICI roofline term
+(`planner.py` `_collective_bytes`).
+
+Device order contract: `jax.devices()` is slice-major (devices of slice 0
+first), which is jax's actual ordering on multislice; the mapper assumes
+it and `Plan.build_mesh` preserves it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# effective per-chip bandwidths; only ratios matter for ranking
+DEFAULT_ICI_BW = 90e9
+DEFAULT_DCN_BW = 6.25e9  # ~50 Gbit/s per-chip share of the DCN NIC
+
+
+@dataclasses.dataclass
+class Cluster:
+    """Slices x chips-per-slice with per-link bandwidths (reference
+    `auto_parallel/cluster.py` Machine/Link graph, collapsed to the two
+    link classes a TPU fleet actually has)."""
+    n_slices: int = 1
+    chips_per_slice: int = 8
+    hosts_per_slice: int = 1            # informational (DCN NIC sharing)
+    peak_flops: float = 197e12
+    hbm_bw: float = 819e9
+    ici_bw: float = DEFAULT_ICI_BW
+    dcn_bw: float = DEFAULT_DCN_BW
+
+    @property
+    def n_devices(self) -> int:
+        return self.n_slices * self.chips_per_slice
+
+    def slice_of(self, device_id: int) -> int:
+        return device_id // self.chips_per_slice
+
+
+class Mapper:
+    """Classify logical mesh axes (and compiled collectives) by the link
+    they ride when the mesh is laid slice-major onto the cluster."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def axis_links(self, mesh_dims: Dict[str, int]) -> Dict[str, str]:
+        """axis name -> "ici" | "dcn". With devices numbered slice-major
+        and the mesh reshaped row-major, an axis with inner-stride `st`
+        and size `sz` connects ids {base + j*st}; it crosses a slice
+        boundary iff st*sz > chips_per_slice (axes of size 1 are local)."""
+        out: Dict[str, str] = {}
+        stride = 1
+        for name in reversed(list(mesh_dims)):  # innermost first
+            sz = int(mesh_dims[name])
+            spans = sz > 1 and stride * sz > self.cluster.chips_per_slice
+            out[name] = "dcn" if spans else "ici"
+            stride *= sz
+        return out
+
+    # -- compiled-HLO collective attribution --------------------------------
+    def collective_bytes_by_link(self, compiled) -> Tuple[float, float]:
+        """(ici_bytes, dcn_bytes) from the optimized per-device HLO: each
+        collective's moved bytes are attributed to DCN when any of its
+        replica groups contains devices from different slices."""
+        from .planner import _iter_collective_lines
+        ici = dcn = 0.0
+        for nbytes, line in _iter_collective_lines(compiled):
+            groups = _parse_replica_groups(line)
+            crosses = any(
+                len({self.cluster.slice_of(d) for d in g}) > 1
+                for g in groups) if groups else False
+            if crosses:
+                dcn += nbytes
+            else:
+                ici += nbytes
+        return ici, dcn
+
+
+def _parse_replica_groups(line: str) -> Optional[List[List[int]]]:
+    """Parse HLO `replica_groups=` — explicit `{{0,1},{2,3}}` lists and the
+    iota form `[G,S]<=[dims](T(perm))?`. Returns None when absent."""
+    m = re.search(r"replica_groups=\{\{([^}]*(?:\},\{[^}]*)*)\}\}", line)
+    if m:
+        return [[int(x) for x in grp.split(",") if x.strip() != ""]
+                for grp in m.group(1).split("},{")]
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\]"
+                  r"(?:T\(([\d,]+)\))?", line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",")]
+            ids = ids.transpose(perm)
+        return ids.reshape(g, s).tolist()
+    return None
+
+
+__all__ = ["Cluster", "Mapper", "DEFAULT_ICI_BW", "DEFAULT_DCN_BW"]

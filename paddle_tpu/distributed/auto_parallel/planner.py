@@ -63,17 +63,17 @@ _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
 _SHAPE_RE = re.compile(r"\b([a-z0-9]+)\[([0-9,]*)\]")
 
 
-def _collective_bytes(compiled) -> float:
-    """Sum output bytes of collective ops in the optimized per-device HLO.
-
-    XLA's cost_analysis does not break out inter-chip traffic, so the
-    planner prices it from the module text: for every line whose op is a
-    collective, the result shapes left of the op name are the moved data."""
+def _iter_collective_lines(compiled):
+    """Yield (moved_bytes, hlo_line) per collective op of the optimized
+    per-device HLO. XLA's cost_analysis does not break out inter-chip
+    traffic, so callers price it from the module text: for every line
+    whose op is a collective, the result shapes left of the op name are
+    the moved data. Shared by the single-fabric scorer below and the
+    Cluster mapper's per-link attribution (cluster.py)."""
     try:
         txt = compiled.as_text()
     except Exception:
-        return 0.0
-    total = 0.0
+        return
     for line in txt.splitlines():
         stripped = line.strip()
         head = None
@@ -89,6 +89,7 @@ def _collective_bytes(compiled) -> float:
                 break
         if head is None:
             continue
+        nbytes = 0.0
         for dt, dims in _SHAPE_RE.findall(head):
             if dt not in _DTYPE_BYTES:
                 continue
@@ -96,8 +97,12 @@ def _collective_bytes(compiled) -> float:
             for d in dims.split(","):
                 if d:
                     n *= int(d)
-            total += n * _DTYPE_BYTES[dt]
-    return total
+            nbytes += n * _DTYPE_BYTES[dt]
+        yield nbytes, stripped
+
+
+def _collective_bytes(compiled) -> float:
+    return sum(nb for nb, _ in _iter_collective_lines(compiled))
 
 
 @dataclasses.dataclass
@@ -167,13 +172,20 @@ class Planner:
                  n_devices: Optional[int] = None,
                  templates: Sequence[str] = ("dp", "tp_alternating", "pp",
                                              "sp_ulysses"),
-                 data_axis: str = "dp"):
+                 data_axis: str = "dp", cluster=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.n = n_devices or len(jax.devices())
         self.templates = list(templates)
         self.data_axis = data_axis
+        # Optional Cluster (cluster.py): prices collectives per LINK — a
+        # replica group crossing a slice boundary rides DCN, not ICI
+        # (reference `auto_parallel/mapper.py:81` link-aware mapping)
+        self.cluster = cluster
+        if cluster is not None and cluster.n_devices < self.n:
+            raise ValueError(f"cluster has {cluster.n_devices} devices, "
+                             f"planner needs {self.n}")
 
     # -- one candidate ------------------------------------------------------
     def _score_candidate(self, dp: int, mp: int, template: str,
@@ -225,6 +237,16 @@ class Planner:
             an = an[0]
         flops = float(an.get("flops", 0.0))
         nbytes = float(an.get("bytes accessed", 0.0))
+        if self.cluster is not None:
+            from .cluster import Mapper
+            c = self.cluster
+            ici, dcn = Mapper(c).collective_bytes_by_link(compiled)
+            score = max(flops / c.peak_flops, nbytes / c.hbm_bw,
+                        ici / c.ici_bw, dcn / c.dcn_bw)
+            return Plan(mesh_dims=mesh_dims, param_specs=specs,
+                        template=template, score=score,
+                        cost={"flops": flops, "bytes": nbytes,
+                              "ici_bytes": ici, "dcn_bytes": dcn})
         ici = _collective_bytes(compiled)
         score = max(flops / PEAK_FLOPS, nbytes / HBM_BW, ici / ICI_BW)
         return Plan(mesh_dims=mesh_dims, param_specs=specs,

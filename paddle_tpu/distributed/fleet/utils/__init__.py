@@ -64,12 +64,16 @@ def _collect_layers(function) -> List[Layer]:
 
 
 def recompute(function, *args, preserve_rng_state: bool = True,
-              use_reentrant: bool = True, **kwargs):
+              use_reentrant: bool = True, policy=None, **kwargs):
     """Run `function(*args)` without saving intermediate activations;
     re-run it during backward (reference recompute.py:199 semantics).
 
     `preserve_rng_state` is accepted for parity; RNG replay is exact either
-    way here (the key is a checkpointed input)."""
+    way here (the key is a checkpointed input). `policy` (a
+    `jax.checkpoint_policies` predicate, e.g. `dots_with_no_batch_dims_saveable`)
+    selects SELECTIVE remat: matmul outputs are saved, elementwise chains
+    (gelu, layernorm internals) recompute in backward — trades a few VPU
+    flops for the HBM round trips of their residuals."""
     from ....jit import _swapped_state
     from ....framework import tape as tape_mod
 
@@ -137,7 +141,9 @@ def recompute(function, *args, preserve_rng_state: bool = True,
         return outs + tuple(new_bufs)
 
     tensors = [rng] + [named[k] for k in keys] + list(args) + kw_tensors
-    res = _d.call(jax.checkpoint(impl), tensors, name="recompute")
+    ckpt = (jax.checkpoint(impl) if policy is None
+            else jax.checkpoint(impl, policy=policy))
+    res = _d.call(ckpt, tensors, name="recompute")
     if not buf_names and not shape_info["tuple_out"]:
         return res if not isinstance(res, (tuple, list)) else res[0]
     res = res if isinstance(res, (tuple, list)) else (res,)

@@ -58,7 +58,83 @@ def save(obj, path, protocol=4, **configs):
         pickle.dump(_to_saveable(obj), f, protocol=protocol)
 
 
+def _is_reference_format(raw) -> bool:
+    return isinstance(raw, dict) and (
+        "StructuredToParameterName@@" in raw
+        or "UnpackBigParamInfor@@" in raw)
+
+
+def _decode_reference(obj, return_numpy):
+    """Decode a checkpoint written by the reference's `paddle.save`
+    (`/root/reference/python/paddle/framework/io.py:568`): state_dict
+    values are plain ndarrays (`_build_saved_state_dict`, io.py:41), big
+    params are split into `key@@.N` slices with an `UnpackBigParamInfor@@`
+    manifest (`fluid/io.py:1768`), and Tensors nested in other containers
+    pickle via `reduce_varbase` to a `((name, ndarray),)` tuple
+    (io.py:240). The pickles contain only numpy + builtins, so they load
+    without the reference installed."""
+    if isinstance(obj, dict):
+        obj = dict(obj)
+        info = obj.pop("UnpackBigParamInfor@@", None)
+        if info:
+            for key, val in info.items():  # re-pack (fluid/io.py:1804)
+                slices = [obj.pop(p) for p in val["slices"]]
+                obj[key] = np.concatenate(
+                    [np.asarray(s) for s in slices]).reshape(
+                        val["OriginShape"])
+        obj.pop("StructuredToParameterName@@", None)
+        return {k: _decode_reference(v, return_numpy) for k, v in obj.items()}
+    if (isinstance(obj, tuple) and len(obj) == 1
+            and isinstance(obj[0], tuple) and len(obj[0]) == 2
+            and isinstance(obj[0][0], str)
+            and isinstance(obj[0][1], np.ndarray)):
+        arr = obj[0][1]  # reduce_varbase encoding: ((name, data),)
+        if return_numpy:
+            return arr
+        t = Tensor(jnp.asarray(arr))
+        t.name = obj[0][0]
+        return t
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode_reference(v, return_numpy) for v in obj)
+    if isinstance(obj, np.ndarray):
+        return obj if return_numpy else Tensor(jnp.asarray(obj))
+    return obj
+
+
+def match_state_dict(layer, state_dict):
+    """Name-map a (possibly prefixed) reference state_dict onto `layer`.
+
+    Zoo structured names already line up with the reference models'
+    (resnet `conv1/bn1/layerN.M/fc`, BertModel
+    `embeddings.*/encoder.layers.N.*/pooler.dense`); ecosystem checkpoints
+    often carry a wrapping prefix (`bert.`) or head keys (`cls.*`). This
+    finds the prefix with the best key overlap, strips it, and returns
+    (matched, missing, unexpected) — apply with `layer.set_state_dict`.
+    """
+    want = set(dict(layer.state_dict()).keys())
+    keys = list(state_dict.keys())
+    prefixes = {""}
+    for k in keys:
+        parts = k.split(".")
+        for i in (1, 2):
+            if len(parts) > i:
+                prefixes.add(".".join(parts[:i]) + ".")
+    def overlap(pref):
+        return sum(1 for k in keys
+                   if k.startswith(pref) and k[len(pref):] in want)
+    best = max(prefixes, key=overlap)
+    matched = {k[len(best):]: v for k, v in state_dict.items()
+               if k.startswith(best) and k[len(best):] in want}
+    missing = sorted(want - set(matched))
+    unexpected = sorted(k for k in keys
+                        if not (k.startswith(best)
+                                and k[len(best):] in want))
+    return matched, missing, unexpected
+
+
 def load(path, return_numpy=False, **configs):
     with open(path, "rb") as f:
         raw = pickle.load(f)
+    if _is_reference_format(raw):
+        return _decode_reference(raw, return_numpy)
     return _from_saveable(raw, return_numpy=return_numpy)

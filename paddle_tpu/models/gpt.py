@@ -29,10 +29,18 @@ class GPTConfig:
     dropout: float = 0.1
     attn_dropout: float = 0.1
     tie_word_embeddings: bool = True
+    # activation-checkpoint policy per block: "" (save-everything),
+    # "dots" (selective: keep matmul outputs, recompute elementwise chains
+    # in backward — HBM-for-VPU trade), "full" (recompute whole block)
+    remat: str = ""
 
     def __post_init__(self):
         if not self.intermediate_size:
             self.intermediate_size = 4 * self.hidden_size
+        if self.remat not in ("", "dots", "full"):
+            raise ValueError(
+                f"GPTConfig.remat must be '', 'dots' or 'full', "
+                f"got {self.remat!r}")
 
     @staticmethod
     def gpt2_small():
@@ -133,8 +141,17 @@ class GPT(nn.Layer):
 
     def forward(self, input_ids):
         x = self.pipeline_pre(input_ids)
-        for blk in self.blocks:
-            x = blk(x)
+        if self.cfg.remat and self.training:
+            import jax
+
+            from ..distributed.fleet.utils import recompute
+            pol = (None if self.cfg.remat == "full" else
+                   jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+            for blk in self.blocks:
+                x = recompute(blk, x, policy=pol)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
         return self.pipeline_post(x)
 
     def loss(self, input_ids, labels):

@@ -1,30 +1,310 @@
-"""paddle.onnx.export (reference export.py -> paddle2onnx)."""
+"""paddle.onnx.export — real ONNX graph emission from the recorded Program.
+
+Reference: `/root/reference/python/paddle/onnx/export.py:36` shells out to
+paddle2onnx; here the recorded static Program (`static.Program`, the
+append_op capture of the layer's forward) is walked op-by-op into ONNX
+NodeProtos and serialized with the in-repo wire writer (`onnx/proto.py`) —
+no external converter or `onnx` package. The supported op set is the
+inference zoo's (conv/bn/pool/matmul/linear/softmax/reshape/activations);
+an unsupported op raises listing itself rather than emitting a broken
+graph. Alongside the `.onnx`, the StableHLO artifact for
+`paddle_tpu.inference.Predictor` is still written (the TPU serving path).
+"""
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import proto
+
+
+def _attrs_of(op) -> Dict[str, Any]:
+    """Static attrs = impl keyword-only defaults overlaid by call kwargs
+    (per-call impls bake attrs into __kwdefaults__)."""
+    out = dict(getattr(op.impl, "__kwdefaults__", None) or {})
+    out.update(op.kwargs or {})
+    return out
+
+
+def _pads4(pad) -> List[int]:
+    """[(h_lo,h_hi),(w_lo,w_hi)] -> ONNX [h_lo, w_lo, h_hi, w_hi]."""
+    (hl, hh), (wl, wh) = pad
+    return [int(hl), int(wl), int(hh), int(wh)]
+
+
+class _Converter:
+    def __init__(self, prog, graph_name: str, dyn_batch: bool = False):
+        self.prog = prog
+        self.dyn_batch = dyn_batch
+        self.graph_name = graph_name
+        self.nodes: List[bytes] = []
+        self.inits: List[bytes] = []
+        self.names: Dict[int, str] = {}   # vid -> onnx value name
+        self._n_const = 0
+        self._n_node = 0
+        for pname, vid in prog.param_vids.items():
+            self.names[vid] = pname
+            self.inits.append(proto.tensor_proto(
+                pname, np.asarray(prog.params[pname])))
+        for fname, vid in prog.inputs.items():
+            self.names[vid] = fname
+
+    # -- helpers ------------------------------------------------------------
+    def vname(self, vid: int) -> str:
+        if vid not in self.names:
+            self.names[vid] = f"v{vid}"
+        return self.names[vid]
+
+    def const(self, arr, hint="const") -> str:
+        name = f"{hint}_{self._n_const}"
+        self._n_const += 1
+        self.inits.append(proto.tensor_proto(name, np.asarray(arr)))
+        return name
+
+    def emit(self, op_type: str, ins: Sequence[str], outs: Sequence[str],
+             **attrs):
+        self._n_node += 1
+        self.nodes.append(proto.node(
+            op_type, ins, outs, name=f"{op_type}_{self._n_node}",
+            attrs=attrs or None))
+
+    def in_names(self, op) -> List[str]:
+        out = []
+        for kind, ref in op.inputs:
+            if kind == "var":
+                out.append(self.vname(ref))
+            elif ref is None:
+                out.append("")
+            else:
+                out.append(self.const(np.asarray(ref)))
+        return out
+
+    def out_shape(self, op, i=0):
+        return tuple(int(d) for d in self.prog.vars[op.out_ids[i]].shape)
+
+    # -- op lowerings -------------------------------------------------------
+    def convert(self, op):
+        a = _attrs_of(op)
+        ins = self.in_names(op)
+        outs = [self.vname(v) for v in op.out_ids]
+        n = op.name
+        if n == "conv2d":
+            if a.get("lhs_spec", "NCHW") != "NCHW":
+                raise NotImplementedError(
+                    "onnx export: Conv is NCHW-only in ONNX; re-export the "
+                    f"model with data_format='NCHW' (got "
+                    f"{a.get('lhs_spec')!r})")
+            pad = a.get("pad")
+            kw = dict(strides=[int(s) for s in a.get("stride", (1, 1))],
+                      dilations=[int(d) for d in a.get("dilation", (1, 1))],
+                      group=int(a.get("groups", 1)))
+            if isinstance(pad, str):
+                kw["auto_pad"] = {"SAME": "SAME_UPPER",
+                                  "VALID": "VALID"}[pad]
+            else:
+                kw["pads"] = _pads4(pad)
+            self.emit("Conv", ins, outs, **kw)
+        elif n == "batch_norm":
+            # recorded input order (x, mean, var, scale, bias) -> ONNX
+            # (x, scale, bias, mean, var)
+            x, rm, rv, w, b = ins
+            self.emit("BatchNormalization", [x, w, b, rm, rv], outs,
+                      epsilon=float(a.get("epsilon", 1e-5)))
+        elif n in ("max_pool2d", "avg_pool2d", "pool2d"):
+            window = a["window"]
+            strides = a["strides"]
+            pads = a["pads"]
+            if window[0] != 1 or window[1] != 1:
+                raise NotImplementedError(
+                    "onnx export: pooling is NCHW-only in ONNX; re-export "
+                    f"with data_format='NCHW' (window {tuple(window)})")
+            kw = dict(kernel_shape=[int(window[-2]), int(window[-1])],
+                      strides=[int(strides[-2]), int(strides[-1])],
+                      pads=_pads4(pads[-2:]))
+            if a.get("mode", "max" if n == "max_pool2d" else "avg") == "max":
+                self.emit("MaxPool", ins[:1], outs, **kw)
+            else:
+                kw["count_include_pad"] = 0 if a.get("exclusive", True) else 1
+                self.emit("AveragePool", ins[:1], outs, **kw)
+        elif n == "adaptive_avg_pool2d":
+            if tuple(a.get("os", ())) != (1, 1):
+                raise NotImplementedError(
+                    "onnx export: adaptive_avg_pool2d only with "
+                    f"output_size (1,1), got {a.get('os')}")
+            self.emit("GlobalAveragePool", ins[:1], outs)
+        elif n in ("relu", "sigmoid", "tanh", "exp", "sqrt", "abs", "floor",
+                   "ceil", "erf", "identity", "assign"):
+            self.emit({"relu": "Relu", "sigmoid": "Sigmoid",
+                       "tanh": "Tanh", "exp": "Exp", "sqrt": "Sqrt",
+                       "abs": "Abs", "floor": "Floor", "ceil": "Ceil",
+                       "erf": "Erf", "identity": "Identity",
+                       "assign": "Identity"}[n], ins[:1], outs)
+        elif n in ("add", "subtract", "multiply", "divide", "maximum",
+                   "minimum", "pow"):
+            self.emit({"add": "Add", "subtract": "Sub", "multiply": "Mul",
+                       "divide": "Div", "maximum": "Max", "minimum": "Min",
+                       "pow": "Pow"}[n], ins[:2], outs)
+        elif n == "gelu":
+            # opset<20 has no Gelu: exact erf composition
+            x = ins[0]
+            h = outs[0]
+            s = self.const(np.asarray(np.sqrt(2.0), np.float32))
+            self.emit("Div", [x, s], [h + "_div"])
+            self.emit("Erf", [h + "_div"], [h + "_erf"])
+            one = self.const(np.asarray(1.0, np.float32))
+            self.emit("Add", [h + "_erf", one], [h + "_1p"])
+            half = self.const(np.asarray(0.5, np.float32))
+            self.emit("Mul", [x, h + "_1p"], [h + "_x1p"])
+            self.emit("Mul", [h + "_x1p", half], outs)
+        elif n in ("flatten", "reshape", "squeeze", "unsqueeze"):
+            tgt = list(self.out_shape(op))
+            # dynamic batch: ONNX Reshape dim 0 -> copy from input (the
+            # exported graph then serves any batch size, like paddle2onnx's
+            # dynamic axes), instead of baking the probe batch
+            if (self.dyn_batch and op.inputs[0][0] == "var"
+                    and len(tgt) >= 1
+                    and tgt[0] == self.prog.vars[op.inputs[0][1]].shape[0]):
+                tgt[0] = 0
+            shape = self.const(np.asarray(tgt, np.int64), "shape")
+            self.emit("Reshape", [ins[0], shape], outs)
+        elif n == "transpose":
+            perm = a.get("perm") or a.get("axes")
+            self.emit("Transpose", ins[:1], outs,
+                      perm=[int(p) for p in perm])
+        elif n == "linear":
+            x, w = ins[0], ins[1]
+            b = ins[2] if len(ins) > 2 else None
+            in_rank = len(self.prog.vars[op.inputs[0][1]].shape) \
+                if op.inputs[0][0] == "var" else None
+            if in_rank == 2:
+                gemm_in = [x, w] + ([b] if b else [])
+                self.emit("Gemm", gemm_in, outs, alpha=1.0, beta=1.0,
+                          transA=0, transB=0)
+            else:  # batched: MatMul (+ Add)
+                mm_out = outs[0] + "_mm" if b else outs[0]
+                self.emit("MatMul", [x, w], [mm_out])
+                if b:
+                    self.emit("Add", [mm_out, b], outs)
+        elif n in ("matmul", "mm", "bmm"):
+            x, w = ins[0], ins[1]
+            if a.get("transpose_x"):
+                xt = x + "_T"
+                rank = len(self.prog.vars[op.inputs[0][1]].shape)
+                perm = list(range(rank - 2)) + [rank - 1, rank - 2]
+                self.emit("Transpose", [x], [xt], perm=perm)
+                x = xt
+            if a.get("transpose_y"):
+                wt = w + "_T"
+                rank = len(self.prog.vars[op.inputs[1][1]].shape) \
+                    if op.inputs[1][0] == "var" else 2
+                perm = list(range(rank - 2)) + [rank - 1, rank - 2]
+                self.emit("Transpose", [w], [wt], perm=perm)
+                w = wt
+            self.emit("MatMul", [x, w], outs)
+        elif n in ("softmax", "log_softmax"):
+            self.emit("Softmax" if n == "softmax" else "LogSoftmax",
+                      ins[:1], outs, axis=int(a.get("axis", -1)))
+        elif n == "dropout":
+            self.emit("Identity", ins[:1], outs)  # inference graphs only
+        elif n == "cast":
+            self.emit("Cast", ins[:1], outs,
+                      to=proto.DT[str(np.dtype(a["dtype"]))])
+        elif n in ("mean", "reduce_mean"):
+            axes = a.get("axis")
+            kw = dict(keepdims=int(bool(a.get("keepdim", False))))
+            if axes is not None:
+                axs = [axes] if isinstance(axes, int) else list(axes)
+                kw["axes"] = [int(x) for x in axs]
+            self.emit("ReduceMean", ins[:1], outs, **kw)
+        else:
+            raise NotImplementedError(
+                f"onnx export: op '{n}' has no ONNX lowering (supported "
+                "set is the inference zoo: conv/bn/pool/linear/matmul/"
+                "activations/reshape/softmax)")
+
+    def finish(self, out_vids) -> bytes:
+        def in_shape(fname, vid):
+            shp = list(self.prog.vars[vid].shape)
+            if 0 in self.prog.dyn_dims.get(fname, ()):
+                shp[0] = "batch"  # dim_param: dynamic axis
+            return shp
+        g_inputs = [proto.value_info(
+            fname, str(self.prog.vars[vid].dtype), in_shape(fname, vid))
+            for fname, vid in self.prog.inputs.items()]
+
+        def out_shape_of(v):
+            shp = list(self.prog.vars[v].shape)
+            if self.dyn_batch and shp:
+                shp[0] = "batch"
+            return shp
+        g_outputs = [proto.value_info(
+            self.vname(v), str(self.prog.vars[v].dtype), out_shape_of(v))
+            for v in out_vids]
+        g = proto.graph(self.nodes, self.graph_name, self.inits,
+                        g_inputs, g_outputs)
+        return proto.model(g)
+
+
+def export_program(prog, out_vids, path: str, graph_name="paddle_tpu",
+                   dyn_batch: bool = False):
+    """Serialize a recorded Program (inference slice) to `path` (.onnx)."""
+    conv = _Converter(prog, graph_name, dyn_batch=dyn_batch)
+    for op in prog.ops:
+        conv.convert(op)
+    data = conv.finish(out_vids)
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
 
 
 def export(layer, path: str, input_spec: Optional[Sequence] = None,
-           opset_version: int = 9, **configs):
-    """Export `layer` for interchange.
-
-    If the `onnx` package is importable, real ONNX conversion could run; in
-    this environment it is not, so the function writes the StableHLO export
-    (`<path>.pdmodel` + params) — the TPU deployment artifact consumed by
-    `paddle_tpu.inference.Predictor` — and raises only if even that fails.
-    """
-    try:
-        import onnx  # noqa: F401
-        have_onnx = True
-    except ImportError:
-        have_onnx = False
-
+           opset_version: int = 13, **configs):
+    """Export `layer` as a real ONNX model (+ the StableHLO Predictor
+    artifact). `input_spec`: list of InputSpec/Tensors (static shapes)."""
     from .. import jit as jit_mod
-    prefix = path[:-5] if path.endswith(".onnx") else path
-    jit_mod.save(layer, prefix, input_spec=input_spec)
+    from .. import static
+    from ..framework.tensor import Tensor
+    from ..static import InputSpec
 
-    if have_onnx:
-        # onnx present but converter (paddle2onnx equivalent) is out of
-        # scope for this build; the StableHLO artifact stands in
-        pass
-    return prefix
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export needs input_spec")
+    specs = []
+    for i, s in enumerate(input_spec):
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        elif isinstance(s, Tensor):
+            specs.append(InputSpec.from_tensor(s, name=f"x{i}"))
+        else:
+            raise TypeError(f"input_spec[{i}]: {type(s)}")
+
+    was_training = layer.training
+    layer.eval()
+    prog = static.Program()
+    static._enable_static()
+    try:
+        with static.program_guard(prog):
+            # raw spec shapes: static.data turns None/-1 dims into probe
+            # size 1 AND records them in prog.dyn_dims (the dynamic-axis
+            # information the converter needs for dim_param emission)
+            feeds = [static.data(s.name or f"x{i}", list(s.shape), s.dtype)
+                     for i, s in enumerate(specs)]
+            out = layer(*feeds)
+    finally:
+        static._disable_static()
+        if was_training:
+            layer.train()
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    out_vids = [o._vid for o in outs]
+    dyn_batch = any(0 in d for d in prog.dyn_dims.values())
+
+    onnx_path = path if path.endswith(".onnx") else path + ".onnx"
+    export_program(prog, out_vids, onnx_path,
+                   graph_name=type(layer).__name__, dyn_batch=dyn_batch)
+    # TPU serving artifact alongside (Predictor consumes this, not ONNX)
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    try:
+        jit_mod.save(layer, prefix, input_spec=specs)
+    except Exception:
+        pass  # the .onnx file is the contract here; StableHLO best-effort
+    return onnx_path

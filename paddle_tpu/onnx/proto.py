@@ -1,0 +1,260 @@
+"""Minimal ONNX protobuf wire-format writer (and reader, for tests).
+
+The reference delegates ONNX serialization to paddle2onnx + the `onnx`
+package (`/root/reference/python/paddle/onnx/export.py:36`); neither is in
+this environment, so the exporter emits the wire format directly. Only the
+message subset the zoo needs is implemented, with field numbers from the
+public onnx.proto (stable since IR version 3): ModelProto{ir_version=1,
+producer_name=2, graph=7, opset_import=8}, GraphProto{node=1, name=2,
+initializer=5, input=11, output=12}, NodeProto{input=1, output=2, name=3,
+op_type=4, attribute=5}, AttributeProto{name=1, f=2, i=3, s=4, t=5,
+floats=7, ints=8, type=20}, TensorProto{dims=1, data_type=2, name=8,
+raw_data=9}, ValueInfoProto{name=1, type=2}, TypeProto{tensor_type=1},
+TypeProto.Tensor{elem_type=1, shape=2}, TensorShapeProto{dim=1},
+Dimension{dim_value=1}.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# ONNX TensorProto.DataType values
+DT = {"float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+      "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+      "bfloat16": 16}
+_NP_OF_DT = {v: k for k, v in DT.items()}
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def _f_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def _f_str(field: int, s: str) -> bytes:
+    return _f_bytes(field, s.encode())
+
+
+def _f_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(v))
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    dt = DT.get(str(arr.dtype))
+    if dt is None:
+        raise ValueError(f"onnx: unsupported initializer dtype {arr.dtype}")
+    msg = b"".join(_f_varint(1, d) for d in arr.shape)
+    msg += _f_varint(2, dt)
+    msg += _f_str(8, name)
+    msg += _f_bytes(9, arr.tobytes())
+    return msg
+
+
+def attribute(name: str, value) -> bytes:
+    msg = _f_str(1, name)
+    if isinstance(value, bool):
+        msg += _f_varint(3, int(value)) + _f_varint(20, ATTR_INT)
+    elif isinstance(value, int):
+        msg += _f_varint(3, value) + _f_varint(20, ATTR_INT)
+    elif isinstance(value, float):
+        msg += _f_float(2, value) + _f_varint(20, ATTR_FLOAT)
+    elif isinstance(value, str):
+        msg += _f_bytes(4, value.encode()) + _f_varint(20, ATTR_STRING)
+    elif isinstance(value, np.ndarray):
+        msg += _f_bytes(5, tensor_proto(name + "_t", value))
+        msg += _f_varint(20, ATTR_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, (int, np.integer)) for v in value):
+            msg += b"".join(_f_varint(8, int(v)) for v in value)
+            msg += _f_varint(20, ATTR_INTS)
+        elif all(isinstance(v, float) for v in value):
+            msg += b"".join(_f_float(7, v) for v in value)
+            msg += _f_varint(20, ATTR_FLOATS)
+        else:
+            raise ValueError(f"onnx attribute {name}: mixed list {value!r}")
+    else:
+        raise ValueError(f"onnx attribute {name}: {type(value)} unsupported")
+    return msg
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         name: str = "", attrs: Optional[Dict[str, Any]] = None) -> bytes:
+    msg = b"".join(_f_str(1, i) for i in inputs)
+    msg += b"".join(_f_str(2, o) for o in outputs)
+    if name:
+        msg += _f_str(3, name)
+    msg += _f_str(4, op_type)
+    for k, v in (attrs or {}).items():
+        msg += _f_bytes(5, attribute(k, v))
+    return msg
+
+
+def value_info(name: str, dtype: str, shape: Sequence) -> bytes:
+    """shape entries: int -> dim_value; str -> dim_param (dynamic axis)."""
+    dims = b"".join(
+        _f_bytes(1, _f_str(2, d) if isinstance(d, str)
+                 else _f_varint(1, int(d)))
+        for d in shape)
+    tensor_type = _f_varint(1, DT[str(dtype)]) + _f_bytes(2, dims)
+    type_proto = _f_bytes(1, tensor_type)
+    return _f_str(1, name) + _f_bytes(2, type_proto)
+
+
+def graph(nodes: Sequence[bytes], name: str,
+          initializers: Sequence[bytes],
+          inputs: Sequence[bytes], outputs: Sequence[bytes]) -> bytes:
+    msg = b"".join(_f_bytes(1, n) for n in nodes)
+    msg += _f_str(2, name)
+    msg += b"".join(_f_bytes(5, t) for t in initializers)
+    msg += b"".join(_f_bytes(11, i) for i in inputs)
+    msg += b"".join(_f_bytes(12, o) for o in outputs)
+    return msg
+
+
+def model(graph_bytes: bytes, opset: int = 13,
+          producer: str = "paddle_tpu") -> bytes:
+    opset_id = _f_str(1, "") + _f_varint(2, opset)
+    return (_f_varint(1, 8)            # ir_version 8
+            + _f_str(2, producer)
+            + _f_bytes(7, graph_bytes)
+            + _f_bytes(8, opset_id))
+
+
+# --------------------------------------------------------------------------
+# wire-format reader (test/tooling side): generic parse into nested dicts
+# keyed by field number, then shaped by the message schemas above
+# --------------------------------------------------------------------------
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = n = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def parse_fields(buf: bytes) -> Dict[int, List]:
+    """field number -> list of raw values (int for varint, bytes for
+    length-delimited, float for fixed32)."""
+    out: Dict[int, List] = {}
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wire} unsupported")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+    f = parse_fields(buf)
+    dims = [int(d) for d in f.get(1, [])]
+    dt = _NP_OF_DT[int(f[2][0])]
+    name = f.get(8, [b""])[0].decode()
+    if 9 in f:
+        arr = np.frombuffer(f[9][0], dtype=dt).reshape(dims)
+    else:
+        raise ValueError("only raw_data tensors emitted/parsed")
+    return name, arr
+
+
+def parse_attribute(buf: bytes):
+    f = parse_fields(buf)
+    name = f[1][0].decode()
+    at = int(f.get(20, [0])[0])
+    if at == ATTR_INT:
+        return name, int(f[3][0])
+    if at == ATTR_FLOAT:
+        return name, float(f[2][0])
+    if at == ATTR_STRING:
+        return name, f[4][0].decode()
+    if at == ATTR_INTS:
+        return name, [int(v) for v in f.get(8, [])]
+    if at == ATTR_FLOATS:
+        return name, [float(v) for v in f.get(7, [])]
+    if at == ATTR_TENSOR:
+        return name, parse_tensor(f[5][0])[1]
+    raise ValueError(f"attribute type {at} unsupported")
+
+
+def parse_node(buf: bytes) -> Dict[str, Any]:
+    f = parse_fields(buf)
+    return {
+        "inputs": [b.decode() for b in f.get(1, [])],
+        "outputs": [b.decode() for b in f.get(2, [])],
+        "name": f.get(3, [b""])[0].decode(),
+        "op_type": f[4][0].decode(),
+        "attrs": dict(parse_attribute(a) for a in f.get(5, [])),
+    }
+
+
+def parse_value_info(buf: bytes) -> Dict[str, Any]:
+    f = parse_fields(buf)
+    name = f[1][0].decode()
+    tt = parse_fields(parse_fields(f[2][0])[1][0])
+    elem = int(tt[1][0])
+    shape: List[Any] = []
+    for dim in parse_fields(tt[2][0]).get(1, []):
+        df = parse_fields(dim)
+        if 2 in df:  # dim_param (dynamic axis)
+            shape.append(df[2][0].decode())
+        else:
+            shape.append(int(df.get(1, [0])[0]))
+    return {"name": name, "dtype": _NP_OF_DT[elem], "shape": shape}
+
+
+def parse_model(buf: bytes) -> Dict[str, Any]:
+    f = parse_fields(buf)
+    g = parse_fields(f[7][0])
+    opset = parse_fields(f[8][0]) if 8 in f else {2: [0]}
+    return {
+        "ir_version": int(f[1][0]),
+        "producer": f.get(2, [b""])[0].decode(),
+        "opset": int(opset.get(2, [0])[0]),
+        "graph": {
+            "name": g.get(2, [b""])[0].decode(),
+            "nodes": [parse_node(n) for n in g.get(1, [])],
+            "initializers": dict(parse_tensor(t) for t in g.get(5, [])),
+            "inputs": [parse_value_info(v) for v in g.get(11, [])],
+            "outputs": [parse_value_info(v) for v in g.get(12, [])],
+        },
+    }

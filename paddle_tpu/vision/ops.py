@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 from ..ops import _dispatch as _d
+from .. import nn as _nn
 
 __all__ = [
     "yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D",
@@ -310,39 +311,31 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         name="deform_conv2d")
 
 
-class DeformConv2D:
+class DeformConv2D(_nn.Layer):
     """Layer wrapper (reference `vision/ops.py` DeformConv2D)."""
 
-    def __new__(cls, *a, **k):
-        from .. import nn
+    def __init__(self, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1,
+                 deformable_groups=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        import math
+        kh, kw = _pair(kernel_size)
+        bound = 1.0 / math.sqrt(in_channels * kh * kw)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kh, kw),
+            default_initializer=_nn.initializer.Uniform(-bound, bound))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter(
+                         (out_channels,),
+                         default_initializer=_nn.initializer.Uniform(
+                             -bound, bound)))
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
 
-        class _DeformConv2D(nn.Layer):
-            def __init__(self, in_channels, out_channels, kernel_size,
-                         stride=1, padding=0, dilation=1,
-                         deformable_groups=1, groups=1, weight_attr=None,
-                         bias_attr=None):
-                super().__init__()
-                kh, kw = _pair(kernel_size)
-                import math
-                bound = 1.0 / math.sqrt(in_channels * kh * kw)
-                self.weight = self.create_parameter(
-                    (out_channels, in_channels // groups, kh, kw),
-                    default_initializer=nn.initializer.Uniform(-bound, bound))
-                self.bias = (None if bias_attr is False else
-                             self.create_parameter(
-                                 (out_channels,),
-                                 default_initializer=nn.initializer.Uniform(
-                                     -bound, bound)))
-                self._cfg = dict(stride=stride, padding=padding,
-                                 dilation=dilation,
-                                 deformable_groups=deformable_groups,
-                                 groups=groups)
-
-            def forward(self, x, offset, mask=None):
-                return deform_conv2d(x, offset, self.weight, self.bias,
-                                     mask=mask, **self._cfg)
-
-        return _DeformConv2D(*a, **k)
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -645,30 +638,27 @@ def decode_jpeg(x, mode="unchanged", name=None):
     return Tensor(jnp.asarray(arr), stop_gradient=True)
 
 
-class _PoolLayerBase:
-    def __new__(cls, fn, output_size, spatial_scale=1.0, **extra):
-        from .. import nn
+class _RoIPoolLayer(_nn.Layer):
+    """Shared layer face for the roi pooling ops (reference RoIPool/
+    RoIAlign/PSRoIPool layer classes)."""
+    _fn = None  # set by subclass
 
-        class _L(nn.Layer):
-            def __init__(self):
-                super().__init__()
-                self._fn = fn
-                self._cfg = dict(output_size=output_size,
-                                 spatial_scale=spatial_scale, **extra)
+    def __init__(self, output_size, spatial_scale=1.0, **extra):
+        super().__init__()
+        self._cfg = dict(output_size=output_size,
+                         spatial_scale=spatial_scale, **extra)
 
-            def forward(self, x, boxes, boxes_num):
-                return self._fn(x, boxes, boxes_num, **self._cfg)
-
-        return _L()
+    def forward(self, x, boxes, boxes_num):
+        return type(self)._fn(x, boxes, boxes_num, **self._cfg)
 
 
-def RoIPool(output_size, spatial_scale=1.0):
-    return _PoolLayerBase(roi_pool, output_size, spatial_scale)
+class RoIPool(_RoIPoolLayer):
+    _fn = staticmethod(roi_pool)
 
 
-def RoIAlign(output_size, spatial_scale=1.0):
-    return _PoolLayerBase(roi_align, output_size, spatial_scale)
+class RoIAlign(_RoIPoolLayer):
+    _fn = staticmethod(roi_align)
 
 
-def PSRoIPool(output_size, spatial_scale=1.0):
-    return _PoolLayerBase(psroi_pool, output_size, spatial_scale)
+class PSRoIPool(_RoIPoolLayer):
+    _fn = staticmethod(psroi_pool)

@@ -1,0 +1,72 @@
+/* C consumer of the pd_inference C API (reference parity test for
+ * capi_exp/pd_inference_api.h): load a saved LeNet artifact, run one
+ * batch read from argv[2] (raw float32), write outputs to argv[3].
+ * Usage: capi_main <model_prefix> <input.bin> <output.bin> <N> <C> <H> <W>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "pd_inference_api.h"
+
+int main(int argc, char** argv) {
+  if (argc != 8) {
+    fprintf(stderr, "usage: %s prefix in.bin out.bin N C H W\n", argv[0]);
+    return 2;
+  }
+  PD_Predictor* p = pd_predictor_create(argv[1]);
+  if (!p) {
+    fprintf(stderr, "create failed: %s\n", pd_last_error());
+    return 1;
+  }
+  if (pd_predictor_num_inputs(p) != 1 || pd_predictor_num_outputs(p) != 1) {
+    fprintf(stderr, "unexpected io arity\n");
+    return 1;
+  }
+  char name[128];
+  if (pd_predictor_input_name(p, 0, name, sizeof name) < 0) return 1;
+  printf("input: %s\n", name);
+
+  int64_t shape[4];
+  int64_t n = 1;
+  for (int d = 0; d < 4; ++d) {
+    shape[d] = atoll(argv[4 + d]);
+    n *= shape[d];
+  }
+  float* in = malloc(n * sizeof(float));
+  FILE* f = fopen(argv[2], "rb");
+  if (!f || fread(in, sizeof(float), n, f) != (size_t)n) {
+    fprintf(stderr, "bad input file\n");
+    return 1;
+  }
+  fclose(f);
+
+  enum { CAP = 1 << 20 };
+  float* out = malloc(CAP * sizeof(float));
+  int64_t out_shape[8];
+  int out_nd = 0;
+  const float* datas[1] = {in};
+  const int64_t* shapes[1] = {shape};
+  int ndims[1] = {4};
+  float* outs[1] = {out};
+  size_t caps[1] = {CAP};
+  int64_t* oshapes[1] = {out_shape};
+  int onds[1] = {0};
+  if (pd_predictor_run(p, 1, datas, shapes, ndims, 1, outs, caps, oshapes,
+                       onds) != 0) {
+    fprintf(stderr, "run failed: %s\n", pd_last_error());
+    return 1;
+  }
+  out_nd = onds[0];
+  int64_t total = 1;
+  for (int d = 0; d < out_nd; ++d) total *= out_shape[d];
+  printf("output dims: %d total: %lld\n", out_nd, (long long)total);
+
+  f = fopen(argv[3], "wb");
+  fwrite(out, sizeof(float), total, f);
+  fclose(f);
+  pd_predictor_destroy(p);
+  free(in);
+  free(out);
+  printf("CAPI_OK\n");
+  return 0;
+}

@@ -1,0 +1,120 @@
+"""Cluster description + mesh mapper (reference `auto_parallel/mapper.py:81`
+link-aware process placement, `cluster.py` machine/link model): axis->link
+classification, replica-group attribution, and the planner choosing
+DIFFERENT plans for a 1x8 slice vs a 2x4-slice topology."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.auto_parallel import Cluster, Mapper, Planner
+from paddle_tpu.distributed.auto_parallel.cluster import _parse_replica_groups
+
+
+class TestAxisLinks:
+    def test_single_slice_all_ici(self):
+        m = Mapper(Cluster(n_slices=1, chips_per_slice=8))
+        links = m.axis_links({"dp": 2, "mp": 4})
+        assert links == {"dp": "ici", "mp": "ici"}
+
+    def test_outer_axis_crosses_slices(self):
+        m = Mapper(Cluster(n_slices=2, chips_per_slice=4))
+        links = m.axis_links({"dp": 2, "mp": 4})
+        assert links["mp"] == "ici"  # stride 1, size 4 == chips_per_slice
+        assert links["dp"] == "dcn"  # stride 4, spans both slices
+
+    def test_inner_axis_too_big_for_slice(self):
+        m = Mapper(Cluster(n_slices=2, chips_per_slice=4))
+        links = m.axis_links({"dp": 1, "mp": 8})
+        assert links["mp"] == "dcn"
+        assert links["dp"] == "ici"  # size-1 axis is local
+
+    def test_size_one_axes_never_dcn(self):
+        m = Mapper(Cluster(n_slices=4, chips_per_slice=2))
+        links = m.axis_links({"pp": 4, "dp": 1, "mp": 2})
+        assert links == {"pp": "dcn", "dp": "ici", "mp": "ici"}
+
+
+class TestReplicaGroupParsing:
+    def test_explicit_lists(self):
+        g = _parse_replica_groups(
+            "%ar = f32[8] all-reduce(%x), replica_groups={{0,1},{2,3}}")
+        assert g == [[0, 1], [2, 3]]
+
+    def test_iota_form(self):
+        g = _parse_replica_groups(
+            "%ar = f32[8] all-reduce(%x), replica_groups=[2,4]<=[8]")
+        assert g == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def test_iota_transposed(self):
+        g = _parse_replica_groups(
+            "%ar = f32[8] all-reduce(%x), replica_groups=[4,2]<=[2,4]T(1,0)")
+        # arange(8).reshape(2,4).T.reshape(4,2) -> pairs stride 4
+        assert g == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+    def test_absent(self):
+        assert _parse_replica_groups("%a = f32[8] add(%x, %y)") is None
+
+
+def _tp_heavy_model():
+    """Params >> activations: TP-sharding params wins on HBM/collectives
+    within one slice, but an mp axis spanning slices pays activation psums
+    over DCN."""
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(1024, 1024), nn.ReLU(),
+                         nn.Linear(1024, 1024), nn.ReLU(),
+                         nn.Linear(1024, 8))
+
+
+class TestPlannerWithCluster:
+    def test_topology_changes_the_plan(self):
+        """The SAME workload must map differently onto 1x8 vs 2x4 slices:
+        scores must differ through the DCN term, and the 2x4 winner must
+        not put a size-8 axis across the slice boundary."""
+        model = _tp_heavy_model()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        lossf = nn.CrossEntropyLoss()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(16, 1024)).astype(
+                "float32"))
+        y = paddle.to_tensor(np.arange(16) % 8)
+
+        def best(cluster):
+            paddle.seed(0)
+            m = _tp_heavy_model()
+            o = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+            pl = Planner(m, lambda out, yy: lossf(out, yy), o,
+                         templates=("dp", "tp_alternating"),
+                         cluster=cluster)
+            return pl.plan(x, y)
+
+        one = best(Cluster(n_slices=1, chips_per_slice=8, dcn_bw=1e9))
+        two = best(Cluster(n_slices=2, chips_per_slice=4, dcn_bw=1e9))
+        assert one.score != two.score
+        # no axis of the 2-slice winner may span slices with heavy traffic
+        links = Mapper(Cluster(n_slices=2, chips_per_slice=4)).axis_links(
+            two.mesh_dims)
+        # params >> activations here, so the dp grad-allreduce must NOT be
+        # the slice-crossing axis when an in-slice alternative exists
+        if "dcn" in links.values():
+            assert two.cost.get("dcn_bytes", 0.0) <= one.cost.get(
+                "ici_bytes", float("inf"))
+
+    def test_dcn_bytes_attributed(self):
+        """On a 2x4 cluster, a pure-dp plan's grad all-reduce crosses
+        slices: the mapper must bill nonzero DCN bytes for it."""
+        model = _tp_heavy_model()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        lossf = nn.CrossEntropyLoss()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(16, 1024)).astype(
+                "float32"))
+        y = paddle.to_tensor(np.arange(16) % 8)
+        pl = Planner(model, lambda out, yy: lossf(out, yy), opt,
+                     templates=("dp",),
+                     cluster=Cluster(n_slices=2, chips_per_slice=4))
+        plan = pl.plan(x, y)
+        assert plan.template == "dp"
+        assert plan.cost["dcn_bytes"] > 0, plan.cost

@@ -1,0 +1,110 @@
+"""Predictor C API (reference `inference/capi_exp/pd_inference_api.h`):
+build the shim, compile a real C consumer (tests/capi_main.c), run LeNet
+through it in a fresh process, and match the Python Predictor's output."""
+import os
+import pathlib
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import _native, nn
+from paddle_tpu.models import LeNet
+
+HERE = pathlib.Path(__file__).resolve().parent
+
+
+@pytest.fixture(scope="module")
+def capi_lib():
+    try:
+        return _native.build_capi()
+    except Exception as e:  # toolchain missing: skip, don't fail the suite
+        pytest.skip(f"cannot build C API shim: {e}")
+
+
+@pytest.fixture(scope="module")
+def lenet_artifact(tmp_path_factory):
+    d = tmp_path_factory.mktemp("capi_model")
+    paddle.seed(5)
+    net = LeNet()
+    net.eval()
+    from paddle_tpu.static import InputSpec
+    prefix = str(d / "lenet")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec((2, 1, 28, 28), "float32", "x")])
+    return net, prefix
+
+
+def test_c_program_matches_python_predictor(capi_lib, lenet_artifact,
+                                            tmp_path):
+    net, prefix = lenet_artifact
+    x = np.random.default_rng(0).normal(size=(2, 1, 28, 28)).astype(
+        "float32")
+
+    # golden from the Python Predictor over the same artifact
+    from paddle_tpu import inference as inf
+    cfg = inf.Config(prefix)
+    cfg.disable_gpu()
+    pred = inf.create_predictor(cfg)
+    iname = pred.get_input_names()[0]
+    pred.get_input_handle(iname).copy_from_cpu(x)
+    pred.run()
+    golden = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+
+    # compile the C consumer and run it in a clean process
+    exe = str(tmp_path / "capi_main")
+    inc = str(pathlib.Path(_native.__file__).parent / "csrc_capi")
+
+    def cfgout(*args):
+        return subprocess.run(["python3-config", *args], check=True,
+                              capture_output=True, text=True).stdout.split()
+    try:
+        ldflags = cfgout("--ldflags", "--embed")
+    except subprocess.CalledProcessError:
+        ldflags = cfgout("--ldflags")
+    cmd = (["gcc", "-O1", str(HERE / "capi_main.c"), f"-I{inc}",
+            "-o", exe, f"-L{capi_lib.parent}", "-lpd_inference_c"]
+           + ldflags + [f"-Wl,-rpath,{capi_lib.parent}"])
+    subprocess.run(cmd, check=True, capture_output=True)
+
+    inp = tmp_path / "in.bin"
+    outp = tmp_path / "out.bin"
+    inp.write_bytes(x.tobytes())
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(HERE.parent) + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    r = subprocess.run(
+        [exe, prefix, str(inp), str(outp), "2", "1", "28", "28"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "CAPI_OK" in r.stdout
+    got = np.frombuffer(outp.read_bytes(), np.float32).reshape(golden.shape)
+    np.testing.assert_allclose(got, golden, atol=1e-5, rtol=1e-5)
+
+
+def test_name_and_arity_queries(capi_lib, lenet_artifact):
+    """Drive the shim in-process via ctypes for the metadata calls."""
+    import ctypes
+    net, prefix = lenet_artifact
+    lib = ctypes.CDLL(str(capi_lib))
+    lib.pd_predictor_create.restype = ctypes.c_void_p
+    lib.pd_predictor_create.argtypes = [ctypes.c_char_p]
+    lib.pd_predictor_num_inputs.argtypes = [ctypes.c_void_p]
+    lib.pd_predictor_num_outputs.argtypes = [ctypes.c_void_p]
+    lib.pd_predictor_input_name.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.pd_predictor_destroy.argtypes = [ctypes.c_void_p]
+    p = lib.pd_predictor_create(prefix.encode())
+    assert p, "create failed"
+    assert lib.pd_predictor_num_inputs(p) == 1
+    assert lib.pd_predictor_num_outputs(p) == 1
+    buf = ctypes.create_string_buffer(128)
+    assert lib.pd_predictor_input_name(p, 0, buf, 128) > 0
+    from paddle_tpu import inference as inf
+    cfg = inf.Config(prefix)
+    cfg.disable_gpu()
+    assert buf.value.decode() == inf.create_predictor(
+        cfg).get_input_names()[0]
+    lib.pd_predictor_destroy(p)

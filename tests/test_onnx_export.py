@@ -1,0 +1,258 @@
+"""Real ONNX emission (reference `python/paddle/onnx/export.py:36`):
+`paddle.onnx.export` writes an actual ONNX protobuf; the test decodes it
+with the in-repo wire reader and EXECUTES the graph with a numpy
+interpreter of the emitted op subset, asserting 1e-4 parity against the
+eager model (onnxruntime is not in this environment; the interpreter
+plays its role — same consumption contract, independent of the encoder's
+jnp semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.onnx import proto
+
+
+# ---------------------------------------------------------------------------
+# minimal numpy ONNX runtime for the exported subset
+# ---------------------------------------------------------------------------
+def _conv2d_np(x, w, b, strides, pads, dilations, group):
+    hl, wl, hh, wh = pads
+    x = np.pad(x, ((0, 0), (0, 0), (hl, hh), (wl, wh)))
+    N, C, H, W = x.shape
+    O, Cg, kh, kw = w.shape
+    sh, sw = strides
+    dh, dw = dilations
+    Ho = (H - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W - (dw * (kw - 1) + 1)) // sw + 1
+    out = np.zeros((N, O, Ho, Wo), np.float32)
+    og = O // group
+    for g in range(group):
+        xs = x[:, g * Cg:(g + 1) * Cg]
+        for i in range(kh):
+            for j in range(kw):
+                patch = xs[:, :, i * dh:i * dh + Ho * sh:sh,
+                           j * dw:j * dw + Wo * sw:sw]
+                out[:, g * og:(g + 1) * og] += np.einsum(
+                    "nchw,oc->nohw", patch, w[g * og:(g + 1) * og, :, i, j])
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pool_np(x, kernel, strides, pads, mode, count_include_pad=0):
+    hl, wl, hh, wh = pads
+    fill = -np.inf if mode == "max" else 0.0
+    x = np.pad(x, ((0, 0), (0, 0), (hl, hh), (wl, wh)),
+               constant_values=fill)
+    N, C, H, W = x.shape
+    kh, kw = kernel
+    sh, sw = strides
+    Ho = (H - kh) // sh + 1
+    Wo = (W - kw) // sw + 1
+    out = np.zeros((N, C, Ho, Wo), np.float32)
+    for i in range(Ho):
+        for j in range(Wo):
+            win = x[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            if mode == "max":
+                out[:, :, i, j] = win.max(axis=(2, 3))
+            elif count_include_pad:
+                out[:, :, i, j] = win.mean(axis=(2, 3))
+            else:
+                cnt = np.isfinite(win).all() and (
+                    min(i * sh + kh, H) - i * sh) * (
+                        min(j * sw + kw, W) - j * sw)
+                out[:, :, i, j] = win.sum(axis=(2, 3)) / cnt
+    return out
+
+
+def run_onnx(model: dict, feeds: dict) -> list:
+    g = model["graph"]
+    env = dict(g["initializers"])
+    env.update(feeds)
+    for nd in g["nodes"]:
+        i = [env[x] if x else None for x in nd["inputs"]]
+        a = nd["attrs"]
+        t = nd["op_type"]
+        if t == "Conv":
+            assert "pads" in a, "exporter always writes explicit pads here"
+            o = _conv2d_np(i[0], i[1], i[2] if len(i) > 2 else None,
+                           a.get("strides", [1, 1]), a["pads"],
+                           a.get("dilations", [1, 1]), a.get("group", 1))
+        elif t == "BatchNormalization":
+            x, sc, b, m, v = i
+            o = (x - m.reshape(1, -1, 1, 1)) / np.sqrt(
+                v.reshape(1, -1, 1, 1) + a.get("epsilon", 1e-5))
+            o = o * sc.reshape(1, -1, 1, 1) + b.reshape(1, -1, 1, 1)
+        elif t == "MaxPool":
+            o = _pool_np(i[0], a["kernel_shape"], a["strides"], a["pads"],
+                         "max")
+        elif t == "AveragePool":
+            o = _pool_np(i[0], a["kernel_shape"], a["strides"], a["pads"],
+                         "avg", a.get("count_include_pad", 0))
+        elif t == "GlobalAveragePool":
+            o = i[0].mean(axis=(2, 3), keepdims=True)
+        elif t == "Relu":
+            o = np.maximum(i[0], 0)
+        elif t == "Sigmoid":
+            o = 1.0 / (1.0 + np.exp(-i[0]))
+        elif t == "Tanh":
+            o = np.tanh(i[0])
+        elif t == "Erf":
+            from math import erf
+            o = np.vectorize(erf)(i[0]).astype(np.float32)
+        elif t == "Identity":
+            o = i[0]
+        elif t == "Add":
+            o = i[0] + i[1]
+        elif t == "Sub":
+            o = i[0] - i[1]
+        elif t == "Mul":
+            o = i[0] * i[1]
+        elif t == "Div":
+            o = i[0] / i[1]
+        elif t == "Reshape":
+            tgt = [int(d) for d in i[1]]
+            # ONNX semantics: 0 copies the input dim, -1 infers
+            tgt = [i[0].shape[k] if d == 0 else d
+                   for k, d in enumerate(tgt)]
+            o = i[0].reshape(tgt)
+        elif t == "Transpose":
+            o = i[0].transpose(a["perm"])
+        elif t == "Gemm":
+            A = i[0].T if a.get("transA") else i[0]
+            B = i[1].T if a.get("transB") else i[1]
+            o = a.get("alpha", 1.0) * (A @ B)
+            if len(i) > 2 and i[2] is not None:
+                o = o + a.get("beta", 1.0) * i[2]
+        elif t == "MatMul":
+            o = i[0] @ i[1]
+        elif t == "Softmax":
+            z = i[0] - i[0].max(axis=a.get("axis", -1), keepdims=True)
+            e = np.exp(z)
+            o = e / e.sum(axis=a.get("axis", -1), keepdims=True)
+        elif t == "ReduceMean":
+            o = i[0].mean(axis=tuple(a["axes"]) if "axes" in a else None,
+                          keepdims=bool(a.get("keepdims", 0)))
+        else:
+            raise NotImplementedError(f"interpreter: {t}")
+        outs = nd["outputs"]
+        if t in ("MatMul",) and len(outs) == 1:
+            env[outs[0]] = o
+        else:
+            env[outs[0]] = o
+    return [env[vo["name"]] for vo in g["outputs"]]
+
+
+def _export_and_run(net, shape, seed=0, atol=1e-4):
+    from paddle_tpu.static import InputSpec
+    net.eval()
+    x = np.random.default_rng(seed).normal(size=shape).astype("float32")
+    golden = net(paddle.to_tensor(x)).numpy()
+    import tempfile
+    import os
+    with tempfile.TemporaryDirectory() as d:
+        p = paddle.onnx.export(net, os.path.join(d, "m"),
+                               input_spec=[InputSpec(shape, "float32", "x")])
+        assert p.endswith(".onnx") and os.path.exists(p)
+        with open(p, "rb") as f:
+            model = proto.parse_model(f.read())
+    assert model["ir_version"] == 8
+    assert model["graph"]["inputs"][0]["name"] == "x"
+    (got,) = run_onnx(model, {"x": x})
+    np.testing.assert_allclose(got, golden, atol=atol, rtol=1e-4)
+    return model
+
+
+class TestWireFormat:
+    def test_tensor_roundtrip(self):
+        arr = np.random.default_rng(0).normal(size=(3, 4)).astype("float32")
+        name, back = proto.parse_tensor(proto.tensor_proto("w", arr))
+        assert name == "w"
+        np.testing.assert_array_equal(back, arr)
+
+    def test_node_roundtrip(self):
+        nb = proto.node("Conv", ["x", "w"], ["y"], name="c1",
+                        attrs={"strides": [2, 2], "group": 1,
+                               "epsilon": 0.5, "auto_pad": "VALID"})
+        nd = proto.parse_node(nb)
+        assert nd["op_type"] == "Conv"
+        assert nd["inputs"] == ["x", "w"]
+        assert nd["attrs"]["strides"] == [2, 2]
+        assert nd["attrs"]["epsilon"] == 0.5
+        assert nd["attrs"]["auto_pad"] == "VALID"
+
+    def test_protoc_decodes_model(self, tmp_path):
+        """The emitted bytes must be valid protobuf: protoc --decode_raw
+        accepts them (structure check independent of our reader)."""
+        import subprocess
+        g = proto.graph([proto.node("Relu", ["x"], ["y"])], "g", [],
+                        [proto.value_info("x", "float32", (2, 2))],
+                        [proto.value_info("y", "float32", (2, 2))])
+        data = proto.model(g)
+        r = subprocess.run(["protoc", "--decode_raw"], input=data,
+                           capture_output=True, timeout=60)
+        assert r.returncode == 0, r.stderr[:300]
+        assert b"Relu" in r.stdout
+
+
+class TestZooExport:
+    def test_lenet_parity(self):
+        from paddle_tpu.models import LeNet
+        paddle.seed(3)
+        model = _export_and_run(LeNet(), (2, 1, 28, 28))
+        ops = {n["op_type"] for n in model["graph"]["nodes"]}
+        assert "Conv" in ops and ("Gemm" in ops or "MatMul" in ops)
+
+    def test_resnet18_parity(self):
+        from paddle_tpu.models.resnet import resnet18
+        paddle.seed(4)
+        model = _export_and_run(resnet18(), (1, 3, 32, 32), atol=5e-4)
+        ops = {n["op_type"] for n in model["graph"]["nodes"]}
+        assert {"Conv", "BatchNormalization", "MaxPool",
+                "GlobalAveragePool"} <= ops
+
+    def test_dynamic_batch_preserved(self, tmp_path):
+        """InputSpec with None batch exports a dim_param graph input and a
+        batch-copying Reshape (ONNX dim 0 semantics) — runnable at any
+        batch size, like the reference paddle2onnx dynamic axes."""
+        import os
+        from paddle_tpu.models import LeNet
+        from paddle_tpu.static import InputSpec
+        paddle.seed(6)
+        net = LeNet()
+        net.eval()
+        p = paddle.onnx.export(
+            net, os.path.join(str(tmp_path), "m"),
+            input_spec=[InputSpec((None, 1, 28, 28), "float32", "x")])
+        with open(p, "rb") as f:
+            model = proto.parse_model(f.read())
+        assert model["graph"]["inputs"][0]["shape"][0] == "batch"
+        # run at TWO batch sizes through the interpreter
+        for B in (1, 5):
+            x = np.random.default_rng(B).normal(
+                size=(B, 1, 28, 28)).astype("float32")
+            golden = net(paddle.to_tensor(x)).numpy()
+            (got,) = run_onnx(model, {"x": x})
+            np.testing.assert_allclose(got, golden, atol=1e-4, rtol=1e-4)
+
+    def test_nhwc_model_refused(self):
+        from paddle_tpu.models.resnet import resnet18
+        from paddle_tpu.static import InputSpec
+        net = resnet18(data_format="NHWC")
+        net.eval()
+        with pytest.raises(NotImplementedError, match="NCHW"):
+            paddle.onnx.export(
+                net, "/tmp/nhwc",
+                input_spec=[InputSpec((1, 32, 32, 3), "float32", "x")])
+
+    def test_unsupported_op_raises_with_name(self):
+        from paddle_tpu import nn
+        from paddle_tpu.static import InputSpec
+
+        class Odd(nn.Layer):
+            def forward(self, x):
+                return paddle.cumsum(x, axis=1)
+
+        with pytest.raises(NotImplementedError, match="cumsum"):
+            paddle.onnx.export(Odd(), "/tmp/odd",
+                               input_spec=[InputSpec((2, 3), "float32")])

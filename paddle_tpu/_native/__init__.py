@@ -117,6 +117,11 @@ def _declare(lib: ctypes.CDLL):
     c = ctypes
     u64p = c.POINTER(c.c_uint64)
     f32p = c.POINTER(c.c_float)
+    u8p = c.POINTER(c.c_uint8)
+
+    # AES-CTR model-file crypto (csrc/crypto.cc)
+    lib.pd_aes_ctr_crypt.restype = c.c_int
+    lib.pd_aes_ctr_crypt.argtypes = [u8p, c.c_int, u8p, u8p, u8p, c.c_int64]
 
     # parameter server
     lib.ps_server_create.restype = c.c_int

@@ -1,0 +1,143 @@
+// AES-CTR model-file encryption (reference:
+// paddle/fluid/framework/io/crypto/cipher.cc — AES cipher for PS model IO
+// over HDFS). Implemented from the FIPS-197 spec: the S-box is generated
+// algorithmically (GF(2^8) inverse + affine transform) at first use, key
+// schedule supports 128/192/256-bit keys, and CTR mode makes encrypt and
+// decrypt the same operation (no padding, arbitrary lengths).
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+
+namespace pdcrypto {
+
+static uint8_t sbox[256];
+static std::once_flag sbox_once;
+
+static uint8_t xtime(uint8_t x) {
+  return static_cast<uint8_t>((x << 1) ^ ((x >> 7) * 0x1b));
+}
+
+static uint8_t gmul(uint8_t a, uint8_t b) {
+  uint8_t r = 0;
+  while (b) {
+    if (b & 1) r ^= a;
+    a = xtime(a);
+    b >>= 1;
+  }
+  return r;
+}
+
+static void init_sbox() {
+  // multiplicative inverse in GF(2^8) (0 -> 0), then the affine transform
+  uint8_t inv[256];
+  inv[0] = 0;
+  for (int i = 1; i < 256; ++i) {
+    for (int j = 1; j < 256; ++j) {
+      if (gmul(static_cast<uint8_t>(i), static_cast<uint8_t>(j)) == 1) {
+        inv[i] = static_cast<uint8_t>(j);
+        break;
+      }
+    }
+  }
+  for (int i = 0; i < 256; ++i) {
+    uint8_t x = inv[i];
+    uint8_t y = x;
+    for (int k = 0; k < 4; ++k) {
+      y = static_cast<uint8_t>((y << 1) | (y >> 7));
+      x ^= y;
+    }
+    sbox[i] = x ^ 0x63;
+  }
+}
+
+struct Schedule {
+  uint8_t rk[15 * 16];  // up to 14 rounds + initial
+  int rounds;
+};
+
+static void expand_key(const uint8_t* key, int key_len, Schedule* s) {
+  std::call_once(sbox_once, init_sbox);
+  const int nk = key_len / 4;            // words in key: 4/6/8
+  s->rounds = nk + 6;                    // 10/12/14
+  const int total_words = 4 * (s->rounds + 1);
+  uint8_t* w = s->rk;
+  std::memcpy(w, key, key_len);
+  uint8_t rcon = 1;
+  for (int i = nk; i < total_words; ++i) {
+    uint8_t t[4];
+    std::memcpy(t, w + 4 * (i - 1), 4);
+    if (i % nk == 0) {
+      const uint8_t tmp = t[0];  // RotWord + SubWord + Rcon
+      t[0] = static_cast<uint8_t>(sbox[t[1]] ^ rcon);
+      t[1] = sbox[t[2]];
+      t[2] = sbox[t[3]];
+      t[3] = sbox[tmp];
+      rcon = xtime(rcon);
+    } else if (nk > 6 && i % nk == 4) {
+      for (int k = 0; k < 4; ++k) t[k] = sbox[t[k]];
+    }
+    for (int k = 0; k < 4; ++k) w[4 * i + k] = w[4 * (i - nk) + k] ^ t[k];
+  }
+}
+
+static void encrypt_block(const Schedule& s, const uint8_t in[16],
+                          uint8_t out[16]) {
+  uint8_t st[16];
+  for (int i = 0; i < 16; ++i) st[i] = in[i] ^ s.rk[i];
+  for (int r = 1; r <= s.rounds; ++r) {
+    uint8_t t[16];
+    // SubBytes + ShiftRows (column-major state: byte i lives at
+    // row i%4, col i/4; row k shifts left by k columns)
+    for (int c = 0; c < 4; ++c)
+      for (int k = 0; k < 4; ++k)
+        t[4 * c + k] = sbox[st[4 * ((c + k) % 4) + k]];
+    if (r < s.rounds) {  // MixColumns
+      for (int c = 0; c < 4; ++c) {
+        const uint8_t a0 = t[4 * c], a1 = t[4 * c + 1], a2 = t[4 * c + 2],
+                      a3 = t[4 * c + 3];
+        st[4 * c] = static_cast<uint8_t>(gmul(a0, 2) ^ gmul(a1, 3) ^ a2 ^ a3);
+        st[4 * c + 1] =
+            static_cast<uint8_t>(a0 ^ gmul(a1, 2) ^ gmul(a2, 3) ^ a3);
+        st[4 * c + 2] =
+            static_cast<uint8_t>(a0 ^ a1 ^ gmul(a2, 2) ^ gmul(a3, 3));
+        st[4 * c + 3] =
+            static_cast<uint8_t>(gmul(a0, 3) ^ a1 ^ a2 ^ gmul(a3, 2));
+      }
+    } else {
+      std::memcpy(st, t, 16);
+    }
+    for (int i = 0; i < 16; ++i) st[i] ^= s.rk[16 * r + i];
+  }
+  std::memcpy(out, st, 16);
+}
+
+}  // namespace pdcrypto
+
+extern "C" {
+
+// CTR mode: out = in XOR AES(key, iv||counter). Symmetric, so one entry
+// point serves encrypt and decrypt. key_len must be 16, 24 or 32.
+// Returns 0 on success, -1 on bad arguments.
+int pd_aes_ctr_crypt(const uint8_t* key, int key_len, const uint8_t iv[16],
+                     const uint8_t* in, uint8_t* out, int64_t n) {
+  if (key == nullptr || iv == nullptr || in == nullptr || out == nullptr ||
+      (key_len != 16 && key_len != 24 && key_len != 32) || n < 0) {
+    return -1;
+  }
+  pdcrypto::Schedule s;
+  pdcrypto::expand_key(key, key_len, &s);
+  uint8_t ctr[16];
+  std::memcpy(ctr, iv, 16);
+  uint8_t ks[16];
+  for (int64_t off = 0; off < n; off += 16) {
+    pdcrypto::encrypt_block(s, ctr, ks);
+    const int64_t m = (n - off < 16) ? n - off : 16;
+    for (int64_t i = 0; i < m; ++i) out[off + i] = in[off + i] ^ ks[i];
+    for (int i = 15; i >= 0; --i) {  // big-endian counter increment
+      if (++ctr[i] != 0) break;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -50,12 +50,44 @@ def _from_saveable(obj, return_numpy=False):
     return obj
 
 
-def save(obj, path, protocol=4, **configs):
+_ENC_MAGIC = b"PDTPUAES1\x00"
+
+
+def _aes_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    """AES-CTR via the native lib (csrc/crypto.cc — reference
+    `framework/io/crypto/cipher.cc` AES model-file cipher). Symmetric:
+    one call both encrypts and decrypts."""
+    import ctypes
+
+    from .. import _native
+    lib = _native.load()
+    if len(key) not in (16, 24, 32):
+        raise ValueError("cipher key must be 16/24/32 bytes (AES-128/192/256)")
+    out = ctypes.create_string_buffer(len(data))
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    rc = lib.pd_aes_ctr_crypt(
+        ctypes.cast(ctypes.c_char_p(key), u8), len(key),
+        ctypes.cast(ctypes.c_char_p(iv), u8),
+        ctypes.cast(ctypes.c_char_p(data), u8),
+        ctypes.cast(out, u8), len(data))
+    if rc != 0:
+        raise RuntimeError("aes_ctr_crypt failed")
+    return out.raw
+
+
+def save(obj, path, protocol=4, cipher_key: bytes = None, **configs):
+    """`cipher_key` (16/24/32 bytes) encrypts the checkpoint with AES-CTR
+    (reference `framework/io/crypto/` model encryption for industrial PS
+    deployments); a random IV is stored in the header."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    payload = pickle.dumps(_to_saveable(obj), protocol=protocol)
+    if cipher_key is not None:
+        iv = os.urandom(16)
+        payload = _ENC_MAGIC + iv + _aes_ctr(cipher_key, iv, payload)
     with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+        f.write(payload)
 
 
 def _is_reference_format(raw) -> bool:
@@ -132,9 +164,16 @@ def match_state_dict(layer, state_dict):
     return matched, missing, unexpected
 
 
-def load(path, return_numpy=False, **configs):
+def load(path, return_numpy=False, cipher_key: bytes = None, **configs):
     with open(path, "rb") as f:
-        raw = pickle.load(f)
+        data = f.read()
+    if data.startswith(_ENC_MAGIC):
+        if cipher_key is None:
+            raise ValueError(
+                f"{path} is AES-encrypted: pass cipher_key=... to load")
+        iv = data[len(_ENC_MAGIC):len(_ENC_MAGIC) + 16]
+        data = _aes_ctr(cipher_key, iv, data[len(_ENC_MAGIC) + 16:])
+    raw = pickle.loads(data)
     if _is_reference_format(raw):
         return _decode_reference(raw, return_numpy)
     return _from_saveable(raw, return_numpy=return_numpy)

@@ -86,6 +86,7 @@ class TestFusedMHA:
             np.asarray(layer.ln_scale.data) + np.asarray(layer.ln_bias.data)
         np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_trains(self):
         paddle.seed(0)
         layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.1)

@@ -69,6 +69,7 @@ class TestLaunchCLI:
         """)
         assert r.returncode == 7
 
+    @pytest.mark.slow
     def test_elastic_restarts_then_gives_up(self, tmp_path):
         r = _run_launch(tmp_path, """
             import sys
@@ -88,6 +89,7 @@ class TestLaunchCLI:
 
 
 class TestSpawn:
+    @pytest.mark.slow
     def test_spawn_runs_workers(self, tmp_path):
         # spawn in a subprocess to avoid forking the jax-laden test process
         script = tmp_path / "sp.py"

@@ -255,6 +255,7 @@ class TestPipelineUnevenSegmentation:
     SegmentLayers supports uneven + cost splits, pp_layers.py:63,282).
     The compiled pipeline pads stages to max(counts) with masked slots."""
 
+    @pytest.mark.slow
     def test_pp_13_layers_over_4_stages_matches_single_device(self):
         cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=13,
                         num_heads=2, max_position_embeddings=32,

@@ -1,4 +1,6 @@
 """Regression tests for review findings."""
+import pytest
+
 import numpy as np
 
 import paddle_tpu as paddle
@@ -46,6 +48,7 @@ def test_backward_preserves_other_graphs():
     np.testing.assert_allclose(x.grad.numpy(), [7.0])
 
 
+@pytest.mark.slow
 def test_tape_id_reuse_safe():
     # discarded outputs (dead tensors) must never swallow cotangents
     import gc

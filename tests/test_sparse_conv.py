@@ -78,6 +78,7 @@ class TestSparseConv3D:
         assert float(np.abs(conv.weight.grad.numpy()).max()) > 0
         assert conv.bias.grad is not None
 
+    @pytest.mark.slow
     def test_point_cloud_toy_network_trains(self):
         """subm conv -> relu -> pool -> subm conv -> global readout, loss
         goes down (the reference's point-cloud workload class, eager)."""

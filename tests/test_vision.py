@@ -64,10 +64,17 @@ class TestModels:
     @pytest.mark.parametrize("factory,ch", [
         (lambda: models.vgg11(num_classes=10), 10),
         (lambda: models.mobilenet_v1(scale=0.25, num_classes=10), 10),
-        (lambda: models.mobilenet_v2(scale=0.25, num_classes=10), 10),
+        # the three slowest-to-trace families keep default coverage via
+        # the v1/vgg/alexnet rows; run them with --slow
+        pytest.param(lambda: models.mobilenet_v2(scale=0.25, num_classes=10),
+                     10, marks=pytest.mark.slow),
         (lambda: models.alexnet(num_classes=10), 10),
-        (lambda: models.mobilenet_v3_small(scale=0.5, num_classes=10), 10),
-        (lambda: models.mobilenet_v3_large(scale=0.5, num_classes=10), 10),
+        pytest.param(lambda: models.mobilenet_v3_small(scale=0.5,
+                                                       num_classes=10),
+                     10, marks=pytest.mark.slow),
+        pytest.param(lambda: models.mobilenet_v3_large(scale=0.5,
+                                                       num_classes=10),
+                     10, marks=pytest.mark.slow),
     ])
     def test_forward_shape(self, factory, ch):
         paddle.seed(0)

@@ -1,0 +1,125 @@
+"""Repo tools (reference `tools/CrossStackProfiler/` + the op-benchmark CI
+gate `tools/check_op_benchmark_result.py`): trace merging with per-rank
+lanes and clock alignment, the cross-rank op summary, and the bench
+regression gate against real BENCH_r*.json artifacts."""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = str(pathlib.Path(__file__).resolve().parent.parent)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_bench_result as gate  # noqa: E402
+import cross_stack_profiler as csp  # noqa: E402
+
+
+def _trace(events):
+    return {"traceEvents": [
+        {"name": n, "ph": "X", "cat": "op", "ts": ts, "dur": d,
+         "pid": 1234, "tid": 0} for n, ts, d in events]}
+
+
+class TestCrossStackProfiler:
+    def test_merge_assigns_rank_lanes_and_aligns(self, tmp_path):
+        (tmp_path / "rank_0.json").write_text(json.dumps(
+            _trace([("matmul", 1000.0, 5.0)])))
+        (tmp_path / "rank_1.json").write_text(json.dumps(
+            _trace([("matmul", 9000.0, 7.0)])))  # different host clock
+        traces = csp.load_rank_traces(str(tmp_path))
+        merged = csp.merge_traces(traces, align=True)
+        xs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        assert {e["pid"] for e in xs} == {0, 1}
+        assert all(e["ts"] == 0.0 for e in xs)  # aligned to rank t0
+        names = [e for e in merged["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert {m["args"]["name"] for m in names} == {"rank 0", "rank 1"}
+
+    def test_op_summary_aggregates_across_ranks(self):
+        traces = {0: _trace([("conv", 0, 10.0), ("conv", 20, 30.0)]),
+                  1: _trace([("conv", 0, 20.0), ("relu", 5, 1.0)])}
+        rows = csp.op_summary(traces)
+        conv = next(r for r in rows if r["name"] == "conv")
+        assert conv["calls"] == 3
+        assert conv["total_us"] == pytest.approx(60.0)
+        assert conv["max_us"] == pytest.approx(30.0)
+        assert conv["by_rank"] == {0: 40.0, 1: 20.0}
+        assert rows[0]["name"] == "conv"  # sorted by total desc
+
+    def test_cli_end_to_end(self, tmp_path):
+        d = tmp_path / "traces"
+        d.mkdir()
+        (d / "worker_0.json").write_text(json.dumps(
+            _trace([("step", 0, 100.0)])))
+        out = tmp_path / "merged.json"
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "cross_stack_profiler.py"),
+             "--trace_dir", str(d), "--out", str(out), "--summary"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert out.exists()
+        assert "step" in r.stdout
+
+    def test_merges_real_profiler_export(self, tmp_path):
+        """End-to-end with the actual paddle_tpu profiler output format."""
+        import paddle_tpu as paddle
+        from paddle_tpu import profiler as P
+        prof = P.Profiler()
+        prof.start()
+        with P.RecordEvent("span_a"):
+            paddle.to_tensor(np.ones(4)) * 2
+        prof.stop()
+        f0 = str(tmp_path / "rank_0.json")
+        prof.export(f0)
+        traces = csp.load_rank_traces([f0])
+        rows = csp.op_summary(traces)
+        assert any(r["name"] == "span_a" for r in rows)
+
+
+class TestBenchGate:
+    BASE = {"configs": {
+        "gpt": {"tokens_per_sec_chip": 100000.0},
+        "resnet": {"samples_per_sec_chip": 2000.0},
+        "ps": {"examples_per_sec": 10000.0}}}
+
+    def test_ok_and_improved(self):
+        cur = {"configs": {
+            "gpt": {"tokens_per_sec_chip": 101000.0},
+            "resnet": {"samples_per_sec_chip": 2500.0},
+            "ps": {"examples_per_sec": 9900.0}}}
+        rows = gate.compare(self.BASE, cur, 0.05)
+        by = {r[0]: r[5] for r in rows}
+        assert by == {"gpt": "ok", "resnet": "improved", "ps": "ok"}
+
+    def test_regression_detected(self):
+        cur = {"configs": {
+            "gpt": {"tokens_per_sec_chip": 80000.0},
+            "resnet": {"samples_per_sec_chip": 2000.0},
+            "ps": {"examples_per_sec": 10000.0}}}
+        rows = gate.compare(self.BASE, cur, 0.05)
+        assert ("gpt", "tokens_per_sec_chip", 100000.0, 80000.0, -0.2,
+                "regressed") in rows
+
+    def test_missing_config_fails(self):
+        cur = {"configs": {"gpt": {"tokens_per_sec_chip": 100000.0}}}
+        rows = gate.compare(self.BASE, cur, 0.05)
+        assert any(r[5] == "missing" for r in rows)
+
+    def test_cli_on_real_driver_artifacts(self, tmp_path):
+        """The gate must parse the actual driver BENCH files in the repo."""
+        base = os.path.join(REPO, "BENCH_r02.json")
+        cur = os.path.join(REPO, "BENCH_r04.json")
+        if not (os.path.exists(base) and os.path.exists(cur)):
+            pytest.skip("driver bench artifacts absent")
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "check_bench_result.py"),
+             "--baseline", base, "--current", cur, "--threshold", "0.05"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode in (0, 1), r.stderr  # parses + gates
+        assert "gpt2_small" in r.stdout

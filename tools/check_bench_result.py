@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Benchmark regression gate — compare a bench.py JSON result against a
+baseline and fail on regressions.
+
+Reference: `tools/check_op_benchmark_result.py` (the op-benchmark CI gate:
+parse the PR run and the develop-branch logs, alarm when speed or accuracy
+regress past a threshold). Here the artifacts are the driver's
+`BENCH_r{N}.json` files / a raw `python bench.py` output line: every config
+with a throughput-like metric is compared, and a relative drop beyond
+--threshold (default 5%) fails the gate. Higher-is-better metrics only —
+step_time_ms is derived from them and would double-count.
+
+CLI:
+    python tools/check_bench_result.py --baseline BENCH_r04.json \
+        --current BENCH_r05.json [--threshold 0.05]
+Exit code 0 = no regression, 1 = regression, 2 = unusable inputs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional, Tuple
+
+# throughput metrics, higher is better
+_METRICS = ("tokens_per_sec_chip", "samples_per_sec_chip",
+            "examples_per_sec")
+
+
+def _load(path: str) -> dict:
+    """Accept a raw `python bench.py` line, a pretty-printed bench object,
+    or a driver BENCH_r{N}.json wrapper (bench line embedded in `tail`)."""
+    with open(path) as f:
+        txt = f.read().strip()
+    try:
+        doc = json.loads(txt)
+        if isinstance(doc, dict):
+            if "configs" in doc or "value" in doc:
+                return doc
+            tail = doc.get("tail")
+            if isinstance(tail, str):
+                txt = tail  # fall through to line scanning below
+    except json.JSONDecodeError:
+        pass
+    for line in reversed(txt.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            return json.loads(line)
+    raise ValueError(f"{path}: no bench JSON object found")
+
+
+def _configs(doc: dict) -> Dict[str, dict]:
+    cfgs = doc.get("configs") or {}
+    # a bare headline value still gates the flagship
+    if not cfgs and doc.get("value") is not None:
+        cfgs = {"headline": {"tokens_per_sec_chip": doc["value"]}}
+    return cfgs
+
+
+def _metric_of(cfg: dict) -> Optional[Tuple[str, float]]:
+    for m in _METRICS:
+        if isinstance(cfg.get(m), (int, float)):
+            return m, float(cfg[m])
+    return None
+
+
+def compare(baseline: dict, current: dict, threshold: float):
+    """[(config, metric, base, cur, rel_change, status)] — status in
+    {"ok", "improved", "regressed", "new", "missing"}."""
+    rows = []
+    base_cfgs = _configs(baseline)
+    cur_cfgs = _configs(current)
+    for name, bc in base_cfgs.items():
+        bm = _metric_of(bc)
+        if bm is None:
+            continue
+        metric, bval = bm
+        cc = cur_cfgs.get(name)
+        cm = _metric_of(cc) if cc else None
+        if cm is None:
+            rows.append((name, metric, bval, None, None, "missing"))
+            continue
+        cval = cm[1]
+        rel = (cval - bval) / bval if bval else 0.0
+        status = ("regressed" if rel < -threshold
+                  else "improved" if rel > threshold else "ok")
+        rows.append((name, metric, bval, cval, rel, status))
+    for name, cc in cur_cfgs.items():
+        if name not in base_cfgs and _metric_of(cc):
+            m, v = _metric_of(cc)
+            rows.append((name, m, None, v, None, "new"))
+    return rows
+
+
+def format_rows(rows) -> str:
+    lines = [f"{'config':<24} {'metric':<22} {'baseline':>12} "
+             f"{'current':>12} {'change':>8}  status"]
+    for name, metric, b, c, rel, status in rows:
+        bs = f"{b:,.1f}" if b is not None else "-"
+        cs = f"{c:,.1f}" if c is not None else "-"
+        rs = f"{100 * rel:+.1f}%" if rel is not None else "-"
+        lines.append(f"{name:<24} {metric:<22} {bs:>12} {cs:>12} {rs:>8}  "
+                     f"{status}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", required=True)
+    ap.add_argument("--current", required=True)
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative drop that fails the gate (default 5%%)")
+    args = ap.parse_args(argv)
+    try:
+        rows = compare(_load(args.baseline), _load(args.current),
+                       args.threshold)
+    except (OSError, ValueError) as e:
+        print(f"check_bench_result: {e}", file=sys.stderr)
+        return 2
+    print(format_rows(rows))
+    bad = [r for r in rows if r[5] in ("regressed", "missing")]
+    if bad:
+        print(f"\nFAIL: {len(bad)} config(s) regressed or missing "
+              f"(threshold {100 * args.threshold:.0f}%)")
+        return 1
+    print("\nOK: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Cross-stack profiler merge — combine per-rank profiler traces into one
+timeline + an aggregated op summary.
+
+Reference: `tools/CrossStackProfiler/` (`CspReporter.py:66` merges per-rank
+DCGM/net/op-profile readers into grouped chrome traces, aligning clocks via
+a shared time file). The TPU translation: every rank of a
+`paddle.distributed.launch` job exports a chrome trace
+(`paddle_tpu.profiler.Profiler.export`); this tool merges them into a
+single chrome://tracing JSON with one process lane per rank (clock-aligned
+to each rank's first event, the `_set_timeInfo` role) and reports per-op
+aggregate statistics across ranks.
+
+CLI:
+    python tools/cross_stack_profiler.py --trace_dir LOGDIR --out merged.json
+where LOGDIR holds `rank_<i>.json` traces (any *.json works; rank inferred
+from the filename's trailing integer, else file order).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+from collections import defaultdict
+from typing import Dict, Iterable, List, Tuple
+
+
+def _rank_of(path: str, fallback: int) -> int:
+    m = re.search(r"(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else fallback
+
+
+def load_rank_traces(trace_dir_or_files) -> Dict[int, dict]:
+    """{rank: chrome-trace dict} from a directory or explicit file list."""
+    if isinstance(trace_dir_or_files, (list, tuple)):
+        files = list(trace_dir_or_files)
+    else:
+        files = sorted(glob.glob(os.path.join(trace_dir_or_files, "*.json")))
+    if not files:
+        raise FileNotFoundError(f"no trace .json files in {trace_dir_or_files}")
+    out = {}
+    for i, f in enumerate(files):
+        with open(f) as fh:
+            out[_rank_of(f, i)] = json.load(fh)
+    return out
+
+
+def merge_traces(traces: Dict[int, dict], align: bool = True) -> dict:
+    """One chrome trace with a process lane per rank.
+
+    `align=True` subtracts each rank's first-event timestamp so lanes start
+    together (ranks have independent host clocks — the reference aligns via
+    `time.txt` prefixes, CspReporter._set_timeInfo)."""
+    merged: List[dict] = []
+    for rank in sorted(traces):
+        events = traces[rank].get("traceEvents", [])
+        t0 = min((e["ts"] for e in events if "ts" in e), default=0.0) \
+            if align else 0.0
+        merged.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "args": {"name": f"rank {rank}"}})
+        for e in events:
+            e2 = dict(e)
+            e2["pid"] = rank
+            if align and "ts" in e2:
+                e2["ts"] = e2["ts"] - t0
+            merged.append(e2)
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "metadata": {"producer": "paddle_tpu.tools.cross_stack_profiler",
+                         "ranks": sorted(traces)}}
+
+
+def op_summary(traces: Dict[int, dict]) -> List[dict]:
+    """Per-op aggregate across ranks: calls, total/mean/max duration (us),
+    per-rank total — the reporter's op table, sorted by total desc."""
+    acc: Dict[str, dict] = defaultdict(
+        lambda: {"calls": 0, "total_us": 0.0, "max_us": 0.0,
+                 "by_rank": defaultdict(float)})
+    for rank, tr in traces.items():
+        for e in tr.get("traceEvents", []):
+            if e.get("ph") != "X":
+                continue
+            a = acc[e["name"]]
+            dur = float(e.get("dur", 0.0))
+            a["calls"] += 1
+            a["total_us"] += dur
+            a["max_us"] = max(a["max_us"], dur)
+            a["by_rank"][rank] += dur
+    rows = []
+    for name, a in acc.items():
+        rows.append({
+            "name": name, "calls": a["calls"],
+            "total_us": round(a["total_us"], 3),
+            "mean_us": round(a["total_us"] / max(a["calls"], 1), 3),
+            "max_us": round(a["max_us"], 3),
+            "by_rank": {r: round(v, 3) for r, v in sorted(
+                a["by_rank"].items())},
+        })
+    rows.sort(key=lambda r: -r["total_us"])
+    return rows
+
+
+def format_summary(rows: Iterable[dict]) -> str:
+    lines = [f"{'op':<40} {'calls':>7} {'total(us)':>12} {'mean(us)':>10} "
+             f"{'max(us)':>10}"]
+    for r in rows:
+        lines.append(f"{r['name'][:40]:<40} {r['calls']:>7} "
+                     f"{r['total_us']:>12.1f} {r['mean_us']:>10.1f} "
+                     f"{r['max_us']:>10.1f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace_dir", required=True,
+                    help="directory of per-rank chrome traces")
+    ap.add_argument("--out", required=True, help="merged trace output path")
+    ap.add_argument("--no-align", action="store_true",
+                    help="keep raw per-rank clocks")
+    ap.add_argument("--summary", action="store_true",
+                    help="print the cross-rank op summary table")
+    args = ap.parse_args(argv)
+    traces = load_rank_traces(args.trace_dir)
+    merged = merge_traces(traces, align=not args.no_align)
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    print(f"merged {len(traces)} rank traces -> {args.out}")
+    if args.summary:
+        print(format_summary(op_summary(traces)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -217,6 +217,25 @@ def _mark_uncacheable(impl, kwargs, arrs):
         _eager_cache[key] = _UNCACHEABLE
 
 
+def _try_cached_fwd(impl, kwargs, arrs, name):
+    """Attempt the cached jitted forward; (entry, outs) on success, else
+    (None, None) — the impl needs CONCRETE values (float()/np conversions
+    work under jax.vjp, whose primals are concrete, but not under jit), so
+    the key is blacklisted and the caller re-runs eagerly, re-raising any
+    genuine op error."""
+    entry = _cache_lookup(impl, kwargs, arrs)
+    if entry is None:
+        return None, None
+    try:
+        outs = entry.fwd(*arrs)
+    except Exception:
+        _mark_uncacheable(impl, kwargs, arrs)
+        return None, None
+    if _nan_check_on():
+        _check_nan_inf(name, outs)
+    return entry, outs
+
+
 def clear_eager_cache():
     _eager_cache.clear()
     _eager_seen.clear()
@@ -251,17 +270,7 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
                 and any(_wants_grad(t) for t in tensors))
 
     if requires:
-        entry = _cache_lookup(impl, kwargs, arrs)
-        if entry is not None:
-            try:
-                outs = entry.fwd(*arrs)
-            except Exception:
-                # the impl needs CONCRETE values (float()/np conversions are
-                # fine under jax.vjp — its primals are concrete — but not
-                # under jit). Blacklist this key and take the re-trace path;
-                # the eager call below re-raises any genuine op error.
-                _mark_uncacheable(impl, kwargs, arrs)
-                entry = None
+        entry, outs = _try_cached_fwd(impl, kwargs, arrs, name)
         if entry is not None:
             vjp_fn = entry.make_vjp(arrs)
             prim_fn = entry.prim
@@ -271,8 +280,8 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
                 return out if isinstance(out, tuple) else (out,)
             outs, vjp_fn = jax.vjp(tup_impl, *arrs)
             prim_fn = tup_impl
-        if _nan_check_on():
-            _check_nan_inf(name, outs)
+            if _nan_check_on():
+                _check_nan_inf(name, outs)
         out_tensors = tuple(Tensor(o, stop_gradient=False) for o in outs)
         in_refs = [t if isinstance(t, Tensor) else None for t in tensors]
         # prim_fn/in_arrs make the node replayable for create_graph (double
@@ -281,6 +290,14 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
                         prim_fn=prim_fn, in_arrs=arrs)
         return out_tensors[0] if len(out_tensors) == 1 else out_tensors
     else:
+        # no-grad (inference/eval) eager path rides the same cache: jitted
+        # forward, with the identical concreteness fallback. A genuine
+        # 1-tuple op output collapses to a single Tensor here, matching the
+        # grad path's long-standing convention.
+        entry, outs = _try_cached_fwd(impl, kwargs, arrs, name)
+        if entry is not None:
+            out_tensors = tuple(Tensor(o, stop_gradient=True) for o in outs)
+            return out_tensors[0] if len(out_tensors) == 1 else out_tensors
         out = impl(*arrs, **kwargs)
         if _nan_check_on():
             _check_nan_inf(name, out if isinstance(out, tuple) else (out,))

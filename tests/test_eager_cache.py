@@ -128,6 +128,32 @@ class TestCorrectness:
         np.testing.assert_allclose(np.asarray(g2.data), [18.0], rtol=1e-5)
 
 
+class TestNoGradPath:
+    def test_eval_outputs_match_uncached(self, fresh_cache):
+        net, _ = _build()
+        net.eval()
+        x = paddle.to_tensor(np.random.default_rng(3).normal(
+            size=(4, 32)).astype("float32"))
+        with paddle.no_grad():
+            flags.set_flags({"FLAGS_eager_op_cache": False})
+            ref = net(x).numpy()
+            flags.set_flags({"FLAGS_eager_op_cache": True})
+            for _ in range(3):
+                got = net(x).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+        assert _dispatch._cache_stats["hit"] > 0
+
+    def test_dynamic_shape_op_falls_back(self, fresh_cache):
+        """masked_select's output shape is data-dependent — untraceable, so
+        it must blacklist itself and stay on the eager path."""
+        x = paddle.to_tensor(np.arange(6, dtype="float32"))
+        m = paddle.to_tensor(np.array([1, 0, 1, 0, 1, 1], bool))
+        with paddle.no_grad():
+            for _ in range(3):
+                out = paddle.masked_select(x, m)
+        np.testing.assert_array_equal(out.numpy(), [0, 2, 4, 5])
+
+
 class TestDispatchSpeed:
     def test_cached_step_much_faster(self, fresh_cache):
         """Full eager train step (fwd+bwd+Adam) >= 3x faster with the cache

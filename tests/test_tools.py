@@ -105,6 +105,29 @@ class TestBenchGate:
         assert ("gpt", "tokens_per_sec_chip", 100000.0, 80000.0, -0.2,
                 "regressed") in rows
 
+    def test_same_metric_enforced(self):
+        """Current config reporting a DIFFERENT (higher-priority) metric
+        must read as missing, not compared across units."""
+        cur = {"configs": {
+            "gpt": {"tokens_per_sec_chip": 100000.0},
+            "resnet": {"tokens_per_sec_chip": 500000.0},  # unit switch
+            "ps": {"examples_per_sec": 10000.0}}}
+        rows = gate.compare(self.BASE, cur, 0.05)
+        by = {r[0]: r[5] for r in rows}
+        assert by["resnet"] == "missing"
+
+    def test_zero_baseline_unusable(self):
+        base = {"configs": {"gpt": {"tokens_per_sec_chip": 0.0}}}
+        cur = {"configs": {"gpt": {"tokens_per_sec_chip": 1.0}}}
+        rows = gate.compare(base, cur, 0.05)
+        assert rows[0][5] == "missing"
+
+    def test_duplicate_rank_files_rejected(self, tmp_path):
+        (tmp_path / "rank_0.json").write_text(json.dumps(_trace([])))
+        (tmp_path / "worker_0.json").write_text(json.dumps(_trace([])))
+        with pytest.raises(ValueError, match="rank 0"):
+            csp.load_rank_traces(str(tmp_path))
+
     def test_missing_config_fails(self):
         cur = {"configs": {"gpt": {"tokens_per_sec_chip": 100000.0}}}
         rows = gate.compare(self.BASE, cur, 0.05)

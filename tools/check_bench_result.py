@@ -75,16 +75,22 @@ def compare(baseline: dict, current: dict, threshold: float):
         if bm is None:
             continue
         metric, bval = bm
-        cc = cur_cfgs.get(name)
-        cm = _metric_of(cc) if cc else None
-        if cm is None:
+        if bval <= 0:
+            # a zero/negative baseline (crashed bench round) can't gate
+            # anything — comparing against it would pass any collapse
             rows.append((name, metric, bval, None, None, "missing"))
             continue
-        cval = cm[1]
-        rel = (cval - bval) / bval if bval else 0.0
+        # compare the SAME metric, never a different one the current round
+        # happens to also report (units would be incomparable)
+        cc = cur_cfgs.get(name) or {}
+        cval = cc.get(metric)
+        if not isinstance(cval, (int, float)):
+            rows.append((name, metric, bval, None, None, "missing"))
+            continue
+        rel = (cval - bval) / bval
         status = ("regressed" if rel < -threshold
                   else "improved" if rel > threshold else "ok")
-        rows.append((name, metric, bval, cval, rel, status))
+        rows.append((name, metric, bval, float(cval), rel, status))
     for name, cc in cur_cfgs.items():
         if name not in base_cfgs and _metric_of(cc):
             m, v = _metric_of(cc)

@@ -41,9 +41,17 @@ def load_rank_traces(trace_dir_or_files) -> Dict[int, dict]:
     if not files:
         raise FileNotFoundError(f"no trace .json files in {trace_dir_or_files}")
     out = {}
+    sources = {}
     for i, f in enumerate(files):
+        rank = _rank_of(f, i)
+        if rank in out:
+            raise ValueError(
+                f"rank {rank} inferred for both {sources[rank]!r} and "
+                f"{f!r} — rename the trace files so each carries a unique "
+                "trailing rank number")
         with open(f) as fh:
-            out[_rank_of(f, i)] = json.load(fh)
+            out[rank] = json.load(fh)
+        sources[rank] = f
     return out
 
 

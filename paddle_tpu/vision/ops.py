@@ -31,7 +31,7 @@ __all__ = [
     "yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D",
     "read_file", "decode_jpeg",
     "roi_pool", "RoIPool", "psroi_pool", "PSRoIPool",
-    "roi_align", "RoIAlign", "nms",
+    "roi_align", "RoIAlign", "nms", "multiclass_nms",
 ]
 
 
@@ -606,6 +606,77 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     if top_k is not None:
         out = out[:top_k]
     return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    """Multi-class NMS (reference `fluid/layers/detection.py:3276`,
+    `detection/multiclass_nms_op`): per image, per non-background class —
+    score-threshold filter, top nms_top_k by score, greedy NMS at
+    nms_threshold, then keep_top_k across classes. Returns (out
+    [N, keep_top_k, 6], valid_counts [N]) with rows
+    (label, confidence, x1, y1, x2, y2); unused rows carry label -1 — the
+    reference's variable-length LoD output made static-shape for XLA.
+    `nms_eta` (adaptive threshold decay) is accepted for signature parity;
+    only the standard eta=1 behavior is implemented."""
+    b = _unwrap(bboxes).astype(jnp.float32)   # [N, M, 4]
+    s = _unwrap(scores).astype(jnp.float32)   # [N, C, M]
+    N, M = b.shape[0], b.shape[1]
+    C = s.shape[1]
+    top_k = int(keep_top_k) if keep_top_k > 0 else M * C
+
+    def impl(bv, sv, *, score_threshold, nms_top_k, top_k, nms_threshold,
+             background_label, C, M):
+        def one_image(boxes, sc):
+            # [C, M] scores; suppress per class, classes never interact
+            def one_class(c_scores):
+                keep = c_scores > score_threshold
+                sc_f = jnp.where(keep, c_scores, -jnp.inf)
+                if 0 < nms_top_k < M:
+                    kth = jnp.sort(sc_f)[-nms_top_k]
+                    sc_f = jnp.where(sc_f >= kth, sc_f, -jnp.inf)
+                order = jnp.argsort(-sc_f)
+                bo = boxes[order]
+                iou = _iou_matrix(bo)
+
+                def body(i, kp):
+                    sup = jnp.any((iou[i] > nms_threshold) & kp
+                                  & (jnp.arange(M) < i))
+                    return kp.at[i].set(jnp.logical_not(sup))
+
+                kp = jax.lax.fori_loop(1, M, body,
+                                       jnp.ones((M,), bool))
+                kp = kp & jnp.isfinite(sc_f[order])
+                # back to box order: kept score or -inf
+                kept = jnp.full((M,), -jnp.inf).at[order].set(
+                    jnp.where(kp, sc_f[order], -jnp.inf))
+                return kept
+
+            kept = jax.vmap(one_class)(sc)  # [C, M]
+            if 0 <= background_label < C:
+                kept = kept.at[background_label].set(-jnp.inf)
+            flat = kept.reshape(-1)  # class-major [C*M]
+            idx = jnp.argsort(-flat)[:top_k]
+            cls = (idx // M).astype(jnp.float32)
+            box_i = idx % M
+            conf = flat[idx]
+            valid = jnp.isfinite(conf)
+            rows = jnp.concatenate(
+                [jnp.where(valid, cls, -1.0)[:, None],
+                 jnp.where(valid, conf, 0.0)[:, None],
+                 jnp.where(valid[:, None], boxes[box_i], 0.0)], axis=1)
+            return rows, valid.sum().astype(jnp.int32)
+
+        return jax.vmap(one_image)(bv, sv)
+
+    return _d.call(
+        impl, (Tensor(b, stop_gradient=True), Tensor(s, stop_gradient=True)),
+        dict(score_threshold=float(score_threshold),
+             nms_top_k=int(nms_top_k), top_k=top_k,
+             nms_threshold=float(nms_threshold),
+             background_label=int(background_label), C=C, M=M),
+        name="multiclass_nms", nondiff=True)
 
 
 # ---------------------------------------------------------------------------

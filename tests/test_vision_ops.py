@@ -283,6 +283,49 @@ class TestNms:
         assert list(keep.numpy()) == [1, 2]
 
 
+class TestMulticlassNms:
+    def test_reference_docstring_example(self):
+        """The reference's own worked example (fluid detection.py:3283):
+        two overlapping boxes, three classes, background 0."""
+        boxes = np.array([[[2.0, 3.0, 7.0, 5.0], [3.0, 4.0, 8.0, 5.0]]],
+                         "float32")
+        scores = np.array([[[0.7, 0.3],    # class 0 (background)
+                            [0.2, 0.3],    # class 1
+                            [0.4, 0.1]]],  # class 2
+                          "float32")
+        out, counts = V.multiclass_nms(Tensor(boxes), Tensor(scores),
+                                       score_threshold=0.0, nms_top_k=-1,
+                                       keep_top_k=10, nms_threshold=0.3)
+        n = int(counts.numpy()[0])
+        assert n == 2
+        rows = out.numpy()[0][:n]
+        rows = rows[np.argsort(rows[:, 0])]  # by label
+        np.testing.assert_allclose(rows[0], [1, 0.3, 3, 4, 8, 5], atol=1e-5)
+        np.testing.assert_allclose(rows[1], [2, 0.4, 2, 3, 7, 5], atol=1e-5)
+
+    def test_per_class_suppression_and_keep_top_k(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [20, 20, 30, 30]]], "float32")
+        scores = np.zeros((1, 2, 3), "float32")
+        scores[0, 1] = [0.9, 0.8, 0.7]  # class 1: first two overlap
+        out, counts = V.multiclass_nms(Tensor(boxes), Tensor(scores),
+                                       score_threshold=0.1, nms_top_k=-1,
+                                       keep_top_k=1, nms_threshold=0.5,
+                                       background_label=0)
+        assert int(counts.numpy()[0]) == 1
+        row = out.numpy()[0][0]
+        assert row[0] == 1 and row[1] == pytest.approx(0.9)
+
+    def test_padded_rows_carry_label_minus_one(self):
+        boxes = np.array([[[0, 0, 1, 1]]], "float32")
+        scores = np.array([[[0.0], [0.05]]], "float32")  # below threshold
+        out, counts = V.multiclass_nms(Tensor(boxes), Tensor(scores),
+                                       score_threshold=0.2, nms_top_k=-1,
+                                       keep_top_k=4, nms_threshold=0.3)
+        assert int(counts.numpy()[0]) == 0
+        assert np.all(out.numpy()[0][:, 0] == -1)
+
+
 class TestIO:
     def test_read_file_decode_jpeg_roundtrip(self, tmp_path):
         from PIL import Image

@@ -20,6 +20,12 @@ import time
 WARMUP = 3
 ITERS = 40  # long chain amortizes per-dispatch host/tunnel latency
 
+# hbm_gb_per_step / hw_flops_util provenance (VERDICT r5 Weak #6): they come
+# from compiled.cost_analysis(), not hardware counters — say so in the JSON
+ESTIMATES_NOTE = ("hbm_gb_per_step and hw_flops_util are XLA cost-analysis "
+                  "ESTIMATES (upper bound, cache-oblivious), not measured "
+                  "hardware counters")
+
 # bf16 peak of one v5e chip; override for other parts (v4: 275e12, v5p: 459e12)
 PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
@@ -107,55 +113,81 @@ def bench_gpt2():
         "hw_flops_util": (round(flops / sec / PEAK_FLOPS, 4)
                           if flops else None),
         "hbm_gb_per_step": round(nbytes / 1e9, 2) if nbytes else None,
+        "estimates_note": ESTIMATES_NOTE,
     }
 
 
-def bench_resnet50():
+def bench_resnet50(B=128, hw=224, depth=50, probe_iters=8):
+    """Synthetic-ImageNet ResNet train step (BASELINE.md primary metric).
+    The size knobs exist so the harness tests can exercise the full probe/
+    compare logic at CPU-feasible shapes; the bench runs the defaults."""
     import numpy as np
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
     from paddle_tpu.jit import TrainStep
-    from paddle_tpu.models.resnet import resnet50
+    from paddle_tpu.models.resnet import ResNet, BasicBlock, BottleneckBlock
     from paddle_tpu.nn import functional as F
 
-    B = 128  # synthetic ImageNet shapes (BASELINE.md primary metric)
     rng = np.random.default_rng(0)
-    img_np = rng.normal(size=(B, 3, 224, 224)).astype("float32")
+    img_np = rng.normal(size=(B, 3, hw, hw)).astype("float32")
     imgs = {"NCHW": paddle.to_tensor(img_np),
             "NHWC": paddle.to_tensor(
                 np.ascontiguousarray(img_np.transpose(0, 2, 3, 1)))}
     labels = paddle.to_tensor(rng.integers(0, 1000, (B,)).astype("int32"))
 
-    def build(rc, df):
+    def build(rc, df, fused):
         paddle.seed(0)
-        model = resnet50(recompute=rc, data_format=df)
+        block = BottleneckBlock if depth >= 50 else BasicBlock
+        model = ResNet(block, depth, recompute=rc, data_format=df,
+                       fused_bn=fused)
         opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                  parameters=model.parameters())
         return TrainStep(model, F.cross_entropy, opt,
                          amp_dtype=jnp.bfloat16)
 
-    # autotune over (remat x data_format) (reference phi/kernels/autotune/
-    # pattern): whether re-running stage convs beats round-tripping
-    # activations through HBM, and which conv layout XLA schedules best,
-    # are measured, not assumed — short probe per variant, winner runs full
+    # autotune over (remat x data_format) for the FUSED-BN path (reference
+    # phi/kernels/autotune/ pattern), plus unfused reference probes at both
+    # layouts — the fused-vs-unfused delta is the r6 headline (the Pallas
+    # fused BN(+add)+ReLU family, this round's kernel work). Each probe also
+    # keeps its executable's cost-analysis bytes so the HBM reduction is
+    # measured in the same run it is claimed for.
     probes, probe_errs = {}, {}
-    for rc in (False, True):
-        for df in ("NCHW", "NHWC"):
-            try:
-                probes[(rc, df)] = _run_config(
-                    build(rc, df), (imgs[df], labels), iters=8, warmup=2)[0]
-            except Exception as e:  # record, don't swallow: if ALL variants
-                probe_errs[(rc, df)] = f"{type(e).__name__}: {e}"  # die, say why
-    if not probes:
+    variants = [(rc, df, True) for rc in (False, True)
+                for df in ("NCHW", "NHWC")]
+    variants += [(False, df, False) for df in ("NCHW", "NHWC")]
+    for rc, df, fused in variants:
+        try:
+            sec_p, _, _, nbytes_p = _run_config(
+                build(rc, df, fused), (imgs[df], labels), iters=probe_iters,
+                warmup=2)
+            probes[(rc, df, fused)] = (sec_p, nbytes_p)
+        except Exception as e:  # record, don't swallow: if ALL variants
+            probe_errs[(rc, df, fused)] = f"{type(e).__name__}: {e}"
+    fused_probes = {k: v for k, v in probes.items() if k[2]}
+    if not fused_probes:
         raise RuntimeError(f"all resnet probe variants failed: {probe_errs}")
-    best_rc, best_df = min(probes, key=probes.get)
-    step = build(best_rc, best_df)
+    best_rc, best_df, _ = min(fused_probes,
+                              key=lambda k: fused_probes[k][0])
+    step = build(best_rc, best_df, fused=True)
     sec, loss, flops, nbytes = _run_config(step, (imgs[best_df], labels))
+    # unfused comparison at the winning layout/remat (compiled in this same
+    # run; probe-length timing is enough for the ratio)
+    unfused = probes.get((best_rc, best_df, False))
+    if unfused is None:
+        try:
+            sec_u, _, _, nbytes_u = _run_config(
+                build(best_rc, best_df, False), (imgs[best_df], labels),
+                iters=probe_iters, warmup=2)
+            unfused = (sec_u, nbytes_u)
+        except Exception as e:
+            probe_errs[(best_rc, best_df, False)] = f"{type(e).__name__}: {e}"
+    hbm_unfused = unfused[1] if unfused else None
     # ResNet-50 fwd = 4.09 GFLOP per 224x224 image; train = fwd + ~2x bwd
-    model_flops = 3 * 4.09e9 * B
-    return {
-        "name": (f"resnet50 b128 224x224 bf16 {best_df} (synthetic ImageNet"
+    model_flops = 3 * 4.09e9 * B * (hw / 224.0) ** 2
+    out = {
+        "name": (f"resnet{depth} b{B} {hw}x{hw} bf16 {best_df} fused-BN "
+                 "(synthetic ImageNet"
                  + (", per-stage remat" if best_rc else "") + ")"),
         "samples_per_sec_chip": round(B / sec, 1),
         "step_time_ms": round(1000 * sec, 2),
@@ -163,15 +195,31 @@ def bench_resnet50():
         "mfu": round(model_flops / sec / PEAK_FLOPS, 4),
         "hw_flops_util": round(flops / sec / PEAK_FLOPS, 4) if flops else None,
         "hbm_gb_per_step": round(nbytes / 1e9, 2) if nbytes else None,
-        "probe_ms": {f"remat={rc},{df}": round(1000 * t, 1)
-                     for (rc, df), t in probes.items()},
-        "note": ("HBM-bandwidth-bound: backward runs at ~0.9 of peak HBM "
-                 "bandwidth (probed in-round); unfused BN train implies "
-                 "~9 full-activation HBM passes per step, so model-MFU "
-                 "plateaus near 0.15 at any layout/remat until conv+BN "
-                 "fusion moves into a custom kernel. Throughput is at the "
-                 "BASELINE.md A100-parity north star."),
+        "estimates_note": ESTIMATES_NOTE,
+        "probe_ms": {
+            f"{'fused' if fu else 'unfused'},remat={rc},{df}":
+                round(1000 * t, 1)
+            for (rc, df, fu), (t, _) in sorted(probes.items(),
+                                               key=lambda kv: kv[1][0])},
+        "note": ("fused Pallas BN(+add)+ReLU train kernels "
+                 "(ops/pallas/fused_bn.py) replace the unfused BN chain "
+                 "whose ~9 full-activation HBM passes pinned model-MFU near "
+                 "0.15 (r5 analysis); unfused probes kept for the delta."),
     }
+    if probe_errs:
+        out["probe_errors"] = {f"remat={rc},{df},fused={fu}": err
+                               for (rc, df, fu), err in probe_errs.items()}
+    if nbytes and hbm_unfused:
+        out["hbm_gb_per_step_unfused"] = round(hbm_unfused / 1e9, 2)
+        out["hbm_pct_saved_vs_unfused"] = round(
+            100.0 * (1.0 - nbytes / hbm_unfused), 1)
+    fused_probe = probes.get((best_rc, best_df, True))
+    if unfused and fused_probe:
+        # probe-vs-probe at the same config: identical iters/warmup on both
+        # sides, so amortization bias doesn't inflate the headline ratio
+        out["fused_speedup_vs_unfused"] = round(
+            unfused[0] / fused_probe[0], 3)
+    return out
 
 
 def bench_bert_base():
@@ -227,6 +275,7 @@ def bench_bert_base():
         "mfu": round(model_flops / sec / PEAK_FLOPS, 4),
         "hw_flops_util": round(flops / sec / PEAK_FLOPS, 4) if flops else None,
         "hbm_gb_per_step": round(nbytes / 1e9, 2) if nbytes else None,
+        "estimates_note": ESTIMATES_NOTE,
     }
 
 
@@ -452,7 +501,7 @@ def main():
         "configs": {},
         "note": "reference publishes no in-repo baseline "
                 "(BASELINE.json published:{}); peak for MFU = "
-                f"{PEAK_FLOPS/1e12:.0f} TFLOP/s bf16",
+                f"{PEAK_FLOPS/1e12:.0f} TFLOP/s bf16; " + ESTIMATES_NOTE,
     }
     configs = result["configs"]
     init_err = _init_backend_with_retry()

@@ -88,6 +88,13 @@ def __getattr__(name):
 from .framework.place import CUDAPinnedPlace, NPUPlace  # noqa: E402,F401
 from .ops.extras import batch  # noqa: E402,F401
 
+# `paddle.callbacks` namespace alias (reference exposes hapi's callbacks at
+# top level, `python/paddle/callbacks.py`); registered in sys.modules so
+# `import paddle_tpu.callbacks` works, not just attribute access
+from .hapi import callbacks  # noqa: E402,F401
+import sys as _sys  # noqa: E402
+_sys.modules[__name__ + ".callbacks"] = callbacks
+
 
 def enable_static():
     """Switch to static-graph mode (reference `paddle.enable_static`)."""

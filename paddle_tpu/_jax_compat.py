@@ -1,0 +1,52 @@
+"""Version compatibility shims for the small set of jax APIs whose import
+path moved between the jax releases this repo runs against.
+
+Everything here is a re-export: callers use identical semantics on either
+side. Keep this module dependency-free (imported very early).
+"""
+from __future__ import annotations
+
+# shard_map: `jax.shard_map` (new) vs `jax.experimental.shard_map` (old).
+# The old entry point also predates two keyword renames the callers use:
+# `axis_names={...}` (old spelling: `auto=` holds the COMPLEMENT set) and
+# `check_vma=` (old spelling: `check_rep=`), so the fallback is a thin
+# translating wrapper, not a bare re-export.
+try:
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, **kw):
+        if axis_names is not None:
+            # old shard_map's `auto=` (the complement set) raises
+            # NotImplementedError when executed eagerly, so go FULL manual:
+            # axes absent from the specs are replicated per device, which is
+            # numerically identical for bodies that only use collectives
+            # over `axis_names`. check_rep must be off — the replication
+            # checker predates several collectives these kernels use.
+            kw.setdefault("check_rep", False)
+        if check_vma is not None:
+            kw.setdefault("check_rep", check_vma)
+        return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+# Pallas TPU compiler params: `CompilerParams` (new) vs `TPUCompilerParams`
+# (old). Both accept dimension_semantics as strings, which is what the
+# PARALLEL/ARBITRARY constants below are for — the GridDimensionSemantics
+# enum only exists on the new side.
+try:
+    from jax.experimental.pallas.tpu import CompilerParams as TPUCompilerParams  # type: ignore
+except ImportError:  # pragma: no cover
+    from jax.experimental.pallas.tpu import TPUCompilerParams  # noqa: F401
+
+DIM_PARALLEL = "parallel"
+DIM_ARBITRARY = "arbitrary"
+
+
+# jax.lax.axis_size arrived after 0.4.x; psum(1, axis) is the portable form
+def axis_size(axis_name):
+    import jax
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

@@ -33,6 +33,9 @@ from . import ps  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from .spawn import spawn  # noqa: F401
+# dataset classes live on fleet but the reference also exposes them at
+# `paddle.distributed.*` (`python/paddle/distributed/__init__.py`)
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 
 # bind paddle.DataParallel lazily (top-level package avoids import cycle)
 import paddle_tpu as _paddle

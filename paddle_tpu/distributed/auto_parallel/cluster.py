@@ -78,9 +78,25 @@ class Mapper:
         ici = dcn = 0.0
         for nbytes, line in _iter_collective_lines(compiled):
             groups = _parse_replica_groups(line)
-            crosses = any(
-                len({self.cluster.slice_of(d) for d in g}) > 1
-                for g in groups) if groups else False
+            if groups:
+                crosses = any(
+                    len({self.cluster.slice_of(d) for d in g}) > 1
+                    for g in groups)
+            else:
+                pairs = _parse_source_target_pairs(line)
+                if pairs is not None:
+                    # collective-permute: priced by its actual pairs (it
+                    # never carries replica_groups — a ring over an ICI
+                    # axis must NOT be billed at DCN rates)
+                    crosses = any(
+                        self.cluster.slice_of(s) != self.cluster.slice_of(t)
+                        for s, t in pairs)
+                else:
+                    # XLA's all-replica form `replica_groups={}` ([] here)
+                    # and a group-carrying collective with the attribute
+                    # missing both span every device: on a >1-slice cluster
+                    # that necessarily crosses DCN
+                    crosses = self.cluster.n_slices > 1
             if crosses:
                 dcn += nbytes
             else:
@@ -90,7 +106,11 @@ class Mapper:
 
 def _parse_replica_groups(line: str) -> Optional[List[List[int]]]:
     """Parse HLO `replica_groups=` — explicit `{{0,1},{2,3}}` lists and the
-    iota form `[G,S]<=[dims](T(perm))?`. Returns None when absent."""
+    iota form `[G,S]<=[dims](T(perm))?`. Returns None when absent and []
+    for the empty all-replica form `replica_groups={}` (one group spanning
+    every device — the caller attributes it by cluster topology)."""
+    if re.search(r"replica_groups=\{\s*\}", line):
+        return []
     m = re.search(r"replica_groups=\{\{([^}]*(?:\},\{[^}]*)*)\}\}", line)
     if m:
         return [[int(x) for x in grp.split(",") if x.strip() != ""]
@@ -106,6 +126,19 @@ def _parse_replica_groups(line: str) -> Optional[List[List[int]]]:
             ids = ids.transpose(perm)
         return ids.reshape(g, s).tolist()
     return None
+
+
+def _parse_source_target_pairs(line: str):
+    """Parse collective-permute's `source_target_pairs={{0,1},{1,2}}`.
+    Returns a list of (src, dst) pairs, or None when absent."""
+    m = re.search(r"source_target_pairs=\{\{([^}]*(?:\},\{[^}]*)*)\}\}", line)
+    if not m:
+        return None
+    out = []
+    for grp in m.group(1).split("},{"):
+        s, t = (int(x) for x in grp.split(","))
+        out.append((s, t))
+    return out
 
 
 __all__ = ["Cluster", "Mapper", "DEFAULT_ICI_BW", "DEFAULT_DCN_BW"]

@@ -8,3 +8,6 @@ from . import nn  # noqa: F401
 from . import operators  # noqa: F401
 from .operators import (  # noqa: F401
     graph_send_recv, softmax_mask_fuse, softmax_mask_fuse_upper_triangle)
+# reference exposes these at `paddle.incubate.*` directly
+# (`python/paddle/incubate/__init__.py`), not just `incubate.optimizer.*`
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

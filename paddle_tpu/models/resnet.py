@@ -11,24 +11,31 @@ class BasicBlock(nn.Layer):
                  groups=1, base_width=64, dilation=1, norm_layer=None,
                  data_format="NCHW"):
         super().__init__()
+        # default BN -> fused BN(+add)+ReLU tails (Pallas kernels); a custom
+        # norm_layer keeps the unfused composition (it has no act=/residual=)
+        self._fused = norm_layer is None
         norm_layer = norm_layer or nn.BatchNorm2D
         df = dict(data_format=data_format)
+        act = dict(act="relu") if self._fused else {}
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
                                bias_attr=False, **df)
-        self.bn1 = norm_layer(planes, **df)
+        self.bn1 = norm_layer(planes, **df, **act)
         self.relu = nn.ReLU()
         self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
                                **df)
-        self.bn2 = norm_layer(planes, **df)
+        self.bn2 = norm_layer(planes, **df, **act)
         self.downsample = downsample
         self.stride = stride
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.bn2(self.conv2(out))
         if self.downsample is not None:
             identity = self.downsample(x)
+        if self._fused:
+            out = self.bn1(self.conv1(x))
+            return self.bn2(self.conv2(out), identity)
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
         return self.relu(out + identity)
 
 
@@ -39,35 +46,41 @@ class BottleneckBlock(nn.Layer):
                  groups=1, base_width=64, dilation=1, norm_layer=None,
                  data_format="NCHW"):
         super().__init__()
+        self._fused = norm_layer is None
         norm_layer = norm_layer or nn.BatchNorm2D
         df = dict(data_format=data_format)
+        act = dict(act="relu") if self._fused else {}
         width = int(planes * (base_width / 64.0)) * groups
         self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, **df)
-        self.bn1 = norm_layer(width, **df)
+        self.bn1 = norm_layer(width, **df, **act)
         self.conv2 = nn.Conv2D(width, width, 3, padding=1, stride=stride,
                                groups=groups, dilation=dilation,
                                bias_attr=False, **df)
-        self.bn2 = norm_layer(width, **df)
+        self.bn2 = norm_layer(width, **df, **act)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
                                bias_attr=False, **df)
-        self.bn3 = norm_layer(planes * self.expansion, **df)
+        self.bn3 = norm_layer(planes * self.expansion, **df, **act)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
     def forward(self, x):
         identity = x
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        if self._fused:
+            out = self.bn1(self.conv1(x))
+            out = self.bn2(self.conv2(out))
+            return self.bn3(self.conv3(out), identity)
         out = self.relu(self.bn1(self.conv1(x)))
         out = self.relu(self.bn2(self.conv2(out)))
         out = self.bn3(self.conv3(out))
-        if self.downsample is not None:
-            identity = self.downsample(x)
         return self.relu(out + identity)
 
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
                  with_pool=True, groups=1, recompute=False,
-                 data_format="NCHW"):
+                 data_format="NCHW", fused_bn=True):
         """`recompute=True` rematerializes each residual STAGE's
         activations in backward (reference RecomputeFunction applied at
         `layer1..layer4` granularity): on a bandwidth-bound chip the
@@ -76,10 +89,14 @@ class ResNet(nn.Layer):
 
         `data_format="NHWC"` runs the whole network feature-last
         (reference resnet.py exposes the same knob): on TPU this is XLA's
-        preferred convolution layout and avoids transposes."""
+        preferred convolution layout and avoids transposes.
+
+        `fused_bn=False` keeps every BN+ReLU(+add) as the unfused
+        composition — the bench's fused-vs-unfused comparison knob."""
         super().__init__()
         self._recompute = recompute
         self._data_format = data_format
+        self._fused_bn = fused_bn
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
         layers = layer_cfg[depth]
@@ -93,7 +110,8 @@ class ResNet(nn.Layer):
         df = dict(data_format=data_format)
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
                                bias_attr=False, **df)
-        self.bn1 = self._norm_layer(self.inplanes, **df)
+        self.bn1 = self._norm_layer(self.inplanes, **df,
+                                    act="relu" if fused_bn else None)
         self.relu = nn.ReLU()
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1, **df)
         self.layer1 = self._make_layer(block, 64, layers[0])
@@ -107,6 +125,9 @@ class ResNet(nn.Layer):
 
     def _make_layer(self, block, planes, blocks, stride=1):
         norm_layer = self._norm_layer
+        # blocks see norm_layer=None when fusion is on: the block picks the
+        # fused BN(+add)+ReLU tails only for the default (our) BatchNorm2D
+        block_norm = None if self._fused_bn else norm_layer
         df = dict(data_format=self._data_format)
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
@@ -115,18 +136,20 @@ class ResNet(nn.Layer):
                           stride=stride, bias_attr=False, **df),
                 norm_layer(planes * block.expansion, **df))
         layers = [block(self.inplanes, planes, stride, downsample,
-                        self.groups, self.base_width, 1, norm_layer,
+                        self.groups, self.base_width, 1, block_norm,
                         data_format=self._data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes,
                                 groups=self.groups, base_width=self.base_width,
-                                norm_layer=norm_layer,
+                                norm_layer=block_norm,
                                 data_format=self._data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        x = self.bn1(self.conv1(x))
+        if not self._fused_bn:  # fused stem BN already applied the ReLU
+            x = self.relu(x)
         x = self.maxpool(x)
         if self._recompute and self.training:
             from ..distributed.fleet.utils import recompute

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops import _dispatch as _d
+from ...ops._bn_common import _bn_axes, _bn_stats
 from ...ops._dispatch import kernel
 from ...framework import random as random_mod
 from ...framework.tensor import Tensor
@@ -588,30 +589,10 @@ def _bn_infer(x, rm, rv, w, b, *, epsilon, data_format):
     return out.astype(x.dtype)
 
 
-def _bn_axes(x, data_format):
-    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
-    axes = tuple(i for i in range(x.ndim) if i != c_axis)
-    shape = [1] * x.ndim
-    shape[c_axis] = x.shape[c_axis]
-    return axes, shape
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _bn_train_core(x, w, b, epsilon, data_format):
     out, _, _ = _bn_train_fwd_impl(x, w, b, epsilon, data_format)
     return out
-
-
-def _bn_stats(x, axes):
-    """One-pass fp32 E[x], E[x^2] statistics: both reductions read x once
-    (independent, so XLA multi-output-fuses them), vs the two-pass
-    (x-mean)^2 form whose second reduction forces another full read of x.
-    fp32 accumulation over bf16 inputs keeps the cancellation benign for
-    activation-scale data (the MLPerf ResNet BN formulation)."""
-    mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
-    mean2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
-    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
-    return mean, var
 
 
 def _bn_train_fwd_impl(x, w, b, epsilon, data_format):
@@ -671,21 +652,83 @@ def _bn_train(x, w, b, *, epsilon, data_format):
     return out, mean, var
 
 
+@kernel("fused_bn_relu")
+def _fused_bn_act_train(x, w, b, *, epsilon, data_format, act):
+    from ...ops.pallas.fused_bn import fused_bn_relu
+    return fused_bn_relu(x, w, b, epsilon=epsilon, data_format=data_format,
+                         act=act)
+
+
+@kernel("fused_bn_add_relu")
+def _fused_bn_add_act_train(x, z, w, b, *, epsilon, data_format, act):
+    from ...ops.pallas.fused_bn import fused_bn_add_relu
+    return fused_bn_add_relu(x, z, w, b, epsilon=epsilon,
+                             data_format=data_format, act=act)
+
+
+@kernel("batch_norm_infer_act")
+def _bn_infer_act(x, rm, rv, w, b, *rest, epsilon, data_format, act):
+    """Inference-mode BN with the same act/add epilogue as the fused train
+    kernels, so a fused layer behaves identically in eval mode (XLA fuses
+    the whole chain; no custom kernel needed off the train hot path)."""
+    out = _bn_infer(x, rm, rv, w, b, epsilon=epsilon, data_format=data_format)
+    if rest:
+        out = out + rest[0]
+    if act == "relu":
+        out = jnp.maximum(out, 0)
+    return out.astype(x.dtype)
+
+
+def _bn_affine_arrays(x, weight, bias, data_format):
+    """The fused kernels require concrete gamma/beta arrays; a disabled
+    affine (weight_attr=False) substitutes constants that take no grad."""
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    C = (x.shape[c_axis] if not isinstance(x, Tensor)
+         else x.data.shape[c_axis])
+    w = jnp.ones((C,), jnp.float32) if weight is None else weight
+    b = jnp.zeros((C,), jnp.float32) if bias is None else bias
+    return w, b
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
-               use_global_stats=None, name=None):
+               use_global_stats=None, name=None, act=None, residual=None):
     """Functional batch norm. In training mode also updates running stats
     in-place on the provided Tensors (reference semantics:
-    `phi/kernels/gpu/batch_norm_kernel.cu` updates mean_out/variance_out)."""
+    `phi/kernels/gpu/batch_norm_kernel.cu` updates mean_out/variance_out).
+
+    `act`/`residual` select the fused BN(+add)+activation kernels
+    (reference `fused_bn_activation_op.cu` / `fused_bn_add_activation_op.cu`,
+    Pallas on TPU): out = act(BN(x) [+ residual]). Running-stat momentum
+    semantics are identical to the unfused path.
+    """
     if use_global_stats is None:
         use_global_stats = not training
-    if use_global_stats:
-        return _d.call(_bn_infer, (x, running_mean, running_var, weight, bias),
-                       dict(epsilon=epsilon, data_format=data_format),
-                       name="batch_norm")
-    out, mean, var = _d.call(_bn_train, (x, weight, bias),
-                             dict(epsilon=epsilon, data_format=data_format),
-                             name="batch_norm")
+    if act is None and residual is None:
+        if use_global_stats:
+            return _d.call(_bn_infer,
+                           (x, running_mean, running_var, weight, bias),
+                           dict(epsilon=epsilon, data_format=data_format),
+                           name="batch_norm")
+        out, mean, var = _d.call(_bn_train, (x, weight, bias),
+                                 dict(epsilon=epsilon, data_format=data_format),
+                                 name="batch_norm")
+    else:
+        w, b = _bn_affine_arrays(x, weight, bias, data_format)
+        attrs = dict(epsilon=epsilon, data_format=data_format, act=act)
+        if use_global_stats:
+            args = (x, running_mean, running_var, w, b)
+            if residual is not None:
+                args = args + (residual,)
+            return _d.call(_bn_infer_act, args, attrs,
+                           name="batch_norm_infer_act")
+        if residual is not None:
+            out, mean, var = _d.call(_fused_bn_add_act_train,
+                                     (x, residual, w, b), attrs,
+                                     name="fused_bn_add_relu")
+        else:
+            out, mean, var = _d.call(_fused_bn_act_train, (x, w, b), attrs,
+                                     name="fused_bn_relu")
     if isinstance(running_mean, Tensor):
         with jax.default_matmul_precision("float32"):
             m = momentum

@@ -359,14 +359,21 @@ class RMSNorm(Layer):
 
 
 class _BatchNormBase(Layer):
+    """`act="relu"` fuses the activation into the BN kernel
+    (Pallas fused BN — reference `fused_bn_activation_op.cu`); calling
+    `forward(x, residual)` additionally folds a residual add before the
+    activation (`fused_bn_add_activation_op.cu`), so a ResNet block tail
+    `relu(bn(conv(x)) + identity)` is one kernel."""
+
     def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
                  weight_attr=None, bias_attr=None, data_format="NCHW",
-                 use_global_stats=None, name=None):
+                 use_global_stats=None, name=None, act=None):
         super().__init__()
         self._momentum = momentum
         self._epsilon = epsilon
         self._data_format = data_format
         self._use_global_stats = use_global_stats
+        self._act = act
         if weight_attr is False:
             self.weight = None
             self._parameters["weight"] = None
@@ -382,11 +389,12 @@ class _BatchNormBase(Layer):
         self.register_buffer("_mean", Tensor(jnp.zeros((num_features,), jnp.float32)))
         self.register_buffer("_variance", Tensor(jnp.ones((num_features,), jnp.float32)))
 
-    def forward(self, x):
+    def forward(self, x, residual=None):
         return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
                             training=self.training, momentum=self._momentum,
                             epsilon=self._epsilon, data_format=self._data_format,
-                            use_global_stats=self._use_global_stats)
+                            use_global_stats=self._use_global_stats,
+                            act=self._act, residual=residual)
 
 
 class BatchNorm1D(_BatchNormBase):

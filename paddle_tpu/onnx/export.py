@@ -111,6 +111,22 @@ class _Converter:
             x, rm, rv, w, b = ins
             self.emit("BatchNormalization", [x, w, b, rm, rv], outs,
                       epsilon=float(a.get("epsilon", 1e-5)))
+        elif n == "batch_norm_infer_act":
+            # fused BN(+add)+act inference op (Pallas fused-BN family):
+            # decompose to BatchNormalization [+ Add] [+ Relu]
+            x, rm, rv, w, b = ins[:5]
+            res = ins[5] if len(ins) > 5 else None
+            cur = outs[0] + "_bn"
+            self.emit("BatchNormalization", [x, w, b, rm, rv], [cur],
+                      epsilon=float(a.get("epsilon", 1e-5)))
+            if res:
+                nxt = outs[0] + "_add"
+                self.emit("Add", [cur, res], [nxt])
+                cur = nxt
+            if a.get("act") == "relu":
+                self.emit("Relu", [cur], outs)
+            else:
+                self.emit("Identity", [cur], outs)
         elif n in ("max_pool2d", "avg_pool2d", "pool2d"):
             window = a["window"]
             strides = a["strides"]

@@ -1,0 +1,30 @@
+"""BatchNorm reduction helpers shared by the unfused train kernels
+(nn/functional) and the fused Pallas BN family (ops/pallas/fused_bn).
+
+One definition on purpose: the fused kernels' running-stat parity with the
+unfused path depends on the statistics FORMULATION being identical, so both
+sides must import these rather than carry copies.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _bn_axes(x, data_format):
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    return axes, shape
+
+
+def _bn_stats(x, axes):
+    """One-pass fp32 E[x], E[x^2] statistics: both reductions read x once
+    (independent, so XLA multi-output-fuses them), vs the two-pass
+    (x-mean)^2 form whose second reduction forces another full read of x.
+    fp32 accumulation over bf16 inputs keeps the cancellation benign for
+    activation-scale data (the MLPerf ResNet BN formulation)."""
+    mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+    mean2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    return mean, var

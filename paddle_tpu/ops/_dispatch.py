@@ -302,7 +302,10 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
         if _nan_check_on():
             _check_nan_inf(name, out if isinstance(out, tuple) else (out,))
         if isinstance(out, tuple):
-            return tuple(Tensor(o, stop_gradient=True) for o in out)
+            out_tensors = tuple(Tensor(o, stop_gradient=True) for o in out)
+            # 1-tuple collapse must match the cached hit above — an op's
+            # return structure may not change once the cache warms
+            return out_tensors[0] if len(out_tensors) == 1 else out_tensors
         return Tensor(out, stop_gradient=True)
 
 

@@ -37,6 +37,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..._jax_compat import (TPUCompilerParams as _TPUCompilerParams,
+                            DIM_PARALLEL as _DIM_P, DIM_ARBITRARY as _DIM_A)
 import numpy as np
 
 _NEG = -1e30
@@ -660,10 +663,10 @@ def _compiler_params(interpret, n_arbitrary=1):
 
     if interpret:
         return None
-    P = pltpu.GridDimensionSemantics.PARALLEL
-    A = pltpu.GridDimensionSemantics.ARBITRARY
+    P = _DIM_P
+    A = _DIM_A
     sem = (P,) * (4 - n_arbitrary) + (A,) * n_arbitrary
-    return pltpu.CompilerParams(dimension_semantics=sem)
+    return _TPUCompilerParams(dimension_semantics=sem)
 
 
 @functools.partial(jax.jit, static_argnames=(

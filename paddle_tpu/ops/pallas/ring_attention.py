@@ -25,6 +25,9 @@ import functools
 from typing import Optional
 
 import jax
+
+from ..._jax_compat import shard_map as _shard_map
+from ..._jax_compat import axis_size as _axis_size
 import jax.numpy as jnp
 import numpy as np
 
@@ -100,7 +103,7 @@ def _local_ring_fn(axis_name: str, causal: bool, scale: float,
 
     def fwd_impl(q, k, v, key):
         B, Lq, H, D = q.shape
-        size = jax.lax.axis_size(axis_name)
+        size = _axis_size(axis_name)
         idx = jax.lax.axis_index(axis_name)
         qf = q.astype(jnp.float32) * scale
         q_off = idx * Lq
@@ -138,7 +141,7 @@ def _local_ring_fn(axis_name: str, causal: bool, scale: float,
     def ring_bwd(res, dout):
         q, k, v, key, out, lse = res
         B, Lq, H, D = q.shape
-        size = jax.lax.axis_size(axis_name)
+        size = _axis_size(axis_name)
         idx = jax.lax.axis_index(axis_name)
         qf = q.astype(jnp.float32) * scale
         doutf = dout.astype(jnp.float32)
@@ -244,10 +247,10 @@ def ring_attention(q, k, v, mesh=None, axis_name: str = "sp",
                 q, k, v, axis_name=axis_name, causal=causal, scale=scale,
                 dropout_p=dropout_p, dropout_key=key)
 
-        fn = jax.shard_map(_local, mesh=mesh, in_specs=(spec, spec, spec, P()),
+        fn = _shard_map(_local, mesh=mesh, in_specs=(spec, spec, spec, P()),
                            out_specs=spec, axis_names={axis_name})
         return fn(q, k, v, raw)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

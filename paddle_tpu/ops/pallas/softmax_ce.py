@@ -31,6 +31,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..._jax_compat import (TPUCompilerParams as _TPUCompilerParams,
+                            DIM_PARALLEL as _DIM_P, DIM_ARBITRARY as _DIM_A)
 import numpy as np
 
 _NEG = -1e30
@@ -149,8 +152,8 @@ def _ce_fwd_pallas(logits, labels, interpret=False):
     kernel = functools.partial(
         _ce_fwd_kernel, block_n=block_n, block_v=block_v, n_rows=N,
         n_cls=V, n_v=n_v)
-    P = pltpu.GridDimensionSemantics.PARALLEL
-    A = pltpu.GridDimensionSemantics.ARBITRARY
+    P = _DIM_P
+    A = _DIM_A
     nll, lse = pl.pallas_call(
         kernel,
         grid=(n_n, n_v),
@@ -165,7 +168,7 @@ def _ce_fwd_pallas(logits, labels, interpret=False):
                         pltpu.VMEM((block_n, _CARRY_LANES), jnp.float32),
                         pltpu.VMEM((block_n, _CARRY_LANES), jnp.float32)],
         compiler_params=(None if interpret
-                         else pltpu.CompilerParams(
+                         else _TPUCompilerParams(
                              dimension_semantics=(P, A))),
         interpret=interpret,
     )(logits, lab_p)
@@ -186,7 +189,7 @@ def _ce_bwd_pallas(logits, labels, lse, dnll, interpret=False):
     dnll_p = jnp.broadcast_to(dnll.astype(jnp.float32)[:, None],
                               (N, _STATS_LANES))
     rowspec = pl.BlockSpec((block_n, _STATS_LANES), lambda i, j: (i, 0))
-    P = pltpu.GridDimensionSemantics.PARALLEL
+    P = _DIM_P
     dlogits = pl.pallas_call(
         functools.partial(_ce_bwd_kernel, block_n=block_n, block_v=block_v,
                           n_rows=N, n_cls=V),
@@ -198,7 +201,7 @@ def _ce_bwd_pallas(logits, labels, lse, dnll, interpret=False):
         out_specs=pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((N, V), logits.dtype),
         compiler_params=(None if interpret
-                         else pltpu.CompilerParams(
+                         else _TPUCompilerParams(
                              dimension_semantics=(P, P))),
         interpret=interpret,
     )(logits, lab_p, lse_p, dnll_p)

@@ -27,6 +27,9 @@ import functools
 from typing import Optional
 
 import jax
+
+from ..._jax_compat import shard_map as _shard_map
+from ..._jax_compat import axis_size as _axis_size
 import jax.numpy as jnp
 import numpy as np
 
@@ -46,7 +49,7 @@ def ulysses_attention_local(q, k, v, axis_name: str = "sp",
     `flash_attention` docstring."""
     from .flash_attention import flash_attention
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     H = q.shape[2]
     assert H % sp == 0, (
         f"Ulysses needs heads ({H}) divisible by the '{axis_name}' axis "
@@ -93,11 +96,11 @@ def ulysses_attention(q, k, v, mesh=None, axis_name: str = "sp",
                 q, k, v, axis_name=axis_name, causal=causal, scale=scale,
                 dropout_p=dropout_p, dropout_key=key)
 
-        fn = jax.shard_map(_local, mesh=mesh,
+        fn = _shard_map(_local, mesh=mesh,
                            in_specs=(spec, spec, spec, P()),
                            out_specs=spec, axis_names={axis_name})
         return fn(q, k, v, dropout_key)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(ulysses_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -66,15 +66,34 @@ class Optimizer:
         return {}
 
     def _decay_grad(self, p, g):
-        """L2 regularization folded into the gradient (non-decoupled)."""
-        if self._weight_decay:
-            return g + self._weight_decay * p
-        return g
+        """L2 regularization folded into the gradient (non-decoupled).
+        No truthiness test on the coefficient: under the jitted update it is
+        a TRACED scalar (so mutating `_weight_decay` mid-run takes effect,
+        including 0 -> nonzero), and XLA folds the wd=0 multiply away."""
+        wd = self._weight_decay
+        if isinstance(wd, (int, float)) and not wd:
+            return g
+        return g + wd * p
 
     # -- eager step ----------------------------------------------------------
     @property
     def _param_groups(self):
         return self._parameter_list
+
+    def _hyper_names(self):
+        """Mutable float hyperparameters (`_weight_decay`, betas, rho, ...)
+        threaded into the jitted update as TRACED arguments like `lr`/`t`,
+        so mutating them mid-run takes effect instead of being silently
+        baked in at first trace. Floats only: bools/ints steer static
+        control flow and shapes. `_learning_rate` already rides as `lr`."""
+        names = self.__dict__.get("_hyper_name_cache")
+        if names is None:
+            names = tuple(sorted(
+                n for n, v in self.__dict__.items()
+                if isinstance(v, float) and not isinstance(v, bool)
+                and n != "_learning_rate"))
+            self.__dict__["_hyper_name_cache"] = names
+        return names
 
     def _get_jit_update(self, kw_key):
         """One jitted per-parameter update per static-kw combination; jit's
@@ -86,13 +105,29 @@ class Optimizer:
         fn = cache.get(kw_key)
         if fn is None:
             kw = dict(kw_key)
+            names = self._hyper_names()
 
-            def u(p, g, slots, lr, t, _kw=kw):
-                return self._update(p, g, slots, lr, t, **_kw)
+            def u(p, g, slots, lr, t, hypers, _kw=kw, _names=names):
+                # rebind the hyper attrs to the traced scalars for the
+                # duration of the trace: subclass `_update` bodies read
+                # `self._beta1` etc. unchanged, yet the compiled executable
+                # takes the CURRENT values as runtime inputs every step
+                saved = {n: getattr(self, n) for n in _names}
+                try:
+                    for n, v in zip(_names, hypers):
+                        setattr(self, n, v)
+                    return self._update(p, g, slots, lr, t, **_kw)
+                finally:
+                    for n, v in saved.items():
+                        setattr(self, n, v)
 
             fn = jax.jit(u)
             cache[kw_key] = fn
         return fn
+
+    def _hyper_values(self):
+        return tuple(jnp.float32(getattr(self, n))
+                     for n in self._hyper_names())
 
     def step(self):
         self._step_count += 1
@@ -101,10 +136,12 @@ class Optimizer:
                         if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
-        # lr/t as device scalars: traced args, so a scheduler tick or step
-        # increment never recompiles the update
+        # lr/t/hypers as device scalars: traced args, so a scheduler tick,
+        # step increment, or hyperparameter mutation never recompiles the
+        # update (hypers hoisted out of the loop — identical within a step)
         lr_a = jnp.float32(lr)
         t_a = jnp.int32(self._step_count)
+        hyper_vals = self._hyper_values()
         for p, g in params_grads:
             if g is None:
                 continue
@@ -122,7 +159,7 @@ class Optimizer:
                 try:
                     upd = self._get_jit_update(tuple(sorted(kw.items())))
                     new_p, new_slots = upd(p.data, g_arr, self._slots[sid],
-                                           lr_a, t_a)
+                                           lr_a, t_a, hyper_vals)
                 except Exception:
                     # a subclass _update that can't trace (host callbacks,
                     # data-dependent python control flow) falls back to the

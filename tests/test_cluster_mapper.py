@@ -55,6 +55,66 @@ class TestReplicaGroupParsing:
     def test_absent(self):
         assert _parse_replica_groups("%a = f32[8] add(%x, %y)") is None
 
+    def test_empty_all_replica_form(self):
+        """XLA's `replica_groups={}` means ONE group spanning all devices —
+        parsed as [] (distinct from None/absent) so the mapper can attribute
+        it by topology."""
+        assert _parse_replica_groups(
+            "%ar = f32[8] all-reduce(%x), replica_groups={}") == []
+
+
+class TestAllReplicaAttribution:
+    """`replica_groups={}` (and groups-less collectives) span every device:
+    on a >1-slice cluster their bytes are DCN, on one slice ICI."""
+
+    def _bytes(self, cluster, line):
+        import paddle_tpu.distributed.auto_parallel.planner as planner_mod
+
+        class FakeCompiled:
+            pass
+
+        orig = planner_mod._iter_collective_lines
+        planner_mod._iter_collective_lines = lambda c: [(1000.0, line)]
+        try:
+            return Mapper(cluster).collective_bytes_by_link(FakeCompiled())
+        finally:
+            planner_mod._iter_collective_lines = orig
+
+    def test_empty_groups_multislice_is_dcn(self):
+        line = "%ar = f32[8] all-reduce(%x), replica_groups={}"
+        ici, dcn = self._bytes(Cluster(n_slices=2, chips_per_slice=4), line)
+        assert dcn == 1000.0 and ici == 0.0
+
+    def test_empty_groups_single_slice_is_ici(self):
+        line = "%ar = f32[8] all-reduce(%x), replica_groups={}"
+        ici, dcn = self._bytes(Cluster(n_slices=1, chips_per_slice=8), line)
+        assert ici == 1000.0 and dcn == 0.0
+
+    def test_missing_groups_multislice_is_dcn(self):
+        line = "%ar = f32[8] all-reduce(%x)"
+        ici, dcn = self._bytes(Cluster(n_slices=2, chips_per_slice=4), line)
+        assert dcn == 1000.0 and ici == 0.0
+
+    def test_explicit_in_slice_groups_stay_ici(self):
+        line = "%ar = f32[8] all-reduce(%x), replica_groups={{0,1,2,3},{4,5,6,7}}"
+        ici, dcn = self._bytes(Cluster(n_slices=2, chips_per_slice=4), line)
+        assert ici == 1000.0 and dcn == 0.0
+
+    def test_permute_priced_by_its_pairs_not_blanket_dcn(self):
+        """collective-permute never carries replica_groups: an in-slice ring
+        (ring attention over an ICI axis) must stay ICI on a multislice
+        cluster, and only slice-crossing pairs go to DCN."""
+        ring_in_slice = ("%cp = f32[8] collective-permute(%x), "
+                        "source_target_pairs={{0,1},{1,2},{2,3},{3,0}}")
+        ici, dcn = self._bytes(Cluster(n_slices=2, chips_per_slice=4),
+                               ring_in_slice)
+        assert ici == 1000.0 and dcn == 0.0
+        crossing = ("%cp = f32[8] collective-permute(%x), "
+                    "source_target_pairs={{0,4},{4,0}}")
+        ici, dcn = self._bytes(Cluster(n_slices=2, chips_per_slice=4),
+                               crossing)
+        assert dcn == 1000.0 and ici == 0.0
+
 
 def _tp_heavy_model():
     """Params >> activations: TP-sharding params wins on HBM/collectives

@@ -143,6 +143,32 @@ class TestNoGradPath:
         np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
         assert _dispatch._cache_stats["hit"] > 0
 
+    def test_return_structure_stable_across_cache_warmup(self, fresh_cache):
+        """A genuine 1-tuple op output must collapse to a single Tensor on
+        BOTH the uncached first call and the cached hit — an op's return
+        type may not change once the cache warms (ADVICE r5 #1)."""
+        from paddle_tpu.framework.tensor import Tensor
+
+        def one_tuple_impl(a):
+            return (a * 2.0,)
+
+        x = paddle.to_tensor(np.ones((4, 4), "float32"))
+        types = []
+        with paddle.no_grad():
+            for _ in range(4):  # 1st: uncached; 2nd: compile; 3rd+: hit
+                out = _dispatch.call(one_tuple_impl, (x,), name="one_tuple")
+                types.append(type(out))
+        assert _dispatch._cache_stats["hit"] > 0
+        assert all(t is Tensor for t in types), types
+        # multi-output ops keep their tuple structure in both states
+        def two_tuple_impl(a):
+            return (a + 1.0, a - 1.0)
+        with paddle.no_grad():
+            structs = [len(_dispatch.call(two_tuple_impl, (x,),
+                                          name="two_tuple"))
+                       for _ in range(4)]
+        assert structs == [2, 2, 2, 2]
+
     def test_dynamic_shape_op_falls_back(self, fresh_cache):
         """masked_select's output shape is data-dependent — untraceable, so
         it must blacklist itself and stay on the eager path."""

@@ -184,7 +184,10 @@ class TestWireFormat:
     def test_protoc_decodes_model(self, tmp_path):
         """The emitted bytes must be valid protobuf: protoc --decode_raw
         accepts them (structure check independent of our reader)."""
+        import shutil
         import subprocess
+        if shutil.which("protoc") is None:
+            pytest.skip("protoc binary not available in this environment")
         g = proto.graph([proto.node("Relu", ["x"], ["y"])], "g", [],
                         [proto.value_info("x", "float32", (2, 2))],
                         [proto.value_info("y", "float32", (2, 2))])

@@ -31,6 +31,33 @@ PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
 _INIT_HUNG = False  # set when the backend-init probe timed out (see main)
 
+# step-window records (profiler/monitor.py schema) from every timed run this
+# process executed; folded into the output under observability.step_records
+_STEP_RECORDS = []
+
+
+def _observability_snapshot():
+    """Metrics-registry snapshot + retrace summary + step records, folded
+    into the bench JSON so each round's perf line carries its own
+    observability data (PR 2). Never raises — the bench must stay
+    unkillable."""
+    out = {}
+    try:
+        from paddle_tpu.profiler import metrics as _metrics
+        _metrics.update_device_memory_gauges()
+        out["metrics"] = _metrics.default_registry().snapshot()
+    except Exception as e:
+        out["metrics_error"] = f"{type(e).__name__}: {e}"
+    try:
+        from paddle_tpu.profiler.watchdog import get_watchdog
+        wd = get_watchdog()
+        out["retraces_total"] = wd.total_retraces()
+        out["retrace_events"] = [e.to_dict() for e in list(wd.events)[-10:]]
+    except Exception as e:
+        out["retrace_error"] = f"{type(e).__name__}: {e}"
+    out["step_records"] = list(_STEP_RECORDS)[-10:]
+    return out
+
 
 def _run_config(step, args, iters=ITERS, warmup=WARMUP):
     """AOT-compile the TrainStep ONCE, read cost_analysis from the same
@@ -63,6 +90,11 @@ def _run_config(step, args, iters=ITERS, warmup=WARMUP):
         loss, params, buffers, opt_state = compiled(
             params, buffers, opt_state, rng, lr, t, *arrs)
     float(loss)  # sync
+    try:
+        from paddle_tpu.profiler.watchdog import get_watchdog
+        retrace0 = get_watchdog().total_retraces()
+    except Exception:
+        retrace0 = None
     t0 = time.perf_counter()
     for _ in range(iters):
         t += 1
@@ -70,6 +102,20 @@ def _run_config(step, args, iters=ITERS, warmup=WARMUP):
             params, buffers, opt_state, rng, lr, t, *arrs)
     final_loss = float(loss)  # device sync
     dt = time.perf_counter() - t0
+    # one step-window observability record per timed run (PR 2 schema)
+    try:
+        from paddle_tpu.profiler.monitor import make_step_record
+        from paddle_tpu.profiler.watchdog import get_watchdog
+        batch = (int(arrs[0].shape[0])
+                 if arrs and getattr(arrs[0], "ndim", 0) else None)
+        _STEP_RECORDS.append(make_step_record(
+            step=iters, window_steps=iters, window_time_s=dt,
+            samples=batch * iters if batch else None,
+            flops_per_step=flops, peak_flops=PEAK_FLOPS,
+            retraces=(get_watchdog().total_retraces() - retrace0
+                      if retrace0 is not None else 0)))
+    except Exception:
+        pass
     return dt / iters, final_loss, flops, nbytes
 
 
@@ -537,6 +583,7 @@ def main():
     else:
         result["error"] = ("flagship gpt2 config failed: "
                            + str(gpt.get("error", "missing")))
+    result["observability"] = _observability_snapshot()
     print(json.dumps(result))
 
 

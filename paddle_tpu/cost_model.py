@@ -73,4 +73,57 @@ class CostModel:
         return (time.perf_counter() - t0) * 1e3 / iters
 
 
-__all__ = ["CostModel", "CostData"]
+# ---------------------------------------------------------------------------
+# Lightweight per-op estimators for the observability layer: the op tracer in
+# ops/_dispatch.py annotates every HostSpan with an estimated byte volume and
+# the metrics registry accumulates them per op. Metadata-only — never forces
+# a device sync (jax.Array .shape/.dtype are host-side).
+# ---------------------------------------------------------------------------
+def array_bytes(x) -> int:
+    """Byte size of an array-like from its metadata (0 for non-arrays)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * itemsize
+
+
+def op_bytes_estimate(in_arrs, out_arrs) -> int:
+    """Host-visible data volume of one op call: inputs read + outputs
+    written. An ESTIMATE (fusion/cache-obliviousness ignored) — the same
+    caveat as XLA cost_analysis bytes, useful for relative ranking."""
+    return (sum(array_bytes(a) for a in in_arrs)
+            + sum(array_bytes(a) for a in out_arrs))
+
+
+def op_flops_estimate(name: str, in_arrs) -> int:
+    """Coarse FLOP estimate from input shapes: exact for the matmul family
+    (2*M*K*N), one-flop-per-element otherwise. Feeds the eager dispatch's
+    per-op `op_flops_total` counter (relative cost ranking); do not quote
+    it as a measurement."""
+    shapes = [tuple(getattr(a, "shape", ())) for a in in_arrs]
+    if name in ("matmul", "mm", "bmm", "linear", "addmm") and len(shapes) >= 2:
+        a, b = shapes[0], shapes[1]
+        if len(a) >= 2 and len(b) >= 2 and a[-1] == b[-2]:
+            batch = 1
+            for d in a[:-2]:
+                batch *= int(d)
+            return 2 * batch * int(a[-2]) * int(a[-1]) * int(b[-1])
+    elems = 0
+    for s in shapes:
+        n = 1
+        for d in s:
+            n *= int(d)
+        elems = max(elems, n)
+    return elems
+
+
+__all__ = ["CostModel", "CostData", "array_bytes", "op_bytes_estimate",
+           "op_flops_estimate"]

@@ -32,7 +32,18 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .._jax_compat import shard_map
 
+from ..cost_model import array_bytes as _array_bytes
 from ..framework.tensor import Tensor
+from ..profiler import metrics as _metrics_mod
+
+_REG = _metrics_mod.default_registry()
+_M_COLL_CALLS = _REG.counter(
+    "collective_calls_total",
+    "eager collective launches by kind and link class (ici/dcn)")
+_M_COLL_BYTES = _REG.counter(
+    "collective_bytes_total",
+    "estimated per-device bytes moved by eager collectives, attributed to "
+    "the slowest link the group's mesh axes cross (cluster-mapper pricing)")
 
 
 class ReduceOp:
@@ -183,6 +194,66 @@ def _eager(group: Group, fn, *arrs, out_specs=None):
                      out_specs=out_specs, check_vma=False)(*arrs)
 
 
+def _group_link(g: Group) -> str:
+    """'ici' or 'dcn': the slowest link class the group's mesh axes cross,
+    via the auto-parallel cluster mapper (PR-1 pricing). Slice topology off
+    a real multislice job comes from `PADDLE_TPU_NUM_SLICES`; default is one
+    slice, so everything is ICI. A bad env value or mapper failure falls
+    back to 'ici' but is LOGGED once — a silent fallback would zero the
+    dcn breakdown on exactly the multislice jobs it exists for."""
+    cached = getattr(g, "_link_class", None)
+    if cached is not None:
+        return cached
+    import logging
+    import os
+    log = logging.getLogger("paddle_tpu.collective")
+    link = "ici"
+    raw = os.environ.get("PADDLE_TPU_NUM_SLICES", "1") or "1"
+    try:
+        n_slices = int(raw)
+    except ValueError:
+        log.warning("PADDLE_TPU_NUM_SLICES=%r is not an integer; collective "
+                    "link attribution falls back to single-slice (all ici)",
+                    raw)
+        n_slices = 1
+    if n_slices > 1:
+        try:
+            from .auto_parallel.cluster import Cluster, Mapper
+            ndev = int(np.prod(g.mesh.devices.shape))
+            cluster = Cluster(n_slices=n_slices,
+                              chips_per_slice=max(1, ndev // n_slices))
+            mesh_dims = dict(zip(g.mesh.axis_names, g.mesh.devices.shape))
+            links = Mapper(cluster).axis_links(mesh_dims)
+            if any(links.get(a) == "dcn" for a in g.axis_names):
+                link = "dcn"
+        except Exception as e:
+            log.warning("cluster mapper failed for group %s (%s: %s); "
+                        "collective link attribution falls back to ici",
+                        g.name, type(e).__name__, e)
+    g._link_class = link
+    return link
+
+
+def _account(kind: str, group: Group, *arrs):
+    """Count one eager collective into the metrics registry (traced/SPMD
+    collectives execute inside compiled programs and are priced by the
+    planner's HLO walk instead — counting the trace would be once-ever)."""
+    if not _metrics_mod.enabled():
+        return
+    try:
+        link = _group_link(group)
+        _M_COLL_CALLS.inc(kind=kind, link=link)
+        _M_COLL_BYTES.inc(sum(_array_bytes(a) for a in arrs),
+                          kind=kind, link=link)
+    except Exception:
+        pass
+
+
+def _eager_acct(kind: str, group: Group, fn, *arrs, out_specs=None):
+    _account(kind, group, *arrs)
+    return _eager(group, fn, *arrs, out_specs=out_specs)
+
+
 def _wrap_like(t, arr):
     if isinstance(t, Tensor):
         t.data = arr
@@ -224,7 +295,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
         ga = lax.all_gather(a, g.axis, axis=0)
         return jnp.prod(ga, axis=0)
 
-    out = f(x) if _is_tracer(x) else _eager(g, f, x)
+    out = f(x) if _is_tracer(x) else _eager_acct("all_reduce", g, f, x)
     return _wrap_like(tensor, out)
 
 
@@ -243,7 +314,7 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
         out = f(x)
     else:
         # gathered result is identical on every device -> replicated output
-        out = _eager(g, f, x, out_specs=P())
+        out = _eager_acct("all_gather", g, f, x, out_specs=P())
     if isinstance(tensor_list, list):
         for i in range(g.nranks):
             tensor_list.append(Tensor(out[i]) if isinstance(tensor, Tensor)
@@ -273,7 +344,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         ga = lax.all_gather(a, g.axis, axis=0)
         return ga[src]
 
-    out = f(x) if _is_tracer(x) else _eager(g, f, x)
+    out = f(x) if _is_tracer(x) else _eager_acct("broadcast", g, f, x)
     return _wrap_like(tensor, out)
 
 
@@ -295,7 +366,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
                                             keepdims=False)
 
         x = _unwrap(tensor)
-        out = f(x) if _is_tracer(x) else _eager(g, f, x)
+        out = f(x) if _is_tracer(x) else _eager_acct("scatter", g, f, x)
         return _wrap_like(tensor, out)
     raise ValueError("scatter requires tensor_list on TPU SPMD")
 
@@ -323,7 +394,8 @@ def reduce_scatter(tensor, tensor_or_list, op=ReduceOp.SUM, group=None,
                 a = a[0]
             return f(a)
 
-        out = _eager(g, f_eager, x, out_specs=P(g.axis))
+        out = _eager_acct("reduce_scatter", g, f_eager, x,
+                          out_specs=P(g.axis))
     return _wrap_like(tensor, out)
 
 
@@ -344,7 +416,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         out = f(x)
     else:
         spec = _spec_of(x, g.mesh)
-        out = _eager(g, f, x, out_specs=spec)
+        out = _eager_acct("alltoall", g, f, x, out_specs=spec)
     if isinstance(out_tensor_list, list):
         for i in range(g.nranks):
             out_tensor_list.append(Tensor(out[i]))
@@ -379,7 +451,7 @@ def ppermute(x, group=None, perm=None):
     def f(a):
         return lax.ppermute(a, g.axis, perm)
 
-    out = f(arr) if _is_tracer(arr) else _eager(g, f, arr)
+    out = f(arr) if _is_tracer(arr) else _eager_acct("ppermute", g, f, arr)
     return Tensor(out) if isinstance(x, Tensor) else out
 
 

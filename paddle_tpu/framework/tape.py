@@ -347,8 +347,12 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
         grad_tensors = [None] * len(tensors)
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
-    _engine(tensors, grad_tensors, retain_graph=retain_graph,
-            create_graph=create_graph)
+    # one host span for the whole reverse sweep (the engine calls recorded
+    # vjp closures directly, so it has no per-op dispatch to hook)
+    from ..profiler.utils import RecordEvent, TracerEventType
+    with RecordEvent("backward", TracerEventType.Backward):
+        _engine(tensors, grad_tensors, retain_graph=retain_graph,
+                create_graph=create_graph)
 
 
 def _accum_leaf(tensor, g, create_graph: bool = False):

@@ -13,6 +13,11 @@ from typing import List, Optional
 
 import numpy as np
 
+# step-window throughput/MFU/retrace JSONL reporter (profiler/monitor.py);
+# re-exported here so `paddle.callbacks.ThroughputMonitor` matches where
+# users expect callbacks to live
+from ..profiler.monitor import ThroughputMonitor  # noqa: F401
+
 
 class Callback:
     def __init__(self):

@@ -11,14 +11,37 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 import jax
 import numpy as np
 
 from ..framework.tensor import Tensor
+from ..profiler import metrics as _metrics_mod
+from ..profiler.timer import benchmark as _benchmark
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
+
+_REG = _metrics_mod.default_registry()
+_M_DL_WAIT = _REG.counter(
+    "dataloader_wait_seconds_total",
+    "time the consumer spent blocked waiting for the next batch")
+_M_DL_BATCHES = _REG.counter("dataloader_batches_total",
+                             "batches delivered to the consumer")
+_M_DL_WAIT_HIST = _REG.histogram(
+    "dataloader_wait_seconds", "per-batch consumer wait time")
+
+
+def _record_fetch_wait(wait_s: float):
+    """Feed one consumer-side batch wait into the global Benchmark reader
+    averager (the hapi/Profiler ips reporter reads data-wait from there)
+    and the metrics registry."""
+    _benchmark().reader.record(wait_s)
+    if _metrics_mod.enabled():
+        _M_DL_WAIT.inc(wait_s)
+        _M_DL_BATCHES.inc()
+        _M_DL_WAIT_HIST.observe(wait_s)
 
 
 def default_collate_fn(batch):
@@ -101,6 +124,7 @@ class _PrefetchIter:
     def __next__(self):
         if self._done:
             raise StopIteration
+        t0 = time.perf_counter()
         item = self._q.get()
         if item is None:
             self._done = True
@@ -108,6 +132,7 @@ class _PrefetchIter:
         if isinstance(item, BaseException):
             self._done = True
             raise item
+        _record_fetch_wait(time.perf_counter() - t0)
         return item
 
     def __iter__(self):

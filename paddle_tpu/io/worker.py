@@ -19,6 +19,7 @@ import multiprocessing as mp
 import os
 import queue as pyqueue
 import threading
+import time
 from dataclasses import dataclass
 from multiprocessing import shared_memory
 from typing import Any, Dict, List, Optional, Tuple
@@ -26,6 +27,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 _SENTINEL = "__end__"
+
+# bound lazily on first batch (dataloader imports this module)
+_record_fetch_wait = None
 
 _worker_info = None
 
@@ -227,6 +231,15 @@ class MultiprocessIter:
         return self
 
     def __next__(self):
+        global _record_fetch_wait
+        if _record_fetch_wait is None:  # deferred once: dodges import cycle
+            from .dataloader import _record_fetch_wait
+        t0 = time.perf_counter()
+        batch = self._next_impl()
+        _record_fetch_wait(time.perf_counter() - t0)
+        return batch
+
+    def _next_impl(self):
         timeout = self.loader.timeout or None
         if self._iterable:
             while self._finished_workers < self._nw:

@@ -28,6 +28,7 @@ from ..framework import random as random_mod
 from ..framework import tape as tape_mod
 from ..framework.tensor import Tensor
 from ..nn.layer import Layer
+from ..profiler.watchdog import get_watchdog as _get_watchdog
 
 
 def _tree_to_arrays(x):
@@ -106,11 +107,18 @@ class Program:
 class StaticLayer:
     """`to_static(layer)` result: eager-looking API, compiled execution."""
 
+    _seq = 0
+
     def __init__(self, layer: Layer, jit_kwargs: Optional[dict] = None):
         self.layer = layer
         self._maybe_convert_forward(layer)
         self.apply_fn, _, _ = functionalize(layer)
         self._jitted = jax.jit(self.apply_fn, static_argnames=())
+        # watchdog key is PER INSTANCE (the jit cache is too): keying by
+        # class name made a second instance's first compile look like a
+        # retrace, and per-instance recompiles look like hits
+        StaticLayer._seq += 1
+        self._wd_name = f"{type(layer).__name__}#{StaticLayer._seq}"
 
     @staticmethod
     def _maybe_convert_forward(layer: Layer):
@@ -135,6 +143,13 @@ class StaticLayer:
         params = {k: p.data for k, p in self.layer.named_parameters()}
         buffers = {k: b.data for k, b in self.layer.named_buffers()}
         arr_inputs = _tree_to_arrays(inputs)
+        # retrace watchdog: a new input signature means jax.jit re-traces
+        # the whole forward — surface WHAT changed (params/buffers keep
+        # their shapes, so the data inputs AND kw leaves key the signature)
+        _get_watchdog().observe(
+            "to_static", self._wd_name,
+            jax.tree_util.tree_leaves(arr_inputs)
+            + jax.tree_util.tree_leaves(kw))
         rng = random_mod.default_generator().split() if self.layer.training else \
             jax.random.PRNGKey(0)
         out, new_buffers = self._jitted(params, buffers, rng, *arr_inputs, **kw)
@@ -149,6 +164,47 @@ class StaticLayer:
         return getattr(self.layer, name)
 
 
+def _collect_captured_tensors(fn) -> list:
+    """Tensors a function captures — through closure cells OR module globals
+    its code actually references (directly, through a Layer, or a few
+    container levels deep). This is the state that must stay LIVE when the
+    function is compiled once and reused (reference: captured Parameters
+    become graph Variables whose values track updates); anything reachable
+    only through deeper indirection is frozen at trace time."""
+    out, seen = [], set()
+
+    def collect(v, depth=0):
+        if id(v) in seen or depth > 3:
+            return
+        seen.add(id(v))
+        if isinstance(v, Tensor):
+            out.append(v)
+        elif isinstance(v, Layer):
+            for _, p in v.named_parameters():
+                collect(p, depth + 1)
+            for _, b in v.named_buffers():
+                collect(b, depth + 1)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                collect(x, depth + 1)
+        elif isinstance(v, dict):
+            for x in v.values():
+                collect(x, depth + 1)
+
+    for cell in (getattr(fn, "__closure__", None) or ()):
+        try:
+            collect(cell.cell_contents)
+        except ValueError:
+            pass
+    code = getattr(fn, "__code__", None)
+    glb = getattr(fn, "__globals__", None)
+    if code is not None and glb is not None:
+        for name in code.co_names:  # only names the code references
+            if name in glb:
+                collect(glb[name])
+    return out
+
+
 def to_static(layer_or_fn=None, input_spec=None, build_strategy=None, **kw):
     """Decorator/wrapper: Layer -> StaticLayer, function -> jitted function.
     Honors `paddle.jit.enable_to_static(False)` (ProgramTranslator gate):
@@ -159,19 +215,76 @@ def to_static(layer_or_fn=None, input_spec=None, build_strategy=None, **kw):
         if isinstance(obj, Layer):
             return StaticLayer(obj)
         from . import dy2static
+        raw = obj
         if dy2static.needs_transform(obj):
             obj = dy2static.ast_transform(obj)
+        if obj is not raw:
+            # ast_transform snapshots closure cells into globals, so cell
+            # REBINDING can't reach the transformed body anyway (documented
+            # in dy2static) — a convert-time snapshot of the same objects is
+            # exactly what the transformed code uses
+            snapshot = _collect_captured_tensors(raw)
+            collect = lambda: snapshot
+        else:
+            # re-read cells/globals per call: `nonlocal w; w = new_tensor`
+            # (or a module-global rebind) must swap the NEW object's data
+            # in, not keep threading the old one
+            collect = lambda: _collect_captured_tensors(raw)
+        # shared per-call state: the wrapper refreshes the tensor list, the
+        # traced body swaps those exact objects — one source of truth
+        state = {"tensors": collect()}
+
+        _to_static_seq[0] += 1
+        fn_name = (getattr(obj, "__qualname__",
+                           getattr(obj, "__name__", "fn"))
+                   + f"#{_to_static_seq[0]}")  # per-conversion watchdog key:
+        # each convert() owns a fresh jit cache, so two conversions of the
+        # same function must not share retrace bookkeeping
+
+        # ONE jitted callable per conversion: defining it inside the wrapper
+        # rebuilt the jit object per call, so jax's cache never hit and every
+        # invocation re-traced+recompiled (and the watchdog, which dedups by
+        # signature, reported the site as retrace-free — a false all-clear).
+        # Captured Tensors (closure cells + referenced module globals) are
+        # threaded as ARGUMENTS (not baked in as trace constants) so
+        # optimizer updates stay visible, and a fresh rng key per call keeps
+        # stochastic ops stochastic; state behind deeper indirection than
+        # _collect_captured_tensors walks is frozen — thread it explicitly.
+        @jax.jit
+        def pure(aux, key, *a):
+            tensors = state["tensors"]
+            saved = [t.data for t in tensors]
+            try:
+                for t, v in zip(tensors, aux):
+                    t.data = v
+                with random_mod.rng_scope(key):
+                    out = obj(*jax.tree_util.tree_map(
+                        lambda x: Tensor(x) if isinstance(x, jax.Array)
+                        else x, a))
+                return _tree_to_arrays(out)
+            finally:
+                for t, v in zip(tensors, saved):
+                    t.data = v
 
         @functools.wraps(obj)
         def wrapper(*args, **kwargs):
+            if kwargs:
+                # silently tracing with defaults would return WRONG results;
+                # fail loudly until kwargs are threaded through the jit
+                raise TypeError(
+                    f"to_static function {fn_name!r} was called with keyword "
+                    f"arguments {sorted(kwargs)} — the compiled path passes "
+                    f"positional arguments only; pass them positionally or "
+                    f"exempt the function with paddle.jit.not_to_static")
             arrs = _tree_to_arrays(args)
-
-            @jax.jit
-            def pure(*a):
-                out = obj(*jax.tree_util.tree_map(
-                    lambda x: Tensor(x) if isinstance(x, jax.Array) else x, a))
-                return _tree_to_arrays(out)
-            out = pure(*arrs)
+            state["tensors"] = collect()
+            aux = tuple(t.data for t in state["tensors"])
+            # aux is part of the jit signature too: a closure tensor whose
+            # shape/dtype/count changes re-traces just like an input change
+            _get_watchdog().observe(
+                "to_static", fn_name,
+                jax.tree_util.tree_leaves(arrs) + list(aux))
+            out = pure(aux, random_mod.default_generator().split(), *arrs)
             return jax.tree_util.tree_map(Tensor, out)
         return wrapper
 
@@ -180,11 +293,16 @@ def to_static(layer_or_fn=None, input_spec=None, build_strategy=None, **kw):
     return convert(layer_or_fn)
 
 
+_to_static_seq = [0]
+
+
 # ---------------------------------------------------------------------------
 # TrainStep: whole-train-step compilation (forward+backward+optimizer in ONE
 # XLA executable — the TPU answer to the reference's InterpreterCore hot loop)
 # ---------------------------------------------------------------------------
 class TrainStep:
+    _seq = 0
+
     def __init__(self, layer: Layer, loss_fn: Callable, optimizer,
                  donate: bool = True, amp_dtype=None):
         """amp_dtype: e.g. jnp.bfloat16 enables O2 mixed precision — fp32
@@ -245,12 +363,18 @@ class TrainStep:
         donate_args = (0, 2) if donate else ()
         self._step = jax.jit(step, static_argnames=(),
                              donate_argnums=donate_args)
+        TrainStep._seq += 1
+        self._wd_name = f"{type(layer).__name__}#{TrainStep._seq}"
 
     def __call__(self, *batch):
         self._t += 1
         rng = random_mod.default_generator().split()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         arrs = _tree_to_arrays(batch)
+        # a new batch signature recompiles the WHOLE fused step — the most
+        # expensive retrace in the system; always worth an event
+        _get_watchdog().observe("train_step", self._wd_name,
+                                jax.tree_util.tree_leaves(arrs))
         loss, self.params, self.buffers, self.opt_state = self._step(
             self.params, self.buffers, self.opt_state, rng, lr,
             self._t, *arrs)

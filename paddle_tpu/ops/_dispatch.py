@@ -12,6 +12,7 @@ the generated GradNodes.
 from __future__ import annotations
 
 import functools
+import threading
 import types
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Sequence
@@ -20,9 +21,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..cost_model import (op_bytes_estimate as _op_bytes_estimate,
+                          op_flops_estimate as _op_flops_estimate)
 from ..framework import dtype as dtype_mod
 from ..framework import tape as tape_mod
 from ..framework.tensor import Tensor
+from ..profiler import metrics as _metrics_mod
+from ..profiler.recorder import HostSpan, get_recorder, now_ns
+from ..profiler.watchdog import get_watchdog
+
+# op-level observability (tentpole PR 2): per-op call/byte counters are
+# always-on (gated by PADDLE_TPU_METRICS), per-op HostSpans only while a
+# Profiler RECORD window has the recorder enabled.
+_REG = _metrics_mod.default_registry()
+_M_OP_CALLS = _REG.counter("op_calls_total",
+                           "eager op dispatches by op name")
+_M_OP_BYTES = _REG.counter(
+    "op_bytes_total",
+    "estimated bytes touched per eager op (inputs+outputs, metadata-based)")
+_M_OP_FLOPS = _REG.counter(
+    "op_flops_total",
+    "estimated FLOPs per eager op (exact for the matmul family, "
+    "one-per-element otherwise — cost_model.op_flops_estimate)")
+_M_OP_TIME = _REG.histogram(
+    "op_time_seconds",
+    "host-side eager dispatch latency by op (RECORD windows only; includes "
+    "async-dispatch enqueue, not device completion)")
+_M_CACHE_EVENTS = _REG.counter(
+    "eager_cache_events_total",
+    "eager jit-cache lookups by result (hit/miss/bypass)")
+_op_recorder = get_recorder()
 
 # impl registry: name -> pure fn (for compiled/functional callers and tests)
 KERNELS: Dict[str, Callable] = {}
@@ -177,28 +205,39 @@ def _entry_key(impl, kwargs, arrs):
         return None
 
 
-def _cache_lookup(impl, kwargs, arrs):
+def _cache_event(result: str):
+    _cache_stats[result] += 1
+    if _metrics_mod.enabled():
+        _M_CACHE_EVENTS.inc(result=result)
+
+
+def _cache_lookup(impl, kwargs, arrs, name=None):
     """Return a _CacheEntry, or None to take the re-trace path."""
     if not _EAGER_CACHE_FLAG.value:
         return None
     key = _entry_key(impl, kwargs, arrs)
     if key is None:
-        _cache_stats["bypass"] += 1
+        _cache_event("bypass")
         return None
     entry = _eager_cache.get(key)
     if entry is not None:
         _eager_cache.move_to_end(key)
         if entry is _UNCACHEABLE:
-            _cache_stats["bypass"] += 1
+            _cache_event("bypass")
             return None
-        _cache_stats["hit"] += 1
+        _cache_event("hit")
         return entry
     if key not in _eager_seen:
-        # first sighting: don't pay a compile for what may never recur
+        # first sighting: don't pay a compile for what may never recur.
+        # The watchdog diffs this signature against the op's previous one —
+        # a retrace event here names the shape/dtype/attr that changed.
         _eager_seen[key] = True
         if len(_eager_seen) > 2 * _CACHE_MAX:
             _eager_seen.popitem(last=False)
-        _cache_stats["miss"] += 1
+        _cache_event("miss")
+        if name is not None:
+            get_watchdog().observe("eager", name, arrs, static=kwargs,
+                                   count_hit=False)
         return None
     try:
         entry = _CacheEntry(impl, kwargs, arrs)
@@ -207,7 +246,7 @@ def _cache_lookup(impl, kwargs, arrs):
     _eager_cache[key] = entry
     if len(_eager_cache) > _CACHE_MAX:
         _eager_cache.popitem(last=False)
-    _cache_stats["miss"] += 1
+    _cache_event("miss")
     return None if entry is _UNCACHEABLE else entry
 
 
@@ -223,7 +262,7 @@ def _try_cached_fwd(impl, kwargs, arrs, name):
     work under jax.vjp, whose primals are concrete, but not under jit), so
     the key is blacklisted and the caller re-runs eagerly, re-raising any
     genuine op error."""
-    entry = _cache_lookup(impl, kwargs, arrs)
+    entry = _cache_lookup(impl, kwargs, arrs, name)
     if entry is None:
         return None, None
     try:
@@ -269,6 +308,41 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
     requires = (not nondiff and tape_mod.grad_enabled()
                 and any(_wants_grad(t) for t in tensors))
 
+    # observability fast-exit: with metrics disabled and no RECORD window the
+    # instrumented path is skipped entirely (one attr read + two bool tests).
+    # Tracer inputs also bypass it: an op re-entered during a to_static /
+    # TrainStep trace executes per compiled run, not per Python call, so
+    # counting it would inject one model's worth of phantom "eager
+    # dispatches" per (re)trace (same rule as collective.py's eager gate)
+    tracing = _op_recorder.enabled
+    if (not tracing and not _metrics_mod.enabled()) or any(
+            isinstance(a, jax.core.Tracer) for a in arrs):
+        return _execute(impl, kwargs, arrs, tensors, name, requires)
+    t0 = now_ns() if tracing else 0  # clock reads only feed spans/histogram
+    result = _execute(impl, kwargs, arrs, tensors, name, requires)
+    t1 = now_ns() if tracing else 0
+    outs = result if isinstance(result, tuple) else (result,)
+    nbytes = _op_bytes_estimate(
+        arrs, [o.data for o in outs if isinstance(o, Tensor)])
+    if _metrics_mod.enabled():
+        _M_OP_CALLS.inc(op=name)
+        _M_OP_BYTES.inc(nbytes, op=name)
+        _M_OP_FLOPS.inc(_op_flops_estimate(name, arrs), op=name)
+        if tracing:
+            _M_OP_TIME.observe((t1 - t0) / 1e9, op=name)
+    if tracing:
+        stack = _op_recorder.span_stack()
+        _op_recorder.push(HostSpan(
+            name=name, start_ns=t0, end_ns=t1, tid=threading.get_ident(),
+            event_type="Operator", parent=stack[-1] if stack else None,
+            args={"shapes": [list(getattr(a, "shape", ())) for a in arrs],
+                  "dtypes": [str(getattr(a, "dtype", "?")) for a in arrs],
+                  "bytes_est": nbytes}))
+    return result
+
+
+def _execute(impl, kwargs, arrs, tensors, name, requires):
+    """The uninstrumented op body: cached-or-traced forward + tape record."""
     if requires:
         entry, outs = _try_cached_fwd(impl, kwargs, arrs, name)
         if entry is not None:

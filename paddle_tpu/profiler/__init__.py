@@ -8,15 +8,21 @@ HostEventRecorder, `platform/profiler/host_event_recorder.h`) and exported as
 chrome://tracing JSON; device-side tracing delegates to `jax.profiler`
 (XPlane/TensorBoard), the TPU answer to CUPTI.
 """
+from . import metrics
+from .monitor import (ThroughputMonitor, make_step_record,
+                      validate_step_record)
 from .profiler import (Profiler, ProfilerState, ProfilerTarget,
                        export_chrome_tracing, export_protobuf, make_scheduler)
 from .statistic import SortedKeys, StatisticData, summary_report
 from .timer import Benchmark, benchmark
 from .utils import RecordEvent, load_profiler_result
+from .watchdog import RetraceWatchdog, get_watchdog
 
 __all__ = [
     'Profiler', 'ProfilerState', 'ProfilerTarget', 'make_scheduler',
     'export_chrome_tracing', 'export_protobuf', 'RecordEvent',
     'load_profiler_result', 'SortedKeys', 'StatisticData', 'summary_report',
-    'Benchmark', 'benchmark',
+    'Benchmark', 'benchmark', 'metrics', 'ThroughputMonitor',
+    'make_step_record', 'validate_step_record', 'RetraceWatchdog',
+    'get_watchdog',
 ]

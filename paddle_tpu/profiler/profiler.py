@@ -222,11 +222,14 @@ class Profiler:
         events = []
         pid = os.getpid()
         for s in self._spans:
+            args = dict(s.args) if s.args else {}
+            if s.parent:
+                args["parent"] = s.parent
             events.append({
                 "name": s.name, "ph": "X", "cat": s.event_type,
                 "ts": s.start_ns / 1e3, "dur": s.dur_ns / 1e3,
                 "pid": pid, "tid": s.tid,
-                "args": {"parent": s.parent} if s.parent else {},
+                "args": args,
             })
         payload = {"traceEvents": events,
                    "displayTimeUnit": "ms",

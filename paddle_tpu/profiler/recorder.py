@@ -2,15 +2,18 @@
 
 Reference parity: `paddle/fluid/platform/profiler/host_event_recorder.h`
 (thread-local ring buffers of RecordEvent spans) + `event_node.cc` (merge into
-an event tree). Here: a per-thread list of completed spans; `collect()` drains
-all threads.
+an event tree). Here: a per-thread buffer of completed spans; `collect()`
+DRAINS all threads' buffers atomically per-thread — each buffer carries its
+own lock, `push()` appends under it, and `collect()` swaps the span list out
+under the same lock, so a span recorded concurrently with a collect lands in
+either this batch or the next, never lost and never duplicated.
 """
 from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
-from typing import List, Optional
+from dataclasses import dataclass
+from typing import Dict, List, Optional
 
 
 @dataclass
@@ -21,23 +24,32 @@ class HostSpan:
     tid: int
     event_type: str = "UserDefined"
     parent: Optional[str] = None
+    args: Optional[dict] = None   # op metadata: shapes/dtypes/bytes estimate
 
     @property
     def dur_ns(self) -> int:
         return self.end_ns - self.start_ns
 
 
+class _ThreadBuffer:
+    __slots__ = ("lock", "spans")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.spans: List[HostSpan] = []
+
+
 class HostEventRecorder:
     def __init__(self):
-        self._lock = threading.Lock()
-        self._buffers = {}          # tid -> list[HostSpan]
+        self._lock = threading.Lock()   # guards the buffer REGISTRY only
+        self._buffers: Dict[int, _ThreadBuffer] = {}
         self._tls = threading.local()
         self.enabled = False
 
-    def _buf(self) -> List[HostSpan]:
+    def _buf(self) -> _ThreadBuffer:
         buf = getattr(self._tls, "buf", None)
         if buf is None:
-            buf = []
+            buf = _ThreadBuffer()
             self._tls.buf = buf
             with self._lock:
                 self._buffers[threading.get_ident()] = buf
@@ -45,20 +57,30 @@ class HostEventRecorder:
 
     def push(self, span: HostSpan):
         if self.enabled:
-            self._buf().append(span)
+            buf = self._buf()
+            with buf.lock:
+                buf.spans.append(span)
 
     def collect(self) -> List[HostSpan]:
+        """Drain every thread's completed spans (sorted by start time).
+        Draining semantics: a second collect() returns only spans recorded
+        after the first one."""
         with self._lock:
-            out = []
-            for buf in self._buffers.values():
-                out.extend(buf)
+            bufs = list(self._buffers.values())
+        out: List[HostSpan] = []
+        for buf in bufs:
+            with buf.lock:
+                out.extend(buf.spans)
+                buf.spans.clear()
         out.sort(key=lambda s: s.start_ns)
         return out
 
     def clear(self):
         with self._lock:
-            for buf in self._buffers.values():
-                buf.clear()
+            bufs = list(self._buffers.values())
+        for buf in bufs:
+            with buf.lock:
+                buf.spans.clear()
 
     # active-span stack for nesting info
     def span_stack(self):

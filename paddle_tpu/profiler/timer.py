@@ -4,6 +4,11 @@
 and reports instantaneous + summary ips, exactly the shape of the reference's
 `Benchmark:325` / `benchmark():417` speed reporter that hapi and the
 DataLoader hook into.
+
+Degradation contract (audited): every accessor is safe with zero recorded
+steps, zero recorded samples (`num_samples=None` throughout), a `step()`
+stream that never saw a reader fetch, and `end()` without `begin()` — ips
+degrades to 0.0 / falls back to steps/s, never ZeroDivisionError.
 """
 from __future__ import annotations
 
@@ -28,6 +33,18 @@ class TimeAverager:
         if num_samples:
             self._total_samples += num_samples
 
+    @property
+    def total_time(self) -> float:
+        return self._total
+
+    @property
+    def count(self) -> int:
+        return self._cnt
+
+    @property
+    def total_samples(self) -> int:
+        return self._total_samples
+
     def get_average(self) -> float:
         return self._total / self._cnt if self._cnt else 0.0
 
@@ -41,6 +58,16 @@ class Benchmark:
     def __init__(self):
         self.reader = TimeAverager()
         self.batch = TimeAverager()
+        self._step_start = None
+        self._reader_start = None
+        self.total_samples = 0
+        self.total_time = 0.0
+        self._begin_time = None
+
+    def reset(self):
+        """Zero both averagers and the run totals (window restart)."""
+        self.reader.reset()
+        self.batch.reset()
         self._step_start = None
         self._reader_start = None
         self.total_samples = 0
@@ -61,11 +88,13 @@ class Benchmark:
         self._step_start = time.perf_counter()
 
     def step(self, num_samples: Optional[int] = None):
+        """Close one step window. Works without a prior `begin()` (the first
+        call then only arms the timer — there is no window to record yet)."""
         now = time.perf_counter()
         if self._step_start is not None:
             self.batch.record(now - self._step_start, num_samples)
-        if num_samples:
-            self.total_samples += num_samples
+            if num_samples:
+                self.total_samples += num_samples
         self._step_start = now
 
     def end(self):
@@ -79,13 +108,18 @@ class Benchmark:
         msg = (f"reader_cost: {reader_avg:.5f} s, batch_cost: {batch_avg:.5f} s")
         if ips:
             msg += f", ips: {ips:.2f} {unit}/s"
+        elif batch_avg:
+            # no sample counts ever recorded: steps/s is still meaningful
+            msg += f", ips: {1.0 / batch_avg:.2f} steps/s"
         return msg
 
     def report(self) -> dict:
+        batch_avg = self.batch.get_average()
         return {
             "reader_cost_avg_s": self.reader.get_average(),
-            "batch_cost_avg_s": self.batch.get_average(),
+            "batch_cost_avg_s": batch_avg,
             "ips": self.batch.get_ips_average(),
+            "steps_per_sec": 1.0 / batch_avg if batch_avg else 0.0,
             "total_samples": self.total_samples,
             "total_time_s": self.total_time,
         }

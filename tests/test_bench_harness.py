@@ -69,6 +69,83 @@ def test_flagship_failure_still_prints_json(capsys, monkeypatch):
     assert "all dead" in rec["configs"]["gpt2_small"]["error"]
 
 
+def test_bench_json_includes_observability_snapshot(capsys, monkeypatch):
+    """PR 2: the bench line must carry the metrics snapshot + retrace
+    summary + schema-valid step records under `observability`."""
+    from paddle_tpu.profiler.monitor import (make_step_record,
+                                             validate_step_record)
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_init_backend_with_retry", lambda: None)
+    monkeypatch.setattr(bench, "bench_gpt2", lambda: {
+        "tokens_per_sec_chip": 1.0, "step_time_ms": 1.0, "mfu": 0.5})
+    for name in ("bench_resnet50", "bench_bert_base",
+                 "bench_wide_deep_ps", "bench_wide_deep_ps_tpu"):
+        monkeypatch.setattr(bench, name, lambda: {"ok": 1})
+    # a timed run would have appended one of these (schema from monitor.py)
+    bench._STEP_RECORDS.append(make_step_record(
+        step=40, window_steps=40, window_time_s=2.0, samples=320,
+        flops_per_step=1e12, peak_flops=197e12, retraces=0))
+    rec = _run_main(bench, capsys)
+    obs = rec["observability"]
+    assert isinstance(obs["metrics"], dict)
+    # counter families registered at import are in the snapshot even on CPU
+    assert "op_calls_total" in obs["metrics"]
+    assert "collective_bytes_total" in obs["metrics"]
+    assert "jit_retraces_total" in obs["metrics"]
+    assert isinstance(obs["retraces_total"], int)
+    assert obs["step_records"], "step records must be folded in"
+    for sr in obs["step_records"]:
+        validate_step_record(sr)
+    assert sr["ips"] == 160.0  # 320 samples / 2 s
+
+
+def test_run_config_emits_step_record(monkeypatch):
+    """bench._run_config appends a schema-valid step record per timed run
+    (exercised with a stub compiled step — no device needed)."""
+    from paddle_tpu.profiler.monitor import validate_step_record
+    bench = _load_bench()
+    import jax.numpy as jnp
+
+    class _Opt:
+        def get_lr(self):
+            return 0.1
+
+    class _Compiled:
+        def cost_analysis(self):
+            return {"flops": 2e9, "bytes accessed": 1e6}
+
+        def __call__(self, params, buffers, opt_state, rng, lr, t, *arrs):
+            return jnp.zeros(()), params, buffers, opt_state
+
+    class _Lowered:
+        def compile(self):
+            return _Compiled()
+
+    class _Step:
+        optimizer = _Opt()
+        params, buffers, opt_state = {}, {}, {}
+
+        class _S:
+            @staticmethod
+            def lower(*a, **kw):
+                return _Lowered()
+        _step = _S()
+
+    class _Arg:
+        data = jnp.ones((4, 8), jnp.float32)
+
+    n0 = len(bench._STEP_RECORDS)
+    sec, loss, flops, nbytes = bench._run_config(
+        _Step(), (_Arg(),), iters=3, warmup=1)
+    assert flops == 2e9 and loss == 0.0
+    assert len(bench._STEP_RECORDS) == n0 + 1
+    sr = bench._STEP_RECORDS[-1]
+    validate_step_record(sr)
+    assert sr["window_steps"] == 3
+    assert sr["samples"] == 12  # batch 4 x 3 iters
+    assert sr["flops_per_step_est"] == 2e9
+
+
 def test_import_paddle_tpu_does_not_init_backend():
     """`import paddle_tpu` must never touch the jax backend: a subprocess
     that merely imports the package must not bind (or hang on) the TPU.

@@ -1,0 +1,163 @@
+"""Metrics registry (profiler/metrics.py) + tools/metrics_dump.py.
+
+Reference analog: `paddle/fluid/platform/monitor.h` StatRegistry tests —
+here the registry is labeled, typed, and exports Prometheus text + JSON.
+"""
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from paddle_tpu.profiler import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture()
+def reg():
+    return metrics.MetricsRegistry()
+
+
+class TestCounterGauge:
+    def test_counter_inc_and_labels(self, reg):
+        c = reg.counter("requests_total", "demo")
+        c.inc()
+        c.inc(2, op="matmul")
+        c.inc(3, op="matmul")
+        assert c.value() == 1
+        assert c.value(op="matmul") == 5
+        assert c.total() == 6
+
+    def test_counter_rejects_negative(self, reg):
+        with pytest.raises(ValueError):
+            reg.counter("c").inc(-1)
+
+    def test_gauge_set_inc_dec(self, reg):
+        g = reg.gauge("mem_bytes")
+        g.set(100, device="tpu:0")
+        g.inc(50, device="tpu:0")
+        g.dec(25, device="tpu:0")
+        assert g.value(device="tpu:0") == 125
+
+    def test_get_or_create_and_type_conflict(self, reg):
+        c1 = reg.counter("x_total")
+        assert reg.counter("x_total") is c1
+        with pytest.raises(TypeError):
+            reg.gauge("x_total")
+
+    def test_label_order_irrelevant(self, reg):
+        c = reg.counter("c_total")
+        c.inc(1, a="1", b="2")
+        c.inc(1, b="2", a="1")
+        assert c.value(a="1", b="2") == 2
+
+
+class TestHistogram:
+    def test_buckets_and_sum(self, reg):
+        h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        (snap,) = h.snapshot()["values"]
+        assert snap["count"] == 4
+        assert snap["sum"] == pytest.approx(5.555)
+        assert snap["buckets"]["0.01"] == 1      # cumulative
+        assert snap["buckets"]["0.1"] == 2
+        assert snap["buckets"]["1.0"] == 3
+        assert snap["buckets"]["+Inf"] == 4
+
+
+class TestExporters:
+    def test_prometheus_text_format(self, reg):
+        reg.counter("ops_total", "op calls").inc(3, op="a\"b\n")
+        reg.gauge("hot").set(1.5)
+        reg.histogram("h_seconds", buckets=(1.0,)).observe(0.5)
+        txt = reg.to_prometheus_text()
+        assert '# TYPE paddle_tpu_ops_total counter' in txt
+        assert 'paddle_tpu_ops_total{op="a\\"b\\n"} 3.0' in txt
+        assert 'paddle_tpu_hot 1.5' in txt
+        assert 'paddle_tpu_h_seconds_bucket{le="1.0"} 1' in txt
+        assert 'paddle_tpu_h_seconds_count 1' in txt
+
+    def test_prometheus_headers_even_without_series(self, reg):
+        reg.counter("quiet_total", "never incremented")
+        assert "paddle_tpu_quiet_total" in reg.to_prometheus_text()
+
+    def test_snapshot_json_serializable(self, reg):
+        reg.counter("a_total").inc(2, k="v")
+        reg.histogram("b_seconds").observe(0.1)
+        snap = json.loads(json.dumps(reg.snapshot()))
+        assert snap["a_total"]["kind"] == "counter"
+        assert snap["a_total"]["values"][0] == {"labels": {"k": "v"},
+                                                "value": 2.0}
+        assert snap["b_seconds"]["values"][0]["count"] == 1
+
+    def test_reset_keeps_families(self, reg):
+        reg.counter("a_total").inc(5)
+        reg.reset()
+        assert reg.counter("a_total").total() == 0
+        assert "a_total" in reg.names()
+
+
+class TestEnableSwitch:
+    def test_set_enabled_roundtrip(self):
+        was = metrics.enabled()
+        try:
+            metrics.set_enabled(False)
+            assert not metrics.enabled()
+            metrics.set_enabled(True)
+            assert metrics.enabled()
+        finally:
+            metrics.set_enabled(was)
+
+
+class TestThreadSafety:
+    def test_concurrent_increments(self, reg):
+        c = reg.counter("t_total")
+        n, k = 8, 2000
+
+        def work():
+            for _ in range(k):
+                c.inc(1, tid="x")
+
+        threads = [threading.Thread(target=work) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value(tid="x") == n * k
+
+
+class TestMetricsDumpTool:
+    def _snapshot(self):
+        r = metrics.MetricsRegistry()
+        r.counter("collective_bytes_total", "bytes").inc(
+            4096, kind="all_reduce", link="ici")
+        r.histogram("w_seconds").observe(0.2)
+        return r.snapshot()
+
+    def test_format_snapshot(self):
+        import metrics_dump
+        out = metrics_dump.format_snapshot(self._snapshot())
+        assert "collective_bytes_total" in out
+        assert "kind=all_reduce,link=ici" in out
+        assert "4,096" in out
+        out2 = metrics_dump.format_snapshot(self._snapshot(), "w_seconds")
+        assert "collective_bytes_total" not in out2 and "w_seconds" in out2
+
+    def test_cli_accepts_bench_json(self, tmp_path, capsys):
+        import metrics_dump
+        bench_doc = {"metric": "x", "value": 1,
+                     "observability": {"metrics": self._snapshot()}}
+        p = tmp_path / "bench.json"
+        p.write_text(json.dumps(bench_doc))
+        assert metrics_dump.main([str(p)]) == 0
+        assert "collective_bytes_total" in capsys.readouterr().out
+
+    def test_cli_rejects_garbage(self, tmp_path):
+        import metrics_dump
+        p = tmp_path / "x.json"
+        p.write_text("not json at all")
+        assert metrics_dump.main([str(p)]) == 2

@@ -191,10 +191,18 @@ model = WideDeep(num_slots=2, embedding_dim=4, dense_dim=3, hidden=16)
 opt = optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
 rng = np.random.default_rng(100 + tid)
 losses = []
-for step in range(20):
+# FIXED batches cycled over the run: a fresh random batch per step made
+# losses[0] vs losses[-1] a coin flip at 20 steps (observed flaking to the
+# fail side for entire rounds, each costing the suite a 420s communicate
+# timeout) — memorizing a deterministic set is what the assert can promise
+batches = []
+for _ in range(4):
     ids = rng.integers(0, 100, (8, 2)).astype(np.int64)
     x = rng.normal(size=(8, 3)).astype(np.float32)
     yv = ((ids.sum(1) % 2) == 0).astype(np.float32).reshape(-1, 1)
+    batches.append((ids, x, yv))
+for step in range(40):
+    ids, x, yv = batches[step % 4]
     logit = model(paddle.to_tensor(ids), paddle.to_tensor(x))
     label = paddle.to_tensor(yv)
     loss = paddle.nn.functional.binary_cross_entropy_with_logits(logit, label)
@@ -233,12 +241,26 @@ class TestPSCluster:
                 env={**base_env, "TRAINING_ROLE": "TRAINER",
                      "PADDLE_TRAINER_ID": str(tid)},
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-        outs = []
+        outs = [None] * len(procs)
         try:
-            for p in procs:
+            # TRAINERS first (they do the work and signal server shutdown
+            # via stop_worker); waiting on the server first meant a failed
+            # trainer left it serving forever and the test burned the whole
+            # 420s on a process that could never exit
+            for i in (1, 2):
                 # generous: the full-suite run can load the machine heavily
-                out, _ = p.communicate(timeout=420)
-                outs.append(out.decode())
+                out, _ = procs[i].communicate(timeout=420)
+                outs[i] = out.decode()
+            # trainers are done: the server has been told to stop (or never
+            # will be) — a short grace is all it legitimately needs
+            try:
+                out, _ = procs[0].communicate(timeout=30)
+                outs[0] = out.decode()
+            except subprocess.TimeoutExpired:
+                procs[0].kill()
+                out, _ = procs[0].communicate()
+                outs[0] = "SERVER LINGERED (trainers never stopped it):\n" \
+                    + out.decode()
         finally:
             # a timed-out child must NOT outlive the test: a leaked trainer
             # can hold the one shared TPU chip and poison every later run

@@ -1,0 +1,352 @@
+"""Recompile/retrace watchdog (profiler/watchdog.py) wired through the jit
+entry points: the eager dispatch cache, jit.to_static, and TrainStep.
+
+On TPU a silent retrace is THE perf killer this PR exists to surface: the
+acceptance test deliberately changes an input shape across jit calls and
+asserts the miss counter moves and the structured event names the changed
+dimension.
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework import flags
+from paddle_tpu.ops import _dispatch
+from paddle_tpu.profiler import metrics
+from paddle_tpu.profiler.watchdog import (RetraceWatchdog, describe_delta,
+                                          get_watchdog, signature_of)
+
+
+@pytest.fixture()
+def wd():
+    w = get_watchdog()
+    w.reset()
+    yield w
+    w.reset()
+
+
+class TestDeltaNaming:
+    def test_shape_delta_names_dimension(self):
+        old = signature_of([np.ones((4, 8), np.float32)])
+        new = signature_of([np.ones((6, 8), np.float32)])
+        d = describe_delta(old, new)
+        assert "dim0 4->6" in d and "(4, 8)" in d and "(6, 8)" in d
+
+    def test_dtype_delta(self):
+        old = signature_of([np.ones((2,), np.float32)])
+        new = signature_of([np.ones((2,), np.int32)])
+        assert "dtype float32->int32" in describe_delta(old, new)
+
+    def test_rank_and_arity_delta(self):
+        a = signature_of([np.ones((2, 3), np.float32)])
+        b = signature_of([np.ones((2, 3, 4), np.float32)])
+        assert "rank 2->3" in describe_delta(a, b)
+        c = signature_of([np.ones((2,)), np.ones((2,))])
+        assert "arity 1->2" in describe_delta(a, c)
+
+    def test_static_args_delta(self):
+        old = signature_of([np.ones((2,))], static={"axis": 0})
+        new = signature_of([np.ones((2,))], static={"axis": 1})
+        assert "static args" in describe_delta(old, new)
+        assert "axis" in describe_delta(old, new)
+
+
+class TestWatchdogCore:
+    def test_first_compile_is_not_a_retrace(self, wd):
+        assert wd.observe("s", "f", [np.ones((2,))]) is None
+        assert wd.total_retraces() == 0
+
+    def test_repeat_signature_is_hit(self, wd):
+        wd.observe("s", "f", [np.ones((2,))])
+        assert wd.observe("s", "f", [np.ones((2,))]) is None
+        assert wd.total_retraces() == 0
+
+    def test_new_signature_is_retrace_with_delta(self, wd):
+        wd.observe("s", "f", [np.ones((2, 4), np.float32)])
+        ev = wd.observe("s", "f", [np.ones((3, 4), np.float32)])
+        assert ev is not None and ev.count == 1
+        assert "dim0 2->3" in ev.delta
+        assert wd.total_retraces("s") == 1
+        assert wd.counts() == {"s:f": 1}
+        snap = wd.snapshot()
+        assert snap["total_retraces"] == 1
+        assert snap["events"][-1]["delta"] == ev.delta
+
+    def test_seen_signatures_become_hits(self, wd):
+        """A->B->A: the return to A is a cache HIT (both signatures hold a
+        compiled executable), so only the first A->B transition counts as a
+        retrace — the counter measures compiles, not signature flips."""
+        a, b = [np.ones((2,))], [np.ones((3,))]
+        wd.observe("s", "f", a)
+        wd.observe("s", "f", b)
+        # both signatures now seen: further calls are hits, not retraces
+        assert wd.observe("s", "f", a) is None
+        assert wd.total_retraces() == 1
+
+    def test_warn_threshold_logs_once_per_window(self, wd, caplog):
+        wd.warn_threshold = 2
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu.retrace"):
+            for n in (1, 2, 3, 4):
+                wd.observe("s", "hot_op", [np.ones((n, 8))])
+        warns = [r for r in caplog.records if "retraced" in r.getMessage()]
+        assert len(warns) == 1
+        assert "hot_op" in warns[0].getMessage()
+        caplog.clear()
+        wd.reset_window()
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu.retrace"):
+            for n in (5, 6, 7):
+                wd.observe("s", "hot_op", [np.ones((n, 8))])
+        assert any("retraced" in r.getMessage() for r in caplog.records)
+
+    def test_counters_mirrored_to_metrics(self, wd):
+        reg = metrics.default_registry()
+        misses0 = reg.counter("jit_cache_misses_total").value(site="tw")
+        retr0 = reg.counter("jit_retraces_total").value(site="tw")
+        wd.observe("tw", "f", [np.ones((2,))])
+        wd.observe("tw", "f", [np.ones((3,))])
+        wd.observe("tw", "f", [np.ones((3,))])  # hit
+        assert reg.counter("jit_cache_misses_total").value(site="tw") \
+            == misses0 + 2
+        assert reg.counter("jit_retraces_total").value(site="tw") == retr0 + 1
+
+
+class TestJitWiring:
+    def test_to_static_shape_change_observed(self, wd):
+        """Acceptance: deliberately change an input shape across jit calls;
+        the miss counter increments and the event names the dimension."""
+        reg = metrics.default_registry()
+        miss0 = reg.counter("jit_cache_misses_total").value(site="to_static")
+
+        @paddle.jit.to_static
+        def double(a):
+            return a * 2.0
+
+        double(paddle.to_tensor(np.ones((4, 8), np.float32)))
+        double(paddle.to_tensor(np.ones((6, 8), np.float32)))
+        assert reg.counter("jit_cache_misses_total").value(site="to_static") \
+            >= miss0 + 2
+        evs = [e for e in wd.events if e.site == "to_static"]
+        assert evs, "shape change must produce a retrace event"
+        assert "dim0 4->6" in evs[-1].delta
+
+    def test_static_layer_batch_size_change_observed(self, wd):
+        layer = paddle.jit.to_static(nn.Linear(8, 4))
+        layer(paddle.to_tensor(np.ones((2, 8), np.float32)))
+        layer(paddle.to_tensor(np.ones((5, 8), np.float32)))
+        evs = [e for e in wd.events if e.site == "to_static"]
+        assert evs and "2->5" in evs[-1].delta
+
+    def test_eager_cache_miss_notes_watchdog(self, wd):
+        _dispatch.clear_eager_cache()
+        flags.set_flags({"FLAGS_eager_op_cache": True})
+        x4 = paddle.to_tensor(np.ones((4, 4), np.float32))
+        x6 = paddle.to_tensor(np.ones((6, 6), np.float32))
+        with paddle.no_grad():
+            (x4 @ x4).numpy()
+            (x6 @ x6).numpy()
+        evs = [e for e in wd.events if e.site == "eager"]
+        assert any("matmul" == e.name and "4" in e.delta and "6" in e.delta
+                   for e in evs), [(e.name, e.delta) for e in evs]
+
+    def test_stable_shapes_do_not_retrace(self, wd):
+        @paddle.jit.to_static
+        def f(a):
+            return a + 1.0
+
+        for _ in range(4):
+            f(paddle.to_tensor(np.ones((3, 3), np.float32)))
+        assert wd.total_retraces("to_static") == 0
+
+    def test_to_static_function_jits_once_per_signature(self, wd):
+        """Regression (found by this PR's watchdog work): the function path
+        used to rebuild its @jax.jit wrapper per call, re-tracing every
+        invocation while the watchdog showed the site retrace-free. The
+        trace count — the fn body runs only at trace time under jit — must
+        match the number of DISTINCT signatures, not the number of calls."""
+        traces = []
+
+        @paddle.jit.to_static
+        def g(a):
+            traces.append(1)
+            return a * 3.0
+
+        for _ in range(4):
+            g(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        assert len(traces) == 1, f"re-traced {len(traces)}x for one signature"
+        g(paddle.to_tensor(np.ones((5, 2), np.float32)))
+        assert len(traces) == 2
+        assert wd.total_retraces("to_static") == 1
+
+    def test_seen_set_is_bounded(self):
+        w = RetraceWatchdog()
+        w._SEEN_MAX = 8
+        for n in range(50):
+            w.observe("s", "f", [np.ones((n + 1,))])
+        assert len(w._seen[("s", "f")]) <= 8
+
+    def test_kwargs_order_does_not_fake_a_retrace(self, wd):
+        """Two call sites building identical static kwargs in different
+        insertion orders share ONE signature (matching the eager cache's
+        sorted canonicalization)."""
+        a = signature_of([np.ones((2,))], static={"axis": 0, "keepdim": True})
+        b = signature_of([np.ones((2,))], static={"keepdim": True, "axis": 0})
+        assert a == b
+        wd.observe("s", "f", [np.ones((2,))],
+                   static={"axis": 0, "keepdim": True})
+        wd.observe("s", "f", [np.ones((2,))],
+                   static={"keepdim": True, "axis": 0})
+        assert wd.total_retraces() == 0
+
+    def test_static_layer_instances_do_not_cross_talk(self, wd):
+        """Each StaticLayer owns a jit cache, so the watchdog key is per
+        instance: a second instance's first compile (any batch size) is a
+        first compile, not a retrace of the first instance."""
+        l1 = paddle.jit.to_static(nn.Linear(4, 2))
+        l2 = paddle.jit.to_static(nn.Linear(4, 2))
+        l1(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        l2(paddle.to_tensor(np.ones((7, 4), np.float32)))
+        assert wd.total_retraces("to_static") == 0
+
+
+class TestToStaticLiveness:
+    """The hoisted one-jit-per-conversion function path must not freeze
+    closure state or randomness as trace constants."""
+
+    def test_closure_tensor_updates_stay_visible(self, wd):
+        w = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+
+        @paddle.jit.to_static
+        def scale(x):
+            return x * w
+
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        np.testing.assert_allclose(scale(x).numpy(), [2, 2, 2])
+        w.data = paddle.to_tensor(np.full((3,), 5.0, np.float32)).data
+        # same input signature -> jit cache HIT, yet the new value must land
+        np.testing.assert_allclose(scale(x).numpy(), [5, 5, 5])
+
+    def test_closure_layer_params_stay_visible(self, wd):
+        lin = nn.Linear(3, 3)
+
+        @paddle.jit.to_static
+        def fwd(x):
+            return lin(x)
+
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        before = fwd(x).numpy()
+        for p in lin.parameters():
+            p.data = (p + 1.0).data
+        after = fwd(x).numpy()
+        assert not np.allclose(before, after), \
+            "parameter update was baked into the compiled function"
+
+    def test_independent_conversions_do_not_cross_talk(self, wd):
+        """Each to_static(fn) call owns a fresh jit cache, so the watchdog
+        key is per conversion: the second conversion's first compile at a
+        different shape is a first compile, not a retrace of the first."""
+        def fn(a):
+            return a + 1.0
+
+        f1 = paddle.jit.to_static(fn)
+        f2 = paddle.jit.to_static(fn)
+        f1(paddle.to_tensor(np.ones((1, 2), np.float32)))
+        f2(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        assert wd.total_retraces("to_static") == 0
+
+    def test_closure_cell_rebinding_stays_visible(self, wd):
+        """`nonlocal w; w = new_tensor` after conversion must reach the
+        compiled function (cells are re-read per call, not snapshot once)."""
+        w = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+
+        def fn(x):
+            return x * w
+
+        f = paddle.jit.to_static(fn)
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        np.testing.assert_allclose(f(x).numpy(), [2, 2, 2])
+        w = paddle.to_tensor(np.full((3,), 7.0, np.float32))  # rebind cell
+        np.testing.assert_allclose(f(x).numpy(), [7, 7, 7])
+
+    def test_kwargs_rejected_loudly(self, wd):
+        """The compiled function path is positional-only: silently tracing
+        with defaults returned wrong numbers, so kwargs must raise."""
+        def fn(x, scale=1.0):
+            return x * scale
+
+        f = paddle.jit.to_static(fn)
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(f(x, 2.0).numpy(), [2, 2])
+        with pytest.raises(TypeError, match="scale"):
+            f(x, scale=2.0)
+
+    def test_closure_tensor_shape_change_is_a_visible_retrace(self, wd):
+        """A closure tensor whose SHAPE changes re-traces the jit exactly
+        like an input change — the watchdog must see it (aux rides the
+        observed signature)."""
+        w = paddle.to_tensor(np.ones((3,), np.float32))
+
+        def fn(x):
+            return x * w
+
+        f = paddle.jit.to_static(fn)
+        x3 = paddle.to_tensor(np.ones((3,), np.float32))
+        f(x3)
+        w = paddle.to_tensor(np.ones((1,), np.float32))  # broadcastable
+        f(x3)
+        assert wd.total_retraces("to_static") == 1
+        assert "3" in wd.events[-1].delta and "1" in wd.events[-1].delta
+
+    def test_module_global_layer_params_stay_visible(self, wd):
+        """The common global-model pattern: a to_static function referencing
+        a module-global Layer must see parameter updates (globals the code
+        references are captured and threaded like closure cells)."""
+        import types
+        mod = types.ModuleType("_tsg_mod")
+        exec(
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu import nn\n"
+            "lin = nn.Linear(2, 1)\n"
+            "def fwd(x):\n"
+            "    return lin(x)\n", mod.__dict__)
+        f = paddle.jit.to_static(mod.fwd)
+        x = paddle.to_tensor(np.ones((1, 2), np.float32))
+        before = f(x).numpy()
+        for p in mod.lin.parameters():
+            p.data = (p + 1.0).data
+        after = f(x).numpy()
+        np.testing.assert_allclose(after - before, [[3.0]], rtol=1e-5), \
+            "global layer's parameter update was baked in as a constant"
+
+    def test_static_layer_kw_shape_change_observed(self, wd):
+        """kw arguments ride the jit signature too: a varying kw shape is a
+        retrace the watchdog must see."""
+        class WithMask(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x, mask=None):
+                out = self.lin(x)
+                return out * mask if mask is not None else out
+
+        st = paddle.jit.to_static(WithMask())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        st(x, mask=paddle.to_tensor(np.ones((2, 4), np.float32)).data)
+        st(x, mask=paddle.to_tensor(np.ones((1, 4), np.float32)).data)
+        assert wd.total_retraces("to_static") == 1
+        assert "2" in wd.events[-1].delta and "1" in wd.events[-1].delta
+
+    def test_randomness_stays_fresh_across_calls(self, wd):
+        from paddle_tpu.nn import functional as F
+
+        @paddle.jit.to_static
+        def drop(x):
+            return F.dropout(x, p=0.5, training=True)
+
+        x = paddle.to_tensor(np.ones((64, 64), np.float32))
+        outs = [drop(x).numpy() for _ in range(3)]
+        assert not np.allclose(outs[0], outs[1])
+        assert not np.allclose(outs[1], outs[2])

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Pretty-print a paddle_tpu metrics snapshot for humans.
+
+Input forms (auto-detected):
+  * a raw `MetricsRegistry.snapshot()` JSON file;
+  * a bench output / driver `BENCH_r{N}.json` whose `observability.metrics`
+    holds the snapshot (the shape bench.py emits since PR 2);
+  * `-` for stdin.
+
+CLI:
+    python tools/metrics_dump.py BENCH_r06.json
+    python tools/metrics_dump.py snapshot.json --filter collective
+    python bench.py | python tools/metrics_dump.py -
+
+Exit code 0 on success, 2 on unusable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+def _extract_snapshot(doc) -> Optional[dict]:
+    """Find a metrics snapshot in any of the accepted document shapes."""
+    if not isinstance(doc, dict):
+        return None
+    # registry snapshot: every value is {kind, ...}
+    if doc and all(isinstance(v, dict) and "kind" in v for v in doc.values()):
+        return doc
+    obs = doc.get("observability")
+    if isinstance(obs, dict) and isinstance(obs.get("metrics"), dict):
+        return obs["metrics"]
+    if isinstance(doc.get("metrics"), dict):
+        return _extract_snapshot(doc["metrics"]) or doc["metrics"]
+    return None
+
+
+def _fmt_value(v: float) -> str:
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        v = int(v)
+    if isinstance(v, int):
+        return f"{v:,}"
+    return f"{v:.6g}"
+
+
+def _fmt_labels(labels: dict) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "-"
+
+
+def format_snapshot(snap: dict, name_filter: str = "") -> str:
+    """Render {name: {kind, help, values}} as aligned human-readable rows."""
+    lines = []
+    for name in sorted(snap):
+        if name_filter and name_filter not in name:
+            continue
+        fam = snap[name]
+        kind, values = fam.get("kind", "?"), fam.get("values", [])
+        lines.append(f"{name} [{kind}] — {fam.get('help', '')}")
+        if not values:
+            lines.append("    (no series)")
+            continue
+        for v in sorted(values, key=lambda d: _fmt_labels(d.get("labels", {}))):
+            labels = _fmt_labels(v.get("labels", {}))
+            if kind == "histogram":
+                cnt, tot = v.get("count", 0), v.get("sum", 0.0)
+                avg = tot / cnt if cnt else 0.0
+                lines.append(f"    {labels:<40} count={cnt:,} "
+                             f"sum={tot:.6g}s avg={avg:.6g}s")
+            else:
+                lines.append(f"    {labels:<40} {_fmt_value(v.get('value', 0))}")
+    return "\n".join(lines) if lines else "(empty snapshot)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="snapshot/bench JSON file, or - for stdin")
+    ap.add_argument("--filter", default="",
+                    help="only show metric families whose name contains this")
+    ap.add_argument("--json", action="store_true",
+                    help="re-emit the extracted snapshot as JSON instead of "
+                         "the human table")
+    args = ap.parse_args(argv)
+    try:
+        txt = sys.stdin.read() if args.path == "-" else open(args.path).read()
+    except OSError as e:
+        print(f"metrics_dump: {e}", file=sys.stderr)
+        return 2
+    doc = None
+    for line in [txt] + list(reversed(txt.strip().splitlines())):
+        try:
+            doc = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    snap = _extract_snapshot(doc) if doc is not None else None
+    if snap is None:
+        print("metrics_dump: no metrics snapshot found in input "
+              "(expected a registry snapshot or bench JSON with "
+              "observability.metrics)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(snap, indent=2, sort_keys=True))
+    else:
+        print(format_snapshot(snap, args.filter))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,7 @@ from .nn.initializer import ParamAttr  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import device  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import fault  # noqa: E402,F401
 from .framework.flags import get_flags, set_flags  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from . import fft  # noqa: E402,F401

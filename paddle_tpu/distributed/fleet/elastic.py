@@ -54,6 +54,7 @@ class ElasticManager:
             self._store = TCPStore(h, int(p), is_master=is_master)
         self._stop = threading.Event()
         self._beat_thread: Optional[threading.Thread] = None
+        self._slot: Optional[int] = None
         self.elastic_level = int(os.environ.get(
             "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1"))
 
@@ -103,15 +104,46 @@ class ElasticManager:
         for i in range(int(n)):
             key = f"member/{i}"
             if self._store.check(key):
-                ids.append(self._store.get(key).decode())
+                v = self._store.get(key).decode()
+                if v:  # "" = tombstone left by a clean exit
+                    ids.append(v)
         # a restarted host re-joins into a fresh slot while its old slot
         # remains — dedupe by host id so it cannot count twice
         return list(dict.fromkeys(ids))
 
+    def _reclaim_slot(self) -> Optional[int]:
+        """Pop a slot freed by a clean exit. Each free-list index has its
+        own monotonic claim counter, so `add(claim/i, 1) == 1` is won by
+        exactly one joiner EVER — no hand-back, no double-claim window.
+        If a won index's value is not yet visible (exit publishes the count
+        after a concurrent exit's value write is still in flight), that
+        freed slot stays tombstoned unreclaimed — safe, just unreused."""
+        try:
+            n = int(self._store.add("member_free_count", 0))
+            for i in range(n):
+                if self._store.add(f"member_free_claim/{i}", 1) != 1:
+                    continue  # someone else owns this index forever
+                key = f"member_free/{i}"
+                if not self._store.check(key):
+                    # won a claim whose value write is still in flight
+                    # (concurrent exits publish the count once): that slot
+                    # is unrecoverable, but later indices may not be —
+                    # keep scanning
+                    continue
+                return int(self._store.get(key).decode())
+            return None
+        except Exception:
+            return None
+
     def join(self):
-        """Claim a membership slot atomically (any rank)."""
-        slot = self._store.add("member_count", 1) - 1
+        """Claim a membership slot atomically (any rank). Prefers a slot
+        released by ElasticManager.exit() so member_count stays bounded
+        across restart cycles instead of growing forever."""
+        slot = self._reclaim_slot()
+        if slot is None:
+            slot = self._store.add("member_count", 1) - 1
         self._store.set(f"member/{slot}", self.host_id)
+        self._slot = slot
         self.register()
 
     # -- watching (reference manager.watch:126) ----------------------------
@@ -139,6 +171,18 @@ class ElasticManager:
             self._store.delete_key(f"beat/{self.host_id}")
         except Exception:
             pass
+        # release the membership slot: tombstone member/<i> and publish it
+        # on the free list so the next joiner reuses it (without this,
+        # member_count grows without bound across restart cycles)
+        if self._slot is not None:
+            try:
+                self._store.set(f"member/{self._slot}", "")
+                j = self._store.add("member_free_next", 1) - 1
+                self._store.set(f"member_free/{j}", str(self._slot))
+                self._store.add("member_free_count", 1)  # publish LAST
+            except Exception:
+                pass  # store gone: job is tearing down
+            self._slot = None
 
     @staticmethod
     def request_restart():

@@ -10,11 +10,31 @@ from __future__ import annotations
 
 import ctypes
 from dataclasses import dataclass
-from typing import Dict, List, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ... import _native
+from ...fault import RetryExhaustedError, RetryPolicy
+from ...fault import site as _fault_site
+
+
+class PSRequestError(RuntimeError):
+    """A PS RPC failed every retry. Names the dead endpoint and table so an
+    operator can tell WHICH server to look at (the reference's brpc client
+    logs the channel address on `FLAGS_pserver_timeout_ms` exhaustion)."""
+
+    def __init__(self, op: str, endpoint: str, table_id: int,
+                 attempts: int, last: BaseException):
+        super().__init__(
+            f"PS request {op!r} to server {endpoint} (table {table_id}) "
+            f"failed after {attempts} attempt(s): "
+            f"{type(last).__name__}: {last}")
+        self.op = op
+        self.endpoint = endpoint
+        self.table_id = table_id
+        self.attempts = attempts
+        self.last = last
 
 _F32P = ctypes.POINTER(ctypes.c_float)
 _U64P = ctypes.POINTER(ctypes.c_uint64)
@@ -43,7 +63,27 @@ class TableConfig:
 
 
 class PSClient:
-    def __init__(self, endpoints: Sequence[str], timeout_ms: int = 60000):
+    def __init__(self, endpoints: Sequence[str], timeout_ms: int = 60000,
+                 retry: Optional[RetryPolicy] = None):
+        if retry is None:
+            retry = RetryPolicy.from_env(
+                "PS", max_attempts=3, base_delay=0.1, max_delay=2.0)
+        # never thread-abandon a native RPC (caller-supplied policies
+        # included): an abandoned attempt keeps writing into the caller-
+        # owned numpy buffer that its retry (and even the returned array)
+        # also uses. Per-attempt deadlines belong to the transport's
+        # timeout_ms, not the retry layer.
+        if retry.attempt_timeout is not None:
+            import copy
+            import warnings
+            warnings.warn(
+                "PSClient ignores RetryPolicy.attempt_timeout (and "
+                "PADDLE_TPU_PS_TIMEOUT): PS RPCs write caller-owned "
+                "buffers and cannot be thread-abandoned; bound individual "
+                "RPCs with PSClient(timeout_ms=...) instead")
+            retry = copy.copy(retry)  # don't mutate the caller's policy
+            retry.attempt_timeout = None
+        self._retry = retry
         self._lib = _native.load()
         self._endpoints = list(endpoints)
         self._handles: List[int] = []
@@ -58,6 +98,26 @@ class PSClient:
     @property
     def num_servers(self) -> int:
         return len(self._handles)
+
+    def _rpc(self, op: str, server_idx: int, table_id: int,
+             call: Callable[[], int]):
+        """Run one native RPC under retry+backoff with a fault site
+        (`ps.<op>`); after exhaustion raise PSRequestError naming the dead
+        endpoint. `call` returns the native rc (0 = ok). Pull/set calls
+        rewrite the same buffer and are safe to replay; merge-style pushes
+        are at-least-once under retry (the native transport fails before
+        the server applies, so a replayed push did not apply the first
+        time)."""
+        def _do():
+            _fault_site(f"ps.{op}")
+            rc = call()
+            if rc != 0:
+                raise RuntimeError(f"{op} rpc returned {rc}")
+        try:
+            self._retry.call(_do, op=f"ps.{op}")
+        except RetryExhaustedError as e:
+            raise PSRequestError(op, self._endpoints[server_idx], table_id,
+                                 e.attempts, e.last) from e
 
     def create_table(self, cfg: TableConfig):
         """Create on every server (idempotent server-side)."""
@@ -76,8 +136,11 @@ class PSClient:
 
     # ------------------------------ dense ---------------------------------
 
-    def _dense_handle(self, table_id: int) -> int:
-        return self._handles[table_id % self.num_servers]
+    def _dense_server(self, table_id: int):
+        """(server_idx, handle) hosting a dense table — the one routing
+        rule, shared by every dense op."""
+        s = table_id % self.num_servers
+        return s, self._handles[s]
 
     # dense tables of any size: transport in <=16M-float (64MB) chunks so
     # frames stay far under the 256MB transport cap
@@ -86,37 +149,34 @@ class PSClient:
     def pull_dense(self, table_id: int) -> np.ndarray:
         cfg = self._tables[table_id]
         out = np.empty(cfg.dense_size, np.float32)
-        h = self._dense_handle(table_id)
+        s, h = self._dense_server(table_id)
         for off in range(0, cfg.dense_size, self._DENSE_CHUNK):
             ln = min(self._DENSE_CHUNK, cfg.dense_size - off)
             chunk = out[off:off + ln]
-            rc = self._lib.ps_pull_dense(
-                h, table_id, chunk.ctypes.data_as(_F32P), off, ln)
-            if rc != 0:
-                raise RuntimeError(f"pull_dense({table_id}) failed")
+            self._rpc("pull_dense", s, table_id,
+                      lambda: self._lib.ps_pull_dense(
+                          h, table_id, chunk.ctypes.data_as(_F32P), off, ln))
         return out
 
     def push_dense(self, table_id: int, grad: np.ndarray):
         g = np.ascontiguousarray(grad, np.float32).ravel()
-        h = self._dense_handle(table_id)
+        s, h = self._dense_server(table_id)
         for off in range(0, g.size, self._DENSE_CHUNK):
             ln = min(self._DENSE_CHUNK, g.size - off)
             chunk = np.ascontiguousarray(g[off:off + ln])
-            rc = self._lib.ps_push_dense(
-                h, table_id, chunk.ctypes.data_as(_F32P), off, ln)
-            if rc != 0:
-                raise RuntimeError(f"push_dense({table_id}) failed")
+            self._rpc("push_dense", s, table_id,
+                      lambda: self._lib.ps_push_dense(
+                          h, table_id, chunk.ctypes.data_as(_F32P), off, ln))
 
     def set_dense(self, table_id: int, values: np.ndarray):
         v = np.ascontiguousarray(values, np.float32).ravel()
-        h = self._dense_handle(table_id)
+        s, h = self._dense_server(table_id)
         for off in range(0, v.size, self._DENSE_CHUNK):
             ln = min(self._DENSE_CHUNK, v.size - off)
             chunk = np.ascontiguousarray(v[off:off + ln])
-            rc = self._lib.ps_set_dense(
-                h, table_id, chunk.ctypes.data_as(_F32P), off, ln)
-            if rc != 0:
-                raise RuntimeError(f"set_dense({table_id}) failed")
+            self._rpc("set_dense", s, table_id,
+                      lambda: self._lib.ps_set_dense(
+                          h, table_id, chunk.ctypes.data_as(_F32P), off, ln))
 
     # ------------------------------ sparse --------------------------------
 
@@ -161,11 +221,11 @@ class PSClient:
         for i in range(0, keys.size, step):
             k = keys[i:i + step]
             o = out[i:i + step]
-            rc = self._lib.ps_pull_sparse(
-                self._handles[s], table_id, k.ctypes.data_as(_U64P), k.size,
-                o.ctypes.data_as(_F32P), o.size)
-            if rc != 0:
-                raise RuntimeError(f"pull_sparse({table_id}) failed")
+            self._rpc("pull_sparse", s, table_id,
+                      lambda: self._lib.ps_pull_sparse(
+                          self._handles[s], table_id,
+                          k.ctypes.data_as(_U64P), k.size,
+                          o.ctypes.data_as(_F32P), o.size))
 
     def push_sparse(self, table_id: int, keys: np.ndarray, grads: np.ndarray):
         """keys uint64 [n], grads float32 [n, dim]."""
@@ -186,11 +246,11 @@ class PSClient:
         for i in range(0, keys.size, step):
             k = np.ascontiguousarray(keys[i:i + step])
             g = np.ascontiguousarray(grads[i:i + step])
-            rc = self._lib.ps_push_sparse(
-                self._handles[s], table_id, k.ctypes.data_as(_U64P), k.size,
-                g.ctypes.data_as(_F32P), g.size)
-            if rc != 0:
-                raise RuntimeError(f"push_sparse({table_id}) failed")
+            self._rpc("push_sparse", s, table_id,
+                      lambda: self._lib.ps_push_sparse(
+                          self._handles[s], table_id,
+                          k.ctypes.data_as(_U64P), k.size,
+                          g.ctypes.data_as(_F32P), g.size))
 
     # -------------------- CTR lifecycle (ctr_accessor) ---------------------
 

@@ -5,6 +5,14 @@ reference's `core.TCPStore` (/root/reference/paddle/fluid/distributed/store/
 tcp_store.h:91) as used by `init_parallel_env`
 (`python/paddle/distributed/parallel.py:232`): the master rank hosts the
 server in-process, every rank (master included) is a client.
+
+get/set/add run under a bounded retry+backoff policy (knobs:
+`PADDLE_TPU_STORE_RETRIES` / `PADDLE_TPU_STORE_BACKOFF`, or pass
+`retry=RetryPolicy(...)`): a transient master hiccup during rendezvous
+should cost milliseconds, not the job. `add` is retried too — the native
+call fails atomically before applying, but a network-partitioned success
+whose ACK was lost would re-apply, so treat add as at-least-once under
+retry. Each op declares a fault site (`store.get` etc.) for chaos tests.
 """
 from __future__ import annotations
 
@@ -12,13 +20,18 @@ import ctypes
 from typing import List, Optional
 
 from .. import _native
+from ..fault import RetryPolicy
+from ..fault import site as _fault_site
 
 _GET_CAP = 1 << 20
 
 
 class TCPStore:
     def __init__(self, host: str, port: int, is_master: bool = False,
-                 world_size: int = 1, timeout: int = 120):
+                 world_size: int = 1, timeout: int = 120,
+                 retry: Optional[RetryPolicy] = None):
+        self._retry = retry or RetryPolicy.from_env(
+            "STORE", max_attempts=3, base_delay=0.05, max_delay=1.0)
         self._lib = _native.load()
         self._server_h: Optional[int] = None
         if is_master:
@@ -39,21 +52,32 @@ class TCPStore:
     def set(self, key: str, value):
         if isinstance(value, str):
             value = value.encode()
-        if self._lib.store_set(self._h, key.encode(), value, len(value)) != 0:
-            raise RuntimeError("TCPStore.set failed")
+
+        def _do():
+            _fault_site("store.set")
+            if self._lib.store_set(self._h, key.encode(), value,
+                                   len(value)) != 0:
+                raise RuntimeError(f"TCPStore.set({key!r}) failed")
+        self._retry.call(_do, op="store.set")
 
     def get(self, key: str) -> bytes:
-        buf = ctypes.create_string_buffer(_GET_CAP)
-        n = self._lib.store_get(self._h, key.encode(), buf, _GET_CAP)
-        if n < 0:
-            raise RuntimeError("TCPStore.get failed")
-        return buf.raw[:n]
+        def _do():
+            _fault_site("store.get")
+            buf = ctypes.create_string_buffer(_GET_CAP)
+            n = self._lib.store_get(self._h, key.encode(), buf, _GET_CAP)
+            if n < 0:
+                raise RuntimeError(f"TCPStore.get({key!r}) failed")
+            return buf.raw[:n]
+        return self._retry.call(_do, op="store.get")
 
     def add(self, key: str, delta: int) -> int:
-        v = self._lib.store_add(self._h, key.encode(), delta)
-        if v == -(2 ** 63):
-            raise RuntimeError("TCPStore.add failed")
-        return v
+        def _do():
+            _fault_site("store.add")
+            v = self._lib.store_add(self._h, key.encode(), delta)
+            if v == -(2 ** 63):
+                raise RuntimeError(f"TCPStore.add({key!r}) failed")
+            return v
+        return self._retry.call(_do, op="store.add")
 
     def wait(self, keys: List[str]):
         arr = (ctypes.c_char_p * len(keys))(*[k.encode() for k in keys])

@@ -1,0 +1,197 @@
+"""Deterministic fault injection at named sites.
+
+Instrumented code declares a site — `fault.site("store.get")` — which is a
+no-op until armed.  Arming happens either programmatically
+(`fault.configure("store.get", times=1)`) or via the
+`PADDLE_TPU_FAULT_SPEC` environment variable, which spawned DataLoader
+worker processes inherit, so a single spec string can fault any layer of a
+training job.
+
+Spec grammar (semicolon-separated clauses)::
+
+    spec   := clause (';' clause)*
+    clause := site '=' count ['@' start] [':' kind]
+    kind   := 'error' | 'timeout' | 'oserror' | 'kill'
+
+`count` occurrences are faulted starting at the `start`-th call of the
+site (1-based, default 1).  Occurrences are counted per process.  Examples:
+
+    store.get=2                 fail the first two store.get calls
+    ps.pull_dense=1@3           fail only the third pull_dense RPC
+    dataloader.worker0=1:kill   worker 0 os._exit()s on its first batch
+
+Every injected fault increments `fault_injected_total{site=,kind=}` in the
+metrics registry, so a chaos run's recovery story is auditable from the
+prometheus/JSON snapshot alongside the retry counters.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..profiler import metrics as _metrics_mod
+
+SPEC_ENV = "PADDLE_TPU_FAULT_SPEC"
+
+_REG = _metrics_mod.default_registry()
+_M_INJECTED = _REG.counter(
+    "fault_injected_total",
+    "faults injected at instrumented sites, labeled by site and kind")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed fault site (kind=error)."""
+
+
+class InjectedTimeout(TimeoutError):
+    """Raised by an armed fault site (kind=timeout)."""
+
+
+class InjectedIOError(OSError):
+    """Raised by an armed fault site (kind=oserror)."""
+
+
+_KINDS = ("error", "timeout", "oserror", "kill")
+
+
+@dataclass
+class _Rule:
+    count: int          # how many occurrences to fault
+    start: int = 1      # 1-based first faulted occurrence
+    kind: str = "error"
+    fired: int = 0      # how many faults this rule has injected
+
+
+def _parse_clause(clause: str) -> Optional[tuple]:
+    site_name, sep, action = clause.partition("=")
+    site_name = site_name.strip()
+    if not sep or not site_name:
+        return None
+    action = action.strip()
+    kind = "error"
+    if ":" in action:
+        action, kind = action.rsplit(":", 1)
+        kind = kind.strip().lower()
+        if kind not in _KINDS:
+            return None
+    start = 1
+    if "@" in action:
+        action, s = action.split("@", 1)
+        start = int(s)
+    count = int(action)
+    if count < 0 or start < 1:
+        return None
+    return site_name, _Rule(count=count, start=start, kind=kind)
+
+
+class FaultInjector:
+    """Per-process registry of armed fault sites (thread-safe)."""
+
+    def __init__(self, spec: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._rules: Dict[str, _Rule] = {}
+        self._seen: Dict[str, int] = {}
+        if spec is None:
+            spec = os.environ.get(SPEC_ENV, "")
+        if spec:
+            self.load_spec(spec)
+
+    def load_spec(self, spec: str):
+        """Parse and arm a spec string; malformed clauses warn, not crash —
+        a typo in an env var must never take down a production job."""
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            try:
+                parsed = _parse_clause(clause)
+            except ValueError:
+                parsed = None
+            if parsed is None:
+                warnings.warn(
+                    f"{SPEC_ENV}: ignoring malformed clause {clause!r} "
+                    f"(grammar: site=count[@start][:kind], kind in {_KINDS})")
+                continue
+            name, rule = parsed
+            with self._lock:
+                self._rules[name] = rule
+
+    def configure(self, site: str, times: int = 1, start: int = 1,
+                  kind: str = "error"):
+        """Programmatic arming (tests): fault `times` occurrences of `site`
+        starting at the `start`-th call."""
+        if kind not in _KINDS:
+            raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
+        with self._lock:
+            self._rules[site] = _Rule(count=times, start=start, kind=kind)
+
+    def reset(self):
+        """Disarm every site and zero occurrence counters."""
+        with self._lock:
+            self._rules.clear()
+            self._seen.clear()
+
+    def fired(self, site: str) -> int:
+        """How many faults have been injected at `site` in this process."""
+        with self._lock:
+            rule = self._rules.get(site)
+            return rule.fired if rule else 0
+
+    def site(self, name: str):
+        """Declare one occurrence of a fault site; injects if armed."""
+        with self._lock:
+            if not self._rules:
+                return
+            rule = self._rules.get(name)
+            if rule is None:
+                return
+            n = self._seen.get(name, 0) + 1
+            self._seen[name] = n
+            if not (rule.start <= n < rule.start + rule.count):
+                return
+            rule.fired += 1
+            kind = rule.kind
+        if _metrics_mod.enabled():
+            _M_INJECTED.inc(site=name, kind=kind)
+        if kind == "kill":
+            # simulate a preemption / OOM-kill of this process: no cleanup,
+            # no exception propagation — the parent sees a corpse
+            os._exit(17)
+        if kind == "timeout":
+            raise InjectedTimeout(f"injected timeout at fault site {name!r}")
+        if kind == "oserror":
+            raise InjectedIOError(f"injected I/O error at fault site {name!r}")
+        raise InjectedFault(f"injected fault at site {name!r}")
+
+
+_default = FaultInjector()
+
+
+def default_injector() -> FaultInjector:
+    return _default
+
+
+def site(name: str):
+    """Module-level shorthand: `fault.site("store.get")`."""
+    _default.site(name)
+
+
+def configure(site_name: str, times: int = 1, start: int = 1,
+              kind: str = "error"):
+    _default.configure(site_name, times=times, start=start, kind=kind)
+
+
+def reset():
+    _default.reset()
+
+
+def reload_spec():
+    """Re-read PADDLE_TPU_FAULT_SPEC (after reset) — lets tests arm faults
+    by mutating os.environ mid-process."""
+    _default.reset()
+    spec = os.environ.get(SPEC_ENV, "")
+    if spec:
+        _default.load_spec(spec)

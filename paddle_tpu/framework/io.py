@@ -17,6 +17,32 @@ import numpy as np
 from .tensor import Tensor
 from .param import Parameter
 
+# process umask, captured once while single-threaded: mkstemp creates 0600
+# files, but a published checkpoint must keep the umask-default mode the
+# plain open() used to give (group-readable checkpoints feed eval jobs)
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
+def _atomic_write(path: str, payload: bytes):
+    """The one atomic-publish protocol for checkpoint-like files (also used
+    by distributed/checkpoint.py): unique tmp in the target dir, umask-
+    default mode, `os.replace` — a crash mid-write never leaves a torn
+    file at the published path, concurrent writers never share a tmp."""
+    import tempfile
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.chmod(tmp, 0o666 & ~_UMASK)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
 
 def _to_saveable(obj):
     if isinstance(obj, Tensor):
@@ -79,15 +105,11 @@ def save(obj, path, protocol=4, cipher_key: bytes = None, **configs):
     """`cipher_key` (16/24/32 bytes) encrypts the checkpoint with AES-CTR
     (reference `framework/io/crypto/` model encryption for industrial PS
     deployments); a random IV is stored in the header."""
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
     payload = pickle.dumps(_to_saveable(obj), protocol=protocol)
     if cipher_key is not None:
         iv = os.urandom(16)
         payload = _ENC_MAGIC + iv + _aes_ctr(cipher_key, iv, payload)
-    with open(path, "wb") as f:
-        f.write(payload)
+    _atomic_write(path, payload)
 
 
 def _is_reference_format(raw) -> bool:

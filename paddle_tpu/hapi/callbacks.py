@@ -118,6 +118,115 @@ def _fmt(v):
         return str(v)
 
 
+class FaultTolerantCheckpoint(Callback):
+    """Resumable checkpointing for `Model.fit`: snapshots model + optimizer
+    (incl. compiled TrainStep slots and step counter) + RNG + epoch/step
+    cursor through a `CheckpointManager` (CRC'd atomic files, keep-last-N
+    GC, corrupt-file fallback on load), every `save_freq_steps` train steps
+    and/or at each epoch end. With `preemption_save=True`, SIGTERM (the
+    TPU-pod preemption signal) triggers one final synchronous save before
+    exit.
+
+    Pair with `Model.fit(..., resume=<dirname>)`: a relaunched job restores
+    everything and skips the already-consumed steps of the interrupted
+    epoch, so kill -9 -> relaunch trains a bit-identical tail.
+
+    Preemption-save caveat: the step cursor is exact at batch boundaries.
+    A SIGTERM that lands INSIDE a train step may snapshot weights that
+    already include the in-flight update with a cursor one step behind —
+    that batch replays once on resume (at-least-once step semantics).
+    Boundary saves (save_freq_steps / epoch end / SIGKILL recovery from
+    the last periodic save) are exactly-once.
+    """
+
+    def __init__(self, dirname: str, save_freq_steps: Optional[int] = None,
+                 save_freq_epochs: int = 1, keep_last_n: int = 3,
+                 async_save: bool = False, preemption_save: bool = True):
+        super().__init__()
+        from ..distributed.checkpoint import CheckpointManager
+        self.manager = CheckpointManager(dirname, keep_last_n=keep_last_n,
+                                         async_save=async_save)
+        self.save_freq_steps = save_freq_steps
+        self.save_freq_epochs = max(1, save_freq_epochs)
+        self.preemption_save = preemption_save
+        self._epoch = 0
+        self._step = -1
+        self._global_step = 0
+        self._epoch_done = False
+        self._resume_epoch = -1
+        self._resume_skip = 0
+
+    # -- state capture -------------------------------------------------------
+    def _capture(self):
+        from ..framework.random import get_rng_state
+        m = self.model
+        m._sync_from_train_step()
+        # before the first resumed batch the compiled step is not rebuilt
+        # yet — its restored slot state still lives in _pending_ts_state
+        # and must survive a preemption save, not vanish
+        ts_state = m._train_step.state_dict() if m._train_step is not None \
+            else getattr(m, "_pending_ts_state", None)
+        state = {
+            "network": {k: v for k, v in m.network.state_dict().items()},
+            "optimizer": (m._optimizer.state_dict()
+                          if m._optimizer is not None else None),
+            "train_step": ts_state,
+            "rng": np.asarray(get_rng_state()),
+            "epoch": self._epoch,
+            "step_in_epoch": self._step + 1,
+            "global_step": self._global_step,
+            "epoch_done": self._epoch_done,
+        }
+        return state
+
+    def _save(self):
+        self.manager.save(self._capture(), step=self._global_step)
+
+    # -- hooks ---------------------------------------------------------------
+    def on_train_begin(self, logs=None):
+        resume = self.params.get("resume") or {}
+        self._global_step = int(resume.get("global_step", 0))
+        self._epoch = int(resume.get("epoch", 0))
+        # a preemption BEFORE the first resumed batch must reproduce the
+        # loaded cursor, not reset it to step 0 of the epoch
+        self._resume_epoch = self._epoch
+        self._resume_skip = int(resume.get("skip_steps", 0))
+        self._step = self._resume_skip - 1
+        if self.preemption_save:
+            self.manager.install_preemption_handler(
+                self._capture, step_fn=lambda: self._global_step)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._step = self._resume_skip - 1 \
+            if epoch == self._resume_epoch else -1
+        self._epoch_done = False
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step = step
+        self._global_step += 1
+        if self.save_freq_steps and \
+                self._global_step % self.save_freq_steps == 0:
+            self._save()
+
+    def on_epoch_end(self, epoch, logs=None):
+        # a mid-epoch stop (num_iters) reaches here too: only mark the
+        # epoch consumed when every step of a known-length epoch ran
+        steps = self.params.get("steps")
+        stopped = getattr(self.model, "stop_training", False)
+        self._epoch_done = not stopped or (steps is not None
+                                           and self._step + 1 >= steps)
+        # honor save_freq_epochs, but never skip the save that preserves a
+        # mid-epoch stop's cursor or the final epoch's state
+        final = (epoch + 1) >= self.params.get("epochs", epoch + 1)
+        if (epoch + 1) % self.save_freq_epochs == 0 or stopped or final:
+            self._save()
+
+    def on_train_end(self, logs=None):
+        if self.preemption_save:
+            self.manager.uninstall_preemption_handler()
+
+
 class ModelCheckpoint(Callback):
     def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
         super().__init__()

@@ -105,12 +105,36 @@ class Model:
             epochs=1, eval_freq=1, log_freq=10, save_dir=None,
             save_freq=1, verbose=2, drop_last=False, shuffle=True,
             num_workers=0, callbacks=None, accumulate_grad_batches=1,
-            num_iters=None):
+            num_iters=None, resume=None):
+        """`resume`: a checkpoint directory (or CheckpointManager) written
+        by a `FaultTolerantCheckpoint` callback. Restores model weights,
+        optimizer slots (incl. the compiled TrainStep state), LR scheduler,
+        RNG, and the epoch/step cursor from the newest VALID checkpoint,
+        then skips the already-consumed steps of the interrupted epoch —
+        so a preempted/killed job continues training bit-identically. With
+        no checkpoint found (fresh job), training starts from scratch."""
         train_loader = _as_loader(train_data, batch_size, shuffle, drop_last,
                                   num_workers)
         eval_loader = _as_loader(eval_data, batch_size, False, False,
                                  num_workers) if eval_data is not None \
             else None
+
+        from ..io import DataLoader as _DataLoader
+        resume_info = self._restore_for_resume(resume) if resume else None
+        if resume_info and resume_info["skip_steps"] and shuffle and \
+                not isinstance(train_data, _DataLoader):
+            # step-skipping replays the interrupted epoch's batch order; the
+            # default sampler reshuffles from global numpy state each epoch,
+            # so the skipped prefix would be a DIFFERENT permutation —
+            # samples double-trained/missed. Epoch boundaries stay exact.
+            import warnings
+            warnings.warn(
+                "fit(resume=...) is skipping mid-epoch steps with "
+                "shuffle=True: the resumed epoch's shuffle order is not "
+                "reproducible, so the skipped prefix may not match what "
+                "was trained before the interruption. Use shuffle=False "
+                "(or a deterministic batch_sampler) for exact step-level "
+                "resume; epoch-level state is exact either way.")
 
         cbks = CallbackList(_to_list(callbacks))
         if verbose and not any(isinstance(c, ProgBarLogger)
@@ -123,6 +147,7 @@ class Model:
         steps = _try_len(train_loader)
         cbks.set_params({"epochs": epochs, "steps": steps,
                          "verbose": verbose, "save_dir": save_dir,
+                         "resume": resume_info or {},
                          "metrics": ["loss"] + [
                              m.name() for m in self._metrics]})
 
@@ -132,14 +157,21 @@ class Model:
                 "gradient_merge with the hybrid engine instead")
         self.stop_training = False
         cbks.on_train_begin()
-        it = 0
+        start_epoch, skip_steps, it = 0, 0, 0
+        if resume_info:
+            start_epoch = resume_info["epoch"]
+            skip_steps = resume_info["skip_steps"]
+            it = resume_info["global_step"]
         logs = {}
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             if self.stop_training:
                 break
             cbks.on_epoch_begin(epoch)
             logs = {}
             for step, batch in enumerate(train_loader):
+                if epoch == start_epoch and step < skip_steps:
+                    continue  # consumed before the interruption — the
+                    # checkpoint's optimizer/RNG state already reflects it
                 inputs, labels = _split_batch(batch)
                 cbks.on_train_batch_begin(step)
                 loss = self.train_batch(inputs, labels)
@@ -249,6 +281,34 @@ class Model:
     def _sync_from_train_step(self):
         if self._train_step is not None:
             self._train_step.sync_to_layer()
+
+    def _restore_for_resume(self, resume):
+        """Restore from the newest valid FaultTolerantCheckpoint snapshot.
+        Returns {"epoch", "skip_steps", "global_step"} or None (no valid
+        checkpoint — fresh start)."""
+        from ..distributed.checkpoint import CheckpointManager
+        mgr = resume if isinstance(resume, CheckpointManager) \
+            else CheckpointManager(str(resume))
+        found = mgr.load_latest()
+        if found is None:
+            return None
+        blob, _ = found
+        self.network.set_state_dict(blob["network"])
+        if blob.get("optimizer") is not None and self._optimizer is not None:
+            self._optimizer.set_state_dict(blob["optimizer"])
+        if blob.get("train_step") is not None:
+            # applied when the compiled step is (re)built on first batch
+            self._pending_ts_state = blob["train_step"]
+            self._train_step = None
+        if blob.get("rng") is not None:
+            from ..framework.random import set_rng_state
+            set_rng_state(np.asarray(blob["rng"]))
+        epoch = int(blob.get("epoch", 0))
+        skip = int(blob.get("step_in_epoch", 0))
+        if blob.get("epoch_done"):
+            epoch, skip = epoch + 1, 0
+        return {"epoch": epoch, "skip_steps": skip,
+                "global_step": int(blob.get("global_step", 0))}
 
 
 def _as_tensor(x):

@@ -65,11 +65,15 @@ class TrainEpochRange:
         dist_ckpt.save({"epoch": epoch, "objects": self._state()}, path)
 
     def restore(self) -> int:
-        """Load the newest snapshot; returns the last FINISHED epoch or -1."""
-        path = dist_ckpt.latest(self.job_dir)
-        if path is None:
+        """Load the newest VALID snapshot; returns the last FINISHED epoch
+        or -1. A truncated/corrupt newest snapshot (host died mid-publish,
+        disk bit-rot) falls back to the previous one instead of crashing;
+        the read-once path verifies and decodes each candidate in a single
+        pass."""
+        found = dist_ckpt.load_latest_valid(self.job_dir)
+        if found is None:
             return -1
-        blob = dist_ckpt.load(path)
+        blob = found[0]
         objects = blob.get("objects", {})
         for k, v in self._attached.items():
             if k in objects and hasattr(v, "set_state_dict"):

@@ -148,7 +148,7 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn: Optional[Callable] = None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, worker_max_restarts=2):
         self.dataset = dataset
         self.batch_size = batch_size
         self.drop_last = drop_last
@@ -159,6 +159,11 @@ class DataLoader:
         self.use_shared_memory = use_shared_memory
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        # dead worker processes (map-style) are respawned and their lost
+        # batches re-dispatched, up to this many times per epoch; iterable
+        # workers instead degrade to fewer workers (stream position is
+        # unrecoverable). 0 restores the old fail-fast behavior.
+        self.worker_max_restarts = worker_max_restarts
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
         elif batch_sampler is not None:

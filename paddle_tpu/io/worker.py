@@ -20,11 +20,23 @@ import os
 import queue as pyqueue
 import threading
 import time
+import warnings
 from dataclasses import dataclass
 from multiprocessing import shared_memory
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..profiler import metrics as _metrics_mod
+
+_REG = _metrics_mod.default_registry()
+_M_WORKER_RESTARTS = _REG.counter(
+    "dataloader_worker_restarts_total",
+    "dead DataLoader worker processes respawned mid-epoch")
+_M_WORKER_LOST = _REG.counter(
+    "dataloader_worker_lost_total",
+    "iterable-mode workers that died and could not be respawned "
+    "(their shard is lost; the loader degraded to fewer workers)")
 
 _SENTINEL = "__end__"
 
@@ -107,14 +119,27 @@ def _tensor_to_numpy(obj):
     return obj
 
 
+def _worker_fault_site(worker_id: int):
+    """Per-batch fault site: `dataloader.worker<N>` (and the generic
+    `dataloader.worker`). A `:kill` spec clause makes this worker vanish
+    mid-epoch like an OOM-kill — the consumer must detect the corpse and
+    respawn. Spawned workers inherit PADDLE_TPU_FAULT_SPEC via os.environ."""
+    from ..fault import site
+    site("dataloader.worker")
+    site(f"dataloader.worker{worker_id}")
+
+
 def _worker_loop(dataset, collate_fn, index_queue, result_queue,
                  worker_id: int, init_fn, use_shared_memory: bool,
                  iterable_mode: bool, batch_size: int, drop_last: bool,
-                 num_workers: int):
+                 num_workers: int, suppress_faults: bool = False):
     """Worker process entry (reference dataloader/worker.py _worker_loop)."""
     from .._platform import pin_platform
     pin_platform("cpu")  # never grab the TPU from a worker (config.update
     # sticks where the env var is ignored by accelerator plugins)
+    if suppress_faults:  # a RESPAWNED worker must not re-die on the same
+        from ..fault import default_injector  # armed kill clause forever
+        default_injector().reset()
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     try:
@@ -135,6 +160,7 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue,
                     continue
                 buf.append(sample)
                 if len(buf) == batch_size:
+                    _worker_fault_site(worker_id)
                     _emit(collate_fn(buf), result_queue, use_shared_memory,
                           batch_idx=-1)
                     buf = []
@@ -149,6 +175,7 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue,
                 result_queue.put((_SENTINEL, worker_id))
                 return
             batch_idx, indices = item
+            _worker_fault_site(worker_id)
             batch = collate_fn([dataset[i] for i in indices])
             _emit(batch, result_queue, use_shared_memory, batch_idx)
     except KeyboardInterrupt:
@@ -193,39 +220,47 @@ class MultiprocessIter:
         # balances without per-worker bookkeeping. Map-style dispatch is
         # additionally FLOW-CONTROLLED to the same window.
         self._index_q = ctx.Queue()
-        self._eof_sent = 0
         if not self._iterable:
             self._batches = list(iter(loader.batch_sampler))
             self._cursor = 0
             for _ in range(window):
                 self._dispatch_one()
+        self._ctx = ctx
         self._workers = []
         for wid in range(self._nw):
-            w = ctx.Process(
-                target=_worker_loop,
-                args=(loader.dataset, loader.collate_fn,
-                      self._index_q, self._result_q, wid,
-                      loader.worker_init_fn, loader.use_shared_memory,
-                      self._iterable, loader.batch_size, loader.drop_last,
-                      self._nw),
-                daemon=True)
-            w.start()
-            self._workers.append(w)
+            self._workers.append(self._spawn_worker(wid))
 
         self._reorder: Dict[int, Any] = {}
         self._next_idx = 0
         self._finished_workers = 0
         self._sentinel_wids = set()  # workers that finished cleanly
+        self._lost_wids = set()      # iterable-mode corpses (shard lost)
+        self._restarts = 0
+        self._max_restarts = getattr(loader, "worker_max_restarts", 2)
         self._shutdown_done = False
 
+    def _spawn_worker(self, wid: int, suppress_faults: bool = False):
+        w = self._ctx.Process(
+            target=_worker_loop,
+            args=(self.loader.dataset, self.loader.collate_fn,
+                  self._index_q, self._result_q, wid,
+                  self.loader.worker_init_fn, self.loader.use_shared_memory,
+                  self._iterable, self.loader.batch_size,
+                  self.loader.drop_last, self._nw, suppress_faults),
+            daemon=True)
+        w.start()
+        return w
+
     def _dispatch_one(self):
+        # NO mid-epoch EOF tokens: workers idle on the index queue once the
+        # epoch is dispatched and exit on the None sent by _shutdown(). A
+        # None circulating mid-epoch would race crash recovery — a dead
+        # worker's consumed token is unobservable, and its respawn could
+        # pop a stale None ahead of the re-dispatched batches and exit.
         if self._cursor < len(self._batches):
             self._index_q.put((self._cursor,
                                list(self._batches[self._cursor])))
             self._cursor += 1
-        elif self._eof_sent < self._nw:
-            self._index_q.put(None)
-            self._eof_sent += 1
 
     def __iter__(self):
         return self
@@ -244,6 +279,8 @@ class MultiprocessIter:
         if self._iterable:
             while self._finished_workers < self._nw:
                 kind, payload = self._get(timeout)
+                if kind == "__recovered__":
+                    continue  # re-check the finished-workers condition
                 if kind == _SENTINEL:
                     self._finished_workers += 1
                     self._sentinel_wids.add(payload)
@@ -264,6 +301,8 @@ class MultiprocessIter:
                 self._shutdown()
                 raise StopIteration
             kind, payload = self._get(timeout)
+            if kind == "__recovered__":
+                continue  # recovery re-dispatched; poll again
             if kind == "__error__":
                 self._shutdown()
                 raise RuntimeError(payload)
@@ -271,13 +310,21 @@ class MultiprocessIter:
                 self._finished_workers += 1
                 self._sentinel_wids.add(payload)
                 continue
+            if kind < self._next_idx or kind in self._reorder:
+                # duplicate from crash-recovery re-dispatch (both a live
+                # worker and a respawn processed it): drop, free its shm
+                self._release(payload)
+                continue
             self._reorder[kind] = payload  # kind is a batch index
             self._dispatch_one()           # keep the in-flight window full
 
     def _get(self, timeout):
         """Poll with liveness checks: a worker killed by the kernel (OOM,
         segfault) posts nothing, and an infinite blocking get would hang the
-        trainer forever."""
+        trainer forever. Dead workers are detected and RESPAWNED (map-style:
+        their lost batches are re-dispatched) up to `worker_max_restarts`
+        times; iterable-mode corpses degrade to fewer workers with a
+        warning, since a restarted stream would replay its whole shard."""
         import time as _time
         deadline = None if not timeout else _time.monotonic() + timeout
         while True:
@@ -285,27 +332,72 @@ class MultiprocessIter:
                 return self._result_q.get(timeout=1.0)
             except pyqueue.Empty:
                 pass
-            # ANY dead worker that never posted its end-of-stream sentinel is
-            # fatal: its dispatched batches can never arrive, so waiting for
-            # the rest would hang on a hole in the batch sequence. This
+            # A dead worker that never posted its end-of-stream sentinel
+            # left a hole: its dispatched batches can never arrive. This
             # covers nonzero exits (OOM-kill, segfault) AND sys.exit(0)
-            # inside user dataset code.
-            crashed = [w for wid, w in enumerate(self._workers)
+            # inside user dataset code. Only act once the queue is drained —
+            # its already-posted results are still in flight.
+            crashed = [wid for wid, w in enumerate(self._workers)
                        if w.exitcode is not None
-                       and wid not in self._sentinel_wids]
+                       and wid not in self._sentinel_wids
+                       and wid not in self._lost_wids]
             if crashed and self._result_q.empty():
-                codes = [w.exitcode for w in self._workers]
-                self._shutdown()
-                raise RuntimeError(
-                    f"DataLoader worker(s) died without finishing "
-                    f"(exitcodes {codes}) — possibly OOM-killed or dataset "
-                    "code called exit(); reduce batch size or num_workers")
+                self._recover_workers(crashed)
+                # hand control back so _next_impl re-checks its end
+                # conditions (e.g. every remaining worker is now finished)
+                return ("__recovered__", None)
             if deadline is not None and _time.monotonic() >= deadline:
                 self._shutdown()
                 raise RuntimeError(
                     f"DataLoader timed out after {timeout}s waiting for "
                     f"workers (alive: "
                     f"{[w.is_alive() for w in self._workers]})")
+
+    def _recover_workers(self, crashed):
+        """Respawn dead workers or degrade; raises when out of budget."""
+        codes = {wid: self._workers[wid].exitcode for wid in crashed}
+        if self._restarts + len(crashed) > self._max_restarts:
+            # budget exhausted (worker_max_restarts=0 = the old fail-fast)
+            self._shutdown()
+            raise RuntimeError(
+                f"DataLoader worker(s) died without finishing (exitcodes "
+                f"{codes}) and the restart budget "
+                f"(worker_max_restarts={self._max_restarts}) is exhausted — "
+                "possibly OOM-killed or dataset code called exit(); reduce "
+                "batch size or num_workers")
+        if self._iterable:
+            # an iterable worker's stream position died with it: respawning
+            # would replay its whole shard, so degrade to fewer workers and
+            # let the epoch finish short (documented, warned, counted —
+            # each lost shard consumes one unit of the restart budget)
+            for wid in crashed:
+                self._restarts += 1
+                self._lost_wids.add(wid)
+                self._finished_workers += 1
+                warnings.warn(
+                    f"DataLoader worker {wid} died (exitcode "
+                    f"{codes[wid]}); its remaining shard is lost — "
+                    f"continuing with {self._nw - len(self._lost_wids)} "
+                    "worker(s)")
+                if _metrics_mod.enabled():
+                    _M_WORKER_LOST.inc(exitcode=codes[wid])
+            return
+        for wid in crashed:
+            self._restarts += 1
+            warnings.warn(
+                f"DataLoader worker {wid} died (exitcode {codes[wid]}); "
+                f"respawning (restart {self._restarts}/{self._max_restarts})")
+            # fault injection stays disarmed in the replacement: a :kill
+            # spec clause would otherwise re-kill every respawn forever
+            self._workers[wid] = self._spawn_worker(wid, suppress_faults=True)
+            if _metrics_mod.enabled():
+                _M_WORKER_RESTARTS.inc(exitcode=codes[wid])
+        # re-dispatch every dispatched-but-unreceived batch: the corpse's
+        # in-flight work is somewhere in that set. Live workers may still
+        # deliver some of them — duplicates are dropped on receive.
+        for idx in range(self._next_idx, self._cursor):
+            if idx not in self._reorder:
+                self._index_q.put((idx, list(self._batches[idx])))
 
     def _finalize(self, payload):
         data = _from_shm(payload) if self.loader.use_shared_memory else payload

@@ -1,0 +1,486 @@
+"""Fault-tolerance runtime tests: retry policy, fault injector, checkpoint
+corruption recovery, CheckpointManager GC/preemption, store retry, PS
+structured errors, and the elastic membership-slot release regression.
+
+Reference inspiration: the reference proves recovery via
+`test_auto_checkpoint.py` (resume correctness) and the fleet elastic
+manager tests; corruption/chaos coverage is TPU-side new (preemptible pods
+make failure the common case, not the exception).
+"""
+import os
+import signal
+import struct
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fault
+from paddle_tpu.distributed import checkpoint as dist_ckpt
+from paddle_tpu.distributed.checkpoint import (CheckpointCorruptError,
+                                               CheckpointManager)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.profiler import metrics as metrics_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault.reset()
+    yield
+    fault.reset()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("transient")
+            return "ok"
+
+        pol = fault.RetryPolicy(max_attempts=4, base_delay=0.001)
+        assert pol.call(flaky, op="t.flaky") == "ok"
+        assert len(calls) == 3
+
+    def test_exhaustion_raises_structured_error(self):
+        pol = fault.RetryPolicy(max_attempts=2, base_delay=0.001)
+
+        def always():
+            raise ValueError("nope")
+
+        with pytest.raises(fault.RetryExhaustedError) as ei:
+            pol.call(always, op="t.always")
+        assert ei.value.op == "t.always"
+        assert ei.value.attempts == 2
+        assert isinstance(ei.value.last, ValueError)
+        assert "nope" in str(ei.value)
+
+    def test_backoff_schedule_deterministic_and_bounded(self):
+        a = fault.RetryPolicy(max_attempts=8, base_delay=0.1, max_delay=0.5,
+                              jitter=0.25, seed=7)
+        b = fault.RetryPolicy(max_attempts=8, base_delay=0.1, max_delay=0.5,
+                              jitter=0.25, seed=7)
+        da = [a.delay(i) for i in range(6)]
+        db = [b.delay(i) for i in range(6)]
+        assert da == db  # same seed -> identical schedule
+        for i, d in enumerate(da):
+            base = min(0.5, 0.1 * 2 ** i)
+            assert base <= d <= base * 1.25
+
+    def test_non_retryable_exception_propagates(self):
+        pol = fault.RetryPolicy(max_attempts=3, base_delay=0.001,
+                                retry_on=(OSError,))
+        with pytest.raises(KeyError):
+            pol.call(lambda: (_ for _ in ()).throw(KeyError("x")), op="t.kerr")
+
+    def test_attempt_timeout_retries_slow_attempts(self):
+        calls = []
+
+        def slow_then_fast():
+            calls.append(1)
+            if len(calls) == 1:
+                time.sleep(0.5)
+            return len(calls)
+
+        pol = fault.RetryPolicy(max_attempts=3, base_delay=0.001,
+                                attempt_timeout=0.1)
+        assert pol.call(slow_then_fast, op="t.slow") == 2
+
+    def test_decorator_form(self):
+        calls = []
+
+        @fault.retryable("t.deco", fault.RetryPolicy(max_attempts=3,
+                                                     base_delay=0.001))
+        def fn():
+            calls.append(1)
+            if len(calls) < 2:
+                raise RuntimeError("once")
+            return 5
+
+        assert fn() == 5
+
+    def test_metrics_recorded(self):
+        reg = metrics_mod.default_registry()
+        before = reg.get("retry_attempts_total").value(op="t.metrics")
+        pol = fault.RetryPolicy(max_attempts=3, base_delay=0.001)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise RuntimeError("x")
+
+        pol.call(flaky, op="t.metrics")
+        assert reg.get("retry_attempts_total").value(op="t.metrics") == \
+            before + 1
+        assert reg.get("retry_recovered_total").value(op="t.metrics") >= 1
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_XYZ_RETRIES", "7")
+        monkeypatch.setenv("PADDLE_TPU_XYZ_BACKOFF", "0.25")
+        pol = fault.RetryPolicy.from_env("xyz", max_attempts=2)
+        assert pol.max_attempts == 7
+        assert pol.base_delay == 0.25
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+class TestFaultInjector:
+    def test_unarmed_site_is_noop(self):
+        fault.site("nothing.armed")  # no raise
+
+    def test_count_and_start_window(self):
+        inj = fault.FaultInjector(spec="")
+        inj.configure("s.op", times=2, start=3)
+        fired = 0
+        for _ in range(6):
+            try:
+                inj.site("s.op")
+            except fault.InjectedFault:
+                fired += 1
+        assert fired == 2
+        assert inj.fired("s.op") == 2
+
+    def test_spec_parsing_kinds(self):
+        inj = fault.FaultInjector(
+            spec="a.b=1; c.d=2@3:timeout ; e.f=1:oserror")
+        with pytest.raises(fault.InjectedFault):
+            inj.site("a.b")
+        inj.site("a.b")  # only the first occurrence faults
+        inj.site("c.d")
+        inj.site("c.d")
+        with pytest.raises(fault.InjectedTimeout):
+            inj.site("c.d")  # 3rd
+        with pytest.raises(fault.InjectedTimeout):
+            inj.site("c.d")  # 4th
+        inj.site("c.d")  # 5th clean
+        with pytest.raises(fault.InjectedIOError):
+            inj.site("e.f")
+
+    def test_malformed_clause_warns_not_crashes(self):
+        with pytest.warns(UserWarning, match="malformed clause"):
+            inj = fault.FaultInjector(spec="good.site=1;bad_clause;also=bad!x")
+        with pytest.raises(fault.InjectedFault):
+            inj.site("good.site")
+
+    def test_env_reload(self, monkeypatch):
+        monkeypatch.setenv(fault.SPEC_ENV, "env.site=1")
+        fault.reload_spec()
+        with pytest.raises(fault.InjectedFault):
+            fault.site("env.site")
+        fault.site("env.site")  # exhausted
+        monkeypatch.delenv(fault.SPEC_ENV)
+        fault.reload_spec()
+        fault.site("env.site")  # disarmed
+
+    def test_injection_metric(self):
+        reg = metrics_mod.default_registry()
+        before = reg.get("fault_injected_total").value(site="m.site",
+                                                       kind="error")
+        fault.configure("m.site", times=1)
+        with pytest.raises(fault.InjectedFault):
+            fault.site("m.site")
+        assert reg.get("fault_injected_total").value(
+            site="m.site", kind="error") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint corruption recovery
+# ---------------------------------------------------------------------------
+class TestCheckpointCorruption:
+    def _save(self, tmp_path, step, value):
+        p = str(tmp_path / f"ckpt_{step}")
+        dist_ckpt.save({"w": np.full(4, value, np.float32), "step": step}, p)
+        return p
+
+    def test_truncated_raises_clear_error(self, tmp_path):
+        p = self._save(tmp_path, 1, 1.0)
+        raw = open(p, "rb").read()
+        open(p, "wb").write(raw[:len(raw) // 2])
+        with pytest.raises(CheckpointCorruptError, match="truncated"):
+            dist_ckpt.load(p)
+
+    def test_bitflip_raises_crc_error(self, tmp_path):
+        p = self._save(tmp_path, 1, 1.0)
+        raw = bytearray(open(p, "rb").read())
+        raw[-3] ^= 0xFF  # flip a payload byte
+        open(p, "wb").write(bytes(raw))
+        ok, reason = dist_ckpt.verify(p)
+        assert not ok and "CRC32" in reason
+        with pytest.raises(CheckpointCorruptError, match="CRC32"):
+            dist_ckpt.load(p)
+
+    def test_zero_length_file(self, tmp_path):
+        p = str(tmp_path / "ckpt_1")
+        open(p, "wb").close()
+        ok, reason = dist_ckpt.verify(p)
+        assert not ok
+        with pytest.raises(CheckpointCorruptError):
+            dist_ckpt.load(p)
+
+    def test_latest_valid_skips_corrupt(self, tmp_path):
+        self._save(tmp_path, 1, 1.0)
+        p2 = self._save(tmp_path, 2, 2.0)
+        p3 = self._save(tmp_path, 3, 3.0)
+        open(p3, "wb").write(open(p3, "rb").read()[:-4])  # torn newest
+        open(p2, "wb").close()                            # zeroed middle
+        with pytest.warns(UserWarning, match="corrupt"):
+            best = dist_ckpt.latest_valid(str(tmp_path))
+        assert best.endswith("ckpt_1")
+        assert float(np.asarray(dist_ckpt.load(best)["w"])[0]) == 1.0
+
+    def test_latest_valid_counts_skips_in_metrics(self, tmp_path):
+        reg = metrics_mod.default_registry()
+        before = reg.get("checkpoint_corrupt_skipped_total").total()
+        p = self._save(tmp_path, 5, 5.0)
+        open(p, "wb").write(b"PTCKPT01garbage")
+        with pytest.warns(UserWarning):
+            assert dist_ckpt.latest_valid(str(tmp_path)) is None
+        assert reg.get("checkpoint_corrupt_skipped_total").total() > before
+
+    def test_legacy_plain_pickle_still_loads(self, tmp_path):
+        import pickle
+        p = str(tmp_path / "ckpt_9")
+        with open(p, "wb") as f:
+            pickle.dump({"state": {"x": np.ones(2, np.float32)}, "specs": {},
+                         "version": 1}, f)
+        assert dist_ckpt.verify(p)[0]
+        out = dist_ckpt.load(p)
+        np.testing.assert_array_equal(np.asarray(out["x"]), np.ones(2))
+
+    def test_tmp_orphans_ignored_by_latest_and_gcd(self, tmp_path):
+        self._save(tmp_path, 1, 1.0)
+        orphan = tmp_path / "ckpt_7.tmp.abc123"
+        orphan.write_bytes(b"partial write from a crashed host")
+        assert dist_ckpt.latest(str(tmp_path)).endswith("ckpt_1")
+        assert dist_ckpt.latest_valid(str(tmp_path)).endswith("ckpt_1")
+        removed = dist_ckpt.cleanup_tmp(str(tmp_path))
+        assert removed == 1 and not orphan.exists()
+
+
+class TestCheckpointManager:
+    def test_keep_last_n_gc(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=3)
+        for s in range(7):
+            mgr.save({"s": s}, step=s)
+        assert mgr.steps() == [6, 5, 4]
+        state, step = mgr.load_latest()
+        assert step == 6 and state["s"] == 6
+
+    def test_init_cleans_orphaned_tmp(self, tmp_path):
+        (tmp_path / "ckpt_3.tmp.xyz").write_bytes(b"torn")
+        CheckpointManager(str(tmp_path))
+        assert not (tmp_path / "ckpt_3.tmp.xyz").exists()
+
+    def test_load_latest_falls_back_over_corruption(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+        mgr.save({"s": 1}, step=1)
+        mgr.save({"s": 2}, step=2)
+        p2 = mgr.path_for(2)
+        open(p2, "wb").write(open(p2, "rb").read()[:-1])
+        with pytest.warns(UserWarning, match="corrupt"):
+            state, step = mgr.load_latest()
+        assert step == 1 and state["s"] == 1
+
+    def test_empty_dir_returns_none(self, tmp_path):
+        assert CheckpointManager(str(tmp_path)).load_latest() is None
+
+    def test_async_manager_waits_before_load(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save({"s": 41}, step=41)
+        state, step = mgr.load_latest()
+        assert step == 41 and state["s"] == 41
+
+    def test_preemption_handler_saves_once_then_exits(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        captured = {"n": 0}
+
+        def state_fn():
+            captured["n"] += 1
+            return {"final": True, "n": captured["n"]}
+
+        assert mgr.install_preemption_handler(state_fn, step_fn=lambda: 99)
+        try:
+            with pytest.raises(SystemExit) as ei:
+                os.kill(os.getpid(), signal.SIGTERM)
+                # the handler runs at the next bytecode boundary
+                for _ in range(100):
+                    time.sleep(0.01)
+            assert ei.value.code == 143
+        finally:
+            mgr.uninstall_preemption_handler()
+        assert captured["n"] == 1
+        state, step = mgr.load_latest()
+        assert step == 99 and state["final"] is True
+
+    def test_reshard_fallback_warns_and_counts(self, tmp_path):
+        # a 3-wide dim cannot split over the 8-device axis: restore must
+        # fall back to replication LOUDLY (warning + counter), not silently
+        import jax
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:8]), axis_names=("dp",))
+        reg = metrics_mod.default_registry()
+        before = reg.get("checkpoint_reshard_fallback_total").total()
+        arr = np.arange(24, dtype=np.float32).reshape(8, 3)
+        with pytest.warns(UserWarning, match="could not apply saved sharding"):
+            out = dist_ckpt._apply_shardings({"x": arr},
+                                            {"/x": (None, "dp")}, mesh)
+        np.testing.assert_array_equal(np.asarray(out["x"]), arr)
+        assert reg.get("checkpoint_reshard_fallback_total").total() > before
+
+
+# ---------------------------------------------------------------------------
+# Store retry under injected faults
+# ---------------------------------------------------------------------------
+class TestStoreRetry:
+    def test_get_recovers_from_injected_fault(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True,
+                         retry=fault.RetryPolicy(max_attempts=3,
+                                                 base_delay=0.001))
+        try:
+            store.set("k", "v")
+            reg = metrics_mod.default_registry()
+            before = reg.get("retry_attempts_total").value(op="store.get")
+            fault.configure("store.get", times=1)
+            assert store.get("k") == b"v"  # first attempt faulted, retried
+            assert reg.get("retry_attempts_total").value(op="store.get") == \
+                before + 1
+        finally:
+            store.stop()
+
+    def test_exhaustion_surfaces_retry_error(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True,
+                         retry=fault.RetryPolicy(max_attempts=2,
+                                                 base_delay=0.001))
+        try:
+            store.set("k", "v")
+            fault.configure("store.get", times=10)
+            with pytest.raises(fault.RetryExhaustedError, match="store.get"):
+                store.get("k")
+        finally:
+            fault.reset()
+            store.stop()
+
+    def test_add_retries(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True,
+                         retry=fault.RetryPolicy(max_attempts=3,
+                                                 base_delay=0.001))
+        try:
+            fault.configure("store.add", times=1)
+            assert store.add("ctr", 2) == 2
+            assert store.add("ctr", 3) == 5
+        finally:
+            store.stop()
+
+
+# ---------------------------------------------------------------------------
+# PS client structured error
+# ---------------------------------------------------------------------------
+class TestPSClientErrors:
+    def test_exhausted_rpc_names_endpoint(self):
+        from paddle_tpu.distributed.ps.client import (PSClient, PSRequestError,
+                                                      TableConfig)
+        from paddle_tpu.distributed.ps.server import PSServer
+        srv = PSServer(port=0)
+        ep = f"127.0.0.1:{srv.port}"
+        cli = PSClient([ep], retry=fault.RetryPolicy(max_attempts=2,
+                                                     base_delay=0.001))
+        cli.create_table(TableConfig(table_id=1, kind="dense", dense_size=4))
+        cli.set_dense(1, np.zeros(4, np.float32))
+        fault.configure("ps.pull_dense", times=10)
+        with pytest.raises(PSRequestError) as ei:
+            cli.pull_dense(1)
+        assert ei.value.endpoint == ep
+        assert ei.value.table_id == 1
+        assert ei.value.op == "pull_dense"
+        assert ep in str(ei.value)
+        fault.reset()
+        np.testing.assert_array_equal(cli.pull_dense(1),
+                                      np.zeros(4, np.float32))
+        srv.stop()
+
+    def test_transient_rpc_fault_recovers(self):
+        from paddle_tpu.distributed.ps.client import PSClient, TableConfig
+        from paddle_tpu.distributed.ps.server import PSServer
+        srv = PSServer(port=0)
+        cli = PSClient([f"127.0.0.1:{srv.port}"],
+                       retry=fault.RetryPolicy(max_attempts=3,
+                                               base_delay=0.001))
+        cli.create_table(TableConfig(table_id=1, kind="dense", dense_size=4))
+        cli.set_dense(1, np.arange(4, dtype=np.float32))
+        fault.configure("ps.pull_dense", times=1)
+        np.testing.assert_array_equal(cli.pull_dense(1),
+                                      np.arange(4, dtype=np.float32))
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership slot release (regression)
+# ---------------------------------------------------------------------------
+class TestElasticSlotRelease:
+    def test_clean_exit_releases_and_reuses_slot(self):
+        import struct as _struct
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        try:
+            def member_count():
+                return _struct.unpack("<q", master.get("member_count"))[0]
+
+            # restart cycle: join/exit 3 times — the slot must be reused,
+            # not leaked (member_count grew without bound before the fix)
+            for i in range(3):
+                m = ElasticManager(host_id=f"gen{i}", ttl=1.0, np=1,
+                                   store=master)
+                m.join()
+                assert m.alive_members() == [f"gen{i}"]
+                m.exit()
+                assert m.alive_members() == []
+            assert member_count() == 1
+            # tombstoned slots never resurface as members
+            m = ElasticManager(host_id="final", ttl=1.0, np=1, store=master)
+            m.join()
+            assert m.alive_members() == ["final"]
+            assert member_count() == 1
+            m.exit()
+        finally:
+            master.stop()
+
+
+# ---------------------------------------------------------------------------
+# ckpt_inspect tool
+# ---------------------------------------------------------------------------
+class TestCkptInspect:
+    def test_reports_ok_and_corrupt(self, tmp_path, capsys):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import ckpt_inspect
+        good = str(tmp_path / "ckpt_1")
+        dist_ckpt.save({"w": np.ones((2, 3), np.float32), "epoch": 4}, good)
+        bad = str(tmp_path / "ckpt_2")
+        open(bad, "wb").write(open(good, "rb").read()[:-9])
+        rc = ckpt_inspect.main([good, bad])
+        out = capsys.readouterr().out
+        assert rc == 1  # corrupt file present
+        assert "status: OK" in out
+        assert "CORRUPT" in out and "truncated" in out
+        assert "/w" in out and "(2, 3)" in out
+        assert "/epoch = 4" in out
+
+    def test_inspect_file_fields(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import ckpt_inspect
+        p = str(tmp_path / "ckpt_5")
+        dist_ckpt.save({"a": np.zeros(3, np.float32)}, p)
+        info = ckpt_inspect.inspect_file(p)
+        assert info["status"] == "ok"
+        assert info["crc_stored"] == info["crc_computed"]
+        assert info["arrays"][0][0] == "/a"

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Inspect paddle_tpu distributed checkpoints: header, checksum, spec table.
+
+usage: python tools/ckpt_inspect.py CKPT [CKPT...]
+       python tools/ckpt_inspect.py --dir CKPT_DIR   # every ckpt_* file
+
+Prints, per file: magic/format version, payload size, stored vs computed
+CRC32 and the verification verdict (OK / CORRUPT with reason / LEGACY for
+pre-header plain-pickle files), then — when the payload is loadable — a
+table of the saved arrays (tree path, shape, dtype) with their recorded
+PartitionSpecs, plus the non-array scalars (epoch/step cursors etc.).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import sys
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _walk(obj, prefix, rows, scalars):
+    import numpy as np
+    if isinstance(obj, np.ndarray):
+        rows.append((prefix or "<root>", tuple(obj.shape), str(obj.dtype),
+                     obj.nbytes))
+        return
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _walk(v, f"{prefix}/{k}", rows, scalars)
+        return
+    if isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _walk(v, f"{prefix}/{i}", rows, scalars)
+        return
+    scalars.append((prefix or "<root>", repr(obj)))
+
+
+def inspect_file(path: str) -> dict:
+    """Header/CRC/spec report for one checkpoint file (importable for
+    tests). Keys: path, status ('ok'|'corrupt'|'legacy'), reason, version,
+    payload_bytes, crc_stored, crc_computed, arrays, scalars, specs."""
+    from paddle_tpu.distributed import checkpoint as ck
+
+    info = {"path": path, "status": "ok", "reason": None, "version": None,
+            "payload_bytes": None, "crc_stored": None, "crc_computed": None,
+            "arrays": [], "scalars": [], "specs": {}}
+    with open(path, "rb") as f:
+        data = f.read()
+    hdr = struct.Struct("<8sIQ")
+    if data.startswith(b"PTCKPT01"):
+        if len(data) >= hdr.size:
+            _, crc, length = hdr.unpack_from(data)
+            payload = data[hdr.size:]
+            info["crc_stored"] = crc
+            info["crc_computed"] = zlib.crc32(payload) & 0xFFFFFFFF
+            info["payload_bytes"] = len(payload)
+    else:
+        info["status"] = "legacy"
+        info["payload_bytes"] = len(data)
+    ok, reason = ck.verify(path)
+    if not ok:
+        info["status"] = "corrupt"
+        info["reason"] = reason
+        return info
+    import pickle
+    payload = data if info["status"] == "legacy" else data[hdr.size:]
+    blob = pickle.loads(payload)
+    info["version"] = blob.get("version")
+    info["specs"] = blob.get("specs", {})
+    _walk(blob.get("state"), "", info["arrays"], info["scalars"])
+    return info
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+
+
+def print_report(info: dict):
+    print(f"== {info['path']}")
+    if info["status"] == "legacy":
+        print("   format: LEGACY (pre-header plain pickle, no checksum)")
+    else:
+        crc_s, crc_c = info["crc_stored"], info["crc_computed"]
+        match = "match" if crc_s == crc_c else "MISMATCH"
+        print(f"   format: PTCKPT01 v{info['version']}  "
+              f"payload {_fmt_bytes(info['payload_bytes'] or 0)}")
+        if crc_s is not None:
+            print(f"   crc32: stored {crc_s:#010x} / computed {crc_c:#010x} "
+                  f"({match})")
+    if info["status"] == "corrupt":
+        print(f"   status: CORRUPT — {info['reason']}")
+        return
+    print("   status: OK")
+    if info["arrays"]:
+        w = max(len(p) for p, *_ in info["arrays"])
+        print(f"   {'tree path':{w}s}  shape            dtype     spec")
+        total = 0
+        for p, shape, dtype, nbytes in info["arrays"]:
+            total += nbytes
+            spec = info["specs"].get(p, "")
+            print(f"   {p:{w}s}  {str(shape):15s}  {dtype:8s}  "
+                  f"{spec if spec else '-'}")
+        print(f"   {len(info['arrays'])} arrays, {_fmt_bytes(total)} total")
+    for p, v in info["scalars"]:
+        print(f"   {p} = {v}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", help="checkpoint files")
+    ap.add_argument("--dir", help="inspect every ckpt_* file in a directory")
+    args = ap.parse_args(argv)
+    paths = list(args.paths)
+    if args.dir:
+        from paddle_tpu.distributed.checkpoint import _step_files
+        paths += [p for _, p in _step_files(args.dir, "ckpt")]
+    if not paths:
+        ap.error("no checkpoint files given")
+    bad = 0
+    for p in paths:
+        info = inspect_file(p)
+        print_report(info)
+        bad += info["status"] == "corrupt"
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,31 @@ def _multihost_env() -> Optional[dict]:
     return None
 
 
+def _rendezvous_initialize(mh: dict):
+    """Bring up the jax coordinator rendezvous under the PR-3 STORE retry
+    policy (knobs: `PADDLE_TPU_STORE_{RETRIES,BACKOFF}`), with a named
+    fault site for chaos tests. The reference retries rendezvous at the
+    brpc/etcd layer; here a transient coordinator hiccup at job start
+    costs a backoff, not the job (ROADMAP "retry-aware collective init")."""
+    from ..fault import RetryPolicy
+    from ..fault import site as _fault_site
+
+    policy = RetryPolicy.from_env("STORE", max_attempts=3, base_delay=0.05,
+                                  max_delay=1.0)
+    # per-attempt thread-abandonment is wrong here for the same reason as
+    # PSClient: an abandoned initialize keeps mutating global jax state
+    if policy.attempt_timeout is not None:
+        import copy
+        policy = copy.copy(policy)
+        policy.attempt_timeout = None
+
+    def _do():
+        _fault_site("parallel.init")
+        jax.distributed.initialize(**mh)
+
+    policy.call(_do, op="parallel.init")
+
+
 def init_parallel_env() -> ParallelEnv:
     """Initialize the distributed context (idempotent)."""
     global _parallel_env_initialized
@@ -52,7 +77,7 @@ def init_parallel_env() -> ParallelEnv:
         return env
     mh = _multihost_env()
     if mh is not None and jax.process_count() == 1:
-        jax.distributed.initialize(**mh)
+        _rendezvous_initialize(mh)
     C._get_default_group()
     _parallel_env_initialized = True
     return env

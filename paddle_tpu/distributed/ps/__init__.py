@@ -13,13 +13,14 @@ CommonAccessor/sparse_sgd_rule design.
 from .client import PSClient, TableConfig
 from .server import PSServer
 from .embedding import SparseEmbedding
+from .cache import HotRowCache
 from . import runtime
 from .runtime import (init_server, run_server, init_worker, stop_worker,
                       barrier_worker, get_client, is_server, is_worker,
                       save_persistables, load_persistables, shutdown)
 
 __all__ = [
-    "PSClient", "PSServer", "TableConfig", "SparseEmbedding",
+    "PSClient", "PSServer", "TableConfig", "SparseEmbedding", "HotRowCache",
     "init_server", "run_server", "init_worker", "stop_worker",
     "barrier_worker", "get_client", "is_server", "is_worker",
     "save_persistables", "load_persistables", "shutdown", "runtime",

@@ -9,8 +9,10 @@ dense tables are placed on server (table_id % n_servers).
 from __future__ import annotations
 
 import ctypes
+import os
+import threading
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -64,7 +66,8 @@ class TableConfig:
 
 class PSClient:
     def __init__(self, endpoints: Sequence[str], timeout_ms: int = 60000,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 pull_lanes: Optional[int] = None):
         if retry is None:
             retry = RetryPolicy.from_env(
                 "PS", max_attempts=3, base_delay=0.1, max_delay=2.0)
@@ -86,6 +89,7 @@ class PSClient:
         self._retry = retry
         self._lib = _native.load()
         self._endpoints = list(endpoints)
+        self._timeout_ms = timeout_ms
         self._handles: List[int] = []
         self._tables: Dict[int, TableConfig] = {}
         for ep in self._endpoints:
@@ -94,6 +98,16 @@ class PSClient:
             if h < 0:
                 raise RuntimeError(f"PSClient: cannot connect to {ep}")
             self._handles.append(h)
+        # extra "lane" connections for pull_sparse_multi: the native client
+        # serializes requests per connection under a mutex, so overlapping
+        # pulls across tables needs one connection set per concurrent lane
+        # (the server spawns a thread per connection). Built lazily.
+        if pull_lanes is None:
+            pull_lanes = int(os.environ.get("PADDLE_TPU_PS_PULL_LANES", "4"))
+        self._max_pull_lanes = max(1, pull_lanes)
+        self._lanes: List[List[int]] = []
+        self._lane_lock = threading.Lock()
+        self._lane_pool = None
 
     @property
     def num_servers(self) -> int:
@@ -195,7 +209,8 @@ class PSClient:
             if idx.size:
                 yield s, idx
 
-    def pull_sparse(self, table_id: int, keys: np.ndarray) -> np.ndarray:
+    def pull_sparse(self, table_id: int, keys: np.ndarray,
+                    handles: Optional[List[int]] = None) -> np.ndarray:
         """keys: uint64 [n] -> values float32 [n, dim]."""
         cfg = self._tables[table_id]
         keys = np.ascontiguousarray(keys, np.uint64).ravel()
@@ -204,26 +219,93 @@ class PSClient:
             return out
         for s, idx in self._shard_indices(keys):
             if idx is None:
-                self._pull_shard(s, table_id, keys, out)
+                self._pull_shard(s, table_id, keys, out, handles)
                 continue
             part = np.empty((idx.size, cfg.dim), np.float32)
             self._pull_shard(s, table_id, np.ascontiguousarray(keys[idx]),
-                             part)
+                             part, handles)
             out[idx] = part
+        return out
+
+    # -------------------- overlapped multi-table pull -----------------------
+
+    def _ensure_lanes(self, n: int) -> int:
+        """Grow the lane-connection pool to min(n, max_pull_lanes) lanes;
+        returns the usable lane count. Lane 0 reuses the primary handles."""
+        n = min(max(n, 1), self._max_pull_lanes)
+        with self._lane_lock:
+            if not self._lanes:
+                self._lanes.append(self._handles)
+            while len(self._lanes) < n:
+                lane = []
+                for ep in self._endpoints:
+                    host, port = ep.rsplit(":", 1)
+                    h = self._lib.ps_connect(host.encode(), int(port),
+                                             self._timeout_ms)
+                    if h < 0:  # degraded server: fall back to fewer lanes
+                        lane = None
+                        break
+                    lane.append(h)
+                if lane is None:
+                    # cap at what we achieved and STOP trying: there is no
+                    # native disconnect, so re-attempting on every pull
+                    # would strand one handle per healthy endpoint per
+                    # step and pay blocking connects on the prepare stage
+                    self._max_pull_lanes = len(self._lanes)
+                    break
+                self._lanes.append(lane)
+            if self._lane_pool is None and len(self._lanes) > 1:
+                import concurrent.futures
+                self._lane_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self._max_pull_lanes,
+                    thread_name_prefix="ps-pull-lane")
+            return len(self._lanes)
+
+    def pull_sparse_multi(
+            self, requests: Sequence[Tuple[int, np.ndarray]]
+    ) -> List[np.ndarray]:
+        """Pull several tables' rows in ONE overlapped RPC round.
+
+        `requests` is a sequence of ``(table_id, keys)``; the result list
+        matches it by position. Each concurrent request runs over its own
+        lane connection (the per-connection mutex in the native client —
+        and the blocking socket under it — would serialize them otherwise),
+        so the wall cost is one round trip, not ``len(requests)``. The
+        per-RPC retry/fault-site machinery (`ps.pull_sparse`) applies
+        unchanged on every lane."""
+        reqs = [(tid, np.ascontiguousarray(k, np.uint64).ravel())
+                for tid, k in requests]
+        live = [i for i, (_, k) in enumerate(reqs) if k.size]
+        if len(live) <= 1:
+            return [self.pull_sparse(tid, k) for tid, k in reqs]
+        lanes = self._ensure_lanes(len(live))
+        if lanes <= 1 or self._lane_pool is None:
+            return [self.pull_sparse(tid, k) for tid, k in reqs]
+        out: List[Optional[np.ndarray]] = [
+            None if i in set(live) else self.pull_sparse(*reqs[i])
+            for i in range(len(reqs))]
+        futs = {}
+        for j, i in enumerate(live):
+            tid, k = reqs[i]
+            futs[i] = self._lane_pool.submit(
+                self.pull_sparse, tid, k, self._lanes[j % lanes])
+        for i, f in futs.items():
+            out[i] = f.result()
         return out
 
     def _sparse_chunk(self, dim: int) -> int:
         return max(1, _SPARSE_CHUNK_BYTES // max(dim * 4, 16))
 
     def _pull_shard(self, s: int, table_id: int, keys: np.ndarray,
-                    out: np.ndarray):
+                    out: np.ndarray, handles: Optional[List[int]] = None):
+        h = (handles or self._handles)[s]
         step = self._sparse_chunk(out.shape[1] if out.ndim > 1 else 1)
         for i in range(0, keys.size, step):
             k = keys[i:i + step]
             o = out[i:i + step]
             self._rpc("pull_sparse", s, table_id,
                       lambda: self._lib.ps_pull_sparse(
-                          self._handles[s], table_id,
+                          h, table_id,
                           k.ctypes.data_as(_U64P), k.size,
                           o.ctypes.data_as(_F32P), o.size))
 

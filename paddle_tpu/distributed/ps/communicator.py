@@ -22,6 +22,16 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ...profiler import metrics as _metrics_mod
+
+_REG = _metrics_mod.default_registry()
+_H_SEND = _REG.histogram(
+    "ps_comm_send_seconds",
+    "communicator sender-thread drain latency (merged push RPC round)")
+_M_MERGED = _REG.counter(
+    "ps_comm_merged_rows_total",
+    "sparse gradient rows merged by the communicator before pushing")
+
 
 class Communicator:
     def __init__(self, client, merge_size: int = 8, send_wait_ms: int = 20,
@@ -93,6 +103,9 @@ class Communicator:
 
         def drain():
             nonlocal pending, last_send
+            t0 = time.monotonic()
+            merged_rows = 0
+            ok = True
             try:
                 for tid, merged in sparse.items():
                     if merged:
@@ -100,14 +113,21 @@ class Communicator:
                                            len(merged))
                         grads = np.stack([merged[k] for k in keys])
                         self._client.push_sparse(tid, keys, grads)
+                        merged_rows += keys.size
                 for tid, g in dense.items():
                     self._client.push_dense(tid, g)
             except BaseException as e:  # surfaced on next push/flush
                 self._error = e
+                ok = False
             sparse.clear()
             dense.clear()
             pending = 0
             last_send = time.monotonic()
+            # only a CLEAN round is recorded: counting rows from an
+            # aborted push would show data flowing during an outage
+            if ok and _metrics_mod.enabled() and merged_rows:
+                _H_SEND.observe(time.monotonic() - t0)
+                _M_MERGED.inc(merged_rows)
 
         while True:
             timeout = self.send_wait_ms / 1000.0

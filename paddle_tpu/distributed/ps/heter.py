@@ -17,10 +17,12 @@ Per step:
    so routing costs microseconds). No per-model protocol needed: any
    id-routing that is a function of the batch (slicing, reshapes, concat)
    is captured.
-2. **pull (host)** — per embedding call: np.unique over the ids, one
-   `pull_sparse` RPC for the unique rows, pad rows to a power-of-two
-   bucket (bounds recompiles; the padded tail is masked by construction:
-   `inverse` only addresses real rows).
+2. **pull (host)** — per embedding call: np.unique over the ids, then ONE
+   overlapped multi-table RPC round (`PSClient.pull_sparse_multi`) for all
+   tables' unique rows, padded to a power-of-two bucket (bounds recompiles;
+   the padded tail is masked by construction: `inverse` only addresses real
+   rows). With the hot-row cache on, only cache MISSES ride the RPC and
+   cache hits are gathered on-chip (`cache.py`).
 3. **dense step (device, ONE jit)** — the model runs with embeddings
    consuming (rows, inverse) as traced arguments; `jax.value_and_grad`
    differentiates the loss w.r.t. dense params AND the pulled rows — the
@@ -28,43 +30,84 @@ Per step:
    gradient comes back already merged per unique key. The dense optimizer
    update happens on-chip in the same executable.
 4. **push (host)** — the first n_unique row-gradients go back with one
-   `push_sparse` RPC per table; the server-side rule (sgd/adagrad/adam in
-   `_native/csrc/ps.cc`) applies the sparse update.
+   `push_sparse` RPC per non-cached table; cached tables absorb gradients
+   on-chip and write back on eviction/flush (server-side SGD is linear in
+   the gradient, so the deferred push is equivalent — see cache.py).
 
-Two modes (reference: sync vs a_sync trainers,
-`ps/service/communicator/communicator.h:402,537`):
+Three modes (reference: sync vs a_sync trainers,
+`ps/service/communicator/communicator.h:402,537`, plus the heter pipeline
+trainer's stage threads, `framework/trainer.h:336`):
 
 - ``mode="sync"`` (default) — each step's pushes land before the next
   step's pulls; loss-for-loss identical to the eager PS loop (tested).
   The host blocks on the row gradients at the end of every step.
-- ``mode="async"`` — software-pipelined: route/pull for step *i* happens
-  BEFORE step *i-1*'s push is drained, and the push RPC + gradient
-  device→host transfer overlap the chip executing step *i* (jax dispatch
-  is asynchronous). Pulls may miss the single outstanding push (staleness
-  ≤ 1 step) — precisely the reference's a_sync communicator contract,
-  where background threads batch pushes while workers keep pulling.
-  Call :meth:`flush` before reading final state.
+- ``mode="async"`` — the push RPC + gradient device→host transfer overlap
+  the chip executing the next step (jax dispatch is asynchronous). Pulls
+  may miss the single outstanding push (staleness ≤ 1 step). Call
+  :meth:`flush` before reading final state.
+- ``mode="pipelined"`` — full software pipeline: route→unique→pull→
+  `device_put` run as a background *prepare* stage on a prefetch thread
+  while the chip executes the previous step, and the push stage runs on a
+  second worker thread — pulls, pushes, and both H2D/D2H transfers all
+  come off the critical path; per-step wall time approaches
+  ``max(prepare, on-chip compute)``. Callers that know the next batch can
+  hand it to :meth:`prefetch` right after a step so the prepare stage
+  truly runs one batch ahead. The staleness contract is UNCHANGED from
+  async — a pull may miss at most the ONE in-flight push (the previous
+  step's): outstanding push futures are drained before a new prepare may
+  pull (for a ``prefetch()``-issued prepare the wait is chained onto the
+  prefetch thread, so ``prefetch()`` itself never blocks), so pulls for
+  step *t* always observe pushes through step *t−2* and possibly step
+  *t−1*. Bounded at 1 step, tested with and without prefetch().
+
+Pipeline-stage failures go through the PR-3 `RetryPolicy` with named fault
+sites (``heter.pull`` / ``heter.push``, knobs `PADDLE_TPU_HETER_*`) ON TOP
+of the per-RPC retry inside `PSClient`, so a mid-pipeline PS hiccup retries
+the stage instead of wedging the prefetch thread; exhaustion surfaces on
+the main thread at the next step.
 
 Routing additionally runs on the host CPU backend when one is visible:
 the ids are a trivial function of the batch, and compiling the router for
 the accelerator would cost a host↔chip round trip per step just to learn
 which rows to pull (the r4 heter bench was latency-bound on exactly that).
+
+Stage latencies land in the metrics registry as histograms
+(``heter_route_seconds`` / ``heter_pull_seconds`` / ``heter_push_seconds``
+/ ``heter_step_wall_seconds``) and cumulative per-stage seconds are
+exposed on :attr:`stage_totals` for the bench overlap breakdown.
 """
 from __future__ import annotations
 
+import sys
 import threading
+import time
+import warnings
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...fault import RetryPolicy
+from ...fault import site as _fault_site
 from ...framework import random as random_mod
 from ...framework.tensor import Tensor
 from ...nn.layer import Layer
+from ...profiler import metrics as _metrics_mod
 
 _ROUTE = threading.local()  # .capture: list appended by SparseEmbedding
 _FEED = threading.local()   # .queue: per-call (rows, inverse, shape) feeds
+
+_REG = _metrics_mod.default_registry()
+_H_ROUTE = _REG.histogram("heter_route_seconds",
+                          "heter-PS id-routing stage latency")
+_H_PULL = _REG.histogram("heter_pull_seconds",
+                         "heter-PS sparse pull stage latency (RPC round)")
+_H_PUSH = _REG.histogram("heter_push_seconds",
+                         "heter-PS sparse push stage latency (incl. D2H)")
+_H_STEP = _REG.histogram("heter_step_wall_seconds",
+                         "heter-PS per-step wall time on the main thread")
 
 
 def _capturing() -> Optional[list]:
@@ -82,25 +125,62 @@ def _bucket(n: int, minimum: int = 64) -> int:
     return b
 
 
+@dataclass
+class _Call:
+    """One SparseEmbedding call's prepared sparse inputs for a step."""
+    emb: object
+    uniq: np.ndarray
+    cache: object = None           # HotRowCache or None
+    cplan: object = None           # CachePlan (cache path only)
+    plan_dev: tuple = None         # (slot_idx, hit_mask, miss_idx) on device
+    evict_keys: Optional[np.ndarray] = None
+    evict_slots_dev: object = None
+
+
+@dataclass
+class _Bundle:
+    """Output of the prepare stage: everything the dispatch needs."""
+    arrs: tuple
+    calls: List[_Call]
+    rows: tuple                     # per-call padded device rows (misses or
+                                    # full bucket for uncached tables)
+    invs: tuple
+    timings: Dict[str, float] = field(default_factory=dict)
+
+
 class HeterPSTrainStep:
     """Compiled dense-net training around a live parameter server.
 
     `model` may contain any number of `SparseEmbedding` layers (tables on
     the PS, no local params) plus ordinary dense layers; `optimizer` only
     ever sees the dense params — sparse updates run server-side, as in the
-    reference's DownpourWorker split."""
+    reference's DownpourWorker split.
+
+    ``cache_capacity`` > 0 enables the device-side hot-row cache
+    (`cache.py`) for every SGD-family sparse table: high-skew id
+    distributions then skip the PS round trip entirely on hits.
+    """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True, mode: str = "sync"):
+                 donate: bool = True, mode: str = "sync",
+                 cache_capacity: int = 0):
         from ...jit import functionalize
         from .embedding import SparseEmbedding
 
-        assert mode in ("sync", "async"), mode
+        assert mode in ("sync", "async", "pipelined"), mode
         self.layer = model
         self.mode = mode
-        self._pending = None  # async mode: (grows, push_meta) not yet pushed
-        self._push_fut = None
+        self._pending = None  # overlapped modes: (grows, push_meta) to push
+        self._push_futs: list = []
         self._push_pool = None  # lazy single worker: pushes stay ordered
+        self._prefetch_pool = None  # pipelined: single prepare worker
+        self._prefetched = None     # (arrs, future) queued by prefetch()
+        self._stage_retry = RetryPolicy.from_env(
+            "HETER", max_attempts=3, base_delay=0.05, max_delay=1.0)
+        self.stage_totals: Dict[str, float] = {
+            "route_s": 0.0, "pull_s": 0.0, "put_s": 0.0, "push_s": 0.0,
+            "steps": 0}
+        self._totals_lock = threading.Lock()
         try:
             self._cpu_dev = jax.devices("cpu")[0]
         except Exception:
@@ -114,6 +194,10 @@ class HeterPSTrainStep:
             "jit.TrainStep for fully-dense models")
         for e in self._embeddings:
             e._ensure_table()
+        self._caches: Dict[int, object] = {}
+        if cache_capacity:
+            from .cache import build_caches
+            self._caches = build_caches(self._embeddings, cache_capacity)
         self.apply_fn, params, buffers = functionalize(model)
         self.params = jax.tree_util.tree_map(jnp.copy, params)
         self.buffers = jax.tree_util.tree_map(jnp.copy, buffers)
@@ -147,6 +231,10 @@ class HeterPSTrainStep:
 
         donate_args = (0, 2) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_args)
+
+    @property
+    def caches(self) -> Dict[int, object]:
+        return self._caches
 
     # -- id routing ---------------------------------------------------------
     def _route(self, arrs):
@@ -194,107 +282,404 @@ class HeterPSTrainStep:
             "model's forward reach its embeddings?")
         return ids
 
-    # -- one training step --------------------------------------------------
-    def _pull(self, ids_list):
+    # -- prepare stage (route + unique + pull + H2D) ------------------------
+    def _prepare(self, arrs) -> _Bundle:
+        """Stage 1 of the pipeline. Runs on the prefetch thread in
+        pipelined mode, inline otherwise; touches NO cache device state and
+        commits no cache index mutations (those happen at dispatch on the
+        main thread), so an abandoned bundle is side-effect-free."""
+        record = _metrics_mod.enabled()
+        t0 = time.perf_counter()
+        ids_list = self._route(arrs)
         # ONE batched device->host fetch for every table's ids: per-array
         # np.asarray costs a full dispatch round trip EACH (~120ms over a
         # TPU tunnel, ~1s/step at 8 tables — the r4 heter bench's actual
         # bottleneck), while device_get transfers the whole tuple in one
         ids_host = jax.device_get(tuple(ids_list))
-        rows_list, inv_list, push_meta = [], [], []
+        route_s = time.perf_counter() - t0
+
+        if self._caches:
+            # a table consumed by MORE THAN ONE embedding call per step
+            # cannot be cached: each call's plan() would start from the
+            # same committed index/free-list state and hand the same slots
+            # to different keys, and the double commit would corrupt the
+            # free list. Drop such tables' caches (flushing pending grads
+            # first — nothing is lost, the rows just go back to the
+            # per-step pull/push path). The plan is adopted on (re)trace,
+            # so this triggers on the first prepare that sees the model.
+            seen, dups = set(), set()
+            for emb, _ in self._plan:
+                tid = emb._table_cfg.table_id
+                (dups if tid in seen else seen).add(tid)
+            for tid in dups:
+                dropped = self._caches.pop(tid, None)
+                if dropped is not None:
+                    dropped.flush()
+                    warnings.warn(
+                        f"hot-row cache disabled for table {tid}: it is "
+                        "consumed by multiple embedding calls in one step "
+                        "(per-step cache plans would collide); this "
+                        "table's rows use the per-step pull/push path")
+
+        calls: List[_Call] = []
+        inv_list: List[np.ndarray] = []
+        pull_reqs = []  # (client, table_id, keys) in call order
         for ids, (emb, shape) in zip(ids_host, self._plan):
             flat = np.asarray(ids).reshape(-1).astype(np.uint64)
             uniq, inverse = np.unique(flat, return_inverse=True)
-            n = uniq.size
-            U = _bucket(n)
-            rows = emb.client.pull_sparse(emb._table_cfg.table_id, uniq)
-            rows_p = np.zeros((U, emb._dim), np.float32)
-            rows_p[:n] = rows
-            rows_list.append(rows_p)
             inv_list.append(inverse.astype(np.int32))
-            push_meta.append((emb, uniq))
-        # one batched host->device transfer for the pulled rows + inverses
-        rows_list, inv_list = jax.device_put((tuple(rows_list),
-                                              tuple(inv_list)))
-        return list(rows_list), list(inv_list), push_meta
+            cache = self._caches.get(emb._table_cfg.table_id)
+            if cache is None:
+                calls.append(_Call(emb=emb, uniq=uniq))
+                pull_reqs.append((emb.client, emb._table_cfg.table_id, uniq))
+            else:
+                cplan = cache.plan(uniq, _bucket(uniq.size))
+                calls.append(_Call(emb=emb, uniq=uniq, cache=cache,
+                                   cplan=cplan))
+                pull_reqs.append((emb.client, emb._table_cfg.table_id,
+                                  cplan.miss_keys))
 
+        t1 = time.perf_counter()
+        pulled = self._stage_retry.call(
+            self._pull_round, pull_reqs, op="heter.pull")
+        pull_s = time.perf_counter() - t1
+
+        t2 = time.perf_counter()
+        rows_host, aux_host = [], []
+        for c, rows in zip(calls, pulled):
+            if c.cache is None:
+                U = _bucket(c.uniq.size)
+                rows_p = np.zeros((U, c.emb._dim), np.float32)
+                rows_p[:c.uniq.size] = rows
+                rows_host.append(rows_p)
+                aux_host.append(None)
+            else:
+                p = c.cplan
+                M = _bucket(len(p.miss_keys), minimum=8)
+                rows_p = np.zeros((M, c.emb._dim), np.float32)
+                rows_p[:len(p.miss_keys)] = rows
+                rows_host.append(rows_p)
+                ev_slots = (np.asarray([s for _, s in p.evicts], np.int32)
+                            if p.evicts else None)
+                aux_host.append((p.slot_idx, p.hit_mask, p.miss_idx,
+                                 ev_slots))
+        # one batched host->device transfer for rows + inverses + cache maps
+        rows_dev, invs_dev, aux_dev = jax.device_put(
+            (tuple(rows_host), tuple(inv_list),
+             tuple(a for a in aux_host if a is not None)))
+        aux_iter = iter(aux_dev)
+        for c, a in zip(calls, aux_host):
+            if a is None:
+                continue
+            slot_idx, hit_mask, miss_idx, ev_slots = next(aux_iter)
+            c.plan_dev = (slot_idx, hit_mask, miss_idx)
+            if a[3] is not None:
+                c.evict_keys = np.asarray([k for k, _ in c.cplan.evicts],
+                                          np.uint64)
+                c.evict_slots_dev = ev_slots
+        put_s = time.perf_counter() - t2
+
+        if record:
+            _H_ROUTE.observe(route_s)
+            _H_PULL.observe(pull_s)
+        with self._totals_lock:
+            self.stage_totals["route_s"] += route_s
+            self.stage_totals["pull_s"] += pull_s
+            self.stage_totals["put_s"] += put_s
+        return _Bundle(arrs=arrs, calls=calls, rows=rows_dev, invs=invs_dev,
+                       timings={"route_s": route_s, "pull_s": pull_s,
+                                "put_s": put_s})
+
+    @staticmethod
+    def _pull_round(pull_reqs):
+        """One overlapped pull round across tables. Requests sharing a
+        client go through its `pull_sparse_multi` (concurrent lane
+        connections — one RPC round of latency instead of one per table);
+        results return in request order."""
+        _fault_site("heter.pull")
+        by_client: Dict[int, list] = {}
+        for pos, (client, tid, keys) in enumerate(pull_reqs):
+            by_client.setdefault(id(client), (client, []))[1].append(
+                (pos, tid, keys))
+        out = [None] * len(pull_reqs)
+        for client, items in by_client.values():
+            multi = getattr(client, "pull_sparse_multi", None)
+            if multi is not None and len(items) > 1:
+                got = multi([(tid, keys) for _, tid, keys in items])
+            else:
+                got = [client.pull_sparse(tid, keys)
+                       for _, tid, keys in items]
+            for (pos, _, _), rows in zip(items, got):
+                out[pos] = rows
+        return out
+
+    # -- push stage ---------------------------------------------------------
     def _push(self, grows, push_meta):
-        # batched fetch (blocks until the producing step finishes on device)
+        """Immediate push for non-cached tables (blocks until the producing
+        step finishes on device, then one RPC per table)."""
+        _fault_site("heter.push")
+        t0 = time.perf_counter()
         grows_host = jax.device_get(tuple(grows))
         for g, (emb, uniq) in zip(grows_host, push_meta):
             merged = np.asarray(g, dtype=np.float32)[:uniq.size]
             emb.client.push_sparse(emb._table_cfg.table_id, uniq, merged)
+        dt = time.perf_counter() - t0
+        if _metrics_mod.enabled():
+            _H_PUSH.observe(dt)
+        with self._totals_lock:
+            self.stage_totals["push_s"] += dt
+
+    def _push_retrying(self, grows, push_meta):
+        # stage-level retry on top of the per-RPC retry inside PSClient: it
+        # re-runs the WHOLE multi-table push, so it is at-least-once across
+        # tables. That only matters after the client's own retry exhausted
+        # (server genuinely down, job failing anyway); injected faults at
+        # the `heter.push` site fire before any RPC and retry cleanly.
+        self._stage_retry.call(self._push, grows, push_meta,
+                               op="heter.push")
+
+    def _submit_push(self, fn, *args):
+        import concurrent.futures
+        if self._push_pool is None:
+            self._push_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1)
+        self._push_futs.append(self._push_pool.submit(fn, *args))
 
     def _drain_fut(self):
-        if self._push_fut is not None:
-            fut, self._push_fut = self._push_fut, None
-            fut.result()  # propagate background push errors
+        if self._push_futs:
+            futs, self._push_futs = self._push_futs, []
+            for f in futs:
+                f.result()  # propagate background push errors
 
-    def flush(self):
-        """Async mode: land the outstanding push (no-op when none/sync)."""
+    # -- pipelined prefetch -------------------------------------------------
+    def prefetch(self, *batch):
+        """Pipelined mode: hand the NEXT batch to the prepare stage so its
+        route/unique/pull/H2D run while the chip executes the current step.
+        The following ``__call__`` MUST receive this same batch (enforced
+        by object identity on the batch elements); an unconsumed prefetch
+        is discarded side-effect-free by flush().
+
+        Staleness stays bounded at 1 step: the prepare is CHAINED behind
+        every push future already in flight (pushes through step t−1 plus
+        eviction write-backs — the wait runs on the prefetch thread, so
+        this call never blocks), and the pending step-t push is submitted
+        here so at most that ONE push can race the prefetched pull."""
+        assert self.mode == "pipelined", "prefetch() requires pipelined mode"
+        assert self._prefetched is None, (
+            "one prefetch may be outstanding; call the step first")
+        arrs = tuple(a.data if isinstance(a, Tensor) else jnp.asarray(a)
+                     for a in batch)
+        # capture the in-flight pushes BEFORE submitting the pending one:
+        # the prepare must observe pushes through step t-1 (and any
+        # eviction write-backs), while step t's push may overlap it
+        waits = list(self._push_futs)
+        if self._pending is not None:
+            prev, self._pending = self._pending, None
+            self._submit_push(self._push_retrying, *prev)
+        self._prefetched = (batch, self._submit_prepare(arrs, waits=waits))
+
+    def _submit_prepare(self, arrs, waits=()):
+        import concurrent.futures
+        if self._prefetch_pool is None:
+            self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1)
+        if not waits:
+            return self._prefetch_pool.submit(self._prepare, arrs)
+
+        def chained():
+            for f in waits:  # push errors surface at bundle.result()
+                f.result()
+            return self._prepare(arrs)
+
+        return self._prefetch_pool.submit(chained)
+
+    def _take_prefetched(self, batch, arrs):
+        """Match a queued prefetch to this call, or submit one now."""
+        if self._prefetched is not None:
+            pre_batch, fut = self._prefetched
+            self._prefetched = None
+            # identity on the ORIGINAL batch objects: the converted arrays
+            # (jnp.asarray of a numpy input) are fresh objects every call
+            if len(pre_batch) == len(batch) and all(
+                    a is b for a, b in zip(pre_batch, batch)):
+                return fut
+            fut.result()  # surface errors; bundle itself is side-effect-free
+            raise RuntimeError(
+                "prefetch()/step batch mismatch: the batch handed to "
+                "prefetch() must be the next one passed to the step "
+                "(prefetched objects were not the ones just received)")
+        return self._submit_prepare(arrs)
+
+    # -- lifecycle ----------------------------------------------------------
+    def _flush_pushes(self):
+        """Drain the push worker + land the pending step's push (keeps the
+        cache accumulators resident — see flush())."""
+        if self._prefetched is not None:
+            _, fut = self._prefetched
+            self._prefetched = None
+            try:  # abandoned bundles are side-effect-free by contract
+                fut.result()
+            except Exception:
+                pass
         self._drain_fut()
         if self._pending is not None:
             grows, meta = self._pending
             self._pending = None
-            self._push(grows, meta)
+            if meta:
+                self._push_retrying(grows, meta)
+
+    def flush(self):
+        """Land every outstanding push: drain the push worker, push the
+        pending step's gradients, and write back all cache-resident
+        gradient accumulators (no-op where nothing is outstanding)."""
+        self._flush_pushes()
+        if self._caches:
+            from .cache import flush_all
+            flush_all(self._caches.values())
 
     def close(self):
-        """Teardown: best-effort land outstanding pushes, then join the
-        worker thread. Safe on the error path BEFORE stopping the PS —
-        otherwise an in-flight background push races server shutdown and
-        the non-daemon executor thread can wedge interpreter exit."""
+        """Teardown: land outstanding pushes, then join the worker threads.
+        Safe on the error path BEFORE stopping the PS — otherwise an
+        in-flight background push races server shutdown and the non-daemon
+        executor threads can wedge interpreter exit. A flush failure is
+        only swallowed when close() runs during exception unwinding
+        (ADVICE r5: a clean close must not silently drop the last step's
+        gradients)."""
+        unwinding = sys.exc_info()[0] is not None
         try:
             self.flush()
         except Exception:
             self._pending = None  # teardown must not mask the original error
-        if self._push_pool is not None:
-            self._push_pool.shutdown(wait=True)
-            self._push_pool = None
+            if not unwinding:
+                self._shutdown_pools()
+                raise
+        self._shutdown_pools()
+
+    def _shutdown_pools(self):
+        for attr in ("_push_pool", "_prefetch_pool"):
+            pool = getattr(self, attr)
+            if pool is not None:
+                pool.shutdown(wait=True)
+                setattr(self, attr, None)
 
     def __del__(self):
         try:
-            if self._push_pool is not None:
-                self._push_pool.shutdown(wait=True)
+            self._shutdown_pools()
         except Exception:
             pass
 
+    # -- one training step --------------------------------------------------
     def __call__(self, *batch):
+        t_wall = time.perf_counter()
         self._t += 1
         arrs = tuple(a.data if isinstance(a, Tensor) else jnp.asarray(a)
                      for a in batch)
         if self.mode == "sync":
-            self.flush()  # defensive: a mode flip mid-run must not drop grads
-        elif self._pending is not None:
-            # hand last step's push to the single worker thread NOW: its
-            # grad fetch + push RPC run concurrently with this step's route
-            # fetch + pull RPC (the C++ client serializes per-connection
-            # requests under a mutex; ctypes releases the GIL)
-            import concurrent.futures
-            if self._push_pool is None:
-                self._push_pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=1)
-            self._drain_fut()  # at most ONE background push in flight
-            prev, self._pending = self._pending, None
-            self._push_fut = self._push_pool.submit(self._push, *prev)
-        ids_list = self._route(arrs)
-        rows_list, inv_list, push_meta = self._pull(ids_list)
+            # defensive: a mode flip mid-run must not drop grads (cache
+            # accumulators stay resident — flushing them every step would
+            # re-serialize the path the cache exists to avoid)
+            self._flush_pushes()
+            bundle = self._prepare(arrs)
+        elif self.mode == "async":
+            if self._pending is not None:
+                # hand last step's push to the single worker thread NOW: its
+                # grad fetch + push RPC run concurrently with this step's
+                # route fetch + pull RPC (the C++ client serializes
+                # per-connection requests under a mutex; ctypes releases
+                # the GIL)
+                self._drain_fut()  # at most ONE background push in flight
+                prev, self._pending = self._pending, None
+                self._submit_push(self._push_retrying, *prev)
+            bundle = self._prepare(arrs)
+        else:  # pipelined
+            # drain BEFORE the new prepare can pull: pulls for step t then
+            # observe every push through step t-2 and can miss at most the
+            # one about to be submitted (staleness <= 1, tested)
+            self._drain_fut()
+            fut = self._take_prefetched(batch, arrs)
+            if self._pending is not None:
+                prev, self._pending = self._pending, None
+                self._submit_push(self._push_retrying, *prev)
+            bundle = fut.result()
+
+        loss, grows_push, push_meta = self._dispatch(bundle)
+
+        if self.mode == "sync":
+            if push_meta:
+                self._push_retrying(grows_push, push_meta)
+        elif push_meta:
+            # dispatch is asynchronous: the chip is now executing step t;
+            # its push drains at the START of call t+1, overlapped with
+            # that call's route/pull (staleness <= 1 step — the reference
+            # a_sync communicator contract). Fully-cached steps have
+            # nothing to push: gradients were absorbed on-chip.
+            self._pending = (grows_push, push_meta)
+        dt = time.perf_counter() - t_wall
+        if _metrics_mod.enabled():
+            _H_STEP.observe(dt, mode=self.mode)
+        with self._totals_lock:
+            self.stage_totals["steps"] += 1
+        return Tensor(loss)
+
+    def _dispatch(self, bundle: _Bundle):
+        """Stage 2+3 on the main thread: cache combine/commit, the ONE
+        compiled dense step, cache apply, and push composition. All cached
+        tables' gathers go out in ONE device dispatch (and one apply) —
+        per-call dispatch latency is what the tunnel charges for."""
+        cached_ix = [i for i, c in enumerate(bundle.calls)
+                     if c.cache is not None]
+        for i in cached_ix:
+            c = bundle.calls[i]
+            # eviction write-back: gather the evicted slots' pending grads
+            # BEFORE this step's apply reuses the slots (jax orders the
+            # gather ahead of the donated-buffer overwrite)
+            if c.evict_keys is not None and c.evict_keys.size:
+                wb = c.cache.writeback_rows(c.evict_slots_dev)
+                c.cache.note_writeback(int(c.evict_keys.size))
+                self._submit_push(self._writeback_push, c.emb, c.evict_keys,
+                                  wb)
+        rows_list = list(bundle.rows)
+        if cached_ix:
+            from .cache import apply_batch, combine_batch
+            served = combine_batch(
+                [bundle.calls[i].cache for i in cached_ix],
+                [bundle.calls[i].plan_dev for i in cached_ix],
+                [bundle.rows[i] for i in cached_ix])
+            for i, rows in zip(cached_ix, served):
+                rows_list[i] = rows
 
         rng = random_mod.default_generator().split()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         (loss, self.params, self.buffers, self.opt_state,
          grows) = self._step(
             self.params, self.buffers, self.opt_state, tuple(rows_list),
-            tuple(inv_list), rng, lr, self._t, *arrs)
+            tuple(bundle.invs), rng, lr, self._t, *bundle.arrs)
 
-        if self.mode == "async":
-            # dispatch is asynchronous: the chip is now executing step t;
-            # its push drains at the START of call t+1, overlapped with
-            # that call's route/pull (staleness <= 1 step — the reference
-            # a_sync communicator contract)
-            self._pending = (grows, push_meta)
-        else:
-            self._push(grows, push_meta)
-        return Tensor(loss)
+        grows_push, push_meta = [], []
+        for c, g in zip(bundle.calls, grows):
+            if c.cache is None:
+                grows_push.append(g)
+                push_meta.append((c.emb, c.uniq))
+                continue
+            c.cache.commit(c.cplan)
+            if c.cplan.overflow:
+                # rare: unique keys beyond capacity found no slot — their
+                # grads must reach the PS now (apply drops them)
+                pos = np.asarray(c.cplan.overflow, np.int64)
+                grows_push.append(jnp.take(g, pos, axis=0))
+                push_meta.append((c.emb, c.uniq[pos]))
+        if cached_ix:
+            apply_batch([bundle.calls[i].cache for i in cached_ix],
+                        [bundle.calls[i].plan_dev for i in cached_ix],
+                        [rows_list[i] for i in cached_ix],
+                        [grows[i] for i in cached_ix])
+        return loss, tuple(grows_push), push_meta
+
+    @staticmethod
+    def _writeback_push(emb, keys, wb_dev):
+        """Push worker task: land an eviction write-back on the PS."""
+        g = np.asarray(jax.device_get(wb_dev), np.float32)
+        emb.client.push_sparse(emb._table_cfg.table_id, keys, g)
 
     # -- state --------------------------------------------------------------
     def sync_to_layer(self):

@@ -170,9 +170,20 @@ def kl_threshold(hist: np.ndarray, bin_width: float, bits: int = 8) -> float:
     if total == 0:
         return bin_width * len(hist)
     best_i, best_kl = len(hist), np.inf
+    # saturation guard: a candidate clip may saturate at most clip_cap of
+    # the total activation mass. Without it, heavily zero-spiked post-ReLU
+    # histograms let the KL objective pick thresholds that clipped ~10% of
+    # real activation mass — the i=n_quant candidate quantizes losslessly
+    # (one bin per level), so its near-zero KL won regardless of how much
+    # tail it threw away (the test_convert_int8[KL] baseline failure).
+    # Genuinely negligible tails (the TensorRT-style clipping KL exists
+    # for) stay clippable.
+    clip_cap = 0.01 * total
     for i in range(n_quant, len(hist) + 1):
-        ref = hist[:i].copy()
         outliers = hist[i:].sum()
+        if outliers > clip_cap:
+            continue
+        ref = hist[:i].copy()
         ref[i - 1] += outliers
         ref_p = ref / ref.sum()
         # quantize i bins down to n_quant

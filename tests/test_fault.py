@@ -484,3 +484,52 @@ class TestCkptInspect:
         assert info["status"] == "ok"
         assert info["crc_stored"] == info["crc_computed"]
         assert info["arrays"][0][0] == "/a"
+
+
+# ---------------------------------------------------------------------------
+# Retry-aware collective init (ROADMAP open item, PR 4 satellite)
+# ---------------------------------------------------------------------------
+class TestCollectiveInitRetry:
+    def test_rendezvous_retries_under_store_policy(self, monkeypatch):
+        """A transient coordinator hiccup during init_parallel_env's
+        rendezvous is retried under the STORE policy via the named
+        `parallel.init` fault site, instead of killing the job."""
+        import jax
+        from paddle_tpu.distributed import parallel as par
+
+        calls = []
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.append(kw))
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                           "127.0.0.1:6170,127.0.0.1:6171")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        monkeypatch.setattr(par, "_parallel_env_initialized", False)
+        fault.configure("parallel.init", times=1)
+        rec_before = metrics_mod.default_registry().get(
+            "retry_recovered_total").value(op="parallel.init")
+        par.init_parallel_env()
+        assert calls == [{"coordinator_address": "127.0.0.1:6170",
+                          "num_processes": 2, "process_id": 0}]
+        assert fault.default_injector().fired("parallel.init") == 1
+        rec_after = metrics_mod.default_registry().get(
+            "retry_recovered_total").value(op="parallel.init")
+        assert rec_after == rec_before + 1
+        # the monkeypatched module global is restored by pytest; the env
+        # stays usable either way because init is idempotent
+
+    def test_rendezvous_exhaustion_raises(self, monkeypatch):
+        import jax
+        from paddle_tpu.distributed import parallel as par
+        from paddle_tpu.fault import RetryExhaustedError
+
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: None)
+        monkeypatch.setenv("PADDLE_TPU_STORE_RETRIES", "2")
+        monkeypatch.setenv("PADDLE_TPU_STORE_BACKOFF", "0.001")
+        fault.configure("parallel.init", times=5)
+        with pytest.raises(RetryExhaustedError):
+            par._rendezvous_initialize({"coordinator_address": "x:1",
+                                        "num_processes": 2,
+                                        "process_id": 0})
+        assert fault.default_injector().fired("parallel.init") == 2

@@ -1,0 +1,402 @@
+"""Pipelined heter-PS training + device-side hot-row embedding cache.
+
+Covers the PR-4 sparse-path pipeline (`heter.py mode="pipelined"` +
+`cache.py`): bounded staleness of the prefetched pulls, cache gather
+correctness including eviction write-back / overflow / partial last
+batches, chaos recovery of a faulted mid-pipeline pull, and the
+multi-table one-round pull on the client.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fault, nn, optimizer
+from paddle_tpu.distributed.ps import PSClient, PSServer
+from paddle_tpu.distributed.ps.heter import HeterPSTrainStep
+from paddle_tpu.models.wide_deep import WideDeep
+
+
+@pytest.fixture()
+def ps():
+    server = PSServer(0)
+    client = PSClient([server.endpoint])
+    yield client
+    client.stop_servers()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.reset()
+    yield
+    fault.reset()
+
+
+def _data(n_batches=8, B=16, vocab=100, slots=4, seed=7, partial_at=None):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n_batches):
+        b = 5 if i == partial_at else B
+        ids = rng.integers(0, vocab, (b, slots))
+        dense = rng.normal(size=(b, slots)).astype(np.float32)
+        y = ((ids.sum(1) % 2) == 0).astype(np.float32)[:, None]
+        out.append((paddle.to_tensor(ids.astype(np.int64)),
+                    paddle.to_tensor(dense), paddle.to_tensor(y)))
+    return out
+
+
+def _trainer(client, mode="sync", cache_capacity=0, slots=4, lr=5e-2):
+    paddle.seed(0)
+    model = WideDeep(num_slots=slots, embedding_dim=8, dense_dim=slots,
+                     hidden=32, client=client)
+    opt = optimizer.SGD(learning_rate=lr, parameters=model.parameters())
+    crit = nn.BCEWithLogitsLoss()
+    step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt, mode=mode,
+                            cache_capacity=cache_capacity)
+    return model, step
+
+
+def _run(step, data, prefetch=False):
+    losses = []
+    for i, batch in enumerate(data):
+        losses.append(float(step(*batch)))
+        if prefetch and i + 1 < len(data):
+            step.prefetch(*data[i + 1])
+    step.flush()
+    return losses
+
+
+def _server_rows(model, client, vocab):
+    keys = np.arange(vocab, dtype=np.uint64)
+    return {e._table_cfg.table_id:
+            client.pull_sparse(e._table_cfg.table_id, keys).copy()
+            for e in [*model.embeddings, model.wide]}
+
+
+class TestPipelinedMode:
+    def test_matches_sync_when_fully_cached(self, ps):
+        """With every table cached, gradients are absorbed on-chip and
+        there is no push to be stale against: pipelined losses must equal
+        the sync-mode run step for step."""
+        data = _data()
+        _, s_sync = _trainer(ps, "sync")
+        sync = _run(s_sync, data)
+        s_sync.close()
+
+        server2 = PSServer(0)
+        client2 = PSClient([server2.endpoint])
+        try:
+            _, s_pipe = _trainer(client2, "pipelined", cache_capacity=256)
+            pipe = _run(s_pipe, data, prefetch=True)
+            s_pipe.close()
+        finally:
+            client2.stop_servers()
+        np.testing.assert_allclose(pipe, sync, atol=1e-5)
+
+    @pytest.mark.parametrize("prefetch", [False, True])
+    def test_bounded_staleness(self, ps, monkeypatch, prefetch):
+        """A pull for step t must observe every push through step t-2:
+        outstanding push futures are drained before a new prepare may
+        pull — inline for __call__-submitted prepares, chained onto the
+        prefetch thread for prefetch()-issued ones (contract documented
+        in heter.py; regression for the pipeline's staleness bound)."""
+        import threading
+        _, step = _trainer(ps, "pipelined")
+        lock = threading.Lock()
+        pushes_done = [0]
+        pulls = []  # (pull_ordinal, pushes_done when the pull started)
+
+        real_pull = HeterPSTrainStep._pull_round
+        real_push = HeterPSTrainStep._push
+
+        def rec_pull(pull_reqs):
+            with lock:
+                pulls.append(pushes_done[0])
+            return real_pull(pull_reqs)
+
+        def rec_push(self, grows, push_meta):
+            real_push(self, grows, push_meta)
+            with lock:
+                pushes_done[0] += 1
+
+        monkeypatch.setattr(HeterPSTrainStep, "_pull_round",
+                            staticmethod(rec_pull))
+        monkeypatch.setattr(HeterPSTrainStep, "_push", rec_push)
+        data = _data(n_batches=8)
+        _run(step, data, prefetch=prefetch)
+        step.close()
+        assert len(pulls) == len(data)
+        for t, done in enumerate(pulls, start=1):
+            # pushes for steps 1..t-2 must have completed before pull t
+            assert done >= t - 2, (t, done, pulls)
+            assert done <= t - 1, (t, done, pulls)
+
+    def test_prefetch_batch_mismatch_raises(self, ps):
+        _, step = _trainer(ps, "pipelined")
+        data = _data(n_batches=3)
+        step(*data[0])
+        step.prefetch(*data[1])
+        with pytest.raises(RuntimeError, match="prefetch"):
+            step(*data[2])
+        step.close()
+
+    def test_prefetch_accepts_numpy_batches(self, ps):
+        """The prefetch/step match is identity on the ORIGINAL batch
+        objects: raw numpy inputs (converted to fresh device arrays on
+        every call) must not trip a spurious mismatch."""
+        _, step = _trainer(ps, "pipelined")
+        rng = np.random.default_rng(11)
+        data = [(rng.integers(0, 50, (8, 4)).astype(np.int64),
+                 rng.normal(size=(8, 4)).astype(np.float32),
+                 np.ones((8, 1), np.float32)) for _ in range(3)]
+        losses = []
+        for i, b in enumerate(data):
+            losses.append(float(step(*b)))
+            if i + 1 < len(data):
+                step.prefetch(*data[i + 1])
+        step.close()
+        assert all(np.isfinite(l) for l in losses)
+
+    @pytest.mark.slow
+    def test_converges_on_learnable_task(self, ps):
+        """Pipelined mode (staleness <= 1) still converges — the mirror of
+        the async-mode convergence test."""
+        rng = np.random.default_rng(3)
+        vocab = 16
+        ids_all = rng.integers(0, vocab, (256, 4))
+        dense_all = rng.normal(size=(256, 4)).astype(np.float32)
+        y_all = ((ids_all[:, 0] < vocab // 2)).astype(np.float32)[:, None]
+        paddle.seed(0)
+        model = WideDeep(num_slots=4, embedding_dim=8, dense_dim=4,
+                         hidden=32, client=ps)
+        opt = optimizer.Adam(learning_rate=5e-2,
+                             parameters=model.parameters())
+        crit = nn.BCEWithLogitsLoss()
+        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt,
+                                mode="pipelined", cache_capacity=64)
+        losses = []
+        for ep in range(12):
+            for s in range(0, 256, 64):
+                losses.append(float(step(
+                    paddle.to_tensor(ids_all[s:s + 64].astype(np.int64)),
+                    paddle.to_tensor(dense_all[s:s + 64]),
+                    paddle.to_tensor(y_all[s:s + 64]))))
+        step.close()
+        assert losses[-1] < 0.35, (losses[0], losses[-1])
+
+
+class TestHotRowCache:
+    VOCAB = 100
+
+    def _rows_after_run(self, cache_capacity, partial_at=6):
+        server = PSServer(0)
+        client = PSClient([server.endpoint])
+        try:
+            model, step = _trainer(client, "sync",
+                                   cache_capacity=cache_capacity)
+            data = _data(vocab=self.VOCAB, partial_at=partial_at)
+            losses = _run(step, data)
+            stats = {t: dict(c.stats) for t, c in step.caches.items()}
+            rows = _server_rows(model, client, self.VOCAB)
+            step.close()
+        finally:
+            client.stop_servers()
+        return losses, rows, stats
+
+    def test_eviction_writeback_and_partial_batches(self):
+        """Tiny capacity forces evictions mid-run (and overflow when a
+        batch's unique count exceeds capacity); after flush the server
+        must hold the same rows as an uncached run — deferred write-backs
+        lose nothing. A partial last-ish batch rides along."""
+        ref_losses, ref_rows, _ = self._rows_after_run(0)
+        losses, rows, stats = self._rows_after_run(16)
+        np.testing.assert_allclose(losses, ref_losses, atol=2e-4)
+        assert any(s["eviction"] > 0 for s in stats.values()), stats
+        assert any(s["writeback"] > 0 for s in stats.values()), stats
+        for tid in ref_rows:
+            np.testing.assert_allclose(rows[tid], ref_rows[tid], atol=1e-4)
+
+    def test_hits_served_from_device(self, ps, monkeypatch):
+        """Once rows are cached, repeated batches must pull NOTHING from
+        the PS (the hit path is an on-chip gather)."""
+        _, step = _trainer(ps, "sync", cache_capacity=256)
+        data = _data(n_batches=2, seed=5)
+        step(*data[0])
+        pulled = []
+        orig = PSClient.pull_sparse
+
+        def spy(self, table_id, keys, handles=None):
+            pulled.append(np.asarray(keys).size)
+            return orig(self, table_id, keys, handles)
+
+        monkeypatch.setattr(PSClient, "pull_sparse", spy)
+        step(*data[0])  # same ids again: all hits
+        assert sum(pulled) == 0, pulled
+        step(*data[1])  # fresh ids: misses pull again
+        assert sum(pulled) > 0
+        total_hits = sum(c.stats["hit"] for c in step.caches.values())
+        assert total_hits > 0
+        step.close()
+
+    def test_sum_table_cached_matches_uncached(self):
+        """A "sum"/geo table (server OPT_SUM: w += g, lr ignored) is the
+        lr = -1 case of the cache's local rule — cached and uncached runs
+        must serve the same rows and land identical server state."""
+        from paddle_tpu.distributed.ps import SparseEmbedding
+
+        def run(cache_capacity):
+            server = PSServer(0)
+            client = PSClient([server.endpoint])
+            try:
+                paddle.seed(0)
+
+                class M(nn.Layer):
+                    def __init__(self):
+                        super().__init__()
+                        self.e = SparseEmbedding(
+                            table_id=0, embedding_dim=4, optimizer="sum",
+                            client=client)
+                        self.lin = nn.Linear(4, 1)
+
+                    def forward(self, ids):
+                        return self.lin(self.e(ids))
+
+                model = M()
+                opt = optimizer.SGD(learning_rate=0.1,
+                                    parameters=model.parameters())
+                crit = nn.MSELoss()
+                step = HeterPSTrainStep(model, lambda o, y: crit(o, y),
+                                        opt, cache_capacity=cache_capacity)
+                rng = np.random.default_rng(2)
+                losses = []
+                for _ in range(4):
+                    ids = paddle.to_tensor(
+                        rng.integers(0, 20, 8).astype(np.int64))
+                    y = paddle.to_tensor(
+                        rng.normal(size=(8, 1)).astype(np.float32))
+                    losses.append(float(step(ids, y)))
+                step.flush()
+                rows = client.pull_sparse(
+                    0, np.arange(20, dtype=np.uint64)).copy()
+                step.close()
+                return losses, rows
+            finally:
+                client.stop_servers()
+
+        ref_losses, ref_rows = run(0)
+        losses, rows = run(64)
+        np.testing.assert_allclose(losses, ref_losses, atol=2e-4)
+        np.testing.assert_allclose(rows, ref_rows, atol=1e-4)
+
+    def test_shared_table_two_calls_drops_cache(self, ps):
+        """A table consumed by TWO embedding calls in one step cannot be
+        cached (each call's plan would hand the same slots to different
+        keys and the double commit would corrupt the free list): the
+        cache is dropped with a warning on the first prepare and the
+        table rides the per-step pull/push path."""
+        paddle.seed(0)
+        from paddle_tpu.distributed.ps import SparseEmbedding
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.e = SparseEmbedding(table_id=0, embedding_dim=4,
+                                         optimizer="sgd", client=ps)
+                self.lin = nn.Linear(8, 1)
+
+            def forward(self, a, b):
+                return self.lin(paddle.concat([self.e(a), self.e(b)],
+                                              axis=-1))
+
+        model = M()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        crit = nn.MSELoss()
+        step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt,
+                                cache_capacity=32)
+        assert 0 in step.caches  # built at init; dropped on first prepare
+        a = paddle.to_tensor(np.arange(8, dtype=np.int64))
+        b = paddle.to_tensor((np.arange(8) + 4).astype(np.int64))
+        y = paddle.to_tensor(np.ones((8, 1), np.float32))
+        with pytest.warns(UserWarning, match="multiple embedding calls"):
+            loss = float(step(a, b, y))
+        assert np.isfinite(loss)
+        assert step.caches == {}
+        assert np.isfinite(float(step(a, b, y)))  # steady state post-drop
+        step.close()
+
+    def test_non_sgd_table_skipped_with_warning(self, ps):
+        paddle.seed(0)
+        from paddle_tpu.distributed.ps import SparseEmbedding
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.e = SparseEmbedding(table_id=0, embedding_dim=4,
+                                         optimizer="adam", client=ps)
+                self.lin = nn.Linear(4, 1)
+
+            def forward(self, ids):
+                return self.lin(self.e(ids))
+
+        model = M()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        crit = nn.MSELoss()
+        with pytest.warns(UserWarning, match="hot-row cache skipped"):
+            step = HeterPSTrainStep(model, lambda o, y: crit(o, y), opt,
+                                    cache_capacity=32)
+        assert step.caches == {}
+        ids = paddle.to_tensor(np.arange(8, dtype=np.int64))
+        y = paddle.to_tensor(np.ones((8, 1), np.float32))
+        assert np.isfinite(float(step(ids, y)))  # un-cached path still works
+        step.close()
+
+
+class TestPipelineChaos:
+    def test_injected_pull_fault_recovers(self, ps):
+        """A PS hiccup in the prepare stage retries under the HETER stage
+        policy instead of wedging the prefetch thread (fault site
+        heter.pull), and the recovery is visible in the metrics."""
+        from paddle_tpu.profiler import metrics as metrics_mod
+        _, step = _trainer(ps, "pipelined", cache_capacity=64)
+        fault.configure("heter.pull", times=1, start=3)
+        data = _data(n_batches=6)
+        losses = _run(step, data, prefetch=True)
+        step.close()
+        assert all(np.isfinite(l) for l in losses)
+        assert fault.default_injector().fired("heter.pull") == 1
+        rec = metrics_mod.default_registry().get("retry_recovered_total")
+        assert rec.value(op="heter.pull") >= 1
+
+    def test_injected_push_fault_recovers(self, ps):
+        _, step = _trainer(ps, "pipelined")  # uncached: pushes every step
+        fault.configure("heter.push", times=1, start=2)
+        data = _data(n_batches=5)
+        losses = _run(step, data)
+        step.close()
+        assert all(np.isfinite(l) for l in losses)
+        assert fault.default_injector().fired("heter.push") == 1
+
+
+class TestPullSparseMulti:
+    def test_matches_serial_pulls(self, ps):
+        from paddle_tpu.distributed.ps import TableConfig
+        rng = np.random.default_rng(0)
+        for tid in range(3):
+            ps.create_table(TableConfig(table_id=tid, kind="sparse", dim=4,
+                                        seed=tid))
+        reqs = [(tid, rng.integers(0, 1000, 64).astype(np.uint64))
+                for tid in range(3)]
+        reqs.append((1, np.empty(0, np.uint64)))  # empty request rides along
+        multi = ps.pull_sparse_multi(reqs)
+        serial = [ps.pull_sparse(tid, keys) for tid, keys in reqs]
+        assert len(multi) == len(serial)
+        for m, s in zip(multi, serial):
+            np.testing.assert_array_equal(m, s)
+
+    def test_single_request_fast_path(self, ps):
+        from paddle_tpu.distributed.ps import TableConfig
+        ps.create_table(TableConfig(table_id=9, kind="sparse", dim=4))
+        keys = np.arange(10, dtype=np.uint64)
+        (rows,) = ps.pull_sparse_multi([(9, keys)])
+        np.testing.assert_array_equal(rows, ps.pull_sparse(9, keys))

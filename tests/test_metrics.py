@@ -161,3 +161,25 @@ class TestMetricsDumpTool:
         p = tmp_path / "x.json"
         p.write_text("not json at all")
         assert metrics_dump.main([str(p)]) == 2
+
+    def test_histogram_percentile_rendering(self):
+        """PR-4: histogram families render p50/p95/p99 estimates from the
+        cumulative buckets (the heter pull/push/route latencies)."""
+        import metrics_dump
+        r = metrics.MetricsRegistry()
+        h = r.histogram("heter_pull_seconds")
+        for v in [0.001] * 90 + [0.08] * 10:
+            h.observe(v, mode="pipelined")
+        out = metrics_dump.format_snapshot(r.snapshot())
+        assert "p50=" in out and "p95=" in out and "p99=" in out
+        # p50 sits in the (0.0005, 0.001] bucket; p95+ in the big one
+        assert "mode=pipelined" in out
+
+    def test_hist_quantile_estimator(self):
+        import metrics_dump
+        buckets = {"0.001": 50, "0.01": 90, "0.1": 100, "+Inf": 100}
+        q50 = metrics_dump.hist_quantile(buckets, 0.5)
+        q99 = metrics_dump.hist_quantile(buckets, 0.99)
+        assert q50 is not None and abs(q50 - 0.001) < 1e-9
+        assert q99 is not None and 0.01 < q99 <= 0.1
+        assert metrics_dump.hist_quantile({"+Inf": 0}, 0.5) is None

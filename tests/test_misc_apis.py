@@ -362,6 +362,7 @@ class TestZooBreadth:
             m.eval()
             assert tuple(m(x).shape) == (2, 7)
 
+    @pytest.mark.slow  # heavy e2e; full-suite only (tier-1 budget)
     def test_googlenet_train_returns_aux_heads(self):
         from paddle_tpu.vision import models as M
         paddle.seed(0)

@@ -28,6 +28,7 @@ def ps_client():
 
 
 class TestGPT:
+    @pytest.mark.slow  # heavy e2e; full-suite only (tier-1 budget)
     def test_forward_shape_and_loss_decreases(self):
         from paddle_tpu.models.gpt import GPT, GPTConfig
         paddle.seed(0)
@@ -85,6 +86,7 @@ class TestBert:
         seq2, _ = model(ids, attention_mask=paddle.to_tensor(am))
         assert not np.allclose(seq.numpy()[:, :8], seq2.numpy()[:, :8])
 
+    @pytest.mark.slow  # heavy e2e; full-suite only (tier-1 budget)
     def test_pretraining_loss_decreases(self):
         from paddle_tpu.models.bert import BertConfig, BertForPretraining
         paddle.seed(0)

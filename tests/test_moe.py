@@ -139,6 +139,7 @@ class TestMoELayer:
         got = np.asarray(moe(x).data)
         np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow  # heavy e2e; full-suite only (tier-1 budget)
     def test_moe_transformer_trains(self):
         """GPT-style block with MoE FFN: loss decreases (compiled engine)."""
         d, E = 16, 4

@@ -58,6 +58,7 @@ def _single_device_losses(model_fn, batches, lr=1e-2, steps=3):
 
 
 class TestPipelineGPT:
+    @pytest.mark.slow  # heavy e2e; full-suite only (tier-1 budget)
     def test_pp_matches_single_device(self):
         cfg = GPTConfig.tiny()  # 2 blocks -> 2 stages
         batches = [_gpt_batch(cfg, B=16, seed=s) for s in range(3)]
@@ -74,6 +75,7 @@ class TestPipelineGPT:
                for a, b in batches]
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # heavy e2e; full-suite only (tier-1 budget)
     def test_pp_with_tp(self):
         cfg = GPTConfig.tiny()
         batches = [_gpt_batch(cfg, seed=s) for s in range(2)]

@@ -87,6 +87,7 @@ class TestRingAttention:
 
 
 class TestSequenceParallelGPT:
+    @pytest.mark.slow  # heavy e2e; full-suite only (tier-1 budget)
     def test_gpt_sp_engine_uses_ring(self):
         """GPT train step with sp>1 routes attention through the ring and
         matches the sp=1 run."""
@@ -177,6 +178,7 @@ class TestUlyssesAttention:
         _, _, mode = _sp_ring_config(q, q, None)
         assert mode == "ring"
 
+    @pytest.mark.slow  # heavy e2e; full-suite only (tier-1 budget)
     def test_gpt_trains_with_ulysses(self):
         """End-to-end: hybrid engine + sp axis + sp_mode=ulysses trains."""
         from paddle_tpu.distributed import fleet

@@ -163,6 +163,7 @@ class TestDatasets:
 
 
 class TestResNetRecompute:
+    @pytest.mark.slow  # heavy e2e; full-suite only (tier-1 budget)
     def test_per_stage_remat_matches_baseline_and_updates_bn(self):
         """ResNet(recompute=True) remats residual stages (reference
         RecomputeFunction at stage granularity): losses AND BatchNorm

@@ -216,6 +216,7 @@ class TestYolo:
         assert np.all(boxes.numpy() == 0)
         assert np.all(scores.numpy() == 0)
 
+    @pytest.mark.slow  # heavy e2e; full-suite only (tier-1 budget)
     def test_yolo_loss_finite_and_decreases(self):
         """The loss must be finite, positive, and trainable: a few SGD steps
         on the raw head tensor should reduce it."""

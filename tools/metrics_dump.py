@@ -49,6 +49,27 @@ def _fmt_labels(labels: dict) -> str:
     return ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "-"
 
 
+def hist_quantile(buckets: dict, q: float) -> Optional[float]:
+    """Estimate a quantile from a histogram family's CUMULATIVE bucket
+    counts ({upper_bound_repr: cum_count, ..., "+Inf": total}), linearly
+    interpolating inside the bucket that crosses the target rank. Returns
+    None for an empty histogram; the +Inf bucket clamps to the largest
+    finite bound (an under-estimate, like every prometheus quantile)."""
+    total = buckets.get("+Inf", 0)
+    if not total:
+        return None
+    target = q * total
+    bounds = sorted((float(k), v) for k, v in buckets.items() if k != "+Inf")
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in bounds:
+        if cum >= target:
+            span = cum - prev_cum
+            frac = (target - prev_cum) / span if span else 1.0
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_cum = bound, cum
+    return bounds[-1][0] if bounds else None
+
+
 def format_snapshot(snap: dict, name_filter: str = "") -> str:
     """Render {name: {kind, help, values}} as aligned human-readable rows."""
     lines = []
@@ -66,8 +87,16 @@ def format_snapshot(snap: dict, name_filter: str = "") -> str:
             if kind == "histogram":
                 cnt, tot = v.get("count", 0), v.get("sum", 0.0)
                 avg = tot / cnt if cnt else 0.0
-                lines.append(f"    {labels:<40} count={cnt:,} "
-                             f"sum={tot:.6g}s avg={avg:.6g}s")
+                line = (f"    {labels:<40} count={cnt:,} "
+                        f"sum={tot:.6g}s avg={avg:.6g}s")
+                buckets = v.get("buckets") or {}
+                if cnt and buckets:
+                    qs = [(q, hist_quantile(buckets, q))
+                          for q in (0.5, 0.95, 0.99)]
+                    line += "".join(
+                        f" p{int(q * 100)}={est:.4g}s"
+                        for q, est in qs if est is not None)
+                lines.append(line)
             else:
                 lines.append(f"    {labels:<40} {_fmt_value(v.get('value', 0))}")
     return "\n".join(lines) if lines else "(empty snapshot)"

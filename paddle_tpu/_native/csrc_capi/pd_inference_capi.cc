@@ -133,13 +133,35 @@ PD_Predictor* pd_predictor_create(const char* model_prefix) {
       p->pred = pred;
       PyObject* ins = PyTuple_GetItem(names, 0);   // borrowed
       PyObject* outs = PyTuple_GetItem(names, 1);  // borrowed
-      for (Py_ssize_t i = 0; i < PyList_Size(ins); ++i)
-        p->input_names.emplace_back(
-            PyUnicode_AsUTF8(PyList_GetItem(ins, i)));
-      for (Py_ssize_t i = 0; i < PyList_Size(outs); ++i)
-        p->output_names.emplace_back(
-            PyUnicode_AsUTF8(PyList_GetItem(outs, i)));
+      // PyUnicode_AsUTF8 returns nullptr for non-str / encoding failures;
+      // feeding that to std::string is UB, so fail the create instead
+      bool names_ok = true;
+      for (Py_ssize_t i = 0; names_ok && i < PyList_Size(ins); ++i) {
+        const char* s = PyUnicode_AsUTF8(PyList_GetItem(ins, i));
+        if (s == nullptr) {
+          PyErr_Clear();
+          set_error("input name is not valid UTF-8 text");
+          names_ok = false;
+        } else {
+          p->input_names.emplace_back(s);
+        }
+      }
+      for (Py_ssize_t i = 0; names_ok && i < PyList_Size(outs); ++i) {
+        const char* s = PyUnicode_AsUTF8(PyList_GetItem(outs, i));
+        if (s == nullptr) {
+          PyErr_Clear();
+          set_error("output name is not valid UTF-8 text");
+          names_ok = false;
+        } else {
+          p->output_names.emplace_back(s);
+        }
+      }
       Py_DECREF(names);
+      if (!names_ok) {
+        delete p;
+        p = nullptr;
+        Py_DECREF(pred);
+      }
     } else {
       Py_DECREF(pred);
     }
@@ -232,8 +254,17 @@ int pd_predictor_run(PD_Predictor* p, int n_inputs,
       }
       std::memcpy(out_data[j], PyBytes_AsString(bytes), nbytes);
       const int nd = static_cast<int>(PyList_Size(oshape));
+      if (nd > 8) {
+        // the out_shapes[j] buffers have capacity 8 (see header); silently
+        // truncating while reporting the full nd would hand the caller a
+        // shape whose tail reads uninitialized memory
+        set_error("output rank " + std::to_string(nd) +
+                  " exceeds the 8-dim capacity of out_shapes");
+        ok = false;
+        break;
+      }
       out_ndims[j] = nd;
-      for (int d = 0; d < nd && d < 8; ++d)
+      for (int d = 0; d < nd; ++d)
         out_shapes[j][d] = PyLong_AsLongLong(PyList_GetItem(oshape, d));
     }
     if (ok) rc = 0;

@@ -21,7 +21,12 @@ fleet elastic):
   the job;
 * `CheckpointManager` adds keep-last-N garbage collection, orphaned
   `.tmp.*` cleanup, and a SIGTERM handler that performs one final
-  synchronous save before exit (TPU-pod preemption sends SIGTERM).
+  synchronous save before exit (TPU-pod preemption sends SIGTERM);
+* `CheckpointCoordinator` turns multi-host saves into a two-phase
+  coordinated commit over the TCPStore — every host publishes step N or
+  none does, so `latest_valid` can never disagree across the fleet — and
+  `negotiate_resume` picks the newest step committed on EVERY host at
+  restart (the elastic supervisors re-enter `fit(resume=...)` with it).
 
 Every save/load/skip/GC event lands in the metrics registry so recovery is
 visible in the prometheus/JSON snapshot.
@@ -64,6 +69,17 @@ _M_RESHARD_FALLBACK = _REG.counter(
     "arrays whose saved sharding could not be applied and were replicated")
 _M_SAVE_SECONDS = _REG.histogram("checkpoint_save_seconds",
                                  "wall time of checkpoint writes")
+_M_BARRIER_WAIT = _REG.histogram(
+    "ckpt_barrier_wait_seconds",
+    "time spent waiting for every host to prepare a coordinated checkpoint")
+_M_BARRIER_ABORTS = _REG.counter(
+    "ckpt_barrier_aborts_total",
+    "coordinated checkpoint rounds aborted (no host published a final "
+    "file), labeled by reason: timeout / peer_abort / error")
+_M_BARRIER_COMMITS = _REG.counter(
+    "ckpt_barrier_commits_total",
+    "coordinated checkpoint commits (this host renamed tmp -> final after "
+    "all hosts prepared)")
 
 _pending_saves: list = []
 _save_errors: list = []
@@ -150,6 +166,12 @@ def _decode(path: str, data: bytes) -> dict:
     return blob
 
 
+def _encode_snapshot(host_state, specs: Dict[str, tuple]) -> bytes:
+    """The one place the on-disk blob layout is defined — both the plain
+    and the coordinated save paths write exactly this."""
+    return _encode({"state": host_state, "specs": specs, "version": 2})
+
+
 def save(state: Any, path: str, async_save: bool = False):
     """Checkpoint a pytree of arrays/Tensors with sharding metadata."""
     specs: Dict[str, tuple] = {}
@@ -157,8 +179,7 @@ def save(state: Any, path: str, async_save: bool = False):
 
     def write():
         t0 = time.perf_counter()
-        _atomic_write(path, _encode({"state": host_state, "specs": specs,
-                                     "version": 2}))
+        _atomic_write(path, _encode_snapshot(host_state, specs))
         if _metrics_mod.enabled():
             _M_SAVES.inc()
             _M_SAVE_SECONDS.observe(time.perf_counter() - t0)
@@ -340,6 +361,268 @@ def cleanup_tmp(dirname: str, prefix: str = "ckpt") -> int:
     return removed
 
 
+class CheckpointCoordinator:
+    """Two-phase coordinated commit over a TCPStore: all hosts publish
+    step N, or none do.
+
+    Protocol (per step, every host):
+
+    1. **prepare** — write the full CRC'd payload to ``<final>.tmp.prep``
+       (durable, fsync'd; invisible to ``latest_valid``/``_step_files``).
+    2. **commit** — publish a per-host "prepared" key, wait until all
+       ``world_size`` hosts have published (bounded by ``timeout``), then
+       atomically rename tmp -> final (the last in-phase step). A host that
+       times out — or fails anywhere in the commit phase — publishes an
+       abort flag instead, which every other host's wait loop observes, so
+       the whole fleet drops its tmp and nobody publishes a final file.
+
+    The fault site ``ckpt.commit`` sits at the top of the commit phase: a
+    host killed there has a durable tmp but never voted, so its peers time
+    out and abort — the exact "died between prepare and commit" failure.
+
+    Residual window (two-generals): a host that dies AFTER the barrier
+    opened but BEFORE its own rename leaves peers that already renamed.
+    ``negotiate_resume`` closes it at restart: every host publishes its
+    newest locally-committed step and the fleet resumes from the minimum —
+    the newest step committed *everywhere* — never the lexically newest
+    file of any single host.
+
+    Keys are namespaced by ``PADDLE_TPU_ELASTIC_RESTART_NUM`` (exported by
+    the elastic supervisors) so a restarted generation's rounds can never
+    collide with stale prepare/abort flags from the incarnation that died.
+    Within a generation every ``commit()`` call additionally consumes a
+    monotonically increasing round id (hosts call ``commit`` in lockstep —
+    the same save sequence on every host, like ``negotiate_resume``), so a
+    re-used *step number* (an epoch-end save followed by a SIGTERM
+    preemption save before the next step, or a step retried after an
+    aborted round) gets a fresh barrier instead of being decided by the
+    previous round's stale votes or abort flag.
+    Give the coordinator its own store client connection: the native store
+    client is a single socket and is not thread-safe across subsystems.
+
+    Every host MUST use its own checkpoint directory: the barrier
+    coordinates *steps*, not storage. Hosts sharing one directory (NFS)
+    would clobber each other's fixed-name ``.tmp.prep``, race the final
+    rename, and GC each other's in-flight tmps — a shared-storage backend
+    (orbax/tensorstore) is the ROADMAP follow-up for that topology.
+    """
+
+    def __init__(self, store, rank: int, world_size: int,
+                 timeout: Optional[float] = None,
+                 resume_timeout: Optional[float] = None,
+                 namespace: Optional[str] = None,
+                 poll_interval: float = 0.05):
+        if world_size < 2:
+            raise ValueError("CheckpointCoordinator needs world_size >= 2; "
+                             "single-host saves do not barrier")
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        if timeout is None:
+            timeout = float(os.environ.get(
+                "PADDLE_TPU_CKPT_BARRIER_TIMEOUT", 60.0))
+        self.timeout = float(timeout)
+        if resume_timeout is None:
+            resume_timeout = float(os.environ.get(
+                "PADDLE_TPU_CKPT_RESUME_TIMEOUT",
+                max(self.timeout, 120.0)))
+        # resume negotiation tolerates much more skew than a save barrier:
+        # restarted hosts arrive staggered by backoff + process startup +
+        # jit warmup, while mid-training saves are lockstep
+        self.resume_timeout = float(resume_timeout)
+        if namespace is None:
+            namespace = "ckptbar/" + os.environ.get(
+                "PADDLE_TPU_ELASTIC_RESTART_NUM", "0")
+        self.namespace = namespace
+        self.poll_interval = float(poll_interval)
+        self._resume_round = 0
+        self._commit_round = 0
+
+    def _k(self, *parts) -> str:
+        return "/".join((self.namespace,) + tuple(str(p) for p in parts))
+
+    def _wait_keys(self, keys, deadline: float,
+                   abort_key: Optional[str] = None) -> str:
+        """Poll until every key exists -> 'ok'; abort flag -> 'abort';
+        deadline -> 'timeout'."""
+        missing = list(keys)
+        while True:
+            if abort_key is not None and self.store.check(abort_key):
+                return "abort"
+            missing = [k for k in missing if not self.store.check(k)]
+            if not missing:
+                return "ok"
+            if time.time() >= deadline:
+                return "timeout"
+            time.sleep(self.poll_interval)
+
+    def mark_abort(self, step: int, reason: str,
+                   round_id: Optional[int] = None):
+        """Publish the abort flag for `step` (best effort) and count it.
+        `round_id` defaults to the round the NEXT local `commit()` would
+        run — the right value for a host poisoning a round it has not
+        entered itself (commit passes its own round explicitly)."""
+        if round_id is None:
+            round_id = self._commit_round
+        try:
+            self.store.set(self._k("abort", int(round_id), int(step)), reason)
+        except Exception:
+            pass  # store gone: peers will hit their own timeout
+        if _metrics_mod.enabled():
+            _M_BARRIER_ABORTS.inc(reason=reason)
+
+    def abort_next_round(self, step: int, reason: str = "error"):
+        """Poison and CONSUME the round this host would run for `step` —
+        for failures BEFORE commit() was entered (prepare-phase errors).
+        Peers already in commit() for this step observe a prompt abort
+        instead of burning the barrier timeout, and if this host survives
+        and keeps training its round counter stays lockstep with the
+        fleet's (otherwise every later save would land on a stale round)."""
+        round_id = self._commit_round
+        self._commit_round += 1
+        self.mark_abort(step, reason, round_id)
+
+    def commit(self, step: int, publish_fn: Callable[[], None]) -> bool:
+        """Run the commit phase for `step`; `publish_fn` performs the local
+        atomic rename. True = committed everywhere we can observe; False =
+        aborted (caller must GC its tmp). Raises whatever `publish_fn` or
+        the store raises after flagging the abort for the peers."""
+        from ..fault import site as _fault_site
+        step = int(step)
+        # one round id per commit() call, consumed even on abort — hosts
+        # run the same save sequence, so a re-used step number can never
+        # see a previous round's votes or abort flag
+        round_id = self._commit_round
+        self._commit_round += 1
+        abort_key = self._k("abort", round_id, step)
+        try:
+            # a kill injected here (host dies between prepare and commit)
+            # has a durable tmp but never votes NOR flags: peers time out
+            # and abort, and no final file appears anywhere. A non-fatal
+            # failure anywhere in the phase flags the abort below so peers
+            # observe a prompt peer_abort instead of burning the timeout.
+            _fault_site("ckpt.commit")
+            self.store.set(self._k("prep", round_id, step, self.rank), "1")
+            prep_keys = [self._k("prep", round_id, step, r)
+                         for r in range(self.world_size)]
+            t0 = time.perf_counter()
+            outcome = self._wait_keys(prep_keys, time.time() + self.timeout,
+                                      abort_key)
+            if _metrics_mod.enabled():
+                _M_BARRIER_WAIT.observe(time.perf_counter() - t0)
+            if outcome != "ok":
+                reason = "peer_abort" if outcome == "abort" else "timeout"
+                self.mark_abort(step, reason, round_id)
+                return False
+            if self.store.check(abort_key):
+                # a slower host timed out after we saw all votes: honor it
+                self.mark_abort(step, "peer_abort", round_id)
+                return False
+            # publish_fn is the LAST in-phase operation: anything after the
+            # rename that could fail would mark_abort a round this host has
+            # already committed on disk — peers would GC their prepared
+            # tmps and the fleet's newest-committed steps would diverge
+            publish_fn()
+        except BaseException:
+            self.mark_abort(step, "error", round_id)
+            raise
+        if _metrics_mod.enabled():
+            _M_BARRIER_COMMITS.inc()
+        return True
+
+    def negotiate_resume(self, local_step: Optional[int]) -> Optional[int]:
+        """Fleet agreement on the resume step: publish this host's newest
+        locally-valid committed step, wait for every host, return the
+        minimum — the newest step that exists on ALL hosts. Returns None
+        (fresh start) when any host has nothing. Hosts must call this in
+        lockstep (same number of times per generation).
+
+        Consistency over availability: a wait timeout poisons the round
+        (abort flag) and RAISES. Falling back to the local step here would
+        split-brain the fleet — a peer arriving just past the deadline
+        finds every key present, resumes the fleet minimum, and trains
+        against this host's different parameters with no error anywhere.
+        A fleet that cannot assemble within the deadline cannot train
+        (collectives need every host), so failing loudly and letting the
+        elastic supervisor's budget drive relaunch is strictly safer."""
+        self._resume_round += 1
+        abort_key = self._k("resume_abort", self._resume_round)
+        mine = -1 if local_step is None else int(local_step)
+        self.store.set(self._k("resume", self._resume_round, self.rank),
+                       str(mine))
+        keys = [self._k("resume", self._resume_round, r)
+                for r in range(self.world_size)]
+        outcome = self._wait_keys(keys, time.time() + self.resume_timeout,
+                                  abort_key)
+        if outcome != "ok" or self.store.check(abort_key):
+            try:
+                self.store.set(abort_key, "timeout")
+            except Exception:
+                pass  # store gone: peers hit their own timeout
+            raise RuntimeError(
+                f"checkpoint resume negotiation "
+                f"{'abandoned by a peer' if outcome == 'abort' else 'timed out'}"
+                f" after {self.resume_timeout}s waiting for "
+                f"{self.world_size} hosts (rank {self.rank}); refusing to "
+                f"fall back to a local step — peers that did assemble "
+                f"would resume a different one. Relaunch the fleet "
+                f"together (the elastic supervisor does this).")
+        steps = [int(self.store.get(k).decode()) for k in keys]
+        if any(s < 0 for s in steps):
+            return None
+        return min(steps)
+
+
+def coordinator_from_env(timeout: Optional[float] = None,
+                         resume_timeout: Optional[float] = None
+                         ) -> Optional[CheckpointCoordinator]:
+    """Build a CheckpointCoordinator from the standard trainer env contract
+    (PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID / MASTER_ADDR / MASTER_PORT —
+    what `paddle_tpu.distributed.launch` and `tools/elastic_run.py` export),
+    or None for single-host jobs / when `PADDLE_TPU_CKPT_BARRIER=0`.
+
+    Opens its OWN store client connection — the native client is one socket
+    and the barrier must not interleave frames with init_parallel_env's
+    rendezvous traffic."""
+    if os.environ.get("PADDLE_TPU_CKPT_BARRIER", "1") == "0":
+        return None
+    try:
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    except ValueError:
+        return None
+    if world < 2 or not os.environ.get("MASTER_ADDR") \
+            or not os.environ.get("MASTER_PORT"):
+        return None
+    try:
+        port = int(os.environ["MASTER_PORT"])
+    except ValueError:
+        # NOT a silent degrade: PADDLE_TRAINERS_NUM says this host is part
+        # of a >=2 fleet, so quietly returning None would disable the
+        # checkpoint barrier on this host alone while its peers wait on it
+        raise ValueError(
+            f"MASTER_PORT={os.environ['MASTER_PORT']!r} is not a port "
+            f"number but PADDLE_TRAINERS_NUM={world} expects a coordinated "
+            f"fleet; fix the launcher env (tools/elastic_run.py exports it) "
+            f"or set PADDLE_TPU_CKPT_BARRIER=0 to opt out of the barrier")
+    try:
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+    except (KeyError, ValueError):
+        # defaulting to rank 0 here would have EVERY host of the fleet
+        # publish prepare votes as rank 0 and wait forever for the others:
+        # each coordinated save burns the barrier timeout with no message
+        # naming the real cause
+        raise ValueError(
+            f"PADDLE_TRAINER_ID={os.environ.get('PADDLE_TRAINER_ID')!r} "
+            f"but PADDLE_TRAINERS_NUM={world} expects a coordinated fleet; "
+            f"every host needs a distinct rank (tools/elastic_run.py "
+            f"exports it from --rank) or set PADDLE_TPU_CKPT_BARRIER=0 to "
+            f"opt out of the barrier")
+    from .store import TCPStore
+    store = TCPStore(os.environ["MASTER_ADDR"], port, is_master=False)
+    return CheckpointCoordinator(store, rank, world, timeout=timeout,
+                                 resume_timeout=resume_timeout)
+
+
 class CheckpointManager:
     """Stepped checkpoints with GC, corruption-tolerant resume, and a
     preemption hook.
@@ -356,15 +639,31 @@ class CheckpointManager:
 
     def __init__(self, dirname: str, prefix: str = "ckpt",
                  keep_last_n: int = 5, async_save: bool = False,
-                 mesh=None):
+                 mesh=None, coordinator: Optional[CheckpointCoordinator] = None,
+                 store=None, rank: int = 0, world_size: int = 1,
+                 barrier_timeout: Optional[float] = None):
         self.dirname = str(dirname)
         self.prefix = prefix
         self.keep_last_n = max(1, int(keep_last_n))
         self.async_save = async_save
         self.mesh = mesh
+        if coordinator is None and store is not None and int(world_size) > 1:
+            coordinator = CheckpointCoordinator(store, rank, world_size,
+                                                timeout=barrier_timeout)
+        # world_size == 1 degrades to the plain local save — no barrier
+        self.coordinator = coordinator
+        if coordinator is not None and self.keep_last_n < 2:
+            # one step of commit skew between hosts is inherent to the
+            # two-generals window: a host that renamed step N just before
+            # the fleet died negotiates resume at N-1 (the fleet minimum),
+            # and with keep_last_n=1 its own GC already deleted N-1 — the
+            # agreed step would be unreadable here and every relaunch
+            # would raise until the restart budget wedged the job
+            self.keep_last_n = 2
         self._prev_sigterm = None
         self._preempt_state_fn: Optional[Callable[[], Any]] = None
         self._last_step: Optional[int] = None
+        self._save_in_flight = False
         os.makedirs(self.dirname, exist_ok=True)
         if not _pending_saves:  # crashed predecessors only — never a tmp
             cleanup_tmp(self.dirname, self.prefix)  # still being written
@@ -375,10 +674,85 @@ class CheckpointManager:
     def steps(self) -> List[int]:
         return [s for s, _ in _step_files(self.dirname, self.prefix)]
 
-    def save(self, state: Any, step: int):
-        save(state, self.path_for(step), async_save=self.async_save)
+    def save(self, state: Any, step: int) -> bool:
+        """Publish one checkpoint. Coordinated two-phase commit when a
+        coordinator is configured (multi-host), plain atomic save
+        otherwise. Returns False when a coordinated round aborted (the
+        checkpoint was skipped fleet-wide); training should continue."""
+        if self.coordinator is not None:
+            committed = self._save_coordinated(state, step)
+        else:
+            save(state, self.path_for(step), async_save=self.async_save)
+            committed = True
         self._last_step = int(step)
         self.gc()
+        return committed
+
+    def _save_coordinated(self, state: Any, step: int) -> bool:
+        """Two-phase commit of step N: durable tmp (prepare), then the
+        coordinator's all-or-nothing rename (commit). Always synchronous —
+        a barrier over a background write would publish a file the fleet
+        already voted on while this host could still fail the write."""
+        # the in-flight flag covers the WHOLE save, prepare included: a
+        # SIGTERM during _to_host/tmp-write/fsync (the longest phase of a
+        # multi-GB save) re-entering a nested coordinated save would
+        # consume a round id peers spend on a different step
+        self._save_in_flight = True
+        try:
+            final = self.path_for(step)
+            tmp = final + ".tmp.prep"
+            try:
+                t0 = time.perf_counter()
+                specs: Dict[str, tuple] = {}
+                host_state = _to_host(state, specs)
+                with open(tmp, "wb") as f:
+                    f.write(_encode_snapshot(host_state, specs))
+                    f.flush()
+                    os.fsync(f.fileno())
+            except BaseException:
+                # prepare failed (disk full, SIGTERM-driven SystemExit, …):
+                # poison + consume this host's round so peers abort
+                # promptly instead of burning the barrier timeout, and so
+                # a caller that survives and keeps training stays round-
+                # lockstep with the fleet
+                self.coordinator.abort_next_round(step)
+                self._rm_quiet(tmp)
+                raise
+            # write time only — the commit wait is already measured by
+            # ckpt_barrier_wait_seconds, and folding a slow peer's 60s
+            # barrier into checkpoint_save_seconds would misread skew as
+            # an I/O cost
+            write_secs = time.perf_counter() - t0
+            try:
+                committed = self.coordinator.commit(
+                    step, lambda: os.replace(tmp, final))
+            except BaseException:
+                # commit() already flagged the abort for the peers (unless
+                # the process was killed outright); here just drop the tmp
+                # and surface the error
+                self._rm_quiet(tmp)
+                raise
+            if not committed:
+                self._rm_quiet(tmp)
+                warnings.warn(
+                    f"coordinated checkpoint step {int(step)} aborted — "
+                    f"not every host prepared in time; no host published a "
+                    f"final file for this step (see "
+                    f"ckpt_barrier_aborts_total)")
+                return False
+            if _metrics_mod.enabled():
+                _M_SAVES.inc()
+                _M_SAVE_SECONDS.observe(write_secs)
+            return True
+        finally:
+            self._save_in_flight = False
+
+    @staticmethod
+    def _rm_quiet(path: str):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
 
     def gc(self) -> int:
         """Keep the newest `keep_last_n` checkpoints; drop the rest and any
@@ -404,39 +778,127 @@ class CheckpointManager:
             wait_all()  # a half-written newest file must finish publishing
         return latest_valid(self.dirname, self.prefix)
 
+    def _local_latest_valid(self) -> Tuple[Optional[int], Optional[dict]]:
+        """(step, decoded blob) of the newest locally-valid checkpoint, or
+        (None, None). Decodes rather than just CRC-verifying: the agreed
+        resume step is almost always this file, and re-reading a multi-GB
+        blob after negotiation would double restore I/O on the
+        preemption-recovery critical path."""
+        for step, path in _step_files(self.dirname, self.prefix):
+            try:
+                with open(path, "rb") as f:
+                    return step, _decode(path, f.read())
+            except (OSError, CheckpointCorruptError) as e:
+                warnings.warn(f"skipping corrupt checkpoint {path}: {e}")
+                if _metrics_mod.enabled():
+                    _M_CORRUPT.inc()
+        return None, None
+
     def load_latest(self) -> Optional[Tuple[Any, int]]:
-        """(state, step) from the newest VALID checkpoint, or None."""
+        """(state, step) from the newest VALID checkpoint, or None.
+
+        Coordinated managers negotiate first: the fleet resumes from the
+        newest step committed on EVERY host (the barrier-committed step),
+        never this host's lexically-newest file — a host that renamed just
+        before the fleet died may be one step ahead of its peers."""
         # drain in-process async saves unconditionally: THIS manager may be
         # sync while another writer (a prior fit's callback) is still
         # publishing into the same directory
         wait_all()
+        if self.coordinator is not None:
+            local_step, local_blob = self._local_latest_valid()
+            agreed = self.coordinator.negotiate_resume(local_step)
+            if agreed is None:
+                return None
+            if agreed == local_step:
+                blob = local_blob  # already read + CRC'd: don't re-read
+            else:
+                blob = self._read_agreed(agreed)
+            if _metrics_mod.enabled():
+                _M_LOADS.inc()
+            return (_apply_shardings(blob["state"], blob.get("specs", {}),
+                                     self.mesh), agreed)
         found = load_latest_valid(self.dirname, self.prefix, mesh=self.mesh)
         if found is None:
             return None
         state, step, _ = found
         return state, step
 
+    def _read_agreed(self, agreed: int) -> dict:
+        """Read the fleet-agreed resume step when it is NOT this host's
+        newest valid file (a peer was behind)."""
+        path = self.path_for(agreed)
+        try:
+            with open(path, "rb") as f:
+                return _decode(path, f.read())
+        except (OSError, CheckpointCorruptError) as e:
+            # do NOT fall back locally: peers are restoring the agreed
+            # step, so a silent fresh start (or an older local step)
+            # would resume this host with divergent parameters that
+            # data-parallel all_reduce then averages into the run.
+            # Failing loudly names the file so an operator can restore
+            # or delete it fleet-wide.
+            if _metrics_mod.enabled():
+                _M_CORRUPT.inc()
+            raise CheckpointCorruptError(
+                path,
+                f"fleet-agreed resume step {agreed} is unreadable on "
+                f"this host ({e}); refusing to diverge from peers that "
+                f"can read it") from e
+
+    def _publish_sync(self, state: Any, step: int) -> bool:
+        """One synchronous publish through the configured path: the
+        coordinated two-phase commit when a coordinator is present (TPU-pod
+        preemption SIGTERMs every host at once, so the fleet barriers the
+        final save too), plain local save when world_size == 1."""
+        if self.coordinator is not None:
+            return self._save_coordinated(state, step)
+        save(state, self.path_for(step), async_save=False)
+        return True
+
     # -- preemption ---------------------------------------------------------
     def install_preemption_handler(self, state_fn: Callable[[], Any],
                                    step_fn: Optional[Callable[[], int]] = None):
         """On SIGTERM (the TPU-pod preemption signal) perform ONE final
         synchronous save of `state_fn()` at step `step_fn()` before exiting.
-        Chains any previously installed handler; without one, exits 143."""
+        Routes through the coordinated barrier when configured. Chains any
+        previously installed handler; without one, exits 143."""
         self._preempt_state_fn = state_fn
         self._preempt_step_fn = step_fn
 
         def handler(signum, frame):
-            try:
-                step = step_fn() if step_fn is not None else \
-                    (self._last_step or 0) + 1
-                # synchronous even if the manager is async: the process is
-                # about to die, a background thread would be reaped mid-write
-                save(state_fn(), self.path_for(step), async_save=False)
-                self._last_step = int(step)
-                if _metrics_mod.enabled():
-                    _M_PREEMPT.inc()
-            except Exception as e:
-                warnings.warn(f"preemption save failed: {e}")
+            if self.coordinator is not None and self._save_in_flight:
+                # SIGTERM landed INSIDE an in-flight coordinated save (the
+                # handler runs on the main thread, interrupting commit()'s
+                # wait loop): re-entering commit() here would consume a
+                # second round id mid-round while peers not mid-save run
+                # their preemption round at the old one — mismatched
+                # rounds, every host burning the full barrier timeout in
+                # its preemption grace period. Skip the extra save: the
+                # SystemExit below unwinds through the in-flight save
+                # (prepare or commit phase alike), which flags a PROMPT
+                # abort for the peers, and the fleet resumes from the
+                # newest fully-committed step.
+                warnings.warn("preemption during an in-flight coordinated "
+                              "save: skipping the final preemption save "
+                              "(resume uses the newest committed step)")
+            else:
+                try:
+                    step = step_fn() if step_fn is not None else \
+                        (self._last_step or 0) + 1
+                    # synchronous even if the manager is async: the process
+                    # is about to die, a background thread would be reaped
+                    # mid-write
+                    if self._publish_sync(state_fn(), step):
+                        # only a COMMITTED save counts: an aborted barrier
+                        # round published nothing anywhere, and reporting
+                        # it would send the operator hunting for a step-N
+                        # file that never existed
+                        self._last_step = int(step)
+                        if _metrics_mod.enabled():
+                            _M_PREEMPT.inc()
+                except Exception as e:
+                    warnings.warn(f"preemption save failed: {e}")
             prev = self._prev_sigterm
             if callable(prev):
                 prev(signum, frame)

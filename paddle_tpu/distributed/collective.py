@@ -22,6 +22,8 @@ the same mesh span hosts; nothing here changes — the mesh is the cluster.
 """
 from __future__ import annotations
 
+import os
+import threading
 from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -44,6 +46,42 @@ _M_COLL_BYTES = _REG.counter(
     "collective_bytes_total",
     "estimated per-device bytes moved by eager collectives, attributed to "
     "the slowest link the group's mesh axes cross (cluster-mapper pricing)")
+_M_COLL_TIMEOUT = _REG.counter(
+    "collective_timeout_total",
+    "eager collectives that exceeded the deadline (or hit the armed "
+    "collective.timeout fault site), by kind and group")
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """An eager collective exceeded its deadline instead of completing.
+
+    Raised (instead of hanging) when `PADDLE_TPU_COLLECTIVE_TIMEOUT` is set
+    and the launch+completion of an eager collective outlives it — the
+    classic symptom of a peer host that died mid-rendezvous — or when the
+    `collective.timeout` fault site is armed (chaos testing). Names the
+    group and this process's rank so the stuck member is identifiable from
+    any host's log.
+
+    Recovery contract: restart the PROCESS (the supervisor's `supervise`
+    argv mode), not just the train loop. Python cannot cancel the
+    abandoned watchdog thread, and if the fleet was slow rather than dead
+    its collective can still complete later — re-entering training in the
+    same process (`ElasticSupervisor.run`) risks that stale completion
+    interleaving an unmatched collective into the next generation and
+    desyncing cross-rank ordering."""
+
+    def __init__(self, kind: str, group: "Group", rank: int,
+                 timeout: float, detail: str = ""):
+        msg = (f"collective {kind!r} over group {group.name!r} "
+               f"(axes {group.axis_names}, {group.nranks} ranks) "
+               f"did not complete within {timeout:g}s on process rank {rank}")
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+        self.kind = kind
+        self.group_name = group.name
+        self.rank = rank
+        self.timeout = timeout
 
 
 class ReduceOp:
@@ -185,13 +223,93 @@ def _spec_of(arr, mesh) -> P:
     return P()
 
 
-def _eager(group: Group, fn, *arrs, out_specs=None):
+def _proc_rank() -> int:
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def _deadline_seconds() -> float:
+    """0 = guard disabled (the default: zero overhead, unchanged async
+    dispatch). Set `PADDLE_TPU_COLLECTIVE_TIMEOUT` (seconds) to bound every
+    eager collective: launch + completion run on a watchdog thread and a
+    blown deadline raises CollectiveTimeoutError instead of hanging.
+
+    The deadline covers the WHOLE thunk — including shard_map tracing and
+    XLA compilation the first time a shape is seen — so size it to cover a
+    cold-start compile (tens of seconds on a pod), not just the wire time:
+    a too-tight value turns a healthy first-step compile into a false
+    dead-peer diagnosis that burns an elastic restart."""
+    raw = os.environ.get("PADDLE_TPU_COLLECTIVE_TIMEOUT", "")
+    try:
+        return float(raw) if raw else 0.0
+    except ValueError:
+        return 0.0
+
+
+def _timed_out(kind: str, group: Group):
+    if _metrics_mod.enabled():
+        _M_COLL_TIMEOUT.inc(kind=kind, group=group.name)
+
+
+def _guard_collective(kind: str, group: Group, thunk):
+    """Run one eager collective under the timeout contract.
+
+    Only the EAGER entry points funnel through here — traced/SPMD
+    collectives execute inside compiled programs where XLA owns scheduling
+    (a hang there surfaces via the runtime's own deadline, not Python).
+    The `collective.timeout` fault site lets chaos tests simulate the hang
+    without a real dead peer."""
+    from ..fault import InjectedFault, InjectedIOError, site as _fault_site
+    try:
+        _fault_site("collective.timeout")
+    except (TimeoutError, InjectedFault, InjectedIOError) as e:
+        # every injected kind at this site models the same thing — a hung
+        # collective — so the bare spec `collective.timeout=1` (default
+        # kind=error) must surface as the typed timeout too, not escape as
+        # a raw InjectedFault that skips the metric
+        _timed_out(kind, group)
+        raise CollectiveTimeoutError(kind, group, _proc_rank(), 0.0,
+                                     detail="injected fault") from e
+    timeout = _deadline_seconds()
+    if timeout <= 0:
+        return thunk()
+    box: dict = {}
+
+    def run():
+        try:
+            r = thunk()
+            jax.block_until_ready(r)  # deadline covers completion, not
+            box["v"] = r              # just the async enqueue
+        except BaseException as e:
+            box["e"] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"collective-{kind}-watchdog")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        # the daemon thread is abandoned, not cancelled (Python can't), so
+        # a slow-but-alive fleet may still complete this collective later:
+        # recover by restarting the process, not the loop — see the
+        # CollectiveTimeoutError docstring
+        _timed_out(kind, group)
+        raise CollectiveTimeoutError(kind, group, _proc_rank(), timeout)
+    if "e" in box:
+        raise box["e"]
+    return box["v"]
+
+
+def _eager(group: Group, fn, *arrs, out_specs=None, kind: str = "collective"):
     """Run `fn` (which uses lax collectives over group.axis) via shard_map."""
     in_specs = tuple(_spec_of(a, group.mesh) for a in arrs)
     if out_specs is None:
         out_specs = in_specs[0]
-    return shard_map(fn, mesh=group.mesh, in_specs=in_specs,
-                     out_specs=out_specs, check_vma=False)(*arrs)
+    return _guard_collective(
+        kind, group,
+        lambda: shard_map(fn, mesh=group.mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)(*arrs))
 
 
 def _group_link(g: Group) -> str:
@@ -251,7 +369,7 @@ def _account(kind: str, group: Group, *arrs):
 
 def _eager_acct(kind: str, group: Group, fn, *arrs, out_specs=None):
     _account(kind, group, *arrs)
-    return _eager(group, fn, *arrs, out_specs=out_specs)
+    return _eager(group, fn, *arrs, out_specs=out_specs, kind=kind)
 
 
 def _wrap_like(t, arr):
@@ -459,7 +577,8 @@ def barrier(group=None):
     """Device barrier: a tiny psum forces a sync point."""
     g = _resolve(group)
     x = jnp.zeros((), jnp.float32)
-    _eager(g, lambda a: lax.psum(a, g.axis), x).block_until_ready()
+    _eager(g, lambda a: lax.psum(a, g.axis), x,
+           kind="barrier").block_until_ready()
 
 
 def wait(tensor, group=None, use_calc_stream=True):

@@ -12,16 +12,40 @@ each node heartbeats `beat/<host_id>` with a timestamp; the manager derives
 alive membership from heartbeat age (the TTL lease). The launcher's
 elastic_level>0 restart loop (`launch/main.py`) plays the reference
 controller's role; `ElasticManager.watch()` is the membership change signal.
+
+`ElasticSupervisor` closes the loop the reference leaves to operators: a
+per-host supervisor that relaunches the trainer on crash / explicit
+`ELASTIC_EXIT_CODE` / membership shrink (watch() → RESTART), with a bounded
+restart budget and exponential backoff, exporting
+`PADDLE_TPU_ELASTIC_RESTART_NUM` so the coordinated-checkpoint barrier
+(`distributed/checkpoint.CheckpointCoordinator`) namespaces each generation
+and the relaunched `Model.fit(resume=...)` re-enters without operator glue.
+`tools/elastic_run.py` is the CLI face.
 """
 from __future__ import annotations
 
 import os
+import signal
 import threading
 import time
-from typing import Dict, List, Optional
+import warnings
+from typing import Dict, List, Optional, Sequence
+
+from ...profiler import metrics as _metrics_mod
 
 ELASTIC_EXIT_CODE = 101
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+#: exported to every trainer generation; the coordinated-checkpoint barrier
+#: namespaces its store keys by this so a restarted fleet can never collide
+#: with prepare/abort flags left by the incarnation that died
+RESTART_NUM_ENV = "PADDLE_TPU_ELASTIC_RESTART_NUM"
+
+_REG = _metrics_mod.default_registry()
+_M_RESTARTS = _REG.counter(
+    "elastic_restarts_total",
+    "trainer relaunches performed by the elastic supervisor, labeled by "
+    "reason: failure / restart_requested / membership")
 
 
 class ElasticStatus:
@@ -144,7 +168,37 @@ class ElasticManager:
             slot = self._store.add("member_count", 1) - 1
         self._store.set(f"member/{slot}", self.host_id)
         self._slot = slot
+        self._clear_done()
         self.register()
+
+    # -- completion flags (supervisor watch) -------------------------------
+    # A host whose training FINISHED stops heartbeating too; without a
+    # completion flag a peer's supervisor could not tell "done" from "dead"
+    # and would restart its own healthy trainer at job end.
+    def mark_done(self, host_id: Optional[str] = None):
+        """Publish that `host_id`'s (default: this manager's own) work
+        completed cleanly — beats may stop without peers treating the
+        silence as a failure. A supervisor passes its child's member id:
+        it observes the clean exit, while most trainers never call this
+        themselves."""
+        try:
+            self._store.set(f"done/{host_id or self.host_id}", "1")
+        except Exception:
+            pass  # store gone: job is tearing down anyway
+
+    def is_done(self, host_id: str) -> bool:
+        try:
+            return bool(self._store.check(f"done/{host_id}"))
+        except Exception:
+            return False
+
+    def _clear_done(self):
+        # a REJOINING host (new generation after restart) is not done
+        try:
+            if self._store.check(f"done/{self.host_id}"):
+                self._store.delete_key(f"done/{self.host_id}")
+        except Exception:
+            pass
 
     # -- watching (reference manager.watch:126) ----------------------------
     def watch(self, timeout: Optional[float] = None) -> str:
@@ -162,6 +216,18 @@ class ElasticManager:
                 return ElasticStatus.RESTART
             if deadline is not None and time.time() >= deadline:
                 return ElasticStatus.COMPLETED
+
+    def abandon(self):
+        """Stop heartbeating WITHOUT deregistering. For a supervisor whose
+        restart budget died: the member stays registered while its beat
+        goes stale, so every peer's membership watch detects the dead host.
+        `exit()` here instead would tombstone the slot — the member list
+        shrinks below `np`, peers' watches read it as 'fleet never
+        assembled', and the death becomes invisible (peers hang in
+        collectives/barriers instead of restarting)."""
+        self._stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=2)
 
     def exit(self, completed: bool = True):
         self._stop.set()
@@ -190,5 +256,249 @@ class ElasticManager:
         raise SystemExit(ELASTIC_EXIT_CODE)
 
 
-__all__ = ["ElasticManager", "ElasticStatus", "ELASTIC_EXIT_CODE",
-           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+class RestartBudgetExceeded(RuntimeError):
+    """The elastic supervisor exhausted its restart budget."""
+
+    def __init__(self, restarts: int, budget: int, last_reason: str):
+        super().__init__(
+            f"elastic restart budget exhausted: {restarts} restarts "
+            f"(budget {budget}), last failure reason: {last_reason}")
+        self.restarts = restarts
+        self.budget = budget
+        self.last_reason = last_reason
+
+
+class ElasticSupervisor:
+    """Per-host auto-restart loop: crash / `ELASTIC_EXIT_CODE` / membership
+    shrink → backoff → relaunch, with a bounded budget.
+
+    Two modes:
+
+    * ``run(train_fn)`` — in-process: call `train_fn` (which should end in
+      `Model.fit(resume=ckpt_dir)` so every generation restores from the
+      newest fleet-committed checkpoint); a raised exception or
+      `SystemExit(ELASTIC_EXIT_CODE)` consumes one restart and re-enters.
+    * ``supervise(cmd)`` — subprocess: spawn the trainer command and watch
+      both the child (corpse / exit code) and, when a `manager` is given,
+      fleet membership — a host whose heartbeat goes stale (the reference
+      `watch() → RESTART` signal) SIGTERMs the local trainer (one final
+      coordinated preemption save) and relaunches it, so EVERY host
+      re-enters the same generation and the checkpoint barrier namespaces
+      line up.
+
+    Each generation sees `PADDLE_TPU_ELASTIC_RESTART_NUM` = number of
+    restarts so far (env for subprocesses, os.environ for in-process).
+    Generations are LOCAL counters kept in lockstep by the trainer
+    contract, not shared state: a trainer whose coordinated save aborts
+    must exit `ELASTIC_EXIT_CODE` so every host's supervisor bumps
+    together. A host whose crash+relaunch slips under the heartbeat TTL
+    runs one generation ahead until its peers' next coordinated save
+    times out and aborts (bounded by the barrier timeout), at which point
+    they exit 101 and catch up — a transient stall of at most one aborted
+    save, not a wedge.
+    Knobs: `PADDLE_TPU_ELASTIC_MAX_RESTARTS` (default 3),
+    `PADDLE_TPU_ELASTIC_BACKOFF` (base seconds, default 1.0, doubled per
+    restart), `PADDLE_TPU_ELASTIC_BACKOFF_MAX` (default 30). Every
+    relaunch lands in `elastic_restarts_total{reason=}`.
+    """
+
+    def __init__(self, max_restarts: Optional[int] = None,
+                 backoff: Optional[float] = None,
+                 backoff_max: Optional[float] = None,
+                 manager: Optional[ElasticManager] = None,
+                 poll: float = 0.2, stop_grace: float = 10.0,
+                 self_member: Optional[str] = None):
+        if max_restarts is None:
+            max_restarts = int(os.environ.get(
+                "PADDLE_TPU_ELASTIC_MAX_RESTARTS", 3))
+        if backoff is None:
+            backoff = float(os.environ.get("PADDLE_TPU_ELASTIC_BACKOFF", 1.0))
+        if backoff_max is None:
+            backoff_max = float(os.environ.get(
+                "PADDLE_TPU_ELASTIC_BACKOFF_MAX", 30.0))
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.backoff_max = float(backoff_max)
+        self.manager = manager
+        self.poll = float(poll)
+        self.stop_grace = float(stop_grace)
+        # the member id the LOCAL trainer registers under (the manager
+        # passed here is typically watch-only, under a different id). The
+        # supervisor watches PEERS by heartbeat; its own child it watches
+        # directly by process exit — so the child's id must be excluded
+        # from staleness checks. Otherwise the child's own restart gap
+        # (old process dead, new one still importing) reads as a stale
+        # member the moment the rest of the fleet reassembles, and the
+        # supervisor SIGTERMs its freshly relaunched trainer: generations
+        # desync and every later barrier round times out fleet-wide.
+        self.self_member = self_member
+        self.restarts = 0
+        self.last_reason: Optional[str] = None
+
+    # -- shared restart accounting ------------------------------------------
+    def _consume_restart(self, reason: str) -> bool:
+        """True = budget left (counted + metered); False = exhausted."""
+        self.restarts += 1
+        self.last_reason = reason
+        if self.restarts > self.max_restarts:
+            return False
+        if _metrics_mod.enabled():
+            _M_RESTARTS.inc(reason=reason)
+        warnings.warn(
+            f"elastic supervisor: restarting trainer "
+            f"({self.restarts}/{self.max_restarts}, reason: {reason})")
+        return True
+
+    def _backoff_sleep(self):
+        time.sleep(min(self.backoff * (2 ** max(0, self.restarts - 1)),
+                       self.backoff_max))
+
+    def _publish_done(self):
+        """The local trainer finished cleanly but its heartbeats now stop:
+        publish its done-flag so every PEER's membership watch reads the
+        silence as completion, not death (most trainers never call
+        mark_done() themselves). With self_member unset (in-process mode,
+        where the manager typically IS the trainer's own) the flag lands
+        on the manager's own member id."""
+        if self.manager is not None:
+            self.manager.mark_done(self.self_member)
+
+    # -- in-process mode -----------------------------------------------------
+    def run(self, train_fn):
+        """Call `train_fn` under the restart budget; returns its result.
+        The function should re-enter through `fit(resume=ckpt_dir)` so each
+        generation restores the newest fleet-committed step. In-process
+        mode has no membership watch (that is supervise()'s job); a
+        manager given here is used only to publish the done-flag on clean
+        completion."""
+        base = int(os.environ.get(RESTART_NUM_ENV, "0"))
+        while True:
+            os.environ[RESTART_NUM_ENV] = str(base + self.restarts)
+            err: BaseException
+            try:
+                result = train_fn()
+                self._publish_done()
+                return result
+            except KeyboardInterrupt:
+                raise
+            except SystemExit as e:
+                code = e.code or 0
+                if code == 0:
+                    self._publish_done()
+                    return None
+                reason = "restart_requested" if code == ELASTIC_EXIT_CODE \
+                    else "failure"
+                err = e
+            except Exception as e:
+                reason, err = "failure", e
+            if not self._consume_restart(reason):
+                raise RestartBudgetExceeded(self.restarts - 1,
+                                            self.max_restarts, reason) from err
+            self._backoff_sleep()
+
+    # -- subprocess mode -----------------------------------------------------
+    def supervise(self, cmd: Sequence[str],
+                  env: Optional[Dict[str, str]] = None) -> int:
+        """Spawn `cmd`, relaunching on failure / ELASTIC_EXIT_CODE /
+        membership shrink until it exits 0 or the budget runs out.
+        Returns the final exit code (0 on success)."""
+        import subprocess
+        last_code = 1
+        # honor a pre-existing generation base (an operator relaunching a
+        # dead supervisor while peers are at generation N), same as run():
+        # starting over at 0 would namespace the checkpoint barrier under
+        # stale keys and every coordinated save would time out fleet-wide
+        base = int(os.environ.get(RESTART_NUM_ENV, "0"))
+        while True:
+            child_env = dict(os.environ)
+            child_env.update(env or {})
+            child_env[RESTART_NUM_ENV] = str(base + self.restarts)
+            proc = subprocess.Popen(list(cmd), env=child_env)
+            reason, code = self._wait_child(proc)
+            if reason is None:
+                self._publish_done()
+                return 0
+            last_code = code
+            if not self._consume_restart(reason):
+                return last_code if last_code else 1
+            self._backoff_sleep()
+
+    def _wait_child(self, proc):
+        """(None, 0) on clean exit; else (reason, exit_code). With a
+        manager, a fleet member that is neither alive nor marked done —
+        after the fleet was once fully assembled — triggers a coordinated
+        local restart (SIGTERM the child, return 'membership')."""
+        seen_full = False
+        next_membership = 0.0
+        while True:
+            code = proc.poll()
+            if code is not None:
+                if code == 0:
+                    return None, 0
+                if code == ELASTIC_EXIT_CODE:
+                    return "restart_requested", code
+                return "failure", code
+            if self.manager is not None \
+                    and time.monotonic() >= next_membership:
+                # a membership check costs O(world_size) store RPCs: run
+                # it on the heartbeat cadence (ttl/3), not the fast child
+                # poll, or a large fleet's supervisors drown the one
+                # rendezvous store the checkpoint barrier also polls
+                next_membership = time.monotonic() + max(
+                    getattr(self.manager, "ttl", 10.0) / 3, self.poll)
+                missing = self._missing_members()
+                if missing is not None:
+                    if not missing:
+                        seen_full = True
+                    elif seen_full:
+                        self._stop_child(proc)
+                        return "membership", ELASTIC_EXIT_CODE
+            time.sleep(self.poll)
+
+    def _missing_members(self) -> Optional[List[str]]:
+        """Members that are neither heartbeating nor marked done; None
+        while the fleet has not fully assembled yet (startup grace)."""
+        mgr = self.manager
+        try:
+            ids = mgr._member_ids()
+            if len(ids) < mgr.np:
+                return None
+            alive = set(mgr.alive_members())
+            return [i for i in ids
+                    if i != self.self_member and i not in alive
+                    and not mgr.is_done(i)]
+        except Exception:
+            return None  # store hiccup: never restart on a read failure
+
+    def _stop_child(self, proc):
+        try:
+            proc.send_signal(signal.SIGTERM)
+        except OSError:
+            return
+        deadline = time.time() + self.stop_grace
+        while proc.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def run_elastic(target, *, max_restarts: Optional[int] = None,
+                backoff: Optional[float] = None,
+                manager: Optional[ElasticManager] = None, **kw):
+    """Supervised elastic execution: `target` is either a callable (run
+    in-process; make it end in `Model.fit(resume=ckpt_dir)`) or an argv list
+    (supervised subprocess). Restarts on crash / ELASTIC_EXIT_CODE — plus,
+    in argv mode with a `manager`, fleet-membership shrink — with bounded
+    budget + backoff; each generation sees `PADDLE_TPU_ELASTIC_RESTART_NUM`.
+    Returns the callable's result, or the subprocess's final exit code."""
+    sup = ElasticSupervisor(max_restarts=max_restarts, backoff=backoff,
+                            manager=manager, **kw)
+    if callable(target):
+        return sup.run(target)
+    return sup.supervise(list(target))
+
+
+__all__ = ["ElasticManager", "ElasticStatus", "ElasticSupervisor",
+           "RestartBudgetExceeded", "run_elastic", "ELASTIC_EXIT_CODE",
+           "ELASTIC_AUTO_PARALLEL_EXIT_CODE", "RESTART_NUM_ENV"]

@@ -85,10 +85,16 @@ class TCPStore:
             raise RuntimeError("TCPStore.wait failed")
 
     def check(self, key: str) -> bool:
-        rc = self._lib.store_check(self._h, key.encode())
-        if rc < 0:
-            raise RuntimeError("TCPStore.check failed")
-        return bool(rc)
+        # retried like get/set/add: the coordinated-checkpoint barrier
+        # polls through check(), and a transient master hiccup mid-poll
+        # must cost a backoff, not a fleet-wide checkpoint abort
+        def _do():
+            _fault_site("store.check")
+            rc = self._lib.store_check(self._h, key.encode())
+            if rc < 0:
+                raise RuntimeError(f"TCPStore.check({key!r}) failed")
+            return bool(rc)
+        return self._retry.call(_do, op="store.check")
 
     def delete_key(self, key: str):
         if self._lib.store_delete(self._h, key.encode()) != 0:

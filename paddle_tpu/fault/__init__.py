@@ -15,16 +15,16 @@ training, and asserts from the metrics snapshot that the faults fired and
 were retried/recovered.
 """
 from .inject import (  # noqa: F401
-    SPEC_ENV, FaultInjector, InjectedFault, InjectedIOError, InjectedTimeout,
-    configure, default_injector, reload_spec, reset, site,
+    SPEC_ENV, DeviceOOMError, FaultInjector, InjectedFault, InjectedIOError,
+    InjectedTimeout, configure, default_injector, reload_spec, reset, site,
 )
 from .retry import (  # noqa: F401
     AttemptTimeout, RetryExhaustedError, RetryPolicy, retry_call, retryable,
 )
 
 __all__ = [
-    "AttemptTimeout", "FaultInjector", "InjectedFault", "InjectedIOError",
-    "InjectedTimeout", "RetryExhaustedError", "RetryPolicy", "SPEC_ENV",
-    "configure", "default_injector", "reload_spec", "reset", "retry_call",
-    "retryable", "site",
+    "AttemptTimeout", "DeviceOOMError", "FaultInjector", "InjectedFault",
+    "InjectedIOError", "InjectedTimeout", "RetryExhaustedError",
+    "RetryPolicy", "SPEC_ENV", "configure", "default_injector",
+    "reload_spec", "reset", "retry_call", "retryable", "site",
 ]

@@ -54,6 +54,25 @@ class InjectedIOError(OSError):
     """Raised by an armed fault site (kind=oserror)."""
 
 
+class DeviceOOMError(RuntimeError):
+    """Device memory exhausted (typed detection at the allocator boundary).
+
+    Raised by the eager dispatch when XLA reports RESOURCE_EXHAUSTED / OOM
+    for an op, or when the `device.alloc` fault site is armed — named so
+    callers can catch the OOM specifically (shrink batch, flush caches)
+    instead of pattern-matching XlaRuntimeError strings."""
+
+    def __init__(self, op: str, bytes_estimate: int = 0, detail: str = ""):
+        msg = f"device out of memory in op {op!r}"
+        if bytes_estimate:
+            msg += f" (~{bytes_estimate} bytes touched)"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+        self.op = op
+        self.bytes_estimate = int(bytes_estimate)
+
+
 _KINDS = ("error", "timeout", "oserror", "kill")
 
 
@@ -142,6 +161,13 @@ class FaultInjector:
 
     def site(self, name: str):
         """Declare one occurrence of a fault site; injects if armed."""
+        if not self._rules:
+            # lock-free fast path: sites now sit on per-op hot paths (the
+            # eager dispatch's allocator boundary, collective entry points),
+            # and an unarmed injector must cost one dict truthiness check.
+            # Arming happens-before the faulted call in every supported use
+            # (env spec at import, configure() before the exercised code).
+            return
         with self._lock:
             if not self._rules:
                 return

@@ -137,21 +137,54 @@ class FaultTolerantCheckpoint(Callback):
     that batch replays once on resume (at-least-once step semantics).
     Boundary saves (save_freq_steps / epoch end / SIGKILL recovery from
     the last periodic save) are exactly-once.
+
+    Multi-host: with `coordinator="auto"` (default) a multi-host job —
+    detected from the trainer env contract (PADDLE_TRAINERS_NUM > 1 +
+    MASTER_ADDR/PORT; see `distributed.checkpoint.coordinator_from_env`) —
+    saves through the two-phase coordinated commit: every host publishes
+    step N or none does, and resume negotiates the newest step committed
+    on EVERY host. Pass an explicit `CheckpointCoordinator`, or
+    `coordinator=None` / env `PADDLE_TPU_CKPT_BARRIER=0`, to override.
+    Single-host jobs are unchanged (plain local atomic saves).
+
+    Generation-resync contract: one aborted coordinated save is tolerated
+    (a transiently slow peer), but `PADDLE_TPU_CKPT_ABORT_EXIT` (default
+    2) CONSECUTIVE aborts raise `SystemExit(ELASTIC_EXIT_CODE)` — the
+    elastic supervisor relaunches every host into the same generation
+    instead of training on forever while no checkpoint is ever published
+    fleet-wide (persistent aborts mean a peer or a generation is out of
+    step). Set the env to 0 to disable.
     """
 
     def __init__(self, dirname: str, save_freq_steps: Optional[int] = None,
                  save_freq_epochs: int = 1, keep_last_n: int = 3,
-                 async_save: bool = False, preemption_save: bool = True):
+                 async_save: bool = False, preemption_save: bool = True,
+                 coordinator="auto", barrier_timeout: Optional[float] = None):
         super().__init__()
-        from ..distributed.checkpoint import CheckpointManager
+        from ..distributed.checkpoint import (CheckpointManager,
+                                              coordinator_from_env)
+        if coordinator == "auto":
+            coordinator = coordinator_from_env(timeout=barrier_timeout)
         self.manager = CheckpointManager(dirname, keep_last_n=keep_last_n,
-                                         async_save=async_save)
+                                         async_save=async_save,
+                                         coordinator=coordinator)
         self.save_freq_steps = save_freq_steps
         self.save_freq_epochs = max(1, save_freq_epochs)
         self.preemption_save = preemption_save
         self._epoch = 0
         self._step = -1
         self._global_step = 0
+        self._aborted_saves = 0
+        raw = os.environ.get("PADDLE_TPU_CKPT_ABORT_EXIT", "2")
+        try:
+            self._abort_exit_limit = int(raw)
+        except ValueError:
+            # fail at construction with the real cause, not mid-training
+            # with an anonymous int() error on the first aborted save
+            raise ValueError(
+                f"PADDLE_TPU_CKPT_ABORT_EXIT={raw!r} is not an integer "
+                f"(consecutive aborted coordinated saves before exiting "
+                f"ELASTIC_EXIT_CODE; 0 disables)")
         self._epoch_done = False
         self._resume_epoch = -1
         self._resume_skip = 0
@@ -180,7 +213,27 @@ class FaultTolerantCheckpoint(Callback):
         return state
 
     def _save(self):
-        self.manager.save(self._capture(), step=self._global_step)
+        committed = self.manager.save(self._capture(),
+                                      step=self._global_step)
+        if committed or self.manager.coordinator is None:
+            self._aborted_saves = 0
+            return
+        self._aborted_saves += 1
+        limit = self._abort_exit_limit
+        if limit > 0 and self._aborted_saves >= limit:
+            # the generation-resync contract (ElasticSupervisor docstring):
+            # persistent barrier aborts mean a peer or a generation is out
+            # of step — exit ELASTIC_EXIT_CODE so every host's supervisor
+            # relaunches the fleet into the same generation, instead of
+            # training on while no checkpoint is ever published anywhere.
+            # Uninstall the SIGTERM hook first: fit() only reaches
+            # on_train_end on clean completion, and an in-process restart
+            # (ElasticSupervisor.run) would otherwise chain this dead
+            # generation's handler — a later preemption would then also
+            # save the OLD generation's captured state at its stale step
+            self.manager.uninstall_preemption_handler()
+            from ..distributed.fleet.elastic import ELASTIC_EXIT_CODE
+            raise SystemExit(ELASTIC_EXIT_CODE)
 
     # -- hooks ---------------------------------------------------------------
     def on_train_begin(self, logs=None):

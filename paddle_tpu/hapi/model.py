@@ -120,7 +120,8 @@ class Model:
             else None
 
         from ..io import DataLoader as _DataLoader
-        resume_info = self._restore_for_resume(resume) if resume else None
+        resume_info = self._restore_for_resume(resume, callbacks) \
+            if resume else None
         if resume_info and resume_info["skip_steps"] and shuffle and \
                 not isinstance(train_data, _DataLoader):
             # step-skipping replays the interrupted epoch's batch order; the
@@ -282,13 +283,30 @@ class Model:
         if self._train_step is not None:
             self._train_step.sync_to_layer()
 
-    def _restore_for_resume(self, resume):
+    def _restore_for_resume(self, resume, callbacks=None):
         """Restore from the newest valid FaultTolerantCheckpoint snapshot.
         Returns {"epoch", "skip_steps", "global_step"} or None (no valid
-        checkpoint — fresh start)."""
-        from ..distributed.checkpoint import CheckpointManager
-        mgr = resume if isinstance(resume, CheckpointManager) \
-            else CheckpointManager(str(resume))
+        checkpoint — fresh start). On multi-host jobs the restore must go
+        through the coordinated manager (fleet-negotiated resume step), so
+        a FaultTolerantCheckpoint callback pointed at the same directory
+        lends its manager; otherwise one is built from the env contract."""
+        import os as _os
+        from ..distributed.checkpoint import (CheckpointManager,
+                                              coordinator_from_env)
+        mgr = None
+        if isinstance(resume, CheckpointManager):
+            mgr = resume
+        else:
+            from .callbacks import FaultTolerantCheckpoint
+            for c in _to_list(callbacks):
+                if isinstance(c, FaultTolerantCheckpoint) and \
+                        _os.path.abspath(c.manager.dirname) == \
+                        _os.path.abspath(str(resume)):
+                    mgr = c.manager
+                    break
+            if mgr is None:
+                mgr = CheckpointManager(str(resume),
+                                        coordinator=coordinator_from_env())
         found = mgr.load_latest()
         if found is None:
             return None

@@ -23,6 +23,8 @@ import numpy as np
 
 from ..cost_model import (op_bytes_estimate as _op_bytes_estimate,
                           op_flops_estimate as _op_flops_estimate)
+from ..fault.inject import (DeviceOOMError, InjectedFault, InjectedIOError,
+                            InjectedTimeout, default_injector)
 from ..framework import dtype as dtype_mod
 from ..framework import tape as tape_mod
 from ..framework.tensor import Tensor
@@ -50,7 +52,12 @@ _M_OP_TIME = _REG.histogram(
 _M_CACHE_EVENTS = _REG.counter(
     "eager_cache_events_total",
     "eager jit-cache lookups by result (hit/miss/bypass)")
+_M_DEVICE_OOM = _REG.counter(
+    "device_oom_total",
+    "eager ops that exhausted device memory (XLA RESOURCE_EXHAUSTED or the "
+    "armed device.alloc fault site), by op")
 _op_recorder = get_recorder()
+_fault_injector = default_injector()
 
 # impl registry: name -> pure fn (for compiled/functional callers and tests)
 KERNELS: Dict[str, Callable] = {}
@@ -315,11 +322,14 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
     # counting it would inject one model's worth of phantom "eager
     # dispatches" per (re)trace (same rule as collective.py's eager gate)
     tracing = _op_recorder.enabled
-    if (not tracing and not _metrics_mod.enabled()) or any(
-            isinstance(a, jax.core.Tracer) for a in arrs):
+    if any(isinstance(a, jax.core.Tracer) for a in arrs):
+        # in-trace re-entry executes per compiled run, not per call: no
+        # eager allocation happens here, so no OOM guard either
         return _execute(impl, kwargs, arrs, tensors, name, requires)
+    if not tracing and not _metrics_mod.enabled():
+        return _execute_guarded(impl, kwargs, arrs, tensors, name, requires)
     t0 = now_ns() if tracing else 0  # clock reads only feed spans/histogram
-    result = _execute(impl, kwargs, arrs, tensors, name, requires)
+    result = _execute_guarded(impl, kwargs, arrs, tensors, name, requires)
     t1 = now_ns() if tracing else 0
     outs = result if isinstance(result, tuple) else (result,)
     nbytes = _op_bytes_estimate(
@@ -339,6 +349,44 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
                   "dtypes": [str(getattr(a, "dtype", "?")) for a in arrs],
                   "bytes_est": nbytes}))
     return result
+
+
+def _looks_like_oom(e: BaseException) -> bool:
+    s = str(e)
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s)
+
+
+def _oom_error(name, arrs, detail: str) -> DeviceOOMError:
+    try:
+        nbytes = int(_op_bytes_estimate(arrs, []))
+    except Exception:
+        nbytes = 0
+    if _metrics_mod.enabled():
+        _M_DEVICE_OOM.inc(op=name)
+    return DeviceOOMError(name, nbytes, detail)
+
+
+def _execute_guarded(impl, kwargs, arrs, tensors, name, requires):
+    """The allocator boundary: every eager op's output buffers are
+    allocated inside this call, so this is where device OOM becomes a typed
+    error. XLA RESOURCE_EXHAUSTED failures — and anything the armed
+    `device.alloc` fault site injects — surface as DeviceOOMError naming
+    the op and its byte estimate (+ `device_oom_total{op=}`) instead of a
+    raw XlaRuntimeError string."""
+    try:
+        # site() itself is a single dict truthiness check when unarmed
+        _fault_injector.site("device.alloc")
+    except (InjectedFault, InjectedTimeout, InjectedIOError) as e:
+        raise _oom_error(name, arrs, str(e)) from e
+    try:
+        return _execute(impl, kwargs, arrs, tensors, name, requires)
+    except DeviceOOMError:
+        raise
+    except Exception as e:
+        if _looks_like_oom(e):
+            raise _oom_error(name, arrs, str(e)) from e
+        raise
 
 
 def _execute(impl, kwargs, arrs, tensors, name, requires):

@@ -1,0 +1,522 @@
+"""Multi-host coordinated checkpoint barrier: two-phase commit, abort
+paths, fleet resume negotiation, and the ckpt_inspect --dir audit.
+
+These are the FAST single-process siblings of the subprocess e2e in
+test_elastic_e2e.py: "hosts" are threads sharing one in-process TCPStore
+master, each with its own client connection and checkpoint directory —
+the same protocol state machine without process spawn / jit warmup cost.
+"""
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from paddle_tpu import fault
+from paddle_tpu.distributed import checkpoint as dist_ckpt
+from paddle_tpu.distributed.checkpoint import (CheckpointCoordinator,
+                                               CheckpointManager,
+                                               coordinator_from_env)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.profiler import metrics as metrics_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault.reset()
+    yield
+    fault.reset()
+
+
+@pytest.fixture()
+def master():
+    st = TCPStore("127.0.0.1", 0, is_master=True)
+    yield st
+    st.stop()
+
+
+def _state(seed=0):
+    return {"w": np.arange(4, dtype=np.float32) + seed}
+
+
+def _manager(master, rank, tmp_path, world=2, timeout=5.0, **kw):
+    """One simulated host: own store client + own checkpoint dir."""
+    store = TCPStore("127.0.0.1", master.port)
+    coord = CheckpointCoordinator(store, rank, world, timeout=timeout,
+                                  poll_interval=0.005, **kw)
+    d = str(tmp_path / f"host{rank}")
+    os.makedirs(d, exist_ok=True)
+    return CheckpointManager(d, coordinator=coord)
+
+
+def _join_all(threads):
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "barrier thread wedged"
+
+
+def _counter_total(name, **labels):
+    m = metrics_mod.default_registry().get(name)
+    if m is None:
+        return 0.0
+    return sum(v["value"] for v in m.snapshot()["values"]
+               if all(v["labels"].get(k) == lv for k, lv in labels.items()))
+
+
+class TestCoordinatedCommit:
+    def test_both_hosts_commit_step(self, master, tmp_path):
+        commits0 = _counter_total("ckpt_barrier_commits_total")
+        m0 = _manager(master, 0, tmp_path)
+        m1 = _manager(master, 1, tmp_path)
+        res = {}
+        _join_all([
+            threading.Thread(target=lambda: res.update(a=m0.save(_state(), 1))),
+            threading.Thread(target=lambda: res.update(b=m1.save(_state(), 1))),
+        ])
+        assert res == {"a": True, "b": True}
+        for m in (m0, m1):
+            newest = dist_ckpt.latest_valid(m.dirname)
+            assert newest is not None and newest.endswith("ckpt_1")
+            ok, reason = dist_ckpt.verify(newest)
+            assert ok, reason
+            # no leftover prepare tmp after a commit
+            assert not any(".tmp." in f for f in os.listdir(m.dirname))
+        assert _counter_total("ckpt_barrier_commits_total") >= commits0 + 2
+
+    def test_single_host_has_no_barrier(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))  # world_size==1: plain save
+        assert m.coordinator is None
+        assert m.save(_state(), 1) is True
+        assert dist_ckpt.latest_valid(str(tmp_path)) is not None
+
+    def test_coordinated_manager_keeps_at_least_two(self, master, tmp_path):
+        """keep_last_n=1 + coordinator is a resume wedge waiting to happen:
+        after a two-generals crash the fleet agrees on N-1, which this
+        host's GC already deleted. Coordinated managers floor it at 2."""
+        m = _manager(master, 0, tmp_path)
+        m.keep_last_n = 1  # what __init__ must have prevented
+        m2 = CheckpointManager(str(tmp_path / "h"), keep_last_n=1,
+                               coordinator=m.coordinator)
+        assert m2.keep_last_n == 2
+        plain = CheckpointManager(str(tmp_path / "p"), keep_last_n=1)
+        assert plain.keep_last_n == 1  # single-host: no skew, no floor
+
+    def test_world_size_one_coordinator_rejected(self, master):
+        store = TCPStore("127.0.0.1", master.port)
+        with pytest.raises(ValueError, match="world_size"):
+            CheckpointCoordinator(store, 0, 1)
+
+    def test_missing_peer_aborts_without_final_file(self, master, tmp_path):
+        aborts0 = _counter_total("ckpt_barrier_aborts_total",
+                                 reason="timeout")
+        m0 = _manager(master, 0, tmp_path, timeout=0.5)
+        with pytest.warns(UserWarning, match="aborted"):
+            assert m0.save(_state(), 7) is False  # peer never arrives
+        assert os.listdir(m0.dirname) == []  # tmp GC'd, nothing published
+        assert _counter_total("ckpt_barrier_aborts_total",
+                              reason="timeout") >= aborts0 + 1
+
+    def test_commit_fault_aborts_fleet_wide(self, master, tmp_path):
+        """The e2e's kill-between-prepare-and-commit, in-process: host 0
+        faults at the ckpt.commit site (never votes), so host 1 times out
+        and aborts — NO host publishes a final file for the step."""
+        fault.configure("ckpt.commit", times=1)
+        m0 = _manager(master, 0, tmp_path, timeout=2.0)
+        m1 = _manager(master, 1, tmp_path, timeout=1.0)
+        res = {}
+
+        def host0():
+            try:
+                m0.save(_state(), 3)
+            except fault.InjectedFault:
+                res["a"] = "died"
+
+        def host1():
+            # the single armed injection must go to host 0: don't enter the
+            # commit phase (and race for it) until host 0 has consumed it
+            deadline = time.time() + 30
+            while (fault.default_injector().fired("ckpt.commit") < 1
+                   and time.time() < deadline):
+                time.sleep(0.005)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                res["b"] = m1.save(_state(), 3)
+
+        _join_all([threading.Thread(target=host0),
+                   threading.Thread(target=host1)])
+        assert res == {"a": "died", "b": False}
+        for m in (m0, m1):
+            assert dist_ckpt.latest_valid(m.dirname) is None
+            assert not os.path.exists(m.path_for(3))
+        # the faulted host flagged the abort before dying: peers observe
+        # it (or time out) instead of hanging, and both paths are metered
+        assert fault.default_injector().fired("ckpt.commit") == 1
+        assert _counter_total("ckpt_barrier_aborts_total") >= 1
+
+    def test_reused_step_gets_fresh_barrier(self, master, tmp_path):
+        """A step number committed in an earlier round (epoch-end save,
+        then SIGTERM preemption save before the next step advances) must
+        run a FRESH barrier — not insta-commit on the previous round's
+        stale prep votes while a peer's prepare never happened."""
+        m0 = _manager(master, 0, tmp_path, timeout=1.0)
+        m1 = _manager(master, 1, tmp_path, timeout=1.0)
+        res = {}
+        _join_all([
+            threading.Thread(target=lambda: res.update(a=m0.save(_state(), 1))),
+            threading.Thread(target=lambda: res.update(b=m1.save(_state(), 1))),
+        ])
+        assert res == {"a": True, "b": True}
+        # host 0 re-saves step 1 alone: peer never prepares, so the round
+        # must time out and abort (stale round-0 votes must not satisfy it)
+        with pytest.warns(UserWarning, match="aborted"):
+            assert m0.save(_state(seed=9), 1) is False
+        # the round-0 final file survives untouched
+        newest = dist_ckpt.latest_valid(m0.dirname)
+        assert newest is not None and newest.endswith("ckpt_1")
+        ok, reason = dist_ckpt.verify(newest)
+        assert ok, reason
+
+    def test_aborted_step_number_can_recommit(self, master, tmp_path):
+        """A step number whose round aborted must be retryable: the next
+        round's barrier must not observe the previous round's abort flag
+        (a preemption save re-using an aborted step would otherwise be
+        silently dropped fleet-wide)."""
+        m0 = _manager(master, 0, tmp_path, timeout=0.8)
+        m1 = _manager(master, 1, tmp_path, timeout=0.8)
+        # each host burns round 0 with a solo abort on DISJOINT steps
+        # (lockstep: same number of rounds per host, like the real protocol
+        # where an abort is observed by the whole fleet) — host 0's abort
+        # flags step 7
+        def solo(m, step):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                assert m.save(_state(), step) is False
+        _join_all([threading.Thread(target=solo, args=(m0, 7)),
+                   threading.Thread(target=solo, args=(m1, 6))])
+        # round 1: the fleet re-commits step 7 — the round-0 abort flag
+        # must not poison it
+        res = {}
+        _join_all([
+            threading.Thread(target=lambda: res.update(a=m0.save(_state(), 7))),
+            threading.Thread(target=lambda: res.update(b=m1.save(_state(), 7))),
+        ])
+        assert res == {"a": True, "b": True}
+        for m in (m0, m1):
+            assert os.path.exists(m.path_for(7))
+
+    def test_prepare_failure_aborts_promptly_and_keeps_rounds(
+            self, master, tmp_path, monkeypatch):
+        """A prepare-phase failure (disk full, SIGTERM during the tmp
+        write) must poison the round: the peer aborts promptly instead of
+        burning the barrier timeout, and the failed host's round counter
+        stays lockstep so its NEXT save still works."""
+        m0 = _manager(master, 0, tmp_path, timeout=30.0)
+        m1 = _manager(master, 1, tmp_path, timeout=30.0)
+        orig = dist_ckpt._encode_snapshot
+
+        def failing(host_state, specs):
+            if isinstance(host_state, dict) and host_state.get("boom"):
+                raise RuntimeError("disk full")
+            return orig(host_state, specs)
+        monkeypatch.setattr(dist_ckpt, "_encode_snapshot", failing)
+        res = {}
+
+        def host0():
+            try:
+                m0.save({"boom": True, "w": np.zeros(2)}, 1)
+            except RuntimeError:
+                res["a"] = "failed"
+
+        def host1():
+            t0 = time.time()
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                res["b"] = m1.save(_state(), 1)
+            res["b_secs"] = time.time() - t0
+        _join_all([threading.Thread(target=host0),
+                   threading.Thread(target=host1)])
+        assert res["a"] == "failed" and res["b"] is False
+        assert res["b_secs"] < 15  # prompt peer_abort, not the 30s timeout
+        # round counters stayed lockstep: the next fleet save commits
+        res2 = {}
+        _join_all([
+            threading.Thread(target=lambda: res2.update(a=m0.save(_state(), 2))),
+            threading.Thread(target=lambda: res2.update(b=m1.save(_state(), 2))),
+        ])
+        assert res2 == {"a": True, "b": True}
+
+    def test_ckpt_commit_armable_via_env_spec(self, master, tmp_path,
+                                              monkeypatch):
+        monkeypatch.setenv(fault.SPEC_ENV, "ckpt.commit=1")
+        fault.reload_spec()
+        m0 = _manager(master, 0, tmp_path, timeout=1.0)
+        with pytest.raises(fault.InjectedFault):
+            m0.save(_state(), 1)
+        assert _counter_total("fault_injected_total", site="ckpt.commit") >= 1
+        assert os.listdir(m0.dirname) == []  # tmp cleaned on the error path
+
+    def test_abort_flag_honored_by_peer(self, master, tmp_path):
+        """A host that observes a peer's abort flag drops its own tmp even
+        if every prepare vote eventually lands."""
+        m0 = _manager(master, 0, tmp_path, timeout=5.0)
+        m0.coordinator.mark_abort(5, "timeout")  # peer aborted step 5
+        m1 = _manager(master, 1, tmp_path, timeout=5.0)
+        with pytest.warns(UserWarning, match="aborted"):
+            assert m1.save(_state(), 5) is False
+        assert os.listdir(m1.dirname) == []
+
+    def test_namespace_isolates_generations(self, master, tmp_path):
+        """A stale abort flag from the generation that died must not poison
+        the restarted generation's rounds: the supervisor bumps
+        PADDLE_TPU_ELASTIC_RESTART_NUM and the coordinator namespaces by it."""
+        stale = _manager(master, 0, tmp_path, namespace="ckptbar/0")
+        stale.coordinator.mark_abort(1, "timeout")
+        m0 = _manager(master, 0, tmp_path, namespace="ckptbar/1")
+        m1 = _manager(master, 1, tmp_path, namespace="ckptbar/1")
+        res = {}
+        _join_all([
+            threading.Thread(target=lambda: res.update(a=m0.save(_state(), 1))),
+            threading.Thread(target=lambda: res.update(b=m1.save(_state(), 1))),
+        ])
+        assert res == {"a": True, "b": True}
+
+    def test_preemption_publish_routes_through_barrier(self, master,
+                                                       tmp_path):
+        """SIGTERM's one final save uses the same two-phase commit: both
+        hosts' _publish_sync barrier together and publish, or neither."""
+        m0 = _manager(master, 0, tmp_path)
+        m1 = _manager(master, 1, tmp_path)
+        res = {}
+        _join_all([
+            threading.Thread(
+                target=lambda: res.update(a=m0._publish_sync(_state(), 9))),
+            threading.Thread(
+                target=lambda: res.update(b=m1._publish_sync(_state(), 9))),
+        ])
+        assert res == {"a": True, "b": True}
+        for m in (m0, m1):
+            assert os.path.exists(m.path_for(9))
+
+
+class TestResumeNegotiation:
+    def test_divergent_hosts_resume_from_fleet_committed_step(
+            self, master, tmp_path):
+        """Regression (satellite): host 0 renamed step 3 just before the
+        fleet died, host 1 never did. Resume must pick the barrier-committed
+        step 2 on BOTH hosts — never host 0's lexically newest file."""
+        m0 = _manager(master, 0, tmp_path)
+        m1 = _manager(master, 1, tmp_path)
+        for step in (1, 2):
+            res = {}
+            _join_all([
+                threading.Thread(
+                    target=lambda: res.update(a=m0.save(_state(step), step))),
+                threading.Thread(
+                    target=lambda: res.update(b=m1.save(_state(step), step))),
+            ])
+            assert res == {"a": True, "b": True}
+        # host 0 alone publishes step 3 (plain local save: the rename
+        # happened, the fleet's vote on the NEXT round never completed)
+        dist_ckpt.save(_state(3), m0.path_for(3))
+        assert dist_ckpt.latest_valid(m0.dirname).endswith("ckpt_3")
+
+        res = {}
+        _join_all([
+            threading.Thread(target=lambda: res.update(a=m0.load_latest())),
+            threading.Thread(target=lambda: res.update(b=m1.load_latest())),
+        ])
+        for key, host in (("a", "host0"), ("b", "host1")):
+            state, step = res[key]
+            assert step == 2, f"{host} resumed from step {step}, wanted 2"
+            np.testing.assert_array_equal(np.asarray(state["w"]), _state(2)["w"])
+
+    def test_all_hosts_empty_resumes_fresh(self, master, tmp_path):
+        m0 = _manager(master, 0, tmp_path)
+        m1 = _manager(master, 1, tmp_path)
+        res = {}
+        _join_all([
+            threading.Thread(target=lambda: res.update(a=m0.load_latest())),
+            threading.Thread(target=lambda: res.update(b=m1.load_latest())),
+        ])
+        assert res == {"a": None, "b": None}
+
+    def test_one_empty_host_forces_fresh_start(self, master, tmp_path):
+        """A host that lost its disk (fresh node joining after restart)
+        has nothing: the fleet cannot resume a step that host lacks."""
+        m0 = _manager(master, 0, tmp_path)
+        m1 = _manager(master, 1, tmp_path)
+        dist_ckpt.save(_state(1), m0.path_for(1))  # only host 0 has data
+        res = {}
+        _join_all([
+            threading.Thread(target=lambda: res.update(a=m0.load_latest())),
+            threading.Thread(target=lambda: res.update(b=m1.load_latest())),
+        ])
+        assert res == {"a": None, "b": None}
+
+    def test_negotiation_timeout_raises_and_poisons_round(self, master,
+                                                          tmp_path):
+        """Consistency over availability: a host whose peers never arrive
+        must NOT silently resume its local step (a peer landing just past
+        the deadline would resume the fleet minimum — split brain). The
+        timeout raises, and the poisoned round makes the late arriver
+        raise too instead of resuming alone."""
+        m0 = _manager(master, 0, tmp_path, resume_timeout=0.3)
+        dist_ckpt.save(_state(4), m0.path_for(4))
+        with pytest.raises(RuntimeError, match="negotiation timed out"):
+            m0.load_latest()
+        # the late arriver finds every key published (its own + host 0's)
+        # but the round is poisoned: it must refuse as well
+        m1 = _manager(master, 1, tmp_path, resume_timeout=5.0)
+        dist_ckpt.save(_state(4), m1.path_for(4))
+        with pytest.raises(RuntimeError, match="abandoned by a peer"):
+            m1.load_latest()
+
+
+class TestCoordinatorFromEnv:
+    def test_builds_from_trainer_env_contract(self, master, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", str(master.port))
+        co = coordinator_from_env()
+        assert co is not None and co.rank == 1 and co.world_size == 2
+
+    def test_single_host_env_returns_none(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", "1")
+        assert coordinator_from_env() is None
+
+    def test_kill_switch_env(self, master, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", str(master.port))
+        monkeypatch.setenv("PADDLE_TPU_CKPT_BARRIER", "0")
+        assert coordinator_from_env() is None
+
+    def test_garbled_master_port_fails_loudly(self, monkeypatch):
+        """A >=2 fleet with an unparseable MASTER_PORT must raise a named
+        error, not silently degrade to the single-host path — this host
+        would skip the barrier while its peers wait on it."""
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", "auto")
+        with pytest.raises(ValueError, match="MASTER_PORT"):
+            coordinator_from_env()
+
+    def test_missing_rank_fails_loudly(self, master, monkeypatch):
+        """A >=2 fleet without PADDLE_TRAINER_ID must raise a named error:
+        defaulting to rank 0 would have EVERY host vote as rank 0 and
+        each coordinated save burn the barrier timeout."""
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", str(master.port))
+        monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+        with pytest.raises(ValueError, match="PADDLE_TRAINER_ID"):
+            coordinator_from_env()
+
+    def test_namespace_follows_restart_num(self, master, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", str(master.port))
+        monkeypatch.setenv("PADDLE_TPU_ELASTIC_RESTART_NUM", "4")
+        co = coordinator_from_env()
+        assert co.namespace == "ckptbar/4"
+
+
+class TestAbortExitContract:
+    """FaultTolerantCheckpoint implements the generation-resync contract:
+    persistent coordinated-save aborts exit ELASTIC_EXIT_CODE so the
+    elastic supervisors relaunch the whole fleet into one generation."""
+
+    def _cb(self, tmp_path, committed_seq):
+        from paddle_tpu.hapi.callbacks import FaultTolerantCheckpoint
+        cb = FaultTolerantCheckpoint(str(tmp_path), coordinator=None,
+                                     preemption_save=False)
+        seq = list(committed_seq)
+
+        class FakeMgr:
+            coordinator = object()  # coordinated manager
+
+            def save(self, state, step):
+                return seq.pop(0)
+
+            def uninstall_preemption_handler(self):
+                pass
+        cb.manager = FakeMgr()
+        cb._capture = lambda: {}
+        return cb
+
+    def test_consecutive_aborts_exit_101(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ELASTIC_EXIT_CODE
+        cb = self._cb(tmp_path, [False, False])
+        cb._save()  # first abort tolerated (transiently slow peer)
+        with pytest.raises(SystemExit) as e:
+            cb._save()
+        assert e.value.code == ELASTIC_EXIT_CODE
+
+    def test_committed_save_resets_the_streak(self, tmp_path):
+        cb = self._cb(tmp_path, [False, True, False])
+        cb._save()
+        cb._save()  # commit resets the abort streak
+        cb._save()  # a single new abort: no exit
+        assert cb._aborted_saves == 1
+
+    def test_knob_disables_exit(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_CKPT_ABORT_EXIT", "0")
+        cb = self._cb(tmp_path, [False] * 5)
+        for _ in range(5):
+            cb._save()
+
+
+class TestCkptInspectDir:
+    def _mkdir(self, tmp_path):
+        d = str(tmp_path)
+        dist_ckpt.save(_state(1), os.path.join(d, "ckpt_1"))
+        dist_ckpt.save(_state(2), os.path.join(d, "ckpt_2"))
+        # step 3: prepared by the barrier but never renamed (torn tmp)
+        with open(os.path.join(d, "ckpt_3.tmp.prep"), "wb") as f:
+            f.write(b"half a payload")
+        # step 4: committed then corrupted on disk
+        p4 = os.path.join(d, "ckpt_4")
+        dist_ckpt.save(_state(4), p4)
+        raw = open(p4, "rb").read()
+        open(p4, "wb").write(raw[:-5])
+        # step 5: an interrupted PLAIN atomic write (io._atomic_write
+        # mkstemp suffix) — NOT a barrier tmp, must not read as torn
+        with open(os.path.join(d, "ckpt_5.tmp.Ab3xQ9"), "wb") as f:
+            f.write(b"half a plain write")
+        return d
+
+    def test_dir_status_classifies_steps(self, tmp_path):
+        sys_path_guard = list(os.sys.path)
+        os.sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from ckpt_inspect import dir_status
+        finally:
+            os.sys.path[:] = sys_path_guard
+        st = dir_status(self._mkdir(tmp_path))
+        by_step = {e["step"]: e["status"] for e in st["steps"]}
+        assert by_step == {1: "committed", 2: "committed",
+                           3: "torn-tmp", 4: "corrupt", 5: "stale-tmp"}
+        assert st["newest_valid"] == 2
+        assert [e["step"] for e in st["steps"]] == [5, 4, 3, 2, 1]  # newest 1st
+
+    def test_cli_dir_report(self, tmp_path, capsys):
+        import subprocess
+        import sys as _sys
+        d = self._mkdir(tmp_path)
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        out = subprocess.run(
+            [_sys.executable, os.path.join(REPO, "tools", "ckpt_inspect.py"),
+             "--dir", d], env=env, capture_output=True, text=True, timeout=120)
+        assert out.returncode != 0  # corrupt file present -> nonzero exit
+        assert "torn-tmp" in out.stdout
+        assert "newest-valid: step 2" in out.stdout

@@ -1,0 +1,232 @@
+"""Slow multi-process e2e: the full distributed fault-tolerance story.
+
+Two "hosts" (subprocesses sharing one rendezvous TCPStore, each with its
+own checkpoint directory) train under per-host elastic supervisors. Host 1
+is killed between prepare and commit of step 3's coordinated checkpoint
+(`ckpt.commit` fault site, kind=kill): the barrier guarantees NO host
+publishes a final file for that step. Host 0's supervisor notices the
+stale heartbeat (watch -> membership restart), host 1's notices the corpse
+(failure restart); both relaunch with a bumped generation, negotiate the
+newest fleet-committed step (2), and train a bit-identical tail.
+
+fast-sibling: tests/test_coord_checkpoint.py (barrier protocol state
+machine), tests/test_elastic_supervisor.py (restart loop) — keep those
+green in tier-1; this file is the slow integration proof.
+"""
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.profiler import metrics as metrics_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+# Per-host trainer. argv: ckpt_dir out_json events_jsonl. Generation and
+# rank come from the supervisor env (PADDLE_TPU_ELASTIC_RESTART_NUM /
+# PADDLE_TRAINER_ID). Deterministic end to end, as in test_fault_resume.
+_TRAIN_SCRIPT = r"""
+import json, os, sys
+
+GEN = int(os.environ.get("PADDLE_TPU_ELASTIC_RESTART_NUM", "0"))
+if GEN > 0:
+    # the injected kill belongs to the incarnation that died; a relaunched
+    # generation must not re-arm it (clear BEFORE the injector's import)
+    os.environ.pop("PADDLE_TPU_FAULT_SPEC", None)
+CKPT, OUT, EVENTS = sys.argv[1], sys.argv[2], sys.argv[3]
+RANK = int(os.environ["PADDLE_TRAINER_ID"])
+
+# snapshot the on-disk state BEFORE any manager construction (init sweeps
+# orphan tmps): this is the evidence of what the dead generation left
+listing = sorted(os.listdir(CKPT)) if os.path.isdir(CKPT) else []
+finals = sorted(int(f.rsplit("_", 1)[1]) for f in listing
+                if f.startswith("ckpt_") and f.rsplit("_", 1)[1].isdigit())
+with open(EVENTS, "a") as f:
+    f.write(json.dumps({"host": RANK, "gen": GEN, "listing": listing,
+                        "final_steps": finals}) + "\n")
+
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+from paddle_tpu.hapi.callbacks import FaultTolerantCheckpoint
+from paddle_tpu.io import Dataset
+
+mgr = ElasticManager(host_id=f"host{RANK}", np=2)  # master addr from env
+mgr.join()
+
+
+class DS(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(1000 + i)
+        return rng.randn(4).astype(np.float32), rng.randn(2).astype(np.float32)
+
+
+def build():
+    paddle.seed(42)
+    net = nn.Linear(4, 2)
+    m = paddle.Model(net)
+    m.prepare(optimizer.Adam(learning_rate=1e-2,
+                             parameters=net.parameters()),
+              loss=nn.MSELoss())
+    return m
+
+
+m = build()
+cbs = [FaultTolerantCheckpoint(CKPT, save_freq_steps=1)]
+m.fit(DS(), batch_size=2, epochs=2, shuffle=False, verbose=0,
+      callbacks=cbs, resume=CKPT)
+
+# uninterrupted reference, trained in THIS process: the resumed tail must
+# match it bit for bit (optimizer slots, RNG, LR cursor all restored)
+m2 = build()
+m2.fit(DS(), batch_size=2, epochs=2, shuffle=False, verbose=0)
+for mm in (m, m2):
+    mm._sync_from_train_step()
+
+from paddle_tpu.profiler.metrics import default_registry
+out = {
+    "gen": GEN,
+    "weights": {k: np.asarray(v.data).tolist()
+                for k, v in m.network.state_dict().items()},
+    "ref_weights": {k: np.asarray(v.data).tolist()
+                    for k, v in m2.network.state_dict().items()},
+    "metrics": default_registry().snapshot(),
+}
+with open(OUT, "w") as f:
+    json.dump(out, f)
+mgr.mark_done()  # beats stop now; peers must read this as done, not dead
+"""
+
+
+def _snapshot_total(snap, name, **labels):
+    vals = snap.get(name, {}).get("values", [])
+    return sum(v["value"] for v in vals
+               if all(v["labels"].get(k) == lv for k, lv in labels.items()))
+
+
+class TestTwoHostKillBetweenPrepareAndCommit:
+    def test_barrier_holds_and_fleet_auto_resumes(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          ElasticSupervisor)
+        script = tmp_path / "train.py"
+        script.write_text(_TRAIN_SCRIPT)
+
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        common = {
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO,
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(master.port),
+            "PADDLE_TRAINERS_NUM": "2",
+            # generous TTL: on a loaded 2-core box a child's beat thread
+            # can wake seconds late during import/compile oversubscription;
+            # a TTL tighter than that reads a healthy peer as dead, fires a
+            # second membership restart, and desyncs the fleet's generation
+            # numbering (every later barrier round then times out)
+            "PADDLE_ELASTIC_TTL": "6",
+            "PADDLE_TPU_CKPT_BARRIER_TIMEOUT": "5",
+            "PADDLE_TPU_CKPT_RESUME_TIMEOUT": "120",
+        }
+
+        sups, codes = {}, {}
+
+        def host(rank, fault_spec, watch):
+            d = str(tmp_path / f"host{rank}")
+            env = dict(common)
+            env["PADDLE_TRAINER_ID"] = str(rank)
+            env["PADDLE_TPU_FAULT_SPEC"] = fault_spec
+            manager = None
+            if watch:
+                # watch-only manager (never joins/beats): the supervisor
+                # must not mask its child's death with its own heartbeat
+                manager = ElasticManager(host_id=f"sup{rank}",
+                                         master=f"127.0.0.1:{master.port}",
+                                         ttl=6.0, np=2)
+            # the killed host backs off 8s before relaunching — longer than
+            # peer staleness detection (TTL 6s + 0.1s poll), so host 0's
+            # membership restart is ordered before host 1's beats resume
+            # self_member: the watch must only react to PEER staleness —
+            # this host's own trainer is monitored by process exit, and its
+            # restart gap (preemption save + relaunch import) outlives any
+            # sane TTL
+            sup = ElasticSupervisor(max_restarts=3,
+                                    backoff=8.0 if rank == 1 else 0.5,
+                                    backoff_max=10.0, manager=manager,
+                                    poll=0.1, stop_grace=20.0,
+                                    self_member=f"host{rank}")
+            sups[rank] = sup
+            codes[rank] = sup.supervise(
+                [sys.executable, str(script), d,
+                 str(tmp_path / f"out{rank}.json"),
+                 str(tmp_path / f"events{rank}.jsonl")], env=env)
+
+        threads = [
+            # host 1 dies between prepare and commit of step 3's save
+            threading.Thread(target=host,
+                             args=(1, "ckpt.commit=1@3:kill", False)),
+            threading.Thread(target=host, args=(0, "", True)),
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=420)
+                assert not t.is_alive(), "supervisor wedged"
+        finally:
+            master.stop()
+
+        assert codes == {0: 0, 1: 0}, "a supervisor gave up"
+        # both hosts relaunched exactly once, for the right reasons
+        assert sups[1].restarts == 1 and sups[1].last_reason == "failure"
+        assert sups[0].restarts == 1 and sups[0].last_reason == "membership"
+        reg = metrics_mod.default_registry()
+        snap = reg.snapshot()
+        assert _snapshot_total(snap, "elastic_restarts_total",
+                               reason="failure") >= 1
+        assert _snapshot_total(snap, "elastic_restarts_total",
+                               reason="membership") >= 1
+
+        events = {}
+        for rank in (0, 1):
+            with open(tmp_path / f"events{rank}.jsonl") as f:
+                events[rank] = [json.loads(line) for line in f]
+        gen1 = {r: next(e for e in events[r] if e["gen"] == 1)
+                for r in (0, 1)}
+        # the barrier held: step 3 was never published as a FINAL file on
+        # either host — the newest fully-committed step everywhere is 2
+        for rank in (0, 1):
+            assert gen1[rank]["final_steps"], f"host {rank} lost everything"
+            assert max(gen1[rank]["final_steps"]) == 2, \
+                f"host {rank} relaunched seeing {gen1[rank]['final_steps']}"
+        # the kill landed where advertised: host 1 left a torn prepare tmp
+        assert any(f.startswith("ckpt_3.tmp.") for f in gen1[1]["listing"])
+
+        outs = {r: json.load(open(tmp_path / f"out{r}.json"))
+                for r in (0, 1)}
+        for rank in (0, 1):
+            out = outs[rank]
+            assert out["gen"] == 1  # the OUTPUT came from the relaunch
+            assert out["weights"].keys() == out["ref_weights"].keys()
+            for k in out["weights"]:
+                assert np.array_equal(np.asarray(out["weights"][k]),
+                                      np.asarray(out["ref_weights"][k])), \
+                    f"host {rank} {k} diverged after coordinated resume"
+            # resume negotiated + loaded, and the relaunched generation's
+            # coordinated saves committed again
+            m = out["metrics"]
+            assert _snapshot_total(m, "checkpoint_loads_total") >= 1
+            assert _snapshot_total(m, "ckpt_barrier_commits_total") >= 1
+        # both hosts trained the identical tail
+        for k in outs[0]["weights"]:
+            assert np.array_equal(np.asarray(outs[0]["weights"][k]),
+                                  np.asarray(outs[1]["weights"][k]))

@@ -533,3 +533,159 @@ class TestCollectiveInitRetry:
                                         "num_processes": 2,
                                         "process_id": 0})
         assert fault.default_injector().fired("parallel.init") == 2
+
+
+# ---------------------------------------------------------------------------
+# collective timeout detection (fault site + deadline watchdog)
+# ---------------------------------------------------------------------------
+class TestCollectiveTimeout:
+    """A hung eager collective (dead peer mid-rendezvous) must surface as a
+    typed CollectiveTimeoutError naming the group and rank — never a silent
+    hang — and every detection lands in collective_timeout_total."""
+
+    def setup_method(self, _):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.topology import (HybridCommunicateGroup,
+                                                     build_mesh)
+        mesh = build_mesh({"dp": 8})
+        dist.set_hybrid_communicate_group(HybridCommunicateGroup(mesh=mesh))
+        dist.destroy_process_group()
+        self.group = dist.new_group(axis_name="dp")
+
+    def teardown_method(self, _):
+        import paddle_tpu.distributed as dist
+        dist.set_hybrid_communicate_group(None)
+        dist.destroy_process_group()
+
+    @staticmethod
+    def _timeouts(**labels):
+        m = metrics_mod.default_registry().get("collective_timeout_total")
+        if m is None:
+            return 0.0
+        return sum(v["value"] for v in m.snapshot()["values"]
+                   if all(v["labels"].get(k) == lv
+                          for k, lv in labels.items()))
+
+    def test_injected_fault_raises_typed_error(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import CollectiveTimeoutError
+        fault.configure("collective.timeout", times=1, kind="timeout")
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        t0 = self._timeouts(kind="all_reduce")
+        with pytest.raises(CollectiveTimeoutError) as ei:
+            dist.all_reduce(x, group=self.group)
+        assert ei.value.kind == "all_reduce"
+        assert ei.value.group_name == self.group.name
+        assert "rank" in str(ei.value) and self.group.name in str(ei.value)
+        assert self._timeouts(kind="all_reduce") == t0 + 1
+        # injector exhausted: the very next collective completes normally
+        y = paddle.to_tensor(np.ones((4,), np.float32))
+        dist.all_reduce(y, group=self.group)
+        np.testing.assert_allclose(y.numpy(), np.full(4, 8.0))
+
+    def test_bare_spec_default_kind_still_types_and_meters(self):
+        """`collective.timeout=1` (no :kind, so the grammar's default
+        kind=error) must coerce to the same typed timeout — every injected
+        kind at this site models a hung collective, and an escaping raw
+        InjectedFault would skip collective_timeout_total."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import CollectiveTimeoutError
+        fault.configure("collective.timeout", times=1)  # default kind
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        t0 = self._timeouts(kind="all_reduce")
+        with pytest.raises(CollectiveTimeoutError):
+            dist.all_reduce(x, group=self.group)
+        assert self._timeouts(kind="all_reduce") == t0 + 1
+
+    def test_armable_via_env_spec(self, monkeypatch):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import CollectiveTimeoutError
+        monkeypatch.setenv(fault.SPEC_ENV, "collective.timeout=1:timeout")
+        fault.reload_spec()
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        with pytest.raises(CollectiveTimeoutError):
+            dist.all_reduce(x, group=self.group)
+        inj = metrics_mod.default_registry().get("fault_injected_total")
+        assert sum(v["value"] for v in inj.snapshot()["values"]
+                   if v["labels"].get("site") == "collective.timeout") >= 1
+
+    def test_deadline_raises_instead_of_hanging(self, monkeypatch):
+        from paddle_tpu.distributed.collective import (CollectiveTimeoutError,
+                                                       _guard_collective)
+        monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_TIMEOUT", "0.1")
+        t0 = self._timeouts(kind="probe")
+        start = time.time()
+        with pytest.raises(CollectiveTimeoutError, match="did not complete"):
+            _guard_collective("probe", self.group,
+                              lambda: time.sleep(30))
+        assert time.time() - start < 10  # bounded, nowhere near the sleep
+        assert self._timeouts(kind="probe") == t0 + 1
+
+    def test_deadline_passes_fast_collectives(self, monkeypatch):
+        import paddle_tpu.distributed as dist
+        monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_TIMEOUT", "60")
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        dist.all_reduce(x, group=self.group)
+        np.testing.assert_allclose(x.numpy(), np.full(4, 8.0))
+
+    def test_thunk_error_propagates_unwrapped(self, monkeypatch):
+        from paddle_tpu.distributed.collective import _guard_collective
+        monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_TIMEOUT", "30")
+
+        def boom():
+            raise ValueError("not a timeout")
+
+        with pytest.raises(ValueError, match="not a timeout"):
+            _guard_collective("probe", self.group, boom)
+
+
+# ---------------------------------------------------------------------------
+# device OOM detection at the eager allocator boundary
+# ---------------------------------------------------------------------------
+class TestDeviceOOM:
+    def test_armable_via_env_spec(self, monkeypatch):
+        from paddle_tpu.fault import DeviceOOMError
+        a = paddle.to_tensor(np.ones((4,), np.float32))
+        b = paddle.to_tensor(np.ones((4,), np.float32))
+        monkeypatch.setenv(fault.SPEC_ENV, "device.alloc=1")
+        fault.reload_spec()
+        oom = metrics_mod.default_registry().get("device_oom_total")
+        before = oom.total()
+        with pytest.raises(DeviceOOMError) as ei:
+            paddle.add(a, b)
+        assert ei.value.op == "add"
+        assert oom.total() == before + 1
+        inj = metrics_mod.default_registry().get("fault_injected_total")
+        assert sum(v["value"] for v in inj.snapshot()["values"]
+                   if v["labels"].get("site") == "device.alloc") >= 1
+        # site exhausted: the op works again (caller can shrink and retry)
+        np.testing.assert_allclose(paddle.add(a, b).numpy(), np.full(4, 2.0))
+
+    def test_resource_exhausted_becomes_typed_oom(self):
+        from paddle_tpu import ops
+        from paddle_tpu.fault import DeviceOOMError
+
+        def alloc_hog(x):
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory allocating 1073741824 "
+                "bytes (probably XlaRuntimeError on a real device)")
+
+        x = paddle.to_tensor(np.ones((8,), np.float32))
+        oom = metrics_mod.default_registry().get("device_oom_total")
+        before = oom.value(op="alloc_hog")
+        with pytest.raises(DeviceOOMError) as ei:
+            ops.call(alloc_hog, (x,))
+        assert ei.value.op == "alloc_hog"
+        assert ei.value.bytes_estimate > 0  # named with the bytes touched
+        assert "RESOURCE_EXHAUSTED" in str(ei.value)
+        assert oom.value(op="alloc_hog") == before + 1
+
+    def test_unrelated_errors_pass_through_unwrapped(self):
+        from paddle_tpu import ops
+
+        def bad_op(x):
+            raise ValueError("shape mismatch, not an OOM")
+
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with pytest.raises(ValueError, match="not an OOM"):
+            ops.call(bad_op, (x,))

@@ -1,0 +1,154 @@
+"""Tier-1 audit: every slow-marked e2e test keeps a fast sibling.
+
+PR 4 trimmed the tier-1 budget by pushing heavy e2e tests behind
+``@pytest.mark.slow`` on the explicit contract that each one keeps a fast
+sibling in tier-1 (same module, or a module named by a ``fast-sibling:``
+annotation).  Nothing enforced that contract, so a future trim could
+silently drop the last fast test from a module and tier-1 would lose the
+subsystem entirely.  This audit makes the contract executable:
+
+* a module whose slow tests sit next to fast ones passes on its own;
+* a module that is slow end to end (``pytestmark = pytest.mark.slow``, or
+  every collected test slow-marked) must carry a ``fast-sibling:`` line in
+  its module docstring naming ``tests/...py`` files, and each named file
+  must itself exist and collect at least one fast test.
+
+The audit is pure ``ast`` — no imports of the test modules, no pytest
+collection — so it costs milliseconds in tier-1.
+"""
+import ast
+import re
+from pathlib import Path
+
+TESTS_DIR = Path(__file__).resolve().parent
+
+SIBLING_RE = re.compile(r"tests/(test_\w+\.py)")
+
+
+def _mark_names(deco):
+    """Yield mark names reachable from one decorator expression.
+
+    Handles ``@pytest.mark.slow``, ``@pytest.mark.slow(...)`` and bare
+    ``@slow``-style aliases; parametrize marks inside the argument list are
+    intentionally NOT walked here (a param-level slow mark still leaves the
+    fast params collected, so the function counts as a fast sibling).
+    """
+    node = deco
+    if isinstance(node, ast.Call):
+        node = node.func
+    while isinstance(node, ast.Attribute):
+        yield node.attr
+        node = node.value
+    if isinstance(node, ast.Name):
+        yield node.id
+
+
+def _is_slow(decorator_list):
+    return any("slow" in _mark_names(d) for d in decorator_list)
+
+
+def _module_level_slow(tree):
+    """True when the module sets ``pytestmark`` to something slow."""
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == "pytestmark"
+                   for t in targets):
+            continue
+        return "slow" in ast.dump(node.value)
+    return False
+
+
+def _audit_module(path):
+    """Return (slow_count, fast_count) of test functions in one file."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    if _module_level_slow(tree):
+        # everything in the file skips without --slow, whatever the
+        # per-function marks say
+        n = sum(isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and f.name.startswith("test_")
+                for cls in [tree] + [n for n in ast.walk(tree)
+                                     if isinstance(n, ast.ClassDef)]
+                for f in cls.body)
+        return n, 0
+
+    slow = fast = 0
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name.startswith("Test"):
+            cls_slow = _is_slow(node.decorator_list)
+            for f in node.body:
+                if (isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and f.name.startswith("test_")):
+                    if cls_slow or _is_slow(f.decorator_list):
+                        slow += 1
+                    else:
+                        fast += 1
+    for node in tree.body:  # top-level test functions
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name.startswith("test_")):
+            if _is_slow(node.decorator_list):
+                slow += 1
+            else:
+                fast += 1
+    return slow, fast
+
+
+def _declared_siblings(path):
+    doc = ast.get_docstring(ast.parse(path.read_text())) or ""
+    m = re.search(r"fast-sibling:", doc)
+    if not m:
+        return None
+    return SIBLING_RE.findall(doc[m.start():])
+
+
+def test_every_slow_test_has_a_fast_sibling():
+    failures = []
+    for path in sorted(TESTS_DIR.glob("test_*.py")):
+        if path.name == Path(__file__).name:
+            continue
+        slow, fast = _audit_module(path)
+        if slow == 0 or fast > 0:
+            continue  # no slow tests, or fast siblings live alongside
+        siblings = _declared_siblings(path)
+        if not siblings:
+            failures.append(
+                f"{path.name}: {slow} slow test(s), no fast test in the "
+                f"module and no 'fast-sibling:' annotation in its docstring")
+            continue
+        for sib in siblings:
+            sib_path = TESTS_DIR / sib
+            if not sib_path.exists():
+                failures.append(f"{path.name}: declared fast sibling "
+                                f"{sib} does not exist")
+                continue
+            _, sib_fast = _audit_module(sib_path)
+            if sib_fast == 0:
+                failures.append(f"{path.name}: declared fast sibling "
+                                f"{sib} collects no fast tests")
+    assert not failures, (
+        "slow-marked e2e tests lost their tier-1 fast siblings:\n  "
+        + "\n  ".join(failures))
+
+
+def test_audit_sees_the_known_slow_modules():
+    """The audit must actually be looking at marks: the PR-4 trim and this
+    PR's barrier e2e are known slow; their presence proves the parser
+    didn't silently go blind (e.g. a marker-style change)."""
+    slow_modules = {p.name for p in sorted(TESTS_DIR.glob("test_*.py"))
+                    if p.name != Path(__file__).name
+                    and _audit_module(p)[0] > 0}
+    assert "test_elastic_e2e.py" in slow_modules
+    assert "test_models.py" in slow_modules
+    assert {"test_vision.py", "test_pipeline_parallel.py"} <= slow_modules
+
+
+def test_elastic_e2e_siblings_declared_and_fast():
+    """The new barrier e2e is wholly slow — its docstring must name its
+    tier-1 siblings (regression pin for this PR's own contract)."""
+    sibs = _declared_siblings(TESTS_DIR / "test_elastic_e2e.py")
+    assert sibs is not None
+    assert "test_coord_checkpoint.py" in sibs
+    assert "test_elastic_supervisor.py" in sibs

@@ -2,13 +2,19 @@
 """Inspect paddle_tpu distributed checkpoints: header, checksum, spec table.
 
 usage: python tools/ckpt_inspect.py CKPT [CKPT...]
-       python tools/ckpt_inspect.py --dir CKPT_DIR   # every ckpt_* file
+       python tools/ckpt_inspect.py --dir CKPT_DIR   # per-step audit
 
-Prints, per file: magic/format version, payload size, stored vs computed
-CRC32 and the verification verdict (OK / CORRUPT with reason / LEGACY for
-pre-header plain-pickle files), then — when the payload is loadable — a
-table of the saved arrays (tree path, shape, dtype) with their recorded
-PartitionSpecs, plus the non-array scalars (epoch/step cursors etc.).
+Per file: magic/format version, payload size, stored vs computed CRC32 and
+the verification verdict (OK / CORRUPT with reason / LEGACY for pre-header
+plain-pickle files), then — when the payload is loadable — a table of the
+saved arrays (tree path, shape, dtype) with their recorded PartitionSpecs,
+plus the non-array scalars (epoch/step cursors etc.).
+
+`--dir` renders the per-step COMMIT status across the directory first —
+committed / torn-tmp (a `.tmp.prep` prepared by the two-phase coordinated
+save but never renamed: barrier abort, or a host that died between prepare
+and commit) / corrupt — with the newest-valid verdict resume would pick,
+so a barrier abort can be audited without reading pickles.
 """
 from __future__ import annotations
 
@@ -111,16 +117,93 @@ def print_report(info: dict):
         print(f"   {p} = {v}")
 
 
+def dir_status(dirname: str, prefix: str = "ckpt") -> dict:
+    """Per-step commit audit of a checkpoint directory (importable).
+
+    Returns {"steps": [{"step", "status", "reason", "final", "tmps"}, ...]
+    newest first, "newest_valid": step or None}. Status per step:
+    'committed' (final file verifies), 'corrupt' (final file fails
+    header/CRC), 'torn-tmp' (only a `.tmp.prep` barrier tmp exists — the
+    two-phase coordinated save aborted, or the host died between prepare
+    and commit), 'stale-tmp' (only a plain-write `.tmp.*` exists — a
+    single-host atomic save was interrupted; no barrier involved)."""
+    from paddle_tpu.distributed.checkpoint import _step_files, verify
+
+    finals = dict((s, p) for s, p in _step_files(dirname, prefix))
+    tmps: dict = {}
+    if os.path.isdir(dirname):
+        for fn in os.listdir(dirname):
+            if not fn.startswith(prefix + "_") or ".tmp." not in fn:
+                continue
+            try:
+                step = int(fn[len(prefix) + 1:].split(".", 1)[0])
+            except ValueError:
+                continue
+            tmps.setdefault(step, []).append(os.path.join(dirname, fn))
+    steps = []
+    newest_valid = None
+    for step in sorted(set(finals) | set(tmps), reverse=True):
+        final = finals.get(step)
+        entry = {"step": step, "final": final,
+                 "tmps": sorted(tmps.get(step, [])), "reason": None}
+        if final is not None:
+            ok, reason = verify(final)
+            entry["status"] = "committed" if ok else "corrupt"
+            entry["reason"] = reason
+            if ok and newest_valid is None:
+                newest_valid = step
+        else:
+            # only the barrier's .tmp.prep means "prepared but never
+            # committed" — an interrupted PLAIN atomic write also leaves
+            # ckpt_<step>.tmp.<suffix> and must not read as a barrier abort
+            entry["status"] = ("torn-tmp" if any(
+                p.endswith(".tmp.prep") for p in entry["tmps"])
+                else "stale-tmp")
+        steps.append(entry)
+    return {"steps": steps, "newest_valid": newest_valid}
+
+
+def print_dir_report(dirname: str, st: dict):
+    print(f"== {dirname} (per-step commit status)")
+    if not st["steps"]:
+        print("   no checkpoint files")
+        return
+    for e in st["steps"]:
+        line = f"   step {e['step']:>8d}  {e['status']:9s}"
+        if e["status"] == "corrupt":
+            line += f"  {e['reason']}"
+        elif e["status"] == "torn-tmp":
+            line += ("  prepared but never committed (barrier abort, or "
+                     "host died between prepare and commit)")
+        elif e["status"] == "stale-tmp":
+            line += ("  interrupted plain write (no barrier involved); "
+                     "safe to GC")
+        if e["tmps"] and e["status"] not in ("torn-tmp", "stale-tmp"):
+            line += f"  [+{len(e['tmps'])} stale tmp]"
+        print(line)
+    nv = st["newest_valid"]
+    if nv is None:
+        print("   newest-valid: NONE — resume would start fresh")
+    else:
+        print(f"   newest-valid: step {nv} — single-host resume picks it; "
+              f"a coordinated fleet resumes from the minimum of every "
+              f"host's newest-valid")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("paths", nargs="*", help="checkpoint files")
-    ap.add_argument("--dir", help="inspect every ckpt_* file in a directory")
+    ap.add_argument("--dir", help="audit a checkpoint directory: per-step "
+                                  "commit status + every ckpt_* file")
     args = ap.parse_args(argv)
     paths = list(args.paths)
     if args.dir:
-        from paddle_tpu.distributed.checkpoint import _step_files
-        paths += [p for _, p in _step_files(args.dir, "ckpt")]
+        st = dir_status(args.dir)
+        print_dir_report(args.dir, st)
+        paths += [e["final"] for e in st["steps"] if e["final"]]
     if not paths:
+        if args.dir:
+            return 0
         ap.error("no checkpoint files given")
     bad = 0
     for p in paths:

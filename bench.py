@@ -37,10 +37,11 @@ _STEP_RECORDS = []
 
 
 def _observability_snapshot():
-    """Metrics-registry snapshot + retrace summary + step records, folded
-    into the bench JSON so each round's perf line carries its own
-    observability data (PR 2). Never raises — the bench must stay
-    unkillable."""
+    """Metrics-registry snapshot + retrace summary + step records +
+    compile attribution + device-vs-host split + recent structured events,
+    folded into the bench JSON so each round's perf line carries its own
+    observability data (PR 2, extended in the fleet-observability PR).
+    Never raises — the bench must stay unkillable."""
     out = {}
     try:
         from paddle_tpu.profiler import metrics as _metrics
@@ -55,8 +56,62 @@ def _observability_snapshot():
         out["retrace_events"] = [e.to_dict() for e in list(wd.events)[-10:]]
     except Exception as e:
         out["retrace_error"] = f"{type(e).__name__}: {e}"
+    try:
+        # XLA compile cost per entry point (jax.monitoring feed): the
+        # relaunch/cold-start story in numbers
+        from paddle_tpu.profiler import compile_watch
+        out["compile_attribution"] = compile_watch.summary()
+    except Exception as e:
+        out["compile_error"] = f"{type(e).__name__}: {e}"
+    try:
+        out["device_time"] = _device_time_probe()
+    except Exception as e:
+        out["device_time_error"] = f"{type(e).__name__}: {e}"
+    try:
+        from paddle_tpu.profiler import events as _events
+        out["events_tail"] = _events.recent(20)
+    except Exception as e:
+        out["events_error"] = f"{type(e).__name__}: {e}"
     out["step_records"] = list(_STEP_RECORDS)[-10:]
     return out
+
+
+def _device_time_probe():
+    """Per-op host-dispatch vs device-execution split on a handful of
+    representative eager ops (profiler/device_time.py). On CPU (and by
+    default on TPU) device times are roofline ESTIMATES from the cost
+    model and labeled so; `PADDLE_TPU_DEVICE_TIME=sync` measures real
+    completion at the price of serialized dispatch."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import device_time
+    from paddle_tpu.profiler.recorder import get_recorder
+
+    rng = np.random.default_rng(0)
+    a = paddle.to_tensor(rng.normal(size=(256, 256)).astype("float32"))
+    b = paddle.to_tensor(rng.normal(size=(256, 256)).astype("float32"))
+    rec = get_recorder()
+    was = rec.enabled
+    rec.clear()
+    rec.enabled = True
+    try:
+        for _ in range(3):  # first pass compiles; later passes are steady
+            c = paddle.matmul(a, b)
+            d = paddle.nn.functional.softmax(c)
+            (d + c).mean()
+    finally:
+        rec.enabled = was
+    rows = device_time.split_rows(rec.collect())
+    platform, peak_flops, peak_bw = device_time.platform_peaks()
+    return {
+        "rows": rows,
+        "mode": "measured" if device_time.sync_mode() else "estimate",
+        "platform": platform,
+        "note": ("host_ms is dispatch latency; device_ms is roofline-"
+                 "estimated from cost-model flops/bytes at peaks "
+                 f"({peak_flops:.3g} FLOP/s, {peak_bw:.3g} B/s) unless "
+                 "mode=measured (PADDLE_TPU_DEVICE_TIME=sync)"),
+    }
 
 
 def _run_config(step, args, iters=ITERS, warmup=WARMUP):
@@ -95,11 +150,17 @@ def _run_config(step, args, iters=ITERS, warmup=WARMUP):
         retrace0 = get_watchdog().total_retraces()
     except Exception:
         retrace0 = None
+    try:
+        from paddle_tpu.profiler import server as _obs_server
+    except Exception:
+        _obs_server = None
     t0 = time.perf_counter()
     for _ in range(iters):
         t += 1
         loss, params, buffers, opt_state = compiled(
             params, buffers, opt_state, rng, lr, t, *arrs)
+        if _obs_server is not None:
+            _obs_server.note_step(t)  # /healthz liveness while benching
     final_loss = float(loss)  # device sync
     dt = time.perf_counter() - t0
     # one step-window observability record per timed run (PR 2 schema)
@@ -654,6 +715,11 @@ def main():
                 f"{PEAK_FLOPS/1e12:.0f} TFLOP/s bf16; " + ESTIMATES_NOTE,
     }
     configs = result["configs"]
+    try:
+        from paddle_tpu.profiler import server as _obs_server
+        _obs_server.maybe_start_server()  # PADDLE_TPU_METRICS_PORT opt-in
+    except Exception:
+        pass
     init_err = _init_backend_with_retry()
     if init_err is not None:
         result["error"] = f"jax backend init failed after retries: {init_err}"

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
+from ..profiler import events as _events_mod
 from ..profiler import metrics as _metrics_mod
 
 _REG = _metrics_mod.default_registry()
@@ -66,7 +67,8 @@ _M_PREEMPT = _REG.counter(
     "final synchronous saves performed by the SIGTERM preemption handler")
 _M_RESHARD_FALLBACK = _REG.counter(
     "checkpoint_reshard_fallback_total",
-    "arrays whose saved sharding could not be applied and were replicated")
+    "arrays whose saved sharding could not be applied and were "
+    "replicated, by tree path")
 _M_SAVE_SECONDS = _REG.histogram("checkpoint_save_seconds",
                                  "wall time of checkpoint writes")
 _M_BARRIER_WAIT = _REG.histogram(
@@ -397,6 +399,13 @@ class CheckpointCoordinator:
     preemption save before the next step, or a step retried after an
     aborted round) gets a fresh barrier instead of being decided by the
     previous round's stale votes or abort flag.
+    Resolved rounds' store keys are garbage-collected with a lag of
+    ``GC_LAG`` rounds: when round R resolves (commit or abort), each host
+    deletes its OWN prep key and the abort flag of round R-2 — lockstep
+    guarantees nobody can still be reading that round — so flags no longer
+    accrete in the master store for the job's lifetime (same rule for
+    resume-negotiation keys).
+
     Give the coordinator its own store client connection: the native store
     client is a single socket and is not thread-safe across subsystems.
 
@@ -437,9 +446,47 @@ class CheckpointCoordinator:
         self.poll_interval = float(poll_interval)
         self._resume_round = 0
         self._commit_round = 0
+        self._round_steps: Dict[int, int] = {}  # round id -> step (for GC)
 
     def _k(self, *parts) -> str:
         return "/".join((self.namespace,) + tuple(str(p) for p in parts))
+
+    # -- store-key GC --------------------------------------------------------
+    GC_LAG = 2  # rounds a resolved round's keys outlive it
+
+    def _gc_round_keys(self, finished_round: int):
+        """Lag-2 deletion of this host's OWN keys for a long-resolved
+        round, so prep/abort flags stop accreting in the master store for
+        the job's lifetime. Safe by lockstep on the COMMIT path:
+        completing round R with all votes proves every host voted in R,
+        hence left round R-1 — nobody can still be reading round R-2's
+        keys. On a TIMEOUT path a host lagging two full rounds behind
+        could miss a just-deleted R-2 abort flag and burn its own timeout
+        before aborting — the same abort outcome, reached slowly, never a
+        torn commit. Best-effort: a failed delete costs memory on the
+        master, never correctness."""
+        r = finished_round - self.GC_LAG
+        step = self._round_steps.pop(r, None)
+        if step is None:
+            return
+        for key in (self._k("prep", r, step, self.rank),
+                    self._k("abort", r, step)):
+            try:
+                self.store.delete_key(key)
+            except Exception:
+                pass
+
+    def _gc_resume_keys(self, finished_round: int):
+        """Same lag-2 rule for resume-negotiation keys."""
+        r = finished_round - self.GC_LAG
+        if r < 1:  # resume rounds start at 1
+            return
+        for key in (self._k("resume", r, self.rank),
+                    self._k("resume_abort", r)):
+            try:
+                self.store.delete_key(key)
+            except Exception:
+                pass
 
     def _wait_keys(self, keys, deadline: float,
                    abort_key: Optional[str] = None) -> str:
@@ -464,12 +511,15 @@ class CheckpointCoordinator:
         entered itself (commit passes its own round explicitly)."""
         if round_id is None:
             round_id = self._commit_round
+        self._round_steps.setdefault(int(round_id), int(step))
         try:
             self.store.set(self._k("abort", int(round_id), int(step)), reason)
         except Exception:
             pass  # store gone: peers will hit their own timeout
         if _metrics_mod.enabled():
             _M_BARRIER_ABORTS.inc(reason=reason)
+        _events_mod.emit("barrier_abort", severity="warn", step=int(step),
+                         round=int(round_id), reason=reason)
 
     def abort_next_round(self, step: int, reason: str = "error"):
         """Poison and CONSUME the round this host would run for `step` —
@@ -494,6 +544,7 @@ class CheckpointCoordinator:
         # see a previous round's votes or abort flag
         round_id = self._commit_round
         self._commit_round += 1
+        self._round_steps[round_id] = step
         abort_key = self._k("abort", round_id, step)
         try:
             # a kill injected here (host dies between prepare and commit)
@@ -513,10 +564,12 @@ class CheckpointCoordinator:
             if outcome != "ok":
                 reason = "peer_abort" if outcome == "abort" else "timeout"
                 self.mark_abort(step, reason, round_id)
+                self._gc_round_keys(round_id)
                 return False
             if self.store.check(abort_key):
                 # a slower host timed out after we saw all votes: honor it
                 self.mark_abort(step, "peer_abort", round_id)
+                self._gc_round_keys(round_id)
                 return False
             # publish_fn is the LAST in-phase operation: anything after the
             # rename that could fail would mark_abort a round this host has
@@ -528,6 +581,8 @@ class CheckpointCoordinator:
             raise
         if _metrics_mod.enabled():
             _M_BARRIER_COMMITS.inc()
+        _events_mod.emit("barrier_commit", step=step, round=round_id)
+        self._gc_round_keys(round_id)
         return True
 
     def negotiate_resume(self, local_step: Optional[int]) -> Optional[int]:
@@ -568,6 +623,7 @@ class CheckpointCoordinator:
                 f"would resume a different one. Relaunch the fleet "
                 f"together (the elastic supervisor does this).")
         steps = [int(self.store.get(k).decode()) for k in keys]
+        self._gc_resume_keys(self._resume_round)
         if any(s < 0 for s in steps):
             return None
         return min(steps)

@@ -36,6 +36,7 @@ from .._jax_compat import shard_map
 
 from ..cost_model import array_bytes as _array_bytes
 from ..framework.tensor import Tensor
+from ..profiler import events as _events_mod
 from ..profiler import metrics as _metrics_mod
 
 _REG = _metrics_mod.default_registry()
@@ -44,8 +45,9 @@ _M_COLL_CALLS = _REG.counter(
     "eager collective launches by kind and link class (ici/dcn)")
 _M_COLL_BYTES = _REG.counter(
     "collective_bytes_total",
-    "estimated per-device bytes moved by eager collectives, attributed to "
-    "the slowest link the group's mesh axes cross (cluster-mapper pricing)")
+    "estimated per-device bytes moved by eager collectives, by kind, "
+    "attributed to the slowest link the group's mesh axes cross "
+    "(cluster-mapper pricing)")
 _M_COLL_TIMEOUT = _REG.counter(
     "collective_timeout_total",
     "eager collectives that exceeded the deadline (or hit the armed "
@@ -251,6 +253,75 @@ def _deadline_seconds() -> float:
 def _timed_out(kind: str, group: Group):
     if _metrics_mod.enabled():
         _M_COLL_TIMEOUT.inc(kind=kind, group=group.name)
+    _events_mod.emit("collective_timeout", severity="error",
+                     collective=kind, group=group.name, rank=_proc_rank())
+
+
+class _GuardWorker:
+    """A long-lived watchdog thread serving guarded eager collectives,
+    instead of a spawn+join per call (thread creation on the per-op eager
+    path costs ~100us and churns native stacks). A `None` job is the exit
+    sentinel (surplus workers retire instead of idling forever)."""
+
+    def __init__(self):
+        import queue
+        self.jobs: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.thread = threading.Thread(target=self._loop, daemon=True,
+                                       name="collective-guard-worker")
+        self.thread.start()
+
+    def _loop(self):
+        while True:
+            job = self.jobs.get()
+            if job is None:
+                return
+            thunk, box, done = job
+            try:
+                r = thunk()
+                jax.block_until_ready(r)  # deadline covers completion, not
+                box["v"] = r              # just the async enqueue
+            except BaseException as e:
+                box["e"] = e
+            done.set()
+
+
+_guard_worker: Optional[_GuardWorker] = None
+_guard_worker_lock = threading.Lock()
+_guard_worker_spawns = 0  # regression-test hook: reuse means this is flat
+
+
+def _run_on_guard_worker(thunk, timeout: float):
+    """Run `thunk` on a pooled watchdog worker, bounded by `timeout`.
+    Returns the result box, or None on deadline.
+
+    Check-out/check-in: the ONE pooled worker is taken exclusively for the
+    job's duration, so sequential guarded collectives (the only real
+    pattern — they come from the train loop) reuse a single thread, while
+    a concurrent caller finding the pool empty gets its own fresh worker
+    and its deadline never includes another caller's thunk. On return, the
+    worker goes back to the pool (or retires if the pool refilled). A
+    timed-out worker is simply ABANDONED — never checked back in — because
+    its thread may be wedged inside the hung collective and Python cannot
+    cancel it; abandoning it can never touch a healthy worker another
+    thread is using."""
+    global _guard_worker, _guard_worker_spawns
+    with _guard_worker_lock:
+        w = _guard_worker
+        _guard_worker = None  # checked out (exclusive) while running
+        if w is None or not w.thread.is_alive():
+            w = _GuardWorker()
+            _guard_worker_spawns += 1
+    box: dict = {}
+    done = threading.Event()
+    w.jobs.put((thunk, box, done))
+    if not done.wait(timeout):
+        return None  # abandoned: may still be executing the hung thunk
+    with _guard_worker_lock:
+        if _guard_worker is None:
+            _guard_worker = w  # back in the pool for the next call
+        else:
+            w.jobs.put(None)  # pool refilled concurrently: retire this one
+    return box
 
 
 def _guard_collective(kind: str, group: Group, thunk):
@@ -275,23 +346,10 @@ def _guard_collective(kind: str, group: Group, thunk):
     timeout = _deadline_seconds()
     if timeout <= 0:
         return thunk()
-    box: dict = {}
-
-    def run():
-        try:
-            r = thunk()
-            jax.block_until_ready(r)  # deadline covers completion, not
-            box["v"] = r              # just the async enqueue
-        except BaseException as e:
-            box["e"] = e
-
-    t = threading.Thread(target=run, daemon=True,
-                         name=f"collective-{kind}-watchdog")
-    t.start()
-    t.join(timeout)
-    if t.is_alive():
-        # the daemon thread is abandoned, not cancelled (Python can't), so
-        # a slow-but-alive fleet may still complete this collective later:
+    box = _run_on_guard_worker(thunk, timeout)
+    if box is None:
+        # the worker is abandoned, not cancelled (Python can't), so a
+        # slow-but-alive fleet may still complete this collective later:
         # recover by restarting the process, not the loop — see the
         # CollectiveTimeoutError docstring
         _timed_out(kind, group)

@@ -31,6 +31,7 @@ import time
 import warnings
 from typing import Dict, List, Optional, Sequence
 
+from ...profiler import events as _events_mod
 from ...profiler import metrics as _metrics_mod
 
 ELASTIC_EXIT_CODE = 101
@@ -344,6 +345,8 @@ class ElasticSupervisor:
             return False
         if _metrics_mod.enabled():
             _M_RESTARTS.inc(reason=reason)
+        _events_mod.emit("elastic_restart", severity="warn", reason=reason,
+                         restart=self.restarts, budget=self.max_restarts)
         warnings.warn(
             f"elastic supervisor: restarting trainer "
             f"({self.restarts}/{self.max_restarts}, reason: {reason})")

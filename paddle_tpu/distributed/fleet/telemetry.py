@@ -1,0 +1,329 @@
+"""Fleet metrics plane: per-host step digests + rank-0 aggregation +
+cross-host straggler detection.
+
+The PR-5 multi-host runtime coordinates hosts (barrier, supervisor,
+heter-PS pipeline) but gives the operator no cross-host view: which host is
+slow, whose steps stalled, who aborted the round. This module closes it
+with the same transport the runtime already trusts — the retry-wrapped
+TCPStore:
+
+* every host runs a :class:`FleetReporter`: per train step it folds the
+  measured step wall into a rolling window and publishes a compact JSON
+  digest under ``obs/digest/<rank>`` (step index, wall p50, data-wait
+  fraction, barrier-wait and heter-stage seconds pulled from the local
+  metrics registry) — one small ``store.set`` per step;
+* rank 0 (and/or any supervisor holding a store connection) runs a
+  :class:`FleetAggregator`: each ``collect()`` reads every rank's digest,
+  mirrors it into the local registry as ``fleet_*`` gauges labeled
+  ``host=`` (so the ObservabilityServer's `/metrics` serves the whole
+  fleet from one scrape), and runs straggler detection: a host whose
+  rolling step-wall p50 exceeds the fleet median by
+  ``PADDLE_TPU_STRAGGLER_FACTOR`` (default 2.0) enters the straggler set,
+  emitting exactly ONE ``fleet_straggler`` event (+
+  ``fleet_straggler_total{host=}``) per excursion; it re-arms after the
+  host returns under the threshold.
+
+Chaos hook: ``FleetReporter.note_step`` declares the ``fleet.step`` fault
+site — arm it with ``fleet.step=N:delay`` (sleep length
+``PADDLE_TPU_FAULT_DELAY``) to turn any host into a straggler without
+touching the model.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ...fault import site as _fault_site
+from ...profiler import events as _events_mod
+from ...profiler import metrics as _metrics_mod
+
+__all__ = ["FleetReporter", "FleetAggregator", "reporter_from_env",
+           "aggregator_from_env", "DIGEST_KEY_FMT"]
+
+DIGEST_KEY_FMT = "obs/digest/{rank}"
+
+_REG = _metrics_mod.default_registry()
+_M_LAST_STEP = _REG.gauge(
+    "fleet_last_step",
+    "newest step index each host's digest reports, by host")
+_M_STEP_AGE = _REG.gauge(
+    "fleet_step_age_seconds",
+    "age of each host's newest digest at collect time, by host — a growing "
+    "age with a fixed step means the host stalled or died")
+_M_WALL_P50 = _REG.gauge(
+    "fleet_step_wall_p50_seconds",
+    "each host's rolling step-wall median from its digest, by host")
+_M_DATA_WAIT = _REG.gauge(
+    "fleet_data_wait_frac",
+    "each host's reported DataLoader wait fraction, by host")
+_M_STRAGGLER = _REG.counter(
+    "fleet_straggler_total",
+    "straggler excursions detected (host p50 exceeded fleet median by the "
+    "configured factor), by host")
+
+
+def _hist_sum(name: str) -> float:
+    """Total seconds accumulated by a local histogram family (all series)."""
+    m = _REG.get(name)
+    if m is None:
+        return 0.0
+    try:
+        return float(sum(v.get("sum", 0.0) for v in m.snapshot()["values"]))
+    except Exception:
+        return 0.0
+
+
+class FleetReporter:
+    """Publishes this host's per-step digest to the TCPStore.
+
+    Drive it with :meth:`note_step` once per train step (the profiler's
+    liveness tracker does this automatically when the reporter is
+    installed); walls are measured between consecutive notes, or pass
+    ``wall_s`` explicitly (tests, custom loops)."""
+
+    def __init__(self, store, rank: int, window: Optional[int] = None,
+                 min_interval_s: Optional[float] = None,
+                 host: Optional[str] = None):
+        self.store = store
+        self.rank = int(rank)
+        # the digest's host identity; overridable for multi-reporter tests
+        # (every real rank is its own process with its own endpoint id)
+        self.host = host or _events_mod.host_id()
+        if window is None:
+            window = int(os.environ.get("PADDLE_TPU_DIGEST_WINDOW", "20"))
+        self.walls: "deque[float]" = deque(maxlen=max(int(window), 2))
+        if min_interval_s is None:
+            # every note still feeds the rolling window, but the store RPC
+            # is rate-limited: a per-step synchronous publish would sit in
+            # the timed train/bench loop AND congest the one rendezvous
+            # store the checkpoint barrier polls at fleet scale
+            min_interval_s = float(
+                os.environ.get("PADDLE_TPU_DIGEST_INTERVAL", "0.5"))
+        self.min_interval_s = float(min_interval_s)
+        self._last_note: Optional[float] = None
+        self._last_publish = 0.0
+        self._last_reader_wait = 0.0
+        self._last_reader_ts: Optional[float] = None
+        self._fail_streak = 0
+        self._disabled = False
+
+    #: consecutive publish failures before the reporter gives up (the
+    #: store client already retries internally per call, so a streak this
+    #: long means the store is gone, not hiccuping)
+    MAX_FAIL_STREAK = 3
+
+    def note_step(self, step: int, wall_s: Optional[float] = None):
+        """Record one completed train step and (rate-limited) publish the
+        digest. Never raises — telemetry must not take down training."""
+        # chaos: an armed `fleet.step=N:delay` sleeps here, inflating the
+        # measured wall exactly like a slow host would
+        try:
+            _fault_site("fleet.step")
+        except Exception:
+            pass  # only delay/no-op kinds make sense here; ignore others
+        now = time.perf_counter()
+        if wall_s is None:
+            wall_s = (now - self._last_note) if self._last_note is not None \
+                else None
+        self._last_note = now
+        if wall_s is not None:
+            self.walls.append(float(wall_s))
+        if self._disabled:
+            return
+        if time.time() - self._last_publish < self.min_interval_s:
+            return
+        try:
+            self.publish(step)
+            self._fail_streak = 0
+        except Exception:
+            # one failed publish is a hiccup (a store blip during a
+            # barrier); only a STREAK of them means the store is gone —
+            # then stop trying rather than stall the train loop
+            self._fail_streak += 1
+            if self._fail_streak >= self.MAX_FAIL_STREAK:
+                self._disabled = True
+
+    def _data_wait_frac(self) -> Optional[float]:
+        """DataLoader wait fraction since the previous digest, from the
+        global Benchmark reader averager."""
+        try:
+            from ...profiler.timer import benchmark
+            wait = float(benchmark().reader.total_time)
+        except Exception:
+            return None
+        now = time.perf_counter()
+        frac = None
+        if self._last_reader_ts is not None:
+            dt = now - self._last_reader_ts
+            if dt > 0:
+                frac = max(0.0, min(1.0, (wait - self._last_reader_wait) / dt))
+        self._last_reader_ts = now
+        self._last_reader_wait = wait
+        return frac
+
+    def digest(self, step: int) -> dict:
+        p50 = statistics.median(self.walls) if self.walls else None
+        return {
+            "rank": self.rank,
+            "host": self.host,
+            "step": int(step),
+            "ts": time.time(),
+            "wall_p50_s": p50,
+            "last_wall_s": self.walls[-1] if self.walls else None,
+            "window": len(self.walls),
+            "data_wait_frac": self._data_wait_frac(),
+            "barrier_wait_s": round(_hist_sum("ckpt_barrier_wait_seconds"), 6),
+            "heter": {
+                "route_s": round(_hist_sum("heter_route_seconds"), 6),
+                "pull_s": round(_hist_sum("heter_pull_seconds"), 6),
+                "push_s": round(_hist_sum("heter_push_seconds"), 6),
+                "step_wall_s": round(_hist_sum("heter_step_wall_seconds"), 6),
+            },
+        }
+
+    def publish(self, step: int):
+        self.store.set(DIGEST_KEY_FMT.format(rank=self.rank),
+                       json.dumps(self.digest(step)))
+        self._last_publish = time.time()
+
+
+class FleetAggregator:
+    """Merges every host's digest into fleet_* gauges + straggler events.
+
+    Thread-safe (the ObservabilityServer scrapes from handler threads and
+    the native store client is one socket)."""
+
+    MIN_WINDOW = 3  # digests with fewer walls don't vote (startup noise)
+
+    def __init__(self, store, world_size: int,
+                 straggler_factor: Optional[float] = None):
+        self.store = store
+        self.world_size = int(world_size)
+        if straggler_factor is None:
+            straggler_factor = float(
+                os.environ.get("PADDLE_TPU_STRAGGLER_FACTOR", "2.0"))
+        self.straggler_factor = float(straggler_factor)
+        self._lock = threading.Lock()
+        self._straggling: set = set()
+        self.last: Dict[int, dict] = {}
+
+    def collect(self) -> Dict[int, dict]:
+        """Read every rank's digest, mirror into the registry, run the
+        straggler check. Returns {rank: digest} for what was readable."""
+        with self._lock:
+            out: Dict[int, dict] = {}
+            for r in range(self.world_size):
+                key = DIGEST_KEY_FMT.format(rank=r)
+                try:
+                    if not self.store.check(key):
+                        continue
+                    out[r] = json.loads(self.store.get(key).decode())
+                except Exception:
+                    continue
+            self.last = out
+            now = time.time()
+            m_on = _metrics_mod.enabled()
+            for r, d in out.items():
+                host = d.get("host", f"rank-{r}")
+                if m_on:
+                    _M_LAST_STEP.set(d.get("step", -1), host=host)
+                    _M_STEP_AGE.set(max(0.0, now - d.get("ts", now)),
+                                    host=host)
+                    if d.get("wall_p50_s") is not None:
+                        _M_WALL_P50.set(d["wall_p50_s"], host=host)
+                    if d.get("data_wait_frac") is not None:
+                        _M_DATA_WAIT.set(d["data_wait_frac"], host=host)
+            self._detect_stragglers(out)
+            return out
+
+    def _detect_stragglers(self, digests: Dict[int, dict]):
+        """One `fleet_straggler` event per excursion: emitted when a host's
+        rolling p50 first exceeds factor x the median of the OTHER hosts'
+        p50s, re-armed when it returns under. Leave-one-out matters: in a
+        small fleet a straggler inflates a plain fleet median enough to
+        hide itself (2 hosts at 10ms/100ms have median 55ms — the slow one
+        would pass a 2x check against it)."""
+        voting = {d.get("host", f"rank-{r}"): d["wall_p50_s"]
+                  for r, d in digests.items()
+                  if d.get("wall_p50_s") is not None
+                  and d.get("window", 0) >= self.MIN_WINDOW}
+        if len(voting) < 2:
+            return  # a fleet of one has no straggler semantics
+        for host, p50 in voting.items():
+            others = [v for h, v in voting.items() if h != host]
+            baseline = statistics.median(others)
+            if baseline <= 0:
+                continue
+            if p50 > self.straggler_factor * baseline:
+                if host not in self._straggling:
+                    self._straggling.add(host)
+                    if _metrics_mod.enabled():
+                        _M_STRAGGLER.inc(host=host)
+                    _events_mod.emit(
+                        "fleet_straggler", severity="warn", straggler=host,
+                        p50_s=round(p50, 6),
+                        fleet_median_s=round(baseline, 6),
+                        factor=self.straggler_factor)
+            else:
+                self._straggling.discard(host)
+
+    def straggling(self) -> List[str]:
+        with self._lock:
+            return sorted(self._straggling)
+
+    def snapshot(self) -> dict:
+        """JSON view for the server's /snapshot endpoint."""
+        with self._lock:
+            return {"world_size": self.world_size,
+                    "straggler_factor": self.straggler_factor,
+                    "straggling": sorted(self._straggling),
+                    "hosts": {str(r): d for r, d in self.last.items()}}
+
+
+def _store_from_env(timeout: int = 10):
+    from ..store import TCPStore
+    addr = os.environ.get("MASTER_ADDR")
+    port = os.environ.get("MASTER_PORT")
+    if not addr or not port:
+        return None
+    try:
+        return TCPStore(addr, int(port), is_master=False, timeout=timeout)
+    except Exception:
+        return None
+
+
+def reporter_from_env() -> Optional[FleetReporter]:
+    """A FleetReporter from the trainer env contract (own store
+    connection), or None for single-host jobs / no master reachable."""
+    try:
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return None
+    if world < 2:
+        return None
+    store = _store_from_env()
+    if store is None:
+        return None
+    return FleetReporter(store, rank)
+
+
+def aggregator_from_env() -> Optional[FleetAggregator]:
+    """A FleetAggregator for rank 0 of a >=2 fleet (own store connection),
+    else None."""
+    try:
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return None
+    if world < 2 or rank != 0:
+        return None
+    store = _store_from_env()
+    if store is None:
+        return None
+    return FleetAggregator(store, world)

@@ -50,8 +50,9 @@ from ...profiler import metrics as _metrics_mod
 _REG = _metrics_mod.default_registry()
 _M_EVENTS = _REG.counter(
     "embed_cache_events_total",
-    "hot-row embedding cache events by kind (hit/miss/eviction/writeback "
-    "are per ROW, overflow counts rows that found no slot)")
+    "hot-row embedding cache events by event kind and table "
+    "(hit/miss/eviction/writeback are per ROW, overflow counts rows that "
+    "found no slot)")
 
 # optimizers whose server-side update is linear in the pushed gradient, so
 # deferring the push to eviction/flush is numerically equivalent. The local

@@ -106,8 +106,9 @@ _H_PULL = _REG.histogram("heter_pull_seconds",
                          "heter-PS sparse pull stage latency (RPC round)")
 _H_PUSH = _REG.histogram("heter_push_seconds",
                          "heter-PS sparse push stage latency (incl. D2H)")
-_H_STEP = _REG.histogram("heter_step_wall_seconds",
-                         "heter-PS per-step wall time on the main thread")
+_H_STEP = _REG.histogram(
+    "heter_step_wall_seconds",
+    "heter-PS per-step wall time on the main thread, by mode")
 
 
 def _capturing() -> Optional[list]:

@@ -11,7 +11,7 @@ Spec grammar (semicolon-separated clauses)::
 
     spec   := clause (';' clause)*
     clause := site '=' count ['@' start] [':' kind]
-    kind   := 'error' | 'timeout' | 'oserror' | 'kill'
+    kind   := 'error' | 'timeout' | 'oserror' | 'kill' | 'delay'
 
 `count` occurrences are faulted starting at the `start`-th call of the
 site (1-based, default 1).  Occurrences are counted per process.  Examples:
@@ -19,19 +19,27 @@ site (1-based, default 1).  Occurrences are counted per process.  Examples:
     store.get=2                 fail the first two store.get calls
     ps.pull_dense=1@3           fail only the third pull_dense RPC
     dataloader.worker0=1:kill   worker 0 os._exit()s on its first batch
+    fleet.step=100:delay        slow this host's steps (straggler chaos)
+
+`delay` raises nothing: it sleeps `PADDLE_TPU_FAULT_DELAY` seconds
+(default 0.05) at the site — the "slow host, not dead host" failure mode
+the fleet straggler detector exists for.
 
 Every injected fault increments `fault_injected_total{site=,kind=}` in the
-metrics registry, so a chaos run's recovery story is auditable from the
-prometheus/JSON snapshot alongside the retry counters.
+metrics registry AND lands one `fault_injected` event in the unified event
+log, so a chaos run's recovery story is auditable from the prometheus/JSON
+snapshot alongside the retry counters.
 """
 from __future__ import annotations
 
 import os
 import threading
+import time
 import warnings
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from ..profiler import events as _events_mod
 from ..profiler import metrics as _metrics_mod
 
 SPEC_ENV = "PADDLE_TPU_FAULT_SPEC"
@@ -73,7 +81,7 @@ class DeviceOOMError(RuntimeError):
         self.bytes_estimate = int(bytes_estimate)
 
 
-_KINDS = ("error", "timeout", "oserror", "kill")
+_KINDS = ("error", "timeout", "oserror", "kill", "delay")
 
 
 @dataclass
@@ -182,10 +190,26 @@ class FaultInjector:
             kind = rule.kind
         if _metrics_mod.enabled():
             _M_INJECTED.inc(site=name, kind=kind)
+        _events_mod.emit("fault_injected", severity="warn",
+                         site=name, fault_kind=kind)
         if kind == "kill":
             # simulate a preemption / OOM-kill of this process: no cleanup,
             # no exception propagation — the parent sees a corpse
             os._exit(17)
+        if kind == "delay":
+            # slow, not dead: the straggler failure mode — nothing raises,
+            # including on a garbled PADDLE_TPU_FAULT_DELAY (delay is legal
+            # at ANY site; a ValueError escaping here would crash the op
+            # with an error unrelated to the slow-host semantics)
+            raw = os.environ.get("PADDLE_TPU_FAULT_DELAY", "0.05")
+            try:
+                delay = float(raw)
+            except ValueError:
+                warnings.warn(f"PADDLE_TPU_FAULT_DELAY={raw!r} is not a "
+                              f"number; using 0.05s")
+                delay = 0.05
+            time.sleep(delay)
+            return
         if kind == "timeout":
             raise InjectedTimeout(f"injected timeout at fault site {name!r}")
         if kind == "oserror":

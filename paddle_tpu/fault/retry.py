@@ -18,6 +18,7 @@ import os
 import time
 from typing import Callable, Optional, Tuple, Type
 
+from ..profiler import events as _events_mod
 from ..profiler import metrics as _metrics_mod
 
 _REG = _metrics_mod.default_registry()
@@ -132,8 +133,11 @@ class RetryPolicy:
         for attempt in range(self.max_attempts):
             try:
                 result = self._run_once(fn, args, kw)
-                if attempt > 0 and record:
-                    _M_RECOVERED.inc(op=name)
+                if attempt > 0:
+                    if record:
+                        _M_RECOVERED.inc(op=name)
+                    _events_mod.emit("retry_recovered", op=name,
+                                     attempts=attempt + 1)
                 return result
             except self.retry_on as e:
                 last = e
@@ -144,6 +148,9 @@ class RetryPolicy:
                 time.sleep(self.delay(attempt))
         if record:
             _M_EXHAUSTED.inc(op=name)
+        _events_mod.emit("retry_exhausted", severity="error", op=name,
+                         attempts=self.max_attempts,
+                         error=f"{type(last).__name__}: {last}")
         raise RetryExhaustedError(name, self.max_attempts, last)
 
     def wrap(self, op: Optional[str] = None):

@@ -113,6 +113,11 @@ class Model:
         then skips the already-consumed steps of the interrupted epoch —
         so a preempted/killed job continues training bit-identically. With
         no checkpoint found (fresh job), training starts from scratch."""
+        # observability plane: with PADDLE_TPU_METRICS_PORT set, /metrics,
+        # /snapshot, /healthz and /events go live for this training job
+        from ..profiler import server as _obs_server
+        _obs_server.maybe_start_server()
+
         train_loader = _as_loader(train_data, batch_size, shuffle, drop_last,
                                   num_workers)
         eval_loader = _as_loader(eval_data, batch_size, False, False,
@@ -179,6 +184,7 @@ class Model:
                 logs = {"loss": loss}
                 cbks.on_train_batch_end(step, logs)
                 it += 1
+                _obs_server.note_step(it)  # /healthz liveness + fleet digest
                 if num_iters is not None and it >= num_iters:
                     self.stop_training = True
                     break

@@ -32,11 +32,11 @@ from ..profiler import metrics as _metrics_mod
 _REG = _metrics_mod.default_registry()
 _M_WORKER_RESTARTS = _REG.counter(
     "dataloader_worker_restarts_total",
-    "dead DataLoader worker processes respawned mid-epoch")
+    "dead DataLoader worker processes respawned mid-epoch, by exitcode")
 _M_WORKER_LOST = _REG.counter(
     "dataloader_worker_lost_total",
-    "iterable-mode workers that died and could not be respawned "
-    "(their shard is lost; the loader degraded to fewer workers)")
+    "iterable-mode workers that died and could not be respawned, by "
+    "exitcode (their shard is lost; the loader degraded to fewer workers)")
 
 _SENTINEL = "__end__"
 

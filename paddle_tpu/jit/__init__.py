@@ -28,6 +28,7 @@ from ..framework import random as random_mod
 from ..framework import tape as tape_mod
 from ..framework.tensor import Tensor
 from ..nn.layer import Layer
+from ..profiler import compile_watch as _compile_watch
 from ..profiler.watchdog import get_watchdog as _get_watchdog
 
 
@@ -152,7 +153,12 @@ class StaticLayer:
             + jax.tree_util.tree_leaves(kw))
         rng = random_mod.default_generator().split() if self.layer.training else \
             jax.random.PRNGKey(0)
-        out, new_buffers = self._jitted(params, buffers, rng, *arr_inputs, **kw)
+        _cw_prev = _compile_watch.push_entry("to_static", self._wd_name)
+        try:
+            out, new_buffers = self._jitted(params, buffers, rng,
+                                            *arr_inputs, **kw)
+        finally:
+            _compile_watch.pop_entry(_cw_prev)
         named_b = dict(self.layer.named_buffers())
         for k, v in new_buffers.items():
             if k in named_b:
@@ -284,7 +290,11 @@ def to_static(layer_or_fn=None, input_spec=None, build_strategy=None, **kw):
             _get_watchdog().observe(
                 "to_static", fn_name,
                 jax.tree_util.tree_leaves(arrs) + list(aux))
-            out = pure(aux, random_mod.default_generator().split(), *arrs)
+            _cw_prev = _compile_watch.push_entry("to_static", fn_name)
+            try:
+                out = pure(aux, random_mod.default_generator().split(), *arrs)
+            finally:
+                _compile_watch.pop_entry(_cw_prev)
             return jax.tree_util.tree_map(Tensor, out)
         return wrapper
 
@@ -375,9 +385,13 @@ class TrainStep:
         # expensive retrace in the system; always worth an event
         _get_watchdog().observe("train_step", self._wd_name,
                                 jax.tree_util.tree_leaves(arrs))
-        loss, self.params, self.buffers, self.opt_state = self._step(
-            self.params, self.buffers, self.opt_state, rng, lr,
-            self._t, *arrs)
+        _cw_prev = _compile_watch.push_entry("train_step", self._wd_name)
+        try:
+            loss, self.params, self.buffers, self.opt_state = self._step(
+                self.params, self.buffers, self.opt_state, rng, lr,
+                self._t, *arrs)
+        finally:
+            _compile_watch.pop_entry(_cw_prev)
         return Tensor(loss)
 
     def state_dict(self):

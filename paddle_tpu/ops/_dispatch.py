@@ -28,6 +28,9 @@ from ..fault.inject import (DeviceOOMError, InjectedFault, InjectedIOError,
 from ..framework import dtype as dtype_mod
 from ..framework import tape as tape_mod
 from ..framework.tensor import Tensor
+from ..profiler import compile_watch as _compile_watch
+from ..profiler import device_time as _device_time
+from ..profiler import events as _events_mod
 from ..profiler import metrics as _metrics_mod
 from ..profiler.recorder import HostSpan, get_recorder, now_ns
 from ..profiler.watchdog import get_watchdog
@@ -56,6 +59,11 @@ _M_DEVICE_OOM = _REG.counter(
     "device_oom_total",
     "eager ops that exhausted device memory (XLA RESOURCE_EXHAUSTED or the "
     "armed device.alloc fault site), by op")
+_M_OP_DEVICE_TIME = _REG.histogram(
+    "op_device_seconds",
+    "device-side execution time by op and src (RECORD windows only; "
+    "src=measured under PADDLE_TPU_DEVICE_TIME=sync, else a roofline "
+    "estimate — see profiler/device_time.py)")
 _op_recorder = get_recorder()
 _fault_injector = default_injector()
 
@@ -334,20 +342,29 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
     outs = result if isinstance(result, tuple) else (result,)
     nbytes = _op_bytes_estimate(
         arrs, [o.data for o in outs if isinstance(o, Tensor)])
+    flops = _op_flops_estimate(name, arrs)
     if _metrics_mod.enabled():
         _M_OP_CALLS.inc(op=name)
         _M_OP_BYTES.inc(nbytes, op=name)
-        _M_OP_FLOPS.inc(_op_flops_estimate(name, arrs), op=name)
+        _M_OP_FLOPS.inc(flops, op=name)
         if tracing:
             _M_OP_TIME.observe((t1 - t0) / 1e9, op=name)
     if tracing:
+        # device-vs-host split: host span = dispatch latency; device time
+        # is measured (sync mode) or roofline-estimated per op
+        dev_ns, dev_src = _device_time.attribute(
+            [o.data for o in outs if isinstance(o, Tensor)],
+            flops, nbytes, t0)
+        if _metrics_mod.enabled():
+            _M_OP_DEVICE_TIME.observe(dev_ns / 1e9, op=name, src=dev_src)
         stack = _op_recorder.span_stack()
         _op_recorder.push(HostSpan(
             name=name, start_ns=t0, end_ns=t1, tid=threading.get_ident(),
             event_type="Operator", parent=stack[-1] if stack else None,
             args={"shapes": [list(getattr(a, "shape", ())) for a in arrs],
                   "dtypes": [str(getattr(a, "dtype", "?")) for a in arrs],
-                  "bytes_est": nbytes}))
+                  "bytes_est": nbytes},
+            device_ns=dev_ns, device_src=dev_src))
     return result
 
 
@@ -364,6 +381,8 @@ def _oom_error(name, arrs, detail: str) -> DeviceOOMError:
         nbytes = 0
     if _metrics_mod.enabled():
         _M_DEVICE_OOM.inc(op=name)
+    _events_mod.emit("device_oom", severity="error", op=name,
+                     bytes_est=nbytes)
     return DeviceOOMError(name, nbytes, detail)
 
 
@@ -390,7 +409,19 @@ def _execute_guarded(impl, kwargs, arrs, tensors, name, requires):
 
 
 def _execute(impl, kwargs, arrs, tensors, name, requires):
-    """The uninstrumented op body: cached-or-traced forward + tape record."""
+    """The uninstrumented op body: cached-or-traced forward + tape record.
+    Labels the thread's compile-attribution entry as `eager:<op>` for the
+    duration, so any XLA compile triggered here (cache staging, jax.vjp,
+    lazy jnp jits) is attributed to this op (two attr writes when nothing
+    compiles)."""
+    _cw_prev = _compile_watch.push_entry("eager", name)
+    try:
+        return _execute_body(impl, kwargs, arrs, tensors, name, requires)
+    finally:
+        _compile_watch.pop_entry(_cw_prev)
+
+
+def _execute_body(impl, kwargs, arrs, tensors, name, requires):
     if requires:
         entry, outs = _try_cached_fwd(impl, kwargs, arrs, name)
         if entry is not None:

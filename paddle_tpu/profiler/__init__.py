@@ -8,9 +8,13 @@ HostEventRecorder, `platform/profiler/host_event_recorder.h`) and exported as
 chrome://tracing JSON; device-side tracing delegates to `jax.profiler`
 (XPlane/TensorBoard), the TPU answer to CUPTI.
 """
+from . import compile_watch
+from . import device_time
+from . import events
 from . import metrics
 from .monitor import (ThroughputMonitor, make_step_record,
                       validate_step_record)
+from . import server
 from .profiler import (Profiler, ProfilerState, ProfilerTarget,
                        export_chrome_tracing, export_protobuf, make_scheduler)
 from .statistic import SortedKeys, StatisticData, summary_report
@@ -18,11 +22,16 @@ from .timer import Benchmark, benchmark
 from .utils import RecordEvent, load_profiler_result
 from .watchdog import RetraceWatchdog, get_watchdog
 
+# subscribe to jax's compile-event stream at import so every XLA compile in
+# the process — including jit warmup before any entry point runs — is
+# attributed (listener cost is nanoseconds per compile event)
+compile_watch.install()
+
 __all__ = [
     'Profiler', 'ProfilerState', 'ProfilerTarget', 'make_scheduler',
     'export_chrome_tracing', 'export_protobuf', 'RecordEvent',
     'load_profiler_result', 'SortedKeys', 'StatisticData', 'summary_report',
-    'Benchmark', 'benchmark', 'metrics', 'ThroughputMonitor',
-    'make_step_record', 'validate_step_record', 'RetraceWatchdog',
-    'get_watchdog',
+    'Benchmark', 'benchmark', 'metrics', 'events', 'compile_watch',
+    'device_time', 'server', 'ThroughputMonitor', 'make_step_record',
+    'validate_step_record', 'RetraceWatchdog', 'get_watchdog',
 ]

@@ -1,0 +1,194 @@
+"""XLA compile attribution: jax.monitoring events -> entry points.
+
+`jax_log_compiles` only gives stderr lines; this module subscribes to the
+same source (`jax.monitoring` duration events, the channel
+`jax_log_compiles` feeds) and attributes every trace/lower/compile to the
+entry point that triggered it — the retrace watchdog names WHAT changed,
+this names WHAT IT COST. Entry points push a thread-local label around the
+calls that may compile (`eager:<op>` in ops/_dispatch, `to_static:<fn>` and
+`train_step:<layer>` in jit/__init__); compiles observed with no label land
+under ``unattributed`` (jax-internal jits, library warmup).
+
+Surfaced three ways:
+
+* metrics: ``xla_compiles_total{entry=}`` (backend compiles) and
+  ``xla_compile_seconds{entry=,phase=}`` histograms (phase: trace / lower /
+  backend_compile), plus ``xla_compile_cache_events_total{event=}`` from
+  jax's persistent compilation cache (hits/misses — the ROADMAP item-5
+  signal);
+* the retrace watchdog's snapshot gains a ``compiles`` section (count +
+  seconds per entry), so one snapshot answers "which entry recompiled and
+  what did it cost";
+* the unified event log gets one ``xla_compile`` event per backend compile.
+
+Also owns the relaunch-to-first-step clock: `PROCESS_T0` is captured when
+`paddle_tpu.profiler` imports (process start for any entry path), and
+`note_first_step()` publishes `relaunch_to_first_step_seconds{generation=}`
+once — the elastic-relaunch cold-start cost the PR-5 supervisor could not
+see.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from . import metrics as metrics_mod
+from . import events as events_mod
+
+__all__ = ["install", "installed", "push_entry", "pop_entry",
+           "current_entry", "summary", "reset", "note_first_step",
+           "PROCESS_T0"]
+
+#: monotonic clock at profiler import — the relaunch-to-first-step origin
+PROCESS_T0 = time.monotonic()
+
+_REG = metrics_mod.default_registry()
+# compile durations span ms (tiny eager ops) to minutes (pod-scale steps)
+_COMPILE_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+_M_COMPILES = _REG.counter(
+    "xla_compiles_total",
+    "XLA backend compiles attributed to the entry point that triggered "
+    "them (eager:<op> / to_static:<fn> / train_step:<layer> / unattributed)")
+_M_COMPILE_SECONDS = _REG.histogram(
+    "xla_compile_seconds",
+    "jax compile-pipeline durations by entry point and phase "
+    "(trace / lower / backend_compile)", buckets=_COMPILE_BUCKETS)
+_M_CACHE_EVENTS = _REG.counter(
+    "xla_compile_cache_events_total",
+    "jax persistent compilation cache events (hits / misses / "
+    "compile_requests)")
+_M_FIRST_STEP = _REG.gauge(
+    "relaunch_to_first_step_seconds",
+    "wall time from process start (profiler import) to the first observed "
+    "train step, by elastic generation — the relaunch cold-start cost "
+    "(import + restore + trace + XLA compile)")
+
+# jax event name -> short phase label
+_PHASES = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "backend_compile",
+    # older jax spellings (kept so the listener survives version drift)
+    "/jax/core/compile/backend_compile_time_duration": "backend_compile",
+}
+_CACHE_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "hit",
+    "/jax/compilation_cache/cache_misses": "miss",
+    "/jax/compilation_cache/compile_requests_use_cache": "request",
+}
+
+_tls = threading.local()
+_lock = threading.Lock()
+_summary: Dict[str, Dict[str, float]] = {}  # entry -> {count, seconds}
+_installed = False
+_first_step_noted = False
+
+
+# -- entry-point labels ------------------------------------------------------
+def push_entry(site: str, name: str):
+    """Mark the current thread as executing entry `site:name`; returns the
+    previous label (pass to pop_entry). Deliberately two attribute ops —
+    this sits on the eager dispatch hot path."""
+    prev = getattr(_tls, "entry", None)
+    _tls.entry = (site, name)
+    return prev
+
+
+def pop_entry(prev):
+    _tls.entry = prev
+
+
+def current_entry() -> str:
+    e = getattr(_tls, "entry", None)
+    return f"{e[0]}:{e[1]}" if e else "unattributed"
+
+
+# -- the jax.monitoring listener ---------------------------------------------
+def _on_duration(event: str, duration_secs: float, **kw):
+    phase = _PHASES.get(event)
+    if phase is None:
+        return
+    try:
+        entry = current_entry()
+        if metrics_mod.enabled():
+            _M_COMPILE_SECONDS.observe(duration_secs, entry=entry,
+                                       phase=phase)
+        if phase == "backend_compile":
+            if metrics_mod.enabled():
+                _M_COMPILES.inc(entry=entry)
+            with _lock:
+                s = _summary.setdefault(entry, {"count": 0, "seconds": 0.0})
+                s["count"] += 1
+                s["seconds"] += float(duration_secs)
+            # feed the retrace watchdog: its snapshot is THE one-stop
+            # retrace view, and an XLA recompile without a watchdog event
+            # (jax-internal cache miss) must still show up there
+            from .watchdog import get_watchdog
+            get_watchdog().record_compile(entry, float(duration_secs))
+            events_mod.emit("xla_compile", entry=entry,
+                            seconds=round(float(duration_secs), 6))
+    except Exception:
+        pass  # a broken listener must never take down jax compilation
+
+
+def _on_event(event: str, **kw):
+    label = _CACHE_EVENTS.get(event)
+    if label is None:
+        return
+    try:
+        if metrics_mod.enabled():
+            _M_CACHE_EVENTS.inc(event=label)
+    except Exception:
+        pass
+
+
+def install() -> bool:
+    """Idempotently register the jax.monitoring listeners. Returns True
+    when active (False if this jax has no monitoring API)."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+    except Exception:
+        return False
+    _installed = True
+    return True
+
+
+def installed() -> bool:
+    return _installed
+
+
+# -- reading -----------------------------------------------------------------
+def summary() -> Dict[str, Dict[str, float]]:
+    """{entry: {"count": n, "seconds": s}} of backend compiles so far —
+    the compile-attribution block bench.py folds into BENCH JSON."""
+    with _lock:
+        return {k: dict(v) for k, v in _summary.items()}
+
+
+def reset():
+    """Tests only: zero the attribution summary (listeners stay installed)."""
+    global _first_step_noted
+    with _lock:
+        _summary.clear()
+    _first_step_noted = False
+
+
+# -- relaunch-to-first-step --------------------------------------------------
+def note_first_step():
+    """Publish the relaunch-to-first-step gauge once per process; called by
+    the liveness tracker on the first observed step."""
+    global _first_step_noted
+    if _first_step_noted:
+        return
+    _first_step_noted = True
+    if metrics_mod.enabled():
+        gen = os.environ.get("PADDLE_TPU_ELASTIC_RESTART_NUM", "0")
+        _M_FIRST_STEP.set(time.monotonic() - PROCESS_T0, generation=gen)

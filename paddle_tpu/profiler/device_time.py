@@ -1,0 +1,117 @@
+"""Per-op device-time attribution for host spans.
+
+PR 2's op spans measure HOST dispatch latency (enqueue, not execution) —
+on TPU the async dispatch returns in microseconds while the op runs on the
+chip for milliseconds, so host spans alone cannot separate "dispatch-bound"
+from "device-bound". Two attribution modes, recorded alongside each span:
+
+* ``estimate`` (default, works everywhere incl. CPU CI): a roofline bound
+  from the cost model — max(flops / peak_flops, bytes / peak_hbm_bw) for
+  the span's op. Clearly labeled an ESTIMATE: cost-analysis numbers are
+  cache-oblivious upper bounds, the same provenance bench.py already
+  documents for hbm_gb_per_step.
+* ``measured`` (`PADDLE_TPU_DEVICE_TIME=sync`): block_until_ready after
+  each traced op, so the span's device time is the wall until device
+  completion. This SERIALIZES the async dispatch pipeline — a profiling
+  mode, never the default (the reference pays the same price for
+  `nvprof --sync`-style tracing).
+
+The full-fidelity path — correlating host spans with the XPlane device
+trace `jax.profiler` writes on real TPU — remains the documented follow-up;
+these two modes make host-vs-device separable TODAY and give the chrome
+trace + summary rows the extra column the XPlane merge will later refine.
+
+Peaks: TPU `BENCH_PEAK_FLOPS` (default 197e12, v5e bf16) and
+`PADDLE_TPU_PEAK_HBM_GBS` (GB/s, default 819 = v5e); CPU gets deliberately
+conservative defaults (100 GFLOP/s, 20 GB/s) so estimate rows stay
+obviously synthetic there.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["sync_mode", "estimate_ns", "attribute", "split_rows",
+           "platform_peaks"]
+
+_CPU_PEAK_FLOPS = 100e9
+_CPU_PEAK_BW = 20e9
+
+_peaks_cache: Optional[Tuple[str, float, float]] = None
+
+
+def _platform() -> str:
+    try:
+        import jax
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
+
+
+def platform_peaks() -> Tuple[str, float, float]:
+    """(platform, peak_flops/s, peak_bytes/s) used by the estimator."""
+    global _peaks_cache
+    if _peaks_cache is not None:
+        return _peaks_cache
+    plat = _platform()
+    if plat == "cpu":
+        peaks = (plat, _CPU_PEAK_FLOPS, _CPU_PEAK_BW)
+    else:
+        flops = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+        bw = float(os.environ.get("PADDLE_TPU_PEAK_HBM_GBS", 819)) * 1e9
+        peaks = (plat, flops, bw)
+    _peaks_cache = peaks
+    return peaks
+
+
+def sync_mode() -> bool:
+    """True when PADDLE_TPU_DEVICE_TIME=sync: measure completion instead of
+    estimating (serializes dispatch — profiling runs only)."""
+    return os.environ.get("PADDLE_TPU_DEVICE_TIME", "").lower() == "sync"
+
+
+def estimate_ns(flops: float, nbytes: float) -> int:
+    """Roofline device-time estimate in ns: the op is bound by compute or
+    memory, whichever is slower at the platform's peaks."""
+    _, peak_flops, peak_bw = platform_peaks()
+    sec = max((flops or 0.0) / peak_flops, (nbytes or 0.0) / peak_bw)
+    return int(sec * 1e9)
+
+
+def attribute(outs, flops: float, nbytes: float,
+              start_ns: int) -> Tuple[int, str]:
+    """(device_ns, source) for one traced op. In sync mode, waits for the
+    op's outputs and reports wall-until-completion as "measured"; otherwise
+    returns the roofline "estimate"."""
+    if sync_mode():
+        try:
+            import jax
+            from .recorder import now_ns
+            jax.block_until_ready(outs)
+            return max(0, now_ns() - start_ns), "measured"
+        except Exception:
+            pass  # fall through to the estimate
+    return estimate_ns(flops, nbytes), "estimate"
+
+
+def split_rows(spans) -> List[dict]:
+    """Aggregate host-vs-device time per op name from spans that carry
+    device attribution — the bench JSON's `device_time.rows` shape,
+    sorted by device time desc."""
+    acc: Dict[str, dict] = {}
+    for s in spans:
+        if getattr(s, "device_ns", None) is None:
+            continue
+        row = acc.setdefault(s.name, {"op": s.name, "calls": 0,
+                                      "host_ms": 0.0, "device_ms": 0.0,
+                                      "src": s.device_src or "estimate"})
+        row["calls"] += 1
+        row["host_ms"] += s.dur_ns / 1e6
+        row["device_ms"] += s.device_ns / 1e6
+        if s.device_src == "measured":
+            row["src"] = "measured"
+    rows = sorted(acc.values(), key=lambda r: -r["device_ms"])
+    for r in rows:
+        r["host_ms"] = round(r["host_ms"], 4)
+        r["device_ms"] = round(r["device_ms"], 4)
+    return rows

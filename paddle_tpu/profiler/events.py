@@ -1,0 +1,218 @@
+"""Unified structured event log: one JSONL stream for every runtime event.
+
+PR 2 gave each subsystem its own event shape (watchdog RetraceEvents,
+fault-injection warnings, barrier abort warnings, elastic restart
+warnings...) — operable only by grepping five different log formats. This
+module is the one funnel: watchdog retraces, fault injections, retry
+exhaustion, coordinated-checkpoint commits/aborts, elastic restarts,
+collective timeouts, device OOMs, XLA compiles, and fleet straggler
+detections all `emit()` here with ONE schema, land in a bounded in-memory
+ring (served by the ObservabilityServer's `/events` endpoint and folded
+into bench JSON), and optionally append to a JSONL file that
+`tools/obs_tail.py` tails/filters/pretty-prints.
+
+Schema (flat JSON object per line):
+
+    required  ts: float      unix seconds
+              kind: str      ^[a-z][a-z0-9_]*$ (see KINDS for the set the
+                             runtime emits today)
+              host: str      stable host identity (PADDLE_CURRENT_ENDPOINT,
+                             else trainer-<PADDLE_TRAINER_ID>, else
+                             <hostname>:<pid>)
+    optional  severity: str  debug | info | warn | error (default info)
+              ...            kind-specific payload keys, all JSON scalars
+                             (lists/dicts allowed but keep events greppable)
+
+`validate_event` is the schema contract tests and
+`tools/check_bench_result.py` check against. Kill switch:
+`PADDLE_TPU_EVENTS=0` makes every emit a no-op. `PADDLE_TPU_EVENT_LOG=path`
+appends each event as one JSON line (the obs_tail input).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["EventLog", "default_event_log", "emit", "recent",
+           "validate_event", "KINDS", "SEVERITIES", "host_id"]
+
+#: kinds the runtime emits today (documentation, not a closed set — any
+#: ^[a-z][a-z0-9_]*$ name validates, so downstream tooling stays generic)
+KINDS = (
+    "retrace",            # watchdog: new jit signature at a warm site
+    "xla_compile",        # jax backend compile, attributed to an entry point
+    "fault_injected",     # an armed fault site fired
+    "retry_exhausted",    # a retried op failed every attempt
+    "retry_recovered",    # a retried op succeeded after >= 1 retry
+    "barrier_commit",     # coordinated checkpoint round committed
+    "barrier_abort",      # coordinated checkpoint round aborted
+    "elastic_restart",    # supervisor relaunched the trainer
+    "collective_timeout", # eager collective blew its deadline
+    "device_oom",         # eager op exhausted device memory
+    "fleet_straggler",    # a host's rolling step p50 left the fleet band
+)
+
+SEVERITIES = ("debug", "info", "warn", "error")
+
+_KIND_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+_RESERVED = ("ts", "kind", "host", "severity")
+
+
+def host_id() -> str:
+    """Stable identity of this process for the `host` field — the same id
+    the elastic membership watch uses (PADDLE_CURRENT_ENDPOINT, which
+    tools/elastic_run.py pins to trainer-<rank>)."""
+    ep = os.environ.get("PADDLE_CURRENT_ENDPOINT")
+    if ep:
+        return ep
+    rank = os.environ.get("PADDLE_TRAINER_ID")
+    if rank:
+        return f"trainer-{rank}"
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+def validate_event(rec: dict) -> dict:
+    """Raise ValueError (naming every violation) unless `rec` conforms to
+    the event schema; returns the record for chaining."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"event must be a dict, got {type(rec)}")
+    problems = []
+    if not isinstance(rec.get("ts"), (int, float)) \
+            or isinstance(rec.get("ts"), bool):
+        problems.append(f"'ts' must be numeric, got {rec.get('ts')!r}")
+    kind = rec.get("kind")
+    if not isinstance(kind, str) or not _KIND_RE.match(kind):
+        problems.append(f"'kind' must match {_KIND_RE.pattern}, "
+                        f"got {kind!r}")
+    if not isinstance(rec.get("host"), str) or not rec.get("host"):
+        problems.append(f"'host' must be a non-empty string, "
+                        f"got {rec.get('host')!r}")
+    sev = rec.get("severity", "info")
+    if sev not in SEVERITIES:
+        problems.append(f"'severity' must be one of {SEVERITIES}, "
+                        f"got {sev!r}")
+    try:
+        json.dumps(rec)
+    except (TypeError, ValueError) as e:
+        problems.append(f"payload is not JSON-serializable: {e}")
+    if problems:
+        raise ValueError("invalid event: " + "; ".join(problems))
+    return rec
+
+
+def _enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_EVENTS", "1").lower() not in (
+        "0", "false", "off", "no")
+
+
+class EventLog:
+    """Bounded ring of structured events + optional JSONL file sink.
+
+    Thread-safe; emit cost with the sink disabled is one dict build + one
+    deque append under a lock (events are rare — retraces, faults,
+    restarts — never per-op)."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 jsonl_path: Optional[str] = None):
+        if capacity is None:
+            capacity = int(os.environ.get("PADDLE_TPU_EVENT_BUFFER", "512"))
+        self._lock = threading.Lock()
+        self._ring: "deque[dict]" = deque(maxlen=max(int(capacity), 1))
+        self._counts: Dict[str, int] = {}
+        self._path = jsonl_path
+        self._file = None
+        self._file_error = False
+
+    # -- emission ------------------------------------------------------------
+    def emit(self, kind: str, severity: str = "info", **data) -> Optional[dict]:
+        """Append one event; returns the record (None when disabled).
+        Reserved keys (ts/kind/host/severity) cannot be overridden by
+        payload kwargs."""
+        if not _enabled():
+            return None
+        rec = {"ts": time.time(), "kind": kind, "host": host_id(),
+               "severity": severity}
+        for k, v in data.items():
+            if k not in _RESERVED:
+                rec[k] = v
+        with self._lock:
+            self._ring.append(rec)
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            self._write_line(rec)
+        return rec
+
+    def _write_line(self, rec: dict):
+        """Append to the JSONL sink (lazy open; one failure disables the
+        sink with a single warning — the ring keeps working)."""
+        if self._file_error:
+            return
+        path = self._path or os.environ.get("PADDLE_TPU_EVENT_LOG")
+        if not path:
+            return
+        try:
+            if self._file is None or self._file.name != path:
+                if self._file is not None:
+                    self._file.close()
+                self._file = open(path, "a")
+            self._file.write(json.dumps(rec) + "\n")
+            self._file.flush()
+        except Exception as e:
+            self._file_error = True
+            import warnings
+            warnings.warn(f"event JSONL sink {path!r} failed ({e}); "
+                          f"events stay in memory only")
+
+    # -- reading -------------------------------------------------------------
+    def recent(self, n: int = 100, kind: Optional[str] = None,
+               min_severity: Optional[str] = None) -> List[dict]:
+        """Newest-last list of up to `n` events, optionally filtered."""
+        with self._lock:
+            events = list(self._ring)
+        if kind:
+            events = [e for e in events if e.get("kind") == kind]
+        if min_severity:
+            floor = SEVERITIES.index(min_severity)
+            events = [e for e in events
+                      if SEVERITIES.index(e.get("severity", "info")) >= floor]
+        return events[-max(int(n), 0):]
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self._counts.clear()
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except Exception:
+                    pass
+                self._file = None
+
+
+_default = EventLog()
+
+
+def default_event_log() -> EventLog:
+    return _default
+
+
+def emit(kind: str, severity: str = "info", **data) -> Optional[dict]:
+    """Module-level shorthand: `events.emit("retrace", site=..., ...)`."""
+    return _default.emit(kind, severity=severity, **data)
+
+
+def recent(n: int = 100, kind: Optional[str] = None) -> List[dict]:
+    return _default.recent(n, kind=kind)

@@ -24,6 +24,7 @@ import time
 from typing import Callable, List, Optional
 
 from . import metrics as metrics_mod
+from . import server as server_mod
 from .timer import benchmark
 from .watchdog import get_watchdog
 
@@ -179,6 +180,10 @@ class ThroughputMonitor:
 
     def on_train_batch_end(self, step, logs=None):
         self._global_step += 1
+        if self.model is None:
+            # manually-driven loop (no hapi fit, which notes its own
+            # global step): feed /healthz liveness + the fleet digest here
+            server_mod.note_step(self._global_step)
         self._win_steps += 1
         n = self.samples_per_step
         if n is None and isinstance(logs, dict):

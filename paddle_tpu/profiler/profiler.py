@@ -225,6 +225,12 @@ class Profiler:
             args = dict(s.args) if s.args else {}
             if s.parent:
                 args["parent"] = s.parent
+            if s.device_ns is not None:
+                # host dispatch vs device execution split (device_time.py);
+                # src says whether it was measured (sync mode) or a
+                # roofline estimate
+                args["device_us"] = s.device_ns / 1e3
+                args["device_src"] = s.device_src
             events.append({
                 "name": s.name, "ph": "X", "cat": s.event_type,
                 "ts": s.start_ns / 1e3, "dur": s.dur_ns / 1e3,

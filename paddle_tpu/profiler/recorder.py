@@ -25,6 +25,8 @@ class HostSpan:
     event_type: str = "UserDefined"
     parent: Optional[str] = None
     args: Optional[dict] = None   # op metadata: shapes/dtypes/bytes estimate
+    device_ns: Optional[int] = None   # device-side execution time
+    device_src: Optional[str] = None  # "measured" | "estimate" (device_time.py)
 
     @property
     def dur_ns(self) -> int:
@@ -32,46 +34,74 @@ class HostSpan:
 
 
 class _ThreadBuffer:
-    __slots__ = ("lock", "spans")
+    __slots__ = ("lock", "spans", "owner", "dropped")
 
     def __init__(self):
         self.lock = threading.Lock()
         self.spans: List[HostSpan] = []
+        self.owner = threading.get_ident()
+        self.dropped = False  # pruned from the registry; do not reuse
 
 
 class HostEventRecorder:
     def __init__(self):
         self._lock = threading.Lock()   # guards the buffer REGISTRY only
+        # keyed by buffer identity, NOT thread ident: the OS reuses thread
+        # idents, and keying by ident let a new thread's buffer overwrite a
+        # dead thread's registry entry while it still held un-collected
+        # spans (silent span loss under churning worker threads)
         self._buffers: Dict[int, _ThreadBuffer] = {}
         self._tls = threading.local()
         self.enabled = False
 
     def _buf(self) -> _ThreadBuffer:
         buf = getattr(self._tls, "buf", None)
-        if buf is None:
+        # `dropped` covers a thread the prune misjudged as dead (a foreign
+        # thread invisible to threading.enumerate()): it re-registers a
+        # fresh buffer instead of pushing into the unreachable old one
+        if buf is None or buf.dropped:
             buf = _ThreadBuffer()
             self._tls.buf = buf
             with self._lock:
-                self._buffers[threading.get_ident()] = buf
+                self._buffers[id(buf)] = buf
         return buf
 
     def push(self, span: HostSpan):
         if self.enabled:
-            buf = self._buf()
-            with buf.lock:
-                buf.spans.append(span)
+            while True:
+                buf = self._buf()
+                with buf.lock:
+                    # re-checked under the lock: a concurrent collect() may
+                    # have pruned this buffer between _buf() and here (a
+                    # live thread misjudged dead) — appending would orphan
+                    # the span, so force a fresh registration instead
+                    if not buf.dropped:
+                        buf.spans.append(span)
+                        return
+                self._tls.buf = None
 
     def collect(self) -> List[HostSpan]:
         """Drain every thread's completed spans (sorted by start time).
         Draining semantics: a second collect() returns only spans recorded
-        after the first one."""
+        after the first one. Buffers of threads that have exited are pruned
+        AFTER their drain (a dead thread cannot push again), bounding
+        registry growth under thread churn."""
         with self._lock:
-            bufs = list(self._buffers.values())
+            items = list(self._buffers.items())
+        live = {t.ident for t in threading.enumerate()}
         out: List[HostSpan] = []
-        for buf in bufs:
+        dead = []
+        for key, buf in items:
             with buf.lock:
                 out.extend(buf.spans)
                 buf.spans.clear()
+                if buf.owner not in live:
+                    buf.dropped = True  # owner re-registers if misjudged
+                    dead.append(key)
+        if dead:
+            with self._lock:
+                for key in dead:
+                    self._buffers.pop(key, None)
         out.sort(key=lambda s: s.start_ns)
         return out
 

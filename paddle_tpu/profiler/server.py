@@ -1,0 +1,291 @@
+"""ObservabilityServer: the runtime's HTTP face — /metrics /snapshot
+/healthz /events on a stdlib daemon-thread server.
+
+PR 2 built the registry and exporters but left scraping to "snapshot into
+bench JSON"; a live job was still opaque. This serves the same process-wide
+surfaces over plain HTTP (http.server, zero deps):
+
+    /metrics    Prometheus text from the default registry; on a fleet's
+                rank 0 (or a supervisor) each scrape first collect()s the
+                FleetAggregator, so fleet_* families arrive host-labeled
+    /snapshot   one JSON object: metrics snapshot, watchdog snapshot (incl.
+                compile attribution), liveness, fleet view, recent events
+    /healthz    step liveness: 200 {"status": "healthy"} while steps keep
+                arriving, 503 {"status": "stalled"} once the last observed
+                step is older than PADDLE_TPU_HEALTH_STALL_SEC (default
+                300; "starting" before the first step)
+    /events     recent unified-event-log entries (?kind=...&n=...)
+
+Opt-in: set `PADDLE_TPU_METRICS_PORT` (0 = pick a free port) and the entry
+points auto-start it — `Model.fit`, `bench.py`, and `tools/elastic_run.py`
+(the supervisor serves on `PADDLE_TPU_SUPERVISOR_METRICS_PORT`, default
+port+1, because the trainer child owns the configured port on the same
+host; the supervisor's server survives trainer relaunches, so its /healthz
+shows the restart gap as a growing step age).
+
+Liveness is fed by `note_step()`, called by the fit loop / ThroughputMonitor
+/ bench timed loops; the first note also publishes
+`relaunch_to_first_step_seconds` and later notes drive the FleetReporter's
+digest publication when one is installed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import warnings
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import compile_watch as _compile_watch
+from . import events as _events_mod
+from . import metrics as _metrics_mod
+from .watchdog import get_watchdog
+
+__all__ = ["ObservabilityServer", "maybe_start_server", "note_step",
+           "liveness", "get_server", "stop_server"]
+
+DEFAULT_STALL_SEC = 300.0
+
+# module-level liveness: {step, ts(monotonic), wall_ts}
+_liveness_lock = threading.Lock()
+_liveness = {"step": None, "ts": None, "wall_ts": None}
+_reporter = None  # FleetReporter installed by maybe_start_server
+_server: Optional["ObservabilityServer"] = None
+
+
+def note_step(step: int):
+    """Record train-loop progress. Cheap, idempotent per step index (a
+    second caller reporting the same step is ignored; a SMALLER step means
+    a new training run started in this process), and never raises."""
+    global _liveness
+    step = int(step)
+    with _liveness_lock:
+        last = _liveness["step"]
+        if last is not None and step == last:
+            return  # a second caller reporting the same step
+        first = last is None
+        # step < last means a NEW training run in this process (a fresh
+        # fit, an in-process elastic re-entry): liveness follows it
+        _liveness["step"] = step
+        _liveness["ts"] = time.monotonic()
+        _liveness["wall_ts"] = time.time()
+    if first:
+        _compile_watch.note_first_step()
+    rep = _reporter
+    if rep is not None:
+        rep.note_step(step)
+
+
+def liveness(stall_after: Optional[float] = None) -> dict:
+    """{"status": healthy|stalled|starting, "last_step", "last_step_age_s",
+    "stall_after_s"} — the /healthz payload."""
+    if stall_after is None:
+        stall_after = float(os.environ.get("PADDLE_TPU_HEALTH_STALL_SEC",
+                                           DEFAULT_STALL_SEC))
+    with _liveness_lock:
+        step, ts = _liveness["step"], _liveness["ts"]
+    if step is None:
+        return {"status": "starting", "last_step": None,
+                "last_step_age_s": None, "stall_after_s": stall_after}
+    age = time.monotonic() - ts
+    return {"status": "stalled" if age > stall_after else "healthy",
+            "last_step": step, "last_step_age_s": round(age, 3),
+            "stall_after_s": stall_after}
+
+
+class ObservabilityServer:
+    """One ThreadingHTTPServer on a daemon thread.
+
+    `aggregator` (a fleet.telemetry.FleetAggregator) makes /metrics and
+    /snapshot fleet-aware; without one they serve this process only."""
+
+    def __init__(self, registry=None, aggregator=None,
+                 stall_after: Optional[float] = None):
+        self.registry = registry or _metrics_mod.default_registry()
+        self.aggregator = aggregator
+        self.stall_after = stall_after
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    # -- endpoint payloads ---------------------------------------------------
+    def _collect_fleet(self):
+        if self.aggregator is None:
+            return
+        try:
+            self.aggregator.collect()
+        except Exception:
+            pass  # a store hiccup must not fail the scrape
+
+    def metrics_text(self) -> str:
+        self._collect_fleet()
+        return self.registry.to_prometheus_text()
+
+    def snapshot(self) -> dict:
+        self._collect_fleet()
+        snap = {
+            "metrics": self.registry.snapshot(),
+            "watchdog": get_watchdog().snapshot(),
+            "compile_attribution": _compile_watch.summary(),
+            "liveness": liveness(self.stall_after),
+            "events_tail": _events_mod.recent(50),
+            "ts": time.time(),
+        }
+        if self.aggregator is not None:
+            snap["fleet"] = self.aggregator.snapshot()
+        return snap
+
+    def healthz(self) -> dict:
+        h = liveness(self.stall_after)
+        if self.aggregator is not None:
+            # supervisor view: the fleet's digests carry the liveness
+            try:
+                self.aggregator.collect()
+                hosts = {}
+                now = time.time()
+                for r, d in self.aggregator.last.items():
+                    hosts[d.get("host", f"rank-{r}")] = {
+                        "step": d.get("step"),
+                        "age_s": round(max(0.0, now - d.get("ts", now)), 3)}
+                h["fleet"] = hosts
+                if h["status"] == "starting" and hosts:
+                    ages = [v["age_s"] for v in hosts.values()]
+                    stall = h["stall_after_s"]
+                    h["status"] = "stalled" if min(ages) > stall \
+                        else "healthy"
+            except Exception:
+                pass
+        return h
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, port: int = 0, host: str = "") -> int:
+        """Bind and serve on a daemon thread; returns the bound port."""
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # keep training stdout clean
+                pass
+
+            def _send(self, code: int, body: str, ctype: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                try:
+                    if url.path == "/metrics":
+                        self._send(200, srv.metrics_text(),
+                                   "text/plain; version=0.0.4")
+                    elif url.path == "/snapshot":
+                        self._send(200, json.dumps(srv.snapshot()),
+                                   "application/json")
+                    elif url.path == "/healthz":
+                        h = srv.healthz()
+                        self._send(200 if h["status"] != "stalled" else 503,
+                                   json.dumps(h), "application/json")
+                    elif url.path == "/events":
+                        q = parse_qs(url.query)
+                        n = int(q.get("n", ["100"])[0])
+                        kind = q.get("kind", [None])[0]
+                        self._send(200, json.dumps(
+                            {"events": _events_mod.recent(n, kind=kind)}),
+                            "application/json")
+                    else:
+                        self._send(404, json.dumps(
+                            {"error": "unknown path", "endpoints":
+                             ["/metrics", "/snapshot", "/healthz",
+                              "/events"]}), "application/json")
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # a handler bug must not kill a scrape
+                    try:
+                        self._send(500, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}),
+                            "application/json")
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name=f"obs-server:{self.port}")
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self._thread = None
+
+
+def get_server() -> Optional[ObservabilityServer]:
+    return _server
+
+
+def stop_server():
+    global _server
+    if _server is not None:
+        _server.stop()
+        _server = None
+
+
+def maybe_start_server(role: str = "trainer",
+                       aggregator=None) -> Optional[ObservabilityServer]:
+    """Start the process-wide server if `PADDLE_TPU_METRICS_PORT` is set
+    (idempotent; returns the existing server on repeat calls).
+
+    role="trainer" (Model.fit, bench.py): binds the configured port, wires
+    a FleetReporter on every rank of a >=2 fleet and a FleetAggregator on
+    rank 0 (both from the trainer env contract). role="supervisor"
+    (tools/elastic_run.py): binds `PADDLE_TPU_SUPERVISOR_METRICS_PORT`
+    (default configured port + 1 — the trainer child owns the configured
+    one on this host); the supervisor passes its `aggregator` explicitly
+    (built from --master) since it runs OUTSIDE the trainer env contract."""
+    global _server, _reporter
+    if _server is not None:
+        return _server
+    raw = os.environ.get("PADDLE_TPU_METRICS_PORT", "")
+    if raw == "":
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        warnings.warn(f"PADDLE_TPU_METRICS_PORT={raw!r} is not a port "
+                      f"number; observability server disabled")
+        return None
+    if role == "supervisor":
+        sup_raw = os.environ.get("PADDLE_TPU_SUPERVISOR_METRICS_PORT", "")
+        port = int(sup_raw) if sup_raw else (port + 1 if port else 0)
+    elif aggregator is None:
+        try:
+            from ..distributed.fleet import telemetry as _telemetry
+            aggregator = _telemetry.aggregator_from_env()
+            if _reporter is None:
+                _reporter = _telemetry.reporter_from_env()
+        except Exception as e:
+            warnings.warn(f"fleet telemetry unavailable ({e}); serving "
+                          f"process-local metrics only")
+    server = ObservabilityServer(aggregator=aggregator)
+    try:
+        bound = server.start(port)
+    except OSError as e:
+        warnings.warn(f"observability server could not bind port {port}: "
+                      f"{e}; disabled for this process")
+        return None
+    _server = server
+    import logging
+    logging.getLogger("paddle_tpu.observability").info(
+        "observability server (%s) on :%d — /metrics /snapshot /healthz "
+        "/events", role, bound)
+    return server

@@ -21,7 +21,8 @@ class SortedKeys(Enum):
 
 
 class _Item:
-    __slots__ = ("name", "calls", "total_ns", "max_ns", "min_ns")
+    __slots__ = ("name", "calls", "total_ns", "max_ns", "min_ns",
+                 "device_ns", "device_src")
 
     def __init__(self, name):
         self.name = name
@@ -29,6 +30,8 @@ class _Item:
         self.total_ns = 0
         self.max_ns = 0
         self.min_ns = None
+        self.device_ns = 0       # summed device-side time (0 = none seen)
+        self.device_src = None   # "measured" | "estimate" | None
 
     def add(self, span: HostSpan):
         d = span.dur_ns
@@ -36,6 +39,11 @@ class _Item:
         self.total_ns += d
         self.max_ns = max(self.max_ns, d)
         self.min_ns = d if self.min_ns is None else min(self.min_ns, d)
+        if span.device_ns is not None:
+            self.device_ns += span.device_ns
+            # one measured span upgrades the row's provenance label
+            if self.device_src != "measured":
+                self.device_src = span.device_src
 
     @property
     def avg_ns(self):
@@ -81,16 +89,26 @@ def summary_report(data: StatisticData, sorted_by: Optional[SortedKeys] = None,
                   key=lambda it: getattr(it, attr) or 0, reverse=True)
     name_w = max([len(r.name) for r in rows], default=4)
     name_w = max(name_w, 4)
+    # the device column appears only when spans carried device attribution
+    # (host time = dispatch latency; device time = execution, measured or
+    # roofline-estimated — see profiler/device_time.py)
+    has_device = any(r.device_ns for r in rows)
     header = (f"{'Name':<{name_w}}  {'Calls':>7}  {'Total(' + time_unit + ')':>12}  "
               f"{'Avg(' + time_unit + ')':>12}  {'Max(' + time_unit + ')':>12}  "
               f"{'Min(' + time_unit + ')':>12}  {'Ratio(%)':>8}")
+    if has_device:
+        header += f"  {'Dev(' + time_unit + ')':>12}  {'DevSrc':>8}"
     lines = ["-" * len(header), header, "-" * len(header)]
     total = sum(r.total_ns for r in rows) or 1
     for r in rows:
-        lines.append(
+        line = (
             f"{r.name:<{name_w}}  {r.calls:>7}  {_fmt(r.total_ns, time_unit):>12}  "
             f"{_fmt(r.avg_ns, time_unit):>12}  {_fmt(r.max_ns, time_unit):>12}  "
             f"{_fmt(r.min_ns or 0, time_unit):>12}  {100 * r.total_ns / total:>8.2f}")
+        if has_device:
+            line += (f"  {_fmt(r.device_ns, time_unit):>12}  "
+                     f"{r.device_src or '-':>8}")
+        lines.append(line)
     lines.append("-" * len(header))
     lines.append(f"Wall clock: {_fmt(data.wall_ns, time_unit)} {time_unit}; "
                  f"{len(data.spans)} spans, {len(data.by_name)} distinct names")

@@ -29,6 +29,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Sequence, Set, Tuple
 
+from . import events as events_mod
 from . import metrics as metrics_mod
 
 __all__ = ["RetraceEvent", "RetraceWatchdog", "get_watchdog",
@@ -37,10 +38,12 @@ __all__ = ["RetraceEvent", "RetraceWatchdog", "get_watchdog",
 logger = logging.getLogger("paddle_tpu.retrace")
 
 _REG = metrics_mod.default_registry()
-_M_HITS = _REG.counter("jit_cache_hits_total",
-                       "jit cache lookups that reused a compiled signature")
-_M_MISSES = _REG.counter("jit_cache_misses_total",
-                         "jit cache lookups that required a (re)trace")
+_M_HITS = _REG.counter(
+    "jit_cache_hits_total",
+    "jit cache lookups that reused a compiled signature, by site")
+_M_MISSES = _REG.counter(
+    "jit_cache_misses_total",
+    "jit cache lookups that required a (re)trace, by site")
 _M_RETRACES = _REG.counter(
     "jit_retraces_total",
     "misses whose signature DIFFERS from the site's previous one "
@@ -129,6 +132,7 @@ class RetraceWatchdog:
         self._retraces: Dict[Tuple[str, str], int] = {}
         self._window: Dict[Tuple[str, str], int] = {}
         self._warned: Set[Tuple[str, str]] = set()
+        self._compiles: Dict[str, Dict[str, float]] = {}
         self.events: "deque[RetraceEvent]" = deque(maxlen=history)
         if warn_threshold is None:
             warn_threshold = int(
@@ -179,6 +183,8 @@ class RetraceWatchdog:
                 self._warned.add(key)
         if m_on:
             _M_RETRACES.inc(site=site)
+        events_mod.emit("retrace", site=site, name=name, count=count,
+                        delta=event.delta)
         logger.debug("retrace %s:%s #%d — %s", site, name, event.count,
                      event.delta)
         if warn:
@@ -189,6 +195,17 @@ class RetraceWatchdog:
                 "(threshold PADDLE_TPU_RETRACE_WARN=%d)",
                 site, name, wcount, event.delta, self.warn_threshold)
         return event
+
+    def record_compile(self, entry: str, seconds: float):
+        """One XLA backend compile attributed to `entry` (fed by
+        profiler/compile_watch.py's jax.monitoring listener) — so the
+        watchdog snapshot pairs WHAT retraced with what the recompiles
+        actually COST."""
+        with self._lock:
+            s = self._compiles.setdefault(entry,
+                                          {"count": 0, "seconds": 0.0})
+            s["count"] += 1
+            s["seconds"] += float(seconds)
 
     # -- reading -------------------------------------------------------------
     def total_retraces(self, site: Optional[str] = None) -> int:
@@ -206,8 +223,10 @@ class RetraceWatchdog:
     def snapshot(self) -> dict:
         with self._lock:
             events = [e.to_dict() for e in self.events]
+            compiles = {k: dict(v) for k, v in self._compiles.items()}
         return {"total_retraces": self.total_retraces(),
-                "by_site_name": self.counts(), "events": events}
+                "by_site_name": self.counts(), "events": events,
+                "compiles": compiles}
 
     # -- lifecycle -----------------------------------------------------------
     def reset_window(self):
@@ -224,6 +243,7 @@ class RetraceWatchdog:
             self._retraces.clear()
             self._window.clear()
             self._warned.clear()
+            self._compiles.clear()
             self.events.clear()
 
 

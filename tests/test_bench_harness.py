@@ -97,6 +97,15 @@ def test_bench_json_includes_observability_snapshot(capsys, monkeypatch):
     for sr in obs["step_records"]:
         validate_step_record(sr)
     assert sr["ips"] == 160.0  # 320 samples / 2 s
+    # fleet-observability PR: compile attribution + device split + events
+    from paddle_tpu.profiler.events import validate_event
+    assert isinstance(obs["compile_attribution"], dict)
+    for entry, stats in obs["compile_attribution"].items():
+        assert stats["count"] >= 1 and stats["seconds"] >= 0
+    assert obs["device_time"]["mode"] in ("estimate", "measured")
+    assert obs["device_time"]["rows"], "device-time probe produced no rows"
+    for ev in obs["events_tail"]:
+        validate_event(ev)
 
 
 def test_run_config_emits_step_record(monkeypatch):

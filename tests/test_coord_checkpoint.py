@@ -520,3 +520,98 @@ class TestCkptInspectDir:
         assert out.returncode != 0  # corrupt file present -> nonzero exit
         assert "torn-tmp" in out.stdout
         assert "newest-valid: step 2" in out.stdout
+
+
+class TestBarrierKeyGC:
+    """Store-key GC for resolved rounds (carried ROADMAP follow-up): each
+    host lag-2-deletes its OWN prep key and the round's abort flag once a
+    round resolves, so flags stop accreting in the master store for the
+    job's lifetime."""
+
+    @staticmethod
+    def _round_keys(coord, round_id, step):
+        return [coord._k("prep", round_id, step, coord.rank),
+                coord._k("abort", round_id, step)]
+
+    def test_resolved_round_keys_are_gced_with_lag_two(self, master,
+                                                       tmp_path):
+        m0 = _manager(master, 0, tmp_path)
+        m1 = _manager(master, 1, tmp_path)
+        n_rounds = 5
+        for step in range(1, n_rounds + 1):
+            res = {}
+            _join_all([
+                threading.Thread(
+                    target=lambda s=step: res.update(a=m0.save(_state(), s))),
+                threading.Thread(
+                    target=lambda s=step: res.update(b=m1.save(_state(), s))),
+            ])
+            assert res == {"a": True, "b": True}
+        probe = TCPStore("127.0.0.1", master.port)
+        lag = m0.coordinator.GC_LAG
+        for m in (m0, m1):
+            c = m.coordinator
+            # rounds are 0-based; rounds older than newest-lag are gone
+            for r in range(n_rounds - lag):
+                for key in self._round_keys(c, r, r + 1):
+                    assert not probe.check(key), \
+                        f"round {r} key {key!r} survived GC"
+            # the newest `lag` rounds keep their prep votes (not yet GCd)
+            newest = n_rounds - 1
+            assert probe.check(c._k("prep", newest, n_rounds, c.rank))
+        # bound: per host, at most GC_LAG rounds of keys remain
+        assert len(m0.coordinator._round_steps) <= lag
+        assert len(m1.coordinator._round_steps) <= lag
+
+    def test_aborted_round_keys_are_gced_too(self, master, tmp_path):
+        """Abort flags are exactly what accretes on a flaky fleet — they
+        must be GC'd once later rounds prove everyone moved on."""
+        m0 = _manager(master, 0, tmp_path, timeout=0.3)
+        m1 = _manager(master, 1, tmp_path, timeout=0.3)
+        with pytest.warns(UserWarning, match="aborted"):
+            assert m0.save(_state(), 1) is False  # round 0: peer missing
+        # peer consumes its round 0 too (lockstep, also aborts)
+        with pytest.warns(UserWarning, match="aborted"):
+            assert m1.save(_state(), 1) is False
+        abort_key = m0.coordinator._k("abort", 0, 1)
+        probe = TCPStore("127.0.0.1", master.port)
+        assert probe.check(abort_key)  # round 0 abort flag exists
+        for step in range(2, 5):  # rounds 1..3 commit in lockstep
+            res = {}
+            _join_all([
+                threading.Thread(
+                    target=lambda s=step: res.update(a=m0.save(_state(), s))),
+                threading.Thread(
+                    target=lambda s=step: res.update(b=m1.save(_state(), s))),
+            ])
+            assert res == {"a": True, "b": True}
+        assert not probe.check(abort_key), "aborted round's flag never GCd"
+
+    def test_resume_round_keys_are_gced(self, master, tmp_path):
+        m0 = _manager(master, 0, tmp_path)
+        m1 = _manager(master, 1, tmp_path)
+        for step in (1, 2):
+            res = {}
+            _join_all([
+                threading.Thread(
+                    target=lambda s=step: res.update(a=m0.save(_state(), s))),
+                threading.Thread(
+                    target=lambda s=step: res.update(b=m1.save(_state(), s))),
+            ])
+            assert res == {"a": True, "b": True}
+        for _ in range(4):  # four lockstep resume negotiations
+            res = {}
+            _join_all([
+                threading.Thread(target=lambda: res.update(a=m0.load_latest())),
+                threading.Thread(target=lambda: res.update(b=m1.load_latest())),
+            ])
+            assert res["a"][1] == res["b"][1] == 2
+        probe = TCPStore("127.0.0.1", master.port)
+        lag = m0.coordinator.GC_LAG
+        for m in (m0, m1):
+            c = m.coordinator
+            newest = c._resume_round
+            for r in range(1, newest - lag + 1):
+                assert not probe.check(c._k("resume", r, c.rank)), \
+                    f"resume round {r} key survived GC"
+            assert probe.check(c._k("resume", newest, c.rank))

@@ -1,0 +1,232 @@
+"""Unified structured event log (profiler/events.py): schema contract,
+ring + JSONL sink, emitter wiring across subsystems, and the
+tools/obs_tail.py renderer.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fault
+from paddle_tpu.profiler import events
+from paddle_tpu.profiler.events import (EventLog, validate_event,
+                                        default_event_log)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fault.reset()
+    default_event_log().clear()
+    yield
+    fault.reset()
+    default_event_log().clear()
+
+
+class TestSchema:
+    def test_emit_produces_valid_record(self):
+        rec = events.emit("retrace", site="eager", name="matmul",
+                          delta="dim0 4->6")
+        validate_event(rec)
+        assert rec["kind"] == "retrace"
+        assert rec["host"]
+        assert rec["severity"] == "info"
+        assert rec["site"] == "eager"
+
+    def test_payload_cannot_override_reserved_keys(self):
+        rec = events.emit("retrace", **{"site": "eager"})
+        before = rec["ts"]
+        rec2 = default_event_log().emit("retrace", site="x")
+        assert rec2["ts"] >= before  # ts always stamped by the log
+
+    def test_validate_rejects_bad_records(self):
+        good = {"ts": time.time(), "kind": "retrace", "host": "h"}
+        validate_event(good)
+        for mutate in (
+                lambda r: r.pop("ts"),
+                lambda r: r.pop("kind"),
+                lambda r: r.pop("host"),
+                lambda r: r.__setitem__("kind", "Not-Valid!"),
+                lambda r: r.__setitem__("kind", ""),
+                lambda r: r.__setitem__("severity", "fatal"),
+                lambda r: r.__setitem__("ts", "yesterday"),
+                lambda r: r.__setitem__("host", "")):
+            bad = dict(good)
+            mutate(bad)
+            with pytest.raises(ValueError, match="invalid event"):
+                validate_event(bad)
+
+    def test_known_kinds_are_schema_legal(self):
+        for kind in events.KINDS:
+            validate_event({"ts": 0.0, "kind": kind, "host": "h"})
+
+
+class TestRingAndSink:
+    def test_ring_is_bounded_and_draining_reads(self):
+        log = EventLog(capacity=5)
+        for i in range(9):
+            log.emit("retrace", i=i)
+        recs = log.recent(100)
+        assert len(recs) == 5
+        assert [r["i"] for r in recs] == [4, 5, 6, 7, 8]
+        assert log.counts()["retrace"] == 9
+
+    def test_kind_and_severity_filters(self):
+        log = EventLog(capacity=32)
+        log.emit("retrace", i=1)
+        log.emit("barrier_abort", severity="warn", i=2)
+        log.emit("device_oom", severity="error", i=3)
+        assert [r["i"] for r in log.recent(10, kind="retrace")] == [1]
+        assert [r["i"] for r in log.recent(10, min_severity="warn")] == [2, 3]
+
+    def test_jsonl_sink_appends_valid_lines(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(capacity=8, jsonl_path=path)
+        log.emit("retrace", site="eager", name="op")
+        log.emit("fleet_straggler", severity="warn", straggler="trainer-1")
+        log.close()
+        lines = open(path).read().strip().splitlines()
+        assert len(lines) == 2
+        for line in lines:
+            validate_event(json.loads(line))
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_EVENTS", "0")
+        log = EventLog(capacity=8)
+        assert log.emit("retrace") is None
+        assert log.recent(10) == []
+
+
+class TestEmitterWiring:
+    """The subsystems actually funnel into the default log."""
+
+    def test_retrace_emits_event(self):
+        from paddle_tpu.profiler.watchdog import RetraceWatchdog
+        wd = RetraceWatchdog()
+        wd.observe("eager", "evtest_op", [np.zeros((2, 2), np.float32)])
+        wd.observe("eager", "evtest_op", [np.zeros((3, 2), np.float32)])
+        recs = [r for r in events.recent(50, kind="retrace")
+                if r.get("name") == "evtest_op"]
+        assert len(recs) == 1
+        assert "dim0 2->3" in recs[0]["delta"]
+
+    def test_fault_injection_emits_event(self):
+        fault.configure("evtest.site", times=1)
+        with pytest.raises(Exception):
+            fault.site("evtest.site")
+        recs = [r for r in events.recent(50, kind="fault_injected")
+                if r.get("site") == "evtest.site"]
+        assert len(recs) == 1
+        assert recs[0]["severity"] == "warn"
+
+    def test_retry_exhausted_and_recovered_emit(self):
+        from paddle_tpu.fault import RetryPolicy, RetryExhaustedError
+        pol = RetryPolicy(max_attempts=2, base_delay=0.001)
+        with pytest.raises(RetryExhaustedError):
+            pol.call(lambda: (_ for _ in ()).throw(ValueError("x")),
+                     op="evtest.op")
+        assert [r for r in events.recent(50, kind="retry_exhausted")
+                if r.get("op") == "evtest.op"]
+        attempts = {"n": 0}
+
+        def flaky():
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                raise ValueError("first")
+            return 7
+
+        assert pol.call(flaky, op="evtest.flaky") == 7
+        assert [r for r in events.recent(50, kind="retry_recovered")
+                if r.get("op") == "evtest.flaky"]
+
+    def test_device_oom_emits_event(self, monkeypatch):
+        from paddle_tpu.fault import DeviceOOMError
+        fault.configure("device.alloc", times=1)
+        a = paddle.to_tensor(np.ones((4,), np.float32))
+        b = paddle.to_tensor(np.ones((4,), np.float32))
+        with pytest.raises(DeviceOOMError):
+            a + b
+        recs = events.recent(50, kind="device_oom")
+        assert recs and recs[-1]["severity"] == "error"
+
+    def test_delay_kind_sleeps_instead_of_raising(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FAULT_DELAY", "0.08")
+        fault.configure("evtest.slow", times=1, kind="delay")
+        t0 = time.perf_counter()
+        fault.site("evtest.slow")  # must NOT raise
+        assert time.perf_counter() - t0 >= 0.07
+        t0 = time.perf_counter()
+        fault.site("evtest.slow")  # rule exhausted: no delay
+        assert time.perf_counter() - t0 < 0.05
+        assert [r for r in events.recent(50, kind="fault_injected")
+                if r.get("site") == "evtest.slow"
+                and r.get("fault_kind") == "delay"]
+
+
+class TestObsTail:
+    def _write(self, tmp_path, extra_garbage=True):
+        path = str(tmp_path / "events.jsonl")
+        now = time.time()
+        recs = [
+            {"ts": now - 3, "kind": "retrace", "host": "trainer-0",
+             "severity": "info", "name": "matmul"},
+            {"ts": now - 2, "kind": "barrier_abort", "host": "trainer-1",
+             "severity": "warn", "step": 4, "reason": "timeout"},
+            {"ts": now - 1, "kind": "fleet_straggler", "host": "trainer-0",
+             "severity": "warn", "straggler": "trainer-1"},
+        ]
+        with open(path, "w") as f:
+            if extra_garbage:
+                f.write("not json\n")
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        return path
+
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "obs_tail.py"),
+             *args], capture_output=True, text=True, timeout=60)
+
+    def test_renders_all_and_reports_garbage(self, tmp_path):
+        r = self._run(self._write(tmp_path))
+        assert r.returncode == 0
+        assert "retrace" in r.stdout and "fleet_straggler" in r.stdout
+        assert "skipped 1" in r.stderr
+
+    def test_kind_filter_and_last_n(self, tmp_path):
+        path = self._write(tmp_path)
+        r = self._run(path, "--kind", "barrier_abort")
+        assert r.returncode == 0
+        lines = [l for l in r.stdout.splitlines() if l.strip()]
+        assert len(lines) == 1 and "reason=timeout" in lines[0]
+        r = self._run(path, "-n", "1", "--json")
+        rec = json.loads(r.stdout.strip())
+        assert rec["kind"] == "fleet_straggler"
+
+    def test_severity_and_host_filters(self, tmp_path):
+        path = self._write(tmp_path)
+        r = self._run(path, "--min-severity", "warn", "--host", "trainer-1")
+        lines = [l for l in r.stdout.splitlines() if l.strip()]
+        assert len(lines) == 1 and "barrier_abort" in lines[0]
+
+    def test_unusable_input(self, tmp_path):
+        bad = str(tmp_path / "bad.jsonl")
+        open(bad, "w").write("nope\n")
+        assert self._run(bad).returncode == 2
+
+    def test_runtime_sink_is_tailable(self, tmp_path, monkeypatch):
+        """The PADDLE_TPU_EVENT_LOG file the runtime writes parses through
+        obs_tail end to end."""
+        path = str(tmp_path / "runtime.jsonl")
+        log = EventLog(capacity=8, jsonl_path=path)
+        log.emit("elastic_restart", severity="warn", reason="failure",
+                 restart=1)
+        log.close()
+        r = self._run(path, "--kind", "elastic_restart")
+        assert r.returncode == 0 and "reason=failure" in r.stdout

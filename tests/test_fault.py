@@ -689,3 +689,67 @@ class TestDeviceOOM:
         x = paddle.to_tensor(np.ones((2,), np.float32))
         with pytest.raises(ValueError, match="not an OOM"):
             ops.call(bad_op, (x,))
+
+
+class TestGuardWorkerReuse:
+    """Satellite (carried ROADMAP follow-up): `_guard_collective` reuses
+    ONE long-lived watchdog worker across guarded eager collectives
+    instead of spawning+joining a thread per call."""
+
+    def setup_method(self, _):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import collective as coll
+        from paddle_tpu.distributed.topology import (HybridCommunicateGroup,
+                                                     build_mesh)
+        mesh = build_mesh({"dp": 8})
+        dist.set_hybrid_communicate_group(HybridCommunicateGroup(mesh=mesh))
+        dist.destroy_process_group()
+        self.group = dist.new_group(axis_name="dp")
+        coll._guard_worker = None  # fresh worker accounting per test
+
+    def teardown_method(self, _):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import collective as coll
+        dist.set_hybrid_communicate_group(None)
+        dist.destroy_process_group()
+        coll._guard_worker = None
+
+    def test_sequential_guarded_collectives_reuse_worker(self, monkeypatch):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import collective as coll
+        monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_TIMEOUT", "60")
+        spawns0 = coll._guard_worker_spawns
+        for _ in range(3):
+            x = paddle.to_tensor(np.ones((4,), np.float32))
+            dist.all_reduce(x, group=self.group)
+            np.testing.assert_allclose(x.numpy(), np.full(4, 8.0))
+        assert coll._guard_worker_spawns == spawns0 + 1
+        worker = coll._guard_worker
+        assert worker is not None and worker.thread.is_alive()
+        # a different collective kind reuses the SAME worker thread
+        dist.barrier(group=self.group)
+        assert coll._guard_worker is worker
+
+    def test_timed_out_worker_is_abandoned_then_replaced(self, monkeypatch):
+        from paddle_tpu.distributed import collective as coll
+        from paddle_tpu.distributed.collective import (CollectiveTimeoutError,
+                                                       _guard_collective)
+        monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_TIMEOUT", "0.15")
+        spawns0 = coll._guard_worker_spawns
+        with pytest.raises(CollectiveTimeoutError):
+            _guard_collective("probe", self.group, lambda: time.sleep(30))
+        # the wedged worker must NOT be reused: the hung thunk may still
+        # complete later on it and interleave with a fresh job
+        assert coll._guard_worker is None
+        assert _guard_collective("probe2", self.group, lambda: 41) == 41
+        assert coll._guard_worker_spawns == spawns0 + 2
+
+    def test_unguarded_path_spawns_no_worker(self, monkeypatch):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import collective as coll
+        monkeypatch.delenv("PADDLE_TPU_COLLECTIVE_TIMEOUT", raising=False)
+        spawns0 = coll._guard_worker_spawns
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        dist.all_reduce(x, group=self.group)
+        assert coll._guard_worker_spawns == spawns0
+        assert coll._guard_worker is None

@@ -1,0 +1,240 @@
+"""Fleet telemetry (distributed/fleet/telemetry.py): digest publication,
+rank-0 aggregation into host-labeled fleet_* gauges, straggler detection —
+including the acceptance scenario: a 2-host job where one host is slowed
+via the injected `fleet.step` delay fault produces exactly ONE
+fleet_straggler event naming the slow host.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.telemetry import (FleetAggregator,
+                                                    FleetReporter,
+                                                    DIGEST_KEY_FMT)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.profiler import events
+from paddle_tpu.profiler import metrics as metrics_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeStore:
+    """Minimal in-memory store (set/get/check) for single-process tests."""
+
+    def __init__(self):
+        self.kv = {}
+        self.lock = threading.Lock()
+
+    def set(self, key, value):
+        with self.lock:
+            self.kv[key] = value.encode() if isinstance(value, str) else value
+
+    def get(self, key):
+        with self.lock:
+            return self.kv[key]
+
+    def check(self, key):
+        with self.lock:
+            return key in self.kv
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    events.default_event_log().clear()
+    yield
+    events.default_event_log().clear()
+
+
+def _feed(reporter, walls, start_step=1):
+    for i, w in enumerate(walls):
+        reporter.note_step(start_step + i, wall_s=w)
+
+
+class TestReporter:
+    def test_digest_shape_and_publication(self):
+        store = FakeStore()
+        rep = FleetReporter(store, rank=1, window=8, min_interval_s=0)
+        _feed(rep, [0.01, 0.02, 0.03], start_step=5)
+        raw = store.get(DIGEST_KEY_FMT.format(rank=1))
+        d = json.loads(raw.decode())
+        assert d["rank"] == 1 and d["step"] == 7
+        assert d["window"] == 3
+        assert abs(d["wall_p50_s"] - 0.02) < 1e-9
+        assert "heter" in d and "barrier_wait_s" in d
+        assert d["host"]
+
+    def test_measured_walls_from_consecutive_notes(self):
+        store = FakeStore()
+        rep = FleetReporter(store, rank=0, window=8, min_interval_s=0)
+        rep.note_step(1)
+        time.sleep(0.05)
+        rep.note_step(2)
+        d = json.loads(store.get(DIGEST_KEY_FMT.format(rank=0)).decode())
+        assert d["last_wall_s"] >= 0.04
+
+    def test_store_failure_disables_after_streak(self):
+        class DeadStore(FakeStore):
+            def set(self, key, value):
+                raise RuntimeError("gone")
+
+        rep = FleetReporter(DeadStore(), rank=0, min_interval_s=0)
+        for step in range(1, rep.MAX_FAIL_STREAK):
+            rep.note_step(step, wall_s=0.01)  # must not raise
+            assert not rep._disabled  # a hiccup is tolerated
+        rep.note_step(rep.MAX_FAIL_STREAK, wall_s=0.01)
+        assert rep._disabled  # a full streak means the store is gone
+
+    def test_publish_success_resets_fail_streak(self):
+        calls = {"n": 0}
+
+        class FlakyStore(FakeStore):
+            def set(self, key, value):
+                calls["n"] += 1
+                if calls["n"] % 2 == 1:  # every other publish blips
+                    raise RuntimeError("blip")
+                super().set(key, value)
+
+        rep = FleetReporter(FlakyStore(), rank=0, min_interval_s=0)
+        for step in range(1, 9):
+            rep.note_step(step, wall_s=0.01)
+        assert not rep._disabled  # alternating blips never reach the streak
+
+
+class TestAggregator:
+    def _fleet(self, slow_factor=10.0, n_steps=6):
+        store = FakeStore()
+        fast = FleetReporter(store, rank=0, window=8, host="trainer-0", min_interval_s=0)
+        slow = FleetReporter(store, rank=1, window=8, host="trainer-1", min_interval_s=0)
+        _feed(fast, [0.01] * n_steps)
+        _feed(slow, [0.01 * slow_factor] * n_steps)
+        return store, FleetAggregator(store, world_size=2,
+                                      straggler_factor=2.0)
+
+    def test_collect_mirrors_fleet_gauges_with_host_labels(self):
+        store, agg = self._fleet()
+        digests = agg.collect()
+        assert sorted(digests) == [0, 1]
+        reg = metrics_mod.default_registry()
+        hosts = {d["host"] for d in digests.values()}
+        g = reg.get("fleet_last_step")
+        labeled = {v["labels"]["host"] for v in g.snapshot()["values"]}
+        assert hosts <= labeled
+        p50 = reg.get("fleet_step_wall_p50_seconds")
+        assert p50 is not None and p50.snapshot()["values"]
+
+    def test_prometheus_text_carries_host_labels(self):
+        store, agg = self._fleet()
+        agg.collect()
+        txt = metrics_mod.default_registry().to_prometheus_text()
+        assert "paddle_tpu_fleet_last_step{host=" in txt
+
+    def test_straggler_fires_exactly_once_and_rearms(self):
+        c = metrics_mod.default_registry().counter(
+            "fleet_straggler_total",
+            "straggler excursions detected (host p50 exceeded fleet median "
+            "by the configured factor), by host")
+        c0 = c.value(host="trainer-1")
+        store, agg = self._fleet(slow_factor=10.0)
+        slow_host = json.loads(
+            store.get(DIGEST_KEY_FMT.format(rank=1)).decode())["host"]
+        for _ in range(4):  # repeated collects must not duplicate
+            agg.collect()
+        recs = events.recent(50, kind="fleet_straggler")
+        assert len(recs) == 1
+        assert recs[0]["straggler"] == slow_host
+        assert agg.straggling() == [slow_host]
+        assert c.value(host=slow_host) == c0 + 1
+        # the slow host recovers: state re-arms, a relapse fires ONE more
+        rep1 = FleetReporter(store, rank=1, window=8, host="trainer-1", min_interval_s=0)
+        _feed(rep1, [0.01] * 6, start_step=50)
+        agg.collect()
+        assert agg.straggling() == []
+        _feed(rep1, [0.5] * 8, start_step=60)
+        agg.collect()
+        assert len(events.recent(50, kind="fleet_straggler")) == 2
+
+    def test_short_windows_do_not_vote(self):
+        store = FakeStore()
+        _feed(FleetReporter(store, rank=0, window=8, host="trainer-0", min_interval_s=0),
+              [0.01] * 2)
+        _feed(FleetReporter(store, rank=1, window=8, host="trainer-1", min_interval_s=0),
+              [0.5] * 2)
+        agg = FleetAggregator(store, 2, straggler_factor=2.0)
+        agg.collect()
+        assert events.recent(50, kind="fleet_straggler") == []
+
+    def test_single_host_fleet_has_no_straggler_semantics(self):
+        store = FakeStore()
+        _feed(FleetReporter(store, rank=0, window=8, min_interval_s=0), [0.5] * 6)
+        FleetAggregator(store, 1).collect()
+        assert events.recent(50, kind="fleet_straggler") == []
+
+    def test_snapshot_shape(self):
+        store, agg = self._fleet()
+        agg.collect()
+        snap = agg.snapshot()
+        assert snap["world_size"] == 2
+        assert set(snap["hosts"]) == {"0", "1"}
+
+
+_HOST_SCRIPT = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.fleet.telemetry import FleetReporter
+store = TCPStore("127.0.0.1", int(sys.argv[1]))
+rep = FleetReporter(store, rank=int(sys.argv[2]), window=8, min_interval_s=0)
+for step in range(1, 14):
+    time.sleep(0.02)        # the base step wall
+    rep.note_step(step)     # fleet.step fault site fires in here
+print("HOST_DONE", flush=True)
+"""
+
+
+class TestTwoHostStragglerE2E:
+    def test_injected_delay_makes_exactly_one_straggler_event(self, tmp_path):
+        """Acceptance: 2 hosts over a real TCPStore, one slowed via the
+        armed `fleet.step` delay fault, aggregator emits exactly one
+        fleet_straggler naming the slow host (trainer-1)."""
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        procs = []
+        try:
+            script = _HOST_SCRIPT.format(repo=REPO)
+            for rank in range(2):
+                env = dict(os.environ)
+                env["PADDLE_CURRENT_ENDPOINT"] = f"trainer-{rank}"
+                env.pop("PADDLE_TPU_FAULT_SPEC", None)
+                if rank == 1:  # the slow host: every step sleeps +80ms
+                    env["PADDLE_TPU_FAULT_SPEC"] = "fleet.step=100:delay"
+                    env["PADDLE_TPU_FAULT_DELAY"] = "0.08"
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c", script, str(master.port),
+                     str(rank)],
+                    env=env, stdout=subprocess.PIPE, text=True))
+            agg = FleetAggregator(TCPStore("127.0.0.1", master.port),
+                                  world_size=2, straggler_factor=2.0)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                agg.collect()
+                if agg.straggling():
+                    break
+                time.sleep(0.05)
+            for p in procs:
+                out, _ = p.communicate(timeout=60)
+                assert "HOST_DONE" in out
+                assert p.returncode == 0
+            agg.collect()  # final pass over the complete digests
+            recs = events.recent(50, kind="fleet_straggler")
+            assert len(recs) == 1, recs
+            assert recs[0]["straggler"] == "trainer-1"
+            assert recs[0]["p50_s"] > recs[0]["fleet_median_s"] * 2.0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            master.stop()

@@ -183,3 +183,66 @@ class TestMetricsDumpTool:
         assert q50 is not None and abs(q50 - 0.001) < 1e-9
         assert q99 is not None and 0.01 < q99 <= 0.1
         assert metrics_dump.hist_quantile({"+Inf": 0}, 0.5) is None
+
+
+class TestMetricNamingLint:
+    """Fleet-observability contract: every registered family is a legal
+    Prometheus name and its help string documents the label keys its
+    series use — a scraper must never meet an undocumented label."""
+
+    NAME_RE = __import__("re").compile(r"^[a-z][a-z0-9_]*$")
+
+    @staticmethod
+    def _import_instrumented_modules():
+        # every module that registers metric families at import
+        import paddle_tpu  # noqa: F401
+        import paddle_tpu.distributed.checkpoint  # noqa: F401
+        import paddle_tpu.distributed.collective  # noqa: F401
+        import paddle_tpu.distributed.fleet.elastic  # noqa: F401
+        import paddle_tpu.distributed.fleet.telemetry  # noqa: F401
+        import paddle_tpu.distributed.ps.cache  # noqa: F401
+        import paddle_tpu.distributed.ps.communicator  # noqa: F401
+        import paddle_tpu.distributed.ps.heter  # noqa: F401
+        import paddle_tpu.fault  # noqa: F401
+        import paddle_tpu.io.dataloader  # noqa: F401
+        import paddle_tpu.io.worker  # noqa: F401
+        import paddle_tpu.ops._dispatch  # noqa: F401
+        import paddle_tpu.profiler.compile_watch  # noqa: F401
+        import paddle_tpu.profiler.watchdog  # noqa: F401
+
+    def test_family_names_match_prometheus_grammar(self):
+        self._import_instrumented_modules()
+        reg = metrics.default_registry()
+        bad = [n for n in reg.names() if not self.NAME_RE.match(n)]
+        assert not bad, f"illegal metric family names: {bad}"
+
+    def test_label_keys_are_documented_in_help(self):
+        """Each live series' label keys must appear (case-insensitively)
+        in the family's help text. Runs over whatever the session has
+        populated so far plus a deterministic seed of the core labeled
+        families."""
+        self._import_instrumented_modules()
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.profiler import compile_watch
+        # deterministic seed: exercise core labeled families
+        a = paddle.to_tensor(np.ones((4, 4), np.float32))
+        paddle.matmul(a, a)  # op_* counters
+        from paddle_tpu.profiler.watchdog import RetraceWatchdog
+        wd = RetraceWatchdog()
+        wd.observe("eager", "lint_op", [np.zeros((2,), np.float32)])
+        compile_watch._on_duration(
+            "/jax/core/compile/backend_compile_duration", 0.01)
+        reg = metrics.default_registry()
+        problems = []
+        for name in reg.names():
+            fam = reg.get(name)
+            help_lc = fam.help.lower()
+            keys = set()
+            for v in fam.snapshot()["values"]:
+                keys.update(v.get("labels", {}))
+            for key in keys:
+                if key.lower() not in help_lc:
+                    problems.append(f"{name}: label {key!r} not mentioned "
+                                    f"in help {fam.help!r}")
+        assert not problems, "\n".join(problems)

@@ -146,3 +146,66 @@ class TestBenchGate:
             capture_output=True, text=True, timeout=120)
         assert r.returncode in (0, 1), r.stderr  # parses + gates
         assert "gpt2_small" in r.stdout
+
+class TestObservabilitySchemaGate:
+    """check_bench_result.py validates `observability` sections against the
+    step-record and event schemas (fleet-observability satellite)."""
+
+    @staticmethod
+    def _good_doc():
+        import time as _time
+        from paddle_tpu.profiler.monitor import make_step_record
+        return {
+            "configs": {"gpt": {"tokens_per_sec_chip": 100000.0}},
+            "observability": {
+                "step_records": [make_step_record(
+                    step=10, window_steps=10, window_time_s=1.0)],
+                "events_tail": [{"ts": _time.time(), "kind": "retrace",
+                                 "host": "trainer-0", "severity": "info"}],
+            },
+        }
+
+    def test_valid_observability_passes(self):
+        doc = self._good_doc()
+        assert gate.validate_observability(doc) == []
+
+    def test_bad_step_record_and_event_named(self):
+        doc = self._good_doc()
+        doc["observability"]["step_records"][0].pop("ts")
+        doc["observability"]["events_tail"][0]["kind"] = "Not Legal"
+        problems = gate.validate_observability(doc)
+        assert len(problems) == 2
+        assert any("step_records[0]" in p and "ts" in p for p in problems)
+        assert any("events_tail[0]" in p and "kind" in p for p in problems)
+
+    def test_per_config_blocks_validated(self):
+        doc = self._good_doc()
+        doc["configs"]["gpt"]["observability"] = {
+            "step_records": [{"bogus": True}]}
+        problems = gate.validate_observability(doc)
+        assert any("configs.gpt.observability" in p for p in problems)
+
+    def test_missing_observability_is_fine(self):
+        assert gate.validate_observability(
+            {"configs": {"gpt": {"tokens_per_sec_chip": 1.0}}}) == []
+
+    def test_gate_fails_on_schema_violation(self, tmp_path, capsys):
+        base = tmp_path / "base.json"
+        cur = tmp_path / "cur.json"
+        base.write_text(json.dumps(self._good_doc()))
+        bad = self._good_doc()
+        bad["observability"]["events_tail"][0].pop("host")
+        cur.write_text(json.dumps(bad))
+        rc = gate.main(["--baseline", str(base), "--current", str(cur)])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "observability schema violations" in out
+        # --no-obs-check restores the old perf-only gate
+        assert gate.main(["--baseline", str(base), "--current", str(cur),
+                          "--no-obs-check"]) == 0
+
+    def test_real_driver_artifact_validates(self):
+        path = os.path.join(REPO, "BENCH_r05.json")
+        if not os.path.exists(path):
+            pytest.skip("no driver artifact on this box")
+        assert gate.validate_observability(gate._load(path)) == []

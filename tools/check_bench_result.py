@@ -10,17 +10,28 @@ with a throughput-like metric is compared, and a relative drop beyond
 --threshold (default 5%) fails the gate. Higher-is-better metrics only —
 step_time_ms is derived from them and would double-count.
 
+The gate also validates the current round's `observability` sections
+against the runtime's schema contracts: every `step_records` entry must
+pass `profiler.monitor.validate_step_record` and every `events_tail`/
+`events` entry must pass `profiler.events.validate_event` (top-level and
+per-config blocks alike) — a bench emitting malformed telemetry fails like
+a perf regression does.
+
 CLI:
     python tools/check_bench_result.py --baseline BENCH_r04.json \
-        --current BENCH_r05.json [--threshold 0.05]
-Exit code 0 = no regression, 1 = regression, 2 = unusable inputs.
+        --current BENCH_r05.json [--threshold 0.05] [--no-obs-check]
+Exit code 0 = no regression, 1 = regression/invalid observability,
+2 = unusable inputs.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # throughput metrics, higher is better
 _METRICS = ("tokens_per_sec_chip", "samples_per_sec_chip",
@@ -98,6 +109,51 @@ def compare(baseline: dict, current: dict, threshold: float):
     return rows
 
 
+def _obs_blocks(doc: dict):
+    """Yield (where, observability-dict) for the top level and each config."""
+    obs = doc.get("observability")
+    if isinstance(obs, dict):
+        yield "observability", obs
+    for name, cfg in (doc.get("configs") or {}).items():
+        sub = cfg.get("observability") if isinstance(cfg, dict) else None
+        if isinstance(sub, dict):
+            yield f"configs.{name}.observability", sub
+
+
+def validate_observability(doc: dict) -> List[str]:
+    """Schema problems in the document's observability sections (empty =
+    valid). step_records must conform to the step-record contract and
+    events/events_tail to the event contract; a missing section is fine
+    (old rounds), a malformed one is not."""
+    from paddle_tpu.profiler.events import validate_event
+    from paddle_tpu.profiler.monitor import validate_step_record
+    problems = []
+    for where, obs in _obs_blocks(doc):
+        recs = obs.get("step_records")
+        if recs is not None:
+            if not isinstance(recs, list):
+                problems.append(f"{where}.step_records is not a list")
+            else:
+                for i, rec in enumerate(recs):
+                    try:
+                        validate_step_record(rec)
+                    except ValueError as e:
+                        problems.append(f"{where}.step_records[{i}]: {e}")
+        for key in ("events_tail", "events"):
+            evs = obs.get(key)
+            if evs is None:
+                continue
+            if not isinstance(evs, list):
+                problems.append(f"{where}.{key} is not a list")
+                continue
+            for i, ev in enumerate(evs):
+                try:
+                    validate_event(ev)
+                except ValueError as e:
+                    problems.append(f"{where}.{key}[{i}]: {e}")
+    return problems
+
+
 def format_rows(rows) -> str:
     lines = [f"{'config':<24} {'metric':<22} {'baseline':>12} "
              f"{'current':>12} {'change':>8}  status"]
@@ -116,18 +172,32 @@ def main(argv=None) -> int:
     ap.add_argument("--current", required=True)
     ap.add_argument("--threshold", type=float, default=0.05,
                     help="relative drop that fails the gate (default 5%%)")
+    ap.add_argument("--no-obs-check", action="store_true",
+                    help="skip observability schema validation of the "
+                         "current round")
     args = ap.parse_args(argv)
     try:
-        rows = compare(_load(args.baseline), _load(args.current),
-                       args.threshold)
+        current = _load(args.current)
+        rows = compare(_load(args.baseline), current, args.threshold)
     except (OSError, ValueError) as e:
         print(f"check_bench_result: {e}", file=sys.stderr)
         return 2
     print(format_rows(rows))
+    obs_problems = [] if args.no_obs_check else validate_observability(current)
     bad = [r for r in rows if r[5] in ("regressed", "missing")]
-    if bad:
-        print(f"\nFAIL: {len(bad)} config(s) regressed or missing "
-              f"(threshold {100 * args.threshold:.0f}%)")
+    if obs_problems:
+        print(f"\nobservability schema violations in {args.current}:")
+        for p in obs_problems:
+            print(f"  - {p}")
+    if bad or obs_problems:
+        msgs = []
+        if bad:
+            msgs.append(f"{len(bad)} config(s) regressed or missing "
+                        f"(threshold {100 * args.threshold:.0f}%)")
+        if obs_problems:
+            msgs.append(f"{len(obs_problems)} observability schema "
+                        f"violation(s)")
+        print(f"\nFAIL: " + "; ".join(msgs))
         return 1
     print("\nOK: no regressions")
     return 0

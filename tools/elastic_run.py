@@ -161,6 +161,34 @@ def main(argv=None) -> int:
             server.stop()
         return 2
 
+    # observability: a child running Model.fit serves
+    # PADDLE_TPU_METRICS_PORT itself; the supervisor serves the SUPERVISOR
+    # port (default +1) — it outlives trainer relaunches, so its /healthz
+    # shows the restart gap as a growing fleet step age, and its /metrics
+    # carries host-labeled fleet_* families aggregated from every rank's
+    # digest. The aggregator is built EXPLICITLY from --master (never by
+    # mutating this process's env — main() may run in-process and env
+    # leaks would rewrite the trainer contract of everything after it).
+    if os.environ.get("PADDLE_TPU_METRICS_PORT", ""):
+        try:
+            from paddle_tpu.profiler import server as _obs_server
+            agg = None
+            if args.np > 1:
+                try:
+                    from paddle_tpu.distributed.fleet.telemetry import (
+                        FleetAggregator)
+                    from paddle_tpu.distributed.store import TCPStore
+                    agg = FleetAggregator(
+                        TCPStore(host, int(port), timeout=10), args.np)
+                except Exception as e:
+                    print(f"[elastic_run] fleet aggregation unavailable: "
+                          f"{e}", file=sys.stderr)
+            _obs_server.maybe_start_server(role="supervisor",
+                                           aggregator=agg)
+        except Exception as e:
+            print(f"[elastic_run] observability server unavailable: {e}",
+                  file=sys.stderr)
+
     manager = None
     member_mgr = None
     if args.watch:

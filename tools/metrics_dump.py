@@ -5,11 +5,16 @@ Input forms (auto-detected):
   * a raw `MetricsRegistry.snapshot()` JSON file;
   * a bench output / driver `BENCH_r{N}.json` whose `observability.metrics`
     holds the snapshot (the shape bench.py emits since PR 2);
+  * a LIVE endpoint of a running job's ObservabilityServer — either its
+    `/snapshot` JSON or its `/metrics` Prometheus text (parsed back into
+    the snapshot shape), via `--url` or an http(s):// positional;
   * `-` for stdin.
 
 CLI:
     python tools/metrics_dump.py BENCH_r06.json
     python tools/metrics_dump.py snapshot.json --filter collective
+    python tools/metrics_dump.py --url http://host:9400/metrics
+    python tools/metrics_dump.py --url http://host:9400/snapshot --filter heter
     python bench.py | python tools/metrics_dump.py -
 
 Exit code 0 on success, 2 on unusable input.
@@ -18,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from typing import Optional
 
@@ -47,6 +53,116 @@ def _fmt_value(v: float) -> str:
 
 def _fmt_labels(labels: dict) -> str:
     return ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "-"
+
+
+_PROM_LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$")
+_PROM_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _prom_unescape(v: str) -> str:
+    # left-to-right scan: sequential str.replace decodes the tail of an
+    # escaped backslash ("\\n" -> backslash+newline instead of "\n")
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            if nxt in ("\\", '"'):
+                out.append(nxt)
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def parse_prometheus_text(txt: str, prefix: str = "paddle_tpu_") -> dict:
+    """Parse a /metrics exposition back into the registry-snapshot shape
+    ({name: {kind, help, values}}), reassembling histograms from their
+    _bucket/_sum/_count series — so the same pretty-printer serves files
+    AND a live endpoint."""
+    kinds, helps = {}, {}
+    # series accumulation: plain -> [(labels, value)], hist -> per-labelkey
+    plain: dict = {}
+    hist: dict = {}
+
+    def strip(name: str) -> str:
+        return name[len(prefix):] if name.startswith(prefix) else name
+
+    for line in txt.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            kinds[strip(name)] = kind.strip()
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_txt = rest.partition(" ")
+            helps[strip(name)] = help_txt
+            continue
+        if line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if not m:
+            continue
+        name, _, label_txt, raw = m.groups()
+        name = strip(name)
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        labels = {k: _prom_unescape(v)
+                  for k, v in _PROM_LABEL.findall(label_txt or "")}
+        base = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and kinds.get(name[:-len(suffix)]) \
+                    == "histogram":
+                base = name[:-len(suffix)]
+                part = suffix[1:]
+                break
+        if base is None:
+            plain.setdefault(name, []).append((labels, value))
+            continue
+        le = labels.pop("le", None)
+        key = tuple(sorted(labels.items()))
+        series = hist.setdefault(base, {}).setdefault(
+            key, {"labels": labels, "buckets": {}, "sum": 0.0, "count": 0})
+        if part == "bucket" and le is not None:
+            series["buckets"][le] = int(value)
+        elif part == "sum":
+            series["sum"] = value
+        elif part == "count":
+            series["count"] = int(value)
+    snap = {}
+    for name, kind in kinds.items():
+        if kind == "histogram":
+            snap[name] = {"kind": kind, "help": helps.get(name, ""),
+                          "values": list(hist.get(name, {}).values())}
+        else:
+            snap[name] = {"kind": kind, "help": helps.get(name, ""),
+                          "values": [{"labels": l, "value": v}
+                                     for l, v in plain.get(name, [])]}
+    return snap
+
+
+def fetch_url(url: str, timeout: float = 10.0) -> Optional[dict]:
+    """GET a live ObservabilityServer endpoint and return a snapshot dict
+    (handles both /metrics text and /snapshot|bench-shaped JSON)."""
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        ctype = r.headers.get("Content-Type", "")
+        body = r.read().decode()
+    if "text/plain" in ctype or body.lstrip().startswith("# "):
+        return parse_prometheus_text(body)
+    doc = json.loads(body)
+    return _extract_snapshot(doc)
 
 
 def hist_quantile(buckets: dict, q: float) -> Optional[float]:
@@ -104,13 +220,41 @@ def format_snapshot(snap: dict, name_filter: str = "") -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="snapshot/bench JSON file, or - for stdin")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="snapshot/bench JSON file, an http(s):// endpoint, "
+                         "or - for stdin")
+    ap.add_argument("--url", default=None,
+                    help="live endpoint of a running job's Observability"
+                         "Server (/metrics Prometheus text or /snapshot "
+                         "JSON)")
     ap.add_argument("--filter", default="",
                     help="only show metric families whose name contains this")
     ap.add_argument("--json", action="store_true",
                     help="re-emit the extracted snapshot as JSON instead of "
                          "the human table")
     args = ap.parse_args(argv)
+    url = args.url
+    if url is None and args.path and args.path.startswith(("http://",
+                                                           "https://")):
+        url = args.path
+    if url is not None:
+        try:
+            snap = fetch_url(url)
+        except Exception as e:
+            print(f"metrics_dump: cannot fetch {url}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+        if snap is None:
+            print(f"metrics_dump: no metrics snapshot in the {url} "
+                  f"response", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(snap, indent=2, sort_keys=True))
+        else:
+            print(format_snapshot(snap, args.filter))
+        return 0
+    if args.path is None:
+        ap.error("need a file path, -, or --url")
     try:
         txt = sys.stdin.read() if args.path == "-" else open(args.path).read()
     except OSError as e:

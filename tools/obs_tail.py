@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Tail / filter / pretty-print the unified structured event log.
+
+The runtime writes one JSON object per line to `PADDLE_TPU_EVENT_LOG`
+(schema: paddle_tpu/profiler/events.py — required ts/kind/host, optional
+severity + kind-specific payload). This renders that stream for operators:
+
+    python tools/obs_tail.py events.jsonl                  # whole file
+    python tools/obs_tail.py events.jsonl -n 50            # last 50
+    python tools/obs_tail.py events.jsonl --kind retrace
+    python tools/obs_tail.py events.jsonl --host trainer-1 --min-severity warn
+    python tools/obs_tail.py events.jsonl --follow         # live tail
+    python tools/obs_tail.py events.jsonl --json --kind fleet_straggler
+    cat events.jsonl | python tools/obs_tail.py -
+
+A running job's recent window is also served live over HTTP
+(`/events?kind=...` on the ObservabilityServer) — this tool is the
+file-based long-horizon view. Lines that do not parse as JSON (torn
+writes, interleaved logging) are counted and reported on stderr, never
+fatal. Exit 0 on success, 2 on unusable input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from datetime import datetime
+from typing import Iterable, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    # the schema owner — keeps --min-severity ordering in lockstep with
+    # what the runtime emits
+    from paddle_tpu.profiler.events import SEVERITIES
+except Exception:  # standalone copy of the tool, no repo on path
+    SEVERITIES = ("debug", "info", "warn", "error")
+
+
+def parse_lines(lines: Iterable[str]):
+    """(events, bad_line_count) from raw JSONL lines."""
+    events, bad = [], 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            bad += 1
+            continue
+        if isinstance(rec, dict) and "kind" in rec:
+            events.append(rec)
+        else:
+            bad += 1
+    return events, bad
+
+
+def event_matches(rec: dict, kind: Optional[str], host: Optional[str],
+                  min_severity: Optional[str], since_ts: float = 0.0) -> bool:
+    if kind and rec.get("kind") != kind:
+        return False
+    if host and rec.get("host") != host:
+        return False
+    if min_severity:
+        sev = rec.get("severity", "info")
+        if sev in SEVERITIES and \
+                SEVERITIES.index(sev) < SEVERITIES.index(min_severity):
+            return False
+    if since_ts and rec.get("ts", 0) < since_ts:
+        return False
+    return True
+
+
+def format_event(rec: dict) -> str:
+    """One aligned human line: time, severity, kind, host, then the
+    kind-specific payload as key=value pairs."""
+    ts = rec.get("ts")
+    try:
+        when = datetime.fromtimestamp(float(ts)).strftime("%H:%M:%S.%f")[:-3]
+    except (TypeError, ValueError, OSError):
+        when = "??:??:??.???"
+    sev = rec.get("severity", "info")
+    extras = " ".join(
+        f"{k}={json.dumps(v) if isinstance(v, (dict, list)) else v}"
+        for k, v in rec.items()
+        if k not in ("ts", "kind", "host", "severity"))
+    return (f"{when} {sev:<5} {rec.get('kind', '?'):<20} "
+            f"{rec.get('host', '?'):<16} {extras}")
+
+
+def _emit(events, as_json: bool, out=sys.stdout):
+    for rec in events:
+        out.write((json.dumps(rec) if as_json else format_event(rec)) + "\n")
+    out.flush()
+
+
+def follow(path: str, args, poll_s: float = 0.5):
+    """Live tail: print matching events appended after startup (plus the
+    initial -n window). Ctrl-C exits cleanly."""
+    with open(path) as f:
+        events, _ = parse_lines(f)
+        window = [e for e in events
+                  if event_matches(e, args.kind, args.host,
+                                   args.min_severity, args.since_ts)]
+        _emit(window[-args.n:] if args.n else window, args.json)
+        try:
+            while True:
+                line = f.readline()
+                if not line:
+                    time.sleep(poll_s)
+                    continue
+                recs, _ = parse_lines([line])
+                _emit([r for r in recs
+                       if event_matches(r, args.kind, args.host,
+                                        args.min_severity, args.since_ts)],
+                      args.json)
+        except KeyboardInterrupt:
+            return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="event JSONL file (PADDLE_TPU_EVENT_LOG), "
+                                 "or - for stdin")
+    ap.add_argument("-n", type=int, default=0,
+                    help="only the last N matching events (0 = all)")
+    ap.add_argument("--kind", default=None,
+                    help="only this event kind (retrace, barrier_abort, "
+                         "fleet_straggler, ...)")
+    ap.add_argument("--host", default=None, help="only this host id")
+    ap.add_argument("--min-severity", default=None, choices=SEVERITIES,
+                    help="drop events below this severity")
+    ap.add_argument("--since-sec", type=float, default=0.0,
+                    help="only events newer than this many seconds ago")
+    ap.add_argument("--follow", action="store_true",
+                    help="keep tailing the file for new events")
+    ap.add_argument("--json", action="store_true",
+                    help="emit matching events as raw JSONL instead of the "
+                         "human format")
+    args = ap.parse_args(argv)
+    args.since_ts = time.time() - args.since_sec if args.since_sec else 0.0
+
+    if args.follow:
+        if args.path == "-":
+            print("obs_tail: --follow needs a file path", file=sys.stderr)
+            return 2
+        if not os.path.exists(args.path):
+            print(f"obs_tail: {args.path}: no such file", file=sys.stderr)
+            return 2
+        return follow(args.path, args) or 0
+
+    try:
+        lines = sys.stdin.readlines() if args.path == "-" \
+            else open(args.path).readlines()
+    except OSError as e:
+        print(f"obs_tail: {e}", file=sys.stderr)
+        return 2
+    events, bad = parse_lines(lines)
+    if bad:
+        print(f"obs_tail: skipped {bad} unparseable line(s)",
+              file=sys.stderr)
+    if not events and bad:
+        return 2
+    matching = [e for e in events
+                if event_matches(e, args.kind, args.host,
+                                 args.min_severity, args.since_ts)]
+    _emit(matching[-args.n:] if args.n else matching, args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

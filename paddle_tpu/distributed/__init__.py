@@ -29,6 +29,7 @@ from . import sharding  # noqa: F401
 from . import launch  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import sharded_checkpoint  # noqa: F401
 from . import ps  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from .store import TCPStore  # noqa: F401

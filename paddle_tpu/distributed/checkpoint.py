@@ -211,37 +211,48 @@ def wait_all():
         raise err
 
 
+def _clean_spec(spec, mesh) -> tuple:
+    """Re-target a saved PartitionSpec at `mesh`: axes the target mesh
+    does not have are dropped (those dims replicate) — restoring onto a
+    smaller/different mesh re-shards what it can. Accepts tuple or list
+    entries (JSON-roundtripped sharded manifests store lists)."""
+    names = set(mesh.axis_names)
+    cleaned = []
+    for p in spec:
+        if p is None:
+            cleaned.append(None)
+        elif isinstance(p, (tuple, list)):
+            kept = tuple(a for a in p if a in names)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(p if p in names else None)
+    return tuple(cleaned)
+
+
+def _warn_reshard_fallback(path: str, spec, mesh, exc: BaseException):
+    """Incompatible spec (divisibility, bad axis): the array stays
+    replicated — but LOUDLY, so silent replication can't masquerade as
+    sharding."""
+    warnings.warn(
+        f"checkpoint restore: could not apply saved sharding to "
+        f"{path or '<root>'} (spec={tuple(spec)}, "
+        f"mesh axes={dict(zip(mesh.axis_names, mesh.devices.shape))}"
+        f"): {type(exc).__name__}: {exc}; keeping the array replicated")
+    if _metrics_mod.enabled():
+        _M_RESHARD_FALLBACK.inc(path=path or "<root>")
+
+
 def _apply_shardings(obj, specs: Dict[str, tuple], mesh, prefix: str = ""):
     if isinstance(obj, np.ndarray):
         arr = jnp.asarray(obj)
         spec = specs.get(prefix)
         if spec is not None and mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
-            names = set(mesh.axis_names)
-            cleaned = []
-            for p in spec:
-                # drop axes that do not exist in the TARGET mesh — restoring
-                # onto a smaller/different mesh replicates those dims
-                if p is None:
-                    cleaned.append(None)
-                elif isinstance(p, tuple):
-                    kept = tuple(a for a in p if a in names)
-                    cleaned.append(kept if kept else None)
-                else:
-                    cleaned.append(p if p in names else None)
+            cleaned = _clean_spec(spec, mesh)
             try:
                 arr = jax.device_put(arr, NamedSharding(mesh, P(*cleaned)))
             except Exception as e:
-                # incompatible spec (divisibility): keep replicated — but
-                # LOUDLY, so silent replication can't masquerade as sharding
-                warnings.warn(
-                    f"checkpoint restore: could not apply saved sharding to "
-                    f"{prefix or '<root>'} (spec={tuple(cleaned)}, "
-                    f"mesh axes={dict(zip(mesh.axis_names, mesh.devices.shape))}"
-                    f"): {type(e).__name__}: {e}; keeping the array "
-                    f"replicated")
-                if _metrics_mod.enabled():
-                    _M_RESHARD_FALLBACK.inc(path=prefix or "<root>")
+                _warn_reshard_fallback(prefix, cleaned, mesh, e)
         return arr
     if isinstance(obj, dict):
         return {k: _apply_shardings(v, specs, mesh, f"{prefix}/{k}")
@@ -285,7 +296,9 @@ def verify(path: str) -> Tuple[bool, Optional[str]]:
 
 
 def _step_files(dirname: str, prefix: str) -> List[Tuple[int, str]]:
-    """[(step, path)] for `<prefix>_<step>` files, newest step first."""
+    """[(step, path)] for `<prefix>_<step>` files, newest step first.
+    Step DIRECTORIES (the sharded/chunked layout) are not this backend's
+    to read — `sharded_checkpoint._step_dirs` owns those."""
     if not os.path.isdir(dirname):
         return []
     out = []
@@ -297,7 +310,10 @@ def _step_files(dirname: str, prefix: str) -> List[Tuple[int, str]]:
             step = int(fn.rsplit("_", 1)[1])
         except ValueError:
             continue
-        out.append((step, os.path.join(dirname, fn)))
+        path = os.path.join(dirname, fn)
+        if os.path.isdir(path):
+            continue
+        out.append((step, path))
     out.sort(reverse=True)
     return out
 
@@ -409,11 +425,16 @@ class CheckpointCoordinator:
     Give the coordinator its own store client connection: the native store
     client is a single socket and is not thread-safe across subsystems.
 
-    Every host MUST use its own checkpoint directory: the barrier
-    coordinates *steps*, not storage. Hosts sharing one directory (NFS)
-    would clobber each other's fixed-name ``.tmp.prep``, race the final
-    rename, and GC each other's in-flight tmps — a shared-storage backend
-    (orbax/tensorstore) is the ROADMAP follow-up for that topology.
+    Directory topology depends on the LAYOUT. With the default file
+    layout every host MUST use its own checkpoint directory: the barrier
+    coordinates *steps*, not storage, and hosts sharing one directory
+    (NFS) would clobber each other's fixed-name ``.tmp.prep``, race the
+    final rename, and GC each other's in-flight tmps. The sharded layout
+    (`sharded_checkpoint.ShardedCheckpointManager`) closes exactly this:
+    chunk files and manifests are rank-namespaced and the commit renames
+    only this rank's manifest, so one shared NFS/GCS-style directory is
+    safe — and required for elastic re-sharding restore across a changed
+    world size.
     """
 
     def __init__(self, store, rank: int, world_size: int,
@@ -679,6 +700,51 @@ def coordinator_from_env(timeout: Optional[float] = None,
                                  resume_timeout=resume_timeout)
 
 
+def detect_layout(dirname: str, prefix: str = "ckpt") -> Optional[str]:
+    """What checkpoint layout lives in `dirname`: "sharded" (step
+    DIRECTORIES holding PTSHARD01 manifests/chunks), "file" (monolithic
+    `<prefix>_<step>` files), or None (empty/fresh directory).
+
+    A directory holding BOTH (a run migrated from the file layout to the
+    sharded one in place) resolves to the layout of the NEWEST step —
+    resume must follow the most recent progress, never the accident of
+    os.listdir order. A tie on step number prefers "sharded" (the file
+    of that step is the older artifact of the two writers)."""
+    if not os.path.isdir(dirname):
+        return None
+    from .sharded_checkpoint import _step_dirs, is_step_dir
+    files = _step_files(dirname, prefix)
+    dirs = [(s, p) for s, p in _step_dirs(dirname, prefix)
+            if is_step_dir(p)]
+    if not files and not dirs:
+        return None
+    if not dirs:
+        return "file"
+    if not files:
+        return "sharded"
+    return "file" if files[0][0] > dirs[0][0] else "sharded"
+
+
+def open_manager(dirname: str, layout: str = "auto", prefix: str = "ckpt",
+                 **kw) -> "CheckpointManager":
+    """Build the right CheckpointManager for `dirname`.
+
+    `layout`: "file" (monolithic per-host pickles, the PR-3/PR-5 path),
+    "sharded" (chunked shared-directory backend,
+    `sharded_checkpoint.ShardedCheckpointManager`), or "auto" — detect
+    from what is already on disk, defaulting to "file" for a fresh
+    directory (pass "sharded" explicitly to start a new sharded run)."""
+    if layout == "auto":
+        layout = detect_layout(dirname, prefix) or "file"
+    if layout == "sharded":
+        from .sharded_checkpoint import ShardedCheckpointManager
+        return ShardedCheckpointManager(dirname, prefix=prefix, **kw)
+    if layout != "file":
+        raise ValueError(f"unknown checkpoint layout {layout!r} "
+                         f"(expected 'file', 'sharded' or 'auto')")
+    return CheckpointManager(dirname, prefix=prefix, **kw)
+
+
 class CheckpointManager:
     """Stepped checkpoints with GC, corruption-tolerant resume, and a
     preemption hook.
@@ -692,6 +758,8 @@ class CheckpointManager:
         ...
         restored = mgr.load_latest()             # (state, step) or None
     """
+
+    layout = "file"
 
     def __init__(self, dirname: str, prefix: str = "ckpt",
                  keep_last_n: int = 5, async_save: bool = False,
@@ -828,6 +896,16 @@ class CheckpointManager:
             except OSError:
                 pass
         return removed
+
+    def drain(self):
+        """Block until every background save this manager may have issued
+        is published; re-raises the first background failure (a silently
+        lost checkpoint is worse than a late crash). Call at end of
+        training — the async writer is a daemon thread, and a process
+        exiting right after `fit()` would otherwise reap it mid-write,
+        leaving the final checkpoint torn while `save()` reported it
+        submitted."""
+        wait_all()
 
     def latest_valid_path(self) -> Optional[str]:
         if self.async_save:

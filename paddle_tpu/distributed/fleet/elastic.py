@@ -83,6 +83,17 @@ class ElasticManager:
         self.elastic_level = int(os.environ.get(
             "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1"))
 
+    def _key(self, name: str) -> str:
+        """Membership keys are namespaced by the FLEET SIZE: an operator
+        relaunching the fleet with a changed --np against the same
+        (supervisor-hosted, long-lived) rendezvous store must not inherit
+        the old world's member registrations — after a 4->2 scale-down the
+        two stale trainer ids would read as permanently dead members and
+        every supervisor's watch would SIGTERM its healthy trainer until
+        the restart budget wedged. A different np is a different fleet;
+        its membership starts empty."""
+        return f"fleet{self.np}/{name}"
+
     # -- registration / heartbeats (reference: etcd TTL lease) -------------
     def register(self):
         self._beat()
@@ -91,7 +102,7 @@ class ElasticManager:
         self._beat_thread.start()
 
     def _beat(self):
-        self._store.set(f"beat/{self.host_id}", repr(time.time()))
+        self._store.set(self._key(f"beat/{self.host_id}"), repr(time.time()))
 
     def _beat_loop(self):
         while not self._stop.wait(self.ttl / 3):
@@ -104,7 +115,7 @@ class ElasticManager:
         members = []
         now = time.time()
         for hid in self._member_ids():
-            key = f"beat/{hid}"
+            key = self._key(f"beat/{hid}")
             try:
                 # store.get blocks until the key exists — probe first (a
                 # departed node deletes its beat key on exit)
@@ -121,13 +132,13 @@ class ElasticManager:
         # membership = per-slot keys claimed via the store's ATOMIC counter
         # (a shared CSV value would lose concurrent joins to read-modify-
         # write races)
-        if not self._store.check("member_count"):
+        if not self._store.check(self._key("member_count")):
             return []
         import struct
-        n = struct.unpack("<q", self._store.get("member_count"))[0]
+        n = struct.unpack("<q", self._store.get(self._key("member_count")))[0]
         ids = []
         for i in range(int(n)):
-            key = f"member/{i}"
+            key = self._key(f"member/{i}")
             if self._store.check(key):
                 v = self._store.get(key).decode()
                 if v:  # "" = tombstone left by a clean exit
@@ -144,11 +155,11 @@ class ElasticManager:
         after a concurrent exit's value write is still in flight), that
         freed slot stays tombstoned unreclaimed — safe, just unreused."""
         try:
-            n = int(self._store.add("member_free_count", 0))
+            n = int(self._store.add(self._key("member_free_count"), 0))
             for i in range(n):
-                if self._store.add(f"member_free_claim/{i}", 1) != 1:
+                if self._store.add(self._key(f"member_free_claim/{i}"), 1) != 1:
                     continue  # someone else owns this index forever
-                key = f"member_free/{i}"
+                key = self._key(f"member_free/{i}")
                 if not self._store.check(key):
                     # won a claim whose value write is still in flight
                     # (concurrent exits publish the count once): that slot
@@ -166,8 +177,8 @@ class ElasticManager:
         across restart cycles instead of growing forever."""
         slot = self._reclaim_slot()
         if slot is None:
-            slot = self._store.add("member_count", 1) - 1
-        self._store.set(f"member/{slot}", self.host_id)
+            slot = self._store.add(self._key("member_count"), 1) - 1
+        self._store.set(self._key(f"member/{slot}"), self.host_id)
         self._slot = slot
         self._clear_done()
         self.register()
@@ -183,21 +194,21 @@ class ElasticManager:
         it observes the clean exit, while most trainers never call this
         themselves."""
         try:
-            self._store.set(f"done/{host_id or self.host_id}", "1")
+            self._store.set(self._key(f"done/{host_id or self.host_id}"), "1")
         except Exception:
             pass  # store gone: job is tearing down anyway
 
     def is_done(self, host_id: str) -> bool:
         try:
-            return bool(self._store.check(f"done/{host_id}"))
+            return bool(self._store.check(self._key(f"done/{host_id}")))
         except Exception:
             return False
 
     def _clear_done(self):
         # a REJOINING host (new generation after restart) is not done
         try:
-            if self._store.check(f"done/{self.host_id}"):
-                self._store.delete_key(f"done/{self.host_id}")
+            if self._store.check(self._key(f"done/{self.host_id}")):
+                self._store.delete_key(self._key(f"done/{self.host_id}"))
         except Exception:
             pass
 
@@ -235,7 +246,7 @@ class ElasticManager:
         if self._beat_thread is not None:
             self._beat_thread.join(timeout=2)
         try:
-            self._store.delete_key(f"beat/{self.host_id}")
+            self._store.delete_key(self._key(f"beat/{self.host_id}"))
         except Exception:
             pass
         # release the membership slot: tombstone member/<i> and publish it
@@ -243,10 +254,10 @@ class ElasticManager:
         # member_count grows without bound across restart cycles)
         if self._slot is not None:
             try:
-                self._store.set(f"member/{self._slot}", "")
-                j = self._store.add("member_free_next", 1) - 1
-                self._store.set(f"member_free/{j}", str(self._slot))
-                self._store.add("member_free_count", 1)  # publish LAST
+                self._store.set(self._key(f"member/{self._slot}"), "")
+                j = self._store.add(self._key("member_free_next"), 1) - 1
+                self._store.set(self._key(f"member_free/{j}"), str(self._slot))
+                self._store.add(self._key("member_free_count"), 1)  # publish LAST
             except Exception:
                 pass  # store gone: job is tearing down
             self._slot = None

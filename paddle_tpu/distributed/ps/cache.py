@@ -179,7 +179,12 @@ class HotRowCache:
         self._slots: "OrderedDict[int, int]" = OrderedDict()
         self._free: List[int] = list(range(self.capacity - 1, -1, -1))
         self.stats = {"hit": 0, "miss": 0, "eviction": 0, "writeback": 0,
-                      "overflow": 0}
+                      "overflow": 0, "invalidation": 0}
+        # server-side lifecycle hook: PSClient.shrink() must flush +
+        # invalidate this cache or evicted rows would be served stale
+        reg = getattr(client, "register_row_cache", None)
+        if callable(reg):
+            reg(self)
 
     # ------------------------------ planning -------------------------------
     def plan(self, uniq: np.ndarray, bucket: int) -> CachePlan:
@@ -292,6 +297,23 @@ class HotRowCache:
             if _metrics_mod.enabled():
                 _M_EVENTS.inc(n, event="writeback",
                               table=str(self.table_id))
+        return n
+
+    def invalidate(self) -> int:
+        """Drop EVERY cached row (index + gradient accumulators). For
+        server-side shrink/eviction: the server just changed or removed
+        rows out from under the cache, so any device-resident copy may be
+        stale — the next batch misses and pulls fresh. Call `flush()`
+        FIRST when gradients may be pending (PSClient.shrink does): the
+        accumulators are zeroed here, and an un-flushed gradient would be
+        silently dropped. Returns the number of rows invalidated."""
+        n = len(self._slots)
+        self._slots.clear()
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.gsum = jnp.zeros_like(self.gsum)
+        self.stats["invalidation"] += n
+        if _metrics_mod.enabled() and n:
+            _M_EVENTS.inc(n, event="invalidation", table=str(self.table_id))
         return n
 
     def note_writeback(self, n: int):

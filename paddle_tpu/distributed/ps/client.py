@@ -361,10 +361,46 @@ class PSClient:
                 if rc != 0:
                     raise RuntimeError(f"push_show_click({table_id}) failed")
 
+    def register_row_cache(self, cache):
+        """Register a device-side hot-row cache serving one of this
+        client's tables (`distributed/ps/cache.py` does this at
+        construction), so server-side lifecycle operations that evict
+        rows — `shrink()` — can flush + invalidate it. Held by weakref:
+        a dropped cache unregisters itself."""
+        import weakref
+        if not hasattr(self, "_row_caches"):
+            self._row_caches = []
+        self._row_caches.append(weakref.ref(cache))
+
+    def _table_caches(self, table_id: int):
+        out = []
+        for ref in list(getattr(self, "_row_caches", ())):
+            c = ref()
+            if c is None:
+                self._row_caches.remove(ref)
+            elif c.table_id == int(table_id):
+                out.append(c)
+        return out
+
     def shrink(self, table_id: int, threshold: float = 0.0,
                max_unseen_days: int = 7) -> int:
         """One day-tick: decay show/click, age rows, evict below-threshold
-        stale rows on every server. Returns total evicted rows."""
+        stale rows on every server. Returns total evicted rows.
+
+        Device hot-row caches registered for this table are part of the
+        lifecycle: their pending gradients are FLUSHED first (so the
+        eviction decision sees fully-accounted rows, and no post-shrink
+        write-back can resurrect an evicted key), then — after the
+        server-side eviction — every cached row is INVALIDATED. Without
+        this, a shrunk row stayed device-resident and was served stale on
+        every later hit (the PR-4 follow-up this closes). Call shrink at
+        a step boundary with no planned-but-undispatched batch in flight
+        (pipelined heter trainers: `HeterPSTrainStep.flush()` first) —
+        a cache plan computed before the invalidation must not be
+        committed after it."""
+        caches = self._table_caches(table_id)
+        for c in caches:
+            c.flush()
         total = 0
         for h in self._handles:
             n = self._lib.ps_shrink(h, table_id, float(threshold),
@@ -372,6 +408,8 @@ class PSClient:
             if n < 0:
                 raise RuntimeError(f"shrink({table_id}) failed")
             total += int(n)
+        for c in caches:
+            c.invalidate()
         return total
 
     def pull_meta(self, table_id: int, keys: np.ndarray):

@@ -154,20 +154,32 @@ class FaultTolerantCheckpoint(Callback):
     instead of training on forever while no checkpoint is ever published
     fleet-wide (persistent aborts mean a peer or a generation is out of
     step). Set the env to 0 to disable.
+
+    Layout: `layout="sharded"` selects the chunked shared-directory
+    backend (`distributed.sharded_checkpoint`): per-array chunk files +
+    per-rank manifests in ONE directory the whole fleet shares, async
+    saves fully off the step critical path, and elastic re-sharding
+    restore across a CHANGED world size. The default "auto" keeps
+    whatever layout the directory already holds (fresh directories get
+    the classic per-host file layout). With the sharded layout an
+    `async_save=True` coordinated save learns its commit outcome one
+    save later, so the abort-exit streak above runs with lag 1.
     """
 
     def __init__(self, dirname: str, save_freq_steps: Optional[int] = None,
                  save_freq_epochs: int = 1, keep_last_n: int = 3,
                  async_save: bool = False, preemption_save: bool = True,
-                 coordinator="auto", barrier_timeout: Optional[float] = None):
+                 coordinator="auto", barrier_timeout: Optional[float] = None,
+                 layout: str = "auto"):
         super().__init__()
-        from ..distributed.checkpoint import (CheckpointManager,
-                                              coordinator_from_env)
+        from ..distributed.checkpoint import (coordinator_from_env,
+                                              open_manager)
         if coordinator == "auto":
             coordinator = coordinator_from_env(timeout=barrier_timeout)
-        self.manager = CheckpointManager(dirname, keep_last_n=keep_last_n,
-                                         async_save=async_save,
-                                         coordinator=coordinator)
+        self.manager = open_manager(dirname, layout=layout,
+                                    keep_last_n=keep_last_n,
+                                    async_save=async_save,
+                                    coordinator=coordinator)
         self.save_freq_steps = save_freq_steps
         self.save_freq_epochs = max(1, save_freq_epochs)
         self.preemption_save = preemption_save
@@ -278,6 +290,11 @@ class FaultTolerantCheckpoint(Callback):
     def on_train_end(self, logs=None):
         if self.preemption_save:
             self.manager.uninstall_preemption_handler()
+        # the async writer is a daemon thread: a trainer exiting right
+        # after fit() would reap it mid-write and the FINAL epoch-end
+        # checkpoint would be silently lost (torn tmp manifest, abandoned
+        # barrier votes) while save() reported it submitted
+        self.manager.drain()
 
 
 class ModelCheckpoint(Callback):

@@ -298,7 +298,8 @@ class Model:
         lends its manager; otherwise one is built from the env contract."""
         import os as _os
         from ..distributed.checkpoint import (CheckpointManager,
-                                              coordinator_from_env)
+                                              coordinator_from_env,
+                                              open_manager)
         mgr = None
         if isinstance(resume, CheckpointManager):
             mgr = resume
@@ -311,8 +312,11 @@ class Model:
                     mgr = c.manager
                     break
             if mgr is None:
-                mgr = CheckpointManager(str(resume),
-                                        coordinator=coordinator_from_env())
+                # layout auto-detected from disk: a directory written by a
+                # sharded (chunked) callback restores through the sharded
+                # backend — including onto a different world size/mesh
+                mgr = open_manager(str(resume),
+                                   coordinator=coordinator_from_env())
         found = mgr.load_latest()
         if found is None:
             return None

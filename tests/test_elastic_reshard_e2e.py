@@ -1,0 +1,259 @@
+"""Slow multi-process e2e: elastic re-sharding restore across a CHANGED
+world size, on the sharded/chunked checkpoint backend in ONE shared
+directory.
+
+Scale-down: a 2-host fleet checkpoints every step (async, chunked,
+coordinated two-phase commit, one shared dir). Both hosts are killed
+inside step 7's commit phase (`ckpt.commit` kill — between prepare and
+commit), so step 7 is torn everywhere and the newest fully-committed step
+is 6. The supervisor then relaunches the job as a ONE-host fleet
+(`--np` changed by the operator): the single trainer re-shards the
+world-2 checkpoint, resumes from the barrier-committed step 6, and
+finishes with weights bit-identical to an uninterrupted single-host run.
+
+Scale-up is symmetric: a 1-host run killed mid-epoch resumes as a 2-host
+fleet from the same shared directory; both hosts negotiate the resume
+step over manifests, restore rank-independently, and finish
+bit-identically.
+
+fast-sibling: tests/test_sharded_ckpt.py (format, ownership,
+re-sharding restore, async off-critical-path, corruption fuzz, chaos) —
+keep those green in tier-1; this file is the slow integration proof.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import sharded_checkpoint as sc
+from paddle_tpu.distributed.store import TCPStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+# Deterministic trainer, shared by every phase. argv: ckpt_dir out_json.
+# World/rank/master come from the standard trainer env contract; the kill
+# phases arm PADDLE_TPU_FAULT_SPEC (ckpt.commit kill) or KILL_AT (SIGKILL
+# after N batches, for the single-host phase that has no barrier site).
+_TRAIN_SCRIPT = r"""
+import json, os, signal, sys
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.hapi.callbacks import Callback, FaultTolerantCheckpoint
+from paddle_tpu.io import Dataset
+
+CKPT, OUT = sys.argv[1], sys.argv[2]
+KILL_AT = int(os.environ.get("KILL_AT", "0"))
+
+
+class DS(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(1000 + i)
+        return rng.randn(4).astype(np.float32), rng.randn(2).astype(np.float32)
+
+
+class KillSwitch(Callback):
+    def __init__(self):
+        super().__init__()
+        self.n = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.n += 1
+        if KILL_AT and self.n >= KILL_AT:
+            os.kill(os.getpid(), signal.SIGKILL)  # no goodbye
+
+
+def build():
+    paddle.seed(42)
+    net = nn.Linear(4, 2)
+    m = paddle.Model(net)
+    m.prepare(optimizer.Adam(learning_rate=1e-2,
+                             parameters=net.parameters()),
+              loss=nn.MSELoss())
+    return m
+
+
+m = build()
+# save_freq_epochs high: only per-step saves + the final epoch-end save,
+# so ckpt.commit occurrence N == global step N's coordinated save
+cbs = [FaultTolerantCheckpoint(CKPT, save_freq_steps=1, save_freq_epochs=10,
+                               layout="sharded", async_save=True)]
+if KILL_AT:
+    cbs.append(KillSwitch())
+m.fit(DS(), batch_size=2, epochs=2, shuffle=False, verbose=0,
+      callbacks=cbs, resume=CKPT)
+
+# uninterrupted single-host reference, trained in THIS process: the
+# resumed-across-world-sizes tail must match it bit for bit
+m2 = build()
+m2.fit(DS(), batch_size=2, epochs=2, shuffle=False, verbose=0)
+for mm in (m, m2):
+    mm._sync_from_train_step()
+
+from paddle_tpu.profiler.metrics import default_registry
+out = {
+    "weights": {k: np.asarray(v.data).tolist()
+                for k, v in m.network.state_dict().items()},
+    "ref_weights": {k: np.asarray(v.data).tolist()
+                    for k, v in m2.network.state_dict().items()},
+    "metrics": default_registry().snapshot(),
+}
+with open(OUT, "w") as f:
+    json.dump(out, f)
+"""
+
+
+def _env(master_port=None, world=1, rank=0, extra=None):
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_FAULT_SPEC", None)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TPU_CKPT_BARRIER_TIMEOUT": "20",
+                "PADDLE_TPU_CKPT_RESUME_TIMEOUT": "120"})
+    if master_port is not None:
+        env["MASTER_ADDR"] = "127.0.0.1"
+        env["MASTER_PORT"] = str(master_port)
+    else:
+        env.pop("MASTER_ADDR", None)
+        env.pop("MASTER_PORT", None)
+    env.update(extra or {})
+    return env
+
+
+def _run_trainer(script, ckpt, out, env, timeout=300):
+    return subprocess.run([sys.executable, str(script), str(ckpt), str(out)],
+                          env=env, timeout=timeout)
+
+
+def _weights(out_path):
+    with open(out_path) as f:
+        doc = json.load(f)
+    return doc
+
+
+def _snapshot_total(snap, name, **labels):
+    vals = snap.get(name, {}).get("values", [])
+    return sum(v["value"] for v in vals
+               if all(v["labels"].get(k) == lv for k, lv in labels.items()))
+
+
+def _assert_bit_identical(doc, who):
+    assert doc["weights"].keys() == doc["ref_weights"].keys()
+    for k in doc["weights"]:
+        assert np.array_equal(np.asarray(doc["weights"][k]),
+                              np.asarray(doc["ref_weights"][k])), \
+            f"{who}: {k} diverged from the uninterrupted run"
+
+
+class TestScaleDownTwoToOne:
+    def test_killed_two_host_fleet_resumes_as_one_host(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+        script = tmp_path / "train.py"
+        script.write_text(_TRAIN_SCRIPT)
+        shared = tmp_path / "ckpt"  # ONE directory for the whole fleet
+
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        try:
+            # phase 1: 2-host fleet, both killed between prepare and
+            # commit of step 7's coordinated save (same occurrence on
+            # both — the fleet dies, like a pod preemption)
+            procs = [subprocess.Popen(
+                [sys.executable, str(script), str(shared),
+                 str(tmp_path / f"out{r}.json")],
+                env=_env(master.port, world=2, rank=r,
+                         extra={"PADDLE_TPU_FAULT_SPEC":
+                                "ckpt.commit=1@7:kill"}))
+                for r in range(2)]
+            for p in procs:
+                assert p.wait(timeout=300) == 17  # the injector's exit code
+        finally:
+            master.stop()
+
+        # the barrier held: steps 1..6 are complete in the shared dir,
+        # step 7 exists only as torn prepares, nothing ever committed it
+        steps = {s: sc.verify_step(p)[0]
+                 for s, p in sc._step_dirs(str(shared), "ckpt")}
+        assert steps.get(7) == "torn", steps
+        committed = sorted(s for s, st in steps.items() if st == "complete")
+        assert committed and max(committed) == 6, steps
+
+        # phase 2: the operator relaunches with --np 1; the supervisor
+        # drives the single-host fleet, which re-shards the world-2
+        # checkpoint and resumes from the barrier-committed step 6
+        out = tmp_path / "out_resume.json"
+        sup = ElasticSupervisor(max_restarts=1, backoff=0.2)
+        rc = sup.supervise(
+            [sys.executable, str(script), str(shared), str(out)],
+            env=_env(None, world=1, rank=0))
+        assert rc == 0
+        doc = _weights(out)
+        _assert_bit_identical(doc, "scale-down host")
+        snap = doc["metrics"]
+        assert _snapshot_total(snap, "checkpoint_loads_total") >= 1
+        # async saves happened in the resumed generation too
+        assert _snapshot_total(snap, "checkpoint_async_bytes") > 0
+
+
+class TestScaleUpOneToTwo:
+    def test_killed_one_host_run_resumes_as_two_host_fleet(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+        script = tmp_path / "train.py"
+        script.write_text(_TRAIN_SCRIPT)
+        shared = tmp_path / "ckpt"
+
+        # phase 1: single host (no barrier), SIGKILLed right after step
+        # 5's batch — its async save may be committed or torn; resume
+        # replays from whatever is newest-committed either way
+        p = subprocess.run(
+            [sys.executable, str(script), str(shared),
+             str(tmp_path / "out_kill.json")],
+            env=_env(None, world=1, rank=0, extra={"KILL_AT": "5"}),
+            timeout=300)
+        assert p.returncode == -9
+        steps = {s: sc.verify_step(pth)[0]
+                 for s, pth in sc._step_dirs(str(shared), "ckpt")}
+        assert any(st == "complete" for st in steps.values()), steps
+
+        # phase 2: relaunched as a 2-host fleet sharing the directory;
+        # both negotiate the resume step over manifests and finish
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        sups, rcs = {}, {}
+        try:
+            import threading
+
+            def host(r):
+                sup = ElasticSupervisor(max_restarts=1, backoff=0.2)
+                sups[r] = sup
+                rcs[r] = sup.supervise(
+                    [sys.executable, str(script), str(shared),
+                     str(tmp_path / f"out_up{r}.json")],
+                    env=_env(master.port, world=2, rank=r))
+
+            ts = [threading.Thread(target=host, args=(r,)) for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=420)
+                assert not t.is_alive(), "supervisor wedged"
+        finally:
+            master.stop()
+        assert rcs == {0: 0, 1: 0}
+
+        docs = {r: _weights(tmp_path / f"out_up{r}.json") for r in range(2)}
+        for r in range(2):
+            _assert_bit_identical(docs[r], f"scale-up host {r}")
+            snap = docs[r]["metrics"]
+            assert _snapshot_total(snap, "checkpoint_loads_total") >= 1
+            assert _snapshot_total(snap, "ckpt_barrier_commits_total") >= 1
+        for k in docs[0]["weights"]:
+            assert np.array_equal(np.asarray(docs[0]["weights"][k]),
+                                  np.asarray(docs[1]["weights"][k]))

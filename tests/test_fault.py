@@ -434,7 +434,10 @@ class TestElasticSlotRelease:
         master = TCPStore("127.0.0.1", 0, is_master=True)
         try:
             def member_count():
-                return _struct.unpack("<q", master.get("member_count"))[0]
+                # membership keys are namespaced by fleet size (np=1 here)
+                # so a relaunch with a changed --np starts a fresh fleet
+                return _struct.unpack("<q",
+                                      master.get("fleet1/member_count"))[0]
 
             # restart cycle: join/exit 3 times — the slot must be reused,
             # not leaked (member_count grew without bound before the fix)

@@ -352,6 +352,90 @@ class TestHotRowCache:
         step.close()
 
 
+class TestShrinkInvalidatesCache:
+    """Server-side table shrink/eviction must reach the device hot-row
+    cache (PR-4 follow-up): before the fix a shrunk row stayed
+    device-resident and every later batch HIT it — serving a row the
+    server had already evicted."""
+
+    def _serve(self, cache, client, tid, keys):
+        """One cached serving round: plan -> pull misses -> commit ->
+        combine. Returns (plan, served rows ndarray)."""
+        import jax.numpy as jnp
+        uniq = np.asarray(keys, np.uint64)
+        plan = cache.plan(uniq, uniq.size)
+        miss_rows = (client.pull_sparse(tid, plan.miss_keys)
+                     if plan.miss_keys.size else
+                     np.zeros((1, cache.dim), np.float32))
+        cache.commit(plan)
+        plan_dev = (jnp.asarray(plan.slot_idx), jnp.asarray(plan.hit_mask),
+                    jnp.asarray(plan.miss_idx))
+        rows = cache.combine(plan_dev, jnp.asarray(miss_rows))
+        return plan, np.asarray(rows)
+
+    def test_shrink_flushes_then_invalidates(self, ps):
+        from paddle_tpu.distributed.ps import TableConfig
+        from paddle_tpu.distributed.ps.cache import HotRowCache
+        import jax.numpy as jnp
+        tid, dim, lr = 60, 4, 0.5
+        ps.create_table(TableConfig(table_id=tid, kind="sparse", dim=dim,
+                                    optimizer="sgd", learning_rate=lr,
+                                    init_range=0.1, seed=11))
+        cache = HotRowCache(tid, dim, capacity=8, learning_rate=lr,
+                            client=ps)
+        k = np.array([7], np.uint64)
+        server_row0 = ps.pull_sparse(tid, k).copy()
+        plan, rows = self._serve(cache, ps, tid, k)
+        assert not plan.hit_mask[0]  # first touch is a miss
+        np.testing.assert_allclose(rows[0], server_row0[0], atol=1e-6)
+
+        # accumulate a local (deferred) gradient on the cached row
+        g = np.full((1, dim), 0.25, np.float32)
+        plan_dev = (jnp.asarray(plan.slot_idx), jnp.asarray(plan.hit_mask),
+                    jnp.asarray(plan.miss_idx))
+        cache.apply(plan_dev, jnp.asarray(rows), jnp.asarray(g))
+
+        # a non-evicting day tick: the pending gradient must be flushed
+        # BEFORE the server's lifecycle pass, and the cache dropped after
+        evicted = ps.shrink(tid, threshold=-1.0, max_unseen_days=30)
+        assert evicted == 0
+        assert len(cache) == 0 and cache.stats["invalidation"] == 1
+        assert not np.any(np.asarray(cache.gsum))  # accumulators cleared
+        server_row1 = ps.pull_sparse(tid, k).copy()
+        np.testing.assert_allclose(server_row1[0], server_row0[0] - lr * g[0],
+                                   atol=1e-5)  # flush landed exactly once
+
+        # re-cache the row, then REALLY evict it server-side: the next
+        # serving round must MISS and see the fresh (re-initialized) row,
+        # never the stale device-resident copy
+        plan, rows_cached = self._serve(cache, ps, tid, k)
+        assert len(cache) == 1
+        for _ in range(3):
+            ps.shrink(tid, threshold=1.0, max_unseen_days=1)
+        _, _, unseen = ps.pull_meta(tid, k)
+        assert unseen[0] == -1  # evicted on the server
+        assert len(cache) == 0, "shrink left the evicted row cached"
+        plan2, rows_fresh = self._serve(cache, ps, tid, k)
+        assert not plan2.hit_mask[0], \
+            "post-shrink serve HIT the stale device cache"
+        fresh_server = ps.pull_sparse(tid, k)
+        np.testing.assert_allclose(rows_fresh[0], fresh_server[0], atol=1e-6)
+
+    def test_unrelated_table_cache_untouched(self, ps):
+        from paddle_tpu.distributed.ps import TableConfig
+        from paddle_tpu.distributed.ps.cache import HotRowCache
+        for t in (61, 62):
+            ps.create_table(TableConfig(table_id=t, kind="sparse", dim=2,
+                                        optimizer="sgd", learning_rate=0.1))
+        c61 = HotRowCache(61, 2, capacity=4, learning_rate=0.1, client=ps)
+        c62 = HotRowCache(62, 2, capacity=4, learning_rate=0.1, client=ps)
+        self._serve(c61, ps, 61, np.array([1], np.uint64))
+        self._serve(c62, ps, 62, np.array([2], np.uint64))
+        ps.shrink(61, threshold=-1.0, max_unseen_days=30)
+        assert len(c61) == 0 and c61.stats["invalidation"] == 1
+        assert len(c62) == 1 and c62.stats["invalidation"] == 0
+
+
 class TestPipelineChaos:
     def test_injected_pull_fault_recovers(self, ps):
         """A PS hiccup in the prepare stage retries under the HETER stage

@@ -1,0 +1,767 @@
+"""Sharded/chunked checkpoint backend: format roundtrip, fleet ownership,
+elastic re-sharding restore, corruption fuzz over chunks + manifests,
+async saves off the step critical path, backpressure, coordinated
+shared-directory commit, and the writer-death prompt-abort chaos contract.
+
+These are the FAST siblings of tests/test_elastic_reshard_e2e.py (the
+slow subprocess proof that a killed 2-host fleet resumes as 1 host and
+vice versa, bit-identically).
+"""
+import json
+import os
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu import fault
+from paddle_tpu.distributed import checkpoint as dist_ckpt
+from paddle_tpu.distributed import sharded_checkpoint as sc
+from paddle_tpu.distributed.checkpoint import (CheckpointCorruptError,
+                                               CheckpointCoordinator,
+                                               detect_layout, open_manager)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.profiler import metrics as metrics_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fault.reset()
+    yield
+    fault.reset()
+
+
+@pytest.fixture()
+def master():
+    st = TCPStore("127.0.0.1", 0, is_master=True)
+    yield st
+    st.stop()
+
+
+def _mgr(tmp_path, master=None, rank=0, world=1, **kw):
+    """A sharded manager; with `master`, one coordinated 'host' sharing
+    tmp_path (the shared-directory topology)."""
+    coord = None
+    if master is not None:
+        store = TCPStore("127.0.0.1", master.port)
+        coord = CheckpointCoordinator(store, rank, world, timeout=5.0,
+                                      poll_interval=0.005)
+    return open_manager(str(tmp_path), layout="sharded", coordinator=coord,
+                        **kw)
+
+
+def _state(seed=0.0):
+    return {
+        "net": {"w": np.arange(12, dtype=np.float32).reshape(3, 4) + seed,
+                "b": np.full(4, 2.0 + seed, np.float32)},
+        "slots": [np.zeros(3, np.float32), np.ones(3, np.float32) * seed],
+        "cursor": {"epoch": 3, "step_in_epoch": int(seed), "done": False},
+        "tag": "gen-" + str(seed),
+        "shapes": (2, "a", None),
+        "exotic": np.float32(1.25),  # not JSON-able: pickle fallback leaf
+    }
+
+
+def _assert_state_equal(a, b):
+    assert set(a) == set(b)
+    np.testing.assert_array_equal(np.asarray(a["net"]["w"]),
+                                  np.asarray(b["net"]["w"]))
+    np.testing.assert_array_equal(np.asarray(a["net"]["b"]),
+                                  np.asarray(b["net"]["b"]))
+    for x, y in zip(a["slots"], b["slots"]):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert a["cursor"] == b["cursor"]
+    assert a["tag"] == b["tag"]
+    assert a["shapes"] == b["shapes"]
+    assert float(a["exotic"]) == float(b["exotic"])
+
+
+def _counter_total(name, **labels):
+    m = metrics_mod.default_registry().get(name)
+    if m is None:
+        return 0.0
+    return sum(v["value"] for v in m.snapshot()["values"]
+               if all(v["labels"].get(k) == lv for k, lv in labels.items()))
+
+
+def _hist_sum(name):
+    m = metrics_mod.default_registry().get(name)
+    if m is None:
+        return 0.0
+    return sum(v["sum"] for v in m.snapshot()["values"])
+
+
+# ---------------------------------------------------------------------------
+# format
+# ---------------------------------------------------------------------------
+class TestFormatRoundtrip:
+    def test_roundtrip_preserves_tree_and_values(self, tmp_path):
+        m = _mgr(tmp_path)
+        st = _state(5.0)
+        assert m.save(st, 1) is True
+        got, step = m.load_latest()
+        assert step == 1
+        _assert_state_equal(got, st)
+
+    def test_layout_detection(self, tmp_path):
+        assert detect_layout(str(tmp_path)) is None
+        _mgr(tmp_path).save(_state(), 1)
+        assert detect_layout(str(tmp_path)) == "sharded"
+        auto = open_manager(str(tmp_path))
+        assert auto.layout == "sharded"
+        # a file-layout dir still auto-detects as file
+        d2 = tmp_path / "plain"
+        dist_ckpt.CheckpointManager(str(d2)).save({"w": np.ones(2)}, 1)
+        assert detect_layout(str(d2)) == "file"
+        assert open_manager(str(d2)).layout == "file"
+
+    def test_mixed_dir_resolves_to_newest_step_layout(self, tmp_path):
+        """A directory holding BOTH layouts (in-place migration) must
+        resume from the layout of the NEWEST step, not whichever entry
+        os.listdir happens to yield first."""
+        dist_ckpt.CheckpointManager(str(tmp_path)).save(
+            {"w": np.ones(2, np.float32)}, 10)
+        _mgr(tmp_path).save(_state(), 20)
+        assert detect_layout(str(tmp_path)) == "sharded"
+        assert open_manager(str(tmp_path)).load_latest()[1] == 20
+        # and the reverse: a newer monolithic file wins
+        d2 = tmp_path / "rev"
+        open_manager(str(d2), layout="sharded").save(_state(), 3)
+        dist_ckpt.CheckpointManager(str(d2)).save(
+            {"w": np.ones(2, np.float32)}, 7)
+        assert detect_layout(str(d2)) == "file"
+        assert open_manager(str(d2)).load_latest()[1] == 7
+
+    def test_manifest_records_world_specs_and_crcs(self, tmp_path):
+        m = _mgr(tmp_path)
+        m.save(_state(), 4)
+        sd = m.path_for(4)
+        with open(os.path.join(sd, "manifest-r0.json")) as f:
+            man = json.load(f)
+        assert man["magic"] == sc.MANIFEST_MAGIC
+        assert man["world_size"] == 1 and man["rank"] == 0
+        assert man["arrays"]["/net/w"]["shape"] == [3, 4]
+        assert man["arrays"]["/net/w"]["dtype"] == "float32"
+        for rec in man["chunks"]:
+            with open(os.path.join(sd, rec["file"]), "rb") as f:
+                data = f.read()
+            assert len(data) == rec["bytes"]
+            assert zlib.crc32(data) & 0xFFFFFFFF == rec["crc32"]
+        assert sc.verify_step(sd, deep=True)[0] == "complete"
+
+    def test_step_files_of_file_backend_ignore_step_dirs(self, tmp_path):
+        """The file backend's latest_valid must not trip over sharded step
+        DIRECTORIES sharing a directory tree."""
+        _mgr(tmp_path).save(_state(), 2)
+        assert dist_ckpt.latest_valid(str(tmp_path)) is None
+
+
+class TestFleetOwnership:
+    def test_each_array_written_exactly_once(self, tmp_path, master):
+        world = 2
+        ms = [_mgr(tmp_path, master, r, world) for r in range(world)]
+        res = {}
+        ts = [threading.Thread(
+            target=lambda r=r: res.update({r: ms[r].save(_state(), 1)}))
+            for r in range(world)]
+        [t.start() for t in ts]
+        [t.join(timeout=60) for t in ts]
+        assert res == {0: True, 1: True}
+        sd = ms[0].path_for(1)
+        scan = sc.scan_step(sd)
+        assert sorted(scan.manifests) == [0, 1]
+        seen = {}
+        for rank, man in scan.manifests.items():
+            for rec in man["chunks"]:
+                assert rec["path"] not in seen, "array written twice"
+                seen[rec["path"]] = rank
+        for path, rank in seen.items():
+            assert rank == sc.owner_rank(path, world)
+        assert set(seen) == set(scan.manifests[0]["arrays"])
+        # either rank alone cannot have written everything (ownership is
+        # spread), unless crc32 degenerately assigned all to one rank
+        assert sc.verify_step(sd, deep=True)[0] == "complete"
+
+    def test_scale_down_restore_from_shared_dir(self, tmp_path, master):
+        """A world-2 checkpoint restores on a world-1 fleet: the single
+        new host reassembles arrays from BOTH ranks' chunks."""
+        world = 2
+        ms = [_mgr(tmp_path, master, r, world) for r in range(world)]
+        st = _state(7.0)
+        ts = [threading.Thread(target=lambda r=r: ms[r].save(st, 3))
+              for r in range(world)]
+        [t.start() for t in ts]
+        [t.join(timeout=60) for t in ts]
+        m1 = open_manager(str(tmp_path))  # auto-detects sharded, world 1
+        got, step = m1.load_latest()
+        assert step == 3
+        _assert_state_equal(got, st)
+
+    def test_scale_up_restore_from_shared_dir(self, tmp_path, master):
+        """A world-1 checkpoint restores on a world-2 fleet: both hosts
+        negotiate over manifests and read rank 0's chunks."""
+        _mgr(tmp_path).save(_state(9.0), 5)
+        ms = [_mgr(tmp_path, master, r, 2) for r in range(2)]
+        res = {}
+        ts = [threading.Thread(
+            target=lambda r=r: res.update({r: ms[r].load_latest()}))
+            for r in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=60) for t in ts]
+        for r in range(2):
+            got, step = res[r]
+            assert step == 5
+            _assert_state_equal(got, _state(9.0))
+
+
+# ---------------------------------------------------------------------------
+# elastic re-sharding (mesh-level)
+# ---------------------------------------------------------------------------
+class TestReshardingRestore:
+    def _sharded_state(self, n_dev):
+        mesh = Mesh(np.array(jax.devices()[:n_dev]), ("x",))
+        w = jax.device_put(
+            jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+            NamedSharding(mesh, P("x")))
+        return mesh, {"w": w, "b": np.ones(3, np.float32)}
+
+    def test_restore_onto_smaller_mesh(self, tmp_path):
+        mesh4, st = self._sharded_state(4)
+        _mgr(tmp_path).save(st, 1)
+        sd = os.path.join(str(tmp_path), "ckpt_1")
+        with open(os.path.join(sd, "manifest-r0.json")) as f:
+            man = json.load(f)
+        assert man["arrays"]["/w"]["spec"] == ["x"]
+        assert man["mesh_axes"] == {"x": 4}
+        # four shard chunks, one per device
+        w_chunks = [c for c in man["chunks"] if c["path"] == "/w"]
+        assert len(w_chunks) == 4
+        mesh2 = Mesh(np.array(jax.devices()[:2]), ("x",))
+        got, step = open_manager(str(tmp_path), mesh=mesh2).load_latest()
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.asarray(st["w"]))
+        assert got["w"].sharding.spec == P("x")
+        assert got["w"].sharding.mesh.shape["x"] == 2
+
+    def test_restore_onto_larger_mesh(self, tmp_path):
+        _, st = self._sharded_state(2)
+        _mgr(tmp_path).save(st, 1)
+        mesh8 = Mesh(np.array(jax.devices()[:8]), ("x",))
+        got, _ = open_manager(str(tmp_path), mesh=mesh8).load_latest()
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.asarray(st["w"]))
+        assert got["w"].sharding.mesh.shape["x"] == 8
+
+    def test_missing_axis_replicates_loudly(self, tmp_path):
+        _, st = self._sharded_state(4)
+        _mgr(tmp_path).save(st, 1)
+        other = Mesh(np.array(jax.devices()[:2]), ("model",))
+        got, _ = open_manager(str(tmp_path), mesh=other).load_latest()
+        # axis "x" does not exist in the target mesh: replicated, same bits
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.asarray(st["w"]))
+        assert got["w"].sharding.spec in (P(None), P())
+
+    def test_reshard_fault_site_is_armed(self, tmp_path):
+        m = _mgr(tmp_path)
+        m.save(_state(), 1)
+        fault.configure("ckpt.reshard", times=1)
+        with pytest.raises(fault.InjectedFault):
+            sc.load_step(m.path_for(1))
+        assert fault.default_injector().fired("ckpt.reshard") == 1
+        got, step = m.load_latest()  # disarmed: restore works again
+        assert step == 1
+
+
+# ---------------------------------------------------------------------------
+# corruption fuzz (chunk-level extension of the PR-3 contract)
+# ---------------------------------------------------------------------------
+class TestCorruptionFuzz:
+    def _three_steps(self, tmp_path):
+        m = _mgr(tmp_path, keep_last_n=5)
+        for s in (1, 2, 3):
+            m.save(_state(float(s)), s)
+        return m
+
+    def _chunk_of(self, m, step, path="/net/w"):
+        sd = m.path_for(step)
+        with open(os.path.join(sd, "manifest-r0.json")) as f:
+            man = json.load(f)
+        rec = next(c for c in man["chunks"] if c["path"] == path)
+        return os.path.join(sd, rec["file"])
+
+    def test_bitflipped_chunk_falls_back(self, tmp_path):
+        m = self._three_steps(tmp_path)
+        cf = self._chunk_of(m, 3)
+        data = bytearray(open(cf, "rb").read())
+        data[len(data) // 2] ^= 0x40
+        open(cf, "wb").write(bytes(data))
+        with pytest.warns(UserWarning, match="skipping corrupt"):
+            got, step = m.load_latest()
+        assert step == 2
+        _assert_state_equal(got, _state(2.0))
+
+    def test_truncated_chunk_falls_back(self, tmp_path):
+        m = self._three_steps(tmp_path)
+        cf = self._chunk_of(m, 3)
+        data = open(cf, "rb").read()
+        open(cf, "wb").write(data[:len(data) // 2])
+        assert sc.verify_step(m.path_for(3))[0] == "corrupt"
+        with pytest.warns(UserWarning, match="skipping corrupt"):
+            got, step = m.load_latest()
+        assert step == 2
+
+    def test_deleted_chunk_falls_back(self, tmp_path):
+        m = self._three_steps(tmp_path)
+        os.remove(self._chunk_of(m, 3))
+        with pytest.warns(UserWarning, match="skipping corrupt"):
+            got, step = m.load_latest()
+        assert step == 2
+
+    def test_deleted_manifest_falls_back(self, tmp_path):
+        m = self._three_steps(tmp_path)
+        os.remove(os.path.join(m.path_for(3), "manifest-r0.json"))
+        got, step = m.load_latest()  # an EMPTY step skips silently
+        assert step == 2
+
+    def test_garbled_manifest_json_falls_back(self, tmp_path):
+        m = self._three_steps(tmp_path)
+        mf = os.path.join(m.path_for(3), "manifest-r0.json")
+        open(mf, "wb").write(b"\x00garbage{{{")
+        assert sc.verify_step(m.path_for(3))[0] == "corrupt"
+        with pytest.warns(UserWarning, match="skipping corrupt"):
+            got, step = m.load_latest()
+        assert step == 2
+
+    def test_bitflipped_pickle_leaf_is_corrupt_not_traceback(self, tmp_path):
+        """A parseable manifest whose pickled leaf is damaged must raise
+        CheckpointCorruptError from load_step — never a raw unpickling
+        traceback (extends the PR-3 contract to the chunked layout)."""
+        m = self._three_steps(tmp_path)
+        mf = os.path.join(m.path_for(3), "manifest-r0.json")
+        man = json.load(open(mf))
+        node = man["tree"]["exotic"]
+        assert "__ptpickle__" in node
+        node["__ptpickle__"] = "AAAA" + node["__ptpickle__"][4:]
+        json.dump(man, open(mf, "w"))
+        with pytest.raises(CheckpointCorruptError):
+            sc.load_step(m.path_for(3))
+        with pytest.warns(UserWarning, match="skipping corrupt"):
+            got, step = m.load_latest()
+        assert step == 2
+
+    def test_all_steps_corrupt_returns_none(self, tmp_path):
+        m = _mgr(tmp_path)
+        m.save(_state(), 1)
+        os.remove(self._chunk_of(m, 1))
+        with pytest.warns(UserWarning, match="skipping corrupt"):
+            assert m.load_latest() is None
+
+    def test_partial_step_still_restores(self, tmp_path, master):
+        """A lost rank whose manifest owned NO chunks (everything this
+        small state owns hashes to the other rank) downgrades the step to
+        `partial` — and restore still works from the surviving chunks."""
+        state = {}
+        i = 0
+        while len(state) < 3:  # keys all owned by rank 0 under world 2
+            k = f"k{i}"
+            if sc.owner_rank(f"/{k}", 2) == 0:
+                state[k] = np.full(4, float(i), np.float32)
+            i += 1
+        ms = [_mgr(tmp_path, master, r, 2) for r in range(2)]
+        ts = [threading.Thread(target=lambda r=r: ms[r].save(state, 1))
+              for r in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=60) for t in ts]
+        sd = ms[0].path_for(1)
+        assert sc.verify_step(sd, deep=True)[0] == "complete"
+        os.remove(os.path.join(sd, "manifest-r1.json"))
+        status, detail = sc.verify_step(sd, deep=True)
+        assert status == "partial", detail
+        got, step = open_manager(str(tmp_path)).load_latest()
+        assert step == 1
+        for k, v in state.items():
+            np.testing.assert_array_equal(np.asarray(got[k]), v)
+
+    def test_lost_owner_rank_is_unrestorable_corrupt(self, tmp_path,
+                                                     master):
+        """Losing the manifest of a rank that DID own chunks makes the
+        step corrupt (arrays cannot be reassembled), not partial."""
+        ms = [_mgr(tmp_path, master, r, 2) for r in range(2)]
+        st = _state()
+        ts = [threading.Thread(target=lambda r=r: ms[r].save(st, 1))
+              for r in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=60) for t in ts]
+        sd = ms[0].path_for(1)
+        owners = {sc.owner_rank(p, 2)
+                  for p in sc.scan_step(sd).manifests[0]["arrays"]}
+        assert owners == {0, 1}  # this state really is spread
+        os.remove(os.path.join(sd, "manifest-r1.json"))
+        status, _ = sc.verify_step(sd)
+        assert status == "corrupt"
+
+
+# ---------------------------------------------------------------------------
+# async: off the critical path + backpressure
+# ---------------------------------------------------------------------------
+class TestAsyncSave:
+    def test_save_is_off_the_critical_path(self, tmp_path, monkeypatch):
+        """Acceptance: step wall time during an in-flight background save
+        stays within noise of no-save steps, and checkpoint_async_seconds
+        records the hidden write cost."""
+        monkeypatch.setenv("PADDLE_TPU_FAULT_DELAY", "0.4")
+        fault.configure("ckpt.chunk_write", times=1, kind="delay")
+        async_sum0 = _hist_sum("checkpoint_async_seconds")
+        m = _mgr(tmp_path, async_save=True)
+        st = {"w": np.random.default_rng(0).normal(
+            size=(64, 64)).astype(np.float32)}
+
+        # baseline: steps with no save in flight
+        def step():
+            t = time.perf_counter()
+            time.sleep(0.002)
+            return time.perf_counter() - t
+        baseline = [step() for _ in range(20)]
+
+        t0 = time.perf_counter()
+        assert m.save(st, 1) is True
+        enqueue = time.perf_counter() - t0
+        assert enqueue < 0.2, \
+            f"save() blocked {enqueue:.3f}s on the background write"
+        during = []
+        while m._writer.busy() and len(during) < 500:
+            during.append(step())
+        assert len(during) >= 3, "write finished too fast to measure"
+        # within noise: nothing stalled for anything like the 0.4s write
+        assert max(during) < max(baseline) + 0.1, (max(during), max(baseline))
+        m._writer.drain()
+        hidden = _hist_sum("checkpoint_async_seconds") - async_sum0
+        assert hidden >= 0.4, hidden  # the sleep landed OFF the step path
+        assert _counter_total("checkpoint_async_bytes") > 0
+        got, step_n = m.load_latest()
+        assert step_n == 1
+        np.testing.assert_array_equal(np.asarray(got["w"]), st["w"])
+
+    def test_backpressure_blocks_second_save(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FAULT_DELAY", "0.3")
+        fault.configure("ckpt.chunk_write", times=2, kind="delay")
+        m = _mgr(tmp_path, async_save=True)
+        st = {"w": np.zeros(8, np.float32)}
+        t0 = time.perf_counter()
+        m.save(st, 1)
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        m.save(st, 2)  # must WAIT for save 1's writer to drain
+        second = time.perf_counter() - t0
+        assert first < 0.15, first
+        assert second >= 0.15, \
+            f"second save did not backpressure ({second:.3f}s)"
+        m._writer.drain()
+        assert m.load_latest()[1] == 2
+
+    def test_save_in_flight_covers_background_writer(self, tmp_path,
+                                                     monkeypatch):
+        """The preemption handler keys off `_save_in_flight`: it must stay
+        True for as long as a background save is queued OR running — a
+        SIGTERM mid-write re-entering a nested coordinated save would
+        desync barrier rounds fleet-wide."""
+        monkeypatch.setenv("PADDLE_TPU_FAULT_DELAY", "0.3")
+        fault.configure("ckpt.chunk_write", times=1, kind="delay")
+        m = _mgr(tmp_path, async_save=True)
+        m.save({"w": np.zeros(4, np.float32)}, 1)
+        assert m._save_in_flight, "in-flight background save not reflected"
+        m._writer.drain()
+        assert not m._save_in_flight
+
+    def test_background_failure_surfaces_on_drain(self, tmp_path):
+        fault.configure("ckpt.chunk_write", times=1, kind="oserror")
+        m = _mgr(tmp_path, async_save=True)
+        m.save({"w": np.zeros(4, np.float32)}, 1)
+        with pytest.raises(fault.InjectedIOError):
+            m._writer.drain()
+        # the failed attempt left nothing a reader could mistake for a
+        # checkpoint
+        assert m.load_latest() is None
+
+
+# ---------------------------------------------------------------------------
+# coordinated shared-directory commit
+# ---------------------------------------------------------------------------
+class TestCoordinatedSharedDir:
+    def test_two_hosts_commit_one_directory(self, tmp_path, master):
+        commits0 = _counter_total("ckpt_barrier_commits_total")
+        ms = [_mgr(tmp_path, master, r, 2) for r in range(2)]
+        res = {}
+        ts = [threading.Thread(
+            target=lambda r=r: res.update({r: ms[r].save(_state(), 1)}))
+            for r in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=60) for t in ts]
+        assert res == {0: True, 1: True}
+        assert _counter_total("ckpt_barrier_commits_total") >= commits0 + 2
+        sd = ms[0].path_for(1)
+        assert sc.verify_step(sd, deep=True)[0] == "complete"
+        assert not any(f.endswith(".tmp.prep") for f in os.listdir(sd))
+
+    def test_missing_peer_aborts_and_leaves_no_manifest(self, tmp_path,
+                                                        master):
+        m0 = _mgr(tmp_path, master, 0, 2)
+        m0.coordinator.timeout = 0.5
+        with pytest.warns(UserWarning, match="aborted"):
+            assert m0.save(_state(), 7) is False
+        sd = m0.path_for(7)
+        # no committed manifest anywhere; tmp + chunks were GC'd
+        assert not os.path.isdir(sd) or not any(
+            sc._parse_manifest_name(f) is not None for f in os.listdir(sd))
+
+    def test_writer_death_aborts_promptly_for_peer(self, tmp_path, master):
+        """Chaos (satellite): a chunk-write fault killing one host's
+        writer mid-prepare must poison the round so the peer aborts in
+        ~poll-interval time, not after the full barrier timeout."""
+        ms = [_mgr(tmp_path, master, r, 2) for r in range(2)]
+        for m in ms:
+            m.coordinator.timeout = 30.0
+        # the two saves race for the single armed fault; whoever draws it
+        # dies in prepare and poisons the round for the other
+        fault.configure("ckpt.chunk_write", times=1)
+        res, t0 = {}, time.perf_counter()
+
+        def run(r):
+            try:
+                res[r] = ms[r].save(_state(), 1)
+            except fault.InjectedFault:
+                res[r] = "died"
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        with pytest.warns(UserWarning, match="aborted"):
+            [t.start() for t in ts]
+            [t.join(timeout=60) for t in ts]
+        elapsed = time.perf_counter() - t0
+        assert sorted(map(str, res.values())) == ["False", "died"], res
+        assert elapsed < 10, \
+            f"peer burned the barrier timeout ({elapsed:.1f}s)"
+        assert fault.default_injector().fired("ckpt.chunk_write") == 1
+
+    def test_save_in_flight_during_sync_coordinated_save(self, tmp_path,
+                                                         master):
+        """The SYNC coordinated path must mark the save in flight for the
+        whole prepare+commit too — a SIGTERM interrupting commit()'s wait
+        loop re-entering a nested save would desync barrier rounds."""
+        import warnings as _w
+        m0 = _mgr(tmp_path, master, 0, 2)
+        m0.coordinator.timeout = 1.5
+        sampled = []
+
+        def run():
+            with _w.catch_warnings():
+                _w.simplefilter("ignore")  # the abort warning (no peer)
+                m0.save(_state(), 1)
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(0.4)  # commit() is waiting on the never-arriving peer
+        sampled.append(m0._save_in_flight)
+        t.join(timeout=30)
+        assert sampled == [True], "sync coordinated save not marked in flight"
+        assert not m0._save_in_flight
+
+    def test_aborted_step_can_be_recommitted(self, tmp_path, master):
+        ms = [_mgr(tmp_path, master, r, 2) for r in range(2)]
+        ms[0].coordinator.timeout = 0.5
+        with pytest.warns(UserWarning, match="aborted"):
+            assert ms[0].save(_state(), 2) is False
+        # peer poisons its next round to stay lockstep, then both retry
+        ms[1].coordinator.abort_next_round(2)
+        res = {}
+        ts = [threading.Thread(
+            target=lambda r=r: res.update({r: ms[r].save(_state(), 2)}))
+            for r in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=60) for t in ts]
+        assert res == {0: True, 1: True}
+        assert sc.verify_step(ms[0].path_for(2))[0] == "complete"
+
+
+# ---------------------------------------------------------------------------
+# manager plumbing
+# ---------------------------------------------------------------------------
+class TestManagerPlumbing:
+    def test_gc_keeps_newest_step_dirs(self, tmp_path):
+        m = _mgr(tmp_path, keep_last_n=2)
+        for s in range(1, 6):
+            m.save(_state(float(s)), s)
+        assert m.steps() == [5, 4]
+
+    def test_orphan_sweep_drops_own_tmps_and_unreferenced_chunks(
+            self, tmp_path):
+        m = _mgr(tmp_path)
+        m.save(_state(), 1)
+        sd = m.path_for(1)
+        # simulate a crashed later attempt: stray tmp manifest + chunk
+        open(os.path.join(sd, "manifest-r0.json.tmp.prep"), "w").write("x")
+        open(os.path.join(sd, "r0-9999.g0a9.chunk"), "wb").write(b"zz")
+        m2 = _mgr(tmp_path)  # init sweeps
+        left = os.listdir(sd)
+        assert "manifest-r0.json.tmp.prep" not in left
+        assert "r0-9999.g0a9.chunk" not in left
+        assert m2.load_latest()[1] == 1
+
+    def test_orphan_sweep_never_touches_peer_files(self, tmp_path):
+        m = _mgr(tmp_path)
+        m.save(_state(), 1)
+        sd = m.path_for(1)
+        # a PEER's live prepare must survive this rank's sweep
+        open(os.path.join(sd, "manifest-r1.json.tmp.prep"), "w").write("x")
+        open(os.path.join(sd, "r1-0000.g0a1.chunk"), "wb").write(b"zz")
+        _mgr(tmp_path)  # init sweep runs as rank 0
+        left = os.listdir(sd)
+        assert "manifest-r1.json.tmp.prep" in left
+        assert "r1-0000.g0a1.chunk" in left
+
+    def test_garbled_rank_env_raises_named_error(self, tmp_path,
+                                                 monkeypatch):
+        """A barrier-opted-out shared-dir fleet with a garbled rank env
+        must fail loudly: a silent rank-0 fallback would have every host
+        clobber the same rank namespace."""
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "not-a-rank")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        with pytest.raises(ValueError, match="PADDLE_TRAINER_ID"):
+            open_manager(str(tmp_path), layout="sharded")
+
+    def test_newest_generation_wins_despite_clock_skew(self, tmp_path,
+                                                       monkeypatch):
+        """Manifest-group freshness orders by GENERATION first: a
+        relaunched host whose wall clock runs behind must still beat the
+        dead generation's stale other-world group."""
+        monkeypatch.setenv("PADDLE_TPU_ELASTIC_RESTART_NUM", "1")
+        m = _mgr(tmp_path)  # world 1, generation 1
+        m.save({"w": np.ones(4, np.float32)}, 1)
+        sd = m.path_for(1)
+        # forge a dead generation-0 world-2 manifest with a FUTURE clock
+        with open(os.path.join(sd, "manifest-r0.json")) as f:
+            man = json.load(f)
+        stale = dict(man, world_size=2, rank=1, generation=0,
+                     wall_time=man["wall_time"] + 1e6, chunks=[])
+        with open(os.path.join(sd, "manifest-r1.json"), "w") as f:
+            json.dump(stale, f)
+        scan = sc.scan_step(sd)
+        assert scan.world_size == 1, \
+            "clock skew resurrected the dead generation's manifest group"
+        got, step = open_manager(str(tmp_path)).load_latest()
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(got["w"]),
+                                      np.ones(4, np.float32))
+
+    def test_fit_drains_async_writer_at_train_end(self, tmp_path,
+                                                  monkeypatch):
+        """fit() must not return while the daemon writer still holds the
+        final epoch-end save — a prompt process exit would reap it
+        mid-write and silently lose the checkpoint."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.hapi.callbacks import FaultTolerantCheckpoint
+        from paddle_tpu.io import Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 2
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                return (rng.randn(4).astype(np.float32),
+                        rng.randn(2).astype(np.float32))
+
+        monkeypatch.setenv("PADDLE_TPU_FAULT_DELAY", "0.05")
+        fault.configure("ckpt.chunk_write", times=999, kind="delay")
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        mdl = paddle.Model(net)
+        mdl.prepare(optimizer.SGD(learning_rate=1e-2,
+                                  parameters=net.parameters()),
+                    loss=nn.MSELoss())
+        cb = FaultTolerantCheckpoint(str(tmp_path / "ck"),
+                                     layout="sharded", async_save=True,
+                                     preemption_save=False)
+        mdl.fit(DS(), batch_size=2, epochs=1, shuffle=False, verbose=0,
+                callbacks=[cb])
+        assert not cb.manager._writer.busy(), \
+            "fit returned with the final save still on the daemon writer"
+        step_dir = cb.manager.latest_valid_path()
+        assert step_dir is not None
+        assert sc.verify_step(step_dir, deep=True)[0] == "complete"
+
+    def test_publish_sync_drains_writer_first(self, tmp_path, monkeypatch):
+        """The preemption save (SIGTERM path) must let an in-flight
+        background save finish publishing before its own synchronous
+        publish — both checkpoints must exist afterwards."""
+        monkeypatch.setenv("PADDLE_TPU_FAULT_DELAY", "0.25")
+        fault.configure("ckpt.chunk_write", times=1, kind="delay")
+        m = _mgr(tmp_path, async_save=True)
+        m.save(_state(1.0), 1)
+        assert m._publish_sync(_state(2.0), 2) is True
+        assert m.steps() == [2, 1]
+        for s in (1, 2):
+            assert sc.verify_step(m.path_for(s), deep=True)[0] == "complete"
+
+    def test_latest_valid_path_and_steps(self, tmp_path):
+        m = _mgr(tmp_path)
+        m.save(_state(), 3)
+        m.save(_state(), 8)
+        assert m.steps() == [8, 3]
+        assert m.latest_valid_path() == m.path_for(8)
+
+    def test_fit_resume_roundtrip_sharded(self, tmp_path):
+        """FaultTolerantCheckpoint(layout='sharded') + fit(resume=): the
+        interrupted run restores through the chunked backend and the tail
+        matches an uninterrupted run bit for bit."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.hapi.callbacks import FaultTolerantCheckpoint
+        from paddle_tpu.io import Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(100 + i)
+                return (rng.randn(4).astype(np.float32),
+                        rng.randn(2).astype(np.float32))
+
+        def build():
+            paddle.seed(7)
+            net = nn.Linear(4, 2)
+            mdl = paddle.Model(net)
+            mdl.prepare(optimizer.Adam(learning_rate=1e-2,
+                                       parameters=net.parameters()),
+                        loss=nn.MSELoss())
+            return mdl
+
+        d = str(tmp_path / "ck")
+        m1 = build()
+        cb = FaultTolerantCheckpoint(d, save_freq_steps=1, layout="sharded",
+                                     preemption_save=False)
+        m1.fit(DS(), batch_size=2, epochs=1, shuffle=False, verbose=0,
+               callbacks=[cb], num_iters=2)
+        assert detect_layout(d) == "sharded"
+
+        m2 = build()  # relaunch: resume + finish both epochs
+        cb2 = FaultTolerantCheckpoint(d, save_freq_steps=1,
+                                      preemption_save=False)  # layout auto
+        assert cb2.manager.layout == "sharded"
+        m2.fit(DS(), batch_size=2, epochs=2, shuffle=False, verbose=0,
+               callbacks=[cb2], resume=d)
+
+        ref = build()
+        ref.fit(DS(), batch_size=2, epochs=2, shuffle=False, verbose=0)
+        for mm in (m2, ref):
+            mm._sync_from_train_step()
+        for k, v in ref.network.state_dict().items():
+            np.testing.assert_array_equal(
+                np.asarray(m2.network.state_dict()[k].data),
+                np.asarray(v.data), err_msg=k)

@@ -209,3 +209,82 @@ class TestObservabilitySchemaGate:
         if not os.path.exists(path):
             pytest.skip("no driver artifact on this box")
         assert gate.validate_observability(gate._load(path)) == []
+
+
+class TestAsyncCheckpointMetricsGate:
+    """checkpoint_async_* families in an observability metrics snapshot
+    must be the right kind with a consistent shape (sharded-checkpoint
+    satellite)."""
+
+    @staticmethod
+    def _doc_with_metrics(metrics):
+        doc = TestObservabilitySchemaGate._good_doc()
+        doc["observability"]["metrics"] = metrics
+        return doc
+
+    @staticmethod
+    def _good_metrics():
+        return {
+            "checkpoint_async_pending": {
+                "kind": "gauge", "help": "h",
+                "values": [{"labels": {}, "value": 0.0}]},
+            "checkpoint_async_bytes": {
+                "kind": "counter", "help": "h",
+                "values": [{"labels": {}, "value": 1024.0}]},
+            "checkpoint_async_seconds": {
+                "kind": "histogram", "help": "h",
+                "values": [{"labels": {},
+                            "buckets": {"0.1": 1, "+Inf": 2},
+                            "sum": 0.5, "count": 2}]},
+        }
+
+    def test_live_registry_snapshot_validates(self):
+        # the REAL families registered by sharded_checkpoint must pass
+        import paddle_tpu.distributed.sharded_checkpoint  # noqa: F401
+        from paddle_tpu.profiler.metrics import default_registry
+        snap = default_registry().snapshot()
+        assert set(_k for _k in snap if _k.startswith("checkpoint_async")) \
+            == {"checkpoint_async_pending", "checkpoint_async_bytes",
+                "checkpoint_async_seconds"}
+        doc = self._doc_with_metrics(snap)
+        assert gate.validate_observability(doc) == []
+
+    def test_good_families_pass(self):
+        assert gate.validate_observability(
+            self._doc_with_metrics(self._good_metrics())) == []
+
+    def test_wrong_kind_named(self):
+        m = self._good_metrics()
+        m["checkpoint_async_pending"]["kind"] = "counter"
+        problems = gate.validate_observability(self._doc_with_metrics(m))
+        assert any("checkpoint_async_pending" in p and "gauge" in p
+                   for p in problems)
+
+    def test_inconsistent_histogram_named(self):
+        m = self._good_metrics()
+        m["checkpoint_async_seconds"]["values"][0]["buckets"]["+Inf"] = 99
+        problems = gate.validate_observability(self._doc_with_metrics(m))
+        assert any("checkpoint_async_seconds" in p and "inconsistent" in p
+                   for p in problems)
+
+    def test_negative_value_and_unknown_family_named(self):
+        m = self._good_metrics()
+        m["checkpoint_async_bytes"]["values"][0]["value"] = -1
+        m["checkpoint_async_queue"] = {"kind": "gauge", "values": []}
+        problems = gate.validate_observability(self._doc_with_metrics(m))
+        assert any("checkpoint_async_bytes" in p for p in problems)
+        assert any("checkpoint_async_queue" in p and "unknown" in p
+                   for p in problems)
+
+    def test_other_families_ignored(self):
+        doc = self._doc_with_metrics(
+            {"op_calls_total": {"kind": "counter", "values": "garbage"}})
+        assert gate.validate_observability(doc) == []
+
+    def test_malformed_values_reported_not_crash(self):
+        for bad in ("garbage", [1, 2], [{"value": 1}, "x"]):
+            m = {"checkpoint_async_pending": {"kind": "gauge",
+                                             "values": bad}}
+            problems = gate.validate_observability(self._doc_with_metrics(m))
+            assert any("checkpoint_async_pending" in p for p in problems), \
+                f"values={bad!r} did not produce a named violation"

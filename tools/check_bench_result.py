@@ -120,15 +120,76 @@ def _obs_blocks(doc: dict):
             yield f"configs.{name}.observability", sub
 
 
+# the async-checkpoint metric families and the snapshot shape each must
+# have when it appears in an observability metrics block
+_ASYNC_CKPT_FAMILIES = {
+    "checkpoint_async_pending": "gauge",
+    "checkpoint_async_bytes": "counter",
+    "checkpoint_async_seconds": "histogram",
+}
+
+
+def _validate_async_ckpt_metrics(where: str, metrics: dict) -> List[str]:
+    """`checkpoint_async_*` families in a metrics snapshot must be
+    well-formed: the right metric kind, numeric non-negative values, and
+    (histograms) buckets/sum/count that agree — a bench advertising async
+    saves with a garbled hidden-cost histogram fails the gate."""
+    problems = []
+    for name, fam in metrics.items():
+        if not name.startswith("checkpoint_async"):
+            continue
+        want = _ASYNC_CKPT_FAMILIES.get(name)
+        if want is None:
+            problems.append(f"{where}.metrics.{name}: unknown "
+                            f"checkpoint_async family (expected one of "
+                            f"{sorted(_ASYNC_CKPT_FAMILIES)})")
+            continue
+        if not isinstance(fam, dict) or fam.get("kind") != want:
+            problems.append(f"{where}.metrics.{name}: kind "
+                            f"{fam.get('kind') if isinstance(fam, dict) else fam!r}"
+                            f", expected {want}")
+            continue
+        values = fam.get("values") or []
+        if not isinstance(values, list) or \
+                not all(isinstance(v, dict) for v in values):
+            problems.append(f"{where}.metrics.{name}.values is not a "
+                            f"list of series objects")
+            continue
+        for i, v in enumerate(values):
+            if want == "histogram":
+                buckets, cnt = v.get("buckets"), v.get("count")
+                if not isinstance(buckets, dict) or \
+                        not isinstance(cnt, (int, float)) or \
+                        not isinstance(v.get("sum"), (int, float)):
+                    problems.append(f"{where}.metrics.{name}[{i}]: "
+                                    f"histogram needs buckets/sum/count")
+                elif buckets.get("+Inf") != cnt or v["sum"] < 0 or cnt < 0:
+                    problems.append(
+                        f"{where}.metrics.{name}[{i}]: inconsistent "
+                        f"histogram (+Inf bucket {buckets.get('+Inf')} != "
+                        f"count {cnt}, or negative sum)")
+            else:
+                val = v.get("value")
+                if not isinstance(val, (int, float)) or val < 0:
+                    problems.append(f"{where}.metrics.{name}[{i}]: "
+                                    f"value {val!r} is not a non-negative "
+                                    f"number")
+    return problems
+
+
 def validate_observability(doc: dict) -> List[str]:
     """Schema problems in the document's observability sections (empty =
-    valid). step_records must conform to the step-record contract and
-    events/events_tail to the event contract; a missing section is fine
-    (old rounds), a malformed one is not."""
+    valid). step_records must conform to the step-record contract,
+    events/events_tail to the event contract, and any
+    `checkpoint_async_*` metric families to their kind/shape contract; a
+    missing section is fine (old rounds), a malformed one is not."""
     from paddle_tpu.profiler.events import validate_event
     from paddle_tpu.profiler.monitor import validate_step_record
     problems = []
     for where, obs in _obs_blocks(doc):
+        metrics = obs.get("metrics")
+        if isinstance(metrics, dict):
+            problems.extend(_validate_async_ckpt_metrics(where, metrics))
         recs = obs.get("step_records")
         if recs is not None:
             if not isinstance(recs, list):

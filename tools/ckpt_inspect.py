@@ -10,11 +10,21 @@ plain-pickle files), then — when the payload is loadable — a table of the
 saved arrays (tree path, shape, dtype) with their recorded PartitionSpecs,
 plus the non-array scalars (epoch/step cursors etc.).
 
+A path that is a SHARDED step directory (the chunked PTSHARD01 layout:
+per-rank manifests + one file per array shard) gets the sharded report
+instead: the manifest table (rank, world size, generation, mesh axes),
+the per-array sharding-spec table, a per-chunk CRC32 verdict, and the
+overall step verdict — `complete`, `partial` (shards missing but every
+array still reassembles: restore works), `torn` (only prepared-but-
+uncommitted manifests), or `corrupt`.
+
 `--dir` renders the per-step COMMIT status across the directory first —
-committed / torn-tmp (a `.tmp.prep` prepared by the two-phase coordinated
-save but never renamed: barrier abort, or a host that died between prepare
-and commit) / corrupt — with the newest-valid verdict resume would pick,
-so a barrier abort can be audited without reading pickles.
+committed / partial / torn-tmp (a `.tmp.prep` prepared by the two-phase
+coordinated save but never renamed: barrier abort, or a host that died
+between prepare and commit) / corrupt — with the newest-valid verdict
+resume would pick, so a barrier abort can be audited without reading
+pickles. Sharded step directories and monolithic step files can coexist
+in one audit.
 """
 from __future__ import annotations
 
@@ -117,6 +127,78 @@ def print_report(info: dict):
         print(f"   {p} = {v}")
 
 
+def is_sharded_step(path: str) -> bool:
+    """True when `path` is a chunked-format step DIRECTORY (delegates to
+    the layout's own predicate so inspector and auto-detector agree)."""
+    from paddle_tpu.distributed.sharded_checkpoint import is_step_dir
+    return is_step_dir(path)
+
+
+def inspect_sharded_step(path: str) -> dict:
+    """Report for one sharded (chunked) step directory — importable.
+
+    Keys: path, status ('complete'|'partial'|'torn'|'corrupt'|'empty'),
+    detail, world_size, manifests [{rank, world_size, generation,
+    mesh_axes, n_chunks}], tmp_manifests, arrays [(path, shape, dtype,
+    spec)], chunks [{file, path, bytes, verdict}]."""
+    from paddle_tpu.distributed import sharded_checkpoint as sc
+
+    # one deep pass: _verify_step_detail hands back its per-chunk
+    # verdicts, so a multi-GB step is read+CRC'd once, not twice
+    status, detail, scan, verdicts = sc._verify_step_detail(path, deep=True)
+    info = {"path": path, "status": status, "detail": detail,
+            "world_size": scan.world_size,
+            "tmp_manifests": [os.path.basename(p)
+                              for p in scan.tmp_manifests],
+            "manifests": [], "arrays": [], "chunks": []}
+    for rank in sorted(scan.manifests):
+        m = scan.manifests[rank]
+        info["manifests"].append({
+            "rank": rank, "world_size": m["world_size"],
+            "generation": m.get("generation"),
+            "mesh_axes": m.get("mesh_axes"),
+            "n_chunks": len(m["chunks"])})
+        for rec in m["chunks"]:
+            info["chunks"].append({"file": rec["file"], "path": rec["path"],
+                                   "bytes": rec["bytes"],
+                                   "verdict": verdicts.get(rec["file"],
+                                                           "unverified")})
+    if scan.manifests:
+        arrays = next(iter(scan.manifests.values()))["arrays"]
+        for p in sorted(arrays):
+            a = arrays[p]
+            info["arrays"].append((p, tuple(a["shape"]), a["dtype"],
+                                   a.get("spec")))
+    return info
+
+
+def print_sharded_report(info: dict):
+    print(f"== {info['path']} (sharded/chunked step)")
+    verdict = info["status"].upper()
+    print(f"   status: {verdict} — {info['detail']}")
+    if info["status"] == "partial":
+        print("   (restore is still possible: surviving chunks cover "
+              "every array)")
+    for m in info["manifests"]:
+        mesh = m["mesh_axes"] or "-"
+        print(f"   manifest rank {m['rank']}/{m['world_size']}  "
+              f"gen {m['generation']}  mesh {mesh}  "
+              f"{m['n_chunks']} chunk(s)")
+    for t in info["tmp_manifests"]:
+        print(f"   PREPARED-UNCOMMITTED {t} (barrier abort, or host died "
+              f"between prepare and commit)")
+    if info["arrays"]:
+        w = max(len(p) for p, *_ in info["arrays"])
+        print(f"   {'tree path':{w}s}  shape            dtype     spec")
+        for p, shape, dtype, spec in info["arrays"]:
+            print(f"   {p:{w}s}  {str(shape):15s}  {dtype:8s}  "
+                  f"{spec if spec else '-'}")
+    for c in info["chunks"]:
+        mark = "ok" if c["verdict"] == "ok" else f"CORRUPT — {c['verdict']}"
+        print(f"   chunk {c['file']:32s} {c['path']:20s} "
+              f"{_fmt_bytes(c['bytes']):>8s}  crc {mark}")
+
+
 def dir_status(dirname: str, prefix: str = "ckpt") -> dict:
     """Per-step commit audit of a checkpoint directory (importable).
 
@@ -128,8 +210,10 @@ def dir_status(dirname: str, prefix: str = "ckpt") -> dict:
     and commit), 'stale-tmp' (only a plain-write `.tmp.*` exists — a
     single-host atomic save was interrupted; no barrier involved)."""
     from paddle_tpu.distributed.checkpoint import _step_files, verify
+    from paddle_tpu.distributed.sharded_checkpoint import _step_dirs
 
     finals = dict((s, p) for s, p in _step_files(dirname, prefix))
+    finals.update((s, p) for s, p in _step_dirs(dirname, prefix))
     tmps: dict = {}
     if os.path.isdir(dirname):
         for fn in os.listdir(dirname):
@@ -146,7 +230,16 @@ def dir_status(dirname: str, prefix: str = "ckpt") -> dict:
         final = finals.get(step)
         entry = {"step": step, "final": final,
                  "tmps": sorted(tmps.get(step, [])), "reason": None}
-        if final is not None:
+        if final is not None and os.path.isdir(final):
+            # chunked-layout step directory: verdict from its manifests
+            from paddle_tpu.distributed import sharded_checkpoint as sc
+            status, detail = sc.verify_step(final, deep=True)
+            entry["status"] = {"complete": "committed",
+                               "torn": "torn-tmp"}.get(status, status)
+            entry["reason"] = detail
+            if status in ("complete", "partial") and newest_valid is None:
+                newest_valid = step
+        elif final is not None:
             ok, reason = verify(final)
             entry["status"] = "committed" if ok else "corrupt"
             entry["reason"] = reason
@@ -172,6 +265,9 @@ def print_dir_report(dirname: str, st: dict):
         line = f"   step {e['step']:>8d}  {e['status']:9s}"
         if e["status"] == "corrupt":
             line += f"  {e['reason']}"
+        elif e["status"] == "partial":
+            line += (f"  shards missing but restore possible "
+                     f"({e['reason']})")
         elif e["status"] == "torn-tmp":
             line += ("  prepared but never committed (barrier abort, or "
                      "host died between prepare and commit)")
@@ -207,9 +303,14 @@ def main(argv=None):
         ap.error("no checkpoint files given")
     bad = 0
     for p in paths:
-        info = inspect_file(p)
-        print_report(info)
-        bad += info["status"] == "corrupt"
+        if is_sharded_step(p):
+            info = inspect_sharded_step(p)
+            print_sharded_report(info)
+            bad += info["status"] in ("corrupt", "torn")
+        else:
+            info = inspect_file(p)
+            print_report(info)
+            bad += info["status"] == "corrupt"
     return 1 if bad else 0
 
 

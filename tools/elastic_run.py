@@ -23,6 +23,17 @@ on EVERY host. With `--watch`, a peer whose heartbeat goes stale (and that
 has not published its done-flag) triggers a local SIGTERM + relaunch, so
 the whole fleet re-enters the same generation together.
 
+Changed world size (elastic re-sharding): when the trainer checkpoints
+with the SHARDED layout (`FaultTolerantCheckpoint(layout="sharded")`,
+one shared directory for the whole fleet), the operator may relaunch the
+supervisors with a DIFFERENT `--np` — e.g. 2 preempted hosts resumed as
+1, or 1 scaled up to 2. Each new rank re-shards the checkpoint onto its
+mesh at restore (`distributed/sharded_checkpoint.py`), and fleet
+membership is namespaced by fleet size, so stale member registrations
+from the old world size in a long-lived `--host-store` rendezvous store
+cannot wedge the new fleet's watch. The classic per-host file layout
+still requires relaunching with the SAME --np.
+
 Knobs (flags override env): --max-restarts / PADDLE_TPU_ELASTIC_MAX_RESTARTS
 (default 3), --backoff / PADDLE_TPU_ELASTIC_BACKOFF (base seconds, doubled
 per restart, capped by PADDLE_TPU_ELASTIC_BACKOFF_MAX), --ttl /

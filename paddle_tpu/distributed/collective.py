@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -52,6 +53,11 @@ _M_COLL_TIMEOUT = _REG.counter(
     "collective_timeout_total",
     "eager collectives that exceeded the deadline (or hit the armed "
     "collective.timeout fault site), by kind and group")
+_M_COLL_SECONDS = _REG.histogram(
+    "collective_seconds",
+    "eager collective wall time (launch through completion of the guarded "
+    "thunk) by kind — the step-diagnosis 'collective' signal; traced/SPMD "
+    "collectives run inside compiled programs and are not timed here")
 
 
 class CollectiveTimeoutError(RuntimeError):
@@ -345,8 +351,17 @@ def _guard_collective(kind: str, group: Group, thunk):
                                      detail="injected fault") from e
     timeout = _deadline_seconds()
     if timeout <= 0:
-        return thunk()
+        if not _metrics_mod.enabled():
+            return thunk()
+        t0 = time.perf_counter()
+        try:
+            return thunk()
+        finally:
+            _M_COLL_SECONDS.observe(time.perf_counter() - t0, kind=kind)
+    t0 = time.perf_counter()
     box = _run_on_guard_worker(thunk, timeout)
+    if box is not None and _metrics_mod.enabled():
+        _M_COLL_SECONDS.observe(time.perf_counter() - t0, kind=kind)
     if box is None:
         # the worker is abandoned, not cancelled (Python can't), so a
         # slow-but-alive fleet may still complete this collective later:

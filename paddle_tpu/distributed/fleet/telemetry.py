@@ -168,6 +168,11 @@ class FleetReporter:
 
     def digest(self, step: int) -> dict:
         p50 = statistics.median(self.walls) if self.walls else None
+        try:
+            from ...profiler.monitor import last_diagnosis
+            diag = (last_diagnosis() or {}).get("dominant")
+        except Exception:
+            diag = None
         return {
             "rank": self.rank,
             "host": self.host,
@@ -177,6 +182,9 @@ class FleetReporter:
             "last_wall_s": self.walls[-1] if self.walls else None,
             "window": len(self.walls),
             "data_wait_frac": self._data_wait_frac(),
+            # newest step_diagnosis dominant term (null until one runs):
+            # the aggregator's fleet view names each host's bottleneck
+            "diag_dominant": diag,
             "barrier_wait_s": round(_hist_sum("ckpt_barrier_wait_seconds"), 6),
             "heter": {
                 "route_s": round(_hist_sum("heter_route_seconds"), 6),

@@ -93,6 +93,48 @@ def _on_flag_set(name: str, value):
             jax.config.update("jax_debug_nans", bool(value))
         except Exception:
             pass
+    elif name == "FLAGS_compile_cache_dir":
+        _apply_compile_cache_dir(value)
+
+
+def _apply_compile_cache_dir(path):
+    """Point jax's persistent compilation cache at `path` (empty = off).
+
+    Makes elastic relaunches / serving cold-starts compile once per
+    program instead of once per process (ROADMAP item 5), and turns the
+    already-exported `xla_compile_cache_events_total{event=}` counters
+    into real hit/miss numbers (profiler/compile_watch.py listens on the
+    jax.monitoring channel the cache feeds). The size/time floors are
+    dropped so every executable is cached — the cache exists for
+    multi-minute pod-scale compiles, but CI exercises the same path with
+    tiny ones."""
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path or None)
+        if path:
+            # each floor knob guarded on its own: a jax version missing one
+            # must not skip the reset_cache() below (without which a
+            # runtime enable is silently ignored — see comment there)
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0)
+            except Exception:
+                pass
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", -1)
+            except Exception:
+                pass  # knob not present on older jax
+        try:
+            # jax latches its cache handle on the FIRST compile of the
+            # process; without a reset, enabling the dir after any compile
+            # (set_flags at runtime, not env) is silently ignored
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+    except Exception:
+        pass  # jax absent / too old: the flag stays readable, inert
 
 
 # ---------------------------------------------------------------------------
@@ -121,6 +163,16 @@ define_flag("FLAGS_eager_op_cache", True,
             "attrs) for eager dispatch (reference: the C++ tracer's "
             "microsecond per-op path, imperative/tracer.cc:172); disable "
             "to force per-call jax.vjp re-tracing")
+define_flag("FLAGS_compile_cache_dir",
+            os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR", ""),
+            "persistent XLA compilation cache directory "
+            "(jax_compilation_cache_dir): elastic relaunches and serving "
+            "cold-starts reuse compiled executables across processes; "
+            "hits/misses land in xla_compile_cache_events_total. "
+            "Set via PADDLE_TPU_COMPILE_CACHE_DIR or set_flags; empty "
+            "disables")
 
 if os.environ.get("FLAGS_check_nan_inf"):
     _on_flag_set("FLAGS_check_nan_inf", flag("FLAGS_check_nan_inf"))
+if flag("FLAGS_compile_cache_dir"):
+    _apply_compile_cache_dir(flag("FLAGS_compile_cache_dir"))

@@ -32,6 +32,7 @@ from ..profiler import compile_watch as _compile_watch
 from ..profiler import device_time as _device_time
 from ..profiler import events as _events_mod
 from ..profiler import metrics as _metrics_mod
+from ..profiler import xplane as _xplane
 from ..profiler.recorder import HostSpan, get_recorder, now_ns
 from ..profiler.watchdog import get_watchdog
 
@@ -337,7 +338,15 @@ def call(impl: Callable, tensors: Sequence[Any], kwargs: Optional[dict] = None,
     if not tracing and not _metrics_mod.enabled():
         return _execute_guarded(impl, kwargs, arrs, tensors, name, requires)
     t0 = now_ns() if tracing else 0  # clock reads only feed spans/histogram
-    result = _execute_guarded(impl, kwargs, arrs, tensors, name, requires)
+    if tracing and _xplane.annotating():
+        # an xplane capture session is recording: put this op's name in the
+        # device trace so xplane.correlate can hand its measured backend
+        # time back to the span below
+        with jax.profiler.TraceAnnotation(name):
+            result = _execute_guarded(impl, kwargs, arrs, tensors, name,
+                                      requires)
+    else:
+        result = _execute_guarded(impl, kwargs, arrs, tensors, name, requires)
     t1 = now_ns() if tracing else 0
     outs = result if isinstance(result, tuple) else (result,)
     nbytes = _op_bytes_estimate(

@@ -15,6 +15,7 @@ from . import metrics
 from .monitor import (ThroughputMonitor, make_step_record,
                       validate_step_record)
 from . import server
+from . import xplane
 from .profiler import (Profiler, ProfilerState, ProfilerTarget,
                        export_chrome_tracing, export_protobuf, make_scheduler)
 from .statistic import SortedKeys, StatisticData, summary_report
@@ -32,6 +33,7 @@ __all__ = [
     'export_chrome_tracing', 'export_protobuf', 'RecordEvent',
     'load_profiler_result', 'SortedKeys', 'StatisticData', 'summary_report',
     'Benchmark', 'benchmark', 'metrics', 'events', 'compile_watch',
-    'device_time', 'server', 'ThroughputMonitor', 'make_step_record',
-    'validate_step_record', 'RetraceWatchdog', 'get_watchdog',
+    'device_time', 'server', 'xplane', 'ThroughputMonitor',
+    'make_step_record', 'validate_step_record', 'RetraceWatchdog',
+    'get_watchdog',
 ]

@@ -16,10 +16,10 @@ from "device-bound". Two attribution modes, recorded alongside each span:
   mode, never the default (the reference pays the same price for
   `nvprof --sync`-style tracing).
 
-The full-fidelity path — correlating host spans with the XPlane device
-trace `jax.profiler` writes on real TPU — remains the documented follow-up;
-these two modes make host-vs-device separable TODAY and give the chrome
-trace + summary rows the extra column the XPlane merge will later refine.
+The full-fidelity third mode lives in `profiler/xplane.py`: a bounded
+`jax.profiler` capture session whose parsed trace is correlated back onto
+host spans (`device_src="xplane"`), replacing the estimate with measured
+backend execution time wherever the correlation lands.
 
 Peaks: TPU `BENCH_PEAK_FLOPS` (default 197e12, v5e bf16) and
 `PADDLE_TPU_PEAK_HBM_GBS` (GB/s, default 819 = v5e); CPU gets deliberately
@@ -32,12 +32,17 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["sync_mode", "estimate_ns", "attribute", "split_rows",
-           "platform_peaks"]
+           "platform_peaks", "reset_peaks"]
 
 _CPU_PEAK_FLOPS = 100e9
 _CPU_PEAK_BW = 20e9
 
-_peaks_cache: Optional[Tuple[str, float, float]] = None
+# cache keyed on the env knobs that feed it — a test or bench changing
+# BENCH_PEAK_FLOPS / PADDLE_TPU_PEAK_HBM_GBS mid-process must see fresh
+# peaks, not the first call's (the platform probe alone stays cached: a
+# process cannot change backends)
+_peaks_cache: Optional[Tuple[Tuple[Optional[str], Optional[str]],
+                             Tuple[str, float, float]]] = None
 
 
 def _platform() -> str:
@@ -51,17 +56,27 @@ def _platform() -> str:
 def platform_peaks() -> Tuple[str, float, float]:
     """(platform, peak_flops/s, peak_bytes/s) used by the estimator."""
     global _peaks_cache
-    if _peaks_cache is not None:
-        return _peaks_cache
-    plat = _platform()
+    env_key = (os.environ.get("BENCH_PEAK_FLOPS"),
+               os.environ.get("PADDLE_TPU_PEAK_HBM_GBS"))
+    if _peaks_cache is not None and _peaks_cache[0] == env_key:
+        return _peaks_cache[1]
+    plat = _platform() if _peaks_cache is None else _peaks_cache[1][0]
     if plat == "cpu":
         peaks = (plat, _CPU_PEAK_FLOPS, _CPU_PEAK_BW)
     else:
-        flops = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
-        bw = float(os.environ.get("PADDLE_TPU_PEAK_HBM_GBS", 819)) * 1e9
+        flops = float(env_key[0]) if env_key[0] else 197e12
+        bw = float(env_key[1] if env_key[1] else 819) * 1e9
         peaks = (plat, flops, bw)
-    _peaks_cache = peaks
+    _peaks_cache = (env_key, peaks)
     return peaks
+
+
+def reset_peaks():
+    """Drop the cached peaks (including the platform probe) — tests that
+    monkeypatch the backend need this; env-knob changes are picked up
+    automatically."""
+    global _peaks_cache
+    _peaks_cache = None
 
 
 def sync_mode() -> bool:
@@ -94,6 +109,12 @@ def attribute(outs, flops: float, nbytes: float,
     return estimate_ns(flops, nbytes), "estimate"
 
 
+#: provenance ranking: a row's src label is its best span's source
+#: (xplane = correlated from a real jax.profiler trace, the authoritative
+#: mode; measured = sync-mode wall; estimate = roofline bound)
+SRC_PRIORITY = {"estimate": 0, "measured": 1, "xplane": 2}
+
+
 def split_rows(spans) -> List[dict]:
     """Aggregate host-vs-device time per op name from spans that carry
     device attribution — the bench JSON's `device_time.rows` shape,
@@ -108,8 +129,8 @@ def split_rows(spans) -> List[dict]:
         row["calls"] += 1
         row["host_ms"] += s.dur_ns / 1e6
         row["device_ms"] += s.device_ns / 1e6
-        if s.device_src == "measured":
-            row["src"] = "measured"
+        if SRC_PRIORITY.get(s.device_src, 0) > SRC_PRIORITY.get(row["src"], 0):
+            row["src"] = s.device_src
     rows = sorted(acc.values(), key=lambda r: -r["device_ms"])
     for r in rows:
         r["host_ms"] = round(r["host_ms"], 4)

@@ -56,6 +56,8 @@ KINDS = (
     "collective_timeout", # eager collective blew its deadline
     "device_oom",         # eager op exhausted device memory
     "fleet_straggler",    # a host's rolling step p50 left the fleet band
+    "step_diagnosis",     # a step window's wall-time decomposition
+    "profile_capture",    # an on-demand profiler capture session ended
 )
 
 SEVERITIES = ("debug", "info", "warn", "error")

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "default_registry", "enabled", "set_enabled",
+    "update_device_memory_gauges", "sample_device_memory",
 ]
 
 # default histogram buckets: seconds, spanning sub-ms host dispatch to
@@ -249,27 +250,105 @@ def set_enabled(flag: bool):
     _enabled = bool(flag)
 
 
-def update_device_memory_gauges(registry: Optional[MetricsRegistry] = None):
-    """Refresh the jax device-memory gauges (allocation high-water mark).
-    Safe everywhere: CPU backends report no memory_stats and are skipped;
-    honors the PADDLE_TPU_METRICS kill switch like every instrument site."""
+def update_device_memory_gauges(registry: Optional[MetricsRegistry] = None
+                                ) -> dict:
+    """Refresh every device-memory gauge from ONE sampling pass and return
+    the sample (see :func:`sample_device_memory` for its shape).
+
+    The PR-2 legacy families (`device_bytes_in_use` /
+    `device_peak_bytes_in_use`) are kept as back-compat mirrors of the
+    allocator-backed series only (they predate the live-arrays fallback);
+    the `device_memory_*` families cover every backend. Honors the
+    PADDLE_TPU_METRICS kill switch like every instrument site."""
     if not _enabled:
-        return
+        return {}
     reg = registry or _default_registry
+    sample = sample_device_memory(registry=reg)
     try:
-        import jax
-        for d in jax.devices():
-            stats = d.memory_stats() or {}
-            if not stats:
+        for label, st in sample.items():
+            if st["src"] != "memory_stats":
                 continue
-            labels = {"device": f"{d.platform}:{d.id}"}
-            if "bytes_in_use" in stats:
-                reg.gauge("device_bytes_in_use",
-                          "device memory currently allocated").set(
-                    stats["bytes_in_use"], **labels)
-            if "peak_bytes_in_use" in stats:
-                reg.gauge("device_peak_bytes_in_use",
-                          "device memory allocation high-water mark").set(
-                    stats["peak_bytes_in_use"], **labels)
+            reg.gauge("device_bytes_in_use",
+                      "device memory currently allocated").set(
+                st["bytes_in_use"], device=label)
+            reg.gauge("device_peak_bytes_in_use",
+                      "device memory allocation high-water mark").set(
+                st["peak_bytes"], device=label)
     except Exception:
         pass
+    return sample
+
+
+# running high-water mark per device label for backends whose allocator
+# reports no peak (the live-arrays fallback can only see "now")
+_mem_peak_seen: Dict[str, float] = {}
+
+
+def _live_array_bytes():
+    """{device label: bytes} summed over jax.live_arrays() shards — the
+    HBM-watermark fallback for backends (CPU) with no memory_stats."""
+    import jax
+    out: Dict[str, float] = {}
+    for a in jax.live_arrays():
+        try:
+            for sh in a.addressable_shards:
+                d = sh.device
+                out[f"{d.platform}:{d.id}"] = (
+                    out.get(f"{d.platform}:{d.id}", 0.0)
+                    + float(getattr(sh.data, "nbytes", 0)))
+        except Exception:
+            continue
+    return out
+
+
+def sample_device_memory(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Sample per-device memory into ``device_memory_bytes_in_use`` /
+    ``device_memory_peak_bytes`` gauges and return
+    ``{device: {"bytes_in_use", "peak_bytes", "src"}}``.
+
+    Source is the allocator's ``memory_stats()`` where the backend has one
+    (TPU/GPU: real HBM watermarks) and a ``jax.live_arrays()`` byte sum
+    otherwise (CPU CI: the peak is a running max of samples, so it only
+    tightens with sampling frequency). Never raises; honors the
+    PADDLE_TPU_METRICS kill switch."""
+    if not _enabled:
+        return {}
+    reg = registry or _default_registry
+    out: Dict[str, dict] = {}
+    try:
+        import jax
+        g_use = reg.gauge(
+            "device_memory_bytes_in_use",
+            "device memory currently allocated, by device "
+            "(allocator memory_stats, else live-array byte sum)")
+        g_peak = reg.gauge(
+            "device_memory_peak_bytes",
+            "device memory high-water mark, by device (allocator peak "
+            "where available, else running max of samples)")
+        live = None
+        for d in jax.devices():
+            label = f"{d.platform}:{d.id}"
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                pass
+            if "bytes_in_use" in stats:
+                in_use = float(stats["bytes_in_use"])
+                peak = float(stats.get("peak_bytes_in_use", in_use))
+                src = "memory_stats"
+            else:
+                if live is None:
+                    live = _live_array_bytes()
+                in_use = float(live.get(label, 0.0))
+                peak = in_use
+                src = "live_arrays"
+            peak = max(peak, _mem_peak_seen.get(label, 0.0), in_use)
+            _mem_peak_seen[label] = peak
+            g_use.set(in_use, device=label)
+            g_peak.set(peak, device=label)
+            out[label] = {"bytes_in_use": int(in_use),
+                          "peak_bytes": int(peak), "src": src}
+    except Exception:
+        pass
+    return out

@@ -29,7 +29,8 @@ from .timer import benchmark
 from .watchdog import get_watchdog
 
 __all__ = ["ThroughputMonitor", "make_step_record", "validate_step_record",
-           "STEP_RECORD_REQUIRED", "STEP_RECORD_FIELDS"]
+           "STEP_RECORD_REQUIRED", "STEP_RECORD_FIELDS",
+           "diag_signals", "diagnose_window", "DIAG_TERMS"]
 
 # schema: required keys are always present; optional keys are present but
 # may be null when the ingredient (sample counts, FLOPs) is unknown
@@ -39,7 +40,7 @@ STEP_RECORD_REQUIRED = {
 }
 STEP_RECORD_OPTIONAL = {
     "ips": float, "samples": int, "flops_per_step_est": float,
-    "mfu_est": float,
+    "mfu_est": float, "device_mem_bytes": int, "device_mem_peak_bytes": int,
 }
 STEP_RECORD_FIELDS = set(STEP_RECORD_REQUIRED) | set(STEP_RECORD_OPTIONAL)
 
@@ -51,7 +52,9 @@ def make_step_record(*, step: int, window_steps: int, window_time_s: float,
                      data_wait_s: float = 0.0,
                      flops_per_step: Optional[float] = None,
                      peak_flops: Optional[float] = None,
-                     retraces: int = 0) -> dict:
+                     retraces: int = 0,
+                     device_mem_bytes: Optional[int] = None,
+                     device_mem_peak_bytes: Optional[int] = None) -> dict:
     """Build one schema-conformant step-window record. Degrades gracefully:
     a zero-length window yields zero rates, missing samples/FLOPs yield
     null ips/mfu — never a ZeroDivisionError."""
@@ -77,7 +80,120 @@ def make_step_record(*, step: int, window_steps: int, window_time_s: float,
                                if flops_per_step else None),
         "mfu_est": mfu,
         "retraces": int(retraces),
+        "device_mem_bytes": (int(device_mem_bytes)
+                             if device_mem_bytes is not None else None),
+        "device_mem_peak_bytes": (int(device_mem_peak_bytes)
+                                  if device_mem_peak_bytes is not None
+                                  else None),
     }
+
+
+def _sampled_device_mem():
+    """(bytes_in_use summed, peak summed) across devices, or (None, None)
+    when sampling found nothing (metrics disabled, no live arrays). One
+    sampling pass refreshes ALL the gauges too."""
+    mem = metrics_mod.update_device_memory_gauges()
+    if not mem:
+        return None, None
+    return (sum(v["bytes_in_use"] for v in mem.values()),
+            sum(v["peak_bytes"] for v in mem.values()))
+
+
+# ---------------------------------------------------------------------------
+# step-slowness diagnosis: decompose a window's wall time into the runtime's
+# known cost terms from signals the registry already holds
+# ---------------------------------------------------------------------------
+#: the decomposition terms, each backed by named registry families (plus the
+#: residual "unattributed" bucket diagnose_window adds)
+DIAG_TERMS = ("data_wait", "host_dispatch", "device_compute", "collective",
+              "compile", "checkpoint", "straggler_wait")
+
+# term -> metric families whose cumulative seconds feed it (histogram sums
+# and counters both work — _cum_seconds handles either)
+_DIAG_FAMILIES = {
+    "host_dispatch": ("op_time_seconds",),
+    "device_compute": ("op_device_seconds",),
+    "collective": ("collective_seconds",),
+    "compile": ("xla_compile_seconds",),
+    "checkpoint": ("checkpoint_save_seconds", "checkpoint_async_seconds"),
+    "straggler_wait": ("ckpt_barrier_wait_seconds",),
+}
+
+
+def _cum_seconds(name: str) -> float:
+    """Cumulative seconds accumulated by a family across all its series."""
+    m = metrics_mod.default_registry().get(name)
+    if m is None:
+        return 0.0
+    try:
+        total = 0.0
+        for v in m.snapshot()["values"]:
+            total += float(v["sum"] if "sum" in v else v.get("value", 0.0))
+        return total
+    except Exception:
+        return 0.0
+
+
+#: newest diagnosis this process produced (any source: monitor window,
+#: capture session, manual call) — the fleet digest picks it up so the
+#: aggregator can show every host's dominant term
+_last_diagnosis: Optional[dict] = None
+
+
+def last_diagnosis() -> Optional[dict]:
+    return _last_diagnosis
+
+
+def diag_signals() -> dict:
+    """Cumulative per-term seconds right now — capture once at a window's
+    start and hand to :func:`diagnose_window` at its end."""
+    sig = {}
+    for term, fams in _DIAG_FAMILIES.items():
+        sig[term] = sum(_cum_seconds(f) for f in fams)
+    try:
+        sig["data_wait"] = float(benchmark().reader.total_time)
+    except Exception:
+        sig["data_wait"] = 0.0
+    return sig
+
+
+def diagnose_window(begin: dict, wall_s: float, steps: int = 0,
+                    step: Optional[int] = None, emit: bool = True) -> dict:
+    """Decompose the window since ``begin`` (a :func:`diag_signals`
+    snapshot) and name the dominant cost term.
+
+    Terms are independent cumulative clocks, so they can overlap (device
+    compute under async dispatch runs concurrently with host time) and a
+    term's share is reported against the wall, clipped to [0, 1] — this is
+    a ranking heuristic for "what should I look at first", not an exact
+    accounting. Whatever the terms don't cover is ``unattributed`` (python/
+    framework host time outside any instrumented clock). Emits one
+    ``step_diagnosis`` event naming the dominant term unless ``emit`` is
+    False."""
+    end = diag_signals()
+    terms = {t: max(0.0, end.get(t, 0.0) - begin.get(t, 0.0))
+             for t in ("data_wait",) + tuple(_DIAG_FAMILIES)}
+    accounted = sum(terms.values())
+    wall_s = max(0.0, float(wall_s))
+    terms["unattributed"] = max(0.0, wall_s - accounted)
+    dominant = max(terms, key=terms.get) if wall_s > 0 else "unknown"
+    dom_s = terms.get(dominant, 0.0)
+    rec = {
+        "wall_s": round(wall_s, 6),
+        "steps": int(steps),
+        "terms": {t: round(v, 6) for t, v in terms.items()},
+        "dominant": dominant,
+        "dominant_frac": (round(min(1.0, dom_s / wall_s), 4)
+                          if wall_s > 0 else None),
+    }
+    if step is not None:
+        rec["step"] = int(step)
+    global _last_diagnosis
+    _last_diagnosis = rec
+    if emit:
+        from . import events as events_mod
+        events_mod.emit("step_diagnosis", **rec)
+    return rec
 
 
 def validate_step_record(rec: dict) -> dict:
@@ -133,7 +249,8 @@ class ThroughputMonitor:
                  flops_per_step: Optional[float] = None,
                  samples_per_step: Optional[int] = None,
                  peak_flops: Optional[float] = None,
-                 emit: Optional[Callable[[dict], None]] = None):
+                 emit: Optional[Callable[[dict], None]] = None,
+                 diagnose: bool = True):
         self.window = max(int(window), 1)
         self.jsonl_path = jsonl_path
         self.flops_per_sample = flops_per_sample
@@ -141,6 +258,8 @@ class ThroughputMonitor:
         self.samples_per_step = samples_per_step
         self.peak_flops = peak_flops or _DEFAULT_PEAK_FLOPS
         self.records: List[dict] = []
+        self.diagnose = bool(diagnose)
+        self.diagnoses: List[dict] = []
         self._emit = emit
         self._file = None
         self.model = None
@@ -161,6 +280,7 @@ class ThroughputMonitor:
         self._win_samples = 0
         self._reader_t0 = 0.0
         self._retrace_t0 = 0
+        self._diag0 = None
 
     # -- hooks ---------------------------------------------------------------
     def on_train_begin(self, logs=None):
@@ -177,6 +297,8 @@ class ThroughputMonitor:
             self._win_t0 = time.perf_counter()
             self._reader_t0 = benchmark().reader.total_time
             self._retrace_t0 = get_watchdog().total_retraces()
+            if self.diagnose:
+                self._diag0 = diag_signals()
 
     def on_train_batch_end(self, step, logs=None):
         self._global_step += 1
@@ -222,6 +344,7 @@ class ThroughputMonitor:
         if flops is None and self.flops_per_sample and self._win_steps:
             flops = (self.flops_per_sample * self._win_samples
                      / self._win_steps) if self._win_samples else None
+        mem_bytes, mem_peak = _sampled_device_mem()
         rec = make_step_record(
             step=self._global_step,
             window_steps=self._win_steps,
@@ -231,9 +354,14 @@ class ThroughputMonitor:
                             - self._reader_t0),
             flops_per_step=flops,
             peak_flops=self.peak_flops,
-            retraces=get_watchdog().total_retraces() - self._retrace_t0)
+            retraces=get_watchdog().total_retraces() - self._retrace_t0,
+            device_mem_bytes=mem_bytes,
+            device_mem_peak_bytes=mem_peak)
         self.records.append(rec)
-        metrics_mod.update_device_memory_gauges()
+        if self.diagnose and self._diag0 is not None:
+            self.diagnoses.append(diagnose_window(
+                self._diag0, dt, steps=self._win_steps,
+                step=self._global_step))
         line = json.dumps(rec)
         if self._file is not None:
             self._file.write(line + "\n")

@@ -137,6 +137,7 @@ class Profiler:
         self.current_state = ProfilerState.CLOSED
         self._spans = []
         self._device_tracing = False
+        self.xplane_stats = None  # correlation stats of the last window
         from .timer import benchmark
         self._benchmark = benchmark()
 
@@ -203,6 +204,10 @@ class Profiler:
             try:
                 jax.profiler.start_trace(self.trace_dir)
                 self._device_tracing = True
+                # per-op dispatch annotates the trace while it records, so
+                # the correlation below can hand device time back per span
+                from . import xplane as _xplane
+                _xplane._ANNOTATING = True
             except Exception:
                 self._device_tracing = False
 
@@ -211,10 +216,23 @@ class Profiler:
         rec.enabled = False
         self._spans = rec.collect()
         if self._device_tracing:
+            from . import xplane as _xplane
+            _xplane._ANNOTATING = False
             try:
                 jax.profiler.stop_trace()
             finally:
                 self._device_tracing = False
+            # upgrade span device time from the trace just written
+            # (device_src="xplane" in summary/export); best-effort — the
+            # roofline estimates survive when the parse finds nothing
+            try:
+                path = _xplane.find_trace_file(self.trace_dir)
+                if path:
+                    doc = _xplane.load_trace(path)
+                    self.xplane_stats = _xplane.correlate(
+                        self._spans, doc.get("traceEvents", []))
+            except Exception:
+                self.xplane_stats = None
 
     # -- results ------------------------------------------------------------
     def export(self, path: str, format: str = "json"):

@@ -26,7 +26,8 @@ class HostSpan:
     parent: Optional[str] = None
     args: Optional[dict] = None   # op metadata: shapes/dtypes/bytes estimate
     device_ns: Optional[int] = None   # device-side execution time
-    device_src: Optional[str] = None  # "measured" | "estimate" (device_time.py)
+    device_src: Optional[str] = None  # "estimate" | "measured" (device_time)
+    #                                 # | "xplane" (xplane.correlate)
 
     @property
     def dur_ns(self) -> int:

@@ -15,6 +15,11 @@ surfaces over plain HTTP (http.server, zero deps):
                 step is older than PADDLE_TPU_HEALTH_STALL_SEC (default
                 300; "starting" before the first step)
     /events     recent unified-event-log entries (?kind=...&n=...)
+    /profile    on-demand deep profiling: ?steps=N arms a bounded capture
+                window around the next N train steps (jax.profiler trace +
+                host spans, correlated by profiler/xplane.py) and returns
+                the session summary; 409 while a session is in flight,
+                hard wall-clock cap PADDLE_TPU_PROFILE_TIMEOUT
 
 Opt-in: set `PADDLE_TPU_METRICS_PORT` (0 = pick a free port) and the entry
 points auto-start it — `Model.fit`, `bench.py`, and `tools/elastic_run.py`
@@ -42,6 +47,7 @@ from urllib.parse import parse_qs, urlparse
 from . import compile_watch as _compile_watch
 from . import events as _events_mod
 from . import metrics as _metrics_mod
+from . import xplane as _xplane_mod
 from .watchdog import get_watchdog
 
 __all__ = ["ObservabilityServer", "maybe_start_server", "note_step",
@@ -77,6 +83,9 @@ def note_step(step: int):
     rep = _reporter
     if rep is not None:
         rep.note_step(step)
+    # drive any armed /profile capture window (cheap no-op while idle;
+    # on_step itself never raises)
+    _xplane_mod.default_capture().on_step(step)
 
 
 def liveness(stall_after: Optional[float] = None) -> dict:
@@ -126,6 +135,9 @@ class ObservabilityServer:
 
     def snapshot(self) -> dict:
         self._collect_fleet()
+        # refresh the device-memory gauges so the snapshot's watermark is
+        # scrape-time, not last-step-record time
+        _metrics_mod.update_device_memory_gauges(self.registry)
         snap = {
             "metrics": self.registry.snapshot(),
             "watchdog": get_watchdog().snapshot(),
@@ -137,6 +149,51 @@ class ObservabilityServer:
         if self.aggregator is not None:
             snap["fleet"] = self.aggregator.snapshot()
         return snap
+
+    def profile(self, query: dict) -> (int, dict):
+        """The `/profile` endpoint body: (http status, payload).
+
+        `?steps=N` arms an on-demand capture around the next N train steps
+        and (by default) blocks until it finalizes — one curl profiles a
+        live job with zero restarts. Exactly one session at a time
+        (concurrent requests get 409); the hard wall-clock cap
+        (`PADDLE_TPU_PROFILE_TIMEOUT`, `&timeout=S` to shrink it) bounds
+        the block even when the job is stalled. `&wait=0` returns the
+        armed ack immediately; without `steps` the current/last session
+        status is returned."""
+        cap = _xplane_mod.default_capture()
+        raw_steps = query.get("steps", [None])[0]
+        if raw_steps is None:
+            return 200, cap.status()
+        try:
+            steps = int(raw_steps)
+            if steps < 1:
+                raise ValueError
+        except ValueError:
+            return 400, {"error": f"steps={raw_steps!r} must be a "
+                                  f"positive integer"}
+        timeout_s = None
+        raw_timeout = query.get("timeout", [None])[0]
+        if raw_timeout is not None:
+            try:
+                timeout_s = float(raw_timeout)
+            except ValueError:
+                return 400, {"error": f"timeout={raw_timeout!r} must be "
+                                      f"a number of seconds"}
+        wait = query.get("wait", ["1"])[0] not in ("0", "false", "no")
+        try:
+            ack = cap.arm(steps, timeout_s=timeout_s)
+        except _xplane_mod.CaptureBusyError as e:
+            return 409, {"error": str(e), "status": cap.status()}
+        if not wait:
+            return 202, ack
+        # the timer finalizes at the cap no matter what, so this bound is
+        # a backstop against a wedged finalize, not the real limit
+        summary = cap.wait((timeout_s or _xplane_mod.capture_timeout()) + 30)
+        if summary is None:
+            return 504, {"error": "capture did not finalize in time",
+                         "status": cap.status()}
+        return 200, summary
 
     def healthz(self) -> dict:
         h = liveness(self.stall_after)
@@ -192,16 +249,27 @@ class ObservabilityServer:
                                    json.dumps(h), "application/json")
                     elif url.path == "/events":
                         q = parse_qs(url.query)
-                        n = int(q.get("n", ["100"])[0])
+                        try:
+                            n = int(q.get("n", ["100"])[0])
+                        except ValueError:
+                            self._send(400, json.dumps(
+                                {"error": f"n={q.get('n')[0]!r} must be "
+                                          f"an integer"}),
+                                "application/json")
+                            return
                         kind = q.get("kind", [None])[0]
                         self._send(200, json.dumps(
                             {"events": _events_mod.recent(n, kind=kind)}),
                             "application/json")
+                    elif url.path == "/profile":
+                        code, payload = srv.profile(parse_qs(url.query))
+                        self._send(code, json.dumps(payload),
+                                   "application/json")
                     else:
                         self._send(404, json.dumps(
                             {"error": "unknown path", "endpoints":
                              ["/metrics", "/snapshot", "/healthz",
-                              "/events"]}), "application/json")
+                              "/events", "/profile"]}), "application/json")
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # a handler bug must not kill a scrape

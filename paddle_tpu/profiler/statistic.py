@@ -41,8 +41,11 @@ class _Item:
         self.min_ns = d if self.min_ns is None else min(self.min_ns, d)
         if span.device_ns is not None:
             self.device_ns += span.device_ns
-            # one measured span upgrades the row's provenance label
-            if self.device_src != "measured":
+            # the best span upgrades the row's provenance label
+            # (estimate < measured < xplane — device_time.SRC_PRIORITY)
+            from .device_time import SRC_PRIORITY
+            if SRC_PRIORITY.get(span.device_src, 0) \
+                    > SRC_PRIORITY.get(self.device_src, -1):
                 self.device_src = span.device_src
 
     @property

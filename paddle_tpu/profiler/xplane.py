@@ -1,0 +1,622 @@
+"""Measured per-op device time from the jax.profiler (XPlane) trace.
+
+PR 6's device-time split attributes device time per op by roofline
+ESTIMATE (or wall-until-completion under ``PADDLE_TPU_DEVICE_TIME=sync``,
+which serializes dispatch). This module adds the third, authoritative
+mode: run a bounded ``jax.profiler`` capture session, parse the trace it
+emits, and correlate backend execution events back to the host op spans —
+``HostSpan.device_ns`` gains ``device_src="xplane"``.
+
+Format choice: ``jax.profiler.stop_trace`` writes both the XPlane proto
+and a TensorBoard chrome export (``*.trace.json.gz``) into
+``<dir>/plugins/profile/<ts>/``. We parse the chrome export — stdlib
+``gzip`` + ``json``, no tensorboard/tensorflow dependency, and its event
+model (complete events with ``pid``/``tid``/``ts``/``dur`` microseconds)
+is stable across jax versions.
+
+Correlation model:
+
+* Host lanes carry ``TraceMe`` annotations — the names ``RecordEvent``
+  already emits (`profiler/utils.py`) plus, while a capture session is
+  active, one annotation per eager op dispatch (`ops/_dispatch` checks
+  :func:`annotating`). The k-th trace annotation named N is matched to the
+  k-th collected host span named N (aligned from the newest — spans
+  recorded before the trace started have no annotation).
+* Work lanes carry backend execution events: on TPU the ``/device:TPU:n``
+  process planes, on the CPU backend the thunk-executor threads (HLO op
+  names like ``dot.3`` / ``broadcast_divide_fusion``). Infra markers
+  (``Foo::Bar`` C++ methods, ``$``-prefixed python tracer frames) are
+  filtered out.
+* A span's measured device time is the summed overlap of work events with
+  its annotation window (plus any work event whose args name the
+  annotation — the XLA-metadata path on real TPU). Work can run on several
+  executor lanes at once, so the sum is lane-time, not wall time; and
+  async dispatch can slide work a little past its window — this is a
+  measurement-based attribution, not a cycle-exact one. CPU CI exercises
+  the full capture/parse/correlate path because jax's profiler records
+  host TraceMe AND CPU-backend thunk execution.
+
+On-demand capture: :class:`ProfileCapture` arms a bounded window around
+the next N observed train steps (`server.note_step` drives it), with a
+hard wall-clock cap so a stalled job cannot trace forever — the
+``/profile?steps=N`` endpoint on the ObservabilityServer fronts it.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from . import device_time as _device_time
+from . import events as _events_mod
+from . import metrics as _metrics_mod
+from .recorder import get_recorder, now_ns
+
+__all__ = [
+    "CaptureBusyError", "CaptureSession", "ProfileCapture",
+    "default_capture", "annotating", "find_trace_file", "load_trace",
+    "classify_lanes", "work_events", "correlate",
+]
+
+#: default hard wall-clock cap (seconds) on one capture session
+DEFAULT_CAPTURE_TIMEOUT = 120.0
+
+_REG = _metrics_mod.default_registry()
+_M_CAPTURES = _REG.counter(
+    "profile_captures_total",
+    "on-demand profiler capture sessions by terminal status "
+    "(complete / timeout / error)")
+
+# True while a CaptureSession is recording: ops/_dispatch wraps each eager
+# op in a TraceAnnotation so its name lands in the trace for correlation
+_ANNOTATING = False
+
+
+def annotating() -> bool:
+    """Cheap flag for the dispatch hot path: wrap ops in TraceAnnotation?"""
+    return _ANNOTATING
+
+
+class CaptureBusyError(RuntimeError):
+    """A capture session is already armed/recording (one at a time), or
+    the host recorder is owned by an active Profiler window."""
+
+
+# ---------------------------------------------------------------------------
+# trace parsing
+# ---------------------------------------------------------------------------
+def find_trace_file(session_dir: str) -> Optional[str]:
+    """Newest ``*.trace.json.gz`` under the jax session layout
+    (``<dir>/plugins/profile/<ts>/``); also accepts a flat dir of traces."""
+    pats = (os.path.join(session_dir, "plugins", "profile", "*",
+                         "*.trace.json.gz"),
+            os.path.join(session_dir, "*.trace.json.gz"))
+    hits: List[str] = []
+    for pat in pats:
+        hits.extend(glob.glob(pat))
+    return max(hits, key=os.path.getmtime) if hits else None
+
+
+def load_trace(path: str) -> dict:
+    """A chrome-trace dict from ``.trace.json.gz`` / plain ``.json``."""
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    with open(path) as f:
+        return json.load(f)
+
+
+def _lane_meta(events: Sequence[dict]):
+    """(process_names {pid: name}, thread_names {(pid, tid): name})."""
+    procs: Dict[object, str] = {}
+    threads: Dict[Tuple[object, object], str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        args = e.get("args") or {}
+        if e.get("name") == "process_name":
+            procs[e.get("pid")] = str(args.get("name", ""))
+        elif e.get("name") == "thread_name":
+            threads[(e.get("pid"), e.get("tid"))] = str(args.get("name", ""))
+    return procs, threads
+
+
+def _is_infra(name: str) -> bool:
+    """C++ infra markers and python-tracer frames — never op work."""
+    return "::" in name or name.startswith("$")
+
+
+def classify_lanes(events: Sequence[dict],
+                   span_names: Sequence[str] = ()):
+    """Split the trace's (pid, tid) lanes into host vs work.
+
+    Host lanes: python threads carrying TraceMe annotations (named thread
+    "python", ``$``-frame events, or one of the span names we are
+    correlating). Work lanes: every lane of a ``/device:*`` process plus
+    any remaining lane with at least one non-infra event (the CPU
+    backend's executor threads). Returns (host_lanes, work_lanes) as sets
+    of (pid, tid)."""
+    procs, threads = _lane_meta(events)
+    device_pids = {pid for pid, name in procs.items() if "/device:" in name}
+    names = set(span_names)
+    host: set = set()
+    work: set = set()
+    lane_events: Dict[Tuple[object, object], List[dict]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        lane_events.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    for lane, evs in lane_events.items():
+        pid = lane[0]
+        if pid in device_pids:
+            work.add(lane)
+            continue
+        tname = threads.get(lane, "")
+        if tname.startswith("python") \
+                or any(e.get("name", "").startswith("$") for e in evs) \
+                or (names and any(e.get("name") in names for e in evs)):
+            host.add(lane)
+        elif any(not _is_infra(e.get("name", "")) for e in evs):
+            work.add(lane)
+    return host, work
+
+
+def work_events(events: Sequence[dict],
+                span_names: Sequence[str] = (),
+                lanes=None) -> List[dict]:
+    """Backend execution events (work lanes, infra filtered), ts-sorted.
+    `lanes` accepts a precomputed `classify_lanes` result so a caller that
+    already classified does not pay a second full trace pass."""
+    _, work = lanes if lanes is not None \
+        else classify_lanes(events, span_names)
+    names = set(span_names)
+    out = [e for e in events
+           if e.get("ph") == "X"
+           and (e.get("pid"), e.get("tid")) in work
+           and not _is_infra(e.get("name", ""))
+           and e.get("name") not in names]
+    out.sort(key=lambda e: e.get("ts", 0.0))
+    return out
+
+
+def _args_name_match(e: dict, names: set) -> Optional[str]:
+    """A work event whose args carry one of our annotation names (XLA
+    op-metadata propagation on real TPU); returns the matched name."""
+    args = e.get("args")
+    if not isinstance(args, dict):
+        return None
+    for v in args.values():
+        if isinstance(v, str) and v in names:
+            return v
+    return None
+
+
+def correlate(spans, events: Sequence[dict]) -> dict:
+    """Attribute measured device time from a parsed trace onto host spans.
+
+    Mutates matched spans in place: ``device_ns`` becomes the measured
+    lane-time, ``device_src`` becomes ``"xplane"``. Unmatched spans keep
+    their estimate. Returns correlation stats including a per-op
+    measured-vs-estimate table (``by_op``)."""
+    span_list = list(spans)
+    names = {s.name for s in span_list}
+    lanes = classify_lanes(events, names)
+    host, _ = lanes
+    anns: Dict[str, List[dict]] = {}
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") in names \
+                and (e.get("pid"), e.get("tid")) in host:
+            anns.setdefault(e["name"], []).append(e)
+    for lst in anns.values():
+        lst.sort(key=lambda e: e.get("ts", 0.0))
+    works = work_events(events, names, lanes=lanes)
+
+    # args-matched work (TPU metadata path): nearest annotation of the name
+    arg_matched: Dict[int, List[dict]] = {}  # id(ann_event) -> [work events]
+    plain_work: List[Tuple[float, float, dict]] = []  # (ts, end, event)
+    for w in works:
+        m = _args_name_match(w, names)
+        cands = anns.get(m) if m else None
+        if cands:
+            nearest = min(cands, key=lambda a: abs(a.get("ts", 0.0)
+                                                   - w.get("ts", 0.0)))
+            arg_matched.setdefault(id(nearest), []).append(w)
+        else:
+            ts = w.get("ts", 0.0)
+            plain_work.append((ts, ts + float(w.get("dur", 0.0)), w))
+
+    # (window start, window end, annotation, span) pairs, aligned from the
+    # newest per name: spans recorded before the trace started have no
+    # annotation, extra annotations have no span
+    by_name: Dict[str, List] = {}
+    for s in span_list:
+        by_name.setdefault(s.name, []).append(s)
+    pairs: List[tuple] = []
+    for name, sps in by_name.items():
+        sps.sort(key=lambda s: s.start_ns)
+        evs = anns.get(name, [])
+        k = min(len(sps), len(evs))
+        for s, a in zip(sps[-k:], evs[-k:]):
+            w0 = a.get("ts", 0.0)
+            pairs.append((w0, w0 + float(a.get("dur", 0.0)), a, s))
+    # one forward cursor over the ts-sorted work events: windows processed
+    # in start order, and an event that ended before window start can
+    # never overlap a later window — near-linear instead of quadratic
+    pairs.sort(key=lambda p: p[0])
+    correlated = 0
+    by_op: Dict[str, dict] = {}
+    lo = 0
+    for w0, w1, a, s in pairs:
+        while lo < len(plain_work) and plain_work[lo][1] <= w0:
+            lo += 1
+        dev_us = 0.0
+        i = lo
+        while i < len(plain_work) and plain_work[i][0] < w1:
+            ov = min(plain_work[i][1], w1) - max(plain_work[i][0], w0)
+            if ov > 0:
+                dev_us += ov
+            i += 1
+        for w in arg_matched.get(id(a), ()):
+            dev_us += float(w.get("dur", 0.0))
+        if dev_us <= 0:
+            continue
+        name = s.name
+        row = by_op.setdefault(name, {"op": name, "calls": 0,
+                                      "est_ms": 0.0, "xplane_ms": 0.0})
+        row["calls"] += 1
+        if s.device_src == "estimate" and s.device_ns:
+            row["est_ms"] += s.device_ns / 1e6
+        row["xplane_ms"] += dev_us / 1e3
+        s.device_ns = int(dev_us * 1e3)
+        s.device_src = "xplane"
+        correlated += 1
+    for row in by_op.values():
+        row["est_ms"] = round(row["est_ms"], 4)
+        row["xplane_ms"] = round(row["xplane_ms"], 4)
+        row["xplane_vs_est"] = (round(row["xplane_ms"] / row["est_ms"], 3)
+                                if row["est_ms"] > 0 else None)
+    return {
+        "spans": len(span_list),
+        "correlated": correlated,
+        "annotations": sum(len(v) for v in anns.values()),
+        "work_events": len(works),
+        "by_op": sorted(by_op.values(), key=lambda r: -r["xplane_ms"]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# capture session
+# ---------------------------------------------------------------------------
+class CaptureSession:
+    """One jax.profiler trace window over the host recorder.
+
+    ``start()`` clears and enables the recorder, starts the device trace,
+    and flips :func:`annotating` so every eager op dispatch annotates the
+    trace; ``stop()`` reverses all of it, parses the emitted trace,
+    correlates spans, and returns (and writes) the summary. The recorder
+    must be idle — an active Profiler RECORD window owns it
+    (:class:`CaptureBusyError`)."""
+
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        self.started = False
+        self.spans: list = []
+        self._t0_ns = 0
+        self._diag0: Optional[dict] = None
+
+    def start(self):
+        global _ANNOTATING
+        rec = get_recorder()
+        if rec.enabled:
+            raise CaptureBusyError(
+                "host recorder is already recording (Profiler window or "
+                "another capture active)")
+        os.makedirs(self.session_dir, exist_ok=True)
+        jax.profiler.start_trace(self.session_dir)
+        rec.clear()
+        rec.enabled = True
+        _ANNOTATING = True
+        from . import monitor as _monitor
+        self._diag0 = _monitor.diag_signals()
+        self._t0_ns = now_ns()
+        self.started = True
+        return self
+
+    def stop(self, steps: Optional[int] = None,
+             status: str = "complete") -> dict:
+        global _ANNOTATING
+        rec = get_recorder()
+        _ANNOTATING = False
+        rec.enabled = False
+        wall_s = max(0.0, (now_ns() - self._t0_ns) / 1e9)
+        self.spans = rec.collect()
+        trace_error = None
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            trace_error = f"{type(e).__name__}: {e}"
+        self.started = False
+        summary = self._summarize(wall_s, steps, status, trace_error)
+        try:
+            with open(os.path.join(self.session_dir, "summary.json"),
+                      "w") as f:
+                json.dump(summary, f, indent=1)
+        except OSError:
+            pass
+        return summary
+
+    def _summarize(self, wall_s: float, steps: Optional[int], status: str,
+                   trace_error: Optional[str]) -> dict:
+        from .statistic import StatisticData, summary_report
+        summary = {
+            "status": status,
+            "ts": time.time(),
+            "session_dir": self.session_dir,
+            "wall_s": round(wall_s, 4),
+            "steps": steps,
+        }
+        if trace_error:
+            summary["trace_error"] = trace_error
+        trace_path = find_trace_file(self.session_dir)
+        summary["trace_path"] = trace_path
+        if trace_path:
+            try:
+                doc = load_trace(trace_path)
+                summary["correlation"] = correlate(
+                    self.spans, doc.get("traceEvents", []))
+            except Exception as e:
+                summary["parse_error"] = f"{type(e).__name__}: {e}"
+        summary["device_time"] = {
+            "rows": _device_time.split_rows(self.spans),
+            "mode": "xplane" if any(s.device_src == "xplane"
+                                    for s in self.spans) else "estimate",
+        }
+        try:
+            summary["summary_table"] = summary_report(
+                StatisticData(self.spans))
+        except Exception as e:
+            summary["table_error"] = f"{type(e).__name__}: {e}"
+        if self._diag0 is not None:
+            try:
+                from . import monitor as _monitor
+                summary["diagnosis"] = _monitor.diagnose_window(
+                    self._diag0, wall_s, steps=steps or 0)
+            except Exception as e:
+                summary["diagnosis_error"] = f"{type(e).__name__}: {e}"
+        return summary
+
+
+# ---------------------------------------------------------------------------
+# on-demand armed capture (the /profile backend)
+# ---------------------------------------------------------------------------
+def _default_session_root() -> str:
+    return os.environ.get(
+        "PADDLE_TPU_PROFILE_DIR",
+        os.path.join(tempfile.gettempdir(),
+                     f"paddle_tpu_profile_{os.getpid()}"))
+
+
+def capture_timeout() -> float:
+    """Hard wall-clock cap on one capture (PADDLE_TPU_PROFILE_TIMEOUT)."""
+    try:
+        return float(os.environ.get("PADDLE_TPU_PROFILE_TIMEOUT",
+                                    DEFAULT_CAPTURE_TIMEOUT))
+    except ValueError:
+        return DEFAULT_CAPTURE_TIMEOUT
+
+
+class ProfileCapture:
+    """Exactly-one-at-a-time capture armed around the next N train steps.
+
+    `arm(steps=N)` -> the next `note_step` starts the trace, the N-th
+    after that stops it and builds the summary. A `threading.Timer` at the
+    hard cap finalizes a window the step flow never closes (stalled job,
+    armed-but-idle loop) — a capture can never outlive the cap.
+
+    While recording, every inter-`note_step` interval is bracketed in a
+    ``train_step`` TraceAnnotation + host span (opened/closed on the
+    training thread, which is the thread calling note_step): a loop whose
+    whole step is ONE compiled executable emits no per-op eager spans, so
+    without this a capture of the production path would correlate
+    nothing — with it, the summary carries measured per-STEP device
+    lane-time next to whatever per-op spans eager dispatch contributed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "idle"  # idle | armed | recording
+        self._session: Optional[CaptureSession] = None
+        self._steps = 0
+        self._start_step: Optional[int] = None
+        self._end_step: Optional[int] = None
+        self._timer: Optional[threading.Timer] = None
+        self._done = threading.Event()
+        self._done.set()
+        self._seq = 0
+        self._step_ann = None      # open TraceAnnotation of the current step
+        self._step_t0: Optional[int] = None
+        self.last_summary: Optional[dict] = None
+
+    def arm(self, steps: int, session_dir: Optional[str] = None,
+            timeout_s: Optional[float] = None) -> dict:
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        cap = float(timeout_s) if timeout_s else capture_timeout()
+        with self._lock:
+            if self.state != "idle":
+                raise CaptureBusyError(
+                    f"capture already {self.state} "
+                    f"(one session at a time)")
+            if get_recorder().enabled:
+                raise CaptureBusyError(
+                    "host recorder is busy (Profiler window active)")
+            self._seq += 1
+            if session_dir is None:
+                session_dir = os.path.join(
+                    _default_session_root(),
+                    f"session_{self._seq}_{int(time.time())}")
+            self._session = CaptureSession(session_dir)
+            self._steps = steps
+            self._start_step = self._end_step = None
+            self.state = "armed"
+            self.last_summary = None
+            self._done.clear()
+            self._timer = threading.Timer(cap, self._on_timeout)
+            self._timer.daemon = True
+            self._timer.start()
+            return {"status": "armed", "steps": steps,
+                    "session_dir": session_dir, "timeout_s": cap}
+
+    def on_step(self, step: int):
+        """Drive the armed window; cheap no-op while idle. Never raises."""
+        if self.state == "idle":
+            return
+        try:
+            with self._lock:
+                if self.state == "armed":
+                    self._session.start()
+                    self._start_step = int(step)
+                    self._end_step = int(step) + self._steps
+                    self.state = "recording"
+                    self._open_step_span()
+                elif self.state == "recording":
+                    self._close_step_span(push=True)
+                    if int(step) >= self._end_step:
+                        self._finalize_locked("complete")
+                    else:
+                        self._open_step_span()
+        except CaptureBusyError as e:
+            with self._lock:
+                if self.state == "armed":
+                    self._abort_locked(f"{e}")
+        except Exception as e:  # capture must never take down training
+            with self._lock:
+                if self.state != "idle":
+                    self._abort_locked(f"{type(e).__name__}: {e}")
+
+    def _open_step_span(self):
+        """Open the next inter-step annotation (training thread)."""
+        self._step_t0 = now_ns()
+        try:
+            self._step_ann = jax.profiler.TraceAnnotation("train_step")
+            self._step_ann.__enter__()
+        except Exception:
+            self._step_ann = None
+
+    def _close_step_span(self, push: bool):
+        """Close the open step annotation; `push` records it as a
+        ``train_step`` host span (skipped on timer-thread finalize, where
+        no full step completed and exiting another thread's TraceMe is
+        best-effort)."""
+        ann, self._step_ann = self._step_ann, None
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+        if push and self._step_t0 is not None:
+            from .recorder import HostSpan
+            rec = get_recorder()
+            if rec.enabled:
+                rec.push(HostSpan(
+                    name="train_step", start_ns=self._step_t0,
+                    end_ns=now_ns(), tid=threading.get_ident(),
+                    event_type="ProfileStep"))
+        self._step_t0 = None
+
+    def _on_timeout(self):
+        with self._lock:
+            if self.state == "recording":
+                self._close_step_span(push=False)
+                self._finalize_locked("timeout")
+            elif self.state == "armed":
+                self._abort_locked("timed out before any step was observed",
+                                   status="timeout")
+
+    def _finalize_locked(self, status: str):
+        self._close_step_span(push=False)  # no-op when already closed
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        steps_seen = 0
+        if self._start_step is not None:
+            steps_seen = min(self._steps,
+                             max(0, self._end_step - self._start_step))
+            if status == "timeout":
+                steps_seen = 0  # unknown; the summary's wall_s is the truth
+        try:
+            summary = self._session.stop(
+                steps=self._steps if status == "complete" else steps_seen,
+                status=status)
+        except Exception as e:
+            summary = {"status": "error", "ts": time.time(),
+                       "error": f"{type(e).__name__}: {e}",
+                       "session_dir": self._session.session_dir}
+            status = "error"
+        self.last_summary = summary
+        self.state = "idle"
+        if _metrics_mod.enabled():
+            _M_CAPTURES.inc(status=summary.get("status", status))
+        _events_mod.emit(
+            "profile_capture",
+            severity="info" if status == "complete" else "warn",
+            status=summary.get("status", status),
+            session_dir=self._session.session_dir,
+            correlated=(summary.get("correlation") or {}).get("correlated"))
+        self._done.set()
+
+    def _abort_locked(self, reason: str, status: str = "error"):
+        self._close_step_span(push=False)
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        sess = self._session
+        if sess is not None and sess.started:
+            try:
+                sess.stop(status=status)
+            except Exception:
+                pass
+        self.last_summary = {"status": status, "ts": time.time(),
+                             "error": reason,
+                             "session_dir": sess.session_dir if sess
+                             else None}
+        self.state = "idle"
+        if _metrics_mod.enabled():
+            _M_CAPTURES.inc(status=status)
+        _events_mod.emit("profile_capture", severity="warn", status=status,
+                         error=reason)
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[dict]:
+        """Block until the current capture finalizes; returns its summary
+        (None if still in flight at `timeout`)."""
+        if not self._done.wait(timeout):
+            return None
+        return self.last_summary
+
+    def status(self) -> dict:
+        with self._lock:
+            st = {"state": self.state}
+            if self.state != "idle" and self._session is not None:
+                st["session_dir"] = self._session.session_dir
+                st["steps"] = self._steps
+                if self._end_step is not None:
+                    st["end_step"] = self._end_step
+            if self.last_summary is not None:
+                st["last"] = self.last_summary
+            return st
+
+
+_default_capture = ProfileCapture()
+
+
+def default_capture() -> ProfileCapture:
+    """The process-wide armed-capture manager (`/profile`'s backend)."""
+    return _default_capture

@@ -68,6 +68,18 @@ class TestReporter:
         assert "heter" in d and "barrier_wait_s" in d
         assert d["host"]
 
+    def test_digest_carries_last_diagnosis_dominant(self):
+        """Deep-profiling PR: each host's digest names its newest
+        step_diagnosis dominant term so the fleet aggregator can show
+        every host's bottleneck."""
+        from paddle_tpu.profiler.monitor import diag_signals, diagnose_window
+        store = FakeStore()
+        rep = FleetReporter(store, rank=2, window=8, min_interval_s=0)
+        diagnose_window(diag_signals(), wall_s=0.1, steps=1, emit=False)
+        _feed(rep, [0.01, 0.02])
+        d = json.loads(store.get(DIGEST_KEY_FMT.format(rank=2)).decode())
+        assert d["diag_dominant"] == "unattributed"
+
     def test_measured_walls_from_consecutive_notes(self):
         store = FakeStore()
         rep = FleetReporter(store, rank=0, window=8, min_interval_s=0)

@@ -233,6 +233,13 @@ class TestMetricNamingLint:
         wd.observe("eager", "lint_op", [np.zeros((2,), np.float32)])
         compile_watch._on_duration(
             "/jax/core/compile/backend_compile_duration", 0.01)
+        # deep-profiling PR families: device-memory gauges (device=),
+        # capture counter (status=), collective timing (kind=)
+        metrics.sample_device_memory()
+        from paddle_tpu.profiler import xplane as _xplane
+        _xplane._M_CAPTURES.inc(status="complete")
+        from paddle_tpu.distributed import collective as _coll
+        _coll._M_COLL_SECONDS.observe(0.001, kind="all_reduce")
         reg = metrics.default_registry()
         problems = []
         for name in reg.names():

@@ -89,6 +89,29 @@ class TestEndpoints:
         evs = json.loads(body)["events"]
         assert len(evs) == 1 and evs[0]["name"] == "srvtest_a"
 
+    def test_events_kind_and_n_combined(self, srv):
+        """Satellite: direct coverage of the ?kind=&n= filter path — the
+        kind filter applies BEFORE the n-truncation, n keeps the newest,
+        and an unknown kind is an empty list, not an error."""
+        events.default_event_log().clear()
+        for i in range(6):
+            events.emit("retrace", seq=i)
+            events.emit("xla_compile", seq=i)
+        status, body, _ = _get(srv.port, "/events?kind=retrace&n=3")
+        assert status == 200
+        evs = json.loads(body)["events"]
+        assert [e["seq"] for e in evs] == [3, 4, 5]
+        assert all(e["kind"] == "retrace" for e in evs)
+        status, body, _ = _get(srv.port, "/events?n=4")
+        assert len(json.loads(body)["events"]) == 4
+        status, body, _ = _get(srv.port, "/events?kind=no_such_kind")
+        assert status == 200 and json.loads(body)["events"] == []
+
+    def test_events_garbled_n_is_400(self, srv):
+        status, body, _ = _get(srv.port, "/events?n=lots")
+        assert status == 400
+        assert "n=" in json.loads(body)["error"]
+
     def test_unknown_path_is_404_with_directory(self, srv):
         status, body, _ = _get(srv.port, "/nope")
         assert status == 404
@@ -320,6 +343,86 @@ class TestMetricsDumpLive:
         assert series and series[0]["count"] >= 1
         assert metrics_dump.hist_quantile(series[0]["buckets"], 0.5) \
             is not None
+
+
+class TestProfileEndpoint:
+    """/profile?steps=N against a live loop: the acceptance path for the
+    deep-profiling PR (remote zero-restart capture, 409 on concurrency,
+    bounded by the hard wall-clock cap)."""
+
+    @pytest.fixture()
+    def train_loop(self):
+        """A background loop dispatching real eager ops and noting steps —
+        the 'running job' the endpoint profiles."""
+        stop = threading.Event()
+
+        def loop():
+            a = paddle.to_tensor(np.ones((64, 64), np.float32))
+            step = 0
+            while not stop.is_set():
+                step += 1
+                paddle.nn.functional.softmax(paddle.matmul(a, a))
+                server_mod.note_step(step)
+                time.sleep(0.01)
+
+        th = threading.Thread(target=loop, daemon=True)
+        th.start()
+        yield
+        stop.set()
+        th.join(10)
+
+    def test_capture_against_running_loop(self, srv, train_loop,
+                                          tmp_path, monkeypatch):
+        """ISSUE acceptance: /profile?steps=2 on a running loop correlates
+        >= 1 op span to device_src="xplane", the summary table shows the
+        measured Dev(ms) column, and a step_diagnosis event names a
+        dominant term."""
+        monkeypatch.setenv("PADDLE_TPU_PROFILE_DIR", str(tmp_path))
+        events.default_event_log().clear()
+        status, body, _ = _get(srv.port, "/profile?steps=2", timeout=90)
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["status"] == "complete"
+        assert doc["correlation"]["correlated"] >= 1, doc["correlation"]
+        assert any(r["src"] == "xplane"
+                   for r in doc["device_time"]["rows"])
+        assert "Dev(ms)" in doc["summary_table"]
+        assert "xplane" in doc["summary_table"]
+        assert doc["diagnosis"]["dominant"]
+        assert os.path.isdir(doc["session_dir"])
+        assert doc["session_dir"].startswith(str(tmp_path))
+        diags = events.recent(50, kind="step_diagnosis")
+        assert diags and diags[-1]["dominant"]
+        caps = events.recent(50, kind="profile_capture")
+        assert caps and caps[-1]["status"] == "complete"
+
+    def test_concurrent_capture_is_409(self, srv, train_loop, tmp_path,
+                                       monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_PROFILE_DIR", str(tmp_path))
+        from paddle_tpu.profiler import xplane
+        status, body, _ = _get(srv.port, "/profile?steps=200&wait=0")
+        assert status == 202
+        try:
+            status2, body2, _ = _get(srv.port, "/profile?steps=2")
+            assert status2 == 409
+            assert "one session at a time" in json.loads(body2)["error"]
+        finally:
+            # force-finalize the long window so later tests see idle
+            cap = xplane.default_capture()
+            with cap._lock:
+                if cap.state != "idle":
+                    cap._finalize_locked("timeout")
+            cap.wait(30)
+
+    def test_profile_without_steps_reports_status(self, srv):
+        status, body, _ = _get(srv.port, "/profile")
+        assert status == 200
+        assert json.loads(body)["state"] in ("idle", "armed", "recording")
+
+    def test_profile_bad_params_are_400(self, srv):
+        for q in ("steps=zero", "steps=-1", "steps=2&timeout=soon"):
+            status, body, _ = _get(srv.port, f"/profile?{q}")
+            assert status == 400, q
 
 
 class TestMaybeStartServer:

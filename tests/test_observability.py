@@ -483,3 +483,113 @@ class TestThroughputMonitor:
         bad3 = dict(good, data_wait_frac=1.5)
         with pytest.raises(ValueError, match="data_wait_frac"):
             validate_step_record(bad3)
+
+    def test_step_records_sample_device_memory(self):
+        """Per-step device-memory watermarks land in the step record (the
+        CPU backend has no memory_stats, so the live-arrays fallback
+        feeds them — live tensors exist, so the sample is > 0)."""
+        _keepalive = paddle.to_tensor(np.ones((64, 64), np.float32))
+        mon = ThroughputMonitor(window=1)
+        mon.on_train_begin()
+        mon.on_train_batch_begin(0)
+        mon.on_train_batch_end(0)
+        mon.on_train_end()
+        rec = mon.records[-1]
+        validate_step_record(rec)
+        assert rec["device_mem_bytes"] and rec["device_mem_bytes"] > 0
+        assert rec["device_mem_peak_bytes"] >= rec["device_mem_bytes"]
+
+
+class TestStepDiagnosis:
+    """diagnose_window decomposes a window's wall into the registry's cost
+    terms, names the dominant one, and emits a step_diagnosis event."""
+
+    def test_dominant_term_from_registry_deltas(self):
+        from paddle_tpu.profiler import events as events_mod
+        from paddle_tpu.profiler.metrics import default_registry
+        from paddle_tpu.profiler.monitor import diag_signals, diagnose_window
+        events_mod.default_event_log().clear()
+        begin = diag_signals()
+        # simulate a compile-bound window: 0.4s of xla_compile_seconds
+        default_registry().get("xla_compile_seconds").observe(
+            0.4, entry="diag_test", phase="backend_compile")
+        rec = diagnose_window(begin, wall_s=0.5, steps=4, step=40)
+        assert rec["dominant"] == "compile"
+        assert rec["terms"]["compile"] == pytest.approx(0.4)
+        assert rec["terms"]["unattributed"] == pytest.approx(0.1)
+        assert rec["dominant_frac"] == pytest.approx(0.8)
+        assert rec["steps"] == 4 and rec["step"] == 40
+        evs = events_mod.recent(10, kind="step_diagnosis")
+        assert evs and evs[-1]["dominant"] == "compile"
+        events_mod.validate_event(evs[-1])
+
+    def test_unattributed_dominates_idle_window(self):
+        from paddle_tpu.profiler.monitor import diag_signals, diagnose_window
+        rec = diagnose_window(diag_signals(), wall_s=0.2, steps=1,
+                              emit=False)
+        assert rec["dominant"] == "unattributed"
+
+    def test_collective_term_fed_by_guarded_collectives(self):
+        """The collective_seconds histogram (new in this PR) feeds the
+        'collective' diagnosis term for every guarded eager collective."""
+        from paddle_tpu.profiler.metrics import default_registry
+        from paddle_tpu.profiler.monitor import diag_signals
+        begin = diag_signals()
+        default_registry().histogram(
+            "collective_seconds", "eager collective wall time by "
+            "kind").observe(0.05, kind="all_reduce")
+        assert diag_signals()["collective"] - begin["collective"] \
+            == pytest.approx(0.05)
+
+    def test_monitor_emits_one_diagnosis_per_window(self):
+        from paddle_tpu.profiler import events as events_mod
+        events_mod.default_event_log().clear()
+        mon = ThroughputMonitor(window=2)
+        mon.on_train_begin()
+        for step in range(4):
+            mon.on_train_batch_begin(step)
+            mon.on_train_batch_end(step)
+        mon.on_train_end()
+        assert len(mon.diagnoses) == 2
+        assert len(events_mod.recent(20, kind="step_diagnosis")) == 2
+        assert all(d["dominant"] for d in mon.diagnoses)
+
+    def test_monitor_diagnose_opt_out(self):
+        from paddle_tpu.profiler import events as events_mod
+        events_mod.default_event_log().clear()
+        mon = ThroughputMonitor(window=1, diagnose=False)
+        mon.on_train_begin()
+        mon.on_train_batch_begin(0)
+        mon.on_train_batch_end(0)
+        mon.on_train_end()
+        assert not mon.diagnoses
+        assert not events_mod.recent(20, kind="step_diagnosis")
+
+
+class TestDeviceMemorySampling:
+    def test_sample_families_and_running_peak(self):
+        from paddle_tpu.profiler import metrics as metrics_mod
+        big = paddle.to_tensor(np.ones((256, 256), np.float32))
+        mem = metrics_mod.sample_device_memory()
+        assert mem, "no devices sampled"
+        dev, stats = next(iter(mem.items()))
+        assert stats["bytes_in_use"] > 0
+        assert stats["peak_bytes"] >= stats["bytes_in_use"]
+        assert stats["src"] in ("memory_stats", "live_arrays")
+        reg = metrics_mod.default_registry()
+        assert reg.get("device_memory_bytes_in_use").value(device=dev) \
+            == stats["bytes_in_use"]
+        peak_before = stats["peak_bytes"]
+        del big
+        mem2 = metrics_mod.sample_device_memory()
+        # the watermark never regresses even when usage drops
+        assert mem2[dev]["peak_bytes"] >= peak_before \
+            or mem2[dev]["src"] == "memory_stats"
+
+    def test_sample_honors_kill_switch(self):
+        from paddle_tpu.profiler import metrics as metrics_mod
+        metrics_mod.set_enabled(False)
+        try:
+            assert metrics_mod.sample_device_memory() == {}
+        finally:
+            metrics_mod.set_enabled(True)

@@ -288,3 +288,217 @@ class TestAsyncCheckpointMetricsGate:
             problems = gate.validate_observability(self._doc_with_metrics(m))
             assert any("checkpoint_async_pending" in p for p in problems), \
                 f"values={bad!r} did not produce a named violation"
+
+
+class TestXplaneLaneMerge:
+    """cross_stack_profiler --xplane_dir: each rank's backend work lanes
+    interleave under its host lane, clock-shifted to the shared zero."""
+
+    @staticmethod
+    def _xplane_doc():
+        return {"traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 9,
+             "args": {"name": "/host:CPU"}},
+            {"ph": "M", "name": "thread_name", "pid": 9, "tid": 1,
+             "args": {"name": "python"}},
+            {"ph": "X", "name": "$frame", "ts": 5000.0, "dur": 100.0,
+             "pid": 9, "tid": 1},
+            {"ph": "X", "name": "dot.3", "ts": 5010.0, "dur": 40.0,
+             "pid": 9, "tid": 2},
+            {"ph": "X", "name": "fusion.1", "ts": 5060.0, "dur": 20.0,
+             "pid": 9, "tid": 2},
+            {"ph": "X", "name": "ThreadpoolListener::StartRegion",
+             "ts": 5000.0, "dur": 500.0, "pid": 9, "tid": 2},
+        ]}
+
+    def test_device_lanes_interleave_under_rank(self, tmp_path):
+        host = {0: _trace([("train_step", 1000.0, 50.0)])}
+        merged = csp.merge_traces(
+            host, align=True, xplane={0: self._xplane_doc()["traceEvents"]})
+        evs = merged["traceEvents"]
+        work = [e for e in evs if e.get("ph") == "X"
+                and e["name"] in ("dot.3", "fusion.1")]
+        assert len(work) == 2
+        assert all(e["pid"] == 0 for e in work), "device lane not re-homed"
+        # clock shifted: first work event at 0, second keeps its offset
+        assert min(e["ts"] for e in work) == 0.0
+        assert max(e["ts"] for e in work) == pytest.approx(50.0)
+        # infra markers stay out; synthetic thread is labeled xplane:
+        assert not any(e.get("name", "").startswith("ThreadpoolListener")
+                       for e in evs)
+        tnames = [e["args"]["name"] for e in evs
+                  if e.get("ph") == "M" and e["name"] == "thread_name"]
+        assert any(t.startswith("xplane:") for t in tnames)
+        assert merged["metadata"]["xplane_ranks"] == [0]
+
+    def test_load_xplane_dir_files_and_session_dirs(self, tmp_path):
+        import gzip
+        d = tmp_path / "xp"
+        d.mkdir()
+        (d / "rank_0.trace.json.gz").write_bytes(
+            gzip.compress(json.dumps(self._xplane_doc()).encode()))
+        sess = d / "rank_1" / "plugins" / "profile" / "2026_01_01"
+        sess.mkdir(parents=True)
+        (sess / "host.trace.json.gz").write_bytes(
+            gzip.compress(json.dumps(self._xplane_doc()).encode()))
+        by_rank = csp.load_xplane_dir(str(d))
+        assert set(by_rank) == {0, 1}
+        assert any(e.get("name") == "dot.3" for e in by_rank[0])
+
+    def test_cli_with_xplane_dir(self, tmp_path):
+        td = tmp_path / "traces"
+        td.mkdir()
+        (td / "rank_0.json").write_text(json.dumps(
+            _trace([("step", 0, 100.0)])))
+        xd = tmp_path / "xp"
+        xd.mkdir()
+        (xd / "rank_0.json").write_text(json.dumps(self._xplane_doc()))
+        out = tmp_path / "merged.json"
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "cross_stack_profiler.py"),
+             "--trace_dir", str(td), "--out", str(out),
+             "--xplane_dir", str(xd)],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        doc = json.load(open(out))
+        assert any(e.get("name") == "dot.3" for e in doc["traceEvents"])
+        assert "1 xplane device traces" in r.stdout
+
+
+class TestObsTailDiagnoseAndFollow:
+    @staticmethod
+    def _diag_event(step=40, dominant="data_wait"):
+        return {"ts": 1722700000.0, "kind": "step_diagnosis",
+                "host": "trainer-0", "severity": "info", "wall_s": 2.0,
+                "steps": 20, "step": step, "dominant": dominant,
+                "dominant_frac": 0.55,
+                "terms": {"data_wait": 1.1, "host_dispatch": 0.3,
+                          "device_compute": 0.0, "unattributed": 0.6}}
+
+    def test_diagnose_renders_breakdown(self, tmp_path, capsys):
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(self._diag_event()) + "\n")
+            f.write(json.dumps({"ts": 1.0, "kind": "retrace",
+                                "host": "trainer-0"}) + "\n")
+        rc = obs_tail.main([str(path), "--diagnose"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "dominant=data_wait (55% of wall)" in out
+        assert "data_wait=1100.0ms" in out
+        assert "step 40" in out
+        assert "retrace" not in out  # --diagnose implies the kind filter
+
+    def test_diagnose_respects_explicit_kind(self, tmp_path, capsys):
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps({"ts": 1.0, "kind": "retrace",
+                                "host": "h"}) + "\n")
+        rc = obs_tail.main([str(path), "--diagnose", "--kind", "retrace"])
+        assert rc == 0
+        assert "retrace" in capsys.readouterr().out
+
+    def test_follow_for_is_time_bounded(self, tmp_path, capsys):
+        """Satellite: --follow gets direct (and bounded) coverage — events
+        appended while following are printed, and --follow-for returns."""
+        import threading as _threading
+        import time as _time
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps({"ts": 1.0, "kind": "retrace",
+                                "host": "h", "seq": 0}) + "\n")
+
+        def append_later():
+            _time.sleep(0.4)
+            with open(path, "a") as f:
+                f.write(json.dumps({"ts": 2.0, "kind": "retrace",
+                                    "host": "h", "seq": 1}) + "\n")
+
+        th = _threading.Thread(target=append_later)
+        th.start()
+        t0 = _time.monotonic()
+        rc = obs_tail.main([str(path), "--follow", "--follow-for", "1.2",
+                            "--json"])
+        took = _time.monotonic() - t0
+        th.join()
+        assert rc == 0
+        assert took < 5.0, "follow-for did not bound the tail"
+        lines = [json.loads(l) for l in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert [l["seq"] for l in lines] == [0, 1]
+
+
+class TestDeviceTimeAndMemoryGate:
+    """check_bench_result: device_time provenance (incl. the new
+    device_src="xplane") and device_memory_* family validation."""
+
+    @staticmethod
+    def _doc(dt=None, metrics=None):
+        obs = {}
+        if dt is not None:
+            obs["device_time"] = dt
+        if metrics is not None:
+            obs["metrics"] = metrics
+        return {"configs": {}, "observability": obs}
+
+    def test_xplane_src_and_mode_valid(self):
+        dt = {"mode": "xplane",
+              "rows": [{"op": "matmul", "calls": 3, "host_ms": 1.0,
+                        "device_ms": 0.5, "src": "xplane"},
+                       {"op": "softmax", "calls": 3, "host_ms": 1.0,
+                        "device_ms": 0.2, "src": "estimate"}]}
+        assert gate.validate_observability(self._doc(dt=dt)) == []
+
+    def test_unknown_src_and_mode_fail(self):
+        dt = {"mode": "vibes",
+              "rows": [{"op": "matmul", "calls": 1, "host_ms": 1.0,
+                        "device_ms": 0.5, "src": "guessed"}]}
+        problems = gate.validate_observability(self._doc(dt=dt))
+        assert any("mode" in p and "vibes" in p for p in problems)
+        assert any("src" in p and "guessed" in p for p in problems)
+
+    def test_malformed_rows_named(self):
+        dt = {"rows": [{"op": "", "calls": -1, "host_ms": "x",
+                        "device_ms": 0.1, "src": "estimate"}, "junk"]}
+        problems = gate.validate_observability(self._doc(dt=dt))
+        assert any(".op" in p for p in problems)
+        assert any(".calls" in p for p in problems)
+        assert any(".host_ms" in p for p in problems)
+        assert any("rows[1]" in p for p in problems)
+
+    def test_device_memory_families(self):
+        good = {"device_memory_bytes_in_use": {
+            "kind": "gauge", "help": "by device",
+            "values": [{"labels": {"device": "cpu:0"}, "value": 1024}]}}
+        assert gate.validate_observability(self._doc(metrics=good)) == []
+        bad = {"device_memory_peak_bytes": {
+            "kind": "counter", "help": "",
+            "values": [{"labels": {}, "value": -5}]}}
+        problems = gate.validate_observability(self._doc(metrics=bad))
+        assert any("expected gauge" in p for p in problems)
+        missing = {"device_memory_peak_bytes": {
+            "kind": "gauge", "help": "",
+            "values": [{"labels": {}, "value": -5}]}}
+        problems = gate.validate_observability(self._doc(metrics=missing))
+        assert any("non-negative" in p for p in problems)
+        assert any("'device' label" in p for p in problems)
+
+    def test_real_capture_summary_device_time_validates(self, tmp_path):
+        """A real CaptureSession summary's device_time block passes the
+        gate with src=xplane rows (the BENCH_r06 shape)."""
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.profiler import xplane
+        sess = xplane.CaptureSession(str(tmp_path / "gate"))
+        sess.start()
+        try:
+            a = paddle.to_tensor(np.ones((64, 64), np.float32))
+            paddle.matmul(a, a)
+        finally:
+            summary = sess.stop(steps=1)
+        assert gate.validate_observability(
+            self._doc(dt=summary["device_time"])) == []

@@ -177,12 +177,83 @@ def _validate_async_ckpt_metrics(where: str, metrics: dict) -> List[str]:
     return problems
 
 
+# legal provenance labels for device-time rows: roofline estimate, sync-mode
+# wall measurement, or xplane-trace correlation (profiler/xplane.py)
+_DEVICE_SRCS = ("estimate", "measured", "xplane")
+
+
+def _validate_device_time(where: str, dt: dict) -> List[str]:
+    """An `observability.device_time` block must be rows of per-op
+    host-vs-device aggregates whose `src` (and the block `mode`) is a
+    known provenance — a bench claiming measured attribution with a
+    garbled or unknown source label fails the gate."""
+    problems = []
+    if not isinstance(dt, dict):
+        return [f"{where}.device_time is not an object"]
+    mode = dt.get("mode")
+    if mode is not None and mode not in _DEVICE_SRCS:
+        problems.append(f"{where}.device_time.mode {mode!r} not in "
+                        f"{_DEVICE_SRCS}")
+    rows = dt.get("rows")
+    if rows is None:
+        return problems
+    if not isinstance(rows, list):
+        return problems + [f"{where}.device_time.rows is not a list"]
+    for i, r in enumerate(rows):
+        if not isinstance(r, dict):
+            problems.append(f"{where}.device_time.rows[{i}] is not an "
+                            f"object")
+            continue
+        if not isinstance(r.get("op"), str) or not r.get("op"):
+            problems.append(f"{where}.device_time.rows[{i}].op "
+                            f"{r.get('op')!r} is not a non-empty string")
+        for key in ("calls", "host_ms", "device_ms"):
+            v = r.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0:
+                problems.append(f"{where}.device_time.rows[{i}].{key} "
+                                f"{v!r} is not a non-negative number")
+        if r.get("src") not in _DEVICE_SRCS:
+            problems.append(f"{where}.device_time.rows[{i}].src "
+                            f"{r.get('src')!r} not in {_DEVICE_SRCS}")
+    return problems
+
+
+def _validate_device_memory_metrics(where: str, metrics: dict) -> List[str]:
+    """`device_memory_*` families must be gauges of non-negative values
+    whose series carry the `device` label."""
+    problems = []
+    for name, fam in metrics.items():
+        if not name.startswith("device_memory_"):
+            continue
+        if not isinstance(fam, dict) or fam.get("kind") != "gauge":
+            problems.append(f"{where}.metrics.{name}: kind "
+                            f"{fam.get('kind') if isinstance(fam, dict) else fam!r}"
+                            f", expected gauge")
+            continue
+        for i, v in enumerate(fam.get("values") or []):
+            if not isinstance(v, dict):
+                problems.append(f"{where}.metrics.{name}[{i}] is not a "
+                                f"series object")
+                continue
+            val = v.get("value")
+            if not isinstance(val, (int, float)) or val < 0:
+                problems.append(f"{where}.metrics.{name}[{i}]: value "
+                                f"{val!r} is not a non-negative number")
+            if "device" not in (v.get("labels") or {}):
+                problems.append(f"{where}.metrics.{name}[{i}]: series "
+                                f"missing the 'device' label")
+    return problems
+
+
 def validate_observability(doc: dict) -> List[str]:
     """Schema problems in the document's observability sections (empty =
     valid). step_records must conform to the step-record contract,
-    events/events_tail to the event contract, and any
-    `checkpoint_async_*` metric families to their kind/shape contract; a
-    missing section is fine (old rounds), a malformed one is not."""
+    events/events_tail to the event contract, `checkpoint_async_*` /
+    `device_memory_*` metric families to their kind/shape contracts, and
+    `device_time` blocks to the per-op row shape with a known provenance
+    label (estimate / measured / xplane); a missing section is fine (old
+    rounds), a malformed one is not."""
     from paddle_tpu.profiler.events import validate_event
     from paddle_tpu.profiler.monitor import validate_step_record
     problems = []
@@ -190,6 +261,10 @@ def validate_observability(doc: dict) -> List[str]:
         metrics = obs.get("metrics")
         if isinstance(metrics, dict):
             problems.extend(_validate_async_ckpt_metrics(where, metrics))
+            problems.extend(_validate_device_memory_metrics(where, metrics))
+        dt = obs.get("device_time")
+        if dt is not None:
+            problems.extend(_validate_device_time(where, dt))
         recs = obs.get("step_records")
         if recs is not None:
             if not isinstance(recs, list):

@@ -15,6 +15,16 @@ CLI:
     python tools/cross_stack_profiler.py --trace_dir LOGDIR --out merged.json
 where LOGDIR holds `rank_<i>.json` traces (any *.json works; rank inferred
 from the filename's trailing integer, else file order).
+
+XPlane device lanes: pass `--xplane_dir DIR` holding each rank's
+jax.profiler output — either per-rank `*<rank>.trace.json.gz` chrome
+exports or per-rank session directories (`rank_<i>/` with the standard
+`plugins/profile/<ts>/` layout, e.g. a `/profile` capture's session_dir).
+Each rank's backend work lanes (classified by paddle_tpu.profiler.xplane)
+are interleaved UNDER that rank's host lane in the merged trace as
+`xplane:`-named threads, with both clocks shifted to a common zero (host
+spans and device events come from different clocks; first-event alignment
+is the same role the reference's `time.txt` prefixes play).
 """
 from __future__ import annotations
 
@@ -23,12 +33,26 @@ import glob
 import json
 import os
 import re
+import sys
 from collections import defaultdict
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _rank_of(path: str, fallback: int) -> int:
     m = re.search(r"(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else fallback
+
+
+def _rank_of_any(path: str, fallback: int) -> int:
+    """Trailing rank integer of a trace file (.json / .trace.json.gz) or a
+    per-rank session directory name."""
+    base = os.path.basename(path.rstrip(os.sep))
+    m = re.search(r"(\d+)(?:\.trace)?\.json(?:\.gz)?$", base)
+    if m:
+        return int(m.group(1))
+    m = re.search(r"(\d+)$", base)
     return int(m.group(1)) if m else fallback
 
 
@@ -55,12 +79,90 @@ def load_rank_traces(trace_dir_or_files) -> Dict[int, dict]:
     return out
 
 
-def merge_traces(traces: Dict[int, dict], align: bool = True) -> dict:
+def load_xplane_dir(xplane_dir: str) -> Dict[int, list]:
+    """{rank: xplane trace events} from a directory of per-rank chrome
+    exports (`*<rank>.trace.json.gz` / `*.json`) or per-rank jax session
+    directories (anything `xplane.find_trace_file` can resolve)."""
+    from paddle_tpu.profiler import xplane as _xplane
+    out: Dict[int, list] = {}
+    entries = sorted(os.listdir(xplane_dir)) if os.path.isdir(xplane_dir) \
+        else []
+    if not entries:
+        raise FileNotFoundError(f"no entries in --xplane_dir {xplane_dir!r}")
+    i = 0
+    for name in entries:
+        path = os.path.join(xplane_dir, name)
+        trace_path: Optional[str] = None
+        if os.path.isdir(path):
+            trace_path = _xplane.find_trace_file(path)
+        elif name.endswith((".json", ".json.gz")):
+            trace_path = path
+        if trace_path is None:
+            continue
+        rank = _rank_of_any(path, i)
+        i += 1
+        if rank in out:
+            raise ValueError(f"rank {rank} inferred for two xplane traces "
+                             f"under {xplane_dir!r} — rename so each "
+                             f"carries a unique trailing rank number")
+        out[rank] = _xplane.load_trace(trace_path).get("traceEvents", [])
+    if not out:
+        raise FileNotFoundError(
+            f"--xplane_dir {xplane_dir!r} holds no parseable traces")
+    return out
+
+
+#: tid base for interleaved device lanes — far above any OS thread id's
+#: chance of colliding with a host lane in the same pid row
+_XPLANE_TID_BASE = 1 << 24
+
+
+def xplane_device_lane_events(xevents: list, rank: int,
+                              align: bool = True) -> List[dict]:
+    """Chrome events for one rank's backend work lanes, re-homed under
+    pid=rank with `xplane:`-named synthetic threads and the clock shifted
+    so the first work event lands at 0 (matching the host lane's
+    first-event alignment)."""
+    from paddle_tpu.profiler import xplane as _xplane
+    works = _xplane.work_events(xevents)
+    if not works:
+        return []
+    procs, threads = _xplane._lane_meta(xevents)
+    t0 = min(e.get("ts", 0.0) for e in works) if align else 0.0
+    lane_tid: Dict[Tuple[object, object], int] = {}
+    out: List[dict] = []
+    for e in works:
+        lane = (e.get("pid"), e.get("tid"))
+        tid = lane_tid.get(lane)
+        if tid is None:
+            tid = _XPLANE_TID_BASE + len(lane_tid)
+            lane_tid[lane] = tid
+            pname = procs.get(lane[0], f"pid {lane[0]}")
+            tname = threads.get(lane, f"tid {lane[1]}")
+            out.append({"ph": "M", "name": "thread_name", "pid": rank,
+                        "tid": tid,
+                        "args": {"name": f"xplane:{pname}/{tname}"}})
+            out.append({"ph": "M", "name": "thread_sort_index", "pid": rank,
+                        "tid": tid, "args": {"sort_index": tid}})
+        e2 = dict(e)
+        e2["pid"] = rank
+        e2["tid"] = tid
+        if align and "ts" in e2:
+            e2["ts"] = e2["ts"] - t0
+        out.append(e2)
+    return out
+
+
+def merge_traces(traces: Dict[int, dict], align: bool = True,
+                 xplane: Optional[Dict[int, list]] = None) -> dict:
     """One chrome trace with a process lane per rank.
 
     `align=True` subtracts each rank's first-event timestamp so lanes start
     together (ranks have independent host clocks — the reference aligns via
-    `time.txt` prefixes, CspReporter._set_timeInfo)."""
+    `time.txt` prefixes, CspReporter._set_timeInfo). `xplane` maps rank ->
+    that rank's jax trace events; its backend work lanes are interleaved
+    under the rank's process row as `xplane:` threads on the same
+    shifted-to-zero clock."""
     merged: List[dict] = []
     for rank in sorted(traces):
         events = traces[rank].get("traceEvents", [])
@@ -74,9 +176,14 @@ def merge_traces(traces: Dict[int, dict], align: bool = True) -> dict:
             if align and "ts" in e2:
                 e2["ts"] = e2["ts"] - t0
             merged.append(e2)
+        if xplane and rank in xplane:
+            merged.extend(xplane_device_lane_events(xplane[rank], rank,
+                                                    align=align))
+    ranks = sorted(traces)
     return {"traceEvents": merged, "displayTimeUnit": "ms",
             "metadata": {"producer": "paddle_tpu.tools.cross_stack_profiler",
-                         "ranks": sorted(traces)}}
+                         "ranks": ranks,
+                         "xplane_ranks": sorted(xplane) if xplane else []}}
 
 
 def op_summary(traces: Dict[int, dict]) -> List[dict]:
@@ -128,12 +235,20 @@ def main(argv=None) -> int:
                     help="keep raw per-rank clocks")
     ap.add_argument("--summary", action="store_true",
                     help="print the cross-rank op summary table")
+    ap.add_argument("--xplane_dir", default=None,
+                    help="directory of per-rank jax.profiler traces "
+                         "(*<rank>.trace.json.gz or rank_<i>/ session "
+                         "dirs); device lanes are interleaved under each "
+                         "rank's host lane")
     args = ap.parse_args(argv)
     traces = load_rank_traces(args.trace_dir)
-    merged = merge_traces(traces, align=not args.no_align)
+    xplane = load_xplane_dir(args.xplane_dir) if args.xplane_dir else None
+    merged = merge_traces(traces, align=not args.no_align, xplane=xplane)
     with open(args.out, "w") as f:
         json.dump(merged, f)
-    print(f"merged {len(traces)} rank traces -> {args.out}")
+    print(f"merged {len(traces)} rank traces"
+          + (f" + {len(xplane)} xplane device traces" if xplane else "")
+          + f" -> {args.out}")
     if args.summary:
         print(format_summary(op_summary(traces)))
     return 0

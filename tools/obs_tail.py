@@ -10,8 +10,14 @@ severity + kind-specific payload). This renders that stream for operators:
     python tools/obs_tail.py events.jsonl --kind retrace
     python tools/obs_tail.py events.jsonl --host trainer-1 --min-severity warn
     python tools/obs_tail.py events.jsonl --follow         # live tail
+    python tools/obs_tail.py events.jsonl --follow --follow-for 30
     python tools/obs_tail.py events.jsonl --json --kind fleet_straggler
+    python tools/obs_tail.py events.jsonl --diagnose       # step_diagnosis
     cat events.jsonl | python tools/obs_tail.py -
+
+`--diagnose` renders `step_diagnosis` events (the runtime's step-slowness
+decomposition) as a per-window cost breakdown naming the dominant term;
+`--follow-for N` bounds a live tail to N seconds (scripting/CI).
 
 A running job's recent window is also served live over HTTP
 (`/events?kind=...` on the ObservabilityServer) — this tool is the
@@ -91,23 +97,61 @@ def format_event(rec: dict) -> str:
             f"{rec.get('host', '?'):<16} {extras}")
 
 
-def _emit(events, as_json: bool, out=sys.stdout):
+def format_diagnosis(rec: dict) -> str:
+    """One step_diagnosis event as a cost breakdown line: dominant term
+    first with its share of the wall, then every nonzero term."""
+    ts = rec.get("ts")
+    try:
+        when = datetime.fromtimestamp(float(ts)).strftime("%H:%M:%S.%f")[:-3]
+    except (TypeError, ValueError, OSError):
+        when = "??:??:??.???"
+    terms = rec.get("terms") or {}
+    dom = rec.get("dominant", "?")
+    frac = rec.get("dominant_frac")
+    frac_s = f" ({100 * frac:.0f}% of wall)" if isinstance(
+        frac, (int, float)) else ""
+    parts = " | ".join(
+        f"{k}={1000 * v:.1f}ms"
+        for k, v in sorted(terms.items(), key=lambda kv: -kv[1])
+        if isinstance(v, (int, float)) and v > 0) or "no nonzero terms"
+    step = f" step {rec['step']}" if "step" in rec else ""
+    return (f"{when} {rec.get('host', '?'):<16}{step} "
+            f"wall {1000 * rec.get('wall_s', 0.0):.1f}ms over "
+            f"{rec.get('steps', '?')} step(s): dominant={dom}{frac_s}  "
+            f"[{parts}]")
+
+
+def _emit(events, as_json: bool, out=None, diagnose: bool = False):
+    out = out if out is not None else sys.stdout  # resolve at call time
     for rec in events:
-        out.write((json.dumps(rec) if as_json else format_event(rec)) + "\n")
+        if as_json:
+            line = json.dumps(rec)
+        elif diagnose and rec.get("kind") == "step_diagnosis":
+            line = format_diagnosis(rec)
+        else:
+            line = format_event(rec)
+        out.write(line + "\n")
     out.flush()
 
 
-def follow(path: str, args, poll_s: float = 0.5):
+def follow(path: str, args, poll_s: float = 0.5,
+           max_s: Optional[float] = None):
     """Live tail: print matching events appended after startup (plus the
-    initial -n window). Ctrl-C exits cleanly."""
+    initial -n window). Ctrl-C exits cleanly; `max_s` bounds the tail
+    (--follow-for) so scripted runs terminate on their own."""
+    t0 = time.monotonic()
+    diagnose = getattr(args, "diagnose", False)
     with open(path) as f:
         events, _ = parse_lines(f)
         window = [e for e in events
                   if event_matches(e, args.kind, args.host,
                                    args.min_severity, args.since_ts)]
-        _emit(window[-args.n:] if args.n else window, args.json)
+        _emit(window[-args.n:] if args.n else window, args.json,
+              diagnose=diagnose)
         try:
             while True:
+                if max_s is not None and time.monotonic() - t0 >= max_s:
+                    return 0
                 line = f.readline()
                 if not line:
                     time.sleep(poll_s)
@@ -116,7 +160,7 @@ def follow(path: str, args, poll_s: float = 0.5):
                 _emit([r for r in recs
                        if event_matches(r, args.kind, args.host,
                                         args.min_severity, args.since_ts)],
-                      args.json)
+                      args.json, diagnose=diagnose)
         except KeyboardInterrupt:
             return 0
 
@@ -137,11 +181,20 @@ def main(argv=None) -> int:
                     help="only events newer than this many seconds ago")
     ap.add_argument("--follow", action="store_true",
                     help="keep tailing the file for new events")
+    ap.add_argument("--follow-for", type=float, default=None, metavar="SEC",
+                    help="with --follow: stop after this many seconds "
+                         "(default: until Ctrl-C)")
+    ap.add_argument("--diagnose", action="store_true",
+                    help="show step_diagnosis events as a per-window cost "
+                         "breakdown (implies --kind step_diagnosis unless "
+                         "--kind is given)")
     ap.add_argument("--json", action="store_true",
                     help="emit matching events as raw JSONL instead of the "
                          "human format")
     args = ap.parse_args(argv)
     args.since_ts = time.time() - args.since_sec if args.since_sec else 0.0
+    if args.diagnose and args.kind is None:
+        args.kind = "step_diagnosis"
 
     if args.follow:
         if args.path == "-":
@@ -150,7 +203,7 @@ def main(argv=None) -> int:
         if not os.path.exists(args.path):
             print(f"obs_tail: {args.path}: no such file", file=sys.stderr)
             return 2
-        return follow(args.path, args) or 0
+        return follow(args.path, args, max_s=args.follow_for) or 0
 
     try:
         lines = sys.stdin.readlines() if args.path == "-" \
@@ -167,7 +220,8 @@ def main(argv=None) -> int:
     matching = [e for e in events
                 if event_matches(e, args.kind, args.host,
                                  args.min_severity, args.since_ts)]
-    _emit(matching[-args.n:] if args.n else matching, args.json)
+    _emit(matching[-args.n:] if args.n else matching, args.json,
+          diagnose=args.diagnose)
     return 0
 
 

@@ -11,10 +11,35 @@ from __future__ import annotations
 
 import contextlib
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 from ..ops._dispatch import amp_state
+from ..profiler import metrics as _metrics_mod
+
+_REG = _metrics_mod.default_registry()
+_M_FOUND_INF = _REG.counter(
+    "amp_found_inf_total",
+    "GradScaler unscale passes that found nonfinite scaled gradients "
+    "(each one skips the optimizer step and feeds the loss-scale backoff)")
+_M_LOSS_SCALE = _REG.gauge(
+    "amp_loss_scale",
+    "current dynamic loss scale of the newest GradScaler — a collapsing "
+    "value means gradients keep overflowing")
+
+
+@jax.jit
+def _unscale_and_check(grads, inv):
+    """ONE fused program over every gradient leaf: unscale and reduce an
+    all-leaves finite check. Replaces the per-gradient host sync loop
+    (bool(~jnp.all(...)) per leaf) with a single device->host fetch of
+    `bad` at the caller."""
+    scaled = [g * inv for g in grads]
+    bad = jnp.zeros((), jnp.bool_)
+    for g in scaled:
+        bad = bad | ~jnp.all(jnp.isfinite(g))
+    return scaled, bad
 
 
 @contextlib.contextmanager
@@ -74,6 +99,8 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled = False  # OptimizerState.UNSCALED equivalent
+        if enable and _metrics_mod.enabled():
+            _M_LOSS_SCALE.set(self._scale)
 
     def scale(self, loss):
         if not self._enable:
@@ -83,15 +110,19 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable or self._unscaled:
             return
-        import jax.numpy as jnp
-        inv = 1.0 / self._scale
-        found_inf = False
-        for p in optimizer._parameter_list:
-            if p.grad is not None:
-                g = p.grad.data * inv
-                found_inf = found_inf | bool(~jnp.all(jnp.isfinite(g)))
+        params = [p for p in optimizer._parameter_list
+                  if p.grad is not None]
+        if params:
+            scaled, bad = _unscale_and_check(
+                [p.grad.data for p in params], 1.0 / self._scale)
+            found_inf = bool(bad)  # the one device sync of the pass
+            for p, g in zip(params, scaled):
                 p.grad = Tensor(g)
-        self._found_inf = bool(found_inf)
+        else:
+            found_inf = False
+        self._found_inf = found_inf
+        if found_inf and _metrics_mod.enabled():
+            _M_FOUND_INF.inc()
         self._unscaled = True
 
     def step(self, optimizer):
@@ -125,6 +156,9 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+        if _metrics_mod.enabled():
+            # scale as a gauge: loss-scale collapse is visible on /metrics
+            _M_LOSS_SCALE.set(self._scale)
 
     def is_enable(self):
         return self._enable
@@ -137,6 +171,8 @@ class GradScaler:
 
     def set_init_loss_scaling(self, v):
         self._scale = float(v)
+        if _metrics_mod.enabled():
+            _M_LOSS_SCALE.set(self._scale)
 
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
@@ -147,3 +183,5 @@ class GradScaler:
         self._scale = sd.get("scale", self._scale)
         self._good_steps = sd.get("good_steps", 0)
         self._bad_steps = sd.get("bad_steps", 0)
+        if self._enable and _metrics_mod.enabled():
+            _M_LOSS_SCALE.set(self._scale)

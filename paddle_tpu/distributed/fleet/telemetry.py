@@ -65,6 +65,13 @@ _M_STRAGGLER = _REG.counter(
     "fleet_straggler_total",
     "straggler excursions detected (host p50 exceeded fleet median by the "
     "configured factor), by host")
+_M_HEALTH = _REG.gauge(
+    "fleet_health_status",
+    "training-health status code each host's digest reports "
+    "(0 ok, 1 warn, 2 diverged), by host")
+
+#: digest health_status string -> fleet_health_status gauge code
+HEALTH_CODES = {"ok": 0, "warn": 1, "diverged": 2}
 
 
 def _hist_sum(name: str) -> float:
@@ -185,6 +192,10 @@ class FleetReporter:
             # newest step_diagnosis dominant term (null until one runs):
             # the aggregator's fleet view names each host's bottleneck
             "diag_dominant": diag,
+            # training-health status (profiler/health.py; null until the
+            # health plane saw a step) — the rank-0 aggregator uses it to
+            # name the first host whose numerics went bad
+            "health_status": self._health_status(),
             "barrier_wait_s": round(_hist_sum("ckpt_barrier_wait_seconds"), 6),
             "heter": {
                 "route_s": round(_hist_sum("heter_route_seconds"), 6),
@@ -193,6 +204,14 @@ class FleetReporter:
                 "step_wall_s": round(_hist_sum("heter_step_wall_seconds"), 6),
             },
         }
+
+    @staticmethod
+    def _health_status():
+        try:
+            from ...profiler.health import last_status
+            return last_status()
+        except Exception:
+            return None
 
     def publish(self, step: int):
         self.store.set(DIGEST_KEY_FMT.format(rank=self.rank),
@@ -218,6 +237,7 @@ class FleetAggregator:
         self.straggler_factor = float(straggler_factor)
         self._lock = threading.Lock()
         self._straggling: set = set()
+        self._unhealthy: Dict[str, str] = {}  # host -> last non-ok status
         self.last: Dict[int, dict] = {}
 
     def collect(self) -> Dict[int, dict]:
@@ -246,8 +266,33 @@ class FleetAggregator:
                         _M_WALL_P50.set(d["wall_p50_s"], host=host)
                     if d.get("data_wait_frac") is not None:
                         _M_DATA_WAIT.set(d["data_wait_frac"], host=host)
+                    if d.get("health_status") in HEALTH_CODES:
+                        _M_HEALTH.set(HEALTH_CODES[d["health_status"]],
+                                      host=host)
             self._detect_stragglers(out)
+            self._detect_unhealthy(out)
             return out
+
+    def _detect_unhealthy(self, digests: Dict[int, dict]):
+        """One `fleet_health` event per status TRANSITION: emitted when a
+        host's digest first reports a non-ok health status (the events
+        are timestamped, so the FIRST such event names the first host
+        whose numerics went bad) and again when the status changes (a
+        warn host escalating to diverged must still fire the
+        severity=error alert operators page on); re-armed when the host
+        reports ok again."""
+        for r, d in digests.items():
+            host = d.get("host", f"rank-{r}")
+            status = d.get("health_status")
+            if status in ("warn", "diverged"):
+                if self._unhealthy.get(host) != status:
+                    self._unhealthy[host] = status
+                    _events_mod.emit(
+                        "fleet_health",
+                        severity="error" if status == "diverged" else "warn",
+                        unhealthy=host, status=status, step=d.get("step"))
+            elif status == "ok":
+                self._unhealthy.pop(host, None)
 
     def _detect_stragglers(self, digests: Dict[int, dict]):
         """One `fleet_straggler` event per excursion: emitted when a host's
@@ -290,6 +335,7 @@ class FleetAggregator:
             return {"world_size": self.world_size,
                     "straggler_factor": self.straggler_factor,
                     "straggling": sorted(self._straggling),
+                    "unhealthy": sorted(self._unhealthy),
                     "hosts": {str(r): d for r, d in self.last.items()}}
 
 

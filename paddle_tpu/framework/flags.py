@@ -87,9 +87,23 @@ def all_flags() -> Dict[str, Any]:
 def _on_flag_set(name: str, value):
     # behavioral side effects
     if name == "FLAGS_check_nan_inf":
+        # Routes to the training-health plane (profiler/health.py), NOT to
+        # jax_debug_nans: the eager dispatch post-check reads this flag per
+        # call (so a runtime set_flags arms it immediately), compiled
+        # TrainSteps fold the in-graph sentinel on next construction, and
+        # here we arm the layer-path attribution stack. jax_debug_nans —
+        # crash-only, no attribution, largely inert inside compiled
+        # steps — is the explicit FLAGS_debug_nans escape hatch below.
+        try:
+            import sys
+            h = sys.modules.get("paddle_tpu.profiler.health")
+            if h is not None:
+                h.set_eager_check(bool(value))
+        except Exception:
+            pass
+    elif name == "FLAGS_debug_nans":
         try:
             import jax
-            # covers jit-compiled programs; eager ops are checked per-dispatch
             jax.config.update("jax_debug_nans", bool(value))
         except Exception:
             pass
@@ -141,8 +155,19 @@ def _apply_compile_cache_dir(path):
 # Flag definitions (subset of platform/flags.cc with TPU-meaningful semantics)
 # ---------------------------------------------------------------------------
 define_flag("FLAGS_check_nan_inf", False,
-            "post-check every op output for NaN/Inf (reference "
-            "nan_inf_utils_detail); compiled programs get jax_debug_nans")
+            "training-health numerics plane (reference nan_inf_utils): "
+            "eager dispatch post-checks every op output and attributes the "
+            "first NaN/Inf to op + layer path (tensor_health event); "
+            "compiled TrainSteps fold the in-graph health sentinel "
+            "(profiler/health.py). See also PADDLE_TPU_HEALTH=1 "
+            "(sentinel-only) and FLAGS_debug_nans (raw jax_debug_nans)")
+define_flag("FLAGS_debug_nans",
+            os.environ.get("PADDLE_TPU_DEBUG_NANS", "").lower() in
+            ("1", "true", "yes", "on"),
+            "escape hatch: jax's own jax_debug_nans (crash-only, no "
+            "attribution, mostly inert inside compiled steps — prefer "
+            "FLAGS_check_nan_inf / PADDLE_TPU_HEALTH). Set via "
+            "PADDLE_TPU_DEBUG_NANS=1 or set_flags")
 define_flag("FLAGS_benchmark", False, "synchronize after each op for timing")
 define_flag("FLAGS_use_pallas_kernels", True,
             "use Pallas TPU kernels (flash attention, fused ops) when shapes "
@@ -174,5 +199,7 @@ define_flag("FLAGS_compile_cache_dir",
 
 if os.environ.get("FLAGS_check_nan_inf"):
     _on_flag_set("FLAGS_check_nan_inf", flag("FLAGS_check_nan_inf"))
+if flag("FLAGS_debug_nans"):
+    _on_flag_set("FLAGS_debug_nans", True)
 if flag("FLAGS_compile_cache_dir"):
     _apply_compile_cache_dir(flag("FLAGS_compile_cache_dir"))

@@ -13,9 +13,11 @@ from typing import List, Optional
 
 import numpy as np
 
-# step-window throughput/MFU/retrace JSONL reporter (profiler/monitor.py);
-# re-exported here so `paddle.callbacks.ThroughputMonitor` matches where
-# users expect callbacks to live
+# step-window throughput/MFU/retrace JSONL reporter (profiler/monitor.py)
+# and the training-health trend/divergence monitor (profiler/health.py);
+# re-exported here so `paddle.callbacks.*` matches where users expect
+# callbacks to live
+from ..profiler.health import HealthMonitor  # noqa: F401
 from ..profiler.monitor import ThroughputMonitor  # noqa: F401
 
 
@@ -131,6 +133,14 @@ class FaultTolerantCheckpoint(Callback):
     everything and skips the already-consumed steps of the interrupted
     epoch, so kill -9 -> relaunch trains a bit-identical tail.
 
+    Training-health guard: while the numerics sentinel is tripped
+    (profiler/health.py — the current weights hold NaN/Inf) periodic and
+    epoch-end saves are SKIPPED with a `health_alert` event, so the last
+    good checkpoint stays the rollback/resume target. A save racing the
+    one-step detection latency can still capture bad state; the
+    HealthMonitor rollback path walks past such files by checking
+    finiteness before restoring.
+
     Preemption-save caveat: the step cursor is exact at batch boundaries.
     A SIGTERM that lands INSIDE a train step may snapshot weights that
     already include the in-flight update with a cursor one step behind —
@@ -225,6 +235,19 @@ class FaultTolerantCheckpoint(Callback):
         return state
 
     def _save(self):
+        from ..profiler import health as _health_mod
+        if _health_mod.tripped():
+            # the numerics sentinel says the CURRENT state holds NaN/Inf:
+            # a CRC-valid checkpoint of it would poison the rollback path
+            # (and fleet resume) with weights nobody wants back. Skip —
+            # the last good checkpoint stays the restore target.
+            _health_mod.note_alert({"signal": "checkpoint_skipped",
+                                    "step": self._global_step})
+            from ..profiler import events as _events_mod
+            _events_mod.emit("health_alert", severity="warn",
+                             signal="checkpoint_skipped",
+                             step=int(self._global_step))
+            return
         committed = self.manager.save(self._capture(),
                                       step=self._global_step)
         if committed or self.manager.coordinator is None:

@@ -175,6 +175,9 @@ class Model:
             cbks.on_epoch_begin(epoch)
             logs = {}
             for step, batch in enumerate(train_loader):
+                if self.stop_training:
+                    break  # a callback (HealthMonitor halt, EarlyStopping)
+                    # stopped the run mid-epoch
                 if epoch == start_epoch and step < skip_steps:
                     continue  # consumed before the interruption — the
                     # checkpoint's optimizer/RNG state already reflects it

@@ -314,12 +314,21 @@ class TrainStep:
     _seq = 0
 
     def __init__(self, layer: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True, amp_dtype=None):
+                 donate: bool = True, amp_dtype=None, health=None):
         """amp_dtype: e.g. jnp.bfloat16 enables O2 mixed precision — fp32
         master weights and optimizer slots, parameters cast to amp_dtype for
         the forward/backward compute (reference AMP level O2, master-weight
         pattern in imperative/amp_auto_cast.h + GradScaler; bf16 on TPU
         needs no loss scaling).
+
+        health: fold the in-graph numerics sentinel (profiler/health.py
+        HealthProbe) into the compiled step — loss, any-nonfinite flag,
+        global + per-layer-group grad norms and update/param ratio are
+        computed on-device in the SAME XLA program and fetched as one
+        tiny vector every PADDLE_TPU_HEALTH_INTERVAL steps. None (the
+        default) follows PADDLE_TPU_HEALTH=1 / FLAGS_check_nan_inf; a
+        sentinel trip triggers a one-shot eager replay of the last batch
+        with the per-op NaN checks armed (first-NaN attribution).
 
         NOTE on recompute: a whole-forward jax.checkpoint here is a
         measured no-op for peak memory (XLA already frees residuals as the
@@ -338,6 +347,18 @@ class TrainStep:
         self.opt_state = optimizer.init_state_tree(params)
         self._t = 0
         loss_fn_ = loss_fn
+        self._loss_fn = loss_fn
+        from ..profiler import health as _health_mod
+        if health is None:
+            health = _health_mod.enabled()
+        self._health_probe = _health_mod.HealthProbe(params) if health \
+            else None
+        self._health_interval = _health_mod.interval()
+        self._last_batch = None   # raw arrays, kept only while health is on
+        self._nan_replayed = False
+        self.last_health = None   # newest decoded sentinel stats
+        self.last_attribution = None
+        health_probe = self._health_probe
 
         def maybe_cast(p):
             if amp_dtype is None:
@@ -368,7 +389,12 @@ class TrainStep:
             (loss, new_buffers), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
             new_params, new_opt = optimizer.apply_fn(params, grads, opt_state,
                                                      lr=lr, t=t)
-            return loss, new_params, new_buffers, new_opt
+            if health_probe is None:
+                return loss, new_params, new_buffers, new_opt
+            # in-graph sentinel: a handful of tiny fused reductions, one
+            # extra (small) output — never a per-tensor host sync
+            hvec = health_probe.stats_vec(loss, grads, params, new_params)
+            return loss, new_params, new_buffers, new_opt, hvec
 
         donate_args = (0, 2) if donate else ()
         self._step = jax.jit(step, static_argnames=(),
@@ -387,12 +413,46 @@ class TrainStep:
                                 jax.tree_util.tree_leaves(arrs))
         _cw_prev = _compile_watch.push_entry("train_step", self._wd_name)
         try:
-            loss, self.params, self.buffers, self.opt_state = self._step(
-                self.params, self.buffers, self.opt_state, rng, lr,
-                self._t, *arrs)
+            if self._health_probe is None:
+                loss, self.params, self.buffers, self.opt_state = self._step(
+                    self.params, self.buffers, self.opt_state, rng, lr,
+                    self._t, *arrs)
+            else:
+                (loss, self.params, self.buffers, self.opt_state,
+                 hvec) = self._step(
+                    self.params, self.buffers, self.opt_state, rng, lr,
+                    self._t, *arrs)
         finally:
             _compile_watch.pop_entry(_cw_prev)
+        if self._health_probe is not None:
+            self._last_batch = arrs
+            if self._t % self._health_interval == 0:
+                self._note_health(hvec)
         return Tensor(loss)
+
+    def _note_health(self, hvec):
+        """Fetch + record one sentinel vector (the tier's single
+        device->host transfer); on a fresh trip, run the one-shot eager
+        replay for first-NaN attribution. Never raises."""
+        from ..profiler import health as _health_mod
+        try:
+            stats = self._health_probe.decode(hvec)
+            self.last_health = _health_mod.record_step_stats(
+                stats, step=self._t, source="sentinel")
+        except Exception:
+            return
+        if not stats.get("nonfinite"):
+            self._nan_replayed = False
+            return
+        if self._nan_replayed:
+            return
+        self._nan_replayed = True  # one replay per trip, not per step
+        try:
+            self.sync_to_layer()
+            self.last_attribution = _health_mod.eager_replay(
+                self.layer, self._loss_fn, self._last_batch)
+        except Exception:
+            pass
 
     def state_dict(self):
         """Optimizer-slot state of the compiled step (for checkpoint/resume)."""

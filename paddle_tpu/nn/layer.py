@@ -17,6 +17,7 @@ import numpy as np
 from ..framework import dtype as dtype_mod
 from ..framework.param import Parameter
 from ..framework.tensor import Tensor
+from ..profiler import health as _health_mod
 
 
 class HookRemoveHelper:
@@ -194,6 +195,19 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        if _health_mod._ATTRIBUTION_ARMED:
+            # NaN/Inf attribution armed (FLAGS_check_nan_inf or an
+            # eager_replay): keep a thread-local layer stack so the
+            # dispatch post-check can name the layer PATH that produced
+            # the first bad value. Unarmed cost: one module-attr test.
+            _health_mod.push_layer(self)
+            try:
+                return self._call_impl(*inputs, **kwargs)
+            finally:
+                _health_mod.pop_layer()
+        return self._call_impl(*inputs, **kwargs)
+
+    def _call_impl(self, *inputs, **kwargs):
         for hook in list(self._forward_pre_hooks.values()):
             out = hook(self, inputs)
             if out is not None:

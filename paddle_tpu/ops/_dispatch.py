@@ -473,9 +473,13 @@ def _execute_body(impl, kwargs, arrs, tensors, name, requires):
 
 # ---------------------------------------------------------------------------
 # NaN/Inf numerical sanitizer (reference: FLAGS_check_nan_inf →
-# CheckOpHasNanOrInfInDygraph, framework/details/nan_inf_utils.h:44)
+# CheckOpHasNanOrInfInDygraph, framework/details/nan_inf_utils.h:44).
+# Routed through the training-health plane (profiler/health.py): the first
+# bad op output emits a `tensor_health` event naming op + layer path +
+# shape/dtype + bad-value kind before the (reference-semantics) crash.
 # ---------------------------------------------------------------------------
 from ..framework import flags as _flags_mod  # noqa: E402  (imports os only)
+from ..profiler import health as _health_mod  # noqa: E402
 
 _NAN_FLAG = _flags_mod._REGISTRY["FLAGS_check_nan_inf"]
 _EAGER_CACHE_FLAG = _flags_mod._REGISTRY["FLAGS_eager_op_cache"]
@@ -490,11 +494,19 @@ def _check_nan_inf(name: str, outs):
         if not isinstance(o, jax.Array):
             continue
         if isinstance(o, jax.core.Tracer):
-            continue  # under jit: jax_debug_nans covers compiled programs
+            continue  # under jit: the TrainStep's in-graph sentinel (or
+            # the PADDLE_TPU_DEBUG_NANS escape hatch) covers compiled code
         if (dtype_mod.is_floating(o.dtype) or dtype_mod.is_complex(o.dtype)):
             if not bool(jnp.all(jnp.isfinite(o))):
+                # failure path only: two more tiny fetches to name the kind
+                kind = "nan" if bool(jnp.any(jnp.isnan(o))) else "inf"
+                rec = _health_mod.note_bad_tensor(
+                    op=name, output_index=i, shape=tuple(o.shape),
+                    dtype=str(o.dtype), kind=kind)
+                where = f" in layer '{rec['layer']}'" if rec.get("layer") \
+                    else ""
                 raise FloatingPointError(
-                    f"Operator '{name}' output {i} contains NaN or Inf "
+                    f"Operator '{name}' output {i} contains {kind}{where} "
                     f"(shape {tuple(o.shape)}, dtype {o.dtype}). Enabled by "
                     f"FLAGS_check_nan_inf.")
 

@@ -11,6 +11,8 @@ chrome://tracing JSON; device-side tracing delegates to `jax.profiler`
 from . import compile_watch
 from . import device_time
 from . import events
+from . import health
+from .health import HealthMonitor
 from . import metrics
 from .monitor import (ThroughputMonitor, make_step_record,
                       validate_step_record)
@@ -33,7 +35,7 @@ __all__ = [
     'export_chrome_tracing', 'export_protobuf', 'RecordEvent',
     'load_profiler_result', 'SortedKeys', 'StatisticData', 'summary_report',
     'Benchmark', 'benchmark', 'metrics', 'events', 'compile_watch',
-    'device_time', 'server', 'xplane', 'ThroughputMonitor',
-    'make_step_record', 'validate_step_record', 'RetraceWatchdog',
-    'get_watchdog',
+    'device_time', 'health', 'server', 'xplane', 'ThroughputMonitor',
+    'HealthMonitor', 'make_step_record', 'validate_step_record',
+    'RetraceWatchdog', 'get_watchdog',
 ]

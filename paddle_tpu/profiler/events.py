@@ -26,7 +26,11 @@ Schema (flat JSON object per line):
 `validate_event` is the schema contract tests and
 `tools/check_bench_result.py` check against. Kill switch:
 `PADDLE_TPU_EVENTS=0` makes every emit a no-op. `PADDLE_TPU_EVENT_LOG=path`
-appends each event as one JSON line (the obs_tail input).
+appends each event as one JSON line (the obs_tail input); with
+`PADDLE_TPU_EVENT_LOG_MAX_MB=N` the sink rotates size-based (`path` ->
+`path.1` -> ... keeping the newest `PADDLE_TPU_EVENT_LOG_KEEP` rotated
+files, default 3) so a long fleet run cannot grow the file unboundedly —
+`tools/obs_tail.py` reads rotated siblings transparently.
 """
 from __future__ import annotations
 
@@ -58,6 +62,10 @@ KINDS = (
     "fleet_straggler",    # a host's rolling step p50 left the fleet band
     "step_diagnosis",     # a step window's wall-time decomposition
     "profile_capture",    # an on-demand profiler capture session ended
+    "tensor_health",      # NaN/Inf detected (sentinel trip or eager op)
+    "health_alert",       # HealthMonitor signal (spike/explosion/...)
+    "health_rollback",    # divergence response restored a checkpoint
+    "fleet_health",       # a host's digest reported a non-ok health status
 )
 
 SEVERITIES = ("debug", "info", "warn", "error")
@@ -152,7 +160,8 @@ class EventLog:
 
     def _write_line(self, rec: dict):
         """Append to the JSONL sink (lazy open; one failure disables the
-        sink with a single warning — the ring keeps working)."""
+        sink with a single warning — the ring keeps working). Rotates the
+        file size-based when PADDLE_TPU_EVENT_LOG_MAX_MB is set."""
         if self._file_error:
             return
         path = self._path or os.environ.get("PADDLE_TPU_EVENT_LOG")
@@ -170,6 +179,49 @@ class EventLog:
             import warnings
             warnings.warn(f"event JSONL sink {path!r} failed ({e}); "
                           f"events stay in memory only")
+            return
+        self._maybe_rotate(path)
+
+    def _maybe_rotate(self, path: str):
+        """Size-based rotation: once the sink passes
+        PADDLE_TPU_EVENT_LOG_MAX_MB, shift `path` -> `path.1` (existing
+        `path.N` -> `path.N+1`, newest-first numbering) and keep only the
+        newest PADDLE_TPU_EVENT_LOG_KEEP rotated files. A rotation
+        failure never disables the sink — worse to lose events than to
+        let the file grow."""
+        raw = os.environ.get("PADDLE_TPU_EVENT_LOG_MAX_MB", "")
+        if not raw:
+            return
+        try:
+            max_bytes = float(raw) * (1 << 20)
+        except ValueError:
+            return
+        if max_bytes <= 0:
+            return
+        try:
+            if self._file.tell() < max_bytes:
+                return
+            keep = 3
+            keep_raw = os.environ.get("PADDLE_TPU_EVENT_LOG_KEEP", "")
+            if keep_raw:
+                try:
+                    keep = max(0, int(keep_raw))
+                except ValueError:
+                    pass
+            self._file.close()
+            self._file = None  # lazy reopen on the next emit
+            oldest = f"{path}.{keep}"
+            if keep == 0:
+                os.remove(path)
+                return
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(keep - 1, 0, -1):
+                if os.path.exists(f"{path}.{i}"):
+                    os.replace(f"{path}.{i}", f"{path}.{i + 1}")
+            os.replace(path, f"{path}.1")
+        except Exception:
+            pass
 
     # -- reading -------------------------------------------------------------
     def recent(self, n: int = 100, kind: Optional[str] = None,

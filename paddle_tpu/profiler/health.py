@@ -1,0 +1,984 @@
+"""Training-health numerics plane: in-graph NaN/Inf sentinels, per-layer
+gradient telemetry, first-NaN attribution, divergence auto-response.
+
+The reference framework treats numerics as a first-class observable:
+`FLAGS_check_nan_inf` hooks every op post-execution with
+`CheckOpHasNanOrInf` (`framework/details/nan_inf_utils.h:29`), naming the
+op and tensor that produced the first bad value. This module is the
+TPU-native port of that idea, with three detection tiers that respect how
+production steps actually run (ONE compiled XLA program, where a per-op
+host check is impossible and `jax_debug_nans` is inert):
+
+1.  **In-graph sentinel** — :class:`HealthProbe` folds a small packed
+    stats vector into the compiled ``TrainStep``: loss value, an
+    any-nonfinite flag, the global grad norm, per-layer-group grad norms
+    (bucketed parameter-tree paths, bounded cardinality), and the
+    update/param ratio. All reductions run on-device in the same XLA
+    program; the host fetches ONE tiny vector per step (or every N steps,
+    ``PADDLE_TPU_HEALTH_INTERVAL``) — no per-tensor syncs.
+
+2.  **Eager first-NaN attribution** — under ``FLAGS_check_nan_inf`` the
+    eager dispatch post-checks every op output (the reference's
+    ``CheckOpHasNanOrInfInDygraph`` analogue) and, on the first bad
+    value, emits a ``tensor_health`` event naming the op, the layer path
+    (a thread-local layer stack armed only while checking), the
+    shape/dtype, and the bad-value kind. Compiled steps get the same
+    attribution without permanently paying eager cost: when the sentinel
+    trips, :func:`eager_replay` re-runs the last batch's forward+loss
+    eagerly ONCE with the checks armed.
+
+3.  **Trend detection + auto-response** — :class:`HealthMonitor` (a hapi
+    callback, sibling of ``ThroughputMonitor``) tracks loss
+    spikes/divergence (EWMA + z-score), grad-norm explosion/vanishing,
+    and stagnation; emits ``health_*`` metric families and structured
+    events into the observability plane, and on confirmed divergence runs
+    the configured response (``PADDLE_TPU_HEALTH_ACTION``): ``warn`` |
+    ``halt`` | ``rollback`` (restore the last valid checkpoint through
+    the existing ``CheckpointManager`` machinery, bit-identically).
+
+Opt-in: ``PADDLE_TPU_HEALTH=1`` or ``FLAGS_check_nan_inf`` arms the
+sentinel on every subsequently-built ``TrainStep``; the eager per-op
+check follows ``FLAGS_check_nan_inf`` alone (it crashes on the first bad
+op, reference semantics). ``PADDLE_TPU_DEBUG_NANS=1`` /
+``FLAGS_debug_nans`` is the explicit escape hatch to jax's own
+``jax_debug_nans`` (see framework/flags.py).
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework import flags as _flags_mod
+from . import events as _events_mod
+from . import metrics as _metrics_mod
+
+__all__ = [
+    "HealthProbe", "HealthMonitor", "enabled", "interval", "record_step_stats",
+    "last_stats", "last_status", "snapshot", "eager_replay", "note_bad_tensor",
+    "index_model", "reset", "HEALTH_EVENT_KINDS",
+]
+
+#: event kinds this plane emits (subset of events.KINDS)
+HEALTH_EVENT_KINDS = ("tensor_health", "health_alert", "health_rollback")
+
+_REG = _metrics_mod.default_registry()
+_M_LOSS = _REG.gauge(
+    "health_loss",
+    "newest loss value the health sentinel fetched (finite values only)")
+_M_GRAD_NORM = _REG.gauge(
+    "health_grad_norm",
+    "newest global gradient L2 norm from the in-graph sentinel (finite "
+    "values only)")
+_M_UPDATE_RATIO = _REG.gauge(
+    "health_update_ratio",
+    "newest parameter update/param L2-norm ratio from the sentinel "
+    "(finite values only)")
+_M_LAYER_GRAD = _REG.gauge(
+    "health_layer_grad_norm",
+    "per-layer-group gradient L2 norm from the sentinel, by group "
+    "(bucketed parameter-tree path, bounded cardinality)")
+_M_NONFINITE = _REG.counter(
+    "health_nonfinite_total",
+    "nonfinite detections by src (sentinel: the in-graph probe tripped; "
+    "eager: the per-op dispatch post-check fired)")
+_M_ALERTS = _REG.counter(
+    "health_alerts_total",
+    "HealthMonitor alerts by signal (nonfinite, loss_spike, "
+    "grad_explosion, grad_vanishing, stagnation)")
+_M_ROLLBACK = _REG.counter(
+    "health_rollback_total",
+    "divergence auto-responses that restored the last valid checkpoint")
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+def enabled() -> bool:
+    """True when the in-graph sentinel should be folded into compiled
+    steps: PADDLE_TPU_HEALTH=1, or the reference flag FLAGS_check_nan_inf
+    (which also arms the eager per-op check)."""
+    if os.environ.get("PADDLE_TPU_HEALTH", "").lower() in (
+            "1", "true", "yes", "on"):
+        return True
+    try:
+        return bool(_flags_mod.flag("FLAGS_check_nan_inf"))
+    except Exception:
+        return False
+
+
+def interval() -> int:
+    """Sentinel fetch cadence in steps (the vector is computed in-graph
+    every step either way; this bounds the device->host transfers and the
+    detection latency)."""
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_HEALTH_INTERVAL", "1")))
+    except ValueError:
+        return 1
+
+
+def action() -> str:
+    """The configured divergence response: warn | halt | rollback."""
+    a = os.environ.get("PADDLE_TPU_HEALTH_ACTION", "warn").lower()
+    return a if a in ("warn", "halt", "rollback") else "warn"
+
+
+def max_groups() -> int:
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_HEALTH_GROUPS", "32")))
+    except ValueError:
+        return 32
+
+
+# ---------------------------------------------------------------------------
+# tier 1: in-graph sentinel
+# ---------------------------------------------------------------------------
+def _group_name(param_name: str) -> str:
+    """Bucket a dotted parameter path into a layer group: drop the leaf
+    (weight/bias/...), keep the first two components of what remains —
+    'blocks.3.attn.qkv.weight' -> 'blocks.3', 'fc2.bias' -> 'fc2'."""
+    parts = param_name.split(".")[:-1]
+    return ".".join(parts[:2]) if parts else "(root)"
+
+
+class HealthProbe:
+    """Builds the packed on-device stats vector for one parameter tree.
+
+    The vector layout is fixed at construction (group names are derived
+    from the FLAT param dict the TrainStep already holds), so
+    :meth:`stats_vec` is pure and traceable and :meth:`decode` needs no
+    device round-trips beyond the one fetch of the vector itself.
+
+    Layout: ``[loss, nonfinite_flag, grad_sq, update_sq, param_sq,
+    group_0_grad_sq, ..., group_{G-1}_grad_sq,
+    group_0_param_bad, ..., group_{G-1}_param_bad]`` — all float32.
+
+    The per-group PARAM nonfinite flags are what make first-bad-layer
+    attribution precise: once a loss goes NaN, backprop poisons every
+    layer's gradients in the same step, but the incoming (pre-update)
+    params are only bad in the group that actually went bad first.
+    """
+
+    N_FIXED = 5
+
+    def __init__(self, params: Dict[str, object],
+                 max_groups_: Optional[int] = None):
+        cap = max_groups_ if max_groups_ is not None else max_groups()
+        raw: Dict[str, List[str]] = {}
+        for name in params:
+            raw.setdefault(_group_name(name), []).append(name)
+        names = sorted(raw)
+        self._group_of: Dict[str, int] = {}
+        if len(names) > cap:
+            # bounded cardinality: hash-bucket the tree paths so the
+            # vector (and the gauge label set) never grows with model depth
+            self.group_names = [f"bucket{i:02d}" for i in range(cap)]
+            for gname, members in raw.items():
+                idx = zlib.crc32(gname.encode()) % cap
+                for m in members:
+                    self._group_of[m] = idx
+        else:
+            self.group_names = names
+            for i, gname in enumerate(names):
+                for m in raw[gname]:
+                    self._group_of[m] = i
+
+    def stats_vec(self, loss, grads, params, new_params):
+        """Traced: the packed float32 stats vector (see class docstring).
+        Every reduction is tiny next to the step's matmuls and fuses into
+        the same XLA program."""
+        f32 = jnp.float32
+        zero = jnp.zeros((), f32)
+        group_sq = [zero] * len(self.group_names)
+        grad_sq = zero
+        bad = jnp.zeros((), jnp.bool_)
+        for name, g in grads.items():
+            if not jnp.issubdtype(g.dtype, jnp.floating):
+                continue
+            s = jnp.sum(jnp.square(g.astype(f32)))
+            grad_sq = grad_sq + s
+            i = self._group_of.get(name)
+            if i is not None:
+                group_sq[i] = group_sq[i] + s
+            bad = bad | ~jnp.all(jnp.isfinite(g))
+        upd_sq = zero
+        par_sq = zero
+        group_bad = [jnp.zeros((), jnp.bool_)] * len(self.group_names)
+        for name, p in params.items():
+            q = new_params.get(name) if hasattr(new_params, "get") else None
+            if q is None or not jnp.issubdtype(
+                    jnp.asarray(p).dtype, jnp.floating):
+                continue
+            d = q.astype(f32) - p.astype(f32)
+            upd_sq = upd_sq + jnp.sum(jnp.square(d))
+            par_sq = par_sq + jnp.sum(jnp.square(p.astype(f32)))
+            i = self._group_of.get(name)
+            if i is not None:
+                group_bad[i] = group_bad[i] | ~jnp.all(jnp.isfinite(p))
+                bad = bad | group_bad[i]
+        loss32 = jnp.asarray(loss, f32).reshape(())
+        bad = bad | ~jnp.isfinite(loss32)
+        return jnp.stack([loss32, bad.astype(f32), grad_sq, upd_sq, par_sq]
+                         + group_sq + [b.astype(f32) for b in group_bad])
+
+    def decode(self, vec) -> dict:
+        """Host side: one fetched vector -> a stats dict. The fetch
+        (np.asarray) is the single device->host transfer of the tier."""
+        v = np.asarray(vec, dtype=np.float64)
+        nonfinite = bool(v[1] > 0) or not bool(np.all(np.isfinite(v)))
+        n_groups = len(self.group_names)
+        with np.errstate(invalid="ignore"):
+            grad_norm = float(np.sqrt(v[2]))
+            upd = float(np.sqrt(v[3]))
+            par = float(np.sqrt(v[4]))
+            groups = {name: float(np.sqrt(v[self.N_FIXED + i]))
+                      for i, name in enumerate(self.group_names)}
+        bad_params = [name for i, name in enumerate(self.group_names)
+                      if v[self.N_FIXED + n_groups + i] > 0]
+        return {
+            "loss": float(v[0]),
+            "nonfinite": nonfinite,
+            "grad_norm": grad_norm,
+            "param_norm": par,
+            "update_ratio": (upd / par) if par > 0 else upd,
+            "group_grad_norms": groups,
+            # groups whose incoming (pre-update) params held NaN/Inf —
+            # the first-bad-layer attribution (see class docstring)
+            "bad_param_groups": bad_params,
+        }
+
+
+# ---------------------------------------------------------------------------
+# module state: last sentinel stats / status / alerts (the /snapshot and
+# fleet-digest surface)
+# ---------------------------------------------------------------------------
+_state_lock = threading.Lock()
+_last_stats: Optional[dict] = None
+_status: Optional[str] = None          # ok | warn | diverged
+_alerts: "deque[dict]" = deque(maxlen=32)
+_rollback_count = 0
+_trip_active = False                   # sentinel currently tripped
+_last_attribution: Optional[dict] = None
+
+
+def _f(x) -> Optional[float]:
+    """Finite float or None — keeps NaN/Inf out of gauges, JSON payloads
+    and fleet digests."""
+    try:
+        x = float(x)
+    except (TypeError, ValueError):
+        return None
+    return x if math.isfinite(x) else None
+
+
+def record_step_stats(stats: dict, step: int,
+                      source: str = "sentinel") -> dict:
+    """Fold one decoded sentinel fetch into the health plane: gauges,
+    last-stats snapshot, status, and (on a nonfinite flag) the
+    ``tensor_health`` trip event. Returns the stored record. Never
+    raises — health telemetry must not take down training."""
+    global _last_stats, _status, _trip_active
+    rec = dict(stats)
+    rec["step"] = int(step)
+    rec["ts"] = time.time()
+    nonfinite = bool(rec.get("nonfinite"))
+    try:
+        if _metrics_mod.enabled():
+            for gauge, key in ((_M_LOSS, "loss"),
+                               (_M_GRAD_NORM, "grad_norm"),
+                               (_M_UPDATE_RATIO, "update_ratio")):
+                val = _f(rec.get(key))
+                if val is not None:
+                    gauge.set(val)
+            for gname, gv in (rec.get("group_grad_norms") or {}).items():
+                val = _f(gv)
+                if val is not None:
+                    _M_LAYER_GRAD.set(val, group=gname)
+    except Exception:
+        pass
+    with _state_lock:
+        _last_stats = rec
+        tripped_now = nonfinite and not _trip_active
+        _trip_active = nonfinite
+        _status = "diverged" if nonfinite else (
+            "ok" if _status != "warn" else _status)
+    if tripped_now:
+        # name the origin: groups whose pre-update PARAMS were bad (the
+        # layer that actually went bad first), else the groups whose grad
+        # norms came back nonfinite (loss/activation-level blowup — once
+        # the loss is NaN, backprop poisons every group the same step)
+        bad_groups = list(rec.get("bad_param_groups") or [])
+        if not bad_groups:
+            bad_groups = sorted(
+                g for g, v in (rec.get("group_grad_norms") or {}).items()
+                if _f(v) is None)
+        try:
+            if _metrics_mod.enabled():
+                _M_NONFINITE.inc(src=source)
+            _events_mod.emit(
+                "tensor_health", severity="error", src=source,
+                step=int(step), loss=_f(rec.get("loss")),
+                grad_norm=_f(rec.get("grad_norm")),
+                bad_groups=bad_groups)
+        except Exception:
+            pass
+    return rec
+
+
+def last_stats() -> Optional[dict]:
+    with _state_lock:
+        return dict(_last_stats) if _last_stats else None
+
+
+def last_status() -> Optional[str]:
+    with _state_lock:
+        return _status
+
+
+def set_status(status: str):
+    global _status
+    with _state_lock:
+        _status = status
+
+
+def tripped() -> bool:
+    """True while the newest sentinel fetch held NaN/Inf. The
+    FaultTolerantCheckpoint consults this to SKIP saves of known-bad
+    state — a CRC-valid checkpoint of NaN weights would poison the very
+    rollback path that is supposed to recover from it."""
+    with _state_lock:
+        return _trip_active
+
+
+def clear_trip():
+    """Re-arm the sentinel trip (after a rollback restored good state)."""
+    global _trip_active
+    with _state_lock:
+        _trip_active = False
+
+
+def note_alert(rec: dict):
+    with _state_lock:
+        _alerts.append(rec)
+
+
+def note_rollback():
+    global _rollback_count
+    with _state_lock:
+        _rollback_count += 1
+
+
+def _json_safe(obj):
+    """Recursively replace nonfinite floats with None — a tripped
+    sentinel's raw stats hold NaN, and NaN in /snapshot would break
+    strict-JSON consumers (jq, browsers)."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
+def snapshot() -> dict:
+    """The /snapshot ``health`` section."""
+    with _state_lock:
+        return {
+            "enabled": enabled(),
+            "eager_check": bool(_ATTRIBUTION_ARMED),
+            "interval": interval(),
+            "action": action(),
+            "status": _status,
+            "tripped": _trip_active,
+            "last": _json_safe(dict(_last_stats)) if _last_stats else None,
+            "last_attribution": (dict(_last_attribution)
+                                 if _last_attribution else None),
+            "alerts_tail": [_json_safe(dict(a))
+                            for a in list(_alerts)[-10:]],
+            "rollbacks": _rollback_count,
+        }
+
+
+def reset():
+    """Test hook: clear all module state (metrics families stay)."""
+    global _last_stats, _status, _rollback_count, _trip_active
+    global _last_attribution
+    with _state_lock:
+        _last_stats = None
+        _status = None
+        _rollback_count = 0
+        _trip_active = False
+        _last_attribution = None
+        _alerts.clear()
+
+
+# ---------------------------------------------------------------------------
+# tier 2: eager first-NaN attribution (layer stack + dispatch hook + replay)
+# ---------------------------------------------------------------------------
+# Fast gate read directly by nn.layer.Layer.__call__ (one module-attr test
+# per layer call while armed; zero extra work otherwise). Armed while
+# FLAGS_check_nan_inf is on, or for the duration of an eager_replay.
+_ATTRIBUTION_ARMED = False
+_tls = threading.local()
+
+# id(layer) -> dotted path, for every model registered via index_model
+_layer_index: Dict[int, str] = {}
+
+
+def set_eager_check(on: bool):
+    """Called by framework.flags when FLAGS_check_nan_inf changes: arms
+    the layer-path stack the dispatch post-check attributes against."""
+    global _ATTRIBUTION_ARMED
+    _ATTRIBUTION_ARMED = bool(on)
+
+
+def push_layer(layer):
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(layer)
+
+
+def pop_layer():
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        stack.pop()
+
+
+def index_model(root) -> Dict[int, str]:
+    """Map every sublayer of `root` to its dotted path so attribution can
+    name real parameter-tree locations instead of class names."""
+    idx = {id(root): "(root)"}
+    try:
+        for name, sub in root.named_sublayers(include_self=False):
+            idx[id(sub)] = name
+    except Exception:
+        pass
+    _layer_index.update(idx)
+    return idx
+
+
+def current_layer_path() -> Optional[str]:
+    """Innermost indexed layer on this thread's call stack; falls back to
+    the class-name chain when no model was indexed."""
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return None
+    for layer in reversed(stack):
+        path = _layer_index.get(id(layer))
+        if path is not None:
+            return path
+    return "/".join(type(l).__name__ for l in stack)
+
+
+def note_bad_tensor(op: str, output_index: int, shape, dtype: str,
+                    kind: str) -> dict:
+    """Called by the dispatch post-check on the FIRST bad op output: emit
+    the `tensor_health` attribution event naming op + layer path +
+    shape/dtype + bad-value kind. Returns the record."""
+    global _last_attribution
+    rec = {
+        "src": "eager",
+        "op": op,
+        "layer": current_layer_path(),
+        "output_index": int(output_index),
+        "shape": list(shape),
+        "dtype": str(dtype),
+        "bad_kind": kind,
+    }
+    with _state_lock:
+        _last_attribution = rec
+    try:
+        if _metrics_mod.enabled():
+            _M_NONFINITE.inc(src="eager")
+        _events_mod.emit("tensor_health", severity="error", **rec)
+    except Exception:
+        pass
+    return rec
+
+
+def eager_replay(layer, loss_fn: Callable, arrs) -> Optional[dict]:
+    """One-shot compiled-step attribution: re-run the last batch's
+    forward + loss EAGERLY with the per-op NaN check armed. The dispatch
+    post-check raises on (and attributes) the first bad op output; the
+    exception is swallowed here — this is diagnosis, not control flow.
+    Returns the attribution record, or None if the eager pass stayed
+    clean (e.g. only the optimizer update was bad)."""
+    global _last_attribution
+    from ..framework import tape as tape_mod
+    from ..framework.tensor import Tensor
+    flag = _flags_mod._REGISTRY["FLAGS_check_nan_inf"]
+    prev_flag, prev_armed = flag.value, _ATTRIBUTION_ARMED
+    index_model(layer)
+    with _state_lock:
+        _last_attribution = None
+    flag.value = True
+    set_eager_check(True)
+    try:
+        inputs = [Tensor(a) for a in arrs[:-1]]
+        label = Tensor(arrs[-1])
+        with tape_mod.no_grad():
+            out = layer(*inputs)
+            loss_fn(out, label)
+    except FloatingPointError:
+        pass  # note_bad_tensor already recorded the attribution
+    except Exception:
+        pass  # replay is best-effort; never take down the train loop
+    finally:
+        flag.value = prev_flag
+        set_eager_check(prev_armed)
+    with _state_lock:
+        return dict(_last_attribution) if _last_attribution else None
+
+
+# arm the eager-attribution stack if the flag was set via environment
+# before this module loaded (flags.py forwards later runtime changes)
+try:
+    set_eager_check(bool(_flags_mod.flag("FLAGS_check_nan_inf")))
+except Exception:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# tier 3: trend detection + auto-response
+# ---------------------------------------------------------------------------
+def _blob_finite(blob) -> bool:
+    """True when every floating network param in a checkpoint blob is
+    finite (one host-side pass; rollback-path only, never per step)."""
+    try:
+        net = blob.get("network") if isinstance(blob, dict) else None
+        if not isinstance(net, dict):
+            return True  # unknown shape: nothing to judge, accept
+        for v in net.values():
+            a = np.asarray(getattr(v, "data", v))
+            if a.dtype.kind == "f":
+                pass
+            elif "float" in str(a.dtype):  # bfloat16/float8 via ml_dtypes
+                a = a.astype(np.float32)
+            else:
+                continue
+            if not np.all(np.isfinite(a)):
+                return False
+        return True
+    except Exception:
+        return True
+
+
+class HealthMonitor:
+    """hapi callback (duck-typed like ThroughputMonitor): loss-spike /
+    divergence / grad-explosion / vanishing / stagnation detection over
+    the sentinel stats (or, without a sentinel, the per-batch loss logs),
+    with the configured auto-response on confirmed divergence.
+
+    Usage::
+
+        model.fit(..., callbacks=[
+            FaultTolerantCheckpoint(dirname, save_freq_steps=50),
+            HealthMonitor(action="rollback", checkpoint=dirname)])
+
+    Detection:
+      * nonfinite loss/grads (sentinel trip or a NaN/Inf loss log) —
+        immediately CONFIRMED divergence;
+      * loss spike: EWMA mean/variance z-score above ``z_threshold`` for
+        ``confirm_steps`` consecutive steps — CONFIRMED divergence;
+      * grad explosion (norm > ``explode_factor`` x its EWMA), vanishing
+        (norm < ``vanish_threshold`` for ``vanish_steps``), stagnation
+        (relative EWMA loss change < ``stagnation_rel`` over
+        ``stagnation_steps``) — warn-level alerts only.
+
+    Response (``action``, default from ``PADDLE_TPU_HEALTH_ACTION``):
+      * ``warn``     — the ``health_alert`` event only;
+      * ``halt``     — set ``model.stop_training`` (fit stops at the next
+        batch boundary);
+      * ``rollback`` — restore the last VALID checkpoint (model +
+        optimizer + compiled-step slots + RNG) through `checkpoint` (a
+        ``FaultTolerantCheckpoint`` callback, a ``CheckpointManager``, or
+        a directory path), count ``health_rollback_total``, and keep
+        training. The restore is bit-identical to a fresh
+        ``fit(resume=)`` from the same file. ``cooldown_steps`` suppresses
+        re-detection while the EWMA re-converges; after ``max_rollbacks``
+        the monitor degrades to halt (a model that keeps diverging from
+        the same checkpoint will not be saved by another restore).
+    """
+
+    def __init__(self, action: Optional[str] = None, window: int = 50,
+                 z_threshold: float = 6.0, confirm_steps: int = 3,
+                 explode_factor: float = 1000.0,
+                 vanish_threshold: float = 1e-10, vanish_steps: int = 20,
+                 stagnation_steps: int = 0, stagnation_rel: float = 1e-4,
+                 checkpoint=None, cooldown_steps: int = 50,
+                 max_rollbacks: int = 3):
+        self.action = (action or globals()["action"]()).lower()
+        if self.action not in ("warn", "halt", "rollback"):
+            raise ValueError(f"unknown health action {self.action!r} "
+                             f"(expected warn | halt | rollback)")
+        self.window = max(int(window), 2)
+        self.z_threshold = float(z_threshold)
+        self.confirm_steps = max(int(confirm_steps), 1)
+        self.explode_factor = float(explode_factor)
+        self.vanish_threshold = float(vanish_threshold)
+        self.vanish_steps = max(int(vanish_steps), 1)
+        self.stagnation_steps = int(stagnation_steps)  # 0 = disabled
+        self.stagnation_rel = float(stagnation_rel)
+        self.checkpoint = checkpoint
+        self.cooldown_steps = max(int(cooldown_steps), 0)
+        self.max_rollbacks = max(int(max_rollbacks), 0)
+        self.model = None
+        self.params = {}
+        self.alerts: List[dict] = []
+        self.rollbacks = 0
+        self._reset_detectors()
+        self._global_step = 0
+        self._last_seen_stats_ts = None
+        self._cooldown_until = -1
+
+    # -- hapi protocol -------------------------------------------------------
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+        net = getattr(model, "network", model)
+        try:
+            index_model(net)
+        except Exception:
+            pass
+
+    def _reset_detectors(self):
+        self._ewma_loss = None
+        self._ewma_var = 0.0
+        self._ewma_grad = None
+        self._n_obs = 0  # losses observed since the last (re)baseline
+        self._spike_streak = 0
+        self._vanish_streak = 0
+        self._stagnation_anchor = None  # (step, ewma_loss)
+
+    def on_train_begin(self, logs=None):
+        self._global_step = 0
+        self._reset_detectors()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        stats = last_stats()
+        fresh = (stats is not None
+                 and stats.get("ts") != self._last_seen_stats_ts)
+        if fresh:
+            self._last_seen_stats_ts = stats.get("ts")
+        loss = None
+        grad_norm = None
+        nonfinite = False
+        if fresh:
+            loss = stats.get("loss")
+            grad_norm = _f(stats.get("grad_norm"))
+            nonfinite = bool(stats.get("nonfinite"))
+        elif isinstance(logs, dict) and logs.get("loss") is not None:
+            try:
+                loss = float(np.asarray(logs["loss"]).ravel()[0])
+            except Exception:
+                loss = None
+        self.observe(loss=loss, grad_norm=grad_norm, nonfinite=nonfinite,
+                     step=self._global_step)
+
+    # unused hooks (hapi CallbackList calls them all)
+    def on_train_end(self, logs=None): pass
+    def on_epoch_begin(self, epoch, logs=None): pass
+    def on_epoch_end(self, epoch, logs=None): pass
+    def on_eval_begin(self, logs=None): pass
+    def on_eval_end(self, logs=None): pass
+    def on_predict_begin(self, logs=None): pass
+    def on_predict_end(self, logs=None): pass
+    def on_train_batch_begin(self, step, logs=None): pass
+    def on_eval_batch_begin(self, step, logs=None): pass
+    def on_eval_batch_end(self, step, logs=None): pass
+    def on_predict_batch_begin(self, step, logs=None): pass
+    def on_predict_batch_end(self, step, logs=None): pass
+
+    # -- detection -----------------------------------------------------------
+    def observe(self, loss: Optional[float] = None,
+                grad_norm: Optional[float] = None,
+                nonfinite: bool = False, step: Optional[int] = None):
+        """Feed one step's signals (also the manual-loop entry point).
+        Runs the detectors and, on confirmed divergence, the response."""
+        if step is None:
+            self._global_step += 1
+            step = self._global_step
+        else:
+            self._global_step = int(step)
+        if step <= self._cooldown_until:
+            return
+        warned = False
+        if loss is not None:
+            try:
+                loss = float(loss)
+            except (TypeError, ValueError):
+                loss = None
+            else:
+                if not math.isfinite(loss):
+                    nonfinite = True
+        if nonfinite:
+            self._alert("nonfinite", step, severity="error",
+                        loss=_f(loss), grad_norm=_f(grad_norm))
+            self._respond("nonfinite", step)
+            self._after_response(step)
+            return
+        if loss is not None and math.isfinite(loss):
+            warned |= self._observe_loss(float(loss), step)
+        if grad_norm is not None and math.isfinite(grad_norm):
+            warned |= self._observe_grad(float(grad_norm), step)
+        if not warned and not tripped() and \
+                last_status() in ("warn", "diverged"):
+            # a clean step re-arms the fleet's transition detector; a
+            # logs-only monitor (no sentinel) would otherwise report
+            # 'diverged' forever after one confirmed spike. While the
+            # sentinel IS tripped it stays authoritative.
+            set_status("ok")
+
+    def _observe_loss(self, loss: float, step: int) -> bool:
+        alpha = 2.0 / (self.window + 1.0)
+        warned = False
+        self._n_obs += 1
+        if self._ewma_loss is None:
+            self._ewma_loss = loss
+            self._ewma_var = 0.0
+        else:
+            dev = loss - self._ewma_loss
+            # std floor is RELATIVE to the loss level (plus an absolute
+            # epsilon): a near-constant warmup loss would otherwise give
+            # std ~ 1e-6 and any normal noise a five-digit z-score. The
+            # warmup gate counts losses OBSERVED since (re)baseline, not
+            # the caller's absolute step number — manual loops hand in
+            # mid-run counters
+            std = max(math.sqrt(max(self._ewma_var, 0.0)),
+                      1e-3 * abs(self._ewma_loss), 1e-9)
+            z = dev / std
+            if z > self.z_threshold and self._n_obs > self.window // 2:
+                self._spike_streak += 1
+                if self._spike_streak >= self.confirm_steps:
+                    self._alert("loss_spike", step, severity="error",
+                                loss=loss, z=round(z, 2),
+                                ewma=round(self._ewma_loss, 6))
+                    self._respond("loss_spike", step)
+                    self._after_response(step)
+                    return True
+                warned = True
+                self._alert("loss_spike_suspect", step, severity="warn",
+                            loss=loss, z=round(z, 2),
+                            streak=self._spike_streak)
+                # do NOT fold a suspected outlier into the EWMA baseline:
+                # a diverging loss would inflate the variance enough to
+                # hide its own successors from the z-test and the streak
+                # would never confirm
+            else:
+                self._spike_streak = 0
+                self._ewma_var = (1 - alpha) * (
+                    self._ewma_var + alpha * dev * dev)
+                self._ewma_loss += alpha * dev
+        # stagnation: relative EWMA movement below threshold over a window
+        if self.stagnation_steps > 0:
+            if self._stagnation_anchor is None:
+                self._stagnation_anchor = (step, self._ewma_loss)
+            else:
+                a_step, a_loss = self._stagnation_anchor
+                if step - a_step >= self.stagnation_steps:
+                    denom = max(abs(a_loss), 1e-12)
+                    if abs(self._ewma_loss - a_loss) / denom < \
+                            self.stagnation_rel:
+                        warned = True
+                        self._alert("stagnation", step, severity="warn",
+                                    ewma=round(self._ewma_loss, 6),
+                                    over_steps=step - a_step)
+                    self._stagnation_anchor = (step, self._ewma_loss)
+        return warned
+
+    def _observe_grad(self, norm: float, step: int) -> bool:
+        warned = False
+        if self._ewma_grad is not None and self._ewma_grad > 0 and \
+                norm > self.explode_factor * self._ewma_grad:
+            warned = True
+            self._alert("grad_explosion", step, severity="warn",
+                        grad_norm=norm,
+                        ewma=round(self._ewma_grad, 9))
+        if norm < self.vanish_threshold:
+            self._vanish_streak += 1
+            if self._vanish_streak == self.vanish_steps:
+                warned = True
+                self._alert("grad_vanishing", step, severity="warn",
+                            grad_norm=norm, streak=self._vanish_streak)
+        else:
+            self._vanish_streak = 0
+        alpha = 2.0 / (self.window + 1.0)
+        self._ewma_grad = norm if self._ewma_grad is None else \
+            (1 - alpha) * self._ewma_grad + alpha * norm
+        return warned
+
+    def _alert(self, signal: str, step: int, severity: str = "warn",
+               **payload):
+        rec = {"signal": signal, "step": int(step), "severity": severity}
+        rec.update(payload)
+        self.alerts.append(rec)
+        note_alert(rec)
+        if severity == "error":
+            set_status("diverged")
+        elif last_status() != "diverged":
+            set_status("warn")
+        try:
+            if _metrics_mod.enabled():
+                _M_ALERTS.inc(signal=signal)
+            _events_mod.emit("health_alert", severity=severity, **rec)
+        except Exception:
+            pass
+
+    def _after_response(self, step: int):
+        """Re-baseline after ANY confirmed response: with action=warn a
+        loss that legitimately shifted to a higher plateau would
+        otherwise re-confirm against the frozen EWMA and emit one
+        severity=error alert per step for the rest of the run. The
+        detectors re-learn from the post-response level and the cooldown
+        window suppresses re-detection meanwhile (rollback sets its own
+        cooldown too — max keeps the longer one)."""
+        self._reset_detectors()
+        self._cooldown_until = max(self._cooldown_until,
+                                   step + self.cooldown_steps)
+
+    # -- response ------------------------------------------------------------
+    def _respond(self, reason: str, step: int):
+        if self.action == "halt":
+            self._halt(reason, step)
+        elif self.action == "rollback":
+            self._rollback(reason, step)
+        # warn: the alert event above is the whole response
+
+    def _halt(self, reason: str, step: int):
+        if self.model is not None:
+            try:
+                self.model.stop_training = True
+            except Exception:
+                pass
+        _events_mod.emit("health_alert", severity="error", signal="halt",
+                         reason=reason, step=int(step))
+
+    def _resolve_manager(self):
+        ckpt = self.checkpoint
+        if ckpt is None:
+            return None
+        from ..distributed.checkpoint import CheckpointManager, open_manager
+        if isinstance(ckpt, CheckpointManager):
+            return ckpt
+        if hasattr(ckpt, "manager"):  # FaultTolerantCheckpoint callback
+            return ckpt.manager
+        return open_manager(str(ckpt))
+
+    def _load_numerically_valid(self, mgr, step: int):
+        """(blob, ckpt_step) of the newest checkpoint whose NETWORK params
+        are all finite, walking back past newer files that captured
+        already-poisoned state (detection lags the first bad step by up to
+        one sentinel interval, so a save can legally race it)."""
+        found = mgr.load_latest()
+        if found is None:
+            return None
+        blob, ckpt_step = found
+        if _blob_finite(blob):
+            return blob, ckpt_step
+        self._alert("rollback_skip_nonfinite", step, severity="warn",
+                    skipped_step=int(ckpt_step))
+        try:
+            older = sorted((s for s in mgr.steps() if s < ckpt_step),
+                           reverse=True)
+        except Exception:
+            return None
+        from ..distributed.checkpoint import load as _load_ckpt
+        for s in older:
+            try:
+                path = mgr.path_for(s)
+                if os.path.isdir(path):
+                    # sharded/chunked layout: a step is a DIRECTORY of
+                    # chunk files + manifests, not one CRC'd blob
+                    from ..distributed.sharded_checkpoint import load_step
+                    blob2 = load_step(path, mesh=getattr(mgr, "mesh", None))
+                else:
+                    blob2 = _load_ckpt(path)
+            except Exception:
+                continue
+            if _blob_finite(blob2):
+                return blob2, s
+            self._alert("rollback_skip_nonfinite", step, severity="warn",
+                        skipped_step=int(s))
+        return None
+
+    def _rollback(self, reason: str, step: int):
+        """Restore the last numerically-valid checkpoint into the live
+        model — exactly what a fresh fit(resume=) would load — and keep
+        training. Degrades to halt when no checkpoint is reachable or the
+        rollback budget is spent."""
+        if self.max_rollbacks and self.rollbacks >= self.max_rollbacks:
+            self._alert("rollback_budget_exhausted", step, severity="error",
+                        rollbacks=self.rollbacks)
+            self._halt(reason, step)
+            return
+        try:
+            mgr = self._resolve_manager()
+            found = self._load_numerically_valid(mgr, step) \
+                if mgr is not None else None
+        except Exception as e:
+            found = None
+            self._alert("rollback_failed", step, severity="error",
+                        error=f"{type(e).__name__}: {e}")
+        if found is None:
+            self._alert("rollback_unavailable", step, severity="error",
+                        reason=reason)
+            self._halt(reason, step)
+            return
+        blob, ckpt_step = found
+        m = self.model
+        if m is None or not isinstance(blob, dict) or "network" not in blob:
+            # manual-loop monitor with no set_model(), or a blob that is
+            # not a FaultTolerantCheckpoint capture: nothing to restore
+            # INTO — degrade to halt instead of raising out of observe()
+            # (the health plane must never take down training)
+            self._alert("rollback_failed", step, severity="error",
+                        error="no model attached" if m is None
+                        else "checkpoint blob has no 'network' state")
+            self._halt(reason, step)
+            return
+        try:
+            m.network.set_state_dict(blob["network"])
+            if blob.get("optimizer") is not None and \
+                    getattr(m, "_optimizer", None) is not None:
+                m._optimizer.set_state_dict(blob["optimizer"])
+            # the compiled step is rebuilt from the restored network on
+            # the next batch, with its slot state applied then (same path
+            # as Model._restore_for_resume)
+            m._pending_ts_state = blob.get("train_step")
+            m._train_step = None
+            if blob.get("rng") is not None:
+                from ..framework.random import set_rng_state
+                set_rng_state(np.asarray(blob["rng"]))
+        except Exception as e:
+            self._alert("rollback_failed", step, severity="error",
+                        error=f"{type(e).__name__}: {e}")
+            self._halt(reason, step)
+            return
+        self.rollbacks += 1
+        note_rollback()
+        clear_trip()
+        set_status("ok")
+        self._reset_detectors()
+        self._cooldown_until = step + self.cooldown_steps
+        try:
+            if _metrics_mod.enabled():
+                _M_ROLLBACK.inc()
+            _events_mod.emit("health_rollback", severity="warn",
+                             reason=reason, step=int(step),
+                             restored_step=int(ckpt_step),
+                             rollbacks=self.rollbacks)
+        except Exception:
+            pass

@@ -46,6 +46,7 @@ from urllib.parse import parse_qs, urlparse
 
 from . import compile_watch as _compile_watch
 from . import events as _events_mod
+from . import health as _health_mod
 from . import metrics as _metrics_mod
 from . import xplane as _xplane_mod
 from .watchdog import get_watchdog
@@ -143,6 +144,7 @@ class ObservabilityServer:
             "watchdog": get_watchdog().snapshot(),
             "compile_attribution": _compile_watch.summary(),
             "liveness": liveness(self.stall_after),
+            "health": _health_mod.snapshot(),
             "events_tail": _events_mod.recent(50),
             "ts": time.time(),
         }

@@ -1,0 +1,791 @@
+"""Training-health numerics plane (profiler/health.py): in-graph
+sentinel, eager first-NaN attribution, trend detection, divergence
+auto-response.
+
+Acceptance contract (ISSUE 10): with the health plane armed, a NaN
+injected into a named layer mid-run is (a) detected by the in-graph
+sentinel within the fetch interval, (b) attributed to that layer in a
+`tensor_health` event, and (c) `action=rollback` resumes from the last
+numerically-valid checkpoint bit-identically.
+
+fast-sibling: every slow test here has fast siblings throughout this
+module (sentinel, attribution, rollback e2e all run in tier-1).
+"""
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.hapi.callbacks import (Callback, FaultTolerantCheckpoint,
+                                       HealthMonitor)
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.nn import functional as F
+from paddle_tpu.profiler import events as events_mod
+from paddle_tpu.profiler import health
+from paddle_tpu.profiler import metrics as metrics_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_health_state():
+    health.reset()
+    yield
+    health.reset()
+
+
+class MLP(nn.Layer):
+    def __init__(self, din=8, hidden=16, dout=4):
+        super().__init__()
+        self.fc1 = nn.Linear(din, hidden)
+        self.fc2 = nn.Linear(hidden, dout)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _mlp_step(health_on=True, lr=1e-2):
+    paddle.seed(7)
+    m = MLP()
+    opt = optimizer.Adam(learning_rate=lr, parameters=m.parameters())
+    step = TrainStep(m, F.cross_entropy, opt, health=health_on)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.array([0, 1, 2, 3], dtype="int64"))
+    return m, step, x, y
+
+
+class TestHealthProbe:
+    def test_grouping_drops_leaf_and_caps_depth(self):
+        assert health._group_name("blocks.3.attn.qkv.weight") == "blocks.3"
+        assert health._group_name("fc2.bias") == "fc2"
+        assert health._group_name("weight") == "(root)"
+
+    def test_bounded_cardinality(self):
+        params = {f"layer{i}.weight": jnp.zeros((2,)) for i in range(100)}
+        probe = health.HealthProbe(params, max_groups_=8)
+        assert len(probe.group_names) == 8
+        assert all(g.startswith("bucket") for g in probe.group_names)
+        # every param maps into a bucket
+        assert set(probe._group_of) == set(params)
+
+    def test_stats_vec_decode_roundtrip(self):
+        params = {"fc1.weight": jnp.ones((3, 2)), "fc2.weight": jnp.ones((2,))}
+        grads = {"fc1.weight": jnp.full((3, 2), 2.0),
+                 "fc2.weight": jnp.full((2,), 3.0)}
+        new_params = {k: v - 0.5 for k, v in params.items()}
+        probe = health.HealthProbe(params)
+        stats = probe.decode(probe.stats_vec(
+            jnp.asarray(1.25), grads, params, new_params))
+        assert stats["loss"] == pytest.approx(1.25)
+        assert not stats["nonfinite"]
+        assert stats["grad_norm"] == pytest.approx(
+            math.sqrt(6 * 4.0 + 2 * 9.0))
+        assert stats["group_grad_norms"]["fc1"] == pytest.approx(
+            math.sqrt(24.0))
+        assert stats["group_grad_norms"]["fc2"] == pytest.approx(
+            math.sqrt(18.0))
+        # update ratio: ||0.5 * ones(8)|| / ||ones(8)||
+        assert stats["update_ratio"] == pytest.approx(0.5)
+        assert stats["bad_param_groups"] == []
+
+    def test_nonfinite_flag_and_bad_param_group(self):
+        params = {"fc1.weight": jnp.ones((2,)),
+                  "fc2.weight": jnp.asarray([jnp.nan, 1.0])}
+        grads = {k: jnp.zeros_like(v) for k, v in params.items()}
+        probe = health.HealthProbe(params)
+        stats = probe.decode(probe.stats_vec(
+            jnp.asarray(0.5), grads, params, params))
+        assert stats["nonfinite"]
+        assert stats["bad_param_groups"] == ["fc2"]
+
+    def test_nan_loss_trips_flag(self):
+        params = {"w": jnp.ones((2,))}
+        grads = {"w": jnp.zeros((2,))}
+        probe = health.HealthProbe(params)
+        stats = probe.decode(probe.stats_vec(
+            jnp.asarray(jnp.nan), grads, params, params))
+        assert stats["nonfinite"]
+
+
+class TestTrainStepSentinel:
+    def test_healthy_steps_record_stats(self):
+        _, step, x, y = _mlp_step()
+        for _ in range(2):
+            step(x, y)
+        stats = health.last_stats()
+        assert stats is not None and stats["step"] == 2
+        assert not stats["nonfinite"]
+        assert stats["grad_norm"] > 0
+        assert set(stats["group_grad_norms"]) == {"fc1", "fc2"}
+        assert health.last_status() == "ok"
+        # gauges live
+        reg = metrics_mod.default_registry()
+        assert reg.get("health_grad_norm").value() > 0
+
+    def test_health_off_returns_plain_tuple(self):
+        _, step, x, y = _mlp_step(health_on=False)
+        step(x, y)
+        assert step.last_health is None
+        assert health.last_stats() is None
+
+    def test_interval_bounds_fetch_cadence(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_HEALTH_INTERVAL", "3")
+        _, step, x, y = _mlp_step()
+        for _ in range(5):
+            step(x, y)
+        # fetched at steps 3 only within 1..5 (6 would be next)
+        assert health.last_stats()["step"] == 3
+
+    def test_injected_nan_attributed_to_layer(self):
+        """Acceptance (a)+(b): poison fc2's weight -> the sentinel trips
+        on the next step and the tensor_health event names fc2."""
+        _, step, x, y = _mlp_step()
+        for _ in range(2):
+            step(x, y)
+        events_mod.default_event_log().clear()
+        step.params["fc2.weight"] = \
+            step.params["fc2.weight"].at[0, 0].set(jnp.nan)
+        step(x, y)
+        assert step.last_health["nonfinite"]
+        assert health.tripped()
+        sentinel = [e for e in events_mod.recent(20, kind="tensor_health")
+                    if e.get("src") == "sentinel"]
+        assert len(sentinel) == 1
+        assert sentinel[0]["bad_groups"] == ["fc2"]
+        assert sentinel[0]["severity"] == "error"
+        # the one-shot eager replay produced an op-level attribution too
+        assert step.last_attribution is not None
+        assert step.last_attribution["bad_kind"] == "nan"
+        # nonfinite counter incremented for the sentinel source
+        reg = metrics_mod.default_registry()
+        assert reg.get("health_nonfinite_total").value(src="sentinel") >= 1
+
+    def test_replay_runs_once_per_trip(self):
+        _, step, x, y = _mlp_step()
+        step(x, y)
+        events_mod.default_event_log().clear()
+        step.params["fc1.weight"] = \
+            step.params["fc1.weight"].at[0, 0].set(jnp.inf)
+        step(x, y)
+        step(x, y)  # still bad: no second replay, no second trip event
+        sentinel = [e for e in events_mod.recent(50, kind="tensor_health")
+                    if e.get("src") == "sentinel"]
+        eager = [e for e in events_mod.recent(50, kind="tensor_health")
+                 if e.get("src") == "eager"]
+        assert len(sentinel) == 1
+        assert len(eager) == 1
+
+
+class TestEagerCheckFlag:
+    """FLAGS_check_nan_inf routes to the health plane; jax_debug_nans is
+    the explicit FLAGS_debug_nans / PADDLE_TPU_DEBUG_NANS escape hatch."""
+
+    def test_runtime_set_flags_arms_dispatch_check(self):
+        import jax
+        prev_debug = jax.config.jax_debug_nans
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            events_mod.default_event_log().clear()
+            a = paddle.to_tensor(np.array([1.0], np.float32))
+            b = paddle.to_tensor(np.array([0.0], np.float32))
+            with pytest.raises(FloatingPointError) as ei:
+                a / b
+            assert "inf" in str(ei.value)
+            ev = events_mod.recent(10, kind="tensor_health")
+            assert ev and ev[-1]["src"] == "eager"
+            assert ev[-1]["bad_kind"] == "inf"
+            assert ev[-1]["op"]
+            # the flag no longer touches jax_debug_nans
+            assert jax.config.jax_debug_nans == prev_debug
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_eager_attribution_names_layer_path(self):
+        paddle.seed(0)
+        net = MLP()
+        net.fc2.weight.data = net.fc2.weight.data.at[0, 0].set(jnp.nan)
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            health.index_model(net)
+            x = paddle.to_tensor(np.ones((2, 8), np.float32))
+            with pytest.raises(FloatingPointError) as ei:
+                net(x)
+            assert "fc2" in str(ei.value)
+            ev = events_mod.recent(10, kind="tensor_health")[-1]
+            assert ev["layer"] == "fc2"
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_debug_nans_escape_hatch(self):
+        import jax
+        prev = jax.config.jax_debug_nans
+        try:
+            paddle.set_flags({"FLAGS_debug_nans": True})
+            assert jax.config.jax_debug_nans is True
+            paddle.set_flags({"FLAGS_debug_nans": False})
+            assert jax.config.jax_debug_nans is False
+        finally:
+            jax.config.update("jax_debug_nans", prev)
+
+    def test_health_enabled_follows_flag(self):
+        assert not health.enabled()
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            assert health.enabled()
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestHealthMonitor:
+    def _monitor(self, **kw):
+        kw.setdefault("action", "warn")
+        kw.setdefault("window", 10)
+        return HealthMonitor(**kw)
+
+    def test_loss_spike_confirmed_after_streak(self):
+        hm = self._monitor(confirm_steps=3, z_threshold=4.0)
+        for i in range(20):
+            hm.observe(loss=1.0 + 0.01 * (i % 3))
+        for i in range(3):
+            hm.observe(loss=100.0 * (i + 1))
+        sigs = [a["signal"] for a in hm.alerts]
+        assert "loss_spike_suspect" in sigs
+        assert "loss_spike" in sigs
+        assert health.last_status() == "diverged"
+
+    def test_single_outlier_not_confirmed(self):
+        hm = self._monitor(confirm_steps=3, z_threshold=4.0)
+        for i in range(20):
+            hm.observe(loss=1.0 + 0.01 * (i % 3))
+        hm.observe(loss=100.0)
+        for _ in range(5):
+            hm.observe(loss=1.0)
+        assert "loss_spike" not in [a["signal"] for a in hm.alerts]
+
+    def test_nonfinite_is_immediate(self):
+        hm = self._monitor()
+        hm.observe(loss=float("nan"), nonfinite=False)  # detected from loss
+        assert hm.alerts and hm.alerts[0]["signal"] == "nonfinite"
+
+    def test_halt_sets_stop_training(self):
+        hm = self._monitor(action="halt")
+
+        class M:
+            stop_training = False
+        hm.model = M()
+        hm.observe(nonfinite=True)
+        assert hm.model.stop_training
+
+    def test_grad_explosion_and_vanishing_warn(self):
+        hm = self._monitor(explode_factor=10.0, vanish_steps=3,
+                           vanish_threshold=1e-8)
+        for _ in range(10):
+            hm.observe(loss=1.0, grad_norm=1.0)
+        hm.observe(loss=1.0, grad_norm=500.0)
+        assert "grad_explosion" in [a["signal"] for a in hm.alerts]
+        for _ in range(3):
+            hm.observe(loss=1.0, grad_norm=0.0)
+        assert "grad_vanishing" in [a["signal"] for a in hm.alerts]
+        # warn-level signals never run the response
+        assert health.last_status() in ("warn", "ok")
+
+    def test_stagnation_alert(self):
+        hm = self._monitor(stagnation_steps=10, stagnation_rel=1e-3)
+        for _ in range(25):
+            hm.observe(loss=1.0)
+        assert "stagnation" in [a["signal"] for a in hm.alerts]
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            HealthMonitor(action="explode")
+
+    def test_confirmed_spike_rebaselines_not_floods(self):
+        """A legitimate plateau shift under action=warn: ONE confirmed
+        loss_spike, then the detectors re-learn the new level instead of
+        re-confirming (and emitting an error alert) every step."""
+        hm = self._monitor(confirm_steps=2, z_threshold=4.0,
+                           cooldown_steps=5)
+        for i in range(20):
+            hm.observe(loss=1.0 + 0.01 * (i % 3))
+        for _ in range(30):  # loss moved to a new, stable plateau
+            hm.observe(loss=50.0)
+        confirmed = [a for a in hm.alerts if a["signal"] == "loss_spike"]
+        assert len(confirmed) == 1
+
+    def test_persistent_nonfinite_respects_cooldown(self):
+        hm = self._monitor(cooldown_steps=10)
+        for _ in range(12):
+            hm.observe(nonfinite=True)
+        nf = [a for a in hm.alerts if a["signal"] == "nonfinite"]
+        assert len(nf) == 2  # once per cooldown window, not per step
+
+    def test_midrun_step_numbers_need_warmup_observations(self):
+        """The z-test warmup gate counts OBSERVED losses, not the
+        caller's absolute step number: a manual loop feeding mid-run
+        step counters must not confirm a spurious divergence on its
+        first few observations."""
+        hm = self._monitor(action="halt", confirm_steps=3, z_threshold=6.0)
+
+        class M:
+            stop_training = False
+        hm.model = M()
+        for i in range(5):  # normal noise at big step numbers
+            hm.observe(loss=1.0 + 0.01 * (i % 2), step=1000 + i)
+        assert not hm.model.stop_training
+        assert "loss_spike" not in [a["signal"] for a in hm.alerts]
+
+    def test_constant_warmup_loss_tolerates_noise(self):
+        """Near-zero variance must not turn normal noise into a
+        five-digit z-score (relative std floor)."""
+        hm = self._monitor(confirm_steps=3, z_threshold=6.0)
+        for _ in range(20):
+            hm.observe(loss=2.0)       # constant: var == 0
+        for _ in range(5):
+            hm.observe(loss=2.004)     # 0.2% wiggle
+        assert "loss_spike" not in [a["signal"] for a in hm.alerts]
+
+    def test_logs_only_monitor_status_recovers(self):
+        """Without a sentinel, a confirmed spike must not pin the host's
+        digest status at 'diverged' forever (fleet re-arm semantics)."""
+        hm = self._monitor(confirm_steps=2, z_threshold=4.0,
+                           cooldown_steps=3)
+        for i in range(20):
+            hm.observe(loss=1.0 + 0.01 * (i % 3))
+        for _ in range(3):
+            hm.observe(loss=500.0)
+        assert health.last_status() == "diverged"
+        for i in range(20):  # past cooldown, clean steps
+            hm.observe(loss=500.0 + 0.5 * (i % 3))
+        assert health.last_status() == "ok"
+
+    def test_rollback_walkback_on_sharded_layout(self, tmp_path):
+        """The finiteness walk-back must read sharded step DIRECTORIES
+        through the chunked backend, not open(dir) and skip them all."""
+        from paddle_tpu.distributed.sharded_checkpoint import \
+            ShardedCheckpointManager
+        mgr = ShardedCheckpointManager(str(tmp_path), rank=0, world_size=1)
+        good = {"network": {"w": np.ones((4,), np.float32)},
+                "optimizer": None, "train_step": None, "rng": None}
+        bad = {"network": {"w": np.full((4,), np.nan, np.float32)},
+               "optimizer": None, "train_step": None, "rng": None}
+        mgr.save(good, step=1)
+        mgr.save(bad, step=2)
+        mgr.drain()
+        hm = HealthMonitor(action="rollback", checkpoint=mgr)
+        found = hm._load_numerically_valid(mgr, step=3)
+        assert found is not None
+        blob, step = found
+        assert step == 1
+        np.testing.assert_array_equal(
+            np.asarray(blob["network"]["w"]), np.ones((4,), np.float32))
+
+    def test_rollback_without_model_degrades_to_halt(self, tmp_path):
+        """Manual-loop monitor with no set_model(): the response must not
+        raise out of observe() (the plane never takes down training)."""
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save({"network": {"w": np.ones((2,), np.float32)}}, step=1)
+        hm = HealthMonitor(action="rollback", checkpoint=mgr)
+        hm.observe(nonfinite=True)  # no model attached — must not raise
+        assert hm.rollbacks == 0
+        assert any(a["signal"] == "rollback_failed" for a in hm.alerts)
+
+    def test_env_action_default(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_HEALTH_ACTION", "halt")
+        assert HealthMonitor().action == "halt"
+
+
+class _FixedDS(paddle.io.Dataset):
+    """Deterministic per-index dataset (index-seeded, resume-friendly)."""
+
+    def __init__(self, n=8):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(1000 + i)
+        return (rng.randn(4).astype(np.float32),
+                rng.randn(2).astype(np.float32))
+
+
+class _PoisonAt(Callback):
+    """Write NaN into the compiled step's params at step-counter `at`."""
+
+    def __init__(self, at):
+        super().__init__()
+        self.at = at
+        self.done = False
+
+    def on_train_batch_end(self, step, logs=None):
+        ts = self.model._train_step
+        if ts is not None and ts._t == self.at and not self.done:
+            self.done = True
+            ts.params["weight"] = \
+                ts.params["weight"].at[0, 0].set(jnp.nan)
+
+
+class TestRollbackE2E:
+    """Acceptance (c): divergence -> rollback restores the last
+    numerically-valid checkpoint bit-identically and training continues."""
+
+    def test_rollback_restores_bit_identical_state(self, tmp_path,
+                                                   monkeypatch):
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+        from paddle_tpu.framework.random import get_rng_state, set_rng_state
+        monkeypatch.setenv("PADDLE_TPU_HEALTH", "1")
+        paddle.seed(11)
+        net = nn.Linear(4, 2)
+        m = paddle.Model(net)
+        m.prepare(optimizer.Adam(learning_rate=1e-2,
+                                 parameters=net.parameters()),
+                  loss=nn.MSELoss())
+        x = np.random.RandomState(3).randn(4, 4).astype(np.float32)
+        y = np.random.RandomState(4).randn(4, 2).astype(np.float32)
+        for _ in range(5):
+            m.train_batch([x], [y])
+        # checkpoint the exact state at step 5 (the _capture shape)
+        m._sync_from_train_step()
+        blob = {
+            "network": {k: np.asarray(v.data)
+                        for k, v in net.state_dict().items()},
+            "optimizer": m._optimizer.state_dict(),
+            "train_step": m._train_step.state_dict(),
+            "rng": np.asarray(get_rng_state()),
+            "epoch": 0, "step_in_epoch": 5, "global_step": 5,
+            "epoch_done": False,
+        }
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(blob, step=5)
+        saved_w = {k: np.asarray(v.data)
+                   for k, v in net.state_dict().items()}
+        # diverge: poison and take a step (tripping the sentinel)
+        m._train_step.params["weight"] = \
+            m._train_step.params["weight"].at[0, 0].set(jnp.nan)
+        m.train_batch([x], [y])
+        assert health.tripped()
+        hm = HealthMonitor(action="rollback", checkpoint=mgr)
+        hm.set_model(m)
+        hm.observe(nonfinite=True, step=6)
+        assert hm.rollbacks == 1
+        # (1) restored state is bit-identical to the checkpoint
+        for k, v in net.state_dict().items():
+            np.testing.assert_array_equal(np.asarray(v.data), saved_w[k])
+        assert m._train_step is None  # rebuilt on next batch
+        assert not health.tripped()
+        # (2) continued training == a control resumed from the same file
+        cont = [np.asarray(m.train_batch([x], [y])) for _ in range(3)]
+        paddle.seed(99)  # control must not depend on ambient RNG
+        net2 = nn.Linear(4, 2)
+        m2 = paddle.Model(net2)
+        m2.prepare(optimizer.Adam(learning_rate=1e-2,
+                                  parameters=net2.parameters()),
+                   loss=nn.MSELoss())
+        blob2, step2 = mgr.load_latest()
+        assert step2 == 5
+        net2.set_state_dict(blob2["network"])
+        m2._optimizer.set_state_dict(blob2["optimizer"])
+        m2._pending_ts_state = blob2["train_step"]
+        set_rng_state(np.asarray(blob2["rng"]))
+        ctrl = [np.asarray(m2.train_batch([x], [y])) for _ in range(3)]
+        np.testing.assert_array_equal(np.asarray(cont), np.asarray(ctrl))
+        for k, v in net.state_dict().items():
+            m._sync_from_train_step()
+            m2._sync_from_train_step()
+            np.testing.assert_array_equal(
+                np.asarray(v.data),
+                np.asarray(dict(net2.state_dict())[k].data))
+
+    def test_fit_poison_rollback_recovers(self, tmp_path, monkeypatch):
+        """Full fit loop: poison mid-run -> exactly one rollback, the
+        poisoned epoch-end checkpoint is skipped by the finiteness
+        walk-back, and the run ends with finite weights."""
+        monkeypatch.setenv("PADDLE_TPU_HEALTH", "1")
+        paddle.seed(42)
+        net = nn.Linear(4, 2)
+        m = paddle.Model(net)
+        m.prepare(optimizer.Adam(learning_rate=1e-2,
+                                 parameters=net.parameters()),
+                  loss=nn.MSELoss())
+        ftc = FaultTolerantCheckpoint(str(tmp_path), save_freq_steps=3)
+        hm = HealthMonitor(action="rollback", checkpoint=ftc,
+                           cooldown_steps=2)
+        events_mod.default_event_log().clear()
+        m.fit(_FixedDS(), batch_size=2, epochs=3, shuffle=False, verbose=0,
+              callbacks=[hm, ftc, _PoisonAt(4)])
+        assert hm.rollbacks == 1
+        rb = events_mod.recent(20, kind="health_rollback")
+        assert len(rb) == 1 and rb[0]["restored_step"] == 3
+        # epoch-end save at step 4 raced detection and captured NaN: the
+        # walk-back skipped it
+        assert any(a["signal"] == "rollback_skip_nonfinite"
+                   for a in hm.alerts)
+        w = np.asarray(dict(net.state_dict())["weight"].data)
+        assert np.all(np.isfinite(w))
+        reg = metrics_mod.default_registry()
+        assert reg.get("health_rollback_total").total() >= 1
+
+    def test_saves_skipped_while_tripped(self, tmp_path, monkeypatch):
+        """FaultTolerantCheckpoint never persists known-bad state."""
+        monkeypatch.setenv("PADDLE_TPU_HEALTH", "1")
+        paddle.seed(1)
+        net = nn.Linear(4, 2)
+        m = paddle.Model(net)
+        m.prepare(optimizer.Adam(learning_rate=1e-2,
+                                 parameters=net.parameters()),
+                  loss=nn.MSELoss())
+        ftc = FaultTolerantCheckpoint(str(tmp_path), save_freq_steps=1)
+        # no HealthMonitor: nothing clears the trip, so every save after
+        # the poison must be skipped
+        m.fit(_FixedDS(), batch_size=2, epochs=2, shuffle=False, verbose=0,
+              callbacks=[ftc, _PoisonAt(3)])
+        from paddle_tpu.distributed.checkpoint import load as load_ckpt
+        steps = sorted(ftc.manager.steps())
+        # step 3's save ran before the poison callback; step 4 raced
+        # detection (sentinel fetches during step 4's train_batch, save
+        # happens at its batch end -> skipped). Nothing newer than 4.
+        assert max(steps) <= 4
+        for s in steps:
+            blob = load_ckpt(ftc.manager.path_for(s))
+            if s < 4:
+                for v in blob["network"].values():
+                    assert np.all(np.isfinite(np.asarray(v)))
+        ev = [e for e in events_mod.recent(100, kind="health_alert")
+              if e.get("signal") == "checkpoint_skipped"]
+        assert ev
+
+    @pytest.mark.slow
+    def test_rollback_long_run_loss_recovers(self, tmp_path, monkeypatch):
+        """Slow full version: a longer fit with a mid-run poison keeps
+        training after the rollback and ends at a loss comparable to an
+        uninterrupted run's."""
+        monkeypatch.setenv("PADDLE_TPU_HEALTH", "1")
+
+        def run(poison):
+            paddle.seed(5)
+            net = MLP(din=4, hidden=32, dout=2)
+            m = paddle.Model(net)
+            m.prepare(optimizer.Adam(learning_rate=5e-3,
+                                     parameters=net.parameters()),
+                      loss=nn.MSELoss())
+            cbs = [HealthMonitor(action="rollback",
+                                 checkpoint=str(tmp_path / "ckpt"),
+                                 cooldown_steps=2),
+                   FaultTolerantCheckpoint(str(tmp_path / "ckpt"),
+                                           save_freq_steps=5)]
+            if poison:
+                cbs.append(_PoisonAtMLP(17))
+            m.fit(_FixedDS(n=40), batch_size=4, epochs=6, shuffle=False,
+                  verbose=0, callbacks=cbs)
+            m._sync_from_train_step()
+            x = np.random.RandomState(3).randn(8, 4).astype(np.float32)
+            y = np.random.RandomState(4).randn(8, 2).astype(np.float32)
+            return float(np.asarray(m.eval_batch([x], [y])[0]))
+
+        import shutil
+        clean = run(poison=False)
+        shutil.rmtree(tmp_path / "ckpt")
+        health.reset()
+        poisoned = run(poison=True)
+        assert math.isfinite(poisoned)
+        assert poisoned < clean * 5 + 1.0  # recovered, not diverged
+
+
+class _PoisonAtMLP(Callback):
+    def __init__(self, at):
+        super().__init__()
+        self.at = at
+        self.done = False
+
+    def on_train_batch_end(self, step, logs=None):
+        ts = self.model._train_step
+        if ts is not None and ts._t == self.at and not self.done:
+            self.done = True
+            ts.params["fc1.weight"] = \
+                ts.params["fc1.weight"].at[0, 0].set(jnp.nan)
+
+
+class TestAmpScaler:
+    """Satellite: found_inf is ONE fused all-leaves reduction with a
+    single device fetch, metered on /metrics."""
+
+    def _opt_with_grads(self, grad_value):
+        from paddle_tpu.framework.tensor import Tensor
+        paddle.seed(0)
+        net = nn.Linear(2, 2)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters())
+        for p in opt._parameter_list:
+            p.grad = Tensor(jnp.full_like(p.data, grad_value))
+        return net, opt
+
+    def test_finite_grads_update_and_unscale(self):
+        from paddle_tpu.amp import GradScaler
+        net, opt = self._opt_with_grads(4.0)
+        w0 = np.asarray(opt._parameter_list[0].data).copy()
+        sc = GradScaler(enable=True, init_loss_scaling=4.0)
+        sc.unscale_(opt)
+        assert not sc._found_inf
+        # grads unscaled by 1/4
+        np.testing.assert_allclose(
+            np.asarray(opt._parameter_list[0].grad.data), 1.0)
+        sc.step(opt)
+        assert not np.allclose(
+            w0, np.asarray(opt._parameter_list[0].data))
+
+    def test_inf_grads_skip_step_and_meter(self):
+        from paddle_tpu.amp import GradScaler
+        reg = metrics_mod.default_registry()
+        before = reg.get("amp_found_inf_total").total()
+        net, opt = self._opt_with_grads(float("inf"))
+        w0 = np.asarray(opt._parameter_list[0].data).copy()
+        sc = GradScaler(enable=True, init_loss_scaling=4.0,
+                        decr_every_n_nan_or_inf=1)
+        sc.step(opt)
+        assert sc._scale == 2.0  # backed off
+        np.testing.assert_array_equal(
+            w0, np.asarray(opt._parameter_list[0].data))  # step skipped
+        assert reg.get("amp_found_inf_total").total() == before + 1
+        assert reg.get("amp_loss_scale").value() == 2.0
+
+    def test_partial_nan_found(self):
+        from paddle_tpu.amp import GradScaler
+        from paddle_tpu.framework.tensor import Tensor
+        net, opt = self._opt_with_grads(1.0)
+        # only ONE leaf, one element bad
+        p = opt._parameter_list[1]
+        p.grad = Tensor(p.grad.data.at[0].set(jnp.nan))
+        sc = GradScaler(enable=True, init_loss_scaling=2.0)
+        sc.unscale_(opt)
+        assert sc._found_inf
+
+    def test_disabled_scaler_passthrough(self):
+        from paddle_tpu.amp import GradScaler
+        net, opt = self._opt_with_grads(1.0)
+        sc = GradScaler(enable=False)
+        w0 = np.asarray(opt._parameter_list[0].data).copy()
+        sc.step(opt)
+        assert not np.allclose(
+            w0, np.asarray(opt._parameter_list[0].data))
+
+
+class TestPlaneSurfaces:
+    """/snapshot health section + fleet digest/aggregator wiring."""
+
+    def test_server_snapshot_has_health_section(self):
+        from paddle_tpu.profiler.server import ObservabilityServer
+        health.record_step_stats(
+            {"loss": 1.0, "nonfinite": False, "grad_norm": 2.0,
+             "update_ratio": 0.1, "group_grad_norms": {"fc1": 2.0}},
+            step=7)
+        snap = ObservabilityServer().snapshot()
+        h = snap["health"]
+        assert h["status"] == "ok"
+        assert h["last"]["step"] == 7
+        assert "enabled" in h and "action" in h
+        import json
+        json.dumps(snap)  # the whole snapshot stays JSON-serializable
+
+    def test_snapshot_sanitizes_nonfinite(self):
+        import json
+        health.record_step_stats(
+            {"loss": float("nan"), "nonfinite": True,
+             "grad_norm": float("inf"), "update_ratio": 0.0,
+             "group_grad_norms": {"fc1": float("nan")}}, step=1)
+        # gauges skipped the nonfinite values
+        reg = metrics_mod.default_registry()
+        text = reg.to_prometheus_text()
+        assert "health_loss nan" not in text.lower()
+        # and a TRIPPED snapshot stays strict JSON (no NaN literals)
+        snap = health.snapshot()
+        payload = json.dumps(snap)
+        assert "NaN" not in payload and "Infinity" not in payload
+        assert snap["last"]["loss"] is None
+        assert snap["tripped"] is True
+
+    def test_tensor_health_served_on_events_endpoint(self):
+        """Acceptance (b): the attribution event is visible on /events."""
+        import json as _json
+        from urllib.request import urlopen
+        from paddle_tpu.profiler.server import ObservabilityServer
+        _, step, x, y = _mlp_step()
+        step(x, y)
+        step.params["fc2.weight"] = \
+            step.params["fc2.weight"].at[0, 0].set(jnp.nan)
+        step(x, y)
+        srv = ObservabilityServer()
+        port = srv.start(0)
+        try:
+            body = urlopen(f"http://127.0.0.1:{port}/events"
+                           f"?kind=tensor_health", timeout=10).read()
+            evs = _json.loads(body)["events"]
+            assert any(e.get("src") == "sentinel"
+                       and e.get("bad_groups") == ["fc2"] for e in evs)
+            snap = _json.loads(urlopen(
+                f"http://127.0.0.1:{port}/snapshot", timeout=10).read())
+            assert snap["health"]["tripped"] is True
+        finally:
+            srv.stop()
+
+    def test_fleet_digest_and_aggregator(self):
+        from paddle_tpu.distributed.fleet.telemetry import (FleetAggregator,
+                                                            FleetReporter)
+
+        class FakeStore:
+            def __init__(self):
+                self.d = {}
+
+            def set(self, k, v):
+                self.d[k] = v.encode() if isinstance(v, str) else v
+
+            def get(self, k):
+                return self.d[k]
+
+            def check(self, k):
+                return k in self.d
+
+        store = FakeStore()
+        rep = FleetReporter(store, rank=0, min_interval_s=0.0,
+                            host="trainer-0")
+        health.record_step_stats(
+            {"loss": float("nan"), "nonfinite": True, "grad_norm": 1.0,
+             "update_ratio": 0.0, "group_grad_norms": {}}, step=3)
+        rep.publish(3)
+        import json as _json
+        digest = _json.loads(store.get("obs/digest/0").decode())
+        assert digest["health_status"] == "diverged"
+        events_mod.default_event_log().clear()
+        agg = FleetAggregator(store, world_size=1)
+        agg.collect()
+        reg = metrics_mod.default_registry()
+        assert reg.get("fleet_health_status").value(host="trainer-0") == 2
+        ev = events_mod.recent(10, kind="fleet_health")
+        assert len(ev) == 1 and ev[0]["unhealthy"] == "trainer-0"
+        # no duplicate event while still unhealthy
+        agg.collect()
+        assert len(events_mod.recent(10, kind="fleet_health")) == 1
+        # recovery re-arms
+        health.record_step_stats(
+            {"loss": 1.0, "nonfinite": False, "grad_norm": 1.0,
+             "update_ratio": 0.0, "group_grad_norms": {}}, step=4)
+        rep.publish(4)
+        agg.collect()
+        assert reg.get("fleet_health_status").value(host="trainer-0") == 0
+        assert agg.snapshot()["unhealthy"] == []
+        # warn -> diverged ESCALATION fires a second (error) event
+        health.set_status("warn")
+        rep.publish(5)
+        agg.collect()
+        health.record_step_stats(
+            {"loss": float("nan"), "nonfinite": True, "grad_norm": 1.0,
+             "update_ratio": 0.0, "group_grad_norms": {}}, step=6)
+        rep.publish(6)
+        agg.collect()
+        fh = events_mod.recent(10, kind="fleet_health")
+        assert [e["status"] for e in fh[-2:]] == ["warn", "diverged"]
+        assert fh[-1]["severity"] == "error"

@@ -196,6 +196,7 @@ class TestMetricNamingLint:
     def _import_instrumented_modules():
         # every module that registers metric families at import
         import paddle_tpu  # noqa: F401
+        import paddle_tpu.amp  # noqa: F401
         import paddle_tpu.distributed.checkpoint  # noqa: F401
         import paddle_tpu.distributed.collective  # noqa: F401
         import paddle_tpu.distributed.fleet.elastic  # noqa: F401
@@ -208,6 +209,7 @@ class TestMetricNamingLint:
         import paddle_tpu.io.worker  # noqa: F401
         import paddle_tpu.ops._dispatch  # noqa: F401
         import paddle_tpu.profiler.compile_watch  # noqa: F401
+        import paddle_tpu.profiler.health  # noqa: F401
         import paddle_tpu.profiler.watchdog  # noqa: F401
 
     def test_family_names_match_prometheus_grammar(self):
@@ -240,6 +242,22 @@ class TestMetricNamingLint:
         _xplane._M_CAPTURES.inc(status="complete")
         from paddle_tpu.distributed import collective as _coll
         _coll._M_COLL_SECONDS.observe(0.001, kind="all_reduce")
+        # training-health PR families: sentinel gauges (group=), nonfinite
+        # counter (src=), monitor alerts (signal=), fleet status (host=),
+        # and the AMP scaler pair
+        from paddle_tpu.profiler import health as _health
+        _health._M_LAYER_GRAD.set(0.5, group="fc1")
+        _health._M_NONFINITE.inc(src="sentinel")
+        _health._M_ALERTS.inc(signal="loss_spike")
+        _health._M_LOSS.set(1.0)
+        _health._M_GRAD_NORM.set(1.0)
+        _health._M_UPDATE_RATIO.set(0.01)
+        _health._M_ROLLBACK.inc()
+        from paddle_tpu.distributed.fleet import telemetry as _tel
+        _tel._M_HEALTH.set(0, host="trainer-0")
+        import paddle_tpu.amp as _amp
+        _amp._M_FOUND_INF.inc()
+        _amp._M_LOSS_SCALE.set(32768.0)
         reg = metrics.default_registry()
         problems = []
         for name in reg.names():

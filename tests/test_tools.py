@@ -502,3 +502,255 @@ class TestDeviceTimeAndMemoryGate:
             summary = sess.stop(steps=1)
         assert gate.validate_observability(
             self._doc(dt=summary["device_time"])) == []
+
+
+class TestHealthGate:
+    """check_bench_result: the bench `observability.health` block and the
+    `health_*`/`amp_*` metric families (training-health PR)."""
+
+    @staticmethod
+    def _doc(health=None, metrics=None):
+        doc = {"configs": {"gpt": {"tokens_per_sec_chip": 1.0}},
+               "observability": {}}
+        if health is not None:
+            doc["observability"]["health"] = health
+        if metrics is not None:
+            doc["observability"]["metrics"] = metrics
+        return doc
+
+    @staticmethod
+    def _good_block():
+        return {"step_ms_off": 10.0, "step_ms_on": 10.1,
+                "overhead_frac": 0.01, "interval": 1, "groups": 13,
+                "sentinel": {"loss": 2.5, "grad_norm": 1.0,
+                             "update_ratio": 0.001, "nonfinite": False},
+                "note": "probe"}
+
+    @staticmethod
+    def _good_metrics():
+        return {
+            "health_loss": {"kind": "gauge", "help": "",
+                            "values": [{"labels": {}, "value": -0.5}]},
+            "health_layer_grad_norm": {
+                "kind": "gauge", "help": "",
+                "values": [{"labels": {"group": "fc1"}, "value": 2.0}]},
+            "health_nonfinite_total": {
+                "kind": "counter", "help": "",
+                "values": [{"labels": {"src": "sentinel"}, "value": 1}]},
+            "amp_found_inf_total": {"kind": "counter", "help": "",
+                                    "values": [{"labels": {}, "value": 2}]},
+            "amp_loss_scale": {"kind": "gauge", "help": "",
+                               "values": [{"labels": {}, "value": 32768.0}]},
+            "fleet_health_status": {
+                "kind": "gauge", "help": "",
+                "values": [{"labels": {"host": "t0"}, "value": 2}]},
+        }
+
+    def test_good_block_and_metrics_pass(self):
+        assert gate.validate_observability(
+            self._doc(self._good_block(), self._good_metrics())) == []
+
+    def test_failed_probe_reports_itself(self):
+        assert gate.validate_observability(
+            self._doc({"error": "TimeoutError: slow box"})) == []
+
+    def test_bad_overhead_and_negative_ms_named(self):
+        h = self._good_block()
+        h["overhead_frac"] = -2.0
+        h["step_ms_on"] = -1.0
+        problems = gate.validate_observability(self._doc(h))
+        assert any("overhead_frac" in p for p in problems)
+        assert any("step_ms_on" in p for p in problems)
+
+    def test_bad_sentinel_named(self):
+        h = self._good_block()
+        h["sentinel"]["nonfinite"] = "yes"
+        h["sentinel"]["grad_norm"] = "big"
+        problems = gate.validate_observability(self._doc(h))
+        assert any("nonfinite" in p for p in problems)
+        assert any("grad_norm" in p for p in problems)
+
+    def test_wrong_kind_and_unknown_family_named(self):
+        m = self._good_metrics()
+        m["health_nonfinite_total"]["kind"] = "gauge"
+        m["health_surprise_total"] = {"kind": "counter", "values": []}
+        problems = gate.validate_observability(self._doc(metrics=m))
+        assert any("health_nonfinite_total" in p and "counter" in p
+                   for p in problems)
+        assert any("health_surprise_total" in p and "unknown" in p
+                   for p in problems)
+
+    def test_missing_label_and_nonfinite_value_named(self):
+        m = self._good_metrics()
+        m["health_layer_grad_norm"]["values"][0]["labels"] = {}
+        m["health_loss"]["values"][0]["value"] = float("nan")
+        problems = gate.validate_observability(self._doc(metrics=m))
+        assert any("'group' label" in p for p in problems)
+        assert any("health_loss" in p and "finite" in p for p in problems)
+
+    def test_negative_counter_named(self):
+        m = self._good_metrics()
+        m["amp_found_inf_total"]["values"][0]["value"] = -1
+        problems = gate.validate_observability(self._doc(metrics=m))
+        assert any("amp_found_inf_total" in p and "negative" in p
+                   for p in problems)
+
+    def test_live_registry_snapshot_validates(self):
+        """Real registry series seeded by the health plane pass the gate."""
+        from paddle_tpu.profiler import health
+        from paddle_tpu.profiler.metrics import default_registry
+        health.reset()
+        health.record_step_stats(
+            {"loss": 1.5, "nonfinite": False, "grad_norm": 2.0,
+             "update_ratio": 0.01, "group_grad_norms": {"fc1": 2.0}},
+            step=1)
+        snap = default_registry().snapshot()
+        assert gate.validate_observability(self._doc(metrics=snap)) == []
+
+    def test_bench_probe_block_validates(self):
+        """bench.health_overhead_probe output passes the gate on a tiny
+        model (the BENCH_r06 shape)."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.nn import functional as F
+        sys.path.insert(0, REPO)
+        try:
+            import bench
+        finally:
+            sys.path.remove(REPO)
+        paddle.seed(0)
+        net = nn.Linear(8, 4)
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+
+        def mk(on):
+            opt = optimizer.SGD(learning_rate=0.01,
+                                parameters=net.parameters())
+            return TrainStep(net, F.cross_entropy, opt, health=on)
+
+        block = bench.health_overhead_probe(mk, (x, y), iters=3, warmup=1)
+        assert block["groups"] == 1
+        assert block["sentinel"]["nonfinite"] is False
+        assert gate.validate_observability(self._doc(block)) == []
+
+
+class TestObsTailHealth:
+    """obs_tail --health: filter + operator rendering of the numerics
+    plane's events."""
+
+    @staticmethod
+    def _write(tmp_path):
+        path = tmp_path / "ev.jsonl"
+        recs = [
+            {"ts": 10.0, "kind": "retrace", "host": "t0", "name": "mm"},
+            {"ts": 11.0, "kind": "tensor_health", "host": "t0",
+             "severity": "error", "src": "sentinel", "step": 40,
+             "bad_groups": ["blocks.3"]},
+            {"ts": 12.0, "kind": "tensor_health", "host": "t0",
+             "severity": "error", "src": "eager", "op": "matmul",
+             "layer": "blocks.3.attn", "bad_kind": "nan",
+             "shape": [8, 64], "dtype": "float32", "output_index": 0},
+            {"ts": 13.0, "kind": "health_alert", "host": "t0",
+             "severity": "warn", "signal": "grad_explosion",
+             "grad_norm": 1e9, "step": 41},
+            {"ts": 14.0, "kind": "health_rollback", "host": "t0",
+             "severity": "warn", "reason": "nonfinite", "step": 42,
+             "restored_step": 35, "rollbacks": 1},
+            {"ts": 15.0, "kind": "fleet_health", "host": "t0",
+             "severity": "error", "unhealthy": "trainer-1",
+             "status": "diverged"},
+        ]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        return str(path)
+
+    def test_health_filters_and_renders(self, tmp_path, capsys):
+        import obs_tail
+        rc = obs_tail.main([self._write(tmp_path), "--health"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "retrace" not in out          # filtered to health kinds
+        assert "nan in blocks.3.attn op=matmul" in out
+        assert "blocks.3" in out             # sentinel bad_groups
+        assert "grad_explosion" in out
+        assert "restored checkpoint step 35" in out
+        assert "host trainer-1 went diverged" in out
+
+    def test_health_respects_explicit_kind(self, tmp_path, capsys):
+        import obs_tail
+        rc = obs_tail.main([self._write(tmp_path), "--health",
+                            "--kind", "health_rollback"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        lines = [l for l in out.splitlines() if l.strip()]
+        assert len(lines) == 1 and "restored checkpoint" in lines[0]
+
+    def test_health_with_diagnose_combines(self, tmp_path, capsys):
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps({"ts": 1.0, "kind": "health_alert",
+                                "host": "t0", "signal": "loss_spike"}) + "\n")
+            f.write(json.dumps({"ts": 2.0, "kind": "step_diagnosis",
+                                "host": "t0", "wall_s": 1.0, "steps": 5,
+                                "dominant": "data_wait",
+                                "dominant_frac": 0.5,
+                                "terms": {"data_wait": 0.5}}) + "\n")
+        rc = obs_tail.main([str(path), "--health", "--diagnose"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "loss_spike" in out
+        assert "dominant=data_wait" in out
+
+
+class TestObsTailErrorPaths:
+    def test_unreadable_file_exits_2(self, tmp_path, capsys):
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        path.write_text("{}\n")
+        os.chmod(path, 0)
+        try:
+            if os.access(path, os.R_OK):
+                pytest.skip("running as root: chmod 0 still readable")
+            assert obs_tail.main([str(path)]) == 2
+            assert "obs_tail:" in capsys.readouterr().err
+        finally:
+            os.chmod(path, 0o644)
+
+    def test_follow_backlog_has_no_gap(self, tmp_path, capsys):
+        """Events appended between backlog render and tail start must not
+        be dropped: follow() reads the backlog through the SAME handle it
+        tails."""
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            for i in range(3):
+                f.write(json.dumps({"ts": float(i), "kind": "retrace",
+                                    "host": "h", "seq": i}) + "\n")
+
+        real_parse = obs_tail.parse_lines
+        appended = {"done": False}
+
+        def racing_parse(lines):
+            # first call = the backlog parse; append an event right after
+            # the backlog lines were read but before the tail loop starts
+            out = real_parse(lines)
+            if not appended["done"]:
+                appended["done"] = True
+                with open(path, "a") as f:
+                    f.write(json.dumps({"ts": 9.0, "kind": "retrace",
+                                        "host": "h", "seq": 3}) + "\n")
+            return out
+
+        obs_tail.parse_lines = racing_parse
+        try:
+            rc = obs_tail.main([str(path), "--follow", "--follow-for",
+                                "1.0", "--json"])
+        finally:
+            obs_tail.parse_lines = real_parse
+        assert rc == 0
+        seqs = [json.loads(l)["seq"] for l in
+                capsys.readouterr().out.strip().splitlines()]
+        assert seqs == [0, 1, 2, 3]  # the racing append is NOT lost

@@ -219,6 +219,112 @@ def _validate_device_time(where: str, dt: dict) -> List[str]:
     return problems
 
 
+# training-health + AMP metric families: name -> (kind, required labels,
+# non-negative values?). Gauges that can legally go negative (a loss) skip
+# the non-negative check; counters never may.
+_HEALTH_FAMILIES = {
+    "health_loss": ("gauge", (), False),
+    "health_grad_norm": ("gauge", (), True),
+    "health_update_ratio": ("gauge", (), True),
+    "health_layer_grad_norm": ("gauge", ("group",), True),
+    "health_nonfinite_total": ("counter", ("src",), True),
+    "health_alerts_total": ("counter", ("signal",), True),
+    "health_rollback_total": ("counter", (), True),
+    "fleet_health_status": ("gauge", ("host",), True),
+    "amp_found_inf_total": ("counter", (), True),
+    "amp_loss_scale": ("gauge", (), True),
+}
+
+
+def _validate_health_metrics(where: str, metrics: dict) -> List[str]:
+    """`health_*` / `amp_*` families must be the documented kind, carry
+    their required labels, and hold finite values (counters and norms
+    non-negative) — label hygiene for the numerics plane."""
+    problems = []
+    for name, fam in metrics.items():
+        if not (name.startswith("health_") or name.startswith("amp_")
+                or name == "fleet_health_status"):
+            continue
+        spec = _HEALTH_FAMILIES.get(name)
+        if spec is None:
+            problems.append(f"{where}.metrics.{name}: unknown health/amp "
+                            f"family (expected one of "
+                            f"{sorted(_HEALTH_FAMILIES)})")
+            continue
+        kind, req_labels, nonneg = spec
+        if not isinstance(fam, dict) or fam.get("kind") != kind:
+            problems.append(f"{where}.metrics.{name}: kind "
+                            f"{fam.get('kind') if isinstance(fam, dict) else fam!r}"
+                            f", expected {kind}")
+            continue
+        for i, v in enumerate(fam.get("values") or []):
+            if not isinstance(v, dict):
+                problems.append(f"{where}.metrics.{name}[{i}] is not a "
+                                f"series object")
+                continue
+            val = v.get("value")
+            if not isinstance(val, (int, float)) or isinstance(val, bool):
+                problems.append(f"{where}.metrics.{name}[{i}]: value "
+                                f"{val!r} is not a number")
+            elif val != val or val in (float("inf"), float("-inf")):
+                problems.append(f"{where}.metrics.{name}[{i}]: value "
+                                f"{val!r} is not finite (the plane must "
+                                f"keep NaN/Inf out of gauges)")
+            elif nonneg and val < 0:
+                problems.append(f"{where}.metrics.{name}[{i}]: value "
+                                f"{val!r} is negative")
+            labels = v.get("labels") or {}
+            for lk in req_labels:
+                if lk not in labels:
+                    problems.append(f"{where}.metrics.{name}[{i}]: series "
+                                    f"missing the {lk!r} label")
+    return problems
+
+
+def _validate_health_block(where: str, h: dict) -> List[str]:
+    """The bench `observability.health` block: the sentinel-overhead
+    measurement (health on vs off on the GPT-2 config) plus the last
+    decoded sentinel stats."""
+    problems = []
+    if not isinstance(h, dict):
+        return [f"{where}.health is not an object"]
+    if "error" in h:
+        return problems  # a failed probe reports itself; nothing to gate
+    for key in ("step_ms_off", "step_ms_on"):
+        v = h.get(key)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool) or v < 0):
+            problems.append(f"{where}.health.{key} {v!r} is not a "
+                            f"non-negative number")
+    ov = h.get("overhead_frac")
+    if ov is not None and (not isinstance(ov, (int, float))
+                           or isinstance(ov, bool) or ov < -1.0):
+        problems.append(f"{where}.health.overhead_frac {ov!r} is not a "
+                        f"number > -1")
+    for key in ("interval", "groups"):
+        v = h.get(key)
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool) or v < 0):
+            problems.append(f"{where}.health.{key} {v!r} is not a "
+                            f"non-negative integer")
+    sent = h.get("sentinel")
+    if sent is not None:
+        if not isinstance(sent, dict):
+            problems.append(f"{where}.health.sentinel is not an object")
+        else:
+            nf = sent.get("nonfinite")
+            if nf is not None and not isinstance(nf, bool):
+                problems.append(f"{where}.health.sentinel.nonfinite "
+                                f"{nf!r} is not a bool")
+            for key in ("loss", "grad_norm", "update_ratio"):
+                v = sent.get(key)
+                if v is not None and (not isinstance(v, (int, float))
+                                      or isinstance(v, bool)):
+                    problems.append(f"{where}.health.sentinel.{key} "
+                                    f"{v!r} is not numeric or null")
+    return problems
+
+
 def _validate_device_memory_metrics(where: str, metrics: dict) -> List[str]:
     """`device_memory_*` families must be gauges of non-negative values
     whose series carry the `device` label."""
@@ -250,10 +356,11 @@ def validate_observability(doc: dict) -> List[str]:
     """Schema problems in the document's observability sections (empty =
     valid). step_records must conform to the step-record contract,
     events/events_tail to the event contract, `checkpoint_async_*` /
-    `device_memory_*` metric families to their kind/shape contracts, and
-    `device_time` blocks to the per-op row shape with a known provenance
-    label (estimate / measured / xplane); a missing section is fine (old
-    rounds), a malformed one is not."""
+    `device_memory_*` / `health_*` / `amp_*` metric families to their
+    kind/label/shape contracts, `device_time` blocks to the per-op row
+    shape with a known provenance label (estimate / measured / xplane),
+    and `health` blocks to the sentinel-overhead shape; a missing section
+    is fine (old rounds), a malformed one is not."""
     from paddle_tpu.profiler.events import validate_event
     from paddle_tpu.profiler.monitor import validate_step_record
     problems = []
@@ -262,9 +369,13 @@ def validate_observability(doc: dict) -> List[str]:
         if isinstance(metrics, dict):
             problems.extend(_validate_async_ckpt_metrics(where, metrics))
             problems.extend(_validate_device_memory_metrics(where, metrics))
+            problems.extend(_validate_health_metrics(where, metrics))
         dt = obs.get("device_time")
         if dt is not None:
             problems.extend(_validate_device_time(where, dt))
+        h = obs.get("health")
+        if h is not None:
+            problems.extend(_validate_health_block(where, h))
         recs = obs.get("step_records")
         if recs is not None:
             if not isinstance(recs, list):

@@ -13,11 +13,17 @@ severity + kind-specific payload). This renders that stream for operators:
     python tools/obs_tail.py events.jsonl --follow --follow-for 30
     python tools/obs_tail.py events.jsonl --json --kind fleet_straggler
     python tools/obs_tail.py events.jsonl --diagnose       # step_diagnosis
+    python tools/obs_tail.py events.jsonl --health         # numerics plane
     cat events.jsonl | python tools/obs_tail.py -
 
 `--diagnose` renders `step_diagnosis` events (the runtime's step-slowness
 decomposition) as a per-window cost breakdown naming the dominant term;
-`--follow-for N` bounds a live tail to N seconds (scripting/CI).
+`--health` renders the training-health events (tensor_health NaN/Inf
+attribution, health_alert divergence signals, health_rollback responses,
+fleet_health) in an operator-oriented line format; `--follow-for N`
+bounds a live tail to N seconds (scripting/CI). A sink rotated by
+`PADDLE_TPU_EVENT_LOG_MAX_MB` is read transparently: `path.N`...`path.1`
+siblings stream before `path` in chronological order.
 
 A running job's recent window is also served live over HTTP
 (`/events?kind=...` on the ObservabilityServer) — this tool is the
@@ -44,6 +50,38 @@ try:
 except Exception:  # standalone copy of the tool, no repo on path
     SEVERITIES = ("debug", "info", "warn", "error")
 
+try:
+    from paddle_tpu.profiler.health import HEALTH_EVENT_KINDS as _HK
+    HEALTH_KINDS = tuple(_HK) + ("fleet_health",)
+except Exception:
+    HEALTH_KINDS = ("tensor_health", "health_alert", "health_rollback",
+                    "fleet_health")
+
+
+def rotated_siblings(path: str):
+    """Rotated sink files for `path` (see events.py size-based rotation:
+    `path.1` is the newest rotated file), oldest first — so reading
+    siblings then `path` yields one chronological stream."""
+    sibs = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        sibs.append(f"{path}.{i}")
+        i += 1
+    return list(reversed(sibs))
+
+
+def read_lines(path: str):
+    """All lines of `path`, transparently prefixed with its rotated
+    siblings (a rotated long-horizon log reads as one stream)."""
+    lines = []
+    for p in rotated_siblings(path) + [path]:
+        try:
+            with open(p) as f:
+                lines.extend(f.readlines())
+        except OSError:
+            continue
+    return lines
+
 
 def parse_lines(lines: Iterable[str]):
     """(events, bad_line_count) from raw JSONL lines."""
@@ -64,10 +102,15 @@ def parse_lines(lines: Iterable[str]):
     return events, bad
 
 
-def event_matches(rec: dict, kind: Optional[str], host: Optional[str],
+def event_matches(rec: dict, kind, host: Optional[str],
                   min_severity: Optional[str], since_ts: float = 0.0) -> bool:
-    if kind and rec.get("kind") != kind:
-        return False
+    """`kind` may be one kind name or a tuple/set of them (--health)."""
+    if kind:
+        if isinstance(kind, str):
+            if rec.get("kind") != kind:
+                return False
+        elif rec.get("kind") not in kind:
+            return False
     if host and rec.get("host") != host:
         return False
     if min_severity:
@@ -121,13 +164,50 @@ def format_diagnosis(rec: dict) -> str:
             f"[{parts}]")
 
 
-def _emit(events, as_json: bool, out=None, diagnose: bool = False):
+def format_health(rec: dict) -> str:
+    """One health event as an operator line: what went bad, where, and
+    what the runtime did about it."""
+    ts = rec.get("ts")
+    try:
+        when = datetime.fromtimestamp(float(ts)).strftime("%H:%M:%S.%f")[:-3]
+    except (TypeError, ValueError, OSError):
+        when = "??:??:??.???"
+    kind = rec.get("kind", "?")
+    step = f" step {rec['step']}" if "step" in rec else ""
+    if kind == "tensor_health":
+        where = rec.get("layer") or ",".join(rec.get("bad_groups") or []) \
+            or "?"
+        what = rec.get("bad_kind") or "nonfinite"
+        op = f" op={rec['op']}" if rec.get("op") else ""
+        detail = f"{what} in {where}{op} (src={rec.get('src', '?')})"
+    elif kind == "health_alert":
+        detail = f"{rec.get('signal', '?')}"
+        for k in ("loss", "z", "grad_norm", "reason"):
+            if rec.get(k) is not None:
+                detail += f" {k}={rec[k]}"
+    elif kind == "health_rollback":
+        detail = (f"restored checkpoint step {rec.get('restored_step')} "
+                  f"(reason={rec.get('reason')}, "
+                  f"rollback #{rec.get('rollbacks')})")
+    elif kind == "fleet_health":
+        detail = (f"host {rec.get('unhealthy')} went "
+                  f"{rec.get('status', '?')}")
+    else:
+        return format_event(rec)
+    return (f"{when} {rec.get('severity', 'info'):<5} {kind:<20} "
+            f"{rec.get('host', '?'):<16}{step} {detail}")
+
+
+def _emit(events, as_json: bool, out=None, diagnose: bool = False,
+          health: bool = False):
     out = out if out is not None else sys.stdout  # resolve at call time
     for rec in events:
         if as_json:
             line = json.dumps(rec)
         elif diagnose and rec.get("kind") == "step_diagnosis":
             line = format_diagnosis(rec)
+        elif health and rec.get("kind") in HEALTH_KINDS:
+            line = format_health(rec)
         else:
             line = format_event(rec)
         out.write(line + "\n")
@@ -141,28 +221,51 @@ def follow(path: str, args, poll_s: float = 0.5,
     (--follow-for) so scripted runs terminate on their own."""
     t0 = time.monotonic()
     diagnose = getattr(args, "diagnose", False)
-    with open(path) as f:
-        events, _ = parse_lines(f)
-        window = [e for e in events
-                  if event_matches(e, args.kind, args.host,
-                                   args.min_severity, args.since_ts)]
-        _emit(window[-args.n:] if args.n else window, args.json,
-              diagnose=diagnose)
+    health = getattr(args, "health", False)
+    # open the live file FIRST and read the backlog through the same
+    # handle: reading a snapshot and then seeking a fresh handle to EOF
+    # would silently drop events appended in between
+    f = open(path)
+    lines = []
+    for p in rotated_siblings(path):
         try:
-            while True:
-                if max_s is not None and time.monotonic() - t0 >= max_s:
-                    return 0
-                line = f.readline()
-                if not line:
-                    time.sleep(poll_s)
-                    continue
-                recs, _ = parse_lines([line])
-                _emit([r for r in recs
-                       if event_matches(r, args.kind, args.host,
-                                        args.min_severity, args.since_ts)],
-                      args.json, diagnose=diagnose)
-        except KeyboardInterrupt:
-            return 0
+            with open(p) as sib:
+                lines.extend(sib.readlines())
+        except OSError:
+            continue
+    lines.extend(f.readlines())  # leaves f at EOF for the tail loop
+    events, _ = parse_lines(lines)
+    window = [e for e in events
+              if event_matches(e, args.kind, args.host,
+                               args.min_severity, args.since_ts)]
+    _emit(window[-args.n:] if args.n else window, args.json,
+          diagnose=diagnose, health=health)
+    try:
+        while True:
+            if max_s is not None and time.monotonic() - t0 >= max_s:
+                return 0
+            line = f.readline()
+            if not line:
+                # the sink may have rotated underneath us (path is now a
+                # fresh file): reopen when the inode changed
+                try:
+                    if os.stat(path).st_ino != os.fstat(f.fileno()).st_ino:
+                        f.close()
+                        f = open(path)
+                        continue
+                except OSError:
+                    pass
+                time.sleep(poll_s)
+                continue
+            recs, _ = parse_lines([line])
+            _emit([r for r in recs
+                   if event_matches(r, args.kind, args.host,
+                                    args.min_severity, args.since_ts)],
+                  args.json, diagnose=diagnose, health=health)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        f.close()
 
 
 def main(argv=None) -> int:
@@ -188,6 +291,11 @@ def main(argv=None) -> int:
                     help="show step_diagnosis events as a per-window cost "
                          "breakdown (implies --kind step_diagnosis unless "
                          "--kind is given)")
+    ap.add_argument("--health", action="store_true",
+                    help="show training-health events (tensor_health, "
+                         "health_alert, health_rollback, fleet_health) "
+                         "with an operator-oriented rendering; filters to "
+                         "those kinds unless --kind is given")
     ap.add_argument("--json", action="store_true",
                     help="emit matching events as raw JSONL instead of the "
                          "human format")
@@ -195,22 +303,38 @@ def main(argv=None) -> int:
     args.since_ts = time.time() - args.since_sec if args.since_sec else 0.0
     if args.diagnose and args.kind is None:
         args.kind = "step_diagnosis"
+    if args.health and args.kind is None:
+        args.kind = HEALTH_KINDS
+    elif args.health and args.kind == "step_diagnosis" and args.diagnose:
+        # --health --diagnose together: health events AND the step
+        # decomposition in one stream
+        args.kind = HEALTH_KINDS + ("step_diagnosis",)
 
     if args.follow:
         if args.path == "-":
             print("obs_tail: --follow needs a file path", file=sys.stderr)
             return 2
-        if not os.path.exists(args.path):
-            print(f"obs_tail: {args.path}: no such file", file=sys.stderr)
+        try:
+            with open(args.path):
+                pass
+        except OSError as e:
+            print(f"obs_tail: {e}", file=sys.stderr)
             return 2
         return follow(args.path, args, max_s=args.follow_for) or 0
 
-    try:
-        lines = sys.stdin.readlines() if args.path == "-" \
-            else open(args.path).readlines()
-    except OSError as e:
-        print(f"obs_tail: {e}", file=sys.stderr)
-        return 2
+    if args.path == "-":
+        lines = sys.stdin.readlines()
+    else:
+        try:
+            # probe the LIVE file loudly (missing OR unreadable must exit
+            # 2, not read as an empty-and-healthy log); rotated siblings
+            # stay best-effort
+            with open(args.path):
+                pass
+        except OSError as e:
+            print(f"obs_tail: {e}", file=sys.stderr)
+            return 2
+        lines = read_lines(args.path)  # rotated siblings included
     events, bad = parse_lines(lines)
     if bad:
         print(f"obs_tail: skipped {bad} unparseable line(s)",
@@ -221,7 +345,7 @@ def main(argv=None) -> int:
                 if event_matches(e, args.kind, args.host,
                                  args.min_severity, args.since_ts)]
     _emit(matching[-args.n:] if args.n else matching, args.json,
-          diagnose=args.diagnose)
+          diagnose=args.diagnose, health=args.health)
     return 0
 
 

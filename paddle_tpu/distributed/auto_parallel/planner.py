@@ -263,6 +263,12 @@ class Planner:
         if batch[0].shape[0] % (pp * max(dp, 1)):
             return None
         hcg = HybridCommunicateGroup(dims={"dp": dp, "pp": pp})
+        # donate=False is deliberate (PR-10 donation audit): this step is a
+        # scoring PROBE — lower().compile() + cost_analysis only, never
+        # invoked — so donation buys nothing here, and a donated executable
+        # would consume the probe's param/opt buffers if a future refactor
+        # ever ran the winner directly. The production engine built from
+        # the returned Plan keeps its donate=True default.
         step = PipelineParallelTrainStep(
             self.model, self.loss_fn, self.optimizer, hcg=hcg,
             num_micro=pp, donate=False)
@@ -295,6 +301,9 @@ class Planner:
         prev = get_hybrid_communicate_group()
         set_hybrid_communicate_group(hcg)  # sdpa routes by the global hcg
         try:
+            # donate=False: compile-only scoring probe, same reasoning as
+            # the pp candidate above — never executed, so donation could
+            # only hurt (consuming probe state if ever invoked)
             step = HybridParallelTrainStep(
                 self.model, self.loss_fn, self.optimizer, hcg=hcg,
                 donate=False)

@@ -196,6 +196,23 @@ define_flag("FLAGS_compile_cache_dir",
             "hits/misses land in xla_compile_cache_events_total. "
             "Set via PADDLE_TPU_COMPILE_CACHE_DIR or set_flags; empty "
             "disables")
+# Pallas kernel autotuner (ops/pallas/autotune.py). The env vars are read
+# LIVE by the autotuner and take precedence; these flags are the set_flags-
+# able fallback when the env is unset. PADDLE_TPU_AUTOTUNE supports the
+# extra value "force" (tune even in interpret mode / on CPU — the CI
+# path), which only the env var can express.
+define_flag("FLAGS_autotune",
+            os.environ.get("PADDLE_TPU_AUTOTUNE", "1").lower() not in
+            ("0", "false", "off", "no"),
+            "benchmark Pallas kernel block-shape candidates at first real "
+            "shape encounter and use the measured winner; off = every "
+            "kernel keeps its static default pick (PADDLE_TPU_AUTOTUNE=0)")
+define_flag("FLAGS_autotune_cache_dir",
+            os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE_DIR", ""),
+            "persistent kernel-autotune cache directory: tuned block "
+            "configs keyed (op, shape-bucket, dtype, chip) as CRC'd JSON; "
+            "a fleet sharing the dir tunes once "
+            "(PADDLE_TPU_AUTOTUNE_CACHE_DIR); empty disables persistence")
 
 if os.environ.get("FLAGS_check_nan_inf"):
     _on_flag_set("FLAGS_check_nan_inf", flag("FLAGS_check_nan_inf"))

@@ -4,4 +4,9 @@ Reference analog: the CUDA `fused/` op tree
 (`/root/reference/paddle/fluid/operators/fused/`) and the KPS tile-primitive
 layer (`operators/kernel_primitives/`). Every kernel here has an XLA-composed
 fallback so the op library works on CPU test meshes.
+
+Block-shape selection is shared: `tiling.py` holds the BlockConfig
+vocabulary + candidate generation (VMEM-budgeted, Mosaic-rule-respecting)
+and `autotune.py` the measured search with a persistent
+(op, shape-bucket, dtype, chip) cache — see README "Kernel autotuning".
 """

@@ -42,6 +42,12 @@ from ..._jax_compat import (TPUCompilerParams as _TPUCompilerParams,
                             DIM_PARALLEL as _DIM_P, DIM_ARBITRARY as _DIM_A)
 import numpy as np
 
+from . import autotune as _autotune
+from . import tiling as _tiling
+from .tiling import ceil_to as _ceil_to
+from .tiling import on_tpu as _on_tpu
+from .tiling import zero_tail_rows as _zero_tail_rows
+
 _NEG = -1e30
 
 # dispatch decisions, counted at trace time (reset freely in tests)
@@ -56,13 +62,6 @@ _CARRY_LANES = 128  # m/l scratch lane width (f32 native lane tile)
 
 _DEF_BLOCK_Q = 256
 _DEF_BLOCK_K = 512
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
 
 
 def flash_attention_xla(q, k, v, mask=None, causal=False, scale=None,
@@ -192,12 +191,8 @@ def _apply_mask(s, mask_ref, mask_is_bool, rows, cols, q_len, kv_len,
     return s, masked
 
 
-def _zero_tail_rows(x, start, length):
-    """Zero block rows past `length` — OOB reads of a virtually-padded tail
-    block are undefined (NaN in the interpreter), and 0 * NaN poisons every
-    matmul the block feeds; masking s alone is not enough."""
-    rows = start + jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0)
-    return jnp.where(rows < length, x, jnp.asarray(0, x.dtype))
+# (_zero_tail_rows now lives in tiling.zero_tail_rows — shared by every
+# row-blocked kernel in the package)
 
 
 def _fa_fwd_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
@@ -433,10 +428,6 @@ def _fa_bwd_dkv_kernel(*refs, scale, causal, has_mask, mask_is_bool, block_q,
         dv_ref[...] = dvacc_ref[...].astype(dv_ref.dtype)
 
 
-def _ceil_to(n: int, m: int) -> int:
-    return -(-n // m) * m
-
-
 # Below this (square) seq length the walk-grid launches B*H tiny programs
 # whose fixed cost dwarfs the work; a single-shot kernel batching all heads
 # of one batch element per program wins (measured: BERT s128 b32 h12 d64
@@ -617,12 +608,144 @@ def _use_small_path(Lq: int, Lk: int, H: int, D: int, mask=None) -> bool:
     return vmem <= 24 * 1024 * 1024
 
 
-def _pick_blocks(Lq: int, Lk: int):
+def _static_blocks(Lq: int, Lk: int):
+    # the pre-autotune fixed picks (the PADDLE_TPU_AUTOTUNE=0 behavior);
     # blocks are multiples of 64 (covers f32/bf16 sublane granularity); a
     # block larger than the array is one virtually-padded block whose tail
     # the kernels mask in-register
     return (min(_DEF_BLOCK_Q, _ceil_to(Lq, 64)),
             min(_DEF_BLOCK_K, _ceil_to(Lk, 64)))
+
+
+def _blocks_or_static(blocks, Lq: int, Lk: int):
+    """(block_q, block_k) from a resolved config tuple, static otherwise."""
+    return blocks if blocks is not None else _static_blocks(Lq, Lk)
+
+
+# ---- autotuned block selection (tiling/autotune layer) ----------------------
+#
+# Resolution happens at DISPATCH time (like the capability probe, and for
+# the same reason: it runs compiled kernels eagerly, which is legal at
+# trace time of a user's outer jit but not inside a pallas body). The
+# resolved (fwd, bwd) configs ride the custom_vjp as a nondiff static arg,
+# so fwd and bwd each use exactly the config they were tuned and probed at.
+
+def _fa_fwd_vmem_bytes(cfg, D: int, itemsize: int, has_mask: bool) -> int:
+    bq, bk = cfg["q"], cfg["k"]
+    b = 2 * (bq * D + 2 * bk * D) * itemsize       # double-buffered q/k/v in
+    b += 2 * (bq * D * itemsize + bq * _STATS_LANES * 4)  # o/lse out
+    b += bq * D * 4 + 2 * bq * _CARRY_LANES * 4    # acc/m/l scratch
+    if has_mask:
+        b += 2 * bq * bk  # worst-case bool mask block, double-buffered
+    return b
+
+
+def _fa_bwd_vmem_bytes(cfg, Lq: int, D: int, itemsize: int,
+                       has_mask: bool, fused: bool) -> int:
+    bq, bk = cfg["q"], cfg["k"]
+    b = 2 * (2 * bq * D + 2 * bk * D) * itemsize   # q/do + k/v in
+    b += 2 * (2 * bq * _STATS_LANES * 4)           # lse/delta in
+    b += 2 * (bq * D + 2 * bk * D) * itemsize      # dq/dk/dv out
+    b += 2 * bk * D * 4                            # dk/dv scratch
+    if fused:
+        b += _ceil_to(Lq, bq) * D * 4              # whole-(b,h) dq scratch
+    else:
+        b += bq * D * 4
+    if has_mask:
+        b += 2 * bq * bk
+    return b
+
+
+# dispatch-time fast path: eager callers resolve per call, so skip the
+# candidate/bench construction once a key is decided (keyed on mode too —
+# a live PADDLE_TPU_AUTOTUNE flip must re-consult the tuner)
+_blocks_memo = _autotune.register_memo({})
+
+
+def _resolve_flash_blocks(q, k, mask, causal):
+    """((fwd_bq, fwd_bk), (bwd_bq, bwd_bk)) for the grid-walk path, or
+    None on the small path (whole-sequence blocks, nothing to tune)."""
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    dtype = q.dtype
+    if _use_small_path(Lq, Lk, H, D, mask):
+        return None
+    # fused-vs-split bwd selection depends on EXACT Lq, not its bucket —
+    # two lengths sharing a bucket can straddle the threshold, so the
+    # choice is part of the key (the tune op name carries it on disk too)
+    fused_bwd = Lq * D * 4 <= _FUSED_BWD_DQ_BYTES
+    key = (_tiling.shape_bucket(Lq), _tiling.shape_bucket(Lk), H, D,
+           jnp.dtype(dtype).name, bool(causal), _mask_key(mask))
+    memo_key = (key, fused_bwd, _INTERPRET, _autotune.mode())
+    hit = _blocks_memo.get(memo_key)
+    if hit is not None:
+        return hit
+    default = _tiling.make_config(q=_static_blocks(Lq, Lk)[0],
+                                  k=_static_blocks(Lq, Lk)[1])
+    itemsize = jnp.dtype(dtype).itemsize
+    has_mask = mask is not None
+    is_bool = has_mask and mask.dtype == jnp.bool_
+    sc = float(1.0 / np.sqrt(D))
+    # probe arrays: tiny batch/head extent — B and H are grid-PARALLEL
+    # dims, so per-block behavior (what the tune ranks) is B/H-invariant,
+    # while the walked q/k axes keep their REAL lengths
+    Bp, Hp = 2, min(H, 4)
+    buf = {}
+
+    def _args():
+        if not buf:
+            buf["q"] = jnp.ones((Bp, Lq, Hp, D), dtype)
+            buf["k"] = jnp.ones((Bp, Lk, Hp, D), dtype)
+            pm = None
+            if has_mask:
+                shp = tuple(1 if d == 1 else {0: Bp, 1: Hp, 2: Lq,
+                                              3: Lk}[ax]
+                            for ax, d in enumerate(mask.shape))
+                pm = (jnp.ones(shp, jnp.bool_) if is_bool
+                      else jnp.zeros(shp, mask.dtype))
+            buf["m"] = pm
+        return buf["q"], buf["k"], buf["m"]
+
+    def bench_fwd(cfg):
+        qa, ka, pm = _args()
+        out = _fa_fwd_pallas(qa, ka, ka, pm, bool(causal), sc,
+                             mask_is_bool=is_bool, interpret=_INTERPRET,
+                             blocks=(cfg["q"], cfg["k"]))
+        jax.block_until_ready(out)
+
+    bwd_fn = _fa_bwd_fused_pallas if fused_bwd else _fa_bwd_pallas
+
+    def bench_bwd(cfg):
+        qa, ka, pm = _args()
+        if "out" not in buf:
+            # residuals once, at the static fwd config — bwd timing must
+            # not fold a per-candidate forward into the clock
+            buf["out"], buf["lse"] = _fa_fwd_pallas(
+                qa, ka, ka, pm, bool(causal), sc, mask_is_bool=is_bool,
+                interpret=_INTERPRET, blocks=_static_blocks(Lq, Lk))
+        grads = bwd_fn(qa, ka, ka, buf["out"], buf["lse"], qa, pm,
+                       bool(causal), sc, mask_is_bool=is_bool,
+                       interpret=_INTERPRET, blocks=(cfg["q"], cfg["k"]))
+        jax.block_until_ready(grads)
+
+    qs = _tiling.axis_candidates(Lq, (128, 256, 512))
+    ks = _tiling.axis_candidates(Lk, (256, 512, 1024))
+    fwd_cfg = _autotune.get_config(
+        "flash_fwd", key, candidates=_tiling.candidate_configs(
+            ("q", "k"), [qs, ks], default,
+            vmem_bytes=lambda c: _fa_fwd_vmem_bytes(c, D, itemsize,
+                                                    has_mask)),
+        default=default, bench=bench_fwd, interpret=_INTERPRET)
+    bwd_cfg = _autotune.get_config(
+        "flash_bwd_fused" if fused_bwd else "flash_bwd_split", key,
+        candidates=_tiling.candidate_configs(
+            ("q", "k"), [qs, ks], default,
+            vmem_bytes=lambda c: _fa_bwd_vmem_bytes(c, Lq, D, itemsize,
+                                                    has_mask, fused_bwd)),
+        default=default, bench=bench_bwd, interpret=_INTERPRET)
+    result = ((fwd_cfg["q"], fwd_cfg["k"]), (bwd_cfg["q"], bwd_cfg["k"]))
+    _blocks_memo[memo_key] = result
+    return result
 
 
 def _mask_spec(mask, block_q, block_k, *, q_axis, k_axis):
@@ -670,16 +793,17 @@ def _compiler_params(interpret, n_arbitrary=1):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "causal", "scale", "mask_is_bool", "interpret"))
+    "causal", "scale", "mask_is_bool", "interpret", "blocks"))
 def _fa_fwd_pallas(q, k, v, mask, causal, scale, mask_is_bool=False,
-                   interpret=False):
-    """Returns (out [B,L,H,D], lse [B,H,Lq] f32). mask may be None."""
+                   interpret=False, blocks=None):
+    """Returns (out [B,L,H,D], lse [B,H,Lq] f32). mask may be None.
+    `blocks` is the resolved (block_q, block_k); None = static picks."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    block_q, block_k = _pick_blocks(Lq, Lk)
+    block_q, block_k = _blocks_or_static(blocks, Lq, Lk)
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     n_q, n_k = pl.cdiv(Lq, block_q), pl.cdiv(Lk, block_k)
     grid = (B, H, n_q, n_k)
@@ -825,15 +949,15 @@ _FUSED_BWD_DQ_BYTES = 6 * 1024 * 1024
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "causal", "scale", "mask_is_bool", "interpret"))
+    "causal", "scale", "mask_is_bool", "interpret", "blocks"))
 def _fa_bwd_fused_pallas(q, k, v, out, lse, do, mask, causal, scale,
-                         mask_is_bool=False, interpret=False):
+                         mask_is_bool=False, interpret=False, blocks=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    block_q, block_k = _pick_blocks(Lq, Lk)
+    block_q, block_k = _blocks_or_static(blocks, Lq, Lk)
     qt, kt, vt, dot_, ot = (jnp.swapaxes(x, 1, 2)
                             for x in (q, k, v, do, out))
     delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32), -1)
@@ -878,15 +1002,15 @@ def _fa_bwd_fused_pallas(q, k, v, out, lse, do, mask, causal, scale,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "causal", "scale", "mask_is_bool", "interpret"))
+    "causal", "scale", "mask_is_bool", "interpret", "blocks"))
 def _fa_bwd_pallas(q, k, v, out, lse, do, mask, causal, scale,
-                   mask_is_bool=False, interpret=False):
+                   mask_is_bool=False, interpret=False, blocks=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    block_q, block_k = _pick_blocks(Lq, Lk)
+    block_q, block_k = _blocks_or_static(blocks, Lq, Lk)
     qt, kt, vt, dot_, ot = (jnp.swapaxes(x, 1, 2)
                             for x in (q, k, v, do, out))
     # delta = rowsum(dout * out), fp32 [B,H,Lq] — one fused XLA pass
@@ -956,44 +1080,56 @@ def _fa_bwd_pallas(q, k, v, out, lse, do, mask, causal, scale,
 # --------------------------- custom-vjp op ----------------------------------
 
 
-def _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret):
+def _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret,
+             blocks=None):
     B, Lq, H, D = q.shape
-    f = (_fa_small_fwd_pallas if _use_small_path(Lq, k.shape[1], H, D, mask)
-         else _fa_fwd_pallas)
-    return f(q, k, v, mask, causal, scale, mask_is_bool=mask_is_bool,
-             interpret=interpret)
+    if _use_small_path(Lq, k.shape[1], H, D, mask):
+        return _fa_small_fwd_pallas(q, k, v, mask, causal, scale,
+                                    mask_is_bool=mask_is_bool,
+                                    interpret=interpret)
+    return _fa_fwd_pallas(q, k, v, mask, causal, scale,
+                          mask_is_bool=mask_is_bool, interpret=interpret,
+                          blocks=blocks[0] if blocks else None)
 
 
 def _bwd_any(q, k, v, out, lse, do, mask, causal, scale, mask_is_bool,
-             interpret):
+             interpret, blocks=None):
     B, Lq, H, D = q.shape
     if _use_small_path(Lq, k.shape[1], H, D, mask):
-        f = _fa_small_bwd_pallas
-    elif Lq * D * 4 <= _FUSED_BWD_DQ_BYTES:
+        return _fa_small_bwd_pallas(q, k, v, out, lse, do, mask, causal,
+                                    scale, mask_is_bool=mask_is_bool,
+                                    interpret=interpret)
+    if Lq * D * 4 <= _FUSED_BWD_DQ_BYTES:
         f = _fa_bwd_fused_pallas  # one-pass p/ds; dq slice fits VMEM
     else:
         f = _fa_bwd_pallas        # very long seq: split dq / dkv walks
     return f(q, k, v, out, lse, do, mask, causal, scale,
-             mask_is_bool=mask_is_bool, interpret=interpret)
+             mask_is_bool=mask_is_bool, interpret=interpret,
+             blocks=blocks[1] if blocks else None)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_fused(q, k, v, mask, causal, scale, mask_is_bool, interpret):
-    out, _ = _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_fused(q, k, v, mask, causal, scale, mask_is_bool, interpret,
+                 blocks=None):
+    out, _ = _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret,
+                      blocks)
     return out
 
 
-def _flash_fused_fwd(q, k, v, mask, causal, scale, mask_is_bool, interpret):
+def _flash_fused_fwd(q, k, v, mask, causal, scale, mask_is_bool, interpret,
+                     blocks):
     _stats["pallas_fwd"] += 1
-    out, lse = _fwd_any(q, k, v, mask, causal, scale, mask_is_bool, interpret)
+    out, lse = _fwd_any(q, k, v, mask, causal, scale, mask_is_bool,
+                        interpret, blocks)
     return out, (q, k, v, mask, out, lse)
 
 
-def _flash_fused_bwd(causal, scale, mask_is_bool, interpret, res, do):
+def _flash_fused_bwd(causal, scale, mask_is_bool, interpret, blocks, res,
+                     do):
     _stats["pallas_bwd"] += 1
     q, k, v, mask, out, lse = res
     dq, dk, dv = _bwd_any(q, k, v, out, lse, do, mask, causal, scale,
-                          mask_is_bool, interpret)
+                          mask_is_bool, interpret, blocks)
     # Only bool masks ride the fused path (dispatch keeps float masks —
     # potentially LEARNED biases — on the XLA path where their gradient is
     # real); their tangent type is float0. The assert keeps that invariant
@@ -1024,8 +1160,10 @@ def _mask_key(mask):
         int(d != 1) for d in mask.shape)
 
 
-def _pallas_fa_ok(dtype, Lq, Lk, H, D, causal, mask=None) -> bool:
-    """Eager fwd+bwd compile probe at the exact production (L, H, D) shapes.
+def _pallas_fa_ok(dtype, Lq, Lk, H, D, causal, mask=None,
+                  blocks=None) -> bool:
+    """Eager fwd+bwd compile probe at the exact production (L, H, D) shapes
+    AND the exact resolved block config.
 
     Mosaic failures inside a traced user program fire at outer-jit compile
     time where try/except can't catch; capability is therefore established
@@ -1033,10 +1171,11 @@ def _pallas_fa_ok(dtype, Lq, Lk, H, D, causal, mask=None) -> bool:
     known-good under value_and_grad before we ever commit to it. H is part
     of the probe: kernel SELECTION (`_use_small_path`) and the small path's
     per-program VMEM footprint both depend on it, so probing a fixed tiny H
-    could validate a kernel production never runs.
+    could validate a kernel production never runs. `blocks` is keyed too —
+    an autotuned config must be probed at that config.
     """
     key = (jnp.dtype(dtype).name, Lq, Lk, H, D, bool(causal),
-           _mask_key(mask), _INTERPRET)
+           _mask_key(mask), blocks, _INTERPRET)
     if key not in _pallas_fa_status:
         if not (_on_tpu() or _INTERPRET):
             _pallas_fa_status[key] = False
@@ -1058,7 +1197,7 @@ def _pallas_fa_ok(dtype, Lq, Lk, H, D, causal, mask=None) -> bool:
                 def f(q, k, v):
                     return _flash_fused(
                         q, k, v, pm, bool(causal), sc, is_bool,
-                        _INTERPRET).astype(jnp.float32).sum()
+                        _INTERPRET, blocks).astype(jnp.float32).sum()
 
                 grads = jax.grad(f, argnums=(0, 1, 2))(q, k, k)
                 jax.block_until_ready(grads)
@@ -1069,6 +1208,8 @@ def _pallas_fa_ok(dtype, Lq, Lk, H, D, causal, mask=None) -> bool:
 
 
 def _pallas_eligible(q, k, v, mask, causal) -> bool:
+    """Shape/dtype eligibility for the fused path (no probe — the caller
+    resolves blocks first, then probes via `_pallas_fa_ok`)."""
     if not (_on_tpu() or _INTERPRET):
         return False
     B, Lq, H, D = q.shape
@@ -1101,7 +1242,7 @@ def _pallas_eligible(q, k, v, mask, causal) -> bool:
         for ax, full in enumerate((B, H, Lq, Lk)):
             if mask.shape[ax] not in (1, full):
                 return False
-    return _pallas_fa_ok(q.dtype, Lq, Lk, H, D, causal, mask)
+    return True
 
 
 def flash_attention(q, k, v, mask=None, causal=False, scale=None,
@@ -1123,9 +1264,15 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None,
                                    scale=scale, dropout_p=dropout_p,
                                    dropout_key=dropout_key)
     if _pallas_eligible(q, k, v, mask, causal):
-        _stats["pallas"] += 1
-        is_bool = mask is not None and mask.dtype == jnp.bool_
-        return _flash_fused(q, k, v, mask, bool(causal), float(scale),
-                            is_bool, _INTERPRET)
+        B, Lq, H, D = q.shape
+        # blocks resolve BEFORE the capability probe: the probe must
+        # compile exactly the (possibly autotuned) config production runs
+        blocks = _resolve_flash_blocks(q, k, mask, causal)
+        if _pallas_fa_ok(q.dtype, Lq, k.shape[1], H, D, causal, mask,
+                         blocks):
+            _stats["pallas"] += 1
+            is_bool = mask is not None and mask.dtype == jnp.bool_
+            return _flash_fused(q, k, v, mask, bool(causal), float(scale),
+                                is_bool, _INTERPRET, blocks)
     _stats["xla"] += 1
     return flash_attention_xla(q, k, v, mask=mask, causal=causal, scale=scale)

@@ -40,23 +40,23 @@ from ..._jax_compat import (TPUCompilerParams as _TPUCompilerParams,
 # shared with the unfused path in nn/functional: running-stat parity
 # requires the statistics formulation to be THE SAME code
 from .._bn_common import _bn_axes, _bn_stats
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
+from . import autotune as _autotune
+from . import tiling as _tiling
+from .tiling import on_tpu as _on_tpu
 
 
 _INTERPRET = False  # tests flip this to run the kernels in the interpreter
 
 _stats = {"pallas_fwd": 0, "pallas_bwd": 0, "xla_fwd": 0, "xla_bwd": 0}
 
-_BLOCK_ROWS = 256   # fixed block shape — the capability probe compiles
-                    # exactly (_BLOCK_ROWS, C); see layer_norm.py
+_DEF_BLOCK_ROWS = 256  # static pick (the PADDLE_TPU_AUTOTUNE=0 behavior);
+                       # also the eligibility floor: R below this stays XLA
 _MAX_PALLAS_C = 2048  # three (256, C) fp32 buffers must fit VMEM
 _SUBLANES = 8       # fp32 sublane count — reduction outputs are (8, C)
+
+# autotune probes cap their synthetic row count: the kernels are pure
+# row-block streams, so candidate ranking at a bounded R ranks any R
+_BENCH_MAX_ROWS = 65536
 
 
 # ----------------------------- shared math ----------------------------------
@@ -88,12 +88,14 @@ def _fwd_kernel(*refs, act, has_add):
     o_ref[...] = y.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("act", "has_add", "interpret"))
-def _bn_act_fwd_pallas(x2d, z2d, k, c, act, has_add, interpret=False):
+@functools.partial(jax.jit, static_argnames=("act", "has_add", "interpret",
+                                             "block_rows"))
+def _bn_act_fwd_pallas(x2d, z2d, k, c, act, has_add, interpret=False,
+                       block_rows=_DEF_BLOCK_ROWS):
     from jax.experimental import pallas as pl
 
     R, C = x2d.shape
-    br = _BLOCK_ROWS
+    br = block_rows
     rowspec = pl.BlockSpec((br, C), lambda i: (i, 0))
     chanspec = pl.BlockSpec((C,), lambda i: (0,))
     in_specs = [rowspec] + ([rowspec] if has_add else []) + [chanspec,
@@ -143,12 +145,14 @@ def _bwd_reduce_kernel(x_ref, y_ref, dy_ref, mean_ref, inv_ref,
     dg_ref[...] = dg_ref[...] + jnp.broadcast_to(dg[None, :], dg_ref.shape)
 
 
-@functools.partial(jax.jit, static_argnames=("act", "interpret"))
-def _bn_bwd_reduce_pallas(x2d, y2d, dy2d, mean, inv, act, interpret=False):
+@functools.partial(jax.jit, static_argnames=("act", "interpret",
+                                             "block_rows"))
+def _bn_bwd_reduce_pallas(x2d, y2d, dy2d, mean, inv, act, interpret=False,
+                          block_rows=_DEF_BLOCK_ROWS):
     from jax.experimental import pallas as pl
 
     R, C = x2d.shape
-    br = _BLOCK_ROWS
+    br = block_rows
     rowspec = pl.BlockSpec((br, C), lambda i: (i, 0))
     chanspec = pl.BlockSpec((C,), lambda i: (0,))
     accspec = pl.BlockSpec((_SUBLANES, C), lambda i: (0, 0))
@@ -180,13 +184,14 @@ def _bwd_dx_kernel(x_ref, y_ref, dy_ref, a_ref, b_ref, c0_ref, *out_refs,
         out_refs[1][...] = g.astype(out_refs[1].dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("act", "has_add", "interpret"))
+@functools.partial(jax.jit, static_argnames=("act", "has_add", "interpret",
+                                             "block_rows"))
 def _bn_bwd_dx_pallas(x2d, y2d, dy2d, a, b, c0, act, has_add,
-                      interpret=False):
+                      interpret=False, block_rows=_DEF_BLOCK_ROWS):
     from jax.experimental import pallas as pl
 
     R, C = x2d.shape
-    br = _BLOCK_ROWS
+    br = block_rows
     rowspec = pl.BlockSpec((br, C), lambda i: (i, 0))
     chanspec = pl.BlockSpec((C,), lambda i: (0,))
     out_shape = [jax.ShapeDtypeStruct((R, C), x2d.dtype)]
@@ -208,27 +213,97 @@ def _bn_bwd_dx_pallas(x2d, y2d, dy2d, a, b, c0, act, has_add,
     return outs  # list: [dx] or [dx, dz] (out_shape is always a list)
 
 
-# ----------------------------- capability probe -----------------------------
+# ------------------------ block selection + probe ---------------------------
 
 _probe_status = {}
 
 
-def _probe_ok(dtype, C: int, has_add: bool) -> bool:
-    """Per-(dtype, channels) EAGER compile probe at the exact fixed block
-    shape production uses — a Mosaic failure inside a traced user program
-    cannot be caught (see layer_norm._pallas_ln_ok)."""
-    key = (jnp.dtype(dtype).name, C, has_add, _INTERPRET)
+def _bn_vmem_bytes(cfg, C: int, itemsize: int, has_add: bool) -> int:
+    # worst pass is bwd dx: three double-buffered (br, C) inputs
+    # (x/y/dy), the dx output — plus dz for the residual-add family —
+    # and the fp32 x/g compute intermediates
+    br = cfg["rows"]
+    n_out = 2 if has_add else 1
+    return (3 + n_out) * (2 * br * C * itemsize) + 2 * br * C * 4
+
+
+_blocks_memo = _autotune.register_memo({})
+
+
+def _block_rows_for(dtype, R: int, C: int, has_add: bool) -> int:
+    """Autotuned row-block extent shared by all three kernels of this
+    family (fwd, bwd-reduce, bwd-dx) — one tune times the full chain, the
+    shapes a training step actually runs. Static _DEF_BLOCK_ROWS when
+    tuning is off for this mode/platform. (A tuned extent larger than a
+    bucket-aliased smaller R is fine here: the reduce kernel masks the
+    `R % br` tail and the elementwise passes clip on write.)"""
+    memo_key = (_tiling.shape_bucket(R, floor=_DEF_BLOCK_ROWS), C,
+                jnp.dtype(dtype).name, has_add, _INTERPRET,
+                _autotune.mode())
+    hit = _blocks_memo.get(memo_key)
+    if hit is not None:
+        return hit
+    default = _tiling.make_config(rows=_DEF_BLOCK_ROWS)
+    itemsize = jnp.dtype(dtype).itemsize
+    cands = _tiling.candidate_configs(
+        ("rows",),
+        [_tiling.axis_candidates(R, (128, 256, 512, 1024),
+                                 grain=_tiling.sublane(dtype))],
+        default, vmem_bytes=lambda c: _bn_vmem_bytes(c, C, itemsize,
+                                                     has_add))
+    rb = min(_tiling.shape_bucket(R, floor=_DEF_BLOCK_ROWS), _BENCH_MAX_ROWS)
+    buf = {}
+
+    def bench(cfg):
+        if not buf:
+            buf["x"] = jnp.ones((rb, C), dtype)
+            buf["v"] = jnp.ones((C,), jnp.float32)
+        x, v = buf["x"], buf["v"]
+        br = cfg["rows"]
+        y = _bn_act_fwd_pallas(x, x if has_add else None, v, v, act="relu",
+                               has_add=has_add, interpret=_INTERPRET,
+                               block_rows=br)
+        db, dg = _bn_bwd_reduce_pallas(x, y, x, v, v, act="relu",
+                                       interpret=_INTERPRET, block_rows=br)
+        outs = _bn_bwd_dx_pallas(x, y, x, v, v, v, act="relu",
+                                 has_add=has_add, interpret=_INTERPRET,
+                                 block_rows=br)
+        jax.block_until_ready((y, db, dg, outs))
+
+    cfg = _autotune.get_config(
+        "fused_bn", key=memo_key[:4],
+        candidates=cands, default=default, bench=bench,
+        interpret=_INTERPRET)
+    _blocks_memo[memo_key] = cfg["rows"]
+    return cfg["rows"]
+
+
+def _probe_ok(dtype, C: int, has_add: bool,
+              block_rows: int = _DEF_BLOCK_ROWS,
+              tail: bool = False) -> bool:
+    """Per-(dtype, channels, block-rows, tail?) EAGER compile probe at the
+    exact block shape production uses — a Mosaic failure inside a traced
+    user program cannot be caught (see layer_norm._pallas_ln_ok). `tail`
+    selects the `R % br` masked-reduce variant (a different Mosaic
+    program, gated by `if R % br:` in the kernel): production shapes with
+    a partial last block must probe THAT variant, so the probe array gets
+    one extra sublane of rows."""
+    key = (jnp.dtype(dtype).name, C, has_add, block_rows, tail, _INTERPRET)
     if key not in _probe_status:
         try:
-            x = jnp.ones((_BLOCK_ROWS, C), dtype)
+            x = jnp.ones((block_rows + (_SUBLANES if tail else 0), C),
+                         dtype)
             v = jnp.ones((C,), jnp.float32)
             y = _bn_act_fwd_pallas(x, x if has_add else None, v, v,
                                    act="relu", has_add=has_add,
-                                   interpret=_INTERPRET)
+                                   interpret=_INTERPRET,
+                                   block_rows=block_rows)
             db, dg = _bn_bwd_reduce_pallas(x, y, x, v, v, act="relu",
-                                           interpret=_INTERPRET)
+                                           interpret=_INTERPRET,
+                                           block_rows=block_rows)
             outs = _bn_bwd_dx_pallas(x, y, x, v, v, v, act="relu",
-                                     has_add=has_add, interpret=_INTERPRET)
+                                     has_add=has_add, interpret=_INTERPRET,
+                                     block_rows=block_rows)
             jax.block_until_ready((y, db, dg, outs))
             _probe_status[key] = True
         except Exception:
@@ -245,13 +320,14 @@ def _pallas_eligible(x, data_format: str, has_add: bool) -> bool:
     R = 1
     for d in x.shape[:-1]:
         R *= d
-    if not isinstance(R, int) or R < _BLOCK_ROWS or R % _SUBLANES:
+    if not isinstance(R, int) or R < _DEF_BLOCK_ROWS or R % _SUBLANES:
         return False
     if C % 128 or C > _MAX_PALLAS_C:
         return False
     if x.dtype not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         return False
-    return _probe_ok(x.dtype, C, has_add)
+    br = _block_rows_for(x.dtype, R, C, has_add)
+    return _probe_ok(x.dtype, C, has_add, br, tail=R % br != 0)
 
 
 # ----------------------------- fwd/bwd common -------------------------------
@@ -267,8 +343,10 @@ def _fwd_common(x, z, gamma, beta, eps, data_format, act):
         C = x.shape[-1]
         x2d = x.reshape(-1, C)
         z2d = z.reshape(-1, C) if has_add else None
+        br = _block_rows_for(x.dtype, x2d.shape[0], C, has_add)
         y = _bn_act_fwd_pallas(x2d, z2d, k, c, act=act, has_add=has_add,
-                               interpret=_INTERPRET).reshape(x.shape)
+                               interpret=_INTERPRET,
+                               block_rows=br).reshape(x.shape)
     else:
         _stats["xla_fwd"] += 1
         yf = x.astype(jnp.float32) * k.reshape(shape) + c.reshape(shape)
@@ -293,8 +371,9 @@ def _bwd_common(res, cots, eps, data_format, act, has_add):
         _stats["pallas_bwd"] += 1
         C = x.shape[-1]
         x2d, y2d, dy2d = (t.reshape(-1, C) for t in (x, y, dy))
+        br = _block_rows_for(x.dtype, x2d.shape[0], C, has_add)
         db, dg = _bn_bwd_reduce_pallas(x2d, y2d, dy2d, mean, inv, act=act,
-                                       interpret=_INTERPRET)
+                                       interpret=_INTERPRET, block_rows=br)
     else:
         _stats["xla_bwd"] += 1
         g = dy.astype(jnp.float32)
@@ -321,7 +400,8 @@ def _bwd_common(res, cots, eps, data_format, act, has_add):
         C = x.shape[-1]
         x2d, y2d, dy2d = (t.reshape(-1, C) for t in (x, y, dy))
         outs = _bn_bwd_dx_pallas(x2d, y2d, dy2d, A, B, C0, act=act,
-                                 has_add=has_add, interpret=_INTERPRET)
+                                 has_add=has_add, interpret=_INTERPRET,
+                                 block_rows=br)
         dx = outs[0].reshape(x.shape)
         dz = outs[1].reshape(x.shape) if has_add else None
     else:

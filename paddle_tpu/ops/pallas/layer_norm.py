@@ -19,12 +19,14 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from . import autotune as _autotune
+from . import tiling as _tiling
+from .tiling import on_tpu as _on_tpu
 
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
+
+_INTERPRET = False  # tests flip this: kernel runs in the Pallas interpreter
+
+_DEF_BLOCK_ROWS = 256  # static pick (the PADDLE_TPU_AUTOTUNE=0 behavior)
 
 
 # ----------------------------- forward --------------------------------------
@@ -37,8 +39,10 @@ def _ln_stats_xla(x2d: jax.Array, eps: float):
     return mean, rstd
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "block_rows"))
-def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5, block_rows: int = 256):
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "block_rows", "interpret"))
+def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5,
+                   block_rows: int = _DEF_BLOCK_ROWS, interpret: bool = False):
     from jax.experimental import pallas as pl
 
     R, N = x2d.shape
@@ -55,9 +59,10 @@ def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5, block_rows: int = 256):
         y = xhat * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
         o_ref[...] = y.astype(o_ref.dtype)
 
-    br = block_rows  # FIXED block shape — the capability probe compiled
-    # exactly (block_rows, N); a data-dependent br would run unprobed
-    # Mosaic variants inside the user's jit (callers gate on R >= br)
+    br = block_rows  # STATIC block shape — the capability probe compiled
+    # exactly (block_rows, N); the autotuner resolves br BEFORE dispatch
+    # (memory-cached per shape bucket), so no unprobed Mosaic variant can
+    # run inside the user's jit (callers gate on R >= _DEF_BLOCK_ROWS)
     grid = (pl.cdiv(R, br),)  # cover ALL rows; the edge block is masked
     return pl.pallas_call(
         kernel,
@@ -69,28 +74,82 @@ def _ln_fwd_pallas(x2d, gamma, beta, eps: float = 1e-5, block_rows: int = 256):
         ],
         out_specs=pl.BlockSpec((br, N), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((R, N), x2d.dtype),
+        interpret=interpret,
     )(x2d, gamma, beta)
 
 
-_pallas_ln_status = {}  # (dtype, N) -> bool
+_pallas_ln_status = {}  # (dtype, N, block_rows) -> bool
 
 _MAX_PALLAS_N = 4096  # block (256, N) must fit VMEM with fp32 intermediates
 
+# probe arrays are capped: per-row independence makes timing linear in R,
+# so ranking at a bounded row count ranks the full array too
+_BENCH_MAX_ROWS = 65536
 
-def _pallas_ln_ok(dtype, N: int) -> bool:
-    """Per-(dtype, hidden-size) EAGER compile probe. A Mosaic failure inside
-    a traced user program cannot be caught (the exception fires at compile
-    time of the outer jit), so capability is established eagerly with the
-    exact kernel shape that production will use."""
-    key = (jnp.dtype(dtype).name, N)
+
+def _ln_vmem_bytes(cfg, N: int, itemsize: int) -> int:
+    br = cfg["rows"]
+    # double-buffered in/out blocks + the fp32 compute intermediate
+    return 2 * (2 * br * N * itemsize) + br * N * 4
+
+
+_blocks_memo = _autotune.register_memo({})
+
+
+def _block_rows_for(R: int, N: int, dtype) -> int:
+    """Autotuned row-block extent (static _DEF_BLOCK_ROWS when tuning is
+    off for this mode/platform). Keyed by (R-bucket, N, dtype, chip)."""
+    memo_key = (_tiling.shape_bucket(R, floor=_DEF_BLOCK_ROWS), N,
+                jnp.dtype(dtype).name, _INTERPRET, _autotune.mode())
+    hit = _blocks_memo.get(memo_key)
+    if hit is None:
+        default = _tiling.make_config(rows=_DEF_BLOCK_ROWS)
+        itemsize = jnp.dtype(dtype).itemsize
+        cands = _tiling.candidate_configs(
+            ("rows",),
+            [_tiling.axis_candidates(R, (128, 256, 512, 1024),
+                                     grain=_tiling.sublane(dtype))],
+            default, vmem_bytes=lambda c: _ln_vmem_bytes(c, N, itemsize))
+        rb = min(_tiling.shape_bucket(R, floor=_DEF_BLOCK_ROWS),
+                 _BENCH_MAX_ROWS)
+        buf = {}
+
+        def bench(cfg):
+            if not buf:
+                buf["x"] = jnp.ones((rb, N), dtype)
+                buf["g"] = jnp.ones((N,), dtype)
+            jax.block_until_ready(_ln_fwd_pallas(
+                buf["x"], buf["g"], buf["g"], eps=1e-5,
+                block_rows=cfg["rows"], interpret=_INTERPRET))
+
+        cfg = _autotune.get_config(
+            "layer_norm_fwd", key=memo_key[:3],
+            candidates=cands, default=default, bench=bench,
+            interpret=_INTERPRET)
+        hit = _blocks_memo[memo_key] = cfg["rows"]
+    # shape buckets alias: a config tuned at the bucket's top can exceed a
+    # smaller R in the same bucket — an extent that was never a candidate
+    # (and may be Mosaic-illegal) — so fall back to the static pick, which
+    # the eligibility floor (R >= _DEF_BLOCK_ROWS) keeps legal
+    return hit if hit <= R else _DEF_BLOCK_ROWS
+
+
+def _pallas_ln_ok(dtype, N: int, block_rows: int = _DEF_BLOCK_ROWS) -> bool:
+    """Per-(dtype, hidden-size, block-rows) EAGER compile probe. A Mosaic
+    failure inside a traced user program cannot be caught (the exception
+    fires at compile time of the outer jit), so capability is established
+    eagerly with the exact kernel shape that production will use."""
+    key = (jnp.dtype(dtype).name, N, block_rows)
     if key not in _pallas_ln_status:
-        if not _on_tpu() or N > _MAX_PALLAS_N:
+        if not (_on_tpu() or _INTERPRET) or N > _MAX_PALLAS_N:
             _pallas_ln_status[key] = False
         else:
             try:
-                probe = jnp.ones((256, N), dtype)
+                probe = jnp.ones((block_rows, N), dtype)
                 g = jnp.ones((N,), dtype)
-                jax.block_until_ready(_ln_fwd_pallas(probe, g, g, eps=1e-5))
+                jax.block_until_ready(_ln_fwd_pallas(
+                    probe, g, g, eps=1e-5, block_rows=block_rows,
+                    interpret=_INTERPRET))
                 _pallas_ln_status[key] = True
             except Exception:
                 _pallas_ln_status[key] = False
@@ -101,10 +160,13 @@ def _ln_fwd(x2d, gamma, beta, eps):
     """Forward output only — stats are recomputed where needed (backward),
     so the forward is a single read of x."""
     R, N = x2d.shape
-    if isinstance(R, int) and R >= 256 and R % 8 == 0 and N % 128 == 0 \
-            and x2d.dtype == gamma.dtype \
-            and _pallas_ln_ok(x2d.dtype, N):
-        return _ln_fwd_pallas(x2d, gamma, beta, eps=eps)
+    if isinstance(R, int) and R >= _DEF_BLOCK_ROWS and R % 8 == 0 \
+            and N % 128 == 0 and x2d.dtype == gamma.dtype \
+            and (_on_tpu() or _INTERPRET) and N <= _MAX_PALLAS_N:
+        br = _block_rows_for(R, N, x2d.dtype)
+        if _pallas_ln_ok(x2d.dtype, N, br):
+            return _ln_fwd_pallas(x2d, gamma, beta, eps=eps, block_rows=br,
+                                  interpret=_INTERPRET)
     mean, rstd = _ln_stats_xla(x2d, eps)
     xhat = (x2d.astype(jnp.float32) - mean[:, None]) * rstd[:, None]
     return (xhat * gamma.astype(jnp.float32) + beta.astype(jnp.float32)

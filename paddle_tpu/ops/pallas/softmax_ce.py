@@ -36,6 +36,11 @@ from ..._jax_compat import (TPUCompilerParams as _TPUCompilerParams,
                             DIM_PARALLEL as _DIM_P, DIM_ARBITRARY as _DIM_A)
 import numpy as np
 
+from . import autotune as _autotune
+from . import tiling as _tiling
+from .tiling import ceil_to as _ceil_to
+from .tiling import on_tpu as _on_tpu
+
 _NEG = -1e30
 
 _stats = {"pallas": 0, "pallas_fwd": 0, "pallas_bwd": 0, "xla": 0}
@@ -48,21 +53,69 @@ _CARRY_LANES = 128  # m/l scratch lane width
 _DEF_BLOCK_N = 256
 _DEF_BLOCK_V = 2048
 
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
+# autotune probe row cap: rows are independent (grid-parallel), so a
+# bounded-N probe ranks candidates for any N; V is walked in full — the
+# vocab-block choice is exactly what is being tuned
+_BENCH_MAX_N = 4096
 
 
-def _ceil_to(n: int, m: int) -> int:
-    return -(-n // m) * m
-
-
-def _pick_blocks(N: int, V: int):
+def _static_blocks(N: int, V: int):
+    """The pre-autotune fixed picks (the PADDLE_TPU_AUTOTUNE=0 behavior)."""
     return (min(_DEF_BLOCK_N, _ceil_to(N, 64)),
             min(_DEF_BLOCK_V, _ceil_to(V, 128)))
+
+
+def _ce_vmem_bytes(cfg, itemsize: int) -> int:
+    bn, bv = cfg["n"], cfg["v"]
+    # double-buffered logits block + (bwd) dlogits out block + fp32
+    # compute intermediate + carry scratch
+    return 2 * bn * bv * itemsize * 2 + bn * bv * 4 + 3 * bn * _CARRY_LANES * 4
+
+
+_blocks_memo = _autotune.register_memo({})
+
+
+def _blocks_for(N: int, V: int, dtype):
+    """Autotuned (block_n, block_v): one tune per (N-bucket, V, dtype,
+    chip) times the fwd+bwd chain at the real vocab width. Static picks
+    when tuning is off for this mode/platform."""
+    memo_key = (_tiling.shape_bucket(N), V, jnp.dtype(dtype).name,
+                _INTERPRET, _autotune.mode())
+    hit = _blocks_memo.get(memo_key)
+    if hit is not None:
+        return hit
+    default = _tiling.make_config(n=_static_blocks(N, V)[0],
+                                  v=_static_blocks(N, V)[1])
+    itemsize = jnp.dtype(dtype).itemsize
+    cands = _tiling.candidate_configs(
+        ("n", "v"),
+        [_tiling.axis_candidates(N, (128, 256, 512), grain=64),
+         _tiling.axis_candidates(V, (1024, 2048, 4096, 8192),
+                                 grain=_tiling.LANE)],
+        default, vmem_bytes=lambda c: _ce_vmem_bytes(c, itemsize))
+    nb = min(_tiling.shape_bucket(N), _BENCH_MAX_N)
+    buf = {}
+
+    def bench(cfg):
+        if not buf:
+            buf["lg"] = jnp.ones((nb, V), dtype)
+            buf["lb"] = jnp.zeros((nb,), jnp.int32)
+            buf["dn"] = jnp.ones((nb,), jnp.float32)
+        lg, lb, dn = buf["lg"], buf["lb"], buf["dn"]
+        blocks = (cfg["n"], cfg["v"])
+        nll, lse = _ce_fwd_pallas(lg, lb, blocks=blocks,
+                                  interpret=_INTERPRET)
+        dl = _ce_bwd_pallas(lg, lb, lse, dn, blocks=blocks,
+                            interpret=_INTERPRET)
+        jax.block_until_ready((nll, dl))
+
+    cfg = _autotune.get_config(
+        "softmax_ce",
+        key=(_tiling.shape_bucket(N), V, jnp.dtype(dtype).name),
+        candidates=cands, default=default, bench=bench,
+        interpret=_INTERPRET)
+    _blocks_memo[memo_key] = (cfg["n"], cfg["v"])
+    return cfg["n"], cfg["v"]
 
 
 def _ce_fwd_kernel(logits_ref, label_ref, nll_ref, lse_ref, m_ref, l_ref,
@@ -137,14 +190,15 @@ def _ce_bwd_kernel(logits_ref, label_ref, lse_ref, dnll_ref, dlogits_ref, *,
     dlogits_ref[...] = ((p - onehot) * dnll).astype(dlogits_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _ce_fwd_pallas(logits, labels, interpret=False):
-    """logits [N, V], labels [N] int32 -> (nll [N] f32, lse [N] f32)."""
+@functools.partial(jax.jit, static_argnames=("blocks", "interpret"))
+def _ce_fwd_pallas(logits, labels, blocks=None, interpret=False):
+    """logits [N, V], labels [N] int32 -> (nll [N] f32, lse [N] f32).
+    `blocks` is the resolved (block_n, block_v); None = static picks."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     N, V = logits.shape
-    block_n, block_v = _pick_blocks(N, V)
+    block_n, block_v = blocks or _static_blocks(N, V)
     n_n, n_v = pl.cdiv(N, block_n), pl.cdiv(V, block_v)
     lab_p = jnp.broadcast_to(labels.astype(jnp.int32)[:, None],
                              (N, _STATS_LANES))
@@ -175,13 +229,13 @@ def _ce_fwd_pallas(logits, labels, interpret=False):
     return nll[:, 0], lse[:, 0]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _ce_bwd_pallas(logits, labels, lse, dnll, interpret=False):
+@functools.partial(jax.jit, static_argnames=("blocks", "interpret"))
+def _ce_bwd_pallas(logits, labels, lse, dnll, blocks=None, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     N, V = logits.shape
-    block_n, block_v = _pick_blocks(N, V)
+    block_n, block_v = blocks or _static_blocks(N, V)
     n_n, n_v = pl.cdiv(N, block_n), pl.cdiv(V, block_v)
     lab_p = jnp.broadcast_to(labels.astype(jnp.int32)[:, None],
                              (N, _STATS_LANES))
@@ -208,22 +262,25 @@ def _ce_bwd_pallas(logits, labels, lse, dnll, interpret=False):
     return dlogits
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def _fused_ce(logits, labels, interpret):
-    nll, _ = _ce_fwd_pallas(logits, labels, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _fused_ce(logits, labels, interpret, blocks=None):
+    nll, _ = _ce_fwd_pallas(logits, labels, blocks=blocks,
+                            interpret=interpret)
     return nll
 
 
-def _fused_ce_fwd(logits, labels, interpret):
+def _fused_ce_fwd(logits, labels, interpret, blocks):
     _stats["pallas_fwd"] += 1
-    nll, lse = _ce_fwd_pallas(logits, labels, interpret=interpret)
+    nll, lse = _ce_fwd_pallas(logits, labels, blocks=blocks,
+                              interpret=interpret)
     return nll, (logits, labels, lse)
 
 
-def _fused_ce_bwd(interpret, res, dnll):
+def _fused_ce_bwd(interpret, blocks, res, dnll):
     _stats["pallas_bwd"] += 1
     logits, labels, lse = res
-    dlogits = _ce_bwd_pallas(logits, labels, lse, dnll, interpret=interpret)
+    dlogits = _ce_bwd_pallas(logits, labels, lse, dnll, blocks=blocks,
+                             interpret=interpret)
     return dlogits, np.zeros(labels.shape, jax.dtypes.float0)
 
 
@@ -233,9 +290,11 @@ _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 _status = {}
 
 
-def _probe_ok(dtype, N, V) -> bool:
-    """Eager fwd+bwd compile probe (see flash_attention._pallas_fa_ok)."""
-    key = (jnp.dtype(dtype).name, N, V, _INTERPRET)
+def _probe_ok(dtype, N, V, blocks=None) -> bool:
+    """Eager fwd+bwd compile probe (see flash_attention._pallas_fa_ok) at
+    the RESOLVED block config — probing static picks while production runs
+    tuned ones would validate a kernel production never executes."""
+    key = (jnp.dtype(dtype).name, N, V, blocks, _INTERPRET)
     if key not in _status:
         if not (_on_tpu() or _INTERPRET):
             _status[key] = False
@@ -243,7 +302,8 @@ def _probe_ok(dtype, N, V) -> bool:
             try:
                 lg = jnp.ones((N, V), dtype)
                 lb = jnp.zeros((N,), jnp.int32)
-                g = jax.grad(lambda x: _fused_ce(x, lb, _INTERPRET).sum())(lg)
+                g = jax.grad(lambda x: _fused_ce(x, lb, _INTERPRET,
+                                                 blocks).sum())(lg)
                 jax.block_until_ready(g)
                 _status[key] = True
             except Exception:
@@ -273,7 +333,8 @@ def fused_softmax_ce_eligible(logits, labels) -> bool:
     N = int(np.prod(logits.shape[:-1])) if logits.ndim > 1 else 1
     if N < 64:
         return False
-    return _probe_ok(logits.dtype, N, logits.shape[-1])
+    blocks = _blocks_for(N, logits.shape[-1], logits.dtype)
+    return _probe_ok(logits.dtype, N, logits.shape[-1], blocks)
 
 
 def fused_softmax_ce(logits, labels):
@@ -290,4 +351,5 @@ def fused_softmax_ce(logits, labels):
     flat = logits.reshape((-1, V))
     flab = labels.reshape((-1,))
     _stats["pallas"] += 1
-    return _fused_ce(flat, flab, _INTERPRET).reshape(shape)
+    blocks = _blocks_for(flat.shape[0], V, flat.dtype)
+    return _fused_ce(flat, flab, _INTERPRET, blocks).reshape(shape)

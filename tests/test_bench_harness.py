@@ -51,7 +51,15 @@ def test_one_config_failure_does_not_sink_others(capsys, monkeypatch):
     rec = _run_main(bench, capsys)
     assert rec["value"] == 123.0
     assert "boom" in rec["configs"]["resnet50"]["error"]
-    assert rec["configs"]["bert_base_seq128"] == {"ok": 1}
+    bert = rec["configs"]["bert_base_seq128"]
+    assert bert["ok"] == 1
+    # every config carries its autotune activity block (PR-10), valid per
+    # the check_bench_result schema
+    assert isinstance(bert["autotune"], dict)
+    assert isinstance(bert["autotune"]["enabled"], bool)
+    from tools import check_bench_result as gate
+    assert not [p for p in gate.validate_observability(rec)
+                if "autotune" in p]
     assert "error" not in rec
 
 
@@ -102,7 +110,9 @@ def test_bench_json_includes_observability_snapshot(capsys, monkeypatch):
     assert isinstance(obs["compile_attribution"], dict)
     for entry, stats in obs["compile_attribution"].items():
         assert stats["count"] >= 1 and stats["seconds"] >= 0
-    assert obs["device_time"]["mode"] in ("estimate", "measured")
+    # --profile-steps is default-ON (ROADMAP 1c), so the eager probe runs
+    # under an xplane capture unless opted out
+    assert obs["device_time"]["mode"] in ("estimate", "measured", "xplane")
     assert obs["device_time"]["rows"], "device-time probe produced no rows"
     for ev in obs["events_tail"]:
         validate_event(ev)

@@ -208,6 +208,7 @@ class TestMetricNamingLint:
         import paddle_tpu.io.dataloader  # noqa: F401
         import paddle_tpu.io.worker  # noqa: F401
         import paddle_tpu.ops._dispatch  # noqa: F401
+        import paddle_tpu.ops.pallas.autotune  # noqa: F401
         import paddle_tpu.profiler.compile_watch  # noqa: F401
         import paddle_tpu.profiler.health  # noqa: F401
         import paddle_tpu.profiler.watchdog  # noqa: F401
@@ -258,6 +259,14 @@ class TestMetricNamingLint:
         import paddle_tpu.amp as _amp
         _amp._M_FOUND_INF.inc()
         _amp._M_LOSS_SCALE.set(32768.0)
+        # kernel-autotuner families: cache events (event=, op=), tune
+        # counter (op=), probe histogram (op=), chosen-config gauge
+        # (op=, config=)
+        from paddle_tpu.ops.pallas import autotune as _at
+        _at._M_EVENTS.inc(event="hit", op="lint_op")
+        _at._M_TUNES.inc(op="lint_op")
+        _at._M_PROBE_SECONDS.observe(0.001, op="lint_op")
+        _at._M_CHOSEN.set(1.0, op="lint_op", config="q256-k512")
         reg = metrics.default_registry()
         problems = []
         for name in reg.names():

@@ -80,6 +80,49 @@ def _apply_scaled_update(optimizer, params, grads, opt_state, lr, t,
     return new_params, new_opt, {"scale": new_scale, "good": new_good}
 
 
+def _build_health_probe(params: Dict[str, object], health):
+    """The PR-9 in-graph numerics sentinel for the parallel engines, which
+    build their own compiled steps and did not carry it (carried-over
+    ROADMAP follow-up). Returns (probe | None, interval). `health=None`
+    follows PADDLE_TPU_HEALTH / FLAGS_check_nan_inf like jit.TrainStep."""
+    from ...profiler import health as _health_mod
+    if health is None:
+        health = _health_mod.enabled()
+    probe = _health_mod.HealthProbe(params) if health else None
+    return probe, _health_mod.interval()
+
+
+def _health_grads(grads, scaler_state, fp16: bool):
+    """Grads as the health sentinel should see them. Under fp16 dynamic
+    loss scaling the raw grads are loss-SCALED (norms inflated by the
+    scale, up to 2^15) and an occasional non-finite scaled grad is the
+    scaler's NORMAL overflow signal (the update is skipped and the scale
+    halves, GradScaler semantics) — not a divergence: unscale, and mask
+    non-finite lanes to 0 so scaler events never trip the sentinel (real
+    divergence still shows through the loss flag and the pre-update param
+    flags). bf16/fp32 paths pass through untouched."""
+    if not fp16:
+        return grads
+    inv = 1.0 / scaler_state["scale"]
+    return jax.tree_util.tree_map(
+        lambda g: jnp.where(jnp.isfinite(g), g * inv, 0.0), grads)
+
+
+def _note_health(step_obj, hvec):
+    """Decode + record one sentinel vector (the tier's single device->host
+    fetch). Parallel steps record like jit.TrainStep but skip the eager
+    replay (the sharded batch has no eager single-host replay path); the
+    per-group PRE-UPDATE param flags still name the first bad layer group.
+    Never raises."""
+    from ...profiler import health as _health_mod
+    try:
+        stats = step_obj._health_probe.decode(hvec)
+        step_obj.last_health = _health_mod.record_step_stats(
+            stats, step=step_obj._t, source="sentinel")
+    except Exception:
+        pass
+
+
 def _parse_strategy(strategy, sizes):
     """(amp_enabled, amp_dtype, recompute, sharding_stage, accum_steps)."""
     amp_enabled = bool(strategy and strategy.amp)
@@ -155,7 +198,7 @@ class HybridParallelTrainStep:
     def __init__(self, layer: Layer, loss_fn: Callable, optimizer,
                  hcg: Optional[HybridCommunicateGroup] = None,
                  strategy=None, batch_specs: Optional[Sequence[P]] = None,
-                 donate: bool = True):
+                 donate: bool = True, health=None):
         from ...jit import functionalize
         self.layer = layer
         self.optimizer = optimizer
@@ -210,6 +253,11 @@ class HybridParallelTrainStep:
             *((data_axes,) + (("sp",) if (sp_on and ndim >= 2) else ())
               + (None,) * max(0, ndim - 2)))
         self.batch_specs = batch_specs
+
+        self._health_probe, self._health_interval = _build_health_probe(
+            self.params, health)
+        self.last_health = None
+        health_probe = self._health_probe
 
         loss_fn_ = loss_fn
         n_micro = self.accumulate_steps
@@ -279,7 +327,12 @@ class HybridParallelTrainStep:
                 new_params, new_opt = optimizer.apply_fn(
                     params, grads, opt_state, lr=lr, t=t)
                 new_scaler = scaler_state
-            return loss, new_params, new_buf, new_opt, new_scaler
+            if health_probe is None:
+                return loss, new_params, new_buf, new_opt, new_scaler
+            hvec = health_probe.stats_vec(
+                loss, _health_grads(grads, scaler_state, fp16), params,
+                new_params)
+            return loss, new_params, new_buf, new_opt, new_scaler, hvec
 
         donate_args = (0, 2) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_args)
@@ -300,10 +353,14 @@ class HybridParallelTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         arrs = self.shard_batch(*batch)
         with self.mesh:
-            (loss, self.params, self.buffers, self.opt_state,
-             self.scaler_state) = self._step(
+            out = self._step(
                 self.params, self.buffers, self.opt_state,
                 self.scaler_state, rng, lr, self._t, *arrs)
+        (loss, self.params, self.buffers, self.opt_state,
+         self.scaler_state) = out[:5]
+        if self._health_probe is not None \
+                and self._t % self._health_interval == 0:
+            _note_health(self, out[5])
         return Tensor(loss)
 
     def sync_to_layer(self):

@@ -194,7 +194,7 @@ class PipelineParallelTrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  hcg: Optional[HybridCommunicateGroup] = None,
                  strategy=None, num_micro: Optional[int] = None,
-                 donate: bool = True):
+                 donate: bool = True, health=None):
         from ...jit import functionalize
         self.layer = model
         self.optimizer = optimizer
@@ -387,6 +387,12 @@ class PipelineParallelTrainStep:
                                  jnp.float32),
             "good": jnp.asarray(0, jnp.int32)}
 
+        from .engine import _build_health_probe
+        self._health_probe, self._health_interval = _build_health_probe(
+            flat_params, health)
+        self.last_health = None
+        health_probe = self._health_probe
+
         def step(flat_params, buffers_, opt_state, scaler_state, rng, lr, t,
                  *batch):
             params = unflat(flat_params)
@@ -410,7 +416,13 @@ class PipelineParallelTrainStep:
                 new_params, new_opt = optimizer.apply_fn(
                     flat_params, fgrads, opt_state, lr=lr, t=t)
                 new_scaler = scaler_state
-            return loss, new_params, new_opt, new_scaler
+            if health_probe is None:
+                return loss, new_params, new_opt, new_scaler
+            from .engine import _health_grads
+            hvec = health_probe.stats_vec(
+                loss, _health_grads(fgrads, scaler_state, fp16),
+                flat_params, new_params)
+            return loss, new_params, new_opt, new_scaler, hvec
 
         donate_args = (0, 2) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_args)
@@ -436,10 +448,15 @@ class PipelineParallelTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         arrs = self.shard_batch(*batch)
         with self.mesh:
-            (loss, self._flat_params, self.opt_state,
-             self.scaler_state) = self._step(
+            out = self._step(
                 self._flat_params, self.buffers, self.opt_state,
                 self.scaler_state, rng, lr, self._t, *arrs)
+        (loss, self._flat_params, self.opt_state,
+         self.scaler_state) = out[:4]
+        if self._health_probe is not None \
+                and self._t % self._health_interval == 0:
+            from .engine import _note_health
+            _note_health(self, out[4])
         return Tensor(loss)
 
     @property

@@ -75,15 +75,20 @@ class GPTAttention(nn.Layer):
         self.resid_drop = nn.Dropout(cfg.dropout)
 
     def forward(self, x):
-        B, L, H = x.shape
-        qkv = self.qkv(x)
-        qkv = reshape(qkv, [B, L, 3, self.num_heads, self.head_dim])
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.attn_dropout,
-            training=self.training)
-        out = reshape(out, [B, L, H])
-        return self.resid_drop(self.proj(out))
+        import jax
+        # named scopes -> XLA op metadata: the trace-measured per-segment
+        # breakdown (profiler/xplane.segment_breakdown) attributes work
+        # events to attention/mlp/ln/... by these scope tags
+        with jax.named_scope("attention"):
+            B, L, H = x.shape
+            qkv = self.qkv(x)
+            qkv = reshape(qkv, [B, L, 3, self.num_heads, self.head_dim])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.attn_dropout,
+                training=self.training)
+            out = reshape(out, [B, L, H])
+            return self.resid_drop(self.proj(out))
 
 
 class GPTMLP(nn.Layer):
@@ -94,7 +99,9 @@ class GPTMLP(nn.Layer):
         self.drop = nn.Dropout(cfg.dropout)
 
     def forward(self, x):
-        return self.drop(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+        import jax
+        with jax.named_scope("mlp"):
+            return self.drop(self.fc2(F.gelu(self.fc1(x), approximate=True)))
 
 
 class GPTBlock(nn.Layer):
@@ -106,8 +113,13 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(cfg)
 
     def forward(self, x):
-        x = x + self.attn(self.ln1(x))
-        x = x + self.mlp(self.ln2(x))
+        import jax
+        with jax.named_scope("ln"):
+            h = self.ln1(x)
+        x = x + self.attn(h)
+        with jax.named_scope("ln"):
+            h = self.ln2(x)
+        x = x + self.mlp(h)
         return x
 
 
@@ -127,17 +139,22 @@ class GPT(nn.Layer):
     # pipeline protocol (distributed.meta_parallel.pipeline_parallel):
     # pre -> scanned homogeneous blocks -> post
     def pipeline_pre(self, input_ids):
-        B, L = input_ids.shape
-        pos = arange(0, L, dtype="int32")
-        x = self.wte(input_ids) + self.wpe(pos)
-        return self.drop(x)
+        import jax
+        with jax.named_scope("embed"):
+            B, L = input_ids.shape
+            pos = arange(0, L, dtype="int32")
+            x = self.wte(input_ids) + self.wpe(pos)
+            return self.drop(x)
 
     def pipeline_post(self, x):
-        x = self.ln_f(x)
-        if self.cfg.tie_word_embeddings:
-            from ..ops import matmul
-            return matmul(x, self.wte.weight, transpose_y=True)
-        return self.lm_head(x)
+        import jax
+        with jax.named_scope("ln"):
+            x = self.ln_f(x)
+        with jax.named_scope("logits"):
+            if self.cfg.tie_word_embeddings:
+                from ..ops import matmul
+                return matmul(x, self.wte.weight, transpose_y=True)
+            return self.lm_head(x)
 
     def forward(self, input_ids):
         x = self.pipeline_pre(input_ids)

@@ -4,16 +4,47 @@ from __future__ import annotations
 from .. import nn
 
 
+def _conv_bn(conv, bn, x, residual=None):
+    """Fused conv+BN block tail: routes through `F.conv2d_bn` (the
+    single-pass 1x1-conv+stats Pallas chain) ONLY when the fused kernel
+    will actually engage for this shape/platform; everywhere else the
+    sublayers are called normally — `Layer.__call__` must keep running so
+    forward hooks fire and the PR-9 NaN-attribution layer stack still
+    names conv1/bn1 rather than the whole block."""
+    from ..nn import functional as F
+    from ..ops.pallas import fused_conv_bn as _fcb
+    ugs = bn._use_global_stats
+    if ugs is None:
+        ugs = not bn.training
+    xs = tuple(x.data.shape) if hasattr(x, "data") else tuple(x.shape)
+    xdt = x.data.dtype if hasattr(x, "data") else x.dtype
+    w = conv.weight
+    ws = tuple(w.data.shape) if hasattr(w, "data") else tuple(w.shape)
+    if (not ugs) and _fcb.eligible(xs, ws, conv._stride, conv._padding,
+                                   conv._dilation, conv._groups,
+                                   conv._data_format, xdt):
+        return F.conv2d_bn(
+            x, conv.weight, bn._mean, bn._variance, bn.weight, bn.bias,
+            training=bn.training, momentum=bn._momentum,
+            epsilon=bn._epsilon, stride=conv._stride,
+            padding=conv._padding, dilation=conv._dilation,
+            groups=conv._groups, data_format=conv._data_format,
+            use_global_stats=bn._use_global_stats, act=bn._act,
+            residual=residual)
+    return bn(conv(x), residual)
+
+
 class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
                  groups=1, base_width=64, dilation=1, norm_layer=None,
-                 data_format="NCHW"):
+                 data_format="NCHW", fused_conv_bn=True):
         super().__init__()
         # default BN -> fused BN(+add)+ReLU tails (Pallas kernels); a custom
         # norm_layer keeps the unfused composition (it has no act=/residual=)
         self._fused = norm_layer is None
+        self._fused_conv = fused_conv_bn and self._fused
         norm_layer = norm_layer or nn.BatchNorm2D
         df = dict(data_format=data_format)
         act = dict(act="relu") if self._fused else {}
@@ -31,6 +62,9 @@ class BasicBlock(nn.Layer):
         identity = x
         if self.downsample is not None:
             identity = self.downsample(x)
+        if self._fused_conv:
+            out = _conv_bn(self.conv1, self.bn1, x)
+            return _conv_bn(self.conv2, self.bn2, out, identity)
         if self._fused:
             out = self.bn1(self.conv1(x))
             return self.bn2(self.conv2(out), identity)
@@ -44,9 +78,10 @@ class BottleneckBlock(nn.Layer):
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
                  groups=1, base_width=64, dilation=1, norm_layer=None,
-                 data_format="NCHW"):
+                 data_format="NCHW", fused_conv_bn=True):
         super().__init__()
         self._fused = norm_layer is None
+        self._fused_conv = fused_conv_bn and self._fused
         norm_layer = norm_layer or nn.BatchNorm2D
         df = dict(data_format=data_format)
         act = dict(act="relu") if self._fused else {}
@@ -67,6 +102,12 @@ class BottleneckBlock(nn.Layer):
         identity = x
         if self.downsample is not None:
             identity = self.downsample(x)
+        if self._fused_conv:
+            # conv1/conv3 are the 1x1s the fused kernel targets; conv2
+            # (3x3) falls back inside conv2d_bn to conv -> fused BN
+            out = _conv_bn(self.conv1, self.bn1, x)
+            out = _conv_bn(self.conv2, self.bn2, out)
+            return _conv_bn(self.conv3, self.bn3, out, identity)
         if self._fused:
             out = self.bn1(self.conv1(x))
             out = self.bn2(self.conv2(out))
@@ -80,7 +121,7 @@ class BottleneckBlock(nn.Layer):
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
                  with_pool=True, groups=1, recompute=False,
-                 data_format="NCHW", fused_bn=True):
+                 data_format="NCHW", fused_bn=True, fused_conv_bn=True):
         """`recompute=True` rematerializes each residual STAGE's
         activations in backward (reference RecomputeFunction applied at
         `layer1..layer4` granularity): on a bandwidth-bound chip the
@@ -92,11 +133,18 @@ class ResNet(nn.Layer):
         preferred convolution layout and avoids transposes.
 
         `fused_bn=False` keeps every BN+ReLU(+add) as the unfused
-        composition — the bench's fused-vs-unfused comparison knob."""
+        composition — the bench's fused-vs-unfused comparison knob.
+
+        `fused_conv_bn=False` keeps the PR-1 behavior (conv, then fused
+        BN(+add)+ReLU); True additionally routes the block tails through
+        `F.conv2d_bn`, whose single-pass 1x1-conv+BN-stats Pallas kernel
+        removes the separate full-activation statistics read on eligible
+        shapes — the bench's conv-fusion A/B knob. Requires fused_bn."""
         super().__init__()
         self._recompute = recompute
         self._data_format = data_format
         self._fused_bn = fused_bn
+        self._fused_conv_bn = fused_conv_bn and fused_bn
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
         layers = layer_cfg[depth]
@@ -137,13 +185,15 @@ class ResNet(nn.Layer):
                 norm_layer(planes * block.expansion, **df))
         layers = [block(self.inplanes, planes, stride, downsample,
                         self.groups, self.base_width, 1, block_norm,
-                        data_format=self._data_format)]
+                        data_format=self._data_format,
+                        fused_conv_bn=self._fused_conv_bn)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes,
                                 groups=self.groups, base_width=self.base_width,
                                 norm_layer=block_norm,
-                                data_format=self._data_format))
+                                data_format=self._data_format,
+                                fused_conv_bn=self._fused_conv_bn))
         return nn.Sequential(*layers)
 
     def forward(self, x):

@@ -679,6 +679,22 @@ def _bn_infer_act(x, rm, rv, w, b, *rest, epsilon, data_format, act):
     return out.astype(x.dtype)
 
 
+def _update_running_stats(running_mean, running_var, mean, var, momentum):
+    """Momentum update of the running-stat buffers (reference
+    batch_norm_kernel.cu mean_out/variance_out semantics) — ONE definition
+    shared by batch_norm and conv2d_bn so the fused conv path can never
+    drift from the unfused one."""
+    if isinstance(running_mean, Tensor):
+        with jax.default_matmul_precision("float32"):
+            m = momentum
+            running_mean.data = (running_mean.data * m
+                                 + mean.data * (1 - m)).astype(
+                                     running_mean.data.dtype)
+            running_var.data = (running_var.data * m
+                                + var.data * (1 - m)).astype(
+                                    running_var.data.dtype)
+
+
 def _bn_affine_arrays(x, weight, bias, data_format):
     """The fused kernels require concrete gamma/beta arrays; a disabled
     affine (weight_attr=False) substitutes constants that take no grad."""
@@ -729,12 +745,76 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         else:
             out, mean, var = _d.call(_fused_bn_act_train, (x, w, b), attrs,
                                      name="fused_bn_relu")
-    if isinstance(running_mean, Tensor):
-        with jax.default_matmul_precision("float32"):
-            m = momentum
-            running_mean.data = (running_mean.data * m + mean.data * (1 - m)).astype(running_mean.data.dtype)
-            running_var.data = (running_var.data * m + var.data * (1 - m)).astype(running_var.data.dtype)
+    _update_running_stats(running_mean, running_var, mean, var, momentum)
     return out
+
+
+@kernel("fused_conv_bn_relu")
+def _fused_conv_bn_train(x, w, g, b, *, epsilon, act):
+    from ...ops.pallas.fused_conv_bn import fused_conv1x1_bn_act
+    return fused_conv1x1_bn_act(x, w, g, b, epsilon=epsilon, act=act)
+
+
+@kernel("fused_conv_bn_add_relu")
+def _fused_conv_bn_add_train(x, z, w, g, b, *, epsilon, act):
+    from ...ops.pallas.fused_conv_bn import fused_conv1x1_bn_act
+    return fused_conv1x1_bn_act(x, w, g, b, residual=z, epsilon=epsilon,
+                                act=act)
+
+
+def conv2d_bn(x, conv_weight, running_mean, running_var, weight=None,
+              bias=None, training=False, momentum=0.9, epsilon=1e-5,
+              stride=1, padding=0, dilation=1, groups=1,
+              data_format="NCHW", use_global_stats=None, act=None,
+              residual=None, name=None):
+    """Fused conv2d + training-mode batch_norm(+residual add)(+act).
+
+    The ResNet block-tail primitive: when the conv is a 1x1/stride-1/
+    channels-last shape the fused Pallas chain
+    (`ops/pallas/fused_conv_bn.py`) computes the matmul and the BN batch
+    statistics in ONE pass over the output — eliminating the separate
+    full-activation stats read the composed path pays — then applies
+    normalize(+add)+act via the fused-BN elementwise kernel. Every other
+    shape (3x3/7x7, strided, grouped, NCHW, CPU) falls back to the exact
+    `conv2d` -> `batch_norm(act=, residual=)` composition, so this is
+    always safe to call. Running-stat momentum semantics are identical to
+    `batch_norm` (shared helper).
+    """
+    if use_global_stats is None:
+        use_global_stats = not training
+    from ...ops.pallas import fused_conv_bn as _fcb
+    xs = tuple(x.data.shape) if isinstance(x, Tensor) else tuple(x.shape)
+    xdt = x.data.dtype if isinstance(x, Tensor) else x.dtype
+    ws = tuple(conv_weight.data.shape) if isinstance(conv_weight, Tensor) \
+        else tuple(conv_weight.shape)
+    if (not use_global_stats) and _fcb.eligible(
+            xs, ws, stride, padding, dilation, groups, data_format, xdt):
+        # the BN affine is sized by the conv OUTPUT channels (w_shape[0]),
+        # not x's channel axis — _bn_affine_arrays reads the latter and
+        # would build a (Cin,) substitute for a disabled affine
+        Cout = int(ws[0])
+        w_ = jnp.ones((Cout,), jnp.float32) if weight is None else weight
+        b_ = jnp.zeros((Cout,), jnp.float32) if bias is None else bias
+        attrs = dict(epsilon=epsilon, act=act)
+        if residual is not None:
+            out, mean, var = _d.call(
+                _fused_conv_bn_add_train,
+                (x, residual, conv_weight, w_, b_), attrs,
+                name="fused_conv_bn_add_relu")
+        else:
+            out, mean, var = _d.call(
+                _fused_conv_bn_train, (x, conv_weight, w_, b_), attrs,
+                name="fused_conv_bn_relu")
+        _update_running_stats(running_mean, running_var, mean, var,
+                              momentum)
+        return out
+    y = conv2d(x, conv_weight, None, stride, padding, dilation, groups,
+               data_format)
+    return batch_norm(y, running_mean, running_var, weight, bias,
+                      training=training, momentum=momentum, epsilon=epsilon,
+                      data_format=data_format,
+                      use_global_stats=use_global_stats, act=act,
+                      residual=residual)
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,

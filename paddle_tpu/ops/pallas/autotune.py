@@ -147,12 +147,23 @@ def chip_label(interpret: bool = False) -> str:
 # ------------------------------ disk cache -----------------------------------
 
 
-def _entry_path(op: str, key: Tuple, chip: str, root: str) -> str:
+def _entry_path(op: str, key: Tuple, chip: str, root: str,
+                space: Optional[str] = None) -> str:
     safe_op = "".join(c if (c.isalnum() or c in "-_") else "_" for c in op)
     h = hashlib.sha1(
-        json.dumps([op, list(key), chip], sort_keys=True).encode()
+        json.dumps([op, list(key), chip, space], sort_keys=True).encode()
     ).hexdigest()[:16]
     return os.path.join(root, f"{safe_op}-{h}.json")
+
+
+def _space_fingerprint(candidates: Sequence[BlockConfig]) -> str:
+    """Identity of the candidate SPACE, folded into the disk-cache path:
+    a kernel widening (or reshaping) its candidate set must re-tune, not
+    keep serving the old space's persisted winner forever — without this
+    a fleet cache dir silently pins every pre-widening pick."""
+    return hashlib.sha1(
+        "|".join(sorted(c.label for c in candidates)).encode()
+    ).hexdigest()[:12]
 
 
 def _disk_load(path: str, op: str) -> Optional[dict]:
@@ -263,7 +274,9 @@ def get_config(op: str,
             return hit[0]
 
         root = cache_dir()
-        path = _entry_path(op, tuple(key), chip, root) if root else None
+        path = _entry_path(op, tuple(key), chip, root,
+                           space=_space_fingerprint(candidates)) \
+            if root else None
         if path is not None:
             payload = _disk_load(path, op)
             if payload is not None:

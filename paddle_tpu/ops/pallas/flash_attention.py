@@ -736,10 +736,17 @@ def _resolve_flash_blocks(q, k, mask, causal):
             vmem_bytes=lambda c: _fa_fwd_vmem_bytes(c, D, itemsize,
                                                     has_mask)),
         default=default, bench=bench_fwd, interpret=_INTERPRET)
+    # bwd candidate space is WIDER than fwd (perf-round r06): the backward
+    # walks q and k in both loop orders and re-reads residuals per block,
+    # so its block-efficiency optimum sits elsewhere — small q blocks cut
+    # dq re-accumulation traffic, large k blocks amortize the residual
+    # streams. The r05 GPT-2 attention-bwd segment is the measured target.
+    qs_bwd = _tiling.axis_candidates(Lq, (64, 128, 256, 512))
+    ks_bwd = _tiling.axis_candidates(Lk, (128, 256, 512, 1024))
     bwd_cfg = _autotune.get_config(
         "flash_bwd_fused" if fused_bwd else "flash_bwd_split", key,
         candidates=_tiling.candidate_configs(
-            ("q", "k"), [qs, ks], default,
+            ("q", "k"), [qs_bwd, ks_bwd], default,
             vmem_bytes=lambda c: _fa_bwd_vmem_bytes(c, Lq, D, itemsize,
                                                     has_mask, fused_bwd)),
         default=default, bench=bench_bwd, interpret=_INTERPRET)

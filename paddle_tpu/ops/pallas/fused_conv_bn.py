@@ -1,0 +1,422 @@
+"""Fused 1x1-conv + BatchNorm(+residual add)+activation training kernels.
+
+The r05 roofline analysis pinned ResNet-50 near 0.157 MFU because the
+conv->BN chain round-trips full activations through HBM: even with the
+PR-1 fused BN(+add)+ReLU kernels, every BN still pays a separate
+full-activation read just to compute the batch statistics before the
+normalize pass can run. This module folds that statistics pass into the
+convolution itself (PAPER L3's phi-kernel analogue, the cuDNN
+``BNStatsFinalize`` pattern): a 1x1 convolution in channels-last layout IS
+a matmul ``y[R, Cout] = x[R, Cin] @ w[Cin, Cout]`` with ``R = N*H*W``, so
+the Pallas kernel computes the matmul block-by-block and accumulates the
+per-channel ``sum``/``sum-of-squares`` of the output in its epilogue while
+the tile is still in VMEM. The normalize+act(+add) pass then reuses the
+PR-1 fused-BN elementwise kernel, and the backward reuses the PR-1
+single-pass reduce + dx kernels (``fused_bn._bwd_common``) followed by two
+MXU matmuls for the conv gradients.
+
+HBM traffic per fused conv+BN+act (vs the composed path's extra
+full-activation stats read):
+
+    composed:  conv writes y; stats read y; apply reads y, writes out
+    fused:     conv writes y + tiny (2, C) stats; apply reads y, writes out
+
+Per-shape implementation choice is MEASURED, not hand-picked: the autotune
+candidate space (registered on :mod:`.tiling`/:mod:`.autotune` as op
+``"conv_bn"``) carries an ``impl`` axis — ``impl=1`` candidates are Pallas
+block shapes, ``impl=0`` is the XLA-composed rewrite (matmul + fused
+stats + elementwise epilogue in one XLA program, no custom-call boundary) —
+and the tuner's timed probe of the full fwd+bwd chain decides per
+(shape-bucket, dtype, chip). Non-1x1 / strided / grouped convolutions are
+out of scope here and keep the existing conv -> ``F.batch_norm(act=)``
+composition (``nn.functional.conv2d_bn`` routes).
+
+Interpret-mode runs the kernels under the Pallas interpreter so CPU CI
+exercises the kernel path itself (same contract as ``fused_bn``; the
+toggle is this module's ``_INTERPRET`` plus ``fused_bn._INTERPRET`` for
+the shared apply/backward kernels).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..._jax_compat import (TPUCompilerParams as _TPUCompilerParams,
+                            DIM_PARALLEL as _DIM_P, DIM_ARBITRARY as _DIM_A)
+from .._bn_common import _bn_stats
+from . import autotune as _autotune
+from . import fused_bn as _fused_bn
+from . import tiling as _tiling
+from .tiling import on_tpu as _on_tpu
+
+_INTERPRET = False  # tests flip this (with fused_bn._INTERPRET) for CPU CI
+
+_stats = {"pallas_fwd": 0, "xla_fwd": 0, "pallas_bwd": 0, "xla_bwd": 0}
+
+_SUBLANES = 8           # fp32 sublane count — stats accumulators are (8, C)
+_DEF_BLOCK_ROWS = 256
+_DEF_BLOCK_COLS = 256
+_MAX_CIN = 2048         # full Cin stripe of x and w must sit in VMEM
+# autotune probes cap their synthetic row count (pure row-stream kernels:
+# ranking at a bounded R ranks any R — same contract as fused_bn)
+_BENCH_MAX_ROWS = 32768
+
+
+def _interp() -> bool:
+    return _INTERPRET or _fused_bn._INTERPRET
+
+
+# ----------------------------- Pallas kernel --------------------------------
+
+def _conv1x1_stats_kernel(x_ref, w_ref, y_ref, s_ref, ss_ref, *, br, R):
+    """One (rows x cols) output tile: MXU matmul + per-channel sum /
+    sum-of-squares epilogue accumulated across the row-block walk. Grid is
+    (cols, rows) with rows innermost so the accumulators for one column
+    stripe stay resident while every row block streams through."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(1)  # row-block index (innermost, sequential)
+
+    @pl.when(i == 0)
+    def _init():
+        s_ref[...] = jnp.zeros_like(s_ref)
+        ss_ref[...] = jnp.zeros_like(ss_ref)
+
+    yf = jnp.dot(x_ref[...], w_ref[...], preferred_element_type=jnp.float32)
+    y_ref[...] = yf.astype(y_ref.dtype)
+    # statistics of the STORED value (post-cast), matching what the
+    # composed path's _bn_stats sees when it re-reads the conv output
+    yc = y_ref[...].astype(jnp.float32)
+    if R % br:  # tail block: OOB rows hold undefined values — mask them
+        rows = i * br + jax.lax.broadcasted_iota(jnp.int32, yc.shape, 0)
+        yc = jnp.where(rows < R, yc, 0.0)
+    s = jnp.sum(yc, axis=0)
+    ss = jnp.sum(jnp.square(yc), axis=0)
+    s_ref[...] = s_ref[...] + jnp.broadcast_to(s[None, :], s_ref.shape)
+    ss_ref[...] = ss_ref[...] + jnp.broadcast_to(ss[None, :], ss_ref.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_rows",
+                                             "block_cols"))
+def _conv1x1_stats_pallas(x2d, w2d, interpret=False,
+                          block_rows=_DEF_BLOCK_ROWS,
+                          block_cols=_DEF_BLOCK_COLS):
+    """(y2d [R, Cout], sum [Cout], sumsq [Cout]) in one pass over x."""
+    from jax.experimental import pallas as pl
+
+    R, Cin = x2d.shape
+    Cout = w2d.shape[1]
+    br, bc = block_rows, min(block_cols, Cout)
+    grid = (pl.cdiv(Cout, bc), pl.cdiv(R, br))
+    y, s, ss = pl.pallas_call(
+        functools.partial(_conv1x1_stats_kernel, br=br, R=R),
+        grid=grid,
+        in_specs=[pl.BlockSpec((br, Cin), lambda j, i: (i, 0)),
+                  pl.BlockSpec((Cin, bc), lambda j, i: (0, j))],
+        out_specs=[pl.BlockSpec((br, bc), lambda j, i: (i, j)),
+                   pl.BlockSpec((_SUBLANES, bc), lambda j, i: (0, j)),
+                   pl.BlockSpec((_SUBLANES, bc), lambda j, i: (0, j))],
+        out_shape=[jax.ShapeDtypeStruct((R, Cout), x2d.dtype),
+                   jax.ShapeDtypeStruct((_SUBLANES, Cout), jnp.float32),
+                   jax.ShapeDtypeStruct((_SUBLANES, Cout), jnp.float32)],
+        compiler_params=(None if interpret
+                         else _TPUCompilerParams(
+                             dimension_semantics=(_DIM_P, _DIM_A))),
+        interpret=interpret,
+    )(x2d, w2d)
+    return y, s[0], ss[0]
+
+
+def _stats_from_sums(s, ss, R: int):
+    """mean/var from the epilogue sums — the SAME E[x], E[x^2] - E[x]^2
+    formulation as ops._bn_common._bn_stats, so running-stat parity with
+    the composed path holds."""
+    mean = s / R
+    var = jnp.maximum(ss / R - jnp.square(mean), 0.0)
+    return mean, var
+
+
+# --------------------- candidate space + impl decision ----------------------
+
+def _vmem_bytes(cfg, Cin: int, itemsize: int) -> int:
+    br, bc = cfg["rows"], cfg["cols"]
+    # double-buffered x block + w stripe + y block, two fp32 accumulator
+    # tiles, and the fp32 matmul intermediate
+    return (2 * br * Cin * itemsize + 2 * Cin * bc * itemsize
+            + 2 * br * bc * itemsize + 2 * _SUBLANES * bc * 4
+            + br * bc * 4)
+
+
+_cfg_memo = _autotune.register_memo({})
+
+
+def _resolve_cfg(dtype, R: int, Cin: int, Cout: int,
+                 has_add: bool) -> _tiling.BlockConfig:
+    """The measured per-shape decision: Pallas block shape OR the
+    XLA-composed rewrite (impl=0). Candidates time the full fused
+    fwd+bwd chain; the persistent autotune cache (op "conv_bn") makes the
+    decision once per (shape-bucket, dtype, chip) fleet-wide."""
+    interpret = _interp()
+    memo_key = (_tiling.shape_bucket(R, floor=_DEF_BLOCK_ROWS), Cin, Cout,
+                jnp.dtype(dtype).name, has_add, interpret, _autotune.mode())
+    hit = _cfg_memo.get(memo_key)
+    if hit is not None:
+        return hit
+    itemsize = jnp.dtype(dtype).itemsize
+    default = _tiling.make_config(impl=1, rows=_DEF_BLOCK_ROWS,
+                                  cols=min(_DEF_BLOCK_COLS, Cout))
+    grain = _tiling.sublane(dtype)
+    pallas_cands = _tiling.candidate_configs(
+        ("impl", "rows", "cols"),
+        [(1,),
+         _tiling.axis_candidates(R, (128, 256, 512), grain=grain),
+         _tiling.axis_candidates(Cout, (128, 256, 512), grain=_tiling.LANE)],
+        default,
+        vmem_bytes=lambda c: _vmem_bytes(c, Cin, itemsize))
+    # the XLA-composed rewrite is a first-class candidate: "decided by
+    # measured probe, not by taste"
+    cands = pallas_cands + [_tiling.make_config(impl=0, rows=0, cols=0)]
+
+    rb = min(_tiling.shape_bucket(R, floor=_DEF_BLOCK_ROWS), _BENCH_MAX_ROWS)
+    buf = {}
+
+    def bench(cfg):
+        if not buf:
+            buf["x"] = jnp.ones((rb, Cin), dtype)
+            buf["w"] = jnp.ones((Cin, Cout), dtype)
+            buf["g"] = jnp.ones((Cout,), jnp.float32)
+            buf["z"] = jnp.ones((rb, Cout), dtype) if has_add else None
+        x, w, g, z = buf["x"], buf["w"], buf["g"], buf["z"]
+
+        def run(xx):
+            args = (xx,) + ((z,) if has_add else ()) + (w, g, g)
+            out = _op(has_add)(*args, 1e-5, "relu", cfg)
+            return out[0].astype(jnp.float32).sum()
+
+        val, grads = jax.value_and_grad(run)(x)
+        jax.block_until_ready((val, grads))
+
+    cfg = _autotune.get_config(
+        "conv_bn", key=memo_key[:5], candidates=cands, default=default,
+        bench=bench, interpret=interpret)
+    _cfg_memo[memo_key] = cfg
+    return cfg
+
+
+_probe_status = {}
+
+
+def _probe_ok(dtype, R: int, Cin: int, Cout: int, cfg) -> bool:
+    """Eager compile probe at the exact resolved block shape (a Mosaic
+    failure inside a traced user program cannot be caught — layer_norm /
+    fused_bn precedent). Probes the tail-masked variant when R % rows."""
+    if cfg["impl"] == 0:
+        return True  # XLA rewrite: nothing to probe
+    br, bc = cfg["rows"], cfg["cols"]
+    key = (jnp.dtype(dtype).name, Cin, Cout, br, bc, bool(R % br), _interp())
+    if key not in _probe_status:
+        try:
+            rows = br + (_SUBLANES if R % br else 0)
+            x = jnp.ones((rows, Cin), dtype)
+            w = jnp.ones((Cin, Cout), dtype)
+            outs = _conv1x1_stats_pallas(x, w, interpret=_interp(),
+                                         block_rows=br, block_cols=bc)
+            jax.block_until_ready(outs)
+            _probe_status[key] = True
+        except Exception:
+            _probe_status[key] = False
+    return _probe_status[key]
+
+
+def eligible(x_shape, w_shape, stride, padding, dilation, groups,
+             data_format: str, dtype) -> bool:
+    """Can this conv+BN run the fused 1x1 path at all? (The impl choice
+    within the path — Pallas kernel vs XLA rewrite — is then measured.)
+    w_shape is the conv layer layout (O, I, kh, kw)."""
+    if not (_on_tpu() or _interp()):
+        return False
+    if data_format.startswith("NC") or len(x_shape) != 4:
+        return False
+    if len(w_shape) != 4 or w_shape[2] != 1 or w_shape[3] != 1:
+        return False
+
+    def _ones(v):
+        return all(int(s) == 1 for s in (v if isinstance(v, (tuple, list))
+                                         else (v,)))
+
+    def _zeros(v):
+        if isinstance(v, str):
+            return v.upper() == "VALID"
+        return all(int(s) == 0 for s in (v if isinstance(v, (tuple, list))
+                                         else (v,)))
+
+    if not (_ones(stride) and _ones(dilation) and groups == 1
+            and _zeros(padding)):
+        return False
+    Cout, Cin = int(w_shape[0]), int(w_shape[1])
+    R = int(x_shape[0]) * int(x_shape[1]) * int(x_shape[2])
+    if int(x_shape[3]) != Cin:
+        return False
+    if Cin % _tiling.LANE or Cout % _tiling.LANE:
+        return False
+    if Cin > _MAX_CIN or Cout > _MAX_CIN:
+        return False
+    if R < _DEF_BLOCK_ROWS or R % _SUBLANES:
+        return False
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16)):
+        return False
+    cfg = _resolve_cfg(dtype, R, Cin, Cout, has_add=False)
+    return _probe_ok(dtype, R, Cin, Cout, cfg)
+
+
+# ----------------------------- fwd/bwd common -------------------------------
+
+def _conv_fwd(x2d, w2d, cfg):
+    """(y_conv, mean, var) via the resolved impl."""
+    R = x2d.shape[0]
+    if cfg["impl"] == 1:
+        _stats["pallas_fwd"] += 1
+        y, s, ss = _conv1x1_stats_pallas(x2d, w2d, interpret=_interp(),
+                                         block_rows=cfg["rows"],
+                                         block_cols=cfg["cols"])
+        mean, var = _stats_from_sums(s, ss, R)
+    else:
+        _stats["xla_fwd"] += 1
+        y = jnp.dot(x2d, w2d, preferred_element_type=jnp.float32) \
+            .astype(x2d.dtype)
+        mean, var = _bn_stats(y, axes=(0,))
+    return y, mean, var
+
+
+def _fwd_common(x2d, z2d, w2d, gamma, beta, epsilon, act, cfg):
+    """Conv (+stats) then normalize(+add)+act. The Pallas impl reuses the
+    PR-1 fused-BN elementwise kernel for the epilogue; the XLA impl stays
+    custom-call-free so the whole matmul->stats->epilogue chain can fuse
+    in one XLA program."""
+    y_conv, mean, var = _conv_fwd(x2d, w2d, cfg)
+    inv = jax.lax.rsqrt(var + epsilon)
+    k, c = _fused_bn._fold_affine(gamma, beta, mean, inv)
+    has_add = z2d is not None
+    use_pallas_apply = (cfg["impl"] == 1
+                        and _fused_bn._pallas_eligible(y_conv, "NHWC",
+                                                       has_add))
+    if use_pallas_apply:
+        br = _fused_bn._block_rows_for(y_conv.dtype, y_conv.shape[0],
+                                       y_conv.shape[1], has_add)
+        y = _fused_bn._bn_act_fwd_pallas(y_conv, z2d, k, c, act=act,
+                                         has_add=has_add,
+                                         interpret=_interp(),
+                                         block_rows=br)
+    else:
+        yf = y_conv.astype(jnp.float32) * k + c
+        if has_add:
+            yf = yf + z2d.astype(jnp.float32)
+        if act == "relu":
+            yf = jnp.maximum(yf, 0.0)
+        y = yf.astype(y_conv.dtype)
+    return y, mean, var, inv, y_conv
+
+
+def _bwd_common(res, cots, epsilon, act, has_add, cfg):
+    x2d, w2d, gamma, beta, mean, inv, y_conv, y_out = res
+    if cfg["impl"] == 1:
+        _stats["pallas_bwd"] += 1
+    else:
+        _stats["xla_bwd"] += 1
+    # BN(+add)+act backward over the conv output — the PR-1 single-pass
+    # reduce + dx kernels (or their XLA twin, fused_bn's own gates decide)
+    d_yconv, dz, dgamma, dbeta = _fused_bn._bwd_common(
+        (y_conv, gamma, beta, mean, inv, y_out), cots, epsilon, "NHWC",
+        act, has_add=has_add)
+    # conv backward: two MXU matmuls (dx = g @ w^T, dw = x^T @ g)
+    g = d_yconv
+    dx = jnp.dot(g, w2d.T, preferred_element_type=jnp.float32) \
+        .astype(x2d.dtype)
+    dw = jnp.dot(x2d.T, g, preferred_element_type=jnp.float32) \
+        .astype(w2d.dtype)
+    return dx, dw, dgamma, dbeta, dz
+
+
+# ----------------------------- custom-vjp ops -------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _conv_bn_act(x2d, w2d, gamma, beta, epsilon, act, cfg):
+    y, mean, var, _, _ = _fwd_common(x2d, None, w2d, gamma, beta, epsilon,
+                                     act, cfg)
+    return y, mean, var
+
+
+def _conv_bn_act_fwd(x2d, w2d, gamma, beta, epsilon, act, cfg):
+    y, mean, var, inv, y_conv = _fwd_common(x2d, None, w2d, gamma, beta,
+                                            epsilon, act, cfg)
+    # residuals: x2d/w2d live anyway; y_conv is the fused op's one extra
+    # saved activation (the composed path saves it too — it is BN's input);
+    # y_out doubles as the ReLU mask
+    return (y, mean, var), (x2d, w2d, gamma, beta, mean, inv, y_conv, y)
+
+
+def _conv_bn_act_bwd(epsilon, act, cfg, res, cots):
+    dx, dw, dgamma, dbeta, _ = _bwd_common(res, cots, epsilon, act,
+                                           has_add=False, cfg=cfg)
+    return dx, dw, dgamma, dbeta
+
+
+_conv_bn_act.defvjp(_conv_bn_act_fwd, _conv_bn_act_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _conv_bn_add_act(x2d, z2d, w2d, gamma, beta, epsilon, act, cfg):
+    y, mean, var, _, _ = _fwd_common(x2d, z2d, w2d, gamma, beta, epsilon,
+                                     act, cfg)
+    return y, mean, var
+
+
+def _conv_bn_add_act_fwd(x2d, z2d, w2d, gamma, beta, epsilon, act, cfg):
+    y, mean, var, inv, y_conv = _fwd_common(x2d, z2d, w2d, gamma, beta,
+                                            epsilon, act, cfg)
+    return (y, mean, var), (x2d, w2d, gamma, beta, mean, inv, y_conv, y)
+
+
+def _conv_bn_add_act_bwd(epsilon, act, cfg, res, cots):
+    dx, dw, dgamma, dbeta, dz = _bwd_common(res, cots, epsilon, act,
+                                            has_add=True, cfg=cfg)
+    return dx, dz, dw, dgamma, dbeta
+
+
+_conv_bn_add_act.defvjp(_conv_bn_add_act_fwd, _conv_bn_add_act_bwd)
+
+
+def _op(has_add: bool):
+    return _conv_bn_add_act if has_add else _conv_bn_act
+
+
+# ----------------------------- public API -----------------------------------
+
+def fused_conv1x1_bn_act(x, w, gamma, beta, *, residual=None, epsilon=1e-5,
+                         act="relu"):
+    """Training-mode ``act(BN(conv1x1(x)) [+ residual])`` in one fused
+    chain over channels-last ``x [N, H, W, Cin]``.
+
+    ``w`` is the conv layer's (O, I, 1, 1) weight (any extra unit dims are
+    squeezed). Returns ``(y [N, H, W, Cout], batch_mean, batch_var)`` —
+    the stats feed the caller's running-stat momentum update exactly like
+    ``fused_bn`` / the unfused kernel. Gradients flow to x, w, gamma,
+    beta (and the residual). Callers must have checked :func:`eligible`.
+    """
+    Cout = w.shape[0]
+    w2d = w.reshape(Cout, -1).T.astype(x.dtype)  # (Cin, Cout)
+    N, H, W, Cin = x.shape
+    x2d = x.reshape(-1, Cin)
+    cfg = _resolve_cfg(x.dtype, x2d.shape[0], Cin, Cout,
+                       has_add=residual is not None)
+    if residual is not None:
+        z2d = residual.reshape(-1, Cout)
+        y, mean, var = _conv_bn_add_act(x2d, z2d, w2d, gamma, beta,
+                                        epsilon, act, cfg)
+    else:
+        y, mean, var = _conv_bn_act(x2d, w2d, gamma, beta, epsilon, act,
+                                    cfg)
+    return y.reshape(N, H, W, Cout), mean, var

@@ -13,12 +13,14 @@ from .optimizer import Optimizer
 
 
 class SGD(Optimizer):
+    _fusable = True  # p - lr*g is elementwise
     def _update(self, p, g, slots, lr, t, **kw):
         g = self._decay_grad(p, g)
         return p - lr * g, slots
 
 
 class Momentum(Optimizer):
+    _fusable = True
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
@@ -39,6 +41,7 @@ class Momentum(Optimizer):
 
 
 class Adam(Optimizer):
+    _fusable = True  # AdamW inherits this
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
@@ -88,6 +91,7 @@ class AdamW(Adam):
 
 
 class Adamax(Optimizer):
+    _fusable = True
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -107,6 +111,7 @@ class Adamax(Optimizer):
 
 
 class Adagrad(Optimizer):
+    _fusable = True
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
                  name=None):
@@ -125,6 +130,7 @@ class Adagrad(Optimizer):
 
 
 class RMSProp(Optimizer):
+    _fusable = True
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -157,6 +163,7 @@ class RMSProp(Optimizer):
 
 
 class Adadelta(Optimizer):
+    _fusable = True
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
@@ -176,6 +183,7 @@ class Adadelta(Optimizer):
 
 
 class Lamb(Optimizer):
+    _fusable = False  # per-param trust-ratio norms
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, name=None):
@@ -206,6 +214,7 @@ class Lamb(Optimizer):
 
 
 class LarsMomentum(Momentum):
+    _fusable = False  # per-param LARS local lr
     def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, parameters=None, grad_clip=None,
                  epsilon=1e-9, name=None):

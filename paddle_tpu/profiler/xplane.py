@@ -186,6 +186,93 @@ def work_events(events: Sequence[dict],
     return out
 
 
+# train-step segment tags: named scopes pushed by the models/TrainStep
+# (models/gpt.py, jit.TrainStep "loss"/"optimizer") plus the Pallas kernel
+# custom-call names — matched as substrings of a work event's name and
+# string args (the XLA op-metadata path; CPU traces carry no metadata, so
+# there the breakdown degrades to "unattributed")
+SEGMENT_TAGS = (
+    ("attention", ("attention", "flash_", "sdpa")),
+    ("mlp", ("mlp",)),
+    # "ln" must stay delimited (bare "ln" is a substring of e.g.
+    # "kernel_name"), but the delimiters need the autodiff spellings too:
+    # backward LN ops are named ".../transpose(jvp(ln))/..."
+    ("ln", ("/ln/", "(ln)", "jvp(ln", "layer_norm")),
+    ("embed", ("embed",)),
+    ("logits", ("logits",)),
+    ("loss", ("loss", "softmax_ce", "cross_entropy")),
+    ("optimizer", ("optimizer",)),
+)
+
+# autodiff markers XLA embeds in op_name metadata for backward ops
+_BWD_MARKERS = ("transpose(", "/transpose[", "vjp(")
+
+
+def _event_blob(e: dict) -> str:
+    """name + every string arg of a work event, lowered — the haystack
+    segment tags are matched against."""
+    parts = [str(e.get("name", ""))]
+    args = e.get("args")
+    if isinstance(args, dict):
+        parts.extend(v for v in args.values() if isinstance(v, str))
+    return " ".join(parts).lower()
+
+
+def segment_breakdown(events: Sequence[dict], lanes=None,
+                      tags=SEGMENT_TAGS) -> dict:
+    """Measured per-segment device time from a parsed trace.
+
+    Classifies every backend work event into a train-step segment
+    (attention/mlp/ln/embed/logits/loss/optimizer) by the named-scope tags
+    XLA propagates into op metadata, splitting attention/mlp further into
+    fwd vs bwd by the autodiff markers in the op_name path. Events with no
+    recognizable metadata land in ``unattributed`` — on CPU traces (no
+    XLA metadata in the chrome export) that is everything, and the block
+    says so rather than guessing. Returns ``{"segments": {name:
+    {"device_ms", "events", "frac"}}, "total_device_ms",
+    "attributed_frac"}`` sorted by time.
+    """
+    works = work_events(events, lanes=lanes)
+    total_us = 0.0
+    seg_us: Dict[str, float] = {}
+    seg_n: Dict[str, int] = {}
+    for e in works:
+        dur = float(e.get("dur", 0.0))
+        if dur <= 0:
+            continue
+        total_us += dur
+        blob = _event_blob(e)
+        seg = None
+        for name, needles in tags:
+            if any(n in blob for n in needles):
+                seg = name
+                break
+        if seg is None:
+            seg = "unattributed"
+        elif seg in ("attention", "mlp"):
+            bwd = any(m in blob for m in _BWD_MARKERS)
+            seg = f"{seg}_{'bwd' if bwd else 'fwd'}"
+        seg_us[seg] = seg_us.get(seg, 0.0) + dur
+        seg_n[seg] = seg_n.get(seg, 0) + 1
+    out = {
+        "segments": {
+            k: {"device_ms": round(v / 1e3, 4),
+                "events": seg_n[k],
+                "frac": round(v / total_us, 4) if total_us else None}
+            for k, v in sorted(seg_us.items(), key=lambda kv: -kv[1])},
+        "total_device_ms": round(total_us / 1e3, 4),
+        "attributed_frac": round(
+            1.0 - seg_us.get("unattributed", 0.0) / total_us, 4)
+        if total_us else None,
+        "note": ("device-lane work events classified by XLA op-metadata "
+                 "scope tags (jax.named_scope in the model + TrainStep); "
+                 "fwd/bwd split by autodiff markers; 'unattributed' "
+                 "covers events whose export carries no metadata (all of "
+                 "them on CPU traces)"),
+    }
+    return out
+
+
 def _args_name_match(e: dict, names: set) -> Optional[str]:
     """A work event whose args carry one of our annotation names (XLA
     op-metadata propagation on real TPU); returns the matched name."""
@@ -371,6 +458,8 @@ class CaptureSession:
                 doc = load_trace(trace_path)
                 summary["correlation"] = correlate(
                     self.spans, doc.get("traceEvents", []))
+                summary["segments"] = segment_breakdown(
+                    doc.get("traceEvents", []))
             except Exception as e:
                 summary["parse_error"] = f"{type(e).__name__}: {e}"
         summary["device_time"] = {

@@ -262,3 +262,42 @@ def test_device_time_probe_xplane_mode(monkeypatch, tmp_path):
     by_op = {r["op"]: r for r in probe["correlation"]["by_op"]}
     assert "matmul" in by_op
     assert by_op["matmul"]["xplane_ms"] > 0
+
+
+import pytest
+
+
+@pytest.mark.slow  # compiles 8 small resnet TrainStep variants (~2 min)
+# fast-sibling: test_resnet_conv_fusion_block_shape validates the block
+# contract without the full probe sweep
+def test_bench_resnet50_emits_conv_fusion_block():
+    """The r06 conv-fusion A/B probe rides bench_resnet50 at CPU-feasible
+    shapes and validates against the gate."""
+    bench = _load_bench()
+    cfg = bench.bench_resnet50(B=4, hw=32, depth=18, probe_iters=2)
+    cf = cfg["conv_fusion"]
+    assert cf["enabled"] is True
+    assert isinstance(cf.get("engaged"), bool)
+    assert cf["probe_ms_on"] > 0 and cf["probe_ms_off"] > 0
+    assert cfg["platform"] == "cpu"
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_bench_result as gate
+    doc = {"configs": {"resnet50": cfg}}
+    assert [p for p in gate.validate_observability(doc)
+            if "conv_fusion" in p] == []
+
+
+def test_resnet_conv_fusion_block_shape():
+    """Fast sibling: the emitted block's field contract (no probe sweep)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_bench_result as gate
+    block = {"enabled": True, "engaged": False,
+             "kernel_stats": {"pallas_fwd": 0, "xla_fwd": 0,
+                              "pallas_bwd": 0, "xla_bwd": 0},
+             "probe_ms_on": 10.0, "probe_ms_off": 11.0,
+             "speedup_vs_off": 1.1, "hbm_gb_per_step_on": 1.0,
+             "hbm_gb_per_step_off": 1.2, "hbm_pct_saved": 16.7,
+             "note": "x"}
+    doc = {"configs": {"resnet50": {"samples_per_sec_chip": 1.0,
+                                    "conv_fusion": block}}}
+    assert gate.validate_observability(doc) == []

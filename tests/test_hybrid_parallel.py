@@ -280,3 +280,114 @@ class TestFp16GradScaling:
                 np.asarray(step.params["0.weight"]), w0)
         finally:
             dist.set_hybrid_communicate_group(None)
+
+
+class TestHealthProbeWiring:
+    """r06 satellite: the PR-9 in-graph numerics sentinel rides in the
+    hybrid engine's own compiled step (it builds its step itself and did
+    not carry the TrainStep wiring)."""
+
+    def test_sentinel_records_on_hybrid_step(self):
+        from paddle_tpu.profiler import health as health_mod
+        X, Y = _make_data()
+        fleet.init(is_collective=True, strategy=DistributedStrategy())
+        dist.set_hybrid_communicate_group(
+            HybridCommunicateGroup(dims={"dp": 2, "mp": 4}))
+        paddle.seed(0)
+        net = MLP()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        step = HybridParallelTrainStep(
+            net, lambda lg, lb: F.cross_entropy(lg, lb), opt, health=True)
+        assert step._health_probe is not None
+        loss = float(step(paddle.to_tensor(X), paddle.to_tensor(Y)))
+        rec = step.last_health
+        assert rec is not None and rec["step"] == 1
+        assert rec["loss"] == pytest.approx(loss, rel=1e-5)
+        assert np.isfinite(rec["grad_norm"]) and rec["grad_norm"] > 0
+        assert not rec["nonfinite"]
+        assert health_mod.last_stats() is not None
+
+    def test_nan_input_trips_sentinel(self):
+        X, Y = _make_data()
+        X = X.copy()
+        X[0, 0] = np.nan
+        fleet.init(is_collective=True, strategy=DistributedStrategy())
+        dist.set_hybrid_communicate_group(
+            HybridCommunicateGroup(dims={"dp": 2, "mp": 4}))
+        paddle.seed(0)
+        net = MLP()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        step = HybridParallelTrainStep(
+            net, lambda lg, lb: F.cross_entropy(lg, lb), opt, health=True)
+        step(paddle.to_tensor(X), paddle.to_tensor(Y))
+        assert step.last_health["nonfinite"]
+        from paddle_tpu.profiler import health as health_mod
+        health_mod.clear_trip()
+
+    def test_health_off_keeps_step_shape(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_HEALTH", raising=False)
+        X, Y = _make_data()
+        fleet.init(is_collective=True, strategy=DistributedStrategy())
+        dist.set_hybrid_communicate_group(
+            HybridCommunicateGroup(dims={"dp": 8}))
+        paddle.seed(0)
+        net = MLP()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        step = HybridParallelTrainStep(
+            net, lambda lg, lb: F.cross_entropy(lg, lb), opt)
+        assert step._health_probe is None
+        float(step(paddle.to_tensor(X), paddle.to_tensor(Y)))
+        assert step.last_health is None
+
+
+class TestHealthUnderFp16:
+    """Review regression: under fp16 dynamic loss scaling the sentinel
+    must see UNSCALED grads (norms not inflated by the 2^k scale) and a
+    scaler overflow event (non-finite scaled grad, update skipped, scale
+    halves — GradScaler semantics) must NOT trip the nonfinite flag."""
+
+    def _step(self, init_scale=256.0):
+        fleet.init(is_collective=True, strategy=DistributedStrategy())
+        dist.set_hybrid_communicate_group(
+            HybridCommunicateGroup(dims={"dp": 8}))
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        strategy.amp_configs = {"dtype": "float16",
+                                "init_loss_scaling": init_scale}
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        return HybridParallelTrainStep(
+            model, lambda o, y: F.cross_entropy(o, y), opt,
+            strategy=strategy, health=True)
+
+    def test_grad_norm_is_unscaled(self):
+        step = self._step()
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(16, 16)).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 4, (16,)).astype(np.int32))
+        step(x, y)
+        rec = step.last_health
+        assert rec is not None and not rec["nonfinite"]
+        # a scaled norm would be ~256x; sane unscaled CE-grad norms on
+        # this toy model sit well under 100
+        assert 0 < rec["grad_norm"] < 100.0, rec["grad_norm"]
+
+    def test_scaler_overflow_does_not_trip_sentinel(self):
+        # an absurd initial scale overflows the fp16 scaled grads on the
+        # first step; the scaler skips the update and halves — the
+        # sentinel must not read that as numeric divergence
+        step = self._step(init_scale=2.0 ** 32)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(
+            (rng.normal(size=(16, 16)) * 100).astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 4, (16,)).astype(np.int32))
+        step(x, y)
+        rec = step.last_health
+        assert rec is not None
+        assert not rec["nonfinite"], rec
+        assert float(step.scaler_state["scale"]) < 2.0 ** 32  # it fired
+        from paddle_tpu.profiler import health as health_mod
+        health_mod.clear_trip()

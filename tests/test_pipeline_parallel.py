@@ -315,3 +315,42 @@ class TestPipelineUnevenSegmentation:
             for _ in range(5)]
         assert losses[-1] < losses[0]
         assert model._train_step.run.counts == [2, 3]
+
+
+class TestHealthProbeWiring:
+    """r06 satellite: the PR-9 sentinel in the pipeline engine's compiled
+    step (regression per parallelism mode; hybrid has its own sibling)."""
+
+    def test_sentinel_records_on_pipeline_step(self):
+        cfg = GPTConfig.tiny()
+        hcg = _setup({"pp": 2})
+        paddle.seed(0)
+        model = GPT(cfg)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        step = PipelineParallelTrainStep(
+            model, F.cross_entropy, opt, hcg=hcg, num_micro=2,
+            donate=False, health=True)
+        assert step._health_probe is not None
+        # B=16 over 2 micro-batches of 8: divisible by the dp axis that
+        # fills the rest of the 8-device mesh
+        a, b = _gpt_batch(cfg, B=16, L=16)
+        loss = float(step(paddle.to_tensor(a), paddle.to_tensor(b)))
+        rec = step.last_health
+        assert rec is not None
+        assert rec["loss"] == pytest.approx(loss, rel=1e-5)
+        assert np.isfinite(rec["grad_norm"]) and rec["grad_norm"] > 0
+        assert not rec["nonfinite"]
+
+    def test_health_off_default(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_HEALTH", raising=False)
+        cfg = GPTConfig.tiny()
+        hcg = _setup({"pp": 2})
+        paddle.seed(0)
+        model = GPT(cfg)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        step = PipelineParallelTrainStep(
+            model, F.cross_entropy, opt, hcg=hcg, num_micro=2,
+            donate=False)
+        assert step._health_probe is None

@@ -512,3 +512,95 @@ class TestTunedDispatch:
         assert np.array_equal(np.asarray(y_tuned), np.asarray(y_static))
         assert np.array_equal(np.asarray(m1), np.asarray(m2))
         fb._probe_status.clear()
+
+
+_CONV_BN_CHILD = """
+import json
+import numpy as np
+import jax.numpy as jnp
+from paddle_tpu.ops.pallas import autotune, fused_bn as fb
+from paddle_tpu.ops.pallas import fused_conv_bn as fcb
+fb._INTERPRET = True
+fcb._INTERPRET = True
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.normal(size=(4, 8, 8, 128)).astype(np.float32))
+w = jnp.asarray((rng.normal(size=(256, 128, 1, 1)) * 0.05).astype(np.float32))
+g = jnp.ones((256,), jnp.float32)
+b = jnp.zeros((256,), jnp.float32)
+y, m, v = fcb.fused_conv1x1_bn_act(x, w, g, b, act="relu")
+print("RESULT" + json.dumps({
+    "y0": float(np.asarray(y).ravel()[0]),
+    "hit": autotune._M_EVENTS.value(event="hit", op="conv_bn"),
+    "miss": autotune._M_EVENTS.value(event="miss", op="conv_bn"),
+    "tunes": autotune._M_TUNES.value(op="conv_bn"),
+    "persist": autotune._M_EVENTS.value(event="persist", op="conv_bn"),
+}))
+"""
+
+
+class TestConvBnCrossProcessCache:
+    """r06 satellite: the NEW conv_bn kernel's autotune resolution hits
+    the persistent cache cross-process — process A tunes+persists, B
+    resolves with ZERO probes (no tune, hit counter > 0)."""
+
+    @staticmethod
+    def _run_child(cache_dir):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "PADDLE_TPU_AUTOTUNE": "force",
+                    "PADDLE_TPU_AUTOTUNE_CACHE_DIR": str(cache_dir),
+                    "PADDLE_TPU_AUTOTUNE_REPEATS": "1",
+                    "PADDLE_TPU_AUTOTUNE_MAX_CONFIGS": "3"})
+        proc = subprocess.run(
+            [sys.executable, "-c", _CONV_BN_CHILD],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env, capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        for line in proc.stdout.splitlines():
+            if line.startswith("RESULT"):
+                return json.loads(line[len("RESULT"):])
+        raise AssertionError(f"child printed no RESULT: {proc.stdout!r}")
+
+    def test_tune_once_then_hit_without_probes(self, tmp_path):
+        a = self._run_child(tmp_path)
+        assert a["miss"] == 1 and a["tunes"] == 1 and a["persist"] == 1
+        assert list(tmp_path.glob("conv_bn-*.json"))
+        b = self._run_child(tmp_path)
+        assert b["hit"] > 0, "process B did not hit the persistent cache"
+        assert b["miss"] == 0 and b["tunes"] == 0, \
+            "process B re-probed a cached conv_bn config"
+        assert b["y0"] == a["y0"]
+
+
+class TestCandidateSpaceFingerprint:
+    """Review regression: widening a kernel's candidate space must MISS
+    the old space's persisted entry and re-tune — the disk path carries a
+    candidate-space fingerprint on top of (op, key, chip)."""
+
+    def test_changed_space_retunes(self, tuner, monkeypatch):
+        calls = []
+
+        def bench(cfg):
+            calls.append(cfg.label)
+
+        default = tiling.make_config(rows=256)
+        narrow = [default, tiling.make_config(rows=128)]
+        cfg1 = autotune.get_config("space_op", (1024, "f32"), narrow,
+                                   default, bench, interpret=True)
+        assert _ev("persist", "space_op") == 1
+        n_after_first = len(calls)
+        assert n_after_first > 0
+        # same space resolves from disk after a memory reset: no probes
+        autotune.reset_for_tests()
+        cfg2 = autotune.get_config("space_op", (1024, "f32"), narrow,
+                                   default, bench, interpret=True)
+        assert cfg2 == cfg1 and len(calls) == n_after_first
+        assert _ev("hit", "space_op") == 1
+        # WIDENED space: the old entry must not satisfy the lookup
+        autotune.reset_for_tests()
+        wide = narrow + [tiling.make_config(rows=512)]
+        autotune.get_config("space_op", (1024, "f32"), wide, default,
+                            bench, interpret=True)
+        assert len(calls) > n_after_first, \
+            "widened candidate space served the stale narrow-space entry"
+        assert _ev("persist", "space_op") == 2

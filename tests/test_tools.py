@@ -875,3 +875,160 @@ class TestAutotuneGate:
             doc["configs"]["gpt"]["autotune"] = bad
             problems = gate.validate_observability(doc)
             assert problems, f"autotune={bad!r} produced no violation"
+
+
+class TestPlatformAwareGate:
+    """r06: cross-platform rounds/configs read 'incomparable', never
+    'regressed' — a CPU dev-box round vs a TPU driver round is not a
+    perf regression. Undeclared-vs-undeclared keeps the old behavior."""
+
+    BASE = {"configs": {
+        "gpt": {"tokens_per_sec_chip": 100000.0},
+        "ps_cpu": {"examples_per_sec": 30000.0, "platform": "cpu"}}}
+
+    def test_declared_mismatch_is_incomparable(self):
+        cur = {"platform": "cpu", "configs": {
+            "gpt": {"tokens_per_sec_chip": 50.0, "platform": "cpu"},
+            "ps_cpu": {"examples_per_sec": 3000.0, "platform": "cpu"}}}
+        rows = gate.compare(self.BASE, cur, 0.05,
+                            baseline_platform="tpu")
+        by = {r[0]: r[5] for r in rows}
+        # round platforms differ -> EVERY row incomparable, including the
+        # all-CPU PS config (it ran on a different HOST)
+        assert by == {"gpt": "incomparable", "ps_cpu": "incomparable"}
+
+    def test_no_assumption_keeps_status_quo(self):
+        cur = {"configs": {
+            "gpt": {"tokens_per_sec_chip": 50.0},
+            "ps_cpu": {"examples_per_sec": 30000.0}}}
+        rows = gate.compare(self.BASE, cur, 0.05)
+        by = {r[0]: r[5] for r in rows}
+        assert by["gpt"] == "regressed"
+
+    def test_axon_is_tpu_family(self):
+        base = {"configs": {
+            "gpt": {"tokens_per_sec_chip": 100000.0, "platform": "axon"}}}
+        cur = {"configs": {
+            "gpt": {"tokens_per_sec_chip": 99000.0, "platform": "tpu"}}}
+        rows = gate.compare(base, cur, 0.05)
+        assert rows[0][5] == "ok"
+
+    def test_incomparable_does_not_fail_cli(self, tmp_path):
+        base = tmp_path / "base.json"
+        cur = tmp_path / "cur.json"
+        base.write_text(json.dumps(self.BASE))
+        cur.write_text(json.dumps({"platform": "cpu", "configs": {
+            "gpt": {"tokens_per_sec_chip": 50.0, "platform": "cpu"},
+            "ps_cpu": {"examples_per_sec": 3000.0, "platform": "cpu"}}}))
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "check_bench_result.py"),
+             "--baseline", str(base), "--current", str(cur),
+             "--assume-baseline-platform", "tpu"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "incomparable" in r.stdout
+
+
+class TestSegmentsAndConvFusionGate:
+    """r06 satellite: the per-segment breakdown block and the conv-fusion
+    A/B probe block validate with NAMED violations."""
+
+    @staticmethod
+    def _doc(profile=None, conv_fusion=None):
+        cfg = {"samples_per_sec_chip": 100.0}
+        if profile is not None:
+            cfg["profile"] = profile
+        if conv_fusion is not None:
+            cfg["conv_fusion"] = conv_fusion
+        return {"configs": {"resnet50": cfg}}
+
+    def test_valid_segments_pass(self):
+        doc = self._doc(profile={"segments": {
+            "segments": {
+                "attention_fwd": {"device_ms": 1.5, "events": 10,
+                                  "frac": 0.5},
+                "unattributed": {"device_ms": 1.5, "events": 3,
+                                 "frac": 0.5}},
+            "total_device_ms": 3.0, "attributed_frac": 0.5}})
+        assert gate.validate_observability(doc) == []
+
+    def test_garbled_segments_named(self):
+        doc = self._doc(profile={"segments": {
+            "segments": {
+                "mlp": {"device_ms": -1.0, "events": 2, "frac": 1.7}},
+            "total_device_ms": "nope", "attributed_frac": None}})
+        probs = gate.validate_observability(doc)
+        blob = "\n".join(probs)
+        assert "configs.resnet50.profile.segments" in blob
+        assert "device_ms" in blob and "frac" in blob \
+            and "total_device_ms" in blob
+
+    def test_valid_conv_fusion_passes(self):
+        doc = self._doc(conv_fusion={
+            "enabled": True, "engaged": False,
+            "probe_ms_on": 12.5, "probe_ms_off": 14.0,
+            "speedup_vs_off": 1.12, "hbm_gb_per_step_on": 40.0,
+            "hbm_gb_per_step_off": 46.0, "hbm_pct_saved": 13.0,
+            "kernel_stats": {"pallas_fwd": 0, "xla_fwd": 0}})
+        assert gate.validate_observability(doc) == []
+
+    def test_garbled_conv_fusion_named(self):
+        doc = self._doc(conv_fusion={
+            "enabled": "yes", "probe_ms_on": -3,
+            "hbm_pct_saved": 250.0,
+            "kernel_stats": {"pallas_fwd": -1}})
+        probs = gate.validate_observability(doc)
+        blob = "\n".join(probs)
+        assert "configs.resnet50.conv_fusion.enabled" in blob
+        assert "probe_ms_on" in blob
+        assert "hbm_pct_saved" in blob
+        assert "kernel_stats" in blob
+
+    def test_probe_error_block_not_gated(self):
+        doc = self._doc(conv_fusion={"enabled": True,
+                                     "error": "RuntimeError: boom"})
+        assert gate.validate_observability(doc) == []
+
+    def test_micro_ab_block_validates(self):
+        doc = self._doc(conv_fusion={
+            "enabled": True, "engaged": False,
+            "micro_ab": {"rows": [
+                {"shape": "b128x56x56 64->256",
+                 "composed_gb_cost_analysis": 3.8,
+                 "composed_gb_model": 0.87, "fused_gb_model": 0.67,
+                 "pct_saved": 23.5}],
+                "total_pct_saved": 23.5}})
+        assert gate.validate_observability(doc) == []
+        bad = self._doc(conv_fusion={
+            "enabled": True,
+            "micro_ab": {"rows": [{"shape": 7, "fused_gb_model": -1,
+                                   "pct_saved": 120.0}]}})
+        blob = "\n".join(gate.validate_observability(bad))
+        assert "micro_ab.rows[0].shape" in blob
+        assert "fused_gb_model" in blob and "pct_saved" in blob
+
+
+class TestScaleAwareGate:
+    """Review regression: a scale=ci round must never gate against a
+    full-scale baseline even on the SAME platform (bench.py's contract:
+    scaled rounds can never be mistaken for full-scale numbers)."""
+
+    def test_scale_mismatch_is_incomparable(self):
+        base = {"configs": {"gpt": {"tokens_per_sec_chip": 100000.0,
+                                    "platform": "tpu"}}}
+        cur = {"configs": {"gpt": {"tokens_per_sec_chip": 50.0,
+                                   "platform": "tpu", "scale": "ci"}}}
+        rows = gate.compare(base, cur, 0.05)
+        assert rows[0][5] == "incomparable"
+        # and the reverse direction (full vs ci baseline)
+        rows = gate.compare(cur, base, 0.05)
+        assert rows[0][5] == "incomparable"
+
+    def test_matching_scales_still_gate(self):
+        base = {"configs": {"gpt": {"tokens_per_sec_chip": 100000.0,
+                                    "platform": "tpu", "scale": "ci"}}}
+        cur = {"configs": {"gpt": {"tokens_per_sec_chip": 80000.0,
+                                   "platform": "tpu", "scale": "ci"}}}
+        rows = gate.compare(base, cur, 0.05)
+        assert rows[0][5] == "regressed"

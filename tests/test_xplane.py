@@ -343,3 +343,70 @@ class TestCompileCacheWiring:
         finally:
             flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
             assert jax.config.jax_compilation_cache_dir is None
+
+
+class TestSegmentBreakdown:
+    """Measured per-segment attribution (r06): work events classified by
+    XLA op-metadata scope tags, fwd/bwd split by autodiff markers,
+    unattributed bucket for metadata-free exports."""
+
+    @staticmethod
+    def _tpu_style_trace():
+        """Device-lane events whose args carry op_name metadata the way
+        the TPU TB export does."""
+        def dev(name, ts, dur, op_name):
+            return _ev(name, ts, dur, pid=5, tid=50,
+                       args={"name": op_name})
+        return [
+            _meta(5, name="/device:TPU:0"),
+            _meta(5, tid=50, name="XLA Op"),
+            dev("fusion.1", 0, 100,
+                "jit(step)/attention/dot_general"),
+            dev("fusion.2", 100, 300,
+                "jit(step)/transpose(jvp(attention))/dot_general"),
+            dev("fusion.3", 400, 80, "jit(step)/mlp/dot_general"),
+            dev("fusion.4", 480, 160,
+                "jit(step)/transpose(jvp(mlp))/dot_general"),
+            dev("fusion.5", 640, 20, "jit(step)/ln/reduce"),
+            dev("fusion.6", 660, 30, "jit(step)/loss/reduce"),
+            dev("fusion.7", 690, 40, "jit(step)/optimizer/multiply"),
+            dev("fusion.8", 730, 25, "jit(step)/embed/gather"),
+            dev("custom-call.9", 755, 55, "flash_attention_fwd"),
+            dev("fusion.10", 810, 90, "something_opaque"),
+            # backward LN spelling: no /ln/ path component, only the
+            # autodiff-wrapped scope — must still classify as ln
+            dev("fusion.11", 900, 10,
+                "jit(step)/transpose(jvp(ln))/reduce"),
+        ]
+
+    def test_classification_and_fractions(self):
+        out = xplane.segment_breakdown(self._tpu_style_trace())
+        seg = out["segments"]
+        assert seg["attention_fwd"]["device_ms"] == pytest.approx(0.155)
+        assert seg["attention_bwd"]["device_ms"] == pytest.approx(0.3)
+        assert seg["mlp_fwd"]["device_ms"] == pytest.approx(0.08)
+        assert seg["mlp_bwd"]["device_ms"] == pytest.approx(0.16)
+        assert seg["ln"]["events"] == 2  # fwd (/ln/) + bwd (jvp(ln))
+        assert seg["ln"]["device_ms"] == pytest.approx(0.03)
+        assert seg["loss"]["device_ms"] == pytest.approx(0.03)
+        assert seg["optimizer"]["device_ms"] == pytest.approx(0.04)
+        assert seg["embed"]["events"] == 1
+        assert seg["unattributed"]["device_ms"] == pytest.approx(0.09)
+        total = out["total_device_ms"]
+        assert total == pytest.approx(0.91)
+        assert out["attributed_frac"] == pytest.approx(1 - 0.09 / 0.91,
+                                                       abs=1e-4)
+        fracs = sum(r["frac"] for r in seg.values())
+        assert fracs == pytest.approx(1.0, abs=1e-3)
+
+    def test_metadata_free_trace_is_all_unattributed(self):
+        out = xplane.segment_breakdown(_synthetic_trace())
+        seg = out["segments"]
+        assert set(seg) == {"unattributed"}
+        assert out["attributed_frac"] == 0.0
+
+    def test_empty_trace(self):
+        out = xplane.segment_breakdown([])
+        assert out["segments"] == {}
+        assert out["total_device_ms"] == 0.0
+        assert out["attributed_frac"] is None

@@ -75,12 +75,51 @@ def _metric_of(cfg: dict) -> Optional[Tuple[str, float]]:
     return None
 
 
-def compare(baseline: dict, current: dict, threshold: float):
+# plugin spellings of the same accelerator family compare fine
+_PLATFORM_FAMILY = {"axon": "tpu"}
+
+
+def _config_platform(cfg: dict, doc: dict,
+                     assumed: Optional[str]) -> Optional[str]:
+    """Declared platform of one config: per-config field, else the
+    round-level field, else the caller's --assume-baseline-platform."""
+    p = cfg.get("platform") if isinstance(cfg, dict) else None
+    if not (isinstance(p, str) and p):
+        p = doc.get("platform")
+    if not (isinstance(p, str) and p):
+        p = assumed
+    return _PLATFORM_FAMILY.get(p, p) if isinstance(p, str) else None
+
+
+def _config_scale(cfg: dict) -> str:
+    """Declared bench scale of one config; rounds predating the field
+    were all full-scale TPU-box runs, so undeclared means "full"."""
+    s = cfg.get("scale") if isinstance(cfg, dict) else None
+    return s if isinstance(s, str) and s else "full"
+
+
+def compare(baseline: dict, current: dict, threshold: float,
+            baseline_platform: Optional[str] = None):
     """[(config, metric, base, cur, rel_change, status)] — status in
-    {"ok", "improved", "regressed", "new", "missing"}."""
+    {"ok", "improved", "regressed", "new", "missing", "incomparable"}.
+
+    A config pair whose two sides DECLARE different platforms (r06+
+    records per-config `platform`; older rounds can be stated via
+    --assume-baseline-platform, e.g. `tpu` for the r01-r05 driver rounds)
+    is "incomparable": a CPU dev-box round vs a TPU round is not a
+    regression, and gating on it would either mask real TPU regressions
+    or fail every cross-box run. Undeclared-vs-declared still compares
+    (best effort), so the gate's behavior on old file pairs is unchanged.
+    """
     rows = []
     base_cfgs = _configs(baseline)
     cur_cfgs = _configs(current)
+    # round-level platforms identify the BOX: when they are known to
+    # differ, every row is incomparable — even an all-CPU config (the
+    # wide&deep PS trainer) ran on a different host
+    rp_base = _config_platform({}, baseline, baseline_platform)
+    rp_cur = _config_platform({}, current, None)
+    rounds_differ = bool(rp_base and rp_cur and rp_base != rp_cur)
     for name, bc in base_cfgs.items():
         bm = _metric_of(bc)
         if bm is None:
@@ -99,6 +138,15 @@ def compare(baseline: dict, current: dict, threshold: float):
             rows.append((name, metric, bval, None, None, "missing"))
             continue
         rel = (cval - bval) / bval
+        bp = _config_platform(bc, baseline, baseline_platform)
+        cp = _config_platform(cc, current, None)
+        # a scale=ci round vs a full-scale baseline (or vice versa) is as
+        # incomparable as a different box — the dims/iters differ
+        if rounds_differ or (bp and cp and bp != cp) \
+                or _config_scale(bc) != _config_scale(cc):
+            rows.append((name, metric, bval, float(cval), rel,
+                         "incomparable"))
+            continue
         status = ("regressed" if rel < -threshold
                   else "improved" if rel > threshold else "ok")
         rows.append((name, metric, bval, float(cval), rel, status))
@@ -442,6 +490,110 @@ def _validate_autotune_block(where: str, at: dict) -> List[str]:
     return problems
 
 
+def _nonneg_num(v) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and v == v and v >= 0)
+
+
+def _validate_segments(where: str, seg: dict) -> List[str]:
+    """A `profile.segments` block (measured per-segment device-time
+    attribution from profiler/xplane.segment_breakdown): every segment
+    row needs non-negative device_ms / events and a frac in [0, 1] (or
+    null on an empty trace); attributed_frac likewise. A bench claiming
+    measured segment attribution with malformed rows fails the gate."""
+    problems = []
+    if not isinstance(seg, dict):
+        return [f"{where} is not an object"]
+    rows = seg.get("segments")
+    if rows is None or not isinstance(rows, dict):
+        return [f"{where}.segments is not an object"]
+    for name, r in rows.items():
+        if not isinstance(r, dict):
+            problems.append(f"{where}.segments[{name!r}] is not an object")
+            continue
+        if not _nonneg_num(r.get("device_ms")):
+            problems.append(f"{where}.segments[{name!r}].device_ms "
+                            f"{r.get('device_ms')!r} is not a non-negative "
+                            f"number")
+        ev = r.get("events")
+        if not isinstance(ev, int) or isinstance(ev, bool) or ev < 0:
+            problems.append(f"{where}.segments[{name!r}].events {ev!r} is "
+                            f"not a non-negative integer")
+        fr = r.get("frac")
+        if fr is not None and (not _nonneg_num(fr) or fr > 1.0 + 1e-9):
+            problems.append(f"{where}.segments[{name!r}].frac {fr!r} is "
+                            f"not in [0, 1] or null")
+    if not _nonneg_num(seg.get("total_device_ms")):
+        problems.append(f"{where}.total_device_ms "
+                        f"{seg.get('total_device_ms')!r} is not a "
+                        f"non-negative number")
+    af = seg.get("attributed_frac")
+    if af is not None and (not _nonneg_num(af) or af > 1.0 + 1e-9):
+        problems.append(f"{where}.attributed_frac {af!r} is not in "
+                        f"[0, 1] or null")
+    return problems
+
+
+def _validate_conv_fusion(where: str, cf: dict) -> List[str]:
+    """A resnet `conv_fusion` A/B probe block: on/off probe times and
+    cost-analysis HBM bytes must be non-negative numbers (or null), the
+    engagement flags bools, and kernel_stats non-negative counters."""
+    problems = []
+    if not isinstance(cf, dict):
+        return [f"{where} is not an object"]
+    if "error" in cf:
+        return problems  # a failed probe reports itself; nothing to gate
+    for key in ("enabled", "engaged"):
+        v = cf.get(key)
+        if v is not None and not isinstance(v, bool):
+            problems.append(f"{where}.{key} {v!r} is not a bool")
+    for key in ("probe_ms_on", "probe_ms_off", "speedup_vs_off",
+                "hbm_gb_per_step_on", "hbm_gb_per_step_off"):
+        v = cf.get(key)
+        if v is not None and not _nonneg_num(v):
+            problems.append(f"{where}.{key} {v!r} is not a non-negative "
+                            f"number or null")
+    pct = cf.get("hbm_pct_saved")
+    if pct is not None and (not isinstance(pct, (int, float))
+                            or isinstance(pct, bool) or pct != pct
+                            or pct > 100.0):
+        problems.append(f"{where}.hbm_pct_saved {pct!r} is not a number "
+                        f"<= 100 or null")
+    ks = cf.get("kernel_stats")
+    if ks is not None:
+        if not isinstance(ks, dict):
+            problems.append(f"{where}.kernel_stats is not an object")
+        else:
+            for k, v in ks.items():
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    problems.append(f"{where}.kernel_stats[{k!r}] {v!r} is "
+                                    f"not a non-negative integer")
+    mab = cf.get("micro_ab")
+    if mab is not None:
+        if not isinstance(mab, dict):
+            problems.append(f"{where}.micro_ab is not an object")
+        else:
+            for i, r in enumerate(mab.get("rows") or []):
+                if not isinstance(r, dict):
+                    problems.append(f"{where}.micro_ab.rows[{i}] is not "
+                                    f"an object")
+                    continue
+                if not isinstance(r.get("shape"), str):
+                    problems.append(f"{where}.micro_ab.rows[{i}].shape "
+                                    f"{r.get('shape')!r} is not a string")
+                for key in ("composed_gb_cost_analysis", "fused_gb_model"):
+                    if not _nonneg_num(r.get(key)):
+                        problems.append(
+                            f"{where}.micro_ab.rows[{i}].{key} "
+                            f"{r.get(key)!r} is not a non-negative number")
+                ps = r.get("pct_saved")
+                if not isinstance(ps, (int, float)) or isinstance(ps, bool)\
+                        or ps != ps or ps > 100.0:
+                    problems.append(f"{where}.micro_ab.rows[{i}].pct_saved "
+                                    f"{ps!r} is not a number <= 100")
+    return problems
+
+
 def _validate_device_memory_metrics(where: str, metrics: dict) -> List[str]:
     """`device_memory_*` families must be gauges of non-negative values
     whose series carry the `device` label."""
@@ -483,12 +635,23 @@ def validate_observability(doc: dict) -> List[str]:
     from paddle_tpu.profiler.events import validate_event
     from paddle_tpu.profiler.monitor import validate_step_record
     problems = []
-    # per-config `autotune` blocks sit beside (not inside) observability
+    # per-config `autotune`/`profile`/`conv_fusion` blocks sit beside
+    # (not inside) observability
     for name, cfg in (doc.get("configs") or {}).items():
-        at = cfg.get("autotune") if isinstance(cfg, dict) else None
+        if not isinstance(cfg, dict):
+            continue
+        at = cfg.get("autotune")
         if at is not None:
             problems.extend(_validate_autotune_block(
                 f"configs.{name}.autotune", at))
+        prof = cfg.get("profile")
+        if isinstance(prof, dict) and prof.get("segments") is not None:
+            problems.extend(_validate_segments(
+                f"configs.{name}.profile.segments", prof["segments"]))
+        cf = cfg.get("conv_fusion")
+        if cf is not None:
+            problems.extend(_validate_conv_fusion(
+                f"configs.{name}.conv_fusion", cf))
     for where, obs in _obs_blocks(doc):
         metrics = obs.get("metrics")
         if isinstance(metrics, dict):
@@ -552,10 +715,19 @@ def main(argv=None) -> int:
     ap.add_argument("--no-obs-check", action="store_true",
                     help="skip observability schema validation of the "
                          "current round")
+    ap.add_argument("--assume-baseline-platform", default=None,
+                    metavar="PLAT",
+                    help="platform the baseline round ran on when its "
+                         "file predates per-config platform fields "
+                         "(r01-r05 driver rounds ran on the TPU box: "
+                         "pass 'tpu'); configs whose declared platforms "
+                         "differ are reported 'incomparable' instead of "
+                         "gated")
     args = ap.parse_args(argv)
     try:
         current = _load(args.current)
-        rows = compare(_load(args.baseline), current, args.threshold)
+        rows = compare(_load(args.baseline), current, args.threshold,
+                       baseline_platform=args.assume_baseline_platform)
     except (OSError, ValueError) as e:
         print(f"check_bench_result: {e}", file=sys.stderr)
         return 2

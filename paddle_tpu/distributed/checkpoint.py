@@ -82,6 +82,10 @@ _M_BARRIER_COMMITS = _REG.counter(
     "ckpt_barrier_commits_total",
     "coordinated checkpoint commits (this host renamed tmp -> final after "
     "all hosts prepared)")
+_M_SKIP_NONFINITE = _REG.counter(
+    "checkpoint_resume_skipped_nonfinite_total",
+    "CRC-valid checkpoints skipped at resume because their weights held "
+    "NaN/Inf (valid-only resume, the fleet-rollback path)")
 
 _pending_saves: list = []
 _save_errors: list = []
@@ -325,6 +329,55 @@ def latest(dirname: str, prefix: str = "ckpt") -> Optional[str]:
     return files[0][1] if files else None
 
 
+def resume_valid_only() -> bool:
+    """`PADDLE_TPU_RESUME_VALID_ONLY=1`: resume must skip checkpoints
+    whose weights hold NaN/Inf even when they are CRC-valid. The fleet
+    controller's coordinated-rollback relaunch sets this so every host
+    negotiates (and restores) the same last NUMERICALLY-valid committed
+    step — a CRC can't see a divergence that was checkpointed before the
+    sentinel's detection latency caught it."""
+    return os.environ.get("PADDLE_TPU_RESUME_VALID_ONLY", "0") \
+        .strip().lower() in ("1", "true", "on", "yes")
+
+
+def tree_finite(obj) -> bool:
+    """True when every floating-point array leaf in a checkpoint state
+    tree is finite. Walks dicts/lists/tuples and Tensor-like leaves; an
+    unrecognized leaf is accepted (nothing to judge). Rollback-path
+    only — never per step."""
+    try:
+        if isinstance(obj, dict):
+            return all(tree_finite(v) for v in obj.values())
+        if isinstance(obj, (list, tuple)):
+            return all(tree_finite(v) for v in obj)
+        # array-like leaf, or a Tensor-like wrapper around one (probe
+        # `.data` only when the leaf itself has no dtype — an ndarray's
+        # own `.data` is a memoryview, not the array)
+        a = obj if hasattr(obj, "dtype") else getattr(obj, "data", obj)
+        if not hasattr(a, "dtype") or not hasattr(a, "shape"):
+            return True
+        a = np.asarray(a)
+        if a.dtype.kind == "f":
+            pass
+        elif "float" in str(a.dtype):  # bfloat16/float8 via ml_dtypes
+            a = a.astype(np.float32)
+        else:
+            return True
+        return bool(np.all(np.isfinite(a)))
+    except Exception:
+        return True  # unjudgeable: accept rather than wedge a resume
+
+
+def _note_nonfinite_skip(path: str):
+    """Shared warn + metric for a CRC-valid candidate skipped at resume
+    because its weights hold NaN/Inf (valid-only mode) — one definition
+    so the six skip sites across both layouts cannot drift."""
+    warnings.warn(f"skipping numerically-invalid checkpoint {path} "
+                  f"(nonfinite weights; valid-only resume)")
+    if _metrics_mod.enabled():
+        _M_SKIP_NONFINITE.inc()
+
+
 def latest_valid(dirname: str, prefix: str = "ckpt") -> Optional[str]:
     """Newest checkpoint that passes verification; corrupt files are
     skipped with a warning + metric instead of crashing the resume."""
@@ -339,12 +392,17 @@ def latest_valid(dirname: str, prefix: str = "ckpt") -> Optional[str]:
 
 
 def load_latest_valid(dirname: str, prefix: str = "ckpt",
-                      mesh=None) -> Optional[Tuple[Any, int, str]]:
+                      mesh=None, valid_only: Optional[bool] = None
+                      ) -> Optional[Tuple[Any, int, str]]:
     """(state, step, path) from the newest checkpoint that decodes cleanly,
     or None. Each candidate is read and CRC-verified ONCE (the decode
     reuses the bytes) — restore is the preemption-recovery critical path
     and must not double a multi-GB file's I/O. Corrupt candidates warn,
-    count, and fall through to the next-newest."""
+    count, and fall through to the next-newest. With `valid_only`
+    (default: the PADDLE_TPU_RESUME_VALID_ONLY env knob), candidates
+    whose weights hold NaN/Inf are skipped the same way."""
+    if valid_only is None:
+        valid_only = resume_valid_only()
     for step, path in _step_files(dirname, prefix):
         try:
             with open(path, "rb") as f:
@@ -354,6 +412,9 @@ def load_latest_valid(dirname: str, prefix: str = "ckpt",
             warnings.warn(f"skipping corrupt checkpoint {path}: {e}")
             if _metrics_mod.enabled():
                 _M_CORRUPT.inc()
+            continue
+        if valid_only and not tree_finite(blob.get("state")):
+            _note_nonfinite_skip(path)
             continue
         if _metrics_mod.enabled():
             _M_LOADS.inc()
@@ -917,15 +978,24 @@ class CheckpointManager:
         (None, None). Decodes rather than just CRC-verifying: the agreed
         resume step is almost always this file, and re-reading a multi-GB
         blob after negotiation would double restore I/O on the
-        preemption-recovery critical path."""
+        preemption-recovery critical path. Under valid-only resume
+        (PADDLE_TPU_RESUME_VALID_ONLY, the fleet-rollback relaunch mode)
+        CRC-valid blobs holding NaN/Inf weights are walked past too, so
+        the fleet negotiation runs over NUMERICALLY-valid steps."""
+        valid_only = resume_valid_only()
         for step, path in _step_files(self.dirname, self.prefix):
             try:
                 with open(path, "rb") as f:
-                    return step, _decode(path, f.read())
+                    blob = _decode(path, f.read())
             except (OSError, CheckpointCorruptError) as e:
                 warnings.warn(f"skipping corrupt checkpoint {path}: {e}")
                 if _metrics_mod.enabled():
                     _M_CORRUPT.inc()
+                continue
+            if valid_only and not tree_finite(blob.get("state")):
+                _note_nonfinite_skip(path)
+                continue
+            return step, blob
         return None, None
 
     def load_latest(self) -> Optional[Tuple[Any, int]]:
@@ -964,7 +1034,7 @@ class CheckpointManager:
         path = self.path_for(agreed)
         try:
             with open(path, "rb") as f:
-                return _decode(path, f.read())
+                blob = _decode(path, f.read())
         except (OSError, CheckpointCorruptError) as e:
             # do NOT fall back locally: peers are restoring the agreed
             # step, so a silent fresh start (or an older local step)
@@ -979,6 +1049,19 @@ class CheckpointManager:
                 f"fleet-agreed resume step {agreed} is unreadable on "
                 f"this host ({e}); refusing to diverge from peers that "
                 f"can read it") from e
+        if resume_valid_only() and not tree_finite(blob.get("state")):
+            # the agreed step must honor the valid-only guarantee on EVERY
+            # host: silently restoring a nonfinite local copy would resume
+            # diverged weights that data-parallel all_reduce averages into
+            # the run — fail loudly like the unreadable case (the
+            # supervisor relaunches and the fleet renegotiates)
+            if _metrics_mod.enabled():
+                _M_SKIP_NONFINITE.inc()
+            raise CheckpointCorruptError(
+                path,
+                f"fleet-agreed resume step {agreed} holds nonfinite "
+                f"weights on this host under valid-only resume")
+        return blob
 
     def _publish_sync(self, state: Any, step: int) -> bool:
         """One synchronous publish through the configured path: the

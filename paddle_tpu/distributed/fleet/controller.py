@@ -1,0 +1,740 @@
+"""FleetController: close the observe -> diagnose -> act loop.
+
+PRs 5-9 built every sensor (fleet digests, straggler detection,
+``step_diagnosis``, per-host ``health_status``) and every actuator
+(elastic relaunch, elastic re-sharding restore, coordinated rollback,
+compile-cache prewarm) of an autonomous fleet — but a straggling or
+diverging host still raised an event and waited for an operator. This
+module is the brain that connects them, supervisor-side (rank 0's
+``tools/elastic_run.py --controller``):
+
+* **Straggler eviction** — a host the :class:`FleetAggregator` flags as a
+  straggler for ``PADDLE_TPU_CONTROLLER_CONFIRM_WINDOWS`` CONSECUTIVE
+  collect windows (debounce: one slow step or a transient excursion never
+  evicts) is evicted: every supervisor relaunches its trainer at N-1 with
+  re-densified ranks, resuming from the newest fleet-committed step via
+  the PR-7 elastic re-sharding restore; the evicted host's supervisor
+  HOLDS its trainer and beats a probation ``ctl/ready`` key instead.
+  Hysteresis: a host that leaves the straggler set re-arms its streak
+  from zero, so recover-then-relapse produces two confirmed decisions.
+* **Readmission** — once the evicted host's probation heartbeat has been
+  fresh for ``PADDLE_TPU_CONTROLLER_READMIT_SEC``, the controller scales
+  the fleet back to N (the original rank assignment).
+* **Fleet-wide rollback** — one host's digest reporting
+  ``health_status == "diverged"`` (the PR-9 sentinel) escalates to a
+  COORDINATED rollback: every supervisor hard-kills its trainer (no
+  preemption save — the in-flight state is the diverged state) and
+  relaunches with ``PADDLE_TPU_RESUME_VALID_ONLY=1``, so the fleet
+  negotiates the newest fleet-committed step whose weights are finite
+  and every host restores the SAME one. This closes the carried-over
+  PR-9 gap: the health response used to be per-host only.
+* **Compile-cache prewarm** — every relaunch command carries
+  ``PADDLE_TPU_COMPILE_CACHE_DIR`` (when configured) so the new
+  generation's compiles hit the PR-8 persistent cache, and the
+  controller measures ``relaunch_to_first_step_s`` per decision from
+  the first fresh digest after actuation.
+
+Every decision — acted, failed, or ``dry_run`` — is ONE structured
+``controller_decision`` event (policy, evidence, action, outcome) in the
+unified event log, and lands in ``controller_decisions_total`` plus the
+per-action ``controller_{evictions,rollbacks,readmissions}_total``
+families. ``status()`` is served live at the ObservabilityServer's
+``/controller`` endpoint.
+
+Actuation transport is the same retry-wrapped TCPStore the runtime
+already trusts: the controller appends commands to a store-backed ledger
+(:class:`ControllerCommandBus`) that every host's
+:class:`~paddle_tpu.distributed.fleet.elastic.ElasticSupervisor` polls.
+An unreachable store or failed publish degrades to a logged
+``controller_decision{outcome="failed"}`` + warning — never an exception
+out of the supervisor.
+
+Knobs: ``PADDLE_TPU_CONTROLLER_CONFIRM_WINDOWS`` (default 3),
+``PADDLE_TPU_CONTROLLER_READMIT_SEC`` (default 30),
+``PADDLE_TPU_CONTROLLER_POLL_SEC`` (supervisor command-poll + aggregator
+poll cadence, default 1.0), ``PADDLE_TPU_CONTROLLER_MIN_WORLD``
+(default 1), ``PADDLE_TPU_CONTROLLER_ROLLBACK_COOLDOWN_SEC``
+(default 60).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import warnings
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ...profiler import events as _events_mod
+from ...profiler import metrics as _metrics_mod
+
+__all__ = ["FleetController", "ControllerCommandBus", "set_controller",
+           "get_controller", "GEN_STRIDE", "controller_from_env"]
+
+#: generation floor stride per controller command: supervisors applying
+#: command K relaunch at generation K*GEN_STRIDE, so every host lands in
+#: the SAME checkpoint-barrier namespace after a controller action even
+#: when their local failure-restart counts had drifted apart (failure
+#: restarts keep bumping by 1 within the stride)
+GEN_STRIDE = 1000
+
+CMD_SEQ_KEY = "ctl/seq"
+CMD_KEY_FMT = "ctl/cmd/{id}"
+READY_KEY_FMT = "ctl/ready/{host}"
+JOB_DONE_KEY = "ctl/job_done"
+PRESENT_KEY = "ctl/present"
+
+_REG = _metrics_mod.default_registry()
+_M_DECISIONS = _REG.counter(
+    "controller_decisions_total",
+    "fleet-controller decisions, by policy (straggler_evict / readmit / "
+    "health_rollback) and outcome (applied / dry_run / failed)")
+_M_EVICTIONS = _REG.counter(
+    "controller_evictions_total",
+    "straggler evictions the controller actually published, by host")
+_M_ROLLBACKS = _REG.counter(
+    "controller_rollbacks_total",
+    "fleet-wide rollbacks the controller actually published, by the "
+    "diverged host that triggered them")
+_M_READMISSIONS = _REG.counter(
+    "controller_readmissions_total",
+    "evicted hosts scaled back into the fleet, by host")
+_M_FIRST_STEP = _REG.gauge(
+    "controller_relaunch_to_first_step_seconds",
+    "seconds from a controller actuation to the first fresh post-relaunch "
+    "digest step, by policy of the decision that caused the relaunch")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        warnings.warn(f"{name}={raw!r} is not a number; using {default}")
+        return default
+
+
+class ControllerCommandBus:
+    """Store-backed command ledger: the controller appends, every
+    supervisor polls. One monotonic sequence (`ctl/seq`, the store's
+    atomic counter) orders commands fleet-wide; commands are immutable
+    JSON values under `ctl/cmd/<id>`.
+
+    Also carries the eviction probation channel: an evicted host's
+    supervisor beats `ctl/ready/<host>` while holding its trainer, and
+    the `ctl/job_done` flag lets held supervisors exit cleanly when the
+    fleet finishes without them."""
+
+    #: seconds a claimed-but-unwritten ledger id may stall the ordered
+    #: scan before readers give up on it: the publisher died (or its set
+    #: failed) between the atomic id claim and the value write, and its
+    #: decision was logged failed / retried under a NEW id — waiting any
+    #: longer would wedge the whole command plane on a permanent hole
+    HOLE_TIMEOUT_S = 15.0
+
+    def __init__(self, store):
+        self.store = store
+        self._hole = None  # (id, first_seen_monotonic) of the stall point
+        self._present_marked = False
+
+    # -- publishing (controller side) ---------------------------------------
+    def publish(self, cmd: dict) -> int:
+        """Append one command; returns its ledger id. The id is claimed
+        atomically BEFORE the value write, so a reader that sees seq=N
+        but no value yet simply retries that id on its next poll."""
+        if not self._present_marked:
+            # first publish arms every supervisor's ledger poll; if this
+            # set fails the command set below fails too, and the whole
+            # publish is retried (with the marking) on the next tick
+            self.mark_present()
+        cid = int(self.store.add(CMD_SEQ_KEY, 1))
+        rec = dict(cmd)
+        rec["id"] = cid
+        rec["ts"] = time.time()
+        self.store.set(CMD_KEY_FMT.format(id=cid), json.dumps(rec))
+        return cid
+
+    def last_id(self) -> int:
+        """Current ledger head (0 = nothing published)."""
+        return int(self.store.add(CMD_SEQ_KEY, 0))
+
+    # -- consuming (supervisor side) ----------------------------------------
+    def poll(self, after_id: int) -> List[dict]:
+        """Commands with id > after_id, in order. A claimed-but-unwritten
+        (or unreadable) id stops the scan — order matters: applying
+        command K+1 before K could readmit before the evict — bounded by
+        ``HOLE_TIMEOUT_S``, after which the id is abandoned as a
+        synthetic ``{"action": "skipped_hole"}`` record so consumers
+        advance their cursor past it (the publisher died between the id
+        claim and the value write; a permanent hole must not silently
+        disable every supervisor's command plane forever)."""
+        out: List[dict] = []
+        head = self.last_id()
+        for cid in range(int(after_id) + 1, head + 1):
+            key = CMD_KEY_FMT.format(id=cid)
+            rec = None
+            try:
+                if self.store.check(key):
+                    rec = json.loads(self.store.get(key).decode())
+            except Exception:
+                rec = None
+            if rec is None:
+                now = time.monotonic()
+                if self._hole is None or self._hole[0] != cid:
+                    self._hole = (cid, now)
+                    break  # give the writer time: retried next poll
+                if now - self._hole[1] < self.HOLE_TIMEOUT_S:
+                    break
+                warnings.warn(
+                    f"controller command ledger id {cid} was claimed but "
+                    f"never written (publisher died mid-publish?); "
+                    f"skipping it so later commands can apply")
+                self._hole = None
+                out.append({"action": "skipped_hole", "id": cid})
+                continue
+            if self._hole is not None and self._hole[0] == cid:
+                self._hole = None
+            out.append(rec)
+        return out
+
+    # -- probation / completion ---------------------------------------------
+    def beat_ready(self, host: str):
+        self.store.set(READY_KEY_FMT.format(host=host), repr(time.time()))
+
+    def ready_age(self, host: str) -> Optional[float]:
+        """Seconds since `host` last beat its probation key, or None.
+        NOTE: compares the beater's wall clock to the caller's — the
+        controller's readmit policy uses :meth:`ready_value` change
+        observation instead, which is skew-immune."""
+        key = READY_KEY_FMT.format(host=host)
+        try:
+            if not self.store.check(key):
+                return None
+            return max(0.0, time.time() - float(self.store.get(key).decode()))
+        except Exception:
+            return None
+
+    def ready_value(self, host: str) -> Optional[str]:
+        """Raw probation-beat value for `host`, or None. Freshness is
+        judged by the value CHANGING between the controller's own polls
+        — never by comparing the beater's wall clock to ours (cross-host
+        clock skew would silently block readmission forever, or read a
+        dead host's last beat as fresh)."""
+        key = READY_KEY_FMT.format(host=host)
+        try:
+            if not self.store.check(key):
+                return None
+            return self.store.get(key).decode()
+        except Exception:
+            return None
+
+    def mark_present(self):
+        """Arm the fleet's command plane. Supervisors probe this ONE key
+        at a relaxed cadence until it appears, and only then start the
+        per-``cmd_poll`` ledger scan — a job with no controller anywhere
+        must not pay N supervisors x 1 Hz of ledger RPCs against the
+        shared rendezvous store the checkpoint barrier also uses."""
+        self.store.set(PRESENT_KEY, "1")
+        self._present_marked = True
+
+    def present(self) -> bool:
+        """Has any controller ever attached to this job's store?"""
+        try:
+            return bool(self.store.check(PRESENT_KEY))
+        except Exception:
+            return False  # store blip: probed again next tick
+
+    def mark_job_done(self):
+        self.store.set(JOB_DONE_KEY, "1")
+
+    def reset_job_done(self):
+        """Clear a PREVIOUS job's done-flag (controller startup): in a
+        long-lived --host-store rendezvous store the stale flag would
+        make the next job's first evicted host exit instead of holding
+        for readmission. Best-effort — a missing key is fine."""
+        try:
+            self.store.delete_key(JOB_DONE_KEY)
+        except Exception:
+            pass
+
+    def job_done(self) -> bool:
+        try:
+            return bool(self.store.check(JOB_DONE_KEY))
+        except Exception:
+            return False
+
+
+class FleetController:
+    """The decision loop. Drive it with :meth:`on_collect` after each
+    :meth:`FleetAggregator.collect` (``FleetAggregator.start_polling``
+    does this on a background thread); every call observes the newest
+    digests and may publish at most one actuation.
+
+    ``dry_run=True`` computes and event-logs every decision
+    (``outcome="dry_run"``) without publishing any command — the
+    operator's rehearsal mode.
+    """
+
+    #: bounded decision history served by status()/the /controller endpoint
+    MAX_DECISIONS = 64
+
+    def __init__(self, aggregator, bus: Optional[ControllerCommandBus],
+                 world_size: int, *, dry_run: bool = False,
+                 confirm_windows: Optional[int] = None,
+                 readmit_after_s: Optional[float] = None,
+                 rollback_cooldown_s: Optional[float] = None,
+                 min_world: Optional[int] = None,
+                 prewarm_cache_dir: Optional[str] = None):
+        self.aggregator = aggregator
+        self.bus = bus
+        self.world_size = int(world_size)
+        self.dry_run = bool(dry_run)
+        if confirm_windows is None:
+            confirm_windows = int(_env_float(
+                "PADDLE_TPU_CONTROLLER_CONFIRM_WINDOWS", 3))
+        self.confirm_windows = max(int(confirm_windows), 1)
+        if readmit_after_s is None:
+            readmit_after_s = _env_float(
+                "PADDLE_TPU_CONTROLLER_READMIT_SEC", 30.0)
+        self.readmit_after_s = float(readmit_after_s)
+        if rollback_cooldown_s is None:
+            rollback_cooldown_s = _env_float(
+                "PADDLE_TPU_CONTROLLER_ROLLBACK_COOLDOWN_SEC", 60.0)
+        self.rollback_cooldown_s = float(rollback_cooldown_s)
+        if min_world is None:
+            min_world = int(_env_float("PADDLE_TPU_CONTROLLER_MIN_WORLD", 1))
+        self.min_world = max(int(min_world), 1)
+        if prewarm_cache_dir is None:
+            prewarm_cache_dir = os.environ.get(
+                "PADDLE_TPU_COMPILE_CACHE_DIR") or None
+        self.prewarm_cache_dir = prewarm_cache_dir
+
+        self._lock = threading.Lock()
+        #: serializes whole ticks so _act may release _lock around the
+        #: store publish (status()/the /controller endpoint must not
+        #: block up to the store timeout behind a slow actuation) without
+        #: a concurrent tick interleaving into the window
+        self._tick_lock = threading.Lock()
+        self._decision_seq = 0
+        self.decisions: "deque[dict]" = deque(maxlen=self.MAX_DECISIONS)
+        #: host -> consecutive straggling collect windows (the debounce)
+        self._streaks: Dict[str, int] = {}
+        #: host -> (ts, step) of the digest the last counted window saw:
+        #: a streak only advances on FRESH evidence (see _straggler_policy)
+        self._streak_obs: Dict[str, tuple] = {}
+        #: hosts already decided this excursion (hysteresis: no re-fire
+        #: until the host leaves the straggler set)
+        self._suppressed: set = set()
+        #: host -> rank assignment of the FULL fleet (learned from digests)
+        self._assignment: Dict[str, int] = {}
+        #: the one evicted host (None = fleet at full strength):
+        #: {"host", "ts", "decision"}
+        self._evicted: Optional[dict] = None
+        #: host -> (last probation-beat value, local monotonic ts when it
+        #: last CHANGED) — freshness on OUR clock, immune to cross-host
+        #: wall-clock skew
+        self._ready_obs: Dict[str, tuple] = {}
+        self._rollback_until = 0.0  # cooldown deadline
+        self._rollback_suppressed: set = set()  # hosts already rolled back
+
+    # -- observation --------------------------------------------------------
+    def on_collect(self, digests: Dict[int, dict]):
+        """One controller tick over the newest digests. Never raises —
+        a controller bug or an unreachable store must not take down the
+        supervisor's poll loop."""
+        try:
+            self._tick(digests)
+        except Exception as e:
+            warnings.warn(f"fleet controller tick failed: "
+                          f"{type(e).__name__}: {e}")
+
+    def _tick(self, digests: Dict[int, dict]):
+        with self._tick_lock, self._lock:
+            self._learn_assignment(digests)
+            self._observe_first_steps(digests)
+            self._straggler_policy()
+            self._health_policy(digests)
+            self._readmit_policy()
+
+    def _learn_assignment(self, digests: Dict[int, dict]):
+        """host -> rank map of the FULL fleet, learned from the digests
+        themselves (member ids are stable across re-ranking; an evicted
+        host keeps its original rank reserved for readmission)."""
+        for r, d in digests.items():
+            host = d.get("host")
+            if not host:
+                continue
+            if len(self._assignment) < self.world_size \
+                    and host not in self._assignment:
+                self._assignment[host] = int(d.get("rank", r))
+
+    # -- policies -----------------------------------------------------------
+    def _straggler_policy(self):
+        straggling = set(self.aggregator.straggling())
+        for host in list(self._streaks):
+            if host not in straggling:
+                # hysteresis re-arm: the host recovered (or its digest
+                # went stale out of the vote); a relapse starts a fresh
+                # streak and may produce a fresh decision
+                self._streaks.pop(host, None)
+                self._streak_obs.pop(host, None)
+                self._suppressed.discard(host)
+        for host in straggling:
+            if self._evicted and host == self._evicted["host"]:
+                continue  # its stale digest still reads slow while held
+            # the debounce counts CONSECUTIVE collect windows of
+            # evidence: the streak only advances when the host's digest
+            # actually changed since the last counted window — the
+            # aggregator re-flagging the same cached digest on every
+            # poll tick must not let one slow sample confirm an
+            # eviction in confirm_windows ticks. (The decision checks
+            # below still run on stale evidence: an already-confirmed
+            # streak blocked by e.g. a partial assignment must actuate
+            # once the blocker clears.)
+            d = self._host_digest(host) or {}
+            obs = (d.get("ts"), d.get("step"))
+            if self._streak_obs.get(host) != obs:
+                self._streak_obs[host] = obs
+                self._streaks[host] = self._streaks.get(host, 0) + 1
+            if host in self._suppressed:
+                continue
+            if self._streaks[host] < self.confirm_windows:
+                continue
+            if self._evicted is not None:
+                continue  # one eviction at a time
+            if self.current_world() - 1 < self.min_world:
+                continue  # never shrink below the floor
+            if len(self._assignment) < self.world_size:
+                # a survivor we have never seen a digest from would be
+                # missing from the relaunch rank map and come back with
+                # an out-of-range rank — no actuation until the full
+                # fleet has reported once (a host with its reporter
+                # disabled keeps the controller in observe-only mode)
+                continue
+            self._decide_evict(host)
+
+    def _decide_evict(self, host: str):
+        evidence = {"windows": self._streaks.get(host, 0),
+                    "straggling": sorted(self.aggregator.straggling()),
+                    "factor": getattr(self.aggregator, "straggler_factor",
+                                      None)}
+        d = self._host_digest(host)
+        if d:
+            evidence["p50_s"] = d.get("wall_p50_s")
+            evidence["step"] = d.get("step")
+            evidence["diag_dominant"] = d.get("diag_dominant")
+        new_np = self.current_world() - 1
+        ranks = self._dense_ranks(exclude=host)
+        cmd = {"action": "evict", "host": host, "np": new_np,
+               "ranks": ranks, "env": self._relaunch_env(extra={
+                   # the survivors may shrink to world 1, where the
+                   # reporter would normally disarm — force it on so the
+                   # controller keeps observing the N-1 fleet
+                   "PADDLE_TPU_FLEET_REPORTER": "1"})}
+        rec = self._act("straggler_evict", evidence, cmd)
+        if rec["outcome"] != "failed":
+            # a FAILED publish (store blip) is retried on the next tick;
+            # suppressing it would mean one blip and a persistent
+            # straggler is never evicted until it transiently recovers
+            self._suppressed.add(host)
+        if rec["outcome"] == "applied":
+            self._evicted = {"host": host, "ts": time.time(),
+                             "decision": rec["id"]}
+            if _metrics_mod.enabled():
+                _M_EVICTIONS.inc(host=host)
+
+    def _health_policy(self, digests: Dict[int, dict]):
+        now = time.time()
+        # STALE digests don't vote here either (mirrors the aggregator's
+        # straggler filter): a dead host's — or, with a long-lived
+        # host-store, a previous incarnation's — frozen 'diverged' digest
+        # must not hard-kill a healthy fleet
+        stale = float(getattr(self.aggregator, "stale_sec", 0.0) or 0.0)
+        bad = sorted(
+            d.get("host", f"rank-{r}") for r, d in digests.items()
+            if d.get("health_status") == "diverged"
+            and (stale <= 0 or now - d.get("ts", now) <= stale))
+        for host in list(self._rollback_suppressed):
+            if host not in bad:
+                self._rollback_suppressed.discard(host)
+        bad = [h for h in bad if h not in self._rollback_suppressed]
+        if not bad or now < self._rollback_until:
+            return
+        if len(self._assignment) < self.world_size:
+            # same guard as the straggler policy: a re-densified rank map
+            # built from a partial assignment would hand two hosts the
+            # same rank (hosts absent from the map keep their old ranks)
+            # and wedge every relaunched trainer in rendezvous
+            return
+        host = bad[0]  # first (alphabetically stable) diverged host
+        evidence = {"diverged": bad,
+                    "step": (self._host_digest(host) or {}).get("step")}
+        # a rollback during an eviction covers the N-1 fleet: the held
+        # host stays out of the rank map (its supervisor consumes the
+        # command without acting) or a survivor would land on a rank >=
+        # np and wedge every relaunch
+        held = self._evicted["host"] if self._evicted else None
+        cmd = {"action": "rollback", "host": host,
+               "np": self.current_world(),
+               "ranks": self._dense_ranks(exclude=held),
+               # every host resumes the newest fleet-committed step whose
+               # weights are FINITE — the same one, by negotiation. The
+               # valid-only knob is ONE-SHOT (env_once): it must not leak
+               # into ordinary failure restarts for the rest of the job
+               "env": self._relaunch_env(),
+               "env_once": {"PADDLE_TPU_RESUME_VALID_ONLY": "1"}}
+        rec = self._act("health_rollback", evidence, cmd)
+        if rec["outcome"] == "failed":
+            return  # not suppressed: retried on the next tick
+        # suppress while the same host keeps reporting diverged (its stale
+        # pre-relaunch digest) and for the cooldown after an actuation
+        self._rollback_suppressed.update(bad)
+        if rec["outcome"] == "applied":
+            self._rollback_until = now + self.rollback_cooldown_s
+            if _metrics_mod.enabled():
+                _M_ROLLBACKS.inc(host=host)
+
+    def _readmit_policy(self):
+        if self._evicted is None or self.bus is None:
+            return
+        if len(self._assignment) < self.world_size:
+            return  # cannot rebuild the full-N rank map yet
+        host = self._evicted["host"]
+        # observe the probation beat on EVERY tick, including during the
+        # hold window: freshness tracking must span the whole probation,
+        # or a supervisor that beat once and died mid-hold would read
+        # age=0 at the first post-window look and a dead host would be
+        # readmitted into the rank map (trainers then wedge in rendezvous
+        # on the missing rank with no policy able to recover)
+        now_local = time.monotonic()
+        # the probation read is a store RPC (up to the client timeout):
+        # run it OUTSIDE the status lock like _act's publish, so
+        # status()/the /controller endpoint never stalls behind a slow
+        # store — _tick_lock keeps a concurrent tick out of the window
+        self._lock.release()
+        try:
+            val = self.bus.ready_value(host)
+        finally:
+            self._lock.acquire()
+        if val is not None:
+            prev = self._ready_obs.get(host)
+            if prev is None or prev[0] != val:
+                self._ready_obs[host] = (val, now_local)
+        held_for = time.time() - self._evicted["ts"]
+        if held_for < self.readmit_after_s:
+            return
+        # the probation heartbeat must be FRESH: freshness = the beat
+        # VALUE changed recently as observed on OUR clock — comparing the
+        # beater's embedded wall-clock timestamp to ours would let modest
+        # cross-host skew block readmission forever (or read a dead
+        # host's last beat as fresh)
+        obs = self._ready_obs.get(host)
+        if obs is None:
+            return
+        age = now_local - obs[1]
+        if age > 3 * self._poll_interval() + 5.0:
+            return
+        evidence = {"held_s": round(held_for, 3),
+                    "ready_age_s": round(age, 3),
+                    "evict_decision": self._evicted["decision"]}
+        cmd = {"action": "readmit", "host": host, "np": self.world_size,
+               "ranks": dict(self._assignment),
+               "env": self._relaunch_env(extra={
+                   "PADDLE_TPU_FLEET_REPORTER": "1"})}
+        rec = self._act("straggler_readmit", evidence, cmd)
+        if rec["outcome"] == "applied":
+            self._evicted = None
+            self._ready_obs.pop(host, None)
+            if _metrics_mod.enabled():
+                _M_READMISSIONS.inc(host=host)
+
+    # -- decision plumbing --------------------------------------------------
+    def _act(self, policy: str, evidence: dict, cmd: dict) -> dict:
+        """Record + event-log + (unless dry-run) publish one decision.
+        Publish failures degrade to outcome="failed" with a warning."""
+        self._decision_seq += 1
+        rec = {"id": self._decision_seq, "ts": time.time(),
+               "policy": policy, "evidence": evidence,
+               "action": {k: v for k, v in cmd.items()
+                          if k not in ("env", "env_once")},
+               "outcome": "dry_run", "cmd_id": None,
+               "relaunch_to_first_step_s": None}
+        if not self.dry_run:
+            if self.bus is None:
+                rec["outcome"] = "failed"
+                rec["error"] = "no command bus attached"
+            else:
+                # the publish is a store RPC (up to the client timeout):
+                # run it OUTSIDE the status lock so /controller and
+                # status() readers never stall behind a slow store —
+                # _tick_lock keeps a concurrent tick out of the window
+                self._lock.release()
+                try:
+                    rec["cmd_id"] = self.bus.publish(cmd)
+                    rec["outcome"] = "applied"
+                except Exception as e:
+                    rec["outcome"] = "failed"
+                    rec["error"] = f"{type(e).__name__}: {e}"
+                    warnings.warn(
+                        f"fleet controller could not publish "
+                        f"{cmd.get('action')} ({rec['error']}); decision "
+                        f"logged as failed")
+                finally:
+                    self._lock.acquire()
+        self.decisions.append(rec)
+        if _metrics_mod.enabled():
+            _M_DECISIONS.inc(policy=policy, outcome=rec["outcome"])
+        _events_mod.emit(
+            "controller_decision",
+            severity="warn" if rec["outcome"] != "failed" else "error",
+            policy=policy, action=cmd.get("action"),
+            target=cmd.get("host"), outcome=rec["outcome"],
+            decision=rec["id"], np=cmd.get("np"),
+            evidence=_json_safe(evidence),
+            dry_run=self.dry_run)
+        return rec
+
+    def _observe_first_steps(self, digests: Dict[int, dict]):
+        """Close the loop on applied decisions: the first digest whose
+        publish timestamp is newer than the actuation is the relaunched
+        fleet's first observed step — report relaunch_to_first_step_s
+        per decision (the relaunch-cost number the compile-cache prewarm
+        exists to shrink)."""
+        pending = [r for r in self.decisions
+                   if r["outcome"] == "applied"
+                   and r["relaunch_to_first_step_s"] is None]
+        if not pending:
+            return
+        for rec in pending:
+            # a digest is post-relaunch only when its GENERATION reached
+            # the command's floor (cmd_id * GEN_STRIDE, what the applying
+            # supervisors relaunch at) — a timestamp alone cannot tell
+            # the new fleet's first step from a pre-relaunch straggler
+            # that published during command-poll + SIGTERM-drain latency.
+            # Digests without a gen field (older reporters) fall back to
+            # a one-poll-interval timestamp floor. The reported duration
+            # is decision -> first OBSERVATION, measured entirely on the
+            # controller's clock (remote digest timestamps carry the
+            # reporter's wall-clock skew; over-reports by at most one
+            # digest-publish + one poll interval).
+            gen_floor = (rec.get("cmd_id") or 0) * GEN_STRIDE
+            ts_floor = rec["ts"] + self._poll_interval()
+            hit = False
+            for d in digests.values():
+                if "gen" in d:
+                    if int(d.get("gen") or 0) >= gen_floor:
+                        hit = True
+                        break
+                else:
+                    ts = d.get("ts")
+                    if ts is not None and ts > ts_floor:
+                        hit = True
+                        break
+            if not hit:
+                continue
+            dt = round(max(0.0, time.time() - rec["ts"]), 3)
+            rec["relaunch_to_first_step_s"] = dt
+            if _metrics_mod.enabled():
+                _M_FIRST_STEP.set(dt, policy=rec["policy"])
+            _events_mod.emit(
+                "controller_decision", severity="info",
+                policy=rec["policy"], action="relaunch_observed",
+                outcome=rec["outcome"], decision=rec["id"],
+                relaunch_to_first_step_s=dt, dry_run=self.dry_run)
+
+    # -- helpers ------------------------------------------------------------
+    def current_world(self) -> int:
+        return self.world_size - (1 if self._evicted else 0)
+
+    def _poll_interval(self) -> float:
+        return _env_float("PADDLE_TPU_CONTROLLER_POLL_SEC", 1.0)
+
+    def _host_digest(self, host: str) -> Optional[dict]:
+        for d in getattr(self.aggregator, "last", {}).values():
+            if d.get("host") == host:
+                return d
+        return None
+
+    def _dense_ranks(self, exclude: Optional[str] = None) -> Dict[str, int]:
+        """New rank assignment: surviving hosts ordered by their ORIGINAL
+        rank, re-densified to 0..n-1 (the deterministic rule every
+        supervisor can verify against its own member id)."""
+        survivors = sorted(
+            (r, h) for h, r in self._assignment.items() if h != exclude)
+        return {h: i for i, (_r, h) in enumerate(survivors)}
+
+    def _relaunch_env(self, extra: Optional[dict] = None) -> Dict[str, str]:
+        env: Dict[str, str] = {}
+        if self.prewarm_cache_dir:
+            # prewarm: the relaunched generation compiles against the
+            # persistent cache, so relaunch_to_first_step stays cheap
+            env["PADDLE_TPU_COMPILE_CACHE_DIR"] = self.prewarm_cache_dir
+        env.update(extra or {})
+        return env
+
+    def status(self) -> dict:
+        """The /controller endpoint payload."""
+        with self._lock:
+            return _json_safe({
+                "dry_run": self.dry_run,
+                "world_size": self.world_size,
+                "current_world": self.current_world(),
+                "confirm_windows": self.confirm_windows,
+                "readmit_after_s": self.readmit_after_s,
+                "min_world": self.min_world,
+                "prewarm_cache_dir": self.prewarm_cache_dir,
+                "streaks": dict(self._streaks),
+                "evicted": dict(self._evicted) if self._evicted else None,
+                "assignment": dict(self._assignment),
+                "decisions": [dict(r) for r in self.decisions],
+            })
+
+
+def _json_safe(obj):
+    """Evidence/status must serialize: anything exotic degrades to str."""
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {str(k): _json_safe(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple, set)):
+            return [_json_safe(v) for v in obj]
+        return str(obj)
+
+
+# -- process-wide registration (the /controller endpoint reads this) --------
+_controller: Optional[FleetController] = None
+
+
+def set_controller(controller: Optional[FleetController]):
+    global _controller
+    _controller = controller
+
+
+def get_controller() -> Optional[FleetController]:
+    return _controller
+
+
+def controller_from_env(aggregator, store, *,
+                        world_size: int,
+                        dry_run: bool = False) -> FleetController:
+    """Build the controller + bus for a supervisor that already holds an
+    aggregator and a dedicated store connection (tools/elastic_run.py),
+    register it for the /controller endpoint, and return it."""
+    bus = ControllerCommandBus(store)
+    # exactly one controller runs per job: clearing a previous job's
+    # done-flag here cannot race a live fleet, only a finished one
+    bus.reset_job_done()
+    try:
+        # arm every supervisor's ledger poll up front so the FIRST
+        # decision doesn't wait out the relaxed presence-probe cadence
+        bus.mark_present()
+    except Exception:
+        pass  # re-tried by the first publish
+    ctl = FleetController(aggregator, bus, world_size, dry_run=dry_run)
+    set_controller(ctl)
+    return ctl

@@ -298,28 +298,58 @@ class ElasticSupervisor:
       re-enters the same generation and the checkpoint barrier namespaces
       line up.
 
-    Each generation sees `PADDLE_TPU_ELASTIC_RESTART_NUM` = number of
-    restarts so far (env for subprocesses, os.environ for in-process).
-    Generations are LOCAL counters kept in lockstep by the trainer
-    contract, not shared state: a trainer whose coordinated save aborts
-    must exit `ELASTIC_EXIT_CODE` so every host's supervisor bumps
-    together. A host whose crash+relaunch slips under the heartbeat TTL
-    runs one generation ahead until its peers' next coordinated save
-    times out and aborts (bounded by the barrier timeout), at which point
-    they exit 101 and catch up — a transient stall of at most one aborted
-    save, not a wedge.
+    Each generation sees `PADDLE_TPU_ELASTIC_RESTART_NUM` (env for
+    subprocesses, os.environ for in-process) from a LOCAL generation
+    counter kept in lockstep by the trainer contract, not shared state: a
+    trainer whose coordinated save aborts must exit `ELASTIC_EXIT_CODE`
+    so every host's supervisor bumps together. A host whose
+    crash+relaunch slips under the heartbeat TTL runs one generation
+    ahead until its peers' next coordinated save times out and aborts
+    (bounded by the barrier timeout), at which point they exit 101 and
+    catch up — a transient stall of at most one aborted save, not a
+    wedge. A fleet-controller command (see below) re-anchors every
+    host's generation to the command id times
+    `controller.GEN_STRIDE`, so controller-driven relaunches land in one
+    barrier namespace even when local failure counts had drifted.
+
+    With `commands` (a `controller.ControllerCommandBus`), the
+    supervisor also polls the fleet controller's command ledger and
+    ACTS: `evict` naming this host's trainer stops the child and HOLDS
+    (beating a probation `ctl/ready` key until `readmit` or job end);
+    `evict` naming a peer / `readmit` / `rollback` stop the child and
+    relaunch it under the command's np / rank / env contract (rollback
+    kills hard — the in-flight state is the diverged state a preemption
+    save must not capture). Controller relaunches are metered
+    (`elastic_restarts_total{reason=controller_*}`) but never consume
+    the restart budget — they are decisions, not failures.
+
     Knobs: `PADDLE_TPU_ELASTIC_MAX_RESTARTS` (default 3),
     `PADDLE_TPU_ELASTIC_BACKOFF` (base seconds, default 1.0, doubled per
-    restart), `PADDLE_TPU_ELASTIC_BACKOFF_MAX` (default 30). Every
-    relaunch lands in `elastic_restarts_total{reason=}`.
+    restart), `PADDLE_TPU_ELASTIC_BACKOFF_MAX` (default 30),
+    `PADDLE_TPU_ELASTIC_BUDGET_RESET_SEC` (default 300; a child that ran
+    healthily at least this long resets the consecutive-restart counter,
+    so a flapping-then-fixed host doesn't wedge the fleet on a stale
+    exhausted budget; 0 disables), `PADDLE_TPU_CONTROLLER_POLL_SEC`
+    (command-ledger poll cadence, default 1.0). Every relaunch lands in
+    `elastic_restarts_total{reason=}`.
     """
+
+    #: a child that fails within this window of its launch is treated as
+    #: never having gotten past resume: its one-shot env overlay
+    #: (env_once, e.g. the rollback's PADDLE_TPU_RESUME_VALID_ONLY) is
+    #: re-armed for the retry instead of being consumed by the failure
+    ENV_ONCE_RETRY_S = 120.0
 
     def __init__(self, max_restarts: Optional[int] = None,
                  backoff: Optional[float] = None,
                  backoff_max: Optional[float] = None,
                  manager: Optional[ElasticManager] = None,
                  poll: float = 0.2, stop_grace: float = 10.0,
-                 self_member: Optional[str] = None):
+                 self_member: Optional[str] = None,
+                 commands=None,
+                 on_fleet_change=None,
+                 budget_reset_s: Optional[float] = None,
+                 cmd_poll: Optional[float] = None):
         if max_restarts is None:
             max_restarts = int(os.environ.get(
                 "PADDLE_TPU_ELASTIC_MAX_RESTARTS", 3))
@@ -344,8 +374,44 @@ class ElasticSupervisor:
         # supervisor SIGTERMs its freshly relaunched trainer: generations
         # desync and every later barrier round times out fleet-wide.
         self.self_member = self_member
+        if budget_reset_s is None:
+            budget_reset_s = float(os.environ.get(
+                "PADDLE_TPU_ELASTIC_BUDGET_RESET_SEC", 300.0))
+        self.budget_reset_s = float(budget_reset_s)
+        if cmd_poll is None:
+            cmd_poll = float(os.environ.get(
+                "PADDLE_TPU_CONTROLLER_POLL_SEC", 1.0))
+        self.cmd_poll = max(float(cmd_poll), 0.05)
+        if commands is not None and self_member is None:
+            warnings.warn(
+                "elastic supervisor: a controller command bus needs "
+                "self_member (the trainer's stable member id) to apply "
+                "rank assignments; ignoring the bus")
+            commands = None
+        self.commands = commands
+        self.on_fleet_change = on_fleet_change
+        #: latched once the presence key is seen: from then on the ledger
+        #: is scanned every cmd_poll (see _wait_child's presence gate)
+        self._ctl_present = False
+        #: the pre-existing RESTART_NUM base supervise() honors; controller
+        #: generation floors are taken net of it (see _apply_command)
+        self._gen_base = 0
         self.restarts = 0
+        #: the RESTART_NUM the next child sees (minus the env base).
+        #: Bumped by 1 per failure relaunch like `restarts`, but never
+        #: reset by the healthy-window budget reset (generations must
+        #: stay monotonic) and re-anchored by controller commands.
+        self.generation = 0
         self.last_reason: Optional[str] = None
+        self._cmd_cursor: Optional[int] = None
+        self._pending_cmd: Optional[dict] = None
+        #: controller-command env overlay (np / rank / prewarm changes
+        #: accumulated from applied commands; persists across relaunches)
+        self._cmd_env: Dict[str, str] = {}
+        #: one-shot overlay (a command's env_once — e.g. the rollback's
+        #: PADDLE_TPU_RESUME_VALID_ONLY): applied to the NEXT launch only,
+        #: so resume-mode flags never leak into ordinary failure restarts
+        self._cmd_env_once: Dict[str, str] = {}
 
     # -- shared restart accounting ------------------------------------------
     def _consume_restart(self, reason: str) -> bool:
@@ -367,6 +433,22 @@ class ElasticSupervisor:
         time.sleep(min(self.backoff * (2 ** max(0, self.restarts - 1)),
                        self.backoff_max))
 
+    def _maybe_reset_budget(self, healthy_s: float):
+        """A trainer that ran healthily for a sustained window earned its
+        budget back: the next failure is a NEW incident, not the tail of
+        the old flap — without this, a host that flapped up to the budget
+        and then ran clean for hours is one hiccup away from a permanent
+        wedge on a stale exhausted counter. Generations never reset."""
+        if self.budget_reset_s <= 0 or self.restarts <= 0:
+            return
+        if healthy_s < self.budget_reset_s:
+            return
+        _events_mod.emit("elastic_budget_reset", severity="info",
+                         healthy_s=round(healthy_s, 3),
+                         restarts_forgiven=self.restarts,
+                         budget=self.max_restarts)
+        self.restarts = 0
+
     def _publish_done(self):
         """The local trainer finished cleanly but its heartbeats now stop:
         publish its done-flag so every PEER's membership watch reads the
@@ -387,7 +469,8 @@ class ElasticSupervisor:
         completion."""
         base = int(os.environ.get(RESTART_NUM_ENV, "0"))
         while True:
-            os.environ[RESTART_NUM_ENV] = str(base + self.restarts)
+            os.environ[RESTART_NUM_ENV] = str(base + self.generation)
+            started = time.monotonic()
             err: BaseException
             try:
                 result = train_fn()
@@ -405,9 +488,11 @@ class ElasticSupervisor:
                 err = e
             except Exception as e:
                 reason, err = "failure", e
+            self._maybe_reset_budget(time.monotonic() - started)
             if not self._consume_restart(reason):
                 raise RestartBudgetExceeded(self.restarts - 1,
                                             self.max_restarts, reason) from err
+            self.generation += 1
             self._backoff_sleep()
 
     # -- subprocess mode -----------------------------------------------------
@@ -423,18 +508,59 @@ class ElasticSupervisor:
         # starting over at 0 would namespace the checkpoint barrier under
         # stale keys and every coordinated save would time out fleet-wide
         base = int(os.environ.get(RESTART_NUM_ENV, "0"))
+        self._gen_base = base
+        if self.commands is not None and self._cmd_cursor is None:
+            # commands published before this supervisor existed belong to
+            # a previous incarnation of the job, never to this one. On a
+            # store blip the cursor stays None and _next_command retries
+            # the anchor — falling back to 0 would REPLAY the previous
+            # incarnation's ledger (a stale rollback hard-killing a
+            # healthy fresh trainer) out of a long-lived host-store
+            self._anchor_cmd_cursor()
         while True:
             child_env = dict(os.environ)
             child_env.update(env or {})
-            child_env[RESTART_NUM_ENV] = str(base + self.restarts)
+            child_env.update(self._cmd_env)
+            once, self._cmd_env_once = self._cmd_env_once, {}
+            child_env.update(once)
+            child_env[RESTART_NUM_ENV] = str(base + self.generation)
+            started = time.monotonic()
             proc = subprocess.Popen(list(cmd), env=child_env)
             reason, code = self._wait_child(proc)
             if reason is None:
                 self._publish_done()
                 return 0
+            if once and time.monotonic() - started < self.ENV_ONCE_RETRY_S:
+                # a child that died within the startup window never got
+                # past its resume: retry with the SAME one-shot contract.
+                # Concretely: the rollback's valid-only resume RAISES on
+                # a nonfinite fleet-agreed step so the fleet renegotiates
+                # — that renegotiation must also run valid-only, or the
+                # relaunch silently restores exactly the diverged state
+                # the rollback existed to skip. A crash hours later ran
+                # healthily past resume and does NOT re-arm (the one-shot
+                # flag must not leak into routine restarts).
+                merged = dict(once)
+                merged.update(self._cmd_env_once)  # newer commands win
+                self._cmd_env_once = merged
+            # a long-healthy child earns its budget back no matter WHY it
+            # stopped — including a controller command: the reshape right
+            # after is the likeliest moment for a rendezvous hiccup, and
+            # a stale exhausted counter would turn it into a permanent
+            # wedge on the relaunched fleet
+            self._maybe_reset_budget(time.monotonic() - started)
+            if reason == "controller":
+                cmd_rec, self._pending_cmd = self._pending_cmd, None
+                if self._apply_command(cmd_rec) == "hold":
+                    readmit = self._hold_for_readmit()
+                    if readmit is None:
+                        return 0  # job finished without this host
+                    self._apply_command(readmit)
+                continue  # controller relaunch: no budget consumed
             last_code = code
             if not self._consume_restart(reason):
                 return last_code if last_code else 1
+            self.generation += 1
             self._backoff_sleep()
 
     def _wait_child(self, proc):
@@ -444,6 +570,7 @@ class ElasticSupervisor:
         local restart (SIGTERM the child, return 'membership')."""
         seen_full = False
         next_membership = 0.0
+        next_cmd = 0.0
         while True:
             code = proc.poll()
             if code is not None:
@@ -452,6 +579,31 @@ class ElasticSupervisor:
                 if code == ELASTIC_EXIT_CODE:
                     return "restart_requested", code
                 return "failure", code
+            if self.commands is not None and time.monotonic() >= next_cmd:
+                if not self._ctl_present \
+                        and not self.commands.present():
+                    # no controller has ever attached to this job: probe
+                    # the ONE presence key at a relaxed cadence instead
+                    # of scanning the ledger — N supervisors x 1 Hz of
+                    # ledger RPCs would tax the rendezvous store the
+                    # checkpoint barrier and membership watch share, for
+                    # a command plane nobody is driving
+                    next_cmd = time.monotonic() + 5 * self.cmd_poll
+                else:
+                    self._ctl_present = True
+                    next_cmd = time.monotonic() + self.cmd_poll
+                    cmd = self._next_command()
+                    if cmd is not None:
+                        # rollback discards the in-flight (diverged)
+                        # state: a graceful SIGTERM would let the
+                        # preemption handler checkpoint exactly what the
+                        # rollback exists to throw away. Evict/readmit
+                        # stop gracefully so the fleet can barrier one
+                        # final coordinated save first.
+                        self._pending_cmd = cmd
+                        self._stop_child(
+                            proc, hard=(cmd.get("action") == "rollback"))
+                        return "controller", ELASTIC_EXIT_CODE
             if self.manager is not None \
                     and time.monotonic() >= next_membership:
                 # a membership check costs O(world_size) store RPCs: run
@@ -484,8 +636,12 @@ class ElasticSupervisor:
         except Exception:
             return None  # store hiccup: never restart on a read failure
 
-    def _stop_child(self, proc):
+    def _stop_child(self, proc, hard: bool = False):
         try:
+            if hard:
+                proc.kill()
+                proc.wait()
+                return
             proc.send_signal(signal.SIGTERM)
         except OSError:
             return
@@ -495,6 +651,134 @@ class ElasticSupervisor:
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+    # -- fleet-controller command application --------------------------------
+    def _anchor_cmd_cursor(self):
+        """Anchor the ledger cursor at the CURRENT head so only commands
+        published after this supervisor started are ever applied."""
+        try:
+            self._cmd_cursor = self.commands.last_id()
+        except Exception:
+            pass  # retried from _next_command; never default to 0
+
+    def _next_command(self) -> Optional[dict]:
+        """Oldest unconsumed actionable ledger command, or None. Never
+        raises (a store hiccup is retried on the next poll tick)."""
+        if self._cmd_cursor is None:
+            self._anchor_cmd_cursor()
+            return None  # anchored just now (or still unreachable)
+        try:
+            for cmd in self.commands.poll(self._cmd_cursor or 0):
+                if cmd.get("action") in ("evict", "readmit", "rollback"):
+                    return cmd
+                # unknown actions from a newer controller: consume + skip
+                self._cmd_cursor = max(self._cmd_cursor or 0,
+                                       int(cmd.get("id", 0)))
+        except Exception:
+            pass
+        return None
+
+    def _apply_command(self, cmd: dict) -> str:
+        """Fold one controller command into the relaunch contract.
+        Returns "hold" when the command evicts THIS host's trainer, else
+        "relaunch". Metered + event-logged, never budget-consuming."""
+        self._cmd_cursor = max(self._cmd_cursor or 0, int(cmd.get("id", 0)))
+        action = cmd.get("action", "?")
+        reason = f"controller_{action}"
+        self.last_reason = reason
+        # generation floor: every supervisor applying command K relaunches
+        # into the SAME checkpoint-barrier namespace, even when their
+        # local failure-restart counts had drifted apart. The child sees
+        # base + generation (supervise() honors a pre-existing
+        # RESTART_NUM base), so the floor is taken net of OUR base — a
+        # supervisor relaunched with base N must land on K*GEN_STRIDE
+        # like its base-0 peers, not N + K*GEN_STRIDE
+        try:
+            from .controller import GEN_STRIDE
+            self.generation = max(
+                self.generation + 1,
+                int(cmd.get("id", 0)) * GEN_STRIDE - self._gen_base)
+        except Exception:
+            self.generation += 1
+        if _metrics_mod.enabled():
+            _M_RESTARTS.inc(reason=reason)
+        _events_mod.emit("elastic_restart", severity="warn", reason=reason,
+                         command=int(cmd.get("id", 0)),
+                         target=cmd.get("host"), np=cmd.get("np"),
+                         generation=self.generation)
+        held = action == "evict" and cmd.get("host") == self.self_member
+        if not held:
+            overlay = {}
+            if cmd.get("np") is not None:
+                overlay["PADDLE_TRAINERS_NUM"] = str(int(cmd["np"]))
+            ranks = cmd.get("ranks") or {}
+            if self.self_member in ranks:
+                overlay["PADDLE_TRAINER_ID"] = str(int(
+                    ranks[self.self_member]))
+            overlay.update({str(k): str(v)
+                            for k, v in (cmd.get("env") or {}).items()})
+            self._cmd_env.update(overlay)
+            self._cmd_env_once.update(
+                {str(k): str(v)
+                 for k, v in (cmd.get("env_once") or {}).items()})
+        if self.on_fleet_change is not None:
+            try:
+                self.on_fleet_change(cmd, held)
+            except Exception as e:
+                warnings.warn(f"elastic supervisor: fleet-change hook "
+                              f"failed ({type(e).__name__}: {e})")
+        warnings.warn(
+            f"elastic supervisor: applying controller command "
+            f"#{cmd.get('id')} {action} (np={cmd.get('np')}, "
+            f"{'holding local trainer' if held else 'relaunching'})")
+        return "hold" if held else "relaunch"
+
+    def _hold_for_readmit(self) -> Optional[dict]:
+        """Evicted-host probation: the trainer stays down while this
+        supervisor beats `ctl/ready/<member>` so the controller knows the
+        host is alive and readmittable. Returns the readmit command, or
+        None when the fleet finished without us (`ctl/job_done`) or the
+        hold outlived `PADDLE_TPU_CONTROLLER_HOLD_MAX_SEC` (3600) —
+        readmit and job_done are both published by the controller host,
+        so if that host dies hard this supervisor would otherwise beat
+        probation forever with no escape."""
+        try:
+            max_hold = float(os.environ.get(
+                "PADDLE_TPU_CONTROLLER_HOLD_MAX_SEC", "3600"))
+        except ValueError:
+            max_hold = 3600.0
+        deadline = time.monotonic() + max_hold if max_hold > 0 else None
+        while True:
+            if deadline is not None and time.monotonic() >= deadline:
+                warnings.warn(
+                    "elastic supervisor: probation hold outlived "
+                    f"PADDLE_TPU_CONTROLLER_HOLD_MAX_SEC ({max_hold}s) "
+                    "with no readmit or job-done flag — the controller "
+                    "host is likely dead; exiting the hold")
+                _events_mod.emit(
+                    "elastic_restart", severity="error",
+                    reason="controller_hold_expired",
+                    member=self.self_member, max_hold_s=max_hold)
+                return None
+            try:
+                self.commands.beat_ready(self.self_member)
+            except Exception:
+                pass  # store blip: keep holding, beat next tick
+            if self.commands.job_done():
+                return None
+            try:
+                for cmd in self.commands.poll(self._cmd_cursor or 0):
+                    self._cmd_cursor = max(self._cmd_cursor or 0,
+                                           int(cmd.get("id", 0)))
+                    if cmd.get("action") == "readmit" \
+                            and cmd.get("host") == self.self_member:
+                        return cmd
+                    # anything else (a rollback of the N-1 fleet, an
+                    # unknown action) does not involve the held trainer:
+                    # consume and keep holding
+            except Exception:
+                pass
+            time.sleep(self.cmd_poll)
 
 
 def run_elastic(target, *, max_restarts: Optional[int] = None,

@@ -185,6 +185,10 @@ class FleetReporter:
             "host": self.host,
             "step": int(step),
             "ts": time.time(),
+            # elastic generation of this incarnation: the controller uses
+            # it to tell a post-relaunch digest from a pre-relaunch
+            # straggler that published just after a decision fired
+            "gen": self._generation(),
             "wall_p50_s": p50,
             "last_wall_s": self.walls[-1] if self.walls else None,
             "window": len(self.walls),
@@ -204,6 +208,13 @@ class FleetReporter:
                 "step_wall_s": round(_hist_sum("heter_step_wall_seconds"), 6),
             },
         }
+
+    @staticmethod
+    def _generation() -> int:
+        try:
+            return int(os.environ.get("PADDLE_TPU_ELASTIC_RESTART_NUM", "0"))
+        except ValueError:
+            return 0
 
     @staticmethod
     def _health_status():
@@ -228,17 +239,25 @@ class FleetAggregator:
     MIN_WINDOW = 3  # digests with fewer walls don't vote (startup noise)
 
     def __init__(self, store, world_size: int,
-                 straggler_factor: Optional[float] = None):
+                 straggler_factor: Optional[float] = None,
+                 stale_sec: Optional[float] = None):
         self.store = store
         self.world_size = int(world_size)
         if straggler_factor is None:
             straggler_factor = float(
                 os.environ.get("PADDLE_TPU_STRAGGLER_FACTOR", "2.0"))
         self.straggler_factor = float(straggler_factor)
+        if stale_sec is None:
+            stale_sec = float(
+                os.environ.get("PADDLE_TPU_DIGEST_STALE_SEC", "120"))
+        self.stale_sec = float(stale_sec)
         self._lock = threading.Lock()
         self._straggling: set = set()
         self._unhealthy: Dict[str, str] = {}  # host -> last non-ok status
         self.last: Dict[int, dict] = {}
+        self._poll_thread: Optional[threading.Thread] = None
+        self._poll_stop = threading.Event()
+        self._poll_hook = None
 
     def collect(self) -> Dict[int, dict]:
         """Read every rank's digest, mirror into the registry, run the
@@ -301,10 +320,23 @@ class FleetAggregator:
         small fleet a straggler inflates a plain fleet median enough to
         hide itself (2 hosts at 10ms/100ms have median 55ms — the slow one
         would pass a 2x check against it)."""
+        now = time.time()
         voting = {d.get("host", f"rank-{r}"): d["wall_p50_s"]
                   for r, d in digests.items()
                   if d.get("wall_p50_s") is not None
-                  and d.get("window", 0) >= self.MIN_WINDOW}
+                  and d.get("window", 0) >= self.MIN_WINDOW
+                  # a STALE digest no longer describes the host: an
+                  # evicted/dead host's frozen slow p50 must not keep
+                  # skewing the leave-one-out baseline of the live fleet
+                  and (self.stale_sec <= 0
+                       or now - d.get("ts", now) <= self.stale_sec)}
+        for host in list(self._straggling):
+            if host not in voting:
+                # a host that stopped voting (stale/absent digest) must
+                # LEAVE the straggler set: its frozen verdict is no longer
+                # evidence, and the controller's eviction debounce counts
+                # membership here as consecutive straggling windows
+                self._straggling.discard(host)
         if len(voting) < 2:
             return  # a fleet of one has no straggler semantics
         for host, p50 in voting.items():
@@ -324,6 +356,77 @@ class FleetAggregator:
                         factor=self.straggler_factor)
             else:
                 self._straggling.discard(host)
+
+    # -- background polling ---------------------------------------------------
+    def start_polling(self, interval: Optional[float] = None,
+                      hook=None) -> bool:
+        """Run collect() on a background daemon thread so digest
+        mirroring, straggler detection and health transitions no longer
+        depend on an external /metrics scraper.
+
+        `interval`: seconds between collects; default
+        `PADDLE_TPU_FLEET_POLL_SEC` — and when that is unset/0 the loop
+        stays OFF unless a `hook` is given (a fleet CONTROLLER is
+        attached), in which case it defaults to
+        `PADDLE_TPU_CONTROLLER_POLL_SEC` (1.0s). `hook(digests)` runs
+        after every collect; hook exceptions are swallowed with a
+        warning (telemetry must not die of a consumer bug). Returns
+        True when the loop started."""
+        if interval is None:
+            raw = os.environ.get("PADDLE_TPU_FLEET_POLL_SEC", "")
+            try:
+                interval = float(raw) if raw else 0.0
+            except ValueError:
+                interval = 0.0
+            if interval <= 0 and hook is not None:
+                try:
+                    interval = float(os.environ.get(
+                        "PADDLE_TPU_CONTROLLER_POLL_SEC", "1.0"))
+                except ValueError:
+                    interval = 1.0
+        if interval is None or interval <= 0:
+            return False
+        if self._poll_thread is not None and self._poll_thread.is_alive():
+            if hook is None or hook is self._poll_hook:
+                return True  # already polling with this consumer
+            # a controller attaching AFTER a hookless metrics-server poll
+            # started (elastic_run starts the server first) must not be
+            # silently dropped — re-arm the loop with the new hook
+            self.stop_polling()
+        self._poll_hook = hook
+        # each loop closes over its OWN stop event: a predecessor thread
+        # that outlived stop_polling's bounded join (blocked in a store
+        # RPC longer than the join timeout) keeps seeing ITS set event
+        # and exits — a shared cleared event would resurrect it alongside
+        # the new loop
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval):
+                try:
+                    digests = self.collect()
+                except Exception:
+                    continue  # store hiccup: try again next tick
+                if hook is not None:
+                    try:
+                        hook(digests)
+                    except Exception as e:
+                        import warnings
+                        warnings.warn(f"fleet poll hook failed: "
+                                      f"{type(e).__name__}: {e}")
+
+        self._poll_stop = stop
+        self._poll_thread = threading.Thread(
+            target=loop, daemon=True, name="fleet-aggregator-poll")
+        self._poll_thread.start()
+        return True
+
+    def stop_polling(self):
+        self._poll_stop.set()
+        t = self._poll_thread
+        if t is not None:
+            t.join(timeout=5)
+        self._poll_thread = None
 
     def straggling(self) -> List[str]:
         with self._lock:
@@ -353,13 +456,21 @@ def _store_from_env(timeout: int = 10):
 
 def reporter_from_env() -> Optional[FleetReporter]:
     """A FleetReporter from the trainer env contract (own store
-    connection), or None for single-host jobs / no master reachable."""
+    connection), or None for single-host jobs / no master reachable.
+
+    `PADDLE_TPU_FLEET_REPORTER` overrides the world-size gate: "0"
+    disables reporting outright; "1" forces it even at world size 1 —
+    the fleet controller sets this on N-1 relaunches so it keeps
+    observing a fleet it shrank to a single host."""
+    force = os.environ.get("PADDLE_TPU_FLEET_REPORTER", "").strip().lower()
+    if force in ("0", "false", "off", "no"):
+        return None
     try:
         world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     except ValueError:
         return None
-    if world < 2:
+    if world < 2 and force not in ("1", "true", "on", "yes", "force"):
         return None
     store = _store_from_env()
     if store is None:

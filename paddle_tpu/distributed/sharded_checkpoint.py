@@ -825,6 +825,9 @@ class ShardedCheckpointManager(CheckpointManager):
                     f"rank, and a silent rank-0 fallback would collide "
                     f"every host's chunks in a shared directory")
         self._attempt = 0
+        #: (step, state) loaded by a valid-only _local_restorable_step
+        #: walk, reused when the fleet agrees on exactly that step
+        self._resume_cache = None
         self._sweep_orphans()
 
     # -- save ----------------------------------------------------------------
@@ -946,16 +949,39 @@ class ShardedCheckpointManager(CheckpointManager):
     def _local_restorable_step(self) -> Optional[int]:
         """Newest step restore could use — decided from MANIFESTS (cheap
         existence/byte-size scan), never by reading array payloads. This
-        is what the fleet negotiates over at resume."""
+        is what the fleet negotiates over at resume.
+
+        Under valid-only resume (PADDLE_TPU_RESUME_VALID_ONLY, the
+        fleet-rollback relaunch mode) each candidate IS loaded and its
+        weights checked finite — payload reads are the price of
+        negotiating over numerically-valid steps, paid only on the rare
+        rollback path; the loaded state is cached so the agreed-step
+        restore does not read it twice."""
+        self._resume_cache = None
+        valid_only = _ck.resume_valid_only()
         for step, path in _step_dirs(self.dirname, self.prefix):
             status, _ = verify_step(path)
-            if status in ("complete", "partial"):
-                return step
+            if status not in ("complete", "partial"):
+                continue
+            if valid_only:
+                try:
+                    state = load_step(path, mesh=self.mesh)
+                except (OSError, CheckpointCorruptError):
+                    continue
+                if not _ck.tree_finite(state):
+                    _ck._note_nonfinite_skip(path)
+                    continue
+                self._resume_cache = (step, state)
+            return step
         return None
 
     def latest_valid_path(self) -> Optional[str]:
         self._writer.drain()
         step = self._local_restorable_step()
+        # only load_latest's agreed-step restore consumes the valid-only
+        # walk's cached state; a path-only query must not leave a full
+        # model-state copy pinned on the manager for the rest of the run
+        self._resume_cache = None
         return None if step is None else self.path_for(step)
 
     def load_latest(self) -> Optional[Tuple[Any, int]]:
@@ -969,12 +995,33 @@ class ShardedCheckpointManager(CheckpointManager):
         restorable one."""
         self._writer.drain()
         _ck.wait_all()
+        valid_only = _ck.resume_valid_only()
         if self.coordinator is not None:
             agreed = self.coordinator.negotiate_resume(
                 self._local_restorable_step())
+            # drop the valid-only walk's cached state up front: on a
+            # fresh-start (agreed None) or a mismatch it would otherwise
+            # pin a full model-state copy on this manager for the rest
+            # of the run
+            cache, self._resume_cache = self._resume_cache, None
             if agreed is None:
                 return None
-            state = load_step(self.path_for(agreed), mesh=self.mesh)
+            if cache is not None and cache[0] == int(agreed):
+                state = cache[1]  # the valid-only walk already loaded it
+            else:
+                cache = None  # release before the second full load
+                state = load_step(self.path_for(agreed), mesh=self.mesh)
+                if valid_only and not _ck.tree_finite(state):
+                    # the agreed step (a peer was behind this host's
+                    # newest valid one) must honor the valid-only
+                    # guarantee too — never silently restore nonfinite
+                    # weights the rollback exists to discard
+                    if _metrics_mod.enabled():
+                        _ck._M_SKIP_NONFINITE.inc()
+                    raise CheckpointCorruptError(
+                        self.path_for(agreed),
+                        f"fleet-agreed resume step {agreed} holds "
+                        f"nonfinite weights under valid-only resume")
             if _metrics_mod.enabled():
                 _ck._M_LOADS.inc()
             return state, int(agreed)
@@ -995,6 +1042,9 @@ class ShardedCheckpointManager(CheckpointManager):
                               f"{path}: {e}")
                 if _metrics_mod.enabled():
                     _ck._M_CORRUPT.inc()
+                continue
+            if valid_only and not _ck.tree_finite(state):
+                _ck._note_nonfinite_skip(path)
                 continue
             if _metrics_mod.enabled():
                 _ck._M_LOADS.inc()

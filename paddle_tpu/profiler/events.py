@@ -66,6 +66,10 @@ KINDS = (
     "health_alert",       # HealthMonitor signal (spike/explosion/...)
     "health_rollback",    # divergence response restored a checkpoint
     "fleet_health",       # a host's digest reported a non-ok health status
+    "controller_decision",  # fleet controller decided (evict/readmit/
+                            # rollback), with policy/evidence/outcome
+    "elastic_budget_reset",  # sustained-healthy window restored the
+                             # supervisor's restart budget
 )
 
 SEVERITIES = ("debug", "info", "warn", "error")

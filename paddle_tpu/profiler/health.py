@@ -34,7 +34,10 @@ host check is impossible and `jax_debug_nans` is inert):
     events into the observability plane, and on confirmed divergence runs
     the configured response (``PADDLE_TPU_HEALTH_ACTION``): ``warn`` |
     ``halt`` | ``rollback`` (restore the last valid checkpoint through
-    the existing ``CheckpointManager`` machinery, bit-identically).
+    the existing ``CheckpointManager`` machinery, bit-identically) |
+    ``fleet`` (pin the ``diverged`` status into the fleet digest and
+    WAIT — the supervisor-side fleet controller escalates one host's
+    divergence to a coordinated fleet-wide rollback relaunch).
 
 Opt-in: ``PADDLE_TPU_HEALTH=1`` or ``FLAGS_check_nan_inf`` arms the
 sentinel on every subsequently-built ``TrainStep``; the eager per-op
@@ -126,9 +129,9 @@ def interval() -> int:
 
 
 def action() -> str:
-    """The configured divergence response: warn | halt | rollback."""
+    """The configured divergence response: warn | halt | rollback | fleet."""
     a = os.environ.get("PADDLE_TPU_HEALTH_ACTION", "warn").lower()
-    return a if a in ("warn", "halt", "rollback") else "warn"
+    return a if a in ("warn", "halt", "rollback", "fleet") else "warn"
 
 
 def max_groups() -> int:
@@ -607,7 +610,14 @@ class HealthMonitor:
         ``fit(resume=)`` from the same file. ``cooldown_steps`` suppresses
         re-detection while the EWMA re-converges; after ``max_rollbacks``
         the monitor degrades to halt (a model that keeps diverging from
-        the same checkpoint will not be saved by another restore).
+        the same checkpoint will not be saved by another restore);
+      * ``fleet``    — defer to the supervisor-side fleet controller: pin
+        ``diverged`` into this host's fleet digest and keep running until
+        the controller's coordinated fleet-wide rollback relaunches the
+        process (every host then resumes the same last numerically-valid
+        committed step under ``PADDLE_TPU_RESUME_VALID_ONLY``). The local
+        monitor takes no action of its own — a local rollback would race
+        the fleet-wide one.
     """
 
     def __init__(self, action: Optional[str] = None, window: int = 50,
@@ -618,9 +628,9 @@ class HealthMonitor:
                  checkpoint=None, cooldown_steps: int = 50,
                  max_rollbacks: int = 3):
         self.action = (action or globals()["action"]()).lower()
-        if self.action not in ("warn", "halt", "rollback"):
+        if self.action not in ("warn", "halt", "rollback", "fleet"):
             raise ValueError(f"unknown health action {self.action!r} "
-                             f"(expected warn | halt | rollback)")
+                             f"(expected warn | halt | rollback | fleet)")
         self.window = max(int(window), 2)
         self.z_threshold = float(z_threshold)
         self.confirm_steps = max(int(confirm_steps), 1)
@@ -740,6 +750,13 @@ class HealthMonitor:
             # logs-only monitor (no sentinel) would otherwise report
             # 'diverged' forever after one confirmed spike. While the
             # sentinel IS tripped it stays authoritative.
+            if self.action == "fleet" and last_status() == "diverged":
+                # pinned: the fleet controller owns the response, and its
+                # poll cadence must not race a one-step excursion that a
+                # clean successor would otherwise flap back to "ok" before
+                # the digest publishes — only the controller's rollback
+                # relaunch (a fresh process) clears a fleet-mode diverged
+                return
             set_status("ok")
 
     def _observe_loss(self, loss: float, step: int) -> bool:
@@ -856,6 +873,10 @@ class HealthMonitor:
         elif self.action == "rollback":
             self._rollback(reason, step)
         # warn: the alert event above is the whole response
+        # fleet: the alert set status=diverged; the digest carries it to
+        # the supervisor-side controller, whose coordinated rollback
+        # relaunches this process — nothing to do locally but keep
+        # reporting (observe() pins the status until that relaunch)
 
     def _halt(self, reason: str, step: int):
         if self.model is not None:

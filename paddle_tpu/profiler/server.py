@@ -20,6 +20,9 @@ surfaces over plain HTTP (http.server, zero deps):
                 host spans, correlated by profiler/xplane.py) and returns
                 the session summary; 409 while a session is in flight,
                 hard wall-clock cap PADDLE_TPU_PROFILE_TIMEOUT
+    /controller the fleet controller's live decision state (policies,
+                streaks, evicted host, recent controller_decision
+                records); 404 when no controller runs in this process
 
 Opt-in: set `PADDLE_TPU_METRICS_PORT` (0 = pick a free port) and the entry
 points auto-start it — `Model.fit`, `bench.py`, and `tools/elastic_run.py`
@@ -197,6 +200,21 @@ class ObservabilityServer:
                          "status": cap.status()}
         return 200, summary
 
+    def controller_status(self) -> (int, dict):
+        """The `/controller` endpoint: the fleet controller's live
+        decision state (status 200), or 404 when no controller is
+        attached to this process (the flag lives on one supervisor)."""
+        try:
+            from ..distributed.fleet.controller import get_controller
+            ctl = get_controller()
+        except Exception:
+            ctl = None
+        if ctl is None:
+            return 404, {"error": "no fleet controller attached to this "
+                                  "process (tools/elastic_run.py "
+                                  "--controller runs one)"}
+        return 200, ctl.status()
+
     def healthz(self) -> dict:
         h = liveness(self.stall_after)
         if self.aggregator is not None:
@@ -267,11 +285,16 @@ class ObservabilityServer:
                         code, payload = srv.profile(parse_qs(url.query))
                         self._send(code, json.dumps(payload),
                                    "application/json")
+                    elif url.path == "/controller":
+                        code, payload = srv.controller_status()
+                        self._send(code, json.dumps(payload),
+                                   "application/json")
                     else:
                         self._send(404, json.dumps(
                             {"error": "unknown path", "endpoints":
                              ["/metrics", "/snapshot", "/healthz",
-                              "/events", "/profile"]}), "application/json")
+                              "/events", "/profile", "/controller"]}),
+                            "application/json")
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # a handler bug must not kill a scrape
@@ -346,6 +369,13 @@ def maybe_start_server(role: str = "trainer",
         except Exception as e:
             warnings.warn(f"fleet telemetry unavailable ({e}); serving "
                           f"process-local metrics only")
+    if aggregator is not None:
+        try:
+            # opt-in background collect loop (PADDLE_TPU_FLEET_POLL_SEC):
+            # straggler/health detection without an external scraper
+            aggregator.start_polling()
+        except Exception:
+            pass
     server = ObservabilityServer(aggregator=aggregator)
     try:
         bound = server.start(port)

@@ -1,0 +1,1436 @@
+"""FleetController (distributed/fleet/controller.py): the
+observe->diagnose->act loop — straggler-eviction debounce + hysteresis,
+readmission, fleet-wide divergence rollback, dry-run, command-bus
+roundtrip, and the ElasticSupervisor side of command application.
+
+These are the fast tier-1 siblings of the slow chaos e2e in
+tests/test_fleet_controller_e2e.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.controller import (ControllerCommandBus,
+                                                     FleetController,
+                                                     GEN_STRIDE,
+                                                     get_controller,
+                                                     set_controller)
+from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+from paddle_tpu.distributed.fleet.telemetry import (FleetAggregator,
+                                                    FleetReporter)
+from paddle_tpu.profiler import events
+from paddle_tpu.profiler import metrics as metrics_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeStore:
+    """In-memory store with the subset of the TCPStore API the
+    controller/bus/aggregator use (set/get/check/add/delete_key)."""
+
+    def __init__(self):
+        self.kv = {}
+        self.lock = threading.Lock()
+
+    def set(self, key, value):
+        with self.lock:
+            self.kv[key] = value.encode() if isinstance(value, str) else value
+
+    def get(self, key):
+        with self.lock:
+            return self.kv[key]
+
+    def check(self, key):
+        with self.lock:
+            return key in self.kv
+
+    def add(self, key, delta):
+        with self.lock:
+            cur = int(self.kv.get(key, b"0").decode())
+            cur += int(delta)
+            self.kv[key] = str(cur).encode()
+            return cur
+
+    def delete_key(self, key):
+        with self.lock:
+            self.kv.pop(key, None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_events(monkeypatch):
+    # an earlier module's in-process ElasticSupervisor.run() leaves the
+    # generation env behind; it would shift every child's recorded gen
+    monkeypatch.delenv("PADDLE_TPU_ELASTIC_RESTART_NUM", raising=False)
+    events.default_event_log().clear()
+    yield
+    events.default_event_log().clear()
+
+
+def _feed(reporter, walls, start_step=1):
+    for i, w in enumerate(walls):
+        reporter.note_step(start_step + i, wall_s=w)
+
+
+def _mk_fleet(store, slow_walls, fast_walls=None, n=6):
+    """Two reporters on `store`; returns (fast, slow)."""
+    fast = FleetReporter(store, rank=0, window=8, host="trainer-0",
+                         min_interval_s=0)
+    slow = FleetReporter(store, rank=1, window=8, host="trainer-1",
+                         min_interval_s=0)
+    _feed(fast, (fast_walls or [0.01]) * n)
+    _feed(slow, [slow_walls] * n)
+    return fast, slow
+
+
+def _decisions(kind="controller_decision"):
+    return [e for e in events.recent(100, kind=kind)
+            if e.get("action") != "relaunch_observed"]
+
+
+class TestCommandBus:
+    def test_publish_poll_roundtrip_in_order(self):
+        bus = ControllerCommandBus(FakeStore())
+        assert bus.last_id() == 0
+        assert bus.poll(0) == []
+        i1 = bus.publish({"action": "evict", "host": "h1", "np": 1})
+        i2 = bus.publish({"action": "readmit", "host": "h1", "np": 2})
+        assert (i1, i2) == (1, 2)
+        cmds = bus.poll(0)
+        assert [c["action"] for c in cmds] == ["evict", "readmit"]
+        assert all("ts" in c for c in cmds)
+        assert bus.poll(i1) == [cmds[1]]
+        assert bus.poll(i2) == []
+
+    def test_claimed_but_unwritten_id_stops_the_scan(self):
+        store = FakeStore()
+        bus = ControllerCommandBus(store)
+        bus.publish({"action": "evict"})
+        store.add("ctl/seq", 1)  # claimed id 2, value never written
+        bus.publish({"action": "readmit"})  # id 3
+        got = bus.poll(0)
+        # order matters: id 3 must NOT be applied before the missing id 2
+        assert [c["id"] for c in got] == [1]
+
+    def test_permanent_hole_is_skipped_after_timeout(self):
+        """Review regression: a publisher that died between the id claim
+        and the value write must not wedge every supervisor's command
+        scan forever — after HOLE_TIMEOUT_S the hole is abandoned as a
+        synthetic skipped_hole record so cursors advance past it."""
+        store = FakeStore()
+        bus = ControllerCommandBus(store)
+        bus.publish({"action": "evict"})
+        store.add("ctl/seq", 1)  # claimed id 2, never written
+        bus.publish({"action": "readmit"})  # id 3
+        bus.HOLE_TIMEOUT_S = 0.05
+        assert [c["id"] for c in bus.poll(0)] == [1]  # hole observed
+        time.sleep(0.08)
+        with pytest.warns(UserWarning, match="never written"):
+            got = bus.poll(1)
+        # the hole is surfaced as a consumable skip record, then id 3
+        assert [(c["id"], c["action"]) for c in got] == \
+            [(2, "skipped_hole"), (3, "readmit")]
+        # a supervisor consumes skipped_hole like any unknown action
+        sup_seen = [c for c in got
+                    if c.get("action") in ("evict", "readmit", "rollback")]
+        assert [c["id"] for c in sup_seen] == [3]
+
+    def test_ready_beat_and_job_done(self):
+        bus = ControllerCommandBus(FakeStore())
+        assert bus.ready_age("h1") is None
+        bus.beat_ready("h1")
+        age = bus.ready_age("h1")
+        assert age is not None and age < 1.0
+        assert not bus.job_done()
+        bus.mark_job_done()
+        assert bus.job_done()
+        # reset clears a previous job's flag (long-lived host-store):
+        # without it the NEXT job's first evicted host would exit
+        # instead of holding for readmission
+        bus.reset_job_done()
+        assert not bus.job_done()
+
+    def test_controller_from_env_clears_stale_job_done(self):
+        from paddle_tpu.distributed.fleet.controller import (
+            controller_from_env)
+        store = FakeStore()
+        ControllerCommandBus(store).mark_job_done()  # previous job's flag
+        ctl = controller_from_env(_Agg(), store, world_size=2)
+        try:
+            assert not ctl.bus.job_done()
+        finally:
+            set_controller(None)
+
+    def test_presence_marked_by_publish_and_from_env(self):
+        """Review regression: supervisors only scan the ledger once a
+        controller has marked the presence key — both attach paths must
+        arm it (controller_from_env up front, publish as the backstop)."""
+        from paddle_tpu.distributed.fleet.controller import (
+            controller_from_env)
+        store = FakeStore()
+        bus = ControllerCommandBus(store)
+        assert not bus.present()
+        bus.publish({"action": "evict"})
+        assert bus.present()
+        store2 = FakeStore()
+        ctl = controller_from_env(_Agg(), store2, world_size=2)
+        try:
+            # armed at startup, before any decision publishes
+            assert ctl.bus.present()
+        finally:
+            set_controller(None)
+
+
+class _Agg:
+    """Scripted aggregator: the controller only reads straggling(),
+    straggler_factor and .last."""
+
+    def __init__(self):
+        self._straggling = []
+        self.straggler_factor = 2.0
+        self.last = {}
+
+    def straggling(self):
+        return list(self._straggling)
+
+
+def _tick(ctl, agg, straggling=(), digests=None):
+    agg._straggling = list(straggling)
+    agg.last = digests or {}
+    ctl.on_collect(agg.last)
+
+
+def _digest(host, rank, step=10, ts=None, health="ok", p50=0.01):
+    return {"host": host, "rank": rank, "step": step,
+            "ts": time.time() if ts is None else ts,
+            "health_status": health, "wall_p50_s": p50, "window": 8}
+
+
+def _base_digests(over=None):
+    d = {0: _digest("trainer-0", 0), 1: _digest("trainer-1", 1)}
+    d.update(over or {})
+    return d
+
+
+class TestStragglerDebounce:
+    def _ctl(self, bus=None, **kw):
+        agg = _Agg()
+        kw.setdefault("confirm_windows", 3)
+        kw.setdefault("readmit_after_s", 9999)
+        ctl = FleetController(agg, bus, world_size=2, **kw)
+        return ctl, agg
+
+    def test_one_window_does_not_evict(self):
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.last_id() == 0
+        assert _decisions() == []
+
+    def test_streak_needs_fresh_digest_evidence(self):
+        """Review regression: the aggregator re-flagging the SAME cached
+        digest on every poll tick must not build the eviction streak —
+        one slow published sample would otherwise confirm in
+        confirm_windows poll ticks, defeating the documented
+        N-consecutive-collect-windows debounce."""
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus)
+        frozen = _base_digests()
+        for _ in range(5):
+            _tick(ctl, agg, ["trainer-1"], frozen)
+        # one published sample, no matter how many ticks re-read it
+        assert bus.last_id() == 0
+        assert ctl._streaks.get("trainer-1") == 1
+        for _ in range(2):  # fresh digests (new ts) still confirm
+            _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.last_id() == 1
+        assert bus.poll(0)[0]["action"] == "evict"
+
+    def test_confirmed_after_n_consecutive_windows(self):
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus)
+        for _ in range(3):
+            _tick(ctl, agg, ["trainer-1"], _base_digests())
+        cmds = bus.poll(0)
+        assert len(cmds) == 1
+        cmd = cmds[0]
+        assert cmd["action"] == "evict"
+        assert cmd["host"] == "trainer-1"
+        assert cmd["np"] == 1
+        assert cmd["ranks"] == {"trainer-0": 0}
+        recs = _decisions()
+        assert len(recs) == 1
+        assert recs[0]["policy"] == "straggler_evict"
+        assert recs[0]["outcome"] == "applied"
+        # confirmed decision does not re-fire while the excursion persists
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.last_id() == 1
+
+    def test_interrupted_streak_rearms_from_zero(self):
+        """Hysteresis half 1: an excursion that recovers before the
+        confirm window must reset the streak — windows are CONSECUTIVE."""
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus)
+        for _ in range(2):
+            _tick(ctl, agg, ["trainer-1"], _base_digests())
+        _tick(ctl, agg, [], _base_digests())  # recovered
+        for _ in range(2):
+            _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.last_id() == 0  # 2+2 non-consecutive never confirms
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.last_id() == 1  # the third consecutive one does
+
+    def test_excursion_recover_excursion_yields_two_decisions(self):
+        """Satellite regression: a host that excursions, recovers, and
+        excursions again produces TWO confirmed decisions, not one —
+        recovery re-arms the suppression, dry-run mode so the fleet
+        state stays at full strength for the second round."""
+        ctl, agg = self._ctl(bus=None, dry_run=True, confirm_windows=2)
+        for _ in range(3):
+            _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert len(_decisions()) == 1
+        _tick(ctl, agg, [], _base_digests())  # recovery re-arms
+        for _ in range(2):
+            _tick(ctl, agg, ["trainer-1"], _base_digests())
+        recs = _decisions()
+        assert len(recs) == 2
+        assert all(r["policy"] == "straggler_evict" for r in recs)
+        assert all(r["outcome"] == "dry_run" for r in recs)
+
+    def test_never_shrinks_below_min_world(self):
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus, min_world=2)
+        for _ in range(5):
+            _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.last_id() == 0
+        assert _decisions() == []
+
+    def test_one_eviction_at_a_time(self):
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=3, confirm_windows=1,
+                              readmit_after_s=9999)
+        d = {0: _digest("trainer-0", 0), 1: _digest("trainer-1", 1),
+             2: _digest("trainer-2", 2)}
+        _tick(ctl, agg, ["trainer-1"], d)
+        _tick(ctl, agg, ["trainer-1", "trainer-2"], d)
+        cmds = bus.poll(0)
+        assert [c["host"] for c in cmds] == ["trainer-1"]
+
+    def test_dry_run_publishes_nothing(self):
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus, dry_run=True, confirm_windows=1)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.last_id() == 0
+        recs = _decisions()
+        assert len(recs) == 1 and recs[0]["outcome"] == "dry_run"
+        assert recs[0]["dry_run"] is True
+
+    def test_no_evict_until_full_fleet_has_reported(self):
+        """A survivor the controller has never seen a digest from would
+        be missing from the relaunch rank map and relaunch with an
+        out-of-range rank — the controller stays observe-only until the
+        full fleet has reported once."""
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=3, confirm_windows=1,
+                              readmit_after_s=9999)
+        two = {0: _digest("trainer-0", 0), 1: _digest("trainer-1", 1)}
+        for _ in range(4):
+            _tick(ctl, agg, ["trainer-1"], two)
+        assert bus.last_id() == 0  # trainer-2 never reported: no actuation
+        # the third host reports: the confirmed straggler is now evictable
+        three = dict(two)
+        three[2] = _digest("trainer-2", 2)
+        _tick(ctl, agg, ["trainer-1"], three)
+        cmds = bus.poll(0)
+        assert [c["host"] for c in cmds] == ["trainer-1"]
+        assert cmds[0]["ranks"] == {"trainer-0": 0, "trainer-2": 1}
+
+    def test_failed_publish_degrades_to_failed_outcome(self):
+        class DeadStore(FakeStore):
+            def add(self, key, delta):
+                raise RuntimeError("store gone")
+
+        ctl, agg = self._ctl(ControllerCommandBus(DeadStore()),
+                             confirm_windows=1)
+        with pytest.warns(UserWarning, match="could not publish"):
+            _tick(ctl, agg, ["trainer-1"], _base_digests())
+        recs = _decisions()
+        assert len(recs) == 1 and recs[0]["outcome"] == "failed"
+        assert recs[0]["severity"] == "error"
+        # the fleet is still at full strength: nothing was actuated
+        assert ctl.current_world() == 2
+
+    def test_decision_counter_by_policy_and_outcome(self):
+        c = metrics_mod.default_registry().get("controller_decisions_total")
+        before = c.value(policy="straggler_evict", outcome="applied")
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus, confirm_windows=1)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert c.value(policy="straggler_evict",
+                       outcome="applied") == before + 1
+
+    def test_evict_env_carries_prewarm_and_forced_reporter(self):
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=2, confirm_windows=1,
+                              readmit_after_s=9999,
+                              prewarm_cache_dir="/tmp/jaxcache")
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        cmd = bus.poll(0)[0]
+        assert cmd["env"]["PADDLE_TPU_COMPILE_CACHE_DIR"] == "/tmp/jaxcache"
+        assert cmd["env"]["PADDLE_TPU_FLEET_REPORTER"] == "1"
+
+
+class TestReadmission:
+    def test_readmit_after_fresh_ready_beat_and_cooldown(self):
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=2, confirm_windows=1,
+                              readmit_after_s=0.05)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.poll(0)[0]["action"] == "evict"
+        # no ready beat yet: held past the cooldown, still not readmitted
+        time.sleep(0.06)
+        _tick(ctl, agg, [], {0: _digest("trainer-0", 0)})
+        assert bus.last_id() == 1
+        bus.beat_ready("trainer-1")
+        _tick(ctl, agg, [], {0: _digest("trainer-0", 0)})
+        cmds = bus.poll(1)
+        assert len(cmds) == 1 and cmds[0]["action"] == "readmit"
+        assert cmds[0]["np"] == 2
+        assert cmds[0]["ranks"] == {"trainer-0": 0, "trainer-1": 1}
+        recs = _decisions()
+        assert [r["policy"] for r in recs] == ["straggler_evict",
+                                               "straggler_readmit"]
+        assert ctl.current_world() == 2
+
+    def test_cooldown_blocks_early_readmission(self):
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=2, confirm_windows=1,
+                              readmit_after_s=60)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        bus.beat_ready("trainer-1")
+        _tick(ctl, agg, [], {0: _digest("trainer-0", 0)})
+        assert bus.last_id() == 1  # evict only
+
+    def test_host_dead_during_hold_is_not_readmitted(self):
+        """Review regression: the beat must be observed on EVERY tick,
+        including during the hold window — a supervisor that beat once
+        and died mid-probation previously read age=0 at the first
+        post-window look and a dead host was readmitted into the rank
+        map (trainers wedge in rendezvous on the missing rank)."""
+        store = FakeStore()
+        bus = ControllerCommandBus(store)
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=2, confirm_windows=1,
+                              readmit_after_s=0.08)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.last_id() == 1
+        # one beat during the hold, observed by the next tick, then the
+        # held supervisor dies (value never changes again)
+        store.set("ctl/ready/trainer-1", "beat-1")
+        _tick(ctl, agg, [], {0: _digest("trainer-0", 0)})
+        assert "trainer-1" in ctl._ready_obs  # observed DURING the hold
+        assert bus.last_id() == 1             # hold window not over
+        # age the in-hold observation past the freshness window (stands
+        # in for a long hold with no further beats) and pass the hold
+        ctl._ready_obs["trainer-1"] = ("beat-1",
+                                       time.monotonic() - 3600.0)
+        time.sleep(0.09)
+        _tick(ctl, agg, [], {0: _digest("trainer-0", 0)})
+        assert bus.last_id() == 1  # dead during probation: no readmit
+
+    def test_readmit_freshness_is_clock_skew_immune(self):
+        """Review regression: probation freshness must be judged by the
+        beat VALUE changing on the controller's own clock — a held host
+        whose wall clock lags far behind ours must still readmit, and a
+        dead host's frozen beat must not."""
+        store = FakeStore()
+        bus = ControllerCommandBus(store)
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=2, confirm_windows=1,
+                              readmit_after_s=0.01)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        time.sleep(0.02)
+        # a beat stamped by a clock ONE HOUR behind ours: ready_age-style
+        # wall-clock comparison would read it as hopelessly stale
+        store.set("ctl/ready/trainer-1", repr(time.time() - 3600.0))
+        _tick(ctl, agg, [], {0: _digest("trainer-0", 0)})
+        assert bus.poll(1)[0]["action"] == "readmit"
+
+    def test_frozen_beat_blocks_readmission(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_CONTROLLER_POLL_SEC", "0.01")
+        store = FakeStore()
+        bus = ControllerCommandBus(store)
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=2, confirm_windows=1,
+                              readmit_after_s=0.01)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        time.sleep(0.02)
+        # one beat, then the held supervisor dies: the value never
+        # changes again. First observation reads fresh; once the
+        # freshness window (3*poll + 5s, monkeypatched via a tiny poll
+        # and a shrunken constant below) passes with no change, the
+        # readmit must stop firing.
+        store.set("ctl/ready/trainer-1", "beat-1")
+        _tick(ctl, agg, [], {0: _digest("trainer-0", 0)})
+        assert bus.last_id() == 2  # first observation: readmitted
+        # simulate the post-readmit relapse: evict again, beat frozen
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.last_id() == 3
+        time.sleep(0.02)
+        # age the frozen observation past the window artificially
+        ctl._ready_obs["trainer-1"] = ("beat-1",
+                                       time.monotonic() - 3600.0)
+        _tick(ctl, agg, [], {0: _digest("trainer-0", 0)})
+        assert bus.last_id() == 3  # frozen beat: no readmission
+
+    def test_status_never_blocks_behind_slow_probation_read(self):
+        """Review regression: _readmit_policy's probation read is a
+        store RPC (up to the client timeout) — it must run outside the
+        status lock like _act's publish, or every /controller scrape
+        stalls behind the store once per tick during an eviction hold."""
+        store = FakeStore()
+        real_get = store.get
+
+        def slow_get(key):
+            if key.startswith("ctl/ready/"):
+                time.sleep(0.8)
+            return real_get(key)
+
+        store.get = slow_get
+        bus = ControllerCommandBus(store)
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=2, confirm_windows=1,
+                              readmit_after_s=60)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        assert bus.last_id() == 1  # trainer-1 held
+        bus.beat_ready("trainer-1")  # probation key exists: get() runs
+        t = threading.Thread(target=_tick, args=(
+            ctl, agg, [], {0: _digest("trainer-0", 0)}))
+        t.start()
+        time.sleep(0.2)  # the tick is now inside the slow probation read
+        t0 = time.monotonic()
+        ctl.status()
+        took = time.monotonic() - t0
+        t.join()
+        assert took < 0.4, f"status() serialized behind the RPC ({took:.2f}s)"
+
+
+class TestRollback:
+    def _ctl(self, bus, **kw):
+        agg = _Agg()
+        kw.setdefault("confirm_windows", 99)
+        ctl = FleetController(agg, bus, world_size=2, **kw)
+        return ctl, agg
+
+    def test_diverged_host_triggers_fleet_rollback(self):
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus)
+        d = _base_digests({1: _digest("trainer-1", 1, health="diverged")})
+        _tick(ctl, agg, [], d)
+        cmds = bus.poll(0)
+        assert len(cmds) == 1
+        cmd = cmds[0]
+        assert cmd["action"] == "rollback"
+        assert cmd["host"] == "trainer-1"
+        assert cmd["np"] == 2  # rollback keeps the world size
+        # valid-only is ONE-SHOT: next-launch overlay, not persistent env
+        assert cmd["env_once"]["PADDLE_TPU_RESUME_VALID_ONLY"] == "1"
+        assert "PADDLE_TPU_RESUME_VALID_ONLY" not in cmd["env"]
+        recs = _decisions()
+        assert recs[0]["policy"] == "health_rollback"
+        assert recs[0]["evidence"]["diverged"] == ["trainer-1"]
+
+    def test_persistent_diverged_status_rolls_back_once(self):
+        """The diverged host's stale digest keeps saying diverged until
+        its relaunch publishes a fresh one — that must not re-fire."""
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus)
+        d = _base_digests({1: _digest("trainer-1", 1, health="diverged")})
+        for _ in range(4):
+            _tick(ctl, agg, [], d)
+        assert bus.last_id() == 1
+
+    def test_recovered_then_rediverged_rolls_back_again(self):
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus, rollback_cooldown_s=0.0)
+        bad = _base_digests({1: _digest("trainer-1", 1, health="diverged")})
+        _tick(ctl, agg, [], bad)
+        _tick(ctl, agg, [], _base_digests())  # fresh generation reports ok
+        _tick(ctl, agg, [], bad)
+        assert bus.last_id() == 2
+        assert len(_decisions()) == 2
+
+    def test_warn_status_does_not_roll_back(self):
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus)
+        _tick(ctl, agg, [],
+              _base_digests({1: _digest("trainer-1", 1, health="warn")}))
+        assert bus.last_id() == 0
+
+    def test_stale_diverged_digest_does_not_roll_back(self):
+        """Review regression: a dead host's (or, with a long-lived
+        host-store, a previous incarnation's) frozen 'diverged' digest
+        must not hard-kill a healthy fleet — health votes are
+        stale-filtered like the aggregator's straggler votes."""
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus)
+        agg.stale_sec = 1.0
+        stale = _base_digests(
+            {1: _digest("trainer-1", 1, health="diverged",
+                        ts=time.time() - 5.0)})
+        _tick(ctl, agg, [], stale)
+        assert bus.last_id() == 0  # frozen verdict: no actuation
+        fresh = _base_digests(
+            {1: _digest("trainer-1", 1, health="diverged")})
+        _tick(ctl, agg, [], fresh)
+        assert bus.last_id() == 1  # a live diverged digest still fires
+
+    def test_no_rollback_until_full_fleet_has_reported(self):
+        """Review regression: like eviction, a rollback's re-densified
+        rank map needs the FULL assignment — a partial map hands two
+        hosts the same rank and wedges every relaunch in rendezvous."""
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=3, confirm_windows=99,
+                              readmit_after_s=9999)
+        partial = {2: _digest("trainer-2", 2, health="diverged")}
+        for _ in range(3):
+            _tick(ctl, agg, [], partial)
+        assert bus.last_id() == 0  # two hosts never reported: observe-only
+        full = {0: _digest("trainer-0", 0), 1: _digest("trainer-1", 1),
+                2: _digest("trainer-2", 2, health="diverged")}
+        _tick(ctl, agg, [], full)
+        cmds = bus.poll(0)
+        assert [c["action"] for c in cmds] == ["rollback"]
+        assert cmds[0]["ranks"] == {"trainer-0": 0, "trainer-1": 1,
+                                    "trainer-2": 2}
+
+    def test_rollback_during_eviction_excludes_held_host(self):
+        """Review regression: a rollback while a host is evicted covers
+        the N-1 fleet — the held host must be OUT of the rank map or a
+        survivor lands on rank >= np and wedges every relaunch."""
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=3, confirm_windows=1,
+                              readmit_after_s=9999)
+        d = {0: _digest("trainer-0", 0), 1: _digest("trainer-1", 1),
+             2: _digest("trainer-2", 2)}
+        _tick(ctl, agg, ["trainer-1"], d)  # evict trainer-1
+        assert ctl.current_world() == 2
+        d2 = {0: _digest("trainer-0", 0, health="diverged"),
+              1: _digest("trainer-1", 1), 2: _digest("trainer-2", 2)}
+        _tick(ctl, agg, [], d2)
+        cmds = bus.poll(0)
+        assert [c["action"] for c in cmds] == ["evict", "rollback"]
+        rb = cmds[1]
+        assert rb["np"] == 2
+        assert rb["ranks"] == {"trainer-0": 0, "trainer-2": 1}
+
+    def test_failed_publish_is_retried_next_tick(self):
+        """Review regression: a store blip at publish time must not
+        permanently suppress the decision — the diverged host stays
+        pinned and the rollback is retried once the store recovers."""
+        class FlakyStore(FakeStore):
+            fail = 1
+
+            def add(self, key, delta):
+                if self.fail:
+                    self.fail -= 1
+                    raise RuntimeError("store blip")
+                return super().add(key, delta)
+
+        bus = ControllerCommandBus(FlakyStore())
+        ctl, agg = self._ctl(bus, rollback_cooldown_s=0.0)
+        d = _base_digests({1: _digest("trainer-1", 1, health="diverged")})
+        with pytest.warns(UserWarning, match="could not publish"):
+            _tick(ctl, agg, [], d)
+        assert [r for r in ctl.decisions if r["outcome"] == "failed"]
+        assert bus.last_id() == 0
+        _tick(ctl, agg, [], d)  # store recovered: the retry actuates
+        cmds = bus.poll(0)
+        assert [c["action"] for c in cmds] == ["rollback"]
+        applied = [r for r in ctl.decisions if r["outcome"] == "applied"]
+        assert len(applied) == 1
+
+
+class TestRelaunchObservation:
+    def test_first_fresh_digest_closes_the_decision(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_CONTROLLER_POLL_SEC", "0.01")
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=2, confirm_windows=1,
+                              readmit_after_s=9999)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        rec = ctl.decisions[-1]
+        assert rec["relaunch_to_first_step_s"] is None
+        # stale digests (pre-decision ts) must not close it
+        _tick(ctl, agg, [], {0: _digest("trainer-0", 0,
+                                        ts=rec["ts"] - 1.0)})
+        assert ctl.decisions[-1]["relaunch_to_first_step_s"] is None
+        time.sleep(0.05)
+        _tick(ctl, agg, [], {0: _digest("trainer-0", 0)})
+        dt = ctl.decisions[-1]["relaunch_to_first_step_s"]
+        assert dt is not None and 0 <= dt < 5
+        obs = [e for e in events.recent(50, kind="controller_decision")
+               if e.get("action") == "relaunch_observed"]
+        assert len(obs) == 1
+        assert obs[0]["relaunch_to_first_step_s"] == dt
+        g = metrics_mod.default_registry().get(
+            "controller_relaunch_to_first_step_seconds")
+        assert g.value(policy="straggler_evict") == dt
+
+    def test_generation_tells_pre_from_post_relaunch(self, monkeypatch):
+        """A PRE-relaunch digest published during command-poll +
+        SIGTERM-drain latency (fresh ts, old generation) must not close
+        the decision; a digest from the command's generation closes it
+        immediately."""
+        monkeypatch.setenv("PADDLE_TPU_CONTROLLER_POLL_SEC", "60")
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=2, confirm_windows=1,
+                              readmit_after_s=9999)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        rec = ctl.decisions[-1]
+        # fresh timestamp but generation 0: the straggler's last gasp
+        d = _digest("trainer-0", 0)
+        d["gen"] = 0
+        _tick(ctl, agg, [], {0: d})
+        assert ctl.decisions[-1]["relaunch_to_first_step_s"] is None
+        # the relaunched generation reports: closes despite the 60s
+        # ts floor that the fallback path would still be waiting on
+        d2 = _digest("trainer-0", 0)
+        d2["gen"] = rec["cmd_id"] * GEN_STRIDE
+        _tick(ctl, agg, [], {0: d2})
+        assert ctl.decisions[-1]["relaunch_to_first_step_s"] is not None
+
+
+class TestStatusEndpointPlumbing:
+    def test_status_shape_and_registration(self):
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=2, confirm_windows=1,
+                              readmit_after_s=9999, dry_run=True)
+        _tick(ctl, agg, ["trainer-1"], _base_digests())
+        st = ctl.status()
+        json.dumps(st)  # must be strictly serializable
+        assert st["dry_run"] is True
+        assert st["world_size"] == 2
+        assert st["assignment"] == {"trainer-0": 0, "trainer-1": 1}
+        assert len(st["decisions"]) == 1
+        set_controller(ctl)
+        try:
+            assert get_controller() is ctl
+        finally:
+            set_controller(None)
+        assert get_controller() is None
+
+    def test_tick_never_raises(self):
+        class BadAgg:
+            straggler_factor = 2.0
+            last = {}
+
+            def straggling(self):
+                raise RuntimeError("boom")
+
+        ctl = FleetController(BadAgg(), None, world_size=2)
+        with pytest.warns(UserWarning, match="controller tick failed"):
+            ctl.on_collect({})  # must not raise
+
+
+class TestAggregatorPolling:
+    def test_polling_off_by_default_without_hook(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_FLEET_POLL_SEC", raising=False)
+        agg = FleetAggregator(FakeStore(), 2)
+        assert agg.start_polling() is False
+        assert agg._poll_thread is None
+
+    def test_polling_defaults_on_with_hook(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_FLEET_POLL_SEC", raising=False)
+        monkeypatch.setenv("PADDLE_TPU_CONTROLLER_POLL_SEC", "0.01")
+        store = FakeStore()
+        _mk_fleet(store, 0.01)
+        agg = FleetAggregator(store, 2)
+        seen = []
+        assert agg.start_polling(hook=seen.append) is True
+        try:
+            deadline = time.time() + 5
+            while not seen and time.time() < deadline:
+                time.sleep(0.01)
+            assert seen and sorted(seen[0]) == [0, 1]
+        finally:
+            agg.stop_polling()
+        assert agg._poll_thread is None
+
+    def test_env_knob_enables_polling_without_hook(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLEET_POLL_SEC", "0.01")
+        store = FakeStore()
+        _mk_fleet(store, 0.2)  # trainer-1 is a straggler
+        agg = FleetAggregator(store, 2, straggler_factor=2.0)
+        assert agg.start_polling() is True
+        try:
+            deadline = time.time() + 5
+            while not agg.straggling() and time.time() < deadline:
+                time.sleep(0.01)
+            # detection ran with NO scrape and NO hook
+            assert agg.straggling() == ["trainer-1"]
+        finally:
+            agg.stop_polling()
+
+    def test_hook_exception_does_not_kill_the_loop(self, monkeypatch):
+        store = FakeStore()
+        _mk_fleet(store, 0.01)
+        agg = FleetAggregator(store, 2)
+        calls = []
+
+        def bad_hook(digests):
+            calls.append(1)
+            raise RuntimeError("consumer bug")
+
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            assert agg.start_polling(interval=0.01, hook=bad_hook)
+            try:
+                deadline = time.time() + 5
+                while len(calls) < 2 and time.time() < deadline:
+                    time.sleep(0.01)
+            finally:
+                agg.stop_polling()
+        assert len(calls) >= 2  # survived its own hook failing
+
+    def test_late_hook_rearms_a_running_loop(self, monkeypatch):
+        """Review regression: elastic_run starts a hookless poll loop via
+        the metrics server BEFORE attaching the controller; the second
+        start_polling(hook=) must re-arm the loop with the hook instead
+        of returning True and silently discarding it (which would leave
+        the whole controller inert)."""
+        monkeypatch.setenv("PADDLE_TPU_FLEET_POLL_SEC", "0.01")
+        store = FakeStore()
+        _mk_fleet(store, 0.01)
+        agg = FleetAggregator(store, 2)
+        seen = []
+        hook = seen.append
+        try:
+            assert agg.start_polling() is True          # hookless first
+            assert agg.start_polling(hook=hook) is True
+            deadline = time.time() + 5
+            while not seen and time.time() < deadline:
+                time.sleep(0.01)
+            assert seen, "late hook never received a collect tick"
+            # the SAME hook again: already armed, no restart churn
+            assert agg.start_polling(hook=hook) is True
+            assert agg._poll_hook is hook
+        finally:
+            agg.stop_polling()
+
+    def test_stale_digests_leave_the_straggler_vote(self):
+        store = FakeStore()
+        fast, slow = _mk_fleet(store, 0.5)
+        agg = FleetAggregator(store, 2, straggler_factor=2.0,
+                              stale_sec=0.2)
+        agg.collect()
+        assert agg.straggling() == ["trainer-1"]
+        time.sleep(0.3)
+        # trainer-0 keeps publishing; trainer-1's digest goes stale
+        _feed(fast, [0.01] * 3, start_step=50)
+        agg.collect()
+        recs = events.recent(50, kind="fleet_straggler")
+        assert len(recs) == 1  # no duplicate event from stale data
+        # review regression: the stale host LEAVES the straggler set —
+        # its frozen verdict is no longer evidence, and the controller's
+        # eviction debounce counts set membership as consecutive
+        # straggling windows (a reporter hiccup must not build a streak)
+        assert agg.straggling() == []
+
+
+class TestForcedReporter:
+    def test_force_knob_builds_reporter_at_world_one(self, monkeypatch):
+        from paddle_tpu.distributed.fleet import telemetry
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("PADDLE_TPU_FLEET_REPORTER", "1")
+        store = FakeStore()
+        monkeypatch.setattr(telemetry, "_store_from_env", lambda: store)
+        rep = telemetry.reporter_from_env()
+        assert rep is not None and rep.rank == 0
+
+    def test_force_off_disables_at_any_world(self, monkeypatch):
+        from paddle_tpu.distributed.fleet import telemetry
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        monkeypatch.setenv("PADDLE_TPU_FLEET_REPORTER", "0")
+        monkeypatch.setattr(telemetry, "_store_from_env",
+                            lambda: FakeStore())
+        assert telemetry.reporter_from_env() is None
+
+    def test_default_unchanged_world_one_is_none(self, monkeypatch):
+        from paddle_tpu.distributed.fleet import telemetry
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+        monkeypatch.delenv("PADDLE_TPU_FLEET_REPORTER", raising=False)
+        monkeypatch.setattr(telemetry, "_store_from_env",
+                            lambda: FakeStore())
+        assert telemetry.reporter_from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# supervisor-side command application
+# ---------------------------------------------------------------------------
+
+_SLEEPY = "import time\ntime.sleep(60)\n"
+_RECORD = """
+import json, os, sys
+with open(sys.argv[1], "a") as f:
+    f.write(json.dumps({
+        "np": os.environ.get("PADDLE_TRAINERS_NUM"),
+        "rank": os.environ.get("PADDLE_TRAINER_ID"),
+        "gen": os.environ.get("PADDLE_TPU_ELASTIC_RESTART_NUM"),
+        "valid_only": os.environ.get("PADDLE_TPU_RESUME_VALID_ONLY"),
+    }) + "\\n")
+import time
+time.sleep({sleep})
+"""
+
+
+def _quiet(fn, *a, **kw):
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        return fn(*a, **kw)
+
+
+class TestSupervisorCommandApplication:
+    def _sup(self, bus, member, **kw):
+        kw.setdefault("max_restarts", 0)
+        kw.setdefault("cmd_poll", 0.05)
+        kw.setdefault("stop_grace", 5.0)
+        return ElasticSupervisor(manager=None, self_member=member,
+                                 commands=bus, poll=0.05, **kw)
+
+    def test_peer_evict_relaunches_with_new_contract(self, tmp_path):
+        """A survivor's supervisor applying `evict(trainer-1)` relaunches
+        its child at np=1 rank 0 with the command's env overlay and the
+        GEN_STRIDE generation floor — without consuming restart budget."""
+        bus = ControllerCommandBus(FakeStore())
+        child = tmp_path / "child.py"
+        child.write_text(_RECORD.replace("{sleep}", "1.2"))
+        out = tmp_path / "out.jsonl"
+        sup = self._sup(bus, "trainer-0")
+        changes = []
+        sup.on_fleet_change = lambda cmd, held: changes.append(
+            (cmd["action"], held))
+        t = threading.Thread(target=_quiet, args=(
+            sup.supervise, [sys.executable, str(child), str(out)]), kwargs={
+            "env": {"PADDLE_TRAINERS_NUM": "2", "PADDLE_TRAINER_ID": "0"}})
+        t.start()
+        time.sleep(0.3)  # first generation is up
+        cid = bus.publish({"action": "evict", "host": "trainer-1", "np": 1,
+                           "ranks": {"trainer-0": 0},
+                           "env": {"PADDLE_TPU_FLEET_REPORTER": "1"}})
+        t.join(timeout=30)
+        assert not t.is_alive()
+        recs = [json.loads(l) for l in out.read_text().splitlines()]
+        assert len(recs) == 2
+        assert recs[0]["np"] == "2" and recs[0]["rank"] == "0"
+        assert recs[1]["np"] == "1" and recs[1]["rank"] == "0"
+        assert int(recs[1]["gen"]) == cid * GEN_STRIDE
+        assert sup.restarts == 0  # controller actions are not failures
+        assert changes == [("evict", False)]
+
+    def test_self_evict_holds_then_readmits(self, tmp_path):
+        bus = ControllerCommandBus(FakeStore())
+        child = tmp_path / "child.py"
+        child.write_text(_RECORD.replace("{sleep}", "1.0"))
+        out = tmp_path / "out.jsonl"
+        sup = self._sup(bus, "trainer-1")
+        rc = {}
+        t = threading.Thread(target=lambda: rc.setdefault("v", _quiet(
+            sup.supervise, [sys.executable, str(child), str(out)],
+            env={"PADDLE_TRAINERS_NUM": "2", "PADDLE_TRAINER_ID": "1"})))
+        t.start()
+        time.sleep(0.3)
+        bus.publish({"action": "evict", "host": "trainer-1", "np": 1,
+                     "ranks": {"trainer-0": 0}})
+        # held: probation beats appear, no relaunch yet
+        deadline = time.time() + 10
+        while bus.ready_age("trainer-1") is None \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        assert bus.ready_age("trainer-1") is not None
+        assert len(out.read_text().splitlines()) == 1
+        rid = bus.publish({"action": "readmit", "host": "trainer-1",
+                           "np": 2,
+                           "ranks": {"trainer-0": 0, "trainer-1": 1}})
+        t.join(timeout=30)
+        assert not t.is_alive() and rc["v"] == 0
+        recs = [json.loads(l) for l in out.read_text().splitlines()]
+        assert len(recs) == 2  # held generation never launched
+        assert recs[1]["np"] == "2" and recs[1]["rank"] == "1"
+        assert int(recs[1]["gen"]) == rid * GEN_STRIDE
+
+    def test_held_supervisor_exits_cleanly_on_job_done(self, tmp_path):
+        bus = ControllerCommandBus(FakeStore())
+        child = tmp_path / "child.py"
+        child.write_text(_SLEEPY)
+        sup = self._sup(bus, "trainer-1")
+        rc = {}
+        t = threading.Thread(target=lambda: rc.setdefault("v", _quiet(
+            sup.supervise, [sys.executable, str(child)])))
+        t.start()
+        time.sleep(0.3)
+        bus.publish({"action": "evict", "host": "trainer-1", "np": 1,
+                     "ranks": {"trainer-0": 0}})
+        deadline = time.time() + 10
+        while bus.ready_age("trainer-1") is None \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        bus.mark_job_done()
+        t.join(timeout=15)
+        assert not t.is_alive() and rc["v"] == 0
+
+    def test_rollback_kills_hard_and_sets_valid_only(self, tmp_path):
+        """Rollback must NOT SIGTERM (the preemption handler would
+        checkpoint the diverged state): the child dies by SIGKILL and
+        the relaunch carries PADDLE_TPU_RESUME_VALID_ONLY=1 — for that
+        ONE launch only (env_once): a failure AFTER the startup retry
+        window (the child got past its resume) must not inherit the
+        rollback's resume mode."""
+        bus = ControllerCommandBus(FakeStore())
+        child = tmp_path / "child.py"
+        # a SIGTERM-trapping child: only SIGKILL gets it down fast.
+        # Launch 1 sleeps (awaiting the rollback kill); launch 2 runs
+        # PAST the (shrunken) startup window then exits 3 to force an
+        # ordinary failure restart; launch 3 exits clean.
+        child.write_text(
+            "import json, os, signal, sys, time\n"
+            "signal.signal(signal.SIGTERM, lambda *a: None)\n"
+            "out = sys.argv[1]\n"
+            "n = len(open(out).read().splitlines()) "
+            "if os.path.exists(out) else 0\n"
+            "with open(out, 'a') as f:\n"
+            "    f.write(json.dumps({'valid_only': "
+            "os.environ.get('PADDLE_TPU_RESUME_VALID_ONLY')}) + '\\n')\n"
+            "if n == 0:\n"
+            "    time.sleep(30.0)\n"
+            "if n == 1:\n"
+            "    time.sleep(0.4)\n"
+            "    sys.exit(3)\n"
+            "sys.exit(0)\n")
+        out = tmp_path / "out.jsonl"
+        sup = self._sup(bus, "trainer-0", stop_grace=30.0, max_restarts=1,
+                        backoff=0.01)
+        sup.ENV_ONCE_RETRY_S = 0.2  # launch 2's 0.4s run is "past resume"
+        t = threading.Thread(target=_quiet, args=(
+            sup.supervise, [sys.executable, str(child), str(out)]))
+        t.start()
+        time.sleep(0.3)
+        t0 = time.time()
+        bus.publish({"action": "rollback", "host": "trainer-1", "np": 2,
+                     "ranks": {"trainer-0": 0, "trainer-1": 1},
+                     "env_once": {"PADDLE_TPU_RESUME_VALID_ONLY": "1"}})
+        t.join(timeout=20)
+        assert not t.is_alive()
+        # SIGKILL path: far faster than the 30s stop_grace a trapped
+        # SIGTERM would have burned
+        assert time.time() - t0 < 15
+        recs = [json.loads(l) for l in out.read_text().splitlines()]
+        assert len(recs) == 3
+        assert recs[0]["valid_only"] is None
+        assert recs[1]["valid_only"] == "1"   # the rollback relaunch
+        assert recs[2]["valid_only"] is None  # one-shot: did not leak
+
+    def test_env_once_rearms_when_resume_itself_fails(self, tmp_path):
+        """Review regression: a rollback relaunch whose valid-only
+        resume RAISES (nonfinite fleet-agreed step -> renegotiation)
+        exits within the startup window — the retry must run valid-only
+        again, or it silently restores exactly the diverged state the
+        rollback existed to skip."""
+        bus = ControllerCommandBus(FakeStore())
+        child = tmp_path / "child.py"
+        # launch 1 awaits the rollback kill; launch 2 (valid-only) dies
+        # INSTANTLY like a resume failure; launch 3 must still be
+        # valid-only and exits clean
+        child.write_text(
+            "import json, os, signal, sys, time\n"
+            "signal.signal(signal.SIGTERM, lambda *a: None)\n"
+            "out = sys.argv[1]\n"
+            "n = len(open(out).read().splitlines()) "
+            "if os.path.exists(out) else 0\n"
+            "with open(out, 'a') as f:\n"
+            "    f.write(json.dumps({'valid_only': "
+            "os.environ.get('PADDLE_TPU_RESUME_VALID_ONLY')}) + '\\n')\n"
+            "if n == 0:\n"
+            "    time.sleep(30.0)\n"
+            "sys.exit(3 if n == 1 else 0)\n")
+        out = tmp_path / "out.jsonl"
+        sup = self._sup(bus, "trainer-0", stop_grace=30.0, max_restarts=1,
+                        backoff=0.01)
+        t = threading.Thread(target=_quiet, args=(
+            sup.supervise, [sys.executable, str(child), str(out)]))
+        t.start()
+        time.sleep(0.3)
+        bus.publish({"action": "rollback", "host": "trainer-1", "np": 2,
+                     "ranks": {"trainer-0": 0, "trainer-1": 1},
+                     "env_once": {"PADDLE_TPU_RESUME_VALID_ONLY": "1"}})
+        t.join(timeout=20)
+        assert not t.is_alive()
+        recs = [json.loads(l) for l in out.read_text().splitlines()]
+        assert [r["valid_only"] for r in recs] == [None, "1", "1"]
+
+    def test_commands_without_self_member_are_dropped(self):
+        with pytest.warns(UserWarning, match="needs self_member"):
+            sup = ElasticSupervisor(commands=ControllerCommandBus(
+                FakeStore()))
+        assert sup.commands is None
+
+    def test_commands_published_before_start_are_ignored(self, tmp_path):
+        """Ledger entries from a previous incarnation of the job must not
+        actuate on a freshly started supervisor."""
+        bus = ControllerCommandBus(FakeStore())
+        bus.publish({"action": "evict", "host": "trainer-0", "np": 1,
+                     "ranks": {}})
+        child = tmp_path / "child.py"
+        child.write_text("pass\n")
+        sup = self._sup(bus, "trainer-0")
+        assert _quiet(sup.supervise, [sys.executable, str(child)]) == 0
+        assert sup.restarts == 0 and sup.generation == 0
+
+    def test_cursor_anchor_blip_does_not_replay_old_ledger(self, tmp_path):
+        """Review regression: a store blip during cursor initialization
+        must leave the cursor UNANCHORED (retried on the next poll) — a
+        0 fallback would replay the previous incarnation's ledger, e.g.
+        a stale rollback hard-killing a healthy fresh trainer."""
+        bus = ControllerCommandBus(FakeStore())
+        bus.publish({"action": "rollback", "host": "trainer-1", "np": 2,
+                     "ranks": {"trainer-0": 0, "trainer-1": 1}})
+        fail = {"n": 1}
+        real_last_id = bus.last_id
+
+        def flaky_last_id():
+            if fail["n"]:
+                fail["n"] -= 1
+                raise RuntimeError("store blip")
+            return real_last_id()
+
+        bus.last_id = flaky_last_id
+        child = tmp_path / "child.py"
+        child.write_text("import time\ntime.sleep(0.5)\n")
+        sup = self._sup(bus, "trainer-0")
+        assert _quiet(sup.supervise, [sys.executable, str(child)]) == 0
+        # the blip consumed the startup anchor; the poll-tick retry
+        # re-anchored at the head — the stale rollback never applied
+        assert sup.generation == 0 and sup.last_reason is None
+        assert sup._cmd_cursor == 1
+
+    def test_controller_relaunch_credits_healthy_budget(self, tmp_path):
+        """Review regression: a long-healthy child stopped by a
+        controller command earns the budget reset like any other stop —
+        without the credit, the post-reshape relaunch (the likeliest
+        moment for a rendezvous hiccup) sits one short-lived failure
+        away from a permanent wedge on a stale exhausted counter."""
+        bus = ControllerCommandBus(FakeStore())
+        child = tmp_path / "child.py"
+        child.write_text(_RECORD.replace("{sleep}", "3.0"))
+        out = tmp_path / "out.jsonl"
+        sup = self._sup(bus, "trainer-0", max_restarts=3,
+                        budget_reset_s=0.3)
+        sup.restarts = 3  # an earlier flap exhausted the budget
+        t = threading.Thread(target=_quiet, args=(
+            sup.supervise, [sys.executable, str(child), str(out)]))
+        t.start()
+        time.sleep(0.8)  # the child has been healthy > budget_reset_s
+        bus.publish({"action": "evict", "host": "trainer-1", "np": 1,
+                     "ranks": {"trainer-0": 0}})
+        deadline = time.time() + 10
+        while sup.restarts != 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert sup.restarts == 0  # the healthy window was credited
+        t.join(timeout=30)
+        assert not t.is_alive()
+
+    def test_no_ledger_scan_until_controller_present(self, tmp_path):
+        """Review regression: a job with no controller anywhere must not
+        pay a per-supervisor ledger scan every cmd_poll against the
+        shared rendezvous store — supervisors probe the ONE presence key
+        at a relaxed cadence until a controller marks it."""
+        store = FakeStore()
+        calls = {"seq": 0, "present": 0}
+        real_add, real_check = store.add, store.check
+
+        def counting_add(key, delta):
+            if key == "ctl/seq":
+                calls["seq"] += 1
+            return real_add(key, delta)
+
+        def counting_check(key):
+            if key == "ctl/present":
+                calls["present"] += 1
+            return real_check(key)
+
+        store.add = counting_add
+        store.check = counting_check
+        bus = ControllerCommandBus(store)
+        child = tmp_path / "child.py"
+        child.write_text("import time\ntime.sleep(1.0)\n")
+        sup = self._sup(bus, "trainer-0")
+        assert _quiet(sup.supervise, [sys.executable, str(child)]) == 0
+        # one ledger RPC total (the startup cursor anchor); every poll
+        # tick in between probed only the presence key, and sparsely
+        assert calls["seq"] == 1
+        assert calls["present"] >= 1
+
+    def test_generation_floor_is_net_of_restart_num_base(self, tmp_path,
+                                                         monkeypatch):
+        """Review regression: a supervisor relaunched with a pre-existing
+        RESTART_NUM base must land controller relaunches on the same
+        K*GEN_STRIDE namespace as its base-0 peers — exporting
+        base + K*GEN_STRIDE would split the checkpoint-barrier namespace
+        and every later coordinated save would time out fleet-wide."""
+        monkeypatch.setenv("PADDLE_TPU_ELASTIC_RESTART_NUM", "5")
+        bus = ControllerCommandBus(FakeStore())
+        child = tmp_path / "child.py"
+        child.write_text(_RECORD.replace("{sleep}", "1.2"))
+        out = tmp_path / "out.jsonl"
+        sup = self._sup(bus, "trainer-0")
+        t = threading.Thread(target=_quiet, args=(
+            sup.supervise, [sys.executable, str(child), str(out)]))
+        t.start()
+        time.sleep(0.3)
+        cid = bus.publish({"action": "evict", "host": "trainer-1", "np": 1,
+                           "ranks": {"trainer-0": 0}})
+        t.join(timeout=30)
+        assert not t.is_alive()
+        recs = [json.loads(l) for l in out.read_text().splitlines()]
+        assert len(recs) == 2
+        assert int(recs[0]["gen"]) == 5  # base honored pre-command
+        # the floor is net of the base: K*GEN_STRIDE, not 5 + K*GEN_STRIDE
+        assert int(recs[1]["gen"]) == cid * GEN_STRIDE
+
+    def test_hold_expires_when_controller_dies(self, tmp_path, monkeypatch):
+        """Review regression: readmit and job_done are both published by
+        the controller host — if it dies hard, the held supervisor must
+        escape probation after PADDLE_TPU_CONTROLLER_HOLD_MAX_SEC instead
+        of beating ctl/ready forever."""
+        monkeypatch.setenv("PADDLE_TPU_CONTROLLER_HOLD_MAX_SEC", "0.6")
+        bus = ControllerCommandBus(FakeStore())
+        child = tmp_path / "child.py"
+        child.write_text(_RECORD.replace("{sleep}", "1.0"))
+        out = tmp_path / "out.jsonl"
+        sup = self._sup(bus, "trainer-1")
+        rc = {}
+        t = threading.Thread(target=lambda: rc.setdefault("v", _quiet(
+            sup.supervise, [sys.executable, str(child), str(out)])))
+        t.start()
+        time.sleep(0.3)
+        bus.publish({"action": "evict", "host": "trainer-1", "np": 1,
+                     "ranks": {"trainer-0": 0}})
+        # no readmit and no job_done ever arrive (controller died)
+        t.join(timeout=15)
+        assert not t.is_alive() and rc["v"] == 0
+        assert len(out.read_text().splitlines()) == 1  # held gen never ran
+
+
+class TestBudgetReset:
+    def test_sustained_healthy_window_resets_budget(self, tmp_path):
+        """Satellite: fail, run healthy past the reset window, fail again
+        — the second failure must find a FRESH budget instead of a stale
+        exhausted counter. Generations keep climbing monotonically."""
+        marker = tmp_path / "marker"
+        child = tmp_path / "child.py"
+        child.write_text(
+            "import os, sys, time\n"
+            "m = sys.argv[1]\n"
+            "if not os.path.exists(m):\n"
+            "    open(m, 'w').write('x')\n"
+            "    sys.exit(3)\n"          # first run: instant failure
+            "if os.path.exists(m + '2'):\n"
+            "    sys.exit(0)\n"          # third run: success
+            "open(m + '2', 'w').write('x')\n"
+            "time.sleep(0.5)\n"          # second run: healthy window
+            "sys.exit(3)\n")
+        sup = ElasticSupervisor(max_restarts=1, backoff=0.001,
+                                budget_reset_s=0.3)
+        rc = _quiet(sup.supervise, [sys.executable, str(child), str(marker)])
+        assert rc == 0
+        # restarts were reset after the healthy run: the final counter
+        # only holds the post-reset failure
+        assert sup.restarts == 1
+        assert sup.generation == 2
+        resets = events.recent(50, kind="elastic_budget_reset")
+        assert len(resets) == 1
+        assert resets[0]["restarts_forgiven"] == 1
+
+    def test_zero_disables_reset(self, tmp_path):
+        child = tmp_path / "child.py"
+        child.write_text("import time\ntime.sleep(0.3)\nimport sys\n"
+                         "sys.exit(3)\n")
+        sup = ElasticSupervisor(max_restarts=1, backoff=0.001,
+                                budget_reset_s=0)
+        rc = _quiet(sup.supervise, [sys.executable, str(child)])
+        assert rc == 3  # budget exhausted, never reset
+        assert events.recent(50, kind="elastic_budget_reset") == []
+
+    def test_in_process_run_resets_too(self):
+        calls = {"n": 0}
+
+        def train():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                time.sleep(0.25)
+                raise RuntimeError("flap")
+            return "done"
+
+        sup = ElasticSupervisor(max_restarts=1, backoff=0.001,
+                                budget_reset_s=0.2)
+        assert _quiet(sup.run, train) == "done"
+        assert len(events.recent(50, kind="elastic_budget_reset")) >= 1
+
+    def test_quick_failures_still_exhaust(self, tmp_path):
+        child = tmp_path / "child.py"
+        child.write_text("import sys; sys.exit(5)\n")
+        sup = ElasticSupervisor(max_restarts=1, backoff=0.001,
+                                budget_reset_s=300)
+        assert _quiet(sup.supervise, [sys.executable, str(child)]) == 5
+
+
+class TestValidOnlyResume:
+    def _save(self, mgr, step, poison=False):
+        import jax.numpy as jnp
+        w = np.full((4,), float(step), np.float32)
+        if poison:
+            w[1] = np.nan
+        mgr.save({"network": {"w": jnp.asarray(w)}, "step": step}, step)
+
+    def test_file_layout_skips_nonfinite_blob(self, tmp_path, monkeypatch):
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=10)
+        self._save(mgr, 1)
+        self._save(mgr, 2, poison=True)
+        # default resume: the newest (poisoned) CRC-valid step wins
+        state, step = mgr.load_latest()
+        assert step == 2
+        monkeypatch.setenv("PADDLE_TPU_RESUME_VALID_ONLY", "1")
+        with pytest.warns(UserWarning, match="numerically-invalid"):
+            state, step = mgr.load_latest()
+        assert step == 1
+        assert np.all(np.isfinite(np.asarray(state["network"]["w"])))
+
+    def test_sharded_layout_skips_nonfinite_step(self, tmp_path,
+                                                 monkeypatch):
+        from paddle_tpu.distributed.sharded_checkpoint import (
+            ShardedCheckpointManager)
+        mgr = ShardedCheckpointManager(str(tmp_path), keep_last_n=10)
+        self._save(mgr, 1)
+        self._save(mgr, 2, poison=True)
+        _, step = mgr.load_latest()
+        assert step == 2
+        monkeypatch.setenv("PADDLE_TPU_RESUME_VALID_ONLY", "1")
+        with pytest.warns(UserWarning, match="numerically-invalid"):
+            state, step = mgr.load_latest()
+        assert step == 1
+        skipped = metrics_mod.default_registry().get(
+            "checkpoint_resume_skipped_nonfinite_total")
+        assert skipped.value() >= 1
+
+    def test_latest_valid_path_does_not_pin_resume_cache(self, tmp_path,
+                                                         monkeypatch):
+        """Review regression: under valid-only resume the walk caches the
+        loaded full model state for load_latest's agreed-step reuse — a
+        path-only query (the health-rollback callback path) must not
+        leave that copy pinned on the manager for the rest of the run."""
+        from paddle_tpu.distributed.sharded_checkpoint import (
+            ShardedCheckpointManager)
+        mgr = ShardedCheckpointManager(str(tmp_path), keep_last_n=10)
+        self._save(mgr, 1)
+        self._save(mgr, 2, poison=True)
+        monkeypatch.setenv("PADDLE_TPU_RESUME_VALID_ONLY", "1")
+        with pytest.warns(UserWarning, match="numerically-invalid"):
+            path = mgr.latest_valid_path()
+        assert path == mgr.path_for(1)
+        assert mgr._resume_cache is None
+
+    def test_agreed_step_nonfinite_raises_under_valid_only(
+            self, tmp_path, monkeypatch):
+        """Review regression: when the fleet-agreed resume step is NOT
+        this host's newest valid file, the valid-only guarantee must
+        still hold — a nonfinite local copy raises (supervisor relaunch
+        + renegotiation) instead of silently restoring NaN weights."""
+        from paddle_tpu.distributed.checkpoint import (
+            CheckpointCorruptError, CheckpointManager)
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=10)
+        self._save(mgr, 1, poison=True)
+        self._save(mgr, 2)
+        assert mgr._read_agreed(1)  # default mode: readable
+        monkeypatch.setenv("PADDLE_TPU_RESUME_VALID_ONLY", "1")
+        with pytest.raises(CheckpointCorruptError, match="nonfinite"):
+            mgr._read_agreed(1)
+
+    def test_tree_finite_walks_nested_and_accepts_ints(self):
+        from paddle_tpu.distributed.checkpoint import tree_finite
+        good = {"a": [np.ones(3, np.float32)],
+                "b": {"c": np.arange(4)},  # int leaves never judged
+                "d": "str", "e": 7}
+        assert tree_finite(good)
+        bad = {"a": {"b": [np.asarray([1.0, np.inf], np.float32)]}}
+        assert not tree_finite(bad)
+
+
+class TestFleetHealthAction:
+    """PADDLE_TPU_HEALTH_ACTION=fleet: the monitor reports diverged and
+    DEFERS — the supervisor-side controller owns the response."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_health(self):
+        from paddle_tpu.profiler import health
+        health.reset()
+        yield
+        health.reset()
+
+    def test_fleet_action_pins_diverged_until_relaunch(self):
+        from paddle_tpu.profiler import health
+        mon = health.HealthMonitor(action="fleet", cooldown_steps=0)
+        mon.observe(loss=1.0)
+        mon.observe(loss=float("nan"))
+        assert health.last_status() == "diverged"
+        # clean successors must NOT flap the status back to ok: the
+        # controller's poll cadence would race a one-step excursion
+        for s in range(3, 10):
+            mon.observe(loss=1.0, step=s)
+        assert health.last_status() == "diverged"
+
+    def test_fleet_action_takes_no_local_response(self):
+        from paddle_tpu.profiler import health
+
+        class _Boom:
+            def __getattr__(self, name):  # any rollback/halt use explodes
+                raise AssertionError("fleet action must not act locally")
+
+        mon = health.HealthMonitor(action="fleet", checkpoint=_Boom(),
+                                   cooldown_steps=0)
+        mon.model = _Boom()
+        mon.observe(loss=float("inf"))  # must not touch model/checkpoint
+        assert health.last_status() == "diverged"
+        assert mon.rollbacks == 0
+
+    def test_warn_action_still_rearms_to_ok(self):
+        from paddle_tpu.profiler import health
+        mon = health.HealthMonitor(action="warn", cooldown_steps=0)
+        mon.observe(loss=float("nan"))
+        assert health.last_status() == "diverged"
+        mon.observe(loss=1.0)
+        assert health.last_status() == "ok"
+
+    def test_unknown_action_still_rejected(self):
+        from paddle_tpu.profiler import health
+        with pytest.raises(ValueError, match="fleet"):
+            health.HealthMonitor(action="bogus")

@@ -199,6 +199,7 @@ class TestMetricNamingLint:
         import paddle_tpu.amp  # noqa: F401
         import paddle_tpu.distributed.checkpoint  # noqa: F401
         import paddle_tpu.distributed.collective  # noqa: F401
+        import paddle_tpu.distributed.fleet.controller  # noqa: F401
         import paddle_tpu.distributed.fleet.elastic  # noqa: F401
         import paddle_tpu.distributed.fleet.telemetry  # noqa: F401
         import paddle_tpu.distributed.ps.cache  # noqa: F401
@@ -267,6 +268,15 @@ class TestMetricNamingLint:
         _at._M_TUNES.inc(op="lint_op")
         _at._M_PROBE_SECONDS.observe(0.001, op="lint_op")
         _at._M_CHOSEN.set(1.0, op="lint_op", config="q256-k512")
+        # self-driving fleet controller families: decisions (policy=,
+        # outcome=), per-action counters (host=), relaunch-to-first-step
+        # gauge (policy=)
+        from paddle_tpu.distributed.fleet import controller as _ctl
+        _ctl._M_DECISIONS.inc(policy="straggler_evict", outcome="applied")
+        _ctl._M_EVICTIONS.inc(host="trainer-1")
+        _ctl._M_ROLLBACKS.inc(host="trainer-1")
+        _ctl._M_READMISSIONS.inc(host="trainer-1")
+        _ctl._M_FIRST_STEP.set(1.5, policy="straggler_evict")
         reg = metrics.default_registry()
         problems = []
         for name in reg.names():

@@ -1032,3 +1032,167 @@ class TestScaleAwareGate:
                                    "platform": "tpu", "scale": "ci"}}}
         rows = gate.compare(base, cur, 0.05)
         assert rows[0][5] == "regressed"
+
+
+class TestControllerGate:
+    """`controller_*` metric families and `controller_decision` events in
+    observability blocks (self-driving fleet satellite): kind/label/shape
+    contracts with named violations."""
+
+    @staticmethod
+    def _doc(metrics=None, events=None):
+        doc = {"configs": {"gpt": {"tokens_per_sec_chip": 1.0}},
+               "observability": {}}
+        if metrics is not None:
+            doc["observability"]["metrics"] = metrics
+        if events is not None:
+            doc["observability"]["events_tail"] = events
+        return doc
+
+    @staticmethod
+    def _decision(**over):
+        ev = {"ts": 12.0, "kind": "controller_decision", "host": "sup-0",
+              "severity": "warn", "policy": "straggler_evict",
+              "action": "evict", "target": "trainer-1",
+              "outcome": "applied", "decision": 1, "np": 1,
+              "evidence": {"windows": 3, "p50_s": 0.4}, "dry_run": False}
+        ev.update(over)
+        return ev
+
+    def test_valid_controller_metrics_and_event_pass(self):
+        metrics = {
+            "controller_decisions_total": {"kind": "counter", "values": [
+                {"labels": {"policy": "straggler_evict",
+                            "outcome": "applied"}, "value": 1}]},
+            "controller_evictions_total": {"kind": "counter", "values": [
+                {"labels": {"host": "trainer-1"}, "value": 1}]},
+            "controller_relaunch_to_first_step_seconds": {
+                "kind": "gauge", "values": [
+                    {"labels": {"policy": "straggler_evict"},
+                     "value": 2.5}]},
+        }
+        doc = self._doc(metrics=metrics, events=[self._decision()])
+        assert gate.validate_observability(doc) == []
+
+    def test_live_registry_snapshot_passes(self):
+        from paddle_tpu.profiler import metrics as metrics_mod
+        from paddle_tpu.distributed.fleet import controller as ctl
+        ctl._M_DECISIONS.inc(policy="health_rollback", outcome="dry_run")
+        ctl._M_ROLLBACKS.inc(host="trainer-0")
+        snap = metrics_mod.default_registry().snapshot()
+        ctl_fams = {k: v for k, v in snap.items()
+                    if k.startswith("controller_")}
+        assert ctl_fams
+        assert gate.validate_observability(self._doc(metrics=ctl_fams)) == []
+
+    def test_unknown_family_and_wrong_kind_named(self):
+        metrics = {
+            "controller_bogus_total": {"kind": "counter", "values": []},
+            "controller_evictions_total": {"kind": "gauge", "values": []},
+        }
+        blob = "\n".join(gate.validate_observability(self._doc(
+            metrics=metrics)))
+        assert "controller_bogus_total" in blob and "unknown" in blob
+        assert "controller_evictions_total" in blob and "gauge" in blob
+
+    def test_missing_label_bad_outcome_negative_value_named(self):
+        metrics = {
+            "controller_decisions_total": {"kind": "counter", "values": [
+                {"labels": {"policy": "straggler_evict",
+                            "outcome": "exploded"}, "value": 1},
+                {"labels": {"outcome": "applied"}, "value": -3},
+            ]},
+        }
+        blob = "\n".join(gate.validate_observability(self._doc(
+            metrics=metrics)))
+        assert "'exploded'" in blob
+        assert "missing the 'policy' label" in blob
+        assert "-3" in blob
+
+    def test_decision_event_contract_violations_named(self):
+        bad = [
+            self._decision(outcome="maybe"),
+            self._decision(decision=0),
+            self._decision(policy=""),
+            self._decision(evidence="not-an-object"),
+        ]
+        blob = "\n".join(gate.validate_observability(self._doc(events=bad)))
+        assert "'maybe'" in blob
+        assert "'decision' must be a positive integer" in blob
+        assert "'policy' must be a non-empty string" in blob
+        assert "'evidence' must be an object" in blob
+
+    def test_non_decision_events_not_held_to_decision_contract(self):
+        ev = {"ts": 1.0, "kind": "elastic_restart", "host": "sup-0",
+              "severity": "warn", "reason": "controller_evict"}
+        assert gate.validate_observability(self._doc(events=[ev])) == []
+
+
+class TestObsTailController:
+    """obs_tail --controller: filter + operator rendering of the fleet
+    controller's decision events."""
+
+    @staticmethod
+    def _write(tmp_path):
+        path = tmp_path / "ev.jsonl"
+        recs = [
+            {"ts": 10.0, "kind": "retrace", "host": "t0", "name": "mm"},
+            {"ts": 11.0, "kind": "controller_decision", "host": "sup-0",
+             "severity": "warn", "policy": "straggler_evict",
+             "action": "evict", "target": "trainer-1", "outcome": "applied",
+             "decision": 1, "np": 1,
+             "evidence": {"windows": 3, "p50_s": 0.41,
+                          "straggling": ["trainer-1"]}, "dry_run": False},
+            {"ts": 12.0, "kind": "controller_decision", "host": "sup-0",
+             "severity": "info", "policy": "straggler_evict",
+             "action": "relaunch_observed", "outcome": "applied",
+             "decision": 1, "relaunch_to_first_step_s": 2.75,
+             "dry_run": False},
+            {"ts": 13.0, "kind": "controller_decision", "host": "sup-0",
+             "severity": "warn", "policy": "health_rollback",
+             "action": "rollback", "target": "trainer-0",
+             "outcome": "dry_run", "decision": 2, "np": 2,
+             "evidence": {"diverged": ["trainer-0"]}, "dry_run": True},
+        ]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        return str(path)
+
+    def test_controller_filters_and_renders(self, tmp_path, capsys):
+        import obs_tail
+        rc = obs_tail.main([self._write(tmp_path), "--controller"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "retrace" not in out          # filtered to decisions
+        assert "straggler_evict" in out
+        assert "target=trainer-1" in out and "windows=3" in out
+        assert "relaunch→first-step 2.75s" in out
+        assert "DRY-RUN" in out              # the dry-run rollback line
+        assert "health_rollback" in out
+
+    def test_controller_composes_with_health(self, tmp_path, capsys):
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps({"ts": 1.0, "kind": "health_alert",
+                                "host": "t0", "signal": "loss_spike"}) + "\n")
+            f.write(json.dumps({"ts": 2.0, "kind": "controller_decision",
+                                "host": "sup-0", "policy": "health_rollback",
+                                "action": "rollback", "outcome": "applied",
+                                "decision": 3}) + "\n")
+        rc = obs_tail.main([str(path), "--controller", "--health"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "loss_spike" in out
+        assert "health_rollback" in out and "decision #3" in out
+
+    def test_controller_respects_explicit_kind(self, tmp_path, capsys):
+        import obs_tail
+        rc = obs_tail.main([self._write(tmp_path), "--controller",
+                            "--kind", "retrace"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        # explicit --kind composes: retraces AND decisions both stream
+        assert "retrace" in out
+        assert "straggler_evict" in out

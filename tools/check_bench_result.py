@@ -439,6 +439,88 @@ def _validate_autotune_metrics(where: str, metrics: dict) -> List[str]:
     return problems
 
 
+# fleet-controller metric families: name -> (kind, required labels).
+_CONTROLLER_FAMILIES = {
+    "controller_decisions_total": ("counter", ("policy", "outcome")),
+    "controller_evictions_total": ("counter", ("host",)),
+    "controller_rollbacks_total": ("counter", ("host",)),
+    "controller_readmissions_total": ("counter", ("host",)),
+    "controller_relaunch_to_first_step_seconds": ("gauge", ("policy",)),
+}
+
+#: legal controller_decision outcomes (the decision contract)
+_CONTROLLER_OUTCOMES = ("applied", "dry_run", "failed")
+
+
+def _validate_controller_metrics(where: str, metrics: dict) -> List[str]:
+    """`controller_*` families must be the documented kind, carry their
+    required labels, and hold non-negative values — the self-driving
+    fleet's observability contract."""
+    problems = []
+    for name, fam in metrics.items():
+        if not name.startswith("controller_"):
+            continue
+        spec = _CONTROLLER_FAMILIES.get(name)
+        if spec is None:
+            problems.append(f"{where}.metrics.{name}: unknown controller "
+                            f"family (expected one of "
+                            f"{sorted(_CONTROLLER_FAMILIES)})")
+            continue
+        kind, req_labels = spec
+        if not isinstance(fam, dict) or fam.get("kind") != kind:
+            problems.append(
+                f"{where}.metrics.{name}: kind "
+                f"{fam.get('kind') if isinstance(fam, dict) else fam!r}"
+                f", expected {kind}")
+            continue
+        for i, v in enumerate(fam.get("values") or []):
+            if not isinstance(v, dict):
+                problems.append(f"{where}.metrics.{name}[{i}] is not a "
+                                f"series object")
+                continue
+            val = v.get("value")
+            if not isinstance(val, (int, float)) or isinstance(val, bool) \
+                    or val != val or val < 0:
+                problems.append(f"{where}.metrics.{name}[{i}]: value "
+                                f"{val!r} is not a non-negative number")
+            labels = v.get("labels") or {}
+            for lk in req_labels:
+                if lk not in labels:
+                    problems.append(f"{where}.metrics.{name}[{i}]: series "
+                                    f"missing the {lk!r} label")
+            if name == "controller_decisions_total" \
+                    and labels.get("outcome") not in _CONTROLLER_OUTCOMES:
+                problems.append(
+                    f"{where}.metrics.{name}[{i}]: outcome "
+                    f"{labels.get('outcome')!r} not in "
+                    f"{_CONTROLLER_OUTCOMES}")
+    return problems
+
+
+def _validate_controller_decision(where: str, ev: dict) -> List[str]:
+    """Beyond the generic event schema, a `controller_decision` event
+    must carry the decision contract: policy, action, a legal outcome,
+    and a decision id — the fields operators and tooling key on."""
+    problems = []
+    if not isinstance(ev.get("policy"), str) or not ev.get("policy"):
+        problems.append(f"{where}: 'policy' must be a non-empty string, "
+                        f"got {ev.get('policy')!r}")
+    if not isinstance(ev.get("action"), str) or not ev.get("action"):
+        problems.append(f"{where}: 'action' must be a non-empty string, "
+                        f"got {ev.get('action')!r}")
+    if ev.get("outcome") not in _CONTROLLER_OUTCOMES:
+        problems.append(f"{where}: 'outcome' {ev.get('outcome')!r} not in "
+                        f"{_CONTROLLER_OUTCOMES}")
+    dec = ev.get("decision")
+    if not isinstance(dec, int) or isinstance(dec, bool) or dec < 1:
+        problems.append(f"{where}: 'decision' must be a positive integer "
+                        f"id, got {dec!r}")
+    if "evidence" in ev and not isinstance(ev["evidence"], dict):
+        problems.append(f"{where}: 'evidence' must be an object, got "
+                        f"{type(ev['evidence']).__name__}")
+    return problems
+
+
 def _validate_autotune_block(where: str, at: dict) -> List[str]:
     """A bench `autotune` block (per config, and the summary under
     `observability.autotune`): enabled flag, event-count deltas, and the
@@ -624,8 +706,10 @@ def _validate_device_memory_metrics(where: str, metrics: dict) -> List[str]:
 def validate_observability(doc: dict) -> List[str]:
     """Schema problems in the document's observability sections (empty =
     valid). step_records must conform to the step-record contract,
-    events/events_tail to the event contract, `checkpoint_async_*` /
-    `device_memory_*` / `health_*` / `amp_*` / `autotune_*` metric
+    events/events_tail to the event contract (`controller_decision`
+    events additionally to the decision contract: policy/action/legal
+    outcome/decision id), `checkpoint_async_*` / `device_memory_*` /
+    `health_*` / `amp_*` / `autotune_*` / `controller_*` metric
     families to their kind/label/shape contracts, `device_time` blocks to
     the per-op row shape with a known provenance label (estimate /
     measured / xplane), `health` blocks to the sentinel-overhead shape,
@@ -659,6 +743,7 @@ def validate_observability(doc: dict) -> List[str]:
             problems.extend(_validate_device_memory_metrics(where, metrics))
             problems.extend(_validate_health_metrics(where, metrics))
             problems.extend(_validate_autotune_metrics(where, metrics))
+            problems.extend(_validate_controller_metrics(where, metrics))
         at = obs.get("autotune")
         if at is not None:
             problems.extend(_validate_autotune_block(f"{where}.autotune",
@@ -691,6 +776,11 @@ def validate_observability(doc: dict) -> List[str]:
                     validate_event(ev)
                 except ValueError as e:
                     problems.append(f"{where}.{key}[{i}]: {e}")
+                    continue
+                if isinstance(ev, dict) \
+                        and ev.get("kind") == "controller_decision":
+                    problems.extend(_validate_controller_decision(
+                        f"{where}.{key}[{i}]", ev))
     return problems
 
 

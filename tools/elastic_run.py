@@ -34,11 +34,29 @@ from the old world size in a long-lived `--host-store` rendezvous store
 cannot wedge the new fleet's watch. The classic per-host file layout
 still requires relaunching with the SAME --np.
 
+Self-driving fleet (`--controller[=dry-run]`, pass on exactly ONE host,
+normally rank 0): the supervisor runs the FleetController
+(`distributed/fleet/controller.py`) on a background aggregator poll —
+a confirmed persistent straggler is EVICTED (every supervisor relaunches
+its trainer at N-1 with re-densified ranks, resuming from the newest
+fleet-committed step via the sharded re-sharding restore, while the
+evicted host's supervisor holds its trainer on probation) and READMITTED
+once its probation heartbeat has been fresh for the cooldown; one host's
+`diverged` health status escalates to a fleet-wide coordinated ROLLBACK
+(hard kill + relaunch with PADDLE_TPU_RESUME_VALID_ONLY=1 so every host
+restores the same last numerically-valid committed step). Every
+supervisor of a >=2 fleet subscribes to the command ledger
+automatically; `--controller=dry-run` logs `controller_decision` events
+without acting. Controller actions never consume the restart budget.
+
 Knobs (flags override env): --max-restarts / PADDLE_TPU_ELASTIC_MAX_RESTARTS
 (default 3), --backoff / PADDLE_TPU_ELASTIC_BACKOFF (base seconds, doubled
 per restart, capped by PADDLE_TPU_ELASTIC_BACKOFF_MAX), --ttl /
-PADDLE_ELASTIC_TTL (heartbeat staleness). Restarts land in
-`elastic_restarts_total{reason=}`.
+PADDLE_ELASTIC_TTL (heartbeat staleness),
+PADDLE_TPU_ELASTIC_BUDGET_RESET_SEC (sustained-healthy budget reset),
+PADDLE_TPU_CONTROLLER_{CONFIRM_WINDOWS,READMIT_SEC,POLL_SEC,MIN_WORLD}.
+Restarts land in `elastic_restarts_total{reason=}`; decisions in
+`controller_decisions_total{policy=,outcome=}`.
 """
 from __future__ import annotations
 
@@ -62,6 +80,17 @@ def parse_args(argv=None):
     p.add_argument("--watch", action="store_true",
                    help="watch fleet membership and restart the local "
                         "trainer when a peer's heartbeat goes stale")
+    p.add_argument("--controller", nargs="?", const="on", default=None,
+                   choices=["on", "dry-run"],
+                   help="run the self-driving fleet controller in THIS "
+                        "supervisor (pass it on exactly one host, "
+                        "normally rank 0): consume fleet digests + "
+                        "health/straggler signals and act — evict a "
+                        "confirmed straggler (fleet relaunches at N-1, "
+                        "scales back on readmission), escalate one "
+                        "host's divergence to a fleet-wide rollback. "
+                        "--controller=dry-run logs every decision "
+                        "without acting")
     p.add_argument("--np", type=int, default=None,
                    help="fleet size (exported to the trainer as "
                         "PADDLE_TRAINERS_NUM; also the --watch quorum; "
@@ -180,20 +209,23 @@ def main(argv=None) -> int:
     # digest. The aggregator is built EXPLICITLY from --master (never by
     # mutating this process's env — main() may run in-process and env
     # leaks would rewrite the trainer contract of everything after it).
+    # The fleet CONTROLLER needs the aggregator too, with or without an
+    # observability server.
+    agg = None
+    if args.np > 1 and (args.controller
+                        or os.environ.get("PADDLE_TPU_METRICS_PORT", "")):
+        try:
+            from paddle_tpu.distributed.fleet.telemetry import (
+                FleetAggregator)
+            from paddle_tpu.distributed.store import TCPStore
+            agg = FleetAggregator(
+                TCPStore(host, int(port), timeout=10), args.np)
+        except Exception as e:
+            print(f"[elastic_run] fleet aggregation unavailable: "
+                  f"{e}", file=sys.stderr)
     if os.environ.get("PADDLE_TPU_METRICS_PORT", ""):
         try:
             from paddle_tpu.profiler import server as _obs_server
-            agg = None
-            if args.np > 1:
-                try:
-                    from paddle_tpu.distributed.fleet.telemetry import (
-                        FleetAggregator)
-                    from paddle_tpu.distributed.store import TCPStore
-                    agg = FleetAggregator(
-                        TCPStore(host, int(port), timeout=10), args.np)
-                except Exception as e:
-                    print(f"[elastic_run] fleet aggregation unavailable: "
-                          f"{e}", file=sys.stderr)
             _obs_server.maybe_start_server(role="supervisor",
                                            aggregator=agg)
         except Exception as e:
@@ -222,18 +254,101 @@ def main(argv=None) -> int:
                                     master=f"{host}:{port}", np=args.np)
         member_mgr.join()
 
+    # self-driving fleet: every supervisor of a >=2 fleet subscribes to
+    # the controller command ledger (evict / readmit / rollback); the
+    # host given --controller ALSO runs the decision loop on a background
+    # aggregator poll (so detection never depends on an external scraper)
+    bus = None
+    controller = None
+    if args.np > 1 and endpoint:
+        try:
+            from paddle_tpu.distributed.fleet.controller import (
+                ControllerCommandBus)
+            from paddle_tpu.distributed.store import TCPStore
+            # own connection: the native store client is one socket and
+            # the supervisor polls commands from its child-wait loop
+            bus = ControllerCommandBus(TCPStore(host, int(port), timeout=10))
+        except Exception as e:
+            print(f"[elastic_run] controller command bus unavailable: {e}",
+                  file=sys.stderr)
+    if args.controller:
+        if agg is None or bus is None:
+            print("[elastic_run] --controller needs a >=2 fleet with "
+                  "--rank/$PADDLE_CURRENT_ENDPOINT and a reachable "
+                  "rendezvous store", file=sys.stderr)
+            if server is not None:
+                server.stop()
+            return 2
+        from paddle_tpu.distributed.fleet.controller import (
+            controller_from_env)
+        from paddle_tpu.distributed.store import TCPStore
+        # the controller publishes from the aggregator's poll thread —
+        # give it a bus on its OWN connection, distinct from the one the
+        # supervisor polls in the child-wait loop
+        controller = controller_from_env(
+            agg, TCPStore(host, int(port), timeout=10),
+            world_size=args.np, dry_run=(args.controller == "dry-run"))
+        agg.start_polling(hook=controller.on_collect)
+        print(f"[elastic_run] fleet controller active "
+              f"({'dry-run' if controller.dry_run else 'acting'}, "
+              f"confirm_windows={controller.confirm_windows})",
+              file=sys.stderr)
+
     # the id the LOCAL trainer registers under: exclude it from the
     # membership watch — the supervisor monitors its own child by process
     # exit, and the child's restart gap must not read as a stale fleet
     # member (that would re-SIGTERM the fresh relaunch)
     sup = ElasticSupervisor(max_restarts=args.max_restarts,
                             backoff=args.backoff, manager=manager,
-                            self_member=endpoint)
+                            self_member=endpoint, commands=bus)
+
+    def on_fleet_change(cmd, held):
+        """A controller command changed the fleet contract: re-join
+        membership under the NEW fleet-size namespace (membership keys
+        are namespaced by np, so the old world's registrations cannot
+        wedge the new one's watch). A held (evicted) host leaves
+        membership entirely until readmission."""
+        nonlocal manager, member_mgr
+        if not args.watch:
+            return
+        new_np = int(cmd.get("np") or args.np)
+        if member_mgr is not None:
+            try:
+                member_mgr.exit()
+            except Exception:
+                pass
+            member_mgr = None
+        manager = None
+        sup.manager = None
+        if held:
+            return
+        manager = ElasticManager(host_id=f"supervisor-{os.getpid()}",
+                                 master=f"{host}:{port}", np=new_np)
+        member_mgr = ElasticManager(host_id=endpoint,
+                                    master=f"{host}:{port}", np=new_np)
+        member_mgr.join()
+        sup.manager = manager
+
+    sup.on_fleet_change = on_fleet_change
     rc = 1
     try:
         rc = sup.supervise(args.cmd, env=env)
         return rc
     finally:
+        if controller is not None:
+            # held peers poll ctl/job_done to exit cleanly once the fleet
+            # is finished (with or without them)
+            try:
+                controller.bus.mark_job_done()
+            except Exception:
+                pass
+            try:
+                agg.stop_polling()
+            except Exception:
+                pass
+            from paddle_tpu.distributed.fleet.controller import (
+                set_controller)
+            set_controller(None)
         if member_mgr is not None:
             if rc == 0:
                 member_mgr.exit()  # clean deregistration (done-flag is set)

@@ -14,6 +14,7 @@ severity + kind-specific payload). This renders that stream for operators:
     python tools/obs_tail.py events.jsonl --json --kind fleet_straggler
     python tools/obs_tail.py events.jsonl --diagnose       # step_diagnosis
     python tools/obs_tail.py events.jsonl --health         # numerics plane
+    python tools/obs_tail.py events.jsonl --controller     # fleet decisions
     cat events.jsonl | python tools/obs_tail.py -
 
 `--diagnose` renders `step_diagnosis` events (the runtime's step-slowness
@@ -198,8 +199,41 @@ def format_health(rec: dict) -> str:
             f"{rec.get('host', '?'):<16}{step} {detail}")
 
 
+def format_controller(rec: dict) -> str:
+    """One controller_decision event as an operator line: which policy
+    fired, on what evidence, what it did, and whether it acted."""
+    ts = rec.get("ts")
+    try:
+        when = datetime.fromtimestamp(float(ts)).strftime("%H:%M:%S.%f")[:-3]
+    except (TypeError, ValueError, OSError):
+        when = "??:??:??.???"
+    policy = rec.get("policy", "?")
+    outcome = rec.get("outcome", "?")
+    if rec.get("action") == "relaunch_observed":
+        detail = (f"decision #{rec.get('decision')} fleet resumed: "
+                  f"relaunch→first-step "
+                  f"{rec.get('relaunch_to_first_step_s')}s")
+    else:
+        ev = rec.get("evidence") or {}
+        bits = [f"action={rec.get('action', '?')}"]
+        if rec.get("target"):
+            bits.append(f"target={rec['target']}")
+        if rec.get("np") is not None:
+            bits.append(f"np→{rec['np']}")
+        for k in ("windows", "p50_s", "diverged", "held_s", "ready_age_s"):
+            if ev.get(k) is not None:
+                v = ev[k]
+                bits.append(f"{k}={json.dumps(v) if isinstance(v, (list, dict)) else v}")
+        if rec.get("dry_run"):
+            bits.append("DRY-RUN")
+        detail = (f"decision #{rec.get('decision')} "
+                  f"[{outcome}] " + " ".join(bits))
+    return (f"{when} {rec.get('severity', 'info'):<5} "
+            f"{policy:<20} {rec.get('host', '?'):<16} {detail}")
+
+
 def _emit(events, as_json: bool, out=None, diagnose: bool = False,
-          health: bool = False):
+          health: bool = False, controller: bool = False):
     out = out if out is not None else sys.stdout  # resolve at call time
     for rec in events:
         if as_json:
@@ -208,6 +242,8 @@ def _emit(events, as_json: bool, out=None, diagnose: bool = False,
             line = format_diagnosis(rec)
         elif health and rec.get("kind") in HEALTH_KINDS:
             line = format_health(rec)
+        elif controller and rec.get("kind") == "controller_decision":
+            line = format_controller(rec)
         else:
             line = format_event(rec)
         out.write(line + "\n")
@@ -222,6 +258,7 @@ def follow(path: str, args, poll_s: float = 0.5,
     t0 = time.monotonic()
     diagnose = getattr(args, "diagnose", False)
     health = getattr(args, "health", False)
+    controller = getattr(args, "controller", False)
     # open the live file FIRST and read the backlog through the same
     # handle: reading a snapshot and then seeking a fresh handle to EOF
     # would silently drop events appended in between
@@ -239,7 +276,7 @@ def follow(path: str, args, poll_s: float = 0.5,
               if event_matches(e, args.kind, args.host,
                                args.min_severity, args.since_ts)]
     _emit(window[-args.n:] if args.n else window, args.json,
-          diagnose=diagnose, health=health)
+          diagnose=diagnose, health=health, controller=controller)
     try:
         while True:
             if max_s is not None and time.monotonic() - t0 >= max_s:
@@ -261,7 +298,8 @@ def follow(path: str, args, poll_s: float = 0.5,
             _emit([r for r in recs
                    if event_matches(r, args.kind, args.host,
                                     args.min_severity, args.since_ts)],
-                  args.json, diagnose=diagnose, health=health)
+                  args.json, diagnose=diagnose, health=health,
+                  controller=controller)
     except KeyboardInterrupt:
         return 0
     finally:
@@ -296,6 +334,11 @@ def main(argv=None) -> int:
                          "health_alert, health_rollback, fleet_health) "
                          "with an operator-oriented rendering; filters to "
                          "those kinds unless --kind is given")
+    ap.add_argument("--controller", action="store_true",
+                    help="show fleet-controller decisions "
+                         "(controller_decision: policy, evidence, action, "
+                         "outcome) with an operator-oriented rendering; "
+                         "filters to that kind unless --kind is given")
     ap.add_argument("--json", action="store_true",
                     help="emit matching events as raw JSONL instead of the "
                          "human format")
@@ -309,6 +352,14 @@ def main(argv=None) -> int:
         # --health --diagnose together: health events AND the step
         # decomposition in one stream
         args.kind = HEALTH_KINDS + ("step_diagnosis",)
+    if args.controller:
+        # composes with --health/--diagnose: decisions join the stream
+        if args.kind is None:
+            args.kind = "controller_decision"
+        elif isinstance(args.kind, tuple):
+            args.kind = args.kind + ("controller_decision",)
+        elif args.kind != "controller_decision":
+            args.kind = (args.kind, "controller_decision")
 
     if args.follow:
         if args.path == "-":
@@ -345,7 +396,8 @@ def main(argv=None) -> int:
                 if event_matches(e, args.kind, args.host,
                                  args.min_severity, args.since_ts)]
     _emit(matching[-args.n:] if args.n else matching, args.json,
-          diagnose=args.diagnose, health=args.health)
+          diagnose=args.diagnose, health=args.health,
+          controller=args.controller)
     return 0
 
 

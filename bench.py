@@ -623,6 +623,166 @@ def _conv_fusion_micro_ab(B=128, dtype_bytes=2):
     return out
 
 
+def _paged_vs_dense_ab(model, ctxs, page_size, n_tokens=8, dense_iters=3):
+    """Per-token decode cost, paged vs cacheless, at growing context.
+
+    Paged side: ONE ServingEngine (one compiled decode executable over a
+    fixed page-pool shape) decodes `n_tokens` after prefilling a
+    `ctx`-token prompt — per-token wall from the engine's decode-phase
+    clock (prefill + compiles excluded). Dense side: one jitted FULL
+    forward over the `ctx`-token sequence (what a cacheless decoder pays
+    for every token at that context), timed after its own warmup. The
+    acceptance read: paged stays ~flat as ctx grows, dense grows with
+    it. Never raises."""
+    import jax
+    import numpy as np
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.jit import functionalize
+
+    rng = np.random.default_rng(7)
+    vocab = model.cfg.vocab_size
+    max_len = max(ctxs) + n_tokens + 1
+    eng = ServingEngine(model, max_batch=1, max_len=max_len,
+                        page_size=page_size, name="paged_ab")
+    # warm the decode executable (and one prefill bucket) out of the clock
+    eng.submit(rng.integers(1, vocab, (8,)).tolist(), max_new_tokens=2)
+    eng.run_until_idle()
+    apply_fn, params, buffers = functionalize(model)
+    dense_jit = jax.jit(lambda p, b, x: apply_fn(p, b, None, x)[0])
+    rows = []
+    for ctx in ctxs:
+        prompt = rng.integers(1, vocab, (ctx,)).tolist()
+        w0, t0 = eng.stats["decode_wall_s"], eng.stats["decode_tokens"]
+        eng.submit(prompt, max_new_tokens=n_tokens)
+        eng.run_until_idle()
+        dw = eng.stats["decode_wall_s"] - w0
+        dt = eng.stats["decode_tokens"] - t0
+        paged_ms = 1000.0 * dw / max(dt, 1)
+        import jax.numpy as jnp
+        jnp_ids = jnp.asarray(np.asarray([prompt], np.int32))
+        jax.block_until_ready(dense_jit(params, buffers, jnp_ids))  # compile
+        td = time.perf_counter()
+        for _ in range(dense_iters):
+            jax.block_until_ready(dense_jit(params, buffers, jnp_ids))
+        dense_ms = 1000.0 * (time.perf_counter() - td) / dense_iters
+        rows.append({"ctx": int(ctx),
+                     "paged_ms_per_token": round(paged_ms, 3),
+                     "dense_ms_per_token": round(dense_ms, 3)})
+    out = {"rows": rows, "decode_tokens_per_ctx": n_tokens,
+           "note": ("paged: one fixed decode executable over the page "
+                    "pool, per-token wall at the given prefilled "
+                    "context; dense: one jitted full forward over the "
+                    "ctx-token sequence = the cacheless cost of ONE "
+                    "token at that context")}
+    if len(rows) >= 2 and rows[0]["paged_ms_per_token"] > 0 \
+            and rows[0]["dense_ms_per_token"] > 0:
+        out["paged_growth"] = round(rows[-1]["paged_ms_per_token"]
+                                    / rows[0]["paged_ms_per_token"], 3)
+        out["dense_growth"] = round(rows[-1]["dense_ms_per_token"]
+                                    / rows[0]["dense_ms_per_token"], 3)
+        if rows[-1]["paged_ms_per_token"] > 0:
+            out["speedup_at_max_ctx"] = round(
+                rows[-1]["dense_ms_per_token"]
+                / rows[-1]["paged_ms_per_token"], 3)
+    return out
+
+
+def bench_gpt2_decode():
+    """Autoregressive-decode serving bench: hundreds of concurrent
+    simulated streams through the continuous-batching engine
+    (inference/serving.py) over the paged KV cache — tokens/s/chip,
+    p50/p99 TTFT/TPOT, goodput, and the paged-vs-dense per-token A/B.
+    The decode analogue of the train-step configs."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    paddle.seed(0)
+    if _SCALE == "ci":
+        cfg = GPTConfig(vocab_size=8192, max_position_embeddings=512,
+                        hidden_size=128, num_layers=2, num_heads=4,
+                        dropout=0.0, attn_dropout=0.0)
+        max_batch, max_len, page_size = 4, 160, 8
+        streams, max_new = 24, 10
+        prompt_lo, prompt_hi = 6, 48
+        ab_ctxs, ab_tokens = (32, 64, 128), 6
+    else:
+        cfg = GPTConfig.gpt2_small()
+        cfg.dropout = cfg.attn_dropout = 0.0
+        max_batch, max_len, page_size = 32, 1024, 16
+        streams, max_new = 512, 64
+        prompt_lo, prompt_hi = 32, 512
+        ab_ctxs, ab_tokens = (128, 512, 960), 16
+    model = GPT(cfg)
+    model.eval()
+    eng = ServingEngine(model, max_batch=max_batch, max_len=max_len,
+                        page_size=page_size, name="gpt2_decode")
+    rng = np.random.default_rng(0)
+    reqs = []
+    t0 = time.perf_counter()
+    for _ in range(streams):
+        plen = int(rng.integers(prompt_lo, prompt_hi))
+        reqs.append(eng.submit(
+            rng.integers(1, cfg.vocab_size, (plen,)).tolist(),
+            max_new_tokens=max_new))
+    eng.run_until_idle(max_iterations=streams * (max_new + 4) + 1000)
+    wall = time.perf_counter() - t0
+    ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+    tpots = [r.tpot_s for r in reqs if r.tpot_s is not None]
+    goodput = sum(len(r.generated) for r in reqs)
+    st = eng.status()["stats"]
+
+    def _pct(vals, q):
+        return round(float(np.percentile(vals, q)), 4) if vals else None
+
+    # serving metric families from the live registry, scoped to this
+    # config's observability block (check_bench_result validates them)
+    obs = {}
+    try:
+        from paddle_tpu.profiler import metrics as _metrics
+        snap = _metrics.default_registry().snapshot()
+        obs["metrics"] = {k: v for k, v in snap.items()
+                          if k.startswith("serving_")}
+    except Exception as e:
+        obs["metrics_error"] = f"{type(e).__name__}: {e}"
+    ab = {}
+    try:
+        ab = _paged_vs_dense_ab(model, ab_ctxs, page_size,
+                                n_tokens=ab_tokens)
+    except Exception as e:
+        ab = {"error": f"{type(e).__name__}: {e}"}
+    return {
+        "name": (f"gpt-decode {cfg.num_layers}L-h{cfg.hidden_size} "
+                 f"continuous batching b{max_batch} x {streams} streams "
+                 f"(paged KV, page={page_size}, max_len={max_len})"),
+        "platform": _platform(),
+        "scale": _SCALE,
+        "streams": streams,
+        "max_new_tokens": max_new,
+        "tokens_per_sec_chip": round(goodput / wall, 1),
+        "decode_tokens_per_sec": (
+            round(st["decode_tokens"] / st["decode_wall_s"], 1)
+            if st["decode_wall_s"] else None),
+        "goodput_tokens": int(goodput),
+        "completed": int(st["completed"]),
+        "preemptions": int(st["preemptions"]),
+        "batch_occupancy_mean": (
+            round(st["decode_tokens"] / max(st["iterations"], 1), 2)),
+        "serving": {
+            "ttft_s": {"p50": _pct(ttfts, 50), "p99": _pct(ttfts, 99)},
+            "tpot_s": {"p50": _pct(tpots, 50), "p99": _pct(tpots, 99)},
+            "wall_s": round(wall, 2),
+            "prefill_buckets": eng.status()["prefill_buckets"],
+            "note": ("TTFT includes queue wait + bucketed prefill (and, "
+                     "for early requests, one-time executable compiles); "
+                     "TPOT is per finished request, first->last token"),
+        },
+        "paged_vs_dense": ab,
+        "observability": obs,
+    }
+
+
 def bench_resnet50(B=None, hw=None, depth=50, probe_iters=None):
     """Synthetic-ImageNet ResNet train step (BASELINE.md primary metric).
     The size knobs exist so the harness tests can exercise the full probe/
@@ -1240,6 +1400,7 @@ def main(argv=None):
     # EVERY config — including the flagship — inside the guard: one failure
     # must not sink the whole bench (the round-3 lesson).
     for fn, key in ((bench_gpt2, "gpt2_small"),
+                    (bench_gpt2_decode, "gpt2_decode"),
                     (bench_resnet50, "resnet50"),
                     (bench_bert_base, "bert_base_seq128"),
                     (bench_wide_deep_ps, "wide_deep_ps"),

@@ -88,8 +88,9 @@ PRESENT_KEY = "ctl/present"
 _REG = _metrics_mod.default_registry()
 _M_DECISIONS = _REG.counter(
     "controller_decisions_total",
-    "fleet-controller decisions, by policy (straggler_evict / readmit / "
-    "health_rollback) and outcome (applied / dry_run / failed)")
+    "fleet-controller decisions, by policy (straggler_evict / "
+    "straggler_skip / readmit / health_rollback) and outcome (applied / "
+    "dry_run / failed)")
 _M_EVICTIONS = _REG.counter(
     "controller_evictions_total",
     "straggler evictions the controller actually published, by host")
@@ -403,6 +404,23 @@ class FleetController:
                 continue
             if self._streaks[host] < self.confirm_windows:
                 continue
+            # diagnosis-aware evidence (ROADMAP item-3 follow-up): a
+            # straggler whose own step_diagnosis names data_wait as the
+            # dominant wall-time term is slow because the INPUT PIPELINE
+            # is slow — evicting the host just moves the same stall to
+            # rank N-1's shards. Decide a skip naming the real culprit
+            # instead of an eviction; hysteresis applies like any other
+            # decision (a relapse after recovery re-decides, and a later
+            # excursion whose dominant term is the host itself evicts).
+            # This check sits ABOVE the eviction-feasibility guards: a
+            # skip publishes nothing, so the diagnosis must surface even
+            # when eviction is impossible (another host held, min_world
+            # floor, partial rank map). `d` is the digest the streak
+            # check read — one observation backs both the confirmation
+            # and the evidence.
+            if d.get("diag_dominant") == "data_wait":
+                self._decide_skip(host, d)
+                continue
             if self._evicted is not None:
                 continue  # one eviction at a time
             if self.current_world() - 1 < self.min_world:
@@ -445,6 +463,21 @@ class FleetController:
                              "decision": rec["id"]}
             if _metrics_mod.enabled():
                 _M_EVICTIONS.inc(host=host)
+
+    def _decide_skip(self, host: str, d: dict):
+        """A confirmed straggler whose dominant diagnosed term (in its
+        digest `d`) is the input pipeline: record the decision NOT to
+        evict (action="skip") with the evidence naming the culprit.
+        Publishes nothing — doing nothing IS the applied action — and
+        suppresses like an eviction so the standing excursion logs once,
+        re-arming on recovery."""
+        evidence = {"windows": self._streaks.get(host, 0),
+                    "diag_dominant": d.get("diag_dominant"),
+                    "culprit": "input_pipeline",
+                    "p50_s": d.get("wall_p50_s"), "step": d.get("step")}
+        self._act("straggler_skip", evidence,
+                  {"action": "skip", "host": host}, publish=False)
+        self._suppressed.add(host)
 
     def _health_policy(self, digests: Dict[int, dict]):
         now = time.time()
@@ -552,9 +585,12 @@ class FleetController:
                 _M_READMISSIONS.inc(host=host)
 
     # -- decision plumbing --------------------------------------------------
-    def _act(self, policy: str, evidence: dict, cmd: dict) -> dict:
+    def _act(self, policy: str, evidence: dict, cmd: dict,
+             publish: bool = True) -> dict:
         """Record + event-log + (unless dry-run) publish one decision.
-        Publish failures degrade to outcome="failed" with a warning."""
+        Publish failures degrade to outcome="failed" with a warning.
+        `publish=False` decisions (skip: the action is to do nothing)
+        are applied by construction and touch no store."""
         self._decision_seq += 1
         rec = {"id": self._decision_seq, "ts": time.time(),
                "policy": policy, "evidence": evidence,
@@ -563,7 +599,9 @@ class FleetController:
                "outcome": "dry_run", "cmd_id": None,
                "relaunch_to_first_step_s": None}
         if not self.dry_run:
-            if self.bus is None:
+            if not publish:
+                rec["outcome"] = "applied"
+            elif self.bus is None:
                 rec["outcome"] = "failed"
                 rec["error"] = "no command bus attached"
             else:
@@ -605,6 +643,8 @@ class FleetController:
         exists to shrink)."""
         pending = [r for r in self.decisions
                    if r["outcome"] == "applied"
+                   and r["cmd_id"] is not None  # skip decisions actuate
+                                                # nothing to observe
                    and r["relaunch_to_first_step_s"] is None]
         if not pending:
             return

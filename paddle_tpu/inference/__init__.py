@@ -257,6 +257,15 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
+def __getattr__(name):
+    # lazy: the continuous-batching serving engine pulls in the metrics/
+    # events plane, which single-request Predictor users don't need
+    if name in ("ServingEngine", "Request", "PageAllocator"):
+        from . import serving
+        return getattr(serving, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 def get_version() -> str:
     import paddle_tpu
     return getattr(paddle_tpu, "__version__", "0.0.0")

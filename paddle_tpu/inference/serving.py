@@ -1,0 +1,580 @@
+"""Continuous-batching autoregressive serving on the inference path.
+
+The L11 inference stack (Predictor -> StableHLO, int8 PTQ, hardened C
+API) stops at single-request ``run()``. This module is the daemon shape
+that makes "millions of users" literal for GPT-class decode: an
+Orca-style (Yu et al., 2022) continuous-batching loop over the paged
+KV cache (ops/pallas/paged_attention.py) —
+
+* a **request queue** feeds a FIXED decode batch of ``max_batch`` slots;
+  admission happens per iteration (a finished sequence's slot is refilled
+  on the very next step, never at epoch/batch boundaries);
+* **prefill is shape-bucketed**: a prompt pads up to the smallest
+  configured bucket, so the whole serving life of the engine compiles
+  one decode executable + one prefill executable per bucket — the
+  retrace watchdog stays quiet and the PR-8 persistent compile cache
+  (``PADDLE_TPU_COMPILE_CACHE_DIR``) makes cold-start cheap;
+* **pages, not slabs**: each sequence owns block-table pages from a
+  :class:`PageAllocator`; pages free on EOS/length, and when the pool
+  runs dry the youngest request is PREEMPTED (pages freed, request
+  requeued with its generated prefix — recompute-style, vLLM's fallback)
+  instead of the engine deadlocking;
+* the decode step is ONE jitted executable over the whole batch with the
+  cache DONATED (the multi-GB page pool is updated in place per token);
+* **serving metric families** land on the PR-6 metrics plane:
+  ``serving_queue_depth``, ``serving_batch_occupancy``,
+  ``serving_ttft_seconds``, ``serving_tpot_seconds``,
+  ``serving_goodput_tokens_total`` — plus one ``serving_admission`` /
+  ``serving_eviction`` structured event per request lifecycle edge
+  (rendered by ``tools/obs_tail.py --serving``).
+
+Greedy decoding only (argmax — the mode with a bit-exact dense parity
+check); sampling policies ride on the same loop later. Weight hot-swap
+by polling sharded-checkpoint manifests is the ROADMAP follow-up.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import tape as tape_mod
+from ..framework.tensor import Tensor
+from ..profiler import events as _events
+from ..profiler import metrics as _metrics
+
+__all__ = ["Request", "PageAllocator", "ServingEngine"]
+
+_REG = _metrics.default_registry()
+_M_QUEUE = _REG.gauge(
+    "serving_queue_depth",
+    "requests queued waiting for a decode slot, by model")
+_M_OCC = _REG.gauge(
+    "serving_batch_occupancy",
+    "active sequences in the fixed continuous-batching decode batch, "
+    "by model")
+_M_TTFT = _REG.histogram(
+    "serving_ttft_seconds",
+    "time to first token: request submit -> first generated token, "
+    "by model")
+_M_TPOT = _REG.histogram(
+    "serving_tpot_seconds",
+    "time per output token after the first, observed once per finished "
+    "request, by model")
+_M_GOODPUT = _REG.counter(
+    "serving_goodput_tokens_total",
+    "generated tokens delivered to finished or running requests, by model")
+
+
+class PageAllocator:
+    """Free-list allocator over the KV page pool. Page 0 is the NULL
+    page (idle slots' block tables point at it; masked decode writes
+    land there) and is never handed out."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = int(num_pages)
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n page ids, or None when the pool can't cover the request
+        (caller preempts or queues — a partial grab is never left
+        dangling)."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages: Sequence[int]):
+        for p in pages:
+            if p:  # the null page is not pool-managed
+                self._free.append(int(p))
+
+
+class Request:
+    """One generation request. Thread-safe result hand-off: `result()`
+    blocks until the engine completes (or fails) the request."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int,
+                 eos_id: int = -1):
+        self.rid = next(Request._ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = int(eos_id)
+        self.generated: List[int] = []
+        self.state = "queued"          # queued|running|done|failed
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.submitted_ts = time.monotonic()
+        self.first_token_ts: Optional[float] = None
+        self.done_ts: Optional[float] = None
+        self.preemptions = 0
+        self.slot: Optional[int] = None
+        self.pages: List[int] = []
+        self._done = threading.Event()
+
+    # -- latency accounting ---------------------------------------------------
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_ts is None:
+            return None
+        return self.first_token_ts - self.submitted_ts
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Per-output-token latency AFTER the first token (the streaming
+        cadence a client sees); None until done or with <2 tokens."""
+        if self.done_ts is None or self.first_token_ts is None \
+                or len(self.generated) < 2:
+            return None
+        return (self.done_ts - self.first_token_ts) \
+            / (len(self.generated) - 1)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Generated token ids (eos included when hit). Raises on engine
+        failure or timeout."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.rid} not done")
+        if self.state == "failed":
+            raise RuntimeError(f"request {self.rid} failed: {self.error}")
+        return list(self.generated)
+
+
+def _pow2_buckets(lo: int, hi: int) -> List[int]:
+    out, b = [], max(int(lo), 1)
+    while b < hi:
+        out.append(b)
+        b <<= 1
+    out.append(hi)
+    return out
+
+
+class ServingEngine:
+    """Continuous-batching decode engine over one model's paged KV cache.
+
+    `model` must expose the GPT decode protocol (`init_cache`,
+    `forward_prefill`, `forward_decode` — models/gpt.py). Drive it either
+    synchronously (`submit` then `run_until_idle`, tests/bench) or with
+    the background thread (`start()`; `close()` joins it).
+
+    `num_pages` below full backing turns the allocator into a real
+    constraint: admission waits for pages and decode preempts when the
+    pool runs dry. The default fully backs `max_batch` x `max_len`."""
+
+    def __init__(self, model, *, max_batch: int = 4, max_len: int = 256,
+                 page_size: int = 16, num_pages: int = 0,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 eos_id: int = -1, name: str = "gpt"):
+        import jax
+
+        model.eval()
+        self.model = model
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.max_len = int(max_len)
+        self.page_size = int(page_size)
+        self.eos_id = int(eos_id)
+        self.cache = model.init_cache(max_batch, max_len,
+                                      page_size=page_size,
+                                      num_pages=num_pages)
+        self.allocator = PageAllocator(self.cache.num_pages)
+        if prefill_buckets is None:
+            prefill_buckets = _pow2_buckets(min(16, max_len), max_len)
+        self.prefill_buckets = sorted(set(int(b) for b in prefill_buckets))
+        if self.prefill_buckets[-1] < max_len:
+            self.prefill_buckets.append(max_len)
+
+        self._params = {k: p.data for k, p in model.named_parameters()}
+        self._buffers = {k: b.data for k, b in model.named_buffers()}
+        self._queue: "deque[Request]" = deque()
+        self._lock = threading.Lock()
+        self._slots: List[Optional[Request]] = [None] * self.max_batch
+        self._cur_tokens = np.zeros((self.max_batch,), np.int32)
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        # rolling stats for bench/status
+        self.stats = {"iterations": 0, "prefills": 0, "decode_tokens": 0,
+                      "completed": 0, "preemptions": 0, "decode_wall_s": 0.0}
+
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(2,))
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(2,))
+
+    # -- jitted model steps ---------------------------------------------------
+    # One decode executable for the engine's life; one prefill trace per
+    # shape bucket (bounded by len(prefill_buckets)). Both observe the
+    # retrace watchdog so an unexpected extra signature is surfaced like
+    # any other jit site, and compile time is attributed on the compile
+    # watch plane.
+
+    def _decode_fn(self, params, buffers, cache, tokens, active):
+        import jax.numpy as jnp
+        from ..jit import _swapped_state
+        with tape_mod.no_grad(), _swapped_state(self.model, params, buffers):
+            logits, cache = self.model.forward_decode(
+                Tensor(tokens), cache, active)
+        nxt = jnp.argmax(logits.data, axis=-1).astype(jnp.int32)
+        return nxt, cache
+
+    def _prefill_fn(self, params, buffers, cache, ids, slot, length):
+        import jax.numpy as jnp
+        from ..jit import _swapped_state
+        with tape_mod.no_grad(), _swapped_state(self.model, params, buffers):
+            logits, cache = self.model.forward_prefill(
+                Tensor(ids), cache, slot, length)
+        nxt = jnp.argmax(logits.data, axis=-1).astype(jnp.int32)
+        return nxt, cache
+
+    def _observe_site(self, site: str, leaves):
+        try:
+            from ..profiler.watchdog import get_watchdog
+            get_watchdog().observe("to_static", f"serving_{site}:{self.name}",
+                                   list(leaves))
+        except Exception:
+            pass
+
+    # -- public API -----------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               eos_id: Optional[int] = None) -> Request:
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        req = Request(prompt, max_new_tokens,
+                      self.eos_id if eos_id is None else eos_id)
+        if not req.prompt:
+            raise ValueError("empty prompt")
+        if len(req.prompt) + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {len(req.prompt)} + max_new_tokens "
+                f"{req.max_new_tokens} exceeds max_len {self.max_len}")
+        total_pages = -(-(len(req.prompt) + req.max_new_tokens)
+                        // self.page_size)
+        if total_pages > self.cache.num_pages - 1:
+            # a request the pool can NEVER satisfy would wedge the queue
+            # head forever (admission waits for frees that cannot come)
+            raise ValueError(
+                f"request needs {total_pages} KV pages but the pool holds "
+                f"{self.cache.num_pages - 1} (num_pages minus the null "
+                f"page); raise num_pages or lower max_new_tokens")
+        with self._lock:
+            # re-check under the lock: a close() racing this submit has
+            # already drained the queue, and a request appended after
+            # that drain would never complete (result() hangs forever)
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            self._queue.append(req)
+            depth = len(self._queue)
+        if _metrics.enabled():
+            _M_QUEUE.set(depth, model=self.name)
+        return req
+
+    def pending(self) -> bool:
+        with self._lock:
+            return bool(self._queue) or any(
+                r is not None for r in self._slots)
+
+    def step(self) -> int:
+        """ONE continuous-batching iteration: admit waiting requests into
+        free slots (bucketed prefill each), grow pages for sequences
+        crossing a page boundary (preempting the youngest on pool
+        exhaustion), then one batched decode step. Returns the number of
+        tokens generated (0 = engine idle)."""
+        self._admit()
+        active_slots = [i for i, r in enumerate(self._slots)
+                        if r is not None]
+        if _metrics.enabled():
+            _M_OCC.set(len(active_slots), model=self.name)
+        if not active_slots:
+            return 0
+        self._ensure_capacity(active_slots)
+        active_slots = [i for i, r in enumerate(self._slots)
+                        if r is not None]  # capacity may have preempted
+        if not active_slots:
+            return 0
+        return self._decode_iteration(active_slots)
+
+    def run_until_idle(self, max_iterations: int = 100000):
+        for _ in range(max_iterations):
+            if not self.pending():
+                return
+            self.step()
+        raise RuntimeError("run_until_idle: iteration cap exceeded")
+
+    def start(self, poll_s: float = 0.005):
+        """Background decode loop: steps while work exists, naps when
+        idle. close() joins it. An exception out of step() is FATAL for
+        the engine (the cache may hold donated/invalid buffers): it is
+        surfaced as a warning + failed requests instead of a silently
+        dead thread that strands every client in result()."""
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._closed:
+                try:
+                    if not self.pending() or self.step() == 0:
+                        time.sleep(poll_s)
+                except Exception as e:  # noqa: BLE001 — see docstring
+                    import warnings
+                    err = f"{type(e).__name__}: {e}"
+                    warnings.warn(
+                        f"serving engine {self.name!r} decode loop died "
+                        f"({err}); failing outstanding requests")
+                    self._closed = True
+                    self._fail_outstanding(f"engine decode loop died: "
+                                           f"{err}")
+                    return
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"serving-{self.name}")
+        self._thread.start()
+
+    def close(self):
+        """Stop the engine. Outstanding (queued or mid-decode) requests
+        FAIL with a clean 'engine closed' error — a client blocked in
+        result() must never hang on a closed engine."""
+        self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        self._fail_outstanding("engine closed")
+
+    def _fail_outstanding(self, error: str):
+        with self._lock:
+            leftovers = list(self._queue) + [r for r in self._slots
+                                             if r is not None]
+            self._queue.clear()
+        for req in leftovers:
+            self._complete(req, "failed", error=error)
+
+    # -- internals ------------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        return self.prefill_buckets[-1]
+
+    def _admit(self):
+        """Per-iteration admission: fill every free slot whose prompt the
+        page pool can cover right now."""
+        import jax.numpy as jnp
+        while True:
+            with self._lock:
+                if not self._queue:
+                    break
+                free = [i for i, r in enumerate(self._slots) if r is None]
+                if not free:
+                    break
+                req = self._queue[0]
+                # admission prompt = original prompt + any tokens already
+                # generated before a preemption (recompute-style resume)
+                tokens = req.prompt + req.generated
+                n_pages = -(-len(tokens) // self.page_size)
+                pages = self.allocator.alloc(n_pages)
+                if pages is None:
+                    break  # pool exhausted: wait for frees
+                self._queue.popleft()
+                slot = free[0]
+                req.slot, req.pages, req.state = slot, pages, "running"
+                self._slots[slot] = req
+                depth = len(self._queue)
+            bucket = self._bucket_for(len(tokens))
+            bt = self.cache.block_tables
+            row = np.zeros((self.cache.pages_per_seq,), np.int32)
+            row[:len(pages)] = pages
+            self.cache.block_tables = bt.at[slot].set(jnp.asarray(row))
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :len(tokens)] = tokens
+            self._observe_site("prefill", [ids])
+            from ..profiler import compile_watch as _cw
+            prev = _cw.push_entry("to_static",
+                                  f"serving_prefill:{self.name}")
+            try:
+                nxt, self.cache = self._prefill_jit(
+                    self._params, self._buffers, self.cache,
+                    jnp.asarray(ids), np.int32(slot),
+                    np.int32(len(tokens)))
+            finally:
+                _cw.pop_entry(prev)
+            self.stats["prefills"] += 1
+            tok = int(np.asarray(nxt)[0])
+            now = time.monotonic()
+            if req.first_token_ts is None:
+                req.first_token_ts = now
+                if _metrics.enabled() and req.ttft_s is not None:
+                    _M_TTFT.observe(req.ttft_s, model=self.name)
+            self._emit_admission(req, bucket, len(tokens))
+            self._record_token(req, tok)
+            if _metrics.enabled():
+                _M_QUEUE.set(depth, model=self.name)
+            if req.state != "running":
+                continue  # single-token request finished at prefill
+            self._cur_tokens[slot] = tok
+
+    def _ensure_capacity(self, active_slots: List[int]):
+        """Every active sequence about to write position `ctx` needs the
+        page ctx // page_size allocated; grow by one page where the
+        boundary was crossed, preempting the youngest request when the
+        pool is dry."""
+        import jax.numpy as jnp
+        for slot in list(active_slots):
+            req = self._slots[slot]
+            if req is None:
+                continue
+            ctx = len(req.prompt) + len(req.generated)
+            need = ctx // self.page_size + 1
+            while len(req.pages) < need:
+                got = self.allocator.alloc(1)
+                if got is None:
+                    victim = self._youngest_running()
+                    running = sum(r is not None for r in self._slots)
+                    if victim is None or (victim is req and running == 1):
+                        # sole runner with a dry pool: submit-time
+                        # validation bounds TOTAL need, so this is an
+                        # external consumer of the pool — fail loudly
+                        # rather than preempt-requeue-wedge
+                        self._complete(req, "failed",
+                                       error="KV page pool exhausted")
+                        break
+                    self._preempt(victim)
+                    if victim is req:
+                        break
+                    continue
+                req.pages.extend(got)
+                self.cache.block_tables = self.cache.block_tables.at[
+                    slot, len(req.pages) - 1].set(jnp.int32(got[0]))
+
+    def _youngest_running(self) -> Optional[Request]:
+        running = [r for r in self._slots if r is not None]
+        if not running:
+            return None
+        return max(running, key=lambda r: r.submitted_ts)
+
+    def _decode_iteration(self, active_slots: List[int]) -> int:
+        import jax.numpy as jnp
+        active = np.zeros((self.max_batch,), bool)
+        active[active_slots] = True
+        self._observe_site("decode", [self._cur_tokens])
+        from ..profiler import compile_watch as _cw
+        prev = _cw.push_entry("to_static", f"serving_decode:{self.name}")
+        t0 = time.perf_counter()
+        try:
+            nxt, self.cache = self._decode_jit(
+                self._params, self._buffers, self.cache,
+                jnp.asarray(self._cur_tokens), jnp.asarray(active))
+        finally:
+            _cw.pop_entry(prev)
+        nxt_np = np.asarray(nxt)  # device sync: the iteration boundary
+        self.stats["decode_wall_s"] += time.perf_counter() - t0
+        self.stats["iterations"] += 1
+        produced = 0
+        for slot in active_slots:
+            req = self._slots[slot]
+            if req is None:
+                continue
+            tok = int(nxt_np[slot])
+            self._record_token(req, tok)
+            produced += 1
+            if req.state == "running":
+                self._cur_tokens[slot] = tok
+        self.stats["decode_tokens"] += produced
+        if _metrics.enabled():
+            # re-publish occupancy AFTER completions so a drained batch
+            # reads 0 even when no further step() runs
+            _M_OCC.set(sum(r is not None for r in self._slots),
+                       model=self.name)
+        return produced
+
+    def _record_token(self, req: Request, tok: int):
+        req.generated.append(tok)
+        if _metrics.enabled():
+            # per-token goodput (prefill's first token included)
+            _M_GOODPUT.inc(1.0, model=self.name)
+        if req.eos_id >= 0 and tok == req.eos_id:
+            self._complete(req, "eos")
+        elif len(req.generated) >= req.max_new_tokens:
+            self._complete(req, "length")
+
+    def _complete(self, req: Request, reason: str,
+                  error: Optional[str] = None):
+        """Free the request's slot + pages; reason eos|length|failed."""
+        self._release_slot(req)
+        req.finish_reason = reason
+        req.done_ts = time.monotonic()
+        req.state = "failed" if reason == "failed" else "done"
+        req.error = error
+        if reason != "failed":
+            self.stats["completed"] += 1
+            if _metrics.enabled() and req.tpot_s is not None:
+                _M_TPOT.observe(req.tpot_s, model=self.name)
+        self._emit_eviction(req, reason)
+        req._done.set()
+
+    def _preempt(self, req: Request):
+        """Recompute-style preemption: pages freed, request requeued with
+        its generated prefix as part of the next admission's prompt."""
+        self._release_slot(req)
+        req.state = "queued"
+        req.slot = None
+        req.preemptions += 1
+        self.stats["preemptions"] += 1
+        with self._lock:
+            self._queue.appendleft(req)
+            depth = len(self._queue)
+        if _metrics.enabled():
+            _M_QUEUE.set(depth, model=self.name)
+        self._emit_eviction(req, "preempted")
+
+    def _release_slot(self, req: Request):
+        import jax.numpy as jnp
+        slot = req.slot
+        if slot is not None and self._slots[slot] is req:
+            self._slots[slot] = None
+            self._cur_tokens[slot] = 0
+            # point the slot's block table back at the null page and zero
+            # its context so the batched decode masks it out entirely
+            self.cache.block_tables = self.cache.block_tables.at[slot].set(
+                jnp.zeros((self.cache.pages_per_seq,), jnp.int32))
+            self.cache.context_lens = self.cache.context_lens.at[slot].set(0)
+        self.allocator.free(req.pages)
+        req.pages = []
+
+    # -- events ---------------------------------------------------------------
+    def _emit_admission(self, req: Request, bucket: int, prompt_len: int):
+        _events.emit(
+            "serving_admission", model=self.name, request=req.rid,
+            slot=req.slot, prompt_len=prompt_len, bucket=bucket,
+            queue_wait_s=round(time.monotonic() - req.submitted_ts, 4),
+            preemptions=req.preemptions,
+            free_pages=self.allocator.free_pages)
+
+    def _emit_eviction(self, req: Request, reason: str):
+        _events.emit(
+            "serving_eviction",
+            severity="warn" if reason in ("preempted", "failed") else "info",
+            model=self.name, request=req.rid, reason=reason,
+            generated=len(req.generated),
+            free_pages=self.allocator.free_pages)
+
+    # -- status ---------------------------------------------------------------
+    def status(self) -> Dict:
+        with self._lock:
+            return {
+                "model": self.name,
+                "max_batch": self.max_batch,
+                "max_len": self.max_len,
+                "page_size": self.page_size,
+                "num_pages": self.cache.num_pages,
+                "free_pages": self.allocator.free_pages,
+                "queue_depth": len(self._queue),
+                "occupancy": sum(r is not None for r in self._slots),
+                "prefill_buckets": list(self.prefill_buckets),
+                "stats": dict(self.stats),
+            }

@@ -18,6 +18,57 @@ from ..framework.tensor import Tensor
 from ..ops import arange, reshape, transpose
 
 
+class PagedKVCache:
+    """Paged decode KV cache: per-layer page pools + per-sequence block
+    tables (ops/pallas/paged_attention.py layout).
+
+    ``k_pages[l]`` / ``v_pages[l]`` are ``[num_pages, page_size, H, D]``;
+    ``block_tables`` is ``[max_batch, pages_per_seq]`` int32 and
+    ``context_lens`` ``[max_batch]`` int32. Page 0 is the NULL page: idle
+    batch slots point at it and their decode-step writes land there (see
+    the serving allocator). Registered as a pytree so a whole serving
+    decode step jits over it with the pools donated."""
+
+    def __init__(self, k_pages, v_pages, block_tables, context_lens,
+                 page_size: int):
+        self.k_pages = list(k_pages)
+        self.v_pages = list(v_pages)
+        self.block_tables = block_tables
+        self.context_lens = context_lens
+        self.page_size = int(page_size)
+
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages[0].shape[0]
+
+    @property
+    def pages_per_seq(self) -> int:
+        return self.block_tables.shape[1]
+
+    @property
+    def max_batch(self) -> int:
+        return self.block_tables.shape[0]
+
+    def tree_flatten(self):
+        return ((self.k_pages, self.v_pages, self.block_tables,
+                 self.context_lens), (self.page_size,))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        k_pages, v_pages, block_tables, context_lens = children
+        return cls(k_pages, v_pages, block_tables, context_lens, aux[0])
+
+
+def _register_cache_pytree():
+    import jax
+    jax.tree_util.register_pytree_node(
+        PagedKVCache, PagedKVCache.tree_flatten,
+        PagedKVCache.tree_unflatten)
+
+
+_register_cache_pytree()
+
+
 @dataclasses.dataclass
 class GPTConfig:
     vocab_size: int = 50304
@@ -177,3 +228,189 @@ class GPT(nn.Layer):
 
     def num_params(self):
         return sum(p.size for p in self.parameters())
+
+    # ---------------- autoregressive decode (paged KV cache) ----------------
+    #
+    # The training forward above re-runs full-sequence attention for every
+    # generated token — O(n^2) FLOPs and HBM traffic per sequence. The
+    # decode path below is the serving shape: K/V of every past token live
+    # in fixed-size pages (ops/pallas/paged_attention.py), prefill runs the
+    # prompt once through the normal flash-attention path while scattering
+    # its K/V into the pages, and each generated token is ONE incremental
+    # step (append one K/V row, attend over the pages). All methods are
+    # traceable — inference/serving.py jits the whole batched step with the
+    # cache donated.
+
+    def init_cache(self, max_batch: int, max_len: int, page_size: int = 16,
+                   num_pages: int = 0, dtype=None) -> PagedKVCache:
+        """Build an empty paged KV cache for `max_batch` concurrent
+        sequences of up to `max_len` tokens. `num_pages` defaults to full
+        backing (every slot can reach max_len) + the null page; a serving
+        deployment may pass less and rely on allocator preemption."""
+        import jax.numpy as jnp
+        if max_len > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"init_cache: max_len {max_len} exceeds "
+                f"max_position_embeddings {self.cfg.max_position_embeddings}")
+        pages_per_seq = -(-max_len // page_size)
+        if not num_pages:
+            num_pages = 1 + max_batch * pages_per_seq  # +1: the null page
+        if dtype is None:
+            dtype = self.wte.weight.dtype
+        H, D = self.cfg.num_heads, self.cfg.hidden_size // self.cfg.num_heads
+        shape = (num_pages, page_size, H, D)
+        k_pages = [jnp.zeros(shape, dtype) for _ in self.blocks]
+        v_pages = [jnp.zeros(shape, dtype) for _ in self.blocks]
+        return PagedKVCache(
+            k_pages, v_pages,
+            jnp.zeros((max_batch, pages_per_seq), jnp.int32),
+            jnp.zeros((max_batch,), jnp.int32), page_size)
+
+    def _block_qkv(self, blk, x):
+        """(q, k, v) raw arrays [B, L, H, D] from one block's qkv proj."""
+        B, L, _ = x.shape
+        qkv = blk.attn.qkv(x)
+        qkv = reshape(qkv, [B, L, 3, blk.attn.num_heads, blk.attn.head_dim])
+        return qkv[:, :, 0].data, qkv[:, :, 1].data, qkv[:, :, 2].data
+
+    def forward_prefill(self, input_ids, cache: PagedKVCache, slot,
+                        length):
+        """Prefill ONE sequence: run the prompt through the normal (flash)
+        causal attention while scattering every position's K/V into the
+        pages of batch slot `slot`. `input_ids` is [1, L_bucket] (L may be
+        padded up to a shape bucket — the retrace watchdog stays quiet
+        because serving always pads to a bucket); `length` is the real
+        prompt length (traced ok). Returns (last-position logits [1, V],
+        updated cache)."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.pallas import paged_attention as _pa
+        B, L = input_ids.shape
+        if B != 1:
+            raise ValueError(f"forward_prefill fills ONE slot's pages; got "
+                             f"batch {B} (serving prefills per request)")
+        with jax.named_scope("embed"):
+            pos = arange(0, L, dtype="int32")
+            x = self.wte(input_ids) + self.wpe(pos)
+        slot = jnp.asarray(slot, jnp.int32)
+        length = jnp.asarray(length, jnp.int32)
+        page_row = jnp.take(cache.block_tables, slot, axis=0)
+        for li, blk in enumerate(self.blocks):
+            with jax.named_scope("ln"):
+                h = blk.ln1(x)
+            with jax.named_scope("attention"):
+                q, k, v = self._block_qkv(blk, h)
+                cache.k_pages[li], cache.v_pages[li] = _pa.prefill_append(
+                    cache.k_pages[li], cache.v_pages[li], k[0], v[0],
+                    page_row, length)
+                out = F.scaled_dot_product_attention(
+                    Tensor(q), Tensor(k), Tensor(v), is_causal=True,
+                    training=False)
+                out = reshape(out, [B, L, self.cfg.hidden_size])
+                x = x + blk.attn.proj(out)
+            with jax.named_scope("ln"):
+                h = blk.ln2(x)
+            x = x + blk.mlp(h)
+        cache.context_lens = cache.context_lens.at[slot].set(length)
+        with jax.named_scope("logits"):
+            # logits of the LAST REAL position only (bucket padding past
+            # `length` attends causally to junk and is never read)
+            last = Tensor(jax.lax.dynamic_index_in_dim(
+                x.data, length - 1, axis=1, keepdims=False))
+            logits = self.pipeline_post(last)
+        return logits, cache
+
+    def forward_decode(self, tokens, cache: PagedKVCache, active=None):
+        """ONE incremental decode step for the whole cache batch: append
+        each sequence's new token K/V to its pages, attend over the paged
+        context. `tokens` is [B] int (the token sitting at position
+        context_lens[b]); `active` [B] bool masks idle serving slots
+        (their writes land on the null page, their logits are garbage
+        nobody reads). Returns (logits [B, V], updated cache)."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.pallas import paged_attention as _pa
+        if active is None:
+            active = jnp.ones((cache.max_batch,), bool)
+        ctx = cache.context_lens
+        with jax.named_scope("embed"):
+            # position of the incoming token = current context length
+            pos = Tensor(jnp.minimum(
+                ctx, self.cfg.max_position_embeddings - 1))
+            x = self.wte(tokens) + self.wpe(pos)       # [B, hidden]
+        B = x.shape[0]
+        x = reshape(x, [B, 1, self.cfg.hidden_size])
+        for li, blk in enumerate(self.blocks):
+            with jax.named_scope("ln"):
+                h = blk.ln1(x)
+            with jax.named_scope("attention"):
+                q, k, v = self._block_qkv(blk, h)      # [B, 1, H, D]
+                cache.k_pages[li], cache.v_pages[li] = _pa.cache_append(
+                    cache.k_pages[li], cache.v_pages[li], k[:, 0], v[:, 0],
+                    cache.block_tables, ctx, active)
+                out = _pa.paged_attention(
+                    q[:, 0], cache.k_pages[li], cache.v_pages[li],
+                    cache.block_tables,
+                    # the new token is part of its own context
+                    jnp.where(active, ctx + 1, 0))
+                out = reshape(Tensor(out), [B, 1, self.cfg.hidden_size])
+                x = x + blk.attn.proj(out)
+            with jax.named_scope("ln"):
+                h = blk.ln2(x)
+            x = x + blk.mlp(h)
+        cache.context_lens = jnp.where(active, ctx + 1, ctx)
+        with jax.named_scope("logits"):
+            logits = self.pipeline_post(reshape(x, [B, self.cfg.hidden_size]))
+        return logits, cache
+
+    # -- reference decode loops (bench A/B + parity tests) -------------------
+
+    def generate_dense(self, input_ids, max_new_tokens: int,
+                       eos_id: int = -1):
+        """Cacheless greedy decode: the O(n^2) baseline — every token
+        re-runs the FULL forward over the whole growing sequence. Returns
+        [B, L + max_new_tokens] (generation stops early only when every
+        row hit eos_id)."""
+        import numpy as np
+        from ..ops import argmax, concat
+        ids = input_ids
+        for _ in range(max_new_tokens):
+            logits = self(ids)                          # [B, L', V]
+            nxt = argmax(logits[:, -1], axis=-1, dtype="int32")
+            ids = concat([ids, reshape(nxt, [ids.shape[0], 1])], axis=1)
+            if eos_id >= 0 and bool(np.all(np.asarray(nxt.data) == eos_id)):
+                break
+        return ids
+
+    def generate_paged(self, input_ids, max_new_tokens: int,
+                       eos_id: int = -1, page_size: int = 8):
+        """Greedy decode through the paged path: prefill once, then one
+        incremental `forward_decode` per token. The parity counterpart of
+        `generate_dense` (inference/serving.py is the production loop —
+        this helper allocates pages contiguously per row)."""
+        import numpy as np
+        import jax.numpy as jnp
+        from ..ops import argmax, concat
+        if max_new_tokens <= 0:
+            return input_ids  # match generate_dense's [B, L] contract
+        B, L = input_ids.shape
+        max_len = L + max_new_tokens
+        cache = self.init_cache(B, max_len, page_size=page_size)
+        pps = cache.pages_per_seq
+        # contiguous page plan: row b owns pages [1 + b*pps, 1 + (b+1)*pps)
+        bt = 1 + np.arange(B * pps, dtype=np.int32).reshape(B, pps)
+        cache.block_tables = jnp.asarray(bt)
+        for b in range(B):
+            logits, cache = self.forward_prefill(
+                input_ids[b:b + 1], cache, b, L)
+            last = logits if b == 0 else concat([last, logits], axis=0)
+        ids = input_ids
+        nxt = argmax(last, axis=-1, dtype="int32")
+        ids = concat([ids, reshape(nxt, [B, 1])], axis=1)
+        for _ in range(max_new_tokens - 1):
+            if eos_id >= 0 and bool(np.all(np.asarray(nxt.data) == eos_id)):
+                break
+            logits, cache = self.forward_decode(nxt, cache)
+            nxt = argmax(logits, axis=-1, dtype="int32")
+            ids = concat([ids, reshape(nxt, [B, 1])], axis=1)
+        return ids

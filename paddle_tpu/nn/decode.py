@@ -108,7 +108,17 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
         history.append((token.copy(), parent.copy()))
         cur_tokens = token
 
-        # reorder cell states by parent beam
+        if finished.all():
+            # every beam has emitted end_token: stop BEFORE the state
+            # reorder — the states are dead (no further cell step reads
+            # them), and gathering the whole state tree one last time is
+            # pure waste for large cells
+            break
+
+        # reorder cell states by parent beam (a finished beam's only
+        # above-floor candidate is its own end-extension, so its state is
+        # gathered from itself — finished hypotheses never inherit a live
+        # beam's state)
         def reorder(s):
             arr = s.data if isinstance(s, Tensor) else s
             sp = arr.reshape((B, K) + arr.shape[1:])
@@ -119,8 +129,6 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
                 gathered.reshape((-1,) + arr.shape[1:])))
         states = jax.tree_util.tree_map(
             reorder, states, is_leaf=lambda x: isinstance(x, Tensor))
-        if finished.all():
-            break
 
     # backtrace through parents
     T = len(history)

@@ -1,0 +1,409 @@
+"""Paged KV-cache decode attention: vLLM-style PagedAttention for TPU.
+
+Autoregressive decode is the serving hot loop: one query token per
+sequence attends over that sequence's whole generated context. A dense
+per-sequence KV cache `[B, max_len, H, D]` wastes HBM on short sequences
+and forces whole-cache reallocation as sequences grow; following vLLM
+(Kwon et al., 2023), K/V live in a shared pool of fixed-size PAGES
+
+    k_pages, v_pages: [num_pages, page_size, num_heads, head_dim]
+
+and each sequence owns a BLOCK TABLE of page indices
+
+    block_tables: [B, pages_per_seq] int32   (unused slots -> page 0)
+    context_lens: [B] int32                  (tokens stored per sequence)
+
+so memory is allocated page-at-a-time and fragmentation is bounded by
+one page per sequence. Page 0 is the NULL page by convention: the
+serving allocator never hands it out, idle batch slots point every
+block-table entry at it, and the cache-append scatter parks dead slots'
+writes there.
+
+Decode attention (one query token per sequence) gathers the scattered
+pages. Two implementations, chosen per shape by a MEASURED probe on the
+PR-10 autotune layer (op ``"paged_attn"``, same pattern as ``conv_bn``):
+
+* ``impl=1`` — the Pallas kernel: grid ``(B, head-blocks, pages)`` under
+  a :class:`PrefetchScalarGridSpec` whose scalar-prefetched block table
+  drives the k/v BlockSpec index maps, so each grid step DMAs exactly
+  ONE page from wherever it lives in the pool into VMEM (the pipeline
+  double-buffers page fetches against compute); online softmax carried
+  across the page walk in VMEM scratch. The ``heads`` candidate axis
+  splits the head dim across grid-parallel programs.
+* ``impl=0`` — the XLA composition: gather pages via
+  ``k_pages[block_tables]``, mask past ``context_lens``, dense softmax.
+  This is also the CPU fallback and the CI parity reference.
+
+`cache_append` is the matching single-token K/V scatter; its eager form
+is jitted with the page pools DONATED, so the steady-state decode loop
+updates the (potentially multi-GB) pool in place instead of copying it
+per token.
+
+Layout convention (paddle): q is [batch, heads, head_dim] (ONE decode
+token per sequence); pages carry [page_size, heads, head_dim] tokens.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..._jax_compat import (TPUCompilerParams as _TPUCompilerParams,
+                            DIM_PARALLEL as _DIM_P, DIM_ARBITRARY as _DIM_A)
+from . import autotune as _autotune
+from . import tiling as _tiling
+from .tiling import on_tpu as _on_tpu
+
+_NEG = -1e30
+_CARRY_LANES = 128  # m/l scratch lane width (f32 native lane tile)
+
+# dispatch decisions, counted at trace time (reset freely in tests)
+_stats = {"pallas": 0, "xla": 0, "append": 0}
+
+# tests set True: the kernel runs in the Pallas interpreter on CPU, so
+# the real gather/online-softmax logic is exercised without a TPU
+_INTERPRET = False
+
+
+# --------------------------- XLA reference (impl=0) --------------------------
+
+
+def paged_attention_xla(q, k_pages, v_pages, block_tables, context_lens,
+                        scale=None):
+    """Dense gather reference: correct for every shape, the CPU path, and
+    the ``impl=0`` autotune candidate. A sequence with ``context_lens==0``
+    (idle serving slot) outputs exactly zero."""
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    n_pages = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    # [B, n_pages, page_size, H, D] -> [B, L_max, H, D]
+    k = k_pages[block_tables].reshape(B, n_pages * page_size, H, D)
+    v = v_pages[block_tables].reshape(B, n_pages * page_size, H, D)
+    s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    pos = jnp.arange(n_pages * page_size, dtype=jnp.int32)[None, None, :]
+    live = pos < context_lens[:, None, None]
+    s = jnp.where(live, s, _NEG)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(live, p, 0.0)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bhl,blhd->bhd", p / l, v.astype(jnp.float32))
+    # fully-empty sequence: m == _NEG everywhere -> p all zero -> out 0
+    return out.astype(q.dtype)
+
+
+# --------------------------- Pallas kernel (impl=1) --------------------------
+
+
+def _paged_attn_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *, page_size, scale, n_pages):
+    """Grid (B, head-blocks, pages); the page axis is the minormost,
+    sequentially-executed dim carrying the online-softmax state. The
+    block table itself picked which page this step's k/v blocks were
+    DMA'd from (see the BlockSpec index maps in `_paged_attn_pallas`)."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx = cl_ref[b]
+
+    # pages at/past ceil(ctx/page_size) hold no live tokens: skip their
+    # compute entirely (their DMA cost is already bounded — unused block
+    # table slots all point at the null page)
+    @pl.when(i * page_size < ctx)
+    def _compute():
+        qb = q_ref[...]          # [bh, D]
+        kb = k_ref[...]          # [page_size, bh, D]
+        vb = v_ref[...]
+        # batched over heads: s[h, p] = q[h, :] . k[p, h, :]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale  # [bh, page_size]
+        pos = i * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx, s, _NEG)
+        m_prev = m_ref[...][:, :1]
+        l_prev = l_ref[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # a page whose every position is past ctx never reaches here, but
+        # the LAST live page's tail positions sit at the floor: zero them
+        # (exp(_NEG - m) underflows only when m is real)
+        p = jnp.where(s > 0.5 * _NEG, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            l_prev * corr + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(i == n_pages - 1)
+    def _finalize():
+        # ctx == 0 (idle slot): acc/l still zero -> output exactly zero,
+        # matching the XLA reference
+        o_ref[...] = (acc_ref[...]
+                      / jnp.maximum(l_ref[...][:, :1], 1e-30)
+                      ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_h", "interpret"))
+def _paged_attn_pallas(q, k_pages, v_pages, block_tables, context_lens,
+                       scale, block_h, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    n_pages = block_tables.shape[1]
+    n_h = pl.cdiv(H, block_h)
+    grid = (B, n_h, n_pages)
+    # the scalar-prefetched block table drives the page fetch: grid step
+    # (b, h, i) DMAs pool page block_tables[b, i] — this is the paged
+    # gather, done by the Pallas pipeline's own double-buffered DMA
+    kspec = pl.BlockSpec((None, page_size, block_h, D),
+                         lambda b, h, i, bt, cl: (bt[b, i], 0, h, 0))
+    qspec = pl.BlockSpec((None, block_h, D),
+                         lambda b, h, i, bt, cl: (b, h, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[qspec, kspec, kspec],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_h, D), jnp.float32),
+                        pltpu.VMEM((block_h, _CARRY_LANES), jnp.float32),
+                        pltpu.VMEM((block_h, _CARRY_LANES), jnp.float32)],
+    )
+    if interpret:
+        params = None
+    else:
+        # the page axis carries the softmax carry state -> ARBITRARY;
+        # batch and head blocks are embarrassingly parallel
+        params = _TPUCompilerParams(
+            dimension_semantics=(_DIM_P, _DIM_P, _DIM_A))
+    return pl.pallas_call(
+        functools.partial(_paged_attn_kernel, page_size=page_size,
+                          scale=scale, n_pages=n_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        compiler_params=params,
+        interpret=interpret,
+    )(block_tables, context_lens, q, k_pages, v_pages)
+
+
+# ------------------- autotuned impl/heads decision ---------------------------
+
+
+def _vmem_bytes(cfg, page_size: int, D: int, itemsize: int) -> int:
+    bh = cfg["heads"]
+    b = 2 * 2 * page_size * bh * D * itemsize   # double-buffered k/v pages
+    b += 2 * bh * D * itemsize                  # q in / o out
+    b += bh * D * 4 + 2 * bh * _CARRY_LANES * 4  # acc/m/l scratch
+    return b
+
+
+_cfg_memo = _autotune.register_memo({})
+
+
+def _head_candidates(H: int):
+    """Head-block extents: every divisor-of-H option (a non-divisor would
+    need head tail-masking the kernel doesn't carry) plus whole-H."""
+    return [h for h in (2, 4, 8, 16) if h < H and H % h == 0] + [H]
+
+
+def _resolve_cfg(dtype, H: int, D: int, page_size: int, n_pages: int):
+    """The measured per-shape decision: Pallas head-block shape or the
+    XLA gather (impl=0). Persisted per (op, shape-bucket, dtype, chip)
+    like every autotuned kernel, so a serving fleet sharing
+    PADDLE_TPU_AUTOTUNE_CACHE_DIR decides once."""
+    interpret = _INTERPRET
+    key = (H, D, page_size, _tiling.shape_bucket(n_pages, floor=1),
+           jnp.dtype(dtype).name)
+    memo_key = (key, interpret, _autotune.mode())
+    hit = _cfg_memo.get(memo_key)
+    if hit is not None:
+        return hit
+    itemsize = jnp.dtype(dtype).itemsize
+    default = _tiling.make_config(impl=1, heads=H)
+    cands = _tiling.candidate_configs(
+        ("impl", "heads"), [(1,), _head_candidates(H)], default,
+        vmem_bytes=lambda c: _vmem_bytes(c, page_size, D, itemsize))
+    # the XLA gather is a first-class candidate: measured, not assumed
+    cands = cands + [_tiling.make_config(impl=0, heads=0)]
+
+    sc = float(1.0 / np.sqrt(D))
+    buf = {}
+
+    def _args():
+        if not buf:
+            # B is a grid-parallel dim (probe small); page count real
+            rng = np.random.default_rng(0)
+            Bp = 2
+            buf["q"] = jnp.asarray(
+                rng.normal(size=(Bp, H, D)).astype(np.float32)).astype(dtype)
+            buf["kp"] = jnp.asarray(rng.normal(
+                size=(max(n_pages, 2), page_size, H, D)
+            ).astype(np.float32)).astype(dtype)
+            buf["bt"] = jnp.asarray(
+                rng.integers(0, max(n_pages, 2), (Bp, n_pages)
+                             ).astype(np.int32))
+            buf["cl"] = jnp.full((Bp,), n_pages * page_size, jnp.int32)
+        return buf["q"], buf["kp"], buf["bt"], buf["cl"]
+
+    def bench(cfg):
+        qa, kp, bt, cl = _args()
+        if cfg["impl"] == 1:
+            out = _paged_attn_pallas(qa, kp, kp, bt, cl, sc, cfg["heads"],
+                                     interpret=interpret)
+        else:
+            out = jax.jit(paged_attention_xla, static_argnames=("scale",))(
+                qa, kp, kp, bt, cl, scale=sc)
+        jax.block_until_ready(out)
+
+    tune_bench = bench if (_on_tpu() or interpret) else None
+    cfg = _autotune.get_config("paged_attn", key, candidates=cands,
+                               default=default, bench=tune_bench,
+                               interpret=interpret)
+    _cfg_memo[memo_key] = cfg
+    return cfg
+
+
+_probe_status = {}
+
+
+def _pallas_ok(dtype, H: int, D: int, page_size: int, n_pages: int,
+               cfg) -> bool:
+    """Eager compile probe at the exact resolved config (Mosaic failures
+    inside a user's outer jit cannot be caught — flash/layer_norm
+    precedent). impl=0 needs no probe."""
+    if cfg["impl"] == 0:
+        return True
+    key = (jnp.dtype(dtype).name, H, D, page_size, n_pages, cfg["heads"],
+           _INTERPRET)
+    if key not in _probe_status:
+        if not (_on_tpu() or _INTERPRET):
+            _probe_status[key] = False
+        else:
+            try:
+                q = jnp.ones((2, H, D), dtype)
+                kp = jnp.ones((max(n_pages, 2), page_size, H, D), dtype)
+                bt = jnp.zeros((2, n_pages), jnp.int32)
+                cl = jnp.full((2,), page_size, jnp.int32)
+                out = _paged_attn_pallas(q, kp, kp, bt, cl,
+                                         float(1.0 / np.sqrt(D)),
+                                         cfg["heads"], interpret=_INTERPRET)
+                jax.block_until_ready(out)
+                _probe_status[key] = True
+            except Exception:
+                _probe_status[key] = False
+    return _probe_status[key]
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    scale=None):
+    """Single-token decode attention over a paged KV pool.
+
+    q [B, H, D]; k_pages/v_pages [num_pages, page_size, H, D];
+    block_tables [B, pages_per_seq] int32 (unused slots MUST index a
+    valid page — the serving layer points them at the null page 0);
+    context_lens [B] int32. Returns [B, H, D].
+
+    Dispatch mirrors `flash_attention`: the per-shape impl (Pallas page
+    walk vs XLA gather) is resolved on the autotune layer, then the
+    resolved Pallas config is capability-probed eagerly; CPU without
+    interpret mode always takes the XLA path. Safe to call at trace time
+    of an outer jit (resolution runs eagerly at trace, like every kernel
+    in this package)."""
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    n_pages = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    eligible = ((_on_tpu() or _INTERPRET)
+                and q.dtype == k_pages.dtype == v_pages.dtype
+                and q.dtype != jnp.dtype(jnp.float16)
+                and isinstance(H, int))
+    if eligible:
+        cfg = _resolve_cfg(q.dtype, H, D, page_size, n_pages)
+        if cfg["impl"] == 1 and _pallas_ok(q.dtype, H, D, page_size,
+                                           n_pages, cfg):
+            _stats["pallas"] += 1
+            return _paged_attn_pallas(q, k_pages, v_pages, block_tables,
+                                      context_lens, float(scale),
+                                      cfg["heads"], interpret=_INTERPRET)
+    _stats["xla"] += 1
+    return paged_attention_xla(q, k_pages, v_pages, block_tables,
+                               context_lens, scale=scale)
+
+
+# ----------------------------- cache append ----------------------------------
+
+
+def _append_impl(k_pages, v_pages, k_new, v_new, block_tables,
+                 context_lens, active):
+    """Scatter one new K/V token per ACTIVE sequence into its current
+    page slot. Inactive slots write to the null page 0 at offset 0
+    (garbage the attention mask never reads — the serving allocator
+    reserves page 0)."""
+    page_size = k_pages.shape[1]
+    slot = jnp.take_along_axis(
+        block_tables, (context_lens // page_size)[:, None], axis=1)[:, 0]
+    off = context_lens % page_size
+    slot = jnp.where(active, slot, 0)
+    off = jnp.where(active, off, 0)
+    k_pages = k_pages.at[slot, off].set(k_new.astype(k_pages.dtype))
+    v_pages = v_pages.at[slot, off].set(v_new.astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
+_append_jit = jax.jit(_append_impl, donate_argnums=(0, 1))
+
+
+def cache_append(k_pages, v_pages, k_new, v_new, block_tables,
+                 context_lens, active=None):
+    """Append k_new/v_new [B, H, D] at position context_lens[b] of each
+    active sequence. Returns the updated pools.
+
+    Eagerly this routes through a jitted scatter whose page pools are
+    DONATED, so XLA updates the buffers in place — the decode loop never
+    copies the pool per token. Under an outer trace the raw scatter
+    inlines (the outer jit owns donation there). Callers must drop their
+    references to the passed-in pools (the returned arrays replace
+    them)."""
+    _stats["append"] += 1
+    if active is None:
+        active = jnp.ones(k_new.shape[:1], bool)
+    if isinstance(jnp.asarray(context_lens), jax.core.Tracer) or \
+            isinstance(k_pages, jax.core.Tracer):
+        return _append_impl(k_pages, v_pages, k_new, v_new, block_tables,
+                            context_lens, active)
+    return _append_jit(k_pages, v_pages, k_new, v_new, block_tables,
+                       context_lens, active)
+
+
+def prefill_append(k_pages, v_pages, k_seq, v_seq, page_ids, length):
+    """Scatter a whole prompt's K/V [L, H, D] into the pages of ONE
+    sequence: position i lands in page_ids[i // page_size] at offset
+    i % page_size. Positions at/past `length` (bucket padding) go to the
+    null page 0. `page_ids` is the sequence's block-table row [n_pages].
+    Traceable (used inside the jitted prefill step)."""
+    page_size = k_pages.shape[1]
+    L = k_seq.shape[0]
+    pos = jnp.arange(L, dtype=jnp.int32)
+    live = pos < length
+    pages = jnp.where(live, page_ids[pos // page_size], 0)
+    offs = jnp.where(live, pos % page_size, 0)
+    k_pages = k_pages.at[pages, offs].set(k_seq.astype(k_pages.dtype))
+    v_pages = v_pages.at[pages, offs].set(v_seq.astype(v_pages.dtype))
+    return k_pages, v_pages

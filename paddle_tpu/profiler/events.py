@@ -70,6 +70,10 @@ KINDS = (
                             # rollback), with policy/evidence/outcome
     "elastic_budget_reset",  # sustained-healthy window restored the
                              # supervisor's restart budget
+    "serving_admission",  # serving engine admitted a request into the
+                          # continuous decode batch (slot, bucket, wait)
+    "serving_eviction",   # a request left the decode batch (eos/length/
+                          # preempted/failed), pages freed
 )
 
 SEVERITIES = ("debug", "info", "warn", "error")

@@ -45,8 +45,8 @@ def test_one_config_failure_does_not_sink_others(capsys, monkeypatch):
         "tokens_per_sec_chip": 123.0, "step_time_ms": 1.0, "mfu": 0.5})
     monkeypatch.setattr(bench, "bench_resnet50",
                         lambda: (_ for _ in ()).throw(RuntimeError("boom")))
-    for name in ("bench_bert_base", "bench_wide_deep_ps",
-                 "bench_wide_deep_ps_tpu"):
+    for name in ("bench_gpt2_decode", "bench_bert_base",
+                 "bench_wide_deep_ps", "bench_wide_deep_ps_tpu"):
         monkeypatch.setattr(bench, name, lambda: {"ok": 1})
     rec = _run_main(bench, capsys)
     assert rec["value"] == 123.0
@@ -66,8 +66,9 @@ def test_one_config_failure_does_not_sink_others(capsys, monkeypatch):
 def test_flagship_failure_still_prints_json(capsys, monkeypatch):
     bench = _load_bench()
     monkeypatch.setattr(bench, "_init_backend_with_retry", lambda: None)
-    for name in ("bench_gpt2", "bench_resnet50", "bench_bert_base",
-                 "bench_wide_deep_ps", "bench_wide_deep_ps_tpu"):
+    for name in ("bench_gpt2", "bench_gpt2_decode", "bench_resnet50",
+                 "bench_bert_base", "bench_wide_deep_ps",
+                 "bench_wide_deep_ps_tpu"):
         monkeypatch.setattr(
             bench, name,
             lambda: (_ for _ in ()).throw(RuntimeError("all dead")))
@@ -86,7 +87,7 @@ def test_bench_json_includes_observability_snapshot(capsys, monkeypatch):
     monkeypatch.setattr(bench, "_init_backend_with_retry", lambda: None)
     monkeypatch.setattr(bench, "bench_gpt2", lambda: {
         "tokens_per_sec_chip": 1.0, "step_time_ms": 1.0, "mfu": 0.5})
-    for name in ("bench_resnet50", "bench_bert_base",
+    for name in ("bench_gpt2_decode", "bench_resnet50", "bench_bert_base",
                  "bench_wide_deep_ps", "bench_wide_deep_ps_tpu"):
         monkeypatch.setattr(bench, name, lambda: {"ok": 1})
     # a timed run would have appended one of these (schema from monitor.py)

@@ -388,6 +388,104 @@ class TestStragglerDebounce:
         assert cmd["env"]["PADDLE_TPU_FLEET_REPORTER"] == "1"
 
 
+class TestDiagAwareEviction:
+    """ROADMAP item-3 follow-up: step_diagnosis feeds the eviction
+    evidence — a confirmed straggler whose dominant wall-time term is
+    data_wait is slow because of the INPUT PIPELINE, so the controller
+    decides action="skip" naming the culprit instead of evicting the
+    host (the stall would just move to the relaunched N-1 fleet)."""
+
+    def _ctl(self, bus, **kw):
+        agg = _Agg()
+        kw.setdefault("confirm_windows", 2)
+        kw.setdefault("readmit_after_s", 9999)
+        return FleetController(agg, bus, world_size=2, **kw), agg
+
+    @staticmethod
+    def _digests(dominant):
+        d = _base_digests()
+        d[1]["diag_dominant"] = dominant
+        return d
+
+    def test_data_wait_dominant_skips_instead_of_evicting(self):
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus)
+        for _ in range(4):
+            _tick(ctl, agg, ["trainer-1"], self._digests("data_wait"))
+        # nothing published, fleet stays at N, but the decision is logged
+        assert bus.last_id() == 0
+        assert ctl._evicted is None
+        recs = _decisions()
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["policy"] == "straggler_skip"
+        assert rec["action"] == "skip"
+        assert rec["target"] == "trainer-1"
+        assert rec["outcome"] == "applied"
+        assert rec["evidence"]["diag_dominant"] == "data_wait"
+        assert rec["evidence"]["culprit"] == "input_pipeline"
+
+    def test_skip_suppresses_until_recovery_then_redecides(self):
+        """The skip is one decision per excursion (hysteresis like an
+        eviction); after recovery a relapse re-decides."""
+        ctl, agg = self._ctl(None, dry_run=False)
+        digests = self._digests("data_wait")
+        for _ in range(5):
+            _tick(ctl, agg, ["trainer-1"], self._digests("data_wait"))
+        assert len(_decisions()) == 1
+        _tick(ctl, agg, [], digests)  # recovery re-arms
+        for _ in range(2):
+            _tick(ctl, agg, ["trainer-1"], self._digests("data_wait"))
+        assert len(_decisions()) == 2
+
+    def test_other_dominant_term_still_evicts(self):
+        bus = ControllerCommandBus(FakeStore())
+        ctl, agg = self._ctl(bus)
+        for _ in range(2):
+            _tick(ctl, agg, ["trainer-1"], self._digests("device_compute"))
+        cmds = bus.poll(0)
+        assert [c["action"] for c in cmds] == ["evict"]
+        assert _decisions()[0]["policy"] == "straggler_evict"
+        # the eviction evidence names the diagnosed dominant term
+        assert _decisions()[0]["evidence"]["diag_dominant"] == \
+            "device_compute"
+
+    def test_skip_fires_even_when_eviction_is_infeasible(self):
+        """Review regression: the skip sat BELOW the eviction-only
+        feasibility guards, so the input-pipeline diagnosis was silently
+        dropped exactly when eviction was impossible (min_world floor /
+        a host already held / partial rank map) — the operator never
+        learned the real culprit. A skip publishes nothing and needs
+        none of those guards."""
+        # min_world == world: eviction impossible, skip must still log
+        ctl, agg = self._ctl(None, min_world=2)
+        for _ in range(2):
+            _tick(ctl, agg, ["trainer-1"], self._digests("data_wait"))
+        recs = _decisions()
+        assert len(recs) == 1 and recs[0]["policy"] == "straggler_skip"
+        events.default_event_log().clear()
+        # partial assignment (one host never reported): same story
+        ctl2, agg2 = self._ctl(None)
+        for _ in range(2):
+            d = {1: _digest("trainer-1", 1)}  # fresh ts: streak advances
+            d[1]["diag_dominant"] = "data_wait"
+            _tick(ctl2, agg2, ["trainer-1"], d)
+        recs = _decisions()
+        assert len(recs) == 1 and recs[0]["policy"] == "straggler_skip"
+
+    def test_skip_decision_never_closes_as_a_relaunch(self):
+        """A skip (cmd_id None) actuates nothing: the first-steps
+        observer must not report relaunch_to_first_step_s for it."""
+        ctl, agg = self._ctl(None)
+        for _ in range(3):
+            _tick(ctl, agg, ["trainer-1"], self._digests("data_wait"))
+        rec = ctl.decisions[-1]
+        assert rec["policy"] == "straggler_skip"
+        assert rec["relaunch_to_first_step_s"] is None
+        assert all(e.get("action") != "relaunch_observed"
+                   for e in events.recent(100, kind="controller_decision"))
+
+
 class TestReadmission:
     def test_readmit_after_fresh_ready_beat_and_cooldown(self):
         bus = ControllerCommandBus(FakeStore())

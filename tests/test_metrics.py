@@ -206,6 +206,7 @@ class TestMetricNamingLint:
         import paddle_tpu.distributed.ps.communicator  # noqa: F401
         import paddle_tpu.distributed.ps.heter  # noqa: F401
         import paddle_tpu.fault  # noqa: F401
+        import paddle_tpu.inference.serving  # noqa: F401
         import paddle_tpu.io.dataloader  # noqa: F401
         import paddle_tpu.io.worker  # noqa: F401
         import paddle_tpu.ops._dispatch  # noqa: F401
@@ -273,10 +274,22 @@ class TestMetricNamingLint:
         # gauge (policy=)
         from paddle_tpu.distributed.fleet import controller as _ctl
         _ctl._M_DECISIONS.inc(policy="straggler_evict", outcome="applied")
+        _ctl._M_DECISIONS.inc(policy="straggler_skip", outcome="applied")
         _ctl._M_EVICTIONS.inc(host="trainer-1")
         _ctl._M_ROLLBACKS.inc(host="trainer-1")
         _ctl._M_READMISSIONS.inc(host="trainer-1")
         _ctl._M_FIRST_STEP.set(1.5, policy="straggler_evict")
+        # continuous-batching serving families (model=) + the paged-KV
+        # decode kernel's autotune op riding the existing families
+        from paddle_tpu.inference import serving as _srv
+        _srv._M_QUEUE.set(2, model="gpt")
+        _srv._M_OCC.set(1, model="gpt")
+        _srv._M_TTFT.observe(0.05, model="gpt")
+        _srv._M_TPOT.observe(0.01, model="gpt")
+        _srv._M_GOODPUT.inc(8, model="gpt")
+        _at._M_EVENTS.inc(event="hit", op="paged_attn")
+        _at._M_TUNES.inc(op="paged_attn")
+        _at._M_CHOSEN.set(1.0, op="paged_attn", config="impl1-heads12")
         reg = metrics.default_registry()
         problems = []
         for name in reg.names():

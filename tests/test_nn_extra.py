@@ -285,6 +285,140 @@ class TestBeamSearch:
         assert (ids.numpy()[:, 0] == 5).all()  # best beam rides token 5
 
 
+class TestDynamicDecodeEarlyStop:
+    """dynamic_decode early-stop contract: when every beam hits
+    end_token before max_step_num the loop stops at the finishing step
+    (the cell is never over-stepped and the dead states are not
+    reordered one last time), finished beams extend only with end_token
+    at zero cost, and a finished beam's state chain stays its own."""
+
+    END, V = 7, 10
+
+    def _script_cell(self, plan):
+        """Cell whose per-call logits come from `plan` (a list of
+        {input_token: logits_row} dicts, last entry repeating); state is
+        a base-100 token-history fingerprint: new = state*100 + token."""
+        from paddle_tpu.framework.tensor import Tensor
+        import jax.numpy as jnp
+        calls = {"n": 0, "states_in": [], "tokens_in": []}
+
+        class Cell:
+            def __call__(cell_self, tokens, states):
+                t = min(calls["n"], len(plan) - 1)
+                calls["n"] += 1
+                tok_np = np.asarray(tokens.data)
+                calls["tokens_in"].append(tok_np.copy())
+                calls["states_in"].append(np.asarray(states.data).copy())
+                logits = np.stack([plan[t].get(int(tk),
+                                               np.full(self.V, -5.0,
+                                                       np.float32))
+                                   for tk in tok_np])
+                new_states = Tensor(
+                    states.data * 100.0 + jnp.asarray(
+                        tok_np[:, None].astype(np.float32)))
+                return Tensor(jnp.asarray(logits)), new_states
+
+        return Cell(), calls
+
+    def _row(self, **tok_logit):
+        row = np.full(self.V, -20.0, np.float32)
+        for tok, lg in tok_logit.items():
+            row[int(tok[1:])] = lg
+        return row
+
+    def test_all_beams_end_early_no_overstep(self):
+        """Every beam decisively emits end at step 2: the decode must
+        stop there — cell called exactly twice, T == 2."""
+        from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+        from paddle_tpu.ops import zeros
+        plan = [{1: self._row(t2=4.0, t3=3.0)},     # step 1: tokens 2/3
+                {2: self._row(t7=30.0), 3: self._row(t7=30.0)}]
+        cell, calls = self._script_cell(plan)
+        dec = BeamSearchDecoder(cell, start_token=1, end_token=self.END,
+                                beam_size=2)
+        ids, scores = dynamic_decode(dec, inits=zeros([1, 1]),
+                                     max_step_num=10)
+        assert calls["n"] == 2, "over-stepped past the all-finished step"
+        assert tuple(ids.shape) == (1, 2, 2)
+        assert np.asarray(ids.data)[0, 0].tolist() == [2, self.END]
+        assert np.asarray(ids.data)[0, 1].tolist() == [3, self.END]
+
+    def test_finished_beam_keeps_own_state_and_zero_cost_extension(self):
+        """Beam 0 finishes at step 2 while beam 1 runs on: the finished
+        beam's state fed into later cell steps is ITS OWN chain (parent
+        == itself, never re-gathered from the live beam), its token
+        extensions are all end_token, and its score stays frozen."""
+        from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+        from paddle_tpu.ops import zeros
+        plan = [
+            {1: self._row(t2=4.0, t3=3.0)},          # beams (2), (3)
+            {2: self._row(t7=30.0),                  # beam (2) finishes
+             3: self._row(t5=3.0)},                  # beam (3) -> 5
+            {self.END: self._row(),                  # finished: all floor
+             5: self._row(t7=30.0)},                 # beam (3,5) finishes
+        ]
+        cell, calls = self._script_cell(plan)
+        dec = BeamSearchDecoder(cell, start_token=1, end_token=self.END,
+                                beam_size=2)
+        ids, scores = dynamic_decode(dec, inits=zeros([1, 1]),
+                                     max_step_num=10)
+        assert calls["n"] == 3
+        out = np.asarray(ids.data)[0]
+        rows = {tuple(r) for r in out.tolist()}
+        # finished beam extended ONLY with end_token
+        assert (2, self.END, self.END) in rows
+        assert (3, 5, self.END) in rows
+        # call 3's states: the finished beam carried its own fingerprint
+        # chain 0 -> 1 -> 102 (start, then token 2), NOT the live beam's
+        # 103 — finished beams are never re-gathered from another parent
+        st3 = calls["states_in"][2].ravel().tolist()
+        tk3 = calls["tokens_in"][2].tolist()
+        fin_rows = [i for i, t in enumerate(tk3) if t == self.END]
+        assert fin_rows, f"no finished-beam row in step-3 inputs {tk3}"
+        for i in fin_rows:
+            assert st3[i] == 102.0, (st3, tk3)
+        # zero-cost extension: the finished hypothesis' score is exactly
+        # its score at finish time (log-softmax of a 30-margin row ~ 0)
+        s = np.asarray(scores.data)[0]
+        best = s.max()
+        assert abs(best - s[out.tolist().index([2, self.END, self.END])]) \
+            < 1e-6
+
+    def test_batch_rows_finish_independently(self):
+        """One batch row finishing early must not stop the other."""
+        from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+        from paddle_tpu.framework.tensor import Tensor
+        import jax.numpy as jnp
+
+        calls = {"n": 0}
+
+        class Cell:
+            def __call__(cell_self, tokens, states):
+                calls["n"] += 1
+                tok = np.asarray(tokens.data)
+                B = tok.shape[0]
+                logits = np.full((B, self.V), -5.0, np.float32)
+                half = B // 2
+                # batch row 0 (first half of merged beams): end now;
+                # batch row 1: end only from call 3
+                logits[:half, self.END] = 30.0
+                if calls["n"] >= 3:
+                    logits[half:, self.END] = 30.0
+                else:
+                    logits[half:, 4] = 6.0
+                return (Tensor(jnp.asarray(logits)),
+                        Tensor(states.data + 1.0))
+
+        from paddle_tpu.ops import zeros
+        dec = BeamSearchDecoder(Cell(), start_token=1, end_token=self.END,
+                                beam_size=2)
+        ids, _ = dynamic_decode(dec, inits=zeros([2, 3]), max_step_num=10)
+        assert calls["n"] == 3
+        out = np.asarray(ids.data)
+        assert out[0, 0].tolist() == [self.END, self.END, self.END]
+        assert out[1, 0].tolist() == [4, 4, self.END]
+
+
 class TestTopLevelExtras:
     def test_assorted(self):
         x = paddle.to_tensor(np.array([[1.0, 2], [3, 4]], np.float32))

@@ -1,0 +1,400 @@
+"""Paged KV-cache decode stack (ops/pallas/paged_attention.py +
+models/gpt.py decode path): kernel parity vs the dense gather reference
+(Pallas interpreter on CPU), cache-append semantics (null page, donated
+eager buffers), the autotune `paged_attn` op (impl axis + cross-process
+disk-cache hit), and greedy-decode parity paged-vs-cacheless.
+
+fast-sibling: every class here is tier-1 except the timing probe
+(TestSuperLinear.test_per_token_cost_flat_vs_dense_slow), whose fast
+sibling is test_paged_growth_structure.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig, PagedKVCache
+from paddle_tpu.ops.pallas import autotune
+from paddle_tpu.ops.pallas import paged_attention as pa
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def interp(monkeypatch):
+    """Kernel under the Pallas interpreter + force-mode tuning with a
+    private cache dir (the CI shortcut)."""
+    autotune.reset_for_tests()
+    monkeypatch.setattr(pa, "_INTERPRET", True)
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "force")
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_REPEATS", "1")
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_MAX_CONFIGS", "3")
+    monkeypatch.delenv("PADDLE_TPU_AUTOTUNE_CACHE_DIR", raising=False)
+    yield
+    autotune.reset_for_tests()
+
+
+def _rand_pool(rng, B, H, D, page_size, num_pages, pages_per_seq):
+    q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
+    kp = jnp.asarray(rng.normal(
+        size=(num_pages, page_size, H, D)).astype(np.float32))
+    vp = jnp.asarray(rng.normal(
+        size=(num_pages, page_size, H, D)).astype(np.float32))
+    bt = jnp.asarray(rng.integers(
+        0, num_pages, (B, pages_per_seq)).astype(np.int32))
+    return q, kp, vp, bt
+
+
+class TestKernelParity:
+    def test_pallas_matches_dense_reference(self, interp):
+        rng = np.random.default_rng(0)
+        q, kp, vp, bt = _rand_pool(rng, 3, 12, 64, 8, 10, 4)
+        cl = jnp.asarray(np.array([13, 5, 32], np.int32))
+        pa._stats["pallas"] = pa._stats["xla"] = 0
+        out = pa.paged_attention(q, kp, vp, bt, cl)
+        assert pa._stats["pallas"] == 1, "Pallas path not taken"
+        ref = pa.paged_attention_xla(q, kp, vp, bt, cl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=0, atol=2e-6)
+
+    def test_zero_context_slot_outputs_zero(self, interp):
+        """An idle serving slot (ctx=0, block table on the null page)
+        must output exactly zero on BOTH impls."""
+        rng = np.random.default_rng(1)
+        q, kp, vp, bt = _rand_pool(rng, 2, 4, 64, 8, 6, 3)
+        cl = jnp.asarray(np.array([0, 17], np.int32))
+        out = pa.paged_attention(q, kp, vp, bt, cl)
+        ref = pa.paged_attention_xla(q, kp, vp, bt, cl)
+        assert np.all(np.asarray(out)[0] == 0.0)
+        assert np.all(np.asarray(ref)[0] == 0.0)
+        np.testing.assert_allclose(np.asarray(out)[1], np.asarray(ref)[1],
+                                   atol=2e-6)
+
+    def test_partial_last_page_is_masked(self, interp):
+        """Positions past ctx on the last live page must not contribute:
+        poisoning them with huge values changes nothing."""
+        rng = np.random.default_rng(2)
+        q, kp, vp, bt = _rand_pool(rng, 1, 4, 64, 8, 6, 3)
+        cl = jnp.asarray(np.array([11], np.int32))  # page 1 holds 3 live
+        out = pa.paged_attention(q, kp, vp, bt, cl)
+        last_page = int(np.asarray(bt)[0, 1])
+        kp2 = kp.at[last_page, 3:].set(1e4)
+        vp2 = vp.at[last_page, 3:].set(1e4)
+        out2 = pa.paged_attention(q, kp2, vp2, bt, cl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                                   atol=2e-6)
+
+    def test_head_split_configs_agree(self, interp):
+        """Every heads candidate regroups grid programs only — outputs
+        are identical across head-block choices."""
+        rng = np.random.default_rng(3)
+        q, kp, vp, bt = _rand_pool(rng, 2, 8, 64, 8, 8, 3)
+        cl = jnp.asarray(np.array([20, 9], np.int32))
+        outs = [
+            np.asarray(pa._paged_attn_pallas(q, kp, vp, bt, cl,
+                                             1.0 / 8.0, bh, interpret=True))
+            for bh in (2, 4, 8)]
+        for o in outs[1:]:
+            np.testing.assert_array_equal(outs[0], o)
+
+    def test_cpu_without_interpret_takes_xla(self):
+        rng = np.random.default_rng(4)
+        q, kp, vp, bt = _rand_pool(rng, 1, 2, 32, 4, 4, 2)
+        cl = jnp.asarray(np.array([5], np.int32))
+        pa._stats["pallas"] = pa._stats["xla"] = 0
+        pa.paged_attention(q, kp, vp, bt, cl)
+        assert pa._stats["xla"] == 1 and pa._stats["pallas"] == 0
+
+
+class TestCacheAppend:
+    def test_append_lands_in_block_table_slot(self):
+        page_size = 4
+        kp = jnp.zeros((5, page_size, 2, 8), jnp.float32)
+        vp = jnp.zeros_like(kp)
+        bt = jnp.asarray(np.array([[2, 3], [4, 1]], np.int32))
+        cl = jnp.asarray(np.array([5, 2], np.int32))
+        k_new = jnp.ones((2, 2, 8), jnp.float32)
+        v_new = 2.0 * jnp.ones((2, 2, 8), jnp.float32)
+        kp, vp = pa.cache_append(kp, vp, k_new, v_new, bt, cl)
+        kp_np = np.array(kp)
+        # row 0: ctx 5 -> page bt[0, 1]=3, offset 1
+        assert np.all(kp_np[3, 1] == 1.0)
+        # row 1: ctx 2 -> page bt[1, 0]=4, offset 2
+        assert np.all(kp_np[4, 2] == 1.0)
+        assert np.all(np.asarray(vp)[3, 1] == 2.0)
+        # nothing else touched
+        kp_np[3, 1] = kp_np[4, 2] = 0.0
+        assert np.all(kp_np == 0.0)
+
+    def test_inactive_rows_write_only_the_null_page(self):
+        page_size = 4
+        kp = jnp.zeros((4, page_size, 2, 8), jnp.float32)
+        vp = jnp.zeros_like(kp)
+        bt = jnp.asarray(np.array([[1, 2], [3, 0]], np.int32))
+        cl = jnp.asarray(np.array([0, 1], np.int32))
+        active = jnp.asarray(np.array([False, True]))
+        k_new = jnp.ones((2, 2, 8), jnp.float32)
+        kp, vp = pa.cache_append(kp, vp, k_new, k_new, bt, cl, active)
+        kp_np = np.asarray(kp)
+        assert np.all(kp_np[3, 1] == 1.0)    # the active row's write
+        assert np.all(kp_np[1] == 0.0)       # inactive row's pages clean
+        assert np.all(kp_np[2] == 0.0)
+
+    def test_eager_append_donates_the_pool(self):
+        """The eager append routes through the donating jit: the passed
+        pool buffer is consumed (deleted), not copied per token."""
+        kp = jnp.zeros((4, 4, 2, 8), jnp.float32)
+        vp = jnp.zeros_like(kp)
+        bt = jnp.zeros((1, 2), jnp.int32)
+        cl = jnp.zeros((1,), jnp.int32)
+        k_new = jnp.ones((1, 2, 8), jnp.float32)
+        kp2, vp2 = pa.cache_append(kp, vp, k_new, k_new, bt, cl)
+        assert kp2 is not kp
+        assert kp.is_deleted(), "pool was copied, not donated"
+        assert vp.is_deleted()
+
+    def test_prefill_append_scatter(self):
+        page_size = 4
+        kp = jnp.zeros((6, page_size, 2, 8), jnp.float32)
+        vp = jnp.zeros_like(kp)
+        page_ids = jnp.asarray(np.array([2, 5, 0], np.int32))
+        L = 9
+        k_seq = jnp.broadcast_to(
+            jnp.arange(1, L + 1, dtype=jnp.float32)[:, None, None],
+            (L, 2, 8))
+        kp, vp = pa.prefill_append(kp, vp, k_seq, k_seq, page_ids,
+                                   jnp.int32(6))  # only 6 of 9 live
+        kp_np = np.asarray(kp)
+        assert np.all(kp_np[2, 0] == 1.0) and np.all(kp_np[2, 3] == 4.0)
+        assert np.all(kp_np[5, 0] == 5.0) and np.all(kp_np[5, 1] == 6.0)
+        # padded positions (7, 8, 9) landed on the null page, not page 5
+        assert np.all(kp_np[5, 2:] == 0.0)
+
+
+class TestAutotunePagedAttn:
+    def test_impl_axis_candidates_include_xla(self, interp, monkeypatch):
+        """The candidate space registered for op paged_attn carries the
+        measured impl axis: Pallas head-block shapes AND the impl=0 XLA
+        gather, conv_bn-style."""
+        seen = {}
+        real = autotune.get_config
+
+        def spy(op, key, candidates, default, bench, interpret=False):
+            if op == "paged_attn":
+                seen["cands"] = list(candidates)
+            return real(op, key, candidates, default, bench,
+                        interpret=interpret)
+
+        monkeypatch.setattr(autotune, "get_config", spy)
+        rng = np.random.default_rng(5)
+        q, kp, vp, bt = _rand_pool(rng, 1, 8, 64, 8, 4, 2)
+        pa.paged_attention(q, kp, vp, bt, jnp.asarray(np.array([9],
+                                                              np.int32)))
+        impls = {c["impl"] for c in seen["cands"]}
+        assert impls == {0, 1}
+        heads = {c["heads"] for c in seen["cands"] if c["impl"] == 1}
+        assert 8 in heads and len(heads) > 1
+
+    def test_tuned_log_names_the_op(self, interp):
+        rng = np.random.default_rng(6)
+        q, kp, vp, bt = _rand_pool(rng, 1, 4, 64, 8, 4, 2)
+        pa.paged_attention(q, kp, vp, bt,
+                           jnp.asarray(np.array([7], np.int32)))
+        ops = [t["op"] for t in autotune.tuned_log()]
+        assert "paged_attn" in ops
+
+
+_XPROC_CHILD = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import json
+import numpy as np
+import jax.numpy as jnp
+from paddle_tpu.ops.pallas import autotune
+from paddle_tpu.ops.pallas import paged_attention as pa
+pa._INTERPRET = True
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.normal(size=(2, 4, 64)).astype(np.float32))
+kp = jnp.asarray(rng.normal(size=(4, 8, 4, 64)).astype(np.float32))
+bt = jnp.zeros((2, 2), jnp.int32)
+cl = jnp.asarray(np.array([9, 3], np.int32))
+out = pa.paged_attention(q, kp, kp, bt, cl)
+print("RESULT" + json.dumps({
+    "o0": float(np.asarray(out).ravel()[0]),
+    "hit": autotune._M_EVENTS.value(event="hit", op="paged_attn"),
+    "miss": autotune._M_EVENTS.value(event="miss", op="paged_attn"),
+    "tunes": autotune._M_TUNES.value(op="paged_attn"),
+    "persist": autotune._M_EVENTS.value(event="persist", op="paged_attn"),
+}))
+"""
+
+
+class TestPagedAttnCrossProcessCache:
+    """Acceptance: op paged_attn shows a cross-process autotune cache
+    hit — process A tunes + persists, process B resolves with ZERO
+    probes (no tune, hit counter > 0)."""
+
+    @staticmethod
+    def _run_child(cache_dir):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "PADDLE_TPU_AUTOTUNE": "force",
+                    "PADDLE_TPU_AUTOTUNE_CACHE_DIR": str(cache_dir),
+                    "PADDLE_TPU_AUTOTUNE_REPEATS": "1",
+                    "PADDLE_TPU_AUTOTUNE_MAX_CONFIGS": "3"})
+        proc = subprocess.run(
+            [sys.executable, "-c", _XPROC_CHILD], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        for line in proc.stdout.splitlines():
+            if line.startswith("RESULT"):
+                return json.loads(line[len("RESULT"):])
+        raise AssertionError(f"child printed no RESULT: {proc.stdout!r}")
+
+    def test_tune_once_hit_everywhere(self, tmp_path):
+        a = self._run_child(tmp_path)
+        assert a["miss"] == 1 and a["tunes"] == 1 and a["persist"] == 1
+        b = self._run_child(tmp_path)
+        assert b["o0"] == a["o0"]
+        assert b["hit"] > 0 and b["miss"] == 0 and b["tunes"] == 0
+
+
+class TestGPTDecodeParity:
+    """Greedy-token parity: the paged incremental decode must produce
+    the SAME tokens as the cacheless full-recompute path (bit-exact on
+    this box — both paths run f32 XLA on CPU; TPU tolerance is the
+    kernels' documented f32-accumulation ULP)."""
+
+    def _model(self):
+        paddle.seed(0)
+        cfg = GPTConfig.tiny()
+        m = GPT(cfg)
+        m.eval()
+        return m, cfg
+
+    def test_greedy_tokens_match_dense(self):
+        m, cfg = self._model()
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(1, cfg.vocab_size, (2, 12)).astype("int32"))
+        dense = np.asarray(m.generate_dense(ids, 8).data)
+        paged = np.asarray(m.generate_paged(ids, 8, page_size=8).data)
+        np.testing.assert_array_equal(dense, paged)
+
+    def test_greedy_parity_on_pallas_interpret(self, interp):
+        """Same parity with the decode attention on the Pallas kernel
+        (interpret mode): tokens still match the dense path."""
+        m, cfg = self._model()
+        rng = np.random.default_rng(1)
+        ids = paddle.to_tensor(
+            rng.integers(1, cfg.vocab_size, (1, 9)).astype("int32"))
+        pa._stats["pallas"] = 0
+        paged = np.asarray(m.generate_paged(ids, 6, page_size=8).data)
+        assert pa._stats["pallas"] > 0, "decode did not use the kernel"
+        dense = np.asarray(m.generate_dense(ids, 6).data)
+        np.testing.assert_array_equal(dense, paged)
+
+    def test_zero_new_tokens_matches_dense_contract(self):
+        """Review regression: generate_paged(ids, 0) returned [B, L+1]
+        (prefill's token appended before the budget check) while
+        generate_dense returned [B, L]."""
+        m, cfg = self._model()
+        rng = np.random.default_rng(9)
+        ids = paddle.to_tensor(
+            rng.integers(1, cfg.vocab_size, (1, 6)).astype("int32"))
+        assert tuple(m.generate_paged(ids, 0).shape) == (1, 6)
+        assert tuple(m.generate_dense(ids, 0).shape) == (1, 6)
+
+    def test_prefill_matches_training_forward_logits(self):
+        """The prefill's last-position logits equal the training
+        forward's — one source of truth for the first generated token."""
+        m, cfg = self._model()
+        rng = np.random.default_rng(2)
+        ids_np = rng.integers(1, cfg.vocab_size, (1, 10)).astype("int32")
+        ids = paddle.to_tensor(ids_np)
+        full = np.asarray(m(ids).data)[0, -1]
+        cache = m.init_cache(1, 32, page_size=8)
+        import jax.numpy as jnp2
+        cache.block_tables = jnp2.asarray(
+            np.arange(1, 5, dtype=np.int32)[None])
+        logits, cache = m.forward_prefill(ids, cache, 0, 10)
+        np.testing.assert_allclose(np.asarray(logits.data)[0], full,
+                                   rtol=1e-5, atol=1e-5)
+        assert int(np.asarray(cache.context_lens)[0]) == 10
+
+    def test_bucketed_prefill_padding_is_inert(self):
+        """Padding the prompt to a shape bucket must not change the
+        prefilled K/V or the last-position logits."""
+        m, cfg = self._model()
+        rng = np.random.default_rng(3)
+        ids_np = rng.integers(1, cfg.vocab_size, (1, 7)).astype("int32")
+        padded = np.zeros((1, 16), np.int32)
+        padded[:, :7] = ids_np
+
+        def run(arr):
+            cache = m.init_cache(1, 32, page_size=8)
+            import jax.numpy as jnp2
+            cache.block_tables = jnp2.asarray(
+                np.arange(1, 5, dtype=np.int32)[None])
+            logits, cache = m.forward_prefill(
+                paddle.to_tensor(arr), cache, 0, 7)
+            return np.asarray(logits.data), \
+                np.asarray(cache.k_pages[0])
+
+        lo_a, kp_a = run(ids_np)
+        lo_b, kp_b = run(padded)
+        np.testing.assert_allclose(lo_a, lo_b, rtol=1e-6, atol=1e-6)
+        # real pages identical; page 0 (the null page) is the designated
+        # dump for padded positions' K/V and legitimately differs
+        np.testing.assert_array_equal(kp_a[1:], kp_b[1:])
+
+
+class TestSuperLinear:
+    """Acceptance: per-token decode cost ~flat as context grows on the
+    paged path while the cacheless path grows with context length."""
+
+    def _model(self):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=2048, max_position_embeddings=512,
+                        hidden_size=128, num_layers=2, num_heads=4,
+                        dropout=0.0, attn_dropout=0.0)
+        m = GPT(cfg)
+        m.eval()
+        return m
+
+    def test_paged_growth_structure(self):
+        """Fast sibling: the A/B probe produces well-formed rows and the
+        paged executable is context-INDEPENDENT by construction — the
+        decode step compiled once serves every context length (no
+        retrace as ctx grows), which is what makes its per-token cost
+        flat."""
+        import bench
+        m = self._model()
+        ab = bench._paged_vs_dense_ab(m, (16, 32), page_size=8,
+                                      n_tokens=2, dense_iters=1)
+        assert [r["ctx"] for r in ab["rows"]] == [16, 32]
+        for r in ab["rows"]:
+            assert r["paged_ms_per_token"] > 0
+            assert r["dense_ms_per_token"] > 0
+
+    @pytest.mark.slow
+    def test_per_token_cost_flat_vs_dense_slow(self):
+        """The measured acceptance A/B at CI scale: over a 4x context
+        growth the dense per-token cost must grow markedly while the
+        paged per-token cost stays ~flat (generous margins: CPU wall
+        clocks on a busy CI box)."""
+        import bench
+        m = self._model()
+        ab = bench._paged_vs_dense_ab(m, (64, 128, 256), page_size=8,
+                                      n_tokens=6, dense_iters=3)
+        assert ab["dense_growth"] > 1.4, ab
+        assert ab["paged_growth"] < ab["dense_growth"] / 1.3, ab
+        assert ab["speedup_at_max_ctx"] > 1.0, ab

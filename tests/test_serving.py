@@ -1,0 +1,333 @@
+"""Continuous-batching serving engine (inference/serving.py): page
+allocator, per-iteration admission into the fixed decode batch,
+bucketed-prefill retrace boundedness, EOS/length completion with page
+freeing, pool-exhaustion preemption, the serving_* metric families, and
+the admission/eviction event stream.
+
+fast-sibling: everything here is tier-1-fast (tiny GPT, XLA decode
+path); the serving-at-scale numbers live in bench.py's gpt2_decode
+config.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import (PageAllocator, Request,
+                                          ServingEngine)
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.profiler import events
+from paddle_tpu.profiler import metrics as metrics_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    events.default_event_log().clear()
+    yield
+    events.default_event_log().clear()
+
+
+def _model(vocab=512):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, max_position_embeddings=128,
+                    hidden_size=32, num_layers=2, num_heads=2,
+                    dropout=0.0, attn_dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _prompts(cfg, n, lo=4, hi=12, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size,
+                         (int(rng.integers(lo, hi)),)).tolist()
+            for _ in range(n)]
+
+
+class TestPageAllocator:
+    def test_null_page_never_handed_out(self):
+        a = PageAllocator(4)
+        got = a.alloc(3)
+        assert sorted(got) == [1, 2, 3]
+        assert a.alloc(1) is None
+
+    def test_partial_grab_never_dangles(self):
+        a = PageAllocator(4)
+        assert a.alloc(5) is None
+        assert a.free_pages == 3  # nothing leaked
+
+    def test_free_recycles_but_not_null(self):
+        a = PageAllocator(4)
+        got = a.alloc(2)
+        a.free(got + [0])  # the null page in a free list is ignored
+        assert a.free_pages == 3
+        assert 0 not in a._free
+
+
+class TestEngineBasics:
+    def test_requests_complete_with_exact_token_budget(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="t")
+        reqs = [eng.submit(p, max_new_tokens=5)
+                for p in _prompts(cfg, 5)]
+        eng.run_until_idle()
+        for r in reqs:
+            out = r.result(timeout=5)
+            assert len(out) == 5
+            assert r.state == "done" and r.finish_reason == "length"
+        # all pages back in the pool, batch empty
+        st = eng.status()
+        assert st["free_pages"] == eng.cache.num_pages - 1
+        assert st["occupancy"] == 0 and st["queue_depth"] == 0
+
+    def test_matches_reference_paged_decode(self):
+        """The engine's continuous-batching output for one request is
+        exactly the model's reference greedy paged decode."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=3, max_len=48, page_size=8,
+                            name="t")
+        prompts = _prompts(cfg, 4, seed=3)
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        eng.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            ids = paddle.to_tensor(np.asarray([p], np.int32))
+            ref = np.asarray(m.generate_paged(ids, 6, page_size=8).data)
+            assert r.result() == ref[0, len(p):].tolist()
+
+    def test_eos_frees_slot_early(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                            name="t")
+        probe = eng.submit(_prompts(cfg, 1)[0], max_new_tokens=6)
+        eng.run_until_idle()
+        # pick as EOS a token whose FIRST occurrence is past index 0, so
+        # the eos path must fire exactly at that position on the rerun
+        toks = probe.result()
+        j = next(i for i in range(1, len(toks))
+                 if toks[i] not in toks[:i])
+        req = eng.submit(probe.prompt, max_new_tokens=10, eos_id=toks[j])
+        eng.run_until_idle()
+        out = req.result()
+        assert req.finish_reason == "eos"
+        assert out == toks[:j + 1]
+        assert eng.status()["free_pages"] == eng.cache.num_pages - 1
+
+    def test_continuous_admission_refills_slots(self):
+        """More streams than slots: every iteration may admit — total
+        completions equal submissions and max occupancy == max_batch."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="t")
+        reqs = [eng.submit(p, max_new_tokens=4)
+                for p in _prompts(cfg, 7, seed=5)]
+        eng.run_until_idle()
+        assert all(len(r.result()) == 4 for r in reqs)
+        assert eng.stats["completed"] == 7
+        occ = metrics_mod.default_registry().get("serving_batch_occupancy")
+        assert occ.value(model="t") == 0.0  # drained at the end
+
+    def test_background_thread_drives_to_completion(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="bg")
+        eng.start(poll_s=0.002)
+        try:
+            reqs = [eng.submit(p, max_new_tokens=3)
+                    for p in _prompts(cfg, 3, seed=9)]
+            for r in reqs:
+                assert len(r.result(timeout=60)) == 3
+        finally:
+            eng.close()
+
+    def test_dead_decode_loop_fails_requests_not_hangs(self):
+        """Review regression: an exception out of step() used to kill
+        the background thread silently, stranding every client in
+        result() forever — it must fail outstanding requests loudly."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                            name="dead")
+        eng.step = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        req = eng.submit(_prompts(cfg, 1, seed=41)[0], max_new_tokens=3)
+        with pytest.warns(UserWarning, match="decode loop died"):
+            eng.start(poll_s=0.001)
+            with pytest.raises(RuntimeError, match="decode loop died"):
+                req.result(timeout=30)
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit([1, 2], max_new_tokens=1)
+
+    def test_submit_after_close_raises(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                            name="cl2")
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit([1, 2, 3], max_new_tokens=1)
+
+    def test_submit_validates_budget(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=32, page_size=8,
+                            name="t")
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            eng.submit(list(range(1, 30)), max_new_tokens=10)
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit([], max_new_tokens=1)
+
+
+class TestBucketedPrefill:
+    def test_prefill_signatures_bounded_by_buckets(self):
+        """Many distinct prompt lengths must compile at most
+        len(prefill_buckets) prefill signatures (the retrace-watchdog
+        quietness contract) and exactly ONE decode signature."""
+        from paddle_tpu.profiler.watchdog import get_watchdog
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=64, page_size=8,
+                            prefill_buckets=(16, 64), name="bk")
+        for p in _prompts(cfg, 8, lo=3, hi=40, seed=11):
+            eng.submit(p, max_new_tokens=2)
+        eng.run_until_idle()
+        wd = get_watchdog()
+        sigs = wd._seen
+        pre = sigs.get(("to_static", "serving_prefill:bk"), set())
+        dec = sigs.get(("to_static", "serving_decode:bk"), set())
+        assert 1 <= len(pre) <= 2, pre
+        assert len(dec) == 1, dec
+
+    def test_bucket_padding_does_not_change_tokens(self):
+        """A prompt served through a larger bucket yields the same
+        generation as through a tight one."""
+        m, cfg = _model()
+        prompt = _prompts(cfg, 1, lo=6, hi=7, seed=13)[0]
+        outs = []
+        for buckets in ((8, 64), (64,)):
+            eng = ServingEngine(m, max_batch=1, max_len=64, page_size=8,
+                                prefill_buckets=buckets, name="pad")
+            r = eng.submit(prompt, max_new_tokens=5)
+            eng.run_until_idle()
+            outs.append(r.result())
+        assert outs[0] == outs[1]
+
+
+class TestPreemption:
+    def test_pool_exhaustion_preempts_youngest_and_recovers(self):
+        """A page pool too small for the whole batch: the youngest
+        running request is preempted (pages freed, requeued with its
+        generated prefix) and every request still completes with its
+        full token budget and the right tokens."""
+        m, cfg = _model()
+        # pool: 2 sequences x 24 tokens need 6 pages; give 5 (+null)
+        eng = ServingEngine(m, max_batch=2, max_len=40, page_size=8,
+                            num_pages=6, name="pre")
+        prompts = _prompts(cfg, 2, lo=14, hi=15, seed=17)
+        reqs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        eng.run_until_idle()
+        assert eng.stats["preemptions"] >= 1
+        assert sum(r.preemptions for r in reqs) >= 1
+        for p, r in zip(prompts, reqs):
+            out = r.result()
+            assert len(out) == 12
+            ids = paddle.to_tensor(np.asarray([p], np.int32))
+            ref = np.asarray(m.generate_paged(ids, 12, page_size=8).data)
+            assert out == ref[0, len(p):].tolist(), \
+                "preemption changed the greedy tokens"
+        ev = [e for e in events.recent(100, kind="serving_eviction")
+              if e.get("reason") == "preempted"]
+        assert ev and ev[0]["severity"] == "warn"
+
+    def test_request_too_big_for_pool_rejected_at_submit(self):
+        """Review regression: a request the pool can NEVER satisfy used
+        to sit at the queue head forever (admission waits for frees that
+        cannot come) — submit now validates total page need up front."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=40, page_size=8,
+                            num_pages=3, name="oom")
+        with pytest.raises(ValueError, match="KV pages"):
+            eng.submit(list(range(1, 15)), max_new_tokens=12)  # 4 > 2
+
+    def test_external_pool_drain_fails_the_sole_runner_loudly(self):
+        """A dry pool with nothing to preempt (pages consumed outside
+        the running set) fails the request instead of wedging."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=40, page_size=8,
+                            name="drain")
+        req = eng.submit(list(range(1, 8)), max_new_tokens=12)
+        eng.step()  # admit + prefill + first decode
+        eng.allocator.alloc(eng.allocator.free_pages)  # drain the pool
+        eng.run_until_idle()
+        with pytest.raises(RuntimeError, match="page pool exhausted"):
+            req.result(timeout=5)
+        assert req.state == "failed"
+
+    def test_close_fails_outstanding_requests(self):
+        """Review regression: close() used to join the thread and leave
+        queued/running requests un-completed — a client blocked in
+        result() hung forever on a closed engine."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                            name="cl")
+        running = eng.submit(_prompts(cfg, 1, seed=31)[0],
+                             max_new_tokens=30)
+        queued = eng.submit(_prompts(cfg, 1, seed=32)[0],
+                            max_new_tokens=5)
+        eng.step()  # `running` admitted into the batch, `queued` waits
+        eng.close()
+        for req in (running, queued):
+            with pytest.raises(RuntimeError, match="engine closed"):
+                req.result(timeout=5)
+        assert eng.status()["free_pages"] == eng.cache.num_pages - 1
+
+
+class TestServingObservability:
+    def test_metric_families_populated(self):
+        m, cfg = _model()
+        reg = metrics_mod.default_registry()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="obs")
+        reqs = [eng.submit(p, max_new_tokens=4)
+                for p in _prompts(cfg, 4, seed=19)]
+        eng.run_until_idle()
+        assert reg.get("serving_goodput_tokens_total").value(
+            model="obs") == sum(len(r.generated) for r in reqs)
+        ttft = [v for v in reg.get("serving_ttft_seconds").snapshot()
+                ["values"] if v["labels"].get("model") == "obs"]
+        assert ttft and ttft[0]["count"] == 4
+        tpot = [v for v in reg.get("serving_tpot_seconds").snapshot()
+                ["values"] if v["labels"].get("model") == "obs"]
+        assert tpot and tpot[0]["count"] == 4
+        for r in reqs:
+            assert r.ttft_s is not None and r.ttft_s >= 0
+            assert r.tpot_s is not None and r.tpot_s >= 0
+
+    def test_admission_and_eviction_events(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                            name="ev")
+        req = eng.submit(_prompts(cfg, 1, seed=23)[0], max_new_tokens=3)
+        eng.run_until_idle()
+        adm = events.recent(50, kind="serving_admission")
+        evi = events.recent(50, kind="serving_eviction")
+        assert len(adm) == 1 and len(evi) == 1
+        a, e = adm[0], evi[0]
+        events.validate_event(a)
+        events.validate_event(e)
+        assert a["request"] == req.rid and a["slot"] == 0
+        assert a["prompt_len"] == len(req.prompt)
+        assert a["bucket"] >= a["prompt_len"]
+        assert a["queue_wait_s"] >= 0
+        assert e["request"] == req.rid and e["reason"] == "length"
+        assert e["generated"] == 3
+
+    def test_status_shape(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="st")
+        st = eng.status()
+        for key in ("model", "max_batch", "max_len", "page_size",
+                    "num_pages", "free_pages", "queue_depth",
+                    "occupancy", "prefill_buckets", "stats"):
+            assert key in st
+        import json
+        json.dumps(st)  # endpoint-serializable

@@ -1196,3 +1196,220 @@ class TestObsTailController:
         # explicit --kind composes: retraces AND decisions both stream
         assert "retrace" in out
         assert "straggler_evict" in out
+
+
+class TestServingGate:
+    """`serving_*` metric families + the gpt2_decode config block
+    (paged-KV decode satellite): kind/label/shape contracts and the
+    TTFT/TPOT/goodput/A/B decode-bench contract, named violations."""
+
+    @staticmethod
+    def _doc(cfg=None, metrics=None):
+        doc = {"configs": {"gpt2_decode": cfg or
+                           {"tokens_per_sec_chip": 50.0}}}
+        if metrics is not None:
+            doc["observability"] = {"metrics": metrics}
+        return doc
+
+    @staticmethod
+    def _decode_cfg(**over):
+        cfg = {
+            "tokens_per_sec_chip": 66.0, "decode_tokens_per_sec": 220.0,
+            "goodput_tokens": 240, "streams": 24, "completed": 24,
+            "preemptions": 0, "batch_occupancy_mean": 3.9,
+            "serving": {"ttft_s": {"p50": 0.4, "p99": 1.2},
+                        "tpot_s": {"p50": 0.004, "p99": 0.02},
+                        "wall_s": 3.6},
+            "paged_vs_dense": {
+                "rows": [{"ctx": 32, "paged_ms_per_token": 2.0,
+                          "dense_ms_per_token": 2.6},
+                         {"ctx": 128, "paged_ms_per_token": 1.9,
+                          "dense_ms_per_token": 5.9}],
+                "paged_growth": 0.95, "dense_growth": 2.27,
+                "speedup_at_max_ctx": 3.1},
+        }
+        cfg.update(over)
+        return cfg
+
+    def test_valid_decode_block_passes(self):
+        assert gate.validate_observability(
+            self._doc(cfg=self._decode_cfg())) == []
+
+    def test_real_bench_block_passes(self):
+        """The ACTUAL bench_gpt2_decode output shape validates (wired via
+        a canned copy of its structure — the full bench run is the BENCH
+        round's job)."""
+        cfg = self._decode_cfg()
+        cfg["platform"] = "cpu"
+        cfg["scale"] = "ci"
+        assert gate.validate_observability(self._doc(cfg=cfg)) == []
+
+    def test_malformed_percentiles_and_rows_named(self):
+        cfg = self._decode_cfg()
+        cfg["serving"]["ttft_s"]["p99"] = -1.0
+        cfg["serving"]["tpot_s"] = "fast"
+        cfg["paged_vs_dense"]["rows"][0]["ctx"] = 0
+        cfg["paged_vs_dense"]["rows"][1]["dense_ms_per_token"] = None
+        cfg["goodput_tokens"] = -5
+        blob = "\n".join(gate.validate_observability(self._doc(cfg=cfg)))
+        assert "ttft_s.p99" in blob
+        assert "tpot_s is not an object" in blob
+        assert "rows[0].ctx" in blob
+        assert "rows[1].dense_ms_per_token" in blob
+        assert "goodput_tokens" in blob
+
+    def test_missing_percentile_families_named(self):
+        cfg = self._decode_cfg()
+        del cfg["serving"]["ttft_s"]
+        blob = "\n".join(gate.validate_observability(self._doc(cfg=cfg)))
+        assert "serving.ttft_s is missing" in blob
+
+    def test_error_ab_probe_reports_itself(self):
+        cfg = self._decode_cfg(paged_vs_dense={"error": "XlaError: boom"})
+        assert gate.validate_observability(self._doc(cfg=cfg)) == []
+
+    def test_valid_serving_metrics_pass(self):
+        metrics = {
+            "serving_queue_depth": {"kind": "gauge", "values": [
+                {"labels": {"model": "gpt"}, "value": 2}]},
+            "serving_goodput_tokens_total": {"kind": "counter", "values": [
+                {"labels": {"model": "gpt"}, "value": 240}]},
+            "serving_ttft_seconds": {"kind": "histogram", "values": [
+                {"labels": {"model": "gpt"},
+                 "buckets": {"0.1": 1, "+Inf": 2}, "sum": 0.6,
+                 "count": 2}]},
+        }
+        assert gate.validate_observability(
+            self._doc(metrics=metrics)) == []
+
+    def test_live_registry_serving_snapshot_passes(self):
+        from paddle_tpu.profiler import metrics as metrics_mod
+        from paddle_tpu.inference import serving as srv
+        srv._M_QUEUE.set(1, model="gatetest")
+        srv._M_TTFT.observe(0.2, model="gatetest")
+        srv._M_TPOT.observe(0.01, model="gatetest")
+        srv._M_GOODPUT.inc(10, model="gatetest")
+        snap = metrics_mod.default_registry().snapshot()
+        fams = {k: v for k, v in snap.items() if k.startswith("serving_")}
+        assert fams
+        assert gate.validate_observability(self._doc(metrics=fams)) == []
+
+    def test_unknown_family_wrong_kind_missing_label_named(self):
+        metrics = {
+            "serving_bogus_total": {"kind": "counter", "values": []},
+            "serving_queue_depth": {"kind": "counter", "values": []},
+            "serving_goodput_tokens_total": {"kind": "counter", "values": [
+                {"labels": {}, "value": 3}]},
+            "serving_tpot_seconds": {"kind": "histogram", "values": [
+                {"labels": {"model": "m"},
+                 "buckets": {"+Inf": 5}, "sum": 1.0, "count": 4}]},
+        }
+        blob = "\n".join(gate.validate_observability(
+            self._doc(metrics=metrics)))
+        assert "serving_bogus_total" in blob and "unknown" in blob
+        assert "serving_queue_depth" in blob and "expected gauge" in blob
+        assert "missing the 'model' label" in blob
+        assert "inconsistent" in blob  # +Inf 5 != count 4
+
+
+class TestMetricsDumpServingHistograms:
+    """tools/metrics_dump.py renders the serving latency histograms with
+    estimated percentiles (the satellite's operator view)."""
+
+    def test_serving_histograms_render_quantiles(self, capsys, tmp_path):
+        import metrics_dump
+        from paddle_tpu.profiler import metrics as metrics_mod
+        reg = metrics_mod.MetricsRegistry()
+        h = reg.histogram("serving_ttft_seconds",
+                          "ttft by model")
+        for v in (0.02, 0.04, 0.06, 0.3, 1.2):
+            h.observe(v, model="gpt")
+        reg.gauge("serving_queue_depth", "queue by model").set(
+            3, model="gpt")
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(reg.snapshot()))
+        rc = metrics_dump.main([str(path), "--filter", "serving"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "serving_ttft_seconds [histogram]" in out
+        assert "count=5" in out and "p50=" in out and "p99=" in out
+        assert "serving_queue_depth [gauge]" in out
+
+    def test_driver_bench_wrapper_is_understood(self, capsys):
+        """The driver's BENCH_r{N}.json wrapper (bench object under
+        `parsed`/`tail`) renders directly — found driving the serving
+        satellite: the operator view of a published round's serving
+        histograms previously required hand-extracting the tail."""
+        import metrics_dump
+        path = os.path.join(REPO, "BENCH_r07.json")
+        rc = metrics_dump.main([path, "--filter", "serving_ttft"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "serving_ttft_seconds [histogram]" in out
+        assert "p99=" in out
+
+    def test_prom_text_roundtrip_for_serving_families(self):
+        import metrics_dump
+        from paddle_tpu.profiler import metrics as metrics_mod
+        reg = metrics_mod.MetricsRegistry()
+        reg.histogram("serving_tpot_seconds", "tpot by model").observe(
+            0.01, model="gpt")
+        snap = metrics_dump.parse_prometheus_text(reg.to_prometheus_text())
+        fam = snap["serving_tpot_seconds"]
+        assert fam["kind"] == "histogram"
+        assert fam["values"][0]["count"] == 1
+
+
+class TestObsTailServing:
+    """obs_tail --serving: filter + operator rendering of the request
+    lifecycle events."""
+
+    @staticmethod
+    def _write(tmp_path):
+        path = tmp_path / "ev.jsonl"
+        recs = [
+            {"ts": 10.0, "kind": "retrace", "host": "t0", "name": "mm"},
+            {"ts": 11.0, "kind": "serving_admission", "host": "t0",
+             "model": "gpt", "request": 7, "slot": 2, "prompt_len": 33,
+             "bucket": 64, "queue_wait_s": 0.12, "preemptions": 0,
+             "free_pages": 90},
+            {"ts": 12.0, "kind": "serving_eviction", "host": "t0",
+             "severity": "info", "model": "gpt", "request": 7,
+             "reason": "eos", "generated": 18, "free_pages": 95},
+            {"ts": 13.0, "kind": "serving_eviction", "host": "t0",
+             "severity": "warn", "model": "gpt", "request": 9,
+             "reason": "preempted", "generated": 4, "free_pages": 10},
+        ]
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        return str(path)
+
+    def test_serving_filters_and_renders(self, tmp_path, capsys):
+        import obs_tail
+        rc = obs_tail.main([self._write(tmp_path), "--serving"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "retrace" not in out              # filtered to lifecycle
+        assert "request 7 -> slot 2" in out
+        assert "prompt 33 -> bucket 64" in out
+        assert "eos after 18 token(s)" in out
+        assert "preempted after 4 token(s)" in out
+
+    def test_serving_composes_with_controller(self, tmp_path, capsys):
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(
+                {"ts": 1.0, "kind": "serving_admission", "host": "t0",
+                 "request": 1, "slot": 0, "prompt_len": 4, "bucket": 16,
+                 "queue_wait_s": 0.0, "free_pages": 3}) + "\n")
+            f.write(json.dumps(
+                {"ts": 2.0, "kind": "controller_decision", "host": "s0",
+                 "policy": "straggler_skip", "action": "skip",
+                 "outcome": "applied", "decision": 4}) + "\n")
+        rc = obs_tail.main([str(path), "--serving", "--controller"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "request 1 -> slot 0" in out
+        assert "straggler_skip" in out and "decision #4" in out

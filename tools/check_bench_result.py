@@ -439,6 +439,149 @@ def _validate_autotune_metrics(where: str, metrics: dict) -> List[str]:
     return problems
 
 
+# continuous-batching serving metric families: name -> (kind, required
+# labels). All values non-negative.
+_SERVING_FAMILIES = {
+    "serving_queue_depth": ("gauge", ("model",)),
+    "serving_batch_occupancy": ("gauge", ("model",)),
+    "serving_ttft_seconds": ("histogram", ("model",)),
+    "serving_tpot_seconds": ("histogram", ("model",)),
+    "serving_goodput_tokens_total": ("counter", ("model",)),
+}
+
+
+def _validate_serving_metrics(where: str, metrics: dict) -> List[str]:
+    """`serving_*` families must be the documented kind, carry the
+    `model` label, and hold non-negative values (histograms: consistent
+    buckets/sum/count) — the serving plane's observability contract."""
+    problems = []
+    for name, fam in metrics.items():
+        if not name.startswith("serving_"):
+            continue
+        spec = _SERVING_FAMILIES.get(name)
+        if spec is None:
+            problems.append(f"{where}.metrics.{name}: unknown serving "
+                            f"family (expected one of "
+                            f"{sorted(_SERVING_FAMILIES)})")
+            continue
+        kind, req_labels = spec
+        if not isinstance(fam, dict) or fam.get("kind") != kind:
+            problems.append(
+                f"{where}.metrics.{name}: kind "
+                f"{fam.get('kind') if isinstance(fam, dict) else fam!r}"
+                f", expected {kind}")
+            continue
+        for i, v in enumerate(fam.get("values") or []):
+            if not isinstance(v, dict):
+                problems.append(f"{where}.metrics.{name}[{i}] is not a "
+                                f"series object")
+                continue
+            if kind == "histogram":
+                buckets, cnt = v.get("buckets"), v.get("count")
+                if not isinstance(buckets, dict) or \
+                        not isinstance(cnt, (int, float)) or \
+                        not isinstance(v.get("sum"), (int, float)):
+                    problems.append(f"{where}.metrics.{name}[{i}]: "
+                                    f"histogram needs buckets/sum/count")
+                elif buckets.get("+Inf") != cnt or v["sum"] < 0 or cnt < 0:
+                    problems.append(
+                        f"{where}.metrics.{name}[{i}]: inconsistent "
+                        f"histogram (+Inf bucket {buckets.get('+Inf')} != "
+                        f"count {cnt}, or negative sum)")
+            else:
+                val = v.get("value")
+                if not isinstance(val, (int, float)) or \
+                        isinstance(val, bool) or val != val or val < 0:
+                    problems.append(f"{where}.metrics.{name}[{i}]: value "
+                                    f"{val!r} is not a non-negative number")
+            labels = v.get("labels") or {}
+            for lk in req_labels:
+                if lk not in labels:
+                    problems.append(f"{where}.metrics.{name}[{i}]: series "
+                                    f"missing the {lk!r} label")
+    return problems
+
+
+def _validate_decode_block(where: str, cfg: dict) -> List[str]:
+    """The `gpt2_decode` bench config: serving percentiles (TTFT/TPOT),
+    goodput fields, and the paged-vs-dense A/B rows — a decode round
+    claiming super-linear speedup with malformed numbers fails the
+    gate like a perf regression does."""
+    problems = []
+    srv = cfg.get("serving")
+    if srv is not None:
+        if not isinstance(srv, dict):
+            problems.append(f"{where}.serving is not an object")
+        else:
+            for fam in ("ttft_s", "tpot_s"):
+                blk = srv.get(fam)
+                if blk is None:
+                    problems.append(f"{where}.serving.{fam} is missing")
+                    continue
+                if not isinstance(blk, dict):
+                    problems.append(f"{where}.serving.{fam} is not an "
+                                    f"object")
+                    continue
+                for q in ("p50", "p99"):
+                    v = blk.get(q)
+                    if v is not None and not _nonneg_num(v):
+                        problems.append(f"{where}.serving.{fam}.{q} {v!r} "
+                                        f"is not a non-negative number or "
+                                        f"null")
+            ws = srv.get("wall_s")
+            if ws is not None and not _nonneg_num(ws):
+                problems.append(f"{where}.serving.wall_s {ws!r} is not a "
+                                f"non-negative number")
+    for key in ("goodput_tokens", "streams", "completed", "preemptions"):
+        v = cfg.get(key)
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool) or v < 0):
+            problems.append(f"{where}.{key} {v!r} is not a non-negative "
+                            f"integer")
+    for key in ("tokens_per_sec_chip", "decode_tokens_per_sec",
+                "batch_occupancy_mean"):
+        v = cfg.get(key)
+        if v is not None and not _nonneg_num(v):
+            problems.append(f"{where}.{key} {v!r} is not a non-negative "
+                            f"number or null")
+    ab = cfg.get("paged_vs_dense")
+    if ab is not None:
+        if not isinstance(ab, dict):
+            problems.append(f"{where}.paged_vs_dense is not an object")
+        elif "error" not in ab:  # a failed probe reports itself
+            rows = ab.get("rows")
+            if not isinstance(rows, list) or not rows:
+                problems.append(f"{where}.paged_vs_dense.rows is not a "
+                                f"non-empty list")
+            else:
+                for i, r in enumerate(rows):
+                    if not isinstance(r, dict):
+                        problems.append(
+                            f"{where}.paged_vs_dense.rows[{i}] is not an "
+                            f"object")
+                        continue
+                    c = r.get("ctx")
+                    if not isinstance(c, int) or isinstance(c, bool) \
+                            or c <= 0:
+                        problems.append(
+                            f"{where}.paged_vs_dense.rows[{i}].ctx {c!r} "
+                            f"is not a positive integer")
+                    for key in ("paged_ms_per_token",
+                                "dense_ms_per_token"):
+                        if not _nonneg_num(r.get(key)):
+                            problems.append(
+                                f"{where}.paged_vs_dense.rows[{i}].{key} "
+                                f"{r.get(key)!r} is not a non-negative "
+                                f"number")
+            for key in ("paged_growth", "dense_growth",
+                        "speedup_at_max_ctx"):
+                v = ab.get(key)
+                if v is not None and not _nonneg_num(v):
+                    problems.append(f"{where}.paged_vs_dense.{key} {v!r} "
+                                    f"is not a non-negative number or null")
+    return problems
+
+
 # fleet-controller metric families: name -> (kind, required labels).
 _CONTROLLER_FAMILIES = {
     "controller_decisions_total": ("counter", ("policy", "outcome")),
@@ -709,8 +852,11 @@ def validate_observability(doc: dict) -> List[str]:
     events/events_tail to the event contract (`controller_decision`
     events additionally to the decision contract: policy/action/legal
     outcome/decision id), `checkpoint_async_*` / `device_memory_*` /
-    `health_*` / `amp_*` / `autotune_*` / `controller_*` metric
-    families to their kind/label/shape contracts, `device_time` blocks to
+    `health_*` / `amp_*` / `autotune_*` / `controller_*` / `serving_*`
+    metric families to their kind/label/shape contracts, `gpt2_decode`
+    configs (a `serving`/`paged_vs_dense` block) to the decode-bench
+    contract (TTFT/TPOT percentiles, goodput fields, A/B rows),
+    `device_time` blocks to
     the per-op row shape with a known provenance label (estimate /
     measured / xplane), `health` blocks to the sentinel-overhead shape,
     and `autotune` blocks (per config and the observability summary) to
@@ -736,6 +882,9 @@ def validate_observability(doc: dict) -> List[str]:
         if cf is not None:
             problems.extend(_validate_conv_fusion(
                 f"configs.{name}.conv_fusion", cf))
+        if cfg.get("serving") is not None \
+                or cfg.get("paged_vs_dense") is not None:
+            problems.extend(_validate_decode_block(f"configs.{name}", cfg))
     for where, obs in _obs_blocks(doc):
         metrics = obs.get("metrics")
         if isinstance(metrics, dict):
@@ -744,6 +893,7 @@ def validate_observability(doc: dict) -> List[str]:
             problems.extend(_validate_health_metrics(where, metrics))
             problems.extend(_validate_autotune_metrics(where, metrics))
             problems.extend(_validate_controller_metrics(where, metrics))
+            problems.extend(_validate_serving_metrics(where, metrics))
         at = obs.get("autotune")
         if at is not None:
             problems.extend(_validate_autotune_block(f"{where}.autotune",

@@ -40,6 +40,21 @@ def _extract_snapshot(doc) -> Optional[dict]:
         return obs["metrics"]
     if isinstance(doc.get("metrics"), dict):
         return _extract_snapshot(doc["metrics"]) or doc["metrics"]
+    # driver BENCH_r{N}.json wrapper: the bench object sits under
+    # `parsed` (or as the raw output line in `tail`)
+    if isinstance(doc.get("parsed"), dict):
+        snap = _extract_snapshot(doc["parsed"])
+        if snap is not None:
+            return snap
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return _extract_snapshot(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
     return None
 
 

@@ -15,13 +15,17 @@ severity + kind-specific payload). This renders that stream for operators:
     python tools/obs_tail.py events.jsonl --diagnose       # step_diagnosis
     python tools/obs_tail.py events.jsonl --health         # numerics plane
     python tools/obs_tail.py events.jsonl --controller     # fleet decisions
+    python tools/obs_tail.py events.jsonl --serving        # request lifecycle
     cat events.jsonl | python tools/obs_tail.py -
 
 `--diagnose` renders `step_diagnosis` events (the runtime's step-slowness
 decomposition) as a per-window cost breakdown naming the dominant term;
 `--health` renders the training-health events (tensor_health NaN/Inf
 attribution, health_alert divergence signals, health_rollback responses,
-fleet_health) in an operator-oriented line format; `--follow-for N`
+fleet_health) in an operator-oriented line format; `--serving` renders
+the continuous-batching request lifecycle (serving_admission /
+serving_eviction: slot, bucket, queue wait, eviction reason, free
+pages); `--follow-for N`
 bounds a live tail to N seconds (scripting/CI). A sink rotated by
 `PADDLE_TPU_EVENT_LOG_MAX_MB` is read transparently: `path.N`...`path.1`
 siblings stream before `path` in chronological order.
@@ -57,6 +61,8 @@ try:
 except Exception:
     HEALTH_KINDS = ("tensor_health", "health_alert", "health_rollback",
                     "fleet_health")
+
+SERVING_KINDS = ("serving_admission", "serving_eviction")
 
 
 def rotated_siblings(path: str):
@@ -232,8 +238,38 @@ def format_controller(rec: dict) -> str:
             f"{policy:<20} {rec.get('host', '?'):<16} {detail}")
 
 
+def format_serving(rec: dict) -> str:
+    """One serving lifecycle event as an operator line: who entered/left
+    the decode batch, why, and what it cost."""
+    ts = rec.get("ts")
+    try:
+        when = datetime.fromtimestamp(float(ts)).strftime("%H:%M:%S.%f")[:-3]
+    except (TypeError, ValueError, OSError):
+        when = "??:??:??.???"
+    kind = rec.get("kind", "?")
+    rid = rec.get("request", "?")
+    if kind == "serving_admission":
+        detail = (f"request {rid} -> slot {rec.get('slot')} "
+                  f"(prompt {rec.get('prompt_len')} -> bucket "
+                  f"{rec.get('bucket')}, waited "
+                  f"{rec.get('queue_wait_s')}s")
+        if rec.get("preemptions"):
+            detail += f", preemptions={rec['preemptions']}"
+        detail += f", free_pages={rec.get('free_pages')})"
+    elif kind == "serving_eviction":
+        detail = (f"request {rid} left the batch: "
+                  f"{rec.get('reason', '?')} after "
+                  f"{rec.get('generated')} token(s), free_pages="
+                  f"{rec.get('free_pages')}")
+    else:
+        return format_event(rec)
+    return (f"{when} {rec.get('severity', 'info'):<5} {kind:<20} "
+            f"{rec.get('host', '?'):<16} {detail}")
+
+
 def _emit(events, as_json: bool, out=None, diagnose: bool = False,
-          health: bool = False, controller: bool = False):
+          health: bool = False, controller: bool = False,
+          serving: bool = False):
     out = out if out is not None else sys.stdout  # resolve at call time
     for rec in events:
         if as_json:
@@ -244,6 +280,8 @@ def _emit(events, as_json: bool, out=None, diagnose: bool = False,
             line = format_health(rec)
         elif controller and rec.get("kind") == "controller_decision":
             line = format_controller(rec)
+        elif serving and rec.get("kind") in SERVING_KINDS:
+            line = format_serving(rec)
         else:
             line = format_event(rec)
         out.write(line + "\n")
@@ -259,6 +297,7 @@ def follow(path: str, args, poll_s: float = 0.5,
     diagnose = getattr(args, "diagnose", False)
     health = getattr(args, "health", False)
     controller = getattr(args, "controller", False)
+    serving = getattr(args, "serving", False)
     # open the live file FIRST and read the backlog through the same
     # handle: reading a snapshot and then seeking a fresh handle to EOF
     # would silently drop events appended in between
@@ -276,7 +315,8 @@ def follow(path: str, args, poll_s: float = 0.5,
               if event_matches(e, args.kind, args.host,
                                args.min_severity, args.since_ts)]
     _emit(window[-args.n:] if args.n else window, args.json,
-          diagnose=diagnose, health=health, controller=controller)
+          diagnose=diagnose, health=health, controller=controller,
+          serving=serving)
     try:
         while True:
             if max_s is not None and time.monotonic() - t0 >= max_s:
@@ -299,7 +339,7 @@ def follow(path: str, args, poll_s: float = 0.5,
                    if event_matches(r, args.kind, args.host,
                                     args.min_severity, args.since_ts)],
                   args.json, diagnose=diagnose, health=health,
-                  controller=controller)
+                  controller=controller, serving=serving)
     except KeyboardInterrupt:
         return 0
     finally:
@@ -339,6 +379,12 @@ def main(argv=None) -> int:
                          "(controller_decision: policy, evidence, action, "
                          "outcome) with an operator-oriented rendering; "
                          "filters to that kind unless --kind is given")
+    ap.add_argument("--serving", action="store_true",
+                    help="show continuous-batching serving events "
+                         "(serving_admission / serving_eviction: slot, "
+                         "bucket, queue wait, eviction reason, free "
+                         "pages) with an operator-oriented rendering; "
+                         "filters to those kinds unless --kind is given")
     ap.add_argument("--json", action="store_true",
                     help="emit matching events as raw JSONL instead of the "
                          "human format")
@@ -360,6 +406,14 @@ def main(argv=None) -> int:
             args.kind = args.kind + ("controller_decision",)
         elif args.kind != "controller_decision":
             args.kind = (args.kind, "controller_decision")
+    if args.serving:
+        # composes with the other operator views the same way
+        if args.kind is None:
+            args.kind = SERVING_KINDS
+        elif isinstance(args.kind, tuple):
+            args.kind = args.kind + SERVING_KINDS
+        else:
+            args.kind = (args.kind,) + SERVING_KINDS
 
     if args.follow:
         if args.path == "-":
@@ -397,7 +451,7 @@ def main(argv=None) -> int:
                                  args.min_severity, args.since_ts)]
     _emit(matching[-args.n:] if args.n else matching, args.json,
           diagnose=args.diagnose, health=args.health,
-          controller=args.controller)
+          controller=args.controller, serving=args.serving)
     return 0
 
 

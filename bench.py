@@ -416,6 +416,27 @@ def _platform() -> str:
         return "unknown"
 
 
+def _program_audit_block(reports_fn):
+    """Static program audit of this config's compiled executables
+    (paddle_tpu.analysis: trace + lower only, nothing runs) — aggregate
+    counts + the findings themselves, so a bench round records whether
+    the headline programs are hazard-clean on the box that produced the
+    numbers. `reports_fn` -> list[AuditReport]. Never raises."""
+    try:
+        reports = reports_fn()
+        counts = {"info": 0, "low": 0, "medium": 0, "high": 0}
+        for r in reports:
+            for sev, n in r.counts().items():
+                counts[sev] += n
+        return {
+            "counts": counts,
+            "clean_high": counts["high"] == 0,
+            "reports": [r.to_dict(max_findings=8) for r in reports],
+        }
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _tuned_vs_static_probe(build_step, args, iters=6, warmup=2):
     """Autotune tuned-vs-static comparison, measured in-round: one short
     timed window with the tuner in its current mode, one with the
@@ -524,6 +545,8 @@ def bench_gpt2():
         "scale": _SCALE,
         "fused_opt": bool(getattr(step, "fused_opt", False)),
         "tuned_vs_static": tuned_vs_static,
+        "program_audit": _program_audit_block(
+            lambda: [step.audit(ids, labels)]),
         "tokens_per_sec_chip": round(B * L / sec, 1),
         "samples_per_sec_chip": round(B / sec, 3),
         "step_time_ms": round(1000 * sec, 2),
@@ -779,6 +802,7 @@ def bench_gpt2_decode():
                      "TPOT is per finished request, first->last token"),
         },
         "paged_vs_dense": ab,
+        "program_audit": _program_audit_block(lambda: eng.audit()),
         "observability": obs,
     }
 
@@ -912,6 +936,8 @@ def bench_resnet50(B=None, hw=None, depth=50, probe_iters=None):
         "scale": _SCALE,
         "conv_fusion": conv_fusion,
         "tuned_vs_static": tuned_vs_static,
+        "program_audit": _program_audit_block(
+            lambda: [step.audit(imgs[best_df], labels)]),
         "samples_per_sec_chip": round(B / sec, 1),
         "step_time_ms": round(1000 * sec, 2),
         "final_loss": round(loss, 4),
@@ -995,6 +1021,8 @@ def bench_bert_base():
         "name": f"bert-base seq{L} b{B} bf16 dropout0 (ERNIE-Base class)",
         "platform": _platform(),
         "scale": _SCALE,
+        "program_audit": _program_audit_block(
+            lambda: [step.audit(ids, labels)]),
         "samples_per_sec_chip": round(B / sec, 1),
         "step_time_ms": round(1000 * sec, 2),
         "final_loss": round(loss, 4),

@@ -1,0 +1,415 @@
+"""Framework convention lints: AST-level checks over the package source.
+
+These join the metrics-naming lint (tests/test_metrics.py) as the
+repo's self-auditing layer — run in tier-1 by
+``tests/test_conventions.py`` and from ``tools/program_audit.py
+--lint``. Each lint returns a list of human-readable violation strings
+(empty = clean):
+
+* :func:`lint_env_knob_parses` — no ``int()``/``float()`` of a
+  ``PADDLE_TPU_*`` env read outside the shared helper
+  (``paddle_tpu/utils/envparse.py``): a garbled knob must warn+default
+  (or raise a NAMED error), never detonate as an anonymous ValueError
+  mid-run.
+* :func:`lint_env_knob_docs` — every ``PADDLE_TPU_*`` knob the package
+  reads is documented in README.md.
+* :func:`lint_fault_sites` — every ``fault.site("...")`` string is
+  registered in ``fault.inject.KNOWN_SITES``/``DYNAMIC_SITES`` and every
+  registered site still has a call site (no dead sites); the README
+  fault-site table mirrors the registry.
+* :func:`lint_threads` — every ``threading.Thread`` in the package is
+  daemon (``daemon=True`` at construction or ``.daemon = True`` before
+  start) or provably joined in its module: a silent non-daemon thread
+  wedges interpreter shutdown on the exact runs (chaos kills, SIGTERM
+  drains) this repo exists to survive.
+* :func:`lint_event_kinds` — every literal kind emitted through
+  ``profiler/events.py`` is declared (with a severity) in
+  ``events.KIND_SEVERITY``, so ``tools/obs_tail.py`` renders it instead
+  of dropping it as garbage.
+
+The lints parse source with ``ast`` — nothing is imported or executed,
+so they run anywhere CI does.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["package_root", "lint_env_knob_parses", "lint_env_knob_docs",
+           "lint_fault_sites", "lint_threads", "lint_event_kinds",
+           "collect_env_knobs", "run_all"]
+
+_ENV_PREFIX = "PADDLE_TPU_"
+_HELPER_SUFFIX = os.path.join("utils", "envparse.py")
+
+
+def package_root() -> str:
+    """The paddle_tpu package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _py_files(root: Optional[str] = None) -> Iterable[Tuple[str, str]]:
+    root = root or package_root()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                yield path, os.path.relpath(path, root)
+
+
+def _parse(path: str) -> Optional[ast.AST]:
+    try:
+        with open(path) as f:
+            return ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+
+
+# -- env knobs ---------------------------------------------------------------
+
+def _env_read_names(node: ast.AST) -> List[str]:
+    """PADDLE_TPU_* literals read from the environment inside `node`
+    (os.environ.get / os.getenv / os.environ[...])."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            is_get = isinstance(f, ast.Attribute) and f.attr == "get" \
+                and isinstance(f.value, (ast.Attribute, ast.Name)) \
+                and (getattr(f.value, "attr", None) == "environ"
+                     or getattr(f.value, "id", None) in ("environ", "env"))
+            is_getenv = (isinstance(f, ast.Attribute)
+                         and f.attr == "getenv") or \
+                (isinstance(f, ast.Name) and f.id == "getenv")
+            if (is_get or is_getenv) and n.args and \
+                    isinstance(n.args[0], ast.Constant) and \
+                    isinstance(n.args[0].value, str) and \
+                    n.args[0].value.startswith(_ENV_PREFIX):
+                out.append(n.args[0].value)
+        elif isinstance(n, ast.Subscript):
+            base_ok = (getattr(n.value, "attr", None) == "environ"
+                       or getattr(n.value, "id", None) in ("environ",
+                                                           "env"))
+            sl = n.slice
+            if base_ok and isinstance(sl, ast.Constant) and \
+                    isinstance(sl.value, str) and \
+                    sl.value.startswith(_ENV_PREFIX):
+                out.append(sl.value)
+    return out
+
+
+def lint_env_knob_parses(root: Optional[str] = None) -> List[str]:
+    """int()/float() wrapped directly around a PADDLE_TPU_* env read,
+    anywhere but the shared helper."""
+    violations = []
+    for path, rel in _py_files(root):
+        if rel.endswith(_HELPER_SUFFIX):
+            continue
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for n in ast.walk(tree):
+            if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id in ("int", "float")):
+                continue
+            names = [x for a in n.args for x in _env_read_names(a)]
+            if names:
+                violations.append(
+                    f"{rel}:{n.lineno}: {n.func.id}() of env knob(s) "
+                    f"{sorted(set(names))} — use "
+                    f"paddle_tpu.utils.envparse.env_{n.func.id} (garbled "
+                    f"values must warn+default or raise a named error)")
+    return violations
+
+
+_HELPER_FNS = ("env_int", "env_float", "env_bool", "env_str")
+
+
+def _helper_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to envparse helpers in this module — including
+    renamed imports (`from ...envparse import env_int as _int_knob`),
+    which would otherwise be invisible to the knob collection."""
+    names = set(_HELPER_FNS)
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom) and n.module and \
+                n.module.endswith("envparse"):
+            for a in n.names:
+                if a.name in _HELPER_FNS:
+                    names.add(a.asname or a.name)
+    return names
+
+
+def collect_env_knobs(root: Optional[str] = None) -> Dict[str, str]:
+    """Every PADDLE_TPU_* knob the package reads -> one 'file:line'
+    witness. Sources: direct environ reads, envparse helper calls
+    (aliased imports included), and RetryPolicy.from_env(prefix)
+    families."""
+    knobs: Dict[str, str] = {}
+
+    def note(name: str, rel: str, lineno: int):
+        knobs.setdefault(name, f"{rel}:{lineno}")
+
+    for path, rel in _py_files(root):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        helper_names = _helper_aliases(tree)
+        for n in ast.walk(tree):
+            if not isinstance(n, (ast.Call, ast.Subscript)):
+                continue
+            for name in _env_read_names(n):
+                note(name, rel, n.lineno)
+            if not isinstance(n, ast.Call):
+                continue
+            fname = getattr(n.func, "id", getattr(n.func, "attr", ""))
+            if fname in helper_names and n.args and \
+                    isinstance(n.args[0], ast.Constant) and \
+                    isinstance(n.args[0].value, str) and \
+                    n.args[0].value.startswith(_ENV_PREFIX):
+                note(n.args[0].value, rel, n.lineno)
+            if fname == "from_env" and n.args and \
+                    isinstance(n.args[0], ast.Constant) and \
+                    isinstance(n.args[0].value, str):
+                prefix = n.args[0].value.upper()
+                for suffix in ("RETRIES", "BACKOFF", "TIMEOUT"):
+                    note(f"{_ENV_PREFIX}{prefix}_{suffix}", rel, n.lineno)
+    return knobs
+
+
+def lint_env_knob_docs(readme_path: Optional[str] = None,
+                       root: Optional[str] = None) -> List[str]:
+    """Every knob the package reads must appear in README.md."""
+    if readme_path is None:
+        readme_path = os.path.join(os.path.dirname(package_root()),
+                                   "README.md")
+    try:
+        with open(readme_path) as f:
+            readme = f.read()
+    except OSError as e:
+        return [f"README not readable: {e}"]
+    violations = []
+    for name, where in sorted(collect_env_knobs(root).items()):
+        if name not in readme:
+            violations.append(
+                f"{where}: env knob {name} is read but not documented "
+                f"in README.md")
+    return violations
+
+
+# -- fault sites -------------------------------------------------------------
+
+def _site_literals(root: Optional[str] = None
+                   ) -> List[Tuple[str, str, bool]]:
+    """(site-or-prefix, 'file:line', is_dynamic) for every fault-site
+    declaration call: site("..."), _fault_site("..."), injector.site(f"..").
+    """
+    out = []
+    for path, rel in _py_files(root):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for n in ast.walk(tree):
+            if not isinstance(n, ast.Call) or not n.args:
+                continue
+            fname = getattr(n.func, "id", getattr(n.func, "attr", ""))
+            if fname not in ("site", "_fault_site", "_worker_fault_site"):
+                continue
+            arg = n.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append((arg.value, f"{rel}:{n.lineno}", False))
+            elif isinstance(arg, ast.JoinedStr) and arg.values and \
+                    isinstance(arg.values[0], ast.Constant):
+                out.append((str(arg.values[0].value),
+                            f"{rel}:{n.lineno}", True))
+    return out
+
+
+def lint_fault_sites(root: Optional[str] = None,
+                     readme_path: Optional[str] = None) -> List[str]:
+    from ..fault.inject import DYNAMIC_SITES, KNOWN_SITES
+    violations = []
+    used_static: Set[str] = set()
+    used_dynamic: Set[str] = set()
+    for name, where, is_dynamic in _site_literals(root):
+        if is_dynamic:
+            prefix = next((p for p in DYNAMIC_SITES
+                           if name.startswith(p) or p.startswith(name)),
+                          None)
+            if prefix is None:
+                violations.append(
+                    f"{where}: dynamic fault site f\"{name}...\" matches "
+                    f"no registered DYNAMIC_SITES prefix")
+            else:
+                used_dynamic.add(prefix)
+            continue
+        if name in KNOWN_SITES:
+            used_static.add(name)
+            continue
+        prefix = next((p for p in DYNAMIC_SITES if name.startswith(p)),
+                      None)
+        if prefix is not None:
+            used_dynamic.add(prefix)
+            continue
+        violations.append(
+            f"{where}: fault site {name!r} is not registered in "
+            f"fault.inject.KNOWN_SITES (register it + document it in "
+            f"the README fault-site table, or remove the site)")
+    for name in sorted(set(KNOWN_SITES) - used_static):
+        violations.append(
+            f"fault.inject.KNOWN_SITES[{name!r}] has no call site left — "
+            f"dead site: remove it from the registry and the README table")
+    for prefix in sorted(set(DYNAMIC_SITES) - used_dynamic):
+        violations.append(
+            f"fault.inject.DYNAMIC_SITES[{prefix!r}] has no call site "
+            f"left — dead site family")
+    if readme_path is None:
+        readme_path = os.path.join(os.path.dirname(package_root()),
+                                   "README.md")
+    try:
+        with open(readme_path) as f:
+            readme = f.read()
+    except OSError as e:
+        return violations + [f"README not readable: {e}"]
+    for name in sorted(KNOWN_SITES):
+        if f"`{name}`" not in readme:
+            violations.append(
+                f"registered fault site {name!r} is missing from the "
+                f"README fault-site table")
+    return violations
+
+
+# -- threads -----------------------------------------------------------------
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "Thread"
+            and getattr(f.value, "id", None) == "threading") or \
+        (isinstance(f, ast.Name) and f.id == "Thread")
+
+
+def _target_key(target: ast.AST) -> Optional[str]:
+    """A searchable suffix for the variable/attribute holding a Thread:
+    'x' for `x = Thread(...)`, '_thread' for `self._thread = ...`."""
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return None
+
+
+def lint_threads(root: Optional[str] = None) -> List[str]:
+    """Every threading.Thread must be daemon or provably joined.
+
+    Accepted proofs, per module: `daemon=True` in the constructor call; a
+    `<target>.daemon = True` assignment; or a `<target>.join(...)` call
+    on the same name/attribute the Thread was assigned to."""
+    violations = []
+    for path, rel in _py_files(root):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        joined: Set[str] = set()
+        daemoned: Set[str] = set()
+        assigned: Dict[int, Optional[str]] = {}
+        ctor_calls: List[ast.Call] = []
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Call) and _is_thread_ctor(n):
+                ctor_calls.append(n)
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and _is_thread_ctor(n.value) and n.targets:
+                assigned[id(n.value)] = _target_key(n.targets[0])
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "join":
+                key = _target_key(n.func.value)
+                if key:
+                    joined.add(key)
+            if isinstance(n, ast.Assign) and n.targets and \
+                    isinstance(n.targets[0], ast.Attribute) and \
+                    n.targets[0].attr == "daemon" and \
+                    isinstance(n.value, ast.Constant) and \
+                    n.value.value is True:
+                key = _target_key(n.targets[0].value)
+                if key:
+                    daemoned.add(key)
+        for call in ctor_calls:
+            key = assigned.get(id(call))
+            has_daemon_kw = any(
+                kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in call.keywords)
+            if has_daemon_kw:
+                continue
+            if key and (key in joined or key in daemoned):
+                continue
+            violations.append(
+                f"{rel}:{call.lineno}: threading.Thread is neither "
+                f"daemon=True nor provably joined"
+                + (f" (target {key!r} has no .join()/.daemon=True in "
+                   f"this module)" if key else " (not assigned — cannot "
+                   "be joined)"))
+    return violations
+
+
+# -- event kinds -------------------------------------------------------------
+
+def _imports_events_emit(tree: ast.AST) -> bool:
+    """Does this module `from ...profiler.events import emit` (any
+    relative depth)? Gates bare `emit("kind", ...)` calls so unrelated
+    local emit() helpers (e.g. the ONNX node builder) don't lint."""
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom) and n.module and \
+                n.module.endswith("events"):
+            if any(a.name == "emit" for a in n.names):
+                return True
+    return False
+
+
+def lint_event_kinds(root: Optional[str] = None) -> List[str]:
+    """Every literal kind passed to an events-module `emit(...)` call
+    (`events.emit`, `_events_mod.emit`, or an imported bare `emit`) must
+    be declared (with a severity) in events.KIND_SEVERITY."""
+    from ..profiler.events import KIND_SEVERITY
+    violations = []
+    for path, rel in _py_files(root):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        bare_emit_is_events = _imports_events_emit(tree)
+        for n in ast.walk(tree):
+            if not isinstance(n, ast.Call) or not n.args:
+                continue
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr == "emit":
+                base = getattr(f.value, "id", "")
+                if "event" not in base.lower():
+                    continue  # some other object's .emit
+            elif isinstance(f, ast.Name) and f.id == "emit":
+                if not bare_emit_is_events:
+                    continue
+            else:
+                continue
+            arg = n.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            kind = arg.value
+            if kind not in KIND_SEVERITY:
+                violations.append(
+                    f"{rel}:{n.lineno}: event kind {kind!r} is emitted "
+                    f"but not declared in events.KIND_SEVERITY — declare "
+                    f"its severity so obs_tail renders it")
+    return violations
+
+
+def run_all(root: Optional[str] = None,
+            readme_path: Optional[str] = None) -> Dict[str, List[str]]:
+    """All lints; {lint-name: violations}. Used by the CLI's --lint."""
+    return {
+        "env-knob-parses": lint_env_knob_parses(root),
+        "env-knob-docs": lint_env_knob_docs(readme_path, root),
+        "fault-sites": lint_fault_sites(root, readme_path),
+        "threads": lint_threads(root),
+        "event-kinds": lint_event_kinds(root),
+    }

@@ -509,14 +509,13 @@ class CheckpointCoordinator:
         self.store = store
         self.rank = int(rank)
         self.world_size = int(world_size)
+        from ..utils.envparse import env_float
         if timeout is None:
-            timeout = float(os.environ.get(
-                "PADDLE_TPU_CKPT_BARRIER_TIMEOUT", 60.0))
+            timeout = env_float("PADDLE_TPU_CKPT_BARRIER_TIMEOUT", 60.0)
         self.timeout = float(timeout)
         if resume_timeout is None:
-            resume_timeout = float(os.environ.get(
-                "PADDLE_TPU_CKPT_RESUME_TIMEOUT",
-                max(self.timeout, 120.0)))
+            resume_timeout = env_float("PADDLE_TPU_CKPT_RESUME_TIMEOUT",
+                                       max(self.timeout, 120.0))
         # resume negotiation tolerates much more skew than a save barrier:
         # restarted hosts arrive staggered by backoff + process startup +
         # jit warmup, while mid-training saves are lockstep

@@ -249,11 +249,8 @@ def _deadline_seconds() -> float:
     cold-start compile (tens of seconds on a pod), not just the wire time:
     a too-tight value turns a healthy first-step compile into a false
     dead-peer diagnosis that burns an elastic restart."""
-    raw = os.environ.get("PADDLE_TPU_COLLECTIVE_TIMEOUT", "")
-    try:
-        return float(raw) if raw else 0.0
-    except ValueError:
-        return 0.0
+    from ..utils.envparse import env_float
+    return env_float("PADDLE_TPU_COLLECTIVE_TIMEOUT", 0.0)
 
 
 def _timed_out(kind: str, group: Group):
@@ -399,14 +396,9 @@ def _group_link(g: Group) -> str:
     import os
     log = logging.getLogger("paddle_tpu.collective")
     link = "ici"
-    raw = os.environ.get("PADDLE_TPU_NUM_SLICES", "1") or "1"
-    try:
-        n_slices = int(raw)
-    except ValueError:
-        log.warning("PADDLE_TPU_NUM_SLICES=%r is not an integer; collective "
-                    "link attribution falls back to single-slice (all ici)",
-                    raw)
-        n_slices = 1
+    from ..utils.envparse import env_int
+    # garbled -> single-slice fallback (all ici link attribution)
+    n_slices = env_int("PADDLE_TPU_NUM_SLICES", 1)
     if n_slices > 1:
         try:
             from .auto_parallel.cluster import Cluster, Mapper

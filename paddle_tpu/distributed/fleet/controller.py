@@ -107,15 +107,8 @@ _M_FIRST_STEP = _REG.gauge(
     "digest step, by policy of the decision that caused the relaunch")
 
 
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "")
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        warnings.warn(f"{name}={raw!r} is not a number; using {default}")
-        return default
+# shared knob parsing: garbled values warn once + fall back (envparse)
+from ...utils.envparse import env_float as _env_float  # noqa: E402
 
 
 class ControllerCommandBus:

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ...profiler import events as _events_mod
 from ...profiler import metrics as _metrics_mod
+from ...utils import envparse as _envparse
 
 ELASTIC_EXIT_CODE = 101
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
@@ -351,13 +352,13 @@ class ElasticSupervisor:
                  budget_reset_s: Optional[float] = None,
                  cmd_poll: Optional[float] = None):
         if max_restarts is None:
-            max_restarts = int(os.environ.get(
-                "PADDLE_TPU_ELASTIC_MAX_RESTARTS", 3))
+            max_restarts = _envparse.env_int(
+                "PADDLE_TPU_ELASTIC_MAX_RESTARTS", 3)
         if backoff is None:
-            backoff = float(os.environ.get("PADDLE_TPU_ELASTIC_BACKOFF", 1.0))
+            backoff = _envparse.env_float("PADDLE_TPU_ELASTIC_BACKOFF", 1.0)
         if backoff_max is None:
-            backoff_max = float(os.environ.get(
-                "PADDLE_TPU_ELASTIC_BACKOFF_MAX", 30.0))
+            backoff_max = _envparse.env_float(
+                "PADDLE_TPU_ELASTIC_BACKOFF_MAX", 30.0)
         self.max_restarts = int(max_restarts)
         self.backoff = float(backoff)
         self.backoff_max = float(backoff_max)
@@ -375,12 +376,12 @@ class ElasticSupervisor:
         # desync and every later barrier round times out fleet-wide.
         self.self_member = self_member
         if budget_reset_s is None:
-            budget_reset_s = float(os.environ.get(
-                "PADDLE_TPU_ELASTIC_BUDGET_RESET_SEC", 300.0))
+            budget_reset_s = _envparse.env_float(
+                "PADDLE_TPU_ELASTIC_BUDGET_RESET_SEC", 300.0)
         self.budget_reset_s = float(budget_reset_s)
         if cmd_poll is None:
-            cmd_poll = float(os.environ.get(
-                "PADDLE_TPU_CONTROLLER_POLL_SEC", 1.0))
+            cmd_poll = _envparse.env_float(
+                "PADDLE_TPU_CONTROLLER_POLL_SEC", 1.0)
         self.cmd_poll = max(float(cmd_poll), 0.05)
         if commands is not None and self_member is None:
             warnings.warn(
@@ -742,11 +743,8 @@ class ElasticSupervisor:
         readmit and job_done are both published by the controller host,
         so if that host dies hard this supervisor would otherwise beat
         probation forever with no escape."""
-        try:
-            max_hold = float(os.environ.get(
-                "PADDLE_TPU_CONTROLLER_HOLD_MAX_SEC", "3600"))
-        except ValueError:
-            max_hold = 3600.0
+        max_hold = _envparse.env_float(
+            "PADDLE_TPU_CONTROLLER_HOLD_MAX_SEC", 3600.0)
         deadline = time.monotonic() + max_hold if max_hold > 0 else None
         while True:
             if deadline is not None and time.monotonic() >= deadline:

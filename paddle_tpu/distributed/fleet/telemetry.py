@@ -41,6 +41,7 @@ from typing import Dict, List, Optional
 from ...fault import site as _fault_site
 from ...profiler import events as _events_mod
 from ...profiler import metrics as _metrics_mod
+from ...utils import envparse as _envparse
 
 __all__ = ["FleetReporter", "FleetAggregator", "reporter_from_env",
            "aggregator_from_env", "DIGEST_KEY_FMT"]
@@ -102,15 +103,15 @@ class FleetReporter:
         # (every real rank is its own process with its own endpoint id)
         self.host = host or _events_mod.host_id()
         if window is None:
-            window = int(os.environ.get("PADDLE_TPU_DIGEST_WINDOW", "20"))
+            window = _envparse.env_int("PADDLE_TPU_DIGEST_WINDOW", 20)
         self.walls: "deque[float]" = deque(maxlen=max(int(window), 2))
         if min_interval_s is None:
             # every note still feeds the rolling window, but the store RPC
             # is rate-limited: a per-step synchronous publish would sit in
             # the timed train/bench loop AND congest the one rendezvous
             # store the checkpoint barrier polls at fleet scale
-            min_interval_s = float(
-                os.environ.get("PADDLE_TPU_DIGEST_INTERVAL", "0.5"))
+            min_interval_s = _envparse.env_float(
+                "PADDLE_TPU_DIGEST_INTERVAL", 0.5)
         self.min_interval_s = float(min_interval_s)
         self._last_note: Optional[float] = None
         self._last_publish = 0.0
@@ -211,10 +212,7 @@ class FleetReporter:
 
     @staticmethod
     def _generation() -> int:
-        try:
-            return int(os.environ.get("PADDLE_TPU_ELASTIC_RESTART_NUM", "0"))
-        except ValueError:
-            return 0
+        return _envparse.env_int("PADDLE_TPU_ELASTIC_RESTART_NUM", 0)
 
     @staticmethod
     def _health_status():
@@ -244,12 +242,12 @@ class FleetAggregator:
         self.store = store
         self.world_size = int(world_size)
         if straggler_factor is None:
-            straggler_factor = float(
-                os.environ.get("PADDLE_TPU_STRAGGLER_FACTOR", "2.0"))
+            straggler_factor = _envparse.env_float(
+                "PADDLE_TPU_STRAGGLER_FACTOR", 2.0)
         self.straggler_factor = float(straggler_factor)
         if stale_sec is None:
-            stale_sec = float(
-                os.environ.get("PADDLE_TPU_DIGEST_STALE_SEC", "120"))
+            stale_sec = _envparse.env_float(
+                "PADDLE_TPU_DIGEST_STALE_SEC", 120.0)
         self.stale_sec = float(stale_sec)
         self._lock = threading.Lock()
         self._straggling: set = set()
@@ -373,17 +371,10 @@ class FleetAggregator:
         warning (telemetry must not die of a consumer bug). Returns
         True when the loop started."""
         if interval is None:
-            raw = os.environ.get("PADDLE_TPU_FLEET_POLL_SEC", "")
-            try:
-                interval = float(raw) if raw else 0.0
-            except ValueError:
-                interval = 0.0
+            interval = _envparse.env_float("PADDLE_TPU_FLEET_POLL_SEC", 0.0)
             if interval <= 0 and hook is not None:
-                try:
-                    interval = float(os.environ.get(
-                        "PADDLE_TPU_CONTROLLER_POLL_SEC", "1.0"))
-                except ValueError:
-                    interval = 1.0
+                interval = _envparse.env_float(
+                    "PADDLE_TPU_CONTROLLER_POLL_SEC", 1.0)
         if interval is None or interval <= 0:
             return False
         if self._poll_thread is not None and self._poll_thread.is_alive():

@@ -103,7 +103,8 @@ class PSClient:
         # pulls across tables needs one connection set per concurrent lane
         # (the server spawns a thread per connection). Built lazily.
         if pull_lanes is None:
-            pull_lanes = int(os.environ.get("PADDLE_TPU_PS_PULL_LANES", "4"))
+            from ...utils.envparse import env_int
+            pull_lanes = env_int("PADDLE_TPU_PS_PULL_LANES", 4)
         self._max_pull_lanes = max(1, pull_lanes)
         self._lanes: List[List[int]] = []
         self._lane_lock = threading.Lock()

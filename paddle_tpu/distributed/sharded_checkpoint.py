@@ -277,8 +277,8 @@ def write_shards(step_dir: str, step: int, rank: int, world_size: int,
     until the manifest is renamed (the commit)."""
     from ..fault import site as _fault_site
     if generation is None:
-        generation = int(os.environ.get(
-            "PADDLE_TPU_ELASTIC_RESTART_NUM", "0") or 0)
+        from ..utils.envparse import env_int
+        generation = env_int("PADDLE_TPU_ELASTIC_RESTART_NUM", 0)
     os.makedirs(step_dir, exist_ok=True)
     rank, world_size = int(rank), max(1, int(world_size))
     suffix = f"g{int(generation)}a{int(attempt)}"

@@ -83,6 +83,36 @@ class DeviceOOMError(RuntimeError):
 
 _KINDS = ("error", "timeout", "oserror", "kill", "delay")
 
+#: Registry of every fault site the package declares — the single source
+#: of truth the convention lint (analysis/conventions.py
+#: lint_fault_sites) holds `site("...")` call sites against, mirrored by
+#: the README "Fault sites" table. A site string used in code but absent
+#: here (or registered here with no call site left — a dead site) fails
+#: the lint in tier-1.
+KNOWN_SITES = {
+    "store.get": "TCPStore get (retry-wrapped)",
+    "store.set": "TCPStore set (retry-wrapped)",
+    "store.add": "TCPStore atomic add (retry-wrapped)",
+    "store.check": "TCPStore key-presence check (retry-wrapped)",
+    "parallel.init": "collective rendezvous in init_parallel_env",
+    "collective.timeout": "eager collective launch (guarded deadline)",
+    "device.alloc": "eager dispatch allocator boundary (OOM detection)",
+    "ckpt.commit": "coordinated-checkpoint commit phase",
+    "ckpt.chunk_write": "sharded-checkpoint chunk write",
+    "ckpt.reshard": "sharded-checkpoint re-sharding restore",
+    "heter.pull": "heter-PS sparse pull stage",
+    "heter.push": "heter-PS sparse push stage",
+    "fleet.step": "per-step fleet telemetry hook (straggler chaos)",
+}
+
+#: dynamic site families: call sites build the name from a prefix +
+#: runtime suffix (worker index, PS RPC op name)
+DYNAMIC_SITES = {
+    "dataloader.worker": "DataLoader worker <N> per-batch site (and the "
+                         "bare generic site)",
+    "ps.": "PS client RPC, by op (ps.pull_dense, ps.push_sparse, ...)",
+}
+
 
 @dataclass
 class _Rule:

@@ -83,14 +83,20 @@ class RetryPolicy:
     def from_env(cls, prefix: str, **defaults) -> "RetryPolicy":
         """Build a policy from PADDLE_TPU_<PREFIX>_{RETRIES,BACKOFF,TIMEOUT}
         env knobs, falling back to `defaults` then class defaults."""
+        from ..utils import envparse
         env = os.environ
         p = f"PADDLE_TPU_{prefix.upper()}_"
+        # garbled knob values warn + keep the caller's default (shared
+        # envparse contract) — a typo'd PADDLE_TPU_STORE_RETRIES must not
+        # detonate as an anonymous ValueError at TCPStore construction
         if p + "RETRIES" in env:
-            defaults["max_attempts"] = int(env[p + "RETRIES"])
+            defaults["max_attempts"] = envparse.env_int(
+                p + "RETRIES", defaults.get("max_attempts", 3))
         if p + "BACKOFF" in env:
-            defaults["base_delay"] = float(env[p + "BACKOFF"])
+            defaults["base_delay"] = envparse.env_float(
+                p + "BACKOFF", defaults.get("base_delay", 0.05))
         if p + "TIMEOUT" in env:
-            t = float(env[p + "TIMEOUT"])
+            t = envparse.env_float(p + "TIMEOUT", 0.0)
             defaults["attempt_timeout"] = t if t > 0 else None
         return cls(**defaults)
 

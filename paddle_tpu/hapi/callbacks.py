@@ -197,16 +197,11 @@ class FaultTolerantCheckpoint(Callback):
         self._step = -1
         self._global_step = 0
         self._aborted_saves = 0
-        raw = os.environ.get("PADDLE_TPU_CKPT_ABORT_EXIT", "2")
-        try:
-            self._abort_exit_limit = int(raw)
-        except ValueError:
-            # fail at construction with the real cause, not mid-training
-            # with an anonymous int() error on the first aborted save
-            raise ValueError(
-                f"PADDLE_TPU_CKPT_ABORT_EXIT={raw!r} is not an integer "
-                f"(consecutive aborted coordinated saves before exiting "
-                f"ELASTIC_EXIT_CODE; 0 disables)")
+        # strict: fail at construction with the real cause, not
+        # mid-training with an anonymous int() error on the first abort
+        from ..utils.envparse import env_int
+        self._abort_exit_limit = env_int("PADDLE_TPU_CKPT_ABORT_EXIT", 2,
+                                         strict=True)
         self._epoch_done = False
         self._resume_epoch = -1
         self._resume_skip = 0

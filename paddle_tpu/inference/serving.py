@@ -200,6 +200,7 @@ class ServingEngine:
         self._slots: List[Optional[Request]] = [None] * self.max_batch
         self._cur_tokens = np.zeros((self.max_batch,), np.int32)
         self._closed = False
+        self._audited = False
         self._thread: Optional[threading.Thread] = None
         # rolling stats for bench/status
         self.stats = {"iterations": 0, "prefills": 0, "decode_tokens": 0,
@@ -232,6 +233,49 @@ class ServingEngine:
                 Tensor(ids), cache, slot, length)
         nxt = jnp.argmax(logits.data, axis=-1).astype(jnp.int32)
         return nxt, cache
+
+    def audit(self, emit: bool = True):
+        """Statically audit the decode and (smallest-bucket) prefill
+        executables for perf hazards — donation/aliasing of the page
+        pools, dtype hygiene, baked constants. Trace + lower only;
+        nothing executes and the live cache is untouched. Returns
+        [decode_report, prefill_report]."""
+        import jax.numpy as jnp
+        from .. import analysis
+        tokens = jnp.zeros((self.max_batch,), jnp.int32)
+        active = jnp.zeros((self.max_batch,), bool)
+        decode = analysis.audit_program(
+            self._decode_fn,
+            (self._params, self._buffers, self.cache, tokens, active),
+            donate_argnums=(2,),
+            name=f"serving_decode:{self.name}", entry="serving_decode",
+            emit=emit)
+        bucket = self.prefill_buckets[0]
+        ids = jnp.zeros((1, bucket), jnp.int32)
+        prefill = analysis.audit_program(
+            self._prefill_fn,
+            (self._params, self._buffers, self.cache, ids,
+             np.int32(0), np.int32(1)),
+            donate_argnums=(2,),
+            name=f"serving_prefill:{self.name}", entry="serving_prefill",
+            emit=emit)
+        return [decode, prefill]
+
+    def _maybe_audit_once(self):
+        """PADDLE_TPU_AUDIT runtime hook: vet both executables once per
+        engine, before the first decode iteration."""
+        if self._audited:
+            return
+        self._audited = True
+        from ..jit import _analysis_enabled
+        if not _analysis_enabled("serving"):
+            return
+        try:
+            self.audit()
+        except Exception as e:  # noqa: BLE001 — audit never kills serving
+            import warnings
+            warnings.warn(f"serving program audit failed "
+                          f"({type(e).__name__}: {e}); skipping")
 
     def _observe_site(self, site: str, leaves):
         try:
@@ -459,6 +503,7 @@ class ServingEngine:
 
     def _decode_iteration(self, active_slots: List[int]) -> int:
         import jax.numpy as jnp
+        self._maybe_audit_once()
         active = np.zeros((self.max_batch,), bool)
         active[active_slots] = True
         self._observe_site("decode", [self._cur_tokens])

@@ -38,6 +38,16 @@ def _tree_to_arrays(x):
         is_leaf=lambda t: isinstance(t, Tensor))
 
 
+def _analysis_enabled(entry: str) -> bool:
+    """Fast gate for the PADDLE_TPU_AUDIT trace-time hook: the common
+    (disarmed) case is one env read, no analysis import."""
+    raw = os.environ.get("PADDLE_TPU_AUDIT", "").strip().lower()
+    if raw in ("", "0", "false", "off", "no"):
+        return False
+    from .. import analysis
+    return analysis.enabled(entry)
+
+
 @contextlib.contextmanager
 def _swapped_state(layer: Layer, params: Dict[str, Any], buffers: Dict[str, Any]):
     """Temporarily rebind parameter/buffer arrays (possibly tracers)."""
@@ -140,10 +150,28 @@ class StaticLayer:
                 object.__setattr__(layer, "forward",
                                    types.MethodType(new_fwd, layer))
 
+    def audit(self, *inputs, emit: bool = True):
+        """Statically audit the compiled forward on this input signature
+        (trace + lower only). Returns an analysis.AuditReport."""
+        from .. import analysis
+        params = {k: p.data for k, p in self.layer.named_parameters()}
+        buffers = {k: b.data for k, b in self.layer.named_buffers()}
+        arr_inputs = tuple(_tree_to_arrays(inputs))
+        return analysis.audit_program(
+            self.apply_fn,
+            (params, buffers, jax.random.PRNGKey(0)) + arr_inputs,
+            name=self._wd_name, entry="to_static", emit=emit)
+
     def __call__(self, *inputs, **kw):
         params = {k: p.data for k, p in self.layer.named_parameters()}
         buffers = {k: b.data for k, b in self.layer.named_buffers()}
         arr_inputs = _tree_to_arrays(inputs)
+        if _analysis_enabled("to_static") and not kw:
+            from .. import analysis
+            analysis.maybe_audit(
+                "to_static", self._wd_name, self.apply_fn,
+                (params, buffers, jax.random.PRNGKey(0))
+                + tuple(arr_inputs))
         # retrace watchdog: a new input signature means jax.jit re-traces
         # the whole forward — surface WHAT changed (params/buffers keep
         # their shapes, so the data inputs AND kw leaves key the signature)
@@ -290,6 +318,11 @@ def to_static(layer_or_fn=None, input_spec=None, build_strategy=None, **kw):
             _get_watchdog().observe(
                 "to_static", fn_name,
                 jax.tree_util.tree_leaves(arrs) + list(aux))
+            if _analysis_enabled("to_static"):
+                from .. import analysis
+                analysis.maybe_audit(
+                    "to_static", fn_name, pure.__wrapped__,
+                    (aux, jax.random.PRNGKey(0)) + tuple(arrs))
             _cw_prev = _compile_watch.push_entry("to_static", fn_name)
             try:
                 out = pure(aux, random_mod.default_generator().split(), *arrs)
@@ -427,8 +460,28 @@ class TrainStep:
         donate_args = (0, 2) if donate else ()
         self._step = jax.jit(step, static_argnames=(),
                              donate_argnums=donate_args)
+        # kept for the static program auditor: audit() re-traces this
+        # closure (never the consumed jit object) without executing
+        self._step_raw = step
+        self._donate_argnums = donate_args
         TrainStep._seq += 1
         self._wd_name = f"{type(layer).__name__}#{TrainStep._seq}"
+
+    def audit(self, *batch, emit: bool = True):
+        """Statically audit the compiled step program for perf hazards
+        (donation, dtype hygiene, collectives, baked constants) on this
+        batch signature — trace + lower only, nothing executes. Returns
+        an analysis.AuditReport."""
+        from .. import analysis
+        arrs = tuple(_tree_to_arrays(batch))
+        rng = jax.random.PRNGKey(0)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        return analysis.audit_program(
+            self._step_raw,
+            (self.params, self.buffers, self.opt_state, rng, lr,
+             self._t + 1) + arrs,
+            donate_argnums=self._donate_argnums,
+            name=self._wd_name, entry="train_step", emit=emit)
 
     def __call__(self, *batch):
         self._t += 1
@@ -439,6 +492,15 @@ class TrainStep:
         # expensive retrace in the system; always worth an event
         _get_watchdog().observe("train_step", self._wd_name,
                                 jax.tree_util.tree_leaves(arrs))
+        if _analysis_enabled("train_step"):
+            from .. import analysis
+            # batch args stay UNflattened: the audit must trace the same
+            # signature the real self._step(..., *arrs) call compiles
+            analysis.maybe_audit(
+                "train_step", self._wd_name, self._step_raw,
+                (self.params, self.buffers, self.opt_state,
+                 jax.random.PRNGKey(0), lr, self._t) + tuple(arrs),
+                donate_argnums=self._donate_argnums)
         _cw_prev = _compile_watch.push_entry("train_step", self._wd_name)
         try:
             if self._health_probe is None:

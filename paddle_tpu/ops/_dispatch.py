@@ -12,6 +12,7 @@ the generated GradNodes.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import types
 from collections import OrderedDict
@@ -263,6 +264,12 @@ def _cache_lookup(impl, kwargs, arrs, name=None):
     if len(_eager_cache) > _CACHE_MAX:
         _eager_cache.popitem(last=False)
     _cache_event("miss")
+    if entry is not _UNCACHEABLE and name is not None and \
+            os.environ.get("PADDLE_TPU_AUDIT", "").strip().lower() == "all":
+        # PADDLE_TPU_AUDIT=all: vet each newly cached eager program once
+        # (the compile decision point — every later call is a cache hit)
+        from .. import analysis
+        analysis.maybe_audit("eager", name, entry.prim, tuple(arrs))
     return None if entry is _UNCACHEABLE else entry
 
 

@@ -115,18 +115,10 @@ def cache_dir() -> str:
                             "FLAGS_autotune_cache_dir", "") or "")
 
 
-def _int_knob(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _float_knob(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+# knob parsing goes through the shared helper (garbled values warn once
+# + fall back, matching every other PADDLE_TPU_* numeric knob)
+from ...utils.envparse import env_float as _float_knob  # noqa: E402
+from ...utils.envparse import env_int as _int_knob  # noqa: E402
 
 
 def chip_label(interpret: bool = False) -> str:

@@ -64,8 +64,9 @@ def platform_peaks() -> Tuple[str, float, float]:
     if plat == "cpu":
         peaks = (plat, _CPU_PEAK_FLOPS, _CPU_PEAK_BW)
     else:
+        from ..utils.envparse import env_float
         flops = float(env_key[0]) if env_key[0] else 197e12
-        bw = float(env_key[1] if env_key[1] else 819) * 1e9
+        bw = env_float("PADDLE_TPU_PEAK_HBM_GBS", 819.0) * 1e9
         peaks = (plat, flops, bw)
     _peaks_cache = (env_key, peaks)
     return peaks

@@ -44,37 +44,47 @@ from collections import deque
 from typing import Dict, List, Optional
 
 __all__ = ["EventLog", "default_event_log", "emit", "recent",
-           "validate_event", "KINDS", "SEVERITIES", "host_id"]
+           "validate_event", "KINDS", "KIND_SEVERITY", "SEVERITIES",
+           "host_id"]
 
-#: kinds the runtime emits today (documentation, not a closed set — any
-#: ^[a-z][a-z0-9_]*$ name validates, so downstream tooling stays generic)
-KINDS = (
-    "retrace",            # watchdog: new jit signature at a warm site
-    "xla_compile",        # jax backend compile, attributed to an entry point
-    "fault_injected",     # an armed fault site fired
-    "retry_exhausted",    # a retried op failed every attempt
-    "retry_recovered",    # a retried op succeeded after >= 1 retry
-    "barrier_commit",     # coordinated checkpoint round committed
-    "barrier_abort",      # coordinated checkpoint round aborted
-    "elastic_restart",    # supervisor relaunched the trainer
-    "collective_timeout", # eager collective blew its deadline
-    "device_oom",         # eager op exhausted device memory
-    "fleet_straggler",    # a host's rolling step p50 left the fleet band
-    "step_diagnosis",     # a step window's wall-time decomposition
-    "profile_capture",    # an on-demand profiler capture session ended
-    "tensor_health",      # NaN/Inf detected (sentinel trip or eager op)
-    "health_alert",       # HealthMonitor signal (spike/explosion/...)
-    "health_rollback",    # divergence response restored a checkpoint
-    "fleet_health",       # a host's digest reported a non-ok health status
-    "controller_decision",  # fleet controller decided (evict/readmit/
-                            # rollback), with policy/evidence/outcome
-    "elastic_budget_reset",  # sustained-healthy window restored the
-                             # supervisor's restart budget
-    "serving_admission",  # serving engine admitted a request into the
-                          # continuous decode batch (slot, bucket, wait)
-    "serving_eviction",   # a request left the decode batch (eos/length/
-                          # preempted/failed), pages freed
-)
+#: kinds the runtime emits today -> their DECLARED baseline severity
+#: (what the emitter uses in the common case; some kinds escalate, e.g.
+#: health_alert warn->error on halt). This table is the source of truth
+#: the convention lint (analysis/conventions.py lint_event_kinds) holds
+#: every `emit("<kind>", ...)` call site against, and every kind here
+#: must render through tools/obs_tail.py (not drop as garbage) — the
+#: pairing is pinned by tests/test_conventions.py. Not a closed set for
+#: VALIDATION (any ^[a-z][a-z0-9_]*$ name validates, so downstream
+#: tooling stays generic) — but a new emitter must register here.
+KIND_SEVERITY = {
+    "retrace": "info",            # watchdog: new jit signature, warm site
+    "xla_compile": "info",        # backend compile, attributed to entry
+    "fault_injected": "warn",     # an armed fault site fired
+    "retry_exhausted": "error",   # a retried op failed every attempt
+    "retry_recovered": "info",    # a retried op succeeded after retries
+    "barrier_commit": "info",     # coordinated checkpoint committed
+    "barrier_abort": "warn",      # coordinated checkpoint aborted
+    "elastic_restart": "warn",    # supervisor relaunched the trainer
+    "collective_timeout": "error",  # eager collective blew its deadline
+    "device_oom": "error",        # eager op exhausted device memory
+    "fleet_straggler": "warn",    # a host's step p50 left the fleet band
+    "step_diagnosis": "info",     # step wall-time decomposition
+    "profile_capture": "warn",    # a profiler capture session ended
+    "tensor_health": "error",     # NaN/Inf detected (sentinel or eager)
+    "health_alert": "warn",       # HealthMonitor signal (spike/...)
+    "health_rollback": "warn",    # divergence response restored a ckpt
+    "fleet_health": "error",      # a host's digest went non-ok
+    "controller_decision": "warn",  # controller evict/readmit/rollback
+    "elastic_budget_reset": "info",  # healthy window restored the budget
+    "serving_admission": "info",  # request entered the decode batch
+    "serving_eviction": "info",   # request left the batch (eos/length/
+                                  # preempted/failed), pages freed
+    "analysis_finding": "warn",   # static program auditor finding
+                                  # (severity tracks the finding's own)
+}
+
+#: back-compat view: the registered kind names
+KINDS = tuple(KIND_SEVERITY)
 
 SEVERITIES = ("debug", "info", "warn", "error")
 
@@ -140,7 +150,8 @@ class EventLog:
     def __init__(self, capacity: Optional[int] = None,
                  jsonl_path: Optional[str] = None):
         if capacity is None:
-            capacity = int(os.environ.get("PADDLE_TPU_EVENT_BUFFER", "512"))
+            from ..utils.envparse import env_int
+            capacity = env_int("PADDLE_TPU_EVENT_BUFFER", 512)
         self._lock = threading.Lock()
         self._ring: "deque[dict]" = deque(maxlen=max(int(capacity), 1))
         self._counts: Dict[str, int] = {}
@@ -197,25 +208,14 @@ class EventLog:
         newest PADDLE_TPU_EVENT_LOG_KEEP rotated files. A rotation
         failure never disables the sink — worse to lose events than to
         let the file grow."""
-        raw = os.environ.get("PADDLE_TPU_EVENT_LOG_MAX_MB", "")
-        if not raw:
-            return
-        try:
-            max_bytes = float(raw) * (1 << 20)
-        except ValueError:
-            return
+        from ..utils.envparse import env_float, env_int
+        max_bytes = env_float("PADDLE_TPU_EVENT_LOG_MAX_MB", 0.0) * (1 << 20)
         if max_bytes <= 0:
             return
         try:
             if self._file.tell() < max_bytes:
                 return
-            keep = 3
-            keep_raw = os.environ.get("PADDLE_TPU_EVENT_LOG_KEEP", "")
-            if keep_raw:
-                try:
-                    keep = max(0, int(keep_raw))
-                except ValueError:
-                    pass
+            keep = max(0, env_int("PADDLE_TPU_EVENT_LOG_KEEP", 3))
             self._file.close()
             self._file = None  # lazy reopen on the next emit
             oldest = f"{path}.{keep}"

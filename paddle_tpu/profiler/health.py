@@ -122,10 +122,8 @@ def interval() -> int:
     """Sentinel fetch cadence in steps (the vector is computed in-graph
     every step either way; this bounds the device->host transfers and the
     detection latency)."""
-    try:
-        return max(1, int(os.environ.get("PADDLE_TPU_HEALTH_INTERVAL", "1")))
-    except ValueError:
-        return 1
+    from ..utils.envparse import env_int
+    return max(1, env_int("PADDLE_TPU_HEALTH_INTERVAL", 1))
 
 
 def action() -> str:
@@ -135,10 +133,8 @@ def action() -> str:
 
 
 def max_groups() -> int:
-    try:
-        return max(1, int(os.environ.get("PADDLE_TPU_HEALTH_GROUPS", "32")))
-    except ValueError:
-        return 32
+    from ..utils.envparse import env_int
+    return max(1, env_int("PADDLE_TPU_HEALTH_GROUPS", 32))
 
 
 # ---------------------------------------------------------------------------

@@ -96,8 +96,9 @@ def liveness(stall_after: Optional[float] = None) -> dict:
     """{"status": healthy|stalled|starting, "last_step", "last_step_age_s",
     "stall_after_s"} — the /healthz payload."""
     if stall_after is None:
-        stall_after = float(os.environ.get("PADDLE_TPU_HEALTH_STALL_SEC",
-                                           DEFAULT_STALL_SEC))
+        from ..utils.envparse import env_float
+        stall_after = env_float("PADDLE_TPU_HEALTH_STALL_SEC",
+                                DEFAULT_STALL_SEC)
     with _liveness_lock:
         step, ts = _liveness["step"], _liveness["ts"]
     if step is None:
@@ -358,8 +359,11 @@ def maybe_start_server(role: str = "trainer",
                       f"number; observability server disabled")
         return None
     if role == "supervisor":
-        sup_raw = os.environ.get("PADDLE_TPU_SUPERVISOR_METRICS_PORT", "")
-        port = int(sup_raw) if sup_raw else (port + 1 if port else 0)
+        # default: trainer child owns `port` on the same host, supervisor
+        # takes port+1; a garbled override warns and keeps that default
+        from ..utils.envparse import env_int
+        port = env_int("PADDLE_TPU_SUPERVISOR_METRICS_PORT",
+                       port + 1 if port else 0)
     elif aggregator is None:
         try:
             from ..distributed.fleet import telemetry as _telemetry

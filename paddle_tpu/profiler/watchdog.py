@@ -135,8 +135,8 @@ class RetraceWatchdog:
         self._compiles: Dict[str, Dict[str, float]] = {}
         self.events: "deque[RetraceEvent]" = deque(maxlen=history)
         if warn_threshold is None:
-            warn_threshold = int(
-                os.environ.get("PADDLE_TPU_RETRACE_WARN", "0") or 0)
+            from ..utils.envparse import env_int
+            warn_threshold = env_int("PADDLE_TPU_RETRACE_WARN", 0)
         self.warn_threshold = warn_threshold
 
     # -- recording -----------------------------------------------------------

@@ -494,11 +494,8 @@ def _default_session_root() -> str:
 
 def capture_timeout() -> float:
     """Hard wall-clock cap on one capture (PADDLE_TPU_PROFILE_TIMEOUT)."""
-    try:
-        return float(os.environ.get("PADDLE_TPU_PROFILE_TIMEOUT",
-                                    DEFAULT_CAPTURE_TIMEOUT))
-    except ValueError:
-        return DEFAULT_CAPTURE_TIMEOUT
+    from ..utils.envparse import env_float
+    return env_float("PADDLE_TPU_PROFILE_TIMEOUT", DEFAULT_CAPTURE_TIMEOUT)
 
 
 class ProfileCapture:

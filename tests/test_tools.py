@@ -1413,3 +1413,115 @@ class TestObsTailServing:
         assert rc == 0
         assert "request 1 -> slot 0" in out
         assert "straggler_skip" in out and "decision #4" in out
+
+
+class TestProgramAuditGate:
+    """Per-config `program_audit` blocks and `analysis_*` metric families
+    (static program auditor, ISSUE 15): shape/label contracts with named
+    violations, plus a live-registry roundtrip through an actual audit."""
+
+    @staticmethod
+    def _block(**over):
+        block = {"counts": {"info": 0, "low": 1, "medium": 0, "high": 0},
+                 "clean_high": True,
+                 "reports": [{"name": "GPT#1", "entry": "train_step",
+                              "counts": {"info": 0, "low": 1, "medium": 0,
+                                         "high": 0},
+                              "findings": [{"check": "dtype",
+                                            "severity": "low",
+                                            "code": "silent-upcast",
+                                            "message": "m"}]}]}
+        block.update(over)
+        return block
+
+    def _doc(self, block):
+        return {"configs": {"gpt2": {"tokens_per_sec_chip": 1.0,
+                                     "program_audit": block}}}
+
+    def test_valid_block_passes(self):
+        assert gate.validate_observability(self._doc(self._block())) == []
+
+    def test_error_block_is_legal(self):
+        doc = self._doc({"error": "TypeError: boom"})
+        assert gate.validate_observability(doc) == []
+
+    def test_clean_high_contradiction_named(self):
+        block = self._block(
+            counts={"info": 0, "low": 0, "medium": 0, "high": 2},
+            clean_high=True)
+        probs = gate.validate_observability(self._doc(block))
+        assert any("clean_high" in p and "contradicts" in p for p in probs)
+
+    def test_illegal_check_and_severity_named(self):
+        block = self._block()
+        block["reports"][0]["findings"][0]["check"] = "vibes"
+        block["reports"][0]["findings"][0]["severity"] = "fatal"
+        probs = gate.validate_observability(self._doc(block))
+        assert any("'vibes'" in p for p in probs)
+        assert any("'fatal'" in p for p in probs)
+
+    def test_negative_count_named(self):
+        block = self._block(
+            counts={"info": 0, "low": -1, "medium": 0, "high": 0})
+        probs = gate.validate_observability(self._doc(block))
+        assert any("counts.low" in p for p in probs)
+
+    def test_analysis_metrics_roundtrip_from_live_registry(self):
+        """An actual audit's emitted metrics validate through the gate."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.analysis import audit_program
+        from paddle_tpu.profiler import metrics as metrics_mod
+
+        def step(params, x):
+            return jax.tree_util.tree_map(lambda p: p * 0.9, params), \
+                x.sum()
+
+        audit_program(step, ({"w": jnp.ones((512, 1024))},
+                             jnp.ones((4,))), name="gate_t", emit=True)
+        snap = metrics_mod.default_registry().snapshot()
+        metrics = {k: v for k, v in snap.items()
+                   if k.startswith("analysis_")}
+        assert "analysis_findings_total" in metrics
+        doc = {"configs": {}, "observability": {"metrics": metrics}}
+        assert gate.validate_observability(doc) == []
+
+    def test_unknown_analysis_family_named(self):
+        metrics = {"analysis_mystery_total": {
+            "kind": "counter", "help": "x",
+            "values": [{"labels": {}, "value": 1}]}}
+        doc = {"configs": {}, "observability": {"metrics": metrics}}
+        probs = gate.validate_observability(doc)
+        assert any("analysis_mystery_total" in p and "unknown" in p
+                   for p in probs)
+
+    def test_bad_severity_label_named(self):
+        metrics = {"analysis_findings_total": {
+            "kind": "counter", "help": "x",
+            "values": [{"labels": {"check": "dtype",
+                                   "severity": "fatal"}, "value": 1}]}}
+        doc = {"configs": {}, "observability": {"metrics": metrics}}
+        probs = gate.validate_observability(doc)
+        assert any("severity" in p and "'fatal'" in p for p in probs)
+
+    def test_obs_tail_analysis_view(self, tmp_path, capsys):
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(
+                {"ts": 1.0, "kind": "analysis_finding", "host": "t0",
+                 "severity": "error", "program": "GPT#1",
+                 "entry": "train_step", "check": "donation",
+                 "code": "undonated-large-input",
+                 "finding_severity": "high", "param": "['w']",
+                 "message": "big and dead",
+                 "fix_hint": "donate it"}) + "\n")
+            f.write(json.dumps(
+                {"ts": 2.0, "kind": "retrace", "host": "t0",
+                 "site": "eager"}) + "\n")
+        rc = obs_tail.main([str(path), "--analysis"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "donation/undonated-large-input" in out
+        assert "GPT#1[train_step]" in out and "donate it" in out
+        assert "retrace" not in out  # filtered to analysis kinds

@@ -846,14 +846,141 @@ def _validate_device_memory_metrics(where: str, metrics: dict) -> List[str]:
     return problems
 
 
+_AUDIT_SEVERITIES = ("info", "low", "medium", "high")
+_AUDIT_CHECKS = ("donation", "dtype", "sharding", "bloat")
+
+
+def _validate_program_audit(where: str, pa) -> List[str]:
+    """A config's `program_audit` block: aggregate severity counts, a
+    `clean_high` verdict consistent with them, and per-report findings
+    whose check/severity are legal — the static auditor's bench
+    contract. An `error` block (audit failed on this box) is legal but
+    must name the error."""
+    problems = []
+    if not isinstance(pa, dict):
+        return [f"{where}.program_audit is not an object"]
+    if "error" in pa:
+        if not isinstance(pa["error"], str) or not pa["error"]:
+            problems.append(f"{where}.program_audit.error must be a "
+                            f"non-empty string")
+        return problems
+    counts = pa.get("counts")
+    if not isinstance(counts, dict):
+        problems.append(f"{where}.program_audit.counts missing")
+        counts = {}
+    for sev in _AUDIT_SEVERITIES:
+        v = counts.get(sev)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            problems.append(f"{where}.program_audit.counts.{sev}: "
+                            f"{v!r} is not a non-negative int")
+    ch = pa.get("clean_high")
+    if not isinstance(ch, bool):
+        problems.append(f"{where}.program_audit.clean_high must be a bool")
+    elif isinstance(counts.get("high"), int) and \
+            ch != (counts["high"] == 0):
+        problems.append(f"{where}.program_audit.clean_high={ch} "
+                        f"contradicts counts.high={counts['high']}")
+    reports = pa.get("reports")
+    if not isinstance(reports, list):
+        problems.append(f"{where}.program_audit.reports is not a list")
+        return problems
+    for i, rep in enumerate(reports):
+        if not isinstance(rep, dict):
+            problems.append(f"{where}.program_audit.reports[{i}] is not "
+                            f"an object")
+            continue
+        for key in ("name", "entry"):
+            if not isinstance(rep.get(key), str) or not rep.get(key):
+                problems.append(f"{where}.program_audit.reports[{i}]."
+                                f"{key} must be a non-empty string")
+        for j, f in enumerate(rep.get("findings") or []):
+            loc = f"{where}.program_audit.reports[{i}].findings[{j}]"
+            if not isinstance(f, dict):
+                problems.append(f"{loc} is not an object")
+                continue
+            if f.get("check") not in _AUDIT_CHECKS:
+                problems.append(f"{loc}.check {f.get('check')!r} not in "
+                                f"{_AUDIT_CHECKS}")
+            if f.get("severity") not in _AUDIT_SEVERITIES:
+                problems.append(f"{loc}.severity {f.get('severity')!r} "
+                                f"not in {_AUDIT_SEVERITIES}")
+            for key in ("code", "message"):
+                if not isinstance(f.get(key), str) or not f.get(key):
+                    problems.append(f"{loc}.{key} must be a non-empty "
+                                    f"string")
+    return problems
+
+
+# static-analysis metric families: name -> (kind, required labels)
+_ANALYSIS_FAMILIES = {
+    "analysis_findings_total": ("counter", ("check", "severity")),
+    "analysis_audits_total": ("counter", ("entry",)),
+}
+
+
+def _validate_analysis_metrics(where: str, metrics: dict) -> List[str]:
+    """`analysis_*` families must be counters with non-negative values,
+    check/severity labels drawn from the auditor's legal sets, and a
+    non-empty entry label."""
+    problems = []
+    for name, fam in metrics.items():
+        if not name.startswith("analysis_"):
+            continue
+        spec = _ANALYSIS_FAMILIES.get(name)
+        if spec is None:
+            problems.append(f"{where}.metrics.{name}: unknown analysis "
+                            f"family (expected one of "
+                            f"{sorted(_ANALYSIS_FAMILIES)})")
+            continue
+        kind, req_labels = spec
+        if not isinstance(fam, dict) or fam.get("kind") != kind:
+            problems.append(
+                f"{where}.metrics.{name}: kind "
+                f"{fam.get('kind') if isinstance(fam, dict) else fam!r}, "
+                f"expected {kind}")
+            continue
+        values = fam.get("values") or []
+        if not isinstance(values, list):
+            problems.append(f"{where}.metrics.{name}.values is not a list")
+            continue
+        for i, v in enumerate(values):
+            if not isinstance(v, dict):
+                problems.append(f"{where}.metrics.{name}[{i}] is not a "
+                                f"series object")
+                continue
+            val = v.get("value")
+            if not isinstance(val, (int, float)) or \
+                    isinstance(val, bool) or val != val or val < 0:
+                problems.append(f"{where}.metrics.{name}[{i}]: value "
+                                f"{val!r} is not a non-negative number")
+            labels = v.get("labels") or {}
+            for lk in req_labels:
+                if lk not in labels:
+                    problems.append(f"{where}.metrics.{name}[{i}]: series "
+                                    f"missing the {lk!r} label")
+            if "severity" in labels and \
+                    labels["severity"] not in _AUDIT_SEVERITIES:
+                problems.append(f"{where}.metrics.{name}[{i}]: severity "
+                                f"label {labels['severity']!r} not in "
+                                f"{_AUDIT_SEVERITIES}")
+            if "check" in labels and labels["check"] not in _AUDIT_CHECKS:
+                problems.append(f"{where}.metrics.{name}[{i}]: check "
+                                f"label {labels['check']!r} not in "
+                                f"{_AUDIT_CHECKS}")
+    return problems
+
+
 def validate_observability(doc: dict) -> List[str]:
     """Schema problems in the document's observability sections (empty =
     valid). step_records must conform to the step-record contract,
     events/events_tail to the event contract (`controller_decision`
     events additionally to the decision contract: policy/action/legal
     outcome/decision id), `checkpoint_async_*` / `device_memory_*` /
-    `health_*` / `amp_*` / `autotune_*` / `controller_*` / `serving_*`
-    metric families to their kind/label/shape contracts, `gpt2_decode`
+    `health_*` / `amp_*` / `autotune_*` / `controller_*` / `serving_*` /
+    `analysis_*` metric families to their kind/label/shape contracts,
+    per-config `program_audit` blocks to the static-auditor contract
+    (severity counts, clean_high verdict, legal check/severity per
+    finding), `gpt2_decode`
     configs (a `serving`/`paged_vs_dense` block) to the decode-bench
     contract (TTFT/TPOT percentiles, goodput fields, A/B rows),
     `device_time` blocks to
@@ -885,6 +1012,9 @@ def validate_observability(doc: dict) -> List[str]:
         if cfg.get("serving") is not None \
                 or cfg.get("paged_vs_dense") is not None:
             problems.extend(_validate_decode_block(f"configs.{name}", cfg))
+        pa = cfg.get("program_audit")
+        if pa is not None:
+            problems.extend(_validate_program_audit(f"configs.{name}", pa))
     for where, obs in _obs_blocks(doc):
         metrics = obs.get("metrics")
         if isinstance(metrics, dict):
@@ -894,6 +1024,7 @@ def validate_observability(doc: dict) -> List[str]:
             problems.extend(_validate_autotune_metrics(where, metrics))
             problems.extend(_validate_controller_metrics(where, metrics))
             problems.extend(_validate_serving_metrics(where, metrics))
+            problems.extend(_validate_analysis_metrics(where, metrics))
         at = obs.get("autotune")
         if at is not None:
             problems.extend(_validate_autotune_block(f"{where}.autotune",

@@ -16,6 +16,7 @@ severity + kind-specific payload). This renders that stream for operators:
     python tools/obs_tail.py events.jsonl --health         # numerics plane
     python tools/obs_tail.py events.jsonl --controller     # fleet decisions
     python tools/obs_tail.py events.jsonl --serving        # request lifecycle
+    python tools/obs_tail.py events.jsonl --analysis       # auditor findings
     cat events.jsonl | python tools/obs_tail.py -
 
 `--diagnose` renders `step_diagnosis` events (the runtime's step-slowness
@@ -25,7 +26,9 @@ attribution, health_alert divergence signals, health_rollback responses,
 fleet_health) in an operator-oriented line format; `--serving` renders
 the continuous-batching request lifecycle (serving_admission /
 serving_eviction: slot, bucket, queue wait, eviction reason, free
-pages); `--follow-for N`
+pages); `--analysis` renders static program-auditor findings
+(analysis_finding: program, check/code, offending param + scope, fix
+hint); `--follow-for N`
 bounds a live tail to N seconds (scripting/CI). A sink rotated by
 `PADDLE_TPU_EVENT_LOG_MAX_MB` is read transparently: `path.N`...`path.1`
 siblings stream before `path` in chronological order.
@@ -63,6 +66,8 @@ except Exception:
                     "fleet_health")
 
 SERVING_KINDS = ("serving_admission", "serving_eviction")
+
+ANALYSIS_KINDS = ("analysis_finding",)
 
 
 def rotated_siblings(path: str):
@@ -267,9 +272,31 @@ def format_serving(rec: dict) -> str:
             f"{rec.get('host', '?'):<16} {detail}")
 
 
+def format_analysis(rec: dict) -> str:
+    """One analysis_finding event as an operator line: which program,
+    which check fired, where, and the fix hint."""
+    ts = rec.get("ts")
+    try:
+        when = datetime.fromtimestamp(float(ts)).strftime("%H:%M:%S.%f")[:-3]
+    except (TypeError, ValueError, OSError):
+        when = "??:??:??.???"
+    sev = rec.get("finding_severity", rec.get("severity", "?"))
+    where = f"{rec.get('program', '?')}[{rec.get('entry', '?')}]"
+    detail = f"{rec.get('check', '?')}/{rec.get('code', '?')}"
+    if rec.get("param"):
+        detail += f" at {rec['param']}"
+    if rec.get("scope"):
+        detail += f" (scope {rec['scope']})"
+    detail += f": {rec.get('message', '')}"
+    if rec.get("fix_hint"):
+        detail += f" — fix: {rec['fix_hint']}"
+    return (f"{when} {sev:<6} {where:<28} "
+            f"{rec.get('host', '?'):<16} {detail}")
+
+
 def _emit(events, as_json: bool, out=None, diagnose: bool = False,
           health: bool = False, controller: bool = False,
-          serving: bool = False):
+          serving: bool = False, analysis: bool = False):
     out = out if out is not None else sys.stdout  # resolve at call time
     for rec in events:
         if as_json:
@@ -282,6 +309,8 @@ def _emit(events, as_json: bool, out=None, diagnose: bool = False,
             line = format_controller(rec)
         elif serving and rec.get("kind") in SERVING_KINDS:
             line = format_serving(rec)
+        elif analysis and rec.get("kind") in ANALYSIS_KINDS:
+            line = format_analysis(rec)
         else:
             line = format_event(rec)
         out.write(line + "\n")
@@ -298,6 +327,7 @@ def follow(path: str, args, poll_s: float = 0.5,
     health = getattr(args, "health", False)
     controller = getattr(args, "controller", False)
     serving = getattr(args, "serving", False)
+    analysis = getattr(args, "analysis", False)
     # open the live file FIRST and read the backlog through the same
     # handle: reading a snapshot and then seeking a fresh handle to EOF
     # would silently drop events appended in between
@@ -316,7 +346,7 @@ def follow(path: str, args, poll_s: float = 0.5,
                                args.min_severity, args.since_ts)]
     _emit(window[-args.n:] if args.n else window, args.json,
           diagnose=diagnose, health=health, controller=controller,
-          serving=serving)
+          serving=serving, analysis=analysis)
     try:
         while True:
             if max_s is not None and time.monotonic() - t0 >= max_s:
@@ -339,7 +369,8 @@ def follow(path: str, args, poll_s: float = 0.5,
                    if event_matches(r, args.kind, args.host,
                                     args.min_severity, args.since_ts)],
                   args.json, diagnose=diagnose, health=health,
-                  controller=controller, serving=serving)
+                  controller=controller, serving=serving,
+                  analysis=analysis)
     except KeyboardInterrupt:
         return 0
     finally:
@@ -385,6 +416,12 @@ def main(argv=None) -> int:
                          "bucket, queue wait, eviction reason, free "
                          "pages) with an operator-oriented rendering; "
                          "filters to those kinds unless --kind is given")
+    ap.add_argument("--analysis", action="store_true",
+                    help="show static program-auditor findings "
+                         "(analysis_finding: program, check, offending "
+                         "param/scope, fix hint) with an "
+                         "operator-oriented rendering; filters to that "
+                         "kind unless --kind is given")
     ap.add_argument("--json", action="store_true",
                     help="emit matching events as raw JSONL instead of the "
                          "human format")
@@ -414,6 +451,13 @@ def main(argv=None) -> int:
             args.kind = args.kind + SERVING_KINDS
         else:
             args.kind = (args.kind,) + SERVING_KINDS
+    if args.analysis:
+        if args.kind is None:
+            args.kind = ANALYSIS_KINDS
+        elif isinstance(args.kind, tuple):
+            args.kind = args.kind + ANALYSIS_KINDS
+        else:
+            args.kind = (args.kind,) + ANALYSIS_KINDS
 
     if args.follow:
         if args.path == "-":
@@ -451,7 +495,8 @@ def main(argv=None) -> int:
                                  args.min_severity, args.since_ts)]
     _emit(matching[-args.n:] if args.n else matching, args.json,
           diagnose=args.diagnose, health=args.health,
-          controller=args.controller, serving=args.serving)
+          controller=args.controller, serving=args.serving,
+          analysis=args.analysis)
     return 0
 
 

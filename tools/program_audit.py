@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Offline static program audit of the shipped models + serving path.
+
+Runs the `paddle_tpu.analysis` program auditor (donation, dtype
+hygiene, sharding, executable bloat — trace + lower only, nothing
+executes) over the headline configurations and prints the findings:
+
+    python tools/program_audit.py                       # all models, text
+    python tools/program_audit.py --model gpt2          # one model
+    python tools/program_audit.py --fail-on=high        # CI gate: exit 1
+                                                        # on >= high
+    python tools/program_audit.py --json                # machine-readable
+    python tools/program_audit.py --lint                # convention lints
+    python tools/program_audit.py --scale tiny          # smoke shapes
+
+Models: gpt2 (GPT-2-small bf16+fp32-master TrainStep), resnet50
+(Momentum TrainStep, fused conv+BN tails), bert (BERT-Base cls head,
+bf16 TrainStep), gpt2_decode (the continuous-batching serving engine's
+decode + prefill executables). `--scale ci` (default) audits the real
+architectures at CPU-feasible batch shapes — the audit is about program
+STRUCTURE, which batch size does not change; `--scale tiny` shrinks
+depth/width too (fast smoke for the test suite's plumbing checks).
+
+Exit codes: 0 = no findings at/above --fail-on (default: no gate, always
+0 unless --fail-on given); 1 = gated findings present (or lint
+violations under --lint); 2 = a model failed to build/audit.
+
+This is the CI gate `tests/test_program_audit_gate.py` drives: the
+shipped programs must stay high-clean while the seeded-hazard fixtures
+in tests/test_analysis.py prove every check fires.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _audit_train_step(step, batch):
+    return [step.audit(*batch, emit=False)]
+
+
+def build_gpt2(scale: str):
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(0)
+    cfg = GPTConfig.gpt2_small()
+    if scale == "tiny":
+        cfg.num_layers, cfg.hidden_size, cfg.num_heads = 2, 64, 2
+        cfg.vocab_size = 1024
+    B, L = 1, 128
+    cfg.max_position_embeddings = L
+    cfg.dropout = cfg.attn_dropout = 0.0
+    model = GPT(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          weight_decay=0.01)
+    step = TrainStep(model, F.cross_entropy, opt, amp_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (B, L)).astype("int32"))
+    return _audit_train_step(step, (ids, ids))
+
+
+def build_resnet50(scale: str):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.resnet import BasicBlock, BottleneckBlock, ResNet
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(0)
+    depth = 18 if scale == "tiny" else 50
+    block = BottleneckBlock if depth >= 50 else BasicBlock
+    model = ResNet(block, depth)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    step = TrainStep(model, F.cross_entropy, opt)
+    rng = np.random.default_rng(0)
+    B, hw = 1, 64
+    imgs = paddle.to_tensor(
+        rng.normal(size=(B, 3, hw, hw)).astype("float32"))
+    labels = paddle.to_tensor(rng.integers(0, 1000, (B,)).astype("int32"))
+    return _audit_train_step(step, (imgs, labels))
+
+
+def build_bert(scale: str):
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.bert import Bert, BertConfig
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(0)
+    cfg = BertConfig.tiny() if scale == "tiny" else BertConfig.base()
+    B, L = 2, 64
+    cfg.max_position_embeddings = max(cfg.max_position_embeddings, L)
+    for attr in ("dropout", "hidden_dropout", "attn_dropout",
+                 "hidden_dropout_prob", "attention_probs_dropout_prob"):
+        if hasattr(cfg, attr):
+            setattr(cfg, attr, 0.0)
+
+    class BertCls(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bert = Bert(cfg)
+            self.head = nn.Linear(cfg.hidden_size, 2)
+
+        def forward(self, ids):
+            _, pooled = self.bert(ids)
+            return self.head(pooled)
+
+    model = BertCls()
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    step = TrainStep(model, F.cross_entropy, opt, amp_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (B, L)).astype("int32"))
+    labels = paddle.to_tensor(rng.integers(0, 2, (B,)).astype("int32"))
+    return _audit_train_step(step, (ids, labels))
+
+
+def build_gpt2_decode(scale: str):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+
+    paddle.seed(0)
+    # the bench gpt2_decode CI config: real paged-attention program
+    # structure (page pools, block-table gathers, donated cache)
+    hidden = 64 if scale == "tiny" else 128
+    cfg = GPTConfig(vocab_size=1024 if scale == "tiny" else 8192,
+                    max_position_embeddings=512, hidden_size=hidden,
+                    num_layers=2, num_heads=4,
+                    dropout=0.0, attn_dropout=0.0)
+    model = GPT(cfg)
+    model.eval()
+    eng = ServingEngine(model, max_batch=4, max_len=160, page_size=8,
+                        name="gpt2_decode_audit")
+    return eng.audit(emit=False)
+
+
+MODELS = {
+    "gpt2": build_gpt2,
+    "resnet50": build_resnet50,
+    "bert": build_bert,
+    "gpt2_decode": build_gpt2_decode,
+}
+
+
+def run_audits(models, scale: str):
+    """[(model, AuditReport | error-string)] for the requested models."""
+    results = []
+    for name in models:
+        try:
+            for report in MODELS[name](scale):
+                results.append((name, report))
+        except Exception as e:  # noqa: BLE001 — reported, exit 2
+            results.append((name, f"{type(e).__name__}: {e}"))
+    return results
+
+
+def run_lints() -> int:
+    from paddle_tpu.analysis import conventions
+    rc = 0
+    for lint, violations in conventions.run_all().items():
+        status = "clean" if not violations else \
+            f"{len(violations)} violation(s)"
+        print(f"[{lint}] {status}")
+        for v in violations:
+            print(f"  {v}")
+            rc = 1
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=sorted(MODELS) + ["all"],
+                    default="all", help="which program(s) to audit")
+    ap.add_argument("--scale", choices=("ci", "tiny"), default="ci",
+                    help="ci = real architectures at CPU-feasible batch "
+                         "shapes (default); tiny = shrunken smoke models")
+    ap.add_argument("--fail-on", choices=("high", "medium", "low"),
+                    default=None, dest="fail_on",
+                    help="exit 1 when any finding at/above this severity "
+                         "is present (the CI gate uses high)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of the text table")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the framework convention lints instead of "
+                         "the program audits")
+    args = ap.parse_args(argv)
+
+    if args.lint:
+        return run_lints()
+
+    models = sorted(MODELS) if args.model == "all" else [args.model]
+    results = run_audits(models, args.scale)
+
+    errors = [(m, r) for m, r in results if isinstance(r, str)]
+    reports = [(m, r) for m, r in results if not isinstance(r, str)]
+
+    gated = 0
+    if args.fail_on:
+        gated = sum(len(r.by_severity(args.fail_on)) for _, r in reports)
+
+    if args.json:
+        doc = {"scale": args.scale,
+               "reports": [dict(model=m, **r.to_dict())
+                           for m, r in reports],
+               "errors": [{"model": m, "error": e} for m, e in errors]}
+        if args.fail_on:
+            doc["fail_on"] = args.fail_on
+            doc["gated_findings"] = gated
+        print(json.dumps(doc, indent=2))
+    else:
+        for m, r in reports:
+            print(r.render())
+        for m, e in errors:
+            print(f"{m}: AUDIT FAILED — {e}", file=sys.stderr)
+        if args.fail_on:
+            print(f"gate --fail-on={args.fail_on}: {gated} finding(s) "
+                  f"at/above threshold")
+
+    if errors:
+        return 2
+    return 1 if gated else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

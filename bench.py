@@ -710,12 +710,100 @@ def _paged_vs_dense_ab(model, ctxs, page_size, n_tokens=8, dense_iters=3):
     return out
 
 
+def _fused_vs_eager_ab(model, prompts, max_batch, max_len, page_size,
+                       n_tokens):
+    """The serving-v2 headline A/B: the SAME greedy traffic through the
+    single-dispatch fused decode step vs the per-op eager path (identical
+    math — the engines must produce identical tokens), per-token decode
+    wall from each engine's own stats."""
+    from paddle_tpu.inference.serving import ServingEngine
+
+    out = {"decode_tokens_per_mode": len(prompts) * n_tokens}
+    tokens = {}
+    for mode in ("fused", "eager"):
+        eng = ServingEngine(model, max_batch=max_batch, max_len=max_len,
+                            page_size=page_size, name=f"ab_{mode}",
+                            decode_mode=mode)
+        # warm compile/trace out of the clock (the eager path traces
+        # per-op abstract evals on first use too)
+        eng.submit(prompts[0][:4] or [1], max_new_tokens=2)
+        eng.run_until_idle()
+        w0, t0 = eng.stats["decode_wall_s"], eng.stats["decode_tokens"]
+        reqs = [eng.submit(p, max_new_tokens=n_tokens) for p in prompts]
+        eng.run_until_idle()
+        dw = eng.stats["decode_wall_s"] - w0
+        dt = eng.stats["decode_tokens"] - t0
+        out[f"{mode}_ms_per_token"] = round(1000.0 * dw / max(dt, 1), 3)
+        tokens[mode] = [r.result(5) for r in reqs]
+    out["identical_tokens"] = tokens["fused"] == tokens["eager"]
+    if out["eager_ms_per_token"] and out["fused_ms_per_token"]:
+        out["speedup"] = round(out["eager_ms_per_token"]
+                               / out["fused_ms_per_token"], 3)
+    out["note"] = ("same greedy prompts through decode_mode=fused (ONE "
+                   "donated executable per lane bucket) vs eager (per-op "
+                   "dispatch of the identical step fn); "
+                   "identical_tokens is the bit-parity check")
+    return out
+
+
+def _shared_prefix_ab(model, max_batch, max_len, page_size, n_requests,
+                      prefix_len, n_tokens):
+    """Copy-on-write shared-prefix A/B: the parallel-sampling shape —
+    n_requests with the IDENTICAL prompt and distinct sampling seeds,
+    admitted with prefix sharing on vs off. The win is PAGE-POOL
+    OCCUPANCY (the on side's free-page watermark stays high because the
+    prompt KV is resident once and forked), and the prompt length is
+    deliberately NOT page-aligned so every sharer's first divergent
+    decode write lands on the shared tail page and exercises the
+    copy-on-write fork (cow_copies)."""
+    import numpy as np
+    from paddle_tpu.inference.serving import SamplingParams, ServingEngine
+
+    rng = np.random.default_rng(3)
+    vocab = model.cfg.vocab_size
+    if prefix_len % page_size == 0:
+        prefix_len -= 2  # keep a partial tail page (see docstring)
+    common = rng.integers(1, vocab, (prefix_len,)).tolist()
+    out = {"requests": n_requests, "prefix_tokens": prefix_len}
+    for label, share in (("on", True), ("off", False)):
+        eng = ServingEngine(model, max_batch=max_batch, max_len=max_len,
+                            page_size=page_size, name=f"shp_{label}",
+                            share_prefix=share)
+        reqs = [eng.submit(common, max_new_tokens=n_tokens,
+                           sampling=SamplingParams(temperature=0.8,
+                                                   seed=1000 + i))
+                for i in range(n_requests)]
+        eng.run_until_idle()
+        for r in reqs:
+            r.result(5)
+        st = eng.stats
+        out[label] = {
+            "min_free_pages": int(st["min_free_pages"]),
+            "prefix_hit_tokens": int(st["prefix_hit_tokens"]),
+            "shared_admissions": int(st["shared_admissions"]),
+            "cow_copies": int(st["cow_copies"]),
+            "preemptions": int(st["preemptions"]),
+            "completed": int(st["completed"]),
+        }
+        leak = eng.allocator.outstanding()
+        out[label]["leaked_pages"] = len(leak)
+    out["pages_saved_at_watermark"] = (out["on"]["min_free_pages"]
+                                       - out["off"]["min_free_pages"])
+    out["note"] = ("identical prompt x n_requests with distinct sampling "
+                   "seeds (parallel sampling), shared-prefix CoW admission "
+                   "on vs off; pages_saved_at_watermark = extra free pages "
+                   "at the deepest point = extra admission headroom; "
+                   "cow_copies counts divergent-write page forks")
+    return out
+
+
 def bench_gpt2_decode():
     """Autoregressive-decode serving bench: hundreds of concurrent
     simulated streams through the continuous-batching engine
     (inference/serving.py) over the paged KV cache — tokens/s/chip,
-    p50/p99 TTFT/TPOT, goodput, and the paged-vs-dense per-token A/B.
-    The decode analogue of the train-step configs."""
+    p50/p99 TTFT/TPOT, goodput, and the paged-vs-dense, fused-vs-eager
+    and shared-prefix-on/off A/Bs. The decode analogue of the
+    train-step configs."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.inference.serving import ServingEngine
@@ -730,6 +818,8 @@ def bench_gpt2_decode():
         streams, max_new = 24, 10
         prompt_lo, prompt_hi = 6, 48
         ab_ctxs, ab_tokens = (32, 64, 128), 6
+        fve_streams, fve_tokens = 6, 6
+        shp_requests, shp_prefix, shp_tokens = 8, 32, 4
     else:
         cfg = GPTConfig.gpt2_small()
         cfg.dropout = cfg.attn_dropout = 0.0
@@ -737,6 +827,8 @@ def bench_gpt2_decode():
         streams, max_new = 512, 64
         prompt_lo, prompt_hi = 32, 512
         ab_ctxs, ab_tokens = (128, 512, 960), 16
+        fve_streams, fve_tokens = 64, 16
+        shp_requests, shp_prefix, shp_tokens = 64, 256, 8
     model = GPT(cfg)
     model.eval()
     eng = ServingEngine(model, max_batch=max_batch, max_len=max_len,
@@ -775,6 +867,23 @@ def bench_gpt2_decode():
                                 n_tokens=ab_tokens)
     except Exception as e:
         ab = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        fve_prompts = [rng.integers(1, cfg.vocab_size,
+                                    (int(rng.integers(prompt_lo,
+                                                      prompt_hi)),)).tolist()
+                       for _ in range(fve_streams)]
+        fused_vs_eager = _fused_vs_eager_ab(
+            model, fve_prompts, max_batch, max_len, page_size,
+            n_tokens=fve_tokens)
+    except Exception as e:
+        fused_vs_eager = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        shared_prefix = _shared_prefix_ab(
+            model, max_batch, max_len, page_size,
+            n_requests=shp_requests, prefix_len=shp_prefix,
+            n_tokens=shp_tokens)
+    except Exception as e:
+        shared_prefix = {"error": f"{type(e).__name__}: {e}"}
     return {
         "name": (f"gpt-decode {cfg.num_layers}L-h{cfg.hidden_size} "
                  f"continuous batching b{max_batch} x {streams} streams "
@@ -802,6 +911,8 @@ def bench_gpt2_decode():
                      "TPOT is per finished request, first->last token"),
         },
         "paged_vs_dense": ab,
+        "fused_vs_eager": fused_vs_eager,
+        "shared_prefix": shared_prefix,
         "program_audit": _program_audit_block(lambda: eng.audit()),
         "observability": obs,
     }

@@ -12,8 +12,9 @@ Operator surfaces: `tools/program_audit.py` (offline CLI, CI gate via
 """
 from .auditor import (AUDIT_ENV, audit_program, audit_sharding, enabled,
                       maybe_audit, reset_seen)
-from .findings import CHECKS, SEVERITIES, AuditReport, Finding
+from .findings import (CHECKS, SEVERITIES, AuditReport, Finding,
+                       recent_reports)
 
 __all__ = ["AUDIT_ENV", "audit_program", "audit_sharding", "enabled",
            "maybe_audit", "reset_seen", "AuditReport", "Finding",
-           "CHECKS", "SEVERITIES"]
+           "CHECKS", "SEVERITIES", "recent_reports"]

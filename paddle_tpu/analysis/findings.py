@@ -23,13 +23,16 @@ themselves count in ``analysis_audits_total{entry=}``.
 """
 from __future__ import annotations
 
+import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..profiler import events as _events_mod
 from ..profiler import metrics as _metrics_mod
 
-__all__ = ["Finding", "AuditReport", "SEVERITIES", "CHECKS"]
+__all__ = ["Finding", "AuditReport", "SEVERITIES", "CHECKS",
+           "recent_reports"]
 
 #: ascending order (the CLI's --fail-on threshold indexes into this)
 SEVERITIES = ("info", "low", "medium", "high")
@@ -47,6 +50,19 @@ _M_FINDINGS = _REG.counter(
 _M_AUDITS = _REG.counter(
     "analysis_audits_total",
     "program audits run, by jit entry point")
+
+#: newest emitted audit reports, for the ObservabilityServer /snapshot
+#: endpoint (bounded; a long-lived daemon auditing every engine it
+#: builds must not grow this without limit)
+_RECENT_REPORTS: "deque[dict]" = deque(maxlen=16)
+
+
+def recent_reports() -> List[dict]:
+    """The newest emitted audit reports (dict form, oldest first) —
+    what the ObservabilityServer surfaces under `program_audit`. Each
+    entry is `AuditReport.to_dict(max_findings=8)` plus an `emitted_ts`
+    wall-clock stamp."""
+    return list(_RECENT_REPORTS)
 
 
 @dataclass
@@ -130,6 +146,12 @@ class AuditReport:
         """Land this report on the observability plane: one
         `analysis_finding` event per finding + the metric families.
         Never raises (audits run inside training entry points)."""
+        try:
+            rec = self.to_dict(max_findings=8)
+            rec["emitted_ts"] = time.time()
+            _RECENT_REPORTS.append(rec)
+        except Exception:
+            pass
         try:
             if _metrics_mod.enabled():
                 _M_AUDITS.inc(entry=self.entry)

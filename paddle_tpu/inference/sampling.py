@@ -1,0 +1,145 @@
+"""In-graph sampling policies for the fused serving decode step.
+
+The serving engine's decode iteration is ONE donated jitted executable
+(inference/serving.py); pulling logits back to the host to sample there
+would re-introduce a host round-trip per token and a second dispatch.
+Everything here is therefore traceable and lives INSIDE that
+executable: temperature scaling, top-k truncation, top-p (nucleus)
+truncation and the categorical draw all run on device, batched over
+the decode lanes, and the chosen token is the only thing that crosses
+back per iteration.
+
+Determinism contract (the preemption-survival property the engine's
+recompute-style preemption relies on):
+
+* every request carries its own integer ``seed`` (defaulting to its
+  request id), threaded into the executable as a lane of the ``seeds``
+  array — no RNG state is carried between iterations;
+* the key for the n-th sampled token of a request is
+  ``fold_in(PRNGKey(seed), n)`` — a pure function of (seed, n), so a
+  request preempted after k tokens and re-prefilled resumes sampling
+  token k with exactly the key it would have used uninterrupted;
+* ``temperature == 0`` lanes take the exact ``argmax`` path and are
+  bit-identical to the PR-14 greedy engine (the parity tests compare
+  whole generations against ``GPT.generate_paged``).
+
+``sample_logits`` short-circuits through ``lax.cond`` when EVERY lane
+is greedy, so a pure-greedy serving batch never pays the sort/softmax
+cost of the sampling branch.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["SamplingParams", "sample_logits"]
+
+#: lanes with temperature <= _GREEDY_EPS are greedy (exact argmax);
+#: positive temperatures below it are clamped to it for stable division
+_GREEDY_EPS = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling policy.
+
+    temperature: 0 (default) = greedy argmax, bit-exact with the PR-14
+        path; > 0 scales logits by 1/temperature before the draw.
+    top_k: keep only the k highest logits (0 = disabled). Clamped to
+        the vocab size in-graph.
+    top_p: nucleus sampling — keep the smallest set of tokens whose
+        probability mass reaches top_p (1.0 = disabled). The highest-
+        probability token is always kept.
+    seed: RNG seed for this request; None derives it from the request
+        id at submit. The n-th token uses fold_in(PRNGKey(seed), n).
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, "
+                             f"got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 disables), "
+                             f"got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= _GREEDY_EPS
+
+
+GREEDY = SamplingParams()
+
+
+def _fold_keys(seeds, steps):
+    """[B] per-lane PRNG keys: fold_in(PRNGKey(seed), step). Pure in
+    (seed, step) — no carried state, so preemption + recompute resumes
+    the stream exactly."""
+    import jax
+
+    def one(seed, step):
+        return jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
+    return jax.vmap(one)(seeds, steps)
+
+
+def _truncate(logits, top_k, top_p):
+    """Mask logits outside the per-lane top-k/top-p sets to -inf.
+    `logits` [B, V] f32; `top_k` [B] int32 (0 = off); `top_p` [B] f32
+    (1 = off). Value-threshold mapping back from the sorted order keeps
+    ties together (deterministically over-inclusive, never empty)."""
+    import jax.numpy as jnp
+    V = logits.shape[-1]
+    desc = -jnp.sort(-logits, axis=-1)                       # [B, V] desc
+    # top-k: threshold at the k-th largest value (k<=0 -> keep all)
+    k = jnp.clip(top_k, 0, V)
+    kth = jnp.take_along_axis(
+        desc, jnp.maximum(k - 1, 0)[:, None], axis=-1)       # [B, 1]
+    keep_k = jnp.where((k > 0)[:, None], logits >= kth, True)
+    # top-p: keep sorted tokens whose PRECEDING cumulative mass < p
+    # (the top token's preceding mass is 0, so it always survives)
+    probs = jnp.exp(desc - desc[:, :1])
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    before = jnp.cumsum(probs, axis=-1) - probs              # mass before i
+    kept_sorted = before < top_p[:, None]
+    # smallest kept sorted value = the admission threshold per lane
+    thresh = jnp.min(jnp.where(kept_sorted, desc, jnp.inf),
+                     axis=-1, keepdims=True)
+    keep_p = logits >= thresh
+    return jnp.where(keep_k & keep_p, logits, -jnp.inf)
+
+
+def sample_logits(logits, temperature, top_k, top_p, seeds, steps):
+    """Draw one token per lane from `logits` [B, V]. All policy args
+    are [B] arrays (per-lane): `temperature` f32, `top_k` int32,
+    `top_p` f32, `seeds` int32, `steps` int32 (tokens already sampled
+    by that lane's request — the fold_in counter). Returns [B] int32.
+
+    Traceable; runs inside the fused serving decode executable. Lanes
+    with temperature <= 0 take the exact argmax (bit-parity with the
+    greedy engine); when ALL lanes are greedy the sampling branch is
+    skipped entirely via lax.cond.
+    """
+    import jax
+    import jax.numpy as jnp
+    logits = logits.astype(jnp.float32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    is_greedy = temperature <= _GREEDY_EPS
+
+    def _sampled():
+        scaled = logits / jnp.maximum(temperature, _GREEDY_EPS)[:, None]
+        masked = _truncate(scaled, jnp.asarray(top_k, jnp.int32),
+                           jnp.asarray(top_p, jnp.float32))
+        keys = _fold_keys(jnp.asarray(seeds, jnp.int32),
+                          jnp.asarray(steps, jnp.int32))
+        drawn = jax.vmap(jax.random.categorical)(keys, masked)
+        return jnp.where(is_greedy, greedy, drawn.astype(jnp.int32))
+
+    return jax.lax.cond(jnp.all(is_greedy), lambda: greedy, _sampled)

@@ -11,26 +11,41 @@ KV cache (ops/pallas/paged_attention.py) —
   on the very next step, never at epoch/batch boundaries);
 * **prefill is shape-bucketed**: a prompt pads up to the smallest
   configured bucket, so the whole serving life of the engine compiles
-  one decode executable + one prefill executable per bucket — the
-  retrace watchdog stays quiet and the PR-8 persistent compile cache
+  one prefill executable per bucket — the retrace watchdog stays quiet
+  and the PR-8 persistent compile cache
   (``PADDLE_TPU_COMPILE_CACHE_DIR``) makes cold-start cheap;
+* the **decode iteration is ONE donated, jitted executable per lane
+  bucket**: all transformer layers, the paged-attention kernel, the
+  K/V page append, the in-graph sampling draw
+  (inference/sampling.py — temperature / top-k / top-p with per-request
+  seeds; ``temperature == 0`` lanes are bit-exact argmax) and the
+  context-length bump fuse into a single dispatch with the page pools
+  DONATED (the multi-GB pool updates in place per token). Active slots
+  gather into ``W`` lanes (``W`` = smallest power-of-two bucket
+  covering the active count, per the ``fused_decode_step`` autotune
+  op), so a mostly-idle batch runs a narrow executable;
+  ``decode_mode="eager"`` keeps the per-op dispatch path alive as the
+  measured A/B baseline (``path`` label on the latency histograms);
 * **pages, not slabs**: each sequence owns block-table pages from a
-  :class:`PageAllocator`; pages free on EOS/length, and when the pool
-  runs dry the youngest request is PREEMPTED (pages freed, request
-  requeued with its generated prefix — recompute-style, vLLM's fallback)
-  instead of the engine deadlocking;
-* the decode step is ONE jitted executable over the whole batch with the
-  cache DONATED (the multi-GB page pool is updated in place per token);
+  refcounted :class:`PageAllocator`. Requests sharing a prompt prefix
+  map their block tables at the SAME physical pages (registered and
+  looked up at admission in the engine's prefix cache) — a shared page
+  is copied only on first divergent write (copy-on-write fork, the
+  vLLM trick that multiplies effective pool capacity under a common
+  system prompt). Pages free on EOS/length, and when the pool runs dry
+  the youngest request is PREEMPTED (pages freed, request requeued with
+  its generated prefix — recompute-style) instead of the engine
+  deadlocking;
 * **serving metric families** land on the PR-6 metrics plane:
   ``serving_queue_depth``, ``serving_batch_occupancy``,
   ``serving_ttft_seconds``, ``serving_tpot_seconds``,
-  ``serving_goodput_tokens_total`` — plus one ``serving_admission`` /
+  ``serving_goodput_tokens_total`` (latency histograms split by the
+  decode ``path`` — fused vs eager) — plus one ``serving_admission`` /
   ``serving_eviction`` structured event per request lifecycle edge
   (rendered by ``tools/obs_tail.py --serving``).
 
-Greedy decoding only (argmax — the mode with a bit-exact dense parity
-check); sampling policies ride on the same loop later. Weight hot-swap
-by polling sharded-checkpoint manifests is the ROADMAP follow-up.
+Weight hot-swap by polling sharded-checkpoint manifests is the ROADMAP
+follow-up.
 """
 from __future__ import annotations
 
@@ -38,7 +53,7 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,8 +61,9 @@ from ..framework import tape as tape_mod
 from ..framework.tensor import Tensor
 from ..profiler import events as _events
 from ..profiler import metrics as _metrics
+from .sampling import SamplingParams, sample_logits
 
-__all__ = ["Request", "PageAllocator", "ServingEngine"]
+__all__ = ["Request", "PageAllocator", "SamplingParams", "ServingEngine"]
 
 _REG = _metrics.default_registry()
 _M_QUEUE = _REG.gauge(
@@ -60,42 +76,155 @@ _M_OCC = _REG.gauge(
 _M_TTFT = _REG.histogram(
     "serving_ttft_seconds",
     "time to first token: request submit -> first generated token, "
-    "by model")
+    "by model and decode path (fused|eager)")
 _M_TPOT = _REG.histogram(
     "serving_tpot_seconds",
     "time per output token after the first, observed once per finished "
-    "request, by model")
+    "request, by model and decode path (fused|eager)")
 _M_GOODPUT = _REG.counter(
     "serving_goodput_tokens_total",
     "generated tokens delivered to finished or running requests, by model")
 
 
 class PageAllocator:
-    """Free-list allocator over the KV page pool. Page 0 is the NULL
-    page (idle slots' block tables point at it; masked decode writes
-    land there) and is never handed out."""
+    """Refcounted free-list allocator over the KV page pool. Page 0 is
+    the NULL page (idle slots' block tables point at it; masked decode
+    writes land there) and is never handed out.
 
-    def __init__(self, num_pages: int):
+    ``alloc`` hands out pages at refcount 1; ``fork`` increments the
+    refcount of pages a second request maps at the same physical
+    location (shared-prefix admission); ``free`` decrements, and a page
+    returns to the free list only when its LAST holder releases it —
+    preempting one sharer can never free a page another request still
+    references. ``on_release(page)`` fires exactly once per page, at
+    that last release (the engine evicts its prefix-cache entries
+    there)."""
+
+    def __init__(self, num_pages: int, on_release=None):
         self.num_pages = int(num_pages)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._refs: Dict[int, int] = {}
+        self._on_release = on_release
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n page ids, or None when the pool can't cover the request
-        (caller preempts or queues — a partial grab is never left
-        dangling)."""
+        """n page ids at refcount 1, or None when the pool can't cover
+        the request (caller preempts or queues — a partial grab is never
+        left dangling)."""
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
         return out
 
-    def free(self, pages: Sequence[int]):
+    def fork(self, pages: Sequence[int]):
+        """Share already-allocated pages with one more holder (copy-on-
+        write mapping: the new holder's block table points at the same
+        physical pages; the first divergent write copies)."""
         for p in pages:
-            if p:  # the null page is not pool-managed
-                self._free.append(int(p))
+            if p:
+                self._refs[p] = self._refs.get(p, 0) + 1
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
+
+    def is_shared(self, page: int) -> bool:
+        return self.refcount(page) > 1
+
+    def outstanding(self) -> Dict[int, int]:
+        """{page: refcount} for every live page — the no-leak audit
+        surface (empty once every request has finished)."""
+        return dict(self._refs)
+
+    def free(self, pages: Sequence[int]):
+        """Release one holder's reference on each page; a page recycles
+        to the free list only at refcount zero."""
+        for p in pages:
+            if not p:  # the null page is not pool-managed
+                continue
+            p = int(p)
+            refs = self._refs.get(p, 1) - 1
+            if refs > 0:
+                self._refs[p] = refs
+                continue
+            self._refs.pop(p, None)
+            self._free.append(p)
+            if self._on_release is not None:
+                self._on_release(p)
+
+
+class _PrefixCache:
+    """Token-chain -> physical-page registry for shared-prefix admission.
+
+    Registered at admission: every page-aligned prefix of an admitted
+    request's tokens maps to the page holding its last ``page_size``
+    tokens, and the exact full token list additionally maps to the
+    partial tail page (if any). Lookup walks the longest chain of full
+    pages matching a new prompt's prefix; the partial tail joins ONLY on
+    an exact whole-prompt match (the parallel-sampling case — same
+    prompt, different seeds — where the first divergent decode write
+    triggers the copy-on-write fork).
+
+    Entries never hold refcounts themselves: a page is only shareable
+    while some live request holds it, and the allocator's release hook
+    (`drop_page`) evicts its entries the moment the last holder frees
+    it — the registry can never hand out a recycled page."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self._full: Dict[Tuple[int, ...], int] = {}
+        self._partial: Dict[Tuple[int, ...], int] = {}
+        self._by_page: Dict[int, List[Tuple[str, Tuple[int, ...]]]] = {}
+
+    def __len__(self):
+        return len(self._full) + len(self._partial)
+
+    def _put(self, kind: str, key: Tuple[int, ...], page: int):
+        d = self._full if kind == "full" else self._partial
+        if key in d:
+            return
+        d[key] = page
+        self._by_page.setdefault(page, []).append((kind, key))
+
+    def register(self, tokens: Sequence[int], pages: Sequence[int]):
+        ps = self.page_size
+        tokens = tuple(int(t) for t in tokens)
+        for i in range(len(tokens) // ps):
+            self._put("full", tokens[:(i + 1) * ps], pages[i])
+        if len(tokens) % ps:
+            self._put("partial", tokens, pages[len(tokens) // ps])
+
+    def lookup(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """(shared_pages, shared_len): the longest registered chain
+        covering a prefix of `tokens`. shared_len is page-aligned unless
+        the exact-match partial tail joined (then == len(tokens))."""
+        ps = self.page_size
+        tokens = tuple(int(t) for t in tokens)
+        pages: List[int] = []
+        n = 0
+        for i in range(len(tokens) // ps):
+            page = self._full.get(tokens[:(i + 1) * ps])
+            if page is None:
+                break
+            pages.append(page)
+            n = (i + 1) * ps
+        tail = len(tokens) % ps
+        if tail and n == len(tokens) - tail:
+            page = self._partial.get(tokens)
+            if page is not None:
+                pages.append(page)
+                n = len(tokens)
+        return pages, n
+
+    def drop_page(self, page: int):
+        for kind, key in self._by_page.pop(int(page), []):
+            d = self._full if kind == "full" else self._partial
+            if d.get(key) == page:
+                del d[key]
 
 
 class Request:
@@ -105,11 +234,18 @@ class Request:
     _ids = itertools.count(1)
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
-                 eos_id: int = -1):
+                 eos_id: int = -1,
+                 sampling: Optional[SamplingParams] = None):
         self.rid = next(Request._ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = int(eos_id)
+        self.sampling = sampling or SamplingParams()
+        # per-request RNG stream; the n-th token's key is
+        # fold_in(PRNGKey(seed), n) — pure in (seed, n), so preemption +
+        # recompute resumes the identical stream
+        self.seed = (self.sampling.seed if self.sampling.seed is not None
+                     else self.rid) & 0x7FFFFFFF
         self.generated: List[int] = []
         self.state = "queued"          # queued|running|done|failed
         self.finish_reason: Optional[str] = None
@@ -120,6 +256,7 @@ class Request:
         self.preemptions = 0
         self.slot: Optional[int] = None
         self.pages: List[int] = []
+        self.shared_tokens = 0         # prefix tokens served from shared pages
         self._done = threading.Event()
 
     # -- latency accounting ---------------------------------------------------
@@ -158,6 +295,49 @@ def _pow2_buckets(lo: int, hi: int) -> List[int]:
     return out
 
 
+#: cross-engine memo for the fused-step autotune decision (cleared by
+#: autotune.reset_for_tests with every other kernel memo)
+def _register_step_memo():
+    from ..ops.pallas import autotune as _autotune
+    return _autotune.register_memo({})
+
+
+_step_cfg_memo = None
+
+
+def _resolve_step_cfg(model_key: tuple, max_batch: int):
+    """The ``fused_decode_step`` autotune decision: lane-bucketed
+    (impl=1, one executable per power-of-two active-lane bucket from
+    ``min_lanes`` up) vs full-width (impl=0, one max_batch-wide
+    executable regardless of occupancy). Persisted per (op, model
+    shape, chip) like every autotuned kernel. On CPU (no measured
+    probe) the static default is lane-bucketed with min_lanes=1 — the
+    narrow executable is the TPOT lever at low occupancy."""
+    global _step_cfg_memo
+    from ..ops.pallas import autotune as _autotune
+    from ..ops.pallas import tiling as _tiling
+    if _step_cfg_memo is None:
+        _step_cfg_memo = _register_step_memo()
+    key = model_key + (max_batch,)
+    memo_key = (key, _autotune.mode())
+    hit = _step_cfg_memo.get(memo_key)
+    if hit is not None:
+        return hit
+    default = _tiling.make_config(impl=1, min_lanes=1)
+    floors = sorted({1, max(1, max_batch // 2)})
+    cands = _tiling.candidate_configs(
+        ("impl", "min_lanes"), [(1,), floors], default)
+    cands = cands + [_tiling.make_config(impl=0, min_lanes=max_batch)]
+    # no bench closure: a representative probe needs live traffic at a
+    # given occupancy; fleets override via PADDLE_TPU_AUTOTUNE_CACHE_DIR
+    # entries measured by the serving bench (tools/check_bench_result
+    # fused_vs_eager block)
+    cfg = _autotune.get_config("fused_decode_step", key, candidates=cands,
+                               default=default, bench=None)
+    _step_cfg_memo[memo_key] = cfg
+    return cfg
+
+
 class ServingEngine:
     """Continuous-batching decode engine over one model's paged KV cache.
 
@@ -168,14 +348,31 @@ class ServingEngine:
 
     `num_pages` below full backing turns the allocator into a real
     constraint: admission waits for pages and decode preempts when the
-    pool runs dry. The default fully backs `max_batch` x `max_len`."""
+    pool runs dry. The default fully backs `max_batch` x `max_len`.
+
+    `decode_mode`: "fused" (default) runs each decode iteration as ONE
+    donated jitted executable per active-lane bucket — model layers,
+    paged attention, K/V append, in-graph sampling and the length bump
+    in a single dispatch. "eager" runs the identical math per-op
+    (unjitted) — the measured baseline the `path` metric label and the
+    bench's fused_vs_eager A/B compare against. Both modes produce
+    bit-identical tokens.
+
+    `share_prefix` (default True) admits requests whose prompt prefix
+    is already resident (page-aligned prefix chains; exact-duplicate
+    prompts additionally share the partial tail page) by FORKING the
+    pages copy-on-write instead of recomputing + re-storing the KV."""
 
     def __init__(self, model, *, max_batch: int = 4, max_len: int = 256,
                  page_size: int = 16, num_pages: int = 0,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 eos_id: int = -1, name: str = "gpt"):
+                 eos_id: int = -1, name: str = "gpt",
+                 decode_mode: str = "fused", share_prefix: bool = True):
         import jax
 
+        if decode_mode not in ("fused", "eager"):
+            raise ValueError(f"decode_mode must be 'fused' or 'eager', "
+                             f"got {decode_mode!r}")
         model.eval()
         self.model = model
         self.name = name
@@ -183,15 +380,29 @@ class ServingEngine:
         self.max_len = int(max_len)
         self.page_size = int(page_size)
         self.eos_id = int(eos_id)
+        self.decode_mode = decode_mode
+        self.share_prefix = bool(share_prefix)
         self.cache = model.init_cache(max_batch, max_len,
                                       page_size=page_size,
                                       num_pages=num_pages)
-        self.allocator = PageAllocator(self.cache.num_pages)
+        self._prefix = _PrefixCache(page_size)
+        self.allocator = PageAllocator(self.cache.num_pages,
+                                       on_release=self._prefix.drop_page)
         if prefill_buckets is None:
             prefill_buckets = _pow2_buckets(min(16, max_len), max_len)
         self.prefill_buckets = sorted(set(int(b) for b in prefill_buckets))
         if self.prefill_buckets[-1] < max_len:
             self.prefill_buckets.append(max_len)
+        # fused-step lane buckets from the autotune decision: impl=1 ->
+        # one executable per pow2 bucket in [min_lanes, max_batch];
+        # impl=0 -> the single full-width executable
+        cfg = _resolve_step_cfg(self._model_key(), self.max_batch)
+        self.step_impl = cfg["impl"]
+        if self.step_impl == 0:
+            self.decode_buckets = [self.max_batch]
+        else:
+            self.decode_buckets = _pow2_buckets(
+                min(cfg["min_lanes"], self.max_batch), self.max_batch)
 
         self._params = {k: p.data for k, p in model.named_parameters()}
         self._buffers = {k: b.data for k, b in model.named_buffers()}
@@ -204,58 +415,88 @@ class ServingEngine:
         self._thread: Optional[threading.Thread] = None
         # rolling stats for bench/status
         self.stats = {"iterations": 0, "prefills": 0, "decode_tokens": 0,
-                      "completed": 0, "preemptions": 0, "decode_wall_s": 0.0}
+                      "completed": 0, "preemptions": 0, "decode_wall_s": 0.0,
+                      "cow_copies": 0, "prefix_hit_tokens": 0,
+                      "shared_admissions": 0,
+                      "min_free_pages": self.allocator.free_pages}
 
-        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(2,))
+        # ONE jit object each: XLA specializes per input shape, so the
+        # fused step compiles exactly one executable per decode-lane
+        # bucket and prefill one per prompt bucket — both donate the
+        # cache (the page pools update in place)
+        self._fused_jit = jax.jit(self._fused_step_fn, donate_argnums=(2,))
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(2,))
 
-    # -- jitted model steps ---------------------------------------------------
-    # One decode executable for the engine's life; one prefill trace per
-    # shape bucket (bounded by len(prefill_buckets)). Both observe the
-    # retrace watchdog so an unexpected extra signature is surfaced like
-    # any other jit site, and compile time is attributed on the compile
-    # watch plane.
+    def _model_key(self) -> tuple:
+        cfg = getattr(self.model, "cfg", None)
+        dt = self.cache.k_pages[0].dtype
+        return (getattr(cfg, "num_layers", 0),
+                getattr(cfg, "hidden_size", 0),
+                getattr(cfg, "num_heads", 0),
+                self.page_size, str(np.dtype(dt) if dt is not None else ""))
 
-    def _decode_fn(self, params, buffers, cache, tokens, active):
+    # -- jitted model steps ---------------------------------------------------
+    # The fused decode step is the tentpole: every layer, the paged-
+    # attention kernel, the K/V page append, the in-graph sampling draw
+    # and the context-length bump — one traced function, donated cache,
+    # one dispatch per iteration per lane bucket. Each bucket's site
+    # observes the retrace watchdog (an unexpected extra signature
+    # surfaces like any other jit site) and compile time is attributed
+    # on the compile-watch plane.
+
+    def _fused_step_fn(self, params, buffers, cache, tokens, slot_map,
+                       lane_active, temp, top_k, top_p, seeds, steps):
         import jax.numpy as jnp
         from ..jit import _swapped_state
         with tape_mod.no_grad(), _swapped_state(self.model, params, buffers):
             logits, cache = self.model.forward_decode(
-                Tensor(tokens), cache, active)
-        nxt = jnp.argmax(logits.data, axis=-1).astype(jnp.int32)
-        return nxt, cache
+                Tensor(tokens), cache, lane_active, slot_map=slot_map)
+        nxt = sample_logits(logits.data, temp, top_k, top_p, seeds, steps)
+        return jnp.where(lane_active, nxt, 0), cache
 
-    def _prefill_fn(self, params, buffers, cache, ids, slot, length):
-        import jax.numpy as jnp
+    def _prefill_fn(self, params, buffers, cache, ids, slot, length,
+                    write_start, temp, top_k, top_p, seed, step):
         from ..jit import _swapped_state
         with tape_mod.no_grad(), _swapped_state(self.model, params, buffers):
             logits, cache = self.model.forward_prefill(
-                Tensor(ids), cache, slot, length)
-        nxt = jnp.argmax(logits.data, axis=-1).astype(jnp.int32)
+                Tensor(ids), cache, slot, length, write_start=write_start)
+        # the FIRST generated token samples in-graph too (step counter 0,
+        # or len(generated) on a post-preemption re-prefill)
+        nxt = sample_logits(logits.data, temp, top_k, top_p, seed, step)
         return nxt, cache
 
     def audit(self, emit: bool = True):
-        """Statically audit the decode and (smallest-bucket) prefill
-        executables for perf hazards — donation/aliasing of the page
-        pools, dtype hygiene, baked constants. Trace + lower only;
-        nothing executes and the live cache is untouched. Returns
-        [decode_report, prefill_report]."""
+        """Statically audit the fused decode step (smallest lane bucket)
+        and the (smallest-bucket) prefill executable for perf hazards —
+        donation/aliasing of the page pools, dtype hygiene, baked
+        constants. Trace + lower only; nothing executes and the live
+        cache is untouched. Returns [decode_report, prefill_report]."""
         import jax.numpy as jnp
         from .. import analysis
-        tokens = jnp.zeros((self.max_batch,), jnp.int32)
-        active = jnp.zeros((self.max_batch,), bool)
+        W = self.decode_buckets[0]
+        lane_args = (jnp.zeros((W,), jnp.int32),           # tokens
+                     jnp.full((W,), self.max_batch, jnp.int32),  # slot_map
+                     jnp.zeros((W,), bool),                # lane_active
+                     jnp.zeros((W,), jnp.float32),         # temperature
+                     jnp.zeros((W,), jnp.int32),           # top_k
+                     jnp.ones((W,), jnp.float32),          # top_p
+                     jnp.zeros((W,), jnp.int32),           # seeds
+                     jnp.zeros((W,), jnp.int32))           # steps
         decode = analysis.audit_program(
-            self._decode_fn,
-            (self._params, self._buffers, self.cache, tokens, active),
+            self._fused_step_fn,
+            (self._params, self._buffers, self.cache) + lane_args,
             donate_argnums=(2,),
             name=f"serving_decode:{self.name}", entry="serving_decode",
             emit=emit)
         bucket = self.prefill_buckets[0]
         ids = jnp.zeros((1, bucket), jnp.int32)
+        one = (jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+               jnp.ones((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+               jnp.zeros((1,), jnp.int32))
         prefill = analysis.audit_program(
             self._prefill_fn,
             (self._params, self._buffers, self.cache, ids,
-             np.int32(0), np.int32(1)),
+             np.int32(0), np.int32(1), np.int32(0)) + one,
             donate_argnums=(2,),
             name=f"serving_prefill:{self.name}", entry="serving_prefill",
             emit=emit)
@@ -280,18 +521,20 @@ class ServingEngine:
     def _observe_site(self, site: str, leaves):
         try:
             from ..profiler.watchdog import get_watchdog
-            get_watchdog().observe("to_static", f"serving_{site}:{self.name}",
+            get_watchdog().observe("to_static", f"serving_{site}",
                                    list(leaves))
         except Exception:
             pass
 
     # -- public API -----------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
-               eos_id: Optional[int] = None) -> Request:
+               eos_id: Optional[int] = None,
+               sampling: Optional[SamplingParams] = None) -> Request:
         if self._closed:
             raise RuntimeError("engine is closed")
         req = Request(prompt, max_new_tokens,
-                      self.eos_id if eos_id is None else eos_id)
+                      self.eos_id if eos_id is None else eos_id,
+                      sampling=sampling)
         if not req.prompt:
             raise ValueError("empty prompt")
         if len(req.prompt) + req.max_new_tokens > self.max_len:
@@ -326,10 +569,11 @@ class ServingEngine:
 
     def step(self) -> int:
         """ONE continuous-batching iteration: admit waiting requests into
-        free slots (bucketed prefill each), grow pages for sequences
-        crossing a page boundary (preempting the youngest on pool
-        exhaustion), then one batched decode step. Returns the number of
-        tokens generated (0 = engine idle)."""
+        free slots (bucketed prefill each, shared-prefix pages forked),
+        grow pages for sequences crossing a page boundary and fork any
+        shared page about to be written (copy-on-write), preempting the
+        youngest on pool exhaustion, then one fused decode dispatch.
+        Returns the number of tokens generated (0 = engine idle)."""
         self._admit()
         active_slots = [i for i, r in enumerate(self._slots)
                         if r is not None]
@@ -405,9 +649,22 @@ class ServingEngine:
                 return b
         return self.prefill_buckets[-1]
 
+    def _decode_bucket(self, n: int) -> int:
+        for b in self.decode_buckets:
+            if b >= n:
+                return b
+        return self.decode_buckets[-1]
+
+    def _note_pool_watermark(self):
+        if self.allocator.free_pages < self.stats["min_free_pages"]:
+            self.stats["min_free_pages"] = self.allocator.free_pages
+
     def _admit(self):
         """Per-iteration admission: fill every free slot whose prompt the
-        page pool can cover right now."""
+        page pool can cover right now. A prompt whose prefix is already
+        resident (prefix cache hit) FORKS the matching pages instead of
+        allocating + recomputing them; prefill then skips the K/V
+        scatter below the shared length."""
         import jax.numpy as jnp
         while True:
             with self._lock:
@@ -421,14 +678,25 @@ class ServingEngine:
                 # generated before a preemption (recompute-style resume)
                 tokens = req.prompt + req.generated
                 n_pages = -(-len(tokens) // self.page_size)
-                pages = self.allocator.alloc(n_pages)
-                if pages is None:
+                shared_pages: List[int] = []
+                shared_len = 0
+                if self.share_prefix:
+                    shared_pages, shared_len = self._prefix.lookup(tokens)
+                new_pages = self.allocator.alloc(n_pages - len(shared_pages))
+                if new_pages is None:
                     break  # pool exhausted: wait for frees
+                self.allocator.fork(shared_pages)
+                pages = shared_pages + new_pages
                 self._queue.popleft()
                 slot = free[0]
                 req.slot, req.pages, req.state = slot, pages, "running"
+                req.shared_tokens = shared_len
                 self._slots[slot] = req
                 depth = len(self._queue)
+            if shared_len:
+                self.stats["shared_admissions"] += 1
+                self.stats["prefix_hit_tokens"] += shared_len
+            self._note_pool_watermark()
             bucket = self._bucket_for(len(tokens))
             bt = self.cache.block_tables
             row = np.zeros((self.cache.pages_per_seq,), np.int32)
@@ -436,24 +704,33 @@ class ServingEngine:
             self.cache.block_tables = bt.at[slot].set(jnp.asarray(row))
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :len(tokens)] = tokens
-            self._observe_site("prefill", [ids])
+            self._observe_site(f"prefill:{self.name}", [ids])
             from ..profiler import compile_watch as _cw
             prev = _cw.push_entry("to_static",
                                   f"serving_prefill:{self.name}")
+            sp = req.sampling
             try:
                 nxt, self.cache = self._prefill_jit(
                     self._params, self._buffers, self.cache,
                     jnp.asarray(ids), np.int32(slot),
-                    np.int32(len(tokens)))
+                    np.int32(len(tokens)), np.int32(shared_len),
+                    jnp.full((1,), sp.temperature, jnp.float32),
+                    jnp.full((1,), sp.top_k, jnp.int32),
+                    jnp.full((1,), sp.top_p, jnp.float32),
+                    jnp.full((1,), req.seed, jnp.int32),
+                    jnp.full((1,), len(req.generated), jnp.int32))
             finally:
                 _cw.pop_entry(prev)
             self.stats["prefills"] += 1
+            if self.share_prefix:
+                self._prefix.register(tokens, pages)
             tok = int(np.asarray(nxt)[0])
             now = time.monotonic()
             if req.first_token_ts is None:
                 req.first_token_ts = now
                 if _metrics.enabled() and req.ttft_s is not None:
-                    _M_TTFT.observe(req.ttft_s, model=self.name)
+                    _M_TTFT.observe(req.ttft_s, model=self.name,
+                                    path=self.decode_mode)
             self._emit_admission(req, bucket, len(tokens))
             self._record_token(req, tok)
             if _metrics.enabled():
@@ -462,38 +739,74 @@ class ServingEngine:
                 continue  # single-token request finished at prefill
             self._cur_tokens[slot] = tok
 
+    def _alloc_one_or_preempt(self, req: Request) -> Optional[int]:
+        """One fresh page for `req`, preempting the youngest runner on a
+        dry pool. None => `req` itself was preempted or failed (caller
+        must stop touching it)."""
+        while True:
+            got = self.allocator.alloc(1)
+            if got is not None:
+                self._note_pool_watermark()
+                return got[0]
+            victim = self._youngest_running()
+            running = sum(r is not None for r in self._slots)
+            if victim is None or (victim is req and running == 1):
+                # sole runner with a dry pool: submit-time validation
+                # bounds TOTAL need, so this is an external consumer of
+                # the pool — fail loudly rather than preempt-requeue-wedge
+                self._complete(req, "failed",
+                               error="KV page pool exhausted")
+                return None
+            self._preempt(victim)
+            if victim is req:
+                return None
+
     def _ensure_capacity(self, active_slots: List[int]):
-        """Every active sequence about to write position `ctx` needs the
-        page ctx // page_size allocated; grow by one page where the
-        boundary was crossed, preempting the youngest request when the
-        pool is dry."""
+        """Every active sequence about to write position `ctx` needs
+        (a) the page ctx // page_size allocated — grow by one where the
+        boundary was crossed — and (b) EXCLUSIVE ownership of the page
+        it writes into: a shared (refcount > 1) write page is forked
+        copy-on-write — one donated dispatch copies the page across
+        every layer's pools, the block table repoints, and the other
+        sharers keep the original. Preempts the youngest request when
+        the pool is dry."""
         import jax.numpy as jnp
+        from ..ops.pallas import paged_attention as _pa
         for slot in list(active_slots):
             req = self._slots[slot]
             if req is None:
                 continue
             ctx = len(req.prompt) + len(req.generated)
             need = ctx // self.page_size + 1
+            dead = False
             while len(req.pages) < need:
-                got = self.allocator.alloc(1)
-                if got is None:
-                    victim = self._youngest_running()
-                    running = sum(r is not None for r in self._slots)
-                    if victim is None or (victim is req and running == 1):
-                        # sole runner with a dry pool: submit-time
-                        # validation bounds TOTAL need, so this is an
-                        # external consumer of the pool — fail loudly
-                        # rather than preempt-requeue-wedge
-                        self._complete(req, "failed",
-                                       error="KV page pool exhausted")
-                        break
-                    self._preempt(victim)
-                    if victim is req:
-                        break
-                    continue
-                req.pages.extend(got)
+                page = self._alloc_one_or_preempt(req)
+                if page is None:
+                    dead = True
+                    break
+                req.pages.append(page)
                 self.cache.block_tables = self.cache.block_tables.at[
-                    slot, len(req.pages) - 1].set(jnp.int32(got[0]))
+                    slot, len(req.pages) - 1].set(jnp.int32(page))
+            if dead or self._slots[slot] is not req:
+                continue
+            # copy-on-write: the page receiving this iteration's K/V
+            # write (position ctx-1 = the token sampled last iteration)
+            write_idx = (ctx - 1) // self.page_size
+            if write_idx >= len(req.pages):
+                continue
+            old = req.pages[write_idx]
+            if not self.allocator.is_shared(old):
+                continue
+            fresh = self._alloc_one_or_preempt(req)
+            if fresh is None:
+                continue
+            self.cache.k_pages, self.cache.v_pages = _pa.cow_copy_pages(
+                self.cache.k_pages, self.cache.v_pages, old, fresh)
+            self.cache.block_tables = self.cache.block_tables.at[
+                slot, write_idx].set(jnp.int32(fresh))
+            req.pages[write_idx] = fresh
+            self.allocator.free([old])  # drop this holder's shared ref
+            self.stats["cow_copies"] += 1
 
     def _youngest_running(self) -> Optional[Request]:
         running = [r for r in self._slots if r is not None]
@@ -501,30 +814,69 @@ class ServingEngine:
             return None
         return max(running, key=lambda r: r.submitted_ts)
 
+    def _lane_arrays(self, active_slots: List[int]):
+        """Gather the active slots into W bucketed lanes (W = smallest
+        decode bucket covering the active count). Padding lanes carry
+        the slot sentinel `max_batch` (clamp-gather + drop-scatter in
+        forward_decode) and greedy sampling params (so an all-greedy
+        batch keeps the sampler's argmax fast path)."""
+        n = len(active_slots)
+        W = self._decode_bucket(n)
+        slot_map = np.full((W,), self.max_batch, np.int32)
+        tokens = np.zeros((W,), np.int32)
+        lane_active = np.zeros((W,), bool)
+        temp = np.zeros((W,), np.float32)
+        top_k = np.zeros((W,), np.int32)
+        top_p = np.ones((W,), np.float32)
+        seeds = np.zeros((W,), np.int32)
+        steps = np.zeros((W,), np.int32)
+        for i, slot in enumerate(active_slots[:W]):
+            req = self._slots[slot]
+            sp = req.sampling
+            slot_map[i] = slot
+            tokens[i] = self._cur_tokens[slot]
+            lane_active[i] = True
+            temp[i] = sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+            seeds[i] = req.seed
+            steps[i] = len(req.generated)
+        return (W, tokens, slot_map, lane_active, temp, top_k, top_p,
+                seeds, steps)
+
     def _decode_iteration(self, active_slots: List[int]) -> int:
         import jax.numpy as jnp
         self._maybe_audit_once()
-        active = np.zeros((self.max_batch,), bool)
-        active[active_slots] = True
-        self._observe_site("decode", [self._cur_tokens])
+        (W, tokens, slot_map, lane_active, temp, top_k, top_p, seeds,
+         steps) = self._lane_arrays(active_slots)
+        # per-bucket watchdog site: ONE signature per lane width is the
+        # zero-retrace steady-state contract
+        self._observe_site(f"decode:{self.name}:w{W}", [tokens])
         from ..profiler import compile_watch as _cw
         prev = _cw.push_entry("to_static", f"serving_decode:{self.name}")
         t0 = time.perf_counter()
+        args = (self._params, self._buffers, self.cache,
+                jnp.asarray(tokens), jnp.asarray(slot_map),
+                jnp.asarray(lane_active), jnp.asarray(temp),
+                jnp.asarray(top_k), jnp.asarray(top_p),
+                jnp.asarray(seeds), jnp.asarray(steps))
         try:
-            nxt, self.cache = self._decode_jit(
-                self._params, self._buffers, self.cache,
-                jnp.asarray(self._cur_tokens), jnp.asarray(active))
+            if self.decode_mode == "fused":
+                nxt, self.cache = self._fused_jit(*args)
+            else:
+                # eager A/B baseline: identical math, per-op dispatch
+                nxt, self.cache = self._fused_step_fn(*args)
         finally:
             _cw.pop_entry(prev)
         nxt_np = np.asarray(nxt)  # device sync: the iteration boundary
         self.stats["decode_wall_s"] += time.perf_counter() - t0
         self.stats["iterations"] += 1
         produced = 0
-        for slot in active_slots:
+        for i, slot in enumerate(active_slots[:W]):
             req = self._slots[slot]
             if req is None:
                 continue
-            tok = int(nxt_np[slot])
+            tok = int(nxt_np[i])
             self._record_token(req, tok)
             produced += 1
             if req.state == "running":
@@ -558,13 +910,16 @@ class ServingEngine:
         if reason != "failed":
             self.stats["completed"] += 1
             if _metrics.enabled() and req.tpot_s is not None:
-                _M_TPOT.observe(req.tpot_s, model=self.name)
+                _M_TPOT.observe(req.tpot_s, model=self.name,
+                                path=self.decode_mode)
         self._emit_eviction(req, reason)
         req._done.set()
 
     def _preempt(self, req: Request):
-        """Recompute-style preemption: pages freed, request requeued with
-        its generated prefix as part of the next admission's prompt."""
+        """Recompute-style preemption: pages freed (shared pages only
+        DECREF — a page another request still references never returns
+        to the pool), request requeued with its generated prefix as part
+        of the next admission's prompt."""
         self._release_slot(req)
         req.state = "queued"
         req.slot = None
@@ -598,6 +953,7 @@ class ServingEngine:
             slot=req.slot, prompt_len=prompt_len, bucket=bucket,
             queue_wait_s=round(time.monotonic() - req.submitted_ts, 4),
             preemptions=req.preemptions,
+            shared_tokens=req.shared_tokens,
             free_pages=self.allocator.free_pages)
 
     def _emit_eviction(self, req: Request, reason: str):
@@ -621,5 +977,9 @@ class ServingEngine:
                 "queue_depth": len(self._queue),
                 "occupancy": sum(r is not None for r in self._slots),
                 "prefill_buckets": list(self.prefill_buckets),
+                "decode_buckets": list(self.decode_buckets),
+                "decode_mode": self.decode_mode,
+                "share_prefix": self.share_prefix,
+                "prefix_entries": len(self._prefix),
                 "stats": dict(self.stats),
             }

@@ -274,14 +274,19 @@ class GPT(nn.Layer):
         return qkv[:, :, 0].data, qkv[:, :, 1].data, qkv[:, :, 2].data
 
     def forward_prefill(self, input_ids, cache: PagedKVCache, slot,
-                        length):
+                        length, write_start=0):
         """Prefill ONE sequence: run the prompt through the normal (flash)
         causal attention while scattering every position's K/V into the
         pages of batch slot `slot`. `input_ids` is [1, L_bucket] (L may be
         padded up to a shape bucket — the retrace watchdog stays quiet
         because serving always pads to a bucket); `length` is the real
-        prompt length (traced ok). Returns (last-position logits [1, V],
-        updated cache)."""
+        prompt length (traced ok). `write_start` masks the K/V scatter
+        below that position: a request admitted with a SHARED prefix
+        (serving's copy-on-write page fork) already has positions
+        [0, write_start) in pages forked from another request, and must
+        not re-write them — attention still runs over the full prompt
+        (the logits need the whole context; only the scatter is masked).
+        Returns (last-position logits [1, V], updated cache)."""
         import jax
         import jax.numpy as jnp
         from ..ops.pallas import paged_attention as _pa
@@ -294,6 +299,7 @@ class GPT(nn.Layer):
             x = self.wte(input_ids) + self.wpe(pos)
         slot = jnp.asarray(slot, jnp.int32)
         length = jnp.asarray(length, jnp.int32)
+        write_start = jnp.asarray(write_start, jnp.int32)
         page_row = jnp.take(cache.block_tables, slot, axis=0)
         for li, blk in enumerate(self.blocks):
             with jax.named_scope("ln"):
@@ -302,7 +308,7 @@ class GPT(nn.Layer):
                 q, k, v = self._block_qkv(blk, h)
                 cache.k_pages[li], cache.v_pages[li] = _pa.prefill_append(
                     cache.k_pages[li], cache.v_pages[li], k[0], v[0],
-                    page_row, length)
+                    page_row, length, start=write_start)
                 out = F.scaled_dot_product_attention(
                     Tensor(q), Tensor(k), Tensor(v), is_causal=True,
                     training=False)
@@ -320,19 +326,41 @@ class GPT(nn.Layer):
             logits = self.pipeline_post(last)
         return logits, cache
 
-    def forward_decode(self, tokens, cache: PagedKVCache, active=None):
-        """ONE incremental decode step for the whole cache batch: append
-        each sequence's new token K/V to its pages, attend over the paged
-        context. `tokens` is [B] int (the token sitting at position
-        context_lens[b]); `active` [B] bool masks idle serving slots
-        (their writes land on the null page, their logits are garbage
-        nobody reads). Returns (logits [B, V], updated cache)."""
+    def forward_decode(self, tokens, cache: PagedKVCache, active=None,
+                       slot_map=None):
+        """ONE incremental decode step: append each sequence's new token
+        K/V to its pages, attend over the paged context. `tokens` is [B]
+        int (the token sitting at position context_lens[b]); `active`
+        [B] bool masks idle serving slots (their writes land on the null
+        page, their logits are garbage nobody reads). Returns
+        (logits [B, V], updated cache).
+
+        `slot_map` [W] int32 switches to LANE mode (the serving engine's
+        width-bucketed fused step): lane i computes the decode step for
+        cache slot slot_map[i], so a batch with few active sequences
+        runs a W << max_batch executable instead of the full-width one.
+        Padding lanes carry slot_map[i] >= max_batch (the gather clamps,
+        active[i] is False, and the context-length scatter-back drops
+        them); `tokens`/`active` are then [W]-shaped per lane."""
         import jax
         import jax.numpy as jnp
         from ..ops.pallas import paged_attention as _pa
-        if active is None:
-            active = jnp.ones((cache.max_batch,), bool)
-        ctx = cache.context_lens
+        lanes = slot_map is not None
+        if lanes:
+            slot_map = jnp.asarray(slot_map, jnp.int32)
+            # clamp-gather: padding lanes read SOME real slot's row, but
+            # their active mask parks writes on the null page and zeroes
+            # their attention context
+            bt = jnp.take(cache.block_tables, slot_map, axis=0,
+                          mode="clip")
+            ctx = jnp.take(cache.context_lens, slot_map, mode="clip")
+            if active is None:
+                active = slot_map < cache.max_batch
+        else:
+            bt = cache.block_tables
+            ctx = cache.context_lens
+            if active is None:
+                active = jnp.ones((cache.max_batch,), bool)
         with jax.named_scope("embed"):
             # position of the incoming token = current context length
             pos = Tensor(jnp.minimum(
@@ -347,10 +375,9 @@ class GPT(nn.Layer):
                 q, k, v = self._block_qkv(blk, h)      # [B, 1, H, D]
                 cache.k_pages[li], cache.v_pages[li] = _pa.cache_append(
                     cache.k_pages[li], cache.v_pages[li], k[:, 0], v[:, 0],
-                    cache.block_tables, ctx, active)
+                    bt, ctx, active)
                 out = _pa.paged_attention(
-                    q[:, 0], cache.k_pages[li], cache.v_pages[li],
-                    cache.block_tables,
+                    q[:, 0], cache.k_pages[li], cache.v_pages[li], bt,
                     # the new token is part of its own context
                     jnp.where(active, ctx + 1, 0))
                 out = reshape(Tensor(out), [B, 1, self.cfg.hidden_size])
@@ -358,7 +385,14 @@ class GPT(nn.Layer):
             with jax.named_scope("ln"):
                 h = blk.ln2(x)
             x = x + blk.mlp(h)
-        cache.context_lens = jnp.where(active, ctx + 1, ctx)
+        if lanes:
+            # scatter-back: +1 for each active lane's slot; padding-lane
+            # sentinels (>= max_batch) drop instead of clamping onto a
+            # real slot's counter
+            cache.context_lens = cache.context_lens.at[slot_map].add(
+                jnp.where(active, 1, 0).astype(jnp.int32), mode="drop")
+        else:
+            cache.context_lens = jnp.where(active, ctx + 1, ctx)
         with jax.named_scope("logits"):
             logits = self.pipeline_post(reshape(x, [B, self.cfg.hidden_size]))
         return logits, cache

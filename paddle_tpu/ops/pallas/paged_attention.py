@@ -60,7 +60,7 @@ _NEG = -1e30
 _CARRY_LANES = 128  # m/l scratch lane width (f32 native lane tile)
 
 # dispatch decisions, counted at trace time (reset freely in tests)
-_stats = {"pallas": 0, "xla": 0, "append": 0}
+_stats = {"pallas": 0, "xla": 0, "append": 0, "cow": 0}
 
 # tests set True: the kernel runs in the Pallas interpreter on CPU, so
 # the real gather/online-softmax logic is exercised without a TPU
@@ -392,18 +392,51 @@ def cache_append(k_pages, v_pages, k_new, v_new, block_tables,
                        context_lens, active)
 
 
-def prefill_append(k_pages, v_pages, k_seq, v_seq, page_ids, length):
+def prefill_append(k_pages, v_pages, k_seq, v_seq, page_ids, length,
+                   start=0):
     """Scatter a whole prompt's K/V [L, H, D] into the pages of ONE
     sequence: position i lands in page_ids[i // page_size] at offset
     i % page_size. Positions at/past `length` (bucket padding) go to the
-    null page 0. `page_ids` is the sequence's block-table row [n_pages].
-    Traceable (used inside the jitted prefill step)."""
+    null page 0, and so do positions below `start` — the copy-on-write
+    shared-prefix path prefills a request whose first `start` tokens'
+    K/V already live in pages FORKED from another request; writing them
+    again would clobber the shared (refcount > 1) pages. `page_ids` is
+    the sequence's block-table row [n_pages]. Traceable (used inside
+    the jitted prefill step)."""
     page_size = k_pages.shape[1]
     L = k_seq.shape[0]
     pos = jnp.arange(L, dtype=jnp.int32)
-    live = pos < length
+    live = (pos >= start) & (pos < length)
     pages = jnp.where(live, page_ids[pos // page_size], 0)
     offs = jnp.where(live, pos % page_size, 0)
     k_pages = k_pages.at[pages, offs].set(k_seq.astype(k_pages.dtype))
     v_pages = v_pages.at[pages, offs].set(v_seq.astype(v_pages.dtype))
     return k_pages, v_pages
+
+
+# --------------------------- copy-on-write fork -------------------------------
+
+
+def _cow_copy_impl(k_pages, v_pages, src, dst):
+    """Duplicate pool page `src` into `dst` across every layer's K and V
+    pools (k_pages/v_pages are the per-layer lists)."""
+    k_pages = [kp.at[dst].set(kp[src]) for kp in k_pages]
+    v_pages = [vp.at[dst].set(vp[src]) for vp in v_pages]
+    return k_pages, v_pages
+
+
+_cow_jit = jax.jit(_cow_copy_impl, donate_argnums=(0, 1))
+
+
+def cow_copy_pages(k_pages, v_pages, src, dst):
+    """Copy-on-write fork of ONE pool page: page `src` (shared,
+    refcount > 1) is duplicated into the freshly-allocated page `dst`
+    so the writer can diverge without clobbering the other sharers.
+
+    `k_pages`/`v_pages` are the per-layer pool lists; one donated jitted
+    dispatch copies the page across all layers in place (the pool is
+    never materialized twice). Callers must drop their references to
+    the passed-in pools — the returned lists replace them."""
+    _stats["cow"] += 1
+    return _cow_jit(list(k_pages), list(v_pages),
+                    np.int32(src), np.int32(dst))

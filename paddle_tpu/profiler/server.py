@@ -126,6 +126,17 @@ class ObservabilityServer:
         self.port: Optional[int] = None
 
     # -- endpoint payloads ---------------------------------------------------
+    @staticmethod
+    def _audit_reports() -> list:
+        # lazy: analysis imports profiler.metrics/events; importing it at
+        # module scope here would be a cycle. A snapshot must also never
+        # fail because the analysis package (optional at runtime) does.
+        try:
+            from ..analysis import recent_reports
+            return recent_reports()
+        except Exception:
+            return []
+
     def _collect_fleet(self):
         if self.aggregator is None:
             return
@@ -139,6 +150,10 @@ class ObservabilityServer:
         return self.registry.to_prometheus_text()
 
     def snapshot(self) -> dict:
+        """One JSON blob for dashboards: metrics + watchdog + compile
+        attribution + liveness + health + the events tail + the newest
+        static program-audit reports (e.g. the serving engine's fused
+        decode executable) + optional fleet view."""
         self._collect_fleet()
         # refresh the device-memory gauges so the snapshot's watermark is
         # scrape-time, not last-step-record time
@@ -150,6 +165,7 @@ class ObservabilityServer:
             "liveness": liveness(self.stall_after),
             "health": _health_mod.snapshot(),
             "events_tail": _events_mod.recent(50),
+            "program_audit": self._audit_reports(),
             "ts": time.time(),
         }
         if self.aggregator is not None:

@@ -273,6 +273,7 @@ class TestResNetIntegration:
         np.testing.assert_allclose(b_f(x).numpy(), b_u(x).numpy(),
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow  # full resnet18 double-trace; block-level tests stay fast
     def test_resnet18_fused_vs_unfused(self):
         from paddle_tpu.models.resnet import resnet18
         rng = np.random.default_rng(1)

@@ -279,13 +279,16 @@ class TestMetricNamingLint:
         _ctl._M_ROLLBACKS.inc(host="trainer-1")
         _ctl._M_READMISSIONS.inc(host="trainer-1")
         _ctl._M_FIRST_STEP.set(1.5, policy="straggler_evict")
-        # continuous-batching serving families (model=) + the paged-KV
-        # decode kernel's autotune op riding the existing families
+        # continuous-batching serving families (model=, latency split by
+        # decode path=) + the paged-KV decode kernel's autotune op riding
+        # the existing families
         from paddle_tpu.inference import serving as _srv
         _srv._M_QUEUE.set(2, model="gpt")
         _srv._M_OCC.set(1, model="gpt")
-        _srv._M_TTFT.observe(0.05, model="gpt")
-        _srv._M_TPOT.observe(0.01, model="gpt")
+        _srv._M_TTFT.observe(0.05, model="gpt", path="fused")
+        _srv._M_TPOT.observe(0.01, model="gpt", path="fused")
+        _srv._M_TTFT.observe(0.07, model="gpt", path="eager")
+        _srv._M_TPOT.observe(0.02, model="gpt", path="eager")
         _srv._M_GOODPUT.inc(8, model="gpt")
         _at._M_EVENTS.inc(event="hit", op="paged_attn")
         _at._M_TUNES.inc(op="paged_attn")

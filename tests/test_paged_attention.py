@@ -280,6 +280,7 @@ class TestGPTDecodeParity:
         m.eval()
         return m, cfg
 
+    @pytest.mark.slow  # dense-vs-paged walk; the interpret sibling stays fast
     def test_greedy_tokens_match_dense(self):
         m, cfg = self._model()
         rng = np.random.default_rng(0)

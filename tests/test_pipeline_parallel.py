@@ -187,6 +187,7 @@ class TestPipelineLayerAPI:
 
 
 class TestPipeline1F1BMemory:
+    @pytest.mark.slow  # M=8*S compiled-memory probe; e2e siblings stay fast
     def test_peak_memory_bounded_by_boundary_activations(self):
         """M=8*S micro-batches: compiled temp memory may grow only by the
         per-tick boundary-activation residuals (~linear, small constant) —

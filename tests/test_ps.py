@@ -218,6 +218,7 @@ fleet.stop_worker()
 
 
 class TestPSCluster:
+    @pytest.mark.slow  # 3-process e2e; in-process PS tests keep coverage
     def test_localhost_cluster_1server_2trainers(self, tmp_path):
         """Subprocess cluster: 1 pserver + 2 trainers on localhost."""
         script = tmp_path / "ps_train.py"

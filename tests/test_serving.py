@@ -29,6 +29,24 @@ def _clean_events():
     events.default_event_log().clear()
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _shared_compile_cache():
+    """Every test here rebuilds the same tiny-model engine, and each
+    rebuild re-compiles identical fused-step/prefill executables; on the
+    1-core tier-1 box that XLA backend time dominates the module.  Point
+    jax's persistent compilation cache at a shared dir so only the first
+    construction pays it (tests in this module assert on TRACE counts and
+    audits, never on backend-compile counters, so cache hits are inert)."""
+    import os
+    import tempfile
+    from paddle_tpu.framework import flags as flags_mod
+    cache = os.path.join(tempfile.gettempdir(), "pt_serving_ccache")
+    os.makedirs(cache, exist_ok=True)
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": cache})
+    yield
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
+
+
 def _model(vocab=512):
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=vocab, max_position_embeddings=128,
@@ -83,6 +101,8 @@ class TestEngineBasics:
         assert st["free_pages"] == eng.cache.num_pages - 1
         assert st["occupancy"] == 0 and st["queue_depth"] == 0
 
+    @pytest.mark.slow  # fused-vs-generate_paged parity stays fast in
+    # test_serving_v2.py::test_temperature_zero_matches_reference_greedy
     def test_matches_reference_paged_decode(self):
         """The engine's continuous-batching output for one request is
         exactly the model's reference greedy paged decode."""
@@ -181,7 +201,8 @@ class TestBucketedPrefill:
     def test_prefill_signatures_bounded_by_buckets(self):
         """Many distinct prompt lengths must compile at most
         len(prefill_buckets) prefill signatures (the retrace-watchdog
-        quietness contract) and exactly ONE decode signature."""
+        quietness contract) and exactly ONE decode signature per
+        active-lane bucket site."""
         from paddle_tpu.profiler.watchdog import get_watchdog
         m, cfg = _model()
         eng = ServingEngine(m, max_batch=2, max_len=64, page_size=8,
@@ -192,10 +213,16 @@ class TestBucketedPrefill:
         wd = get_watchdog()
         sigs = wd._seen
         pre = sigs.get(("to_static", "serving_prefill:bk"), set())
-        dec = sigs.get(("to_static", "serving_decode:bk"), set())
         assert 1 <= len(pre) <= 2, pre
-        assert len(dec) == 1, dec
+        dec_sites = {site: seen for (kind, site), seen in sigs.items()
+                     if kind == "to_static"
+                     and site.startswith("serving_decode:bk:w")}
+        assert dec_sites, "no decode lane-bucket sites observed"
+        assert len(dec_sites) <= len(eng.decode_buckets), dec_sites
+        for site, seen in dec_sites.items():
+            assert len(seen) == 1, (site, seen)
 
+    @pytest.mark.slow  # two engines per run; signature-count sibling stays fast
     def test_bucket_padding_does_not_change_tokens(self):
         """A prompt served through a larger bucket yields the same
         generation as through a tight one."""
@@ -212,6 +239,7 @@ class TestBucketedPrefill:
 
 
 class TestPreemption:
+    @pytest.mark.slow  # drain/close preemption siblings below stay fast
     def test_pool_exhaustion_preempts_youngest_and_recovers(self):
         """A page pool too small for the whole batch: the youngest
         running request is preempted (pages freed, requeued with its

@@ -1268,6 +1268,75 @@ class TestServingGate:
         cfg = self._decode_cfg(paged_vs_dense={"error": "XlaError: boom"})
         assert gate.validate_observability(self._doc(cfg=cfg)) == []
 
+    @staticmethod
+    def _v2_blocks():
+        return {
+            "fused_vs_eager": {"fused_ms_per_token": 9.0,
+                               "eager_ms_per_token": 21.0,
+                               "speedup": 2.33, "identical_tokens": True},
+            "shared_prefix": {
+                "on": {"min_free_pages": 60, "prefix_hit_tokens": 180,
+                       "shared_admissions": 6, "cow_copies": 6,
+                       "preemptions": 0, "completed": 8,
+                       "leaked_pages": 0},
+                "off": {"min_free_pages": 51, "prefix_hit_tokens": 0,
+                        "shared_admissions": 0, "cow_copies": 0,
+                        "preemptions": 0, "completed": 8,
+                        "leaked_pages": 0},
+            },
+        }
+
+    def test_valid_v2_ab_blocks_pass(self):
+        cfg = self._decode_cfg(**self._v2_blocks())
+        assert gate.validate_observability(self._doc(cfg=cfg)) == []
+
+    def test_fused_eager_token_drift_fails_the_gate(self):
+        """fused and eager decode disagreeing on tokens is a correctness
+        bug the schema gate must catch, not a perf footnote."""
+        blocks = self._v2_blocks()
+        blocks["fused_vs_eager"]["identical_tokens"] = False
+        blob = "\n".join(gate.validate_observability(
+            self._doc(cfg=self._decode_cfg(**blocks))))
+        assert "identical_tokens" in blob and "disagreed" in blob
+
+    def test_shared_prefix_leak_and_phantom_hits_named(self):
+        blocks = self._v2_blocks()
+        blocks["shared_prefix"]["on"]["leaked_pages"] = 2
+        blocks["shared_prefix"]["off"]["prefix_hit_tokens"] = 9
+        blocks["shared_prefix"]["on"]["cow_copies"] = -1
+        blob = "\n".join(gate.validate_observability(
+            self._doc(cfg=self._decode_cfg(**blocks))))
+        assert "on.leaked_pages" in blob
+        assert "off.prefix_hit_tokens" in blob and "disabled" in blob
+        assert "on.cow_copies" in blob
+
+    def test_v2_error_blocks_report_themselves(self):
+        cfg = self._decode_cfg(
+            fused_vs_eager={"error": "XlaError: boom"},
+            shared_prefix={"error": "RuntimeError: pool"})
+        assert gate.validate_observability(self._doc(cfg=cfg)) == []
+
+    def test_path_label_value_enum_enforced(self):
+        metrics = {
+            "serving_ttft_seconds": {"kind": "histogram", "values": [
+                {"labels": {"model": "m", "path": "warp"},
+                 "buckets": {"+Inf": 1}, "sum": 0.1, "count": 1}]},
+        }
+        blob = "\n".join(gate.validate_observability(
+            self._doc(metrics=metrics)))
+        assert "path label" in blob and "warp" in blob
+
+    def test_path_label_optional_for_back_compat(self):
+        """Pre-v2 artifacts (BENCH_r07 and earlier) carry no path label
+        on the latency histograms — they must keep validating."""
+        metrics = {
+            "serving_tpot_seconds": {"kind": "histogram", "values": [
+                {"labels": {"model": "m"},
+                 "buckets": {"+Inf": 2}, "sum": 0.1, "count": 2}]},
+        }
+        assert gate.validate_observability(
+            self._doc(metrics=metrics)) == []
+
     def test_valid_serving_metrics_pass(self):
         metrics = {
             "serving_queue_depth": {"kind": "gauge", "values": [
@@ -1358,6 +1427,42 @@ class TestMetricsDumpServingHistograms:
         fam = snap["serving_tpot_seconds"]
         assert fam["kind"] == "histogram"
         assert fam["values"][0]["count"] == 1
+
+    def test_serving_summary_view_splits_by_path(self, capsys, tmp_path):
+        """--serving: the SLO summary breaks TTFT/TPOT out per decode
+        path (fused vs eager) with quantiles."""
+        import metrics_dump
+        from paddle_tpu.profiler import metrics as metrics_mod
+        reg = metrics_mod.MetricsRegistry()
+        ttft = reg.histogram("serving_ttft_seconds",
+                             "ttft by model and path")
+        tpot = reg.histogram("serving_tpot_seconds",
+                             "tpot by model and path")
+        for v in (0.02, 0.05, 0.4):
+            ttft.observe(v, model="gpt", path="fused")
+            tpot.observe(v / 10, model="gpt", path="fused")
+        ttft.observe(0.9, model="gpt", path="eager")
+        reg.gauge("serving_batch_occupancy", "occ by model").set(
+            4, model="gpt")
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(reg.snapshot()))
+        rc = metrics_dump.main([str(path), "--serving"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "path=fused" in out and "path=eager" in out
+        assert "ttft" in out and "tpot" in out
+        assert "p50=" in out and "p99=" in out
+        assert "serving_batch_occupancy" in out
+
+    def test_serving_summary_view_on_published_bench(self, capsys):
+        """--serving degrades gracefully on a pre-v2 artifact (no path
+        label) and still summarizes the families."""
+        import metrics_dump
+        path = os.path.join(REPO, "BENCH_r07.json")
+        rc = metrics_dump.main([path, "--serving"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ttft" in out and "serving summary" in out
 
 
 class TestObsTailServing:

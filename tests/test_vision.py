@@ -62,10 +62,11 @@ class TestTransforms:
 
 class TestModels:
     @pytest.mark.parametrize("factory,ch", [
-        (lambda: models.vgg11(num_classes=10), 10),
+        pytest.param(lambda: models.vgg11(num_classes=10), 10,
+                     marks=pytest.mark.slow),
         (lambda: models.mobilenet_v1(scale=0.25, num_classes=10), 10),
-        # the three slowest-to-trace families keep default coverage via
-        # the v1/vgg/alexnet rows; run them with --slow
+        # the slowest-to-trace families keep default coverage via
+        # the v1/alexnet rows; run them with --slow
         pytest.param(lambda: models.mobilenet_v2(scale=0.25, num_classes=10),
                      10, marks=pytest.mark.slow),
         (lambda: models.alexnet(num_classes=10), 10),

@@ -440,7 +440,10 @@ def _validate_autotune_metrics(where: str, metrics: dict) -> List[str]:
 
 
 # continuous-batching serving metric families: name -> (kind, required
-# labels). All values non-negative.
+# labels). All values non-negative. The latency histograms additionally
+# carry a `path` label since serving v2 (fused|eager decode) — optional
+# here so pre-v2 bench artifacts stay valid, but when present the value
+# must be one of _SERVING_PATHS.
 _SERVING_FAMILIES = {
     "serving_queue_depth": ("gauge", ("model",)),
     "serving_batch_occupancy": ("gauge", ("model",)),
@@ -448,6 +451,9 @@ _SERVING_FAMILIES = {
     "serving_tpot_seconds": ("histogram", ("model",)),
     "serving_goodput_tokens_total": ("counter", ("model",)),
 }
+
+#: legal decode-path label values on the serving latency histograms
+_SERVING_PATHS = ("fused", "eager")
 
 
 def _validate_serving_metrics(where: str, metrics: dict) -> List[str]:
@@ -499,6 +505,10 @@ def _validate_serving_metrics(where: str, metrics: dict) -> List[str]:
                 if lk not in labels:
                     problems.append(f"{where}.metrics.{name}[{i}]: series "
                                     f"missing the {lk!r} label")
+            path = labels.get("path")
+            if path is not None and path not in _SERVING_PATHS:
+                problems.append(f"{where}.metrics.{name}[{i}]: path label "
+                                f"{path!r} is not one of {_SERVING_PATHS}")
     return problems
 
 
@@ -579,6 +589,59 @@ def _validate_decode_block(where: str, cfg: dict) -> List[str]:
                 if v is not None and not _nonneg_num(v):
                     problems.append(f"{where}.paged_vs_dense.{key} {v!r} "
                                     f"is not a non-negative number or null")
+    fve = cfg.get("fused_vs_eager")
+    if fve is not None:
+        if not isinstance(fve, dict):
+            problems.append(f"{where}.fused_vs_eager is not an object")
+        elif "error" not in fve:  # a failed probe reports itself
+            for key in ("fused_ms_per_token", "eager_ms_per_token"):
+                if not _nonneg_num(fve.get(key)):
+                    problems.append(f"{where}.fused_vs_eager.{key} "
+                                    f"{fve.get(key)!r} is not a "
+                                    f"non-negative number")
+            sp = fve.get("speedup")
+            if sp is not None and not _nonneg_num(sp):
+                problems.append(f"{where}.fused_vs_eager.speedup {sp!r} "
+                                f"is not a non-negative number or null")
+            # the bit-parity claim: both decode paths MUST emit the same
+            # tokens — a fused path that drifts is a correctness bug the
+            # gate treats like a regression
+            if fve.get("identical_tokens") is not True:
+                problems.append(f"{where}.fused_vs_eager.identical_tokens "
+                                f"{fve.get('identical_tokens')!r}: fused "
+                                f"and eager decode disagreed on tokens")
+    shp = cfg.get("shared_prefix")
+    if shp is not None:
+        if not isinstance(shp, dict):
+            problems.append(f"{where}.shared_prefix is not an object")
+        elif "error" not in shp:
+            for side in ("on", "off"):
+                blk = shp.get(side)
+                if not isinstance(blk, dict):
+                    problems.append(f"{where}.shared_prefix.{side} is not "
+                                    f"an object")
+                    continue
+                for key in ("min_free_pages", "prefix_hit_tokens",
+                            "shared_admissions", "cow_copies",
+                            "preemptions", "completed", "leaked_pages"):
+                    v = blk.get(key)
+                    if not isinstance(v, int) or isinstance(v, bool) \
+                            or v < 0:
+                        problems.append(
+                            f"{where}.shared_prefix.{side}.{key} {v!r} is "
+                            f"not a non-negative integer")
+                # a leaked page means a refcount failed to return to zero
+                if blk.get("leaked_pages") not in (None, 0):
+                    problems.append(
+                        f"{where}.shared_prefix.{side}.leaked_pages "
+                        f"{blk.get('leaked_pages')!r}: allocator held "
+                        f"pages after all requests finished")
+            off = shp.get("off")
+            if isinstance(off, dict) and off.get("prefix_hit_tokens"):
+                problems.append(
+                    f"{where}.shared_prefix.off.prefix_hit_tokens "
+                    f"{off.get('prefix_hit_tokens')!r}: sharing disabled "
+                    f"but prefix hits were recorded")
     return problems
 
 

@@ -15,6 +15,7 @@ CLI:
     python tools/metrics_dump.py snapshot.json --filter collective
     python tools/metrics_dump.py --url http://host:9400/metrics
     python tools/metrics_dump.py --url http://host:9400/snapshot --filter heter
+    python tools/metrics_dump.py BENCH_r16.json --serving
     python bench.py | python tools/metrics_dump.py -
 
 Exit code 0 on success, 2 on unusable input.
@@ -233,6 +234,51 @@ def format_snapshot(snap: dict, name_filter: str = "") -> str:
     return "\n".join(lines) if lines else "(empty snapshot)"
 
 
+def format_serving(snap: dict) -> str:
+    """Serving-focused summary: queue/occupancy/goodput gauges plus the
+    TTFT/TPOT latency histograms broken out per decode path (fused vs
+    eager) with p50/p95/p99 — the at-a-glance SLO view of a serving
+    deployment. Families absent from the snapshot are skipped."""
+    lines = ["serving summary"]
+    for name in ("serving_queue_depth", "serving_batch_occupancy",
+                 "serving_goodput_tokens_total"):
+        fam = snap.get(name)
+        if not fam:
+            continue
+        for v in sorted(fam.get("values", []),
+                        key=lambda d: _fmt_labels(d.get("labels", {}))):
+            labels = _fmt_labels(v.get("labels", {}))
+            lines.append(f"    {name:<32} {labels:<24} "
+                         f"{_fmt_value(v.get('value', 0))}")
+    for name, title in (("serving_ttft_seconds", "ttft"),
+                        ("serving_tpot_seconds", "tpot")):
+        fam = snap.get(name)
+        if not fam:
+            continue
+        for v in sorted(fam.get("values", []),
+                        key=lambda d: _fmt_labels(d.get("labels", {}))):
+            labels = v.get("labels", {})
+            path = labels.get("path", "?")
+            model = labels.get("model", "?")
+            cnt = v.get("count", 0)
+            buckets = v.get("buckets") or {}
+            line = (f"    {title} model={model} path={path:<6} "
+                    f"count={cnt:,}")
+            if cnt:
+                avg = v.get("sum", 0.0) / cnt
+                line += f" avg={avg:.6g}s"
+                if buckets:
+                    line += "".join(
+                        f" p{int(q * 100)}={est:.4g}s"
+                        for q, est in ((q, hist_quantile(buckets, q))
+                                       for q in (0.5, 0.95, 0.99))
+                        if est is not None)
+            lines.append(line)
+    if len(lines) == 1:
+        return "serving summary: no serving_* families in snapshot"
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", default=None,
@@ -247,6 +293,9 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="re-emit the extracted snapshot as JSON instead of "
                          "the human table")
+    ap.add_argument("--serving", action="store_true",
+                    help="serving SLO summary: queue/occupancy/goodput plus "
+                         "TTFT/TPOT quantiles per decode path (fused|eager)")
     args = ap.parse_args(argv)
     url = args.url
     if url is None and args.path and args.path.startswith(("http://",
@@ -265,6 +314,8 @@ def main(argv=None) -> int:
             return 2
         if args.json:
             print(json.dumps(snap, indent=2, sort_keys=True))
+        elif args.serving:
+            print(format_serving(snap))
         else:
             print(format_snapshot(snap, args.filter))
         return 0
@@ -290,6 +341,8 @@ def main(argv=None) -> int:
         return 2
     if args.json:
         print(json.dumps(snap, indent=2, sort_keys=True))
+    elif args.serving:
+        print(format_serving(snap))
     else:
         print(format_snapshot(snap, args.filter))
     return 0

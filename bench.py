@@ -845,6 +845,8 @@ def bench_gpt2_decode():
     wall = time.perf_counter() - t0
     ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
     tpots = [r.tpot_s for r in reqs if r.tpot_s is not None]
+    qwaits = [r.admitted_ts - r.submitted_ts for r in reqs
+              if r.admitted_ts is not None]
     goodput = sum(len(r.generated) for r in reqs)
     st = eng.status()["stats"]
 
@@ -858,9 +860,20 @@ def bench_gpt2_decode():
         from paddle_tpu.profiler import metrics as _metrics
         snap = _metrics.default_registry().snapshot()
         obs["metrics"] = {k: v for k, v in snap.items()
-                          if k.startswith("serving_")}
+                          if k.startswith(("serving_", "slo_"))}
     except Exception as e:
         obs["metrics_error"] = f"{type(e).__name__}: {e}"
+    # request-scoped trace + SLO-window blocks (profiler/reqtrace.py /
+    # profiler/slo.py — the /requests and /slo endpoint payloads), so a
+    # BENCH round carries per-phase latency attribution
+    try:
+        obs["reqtrace"] = eng.requests_snapshot(n=min(streams, 50))
+    except Exception as e:
+        obs["reqtrace"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        obs["slo"] = eng.slo.snapshot()
+    except Exception as e:
+        obs["slo"] = {"error": f"{type(e).__name__}: {e}"}
     ab = {}
     try:
         ab = _paged_vs_dense_ab(model, ab_ctxs, page_size,
@@ -904,6 +917,8 @@ def bench_gpt2_decode():
         "serving": {
             "ttft_s": {"p50": _pct(ttfts, 50), "p99": _pct(ttfts, 99)},
             "tpot_s": {"p50": _pct(tpots, 50), "p99": _pct(tpots, 99)},
+            "queue_wait_s": {"p50": _pct(qwaits, 50),
+                             "p99": _pct(qwaits, 99)},
             "wall_s": round(wall, 2),
             "prefill_buckets": eng.status()["prefill_buckets"],
             "note": ("TTFT includes queue wait + bucketed prefill (and, "

@@ -201,6 +201,11 @@ class FleetReporter:
             # health plane saw a step) — the rank-0 aggregator uses it to
             # name the first host whose numerics went bad
             "health_status": self._health_status(),
+            # serving-SLO status ('ok' / 'breach:<signals>'; null until a
+            # serving engine runs here) — the same transition-shaped
+            # signal as health_status, so controller policies can consume
+            # serving health exactly like trainer health
+            "serving_slo": self._serving_slo_status(),
             "barrier_wait_s": round(_hist_sum("ckpt_barrier_wait_seconds"), 6),
             "heter": {
                 "route_s": round(_hist_sum("heter_route_seconds"), 6),
@@ -218,6 +223,14 @@ class FleetReporter:
     def _health_status():
         try:
             from ...profiler.health import last_status
+            return last_status()
+        except Exception:
+            return None
+
+    @staticmethod
+    def _serving_slo_status():
+        try:
+            from ...profiler.slo import last_status
             return last_status()
         except Exception:
             return None

@@ -103,6 +103,8 @@ KNOWN_SITES = {
     "heter.pull": "heter-PS sparse pull stage",
     "heter.push": "heter-PS sparse push stage",
     "fleet.step": "per-step fleet telemetry hook (straggler chaos)",
+    "serving.decode": "per-iteration serving decode dispatch "
+                      "(latency chaos for SLO breach drills)",
 }
 
 #: dynamic site families: call sites build the name from a prefix +

@@ -52,18 +52,42 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..fault import site as _fault_site
 from ..framework import tape as tape_mod
 from ..framework.tensor import Tensor
 from ..profiler import events as _events
 from ..profiler import metrics as _metrics
+from ..profiler import reqtrace as _reqtrace
+from ..profiler import slo as _slo
+from ..utils.envparse import env_float, env_int
 from .sampling import SamplingParams, sample_logits
 
-__all__ = ["Request", "PageAllocator", "SamplingParams", "ServingEngine"]
+__all__ = ["Request", "PageAllocator", "SamplingParams", "ServingEngine",
+           "current_engine"]
+
+#: live engines, newest last — how the ObservabilityServer's /requests,
+#: /slo and /generate endpoints find the engine without plumbing a
+#: handle through the server constructor
+_engine_refs: List["weakref.ref[ServingEngine]"] = []
+_engine_lock = threading.Lock()
+
+
+def current_engine(name: Optional[str] = None) -> Optional["ServingEngine"]:
+    """Most recently constructed live engine (or by model name)."""
+    with _engine_lock:
+        for ref in reversed(_engine_refs):
+            eng = ref()
+            if eng is None or eng._closed:
+                continue
+            if name is None or eng.name == name:
+                return eng
+    return None
 
 _REG = _metrics.default_registry()
 _M_QUEUE = _REG.gauge(
@@ -109,6 +133,11 @@ class PageAllocator:
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @property
+    def shared_page_count(self) -> int:
+        """Pages currently held by more than one request (CoW-shared)."""
+        return sum(1 for c in self._refs.values() if c > 1)
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """n page ids at refcount 1, or None when the pool can't cover
@@ -251,8 +280,10 @@ class Request:
         self.finish_reason: Optional[str] = None
         self.error: Optional[str] = None
         self.submitted_ts = time.monotonic()
+        self.admitted_ts: Optional[float] = None   # first admission only
         self.first_token_ts: Optional[float] = None
         self.done_ts: Optional[float] = None
+        self.trace_id: Optional[int] = None        # reqtrace id (if on)
         self.preemptions = 0
         self.slot: Optional[int] = None
         self.pages: List[int] = []
@@ -419,6 +450,18 @@ class ServingEngine:
                       "cow_copies": 0, "prefix_hit_tokens": 0,
                       "shared_admissions": 0,
                       "min_free_pages": self.allocator.free_pages}
+        # request-scoped observability plane: lifecycle tracer, sliding-
+        # window SLO tracker, and a bounded ring of per-iteration
+        # introspection snapshots (the /requests endpoint payload tail)
+        self.tracer = _reqtrace.RequestTracer(name)
+        self.slo = _slo.SLOTracker(name)
+        self._introspect: "deque[dict]" = deque(
+            maxlen=max(1, env_int("PADDLE_TPU_SERVING_INTROSPECT_RING",
+                                  256)))
+        self._last_progress = time.monotonic()
+        with _engine_lock:
+            _engine_refs.append(weakref.ref(self))
+            del _engine_refs[:-8]  # bound the registry
 
         # ONE jit object each: XLA specializes per input shape, so the
         # fused step compiles exactly one executable per decode-lane
@@ -558,9 +601,14 @@ class ServingEngine:
                 raise RuntimeError("engine is closed")
             self._queue.append(req)
             depth = len(self._queue)
+        req.trace_id = self.tracer.submit(req.rid)
         if _metrics.enabled():
             _M_QUEUE.set(depth, model=self.name)
         return req
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
 
     def pending(self) -> bool:
         with self._lock:
@@ -586,7 +634,32 @@ class ServingEngine:
                         if r is not None]  # capacity may have preempted
         if not active_slots:
             return 0
-        return self._decode_iteration(active_slots)
+        produced = self._decode_iteration(active_slots)
+        self._note_introspection(len(active_slots))
+        self._last_progress = time.monotonic()
+        return produced
+
+    def _note_introspection(self, active: int):
+        """One bounded-ring snapshot per decode iteration: the live view
+        /requests serves alongside the per-request phase breakdown."""
+        with self._lock:
+            depth = len(self._queue)
+        used = self.cache.num_pages - 1 - self.allocator.free_pages
+        self._introspect.append({
+            "iteration": self.stats["iterations"],
+            "ts": time.time(),
+            "active": active,
+            "lanes": self._decode_bucket(active),
+            "occupancy": sum(r is not None for r in self._slots),
+            "queue_depth": depth,
+            "free_pages": self.allocator.free_pages,
+            "used_pages": used,
+            "cow_shared_pages": self.allocator.shared_page_count,
+            "decode_mode": self.decode_mode,
+        })
+
+    def introspection(self, n: int = 32) -> List[dict]:
+        return list(self._introspect)[-max(0, n):]
 
     def run_until_idle(self, max_iterations: int = 100000):
         for _ in range(max_iterations):
@@ -698,6 +771,15 @@ class ServingEngine:
                 self.stats["prefix_hit_tokens"] += shared_len
             self._note_pool_watermark()
             bucket = self._bucket_for(len(tokens))
+            requeue = req.preemptions > 0
+            if req.admitted_ts is None:
+                req.admitted_ts = time.monotonic()
+                self.slo.observe("queue_wait",
+                                 req.admitted_ts - req.submitted_ts)
+            self.tracer.admitted(req.rid, bucket=bucket,
+                                 prompt_tokens=len(tokens),
+                                 shared_tokens=shared_len,
+                                 requeue=requeue)
             bt = self.cache.block_tables
             row = np.zeros((self.cache.pages_per_seq,), np.int32)
             row[:len(pages)] = pages
@@ -725,12 +807,15 @@ class ServingEngine:
             if self.share_prefix:
                 self._prefix.register(tokens, pages)
             tok = int(np.asarray(nxt)[0])
+            self.tracer.prefill_done(req.rid)
             now = time.monotonic()
             if req.first_token_ts is None:
                 req.first_token_ts = now
                 if _metrics.enabled() and req.ttft_s is not None:
                     _M_TTFT.observe(req.ttft_s, model=self.name,
                                     path=self.decode_mode)
+                if req.ttft_s is not None:
+                    self.slo.observe("ttft", req.ttft_s)
             self._emit_admission(req, bucket, len(tokens))
             self._record_token(req, tok)
             if _metrics.enabled():
@@ -847,6 +932,12 @@ class ServingEngine:
     def _decode_iteration(self, active_slots: List[int]) -> int:
         import jax.numpy as jnp
         self._maybe_audit_once()
+        # chaos: an armed `serving.decode=N:delay` sleeps here, inflating
+        # TTFT/TPOT exactly like a slow device would (the SLO-breach drill)
+        try:
+            _fault_site("serving.decode")
+        except Exception:
+            pass  # only delay/no-op kinds make sense here; ignore others
         (W, tokens, slot_map, lane_active, temp, top_k, top_p, seeds,
          steps) = self._lane_arrays(active_slots)
         # per-bucket watchdog site: ONE signature per lane width is the
@@ -877,6 +968,8 @@ class ServingEngine:
             if req is None:
                 continue
             tok = int(nxt_np[i])
+            self.tracer.decode_iteration(req.rid, bucket=W,
+                                         path=self.decode_mode)
             self._record_token(req, tok)
             produced += 1
             if req.state == "running":
@@ -912,6 +1005,10 @@ class ServingEngine:
             if _metrics.enabled() and req.tpot_s is not None:
                 _M_TPOT.observe(req.tpot_s, model=self.name,
                                 path=self.decode_mode)
+            if req.tpot_s is not None:
+                self.slo.observe("tpot", req.tpot_s)
+            self.slo.observe("e2e", req.done_ts - req.submitted_ts)
+        self.tracer.complete(req.rid, reason, error=error)
         self._emit_eviction(req, reason)
         req._done.set()
 
@@ -921,6 +1018,7 @@ class ServingEngine:
         to the pool), request requeued with its generated prefix as part
         of the next admission's prompt."""
         self._release_slot(req)
+        self.tracer.preempted(req.rid)
         req.state = "queued"
         req.slot = None
         req.preemptions += 1
@@ -963,6 +1061,55 @@ class ServingEngine:
             model=self.name, request=req.rid, reason=reason,
             generated=len(req.generated),
             free_pages=self.allocator.free_pages)
+
+    # -- introspection / HTTP serving surface ---------------------------------
+    def requests_snapshot(self, n: int = 50) -> Dict:
+        """The `/requests` endpoint payload: live + recently-completed
+        per-request phase breakdowns plus the per-iteration engine
+        introspection ring."""
+        snap = self.tracer.snapshot(n)
+        with self._lock:
+            snap["queue_depth"] = len(self._queue)
+        snap["occupancy"] = sum(r is not None for r in self._slots)
+        snap["introspection"] = self.introspection(n)
+        return snap
+
+    def wedged(self, stall_after: Optional[float] = None) -> bool:
+        """True when the engine holds work but has not completed a decode
+        iteration for `stall_after` seconds (default: the /healthz stall
+        threshold, PADDLE_TPU_HEALTH_STALL_SEC) — the shed signal
+        /generate turns into a 503 instead of hanging a client."""
+        if stall_after is None:
+            stall_after = env_float("PADDLE_TPU_HEALTH_STALL_SEC", 300.0)
+        if not self.pending():
+            return False
+        if self._closed:
+            return True
+        return (time.monotonic() - self._last_progress) > stall_after
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                 sampling: Optional[SamplingParams] = None,
+                 timeout: float = 120.0) -> Dict:
+        """Synchronous one-call inference for the `/generate` endpoint:
+        submit, (drive the loop inline when no background thread runs),
+        wait, and return an endpoint-serializable result."""
+        req = self.submit(prompt, max_new_tokens=max_new_tokens,
+                          sampling=sampling)
+        if self._thread is None:
+            self.run_until_idle()
+        tokens = req.result(timeout=timeout)
+        return {
+            "request": req.rid,
+            "trace_id": req.trace_id,
+            "model": self.name,
+            "tokens": tokens,
+            "finish_reason": req.finish_reason,
+            "preemptions": req.preemptions,
+            "ttft_s": req.ttft_s,
+            "tpot_s": req.tpot_s,
+            "e2e_s": (req.done_ts - req.submitted_ts
+                      if req.done_ts is not None else None),
+        }
 
     # -- status ---------------------------------------------------------------
     def status(self) -> Dict:

@@ -81,6 +81,11 @@ KIND_SEVERITY = {
                                   # preempted/failed), pages freed
     "analysis_finding": "warn",   # static program auditor finding
                                   # (severity tracks the finding's own)
+    "request_trace": "info",      # a serving request's lifecycle trace
+                                  # completed (warn when it failed)
+    "slo_breach": "warn",         # a serving SLO window left its target
+                                  # (one per excursion; re-arms on
+                                  # recovery)
 }
 
 #: back-compat view: the registered kind names

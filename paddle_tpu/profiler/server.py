@@ -23,6 +23,18 @@ surfaces over plain HTTP (http.server, zero deps):
     /controller the fleet controller's live decision state (policies,
                 streaks, evicted host, recent controller_decision
                 records); 404 when no controller runs in this process
+    /requests   serving introspection: live + recently-completed request
+                traces (per-request phase breakdown from
+                profiler/reqtrace.py) and the engine's per-iteration
+                snapshot ring; 404 when no engine runs in this process
+    /slo        serving SLO plane (profiler/slo.py): targets, sliding-
+                window p50/p95/p99 per signal, current breach status
+    /generate   POST {"prompt": [token ids], "max_new_tokens": N,
+                sampling knobs...} -> generated tokens + latency
+                attribution from the live engine. Sheds instead of
+                hanging: 503 JSON when the engine is wedged past the
+                /healthz stall threshold (or closed/absent), 429 with
+                the queue depth when admission is saturated
 
 Opt-in: set `PADDLE_TPU_METRICS_PORT` (0 = pick a free port) and the entry
 points auto-start it — `Model.fit`, `bench.py`, and `tools/elastic_run.py`
@@ -232,6 +244,104 @@ class ObservabilityServer:
                                   "--controller runs one)"}
         return 200, ctl.status()
 
+    # -- serving introspection endpoints -------------------------------------
+    @staticmethod
+    def _engine(name: Optional[str] = None):
+        """The live ServingEngine, WITHOUT importing the inference stack
+        from a scrape: if serving was never imported in this process there
+        is no engine to find (and no reason to pull jax in)."""
+        import sys
+        mod = sys.modules.get("paddle_tpu.inference.serving")
+        if mod is None:
+            return None
+        try:
+            return mod.current_engine(name)
+        except Exception:
+            return None
+
+    def requests_payload(self, query: dict) -> (int, dict):
+        """`/requests`: live + recently-completed per-request phase
+        breakdowns and the engine's per-iteration introspection ring."""
+        raw_n = query.get("n", ["50"])[0]
+        try:
+            n = int(raw_n)
+        except ValueError:
+            return 400, {"error": f"n={raw_n!r} must be an integer"}
+        eng = self._engine(query.get("model", [None])[0])
+        if eng is None:
+            return 404, {"error": "no serving engine in this process"}
+        return 200, eng.requests_snapshot(n)
+
+    def slo_payload(self, query: dict) -> (int, dict):
+        """`/slo`: targets, sliding-window quantiles per signal, breach
+        status. Falls back to the process's most recent SLO tracker when
+        the engine itself is gone (post-close scrape)."""
+        eng = self._engine(query.get("model", [None])[0])
+        if eng is not None:
+            return 200, eng.slo.snapshot()
+        from .slo import current_snapshot
+        snap = current_snapshot()
+        if snap is None:
+            return 404, {"error": "no serving SLO tracker in this "
+                                  "process"}
+        return 200, snap
+
+    def generate_payload(self, body: bytes) -> (int, dict):
+        """`/generate` (POST): one-call HTTP inference against the live
+        engine. Sheds instead of hanging: 503 with a JSON error when the
+        engine is wedged past the /healthz stall threshold (or closed /
+        absent), 429 with the queue depth when admission is saturated
+        (`PADDLE_TPU_SERVING_QUEUE_LIMIT` deep)."""
+        from ..utils.envparse import env_int
+        eng = self._engine()
+        if eng is None:
+            return 503, {"error": "no serving engine in this process"}
+        if eng._closed:
+            return 503, {"error": "serving engine is closed",
+                         "model": eng.name}
+        if eng.wedged(self.stall_after):
+            return 503, {"error": "serving engine is wedged (no decode "
+                                  "progress past the stall threshold)",
+                         "model": eng.name,
+                         "stall_after_s": self.stall_after or liveness()
+                         .get("stall_after_s")}
+        limit = env_int("PADDLE_TPU_SERVING_QUEUE_LIMIT", 64)
+        depth = eng.queue_depth()
+        if limit > 0 and depth >= limit:
+            return 429, {"error": "admission queue saturated",
+                         "model": eng.name, "queue_depth": depth,
+                         "limit": limit}
+        try:
+            req = json.loads(body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            return 400, {"error": f"request body is not JSON: {e}"}
+        prompt = req.get("prompt")
+        if not isinstance(prompt, list) or \
+                not all(isinstance(t, int) for t in prompt):
+            return 400, {"error": "'prompt' must be a list of token ids"}
+        sampling = None
+        sp_keys = {k: req[k] for k in ("temperature", "top_k", "top_p",
+                                       "seed") if k in req}
+        if sp_keys:
+            try:
+                from ..inference.sampling import SamplingParams
+                sampling = SamplingParams(**sp_keys)
+            except (TypeError, ValueError) as e:
+                return 400, {"error": f"bad sampling params: {e}"}
+        try:
+            out = eng.generate(
+                prompt,
+                max_new_tokens=int(req.get("max_new_tokens", 16)),
+                sampling=sampling,
+                timeout=float(req.get("timeout", 120.0)))
+        except (TypeError, ValueError) as e:
+            return 400, {"error": str(e)}
+        except TimeoutError as e:
+            return 504, {"error": str(e)}
+        except RuntimeError as e:
+            return 503, {"error": str(e), "model": eng.name}
+        return 200, out
+
     def healthz(self) -> dict:
         h = liveness(self.stall_after)
         if self.aggregator is not None:
@@ -306,15 +416,58 @@ class ObservabilityServer:
                         code, payload = srv.controller_status()
                         self._send(code, json.dumps(payload),
                                    "application/json")
+                    elif url.path == "/requests":
+                        code, payload = srv.requests_payload(
+                            parse_qs(url.query))
+                        self._send(code, json.dumps(payload),
+                                   "application/json")
+                    elif url.path == "/slo":
+                        code, payload = srv.slo_payload(parse_qs(url.query))
+                        self._send(code, json.dumps(payload),
+                                   "application/json")
+                    elif url.path == "/generate":
+                        self._send(405, json.dumps(
+                            {"error": "POST a JSON body "
+                                      "{\"prompt\": [token ids], ...} "
+                                      "to /generate"}),
+                            "application/json")
                     else:
                         self._send(404, json.dumps(
                             {"error": "unknown path", "endpoints":
                              ["/metrics", "/snapshot", "/healthz",
-                              "/events", "/profile", "/controller"]}),
+                              "/events", "/profile", "/controller",
+                              "/requests", "/slo", "/generate"]}),
                             "application/json")
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # a handler bug must not kill a scrape
+                    try:
+                        self._send(500, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}),
+                            "application/json")
+                    except Exception:
+                        pass
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                try:
+                    if url.path == "/generate":
+                        try:
+                            length = int(self.headers.get(
+                                "Content-Length", "0"))
+                        except ValueError:
+                            length = 0
+                        body = self.rfile.read(length) if length else b""
+                        code, payload = srv.generate_payload(body)
+                        self._send(code, json.dumps(payload),
+                                   "application/json")
+                    else:
+                        self._send(404, json.dumps(
+                            {"error": "unknown path", "endpoints":
+                             ["/generate"]}), "application/json")
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # a handler bug must not kill serving
                     try:
                         self._send(500, json.dumps(
                             {"error": f"{type(e).__name__}: {e}"}),

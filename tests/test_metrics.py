@@ -213,6 +213,8 @@ class TestMetricNamingLint:
         import paddle_tpu.ops.pallas.autotune  # noqa: F401
         import paddle_tpu.profiler.compile_watch  # noqa: F401
         import paddle_tpu.profiler.health  # noqa: F401
+        import paddle_tpu.profiler.reqtrace  # noqa: F401
+        import paddle_tpu.profiler.slo  # noqa: F401
         import paddle_tpu.profiler.watchdog  # noqa: F401
 
     def test_family_names_match_prometheus_grammar(self):
@@ -293,6 +295,16 @@ class TestMetricNamingLint:
         _at._M_EVENTS.inc(event="hit", op="paged_attn")
         _at._M_TUNES.inc(op="paged_attn")
         _at._M_CHOSEN.set(1.0, op="paged_attn", config="impl1-heads12")
+        # request-trace lifecycle histograms (model=) + SLO plane
+        # families (model=, signal=)
+        from paddle_tpu.profiler import reqtrace as _rt
+        _rt._M_QWAIT.observe(0.01, model="gpt")
+        _rt._M_PREFILL.observe(0.05, model="gpt")
+        _rt._M_REQUEUE.observe(0.02, model="gpt")
+        from paddle_tpu.profiler import slo as _slo
+        _slo._M_BREACHES.inc(model="gpt", signal="ttft")
+        _slo._M_BREACHED.set(1, model="gpt", signal="ttft")
+        _slo._M_P99.set(0.2, model="gpt", signal="ttft")
         reg = metrics.default_registry()
         problems = []
         for name in reg.names():

@@ -535,3 +535,186 @@ class TestSupervisorRole:
         finally:
             if proc.poll() is None:
                 proc.kill()
+
+
+def _post(port, path, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=body if isinstance(body, bytes) else body.encode(),
+        method="POST", headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestServingObservabilityEndpoints:
+    """The serving introspection plane: /requests, /slo, and the
+    shedding /generate inference endpoint (never hangs a client: 503
+    when wedged/closed/absent, 429 when admission is saturated)."""
+
+    @pytest.fixture(scope="class", autouse=True)
+    def _serving_ccache(self):
+        import tempfile
+        from paddle_tpu.framework import flags as flags_mod
+        cache = os.path.join(tempfile.gettempdir(), "pt_serving_ccache")
+        os.makedirs(cache, exist_ok=True)
+        flags_mod.set_flags({"FLAGS_compile_cache_dir": cache})
+        yield
+        flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
+
+    @staticmethod
+    def _engine(name="obs_srv", **kw):
+        from paddle_tpu.inference.serving import ServingEngine
+        from paddle_tpu.models.gpt import GPT, GPTConfig
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=512, max_position_embeddings=128,
+                        hidden_size=32, num_layers=2, num_heads=2,
+                        dropout=0.0, attn_dropout=0.0)
+        m = GPT(cfg)
+        m.eval()
+        kw.setdefault("max_batch", 2)
+        return ServingEngine(m, max_len=48, page_size=8, name=name, **kw)
+
+    @staticmethod
+    def _no_engine(monkeypatch):
+        from paddle_tpu.inference import serving as serving_mod
+        from paddle_tpu.profiler import slo as slo_mod
+        monkeypatch.setattr(serving_mod, "_engine_refs", [])
+        monkeypatch.setattr(slo_mod, "_current", None)
+
+    def test_requests_and_slo_404_without_engine(self, srv, monkeypatch):
+        self._no_engine(monkeypatch)
+        status, body, _ = _get(srv.port, "/requests")
+        assert status == 404
+        assert "no serving engine" in json.loads(body)["error"]
+        status, body, _ = _get(srv.port, "/slo")
+        assert status == 404
+        assert "SLO" in json.loads(body)["error"]
+
+    def test_requests_reports_live_engine(self, srv):
+        eng = self._engine(name="obs_req")
+        reqs = [eng.submit(list(range(1, 9)), max_new_tokens=3)
+                for _ in range(2)]
+        eng.run_until_idle()
+        for r in reqs:
+            r.result(timeout=10)
+        status, body, _ = _get(srv.port, "/requests?n=5")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["model"] == "obs_req"
+        assert len(doc["completed"]) == 2
+        phases = [s["phase"] for s in doc["completed"][0]["spans"]]
+        assert "prefill" in phases and "decode" in phases
+        assert doc["introspection"], "introspection ring missing"
+        assert doc["queue_depth"] == 0
+
+    def test_requests_garbled_n_is_400(self, srv):
+        self._engine(name="obs_n")
+        status, body, _ = _get(srv.port, "/requests?n=lots")
+        assert status == 400
+        assert "n=" in json.loads(body)["error"]
+
+    def test_slo_serves_window_quantiles(self, srv):
+        eng = self._engine(name="obs_slo")
+        req = eng.submit(list(range(1, 9)), max_new_tokens=3)
+        eng.run_until_idle()
+        req.result(timeout=10)
+        status, body, _ = _get(srv.port, "/slo")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["model"] == "obs_slo" and doc["status"] == "ok"
+        assert doc["signals"]["ttft"]["count"] >= 1
+        assert doc["signals"]["ttft"]["p50"] <= doc["signals"]["ttft"]["p99"]
+
+    def test_slo_falls_back_to_last_tracker_without_engine(
+            self, srv, monkeypatch):
+        from paddle_tpu.inference import serving as serving_mod
+        from paddle_tpu.profiler.slo import SLOTracker
+        monkeypatch.setattr(serving_mod, "_engine_refs", [])
+        t = SLOTracker("obs_fallback", window=4, min_samples=1,
+                       targets={})
+        t.observe("e2e", 0.5)
+        status, body, _ = _get(srv.port, "/slo")
+        assert status == 200
+        assert json.loads(body)["model"] == "obs_fallback"
+
+    def test_generate_get_is_405_post_roundtrips(self, srv):
+        eng = self._engine(name="obs_gen", max_batch=1)
+        status, body, _ = _get(srv.port, "/generate")
+        assert status == 405
+        status, body = _post(srv.port, "/generate", json.dumps(
+            {"prompt": list(range(1, 8)), "max_new_tokens": 3,
+             "temperature": 0.0}))
+        assert status == 200, body
+        out = json.loads(body)
+        assert out["model"] == "obs_gen"
+        assert len(out["tokens"]) == 3
+        assert all(isinstance(t, int) for t in out["tokens"])
+        assert out["finish_reason"] in ("eos", "length", "stop")
+        assert out["ttft_s"] >= 0 and out["e2e_s"] >= out["ttft_s"]
+        # the HTTP request is itself traced
+        tr = eng.tracer.get(out["request"])
+        assert tr is not None and tr.trace_id == out["trace_id"]
+
+    def test_generate_bad_bodies_are_400(self, srv):
+        self._engine(name="obs_bad")
+        status, body = _post(srv.port, "/generate", b"{not json")
+        assert status == 400
+        assert "not JSON" in json.loads(body)["error"]
+        status, body = _post(srv.port, "/generate",
+                             json.dumps({"prompt": "hello"}))
+        assert status == 400
+        assert "token ids" in json.loads(body)["error"]
+        status, body = _post(srv.port, "/generate", json.dumps(
+            {"prompt": [1, 2, 3], "temperature": -2.0}))
+        assert status == 400
+        assert "sampling" in json.loads(body)["error"]
+
+    def test_generate_sheds_503_when_absent_closed_or_wedged(
+            self, srv, monkeypatch):
+        self._no_engine(monkeypatch)
+        status, body = _post(srv.port, "/generate",
+                             json.dumps({"prompt": [1, 2]}))
+        assert status == 503
+        assert "no serving engine" in json.loads(body)["error"]
+        # a closed engine is invisible to current_engine -> same 503
+        eng = self._engine(name="obs_closed")
+        eng.close()
+        status, body = _post(srv.port, "/generate",
+                             json.dumps({"prompt": [1, 2]}))
+        assert status == 503
+        assert "no serving engine" in json.loads(body)["error"]
+        # the close-after-lookup race guard answers "closed"
+        monkeypatch.setattr(type(srv), "_engine",
+                            staticmethod(lambda name=None: eng))
+        code, doc = srv.generate_payload(b'{"prompt": [1, 2]}')
+        assert code == 503 and "closed" in doc["error"]
+        monkeypatch.undo()
+        # wedged: holds work, zero decode progress past the threshold
+        eng2 = self._engine(name="obs_wedged")
+        eng2.submit(list(range(1, 6)), max_new_tokens=2)
+        monkeypatch.setattr(eng2, "_last_progress",
+                            eng2._last_progress - 3600.0)
+        monkeypatch.setattr(srv, "stall_after", 1.0)
+        status, body = _post(srv.port, "/generate",
+                             json.dumps({"prompt": [1, 2]}))
+        assert status == 503
+        doc = json.loads(body)
+        assert "wedged" in doc["error"] and doc["model"] == "obs_wedged"
+        eng2.run_until_idle()  # drain so later tests see a clean engine
+
+    def test_generate_sheds_429_when_queue_saturated(self, srv,
+                                                     monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SERVING_QUEUE_LIMIT", "2")
+        eng = self._engine(name="obs_sat", max_batch=1)
+        for _ in range(2):  # fill the admission queue, engine not running
+            eng.submit(list(range(1, 6)), max_new_tokens=2)
+        status, body = _post(srv.port, "/generate",
+                             json.dumps({"prompt": [1, 2]}))
+        assert status == 429
+        doc = json.loads(body)
+        assert doc["queue_depth"] >= 2 and doc["limit"] == 2
+        assert "saturated" in doc["error"]
+        eng.run_until_idle()  # drain

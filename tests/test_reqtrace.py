@@ -1,0 +1,354 @@
+"""Request-scoped serving traces (profiler/reqtrace.py) and the
+ServingEngine lifecycle hooks that feed them.
+
+The ISSUE-17 contracts: every request gets ONE trace id at submit and
+keeps it across preemption + re-prefill (the re-admission span is
+labeled `requeue`), decode spans are bucketed per
+PADDLE_TPU_REQTRACE_EVERY iterations and carry bucket/path labels,
+per-phase durations sum to within noise of the e2e wall time
+(contiguous attribution), completed traces land in a bounded ring and
+emit one `request_trace` event, the chrome-trace/JSONL exports are
+well-formed, and the PADDLE_TPU_REQTRACE kill switch turns every hook
+into a no-op.
+
+Tracer unit tests drive the hooks directly (no jax); the engine
+integration tests reuse the tiny serving GPT and the shared persistent
+compile cache from test_serving_v2.py.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.profiler import events
+from paddle_tpu.profiler import reqtrace
+from paddle_tpu.profiler.reqtrace import RequestTracer, to_chrome_trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    events.default_event_log().clear()
+    yield
+    events.default_event_log().clear()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _shared_compile_cache():
+    """Same tiny-model engine as test_serving.py/test_serving_v2.py:
+    share the one persistent XLA compile cache dir so only the first
+    suite in the tier-1 run pays backend compile."""
+    import os
+    import tempfile
+    from paddle_tpu.framework import flags as flags_mod
+    cache = os.path.join(tempfile.gettempdir(), "pt_serving_ccache")
+    os.makedirs(cache, exist_ok=True)
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": cache})
+    yield
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
+
+
+def _model(vocab=512):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, max_position_embeddings=128,
+                    hidden_size=32, num_layers=2, num_heads=2,
+                    dropout=0.0, attn_dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _spans(trace_dict, phase):
+    return [s for s in trace_dict["spans"] if s["phase"] == phase]
+
+
+class TestTracerUnit:
+    """Hook-level contracts, no engine: the tracer is plain Python."""
+
+    def _run_one(self, tracer, rid=1, iters=3, bucket=8, path="fused"):
+        tracer.submit(rid)
+        tracer.admitted(rid, bucket=bucket, prompt_tokens=5)
+        tracer.prefill_done(rid)
+        for _ in range(iters):
+            tracer.decode_iteration(rid, bucket=bucket, path=path)
+        tracer.complete(rid, "eos")
+
+    def test_lifecycle_spans_in_order(self):
+        tr = RequestTracer("unit", ring=8)
+        tid = tr.submit(1)
+        assert isinstance(tid, int)
+        self_phases = None
+        tr.admitted(1, bucket=16, prompt_tokens=9, shared_tokens=4)
+        tr.prefill_done(1)
+        tr.decode_iteration(1, bucket=16, path="fused")
+        tr.complete(1, "eos")
+        [rec] = tr.completed()
+        assert rec["trace_id"] == tid and rec["state"] == "complete"
+        self_phases = [s["phase"] for s in rec["spans"]]
+        assert self_phases == ["queued", "prefill", "decode", "complete"]
+        pre = _spans(rec, "prefill")[0]
+        assert pre["bucket"] == 16 and pre["prompt_tokens"] == 9
+        assert pre["shared_prefix_skip"] == 4  # shared-prefix skip noted
+        dec = _spans(rec, "decode")[0]
+        assert dec["bucket"] == 16 and dec["path"] == "fused"
+        # every span closed, marker is zero-width, durations non-negative
+        for s in rec["spans"]:
+            assert s["end"] is not None and s["end"] >= s["start"]
+        assert rec["e2e_s"] >= 0
+
+    def test_decode_spans_bucket_per_every_and_on_label_change(self):
+        tr = RequestTracer("unit", ring=8, decode_every=4)
+        tr.submit(2)
+        tr.admitted(2, bucket=8, prompt_tokens=3)
+        tr.prefill_done(2)
+        for _ in range(8):  # 8 iters at every=4 -> 2 spans
+            tr.decode_iteration(2, bucket=8, path="fused")
+        tr.decode_iteration(2, bucket=16, path="fused")  # bucket change
+        tr.decode_iteration(2, bucket=16, path="eager")  # path change
+        tr.complete(2, "length")
+        [rec] = tr.completed()
+        decs = _spans(rec, "decode")
+        assert len(decs) == 4
+        assert [d["iters"] for d in decs] == [4, 4, 1, 1]
+        assert decs[2]["bucket"] == 16 and decs[3]["path"] == "eager"
+        assert rec["decode_iterations"] == 10
+        assert rec["decode_tokens"] == 10
+
+    def test_preemption_keeps_trace_id_and_labels_requeue(self):
+        tr = RequestTracer("unit", ring=8)
+        tid = tr.submit(3)
+        tr.admitted(3, bucket=8, prompt_tokens=4)
+        tr.prefill_done(3)
+        tr.decode_iteration(3, bucket=8, path="fused")
+        tr.preempted(3)
+        assert tr.get(3).trace_id == tid  # SAME trace across requeue
+        tr.admitted(3, bucket=8, prompt_tokens=6, requeue=True)
+        tr.prefill_done(3)
+        tr.decode_iteration(3, bucket=8, path="fused")
+        tr.complete(3, "eos")
+        [rec] = tr.completed()
+        assert rec["trace_id"] == tid and rec["preemptions"] == 1
+        pres = _spans(rec, "prefill")
+        assert len(pres) == 2
+        assert "requeue" not in pres[0]
+        assert pres[1]["requeue"] is True
+        assert len(_spans(rec, "preempted")) == 1
+        assert "preempted" in rec["phases"]
+
+    def test_failed_completion_marked_and_event_warns(self):
+        tr = RequestTracer("unit", ring=8)
+        tr.submit(4)
+        tr.admitted(4, bucket=8, prompt_tokens=2)
+        tr.complete(4, "error", error="boom")
+        [rec] = tr.completed()
+        assert rec["state"] == "failed"
+        [mark] = _spans(rec, "failed")
+        assert mark["error"] == "boom"
+        [ev] = events.recent(kind="request_trace")
+        assert ev["severity"] == "warn" and ev["finish_reason"] == "error"
+
+    def test_completed_ring_is_bounded(self):
+        tr = RequestTracer("unit", ring=3)
+        for rid in range(6):
+            self._run_one(tr, rid=rid, iters=1)
+        done = tr.completed()
+        assert len(done) == 3
+        assert [d["rid"] for d in done] == [3, 4, 5]
+        assert tr.snapshot()["ring_size"] == 3
+
+    def test_request_trace_event_per_completion(self):
+        tr = RequestTracer("unit", ring=8)
+        self._run_one(tr, rid=7)
+        evs = events.recent(kind="request_trace")
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["model"] == "unit" and ev["rid"] == 7
+        assert ev["finish_reason"] == "eos"
+        assert set(ev["phases"]) >= {"queued", "prefill", "decode"}
+
+    def test_kill_switch_disables_every_hook(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_REQTRACE", "0")
+        tr = RequestTracer("unit", ring=8)
+        assert tr.submit(8) is None
+        # hooks on an untracked rid are silent no-ops
+        tr.admitted(8, bucket=8, prompt_tokens=1)
+        tr.decode_iteration(8, bucket=8, path="fused")
+        tr.complete(8, "eos")
+        assert tr.completed() == [] and tr.live() == []
+        assert tr.snapshot()["enabled"] is False
+        assert events.recent(kind="request_trace") == []
+
+    def test_jsonl_log_appends_one_line_per_trace(self, tmp_path):
+        log = tmp_path / "traces.jsonl"
+        tr = RequestTracer("unit", ring=8, log_path=str(log))
+        self._run_one(tr, rid=9)
+        self._run_one(tr, rid=10)
+        lines = [json.loads(l) for l in
+                 log.read_text().strip().splitlines()]
+        assert [l["rid"] for l in lines] == [9, 10]
+        assert all(l["state"] == "complete" for l in lines)
+
+    def test_export_jsonl_and_chrome_trace(self, tmp_path):
+        tr = RequestTracer("unit", ring=8, decode_every=2)
+        self._run_one(tr, rid=11, iters=5)
+        n = tr.export_jsonl(str(tmp_path / "t.jsonl"))
+        assert n == 1
+        rec = json.loads((tmp_path / "t.jsonl").read_text())
+        assert rec["rid"] == 11
+        n = tr.export_chrome_trace(str(tmp_path / "t.json"))
+        assert n == 1
+        doc = json.loads((tmp_path / "t.json").read_text())
+        assert doc["displayTimeUnit"] == "ms"
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert names >= {"queued", "prefill", "decode", "complete"}
+        for e in doc["traceEvents"]:
+            assert e["ph"] == "X" and e["dur"] >= 0
+            assert e["pid"] == "unit"
+            assert e["args"]["rid"] == 11
+
+    def test_chrome_trace_skips_open_spans(self):
+        tr = RequestTracer("unit", ring=8)
+        tr.submit(12)  # queued span still open
+        doc = to_chrome_trace(tr.live())
+        assert doc["traceEvents"] == []
+
+    def test_metric_families_observe_per_phase(self):
+        from paddle_tpu.profiler import metrics as metrics_mod
+        tr = RequestTracer("hist_unit", ring=8)
+        tr.submit(13)
+        tr.admitted(13, bucket=8, prompt_tokens=2)
+        tr.prefill_done(13)
+        tr.preempted(13)
+        tr.admitted(13, bucket=8, prompt_tokens=3, requeue=True)
+        tr.prefill_done(13)
+        tr.complete(13, "eos")
+        snap = metrics_mod.default_registry().snapshot()
+        for fam in ("serving_queue_wait_seconds",
+                    "serving_prefill_seconds",
+                    "serving_preempt_requeue_seconds"):
+            vals = [v for v in snap[fam]["values"]
+                    if v["labels"].get("model") == "hist_unit"]
+            assert vals and vals[0]["count"] >= 1, fam
+
+
+class TestEngineTraces:
+    """The ServingEngine hooks: traces built by real serving runs."""
+
+    def _serve(self, eng, prompts, max_new=5, sampling=None):
+        if sampling is None:
+            sampling = [None] * len(prompts)
+        reqs = [eng.submit(p, max_new_tokens=max_new, sampling=s)
+                for p, s in zip(prompts, sampling)]
+        eng.run_until_idle()
+        for r in reqs:
+            r.result(timeout=10)
+        return reqs
+
+    def test_every_phase_present_with_bucket_and_path_labels(self):
+        from paddle_tpu.inference.serving import ServingEngine
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="rt_phases")
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, cfg.vocab_size, (n,)).tolist()
+                   for n in (7, 12)]
+        reqs = self._serve(eng, prompts, max_new=5)
+        done = eng.tracer.completed()
+        assert len(done) == 2
+        for req, rec in zip(reqs, sorted(done, key=lambda d: d["rid"])):
+            assert rec["trace_id"] == req.trace_id
+            phases = [s["phase"] for s in rec["spans"]]
+            for ph in ("queued", "prefill", "decode", "complete"):
+                assert ph in phases, (ph, phases)
+            for d in _spans(rec, "decode"):
+                assert d["bucket"] in eng.decode_buckets or \
+                    d["bucket"] == eng.max_batch
+                assert d["path"] == "fused"
+            assert rec["decode_tokens"] >= 4  # max_new - prefill token
+            assert rec["finish_reason"] in ("eos", "length", "stop")
+
+    def test_preemption_trace_continuity(self):
+        """THE preemption contract: a preempted+requeued request keeps
+        ONE trace id end to end, its re-prefill span is labeled
+        `requeue`, and per-phase durations sum to within noise of the
+        e2e wall time (contiguous attribution)."""
+        from paddle_tpu.inference.serving import ServingEngine
+        m, cfg = _model()
+        prompt = list(range(1, 15))
+        eng = ServingEngine(m, max_batch=2, max_len=64, page_size=8,
+                            name="rt_preempt")
+        reqs = [eng.submit(prompt, max_new_tokens=6) for _ in range(2)]
+        eng.step()  # admit both + first decode iteration
+        victim_req = eng._slots[1]
+        eng._preempt(victim_req)
+        eng.run_until_idle()
+        for r in reqs:
+            r.result(timeout=10)
+        rec = eng.tracer.get(victim_req.rid).to_dict()
+        # ONE trace id across the preemption
+        assert rec["trace_id"] == victim_req.trace_id
+        assert rec["preemptions"] == 1
+        ids = {victim_req.trace_id}
+        for s in rec["spans"]:
+            assert s["end"] is not None
+        pres = _spans(rec, "prefill")
+        assert len(pres) == 2
+        assert pres[1]["requeue"] is True  # re-prefill labeled
+        assert len(_spans(rec, "preempted")) == 1
+        assert len(ids) == 1
+        # contiguous attribution: phases sum ~ e2e (small inter-hook
+        # gaps only — the spans cover the request's whole life)
+        total = sum(rec["phases"].values())
+        assert rec["e2e_s"] is not None
+        assert abs(total - rec["e2e_s"]) < max(0.1, 0.05 * rec["e2e_s"]), \
+            (total, rec["e2e_s"], rec["phases"])
+        # the survivor saw no preemption and exactly one prefill
+        other = eng.tracer.get(reqs[0].rid).to_dict()
+        assert other["preemptions"] == 0
+        assert len(_spans(other, "prefill")) == 1
+
+    def test_phase_durations_sum_to_e2e_without_preemption(self):
+        from paddle_tpu.inference.serving import ServingEngine
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="rt_sum")
+        self._serve(eng, [list(range(1, 9)), list(range(2, 14))],
+                    max_new=5)
+        for rec in eng.tracer.completed():
+            total = sum(rec["phases"].values())
+            assert abs(total - rec["e2e_s"]) < \
+                max(0.1, 0.05 * rec["e2e_s"]), (total, rec["e2e_s"])
+
+    def test_requests_snapshot_and_introspection_ring(self):
+        from paddle_tpu.inference.serving import ServingEngine
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="rt_snap")
+        self._serve(eng, [list(range(1, 10))], max_new=4)
+        snap = eng.requests_snapshot()
+        assert snap["model"] == "rt_snap"
+        assert snap["queue_depth"] == 0 and snap["live"] == []
+        assert len(snap["completed"]) == 1
+        intr = snap["introspection"]
+        assert intr, "per-iteration introspection ring is empty"
+        for row in intr:
+            for key in ("iteration", "active", "lanes", "occupancy",
+                        "queue_depth", "free_pages", "used_pages",
+                        "cow_shared_pages", "decode_mode"):
+                assert key in row, key
+        assert any(r["active"] >= 1 for r in intr)
+        json.dumps(snap)  # endpoint payload must be JSON-serializable
+
+    def test_engine_kill_switch_run_still_serves(self, monkeypatch):
+        """PADDLE_TPU_REQTRACE=0: tokens still flow, no traces kept."""
+        monkeypatch.setenv("PADDLE_TPU_REQTRACE", "0")
+        from paddle_tpu.inference.serving import ServingEngine
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                            name="rt_off")
+        [req] = self._serve(eng, [list(range(1, 8))], max_new=3)
+        assert req.trace_id is None
+        assert len(req.generated) == 3
+        assert eng.tracer.completed() == []
+        assert events.recent(kind="request_trace") == []

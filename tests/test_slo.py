@@ -1,0 +1,244 @@
+"""Sliding-window SLO tracker (profiler/slo.py) and the chaos drill
+that proves the serving plane's breach alerting.
+
+The ISSUE-17 contracts: window p50/p95/p99 against PADDLE_TPU_SLO_*
+targets, exactly ONE `slo_breach` event per excursion with silent
+re-arm on recovery (the PR-9 health-detector transition shape), the
+fleet-digest mirror (`serving_slo`), and the end-to-end chaos check —
+a `delay`-faulted `serving.decode` drives a p99 TTFT breach that emits
+one event, re-arms when the window recovers, and fires again on the
+next excursion, all while tokens keep flowing.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fault
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.profiler import events
+from paddle_tpu.profiler import metrics as metrics_mod
+from paddle_tpu.profiler import slo
+from paddle_tpu.profiler.slo import SLOTracker, _quantile
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    events.default_event_log().clear()
+    fault.reset()
+    yield
+    events.default_event_log().clear()
+    fault.reset()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _shared_compile_cache():
+    """Shared persistent XLA compile cache with the other serving
+    suites (identical tiny-GPT HLO)."""
+    import os
+    import tempfile
+    from paddle_tpu.framework import flags as flags_mod
+    cache = os.path.join(tempfile.gettempdir(), "pt_serving_ccache")
+    os.makedirs(cache, exist_ok=True)
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": cache})
+    yield
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
+
+
+def _model(vocab=512):
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, max_position_embeddings=128,
+                    hidden_size=32, num_layers=2, num_heads=2,
+                    dropout=0.0, attn_dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m, cfg
+
+
+class TestSLOTrackerUnit:
+    def test_quantile_interpolation(self):
+        vals = [1.0, 2.0, 3.0, 4.0]
+        assert _quantile(vals, 0.5) == pytest.approx(2.5)
+        assert _quantile(vals, 0.0) == 1.0
+        assert _quantile(vals, 1.0) == 4.0
+        assert _quantile([7.0], 0.99) == 7.0
+
+    def test_window_quantiles_and_snapshot_shape(self):
+        t = SLOTracker("unit", window=16, min_samples=4, targets={})
+        for v in range(1, 11):
+            t.observe("ttft", v / 10.0)
+        qs = t.quantiles("ttft")
+        assert qs["count"] == 10
+        assert qs["p50"] <= qs["p95"] <= qs["p99"] <= 1.0
+        snap = t.snapshot()
+        assert snap["status"] == "ok" and snap["breached"] == {}
+        assert set(snap["signals"]) == set(slo.SIGNALS)
+        assert snap["signals"]["tpot"]["count"] == 0
+        assert snap["signals"]["tpot"]["p99"] is None
+        json.dumps(snap)
+
+    def test_unknown_signal_raises(self):
+        t = SLOTracker("unit", targets={})
+        with pytest.raises(ValueError, match="unknown SLO signal"):
+            t.observe("latency", 1.0)
+
+    def test_one_event_per_excursion_and_rearm(self):
+        """Breach entry emits exactly ONE slo_breach; further breached
+        samples are silent; recovery re-arms silently; the NEXT
+        excursion emits again."""
+        t = SLOTracker("unit_excur", window=4, min_samples=2,
+                       targets={"ttft": 0.1})
+        for _ in range(4):
+            t.observe("ttft", 1.0)  # deep breach, many samples
+        evs = events.recent(kind="slo_breach")
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["severity"] == "warn" and ev["signal"] == "ttft"
+        assert ev["value"] > ev["target"] == 0.1
+        assert t.status() == "breach:ttft"
+        assert t.stats["breaches"] == 1
+        # recovery: fast samples flush the window -> silent re-arm
+        for _ in range(4):
+            t.observe("ttft", 0.01)
+        assert t.status() == "ok" and t.breached() == {}
+        assert t.stats["recoveries"] == 1
+        assert len(events.recent(kind="slo_breach")) == 1  # no new event
+        # next excursion fires again
+        for _ in range(4):
+            t.observe("ttft", 2.0)
+        assert len(events.recent(kind="slo_breach")) == 2
+        assert t.stats["breaches"] == 2
+
+    def test_min_samples_gates_checking(self):
+        t = SLOTracker("unit_min", window=32, min_samples=8,
+                       targets={"e2e": 0.001})
+        for _ in range(7):
+            t.observe("e2e", 5.0)
+        assert t.status() == "ok"  # not enough samples yet
+        t.observe("e2e", 5.0)
+        assert t.status() == "breach:e2e"
+
+    def test_unset_target_is_never_checked(self):
+        t = SLOTracker("unit_unset", window=8, min_samples=1,
+                       targets={"ttft": 0.1})
+        for _ in range(8):
+            t.observe("tpot", 100.0)  # no tpot target -> no breach
+        assert t.status() == "ok"
+        assert events.recent(kind="slo_breach") == []
+
+    def test_kill_switch_disables_observation(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SLO", "0")
+        t = SLOTracker("unit_off", window=8, min_samples=1,
+                       targets={"ttft": 0.001})
+        for _ in range(8):
+            t.observe("ttft", 9.0)
+        assert t.snapshot()["enabled"] is False
+        assert t.snapshot()["signals"]["ttft"]["count"] == 0
+        assert events.recent(kind="slo_breach") == []
+
+    def test_default_targets_from_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SLO_TTFT_P99_S", "0.25")
+        monkeypatch.setenv("PADDLE_TPU_SLO_E2E_P99_S", "3.5")
+        monkeypatch.delenv("PADDLE_TPU_SLO_TPOT_P99_S", raising=False)
+        monkeypatch.delenv("PADDLE_TPU_SLO_QUEUE_P99_S", raising=False)
+        assert slo.default_targets() == {"ttft": 0.25, "e2e": 3.5}
+
+    def test_breach_metric_families(self):
+        t = SLOTracker("unit_fam", window=4, min_samples=2,
+                       targets={"queue_wait": 0.05})
+        for _ in range(3):
+            t.observe("queue_wait", 1.0)
+        snap = metrics_mod.default_registry().snapshot()
+
+        def series(fam):
+            return {tuple(sorted(v["labels"].items())): v["value"]
+                    for v in snap[fam]["values"]}
+        key = (("model", "unit_fam"), ("signal", "queue_wait"))
+        assert series("slo_breaches_total")[key] == 1
+        assert series("slo_breached")[key] == 1
+        assert series("slo_window_p99_seconds")[key] > 0.05
+        for _ in range(4):
+            t.observe("queue_wait", 0.001)
+        snap = metrics_mod.default_registry().snapshot()
+        assert series("slo_breached")[key] == 0  # gauge re-armed
+        assert series("slo_breaches_total")[key] == 1  # excursions, not samples
+
+    def test_last_status_and_current_snapshot_track_newest(self):
+        t = SLOTracker("unit_cur", window=4, min_samples=1,
+                       targets={"ttft": 0.1})
+        assert slo.last_status() == "ok"
+        t.observe("ttft", 1.0)
+        assert slo.last_status() == "breach:ttft"
+        snap = slo.current_snapshot()
+        assert snap["model"] == "unit_cur"
+
+    def test_fleet_digest_mirrors_slo_status(self):
+        from paddle_tpu.distributed.fleet.telemetry import FleetReporter
+        t = SLOTracker("unit_digest", window=4, min_samples=1,
+                       targets={"e2e": 0.01})  # held: _current is a weakref
+        t.observe("e2e", 5.0)
+        assert FleetReporter._serving_slo_status() == "breach:e2e"
+
+
+class TestSLOChaosDrill:
+    """End-to-end: latency chaos at `serving.decode` drives a TTFT
+    breach; the alert fires once, re-arms, and fires again."""
+
+    def test_delay_fault_drives_single_breach_then_rearms(self,
+                                                          monkeypatch):
+        # tight target + tiny window so the drill is deterministic and
+        # the recovery flush is cheap
+        monkeypatch.setenv("PADDLE_TPU_SLO_TTFT_P99_S", "0.01")
+        monkeypatch.setenv("PADDLE_TPU_SLO_MIN_SAMPLES", "2")
+        monkeypatch.setenv("PADDLE_TPU_SLO_WINDOW", "8")
+        from paddle_tpu.inference.serving import ServingEngine
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                            name="slo_chaos")
+        assert eng.slo.targets == {"ttft": 0.01}
+        # every decode dispatch sleeps PADDLE_TPU_FAULT_DELAY: with
+        # max_batch=1 the queued requests' TTFT inherits the slowdown
+        fault.configure("serving.decode", times=64, kind="delay")
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(1, cfg.vocab_size, (6,)).tolist()
+                   for _ in range(3)]
+        reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        eng.run_until_idle()
+        outs = [r.result(timeout=30) for r in reqs]
+        assert all(len(o) == 4 for o in outs)  # tokens kept flowing
+        assert fault.default_injector().fired("serving.decode") > 0
+        evs = events.recent(kind="slo_breach")
+        assert len(evs) == 1, evs  # exactly ONE event for the excursion
+        ev = evs[0]
+        assert ev["model"] == "slo_chaos" and ev["signal"] == "ttft"
+        assert ev["quantile"] == "p99" and ev["value"] > 0.01
+        assert eng.slo.status() == "breach:ttft"
+        assert eng.slo.snapshot()["breached"]["ttft"]["target"] == 0.01
+        # recovery: healthy samples flush the 8-deep window -> re-arm,
+        # still only one event
+        fault.reset()
+        for _ in range(8):
+            eng.slo.observe("ttft", 0.001)
+        assert eng.slo.status() == "ok"
+        assert eng.slo.stats["recoveries"] == 1
+        assert len(events.recent(kind="slo_breach")) == 1
+        # a second excursion alerts again
+        for _ in range(8):
+            eng.slo.observe("ttft", 1.0)
+        assert len(events.recent(kind="slo_breach")) == 2
+
+    def test_engine_feeds_all_four_signals(self):
+        from paddle_tpu.inference.serving import ServingEngine
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="slo_feed")
+        reqs = [eng.submit(list(range(1, 9)), max_new_tokens=4)
+                for _ in range(2)]
+        eng.run_until_idle()
+        for r in reqs:
+            r.result(timeout=10)
+        sig = eng.slo.snapshot()["signals"]
+        for s in ("ttft", "tpot", "queue_wait", "e2e"):
+            assert sig[s]["count"] >= 2, s
+            assert sig[s]["p50"] <= sig[s]["p95"] <= sig[s]["p99"], s

@@ -1630,3 +1630,298 @@ class TestProgramAuditGate:
         assert "donation/undonated-large-input" in out
         assert "GPT#1[train_step]" in out and "donate it" in out
         assert "retrace" not in out  # filtered to analysis kinds
+
+
+class TestReqTraceAndSLOGate:
+    """`reqtrace`/`slo` observability blocks + `slo_*` metric families:
+    the bench gate's request-trace and SLO-window shape contracts."""
+
+    @staticmethod
+    def _trace(**over):
+        t = {"trace_id": 5, "rid": 3, "model": "gpt",
+             "state": "complete", "finish_reason": "eos",
+             "preemptions": 1, "decode_iterations": 6,
+             "decode_tokens": 6, "shared_tokens": 0, "e2e_s": 0.5,
+             "phases": {"queued": 0.1, "prefill": 0.1, "decode": 0.25,
+                        "preempted": 0.05},
+             "spans": [
+                 {"phase": "queued", "start": 0.0, "end": 0.1},
+                 {"phase": "prefill", "start": 0.1, "end": 0.15,
+                  "bucket": 16, "prompt_tokens": 9},
+                 {"phase": "preempted", "start": 0.15, "end": 0.2},
+                 {"phase": "prefill", "start": 0.2, "end": 0.25,
+                  "bucket": 16, "prompt_tokens": 11, "requeue": True},
+                 {"phase": "decode", "start": 0.25, "end": 0.5,
+                  "bucket": 2, "path": "fused", "iters": 6},
+                 {"phase": "complete", "start": 0.5, "end": 0.5}]}
+        t.update(over)
+        return t
+
+    def _reqtrace(self, **over):
+        rt = {"enabled": True, "model": "gpt", "live": [],
+              "completed": [self._trace()], "ring_size": 256,
+              "decode_every": 8}
+        rt.update(over)
+        return rt
+
+    @staticmethod
+    def _slo(**over):
+        s = {"enabled": True, "model": "gpt", "window": 512,
+             "min_samples": 8, "targets": {"ttft": 0.5},
+             "signals": {
+                 "ttft": {"count": 10, "p50": 0.1, "p95": 0.2,
+                          "p99": 0.3},
+                 "tpot": {"count": 0, "p50": None, "p95": None,
+                          "p99": None}},
+             "breached": {}, "status": "ok",
+             "stats": {"breaches": 1, "recoveries": 1,
+                       "observations": 40}}
+        s.update(over)
+        return s
+
+    @staticmethod
+    def _doc(reqtrace=None, slo=None, metrics=None):
+        obs = {}
+        if reqtrace is not None:
+            obs["reqtrace"] = reqtrace
+        if slo is not None:
+            obs["slo"] = slo
+        if metrics is not None:
+            obs["metrics"] = metrics
+        return {"observability": obs}
+
+    def test_valid_blocks_pass(self):
+        assert gate.validate_observability(self._doc(
+            reqtrace=self._reqtrace(), slo=self._slo())) == []
+
+    def test_live_engine_payloads_validate(self):
+        """The gate accepts what the engine actually serves: run a tiny
+        engine and pipe its /requests + /slo payloads straight in."""
+        import tempfile
+        from paddle_tpu.framework import flags as flags_mod
+        import paddle_tpu as paddle
+        from paddle_tpu.inference.serving import ServingEngine
+        from paddle_tpu.models.gpt import GPT, GPTConfig
+        cache = os.path.join(tempfile.gettempdir(), "pt_serving_ccache")
+        os.makedirs(cache, exist_ok=True)
+        flags_mod.set_flags({"FLAGS_compile_cache_dir": cache})
+        try:
+            paddle.seed(0)
+            cfg = GPTConfig(vocab_size=512, max_position_embeddings=128,
+                            hidden_size=32, num_layers=2, num_heads=2,
+                            dropout=0.0, attn_dropout=0.0)
+            m = GPT(cfg)
+            m.eval()
+            eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                                name="gate_live")
+            req = eng.submit(list(range(1, 9)), max_new_tokens=3)
+            eng.run_until_idle()
+            req.result(timeout=10)
+            doc = self._doc(reqtrace=eng.requests_snapshot(),
+                            slo=eng.slo.snapshot())
+            assert gate.validate_observability(doc) == []
+        finally:
+            flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
+
+    def test_bad_trace_ids_phase_and_span_named(self):
+        t = self._trace(trace_id=0, e2e_s=float("inf"))
+        t["phases"]["warmup"] = 0.1
+        t["spans"].append({"phase": "decode", "start": 2.0, "end": 1.0})
+        probs = gate.validate_observability(self._doc(
+            reqtrace=self._reqtrace(completed=[t])))
+        text = "\n".join(probs)
+        assert "trace_id" in text
+        assert "e2e_s" in text
+        assert "warmup" in text and "unknown phase" in text
+        assert "end 1.0 < start 2.0" in text
+
+    def test_non_monotone_quantiles_named(self):
+        s = self._slo()
+        s["signals"]["ttft"]["p95"] = 0.05  # p50 0.1 > p95
+        probs = gate.validate_observability(self._doc(slo=s))
+        assert any("not monotone" in p for p in probs)
+
+    def test_nonfinite_quantile_and_negative_stats_named(self):
+        s = self._slo()
+        s["signals"]["ttft"]["p99"] = float("nan")
+        s["stats"]["breaches"] = -1
+        probs = gate.validate_observability(self._doc(slo=s))
+        text = "\n".join(probs)
+        assert "finite non-negative" in text
+        assert "stats.breaches" in text
+
+    def test_unknown_slo_family_and_wrong_kind_named(self):
+        metrics = {
+            "slo_breach_count": {"kind": "counter", "values": []},
+            "slo_breached": {"kind": "counter", "values": []},
+            "slo_breaches_total": {
+                "kind": "counter",
+                "values": [{"labels": {"model": "gpt"}, "value": 1}]},
+        }
+        probs = gate.validate_observability(self._doc(metrics=metrics))
+        text = "\n".join(probs)
+        assert "slo_breach_count: unknown slo family" in text
+        assert "slo_breached: kind" in text and "expected gauge" in text
+        assert "missing the 'signal' label" in text
+
+    def test_error_blocks_report_themselves(self):
+        assert gate.validate_observability(self._doc(
+            reqtrace={"error": "probe failed"},
+            slo={"error": "probe failed"})) == []
+
+    def test_queue_wait_percentiles_in_decode_block(self):
+        cfg = {"tokens_per_sec_chip": 50.0,
+               "serving": {"ttft_s": {"p50": 0.1, "p99": 0.2},
+                           "tpot_s": {"p50": 0.01, "p99": 0.02},
+                           "queue_wait_s": {"p50": 0.05, "p99": 0.4}}}
+        assert gate.validate_observability(
+            {"configs": {"gpt2_decode": cfg}}) == []
+        cfg["serving"]["queue_wait_s"]["p99"] = -0.4
+        probs = gate.validate_observability(
+            {"configs": {"gpt2_decode": cfg}})
+        assert any("queue_wait_s" in p for p in probs)
+
+
+class TestObsTailSLO:
+    """--slo: the serving SLO plane view (breach excursions + completed
+    request traces) with kind-filter composition."""
+
+    @staticmethod
+    def _breach_event():
+        return {"ts": 1722700000.0, "kind": "slo_breach", "host": "t0",
+                "severity": "warn", "model": "gpt", "signal": "ttft",
+                "quantile": "p99", "value": 0.82, "target": 0.5,
+                "window": 24}
+
+    @staticmethod
+    def _trace_event():
+        return {"ts": 1722700001.0, "kind": "request_trace",
+                "host": "t0", "severity": "info", "trace_id": 9,
+                "rid": 4, "model": "gpt", "finish_reason": "eos",
+                "preemptions": 1, "decode_tokens": 16, "e2e_s": 1.25,
+                "phases": {"queued": 0.2, "prefill": 0.15,
+                           "decode": 0.85, "preempted": 0.05}}
+
+    def test_slo_filters_and_renders(self, tmp_path, capsys):
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(self._breach_event()) + "\n")
+            f.write(json.dumps(self._trace_event()) + "\n")
+            f.write(json.dumps({"ts": 1.0, "kind": "retrace",
+                                "host": "t0"}) + "\n")
+        rc = obs_tail.main([str(path), "--slo"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ttft p99=820.0ms breached target 500.0ms" in out
+        assert "over 24 sample(s)" in out
+        assert "re-arms on recovery" in out
+        assert "trace 9 request 4 eos e2e 1250.0ms" in out
+        assert "preemptions=1" in out
+        assert "decode=850.0ms" in out
+        assert "retrace" not in out  # --slo implies the kind filter
+
+    def test_slo_composes_with_explicit_kind(self, tmp_path, capsys):
+        import obs_tail
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(self._breach_event()) + "\n")
+            f.write(json.dumps({"ts": 2.0, "kind": "retrace",
+                                "host": "t0"}) + "\n")
+            f.write(json.dumps({"ts": 3.0, "kind": "xla_compile",
+                                "host": "t0"}) + "\n")
+        rc = obs_tail.main([str(path), "--slo", "--kind", "retrace"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "slo_breach" in out and "retrace" in out
+        assert "xla_compile" not in out
+
+
+class TestMetricsDumpRequests:
+    """--requests: per-request phase breakdowns from a bench artifact,
+    a /requests payload file, or the live endpoint."""
+
+    @staticmethod
+    def _payload():
+        return {
+            "enabled": True, "model": "gpt", "ring_size": 256,
+            "decode_every": 8,
+            "live": [{"trace_id": 7, "rid": 5, "state": "running",
+                      "preemptions": 0, "decode_tokens": 3,
+                      "phases": {"queued": 0.01, "prefill": 0.04}}],
+            "completed": [{"trace_id": 6, "rid": 4,
+                           "finish_reason": "eos", "preemptions": 2,
+                           "decode_tokens": 8, "e2e_s": 0.9,
+                           "phases": {"queued": 0.1, "prefill": 0.2,
+                                      "decode": 0.55,
+                                      "preempted": 0.05}}],
+            "introspection": [
+                {"iteration": 41, "active": 3, "lanes": 4,
+                 "occupancy": 3, "queue_depth": 2, "free_pages": 11,
+                 "used_pages": 20, "cow_shared_pages": 5,
+                 "decode_mode": "fused"}],
+        }
+
+    def test_requests_view_from_payload_file(self, tmp_path, capsys):
+        import metrics_dump
+        path = tmp_path / "requests.json"
+        path.write_text(json.dumps(self._payload()))
+        rc = metrics_dump.main([str(path), "--requests"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "request traces (model gpt, tracer on)" in out
+        assert "LIVE trace    7 request    5" in out
+        assert "DONE trace    6 request    4 eos" in out
+        assert "preempt=2" in out and "e2e=900.0ms" in out
+        assert "decode=550.0ms" in out
+        assert "pages free/used/shared=11/20/5" in out
+
+    def test_requests_view_from_bench_observability(self, tmp_path,
+                                                    capsys):
+        import metrics_dump
+        path = tmp_path / "bench.json"
+        path.write_text(json.dumps(
+            {"observability": {"reqtrace": self._payload()}}))
+        rc = metrics_dump.main([str(path), "--requests"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "DONE trace    6" in out
+
+    def test_requests_view_without_traces_reports_it(self, tmp_path,
+                                                     capsys):
+        import metrics_dump
+        path = tmp_path / "bench.json"
+        path.write_text(json.dumps(
+            {"observability": {"reqtrace": {
+                "enabled": True, "model": "gpt", "live": [],
+                "completed": []}}}))
+        rc = metrics_dump.main([str(path), "--requests"])
+        assert rc == 0
+        assert "(no traces recorded)" in capsys.readouterr().out
+
+    def test_requests_view_from_live_endpoint(self, capsys):
+        from paddle_tpu.profiler.server import ObservabilityServer
+        import metrics_dump
+        import urllib.request  # noqa: F401  (exercised inside the tool)
+        payload = self._payload()
+
+        class _Stub:
+            @staticmethod
+            def requests_snapshot(n=50):
+                return payload
+        srv = ObservabilityServer()
+        srv.start(0)
+        try:
+            import paddle_tpu.profiler.server as server_mod
+            orig = server_mod.ObservabilityServer._engine
+            server_mod.ObservabilityServer._engine = staticmethod(
+                lambda name=None: _Stub())
+            try:
+                rc = metrics_dump.main(
+                    [f"http://127.0.0.1:{srv.port}/requests",
+                     "--requests"])
+            finally:
+                server_mod.ObservabilityServer._engine = orig
+        finally:
+            srv.stop()
+        out = capsys.readouterr().out
+        assert rc == 0 and "DONE trace    6" in out

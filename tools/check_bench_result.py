@@ -450,6 +450,18 @@ _SERVING_FAMILIES = {
     "serving_ttft_seconds": ("histogram", ("model",)),
     "serving_tpot_seconds": ("histogram", ("model",)),
     "serving_goodput_tokens_total": ("counter", ("model",)),
+    # request-scoped phase histograms (profiler/reqtrace.py)
+    "serving_queue_wait_seconds": ("histogram", ("model",)),
+    "serving_prefill_seconds": ("histogram", ("model",)),
+    "serving_preempt_requeue_seconds": ("histogram", ("model",)),
+}
+
+# serving SLO-plane families (profiler/slo.py): breach excursions and
+# the live window p99 per signal
+_SLO_FAMILIES = {
+    "slo_breaches_total": ("counter", ("model", "signal")),
+    "slo_breached": ("gauge", ("model", "signal")),
+    "slo_window_p99_seconds": ("gauge", ("model", "signal")),
 }
 
 #: legal decode-path label values on the serving latency histograms
@@ -512,6 +524,169 @@ def _validate_serving_metrics(where: str, metrics: dict) -> List[str]:
     return problems
 
 
+def _validate_slo_metrics(where: str, metrics: dict) -> List[str]:
+    """`slo_*` families must be the documented kind and carry the
+    model+signal labels; an unknown `slo_*` family is NAMED (a typo'd
+    breach counter silently passing is exactly what this gate exists to
+    catch)."""
+    problems = []
+    for name, fam in metrics.items():
+        if not name.startswith("slo_"):
+            continue
+        spec = _SLO_FAMILIES.get(name)
+        if spec is None:
+            problems.append(f"{where}.metrics.{name}: unknown slo family "
+                            f"(expected one of {sorted(_SLO_FAMILIES)})")
+            continue
+        kind, req_labels = spec
+        if not isinstance(fam, dict) or fam.get("kind") != kind:
+            problems.append(
+                f"{where}.metrics.{name}: kind "
+                f"{fam.get('kind') if isinstance(fam, dict) else fam!r}"
+                f", expected {kind}")
+            continue
+        for i, v in enumerate(fam.get("values") or []):
+            if not isinstance(v, dict):
+                problems.append(f"{where}.metrics.{name}[{i}] is not a "
+                                f"series object")
+                continue
+            if not _nonneg_num(v.get("value")):
+                problems.append(f"{where}.metrics.{name}[{i}]: value "
+                                f"{v.get('value')!r} is not a "
+                                f"non-negative number")
+            labels = v.get("labels") or {}
+            for lk in req_labels:
+                if lk not in labels:
+                    problems.append(f"{where}.metrics.{name}[{i}]: series "
+                                    f"missing the {lk!r} label")
+    return problems
+
+
+def _finite_nonneg(v) -> bool:
+    return _nonneg_num(v) and v != float("inf")
+
+
+_TRACE_PHASES = ("queued", "prefill", "decode", "preempted", "complete",
+                 "failed")
+
+
+def _validate_trace(where: str, t: dict) -> List[str]:
+    """One request-trace record: ids, non-negative per-phase durations
+    over the known phase names, spans with end >= start."""
+    problems = []
+    if not isinstance(t, dict):
+        return [f"{where} is not a trace object"]
+    for key in ("trace_id", "rid"):
+        v = t.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            problems.append(f"{where}.{key}: {v!r} is not a positive id")
+    for key in ("preemptions", "decode_iterations", "decode_tokens"):
+        if key in t and not _nonneg_num(t.get(key)):
+            problems.append(f"{where}.{key}: {t.get(key)!r} is not a "
+                            f"non-negative number")
+    e2e = t.get("e2e_s")
+    if e2e is not None and not _finite_nonneg(e2e):
+        problems.append(f"{where}.e2e_s: {e2e!r} is not finite "
+                        f"non-negative")
+    phases = t.get("phases")
+    if phases is not None:
+        if not isinstance(phases, dict):
+            problems.append(f"{where}.phases is not an object")
+        else:
+            for ph, dur in phases.items():
+                if ph not in _TRACE_PHASES:
+                    problems.append(f"{where}.phases.{ph}: unknown phase "
+                                    f"(expected one of {_TRACE_PHASES})")
+                if not _finite_nonneg(dur):
+                    problems.append(f"{where}.phases.{ph}: duration "
+                                    f"{dur!r} is not finite non-negative")
+    for i, s in enumerate(t.get("spans") or []):
+        if not isinstance(s, dict) or s.get("phase") not in _TRACE_PHASES:
+            problems.append(f"{where}.spans[{i}]: bad span/phase")
+            continue
+        start, end = s.get("start"), s.get("end")
+        if end is not None and isinstance(start, (int, float)) \
+                and isinstance(end, (int, float)) and end < start:
+            problems.append(f"{where}.spans[{i}]: end {end} < start "
+                            f"{start}")
+    return problems
+
+
+def _validate_reqtrace_block(where: str, rt: dict) -> List[str]:
+    """The bench `observability.reqtrace` block / `/requests` payload:
+    live + completed trace lists, each conforming to the trace shape."""
+    if not isinstance(rt, dict):
+        return [f"{where} is not an object"]
+    if "error" in rt:
+        return []  # a failed probe reports itself
+    problems = []
+    for key in ("live", "completed"):
+        lst = rt.get(key)
+        if lst is None:
+            continue
+        if not isinstance(lst, list):
+            problems.append(f"{where}.{key} is not a list")
+            continue
+        for i, t in enumerate(lst):
+            problems.extend(_validate_trace(f"{where}.{key}[{i}]", t))
+    return problems
+
+
+def _validate_slo_block(where: str, s: dict) -> List[str]:
+    """The bench `observability.slo` block / `/slo` payload: per-signal
+    window quantiles finite and monotone (p50 <= p95 <= p99), breach
+    counts non-negative."""
+    if not isinstance(s, dict):
+        return [f"{where} is not an object"]
+    if "error" in s:
+        return []  # a failed probe reports itself
+    problems = []
+    targets = s.get("targets")
+    if targets is not None and not isinstance(targets, dict):
+        problems.append(f"{where}.targets is not an object")
+    elif targets:
+        for sig, t in targets.items():
+            if not _finite_nonneg(t):
+                problems.append(f"{where}.targets.{sig}: {t!r} is not "
+                                f"finite non-negative")
+    signals = s.get("signals")
+    if signals is not None:
+        if not isinstance(signals, dict):
+            problems.append(f"{where}.signals is not an object")
+        else:
+            for sig, qs in signals.items():
+                w = f"{where}.signals.{sig}"
+                if not isinstance(qs, dict):
+                    problems.append(f"{w} is not an object")
+                    continue
+                if not _nonneg_num(qs.get("count")):
+                    problems.append(f"{w}.count: {qs.get('count')!r} is "
+                                    f"not a non-negative number")
+                vals = [qs.get(q) for q in ("p50", "p95", "p99")]
+                if any(v is not None for v in vals):
+                    if not all(_finite_nonneg(v) for v in vals):
+                        problems.append(f"{w}: quantiles {vals!r} must "
+                                        f"all be finite non-negative")
+                    elif not (vals[0] <= vals[1] <= vals[2]):
+                        problems.append(f"{w}: quantiles not monotone "
+                                        f"(p50 {vals[0]} <= p95 {vals[1]} "
+                                        f"<= p99 {vals[2]} violated)")
+    stats = s.get("stats")
+    if stats is not None:
+        if not isinstance(stats, dict):
+            problems.append(f"{where}.stats is not an object")
+        else:
+            for key in ("breaches", "recoveries", "observations"):
+                if key in stats and not _nonneg_num(stats.get(key)):
+                    problems.append(f"{where}.stats.{key}: "
+                                    f"{stats.get(key)!r} is not a "
+                                    f"non-negative count")
+    breached = s.get("breached")
+    if breached is not None and not isinstance(breached, dict):
+        problems.append(f"{where}.breached is not an object")
+    return problems
+
+
 def _validate_decode_block(where: str, cfg: dict) -> List[str]:
     """The `gpt2_decode` bench config: serving percentiles (TTFT/TPOT),
     goodput fields, and the paged-vs-dense A/B rows — a decode round
@@ -538,6 +713,18 @@ def _validate_decode_block(where: str, cfg: dict) -> List[str]:
                         problems.append(f"{where}.serving.{fam}.{q} {v!r} "
                                         f"is not a non-negative number or "
                                         f"null")
+            qw = srv.get("queue_wait_s")  # optional (added with reqtrace)
+            if qw is not None:
+                if not isinstance(qw, dict):
+                    problems.append(f"{where}.serving.queue_wait_s is "
+                                    f"not an object")
+                else:
+                    for q in ("p50", "p99"):
+                        v = qw.get(q)
+                        if v is not None and not _nonneg_num(v):
+                            problems.append(
+                                f"{where}.serving.queue_wait_s.{q} {v!r} "
+                                f"is not a non-negative number or null")
             ws = srv.get("wall_s")
             if ws is not None and not _nonneg_num(ws):
                 problems.append(f"{where}.serving.wall_s {ws!r} is not a "
@@ -1040,7 +1227,10 @@ def validate_observability(doc: dict) -> List[str]:
     events additionally to the decision contract: policy/action/legal
     outcome/decision id), `checkpoint_async_*` / `device_memory_*` /
     `health_*` / `amp_*` / `autotune_*` / `controller_*` / `serving_*` /
-    `analysis_*` metric families to their kind/label/shape contracts,
+    `slo_*` / `analysis_*` metric families to their kind/label/shape
+    contracts, `reqtrace`/`slo` observability blocks to the request-trace
+    and SLO-window shapes (quantiles finite + monotone p50<=p95<=p99,
+    breach counts non-negative),
     per-config `program_audit` blocks to the static-auditor contract
     (severity counts, clean_high verdict, legal check/severity per
     finding), `gpt2_decode`
@@ -1087,7 +1277,15 @@ def validate_observability(doc: dict) -> List[str]:
             problems.extend(_validate_autotune_metrics(where, metrics))
             problems.extend(_validate_controller_metrics(where, metrics))
             problems.extend(_validate_serving_metrics(where, metrics))
+            problems.extend(_validate_slo_metrics(where, metrics))
             problems.extend(_validate_analysis_metrics(where, metrics))
+        rt = obs.get("reqtrace")
+        if rt is not None:
+            problems.extend(_validate_reqtrace_block(f"{where}.reqtrace",
+                                                     rt))
+        slo_blk = obs.get("slo")
+        if slo_blk is not None:
+            problems.extend(_validate_slo_block(f"{where}.slo", slo_blk))
         at = obs.get("autotune")
         if at is not None:
             problems.extend(_validate_autotune_block(f"{where}.autotune",

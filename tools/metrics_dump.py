@@ -16,6 +16,8 @@ CLI:
     python tools/metrics_dump.py --url http://host:9400/metrics
     python tools/metrics_dump.py --url http://host:9400/snapshot --filter heter
     python tools/metrics_dump.py BENCH_r16.json --serving
+    python tools/metrics_dump.py BENCH_r17.json --requests
+    python tools/metrics_dump.py --url http://host:9400/requests --requests
     python bench.py | python tools/metrics_dump.py -
 
 Exit code 0 on success, 2 on unusable input.
@@ -279,6 +281,80 @@ def format_serving(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def _extract_requests(doc) -> Optional[dict]:
+    """Find a request-trace payload (the /requests endpoint shape, also
+    emitted as bench observability.reqtrace) in any accepted document."""
+    if not isinstance(doc, dict):
+        return None
+    if "completed" in doc and "live" in doc:
+        return doc
+    obs = doc.get("observability")
+    if isinstance(obs, dict) and isinstance(obs.get("reqtrace"), dict):
+        return obs["reqtrace"]
+    if isinstance(doc.get("parsed"), dict):
+        rt = _extract_requests(doc["parsed"])
+        if rt is not None:
+            return rt
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return _extract_requests(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return None
+
+
+def _fmt_phase_ms(phases: dict) -> str:
+    parts = [f"{k}={1000 * v:.1f}ms"
+             for k, v in sorted(phases.items(), key=lambda kv: -kv[1])
+             if isinstance(v, (int, float)) and v > 0]
+    return " | ".join(parts) or "no phases"
+
+
+def format_requests(rt: dict) -> str:
+    """Per-request phase breakdown: live then recently-completed traces
+    (one line each: trace id, state, preemptions, e2e, phase costs),
+    plus the engine's latest introspection snapshot."""
+    lines = [f"request traces (model {rt.get('model', '?')}, "
+             f"tracer {'on' if rt.get('enabled', True) else 'OFF'})"]
+    for t in rt.get("live") or []:
+        phases = t.get("phases") or {}
+        lines.append(f"    LIVE trace {t.get('trace_id', '?'):>4} "
+                     f"request {t.get('rid', '?'):>4} "
+                     f"state={t.get('state', '?'):<8} "
+                     f"preempt={t.get('preemptions', 0)} "
+                     f"tokens={t.get('decode_tokens', 0)}  "
+                     f"[{_fmt_phase_ms(phases)}]")
+    for t in rt.get("completed") or []:
+        e2e = t.get("e2e_s")
+        e2e_s = f"{1000 * e2e:.1f}ms" if isinstance(e2e, (int, float)) \
+            else "?"
+        lines.append(f"    DONE trace {t.get('trace_id', '?'):>4} "
+                     f"request {t.get('rid', '?'):>4} "
+                     f"{t.get('finish_reason', '?'):<8} "
+                     f"preempt={t.get('preemptions', 0)} "
+                     f"tokens={t.get('decode_tokens', 0)} "
+                     f"e2e={e2e_s}  [{_fmt_phase_ms(t.get('phases') or {})}]")
+    intro = rt.get("introspection") or []
+    if intro:
+        last = intro[-1]
+        lines.append(f"    engine @ iteration {last.get('iteration', '?')}: "
+                     f"active={last.get('active', '?')} "
+                     f"lanes={last.get('lanes', '?')} "
+                     f"queue={last.get('queue_depth', '?')} "
+                     f"pages free/used/shared="
+                     f"{last.get('free_pages', '?')}/"
+                     f"{last.get('used_pages', '?')}/"
+                     f"{last.get('cow_shared_pages', '?')} "
+                     f"({len(intro)} snapshot(s))")
+    if len(lines) == 1:
+        lines.append("    (no traces recorded)")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", default=None,
@@ -296,6 +372,10 @@ def main(argv=None) -> int:
     ap.add_argument("--serving", action="store_true",
                     help="serving SLO summary: queue/occupancy/goodput plus "
                          "TTFT/TPOT quantiles per decode path (fused|eager)")
+    ap.add_argument("--requests", action="store_true",
+                    help="per-request trace view: live + recently-completed "
+                         "request phase breakdowns (a /requests endpoint "
+                         "payload or bench observability.reqtrace block)")
     args = ap.parse_args(argv)
     url = args.url
     if url is None and args.path and args.path.startswith(("http://",
@@ -303,6 +383,22 @@ def main(argv=None) -> int:
         url = args.path
     if url is not None:
         try:
+            if args.requests:
+                # the /requests payload is not a metrics snapshot: fetch
+                # the raw JSON (accepts /requests itself or bench JSON)
+                import urllib.request
+                with urllib.request.urlopen(url, timeout=10.0) as r:
+                    doc = json.loads(r.read().decode())
+                rt = _extract_requests(doc)
+                if rt is None:
+                    print(f"metrics_dump: no request traces in the {url} "
+                          f"response (expected the /requests endpoint or "
+                          f"bench JSON with observability.reqtrace)",
+                          file=sys.stderr)
+                    return 2
+                print(json.dumps(rt, indent=2, sort_keys=True)
+                      if args.json else format_requests(rt))
+                return 0
             snap = fetch_url(url)
         except Exception as e:
             print(f"metrics_dump: cannot fetch {url}: "
@@ -333,6 +429,16 @@ def main(argv=None) -> int:
             break
         except json.JSONDecodeError:
             continue
+    if args.requests:
+        rt = _extract_requests(doc) if doc is not None else None
+        if rt is None:
+            print("metrics_dump: no request traces found in input "
+                  "(expected a /requests payload or bench JSON with "
+                  "observability.reqtrace)", file=sys.stderr)
+            return 2
+        print(json.dumps(rt, indent=2, sort_keys=True)
+              if args.json else format_requests(rt))
+        return 0
     snap = _extract_snapshot(doc) if doc is not None else None
     if snap is None:
         print("metrics_dump: no metrics snapshot found in input "

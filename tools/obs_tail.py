@@ -16,6 +16,7 @@ severity + kind-specific payload). This renders that stream for operators:
     python tools/obs_tail.py events.jsonl --health         # numerics plane
     python tools/obs_tail.py events.jsonl --controller     # fleet decisions
     python tools/obs_tail.py events.jsonl --serving        # request lifecycle
+    python tools/obs_tail.py events.jsonl --slo            # SLO plane
     python tools/obs_tail.py events.jsonl --analysis       # auditor findings
     cat events.jsonl | python tools/obs_tail.py -
 
@@ -26,7 +27,9 @@ attribution, health_alert divergence signals, health_rollback responses,
 fleet_health) in an operator-oriented line format; `--serving` renders
 the continuous-batching request lifecycle (serving_admission /
 serving_eviction: slot, bucket, queue wait, eviction reason, free
-pages); `--analysis` renders static program-auditor findings
+pages); `--slo` renders the serving SLO plane (slo_breach excursions —
+signal, window quantile vs target — and request_trace per-request phase
+breakdowns); `--analysis` renders static program-auditor findings
 (analysis_finding: program, check/code, offending param + scope, fix
 hint); `--follow-for N`
 bounds a live tail to N seconds (scripting/CI). A sink rotated by
@@ -66,6 +69,8 @@ except Exception:
                     "fleet_health")
 
 SERVING_KINDS = ("serving_admission", "serving_eviction")
+
+SLO_KINDS = ("slo_breach", "request_trace")
 
 ANALYSIS_KINDS = ("analysis_finding",)
 
@@ -272,6 +277,48 @@ def format_serving(rec: dict) -> str:
             f"{rec.get('host', '?'):<16} {detail}")
 
 
+def format_slo(rec: dict) -> str:
+    """One SLO-plane event as an operator line: which signal left (or
+    which request finished under) what latency budget."""
+    ts = rec.get("ts")
+    try:
+        when = datetime.fromtimestamp(float(ts)).strftime("%H:%M:%S.%f")[:-3]
+    except (TypeError, ValueError, OSError):
+        when = "??:??:??.???"
+    kind = rec.get("kind", "?")
+    if kind == "slo_breach":
+        val = rec.get("value")
+        tgt = rec.get("target")
+        val_s = f"{1000 * val:.1f}ms" if isinstance(val, (int, float)) \
+            else "?"
+        tgt_s = f"{1000 * tgt:.1f}ms" if isinstance(tgt, (int, float)) \
+            else "?"
+        detail = (f"{rec.get('signal', '?')} "
+                  f"{rec.get('quantile', 'p99')}={val_s} breached target "
+                  f"{tgt_s} over {rec.get('window', '?')} sample(s) "
+                  f"(model {rec.get('model', '?')}; one event per "
+                  f"excursion, re-arms on recovery)")
+    elif kind == "request_trace":
+        phases = rec.get("phases") or {}
+        parts = " | ".join(
+            f"{k}={1000 * v:.1f}ms"
+            for k, v in sorted(phases.items(), key=lambda kv: -kv[1])
+            if isinstance(v, (int, float)) and v > 0) or "no phases"
+        e2e = rec.get("e2e_s")
+        e2e_s = f"{1000 * e2e:.1f}ms" if isinstance(e2e, (int, float)) \
+            else "?"
+        detail = (f"trace {rec.get('trace_id', '?')} request "
+                  f"{rec.get('rid', '?')} {rec.get('finish_reason', '?')} "
+                  f"e2e {e2e_s}")
+        if rec.get("preemptions"):
+            detail += f" preemptions={rec['preemptions']}"
+        detail += f"  [{parts}]"
+    else:
+        return format_event(rec)
+    return (f"{when} {rec.get('severity', 'info'):<5} {kind:<20} "
+            f"{rec.get('host', '?'):<16} {detail}")
+
+
 def format_analysis(rec: dict) -> str:
     """One analysis_finding event as an operator line: which program,
     which check fired, where, and the fix hint."""
@@ -296,7 +343,8 @@ def format_analysis(rec: dict) -> str:
 
 def _emit(events, as_json: bool, out=None, diagnose: bool = False,
           health: bool = False, controller: bool = False,
-          serving: bool = False, analysis: bool = False):
+          serving: bool = False, analysis: bool = False,
+          slo: bool = False):
     out = out if out is not None else sys.stdout  # resolve at call time
     for rec in events:
         if as_json:
@@ -311,6 +359,8 @@ def _emit(events, as_json: bool, out=None, diagnose: bool = False,
             line = format_serving(rec)
         elif analysis and rec.get("kind") in ANALYSIS_KINDS:
             line = format_analysis(rec)
+        elif slo and rec.get("kind") in SLO_KINDS:
+            line = format_slo(rec)
         else:
             line = format_event(rec)
         out.write(line + "\n")
@@ -328,6 +378,7 @@ def follow(path: str, args, poll_s: float = 0.5,
     controller = getattr(args, "controller", False)
     serving = getattr(args, "serving", False)
     analysis = getattr(args, "analysis", False)
+    slo = getattr(args, "slo", False)
     # open the live file FIRST and read the backlog through the same
     # handle: reading a snapshot and then seeking a fresh handle to EOF
     # would silently drop events appended in between
@@ -346,7 +397,7 @@ def follow(path: str, args, poll_s: float = 0.5,
                                args.min_severity, args.since_ts)]
     _emit(window[-args.n:] if args.n else window, args.json,
           diagnose=diagnose, health=health, controller=controller,
-          serving=serving, analysis=analysis)
+          serving=serving, analysis=analysis, slo=slo)
     try:
         while True:
             if max_s is not None and time.monotonic() - t0 >= max_s:
@@ -370,7 +421,7 @@ def follow(path: str, args, poll_s: float = 0.5,
                                     args.min_severity, args.since_ts)],
                   args.json, diagnose=diagnose, health=health,
                   controller=controller, serving=serving,
-                  analysis=analysis)
+                  analysis=analysis, slo=slo)
     except KeyboardInterrupt:
         return 0
     finally:
@@ -416,6 +467,12 @@ def main(argv=None) -> int:
                          "bucket, queue wait, eviction reason, free "
                          "pages) with an operator-oriented rendering; "
                          "filters to those kinds unless --kind is given")
+    ap.add_argument("--slo", action="store_true",
+                    help="show the serving SLO plane (slo_breach: signal, "
+                         "window quantile vs target; request_trace: "
+                         "per-request phase breakdown) with an "
+                         "operator-oriented rendering; filters to those "
+                         "kinds unless --kind is given")
     ap.add_argument("--analysis", action="store_true",
                     help="show static program-auditor findings "
                          "(analysis_finding: program, check, offending "
@@ -451,6 +508,13 @@ def main(argv=None) -> int:
             args.kind = args.kind + SERVING_KINDS
         else:
             args.kind = (args.kind,) + SERVING_KINDS
+    if args.slo:
+        if args.kind is None:
+            args.kind = SLO_KINDS
+        elif isinstance(args.kind, tuple):
+            args.kind = args.kind + SLO_KINDS
+        else:
+            args.kind = (args.kind,) + SLO_KINDS
     if args.analysis:
         if args.kind is None:
             args.kind = ANALYSIS_KINDS
@@ -496,7 +560,7 @@ def main(argv=None) -> int:
     _emit(matching[-args.n:] if args.n else matching, args.json,
           diagnose=args.diagnose, health=args.health,
           controller=args.controller, serving=args.serving,
-          analysis=args.analysis)
+          analysis=args.analysis, slo=args.slo)
     return 0
 
 

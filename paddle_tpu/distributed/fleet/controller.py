@@ -49,12 +49,28 @@ An unreachable store or failed publish degrades to a logged
 ``controller_decision{outcome="failed"}`` + warning — never an exception
 out of the supervisor.
 
+The controller also runs the SERVING resilience policies over every
+live in-process :class:`~paddle_tpu.inference.serving.ServingEngine`
+(the same observe→diagnose→act loop, actuated locally instead of via
+the command bus): shed/queue-cap on sustained TTFT / queue-wait SLO
+breach, watchdog restart of a wedged decode loop (in-flight requests
+requeue through the preemption path), and post-hot-swap canary/SLO
+rollback with a max-rollbacks→halt breaker (inference/hotswap.py).
+Multi-straggler handling: up to ``world_size - min_world`` hosts may be
+held evicted simultaneously, each confirmed by its own debounced
+streak, readmitted independently.
+
 Knobs: ``PADDLE_TPU_CONTROLLER_CONFIRM_WINDOWS`` (default 3),
 ``PADDLE_TPU_CONTROLLER_READMIT_SEC`` (default 30),
 ``PADDLE_TPU_CONTROLLER_POLL_SEC`` (supervisor command-poll + aggregator
 poll cadence, default 1.0), ``PADDLE_TPU_CONTROLLER_MIN_WORLD``
 (default 1), ``PADDLE_TPU_CONTROLLER_ROLLBACK_COOLDOWN_SEC``
-(default 60).
+(default 60), ``PADDLE_TPU_CONTROLLER_SLO_WINDOWS`` (default 3),
+``PADDLE_TPU_CONTROLLER_WEDGE_WINDOWS`` (default 2),
+``PADDLE_TPU_CONTROLLER_RESTART_COOLDOWN_SEC`` (default 30),
+``PADDLE_TPU_CONTROLLER_MAX_SWAP_ROLLBACKS`` (default 2),
+``PADDLE_TPU_CONTROLLER_SWAP_OBSERVE_SEC`` (default 60),
+``PADDLE_TPU_SERVING_SHED_QUEUE_CAP`` (default 8).
 """
 from __future__ import annotations
 
@@ -89,8 +105,9 @@ _REG = _metrics_mod.default_registry()
 _M_DECISIONS = _REG.counter(
     "controller_decisions_total",
     "fleet-controller decisions, by policy (straggler_evict / "
-    "straggler_skip / readmit / health_rollback) and outcome (applied / "
-    "dry_run / failed)")
+    "straggler_skip / readmit / health_rollback / serving_shed / "
+    "serving_restart / serving_swap_rollback / serving_swap_halt) and "
+    "outcome (applied / dry_run / failed)")
 _M_EVICTIONS = _REG.counter(
     "controller_evictions_total",
     "straggler evictions the controller actually published, by host")
@@ -281,7 +298,14 @@ class FleetController:
                  readmit_after_s: Optional[float] = None,
                  rollback_cooldown_s: Optional[float] = None,
                  min_world: Optional[int] = None,
-                 prewarm_cache_dir: Optional[str] = None):
+                 prewarm_cache_dir: Optional[str] = None,
+                 slo_windows: Optional[int] = None,
+                 wedge_windows: Optional[int] = None,
+                 restart_cooldown_s: Optional[float] = None,
+                 max_swap_rollbacks: Optional[int] = None,
+                 swap_observe_s: Optional[float] = None,
+                 shed_queue_cap: Optional[int] = None,
+                 serving_provider: Optional[Callable] = None):
         self.aggregator = aggregator
         self.bus = bus
         self.world_size = int(world_size)
@@ -305,6 +329,34 @@ class FleetController:
             prewarm_cache_dir = os.environ.get(
                 "PADDLE_TPU_COMPILE_CACHE_DIR") or None
         self.prewarm_cache_dir = prewarm_cache_dir
+        # serving-policy knobs (the serving resilience plane)
+        if slo_windows is None:
+            slo_windows = int(_env_float(
+                "PADDLE_TPU_CONTROLLER_SLO_WINDOWS", 3))
+        self.slo_windows = max(int(slo_windows), 1)
+        if wedge_windows is None:
+            wedge_windows = int(_env_float(
+                "PADDLE_TPU_CONTROLLER_WEDGE_WINDOWS", 2))
+        self.wedge_windows = max(int(wedge_windows), 1)
+        if restart_cooldown_s is None:
+            restart_cooldown_s = _env_float(
+                "PADDLE_TPU_CONTROLLER_RESTART_COOLDOWN_SEC", 30.0)
+        self.restart_cooldown_s = float(restart_cooldown_s)
+        if max_swap_rollbacks is None:
+            max_swap_rollbacks = int(_env_float(
+                "PADDLE_TPU_CONTROLLER_MAX_SWAP_ROLLBACKS", 2))
+        self.max_swap_rollbacks = max(int(max_swap_rollbacks), 1)
+        if swap_observe_s is None:
+            swap_observe_s = _env_float(
+                "PADDLE_TPU_CONTROLLER_SWAP_OBSERVE_SEC", 60.0)
+        self.swap_observe_s = float(swap_observe_s)
+        if shed_queue_cap is None:
+            shed_queue_cap = int(_env_float(
+                "PADDLE_TPU_SERVING_SHED_QUEUE_CAP", 8))
+        self.shed_queue_cap = max(int(shed_queue_cap), 1)
+        #: engine source override (tests); default: the in-process
+        #: serving registry, looked up lazily and without importing it
+        self.serving_provider = serving_provider
 
         self._lock = threading.Lock()
         #: serializes whole ticks so _act may release _lock around the
@@ -324,15 +376,25 @@ class FleetController:
         self._suppressed: set = set()
         #: host -> rank assignment of the FULL fleet (learned from digests)
         self._assignment: Dict[str, int] = {}
-        #: the one evicted host (None = fleet at full strength):
-        #: {"host", "ts", "decision"}
-        self._evicted: Optional[dict] = None
+        #: evicted hosts (empty = fleet at full strength):
+        #: host -> {"host", "ts", "decision"}. Up to
+        #: world_size - min_world hosts may be held at once (the
+        #: N-quorum multi-straggler bound); each eviction still needs
+        #: its own confirmed streak
+        self._evicted: Dict[str, dict] = {}
         #: host -> (last probation-beat value, local monotonic ts when it
         #: last CHANGED) — freshness on OUR clock, immune to cross-host
         #: wall-clock skew
         self._ready_obs: Dict[str, tuple] = {}
         self._rollback_until = 0.0  # cooldown deadline
         self._rollback_suppressed: set = set()  # hosts already rolled back
+        # serving-policy state, keyed by engine/model name
+        self._srv_slo_streaks: Dict[str, int] = {}
+        self._srv_recover_streaks: Dict[str, int] = {}
+        self._srv_shed: set = set()
+        self._srv_wedge_streaks: Dict[str, int] = {}
+        self._srv_restart_after: Dict[str, float] = {}
+        self._srv_rollbacks: Dict[str, int] = {}
 
     # -- observation --------------------------------------------------------
     def on_collect(self, digests: Dict[int, dict]):
@@ -352,6 +414,7 @@ class FleetController:
             self._straggler_policy()
             self._health_policy(digests)
             self._readmit_policy()
+            self._serving_policy()
 
     def _learn_assignment(self, digests: Dict[int, dict]):
         """host -> rank map of the FULL fleet, learned from the digests
@@ -377,7 +440,7 @@ class FleetController:
                 self._streak_obs.pop(host, None)
                 self._suppressed.discard(host)
         for host in straggling:
-            if self._evicted and host == self._evicted["host"]:
+            if host in self._evicted:
                 continue  # its stale digest still reads slow while held
             # the debounce counts CONSECUTIVE collect windows of
             # evidence: the streak only advances when the host's digest
@@ -414,8 +477,10 @@ class FleetController:
             if d.get("diag_dominant") == "data_wait":
                 self._decide_skip(host, d)
                 continue
-            if self._evicted is not None:
-                continue  # one eviction at a time
+            # multi-straggler: up to world_size - min_world hosts may be
+            # held SIMULTANEOUSLY (two slow hosts both confirm, both
+            # evict — each on its own debounced streak); the quorum
+            # floor is the only cap
             if self.current_world() - 1 < self.min_world:
                 continue  # never shrink below the floor
             if len(self._assignment) < self.world_size:
@@ -438,7 +503,7 @@ class FleetController:
             evidence["step"] = d.get("step")
             evidence["diag_dominant"] = d.get("diag_dominant")
         new_np = self.current_world() - 1
-        ranks = self._dense_ranks(exclude=host)
+        ranks = self._dense_ranks(exclude=set(self._evicted) | {host})
         cmd = {"action": "evict", "host": host, "np": new_np,
                "ranks": ranks, "env": self._relaunch_env(extra={
                    # the survivors may shrink to world 1, where the
@@ -452,8 +517,8 @@ class FleetController:
             # straggler is never evicted until it transiently recovers
             self._suppressed.add(host)
         if rec["outcome"] == "applied":
-            self._evicted = {"host": host, "ts": time.time(),
-                             "decision": rec["id"]}
+            self._evicted[host] = {"host": host, "ts": time.time(),
+                                   "decision": rec["id"]}
             if _metrics_mod.enabled():
                 _M_EVICTIONS.inc(host=host)
 
@@ -498,14 +563,13 @@ class FleetController:
         host = bad[0]  # first (alphabetically stable) diverged host
         evidence = {"diverged": bad,
                     "step": (self._host_digest(host) or {}).get("step")}
-        # a rollback during an eviction covers the N-1 fleet: the held
-        # host stays out of the rank map (its supervisor consumes the
-        # command without acting) or a survivor would land on a rank >=
-        # np and wedge every relaunch
-        held = self._evicted["host"] if self._evicted else None
+        # a rollback during evictions covers the shrunken fleet: every
+        # held host stays out of the rank map (its supervisor consumes
+        # the command without acting) or a survivor would land on a rank
+        # >= np and wedge every relaunch
         cmd = {"action": "rollback", "host": host,
                "np": self.current_world(),
-               "ranks": self._dense_ranks(exclude=held),
+               "ranks": self._dense_ranks(exclude=set(self._evicted)),
                # every host resumes the newest fleet-committed step whose
                # weights are FINITE — the same one, by negotiation. The
                # valid-only knob is ONE-SHOT (env_once): it must not leak
@@ -524,66 +588,232 @@ class FleetController:
                 _M_ROLLBACKS.inc(host=host)
 
     def _readmit_policy(self):
-        if self._evicted is None or self.bus is None:
+        if not self._evicted or self.bus is None:
             return
         if len(self._assignment) < self.world_size:
             return  # cannot rebuild the full-N rank map yet
-        host = self._evicted["host"]
-        # observe the probation beat on EVERY tick, including during the
-        # hold window: freshness tracking must span the whole probation,
-        # or a supervisor that beat once and died mid-hold would read
-        # age=0 at the first post-window look and a dead host would be
-        # readmitted into the rank map (trainers then wedge in rendezvous
-        # on the missing rank with no policy able to recover)
+        # observe EVERY held host's probation beat on EVERY tick,
+        # including during the hold window: freshness tracking must span
+        # the whole probation, or a supervisor that beat once and died
+        # mid-hold would read age=0 at the first post-window look and a
+        # dead host would be readmitted into the rank map (trainers then
+        # wedge in rendezvous on the missing rank with no policy able to
+        # recover)
         now_local = time.monotonic()
-        # the probation read is a store RPC (up to the client timeout):
-        # run it OUTSIDE the status lock like _act's publish, so
-        # status()/the /controller endpoint never stalls behind a slow
-        # store — _tick_lock keeps a concurrent tick out of the window
-        self._lock.release()
+        for host in sorted(self._evicted):
+            # the probation read is a store RPC (up to the client
+            # timeout): run it OUTSIDE the status lock like _act's
+            # publish, so status()/the /controller endpoint never stalls
+            # behind a slow store — _tick_lock keeps a concurrent tick
+            # out of the window
+            self._lock.release()
+            try:
+                val = self.bus.ready_value(host)
+            finally:
+                self._lock.acquire()
+            if val is not None:
+                prev = self._ready_obs.get(host)
+                if prev is None or prev[0] != val:
+                    self._ready_obs[host] = (val, now_local)
+        for host in sorted(self._evicted):
+            held_for = time.time() - self._evicted[host]["ts"]
+            if held_for < self.readmit_after_s:
+                continue
+            # the probation heartbeat must be FRESH: freshness = the beat
+            # VALUE changed recently as observed on OUR clock — comparing
+            # the beater's embedded wall-clock timestamp to ours would
+            # let modest cross-host skew block readmission forever (or
+            # read a dead host's last beat as fresh)
+            obs = self._ready_obs.get(host)
+            if obs is None:
+                continue
+            age = now_local - obs[1]
+            if age > 3 * self._poll_interval() + 5.0:
+                continue
+            evidence = {"held_s": round(held_for, 3),
+                        "ready_age_s": round(age, 3),
+                        "evict_decision": self._evicted[host]["decision"]}
+            # the readmitted host rejoins whatever strength the fleet is
+            # at: full N (original assignment) once it is the last one
+            # held, a partial re-densified map while others stay out
+            remaining = set(self._evicted) - {host}
+            ranks = (self._dense_ranks(exclude=remaining) if remaining
+                     else dict(self._assignment))
+            cmd = {"action": "readmit", "host": host,
+                   "np": self.world_size - len(remaining),
+                   "ranks": ranks,
+                   "env": self._relaunch_env(extra={
+                       "PADDLE_TPU_FLEET_REPORTER": "1"})}
+            rec = self._act("straggler_readmit", evidence, cmd)
+            if rec["outcome"] == "applied":
+                self._evicted.pop(host, None)
+                self._ready_obs.pop(host, None)
+                if _metrics_mod.enabled():
+                    _M_READMISSIONS.inc(host=host)
+            return  # at most one readmission per tick (ledger ordering)
+
+    # -- serving policies (the resilience plane over live engines) ----------
+    def _serving_engines(self) -> list:
+        """The engines this controller watches: an injected provider
+        (tests / remote deployments) or the in-process serving registry,
+        looked up WITHOUT importing the serving stack — a trainer-only
+        controller must not pull jit/inference modules in."""
+        if self.serving_provider is not None:
+            return list(self.serving_provider())
+        import sys
+        mod = sys.modules.get("paddle_tpu.inference.serving")
+        if mod is None:
+            return []
         try:
-            val = self.bus.ready_value(host)
-        finally:
-            self._lock.acquire()
-        if val is not None:
-            prev = self._ready_obs.get(host)
-            if prev is None or prev[0] != val:
-                self._ready_obs[host] = (val, now_local)
-        held_for = time.time() - self._evicted["ts"]
-        if held_for < self.readmit_after_s:
+            return [e for e in mod.live_engines()]
+        except Exception:
+            return []
+
+    def _serving_policy(self):
+        for eng in self._serving_engines():
+            try:
+                self._serving_wedge_policy(eng)
+                self._serving_slo_policy(eng)
+                self._serving_swap_policy(eng)
+            except Exception as e:  # noqa: BLE001 — one engine's failure
+                warnings.warn(                # must not mute the others
+                    f"serving policy tick failed for engine "
+                    f"{getattr(eng, 'name', '?')!r}: "
+                    f"{type(e).__name__}: {e}")
+
+    def _serving_wedge_policy(self, eng):
+        """Liveness watchdog: an engine holding work without completing
+        a decode iteration for the stall window, confirmed over
+        `wedge_windows` consecutive ticks, is restarted — in-flight
+        requests requeue through the preemption path (trace ids
+        preserved), then the decode loop relaunches. Cooldown stops a
+        permanently-sick engine from restart-thrashing."""
+        name = eng.name
+        if not eng.wedged():
+            self._srv_wedge_streaks.pop(name, None)
             return
-        # the probation heartbeat must be FRESH: freshness = the beat
-        # VALUE changed recently as observed on OUR clock — comparing the
-        # beater's embedded wall-clock timestamp to ours would let modest
-        # cross-host skew block readmission forever (or read a dead
-        # host's last beat as fresh)
-        obs = self._ready_obs.get(host)
-        if obs is None:
+        n = self._srv_wedge_streaks.get(name, 0) + 1
+        self._srv_wedge_streaks[name] = n
+        if n < self.wedge_windows:
             return
-        age = now_local - obs[1]
-        if age > 3 * self._poll_interval() + 5.0:
+        now = time.time()
+        if now < self._srv_restart_after.get(name, 0.0):
             return
-        evidence = {"held_s": round(held_for, 3),
-                    "ready_age_s": round(age, 3),
-                    "evict_decision": self._evicted["decision"]}
-        cmd = {"action": "readmit", "host": host, "np": self.world_size,
-               "ranks": dict(self._assignment),
-               "env": self._relaunch_env(extra={
-                   "PADDLE_TPU_FLEET_REPORTER": "1"})}
-        rec = self._act("straggler_readmit", evidence, cmd)
-        if rec["outcome"] == "applied":
-            self._evicted = None
-            self._ready_obs.pop(host, None)
-            if _metrics_mod.enabled():
-                _M_READMISSIONS.inc(host=host)
+        evidence = {"windows": n,
+                    "stall_s": round(eng.last_progress_age(), 3),
+                    "queue_depth": eng.queue_depth()}
+        rec = self._act("serving_restart", evidence,
+                        {"action": "restart", "host": name, "model": name},
+                        local_fn=lambda: eng.restart(reason="wedged"))
+        if rec["outcome"] != "failed":
+            self._srv_restart_after[name] = now + self.restart_cooldown_s
+            self._srv_wedge_streaks.pop(name, None)
+
+    def _serving_slo_policy(self, eng):
+        """Shed on sustained admission-side SLO breach (ttft /
+        queue_wait — the signals a queue cap can actually relieve),
+        confirmed over `slo_windows` ticks like the straggler debounce;
+        un-shed after the same streak of clean windows."""
+        name = eng.name
+        try:
+            breached = sorted(eng.slo.breached())
+        except Exception:
+            breached = []
+        relevant = [s for s in breached if s in ("ttft", "queue_wait")]
+        if relevant:
+            self._srv_recover_streaks.pop(name, None)
+            n = self._srv_slo_streaks.get(name, 0) + 1
+            self._srv_slo_streaks[name] = n
+            if name in self._srv_shed or n < self.slo_windows:
+                return
+            cap = self.shed_queue_cap
+            rec = self._act(
+                "serving_shed",
+                {"windows": n, "breached": relevant,
+                 "queue_depth": eng.queue_depth()},
+                {"action": "shed", "host": name, "model": name,
+                 "queue_cap": cap},
+                local_fn=lambda: eng.set_queue_limit(cap))
+            if rec["outcome"] != "failed":
+                self._srv_shed.add(name)
+                self._srv_slo_streaks.pop(name, None)
+        else:
+            self._srv_slo_streaks.pop(name, None)
+            if name not in self._srv_shed:
+                return
+            n = self._srv_recover_streaks.get(name, 0) + 1
+            self._srv_recover_streaks[name] = n
+            if n < self.slo_windows:
+                return
+            rec = self._act(
+                "serving_shed", {"recovered_windows": n},
+                {"action": "unshed", "host": name, "model": name},
+                local_fn=lambda: eng.set_queue_limit(None))
+            if rec["outcome"] != "failed":
+                self._srv_shed.discard(name)
+                self._srv_recover_streaks.pop(name, None)
+
+    def _serving_swap_policy(self, eng):
+        """Post-swap watch: a hot-swapped checkpoint whose post-swap
+        canary regresses (or whose engine breaches SLO inside the
+        observe window) rolls back to the prior step; a swap that stays
+        healthy through the window is vetted. More than
+        `max_swap_rollbacks` rollbacks trips the breaker: one final
+        rollback, then the hot-swap manager halts entirely."""
+        mgr = getattr(eng, "hotswap", None)
+        if mgr is None or mgr.vetted or mgr.halted:
+            return
+        if mgr.swapped_ts is None:
+            return  # staged but not yet applied: nothing to judge
+        name = eng.name
+        age = time.time() - mgr.swapped_ts
+        reason, regress = None, None
+        try:
+            breached = sorted(eng.slo.breached())
+        except Exception:
+            breached = []
+        if breached:
+            reason = "slo:" + ",".join(breached)
+        else:
+            try:
+                regress = mgr.post_swap_regressed()
+            except Exception:
+                regress = None
+            if regress and regress.get("regressed"):
+                reason = "canary"
+        if reason is None:
+            if age > self.swap_observe_s:
+                mgr.vetted = True  # healthy through the whole window
+            return
+        n = self._srv_rollbacks.get(name, 0) + 1
+        self._srv_rollbacks[name] = n
+        evidence = {"reason": reason, "post_swap_age_s": round(age, 3),
+                    "step": mgr.current_step, "rollbacks": n}
+        if regress:
+            evidence["live_ppl"] = round(regress["live_ppl"], 4)
+            evidence["baseline_ppl"] = round(regress["baseline_ppl"], 4)
+        if n > self.max_swap_rollbacks:
+            def roll_and_halt():
+                mgr.rollback(reason=reason)
+                mgr.halt(reason="max_rollbacks")
+            self._act("serving_swap_halt", evidence,
+                      {"action": "swap_halt", "host": name, "model": name},
+                      local_fn=roll_and_halt)
+            return
+        self._act("serving_swap_rollback", evidence,
+                  {"action": "swap_rollback", "host": name, "model": name,
+                   "step": mgr.current_step},
+                  local_fn=lambda: mgr.rollback(reason=reason))
 
     # -- decision plumbing --------------------------------------------------
     def _act(self, policy: str, evidence: dict, cmd: dict,
-             publish: bool = True) -> dict:
-        """Record + event-log + (unless dry-run) publish one decision.
-        Publish failures degrade to outcome="failed" with a warning.
-        `publish=False` decisions (skip: the action is to do nothing)
-        are applied by construction and touch no store."""
+             publish: bool = True, local_fn=None) -> dict:
+        """Record + event-log + (unless dry-run) actuate one decision.
+        Three actuation shapes: publish to the command bus (the trainer
+        fleet), call `local_fn` directly (serving policies actuate the
+        in-process engine), or `publish=False` (skip: doing nothing IS
+        the applied action). Failures degrade to outcome="failed" with a
+        warning — never an exception out of the tick."""
         self._decision_seq += 1
         rec = {"id": self._decision_seq, "ts": time.time(),
                "policy": policy, "evidence": evidence,
@@ -592,7 +822,24 @@ class FleetController:
                "outcome": "dry_run", "cmd_id": None,
                "relaunch_to_first_step_s": None}
         if not self.dry_run:
-            if not publish:
+            if local_fn is not None:
+                # local actuation may be slow (an engine restart joins
+                # the decode loop): release the status lock around it,
+                # same as the store publish below
+                self._lock.release()
+                try:
+                    local_fn()
+                    rec["outcome"] = "applied"
+                except Exception as e:
+                    rec["outcome"] = "failed"
+                    rec["error"] = f"{type(e).__name__}: {e}"
+                    warnings.warn(
+                        f"fleet controller could not actuate "
+                        f"{cmd.get('action')} ({rec['error']}); decision "
+                        f"logged as failed")
+                finally:
+                    self._lock.acquire()
+            elif not publish:
                 rec["outcome"] = "applied"
             elif self.bus is None:
                 rec["outcome"] = "failed"
@@ -680,7 +927,7 @@ class FleetController:
 
     # -- helpers ------------------------------------------------------------
     def current_world(self) -> int:
-        return self.world_size - (1 if self._evicted else 0)
+        return self.world_size - len(self._evicted)
 
     def _poll_interval(self) -> float:
         return _env_float("PADDLE_TPU_CONTROLLER_POLL_SEC", 1.0)
@@ -691,12 +938,17 @@ class FleetController:
                 return d
         return None
 
-    def _dense_ranks(self, exclude: Optional[str] = None) -> Dict[str, int]:
+    def _dense_ranks(self, exclude=None) -> Dict[str, int]:
         """New rank assignment: surviving hosts ordered by their ORIGINAL
         rank, re-densified to 0..n-1 (the deterministic rule every
-        supervisor can verify against its own member id)."""
+        supervisor can verify against its own member id). `exclude` is a
+        host name or a set of them."""
+        if exclude is None:
+            exclude = set()
+        elif isinstance(exclude, str):
+            exclude = {exclude}
         survivors = sorted(
-            (r, h) for h, r in self._assignment.items() if h != exclude)
+            (r, h) for h, r in self._assignment.items() if h not in exclude)
         return {h: i for i, (_r, h) in enumerate(survivors)}
 
     def _relaunch_env(self, extra: Optional[dict] = None) -> Dict[str, str]:
@@ -720,8 +972,15 @@ class FleetController:
                 "min_world": self.min_world,
                 "prewarm_cache_dir": self.prewarm_cache_dir,
                 "streaks": dict(self._streaks),
-                "evicted": dict(self._evicted) if self._evicted else None,
+                "evicted": ({h: dict(r) for h, r in self._evicted.items()}
+                            if self._evicted else None),
                 "assignment": dict(self._assignment),
+                "serving": {
+                    "shed": sorted(self._srv_shed),
+                    "slo_streaks": dict(self._srv_slo_streaks),
+                    "wedge_streaks": dict(self._srv_wedge_streaks),
+                    "swap_rollbacks": dict(self._srv_rollbacks),
+                },
                 "decisions": [dict(r) for r in self.decisions],
             })
 

@@ -748,6 +748,28 @@ def _step_dirs(dirname: str, prefix: str) -> List[Tuple[int, str]]:
     return out
 
 
+def newest_committed_step(dirname: str, prefix: str = "ckpt",
+                          min_step: int = -1,
+                          skip: Optional[set] = None
+                          ) -> Optional[Tuple[int, str]]:
+    """Cheapest answer to "is there a NEWER complete checkpoint?" —
+    the serving hot-swap poller's watch primitive. Scans step
+    directories newest-first and returns the first `(step, path)` whose
+    manifests verify "complete", skipping steps <= `min_step` and any
+    in `skip` (canary-rejected pushes are skipped forever rather than
+    re-scored every poll). Returns None when nothing qualifies. Shallow
+    verification only (manifest + chunk presence/size); the loader's
+    checksum pass still guards the actual swap."""
+    for step, path in _step_dirs(dirname, prefix):
+        if step <= min_step:
+            return None  # newest-first: everything below is older too
+        if skip and step in skip:
+            continue
+        if verify_step(path)[0] == "complete":
+            return step, path
+    return None
+
+
 class ShardedCheckpointManager(CheckpointManager):
     """CheckpointManager over the chunked layout (module docstring).
 
@@ -1105,4 +1127,4 @@ class ShardedCheckpointManager(CheckpointManager):
 
 __all__ = ["ShardedCheckpointManager", "snapshot_tree", "write_shards",
            "scan_step", "verify_step", "load_step", "owner_rank",
-           "is_step_dir", "MANIFEST_MAGIC"]
+           "is_step_dir", "newest_committed_step", "MANIFEST_MAGIC"]

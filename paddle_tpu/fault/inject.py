@@ -105,6 +105,13 @@ KNOWN_SITES = {
     "fleet.step": "per-step fleet telemetry hook (straggler chaos)",
     "serving.decode": "per-iteration serving decode dispatch "
                       "(latency chaos for SLO breach drills)",
+    "serving.swap": "checkpoint hot-swap load/stage path "
+                    "(bad-push and torn-load drills)",
+    "serving.wedge": "top of the serving step loop "
+                     "(delay kind wedges the decode loop for "
+                     "watchdog-restart drills)",
+    "serving.admit": "request admission into the serving queue "
+                     "(shed and admission-failure drills)",
 }
 
 #: dynamic site families: call sites build the name from a prefix +

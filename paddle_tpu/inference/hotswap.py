@@ -1,0 +1,340 @@
+"""Zero-downtime checkpoint hot-swap for the serving engine.
+
+The train-to-serve continuous-deployment arc: trainers commit sharded
+checkpoints (distributed/sharded_checkpoint.py), and the serving side
+must pick them up WITHOUT draining — a drain at fleet scale is an
+availability event. :class:`HotSwapManager` closes the loop:
+
+* a background **poller** watches the checkpoint directory's manifests
+  for a newer committed step (``newest_committed_step`` — shallow
+  manifest/chunk verification, no tensor reads);
+* the candidate loads **off the critical path** (the decode loop keeps
+  serving on the live weights while ``load_step`` reassembles and
+  checksums the new ones);
+* a **canary gate** scores the candidate on a fixed probe batch — mean
+  perplexity vs the LIVE weights (``ServingEngine.run_canary``). A
+  candidate regressing past ``canary_tol`` is REJECTED with a
+  ``serving_swap`` event and never swapped in (and never re-scored:
+  rejected steps are skipped by later polls);
+* a passing candidate is **staged** into the engine
+  (``request_swap``) and rebinds atomically between decode iterations —
+  in-flight requests keep their KV pages and continue on the new
+  weights; the pause is timed into ``serving_swap_pause_seconds``;
+* the outgoing weights are retained, so :meth:`rollback` (driven by the
+  controller's post-swap canary/SLO watch) restores the prior step and
+  blacklists the bad one; repeated rollbacks trip the controller's
+  max-rollbacks → :meth:`halt` breaker, which stops the poller entirely.
+
+Chaos: the ``serving.swap`` fault site arms the load/stage path
+(bad-push and torn-load drills — an armed error lands in the ``fail``
+outcome, never in the live weights).
+
+Knobs: ``PADDLE_TPU_SWAP_POLL_SEC`` (poll cadence),
+``PADDLE_TPU_SWAP_CANARY`` (gate on/off),
+``PADDLE_TPU_SWAP_CANARY_TOL`` (relative perplexity tolerance).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..fault import site as _fault_site
+from ..profiler import events as _events
+from ..profiler import metrics as _metrics
+from ..utils.envparse import env_bool, env_float
+from .serving import _M_SWAP_TOTAL, ServingEngine
+
+__all__ = ["HotSwapManager", "default_probe_batch"]
+
+#: load failures tolerated per step before the poller stops retrying it
+_MAX_LOAD_FAILURES = 3
+
+
+def default_probe_batch(engine: ServingEngine, batch: int = 2,
+                        length: Optional[int] = None) -> np.ndarray:
+    """The FIXED canary probe: deterministic token ids (seeded RNG over
+    the model's vocab), identical across engine lifetimes so canary
+    scores are comparable poll-to-poll and host-to-host."""
+    cfg = getattr(engine.model, "cfg", None)
+    vocab = int(getattr(cfg, "vocab_size", 256))
+    if length is None:
+        length = min(32, engine.max_len)
+    rng = np.random.default_rng(1234)
+    return rng.integers(1, max(2, vocab), size=(batch, int(length)),
+                        dtype=np.int32)
+
+
+class HotSwapManager:
+    """Watches a sharded-checkpoint directory and hot-swaps newer
+    committed weights into `engine`, canary-gated. Drive it manually
+    (`poll_once` / `try_swap`, tests) or start the background poller
+    (`start()`; `stop()` joins it). Attaches itself as
+    ``engine.hotswap`` — the controller's swap-health policy finds it
+    there."""
+
+    def __init__(self, engine: ServingEngine, ckpt_dir: str, *,
+                 prefix: str = "ckpt", poll_s: Optional[float] = None,
+                 canary: Optional[bool] = None,
+                 canary_tol: Optional[float] = None,
+                 probe_ids: Optional[np.ndarray] = None, mesh=None):
+        self.engine = engine
+        self.ckpt_dir = ckpt_dir
+        self.prefix = prefix
+        self.poll_s = (env_float("PADDLE_TPU_SWAP_POLL_SEC", 5.0)
+                       if poll_s is None else float(poll_s))
+        self.canary = (env_bool("PADDLE_TPU_SWAP_CANARY", True)
+                       if canary is None else bool(canary))
+        self.canary_tol = (env_float("PADDLE_TPU_SWAP_CANARY_TOL", 0.10)
+                           if canary_tol is None else float(canary_tol))
+        self.probe_ids = (default_probe_batch(engine)
+                          if probe_ids is None else np.asarray(probe_ids))
+        self.mesh = mesh
+        #: newest step already live (polls only look above it)
+        self.current_step: int = (engine.weights_step
+                                  if engine.weights_step is not None else -1)
+        #: canary-rejected / rolled-back steps — never re-tried
+        self.rejected: set = set()
+        self.halted = False
+        #: False between a swap landing and the controller's post-swap
+        #: canary/SLO verdict (rollback window)
+        self.vetted = True
+        self.swapped_ts: Optional[float] = None
+        self.baseline_ppl: Optional[float] = None
+        self.last_canary: Optional[dict] = None
+        self.stats = {"polls": 0, "swaps": 0, "rejects": 0, "failures": 0,
+                      "rollbacks": 0}
+        self._fail_counts: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        engine.hotswap = self
+
+    # -- polling --------------------------------------------------------------
+    def poll_once(self) -> Optional[dict]:
+        """One manifest scan; loads + gates + stages when a newer
+        committed step exists. Returns the attempt record (None = no
+        candidate)."""
+        self.stats["polls"] += 1
+        if self.halted:
+            return None
+        from ..distributed import sharded_checkpoint as _ckpt
+        hit = _ckpt.newest_committed_step(self.ckpt_dir, self.prefix,
+                                          min_step=self.current_step,
+                                          skip=self.rejected)
+        if hit is None:
+            return None
+        step, path = hit
+        return self.try_swap(step=step, path=path)
+
+    def start(self):
+        """Launch the background poller (daemon; `stop()` joins it)."""
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.poll_s):
+                if self.halted:
+                    return
+                try:
+                    self.poll_once()
+                except Exception as e:  # noqa: BLE001 — poller survives
+                    import warnings
+                    warnings.warn(f"hot-swap poll failed "
+                                  f"({type(e).__name__}: {e}); retrying")
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name=f"hotswap-{self.engine.name}")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+            self._thread = None
+
+    # -- the swap attempt -----------------------------------------------------
+    def try_swap(self, step: Optional[int] = None,
+                 path: Optional[str] = None, force: bool = False) -> dict:
+        """Load → canary-gate → stage one candidate step (the newest
+        committed one when `step` is None). `force=True` skips the gate
+        (operator override / rollback-drill path) but still records the
+        pre-swap baseline so the post-swap watch can catch the
+        regression. Returns {"outcome": staged|rejected|failed, ...}."""
+        from ..distributed import sharded_checkpoint as _ckpt
+        with self._lock:
+            if path is None and step is not None:
+                # explicit target (operator override): resolve the step
+                # dir directly so even a blacklisted step is reachable
+                # under force=True
+                path = os.path.join(self.ckpt_dir,
+                                    f"{self.prefix}_{int(step)}")
+            elif step is None or path is None:
+                hit = _ckpt.newest_committed_step(
+                    self.ckpt_dir, self.prefix,
+                    min_step=self.current_step,
+                    skip=None if force else self.rejected)
+                if hit is None:
+                    return {"outcome": "failed",
+                            "error": "no newer committed step"}
+                step, path = hit
+            rec: dict = {"step": step, "from_step": self.current_step,
+                         "forced": bool(force)}
+            try:
+                # chaos: `serving.swap` arms the load/stage path — an
+                # injected error is a failed PUSH, never corrupt weights
+                _fault_site("serving.swap")
+                state = _ckpt.load_step(path, mesh=self.mesh)
+                params, buffers = self._extract(state)
+            except Exception as e:  # noqa: BLE001 — one push, one verdict
+                return self._record_failure(step, rec, e)
+            if self.canary:
+                live_ppl = self.engine.run_canary(self.probe_ids)
+                self.baseline_ppl = live_ppl
+                if not force:
+                    try:
+                        cand_ppl = self.engine.run_canary(
+                            self.probe_ids, params=params, buffers=buffers)
+                    except Exception as e:  # noqa: BLE001
+                        return self._record_failure(step, rec, e)
+                    canary = {"live_ppl": live_ppl, "cand_ppl": cand_ppl,
+                              "tol": self.canary_tol}
+                    self.last_canary = dict(canary, step=step)
+                    rec["canary"] = canary
+                    if not np.isfinite(cand_ppl) or \
+                            cand_ppl > live_ppl * (1.0 + self.canary_tol):
+                        return self._record_reject(step, rec, canary)
+            try:
+                self.vetted = False
+                self.engine.request_swap(
+                    params, buffers, step=step,
+                    source="hotswap-forced" if force else "hotswap",
+                    on_applied=self._on_applied)
+            except ValueError as e:  # shape/dtype mismatch = a bad push
+                self.vetted = True
+                return self._record_failure(step, rec, e)
+            rec["outcome"] = "staged"
+            # a synchronously-driven engine (no loop thread) has no one
+            # to hit the iteration boundary while idle — apply now
+            if self.engine._thread is None and not self.engine.pending():
+                self.engine._apply_pending_swap()
+            return rec
+
+    def _record_failure(self, step: int, rec: dict, err: Exception) -> dict:
+        self.stats["failures"] += 1
+        n = self._fail_counts[step] = self._fail_counts.get(step, 0) + 1
+        if n >= _MAX_LOAD_FAILURES:
+            self.rejected.add(step)  # stop retrying a push that can't heal
+        rec.update(outcome="failed", error=f"{type(err).__name__}: {err}")
+        if _metrics.enabled():
+            _M_SWAP_TOTAL.inc(1.0, model=self.engine.name, outcome="failed")
+        _events.emit("serving_swap", action="fail", model=self.engine.name,
+                     to_step=step, error=rec["error"], attempts=n,
+                     blacklisted=step in self.rejected)
+        return rec
+
+    def _record_reject(self, step: int, rec: dict, canary: dict) -> dict:
+        self.stats["rejects"] += 1
+        self.rejected.add(step)
+        rec["outcome"] = "rejected"
+        if _metrics.enabled():
+            _M_SWAP_TOTAL.inc(1.0, model=self.engine.name,
+                              outcome="rejected")
+        _events.emit("serving_swap", action="reject",
+                     model=self.engine.name, to_step=step,
+                     live_ppl=round(canary["live_ppl"], 4),
+                     cand_ppl=round(canary["cand_ppl"], 4),
+                     tol=canary["tol"])
+        return rec
+
+    def _on_applied(self, swap: dict):
+        self.stats["swaps"] += 1
+        self.current_step = (swap["step"] if swap["step"] is not None
+                             else self.current_step)
+        self.swapped_ts = time.time()
+
+    # -- post-swap watch / rollback / halt ------------------------------------
+    def post_swap_regressed(self) -> Optional[dict]:
+        """Re-score the LIVE weights against the pre-swap baseline —
+        the controller's post-swap canary check. None when no baseline
+        exists (canary off, or no swap yet)."""
+        if self.baseline_ppl is None or not self.canary:
+            return None
+        live = self.engine.run_canary(self.probe_ids)
+        regressed = (not np.isfinite(live)
+                     or live > self.baseline_ppl * (1.0 + self.canary_tol))
+        return {"live_ppl": live, "baseline_ppl": self.baseline_ppl,
+                "tol": self.canary_tol, "regressed": regressed}
+
+    def rollback(self, reason: str = "regression") -> dict:
+        """Stage the prior weights back in and blacklist the regressing
+        step. The engine applies at its next iteration boundary (or
+        immediately when driven synchronously)."""
+        with self._lock:
+            bad = self.current_step
+            pend = self.engine.rollback_weights(source=f"hotswap:{reason}")
+            if bad is not None and bad >= 0:
+                self.rejected.add(bad)
+            self.stats["rollbacks"] += 1
+            self.current_step = (pend["step"] if pend["step"] is not None
+                                 else -1)
+            self.vetted = True
+            self.baseline_ppl = None
+            if self.engine._thread is None and not self.engine.pending():
+                self.engine._apply_pending_swap()
+        return {"rolled_back_step": bad, "restored_step": pend["step"],
+                "reason": reason}
+
+    def halt(self, reason: str = "max_rollbacks"):
+        """Breaker: stop swapping entirely (controller max-rollbacks
+        response). The poller thread exits; `halted` stays sticky."""
+        self.halted = True
+        self._stop.set()
+        _events.emit("serving_swap", action="halt", model=self.engine.name,
+                     reason=reason, rollbacks=self.stats["rollbacks"])
+
+    # -- plumbing -------------------------------------------------------------
+    def _extract(self, state) -> Tuple[Dict, Optional[Dict]]:
+        """Find the engine's parameter set inside a loaded checkpoint
+        tree (top level or nested under e.g. 'model'/'params')."""
+        want = set(self.engine._params)
+
+        def find(node):
+            if isinstance(node, dict):
+                if want <= set(node.keys()):
+                    return node
+                for v in node.values():
+                    hit = find(v)
+                    if hit is not None:
+                        return hit
+            return None
+
+        src = find(state)
+        if src is None:
+            raise ValueError(
+                "checkpoint does not contain the engine's parameter set "
+                f"({len(want)} named parameters)")
+        params = {k: src[k] for k in want}
+        bwant = set(self.engine._buffers)
+        buffers = {k: src[k] for k in bwant if k in src}
+        return params, (buffers or None)
+
+    def status(self) -> dict:
+        return {
+            "model": self.engine.name,
+            "ckpt_dir": self.ckpt_dir,
+            "poll_s": self.poll_s,
+            "canary": self.canary,
+            "canary_tol": self.canary_tol,
+            "current_step": self.current_step,
+            "rejected_steps": sorted(self.rejected),
+            "halted": self.halted,
+            "vetted": self.vetted,
+            "baseline_ppl": self.baseline_ppl,
+            "last_canary": self.last_canary,
+            "stats": dict(self.stats),
+        }

@@ -44,12 +44,26 @@ KV cache (ops/pallas/paged_attention.py) —
   ``serving_eviction`` structured event per request lifecycle edge
   (rendered by ``tools/obs_tail.py --serving``).
 
-Weight hot-swap by polling sharded-checkpoint manifests is the ROADMAP
-follow-up.
+The engine is also the actuation surface of the self-healing serving
+plane (inference/hotswap.py, the controller's serving policies):
+
+* **zero-downtime weight hot-swap** — `request_swap` stages a validated
+  replacement weight set; it rebinds atomically BETWEEN decode
+  iterations (`serving_swap_pause_seconds` times the pause), in-flight
+  requests keep their pages and continue on the new weights, and the
+  outgoing weights are retained for `rollback_weights`;
+* **watchdog restart** — `restart()` joins the decode loop, requeues
+  every in-flight request through the existing preemption path (trace
+  ids preserved), rebuilds the KV plane, and relaunches the loop;
+* **graceful degradation** — `shrink_pool` parks free KV pages out of
+  circulation and `suspend` refuses admission with
+  :class:`EngineSuspended` (the /generate 503 + Retry-After surface)
+  while in-flight work drains, so memory pressure never OOMs the chip.
 """
 from __future__ import annotations
 
 import itertools
+import math
 import threading
 import time
 import weakref
@@ -69,7 +83,7 @@ from ..utils.envparse import env_float, env_int
 from .sampling import SamplingParams, sample_logits
 
 __all__ = ["Request", "PageAllocator", "SamplingParams", "ServingEngine",
-           "current_engine"]
+           "EngineSuspended", "current_engine", "live_engines"]
 
 #: live engines, newest last — how the ObservabilityServer's /requests,
 #: /slo and /generate endpoints find the engine without plumbing a
@@ -88,6 +102,33 @@ def current_engine(name: Optional[str] = None) -> Optional["ServingEngine"]:
             if name is None or eng.name == name:
                 return eng
     return None
+
+
+def live_engines() -> List["ServingEngine"]:
+    """Every live (non-closed) engine, oldest first — the controller's
+    serving-policy scan and the /healthz serving-liveness walk."""
+    out: List["ServingEngine"] = []
+    with _engine_lock:
+        for ref in _engine_refs:
+            eng = ref()
+            if eng is not None and not eng._closed:
+                out.append(eng)
+    return out
+
+
+class EngineSuspended(RuntimeError):
+    """Admission refused: the engine is suspended (memory-pressure
+    degradation). Carries ``retry_after_s`` so the /generate endpoint
+    can answer 503 with a Retry-After header instead of a bare error."""
+
+    def __init__(self, model: str, reason: str, retry_after_s: float):
+        super().__init__(
+            f"engine {model!r} suspended ({reason}); "
+            f"retry after {retry_after_s:g}s")
+        self.model = model
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+
 
 _REG = _metrics.default_registry()
 _M_QUEUE = _REG.gauge(
@@ -108,6 +149,25 @@ _M_TPOT = _REG.histogram(
 _M_GOODPUT = _REG.counter(
     "serving_goodput_tokens_total",
     "generated tokens delivered to finished or running requests, by model")
+_M_SWAP_TOTAL = _REG.counter(
+    "serving_swap_total",
+    "weight hot-swap attempts by model and outcome "
+    "(applied|rejected|rolled_back|failed)")
+_M_SWAP_PAUSE = _REG.histogram(
+    "serving_swap_pause_seconds",
+    "decode-loop pause while a staged weight swap rebinds between "
+    "iterations, by model")
+_M_SWAP_STEP = _REG.gauge(
+    "serving_swap_step",
+    "checkpoint step of the live serving weights, by model "
+    "(-1 until a hot-swap lands)")
+_M_RESTARTS = _REG.counter(
+    "serving_restart_total",
+    "watchdog engine restarts by model and reason; in-flight requests "
+    "requeue through the preemption path")
+_M_SUSPENDED = _REG.gauge(
+    "serving_suspended",
+    "1 while admission is suspended under memory pressure, by model")
 
 
 class PageAllocator:
@@ -128,6 +188,7 @@ class PageAllocator:
         self.num_pages = int(num_pages)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._refs: Dict[int, int] = {}
+        self._reserved: List[int] = []
         self._on_release = on_release
 
     @property
@@ -184,6 +245,27 @@ class PageAllocator:
             self._free.append(p)
             if self._on_release is not None:
                 self._on_release(p)
+
+    @property
+    def reserved_pages(self) -> int:
+        return len(self._reserved)
+
+    def reserve(self, n: int) -> int:
+        """Park up to `n` FREE pages out of circulation (memory-pressure
+        degradation: a reserved page cannot be allocated until released).
+        Live pages are never touched. Returns the count reserved."""
+        take = min(max(0, int(n)), len(self._free))
+        for _ in range(take):
+            self._reserved.append(self._free.pop())
+        return take
+
+    def release_reserved(self, n: Optional[int] = None) -> int:
+        """Return reserved pages to the free list (all by default)."""
+        take = len(self._reserved) if n is None \
+            else min(max(0, int(n)), len(self._reserved))
+        for _ in range(take):
+            self._free.append(self._reserved.pop())
+        return take
 
 
 class _PrefixCache:
@@ -398,7 +480,8 @@ class ServingEngine:
                  page_size: int = 16, num_pages: int = 0,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  eos_id: int = -1, name: str = "gpt",
-                 decode_mode: str = "fused", share_prefix: bool = True):
+                 decode_mode: str = "fused", share_prefix: bool = True,
+                 priority: int = 0, mem_budget_bytes: int = 0):
         import jax
 
         if decode_mode not in ("fused", "eager"):
@@ -413,9 +496,26 @@ class ServingEngine:
         self.eos_id = int(eos_id)
         self.decode_mode = decode_mode
         self.share_prefix = bool(share_prefix)
+        # multi-model co-residency: priority picks the degradation victim
+        # (LOWEST degrades first) and mem_budget_bytes caps this engine's
+        # page-pool footprint at construction (budget enforcement against
+        # the device_memory_* watermarks happens in MemoryGovernor)
+        self.priority = int(priority)
+        self.mem_budget_bytes = int(mem_budget_bytes)
         self.cache = model.init_cache(max_batch, max_len,
                                       page_size=page_size,
                                       num_pages=num_pages)
+        self._budget_capped: Optional[Tuple[int, int]] = None
+        if self.mem_budget_bytes > 0:
+            per_page = max(1, self.pool_bytes() // max(1,
+                                                       self.cache.num_pages))
+            fit = int(self.mem_budget_bytes // per_page)
+            if fit < self.cache.num_pages:
+                capped = max(2, fit)
+                self._budget_capped = (self.cache.num_pages, capped)
+                self.cache = model.init_cache(max_batch, max_len,
+                                              page_size=page_size,
+                                              num_pages=capped)
         self._prefix = _PrefixCache(page_size)
         self.allocator = PageAllocator(self.cache.num_pages,
                                        on_release=self._prefix.drop_page)
@@ -444,11 +544,25 @@ class ServingEngine:
         self._closed = False
         self._audited = False
         self._thread: Optional[threading.Thread] = None
+        self._loop_poll_s = 0.005
+        # self-healing plane state: staged weight swap (applied between
+        # decode iterations), previous weights kept for rollback, the
+        # watchdog-restart flag, and the shed/suspend admission gates
+        self._swap_lock = threading.Lock()
+        self._dispatch_lock = threading.Lock()
+        self._pending_swap: Optional[dict] = None
+        self._prev_weights: Optional[tuple] = None
+        self.weights_step: Optional[int] = None
+        self.last_swap: Optional[dict] = None
+        self.hotswap = None            # HotSwapManager attaches here
+        self._restarting = False
+        self.queue_limit: Optional[int] = None
+        self._suspended: Optional[dict] = None
         # rolling stats for bench/status
         self.stats = {"iterations": 0, "prefills": 0, "decode_tokens": 0,
                       "completed": 0, "preemptions": 0, "decode_wall_s": 0.0,
                       "cow_copies": 0, "prefix_hit_tokens": 0,
-                      "shared_admissions": 0,
+                      "shared_admissions": 0, "swaps": 0, "restarts": 0,
                       "min_free_pages": self.allocator.free_pages}
         # request-scoped observability plane: lifecycle tracer, sliding-
         # window SLO tracker, and a bounded ring of per-iteration
@@ -575,6 +689,14 @@ class ServingEngine:
                sampling: Optional[SamplingParams] = None) -> Request:
         if self._closed:
             raise RuntimeError("engine is closed")
+        # chaos: an armed `serving.admit` fails admission BEFORE the
+        # request exists (error kinds propagate to the caller; delay
+        # kinds slow the admission edge) — the shed drill
+        _fault_site("serving.admit")
+        susp = self._suspended
+        if susp is not None:
+            raise EngineSuspended(self.name, susp["reason"],
+                                  susp["retry_after_s"])
         req = Request(prompt, max_new_tokens,
                       self.eos_id if eos_id is None else eos_id,
                       sampling=sampling)
@@ -599,6 +721,12 @@ class ServingEngine:
             # that drain would never complete (result() hangs forever)
             if self._closed:
                 raise RuntimeError("engine is closed")
+            if self.queue_limit is not None \
+                    and len(self._queue) >= self.queue_limit:
+                # controller shed: sustained SLO breach capped the queue
+                raise RuntimeError(
+                    f"queue at shed cap ({self.queue_limit}); "
+                    f"engine {self.name!r} is shedding load")
             self._queue.append(req)
             depth = len(self._queue)
         req.trace_id = self.tracer.submit(req.rid)
@@ -622,6 +750,18 @@ class ServingEngine:
         shared page about to be written (copy-on-write), preempting the
         youngest on pool exhaustion, then one fused decode dispatch.
         Returns the number of tokens generated (0 = engine idle)."""
+        # chaos: an armed `serving.wedge=N:delay` stalls the loop HERE,
+        # before any progress is made — `wedged()` flips once the stall
+        # outlives the liveness window (the watchdog-restart drill)
+        try:
+            _fault_site("serving.wedge")
+        except Exception:
+            pass  # delay/no-op kinds only; a wedge is slow, not dead
+        # a staged weight swap lands at the iteration boundary: in-flight
+        # requests keep their pages and decode the next token on the new
+        # weights — no drain, no retrace (shapes/dtypes validated)
+        if self._pending_swap is not None:
+            self._apply_pending_swap()
         self._admit()
         active_slots = [i for i, r in enumerate(self._slots)
                         if r is not None]
@@ -676,10 +816,14 @@ class ServingEngine:
         dead thread that strands every client in result()."""
         if self._thread is not None:
             return
+        self._loop_poll_s = poll_s
 
         def loop():
-            while not self._closed:
+            while not self._closed and not self._restarting:
                 try:
+                    if self._pending_swap is not None and \
+                            not self.pending():
+                        self._apply_pending_swap()  # idle engines swap too
                     if not self.pending() or self.step() == 0:
                         time.sleep(poll_s)
                 except Exception as e:  # noqa: BLE001 — see docstring
@@ -714,6 +858,214 @@ class ServingEngine:
             self._queue.clear()
         for req in leftovers:
             self._complete(req, "failed", error=error)
+
+    # -- self-healing plane: hot-swap / restart / degradation -----------------
+    def pool_bytes(self) -> int:
+        """Device bytes held by the KV page pools (all layers, K + V)."""
+        return int(sum(int(k.nbytes) + int(v.nbytes)
+                       for k, v in zip(self.cache.k_pages,
+                                       self.cache.v_pages)))
+
+    def request_swap(self, params: Dict, buffers: Optional[Dict] = None, *,
+                     step: Optional[int] = None, source: str = "manual",
+                     rollback: bool = False, on_applied=None) -> dict:
+        """Stage a replacement weight set; it rebinds atomically at the
+        next decode-iteration boundary (`step()` / the idle loop). The
+        arrays are validated against the live weights here — a missing
+        key or a shape/dtype mismatch raises (nothing staged), so the
+        fused executables can never retrace mid-swap. Returns the staged
+        record; a second stage before apply replaces the first."""
+        for k, live in self._params.items():
+            cand = params.get(k)
+            if cand is None:
+                raise ValueError(f"swap rejected: missing parameter {k!r}")
+            if tuple(cand.shape) != tuple(live.shape) \
+                    or np.dtype(cand.dtype) != np.dtype(live.dtype):
+                raise ValueError(
+                    f"swap rejected: parameter {k!r} is "
+                    f"{tuple(cand.shape)}/{np.dtype(cand.dtype)} but the "
+                    f"live weights hold "
+                    f"{tuple(live.shape)}/{np.dtype(live.dtype)}")
+        if buffers is not None:
+            for k, live in self._buffers.items():
+                cand = buffers.get(k)
+                if cand is not None \
+                        and tuple(cand.shape) != tuple(live.shape):
+                    raise ValueError(
+                        f"swap rejected: buffer {k!r} shape "
+                        f"{tuple(cand.shape)} != {tuple(live.shape)}")
+        pend = {"params": {k: params[k] for k in self._params},
+                "buffers": buffers, "step": step, "source": source,
+                "rollback": bool(rollback), "on_applied": on_applied,
+                "staged_ts": time.time()}
+        with self._swap_lock:
+            self._pending_swap = pend
+        _events.emit("serving_swap", severity="info", action="stage",
+                     model=self.name, to_step=step, source=source,
+                     rollback=bool(rollback))
+        return pend
+
+    def _apply_pending_swap(self) -> Optional[dict]:
+        with self._swap_lock:
+            pend, self._pending_swap = self._pending_swap, None
+        if pend is None:
+            return None
+        from_step = self.weights_step
+        t0 = time.perf_counter()
+        with self._dispatch_lock:
+            self._prev_weights = (self._params, self._buffers,
+                                  self.weights_step)
+            self._params = pend["params"]
+            if pend["buffers"] is not None:
+                self._buffers = dict(self._buffers, **pend["buffers"])
+            self.weights_step = pend["step"]
+        pause_s = time.perf_counter() - t0
+        self.stats["swaps"] += 1
+        action = "rollback" if pend["rollback"] else "swap"
+        self.last_swap = {"action": action, "step": pend["step"],
+                          "from_step": from_step, "pause_s": pause_s,
+                          "ts": time.time(), "source": pend["source"],
+                          "in_flight": sum(r is not None
+                                           for r in self._slots)}
+        if _metrics.enabled():
+            outcome = "rolled_back" if pend["rollback"] else "applied"
+            _M_SWAP_TOTAL.inc(1.0, model=self.name, outcome=outcome)
+            _M_SWAP_PAUSE.observe(pause_s, model=self.name)
+            _M_SWAP_STEP.set(-1 if pend["step"] is None else pend["step"],
+                             model=self.name)
+        _events.emit("serving_swap",
+                     severity="warn" if pend["rollback"] else "info",
+                     action=action, model=self.name,
+                     from_step=from_step, to_step=pend["step"],
+                     pause_s=round(pause_s, 6), source=pend["source"],
+                     in_flight=sum(r is not None for r in self._slots))
+        cb = pend.get("on_applied")
+        if cb is not None:
+            try:
+                cb(self.last_swap)
+            except Exception:  # noqa: BLE001 — observer must not kill decode
+                pass
+        return self.last_swap
+
+    def rollback_weights(self, *, source: str = "rollback") -> dict:
+        """Stage the previous weight set back in (post-swap regression
+        response). Raises when no swap has happened yet."""
+        if self._prev_weights is None:
+            raise RuntimeError("no previous weights to roll back to")
+        params, buffers, step = self._prev_weights
+        return self.request_swap(params, buffers, step=step,
+                                 source=source, rollback=True)
+
+    def run_canary(self, probe_ids, params: Optional[Dict] = None,
+                   buffers: Optional[Dict] = None) -> float:
+        """Mean-token perplexity of the fixed probe batch under the
+        given weights (default: the live weights) — the hot-swap canary
+        score. Serializes with decode via the dispatch lock (the probe
+        is a full forward with temporarily-rebound model state)."""
+        from ..jit import _swapped_state
+        params = self._params if params is None else params
+        buffers = self._buffers if buffers is None else buffers
+        ids = np.asarray(probe_ids, np.int32)
+        if ids.ndim != 2 or ids.shape[1] < 2:
+            raise ValueError("probe batch must be (B, T>=2) token ids")
+        inp, lbl = Tensor(ids[:, :-1]), Tensor(ids[:, 1:])
+        with self._dispatch_lock:
+            with tape_mod.no_grad(), _swapped_state(self.model, params,
+                                                    buffers):
+                loss = self.model.loss(inp, lbl)
+        nll = float(np.asarray(loss.data))
+        try:
+            return math.exp(nll)  # a confidently-wrong push overflows
+        except OverflowError:     # float exp — that IS the verdict
+            return float("inf")
+
+    def last_progress_age(self) -> float:
+        """Seconds since the last completed decode iteration (the
+        /healthz serving-liveness signal)."""
+        return time.monotonic() - self._last_progress
+
+    def restart(self, reason: str = "wedged",
+                join_timeout: float = 15.0) -> dict:
+        """Watchdog restart: stop the decode loop, requeue every
+        in-flight request through the PREEMPTION path (trace ids and
+        generated prefixes preserved — recompute-style resume), rebuild
+        the KV plane (cache, allocator, prefix registry), and relaunch
+        the loop if one was running. Queued requests are untouched.
+        Raises if the loop won't stop inside `join_timeout` (the caller
+        records a failed decision rather than corrupting live state)."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        was_running = self._thread is not None
+        self._restarting = True
+        try:
+            t = self._thread
+            if t is not None:
+                t.join(join_timeout)
+                if t.is_alive():
+                    raise RuntimeError(
+                        f"decode loop did not stop within {join_timeout}s")
+                self._thread = None
+            requeued = 0
+            for req in [r for r in self._slots if r is not None]:
+                self._preempt(req)
+                requeued += 1
+            leaked = self.allocator.outstanding()
+            reserved = self.allocator.reserved_pages
+            self._prefix = _PrefixCache(self.page_size)
+            self.cache = self.model.init_cache(
+                self.max_batch, self.max_len, page_size=self.page_size,
+                num_pages=self.cache.num_pages)
+            self.allocator = PageAllocator(self.cache.num_pages,
+                                           on_release=self._prefix.drop_page)
+            if reserved:
+                self.allocator.reserve(reserved)  # keep the shrink in force
+            self._cur_tokens[:] = 0
+            self.stats["restarts"] += 1
+            self._last_progress = time.monotonic()
+        finally:
+            self._restarting = False
+        if _metrics.enabled():
+            _M_RESTARTS.inc(1.0, model=self.name, reason=reason)
+        _events.emit("serving_restart", model=self.name, reason=reason,
+                     requeued=requeued, leaked_pages=len(leaked),
+                     restarted_thread=was_running)
+        if was_running:
+            self.start(self._loop_poll_s)
+        return {"requeued": requeued, "leaked_pages": len(leaked),
+                "restarted_thread": was_running}
+
+    def set_queue_limit(self, limit: Optional[int]):
+        """Controller shed actuation: cap (or uncap) queue admission."""
+        self.queue_limit = None if limit is None else max(1, int(limit))
+
+    def suspend(self, reason: str = "memory_pressure",
+                retry_after_s: Optional[float] = None):
+        """Refuse new admissions (EngineSuspended carries Retry-After);
+        queued and in-flight work keeps draining."""
+        if retry_after_s is None:
+            retry_after_s = env_float("PADDLE_TPU_SERVING_RETRY_AFTER_SEC",
+                                      5.0)
+        self._suspended = {"reason": reason,
+                           "retry_after_s": float(retry_after_s),
+                           "ts": time.time()}
+        if _metrics.enabled():
+            _M_SUSPENDED.set(1, model=self.name)
+
+    def resume_admissions(self):
+        self._suspended = None
+        if _metrics.enabled():
+            _M_SUSPENDED.set(0, model=self.name)
+
+    def shrink_pool(self, frac: float = 0.5) -> int:
+        """Park up to `frac` of the pool's pages (taken from the free
+        list) out of circulation — the first memory-pressure degradation
+        rung. Returns pages actually parked (live pages never move)."""
+        target = max(1, int((self.cache.num_pages - 1) * frac))
+        return self.allocator.reserve(target)
+
+    def restore_pool(self) -> int:
+        """Return every parked page to the free list (pressure cleared)."""
+        return self.allocator.release_reserved()
 
     # -- internals ------------------------------------------------------------
     def _bucket_for(self, n: int) -> int:
@@ -792,15 +1144,19 @@ class ServingEngine:
                                   f"serving_prefill:{self.name}")
             sp = req.sampling
             try:
-                nxt, self.cache = self._prefill_jit(
-                    self._params, self._buffers, self.cache,
-                    jnp.asarray(ids), np.int32(slot),
-                    np.int32(len(tokens)), np.int32(shared_len),
-                    jnp.full((1,), sp.temperature, jnp.float32),
-                    jnp.full((1,), sp.top_k, jnp.int32),
-                    jnp.full((1,), sp.top_p, jnp.float32),
-                    jnp.full((1,), req.seed, jnp.int32),
-                    jnp.full((1,), len(req.generated), jnp.int32))
+                # dispatch lock: a concurrent canary evaluation rebinds
+                # the model's parameter state while it traces — never
+                # interleave that with a prefill/decode trace
+                with self._dispatch_lock:
+                    nxt, self.cache = self._prefill_jit(
+                        self._params, self._buffers, self.cache,
+                        jnp.asarray(ids), np.int32(slot),
+                        np.int32(len(tokens)), np.int32(shared_len),
+                        jnp.full((1,), sp.temperature, jnp.float32),
+                        jnp.full((1,), sp.top_k, jnp.int32),
+                        jnp.full((1,), sp.top_p, jnp.float32),
+                        jnp.full((1,), req.seed, jnp.int32),
+                        jnp.full((1,), len(req.generated), jnp.int32))
             finally:
                 _cw.pop_entry(prev)
             self.stats["prefills"] += 1
@@ -952,11 +1308,12 @@ class ServingEngine:
                 jnp.asarray(top_k), jnp.asarray(top_p),
                 jnp.asarray(seeds), jnp.asarray(steps))
         try:
-            if self.decode_mode == "fused":
-                nxt, self.cache = self._fused_jit(*args)
-            else:
-                # eager A/B baseline: identical math, per-op dispatch
-                nxt, self.cache = self._fused_step_fn(*args)
+            with self._dispatch_lock:  # see _admit: canary serialization
+                if self.decode_mode == "fused":
+                    nxt, self.cache = self._fused_jit(*args)
+                else:
+                    # eager A/B baseline: identical math, per-op dispatch
+                    nxt, self.cache = self._fused_step_fn(*args)
         finally:
             _cw.pop_entry(prev)
         nxt_np = np.asarray(nxt)  # device sync: the iteration boundary
@@ -1128,5 +1485,15 @@ class ServingEngine:
                 "decode_mode": self.decode_mode,
                 "share_prefix": self.share_prefix,
                 "prefix_entries": len(self._prefix),
+                "priority": self.priority,
+                "mem_budget_bytes": self.mem_budget_bytes,
+                "budget_capped_pages": self._budget_capped,
+                "reserved_pages": self.allocator.reserved_pages,
+                "queue_limit": self.queue_limit,
+                "suspended": dict(self._suspended) if self._suspended
+                             else None,
+                "weights_step": self.weights_step,
+                "last_swap": dict(self.last_swap) if self.last_swap
+                             else None,
                 "stats": dict(self.stats),
             }

@@ -86,6 +86,10 @@ KIND_SEVERITY = {
     "slo_breach": "warn",         # a serving SLO window left its target
                                   # (one per excursion; re-arms on
                                   # recovery)
+    "serving_swap": "warn",       # weight hot-swap lifecycle (stage/
+                                  # swap/reject/rollback/fail/halt)
+    "serving_restart": "warn",    # wedged engine restarted; in-flight
+                                  # requests requeued, pages rebuilt
 }
 
 #: back-compat view: the registered kind names

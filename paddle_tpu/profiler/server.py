@@ -288,14 +288,28 @@ class ObservabilityServer:
 
     def generate_payload(self, body: bytes) -> (int, dict):
         """`/generate` (POST): one-call HTTP inference against the live
-        engine. Sheds instead of hanging: 503 with a JSON error when the
-        engine is wedged past the /healthz stall threshold (or closed /
-        absent), 429 with the queue depth when admission is saturated
+        engine. Routes by the optional `model` body field when several
+        engines share the process. Sheds instead of hanging: 503 with a
+        JSON error when the engine is wedged past the /healthz stall
+        threshold (or closed / absent / suspended — suspended answers
+        carry `retry_after_s`, surfaced as a Retry-After header), 429
+        with the queue depth when admission is saturated
         (`PADDLE_TPU_SERVING_QUEUE_LIMIT` deep)."""
         from ..utils.envparse import env_int
-        eng = self._engine()
+        try:
+            req = json.loads(body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            req = None  # defer the 400: absent-engine 503 wins
+        model = req.get("model") if isinstance(req, dict) else None
+        eng = self._engine(model)
         if eng is None:
+            if model is not None:
+                return 503, {"error": f"no serving engine named "
+                                      f"{model!r} in this process",
+                             "model": model}
             return 503, {"error": "no serving engine in this process"}
+        if req is None:
+            return 400, {"error": "request body is not JSON"}
         if eng._closed:
             return 503, {"error": "serving engine is closed",
                          "model": eng.name}
@@ -305,16 +319,18 @@ class ObservabilityServer:
                          "model": eng.name,
                          "stall_after_s": self.stall_after or liveness()
                          .get("stall_after_s")}
+        if getattr(eng, "_suspended", None):
+            return 503, {"error": "serving engine is suspended "
+                                  f"({eng._suspended.get('reason')})",
+                         "model": eng.name,
+                         "retry_after_s":
+                             eng._suspended.get("retry_after_s")}
         limit = env_int("PADDLE_TPU_SERVING_QUEUE_LIMIT", 64)
         depth = eng.queue_depth()
         if limit > 0 and depth >= limit:
             return 429, {"error": "admission queue saturated",
                          "model": eng.name, "queue_depth": depth,
                          "limit": limit}
-        try:
-            req = json.loads(body.decode() or "{}")
-        except (ValueError, UnicodeDecodeError) as e:
-            return 400, {"error": f"request body is not JSON: {e}"}
         prompt = req.get("prompt")
         if not isinstance(prompt, list) or \
                 not all(isinstance(t, int) for t in prompt):
@@ -339,11 +355,40 @@ class ObservabilityServer:
         except TimeoutError as e:
             return 504, {"error": str(e)}
         except RuntimeError as e:
-            return 503, {"error": str(e), "model": eng.name}
+            payload = {"error": str(e), "model": eng.name}
+            if getattr(e, "retry_after_s", None) is not None:
+                payload["retry_after_s"] = e.retry_after_s
+            return 503, payload
         return 200, out
 
     def healthz(self) -> dict:
         h = liveness(self.stall_after)
+        # serving liveness counts too: a running engine holding work
+        # without a completed decode iteration inside the stall window
+        # flips 503 `stalled` just like a training loop that stopped
+        # stepping (lazy module lookup — never imports the inference
+        # stack from a scrape)
+        import sys
+        mod = sys.modules.get("paddle_tpu.inference.serving")
+        if mod is not None:
+            try:
+                serving = {}
+                for eng in mod.live_engines():
+                    wedged = eng.wedged(self.stall_after)
+                    serving[eng.name] = {
+                        "pending": eng.pending(),
+                        "last_progress_age_s":
+                            round(eng.last_progress_age(), 3),
+                        "wedged": wedged,
+                        "suspended": bool(eng._suspended)}
+                    if wedged:
+                        h["status"] = "stalled"
+                        h["stalled_by"] = h.get("stalled_by",
+                                                "serving:" + eng.name)
+                if serving:
+                    h["serving"] = serving
+            except Exception:
+                pass
         if self.aggregator is not None:
             # supervisor view: the fleet's digests carry the liveness
             try:
@@ -373,11 +418,14 @@ class ObservabilityServer:
             def log_message(self, *a):  # keep training stdout clean
                 pass
 
-            def _send(self, code: int, body: str, ctype: str):
+            def _send(self, code: int, body: str, ctype: str,
+                      headers: Optional[dict] = None):
                 data = body.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -459,8 +507,13 @@ class ObservabilityServer:
                             length = 0
                         body = self.rfile.read(length) if length else b""
                         code, payload = srv.generate_payload(body)
+                        hdrs = None
+                        if code == 503 and isinstance(payload, dict) and \
+                                payload.get("retry_after_s") is not None:
+                            hdrs = {"Retry-After": int(round(
+                                float(payload["retry_after_s"])))}
                         self._send(code, json.dumps(payload),
-                                   "application/json")
+                                   "application/json", headers=hdrs)
                     else:
                         self._send(404, json.dumps(
                             {"error": "unknown path", "endpoints":

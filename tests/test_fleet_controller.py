@@ -310,17 +310,60 @@ class TestStragglerDebounce:
         assert bus.last_id() == 0
         assert _decisions() == []
 
-    def test_one_eviction_at_a_time(self):
+    def test_quorum_floor_caps_simultaneous_evictions(self):
+        """Multi-straggler handling is bounded by min_world: once the
+        fleet is at the floor, further confirmed stragglers are held
+        back (no decision, no publish)."""
         bus = ControllerCommandBus(FakeStore())
         agg = _Agg()
         ctl = FleetController(agg, bus, world_size=3, confirm_windows=1,
-                              readmit_after_s=9999)
+                              readmit_after_s=9999, min_world=2)
         d = {0: _digest("trainer-0", 0), 1: _digest("trainer-1", 1),
              2: _digest("trainer-2", 2)}
         _tick(ctl, agg, ["trainer-1"], d)
         _tick(ctl, agg, ["trainer-1", "trainer-2"], d)
         cmds = bus.poll(0)
+        # trainer-2 confirmed too, but evicting it would breach the floor
         assert [c["host"] for c in cmds] == ["trainer-1"]
+        assert ctl.current_world() == 2
+
+    def test_two_simultaneous_stragglers_both_evict(self):
+        """Regression for the PR-13 carried follow-up: two hosts slow at
+        once each confirm their own debounced streak and BOTH evict
+        (down to the min_world floor), with re-densified rank maps that
+        exclude every held host."""
+        bus = ControllerCommandBus(FakeStore())
+        agg = _Agg()
+        ctl = FleetController(agg, bus, world_size=3, confirm_windows=2,
+                              readmit_after_s=9999, min_world=1)
+        for i in range(2):
+            d = {0: _digest("trainer-0", 0, step=10 + i),
+                 1: _digest("trainer-1", 1, step=10 + i),
+                 2: _digest("trainer-2", 2, step=10 + i)}
+            _tick(ctl, agg, ["trainer-1", "trainer-2"], d)
+        cmds = bus.poll(0)
+        assert [c["action"] for c in cmds] == ["evict", "evict"]
+        assert {c["host"] for c in cmds} == {"trainer-1", "trainer-2"}
+        # ledger order: the second eviction's rank map excludes BOTH
+        assert cmds[0]["np"] == 2 and cmds[1]["np"] == 1
+        assert cmds[1]["ranks"] == {"trainer-0": 0}
+        assert ctl.current_world() == 1
+        # both readmit independently once their probation beats are fresh
+        ctl.readmit_after_s = 0.0
+        bus.beat_ready("trainer-1")
+        bus.beat_ready("trainer-2")
+        d = {0: _digest("trainer-0", 0, step=20)}
+        _tick(ctl, agg, [], d)  # observes beats; readmits one
+        _tick(ctl, agg, [], d)  # readmits the other
+        back = bus.poll(2)
+        assert [c["action"] for c in back] == ["readmit", "readmit"]
+        assert {c["host"] for c in back} == {"trainer-1", "trainer-2"}
+        # partial readmission covers N-1; the last one restores full N
+        assert sorted(c["np"] for c in back) == [2, 3]
+        last = [c for c in back if c["np"] == 3][0]
+        assert last["ranks"] == {"trainer-0": 0, "trainer-1": 1,
+                                 "trainer-2": 2}
+        assert ctl.current_world() == 3
 
     def test_dry_run_publishes_nothing(self):
         bus = ControllerCommandBus(FakeStore())
@@ -414,7 +457,7 @@ class TestDiagAwareEviction:
             _tick(ctl, agg, ["trainer-1"], self._digests("data_wait"))
         # nothing published, fleet stays at N, but the decision is logged
         assert bus.last_id() == 0
-        assert ctl._evicted is None
+        assert not ctl._evicted
         recs = _decisions()
         assert len(recs) == 1
         rec = recs[0]

@@ -283,6 +283,7 @@ class TestPallasFlashAttention:
         assert fa._stats["xla"] > before["xla"], fa._stats
         assert float(jnp.abs(gm).max()) > 0, "learned bias silently frozen"
 
+    @pytest.mark.slow  # 640-token grid walk; seq128/masked pallas paths stay fast
     def test_long_seq_walk_grid_tail_blocks(self):
         # 640 = 2.5 blocks of 256: exercises in-kernel tail masking on the
         # grid-walked path (round-2 kernel required % 256 == 0)

@@ -294,6 +294,7 @@ class TestResNetIntegration:
             np.testing.assert_allclose(ga[k], gb[k], rtol=3e-4,
                                        atol=3e-4 * scale, err_msg=k)
 
+    @pytest.mark.slow  # whole-resnet18 double trace; bottleneck parity stays fast
     def test_resnet18_knob_off_is_status_quo(self):
         """Without interpret/TPU the knob is inert: fused_conv_bn=True
         must trace the identical composition (CPU tier-1 safety)."""

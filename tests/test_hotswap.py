@@ -1,0 +1,311 @@
+"""Zero-downtime checkpoint hot-swap (inference/hotswap.py +
+ServingEngine.request_swap): manifest discovery, the canary gate,
+between-iteration swap semantics (in-flight requests keep pages),
+rollback, the `serving.swap` chaos site, and the swap x preemption
+interleaving audit.
+
+fast-sibling: everything here is tier-1-fast (tiny GPT, shared compile
+cache); the thread-under-load swap drills live in
+tests/test_serving_chaos_e2e.py (slow tier).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.sharded_checkpoint import (
+    ShardedCheckpointManager, newest_committed_step)
+from paddle_tpu.fault import inject
+from paddle_tpu.inference.hotswap import HotSwapManager, default_probe_batch
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.profiler import events
+from paddle_tpu.profiler import metrics as metrics_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    events.default_event_log().clear()
+    inject.reset()
+    yield
+    inject.reset()
+    events.default_event_log().clear()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _shared_compile_cache():
+    """Same shared persistent-compile-cache dir as test_serving.py: every
+    test rebuilds the same tiny-model executables, only the first
+    construction across the serving test modules pays XLA."""
+    from paddle_tpu.framework import flags as flags_mod
+    cache = os.path.join(tempfile.gettempdir(), "pt_serving_ccache")
+    os.makedirs(cache, exist_ok=True)
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": cache})
+    yield
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
+
+
+def _model(seed=0, vocab=512):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=vocab, max_position_embeddings=128,
+                    hidden_size=32, num_layers=2, num_heads=2,
+                    dropout=0.0, attn_dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _params(m):
+    return {k: p.data for k, p in m.named_parameters()}
+
+
+def _save(tmpdir, state, step):
+    mgr = ShardedCheckpointManager(str(tmpdir), prefix="ckpt",
+                                   keep_last_n=10)
+    assert mgr.save(state, step=step)
+
+
+def _amplified(state, factor=50.0):
+    """Confidently-wrong weights: same shapes/dtypes, huge logits —
+    the canary's perplexity check must reject them."""
+    return {k: paddle.to_tensor(
+                (np.asarray(v) * factor).astype(np.asarray(v).dtype))
+            for k, v in state.items()}
+
+
+def _swap_events(action=None):
+    evs = [e for e in events.recent(200, kind="serving_swap")]
+    return [e for e in evs if action is None or e.get("action") == action]
+
+
+class TestNewestCommittedStep:
+    def test_empty_dir_and_min_step_and_skip(self, tmp_path):
+        assert newest_committed_step(str(tmp_path)) is None
+        m, _ = _model()
+        _save(tmp_path, _params(m), 100)
+        _save(tmp_path, _params(m), 200)
+        step, path = newest_committed_step(str(tmp_path))
+        assert step == 200 and path.endswith("ckpt_200")
+        # min_step: nothing newer than 200
+        assert newest_committed_step(str(tmp_path), min_step=200) is None
+        # skip: a blacklisted newest falls back to the next committed one
+        step, _ = newest_committed_step(str(tmp_path), skip={200})
+        assert step == 100
+
+    def test_torn_step_is_invisible(self, tmp_path):
+        """A step dir without a committed manifest (a save that died
+        mid-write) must never be offered for a swap."""
+        m, _ = _model()
+        _save(tmp_path, _params(m), 100)
+        os.makedirs(str(tmp_path / "ckpt_200"))  # empty = no manifest
+        step, _ = newest_committed_step(str(tmp_path))
+        assert step == 100
+
+
+class TestHotSwap:
+    def test_poll_swaps_and_records_metrics(self, tmp_path):
+        m, _ = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="hs1")
+        _save(tmp_path, _params(m), 100)
+        hsm = HotSwapManager(eng, str(tmp_path), poll_s=999, canary=True)
+        rec = hsm.poll_once()
+        assert rec["outcome"] == "staged"
+        # threadless idle engine applies immediately
+        assert eng.weights_step == 100 and hsm.current_step == 100
+        assert eng.last_swap["pause_s"] >= 0.0
+        assert hsm.last_canary["step"] == 100
+        actions = [e["action"] for e in _swap_events()]
+        assert actions == ["stage", "swap"]
+        if metrics_mod.enabled():
+            reg = metrics_mod.default_registry()
+            vals = {tuple(sorted(v["labels"].items())): v["value"]
+                    for v in reg.get("serving_swap_step").snapshot()["values"]}
+            assert vals[(("model", "hs1"),)] == 100
+        # nothing newer: the next poll is a no-op
+        assert hsm.poll_once() is None
+        eng.close()
+
+    def test_canary_rejects_and_blacklists_bad_push(self, tmp_path):
+        m, _ = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="hs2")
+        state = _params(m)
+        _save(tmp_path, state, 100)
+        hsm = HotSwapManager(eng, str(tmp_path), poll_s=999, canary=True,
+                             canary_tol=0.10)
+        assert hsm.poll_once()["outcome"] == "staged"
+        _save(tmp_path, _amplified(state), 200)
+        rec = hsm.poll_once()
+        assert rec["outcome"] == "rejected"
+        assert rec["canary"]["cand_ppl"] > rec["canary"]["live_ppl"] * 1.1
+        # live weights untouched, step blacklisted, poller moves on
+        assert eng.weights_step == 100
+        assert 200 in hsm.rejected
+        assert hsm.poll_once() is None
+        ev = _swap_events("reject")
+        assert len(ev) == 1 and ev[0]["to_step"] == 200
+        assert hsm.stats["rejects"] == 1
+        eng.close()
+
+    def test_forced_bad_swap_then_rollback_restores_weights(self, tmp_path):
+        """Operator force-push of a rejected step: the post-swap watch
+        (post_swap_regressed) flags it and rollback() restores the prior
+        step, blacklists the bad one, and greedy decode is bit-identical
+        to the pre-swap engine."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="hs3")
+        state = _params(m)
+        _save(tmp_path, state, 100)
+        hsm = HotSwapManager(eng, str(tmp_path), poll_s=999, canary=True)
+        hsm.poll_once()
+        prompt = [5, 9, 3, 17]
+        before = eng.generate(prompt, max_new_tokens=6)["tokens"]
+
+        _save(tmp_path, _amplified(state), 200)
+        rec = hsm.try_swap(step=200, force=True)
+        assert rec["outcome"] == "staged" and rec["forced"]
+        assert eng.weights_step == 200
+        assert hsm.vetted is False  # forced swaps still need the watch
+        regress = hsm.post_swap_regressed()
+        assert regress["regressed"]
+
+        rb = hsm.rollback("canary")
+        assert rb == {"rolled_back_step": 200, "restored_step": 100,
+                      "reason": "canary"}
+        assert eng.weights_step == 100 and hsm.vetted is True
+        assert 200 in hsm.rejected
+        after = eng.generate(prompt, max_new_tokens=6)["tokens"]
+        assert after == before, "rollback changed the greedy tokens"
+        ev = _swap_events("rollback")
+        assert len(ev) == 1 and ev[0]["severity"] == "warn"
+        eng.close()
+
+    def test_post_swap_requests_decode_on_new_weights(self, tmp_path):
+        """Determinism across the swap: temperature=0 requests admitted
+        entirely post-swap produce exactly the NEW model's reference
+        greedy tokens (and pre-swap ones the old model's)."""
+        m_old, cfg = _model(seed=0)
+        m_new, _ = _model(seed=7)
+        eng = ServingEngine(m_old, max_batch=2, max_len=48, page_size=8,
+                            name="hs4")
+        prompt = [11, 4, 2, 9, 31]
+        pre = eng.generate(prompt, max_new_tokens=6)["tokens"]
+        ids = paddle.to_tensor(np.asarray([prompt], np.int32))
+        ref_old = np.asarray(
+            m_old.generate_paged(ids, 6, page_size=8).data)
+        assert pre == ref_old[0, len(prompt):].tolist()
+
+        _save(tmp_path, _params(m_new), 300)
+        hsm = HotSwapManager(eng, str(tmp_path), poll_s=999, canary=False)
+        assert hsm.poll_once()["outcome"] == "staged"
+        assert eng.weights_step == 300
+        post = eng.generate(prompt, max_new_tokens=6)["tokens"]
+        ref_new = np.asarray(
+            m_new.generate_paged(ids, 6, page_size=8).data)
+        assert post == ref_new[0, len(prompt):].tolist(), \
+            "post-swap decode did not run on the swapped-in weights"
+        eng.close()
+
+    def test_swap_rejects_shape_mismatch(self, tmp_path):
+        m, _ = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="hs5")
+        good = _params(m)
+        k = next(iter(good))
+        bad = dict(good)
+        bad[k] = paddle.to_tensor(
+            np.zeros((3, 3), np.asarray(good[k]).dtype))
+        with pytest.raises(ValueError, match="swap rejected"):
+            eng.request_swap(bad)
+        assert eng._pending_swap is None
+        eng.close()
+
+    def test_fault_site_fails_push_not_weights(self, tmp_path):
+        """Chaos `serving.swap`: an armed error lands as outcome=failed
+        (with the event trail) and NEVER reaches the live weights;
+        repeated failures blacklist the step."""
+        m, _ = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="hs6")
+        _save(tmp_path, _params(m), 100)
+        hsm = HotSwapManager(eng, str(tmp_path), poll_s=999, canary=False)
+        inject.configure("serving.swap", times=3)
+        for i in range(3):
+            rec = hsm.poll_once()
+            assert rec["outcome"] == "failed"
+            assert eng.weights_step is None  # never swapped
+        assert 100 in hsm.rejected  # 3 strikes: stop retrying the push
+        assert hsm.poll_once() is None
+        ev = _swap_events("fail")
+        assert len(ev) == 3 and ev[-1]["blacklisted"]
+        inject.reset()
+        eng.close()
+
+    def test_probe_batch_shape_and_determinism(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="hs7")
+        ids = default_probe_batch(eng)
+        assert ids.shape[0] == 2 and 2 <= ids.shape[1] <= 32
+        assert ids.min() >= 1 and ids.max() < cfg.vocab_size
+        assert np.array_equal(ids, default_probe_batch(eng))
+        p1 = eng.run_canary(ids)
+        p2 = eng.run_canary(ids)
+        assert np.isfinite(p1) and p1 == p2
+        eng.close()
+
+
+class TestSwapPreemptionInterleave:
+    def test_preempted_mid_swap_request_resumes_on_new_weights(
+            self, tmp_path):
+        """The satellite audit: a request preempted while a swap is
+        pending resumes (same trace id) and completes on the post-swap
+        weights, with zero leaked pages and intact refcounts."""
+        m, cfg = _model()
+        # pool sized to force a preemption mid-run (see test_serving's
+        # pool-exhaustion test: 2 x 24-token sequences on 5 usable pages)
+        eng = ServingEngine(m, max_batch=2, max_len=40, page_size=8,
+                            num_pages=6, name="hsx")
+        rng = np.random.default_rng(17)
+        prompts = [rng.integers(1, cfg.vocab_size, (14,)).tolist()
+                   for _ in range(2)]
+        reqs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        traces = [r.trace_id for r in reqs]
+        for _ in range(3):
+            eng.step()  # admit + a few decode iterations on old weights
+
+        # stage a swap while both requests are in flight (threadless +
+        # pending: it must NOT apply synchronously here...)
+        _save(tmp_path, _params(m), 100)
+        hsm = HotSwapManager(eng, str(tmp_path), poll_s=999, canary=False)
+        rec = hsm.poll_once()
+        assert rec["outcome"] == "staged"
+        assert eng._pending_swap is not None and eng.weights_step is None
+
+        # ...it lands at the next iteration boundary, in-flight intact
+        eng.step()
+        assert eng.weights_step == 100
+        # pool pressure may have preempted one already; at least one
+        # request rode through the swap in place
+        assert eng.last_swap["in_flight"] >= 1
+
+        eng.run_until_idle()
+        assert eng.stats["preemptions"] >= 1
+        assert sum(r.preemptions for r in reqs) >= 1
+        for p, r in zip(prompts, reqs):
+            out = r.result(timeout=10)
+            assert len(out) == 12 and r.state == "done"
+            # same weights before/after: the interleaved swap +
+            # preemption must not change greedy decode
+            ids = paddle.to_tensor(np.asarray([p], np.int32))
+            ref = np.asarray(m.generate_paged(ids, 12, page_size=8).data)
+            assert out == ref[0, len(p):].tolist()
+        assert [r.trace_id for r in reqs] == traces
+        # the no-leak audit: every page refcount returned to the pool
+        assert eng.allocator.outstanding() == {}
+        assert eng.status()["free_pages"] == eng.cache.num_pages - 1
+        eng.close()

@@ -292,6 +292,14 @@ class TestMetricNamingLint:
         _srv._M_TTFT.observe(0.07, model="gpt", path="eager")
         _srv._M_TPOT.observe(0.02, model="gpt", path="eager")
         _srv._M_GOODPUT.inc(8, model="gpt")
+        # self-healing serving families: hot-swap lifecycle (model=,
+        # outcome=), swap pause histogram + applied-step gauge (model=),
+        # watchdog restarts (model=, reason=), suspension gauge (model=)
+        _srv._M_SWAP_TOTAL.inc(1.0, model="gpt", outcome="applied")
+        _srv._M_SWAP_PAUSE.observe(0.003, model="gpt")
+        _srv._M_SWAP_STEP.set(100, model="gpt")
+        _srv._M_RESTARTS.inc(model="gpt", reason="wedged")
+        _srv._M_SUSPENDED.set(0, model="gpt")
         _at._M_EVENTS.inc(event="hit", op="paged_attn")
         _at._M_TUNES.inc(op="paged_attn")
         _at._M_CHOSEN.set(1.0, op="paged_attn", config="impl1-heads12")

@@ -111,6 +111,7 @@ class TestBert:
 
 
 class TestErnie:
+    @pytest.mark.slow  # full pretrain step; the jit roundtrip below stays fast
     def test_forward_and_loss_decreases(self):
         from paddle_tpu.models.ernie import ErnieConfig, ErnieForPretraining
         paddle.seed(0)

@@ -718,3 +718,66 @@ class TestServingObservabilityEndpoints:
         assert doc["queue_depth"] >= 2 and doc["limit"] == 2
         assert "saturated" in doc["error"]
         eng.run_until_idle()  # drain
+
+    def test_generate_routes_by_model_name(self, srv):
+        a = self._engine(name="obs_route_a", max_batch=1)
+        b = self._engine(name="obs_route_b", max_batch=1)
+        for name in (a.name, b.name):
+            status, body = _post(srv.port, "/generate", json.dumps(
+                {"prompt": [1, 2, 3], "max_new_tokens": 2,
+                 "model": name, "temperature": 0.0}))
+            assert status == 200, body
+            assert json.loads(body)["model"] == name
+        # unknown name: 503 naming the missing model, never a silent
+        # fallback to whichever engine happens to be newest
+        status, body = _post(srv.port, "/generate", json.dumps(
+            {"prompt": [1, 2], "model": "obs_route_nope"}))
+        assert status == 503
+        doc = json.loads(body)
+        assert "no serving engine named 'obs_route_nope'" in doc["error"]
+        assert doc["model"] == "obs_route_nope"
+
+    def test_generate_suspended_is_503_with_retry_after(self, srv):
+        eng = self._engine(name="obs_susp", max_batch=1)
+        eng.suspend(reason="memory_pressure", retry_after_s=4.0)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"prompt": [1, 2, 3],
+                             "model": "obs_susp"}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                status, body, hdrs = (r.status, r.read().decode(),
+                                      dict(r.headers))
+        except urllib.error.HTTPError as e:
+            status, body, hdrs = e.code, e.read().decode(), dict(e.headers)
+        assert status == 503
+        doc = json.loads(body)
+        assert "suspended" in doc["error"] and "memory_pressure" in \
+            doc["error"]
+        assert doc["retry_after_s"] == 4.0
+        assert hdrs["Retry-After"] == "4"  # degradation is machine-usable
+        eng.resume_admissions()
+        status, body = _post(srv.port, "/generate", json.dumps(
+            {"prompt": [1, 2, 3], "max_new_tokens": 2,
+             "model": "obs_susp", "temperature": 0.0}))
+        assert status == 200, body
+
+    def test_healthz_reports_serving_stall(self, srv, monkeypatch):
+        eng = self._engine(name="obs_hz", max_batch=1)
+        eng.submit(list(range(1, 6)), max_new_tokens=2)
+        monkeypatch.setattr(eng, "_last_progress",
+                            eng._last_progress - 3600.0)
+        monkeypatch.setattr(srv, "stall_after", 1.0)
+        status, body, _ = _get(srv.port, "/healthz")
+        assert status == 503
+        doc = json.loads(body)
+        assert doc["status"] == "stalled"
+        assert doc["stalled_by"] == "serving:obs_hz"
+        s = doc["serving"]["obs_hz"]
+        assert s["wedged"] is True and s["pending"] >= 1
+        assert s["last_progress_age_s"] > 1.0
+        assert s["suspended"] is False
+        eng.run_until_idle()  # drain: healthz is clean again
+        status, body, _ = _get(srv.port, "/healthz")
+        assert json.loads(body).get("stalled_by") != "serving:obs_hz"

@@ -280,7 +280,7 @@ class TestGPTDecodeParity:
         m.eval()
         return m, cfg
 
-    @pytest.mark.slow  # dense-vs-paged walk; the interpret sibling stays fast
+    @pytest.mark.slow  # dense-vs-paged walk; prefill/contract siblings stay fast
     def test_greedy_tokens_match_dense(self):
         m, cfg = self._model()
         rng = np.random.default_rng(0)
@@ -290,7 +290,8 @@ class TestGPTDecodeParity:
         paged = np.asarray(m.generate_paged(ids, 8, page_size=8).data)
         np.testing.assert_array_equal(dense, paged)
 
-    def test_greedy_parity_on_pallas_interpret(self, interp):
+    @pytest.mark.slow  # interpret-mode kernel walk; prefill/contract/bucketed
+    def test_greedy_parity_on_pallas_interpret(self, interp):  # stay fast
         """Same parity with the decode attention on the Pallas kernel
         (interpret mode): tokens still match the dense path."""
         m, cfg = self._model()

@@ -322,6 +322,7 @@ class TestHealthProbeWiring:
     """r06 satellite: the PR-9 sentinel in the pipeline engine's compiled
     step (regression per parallelism mode; hybrid has its own sibling)."""
 
+    @pytest.mark.slow  # full pipeline trace; test_health_off_default stays fast
     def test_sentinel_records_on_pipeline_step(self):
         cfg = GPTConfig.tiny()
         hcg = _setup({"pp": 2})

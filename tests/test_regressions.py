@@ -78,6 +78,7 @@ def test_adamw_decay_exclusion():
     np.testing.assert_allclose(p_b.numpy(), [1.0, 1.0])  # excluded
 
 
+@pytest.mark.slow  # thread-churn soak; the dataloader fast paths stay tier-1
 def test_dataloader_abandoned_iterator_no_leak():
     import gc
     import threading

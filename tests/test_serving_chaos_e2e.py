@@ -1,0 +1,289 @@
+"""Chaos drills for the self-healing serving plane: the full
+detect->decide->actuate->recover loop under a live decode thread, with
+fault injection driving the failures. Each drill asserts the event
+trail (serving_swap / serving_restart / controller_decision), trace-id
+continuity, and the zero-page-leak audit — the properties the fast
+tests pin piecewise.
+
+fast-sibling: tests/test_hotswap.py
+fast-sibling: tests/test_serving_controller.py
+"""
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.controller import FleetController
+from paddle_tpu.distributed.sharded_checkpoint import ShardedCheckpointManager
+from paddle_tpu.fault import inject
+from paddle_tpu.inference.governor import MemoryGovernor
+from paddle_tpu.inference.hotswap import HotSwapManager
+from paddle_tpu.inference.serving import EngineSuspended, ServingEngine
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.profiler import events
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    events.default_event_log().clear()
+    inject.reset()
+    yield
+    inject.reset()
+    events.default_event_log().clear()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _shared_compile_cache():
+    from paddle_tpu.framework import flags as flags_mod
+    cache = os.path.join(tempfile.gettempdir(), "pt_serving_ccache")
+    os.makedirs(cache, exist_ok=True)
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": cache})
+    yield
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=512, max_position_embeddings=128,
+                    hidden_size=32, num_layers=2, num_heads=2,
+                    dropout=0.0, attn_dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _params(m):
+    return {k: p.data for k, p in m.named_parameters()}
+
+
+def _save(tmpdir, state, step):
+    mgr = ShardedCheckpointManager(str(tmpdir), prefix="ckpt",
+                                   keep_last_n=10)
+    assert mgr.save(state, step=step)
+
+
+def _amplified(state, factor=50.0):
+    return {k: paddle.to_tensor(
+                (np.asarray(v) * factor).astype(np.asarray(v).dtype))
+            for k, v in state.items()}
+
+
+def _ctl(engines, **kw):
+    kw.setdefault("confirm_windows", 3)
+    kw.setdefault("readmit_after_s", 9999)
+    kw.setdefault("restart_cooldown_s", 9999.0)
+    kw.setdefault("swap_observe_s", 9999.0)
+
+    class _Agg:
+        straggler_factor = 2.0
+        last = {}
+
+        def straggling(self):
+            return []
+    return FleetController(_Agg(), None, world_size=1,
+                           serving_provider=lambda: list(engines), **kw)
+
+
+def _decisions(policy):
+    return [e for e in events.recent(200, kind="controller_decision")
+            if e.get("policy") == policy]
+
+
+class TestWedgeRestartDrill:
+    def test_wedged_loop_is_restarted_and_requests_complete(
+            self, monkeypatch):
+        """Inject `serving.wedge` (delay) into a LIVE decode loop until
+        the controller's liveness watchdog confirms the stall and
+        restarts the engine; every in-flight request must complete with
+        its original trace id and zero pages may leak."""
+        monkeypatch.setenv("PADDLE_TPU_HEALTH_STALL_SEC", "0.4")
+        monkeypatch.setenv("PADDLE_TPU_FAULT_DELAY", "1.0")
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=64, page_size=8,
+                            name="chaos-wedge")
+        ctl = _ctl([eng], wedge_windows=2, dry_run=False)
+        eng.start(poll_s=0.005)
+        try:
+            # wedge every iteration BEFORE submitting: each step sleeps
+            # 1s, so the loop makes (slow) progress but spends most of
+            # each cycle past the 0.4s stall window — and the requests
+            # (24 tokens at ~1 token/s) cannot finish before the
+            # watchdog fires
+            inject.configure("serving.wedge", times=10_000, kind="delay")
+            rng = np.random.default_rng(3)
+            prompts = [rng.integers(1, cfg.vocab_size, (8,)).tolist()
+                       for _ in range(2)]
+            reqs = [eng.submit(p, max_new_tokens=24) for p in prompts]
+            traces = [r.trace_id for r in reqs]
+            # wait for both to be admitted into decode slots so the
+            # restart exercises the in-flight requeue path
+            deadline = time.time() + 20
+            while (sum(s is not None for s in eng._slots) < 2
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            assert sum(s is not None for s in eng._slots) == 2
+            for _ in range(200):
+                ctl.on_collect({})
+                if _decisions("serving_restart"):
+                    break
+                time.sleep(0.25)
+            d = _decisions("serving_restart")
+            assert d and d[-1]["outcome"] == "applied", \
+                "watchdog never confirmed the wedge"
+            inject.reset()  # the relaunched loop runs clean
+
+            for p, r in zip(prompts, reqs):
+                out = r.result(timeout=60)
+                assert len(out) == 24 and r.state == "done"
+                ids = paddle.to_tensor(np.asarray([p], np.int32))
+                ref = np.asarray(
+                    m.generate_paged(ids, 24, page_size=8).data)
+                assert out == ref[0, len(p):].tolist(), \
+                    "restart changed greedy decode"
+            assert [r.trace_id for r in reqs] == traces
+            assert eng.stats["restarts"] == 1
+
+            rest = events.recent(50, kind="serving_restart")
+            assert len(rest) == 1
+            assert rest[0]["reason"] == "wedged"
+            assert rest[0]["requeued"] == 2
+            assert rest[0]["restarted_thread"] is True
+        finally:
+            inject.reset()
+            eng.close()
+        assert eng.allocator.outstanding() == {}
+
+
+class TestBadPushDrill:
+    def test_background_poller_rejects_bad_push_while_serving(self):
+        """A confidently-wrong checkpoint lands in the watch dir while
+        the engine serves traffic: the background poller's canary must
+        reject it without ever touching the live weights."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=64, page_size=8,
+                            name="chaos-push")
+        with tempfile.TemporaryDirectory() as d:
+            state = _params(m)
+            _save(d, state, 100)
+            hsm = HotSwapManager(eng, d, poll_s=0.05, canary=True,
+                                 canary_tol=0.10)
+            eng.start(poll_s=0.005)
+            hsm.start()
+            try:
+                deadline = time.time() + 30
+                while hsm.current_step != 100 and time.time() < deadline:
+                    time.sleep(0.02)
+                assert hsm.current_step == 100  # baseline push applied
+
+                r1 = eng.submit([5, 9, 3, 17], max_new_tokens=8)
+                good = r1.result(timeout=30)
+
+                _save(d, _amplified(state), 200)
+                deadline = time.time() + 30
+                while 200 not in hsm.rejected and time.time() < deadline:
+                    time.sleep(0.02)
+                assert 200 in hsm.rejected, "canary never rejected step 200"
+                assert eng.weights_step == 100  # live weights untouched
+
+                r2 = eng.submit([5, 9, 3, 17], max_new_tokens=8)
+                assert r2.result(timeout=30) == good, \
+                    "rejected push changed live decode"
+                acts = [e["action"] for e in
+                        events.recent(100, kind="serving_swap")]
+                assert acts.count("reject") == 1
+                assert acts[:2] == ["stage", "swap"]  # the good baseline
+            finally:
+                hsm.stop()
+                eng.close()
+
+
+class TestForcedRegressionRollbackDrill:
+    def test_controller_rolls_back_a_forced_bad_swap(self):
+        """An operator force-pushes a blacklisted step; the controller's
+        post-swap watch sees the canary regression and rolls back to the
+        prior step automatically, leaving greedy decode bit-identical to
+        the pre-push engine."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="chaos-roll")
+        ctl = _ctl([eng], max_swap_rollbacks=2, dry_run=False)
+        with tempfile.TemporaryDirectory() as d:
+            state = _params(m)
+            _save(d, state, 100)
+            hsm = HotSwapManager(eng, d, poll_s=999, canary=True)
+            eng.hotswap = hsm
+            assert hsm.poll_once()["outcome"] == "staged"
+            before = eng.generate([7, 1, 30, 2], max_new_tokens=8)["tokens"]
+            ctl.on_collect({})  # healthy baseline: nothing to do
+            assert _decisions("serving_swap_rollback") == []
+
+            _save(d, _amplified(state), 200)
+            rec = hsm.try_swap(step=200, force=True)
+            assert rec["outcome"] == "staged" and rec["forced"]
+            assert eng.weights_step == 200 and hsm.vetted is False
+
+            ctl.on_collect({})  # the watch fires on this tick
+            d2 = _decisions("serving_swap_rollback")
+            assert len(d2) == 1 and d2[0]["outcome"] == "applied"
+            assert d2[0]["evidence"]["reason"] == "canary"
+            assert eng.weights_step == 100 and hsm.vetted is True
+            after = eng.generate([7, 1, 30, 2], max_new_tokens=8)["tokens"]
+            assert after == before, "rollback did not restore decode"
+            acts = [e["action"] for e in
+                    events.recent(100, kind="serving_swap")]
+            # baseline push, forced push, then the restore (a rollback
+            # stages the prior weights like any other swap)
+            assert acts == ["stage", "swap", "stage", "swap",
+                            "stage", "rollback"]
+        eng.close()
+
+
+class TestMemoryPressureDrill:
+    def test_governor_degrades_and_recovers_under_live_load(self):
+        """Two co-resident engines under memory pressure: the governor
+        shrinks then suspends the low-priority one (503-style refusal
+        with Retry-After) while the high-priority engine keeps serving;
+        when pressure clears both recover and serve again."""
+        m, cfg = _model()
+        hi = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                           name="chaos-hi", priority=10)
+        lo = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                           name="chaos-lo", priority=1)
+        hi.start(poll_s=0.005)
+        lo.start(poll_s=0.005)
+        pressure = {"bytes": 100}
+        gov = MemoryGovernor(limit_bytes=50, retry_after_s=2.5,
+                             sampler=lambda: pressure["bytes"],
+                             engines=lambda: [hi, lo])
+        try:
+            # keep lo busy so suspension provably spares in-flight work
+            busy = lo.submit([9, 2, 4], max_new_tokens=8)
+            assert gov.tick()["action"] == "shrink_pool"
+            assert gov.tick()["action"] == "suspend"
+            with pytest.raises(EngineSuspended) as ei:
+                lo.submit([1, 2, 3], max_new_tokens=4)
+            assert ei.value.retry_after_s == 2.5
+            # the suspension refuses ADMISSION only: in-flight drains...
+            assert len(busy.result(timeout=30)) == 8
+            # ...and the high-priority engine never stopped serving
+            r = hi.submit([1, 2, 3], max_new_tokens=4)
+            assert len(r.result(timeout=30)) == 4
+
+            pressure["bytes"] = 10
+            seen = []
+            for _ in range(4):
+                rec = gov.tick()
+                if rec:
+                    seen.append(rec["action"])
+            assert seen == ["resume", "restore_pool"]
+            assert gov.status()["degraded"] == {}
+            r = lo.submit([1, 2, 3], max_new_tokens=4)
+            assert len(r.result(timeout=30)) == 4
+        finally:
+            hi.close()
+            lo.close()

@@ -1,0 +1,442 @@
+"""SLO-driven serving policies (distributed/fleet/controller.py) +
+budget-based degradation (inference/governor.py): wedge-watchdog
+restart with confirm-streak debounce and cooldown, shed/un-shed on
+sustained breach, post-swap canary/SLO rollback with the max-rollbacks
+halt breaker, the MemoryGovernor shrink->suspend ladder, and the
+engine-side actuators (queue cap, suspend, pool shrink).
+
+These are the fast tier-1 siblings of the slow chaos e2e in
+tests/test_serving_chaos_e2e.py.
+"""
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.controller import FleetController
+from paddle_tpu.inference.governor import MemoryGovernor
+from paddle_tpu.inference.serving import EngineSuspended, ServingEngine
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.profiler import events
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    events.default_event_log().clear()
+    yield
+    events.default_event_log().clear()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _shared_compile_cache():
+    """Shared persistent-compile-cache dir (see test_serving.py) for the
+    real-engine governor/actuator tests below."""
+    from paddle_tpu.framework import flags as flags_mod
+    cache = os.path.join(tempfile.gettempdir(), "pt_serving_ccache")
+    os.makedirs(cache, exist_ok=True)
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": cache})
+    yield
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
+
+
+# -- scripted engine/manager doubles (the policy tests drive evidence,
+# -- not XLA) ----------------------------------------------------------------
+class _FakeSLO:
+    def __init__(self):
+        self.breaches = {}
+
+    def breached(self):
+        return dict(self.breaches)
+
+
+class _FakeHotswap:
+    def __init__(self):
+        self.vetted = True
+        self.halted = False
+        self.swapped_ts = None
+        self.current_step = -1
+        self.regress = None
+        self.calls = []
+
+    def post_swap_regressed(self):
+        return self.regress
+
+    def rollback(self, reason):
+        self.calls.append(("rollback", reason))
+        self.vetted = True
+        self.swapped_ts = None
+
+    def halt(self, reason):
+        self.calls.append(("halt", reason))
+        self.halted = True
+
+
+class _FakeEngine:
+    def __init__(self, name="gpt"):
+        self.name = name
+        self.priority = 0
+        self._closed = False
+        self.slo = _FakeSLO()
+        self.hotswap = _FakeHotswap()
+        self.queue_limit = None
+        self.is_wedged = False
+        self.restarts = []
+        self.restart_error = None
+
+    def wedged(self, stall_after=None):
+        return self.is_wedged
+
+    def last_progress_age(self):
+        return 12.0 if self.is_wedged else 0.0
+
+    def queue_depth(self):
+        return 3
+
+    def set_queue_limit(self, limit):
+        self.queue_limit = limit
+
+    def restart(self, reason="wedged"):
+        if self.restart_error is not None:
+            raise self.restart_error
+        self.restarts.append(reason)
+        self.is_wedged = False
+        return {"requeued": 1, "leaked_pages": 0, "restarted_thread": True}
+
+
+class _Agg:
+    def __init__(self):
+        self._straggling = []
+        self.straggler_factor = 2.0
+        self.last = {}
+
+    def straggling(self):
+        return list(self._straggling)
+
+
+def _ctl(engines, **kw):
+    agg = _Agg()
+    kw.setdefault("confirm_windows", 3)
+    kw.setdefault("readmit_after_s", 9999)
+    kw.setdefault("wedge_windows", 2)
+    kw.setdefault("slo_windows", 2)
+    kw.setdefault("shed_queue_cap", 4)
+    kw.setdefault("restart_cooldown_s", 9999.0)
+    kw.setdefault("swap_observe_s", 9999.0)
+    kw.setdefault("max_swap_rollbacks", 1)
+    ctl = FleetController(agg, None, world_size=2,
+                          serving_provider=lambda: list(engines), **kw)
+    return ctl, agg
+
+
+def _tick(ctl, agg):
+    ctl.on_collect(agg.last)
+
+
+def _decisions(policy=None):
+    out = [e for e in events.recent(200, kind="controller_decision")
+           if e.get("action") != "relaunch_observed"]
+    return [e for e in out if policy is None or e.get("policy") == policy]
+
+
+class TestWedgeWatchdog:
+    def test_confirm_streak_then_restart_then_cooldown(self):
+        eng = _FakeEngine()
+        ctl, agg = _ctl([eng])
+        eng.is_wedged = True
+        _tick(ctl, agg)                       # streak 1 of 2: no action
+        assert eng.restarts == [] and _decisions("serving_restart") == []
+        _tick(ctl, agg)                       # confirmed: restart
+        assert eng.restarts == ["wedged"]
+        d = _decisions("serving_restart")
+        assert len(d) == 1 and d[0]["outcome"] == "applied"
+        assert d[0]["action"] == "restart" and d[0]["target"] == eng.name
+        # wedged again immediately: cooldown holds the trigger
+        eng.is_wedged = True
+        _tick(ctl, agg)
+        _tick(ctl, agg)
+        assert len(eng.restarts) == 1
+        assert ctl.status()["serving"]["wedge_streaks"][eng.name] >= 2
+
+    def test_recovery_clears_the_streak(self):
+        eng = _FakeEngine()
+        ctl, agg = _ctl([eng])
+        eng.is_wedged = True
+        _tick(ctl, agg)
+        eng.is_wedged = False
+        _tick(ctl, agg)                       # healthy window resets
+        eng.is_wedged = True
+        _tick(ctl, agg)                       # streak back to 1
+        assert eng.restarts == []
+
+    def test_failed_restart_is_a_failed_decision_without_cooldown(self):
+        eng = _FakeEngine()
+        eng.restart_error = RuntimeError("decode loop did not stop")
+        ctl, agg = _ctl([eng])
+        eng.is_wedged = True
+        with pytest.warns(UserWarning, match="could not actuate"):
+            _tick(ctl, agg)
+            _tick(ctl, agg)
+        d = _decisions("serving_restart")
+        assert d and d[-1]["outcome"] == "failed"
+        # no cooldown on failure: the next confirmed tick retries
+        eng.restart_error = None
+        _tick(ctl, agg)
+        assert eng.restarts == ["wedged"]
+
+    def test_one_sick_engine_does_not_mute_the_others(self):
+        bad, good = _FakeEngine("bad"), _FakeEngine("good")
+
+        def _boom(*a, **k):
+            raise RuntimeError("boom")
+        bad.wedged = _boom  # blows up inside the policy tick
+        ctl, agg = _ctl([bad, good])
+        good.is_wedged = True
+        with pytest.warns(UserWarning, match="serving policy tick"):
+            _tick(ctl, agg)
+            _tick(ctl, agg)
+        assert good.restarts == ["wedged"]
+
+
+class TestSheddingPolicy:
+    def test_sustained_breach_sheds_and_recovery_unsheds(self):
+        eng = _FakeEngine()
+        ctl, agg = _ctl([eng])
+        eng.slo.breaches = {"ttft": {"value": 0.9}}
+        _tick(ctl, agg)
+        assert eng.queue_limit is None        # streak 1 of 2
+        _tick(ctl, agg)
+        assert eng.queue_limit == 4           # shed at the configured cap
+        d = _decisions("serving_shed")
+        assert d[-1]["action"] == "shed"
+        assert d[-1]["evidence"]["breached"] == ["ttft"]
+        assert ctl.status()["serving"]["shed"] == [eng.name]
+        # two clean windows: un-shed
+        eng.slo.breaches = {}
+        _tick(ctl, agg)
+        assert eng.queue_limit == 4
+        _tick(ctl, agg)
+        assert eng.queue_limit is None
+        assert _decisions("serving_shed")[-1]["action"] == "unshed"
+        assert ctl.status()["serving"]["shed"] == []
+
+    def test_non_admission_signals_do_not_shed(self):
+        """tpot/e2e breaches are decode-side — a queue cap cannot
+        relieve them, so the shed policy must ignore them."""
+        eng = _FakeEngine()
+        ctl, agg = _ctl([eng])
+        eng.slo.breaches = {"tpot": {}, "e2e": {}}
+        for _ in range(4):
+            _tick(ctl, agg)
+        assert eng.queue_limit is None
+        assert _decisions("serving_shed") == []
+
+
+class TestSwapRollbackPolicy:
+    def _swapped(self, eng, step=200):
+        eng.hotswap.vetted = False
+        eng.hotswap.swapped_ts = time.time()
+        eng.hotswap.current_step = step
+
+    def test_canary_regression_rolls_back(self):
+        eng = _FakeEngine()
+        ctl, agg = _ctl([eng])
+        self._swapped(eng)
+        eng.hotswap.regress = {"regressed": True, "live_ppl": 9000.0,
+                               "baseline_ppl": 500.0, "tol": 0.1}
+        _tick(ctl, agg)
+        assert eng.hotswap.calls == [("rollback", "canary")]
+        d = _decisions("serving_swap_rollback")
+        assert d[-1]["outcome"] == "applied"
+        assert d[-1]["evidence"]["reason"] == "canary"
+        assert d[-1]["evidence"]["live_ppl"] == 9000.0
+
+    def test_slo_breach_inside_observe_window_rolls_back(self):
+        eng = _FakeEngine()
+        ctl, agg = _ctl([eng])
+        self._swapped(eng)
+        eng.slo.breaches = {"tpot": {}}
+        _tick(ctl, agg)
+        assert eng.hotswap.calls == [("rollback", "slo:tpot")]
+
+    def test_healthy_swap_is_vetted_after_observe_window(self):
+        eng = _FakeEngine()
+        ctl, agg = _ctl([eng], swap_observe_s=0.0)
+        self._swapped(eng)
+        time.sleep(0.01)
+        _tick(ctl, agg)
+        assert eng.hotswap.vetted is True
+        assert eng.hotswap.calls == []
+        assert _decisions("serving_swap_rollback") == []
+
+    def test_max_rollbacks_trips_the_halt_breaker(self):
+        eng = _FakeEngine()
+        ctl, agg = _ctl([eng], max_swap_rollbacks=1)
+        self._swapped(eng)
+        eng.hotswap.regress = {"regressed": True, "live_ppl": 9.0,
+                               "baseline_ppl": 1.0, "tol": 0.1}
+        _tick(ctl, agg)                       # rollback #1
+        assert eng.hotswap.calls == [("rollback", "canary")]
+        self._swapped(eng, step=300)          # a second bad push lands
+        _tick(ctl, agg)                       # #2 > max: roll AND halt
+        assert eng.hotswap.calls[1:] == [("rollback", "canary"),
+                                         ("halt", "max_rollbacks")]
+        assert eng.hotswap.halted
+        d = _decisions("serving_swap_halt")
+        assert len(d) == 1 and d[0]["evidence"]["rollbacks"] == 2
+        # a halted manager is left alone from then on
+        _tick(ctl, agg)
+        assert len(eng.hotswap.calls) == 3
+
+    def test_dry_run_records_but_does_not_actuate(self):
+        eng = _FakeEngine()
+        ctl, agg = _ctl([eng], dry_run=True)
+        self._swapped(eng)
+        eng.hotswap.regress = {"regressed": True, "live_ppl": 9.0,
+                               "baseline_ppl": 1.0, "tol": 0.1}
+        eng.is_wedged = True
+        _tick(ctl, agg)
+        _tick(ctl, agg)
+        assert eng.hotswap.calls == [] and eng.restarts == []
+        recs = _decisions()
+        assert recs and all(r["outcome"] == "dry_run" for r in recs)
+
+
+# -- the real engine actuators + the memory governor -------------------------
+def _model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, max_position_embeddings=128,
+                    hidden_size=32, num_layers=2, num_heads=2,
+                    dropout=0.0, attn_dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m, cfg
+
+
+class TestEngineActuators:
+    def test_queue_cap_sheds_submit(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                            name="cap")
+        eng.set_queue_limit(2)
+        eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.submit([4, 5, 6], max_new_tokens=2)
+        with pytest.raises(RuntimeError, match="shed cap"):
+            eng.submit([7, 8, 9], max_new_tokens=2)
+        eng.set_queue_limit(None)
+        eng.submit([7, 8, 9], max_new_tokens=2)   # uncapped again
+        eng.run_until_idle()
+        eng.close()
+
+    def test_suspend_refuses_admission_with_retry_after(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                            name="susp")
+        eng.suspend(reason="memory_pressure", retry_after_s=7.5)
+        with pytest.raises(EngineSuspended) as ei:
+            eng.submit([1, 2, 3], max_new_tokens=2)
+        assert ei.value.retry_after_s == 7.5
+        assert ei.value.reason == "memory_pressure"
+        assert eng.status()["suspended"]["reason"] == "memory_pressure"
+        eng.resume_admissions()
+        r = eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.run_until_idle()
+        assert len(r.result(timeout=10)) == 2
+        eng.close()
+
+    def test_shrink_and_restore_pool(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="shrink")
+        free0 = eng.allocator.free_pages
+        parked = eng.shrink_pool()
+        assert parked == max(1, (eng.cache.num_pages - 1) // 2)
+        assert eng.allocator.free_pages == free0 - parked
+        assert eng.allocator.reserved_pages == parked
+        restored = eng.restore_pool()
+        assert restored == parked and eng.allocator.free_pages == free0
+        eng.close()
+
+    def test_mem_budget_caps_the_page_pool(self):
+        m, cfg = _model()
+        ref = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="ref")
+        full_pages = ref.cache.num_pages
+        budget = ref.pool_bytes() // 2
+        ref.close()
+        eng = ServingEngine(m, max_batch=2, max_len=48, page_size=8,
+                            name="budget", mem_budget_bytes=budget)
+        assert eng.cache.num_pages < full_pages
+        assert eng.pool_bytes() <= budget
+        capped = eng.status()["budget_capped_pages"]
+        assert capped == (full_pages, eng.cache.num_pages)
+        eng.close()
+
+
+class TestMemoryGovernor:
+    def _engines(self):
+        m, _ = _model()
+        hi = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                           name="hi", priority=10)
+        lo = ServingEngine(m, max_batch=1, max_len=48, page_size=8,
+                           name="lo", priority=1)
+        return hi, lo
+
+    def test_inert_without_a_limit(self):
+        hi, lo = self._engines()
+        gov = MemoryGovernor(limit_bytes=0, sampler=lambda: 10**12,
+                             engines=lambda: [hi, lo])
+        assert gov.tick() is None
+        hi.close(), lo.close()
+
+    def test_ladder_degrades_lowest_priority_then_recovers(self):
+        hi, lo = self._engines()
+        pressure = {"bytes": 100}
+        gov = MemoryGovernor(limit_bytes=50, retry_after_s=3.0,
+                             sampler=lambda: pressure["bytes"],
+                             engines=lambda: [hi, lo])
+        d1 = gov.tick()                       # rung 1: shrink lo's pool
+        assert (d1["action"], d1["model"]) == ("shrink_pool", "lo")
+        assert lo.allocator.reserved_pages > 0
+        d2 = gov.tick()                       # rung 2: suspend lo
+        assert (d2["action"], d2["model"]) == ("suspend", "lo")
+        with pytest.raises(EngineSuspended):
+            lo.submit([1, 2, 3], max_new_tokens=2)
+        hi.submit([1, 2, 3], max_new_tokens=2)  # hi keeps serving
+        hi.run_until_idle()
+        d3 = gov.tick()                       # lo exhausted: shrink hi
+        assert (d3["action"], d3["model"]) == ("shrink_pool", "hi")
+        assert gov.status()["degraded"] == {"lo": "suspended",
+                                            "hi": "shrunk"}
+
+        pressure["bytes"] = 10                # pressure clears (hysteresis)
+        d4 = gov.tick()                       # highest priority first
+        assert (d4["action"], d4["model"]) == ("restore_pool", "hi")
+        d5 = gov.tick()
+        assert (d5["action"], d5["model"]) == ("resume", "lo")
+        lo.submit([1, 2, 3], max_new_tokens=2)
+        lo.run_until_idle()
+        d6 = gov.tick()
+        assert (d6["action"], d6["model"]) == ("restore_pool", "lo")
+        assert gov.status()["degraded"] == {}
+        assert gov.tick() is None             # steady state
+        kinds = [e["action"] for e in
+                 events.recent(50, kind="controller_decision")
+                 if e.get("policy") == "serving_memory"]
+        assert kinds == ["shrink_pool", "suspend", "shrink_pool",
+                         "restore_pool", "resume", "restore_pool"]
+        hi.close(), lo.close()
+
+    def test_hysteresis_band_holds_state(self):
+        hi, lo = self._engines()
+        pressure = {"bytes": 100}
+        gov = MemoryGovernor(limit_bytes=50, resume_frac=0.85,
+                             sampler=lambda: pressure["bytes"],
+                             engines=lambda: [hi, lo])
+        gov.tick()
+        pressure["bytes"] = 45                # below limit, above 0.85*50
+        assert gov.tick() is None             # no flapping
+        assert gov.status()["degraded"] == {"lo": "shrunk"}
+        hi.close(), lo.close()

@@ -561,6 +561,7 @@ class TestConvBnCrossProcessCache:
                 return json.loads(line[len("RESULT"):])
         raise AssertionError(f"child printed no RESULT: {proc.stdout!r}")
 
+    @pytest.mark.slow  # two child processes; test_changed_space_retunes stays fast
     def test_tune_once_then_hit_without_probes(self, tmp_path):
         a = self._run_child(tmp_path)
         assert a["miss"] == 1 and a["tunes"] == 1 and a["persist"] == 1

@@ -64,9 +64,10 @@ class TestModels:
     @pytest.mark.parametrize("factory,ch", [
         pytest.param(lambda: models.vgg11(num_classes=10), 10,
                      marks=pytest.mark.slow),
-        (lambda: models.mobilenet_v1(scale=0.25, num_classes=10), 10),
+        pytest.param(lambda: models.mobilenet_v1(scale=0.25, num_classes=10),
+                     10, marks=pytest.mark.slow),
         # the slowest-to-trace families keep default coverage via
-        # the v1/alexnet rows; run them with --slow
+        # the alexnet row; run the rest with --slow
         pytest.param(lambda: models.mobilenet_v2(scale=0.25, num_classes=10),
                      10, marks=pytest.mark.slow),
         (lambda: models.alexnet(num_classes=10), 10),

@@ -315,7 +315,8 @@ class TestPeaksCacheRegression:
 
 
 class TestCompileCacheWiring:
-    def test_flag_points_jax_at_persistent_cache(self, tmp_path):
+    @pytest.mark.slow  # child-process cache roundtrip; flag plumbing is
+    def test_flag_points_jax_at_persistent_cache(self, tmp_path):  # pinned fast elsewhere
         """Satellite: PADDLE_TPU_COMPILE_CACHE_DIR -> jax's persistent
         compilation cache, making xla_compile_cache_events_total count
         real hits/misses (it sat at zero with the cache unwired)."""

@@ -454,7 +454,20 @@ _SERVING_FAMILIES = {
     "serving_queue_wait_seconds": ("histogram", ("model",)),
     "serving_prefill_seconds": ("histogram", ("model",)),
     "serving_preempt_requeue_seconds": ("histogram", ("model",)),
+    # self-healing plane (inference/hotswap.py + the engine watchdog)
+    "serving_swap_total": ("counter", ("model", "outcome")),
+    "serving_swap_pause_seconds": ("histogram", ("model",)),
+    "serving_swap_step": ("gauge", ("model",)),
+    "serving_restart_total": ("counter", ("model", "reason")),
+    "serving_suspended": ("gauge", ("model",)),
 }
+
+#: families whose gauge value may legitimately be negative
+#: (serving_swap_step is -1 until a hot-swap lands)
+_SERVING_SIGNED = ("serving_swap_step",)
+
+#: legal `outcome` label values on serving_swap_total
+_SWAP_OUTCOMES = ("applied", "rolled_back", "rejected", "failed")
 
 # serving SLO-plane families (profiler/slo.py): breach excursions and
 # the live window p99 per signal
@@ -509,7 +522,8 @@ def _validate_serving_metrics(where: str, metrics: dict) -> List[str]:
             else:
                 val = v.get("value")
                 if not isinstance(val, (int, float)) or \
-                        isinstance(val, bool) or val != val or val < 0:
+                        isinstance(val, bool) or val != val or \
+                        (val < 0 and name not in _SERVING_SIGNED):
                     problems.append(f"{where}.metrics.{name}[{i}]: value "
                                     f"{val!r} is not a non-negative number")
             labels = v.get("labels") or {}
@@ -521,6 +535,12 @@ def _validate_serving_metrics(where: str, metrics: dict) -> List[str]:
             if path is not None and path not in _SERVING_PATHS:
                 problems.append(f"{where}.metrics.{name}[{i}]: path label "
                                 f"{path!r} is not one of {_SERVING_PATHS}")
+            if name == "serving_swap_total" and \
+                    labels.get("outcome") not in _SWAP_OUTCOMES:
+                problems.append(
+                    f"{where}.metrics.{name}[{i}]: outcome label "
+                    f"{labels.get('outcome')!r} is not one of "
+                    f"{_SWAP_OUTCOMES}")
     return problems
 
 

@@ -70,7 +70,8 @@ except Exception:
 
 SERVING_KINDS = ("serving_admission", "serving_eviction")
 
-SLO_KINDS = ("slo_breach", "request_trace")
+SLO_KINDS = ("slo_breach", "request_trace", "serving_swap",
+             "serving_restart")
 
 ANALYSIS_KINDS = ("analysis_finding",)
 
@@ -138,6 +139,17 @@ def event_matches(rec: dict, kind, host: Optional[str],
     if since_ts and rec.get("ts", 0) < since_ts:
         return False
     return True
+
+
+def scope_slo_decisions(events, args):
+    """--slo without --controller: of the controller_decision stream,
+    only the serving_* policies belong in the SLO view."""
+    if not getattr(args, "slo", False) or getattr(args, "controller",
+                                                  False):
+        return events
+    return [e for e in events
+            if e.get("kind") != "controller_decision"
+            or str(e.get("policy", "")).startswith("serving")]
 
 
 def format_event(rec: dict) -> str:
@@ -313,6 +325,42 @@ def format_slo(rec: dict) -> str:
         if rec.get("preemptions"):
             detail += f" preemptions={rec['preemptions']}"
         detail += f"  [{parts}]"
+    elif kind == "serving_swap":
+        action = rec.get("action", "?")
+        model = rec.get("model", "?")
+        if action in ("swap", "rollback"):
+            pause = rec.get("pause_s")
+            pause_s = f"{1000 * pause:.1f}ms" if isinstance(
+                pause, (int, float)) else "?"
+            detail = (f"{action} {model} weights step "
+                      f"{rec.get('from_step')} -> {rec.get('to_step')} "
+                      f"(pause {pause_s}, {rec.get('in_flight', 0)} "
+                      f"in-flight, source {rec.get('source', '?')})")
+        elif action == "reject":
+            detail = (f"canary REJECTED step {rec.get('to_step')} for "
+                      f"{model}: cand_ppl={rec.get('cand_ppl')} vs "
+                      f"live_ppl={rec.get('live_ppl')} "
+                      f"(tol {rec.get('tol')})")
+        elif action == "fail":
+            detail = (f"load of step {rec.get('to_step')} for {model} "
+                      f"failed ({rec.get('error')}), attempt "
+                      f"#{rec.get('attempts')}"
+                      + (", BLACKLISTED" if rec.get("blacklisted")
+                         else ""))
+        elif action == "halt":
+            detail = (f"hot-swap HALTED for {model}: "
+                      f"{rec.get('reason', '?')} after "
+                      f"{rec.get('rollbacks')} rollback(s) — manual "
+                      f"re-arm required")
+        else:  # stage
+            detail = (f"{action} {model} -> step {rec.get('to_step')} "
+                      f"(source {rec.get('source', '?')})")
+    elif kind == "serving_restart":
+        detail = (f"engine {rec.get('model', '?')} restarted "
+                  f"({rec.get('reason', '?')}): {rec.get('requeued')} "
+                  f"in-flight requeued, {rec.get('leaked_pages')} "
+                  f"leaked page(s), loop "
+                  f"{'relaunched' if rec.get('restarted_thread') else 'left stopped'}")
     else:
         return format_event(rec)
     return (f"{when} {rec.get('severity', 'info'):<5} {kind:<20} "
@@ -361,6 +409,11 @@ def _emit(events, as_json: bool, out=None, diagnose: bool = False,
             line = format_analysis(rec)
         elif slo and rec.get("kind") in SLO_KINDS:
             line = format_slo(rec)
+        elif slo and rec.get("kind") == "controller_decision":
+            # --slo pulls in the controller's serving actions (shed,
+            # restart, swap rollback) so one view tells the whole
+            # breach -> reaction story
+            line = format_controller(rec)
         else:
             line = format_event(rec)
         out.write(line + "\n")
@@ -392,9 +445,10 @@ def follow(path: str, args, poll_s: float = 0.5,
             continue
     lines.extend(f.readlines())  # leaves f at EOF for the tail loop
     events, _ = parse_lines(lines)
-    window = [e for e in events
-              if event_matches(e, args.kind, args.host,
-                               args.min_severity, args.since_ts)]
+    window = scope_slo_decisions(
+        [e for e in events
+         if event_matches(e, args.kind, args.host,
+                          args.min_severity, args.since_ts)], args)
     _emit(window[-args.n:] if args.n else window, args.json,
           diagnose=diagnose, health=health, controller=controller,
           serving=serving, analysis=analysis, slo=slo)
@@ -416,9 +470,11 @@ def follow(path: str, args, poll_s: float = 0.5,
                 time.sleep(poll_s)
                 continue
             recs, _ = parse_lines([line])
-            _emit([r for r in recs
-                   if event_matches(r, args.kind, args.host,
-                                    args.min_severity, args.since_ts)],
+            _emit(scope_slo_decisions(
+                      [r for r in recs
+                       if event_matches(r, args.kind, args.host,
+                                        args.min_severity,
+                                        args.since_ts)], args),
                   args.json, diagnose=diagnose, health=health,
                   controller=controller, serving=serving,
                   analysis=analysis, slo=slo)
@@ -470,7 +526,9 @@ def main(argv=None) -> int:
     ap.add_argument("--slo", action="store_true",
                     help="show the serving SLO plane (slo_breach: signal, "
                          "window quantile vs target; request_trace: "
-                         "per-request phase breakdown) with an "
+                         "per-request phase breakdown; serving_swap / "
+                         "serving_restart and the controller's serving_* "
+                         "decisions: the self-healing reactions) with an "
                          "operator-oriented rendering; filters to those "
                          "kinds unless --kind is given")
     ap.add_argument("--analysis", action="store_true",
@@ -509,12 +567,16 @@ def main(argv=None) -> int:
         else:
             args.kind = (args.kind,) + SERVING_KINDS
     if args.slo:
+        # the SLO view includes the controller's serving actions
+        # (policy serving_*) so breach and reaction read as one stream;
+        # non-serving decisions stay out unless --controller is given
+        slo_kinds = SLO_KINDS + ("controller_decision",)
         if args.kind is None:
-            args.kind = SLO_KINDS
+            args.kind = slo_kinds
         elif isinstance(args.kind, tuple):
-            args.kind = args.kind + SLO_KINDS
+            args.kind = args.kind + slo_kinds
         else:
-            args.kind = (args.kind,) + SLO_KINDS
+            args.kind = (args.kind,) + slo_kinds
     if args.analysis:
         if args.kind is None:
             args.kind = ANALYSIS_KINDS
@@ -554,9 +616,10 @@ def main(argv=None) -> int:
               file=sys.stderr)
     if not events and bad:
         return 2
-    matching = [e for e in events
-                if event_matches(e, args.kind, args.host,
-                                 args.min_severity, args.since_ts)]
+    matching = scope_slo_decisions(
+        [e for e in events
+         if event_matches(e, args.kind, args.host,
+                          args.min_severity, args.since_ts)], args)
     _emit(matching[-args.n:] if args.n else matching, args.json,
           diagnose=args.diagnose, health=args.health,
           controller=args.controller, serving=args.serving,

@@ -26,6 +26,16 @@ import os
 import tempfile
 import time
 
+# the gpt2_decode tp_decode/disagg A/B blocks need >=2 devices; on the
+# CPU bench box fake them via the host-platform device count. Must land
+# in XLA_FLAGS before the first jax import anywhere in this process —
+# inert on a real TPU backend (the flag only affects the host platform)
+# and respects an operator-provided count.
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+
 WARMUP = 3
 ITERS = 40  # long chain amortizes per-dispatch host/tunnel latency
 
@@ -797,13 +807,131 @@ def _shared_prefix_ab(model, max_batch, max_len, page_size, n_requests,
     return out
 
 
+def _tp_decode_ab(model, prompts, max_batch, max_len, page_size,
+                  n_tokens):
+    """Tensor-parallel decode A/B: the SAME greedy traffic through the
+    single-chip fused engine vs a 2-way ``Mesh(("tp",))`` engine (paged
+    KV pools + attention heads sharded over the head axis, block tables
+    host-side). The claim is capacity, not speed — per-device KV bytes
+    halve at the same TPOT — so the gate pins `identical_tokens` (TP is
+    a layout change, never a math change) and reports the per-link
+    collective bytes of the sharded decode program."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from paddle_tpu.inference.serving import ServingEngine
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "needs >=2 devices"}
+    out = {"decode_tokens_per_mode": len(prompts) * n_tokens}
+    tokens = {}
+    for mode in ("single", "tp"):
+        mesh = (Mesh(np.array(jax.devices()[:2]), ("tp",))
+                if mode == "tp" else None)
+        eng = ServingEngine(model, max_batch=max_batch, max_len=max_len,
+                            page_size=page_size, name=f"tpab_{mode}",
+                            mesh=mesh)
+        eng.submit(prompts[0][:4] or [1], max_new_tokens=2)  # warm
+        eng.run_until_idle()
+        w0, t0 = eng.stats["decode_wall_s"], eng.stats["decode_tokens"]
+        reqs = [eng.submit(p, max_new_tokens=n_tokens) for p in prompts]
+        eng.run_until_idle()
+        dw = eng.stats["decode_wall_s"] - w0
+        dt = eng.stats["decode_tokens"] - t0
+        out[f"{mode}_ms_per_token"] = round(1000.0 * dw / max(dt, 1), 3)
+        tokens[mode] = [r.result(5) for r in reqs]
+        if mode == "tp":
+            out["tp_degree"] = eng.tp_degree()
+            try:
+                link = eng.audit(emit=False)[-1]
+                out["collective_bytes_by_link"] = dict(link.link_bytes)
+            except Exception as e:
+                out["collective_bytes_by_link"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+    out["identical_tokens"] = tokens["single"] == tokens["tp"]
+    if out["single_ms_per_token"] and out["tp_ms_per_token"]:
+        out["tpot_ratio"] = round(out["tp_ms_per_token"]
+                                  / out["single_ms_per_token"], 3)
+    out["note"] = ("same greedy prompts through the single-chip fused "
+                   "engine vs the head-sharded 2-way TP mesh engine; "
+                   "identical_tokens is the bit-parity check, tpot_ratio "
+                   "~1.0 means the model could be tp_degree x larger at "
+                   "the same TPOT (per-device KV bytes / tp_degree)")
+    return out
+
+
+def _disagg_ab(model, prompts, max_batch, max_len, page_size, n_tokens):
+    """Disaggregated prefill/decode A/B: the SAME greedy traffic through
+    the co-located engine vs the two-stage pipeline (prefill workers on
+    their own devices producing KV pages into the handoff queue, the
+    decode engine draining it inside its own step). The claim is
+    interference isolation — decode TPOT stops paying for prefill
+    bubbles — pinned again by `identical_tokens` (the handoff is a page
+    move, never a math change) plus the handoff-plane counters."""
+    from paddle_tpu.inference.disagg import DisaggPipeline
+    from paddle_tpu.inference.serving import ServingEngine
+
+    out = {"decode_tokens_per_mode": len(prompts) * n_tokens}
+    tokens = {}
+    for mode in ("colocated", "disagg"):
+        eng = ServingEngine(model, max_batch=max_batch, max_len=max_len,
+                            page_size=page_size, name=f"dab_{mode}")
+        pipe = DisaggPipeline(eng, num_workers=1) if mode == "disagg" \
+            else None
+        submit = pipe.submit if pipe is not None else eng.submit
+        drain = (pipe.run_until_idle if pipe is not None
+                 else eng.run_until_idle)
+        # warm compiles out of the clock: one prompt per distinct
+        # pow2 handoff bucket the timed traffic will hit, so the
+        # per-bucket inject/extract executables all exist before the
+        # timer starts (same warm set for both modes — the engines'
+        # lane/prefill compiles stay comparable)
+        from paddle_tpu.inference.disagg import _pow2_pad
+        seen_buckets = set()
+        for p in sorted(prompts, key=len):
+            b = _pow2_pad(-(-(len(p) + 1) // page_size))
+            if b in seen_buckets:
+                continue
+            seen_buckets.add(b)
+            submit(p, max_new_tokens=2)
+        drain()
+        w0, t0 = eng.stats["decode_wall_s"], eng.stats["decode_tokens"]
+        reqs = [submit(p, max_new_tokens=n_tokens) for p in prompts]
+        drain()
+        dw = eng.stats["decode_wall_s"] - w0
+        dt = eng.stats["decode_tokens"] - t0
+        out[f"{mode}_ms_per_token"] = round(1000.0 * dw / max(dt, 1), 3)
+        tokens[mode] = [r.result(5) for r in reqs]
+        ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+        if ttfts:
+            out[f"{mode}_ttft_p50_ms"] = round(
+                1000.0 * sorted(ttfts)[len(ttfts) // 2], 3)
+        if mode == "disagg":
+            st = pipe.status()
+            out["handoffs"] = int(st["handoffs"])
+            out["prefill_workers"] = int(st["stages"]["prefill"]["workers"])
+            out["worker_prefills"] = int(st["worker_prefills"])
+            out["decode_prefills"] = int(eng.stats["prefills"])
+            pipe.close()
+    out["identical_tokens"] = tokens["colocated"] == tokens["disagg"]
+    if out["colocated_ms_per_token"] and out["disagg_ms_per_token"]:
+        out["tpot_ratio"] = round(out["disagg_ms_per_token"]
+                                  / out["colocated_ms_per_token"], 3)
+    out["note"] = ("same greedy prompts through the co-located engine vs "
+                   "the disaggregated prefill/decode pipeline (KV-page "
+                   "handoff); identical_tokens is the bit-parity check; "
+                   "decode_prefills==0 proves every prefill ran on a "
+                   "prefill worker, not the decode engine")
+    return out
+
+
 def bench_gpt2_decode():
     """Autoregressive-decode serving bench: hundreds of concurrent
     simulated streams through the continuous-batching engine
     (inference/serving.py) over the paged KV cache — tokens/s/chip,
-    p50/p99 TTFT/TPOT, goodput, and the paged-vs-dense, fused-vs-eager
-    and shared-prefix-on/off A/Bs. The decode analogue of the
-    train-step configs."""
+    p50/p99 TTFT/TPOT, goodput, and the paged-vs-dense, fused-vs-eager,
+    shared-prefix-on/off, tp-decode and disagg A/Bs. The decode
+    analogue of the train-step configs."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.inference.serving import ServingEngine
@@ -820,6 +948,8 @@ def bench_gpt2_decode():
         ab_ctxs, ab_tokens = (32, 64, 128), 6
         fve_streams, fve_tokens = 6, 6
         shp_requests, shp_prefix, shp_tokens = 8, 32, 4
+        tpd_streams, tpd_tokens = 4, 6
+        dis_streams, dis_tokens = 4, 6
     else:
         cfg = GPTConfig.gpt2_small()
         cfg.dropout = cfg.attn_dropout = 0.0
@@ -829,6 +959,8 @@ def bench_gpt2_decode():
         ab_ctxs, ab_tokens = (128, 512, 960), 16
         fve_streams, fve_tokens = 64, 16
         shp_requests, shp_prefix, shp_tokens = 64, 256, 8
+        tpd_streams, tpd_tokens = 16, 16
+        dis_streams, dis_tokens = 16, 16
     model = GPT(cfg)
     model.eval()
     eng = ServingEngine(model, max_batch=max_batch, max_len=max_len,
@@ -853,27 +985,6 @@ def bench_gpt2_decode():
     def _pct(vals, q):
         return round(float(np.percentile(vals, q)), 4) if vals else None
 
-    # serving metric families from the live registry, scoped to this
-    # config's observability block (check_bench_result validates them)
-    obs = {}
-    try:
-        from paddle_tpu.profiler import metrics as _metrics
-        snap = _metrics.default_registry().snapshot()
-        obs["metrics"] = {k: v for k, v in snap.items()
-                          if k.startswith(("serving_", "slo_"))}
-    except Exception as e:
-        obs["metrics_error"] = f"{type(e).__name__}: {e}"
-    # request-scoped trace + SLO-window blocks (profiler/reqtrace.py /
-    # profiler/slo.py — the /requests and /slo endpoint payloads), so a
-    # BENCH round carries per-phase latency attribution
-    try:
-        obs["reqtrace"] = eng.requests_snapshot(n=min(streams, 50))
-    except Exception as e:
-        obs["reqtrace"] = {"error": f"{type(e).__name__}: {e}"}
-    try:
-        obs["slo"] = eng.slo.snapshot()
-    except Exception as e:
-        obs["slo"] = {"error": f"{type(e).__name__}: {e}"}
     ab = {}
     try:
         ab = _paged_vs_dense_ab(model, ab_ctxs, page_size,
@@ -897,6 +1008,47 @@ def bench_gpt2_decode():
             n_tokens=shp_tokens)
     except Exception as e:
         shared_prefix = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        tpd_prompts = [rng.integers(1, cfg.vocab_size,
+                                    (int(rng.integers(prompt_lo,
+                                                      prompt_hi)),)).tolist()
+                       for _ in range(tpd_streams)]
+        tp_decode = _tp_decode_ab(model, tpd_prompts, max_batch, max_len,
+                                  page_size, n_tokens=tpd_tokens)
+    except Exception as e:
+        tp_decode = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        dis_prompts = [rng.integers(1, cfg.vocab_size,
+                                    (int(rng.integers(prompt_lo,
+                                                      prompt_hi)),)).tolist()
+                       for _ in range(dis_streams)]
+        disagg = _disagg_ab(model, dis_prompts, max_batch, max_len,
+                            page_size, n_tokens=dis_tokens)
+    except Exception as e:
+        disagg = {"error": f"{type(e).__name__}: {e}"}
+    # serving metric families from the live registry, scoped to this
+    # config's observability block (check_bench_result validates them).
+    # Snapshotted AFTER the A/B probes so the handoff/per-stage families
+    # the disagg pipeline populates land in the same artifact.
+    obs = {}
+    try:
+        from paddle_tpu.profiler import metrics as _metrics
+        snap = _metrics.default_registry().snapshot()
+        obs["metrics"] = {k: v for k, v in snap.items()
+                          if k.startswith(("serving_", "slo_"))}
+    except Exception as e:
+        obs["metrics_error"] = f"{type(e).__name__}: {e}"
+    # request-scoped trace + SLO-window blocks (profiler/reqtrace.py /
+    # profiler/slo.py — the /requests and /slo endpoint payloads), so a
+    # BENCH round carries per-phase latency attribution
+    try:
+        obs["reqtrace"] = eng.requests_snapshot(n=min(streams, 50))
+    except Exception as e:
+        obs["reqtrace"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        obs["slo"] = eng.slo.snapshot()
+    except Exception as e:
+        obs["slo"] = {"error": f"{type(e).__name__}: {e}"}
     return {
         "name": (f"gpt-decode {cfg.num_layers}L-h{cfg.hidden_size} "
                  f"continuous batching b{max_batch} x {streams} streams "
@@ -928,6 +1080,8 @@ def bench_gpt2_decode():
         "paged_vs_dense": ab,
         "fused_vs_eager": fused_vs_eager,
         "shared_prefix": shared_prefix,
+        "tp_decode": tp_decode,
+        "disagg": disagg,
         "program_audit": _program_audit_block(lambda: eng.audit()),
         "observability": obs,
     }

@@ -10,11 +10,12 @@ Operator surfaces: `tools/program_audit.py` (offline CLI, CI gate via
 --fail-on), the per-config `program_audit` block in bench.py, and the
 `analysis_finding` event / `analysis_*` metric families.
 """
-from .auditor import (AUDIT_ENV, audit_program, audit_sharding, enabled,
-                      maybe_audit, reset_seen)
+from .auditor import (AUDIT_ENV, audit_collectives_by_link, audit_program,
+                      audit_sharding, enabled, maybe_audit, reset_seen)
 from .findings import (CHECKS, SEVERITIES, AuditReport, Finding,
                        recent_reports)
 
-__all__ = ["AUDIT_ENV", "audit_program", "audit_sharding", "enabled",
-           "maybe_audit", "reset_seen", "AuditReport", "Finding",
-           "CHECKS", "SEVERITIES", "recent_reports"]
+__all__ = ["AUDIT_ENV", "audit_program", "audit_collectives_by_link",
+           "audit_sharding", "enabled", "maybe_audit", "reset_seen",
+           "AuditReport", "Finding", "CHECKS", "SEVERITIES",
+           "recent_reports"]

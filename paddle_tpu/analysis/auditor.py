@@ -50,8 +50,8 @@ import numpy as np
 from ..utils.envparse import env_float, env_int, env_str
 from .findings import AuditReport, Finding
 
-__all__ = ["audit_program", "audit_sharding", "maybe_audit", "enabled",
-           "AUDIT_ENV", "reset_seen"]
+__all__ = ["audit_program", "audit_collectives_by_link", "audit_sharding",
+           "maybe_audit", "enabled", "AUDIT_ENV", "reset_seen"]
 
 AUDIT_ENV = "PADDLE_TPU_AUDIT"
 
@@ -82,6 +82,16 @@ def _min_upcast_bytes() -> int:
 
 def _collective_budget_bytes() -> float:
     return env_float("PADDLE_TPU_AUDIT_COLLECTIVE_BUDGET_MB",
+                     16 * 1024.0) * (1 << 20)
+
+
+def _link_budget_bytes(link: str) -> float:
+    """Per-link budgets: DCN is ~15x slower per chip than ICI, so the
+    same byte count that is fine intra-slice is a hazard across slices."""
+    if link == "dcn":
+        return env_float("PADDLE_TPU_AUDIT_COLLECTIVE_BUDGET_DCN_MB",
+                         1024.0) * (1 << 20)
+    return env_float("PADDLE_TPU_AUDIT_COLLECTIVE_BUDGET_ICI_MB",
                      16 * 1024.0) * (1 << 20)
 
 
@@ -475,6 +485,66 @@ def audit_program(fn, args: Sequence, kwargs: Optional[dict] = None, *,
     _check_collectives(report, closed.jaxpr)
     _check_bloat(report, closed.consts, static_args)
 
+    if emit:
+        report.emit()
+    return report
+
+
+def audit_collectives_by_link(fn, args: Sequence,
+                              kwargs: Optional[dict] = None, *,
+                              donate_argnums: Sequence[int] = (),
+                              cluster=None, name: str = "program",
+                              entry: str = "collectives",
+                              emit: bool = True) -> AuditReport:
+    """Per-link (ici/dcn) collective-bytes budget over the COMPILED
+    program. `audit_program`'s jaxpr check only sees explicit collective
+    primitives; the collectives of a GSPMD/shard_map-partitioned program
+    (the TP decode path) are inserted by the partitioner, so this check
+    compiles (nothing executes — XLA donation is a compile-time aliasing
+    hint) and prices the optimized HLO's collectives by the link class
+    their replica groups actually cross, via the cluster mapper's
+    slice-major topology. Budgets:
+    ``PADDLE_TPU_AUDIT_COLLECTIVE_BUDGET_ICI_MB`` (default 16 GiB) and
+    ``_DCN_MB`` (default 1 GiB); the cluster shape comes from
+    ``PADDLE_TPU_NUM_SLICES`` (single-slice clusters bill everything to
+    ici) unless an explicit `cluster` is passed. The report carries the
+    measured totals on ``report.link_bytes``."""
+    import jax
+
+    from ..distributed.auto_parallel.cluster import Cluster, Mapper
+
+    kwargs = kwargs or {}
+    if cluster is None:
+        ndev = jax.device_count()
+        n_slices = max(1, env_int("PADDLE_TPU_NUM_SLICES", 1))
+        cluster = Cluster(n_slices=n_slices,
+                          chips_per_slice=max(1, ndev // n_slices))
+    report = AuditReport(name=name, entry=entry)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        compiled = jax.jit(
+            fn, donate_argnums=tuple(donate_argnums)).lower(
+                *args, **kwargs).compile()
+    ici, dcn = Mapper(cluster).collective_bytes_by_link(compiled)
+    for link, nbytes, bw in (("ici", ici, cluster.ici_bw),
+                             ("dcn", dcn, cluster.dcn_bw)):
+        budget = _link_budget_bytes(link)
+        if budget <= 0 or nbytes <= budget:
+            continue
+        report.add(Finding(
+            check="sharding", severity="high",
+            code=f"collective-budget-exceeded-{link}",
+            message=(f"compiled collectives move ~{int(nbytes) >> 20} MiB "
+                     f"per step over {link} "
+                     f"(~{nbytes / bw * 1e3:.2f} ms at "
+                     f"{bw / 1e9:.0f} GB/s), over the "
+                     f"{int(budget) >> 20} MiB {link} budget"),
+            nbytes=int(nbytes),
+            fix_hint=(f"reshard so the traffic rides a faster link, fuse "
+                      f"collectives, or raise "
+                      f"PADDLE_TPU_AUDIT_COLLECTIVE_BUDGET_"
+                      f"{link.upper()}_MB")))
+    report.link_bytes = {"ici": float(ici), "dcn": float(dcn)}
     if emit:
         report.emit()
     return report

@@ -439,7 +439,8 @@ class FleetController:
                 self._streaks.pop(host, None)
                 self._streak_obs.pop(host, None)
                 self._suppressed.discard(host)
-        for host in straggling:
+        evictable: List[str] = []
+        for host in sorted(straggling):
             if host in self._evicted:
                 continue  # its stale digest still reads slow while held
             # the debounce counts CONSECUTIVE collect windows of
@@ -478,10 +479,11 @@ class FleetController:
                 self._decide_skip(host, d)
                 continue
             # multi-straggler: up to world_size - min_world hosts may be
-            # held SIMULTANEOUSLY (two slow hosts both confirm, both
-            # evict — each on its own debounced streak); the quorum
-            # floor is the only cap
-            if self.current_world() - 1 < self.min_world:
+            # confirmed in the SAME tick — they batch into ONE decision
+            # below (one command, one relaunch) instead of a sequence of
+            # single-host evictions whose relaunch specs supersede each
+            # other mid-apply; the quorum floor caps the batch
+            if self.current_world() - (len(evictable) + 1) < self.min_world:
                 continue  # never shrink below the floor
             if len(self._assignment) < self.world_size:
                 # a survivor we have never seen a digest from would be
@@ -490,21 +492,40 @@ class FleetController:
                 # fleet has reported once (a host with its reporter
                 # disabled keeps the controller in observe-only mode)
                 continue
-            self._decide_evict(host)
+            evictable.append(host)
+        if evictable:
+            self._decide_evict(evictable)
 
-    def _decide_evict(self, host: str):
-        evidence = {"windows": self._streaks.get(host, 0),
+    def _decide_evict(self, hosts):
+        """ONE debounced eviction decision covering every host in
+        `hosts` (each arrived here on its own confirmed streak): a
+        single command carries the full list, the post-eviction world
+        size, and a rank map excluding every held + evicted host — the
+        supervisors apply one relaunch, not a churn of N overlapping
+        ones. `cmd["host"]` stays the first host for ledger/back-compat
+        consumers; `cmd["hosts"]` is the authoritative list."""
+        if isinstance(hosts, str):
+            hosts = [hosts]
+        per_host = {}
+        for host in hosts:
+            hv = {"windows": self._streaks.get(host, 0)}
+            d = self._host_digest(host)
+            if d:
+                hv["p50_s"] = d.get("wall_p50_s")
+                hv["step"] = d.get("step")
+                hv["diag_dominant"] = d.get("diag_dominant")
+            per_host[host] = hv
+        evidence = {"hosts": per_host,
+                    "windows": per_host[hosts[0]]["windows"],
                     "straggling": sorted(self.aggregator.straggling()),
                     "factor": getattr(self.aggregator, "straggler_factor",
                                       None)}
-        d = self._host_digest(host)
-        if d:
-            evidence["p50_s"] = d.get("wall_p50_s")
-            evidence["step"] = d.get("step")
-            evidence["diag_dominant"] = d.get("diag_dominant")
-        new_np = self.current_world() - 1
-        ranks = self._dense_ranks(exclude=set(self._evicted) | {host})
-        cmd = {"action": "evict", "host": host, "np": new_np,
+        if len(hosts) == 1:
+            evidence.update(per_host[hosts[0]])
+        new_np = self.current_world() - len(hosts)
+        ranks = self._dense_ranks(exclude=set(self._evicted) | set(hosts))
+        cmd = {"action": "evict", "host": hosts[0], "hosts": list(hosts),
+               "np": new_np,
                "ranks": ranks, "env": self._relaunch_env(extra={
                    # the survivors may shrink to world 1, where the
                    # reporter would normally disarm — force it on so the
@@ -515,12 +536,13 @@ class FleetController:
             # a FAILED publish (store blip) is retried on the next tick;
             # suppressing it would mean one blip and a persistent
             # straggler is never evicted until it transiently recovers
-            self._suppressed.add(host)
+            self._suppressed.update(hosts)
         if rec["outcome"] == "applied":
-            self._evicted[host] = {"host": host, "ts": time.time(),
-                                   "decision": rec["id"]}
-            if _metrics_mod.enabled():
-                _M_EVICTIONS.inc(host=host)
+            for host in hosts:
+                self._evicted[host] = {"host": host, "ts": time.time(),
+                                       "decision": rec["id"]}
+                if _metrics_mod.enabled():
+                    _M_EVICTIONS.inc(host=host)
 
     def _decide_skip(self, host: str, d: dict):
         """A confirmed straggler whose dominant diagnosed term (in its

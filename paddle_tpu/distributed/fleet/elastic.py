@@ -707,7 +707,10 @@ class ElasticSupervisor:
                          command=int(cmd.get("id", 0)),
                          target=cmd.get("host"), np=cmd.get("np"),
                          generation=self.generation)
-        held = action == "evict" and cmd.get("host") == self.self_member
+        # batched multi-straggler eviction: one command may hold SEVERAL
+        # hosts ("hosts" list); single-host commands carry "host" only
+        held = action == "evict" and self.self_member in (
+            cmd.get("hosts") or [cmd.get("host")])
         if not held:
             overlay = {}
             if cmd.get("np") is not None:

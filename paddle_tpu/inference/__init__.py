@@ -263,6 +263,9 @@ def __getattr__(name):
     if name in ("ServingEngine", "Request", "PageAllocator"):
         from . import serving
         return getattr(serving, name)
+    if name in ("DisaggPipeline", "PrefillWorker", "KVHandoff"):
+        from . import disagg
+        return getattr(disagg, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -1,0 +1,434 @@
+"""Disaggregated prefill/decode serving: a two-stage pipeline with
+explicit KV-page handoff.
+
+Co-locating compute-bound prefill with bandwidth-bound decode makes
+TTFT and TPOT fight each other: one long prompt's prefill stalls every
+in-flight request's next token for the whole forward pass. Splitting
+the stages onto separate device groups (the DistServe/Splitwise shape,
+and the heter-PS prepare-pipeline pattern: one group PRODUCES KV, the
+other CONSUMES it) bounds that interference to the handoff cost:
+
+* :class:`PrefillWorker` — owns one device OUTSIDE the decode group, a
+  private single-slot paged cache, and a device-local replica of the
+  serving weights (refreshed when the engine hot-swaps). It runs the
+  bucketed prefill + first-token sample there and extracts the written
+  K/V pages into a :class:`KVHandoff` payload (page count padded to a
+  power-of-two bucket, so extraction and decode-side injection each
+  compile one executable per bucket for the life of the pipeline);
+* :class:`KVHandoff` — the unit moved between stages: the request, the
+  per-layer page payloads, and the produce timestamp that becomes the
+  ``serving_handoff_wait_seconds`` observation (and the ``handoff_wait``
+  SLO signal) at admission;
+* :class:`DisaggPipeline` — the two-stage continuous-batching loop:
+  queued requests dispatch to idle prefill workers, finished payloads
+  queue on the handoff plane (``serving_handoff_depth``), and the
+  decode engine admits them into free slots via
+  ``ServingEngine.admit_handoff`` — pages allocated, payload scattered
+  in ONE donated dispatch, decode resumed from the worker's first
+  sampled token. Per-stage busy counts land on
+  ``serving_stage_occupancy{stage=prefill|decode}``.
+
+Preemption stays recompute-style end to end: the engine's
+``on_preempt_requeue`` hook routes an evicted request back to the
+PREFILL stage (its next admission re-prefills prompt + generated
+prefix), so pool pressure on the decode side never wedges the pipeline.
+
+Tokens are bit-exact vs the co-located engine: the worker runs the
+identical prefill math (same bucket, same in-graph sampling draw at the
+same step counter) and the injected pages are byte-identical to the
+ones prefill would have written in place. TP decode composes — the
+payload replicates onto the decode mesh at admission and the scatter
+runs under the pools' head sharding.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..framework import tape as tape_mod
+from ..framework.tensor import Tensor
+from ..profiler import metrics as _metrics
+from .sampling import SamplingParams, sample_logits
+from .serving import (ServingEngine, Request, _M_HANDOFF_DEPTH, _M_QUEUE,
+                      _M_STAGE_OCC, _M_TTFT)
+
+__all__ = ["KVHandoff", "PrefillWorker", "DisaggPipeline"]
+
+
+def _pow2_pad(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _extract_pages_impl(k_pages, v_pages, page_ids):
+    """Gather the per-layer pages a prefill just wrote into a dense
+    payload [P_pad, page_size, H, D]. Padding ids are the null page 0 —
+    its garbage rows scatter back onto page 0 at the decode side."""
+    return ([kp[page_ids] for kp in k_pages],
+            [vp[page_ids] for vp in v_pages])
+
+
+class KVHandoff:
+    """One prefilled request crossing the prefill->decode boundary."""
+
+    __slots__ = ("request", "k_payload", "v_payload", "bucket",
+                 "produced_ts", "worker")
+
+    def __init__(self, request: Request, k_payload, v_payload,
+                 bucket: int, worker: int):
+        self.request = request
+        self.k_payload = k_payload
+        self.v_payload = v_payload
+        self.bucket = int(bucket)
+        self.worker = int(worker)
+        self.produced_ts = time.monotonic()
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(int(k.nbytes) + int(v.nbytes)
+                       for k, v in zip(self.k_payload, self.v_payload)))
+
+
+class PrefillWorker:
+    """One prefill device: private single-slot paged cache + a device-
+    local weights replica. ``prefill(req)`` runs the bucketed prefill
+    and the first-token sample on THIS device and returns the KVHandoff
+    (or None when the request finished at the prefill stage)."""
+
+    def __init__(self, engine: ServingEngine, device, wid: int = 0):
+        import jax
+
+        self.engine = engine
+        self.device = device
+        self.wid = int(wid)
+        self.busy = False
+        model = engine.model
+        pages_per_seq = -(-engine.max_len // engine.page_size)
+        # null page + exactly one sequence's worth of pages; the block
+        # table row is FIXED at [1..pages_per_seq] for the worker's life
+        cache = model.init_cache(1, engine.max_len,
+                                 page_size=engine.page_size,
+                                 num_pages=1 + pages_per_seq,
+                                 sharded=False)
+        self._page_row = np.arange(1, pages_per_seq + 1, dtype=np.int32)
+        import jax.numpy as jnp
+        cache.block_tables = cache.block_tables.at[0].set(
+            jnp.asarray(self._page_row))
+        self.cache = jax.device_put(cache, device)
+        self._params = None
+        self._buffers = None
+        self._seen_step = object()  # != any weights_step -> first refresh
+        # worker-private executables: one prefill per prompt bucket, one
+        # page extraction per pow2 page-count bucket. The cache donates
+        # (pools update in place every prefill); extraction is a pure
+        # gather and must NOT donate — the pools are reused next request.
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(2,))
+        self._extract_jit = jax.jit(_extract_pages_impl)
+
+    def _prefill_fn(self, params, buffers, cache, ids, slot, length,
+                    write_start, temp, top_k, top_p, seed, step):
+        from ..jit import _swapped_state
+        model = self.engine.model
+        with tape_mod.no_grad(), _swapped_state(model, params, buffers):
+            # use_tp=False: the private cache is unsharded regardless of
+            # the decode mesh — prefill is compute-bound and runs whole
+            logits, cache = model.forward_prefill(
+                Tensor(ids), cache, slot, length, write_start=write_start,
+                use_tp=False)
+        nxt = sample_logits(logits.data, temp, top_k, top_p, seed, step)
+        return nxt, cache
+
+    def _refresh_weights(self):
+        """Device-local weights replica, re-pulled whenever the engine's
+        live weights changed (hot-swap / rollback): `weights_step` is
+        the swap plane's version marker. A mesh-replicated source
+        gathers onto this worker's single device transparently."""
+        import jax
+        eng = self.engine
+        step = eng.weights_step
+        if self._params is not None and step == self._seen_step:
+            return
+        self._params = jax.device_put(dict(eng._params), self.device)
+        self._buffers = jax.device_put(dict(eng._buffers), self.device)
+        self._seen_step = step
+
+    def prefill(self, req: Request) -> Optional[KVHandoff]:
+        import jax.numpy as jnp
+        eng = self.engine
+        self._refresh_weights()
+        tokens = req.prompt + req.generated
+        bucket = eng._bucket_for(len(tokens))
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :len(tokens)] = tokens
+        if req.admitted_ts is None:
+            req.admitted_ts = time.monotonic()
+            eng.slo.observe("queue_wait",
+                            req.admitted_ts - req.submitted_ts)
+        eng.tracer.admitted(req.rid, bucket=bucket,
+                            prompt_tokens=len(tokens), shared_tokens=0,
+                            requeue=req.preemptions > 0)
+        eng._observe_site(f"disagg_prefill:{eng.name}:w{self.wid}", [ids])
+        sp = req.sampling
+        from ..profiler import compile_watch as _cw
+        prev = _cw.push_entry("to_static", f"disagg_prefill:{eng.name}")
+        try:
+            # the dispatch lock serializes TRACING against the engine
+            # (model-state rebinds must not interleave); dispatch is
+            # async, so the device-sync below overlaps with decode
+            with eng._dispatch_lock:
+                nxt, self.cache = self._prefill_jit(
+                    self._params, self._buffers, self.cache,
+                    jnp.asarray(ids), np.int32(0),
+                    np.int32(len(tokens)), np.int32(0),
+                    jnp.full((1,), sp.temperature, jnp.float32),
+                    jnp.full((1,), sp.top_k, jnp.int32),
+                    jnp.full((1,), sp.top_p, jnp.float32),
+                    jnp.full((1,), req.seed, jnp.int32),
+                    jnp.full((1,), len(req.generated), jnp.int32))
+        finally:
+            _cw.pop_entry(prev)
+        tok = int(np.asarray(nxt)[0])
+        eng.tracer.prefill_done(req.rid)
+        now = time.monotonic()
+        if req.first_token_ts is None:
+            req.first_token_ts = now
+            if _metrics.enabled() and req.ttft_s is not None:
+                _M_TTFT.observe(req.ttft_s, model=eng.name,
+                                path=eng.decode_mode)
+            if req.ttft_s is not None:
+                eng.slo.observe("ttft", req.ttft_s)
+        # counted apart from stats["prefills"]: that one counts prefills
+        # the DECODE engine ran itself, and under disaggregation it must
+        # stay 0 (the bench gate pins decode_prefills == 0 on it)
+        eng.stats["worker_prefills"] += 1
+        eng._record_token(req, tok)
+        if req.state != "queued":
+            return None  # finished (or failed) at the prefill stage
+        n_pages = -(-len(tokens) // eng.page_size)
+        pad = _pow2_pad(n_pages)
+        gather = np.zeros((pad,), np.int32)
+        gather[:n_pages] = self._page_row[:n_pages]
+        k_pay, v_pay = self._extract_jit(
+            self.cache.k_pages, self.cache.v_pages, jnp.asarray(gather))
+        return KVHandoff(req, k_pay, v_pay, bucket=bucket, worker=self.wid)
+
+
+class DisaggPipeline:
+    """Two-stage continuous batching over one decode engine plus N
+    prefill workers. Drive it synchronously (`submit` then
+    `run_until_idle`, tests/bench) or threaded (`start()` spawns one
+    loop per prefill worker, a handoff drainer, and the engine's decode
+    loop; `close()` joins everything).
+
+    `prefill_devices` defaults to devices OUTSIDE the engine's TP mesh
+    (the disaggregation claim: prefill compute never steals decode
+    bandwidth); when none are free it falls back to sharing — the
+    pipeline semantics (and the A/B bench) still hold."""
+
+    def __init__(self, engine: ServingEngine, *,
+                 prefill_devices=None, num_workers: int = 1):
+        import jax
+
+        self.engine = engine
+        if prefill_devices is None:
+            taken = set()
+            if engine.mesh is not None:
+                taken = {d for d in np.asarray(engine.mesh.devices).flat}
+            prefill_devices = [d for d in jax.devices()
+                               if d not in taken] or list(jax.devices())
+        self.workers: List[PrefillWorker] = [
+            PrefillWorker(engine, prefill_devices[i % len(prefill_devices)],
+                          wid=i)
+            for i in range(max(1, int(num_workers)))]
+        self._queue: "deque[Request]" = deque()
+        self._handoffs: "deque[KVHandoff]" = deque()
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self._running = False
+        # decode-side preemption re-enters the PREFILL stage (the
+        # recompute resume re-runs prefill over prompt + generated);
+        # the engine drains our handoff queue at the top of every
+        # step() via the peek/pop protocol — injection stays on the
+        # decode thread, never racing the donated decode dispatch
+        engine.on_preempt_requeue = self._on_preempt
+        engine.handoff_source = self
+
+    # -- admission ------------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               sampling: Optional[SamplingParams] = None) -> Request:
+        eng = self.engine
+        req = eng.make_request(prompt, max_new_tokens, eos_id,
+                               sampling=sampling)
+        with self._lock:
+            if eng.queue_limit is not None \
+                    and len(self._queue) >= eng.queue_limit:
+                raise RuntimeError(
+                    f"queue at shed cap ({eng.queue_limit}); "
+                    f"engine {eng.name!r} is shedding load")
+            self._queue.append(req)
+            depth = len(self._queue)
+        req.trace_id = eng.tracer.submit(req.rid)
+        if _metrics.enabled():
+            _M_QUEUE.set(depth, model=eng.name)
+        return req
+
+    def _on_preempt(self, req: Request):
+        with self._lock:
+            self._queue.appendleft(req)
+            depth = len(self._queue)
+        if _metrics.enabled():
+            _M_QUEUE.set(depth, model=self.engine.name)
+
+    # -- handoff-source protocol (consumed by ServingEngine.step) -------------
+    def _handoff_peek(self) -> Optional[KVHandoff]:
+        with self._lock:
+            return self._handoffs[0] if self._handoffs else None
+
+    def _handoff_pop(self, h: KVHandoff):
+        with self._lock:
+            if self._handoffs and self._handoffs[0] is h:
+                self._handoffs.popleft()
+            depth = len(self._handoffs)
+        if _metrics.enabled():
+            _M_HANDOFF_DEPTH.set(depth, model=self.engine.name)
+
+    # -- synchronous drive ----------------------------------------------------
+    def step(self) -> int:
+        """One pipeline tick: dispatch queued requests to idle prefill
+        workers, drain finished payloads into the decode batch, run one
+        decode iteration. Returns tokens produced by the decode stage."""
+        work = []
+        with self._lock:
+            for w in self.workers:
+                if not self._queue:
+                    break
+                if w.busy:
+                    continue
+                w.busy = True
+                work.append((w, self._queue.popleft()))
+            if _metrics.enabled():
+                _M_QUEUE.set(len(self._queue), model=self.engine.name)
+        for w, req in work:
+            try:
+                h = w.prefill(req)
+            finally:
+                w.busy = False
+            if h is not None:
+                self._enqueue_handoff(h)
+        # engine.step() drains the handoff queue first (peek/pop), then
+        # admits + decodes — injection happens on THIS thread here
+        produced = self.engine.step()
+        self._publish_occupancy()
+        return produced
+
+    def _enqueue_handoff(self, h: KVHandoff):
+        with self._lock:
+            self._handoffs.append(h)
+            depth = len(self._handoffs)
+        if _metrics.enabled():
+            _M_HANDOFF_DEPTH.set(depth, model=self.engine.name)
+
+    def _publish_occupancy(self):
+        if not _metrics.enabled():
+            return
+        busy = sum(w.busy for w in self.workers)
+        active = sum(r is not None for r in self.engine._slots)
+        _M_STAGE_OCC.set(busy, model=self.engine.name, stage="prefill")
+        _M_STAGE_OCC.set(active, model=self.engine.name, stage="decode")
+
+    def pending(self) -> bool:
+        with self._lock:
+            staged = bool(self._queue) or bool(self._handoffs)
+        return staged or any(w.busy for w in self.workers) \
+            or self.engine.pending()
+
+    def run_until_idle(self, max_iterations: int = 100000):
+        for _ in range(max_iterations):
+            if not self.pending():
+                return
+            self.step()
+        raise RuntimeError("run_until_idle: iteration cap exceeded")
+
+    # -- threaded drive -------------------------------------------------------
+    def start(self, poll_s: float = 0.005):
+        """Background mode: one loop per prefill worker, one handoff
+        drainer, and the engine's own decode loop."""
+        if self._running:
+            return
+        self._running = True
+        self.engine.start(poll_s)
+
+        def worker_loop(w: PrefillWorker):
+            while self._running and not self.engine._closed:
+                with self._lock:
+                    req = self._queue.popleft() if self._queue else None
+                    if req is not None:
+                        w.busy = True
+                if req is None:
+                    time.sleep(poll_s)
+                    continue
+                try:
+                    h = w.prefill(req)
+                finally:
+                    w.busy = False
+                if h is not None:
+                    self._enqueue_handoff(h)
+
+        def occupancy_loop():
+            # the engine's own decode loop drains the handoff queue;
+            # this thread only keeps the per-stage gauges fresh
+            while self._running and not self.engine._closed:
+                self._publish_occupancy()
+                time.sleep(max(poll_s, 0.01))
+
+        for w in self.workers:
+            t = threading.Thread(target=worker_loop, args=(w,), daemon=True,
+                                 name=f"disagg-prefill-{w.wid}")
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=occupancy_loop, daemon=True,
+                             name="disagg-occupancy")
+        t.start()
+        self._threads.append(t)
+
+    def close(self):
+        self._running = False
+        for t in self._threads:
+            t.join(timeout=30)
+        self._threads = []
+        self.engine.on_preempt_requeue = None
+        self.engine.handoff_source = None
+        self.engine.close()
+        with self._lock:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._handoffs.clear()
+        for req in leftovers:
+            self.engine._complete(req, "failed", error="pipeline closed")
+
+    # -- status ---------------------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "stages": {
+                    "prefill": {"workers": len(self.workers),
+                                "busy": sum(w.busy for w in self.workers),
+                                "devices": [str(w.device)
+                                            for w in self.workers]},
+                    "decode": {"occupancy": sum(
+                        r is not None for r in self.engine._slots),
+                        "tp_degree": self.engine.tp_degree()},
+                },
+                "queue_depth": len(self._queue),
+                "handoff_depth": len(self._handoffs),
+                "handoffs": self.engine.stats.get("handoffs", 0),
+                "worker_prefills": self.engine.stats.get(
+                    "worker_prefills", 0),
+            }

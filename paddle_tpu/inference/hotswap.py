@@ -92,7 +92,11 @@ class HotSwapManager:
                            if canary_tol is None else float(canary_tol))
         self.probe_ids = (default_probe_batch(engine)
                           if probe_ids is None else np.asarray(probe_ids))
-        self.mesh = mesh
+        # a TP-armed engine swaps sharded weights: checkpoint loads must
+        # reassemble against the SAME mesh the engine decodes on, or the
+        # stage-time replication in request_swap round-trips through host
+        self.mesh = mesh if mesh is not None else getattr(engine, "mesh",
+                                                          None)
         #: newest step already live (polls only look above it)
         self.current_step: int = (engine.weights_step
                                   if engine.weights_step is not None else -1)

@@ -168,6 +168,24 @@ _M_RESTARTS = _REG.counter(
 _M_SUSPENDED = _REG.gauge(
     "serving_suspended",
     "1 while admission is suspended under memory pressure, by model")
+# disaggregated prefill/decode pipeline (inference/disagg.py): the
+# prefill->decode KV handoff plane and per-stage occupancy
+_M_HANDOFF_DEPTH = _REG.gauge(
+    "serving_handoff_depth",
+    "prefilled KV payloads queued for decode-side admission "
+    "(disaggregated prefill/decode pipeline), by model")
+_M_HANDOFF_WAIT = _REG.histogram(
+    "serving_handoff_wait_seconds",
+    "prefill->decode handoff latency: KV payload produced by a prefill "
+    "worker -> admitted into the decode batch, by model")
+_M_HANDOFF_BYTES = _REG.counter(
+    "serving_handoff_bytes_total",
+    "KV page payload bytes moved across the prefill->decode handoff, "
+    "by model")
+_M_STAGE_OCC = _REG.gauge(
+    "serving_stage_occupancy",
+    "busy units per pipeline stage (prefill: busy prefill workers; "
+    "decode: active decode slots), by model and stage")
 
 
 class PageAllocator:
@@ -451,6 +469,20 @@ def _resolve_step_cfg(model_key: tuple, max_batch: int):
     return cfg
 
 
+def _inject_pages_impl(k_pages, v_pages, k_payload, v_payload, page_ids):
+    """Scatter a prefill worker's per-layer KV page payload into the
+    decode pools (disaggregated handoff). The pools are DONATED — the
+    multi-GB buffers update in place like the fused decode step.
+    `page_ids` is padded to a power-of-two bucket with the null page 0;
+    padding rows overwrite page 0, which by convention holds garbage —
+    so the whole serving life compiles one executable per bucket."""
+    k_out, v_out = [], []
+    for kp, vp, kq, vq in zip(k_pages, v_pages, k_payload, v_payload):
+        k_out.append(kp.at[page_ids].set(kq.astype(kp.dtype)))
+        v_out.append(vp.at[page_ids].set(vq.astype(vp.dtype)))
+    return k_out, v_out
+
+
 class ServingEngine:
     """Continuous-batching decode engine over one model's paged KV cache.
 
@@ -481,7 +513,8 @@ class ServingEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  eos_id: int = -1, name: str = "gpt",
                  decode_mode: str = "fused", share_prefix: bool = True,
-                 priority: int = 0, mem_budget_bytes: int = 0):
+                 priority: int = 0, mem_budget_bytes: int = 0,
+                 mesh=None, tp_axis: str = "tp"):
         import jax
 
         if decode_mode not in ("fused", "eager"):
@@ -496,6 +529,25 @@ class ServingEngine:
         self.eos_id = int(eos_id)
         self.decode_mode = decode_mode
         self.share_prefix = bool(share_prefix)
+        # tensor-parallel decode: shard the K/V page pools (and the
+        # attention heads) over `tp_axis` of `mesh` — each device holds
+        # 1/N of every pool, so the SAME engine serves an N×-larger
+        # model at unchanged TPOT. Weights replicate; greedy decode is
+        # bit-exact vs single-chip (models/gpt.py set_tp_mesh).
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        if mesh is not None:
+            if not hasattr(model, "set_tp_mesh"):
+                raise ValueError(
+                    f"model {type(model).__name__} does not implement the "
+                    f"TP decode protocol (set_tp_mesh)")
+            model.set_tp_mesh(mesh, tp_axis)
+        elif hasattr(model, "set_tp_mesh") \
+                and getattr(model, "tp_mesh", lambda: None)() is not None:
+            # a previous TP engine armed this model: a meshless engine
+            # must disarm, or init_cache builds sharded pools this
+            # engine has no mesh to place payloads/buffers against
+            model.set_tp_mesh(None)
         # multi-model co-residency: priority picks the degradation victim
         # (LOWEST degrades first) and mem_budget_bytes caps this engine's
         # page-pool footprint at construction (budget enforcement against
@@ -537,6 +589,14 @@ class ServingEngine:
 
         self._params = {k: p.data for k, p in model.named_parameters()}
         self._buffers = {k: b.data for k, b in model.named_buffers()}
+        if mesh is not None:
+            # weights replicate onto the mesh ONCE at construction (and
+            # per hot-swap in request_swap) so every fused dispatch sees
+            # committed, consistently-placed inputs
+            self._params = {k: jax.device_put(v, self._rep_sharding())
+                            for k, v in self._params.items()}
+            self._buffers = {k: jax.device_put(v, self._rep_sharding())
+                             for k, v in self._buffers.items()}
         self._queue: "deque[Request]" = deque()
         self._lock = threading.Lock()
         self._slots: List[Optional[Request]] = [None] * self.max_batch
@@ -558,11 +618,22 @@ class ServingEngine:
         self._restarting = False
         self.queue_limit: Optional[int] = None
         self._suspended: Optional[dict] = None
+        # disaggregated-pipeline hooks: when set, a preempted request is
+        # handed to `on_preempt_requeue` (back to the prefill stage)
+        # instead of requeueing on this engine's own admission queue,
+        # and `handoff_source` (peek/pop protocol — DisaggPipeline) is
+        # drained at the top of every step(). Draining INSIDE step keeps
+        # every cache mutation on the decode thread: a payload injection
+        # racing the donated decode dispatch from another thread would
+        # use buffers the dispatch just consumed.
+        self.on_preempt_requeue = None
+        self.handoff_source = None
         # rolling stats for bench/status
         self.stats = {"iterations": 0, "prefills": 0, "decode_tokens": 0,
                       "completed": 0, "preemptions": 0, "decode_wall_s": 0.0,
                       "cow_copies": 0, "prefix_hit_tokens": 0,
                       "shared_admissions": 0, "swaps": 0, "restarts": 0,
+                      "handoffs": 0, "worker_prefills": 0,
                       "min_free_pages": self.allocator.free_pages}
         # request-scoped observability plane: lifecycle tracer, sliding-
         # window SLO tracker, and a bounded ring of per-iteration
@@ -583,6 +654,20 @@ class ServingEngine:
         # cache (the page pools update in place)
         self._fused_jit = jax.jit(self._fused_step_fn, donate_argnums=(2,))
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(2,))
+        # disagg handoff injection: ONE donated executable per pow2
+        # page-count bucket scatters a prefill worker's page payload
+        # into the (possibly head-sharded) pools in place
+        self._inject_jit = jax.jit(_inject_pages_impl,
+                                   donate_argnums=(0, 1))
+
+    def _rep_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def tp_degree(self) -> int:
+        """Shards the KV pools split over (1 = single-chip)."""
+        return int(self.mesh.shape[self.tp_axis]) if self.mesh is not None \
+            else 1
 
     def _model_key(self) -> tuple:
         cfg = getattr(self.model, "cfg", None)
@@ -627,7 +712,8 @@ class ServingEngine:
         and the (smallest-bucket) prefill executable for perf hazards —
         donation/aliasing of the page pools, dtype hygiene, baked
         constants. Trace + lower only; nothing executes and the live
-        cache is untouched. Returns [decode_report, prefill_report]."""
+        cache is untouched. Returns [decode_report, prefill_report]
+        (+ a per-link collective-bytes report when TP decode is on)."""
         import jax.numpy as jnp
         from .. import analysis
         W = self.decode_buckets[0]
@@ -657,7 +743,19 @@ class ServingEngine:
             donate_argnums=(2,),
             name=f"serving_prefill:{self.name}", entry="serving_prefill",
             emit=emit)
-        return [decode, prefill]
+        reports = [decode, prefill]
+        if self.mesh is not None:
+            # TP decode: price the compiled program's collectives per
+            # link class (ici vs dcn) against the per-link budgets — the
+            # jaxpr-level audit above cannot see GSPMD-inserted
+            # collectives, so this one compiles (cache untouched: XLA
+            # donation is a compile-time aliasing hint, nothing runs)
+            reports.append(analysis.audit_collectives_by_link(
+                self._fused_step_fn,
+                (self._params, self._buffers, self.cache) + lane_args,
+                donate_argnums=(2,),
+                name=f"serving_decode:{self.name}", emit=emit))
+        return reports
 
     def _maybe_audit_once(self):
         """PADDLE_TPU_AUDIT runtime hook: vet both executables once per
@@ -684,9 +782,13 @@ class ServingEngine:
             pass
 
     # -- public API -----------------------------------------------------------
-    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
-               eos_id: Optional[int] = None,
-               sampling: Optional[SamplingParams] = None) -> Request:
+    def make_request(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                     eos_id: Optional[int] = None,
+                     sampling: Optional[SamplingParams] = None) -> Request:
+        """Validate and build a Request WITHOUT enqueueing it — the
+        disaggregated pipeline routes requests through its prefill stage
+        first and hands the KV back via `admit_handoff`. All submit-time
+        validation (pool coverage, length bounds, suspension) applies."""
         if self._closed:
             raise RuntimeError("engine is closed")
         # chaos: an armed `serving.admit` fails admission BEFORE the
@@ -715,6 +817,13 @@ class ServingEngine:
                 f"request needs {total_pages} KV pages but the pool holds "
                 f"{self.cache.num_pages - 1} (num_pages minus the null "
                 f"page); raise num_pages or lower max_new_tokens")
+        return req
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               sampling: Optional[SamplingParams] = None) -> Request:
+        req = self.make_request(prompt, max_new_tokens, eos_id,
+                                sampling=sampling)
         with self._lock:
             # re-check under the lock: a close() racing this submit has
             # already drained the queue, and a request appended after
@@ -740,8 +849,12 @@ class ServingEngine:
 
     def pending(self) -> bool:
         with self._lock:
-            return bool(self._queue) or any(
+            busy = bool(self._queue) or any(
                 r is not None for r in self._slots)
+        if busy:
+            return True
+        src = self.handoff_source
+        return src is not None and src._handoff_peek() is not None
 
     def step(self) -> int:
         """ONE continuous-batching iteration: admit waiting requests into
@@ -762,6 +875,8 @@ class ServingEngine:
         # weights — no drain, no retrace (shapes/dtypes validated)
         if self._pending_swap is not None:
             self._apply_pending_swap()
+        if self.handoff_source is not None:
+            self._drain_handoff_source()
         self._admit()
         active_slots = [i for i, r in enumerate(self._slots)
                         if r is not None]
@@ -894,7 +1009,21 @@ class ServingEngine:
                     raise ValueError(
                         f"swap rejected: buffer {k!r} shape "
                         f"{tuple(cand.shape)} != {tuple(live.shape)}")
-        pend = {"params": {k: params[k] for k in self._params},
+        cand_params = {k: params[k] for k in self._params}
+        if self.mesh is not None:
+            # sharded engines replicate the candidate weights onto the
+            # mesh at STAGE time (off the decode hot path): apply-time
+            # rebind stays a pointer swap and the very next fused
+            # dispatch sees consistently-placed inputs — a host-resident
+            # candidate would otherwise retrigger placement mid-decode
+            import jax
+            rep = self._rep_sharding()
+            cand_params = {k: jax.device_put(v, rep)
+                           for k, v in cand_params.items()}
+            if buffers is not None:
+                buffers = {k: jax.device_put(v, rep)
+                           for k, v in buffers.items()}
+        pend = {"params": cand_params,
                 "buffers": buffers, "step": step, "source": source,
                 "rollback": bool(rollback), "on_applied": on_applied,
                 "staged_ts": time.time()}
@@ -1066,6 +1195,90 @@ class ServingEngine:
     def restore_pool(self) -> int:
         """Return every parked page to the free list (pressure cleared)."""
         return self.allocator.release_reserved()
+
+    # -- disaggregated prefill/decode handoff ---------------------------------
+    def admit_handoff(self, handoff) -> bool:
+        """Decode-side admission of a prefill worker's KV payload
+        (inference/disagg.py): allocate pages for the prefilled context,
+        scatter the per-layer page payload into the pools in ONE donated
+        dispatch (pow2 page-count buckets — padding rows land on the
+        null page), point the slot's block table at them, and resume
+        decode from the worker's first sampled token. Returns False with
+        the payload untouched when no slot or pages are free right now
+        (the pipeline retries next tick); True when admitted OR when the
+        request already finished at the prefill stage."""
+        import jax.numpy as jnp
+        req = handoff.request
+        if req.state != "queued":
+            return True  # single-token request finished at prefill
+        # KV covers everything BEFORE the worker's sampled token
+        ctx = len(req.prompt) + len(req.generated) - 1
+        n_pages = -(-ctx // self.page_size)
+        with self._lock:
+            if self._closed:
+                return False
+            free = [i for i, r in enumerate(self._slots) if r is None]
+            if not free:
+                return False
+            pages = self.allocator.alloc(n_pages)
+            if pages is None:
+                return False  # pool exhausted: wait for frees
+            slot = free[0]
+            req.slot, req.pages, req.state = slot, list(pages), "running"
+            self._slots[slot] = req
+        self._note_pool_watermark()
+        row = np.zeros((self.cache.pages_per_seq,), np.int32)
+        row[:n_pages] = pages
+        self.cache.block_tables = self.cache.block_tables.at[slot].set(
+            jnp.asarray(row))
+        self.cache.context_lens = self.cache.context_lens.at[slot].set(
+            jnp.int32(ctx))
+        # scatter ids padded to the payload's pow2 bucket with page 0
+        pad = int(handoff.k_payload[0].shape[0])
+        ids = np.zeros((pad,), np.int32)
+        ids[:n_pages] = pages
+        # the worker committed the payload to ITS device; re-place onto
+        # this engine's placement (replicated over the mesh under TP)
+        # so the inject dispatch sees consistently-located inputs
+        import jax
+        target = self._rep_sharding() if self.mesh is not None \
+            else next(iter(self.cache.k_pages[0].devices()))
+        k_payload = jax.device_put(handoff.k_payload, target)
+        v_payload = jax.device_put(handoff.v_payload, target)
+        with self._dispatch_lock:
+            self.cache.k_pages, self.cache.v_pages = self._inject_jit(
+                self.cache.k_pages, self.cache.v_pages,
+                k_payload, v_payload, jnp.asarray(ids))
+        self._cur_tokens[slot] = req.generated[-1]
+        if req.admitted_ts is None:
+            req.admitted_ts = time.monotonic()
+            self.slo.observe("queue_wait",
+                             req.admitted_ts - req.submitted_ts)
+        wait_s = time.monotonic() - handoff.produced_ts
+        self.stats["handoffs"] += 1
+        if _metrics.enabled():
+            _M_HANDOFF_WAIT.observe(wait_s, model=self.name)
+            _M_HANDOFF_BYTES.inc(float(handoff.nbytes), model=self.name)
+        self.slo.observe("handoff_wait", wait_s)
+        if self.share_prefix:
+            tokens = (req.prompt + req.generated[:-1])[:ctx]
+            self._prefix.register(tokens, pages)
+        # no tracer.admitted here: the prefill WORKER owns the queued ->
+        # prefill transition; the handoff wait lands in the decode span
+        # via reqtrace's contiguous attribution
+        self._emit_admission(req, handoff.bucket, ctx)
+        return True
+
+    def _drain_handoff_source(self):
+        """Admit queued handoffs until slots/pages run out — called at
+        the top of step() so payload injection always happens on the
+        decode thread, never racing the donated decode dispatch."""
+        src = self.handoff_source
+        while True:
+            h = src._handoff_peek()
+            if h is None or not self.admit_handoff(h):
+                break
+            src._handoff_pop(h)
 
     # -- internals ------------------------------------------------------------
     def _bucket_for(self, n: int) -> int:
@@ -1380,11 +1593,18 @@ class ServingEngine:
         req.slot = None
         req.preemptions += 1
         self.stats["preemptions"] += 1
-        with self._lock:
-            self._queue.appendleft(req)
-            depth = len(self._queue)
-        if _metrics.enabled():
-            _M_QUEUE.set(depth, model=self.name)
+        hook = self.on_preempt_requeue
+        if hook is not None:
+            # disaggregated pipeline: the recompute-style resume re-runs
+            # prefill (prompt + generated prefix), so route the request
+            # back to the PREFILL stage instead of this engine's queue
+            hook(req)
+        else:
+            with self._lock:
+                self._queue.appendleft(req)
+                depth = len(self._queue)
+            if _metrics.enabled():
+                _M_QUEUE.set(depth, model=self.name)
         self._emit_eviction(req, "preempted")
 
     def _release_slot(self, req: Request):
@@ -1483,6 +1703,8 @@ class ServingEngine:
                 "prefill_buckets": list(self.prefill_buckets),
                 "decode_buckets": list(self.decode_buckets),
                 "decode_mode": self.decode_mode,
+                "tp_degree": self.tp_degree(),
+                "tp_axis": self.tp_axis if self.mesh is not None else None,
                 "share_prefix": self.share_prefix,
                 "prefix_entries": len(self._prefix),
                 "priority": self.priority,

@@ -241,12 +241,45 @@ class GPT(nn.Layer):
     # traceable — inference/serving.py jits the whole batched step with the
     # cache donated.
 
+    def set_tp_mesh(self, mesh, axis: str = "tp"):
+        """Arm the tensor-parallel decode path: `init_cache` shards the
+        K/V page pools over `axis` on the HEAD dim, and the decode/
+        prefill page paths run per-shard via shard_map (the attention
+        output is gathered back to replicated before the proj matmul, so
+        no floating-point contraction ever splits across devices —
+        greedy decode stays bit-exact vs single-chip). Pass None to
+        disarm. Weights stay replicated (decode is KV-bandwidth bound;
+        the pool is the memory that scales N×)."""
+        if mesh is not None:
+            if axis not in mesh.shape:
+                raise ValueError(f"set_tp_mesh: mesh has no axis "
+                                 f"{axis!r} (axes: {dict(mesh.shape)})")
+            if self.cfg.num_heads % mesh.shape[axis]:
+                raise ValueError(
+                    f"set_tp_mesh: num_heads {self.cfg.num_heads} does "
+                    f"not divide over mesh axis {axis!r} of size "
+                    f"{mesh.shape[axis]}")
+        self._tp_mesh = mesh
+        self._tp_axis = axis
+
+    def tp_mesh(self):
+        return getattr(self, "_tp_mesh", None)
+
     def init_cache(self, max_batch: int, max_len: int, page_size: int = 16,
-                   num_pages: int = 0, dtype=None) -> PagedKVCache:
+                   num_pages: int = 0, dtype=None,
+                   sharded: bool = True) -> PagedKVCache:
         """Build an empty paged KV cache for `max_batch` concurrent
         sequences of up to `max_len` tokens. `num_pages` defaults to full
         backing (every slot can reach max_len) + the null page; a serving
-        deployment may pass less and rely on allocator preemption."""
+        deployment may pass less and rely on allocator preemption.
+
+        With a TP mesh armed (`set_tp_mesh`) the pools allocate SHARDED
+        over the head axis — each device holds 1/N of every layer's pool,
+        which is the N×-larger-model capacity claim — while block tables
+        and context lens replicate (they are host-updated control state).
+        `sharded=False` builds a plain single-device cache regardless
+        (the disaggregated prefill workers' private caches)."""
+        import jax
         import jax.numpy as jnp
         if max_len > self.cfg.max_position_embeddings:
             raise ValueError(
@@ -259,12 +292,28 @@ class GPT(nn.Layer):
             dtype = self.wte.weight.dtype
         H, D = self.cfg.num_heads, self.cfg.hidden_size // self.cfg.num_heads
         shape = (num_pages, page_size, H, D)
-        k_pages = [jnp.zeros(shape, dtype) for _ in self.blocks]
-        v_pages = [jnp.zeros(shape, dtype) for _ in self.blocks]
-        return PagedKVCache(
-            k_pages, v_pages,
-            jnp.zeros((max_batch, pages_per_seq), jnp.int32),
-            jnp.zeros((max_batch,), jnp.int32), page_size)
+        mesh = self.tp_mesh() if sharded else None
+        if mesh is None:
+            k_pages = [jnp.zeros(shape, dtype) for _ in self.blocks]
+            v_pages = [jnp.zeros(shape, dtype) for _ in self.blocks]
+            bt = jnp.zeros((max_batch, pages_per_seq), jnp.int32)
+            cl = jnp.zeros((max_batch,), jnp.int32)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            pool_sh = NamedSharding(mesh, P(None, None, self._tp_axis,
+                                            None))
+            rep_sh = NamedSharding(mesh, P())
+            # allocate THROUGH the sharding: each device materializes
+            # only its pool shard — the whole point of TP decode is that
+            # the full pool never exists on one chip
+            zeros = jax.jit(lambda: jnp.zeros(shape, dtype),
+                            out_shardings=pool_sh)
+            k_pages = [zeros() for _ in self.blocks]
+            v_pages = [zeros() for _ in self.blocks]
+            bt = jax.device_put(
+                jnp.zeros((max_batch, pages_per_seq), jnp.int32), rep_sh)
+            cl = jax.device_put(jnp.zeros((max_batch,), jnp.int32), rep_sh)
+        return PagedKVCache(k_pages, v_pages, bt, cl, page_size)
 
     def _block_qkv(self, blk, x):
         """(q, k, v) raw arrays [B, L, H, D] from one block's qkv proj."""
@@ -274,7 +323,7 @@ class GPT(nn.Layer):
         return qkv[:, :, 0].data, qkv[:, :, 1].data, qkv[:, :, 2].data
 
     def forward_prefill(self, input_ids, cache: PagedKVCache, slot,
-                        length, write_start=0):
+                        length, write_start=0, use_tp: bool = True):
         """Prefill ONE sequence: run the prompt through the normal (flash)
         causal attention while scattering every position's K/V into the
         pages of batch slot `slot`. `input_ids` is [1, L_bucket] (L may be
@@ -301,14 +350,23 @@ class GPT(nn.Layer):
         length = jnp.asarray(length, jnp.int32)
         write_start = jnp.asarray(write_start, jnp.int32)
         page_row = jnp.take(cache.block_tables, slot, axis=0)
+        mesh = self.tp_mesh() if use_tp else None
         for li, blk in enumerate(self.blocks):
             with jax.named_scope("ln"):
                 h = blk.ln1(x)
             with jax.named_scope("attention"):
                 q, k, v = self._block_qkv(blk, h)
-                cache.k_pages[li], cache.v_pages[li] = _pa.prefill_append(
-                    cache.k_pages[li], cache.v_pages[li], k[0], v[0],
-                    page_row, length, start=write_start)
+                if mesh is not None:
+                    cache.k_pages[li], cache.v_pages[li] = \
+                        _pa.prefill_append_tp(
+                            cache.k_pages[li], cache.v_pages[li], k[0],
+                            v[0], page_row, length, mesh,
+                            axis=self._tp_axis, start=write_start)
+                else:
+                    cache.k_pages[li], cache.v_pages[li] = \
+                        _pa.prefill_append(
+                            cache.k_pages[li], cache.v_pages[li], k[0],
+                            v[0], page_row, length, start=write_start)
                 out = F.scaled_dot_product_attention(
                     Tensor(q), Tensor(k), Tensor(v), is_causal=True,
                     training=False)
@@ -327,7 +385,7 @@ class GPT(nn.Layer):
         return logits, cache
 
     def forward_decode(self, tokens, cache: PagedKVCache, active=None,
-                       slot_map=None):
+                       slot_map=None, use_tp: bool = True):
         """ONE incremental decode step: append each sequence's new token
         K/V to its pages, attend over the paged context. `tokens` is [B]
         int (the token sitting at position context_lens[b]); `active`
@@ -368,18 +426,30 @@ class GPT(nn.Layer):
             x = self.wte(tokens) + self.wpe(pos)       # [B, hidden]
         B = x.shape[0]
         x = reshape(x, [B, 1, self.cfg.hidden_size])
+        mesh = self.tp_mesh() if use_tp else None
         for li, blk in enumerate(self.blocks):
             with jax.named_scope("ln"):
                 h = blk.ln1(x)
             with jax.named_scope("attention"):
                 q, k, v = self._block_qkv(blk, h)      # [B, 1, H, D]
-                cache.k_pages[li], cache.v_pages[li] = _pa.cache_append(
-                    cache.k_pages[li], cache.v_pages[li], k[:, 0], v[:, 0],
-                    bt, ctx, active)
-                out = _pa.paged_attention(
-                    q[:, 0], cache.k_pages[li], cache.v_pages[li], bt,
-                    # the new token is part of its own context
-                    jnp.where(active, ctx + 1, 0))
+                if mesh is not None:
+                    # TP: per-shard append + attention on the local head
+                    # slice; `out` comes back REPLICATED so the proj
+                    # contraction below never splits (bit-exactness)
+                    out, cache.k_pages[li], cache.v_pages[li] = \
+                        _pa.decode_step_tp(
+                            q[:, 0], k[:, 0], v[:, 0], cache.k_pages[li],
+                            cache.v_pages[li], bt, ctx, active, mesh,
+                            axis=self._tp_axis)
+                else:
+                    cache.k_pages[li], cache.v_pages[li] = \
+                        _pa.cache_append(
+                            cache.k_pages[li], cache.v_pages[li],
+                            k[:, 0], v[:, 0], bt, ctx, active)
+                    out = _pa.paged_attention(
+                        q[:, 0], cache.k_pages[li], cache.v_pages[li], bt,
+                        # the new token is part of its own context
+                        jnp.where(active, ctx + 1, 0))
                 out = reshape(Tensor(out), [B, 1, self.cfg.hidden_size])
                 x = x + blk.attn.proj(out)
             with jax.named_scope("ln"):

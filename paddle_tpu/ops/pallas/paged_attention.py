@@ -347,6 +347,103 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
                                context_lens, scale=scale)
 
 
+# ------------------- tensor-parallel (head-sharded) path ---------------------
+#
+# Decode attention is embarrassingly parallel over HEADS: each head's
+# page gather, online softmax and weighted sum touch only that head's
+# slice of the pools. Sharding the pools' head axis over a mesh axis
+# therefore needs NO cross-device math — every shard runs the normal
+# single-chip dispatch on its local head slice (the Pallas page walk or
+# the XLA gather, resolved per LOCAL shape by the same autotune layer),
+# and concatenating shard outputs reproduces the single-chip result
+# BIT-EXACTLY because no floating-point reduction ever crosses the
+# shard boundary. The serving layer replicates the attention output
+# before the proj matmul (see models/gpt.py) so the contraction that
+# follows is also never split — that is the whole bit-exactness
+# contract of TP decode.
+
+
+def _rep_put(x, mesh):
+    """Replicate `x` onto `mesh`: a sharding constraint under a trace
+    (GSPMD inserts the all-gather — pure data movement), a device_put
+    eagerly (with_sharding_constraint needs a surrounding jit)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    sh = NamedSharding(mesh, PartitionSpec())
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sh)
+    return jax.device_put(x, sh)
+
+
+def decode_step_tp(q, k_new, v_new, k_pages, v_pages, block_tables,
+                   context_lens, active, mesh, axis="tp", scale=None):
+    """One TP decode-attention step on head-sharded pools: per-shard K/V
+    append + paged attention over the LOCAL head slice (the page gather
+    is unchanged inside each shard — block tables and context lens
+    replicate), then the attention output is gathered back to replicated
+    so the caller's proj matmul never splits a contraction.
+
+    q/k_new/v_new are [B, H, D]; pools [num_pages, page_size, H, D]
+    sharded (or shardable) over `axis` on the head dim. Returns
+    (out [B, H, D] replicated, k_pages, v_pages head-sharded). H must
+    divide by the mesh axis size. Traceable — the serving engine's fused
+    step jits over it with the pools donated."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..._jax_compat import shard_map
+    B, H, D = q.shape
+    n_shards = mesh.shape[axis]
+    if H % n_shards:
+        raise ValueError(f"decode_step_tp: {H} heads do not divide over "
+                         f"mesh axis {axis!r} of size {n_shards}")
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    scale = float(scale)
+
+    def body(q_s, kn_s, vn_s, kp_s, vp_s, bt, cl, act):
+        kp_s, vp_s = _append_impl(kp_s, vp_s, kn_s, vn_s, bt, cl, act)
+        out = paged_attention(q_s, kp_s, vp_s, bt,
+                              jnp.where(act, cl + 1, 0), scale=scale)
+        return out, kp_s, vp_s
+
+    head = P(None, axis, None)
+    pool = P(None, None, axis, None)
+    rep = P()
+    out, k_pages, v_pages = shard_map(
+        body, mesh=mesh,
+        in_specs=(head, head, head, pool, pool, rep, rep, rep),
+        out_specs=(head, pool, pool), check_vma=False)(
+            q, k_new, v_new, k_pages, v_pages, block_tables,
+            context_lens, active)
+    return _rep_put(out, mesh), k_pages, v_pages
+
+
+def prefill_append_tp(k_pages, v_pages, k_seq, v_seq, page_ids, length,
+                      mesh, axis="tp", start=0):
+    """`prefill_append` on head-sharded pools: each shard scatters its
+    own head slice of the prompt K/V [L, H, D] into its pool slice. The
+    scatter indices (page ids, offsets) are head-independent, so this is
+    the identical write per shard — no communication at all."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..._jax_compat import shard_map
+
+    def body(kp_s, vp_s, ks_s, vs_s, pid, ln, st):
+        return prefill_append(kp_s, vp_s, ks_s, vs_s, pid, ln, start=st)
+
+    pool = P(None, None, axis, None)
+    seq = P(None, axis, None)
+    rep = P()
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(pool, pool, seq, seq, rep, rep, rep),
+        out_specs=(pool, pool), check_vma=False)(
+            k_pages, v_pages, k_seq, v_seq, page_ids,
+            jnp.asarray(length, jnp.int32), jnp.asarray(start, jnp.int32))
+
+
 # ----------------------------- cache append ----------------------------------
 
 

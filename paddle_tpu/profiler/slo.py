@@ -45,7 +45,7 @@ from . import metrics as _metrics
 __all__ = ["SLOTracker", "SIGNALS", "QUANTILES", "enabled",
            "default_targets", "last_status", "current_snapshot"]
 
-SIGNALS = ("ttft", "tpot", "queue_wait", "e2e")
+SIGNALS = ("ttft", "tpot", "queue_wait", "e2e", "handoff_wait")
 QUANTILES = ("p50", "p95", "p99")
 
 _REG = _metrics.default_registry()
@@ -73,7 +73,9 @@ def default_targets() -> Dict[str, float]:
     pairs = (("ttft", env_float("PADDLE_TPU_SLO_TTFT_P99_S", 0.0)),
              ("tpot", env_float("PADDLE_TPU_SLO_TPOT_P99_S", 0.0)),
              ("queue_wait", env_float("PADDLE_TPU_SLO_QUEUE_P99_S", 0.0)),
-             ("e2e", env_float("PADDLE_TPU_SLO_E2E_P99_S", 0.0)))
+             ("e2e", env_float("PADDLE_TPU_SLO_E2E_P99_S", 0.0)),
+             ("handoff_wait",
+              env_float("PADDLE_TPU_SLO_HANDOFF_P99_S", 0.0)))
     for sig, t in pairs:
         if t > 0:
             out[sig] = t

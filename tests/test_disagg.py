@@ -1,0 +1,261 @@
+"""Disaggregated prefill/decode pipeline (inference/disagg.py): prefill
+workers own a private single-slot cache on their own device, produce KV
+pages into a handoff queue, and the decode engine drains the queue at
+the top of its own step (ALL cache mutation on the decode thread — the
+``handoff_source`` peek/pop protocol).  Greedy tokens must stay
+bit-exact vs the co-located engine in sync, threaded, and TP-composed
+modes; preemption requeues to the PIPELINE (re-prefill by a worker);
+the ``serving_handoff_*`` / per-stage occupancy metric families feed
+the SLO plane.
+
+fast-sibling: tier-1-fast tiny GPT; disagg-at-scale A/B numbers live
+in bench.py's gpt2_decode ``disagg`` block.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.disagg import DisaggPipeline, KVHandoff
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.profiler import events
+from paddle_tpu.profiler import metrics as metrics_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    events.default_event_log().clear()
+    yield
+    events.default_event_log().clear()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _shared_compile_cache():
+    """Shares test_serving.py's persistent-compile-cache dir — the
+    decode engine here compiles the same tiny-model executables."""
+    import os
+    import tempfile
+    from paddle_tpu.framework import flags as flags_mod
+    cache = os.path.join(tempfile.gettempdir(), "pt_serving_ccache")
+    os.makedirs(cache, exist_ok=True)
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": cache})
+    yield
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
+
+
+def _model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, max_position_embeddings=128,
+                    hidden_size=32, num_layers=2, num_heads=2,
+                    dropout=0.0, attn_dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _ref(m, prompt, n, page_size=8):
+    # disarm for the reference run: generate_paged on a TP-armed model
+    # (the TP-composed test) would shard instead of running single-chip
+    mesh, axis = m.tp_mesh(), getattr(m, "_tp_axis", "tp")
+    m.set_tp_mesh(None)
+    try:
+        ids = paddle.to_tensor(np.asarray([prompt], np.int32))
+        out = np.asarray(m.generate_paged(ids, n,
+                                          page_size=page_size).data)
+    finally:
+        m.set_tp_mesh(mesh, axis)
+    return out[0, len(prompt):].tolist()
+
+
+_PROMPTS = [[5, 7, 11, 13], [3, 1, 4, 1, 5, 9, 2, 6], [42] * 17, [9, 9]]
+
+
+class TestDisaggParity:
+    def test_sync_pipeline_matches_colocated_tokens(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=4, max_len=64, page_size=8,
+                            name="dis")
+        pipe = DisaggPipeline(eng, num_workers=2)
+        reqs = [pipe.submit(p, max_new_tokens=10) for p in _PROMPTS]
+        pipe.run_until_idle()
+        for p, r in zip(_PROMPTS, reqs):
+            assert r.result(timeout=5) == _ref(m, p, 10), \
+                "disagg handoff changed the greedy tokens"
+        assert eng.stats["handoffs"] == len(_PROMPTS)
+        assert eng.stats["prefills"] == 0  # every prefill ran on a worker
+        st = pipe.status()
+        assert st["handoffs"] == len(_PROMPTS)
+        assert st["worker_prefills"] == len(_PROMPTS)
+        assert st["queue_depth"] == 0 and st["handoff_depth"] == 0
+        # pages fully recycled on the DECODE pools
+        assert eng.status()["free_pages"] == eng.cache.num_pages - 1
+        pipe.close()
+
+    @pytest.mark.slow
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="TP-composed disagg needs >=2 devices")
+    def test_tp_composed_pipeline_matches_single_chip(self):
+        """Disagg over a TP decode mesh: prefill workers land on
+        non-mesh devices, payloads re-place onto the replicated mesh
+        sharding at inject, tokens stay bit-exact.  Slow: composes the
+        two heavy compile sets (mesh decode programs + sharded-pool
+        inject); each half is pinned tier-1-fast on its own.
+
+        fast-sibling: tests/test_tp_decode.py, tests/test_disagg.py
+        (sync-pipeline parity stays tier-1-fast)."""
+        from jax.sharding import Mesh
+        m, cfg = _model()
+        mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        eng = ServingEngine(m, max_batch=4, max_len=64, page_size=8,
+                            name="distp", mesh=mesh)
+        pipe = DisaggPipeline(eng, num_workers=1)
+        if len(jax.devices()) > 2:  # a spare device exists off the mesh
+            mesh_devs = {str(d) for d in mesh.devices.flat}
+            for d in pipe.status()["stages"]["prefill"]["devices"]:
+                assert d not in mesh_devs
+        reqs = [pipe.submit(p, max_new_tokens=10) for p in _PROMPTS]
+        pipe.run_until_idle()
+        for p, r in zip(_PROMPTS, reqs):
+            assert r.result(timeout=5) == _ref(m, p, 10)
+        assert eng.stats["handoffs"] == len(_PROMPTS)
+        pipe.close()
+
+    def test_threaded_pipeline_matches_and_stops_clean(self):
+        """Worker threads + engine loop: handoffs drain INSIDE the
+        decode thread's step (the drainer-thread race regression)."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=4, max_len=64, page_size=8,
+                            name="thr")
+        pipe = DisaggPipeline(eng, num_workers=2)
+        pipe.start(poll_s=0.002)
+        reqs = [pipe.submit(p, max_new_tokens=10) for p in _PROMPTS]
+        outs = [r.result(timeout=60) for r in reqs]
+        pipe.close()
+        for p, out in zip(_PROMPTS, outs):
+            assert out == _ref(m, p, 10)
+        assert eng._closed
+
+
+class TestDisaggLifecycle:
+    def test_preemption_requeues_to_pipeline_and_reprefills(self):
+        """Pool exhaustion on the DECODE engine: the victim goes back
+        to the pipeline queue (on_preempt_requeue hook), a worker
+        re-prefills prompt+generated, and tokens stay exact."""
+        m, cfg = _model()
+        rng = np.random.default_rng(17)
+        prompts = [rng.integers(1, cfg.vocab_size, (14,)).tolist()
+                   for _ in range(2)]
+        eng = ServingEngine(m, max_batch=2, max_len=40, page_size=8,
+                            num_pages=6, name="dispre")
+        pipe = DisaggPipeline(eng, num_workers=1)
+        reqs = [pipe.submit(p, max_new_tokens=12) for p in prompts]
+        pipe.run_until_idle()
+        assert eng.stats["preemptions"] >= 1
+        assert eng.stats["handoffs"] >= len(prompts) + 1  # re-prefill handoff
+        for p, r in zip(prompts, reqs):
+            out = r.result(timeout=5)
+            assert len(out) == 12 and out == _ref(m, p, 12), \
+                "preempt->re-prefill through the pipeline changed tokens"
+        pipe.close()
+
+    def test_finished_at_prefill_never_hands_off(self):
+        """max_new_tokens=1 finishes inside the worker (first token is
+        the last): no KV payload crosses stages for it."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=64, page_size=8,
+                            name="dis1")
+        pipe = DisaggPipeline(eng, num_workers=1)
+        r = pipe.submit([4, 5, 6], max_new_tokens=1)
+        pipe.run_until_idle()
+        assert r.result(timeout=5) == _ref(m, [4, 5, 6], 1)
+        assert eng.stats["handoffs"] == 0
+        pipe.close()
+
+    def test_close_fails_queued_requests_loudly(self):
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=64, page_size=8,
+                            name="discl")
+        pipe = DisaggPipeline(eng, num_workers=1)
+        req = pipe.submit([1, 2, 3], max_new_tokens=4)
+        pipe.close()  # never stepped: request still queued at the pipeline
+        with pytest.raises(RuntimeError, match="pipeline closed"):
+            req.result(timeout=5)
+        assert eng._closed
+
+    def test_handoff_payload_is_pow2_bucketed(self):
+        """Payload page-count pads to a power of two so the inject jit
+        compiles once per bucket, not once per sequence length."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=1, max_len=64, page_size=8,
+                            name="dispad")
+        pipe = DisaggPipeline(eng, num_workers=1)
+        captured = []
+        orig = pipe._enqueue_handoff
+
+        def spy(h):
+            captured.append(h)
+            orig(h)
+
+        pipe._enqueue_handoff = spy
+        r = pipe.submit(list(range(1, 18)), max_new_tokens=2)  # 3 pages
+        pipe.run_until_idle()
+        assert r.result(timeout=5) == _ref(m, list(range(1, 18)), 2)
+        assert len(captured) == 1
+        h = captured[0]
+        assert isinstance(h, KVHandoff)
+        assert h.k_payload[0].shape[0] == 4  # 3 pages -> pow2 bucket 4
+        assert h.nbytes > 0
+        pipe.close()
+
+
+class TestDisaggObservability:
+    def test_handoff_and_occupancy_metric_families(self):
+        m, cfg = _model()
+        reg = metrics_mod.default_registry()
+        eng = ServingEngine(m, max_batch=4, max_len=64, page_size=8,
+                            name="disobs")
+        pipe = DisaggPipeline(eng, num_workers=2)
+        reqs = [pipe.submit(p, max_new_tokens=4) for p in _PROMPTS]
+        pipe.run_until_idle()
+        for r in reqs:
+            r.result(timeout=5)
+        wait = [v for v in reg.get("serving_handoff_wait_seconds")
+                .snapshot()["values"]
+                if v["labels"].get("model") == "disobs"]
+        assert wait and wait[0]["count"] == len(_PROMPTS)
+        assert reg.get("serving_handoff_bytes_total").value(
+            model="disobs") > 0
+        assert reg.get("serving_handoff_depth").value(
+            model="disobs") == 0  # drained
+        occ = reg.get("serving_stage_occupancy")
+        # published for both stages at least once
+        stages = {v["labels"].get("stage")
+                  for v in occ.snapshot()["values"]
+                  if v["labels"].get("model") == "disobs"}
+        assert stages == {"prefill", "decode"}
+        # handoff_wait wired into the SLO plane's signal set
+        from paddle_tpu.profiler.slo import SIGNALS
+        assert "handoff_wait" in SIGNALS
+        pipe.close()
+
+    def test_ttft_attributed_to_worker_prefill(self):
+        """TTFT lands when the WORKER emits the first token (before the
+        handoff), labelled with the engine decode path."""
+        m, cfg = _model()
+        reg = metrics_mod.default_registry()
+        eng = ServingEngine(m, max_batch=2, max_len=64, page_size=8,
+                            name="disttft")
+        pipe = DisaggPipeline(eng, num_workers=1)
+        reqs = [pipe.submit(p, max_new_tokens=3) for p in _PROMPTS[:2]]
+        pipe.run_until_idle()
+        for r in reqs:
+            r.result(timeout=5)
+            assert r.ttft_s is not None and r.ttft_s >= 0
+        ttft = [v for v in reg.get("serving_ttft_seconds")
+                .snapshot()["values"]
+                if v["labels"].get("model") == "disttft"]
+        assert ttft and ttft[0]["count"] == 2
+        assert ttft[0]["labels"]["path"] == eng.decode_mode
+        pipe.close()

@@ -329,9 +329,10 @@ class TestStragglerDebounce:
 
     def test_two_simultaneous_stragglers_both_evict(self):
         """Regression for the PR-13 carried follow-up: two hosts slow at
-        once each confirm their own debounced streak and BOTH evict
-        (down to the min_world floor), with re-densified rank maps that
-        exclude every held host."""
+        once each confirm their own debounced streak and BOTH evict in
+        ONE batched decision (down to the min_world floor) — a single
+        command carrying the full host list and a rank map that excludes
+        every held host, instead of two overlapping relaunch specs."""
         bus = ControllerCommandBus(FakeStore())
         agg = _Agg()
         ctl = FleetController(agg, bus, world_size=3, confirm_windows=2,
@@ -342,20 +343,21 @@ class TestStragglerDebounce:
                  2: _digest("trainer-2", 2, step=10 + i)}
             _tick(ctl, agg, ["trainer-1", "trainer-2"], d)
         cmds = bus.poll(0)
-        assert [c["action"] for c in cmds] == ["evict", "evict"]
-        assert {c["host"] for c in cmds} == {"trainer-1", "trainer-2"}
-        # ledger order: the second eviction's rank map excludes BOTH
-        assert cmds[0]["np"] == 2 and cmds[1]["np"] == 1
-        assert cmds[1]["ranks"] == {"trainer-0": 0}
+        assert [c["action"] for c in cmds] == ["evict"]
+        assert set(cmds[0]["hosts"]) == {"trainer-1", "trainer-2"}
+        assert cmds[0]["host"] in cmds[0]["hosts"]  # back-compat field
+        assert cmds[0]["np"] == 1
+        assert cmds[0]["ranks"] == {"trainer-0": 0}
         assert ctl.current_world() == 1
         # both readmit independently once their probation beats are fresh
         ctl.readmit_after_s = 0.0
         bus.beat_ready("trainer-1")
         bus.beat_ready("trainer-2")
+        seen = bus.last_id()  # one batched evict == one bus command
         d = {0: _digest("trainer-0", 0, step=20)}
         _tick(ctl, agg, [], d)  # observes beats; readmits one
         _tick(ctl, agg, [], d)  # readmits the other
-        back = bus.poll(2)
+        back = bus.poll(seen)
         assert [c["action"] for c in back] == ["readmit", "readmit"]
         assert {c["host"] for c in back} == {"trainer-1", "trainer-2"}
         # partial readmission covers N-1; the last one restores full N

@@ -206,6 +206,7 @@ class TestMetricNamingLint:
         import paddle_tpu.distributed.ps.communicator  # noqa: F401
         import paddle_tpu.distributed.ps.heter  # noqa: F401
         import paddle_tpu.fault  # noqa: F401
+        import paddle_tpu.inference.disagg  # noqa: F401
         import paddle_tpu.inference.serving  # noqa: F401
         import paddle_tpu.io.dataloader  # noqa: F401
         import paddle_tpu.io.worker  # noqa: F401
@@ -300,6 +301,13 @@ class TestMetricNamingLint:
         _srv._M_SWAP_STEP.set(100, model="gpt")
         _srv._M_RESTARTS.inc(model="gpt", reason="wedged")
         _srv._M_SUSPENDED.set(0, model="gpt")
+        # disaggregated prefill/decode handoff plane (model=, per-stage
+        # occupancy additionally by stage=)
+        _srv._M_HANDOFF_DEPTH.set(1, model="gpt")
+        _srv._M_HANDOFF_WAIT.observe(0.004, model="gpt")
+        _srv._M_HANDOFF_BYTES.inc(4096, model="gpt")
+        _srv._M_STAGE_OCC.set(1, model="gpt", stage="prefill")
+        _srv._M_STAGE_OCC.set(2, model="gpt", stage="decode")
         _at._M_EVENTS.inc(event="hit", op="paged_attn")
         _at._M_TUNES.inc(op="paged_attn")
         _at._M_CHOSEN.set(1.0, op="paged_attn", config="impl1-heads12")
@@ -313,6 +321,7 @@ class TestMetricNamingLint:
         _slo._M_BREACHES.inc(model="gpt", signal="ttft")
         _slo._M_BREACHED.set(1, model="gpt", signal="ttft")
         _slo._M_P99.set(0.2, model="gpt", signal="ttft")
+        _slo._M_P99.set(0.01, model="gpt", signal="handoff_wait")
         reg = metrics.default_registry()
         problems = []
         for name in reg.names():

@@ -1316,6 +1316,80 @@ class TestServingGate:
             shared_prefix={"error": "RuntimeError: pool"})
         assert gate.validate_observability(self._doc(cfg=cfg)) == []
 
+    @staticmethod
+    def _distributed_blocks():
+        return {
+            "tp_decode": {"single_ms_per_token": 12.0,
+                          "tp_ms_per_token": 12.4, "tp_degree": 2,
+                          "tpot_ratio": 1.033, "identical_tokens": True,
+                          "collective_bytes_by_link": {"ici": 512.0,
+                                                       "dcn": 0.0}},
+            "disagg": {"colocated_ms_per_token": 12.0,
+                       "disagg_ms_per_token": 12.2, "tpot_ratio": 1.017,
+                       "handoffs": 5, "prefill_workers": 1,
+                       "decode_prefills": 0, "identical_tokens": True},
+        }
+
+    def test_valid_distributed_decode_blocks_pass(self):
+        cfg = self._decode_cfg(**self._distributed_blocks())
+        assert gate.validate_observability(self._doc(cfg=cfg)) == []
+
+    def test_tp_token_drift_and_bad_degree_named(self):
+        """TP is a layout change: token drift vs single-chip is a
+        correctness bug, and a tp_degree < 2 means no sharding ran."""
+        blocks = self._distributed_blocks()
+        blocks["tp_decode"]["identical_tokens"] = False
+        blocks["tp_decode"]["tp_degree"] = 1
+        blocks["tp_decode"]["collective_bytes_by_link"]["ici"] = -1
+        blob = "\n".join(gate.validate_observability(
+            self._doc(cfg=self._decode_cfg(**blocks))))
+        assert "tp_decode.identical_tokens" in blob and "disagreed" in blob
+        assert "tp_decode.tp_degree" in blob
+        assert "collective_bytes_by_link.ici" in blob
+
+    def test_disagg_decode_side_prefill_fails_the_gate(self):
+        """A nonzero decode-side prefill count means the stages were
+        never actually split — the disaggregation claim is void."""
+        blocks = self._distributed_blocks()
+        blocks["disagg"]["decode_prefills"] = 3
+        blocks["disagg"]["handoffs"] = 0
+        blob = "\n".join(gate.validate_observability(
+            self._doc(cfg=self._decode_cfg(**blocks))))
+        assert "decode_prefills" in blob and "ran prefills itself" in blob
+        assert "disagg.handoffs" in blob
+
+    def test_distributed_blocks_may_skip_or_error(self):
+        """A 1-device box skips the TP A/B; a failed probe reports
+        itself — both stay schema-valid."""
+        cfg = self._decode_cfg(
+            tp_decode={"skipped": "needs >=2 devices"},
+            disagg={"error": "RuntimeError: boom"})
+        assert gate.validate_observability(self._doc(cfg=cfg)) == []
+
+    def test_handoff_families_and_stage_enum_enforced(self):
+        metrics = {
+            "serving_handoff_wait_seconds": {
+                "kind": "histogram", "values": [
+                    {"labels": {"model": "m"},
+                     "buckets": {"+Inf": 3}, "sum": 0.01, "count": 3}]},
+            "serving_handoff_bytes_total": {
+                "kind": "counter", "values": [
+                    {"labels": {"model": "m"}, "value": 8192.0}]},
+            "serving_handoff_depth": {
+                "kind": "gauge", "values": [
+                    {"labels": {"model": "m"}, "value": 0}]},
+            "serving_stage_occupancy": {
+                "kind": "gauge", "values": [
+                    {"labels": {"model": "m", "stage": "prefill"},
+                     "value": 1}]},
+        }
+        assert gate.validate_observability(self._doc(metrics=metrics)) == []
+        metrics["serving_stage_occupancy"]["values"][0]["labels"][
+            "stage"] = "warp"
+        blob = "\n".join(gate.validate_observability(
+            self._doc(metrics=metrics)))
+        assert "stage label" in blob and "warp" in blob
+
     def test_path_label_value_enum_enforced(self):
         metrics = {
             "serving_ttft_seconds": {"kind": "histogram", "values": [

@@ -460,7 +460,16 @@ _SERVING_FAMILIES = {
     "serving_swap_step": ("gauge", ("model",)),
     "serving_restart_total": ("counter", ("model", "reason")),
     "serving_suspended": ("gauge", ("model",)),
+    # disaggregated prefill/decode handoff plane (inference/disagg.py)
+    "serving_handoff_depth": ("gauge", ("model",)),
+    "serving_handoff_wait_seconds": ("histogram", ("model",)),
+    "serving_handoff_bytes_total": ("counter", ("model",)),
+    "serving_stage_occupancy": ("gauge", ("model", "stage")),
 }
+
+#: legal `stage` label values on serving_stage_occupancy (the two-stage
+#: disaggregated pipeline)
+_STAGES = ("prefill", "decode")
 
 #: families whose gauge value may legitimately be negative
 #: (serving_swap_step is -1 until a hot-swap lands)
@@ -541,6 +550,11 @@ def _validate_serving_metrics(where: str, metrics: dict) -> List[str]:
                     f"{where}.metrics.{name}[{i}]: outcome label "
                     f"{labels.get('outcome')!r} is not one of "
                     f"{_SWAP_OUTCOMES}")
+            if name == "serving_stage_occupancy" and \
+                    labels.get("stage") not in _STAGES:
+                problems.append(
+                    f"{where}.metrics.{name}[{i}]: stage label "
+                    f"{labels.get('stage')!r} is not one of {_STAGES}")
     return problems
 
 
@@ -849,6 +863,66 @@ def _validate_decode_block(where: str, cfg: dict) -> List[str]:
                     f"{where}.shared_prefix.off.prefix_hit_tokens "
                     f"{off.get('prefix_hit_tokens')!r}: sharing disabled "
                     f"but prefix hits were recorded")
+    tpd = cfg.get("tp_decode")
+    if tpd is not None:
+        if not isinstance(tpd, dict):
+            problems.append(f"{where}.tp_decode is not an object")
+        elif "error" not in tpd and "skipped" not in tpd:
+            for key in ("single_ms_per_token", "tp_ms_per_token"):
+                if not _nonneg_num(tpd.get(key)):
+                    problems.append(f"{where}.tp_decode.{key} "
+                                    f"{tpd.get(key)!r} is not a "
+                                    f"non-negative number")
+            deg = tpd.get("tp_degree")
+            if not isinstance(deg, int) or isinstance(deg, bool) \
+                    or deg < 2:
+                problems.append(f"{where}.tp_decode.tp_degree {deg!r} is "
+                                f"not an integer >= 2")
+            ratio = tpd.get("tpot_ratio")
+            if ratio is not None and not _nonneg_num(ratio):
+                problems.append(f"{where}.tp_decode.tpot_ratio {ratio!r} "
+                                f"is not a non-negative number or null")
+            # the bit-parity claim: head-sharding is a LAYOUT change —
+            # TP tokens drifting from single-chip is a correctness bug
+            if tpd.get("identical_tokens") is not True:
+                problems.append(f"{where}.tp_decode.identical_tokens "
+                                f"{tpd.get('identical_tokens')!r}: TP and "
+                                f"single-chip decode disagreed on tokens")
+            link = tpd.get("collective_bytes_by_link")
+            if isinstance(link, dict) and "error" not in link:
+                for lk in ("ici", "dcn"):
+                    if not _nonneg_num(link.get(lk)):
+                        problems.append(
+                            f"{where}.tp_decode.collective_bytes_by_link"
+                            f".{lk} {link.get(lk)!r} is not a "
+                            f"non-negative number")
+    dis = cfg.get("disagg")
+    if dis is not None:
+        if not isinstance(dis, dict):
+            problems.append(f"{where}.disagg is not an object")
+        elif "error" not in dis and "skipped" not in dis:
+            for key in ("colocated_ms_per_token", "disagg_ms_per_token"):
+                if not _nonneg_num(dis.get(key)):
+                    problems.append(f"{where}.disagg.{key} "
+                                    f"{dis.get(key)!r} is not a "
+                                    f"non-negative number")
+            for key in ("handoffs", "prefill_workers"):
+                v = dis.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                    problems.append(f"{where}.disagg.{key} {v!r} is not a "
+                                    f"positive integer")
+            # the disaggregation claim itself: EVERY prefill ran on a
+            # prefill worker — a nonzero decode-side prefill count means
+            # the stages were never actually split
+            if dis.get("decode_prefills") != 0:
+                problems.append(f"{where}.disagg.decode_prefills "
+                                f"{dis.get('decode_prefills')!r}: the "
+                                f"decode engine ran prefills itself")
+            if dis.get("identical_tokens") is not True:
+                problems.append(f"{where}.disagg.identical_tokens "
+                                f"{dis.get('identical_tokens')!r}: "
+                                f"disagg and co-located decode disagreed "
+                                f"on tokens")
     return problems
 
 

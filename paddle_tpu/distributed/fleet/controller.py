@@ -70,7 +70,12 @@ poll cadence, default 1.0), ``PADDLE_TPU_CONTROLLER_MIN_WORLD``
 ``PADDLE_TPU_CONTROLLER_RESTART_COOLDOWN_SEC`` (default 30),
 ``PADDLE_TPU_CONTROLLER_MAX_SWAP_ROLLBACKS`` (default 2),
 ``PADDLE_TPU_CONTROLLER_SWAP_OBSERVE_SEC`` (default 60),
-``PADDLE_TPU_SERVING_SHED_QUEUE_CAP`` (default 8).
+``PADDLE_TPU_SERVING_SHED_QUEUE_CAP`` (default 8), plus the HA-election
+pair ``PADDLE_TPU_CONTROLLER_LEASE_TTL`` / ``PADDLE_TPU_CONTROLLER_STANDBYS``
+(fleet/leader.py: ``--controller`` on several hosts elects ONE leader;
+standbys observe and take over within a lease TTL, inheriting the
+replicated ``ctl/ledger`` decision state; every actuation carries the
+leader's fencing term).
 """
 from __future__ import annotations
 
@@ -84,6 +89,8 @@ from typing import Callable, Dict, List, Optional
 
 from ...profiler import events as _events_mod
 from ...profiler import metrics as _metrics_mod
+from .leader import (ControllerFencedError, LeaderLease, LEDGER_KEY,
+                     note_term)
 
 __all__ = ["FleetController", "ControllerCommandBus", "set_controller",
            "get_controller", "GEN_STRIDE", "controller_from_env"]
@@ -107,7 +114,7 @@ _M_DECISIONS = _REG.counter(
     "fleet-controller decisions, by policy (straggler_evict / "
     "straggler_skip / readmit / health_rollback / serving_shed / "
     "serving_restart / serving_swap_rollback / serving_swap_halt) and "
-    "outcome (applied / dry_run / failed)")
+    "outcome (applied / dry_run / failed / fenced)")
 _M_EVICTIONS = _REG.counter(
     "controller_evictions_total",
     "straggler evictions the controller actually published, by host")
@@ -305,9 +312,16 @@ class FleetController:
                  max_swap_rollbacks: Optional[int] = None,
                  swap_observe_s: Optional[float] = None,
                  shed_queue_cap: Optional[int] = None,
-                 serving_provider: Optional[Callable] = None):
+                 serving_provider: Optional[Callable] = None,
+                 lease: Optional[LeaderLease] = None):
         self.aggregator = aggregator
         self.bus = bus
+        #: HA mode (PR 20): with a LeaderLease attached this controller
+        #: is one of possibly many — policies only run while it HOLDS
+        #: the lease; standbys observe and wait. lease=None preserves
+        #: the original single-controller behavior exactly (leader by
+        #: definition, no store election traffic).
+        self.lease = lease
         self.world_size = int(world_size)
         self.dry_run = bool(dry_run)
         if confirm_windows is None:
@@ -395,6 +409,11 @@ class FleetController:
         self._srv_wedge_streaks: Dict[str, int] = {}
         self._srv_restart_after: Dict[str, float] = {}
         self._srv_rollbacks: Dict[str, int] = {}
+        #: set when a decision changed replicable ledger state; the tick
+        #: tail writes ONE ctl/ledger blob per dirty tick (not per
+        #: decision) so a standby inherits cooldowns/probation/rollback
+        #: counts on takeover
+        self._ledger_dirty = False
 
     # -- observation --------------------------------------------------------
     def on_collect(self, digests: Dict[int, dict]):
@@ -407,14 +426,117 @@ class FleetController:
             warnings.warn(f"fleet controller tick failed: "
                           f"{type(e).__name__}: {e}")
 
+    def is_leader(self) -> bool:
+        """Without a lease this controller IS the control plane (the
+        pre-HA single-controller deployment); with one, only the current
+        lease holder may decide."""
+        return self.lease is None or self.lease.is_leader
+
     def _tick(self, digests: Dict[int, dict]):
-        with self._tick_lock, self._lock:
-            self._learn_assignment(digests)
-            self._observe_first_steps(digests)
-            self._straggler_policy()
-            self._health_policy(digests)
-            self._readmit_policy()
-            self._serving_policy()
+        with self._tick_lock:
+            # election step first, OUTSIDE the status lock (store RPCs);
+            # _tick_lock keeps concurrent ticks out
+            if self.lease is not None and self.lease.tick() == "acquired":
+                self._load_ledger()
+            blob = None
+            with self._lock:
+                self._learn_assignment(digests)
+                self._observe_first_steps(digests)
+                if self.is_leader():
+                    self._straggler_policy()
+                    self._health_policy(digests)
+                    self._readmit_policy()
+                    self._serving_policy()
+                if self._ledger_dirty and self.lease is not None \
+                        and self.lease.is_leader:
+                    blob = json.dumps(_json_safe(self._ledger_snapshot()))
+                    self._ledger_dirty = False
+            if blob is not None:
+                try:
+                    self.lease.store.set(LEDGER_KEY, blob)
+                except Exception as e:
+                    warnings.warn(f"controller ledger replication failed "
+                                  f"({type(e).__name__}: {e}); retrying "
+                                  f"next tick")
+                    with self._lock:
+                        self._ledger_dirty = True
+
+    # -- ledger replication (HA takeover inheritance) -----------------------
+    def _ledger_snapshot(self) -> dict:
+        """Everything a NEW leader must inherit to not repeat a standing
+        decision: eviction/probation state, hysteresis suppressions,
+        rollback cooldown + counts, shed set, restart cooldowns, the
+        learned rank assignment, and the last decision per policy.
+        Deliberately NOT replicated: `_ready_obs` / `_streaks` — those
+        are freshness observations on THIS process's monotonic clock and
+        must be re-observed by the inheritor. Called under _lock."""
+        last: Dict[str, dict] = {}
+        for r in self.decisions:
+            last[r["policy"]] = dict(r)
+        return {
+            "term": self.lease.term if self.lease is not None else 0,
+            "decision_seq": self._decision_seq,
+            "evicted": {h: dict(r) for h, r in self._evicted.items()},
+            "suppressed": sorted(self._suppressed),
+            "rollback_suppressed": sorted(self._rollback_suppressed),
+            # wall-clock deadlines survive replication (cross-host skew
+            # only shifts a cooldown by the skew, never re-arms it)
+            "rollback_until": self._rollback_until,
+            "srv_rollbacks": dict(self._srv_rollbacks),
+            "srv_shed": sorted(self._srv_shed),
+            "srv_restart_after": dict(self._srv_restart_after),
+            "assignment": dict(self._assignment),
+            "last_decision": last,
+        }
+
+    def _load_ledger(self):
+        """Takeover: merge the deposed leader's replicated ledger into
+        our own state — union/max merges, so a standby that already
+        observed something locally never regresses. Without this, the
+        new leader would re-evict a host mid-probation (its stale digest
+        still reads slow) or re-roll-back an already-restored swap."""
+        if self.lease is None:
+            return
+        try:
+            store = self.lease.store
+            if not store.check(LEDGER_KEY):
+                return
+            blob = json.loads(store.get(LEDGER_KEY).decode())
+        except Exception as e:
+            warnings.warn(f"controller ledger load failed "
+                          f"({type(e).__name__}: {e}); starting from "
+                          f"local state only")
+            return
+        with self._lock:
+            self._decision_seq = max(self._decision_seq,
+                                     int(blob.get("decision_seq", 0)))
+            for h, r in (blob.get("evicted") or {}).items():
+                self._evicted.setdefault(h, dict(r))
+            self._suppressed.update(blob.get("suppressed") or ())
+            self._rollback_suppressed.update(
+                blob.get("rollback_suppressed") or ())
+            self._rollback_until = max(
+                self._rollback_until,
+                float(blob.get("rollback_until", 0.0)))
+            for k, v in (blob.get("srv_rollbacks") or {}).items():
+                self._srv_rollbacks[k] = max(
+                    self._srv_rollbacks.get(k, 0), int(v))
+            self._srv_shed.update(blob.get("srv_shed") or ())
+            for k, v in (blob.get("srv_restart_after") or {}).items():
+                self._srv_restart_after[k] = max(
+                    self._srv_restart_after.get(k, 0.0), float(v))
+            for h, r in (blob.get("assignment") or {}).items():
+                self._assignment.setdefault(h, int(r))
+            # seed the decision history with the inherited last decision
+            # per policy: status()/obs_tail show continuity across the
+            # takeover, and _observe_first_steps keeps watching an
+            # inherited in-flight relaunch for its first fresh digest
+            have = {r["id"] for r in self.decisions}
+            for rec in (blob.get("last_decision") or {}).values():
+                if rec.get("id") not in have:
+                    rec = dict(rec)
+                    rec["inherited"] = True
+                    self.decisions.append(rec)
 
     def _learn_assignment(self, digests: Dict[int, dict]):
         """host -> rank map of the FULL fleet, learned from the digests
@@ -726,7 +848,8 @@ class FleetController:
                     "queue_depth": eng.queue_depth()}
         rec = self._act("serving_restart", evidence,
                         {"action": "restart", "host": name, "model": name},
-                        local_fn=lambda: eng.restart(reason="wedged"))
+                        local_fn=lambda: eng.restart(reason="wedged",
+                                                     term=self._term()))
         if rec["outcome"] != "failed":
             self._srv_restart_after[name] = now + self.restart_cooldown_s
             self._srv_wedge_streaks.pop(name, None)
@@ -755,7 +878,8 @@ class FleetController:
                  "queue_depth": eng.queue_depth()},
                 {"action": "shed", "host": name, "model": name,
                  "queue_cap": cap},
-                local_fn=lambda: eng.set_queue_limit(cap))
+                local_fn=lambda: eng.set_queue_limit(cap,
+                                                     term=self._term()))
             if rec["outcome"] != "failed":
                 self._srv_shed.add(name)
                 self._srv_slo_streaks.pop(name, None)
@@ -770,7 +894,8 @@ class FleetController:
             rec = self._act(
                 "serving_shed", {"recovered_windows": n},
                 {"action": "unshed", "host": name, "model": name},
-                local_fn=lambda: eng.set_queue_limit(None))
+                local_fn=lambda: eng.set_queue_limit(None,
+                                                     term=self._term()))
             if rec["outcome"] != "failed":
                 self._srv_shed.discard(name)
                 self._srv_recover_streaks.pop(name, None)
@@ -835,8 +960,18 @@ class FleetController:
         fleet), call `local_fn` directly (serving policies actuate the
         in-process engine), or `publish=False` (skip: doing nothing IS
         the applied action). Failures degrade to outcome="failed" with a
-        warning — never an exception out of the tick."""
+        warning — never an exception out of the tick.
+
+        HA: every command carries the deciding policy and (with a lease
+        attached) the leader's fencing term, so consumers — elastic
+        supervisors and the in-process serving gate — can reject an
+        actuation a DEPOSED leader left in flight (outcome="fenced")."""
         self._decision_seq += 1
+        self._ledger_dirty = True
+        cmd = dict(cmd)
+        cmd.setdefault("policy", policy)
+        if self.lease is not None:
+            cmd["term"] = int(self.lease.term)
         rec = {"id": self._decision_seq, "ts": time.time(),
                "policy": policy, "evidence": evidence,
                "action": {k: v for k, v in cmd.items()
@@ -852,6 +987,12 @@ class FleetController:
                 try:
                     local_fn()
                     rec["outcome"] = "applied"
+                except ControllerFencedError as e:
+                    # the in-process gate saw a newer term than ours: we
+                    # were deposed between deciding and actuating — the
+                    # new leader owns this incident now
+                    rec["outcome"] = "fenced"
+                    rec["error"] = str(e)
                 except Exception as e:
                     rec["outcome"] = "failed"
                     rec["error"] = f"{type(e).__name__}: {e}"
@@ -948,6 +1089,10 @@ class FleetController:
                 relaunch_to_first_step_s=dt, dry_run=self.dry_run)
 
     # -- helpers ------------------------------------------------------------
+    def _term(self) -> Optional[int]:
+        """Fencing term for locally-actuated commands (None pre-HA)."""
+        return int(self.lease.term) if self.lease is not None else None
+
     def current_world(self) -> int:
         return self.world_size - len(self._evicted)
 
@@ -984,9 +1129,14 @@ class FleetController:
 
     def status(self) -> dict:
         """The /controller endpoint payload."""
+        # the lease status reads the store (RPCs): take it OUTSIDE the
+        # status lock, same rule as _act's publish
+        lease_st = self.lease.status() if self.lease is not None else None
         with self._lock:
             return _json_safe({
                 "dry_run": self.dry_run,
+                "leader": lease_st,
+                "is_leader": self.is_leader(),
                 "world_size": self.world_size,
                 "current_world": self.current_world(),
                 "confirm_windows": self.confirm_windows,
@@ -1035,13 +1185,26 @@ def get_controller() -> Optional[FleetController]:
 
 def controller_from_env(aggregator, store, *,
                         world_size: int,
-                        dry_run: bool = False) -> FleetController:
+                        dry_run: bool = False,
+                        leader_elect: bool = True,
+                        controller_id: Optional[str] = None,
+                        lease_ttl: Optional[float] = None
+                        ) -> FleetController:
     """Build the controller + bus for a supervisor that already holds an
     aggregator and a dedicated store connection (tools/elastic_run.py),
-    register it for the /controller endpoint, and return it."""
+    register it for the /controller endpoint, and return it.
+
+    ``leader_elect=True`` (the default since PR 20) attaches a
+    :class:`~paddle_tpu.distributed.fleet.leader.LeaderLease`:
+    ``--controller`` may now be passed on EVERY host — the first ticker
+    bootstraps as leader, the rest stand by and take over within one
+    ``PADDLE_TPU_CONTROLLER_LEASE_TTL`` of leader silence. A lone
+    controller pays one lease renew per ``ttl/3`` and behaves exactly
+    like the pre-HA deployment otherwise."""
     bus = ControllerCommandBus(store)
-    # exactly one controller runs per job: clearing a previous job's
-    # done-flag here cannot race a live fleet, only a finished one
+    # clearing a previous job's done-flag cannot race a live fleet, only
+    # a finished one — with standbys this runs once per controller at
+    # job start, before any eviction can have held a host
     bus.reset_job_done()
     try:
         # arm every supervisor's ledger poll up front so the FIRST
@@ -1049,6 +1212,10 @@ def controller_from_env(aggregator, store, *,
         bus.mark_present()
     except Exception:
         pass  # re-tried by the first publish
-    ctl = FleetController(aggregator, bus, world_size, dry_run=dry_run)
+    lease = (LeaderLease(store, controller_id=controller_id,
+                         ttl=lease_ttl)
+             if leader_elect else None)
+    ctl = FleetController(aggregator, bus, world_size, dry_run=dry_run,
+                          lease=lease)
     set_controller(ctl)
     return ctl

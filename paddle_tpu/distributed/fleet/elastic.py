@@ -406,6 +406,11 @@ class ElasticSupervisor:
         self.last_reason: Optional[str] = None
         self._cmd_cursor: Optional[int] = None
         self._pending_cmd: Optional[dict] = None
+        #: highest controller fencing term ever applied by this
+        #: supervisor: a deposed leader's in-flight command (term below
+        #: this, or below the CURRENT lease record's term) is consumed
+        #: without actuation — see _command_fenced
+        self._term_seen = 0
         #: controller-command env overlay (np / rank / prewarm changes
         #: accumulated from applied commands; persists across relaunches)
         self._cmd_env: Dict[str, str] = {}
@@ -671,6 +676,14 @@ class ElasticSupervisor:
         try:
             for cmd in self.commands.poll(self._cmd_cursor or 0):
                 if cmd.get("action") in ("evict", "readmit", "rollback"):
+                    if self._command_fenced(cmd):
+                        # stale term: consume WITHOUT actuating — the
+                        # issuer was deposed and the new leader owns
+                        # this incident (it may publish its own,
+                        # current-term command any tick now)
+                        self._cmd_cursor = max(self._cmd_cursor or 0,
+                                               int(cmd.get("id", 0)))
+                        continue
                     return cmd
                 # unknown actions from a newer controller: consume + skip
                 self._cmd_cursor = max(self._cmd_cursor or 0,
@@ -678,6 +691,34 @@ class ElasticSupervisor:
         except Exception:
             pass
         return None
+
+    def _command_fenced(self, cmd: dict) -> bool:
+        """Is this command's fencing term stale? Judged against the
+        HIGHEST of (a) the term in the CURRENT lease record — never the
+        raw term counter, which a failed acquirer bumps without ever
+        holding the lease — and (b) the highest term this supervisor has
+        already applied (covers a store blip hiding the lease record).
+        Commands without a term (pre-HA controller) always pass."""
+        term = cmd.get("term")
+        if term is None:
+            return False
+        term = int(term)
+        from . import leader as _leader
+        cur = _leader.lease_term(self.commands.store)
+        high = max(self._term_seen, int(cur or 0))
+        if term < high:
+            policy = str(cmd.get("policy", "?"))
+            if _metrics_mod.enabled():
+                _leader._M_FENCED.inc(policy=policy)
+            _events_mod.emit(
+                "controller_fenced", severity="warn", policy=policy,
+                term=term, current_term=high,
+                action=cmd.get("action"), command=int(cmd.get("id", 0)),
+                target=cmd.get("host"))
+            return True
+        self._term_seen = max(self._term_seen, term)
+        _leader.note_term(term)
+        return False
 
     def _apply_command(self, cmd: dict) -> str:
         """Fold one controller command into the relaunch contract.

@@ -1,0 +1,403 @@
+"""Lease-based leader election + term fencing for the FleetController.
+
+Every self-healing policy PRs 13/18 built (evict/readmit, coordinated
+rollback, serving wedge-restart, swap-rollback) ran on ONE supervisor —
+kill it and the fleet loses its brain mid-incident. This module makes
+the control plane itself highly available over the same retry-wrapped
+TCPStore the runtime already trusts, with no new dependency:
+
+* **Lease** — the leader holds ``ctl/leader/lease``, a JSON record
+  ``{id, term, beat}`` it rewrites every ``ttl/3`` seconds with a fresh
+  ``beat`` sequence number. Standbys judge freshness by the VALUE
+  CHANGING between their own polls on their own monotonic clock — the
+  same skew-immune convention as the probation ``ready_value`` channel;
+  comparing the holder's wall clock to ours would read a dead leader as
+  alive (or a live one as dead) under cross-host clock skew.
+* **Term** — a fleet-monotonic epoch from the store's atomic counter
+  ``ctl/leader/term``. Acquiring bumps it; the new value rides in the
+  lease record and in EVERY actuation the leader issues (elastic
+  commands, serving ``restart``/``set_queue_limit``/``try_swap``). A
+  deposed leader that pauses mid-actuation and resumes after a takeover
+  carries a stale term and is rejected (fenced) — terms may skip values
+  when the at-least-once store retry double-counts an ``add``, which is
+  harmless: only ordering matters, not density.
+* **Takeover** — a standby that watched the lease value stay frozen for
+  one full TTL bumps the term, writes its own record, and re-reads to
+  confirm (last-writer-wins resolves acquire races; the loser observes a
+  foreign record and stays standby). Before each renew the holder
+  re-reads the record and DEMOTES itself if a higher term appears — the
+  two-leaders window after a pause/resume closes at the deposed
+  leader's next renew, and fencing covers the window itself.
+* **Self-fencing** — a leader whose renews keep failing (store down,
+  injected ``controller.lease`` fault) demotes itself once its last
+  successful renew is a full TTL old: it can no longer prove the fleet
+  hasn't elected someone else, so it must stop actuating.
+
+Standby registry: each controller claims a slot via the atomic
+``ctl/leader/nmembers`` counter and beats ``ctl/leader/member/<slot>``
+(the store has no key listing). ``standby_count`` = fresh member beats
+minus the leader — surfaced at ``/controller`` and in ``obs_tail
+--controller`` so an operator sees at a glance whether failover cover
+actually exists.
+
+In-process fencing gate: serving actuators run in the leader's own
+process (no command bus), so :func:`check_term` fences against a
+module-level high-water mark of every term this process has observed
+(:func:`note_term` — fed by lease renews/observations and by applied
+commands). Elastic supervisors fence commands against
+:func:`lease_term` (the record's CURRENT term read from the store) —
+never against the raw counter: a standby that bumps the counter but
+loses the lease-write race would otherwise falsely fence the real
+leader.
+
+Knobs: ``PADDLE_TPU_CONTROLLER_LEASE_TTL`` (seconds, default 5.0) and
+``PADDLE_TPU_CONTROLLER_STANDBYS`` (expected standby count, default 0 —
+purely informational: surfaced in status so dashboards can alert when
+actual < expected).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import warnings
+from typing import Optional
+
+from ...profiler import events as _events_mod
+from ...profiler import metrics as _metrics_mod
+from ...utils.envparse import env_float as _env_float
+from ...utils.envparse import env_int as _env_int
+
+__all__ = ["LeaderLease", "ControllerFencedError", "note_term",
+           "check_term", "lease_term", "LEASE_KEY", "TERM_KEY"]
+
+LEASE_KEY = "ctl/leader/lease"
+TERM_KEY = "ctl/leader/term"
+NMEMBERS_KEY = "ctl/leader/nmembers"
+MEMBER_KEY_FMT = "ctl/leader/member/{slot}"
+LEDGER_KEY = "ctl/ledger"
+
+_REG = _metrics_mod.default_registry()
+_M_TERM = _REG.gauge(
+    "controller_leader_term",
+    "fencing term of the lease this controller currently holds (or last "
+    "held) — fleet-monotonic; a step up means a takeover happened")
+_M_TAKEOVERS = _REG.counter(
+    "controller_takeovers_total",
+    "successful leadership acquisitions, by reason (bootstrap: no lease "
+    "existed / lease_expired: the previous holder's beat went stale)")
+_M_FENCED = _REG.counter(
+    "controller_fenced_total",
+    "actuations rejected for carrying a stale term, by policy of the "
+    "fenced command (a deposed leader tried to act after a takeover)")
+
+
+class ControllerFencedError(RuntimeError):
+    """An actuation carried a term older than one this process has
+    already observed — the issuer was deposed; the action must not run."""
+
+
+# --- in-process fencing gate -------------------------------------------
+# Serving actuators (engine.restart / set_queue_limit / hotswap.try_swap)
+# execute inside the controller process itself, so there is no command
+# bus to fence at. Instead every lease renew/observation and every
+# applied command raises this process-wide high-water mark, and the
+# actuators call check_term() before touching anything.
+_gate_lock = threading.Lock()
+_term_high_water = 0
+
+
+def note_term(term: Optional[int]):
+    """Raise the process-wide term high-water mark (monotonic)."""
+    global _term_high_water
+    if term is None:
+        return
+    with _gate_lock:
+        if int(term) > _term_high_water:
+            _term_high_water = int(term)
+
+
+def term_high_water() -> int:
+    with _gate_lock:
+        return _term_high_water
+
+
+def reset_gate():
+    """Test hook: forget every observed term (process-wide)."""
+    global _term_high_water
+    with _gate_lock:
+        _term_high_water = 0
+
+
+def check_term(term: Optional[int], policy: str = "serving"):
+    """Fence an in-process actuation. ``term=None`` (no controller /
+    operator-issued) always passes — fencing only rejects an actuation
+    that CLAIMS an epoch and claims a stale one."""
+    if term is None:
+        return
+    hw = term_high_water()
+    if int(term) < hw:
+        if _metrics_mod.enabled():
+            _M_FENCED.inc(policy=policy)
+        _events_mod.emit("controller_fenced", severity="warn",
+                         policy=policy, term=int(term), current_term=hw)
+        raise ControllerFencedError(
+            f"stale controller term {int(term)} < {hw} for {policy!r}: "
+            f"issuer was deposed; actuation rejected")
+
+
+def lease_term(store) -> Optional[int]:
+    """Term in the CURRENT lease record, or None (no lease / store
+    blip). This — not the raw ``ctl/leader/term`` counter — is what
+    command consumers fence against: a failed acquirer bumps the counter
+    without ever holding the key."""
+    try:
+        if not store.check(LEASE_KEY):
+            return None
+        rec = json.loads(store.get(LEASE_KEY).decode())
+        return int(rec["term"])
+    except Exception:
+        return None
+
+
+class LeaderLease:
+    """One controller's handle on the leadership lease. Drive it with
+    :meth:`tick` at the aggregator-poll cadence; it acquires, renews,
+    observes, and demotes as the store's lease record dictates.
+
+    The very first tick of the very first controller acquires
+    immediately (reason ``bootstrap``); after that a takeover costs one
+    full TTL of observed silence."""
+
+    def __init__(self, store, *, controller_id: Optional[str] = None,
+                 ttl: Optional[float] = None,
+                 expected_standbys: Optional[int] = None,
+                 register: bool = True):
+        from ...profiler.events import host_id
+        self.store = store
+        self.id = controller_id or f"{host_id()}:{os.getpid()}"
+        self.ttl = float(ttl) if ttl is not None else _env_float(
+            "PADDLE_TPU_CONTROLLER_LEASE_TTL", 5.0)
+        self.expected_standbys = (
+            int(expected_standbys) if expected_standbys is not None
+            else _env_int("PADDLE_TPU_CONTROLLER_STANDBYS", 0))
+        self.term = 0                 # term of the lease we hold/held
+        self.takeovers = 0
+        self._leader = False
+        self._beat_seq = 0
+        self._last_renew_ok = 0.0     # monotonic; 0 = never
+        self._renew_failures = 0
+        # standby-side freshness: (raw lease value, monotonic ts it was
+        # first seen) — staleness is silence on OUR clock, never theirs
+        self._obs: Optional[tuple] = None
+        self._ever_saw_lease = False
+        # member slot (standby registry)
+        self._slot: Optional[int] = None
+        self._member_obs: dict = {}   # slot -> (value, monotonic ts)
+        self._standbys = 0
+        if register:
+            try:
+                self._slot = self.store.add(NMEMBERS_KEY, 1) - 1
+            except Exception:
+                self._slot = None     # registry is best-effort cosmetics
+
+    # -- leadership ------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def tick(self) -> Optional[str]:
+        """One election step. Returns ``"acquired"`` on a takeover this
+        tick (the controller must reload the replicated ledger),
+        ``"demoted"`` on losing leadership, else None."""
+        self._beat_member()
+        self._count_standbys()
+        if self._leader:
+            return self._tick_leader()
+        return self._tick_standby()
+
+    def _tick_leader(self) -> Optional[str]:
+        now = time.monotonic()
+        if now - self._last_renew_ok < self.ttl / 3.0:
+            return None
+        # read-before-renew: a higher term in the record means the fleet
+        # elected someone else while we were paused — stand down without
+        # clobbering the new leader's lease
+        rec = self._read()
+        if rec is not None and int(rec.get("term", 0)) > self.term:
+            note_term(int(rec["term"]))
+            self._demote("superseded by term %d" % int(rec["term"]))
+            return "demoted"
+        try:
+            self._write_lease(renew=True)
+            self._last_renew_ok = now
+            self._renew_failures = 0
+        except Exception as e:
+            self._renew_failures += 1
+            # self-fence: past a full TTL of failed renews we can no
+            # longer prove nobody else took over — stop actuating
+            if now - self._last_renew_ok > self.ttl:
+                self._demote(f"renew failed {self._renew_failures}x "
+                             f"({type(e).__name__}: {e})")
+                return "demoted"
+        return None
+
+    def _tick_standby(self) -> Optional[str]:
+        raw = self._read_raw()
+        now = time.monotonic()
+        if raw is None:
+            # no lease at all: bootstrap (or the holder released it)
+            if self._acquire("bootstrap" if not self._ever_saw_lease
+                             else "lease_expired"):
+                return "acquired"
+            return None
+        self._ever_saw_lease = True
+        try:
+            note_term(int(json.loads(raw.decode())["term"]))
+        except Exception:
+            pass
+        if self._obs is None or self._obs[0] != raw:
+            self._obs = (raw, now)    # value changed: holder is alive
+            return None
+        if now - self._obs[1] > self.ttl:
+            if self._acquire("lease_expired"):
+                return "acquired"
+            self._obs = None          # lost the race: re-arm the timer
+        return None
+
+    def _acquire(self, reason: str) -> bool:
+        try:
+            term = int(self.store.add(TERM_KEY, 1))
+            self.term = term
+            self._write_lease(renew=False)
+            rec = self._read()        # last-writer-wins: confirm it's us
+            if rec is None or rec.get("id") != self.id or \
+                    int(rec.get("term", -1)) != term:
+                note_term(int(rec["term"]) if rec else None)
+                return False
+        except Exception as e:
+            warnings.warn(f"controller lease acquire failed: {e}")
+            return False
+        self._leader = True
+        self._last_renew_ok = time.monotonic()
+        self._renew_failures = 0
+        self.takeovers += 1
+        note_term(term)
+        if _metrics_mod.enabled():
+            _M_TERM.set(term)
+            _M_TAKEOVERS.inc(reason=reason)
+        _events_mod.emit("controller_takeover", severity="warn",
+                         leader=self.id, term=term, reason=reason)
+        return True
+
+    def _demote(self, why: str):
+        self._leader = False
+        self._obs = None
+        warnings.warn(f"controller {self.id} demoted (term {self.term}): "
+                      f"{why}")
+
+    def release(self):
+        """Voluntary hand-off (clean shutdown): drop the lease key so a
+        standby acquires on its next tick instead of waiting out a TTL."""
+        if not self._leader:
+            return
+        self._leader = False
+        try:
+            self.store.delete_key(LEASE_KEY)
+        except Exception:
+            pass                      # standbys fall back to TTL expiry
+
+    def _write_lease(self, renew: bool):
+        if renew:
+            from ...fault import site as _fault_site
+            _fault_site("controller.lease")
+        self._beat_seq += 1
+        self.store.set(LEASE_KEY, json.dumps(
+            {"id": self.id, "term": self.term, "beat": self._beat_seq}))
+
+    def _read_raw(self) -> Optional[bytes]:
+        try:
+            if not self.store.check(LEASE_KEY):
+                return None
+            return self.store.get(LEASE_KEY)
+        except Exception:
+            return None               # store blip reads as "no news"
+
+    def _read(self) -> Optional[dict]:
+        raw = self._read_raw()
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except Exception:
+            return None
+
+    # -- standby registry ------------------------------------------------
+
+    def _beat_member(self):
+        if self._slot is None:
+            return
+        try:
+            self.store.set(MEMBER_KEY_FMT.format(slot=self._slot),
+                           repr(time.time()))
+        except Exception:
+            pass
+
+    def _count_standbys(self):
+        """Fresh member beats (value-change on our clock), minus the
+        leader itself. Best-effort — a store blip keeps the last count."""
+        try:
+            n = int(self.store.add(NMEMBERS_KEY, 0))
+        except Exception:
+            return
+        now = time.monotonic()
+        alive = 0
+        for slot in range(n):
+            try:
+                key = MEMBER_KEY_FMT.format(slot=slot)
+                if not self.store.check(key):
+                    continue
+                val = self.store.get(key)
+            except Exception:
+                continue
+            prev = self._member_obs.get(slot)
+            if prev is None or prev[0] != val:
+                self._member_obs[slot] = (val, now)
+                alive += 1
+            elif now - prev[1] <= max(self.ttl, 3.0):
+                alive += 1
+        self._standbys = max(0, alive - 1)
+
+    # -- introspection ---------------------------------------------------
+
+    def leader_id(self) -> Optional[str]:
+        rec = self._read()
+        return rec.get("id") if rec else None
+
+    def lease_age_s(self) -> Optional[float]:
+        """Seconds since WE last saw the lease value change (or renewed
+        it ourselves). None until anything was observed."""
+        if self._leader:
+            return max(0.0, time.monotonic() - self._last_renew_ok)
+        if self._obs is None:
+            return None
+        return max(0.0, time.monotonic() - self._obs[1])
+
+    def standby_count(self) -> int:
+        return self._standbys
+
+    def status(self) -> dict:
+        rec = self._read()
+        return {
+            "id": self.id,
+            "is_leader": self._leader,
+            "leader": rec.get("id") if rec else None,
+            "term": int(rec["term"]) if rec else self.term,
+            "lease_ttl_s": self.ttl,
+            "lease_age_s": self.lease_age_s(),
+            "standbys": self._standbys,
+            "expected_standbys": self.expected_standbys,
+            "takeovers": self.takeovers,
+        }

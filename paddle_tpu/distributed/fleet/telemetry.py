@@ -206,6 +206,12 @@ class FleetReporter:
             # signal as health_status, so controller policies can consume
             # serving health exactly like trainer health
             "serving_slo": self._serving_slo_status(),
+            # leader of the HA control plane as THIS host sees it (null
+            # when no controller attached / store blip): display-level
+            # fleet state for /fleet + obs_tail; the aggregator's
+            # fleet_leaderless detection watches the lease key itself
+            # (value-change freshness), not this cached snapshot
+            "controller_leader": self._controller_leader(),
             "barrier_wait_s": round(_hist_sum("ckpt_barrier_wait_seconds"), 6),
             "heter": {
                 "route_s": round(_hist_sum("heter_route_seconds"), 6),
@@ -218,6 +224,16 @@ class FleetReporter:
     @staticmethod
     def _generation() -> int:
         return _envparse.env_int("PADDLE_TPU_ELASTIC_RESTART_NUM", 0)
+
+    def _controller_leader(self) -> Optional[str]:
+        try:
+            from .leader import LEASE_KEY
+            if not self.store.check(LEASE_KEY):
+                return None
+            return json.loads(
+                self.store.get(LEASE_KEY).decode()).get("id")
+        except Exception:
+            return None
 
     @staticmethod
     def _health_status():
@@ -266,6 +282,11 @@ class FleetAggregator:
         self._straggling: set = set()
         self._unhealthy: Dict[str, str] = {}  # host -> last non-ok status
         self.last: Dict[int, dict] = {}
+        #: leader-lease observation: (raw value, monotonic ts it last
+        #: CHANGED) — the leaderless check is value-change freshness on
+        #: OUR clock, the same skew-immune rule standby controllers use
+        self._lease_obs: Optional[tuple] = None
+        self._leaderless_fired = False
         self._poll_thread: Optional[threading.Thread] = None
         self._poll_stop = threading.Event()
         self._poll_hook = None
@@ -301,7 +322,42 @@ class FleetAggregator:
                                       host=host)
             self._detect_stragglers(out)
             self._detect_unhealthy(out)
+            self._detect_leaderless()
             return out
+
+    def _detect_leaderless(self):
+        """One `fleet_leaderless` event when the leader lease stops
+        being renewed for over one TTL (every standby is gone too, or
+        they would have taken over by then): the fleet's self-healing
+        plane is down and an operator must know. Re-armed when the
+        lease value moves again. A job with no controller attached (no
+        lease key at all) never alarms."""
+        try:
+            from .leader import LEASE_KEY
+            raw = (self.store.get(LEASE_KEY)
+                   if self.store.check(LEASE_KEY) else None)
+        except Exception:
+            return  # store blip: no verdict this round
+        now = time.monotonic()
+        if raw is None:
+            self._lease_obs = None
+            return  # controller-less (or cleanly released): legal
+        if self._lease_obs is None or self._lease_obs[0] != raw:
+            self._lease_obs = (raw, now)
+            self._leaderless_fired = False
+            return
+        ttl = _envparse.env_float("PADDLE_TPU_CONTROLLER_LEASE_TTL", 5.0)
+        silent = now - self._lease_obs[1]
+        if not self._leaderless_fired and silent > ttl:
+            self._leaderless_fired = True
+            try:
+                rec = json.loads(raw.decode())
+            except Exception:
+                rec = {}
+            _events_mod.emit(
+                "fleet_leaderless", severity="warn",
+                leader=rec.get("id"), term=rec.get("term"),
+                silent_s=round(silent, 3), ttl_s=ttl)
 
     def _detect_unhealthy(self, digests: Dict[int, dict]):
         """One `fleet_health` event per status TRANSITION: emitted when a

@@ -41,7 +41,11 @@ this rank's manifest. A step is *complete* when every rank's manifest of
 its world size verifies, *partial* when manifests/chunks are missing but
 the surviving chunks still cover every array (restore proceeds), *torn*
 when only ``.tmp.prep`` manifests exist (barrier abort / death between
-prepare and commit — skipped by resume, GC'd later).
+prepare and commit — skipped by resume, GC'd later). After each commit,
+rank r additionally replicates peer ``(r+1)%world``'s committed manifest
+to ``manifest-r<peer>.json.mirror`` (retried lag-1 from the next save),
+so losing one owner's manifest file degrades the step to ``partial`` —
+restorable from the mirror — instead of orphaning that rank's chunks.
 
 Fault sites: ``ckpt.chunk_write`` (per chunk file write — a writer-thread
 death mid-save aborts the barrier round promptly via
@@ -90,6 +94,18 @@ _MANIFEST_VERSION = 1
 
 def _manifest_name(rank: int) -> str:
     return f"manifest-r{int(rank)}.json"
+
+
+_MIRROR_SUFFIX = ".mirror"
+
+
+def _mirror_name(rank: int) -> str:
+    """Peer-written replica of rank `rank`'s manifest: after each commit,
+    rank r copies rank (r+1)%world's committed manifest to this name, so
+    losing (or corrupting) one owner's manifest file still leaves a
+    readable copy and the step stays `partial`-restorable instead of
+    dropping a rank's chunks on the floor."""
+    return _manifest_name(rank) + _MIRROR_SUFFIX
 
 
 def _parse_manifest_name(fn: str) -> Optional[int]:
@@ -347,6 +363,32 @@ class StepScan:
     bad_manifests: List[Tuple[str, str]] = field(default_factory=list)
     tmp_manifests: List[str] = field(default_factory=list)
     world_size: Optional[int] = None
+    #: ranks whose manifest came from a peer-written `.mirror` copy (the
+    #: owner's own manifest was missing or unreadable)
+    mirrored: List[int] = field(default_factory=list)
+
+
+def _read_manifest(path: str) -> dict:
+    """Read + validate one committed manifest (raises on anything that
+    downstream consumers — verify, coverage, load — could not trust)."""
+    with open(path, "rb") as f:
+        m = json.loads(f.read().decode())
+    if m.get("magic") != MANIFEST_MAGIC or "tree" not in m \
+            or not isinstance(m.get("chunks"), list) \
+            or not isinstance(m.get("arrays"), dict):
+        raise ValueError("not a PTSHARD01 manifest")
+    int(m["world_size"]), int(m["rank"])
+    for rec in m["chunks"]:
+        # validate here so every downstream consumer can trust the record
+        # shape — a garbled record must mean "bad manifest", never a
+        # KeyError leaking out of a resume path
+        if not isinstance(rec, dict) or \
+                not isinstance(rec["file"], str) or \
+                not isinstance(rec["path"], str):
+            raise ValueError("malformed chunk record")
+        int(rec["bytes"]), int(rec["crc32"])
+        [(int(a), int(b)) for a, b in rec["index"]]
+    return m
 
 
 def scan_step(step_dir: str) -> StepScan:
@@ -354,43 +396,48 @@ def scan_step(step_dir: str) -> StepScan:
     of DIFFERENT world sizes coexist (a step number re-used after an
     elastic resize into the same shared dir), the group written most
     recently wins — stale other-world manifests are ignored, not an
-    error."""
+    error. A rank whose own manifest is missing/corrupt falls back to the
+    peer-written ``.mirror`` copy (recorded in ``scan.mirrored``)."""
     scan = StepScan(step_dir=step_dir)
     if not os.path.isdir(step_dir):
         return scan
     groups: Dict[int, Dict[int, dict]] = {}
+    mirror_groups: Dict[int, Dict[int, dict]] = {}
     for fn in sorted(os.listdir(step_dir)):
         if fn.endswith(".tmp.prep") and _parse_manifest_name(
                 fn[:-len(".tmp.prep")]) is not None:
             scan.tmp_manifests.append(os.path.join(step_dir, fn))
             continue
-        rank = _parse_manifest_name(fn)
+        mirror = fn.endswith(_MIRROR_SUFFIX)
+        rank = _parse_manifest_name(fn[:-len(_MIRROR_SUFFIX)]) if mirror \
+            else _parse_manifest_name(fn)
         if rank is None:
             continue
         path = os.path.join(step_dir, fn)
         try:
-            with open(path, "rb") as f:
-                m = json.loads(f.read().decode())
-            if m.get("magic") != MANIFEST_MAGIC or "tree" not in m \
-                    or not isinstance(m.get("chunks"), list) \
-                    or not isinstance(m.get("arrays"), dict):
-                raise ValueError("not a PTSHARD01 manifest")
+            m = _read_manifest(path)
             world, rank_m = int(m["world_size"]), int(m["rank"])
-            for rec in m["chunks"]:
-                # validate here so every downstream consumer (verify,
-                # coverage, load) can trust the record shape — a garbled
-                # record must mean "bad manifest", never a KeyError leaking
-                # out of a resume path
-                if not isinstance(rec, dict) or \
-                        not isinstance(rec["file"], str) or \
-                        not isinstance(rec["path"], str):
-                    raise ValueError("malformed chunk record")
-                int(rec["bytes"]), int(rec["crc32"])
-                [(int(a), int(b)) for a, b in rec["index"]]
         except (OSError, ValueError, KeyError, TypeError) as e:
-            scan.bad_manifests.append((path, f"{type(e).__name__}: {e}"))
+            if not mirror:
+                # an unreadable MIRROR is not evidence of a bad step —
+                # the original may be intact; only originals land in
+                # bad_manifests (which can flip the verdict to corrupt)
+                scan.bad_manifests.append(
+                    (path, f"{type(e).__name__}: {e}"))
             continue
-        groups.setdefault(world, {})[rank_m] = m
+        if mirror:
+            mirror_groups.setdefault(world, {})[rank_m] = m
+        else:
+            groups.setdefault(world, {})[rank_m] = m
+    # fallback: a mirror fills a (world, rank) slot ONLY when the owner's
+    # own manifest is gone — an intact original always wins (the mirror
+    # may lag one save behind)
+    mirrored_by_world: Dict[int, List[int]] = {}
+    for world, ms in mirror_groups.items():
+        for rank_m, m in ms.items():
+            if rank_m not in groups.get(world, {}):
+                groups.setdefault(world, {})[rank_m] = m
+                mirrored_by_world.setdefault(world, []).append(rank_m)
     if groups:
         def freshness(item):
             _, ms = item
@@ -404,6 +451,7 @@ def scan_step(step_dir: str) -> StepScan:
         world, manifests = max(groups.items(), key=freshness)
         scan.world_size = world
         scan.manifests = manifests
+        scan.mirrored = sorted(mirrored_by_world.get(world, []))
     return scan
 
 
@@ -467,6 +515,11 @@ def _verify_step_detail(step_dir: str, deep: bool
     if missing_ranks:
         problems.append(f"missing manifest(s) for rank(s) {missing_ranks} "
                         f"of world {world}")
+    if scan.mirrored:
+        # a mirror may lag one save behind the lost original, so a step
+        # leaning on one is at best `partial` — restorable, not pristine
+        problems.append(f"rank(s) {scan.mirrored} recovered via "
+                        f"peer-mirrored manifest(s)")
     # coverage: available volume per array from intact chunks only
     # (chunks are disjoint by construction: replica-0 shards partition the
     # array and replicated arrays have exactly one fleet-level owner)
@@ -861,6 +914,12 @@ class ShardedCheckpointManager(CheckpointManager):
         (or, async, when the previous one did)."""
         self._attempt += 1
         attempt = self._attempt
+        prev = self._last_step
+        if prev is not None and prev != int(step):
+            # lag-1 backfill: the post-commit mirror attempt may race a
+            # slow peer's rename; by the NEXT save the peer's commit has
+            # long landed, so this retry closes the gap
+            self._mirror_peer_manifest(self.path_for(prev))
         snap = snapshot_tree(state)
         if self.async_save:
             if self.coordinator is not None:
@@ -925,10 +984,51 @@ class ShardedCheckpointManager(CheckpointManager):
             if _metrics_mod.enabled():
                 _ck._M_SAVES.inc()
                 _ck._M_SAVE_SECONDS.observe(write_secs)
+            self._mirror_peer_manifest(step_dir)
             return True
         finally:
             if self.coordinator is not None:
                 self._save_in_flight = False
+
+    def _mirror_peer_manifest(self, step_dir: str):
+        """Replicate peer ``(rank+1)%world``'s committed manifest to its
+        ``.mirror`` name (atomic tmp+rename, best-effort). Called after
+        each commit and again lag-1 from the next ``save()``, so losing
+        one owner's manifest file still leaves the step
+        ``partial``-restorable from the peer's copy. A single-rank
+        world has no peer — a self-mirror would only change the
+        single-host corruption semantics (a torn manifest must stay a
+        hard fallback-to-previous-step, not a silent self-heal)."""
+        if self._world <= 1:
+            return
+        peer = (self._rank + 1) % self._world
+        src = os.path.join(step_dir, _manifest_name(peer))
+        dst = os.path.join(step_dir, _mirror_name(peer))
+        tmp = dst + f".tmp.r{self._rank}"
+        try:
+            data = None
+            # the coordinated commit barrier proves the peer PREPARED,
+            # but its final rename races ours — give it a beat to land
+            # before falling back to the next save's lag-1 backfill
+            deadline = time.monotonic() + 0.5
+            while True:
+                try:
+                    with open(src, "rb") as f:
+                        data = f.read()
+                    break
+                except FileNotFoundError:
+                    if time.monotonic() >= deadline:
+                        return
+                    time.sleep(0.01)
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, dst)
+        except OSError:
+            # peer not committed yet (post-commit race), step dir GC'd,
+            # or a torn write — the next save's backfill retries
+            self._rm_quiet(tmp)
 
     def _gc_attempt(self, step_dir: str, attempt: int):
         """Drop this rank's files of one failed/aborted save attempt."""
@@ -1114,6 +1214,10 @@ class ShardedCheckpointManager(CheckpointManager):
             for fn in names:
                 path = os.path.join(step_dir, fn)
                 if fn == mine + ".tmp.prep":
+                    self._rm_quiet(path)
+                    removed += 1
+                elif fn.endswith(_MIRROR_SUFFIX + f".tmp.r{self._rank}"):
+                    # this rank's torn mirror-replication write
                     self._rm_quiet(path)
                     removed += 1
                 elif referenced is not None and fn.startswith(own) \

@@ -112,6 +112,10 @@ KNOWN_SITES = {
                      "watchdog-restart drills)",
     "serving.admit": "request admission into the serving queue "
                      "(shed and admission-failure drills)",
+    "controller.lease": "leader-lease renew write (drop renews to force "
+                        "a standby takeover / failover drill)",
+    "disagg.prefill": "prefill-worker forward pass (kill a worker "
+                      "mid-prefill; the pipeline must requeue + respawn)",
 }
 
 #: dynamic site families: call sites build the name from a prefix +

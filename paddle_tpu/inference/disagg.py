@@ -33,6 +33,19 @@ Preemption stays recompute-style end to end: the engine's
 PREFILL stage (its next admission re-prefills prompt + generated
 prefix), so pool pressure on the decode side never wedges the pipeline.
 
+Worker fault tolerance (PR 20): every worker heartbeats through the
+pipeline (``beat()`` around each prefill), and the DECODE side reaps —
+``_handoff_peek`` runs at the top of every engine step, so a worker
+whose beat went silent past ``worker_ttl_s`` (or that raised, including
+the ``disagg.prefill`` chaos site) is retired there: its in-flight
+request requeues to the surviving workers with its ORIGINAL trace id,
+the queue drains to the decode engine's own colocated prefill when no
+worker survives, and a fresh worker respawns into the slot (the PR-3
+DataLoader respawn contract: bounded respawns per slot, a loud event +
+``disagg_worker_restarts_total`` each time). Requeues are bounded per
+request (``max_attempts`` dispatches); exhaustion fails the request
+loudly through ``Request.result()`` — never a silent hang.
+
 Tokens are bit-exact vs the co-located engine: the worker runs the
 identical prefill math (same bucket, same in-graph sampling draw at the
 same step counter) and the injected pages are byte-identical to the
@@ -44,19 +57,35 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 from collections import deque
 from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..fault import site as _fault_site
 from ..framework import tape as tape_mod
 from ..framework.tensor import Tensor
+from ..profiler import events as _events
 from ..profiler import metrics as _metrics
 from .sampling import SamplingParams, sample_logits
 from .serving import (ServingEngine, Request, _M_HANDOFF_DEPTH, _M_QUEUE,
                       _M_STAGE_OCC, _M_TTFT)
 
 __all__ = ["KVHandoff", "PrefillWorker", "DisaggPipeline"]
+
+_REG = _metrics.default_registry()
+_M_W_RESTARTS = _REG.counter(
+    "disagg_worker_restarts_total",
+    "prefill workers respawned into their slot after an error or a "
+    "missed-heartbeat death (bounded per slot; past the cap the slot "
+    "is disabled and its load reroutes)")
+_M_REQUEUE = _REG.counter(
+    "disagg_requeue_total",
+    "requests rerouted after losing their prefill worker, by reason "
+    "(worker_error: the prefill raised / worker_dead: the worker's "
+    "heartbeat went silent past the TTL / colocated: no surviving "
+    "worker — the decode engine prefills it itself)")
 
 
 def _pow2_pad(n: int) -> int:
@@ -108,6 +137,16 @@ class PrefillWorker:
         self.device = device
         self.wid = int(wid)
         self.busy = False
+        #: liveness plane (all guarded by the PIPELINE's lock): `alive`
+        #: drops when the worker errors or its heartbeat goes silent;
+        #: `retired` marks the object replaced in its slot — a wedged
+        #: prefill that eventually returns must DISCARD its result (the
+        #: request was already requeued by the reaper); `current` is the
+        #: in-flight request the reaper steals on death
+        self.alive = True
+        self.retired = False
+        self.current: Optional[Request] = None
+        self.last_beat = time.monotonic()
         model = engine.model
         pages_per_seq = -(-engine.max_len // engine.page_size)
         # null page + exactly one sequence's worth of pages; the block
@@ -158,9 +197,22 @@ class PrefillWorker:
         self._buffers = jax.device_put(dict(eng._buffers), self.device)
         self._seen_step = step
 
+    def beat(self):
+        self.last_beat = time.monotonic()
+
     def prefill(self, req: Request) -> Optional[KVHandoff]:
         import jax.numpy as jnp
         eng = self.engine
+        self.beat()
+        # chaos: `disagg.prefill` kills this worker mid-prefill (error
+        # kinds surface as a worker death — requeue + respawn; delay
+        # kinds wedge it past the heartbeat TTL for the reaper drill)
+        _fault_site("disagg.prefill")
+        if self.retired:
+            # reaped while wedged (an injected delay past the TTL): the
+            # request was already requeued elsewhere — abort before
+            # touching it, or its tokens would be recorded twice
+            raise RuntimeError("prefill worker reaped mid-dispatch")
         self._refresh_weights()
         tokens = req.prompt + req.generated
         bucket = eng._bucket_for(len(tokens))
@@ -193,6 +245,12 @@ class PrefillWorker:
                     jnp.full((1,), len(req.generated), jnp.int32))
         finally:
             _cw.pop_entry(prev)
+        self.beat()  # liveness proven through the dispatch itself
+        if self.retired:
+            # the reaper fired while the dispatch was in flight and the
+            # request is being re-prefilled: recording this late token
+            # would corrupt the resumed sequence
+            raise RuntimeError("prefill worker reaped mid-dispatch")
         tok = int(np.asarray(nxt)[0])
         eng.tracer.prefill_done(req.rid)
         now = time.monotonic()
@@ -232,10 +290,24 @@ class DisaggPipeline:
     pipeline semantics (and the A/B bench) still hold."""
 
     def __init__(self, engine: ServingEngine, *,
-                 prefill_devices=None, num_workers: int = 1):
+                 prefill_devices=None, num_workers: int = 1,
+                 max_attempts: int = 3, worker_ttl_s: float = 10.0,
+                 max_worker_restarts: int = 3):
         import jax
 
         self.engine = engine
+        #: per-request dispatch bound: a request whose prefill keeps
+        #: losing its worker is failed LOUDLY through result() after
+        #: `max_attempts` dispatches — never parked forever
+        self.max_attempts = max(1, int(max_attempts))
+        #: heartbeat TTL: a busy worker silent this long is reaped by
+        #: the decode side (its jit is wedged or its thread died)
+        self.worker_ttl_s = float(worker_ttl_s)
+        #: respawns allowed per worker slot (the PR-3 DataLoader
+        #: respawn contract); past the cap the slot is disabled
+        self.max_worker_restarts = max(0, int(max_worker_restarts))
+        self._attempts: dict = {}   # rid -> dispatches so far
+        self._restarts: dict = {}   # wid -> respawns so far
         if prefill_devices is None:
             taken = set()
             if engine.mesh is not None:
@@ -286,8 +358,142 @@ class DisaggPipeline:
         if _metrics.enabled():
             _M_QUEUE.set(depth, model=self.engine.name)
 
+    # -- worker fault tolerance -----------------------------------------------
+    def _reap_dead_workers(self):
+        """Decode-side death detection: runs at the top of every engine
+        step (via ``_handoff_peek``). A busy worker whose heartbeat went
+        silent past ``worker_ttl_s`` is retired — its in-flight request
+        requeued, a replacement respawned into the slot — and with no
+        surviving worker the queue drains to colocated prefill."""
+        now = time.monotonic()
+        victims = []
+        with self._lock:
+            for w in self.workers:
+                if not w.alive or w.retired or not w.busy:
+                    continue
+                stall = now - w.last_beat
+                if stall <= self.worker_ttl_s:
+                    continue
+                w.alive = False
+                w.retired = True
+                req, w.current = w.current, None
+                victims.append((w, req, stall))
+        for w, req, stall in victims:
+            err = f"no heartbeat for {stall:.1f}s (ttl {self.worker_ttl_s}s)"
+            self._respawn(w, "worker_dead", err)
+            self._requeue(req, "worker_dead", err)
+        self._drain_to_colocated()
+
+    def _on_worker_error(self, w: PrefillWorker, req: Request, exc):
+        """A prefill raised (including the ``disagg.prefill`` chaos
+        site): the worker is dead — requeue its request, respawn."""
+        with self._lock:
+            if w.retired:
+                return  # the reaper got here first and took the request
+            w.alive = False
+            w.retired = True
+            w.busy = False
+            w.current = None
+        err = f"{type(exc).__name__}: {exc}"
+        self._respawn(w, "worker_error", err)
+        self._requeue(req, "worker_error", err)
+        self._drain_to_colocated()
+
+    def _respawn(self, w: PrefillWorker, cause: str, error=None):
+        """Fresh worker into the dead one's slot, same device, bounded
+        per slot. Threaded mode also spawns its loop thread."""
+        n = self._restarts.get(w.wid, 0) + 1
+        self._restarts[w.wid] = n
+        eng = self.engine
+        if n > self.max_worker_restarts:
+            warnings.warn(
+                f"disagg prefill worker {w.wid} ({eng.name!r}) died "
+                f"{n} times ({cause}); slot disabled")
+            _events.emit("disagg_worker_restart", severity="warn",
+                         model=eng.name, worker=w.wid, restarts=n,
+                         cause=cause, respawned=False, error=error)
+            return
+        try:
+            nw = PrefillWorker(eng, w.device, wid=w.wid)
+        except Exception as e:  # noqa: BLE001 — a sick device must not
+            warnings.warn(      # take the whole pipeline down with it
+                f"disagg prefill worker {w.wid} respawn failed "
+                f"({type(e).__name__}: {e}); slot disabled")
+            return
+        with self._lock:
+            for i, cur in enumerate(self.workers):
+                if cur is w:
+                    self.workers[i] = nw
+                    break
+            else:
+                return  # slot already replaced by a racing respawn
+        if _metrics.enabled():
+            _M_W_RESTARTS.inc()
+        _events.emit("disagg_worker_restart", severity="warn",
+                     model=eng.name, worker=w.wid, restarts=n,
+                     cause=cause, respawned=True, error=error)
+        if self._running and not eng._closed:
+            self._spawn_worker_thread(nw)
+
+    def _requeue(self, req: Optional[Request], reason: str, error=None):
+        """Bounded reroute of a request that lost its prefill worker —
+        trace id untouched (set once at submit). Exhaustion fails the
+        request loudly; with no surviving worker it reroutes to the
+        decode engine's own colocated prefill."""
+        if req is None:
+            return
+        eng = self.engine
+        attempts = self._attempts.get(req.rid, 0)
+        if attempts >= self.max_attempts:
+            self._attempts.pop(req.rid, None)
+            eng._complete(req, "failed", error=(
+                f"disagg prefill gave up after {attempts} attempts "
+                f"(last: {reason}" + (f": {error}" if error else "") + ")"))
+            return
+        if _metrics.enabled():
+            _M_REQUEUE.inc(reason=reason)
+        with self._lock:
+            alive = any(w.alive for w in self.workers)
+            if alive:
+                self._queue.appendleft(req)
+                depth = len(self._queue)
+        if alive:
+            if _metrics.enabled():
+                _M_QUEUE.set(depth, model=eng.name)
+            return
+        self._to_colocated(req)
+
+    def _to_colocated(self, req: Request):
+        """Last resort: hand the request to the decode engine's OWN
+        queue — it prefills it itself (stats["prefills"] counts it),
+        original trace id preserved."""
+        eng = self.engine
+        self._attempts.pop(req.rid, None)
+        with eng._lock:
+            eng._queue.append(req)
+            depth = len(eng._queue)
+        if _metrics.enabled():
+            _M_QUEUE.set(depth, model=eng.name)
+
+    def _drain_to_colocated(self):
+        """With NO surviving worker, queued requests would strand —
+        reroute every one to colocated prefill (reason="colocated")."""
+        with self._lock:
+            if any(w.alive for w in self.workers):
+                return
+            stranded = list(self._queue)
+            self._queue.clear()
+        for req in stranded:
+            if _metrics.enabled():
+                _M_REQUEUE.inc(reason="colocated")
+            self._to_colocated(req)
+
     # -- handoff-source protocol (consumed by ServingEngine.step) -------------
     def _handoff_peek(self) -> Optional[KVHandoff]:
+        # the decode thread calls this at the top of EVERY step: it is
+        # the pipeline's reaper tick — worker death is detected and
+        # repaired here even when no handoff is pending
+        self._reap_dead_workers()
         with self._lock:
             return self._handoffs[0] if self._handoffs else None
 
@@ -309,24 +515,46 @@ class DisaggPipeline:
             for w in self.workers:
                 if not self._queue:
                     break
-                if w.busy:
+                if w.busy or not w.alive:
                     continue
                 w.busy = True
-                work.append((w, self._queue.popleft()))
+                req = self._queue.popleft()
+                w.current = req
+                self._attempts[req.rid] = \
+                    self._attempts.get(req.rid, 0) + 1
+                work.append((w, req))
             if _metrics.enabled():
                 _M_QUEUE.set(len(self._queue), model=self.engine.name)
         for w, req in work:
             try:
                 h = w.prefill(req)
-            finally:
-                w.busy = False
-            if h is not None:
-                self._enqueue_handoff(h)
+            except Exception as e:  # noqa: BLE001 — a worker death is a
+                self._on_worker_error(w, req, e)  # repairable event
+                continue
+            self._finish_dispatch(w, req, h)
+        self._drain_to_colocated()
         # engine.step() drains the handoff queue first (peek/pop), then
         # admits + decodes — injection happens on THIS thread here
         produced = self.engine.step()
         self._publish_occupancy()
         return produced
+
+    def _finish_dispatch(self, w: PrefillWorker, req: Request,
+                         h: Optional[KVHandoff]) -> bool:
+        """Atomically (vs the reaper) complete one dispatch: a worker
+        retired MID-PREFILL had its request requeued already — its late
+        result must be dropped, or the request would run twice (once
+        re-prefilled, once from this stale handoff). Returns False when
+        the result was dropped."""
+        with self._lock:
+            if w.retired:
+                return False
+            w.busy = False
+            w.current = None
+        self._attempts.pop(req.rid, None)
+        if h is not None:
+            self._enqueue_handoff(h)
+        return True
 
     def _enqueue_handoff(self, h: KVHandoff):
         with self._lock:
@@ -338,7 +566,8 @@ class DisaggPipeline:
     def _publish_occupancy(self):
         if not _metrics.enabled():
             return
-        busy = sum(w.busy for w in self.workers)
+        busy = sum(w.busy for w in self.workers
+                   if w.alive and not w.retired)
         active = sum(r is not None for r in self.engine._slots)
         _M_STAGE_OCC.set(busy, model=self.engine.name, stage="prefill")
         _M_STAGE_OCC.set(active, model=self.engine.name, stage="decode")
@@ -346,8 +575,11 @@ class DisaggPipeline:
     def pending(self) -> bool:
         with self._lock:
             staged = bool(self._queue) or bool(self._handoffs)
-        return staged or any(w.busy for w in self.workers) \
-            or self.engine.pending()
+            # a dead worker stuck busy must not read as pending work —
+            # its request was (or will be, next reap) requeued
+            busy = any(w.busy for w in self.workers
+                       if w.alive and not w.retired)
+        return staged or busy or self.engine.pending()
 
     def run_until_idle(self, max_iterations: int = 100000):
         for _ in range(max_iterations):
@@ -363,23 +595,8 @@ class DisaggPipeline:
         if self._running:
             return
         self._running = True
+        self._poll_s = poll_s
         self.engine.start(poll_s)
-
-        def worker_loop(w: PrefillWorker):
-            while self._running and not self.engine._closed:
-                with self._lock:
-                    req = self._queue.popleft() if self._queue else None
-                    if req is not None:
-                        w.busy = True
-                if req is None:
-                    time.sleep(poll_s)
-                    continue
-                try:
-                    h = w.prefill(req)
-                finally:
-                    w.busy = False
-                if h is not None:
-                    self._enqueue_handoff(h)
 
         def occupancy_loop():
             # the engine's own decode loop drains the handoff queue;
@@ -389,12 +606,41 @@ class DisaggPipeline:
                 time.sleep(max(poll_s, 0.01))
 
         for w in self.workers:
-            t = threading.Thread(target=worker_loop, args=(w,), daemon=True,
-                                 name=f"disagg-prefill-{w.wid}")
-            t.start()
-            self._threads.append(t)
+            self._spawn_worker_thread(w)
         t = threading.Thread(target=occupancy_loop, daemon=True,
                              name="disagg-occupancy")
+        t.start()
+        self._threads.append(t)
+
+    def _spawn_worker_thread(self, w: PrefillWorker):
+        """One loop per worker OBJECT: a respawned slot gets a fresh
+        thread; the retired object's loop exits on its own."""
+        poll_s = getattr(self, "_poll_s", 0.005)
+
+        def worker_loop():
+            while self._running and not self.engine._closed \
+                    and not w.retired:
+                w.beat()  # idle liveness: an empty queue is not a wedge
+                with self._lock:
+                    req = self._queue.popleft() if self._queue else None
+                    if req is not None:
+                        w.busy = True
+                        w.current = req
+                        self._attempts[req.rid] = \
+                            self._attempts.get(req.rid, 0) + 1
+                if req is None:
+                    time.sleep(poll_s)
+                    continue
+                try:
+                    h = w.prefill(req)
+                except Exception as e:  # noqa: BLE001 — a worker death
+                    self._on_worker_error(w, req, e)  # is repairable
+                    return  # this worker object is retired; loop ends
+                if not self._finish_dispatch(w, req, h):
+                    return  # reaped mid-prefill: result dropped
+
+        t = threading.Thread(target=worker_loop, daemon=True,
+                             name=f"disagg-prefill-{w.wid}")
         t.start()
         self._threads.append(t)
 
@@ -419,7 +665,10 @@ class DisaggPipeline:
             return {
                 "stages": {
                     "prefill": {"workers": len(self.workers),
-                                "busy": sum(w.busy for w in self.workers),
+                                "alive": sum(w.alive for w in self.workers),
+                                "busy": sum(w.busy for w in self.workers
+                                            if w.alive and not w.retired),
+                                "restarts": dict(self._restarts),
                                 "devices": [str(w.device)
                                             for w in self.workers]},
                     "decode": {"occupancy": sum(

@@ -163,12 +163,17 @@ class HotSwapManager:
 
     # -- the swap attempt -----------------------------------------------------
     def try_swap(self, step: Optional[int] = None,
-                 path: Optional[str] = None, force: bool = False) -> dict:
+                 path: Optional[str] = None, force: bool = False,
+                 term: Optional[int] = None) -> dict:
         """Load → canary-gate → stage one candidate step (the newest
         committed one when `step` is None). `force=True` skips the gate
         (operator override / rollback-drill path) but still records the
         pre-swap baseline so the post-swap watch can catch the
-        regression. Returns {"outcome": staged|rejected|failed, ...}."""
+        regression. Returns {"outcome": staged|rejected|failed, ...}.
+        `term` fences a swap ordered by a deposed controller leader
+        (raises ControllerFencedError; `term=None` always passes)."""
+        from ..distributed.fleet.leader import check_term
+        check_term(term, policy="serving_swap")
         from ..distributed import sharded_checkpoint as _ckpt
         with self._lock:
             if path is None and step is not None:

@@ -1114,14 +1114,22 @@ class ServingEngine:
         return time.monotonic() - self._last_progress
 
     def restart(self, reason: str = "wedged",
-                join_timeout: float = 15.0) -> dict:
+                join_timeout: float = 15.0,
+                term: Optional[int] = None) -> dict:
         """Watchdog restart: stop the decode loop, requeue every
         in-flight request through the PREEMPTION path (trace ids and
         generated prefixes preserved — recompute-style resume), rebuild
         the KV plane (cache, allocator, prefix registry), and relaunch
         the loop if one was running. Queued requests are untouched.
         Raises if the loop won't stop inside `join_timeout` (the caller
-        records a failed decision rather than corrupting live state)."""
+        records a failed decision rather than corrupting live state).
+
+        `term` is the issuing controller's fencing token: a restart
+        ordered by a DEPOSED leader (term below the process high-water
+        mark) raises ControllerFencedError before touching any state —
+        `term=None` (operator / pre-HA caller) always passes."""
+        from ..distributed.fleet.leader import check_term
+        check_term(term, policy="serving_restart")
         if self._closed:
             raise RuntimeError("engine is closed")
         was_running = self._thread is not None
@@ -1163,8 +1171,13 @@ class ServingEngine:
         return {"requeued": requeued, "leaked_pages": len(leaked),
                 "restarted_thread": was_running}
 
-    def set_queue_limit(self, limit: Optional[int]):
-        """Controller shed actuation: cap (or uncap) queue admission."""
+    def set_queue_limit(self, limit: Optional[int],
+                        term: Optional[int] = None):
+        """Controller shed actuation: cap (or uncap) queue admission.
+        `term` fences a deposed leader's stale shed/unshed (see
+        :meth:`restart`)."""
+        from ..distributed.fleet.leader import check_term
+        check_term(term, policy="serving_shed")
         self.queue_limit = None if limit is None else max(1, int(limit))
 
     def suspend(self, reason: str = "memory_pressure",

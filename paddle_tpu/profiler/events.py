@@ -90,6 +90,14 @@ KIND_SEVERITY = {
                                   # swap/reject/rollback/fail/halt)
     "serving_restart": "warn",    # wedged engine restarted; in-flight
                                   # requests requeued, pages rebuilt
+    "controller_takeover": "warn",  # a controller acquired the leader
+                                    # lease (bootstrap / lease_expired)
+    "controller_fenced": "warn",  # stale-term actuation rejected (a
+                                  # deposed leader tried to act)
+    "fleet_leaderless": "warn",   # no controller renewed the lease for
+                                  # over one TTL — failover cover gone
+    "disagg_worker_restart": "warn",  # dead/wedged prefill worker
+                                      # respawned; its work requeued
 }
 
 #: back-compat view: the registered kind names

@@ -22,7 +22,10 @@ surfaces over plain HTTP (http.server, zero deps):
                 hard wall-clock cap PADDLE_TPU_PROFILE_TIMEOUT
     /controller the fleet controller's live decision state (policies,
                 streaks, evicted host, recent controller_decision
-                records); 404 when no controller runs in this process
+                records; with HA election, the `leader` block carries
+                leader id / term / lease age / standby count and
+                `is_leader` says whether THIS process decides); 404
+                when no controller runs in this process
     /requests   serving introspection: live + recently-completed request
                 traces (per-request phase breakdown from
                 profiler/reqtrace.py) and the engine's per-iteration

@@ -1,0 +1,231 @@
+"""Chaos drill A (slow tier): HA control-plane failover mid-incident.
+
+Two leader-elected FleetControllers share ONE real TCPStore. The drill
+kills the leader at the worst possible moments of a straggler incident
+and proves the control plane stays single-writer:
+
+* leader killed mid-debounce -> the standby takes over within one lease
+  TTL and finishes the incident with exactly ONE eviction total;
+* leader killed right AFTER evicting -> the successor inherits the
+  replicated ledger and honors probation (no double-eviction while the
+  held host's stale digest still reads slow);
+* the deposed leader revives with a queued command at its old term ->
+  the supervisor consumes it fenced (controller_fenced event, cursor
+  advanced, no actuation) and the zombie demotes on its next tick.
+
+fast-sibling: tests/test_leader.py
+fast-sibling: tests/test_fleet_controller.py
+"""
+import time
+
+import pytest
+
+from paddle_tpu import fault
+from paddle_tpu.distributed.fleet import leader as leader_mod
+from paddle_tpu.distributed.fleet.controller import (ControllerCommandBus,
+                                                     FleetController)
+from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+from paddle_tpu.distributed.fleet.leader import LeaderLease
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.profiler import events
+
+pytestmark = pytest.mark.slow
+
+TTL = 0.3
+WORLD = 3
+HOSTS = ("trainer-0", "trainer-1", "trainer-2")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.reset()
+    leader_mod.reset_gate()
+    events.default_event_log().clear()
+    yield
+    fault.reset()
+    leader_mod.reset_gate()
+    events.default_event_log().clear()
+
+
+@pytest.fixture()
+def store():
+    s = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        yield s
+    finally:
+        s.stop()
+
+
+class _Agg:
+    """Scripted aggregator: the controller only reads straggling(),
+    straggler_factor and .last."""
+
+    def __init__(self):
+        self._straggling = []
+        self.straggler_factor = 2.0
+        self.last = {}
+
+    def straggling(self):
+        return list(self._straggling)
+
+
+class _Fleet:
+    """Drives one or both controllers through collect windows with FRESH
+    digest evidence each window (the debounce only advances on a new
+    (ts, step) observation)."""
+
+    def __init__(self, store):
+        self.step = 10
+        self.agg = {}
+        self.ctl = {}
+        for cid in ("c1", "c2"):
+            agg = _Agg()
+            lease = LeaderLease(store, controller_id=cid, ttl=TTL)
+            self.agg[cid] = agg
+            self.ctl[cid] = FleetController(
+                agg, ControllerCommandBus(store), WORLD,
+                confirm_windows=3, readmit_after_s=9999.0, min_world=1,
+                lease=lease)
+
+    def digests(self, straggler=None):
+        self.step += 1
+        out = {}
+        for r, host in enumerate(HOSTS):
+            p50 = 0.5 if host == straggler else 0.01
+            out[r] = {"host": host, "rank": r, "step": self.step,
+                      "ts": time.time(), "health_status": "ok",
+                      "wall_p50_s": p50, "window": 8}
+        return out
+
+    def tick(self, cids, straggler=None):
+        d = self.digests(straggler)
+        for cid in cids:
+            agg = self.agg[cid]
+            agg._straggling = [straggler] if straggler else []
+            agg.last = d
+            self.ctl[cid].on_collect(d)
+
+    def evictions(self):
+        bus = self.ctl["c1"].bus
+        return [c for c in bus.poll(0) if c.get("action") == "evict"]
+
+
+def _spin_leader(fleet, cid, straggler=None, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        fleet.tick([cid], straggler=straggler)
+        if fleet.ctl[cid].is_leader():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"{cid} never took leadership")
+
+
+class TestFailoverChaos:
+    def test_leader_killed_mid_debounce_single_eviction(self, store):
+        """c1 dies two windows into a three-window eviction debounce;
+        c2 takes over within one TTL and the fleet still sees exactly
+        one eviction for the whole incident."""
+        fleet = _Fleet(store)
+        fleet.tick(["c1", "c2"])              # c1 bootstraps, c2 standby
+        assert fleet.ctl["c1"].is_leader()
+        assert not fleet.ctl["c2"].is_leader()
+
+        # incident: trainer-1 goes slow; two of three confirm windows
+        for _ in range(2):
+            fleet.tick(["c1", "c2"], straggler="trainer-1")
+            time.sleep(0.02)
+        assert fleet.evictions() == []        # debounce still holding
+
+        t0 = time.monotonic()                 # c1 dies: stops ticking
+        _spin_leader(fleet, "c2", straggler="trainer-1")
+        took = time.monotonic() - t0
+        assert took < 2 * TTL + 0.5           # one TTL + poll slack
+
+        # the successor finishes the incident on its OWN streak
+        deadline = time.monotonic() + 5.0
+        while not fleet.evictions() and time.monotonic() < deadline:
+            fleet.tick(["c2"], straggler="trainer-1")
+            time.sleep(0.02)
+        evs = fleet.evictions()
+        assert len(evs) == 1
+        assert evs[0]["host"] == "trainer-1"
+        assert evs[0]["term"] == fleet.ctl["c2"].lease.term
+
+        # more straggling windows (stale digest reads slow while held):
+        # hysteresis + probation keep it at one eviction
+        for _ in range(4):
+            fleet.tick(["c2"], straggler="trainer-1")
+            time.sleep(0.02)
+        assert len(fleet.evictions()) == 1
+
+    def test_takeover_inherits_probation_no_double_evict(self, store):
+        """c1 evicts trainer-1 (ledger replicated in the same tick) and
+        dies; c2 takes over while the host still reads slow and must NOT
+        evict it again — the inherited ledger holds the probation."""
+        fleet = _Fleet(store)
+        fleet.tick(["c1", "c2"])
+        deadline = time.monotonic() + 5.0
+        while not fleet.evictions() and time.monotonic() < deadline:
+            fleet.tick(["c1", "c2"], straggler="trainer-1")
+            time.sleep(0.02)
+        assert len(fleet.evictions()) == 1    # c1 completed the eviction
+
+        # c1 dies; c2 takes over and keeps seeing the stale-slow digest
+        _spin_leader(fleet, "c2", straggler="trainer-1")
+        for _ in range(6):                    # >> confirm_windows
+            fleet.tick(["c2"], straggler="trainer-1")
+            time.sleep(0.02)
+        assert len(fleet.evictions()) == 1    # probation honored
+        with fleet.ctl["c2"]._lock:
+            assert "trainer-1" in fleet.ctl["c2"]._evicted
+
+        # exactly one takeover event, attributed to c2
+        tk = events.recent(kind="controller_takeover")
+        assert tk[-1]["leader"] == "c2"
+        assert tk[-1]["reason"] == "lease_expired"
+
+    def test_revived_leader_queued_command_is_fenced(self, store):
+        """The deposed leader wakes up and flushes a queued actuation at
+        its old term: the supervisor must consume it WITHOUT acting, and
+        the zombie must demote itself on its next election tick."""
+        fleet = _Fleet(store)
+        fleet.tick(["c1", "c2"])
+        assert fleet.ctl["c1"].is_leader()
+        old_term = fleet.ctl["c1"].lease.term
+
+        _spin_leader(fleet, "c2")             # c1 pauses; c2 takes over
+        assert fleet.ctl["c2"].lease.term > old_term
+
+        leader_mod.reset_gate()               # supervisor = own process
+        bus = ControllerCommandBus(store)
+        sup = ElasticSupervisor(max_restarts=0, commands=bus,
+                                self_member="trainer-sup")
+        assert sup._next_command() is None    # anchors the ledger cursor
+
+        # the zombie's queued eviction finally reaches the bus
+        bus.publish({"action": "evict", "host": "trainer-2",
+                     "policy": "straggler", "np": 2, "term": old_term})
+        assert sup._next_command() is None    # fenced: never surfaced
+        ev = events.recent(kind="controller_fenced")
+        assert ev and ev[-1]["term"] == old_term
+        assert ev[-1]["action"] == "evict"
+        assert sup._next_command() is None    # consumed, not re-delivered
+
+        # a current-term command still actuates (the fence is per-term,
+        # not a lockout)
+        bus.publish({"action": "evict", "host": "trainer-2",
+                     "policy": "straggler", "np": 2,
+                     "term": fleet.ctl["c2"].lease.term})
+        cmd = sup._next_command()
+        assert cmd is not None and cmd["host"] == "trainer-2"
+
+        # the revived c1 demotes on its next tick (read-before-renew)
+        deadline = time.monotonic() + 5.0
+        res = None
+        while time.monotonic() < deadline:
+            res = fleet.ctl["c1"].lease.tick()
+            if res == "demoted":
+                break
+            time.sleep(0.02)
+        assert res == "demoted"
+        assert not fleet.ctl["c1"].is_leader()

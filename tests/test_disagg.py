@@ -259,3 +259,132 @@ class TestDisaggObservability:
         assert ttft and ttft[0]["count"] == 2
         assert ttft[0]["labels"]["path"] == eng.decode_mode
         pipe.close()
+
+
+class TestDisaggWorkerFaults:
+    """Prefill-worker fault tolerance (PR 20): a dead/wedged worker is
+    retired, its request rerouted with the ORIGINAL trace id under a
+    bounded attempt count, a replacement respawned into the slot (the
+    PR-3 DataLoader respawn contract), and with no survivor the decode
+    engine's colocated prefill is the last resort.
+
+    fast-sibling of tests/test_disagg_chaos_e2e.py (live-traffic drill)."""
+
+    def test_worker_error_requeues_respawns_and_tokens_survive(self):
+        from paddle_tpu import fault
+        fault.reset()
+        m, cfg = _model()
+        reg = metrics_mod.default_registry()
+        requeued0 = reg.get("disagg_requeue_total").value(
+            reason="worker_error")
+        eng = ServingEngine(m, max_batch=4, max_len=64, page_size=8,
+                            name="disflt")
+        pipe = DisaggPipeline(eng, num_workers=2)
+        fault.configure("disagg.prefill", times=1)  # first dispatch dies
+        reqs = [pipe.submit(p, max_new_tokens=8) for p in _PROMPTS]
+        tids = [r.trace_id for r in reqs]
+        pipe.run_until_idle()
+        for p, r, tid in zip(_PROMPTS, reqs, tids):
+            assert r.result(timeout=5) == _ref(m, p, 8)
+            assert r.trace_id == tid, "reroute must keep the trace id"
+        assert reg.get("disagg_requeue_total").value(
+            reason="worker_error") == requeued0 + 1
+        st = pipe.status()["stages"]["prefill"]
+        assert st["restarts"] and sum(st["restarts"].values()) == 1
+        assert st["alive"] == 2  # the slot came back
+        ev = events.recent(kind="disagg_worker_restart")
+        assert ev and ev[-1]["cause"] == "worker_error"
+        assert ev[-1]["respawned"] is True
+        assert eng.status()["free_pages"] == eng.cache.num_pages - 1
+        fault.reset()
+        pipe.close()
+
+    def test_attempt_exhaustion_fails_the_request_loudly(self):
+        from paddle_tpu import fault
+        fault.reset()
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=64, page_size=8,
+                            name="disexh")
+        pipe = DisaggPipeline(eng, num_workers=1, max_attempts=1,
+                              max_worker_restarts=0)
+        fault.configure("disagg.prefill", times=100)
+        req = pipe.submit([7, 8, 9], max_new_tokens=4)
+        pipe.run_until_idle()
+        with pytest.raises(RuntimeError, match="gave up after 1 attempt"):
+            req.result(timeout=5)
+        fault.reset()
+        pipe.close()
+
+    def test_colocated_fallback_when_no_worker_survives(self):
+        from paddle_tpu import fault
+        fault.reset()
+        m, cfg = _model()
+        reg = metrics_mod.default_registry()
+        colo0 = reg.get("disagg_requeue_total").value(reason="colocated")
+        eng = ServingEngine(m, max_batch=4, max_len=64, page_size=8,
+                            name="discolo")
+        pipe = DisaggPipeline(eng, num_workers=1, max_worker_restarts=0)
+        fault.configure("disagg.prefill", times=1)
+        reqs = [pipe.submit(p, max_new_tokens=6) for p in _PROMPTS[:2]]
+        tids = [r.trace_id for r in reqs]
+        pipe.run_until_idle()
+        for p, r, tid in zip(_PROMPTS[:2], reqs, tids):
+            assert r.result(timeout=5) == _ref(m, p, 6)
+            assert r.trace_id == tid
+        # the only worker died and its slot is disabled: every prefill
+        # ran colocated on the decode engine
+        assert eng.stats["prefills"] == 2
+        assert pipe.status()["stages"]["prefill"]["alive"] == 0
+        assert reg.get("disagg_requeue_total").value(
+            reason="colocated") > colo0
+        ev = events.recent(kind="disagg_worker_restart")
+        assert ev and ev[-1]["respawned"] is False  # cap 0: disabled
+        fault.reset()
+        pipe.close()
+
+    def test_silent_worker_reaped_by_heartbeat_ttl(self):
+        import time as _time
+        m, cfg = _model()
+        reg = metrics_mod.default_registry()
+        dead0 = reg.get("disagg_requeue_total").value(reason="worker_dead")
+        eng = ServingEngine(m, max_batch=2, max_len=64, page_size=8,
+                            name="disttl")
+        pipe = DisaggPipeline(eng, num_workers=2, worker_ttl_s=0.05)
+        req = pipe.submit(_PROMPTS[0], max_new_tokens=6)
+        tid = req.trace_id
+        w = pipe.workers[0]
+        with pipe._lock:  # simulate a wedged dispatch: busy, never beats
+            pipe._queue.clear()
+            w.busy = True
+            w.current = req
+            pipe._attempts[req.rid] = 1
+        w.last_beat = _time.monotonic() - 1.0
+        pipe._reap_dead_workers()  # the decode side's _handoff_peek tick
+        assert w.retired and not w.alive
+        assert pipe.workers[0] is not w  # replacement in the slot
+        assert reg.get("disagg_requeue_total").value(
+            reason="worker_dead") == dead0 + 1
+        ev = events.recent(kind="disagg_worker_restart")
+        assert ev and ev[-1]["cause"] == "worker_dead"
+        pipe.run_until_idle()
+        assert req.result(timeout=5) == _ref(m, _PROMPTS[0], 6)
+        assert req.trace_id == tid
+        pipe.close()
+
+    def test_late_result_from_reaped_worker_is_dropped(self):
+        """A worker retired mid-prefill must not land its stale handoff:
+        the request was already requeued — running it twice would decode
+        a duplicate (the double-run race)."""
+        m, cfg = _model()
+        eng = ServingEngine(m, max_batch=2, max_len=64, page_size=8,
+                            name="dislate")
+        pipe = DisaggPipeline(eng, num_workers=1)
+        req = pipe.submit([1, 2, 3], max_new_tokens=2)
+        w = pipe.workers[0]
+        with pipe._lock:
+            pipe._queue.clear()
+            w.retired = True
+        assert pipe._finish_dispatch(w, req, None) is False
+        with pipe._lock:
+            assert not pipe._handoffs
+        pipe.close()

@@ -1,0 +1,156 @@
+"""Chaos drill B (slow tier): prefill worker killed mid-prefill under
+LIVE threaded traffic.
+
+The ``disagg.prefill`` fault site kills a PrefillWorker while the
+pipeline's worker threads and the decode engine loop are all running.
+Every in-flight request must complete with its ORIGINAL trace id and
+greedy tokens bit-exact vs the colocated single-engine reference; the
+``disagg_requeue_total`` / ``serving_stage_occupancy`` families must
+reflect the reroute; the decode pools must recycle every page (zero
+leaks). A second drill wipes out EVERY worker (respawn cap 0) and the
+decode engine's own colocated prefill absorbs the full stream.
+
+fast-sibling: tests/test_disagg.py
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fault
+from paddle_tpu.inference.disagg import DisaggPipeline
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.profiler import events
+from paddle_tpu.profiler import metrics as metrics_mod
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.reset()
+    events.default_event_log().clear()
+    yield
+    fault.reset()
+    events.default_event_log().clear()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _shared_compile_cache():
+    """Shares test_serving.py's persistent-compile-cache dir — this
+    drill compiles the same tiny-model executables."""
+    import os
+    import tempfile
+    from paddle_tpu.framework import flags as flags_mod
+    cache = os.path.join(tempfile.gettempdir(), "pt_serving_ccache")
+    os.makedirs(cache, exist_ok=True)
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": cache})
+    yield
+    flags_mod.set_flags({"FLAGS_compile_cache_dir": ""})
+
+
+def _model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, max_position_embeddings=128,
+                    hidden_size=32, num_layers=2, num_heads=2,
+                    dropout=0.0, attn_dropout=0.0)
+    m = GPT(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _ref(m, prompt, n, page_size=8):
+    ids = paddle.to_tensor(np.asarray([prompt], np.int32))
+    out = np.asarray(m.generate_paged(ids, n, page_size=page_size).data)
+    return out[0, len(prompt):].tolist()
+
+
+def _traffic(cfg, n=8, seed=23):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size,
+                         (int(rng.integers(2, 20)),)).tolist()
+            for _ in range(n)]
+
+
+class TestDisaggChaos:
+    def test_worker_killed_mid_prefill_under_live_traffic(self):
+        """One worker dies mid-prefill with threads live: the reroute is
+        invisible to clients — same trace ids, bit-exact tokens — and
+        visible to operators — requeue counter, restart event, both
+        stage-occupancy series."""
+        m, cfg = _model()
+        prompts = _traffic(cfg)
+        reg = metrics_mod.default_registry()
+        requeued0 = reg.get("disagg_requeue_total").value(
+            reason="worker_error")
+        restarts0 = reg.get("disagg_worker_restarts_total").value()
+        eng = ServingEngine(m, max_batch=4, max_len=64, page_size=8,
+                            name="chaosb")
+        pipe = DisaggPipeline(eng, num_workers=2)
+        pipe.start(poll_s=0.002)
+        fault.configure("disagg.prefill", times=1)  # next dispatch dies
+        reqs = [pipe.submit(p, max_new_tokens=8) for p in prompts]
+        tids = [r.trace_id for r in reqs]
+        outs = [r.result(timeout=60) for r in reqs]
+
+        # client-visible contract: original trace ids, exact tokens
+        for p, r, tid, out in zip(prompts, reqs, tids, outs):
+            assert r.trace_id == tid, "reroute must keep the trace id"
+            assert out == _ref(m, p, 8), \
+                "worker death changed the greedy tokens"
+
+        # operator-visible contract: the reroute is metered
+        assert reg.get("disagg_requeue_total").value(
+            reason="worker_error") == requeued0 + 1
+        st = pipe.status()["stages"]["prefill"]
+        assert sum(st["restarts"].values()) == 1
+        assert st["alive"] == 2               # the slot respawned
+        # (the disagg_worker_restart EVENT is asserted in the fast
+        # sibling — under live traffic the lifecycle-trace flood can
+        # rotate it out of the bounded ring; the counter is durable)
+        assert reg.get("disagg_worker_restarts_total").value() == \
+            restarts0 + 1
+        # survivors absorbed the stream: no colocated fallback needed
+        assert eng.stats["prefills"] == 0
+        assert eng.stats["handoffs"] == len(prompts)
+        stages = {v["labels"].get("stage")
+                  for v in reg.get("serving_stage_occupancy")
+                  .snapshot()["values"]
+                  if v["labels"].get("model") == "chaosb"}
+        assert stages == {"prefill", "decode"}
+
+        # zero page leaks on the decode pools
+        assert eng.status()["free_pages"] == eng.cache.num_pages - 1
+        pipe.close()
+        assert eng._closed
+
+    def test_total_worker_loss_colocated_absorbs_live_stream(self):
+        """Both workers die (respawn cap 0) with traffic in flight: the
+        decode engine's own prefill is the last resort — everything
+        still completes exactly, nothing strands in the queue."""
+        m, cfg = _model()
+        prompts = _traffic(cfg, n=6, seed=31)
+        reg = metrics_mod.default_registry()
+        colo0 = reg.get("disagg_requeue_total").value(reason="colocated")
+        eng = ServingEngine(m, max_batch=4, max_len=64, page_size=8,
+                            name="chaosb2")
+        pipe = DisaggPipeline(eng, num_workers=2, max_worker_restarts=0)
+        pipe.start(poll_s=0.002)
+        fault.configure("disagg.prefill", times=2)  # both workers die
+        reqs = [pipe.submit(p, max_new_tokens=6) for p in prompts]
+        tids = [r.trace_id for r in reqs]
+        outs = [r.result(timeout=60) for r in reqs]
+
+        for p, r, tid, out in zip(prompts, reqs, tids, outs):
+            assert r.trace_id == tid
+            assert out == _ref(m, p, 6)
+
+        st = pipe.status()["stages"]["prefill"]
+        assert st["alive"] == 0               # cap 0: slots disabled
+        assert reg.get("disagg_requeue_total").value(
+            reason="colocated") > colo0
+        # the decode engine prefilled whatever the dead workers dropped
+        assert eng.stats["prefills"] >= len(prompts) - 2
+        assert pipe.status()["queue_depth"] == 0
+        assert eng.status()["free_pages"] == eng.cache.num_pages - 1
+        pipe.close()

@@ -756,3 +756,58 @@ class TestGuardWorkerReuse:
         dist.all_reduce(x, group=self.group)
         assert coll._guard_worker_spawns == spawns0
         assert coll._guard_worker is None
+
+
+# ---------------------------------------------------------------------------
+# HA control-plane fault sites (PR 20)
+# ---------------------------------------------------------------------------
+class TestHAFaultSites:
+    """`controller.lease` (drop lease renews to force a standby takeover)
+    and `disagg.prefill` (kill a prefill worker mid-dispatch) must be
+    registered — the AST convention lint holds call sites against the
+    registry — and armable through the PADDLE_TPU_FAULT_SPEC grammar."""
+
+    def test_registered_in_known_sites(self):
+        from paddle_tpu.fault.inject import KNOWN_SITES
+        assert "controller.lease" in KNOWN_SITES
+        assert "disagg.prefill" in KNOWN_SITES
+        # descriptions feed the README fault-sites table; empty ones
+        # would document nothing
+        assert KNOWN_SITES["controller.lease"]
+        assert KNOWN_SITES["disagg.prefill"]
+
+    def test_spec_grammar_arms_lease_site(self, monkeypatch):
+        monkeypatch.setenv(fault.SPEC_ENV, "controller.lease=2:oserror")
+        fault.reload_spec()
+        for _ in range(2):
+            with pytest.raises(fault.InjectedIOError):
+                fault.site("controller.lease")
+        fault.site("controller.lease")  # exhausted -> clean
+
+    def test_spec_grammar_arms_prefill_site_with_start(self):
+        inj = fault.FaultInjector(spec="disagg.prefill=1@2")
+        inj.site("disagg.prefill")  # occurrence 1: clean
+        with pytest.raises(fault.InjectedFault):
+            inj.site("disagg.prefill")  # occurrence 2: faulted
+        inj.site("disagg.prefill")  # exhausted
+        assert inj.fired("disagg.prefill") == 1
+
+    def test_lease_renew_path_honors_armed_site(self):
+        """The injector must reach the actual renew write: a leader whose
+        `controller.lease` site is armed fails its renew (and, once past
+        the TTL, self-fences) instead of silently skipping the fault."""
+        from paddle_tpu.distributed.fleet import leader as leader_mod
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        try:
+            lease = leader_mod.LeaderLease(store, controller_id="c0",
+                                           ttl=0.3, register=False)
+            assert lease.tick() == "acquired" and lease.is_leader
+            fault.configure("controller.lease", times=100, kind="oserror")
+            time.sleep(0.35)
+            deadline = time.monotonic() + 5.0
+            while lease.is_leader and time.monotonic() < deadline:
+                lease.tick()
+                time.sleep(0.02)
+            assert not lease.is_leader  # self-fenced: renews kept failing
+        finally:
+            store.stop()

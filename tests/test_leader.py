@@ -1,0 +1,289 @@
+"""Leader election + term fencing unit tests (fast tier).
+
+Covers the HA control plane's building blocks over a real TCPStore:
+lease bootstrap/renew/takeover, acquire-race resolution, read-before-
+renew demotion, voluntary release, the in-process fencing gate
+(note_term/check_term), lease_term (record term, not the raw counter),
+the standby registry, the elastic command-bus fence, and ledger
+replication/inheritance across a controller handoff. The full two-
+controller chaos drill (leader killed mid-incident) lives in
+tests/test_controller_failover_e2e.py (slow tier).
+"""
+import json
+import time
+
+import pytest
+
+from paddle_tpu import fault
+from paddle_tpu.distributed.fleet import leader as leader_mod
+from paddle_tpu.distributed.fleet.leader import (ControllerFencedError,
+                                                 LeaderLease, LEASE_KEY,
+                                                 TERM_KEY, check_term,
+                                                 lease_term, note_term)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.profiler import events
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    fault.reset()
+    leader_mod.reset_gate()
+    events.default_event_log().clear()
+    yield
+    fault.reset()
+    leader_mod.reset_gate()
+    events.default_event_log().clear()
+
+
+@pytest.fixture()
+def store():
+    s = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        yield s
+    finally:
+        s.stop()
+
+
+def _spin(lease, until, timeout=5.0, sleep=0.01):
+    deadline = time.monotonic() + timeout
+    res = None
+    while time.monotonic() < deadline:
+        res = lease.tick()
+        if until(res):
+            return res
+        time.sleep(sleep)
+    raise AssertionError("condition not reached within timeout")
+
+
+class TestLease:
+    def test_bootstrap_acquires_on_first_tick(self, store):
+        lease = LeaderLease(store, controller_id="c0", ttl=1.0)
+        assert lease.tick() == "acquired"
+        assert lease.is_leader and lease.term >= 1
+        ev = events.recent(kind="controller_takeover")
+        assert ev and ev[-1]["reason"] == "bootstrap"
+        assert ev[-1]["leader"] == "c0"
+
+    def test_standby_observes_while_leader_renews(self, store):
+        a = LeaderLease(store, controller_id="a", ttl=0.3)
+        b = LeaderLease(store, controller_id="b", ttl=0.3)
+        assert a.tick() == "acquired"
+        for _ in range(12):           # > one TTL of live renewing
+            a.tick()
+            assert b.tick() is None   # value keeps changing: no takeover
+            time.sleep(0.05)
+        assert a.is_leader and not b.is_leader
+        assert b.leader_id() == "a"
+
+    def test_standby_takes_over_within_one_ttl_of_silence(self, store):
+        a = LeaderLease(store, controller_id="a", ttl=0.3)
+        b = LeaderLease(store, controller_id="b", ttl=0.3)
+        assert a.tick() == "acquired"
+        b.tick()                      # observe the live lease once
+        t0 = time.monotonic()         # a dies: stops ticking entirely
+        _spin(b, lambda r: r == "acquired", timeout=5.0)
+        took = time.monotonic() - t0
+        assert b.is_leader and b.term > a.term
+        # "within one lease TTL" plus one poll of slack
+        assert took < 2 * 0.3 + 0.5
+        ev = events.recent(kind="controller_takeover")
+        assert ev[-1]["reason"] == "lease_expired"
+
+    def test_release_hands_off_without_waiting_out_ttl(self, store):
+        a = LeaderLease(store, controller_id="a", ttl=30.0)
+        b = LeaderLease(store, controller_id="b", ttl=30.0)
+        assert a.tick() == "acquired"
+        b.tick()
+        a.release()
+        assert not a.is_leader
+        # no TTL wait: the missing key acquires on b's next tick
+        assert b.tick() == "acquired"
+        assert b.term > a.term
+
+    def test_deposed_leader_demotes_on_higher_term(self, store):
+        a = LeaderLease(store, controller_id="a", ttl=0.3)
+        assert a.tick() == "acquired"
+        # a pauses (GC stall / SIGSTOP); b takes over meanwhile
+        b = LeaderLease(store, controller_id="b", ttl=0.3)
+        b.tick()
+        time.sleep(0.4)
+        _spin(b, lambda r: r == "acquired", timeout=5.0)
+        # a resumes: its next renew read sees the higher term and demotes
+        time.sleep(0.15)              # past a's renew cadence (ttl/3)
+        assert _spin(a, lambda r: r == "demoted", timeout=5.0) == "demoted"
+        assert not a.is_leader and b.is_leader
+
+    def test_failed_renews_self_fence_after_one_ttl(self, store):
+        a = LeaderLease(store, controller_id="a", ttl=0.3)
+        assert a.tick() == "acquired"
+        fault.configure("controller.lease", times=1000, kind="oserror")
+        time.sleep(0.35)
+        _spin(a, lambda r: r == "demoted", timeout=5.0)
+        assert not a.is_leader
+
+    def test_acquire_race_has_one_winner(self, store):
+        """Two standbys racing an expired lease: last-writer-wins via the
+        re-read — exactly one ends up leader, the loser re-arms."""
+        a = LeaderLease(store, controller_id="a", ttl=0.2)
+        b = LeaderLease(store, controller_id="b", ttl=0.2)
+        c = LeaderLease(store, controller_id="c", ttl=0.2)
+        assert a.tick() == "acquired"
+        b.tick(), c.tick()
+        time.sleep(0.3)               # a dead: lease frozen past TTL
+        results = [b.tick(), c.tick()]
+        assert results.count("acquired") == 1
+        assert [b.is_leader, c.is_leader].count(True) == 1
+
+    def test_terms_are_monotonic_across_takeovers(self, store):
+        terms = []
+        prev_term = 0
+        for cid in ("a", "b", "c"):
+            lease = LeaderLease(store, controller_id=cid, ttl=0.2)
+            lease.term = prev_term    # fresh object, shared store state
+            _spin(lease, lambda r: r == "acquired", timeout=5.0)
+            terms.append(lease.term)
+            prev_term = lease.term
+            lease._leader = False     # "kill" it: stop renewing
+            time.sleep(0.25)
+        assert terms == sorted(terms) and len(set(terms)) == 3
+
+
+class TestFencingGate:
+    def test_none_term_always_passes(self):
+        note_term(7)
+        check_term(None, policy="serving_restart")  # operator action
+
+    def test_stale_term_raises_and_meters(self, store):
+        note_term(5)
+        with pytest.raises(ControllerFencedError):
+            check_term(4, policy="serving_shed")
+        ev = events.recent(kind="controller_fenced")
+        assert ev and ev[-1]["policy"] == "serving_shed"
+        assert ev[-1]["term"] == 4 and ev[-1]["current_term"] == 5
+
+    def test_current_and_future_terms_pass(self):
+        note_term(5)
+        check_term(5)
+        check_term(6)                 # a renewal we haven't observed yet
+
+    def test_gate_is_monotonic(self):
+        note_term(9)
+        note_term(3)                  # lower observation cannot regress it
+        assert leader_mod.term_high_water() == 9
+
+    def test_lease_term_reads_record_not_counter(self, store):
+        lease = LeaderLease(store, controller_id="x", ttl=1.0)
+        assert lease.tick() == "acquired"
+        held = lease.term
+        # a failed acquirer bumps the counter without holding the key —
+        # fencing against the counter would depose the real leader
+        store.add(TERM_KEY, 1)
+        assert lease_term(store) == held
+        assert lease_term(store) < int(store.add(TERM_KEY, 0))
+
+    def test_lease_term_none_without_lease(self, store):
+        assert lease_term(store) is None
+
+
+class TestStandbyRegistry:
+    def test_counts_exclude_leader(self, store):
+        a = LeaderLease(store, controller_id="a", ttl=0.5)
+        b = LeaderLease(store, controller_id="b", ttl=0.5)
+        c = LeaderLease(store, controller_id="c", ttl=0.5)
+        assert a.tick() == "acquired"
+        for _ in range(3):            # let everyone beat + observe
+            b.tick(), c.tick(), a.tick()
+            time.sleep(0.02)
+        assert a.standby_count() == 2
+        st = a.status()
+        assert st["is_leader"] and st["leader"] == "a"
+        assert st["standbys"] == 2 and st["term"] == a.term
+
+    def test_status_shape_for_observability(self, store):
+        lease = LeaderLease(store, controller_id="s", ttl=1.0,
+                            expected_standbys=2)
+        lease.tick()
+        st = lease.status()
+        for key in ("id", "is_leader", "leader", "term", "lease_ttl_s",
+                    "lease_age_s", "standbys", "expected_standbys",
+                    "takeovers"):
+            assert key in st
+        assert st["expected_standbys"] == 2
+        assert st["lease_age_s"] is not None
+
+
+class TestElasticCommandFence:
+    def _supervisor(self, store):
+        from paddle_tpu.distributed.fleet.controller import (
+            ControllerCommandBus)
+        from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+        bus = ControllerCommandBus(store)
+        sup = ElasticSupervisor(max_restarts=0, commands=bus,
+                                self_member="trainer-sup")
+        assert sup._next_command() is None  # anchors the ledger cursor
+        return bus, sup
+
+    def test_stale_term_command_is_consumed_not_actuated(self, store):
+        lease = LeaderLease(store, controller_id="ctl", ttl=1.0)
+        assert lease.tick() == "acquired"
+        bus, sup = self._supervisor(store)
+        bus.publish({"action": "evict", "host": "h1", "policy": "straggler",
+                     "term": lease.term - 1})
+        cmd = sup._next_command()
+        assert cmd is None            # fenced: dropped, never surfaced
+        ev = events.recent(kind="controller_fenced")
+        assert ev and ev[-1]["action"] == "evict"
+        assert ev[-1]["term"] == lease.term - 1
+        # the cursor advanced: the fenced command is not re-delivered
+        assert sup._next_command() is None
+
+    def test_current_term_command_passes_and_raises_gate(self, store):
+        lease = LeaderLease(store, controller_id="ctl", ttl=1.0)
+        assert lease.tick() == "acquired"
+        leader_mod.reset_gate()       # simulate a separate process
+        bus, sup = self._supervisor(store)
+        bus.publish({"action": "evict", "host": "h1", "policy": "straggler",
+                     "term": lease.term})
+        cmd = sup._next_command()
+        assert cmd is not None and cmd["host"] == "h1"
+        assert leader_mod.term_high_water() >= lease.term
+
+    def test_untermed_command_passes(self, store):
+        """Back-compat: commands from a pre-HA controller (or an operator
+        tool) carry no term and must keep working."""
+        bus, sup = self._supervisor(store)
+        bus.publish({"action": "evict", "host": "h2", "policy": "manual"})
+        cmd = sup._next_command()
+        assert cmd is not None and cmd["host"] == "h2"
+
+
+class TestLedgerReplication:
+    def _controller(self, store, agg, cid):
+        from paddle_tpu.distributed.fleet.controller import FleetController
+        lease = LeaderLease(store, controller_id=cid, ttl=0.3)
+        return FleetController(agg, None, 2, lease=lease)
+
+    def test_new_leader_inherits_decision_state(self, store):
+        """The successor must see the predecessor's cooldowns/evictions —
+        NOT double-evict a host mid-probation after a takeover."""
+
+        class _Agg:                   # collect() never called here
+            pass
+
+        c1 = self._controller(store, _Agg(), "c1")
+        assert c1.lease.tick() == "acquired"
+        with c1._lock:
+            c1._evicted["trainer-1"] = {"step": 7, "since": time.time()}
+            c1._decision_seq = 4
+            c1._ledger_dirty = True
+        blob = json.dumps(c1._ledger_snapshot())
+        store.set(leader_mod.LEDGER_KEY, blob)
+        c1.lease._leader = False      # c1 dies (stops renewing)
+        time.sleep(0.35)
+        c2 = self._controller(store, _Agg(), "c2")
+        _spin(c2.lease, lambda r: r == "acquired", timeout=5.0)
+        c2._load_ledger()
+        with c2._lock:
+            assert "trainer-1" in c2._evicted
+            assert c2._evicted["trainer-1"]["step"] == 7
+            assert c2._decision_seq >= 4

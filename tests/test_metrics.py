@@ -201,6 +201,7 @@ class TestMetricNamingLint:
         import paddle_tpu.distributed.collective  # noqa: F401
         import paddle_tpu.distributed.fleet.controller  # noqa: F401
         import paddle_tpu.distributed.fleet.elastic  # noqa: F401
+        import paddle_tpu.distributed.fleet.leader  # noqa: F401
         import paddle_tpu.distributed.fleet.telemetry  # noqa: F401
         import paddle_tpu.distributed.ps.cache  # noqa: F401
         import paddle_tpu.distributed.ps.communicator  # noqa: F401
@@ -282,6 +283,17 @@ class TestMetricNamingLint:
         _ctl._M_ROLLBACKS.inc(host="trainer-1")
         _ctl._M_READMISSIONS.inc(host="trainer-1")
         _ctl._M_FIRST_STEP.set(1.5, policy="straggler_evict")
+        # HA control plane families: election term gauge, takeovers
+        # (reason=), fenced stale actuations (policy=)
+        from paddle_tpu.distributed.fleet import leader as _ldr
+        _ldr._M_TERM.set(3)
+        _ldr._M_TAKEOVERS.inc(reason="lease_expired")
+        _ldr._M_FENCED.inc(policy="serving_restart")
+        # disaggregated-serving fault-tolerance families: worker
+        # respawns + requeues (reason=)
+        from paddle_tpu.inference import disagg as _dis
+        _dis._M_W_RESTARTS.inc()
+        _dis._M_REQUEUE.inc(reason="worker_dead")
         # continuous-batching serving families (model=, latency split by
         # decode path=) + the paged-KV decode kernel's autotune op riding
         # the existing families

@@ -95,10 +95,10 @@ class _FakeEngine:
     def queue_depth(self):
         return 3
 
-    def set_queue_limit(self, limit):
+    def set_queue_limit(self, limit, term=None):
         self.queue_limit = limit
 
-    def restart(self, reason="wedged"):
+    def restart(self, reason="wedged", term=None):
         if self.restart_error is not None:
             raise self.restart_error
         self.restarts.append(reason)

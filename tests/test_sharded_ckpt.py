@@ -391,8 +391,9 @@ class TestCorruptionFuzz:
 
     def test_lost_owner_rank_is_unrestorable_corrupt(self, tmp_path,
                                                      master):
-        """Losing the manifest of a rank that DID own chunks makes the
-        step corrupt (arrays cannot be reassembled), not partial."""
+        """Losing the manifest of a rank that DID own chunks — AND the
+        peer-written ``.mirror`` copy of it (PR 20) — makes the step
+        corrupt (arrays cannot be reassembled), not partial."""
         ms = [_mgr(tmp_path, master, r, 2) for r in range(2)]
         st = _state()
         ts = [threading.Thread(target=lambda r=r: ms[r].save(st, 1))
@@ -404,8 +405,122 @@ class TestCorruptionFuzz:
                   for p in sc.scan_step(sd).manifests[0]["arrays"]}
         assert owners == {0, 1}  # this state really is spread
         os.remove(os.path.join(sd, "manifest-r1.json"))
+        os.remove(os.path.join(sd, "manifest-r1.json.mirror"))
         status, _ = sc.verify_step(sd)
         assert status == "corrupt"
+
+
+class TestManifestMirrorFuzz:
+    """PR 20: each rank replicates peer ``(r+1)%world``'s committed
+    manifest to a ``.mirror`` copy, so losing ONE owner's manifest
+    downgrades the step to ``partial`` instead of ``corrupt``."""
+
+    def _two_rank_save(self, tmp_path, master, step=1):
+        ms = [_mgr(tmp_path, master, r, 2) for r in range(2)]
+        st = _state()
+        ts = [threading.Thread(target=lambda r=r: ms[r].save(st, step))
+              for r in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=60) for t in ts]
+        return ms, st
+
+    def test_every_rank_manifest_gets_a_peer_mirror(self, tmp_path, master):
+        ms, _ = self._two_rank_save(tmp_path, master)
+        sd = ms[0].path_for(1)
+        files = set(os.listdir(sd))
+        # ring topology: r0 mirrors r1's manifest and vice versa
+        assert {"manifest-r0.json.mirror",
+                "manifest-r1.json.mirror"} <= files
+        for r in range(2):
+            with open(os.path.join(sd, f"manifest-r{r}.json"), "rb") as a, \
+                    open(os.path.join(sd, f"manifest-r{r}.json.mirror"),
+                         "rb") as b:
+                assert a.read() == b.read()
+        # an intact step scans without touching the mirrors
+        scan = sc.scan_step(sd)
+        assert scan.mirrored == [] and set(scan.manifests) == {0, 1}
+        assert sc.verify_step(sd, deep=True)[0] == "complete"
+
+    def test_deleted_manifest_recovers_partial_via_mirror(self, tmp_path,
+                                                          master):
+        """The headline contract: losing one owner's manifest leaves the
+        step partial-restorable from the peer's mirror — the restore
+        returns the full state, and verify names the recovery."""
+        ms, st = self._two_rank_save(tmp_path, master)
+        sd = ms[0].path_for(1)
+        owners = {sc.owner_rank(p, 2)
+                  for p in sc.scan_step(sd).manifests[0]["arrays"]}
+        assert owners == {0, 1}  # rank 1 really owned chunks
+        os.remove(os.path.join(sd, "manifest-r1.json"))
+        scan = sc.scan_step(sd)
+        assert scan.mirrored == [1]
+        assert set(scan.manifests) == {0, 1}
+        status, detail = sc.verify_step(sd, deep=True)
+        assert status == "partial", detail
+        assert "recovered via peer-mirrored" in detail
+        got, step = open_manager(str(tmp_path)).load_latest()
+        assert step == 1
+        _assert_state_equal(got, st)
+
+    def test_garbled_manifest_recovers_partial_via_mirror(self, tmp_path,
+                                                          master):
+        """Bitrot, not loss: the torn original lands in bad_manifests
+        but the mirror still reassembles the step."""
+        ms, st = self._two_rank_save(tmp_path, master)
+        sd = ms[0].path_for(1)
+        open(os.path.join(sd, "manifest-r0.json"), "wb").write(
+            b"\x00garbage{{{")
+        scan = sc.scan_step(sd)
+        assert scan.mirrored == [0] and scan.bad_manifests
+        status, detail = sc.verify_step(sd, deep=True)
+        assert status == "partial", detail
+        got, _ = open_manager(str(tmp_path)).load_latest()
+        _assert_state_equal(got, st)
+
+    def test_corrupt_mirror_with_intact_original_is_harmless(self, tmp_path,
+                                                             master):
+        """Fuzzing the MIRROR must not downgrade a healthy step: an
+        unreadable mirror is skipped silently (never bad_manifests) and
+        an intact original always wins over a stale-but-valid mirror."""
+        ms, st = self._two_rank_save(tmp_path, master)
+        sd = ms[0].path_for(1)
+        mirror = os.path.join(sd, "manifest-r1.json.mirror")
+        open(mirror, "wb").write(b"\xff\xfe not json")
+        scan = sc.scan_step(sd)
+        assert scan.mirrored == [] and scan.bad_manifests == []
+        assert sc.verify_step(sd, deep=True)[0] == "complete"
+        # a VALID but divergent mirror must not shadow the original
+        with open(os.path.join(sd, "manifest-r1.json")) as f:
+            man = json.load(f)
+        man["chunks"] = []
+        open(mirror, "w").write(json.dumps(man))
+        scan = sc.scan_step(sd)
+        assert scan.mirrored == []
+        assert scan.manifests[1]["chunks"], "mirror shadowed the original"
+        got, _ = open_manager(str(tmp_path)).load_latest()
+        _assert_state_equal(got, st)
+
+    def test_single_rank_world_writes_no_mirror(self, tmp_path):
+        """world=1 has no peer: a self-mirror would silently change the
+        single-host corruption contract (a torn manifest must fall back
+        to the previous step, not self-heal)."""
+        m = _mgr(tmp_path)
+        m.save(_state(), 1)
+        m.save(_state(1.0), 2)  # the lag-1 backfill path runs too
+        for s in (1, 2):
+            assert not [fn for fn in os.listdir(m.path_for(s))
+                        if fn.endswith(".mirror")]
+
+    def test_orphan_sweep_drops_own_torn_mirror_tmp(self, tmp_path, master):
+        ms, _ = self._two_rank_save(tmp_path, master)
+        sd = ms[0].path_for(1)
+        torn = os.path.join(sd, "manifest-r1.json.mirror.tmp.r0")
+        open(torn, "wb").write(b"half")
+        peer = os.path.join(sd, "manifest-r0.json.mirror.tmp.r1")
+        open(peer, "wb").write(b"half")
+        ms[0]._sweep_orphans()
+        assert not os.path.exists(torn)   # own torn tmp swept
+        assert os.path.exists(peer)       # peer's file never touched
 
 
 # ---------------------------------------------------------------------------

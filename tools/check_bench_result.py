@@ -933,10 +933,16 @@ _CONTROLLER_FAMILIES = {
     "controller_rollbacks_total": ("counter", ("host",)),
     "controller_readmissions_total": ("counter", ("host",)),
     "controller_relaunch_to_first_step_seconds": ("gauge", ("policy",)),
+    # HA control plane: election term, takeovers, fenced stale actuations
+    "controller_leader_term": ("gauge", ()),
+    "controller_takeovers_total": ("counter", ("reason",)),
+    "controller_fenced_total": ("counter", ("policy",)),
 }
 
-#: legal controller_decision outcomes (the decision contract)
-_CONTROLLER_OUTCOMES = ("applied", "dry_run", "failed")
+#: legal controller_decision outcomes (the decision contract);
+#: `fenced` = the actuation carried a stale leadership term and was
+#: rejected at the actuation boundary
+_CONTROLLER_OUTCOMES = ("applied", "dry_run", "failed", "fenced")
 
 
 def _validate_controller_metrics(where: str, metrics: dict) -> List[str]:
@@ -981,6 +987,53 @@ def _validate_controller_metrics(where: str, metrics: dict) -> List[str]:
                     f"{where}.metrics.{name}[{i}]: outcome "
                     f"{labels.get('outcome')!r} not in "
                     f"{_CONTROLLER_OUTCOMES}")
+    return problems
+
+
+# disaggregated-serving fault-tolerance families: name -> (kind,
+# required labels)
+_DISAGG_FAMILIES = {
+    "disagg_worker_restarts_total": ("counter", ()),
+    "disagg_requeue_total": ("counter", ("reason",)),
+}
+
+
+def _validate_disagg_metrics(where: str, metrics: dict) -> List[str]:
+    """`disagg_*` families must be the documented kind, carry their
+    required labels, and hold non-negative values — the disaggregated
+    pipeline's fault-tolerance observability contract."""
+    problems = []
+    for name, fam in metrics.items():
+        if not name.startswith("disagg_"):
+            continue
+        spec = _DISAGG_FAMILIES.get(name)
+        if spec is None:
+            problems.append(f"{where}.metrics.{name}: unknown disagg "
+                            f"family (expected one of "
+                            f"{sorted(_DISAGG_FAMILIES)})")
+            continue
+        kind, req_labels = spec
+        if not isinstance(fam, dict) or fam.get("kind") != kind:
+            problems.append(
+                f"{where}.metrics.{name}: kind "
+                f"{fam.get('kind') if isinstance(fam, dict) else fam!r}"
+                f", expected {kind}")
+            continue
+        for i, v in enumerate(fam.get("values") or []):
+            if not isinstance(v, dict):
+                problems.append(f"{where}.metrics.{name}[{i}] is not a "
+                                f"series object")
+                continue
+            val = v.get("value")
+            if not isinstance(val, (int, float)) or isinstance(val, bool) \
+                    or val != val or val < 0:
+                problems.append(f"{where}.metrics.{name}[{i}]: value "
+                                f"{val!r} is not a non-negative number")
+            labels = v.get("labels") or {}
+            for lk in req_labels:
+                if lk not in labels:
+                    problems.append(f"{where}.metrics.{name}[{i}]: series "
+                                    f"missing the {lk!r} label")
     return problems
 
 
@@ -1320,8 +1373,9 @@ def validate_observability(doc: dict) -> List[str]:
     events/events_tail to the event contract (`controller_decision`
     events additionally to the decision contract: policy/action/legal
     outcome/decision id), `checkpoint_async_*` / `device_memory_*` /
-    `health_*` / `amp_*` / `autotune_*` / `controller_*` / `serving_*` /
-    `slo_*` / `analysis_*` metric families to their kind/label/shape
+    `health_*` / `amp_*` / `autotune_*` / `controller_*` / `disagg_*` /
+    `serving_*` / `slo_*` / `analysis_*` metric families to their
+    kind/label/shape
     contracts, `reqtrace`/`slo` observability blocks to the request-trace
     and SLO-window shapes (quantiles finite + monotone p50<=p95<=p99,
     breach counts non-negative),
@@ -1370,6 +1424,7 @@ def validate_observability(doc: dict) -> List[str]:
             problems.extend(_validate_health_metrics(where, metrics))
             problems.extend(_validate_autotune_metrics(where, metrics))
             problems.extend(_validate_controller_metrics(where, metrics))
+            problems.extend(_validate_disagg_metrics(where, metrics))
             problems.extend(_validate_serving_metrics(where, metrics))
             problems.extend(_validate_slo_metrics(where, metrics))
             problems.extend(_validate_analysis_metrics(where, metrics))

@@ -34,8 +34,10 @@ from the old world size in a long-lived `--host-store` rendezvous store
 cannot wedge the new fleet's watch. The classic per-host file layout
 still requires relaunching with the SAME --np.
 
-Self-driving fleet (`--controller[=dry-run]`, pass on exactly ONE host,
-normally rank 0): the supervisor runs the FleetController
+Self-driving fleet (`--controller[=dry-run]`, pass on ANY number of
+hosts — the controllers lease-elect ONE leader over the rendezvous
+store; the rest stand by and take over within one lease TTL): each
+supervisor given the flag runs a FleetController
 (`distributed/fleet/controller.py`) on a background aggregator poll —
 a confirmed persistent straggler is EVICTED (every supervisor relaunches
 its trainer at N-1 with re-densified ranks, resuming from the newest
@@ -83,11 +85,15 @@ def parse_args(argv=None):
     p.add_argument("--controller", nargs="?", const="on", default=None,
                    choices=["on", "dry-run"],
                    help="run the self-driving fleet controller in THIS "
-                        "supervisor (pass it on exactly one host, "
-                        "normally rank 0): consume fleet digests + "
-                        "health/straggler signals and act — evict a "
+                        "supervisor. Pass it on any number of hosts: "
+                        "controllers lease-elect one leader over the "
+                        "rendezvous store (term-fenced; standbys take "
+                        "over within PADDLE_TPU_CONTROLLER_LEASE_TTL "
+                        "seconds and inherit the decision ledger). The "
+                        "leader consumes fleet digests + "
+                        "health/straggler signals and acts — evicts a "
                         "confirmed straggler (fleet relaunches at N-1, "
-                        "scales back on readmission), escalate one "
+                        "scales back on readmission), escalates one "
                         "host's divergence to a fleet-wide rollback. "
                         "--controller=dry-run logs every decision "
                         "without acting")
@@ -289,8 +295,9 @@ def main(argv=None) -> int:
             agg, TCPStore(host, int(port), timeout=10),
             world_size=args.np, dry_run=(args.controller == "dry-run"))
         agg.start_polling(hook=controller.on_collect)
+        role = "leader-elect" if controller.lease is not None else "solo"
         print(f"[elastic_run] fleet controller active "
-              f"({'dry-run' if controller.dry_run else 'acting'}, "
+              f"({'dry-run' if controller.dry_run else 'acting'}, {role}, "
               f"confirm_windows={controller.confirm_windows})",
               file=sys.stderr)
 
@@ -337,15 +344,25 @@ def main(argv=None) -> int:
     finally:
         if controller is not None:
             # held peers poll ctl/job_done to exit cleanly once the fleet
-            # is finished (with or without them)
+            # is finished (with or without them) — but only the LEADER
+            # declares the job done; a standby exiting must not tear the
+            # fleet down under the live leader
             try:
-                controller.bus.mark_job_done()
+                if controller.is_leader():
+                    controller.bus.mark_job_done()
             except Exception:
                 pass
             try:
                 agg.stop_polling()
             except Exception:
                 pass
+            if controller.lease is not None:
+                # voluntary handoff: deleting the lease lets a standby
+                # take over immediately instead of waiting out the TTL
+                try:
+                    controller.lease.release()
+                except Exception:
+                    pass
             from paddle_tpu.distributed.fleet.controller import (
                 set_controller)
             set_controller(None)

@@ -70,6 +70,12 @@ except Exception:
 
 SERVING_KINDS = ("serving_admission", "serving_eviction")
 
+#: the HA control-plane view: decisions plus the election/fencing
+#: lifecycle (who leads at what term, takeovers, fenced stale
+#: actuations, and the nobody-leads alarm)
+CONTROLLER_KINDS = ("controller_decision", "controller_takeover",
+                    "controller_fenced", "fleet_leaderless")
+
 SLO_KINDS = ("slo_breach", "request_trace", "serving_swap",
              "serving_restart")
 
@@ -235,6 +241,26 @@ def format_controller(rec: dict) -> str:
         when = datetime.fromtimestamp(float(ts)).strftime("%H:%M:%S.%f")[:-3]
     except (TypeError, ValueError, OSError):
         when = "??:??:??.???"
+    kind = rec.get("kind", "controller_decision")
+    if kind == "controller_takeover":
+        detail = (f"leader={rec.get('leader', '?')} term={rec.get('term')} "
+                  f"took over ({rec.get('reason', '?')})")
+        return (f"{when} {rec.get('severity', 'warn'):<5} "
+                f"{'takeover':<20} {rec.get('host', '?'):<16} {detail}")
+    if kind == "controller_fenced":
+        detail = (f"stale term {rec.get('term')} < current "
+                  f"{rec.get('current_term')} — dropped "
+                  f"{rec.get('action', rec.get('policy', '?'))}")
+        if rec.get("target"):
+            detail += f" target={rec['target']}"
+        return (f"{when} {rec.get('severity', 'warn'):<5} "
+                f"{'fenced':<20} {rec.get('host', '?'):<16} {detail}")
+    if kind == "fleet_leaderless":
+        detail = (f"no live leader for {rec.get('silent_s')}s "
+                  f"(ttl={rec.get('ttl_s')}s; last lease: "
+                  f"leader={rec.get('leader', '?')} term={rec.get('term')})")
+        return (f"{when} {rec.get('severity', 'warn'):<5} "
+                f"{'leaderless':<20} {rec.get('host', '?'):<16} {detail}")
     policy = rec.get("policy", "?")
     outcome = rec.get("outcome", "?")
     if rec.get("action") == "relaunch_observed":
@@ -401,7 +427,7 @@ def _emit(events, as_json: bool, out=None, diagnose: bool = False,
             line = format_diagnosis(rec)
         elif health and rec.get("kind") in HEALTH_KINDS:
             line = format_health(rec)
-        elif controller and rec.get("kind") == "controller_decision":
+        elif controller and rec.get("kind") in CONTROLLER_KINDS:
             line = format_controller(rec)
         elif serving and rec.get("kind") in SERVING_KINDS:
             line = format_serving(rec)
@@ -513,10 +539,13 @@ def main(argv=None) -> int:
                          "with an operator-oriented rendering; filters to "
                          "those kinds unless --kind is given")
     ap.add_argument("--controller", action="store_true",
-                    help="show fleet-controller decisions "
-                         "(controller_decision: policy, evidence, action, "
-                         "outcome) with an operator-oriented rendering; "
-                         "filters to that kind unless --kind is given")
+                    help="show the HA control plane (controller_decision: "
+                         "policy, evidence, action, outcome; "
+                         "controller_takeover: leader id, term, reason; "
+                         "controller_fenced: stale-term actuation dropped; "
+                         "fleet_leaderless: no live lease) with an "
+                         "operator-oriented rendering; filters to those "
+                         "kinds unless --kind is given")
     ap.add_argument("--serving", action="store_true",
                     help="show continuous-batching serving events "
                          "(serving_admission / serving_eviction: slot, "
@@ -551,13 +580,14 @@ def main(argv=None) -> int:
         # decomposition in one stream
         args.kind = HEALTH_KINDS + ("step_diagnosis",)
     if args.controller:
-        # composes with --health/--diagnose: decisions join the stream
+        # composes with --health/--diagnose: the control plane joins
+        # the stream (decisions + election/fencing lifecycle)
         if args.kind is None:
-            args.kind = "controller_decision"
+            args.kind = CONTROLLER_KINDS
         elif isinstance(args.kind, tuple):
-            args.kind = args.kind + ("controller_decision",)
-        elif args.kind != "controller_decision":
-            args.kind = (args.kind, "controller_decision")
+            args.kind = args.kind + CONTROLLER_KINDS
+        elif args.kind not in CONTROLLER_KINDS:
+            args.kind = (args.kind,) + CONTROLLER_KINDS
     if args.serving:
         # composes with the other operator views the same way
         if args.kind is None:
